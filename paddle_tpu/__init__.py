@@ -1,0 +1,84 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+A from-scratch framework with the API surface of the reference (a PaddlePaddle
+dev snapshot, see SURVEY.md) built on JAX/XLA/Pallas/pjit: eager tensors with
+define-by-run autograd, nn layers/optimizers/dataloaders, jit compilation of
+dygraph code, bf16 AMP, and a full hybrid-parallel distributed stack mapped
+onto TPU meshes (ICI/DCN) instead of NCCL.
+"""
+
+from __future__ import annotations
+
+import jax as _jax
+
+# int64/float64 must exist as real dtypes (reference semantics: int64 is the
+# default integer type). Float defaults remain float32 — creation ops and
+# `to_tensor` normalize python floats to the framework default dtype.
+_jax.config.update("jax_enable_x64", True)
+
+# -- core ------------------------------------------------------------------
+from .core.dtype import (  # noqa: F401
+    DType, bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    get_default_dtype, set_default_dtype, finfo, iinfo,
+)
+from .core.dtype import bool_ as bool  # noqa: F401  (paddle.bool)
+from .core.tensor import Tensor, to_tensor, is_tensor  # noqa: F401
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .core.generator import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core import enforce  # noqa: F401
+
+# -- autograd --------------------------------------------------------------
+from .autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad  # noqa: F401
+from . import autograd  # noqa: F401
+
+# -- ops (flat paddle.* namespace) ----------------------------------------
+from .ops import *  # noqa: F401,F403
+from . import ops  # noqa: F401
+from .ops import linalg  # noqa: F401
+
+# -- framework -------------------------------------------------------------
+from .framework.io import save, load  # noqa: F401
+from .framework.framework import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, get_device, set_device, is_compiled_with_cuda,
+    is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_custom_device,
+    in_dynamic_mode, device_count,
+)
+from .framework.parameter import create_parameter  # noqa: F401
+
+# -- subpackages (paddle.nn, paddle.optimizer, ...) ------------------------
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import device  # noqa: F401
+from . import framework  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # heavy subpackages loaded lazily to keep import light
+    if name == "distributed":
+        import importlib
+        mod = importlib.import_module(".distributed", __name__)
+        globals()["distributed"] = mod
+        return mod
+    if name == "profiler":
+        import importlib
+        mod = importlib.import_module(".profiler", __name__)
+        globals()["profiler"] = mod
+        return mod
+    if name == "vision":
+        import importlib
+        mod = importlib.import_module(".vision", __name__)
+        globals()["vision"] = mod
+        return mod
+    if name == "incubate":
+        import importlib
+        mod = importlib.import_module(".incubate", __name__)
+        globals()["incubate"] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

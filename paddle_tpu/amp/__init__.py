@@ -1,0 +1,4 @@
+from .auto_cast import auto_cast, amp_guard, decorate, amp_decorate, is_auto_cast_enabled, get_amp_dtype  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+from . import amp_lists  # noqa: F401
+from .debugging import check_numerics, enable_operator_stats_collection, disable_operator_stats_collection  # noqa: F401

@@ -1,0 +1,34 @@
+"""AMP op lists (reference: python/paddle/amp/amp_lists.py + the generated
+C++ lists in paddle/fluid/eager/api/generated).
+
+O1 ("white") ops run in low precision; "black" ops stay fp32; the rest follow
+their inputs. On TPU bf16 is the native low-precision type, so the default
+low dtype is bfloat16 (no loss scaling needed).
+"""
+
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "addmm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "scaled_dot_product_attention",
+}
+
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "cos_sim",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "kl_div", "cumsum",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "norm", "logsumexp", "erfinv", "pow", "divide",
+}
+
+EXTRA_BLACK_LIST_O2 = {
+    "lookup_table", "lookup_table_v2", "scatter",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)

@@ -1,0 +1,129 @@
+"""AMP autocast (reference: python/paddle/amp/auto_cast.py:273 amp_guard).
+
+Mirrors the reference's op-granular insertion (eager_amp_auto_cast.h): the
+autograd `apply` consults this module's thread-local state and casts floating
+inputs per the white/black lists. On TPU the low dtype defaults to bfloat16.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from . import amp_lists
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate",
+           "is_auto_cast_enabled", "get_amp_dtype", "amp_state"]
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white = amp_lists.white_list()
+        self.black = amp_lists.black_list()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state.enabled
+
+
+def get_amp_dtype() -> str:
+    return jnp.dtype(_state.dtype).name
+
+
+def cast_for_op(name: str, arrays):
+    """Called by autograd.apply: cast float arrays per amp policy."""
+    if not _state.enabled:
+        return arrays
+    low = _state.dtype
+    if _state.level == "O2":
+        # O2: everything low precision except black-listed ops
+        target = jnp.float32 if name in _state.black else low
+    else:
+        if name in _state.white:
+            target = low
+        elif name in _state.black:
+            target = jnp.float32
+        else:
+            return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != jnp.dtype(target):
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.white, _state.black)
+    _state.enabled = bool(enable)
+    _state.dtype = dtypes.dtype_from_any(dtype).np_dtype
+    _state.level = level
+    white = amp_lists.white_list()
+    black = amp_lists.black_list()
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    _state.white, _state.black = white, black
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.white,
+         _state.black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 decoration: cast model params to the low dtype, keep master weights
+    in the optimizer (reference: python/paddle/amp/auto_cast.py decorate)."""
+    from ..nn.layer import Layer
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        dt = dtypes.dtype_from_any(dtype)
+        excluded = set()
+        for m in model_list:
+            from ..nn.layers.norm import _BatchNormBase, LayerNorm
+            for sub in m.sublayers(include_self=True):
+                if isinstance(sub, (_BatchNormBase, LayerNorm)):
+                    excluded.add(id(sub))
+            for sub in m.sublayers(include_self=True):
+                if id(sub) in excluded:
+                    continue
+                for p in sub.parameters(include_sublayers=False):
+                    if dtypes.is_floating_point(p.dtype):
+                        p._data = p._data.astype(dt.np_dtype)
+        if optimizers is not None:
+            opts = optimizers if isinstance(optimizers, (list, tuple)) \
+                else [optimizers]
+            for o in opts:
+                o._multi_precision = True
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+amp_decorate = decorate

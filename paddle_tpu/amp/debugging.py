@@ -1,0 +1,78 @@
+"""AMP debugging utilities (reference: python/paddle/amp/debugging.py)."""
+
+from __future__ import annotations
+
+import contextlib
+from collections import Counter
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["check_numerics", "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker"]
+
+_op_stats: Counter | None = None
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    t = to_tensor(tensor)
+    if jnp.issubdtype(t._data.dtype, jnp.floating):
+        n_nan = int(jnp.sum(jnp.isnan(t._data)))
+        n_inf = int(jnp.sum(jnp.isinf(t._data)))
+        if n_nan or n_inf:
+            raise FloatingPointError(
+                f"numerics check failed for op={op_type!r} var={var_name!r}: "
+                f"{n_nan} NaN, {n_inf} Inf")
+    return Tensor(jnp.zeros(3, jnp.float32))
+
+
+def enable_operator_stats_collection():
+    global _op_stats
+    _op_stats = Counter()
+
+
+def disable_operator_stats_collection():
+    global _op_stats
+    stats, _op_stats = _op_stats, None
+    if stats:
+        print("<------------------------------ op list ------------------------------>")
+        for name, count in sorted(stats.items()):
+            print(f"  {name:40s} calls={count}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def record_op(name: str):
+    if _op_stats is not None:
+        _op_stats[name] += 1
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=False, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None, debug_step=None,
+                 stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    from ..core.flags import set_flags
+    if config.enable:
+        set_flags({"check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    from ..core.flags import set_flags
+    set_flags({"check_nan_inf": False})

@@ -1,0 +1,4 @@
+from .grad_mode import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .engine import backward, grad  # noqa: F401
+from .function import apply, apply_multi, GradNode  # noqa: F401
+from .pylayer import PyLayer, PyLayerContext  # noqa: F401

@@ -1,0 +1,129 @@
+"""The eager autograd core: `apply` builds the define-by-run grad graph.
+
+Reference analog: the generated `*_ad_func` C++ functions + `GradNodeBase`
+(paddle/fluid/eager/grad_node_info.h:197). Here every differentiable op is a
+pure JAX function over arrays; `apply` runs it and — when grad is required —
+records a `GradNode` holding the `jax.vjp` residual closure. Because `jax.vjp`
+is traceable, an entire eager forward+backward executes unchanged inside
+`jax.jit` (this is how `paddle_tpu.jit.to_static` compiles dygraph code).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .grad_mode import is_grad_enabled
+
+__all__ = ["GradNode", "apply", "apply_multi"]
+
+
+class GradNode:
+    """One recorded op in the grad graph.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents (a tuple, one per
+    traced input array). ``inputs`` holds the producing Tensors (or None for
+    non-Tensor / stop-gradient inputs, whose cotangents are dropped).
+    ``jfn``/``raw_inputs`` keep the primal so higher-order grad
+    (create_graph=True) can re-derive the vjp symbolically through `apply`.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_meta", "multi_out", "consumed",
+                 "jfn", "raw_inputs")
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any],
+                 out_meta: list[tuple[tuple[int, ...], Any]], multi_out: bool,
+                 jfn: Callable | None = None, raw_inputs: Sequence[Any] = ()):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.out_meta = out_meta  # [(shape, dtype)] per output, for zero cotangents
+        self.multi_out = multi_out
+        self.consumed = False
+        self.jfn = jfn
+        self.raw_inputs = list(raw_inputs)
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_in={len(self.inputs)} n_out={len(self.out_meta)}>"
+
+
+def _check_nan_inf(name: str, arrays) -> None:
+    from ..core.flags import flag
+    if not flag("check_nan_inf"):
+        return
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            # Eager-only debugging aid (reference: FLAGS_check_nan_inf,
+            # paddle/fluid/eager/nan_inf_utils.h). Skipped while tracing.
+            if isinstance(a, jax.core.Tracer):
+                return
+            bad = jnp.any(~jnp.isfinite(a))
+            if bool(bad):
+                raise FloatingPointError(f"NaN/Inf detected in output of op {name!r}")
+
+
+def apply(jfn: Callable, *inputs, name: str | None = None):
+    """Run ``jfn`` over the unwrapped inputs; record a GradNode if needed.
+
+    ``inputs`` may be Tensors, jax arrays, or python scalars. ``jfn`` must be a
+    pure function over arrays returning a single array.
+    """
+    return _apply_impl(jfn, inputs, name or getattr(jfn, "__name__", "op"), multi=False)
+
+
+def apply_multi(jfn: Callable, *inputs, name: str | None = None):
+    """Like `apply` for ops returning a tuple of arrays (all differentiable)."""
+    return _apply_impl(jfn, inputs, name or getattr(jfn, "__name__", "op"), multi=True)
+
+
+def _apply_impl(jfn, inputs, name, multi):
+    from ..core.tensor import Tensor
+    from ..amp.auto_cast import amp_state, cast_for_op
+    from ..amp.debugging import record_op
+
+    record_op(name)
+    if amp_state().enabled:
+        # op-granular autocast inside the traced fn so vjp casts grads back
+        # (reference: eager_amp_auto_cast.h insertion in generated ad_funcs)
+        inner = jfn
+        jfn = lambda *arrs: inner(*cast_for_op(name, arrs))  # noqa: E731
+
+    arrays = []
+    tensor_in: list[Tensor | None] = []
+    need = False
+    grad_on = is_grad_enabled()
+    for a in inputs:
+        if isinstance(a, Tensor):
+            arrays.append(a._data)
+            tensor_in.append(a)
+            if grad_on and not a.stop_gradient:
+                need = True
+        else:
+            arrays.append(a)
+            tensor_in.append(None)
+
+    if not need:
+        out = jfn(*arrays)
+        outs = out if multi else (out,)
+        _check_nan_inf(name, outs)
+        wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        return wrapped if multi else wrapped[0]
+
+    out, vjp_fn = jax.vjp(jfn, *arrays)
+    outs = out if multi else (out,)
+    _check_nan_inf(name, outs)
+    diffable = [jnp.issubdtype(o.dtype, jnp.inexact) for o in outs]
+    if not any(diffable):
+        # e.g. argmax of a differentiable input: nothing to record.
+        wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        return wrapped if multi else wrapped[0]
+    out_meta = [(tuple(o.shape), o.dtype) for o in outs]
+    node = GradNode(name, vjp_fn, tensor_in, out_meta, multi,
+                    jfn=jfn, raw_inputs=arrays)
+    wrapped = tuple(
+        Tensor(o, stop_gradient=not d, node=node, out_index=i)
+        for i, (o, d) in enumerate(zip(outs, diffable))
+    )
+    return wrapped if multi else wrapped[0]

@@ -1,0 +1,59 @@
+"""Gradient-mode switches (`paddle.no_grad`, `paddle.enable_grad`, ...)."""
+
+from __future__ import annotations
+
+import threading
+from contextlib import ContextDecorator
+
+__all__ = ["no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled"]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+class set_grad_enabled(ContextDecorator):
+    def __init__(self, mode: bool):
+        self.mode = bool(mode)
+        self.prev = _state.enabled
+        _state.enabled = self.mode
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self.prev
+        return False
+
+
+class no_grad(ContextDecorator):
+    """Context-manager / decorator disabling grad recording (reference:
+    python/paddle/base/dygraph/base.py no_grad_)."""
+
+    def __enter__(self):
+        self.prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self.prev
+        return False
+
+
+class enable_grad(ContextDecorator):
+    def __enter__(self):
+        self.prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self.prev
+        return False

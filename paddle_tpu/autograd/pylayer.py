@@ -1,0 +1,109 @@
+"""PyLayer: user-defined forward/backward inside the autograd graph.
+
+Reference: paddle/fluid/eager/pylayer/ + pybind eager_py_layer.cc. The forward
+runs under no_grad; a GradNode wired to the user's `backward` replaces the
+recorded graph, exactly like the reference's PyLayerGradNode.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .function import GradNode
+from .grad_mode import no_grad, is_grad_enabled
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self._non_differentiable = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    # torch-style alias used by some reference tests
+    saved_tensors = property(lambda self: self._saved)
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.tensor import Tensor
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        need = is_grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        if not need:
+            return outs
+
+        non_diff_ids = {id(t) for t in ctx._non_differentiable}
+        diffable = [isinstance(o, Tensor) and id(o) not in non_diff_ids and
+                    jnp.issubdtype(o._data.dtype, jnp.inexact) for o in out_list]
+        if not any(diffable):
+            return outs
+
+        out_meta = [(tuple(o._data.shape), o._data.dtype) if isinstance(o, Tensor)
+                    else ((), jnp.float32.dtype) for o in out_list]
+        # inputs aligned with forward's positional tensor args
+        node_inputs = [a if isinstance(a, Tensor) else None for a in args]
+
+        def vjp_fn(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            grads_in = [Tensor(c) if c is not None and getattr(c, "dtype", None)
+                        is not None and jnp.issubdtype(c.dtype, jnp.inexact)
+                        else None for c in cts]
+            # only pass grads for differentiable outputs, in order
+            with no_grad():
+                res = cls.backward(ctx, *[g for g, d in zip(grads_in, diffable) if d])
+            res_list = [res] if isinstance(res, Tensor) or res is None else list(res)
+            out = []
+            it = iter(res_list)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(it, None)
+                    out.append(jnp.zeros(a._data.shape, a._data.dtype)
+                               if g is None else
+                               (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+                else:
+                    out.append(None)
+            return tuple(out)
+
+        node = GradNode(cls.__name__, vjp_fn, node_inputs, out_meta, multi_out=True)
+        wrapped = []
+        for i, (o, d) in enumerate(zip(out_list, diffable)):
+            if isinstance(o, Tensor) and d:
+                wrapped.append(Tensor(o._data, stop_gradient=False, node=node,
+                                      out_index=i))
+            else:
+                wrapped.append(o)
+        return wrapped[0] if single else tuple(wrapped)

@@ -1,0 +1,2 @@
+from . import dtype, enforce, flags, generator  # noqa: F401
+from .tensor import Tensor, Parameter, to_tensor, is_tensor  # noqa: F401

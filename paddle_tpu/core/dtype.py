@@ -1,0 +1,203 @@
+"""Dtype system for the framework.
+
+Mirrors the reference's dtype surface (paddle/phi/common/data_type.h and the
+Python `paddle.dtype` enum exposed via pybind) with JAX dtypes as the substrate.
+We expose the same names users expect (`float32`, `bfloat16`, `int64`, ...)
+plus helpers used by AMP and type-promotion logic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+__all__ = [
+    "DType",
+    "bool_",
+    "uint8",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "complex64",
+    "complex128",
+    "float8_e4m3fn",
+    "float8_e5m2",
+    "dtype_from_any",
+    "is_floating_point",
+    "is_integer",
+    "is_complex",
+    "get_default_dtype",
+    "set_default_dtype",
+    "promote_types",
+    "finfo",
+    "iinfo",
+]
+
+
+class DType:
+    """A lightweight dtype handle wrapping a numpy dtype.
+
+    Comparable to `paddle.dtype`; interoperates with numpy/jax dtypes and
+    strings. Singleton per canonical dtype name.
+    """
+
+    _registry: dict[str, "DType"] = {}
+
+    __slots__ = ("name", "np_dtype")
+
+    def __new__(cls, name: str, np_dtype):
+        if name in cls._registry:
+            return cls._registry[name]
+        self = object.__new__(cls)
+        object.__setattr__(self, "name", name)
+        object.__setattr__(self, "np_dtype", np.dtype(np_dtype))
+        cls._registry[name] = self
+        return self
+
+    def __setattr__(self, key, value):  # immutable
+        raise AttributeError("DType is immutable")
+
+    def __reduce__(self):  # pickle/copy/deepcopy preserve the singleton
+        return (_dtype_by_name, (self.name,))
+
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+    @property
+    def is_floating(self) -> bool:
+        return is_floating_point(self)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __str__(self):
+        return self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        try:
+            return self.np_dtype == np.dtype(_np_of(other))
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+
+def _dtype_by_name(name: str) -> "DType":
+    return DType._registry[name]
+
+
+def _np_of(d):
+    if isinstance(d, DType):
+        return d.np_dtype
+    if d is bool:
+        return np.bool_
+    if d is int:
+        return np.int64
+    if d is float:
+        return np.float32
+    if isinstance(d, str):
+        s = d
+        if s == "bool":
+            s = "bool_"
+        if s in DType._registry:
+            return DType._registry[s].np_dtype
+    return d
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", ml_dtypes.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", ml_dtypes.float8_e5m2)
+
+_FLOATING = {"float16", "bfloat16", "float32", "float64", "float8_e4m3fn", "float8_e5m2"}
+_INTEGER = {"uint8", "int8", "int16", "int32", "int64"}
+_COMPLEX = {"complex64", "complex128"}
+
+_BY_NP: dict[np.dtype, DType] = {d.np_dtype: d for d in DType._registry.values()}
+
+
+def dtype_from_any(d) -> DType:
+    """Coerce a string / numpy dtype / jax dtype / DType into a DType."""
+    if d is None:
+        return get_default_dtype()
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        name = {"bool_": "bool"}.get(d, d)
+        if name in DType._registry:
+            return DType._registry[name]
+    npd = np.dtype(_np_of(d))
+    if npd in _BY_NP:
+        return _BY_NP[npd]
+    raise TypeError(f"unsupported dtype: {d!r}")
+
+
+def is_floating_point(d) -> bool:
+    return dtype_from_any(d).name in _FLOATING
+
+
+def is_integer(d) -> bool:
+    return dtype_from_any(d).name in _INTEGER
+
+
+def is_complex(d) -> bool:
+    return dtype_from_any(d).name in _COMPLEX
+
+
+_default_dtype = float32
+
+
+def get_default_dtype() -> DType:
+    return _default_dtype
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    d = dtype_from_any(d)
+    if not is_floating_point(d):
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def promote_types(a, b) -> DType:
+    """Numpy-style promotion, restricted to our dtype set (uses jnp rules)."""
+    ra = jnp.promote_types(dtype_from_any(a).np_dtype, dtype_from_any(b).np_dtype)
+    return dtype_from_any(ra)
+
+
+def finfo(d):
+    return ml_dtypes.finfo(dtype_from_any(d).np_dtype)
+
+
+def iinfo(d):
+    return np.iinfo(dtype_from_any(d).np_dtype)

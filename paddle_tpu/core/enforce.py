@@ -1,0 +1,90 @@
+"""Error reporting: typed errors + enforce helpers.
+
+Analog of the reference's `PADDLE_ENFORCE*` macros (paddle/fluid/platform/enforce.h)
+and typed error codes (paddle/phi/core/errors.h). Python exceptions carry the
+error category; `enforce` collapses the macro family into a callable.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet",
+    "InvalidArgumentError",
+    "NotFoundError",
+    "OutOfRangeError",
+    "AlreadyExistsError",
+    "PreconditionNotMetError",
+    "PermissionDeniedError",
+    "UnimplementedError",
+    "UnavailableError",
+    "FatalError",
+    "ExecutionTimeoutError",
+    "enforce",
+    "enforce_eq",
+    "enforce_gt",
+    "enforce_shape_match",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base framework error (reference: platform::EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+def enforce(cond: bool, msg: str = "", error: type = InvalidArgumentError) -> None:
+    if not cond:
+        raise error(msg or "enforce failed")
+
+
+def enforce_eq(a, b, msg: str = "") -> None:
+    if a != b:
+        raise InvalidArgumentError(f"expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_gt(a, b, msg: str = "") -> None:
+    if not a > b:
+        raise InvalidArgumentError(f"expected {a!r} > {b!r}. {msg}")
+
+
+def enforce_shape_match(shape_a, shape_b, msg: str = "") -> None:
+    if tuple(shape_a) != tuple(shape_b):
+        raise InvalidArgumentError(f"shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)}. {msg}")

@@ -1,0 +1,110 @@
+"""Runtime flag system.
+
+The reference exposes ~105 `PHI_DEFINE_EXPORTED_*` flags (paddle/phi/core/flags.cc,
+macros at flags.h:145-196) settable via env vars (``FLAGS_*``) and
+``paddle.set_flags``/``get_flags``. We reproduce that surface: flags are declared
+with a type + default + help, env overrides are read at declaration time, and
+`set_flags`/`get_flags` operate on the global registry. Callbacks let subsystems
+react to flag changes (e.g. matmul precision).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag", "FLAGS"]
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    value: Any
+    default: Any
+    help: str
+    on_change: list[Callable[[Any], None]] = field(default_factory=list)
+
+
+_REGISTRY: dict[str, _Flag] = {}
+
+
+def _coerce(ty: type, v: Any) -> Any:
+    if ty is bool and isinstance(v, str):
+        return v.lower() in ("1", "true", "yes", "on")
+    return ty(v)
+
+
+def define_flag(name: str, default: Any, help: str = "", type: type | None = None,
+                on_change: Callable[[Any], None] | None = None):
+    """Declare a runtime flag. Env var ``FLAGS_<name>`` overrides the default."""
+    ty = type if type is not None else default.__class__
+    env = os.environ.get(f"FLAGS_{name}")
+    value = _coerce(ty, env) if env is not None else default
+    f = _Flag(name=name, type=ty, value=value, default=default, help=help)
+    if on_change is not None:
+        f.on_change.append(on_change)
+    _REGISTRY[name] = f
+    return f
+
+
+def flag(name: str) -> Any:
+    """Read a flag's current value."""
+    return _REGISTRY[name].value
+
+
+def set_flags(flags: dict[str, Any]) -> None:
+    """`paddle.set_flags` equivalent."""
+    for k, v in flags.items():
+        k = k.removeprefix("FLAGS_")
+        if k not in _REGISTRY:
+            raise KeyError(f"unknown flag FLAGS_{k}")
+        f = _REGISTRY[k]
+        f.value = _coerce(f.type, v)
+        for cb in f.on_change:
+            cb(f.value)
+
+
+def get_flags(names=None) -> dict[str, Any]:
+    """`paddle.get_flags` equivalent; None returns all flags."""
+    if names is None:
+        names = list(_REGISTRY)
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        k = n.removeprefix("FLAGS_")
+        out[f"FLAGS_{k}"] = _REGISTRY[k].value
+    return out
+
+
+class _FlagsNamespace:
+    """Attribute-style access: ``FLAGS.check_nan_inf``."""
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return _REGISTRY[name].value
+        except KeyError:
+            raise AttributeError(f"unknown flag {name!r}") from None
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        set_flags({name: value})
+
+
+FLAGS = _FlagsNamespace()
+
+# ---------------------------------------------------------------------------
+# Core flags (analogs of the reference's most-used PHI flags).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf after each eager op", bool)
+define_flag("matmul_precision", "default",
+            "jax matmul precision: default|high|highest|bfloat16|tensorfloat32|float32", str)
+define_flag("use_pallas_kernels", True, "use pallas fused kernels on TPU where available", bool)
+define_flag("eager_delete_tensor_gb", 0.0, "kept for API parity; XLA manages memory", float)
+define_flag("allocator_strategy", "auto_growth", "kept for API parity; XLA manages memory", str)
+define_flag("benchmark", False, "block_until_ready after each eager op for timing", bool)
+define_flag("log_level", 1, "framework VLOG level (0=off)", int)
+define_flag("cudnn_deterministic", False, "parity alias: request deterministic XLA reductions", bool)
+define_flag("conv_workspace_size_limit", 512, "parity alias; unused on TPU", int)
+define_flag("embedding_deterministic", 0, "parity alias; unused on TPU", int)

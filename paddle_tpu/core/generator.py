@@ -1,0 +1,150 @@
+"""RNG state management.
+
+The reference keeps per-device generator state (paddle/phi/core/generator.h) with
+global `paddle.seed` control plus the fleet's per-mp-rank seed trees
+(python/paddle/distributed/fleet/layers/mpu/random.py). On TPU the substrate is
+JAX's splittable threefry keys. We keep a *global stateful generator* for the
+eager/dygraph feel (each random op consumes a fresh split) and named state
+trackers for parallel RNG isolation (model-parallel dropout must differ across
+tp ranks but match inside a rank; see RNGStatesTracker).
+
+Inside a `jit` trace the same machinery works: `default_generator.split()` folds
+a Python-level counter into the key, so a traced step function gets a
+deterministic sequence of keys per trace. For per-step randomness inside a
+compiled train loop, seed by step counter (see nn.functional.dropout's
+`rng_key` argument).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+__all__ = ["Generator", "default_generator", "seed", "get_rng_state", "set_rng_state",
+           "RNGStatesTracker", "get_rng_state_tracker"]
+
+
+class Generator:
+    """Stateful RNG over jax threefry keys.
+
+    The key lives in a framework Tensor so that `paddle_tpu.jit.to_static`
+    lifts it as mutable state — a jitted train step then advances the RNG
+    stream across steps instead of baking a constant key.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._key_tensor = None  # built lazily: no jax backend init on import
+        self._seed = int(seed)
+
+    def manual_seed(self, seed: int) -> "Generator":
+        from .tensor import Tensor
+        with self._lock:
+            self._seed = int(seed)
+            if self._key_tensor is not None:
+                self._key_tensor._data = jax.random.PRNGKey(self._seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def _ensure_key(self):
+        if self._key_tensor is None:
+            from .tensor import Tensor
+            self._key_tensor = Tensor(jax.random.PRNGKey(self._seed))
+        return self._key_tensor
+
+    def split(self) -> jax.Array:
+        with self._lock:
+            kt = self._ensure_key()
+            new_key, sub = jax.random.split(kt._data)
+            kt._data = new_key
+        return sub
+
+    def get_state(self):
+        return (self._seed, np.asarray(jax.device_get(self._ensure_key()._d)))
+
+    def set_state(self, state) -> None:
+        import jax.numpy as jnp
+        self._seed = int(state[0])
+        self._ensure_key()._data = jnp.asarray(state[1])
+
+    def random(self) -> int:
+        """A fresh python-int seed (used to seed child processes etc.)."""
+        key = self.split()
+        return int(jax.random.randint(key, (), 0, np.iinfo(np.int32).max))
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int) -> Generator:
+    """`paddle.seed` equivalent: reseed the global generator."""
+    default_generator.manual_seed(s)
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state) -> None:
+    default_generator.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG states for parallel training.
+
+    Analog of fleet/layers/mpu/random.py's RNGStatesTracker: tensor-parallel
+    regions register e.g. a ``model_parallel_rng`` stream seeded differently per
+    tp rank, and ``local_seed``/``global_seed`` streams for dropout inside vs
+    outside parallel regions.
+    """
+
+    def __init__(self):
+        self._states: dict[str, Generator] = {}
+
+    def reset(self) -> None:
+        self._states.clear()
+
+    def add(self, name: str, seed: int) -> None:
+        if name in self._states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._states[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return {k: g.get_state() for k, g in self._states.items()}
+
+    def set_states_tracker(self, states) -> None:
+        for k, s in states.items():
+            self._states.setdefault(k, Generator(0)).set_state(s)
+
+    class _Scope:
+        def __init__(self, tracker, name):
+            self.tracker, self.name = tracker, name
+
+        def __enter__(self):
+            import paddle_tpu.core.generator as G
+            self._saved = G.default_generator
+            G.default_generator = self.tracker._states[self.name]
+            return self
+
+        def __exit__(self, *exc):
+            import paddle_tpu.core.generator as G
+            G.default_generator = self._saved
+            return False
+
+    def rng_state(self, name: str = "model_parallel_rng"):
+        """Context manager: route the global generator through a named stream."""
+        if name not in self._states:
+            raise ValueError(f"rng state {name!r} not registered")
+        return RNGStatesTracker._Scope(self, name)
+
+
+_GLOBAL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _GLOBAL_TRACKER

@@ -1,0 +1,359 @@
+"""The framework Tensor: an eager, autograd-aware handle over `jax.Array`.
+
+Reference analogs: the public `paddle::Tensor` handle
+(paddle/phi/api/include/tensor.h:82) + the Python eager tensor with its method
+patches (paddle/fluid/pybind/eager_method.cc, eager_math_op_patch.cc) and
+`AutogradMeta`. Semantics follow the reference:
+
+- tensors default to ``stop_gradient=True``; `Parameter`s default to False;
+- ``.backward()`` runs the eager engine and fills ``.grad`` on leaves;
+- math operators promote scalars and dispatch to the op library;
+- everything is functional underneath — "in-place" methods rebind ``_data``.
+
+Most computational methods are installed by ``paddle_tpu.ops`` at import time
+(`_install_method`) so the op library remains the single source of truth.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from ..autograd.engine import run_backward
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "is_tensor"]
+
+
+# Set by paddle_tpu.jit during to_static state discovery; records every
+# concrete-array read/write on any Tensor (the reference analog: persistable
+# variables captured into the traced Program).
+_TRACKER = None
+
+
+class Tensor:
+    __slots__ = ("_d", "stop_gradient", "_grad", "_node", "_out_index",
+                 "_hooks", "name", "persistable", "_sharding_spec", "__weakref__")
+
+    _iid = 0
+
+    def __init__(self, data, stop_gradient: bool = True, node=None, out_index: int = 0,
+                 name: str | None = None):
+        if isinstance(data, Tensor):
+            data = data._d
+        elif not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._d = data
+        self.stop_gradient = stop_gradient
+        self._grad: Tensor | None = None
+        self._node = node
+        self._out_index = out_index
+        self._hooks: list = []
+        if name is None:
+            Tensor._iid += 1
+            name = f"generated_tensor_{Tensor._iid}"
+        self.name = name
+        self.persistable = False
+        self._sharding_spec = None  # set by distributed.shard_tensor
+
+    # -- data storage (tracked for jit state lifting) -----------------------
+    @property
+    def _data(self):
+        if _TRACKER is not None:
+            _TRACKER.on_read(self)
+        return self._d
+
+    @_data.setter
+    def _data(self, value):
+        if _TRACKER is not None:
+            _TRACKER.on_write(self)
+        self._d = value
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self) -> "Tensor":
+        return self
+
+    @property
+    def shape(self) -> list[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def dtype(self) -> dtypes.DType:
+        return dtypes.dtype_from_any(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def place(self) -> str:
+        try:
+            d = list(self._data.devices())[0]
+            return f"Place({d.platform}:{d.id})"
+        except Exception:
+            return "Place(traced)"
+
+    @property
+    def grad(self) -> "Tensor | None":
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else to_tensor(value)
+
+    @property
+    def T(self) -> "Tensor":
+        from .. import ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor: "Tensor | None" = None, retain_graph: bool = False):
+        """Run the eager backward engine from this tensor (reference:
+        tensor_patch_methods.py:224 -> eager_functions.cc run_backward)."""
+        run_backward([self], [grad_tensor] if grad_tensor is not None else None,
+                     retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._data))
+        else:
+            self._grad = None
+
+    def _accumulate_grad(self, g: "Tensor"):
+        if self._grad is None:
+            self._grad = Tensor(g._data)
+        else:
+            self._grad = Tensor(self._grad._data + g._data)
+
+    def register_hook(self, hook):
+        """Gradient hook: called with the grad Tensor; may return a new one."""
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self._out_index = 0
+        self.stop_gradient = True
+        return self
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args) -> Any:
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def clone(self) -> "Tensor":
+        from ..autograd.function import apply
+        return apply(lambda a: a + 0, self, name="clone")
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        # accepts dtype or device-ish strings; device moves are sharding's job
+        for a in list(args) + list(kwargs.values()):
+            try:
+                return self.astype(dtypes.dtype_from_any(a))
+            except (TypeError, KeyError):
+                continue
+        return self
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    def contiguous(self) -> "Tensor":
+        return self
+
+    def is_contiguous(self) -> bool:
+        return True
+
+    # -- mutation (functional rebind, mirrors in-place API) -----------------
+    def copy_(self, other, blocking: bool = True) -> "Tensor":
+        other = to_tensor(other)
+        self._data = jnp.asarray(other._data, dtype=self._data.dtype)
+        return self
+
+    def set_value(self, value) -> None:
+        value = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        self._data = value.astype(self._data.dtype)
+
+    def fill_(self, value) -> "Tensor":
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self) -> "Tensor":
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def scale_(self, scale: float, bias: float = 0.0) -> "Tensor":
+        self._data = self._data * scale + bias
+        return self
+
+    # -- python protocol ----------------------------------------------------
+    def __repr__(self):
+        prefix = "Parameter" if isinstance(self, Parameter) else "Tensor"
+        try:
+            body = np.array2string(self.numpy(), precision=6, separator=", ")
+        except Exception:
+            body = f"<traced {self._data}>"
+        return (f"{prefix}(shape={self.shape}, dtype={self.dtype.name}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __bool__(self):
+        if self._data.size == 1:
+            return bool(self.numpy().item())
+        return bool(self._data)  # raises the standard ambiguity error
+
+    def __int__(self):
+        return int(self.numpy().item())
+
+    def __float__(self):
+        return float(self.numpy().item())
+
+    def __index__(self):
+        return int(self.numpy().item())
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return str(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __hash__(self):
+        return id(self)
+
+    def __getitem__(self, idx):
+        from .. import ops
+        return ops.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from .. import ops
+        ops.setitem_(self, idx, value)
+
+    def dim(self) -> int:
+        return self.ndim
+
+    def numel(self) -> int:
+        return self.size
+
+    def element_size(self) -> int:
+        return self.dtype.itemsize
+
+    # Math dunders are installed by paddle_tpu.ops (single source of truth).
+
+    @classmethod
+    def _install_method(cls, name: str, fn):
+        setattr(cls, name, fn)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: EagerParamBase,
+    python/paddle/base/framework.py). ``stop_gradient`` defaults to False."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, data, trainable: bool = True, name: str | None = None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.persistable = True
+
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """`paddle.to_tensor` equivalent."""
+    if isinstance(data, Tensor):
+        arr = data._data
+        if dtype is not None:
+            arr = arr.astype(dtypes.dtype_from_any(dtype).np_dtype)
+        t = Tensor(arr, stop_gradient=stop_gradient)
+        return t
+    if dtype is not None:
+        np_dtype = dtypes.dtype_from_any(dtype).np_dtype
+        arr = jnp.asarray(data, dtype=np_dtype)
+    else:
+        arr = jnp.asarray(data)
+        # paddle defaults python floats to the default float dtype
+        if isinstance(data, float) or (
+            isinstance(data, (list, tuple)) and arr.dtype == jnp.float64
+        ):
+            arr = arr.astype(dtypes.get_default_dtype().np_dtype)
+        if isinstance(data, np.ndarray) and data.dtype == np.float64:
+            arr = arr.astype(dtypes.get_default_dtype().np_dtype)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def as_tensor(x) -> Tensor:
+    """Internal pass-through coercion: unlike `to_tensor`, returns the SAME
+    object (graph + stop_gradient intact) when already a Tensor."""
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(x)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+# Register Tensor as a pytree so jitted functions can take/return Tensors.
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._data,), (t.stop_gradient, t.name)),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux[0], name=aux[1]),
+)

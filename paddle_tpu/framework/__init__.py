@@ -1,0 +1,4 @@
+from .io import save, load  # noqa: F401
+from .framework import *  # noqa: F401,F403
+from .parameter import create_parameter, ParamAttr  # noqa: F401
+from ..core.generator import seed  # noqa: F401

@@ -1,0 +1,102 @@
+"""Device/place surface (reference: paddle/phi/common/place.h + paddle.device).
+
+On TPU the substrate is jax's device model; "places" are thin descriptors kept
+for API parity. `set_device` selects the default jax device.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = [
+    "CPUPlace", "CUDAPlace", "TPUPlace", "XPUPlace", "CustomPlace",
+    "get_device", "set_device", "is_compiled_with_cuda", "is_compiled_with_xpu",
+    "is_compiled_with_rocm", "is_compiled_with_custom_device", "in_dynamic_mode",
+    "device_count",
+]
+
+
+class _Place:
+    kind = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, _Place) and self.kind == other.kind
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.kind, self.device_id))
+
+
+class CPUPlace(_Place):
+    kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class CUDAPlace(_Place):
+    kind = "gpu"
+
+
+class TPUPlace(_Place):
+    kind = "tpu"
+
+
+class XPUPlace(_Place):
+    kind = "xpu"
+
+
+class CustomPlace(_Place):
+    kind = "custom"
+
+    def __init__(self, dev_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self.dev_type = dev_type
+
+
+def get_device() -> str:
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device: str):
+    """Select the default device ('cpu', 'tpu', 'tpu:0', ...)."""
+    platform = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    devs = [d for d in jax.devices() if d.platform == platform]
+    if not devs:
+        raise ValueError(f"no {platform} devices available; have "
+                         f"{[d.platform for d in jax.devices()]}")
+    jax.config.update("jax_default_device", devs[idx])
+    return devs[idx]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False  # no CUDA anywhere in this build, by design
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = "tpu") -> bool:
+    return any(d.platform == device_type for d in jax.devices())
+
+
+def in_dynamic_mode() -> bool:
+    from ..jit.api import in_to_static_trace
+    return not in_to_static_trace()

@@ -1,0 +1,73 @@
+"""`paddle.save` / `paddle.load` (reference: python/paddle/framework/io.py:646,885).
+
+Pickled nested state dicts with Tensors serialized as numpy arrays (+ dtype
+tag so bfloat16 round-trips). Large (>4GB) objects use pickle protocol 4
+automatically, matching the reference's behavior.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+
+__all__ = ["save", "load"]
+
+_SENTINEL = "__paddle_tpu_tensor__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._data)
+        return {_SENTINEL: True, "data": arr, "dtype": str(arr.dtype),
+                "param": isinstance(obj, Parameter),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get(_SENTINEL):
+            arr = obj["data"]
+            if return_numpy:
+                return arr
+            if obj.get("param"):
+                p = Parameter(arr, trainable=not obj.get("stop_gradient", False),
+                              name=obj.get("name"))
+                return p
+            t = Tensor(arr, stop_gradient=obj.get("stop_gradient", True),
+                       name=obj.get("name"))
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol: int = 4, **configs):
+    """Serialize a (possibly nested) object containing Tensors."""
+    if hasattr(path, "write"):
+        pickle.dump(_pack(obj), path, protocol=protocol)
+        return
+    path = os.fspath(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy: bool = False, **configs):
+    if hasattr(path, "read"):
+        return _unpack(pickle.load(path), return_numpy)
+    with open(os.fspath(path), "rb") as f:
+        return _unpack(pickle.load(f), return_numpy)

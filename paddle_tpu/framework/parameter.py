@@ -1,0 +1,44 @@
+"""`paddle.create_parameter` equivalent."""
+
+from __future__ import annotations
+
+from ..core.tensor import Parameter
+from ..core import dtype as dtypes
+
+__all__ = ["create_parameter", "ParamAttr"]
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/base/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias=False, default_initializer=None) -> Parameter:
+    from ..nn import initializer as init
+    dt = dtypes.dtype_from_any(dtype)
+    if isinstance(attr, ParamAttr):
+        initializer = attr.initializer
+        trainable = attr.trainable
+        name = name or attr.name
+    else:
+        initializer, trainable = None, True
+    if initializer is None:
+        initializer = default_initializer or (
+            init.Constant(0.0) if is_bias else init.XavierNormal())
+    data = initializer(tuple(int(s) for s in shape), dt)
+    p = Parameter(data, trainable=trainable, name=name)
+    if isinstance(attr, ParamAttr):
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+    return p

@@ -1,0 +1,18 @@
+"""`paddle.io` equivalent (reference: python/paddle/io/).
+
+Dataset/Sampler/BatchSampler/DataLoader. The default collate stacks numpy
+arrays and wraps batches as Tensors; multi-worker loading uses a thread pool
+prefetcher (host-side IO overlap — the TPU analog of the reference's
+multiprocess DataLoader with shared-memory queues; a C++ shared-memory loader
+core is planned per SURVEY.md §2.6).
+"""
+
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split, ConcatDataset,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

@@ -1,0 +1,126 @@
+"""DataLoader (reference: python/paddle/io/reader.py:216 DataLoader).
+
+Host-side loading with a thread-pool prefetcher: workers run `dataset[i]` +
+collate concurrently while the accelerator computes, the TPU-idiomatic
+replacement for the reference's multiprocess shared-memory loader (device
+transfer is XLA's job; `jnp.asarray` in collate is async).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched Tensors (reference:
+    python/paddle/io/dataloader/collate.py)."""
+    from ..core.tensor import Tensor, to_tensor
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([s._data for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return to_tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return to_tensor(np.array(batch))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(default_collate_fn(list(items))
+                            for items in zip(*batch))
+    raise TypeError(f"unsupported sample type {type(sample)}")
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif batch_size is None:
+            self.batch_sampler = None
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no length")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def _index_batches(self):
+        if self._iterable:
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield batch  # already samples, not indices
+        elif self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield [i]
+        else:
+            yield from self.batch_sampler
+
+    def _fetch(self, batch):
+        if self._iterable:
+            samples = batch
+        else:
+            samples = [self.dataset[i] for i in batch]
+        return self.collate_fn(samples)
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            for batch in self._index_batches():
+                yield self._fetch(batch)
+            return
+        # thread-pool prefetch pipeline
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            if self.worker_init_fn is not None:
+                for w in range(self.num_workers):
+                    pool.submit(self.worker_init_fn, w)
+            depth = self.num_workers * self.prefetch_factor
+            batches = self._index_batches()
+            pending = queue.Queue()
+            for batch in itertools.islice(batches, depth):
+                pending.put(pool.submit(self._fetch, batch))
+            while not pending.empty():
+                fut = pending.get()
+                for batch in itertools.islice(batches, 1):
+                    pending.put(pool.submit(self._fetch, batch))
+                yield fut.result()

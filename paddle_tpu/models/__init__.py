@@ -1,0 +1,1 @@
+from .gpt import GPT, GPTConfig, gpt2_small, gpt2_tiny  # noqa: F401

@@ -1,0 +1,153 @@
+"""GPT-2 style decoder-only LM (flagship Phase-1 model; BASELINE.md config #1).
+
+Written entirely against the framework's public surface (nn.Layer, ops,
+functional) the way a user would — it doubles as the end-to-end integration
+test and the bench.py workload. Attention routes through
+scaled_dot_product_attention (Pallas flash kernel on TPU).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["GPTConfig", "GPT", "gpt2_small", "gpt2_tiny"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304  # padded to a multiple of 128 for the MXU
+    max_position_embeddings: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int | None = None
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+class CausalSelfAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.n_head = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=attr)
+        proj_init = nn.initializer.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
+        self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                              weight_attr=paddle.framework.ParamAttr(
+                                  initializer=proj_init))
+        self.dropout = cfg.dropout
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.n_head, self.head_dim])
+        q, k, v = paddle.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout,
+            training=self.training)
+        return self.proj(out.reshape([b, s, h]))
+
+
+class MLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        proj_init = nn.initializer.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
+        self.fc = nn.Linear(cfg.hidden_size, cfg.ffn_size, weight_attr=attr)
+        self.proj = nn.Linear(cfg.ffn_size, cfg.hidden_size,
+                              weight_attr=paddle.framework.ParamAttr(
+                                  initializer=proj_init))
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.drop(self.proj(F.gelu(self.fc(x), approximate=True)))
+
+
+class Block(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_epsilon)
+        self.attn = CausalSelfAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_epsilon)
+        self.mlp = MLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPT(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size, weight_attr=attr)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                                weight_attr=attr)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([Block(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_epsilon)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     weight_attr=attr, bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        b, s = input_ids.shape
+        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        if self.cfg.tie_word_embeddings:
+            logits = paddle.matmul(x, self.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.cfg.vocab_size]).cast("float32"),
+                labels.reshape([-1]))
+            return logits, loss
+        return logits
+
+    def num_params(self, non_embedding=True) -> int:
+        n = sum(p.size for p in self.parameters())
+        if non_embedding:
+            n -= self.wpe.weight.size
+        return n
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Analytic FLOPs/token: 6N + attention correction (BASELINE.md rule)."""
+        n = self.num_params()
+        l, h = self.cfg.num_layers, self.cfg.hidden_size
+        return 6.0 * n + 12.0 * l * h * seq_len / 2  # causal: half the window
+
+
+def gpt2_small(**kw) -> GPT:
+    return GPT(GPTConfig(**kw))
+
+
+def gpt2_tiny(**kw) -> GPT:
+    cfg = dict(vocab_size=1024, max_position_embeddings=128, hidden_size=128,
+               num_layers=2, num_heads=4)
+    cfg.update(kw)
+    return GPT(GPTConfig(**cfg))

@@ -1,0 +1,15 @@
+"""`paddle.nn` equivalent (reference: python/paddle/nn/__init__.py)."""
+
+from .layer import Layer  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layers.common import *  # noqa: F401,F403
+from .layers.norm import *  # noqa: F401,F403
+from .layers.container import *  # noqa: F401,F403
+from .layers.activation import *  # noqa: F401,F403
+from .layers.conv import *  # noqa: F401,F403
+from .layers.loss import *  # noqa: F401,F403
+from .layers.transformer import *  # noqa: F401,F403
+from .layers.pooling import *  # noqa: F401,F403
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters  # noqa: F401

@@ -1,0 +1,150 @@
+"""Convolution functionals over lax.conv_general_dilated (reference:
+python/paddle/nn/functional/conv.py; kernels paddle/phi/kernels/*/conv*)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...autograd.function import apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tup(v, n):
+    a = np.atleast_1d(v)
+    if a.size == 1:
+        a = np.repeat(a, n)
+    return tuple(int(x) for x in a)
+
+
+def _pad_arg(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    a = np.atleast_1d(padding)
+    if a.size == 1:
+        return [(int(a[0]), int(a[0]))] * n
+    if a.size == n:
+        return [(int(p), int(p)) for p in a]
+    if a.size == 2 * n:
+        return [(int(a[2 * i]), int(a[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last,
+          name):
+    st, dl = _tup(stride, n), _tup(dilation, n)
+    pad = _pad_arg(padding, n)
+    if channel_last:
+        # NHWC-style
+        lhs_spec = "N" + "".join("DHW"[3 - n:]) + "C"
+    else:
+        lhs_spec = "NC" + "".join("DHW"[3 - n:])
+    rhs_spec = "OI" + "".join("DHW"[3 - n:])
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        tuple([1] * (n + 2)), tuple([1] * (n + 2)), (lhs_spec, rhs_spec, out_spec))
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=st, padding=pad, rhs_dilation=dl,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b[0].shape[0]
+            out = out + b[0].reshape(shape)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply(f, *args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None) -> Tensor:
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format == "NLC", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None) -> Tensor:
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format == "NHWC", "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None) -> Tensor:
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format == "NDHWC", "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, channel_last, name):
+    st, dl = _tup(stride, n), _tup(dilation, n)
+    opad = _tup(output_padding, n)
+    if isinstance(padding, str):
+        raise ValueError("string padding unsupported for transpose conv")
+    pad = _pad_arg(padding, n)
+    if channel_last:
+        lhs_spec = "N" + "".join("DHW"[3 - n:]) + "C"
+    else:
+        lhs_spec = "NC" + "".join("DHW"[3 - n:])
+    # paddle stores transpose-conv weight as [in, out/groups, *k]
+    rhs_spec = "IO" + "".join("DHW"[3 - n:])
+    dn = jax.lax.conv_dimension_numbers(
+        tuple([1] * (n + 2)), tuple([1] * (n + 2)), (lhs_spec, rhs_spec, lhs_spec))
+
+    def f(a, w, *b):
+        k = w.shape[2:]
+        # transposed conv = lhs-dilated conv with flipped effective padding
+        tpad = [(dl[i] * (k[i] - 1) - pad[i][0],
+                 dl[i] * (k[i] - 1) - pad[i][1] + opad[i]) for i in range(n)]
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        wt = jnp.swapaxes(wt, 0, 1)  # IO -> OI ordering after flip
+        if groups > 1:
+            # regroup for grouped transpose conv
+            i_per, o_per = w.shape[0] // groups, w.shape[1]
+            wt = w.reshape((groups, i_per) + w.shape[1:]) \
+                .transpose((0, 2, 1) + tuple(range(3, 3 + n))) \
+                .reshape((groups * o_per, i_per) + k)
+            wt = jnp.flip(wt, axis=tuple(range(2, 2 + n)))
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1,) * n, padding=tpad, lhs_dilation=st,
+            rhs_dilation=dl,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                tuple([1] * (n + 2)), tuple([1] * (n + 2)),
+                (lhs_spec, "OI" + "".join("DHW"[3 - n:]), lhs_spec)),
+            feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b[0].shape[0]
+            out = out + b[0].reshape(shape)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply(f, *args, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None) -> Tensor:
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format == "NLC",
+                           "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None) -> Tensor:
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format == "NHWC",
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None) -> Tensor:
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format == "NDHWC",
+                           "conv3d_transpose")

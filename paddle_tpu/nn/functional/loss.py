@@ -1,0 +1,332 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, as_tensor
+from ...autograd.function import apply
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "cosine_embedding_loss",
+    "hinge_embedding_loss", "log_loss", "square_error_cost", "triplet_margin_loss",
+    "sigmoid_focal_loss", "dice_loss", "ctc_loss", "poisson_nll_loss",
+    "multi_label_soft_margin_loss", "soft_margin_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None) -> Tensor:
+    lbl = as_tensor(label)._data
+    w_arr = as_tensor(weight)._data if weight is not None else None
+
+    def f(logits):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) \
+            if use_softmax else jnp.log(jnp.clip(logits.astype(jnp.float32),
+                                                 1e-12, None))
+        n_class = logits.shape[axis]
+        if soft_label:
+            tgt = lbl.astype(logp.dtype)
+            if label_smoothing > 0.0:
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / n_class
+            if w_arr is not None:
+                shape = [1] * logp.ndim
+                shape[axis] = n_class
+                tgt = tgt * w_arr.astype(logp.dtype).reshape(shape)
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            return _reduce(loss, reduction)
+        idx = lbl
+        if idx.ndim == logp.ndim and idx.shape[axis] == 1:
+            idx = jnp.squeeze(idx, axis)
+        idx = idx.astype(jnp.int32)
+        valid = idx != ignore_index
+        safe = jnp.where(valid, idx, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+        if label_smoothing > 0.0:
+            smooth = jnp.mean(logp, axis=axis)
+            picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+        loss = -picked
+        if w_arr is not None:
+            cw = jnp.take(w_arr.astype(logp.dtype), safe)
+            loss = loss * cw
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(
+                    valid, cw, 0.0)), 1e-12)
+        else:
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    return apply(f, input, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    if return_softmax:
+        from ...ops.activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(x, y, *w):
+        xs = jnp.clip(x.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+        out = -(y * jnp.log(xs) + (1 - y) * jnp.log1p(-xs))
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+    args = [input, as_tensor(label)] + ([weight] if weight is not None else [])
+    return apply(f, *args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    pw = as_tensor(pos_weight)._data if pos_weight is not None else None
+
+    def f(x, y, *w):
+        x = x.astype(jnp.float32)
+        y = y.astype(jnp.float32)
+        log_sig = jax.nn.log_sigmoid(x)
+        log_1msig = jax.nn.log_sigmoid(-x)
+        if pw is not None:
+            out = -(pw * y * log_sig + (1 - y) * log_1msig)
+        else:
+            out = -(y * log_sig + (1 - y) * log_1msig)
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+    args = [logit, as_tensor(label)] + ([weight] if weight is not None else [])
+    return apply(f, *args, name="bce_with_logits")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda x, y: _reduce(jnp.square(x - y), reduction),
+                 input, as_tensor(label), name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda x, y: _reduce(jnp.abs(x - y), reduction),
+                 input, as_tensor(label), name="l1_loss")
+
+
+def square_error_cost(input, label, name=None):
+    return apply(lambda x, y: jnp.square(x - y), input, as_tensor(label),
+                 name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(x, y):
+        return -(y * jnp.log(x + epsilon) + (1 - y) * jnp.log(1 - x + epsilon))
+    return apply(f, input, as_tensor(label), name="log_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lbl = as_tensor(label)._data.astype(jnp.int32)
+    w_arr = as_tensor(weight)._data if weight is not None else None
+
+    def f(logp):
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = -picked
+        cw = jnp.take(w_arr.astype(logp.dtype), safe) if w_arr is not None \
+            else valid.astype(logp.dtype)
+        loss = jnp.where(valid, loss * cw, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(cw * valid), 1e-12)
+        return _reduce(loss, reduction)
+    return apply(f, input, name="nll_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(x, y):
+        if log_target:
+            out = jnp.exp(y) * (y - x)
+        else:
+            out = y * (jnp.log(jnp.clip(y, 1e-12, None)) - x)
+        if reduction == "batchmean":
+            return jnp.sum(out) / x.shape[0]
+        return _reduce(out, reduction)
+    return apply(f, input, as_tensor(label), name="kl_div")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(x, y):
+        d = x - y
+        ad = jnp.abs(d)
+        out = jnp.where(ad < delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+        return _reduce(out, reduction)
+    return apply(f, input, as_tensor(label), name="smooth_l1_loss")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        out = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(out, reduction)
+    return apply(f, input, other, as_tensor(label), name="margin_ranking_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        out = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(out, reduction)
+    return apply(f, input1, input2, as_tensor(label), name="cosine_embedding_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(x, y):
+        out = jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0))
+        return _reduce(out, reduction)
+    return apply(f, input, as_tensor(label), name="hinge_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(f, input, positive, negative, name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    norm = as_tensor(normalizer)._data if normalizer is not None else None
+
+    def f(x, y):
+        x = x.astype(jnp.float32)
+        p = jax.nn.sigmoid(x)
+        ce = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * jnp.power(1 - p_t, gamma) * ce
+        if norm is not None:
+            out = out / norm
+        return _reduce(out, reduction)
+    return apply(f, logit, as_tensor(label), name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    lbl = as_tensor(label)._data
+
+    def f(x):
+        n_class = x.shape[-1]
+        oh = (lbl.squeeze(-1)[..., None] == jnp.arange(n_class)).astype(x.dtype)
+        inter = jnp.sum(x * oh, axis=tuple(range(1, x.ndim)))
+        union = jnp.sum(x, axis=tuple(range(1, x.ndim))) + \
+            jnp.sum(oh, axis=tuple(range(1, x.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(f, input, name="dice_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            out = jnp.exp(x) - y * x
+        else:
+            out = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * jnp.pi * (y + epsilon))
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+    return apply(f, input, as_tensor(label), name="poisson_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def f(x, y, *w):
+        out = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        out = jnp.mean(out, axis=-1)
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+    args = [input, as_tensor(label)] + ([weight] if weight is not None else [])
+    return apply(f, *args, name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return apply(f, input, as_tensor(label), name="soft_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the classic alpha-recursion in log space (lax.scan over time).
+    Reference: warpctc-backed paddle ctc_loss."""
+    lbl = as_tensor(labels)._data.astype(jnp.int32)
+    in_len = as_tensor(input_lengths)._data.astype(jnp.int32)
+    lb_len = as_tensor(label_lengths)._data.astype(jnp.int32)
+
+    def f(lp):
+        # lp: [T, B, C] logits (paddle layout) -> log-probs
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        # extended label seq: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl)
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(lp[0, jnp.arange(B), ext[:, 1]])
+
+        same = jnp.concatenate(
+            [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            a_prev2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            a_prev2 = jnp.where(same, neg_inf, a_prev2)
+            m = jnp.maximum(jnp.maximum(alpha, a_prev1), a_prev2)
+            new = m + jnp.log(
+                jnp.exp(alpha - m) + jnp.exp(a_prev1 - m) + jnp.exp(a_prev2 - m))
+            new = jnp.where(m <= neg_inf / 2, neg_inf, new)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return new + emit, new + emit
+
+        alphaT, hist = jax.lax.scan(step, alpha0, lp[1:])
+        hist = jnp.concatenate([alpha0[None], hist], axis=0)  # [T, B, S]
+        # pick alpha at t = input_length-1, s = 2*label_length or 2*label_length-1
+        t_idx = jnp.clip(in_len - 1, 0, T - 1)
+        a_final = hist[t_idx, jnp.arange(B)]  # [B, S]
+        s1 = jnp.clip(2 * lb_len, 0, S - 1)
+        s2 = jnp.clip(2 * lb_len - 1, 0, S - 1)
+        la = a_final[jnp.arange(B), s1]
+        lb_ = a_final[jnp.arange(B), s2]
+        m = jnp.maximum(la, lb_)
+        ll = m + jnp.log(jnp.exp(la - m) + jnp.exp(lb_ - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lb_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+    return apply(f, log_probs, name="ctc_loss")

@@ -1,0 +1,159 @@
+"""Pooling functionals over lax.reduce_window (reference:
+python/paddle/nn/functional/pooling.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...autograd.function import apply
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+           "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+           "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d"]
+
+
+def _max_init(dt):
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.asarray(-jnp.inf, dt)
+    return jnp.asarray(jnp.iinfo(dt).min, dt)
+
+
+def _tup(v, n):
+    a = np.atleast_1d(v)
+    if a.size == 1:
+        a = np.repeat(a, n)
+    return tuple(int(x) for x in a)
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, name,
+          ceil_mode=False, count_include_pad=True, average=False):
+    k = _tup(kernel, n)
+    st = _tup(stride if stride is not None else kernel, n)
+    pd = _tup(padding, n)
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + st + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + st
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+
+    def f(a):
+        out = jax.lax.reduce_window(a, init(a.dtype), reducer, dims, strides, pads)
+        if average:
+            if count_include_pad:
+                denom = float(np.prod(k))
+                out = out / denom
+            else:
+                ones = jnp.ones_like(a)
+                cnt = jax.lax.reduce_window(ones, jnp.zeros((), a.dtype),
+                                            jax.lax.add, dims, strides, pads)
+                out = out / cnt
+        return out
+    return apply(f, x, name=name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None) -> Tensor:
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 jax.lax.max, _max_init,
+                 "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None) -> Tensor:
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 jax.lax.max, _max_init,
+                 "max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None) -> Tensor:
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 jax.lax.max, _max_init,
+                 "max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None) -> Tensor:
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 jax.lax.add, lambda dt: jnp.zeros((), dt), "avg_pool1d",
+                 count_include_pad=not exclusive, average=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None) -> Tensor:
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 jax.lax.add, lambda dt: jnp.zeros((), dt), "avg_pool2d",
+                 count_include_pad=not exclusive, average=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None) -> Tensor:
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 jax.lax.add, lambda dt: jnp.zeros((), dt), "avg_pool3d",
+                 count_include_pad=not exclusive, average=True)
+
+
+def _adaptive(x, output_size, n, channel_last, mode, name):
+    out_sz = _tup(output_size, n)
+
+    def f(a):
+        sp_axes = list(range(2, 2 + n)) if not channel_last else \
+            list(range(1, 1 + n))
+        out = a
+        for i, ax in enumerate(sp_axes):
+            in_sz = out.shape[ax]
+            o = out_sz[i]
+            if in_sz % o == 0:
+                k = in_sz // o
+                shape = out.shape[:ax] + (o, k) + out.shape[ax + 1:]
+                r = out.reshape(shape)
+                out = jnp.max(r, axis=ax + 1) if mode == "max" else \
+                    jnp.mean(r, axis=ax + 1)
+            else:
+                # general adaptive: gather variable windows
+                starts = (np.arange(o) * in_sz) // o
+                ends = ((np.arange(o) + 1) * in_sz + o - 1) // o
+                slices = []
+                for s_, e_ in zip(starts, ends):
+                    w = jnp.take(out, jnp.arange(s_, e_), axis=ax)
+                    red = jnp.max(w, axis=ax, keepdims=True) if mode == "max" \
+                        else jnp.mean(w, axis=ax, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+    return apply(f, x, name=name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None) -> Tensor:
+    return _adaptive(x, output_size, 1, False, "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None) -> Tensor:
+    return _adaptive(x, output_size, 2, data_format == "NHWC", "avg",
+                     "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None) -> Tensor:
+    return _adaptive(x, output_size, 3, data_format == "NDHWC", "avg",
+                     "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None) -> Tensor:
+    return _adaptive(x, output_size, 1, False, "max", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None) -> Tensor:
+    return _adaptive(x, output_size, 2, False, "max", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None) -> Tensor:
+    return _adaptive(x, output_size, 3, False, "max", "adaptive_max_pool3d")

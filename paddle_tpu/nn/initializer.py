@@ -1,0 +1,187 @@
+"""Weight initializers (reference: python/paddle/nn/initializer/).
+
+Each initializer is a callable ``(shape, dtype) -> jax.Array`` drawing from the
+global generator, and doubles as the `paddle.nn.initializer.*` API surface.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import generator as gen_mod
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv_transpose1d": 1.0, "conv_transpose2d": 1.0,
+        "conv_transpose3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return gains[nonlinearity]
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # linear weight stored [in, out] (paddle layout)
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None) -> jax.Array:
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(shape), self.value,
+                        dtypes.dtype_from_any(dtype).np_dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        key = gen_mod.default_generator.split()
+        dt = dtypes.dtype_from_any(dtype).np_dtype
+        return self.mean + self.std * jax.random.normal(key, tuple(shape), dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0,
+                 b: float = 2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype=None):
+        key = gen_mod.default_generator.split()
+        dt = dtypes.dtype_from_any(dtype).np_dtype
+        return self.mean + self.std * jax.random.truncated_normal(
+            key, self.a, self.b, tuple(shape), dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        key = gen_mod.default_generator.split()
+        dt = dtypes.dtype_from_any(dtype).np_dtype
+        return jax.random.uniform(key, tuple(shape), dt, self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fin, fout = _fans(tuple(shape))
+        fin = self.fan_in if self.fan_in is not None else fin
+        fout = self.fan_out if self.fan_out is not None else fout
+        std = self.gain * math.sqrt(2.0 / (fin + fout))
+        return Normal(0.0, std)(shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fin, fout = _fans(tuple(shape))
+        fin = self.fan_in if self.fan_in is not None else fin
+        fout = self.fan_out if self.fan_out is not None else fout
+        limit = self.gain * math.sqrt(6.0 / (fin + fout))
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        fin, _ = _fans(tuple(shape))
+        fin = self.fan_in if self.fan_in is not None else fin
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fin)
+        return Normal(0.0, std)(shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=None):
+        fin, _ = _fans(tuple(shape))
+        fin = self.fan_in if self.fan_in is not None else fin
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fin)
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        from ..core.tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._data
+        arr = jnp.asarray(np.asarray(v) if not isinstance(v, jax.Array) else v)
+        dt = dtypes.dtype_from_any(dtype).np_dtype
+        return arr.reshape(tuple(shape)).astype(dt)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        key = gen_mod.default_generator.split()
+        dt = dtypes.dtype_from_any(dtype).np_dtype
+        return self.gain * jax.nn.initializers.orthogonal()(key, tuple(shape), dt)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dt = dtypes.dtype_from_any(dtype).np_dtype
+        arr = np.zeros(tuple(shape), dt)
+        out_c, in_c = shape[0], shape[1]
+        per = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, in_c)):
+                center = tuple(s // 2 for s in shape[2:])
+                arr[(g * per + i, i) + center] = 1.0
+        return jnp.asarray(arr)

@@ -1,0 +1,86 @@
+"""Activation layer classes (reference: python/paddle/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+from ..layer import Layer
+from .. import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "ELU", "CELU", "SELU", "GELU",
+           "Sigmoid", "LogSigmoid", "Hardsigmoid", "Hardswish", "Hardtanh",
+           "Hardshrink", "Softshrink", "Tanhshrink", "Silu", "Swish", "Mish",
+           "Softplus", "Softsign", "Tanh", "Softmax", "LogSoftmax", "Maxout",
+           "ThresholdedReLU", "RReLU", "PReLU", "GLU"]
+
+
+def _simple(name, fn_name, params=()):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        for i, p in enumerate(params):
+            v = args[i] if i < len(args) else kwargs.get(p[0], p[1])
+            setattr(self, p[0], v)
+
+    def forward(self, x):
+        fn = getattr(F, fn_name)
+        return fn(x, *[getattr(self, p[0]) for p in params])
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+Silu = _simple("Silu", "silu")
+Swish = _simple("Swish", "swish")
+Mish = _simple("Mish", "mish")
+Softsign = _simple("Softsign", "softsign")
+Tanh = _simple("Tanh", "tanh")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+Hardswish = _simple("Hardswish", "hardswish")
+LeakyReLU = _simple("LeakyReLU", "leaky_relu", (("negative_slope", 0.01),))
+ELU = _simple("ELU", "elu", (("alpha", 1.0),))
+CELU = _simple("CELU", "celu", (("alpha", 1.0),))
+SELU = _simple("SELU", "selu")
+Hardshrink = _simple("Hardshrink", "hardshrink", (("threshold", 0.5),))
+Softshrink = _simple("Softshrink", "softshrink", (("threshold", 0.5),))
+Hardtanh = _simple("Hardtanh", "hardtanh", (("min", -1.0), ("max", 1.0)))
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Softplus = _simple("Softplus", "softplus", (("beta", 1.0), ("threshold", 20.0)))
+Softmax = _simple("Softmax", "softmax", (("axis", -1),))
+LogSoftmax = _simple("LogSoftmax", "log_softmax", (("axis", -1),))
+Maxout = _simple("Maxout", "maxout", (("groups", 1), ("axis", 1)))
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu",
+                          (("threshold", 1.0),))
+GLU = _simple("GLU", "glu", (("axis", -1),))
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False, name=None):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, self.approximate)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=0.125, upper=0.3333333, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        from .. import initializer as I
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

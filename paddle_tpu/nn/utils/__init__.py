@@ -1,0 +1,98 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad for p in parameters if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(
+            g._data.astype(jnp.float32)), norm_type)) for g in grads),
+            1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p._grad is not None:
+            p._grad._data = p._grad._data * scale.astype(p._grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p._grad is not None:
+            p._grad._data = jnp.clip(p._grad._data, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    return Tensor(jnp.concatenate([p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._data = vec._data[offset:offset + n].reshape(p._data.shape) \
+            .astype(p._data.dtype)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize ``name`` as g * v/||v|| (reference: nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    g = jnp.sqrt(jnp.sum(jnp.square(w._data), axis=axes, keepdims=True))
+    from ...core.tensor import Parameter
+    layer.add_parameter(name + "_g", Parameter(g))
+    layer.add_parameter(name + "_v", Parameter(w._data))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        v = l._parameters[name + "_v"]
+        gg = l._parameters[name + "_g"]
+        norm = jnp.sqrt(jnp.sum(jnp.square(v._data), axis=axes, keepdims=True))
+        from ...autograd.function import apply
+        wt = apply(lambda vv, ggg: ggg * vv / jnp.maximum(
+            jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes, keepdims=True)), 1e-12),
+            v, gg, name="weight_norm")
+        object.__setattr__(l, "_wn_" + name, wt)
+        l.__dict__[name] = wt
+        return None
+    layer._wn_hook = layer.register_forward_pre_hook(hook)
+    layer._wn_name = name
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ...core.tensor import Parameter
+    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters.pop(name + "_g")
+    axes_norm = jnp.sqrt(jnp.sum(jnp.square(v._data),
+                                 axis=tuple(range(1, v.ndim)), keepdims=True))
+    layer._wn_hook.remove()
+    layer.__dict__.pop(name, None)
+    w = g._data * v._data / jnp.maximum(
+        jnp.sqrt(jnp.sum(jnp.square(v._data),
+                         axis=tuple(i for i in range(v.ndim) if g._data.shape[i] == 1
+                                    ) or (0,), keepdims=True)), 1e-12)
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=0):
+    raise NotImplementedError("spectral_norm: planned (see SURVEY.md §2.2)")

@@ -1,0 +1,137 @@
+"""The op library: single source of truth for tensor operations.
+
+Assembles the op families (creation/math/reduction/manipulation/logic/linalg/
+random/activation) and installs Tensor methods + operator dunders, mirroring
+how the reference patches the eager tensor (paddle/fluid/pybind/
+eager_math_op_patch.cc + python/paddle/tensor/__init__.py's method registry).
+"""
+
+from __future__ import annotations
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .activation import *  # noqa: F401,F403
+
+from . import creation, math, reduction, manipulation, logic, linalg, random, activation
+
+from ..core.tensor import Tensor
+from . import math as _m
+from . import reduction as _r
+from . import manipulation as _mp
+from . import logic as _l
+from . import linalg as _la
+from . import activation as _a
+
+
+def _method(fn, swap=False, scalar_left=False):
+    if swap:
+        def m(self, other):
+            return fn(other, self)
+    else:
+        def m(self, *args, **kwargs):
+            return fn(self, *args, **kwargs)
+    return m
+
+
+_METHODS = {
+    # math
+    "add": _m.add, "subtract": _m.subtract, "multiply": _m.multiply,
+    "divide": _m.divide, "floor_divide": _m.floor_divide, "remainder": _m.remainder,
+    "mod": _m.mod, "pow": _m.pow, "matmul": _m.matmul, "mm": _m.mm, "bmm": _m.bmm,
+    "dot": _m.dot, "inner": _m.inner, "outer": _m.outer, "addmm": _m.addmm,
+    "neg": _m.neg, "abs": _m.abs, "sign": _m.sign, "reciprocal": _m.reciprocal,
+    "exp": _m.exp, "expm1": _m.expm1, "log": _m.log, "log2": _m.log2,
+    "log10": _m.log10, "log1p": _m.log1p, "sqrt": _m.sqrt, "rsqrt": _m.rsqrt,
+    "square": _m.square, "sin": _m.sin, "cos": _m.cos, "tan": _m.tan,
+    "asin": _m.asin, "acos": _m.acos, "atan": _m.atan, "atan2": _m.atan2,
+    "sinh": _m.sinh, "cosh": _m.cosh, "asinh": _m.asinh, "acosh": _m.acosh,
+    "atanh": _m.atanh, "floor": _m.floor, "ceil": _m.ceil, "round": _m.round,
+    "trunc": _m.trunc, "frac": _m.frac, "clip": _m.clip, "clip_": _m.clip_,
+    "maximum": _m.maximum, "minimum": _m.minimum, "fmax": _m.fmax, "fmin": _m.fmin,
+    "erf": _m.erf, "erfinv": _m.erfinv, "lerp": _m.lerp, "logit": _m.logit,
+    "isnan": _m.isnan, "isinf": _m.isinf, "isfinite": _m.isfinite,
+    "nan_to_num": _m.nan_to_num, "cumsum": _m.cumsum, "cumprod": _m.cumprod,
+    "cummax": _m.cummax, "cummin": _m.cummin, "logsumexp": _m.logsumexp,
+    "scale": _m.scale, "stanh": _m.stanh, "rad2deg": _m.rad2deg,
+    "deg2rad": _m.deg2rad, "digamma": _m.digamma, "lgamma": _m.lgamma,
+    "kron": _m.kron, "diff": _m.diff, "add_": _m.add_, "subtract_": _m.subtract_,
+    "multiply_": _m.multiply_, "conj": _m.conj, "angle": _m.angle,
+    "real": _m.real, "imag": _m.imag, "cast": _m.cast,
+    # reduction
+    "sum": _r.sum, "mean": _r.mean, "max": _r.max, "min": _r.min,
+    "amax": _r.amax, "amin": _r.amin, "prod": _r.prod, "all": _r.all,
+    "any": _r.any, "argmax": _r.argmax, "argmin": _r.argmin, "std": _r.std,
+    "var": _r.var, "median": _r.median, "nanmedian": _r.nanmedian,
+    "nanmean": _r.nanmean, "nansum": _r.nansum, "count_nonzero": _r.count_nonzero,
+    "kthvalue": _r.kthvalue, "mode": _r.mode, "quantile": _r.quantile,
+    # manipulation
+    "reshape": _mp.reshape, "reshape_": _mp.reshape_, "transpose": _mp.transpose,
+    "flatten": _mp.flatten, "squeeze": _mp.squeeze, "unsqueeze": _mp.unsqueeze,
+    "split": _mp.split, "chunk": _mp.chunk, "tile": _mp.tile, "expand": _mp.expand,
+    "expand_as": _mp.expand_as, "broadcast_to": _mp.broadcast_to, "flip": _mp.flip,
+    "roll": _mp.roll, "gather": _mp.gather, "gather_nd": _mp.gather_nd,
+    "scatter": _mp.scatter, "index_select": _mp.index_select,
+    "masked_select": _mp.masked_select, "masked_fill": _mp.masked_fill,
+    "where": _mp.where, "nonzero": _mp.nonzero, "sort": _mp.sort,
+    "argsort": _mp.argsort, "topk": _mp.topk, "unique": _mp.unique,
+    "repeat_interleave": _mp.repeat_interleave, "unbind": _mp.unbind,
+    "take_along_axis": _mp.take_along_axis, "put_along_axis": _mp.put_along_axis,
+    "pad": _mp.pad, "moveaxis": _mp.moveaxis, "swapaxes": _mp.swapaxes,
+    "diagonal": _mp.diagonal, "tensordot": _mp.tensordot,
+    "searchsorted": _mp.searchsorted, "bucketize": _mp.bucketize,
+    "as_complex": _mp.as_complex, "as_real": _mp.as_real, "view": _mp.view,
+    "view_as": _mp.view_as, "rot90": _mp.rot90, "strided_slice": _mp.strided_slice,
+    "index_add": _mp.index_add, "index_put": _mp.index_put,
+    "diagonal_scatter": _mp.diagonal_scatter,
+    # logic
+    "equal": _l.equal, "not_equal": _l.not_equal, "less_than": _l.less_than,
+    "less_equal": _l.less_equal, "greater_than": _l.greater_than,
+    "greater_equal": _l.greater_equal, "equal_all": _l.equal_all,
+    "allclose": _l.allclose, "isclose": _l.isclose,
+    "logical_and": _l.logical_and, "logical_or": _l.logical_or,
+    "logical_not": _l.logical_not, "logical_xor": _l.logical_xor,
+    "bitwise_and": _l.bitwise_and, "bitwise_or": _l.bitwise_or,
+    "bitwise_not": _l.bitwise_not, "bitwise_xor": _l.bitwise_xor,
+    # linalg
+    "norm": _la.norm, "cholesky": _la.cholesky, "inverse": _la.inv,
+    "matrix_power": _la.matrix_power, "det": _la.det, "cross": _la.cross,
+    "histogram": _la.histogram, "bincount": _la.bincount, "t": _la.t,
+    # activation (tensor-method parity with reference)
+    "tanh": _a.tanh, "tanh_": _a.tanh_, "sigmoid": _a.sigmoid,
+    "softmax": _a.softmax, "relu": _a.relu, "relu_": _a.relu_,
+}
+
+for _name, _fn in _METHODS.items():
+    Tensor._install_method(_name, _method(_fn))
+
+# operator dunders
+_DUNDERS = {
+    "__add__": _m.add, "__radd__": _m.add,
+    "__sub__": _m.subtract, "__mul__": _m.multiply, "__rmul__": _m.multiply,
+    "__truediv__": _m.divide, "__floordiv__": _m.floor_divide,
+    "__mod__": _m.remainder, "__pow__": _m.pow, "__matmul__": _m.matmul,
+    "__and__": _l.bitwise_and, "__or__": _l.bitwise_or, "__xor__": _l.bitwise_xor,
+    "__eq__": _l.equal, "__ne__": _l.not_equal, "__lt__": _l.less_than,
+    "__le__": _l.less_equal, "__gt__": _l.greater_than, "__ge__": _l.greater_equal,
+}
+for _name, _fn in _DUNDERS.items():
+    Tensor._install_method(_name, _method(_fn))
+
+_RDUNDERS = {
+    "__rsub__": _m.subtract, "__rtruediv__": _m.divide, "__rpow__": _m.pow,
+    "__rfloordiv__": _m.floor_divide, "__rmod__": _m.remainder,
+    "__rmatmul__": _m.matmul,
+}
+for _name, _fn in _RDUNDERS.items():
+    Tensor._install_method(_name, _method(_fn, swap=True))
+
+Tensor._install_method("__neg__", _method(_m.neg))
+Tensor._install_method("__abs__", _method(_m.abs))
+Tensor._install_method("__invert__", _method(_l.bitwise_not))
+# __eq__ is overridden above; restore identity hashing
+Tensor.__hash__ = lambda self: id(self)

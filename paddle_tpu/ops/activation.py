@@ -1,0 +1,184 @@
+"""Activation ops (reference: python/paddle/nn/functional/activation.py,
+kernels in paddle/phi/kernels/*/activation_kernel.*). These are the op-level
+primitives; nn.functional re-exports them."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..autograd.function import apply
+
+__all__ = [
+    "relu", "relu6", "relu_", "leaky_relu", "elu", "celu", "selu", "gelu",
+    "sigmoid", "log_sigmoid", "hardsigmoid", "hardswish", "hardtanh",
+    "hardshrink", "softshrink", "tanhshrink", "silu", "swish", "mish",
+    "softplus", "softsign", "tanh", "tanh_", "softmax", "log_softmax",
+    "maxout", "thresholded_relu", "rrelu", "prelu", "glu", "swiglu",
+]
+
+
+def _unary(jfn, name):
+    def op(x, name_=None):
+        return apply(jfn, x, name=name)
+    op.__name__ = name
+    return op
+
+
+relu = _unary(jax.nn.relu, "relu")
+relu6 = _unary(jax.nn.relu6, "relu6")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+log_sigmoid = _unary(jax.nn.log_sigmoid, "log_sigmoid")
+silu = _unary(jax.nn.silu, "silu")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+tanh = _unary(jnp.tanh, "tanh")
+mish = _unary(jax.nn.mish, "mish")
+
+
+def relu_(x, name=None) -> Tensor:
+    out = relu(x)
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def tanh_(x, name=None) -> Tensor:
+    out = tanh(x)
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def leaky_relu(x, negative_slope=0.01, name=None) -> Tensor:
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x, name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None) -> Tensor:
+    return apply(lambda a: jax.nn.elu(a, alpha), x, name="elu")
+
+
+def celu(x, alpha=1.0, name=None) -> Tensor:
+    return apply(lambda a: jax.nn.celu(a, alpha), x, name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None) -> Tensor:
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x,
+                 name="selu")
+
+
+def gelu(x, approximate=False, name=None) -> Tensor:
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x, name="gelu")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None) -> Tensor:
+    return apply(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x,
+                 name="hardsigmoid")
+
+
+def hardswish(x, name=None) -> Tensor:
+    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None) -> Tensor:
+    return apply(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None) -> Tensor:
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x,
+                 name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None) -> Tensor:
+    return apply(lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold, 0.0)),
+                 x, name="softshrink")
+
+
+def tanhshrink(x, name=None) -> Tensor:
+    return apply(lambda a: a - jnp.tanh(a), x, name="tanhshrink")
+
+
+def swish(x, name=None) -> Tensor:
+    return silu(x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None) -> Tensor:
+    return apply(lambda a: jnp.where(a * beta > threshold, a,
+                                     jax.nn.softplus(a * beta) / beta), x,
+                 name="softplus")
+
+
+def softmax(x, axis=-1, dtype=None, name=None) -> Tensor:
+    from ..core import dtype as dtypes
+    dt = None if dtype is None else dtypes.dtype_from_any(dtype).np_dtype
+
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+    return apply(f, x, name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None) -> Tensor:
+    from ..core import dtype as dtypes
+    dt = None if dtype is None else dtypes.dtype_from_any(dtype).np_dtype
+
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply(f, x, name="log_softmax")
+
+
+def maxout(x, groups, axis=1, name=None) -> Tensor:
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        shp = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(shp), axis=ax + 1)
+    return apply(f, x, name="maxout")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None) -> Tensor:
+    return apply(lambda a: jnp.where(a > threshold, a, value), x,
+                 name="thresholded_relu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None) -> Tensor:
+    if training:
+        from ..core import generator as gen_mod
+        key = gen_mod.default_generator.split()
+
+        def f(a):
+            slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply(f, x, name="rrelu")
+    mid = (lower + upper) / 2.0
+    return apply(lambda a: jnp.where(a >= 0, a, mid * a), x, name="rrelu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None) -> Tensor:
+    def f(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shp = [1] * a.ndim
+            ch = 1 if data_format == "NCHW" else a.ndim - 1
+            shp[ch] = w.size
+            wb = w.reshape(shp)
+        return jnp.where(a >= 0, a, wb * a)
+    return apply(f, x, weight, name="prelu")
+
+
+def glu(x, axis=-1, name=None) -> Tensor:
+    return apply(lambda a: jax.nn.glu(a, axis=axis), x, name="glu")
+
+
+def swiglu(x, y=None, name=None) -> Tensor:
+    if y is None:
+        def f(a):
+            u, v = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(u) * v
+        return apply(f, x, name="swiglu")
+    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")

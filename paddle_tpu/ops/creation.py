@@ -1,0 +1,179 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, as_tensor
+from ..autograd.function import apply
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
+    "tril", "triu", "meshgrid", "assign", "clone", "tril_indices", "triu_indices",
+    "one_hot", "complex",
+]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return (default or dtypes.get_default_dtype()).np_dtype
+    return dtypes.dtype_from_any(dtype).np_dtype
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        arr = jnp.full(_shape(shape), fill_value)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(dtypes.get_default_dtype().np_dtype)
+        return Tensor(arr)
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=None if dtype is None else _dt(dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=None if dtype is None else _dt(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.full_like(x._data, fill_value,
+                                dtype=None if dtype is None else _dt(dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python numbers")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        use_float = any(isinstance(v, float) for v in (start, end, step))
+        dt = dtypes.get_default_dtype().np_dtype if use_float else np.int64
+    else:
+        dt = _dt(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.linspace(float(start), float(stop), int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = as_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        def f(a):
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            return base + jnp.diag(a - jnp.zeros((), a.dtype), offset) \
+                - jnp.diag(jnp.full((a.shape[0],), padding_value, a.dtype), offset)
+        return apply(f, x, name="diag")
+    return apply(lambda a: jnp.diag(a, offset), x, name="diag")
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply(lambda a: jnp.diagflat(a, offset), as_tensor(x), name="diagflat")
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda a: jnp.tril(a, diagonal), as_tensor(x), name="tril")
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda a: jnp.triu(a, diagonal), as_tensor(x), name="triu")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def meshgrid(*args, name=None):
+    args = [as_tensor(a) for a in (args[0] if len(args) == 1 and
+                                   isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[a._data for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None) -> Tensor:
+    x = as_tensor(x)
+    out = apply(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.number) else a,
+                x, name="assign")
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return as_tensor(x).clone()
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jax_one_hot(x._data, int(num_classes)))
+
+
+def jax_one_hot(a, n):
+    return (a[..., None] == jnp.arange(n, dtype=a.dtype)).astype(
+        dtypes.get_default_dtype().np_dtype)
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply(lambda r, i: jax_complex(r, i), as_tensor(real), as_tensor(imag),
+                 name="complex")
+
+
+def jax_complex(r, i):
+    return r + 1j * i

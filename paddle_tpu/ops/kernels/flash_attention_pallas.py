@@ -1,0 +1,97 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Blocked online-softmax attention (FlashAttention-2 style): grid over
+(batch*heads, q-blocks); the kernel scans k/v blocks keeping running max and
+sum. bf16 inputs compute logits in f32 on the MXU.
+
+Layout: [batch, seq, heads, head_dim] (reference flash_attn layout,
+paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k, seq_len, scale,
+                 block_q):
+    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq, d]; o_ref: [1, block_q, d]
+    d = q_ref.shape[-1]
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_blk = pl.program_id(1)
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    n_k = seq_len // block_k
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only scan k blocks up to (and including) the diagonal block
+        last = ((q_blk + 1) * block_q + block_k - 1) // block_k
+        n_used = jnp.minimum(last, n_k)
+        m, l, acc = jax.lax.fori_loop(0, n_used, body, (m, l, acc))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
+
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention_forward(q, k, v, causal=False, block_q=256, block_k=256,
+                            interpret=False):
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq {s} must divide block sizes {block_q}/{block_k}")
+    scale = 1.0 / math.sqrt(d)
+
+    # [B,S,H,D] -> [B*H, S, D] for blocking along seq
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
+
+    grid = (b * h, s // block_q)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, causal=causal, block_k=block_k,
+                          seq_len=s, scale=scale, block_q=block_q),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

@@ -1,0 +1,227 @@
+"""Linear-algebra ops (reference: python/paddle/tensor/linalg.py; kernels in
+paddle/phi/kernels/*/{cholesky,qr,svd,...}). Exposed as `paddle_tpu.linalg.*`
+and a few top-level names, backed by jnp.linalg / lax.linalg."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, as_tensor
+from ..autograd.function import apply, apply_multi
+
+__all__ = [
+    "norm", "vector_norm", "matrix_norm", "cholesky", "qr", "svd", "svdvals",
+    "inv", "pinv", "solve", "triangular_solve", "cholesky_solve", "lstsq",
+    "det", "slogdet", "matrix_power", "matrix_rank", "eig", "eigh", "eigvals",
+    "eigvalsh", "lu", "cond", "cov", "corrcoef", "householder_product",
+    "multi_dot", "cross", "histogram", "histogramdd", "bincount", "t",
+]
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None) -> Tensor:
+    def f(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=p, keepdims=keepdim)
+        return jnp.linalg.norm(a, ord=p, axis=_ax(axis), keepdims=keepdim)
+    return apply(f, x, name="norm")
+
+
+def _ax(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.linalg.vector_norm(a, ord=p, axis=_ax(axis),
+                                                  keepdims=keepdim), x,
+                 name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim),
+                 x, name="matrix_norm")
+
+
+def cholesky(x, upper=False, name=None) -> Tensor:
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply(f, x, name="cholesky")
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = apply_multi(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, name="qr")
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_multi(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                       x, name="svd")
+
+
+def svdvals(x, name=None) -> Tensor:
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x, name="svdvals")
+
+
+def inv(x, name=None) -> Tensor:
+    return apply(jnp.linalg.inv, x, name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x,
+                 name="pinv")
+
+
+def solve(x, y, name=None) -> Tensor:
+    return apply(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None) -> Tensor:
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(f, x, y, name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None) -> Tensor:
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return apply(f, x, y, name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x_t, y_t = as_tensor(x), as_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x_t._data, y_t._data, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def det(x, name=None) -> Tensor:
+    return apply(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    s, l = apply_multi(lambda a: tuple(jnp.linalg.slogdet(a)), x, name="slogdet")
+    return s, l
+
+
+def matrix_power(x, n, name=None) -> Tensor:
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x, name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x._data, rtol=tol).astype(jnp.int64))
+
+
+def eig(x, name=None):
+    x = as_tensor(x)
+    import numpy as np
+    w, v = np.linalg.eig(x.numpy())  # general eig: CPU (XLA lacks nonsymmetric eig on TPU)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None) -> Tensor:
+    import numpy as np
+    return Tensor(jnp.asarray(np.linalg.eigvals(as_tensor(x).numpy())))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_multi(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x, name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None) -> Tensor:
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x, name="eigvalsh")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = as_tensor(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
+    out = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return out + (Tensor(jnp.zeros((), jnp.int32)),)
+    return out
+
+
+def cond(x, p=None, name=None) -> Tensor:
+    return apply(lambda a: jnp.linalg.cond(a, p=p), x, name="cond")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None) -> Tensor:
+    fw = as_tensor(fweights)._data if fweights is not None else None
+    aw = as_tensor(aweights)._data if aweights is not None else None
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x, name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None) -> Tensor:
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def householder_product(x, tau, name=None) -> Tensor:
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+
+        def body(i, acc):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[..., i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i, None, None] * \
+                (v[..., :, None] * v[..., None, :])
+            return acc @ h
+        return jax.lax.fori_loop(0, n, body, q)[..., :, :n]
+    return apply(f, x, tau, name="householder_product")
+
+
+def multi_dot(x, name=None) -> Tensor:
+    tensors = [as_tensor(t) for t in x]
+    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs), *tensors, name="multi_dot")
+
+
+def cross(x, y, axis=9, name=None) -> Tensor:
+    x_t = as_tensor(x)
+    ax = axis if axis != 9 else next(
+        (i for i, s in enumerate(x_t.shape) if s == 3), -1)
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), x, y, name="cross")
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    a = as_tensor(input)._data.reshape(-1)
+    if min == 0 and max == 0:
+        lo, hi = a.min(), a.max()
+    else:
+        lo, hi = min, max
+    w = as_tensor(weight)._data.reshape(-1) if weight is not None else None
+    h, _ = jnp.histogram(a, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(h if (density or w is not None) else h.astype(jnp.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = as_tensor(x)._data
+    w = as_tensor(weights)._data if weights is not None else None
+    h, edges = jnp.histogramdd(a, bins=bins, range=ranges, weights=w, density=density)
+    return Tensor(h), [Tensor(e) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None) -> Tensor:
+    a = as_tensor(x)._data
+    w = as_tensor(weights)._data if weights is not None else None
+    out = jnp.bincount(a, weights=w, minlength=minlength)  # dynamic: eager-only
+    return Tensor(out if w is not None else out.astype(jnp.int64))
+
+
+def t(input, name=None) -> Tensor:
+    x = as_tensor(input)
+    if x.ndim < 2:
+        return x
+    return apply(lambda a: jnp.swapaxes(a, -1, -2), x, name="t")

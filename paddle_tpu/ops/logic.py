@@ -1,0 +1,79 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
+    "bitwise_not", "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
+    "is_empty", "isreal", "iscomplex",
+]
+
+
+def _cmp(jfn, name):
+    def op(x, y, name_=None):
+        xa = x._data if isinstance(x, Tensor) else x
+        ya = y._data if isinstance(y, Tensor) else y
+        return Tensor(jfn(xa, ya))
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+bitwise_left_shift = _cmp(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _cmp(jnp.right_shift, "bitwise_right_shift")
+
+
+def logical_not(x, name=None) -> Tensor:
+    return Tensor(jnp.logical_not(as_tensor(x)._data))
+
+
+def bitwise_not(x, name=None) -> Tensor:
+    return Tensor(jnp.bitwise_not(as_tensor(x)._data))
+
+
+def equal_all(x, y, name=None) -> Tensor:
+    x, y = as_tensor(x), as_tensor(y)
+    if tuple(x.shape) != tuple(y.shape):
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.all(x._data == y._data))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.allclose(x._data, y._data, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None) -> Tensor:
+    x, y = as_tensor(x), as_tensor(y)
+    return Tensor(jnp.isclose(x._data, y._data, rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def is_empty(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(as_tensor(x).size == 0))
+
+
+def isreal(x, name=None) -> Tensor:
+    return Tensor(jnp.isreal(as_tensor(x)._data))
+
+
+def iscomplex(x) -> bool:
+    return jnp.iscomplexobj(as_tensor(x)._data)

@@ -1,0 +1,594 @@
+"""Shape / layout / indexing manipulation ops
+(reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, as_tensor
+from ..autograd.function import apply, apply_multi
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "flatten", "squeeze", "unsqueeze",
+    "concat", "stack", "split", "chunk", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll", "gather",
+    "gather_nd", "scatter", "scatter_nd_add", "index_select", "index_add",
+    "index_put", "masked_select", "masked_fill", "where", "nonzero", "sort",
+    "argsort", "topk", "unique", "unique_consecutive", "repeat_interleave",
+    "take_along_axis", "put_along_axis", "pad", "slice", "strided_slice",
+    "unbind", "unstack", "moveaxis", "swapaxes", "diagonal", "searchsorted",
+    "bucketize", "as_complex", "as_real", "view", "view_as", "getitem",
+    "setitem_", "crop", "tensordot", "einsum", "tolist", "atleast_1d",
+    "atleast_2d", "atleast_3d", "select_scatter", "diagonal_scatter",
+]
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None) -> Tensor:
+    shp = _norm_shape(shape)
+    return apply(lambda a: jnp.reshape(a, shp), x, name="reshape")
+
+
+def reshape_(x, shape, name=None) -> Tensor:
+    out = reshape(x, shape)
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None) -> Tensor:
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    from ..core import dtype as dtypes
+    dt = dtypes.dtype_from_any(shape_or_dtype).np_dtype
+    x = as_tensor(x)
+    return Tensor(x._data.view(dt))
+
+
+def view_as(x, other, name=None) -> Tensor:
+    return reshape(x, as_tensor(other).shape)
+
+
+def transpose(x, perm=None, name=None) -> Tensor:
+    x = as_tensor(x) if not isinstance(x, Tensor) else x
+    if perm is None:
+        perm = list(range(x.ndim))[::-1]
+    perm = [int(p) for p in perm]
+    return apply(lambda a: jnp.transpose(a, perm), x, name="transpose")
+
+
+def moveaxis(x, source, destination, name=None) -> Tensor:
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x, name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None) -> Tensor:
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), x, name="swapaxes")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None) -> Tensor:
+    x = as_tensor(x) if not isinstance(x, Tensor) else x
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def f(a):
+        shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, shape)
+    return apply(f, x, name="flatten")
+
+
+def squeeze(x, axis=None, name=None) -> Tensor:
+    x = as_tensor(x) if not isinstance(x, Tensor) else x
+    if axis is None:
+        ax = None
+    else:
+        if isinstance(axis, (int, np.integer)):
+            axis = [axis]
+        ax = tuple(int(a) % x.ndim for a in axis if x.shape[int(a) % x.ndim] == 1)
+    return apply(lambda a: jnp.squeeze(a, axis=ax), x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None) -> Tensor:
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    ax = tuple(int(a) for a in axis)
+    return apply(lambda a: jnp.expand_dims(a, ax), x, name="unsqueeze")
+
+
+def concat(x, axis=0, name=None) -> Tensor:
+    tensors = [as_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), *tensors,
+                 name="concat")
+
+
+def stack(x, axis=0, name=None) -> Tensor:
+    tensors = [as_tensor(t) for t in x]
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), *tensors, name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = as_tensor(x) if not isinstance(x, Tensor) else x
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections:
+            raise ValueError(
+                f"split: axis {axis} length {dim} is not divisible by "
+                f"{num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_neg = sum(1 for s in sizes if s < 0)
+        if n_neg:
+            rest = dim - sum(s for s in sizes if s >= 0)
+            sizes = [rest if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes).tolist()
+    n = len(sizes)
+
+    def f(a):
+        return tuple(jax.lax.slice_in_dim(a, offsets[i], offsets[i + 1], axis=axis)
+                     for i in range(n))
+    return list(apply_multi(f, x, name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = as_tensor(x) if not isinstance(x, Tensor) else x
+    n = x.shape[axis]
+
+    def f(a):
+        return tuple(jnp.take(a, i, axis=axis) for i in range(n))
+    return list(apply_multi(f, x, name="unbind"))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None) -> Tensor:
+    reps = _norm_shape(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+def expand(x, shape, name=None) -> Tensor:
+    shp = _norm_shape(shape)
+    x = as_tensor(x) if not isinstance(x, Tensor) else x
+    # -1 entries keep the original size (paddle semantics)
+    cur = ([1] * (len(shp) - x.ndim)) + x.shape
+    tgt = tuple(c if s == -1 else s for s, c in zip(shp, cur))
+    return apply(lambda a: jnp.broadcast_to(a, tgt), x, name="expand")
+
+
+def expand_as(x, y, name=None) -> Tensor:
+    return expand(x, as_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None) -> Tensor:
+    shp = _norm_shape(shape)
+    return apply(lambda a: jnp.broadcast_to(a, shp), x, name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [as_tensor(t) for t in inputs]
+    shp = np.broadcast_shapes(*[tuple(t.shape) for t in tensors])
+    return [broadcast_to(t, shp) for t in tensors]
+
+
+def atleast_1d(*inputs):
+    outs = [reshape(t, [-1]) if as_tensor(t).ndim == 0 else as_tensor(t)
+            for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = []
+    for t in inputs:
+        t = as_tensor(t)
+        while t.ndim < 2:
+            t = unsqueeze(t, 0)
+        outs.append(t)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = []
+    for t in inputs:
+        t = as_tensor(t)
+        while t.ndim < 3:
+            t = unsqueeze(t, t.ndim)
+        outs.append(t)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def flip(x, axis, name=None) -> Tensor:
+    if isinstance(axis, int):
+        axis = [axis]
+    ax = tuple(int(a) for a in axis)
+    return apply(lambda a: jnp.flip(a, axis=ax), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None) -> Tensor:
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None) -> Tensor:
+    if isinstance(shifts, Tensor):
+        shifts = shifts.tolist()
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), x, name="roll")
+
+
+def gather(x, index, axis=0, name=None) -> Tensor:
+    idx = as_tensor(index)._data
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda a: jnp.take(a, idx.reshape(-1) if idx.ndim else idx,
+                                    axis=axis), x, name="gather")
+
+
+def gather_nd(x, index, name=None) -> Tensor:
+    idx = as_tensor(index)._data
+
+    def f(a):
+        nd = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(nd))
+        return a[flat_idx]
+    return apply(f, x, name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None) -> Tensor:
+    idx = as_tensor(index)._data.reshape(-1)
+
+    def f(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        z = a.at[idx].set(jnp.zeros_like(u))
+        return z.at[idx].add(u)
+    return apply(f, x, as_tensor(updates), name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None) -> Tensor:
+    idx = as_tensor(index)._data
+
+    def f(a, u):
+        nd = idx.shape[-1]
+        return a.at[tuple(idx[..., i] for i in range(nd))].add(u)
+    return apply(f, x, as_tensor(updates), name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None) -> Tensor:
+    idx = as_tensor(index)._data
+    return apply(lambda a: jnp.take(a, idx, axis=axis), x, name="index_select")
+
+
+def index_add(x, index, axis, value, name=None) -> Tensor:
+    idx = as_tensor(index)._data
+
+    def f(a, v):
+        sl = [np.s_[:]] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].add(v)
+    return apply(f, x, as_tensor(value), name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None) -> Tensor:
+    idx = tuple(as_tensor(i)._data for i in indices)
+
+    def f(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+    return apply(f, x, as_tensor(value), name="index_put")
+
+
+def masked_select(x, mask, name=None) -> Tensor:
+    # dynamic output shape: eager-only (like reference's masked_select)
+    x, m = as_tensor(x), as_tensor(mask)
+    return Tensor(x._data[m._data])
+
+
+def masked_fill(x, mask, value, name=None) -> Tensor:
+    m = as_tensor(mask)._data
+    if isinstance(value, Tensor):
+        return apply(lambda a, v: jnp.where(m, v.astype(a.dtype), a), x, value,
+                     name="masked_fill")
+    return apply(lambda a: jnp.where(m, jnp.asarray(value, a.dtype), a), x,
+                 name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = as_tensor(condition)
+    if x is None and y is None:
+        return nonzero(cond, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), cond, x, y, name="where")
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = as_tensor(x)
+    idx = jnp.nonzero(x._data)  # dynamic shape: eager-only
+    if as_tuple:
+        return tuple(Tensor(i[:, None]) for i in idx)
+    return Tensor(jnp.stack(idx, axis=1).astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    def f(a):
+        s = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply(f, x, name="sort")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None) -> Tensor:
+    x = as_tensor(x)
+    s = jnp.argsort(x._data, axis=axis, stable=stable)
+    if descending:
+        s = jnp.flip(s, axis=axis)
+    return Tensor(s.astype(jnp.int64))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = as_tensor(x) if not isinstance(x, Tensor) else x
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = axis % x.ndim
+
+    def f(a):
+        a2 = jnp.moveaxis(a, ax, -1)
+        v, i = jax.lax.top_k(a2 if largest else -a2, k)
+        v = v if largest else -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax)
+    vals, idx = apply_multi(f, x, name="topk")
+    return vals, Tensor(idx._data.astype(jnp.int64))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    res = jnp.unique(x._data, return_index=return_index,
+                     return_inverse=return_inverse, return_counts=return_counts,
+                     axis=axis)  # dynamic shape: eager-only
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r.astype(jnp.int64) if i > 0 else r)
+                 for i, r in enumerate(res))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    # dynamic output shape: eager-only, computed host-side like the reference's
+    # CPU kernel
+    x = as_tensor(x)
+    a = np.asarray(x.numpy())
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis % a.ndim
+    n = a.shape[ax]
+    if n == 0:
+        first = np.zeros((0,), bool)
+    else:
+        moved = np.moveaxis(a, ax, 0).reshape(n, -1)
+        first = np.concatenate([[True], (moved[1:] != moved[:-1]).any(axis=1)])
+    keep = np.nonzero(first)[0]
+    out = [Tensor(jnp.asarray(np.take(a, keep, axis=ax)))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(first.astype(np.int64)) - 1)))
+    if return_counts:
+        nxt = np.concatenate([keep[1:], [n]])
+        out.append(Tensor(jnp.asarray((nxt - keep).astype(np.int64))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None) -> Tensor:
+    if isinstance(repeats, Tensor):
+        repeats = repeats._data
+    return apply(lambda a: jnp.repeat(a, repeats, axis=axis), x,
+                 name="repeat_interleave")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None) -> Tensor:
+    idx = as_tensor(indices)._data
+    return apply(lambda a: jnp.take_along_axis(a, idx, axis=axis), arr,
+                 name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None) -> Tensor:
+    idx = as_tensor(indices)._data
+    mode = {"assign": "set", "add": "add", "mul": "multiply", "multiply": "multiply",
+            "amin": "min", "amax": "max"}[reduce]
+
+    def f(a, v):
+        v = jnp.broadcast_to(v, idx.shape) if np.ndim(v) else \
+            jnp.full(idx.shape, v, a.dtype)
+        sl = []
+        for d in range(a.ndim):
+            if d == axis % a.ndim:
+                sl.append(idx)
+            else:
+                shape = [1] * a.ndim
+                shape[d] = idx.shape[d]
+                sl.append(jnp.reshape(jnp.arange(idx.shape[d]), shape))
+        return getattr(a.at[tuple(sl)], mode)(v.astype(a.dtype))
+    if isinstance(values, Tensor):
+        return apply(f, arr, values, name="put_along_axis")
+    return apply(lambda a: f(a, values), arr, name="put_along_axis")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None) -> Tensor:
+    x = as_tensor(x) if not isinstance(x, Tensor) else x
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-rank paddle layout: [dim0_before, dim0_after, ...]? paddle uses
+        # per-dim pairs in order of dims for len==2*ndim
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial (NCHW/NHWC style): pad applies to trailing spatial dims,
+        # ordered last-dim-first like torch/paddle functional.pad
+        widths = [(0, 0)] * nd
+        n_pairs = len(pad) // 2
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            dims = list(range(nd - 1, nd - 1 - n_pairs, -1))
+        else:  # NHWC-style: spatial dims are 1..nd-2
+            dims = list(range(nd - 2, nd - 2 - n_pairs, -1))
+        for i, d in enumerate(dims):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    kw = {"constant_values": value} if jmode == "constant" else {}
+    return apply(lambda a: jnp.pad(a, widths, mode=jmode, **kw), x, name="pad")
+
+
+def slice(input, axes, starts, ends, name=None) -> Tensor:
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def f(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            n = a.shape[ax]
+            st2, en2 = max(st + n, 0) if st < 0 else min(st, n), \
+                max(en + n, 0) if en < 0 else min(en, n)
+            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+    return apply(f, input, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None) -> Tensor:
+    def f(a):
+        sl = [np.s_[:]] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[ax] = np.s_[st:en:sd]
+        return a[tuple(sl)]
+    return apply(f, x, name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    shape = _norm_shape(shape) if shape is not None else tuple(x.shape)
+    offsets = _norm_shape(offsets) if offsets is not None else (0,) * x.ndim
+    shape = tuple(x.shape[i] if s == -1 else s for i, s in enumerate(shape))
+
+    def f(a):
+        return jax.lax.dynamic_slice(a, offsets, shape)
+    return apply(f, x, name="crop")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None) -> Tensor:
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                 x, name="diagonal")
+
+
+def select_scatter(x, values, axis, index, name=None) -> Tensor:
+    def f(a, v):
+        sl = [np.s_[:]] * a.ndim
+        sl[axis] = index
+        return a.at[tuple(sl)].set(v.astype(a.dtype))
+    return apply(f, x, as_tensor(values), name="select_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None) -> Tensor:
+    def f(a, v):
+        rows, cols = a.shape[axis1], a.shape[axis2]
+        # length of the offset diagonal (matches jnp.diagonal)
+        n = builtins_min(rows + builtins_min(offset, 0),
+                         cols - builtins_max(offset, 0))
+        i = jnp.arange(builtins_max(n, 0))
+        r = i + (-offset if offset < 0 else 0)
+        c = i + (offset if offset > 0 else 0)
+        sl = [np.s_[:]] * a.ndim
+        sl[axis1], sl[axis2] = r, c
+        return a.at[tuple(sl)].set(v.astype(a.dtype))
+    return apply(f, x, as_tensor(y), name="diagonal_scatter")
+
+
+builtins_min = min
+builtins_max = max
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None) -> Tensor:
+    s, v = as_tensor(sorted_sequence), as_tensor(values)
+    side = "right" if right else "left"
+    if s.ndim == 1:
+        out = jnp.searchsorted(s._data, v._data, side=side)
+    else:
+        out = jax.vmap(lambda sq, vl: jnp.searchsorted(sq, vl, side=side))(
+            s._data.reshape(-1, s.shape[-1]), v._data.reshape(-1, v.shape[-1])
+        ).reshape(v.shape)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None) -> Tensor:
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def as_complex(x, name=None) -> Tensor:
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, name="as_complex")
+
+
+def as_real(x, name=None) -> Tensor:
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x,
+                 name="as_real")
+
+
+def tensordot(x, y, axes=2, name=None) -> Tensor:
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y, name="tensordot")
+
+
+def einsum(equation, *operands):
+    tensors = [as_tensor(o) for o in operands]
+    return apply(lambda *arrs: jnp.einsum(equation, *arrs), *tensors, name="einsum")
+
+
+def tolist(x):
+    return as_tensor(x).tolist()
+
+
+# -- __getitem__ / __setitem__ ---------------------------------------------
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+def getitem(x, idx) -> Tensor:
+    jidx = _unwrap_index(idx)
+    return apply(lambda a: a[jidx], x, name="getitem")
+
+
+def setitem_(x, idx, value) -> Tensor:
+    jidx = _unwrap_index(idx)
+    if isinstance(value, Tensor):
+        out = apply(lambda a, v: a.at[jidx].set(v.astype(a.dtype)), x, value,
+                    name="setitem")
+    else:
+        out = apply(lambda a: a.at[jidx].set(jnp.asarray(value, a.dtype)), x,
+                    name="setitem")
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x

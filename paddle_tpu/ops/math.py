@@ -1,0 +1,310 @@
+"""Elementwise & general math ops (reference: python/paddle/tensor/math.py,
+ops declared in paddle/phi/api/yaml/ops.yaml)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, as_tensor
+from ..autograd.function import apply
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "float_power", "matmul", "dot", "inner", "outer", "bmm", "addmm", "mm",
+    "neg", "abs", "sign", "reciprocal", "exp", "expm1", "log", "log2", "log10",
+    "log1p", "sqrt", "rsqrt", "square", "sin", "cos", "tan", "asin", "acos",
+    "atan", "atan2", "sinh", "cosh", "asinh", "acosh", "atanh", "floor", "ceil",
+    "round", "trunc", "frac", "clip", "maximum", "minimum", "fmax", "fmin",
+    "erf", "erfinv", "lerp", "logit", "isnan", "isinf", "isfinite", "nan_to_num",
+    "cumsum", "cumprod", "cummax", "cummin", "logsumexp", "logaddexp",
+    "multiply_", "add_", "subtract_", "clip_", "scale", "stanh", "rad2deg",
+    "deg2rad", "gcd", "lcm", "heaviside", "nextafter", "hypot", "ldexp",
+    "digamma", "lgamma", "polygamma", "i0", "i1", "sinc", "diff", "trapezoid",
+    "kron", "cast", "increment", "angle", "conj", "real", "imag",
+]
+
+
+def _binary(jfn, name):
+    def op(x, y, name_=None):
+        return apply(jfn, x, y, name=name)
+    op.__name__ = name
+    return op
+
+
+def _unary(jfn, name):
+    def op(x, name_=None):
+        return apply(jfn, x, name=name)
+    op.__name__ = name
+    return op
+
+
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+remainder = _binary(jnp.remainder, "remainder")
+mod = remainder
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+nextafter = _binary(jnp.nextafter, "nextafter")
+hypot = _binary(jnp.hypot, "hypot")
+gcd = _binary(jnp.gcd, "gcd")
+lcm = _binary(jnp.lcm, "lcm")
+heaviside = _binary(jnp.heaviside, "heaviside")
+ldexp = _binary(jnp.ldexp, "ldexp")
+kron = _binary(jnp.kron, "kron")
+
+
+def divide(x, y, name=None) -> Tensor:
+    return apply(jnp.true_divide, x, y, name="divide")
+
+
+def pow(x, y, name=None) -> Tensor:
+    return apply(jnp.power, x, y, name="pow")
+
+
+float_power = pow
+
+neg = _unary(jnp.negative, "neg")
+abs = _unary(jnp.abs, "abs")
+sign = _unary(jnp.sign, "sign")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+square = _unary(jnp.square, "square")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+i0 = _unary(jax.scipy.special.i0, "i0")
+i1 = _unary(jax.scipy.special.i1, "i1")
+sinc = _unary(jnp.sinc, "sinc")
+angle = _unary(jnp.angle, "angle")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+
+
+def frac(x, name=None) -> Tensor:
+    return apply(lambda a: a - jnp.trunc(a), x, name="frac")
+
+
+def polygamma(x, n, name=None) -> Tensor:
+    return apply(lambda a: jax.scipy.special.polygamma(n, a), x, name="polygamma")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None) -> Tensor:
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
+
+
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+
+
+def clip(x, min=None, max=None, name=None) -> Tensor:
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+def clip_(x, min=None, max=None, name=None) -> Tensor:
+    out = clip(x, min, max)
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def lerp(x, y, weight, name=None) -> Tensor:
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+
+
+def logit(x, eps=None, name=None) -> Tensor:
+    def f(a):
+        z = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(z) - jnp.log1p(-z)
+    return apply(f, x, name="logit")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None) -> Tensor:
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                 x, name="nan_to_num")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None) -> Tensor:
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def f(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+    return apply(f, x, name="scale")
+
+
+def increment(x, value=1.0, name=None) -> Tensor:
+    out = apply(lambda a: a + value, x, name="increment")
+    x._data = out._data
+    return x
+
+
+def cast(x, dtype, name=None) -> Tensor:
+    dt = dtypes.dtype_from_any(dtype)
+    x = as_tensor(x) if not isinstance(x, Tensor) else x
+    if x.dtype == dt:
+        return x
+    src_float = jnp.issubdtype(x._data.dtype, jnp.inexact)
+    dst_float = np.issubdtype(dt.np_dtype, np.inexact) or dt.name in (
+        "bfloat16", "float8_e4m3fn", "float8_e5m2")
+    if src_float and dst_float:
+        return apply(lambda a: a.astype(dt.np_dtype), x, name="cast")
+    return Tensor(x._data.astype(dt.np_dtype),
+                  stop_gradient=x.stop_gradient if not src_float else True)
+
+
+# -- matmul family ----------------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None) -> Tensor:
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply(f, x, y, name="matmul")
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None) -> Tensor:
+    return apply(jnp.matmul, x, y, name="bmm")
+
+
+def dot(x, y, name=None) -> Tensor:
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot")
+
+
+def inner(x, y, name=None) -> Tensor:
+    return apply(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y, name=None) -> Tensor:
+    return apply(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None) -> Tensor:
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 input, x, y, name="addmm")
+
+
+# -- scans ------------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None) -> Tensor:
+    dt = None if dtype is None else dtypes.dtype_from_any(dtype).np_dtype
+    return apply(lambda a: jnp.cumsum(a, axis=axis, dtype=dt), x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None) -> Tensor:
+    dt = None if dtype is None else dtypes.dtype_from_any(dtype).np_dtype
+    return apply(lambda a: jnp.cumprod(a, axis=dim, dtype=dt), x, name="cumprod")
+
+
+def _cum_extreme(x, axis, dtype, combine, name):
+    x = as_tensor(x)
+    ax = axis if axis is not None else 0
+    flat = x if axis is not None else x.reshape([-1])
+    v = apply(lambda arr: jax.lax.associative_scan(combine, arr, axis=ax),
+              flat, name=name)
+    idx = _cum_arg(flat._data, v._data, ax, dtypes.dtype_from_any(dtype).np_dtype)
+    return v, Tensor(idx)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, jnp.maximum, "cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, jnp.minimum, "cummin")
+
+
+def _cum_arg(a, vals, ax, dtype):
+    # index of the running extremum: latest position where a == running extremum
+    n = a.shape[ax]
+    pos = jnp.arange(n).reshape([-1 if i == (ax % a.ndim) else 1
+                                 for i in range(a.ndim)])
+    hit = (a == vals)
+    masked = jnp.where(hit, pos, -1)
+    return jax.lax.associative_scan(jnp.maximum, masked, axis=ax).astype(dtype)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim),
+                 x, name="logsumexp")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None) -> Tensor:
+    has_pre = isinstance(prepend, Tensor)
+    has_app = isinstance(append, Tensor)
+
+    def f(a, *rest):
+        it = iter(rest)
+        p = next(it) if has_pre else prepend
+        q = next(it) if has_app else append
+        return jnp.diff(a, n=n, axis=axis, prepend=p, append=q)
+
+    args = [x] + ([prepend] if has_pre else []) + ([append] if has_app else [])
+    return apply(f, *args, name="diff")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None) -> Tensor:
+    if x is not None:
+        return apply(lambda a, b: jnp.trapezoid(a, x=b, axis=axis), y, x,
+                     name="trapezoid")
+    return apply(lambda a: jnp.trapezoid(a, dx=1.0 if dx is None else dx, axis=axis),
+                 y, name="trapezoid")
+
+
+# in-place style aliases (functional rebind)
+def _inplace(fn):
+    def op(x, y, name=None):
+        out = fn(x, y)
+        x._data, x._node, x._out_index = out._data, out._node, out._out_index
+        x.stop_gradient = out.stop_gradient
+        return x
+    return op
+
+
+add_ = _inplace(add)
+subtract_ = _inplace(subtract)
+multiply_ = _inplace(multiply)

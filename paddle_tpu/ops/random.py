@@ -1,0 +1,141 @@
+"""Random ops (reference: python/paddle/tensor/random.py), over the global
+stateful Generator (core/generator.py) -> jax threefry keys."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import generator as gen_mod
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = [
+    "rand", "randn", "uniform", "normal", "gaussian", "standard_normal",
+    "randint", "randint_like", "randperm", "bernoulli", "multinomial",
+    "poisson", "exponential_", "uniform_", "normal_", "binomial", "standard_gamma",
+]
+
+
+def _key(gen=None):
+    g = gen or gen_mod.default_generator
+    return g.split()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def _dt(dtype):
+    return dtypes.dtype_from_any(dtype).np_dtype
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.key(seed) if seed else _key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    key = jax.random.key(seed) if seed else _key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean)._data if isinstance(mean, Tensor) else mean
+        s = as_tensor(std)._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(np.shape(m), np.shape(s))
+        return Tensor(m + s * jax.random.normal(_key(), shp,
+                                                dtypes.get_default_dtype().np_dtype))
+    return gaussian(shape, mean, std)
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return gaussian(shape, 0.0, 1.0, dtype=dtype)
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return standard_normal(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), _shape(shape), low, high, _dt(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    if high is None:
+        low, high = 0, low
+    dt = _dt(dtype) if dtype is not None else x._data.dtype
+    return Tensor(jax.random.randint(_key(), tuple(x.shape), low, high).astype(dt))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(_key(), int(n)).astype(_dt(dtype)))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jax.random.bernoulli(_key(), x._data).astype(x._data.dtype))
+
+
+def poisson(x, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jax.random.poisson(_key(), x._data).astype(x._data.dtype))
+
+
+def binomial(count, prob, name=None) -> Tensor:
+    c, p = as_tensor(count), as_tensor(prob)
+    return Tensor(jax.random.binomial(_key(), c._data.astype(jnp.float32),
+                                      p._data).astype(jnp.int64))
+
+
+def standard_gamma(x, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jax.random.gamma(_key(), x._data))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = as_tensor(x)
+    probs = x._data / jnp.sum(x._data, axis=-1, keepdims=True)
+    if x.ndim == 1:
+        out = jax.random.choice(_key(), x.shape[0], (num_samples,),
+                                replace=replacement, p=probs)
+    else:
+        keys = jax.random.split(_key(), x.shape[0])
+        out = jax.vmap(lambda k, p: jax.random.choice(
+            k, x.shape[-1], (num_samples,), replace=replacement, p=p))(keys, probs)
+    return Tensor(out.astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    x = as_tensor(x)
+    x._data = jax.random.exponential(_key(), tuple(x.shape),
+                                     x._data.dtype) / lam
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    x._data = jax.random.uniform(_key(), tuple(x.shape), x._data.dtype,
+                                 minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x._data = mean + std * jax.random.normal(_key(), tuple(x.shape), x._data.dtype)
+    return x

@@ -1,0 +1,160 @@
+"""Reduction ops (reference: python/paddle/tensor/math.py + stat.py + search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, as_tensor
+from ..autograd.function import apply
+
+__all__ = [
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "all", "any",
+    "argmax", "argmin", "std", "var", "median", "nanmedian", "nanmean",
+    "nansum", "count_nonzero", "numel", "kthvalue", "mode", "quantile",
+]
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None) -> Tensor:
+    from ..core import dtype as dtypes
+    dt = None if dtype is None else dtypes.dtype_from_any(dtype).np_dtype
+    return apply(lambda a: jnp.sum(a, axis=_axes(axis), dtype=dt, keepdims=keepdim),
+                 x, name="sum")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.nansum(a, axis=_axes(axis), keepdims=keepdim),
+                 x, name="nansum")
+
+
+def mean(x, axis=None, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.mean(a, axis=_axes(axis), keepdims=keepdim),
+                 x, name="mean")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.nanmean(a, axis=_axes(axis), keepdims=keepdim),
+                 x, name="nanmean")
+
+
+def max(x, axis=None, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.max(a, axis=_axes(axis), keepdims=keepdim),
+                 x, name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.min(a, axis=_axes(axis), keepdims=keepdim),
+                 x, name="min")
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None) -> Tensor:
+    from ..core import dtype as dtypes
+    dt = None if dtype is None else dtypes.dtype_from_any(dtype).np_dtype
+    return apply(lambda a: jnp.prod(a, axis=_axes(axis), dtype=dt, keepdims=keepdim),
+                 x, name="prod")
+
+
+def all(x, axis=None, keepdim=False, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.all(x._data, axis=_axes(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.any(x._data, axis=_axes(axis), keepdims=keepdim))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    x = as_tensor(x)
+    a = jnp.argmax(x._data, axis=_axes(axis), keepdims=keepdim if axis is not None else False)
+    return Tensor(a.astype(jnp.dtype(str(dtype).replace("paddle_tpu.", ""))))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None) -> Tensor:
+    x = as_tensor(x)
+    a = jnp.argmin(x._data, axis=_axes(axis), keepdims=keepdim if axis is not None else False)
+    return Tensor(a.astype(jnp.dtype(str(dtype).replace("paddle_tpu.", ""))))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.std(a, axis=_axes(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.var(a, axis=_axes(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None) -> Tensor:
+    def f(a):
+        if mode == "min" and axis is not None:
+            n = a.shape[axis]
+            k = (n - 1) // 2
+            srt = jnp.sort(a, axis=axis)
+            return jnp.take(srt, k, axis=axis) if not keepdim else \
+                jnp.take(srt, jnp.array([k]), axis=axis)
+        return jnp.median(a, axis=_axes(axis), keepdims=keepdim)
+    return apply(f, x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None) -> Tensor:
+    return apply(lambda a: jnp.nanmedian(a, axis=_axes(axis), keepdims=keepdim),
+                 x, name="nanmedian")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.count_nonzero(x._data, axis=_axes(axis), keepdims=keepdim)
+                  .astype(jnp.int64))
+
+
+def numel(x, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+    srt_idx = jnp.argsort(x._data, axis=axis)
+    idx = jnp.take(srt_idx, k - 1, axis=axis)
+    vals = apply(lambda a: jnp.take(jnp.sort(a, axis=axis), k - 1, axis=axis),
+                 x, name="kthvalue")
+    if keepdim:
+        vals = apply(lambda a: jnp.expand_dims(a, axis), vals, name="kthvalue_keepdim")
+        idx = jnp.expand_dims(idx, axis)
+    return vals, Tensor(idx.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+    a = jnp.moveaxis(x._data, axis, -1)
+    srt = jnp.sort(a, axis=-1)
+    counts = (srt[..., :, None] == srt[..., None, :]).sum(-1)  # O(n^2), rarely-hot op
+    best = jnp.argmax(counts, axis=-1, keepdims=True)
+    vals = jnp.moveaxis(jnp.take_along_axis(srt, best, axis=-1), -1, axis)
+    idx = jnp.argmax(jnp.moveaxis(a, -1, axis) == vals, axis=axis, keepdims=True)
+    if not keepdim:
+        vals, idx = vals.squeeze(axis), idx.squeeze(axis)
+    return Tensor(vals), Tensor(idx.astype(jnp.int64))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None) -> Tensor:
+    qv = q.item() if isinstance(q, Tensor) else q
+    return apply(lambda a: jnp.quantile(a, jnp.asarray(qv), axis=_axes(axis),
+                                        keepdims=keepdim, method=interpolation),
+                 x, name="quantile")

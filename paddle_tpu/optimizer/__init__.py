@@ -1,0 +1,7 @@
+"""`paddle.optimizer` equivalent."""
+
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Momentum, Adam, AdamW, Adagrad, Adadelta, Adamax, RMSProp, Lamb, LBFGS,
+)
+from . import lr  # noqa: F401

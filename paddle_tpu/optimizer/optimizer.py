@@ -1,0 +1,187 @@
+"""Optimizer base class (reference: python/paddle/optimizer/optimizer.py:93).
+
+Semantics mirror the reference: optimizers hold a parameter list, read
+``param.grad`` filled by ``loss.backward()``, apply grad clip / weight decay,
+and update parameters in place. The learning rate lives in a device scalar
+(`_lr_tensor`) so a jitted train step never recompiles when a scheduler steps.
+
+All update math is jnp elementwise — XLA fuses the whole optimizer into a few
+kernels under jit, which is the TPU analog of the reference's fused
+multi-tensor AdamW kernels (paddle/phi/kernels/fusion/gpu/fused_adam_kernel.cu).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import dtype as dtypes
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        from .lr import LRScheduler
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        self._parameter_list = list(parameters)
+        # support param groups: [{'params': [...], 'learning_rate': ...}, ...]
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._lr_scheduler = None
+        if isinstance(learning_rate, LRScheduler):
+            self._lr_scheduler = learning_rate
+            lr0 = float(learning_rate())
+        else:
+            lr0 = float(learning_rate)
+        self._lr_tensor = Tensor(jnp.asarray(lr0, jnp.float32))
+        if self._lr_scheduler is not None:
+            self._lr_scheduler.bind(self)
+        # a bare float weight_decay means coupled L2 decay (reference
+        # semantics); decoupled optimizers (AdamW) bypass this and use
+        # self._weight_decay directly
+        self._weight_decay = weight_decay if isinstance(weight_decay, (int, float)) \
+            else getattr(weight_decay, "_coeff", None)
+        if isinstance(weight_decay, (int, float)) and weight_decay:
+            from ..regularizer import L2Decay
+            self._regularization = L2Decay(float(weight_decay))
+        elif weight_decay is None or isinstance(weight_decay, (int, float)):
+            self._regularization = None
+        else:  # L1Decay / L2Decay object
+            self._regularization = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # accumulators: name -> {param_name: Tensor}
+        self._accumulators: dict[str, dict[int, Tensor]] = defaultdict(dict)
+        self._master_weights: dict[int, Tensor] = {}
+        self._step_count = 0
+        # device-side step counter so bias correction is data, not a baked
+        # constant, inside a jitted train step
+        self._step_tensor = Tensor(jnp.zeros((), jnp.float32))
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        return float(self._lr_tensor._data)
+
+    def set_lr(self, value: float):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("cannot set_lr when an LRScheduler is in use")
+        self._lr_tensor._data = jnp.asarray(float(value), jnp.float32)
+
+    def _set_lr_value(self, value: float):
+        self._lr_tensor._data = jnp.asarray(float(value), jnp.float32)
+
+    def _lr(self, param=None):
+        lr = self._lr_tensor._data
+        if param is not None and getattr(param, "optimize_attr", None):
+            lr = lr * param.optimize_attr.get("learning_rate", 1.0)
+        return lr
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, dtype=None):
+        key = id(param)
+        if key not in self._accumulators[name]:
+            dt = dtype if dtype is not None else (
+                jnp.float32 if self._multi_precision else param._data.dtype)
+            self._accumulators[name][key] = Tensor(
+                jnp.full(param._data.shape, fill_value, dt))
+        return self._accumulators[name][key]
+
+    def _get_master(self, param):
+        if not self._multi_precision or param._data.dtype == jnp.float32.dtype:
+            return None
+        key = id(param)
+        if key not in self._master_weights:
+            self._master_weights[key] = Tensor(param._data.astype(jnp.float32))
+        return self._master_weights[key]
+
+    # -- core update --------------------------------------------------------
+    def step(self):
+        params_grads = []
+        for p in self._parameter_list:
+            if p.stop_gradient or p._grad is None:
+                continue
+            params_grads.append((p, p._grad))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        self._step_tensor._data = self._step_tensor._data + 1.0
+        for p, g in params_grads:
+            if g is None:
+                continue
+            self._append_optimize_op(p, g)
+
+    def _append_optimize_op(self, param, grad):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p._grad) for p in self._parameter_list]
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self) -> dict:
+        state = {}
+        for acc_name, accs in self._accumulators.items():
+            for p in self._parameter_list:
+                if id(p) in accs:
+                    state[f"{p.name}_{acc_name}"] = accs[id(p)]
+        if self._master_weights:
+            state["master_weights"] = {
+                p.name: self._master_weights[id(p)]
+                for p in self._parameter_list if id(p) in self._master_weights}
+        if self._lr_scheduler is not None:
+            state["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        state["@step"] = self._step_count
+        return state
+
+    def set_state_dict(self, state_dict):
+        state_dict = dict(state_dict)
+        self._step_count = int(state_dict.pop("@step", 0))
+        sched = state_dict.pop("LR_Scheduler", None)
+        if sched is not None and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(sched)
+        masters = state_dict.pop("master_weights", None)
+        if masters:
+            by_name = {p.name: p for p in self._parameter_list}
+            for n, w in masters.items():
+                if n in by_name:
+                    self._master_weights[id(by_name[n])] = Tensor(
+                        w._data if isinstance(w, Tensor) else jnp.asarray(w))
+        by_name = {p.name: p for p in self._parameter_list}
+        for k, v in state_dict.items():
+            for p_name, p in by_name.items():
+                if k.startswith(p_name + "_"):
+                    acc_name = k[len(p_name) + 1:]
+                    arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                    self._accumulators[acc_name][id(p)] = Tensor(arr)
+                    break
+
+    # -- state tensors for jit lifting -------------------------------------
+    def _state_tensors(self) -> list[Tensor]:
+        out = [self._lr_tensor]
+        for accs in self._accumulators.values():
+            out.extend(accs.values())
+        out.extend(self._master_weights.values())
+        return out
+
+    # weight decay helper: returns decayed grad (decoupled handled per-opt)
+    def _apply_coupled_weight_decay(self, param, g_arr):
+        if self._regularization is not None:
+            return self._regularization._apply(param._data, g_arr)
+        return g_arr

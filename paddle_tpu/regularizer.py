@@ -1,0 +1,40 @@
+"""`paddle.regularizer` equivalent (reference: python/paddle/regularizer.py).
+
+Regularizers apply coupled decay to gradients inside the optimizer; a bare
+float ``weight_decay`` is treated as L2Decay, matching the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    _coeff = 0.0
+
+    def _apply(self, param_arr, grad_arr):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    def _apply(self, param_arr, grad_arr):
+        return grad_arr + self._coeff * jnp.sign(param_arr).astype(grad_arr.dtype)
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    def _apply(self, param_arr, grad_arr):
+        return grad_arr + self._coeff * param_arr.astype(grad_arr.dtype)
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"

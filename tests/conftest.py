@@ -1,0 +1,32 @@
+"""Test configuration: force a virtual 8-device CPU mesh so the entire
+distributed stack is testable without TPU hardware (SURVEY.md §4 lesson —
+the reference runs its collective tests on CPU/Gloo the same way)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+# The CI image may register an out-of-tree TPU-tunnel PJRT plugin ("axon") at
+# interpreter start; jax's backends() initializes every registered factory, so
+# a wedged tunnel would hang CPU-only tests. Tests are CPU-mesh only: drop the
+# factory before first device use.
+try:
+    import jax  # noqa: E402
+    import jax._src.xla_bridge as _xb  # noqa: E402
+    jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+
+@pytest.fixture(autouse=True)
+def _reseed():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    yield

@@ -1,0 +1,166 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = paddle.exp(paddle.sin(x))
+    y.backward()
+    np.testing.assert_allclose(x.grad.item(),
+                               np.exp(np.sin(2.0)) * np.cos(2.0), rtol=1e-5)
+
+
+def test_shared_input():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    ((x + x) * x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4, 8])
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    b = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    (x + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [2, 2])
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((2, 2)))
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_blocks():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * x
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_backward_twice_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_non_scalar_needs_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [12.0])
+    assert x.grad is None  # grad() must not touch .grad
+
+
+def test_grad_interior():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    h = x * 3
+    y = (h * h).sum()
+    (gh,) = paddle.grad(y, h)
+    np.testing.assert_allclose(gh.numpy(), [12.0])
+
+
+def test_double_grad():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x ** 3
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g1, x)
+    np.testing.assert_allclose(g2.item(), 12.0)  # d2(x^3)/dx2 = 6x
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_gradient_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_argmax_nondiff_path():
+    x = paddle.to_tensor([[1.0, 5.0]], stop_gradient=False)
+    idx = paddle.argmax(x, axis=-1)
+    assert idx.stop_gradient
+    # mixing: topk values differentiable, indices not
+    vals, indices = paddle.topk(x, 1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[0, 1]])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            (a,) = ctx.saved_tensor()
+            return g * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_pylayer_custom_grad():
+    class StraightThrough(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            return paddle.round(a)
+
+        @staticmethod
+        def backward(ctx, g):
+            return g  # straight-through estimator
+
+    x = paddle.to_tensor([1.4], stop_gradient=False)
+    StraightThrough.apply(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+
+def test_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None

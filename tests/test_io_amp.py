@@ -1,0 +1,120 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import (DataLoader, Dataset, TensorDataset, BatchSampler,
+                           RandomSampler, DistributedBatchSampler)
+
+
+class _SquareDs(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_basic():
+    dl = DataLoader(_SquareDs(), batch_size=4, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == [4, 1]
+    np.testing.assert_allclose(y.numpy().squeeze(), x.numpy().squeeze() ** 2)
+
+
+def test_dataloader_shuffle_and_workers():
+    dl = DataLoader(_SquareDs(), batch_size=5, shuffle=True, num_workers=2)
+    xs = np.concatenate([x.numpy().squeeze(1) for x, _ in dl])
+    assert sorted(xs.tolist()) == list(range(20))
+
+
+def test_tensor_dataset():
+    a = paddle.arange(10, dtype="float32")
+    b = paddle.arange(10, dtype="float32") * 2
+    ds = TensorDataset([a.reshape([10, 1]), b.reshape([10, 1])])
+    x, y = ds[3]
+    assert float(y) == 6.0
+
+
+def test_distributed_batch_sampler():
+    ds = _SquareDs(20)
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=5, num_replicas=4, rank=rank)
+        for batch in s:
+            seen.extend(batch)
+    assert sorted(seen) == list(range(20))
+
+
+def test_amp_auto_cast_o1():
+    lin = nn.Linear(8, 8)
+    x = paddle.randn([2, 8])
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = lin(x)
+    assert out.dtype == paddle.bfloat16
+    # black-listed op stays fp32
+    with paddle.amp.auto_cast(level="O1"):
+        s = paddle.nn.functional.softmax(x)
+    assert s.dtype == paddle.float32
+
+
+def test_amp_grads_flow():
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    with paddle.amp.auto_cast():
+        loss = lin(x).cast("float32").square().mean()
+    loss.backward()
+    assert lin.weight.grad is not None
+    assert lin.weight.grad.dtype == paddle.float32  # cast-back in vjp
+
+
+def test_amp_decorate_o2():
+    lin = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=lin.parameters())
+    model, opt = paddle.amp.decorate(lin, opt, level="O2", dtype="bfloat16")
+    assert model.weight.dtype == paddle.bfloat16
+    assert opt._multi_precision
+
+
+def test_grad_scaler_noop_path():
+    lin = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(enable=False)
+    loss = scaler.scale(lin(paddle.randn([2, 4])).mean())
+    loss.backward()
+    scaler.step(opt)
+    scaler.update()
+
+
+def test_grad_scaler_dynamic():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                   decr_every_n_nan_or_inf=1)
+    p = paddle.framework.create_parameter([2], dtype="float32")
+    opt = paddle.optimizer.SGD(0.0, parameters=[p])
+    p.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+    scaler.step(opt)  # must skip
+    scaler.update()
+    assert scaler.get_init_loss_scaling() == 2.0
+
+
+def test_metric_accuracy():
+    m = paddle.metric.Accuracy()
+    pred = paddle.to_tensor([[0.9, 0.1], [0.2, 0.8]])
+    label = paddle.to_tensor([[0], [0]])
+    c = m.compute(pred, label)
+    m.update(c)
+    assert abs(m.accumulate() - 0.5) < 1e-6
+
+
+def test_metric_auc():
+    auc = paddle.metric.Auc()
+    preds = paddle.to_tensor(np.stack([1 - np.array([0.9, 0.8, 0.2, 0.1]),
+                                       np.array([0.9, 0.8, 0.2, 0.1])], 1))
+    labels = paddle.to_tensor(np.array([[1], [1], [0], [0]]))
+    auc.update(preds, labels)
+    assert auc.accumulate() == 1.0

@@ -1,0 +1,117 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _make_step():
+    model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+    lossfn = nn.CrossEntropyLoss()
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        loss = lossfn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return model, opt, train_step
+
+
+def test_to_static_trains():
+    model, opt, step = _make_step()
+    x = paddle.randn([16, 8])
+    y = paddle.to_tensor(np.random.randint(0, 4, (16,)))
+    losses = [float(step(x, y)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert len(step._cache) == 1  # single compilation
+
+
+def test_to_static_matches_eager():
+    paddle.seed(7)
+    m1 = nn.Linear(4, 4)
+    m2 = nn.Linear(4, 4)
+    m2.set_state_dict(m1.state_dict())
+    o1 = paddle.optimizer.SGD(0.1, parameters=m1.parameters())
+    o2 = paddle.optimizer.SGD(0.1, parameters=m2.parameters())
+
+    def step_eager(x):
+        loss = m1(x).square().mean()
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+        return loss
+
+    @paddle.jit.to_static
+    def step_static(x):
+        loss = m2(x).square().mean()
+        loss.backward()
+        o2.step()
+        o2.clear_grad()
+        return loss
+
+    x = paddle.randn([8, 4])
+    for i in range(4):
+        l1, l2 = step_eager(x), step_static(x)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(), rtol=1e-5)
+
+
+def test_to_static_retraces_on_shape_change():
+    lin = nn.Linear(4, 2)
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return lin(x)
+
+    fwd(paddle.randn([2, 4]))  # discovery (eager)
+    fwd(paddle.randn([2, 4]))  # compile 1
+    fwd(paddle.randn([3, 4]))  # new shape -> compile 2
+    assert len(fwd._cache) == 2
+
+
+def test_to_static_scheduler_no_recompile():
+    lin = nn.Linear(4, 2)
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    opt = paddle.optimizer.SGD(sched, parameters=lin.parameters())
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = paddle.randn([2, 4])
+    for _ in range(4):
+        step(x)
+        sched.step()
+    assert len(step._cache) == 1  # lr change is data, not a recompile
+
+
+def test_to_static_rng_advances():
+    drop = nn.Dropout(0.5)
+
+    @paddle.jit.to_static
+    def f(x):
+        return drop(x)
+
+    x = paddle.ones([100])
+    f(x)  # discovery
+    a = f(x).numpy()
+    b = f(x).numpy()
+    assert not np.allclose(a, b)  # rng key is lifted state, advances per call
+
+
+def test_jit_save(tmp_path):
+    from paddle_tpu.jit.save_load import InputSpec
+    lin = nn.Linear(4, 2)
+    path = str(tmp_path / "model")
+    paddle.jit.save(lin, path, input_spec=[InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    assert loaded.program() is not None
+    assert "stablehlo" in loaded.program() or "module" in loaded.program()

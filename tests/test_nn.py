@@ -1,0 +1,246 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    lin = nn.Linear(4, 3)
+    assert lin.weight.shape == [4, 3]
+    assert lin.bias.shape == [3]
+    x = paddle.randn([2, 4])
+    out = lin(x)
+    np.testing.assert_allclose(
+        out.numpy(), x.numpy() @ lin.weight.numpy() + lin.bias.numpy(), rtol=1e-5)
+
+
+def test_layer_registration():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 4)
+            self.fc2 = nn.Linear(4, 2)
+            self.register_buffer("running", paddle.zeros([4]))
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    m = M()
+    assert len(m.parameters()) == 4
+    names = dict(m.named_parameters())
+    assert "fc1.weight" in names and "fc2.bias" in names
+    sd = m.state_dict()
+    assert "running" in sd
+    assert len(list(m.sublayers())) == 2
+
+
+def test_state_dict_roundtrip(tmp_path):
+    m1 = nn.Linear(3, 3)
+    m2 = nn.Linear(3, 3)
+    path = str(tmp_path / "lin.pdparams")
+    paddle.save(m1.state_dict(), path)
+    m2.set_state_dict(paddle.load(path))
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy())
+
+
+def test_train_eval_mode():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    m.eval()
+    assert not m[1].training
+    x = paddle.ones([10, 4])
+    out1, out2 = m(x), m(x)
+    np.testing.assert_allclose(out1.numpy(), out2.numpy())
+    m.train()
+    assert m[1].training
+
+
+def test_dropout_scaling():
+    paddle.seed(0)
+    x = paddle.ones([1000])
+    y = F.dropout(x, 0.5, training=True)
+    kept = y.numpy()[y.numpy() > 0]
+    np.testing.assert_allclose(kept, 2.0)  # upscale_in_train
+    assert 300 < (y.numpy() > 0).sum() < 700
+
+
+def test_layer_norm():
+    x = np.random.randn(2, 5, 8).astype(np.float32)
+    ln = nn.LayerNorm(8)
+    out = ln(paddle.to_tensor(x)).numpy()
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_rms_norm():
+    x = np.random.randn(2, 8).astype(np.float32)
+    rn = nn.RMSNorm(8)
+    out = rn(paddle.to_tensor(x)).numpy()
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batch_norm_running_stats():
+    bn = nn.BatchNorm1D(4, momentum=0.5, data_format="NCL")
+    x = paddle.to_tensor(np.random.randn(8, 4, 6).astype(np.float32) * 3 + 1)
+    bn.train()
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y1 = bn(x)
+    y2 = bn(x)
+    np.testing.assert_allclose(y1.numpy(), y2.numpy())
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor([[1, 0, 3]])
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_conv2d():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    out = conv(x)
+    assert out.shape == [2, 8, 16, 16]
+    out = nn.Conv2D(3, 8, 3, stride=2)(x)
+    assert out.shape == [2, 8, 7, 7]
+
+
+def test_conv2d_matches_numpy():
+    x = np.random.randn(1, 1, 5, 5).astype(np.float32)
+    w = np.random.randn(1, 1, 3, 3).astype(np.float32)
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+    ref = np.zeros((1, 1, 3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            ref[0, 0, i, j] = (x[0, 0, i:i + 3, j:j + 3] * w[0, 0]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pools():
+    x = paddle.randn([2, 3, 8, 8])
+    assert F.max_pool2d(x, 2).shape == [2, 3, 4, 4]
+    assert F.avg_pool2d(x, 2).shape == [2, 3, 4, 4]
+    assert F.adaptive_avg_pool2d(x, 1).shape == [2, 3, 1, 1]
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool2d(x, 1).numpy()[..., 0, 0],
+        x.numpy().mean((-1, -2)), rtol=1e-5)
+
+
+def test_cross_entropy():
+    logits = np.random.randn(4, 5).astype(np.float32)
+    labels = np.array([0, 1, 2, 3])
+    loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(loss.item(), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor([0, -100, 2, -100])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    l0 = F.cross_entropy(logits[paddle.to_tensor([0, 2])],
+                         paddle.to_tensor([0, 2]))
+    np.testing.assert_allclose(loss.item(), l0.item(), rtol=1e-5)
+
+
+def test_cross_entropy_soft_label():
+    logits = paddle.randn([4, 5])
+    soft = paddle.nn.functional.softmax(paddle.randn([4, 5]))
+    loss = F.cross_entropy(logits, soft, soft_label=True)
+    assert loss.ndim == 0
+
+
+def test_losses():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([1.5, 1.5])
+    np.testing.assert_allclose(F.mse_loss(x, y).item(), 0.25, rtol=1e-6)
+    np.testing.assert_allclose(F.l1_loss(x, y).item(), 0.5, rtol=1e-6)
+    z = paddle.to_tensor([0.7, 0.3])
+    t = paddle.to_tensor([1.0, 0.0])
+    ref = -(np.log(0.7) + np.log(0.7)) / 2
+    np.testing.assert_allclose(F.binary_cross_entropy(z, t).item(), ref, rtol=1e-5)
+
+
+def test_sdpa_reference():
+    b, s, h, d = 2, 8, 2, 4
+    q = paddle.randn([b, s, h, d])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [b, s, h, d]
+    # causal: first position attends only to itself -> output == v[0]
+    np.testing.assert_allclose(out.numpy()[:, 0], q.numpy()[:, 0], rtol=1e-5)
+
+
+def test_mha():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    out = mha(x)
+    assert out.shape == [2, 6, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    loss = out.mean()
+    loss.backward()
+    assert enc.layers[0].linear1.weight.grad is not None
+    assert enc.layers[1].linear1.weight.grad is not None
+
+
+def test_sequential_containers():
+    s = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(s) == 3
+    out = s(paddle.randn([2, 4]))
+    assert out.shape == [2, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+
+
+def test_clip_grad_by_global_norm():
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([8, 4])
+    (lin(x) * 100).sum().backward()
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    pg = clip([(p, p.grad) for p in lin.parameters()])
+    total = np.sqrt(sum((g.numpy().astype(np.float64) ** 2).sum() for _, g in pg))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-3)
+
+
+def test_weight_initializers():
+    import paddle_tpu.nn.initializer as I
+    w = I.XavierUniform()((100, 100), paddle.float32)
+    limit = np.sqrt(6.0 / 200)
+    assert abs(np.asarray(w)).max() <= limit + 1e-6
+    c = I.Constant(3.0)((4,), paddle.float32)
+    np.testing.assert_allclose(np.asarray(c), 3.0)
+    o = I.Orthogonal()((16, 16), paddle.float32)
+    np.testing.assert_allclose(np.asarray(o) @ np.asarray(o).T, np.eye(16),
+                               atol=1e-4)
+
+
+def test_forward_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h1 = lin.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+    h2 = lin.register_forward_post_hook(lambda l, inp, out: calls.append("post"))
+    lin(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    lin(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]

@@ -1,0 +1,239 @@
+"""Numpy-reference op tests, following the reference's OpTest discipline
+(test/legacy_test/op_test.py): forward vs numpy + analytic-vs-numeric grads."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central finite difference of scalar fn wrt x (numpy array)."""
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f1 = fn(x.copy().reshape(x.shape))
+        flat[i] = orig - eps
+        f2 = fn(x.copy().reshape(x.shape))
+        flat[i] = orig
+        gf[i] = (f1 - f2) / (2 * eps)
+    return g
+
+
+def check_grad(op, x_np, rtol=1e-2, atol=1e-3):
+    x = paddle.to_tensor(x_np.astype(np.float32), stop_gradient=False)
+    y = op(x)
+    y.sum().backward()
+    analytic = x.grad.numpy().astype(np.float64)
+
+    def scalar_fn(a):
+        t = paddle.to_tensor(a.astype(np.float32))
+        return float(op(t).sum().numpy())
+    numeric = numeric_grad(scalar_fn, x_np.astype(np.float64).copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+UNARY_CASES = [
+    (paddle.exp, np.exp, (2, 3), (-1, 1)),
+    (paddle.log, np.log, (2, 3), (0.5, 2)),
+    (paddle.sqrt, np.sqrt, (2, 3), (0.5, 2)),
+    (paddle.tanh, np.tanh, (2, 3), (-2, 2)),
+    (paddle.sin, np.sin, (2, 3), (-2, 2)),
+    (paddle.cos, np.cos, (2, 3), (-2, 2)),
+    (paddle.abs, np.abs, (2, 3), (0.5, 2)),
+    (paddle.square, np.square, (2, 3), (-2, 2)),
+    (paddle.floor, np.floor, (2, 3), (-2, 2)),
+    (paddle.sigmoid, lambda a: 1 / (1 + np.exp(-a)), (4,), (-2, 2)),
+]
+
+
+@pytest.mark.parametrize("op,ref,shape,rng", UNARY_CASES,
+                         ids=[c[0].__name__ for c in UNARY_CASES])
+def test_unary_forward(op, ref, shape, rng):
+    x = np.random.uniform(*rng, shape).astype(np.float32)
+    out = op(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref(x), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("op", [paddle.exp, paddle.tanh, paddle.sqrt,
+                                paddle.sigmoid],
+                         ids=["exp", "tanh", "sqrt", "sigmoid"])
+def test_unary_grad(op):
+    x = np.random.uniform(0.5, 2.0, (2, 3))
+    check_grad(op, x)
+
+
+def test_matmul_forward_grad():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    ta = paddle.to_tensor(a, stop_gradient=False)
+    tb = paddle.to_tensor(b, stop_gradient=False)
+    out = paddle.matmul(ta, tb)
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+    out.sum().backward()
+    np.testing.assert_allclose(ta.grad.numpy(),
+                               np.ones((3, 5)) @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(tb.grad.numpy(),
+                               a.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_matmul_transpose_flags():
+    a = np.random.randn(4, 3).astype(np.float32)
+    b = np.random.randn(5, 4).astype(np.float32)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                        transpose_x=True, transpose_y=True)
+    np.testing.assert_allclose(out.numpy(), a.T @ b.T, rtol=1e-5)
+
+
+def test_reductions():
+    x = np.random.randn(3, 4, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(), x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t).numpy(), x.mean(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(t, axis=[0, 2]).numpy(),
+                               x.max((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(paddle.prod(t, axis=0).numpy(), x.prod(0), rtol=1e-4)
+    np.testing.assert_allclose(paddle.std(t, axis=1).numpy(), x.std(1, ddof=1),
+                               rtol=1e-4)
+    np.testing.assert_allclose(paddle.logsumexp(t, axis=-1).numpy(),
+                               np.log(np.exp(x).sum(-1)), rtol=1e-5)
+    assert paddle.argmax(t).item() == x.argmax()
+
+
+def test_mean_grad():
+    x = np.random.randn(4, 4)
+    check_grad(lambda t: paddle.mean(t), x)
+
+
+def test_manipulation():
+    x = np.arange(24.0).reshape(2, 3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t, 1).shape == [2, 12]
+    assert paddle.unsqueeze(t, 0).shape == [1, 2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+    c = paddle.concat([t, t], axis=1)
+    assert c.shape == [2, 6, 4]
+    s = paddle.split(t, 3, axis=1)
+    assert len(s) == 3 and s[0].shape == [2, 1, 4]
+    st = paddle.stack([t, t])
+    assert st.shape == [2, 2, 3, 4]
+    assert paddle.tile(t, [1, 2, 1]).shape == [2, 6, 4]
+    np.testing.assert_allclose(paddle.flip(t, [0]).numpy(), x[::-1], rtol=0)
+
+
+def test_split_uneven():
+    t = paddle.to_tensor(np.arange(10.0))
+    parts = paddle.split(t, [3, -1, 2], axis=0)
+    assert [p.shape[0] for p in parts] == [3, 5, 2]
+
+
+def test_concat_grad():
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = paddle.to_tensor([3.0], stop_gradient=False)
+    (paddle.concat([a, b]) * paddle.to_tensor([1.0, 2.0, 3.0])).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [1, 2])
+    np.testing.assert_allclose(b.grad.numpy(), [3])
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12.0).reshape(4, 3).astype(np.float32))
+    idx = paddle.to_tensor([0, 2])
+    g = paddle.gather(x, idx, axis=0)
+    np.testing.assert_allclose(g.numpy(), [[0, 1, 2], [6, 7, 8]])
+    upd = paddle.to_tensor(np.ones((2, 3), np.float32))
+    s = paddle.scatter(x, idx, upd)
+    np.testing.assert_allclose(s.numpy()[0], [1, 1, 1])
+    np.testing.assert_allclose(s.numpy()[1], [3, 4, 5])
+
+
+def test_where_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    np.testing.assert_allclose(paddle.sort(x).numpy(), [1, 2, 3])
+    np.testing.assert_allclose(paddle.argsort(x).numpy(), [1, 2, 0])
+    v, i = paddle.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [3, 2])
+    np.testing.assert_allclose(i.numpy(), [0, 2])
+    cond = paddle.to_tensor([True, False, True])
+    out = paddle.where(cond, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(out.numpy(), [3, 0, 2])
+
+
+def test_einsum():
+    a = np.random.randn(2, 3).astype(np.float32)
+    b = np.random.randn(3, 4).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_cumsum_cumprod():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.5
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.cumsum(t, axis=1).numpy(), x.cumsum(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.cumprod(t, dim=0).numpy(), x.cumprod(0),
+                               rtol=1e-5)
+
+
+def test_clip_grad():
+    x = np.array([[-2.0, 0.5, 3.0]])
+    check_grad(lambda t: paddle.clip(t, -1.0, 1.0), x)
+
+
+def test_comparison_and_logical():
+    a = paddle.to_tensor([1, 2, 3])
+    b = paddle.to_tensor([3, 2, 1])
+    np.testing.assert_array_equal(paddle.equal(a, b).numpy(),
+                                  [False, True, False])
+    np.testing.assert_array_equal(paddle.logical_and(a > 1, b > 1).numpy(),
+                                  [False, True, False])
+    assert paddle.equal_all(a, a).item()
+    assert paddle.allclose(paddle.to_tensor([1.0]),
+                           paddle.to_tensor([1.0 + 1e-9])).item()
+
+
+def test_linalg():
+    a = np.random.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(spd)
+    l = paddle.linalg.cholesky(t)
+    np.testing.assert_allclose((l @ l.T).numpy(), spd, rtol=1e-4, atol=1e-4)
+    inv = paddle.linalg.inv(t)
+    np.testing.assert_allclose((t @ inv).numpy(), np.eye(4), atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.det(t).item(),
+                               np.linalg.det(spd), rtol=1e-3)
+    u, s, vt = paddle.linalg.svd(paddle.to_tensor(a))
+    np.testing.assert_allclose(np.sort(s.numpy())[::-1],
+                               np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4])
+    paddle.seed(42)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = paddle.randn([4])
+    assert not np.allclose(b.numpy(), c.numpy())
+
+
+def test_rand_ranges():
+    u = paddle.uniform([1000], min=2.0, max=3.0)
+    assert float(u.min()) >= 2.0 and float(u.max()) <= 3.0
+    r = paddle.randint(0, 5, [1000])
+    assert int(r.min()) >= 0 and int(r.max()) < 5
+    p = paddle.randperm(10)
+    assert sorted(p.tolist()) == list(range(10))
+
+
+def test_one_hot_and_pad():
+    oh = paddle.nn.functional.one_hot(paddle.to_tensor([0, 2]), 3)
+    np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+    x = paddle.ones([1, 1, 2, 2])
+    p = paddle.nn.functional.pad(x, [1, 1, 1, 1])
+    assert p.shape == [1, 1, 4, 4]
+    assert p.numpy().sum() == 4

@@ -1,0 +1,152 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Lamb
+from paddle_tpu.optimizer import lr as lr_sched
+
+
+def _quadratic_steps(opt_cls, n=60, **kw):
+    """Minimize ||w - 3||^2; return final w."""
+    w = paddle.framework.create_parameter([4], dtype="float32")
+    w.set_value(np.zeros(4, np.float32))
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(n):
+        loss = ((w - 3.0) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy()
+
+
+def test_sgd_converges():
+    w = _quadratic_steps(SGD, learning_rate=0.1)
+    np.testing.assert_allclose(w, 3.0, atol=1e-3)
+
+
+def test_momentum_converges():
+    w = _quadratic_steps(Momentum, n=150, learning_rate=0.05, momentum=0.9)
+    np.testing.assert_allclose(w, 3.0, atol=0.05)
+
+
+def test_adam_converges():
+    w = _quadratic_steps(Adam, n=200, learning_rate=0.3)
+    np.testing.assert_allclose(w, 3.0, atol=0.05)
+
+
+def test_adamw_matches_reference_formula():
+    # one step against a hand-computed AdamW update
+    w0 = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.5, -1.0], np.float32)
+    p = paddle.framework.create_parameter([2], dtype="float32")
+    p.set_value(w0)
+    opt = AdamW(learning_rate=0.1, beta1=0.9, beta2=0.99, epsilon=1e-8,
+                parameters=[p], weight_decay=0.01)
+    p.grad = paddle.to_tensor(g)
+    opt.step()
+    m = 0.1 * g
+    v = 0.01 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.99)
+    ref = w0 * (1 - 0.1 * 0.01) - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), ref, rtol=1e-5)
+
+
+def test_adamw_no_decay_fn():
+    p = paddle.framework.create_parameter([2], dtype="float32", name="bias_p")
+    p.set_value(np.array([1.0, 1.0], np.float32))
+    opt = AdamW(learning_rate=0.0, parameters=[p], weight_decay=0.5,
+                apply_decay_param_fun=lambda n: "bias" not in n)
+    p.grad = paddle.to_tensor(np.zeros(2, np.float32))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1.0, 1.0])  # lr=0 & excluded
+
+
+def test_grad_clip_in_optimizer():
+    p = paddle.framework.create_parameter([2], dtype="float32")
+    p.set_value(np.zeros(2, np.float32))
+    opt = SGD(learning_rate=1.0, parameters=[p],
+              grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    p.grad = paddle.to_tensor(np.array([30.0, 40.0], np.float32))
+    opt.step()
+    np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-4)
+
+
+def test_multi_precision_master_weights():
+    p = paddle.framework.create_parameter([4], dtype="float32")
+    p._data = p._data.astype("bfloat16")
+    opt = AdamW(learning_rate=1e-4, parameters=[p], multi_precision=True)
+    p.grad = paddle.to_tensor(np.ones(4), dtype="bfloat16")
+    opt.step()
+    assert id(p) in opt._master_weights
+    assert str(opt._master_weights[id(p)]._data.dtype) == "float32"
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = paddle.framework.create_parameter([3], dtype="float32", name="w")
+    opt = Adam(learning_rate=0.1, parameters=[p])
+    p.grad = paddle.to_tensor(np.ones(3, np.float32))
+    opt.step()
+    sd = opt.state_dict()
+    p2 = paddle.framework.create_parameter([3], dtype="float32", name="w")
+    opt2 = Adam(learning_rate=0.1, parameters=[p2])
+    opt2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        opt2._accumulators["moment1"][id(p2)].numpy(),
+        opt._accumulators["moment1"][id(p)].numpy())
+
+
+def test_lr_scheduler_basics():
+    sched = lr_sched.StepDecay(learning_rate=1.0, step_size=2, gamma=0.1)
+    p = paddle.framework.create_parameter([1], dtype="float32")
+    opt = SGD(learning_rate=sched, parameters=[p])
+    assert abs(opt.get_lr() - 1.0) < 1e-6
+    sched.step()
+    sched.step()
+    assert abs(opt.get_lr() - 0.1) < 1e-6
+
+
+def test_warmup_schedule():
+    sched = lr_sched.LinearWarmup(learning_rate=1.0, warmup_steps=10,
+                                  start_lr=0.0, end_lr=1.0)
+    vals = []
+    for _ in range(12):
+        vals.append(sched.last_lr)
+        sched.step()
+    assert vals[0] == 0.0
+    assert abs(vals[5] - 0.5) < 1e-6
+    assert vals[11] == 1.0
+
+
+def test_cosine_schedule():
+    sched = lr_sched.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    v0 = sched.last_lr
+    for _ in range(10):
+        sched.step()
+    assert v0 == 1.0 and abs(sched.last_lr) < 1e-6
+
+
+def test_noam():
+    s = lr_sched.NoamDecay(d_model=512, warmup_steps=100, learning_rate=1.0)
+    lrs = []
+    for _ in range(200):
+        s.step()
+        lrs.append(s.last_lr)
+    assert np.argmax(lrs) in range(95, 105)
+
+
+def test_lbfgs_quadratic():
+    from paddle_tpu.optimizer import LBFGS
+    w = paddle.framework.create_parameter([2], dtype="float32")
+    w.set_value(np.zeros(2, np.float32))
+    opt = LBFGS(learning_rate=0.5, max_iter=20, parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = ((w - 2.0) ** 2).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    np.testing.assert_allclose(w.numpy(), 2.0, atol=1e-2)

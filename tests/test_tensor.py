@@ -1,0 +1,103 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    assert t.stop_gradient
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_coercion():
+    assert paddle.to_tensor([1, 2]).dtype == paddle.int64 or \
+        paddle.to_tensor([1, 2]).dtype == paddle.int32
+    t = paddle.to_tensor([1, 2], dtype="float32")
+    assert t.dtype == paddle.float32
+    t16 = t.astype(paddle.bfloat16)
+    assert t16.dtype == paddle.bfloat16
+    assert t16.astype("float32").dtype == paddle.float32
+
+
+def test_item_and_scalars():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == 3.5
+    assert float(t) == 3.5
+    assert int(paddle.to_tensor(7)) == 7
+    assert bool(paddle.to_tensor(True))
+
+
+def test_operators():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 - a).numpy(), [1, 0])
+    np.testing.assert_allclose((1.0 / a).numpy(), [1, 0.5])
+    np.testing.assert_allclose((a @ b).numpy(), 11)
+    assert (a == a).all().item()
+    assert (a < b).any().item()
+
+
+def test_getitem_setitem():
+    t = paddle.to_tensor(np.arange(12.0).reshape(3, 4))
+    np.testing.assert_allclose(t[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(t[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(t[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    t[0, 0] = 100.0
+    assert t[0, 0].item() == 100.0
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(t[idx].numpy()[1], [8, 9, 10, 11])
+
+
+def test_inplace_helpers():
+    t = paddle.zeros([2, 2])
+    t.fill_(5.0)
+    assert t.numpy().sum() == 20
+    t.zero_()
+    assert t.numpy().sum() == 0
+    t2 = paddle.ones([2, 2])
+    t.copy_(t2)
+    assert t.numpy().sum() == 4
+
+
+def test_detach_and_clone():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    c = t.clone()
+    loss = (c * 2).sum()
+    loss.backward()
+    np.testing.assert_allclose(t.grad.numpy(), [2.0])
+
+
+def test_parameter():
+    p = paddle.framework.create_parameter([3, 3], dtype="float32")
+    assert not p.stop_gradient
+    assert p.persistable
+
+
+def test_save_load(tmp_path):
+    sd = {"w": paddle.to_tensor([[1.0, 2.0]]),
+          "nested": {"b": paddle.to_tensor([3], dtype="int64")},
+          "scalar": 5}
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(sd, path)
+    loaded = paddle.load(path)
+    np.testing.assert_allclose(loaded["w"].numpy(), [[1, 2]])
+    assert loaded["nested"]["b"].dtype == paddle.int64
+    assert loaded["scalar"] == 5
+
+
+def test_save_load_bfloat16(tmp_path):
+    t = paddle.to_tensor([1.5, 2.5]).astype(paddle.bfloat16)
+    path = str(tmp_path / "bf16.pdparams")
+    paddle.save({"t": t}, path)
+    loaded = paddle.load(path)
+    assert loaded["t"].dtype == paddle.bfloat16
