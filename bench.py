@@ -1,0 +1,104 @@
+"""Benchmark harness: GPT-2 124M train-step throughput + MFU on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline discipline per BASELINE.md: primary metric is tokens/sec/chip with
+MFU derived from analytic FLOPs (6N + attention correction); the north-star
+target is 40% MFU, so vs_baseline = MFU / 0.40.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPT, GPTConfig
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, max_position_embeddings=1024,
+                        hidden_size=768, num_layers=12, num_heads=12)
+        batch, seq, steps, warmup = 8, 1024, 20, 3
+    else:  # CPU smoke so the harness itself stays testable
+        cfg = GPTConfig(vocab_size=1024, max_position_embeddings=256,
+                        hidden_size=256, num_layers=4, num_heads=8)
+        batch, seq, steps, warmup = 4, 256, 5, 2
+
+    paddle.seed(0)
+    model = GPT(cfg)
+    opt = paddle.optimizer.AdamW(
+        3e-4, parameters=model.parameters(), weight_decay=0.1,
+        multi_precision=True)
+    if on_tpu:
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(warmup):
+        loss = train_step(x, y)
+    float(loss)  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(x, y)
+    final = float(loss)  # device sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = batch * seq * steps / dt
+    flops_per_token = model.flops_per_token(seq) * 3  # fwd + bwd(2x)
+    achieved = tokens_per_s * flops_per_token
+
+    peak = _peak_flops(dev)
+    mfu = achieved / peak if peak else 0.0
+    result = {
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip" if on_tpu
+        else "gpt2_cpu_smoke_tokens_per_sec",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4) if peak else 0.0,
+        "extra": {
+            "mfu": round(mfu, 4), "loss": round(final, 3), "batch": batch,
+            "seq": seq, "steps": steps, "device": str(dev.device_kind
+                                                      if hasattr(dev, "device_kind") else dev.platform),
+            "dtype": "bf16" if on_tpu else "f32",
+        },
+    }
+    print(json.dumps(result))
+
+
+def _peak_flops(dev) -> float:
+    """bf16 peak FLOPs from the device kind (spec-sheet numbers)."""
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    table = {
+        "v6e": 918e12, "v6": 918e12, "v5p": 459e12, "v5e": 197e12,
+        "v5litepod": 197e12, "v4": 275e12, "v3": 123e12, "v2": 45e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for k, v in table.items():
+        if k in gen:
+            return v
+    return table["v5e"] if dev.platform in ("tpu", "axon") else 0.0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

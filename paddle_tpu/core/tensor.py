@@ -35,8 +35,11 @@ _TRACKER = None
 
 
 class Tensor:
+    # named slots for the hot fields; __dict__ kept for the long tail of
+    # annotation attributes (placements, is_sequence_parallel, need_clip, ...)
     __slots__ = ("_d", "stop_gradient", "_grad", "_node", "_out_index",
-                 "_hooks", "name", "persistable", "_sharding_spec", "__weakref__")
+                 "_hooks", "name", "persistable", "_sharding_spec",
+                 "__weakref__", "__dict__")
 
     _iid = 0
 

@@ -1,0 +1,39 @@
+"""`paddle.distributed` equivalent: the TPU-native hybrid-parallel stack.
+
+Reference: python/paddle/distributed/ (123k LoC over NCCL/Gloo ProcessGroups).
+Here: mesh axes + GSPMD shardings + shard_map collectives over ICI/DCN; see
+SURVEY.md §5.8 for the design mapping.
+"""
+
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized, ParallelEnv,
+)
+from .communication import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, is_available, destroy_process_group,
+    all_reduce, all_gather, all_gather_object, all_to_all, all_to_all_single,
+    broadcast, broadcast_object_list, reduce, reduce_scatter, scatter,
+    scatter_object_list, gather, send, recv, isend, irecv, barrier, wait,
+    stream,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, get_hybrid_communicate_group,
+    get_mesh, ParallelMode,
+)
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, shard_tensor, reshard, shard_layer, dtensor_from_fn,
+    unshard_dtensor, shard_optimizer, Shard, Replicate, Partial,
+)
+from .sharding_utils import mark_sharding, sharded_call  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+from .meta_parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .fleet.recompute import recompute  # noqa: F401
+
+
+from . import sharding  # noqa: F401
+
+
+def get_mesh_or_none():
+    from .topology import get_mesh as _g
+    return _g()

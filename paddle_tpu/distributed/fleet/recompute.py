@@ -1,0 +1,104 @@
+"""Activation recompute (checkpointing).
+
+Reference: fleet/recompute/recompute.py — `RecomputeFunction` (:108) replays
+forward in backward with RNG-state restore (:96); `recompute_sequential`,
+offload variants in recompute_hybrid.py. TPU-native: `jax.checkpoint` (remat)
+is the substrate — the XLA scheduler replays the forward subgraph during the
+backward pass; RNG replay is free because keys are explicit values.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ...core.tensor import Tensor
+from ...autograd.function import apply
+from ...autograd.grad_mode import no_grad
+
+__all__ = ["recompute", "recompute_sequential"]
+
+_POLICIES = {
+    "full": None,  # save nothing, recompute all
+    "dots_saveable": "dots_saveable",
+    "nothing_saveable": None,
+}
+
+
+def _policy(name):
+    if name in (None, "full", "nothing_saveable"):
+        return None
+    import jax.ad_checkpoint as adc
+    return getattr(adc.checkpoint_policies, name, None)
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              policy=None, **kwargs):
+    """`paddle.distributed.fleet.utils.recompute` equivalent: run `function`
+    without saving intermediate activations; backward rematerializes."""
+    from ...nn.layer import Layer
+    tensors = [a for a in args if isinstance(a, Tensor)]
+    statics = {i: a for i, a in enumerate(args) if not isinstance(a, Tensor)}
+
+    if isinstance(function, Layer):
+        layer = function
+        params = [p for _, p in layer.named_parameters()]
+
+        def raw(param_arrays, *xs_arrays):
+            saved = [(p._d, p._node) for p in params]
+            for p, a in zip(params, param_arrays):
+                p._d = a
+                p._node = None
+            try:
+                with no_grad():
+                    rebuilt = []
+                    it = iter(xs_arrays)
+                    for i in range(len(args)):
+                        rebuilt.append(statics[i] if i in statics
+                                       else Tensor(next(it)))
+                    out = layer(*rebuilt, **kwargs)
+                return out._d if isinstance(out, Tensor) else \
+                    tuple(o._d for o in out)
+            finally:
+                for p, (d, n) in zip(params, saved):
+                    p._d = d
+                    p._node = n
+
+        ck = jax.checkpoint(raw, policy=_policy(policy))
+        return apply(lambda *arrs: ck(list(arrs[:len(params)]),
+                                      *arrs[len(params):]),
+                     *params, *tensors, name="recompute")
+
+    # plain callable over Tensors
+    def raw_fn(*xs_arrays):
+        with no_grad():
+            rebuilt = []
+            it = iter(xs_arrays)
+            for i in range(len(args)):
+                rebuilt.append(statics[i] if i in statics else Tensor(next(it)))
+            out = function(*rebuilt, **kwargs)
+        return out._d if isinstance(out, Tensor) else \
+            tuple(o._d for o in out)
+
+    ck = jax.checkpoint(raw_fn, policy=_policy(policy))
+    return apply(lambda *arrs: ck(*arrs), *tensors, name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Reference: recompute_sequential — chunked recompute over a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    per = max(len(layers) // segments, 1)
+    x = args[0]
+    i = 0
+    while i < len(layers):
+        chunk = layers[i: i + per]
+
+        def run_chunk(t, chunk=chunk):
+            for l in chunk:
+                t = l(t)
+            return t
+        x = recompute(run_chunk, x, **kwargs)
+        i += per
+    return x

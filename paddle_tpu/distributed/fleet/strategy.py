@@ -1,0 +1,138 @@
+"""DistributedStrategy (reference: python/paddle/distributed/fleet/base/
+distributed_strategy.py:175 over distributed_strategy.proto:353).
+
+One strongly-typed, serializable config object for every fleet feature. The
+reference backs it with protobuf; here a dataclass tree with dict round-trip
+(versioned) — same role, no proto dependency.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, asdict
+
+__all__ = ["DistributedStrategy"]
+
+STRATEGY_VERSION = 1
+
+
+@dataclass
+class HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+    order: list = field(default_factory=lambda: ["dp", "pp", "sharding",
+                                                 "sep", "mp"])
+
+
+@dataclass
+class ShardingConfig:
+    stage: int = 1
+    degree: int = 1
+    offload: bool = False
+    comm_overlap: bool = True
+
+
+@dataclass
+class AmpConfig:
+    enable: bool = False
+    dtype: str = "bfloat16"
+    level: str = "O1"
+    init_loss_scaling: float = 65536.0
+    use_dynamic_loss_scaling: bool = True
+    custom_white_list: list = field(default_factory=list)
+    custom_black_list: list = field(default_factory=list)
+
+
+@dataclass
+class RecomputeConfig:
+    enable: bool = False
+    checkpoints: list = field(default_factory=list)
+    policy: str = "full"  # full | dots_saveable | nothing_saveable
+
+
+@dataclass
+class PipelineConfig:
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"  # 1F1B | FThenB | VPP
+    vpp_degree: int = 1
+    p2p_overlap: bool = True
+
+
+@dataclass
+class TensorParallelConfig:
+    tensor_parallel_degree: int = 1
+    tensor_init_seed: int = -1
+    sequence_parallel: bool = False
+
+
+@dataclass
+class GradientMergeConfig:
+    enable: bool = False
+    k_steps: int = 1
+    avg: bool = True
+
+
+@dataclass
+class MoEConfig:
+    expert_parallel_degree: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    gate: str = "gshard"
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = HybridConfig()
+        self.sharding_configs = ShardingConfig()
+        self.amp_configs = AmpConfig()
+        self.recompute_configs = RecomputeConfig()
+        self.pipeline_configs = PipelineConfig()
+        self.tensor_parallel_configs = TensorParallelConfig()
+        self.gradient_merge_configs = GradientMergeConfig()
+        self.moe_configs = MoEConfig()
+        self.amp = False
+        self.recompute = False
+        self.sharding = False
+        self.gradient_merge = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.sequence_parallel = False
+
+    # dict-style assignment parity: strategy.hybrid_configs = {...}
+    def __setattr__(self, key, value):
+        current = self.__dict__.get(key)
+        if isinstance(value, dict) and current is not None and \
+                hasattr(current, "__dataclass_fields__"):
+            for k, v in value.items():
+                if k in current.__dataclass_fields__:
+                    setattr(current, k, v)
+                else:
+                    raise KeyError(f"unknown {key} field {k!r}")
+            return
+        object.__setattr__(self, key, value)
+
+    def to_dict(self) -> dict:
+        out = {"__version__": STRATEGY_VERSION}
+        for k, v in self.__dict__.items():
+            out[k] = asdict(v) if hasattr(v, "__dataclass_fields__") else v
+        return out
+
+    def save_to_prototxt(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+    def load_from_prototxt(self, path: str):
+        with open(path) as f:
+            data = json.load(f)
+        data.pop("__version__", None)
+        for k, v in data.items():
+            if k in self.__dict__:
+                setattr(self, k, v)
+
+    def __repr__(self):
+        return json.dumps(self.to_dict(), indent=2, default=str)

@@ -1,0 +1,23 @@
+from .meta_parallel_base import (  # noqa: F401
+    MetaParallelBase, DataParallelModel, TensorParallel, ShardingParallel,
+    SegmentParallel, DataParallel,
+)
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .sequence_parallel_utils import (  # noqa: F401
+    ScatterOp, GatherOp, AllGatherOp, ReduceScatterOp,
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+    mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks,
+)
+from .sharding import (  # noqa: F401
+    DygraphShardingOptimizer, GroupShardedOptimizerStage2, GroupShardedStage2,
+    GroupShardedStage3, group_sharded_parallel, save_group_sharded_model,
+)
+from .hybrid_parallel_optimizer import (  # noqa: F401
+    HybridParallelOptimizer, HybridParallelClipGrad, HybridParallelGradScaler,
+)
+from .pp_layers import LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401

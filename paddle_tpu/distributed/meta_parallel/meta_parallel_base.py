@@ -1,0 +1,159 @@
+"""Parallel model wrappers (reference: fleet/meta_parallel/
+{meta_parallel_base,tensor_parallel,sharding_parallel,segment_parallel}.py +
+paddle.DataParallel in distributed/parallel.py:202).
+
+On TPU these wrappers do not broadcast parameters or hook gradients — GSPMD
+replication makes params identical by construction, and data-parallel grad
+all-reduce is inserted by the partitioner when batch-sharded activations meet
+replicated params. The wrappers' real work is annotating input/param/output
+shardings so the partitioner has the right layout to work with.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from jax.sharding import PartitionSpec as P
+
+from ...nn.layer import Layer
+from ...core.tensor import Tensor
+from ..sharding_utils import mark_sharding
+from ..topology import get_mesh
+
+__all__ = ["MetaParallelBase", "DataParallelModel", "TensorParallel",
+           "ShardingParallel", "SegmentParallel", "DataParallel"]
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def _batch_input_spec(self):
+        """Shard the batch dim over dp (and sharding, which also consumes
+        batch for its grad/ZeRO math — reference fuses dp+sharding for the
+        grad allreduce)."""
+        axes = []
+        if self._hcg.get_data_parallel_world_size() > 1:
+            axes.append("dp")
+        if self._hcg.get_sharding_parallel_world_size() > 1:
+            axes.append("sharding")
+        if not axes:
+            return None
+        return tuple(axes) if len(axes) > 1 else axes[0]
+
+    def _shard_inputs(self, inputs):
+        batch_axes = self._batch_input_spec()
+        if batch_axes is None or get_mesh() is None:
+            return inputs
+        out = []
+        for t in inputs:
+            if isinstance(t, Tensor) and t.ndim >= 1:
+                spec = P(batch_axes, *([None] * (t.ndim - 1)))
+                out.append(mark_sharding(t, spec))
+            else:
+                out.append(t)
+        return tuple(out)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = self._shard_inputs(inputs)
+        return self._layers(*inputs, **kwargs)
+
+    # passthrough surface
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)
+
+    load_dict = set_state_dict
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class DataParallelModel(MetaParallelBase):
+    """Pure dp (+optional ZeRO via sharding axis)."""
+
+
+class TensorParallel(MetaParallelBase):
+    """mp active: parallel layers already carry their specs; inputs get
+    batch sharding (reference: meta_parallel/tensor_parallel.py broadcasts
+    inputs in the mp group — replication under GSPMD is automatic)."""
+
+
+class ShardingParallel(MetaParallelBase):
+    pass
+
+
+class SegmentParallel(MetaParallelBase):
+    """sep active: additionally shard the sequence dim (dim 1) over 'sep'
+    (reference: meta_parallel/segment_parallel.py:26)."""
+
+    def _shard_inputs(self, inputs):
+        inputs = super()._shard_inputs(inputs)
+        if get_mesh() is None:
+            return inputs
+        out = []
+        batch_axes = self._batch_input_spec()
+        for t in inputs:
+            if isinstance(t, Tensor) and t.ndim >= 2:
+                spec = P(batch_axes, "sep", *([None] * (t.ndim - 2)))
+                out.append(mark_sharding(t, spec))
+            else:
+                out.append(t)
+        return tuple(out)
+
+
+class DataParallel(Layer):
+    """`paddle.DataParallel` (reference: distributed/parallel.py:202). The
+    comm_buffer/bucketing knobs are accepted for parity; XLA fuses gradient
+    all-reduces itself."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        mesh = get_mesh()
+        if mesh is not None and "dp" in mesh.axis_names:
+            shard = []
+            for t in inputs:
+                if isinstance(t, Tensor) and t.ndim >= 1:
+                    shard.append(mark_sharding(
+                        t, P("dp", *([None] * (t.ndim - 1)))))
+                else:
+                    shard.append(t)
+            inputs = tuple(shard)
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield  # grads sync inside the compiled step; nothing to defer
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def scale_loss(self, loss):
+        return loss  # grads are averaged by pmean semantics in GSPMD
+
+    def apply_collective_grads(self):
+        pass

@@ -1,0 +1,32 @@
+"""Model-wide sharding annotation used by fleet.distributed_model.
+
+The reference broadcasts params within groups at wrap time
+(fleet/model.py:32); with GSPMD the equivalent is assigning every parameter a
+PartitionSpec (tp layers set theirs in __init__; everything else defaults to
+replicated, optionally ZeRO-sharded over the sharding axis).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..sharding_utils import mark_sharding
+from ..topology import get_mesh
+
+__all__ = ["annotate_model_shardings"]
+
+
+def annotate_model_shardings(model, hcg, strategy):
+    if get_mesh() is None:
+        return model
+    stage = strategy.sharding_configs.stage if strategy else 1
+    sharding_degree = hcg.get_sharding_parallel_world_size()
+    from .sharding import shard_spec_for
+    for p in model.parameters():
+        if p._sharding_spec is None:
+            if sharding_degree > 1 and stage >= 3:
+                spec = shard_spec_for(p)
+                mark_sharding(p, spec if spec is not None else P())
+            else:
+                mark_sharding(p, P(*([None] * p.ndim)))
+    return model

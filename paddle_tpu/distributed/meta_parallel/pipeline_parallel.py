@@ -1,0 +1,244 @@
+"""Compiled SPMD pipeline parallelism.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py — `PipelineParallel`
+(1F1B at :416, interleaved :875) drives an imperative micro-batch loop with
+NCCL P2P (`P2pHelper` p2p_communication.py:506, dynamic-shape `SendRecvMeta`
+handshakes at :51).
+
+TPU-native redesign: the schedule is *compiled*, not imperative. The
+homogeneous block run of the PipelineLayer is stacked into [L, ...] params
+sharded over the 'pp' mesh axis; a `shard_map` body rotates micro-batch
+activations around the pp ring with `lax.ppermute` inside a `lax.scan` over
+ticks (M + S - 1 of them). Stage-local blocks execute as a scan over the
+local layer shard. jax autodiff through the scan+ppermute yields the reverse
+(backward) pipeline automatically — no hand-written 1F1B state machine, no
+shape handshakes (shapes are static, as SURVEY.md §7 prescribes). Remat of
+each block (recompute_interval) bounds activation memory like 1F1B does.
+
+Head/tail layers (embedding, final norm/head) run as full-batch GSPMD ops
+outside the ring, so their FLOPs are not multiplied by pp.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...core.tensor import Tensor, Parameter
+from ...autograd.function import apply
+from ...autograd.grad_mode import no_grad
+from ...nn.layer import Layer
+from .meta_parallel_base import MetaParallelBase
+from .pp_layers import PipelineLayer
+from ..sharding_utils import mark_sharding, sharded_call
+from ..topology import get_mesh
+
+__all__ = ["PipelineParallel"]
+
+
+def _functionalize(template: Layer):
+    """(ordered params, fn(param_arrays, x_arr) -> out_arr) for one block."""
+    names_params = list(template.named_parameters())
+    params = [p for _, p in names_params]
+
+    def block_fn(param_arrays, h):
+        saved = [(p._d, p._node) for p in params]
+        for p, a in zip(params, param_arrays):
+            p._d = a
+            p._node = None
+        try:
+            with no_grad():
+                out = template(Tensor(h))
+            return out._d
+        finally:
+            for p, (d, n) in zip(params, saved):
+                p._d = d
+                p._node = n
+
+    return [n for n, _ in names_params], params, block_fn
+
+
+class PipelineParallel(MetaParallelBase):
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel requires a PipelineLayer")
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.accumulate_steps = strategy.pipeline_configs.accumulate_steps \
+            if strategy else 1
+        self._recompute = bool(strategy and strategy.recompute)
+        super().__init__(layers, hcg, strategy)
+
+    def _prepare_for_model(self):
+        pl: PipelineLayer = self._layers
+        s, e = pl._block_range
+        blocks = pl.block_layers
+        if self.num_stages > 1 and len(blocks) % self.num_stages:
+            raise ValueError(
+                f"{len(blocks)} pipelined blocks not divisible by "
+                f"{self.num_stages} stages")
+        self._n_blocks = len(blocks)
+        self._head = [pl.run_function[i] for i in range(0, s)]
+        self._tail = [pl.run_function[i]
+                      for i in range(e, len(pl.run_function))]
+
+        # stack per-position params across blocks -> [L, ...] sharded on 'pp'
+        # (functionalize a detached copy: the live blocks lose their params)
+        import copy
+        template = copy.deepcopy(blocks[0])
+        self._param_names, self._template_params, self._block_fn = \
+            _functionalize(template)
+        self._stacked: list[Parameter] = []
+        for j, name in enumerate(self._param_names):
+            per_layer = []
+            for blk in blocks:
+                p = dict(blk.named_parameters())[name]
+                per_layer.append(p._d)
+            stacked = Parameter(jnp.stack(per_layer, axis=0),
+                                name=f"pipeline_blocks.{name}")
+            base_spec = self._template_params[j]._sharding_spec
+            entries = ["pp"] + (list(base_spec) if base_spec else
+                                [None] * (stacked.ndim - 1))
+            entries = entries + [None] * (stacked.ndim - len(entries))
+            mark_sharding(stacked, P(*entries[: stacked.ndim]))
+            self._stacked.append(stacked)
+
+        # register the stacked versions on the PipelineLayer (so its
+        # parameters()/state_dict() see them) and drop the per-block params
+        for blk in blocks:
+            for k in list(blk._parameters):
+                del blk._parameters[k]
+            for k in list(blk._sub_layers):
+                del blk._sub_layers[k]
+        for j, stacked in enumerate(self._stacked):
+            pl.add_parameter(f"pipeline_{j}", stacked)
+
+        self._pipeline_jfn = self._build_pipeline_fn()
+
+    # -- compiled ring schedule --------------------------------------------
+    def _build_pipeline_fn(self):
+        S = max(self.num_stages, 1)
+        block_fn = self._block_fn
+        if self._recompute:
+            block_fn_inner = block_fn
+            block_fn = jax.checkpoint(
+                lambda pa, h: block_fn_inner(pa, h))
+        n_local = self._n_blocks // S
+
+        def local_stack(stacked_local, h):
+            def one(carry, layer_params):
+                return block_fn(layer_params, carry), None
+            h, _ = jax.lax.scan(one, h, stacked_local)
+            return h
+
+        def body(x_micro, *stacked_local):
+            # x_micro: [M, mb, ...] (replicated w.r.t. pp)
+            # stacked_local: each [n_local, ...] — this stage's layer shard
+            M = x_micro.shape[0]
+            T = M + S - 1
+            idx = jax.lax.axis_index("pp")
+            buf = jnp.zeros_like(x_micro[0])
+            out_buf = jnp.zeros_like(x_micro)
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            def tick(carry, t):
+                buf, out_buf = carry
+                mb = jax.lax.dynamic_index_in_dim(
+                    x_micro, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+                inp = jnp.where(idx == 0, mb, buf)
+                h = local_stack(stacked_local, inp)
+                # last stage writes its result for microbatch t-(S-1)
+                oi = jnp.clip(t - (S - 1), 0, M - 1)
+                valid = (t >= S - 1) & (idx == S - 1)
+                cur = jax.lax.dynamic_index_in_dim(out_buf, oi, 0, False)
+                out_buf = jax.lax.dynamic_update_index_in_dim(
+                    out_buf, jnp.where(valid, h, cur), oi, 0)
+                nxt = jax.lax.ppermute(h, "pp", perm)
+                return (nxt, out_buf), None
+
+            (buf, out_buf), _ = jax.lax.scan(
+                tick, (buf, out_buf), jnp.arange(T))
+            # only the last stage's buffer is real: psum of masked buffers
+            contrib = jnp.where(idx == S - 1, out_buf,
+                                jnp.zeros_like(out_buf))
+            return jax.lax.psum(contrib, "pp")
+
+        return body
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, x):
+        """Full pipelined forward: head -> compiled ring -> tail."""
+        for l in self._head:
+            x = l(x)
+        x = self._run_pipeline(x)
+        for l in self._tail:
+            x = l(x)
+        return x
+
+    def _run_pipeline(self, h):
+        mesh = get_mesh()
+        M = max(self.accumulate_steps, 1)
+        b = h.shape[0]
+        if b % M:
+            raise ValueError(f"batch {b} not divisible by accumulate_steps {M}")
+
+        if mesh is None or self.num_stages <= 1 or "pp" not in mesh.axis_names:
+            # no pp: run blocks sequentially over the stacked params
+            return apply(lambda a, *ps: _scan_tuple(self._block_fn, a, ps),
+                         h, *self._stacked, name="pipeline_seq")
+
+        body = self._pipeline_jfn
+        in_specs = tuple([P()] + [P("pp")] * len(self._stacked))
+        smap = sharded_call(body, mesh, in_specs, P(), axis_names=("pp",))
+
+        def jfn(x_arr, *stacked_arrays):
+            mshape = (M, b // M) + x_arr.shape[1:]
+            out_micro = smap(x_arr.reshape(mshape), *stacked_arrays)
+            return out_micro.reshape((b,) + out_micro.shape[2:])
+
+        return apply(jfn, h, *self._stacked, name="pipeline")
+
+    # -- train/eval batch API (reference surface) --------------------------
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Reference pipeline_parallel.py:633 — one full fwd/bwd/step over
+        the micro-batched global batch."""
+        x, y = data
+        loss = self._loss(x, y)
+        loss.backward()
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        with no_grad():
+            return self._loss(x, y) if compute_loss else self.forward(x)
+
+    def _loss(self, x, y):
+        out = self.forward(x)
+        if self._layers._loss_fn is None:
+            raise ValueError("PipelineLayer needs loss_fn for train_batch")
+        return self._layers._loss_fn(out, y)
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        x, y = data
+        loss = self._loss(x, y)
+        loss.backward()
+        return loss
+
+
+def _scan_tuple(block_fn, x_arr, stacked_arrays):
+    """scan over layer dim when params are a tuple of stacked arrays."""
+    def one(carry, layer_params):
+        return block_fn(list(layer_params), carry), None
+    out, _ = jax.lax.scan(one, x_arr, tuple(stacked_arrays))
+    return out

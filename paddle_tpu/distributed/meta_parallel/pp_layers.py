@@ -1,0 +1,199 @@
+"""PipelineLayer: declarative stage-partitioned model description.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py —
+`LayerDesc` (:56), `SharedLayerDesc` (:76, tied embeddings), `SegmentLayers`
+(:92, balanced partition), `PipelineLayer` (:237).
+
+TPU-native twist: there is no per-rank construction — the single controller
+builds every layer, and `PipelineParallel` stacks the homogeneous middle run
+of blocks into [L, ...] parameters sharded over the 'pp' mesh axis. The
+head/tail (embedding, final norm, lm head) execute as full-batch GSPMD ops
+outside the pipelined scan.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+
+from ...nn.layer import Layer
+from ...nn.layers.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects an nn.Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing on multiple stages (reference :76). The
+    first occurrence of `key` owns the layer; later occurrences reuse it
+    through `forward_func`."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layer descs into num_parts contiguous segments
+    (reference :92: uniform by count, or 'layer:<ClassName>' to balance by
+    occurrences of a class)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.descs)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.descs)
+                     if d.layer_func.__name__ == cls_name]
+            if len(marks) % self.num_parts:
+                raise ValueError(
+                    f"{len(marks)} x {cls_name} not divisible into "
+                    f"{self.num_parts} stages")
+            per = len(marks) // self.num_parts
+            bounds = [0]
+            for p in range(1, self.num_parts):
+                bounds.append(marks[p * per])
+            bounds.append(n)
+            return bounds
+        raise ValueError(f"unknown seg method {self.method!r}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        bounds = [0]
+        for p in range(1, num_parts + 1):
+            bounds.append(int(round(num_items * p / num_parts)))
+        return bounds
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._topology = topology
+        if num_stages is None:
+            from ..topology import get_hybrid_communicate_group
+            hcg = get_hybrid_communicate_group()
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._seg_method = seg_method
+
+        # build all layers; resolve shared descs by key
+        self._shared: dict[str, Layer] = {}
+        built = []
+        self._shared_fwd: dict[int, SharedLayerDesc] = {}
+        for i, desc in enumerate(self._layers_desc):
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in self._shared:
+                    self._shared[desc.layer_name] = desc.build_layer()
+                built.append(self._shared[desc.layer_name])
+                self._shared_fwd[i] = desc
+            elif isinstance(desc, LayerDesc):
+                built.append(desc.build_layer())
+            elif isinstance(desc, Layer):
+                built.append(desc)
+            else:
+                raise TypeError(f"bad pipeline desc: {desc!r}")
+        self.run_function = LayerList(built)
+
+        self._segment_bounds = SegmentLayers(
+            self._layers_desc, num_stages, seg_method).do_segment() \
+            if num_stages > 1 else [0, len(built)]
+
+        # homogeneous middle run for the compiled pipeline: longest contiguous
+        # run of same-class non-shared descs with count % num_stages == 0
+        self._block_range = self._find_block_run()
+
+    def _find_block_run(self):
+        descs = self._layers_desc
+        best = (0, 0)
+        i = 0
+        while i < len(descs):
+            if isinstance(descs[i], SharedLayerDesc) or \
+                    not isinstance(descs[i], LayerDesc):
+                i += 1
+                continue
+            j = i
+            cls = descs[i].layer_func
+            while j < len(descs) and isinstance(descs[j], LayerDesc) and \
+                    not isinstance(descs[j], SharedLayerDesc) and \
+                    descs[j].layer_func is cls:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        start, end = best
+        count = end - start
+        if self._num_stages > 1 and count % self._num_stages:
+            # trim to a multiple of num_stages
+            count -= count % self._num_stages
+            end = start + count
+        return (start, end)
+
+    @property
+    def block_layers(self):
+        s, e = self._block_range
+        return [self.run_function[i] for i in range(s, e)]
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_stage_from_index(self, layer_idx):
+        for s in range(self._num_stages):
+            if self._segment_bounds[s] <= layer_idx < self._segment_bounds[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def forward(self, input, chunk_id=None):
+        """Sequential (non-pipelined) execution — correctness reference and
+        the eval path."""
+        x = input
+        for i, layer in enumerate(self.run_function):
+            if i in self._shared_fwd and self._shared_fwd[i].forward_func is not None and \
+                    list(self._shared.values()).index(layer) >= 0 and \
+                    i != self._first_occurrence(self._shared_fwd[i].layer_name):
+                x = self._shared_fwd[i].forward_func(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+    def _first_occurrence(self, key):
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc) and d.layer_name == key:
+                return i
+        return -1
+
+    def save_state_dict(self, path):
+        import paddle_tpu as paddle
+        paddle.save(self.state_dict(), path)
+
+    def set_state_dir(self, path):
+        import paddle_tpu as paddle
+        self.set_state_dict(paddle.load(path))

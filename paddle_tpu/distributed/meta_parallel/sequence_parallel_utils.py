@@ -1,0 +1,140 @@
+"""Megatron-style sequence parallelism inside the tp group.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers (:85-137) and
+Column/RowSequenceParallelLinear (:230, :340). The reference hand-codes the
+allgather (before column matmul) and reduce-scatter (after row matmul) on the
+sequence dim; here the same dataflow is expressed as sharding constraints —
+activations sequence-sharded over 'mp' between blocks, unsharded inside the
+matmuls — and the GSPMD partitioner emits exactly that allgather/
+reduce-scatter pair on ICI.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ...nn.layer import Layer
+from ...nn import functional as F
+from ...nn import initializer as I
+from ..sharding_utils import mark_sharding
+from ..topology import get_mesh
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+_SEQ_DIM = 1  # [b, s, h] activations
+
+
+def _seq_spec(ndim, axis="mp"):
+    spec = [None] * ndim
+    spec[_SEQ_DIM] = axis
+    return P(*spec)
+
+
+class ScatterOp:
+    """Split activations along the sequence dim across mp (reference :85)."""
+
+    @staticmethod
+    def apply(x, axis=_SEQ_DIM):
+        if get_mesh() is None:
+            return x
+        spec = [None] * x.ndim
+        spec[axis] = "mp"
+        return mark_sharding(x, P(*spec))
+
+
+class GatherOp:
+    """Gather sequence-sharded activations back to full (reference :107)."""
+
+    @staticmethod
+    def apply(x, axis=_SEQ_DIM):
+        if get_mesh() is None:
+            return x
+        return mark_sharding(x, P(*([None] * x.ndim)))
+
+
+class AllGatherOp:
+    """Forward allgather / backward reduce-scatter (reference :117)."""
+
+    @staticmethod
+    def apply(x):
+        return GatherOp.apply(x)
+
+
+class ReduceScatterOp:
+    """Forward reduce-scatter / backward allgather (reference :129)."""
+
+    @staticmethod
+    def apply(x):
+        return ScatterOp.apply(x)
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel matmul consuming sequence-sharded input
+    (reference :230): in-dataflow = allgather(seq) -> matmul -> out sharded
+    on features."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        mark_sharding(self.weight, P(None, "mp"))
+        self.bias = self.create_parameter(shape=[out_features], is_bias=True) \
+            if (has_bias or has_bias is None) else None
+        if self.bias is not None:
+            mark_sharding(self.bias, P("mp"))
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        if get_mesh() is not None:
+            # input arrives sequence-sharded; GSPMD inserts the allgather
+            x = mark_sharding(x, _seq_spec(x.ndim))
+            x = mark_sharding(x, P(*([None] * x.ndim)))
+        out = F.linear(x, self.weight, self.bias)
+        if get_mesh() is not None and not self.gather_output:
+            out = mark_sharding(out, P(*([None] * (out.ndim - 1)), "mp"))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel matmul producing sequence-sharded output
+    (reference :340): matmul on feature-sharded input -> reduce-scatter over
+    the sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        mark_sharding(self.weight, P("mp", None))
+        self.bias = self.create_parameter(shape=[out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        if get_mesh() is not None:
+            x = mark_sharding(x, P(*([None] * (x.ndim - 1)), "mp"))
+        out = F.linear(x, self.weight, self.bias)
+        if get_mesh() is not None:
+            # reduce-scatter: output leaves sequence-sharded
+            out = mark_sharding(out, _seq_spec(out.ndim))
+        return out
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.is_sequence_parallel = True  # consumed by HybridParallelOptimizer
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Reference :192 — SP params (norms) need grad allreduce across mp. With
+    GSPMD these params are replicated over mp, so the partitioner already
+    reduces their grads; kept as an API no-op with the same signature."""
+    return None

@@ -1,0 +1,88 @@
+"""Ring attention: exact attention over a sequence-sharded ring.
+
+The reference snapshot has NO ring attention (SURVEY.md §5.7 — its long-
+context story is Megatron-SP + the sep axis + flash kernels); this module is
+the TPU-native upgrade the survey prescribes: K/V shards rotate around the
+'sep' mesh axis with `lax.ppermute` (ICI is a torus — each hop is a neighbor
+transfer), while each device keeps a running online-softmax accumulator over
+its local Q shard. Comm volume per device = one full K/V pass, fully
+overlapped by XLA with the per-step matmuls.
+
+Layout: [batch, seq, heads, head_dim], seq sharded over 'sep'.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor, as_tensor
+from ..autograd.function import apply
+from .sharding_utils import sharded_call
+from .topology import get_mesh
+
+__all__ = ["ring_attention", "ring_attention_fn"]
+
+NEG_INF = -1e30
+
+
+def ring_attention_fn(q, k, v, causal=False, axis_name="sep"):
+    """Pure jax body; call inside shard_map with seq sharded on axis_name."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_loc = q.shape[1]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale  # [b,h,sq,d]
+    k0 = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    v0 = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+
+    b, h, sq, d = qh.shape
+    m0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+
+    q_pos = idx * s_loc + jnp.arange(sq)  # global positions of local queries
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        src = (idx - i) % n  # ring shard currently held
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, k_cur)
+        if causal:
+            k_pos = src * s_loc + jnp.arange(k_cur.shape[2])
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        step, (k0, v0, m0, l0, acc0), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention(query, key, value, causal=False, axis_name="sep"):
+    """Framework entry: [B, S, H, D] tensors with S sharded over `axis_name`.
+    Falls back to plain SDPA when no mesh / sep degree 1."""
+    mesh = get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or \
+            mesh.shape[axis_name] <= 1:
+        from ..nn.functional import scaled_dot_product_attention
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal)
+    spec = P(None, axis_name, None, None)
+    body = sharded_call(
+        lambda q, k, v: ring_attention_fn(q, k, v, causal=causal,
+                                          axis_name=axis_name),
+        mesh, (spec, spec, spec), spec, axis_names=(axis_name,))
+    return apply(body, query, key, value, name="ring_attention")

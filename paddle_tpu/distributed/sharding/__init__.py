@@ -1,0 +1,6 @@
+"""`paddle.distributed.sharding` (reference: python/paddle/distributed/
+sharding/group_sharded.py facade)."""
+
+from ..meta_parallel.sharding import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model,
+)

@@ -1,0 +1,92 @@
+"""Core sharding utilities bridging framework Tensors and GSPMD.
+
+This is the TPU-native replacement for the reference's DistTensor machinery
+(paddle/phi/core/distributed/auto_parallel/dist_tensor.h:28 + the reshard
+functions): a Tensor carries a `PartitionSpec`; `mark_sharding` constrains the
+traced value (GSPMD propagates and inserts collectives); `sharded_call` runs a
+framework function under `shard_map` with the collective context active.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor, as_tensor
+from ..autograd.function import apply
+from .topology import get_mesh
+from .communication.group import _axis_scope
+
+__all__ = ["PartitionSpec", "mark_sharding", "named_sharding", "spec_of",
+           "sharded_call", "replicate_spec"]
+
+
+def named_sharding(spec, mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise RuntimeError("no device mesh active; call fleet.init or "
+                           "auto_parallel first")
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    return NamedSharding(mesh, spec)
+
+
+def replicate_spec() -> PartitionSpec:
+    return PartitionSpec()
+
+
+def spec_of(t: Tensor) -> PartitionSpec | None:
+    return t._sharding_spec
+
+
+def mark_sharding(t, spec, mesh: Mesh | None = None) -> Tensor:
+    """Annotate + constrain a tensor's sharding (differentiable).
+
+    Inside a jit trace this emits `with_sharding_constraint` (the GSPMD
+    anchor); eagerly it `device_put`s onto the mesh when one is active. The
+    spec is also remembered on the Tensor so `to_static` compiles matching
+    `in_shardings` — the analog of the reference's TensorDistAttr.
+    """
+    t = as_tensor(t)
+    mesh = mesh or get_mesh()
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    t._sharding_spec = spec
+    if mesh is None:
+        return t
+    ns = NamedSharding(mesh, spec)
+    if isinstance(t._d, jax.core.Tracer):
+        out = apply(lambda a: jax.lax.with_sharding_constraint(a, ns), t,
+                    name="shard_constraint")
+        out._sharding_spec = spec
+        return out
+    t._data = jax.device_put(t._d, ns)
+    return t
+
+
+def sharded_call(fn, mesh: Mesh | None, in_specs, out_specs, axis_names=None):
+    """Run `fn` (a function over jax arrays) under shard_map on the mesh,
+    with the framework collective context active so
+    `paddle_tpu.distributed.all_reduce` etc. lower to lax collectives.
+
+    `axis_names` selects the manual axes; remaining mesh axes stay `auto`
+    (GSPMD-partitioned), which is how compiled pipelines nest inside dp/mp
+    sharding.
+    """
+    mesh = mesh or get_mesh()
+    axis_names = tuple(axis_names) if axis_names is not None else \
+        tuple(mesh.axis_names)
+
+    def wrapped(*args):
+        with _axis_scope(axis_names):
+            return fn(*args)
+
+    smapped = jax.shard_map(wrapped, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs,
+                            axis_names=frozenset(axis_names), check_vma=False)
+    # partial-manual shard_map (manual subset of mesh axes) only lowers under
+    # jit; jit dispatch also makes the eager path work
+    return jax.jit(smapped)

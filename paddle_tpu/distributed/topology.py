@@ -1,0 +1,243 @@
+"""5-D process topology -> TPU device mesh.
+
+Reference: python/paddle/distributed/fleet/base/topology.py —
+`CommunicateTopology` (:61) builds the cartesian rank topology over axes
+[data, pipe, sharding, model, sep]; `HybridCommunicateGroup` (:174) derives
+per-axis communication groups. TPU-native realization: the topology IS a
+`jax.sharding.Mesh` with named axes; "groups" are mesh axis names consumed by
+GSPMD shardings and `shard_map` collectives instead of NCCL communicators.
+
+Axis placement matters for ICI vs DCN: jax mesh axes are laid out
+major-to-minor over the device list, so we order [dp, pp, sharding, sep, mp]
+— tp (mp) innermost rides ICI neighbors, dp/pp outermost may cross DCN,
+matching the reference's bandwidth hierarchy guidance.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import OrderedDict
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup",
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+           "get_mesh", "ParallelMode"]
+
+# canonical axis name mapping: reference name -> mesh axis name
+AXIS_NAME = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+             "model": "mp", "sep": "sep"}
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = [int(d) for d in dims]
+        self._world = int(np.prod(self._dims))
+        shape = tuple(self._dims)
+        self._rank_grid = np.arange(self._world).reshape(shape)
+        self._coord_of = {}
+        for coord in itertools.product(*[range(d) for d in self._dims]):
+            self._coord_of[int(self._rank_grid[coord])] = coord
+
+    def get_hybrid_group_names(self):
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return int(self._rank_grid[coord])
+
+    def get_coord(self, rank: int):
+        return self._coord_of[rank]
+
+    def get_axis_list(self, axis_name: str, index: int):
+        """All ranks whose coordinate on `axis_name` equals index."""
+        ax = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[ax] = index
+        return sorted(int(r) for r in self._rank_grid[tuple(sl)].reshape(-1))
+
+    def get_comm_list(self, axis_name: str):
+        """List of rank-groups along `axis_name` (one group per combination
+        of the other axes) — the reference's per-axis communicator sets."""
+        ax = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._rank_grid, ax, -1)
+        return [sorted(int(r) for r in row)
+                for row in moved.reshape(-1, self._dims[ax])]
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = dict(zip(self._parallel_names, self.get_coord(global_rank)))
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class HybridCommunicateGroup:
+    """Reference topology.py:174. Holds the topology + the jax Mesh; exposes
+    the same rank/degree/group queries the fleet stack uses."""
+
+    def __init__(self, topology: CommunicateTopology, devices=None):
+        self._topo = topology
+        self.nranks = topology.world_size()
+        self.global_rank = 0  # SPMD: one process drives all mesh ranks
+
+        names = topology.get_hybrid_group_names()
+        self._dp_degree = topology.get_dim("data") if "data" in names else 1
+        self._pp_degree = topology.get_dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = (topology.get_dim("sharding")
+                                 if "sharding" in names else 1)
+        self._mp_degree = topology.get_dim("model") if "model" in names else 1
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+
+        if devices is None:
+            devices = jax.devices()
+        n_needed = self.nranks
+        if len(devices) < n_needed:
+            raise ValueError(
+                f"topology needs {n_needed} devices, have {len(devices)}")
+        mesh_shape = tuple(topology.get_dim(n) for n in names)
+        axis_names = tuple(AXIS_NAME[n] for n in names)
+        dev_array = np.array(devices[:n_needed]).reshape(mesh_shape)
+        self._mesh = Mesh(dev_array, axis_names)
+        _set_global_mesh(self._mesh)
+
+    # -- mesh ---------------------------------------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._sep_degree > 1:
+            return ParallelMode.SEGMENT_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    # -- degrees / ranks (reference API surface) ---------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def _rank_on(self, axis):
+        coord = self._topo.get_coord(self.global_rank)
+        return coord[self._topo.get_hybrid_group_names().index(axis)]
+
+    def get_data_parallel_rank(self):
+        return self._rank_on("data")
+
+    def get_model_parallel_rank(self):
+        return self._rank_on("model")
+
+    def get_stage_id(self):
+        return self._rank_on("pipe")
+
+    def get_sharding_parallel_rank(self):
+        return self._rank_on("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._rank_on("sep")
+
+    # -- groups: mesh-axis handles (see communication.group.Group) ---------
+    def _axis_group(self, mesh_axis):
+        from .communication.group import Group
+        return Group(ranks=list(range(self._topo.get_dim(
+            {v: k for k, v in AXIS_NAME.items()}[mesh_axis]))),
+            mesh_axis=mesh_axis, mesh=self._mesh)
+
+    def get_data_parallel_group(self):
+        return self._axis_group("dp")
+
+    def get_model_parallel_group(self):
+        return self._axis_group("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._axis_group("sep")
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._axis_group("mp")
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pp neighbors (compiled pipeline uses ppermute; these are for parity)
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def get_rank_from_stage(self, stage_id):
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id)
+
+
+_HCG: HybridCommunicateGroup | None = None
+_GLOBAL_MESH: Mesh | None = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _HCG
+    _HCG = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup | None:
+    return _HCG
+
+
+def _set_global_mesh(mesh: Mesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> Mesh | None:
+    """The active device mesh (set by fleet.init / auto_parallel)."""
+    return _GLOBAL_MESH

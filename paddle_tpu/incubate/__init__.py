@@ -1,0 +1,2 @@
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,3 @@
+from .moe_layer import MoELayer  # noqa: F401
+from .gate import NaiveGate, GShardGate, SwitchGate, BaseGate  # noqa: F401
+from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401

@@ -1,0 +1,62 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+{naive,gshard,switch}_gate.py).
+
+Gates produce per-token expert scores; routing/capacity logic lives in
+MoELayer (GShard-style dispatch/combine einsums so XLA can lay the all-to-all
+over the expert mesh axis).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....nn.layer import Layer
+from .....nn.layers.common import Linear
+from .....nn import functional as F
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.top_k = top_k
+        self.loss = None
+
+    def forward(self, x):
+        raise NotImplementedError
+
+
+class NaiveGate(BaseGate):
+    """Plain linear top-k gate (reference naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.gate = Linear(d_model, self.tot_expert)
+
+    def forward(self, x):
+        return self.gate(x)
+
+
+class GShardGate(NaiveGate):
+    """GShard gate: top-2 with aux load-balance loss (reference
+    gshard_gate.py). The aux loss (mean_prob * fraction_routed * E) is
+    computed in MoELayer where routing fractions are known."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.capacity = capacity
+
+
+class SwitchGate(NaiveGate):
+    """Switch-Transformer top-1 gate (reference switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, top_k=1)
+        self.switch_eps = switch_eps

@@ -1,0 +1,19 @@
+"""MoE-aware global-norm clip (reference: incubate/distributed/models/moe/
+grad_clip.py ClipGradForMOEByGlobalNorm): expert params' grad norms are
+summed once per expert owner. In the SPMD model every grad is logically
+global, so the plain global norm is already correct; the class keeps the
+reference surface (is_expert_param_func, moe_group)."""
+
+from __future__ import annotations
+
+from .....nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group

@@ -1,0 +1,4 @@
+"""paddle.incubate.nn equivalents: fused-op layer surface (reference:
+python/paddle/incubate/nn/). The fused layers map onto XLA-fused composites /
+pallas kernels."""
+from . import functional  # noqa: F401

@@ -1,0 +1,29 @@
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional/).
+On TPU these alias framework composites — XLA fuses elementwise chains into
+the matmuls; flash attention uses the Pallas kernel."""
+
+from ....nn.functional import rms_norm as fused_rms_norm  # noqa: F401
+from ....nn.functional import layer_norm as fused_layer_norm  # noqa: F401
+from ....nn.functional import rope as fused_rotary_position_embedding  # noqa: F401
+from ....nn.functional import swiglu  # noqa: F401
+from ....nn.functional import scaled_dot_product_attention as fused_dot_product_attention  # noqa: F401
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.0, ln_epsilon=1e-5,
+                                           training=True):
+    """Reference: fused_bias_dropout_residual_layer_norm op
+    (paddle/phi/kernels/fusion/gpu/fused_bias_dropout_residual_layer_norm*)."""
+    from ....nn import functional as F
+    out = x if bias is None else x + bias
+    out = F.dropout(out, dropout_rate, training=training)
+    out = out + residual
+    return F.layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    from ....nn import functional as F
+    from .... import ops
+    w = ops.t(weight) if transpose_weight else weight
+    return F.linear(x, w, bias)

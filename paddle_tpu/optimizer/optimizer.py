@@ -92,8 +92,10 @@ class Optimizer:
         if key not in self._accumulators[name]:
             dt = dtype if dtype is not None else (
                 jnp.float32 if self._multi_precision else param._data.dtype)
-            self._accumulators[name][key] = Tensor(
-                jnp.full(param._data.shape, fill_value, dt))
+            acc = Tensor(jnp.full(param._data.shape, fill_value, dt))
+            # moments follow their parameter's sharding (ZeRO/semi-auto)
+            acc._sharding_spec = param._sharding_spec
+            self._accumulators[name][key] = acc
         return self._accumulators[name][key]
 
     def _get_master(self, param):

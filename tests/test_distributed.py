@@ -1,0 +1,365 @@
+"""Distributed stack tests on the virtual 8-device CPU mesh (SURVEY.md §4:
+the reference tests its collective stack on CPU/Gloo the same way)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+
+import jax
+
+
+def _reset_mesh():
+    from paddle_tpu.distributed import topology
+    topology._HCG = None
+    topology._GLOBAL_MESH = None
+
+
+@pytest.fixture(autouse=True)
+def clean_mesh():
+    _reset_mesh()
+    yield
+    _reset_mesh()
+
+
+def _init_fleet(dp=1, mp=1, pp=1, sharding=1, sep=1, **strategy_kw):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+        "sharding_degree": sharding, "sep_degree": sep,
+    }
+    for k, v in strategy_kw.items():
+        setattr(strategy, k, v)
+    return fleet.init(is_collective=True, strategy=strategy), strategy
+
+
+def test_topology_mesh():
+    hcg, _ = _init_fleet(dp=2, mp=4)
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 4
+    mesh = hcg.mesh
+    assert dict(zip(mesh.axis_names, mesh.devices.shape))["mp"] == 4
+    topo = hcg.topology
+    assert topo.world_size() == 8
+    assert len(topo.get_comm_list("model")) == 2
+    assert topo.get_comm_list("model")[0] == [0, 1, 2, 3]
+
+
+def test_comm_topology_coords():
+    from paddle_tpu.distributed.topology import CommunicateTopology
+    topo = CommunicateTopology(["data", "model"], [2, 4])
+    assert topo.get_rank(data=1, model=2) == 6
+    assert topo.get_coord(6) == (1, 2)
+    assert topo.get_axis_list("data", 0) == [0, 1, 2, 3]
+
+
+def test_dp_training_parity():
+    """dp=8 compiled training must match single-device training exactly."""
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    ref = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4))
+    ref.set_state_dict(model.state_dict())
+
+    hcg, _ = _init_fleet(dp=8)
+    dmodel = fleet.distributed_model(model)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+    dopt = fleet.distributed_optimizer(opt)
+    ropt = paddle.optimizer.AdamW(1e-2, parameters=ref.parameters())
+
+    x = paddle.randn([16, 16])
+    y = paddle.to_tensor(np.random.randint(0, 4, (16,)))
+    lossfn = nn.CrossEntropyLoss()
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = lossfn(dmodel(x), y)
+        loss.backward()
+        dopt.step()
+        dopt.clear_grad()
+        return loss
+
+    losses = [float(step(x, y)) for _ in range(4)]
+
+    _reset_mesh()
+    for _ in range(4):
+        rl = lossfn(ref(x), y)
+        rl.backward()
+        ropt.step()
+        ropt.clear_grad()
+    np.testing.assert_allclose(losses[-1], float(rl), rtol=1e-4)
+    np.testing.assert_allclose(model[0].weight.numpy(),
+                               ref[0].weight.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_tp_layers_match_dense():
+    paddle.seed(5)
+    hcg, _ = _init_fleet(mp=4)
+    from paddle_tpu.distributed.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+    col = ColumnParallelLinear(16, 32, gather_output=False)
+    row = RowParallelLinear(32, 16, input_is_parallel=True)
+    emb = VocabParallelEmbedding(64, 16)
+
+    ids = paddle.to_tensor(np.random.randint(0, 64, (2, 8)))
+
+    @paddle.jit.to_static
+    def fwd(ids):
+        h = emb(ids)
+        h = col(h)
+        h = row(h)
+        return h.mean()
+
+    out = float(fwd(ids))
+    out2 = float(fwd(ids))
+    np.testing.assert_allclose(out, out2, rtol=1e-6)
+
+    # dense reference with identical weights
+    _reset_mesh()
+    ref = float((paddle.nn.functional.linear(
+        paddle.nn.functional.linear(
+            paddle.nn.functional.embedding(ids, emb.weight),
+            col.weight, col.bias),
+        row.weight, row.bias)).mean())
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_tp_params_are_sharded():
+    hcg, _ = _init_fleet(mp=4)
+    from paddle_tpu.distributed.meta_parallel import ColumnParallelLinear
+    col = ColumnParallelLinear(16, 32)
+    spec = col.weight._sharding_spec
+    assert spec is not None and spec[1] == "mp"
+    # physically sharded: per-device shard is out_features/4
+    shards = col.weight._d.addressable_shards
+    assert shards[0].data.shape == (16, 8)
+
+
+def test_sharding_stage3_param_sharding():
+    hcg, strategy = _init_fleet(sharding=8)
+    strategy.sharding_configs = {"stage": 3}
+    model = nn.Linear(32, 32)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    wrapped, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+    assert model.weight._sharding_spec[0] == "sharding"
+    assert model.weight._d.addressable_shards[0].data.shape == (4, 32)
+    # train a step: forward/backward/step still correct
+    x = paddle.randn([8, 32])
+    loss = wrapped(x).square().mean()
+    loss.backward()
+    opt.step()
+    # optimizer moments inherit the sharding
+    m = opt._accumulators["moment1"][id(model.weight)]
+    assert m._sharding_spec is not None and m._sharding_spec[0] == "sharding"
+
+
+def test_sharding_stage1_optimizer_states():
+    hcg, _ = _init_fleet(sharding=8)
+    model = nn.Linear(32, 8)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    model2, opt2, _ = group_sharded_parallel(model, opt, level="os")
+    x = paddle.randn([4, 32])
+    model(x).square().mean().backward()
+    opt2.step()
+    m = opt._accumulators["moment1"][id(model.weight)]
+    assert m._sharding_spec is not None and m._sharding_spec[0] == "sharding"
+
+
+def test_collectives_in_shard_map():
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    hcg, _ = _init_fleet(dp=8)
+    g = hcg.get_data_parallel_group()
+    from paddle_tpu.distributed.sharding_utils import sharded_call
+
+    def body(x):
+        t = paddle.Tensor(x)
+        dist.all_reduce(t, group=g)
+        return t._data
+
+    fn = sharded_call(body, hcg.mesh, (P("dp"),), P(), axis_names=("dp",))
+    x = np.arange(8.0)
+    out = np.asarray(fn(jnp.asarray(x)))
+    assert np.allclose(out, x.sum())
+
+
+def test_all_gather_in_shard_map():
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    hcg, _ = _init_fleet(dp=8)
+    g = hcg.get_data_parallel_group()
+    from paddle_tpu.distributed.sharding_utils import sharded_call
+
+    def body(x):
+        t = paddle.Tensor(x)
+        out = dist.all_gather(None, t, group=g)
+        return out._data
+
+    fn = sharded_call(body, hcg.mesh, (P("dp"),), P(None, "dp"),
+                      axis_names=("dp",))
+    x = np.arange(8.0)
+    out = np.asarray(fn(jnp.asarray(x)))
+    # every dp rank holds the gathered [8, 1] shard stack
+    assert out.shape == (8, 8)
+    np.testing.assert_allclose(out[:, 3], x)
+
+
+def test_shard_tensor_api():
+    mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    data = paddle.randn([8, 4])
+    t = dist.shard_tensor(data, mesh, [dist.Shard(0), dist.Replicate()])
+    assert t._sharding_spec[0] == "x"
+    assert t._d.addressable_shards[0].data.shape == (4, 4)
+    r = dist.reshard(t, mesh, [dist.Replicate(), dist.Replicate()])
+    assert r._d.addressable_shards[0].data.shape == (8, 4)
+    np.testing.assert_allclose(np.asarray(r._d), data.numpy())
+    full = dist.unshard_dtensor(t)
+    np.testing.assert_allclose(full.numpy(), data.numpy())
+
+
+def test_ring_attention_matches_sdpa():
+    paddle.seed(11)
+    hcg, _ = _init_fleet(sep=8)
+    b, s, h, d = 2, 32, 4, 8
+    q = paddle.randn([b, s, h, d])
+    k = paddle.randn([b, s, h, d])
+    v = paddle.randn([b, s, h, d])
+    out_ring = dist.ring_attention(q, k, v, causal=True)
+    _reset_mesh()
+    ref = paddle.nn.functional.scaled_dot_product_attention(
+        q, k, v, is_causal=True)
+    np.testing.assert_allclose(out_ring.numpy(), ref.numpy(),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_ring_attention_grads():
+    hcg, _ = _init_fleet(sep=4)
+    q = paddle.randn([1, 16, 2, 4])
+    q.stop_gradient = False
+    out = dist.ring_attention(q, q, q, causal=False)
+    out.sum().backward()
+    assert q.grad is not None
+    assert not np.allclose(q.grad.numpy(), 0)
+
+
+def test_moe_layer():
+    paddle.seed(13)
+    hcg, _ = _init_fleet(dp=8)
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    experts = [nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 16))
+               for _ in range(8)]
+    moe = MoELayer(d_model=16, experts=experts, gate={"type": "gshard",
+                                                      "top_k": 2},
+                   capacity_factor=2.0)
+    x = paddle.randn([4, 8, 16])
+    x.stop_gradient = False
+    out = moe(x)
+    assert out.shape == [4, 8, 16]
+    assert moe.l_aux is not None
+    out.mean().backward()
+    assert moe._stacked[0].grad is not None
+    # expert params sharded over dp
+    assert moe._stacked[0]._sharding_spec[0] == "dp"
+
+
+def test_pipeline_layer_segmentation():
+    from paddle_tpu.distributed.meta_parallel import (LayerDesc, PipelineLayer)
+    descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(8)]
+    pl = PipelineLayer(layers=descs, num_stages=4)
+    assert pl._segment_bounds == [0, 2, 4, 6, 8]
+    assert pl._block_range == (0, 8)
+
+
+def test_pipeline_parallel_training():
+    paddle.seed(17)
+    hcg, strategy = _init_fleet(pp=4)
+    strategy.pipeline_configs = {"accumulate_steps": 4}
+    from paddle_tpu.distributed.meta_parallel import LayerDesc, PipelineLayer
+
+    class Block(nn.Layer):
+        def __init__(self, h):
+            super().__init__()
+            self.fc = nn.Linear(h, h)
+
+        def forward(self, x):
+            return x + paddle.nn.functional.gelu(self.fc(x))
+
+    lossfn = nn.MSELoss()
+    descs = [LayerDesc(Block, 16) for _ in range(8)]
+    pl = PipelineLayer(layers=descs, num_stages=4, loss_fn=lossfn)
+    # keep a dense copy before wrapping stacks/clears the block params
+    import copy
+    ref_layers = [copy.deepcopy(pl.run_function[i]) for i in range(8)]
+
+    model = fleet.distributed_model(pl)
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    opt = fleet.distributed_optimizer(opt)
+
+    x = paddle.randn([8, 16])
+    y = paddle.zeros([8, 16])
+
+    # forward parity vs dense reference
+    out = model.forward(x)
+    ref = x
+    for l in ref_layers:
+        ref = l(ref)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+    # training decreases loss
+    losses = []
+    for _ in range(5):
+        loss = model.train_batch([x, y], opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_sequence_parallel_linears():
+    paddle.seed(19)
+    hcg, _ = _init_fleet(mp=4)
+    from paddle_tpu.distributed.meta_parallel import (
+        ColumnSequenceParallelLinear, RowSequenceParallelLinear)
+    col = ColumnSequenceParallelLinear(16, 32)
+    row = RowSequenceParallelLinear(32, 16)
+    x = paddle.randn([2, 8, 16])
+    out = row(col(x))
+    assert out.shape == [2, 8, 16]
+    _reset_mesh()
+    ref = paddle.nn.functional.linear(
+        paddle.nn.functional.linear(x, col.weight, col.bias),
+        row.weight, row.bias)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_recompute_matches_plain():
+    paddle.seed(23)
+    from paddle_tpu.distributed.fleet import recompute
+    block = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 8))
+    x = paddle.randn([4, 8])
+    x.stop_gradient = False
+    out = recompute(block, x)
+    out.sum().backward()
+    g_recompute = x.grad.numpy()
+    w_grad = block[0].weight.grad.numpy()
+
+    x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    block.clear_gradients()
+    block(x2).sum().backward()
+    np.testing.assert_allclose(g_recompute, x2.grad.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(w_grad, block[0].weight.grad.numpy(), rtol=1e-5)
+
+
+def test_distributed_strategy_roundtrip(tmp_path):
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    s.sharding_configs = {"stage": 2}
+    path = str(tmp_path / "strategy.json")
+    s.save_to_prototxt(path)
+    s2 = DistributedStrategy()
+    s2.load_from_prototxt(path)
+    assert s2.hybrid_configs.dp_degree == 2
+    assert s2.hybrid_configs.mp_degree == 4
+    assert s2.sharding_configs.stage == 2
