@@ -1,6 +1,13 @@
-"""Benchmark harness: GPT-2 124M train-step throughput + MFU on one chip.
+"""Benchmark harness: flagship train-step throughput + MFU on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — ALWAYS.
+
+Round-1 lost its number because `jax.devices()` wedged on the TPU tunnel (it
+can HANG, not just raise). So the orchestration never trusts in-process TPU
+init: the TPU probe and the TPU bench each run in a subprocess under a hard
+timeout; on any failure the harness falls back to a forced-CPU smoke run and
+still emits the JSON line (with an "error"/"init_warning" field).
+
 Baseline discipline per BASELINE.md: primary metric is tokens/sec/chip with
 MFU derived from analytic FLOPs (6N + attention correction); the north-star
 target is 40% MFU, so vs_baseline = MFU / 0.40.
@@ -8,18 +15,24 @@ target is 40% MFU, so vs_baseline = MFU / 0.40.
 
 import json
 import os
+import subprocess
 import sys
 import time
+import traceback
+
+_PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+_RUN_TIMEOUT = int(os.environ.get("BENCH_RUN_TIMEOUT", "1800"))
 
 
-def main():
+def _force_cpu():
+    from paddle_tpu.device import force_cpu_backend
+    return force_cpu_backend().devices("cpu")[0]
+
+
+def run_gpt_bench(dev, on_tpu):
     import numpy as np
-    import jax
     import paddle_tpu as paddle
     from paddle_tpu.models import GPT, GPTConfig
-
-    dev = jax.devices()[0]
-    on_tpu = dev.platform in ("tpu", "axon")
 
     if on_tpu:
         cfg = GPTConfig(vocab_size=50304, max_position_embeddings=1024,
@@ -36,7 +49,8 @@ def main():
         3e-4, parameters=model.parameters(), weight_decay=0.1,
         multi_precision=True)
     if on_tpu:
-        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
 
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
@@ -65,9 +79,9 @@ def main():
     flops_per_token = model.flops_per_token(seq) * 3  # fwd + bwd(2x)
     achieved = tokens_per_s * flops_per_token
 
-    peak = _peak_flops(dev)
+    peak, peak_src = _peak_flops(dev)
     mfu = achieved / peak if peak else 0.0
-    result = {
+    return {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip" if on_tpu
         else "gpt2_cpu_smoke_tokens_per_sec",
         "value": round(tokens_per_s, 1),
@@ -75,29 +89,126 @@ def main():
         "vs_baseline": round(mfu / 0.40, 4) if peak else 0.0,
         "extra": {
             "mfu": round(mfu, 4), "loss": round(final, 3), "batch": batch,
-            "seq": seq, "steps": steps, "device": str(dev.device_kind
-                                                      if hasattr(dev, "device_kind") else dev.platform),
+            "seq": seq, "steps": steps,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
             "dtype": "bf16" if on_tpu else "f32",
+            "peak_flops": peak, "peak_flops_source": peak_src,
         },
     }
-    print(json.dumps(result))
 
 
-def _peak_flops(dev) -> float:
-    """bf16 peak FLOPs from the device kind (spec-sheet numbers)."""
+def _peak_flops(dev):
+    """(bf16 peak FLOPs, source) from the device kind (spec sheets)."""
     kind = (getattr(dev, "device_kind", "") or "").lower()
     table = {
         "v6e": 918e12, "v6": 918e12, "v5p": 459e12, "v5e": 197e12,
         "v5litepod": 197e12, "v4": 275e12, "v3": 123e12, "v2": 45e12,
     }
+    if dev.platform not in ("tpu", "axon"):
+        return 0.0, "cpu"
     for k, v in table.items():
         if k in kind:
-            return v
+            return v, f"device_kind:{kind}"
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
     for k, v in table.items():
         if k in gen:
-            return v
-    return table["v5e"] if dev.platform in ("tpu", "axon") else 0.0
+            return v, f"env:PALLAS_AXON_TPU_GEN={gen}"
+    return table["v5e"], "default_guess_v5e"
+
+
+# ---------------------------------------------------------------------------
+# orchestration (parent process; never touches the TPU backend itself)
+# ---------------------------------------------------------------------------
+
+def _probe_tpu():
+    """Subprocess probe: is a TPU-ish backend alive? Hard timeout."""
+    code = ("import jax; d=jax.devices()[0]; "
+            "print(d.platform, getattr(d,'device_kind',''))")
+    for attempt in range(2):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True,
+                timeout=_PROBE_TIMEOUT, cwd=os.path.dirname(
+                    os.path.abspath(__file__)))
+            if out.returncode == 0 and out.stdout.strip():
+                parts = out.stdout.split()
+                return parts[0], " ".join(parts[1:])
+        except subprocess.TimeoutExpired:
+            pass
+        except Exception:
+            pass
+        if attempt == 0:
+            time.sleep(5)
+    return None, None
+
+
+def _run_child(mode):
+    """Run the bench in a subprocess; returns parsed JSON dict or None."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), mode],
+            capture_output=True, text=True, timeout=_RUN_TIMEOUT,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in reversed(out.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+    except Exception:
+        pass
+    return None
+
+
+def _child_main(mode):
+    """--child-tpu / --child-cpu: actually run the workload, print JSON."""
+    try:
+        if mode == "--child-tpu":
+            import jax
+            dev = jax.devices()[0]
+            result = run_gpt_bench(dev, dev.platform in ("tpu", "axon"))
+        else:
+            dev = _force_cpu()
+            result = run_gpt_bench(dev, False)
+        print(json.dumps(result))
+        return 0
+    except Exception:
+        print(json.dumps({"metric": "bench_child_failed", "value": 0.0,
+                          "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                          "error": traceback.format_exc(limit=8)}))
+        return 1
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1].startswith("--child"):
+        return _child_main(sys.argv[1])
+
+    result = None
+    warning = None
+    platform, kind = _probe_tpu()
+    if platform in ("tpu", "axon"):
+        result = _run_child("--child-tpu")
+        if result is not None and "error" in result:
+            warning = result["error"]
+            result = None
+        elif result is None:
+            warning = "tpu bench child timed out or produced no JSON"
+    elif platform is None:
+        warning = "tpu probe failed (backend init hung or errored)"
+    else:
+        warning = f"no tpu: probe saw platform={platform}"
+
+    if result is None:
+        # in-process CPU fallback: guaranteed JSON line
+        try:
+            dev = _force_cpu()
+            result = run_gpt_bench(dev, False)
+        except Exception:
+            result = {"metric": "gpt2_cpu_smoke_tokens_per_sec", "value": 0.0,
+                      "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                      "error": traceback.format_exc(limit=8)}
+    if warning:
+        result.setdefault("extra", {})["init_warning"] = str(warning)[:2000]
+    print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":

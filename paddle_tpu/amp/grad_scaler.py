@@ -27,6 +27,13 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer state (reference tracks OptimizerState per optimizer):
+        # _unscaled guards the `unscale_ -> clip -> step` pattern against a
+        # second divide-by-scale; _found_inf_per keeps inf detection per
+        # optimizer so a clean second optimizer cannot mask an inf in the
+        # first one's grads
+        self._unscaled: set[int] = set()
+        self._found_inf_per: dict[int, bool] = {}
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
@@ -37,6 +44,10 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if id(optimizer) in self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last update()")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -45,17 +56,23 @@ class GradScaler:
             g = p._grad._data.astype(jnp.float32) * inv
             found = found or bool(jnp.any(~jnp.isfinite(g)))
             p._grad._data = g.astype(p._grad._data.dtype)
-        self._found_inf = found
+        self._found_inf_per[id(optimizer)] = found
+        self._found_inf = any(self._found_inf_per.values())
+        self._unscaled.add(id(optimizer))
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        if id(optimizer) not in self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf_per.get(id(optimizer), False):
             optimizer.step()
 
     def update(self):
+        self._unscaled.clear()
+        self._found_inf = self._found_inf or any(self._found_inf_per.values())
+        self._found_inf_per.clear()
         if not self._enable or not self._use_dynamic:
             return
         if self._found_inf:

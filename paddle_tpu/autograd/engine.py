@@ -18,7 +18,9 @@ __all__ = ["run_backward", "backward", "grad"]
 
 
 def _topo_order(roots):
-    """Post-order DFS over GradNodes reachable from root tensors."""
+    """Post-order DFS over GradNodes reachable from root tensors. Edges come
+    from each node's RECORDED input_nodes (captured at op-record time), not
+    the live `t._node`, which in-place ops may have rebound since."""
     order, seen = [], set()
     stack = [(n, False) for t in roots if (n := t._node) is not None]
     while stack:
@@ -30,9 +32,9 @@ def _topo_order(roots):
             continue
         seen.add(id(node))
         stack.append((node, True))
-        for t in node.inputs:
-            if t is not None and t._node is not None and id(t._node) not in seen:
-                stack.append((t._node, False))
+        for n_in, _ in node.input_nodes:
+            if n_in is not None and id(n_in) not in seen:
+                stack.append((n_in, False))
     return order  # topological (inputs before consumers)
 
 
@@ -140,13 +142,14 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, create_graph=Fa
             if not retain_graph:
                 node.vjp_fn = None
                 node.consumed = True
-            for t_in, c in zip(node.inputs, in_cots):
+            for t_in, (n_in, oi_in), c in zip(node.inputs, node.input_nodes,
+                                              in_cots):
                 if t_in is None or t_in.stop_gradient or c is None:
                     continue
                 c = _run_hooks(t_in, c)
-                if t_in._node is not None:
-                    s = cot.setdefault(id(t_in._node), [None] * len(t_in._node.out_meta))
-                    s[t_in._out_index] = _acc(s[t_in._out_index], c)
+                if n_in is not None:
+                    s = cot.setdefault(id(n_in), [None] * len(n_in.out_meta))
+                    s[oi_in] = _acc(s[oi_in], c)
                 else:
                     leaf_grads[id(t_in)] = _acc(leaf_grads.get(id(t_in)), c)
                     leaf_tensors[id(t_in)] = t_in

@@ -26,12 +26,16 @@ class GradNode:
     ``vjp_fn`` maps output cotangents -> input cotangents (a tuple, one per
     traced input array). ``inputs`` holds the producing Tensors (or None for
     non-Tensor / stop-gradient inputs, whose cotangents are dropped).
+    ``input_nodes`` snapshots each input's (producing node, out_index) AT
+    RECORD TIME — the engine routes cotangents through these, not through the
+    live ``t._node``, so in-place ops that rebind a tensor's node later
+    cannot corrupt the gradients of values computed before the mutation.
     ``jfn``/``raw_inputs`` keep the primal so higher-order grad
     (create_graph=True) can re-derive the vjp symbolically through `apply`.
     """
 
-    __slots__ = ("name", "vjp_fn", "inputs", "out_meta", "multi_out", "consumed",
-                 "jfn", "raw_inputs")
+    __slots__ = ("name", "vjp_fn", "inputs", "input_nodes", "out_meta",
+                 "multi_out", "consumed", "jfn", "raw_inputs")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any],
                  out_meta: list[tuple[tuple[int, ...], Any]], multi_out: bool,
@@ -39,6 +43,9 @@ class GradNode:
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)
+        self.input_nodes = [
+            (t._node, t._out_index) if t is not None else (None, 0)
+            for t in self.inputs]
         self.out_meta = out_meta  # [(shape, dtype)] per output, for zero cotangents
         self.multi_out = multi_out
         self.consumed = False

@@ -19,7 +19,33 @@ __all__ = ["get_device", "set_device", "device_count", "synchronize",
            "get_all_device_type", "get_available_device",
            "get_available_custom_device", "memory_allocated",
            "max_memory_allocated", "memory_reserved", "empty_cache", "Stream",
-           "Event", "current_stream", "stream_guard"]
+           "Event", "current_stream", "stream_guard", "force_cpu_backend"]
+
+
+def force_cpu_backend(n_devices: int | None = None):
+    """Pin jax to the host CPU backend, defending against the out-of-tree
+    "axon" TPU-tunnel PJRT plugin whose factory can wedge `jax.backends()`
+    even under JAX_PLATFORMS=cpu. Single source of truth for the workaround
+    used by bench.py, __graft_entry__.py and tests/conftest.py.
+
+    `n_devices` requests that many virtual CPU devices — only effective if
+    jax has not initialized a backend yet (XLA_FLAGS is read at init)."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    try:
+        import jax._src.xla_bridge as _xb
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    return jax
 
 
 def synchronize(device=None):

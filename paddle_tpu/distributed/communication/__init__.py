@@ -35,6 +35,9 @@ def _axis(group):
 
 
 def _in_place(t, out):
+    """Rebind `t` to the collective's output. Recording `t` as the op input
+    is safe: GradNode snapshots (node, out_index) at record time, so the
+    rebind cannot create a self-referential node."""
     t._data = out._data if isinstance(out, Tensor) else out
     if isinstance(out, Tensor):
         t._node, t._out_index = out._node, out._out_index
@@ -79,13 +82,16 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     t = as_tensor(tensor)
     if ax is None:
         if isinstance(tensor_list, list):
-            tensor_list.append(t)
+            # reference contract: the list gains one entry PER RANK; on the
+            # single controller the shards are replicas of the same value
+            from .group import _get_or_create_world_group
+            n = (group or _get_or_create_world_group()).nranks
+            tensor_list.extend(Tensor(t._data) for _ in range(n))
             return _Task(t)
         return _Task(t)
     out = apply(lambda a: jax.lax.all_gather(a, ax, axis=0, tiled=False), t,
                 name="all_gather")
     if isinstance(tensor_list, list):
-        n = group.nranks
         from ...ops.manipulation import unbind
         tensor_list.extend(unbind(out, axis=0))
         return _Task(t)
@@ -139,9 +145,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     src_idx = group.get_group_rank(src) if src in group.ranks else src
 
     def f(a):
-        # select src's shard on every member of the axis
-        full = jax.lax.all_gather(a, ax, axis=0)
-        return full[src_idx]
+        # masked psum: one O(|a|) all-reduce instead of an O(n|a|)
+        # all_gather+index on every member
+        idx = jax.lax.axis_index(ax)
+        return jax.lax.psum(jnp.where(idx == src_idx, a, jnp.zeros_like(a)),
+                            ax)
     out = apply(f, t, name="broadcast")
     _in_place(t, out)
     return _Task(t)
@@ -199,28 +207,49 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """Point-to-point send. Inside shard_map this is a ppermute shift to
-    `dst` (reference: ProcessGroupNCCL::Send); XLA schedules it on ICI."""
+    """Point-to-point send (reference: ProcessGroupNCCL::Send).
+
+    TPU-native SPMD semantics: every rank executes the same program, so one
+    `send`/`recv` pair IS one `lax.ppermute` ring shift on the group axis.
+    The caller's (rank, dst) fixes the hop count d = dst - rank; the matching
+    `recv(src=rank-d)` consumes the shifted value. Mismatched pairings raise
+    instead of silently mis-routing (r1 built a non-permutation here)."""
     ax = _axis(group)
     t = as_tensor(tensor)
+    me = group.rank if group is not None and group.rank >= 0 else 0
     if ax is None:
-        _P2P_BUF.append(t)
+        _P2P_PENDING.append((t, None, 0))
         return _Task(t)
     n = group.nranks
-    perm = [(i, dst % n) for i in range(n)]
+    d = (dst - me) % n
+    perm = [(i, (i + d) % n) for i in range(n)]
     out = apply(lambda a: jax.lax.ppermute(a, ax, perm), t, name="send")
-    _P2P_BUF.append(out)
+    _P2P_PENDING.append((out, ax, d))
     return _Task(t)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    if _P2P_BUF:
-        val = _P2P_BUF.pop(0)
-        _in_place(tensor, val)
+    if not _P2P_PENDING:
+        raise RuntimeError(
+            "recv() with no pending send(): SPMD P2P requires the matching "
+            "send in the same traced program (one ppermute per pair)")
+    val, ax, d = _P2P_PENDING.pop(0)
+    cur_ax = _axis(group)
+    me = group.rank if group is not None and group.rank >= 0 else 0
+    if cur_ax is not None:
+        n = group.nranks
+        expect = (me - src) % n
+        if ax != cur_ax or d != expect:
+            raise RuntimeError(
+                f"recv(src={src}) on axis {cur_ax!r} (shift {expect}) does "
+                f"not match pending send (axis {ax!r}, shift {d})")
+    _in_place(tensor, val)
     return _Task(tensor)
 
 
-_P2P_BUF: list = []
+# FIFO of in-flight sends within the current traced program:
+# entries (shifted value, axis, hop count)
+_P2P_PENDING: list = []
 
 
 def isend(tensor, dst=0, group=None):

@@ -5,6 +5,13 @@ forward in backward with RNG-state restore (:96); `recompute_sequential`,
 offload variants in recompute_hybrid.py. TPU-native: `jax.checkpoint` (remat)
 is the substrate — the XLA scheduler replays the forward subgraph during the
 backward pass; RNG replay is free because keys are explicit values.
+
+Parameters referenced inside the recomputed function MUST enter the
+`jax.checkpoint` trace as traced inputs, not closed-over constants — else
+their gradients are silently dropped (ADVICE r1, high). The Layer path
+threads `named_parameters()`; the plain-callable path discovers Layers
+captured in the callable's closure/partial/bound-self and threads their
+params the same way (or accepts an explicit `params=` list).
 """
 
 from __future__ import annotations
@@ -19,12 +26,6 @@ from ...autograd.grad_mode import no_grad
 
 __all__ = ["recompute", "recompute_sequential"]
 
-_POLICIES = {
-    "full": None,  # save nothing, recompute all
-    "dots_saveable": "dots_saveable",
-    "nothing_saveable": None,
-}
-
 
 def _policy(name):
     if name in (None, "full", "nothing_saveable"):
@@ -33,8 +34,78 @@ def _policy(name):
     return getattr(adc.checkpoint_policies, name, None)
 
 
+def _collect_layers(obj, seen, out, depth=0):
+    import types
+    from ...nn.layer import Layer
+    from ...core.tensor import Tensor
+    if id(obj) in seen or depth > 4:
+        return
+    seen.add(id(obj))
+    if isinstance(obj, Layer):
+        out.append(obj)
+        return
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        for o in obj:
+            _collect_layers(o, seen, out, depth + 1)
+    elif isinstance(obj, dict):
+        for o in obj.values():
+            _collect_layers(o, seen, out, depth + 1)
+    elif not isinstance(obj, (str, bytes, type, Tensor, types.ModuleType,
+                              types.FunctionType, types.BuiltinFunctionType)):
+        # plain holder objects (e.g. a Trainer with self.model): scan their
+        # instance attributes for Layers
+        attrs = getattr(obj, "__dict__", None)
+        if isinstance(attrs, dict):
+            for o in attrs.values():
+                _collect_layers(o, seen, out, depth + 1)
+
+
+def _discover_params(fn):
+    """Find Layers reachable from a callable (closure cells, functools.partial
+    binding, bound `self`) and return their parameters in a stable order."""
+    seen: set[int] = set()
+    layers: list = []
+    stack = [fn]
+    visited: set[int] = set()
+    while stack:
+        f = stack.pop()
+        if id(f) in visited:
+            continue
+        visited.add(id(f))
+        if isinstance(f, functools.partial):
+            stack.append(f.func)
+            _collect_layers(list(f.args) + list(f.keywords.values()),
+                            seen, layers)
+            continue
+        self_obj = getattr(f, "__self__", None)
+        if self_obj is not None:
+            _collect_layers(self_obj, seen, layers)
+        for dflt in (getattr(f, "__defaults__", None) or ()):
+            _collect_layers(dflt, seen, layers)
+        for dflt in (getattr(f, "__kwdefaults__", None) or {}).values():
+            _collect_layers(dflt, seen, layers)
+        closure = getattr(f, "__closure__", None)
+        if closure:
+            for cell in closure:
+                try:
+                    v = cell.cell_contents
+                except ValueError:
+                    continue
+                if callable(v) and (getattr(v, "__closure__", None) or
+                                    isinstance(v, functools.partial)):
+                    stack.append(v)
+                _collect_layers(v, seen, layers)
+    params, pseen = [], set()
+    for layer in layers:
+        for _, p in layer.named_parameters():
+            if id(p) not in pseen:
+                pseen.add(id(p))
+                params.append(p)
+    return params
+
+
 def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
-              policy=None, **kwargs):
+              policy=None, params=None, **kwargs):
     """`paddle.distributed.fleet.utils.recompute` equivalent: run `function`
     without saving intermediate activations; backward rematerializes."""
     from ...nn.layer import Layer
@@ -42,51 +113,40 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
     statics = {i: a for i, a in enumerate(args) if not isinstance(a, Tensor)}
 
     if isinstance(function, Layer):
-        layer = function
-        params = [p for _, p in layer.named_parameters()]
+        params = [p for _, p in function.named_parameters()]
+    elif params is None:
+        params = _discover_params(function)
 
-        def raw(param_arrays, *xs_arrays):
-            saved = [(p._d, p._node) for p in params]
-            for p, a in zip(params, param_arrays):
-                p._d = a
-                p._node = None
-            try:
-                with no_grad():
-                    rebuilt = []
-                    it = iter(xs_arrays)
-                    for i in range(len(args)):
-                        rebuilt.append(statics[i] if i in statics
-                                       else Tensor(next(it)))
-                    out = layer(*rebuilt, **kwargs)
-                return out._d if isinstance(out, Tensor) else \
-                    tuple(o._d for o in out)
-            finally:
-                for p, (d, n) in zip(params, saved):
-                    p._d = d
-                    p._node = n
+    def raw(param_arrays, *xs_arrays):
+        saved = [(p._d, p._node) for p in params]
+        for p, a in zip(params, param_arrays):
+            p._d = a
+            p._node = None
+        try:
+            with no_grad():
+                rebuilt = []
+                it = iter(xs_arrays)
+                for i in range(len(args)):
+                    rebuilt.append(statics[i] if i in statics
+                                   else Tensor(next(it)))
+                out = function(*rebuilt, **kwargs)
+            return out._d if isinstance(out, Tensor) else \
+                tuple(o._d for o in out)
+        finally:
+            for p, (d, n) in zip(params, saved):
+                p._d = d
+                p._node = n
 
-        ck = jax.checkpoint(raw, policy=_policy(policy))
-        return apply(lambda *arrs: ck(list(arrs[:len(params)]),
-                                      *arrs[len(params):]),
-                     *params, *tensors, name="recompute")
-
-    # plain callable over Tensors
-    def raw_fn(*xs_arrays):
-        with no_grad():
-            rebuilt = []
-            it = iter(xs_arrays)
-            for i in range(len(args)):
-                rebuilt.append(statics[i] if i in statics else Tensor(next(it)))
-            out = function(*rebuilt, **kwargs)
-        return out._d if isinstance(out, Tensor) else \
-            tuple(o._d for o in out)
-
-    ck = jax.checkpoint(raw_fn, policy=_policy(policy))
-    return apply(lambda *arrs: ck(*arrs), *tensors, name="recompute")
+    ck = jax.checkpoint(raw, policy=_policy(policy))
+    return apply(lambda *arrs: ck(list(arrs[:len(params)]),
+                                  *arrs[len(params):]),
+                 *params, *tensors, name="recompute")
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):
-    """Reference: recompute_sequential — chunked recompute over a Sequential."""
+    """Reference: recompute_sequential — chunked recompute over a Sequential.
+    Each chunk goes through the param-threading path (the closure over the
+    chunk's Layers is discovered), so parameter gradients flow."""
     segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
     layers = list(functions)
     per = max(len(layers) // segments, 1)

@@ -81,9 +81,8 @@ class PipelineParallel(MetaParallelBase):
                 f"{len(blocks)} pipelined blocks not divisible by "
                 f"{self.num_stages} stages")
         self._n_blocks = len(blocks)
-        self._head = [pl.run_function[i] for i in range(0, s)]
-        self._tail = [pl.run_function[i]
-                      for i in range(e, len(pl.run_function))]
+        self._head = [pl.run_at(i) for i in range(0, s)]
+        self._tail = [pl.run_at(i) for i in range(e, len(pl.run_function))]
 
         # stack per-position params across blocks -> [L, ...] sharded on 'pp'
         # (functionalize a detached copy: the live blocks lose their params)

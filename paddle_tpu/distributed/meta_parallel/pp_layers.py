@@ -171,17 +171,24 @@ class PipelineLayer(Layer):
                 return s
         return self._num_stages - 1
 
+    def run_at(self, i):
+        """Callable executing position `i`, honoring SharedLayerDesc: a later
+        occurrence of a shared key runs through its `forward_func` (the tied
+        lm-head path, reference pp_layers.py:76)."""
+        layer = self.run_function[i]
+        desc = self._shared_fwd.get(i)
+        if desc is not None and desc.forward_func is not None and \
+                i != self._first_occurrence(desc.layer_name):
+            fwd = desc.forward_func
+            return lambda x: fwd(layer, x)
+        return layer
+
     def forward(self, input, chunk_id=None):
         """Sequential (non-pipelined) execution — correctness reference and
         the eval path."""
         x = input
-        for i, layer in enumerate(self.run_function):
-            if i in self._shared_fwd and self._shared_fwd[i].forward_func is not None and \
-                    list(self._shared.values()).index(layer) >= 0 and \
-                    i != self._first_occurrence(self._shared_fwd[i].layer_name):
-                x = self._shared_fwd[i].forward_func(layer, x)
-            else:
-                x = layer(x)
+        for i in range(len(self.run_function)):
+            x = self.run_at(i)(x)
         return x
 
     def _first_occurrence(self, key):

@@ -1,1 +1,2 @@
 from .gpt import GPT, GPTConfig, gpt2_small, gpt2_tiny  # noqa: F401
+from .gpt_hybrid import gpt_for_pipeline, GPTPretrainLoss  # noqa: F401

@@ -1,0 +1,142 @@
+"""Hybrid-parallel GPT: the 4D (dp x sharding x mp x pp) pretraining recipe.
+
+Reference shape: PaddleNLP-style `GPTForPretrainingPipe` built from
+`PipelineLayer` + the fleet TP layers (reference
+fleet/meta_parallel/parallel_layers/pp_layers.py:237 and
+fleet/layers/mpu/mp_layers.py). TPU-native: the blocks carry GSPMD
+PartitionSpecs (mp) and the compiled ppermute ring (pipeline_parallel.py)
+stacks them over the pp axis; dp/sharding come from batch sharding + ZeRO
+param sharding. The word embedding is tied to the lm head with
+`SharedLayerDesc` — head and tail run outside the pipelined scan, so the
+tied weight lives once and GSPMD keeps it consistent.
+"""
+
+from __future__ import annotations
+
+import math
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+from ..distributed.meta_parallel import (
+    LayerDesc, SharedLayerDesc, PipelineLayer,
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from .gpt import GPTConfig
+
+__all__ = ["GPTEmbeddingPipe", "GPTBlockPipe", "GPTNormPipe",
+           "gpt_for_pipeline", "GPTPretrainLoss"]
+
+
+class GPTEmbeddingPipe(nn.Layer):
+    """Word+position embedding; doubles as the tied lm head via `as_head`."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                          weight_attr=attr)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                                weight_attr=attr)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        return self.wte(input_ids) + self.wpe(pos)
+
+    def as_head(self, x):
+        """Tied lm head: logits = x @ wte.weight^T (vocab sharded on mp)."""
+        return paddle.matmul(x, self.wte.weight, transpose_y=True)
+
+
+class ParallelAttention(nn.Layer):
+    """Causal self-attention with mp-sharded heads (Column qkv / Row proj)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.n_head = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        proj_init = nn.initializer.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
+        self.qkv = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=attr,
+            gather_output=False)
+        self.proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,
+            weight_attr=paddle.framework.ParamAttr(initializer=proj_init))
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.n_head, self.head_dim])
+        q, k, v = paddle.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.proj(out.reshape([b, s, h]))
+
+
+class ParallelMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        proj_init = nn.initializer.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
+        self.fc = ColumnParallelLinear(cfg.hidden_size, cfg.ffn_size,
+                                       weight_attr=attr, gather_output=False)
+        self.proj = RowParallelLinear(
+            cfg.ffn_size, cfg.hidden_size, input_is_parallel=True,
+            weight_attr=paddle.framework.ParamAttr(initializer=proj_init))
+
+    def forward(self, x):
+        return self.proj(F.gelu(self.fc(x), approximate=True))
+
+
+class GPTBlockPipe(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_epsilon)
+        self.attn = ParallelAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_epsilon)
+        self.mlp = ParallelMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTNormPipe(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_epsilon)
+
+    def forward(self, x):
+        return self.ln_f(x)
+
+
+class GPTPretrainLoss(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.vocab_size = cfg.vocab_size
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, self.vocab_size]).cast("float32"),
+            labels.reshape([-1]))
+
+
+def gpt_for_pipeline(cfg: GPTConfig, num_stages=None) -> PipelineLayer:
+    """Build the PipelineLayer GPT with a SharedLayerDesc-tied lm head."""
+    descs = [
+        SharedLayerDesc("embed", GPTEmbeddingPipe, None, "wte", cfg),
+    ]
+    descs += [LayerDesc(GPTBlockPipe, cfg) for _ in range(cfg.num_layers)]
+    descs += [
+        LayerDesc(GPTNormPipe, cfg),
+        SharedLayerDesc("embed", GPTEmbeddingPipe,
+                        lambda layer, x: layer.as_head(x), "wte", cfg),
+    ]
+    return PipelineLayer(layers=descs, num_stages=num_stages,
+                         loss_fn=GPTPretrainLoss(cfg))

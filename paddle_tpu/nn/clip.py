@@ -68,7 +68,9 @@ class ClipGradByGlobalNorm(ClipGradBase):
         scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
         out = []
         for p, g in params_grads:
-            if g is None:
+            if g is None or getattr(p, "need_clip", True) is False:
+                # need_clip=False grads are left untouched (reference
+                # behavior: excluded from the norm AND from the scaling)
                 out.append((p, g))
             else:
                 out.append((p, Tensor(g._data * scale.astype(g._data.dtype))))

@@ -582,6 +582,9 @@ def getitem(x, idx) -> Tensor:
 
 
 def setitem_(x, idx, value) -> Tensor:
+    # safe to record x itself: GradNode snapshots (node, out_index) at record
+    # time, so rebinding x._node below cannot create a self-referential node
+    # or corrupt pre-mutation consumers (see autograd.function.GradNode)
     jidx = _unwrap_index(idx)
     if isinstance(value, Tensor):
         out = apply(lambda a, v: a.at[jidx].set(v.astype(a.dtype)), x, value,

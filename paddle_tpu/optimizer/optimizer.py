@@ -167,12 +167,20 @@ class Optimizer:
                         w._data if isinstance(w, Tensor) else jnp.asarray(w))
         by_name = {p.name: p for p in self._parameter_list}
         for k, v in state_dict.items():
-            for p_name, p in by_name.items():
-                if k.startswith(p_name + "_"):
-                    acc_name = k[len(p_name) + 1:]
-                    arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
-                    self._accumulators[acc_name][id(p)] = Tensor(arr)
-                    break
+            # longest-prefix match: with params 'w' and 'w_1', key
+            # 'w_1_moment1' must bind to 'w_1' (ADVICE r1: arbitrary-order
+            # startswith matching could assign state to the wrong param)
+            best = None
+            for p_name in by_name:
+                if k.startswith(p_name + "_") and \
+                        (best is None or len(p_name) > len(best)):
+                    best = p_name
+            if best is None:
+                continue
+            p = by_name[best]
+            acc_name = k[len(best) + 1:]
+            arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            self._accumulators[acc_name][id(p)] = Tensor(arr)
 
     # -- state tensors for jit lifting -------------------------------------
     def _state_tensors(self) -> list[Tensor]:

@@ -164,3 +164,34 @@ def test_accumulation_across_backwards():
     np.testing.assert_allclose(x.grad.numpy(), [5.0])
     x.clear_grad()
     assert x.grad is None
+
+
+def test_setitem_inplace_grad_flows():
+    """In-place __setitem__ must not break the grad chain (ADVICE r1: the
+    rebound node was self-referential and silently dropped gradients).
+    Reference semantics: zeroed-slot grads, never silent loss."""
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    x[0] = 5.0
+    (x * 3).sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 3.0, 3.0])
+
+
+def test_setitem_tensor_value_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    v = paddle.to_tensor([7.0], stop_gradient=False)
+    x[1:] = v
+    (x * x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0])
+    np.testing.assert_allclose(v.grad.numpy(), [14.0])
+
+
+def test_setitem_premutation_consumers_unaffected():
+    """Values computed BEFORE an in-place mutation keep correct grads: the
+    GradNode snapshots producing nodes at record time, so rebinding x._node
+    cannot reroute y's cotangent through the later setitem."""
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    x[0] = 5.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0, 2.0])

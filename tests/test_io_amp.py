@@ -118,3 +118,65 @@ def test_metric_auc():
     labels = paddle.to_tensor(np.array([[1], [1], [0], [0]]))
     auc.update(preds, labels)
     assert auc.accumulate() == 1.0
+
+
+def test_grad_scaler_no_double_unscale():
+    """scaler.unscale_(opt) -> clip -> scaler.step(opt) must divide grads by
+    the scale exactly once (ADVICE r1 medium)."""
+    import paddle_tpu.nn as nn
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.0, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.ones([2, 4])
+    loss = model(x).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    g1 = model.weight.grad.numpy().copy()
+    scaler.step(opt)  # must NOT unscale again
+    scaler.update()
+    np.testing.assert_allclose(model.weight.grad.numpy(), g1)
+    # explicit second unscale_ before update() raises
+    loss = model(x).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    import pytest
+    with pytest.raises(RuntimeError):
+        scaler.unscale_(opt)
+    scaler.update()
+
+
+def test_optimizer_state_dict_prefix_names():
+    """Param names where one is a prefix of another must round-trip state."""
+    import paddle_tpu.nn as nn
+    w = paddle.create_parameter([4], "float32", name="w")
+    w1 = paddle.create_parameter([6], "float32", name="w_1")
+    opt = paddle.optimizer.Adam(1e-3, parameters=[w, w1])
+    (w.sum() + w1.sum()).backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(1e-3, parameters=[w, w1])
+    opt2.set_state_dict(sd)
+    m1 = opt2._accumulators["moment1"]
+    assert m1[id(w)].shape == [4]
+    assert m1[id(w1)].shape == [6]
+
+
+def test_grad_scaler_per_optimizer_inf_isolation():
+    """An inf in optimizer A's grads must not be masked by a clean
+    unscale_ of optimizer B (per-optimizer found_inf tracking)."""
+    import paddle_tpu.nn as nn
+    m1, m2 = nn.Linear(2, 2), nn.Linear(2, 2)
+    o1 = paddle.optimizer.SGD(1.0, parameters=m1.parameters())
+    o2 = paddle.optimizer.SGD(1.0, parameters=m2.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    x = paddle.ones([1, 2])
+    (scaler.scale(m1(x).sum()) + scaler.scale(m2(x).sum())).backward()
+    m1.weight.grad._data = m1.weight.grad._data * float("inf")
+    w1_before = m1.weight.numpy().copy()
+    scaler.unscale_(o1)   # inf found here
+    scaler.unscale_(o2)   # clean — must not erase o1's inf
+    scaler.step(o1)       # must SKIP the update
+    scaler.step(o2)       # must apply
+    scaler.update()
+    np.testing.assert_allclose(m1.weight.numpy(), w1_before)
+    assert scaler._scale < 2.0  # inf observed -> scale decreased
