@@ -29,6 +29,76 @@ def _force_cpu():
     return force_cpu_backend().devices("cpu")[0]
 
 
+def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
+                      lr=3e-4):
+    """tokens/s + final loss for a jitted train step of `model`."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    opt = paddle.optimizer.AdamW(
+        lr, parameters=model.parameters(), weight_decay=0.1,
+        multi_precision=True)
+    if on_tpu:
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, seq + 1))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(warmup):
+        loss = train_step(x, y)
+    float(loss)  # sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(x, y)
+    final = float(loss)  # device sync
+    dt = time.perf_counter() - t0
+    return batch * seq * steps / dt, final
+
+
+def run_llama_bench(dev):
+    """Llama-family single-chip bench (the north-star model family,
+    BASELINE.md config #3): largest config that fits one chip comfortably."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    # ~310M params: fits v5e HBM with AdamW fp32 states + bf16 compute
+    cfg = LlamaConfig(vocab_size=32000, max_position_embeddings=2048,
+                      hidden_size=1024, num_layers=16, num_heads=16,
+                      num_kv_heads=4, intermediate_size=4096)
+    batch, seq, steps, warmup = 4, 2048, 10, 2
+    paddle.seed(0)
+    model = Llama(cfg)
+    n_params = model.num_params()
+    flops_per_token = model.flops_per_token(seq) * 3
+    tokens_per_s, final = _train_throughput(
+        model, batch, seq, steps, warmup, cfg.vocab_size, on_tpu=True)
+    peak, peak_src = _peak_flops(dev)
+    mfu = tokens_per_s * flops_per_token / peak if peak else 0.0
+    return {
+        "metric": "llama_310m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4) if peak else 0.0,
+        "extra": {
+            "mfu": round(mfu, 4), "loss": round(final, 3), "batch": batch,
+            "seq": seq, "steps": steps, "n_params": n_params,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "dtype": "bf16",
+            "peak_flops": peak, "peak_flops_source": peak_src,
+        },
+    }
+
+
 def run_gpt_bench(dev, on_tpu):
     import numpy as np
     import paddle_tpu as paddle
@@ -164,7 +234,16 @@ def _child_main(mode):
         if mode == "--child-tpu":
             import jax
             dev = jax.devices()[0]
-            result = run_gpt_bench(dev, dev.platform in ("tpu", "axon"))
+            gpt = run_gpt_bench(dev, dev.platform in ("tpu", "axon"))
+            try:
+                # north-star family: primary metric when it runs
+                result = run_llama_bench(dev)
+                result["extra"]["gpt2_124m_tokens_per_s"] = gpt["value"]
+                result["extra"]["gpt2_124m_mfu"] = gpt["extra"]["mfu"]
+            except Exception:
+                gpt.setdefault("extra", {})["llama_bench_error"] = \
+                    traceback.format_exc(limit=4)[:1500]
+                result = gpt
         else:
             dev = _force_cpu()
             result = run_gpt_bench(dev, False)

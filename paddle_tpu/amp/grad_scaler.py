@@ -57,7 +57,8 @@ class GradScaler:
             found = found or bool(jnp.any(~jnp.isfinite(g)))
             p._grad._data = g.astype(p._grad._data.dtype)
         self._found_inf_per[id(optimizer)] = found
-        self._found_inf = any(self._found_inf_per.values())
+        # aggregate is sticky until update() resets it
+        self._found_inf = self._found_inf or found
         self._unscaled.add(id(optimizer))
 
     def step(self, optimizer):
@@ -68,13 +69,22 @@ class GradScaler:
             self.unscale_(optimizer)
         if not self._found_inf_per.get(id(optimizer), False):
             optimizer.step()
+        # this optimizer's scale/inf cycle is complete: drop its marks so the
+        # next iteration unscales fresh grads even if update() is never
+        # called (update() is only required for dynamic scaling); the
+        # aggregate _found_inf survives for update()'s scale adjustment
+        self._found_inf = self._found_inf or \
+            self._found_inf_per.pop(id(optimizer), False)
+        self._unscaled.discard(id(optimizer))
 
     def update(self):
         self._unscaled.clear()
-        self._found_inf = self._found_inf or any(self._found_inf_per.values())
+        found = self._found_inf or any(self._found_inf_per.values())
         self._found_inf_per.clear()
+        self._found_inf = False
         if not self._enable or not self._use_dynamic:
             return
+        self._found_inf = found
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0

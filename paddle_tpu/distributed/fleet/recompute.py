@@ -34,37 +34,43 @@ def _policy(name):
     return getattr(adc.checkpoint_policies, name, None)
 
 
-def _collect_layers(obj, seen, out, depth=0):
+def _collect_params(obj, seen, out, depth=0):
+    """Append Parameters reachable from obj (Layers, bare Parameters,
+    containers, plain holder objects) to `out`."""
     import types
     from ...nn.layer import Layer
-    from ...core.tensor import Tensor
+    from ...core.tensor import Tensor, Parameter
     if id(obj) in seen or depth > 4:
         return
     seen.add(id(obj))
     if isinstance(obj, Layer):
+        out.extend(p for _, p in obj.named_parameters())
+        return
+    if isinstance(obj, Parameter):
         out.append(obj)
         return
     if isinstance(obj, (list, tuple, set, frozenset)):
         for o in obj:
-            _collect_layers(o, seen, out, depth + 1)
+            _collect_params(o, seen, out, depth + 1)
     elif isinstance(obj, dict):
         for o in obj.values():
-            _collect_layers(o, seen, out, depth + 1)
+            _collect_params(o, seen, out, depth + 1)
     elif not isinstance(obj, (str, bytes, type, Tensor, types.ModuleType,
                               types.FunctionType, types.BuiltinFunctionType)):
         # plain holder objects (e.g. a Trainer with self.model): scan their
-        # instance attributes for Layers
+        # instance attributes
         attrs = getattr(obj, "__dict__", None)
         if isinstance(attrs, dict):
             for o in attrs.values():
-                _collect_layers(o, seen, out, depth + 1)
+                _collect_params(o, seen, out, depth + 1)
 
 
 def _discover_params(fn):
-    """Find Layers reachable from a callable (closure cells, functools.partial
-    binding, bound `self`) and return their parameters in a stable order."""
+    """Find Parameters reachable from a callable — closure cells,
+    functools.partial bindings, bound `self`, argument defaults, and
+    module-level globals the code object names — in a stable order."""
     seen: set[int] = set()
-    layers: list = []
+    found: list = []
     stack = [fn]
     visited: set[int] = set()
     while stack:
@@ -74,16 +80,23 @@ def _discover_params(fn):
         visited.add(id(f))
         if isinstance(f, functools.partial):
             stack.append(f.func)
-            _collect_layers(list(f.args) + list(f.keywords.values()),
-                            seen, layers)
+            _collect_params(list(f.args) + list(f.keywords.values()),
+                            seen, found)
             continue
         self_obj = getattr(f, "__self__", None)
         if self_obj is not None:
-            _collect_layers(self_obj, seen, layers)
+            _collect_params(self_obj, seen, found)
+            f = getattr(f, "__func__", f)
         for dflt in (getattr(f, "__defaults__", None) or ()):
-            _collect_layers(dflt, seen, layers)
+            _collect_params(dflt, seen, found)
         for dflt in (getattr(f, "__kwdefaults__", None) or {}).values():
-            _collect_layers(dflt, seen, layers)
+            _collect_params(dflt, seen, found)
+        code = getattr(f, "__code__", None)
+        gl = getattr(f, "__globals__", None)
+        if code is not None and gl is not None:
+            for name in code.co_names:
+                if name in gl:
+                    _collect_params(gl[name], seen, found)
         closure = getattr(f, "__closure__", None)
         if closure:
             for cell in closure:
@@ -94,35 +107,37 @@ def _discover_params(fn):
                 if callable(v) and (getattr(v, "__closure__", None) or
                                     isinstance(v, functools.partial)):
                     stack.append(v)
-                _collect_layers(v, seen, layers)
+                _collect_params(v, seen, found)
     params, pseen = [], set()
-    for layer in layers:
-        for _, p in layer.named_parameters():
-            if id(p) not in pseen:
-                pseen.add(id(p))
-                params.append(p)
+    for p in found:
+        if id(p) not in pseen:
+            pseen.add(id(p))
+            params.append(p)
     return params
 
 
 def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
-              policy=None, params=None, **kwargs):
+              policy=None, recompute_params=None, **kwargs):
     """`paddle.distributed.fleet.utils.recompute` equivalent: run `function`
-    without saving intermediate activations; backward rematerializes."""
+    without saving intermediate activations; backward rematerializes.
+
+    `recompute_params` explicitly lists the Parameters to thread into the
+    checkpoint trace (named to avoid colliding with a user function's own
+    `params` kwarg, which passes through **kwargs untouched)."""
     from ...nn.layer import Layer
+    from ...nn.utils import bind_param_arrays
     tensors = [a for a in args if isinstance(a, Tensor)]
     statics = {i: a for i, a in enumerate(args) if not isinstance(a, Tensor)}
 
     if isinstance(function, Layer):
         params = [p for _, p in function.named_parameters()]
-    elif params is None:
+    elif recompute_params is not None:
+        params = list(recompute_params)
+    else:
         params = _discover_params(function)
 
     def raw(param_arrays, *xs_arrays):
-        saved = [(p._d, p._node) for p in params]
-        for p, a in zip(params, param_arrays):
-            p._d = a
-            p._node = None
-        try:
+        with bind_param_arrays(params, param_arrays):
             with no_grad():
                 rebuilt = []
                 it = iter(xs_arrays)
@@ -132,10 +147,6 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
                 out = function(*rebuilt, **kwargs)
             return out._d if isinstance(out, Tensor) else \
                 tuple(o._d for o in out)
-        finally:
-            for p, (d, n) in zip(params, saved):
-                p._d = d
-                p._node = n
 
     ck = jax.checkpoint(raw, policy=_policy(policy))
     return apply(lambda *arrs: ck(list(arrs[:len(params)]),

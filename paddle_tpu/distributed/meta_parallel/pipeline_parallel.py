@@ -42,22 +42,15 @@ __all__ = ["PipelineParallel"]
 
 def _functionalize(template: Layer):
     """(ordered params, fn(param_arrays, x_arr) -> out_arr) for one block."""
+    from ...nn.utils import bind_param_arrays
     names_params = list(template.named_parameters())
     params = [p for _, p in names_params]
 
     def block_fn(param_arrays, h):
-        saved = [(p._d, p._node) for p in params]
-        for p, a in zip(params, param_arrays):
-            p._d = a
-            p._node = None
-        try:
+        with bind_param_arrays(params, param_arrays):
             with no_grad():
                 out = template(Tensor(h))
             return out._d
-        finally:
-            for p, (d, n) in zip(params, saved):
-                p._d = d
-                p._node = n
 
     return [n for n, _ in names_params], params, block_fn
 
@@ -76,15 +69,34 @@ class PipelineParallel(MetaParallelBase):
         pl: PipelineLayer = self._layers
         s, e = pl._block_range
         blocks = pl.block_layers
-        if self.num_stages > 1 and len(blocks) % self.num_stages:
+        self._n_virtual = max(getattr(pl, "_num_virtual", 1), 1)
+        if self.num_stages > 1 and \
+                len(blocks) % (self.num_stages * self._n_virtual):
             raise ValueError(
                 f"{len(blocks)} pipelined blocks not divisible by "
-                f"{self.num_stages} stages")
+                f"{self.num_stages} stages x {self._n_virtual} virtual")
         self._n_blocks = len(blocks)
         self._head = [pl.run_at(i) for i in range(0, s)]
         self._tail = [pl.run_at(i) for i in range(e, len(pl.run_function))]
 
-        # stack per-position params across blocks -> [L, ...] sharded on 'pp'
+        # Stack per-position params across blocks -> [L, ...] sharded on 'pp'.
+        # Interleaved VPP (reference pipeline_parallel.py:875): stage s owns
+        # chunk c = blocks [c*S*n + s*n, +n) for each virtual chunk c, so the
+        # stack is permuted stage-major/chunk-minor — the contiguous pp shard
+        # of the permuted stack is exactly stage s's v chunks.
+        S, v = max(self.num_stages, 1), self._n_virtual
+        n_chunk = self._n_blocks // (S * v)
+        order = []
+        for st in range(S):
+            for c in range(v):
+                start = c * S * n_chunk + st * n_chunk
+                order.extend(range(start, start + n_chunk))
+        self._stack_order = order
+        inv = [0] * len(order)
+        for pos, idx in enumerate(order):
+            inv[idx] = pos
+        self._stack_order_inv = inv
+
         # (functionalize a detached copy: the live blocks lose their params)
         import copy
         template = copy.deepcopy(blocks[0])
@@ -93,8 +105,8 @@ class PipelineParallel(MetaParallelBase):
         self._stacked: list[Parameter] = []
         for j, name in enumerate(self._param_names):
             per_layer = []
-            for blk in blocks:
-                p = dict(blk.named_parameters())[name]
+            for bi in order:
+                p = dict(blocks[bi].named_parameters())[name]
                 per_layer.append(p._d)
             stacked = Parameter(jnp.stack(per_layer, axis=0),
                                 name=f"pipeline_blocks.{name}")
@@ -120,12 +132,13 @@ class PipelineParallel(MetaParallelBase):
     # -- compiled ring schedule --------------------------------------------
     def _build_pipeline_fn(self):
         S = max(self.num_stages, 1)
+        v = self._n_virtual
         block_fn = self._block_fn
         if self._recompute:
             block_fn_inner = block_fn
             block_fn = jax.checkpoint(
                 lambda pa, h: block_fn_inner(pa, h))
-        n_local = self._n_blocks // S
+        n_chunk = self._n_blocks // (S * v)
 
         def local_stack(stacked_local, h):
             def one(carry, layer_params):
@@ -133,9 +146,8 @@ class PipelineParallel(MetaParallelBase):
             h, _ = jax.lax.scan(one, h, stacked_local)
             return h
 
-        def body(x_micro, *stacked_local):
-            # x_micro: [M, mb, ...] (replicated w.r.t. pp)
-            # stacked_local: each [n_local, ...] — this stage's layer shard
+        def ring(x_micro, chunk_params):
+            # one fill-drain ring pass: x_micro [M, mb, ...] -> [M, mb, ...]
             M = x_micro.shape[0]
             T = M + S - 1
             idx = jax.lax.axis_index("pp")
@@ -148,7 +160,7 @@ class PipelineParallel(MetaParallelBase):
                 mb = jax.lax.dynamic_index_in_dim(
                     x_micro, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
                 inp = jnp.where(idx == 0, mb, buf)
-                h = local_stack(stacked_local, inp)
+                h = local_stack(chunk_params, inp)
                 # last stage writes its result for microbatch t-(S-1)
                 oi = jnp.clip(t - (S - 1), 0, M - 1)
                 valid = (t >= S - 1) & (idx == S - 1)
@@ -164,6 +176,17 @@ class PipelineParallel(MetaParallelBase):
             contrib = jnp.where(idx == S - 1, out_buf,
                                 jnp.zeros_like(out_buf))
             return jax.lax.psum(contrib, "pp")
+
+        def body(x_micro, *stacked_local):
+            # stacked_local: each [v*n_chunk, ...] — this stage's v chunks
+            # (chunk-major); chunk c rides one full ring pass, its drained
+            # output feeding chunk c+1 — the compiled analog of interleaved
+            # virtual stages (same per-device memory, v rings).
+            for c in range(v):
+                chunk = [p[c * n_chunk:(c + 1) * n_chunk]
+                         for p in stacked_local]
+                x_micro = ring(x_micro, chunk)
+            return x_micro
 
         return body
 
@@ -186,8 +209,16 @@ class PipelineParallel(MetaParallelBase):
 
         if mesh is None or self.num_stages <= 1 or "pp" not in mesh.axis_names:
             # no pp: run blocks sequentially over the stacked params
-            return apply(lambda a, *ps: _scan_tuple(self._block_fn, a, ps),
-                         h, *self._stacked, name="pipeline_seq")
+            # (un-permute the interleaved stack back to execution order)
+            inv = self._stack_order_inv
+            identity = inv == sorted(inv)
+            inv_arr = None if identity else jnp.asarray(inv)
+
+            def seq(a, *ps):
+                if inv_arr is not None:
+                    ps = tuple(p[inv_arr] for p in ps)
+                return _scan_tuple(self._block_fn, a, ps)
+            return apply(seq, h, *self._stacked, name="pipeline_seq")
 
         body = self._pipeline_jfn
         in_specs = tuple([P()] + [P("pp")] * len(self._stacked))

@@ -98,6 +98,7 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._recompute_interval = recompute_interval
         self._topology = topology
+        self._num_virtual = int(num_virtual_pipeline_stages or 1)
         if num_stages is None:
             from ..topology import get_hybrid_communicate_group
             hcg = get_hybrid_communicate_group()

@@ -81,8 +81,16 @@ def sharded_call(fn, mesh: Mesh | None, in_specs, out_specs, axis_names=None):
         tuple(mesh.axis_names)
 
     def wrapped(*args):
-        with _axis_scope(axis_names):
-            return fn(*args)
+        # P2P send/recv pairs rendezvous through a FIFO scoped to one traced
+        # program: clear on entry AND exit so a failed trace (or a send whose
+        # recv never ran) cannot poison a later unrelated program
+        from .communication import _P2P_PENDING
+        _P2P_PENDING.clear()
+        try:
+            with _axis_scope(axis_names):
+                return fn(*args)
+        finally:
+            _P2P_PENDING.clear()
 
     smapped = jax.shard_map(wrapped, mesh=mesh, in_specs=in_specs,
                             out_specs=out_specs,

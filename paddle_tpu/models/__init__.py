@@ -1,2 +1,4 @@
 from .gpt import GPT, GPTConfig, gpt2_small, gpt2_tiny  # noqa: F401
 from .gpt_hybrid import gpt_for_pipeline, GPTPretrainLoss  # noqa: F401
+from .llama import (Llama, LlamaConfig, llama_tiny, llama3_8b,  # noqa: F401
+                    llama_for_pipeline)

@@ -1,0 +1,316 @@
+"""Llama-3 style decoder-only LM (BASELINE.md config #3 — the north star).
+
+RMSNorm + rotary embeddings + SwiGLU MLP + grouped-query attention, written
+against the framework's public surface (reference shape: PaddleNLP llm/
+llama recipes driven through fleet; model math is the published Llama
+architecture). The hybrid variant (`llama_for_pipeline`) composes the same
+blocks from TP layers inside a PipelineLayer for the 4D dp/sharding/mp/pp
+recipe, mirroring models/gpt_hybrid.py.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+from ..distributed.meta_parallel import (
+    LayerDesc, SharedLayerDesc, PipelineLayer,
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+__all__ = ["LlamaConfig", "Llama", "llama_tiny", "llama3_8b",
+           "llama_for_pipeline"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    max_position_embeddings: int = 8192
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8          # GQA
+    intermediate_size: int = 14336
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _rope_tables(cfg: LlamaConfig, seq_len: int, dtype="float32"):
+    """cos/sin [1, S, 1, head_dim] for rotate-half RoPE."""
+    d = cfg.head_dim
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(0, d, 2, dtype=np.float64) / d))
+    ang = np.outer(np.arange(seq_len, dtype=np.float64), inv)  # [S, d/2]
+    cos = np.concatenate([np.cos(ang), np.cos(ang)], axis=-1)
+    sin = np.concatenate([np.sin(ang), np.sin(ang)], axis=-1)
+    shape = (1, seq_len, 1, d)
+    return (paddle.to_tensor(cos.reshape(shape).astype(dtype)),
+            paddle.to_tensor(sin.reshape(shape).astype(dtype)))
+
+
+def _repeat_kv(x, n_rep: int):
+    """[B, S, KV, D] -> [B, S, KV*n_rep, D] (GQA head expansion)."""
+    if n_rep == 1:
+        return x
+    b, s, kv, d = x.shape
+    return (x.unsqueeze(3)
+             .expand([b, s, kv, n_rep, d])
+             .reshape([b, s, kv * n_rep, d]))
+
+
+class LlamaAttention(nn.Layer):
+    """GQA attention; `parallel=True` shards heads over mp via Column/Row."""
+
+    def __init__(self, cfg: LlamaConfig, parallel: bool = False):
+        super().__init__()
+        self.cfg = cfg
+        self.n_head = cfg.num_heads
+        self.n_kv = cfg.num_kv_heads
+        self.head_dim = cfg.head_dim
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        o_init = nn.initializer.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
+        o_attr = paddle.framework.ParamAttr(initializer=o_init)
+        q_out = cfg.num_heads * cfg.head_dim
+        kv_out = cfg.num_kv_heads * cfg.head_dim
+        if parallel:
+            self.q_proj = ColumnParallelLinear(cfg.hidden_size, q_out,
+                                               weight_attr=attr,
+                                               has_bias=False,
+                                               gather_output=False)
+            self.k_proj = ColumnParallelLinear(cfg.hidden_size, kv_out,
+                                               weight_attr=attr,
+                                               has_bias=False,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(cfg.hidden_size, kv_out,
+                                               weight_attr=attr,
+                                               has_bias=False,
+                                               gather_output=False)
+            self.o_proj = RowParallelLinear(q_out, cfg.hidden_size,
+                                            weight_attr=o_attr, has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = nn.Linear(cfg.hidden_size, q_out, weight_attr=attr,
+                                    bias_attr=False)
+            self.k_proj = nn.Linear(cfg.hidden_size, kv_out, weight_attr=attr,
+                                    bias_attr=False)
+            self.v_proj = nn.Linear(cfg.hidden_size, kv_out, weight_attr=attr,
+                                    bias_attr=False)
+            self.o_proj = nn.Linear(q_out, cfg.hidden_size, weight_attr=o_attr,
+                                    bias_attr=False)
+
+    def forward(self, x, cos, sin):
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape([b, s, self.n_head, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.n_kv, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.n_kv, self.head_dim])
+        q, k = F.rope(q, k, sin, cos)
+        k = _repeat_kv(k, self.n_head // self.n_kv)
+        v = _repeat_kv(v, self.n_head // self.n_kv)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.o_proj(out.reshape([b, s, self.n_head * self.head_dim]))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig, parallel: bool = False):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        d_init = nn.initializer.Normal(
+            0.0, cfg.initializer_range / math.sqrt(2 * cfg.num_layers))
+        d_attr = paddle.framework.ParamAttr(initializer=d_init)
+        h, m = cfg.hidden_size, cfg.intermediate_size
+        if parallel:
+            self.gate_proj = ColumnParallelLinear(h, m, weight_attr=attr,
+                                                  has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, m, weight_attr=attr,
+                                                has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(m, h, weight_attr=d_attr,
+                                               has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = nn.Linear(h, m, weight_attr=attr, bias_attr=False)
+            self.up_proj = nn.Linear(h, m, weight_attr=attr, bias_attr=False)
+            self.down_proj = nn.Linear(m, h, weight_attr=d_attr,
+                                       bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(paddle.swiglu(self.gate_proj(x),
+                                            self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig, parallel: bool = False):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg, parallel=parallel)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg, parallel=parallel)
+
+    def forward(self, x, cos, sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class Llama(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                         weight_attr=attr)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(cfg) for _ in range(cfg.num_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     weight_attr=attr, bias_attr=False)
+        self._rope_cache: dict[int, tuple] = {}
+
+    def _rope(self, s):
+        if s not in self._rope_cache:
+            self._rope_cache[s] = _rope_tables(self.cfg, s)
+        return self._rope_cache[s]
+
+    def forward(self, input_ids, labels=None):
+        b, s = input_ids.shape
+        cos, sin = self._rope(s)
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, cos, sin)
+        x = self.norm(x)
+        if self.cfg.tie_word_embeddings:
+            logits = paddle.matmul(x, self.embed_tokens.weight,
+                                   transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.cfg.vocab_size]).cast("float32"),
+                labels.reshape([-1]))
+            return logits, loss
+        return logits
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """6N + causal attention correction (BASELINE.md rule)."""
+        n = self.num_params()
+        l, h = self.cfg.num_layers, self.cfg.hidden_size
+        return 6.0 * n + 12.0 * l * h * seq_len / 2
+
+
+# -- hybrid 4D pipeline variant (mirrors gpt_hybrid.py) ---------------------
+
+class LlamaEmbeddingPipe(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        self.embed_tokens = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=attr)
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+    def as_head(self, x):
+        return paddle.matmul(x, self.embed_tokens.weight, transpose_y=True)
+
+
+class LlamaBlockPipe(nn.Layer):
+    """Decoder layer with the rope tables computed in-block (pipeline blocks
+    are single-input homogeneous stages; tables are cheap closed-form)."""
+
+    def __init__(self, cfg: LlamaConfig, seq_len: int):
+        super().__init__()
+        self.block = LlamaDecoderLayer(cfg, parallel=True)
+        cos, sin = _rope_tables(cfg, seq_len)
+        # constants, not parameters: registered as buffers so stacking skips
+        self._cos_np = cos.numpy()
+        self._sin_np = sin.numpy()
+
+    def forward(self, x):
+        cos = paddle.to_tensor(self._cos_np)
+        sin = paddle.to_tensor(self._sin_np)
+        return self.block(x, cos, sin)
+
+
+class LlamaHeadPipe(nn.Layer):
+    """Final norm + untied lm head (Llama-3 does not tie embeddings)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = paddle.framework.ParamAttr(initializer=init)
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.lm_head = ColumnParallelLinear(
+            cfg.hidden_size, cfg.vocab_size, weight_attr=attr, has_bias=False,
+            gather_output=True)
+
+    def forward(self, x):
+        return self.lm_head(self.norm(x))
+
+
+# same next-token CE as GPT: one implementation, shared
+from .gpt_hybrid import GPTPretrainLoss as LlamaPretrainLoss  # noqa: E402
+
+
+def llama_for_pipeline(cfg: LlamaConfig, seq_len: int,
+                       num_stages=None) -> PipelineLayer:
+    """PipelineLayer Llama for the 4D recipe. With tie_word_embeddings the
+    embedding reappears at the tail as a SharedLayerDesc head."""
+    descs = []
+    if cfg.tie_word_embeddings:
+        descs.append(SharedLayerDesc("embed", LlamaEmbeddingPipe, None,
+                                     "embed_tokens", cfg))
+    else:
+        descs.append(LayerDesc(LlamaEmbeddingPipe, cfg))
+    descs += [LayerDesc(LlamaBlockPipe, cfg, seq_len)
+              for _ in range(cfg.num_layers)]
+    if cfg.tie_word_embeddings:
+        descs.append(LayerDesc(LlamaNormPipe, cfg))
+        descs.append(SharedLayerDesc("embed", LlamaEmbeddingPipe,
+                                     lambda layer, x: layer.as_head(x),
+                                     "embed_tokens", cfg))
+    else:
+        descs.append(LayerDesc(LlamaHeadPipe, cfg))
+    return PipelineLayer(layers=descs, num_stages=num_stages,
+                         loss_fn=LlamaPretrainLoss(cfg))
+
+
+class LlamaNormPipe(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+
+    def forward(self, x):
+        return self.norm(x)
+
+
+def llama_tiny(**kw) -> Llama:
+    cfg = dict(vocab_size=512, max_position_embeddings=128, hidden_size=64,
+               num_layers=2, num_heads=4, num_kv_heads=2,
+               intermediate_size=128)
+    cfg.update(kw)
+    return Llama(LlamaConfig(**cfg))
+
+
+def llama3_8b(**kw) -> Llama:
+    return Llama(LlamaConfig(**kw))

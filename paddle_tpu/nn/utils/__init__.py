@@ -2,13 +2,35 @@
 
 from __future__ import annotations
 
+import contextlib
+
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
 
 __all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
            "vector_to_parameters", "weight_norm", "remove_weight_norm",
-           "spectral_norm"]
+           "spectral_norm", "bind_param_arrays"]
+
+
+@contextlib.contextmanager
+def bind_param_arrays(params, arrays):
+    """Temporarily rebind each Parameter's storage to the given (usually
+    traced) array, restoring the originals on exit. This is THE idiom for
+    functionalizing framework modules into pure jax functions (used by the
+    compiled pipeline, recompute, and the driver entry points) — a missed
+    restore corrupts live params for the rest of the process, so every
+    caller goes through this one context manager."""
+    saved = [(p._d, p._node) for p in params]
+    try:
+        for p, a in zip(params, arrays):
+            p._d = a
+            p._node = None
+        yield
+    finally:
+        for p, (d, n) in zip(params, saved):
+            p._d = d
+            p._node = n
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,

@@ -180,3 +180,22 @@ def test_grad_scaler_per_optimizer_inf_isolation():
     scaler.update()
     np.testing.assert_allclose(m1.weight.numpy(), w1_before)
     assert scaler._scale < 2.0  # inf observed -> scale decreased
+
+
+def test_grad_scaler_loop_without_update():
+    """A loop of scale->backward->step without update() must unscale every
+    iteration (static-scale users never call update())."""
+    import paddle_tpu.nn as nn
+    model = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(0.0, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=256.0,
+                                   use_dynamic_loss_scaling=False)
+    x = paddle.ones([1, 2])
+    grads = []
+    for _ in range(3):
+        scaler.scale(model(x).sum()).backward()
+        scaler.step(opt)
+        grads.append(model.weight.grad.numpy().copy())
+        opt.clear_grad()
+    np.testing.assert_allclose(grads[0], grads[1])
+    np.testing.assert_allclose(grads[1], grads[2])
