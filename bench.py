@@ -62,7 +62,22 @@ def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
         loss = train_step(x, y)
     final = float(loss)  # device sync
     dt = time.perf_counter() - t0
-    return batch * seq * steps / dt, final
+
+    # step-time breakdown (BASELINE.md: compute vs host split): host time is
+    # the non-blocking dispatch cost; the rest of the step is device time.
+    # Single-chip, so the comm share is zero by construction.
+    t1 = time.perf_counter()
+    loss = train_step(x, y)  # enqueue only
+    host_s = time.perf_counter() - t1
+    float(loss)  # drain
+    step_s = dt / steps
+    breakdown = {
+        "step_ms": round(step_s * 1e3, 2),
+        "host_dispatch_ms": round(host_s * 1e3, 2),
+        "device_ms": round(max(step_s - host_s, 0.0) * 1e3, 2),
+        "comm_ms": 0.0,
+    }
+    return batch * seq * steps / dt, final, breakdown
 
 
 def run_llama_bench(dev):
@@ -80,7 +95,7 @@ def run_llama_bench(dev):
     model = Llama(cfg)
     n_params = model.num_params()
     flops_per_token = model.flops_per_token(seq) * 3
-    tokens_per_s, final = _train_throughput(
+    tokens_per_s, final, breakdown = _train_throughput(
         model, batch, seq, steps, warmup, cfg.vocab_size, on_tpu=True)
     peak, peak_src = _peak_flops(dev)
     mfu = tokens_per_s * flops_per_token / peak if peak else 0.0
@@ -93,7 +108,7 @@ def run_llama_bench(dev):
             "mfu": round(mfu, 4), "loss": round(final, 3), "batch": batch,
             "seq": seq, "steps": steps, "n_params": n_params,
             "device": str(getattr(dev, "device_kind", dev.platform)),
-            "dtype": "bf16",
+            "dtype": "bf16", "step_breakdown": breakdown,
             "peak_flops": peak, "peak_flops_source": peak_src,
         },
     }
@@ -115,42 +130,12 @@ def run_gpt_bench(dev, on_tpu):
 
     paddle.seed(0)
     model = GPT(cfg)
-    opt = paddle.optimizer.AdamW(
-        3e-4, parameters=model.parameters(), weight_decay=0.1,
-        multi_precision=True)
-    if on_tpu:
-        model, opt = paddle.amp.decorate(model, opt, level="O2",
-                                         dtype="bfloat16")
-
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
-    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
-    y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
-
-    @paddle.jit.to_static
-    def train_step(x, y):
-        _, loss = model(x, labels=y)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
-
-    for _ in range(warmup):
-        loss = train_step(x, y)
-    float(loss)  # sync
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = train_step(x, y)
-    final = float(loss)  # device sync
-    dt = time.perf_counter() - t0
-
-    tokens_per_s = batch * seq * steps / dt
     flops_per_token = model.flops_per_token(seq) * 3  # fwd + bwd(2x)
-    achieved = tokens_per_s * flops_per_token
+    tokens_per_s, final, breakdown = _train_throughput(
+        model, batch, seq, steps, warmup, cfg.vocab_size, on_tpu)
 
     peak, peak_src = _peak_flops(dev)
-    mfu = achieved / peak if peak else 0.0
+    mfu = tokens_per_s * flops_per_token / peak if peak else 0.0
     return {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip" if on_tpu
         else "gpt2_cpu_smoke_tokens_per_sec",
@@ -162,6 +147,7 @@ def run_gpt_bench(dev, on_tpu):
             "seq": seq, "steps": steps,
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "dtype": "bf16" if on_tpu else "f32",
+            "step_breakdown": breakdown,
             "peak_flops": peak, "peak_flops_source": peak_src,
         },
     }

@@ -53,9 +53,15 @@ def collect_operator_stats():
         disable_operator_stats_collection()
 
 
+# set by paddle_tpu.profiler.Profiler.start() to receive op dispatch names
+_PROFILER_OP_HOOK = None
+
+
 def record_op(name: str):
     if _op_stats is not None:
         _op_stats[name] += 1
+    if _PROFILER_OP_HOOK is not None:
+        _PROFILER_OP_HOOK(name)
 
 
 class TensorCheckerConfig:

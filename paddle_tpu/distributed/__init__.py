@@ -37,3 +37,5 @@ from . import sharding  # noqa: F401
 def get_mesh_or_none():
     from .topology import get_mesh as _g
     return _g()
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401

@@ -1,0 +1,201 @@
+"""Distributed (sharded) checkpointing v2.
+
+Reference: python/paddle/distributed/auto_parallel/static/dist_saver.py:53
+(per-rank save with dist attrs) + converter.py (reshard between parallel
+configs on load). TPU-native realization: one file PER UNIQUE SHARD of each
+jax.Array (replicas deduplicated by shard index), a JSON metadata manifest
+describing shapes/dtypes/shard indices, optional async commit on a
+background thread, and reshard-on-load — the loaded tensor takes whatever
+sharding the LIVE destination tensor carries on the CURRENT mesh, so a
+checkpoint written under dp8 restores cleanly under mp4 x dp2.
+
+Surface: `save_state_dict` / `load_state_dict` (the reference's new dist
+checkpoint API shape), plus `async_save` kwarg.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from ..topology import get_mesh
+
+__all__ = ["save_state_dict", "load_state_dict", "wait_all_saves"]
+
+_META = "metadata.json"
+_PENDING: list[threading.Thread] = []
+
+
+def _flatten(obj, prefix=""):
+    """Flatten nested dict/list state into {key: leaf}."""
+    out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+    else:
+        out[prefix[:-1]] = obj
+    return out
+
+
+def _index_to_json(index, shape):
+    """jax shard index (tuple of slices) -> [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _unique_shards(arr):
+    """Deduplicate replicated shards: one (index, data) per distinct index."""
+    seen = {}
+    for sh in arr.addressable_shards:
+        key = tuple(_index_to_json(sh.index, arr.shape)[i][0]
+                    for i in range(arr.ndim)) if arr.ndim else ()
+        if key not in seen:
+            seen[key] = sh
+    return list(seen.values())
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    async_save: bool = False):
+    """Write a sharded checkpoint directory at `path`."""
+    flat = _flatten(state_dict)
+    os.makedirs(os.path.join(path, "data"), exist_ok=True)
+    meta = {"tensors": {}, "objects": {}}
+    writes = []  # (file path, numpy array) — copied to host synchronously
+
+    for key, leaf in flat.items():
+        safe = key.replace("/", ".")
+        if isinstance(leaf, Tensor):
+            arr = leaf._d
+            entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                     "shards": []}
+            if isinstance(getattr(arr, "sharding", None), NamedSharding) and \
+                    not arr.is_fully_replicated:
+                for i, sh in enumerate(_unique_shards(arr)):
+                    fname = f"{safe}.shard{i}.npy"
+                    entry["shards"].append(
+                        {"file": fname,
+                         "index": _index_to_json(sh.index, arr.shape)})
+                    writes.append((os.path.join(path, "data", fname),
+                                   np.asarray(sh.data)))
+            else:
+                fname = f"{safe}.full.npy"
+                entry["shards"].append({"file": fname, "index": None})
+                writes.append((os.path.join(path, "data", fname),
+                               np.asarray(arr)))
+            meta["tensors"][key] = entry
+        else:
+            meta["objects"][key] = _obj_token(leaf, path, safe)
+
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump(meta, f)
+
+    def commit():
+        for fpath, host_arr in writes:
+            tmp = fpath + ".tmp"
+            with open(tmp, "wb") as fh:
+                np.save(fh, host_arr)
+            os.replace(tmp, fpath)
+        # commit marker: readers treat the checkpoint as complete only when
+        # present (async writers may still be mid-flight otherwise)
+        with open(os.path.join(path, ".complete"), "w") as fh:
+            fh.write("ok")
+
+    if async_save:
+        th = threading.Thread(target=commit, daemon=True)
+        th.start()
+        _PENDING.append(th)
+        return th
+    commit()
+    return None
+
+
+def _obj_token(leaf, path, safe):
+    """Non-tensor leaves: JSON-able stored inline, else pickled sidecar."""
+    try:
+        json.dumps(leaf)
+        return {"inline": leaf}
+    except (TypeError, ValueError):
+        fname = f"{safe}.pkl"
+        with open(os.path.join(path, "data", fname), "wb") as f:
+            pickle.dump(leaf, f)
+        return {"pickle": fname}
+
+
+def wait_all_saves():
+    """Block until every async save has committed."""
+    while _PENDING:
+        _PENDING.pop().join()
+
+
+def _assemble(path, entry) -> np.ndarray:
+    """Rebuild the full host array from its shard files."""
+    shape = tuple(entry["shape"])
+    first = entry["shards"][0]
+    if first["index"] is None:
+        return np.load(os.path.join(path, "data", first["file"]))
+    full = None
+    for sh in entry["shards"]:
+        data = np.load(os.path.join(path, "data", sh["file"]))
+        if full is None:
+            full = np.zeros(shape, dtype=data.dtype)
+        sl = tuple(slice(a, b) for a, b in sh["index"])
+        full[sl] = data
+    return full
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0):
+    """Fill `state_dict`'s tensors in place from the checkpoint at `path`,
+    resharding each tensor onto ITS current sharding spec / mesh (the
+    converter.py behavior: a dp8 checkpoint loads under mp4 x dp2)."""
+    if not os.path.exists(os.path.join(path, ".complete")):
+        wait_all_saves()  # an async save may still be committing
+    if not os.path.exists(os.path.join(path, ".complete")):
+        raise FileNotFoundError(
+            f"checkpoint at {path!r} has no .complete marker (partial or "
+            "missing write)")
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    flat = _flatten(state_dict)
+    mesh = get_mesh()
+    missing = []
+    for key, leaf in flat.items():
+        if isinstance(leaf, Tensor):
+            entry = meta["tensors"].get(key)
+            if entry is None:
+                missing.append(key)
+                continue
+            host = _assemble(path, entry)
+            if list(host.shape) != list(leaf.shape):
+                raise ValueError(
+                    f"shape mismatch for {key!r}: checkpoint "
+                    f"{list(host.shape)} vs live {list(leaf.shape)}")
+            arr = host.astype(np.dtype(leaf._d.dtype))
+            if mesh is not None and leaf._sharding_spec is not None:
+                leaf._data = jax.device_put(
+                    arr, NamedSharding(mesh, leaf._sharding_spec))
+            elif isinstance(getattr(leaf._d, "sharding", None),
+                            NamedSharding):
+                leaf._data = jax.device_put(arr, leaf._d.sharding)
+            else:
+                leaf._data = jax.numpy.asarray(arr)
+            leaf._node = None
+    if missing:
+        raise KeyError(f"checkpoint at {path!r} missing tensors: "
+                       f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+    return state_dict

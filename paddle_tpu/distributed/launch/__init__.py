@@ -1,0 +1,2 @@
+"""Launcher package (reference: python/paddle/distributed/launch/)."""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,142 @@
+"""`python -m paddle_tpu.distributed.launch` — multi-process job launcher.
+
+Reference: python/paddle/distributed/launch/main.py:18 + controllers/
+collective.py (build_pod): the launcher materializes the env contract that
+`distributed/env.py` reads (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_MASTER / PADDLE_TRAINER_ENDPOINTS), spawns one worker per local
+process, tails logs into --log_dir, and restarts failed workers up to
+--max_restart times (the controller watch loop, controller.py:79).
+
+TPU-native: the normal deployment is ONE process per host (jax.distributed
+over DCN; all local chips visible to that process), so --nproc_per_node
+defaults to 1; multi-proc-per-node remains available for CPU tests — the
+reference's Gloo-style pattern (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a distributed training job")
+    p.add_argument("--master", default=None,
+                   help="coordinator ip:port (default: 127.0.0.1:<free>)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", "--rank", type=int, dest="node_rank",
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None,
+                   help="comma-separated local device ids")
+    p.add_argument("--max_restart", type=int, default=0)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_env(args, local_rank, master):
+    world = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_MASTER": master,
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_JOB_ID": args.job_id,
+        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{_free_port()}",
+        "RANK": str(rank),
+        "WORLD_SIZE": str(world),
+        "MASTER_ADDR_PORT": master,
+    })
+    if args.devices is not None:
+        devs = args.devices.split(",")
+        env["FLAGS_selected_tpus"] = devs[local_rank % len(devs)]
+    return env
+
+
+def launch(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    master = args.master or f"127.0.0.1:{_free_port()}"
+    log_dir = args.log_dir
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    def spawn(local_rank):
+        env = _worker_env(args, local_rank, master)
+        cmd = [sys.executable, args.training_script] + \
+            args.training_script_args
+        if log_dir:
+            rank = env["PADDLE_TRAINER_ID"]
+            logf = open(os.path.join(
+                log_dir, f"workerlog.{rank}"), "ab")
+            return subprocess.Popen(cmd, env=env, stdout=logf,
+                                    stderr=subprocess.STDOUT), logf
+        return subprocess.Popen(cmd, env=env), None
+
+    procs = [spawn(i) for i in range(args.nproc_per_node)]
+    restarts = [0] * len(procs)
+    rc = 0
+    try:
+        while True:
+            alive = False
+            for i, (proc, logf) in enumerate(procs):
+                ret = proc.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    if restarts[i] < args.max_restart:
+                        restarts[i] += 1
+                        print(f"[launch] worker {i} exited rc={ret}; "
+                              f"restart {restarts[i]}/{args.max_restart}",
+                              file=sys.stderr)
+                        procs[i] = spawn(i)
+                        alive = True
+                    else:
+                        rc = ret
+                        raise KeyboardInterrupt  # tear the pod down
+            if not alive:
+                break
+            time.sleep(0.3)
+    except KeyboardInterrupt:
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc, _ in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    finally:
+        for _, logf in procs:
+            if logf:
+                logf.close()
+    return rc
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

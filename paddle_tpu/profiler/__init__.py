@@ -1,0 +1,10 @@
+"""`paddle.profiler` equivalent (reference: python/paddle/profiler/)."""
+
+from .profiler import (Profiler, ProfilerState, ProfilerTarget, SummaryView,  # noqa: F401
+                       RecordEvent, make_scheduler, export_chrome_tracing,
+                       export_protobuf, load_profiler_result)
+from .profiler_statistic import SortedKeys  # noqa: F401
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "SummaryView",
+           "RecordEvent", "make_scheduler", "export_chrome_tracing",
+           "export_protobuf", "load_profiler_result", "SortedKeys"]

@@ -1,0 +1,300 @@
+"""Profiler with the reference API surface over jax.profiler.
+
+Reference: python/paddle/profiler/profiler.py — `Profiler` (:346) is a
+scheduler-driven state machine CLOSED -> READY -> RECORD ->
+RECORD_AND_RETURN; `RecordEvent` spans instrument user code;
+`export_chrome_tracing` is the on_trace_ready handler; `summary()` prints
+stat tables (profiler_statistic.py).
+
+TPU-native: device-side tracing is delegated to `jax.profiler`
+(start_trace/stop_trace writes an XPlane TensorBoard profile — the CudaTracer
+analog); host-side RecordEvent spans and framework op counts are collected in
+Python and exported as Chrome tracing JSON + summary tables, which is the
+part the reference's HostTracer provides.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from enum import Enum
+
+__all__ = ["ProfilerState", "ProfilerTarget", "SummaryView", "make_scheduler",
+           "export_chrome_tracing", "export_protobuf", "Profiler",
+           "RecordEvent", "load_profiler_result"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # the last step of a record window
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0):
+    """Reference profiler.py:117 — returns fn(step)->ProfilerState cycling
+    [closed][ready][record...RECORD_AND_RETURN], `repeat` times (0=forever)."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s // period >= repeat:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+    """on_trace_ready handler writing chrome://tracing JSON (reference
+    profiler.py:215)."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handle(prof: "Profiler"):
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+        prof.export(path, format="json")
+
+    return handle
+
+
+def export_protobuf(dir_name: str, worker_name: str | None = None):
+    """on_trace_ready handler keeping the TensorBoard (XPlane) profile dir."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handle(prof: "Profiler"):
+        prof.export(dir_name, format="pb")
+
+    return handle
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# host event collection
+# ---------------------------------------------------------------------------
+
+_active_profiler: "Profiler | None" = None
+
+
+class RecordEvent:
+    """User-instrumented span (reference profiler/utils.py RecordEvent):
+    also emitted as a jax.profiler.TraceAnnotation so spans appear inside
+    the device trace viewer."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._begin = None
+        self._jax_ann = None
+
+    def begin(self):
+        self._begin = time.perf_counter()
+        try:
+            import jax
+            self._jax_ann = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ann.__enter__()
+        except Exception:
+            self._jax_ann = None
+
+    def end(self):
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(None, None, None)
+            self._jax_ann = None
+        if self._begin is None:
+            return
+        prof = _active_profiler
+        if prof is not None and prof._recording():
+            prof._events.append(
+                (self.name, self._begin, time.perf_counter()))
+        self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def _on_op(name: str):
+    """Framework op hook: every `apply` reports its op name here."""
+    prof = _active_profiler
+    if prof is not None and prof._recording():
+        prof._op_counts[name] = prof._op_counts.get(name, 0) + 1
+
+
+class Profiler:
+    """Reference profiler.py:346. `timer_only=True` skips device tracing and
+    just benchmarks step throughput (reference behavior)."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 custom_device_types=None, with_flops=False, emit_nvtx=False):
+        if isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                       record=end - start, repeat=1)
+        self._scheduler = scheduler or _default_state_scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.current_state = ProfilerState.CLOSED
+        self._step = 0
+        self._events: list[tuple[str, float, float]] = []
+        self._op_counts: dict[str, int] = {}
+        self._step_times: list[float] = []
+        self._last_step_t: float | None = None
+        self._trace_dir: str | None = None
+        self._jax_tracing = False
+
+    # -- state machine ------------------------------------------------------
+    def _recording(self) -> bool:
+        return self.current_state in (ProfilerState.RECORD,
+                                      ProfilerState.RECORD_AND_RETURN)
+
+    def _transition(self, new_state: ProfilerState):
+        old = self.current_state
+        if new_state == old:
+            return
+        if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and old in (ProfilerState.CLOSED, ProfilerState.READY):
+            self._start_device_trace()
+        if new_state in (ProfilerState.CLOSED, ProfilerState.READY) and \
+                old in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._stop_device_trace()
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+        self.current_state = new_state
+
+    def _start_device_trace(self):
+        if self._timer_only or self._jax_tracing:
+            return
+        try:
+            import jax
+            self._trace_dir = self._trace_dir or os.path.join(
+                os.environ.get("PADDLE_PROFILER_LOG_DIR", "profiler_log"),
+                f"run_{int(time.time())}")
+            os.makedirs(self._trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self._trace_dir)
+            self._jax_tracing = True
+        except Exception:
+            self._jax_tracing = False
+
+    def _stop_device_trace(self):
+        if self._jax_tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+
+    # -- public API ---------------------------------------------------------
+    def start(self):
+        global _active_profiler
+        _active_profiler = self
+        from ..amp import debugging as _dbg
+        _dbg._PROFILER_OP_HOOK = _on_op
+        self._last_step_t = time.perf_counter()
+        self._transition(self._scheduler(self._step))
+
+    def stop(self):
+        global _active_profiler
+        self._transition(ProfilerState.CLOSED)
+        from ..amp import debugging as _dbg
+        _dbg._PROFILER_OP_HOOK = None
+        if _active_profiler is self:
+            _active_profiler = None
+
+    def step(self, num_samples: int | None = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+        self._transition(self._scheduler(self._step))
+
+    def step_info(self, unit: str | None = None) -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        arr = np.array(self._step_times[-20:])
+        ips = 1.0 / arr.mean() if arr.mean() > 0 else 0.0
+        return (f"step_time: avg {arr.mean()*1e3:.3f} ms, "
+                f"max {arr.max()*1e3:.3f} ms, min {arr.min()*1e3:.3f} ms, "
+                f"ips {ips:.2f} steps/s")
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- export / summary ---------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        """Chrome tracing JSON from host events; 'pb' points at the XPlane
+        TensorBoard dir jax.profiler produced."""
+        if format == "pb":
+            return self._trace_dir
+        events = []
+        for name, t0, t1 in self._events:
+            events.append({"name": name, "ph": "X", "cat": "host",
+                           "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                           "pid": os.getpid(), "tid": 0})
+        for i, dt in enumerate(self._step_times):
+            events.append({"name": f"ProfileStep#{i}", "ph": "C",
+                           "ts": i, "pid": os.getpid(),
+                           "args": {"step_time_ms": dt * 1e3}})
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "op_counts": self._op_counts}, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        from .profiler_statistic import build_summary
+        txt = build_summary(self._events, self._op_counts, self._step_times,
+                            sorted_by=sorted_by, time_unit=time_unit)
+        print(txt)
+        return txt

@@ -1,0 +1,89 @@
+"""Summary statistics tables (reference:
+python/paddle/profiler/profiler_statistic.py — SortedKeys :49 and the
+table builders behind Profiler.summary :875)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from enum import Enum
+
+__all__ = ["SortedKeys", "build_summary"]
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+_UNIT = {"s": 1.0, "ms": 1e3, "us": 1e6}
+
+
+def _table(headers, rows, title):
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows
+              else len(str(h)) for i, h in enumerate(headers)]
+    sep = "-" * (sum(widths) + 3 * len(widths) + 1)
+    out = [sep, f"| {title}", sep,
+           "| " + "  ".join(str(h).ljust(w) for h, w in zip(headers, widths))]
+    out.append(sep)
+    for r in rows:
+        out.append("| " + "  ".join(str(c).ljust(w)
+                                    for c, w in zip(r, widths)))
+    out.append(sep)
+    return "\n".join(out)
+
+
+def build_summary(events, op_counts, step_times, sorted_by=None,
+                  time_unit="ms"):
+    mul = _UNIT.get(time_unit, 1e3)
+    parts = []
+
+    if step_times:
+        import numpy as np
+        arr = np.array(step_times) * mul
+        parts.append(_table(
+            ["stat", f"value ({time_unit})"],
+            [["steps", len(arr)],
+             ["avg", f"{arr.mean():.3f}"],
+             ["max", f"{arr.max():.3f}"],
+             ["min", f"{arr.min():.3f}"],
+             ["p50", f"{np.percentile(arr, 50):.3f}"],
+             ["p99", f"{np.percentile(arr, 99):.3f}"]],
+            "Step Time Summary"))
+
+    if events:
+        agg = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])
+        for name, t0, t1 in events:
+            dt = t1 - t0
+            a = agg[name]
+            a[0] += 1
+            a[1] += dt
+            a[2] = max(a[2], dt)
+            a[3] = min(a[3], dt)
+        key = {
+            SortedKeys.CPUAvg: lambda kv: kv[1][1] / kv[1][0],
+            SortedKeys.CPUMax: lambda kv: kv[1][2],
+            SortedKeys.CPUMin: lambda kv: kv[1][3],
+        }.get(sorted_by, lambda kv: kv[1][1])
+        rows = []
+        for name, (n, tot, mx, mn) in sorted(agg.items(), key=key,
+                                             reverse=True):
+            rows.append([name, n, f"{tot*mul:.3f}", f"{tot/n*mul:.3f}",
+                         f"{mx*mul:.3f}", f"{mn*mul:.3f}"])
+        parts.append(_table(
+            ["Name", "Calls", f"Total ({time_unit})", f"Avg ({time_unit})",
+             f"Max ({time_unit})", f"Min ({time_unit})"],
+            rows, "Host Event Summary (RecordEvent spans)"))
+
+    if op_counts:
+        rows = [[name, n] for name, n in
+                sorted(op_counts.items(), key=lambda kv: -kv[1])]
+        parts.append(_table(["Operator", "Calls"], rows[:50],
+                            "Operator Summary (eager op dispatches)"))
+
+    return "\n\n".join(parts) if parts else "nothing recorded"

@@ -1,0 +1,72 @@
+"""Launcher + real multi-process jax.distributed test (reference pattern:
+test_parallel_dygraph_dataparallel.py:159 spawns ranked subprocesses with
+the env contract; TestMultipleWithGloo runs 2-process CPU jobs)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    import jax._src.xla_bridge as xb
+    jax.config.update("jax_platforms", "cpu")
+    xb._backend_factories.pop("axon", None)
+    sys.path.insert(0, %r)
+    from paddle_tpu.distributed.env import ParallelEnv, init_parallel_env
+    env = ParallelEnv()
+    assert env.world_size == 2, env.world_size
+    init_parallel_env()
+    assert jax.process_count() == 2, jax.process_count()
+    # the global view aggregates both processes' local devices
+    assert jax.device_count() == 2 * jax.local_device_count(), \\
+        (jax.device_count(), jax.local_device_count())
+    x = jax.numpy.ones(())
+    print("RANK", env.rank, "OK", flush=True)
+""" % REPO)
+
+
+def test_launcher_two_process_cpu(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    log_dir = str(tmp_path / "logs")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir, str(script)],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO)
+    logs = ""
+    for f in sorted(os.listdir(log_dir)):
+        logs += open(os.path.join(log_dir, f)).read()
+    assert out.returncode == 0, (out.stdout, out.stderr, logs)
+    assert "RANK 0 OK" in logs and "RANK 1 OK" in logs, logs
+
+
+def test_launcher_env_contract(tmp_path):
+    script = tmp_path / "printer.py"
+    script.write_text(
+        "import os\n"
+        "print(os.environ['PADDLE_TRAINER_ID'],\n"
+        "      os.environ['PADDLE_TRAINERS_NUM'],\n"
+        "      os.environ['PADDLE_MASTER'] != '',\n"
+        "      os.environ['PADDLE_JOB_ID'], flush=True)\n")
+    log_dir = str(tmp_path / "logs")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--job_id", "jobx",
+         "--log_dir", log_dir, str(script)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    logs = [open(os.path.join(log_dir, f)).read()
+            for f in sorted(os.listdir(log_dir))]
+    assert "0 2 True jobx" in logs[0]
+    assert "1 2 True jobx" in logs[1]

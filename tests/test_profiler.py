@@ -1,0 +1,77 @@
+"""Profiler surface tests (reference: python/paddle/profiler/profiler.py:346
+state machine, RecordEvent, chrome-trace export, summary tables)."""
+
+import json
+import os
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 make_scheduler, export_chrome_tracing,
+                                 SortedKeys)
+
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(6)]
+    assert states[0] == ProfilerState.CLOSED
+    assert states[1] == ProfilerState.READY
+    assert states[2] == ProfilerState.RECORD
+    assert states[3] == ProfilerState.RECORD_AND_RETURN
+    assert states[4] == ProfilerState.CLOSED  # repeat=1 exhausted
+
+
+def test_profiler_records_events_and_ops(tmp_path):
+    traces = []
+    prof = Profiler(scheduler=None, timer_only=True,
+                    on_trace_ready=lambda p: traces.append(p))
+    prof.start()
+    x = paddle.ones([4, 4])
+    for _ in range(3):
+        with RecordEvent("forward"):
+            y = (x @ x).sum()
+        prof.step()
+    prof.stop()
+    assert traces, "on_trace_ready must fire on RECORD->CLOSED"
+    assert any(n == "forward" for n, _, _ in prof._events)
+    assert prof._op_counts.get("matmul", 0) >= 3
+    assert len(prof._step_times) == 3
+
+    path = str(tmp_path / "trace.json")
+    prof.export(path)
+    data = json.load(open(path))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "forward" in names
+
+    txt = prof.summary(sorted_by=SortedKeys.CPUTotal)
+    assert "Step Time Summary" in txt
+    assert "forward" in txt
+    assert "matmul" in txt
+    assert "step_time" in prof.step_info()
+
+
+def test_export_chrome_tracing_handler(tmp_path):
+    d = str(tmp_path / "out")
+    prof = Profiler(timer_only=True,
+                    on_trace_ready=export_chrome_tracing(d))
+    with prof:
+        with RecordEvent("span"):
+            paddle.ones([2]).sum()
+        prof.step()
+    files = os.listdir(d)
+    assert any(f.endswith(".paddle_trace.json") for f in files)
+
+
+def test_scheduled_window(tmp_path):
+    """Only steps inside the record window are captured."""
+    prof = Profiler(timer_only=True,
+                    scheduler=make_scheduler(closed=2, ready=0, record=2,
+                                             repeat=1))
+    prof.start()
+    for i in range(6):
+        with RecordEvent(f"it{i}"):
+            pass
+        prof.step()
+    prof.stop()
+    names = {n for n, _, _ in prof._events}
+    assert "it0" not in names and "it1" not in names
+    assert "it2" in names or "it3" in names
