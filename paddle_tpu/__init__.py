@@ -9,7 +9,32 @@ onto TPU meshes (ICI/DCN) instead of NCCL.
 
 from __future__ import annotations
 
+import sys as _sys
+
 import jax as _jax
+
+# Mosaic/MLIR lowering of Pallas kernels inside large jaxprs (deep models,
+# autograd-built training steps) recurses per jaxpr eqn; the CPython default
+# limit of 1000 aborts compilation of real-size models with RecursionError.
+if _sys.getrecursionlimit() < 20000:
+    _sys.setrecursionlimit(20000)
+
+# Persistent compilation cache: TPU compiles of full train steps take minutes
+# through remote-compile tunnels; cache them across processes/runs.
+import os as _os
+
+_cache_dir = _os.environ.get(
+    "PADDLE_TPU_COMPILE_CACHE",
+    _os.path.join(_os.path.expanduser("~"), ".cache", "paddle_tpu_xla"))
+# CPU-only runs skip the cache: XLA:CPU AOT entries record exact machine
+# features and reloading them across processes warns about SIGILL risk.
+if "cpu" not in _os.environ.get("JAX_PLATFORMS", ""):
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass  # cache is best-effort; never block import
 
 # int64/float64 must exist as real dtypes (reference semantics: int64 is the
 # default integer type). Float defaults remain float32 — creation ops and

@@ -161,9 +161,13 @@ class LlamaDecoderLayer(nn.Layer):
         self.mlp = LlamaMLP(cfg, parallel=parallel)
 
     def forward(self, x, cos, sin):
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
-        x = x + self.mlp(self.post_attention_layernorm(x))
-        return x
+        attn_out = self.self_attn(self.input_layernorm(x), cos, sin)
+        # fused residual-add + rmsnorm (one VMEM pass on TPU): y = norm(x +
+        # attn_out) and h = x + attn_out come from the same kernel
+        y, h = F.fused_rms_norm_add(attn_out, x,
+                                    self.post_attention_layernorm.weight,
+                                    self.post_attention_layernorm._epsilon)
+        return h + self.mlp(y)
 
 
 class Llama(nn.Layer):

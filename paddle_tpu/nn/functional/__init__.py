@@ -28,7 +28,8 @@ from .pooling import *  # noqa: F401,F403
 from .pooling import __all__ as _pool_all
 
 __all__ = list(_act_all) + list(_loss_all) + list(_conv_all) + list(_pool_all) + [
-    "linear", "embedding", "layer_norm", "rms_norm", "batch_norm", "group_norm",
+    "linear", "embedding", "layer_norm", "rms_norm", "fused_rms_norm_add",
+    "batch_norm", "group_norm",
     "instance_norm", "normalize", "dropout", "dropout2d", "dropout3d",
     "alpha_dropout", "cosine_similarity", "pairwise_distance", "one_hot", "pad",
     "scaled_dot_product_attention", "interpolate", "upsample", "pixel_shuffle",
@@ -83,7 +84,18 @@ def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5,
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None) -> Tensor:
     """RMSNorm (reference: fused rms_norm kernel,
-    paddle/phi/kernels/fusion/gpu/fused_rms_norm*)."""
+    paddle/phi/kernels/fusion/gpu/fused_rms_norm*). On TPU with a weight this
+    dispatches to the fused Pallas forward+backward kernel."""
+    from ...core.flags import flag
+    from ...ops.kernels import _common as kern
+
+    if weight is not None and kern.available() and flag("use_pallas_kernels"):
+        from ...ops.kernels.rms_norm_pallas import rms_norm_fused
+        return apply(
+            lambda a, w: rms_norm_fused(a, w, None, epsilon,
+                                        kern.interpret_mode())[0],
+            x, weight, name="rms_norm")
+
     def f(a, *w):
         af = a.astype(jnp.float32) if a.dtype in (jnp.bfloat16, jnp.float16) else a
         ms = jnp.mean(jnp.square(af), axis=-1, keepdims=True)
@@ -93,6 +105,30 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None) -> Tensor:
         return out
     args = [x] + ([weight] if weight is not None else [])
     return apply(f, *args, name="rms_norm")
+
+
+def fused_rms_norm_add(x, residual, weight, epsilon=1e-6, name=None):
+    """(rmsnorm(x + residual) * weight, x + residual) — the pre-norm residual
+    block primitive (reference: fused_rms_norm residual variants). One fused
+    VMEM pass on TPU; XLA composite elsewhere."""
+    from ...core.flags import flag
+    from ...ops.kernels import _common as kern
+
+    from ...autograd.function import apply_multi
+
+    if kern.available() and flag("use_pallas_kernels"):
+        from ...ops.kernels.rms_norm_pallas import rms_norm_fused
+        return apply_multi(
+            lambda a, r, w: rms_norm_fused(a, w, r, epsilon,
+                                           kern.interpret_mode()),
+            x, residual, weight, name="fused_rms_norm_add")
+
+    def f(a, r, w):
+        h = a + r
+        hf = h.astype(jnp.float32) if h.dtype in (jnp.bfloat16, jnp.float16) else h
+        ms = jnp.mean(jnp.square(hf), axis=-1, keepdims=True)
+        return (hf * jax.lax.rsqrt(ms + epsilon)).astype(h.dtype) * w, h
+    return apply_multi(f, x, residual, weight, name="fused_rms_norm_add")
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

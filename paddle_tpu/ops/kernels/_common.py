@@ -1,0 +1,34 @@
+"""Shared state for Pallas TPU kernels: availability + interpret-mode hook.
+
+Every kernel module (flash attention, fused rmsnorm, ...) dispatches on
+`available()`; tests flip `force_interpret(True)` to run the real kernel
+jaxprs through the Pallas interpreter on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+_INTERPRET = False  # test hook: run the Pallas kernels in interpret mode
+
+
+def force_interpret(enable: bool) -> None:
+    global _INTERPRET
+    _INTERPRET = bool(enable)
+    available.cache_clear()
+
+
+def interpret_mode() -> bool:
+    return _INTERPRET
+
+
+@functools.cache
+def available() -> bool:
+    if _INTERPRET:
+        return True
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False

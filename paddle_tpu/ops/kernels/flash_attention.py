@@ -1,29 +1,27 @@
 """Flash attention for TPU.
 
 Reference analog: paddle/phi/kernels/gpu/flash_attn_kernel.cu (FA2 glue).
-Here: a Pallas TPU kernel (forward) with a jax.custom_vjp whose backward uses
-the XLA-fused composite (recompute-based) — numerically exact, memory-light.
-Layout matches the reference flash_attn API: [batch, seq, heads, head_dim].
+Here: Pallas TPU kernels for BOTH forward and backward (FlashAttention-2
+blocked online-softmax forward saving logsumexp; fused dq / dkv backward
+kernels — no O(S^2) materialisation in either direction). Layout matches the
+reference flash_attn API: [batch, seq, heads, head_dim].
+
+The primal-only path (inference / no-grad) uses a forward kernel that skips
+the logsumexp output entirely; the vjp path saves lse for the fused backward.
 
 On non-TPU backends `available()` is False and callers fall back to the XLA
-composite in nn.functional.scaled_dot_product_attention.
+composite in nn.functional.scaled_dot_product_attention. Tests exercise the
+kernels on CPU via `force_interpret(True)` (Pallas interpret mode).
 """
 
 from __future__ import annotations
 
-import functools
 import math
 
 import jax
 import jax.numpy as jnp
 
-
-@functools.cache
-def available() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+from ._common import available, force_interpret, interpret_mode  # noqa: F401
 
 
 def _reference_attention(q, k, v, causal):
@@ -40,35 +38,70 @@ def _reference_attention(q, k, v, causal):
     return jnp.swapaxes(out, 1, 2)
 
 
-def _fwd_pallas(q, k, v, causal):
-    from .flash_attention_pallas import flash_attention_forward
-    return flash_attention_forward(q, k, v, causal=causal)
+def _pallas_ok(q) -> bool:
+    """Kernel constraints: seq divisible by the block size it will pick."""
+    if not available():
+        return False
+    s = q.shape[1]
+    blk = min(256, s)
+    return s % blk == 0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, causal):
-    if available():
+@jax.custom_vjp
+def _flash_causal(q, k, v):
+    return _flash_impl(q, k, v, True)
+
+
+@jax.custom_vjp
+def _flash_full(q, k, v):
+    return _flash_impl(q, k, v, False)
+
+
+def _flash_impl(q, k, v, causal):
+    if _pallas_ok(q):
         try:
-            return _fwd_pallas(q, k, v, causal)
+            from .flash_attention_pallas import flash_attention_forward
+            return flash_attention_forward(q, k, v, causal=causal,
+                                           interpret=interpret_mode())
         except Exception:
-            return _reference_attention(q, k, v, causal)
+            pass
     return _reference_attention(q, k, v, causal)
 
 
-def _flash_fwd(q, k, v, causal):
-    out = _flash(q, k, v, causal)
-    return out, (q, k, v)
+def _fwd_impl(q, k, v, causal):
+    if _pallas_ok(q):
+        try:
+            from .flash_attention_pallas import flash_attention_forward_lse
+            out, lse = flash_attention_forward_lse(q, k, v, causal=causal,
+                                                   interpret=interpret_mode())
+            return out, (q, k, v, out, lse)
+        except Exception:
+            pass
+    out = _reference_attention(q, k, v, causal)
+    return out, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _reference_attention(a, b, c, causal), q, k, v)
+def _bwd_impl(causal, res, g):
+    q, k, v, out, lse = res
+    if lse is not None:
+        try:
+            from .flash_attention_pallas import flash_attention_backward
+            return flash_attention_backward(q, k, v, out, lse, g,
+                                            causal=causal,
+                                            interpret=interpret_mode())
+        except Exception:
+            pass
+    _, vjp = jax.vjp(lambda a, b, c: _reference_attention(a, b, c, causal),
+                     q, k, v)
     return vjp(g)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_causal.defvjp(lambda q, k, v: _fwd_impl(q, k, v, True),
+                     lambda res, g: _bwd_impl(True, res, g))
+_flash_full.defvjp(lambda q, k, v: _fwd_impl(q, k, v, False),
+                   lambda res, g: _bwd_impl(False, res, g))
 
 
 def flash_attention(q, k, v, causal: bool = False):
-    """[B, S, H, D] attention; pallas forward on TPU, exact recompute backward."""
-    return _flash(q, k, v, causal)
+    """[B, S, H, D] attention; fused Pallas forward+backward on TPU."""
+    return _flash_causal(q, k, v) if causal else _flash_full(q, k, v)

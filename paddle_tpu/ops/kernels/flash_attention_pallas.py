@@ -17,14 +17,16 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-NEG_INF = -1e30
+NEG_INF = -1e30  # wrapped in jnp.float32 at use sites (x64 safety)
+LSE_LANES = 128  # lse/delta stored [.., S, 128]: Mosaic wants full-lane layouts
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k, seq_len, scale,
-                 block_q):
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
+                 seq_len, scale, block_q):
     # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq, d]; o_ref: [1, block_q, d]
+    # lse_ref: [1, block_q] (logsumexp of the scaled logits, for backward)
     d = q_ref.shape[-1]
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)
     q_blk = pl.program_id(1)
 
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
@@ -44,7 +46,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k, seq_len, scale,
                 jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -55,19 +57,24 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k, seq_len, scale,
 
     if causal:
         # only scan k blocks up to (and including) the diagonal block
-        last = ((q_blk + 1) * block_q + block_k - 1) // block_k
+        last = ((q_blk + 1) * block_q + block_k - 1) // jnp.int32(block_k)
         n_used = jnp.minimum(last, n_k)
-        m, l, acc = jax.lax.fori_loop(0, n_used, body, (m, l, acc))
+        m, l, acc = jax.lax.fori_loop(jnp.int32(0), n_used.astype(jnp.int32), body,
+                                      (m, l, acc))
     else:
-        m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
+        m, l, acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_k), body,
+                                      (m, l, acc))
 
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, jnp.float32(1e-30))
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (block_q, LSE_LANES))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
-def flash_attention_forward(q, k, v, causal=False, block_q=256, block_k=256,
-                            interpret=False):
+def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
+                                block_k=256, interpret=False):
+    """Returns (out [B,S,H,D], lse [B*H, S] float32)."""
     b, s, h, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -81,17 +88,199 @@ def flash_attention_forward(q, k, v, causal=False, block_q=256, block_k=256,
     vt = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
 
     grid = (b * h, s // block_q)
-    out = pl.pallas_call(
-        functools.partial(_attn_kernel, causal=causal, block_k=block_k,
-                          seq_len=s, scale=scale, block_q=block_q),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
-            pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        interpret=interpret,
-    )(qt, kt, vt)
-    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+    with jax.enable_x64(False):
+        out, lse = pl.pallas_call(
+            functools.partial(_attn_kernel, causal=causal, block_k=block_k,
+                              seq_len=s, scale=scale, block_q=block_q),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+                pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+                pl.BlockSpec((1, block_q, LSE_LANES), lambda bi, qi: (bi, qi, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+                jax.ShapeDtypeStruct((b * h, s, LSE_LANES), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qt, kt, vt)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2), lse[:, :, 0]
+
+
+def flash_attention_forward(q, k, v, causal=False, block_q=256, block_k=256,
+                            interpret=False):
+    return flash_attention_forward_lse(q, k, v, causal=causal, block_q=block_q,
+                                       block_k=block_k, interpret=interpret)[0]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               causal, block_q, block_k, seq_len, scale):
+    """Grid (B*H, n_q): dQ for one q block, scanning k/v blocks.
+
+    dS = P * (dO V^T - delta);  dQ = scale * dS K   with P = exp(S - lse).
+    """
+    d = q_ref.shape[-1]
+    q_blk = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # pre-scaled q
+    do = do_ref[0].astype(jnp.float32)                # [bq, d]
+    lse = lse_ref[0][:, :1]                           # [bq, 1]
+    delta = delta_ref[0][:, :1]                       # [bq, 1]
+
+    n_k = seq_len // block_k
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(i, acc):
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        last = ((q_blk + 1) * block_q + block_k - 1) // jnp.int32(block_k)
+        acc = jax.lax.fori_loop(jnp.int32(0),
+                                jnp.minimum(last, n_k).astype(jnp.int32),
+                                body, acc)
+    else:
+        acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_k), body, acc)
+    dq_ref[0] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, causal, block_q, block_k, seq_len, scale):
+    """Grid (B*H, n_k): dK/dV for one k/v block, scanning q blocks.
+
+    dV = P^T dO;  dK = scale * dS^T Q.
+    """
+    d = k_ref.shape[-1]
+    k_blk = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+    v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+
+    n_q = seq_len // block_q
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) \
+            * jnp.float32(scale)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :][:, :1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_blk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                          # [bq, bk]
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        start = (k_blk * block_k) // jnp.int32(block_q)
+        dk, dv = jax.lax.fori_loop(start.astype(jnp.int32), jnp.int32(n_q),
+                                  body, (dk, dv))
+    else:
+        dk, dv = jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_q), body, (dk, dv))
+    # q was pre-scaled, so ds^T q already carries one factor of scale; the
+    # analytic dK = scale * dS^T Q is exactly what accumulated above.
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
+                             block_k=256, interpret=False):
+    """Fused FA2-style backward: (dq, dk, dv), all [B,S,H,D].
+
+    `lse` is the [B*H, S] logsumexp from flash_attention_forward_lse; `g` the
+    output cotangent. delta = rowsum(dO * O) is computed outside the kernels
+    (one fused XLA elementwise pass).
+    """
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq {s} must divide block sizes {block_q}/{block_k}")
+    scale = 1.0 / math.sqrt(d)
+
+    def to_bh(t):
+        return jnp.swapaxes(t, 1, 2).reshape(b * h, s, d)
+
+    qt, kt, vt, dot = to_bh(q), to_bh(k), to_bh(v), to_bh(g)
+    ot = to_bh(out)
+    delta1 = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                     axis=-1, keepdims=True)
+    delta = jnp.broadcast_to(delta1, (b * h, s, LSE_LANES))
+    lse3 = jnp.broadcast_to(lse[:, :, None], (b * h, s, LSE_LANES))
+
+    full = lambda bi, qi: (bi, 0, 0)
+    blk_q3 = pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0))
+    blk_q1 = pl.BlockSpec((1, block_q, LSE_LANES), lambda bi, qi: (bi, qi, 0))
+    blk_k3 = pl.BlockSpec((1, block_k, d), lambda bi, ki: (bi, ki, 0))
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, causal=causal, block_q=block_q,
+                              block_k=block_k, seq_len=s, scale=scale),
+            grid=(b * h, s // block_q),
+            in_specs=[
+                blk_q3,                                    # q
+                pl.BlockSpec((1, s, d), full),             # k
+                pl.BlockSpec((1, s, d), full),             # v
+                blk_q3,                                    # do
+                blk_q1,                                    # lse
+                blk_q1,                                    # delta
+            ],
+            out_specs=blk_q3,
+            out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse3, delta)
+
+    with jax.enable_x64(False):
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, causal=causal, block_q=block_q,
+                              block_k=block_k, seq_len=s, scale=scale),
+            grid=(b * h, s // block_k),
+            in_specs=[
+                pl.BlockSpec((1, s, d), full),             # q
+                blk_k3,                                    # k
+                blk_k3,                                    # v
+                pl.BlockSpec((1, s, d), full),             # do
+                pl.BlockSpec((1, s, LSE_LANES), full),     # lse
+                pl.BlockSpec((1, s, LSE_LANES), full),     # delta
+            ],
+            out_specs=[blk_k3, blk_k3],
+            out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                       jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+            interpret=interpret,
+        )(qt, kt, vt, dot, lse3, delta)
+
+    from_bh = lambda t: jnp.swapaxes(t.reshape(b, h, s, d), 1, 2)
+    return from_bh(dq), from_bh(dk), from_bh(dv)

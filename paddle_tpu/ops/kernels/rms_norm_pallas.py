@@ -1,0 +1,167 @@
+"""Fused RMSNorm(+residual-add) Pallas TPU kernel, forward and backward.
+
+Reference analog: paddle/phi/kernels/fusion/gpu/fused_rms_norm* (the fused
+rmsnorm+bias+residual CUDA kernels). TPU design: one VMEM pass per row block
+computes the optional residual add and the normalised output — no
+intermediate HBM round trip. Backward recomputes the f32 rstd from the saved
+pre-norm activations (cheaper than storing a per-row vector, which would
+force an awkward 1-D layout) and fuses the row-local dx with per-block
+partial dw accumulation; partials are summed by one XLA reduce.
+
+All pallas_call sites trace under jax.enable_x64(False): the framework
+enables x64 globally, which turns index-map/loop literals into i64/f64 —
+types Mosaic cannot legalize.
+
+Public entry: `rms_norm_fused(x, weight, residual=None, eps)` with a
+custom_vjp; non-TPU callers use the XLA composite (nn.functional.rms_norm
+handles the dispatch). Tests run these kernels in interpret mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _fwd_plain_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)                    # [rows, H]
+    rstd = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True)
+                         + jnp.float32(eps))
+    o_ref[...] = (x * rstd * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _fwd_res_kernel(x_ref, res_ref, w_ref, o_ref, h_ref, *, eps):
+    h = x_ref[...].astype(jnp.float32) + res_ref[...].astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(h * h, axis=-1, keepdims=True)
+                         + jnp.float32(eps))
+    o_ref[...] = (h * rstd * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+    h_ref[...] = h.astype(h_ref.dtype)
+
+
+def _bwd_kernel(h_ref, w_ref, g_ref, dx_ref, dwp_ref, *, hidden, eps):
+    """dx (row-local) + this block's partial dw; rstd recomputed from h.
+
+    u = g*w; dx = rstd*u - h * rstd^3/H * rowsum(h*u);
+    dw_partial = sum_rows g * h * rstd.
+    """
+    h = h_ref[...].astype(jnp.float32)                    # [rows, H]
+    g = g_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)                    # [1, H]
+    rstd = jax.lax.rsqrt(jnp.mean(h * h, axis=-1, keepdims=True)
+                         + jnp.float32(eps))
+    u = g * w
+    dot = jnp.sum(h * u, axis=-1, keepdims=True)
+    dx = rstd * u - h * (rstd * rstd * rstd) * (dot * jnp.float32(1.0 / hidden))
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    # [n_blocks, 8, H] output: sublane-dim 8 keeps the layout legal; the
+    # wrapper reads row 0 of each block's 8 identical rows.
+    dwp_ref[0] = jnp.broadcast_to(
+        jnp.sum(g * h * rstd, axis=0, keepdims=True), (8, hidden))
+
+
+def _pick_rows(n_rows, hidden):
+    """Row-block size: stay well under VMEM with ~4 f32 row buffers."""
+    budget = 4 * 1024 * 1024  # bytes for one [rows, H] f32 buffer
+    rows = max(8, min(256, budget // max(hidden * 4, 1)))
+    while n_rows % rows:
+        rows //= 2
+        if rows <= 1:
+            return 1
+    return rows
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _fused_fwd(x2, res2, w, eps, interpret):
+    n, h = x2.shape
+    rows = _pick_rows(n, h)
+    grid = (n // rows,)
+    row_spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
+    w_spec = pl.BlockSpec((1, h), lambda i: (0, 0))
+    if res2 is None:
+        with jax.enable_x64(False):
+            out = pl.pallas_call(
+                functools.partial(_fwd_plain_kernel, eps=eps),
+                grid=grid,
+                in_specs=[row_spec, w_spec],
+                out_specs=row_spec,
+                out_shape=jax.ShapeDtypeStruct((n, h), x2.dtype),
+                interpret=interpret,
+            )(x2, w.reshape(1, h))
+        return out, x2
+    with jax.enable_x64(False):
+        out, hsum = pl.pallas_call(
+            functools.partial(_fwd_res_kernel, eps=eps),
+            grid=grid,
+            in_specs=[row_spec, row_spec, w_spec],
+            out_specs=[row_spec, row_spec],
+            out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
+                       jax.ShapeDtypeStruct((n, h), x2.dtype)],
+            interpret=interpret,
+        )(x2, res2, w.reshape(1, h))
+    return out, hsum
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _fused_bwd(h2, w, g2, eps, interpret):
+    n, h = h2.shape
+    rows = _pick_rows(n, h)
+    grid = (n // rows,)
+    row_spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
+    with jax.enable_x64(False):
+        dx, dw_part = pl.pallas_call(
+            functools.partial(_bwd_kernel, hidden=h, eps=eps),
+            grid=grid,
+            in_specs=[row_spec,
+                      pl.BlockSpec((1, h), lambda i: (0, 0)),
+                      row_spec],
+            out_specs=[row_spec, pl.BlockSpec((1, 8, h), lambda i: (i, 0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n, h), h2.dtype),
+                       jax.ShapeDtypeStruct((n // rows, 8, h), jnp.float32)],
+            interpret=interpret,
+        )(h2, w.reshape(1, h), g2)
+    return dx, jnp.sum(dw_part[:, 0, :], axis=0)
+
+
+def _run_fwd(x, weight, residual, eps, interpret):
+    """((y, summed_residual_or_None), (hsum2d, shape)) — single forward body
+    shared by the primal and vjp paths."""
+    shp = x.shape
+    h = shp[-1]
+    x2 = x.reshape(-1, h)
+    has_res = residual is not None
+    res2 = residual.reshape(-1, h) if has_res else None
+    out, hsum = _fused_fwd(x2, res2, weight, eps, interpret)
+    outs = (out.reshape(shp), hsum.reshape(shp) if has_res else None)
+    return outs, (hsum, has_res)
+
+
+def _primal(x, weight, residual, eps, interpret=False):
+    """(y, summed_residual_or_None)."""
+    return _run_fwd(x, weight, residual, eps, interpret)[0]
+
+
+rms_norm_fused = jax.custom_vjp(_primal, nondiff_argnums=(3, 4))
+
+
+def _vjp_fwd(x, weight, residual, eps, interpret):
+    outs, (hsum, has_res) = _run_fwd(x, weight, residual, eps, interpret)
+    return outs, (hsum, weight, x.shape, has_res)
+
+
+def _vjp_bwd(eps, interpret, saved, grads):
+    hsum, weight, shp, has_res = saved
+    g_out, g_h = grads
+    h = shp[-1]
+    g2 = g_out.reshape(-1, h)
+    dx, dw = _fused_bwd(hsum, weight, g2, eps, interpret)
+    dx = dx.reshape(shp)
+    if g_h is not None:
+        dx = dx + g_h.reshape(shp)  # residual-stream cotangent joins dx
+    # d(residual) == d(x): both feed the same pre-norm sum
+    return dx, dw.astype(weight.dtype), (dx if has_res else None)
+
+
+rms_norm_fused.defvjp(_vjp_fwd, _vjp_bwd)
