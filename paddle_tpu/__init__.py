@@ -26,9 +26,12 @@ import os as _os
 _cache_dir = _os.environ.get(
     "PADDLE_TPU_COMPILE_CACHE",
     _os.path.join(_os.path.expanduser("~"), ".cache", "paddle_tpu_xla"))
-# CPU-only runs skip the cache: XLA:CPU AOT entries record exact machine
-# features and reloading them across processes warns about SIGILL risk.
-if "cpu" not in _os.environ.get("JAX_PLATFORMS", ""):
+# Only TPU-targeting processes use the cache: XLA:CPU AOT entries record
+# exact machine features and reloading them across hosts risks SIGILL.
+_wants_tpu = ("tpu" in _os.environ.get("JAX_PLATFORMS", "")
+              or ("PALLAS_AXON_POOL_IPS" in _os.environ
+                  and "cpu" not in _os.environ.get("JAX_PLATFORMS", "")))
+if _wants_tpu:
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
@@ -106,4 +109,11 @@ def __getattr__(name):
         mod = importlib.import_module(".incubate", __name__)
         globals()["incubate"] = mod
         return mod
+    if name in ("hapi", "Model", "callbacks"):
+        import importlib
+        mod = importlib.import_module(".hapi", __name__)
+        globals()["hapi"] = mod
+        globals()["Model"] = mod.Model
+        globals()["callbacks"] = mod.callbacks
+        return globals()[name]
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,9 @@
+"""High-level training API (reference: python/paddle/hapi/)."""
+
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau)
+
+__all__ = ["Model", "callbacks"]

@@ -1,0 +1,357 @@
+"""hapi Model: high-level train/eval/predict loops (reference:
+python/paddle/hapi/model.py:1054 `Model`, fit :1756).
+
+TPU-first design: `prepare()` records optimizer/loss/metrics and the whole
+train step (forward + backward + optimizer update) is compiled once with
+`paddle.jit.to_static` — one XLA program per step instead of the reference's
+op-by-op dygraph loop. Metrics stream on host from the step's outputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric.metrics import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class Model:
+    """Network wrapper with fit/evaluate/predict (reference Model:1054).
+
+    Usage matches the reference::
+
+        model = paddle.Model(network)
+        model.prepare(optimizer, loss, metrics)
+        model.fit(train_ds, eval_ds, batch_size=64, epochs=2)
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._amp_level = "O0"
+        self.stop_training = False
+        self._save_dir = None
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+
+    # -- configuration ------------------------------------------------------
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be callable or a Layer")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle.metric.Metric")
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O0")
+        if self._amp_level == "O2" and optimizer is not None:
+            self.network, self._optimizer = paddle.amp.decorate(
+                self.network, self._optimizer, level="O2", dtype="bfloat16")
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+
+    # -- single-batch API ---------------------------------------------------
+
+    def _split_batch(self, data):
+        """[inputs..., labels...] split by declared specs or loss arity."""
+        data = _to_list(data)
+        if self._inputs:
+            n_in = len(self._inputs)
+        elif self._loss is not None and len(data) > 1:
+            n_in = len(data) - max(len(self._labels), 1)
+        else:
+            n_in = len(data)
+        return data[:n_in], data[n_in:]
+
+    def _as_tensors(self, xs):
+        return [x if isinstance(x, Tensor) else paddle.to_tensor(x)
+                for x in xs]
+
+    def _build_train_step(self, n_in, update):
+        model = self
+
+        def raw(*args):
+            ins, labs = args[:n_in], args[n_in:]
+            if model._amp_level == "O1":
+                with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+                    outs = model.network(*ins)
+            else:
+                outs = model.network(*ins)
+            outs_l = _to_list(outs)
+            loss = model._loss(*(outs_l + list(labs)))
+            loss.backward()  # accumulates into .grad when update is False
+            if update:
+                model._optimizer.step()
+                model._optimizer.clear_grad()
+            return tuple([loss] + outs_l)
+
+        return paddle.jit.to_static(raw)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One optimizer step (or gradient accumulation when update=False);
+        returns [loss] (+ metric results). Reference Model.train_batch:1196."""
+        ins = self._as_tensors(_to_list(inputs))
+        labs = self._as_tensors(_to_list(labels))
+        key = (len(ins), bool(update))
+        if self._train_step is None:
+            self._train_step = {}
+        if key not in self._train_step:
+            self._train_step[key] = self._build_train_step(len(ins), update)
+        res = self._train_step[key](*ins, *labs)
+        loss, outs = res[0], res[1:]
+        self._update_metrics(outs, labs)
+        m = [float(np.asarray(loss.numpy()).reshape(-1)[0])]
+        return m if not self._metrics else (m, self._metric_results())
+
+    def eval_batch(self, inputs, labels=None):
+        ins = self._as_tensors(_to_list(inputs))
+        labs = self._as_tensors(_to_list(labels))
+        if self._eval_step is None or getattr(self, "_eval_n_in", None) != \
+                len(ins):
+            model = self
+            n_in = len(ins)
+
+            def raw(*args):
+                with paddle.no_grad():
+                    i, l = args[:n_in], args[n_in:]
+                    outs = _to_list(model.network(*i))
+                    loss = model._loss(*(outs + list(l))) \
+                        if model._loss is not None else None
+                return tuple(([loss] if loss is not None else []) + outs)
+
+            self._eval_n_in = n_in
+            self._eval_step = paddle.jit.to_static(raw)
+        res = self._eval_step(*ins, *labs)
+        if self._loss is not None:
+            loss, outs = res[0], res[1:]
+            out_m = [float(np.asarray(loss.numpy()).reshape(-1)[0])]
+        else:
+            loss, outs = None, res
+            out_m = []
+        self._update_metrics(outs, labs)
+        return out_m if not self._metrics else (out_m,
+                                                self._metric_results())
+
+    def predict_batch(self, inputs):
+        ins = self._as_tensors(_to_list(inputs))
+        if self._predict_step is None:
+            model = self
+
+            def raw(*args):
+                with paddle.no_grad():
+                    return tuple(_to_list(model.network(*args)))
+
+            self._predict_step = paddle.jit.to_static(raw)
+        outs = self._predict_step(*ins)
+        return [np.asarray(o.numpy()) for o in _to_list(outs)]
+
+    def _update_metrics(self, outs, labs):
+        for m in self._metrics:
+            r = m.compute(*(_to_list(outs) + list(labs)))
+            m.update(*[np.asarray(x.numpy()) if isinstance(x, Tensor) else x
+                       for x in _to_list(r)])
+
+    def _metric_results(self):
+        return [m.accumulate() for m in self._metrics]
+
+    # -- loops --------------------------------------------------------------
+
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """Reference Model.fit:1756. Trains for `epochs`, evaluating every
+        `eval_freq` epochs when eval_data is given."""
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) before fit"
+        self._save_dir = save_dir
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers, False)
+        try:
+            steps = len(loader)
+        except Exception:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                epochs=epochs, steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=["loss"] + [m.name()
+                                                    for m in self._metrics])
+        self.stop_training = False
+        cbks.on_begin("train")
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_batch_begin("train", step, logs)
+                ins, labs = self._split_batch(batch)
+                # gradient accumulation: only every k-th batch steps the
+                # optimizer; the others just add into .grad
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(ins, labs, update=update)
+                logs = self._result_logs(res)
+                cbks.on_batch_end("train", step, logs)
+                it += 1
+                if (num_iters is not None and it >= num_iters) or \
+                        self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks,
+                              num_workers=num_workers, _inner=True)
+            if (num_iters is not None and it >= num_iters) or \
+                    self.stop_training:
+                break
+        cbks.on_end("train", logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _inner=False):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers,
+                                   False)
+        for m in self._metrics:
+            m.reset()
+        cbks = callbacks if _inner else config_callbacks(
+            callbacks, model=self, batch_size=batch_size, log_freq=log_freq,
+            verbose=verbose,
+            metrics=["loss"] + [m.name() for m in self._metrics])
+        try:
+            n = len(loader)
+        except Exception:
+            n = None
+        cbks.on_begin("eval", {"steps": n})
+        logs = {}
+        seen = 0
+        for step, batch in enumerate(loader):
+            cbks.on_batch_begin("eval", step, logs)
+            ins, labs = self._split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            logs = self._result_logs(res)
+            cbks.on_batch_end("eval", step, logs)
+            seen += 1
+            if num_samples is not None and seen * batch_size >= num_samples:
+                break
+        cbks.on_end("eval", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers,
+                                   False)
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                verbose=verbose, metrics=[])
+        cbks.on_begin("predict")
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_batch_begin("predict", step, {})
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+            cbks.on_batch_end("predict", step, {})
+        cbks.on_end("predict")
+        # transpose [steps][n_out] -> [n_out][steps]
+        outs = list(map(list, zip(*outputs))) if outputs else []
+        if stack_outputs:
+            outs = [np.concatenate(o, axis=0) for o in outs]
+        return outs
+
+    def _result_logs(self, res):
+        if self._metrics:
+            losses, metrics = res
+            logs = {"loss": losses[0]}
+            for m, r in zip(self._metrics, metrics):
+                names = _to_list(m.name())
+                for nm, v in zip(names, _to_list(r)):
+                    logs[nm] = v
+            return logs
+        return {"loss": res[0]}
+
+    # -- persistence / info -------------------------------------------------
+
+    def save(self, path, training=True):
+        """path.pdparams (+ path.pdopt when training). Reference
+        Model.save:1358."""
+        import os
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        """Reference Model.load:1425."""
+        import os
+        state = paddle.load(path + ".pdparams")
+        if skip_mismatch:
+            own = self.network.state_dict()
+            state = {k: v for k, v in state.items()
+                     if k in own and list(own[k].shape) == list(v.shape)}
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(paddle.load(opt_path))
+        self._train_step = None  # recompile against the restored state
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        """Parameter-count summary (reference hapi/model_summary.py)."""
+        rows = []
+        total = 0
+        trainable = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            if not p.stop_gradient:
+                trainable += n
+            rows.append((name, list(p.shape), n))
+        width = max([len(r[0]) for r in rows], default=20) + 2
+        lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':>12}"]
+        lines += [f"{n:<{width}}{str(s):<20}{c:>12,}" for n, s, c in rows]
+        lines += [f"Total params: {total:,}",
+                  f"Trainable params: {trainable:,}",
+                  f"Non-trainable params: {total - trainable:,}"]
+        out = "\n".join(lines)
+        print(out)
+        return {"total_params": total, "trainable_params": trainable}

@@ -24,6 +24,11 @@ _worker_info = threading.local()
 
 
 def get_worker_info():
+    """WorkerInfo inside a loader worker (process or thread), else None."""
+    from .worker import get_worker_info as _mp_info
+    info = _mp_info()
+    if info is not None:
+        return info
     return getattr(_worker_info, "info", None)
 
 
@@ -60,6 +65,13 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        # num_workers>0 defaults to forked worker processes (reference
+        # semantics); use_buffer_reader=False keeps the in-process thread
+        # pool instead (e.g. datasets holding device arrays, which must not
+        # cross a fork)
+        self.use_multiprocess = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -104,10 +116,38 @@ class DataLoader:
             samples = [self.dataset[i] for i in batch]
         return self.collate_fn(samples)
 
+    def _np_tree_to_tensors(self, data):
+        """Numpy tree from a worker process -> Tensor tree on device."""
+        from ..core.tensor import to_tensor
+        if isinstance(data, np.ndarray):
+            return to_tensor(data)
+        if isinstance(data, dict):
+            return {k: self._np_tree_to_tensors(v) for k, v in data.items()}
+        if isinstance(data, (tuple, list)):
+            return type(data)(self._np_tree_to_tensors(v) for v in data)
+        return data
+
     def __iter__(self):
         if self.num_workers == 0:
             for batch in self._index_batches():
                 yield self._fetch(batch)
+            return
+        if self.use_multiprocess:
+            # reference io/reader.py:216 semantics: num_workers>0 = forked
+            # worker processes, numpy collate in-worker, shm transport for
+            # large arrays, ordered reassembly in the parent
+            from .worker import MultiprocessLoaderIter, np_collate
+            collate = np_collate if self.collate_fn is default_collate_fn \
+                else self.collate_fn
+            yield from MultiprocessLoaderIter(
+                self.dataset,
+                [] if self._iterable else self._index_batches(),
+                self.num_workers, collate, self._np_tree_to_tensors,
+                prefetch_factor=self.prefetch_factor,
+                worker_init_fn=self.worker_init_fn,
+                timeout=self.timeout, iterable=self._iterable,
+                batch_size=getattr(self, "batch_size", None),
+                use_shm=self.use_shared_memory)
             return
         # thread-pool prefetch pipeline
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:

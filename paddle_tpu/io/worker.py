@@ -1,0 +1,266 @@
+"""Multiprocess DataLoader workers (reference: python/paddle/io/reader.py:216
+and dataloader/worker.py _worker_loop).
+
+Design: N forked worker processes each own an index queue; the parent deals
+batch indices round-robin and reassembles results in order. Workers collate
+to numpy in-process (CPU-parallel decode/augment) and ship arrays to the
+parent; arrays above a threshold ride POSIX shared memory instead of the
+pickle pipe (the reference's _shared_memory path). Device transfer stays in
+the parent: jnp.asarray on the collated numpy batch is XLA's async H2D.
+"""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import os
+import queue as queue_mod
+import threading
+import traceback
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+
+import numpy as np
+
+_SHM_THRESHOLD = 1 << 20  # 1 MiB: below this, pickling beats shm setup cost
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    seed: int
+    dataset: object
+
+
+_worker_state = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_state, "info", None)
+
+
+def _set_worker_info(info):
+    _worker_state.info = info
+
+
+# -- shm-aware array transport ----------------------------------------------
+
+def _encode(obj):
+    """Replace large ndarrays in a (possibly nested) batch with shm refs."""
+    if isinstance(obj, np.ndarray) and obj.nbytes >= _SHM_THRESHOLD:
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        dst = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+        dst[...] = obj
+        name = shm.name
+        shm.close()  # parent reopens by name; creator's mapping not needed
+        return ("__shm__", name, obj.shape, str(obj.dtype))
+    if isinstance(obj, tuple):
+        return tuple(_encode(v) for v in obj)
+    if isinstance(obj, list):
+        return [_encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    return obj
+
+
+def _decode(obj):
+    if isinstance(obj, tuple):
+        if len(obj) == 4 and obj and obj[0] == "__shm__":
+            _, name, shape, dtype = obj
+            shm = shared_memory.SharedMemory(name=name)
+            try:
+                arr = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf).copy()
+            finally:
+                shm.close()
+                shm.unlink()
+            return arr
+        return tuple(_decode(v) for v in obj)
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _decode(v) for k, v in obj.items()}
+    return obj
+
+
+def np_collate(batch):
+    """Collate samples into numpy arrays (worker-side; no jax in workers)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: np_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(np_collate(list(items)) for items in zip(*batch))
+    # fall back: try numpy conversion (covers Tensor via __array__)
+    return np.stack([np.asarray(s) for s in batch])
+
+
+# -- worker loop -------------------------------------------------------------
+
+def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
+                 num_workers, init_fn, base_seed, iterable, use_shm):
+    _set_worker_info(WorkerInfo(worker_id, num_workers, base_seed + worker_id,
+                                dataset))
+    np.random.seed((base_seed + worker_id) % (2 ** 31))
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+    except Exception:
+        out_queue.put(("error", worker_id, traceback.format_exc()))
+        return
+    ds_iter = iter(dataset) if iterable else None
+    while True:
+        try:
+            job = index_queue.get()
+        except (EOFError, OSError):
+            return
+        if job is None:
+            return
+        batch_idx, payload = job
+        try:
+            if iterable:
+                # payload = batch size; worker draws from its own shard
+                samples = list(itertools.islice(ds_iter, payload))
+                if not samples:
+                    out_queue.put(("end", worker_id, batch_idx))
+                    continue
+            else:
+                samples = [dataset[i] for i in payload]
+            data = collate_fn(samples)
+            if use_shm:
+                data = _encode(data)
+            out_queue.put(("ok", worker_id, (batch_idx, data)))
+        except Exception:
+            out_queue.put(("error", worker_id, traceback.format_exc()))
+            return
+
+
+class MultiprocessLoaderIter:
+    """Ordered multiprocess iterator over index batches."""
+
+    def __init__(self, dataset, index_batches, num_workers, collate_np,
+                 to_output, prefetch_factor=2, worker_init_fn=None,
+                 timeout=0, iterable=False, batch_size=None, use_shm=True):
+        self._num_workers = num_workers
+        self._to_output = to_output
+        self._timeout = timeout if timeout else None
+        self._iterable = iterable
+        # fork is fastest and fine for numpy-only workers (they never touch
+        # jax); spawn/forkserver available for datasets that need it
+        method = os.environ.get(
+            "PADDLE_TPU_LOADER_START_METHOD",
+            "fork" if os.name == "posix" else "spawn")
+        ctx = mp.get_context(method)
+        self._out_queue = ctx.Queue()
+        self._index_queues = [ctx.SimpleQueue() for _ in range(num_workers)]
+        base_seed = int(np.random.randint(0, 2 ** 31 - 1))
+        self._workers = []
+        for w in range(num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(dataset, self._index_queues[w], self._out_queue,
+                      collate_np, w, num_workers, worker_init_fn, base_seed,
+                      iterable, use_shm),
+                daemon=True)
+            p.start()
+            self._workers.append(p)
+
+        self._batches = iter(index_batches)
+        self._batch_size = batch_size
+        self._send_idx = 0
+        self._rcvd_idx = 0
+        self._reorder = {}
+        self._ended_workers = set()
+        self._exhausted = False
+        for _ in range(num_workers * max(prefetch_factor, 1)):
+            self._dispatch_next()
+
+    def _dispatch_next(self):
+        if self._exhausted:
+            return False
+        if self._iterable:
+            payload = self._batch_size
+        else:
+            try:
+                payload = next(self._batches)
+            except StopIteration:
+                self._exhausted = True
+                return False
+        w = self._send_idx % self._num_workers
+        if w in self._ended_workers:
+            # iterable shard drained; try the next live worker
+            live = [i for i in range(self._num_workers)
+                    if i not in self._ended_workers]
+            if not live:
+                self._exhausted = True
+                return False
+            w = live[self._send_idx % len(live)]
+        self._index_queues[w].put((self._send_idx, payload))
+        self._send_idx += 1
+        return True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._rcvd_idx in self._reorder:
+                data = self._reorder.pop(self._rcvd_idx)
+                self._rcvd_idx += 1
+                if data is _SKIP:
+                    continue
+                self._dispatch_next()
+                return self._to_output(data)
+            if self._rcvd_idx >= self._send_idx:
+                self.shutdown()
+                raise StopIteration
+            try:
+                kind, w, payload = self._out_queue.get(timeout=self._timeout)
+            except queue_mod.Empty:
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker timed out after {self._timeout}s")
+            except KeyboardInterrupt:
+                self.shutdown()
+                raise
+            if kind == "error":
+                self.shutdown()
+                raise RuntimeError(f"DataLoader worker {w} failed:\n{payload}")
+            if kind == "end":
+                self._ended_workers.add(w)
+                self._reorder[payload] = _SKIP
+                continue
+            batch_idx, data = payload
+            self._reorder[batch_idx] = _decode(data)
+
+    def shutdown(self):
+        for q, p in zip(self._index_queues, self._workers):
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self._workers:
+            p.join(timeout=2)
+            if p.is_alive():
+                p.terminate()
+        self._workers = []
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+class _Skip:
+    pass
+
+
+_SKIP = _Skip()

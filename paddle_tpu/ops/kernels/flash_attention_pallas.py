@@ -21,10 +21,11 @@ NEG_INF = -1e30  # wrapped in jnp.float32 at use sites (x64 safety)
 LSE_LANES = 128  # lse/delta stored [.., S, 128]: Mosaic wants full-lane layouts
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, block_k,
                  seq_len, scale, block_q):
     # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq, d]; o_ref: [1, block_q, d]
-    # lse_ref: [1, block_q] (logsumexp of the scaled logits, for backward)
+    # maybe_lse_ref: ([1, block_q, LSE_LANES],) on the vjp path (logsumexp of
+    # the scaled logits, for backward); empty on the primal-only path
     d = q_ref.shape[-1]
     q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)
     q_blk = pl.program_id(1)
@@ -67,7 +68,9 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
 
     l_safe = jnp.maximum(l, jnp.float32(1e-30))
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (block_q, LSE_LANES))
+    if maybe_lse_ref:
+        maybe_lse_ref[0][0] = jnp.broadcast_to(m + jnp.log(l_safe),
+                                               (block_q, LSE_LANES))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
@@ -111,10 +114,35 @@ def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2), lse[:, :, 0]
 
 
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
 def flash_attention_forward(q, k, v, causal=False, block_q=256, block_k=256,
                             interpret=False):
-    return flash_attention_forward_lse(q, k, v, causal=causal, block_q=block_q,
-                                       block_k=block_k, interpret=interpret)[0]
+    """Primal-only forward: no logsumexp output (inference path)."""
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq {s} must divide block sizes {block_q}/{block_k}")
+    scale = 1.0 / math.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_attn_kernel, causal=causal, block_k=block_k,
+                              seq_len=s, scale=scale, block_q=block_q),
+            grid=(b * h, s // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+                pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+            out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            interpret=interpret,
+        )(qt, kt, vt)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,

@@ -1,0 +1,216 @@
+"""hapi Model.fit/evaluate/predict (reference hapi/model.py:1054)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class _ClsDs(Dataset):
+    """Linearly separable 2-class toy problem (numpy-only: forkable)."""
+
+    def __init__(self, n=128, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.standard_normal((n, 8)).astype(np.float32)
+        w = rng.standard_normal((8,)).astype(np.float32)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+
+
+def test_fit_reduces_loss_and_tracks_accuracy(capsys):
+    paddle.seed(0)
+    model = paddle.Model(_mlp())
+    model.prepare(paddle.optimizer.Adam(1e-2,
+                                        parameters=model.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    ds = _ClsDs()
+    model.fit(ds, ds, batch_size=32, epochs=3, verbose=2, log_freq=2)
+    logs = model.evaluate(ds, batch_size=32, verbose=0)
+    assert logs["loss"] < 0.5
+    assert logs["acc"] > 0.8
+    out = capsys.readouterr().out
+    assert "Epoch 1/3" in out and "loss" in out
+
+
+def test_fit_with_multiprocess_loader():
+    paddle.seed(0)
+    model = paddle.Model(_mlp())
+    model.prepare(paddle.optimizer.Adam(1e-2,
+                                        parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    model.fit(_ClsDs(), batch_size=32, epochs=2, verbose=0, num_workers=2)
+    logs = model.evaluate(_ClsDs(), batch_size=32, verbose=0, num_workers=2)
+    assert logs["loss"] < 0.6
+
+
+def test_predict_stacks_outputs():
+    class XOnly(Dataset):
+        def __init__(self, n):
+            self.x = _ClsDs(n).x
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i]
+
+    paddle.seed(0)
+    model = paddle.Model(_mlp())
+    model.prepare(loss=None)
+    outs = model.predict(XOnly(40), batch_size=16, stack_outputs=True,
+                         verbose=0)
+    assert len(outs) == 1 and outs[0].shape == (40, 2)
+
+
+def test_save_load_roundtrip(tmp_path):
+    paddle.seed(0)
+    model = paddle.Model(_mlp())
+    opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    ds = _ClsDs(n=64)
+    model.fit(ds, batch_size=32, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    paddle.seed(1)
+    model2 = paddle.Model(_mlp())
+    opt2 = paddle.optimizer.Adam(1e-2, parameters=model2.parameters())
+    model2.prepare(opt2, nn.CrossEntropyLoss())
+    model2.load(path)
+    x = paddle.to_tensor(_ClsDs(n=4).x)
+    np.testing.assert_allclose(
+        np.asarray(model.network(x).numpy()),
+        np.asarray(model2.network(x).numpy()), rtol=1e-6)
+
+
+def test_early_stopping_stops():
+    paddle.seed(0)
+    model = paddle.Model(_mlp())
+    model.prepare(paddle.optimizer.Adam(0.0,  # lr 0: loss never improves
+                                        parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    es = paddle.callbacks.EarlyStopping(monitor="loss", patience=1,
+                                        save_best_model=False, verbose=0)
+    ds = _ClsDs(n=64)
+    model.fit(ds, ds, batch_size=32, epochs=10, verbose=0, callbacks=[es])
+    assert model.stop_training
+
+
+def test_model_checkpoint_saves(tmp_path):
+    paddle.seed(0)
+    model = paddle.Model(_mlp())
+    model.prepare(paddle.optimizer.Adam(1e-2,
+                                        parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    model.fit(_ClsDs(n=64), batch_size=32, epochs=2, verbose=0,
+              save_dir=str(tmp_path))
+    assert os.path.exists(str(tmp_path / "0.pdparams"))
+    assert os.path.exists(str(tmp_path / "final.pdparams"))
+
+
+def test_summary_counts_params(capsys):
+    model = paddle.Model(_mlp())
+    info = model.summary()
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
+    assert "Total params" in capsys.readouterr().out
+
+
+def test_gpt2_trains_via_model_fit():
+    """The VERDICT item: GPT-2 trains through Model.fit with a multiprocess
+    DataLoader."""
+    from paddle_tpu.models import GPTConfig, GPT
+
+    class LMDs(Dataset):
+        def __init__(self, n=16, seq=17, vocab=128):
+            rng = np.random.default_rng(0)
+            self.toks = rng.integers(0, vocab, (n, seq + 1))
+
+        def __len__(self):
+            return len(self.toks)
+
+        def __getitem__(self, i):
+            row = self.toks[i]
+            return row[:-1].astype(np.int32), row[1:].astype(np.int64)
+
+    class GPTWithLoss(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.gpt = GPT(GPTConfig(vocab_size=128,
+                                     max_position_embeddings=32,
+                                     hidden_size=32, num_layers=2,
+                                     num_heads=4))
+
+        def forward(self, ids):
+            return self.gpt(ids)
+
+    class NextTokenCE(nn.Layer):
+        def forward(self, logits, labels):
+            import paddle_tpu.nn.functional as F
+            return F.cross_entropy(
+                logits.reshape([-1, 128]).cast("float32"),
+                labels.reshape([-1]))
+
+    paddle.seed(0)
+    model = paddle.Model(GPTWithLoss())
+    model.prepare(paddle.optimizer.AdamW(
+        1e-3, parameters=model.parameters()), NextTokenCE())
+    model.fit(LMDs(), batch_size=8, epochs=4, verbose=0, num_workers=2,
+              drop_last=True)
+    logs = model.evaluate(LMDs(), batch_size=8, verbose=0)
+    assert logs["loss"] < 4.85  # log(128) ~ 4.852 at init; must improve
+
+
+def test_gradient_accumulation_matches_big_batch():
+    """k small batches with update=False + 1 update == one k*batch step
+    (optimizer SGD so the equivalence is exact up to lr scaling of summed
+    grads: we compare against a manual big-batch whose loss is the MEAN, so
+    accumulate with mean-reduction loss sums k mean-losses -> compare with
+    lr/k on the big batch)."""
+    ds = _ClsDs(n=32)
+    xs, ys = ds.x, ds.y
+
+    def make():
+        paddle.seed(7)
+        m = paddle.Model(_mlp())
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        m.prepare(opt, nn.CrossEntropyLoss())
+        return m
+
+    # accumulated: two half-batches, update on the second
+    m1 = make()
+    m1.train_batch([xs[:16]], [ys[:16]], update=False)
+    m1.train_batch([xs[16:]], [ys[16:]], update=True)
+
+    # equivalent single step: mean-CE over each half summed = 2 * mean over
+    # the full batch, so use lr scaled by 1/2... instead just replicate the
+    # exact accumulated objective with a manual double-backward eager step
+    m2 = make()
+    x_t = paddle.to_tensor(xs)
+    y_t = paddle.to_tensor(ys)
+    ce = nn.CrossEntropyLoss()
+    l1 = ce(m2.network(paddle.to_tensor(xs[:16])), paddle.to_tensor(ys[:16]))
+    l2 = ce(m2.network(paddle.to_tensor(xs[16:])), paddle.to_tensor(ys[16:]))
+    (l1 + l2).backward()
+    m2._optimizer.step()
+    m2._optimizer.clear_grad()
+
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(np.asarray(p1.numpy()),
+                                   np.asarray(p2.numpy()),
+                                   rtol=1e-5, atol=1e-6)

@@ -98,6 +98,19 @@ def test_custom_vjp_uses_pallas_backward():
         fa.force_interpret(False)
 
 
+def test_primal_only_forward_kernel():
+    """No-grad path uses the lse-free kernel and matches the reference."""
+    fa.force_interpret(True)
+    try:
+        q, k, v = _rand_qkv(s=64)
+        out = fa.flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_ref(q, k, v, True)),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        fa.force_interpret(False)
+
+
 def test_uneven_seq_falls_back():
     """seq not divisible by the block size -> XLA composite, still correct.
 
