@@ -1,8 +1,15 @@
 """MoE-aware global-norm clip (reference: incubate/distributed/models/moe/
-grad_clip.py ClipGradForMOEByGlobalNorm): expert params' grad norms are
-summed once per expert owner. In the SPMD model every grad is logically
-global, so the plain global norm is already correct; the class keeps the
-reference surface (is_expert_param_func, moe_group)."""
+grad_clip.py ClipGradForMOEByGlobalNorm).
+
+In the reference, each EP rank holds DIFFERENT experts, so the global norm
+must sum expert-grad norms across the moe_group (an allreduce) on top of the
+shared-param norms. In this framework all experts live in one stacked
+[E, ...] logical array (sharded over the expert axis), so a plain global
+norm already sums every expert's grad exactly once — the reference's
+cross-rank bookkeeping is subsumed by SPMD. Proof:
+tests/test_distributed.py::test_moe_grad_clip_matches_manual_global_norm
+checks the applied clip factor equals the hand-computed norm over normal +
+expert params together."""
 
 from __future__ import annotations
 

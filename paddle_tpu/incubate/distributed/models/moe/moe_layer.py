@@ -56,21 +56,38 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, experts, gate=None, moe_group=None,
                  mp_group=None, recompute_interval=0, top_k=2,
-                 capacity_factor=1.25, expert_parallel_axis="dp", name=None):
+                 capacity_factor=1.25, expert_parallel_axis="dp",
+                 shared_experts=None, name=None):
         super().__init__()
         self.d_model = d_model
         self.num_expert = len(experts)
         self.capacity_factor = capacity_factor
         self._axis = expert_parallel_axis
+        # one shared decision for gate world_size AND stacked-param
+        # sharding: the expert axis participates only when it divides the
+        # global expert count
+        from .....distributed.topology import get_mesh
+        mesh = get_mesh()
+        self._ep_size = 1
+        if mesh is not None and expert_parallel_axis in mesh.axis_names and \
+                self.num_expert % mesh.shape[expert_parallel_axis] == 0:
+            self._ep_size = mesh.shape[expert_parallel_axis]
         if gate is None or isinstance(gate, dict):
             cfg = gate or {}
             gtype = cfg.get("type", "gshard")
             top_k = cfg.get("top_k", top_k)
             cls = {"naive": NaiveGate, "gshard": GShardGate,
                    "switch": SwitchGate}[gtype]
-            gate = cls(d_model, self.num_expert, world_size=1, top_k=top_k)
+            # world_size = expert-axis size: `experts` is the GLOBAL list, so
+            # per-rank num_expert * world_size = len(experts) (the reference's
+            # tot_expert contract, moe_layer.py:263)
+            gate = cls(d_model, self.num_expert // self._ep_size,
+                       world_size=self._ep_size, top_k=top_k)
         self.gate = gate
         self.top_k = gate.top_k
+        # always-on experts added to every token's output (DeepSeekMoE /
+        # Qwen2-MoE shared experts; reference incubate moe shared variants)
+        self.shared_experts = shared_experts
 
         # stack expert params: [E, ...] sharded over the expert axis
         self._param_names, self._template_params, self._expert_fn = \
@@ -81,10 +98,7 @@ class MoELayer(Layer):
             stacked = Parameter(jnp.stack(per, axis=0),
                                 name=f"moe_experts.{pname}")
             from .....distributed.sharding_utils import mark_sharding
-            from .....distributed.topology import get_mesh
-            mesh = get_mesh()
-            if mesh is not None and self._axis in mesh.axis_names and \
-                    self.num_expert % mesh.shape[self._axis] == 0:
+            if self._ep_size > 1:
                 mark_sharding(stacked,
                               P(self._axis, *([None] * (stacked.ndim - 1))))
             self.add_parameter(f"expert_{j}", stacked)
@@ -109,12 +123,12 @@ class MoELayer(Layer):
             # top-k routing
             topv, topi = jax.lax.top_k(probs, k)          # [n, k]
             topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
-            onehot = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # [n, k, e]
+            route_oh = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # [n, k, e]
             # position of each token within its expert queue
-            pos = jnp.cumsum(onehot.reshape(-1, e), axis=0).reshape(n, k, e) \
-                - onehot  # 0-based arrival order
+            pos = jnp.cumsum(route_oh.reshape(-1, e), axis=0).reshape(n, k, e) \
+                - route_oh  # 0-based arrival order
             keep = pos < capacity
-            onehot = onehot * keep
+            onehot = route_oh * keep                      # post-capacity-drop
             pos_idx = jnp.einsum("nke->nk", pos * onehot).astype(jnp.int32)
             cap_oh = jax.nn.one_hot(jnp.where(jnp.sum(onehot, -1) > 0,
                                               pos_idx, capacity),
@@ -131,15 +145,21 @@ class MoELayer(Layer):
             expert_out = jax.vmap(run_one)(stacked_params, expert_in)  # [e,c,h]
             out = jnp.einsum("nec,ech->nh", combine,
                              expert_out.astype(jnp.float32)).astype(tok.dtype)
-            # aux load-balance loss (GShard): E * mean(prob) . mean(route)
+            # aux load-balance loss (GShard eq.(4), generalised to top-k):
+            # f_i = fraction of routing slots assigned to expert i BEFORE the
+            # capacity drop (load balance must see intended routing, not the
+            # post-drop truncation), m_i = mean gate prob; aux = E * f . m
             me = jnp.mean(probs, axis=0)
-            ce = jnp.mean(onehot[:, 0, :], axis=0)
+            ce = jnp.mean(jnp.sum(route_oh, axis=1) / k, axis=0)
             aux = jnp.sum(me * ce) * e
             return out, aux
 
         out, aux = _apply2(jfn, tokens, logits, self._stacked)
         self.l_aux = aux
-        return out.reshape(b_shape)
+        out = out.reshape(b_shape)
+        if self.shared_experts is not None:
+            out = out + self.shared_experts(x)
+        return out
 
 
 def _apply2(jfn, tokens, logits, stacked):
