@@ -188,6 +188,47 @@ def run_flash_ab(dev):
             "speedup": round(xla_ms / pallas_ms, 3)}
 
 
+def run_dit_bench(dev):
+    """DiT-S/2 training throughput (BASELINE.md ladder #4: 'trains;
+    throughput reported'): images/s for the jitted DDPM train step."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import DiTPipeline, dit_s_2
+
+    paddle.seed(0)
+    pipe = DiTPipeline(dit_s_2(input_size=32, num_classes=1000))
+    opt = paddle.optimizer.AdamW(1e-4, parameters=pipe.parameters())
+    b = 32
+    rng = np.random.default_rng(0)
+    x0 = paddle.to_tensor(
+        rng.standard_normal((b, 4, 32, 32)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 1000, b).astype(np.int64))
+    noise = paddle.to_tensor(
+        rng.standard_normal((b, 4, 32, 32)).astype(np.float32))
+    t = paddle.to_tensor(rng.integers(0, 1000, b).astype(np.int64))
+
+    @paddle.jit.to_static
+    def step(x0, y, noise, t):
+        loss = pipe(x0, y, noise, t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(2):
+        loss = step(x0, y, noise, t)
+    float(loss)
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x0, y, noise, t)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    return {"images_per_sec": round(b * steps / dt, 1),
+            "loss": round(final, 4), "batch": b,
+            "n_params": pipe.dit.num_params()}
+
+
 def _peak_flops(dev):
     """(bf16 peak FLOPs, source) from the device kind (spec sheets)."""
     kind = (getattr(dev, "device_kind", "") or "").lower()
@@ -277,6 +318,10 @@ def _child_main(mode):
                 result["extra"]["flash_ab"] = run_flash_ab(dev)
             except Exception:
                 errs["flash_ab_error"] = traceback.format_exc(limit=2)[:600]
+            try:
+                result["extra"]["dit_s2"] = run_dit_bench(dev)
+            except Exception:
+                errs["dit_bench_error"] = traceback.format_exc(limit=2)[:600]
             result.setdefault("extra", {}).update(errs)
         else:
             dev = _force_cpu()

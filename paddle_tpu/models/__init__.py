@@ -2,3 +2,9 @@ from .gpt import GPT, GPTConfig, gpt2_small, gpt2_tiny  # noqa: F401
 from .gpt_hybrid import gpt_for_pipeline, GPTPretrainLoss  # noqa: F401
 from .llama import (Llama, LlamaConfig, llama_tiny, llama3_8b,  # noqa: F401
                     llama_for_pipeline)
+from .qwen2_moe import (Qwen2Moe, Qwen2MoeConfig, qwen2_moe_tiny,  # noqa: F401
+                        deepseek_moe)
+from .ernie import (Ernie, ErnieConfig, ernie_tiny,  # noqa: F401
+                    ernie_for_pipeline, ErniePretrainLoss)
+from .dit import (DiT, DiTConfig, DiTPipeline, dit_tiny, dit_s_2,  # noqa: F401
+                  dit_xl_2)

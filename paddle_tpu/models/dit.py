@@ -1,0 +1,263 @@
+"""DiT — Diffusion Transformer (BASELINE.md ladder config #4: non-LLM
+coverage; target: trains, throughput reported).
+
+Reference shape: the DiT/SD3-class latent diffusion transformers trained by
+the reference's vision recipes (PaddleMIX ppdiffusers). Architecture is the
+published DiT: patchify -> N blocks of [adaLN-zero modulated attention +
+MLP] conditioned on (timestep, class) embeddings -> adaLN final layer ->
+unpatchify. Training objective: predict the noise added to latents at a
+uniformly sampled timestep (epsilon-prediction, DDPM schedule).
+
+TPU notes: attention rides scaled_dot_product_attention (Pallas flash kernel
+when seq = num_patches is block-aligned); all shapes static; the sampling
+loop uses a host loop over jitted steps.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["DiTConfig", "DiT", "DiTPipeline", "dit_tiny", "dit_s_2",
+           "dit_xl_2"]
+
+
+@dataclass
+class DiTConfig:
+    input_size: int = 32          # latent spatial size
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    num_layers: int = 28
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    class_dropout_prob: float = 0.1
+    num_train_timesteps: int = 1000
+    learn_sigma: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        return (self.input_size // self.patch_size) ** 2
+
+    @property
+    def out_channels(self) -> int:
+        return self.in_channels * (2 if self.learn_sigma else 1)
+
+
+def _timestep_embedding(t, dim, max_period=10000):
+    """Sinusoidal timestep embedding [B] -> [B, dim] (DiT reference)."""
+    half = dim // 2
+    freqs = paddle.to_tensor(
+        np.exp(-math.log(max_period) * np.arange(half, dtype=np.float32)
+               / half))
+    args = t.cast("float32").unsqueeze(-1) * freqs.unsqueeze(0)
+    return paddle.concat([paddle.cos(args), paddle.sin(args)], axis=-1)
+
+
+class TimestepEmbedder(nn.Layer):
+    def __init__(self, hidden_size, freq_dim=256):
+        super().__init__()
+        self.freq_dim = freq_dim
+        self.mlp = nn.Sequential(nn.Linear(freq_dim, hidden_size), nn.Silu(),
+                                 nn.Linear(hidden_size, hidden_size))
+
+    def forward(self, t):
+        return self.mlp(_timestep_embedding(t, self.freq_dim))
+
+
+class LabelEmbedder(nn.Layer):
+    """Class embedding with a null class for classifier-free guidance.
+    During training, labels are dropped to the null class with
+    `dropout_prob` so the null row learns the unconditional distribution."""
+
+    def __init__(self, num_classes, hidden_size, dropout_prob=0.0):
+        super().__init__()
+        self.table = nn.Embedding(num_classes + 1, hidden_size)
+        self.num_classes = num_classes
+        self.dropout_prob = dropout_prob
+
+    def forward(self, y):
+        if self.training and self.dropout_prob > 0:
+            drop = paddle.rand([y.shape[0]]) < self.dropout_prob
+            null = paddle.full_like(y, self.num_classes)
+            y = paddle.where(drop, null, y)
+        return self.table(y)
+
+
+def _modulate(x, shift, scale):
+    return x * (1 + scale.unsqueeze(1)) + shift.unsqueeze(1)
+
+
+class DiTBlock(nn.Layer):
+    """adaLN-zero transformer block (DiT paper, sec. 3.2)."""
+
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.norm1 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        self.attn_qkv = nn.Linear(h, 3 * h)
+        self.attn_out = nn.Linear(h, h)
+        self.norm2 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        m = int(h * cfg.mlp_ratio)
+        self.mlp = nn.Sequential(nn.Linear(h, m), nn.GELU(approximate=True),
+                                 nn.Linear(m, h))
+        # adaLN-zero: 6 modulation vectors, initialized to zero so each
+        # block starts as identity
+        zero = paddle.framework.ParamAttr(
+            initializer=nn.initializer.Constant(0.0))
+        self.adaLN = nn.Linear(h, 6 * h, weight_attr=zero, bias_attr=zero)
+        self.n_head = cfg.num_heads
+
+    def forward(self, x, c):
+        b, s, h = x.shape
+        mods = self.adaLN(F.silu(c))
+        sh_a, sc_a, g_a, sh_m, sc_m, g_m = paddle.split(mods, 6, axis=-1)
+        xa = _modulate(self.norm1(x), sh_a, sc_a)
+        qkv = self.attn_qkv(xa).reshape([b, s, 3, self.n_head,
+                                         h // self.n_head])
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        attn = F.scaled_dot_product_attention(q, k, v)
+        attn = self.attn_out(attn.reshape([b, s, h]))
+        x = x + g_a.unsqueeze(1) * attn
+        xm = _modulate(self.norm2(x), sh_m, sc_m)
+        return x + g_m.unsqueeze(1) * self.mlp(xm)
+
+
+class FinalLayer(nn.Layer):
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.norm = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                 bias_attr=False)
+        zero = paddle.framework.ParamAttr(
+            initializer=nn.initializer.Constant(0.0))
+        self.adaLN = nn.Linear(h, 2 * h, weight_attr=zero, bias_attr=zero)
+        self.proj = nn.Linear(h, cfg.patch_size ** 2 * cfg.out_channels,
+                              weight_attr=zero, bias_attr=zero)
+
+    def forward(self, x, c):
+        shift, scale = paddle.split(self.adaLN(F.silu(c)), 2, axis=-1)
+        return self.proj(_modulate(self.norm(x), shift, scale))
+
+
+class DiT(nn.Layer):
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        self.cfg = cfg
+        p, c, h = cfg.patch_size, cfg.in_channels, cfg.hidden_size
+        self.x_embed = nn.Linear(p * p * c, h)
+        # fixed 2d sin-cos positional embedding (DiT reference)
+        self.pos_embed = paddle.to_tensor(
+            _pos_embed_2d(h, cfg.input_size // p).astype(np.float32))
+        self.t_embed = TimestepEmbedder(h)
+        self.y_embed = LabelEmbedder(cfg.num_classes, h,
+                                      cfg.class_dropout_prob)
+        self.blocks = nn.LayerList([DiTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.final = FinalLayer(cfg)
+
+    def _patchify(self, x):
+        """[B, C, H, W] -> [B, n_patches, p*p*C]."""
+        b, c, hh, ww = x.shape
+        p = self.cfg.patch_size
+        x = x.reshape([b, c, hh // p, p, ww // p, p])
+        x = x.transpose([0, 2, 4, 3, 5, 1])
+        return x.reshape([b, (hh // p) * (ww // p), p * p * c])
+
+    def _unpatchify(self, x):
+        b = x.shape[0]
+        p = self.cfg.patch_size
+        g = self.cfg.input_size // p
+        c = self.cfg.out_channels
+        x = x.reshape([b, g, g, p, p, c])
+        x = x.transpose([0, 5, 1, 3, 2, 4])
+        return x.reshape([b, c, g * p, g * p])
+
+    def forward(self, x, t, y):
+        """x: [B, C, H, W] noised latents; t: [B] timesteps; y: [B] labels."""
+        tok = self.x_embed(self._patchify(x)) + self.pos_embed.unsqueeze(0)
+        c = self.t_embed(t) + self.y_embed(y)
+        for blk in self.blocks:
+            tok = blk(tok, c)
+        return self._unpatchify(self.final(tok, c))
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_image(self) -> float:
+        """Forward FLOPs for one image: 6N per patch token plus the
+        attention quadratic term (BASELINE.md analytic-MFU rule)."""
+        n = self.num_params()
+        s = self.cfg.num_patches
+        l, h = self.cfg.num_layers, self.cfg.hidden_size
+        return 6.0 * n * s + 12.0 * l * h * s * s
+
+
+def _pos_embed_2d(dim, grid):
+    """Fixed 2D sin-cos positional embedding [grid*grid, dim]."""
+    def _1d(d, pos):
+        omega = 1.0 / 10000 ** (np.arange(d // 2, dtype=np.float64) / (d / 2))
+        out = np.outer(pos.reshape(-1), omega)
+        return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+    ys, xs = np.meshgrid(np.arange(grid), np.arange(grid), indexing="ij")
+    return np.concatenate([_1d(dim // 2, ys), _1d(dim // 2, xs)], axis=1)
+
+
+class DiTPipeline(nn.Layer):
+    """DDPM training objective around DiT: q-sample latents at a random
+    timestep, predict epsilon, MSE loss (the reference diffusion recipes'
+    train step, TPU-jittable end to end)."""
+
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        self.dit = DiT(cfg)
+        self.cfg = cfg
+        betas = np.linspace(1e-4, 0.02, cfg.num_train_timesteps,
+                            dtype=np.float64)
+        ac = np.cumprod(1.0 - betas)
+        self._sqrt_ac = paddle.to_tensor(np.sqrt(ac).astype(np.float32))
+        self._sqrt_1mac = paddle.to_tensor(
+            np.sqrt(1.0 - ac).astype(np.float32))
+
+    def training_loss(self, x0, y, noise, t):
+        """x0: clean latents [B,C,H,W]; noise ~ N(0,1) same shape;
+        t: [B] int timesteps. Returns scalar MSE(eps_hat, eps)."""
+        a = self._sqrt_ac.index_select(t).reshape([-1, 1, 1, 1])
+        b = self._sqrt_1mac.index_select(t).reshape([-1, 1, 1, 1])
+        xt = a * x0 + b * noise
+        eps_hat = self.dit(xt, t, y)
+        if self.cfg.learn_sigma:
+            eps_hat = eps_hat[:, :self.cfg.in_channels]
+        return ((eps_hat - noise) ** 2).mean()
+
+    def forward(self, x0, y, noise, t):
+        return self.training_loss(x0, y, noise, t)
+
+
+def dit_tiny(**kw) -> DiTConfig:
+    cfg = dict(input_size=8, patch_size=2, in_channels=4, hidden_size=32,
+               num_layers=2, num_heads=4, num_classes=10)
+    cfg.update(kw)
+    return DiTConfig(**cfg)
+
+
+def dit_s_2(**kw) -> DiTConfig:
+    cfg = dict(hidden_size=384, num_layers=12, num_heads=6, patch_size=2)
+    cfg.update(kw)
+    return DiTConfig(**cfg)
+
+
+def dit_xl_2(**kw) -> DiTConfig:
+    cfg = dict(hidden_size=1152, num_layers=28, num_heads=16, patch_size=2)
+    cfg.update(kw)
+    return DiTConfig(**cfg)

@@ -1,0 +1,172 @@
+"""ERNIE-4.5 style decoder LM (BASELINE.md ladder config #2 — the
+native-Paddle flagship family; target: trains under hybrid parallel).
+
+Reference shape: the ERNIE-4.5 text backbone — a GQA decoder with SwiGLU
+MLPs where dense layers lead and MoE layers (with shared experts) follow
+(`first_k_dense`), tied or untied embeddings. The dense variant doubles as
+ERNIE 3.0-style pretraining when num_experts == 0.
+
+Hybrid-parallel: `ernie_for_pipeline` composes the same blocks from TP
+layers inside a PipelineLayer for the dp x mp x pp recipe, mirroring
+models/llama.py's hybrid variant.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+from ..distributed.meta_parallel import PipelineLayer
+from .llama import LlamaConfig, LlamaDecoderLayer, _rope_tables
+from .gpt_hybrid import GPTPretrainLoss as ErniePretrainLoss
+from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeDecoderLayer
+
+__all__ = ["ErnieConfig", "Ernie", "ernie_tiny", "ernie_for_pipeline",
+           "ErniePretrainLoss"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 103424
+    max_position_embeddings: int = 131072
+    hidden_size: int = 2560
+    num_layers: int = 28
+    num_heads: int = 20
+    num_kv_heads: int = 4
+    intermediate_size: int = 12288
+    # MoE tail (ERNIE-4.5: dense first k layers, MoE after); num_experts=0
+    # gives the fully dense ERNIE 3.0-style backbone
+    num_experts: int = 0
+    num_experts_per_tok: int = 6
+    moe_intermediate_size: int = 1536
+    shared_expert_intermediate_size: int = 1536
+    first_k_dense: int = 3
+    router_aux_loss_coef: float = 0.001
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            max_position_embeddings=self.max_position_embeddings,
+            hidden_size=self.hidden_size, num_layers=self.num_layers,
+            num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+            intermediate_size=self.intermediate_size,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            initializer_range=self.initializer_range,
+            tie_word_embeddings=self.tie_word_embeddings)
+
+    def as_moe(self) -> Qwen2MoeConfig:
+        return Qwen2MoeConfig(
+            vocab_size=self.vocab_size,
+            max_position_embeddings=self.max_position_embeddings,
+            hidden_size=self.hidden_size, num_layers=self.num_layers,
+            num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+            moe_intermediate_size=self.moe_intermediate_size,
+            shared_expert_intermediate_size=(
+                self.shared_expert_intermediate_size),
+            num_experts=self.num_experts,
+            num_experts_per_tok=self.num_experts_per_tok,
+            first_k_dense_replace=self.first_k_dense,
+            dense_intermediate_size=self.intermediate_size,
+            router_aux_loss_coef=self.router_aux_loss_coef,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            initializer_range=self.initializer_range)
+
+
+class Ernie(nn.Layer):
+    """Dense-leading decoder; MoE tail when num_experts > 0."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        lcfg = cfg.as_llama()
+        attr = paddle.framework.ParamAttr(
+            initializer=nn.initializer.Normal(0.0, cfg.initializer_range))
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                         weight_attr=attr)
+        layers = []
+        mcfg = cfg.as_moe() if cfg.num_experts else None
+        for i in range(cfg.num_layers):
+            if cfg.num_experts and i >= cfg.first_k_dense:
+                layers.append(Qwen2MoeDecoderLayer(mcfg, i))
+            else:
+                layers.append(LlamaDecoderLayer(lcfg))
+        self.layers = nn.LayerList(layers)
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     weight_attr=attr, bias_attr=False)
+        self._rope_cache: dict[int, tuple] = {}
+        self.l_aux = None
+
+    def _rope(self, s):
+        if s not in self._rope_cache:
+            self._rope_cache[s] = _rope_tables(self.cfg.as_llama(), s)
+        return self._rope_cache[s]
+
+    def forward(self, input_ids, labels=None):
+        cos, sin = self._rope(input_ids.shape[1])
+        x = self.embed_tokens(input_ids)
+        auxes = []
+        for layer in self.layers:
+            x = layer(x, cos, sin)
+            aux = getattr(layer, "l_aux", None)
+            if aux is not None:
+                auxes.append(aux)
+        x = self.norm(x)
+        if self.cfg.tie_word_embeddings:
+            logits = paddle.matmul(x, self.embed_tokens.weight,
+                                   transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        self.l_aux = sum(auxes[1:], auxes[0]) if auxes else None
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.cfg.vocab_size]).cast("float32"),
+                labels.reshape([-1]))
+            if self.l_aux is not None:
+                loss = loss + self.cfg.router_aux_loss_coef * self.l_aux
+            return logits, loss
+        return logits
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        n = self.num_params()
+        l, h = self.cfg.num_layers, self.cfg.hidden_size
+        return 6.0 * n + 12.0 * l * h * seq_len / 2
+
+
+def ernie_for_pipeline(cfg: ErnieConfig, seq_len: int,
+                       num_stages=None) -> PipelineLayer:
+    """PipelineLayer ERNIE for the hybrid dp x mp x pp recipe. The dense
+    backbone is architecturally a Llama stack, so the desc layout (tied
+    embeddings via SharedLayerDesc, TP blocks) is delegated to
+    llama_for_pipeline — one copy of the wiring to maintain.
+
+    The MoE tail cannot be pipelined yet (MoELayer has no TP/pp block
+    form); raising beats silently training a dense model as 'MoE ERNIE'."""
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "ernie_for_pipeline supports the dense backbone only; "
+            "set num_experts=0 (MoE pipeline stages not implemented)")
+    from .llama import llama_for_pipeline
+    return llama_for_pipeline(cfg.as_llama(), seq_len, num_stages=num_stages)
+
+
+def ernie_tiny(**kw) -> Ernie:
+    cfg = dict(vocab_size=256, max_position_embeddings=64, hidden_size=32,
+               num_layers=2, num_heads=4, num_kv_heads=2,
+               intermediate_size=64)
+    cfg.update(kw)
+    return Ernie(ErnieConfig(**cfg))

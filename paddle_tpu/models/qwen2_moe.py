@@ -1,0 +1,191 @@
+"""Qwen2-MoE / DeepSeekMoE style decoder LM (BASELINE.md ladder config #5).
+
+Reference shape: PaddleNLP llm qwen2moe/deepseek recipes over the incubate
+MoE stack (reference moe_layer.py:263). TPU design: Llama-style blocks whose
+MLP is the GShard-einsum MoELayer (stacked [E,...] experts sharded over the
+expert mesh axis; XLA partitions the dispatch/combine einsums into the
+all-to-all pair), with the Qwen2-MoE/DeepSeekMoE signature features:
+always-on shared experts alongside routed ones, and optional dense first
+layers (DeepSeekMoE's `first_k_dense_replace`).
+
+The per-layer aux losses are summed into `model.l_aux` and added to the LM
+loss scaled by `router_aux_loss_coef`.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+from ..incubate.distributed.models.moe import MoELayer
+from .llama import LlamaConfig, LlamaDecoderLayer, _rope_tables
+
+__all__ = ["Qwen2MoeConfig", "Qwen2Moe", "qwen2_moe_tiny", "deepseek_moe"]
+
+
+@dataclass
+class Qwen2MoeConfig:
+    vocab_size: int = 151936
+    max_position_embeddings: int = 8192
+    hidden_size: int = 2048
+    num_layers: int = 24
+    num_heads: int = 16
+    num_kv_heads: int = 16
+    moe_intermediate_size: int = 1408   # per-expert ffn width
+    shared_expert_intermediate_size: int = 5632
+    num_experts: int = 60
+    num_experts_per_tok: int = 4
+    first_k_dense_replace: int = 0      # DeepSeekMoE: dense first k layers
+    dense_intermediate_size: int = 5632
+    router_aux_loss_coef: float = 0.001
+    capacity_factor: float = 2.0
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1e6
+    initializer_range: float = 0.02
+    expert_parallel_axis: str = "dp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            max_position_embeddings=self.max_position_embeddings,
+            hidden_size=self.hidden_size, num_layers=self.num_layers,
+            num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+            intermediate_size=self.dense_intermediate_size,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            initializer_range=self.initializer_range)
+
+
+class _SwiGLU(nn.Layer):
+    def __init__(self, h, m, init_range, n_layers):
+        super().__init__()
+        attr = paddle.framework.ParamAttr(
+            initializer=nn.initializer.Normal(0.0, init_range))
+        d_attr = paddle.framework.ParamAttr(
+            initializer=nn.initializer.Normal(
+                0.0, init_range / math.sqrt(2 * n_layers)))
+        self.gate_proj = nn.Linear(h, m, weight_attr=attr, bias_attr=False)
+        self.up_proj = nn.Linear(h, m, weight_attr=attr, bias_attr=False)
+        self.down_proj = nn.Linear(m, h, weight_attr=d_attr, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(paddle.swiglu(self.gate_proj(x),
+                                            self.up_proj(x)))
+
+
+class Qwen2MoeDecoderLayer(LlamaDecoderLayer):
+    """LlamaDecoderLayer with the MLP swapped for the routed-MoE block —
+    norms, attention, and the fused-residual forward are inherited, so the
+    TPU-sensitive kernel call sequence lives in exactly one place."""
+
+    def __init__(self, cfg: Qwen2MoeConfig, layer_idx: int):
+        super().__init__(cfg.as_llama())
+        self.is_dense = layer_idx < cfg.first_k_dense_replace
+        if self.is_dense:
+            self.mlp = _SwiGLU(cfg.hidden_size, cfg.dense_intermediate_size,
+                               cfg.initializer_range, cfg.num_layers)
+        else:
+            experts = [_SwiGLU(cfg.hidden_size, cfg.moe_intermediate_size,
+                               cfg.initializer_range, cfg.num_layers)
+                       for _ in range(cfg.num_experts)]
+            shared = None
+            if cfg.shared_expert_intermediate_size:
+                shared = _SwiGLU(cfg.hidden_size,
+                                 cfg.shared_expert_intermediate_size,
+                                 cfg.initializer_range, cfg.num_layers)
+            self.mlp = MoELayer(
+                d_model=cfg.hidden_size, experts=experts,
+                gate={"type": "gshard", "top_k": cfg.num_experts_per_tok},
+                capacity_factor=cfg.capacity_factor,
+                expert_parallel_axis=cfg.expert_parallel_axis,
+                shared_experts=shared)
+
+    @property
+    def l_aux(self):
+        return None if self.is_dense else self.mlp.l_aux
+
+
+class Qwen2Moe(nn.Layer):
+    def __init__(self, cfg: Qwen2MoeConfig):
+        super().__init__()
+        self.cfg = cfg
+        attr = paddle.framework.ParamAttr(
+            initializer=nn.initializer.Normal(0.0, cfg.initializer_range))
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                         weight_attr=attr)
+        self.layers = nn.LayerList(
+            [Qwen2MoeDecoderLayer(cfg, i) for i in range(cfg.num_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                 weight_attr=attr, bias_attr=False)
+        self._rope_cache: dict[int, tuple] = {}
+        self.l_aux = None
+
+    def _rope(self, s):
+        if s not in self._rope_cache:
+            self._rope_cache[s] = _rope_tables(self.cfg.as_llama(), s)
+        return self._rope_cache[s]
+
+    def forward(self, input_ids, labels=None):
+        cos, sin = self._rope(input_ids.shape[1])
+        x = self.embed_tokens(input_ids)
+        auxes = []
+        for layer in self.layers:
+            x = layer(x, cos, sin)
+            if layer.l_aux is not None:
+                auxes.append(layer.l_aux)
+        x = self.norm(x)
+        logits = self.lm_head(x)
+        self.l_aux = sum(auxes[1:], auxes[0]) if auxes else None
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.cfg.vocab_size]).cast("float32"),
+                labels.reshape([-1]))
+            if self.l_aux is not None:
+                loss = loss + self.cfg.router_aux_loss_coef * self.l_aux
+            return logits, loss
+        return logits
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def num_activated_params(self) -> int:
+        """Params touched per token (dense + shared + top_k experts)."""
+        total = self.num_params()
+        for layer in self.layers:
+            if not layer.is_dense:
+                per_expert = sum(p.size for p in layer.mlp._stacked) \
+                    // self.cfg.num_experts
+                inactive = self.cfg.num_experts - self.cfg.num_experts_per_tok
+                total -= per_expert * inactive
+        return total
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """6 * activated params + causal attention correction."""
+        n = self.num_activated_params()
+        l, h = self.cfg.num_layers, self.cfg.hidden_size
+        return 6.0 * n + 12.0 * l * h * seq_len / 2
+
+
+def qwen2_moe_tiny(**kw) -> Qwen2Moe:
+    cfg = dict(vocab_size=256, max_position_embeddings=64, hidden_size=32,
+               num_layers=2, num_heads=4, num_kv_heads=2,
+               moe_intermediate_size=32, shared_expert_intermediate_size=64,
+               num_experts=4, num_experts_per_tok=2)
+    cfg.update(kw)
+    return Qwen2Moe(Qwen2MoeConfig(**cfg))
+
+
+def deepseek_moe(**kw) -> Qwen2Moe:
+    """DeepSeekMoE flavour: dense first layer, many small experts."""
+    cfg = dict(first_k_dense_replace=1, num_experts=64,
+               num_experts_per_tok=6, moe_intermediate_size=1408,
+               shared_expert_intermediate_size=2816)
+    cfg.update(kw)
+    return Qwen2Moe(Qwen2MoeConfig(**cfg))
