@@ -355,8 +355,12 @@ def is_tensor(x) -> bool:
 
 
 # Register Tensor as a pytree so jitted functions can take/return Tensors.
+# aux carries stop_gradient ONLY: auto-generated tensor names are unique per
+# instance, and putting them in the treedef made every jit.to_static cache
+# key distinct — each train step silently recompiled instead of hitting the
+# compiled-program cache
 jax.tree_util.register_pytree_node(
     Tensor,
-    lambda t: ((t._data,), (t.stop_gradient, t.name)),
-    lambda aux, children: Tensor(children[0], stop_gradient=aux[0], name=aux[1]),
+    lambda t: ((t._data,), (t.stop_gradient,)),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux[0]),
 )

@@ -1,2 +1,2 @@
 from .api import to_static, not_to_static, in_to_static_trace, enable_to_static, ignore_module  # noqa: F401
-from .save_load import save, load, TranslatedLayer  # noqa: F401
+from .save_load import save, load, TranslatedLayer, InputSpec  # noqa: F401

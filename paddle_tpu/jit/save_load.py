@@ -1,10 +1,15 @@
 """`paddle.jit.save/load` (reference: python/paddle/jit/api.py save/load +
 translated_layer.py TranslatedLayer).
 
-Serialization: model structure is saved as the AOT-lowered StableHLO text of
-the traced forward (per input spec) plus the state dict — the TPU analog of
-the reference's Program + params format. Loading returns a TranslatedLayer
-that executes the compiled program.
+Serialization: the traced forward is exported with `jax.export` — versioned,
+portable StableHLO bytes (the TPU analog of the reference's Program format) —
+alongside the numpy state dict. Loading returns a TranslatedLayer whose
+forward EXECUTES the deserialized program (no access to the original Python
+class needed), which is the reference's deploy/inference contract
+(translated_layer.py: program + persistable vars -> runnable layer).
+
+A human-readable `.pdmodel.txt` with the StableHLO text is written next to
+the binary for inspection parity with `paddle.static.Program.__str__`.
 """
 
 from __future__ import annotations
@@ -15,6 +20,7 @@ import pickle
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import export as jax_export
 
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
@@ -34,14 +40,40 @@ class InputSpec:
         return jax.ShapeDtypeStruct(shape, self.dtype.np_dtype)
 
 
+def _spec_structs(input_spec):
+    """ShapeDtypeStructs for export; -1/None dims become jax.export symbolic
+    dimensions so the serialized program stays batch-polymorphic."""
+    structs = []
+    n_sym = 0
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            dims = []
+            for d in s.shape:
+                if d == -1:
+                    dims.append(jax_export.symbolic_shape(f"_d{n_sym}")[0])
+                    n_sym += 1
+                else:
+                    dims.append(d)
+            structs.append(jax.ShapeDtypeStruct(tuple(dims),
+                                                s.dtype.np_dtype))
+        elif isinstance(s, Tensor):
+            structs.append(jax.ShapeDtypeStruct(tuple(s.shape),
+                                                s._data.dtype))
+        else:
+            structs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+    return structs
+
+
 def save(layer, path, input_spec=None, **configs):
-    """Serialize layer: state dict + (optionally) lowered StableHLO."""
+    """Serialize `layer`: state dict + exported program per input spec.
+
+    Reference api.py `paddle.jit.save`: path gets `.pdmodel` (program) — here
+    one pickle holding numpy params and jax.export bytes.
+    """
     state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
     payload = {"state": state, "class": type(layer).__name__}
     if input_spec:
-        structs = [s.to_struct() if isinstance(s, InputSpec) else
-                   jax.ShapeDtypeStruct(tuple(s.shape), s._data.dtype)
-                   for s in input_spec]
+        structs = _spec_structs(input_spec)
 
         def fn(params, *xs):
             saved = {}
@@ -50,23 +82,39 @@ def save(layer, path, input_spec=None, **configs):
                 saved[k] = t._d
                 t._d = params[k]
             try:
-                out = layer(*[Tensor(x) for x in xs])
+                from ..autograd.grad_mode import no_grad
+                with no_grad():
+                    out = layer(*[Tensor(x) for x in xs])
             finally:
                 for k, t in sd.items():
                     t._d = saved[k]
+            if isinstance(out, (tuple, list)):
+                payload["out_is_tuple"] = True
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in out)
+            payload["out_is_tuple"] = False
             return out._data if isinstance(out, Tensor) else out
-        lowered = jax.jit(fn).lower(
-            {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in state.items()},
-            *structs)
-        payload["stablehlo"] = lowered.as_text()
-        payload["in_shapes"] = [(tuple(s.shape), str(s.dtype)) for s in structs]
+
+        param_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                         for k, v in state.items()}
+        exported = jax_export.export(jax.jit(fn))(param_structs, *structs)
+        payload["exported"] = exported.serialize()
+        payload["in_shapes"] = [
+            (tuple(d if isinstance(d, int) else str(d) for d in s.shape),
+             str(s.dtype)) for s in structs]  # symbolic dims as strings
+        payload["stablehlo"] = exported.mlir_module()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(payload, f, protocol=4)
+    if "stablehlo" in payload:
+        with open(path + ".pdmodel.txt", "w") as f:
+            f.write(payload["stablehlo"])
 
 
 class TranslatedLayer(Layer):
-    """Deserialized inference layer (reference: translated_layer.py:?)."""
+    """Deserialized inference layer (reference: translated_layer.py
+    TranslatedLayer): executes the exported program against the restored
+    params — the original Python class is NOT required."""
 
     def __init__(self, payload):
         super().__init__()
@@ -77,13 +125,31 @@ class TranslatedLayer(Layer):
         for k, p in self._state.items():
             self.add_parameter(k.replace(".", "__"), p)
         self._program_text = payload.get("stablehlo")
+        self._exported = None
+        if payload.get("exported") is not None:
+            self._exported = jax_export.deserialize(payload["exported"])
 
     def forward(self, *xs):
-        raise NotImplementedError(
-            "TranslatedLayer executes via its original class; StableHLO "
-            "execution shim lands with the inference engine (SURVEY.md §2.4)")
+        if self._exported is None:
+            raise RuntimeError(
+                "this model was saved without input_spec, so no program was "
+                "exported; re-save with paddle.jit.save(layer, path, "
+                "input_spec=[...])")
+        params = {k: p._d for k, p in self._state.items()}
+        arrs = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in xs]
+        out = self._exported.call(params, *arrs)
+        if self._payload.get("out_is_tuple") or isinstance(out, (tuple,
+                                                                 list)):
+            # preserve the saved layer's return contract exactly: a layer
+            # that returned a 1-tuple must still return a 1-tuple
+            out_t = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(Tensor(o, stop_gradient=True) for o in out_t)
+        return Tensor(out, stop_gradient=True)
 
     def program(self):
+        """StableHLO text of the exported forward (reference:
+        TranslatedLayer.program())."""
         return self._program_text
 
     def state_dict(self, *a, **kw):

@@ -30,7 +30,16 @@ def _norm_shape(shape):
         return tuple(int(s) for s in shape.numpy())
     out = []
     for s in shape:
-        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            try:
+                out.append(int(s))
+            except Exception:
+                # symbolic dimension (jax.export shape polymorphism raises
+                # InconclusiveDimensionOperation on int()): jnp.reshape
+                # consumes the _DimExpr directly
+                out.append(s)
     return tuple(out)
 
 
