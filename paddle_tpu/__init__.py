@@ -109,6 +109,11 @@ def __getattr__(name):
         mod = importlib.import_module(".incubate", __name__)
         globals()["incubate"] = mod
         return mod
+    if name in ("distribution", "text", "quantization"):
+        import importlib
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
     if name in ("hapi", "Model", "callbacks"):
         import importlib
         mod = importlib.import_module(".hapi", __name__)

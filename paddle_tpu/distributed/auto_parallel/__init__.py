@@ -1,3 +1,4 @@
 from .process_mesh import ProcessMesh  # noqa: F401
 from .api import (shard_tensor, reshard, shard_layer, dtensor_from_fn,  # noqa: F401
                   unshard_dtensor, shard_optimizer, Shard, Replicate, Partial)
+from .engine import Engine  # noqa: F401

@@ -1,0 +1,13 @@
+"""paddle.distribution equivalent (reference: python/paddle/distribution/).
+
+Distributions are thin stateless wrappers over jnp math; sampling draws keys
+from the framework generator so paddle.seed governs reproducibility.
+"""
+
+from .distributions import (  # noqa: F401
+    Distribution, Normal, Uniform, Categorical, Bernoulli, Exponential,
+    Beta, Gumbel, Laplace, kl_divergence, register_kl)
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Exponential", "Beta", "Gumbel", "Laplace", "kl_divergence",
+           "register_kl"]

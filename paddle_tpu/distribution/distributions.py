@@ -1,0 +1,301 @@
+"""Distributions (reference: python/paddle/distribution/{normal,uniform,
+categorical,bernoulli,exponential,beta,gumbel,laplace,kl}.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.function import apply
+from ..core import generator as gen_mod
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Exponential", "Beta", "Gumbel", "Laplace", "kl_divergence",
+           "register_kl"]
+
+
+def _arr(x):
+    return as_tensor(x)._data if not isinstance(x, (int, float)) \
+        else jnp.float32(x)
+
+
+def _key():
+    return gen_mod.default_generator.split()
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply(lambda lp: jnp.exp(lp), self.log_prob(value),
+                     name="prob")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Differentiable: loc/scale given as Tensors keep their autograd
+    linkage — log_prob and rsample route through `apply`, so REINFORCE and
+    reparameterized-gradient training both work."""
+
+    def __init__(self, loc, scale, name=None):
+        self._loc_t = as_tensor(loc)
+        self._scale_t = as_tensor(scale)
+
+    @property
+    def loc(self):
+        return self._loc_t._data
+
+    @property
+    def scale(self):
+        return self._scale_t._data
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc,
+                                       jnp.shape(self.loc + self.scale)))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2,
+                                       jnp.shape(self.loc + self.scale)))
+
+    def _shape(self, shape):
+        base = jnp.shape(self.loc + self.scale)
+        return tuple(shape) + base
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_key(), self._shape(shape))
+        return apply(lambda m, s: m + s * eps, self._loc_t, self._scale_t,
+                     name="normal_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = as_tensor(value)
+        return apply(
+            lambda x, m, s: -((x - m) ** 2) / (2 * s ** 2) - jnp.log(s)
+            - 0.5 * math.log(2 * math.pi),
+            v, self._loc_t, self._scale_t, name="normal_log_prob")
+
+    def entropy(self):
+        return apply(
+            lambda m, s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                jnp.shape(m + s)),
+            self._loc_t, self._scale_t, name="normal_entropy")
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=()):
+        base = jnp.shape(self.low + self.high)
+        u = jax.random.uniform(_key(), tuple(shape) + base)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = as_tensor(value)
+        return apply(
+            lambda x: jnp.where((x >= self.low) & (x <= self.high),
+                                -jnp.log(self.high - self.low), -jnp.inf),
+            v, name="uniform_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+
+    def sample(self, shape=()):
+        batch = jnp.shape(self.logits)[:-1]
+        out_shape = tuple(shape) + batch
+        return Tensor(jax.random.categorical(_key(), self.logits,
+                                             shape=out_shape or None))
+
+    def probs(self):
+        return Tensor(jax.nn.softmax(self.logits, axis=-1))
+
+    def log_prob(self, value):
+        v = as_tensor(value)
+        return apply(
+            lambda i: jnp.take_along_axis(
+                jax.nn.log_softmax(self.logits, -1),
+                i[..., None].astype(jnp.int32), axis=-1)[..., 0],
+            v, name="categorical_log_prob")
+
+    def entropy(self):
+        p = jax.nn.softmax(self.logits, -1)
+        lp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(p * lp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _arr(probs)
+
+    def sample(self, shape=()):
+        base = jnp.shape(self.probs_)
+        return Tensor(jax.random.bernoulli(
+            _key(), self.probs_, tuple(shape) + base).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = as_tensor(value)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return apply(lambda x: x * jnp.log(p) + (1 - x) * jnp.log(1 - p),
+                     v, name="bernoulli_log_prob")
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+
+    def sample(self, shape=()):
+        base = jnp.shape(self.rate)
+        u = jax.random.exponential(_key(), tuple(shape) + base)
+        return Tensor(u / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = as_tensor(value)
+        return apply(lambda x: jnp.log(self.rate) - self.rate * x, v,
+                     name="exponential_log_prob")
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+
+    def sample(self, shape=()):
+        base = jnp.shape(self.alpha + self.beta)
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta,
+                                      tuple(shape) + base))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = as_tensor(value)
+        a, b = self.alpha, self.beta
+        return apply(
+            lambda x: (a - 1) * jnp.log(x) + (b - 1) * jnp.log1p(-x)
+            - betaln(a, b), v, name="beta_log_prob")
+
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=()):
+        base = jnp.shape(self.loc + self.scale)
+        g = jax.random.gumbel(_key(), tuple(shape) + base)
+        return Tensor(self.loc + self.scale * g)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = as_tensor(value)
+
+        def f(x):
+            z = (x - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+        return apply(f, v, name="gumbel_log_prob")
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=()):
+        base = jnp.shape(self.loc + self.scale)
+        l = jax.random.laplace(_key(), tuple(shape) + base)
+        return Tensor(self.loc + self.scale * l)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = as_tensor(value)
+        return apply(
+            lambda x: -jnp.abs(x - self.loc) / self.scale
+            - jnp.log(2 * self.scale), v, name="laplace_log_prob")
+
+    def entropy(self):
+        return Tensor(1.0 + jnp.log(2 * self.scale))
+
+
+# -- KL registry (reference: distribution/kl.py) -----------------------------
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    pp = jax.nn.softmax(p.logits, -1)
+    return Tensor(jnp.sum(
+        pp * (jax.nn.log_softmax(p.logits, -1)
+              - jax.nn.log_softmax(q.logits, -1)), axis=-1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(1 / r) + r - 1)

@@ -200,6 +200,28 @@ class StaticFunction:
             t._node = None
         return jax.tree_util.tree_unflatten(cell["out_tree"], out_flat)
 
+    def memory_analysis(self, *args, **kwargs):
+        """Compile the step for these args and return XLA's memory analysis
+        (argument/output/temp/generated-code bytes). The signature must have
+        been called at least once (so state is discovered)."""
+        args_flat, treedef = jax.tree_util.tree_flatten(args)
+        sig = self._sig_of(args_flat)
+        kw_key = tuple(sorted(kwargs.items(), key=lambda kv: kv[0]))
+        key = (treedef, sig, kw_key)
+        if key not in self._state_by_key:
+            self(*args, **kwargs)
+        if not hasattr(self, "_mem_analysis_cache"):
+            self._mem_analysis_cache = {}
+        if key in self._mem_analysis_cache:
+            return self._mem_analysis_cache[key]
+        state_list = self._state_by_key[key]
+        jitted, _ = self._compile(treedef, sig, dict(kwargs), state_list)
+        state_arrays = [t._d for t in state_list]
+        compiled = jitted.lower(state_arrays, list(args_flat)).compile()
+        ma = compiled.memory_analysis()
+        self._mem_analysis_cache[key] = ma
+        return ma
+
     # -- parity surface -----------------------------------------------------
     def concrete_program(self):
         return None

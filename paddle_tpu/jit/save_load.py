@@ -45,12 +45,20 @@ def _spec_structs(input_spec):
     dimensions so the serialized program stays batch-polymorphic."""
     structs = []
     n_sym = 0
+    scope = None  # ONE scope shared by every symbolic dim (export rejects
+    # dims from different scopes in the same program)
     for s in input_spec:
         if isinstance(s, InputSpec):
             dims = []
             for d in s.shape:
                 if d == -1:
-                    dims.append(jax_export.symbolic_shape(f"_d{n_sym}")[0])
+                    if scope is None:
+                        sym = jax_export.symbolic_shape(f"_d{n_sym}")[0]
+                        scope = sym.scope
+                    else:
+                        sym = jax_export.symbolic_shape(
+                            f"_d{n_sym}", scope=scope)[0]
+                    dims.append(sym)
                     n_sym += 1
                 else:
                     dims.append(d)

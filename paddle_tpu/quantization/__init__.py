@@ -1,0 +1,25 @@
+"""Quantization (reference: python/paddle/quantization/ — config.py:60
+QuantConfig, qat.py:23 QAT, ptq.py:24 PTQ, quanters/, observers/).
+
+TPU-native design: fake-quant is a pure jnp transform with a straight-
+through estimator (x + stop_gradient(q(x) - x)), so QAT train steps compile
+into the same single XLA program as regular training. PTQ observers collect
+ranges eagerly on calibration batches; `convert` bakes scales in. Weight-only
+int8 inference keeps weights as int8 + per-channel scales and dequantizes
+in-matmul (bf16 accumulation on the MXU).
+"""
+
+from .config import QuantConfig, SingleLayerConfig  # noqa: F401
+from .observers import AbsmaxObserver, MovingAverageMinMaxObserver  # noqa: F401
+from .quanters import FakeQuanterWithAbsMaxObserver  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .wrapper import QuantedLinear, Int8WeightOnlyLinear  # noqa: F401
+from .functional import fake_quant, quantize_weight_int8  # noqa: F401
+
+__all__ = [
+    "QuantConfig", "SingleLayerConfig", "AbsmaxObserver",
+    "MovingAverageMinMaxObserver", "FakeQuanterWithAbsMaxObserver", "QAT",
+    "PTQ", "QuantedLinear", "Int8WeightOnlyLinear", "fake_quant",
+    "quantize_weight_int8",
+]

@@ -1,0 +1,61 @@
+"""Quantization primitives as pure jnp transforms.
+
+Reference analog: the fake_quantize_* kernels
+(paddle/phi/kernels/fake_quantize_kernel.*) — here symmetric-range fake
+quant with a straight-through estimator, jit/grad-safe by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(x, q):
+    """Straight-through estimator: forward q, backward identity."""
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def fake_quant_array(x, scale, bit_length=8):
+    """Symmetric fake quantization of a jnp array given scale(s)."""
+    bound = 2 ** (bit_length - 1) - 1
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * bound), -bound, bound) * s / bound
+    return _ste(x, q.astype(x.dtype))
+
+
+def fake_quant(x, scale, bit_length=8):
+    """Tensor-level fake quant (framework Tensor in/out)."""
+    from ..autograd.function import apply
+    from ..core.tensor import as_tensor
+    s_arr = as_tensor(scale)._data if not isinstance(scale, (int, float)) \
+        else scale
+    return apply(lambda a: fake_quant_array(a, s_arr, bit_length), x,
+                 name="fake_quantize")
+
+
+def absmax_scale(x, axis=None):
+    """Per-tensor (axis=None) or per-channel absmax scale."""
+    if axis is None:
+        return jnp.max(jnp.abs(x))
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    return jnp.max(jnp.abs(x), axis=axes)
+
+
+def quantize_weight_int8(w, axis=1):
+    """[in, out] weight -> (int8 weight, f32 per-out-channel scales).
+
+    Reference analog: weight_only_linear's int8 path
+    (paddle/phi/kernels/fusion/gpu/fused_weight_only_linear*)."""
+    bound = 127.0
+    scales = absmax_scale(w, axis=axis)
+    s = jnp.maximum(scales, 1e-9)
+    q = jnp.clip(jnp.round(w / s * bound), -bound, bound).astype(jnp.int8)
+    return q, (s / bound).astype(jnp.float32)
+
+
+def dequant_matmul_int8(x, w_int8, scales):
+    """x @ dequant(w): scales applied after the matmul so the MXU sees one
+    [*, in] x [in, out] contraction; XLA fuses the per-column rescale."""
+    y = jnp.matmul(x, w_int8.astype(x.dtype))
+    return y * scales.astype(x.dtype)

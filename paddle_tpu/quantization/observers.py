@@ -1,0 +1,66 @@
+"""PTQ observers (reference: python/paddle/quantization/observers/).
+
+Observers watch activations during calibration (eager passes) and produce
+the scale used at convert time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer import Layer
+
+
+class BaseObserver(Layer):
+    def __init__(self, bit_length=8):
+        super().__init__()
+        self.bit_length = bit_length
+
+    def scale(self) -> float:
+        raise NotImplementedError
+
+    def forward(self, x):
+        self.observe(x)
+        return x
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def _instance(self, layer):
+        """QuanterFactory protocol: an observer class doubles as its own
+        factory (reference factory.py ObserverFactory._instance)."""
+        return type(self)(bit_length=self.bit_length)
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (reference observers/abs_max.py)."""
+
+    def __init__(self, bit_length=8, quant_bits=None):
+        super().__init__(bit_length=quant_bits or bit_length)
+        self._max = 0.0
+
+    def observe(self, x):
+        self._max = max(self._max, float(np.max(np.abs(np.asarray(x.numpy())))))
+
+    def scale(self):
+        return self._max if self._max > 0 else 1e-9
+
+
+class MovingAverageMinMaxObserver(BaseObserver):
+    """EMA of per-batch absmax (reference observers/mse/ema style)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8):
+        super().__init__(bit_length=bit_length)
+        self.moving_rate = moving_rate
+        self._state = None
+
+    def observe(self, x):
+        cur = float(np.max(np.abs(np.asarray(x.numpy()))))
+        if self._state is None:
+            self._state = cur
+        else:
+            self._state = self.moving_rate * self._state + \
+                (1 - self.moving_rate) * cur
+
+    def scale(self):
+        return self._state if self._state else 1e-9

@@ -1,0 +1,62 @@
+"""PTQ (reference: python/paddle/quantization/ptq.py:24).
+
+`quantize(model)` inserts activation observers before each configured
+Linear; run calibration batches eagerly, then `convert(model)` replaces the
+observed layers with int8 weight-only linears (weights quantized
+per-out-channel, activations left in fp per the TPU weight-only recipe).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear
+from .config import QuantConfig
+from .observers import BaseObserver
+from .wrapper import Int8WeightOnlyLinear
+
+
+class _ObservedLinear(Layer):
+    def __init__(self, inner, observer):
+        super().__init__()
+        self.inner = inner
+        self.observer = observer
+
+    def forward(self, x):
+        if self.observer is not None:
+            self.observer.observe(x)
+        return self.inner(x)
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def _wrap(self, layer: Layer, prefix: str):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            cfg = self._config.config_for(sub, full)
+            if isinstance(sub, Linear) and cfg is not None:
+                obs = cfg.activation._instance(sub) \
+                    if isinstance(cfg.activation, BaseObserver) else None
+                layer._sub_layers[name] = _ObservedLinear(sub, obs)
+            else:
+                self._wrap(sub, full)
+        return layer
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        target = model if inplace else copy.deepcopy(model)
+        return self._wrap(target, "")
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        target = model if inplace else copy.deepcopy(model)
+
+        def conv(layer: Layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, _ObservedLinear):
+                    layer._sub_layers[name] = Int8WeightOnlyLinear(sub.inner)
+                else:
+                    conv(sub)
+        conv(target)
+        return target

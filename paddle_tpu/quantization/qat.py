@@ -1,0 +1,54 @@
+"""QAT (reference: python/paddle/quantization/qat.py:23).
+
+`quantize(model)` walks the model, replacing each configured Linear with a
+QuantedLinear carrying fresh quanter instances; the result trains normally
+(the STE fake quant compiles into the train step).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear
+from .config import QuantConfig
+from .wrapper import QuantedLinear
+
+
+class QAT:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def _wrap(self, layer: Layer, prefix: str):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            cfg = self._config.config_for(sub, full)
+            if isinstance(sub, Linear) and cfg is not None and \
+                    not isinstance(sub, QuantedLinear):
+                act_q = cfg.activation._instance(sub) \
+                    if cfg.activation is not None else None
+                w_q = cfg.weight._instance(sub) \
+                    if cfg.weight is not None else None
+                layer._sub_layers[name] = QuantedLinear(sub, act_q, w_q)
+            else:
+                self._wrap(sub, full)
+        return layer
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        target = model if inplace else copy.deepcopy(model)
+        return self._wrap(target, "")
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Strip quanters for deployment: bake the learned scales into plain
+        fake-quant-free layers (weights stay fp; use Int8WeightOnlyLinear via
+        PTQ.convert for weight compression)."""
+        target = model if inplace else copy.deepcopy(model)
+
+        def strip(layer: Layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, QuantedLinear):
+                    layer._sub_layers[name] = sub.inner
+                else:
+                    strip(sub)
+        strip(target)
+        return target

@@ -1,0 +1,52 @@
+"""QAT quanters (reference: python/paddle/quantization/quanters/abs_max.py
+FakeQuanterWithAbsMaxObserver).
+
+The quanter is a Layer inserted into the quantized model: it tracks a
+moving-average absmax scale as a non-trainable state tensor (threaded through
+compiled train steps like any optimizer accumulator) and applies STE fake
+quant every forward.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from ..nn.layer import Layer
+from ..autograd.function import apply
+from .functional import fake_quant_array
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        # moving absmax as state so jitted steps update it functionally
+        self._scale = paddle.to_tensor(jnp.zeros((), jnp.float32))
+        self._inited = paddle.to_tensor(jnp.zeros((), jnp.float32))
+
+    def _instance(self, layer):
+        return FakeQuanterWithAbsMaxObserver(self.moving_rate,
+                                             self.bit_length)
+
+    def forward(self, x):
+        mr = self.moving_rate
+        cur_t = x.abs().max().cast("float32")
+        if self.training:
+            new_scale = apply(
+                lambda s, i, c: jnp.where(i > 0, mr * s + (1 - mr) * c, c),
+                self._scale, self._inited, cur_t, name="quant_scale_ema")
+            self._scale._d = new_scale._d
+            self._inited._d = jnp.ones((), jnp.float32)
+            scale = new_scale
+        else:
+            scale = self._scale
+        return apply(
+            lambda a, s: fake_quant_array(a, jnp.maximum(s, 1e-9),
+                                          self.bit_length),
+            x, scale, name="fake_quantize")
+
+    def scale(self):
+        return float(self._scale)

@@ -1,0 +1,57 @@
+"""Quantized layer wrappers (reference: quantization/wrapper.py
+QuantedLayer + nn/quant/ QuantedLinear family).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from ..autograd.function import apply
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer import Layer
+from ..nn import functional as F
+from .functional import dequant_matmul_int8, quantize_weight_int8
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized activation/weight (QAT form)."""
+
+    def __init__(self, inner, activation_quanter=None, weight_quanter=None):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        w = self.inner.weight
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, getattr(self.inner, "bias", None))
+
+
+class Int8WeightOnlyLinear(Layer):
+    """Inference linear holding int8 weights + per-out-channel scales
+    (reference: paddle.nn.quant.weight_only_linear int8 path)."""
+
+    def __init__(self, linear):
+        super().__init__()
+        q, s = quantize_weight_int8(linear.weight._d, axis=1)
+        self.weight_int8 = Parameter(q, name=linear.weight.name + "_int8")
+        self.weight_int8.stop_gradient = True
+        self.scales = Parameter(s, name=linear.weight.name + "_scales")
+        self.scales.stop_gradient = True
+        self.bias = getattr(linear, "bias", None)
+
+    def forward(self, x):
+        args = [x, self.weight_int8, self.scales]
+        if self.bias is not None:
+            return apply(lambda a, w, s, b: dequant_matmul_int8(a, w, s) + b,
+                         *args, self.bias, name="int8_linear")
+        return apply(lambda a, w, s: dequant_matmul_int8(a, w, s), *args,
+                     name="int8_linear")
+
+    def memory_bytes(self) -> int:
+        return int(self.weight_int8.size + 4 * self.scales.size)

@@ -1,0 +1,11 @@
+"""paddle.text equivalent (reference: python/paddle/text/ — ViterbiDecoder
+in paddle.text.viterbi_decode / paddle.nn.LayerList of datasets).
+
+The dataset zoo needs network downloads (unavailable here); the compute
+pieces — Viterbi decoding for sequence labeling — are implemented as
+TPU-compilable lax scans.
+"""
+
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["ViterbiDecoder", "viterbi_decode"]

@@ -1,0 +1,83 @@
+"""Viterbi decode (reference: python/paddle/text/viterbi_decode.py).
+
+Dynamic program over the sequence as a lax.scan — static shapes, no host
+loop, so the decode jits onto TPU with the rest of the model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.function import apply_multi
+from ..core.tensor import Tensor, as_tensor
+from ..nn.layer import Layer
+
+__all__ = ["ViterbiDecoder", "viterbi_decode"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """potentials: [B, T, N] emission scores; transition_params: [N, N].
+    Returns (scores [B], paths [B, T])."""
+    pot = as_tensor(potentials)._data
+    trans = as_tensor(transition_params)._data
+    b, t, n = pot.shape
+    lens = as_tensor(lengths)._data if lengths is not None \
+        else jnp.full((b,), t, jnp.int32)
+
+    def f(pot, trans, lens):
+        start = pot[:, 0, :]
+        if include_bos_eos_tag:
+            # reference semantics: BOS tag is N-2, EOS is N-1
+            start = start + trans[n - 2][None, :]
+
+        def step(carry, xs):
+            alpha, idx = carry
+            emit, mask = xs  # emit [B, N], mask [B]
+            scores = alpha[:, :, None] + trans[None, :, :]  # [B, N, N]
+            best_prev = jnp.argmax(scores, axis=1)           # [B, N]
+            best_score = jnp.max(scores, axis=1) + emit      # [B, N]
+            alpha_new = jnp.where(mask[:, None], best_score, alpha)
+            return (alpha_new, idx + 1), jnp.where(
+                mask[:, None], best_prev, -jnp.ones_like(best_prev))
+
+        masks = (jnp.arange(1, t)[None, :] < lens[:, None]).T  # [T-1, B]
+        emits = jnp.swapaxes(pot[:, 1:, :], 0, 1)              # [T-1, B, N]
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (start, jnp.int32(1)), (emits, masks))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, n - 1][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)                      # [B]
+
+        def backtrack(carry, bp):
+            # carry = tag at position i+1; bp = backptrs for step i -> i+1;
+            # output slot i must receive tag_i = bp[tag_{i+1}]
+            cur = carry
+            prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+            prev = jnp.where(prev < 0, cur, prev)
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(backtrack, last, backptrs,
+                                   reverse=True)
+        paths = jnp.concatenate([jnp.swapaxes(path_rev, 0, 1),
+                                 last[:, None]], axis=1)       # [B, T]
+        return scores, paths.astype(jnp.int64)
+
+    scores, paths = apply_multi(lambda p, tr: f(p, tr, lens), pot, trans,
+                                name="viterbi_decode")
+    return scores, paths
+
+
+class ViterbiDecoder(Layer):
+    """Reference text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

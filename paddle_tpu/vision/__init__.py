@@ -1,0 +1,11 @@
+"""paddle.vision equivalent (reference: python/paddle/vision/)."""
+
+from . import transforms  # noqa: F401
+from . import models  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401
+from .models import (LeNet, ResNet, resnet18, resnet34,  # noqa: F401
+                     resnet50)
+
+__all__ = ["transforms", "models", "datasets", "ops", "LeNet", "ResNet",
+           "resnet18", "resnet34", "resnet50"]

@@ -1,0 +1,129 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+No-network environment: MNIST/Cifar parse already-downloaded files;
+DatasetFolder walks a class-per-directory tree with a pluggable loader.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "DatasetFolder"]
+
+
+class MNIST(Dataset):
+    """idx-format MNIST from local files (reference datasets/mnist.py; the
+    download step is out of scope in an egress-less environment — pass
+    image_path/label_path to the extracted/gz files)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, backend="cv2"):
+        if image_path is None or label_path is None:
+            raise ValueError("MNIST needs explicit image_path/label_path "
+                             "(no network download available)")
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad MNIST image magic {magic}"
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+            return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad MNIST label magic {magic}"
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-version tarball (reference
+    datasets/cifar.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend="cv2"):
+        if data_file is None:
+            raise ValueError("Cifar10 needs data_file (no network download)")
+        self.transform = transform
+        wanted = ["data_batch"] if mode == "train" else ["test_batch"]
+        xs, ys = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                if any(w in m.name for w in wanted):
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    xs.append(np.asarray(d[b"data"]))
+                    ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)
+        self.labels = np.asarray(ys, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory dataset (reference datasets/folder.py).
+    Default loader reads .npy; pass `loader` for image decoding."""
+
+    def __init__(self, root, loader=None, extensions=(".npy",),
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or (lambda p: np.load(p))
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(tuple(extensions))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        path, target = self.samples[i]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.int64(target)

@@ -1,0 +1,71 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — nms, box utils)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.function import apply
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = ["nms", "box_area", "box_iou"]
+
+
+def box_area(boxes):
+    def f(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return apply(f, boxes, name="box_area")
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = (x2 - x1) * (y2 - y1)
+    xx1 = jnp.maximum(x1[:, None], x1[None, :])
+    yy1 = jnp.maximum(y1[:, None], y1[None, :])
+    xx2 = jnp.minimum(x2[:, None], x2[None, :])
+    yy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.clip(xx2 - xx1, 0) * jnp.clip(yy2 - yy1, 0)
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+
+
+def box_iou(boxes1, boxes2):
+    def f(b1, b2):
+        x11, y11, x12, y12 = (b1[:, i] for i in range(4))
+        x21, y21, x22, y22 = (b2[:, i] for i in range(4))
+        a1 = (x12 - x11) * (y12 - y11)
+        a2 = (x22 - x21) * (y22 - y21)
+        xx1 = jnp.maximum(x11[:, None], x21[None, :])
+        yy1 = jnp.maximum(y11[:, None], y21[None, :])
+        xx2 = jnp.minimum(x12[:, None], x22[None, :])
+        yy2 = jnp.minimum(y12[:, None], y22[None, :])
+        inter = jnp.clip(xx2 - xx1, 0) * jnp.clip(yy2 - yy1, 0)
+        return inter / jnp.maximum(a1[:, None] + a2[None, :] - inter, 1e-9)
+    return apply(f, boxes1, boxes2, name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS as a fixed-trip lax loop (static shapes: TPU-compilable).
+    Returns kept indices sorted by score (reference vision/ops.py nms)."""
+    b = as_tensor(boxes)._data
+    n = b.shape[0]
+    s = as_tensor(scores)._data if scores is not None \
+        else jnp.arange(n, 0, -1, dtype=jnp.float32)
+
+    iou = _iou_matrix(b.astype(jnp.float32))
+    order = jnp.argsort(-s)
+
+    ranks = jnp.empty_like(order).at[order].set(jnp.arange(n))
+
+    def body(i, keep):
+        # box at score-rank i, if still kept, suppresses every lower-ranked
+        # box overlapping it beyond the threshold
+        oi = order[i]
+        kill = (iou[oi] > iou_threshold) & (ranks > i) & keep[oi]
+        return jnp.where(kill, False, keep)
+
+    keep = jnp.ones((n,), bool)
+    keep = jax.lax.fori_loop(0, n, body, keep)
+    kept_sorted = order[keep[order]]
+    idx = kept_sorted if top_k is None else kept_sorted[:top_k]
+    return Tensor(idx, stop_gradient=True)

@@ -1,0 +1,163 @@
+"""Domain libraries: vision / distribution / text (reference: python/paddle/
+{vision,distribution,text})."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# -- vision ------------------------------------------------------------------
+
+def test_transforms_pipeline():
+    from paddle_tpu.vision import transforms as T
+    t = T.Compose([T.Resize(16), T.CenterCrop(12), T.ToTensor(),
+                   T.Normalize(mean=[0.5], std=[0.5])])
+    img = (np.arange(24 * 32, dtype=np.uint8).reshape(24, 32) % 255)
+    out = t(img)
+    assert out.shape == (1, 12, 12)
+    assert out.dtype == np.float32
+    assert out.min() >= -1.001 and out.max() <= 1.001
+
+
+def test_random_transforms_shapes():
+    from paddle_tpu.vision import transforms as T
+    img = np.zeros((20, 20, 3), np.uint8)
+    assert T.RandomCrop(16)(img).shape == (16, 16, 3)
+    assert T.RandomHorizontalFlip(1.0)(img).shape == (20, 20, 3)
+    assert T.Pad(2)(img).shape == (24, 24, 3)
+
+
+def test_lenet_and_resnet_forward_train():
+    from paddle_tpu.vision.models import LeNet, resnet18
+    paddle.seed(0)
+    le = LeNet(num_classes=10)
+    x = paddle.randn([2, 1, 28, 28])
+    out = le(x)
+    assert out.shape == [2, 10]
+
+    rn = resnet18(num_classes=7)
+    xi = paddle.randn([2, 3, 32, 32])
+    logits = rn(xi)
+    assert logits.shape == [2, 7]
+    # one training step works end to end
+    opt = paddle.optimizer.SGD(1e-2, parameters=rn.parameters())
+    y = paddle.to_tensor(np.array([1, 2], dtype=np.int64))
+    loss = nn.CrossEntropyLoss()(logits, y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss))
+
+
+def test_dataset_folder(tmp_path):
+    from paddle_tpu.vision.datasets import DatasetFolder
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(str(d / f"{i}.npy"),
+                    np.full((4, 4), i, dtype=np.float32))
+    ds = DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    x, y = ds[0]
+    assert x.shape == (4, 4) and y in (0, 1)
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+
+
+def test_nms():
+    from paddle_tpu.vision.ops import nms
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],     # heavy overlap with 0
+        [20, 20, 30, 30],   # separate
+    ], dtype=np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], dtype=np.float32))
+    keep = nms(boxes, iou_threshold=0.5, scores=scores)
+    assert sorted(np.asarray(keep.numpy()).tolist()) == [0, 2]
+
+
+# -- distribution -------------------------------------------------------------
+
+def test_normal_sampling_and_logprob():
+    from paddle_tpu.distribution import Normal
+    paddle.seed(3)
+    d = Normal(1.0, 2.0)
+    s = d.sample([20000])
+    arr = np.asarray(s.numpy())
+    assert abs(arr.mean() - 1.0) < 0.08
+    assert abs(arr.std() - 2.0) < 0.08
+    lp = float(d.log_prob(paddle.to_tensor(1.0)))
+    import math
+    assert abs(lp - (-math.log(2.0) - 0.5 * math.log(2 * math.pi))) < 1e-5
+
+
+def test_kl_normal_normal_and_registry():
+    from paddle_tpu.distribution import Normal, kl_divergence
+    p = Normal(0.0, 1.0)
+    q = Normal(1.0, 2.0)
+    kl = float(kl_divergence(p, q))
+    # closed form: log(s2/s1) + (s1^2 + (m1-m2)^2)/(2 s2^2) - 1/2
+    import math
+    expect = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    assert abs(kl - expect) < 1e-5
+    with pytest.raises(NotImplementedError):
+        from paddle_tpu.distribution import Beta
+        kl_divergence(p, Beta(1.0, 1.0))
+
+
+def test_categorical_and_bernoulli():
+    from paddle_tpu.distribution import Bernoulli, Categorical
+    paddle.seed(4)
+    c = Categorical(paddle.to_tensor(np.log(
+        np.array([0.7, 0.2, 0.1], dtype=np.float32))))
+    samples = np.asarray(c.sample([5000]).numpy())
+    frac0 = (samples == 0).mean()
+    assert abs(frac0 - 0.7) < 0.05
+    ent = float(c.entropy())
+    assert 0 < ent < np.log(3) + 1e-6
+
+    b = Bernoulli(0.3)
+    lp = float(b.log_prob(paddle.to_tensor(1.0)))
+    assert abs(lp - np.log(0.3)) < 1e-5
+
+
+def test_distribution_grads_flow():
+    """rsample reparameterization: gradients reach loc/scale params."""
+    from paddle_tpu.distribution import Normal
+    paddle.seed(5)
+    loc = paddle.to_tensor(np.float32(0.0))
+    loc.stop_gradient = False
+    d = Normal(loc, 1.0)
+    lp = d.log_prob(paddle.to_tensor(np.float32(2.0)))
+    lp.backward()
+    assert abs(float(loc.grad) - 2.0) < 1e-5  # d/dloc of -(x-loc)^2/2 = x-loc
+
+
+# -- text ---------------------------------------------------------------------
+
+def test_viterbi_decode_matches_bruteforce():
+    from paddle_tpu.text import ViterbiDecoder
+    rng = np.random.default_rng(0)
+    b, t, n = 2, 5, 4
+    pot = rng.standard_normal((b, t, n)).astype(np.float32)
+    trans = rng.standard_normal((n, n)).astype(np.float32)
+
+    scores, paths = ViterbiDecoder(
+        paddle.to_tensor(trans), include_bos_eos_tag=False)(
+        paddle.to_tensor(pot))
+    got_paths = np.asarray(paths.numpy())
+    got_scores = np.asarray(scores.numpy())
+
+    # brute force over all n^t paths
+    import itertools
+    for bi in range(b):
+        best, best_path = -1e30, None
+        for cand in itertools.product(range(n), repeat=t):
+            s = pot[bi, 0, cand[0]]
+            for i in range(1, t):
+                s += trans[cand[i - 1], cand[i]] + pot[bi, i, cand[i]]
+            if s > best:
+                best, best_path = s, cand
+        np.testing.assert_allclose(got_scores[bi], best, rtol=1e-5)
+        assert got_paths[bi].tolist() == list(best_path)

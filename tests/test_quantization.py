@@ -1,0 +1,111 @@
+"""Quantization subsystem (reference: python/paddle/quantization/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    QAT, PTQ, AbsmaxObserver, FakeQuanterWithAbsMaxObserver, QuantConfig,
+    Int8WeightOnlyLinear, fake_quant)
+
+
+def _net():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_fake_quant_values_and_ste():
+    x = paddle.to_tensor(np.array([0.5, -1.0, 0.26], dtype=np.float32))
+    x.stop_gradient = False
+    out = fake_quant(x, 1.0, bit_length=8)
+    # q = round(x*127)/127
+    expect = np.round(np.array([0.5, -1.0, 0.26]) * 127) / 127
+    np.testing.assert_allclose(np.asarray(out.numpy()), expect, atol=1e-6)
+    out.sum().backward()
+    # straight-through: gradient is identity
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), 1.0)
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    model = _net()
+    quanter = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+    q_config = QuantConfig(activation=quanter, weight=quanter)
+    qat = QAT(q_config)
+    qmodel = qat.quantize(model)
+    from paddle_tpu.quantization import QuantedLinear
+    assert isinstance(qmodel._sub_layers["0"], QuantedLinear)
+
+    opt = paddle.optimizer.Adam(5e-3, parameters=qmodel.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, 16).astype(np.int64))
+    ce = nn.CrossEntropyLoss()
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = ce(qmodel(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(step(x, y)) for _ in range(20)]
+    assert losses[-1] < losses[0] - 0.2, (losses[0], losses[-1])
+    # the quanter's EMA scale must have been updated by training
+    assert qmodel._sub_layers["0"].activation_quanter.scale() > 0
+
+    # convert strips quanters: plain Linears remain, outputs finite
+    deploy = qat.convert(qmodel)
+    assert not isinstance(deploy._sub_layers["0"], QuantedLinear)
+    out = deploy(x)
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_ptq_calibrate_and_int8_convert():
+    paddle.seed(1)
+    model = _net()
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver(), weight=None))
+    calib_model = ptq.quantize(model)
+    rng = np.random.default_rng(1)
+    xs = rng.standard_normal((4, 16, 8)).astype(np.float32)
+    for b in xs:
+        calib_model(paddle.to_tensor(b))
+    obs = calib_model._sub_layers["0"].observer
+    assert obs.scale() > 0
+
+    int8_model = ptq.convert(calib_model)
+    assert isinstance(int8_model._sub_layers["0"], Int8WeightOnlyLinear)
+    x = paddle.to_tensor(xs[0])
+    ref = model(x)
+    out = int8_model(x)
+    err = np.abs(np.asarray(out.numpy()) - np.asarray(ref.numpy())).max()
+    scale = np.abs(np.asarray(ref.numpy())).max()
+    assert err < 0.05 * max(scale, 1.0), (err, scale)
+    # int8 weights actually stored as int8
+    assert str(int8_model._sub_layers["0"].weight_int8.dtype) == "int8"
+
+
+def test_quant_config_precedence():
+    from paddle_tpu.quantization import QuantedLinear
+    paddle.seed(2)
+    model = _net()
+    quanter = FakeQuanterWithAbsMaxObserver()
+    cfg = QuantConfig(activation=None, weight=None)
+    cfg.add_type_config(nn.Linear, activation=quanter, weight=quanter)
+    qmodel = QAT(cfg).quantize(model)
+    assert isinstance(qmodel._sub_layers["0"], QuantedLinear)
+    assert isinstance(qmodel._sub_layers["2"], QuantedLinear)
+    # name config wins for exclusion? name-scoped config on one layer only
+    cfg2 = QuantConfig(activation=None, weight=None)
+    cfg2.add_name_config("0", activation=quanter, weight=quanter)
+    q2 = QAT(cfg2).quantize(_net())
+    assert isinstance(q2._sub_layers["0"], QuantedLinear)
+    assert not isinstance(q2._sub_layers["2"], QuantedLinear)
+
+
+def test_int8_weight_only_memory_shrinks():
+    lin = nn.Linear(128, 256)
+    q = Int8WeightOnlyLinear(lin)
+    fp_bytes = 128 * 256 * 4
+    assert q.memory_bytes() < fp_bytes / 3.5
