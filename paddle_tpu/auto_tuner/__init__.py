@@ -1,0 +1,15 @@
+"""Distributed-config auto-tuner (reference: python/paddle/distributed/
+auto_tuner/tuner.py:19 AutoTuner + prune rules).
+
+The reference enumerates (dp, mp, pp, sharding, micro-batch) candidates,
+prunes invalid ones, launches trial runs, and picks the best by observed
+throughput. TPU redesign: candidates are mesh factorizations; trials are
+DRY-RUN COMPILES — XLA's memory analysis and (optionally) a few measured
+steps score each candidate without burning cluster time on full launches.
+"""
+
+from .tuner import (AutoTuner, Candidate,  # noqa: F401
+                    default_candidates, prune_by_divisibility)
+
+__all__ = ["AutoTuner", "Candidate", "default_candidates",
+           "prune_by_divisibility"]

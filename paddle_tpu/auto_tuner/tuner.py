@@ -1,0 +1,111 @@
+"""AutoTuner core (reference auto_tuner/tuner.py:19, prune.py)."""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Candidate:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding: int = 1
+    micro_batch: int = 1
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def world(self) -> int:
+        return self.dp * self.mp * self.pp * self.sharding
+
+    def as_hybrid_configs(self) -> dict:
+        return {"dp_degree": self.dp, "mp_degree": self.mp,
+                "pp_degree": self.pp, "sharding_degree": self.sharding,
+                "sep_degree": 1}
+
+    def __repr__(self):
+        return (f"Candidate(dp{self.dp} mp{self.mp} pp{self.pp} "
+                f"sh{self.sharding} mb{self.micro_batch})")
+
+
+def default_candidates(n_devices, max_mp=8, max_pp=8,
+                       micro_batches=(1,)):
+    """Every (dp, mp, pp, sharding) factorization of n_devices (the
+    reference's search space builder, auto_tuner/utils.py)."""
+    out = []
+    for mp, pp in itertools.product(range(1, max_mp + 1),
+                                    range(1, max_pp + 1)):
+        if n_devices % (mp * pp):
+            continue
+        rest = n_devices // (mp * pp)
+        for sharding in (d for d in range(1, rest + 1) if rest % d == 0):
+            dp = rest // sharding
+            for mb in micro_batches:
+                out.append(Candidate(dp=dp, mp=mp, pp=pp,
+                                     sharding=sharding, micro_batch=mb))
+    return out
+
+
+def prune_by_divisibility(candidates, num_layers=None, num_heads=None,
+                          global_batch=None):
+    """Reference prune rules: mp must divide heads, pp must divide layers,
+    dp*sharding*micro_batch must divide the global batch."""
+    kept = []
+    for c in candidates:
+        if num_heads is not None and num_heads % c.mp:
+            continue
+        if num_layers is not None and num_layers % c.pp:
+            continue
+        if global_batch is not None and \
+                global_batch % (c.dp * c.sharding * c.micro_batch):
+            continue
+        kept.append(c)
+    return kept
+
+
+class AutoTuner:
+    """Search candidates with a user measure function.
+
+    measure(candidate) -> dict with at least one of:
+      - "error": truthy -> candidate failed (OOM, invalid) and is skipped
+      - "time_s": lower is better (primary when present)
+      - "memory_bytes": lower is better (primary otherwise)
+    The history of every trial is kept (reference records trial logs)."""
+
+    def __init__(self, measure, candidates=None):
+        self._measure = measure
+        self._candidates = list(candidates or [])
+        self.history: list[tuple] = []
+
+    def add(self, candidate):
+        self._candidates.append(candidate)
+
+    @staticmethod
+    def _score(result):
+        if "time_s" in result:
+            return ("time", result["time_s"])
+        return ("mem", result.get("memory_bytes", float("inf")))
+
+    def search(self):
+        best, best_score = None, None
+        for cand in self._candidates:
+            try:
+                result = self._measure(cand)
+            except Exception as e:  # a failing trial never kills the search
+                result = {"error": f"{type(e).__name__}: {e}"}
+            self.history.append((cand, result))
+            if result.get("error"):
+                continue
+            score = self._score(result)
+            if best_score is None or score[1] < best_score[1]:
+                best, best_score = cand, score
+        return best
+
+    def summary(self):
+        lines = []
+        for cand, res in self.history:
+            status = res.get("error") or \
+                f"time={res.get('time_s')} mem={res.get('memory_bytes')}"
+            lines.append(f"{cand}: {status}")
+        return "\n".join(lines)

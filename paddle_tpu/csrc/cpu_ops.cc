@@ -1,0 +1,69 @@
+// Native host-side ops exposed through the XLA FFI ABI.
+//
+// Reference analog: the custom-op C++ sources users build with
+// paddle.utils.cpp_extension (custom_relu etc. in the reference test suite).
+// These handlers run on the host platform; device kernels belong to Pallas.
+//
+// Build: paddle_tpu.utils.cpp_extension.load(name, [this file], functions=...)
+
+#include <cmath>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+// out = x*x + y  (the canonical custom-op smoke test)
+static ffi::Error SquareAddImpl(ffi::Buffer<ffi::F32> x,
+                                ffi::Buffer<ffi::F32> y,
+                                ffi::ResultBuffer<ffi::F32> out) {
+  const float* xd = x.typed_data();
+  const float* yd = y.typed_data();
+  float* od = out->typed_data();
+  const size_t n = x.element_count();
+  for (size_t i = 0; i < n; ++i) od[i] = xd[i] * xd[i] + yd[i];
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    SquareAdd, SquareAddImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+
+// Greedy byte-pair-free whitespace "tokenizer": maps bytes to ids with a
+// trivial hash, writing fixed-length id rows — the host-side data-pipeline
+// op class the extension mechanism exists for (no Python round trip).
+static ffi::Error HashTokenizeImpl(ffi::Buffer<ffi::U8> text,
+                                   ffi::ResultBuffer<ffi::S32> ids) {
+  const uint8_t* t = text.typed_data();
+  int32_t* o = ids->typed_data();
+  const size_t n_in = text.element_count();
+  const size_t n_out = ids->element_count();
+  size_t w = 0;
+  uint32_t h = 2166136261u;
+  bool in_word = false;
+  for (size_t i = 0; i < n_in && w < n_out; ++i) {
+    const uint8_t c = t[i];
+    if (c == ' ' || c == '\n' || c == '\t') {
+      if (in_word) {
+        o[w++] = static_cast<int32_t>(h % 50000);
+        h = 2166136261u;
+        in_word = false;
+      }
+    } else {
+      h = (h ^ c) * 16777619u;
+      in_word = true;
+    }
+  }
+  if (in_word && w < n_out) o[w++] = static_cast<int32_t>(h % 50000);
+  for (; w < n_out; ++w) o[w] = -1;  // pad
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    HashTokenize, HashTokenizeImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::U8>>()
+        .Ret<ffi::Buffer<ffi::S32>>());

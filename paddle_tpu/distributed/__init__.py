@@ -39,3 +39,6 @@ def get_mesh_or_none():
     return _g()
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+
+from . import rpc  # noqa: F401
+from . import passes  # noqa: F401

@@ -141,7 +141,9 @@ class Engine:
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/epoch{epoch}")
             if valid_data is not None and (epoch + 1) % valid_freq == 0:
-                self.evaluate(valid_data, batch_size=batch_size,
+                self.evaluate(valid_data,
+                              valid_sample_split=valid_sample_split,
+                              batch_size=batch_size, steps=valid_steps,
                               verbose=verbose)
         return history
 

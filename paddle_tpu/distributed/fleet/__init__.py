@@ -23,3 +23,6 @@ class utils:
 class layers:
     from .. import meta_parallel as _mp
     mpu = _mp
+
+from . import elastic  # noqa: F401
+from .elastic import ElasticManager, ElasticStatus  # noqa: F401

@@ -1,0 +1,91 @@
+"""Elastic training manager (reference: distributed/fleet/elastic/
+manager.py:126 ElasticManager — etcd-backed membership, fault watching,
+restart on scale events).
+
+TPU form: membership is whatever `jax.distributed` was initialized with;
+the manager's job is the reference's state machine — watch a membership
+source, decide HEALTHY/RESTART/EXIT, and run registered hooks — with the
+etcd client swapped for a pluggable listener (a file written by the
+launcher, or any callable returning the live host list). Multi-host TPU
+slices are repaired by replacing the VM and re-running the launcher, so
+`restart` maps to checkpoint-and-exit for the scheduler to relaunch.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["ElasticStatus", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, hosts=None, scale=0, force=False, listener=None,
+                 min_hosts=None, max_hosts=None):
+        """listener: callable -> current live host list (the etcd watch
+        analog); defaults to reading PADDLE_TRAINER_ENDPOINTS style env."""
+        self._listener = listener or self._env_listener
+        self.hosts = list(hosts) if hosts else self._listener()
+        self.np = len(self.hosts) or 1
+        self.min_hosts = min_hosts or self.np
+        self.max_hosts = max_hosts or self.np
+        self.elastic_level = 1 if (self.min_hosts != self.max_hosts
+                                   or scale) else 0
+        self._pre_hooks = []
+        self._stopped = False
+
+    @staticmethod
+    def _env_listener():
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return [e for e in eps.split(",") if e]
+
+    def enabled(self) -> bool:
+        return self.elastic_level > 0
+
+    def register_pre_hook(self, fn):
+        """Run before a restart decision is surfaced (the reference's
+        checkpoint-before-restart hook)."""
+        self._pre_hooks.append(fn)
+
+    def watch(self) -> str:
+        """One membership poll -> ElasticStatus (reference manager.watch
+        loops this)."""
+        if self._stopped:
+            return ElasticStatus.EXIT
+        live = self._listener()
+        n = len(live)
+        if n == self.np:
+            return ElasticStatus.HOLD
+        if n < self.min_hosts:
+            # lost too many hosts: wait for replacements
+            return ElasticStatus.HOLD
+        # membership changed within [min, max]: scale event
+        for hook in self._pre_hooks:
+            hook()
+        self.hosts = list(live)
+        self.np = n
+        return ElasticStatus.RESTART
+
+    def run(self, poll_interval=5.0, max_polls=None):
+        """Blocking watch loop; returns the terminal status."""
+        polls = 0
+        while True:
+            status = self.watch()
+            if status in (ElasticStatus.RESTART, ElasticStatus.EXIT,
+                          ElasticStatus.COMPLETED, ElasticStatus.ERROR):
+                return status
+            polls += 1
+            if max_polls is not None and polls >= max_polls:
+                return ElasticStatus.HOLD
+            time.sleep(poll_interval)
+
+    def stop(self):
+        self._stopped = True

@@ -1,0 +1,36 @@
+"""Distributed passes (reference: python/paddle/distributed/passes/ — 21
+pass files rewriting the static Program: gradient merge, comm fusion/overlap,
+1F1B scheduling, recompute insertion...).
+
+TPU mapping: most reference passes rewrite communication the XLA scheduler
+already fuses/overlaps (proofs: tests/test_distributed.py HLO-inspection
+tests), so the pass layer here is small and OPTIMIZER/STEP-level:
+
+- gradient_merge: accumulate k micro-step grads before one optimizer step
+  (the reference's gradient_merge_pass rewritten as an optimizer wrapper —
+  the compiled step stays one XLA program per micro-step).
+- recompute: delegates to fleet.recompute (jax.checkpoint).
+- fuse_allreduce / overlap passes: registered no-ops with the subsumption
+  recorded, so strategy configs naming them still resolve.
+"""
+
+from __future__ import annotations
+
+from .pass_base import PassBase, PassContext, PassManager, register_pass  # noqa: F401
+from .gradient_merge import GradientMergePass  # noqa: F401
+
+__all__ = ["PassBase", "PassContext", "PassManager", "register_pass",
+           "GradientMergePass", "new_pass"]
+
+
+def new_pass(name, attrs=None):
+    """Reference passes/pass_base.py new_pass."""
+    from .pass_base import _PASSES
+    cls = _PASSES.get(name)
+    if cls is None:
+        raise ValueError(f"unknown pass {name!r}; registered: "
+                         f"{sorted(_PASSES)}")
+    p = cls()
+    for k, v in (attrs or {}).items():
+        p.set_attr(k, v)
+    return p

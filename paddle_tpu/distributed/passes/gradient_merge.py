@@ -1,0 +1,64 @@
+"""Gradient-merge pass (reference: distributed/passes/auto_parallel_
+gradient_merge.py — rewrites the program to accumulate k micro-batch grads
+then step once).
+
+TPU form: wrap the optimizer so `step()` is a counted accumulation —
+backward already sums into .grad, so k-1 calls are no-ops and the k-th
+rescales by 1/k (avg=True) and runs the real update. The micro/real split
+is a HOST decision (python counter): under `jit.to_static` the two phases
+compile as two programs, exactly like hapi Model.fit's
+accumulate_grad_batches (same contract, reference gradient_merge_pass's
+cond-block split). Masking grads inside one traced program instead would
+corrupt stateful optimizers (Adam moments would decay on masked steps).
+"""
+
+from __future__ import annotations
+
+from .pass_base import PassBase, register_pass
+
+
+class _GradientMergeOptimizer:
+    def __init__(self, inner, k_steps, avg=True):
+        self._inner = inner
+        self._k = int(k_steps)
+        self._avg = avg
+        self._count = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def is_real_step(self) -> bool:
+        """True when the NEXT step() call performs the optimizer update —
+        to_static callers key their compiled step on this (static kwarg),
+        mirroring hapi Model.train_batch(update=...)."""
+        return (self._count + 1) % self._k == 0
+
+    def step(self):
+        self._count += 1
+        if self._count % self._k:
+            return  # accumulate only; grads keep summing via backward
+        if self._avg:
+            for p in self._inner._parameter_list:
+                if p.grad is not None:
+                    p._grad = p.grad.scale(1.0 / self._k)
+        self._inner.step()
+        self._inner.clear_grad()
+
+    def clear_grad(self):
+        # grad lifetime belongs to the merge: cleared only on real steps
+        # (reference pass removes the per-microbatch zeroing ops too)
+        if self._count % self._k == 0:
+            self._inner.clear_grad()
+
+
+@register_pass("auto_parallel_gradient_merge_pass")
+@register_pass("gradient_merge")
+class GradientMergePass(PassBase):
+    """apply(optimizer) -> merged optimizer. Attrs: k_steps (default 2),
+    avg (default True)."""
+
+    def apply(self, target, context=None):
+        k = self.get_attr("k_steps", 2)
+        avg = self.get_attr("avg", True)
+        return _GradientMergeOptimizer(target, k, avg)

@@ -1,0 +1,70 @@
+"""Pass framework (reference: distributed/passes/pass_base.py)."""
+
+from __future__ import annotations
+
+_PASSES: dict = {}
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        _PASSES[name] = cls
+        return cls
+    return deco
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs = {}
+
+
+class PassBase:
+    name = "base"
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, k, v):
+        self._attrs[k] = v
+        return self
+
+    def get_attr(self, k, default=None):
+        return self._attrs.get(k, default)
+
+    def check_before_apply(self) -> bool:
+        return True
+
+    def apply(self, target, context=None):
+        """Transform and return `target` (an optimizer, a step callable, or
+        a model depending on the pass)."""
+        raise NotImplementedError
+
+
+class PassManager:
+    def __init__(self, passes):
+        self.passes = list(passes)
+
+    def apply(self, target, context=None):
+        ctx = context or PassContext()
+        for p in self.passes:
+            if p.check_before_apply():
+                target = p.apply(target, ctx)
+        return target
+
+
+@register_pass("fuse_all_reduce")
+class _FuseAllReducePass(PassBase):
+    """Subsumed: XLA fuses/buckets gradient collectives during scheduling
+    (HLO proof: tests/test_distributed.py::test_hlo_* collective tests)."""
+
+    def apply(self, target, context=None):
+        return target
+
+
+@register_pass("comm_overlap")
+class _CommOverlapPass(PassBase):
+    """Subsumed: XLA's latency-hiding scheduler overlaps collectives with
+    compute; no user-level rewrite exists or is needed."""
+
+    def apply(self, target, context=None):
+        return target

@@ -1,0 +1,158 @@
+"""RPC (reference: python/paddle/distributed/rpc/rpc.py:73 — init_rpc,
+rpc_sync, rpc_async, shutdown over the brpc-backed C++ agent).
+
+TPU form: the SPMD compute path never needs RPC, but the host-side control
+plane (parameter servers for sparse lookups, coordination, custom data
+services) keeps the surface. Implementation is a small TCP agent: each
+worker runs a listener thread; calls are pickled (fn, args, kwargs)
+executed on the callee's thread pool. Endpoints come from init_rpc's
+rank/world mapping, the same contract the launcher env sets.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "WorkerInfo"]
+
+_agent = None
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank}, " \
+               f"endpoint={self.ip}:{self.port})"
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    n = struct.unpack(">Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = pickle.loads(_recv_msg(self.request))
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except Exception as e:
+                result = ("err", e)
+            _send_msg(self.request, pickle.dumps(result, protocol=4))
+        except ConnectionError:
+            pass
+
+
+class _Agent:
+    def __init__(self, name, rank, world_size, workers):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.workers = workers  # name -> WorkerInfo
+        me = workers[name]
+        self._server = socketserver.ThreadingTCPServer(
+            (me.ip, me.port), _Handler, bind_and_activate=False)
+        self._server.allow_reuse_address = True
+        self._server.server_bind()
+        self._server.server_activate()
+        # the bound port (port=0 requests an ephemeral one)
+        me.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self._pool = ThreadPoolExecutor(max_workers=8)
+
+    def call(self, to, fn, args, kwargs, timeout):
+        info = self.workers[to] if isinstance(to, str) else to
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=timeout or None) as s:
+            _send_msg(s, pickle.dumps((fn, args, kwargs), protocol=4))
+            status, value = pickle.loads(_recv_msg(s))
+        if status == "err":
+            raise value
+        return value
+
+    def call_async(self, to, fn, args, kwargs, timeout) -> Future:
+        return self._pool.submit(self.call, to, fn, args, kwargs, timeout)
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._pool.shutdown(wait=False)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None,
+             worker_endpoints=None):
+    """Reference rpc.py init_rpc. worker_endpoints: list of "ip:port" in
+    rank order (port 0 = pick free); defaults to localhost ephemeral ports
+    coordinated via master_endpoint file for tests/single-host."""
+    global _agent
+    if worker_endpoints is None:
+        worker_endpoints = [f"127.0.0.1:0"] * (world_size or 1)
+    workers = {}
+    for r, ep in enumerate(worker_endpoints):
+        ip, port = ep.rsplit(":", 1)
+        wname = name if r == (rank or 0) else f"worker{r}"
+        workers[wname] = WorkerInfo(wname, r, ip, int(port))
+    _agent = _Agent(name, rank or 0, world_size or 1, workers)
+    return _agent
+
+
+def register_worker(name, ip, port, rank=None):
+    """Add/refresh a peer after its ephemeral port is known."""
+    if _agent is None:
+        raise RuntimeError("init_rpc first")
+    _agent.workers[name] = WorkerInfo(name, rank or len(_agent.workers),
+                                      ip, port)
+
+
+def get_worker_info(name=None):
+    if _agent is None:
+        raise RuntimeError("init_rpc first")
+    return _agent.workers[name or _agent.name]
+
+
+def rpc_sync(to, fn, args=(), kwargs=None, timeout=30):
+    if _agent is None:
+        raise RuntimeError("init_rpc first")
+    return _agent.call(to, fn, tuple(args), kwargs or {}, timeout)
+
+
+def rpc_async(to, fn, args=(), kwargs=None, timeout=30):
+    if _agent is None:
+        raise RuntimeError("init_rpc first")
+    return _agent.call_async(to, fn, tuple(args), kwargs or {}, timeout)
+
+
+def shutdown():
+    global _agent
+    if _agent is not None:
+        _agent.shutdown()
+        _agent = None

@@ -208,6 +208,7 @@ class Beta(Distribution):
             lambda x: (a - 1) * jnp.log(x) + (b - 1) * jnp.log1p(-x)
             - betaln(a, b), v, name="beta_log_prob")
 
+    @property
     def mean(self):
         return Tensor(self.alpha / (self.alpha + self.beta))
 

@@ -23,8 +23,9 @@ class QAT:
         for name, sub in list(layer._sub_layers.items()):
             full = f"{prefix}.{name}" if prefix else name
             cfg = self._config.config_for(sub, full)
-            if isinstance(sub, Linear) and cfg is not None and \
-                    not isinstance(sub, QuantedLinear):
+            if isinstance(sub, QuantedLinear):
+                continue  # already quantized: never recurse into or rewrap
+            if isinstance(sub, Linear) and cfg is not None:
                 act_q = cfg.activation._instance(sub) \
                     if cfg.activation is not None else None
                 w_q = cfg.weight._instance(sub) \

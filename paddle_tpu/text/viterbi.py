@@ -29,8 +29,10 @@ def viterbi_decode(potentials, transition_params, lengths=None,
     def f(pot, trans, lens):
         start = pot[:, 0, :]
         if include_bos_eos_tag:
-            # reference semantics: BOS tag is N-2, EOS is N-1
-            start = start + trans[n - 2][None, :]
+            # reference semantics (text/viterbi_decode.py:38): the LAST
+            # row/column of transitions is the start tag, the second-to-last
+            # the stop tag
+            start = start + trans[n - 1][None, :]
 
         def step(carry, xs):
             alpha, idx = carry
@@ -47,7 +49,7 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         (alpha, _), backptrs = jax.lax.scan(
             step, (start, jnp.int32(1)), (emits, masks))
         if include_bos_eos_tag:
-            alpha = alpha + trans[:, n - 1][None, :]
+            alpha = alpha + trans[:, n - 2][None, :]
         scores = jnp.max(alpha, axis=-1)
         last = jnp.argmax(alpha, axis=-1)                      # [B]
 

@@ -1,0 +1,5 @@
+"""paddle.utils equivalent (reference: python/paddle/utils/)."""
+
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension"]

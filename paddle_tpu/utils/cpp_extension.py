@@ -1,0 +1,141 @@
+"""C++ custom-op extension (reference: python/paddle/utils/cpp_extension/ —
+load/setup building .so custom ops against the Paddle C++ ABI).
+
+TPU-native redesign: custom *device* kernels are Pallas's job; the native
+extension surface targets the XLA FFI ABI instead of a framework-private
+one. `load()` compiles C++ sources against jaxlib's bundled XLA FFI headers
+into a shared library, registers each exported XLA_FFI handler as a custom-
+call target, and returns a namespace of framework-level ops (autograd
+Tensors in/out, usable inside jit). Handlers execute on the host (CPU
+platform) — the right tool for tokenizers, samplers, and data-pipeline ops
+that should not round-trip through Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import types
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["load", "get_include_dirs", "CppExtension", "BuildExtension"]
+
+_DEFAULT_BUILD_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+
+
+def get_include_dirs():
+    """Include paths for building FFI handlers (jaxlib ships xla/ffi/api)."""
+    return [jax.ffi.include_dir()]
+
+
+def _build_so(name, sources, extra_cflags, extra_ldflags, build_directory,
+              verbose):
+    os.makedirs(build_directory, exist_ok=True)
+    tag = hashlib.sha1(
+        ("".join(sorted(sources)) + str(extra_cflags)).encode()).hexdigest()[:10]
+    so_path = os.path.join(build_directory, f"{name}_{tag}.so")
+    srcs_mtime = max(os.path.getmtime(s) for s in sources)
+    if os.path.exists(so_path) and os.path.getmtime(so_path) >= srcs_mtime:
+        return so_path
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+    for inc in get_include_dirs():
+        cmd += ["-I", inc]
+    cmd += list(extra_cflags or [])
+    cmd += list(sources)
+    cmd += ["-o", so_path]
+    cmd += list(extra_ldflags or [])
+    if verbose:
+        print("[cpp_extension]", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"cpp_extension build failed:\n{proc.stderr[-4000:]}")
+    return so_path
+
+
+def _make_op(target_name, num_outputs=1):
+    """Framework-level op over an FFI target: shapes/dtypes of outputs
+    default to the first input's (elementwise contract); pass out_shapes
+    to the returned fn for anything else."""
+    from ..autograd.function import apply, apply_multi
+    from ..core.tensor import as_tensor
+
+    def op(*tensors, out_shapes=None, **attrs):
+        arrs = [as_tensor(t)._data for t in tensors]
+        if out_shapes is None:
+            outs = [jax.ShapeDtypeStruct(arrs[0].shape, arrs[0].dtype)
+                    for _ in range(num_outputs)]
+        else:
+            outs = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                    for s, d in out_shapes]
+        call = jax.ffi.ffi_call(target_name,
+                                outs[0] if num_outputs == 1 else outs)
+
+        def jfn(*xs):
+            return call(*xs, **attrs)
+
+        if num_outputs == 1:
+            return apply(jfn, *tensors, name=target_name)
+        return apply_multi(jfn, *tensors, name=target_name)
+
+    op.__name__ = target_name
+    return op
+
+
+def load(name, sources, functions, extra_cflags=None, extra_ldflags=None,
+         build_directory=None, verbose=False, platform="cpu"):
+    """Compile `sources`, register FFI handlers, return an op namespace.
+
+    functions: dict mapping python op name -> exported C symbol (created
+    with XLA_FFI_DEFINE_HANDLER_SYMBOL), or -> (symbol, num_outputs).
+    """
+    so_path = _build_so(name, sources, extra_cflags, extra_ldflags,
+                        build_directory or _DEFAULT_BUILD_DIR, verbose)
+    lib = ctypes.CDLL(so_path)
+    mod = types.SimpleNamespace(__so_path__=so_path)
+    for py_name, spec in functions.items():
+        symbol, n_out = (spec, 1) if isinstance(spec, str) else spec
+        target = f"{name}.{py_name}"
+        jax.ffi.register_ffi_target(
+            target, jax.ffi.pycapsule(getattr(lib, symbol)),
+            platform=platform)
+        setattr(mod, py_name, _make_op(target, n_out))
+    return mod
+
+
+class CppExtension:
+    """setup()-style extension description (reference cpp_extension
+    CppExtension); consumed by BuildExtension/load."""
+
+    def __init__(self, sources, include_dirs=None, extra_compile_args=None,
+                 extra_link_args=None, name=None):
+        self.sources = list(sources)
+        self.include_dirs = list(include_dirs or [])
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+        self.name = name
+
+
+class BuildExtension:
+    """Minimal stand-in for the reference's setuptools command: builds every
+    CppExtension eagerly into the cache dir."""
+
+    def __init__(self, extensions, build_directory=None, verbose=False):
+        self.extensions = extensions
+        self.build_directory = build_directory or _DEFAULT_BUILD_DIR
+        self.verbose = verbose
+
+    def build(self):
+        outs = []
+        for ext in self.extensions:
+            flags = ext.extra_compile_args + \
+                [f"-I{d}" for d in ext.include_dirs]
+            outs.append(_build_so(ext.name or "ext", ext.sources, flags,
+                                  ext.extra_link_args, self.build_directory,
+                                  self.verbose))
+        return outs

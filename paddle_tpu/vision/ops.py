@@ -47,12 +47,18 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None):
     """Greedy NMS as a fixed-trip lax loop (static shapes: TPU-compilable).
     Returns kept indices sorted by score (reference vision/ops.py nms)."""
-    b = as_tensor(boxes)._data
+    b = as_tensor(boxes)._data.astype(jnp.float32)
     n = b.shape[0]
     s = as_tensor(scores)._data if scores is not None \
         else jnp.arange(n, 0, -1, dtype=jnp.float32)
+    if category_idxs is not None:
+        # per-category NMS (reference contract): translate each category's
+        # boxes to a disjoint region so cross-category IoU is zero
+        cat = as_tensor(category_idxs)._data.astype(jnp.float32)
+        span = jnp.max(b) - jnp.min(b) + 1.0
+        b = b + (cat * span)[:, None]
 
-    iou = _iou_matrix(b.astype(jnp.float32))
+    iou = _iou_matrix(b)
     order = jnp.argsort(-s)
 
     ranks = jnp.empty_like(order).at[order].set(jnp.arange(n))

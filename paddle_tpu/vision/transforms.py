@@ -154,9 +154,11 @@ class BrightnessTransform:
     def __call__(self, img):
         if self.value == 0:
             return np.asarray(img)
+        src_arr = np.asarray(img)
+        # ceiling decided by the INPUT's dtype, not post-scale values
+        ceil = 255.0 if np.issubdtype(src_arr.dtype, np.integer) else 1.0
         factor = 1 + pyrandom.uniform(-self.value, self.value)
-        arr = np.asarray(img).astype(np.float32) * factor
-        return np.clip(arr, 0, 255 if arr.max() > 1 else 1.0)
+        return np.clip(src_arr.astype(np.float32) * factor, 0, ceil)
 
 
 class Pad:
@@ -169,6 +171,8 @@ class Pad:
         p = self.padding
         if isinstance(p, numbers.Number):
             p = (p, p, p, p)
+        elif len(p) == 2:  # (left/right, top/bottom), reference contract
+            p = (p[0], p[1], p[0], p[1])
         pad = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
         return np.pad(arr, pad, mode="constant",
                       constant_values=self.fill)

@@ -1,0 +1,12 @@
+"""Packaging shim (configuration lives in pyproject.toml).
+
+Native code note: the C++ sources under paddle_tpu/csrc/ ship as package
+data and are compiled ON DEMAND against the installed jaxlib's XLA FFI
+headers via paddle_tpu.utils.cpp_extension.load — prebuilt binaries would
+pin a single jaxlib ABI, exactly the portability trap the reference's
+prebuilt-kernel wheels suffer from.
+"""
+
+from setuptools import setup
+
+setup()

@@ -161,3 +161,63 @@ def test_viterbi_decode_matches_bruteforce():
                 best, best_path = s, cand
         np.testing.assert_allclose(got_scores[bi], best, rtol=1e-5)
         assert got_paths[bi].tolist() == list(best_path)
+
+
+def test_viterbi_bos_eos_convention():
+    """include_bos_eos_tag=True: last transitions row = start tag, second-
+    to-last column = stop tag (reference viterbi_decode.py:38)."""
+    import itertools
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.default_rng(1)
+    b, t, n = 1, 4, 4
+    pot = rng.standard_normal((b, t, n)).astype(np.float32)
+    trans = rng.standard_normal((n, n)).astype(np.float32)
+    scores, paths = viterbi_decode(paddle.to_tensor(pot),
+                                   paddle.to_tensor(trans))
+    best, best_path = -1e30, None
+    for cand in itertools.product(range(n), repeat=t):
+        s = trans[n - 1, cand[0]] + pot[0, 0, cand[0]]
+        for i in range(1, t):
+            s += trans[cand[i - 1], cand[i]] + pot[0, i, cand[i]]
+        s += trans[cand[-1], n - 2]
+        if s > best:
+            best, best_path = s, cand
+    np.testing.assert_allclose(float(scores), best, rtol=1e-5)
+    assert np.asarray(paths.numpy())[0].tolist() == list(best_path)
+
+
+def test_nms_per_category():
+    from paddle_tpu.vision.ops import nms
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],
+    ], dtype=np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8], dtype=np.float32))
+    cats = paddle.to_tensor(np.array([0, 1], dtype=np.int64))
+    # different categories: both survive despite heavy overlap
+    keep = nms(boxes, 0.5, scores=scores, category_idxs=cats,
+               categories=[0, 1])
+    assert sorted(np.asarray(keep.numpy()).tolist()) == [0, 1]
+
+
+def test_pad_two_tuple_and_brightness_ceiling():
+    from paddle_tpu.vision import transforms as T
+    img = np.zeros((8, 8, 3), np.uint8)
+    assert T.Pad((2, 3))(img).shape == (14, 12, 3)
+    f = np.full((4, 4, 3), 0.9, np.float32)
+    out = T.BrightnessTransform(0.5)(f)
+    assert out.max() <= 1.0 + 1e-6  # float input clipped at 1
+
+
+def test_qat_idempotent():
+    import paddle_tpu.nn as nn2
+    from paddle_tpu.quantization import (QAT, QuantConfig, QuantedLinear,
+                                         FakeQuanterWithAbsMaxObserver)
+    q = FakeQuanterWithAbsMaxObserver()
+    qat = QAT(QuantConfig(activation=q, weight=q))
+    m = nn2.Sequential(nn2.Linear(4, 4))
+    m1 = qat.quantize(m)
+    m2 = qat.quantize(m1)
+    inner = m2._sub_layers["0"]
+    assert isinstance(inner, QuantedLinear)
+    assert not isinstance(inner.inner, QuantedLinear)  # no nesting
