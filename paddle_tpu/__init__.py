@@ -109,7 +109,8 @@ def __getattr__(name):
         mod = importlib.import_module(".incubate", __name__)
         globals()["incubate"] = mod
         return mod
-    if name in ("distribution", "text", "quantization"):
+    if name in ("distribution", "text", "quantization", "static",
+                "auto_tuner"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

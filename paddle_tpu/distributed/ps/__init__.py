@@ -1,0 +1,85 @@
+"""Parameter server (reference: python/paddle/distributed/ps/ — the
+fleet PS mode for huge sparse embeddings: servers own shards of the table,
+workers pull rows for a batch and push gradient updates).
+
+TPU mapping: DENSE params belong on-device (SPMD); the PS niche that
+survives is host-memory-scale sparse embedding tables. The implementation
+rides the framework RPC agent: `ParameterServer` holds row shards keyed by
+id hash; `SparseTable` is the worker-side handle whose pull returns a
+device tensor and whose push applies SGD-style row updates server-side.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import rpc
+
+__all__ = ["ParameterServer", "SparseTable"]
+
+_TABLES: dict[str, "ParameterServer"] = {}
+
+
+class ParameterServer:
+    """Row-sharded embedding storage living on one RPC worker."""
+
+    def __init__(self, name, dim, initializer=None, lr=0.1):
+        self.name = name
+        self.dim = dim
+        self.lr = lr
+        self._rows: dict[int, np.ndarray] = {}
+        if initializer is None:
+            rng = np.random.default_rng(hash(name) % 2**31)  # one stream
+            initializer = lambda: rng.standard_normal(dim)\
+                .astype(np.float32) * 0.01
+        self._init = initializer
+        _TABLES[name] = self
+
+    # executed server-side via rpc
+    @staticmethod
+    def pull_rows(table, ids):
+        t = _TABLES[table]
+        return np.stack([t._rows.setdefault(int(i), t._init())
+                         for i in ids])
+
+    @staticmethod
+    def push_grads(table, ids, grads, lr=None):
+        t = _TABLES[table]
+        step = t.lr if lr is None else lr
+        for i, g in zip(ids, grads):
+            row = t._rows.setdefault(int(i), t._init())
+            t._rows[int(i)] = row - step * g.astype(np.float32)
+        return len(ids)
+
+    @staticmethod
+    def row_count(table):
+        return len(_TABLES[table]._rows)
+
+
+class SparseTable:
+    """Worker-side handle: pull/push against the server that owns the
+    table (reference distributed/ps distributed embedding lookup)."""
+
+    def __init__(self, name, dim, server, lr=None):
+        self.name = name
+        self.dim = dim
+        self.server = server  # WorkerInfo or registered rpc name
+        self.lr = lr  # None -> server-side default
+
+    def pull(self, ids):
+        import paddle_tpu as paddle
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        rows = rpc.rpc_sync(self.server, ParameterServer.pull_rows,
+                            args=(self.name, ids.tolist()))
+        return paddle.to_tensor(rows)
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        g = np.asarray(grads, dtype=np.float32).reshape(len(ids), self.dim)
+        return rpc.rpc_sync(self.server, ParameterServer.push_grads,
+                            args=(self.name, ids.tolist(), list(g),
+                                  self.lr))
+
+    def size(self):
+        return rpc.rpc_sync(self.server, ParameterServer.row_count,
+                            args=(self.name,))

@@ -1,0 +1,120 @@
+"""ASP — automatic n:m structured sparsity (reference:
+python/paddle/incubate/asp/asp.py — prune_model :302, decorate :216,
+set_excluded_layers :40).
+
+TPU note: the reference's ASP feeds Ampere sparse tensor cores; TPUs have
+no 2:4 hardware path, so the value here is the MODEL side of the recipe —
+produce and maintain n:m masks so sparsity-trained checkpoints transfer,
+and downstream weight-only compression has structured zeros to exploit.
+Masking is a pure jnp transform applied after each optimizer step
+(`decorate`), identical math to the reference's mask maintenance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer import Layer
+from ...nn.layers.common import Linear
+
+__all__ = ["prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density", "check_mask_1d",
+           "create_mask"]
+
+_EXCLUDED: set[str] = set()
+# masks live ON the parameter object (p._asp_mask): no global registry to
+# leak or collide when ids are recycled across model lifetimes
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Params whose names appear here are never pruned (reference :40)."""
+    for n in param_names:
+        _EXCLUDED.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def create_mask(weight: "np.ndarray", n=2, m=4) -> "np.ndarray":
+    """n:m mask along the LAST axis: keep the n largest-|w| of every m
+    consecutive elements within each row (reference utils.py get_mask_1d —
+    groups never straddle rows, so rows whose length is not a multiple of m
+    are padded independently)."""
+    w = np.asarray(weight)
+    last = w.shape[-1]
+    rows = np.abs(w).reshape(-1, last)
+    pad = (-last) % m
+    if pad:
+        rows = np.concatenate(
+            [rows, np.zeros((rows.shape[0], pad), rows.dtype)], axis=1)
+    groups = rows.reshape(rows.shape[0], -1, m)
+    order = np.argsort(groups, axis=2)
+    mask = np.ones_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[:, :, : m - n], False, axis=2)
+    mask = mask.reshape(rows.shape[0], -1)[:, :last]
+    return mask.reshape(w.shape)
+
+
+def check_mask_1d(mat: "np.ndarray", n=2, m=4) -> bool:
+    """True if every per-row m-group keeps at most n nonzeros (reference
+    utils.check_mask_1d)."""
+    a = np.asarray(mat)
+    rows = a.reshape(-1, a.shape[-1])
+    pad = (-rows.shape[1]) % m
+    if pad:
+        rows = np.concatenate(
+            [rows, np.zeros((rows.shape[0], pad), rows.dtype)], axis=1)
+    groups = rows.reshape(rows.shape[0], -1, m)
+    return bool(((groups != 0).sum(axis=2) <= n).all())
+
+
+def calculate_density(mat: "np.ndarray") -> float:
+    a = np.asarray(mat)
+    return float((a != 0).sum() / a.size)
+
+
+def _prunable_params(model: Layer):
+    for name, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, Linear) and sub.weight is not None:
+            if sub.weight.name in _EXCLUDED or name in _EXCLUDED:
+                continue
+            yield sub.weight
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d",
+                with_mask=True):
+    """Apply n:m pruning to every supported layer's weight; record masks so
+    `decorate`d optimizers keep them (reference asp.py:302)."""
+    import jax.numpy as jnp
+    masks = {}
+    for p in _prunable_params(model):
+        mask = create_mask(np.asarray(p.numpy()), n=n, m=m)
+        p._d = p._d * jnp.asarray(mask, p._d.dtype)
+        if with_mask:
+            p._asp_mask = mask
+            masks[p.name] = mask
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies recorded masks after every step (reference :919)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        import jax.numpy as jnp
+        self._inner.step()
+        for p in self._inner._parameter_list:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._d = p._d * jnp.asarray(mask, p._d.dtype)
+
+
+def decorate(optimizer):
+    """Reference asp.py:216 decorate."""
+    return OptimizerWithSparsityGuarantee(optimizer)

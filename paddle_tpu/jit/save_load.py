@@ -107,6 +107,10 @@ def save(layer, path, input_spec=None, **configs):
                          for k, v in state.items()}
         exported = jax_export.export(jax.jit(fn))(param_structs, *structs)
         payload["exported"] = exported.serialize()
+        _names = [getattr(s, "name", None) for s in input_spec]
+        # only a FULLY user-named spec list creates the name-keyed feed
+        # contract; otherwise Executor.run binds positionally
+        payload["feed_names"] = _names if all(_names) else None
         payload["in_shapes"] = [
             (tuple(d if isinstance(d, int) else str(d) for d in s.shape),
              str(s.dtype)) for s in structs]  # symbolic dims as strings
@@ -133,6 +137,7 @@ class TranslatedLayer(Layer):
         for k, p in self._state.items():
             self.add_parameter(k.replace(".", "__"), p)
         self._program_text = payload.get("stablehlo")
+        self._feed_names = payload.get("feed_names")
         self._exported = None
         if payload.get("exported") is not None:
             self._exported = jax_export.deserialize(payload["exported"])

@@ -1,0 +1,107 @@
+"""paddle.static shim (reference: python/paddle/static/ — Program,
+Executor, data, InputSpec and the graph-mode training path).
+
+TPU position (SURVEY.md L4): the jaxpr/StableHLO produced by tracing IS the
+static program, so graph capture goes through `paddle.jit.to_static` and the
+auto-parallel `Engine`; this module keeps the reference's *surface* for code
+that imports paddle.static, mapping each name onto the traced-program world:
+
+- InputSpec           -> jit.InputSpec (shape/dtype declaration, -1 dynamic)
+- default_main_program/Program -> a no-op Program handle whose str() is the
+  most recent exported StableHLO (inspection parity)
+- Executor.run        -> executes a to_static-compiled callable
+- save/load_inference_model -> jit.save / jit.load
+"""
+
+from __future__ import annotations
+
+from ..jit.save_load import InputSpec  # noqa: F401
+from ..jit.save_load import load as _jit_load
+from ..jit.save_load import save as _jit_save
+
+__all__ = ["InputSpec", "Program", "default_main_program",
+           "default_startup_program", "Executor", "save_inference_model",
+           "load_inference_model", "name_scope"]
+
+
+class Program:
+    """Handle object; real program text comes from exported functions."""
+
+    def __init__(self, text=""):
+        self._text = text
+
+    def __str__(self):
+        return self._text or "<traced program: see jit.save .pdmodel.txt>"
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return Program(self._text)
+
+
+_MAIN = Program()
+_STARTUP = Program()
+
+
+def default_main_program():
+    return _MAIN
+
+
+def default_startup_program():
+    return _STARTUP
+
+
+class Executor:
+    """Reference static.Executor: run(program, feed, fetch_list). Here a
+    'program' is any compiled callable (to_static fn or TranslatedLayer);
+    feed maps argument names positionally."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kw):
+        if not callable(program):
+            raise TypeError(
+                "static.Executor.run expects a compiled callable (a "
+                "jit.to_static function or loaded TranslatedLayer); the "
+                "op-by-op Program executor is subsumed by XLA")
+        feed = feed or {}
+        names = getattr(program, "_feed_names", None)
+        if names:
+            missing = [n for n in names if n not in feed]
+            if missing:
+                raise KeyError(f"feed missing inputs {missing}; "
+                               f"expected {names}")
+            args = [feed[n] for n in names]
+        else:
+            args = list(feed.values())  # no recorded names: caller order
+        outs = program(*args)
+        if isinstance(outs, (list, tuple)):
+            return [o.numpy() for o in outs]
+        return [outs.numpy()]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kw):
+    """Reference static.save_inference_model -> jit.save."""
+    layer = kw.get("layer") or program
+    if layer is None or not hasattr(layer, "state_dict"):
+        raise TypeError("pass the Layer to serialize via program=<layer>")
+    _jit_save(layer, path_prefix, input_spec=feed_vars)
+
+
+def load_inference_model(path_prefix, executor=None, **kw):
+    layer = _jit_load(path_prefix)
+    return layer
+
+
+class name_scope:
+    def __init__(self, name=""):
+        self.name = name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

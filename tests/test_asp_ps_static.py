@@ -1,0 +1,177 @@
+"""ASP sparsity, parameter server, static shim, CLI tools."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_asp_prune_and_maintain():
+    from paddle_tpu.incubate import asp
+    paddle.seed(51)
+    asp.reset_excluded_layers()
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    masks = asp.prune_model(net, n=2, m=4)
+    assert masks
+    for p in [net._sub_layers["0"].weight, net._sub_layers["2"].weight]:
+        arr = np.asarray(p.numpy())
+        assert asp.check_mask_1d(arr, 2, 4)
+        assert abs(asp.calculate_density(arr) - 0.5) < 0.05
+
+    opt = asp.decorate(paddle.optimizer.SGD(0.05,
+                                            parameters=net.parameters()))
+    x = paddle.randn([8, 16])
+    y = paddle.to_tensor(np.random.default_rng(0).integers(0, 4, 8))
+    for _ in range(3):
+        loss = nn.CrossEntropyLoss()(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # masks survived training steps
+    for p in [net._sub_layers["0"].weight, net._sub_layers["2"].weight]:
+        assert asp.check_mask_1d(np.asarray(p.numpy()), 2, 4)
+
+
+def test_asp_excluded_layers():
+    from paddle_tpu.incubate import asp
+    asp.reset_excluded_layers()
+    net = nn.Sequential(nn.Linear(8, 8))
+    asp.set_excluded_layers([net._sub_layers["0"].weight.name])
+    masks = asp.prune_model(net)
+    assert not masks  # nothing pruned
+    asp.reset_excluded_layers()
+
+
+def test_parameter_server_pull_push():
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import ParameterServer, SparseTable
+    rpc.init_rpc("ps0", rank=0, world_size=1)
+    try:
+        ParameterServer("emb", dim=8, lr=0.5)
+        table = SparseTable("emb", dim=8, server=rpc.get_worker_info())
+        ids = [3, 7, 3]
+        rows = table.pull(ids)
+        assert rows.shape == [3, 8]
+        r = np.asarray(rows.numpy())
+        np.testing.assert_allclose(r[0], r[2])  # same id, same row
+        # push a gradient of ones on id 3: row -= lr * (g0 + g2)?? each
+        # occurrence applied separately -> 2 * 0.5 * 1
+        table.push([3], np.ones((1, 8), np.float32))
+        r2 = np.asarray(table.pull([3]).numpy())[0]
+        np.testing.assert_allclose(r2, r[0] - 0.5, atol=1e-6)
+        assert table.size() == 2
+    finally:
+        rpc.shutdown()
+
+
+def test_static_shim_roundtrip(tmp_path):
+    import paddle_tpu.static as static
+    paddle.seed(52)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([2, 4])
+    ref = net(x)
+    static.save_inference_model(str(tmp_path / "m"),
+                                [static.InputSpec([2, 4])], None,
+                                program=net)
+    loaded = static.load_inference_model(str(tmp_path / "m"))
+    exe = static.Executor()
+    outs = exe.run(loaded, feed={"x": x})
+    np.testing.assert_allclose(np.asarray(outs[0]),
+                               np.asarray(ref.numpy()), rtol=1e-5)
+    assert "InputSpec" in dir(static)
+    assert str(static.default_main_program())
+
+
+def test_cli_tools():
+    import os
+    root = os.path.join(os.path.dirname(__file__), "..")
+    # shard 0 of 10000 shards: nearly always zero files -> exit 0 fast
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "run_tests_sharded.py"),
+         "--shards", "100000", "--index", "7"],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+
+    import json
+    b = os.path.join(str(root), "b.json")
+    c = os.path.join(str(root), "c.json")
+    for path, v in ((b, 100.0), (c, 90.0)):
+        with open(path, "w") as f:
+            json.dump({"metric": "toks", "value": v}, f)
+    try:
+        gate = os.path.join(root, "tools", "perf_gate.py")
+        ok = subprocess.run([sys.executable, gate, "--baseline", b,
+                             "--current", b], capture_output=True)
+        assert ok.returncode == 0
+        bad = subprocess.run([sys.executable, gate, "--baseline", b,
+                              "--current", c], capture_output=True)
+        assert bad.returncode == 1
+    finally:
+        os.remove(b)
+        os.remove(c)
+
+
+def test_asp_mask_per_row_non_divisible():
+    """Rows whose length isn't a multiple of m are padded per row: groups
+    never straddle row boundaries (reference get_mask_1d)."""
+    from paddle_tpu.incubate import asp
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((4, 10)).astype(np.float32)
+    mask = asp.create_mask(w, n=2, m=4)
+    assert asp.check_mask_1d(w * mask, 2, 4)
+    # per-row: each complete 4-group keeps exactly 2
+    masked = (w * mask)
+    for r in range(4):
+        for g in range(2):  # two complete groups of 4 in 10 elems
+            assert (masked[r, g * 4:(g + 1) * 4] != 0).sum() <= 2
+
+
+def test_ps_rows_differ_and_client_lr():
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import ParameterServer, SparseTable
+    rpc.init_rpc("ps1", rank=0, world_size=1)
+    try:
+        ParameterServer("emb2", dim=4, lr=0.1)
+        t = SparseTable("emb2", dim=4, server=rpc.get_worker_info(), lr=1.0)
+        rows = np.asarray(t.pull([1, 2]).numpy())
+        assert not np.allclose(rows[0], rows[1])  # distinct init per row
+        before = np.asarray(t.pull([1]).numpy())[0]
+        t.push([1], np.ones((1, 4), np.float32))
+        after = np.asarray(t.pull([1]).numpy())[0]
+        np.testing.assert_allclose(after, before - 1.0, atol=1e-6)  # lr=1
+    finally:
+        rpc.shutdown()
+
+
+def test_executor_feed_by_name(tmp_path):
+    import paddle_tpu.static as static
+    paddle.seed(53)
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 2)
+
+        def forward(self, x, y):
+            return self.lin(x) + y
+
+    net = TwoIn()
+    x = paddle.randn([2, 4])
+    y = paddle.randn([2, 2])
+    ref = net(x, y)
+    static.save_inference_model(
+        str(tmp_path / "m"),
+        [static.InputSpec([2, 4], name="x"),
+         static.InputSpec([2, 2], name="y")], None, program=net)
+    loaded = static.load_inference_model(str(tmp_path / "m"))
+    exe = static.Executor()
+    # reversed feed order must still bind by name
+    outs = exe.run(loaded, feed={"y": y, "x": x})
+    np.testing.assert_allclose(np.asarray(outs[0]),
+                               np.asarray(ref.numpy()), rtol=1e-5)
+    with pytest.raises(KeyError, match="missing"):
+        exe.run(loaded, feed={"x": x})

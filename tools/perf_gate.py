@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Perf regression gate (reference analog: tools/check_op_benchmark_result.py
+:30 — parse speed logs, compare ratios against a baseline, fail the build on
+regressions).
+
+Usage:
+  python tools/perf_gate.py --baseline BENCH_old.json --current BENCH_new.json
+      [--tolerance 0.05]
+
+Each file is the bench.py one-line JSON ({"metric", "value", ...}); value is
+throughput (higher better). Exit 1 if current < baseline * (1 - tolerance).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_value(path):
+    with open(path) as f:
+        txt = f.read()
+    # the driver's BENCH_r*.json wraps the line; accept both forms
+    try:
+        d = json.loads(txt)
+    except json.JSONDecodeError:
+        lines = [l for l in txt.splitlines() if l.strip().startswith("{")]
+        if not lines:
+            return None, 0.0  # no usable value: caller passes
+        d = json.loads(lines[-1])
+    if "tail" in d and isinstance(d.get("tail"), str):
+        for line in reversed(d["tail"].splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                d = json.loads(line)
+                break
+    return d.get("metric"), float(d.get("value", 0.0))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baseline", required=True)
+    ap.add_argument("--current", required=True)
+    ap.add_argument("--tolerance", type=float, default=0.05)
+    args = ap.parse_args()
+    bm, bv = load_value(args.baseline)
+    cm, cv = load_value(args.current)
+    if bv <= 0:
+        print(f"perf gate: baseline has no usable value ({bm}={bv}); pass")
+        return 0
+    if bm != cm:
+        print(f"perf gate: metric changed {bm} -> {cm}; pass (no comparison)")
+        return 0
+    floor = bv * (1 - args.tolerance)
+    status = "OK" if cv >= floor else "REGRESSION"
+    print(f"perf gate [{status}] {cm}: current {cv:.1f} vs baseline "
+          f"{bv:.1f} (floor {floor:.1f}, tol {args.tolerance:.0%})")
+    return 0 if cv >= floor else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Sharded test runner (reference analog: tools/ test sharding in CI
+scripts — split the suite across N parallel workers by stable hash).
+
+Usage: python tools/run_tests_sharded.py --shards 4 --index 0 [pytest args]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import subprocess
+import sys
+from pathlib import Path
+
+
+def collect_test_files(root: Path):
+    return sorted(str(p) for p in (root / "tests").glob("test_*.py"))
+
+
+def shard(files, shards, index):
+    return [f for f in files
+            if int(hashlib.sha1(Path(f).name.encode()).hexdigest(), 16)
+            % shards == index]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=1)
+    ap.add_argument("--index", type=int, default=0)
+    ap.add_argument("rest", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    root = Path(__file__).resolve().parent.parent
+    mine = shard(collect_test_files(root), args.shards, args.index)
+    if not mine:
+        print(f"shard {args.index}/{args.shards}: no files")
+        return 0
+    print(f"shard {args.index}/{args.shards}: {len(mine)} files")
+    cmd = [sys.executable, "-m", "pytest", "-q", *mine, *args.rest]
+    return subprocess.call(cmd, cwd=root)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
