@@ -110,7 +110,7 @@ def __getattr__(name):
         globals()["incubate"] = mod
         return mod
     if name in ("distribution", "text", "quantization", "static",
-                "auto_tuner"):
+                "auto_tuner", "audio", "sparse"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

@@ -1,0 +1,15 @@
+"""paddle.audio equivalent (reference: python/paddle/audio/ — features/
+layers.py Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC, functional/
+window + mel utilities).
+
+All features are jnp compositions (framing + rFFT + filterbanks), so they
+run inside jitted train steps on TPU — the reference's separate C++ kernels
+are subsumed by XLA fusion of the framing matmuls.
+"""
+
+from . import functional  # noqa: F401
+from .features import (MFCC, LogMelSpectrogram, MelSpectrogram,  # noqa: F401
+                       Spectrogram)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

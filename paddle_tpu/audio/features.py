@@ -1,0 +1,122 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py
+Spectrogram :24, MelSpectrogram :106, LogMelSpectrogram :206, MFCC :309)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from ..autograd.function import apply
+from ..nn.layer import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """STFT magnitude^power: frame -> window -> rFFT (reference :24).
+    Input [B, T] (or [T]); output [B, n_fft//2+1, n_frames]."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = AF.get_window(window, self.win_length)
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            pad = n_fft - self.win_length
+            import numpy as np
+            w = np.pad(w, (pad // 2, pad - pad // 2))
+        self._window = jnp.asarray(w)
+
+    def forward(self, x):
+        n_fft, hop = self.n_fft, self.hop
+        win = self._window
+        power = self.power
+        center = self.center
+        pad_mode = self.pad_mode
+
+        def f(a):
+            squeeze = a.ndim == 1
+            if squeeze:
+                a = a[None, :]
+            if center:
+                a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)),
+                            mode=pad_mode)
+            n_frames = 1 + (a.shape[-1] - n_fft) // hop
+            idx = (jnp.arange(n_frames)[:, None] * hop
+                   + jnp.arange(n_fft)[None, :])
+            frames = a[:, idx] * win[None, None, :]      # [B, F, n_fft]
+            spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** power
+            out = jnp.swapaxes(spec, 1, 2)               # [B, bins, F]
+            return out[0] if squeeze else out
+        return apply(f, x, name="spectrogram")
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram -> mel filterbank (reference :106)."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        self._fbank = jnp.asarray(AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        fb = self._fbank
+        return apply(lambda s: jnp.einsum("mf,...ft->...mt", fb, s), spec,
+                     name="mel_spectrogram")
+
+
+class LogMelSpectrogram(Layer):
+    """power_to_db(MelSpectrogram) (reference :206)."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+        return apply(lambda s: AF.power_to_db(s, self.ref_value, self.amin,
+                                              self.top_db), m,
+                     name="log_mel_spectrogram")
+
+
+class MFCC(Layer):
+    """DCT-II over log-mel (reference :309). Output [B, n_mfcc, frames]."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db)
+        self._dct = jnp.asarray(AF.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        lm = self.log_mel(x)
+        dct = self._dct
+        return apply(lambda s: jnp.einsum("mk,...mt->...kt", dct, s), lm,
+                     name="mfcc")

@@ -1,0 +1,101 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/ —
+window.py get_window, functional.py hz_to_mel/mel_to_hz/
+compute_fbank_matrix/create_dct)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
+           "create_dct", "power_to_db"]
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/boxcar windows (reference window.py)."""
+    n = win_length
+    denom = n if fftbins else n - 1
+    k = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * k / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * k / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * k / denom)
+             + 0.08 * np.cos(4 * math.pi * k / denom))
+    elif window in ("boxcar", "rectangular", "ones"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w.astype(np.float32)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = np.asarray(freq, dtype=np.float64)
+    if htk:
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+    # Slaney scale (reference default)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(f / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, dtype=np.float64)
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64, f_min=0.0,
+                         f_max=None, htk=False, norm="slaney"):
+    """[n_mels, n_fft//2 + 1] triangular mel filterbank (reference
+    functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2, n_bins)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_bins))
+    for i in range(n_mels):
+        lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-9)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-9)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return fb.astype(np.float32)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho"):
+    """[n_mels, n_mfcc] DCT-II basis (reference functional.py create_dct)."""
+    k = np.arange(n_mfcc)[None, :]
+    n = np.arange(n_mels)[:, None]
+    basis = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(2.0)
+        basis *= math.sqrt(2.0 / n_mels)
+    return basis.astype(np.float32)
+
+
+def power_to_db(spec, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10 with clamping (reference functional.py power_to_db)."""
+    import jax.numpy as jnp
+    log_spec = 10.0 * jnp.log10(jnp.maximum(spec, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec

@@ -37,17 +37,26 @@ class ParameterServer:
 
     # executed server-side via rpc
     @staticmethod
+    def _row(t, i):
+        # no setdefault: its default evaluates eagerly, which would burn an
+        # rng draw per existing-id lookup and make new-row init depend on
+        # query history
+        i = int(i)
+        if i not in t._rows:
+            t._rows[i] = t._init()
+        return t._rows[i]
+
+    @staticmethod
     def pull_rows(table, ids):
         t = _TABLES[table]
-        return np.stack([t._rows.setdefault(int(i), t._init())
-                         for i in ids])
+        return np.stack([ParameterServer._row(t, i) for i in ids])
 
     @staticmethod
     def push_grads(table, ids, grads, lr=None):
         t = _TABLES[table]
         step = t.lr if lr is None else lr
         for i, g in zip(ids, grads):
-            row = t._rows.setdefault(int(i), t._init())
+            row = ParameterServer._row(t, i)
             t._rows[int(i)] = row - step * g.astype(np.float32)
         return len(ids)
 

@@ -36,36 +36,35 @@ def reset_excluded_layers(main_program=None):
     _EXCLUDED.clear()
 
 
-def create_mask(weight: "np.ndarray", n=2, m=4) -> "np.ndarray":
-    """n:m mask along the LAST axis: keep the n largest-|w| of every m
-    consecutive elements within each row (reference utils.py get_mask_1d —
-    groups never straddle rows, so rows whose length is not a multiple of m
-    are padded independently)."""
-    w = np.asarray(weight)
-    last = w.shape[-1]
-    rows = np.abs(w).reshape(-1, last)
-    pad = (-last) % m
-    if pad:
-        rows = np.concatenate(
-            [rows, np.zeros((rows.shape[0], pad), rows.dtype)], axis=1)
-    groups = rows.reshape(rows.shape[0], -1, m)
-    order = np.argsort(groups, axis=2)
-    mask = np.ones_like(groups, dtype=bool)
-    np.put_along_axis(mask, order[:, :, : m - n], False, axis=2)
-    mask = mask.reshape(rows.shape[0], -1)[:, :last]
-    return mask.reshape(w.shape)
-
-
-def check_mask_1d(mat: "np.ndarray", n=2, m=4) -> bool:
-    """True if every per-row m-group keeps at most n nonzeros (reference
-    utils.check_mask_1d)."""
+def _grouped(mat: "np.ndarray", m: int):
+    """[rows, n_groups, m] view of the last axis, rows padded independently
+    so groups never straddle row boundaries."""
     a = np.asarray(mat)
     rows = a.reshape(-1, a.shape[-1])
     pad = (-rows.shape[1]) % m
     if pad:
         rows = np.concatenate(
             [rows, np.zeros((rows.shape[0], pad), rows.dtype)], axis=1)
-    groups = rows.reshape(rows.shape[0], -1, m)
+    return rows.reshape(rows.shape[0], -1, m)
+
+
+def create_mask(weight: "np.ndarray", n=2, m=4) -> "np.ndarray":
+    """n:m mask along the LAST axis: keep the n largest-|w| of every m
+    consecutive elements within each row (reference utils.py get_mask_1d)."""
+    w = np.asarray(weight)
+    last = w.shape[-1]
+    groups = _grouped(np.abs(w), m)
+    order = np.argsort(groups, axis=2)
+    mask = np.ones_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[:, :, : m - n], False, axis=2)
+    mask = mask.reshape(groups.shape[0], -1)[:, :last]
+    return mask.reshape(w.shape)
+
+
+def check_mask_1d(mat: "np.ndarray", n=2, m=4) -> bool:
+    """True if every per-row m-group keeps at most n nonzeros (reference
+    utils.check_mask_1d)."""
+    groups = _grouped(mat, m)
     return bool(((groups != 0).sum(axis=2) <= n).all())
 
 
@@ -106,13 +105,25 @@ class OptimizerWithSparsityGuarantee:
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
-    def step(self):
+    def _remask(self):
         import jax.numpy as jnp
-        self._inner.step()
         for p in self._inner._parameter_list:
             mask = getattr(p, "_asp_mask", None)
             if mask is not None:
                 p._d = p._d * jnp.asarray(mask, p._d.dtype)
+
+    def step(self):
+        self._inner.step()
+        self._remask()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # the reference wraps minimize as well (asp.py:919): the inner
+        # minimize calls the INNER step, bypassing the mask hook
+        out = self._inner.minimize(loss, startup_program, parameters,
+                                   no_grad_set)
+        self._remask()
+        return out
 
 
 def decorate(optimizer):

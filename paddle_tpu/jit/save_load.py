@@ -107,10 +107,12 @@ def save(layer, path, input_spec=None, **configs):
                          for k, v in state.items()}
         exported = jax_export.export(jax.jit(fn))(param_structs, *structs)
         payload["exported"] = exported.serialize()
-        _names = [getattr(s, "name", None) for s in input_spec]
-        # only a FULLY user-named spec list creates the name-keyed feed
-        # contract; otherwise Executor.run binds positionally
-        payload["feed_names"] = _names if all(_names) else None
+        _names = [s.name if isinstance(s, InputSpec) else None
+                  for s in input_spec]
+        # only a FULLY user-named InputSpec list creates the name-keyed
+        # feed contract (Tensor specs carry auto-generated names that the
+        # caller never chose); otherwise Executor.run binds positionally
+        payload["feed_names"] = _names if _names and all(_names) else None
         payload["in_shapes"] = [
             (tuple(d if isinstance(d, int) else str(d) for d in s.shape),
              str(s.dtype)) for s in structs]  # symbolic dims as strings

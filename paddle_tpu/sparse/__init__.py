@@ -1,0 +1,125 @@
+"""paddle.sparse equivalent (reference: python/paddle/sparse/ —
+creation.py sparse_coo_tensor/sparse_csr_tensor, unary/binary ops, nn).
+
+TPU design: sparse values ride jax.experimental.sparse.BCOO — XLA lowers
+sparse-dense matmuls to gather/scatter programs, which is the honest TPU
+story (no sparse tensor cores). The SparseTensor wrapper keeps the
+reference surface: indices()/values()/to_dense()/nnz, add/mul, matmul,
+relu, and coalesce.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+           "to_dense", "add", "multiply", "matmul", "relu", "coalesce",
+           "is_sparse"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor over BCOO (reference core SparseCooTensor)."""
+
+    def __init__(self, bcoo: "jsparse.BCOO"):
+        self._b = bcoo
+
+    # -- reference accessors -------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._b.shape)
+
+    def indices(self) -> Tensor:
+        return Tensor(jnp.swapaxes(self._b.indices, 0, 1),
+                      stop_gradient=True)  # [ndim, nnz] reference layout
+
+    def values(self) -> Tensor:
+        return Tensor(self._b.data, stop_gradient=True)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._b.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._b.todense(), stop_gradient=True)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._b.sum_duplicates())
+
+    def is_sparse(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """indices: [ndim, nnz] (reference layout); values: [nnz]."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                     else indices)
+    val = as_tensor(values)._data
+    if dtype is not None:
+        from ..core.dtype import dtype_from_any
+        val = val.astype(dtype_from_any(dtype).np_dtype)
+    if shape is None:
+        shape = tuple(int(i.max()) + 1 for i in idx)
+    b = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(b)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """CSR surface: converted to COO internally (BCOO is jax's native
+    format; the reference's CSR kernels are format-specific GPU code)."""
+    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return sparse_coo_tensor(np.stack([rows, cols]), values, shape, dtype)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+def to_dense(x):
+    return x.to_dense() if is_sparse(x) else as_tensor(x)
+
+
+def _binary(a, b, op):
+    ab = a._b.sum_duplicates() if is_sparse(a) else None
+    bb = b._b.sum_duplicates() if is_sparse(b) else None
+    if ab is not None and bb is not None:
+        dense = op(ab.todense(), bb.todense())
+        return SparseCooTensor(jsparse.BCOO.fromdense(dense))
+    raise TypeError("sparse binary ops need two SparseCooTensors")
+
+
+def add(a, b):
+    return _binary(a, b, jnp.add)
+
+
+def multiply(a, b):
+    return _binary(a, b, jnp.multiply)
+
+
+def matmul(a, b):
+    """sparse @ dense -> dense Tensor (the TPU-meaningful product);
+    gradient flows into the dense operand."""
+    if not is_sparse(a):
+        raise TypeError("first operand must be sparse")
+    dense = as_tensor(b)
+    bcoo = a._b
+    from ..autograd.function import apply
+    return apply(lambda d: bcoo @ d, dense, name="sparse_matmul")
+
+
+def relu(x):
+    if not is_sparse(x):
+        raise TypeError("sparse.relu expects a SparseCooTensor")
+    b = x._b
+    return SparseCooTensor(jsparse.BCOO((jnp.maximum(b.data, 0), b.indices),
+                                        shape=b.shape))

@@ -84,11 +84,17 @@ class Executor:
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kw):
-    """Reference static.save_inference_model -> jit.save."""
+    """Reference static.save_inference_model -> jit.save. The exported
+    StableHLO becomes default_main_program()'s text for inspection."""
     layer = kw.get("layer") or program
     if layer is None or not hasattr(layer, "state_dict"):
         raise TypeError("pass the Layer to serialize via program=<layer>")
     _jit_save(layer, path_prefix, input_spec=feed_vars)
+    try:
+        with open(path_prefix + ".pdmodel.txt") as f:
+            _MAIN._text = f.read()
+    except OSError:
+        pass
 
 
 def load_inference_model(path_prefix, executor=None, **kw):

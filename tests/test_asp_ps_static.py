@@ -175,3 +175,28 @@ def test_executor_feed_by_name(tmp_path):
                                np.asarray(ref.numpy()), rtol=1e-5)
     with pytest.raises(KeyError, match="missing"):
         exe.run(loaded, feed={"x": x})
+
+
+def test_asp_minimize_keeps_masks():
+    """decorate() must guard minimize() too (reference asp.py:919)."""
+    from paddle_tpu.incubate import asp
+    paddle.seed(54)
+    asp.reset_excluded_layers()
+    net = nn.Sequential(nn.Linear(8, 8))
+    asp.prune_model(net)
+    opt = asp.decorate(paddle.optimizer.SGD(0.1,
+                                            parameters=net.parameters()))
+    x = paddle.randn([4, 8])
+    loss = (net(x) ** 2).mean()
+    opt.minimize(loss)
+    assert asp.check_mask_1d(
+        np.asarray(net._sub_layers["0"].weight.numpy()), 2, 4)
+
+
+def test_static_main_program_text_updates(tmp_path):
+    import paddle_tpu.static as static
+    net = nn.Linear(4, 2)
+    static.save_inference_model(str(tmp_path / "p"),
+                                [static.InputSpec([2, 4])], None,
+                                program=net)
+    assert "module" in str(static.default_main_program())

@@ -1,0 +1,106 @@
+"""paddle.audio + paddle.sparse (reference: python/paddle/{audio,sparse})."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# -- audio --------------------------------------------------------------------
+
+def test_spectrogram_parseval_and_shape():
+    from paddle_tpu.audio import Spectrogram
+    sr = 8000
+    t = np.arange(sr, dtype=np.float32) / sr
+    # pure 440 Hz tone: spectrogram peak must land in the right bin
+    x = paddle.to_tensor(np.sin(2 * np.pi * 440 * t)[None, :])
+    spec = Spectrogram(n_fft=512, hop_length=256, power=2.0)(x)
+    arr = np.asarray(spec.numpy())
+    assert arr.shape[1] == 257  # n_fft//2 + 1 bins
+    peak_bin = arr.mean(axis=-1)[0].argmax()
+    freq = peak_bin * sr / 512
+    assert abs(freq - 440) < sr / 512 + 1  # within one bin
+
+
+def test_mel_and_mfcc_shapes():
+    from paddle_tpu.audio import MFCC, LogMelSpectrogram, MelSpectrogram
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((2, 4000)).astype(np.float32))
+    mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=40)(x)
+    assert np.asarray(mel.numpy()).shape[:2] == (2, 40)
+    logmel = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=40, top_db=80)(x)
+    lm = np.asarray(logmel.numpy())
+    assert lm.max() - lm.min() <= 80 + 1e-3
+    mfcc = MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=40)(x)
+    assert np.asarray(mfcc.numpy()).shape[:2] == (2, 13)
+
+
+def test_fbank_matrix_properties():
+    from paddle_tpu.audio import functional as AF
+    fb = AF.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=64)
+    assert fb.shape == (64, 257)
+    assert (fb >= 0).all()
+    # every filter has some support
+    assert (fb.sum(axis=1) > 0).all()
+    # hz<->mel roundtrip
+    f = np.array([100.0, 440.0, 4000.0])
+    np.testing.assert_allclose(AF.mel_to_hz(AF.hz_to_mel(f)), f, rtol=1e-6)
+
+
+def test_audio_features_gradable():
+    """Features compile into training graphs: grads flow to the waveform."""
+    from paddle_tpu.audio import MelSpectrogram
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((1, 1024)).astype(np.float32))
+    x.stop_gradient = False
+    out = MelSpectrogram(sr=8000, n_fft=256, n_mels=8)(x)
+    out.sum().backward()
+    assert x.grad is not None
+    assert np.abs(np.asarray(x.grad.numpy())).sum() > 0
+
+
+# -- sparse -------------------------------------------------------------------
+
+def test_sparse_coo_roundtrip():
+    import paddle_tpu.sparse as sparse
+    idx = np.array([[0, 1, 2], [1, 0, 2]])
+    vals = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    s = sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+    assert s.nnz == 3 and s.shape == [3, 3]
+    dense = np.asarray(s.to_dense().numpy())
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 0], expect[2, 2] = 1, 2, 3
+    np.testing.assert_allclose(dense, expect)
+    np.testing.assert_allclose(np.asarray(s.values().numpy()), vals)
+    assert np.asarray(s.indices().numpy()).shape == (2, 3)
+
+
+def test_sparse_csr_and_ops():
+    import paddle_tpu.sparse as sparse
+    # csr for the same matrix
+    s = sparse.sparse_csr_tensor([0, 1, 2, 3], [1, 0, 2],
+                                 np.array([1.0, 2.0, 3.0], np.float32),
+                                 shape=[3, 3])
+    d = np.asarray(s.to_dense().numpy())
+    assert d[0, 1] == 1 and d[1, 0] == 2 and d[2, 2] == 3
+
+    s2 = sparse.add(s, s)
+    np.testing.assert_allclose(np.asarray(s2.to_dense().numpy()), d * 2)
+    sneg = sparse.sparse_coo_tensor([[0], [0]],
+                                    np.array([-5.0], np.float32), [3, 3])
+    r = sparse.relu(sneg)
+    assert np.asarray(r.to_dense().numpy())[0, 0] == 0.0
+
+
+def test_sparse_dense_matmul_with_grad():
+    import paddle_tpu.sparse as sparse
+    idx = np.array([[0, 1], [1, 0]])
+    s = sparse.sparse_coo_tensor(idx, np.array([2.0, 3.0], np.float32),
+                                 shape=[2, 2])
+    x = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    x.stop_gradient = False
+    out = sparse.matmul(s, x)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               [[0, 2], [3, 0]])
+    out.sum().backward()
+    assert x.grad is not None  # grads flow into the dense operand
