@@ -42,3 +42,5 @@ from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 
 from . import rpc  # noqa: F401
 from . import passes  # noqa: F401
+from . import watchdog  # noqa: F401
+from .watchdog import StepWatchdog, StragglerDetector  # noqa: F401

@@ -1,0 +1,149 @@
+"""Step watchdog + straggler detection (reference analog: ProcessGroupNCCL's
+comm watchdog thread — abort/report when a collective hangs — and the
+fleet monitor's slow-rank detection).
+
+On TPU the failure mode is a wedged step (a hung host callback, a dead ICI
+link, an unresponsive runtime): collectives are compiled into the step, so
+the observable unit is step latency. `StepWatchdog` wraps the train step;
+a daemon thread fires `on_stall` once a step overruns its deadline (default:
+dump a diagnostic; optionally kill the process so the scheduler/elastic
+manager can relaunch). `StragglerDetector` keeps an EMA of step times and
+flags outliers — the single-controller version of slow-rank detection.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+import traceback
+
+__all__ = ["StepWatchdog", "StragglerDetector"]
+
+
+class StepWatchdog:
+    """Wraps a step callable; alarms when one call exceeds `timeout_s`.
+
+    on_stall(info) runs on the watchdog thread. With abort=True the process
+    receives SIGABRT after the alarm (the NCCL watchdog's contract: better a
+    loud corpse than a silent hang — elastic relaunches it).
+    """
+
+    def __init__(self, step_fn, timeout_s=300.0, on_stall=None, abort=False,
+                 poll_s=1.0):
+        self._fn = step_fn
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall or self._default_on_stall
+        self.abort = abort
+        self._poll_s = poll_s
+        self._lock = threading.Lock()
+        self._entered_at = None
+        self._step_idx = 0
+        self._stalled = False
+        self.stall_count = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _default_on_stall(info):
+        print(f"[watchdog] step {info['step']} stalled: "
+              f"{info['elapsed_s']:.1f}s > {info['timeout_s']:.1f}s limit")
+        for tid, frame in info.get("stacks", {}).items():
+            print(f"[watchdog] thread {tid}:\n{frame}")
+
+    def _watch(self):
+        import sys
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                entered, idx = self._entered_at, self._step_idx
+                already = self._stalled
+            if entered is None or already:
+                continue
+            elapsed = time.monotonic() - entered
+            if elapsed > self.timeout_s:
+                with self._lock:
+                    if self._step_idx != idx:
+                        continue  # that step finished; don't tag its successor
+                    self._stalled = True
+                    self.stall_count += 1
+                stacks = {tid: "".join(traceback.format_stack(frame))
+                          for tid, frame in sys._current_frames().items()}
+                self.on_stall({"step": idx, "elapsed_s": elapsed,
+                               "timeout_s": self.timeout_s,
+                               "stacks": stacks})
+                if self.abort:
+                    os.kill(os.getpid(), signal.SIGABRT)
+
+    def __call__(self, *args, **kwargs):
+        with self._lock:
+            self._entered_at = time.monotonic()
+            self._step_idx += 1
+            self._stalled = False
+        try:
+            return self._fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._entered_at = None
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+class StragglerDetector:
+    """EMA-based step-time outlier detection (reference analog: fleet's
+    slow-node monitor). record() each step duration; is_straggler says
+    whether the last step exceeded ratio * EMA."""
+
+    def __init__(self, ratio=2.0, momentum=0.9, warmup_steps=5,
+                 rebaseline_after=10, max_flagged=1000):
+        self.ratio = ratio
+        self.momentum = momentum
+        self.warmup_steps = warmup_steps
+        self.rebaseline_after = rebaseline_after
+        self.max_flagged = max_flagged
+        self._ema = None
+        self._n = 0
+        self._consecutive = 0
+        self.flagged: list[tuple[int, float]] = []
+
+    def record(self, duration_s: float) -> bool:
+        """Returns True if this step is a straggler."""
+        self._n += 1
+        if self._n <= self.warmup_steps:
+            # warmup (jit compiles, cache warms) never seeds the baseline —
+            # the first TPU step can be 100x steady state
+            return False
+        if self._ema is None:
+            self._ema = duration_s
+            return False
+        is_slow = duration_s > self.ratio * self._ema
+        if is_slow:
+            self._consecutive += 1
+            if len(self.flagged) < self.max_flagged:
+                self.flagged.append((self._n, duration_s))
+            if self._consecutive >= self.rebaseline_after:
+                # sustained slowdown is a regime change, not straggling:
+                # adopt the new level instead of alarming forever
+                self._ema = duration_s
+                self._consecutive = 0
+        else:
+            self._consecutive = 0
+            self._ema = (self.momentum * self._ema
+                         + (1 - self.momentum) * duration_s)
+        return is_slow
+
+    @property
+    def ema_s(self):
+        return self._ema
+
+    def timed(self, step_fn):
+        """Wrap a step callable: record every call's duration."""
+        def run(*a, **kw):
+            t0 = time.monotonic()
+            out = step_fn(*a, **kw)
+            self.record(time.monotonic() - t0)
+            return out
+        return run

@@ -1,0 +1,98 @@
+"""API-surface registry (the SURVEY L9 'YAML op registry + codegen' slot,
+reference: paddle/phi/ops/yaml/*.yaml + generated python APIs).
+
+The reference generates its Python surface from YAML op definitions; here
+the ops are hand-written jnp compositions, so the registry runs the other
+direction: INTROSPECT the live surface into a manifest (one record per
+public op/layer/functional with its signature), which serves the same two
+purposes the YAML file served —
+  1. a single queryable source of truth (`api_surface()`, `lookup()`),
+  2. a CI contract: `tools/check_api_surface.py` diffs the live surface
+     against the committed manifest so accidental op removals or signature
+     breaks fail the build (the codegen-regeneration check's analog).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import json
+from dataclasses import asdict, dataclass
+
+
+@dataclass(frozen=True)
+class ApiRecord:
+    name: str          # dotted public path, e.g. "paddle.matmul"
+    kind: str          # "op" | "layer" | "functional"
+    signature: str
+
+    def key(self):
+        return self.name
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _collect(module, prefix, kind, records, predicate):
+    names = getattr(module, "__all__", None) or [
+        n for n in dir(module) if not n.startswith("_")]
+    for n in sorted(set(names)):
+        obj = getattr(module, n, None)
+        if obj is None or not predicate(obj):
+            continue
+        records.append(ApiRecord(f"{prefix}.{n}", kind, _sig(obj)))
+
+
+@functools.lru_cache(maxsize=1)
+def _surface_cached() -> tuple:
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    records: list[ApiRecord] = []
+    # names are prefix-qualified per module, so no cross-module collisions
+    _collect(paddle, "paddle", "op", records,
+             lambda o: inspect.isfunction(o))
+    _collect(F, "paddle.nn.functional", "functional", records,
+             lambda o: inspect.isfunction(o))
+    _collect(nn, "paddle.nn", "layer", records,
+             lambda o: inspect.isclass(o))
+    return tuple(sorted(records, key=lambda r: r.name))
+
+
+def api_surface() -> list[ApiRecord]:
+    """Every public op, nn.functional, and nn layer with its signature
+    (introspected once per process; lru-cached)."""
+    return list(_surface_cached())
+
+
+def lookup(name: str):
+    for r in api_surface():
+        if r.name == name or r.name.endswith("." + name):
+            return r
+    return None
+
+
+def save_manifest(path: str):
+    records = api_surface()
+    with open(path, "w") as f:
+        json.dump([asdict(r) for r in records], f, indent=0, sort_keys=True)
+    return len(records)
+
+
+def check_manifest(path: str):
+    """(missing, signature_changed, added) vs the committed manifest.
+    Missing/changed entries are API breaks; added entries are fine (the
+    checker only asks for a manifest refresh)."""
+    with open(path) as f:
+        want = {r["name"]: r for r in json.load(f)}
+    have = {r.name: r for r in api_surface()}
+    missing = sorted(set(want) - set(have))
+    added = sorted(set(have) - set(want))
+    changed = sorted(n for n in set(want) & set(have)
+                     if want[n]["signature"] != have[n].signature)
+    return missing, changed, added

@@ -152,3 +152,101 @@ def test_packaging_metadata():
     assert os.path.exists(os.path.join(root, "pyproject.toml"))
     txt = open(os.path.join(root, "pyproject.toml")).read()
     assert "paddle-tpu" in txt and "jax" in txt
+
+
+# -- watchdog / straggler detection -------------------------------------------
+
+def test_step_watchdog_fires_on_stall():
+    import time
+    from paddle_tpu.distributed import StepWatchdog
+    events = []
+
+    def slow_step(x):
+        time.sleep(0.5)
+        return x + 1
+
+    wd = StepWatchdog(slow_step, timeout_s=0.15, poll_s=0.05,
+                      on_stall=events.append)
+    try:
+        assert wd(1) == 2          # completes, but overran the deadline
+        assert wd.stall_count == 1
+        assert events and events[0]["step"] == 1
+        assert events[0]["elapsed_s"] > 0.15
+        assert events[0]["stacks"]  # diagnostic stacks captured
+    finally:
+        wd.close()
+
+
+def test_step_watchdog_quiet_on_fast_steps():
+    from paddle_tpu.distributed import StepWatchdog
+    events = []
+    wd = StepWatchdog(lambda x: x, timeout_s=5.0, poll_s=0.05,
+                      on_stall=events.append)
+    try:
+        for i in range(10):
+            wd(i)
+        assert wd.stall_count == 0 and not events
+    finally:
+        wd.close()
+
+
+def test_straggler_detector():
+    from paddle_tpu.distributed import StragglerDetector
+    det = StragglerDetector(ratio=2.0, warmup_steps=3)
+    for _ in range(10):
+        assert not det.record(0.1)
+    assert det.record(0.5)          # 5x the EMA -> straggler
+    assert det.flagged and det.flagged[0][1] == 0.5
+    # baseline unpoisoned by the outlier
+    assert abs(det.ema_s - 0.1) < 0.01
+    assert not det.record(0.11)
+
+
+# -- api surface registry ------------------------------------------------------
+
+def test_api_registry_surface_and_manifest(tmp_path):
+    from paddle_tpu.ops.registry import (api_surface, check_manifest, lookup,
+                                         save_manifest)
+    surface = api_surface()
+    assert len(surface) > 400  # ops + functionals + layers
+    names = {r.name for r in surface}
+    assert "paddle.matmul" in names
+    assert "paddle.nn.functional.scaled_dot_product_attention" in names
+    assert "paddle.nn.Linear" in names
+    rec = lookup("matmul")
+    assert rec is not None and rec.kind == "op"
+
+    path = str(tmp_path / "manifest.json")
+    save_manifest(path)
+    missing, changed, added = check_manifest(path)
+    assert not missing and not changed and not added
+
+
+def test_api_manifest_committed_and_current():
+    """The committed manifest must match the live surface (removals or
+    signature changes fail the gate; additions only warn)."""
+    import os
+    from paddle_tpu.ops.registry import check_manifest
+    manifest = os.path.join(os.path.dirname(__file__), "..",
+                            "api_manifest.json")
+    assert os.path.exists(manifest)
+    missing, changed, _ = check_manifest(manifest)
+    assert not missing, f"APIs removed without manifest update: {missing}"
+    assert not changed, f"signatures changed without manifest update: {changed}"
+
+
+def test_straggler_warmup_and_regime_change():
+    from paddle_tpu.distributed import StragglerDetector
+    det = StragglerDetector(ratio=2.0, warmup_steps=3, rebaseline_after=4)
+    # compile-heavy first steps never seed the baseline
+    det.record(10.0)
+    det.record(9.0)
+    det.record(8.0)
+    for _ in range(5):
+        assert not det.record(0.1)
+    assert abs(det.ema_s - 0.1) < 0.02
+    # sustained slowdown re-baselines instead of alarming forever
+    flags = [det.record(0.3) for _ in range(8)]
+    assert flags[0] is True            # initially flagged
+    assert flags[-1] is False          # adopted as the new regime
+    assert abs(det.ema_s - 0.3) < 0.05
