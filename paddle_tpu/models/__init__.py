@@ -8,3 +8,4 @@ from .ernie import (Ernie, ErnieConfig, ernie_tiny,  # noqa: F401
                     ernie_for_pipeline, ErniePretrainLoss)
 from .dit import (DiT, DiTConfig, DiTPipeline, dit_tiny, dit_s_2,  # noqa: F401
                   dit_xl_2)
+from .generation import GenerationMixin, generate  # noqa: F401

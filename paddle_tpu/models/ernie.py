@@ -23,6 +23,7 @@ from .llama import LlamaConfig, LlamaDecoderLayer, _rope_tables
 from .gpt_hybrid import GPTPretrainLoss as ErniePretrainLoss
 from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeDecoderLayer
 
+from .generation import GenerationMixin
 __all__ = ["ErnieConfig", "Ernie", "ernie_tiny", "ernie_for_pipeline",
            "ErniePretrainLoss"]
 
@@ -82,7 +83,7 @@ class ErnieConfig:
             initializer_range=self.initializer_range)
 
 
-class Ernie(nn.Layer):
+class Ernie(GenerationMixin, nn.Layer):
     """Dense-leading decoder; MoE tail when num_experts > 0."""
 
     def __init__(self, cfg: ErnieConfig):

@@ -1,0 +1,129 @@
+"""Text generation (reference shape: PaddleNLP generation_utils — greedy /
+sampling decode driving the reference models; the deploy analog of the
+training forward).
+
+TPU design: ONE compiled program serves the whole decode for dense models.
+The token buffer is padded to its final length up front (prompt +
+max_new_tokens); causal attention guarantees positions past the current
+length cannot influence the position being read, so the step function
+(buffer, pos) -> next-token logits has fully static shapes. The compiled
+step is cached on the model keyed by (batch, total), so repeated generate()
+calls reuse it.
+
+MoE models are the exception: capacity routing is NOT causal — padding
+tokens compete for expert capacity and can evict real tokens of other batch
+rows — so models containing a MoELayer decode with exact-length slices
+(one compile per emitted length; correct by construction).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+__all__ = ["GenerationMixin", "generate"]
+
+_seed_counter = itertools.count(1)
+
+
+def _contains_moe(model) -> bool:
+    from ..incubate.distributed.models.moe import MoELayer
+    return any(isinstance(sub, MoELayer)
+               for _, sub in model.named_sublayers(include_self=True))
+
+
+def _gen_step(model, kind):
+    """Compiled (buffer, pos) -> [B, V] last-token logits, cached on the
+    model so repeated generate() calls skip retrace/recompile."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    cache = getattr(model, "_gen_step_cache", None)
+    if cache is None:
+        cache = model._gen_step_cache = {}
+    if kind in cache:
+        return cache[kind]
+
+    @paddle.jit.to_static
+    def next_logits(buffer, pos):
+        with paddle.no_grad():
+            logits = model(buffer)
+        from ..autograd.function import apply
+        return apply(
+            lambda lg, p: jnp.take_along_axis(
+                lg, p.reshape(-1, 1, 1).astype(jnp.int32), axis=1)[:, 0, :],
+            logits, pos, name="gather_last_logits")
+
+    cache[kind] = next_logits
+    return next_logits
+
+
+def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
+             top_k=None, do_sample=False, eos_token_id=None, seed=None):
+    """input_ids: [B, S] prompt Tensor/ndarray. Returns [B, S+max_new]
+    int64 ndarray (generation stops early per-row on eos but the buffer
+    keeps its static shape, eos-padded)."""
+    import jax
+    import paddle_tpu as paddle
+    from ..core.tensor import Tensor
+
+    ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
+                     else input_ids).astype(np.int64)
+    b, s = ids.shape
+    total = s + max_new_tokens
+    max_pos = getattr(model.cfg, "max_position_embeddings", total)
+    if total > max_pos:
+        raise ValueError(f"prompt {s} + max_new_tokens {max_new_tokens} "
+                         f"exceeds max_position_embeddings {max_pos}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    buf = np.zeros((b, total), dtype=np.int64)
+    buf[:, :s] = ids
+
+    exact_slices = _contains_moe(model)
+    step_fn = _gen_step(model, "decode")
+
+    was_training = getattr(model, "training", False)
+    model.eval()
+    # seed=None still avoids wall-clock entropy (TPU-reproducible runs):
+    # a process-level counter makes unseeded calls differ from each other
+    key = jax.random.PRNGKey(seed if seed is not None
+                             else next(_seed_counter))
+    finished = np.zeros(b, dtype=bool)
+    try:
+        for i in range(s, total):
+            feed = buf[:, :i] if exact_slices else buf
+            pos = paddle.to_tensor(np.full((b,), i - 1, dtype=np.int64))
+            lg = step_fn(paddle.to_tensor(feed), pos)
+            arr = np.asarray(lg.numpy()).astype(np.float64)  # [B, V]
+            if do_sample:
+                arr = arr / max(temperature, 1e-6)
+                if top_k is not None and top_k < arr.shape[-1]:
+                    kth = np.sort(arr, axis=-1)[:, -top_k][:, None]
+                    arr = np.where(arr < kth, -np.inf, arr)
+                key, sub = jax.random.split(key)
+                gumbel = np.asarray(jax.random.gumbel(sub, arr.shape))
+                nxt = (arr + gumbel).argmax(-1)
+            else:
+                nxt = arr.argmax(-1)
+            if eos_token_id is not None:
+                nxt = np.where(finished, eos_token_id, nxt)
+                finished |= nxt == eos_token_id
+            buf[:, i] = nxt
+            if eos_token_id is not None and finished.all():
+                buf[:, i + 1:] = eos_token_id
+                break
+    finally:
+        if was_training:
+            model.train()
+    return buf
+
+
+class GenerationMixin:
+    """Adds .generate() to a causal LM whose forward(input_ids) -> logits."""
+
+    def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
+                 top_k=None, do_sample=False, eos_token_id=None, seed=None):
+        return generate(self, input_ids, max_new_tokens, temperature, top_k,
+                        do_sample, eos_token_id, seed)

@@ -17,6 +17,7 @@ import paddle_tpu as paddle
 from .. import nn
 from ..nn import functional as F
 
+from .generation import GenerationMixin
 __all__ = ["GPTConfig", "GPT", "gpt2_small", "gpt2_tiny"]
 
 
@@ -94,7 +95,7 @@ class Block(nn.Layer):
         return x
 
 
-class GPT(nn.Layer):
+class GPT(GenerationMixin, nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg

@@ -22,6 +22,7 @@ from ..distributed.meta_parallel import (
     LayerDesc, SharedLayerDesc, PipelineLayer,
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
 
+from .generation import GenerationMixin
 __all__ = ["LlamaConfig", "Llama", "llama_tiny", "llama3_8b",
            "llama_for_pipeline"]
 
@@ -170,7 +171,7 @@ class LlamaDecoderLayer(nn.Layer):
         return h + self.mlp(y)
 
 
-class Llama(nn.Layer):
+class Llama(GenerationMixin, nn.Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg

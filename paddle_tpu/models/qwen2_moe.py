@@ -23,6 +23,7 @@ from ..nn import functional as F
 from ..incubate.distributed.models.moe import MoELayer
 from .llama import LlamaConfig, LlamaDecoderLayer, _rope_tables
 
+from .generation import GenerationMixin
 __all__ = ["Qwen2MoeConfig", "Qwen2Moe", "qwen2_moe_tiny", "deepseek_moe"]
 
 
@@ -111,7 +112,7 @@ class Qwen2MoeDecoderLayer(LlamaDecoderLayer):
         return None if self.is_dense else self.mlp.l_aux
 
 
-class Qwen2Moe(nn.Layer):
+class Qwen2Moe(GenerationMixin, nn.Layer):
     def __init__(self, cfg: Qwen2MoeConfig):
         super().__init__()
         self.cfg = cfg
