@@ -63,7 +63,7 @@ from . import autograd  # noqa: F401
 # -- ops (flat paddle.* namespace) ----------------------------------------
 from .ops import *  # noqa: F401,F403
 from . import ops  # noqa: F401
-from .ops import linalg  # noqa: F401
+from . import linalg  # noqa: F401
 
 # -- framework -------------------------------------------------------------
 from .framework.io import save, load  # noqa: F401
@@ -73,6 +73,7 @@ from .framework.framework import (  # noqa: F401
     in_dynamic_mode, device_count,
 )
 from .framework.parameter import create_parameter  # noqa: F401
+from .batch import batch  # noqa: F401
 
 # -- subpackages (paddle.nn, paddle.optimizer, ...) ------------------------
 from . import nn  # noqa: F401
@@ -110,7 +111,9 @@ def __getattr__(name):
         globals()["incubate"] = mod
         return mod
     if name in ("distribution", "text", "quantization", "static",
-                "auto_tuner", "audio", "sparse"):
+                "auto_tuner", "audio", "sparse", "fft", "signal",
+                "sysconfig", "hub", "dataset", "geometric", "inference",
+                "onnx"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

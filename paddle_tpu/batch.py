@@ -1,0 +1,27 @@
+"""`paddle.batch` (reference: python/paddle/batch.py) — wrap an item reader
+into a minibatch reader."""
+
+from __future__ import annotations
+
+__all__ = []
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Turn ``reader`` (a no-arg callable yielding items) into a callable
+    yielding lists of ``batch_size`` items; the short tail batch is kept
+    unless ``drop_last``."""
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,13 @@
+"""`paddle.dataset` (reference: python/paddle/dataset/) — legacy
+reader-factory datasets. Readers are no-arg callables yielding samples,
+composable with `paddle.batch`. In the zero-egress TPU environment the
+download step only serves files already present in the cache
+(`common.DATA_HOME`)."""
+
+from __future__ import annotations
+
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import uci_housing  # noqa: F401
+
+__all__ = ['common', 'mnist', 'uci_housing']

@@ -1,0 +1,60 @@
+"""Shared dataset plumbing (reference: python/paddle/dataset/common.py —
+DATA_HOME, md5file, download-with-cache)."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ['DATA_HOME', 'md5file', 'download', 'split']
+
+DATA_HOME = os.environ.get(
+    'PADDLE_TPU_DATA_HOME',
+    os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu', 'dataset'))
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, 'rb') as f:
+        for chunk in iter(lambda: f.read(4096), b''):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str | None = None,
+             save_name: str | None = None) -> str:
+    """Return the cached file for ``url``; no-egress environment, so a cache
+    miss is an error telling the user where to place the file."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name or url.split('/')[-1])
+    if os.path.exists(filename):
+        if md5sum and md5file(filename) != md5sum:
+            raise IOError(
+                f"cached file {filename} fails md5 check; delete and re-fetch")
+        return filename
+    raise RuntimeError(
+        f"dataset file not cached and this environment has no network "
+        f"egress; place the file from {url} at {filename}")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split samples from ``reader`` into pickled chunk files of
+    ``line_count`` samples each."""
+    import pickle
+    dumper = dumper or (lambda obj, f: pickle.dump(obj, f, protocol=4))
+    buf, index, files = [], 0, []
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) == line_count:
+            fname = suffix % index
+            with open(fname, 'wb') as f:
+                dumper(buf, f)
+            files.append(fname)
+            buf, index = [], index + 1
+    if buf:
+        fname = suffix % index
+        with open(fname, 'wb') as f:
+            dumper(buf, f)
+        files.append(fname)
+    return files

@@ -1,0 +1,47 @@
+"""MNIST reader factories (reference: python/paddle/dataset/mnist.py).
+Parses the idx-format files already in the cache (or at explicit paths) via
+paddle_tpu.vision.datasets.MNIST."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ['train', 'test']
+
+_DIR = os.path.join(DATA_HOME, 'mnist')
+_FILES = {
+    'train': ('train-images-idx3-ubyte.gz', 'train-labels-idx1-ubyte.gz'),
+    'test': ('t10k-images-idx3-ubyte.gz', 't10k-labels-idx1-ubyte.gz'),
+}
+
+
+def _reader(mode, image_path=None, label_path=None):
+    from ..vision.datasets import MNIST
+
+    imgs, lbls = _FILES[mode]
+    image_path = image_path or os.path.join(_DIR, imgs)
+    label_path = label_path or os.path.join(_DIR, lbls)
+    if not (os.path.exists(image_path) and os.path.exists(label_path)):
+        raise RuntimeError(
+            f"MNIST files not cached (no network egress); place "
+            f"{imgs}/{lbls} under {_DIR} or pass explicit paths")
+    ds = MNIST(image_path=image_path, label_path=label_path, mode=mode)
+
+    def reader():
+        for i in range(len(ds)):
+            img, lbl = ds[i]
+            yield np.asarray(img).reshape(-1).astype('float32') / 255.0 * 2 - 1, int(lbl)
+
+    return reader
+
+
+def train(image_path=None, label_path=None):
+    return _reader('train', image_path, label_path)
+
+
+def test(image_path=None, label_path=None):
+    return _reader('test', image_path, label_path)

@@ -1,0 +1,54 @@
+"""UCI housing reader factories (reference:
+python/paddle/dataset/uci_housing.py). Feature-normalized rows of the Boston
+housing data; reads the cached `housing.data` (whitespace-separated, 14 cols)
+or an explicit path."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ['feature_names', 'train', 'test']
+
+feature_names = [
+    'CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS', 'RAD', 'TAX',
+    'PTRATIO', 'B', 'LSTAT',
+]
+
+_PATH = os.path.join(DATA_HOME, 'uci_housing', 'housing.data')
+
+
+def _load(path):
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"housing.data not cached (no network egress); place it at {path}")
+    data = np.loadtxt(path, dtype='float32')
+    if data.ndim != 2 or data.shape[1] != 14:
+        raise ValueError(f"expected Nx14 housing data, got {data.shape}")
+    feats, target = data[:, :-1], data[:, -1:]
+    lo, hi, mean = feats.min(0), feats.max(0), feats.mean(0)
+    feats = (feats - mean) / np.where(hi > lo, hi - lo, 1.0)
+    return np.concatenate([feats, target], axis=1)
+
+
+def _reader(path, lo_frac, hi_frac):
+    data = _load(path or _PATH)
+    n = data.shape[0]
+    rows = data[int(n * lo_frac):int(n * hi_frac)]
+
+    def reader():
+        for row in rows:
+            yield row[:-1], row[-1:]
+
+    return reader
+
+
+def train(path=None):
+    return _reader(path, 0.0, 0.8)
+
+
+def test(path=None):
+    return _reader(path, 0.8, 1.0)

@@ -124,10 +124,40 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy=None):
     """Wrap with hybrid-parallel semantics (reference: fleet/optimizer.py:68 →
-    HybridParallelOptimizer)."""
+    HybridParallelOptimizer). Strategy flags select meta-optimizer wrappers
+    first (reference meta_optimizers/ rewrites; here dygraph wrappers)."""
     hcg = get_hybrid_communicate_group()
+    strat = strategy or _strategy
+    if strat is not None:
+        from . import meta_optimizers as mo
+        if getattr(strat, "lars", False):
+            # reference lars meta-optimizer swaps Momentum -> LarsMomentum;
+            # rebuild the inner optimizer as Lars with the same hyperparams
+            cfg = strat.lars_configs
+            optimizer = mo.Lars(
+                learning_rate=optimizer.get_lr(),
+                momentum=getattr(optimizer, "_momentum", 0.9),
+                lars_coeff=cfg.lars_coeff,
+                lars_weight_decay=cfg.lars_weight_decay,
+                epsilon=cfg.epsilon,
+                exclude_from_weight_decay=cfg.exclude_from_weight_decay,
+                parameters=optimizer._parameter_list,
+                grad_clip=getattr(optimizer, "_grad_clip", None))
+        if getattr(strat, "dgc", False):
+            cfg = strat.dgc_configs
+            optimizer = mo.DGCMomentumOptimizer(
+                optimizer, rampup_begin_step=cfg.rampup_begin_step,
+                rampup_step=cfg.rampup_step, sparsity=cfg.sparsity)
+        if getattr(strat, "localsgd", False):
+            cfg = strat.localsgd_configs
+            optimizer = mo.LocalSGDOptimizer(
+                optimizer, k_steps=cfg.k_steps, begin_step=cfg.begin_step)
+        if getattr(strat, "gradient_merge", False):
+            cfg = strat.gradient_merge_configs
+            optimizer = mo.GradientMergeOptimizer(
+                optimizer, k_steps=cfg.k_steps, avg=cfg.avg)
     from ..meta_parallel.hybrid_parallel_optimizer import HybridParallelOptimizer
-    return HybridParallelOptimizer(optimizer, hcg, strategy or _strategy)
+    return HybridParallelOptimizer(optimizer, hcg, strat)
 
 
 class _FleetNamespace:

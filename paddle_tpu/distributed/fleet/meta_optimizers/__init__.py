@@ -1,0 +1,198 @@
+"""`paddle.distributed.fleet.meta_optimizers` — optimizer-level distributed
+strategies (reference: python/paddle/distributed/fleet/meta_optimizers/,
+21 graph-rewriting files: lars/lamb/dgc/localsgd/gradient-merge/...).
+
+The reference implements these as static-graph rewrites; in the TPU build
+they are dygraph optimizer wrappers whose math runs inside the jitted train
+step, with comm expressed through the collective layer (XLA inserts the
+actual ICI/DCN transfers). Strategy flags in `DistributedStrategy`
+(strategy.py: lars/lamb/dgc/localsgd/gradient_merge) select them through
+`fleet.distributed_optimizer`."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ....optimizer.optimizer import Optimizer
+
+__all__ = ['Lars', 'LarsMomentumOptimizer', 'LocalSGDOptimizer',
+           'DGCMomentumOptimizer', 'GradientMergeOptimizer']
+
+
+class Lars(Optimizer):
+    """LARS momentum (reference meta_optimizers/lars_optimizer.py over the
+    lars_momentum kernel): layer-wise trust ratio
+    ||w|| / (||g|| + wd*||w||) scales the learning rate per parameter."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay=None,
+                 epsilon=1e-9, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._exclude = tuple(exclude_from_weight_decay or ())
+        self._epsilon = epsilon
+
+    def _append_optimize_op(self, p, grad):
+        g = grad._data.astype(jnp.float32)
+        w = p._data.astype(jnp.float32)
+        v = self._add_accumulator("velocity", p, dtype=jnp.float32)
+        wd = self._lars_wd
+        if any(tag in (p.name or "") for tag in self._exclude):
+            wd = 0.0
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + wd * w_norm + self._epsilon),
+            1.0)
+        lr = self._lr(p) * local_lr
+        v._data = self._momentum * v._data + lr * (g + wd * w)
+        p._data = (w - v._data).astype(p._data.dtype)
+
+
+LarsMomentumOptimizer = Lars
+
+
+class _WrapperBase:
+    """Delegating base: exposes the inner Optimizer surface."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def clear_grad(self, *a, **kw):
+        self._inner.clear_grad(*a, **kw)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        self._inner.set_state_dict(sd)
+
+
+class LocalSGDOptimizer(_WrapperBase):
+    """Local SGD (reference meta_optimizers/localsgd_optimizer.py): each dp
+    rank steps locally; every `k_steps` the params are averaged across the
+    dp group — one fused all-reduce instead of per-step grad sync."""
+
+    def __init__(self, optimizer, k_steps=1, begin_step=1):
+        super().__init__(optimizer)
+        self._k_steps = max(1, int(k_steps))
+        self._begin = begin_step
+        self._local_step = 0
+
+    def step(self):
+        self._inner.step()
+        self._local_step += 1
+        if (self._local_step >= self._begin
+                and self._local_step % self._k_steps == 0):
+            self._average_params()
+
+    def _average_params(self):
+        from ... import communication as dist
+
+        group = None
+        try:
+            from ...topology import get_hybrid_communicate_group
+            hcg = get_hybrid_communicate_group()
+            if hcg is not None:
+                group = hcg.get_data_parallel_group()
+        except Exception:
+            pass
+        n = getattr(group, "nranks", 1) if group is not None else 1
+        for p in self._inner._parameter_list:
+            t = Tensor(p._data)
+            dist.all_reduce(t, group=group)
+            p._data = (t._data / n).astype(p._data.dtype)
+
+
+class DGCMomentumOptimizer(_WrapperBase):
+    """Deep Gradient Compression (reference meta_optimizers/dgc_optimizer.py
+    over the dgc kernels): momentum correction + error feedback + top-k
+    gradient sparsification before the dp all-reduce. The sparsified tensor
+    stays dense-shaped (zeros elsewhere) — on TPU a dense all-reduce of a
+    mostly-zero tensor is what XLA would run anyway, so the win kept here is
+    the *algorithmic* one (momentum correction, delayed small updates)."""
+
+    def __init__(self, optimizer, momentum=0.9, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,)):
+        super().__init__(optimizer)
+        self._momentum = momentum
+        self._begin = rampup_begin_step
+        self._sparsity = list(sparsity)
+        self._step_n = 0
+        self._u = {}  # momentum buffer
+        self._e = {}  # error feedback
+
+    def _current_sparsity(self):
+        i = min(len(self._sparsity) - 1,
+                max(0, self._step_n - self._begin))
+        return self._sparsity[i]
+
+    def step(self):
+        self._step_n += 1
+        if self._step_n <= self._begin:
+            self._inner.step()
+            return
+        s = self._current_sparsity()
+        for p in self._inner._parameter_list:
+            if p.stop_gradient or p._grad is None:
+                continue
+            g = p._grad._data
+            key = id(p)
+            u = self._u.get(key, jnp.zeros_like(g))
+            e = self._e.get(key, jnp.zeros_like(g))
+            u = self._momentum * u + g           # momentum correction
+            acc = e + u                           # error feedback
+            flat = jnp.abs(acc).reshape(-1)
+            k = max(1, int(flat.shape[0] * (1.0 - s)))
+            thresh = jnp.sort(flat)[-k]
+            mask = (jnp.abs(acc) >= thresh).astype(g.dtype)
+            send = acc * mask
+            self._e[key] = acc * (1 - mask)
+            self._u[key] = u * (1 - mask)
+            p._grad._data = send                  # dp sync happens on this
+        self._inner.step()
+
+
+class GradientMergeOptimizer(_WrapperBase):
+    """Gradient merge / micro-batch accumulation (reference
+    meta_optimizers/gradient_merge_optimizer.py): accumulate `k_steps` of
+    gradients, apply once."""
+
+    def __init__(self, optimizer, k_steps=1, avg=True):
+        super().__init__(optimizer)
+        self._k_steps = max(1, int(k_steps))
+        self._avg = avg
+        self._acc = {}
+        self._n = 0
+
+    def step(self):
+        self._n += 1
+        for p in self._inner._parameter_list:
+            if p.stop_gradient or p._grad is None:
+                continue
+            key = id(p)
+            self._acc[key] = self._acc.get(key, 0) + p._grad._data
+        if self._n % self._k_steps != 0:
+            self._inner.clear_grad()
+            return
+        for p in self._inner._parameter_list:
+            key = id(p)
+            if key not in self._acc:
+                continue
+            g = self._acc[key]
+            if self._avg:
+                g = g / self._k_steps
+            p._grad._data = g
+        self._acc = {}
+        self._inner.step()

@@ -77,6 +77,27 @@ class GradientMergeConfig:
 
 
 @dataclass
+class LarsConfig:
+    lars_coeff: float = 0.001
+    lars_weight_decay: float = 0.0005
+    epsilon: float = 1e-9
+    exclude_from_weight_decay: list = field(default_factory=list)
+
+
+@dataclass
+class DGCConfig:
+    rampup_begin_step: int = 0
+    rampup_step: int = 1
+    sparsity: list = field(default_factory=lambda: [0.999])
+
+
+@dataclass
+class LocalSGDConfig:
+    k_steps: int = 1
+    begin_step: int = 1
+
+
+@dataclass
 class MoEConfig:
     expert_parallel_degree: int = 1
     top_k: int = 2
@@ -94,10 +115,16 @@ class DistributedStrategy:
         self.tensor_parallel_configs = TensorParallelConfig()
         self.gradient_merge_configs = GradientMergeConfig()
         self.moe_configs = MoEConfig()
+        self.lars_configs = LarsConfig()
+        self.dgc_configs = DGCConfig()
+        self.localsgd_configs = LocalSGDConfig()
         self.amp = False
         self.recompute = False
         self.sharding = False
         self.gradient_merge = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32

@@ -1,0 +1,16 @@
+"""`paddle.distributed.fleet.utils` (reference:
+python/paddle/distributed/fleet/utils/__init__.py — recompute entry, fs,
+log_util; tensor fusion is subsumed by XLA's comm bucketing)."""
+
+from __future__ import annotations
+
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+from . import fs  # noqa: F401
+from . import log_util  # noqa: F401
+from . import timer_helper  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401
+from .log_util import logger, set_log_level  # noqa: F401
+from .timer_helper import get_timers, set_timers  # noqa: F401
+
+__all__ = ['LocalFS', 'HDFSClient', 'recompute', 'recompute_sequential',
+           'logger', 'set_log_level', 'get_timers', 'set_timers']

@@ -1,0 +1,207 @@
+"""Filesystem abstraction (reference: python/paddle/distributed/fleet/utils/
+fs.py — `FS` base, `LocalFS`, `HDFSClient`). Checkpoints and PS tables go
+through this indirection so HDFS/AFS-backed storage is swappable; on TPU pods
+the same role is filled by GCS/NFS mounts, which look like local paths, so
+`LocalFS` is the complete implementation and `HDFSClient` shells out to a
+hadoop binary when one exists."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ['LocalFS', 'HDFSClient']
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local (or mounted GCS/NFS) filesystem."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for e in os.listdir(fs_path):
+            (dirs if os.path.isdir(os.path.join(fs_path, e)) else files).append(e)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if self.is_file(fs_path):
+            os.remove(fs_path)
+        elif self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, 'a'):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if self.is_exist(dst_path) and not overwrite:
+            raise FSFileExistsError(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient(FS):
+    """Shells out to `hadoop fs` (reference HDFSClient does the same via its
+    configured hadoop bin). Raises a clear error when no hadoop binary is
+    available — on TPU deployments object storage is mounted, not HDFS."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        self._hadoop = (os.path.join(hadoop_home, 'bin', 'hadoop')
+                        if hadoop_home else shutil.which('hadoop'))
+        self._configs = configs or {}
+
+    def _run(self, *args):
+        if not self._hadoop or not os.path.exists(self._hadoop):
+            raise ExecuteError(
+                "no hadoop binary found; HDFSClient requires a Hadoop "
+                "installation (pass hadoop_home=). On TPU pods prefer "
+                "LocalFS over a mounted GCS/NFS path.")
+        cfg = sum((['-D', f'{k}={v}'] for k, v in self._configs.items()), [])
+        cmd = [self._hadoop, 'fs'] + cfg + [str(a) for a in args]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise ExecuteError(f"{' '.join(cmd)}: {proc.stderr}")
+        return proc.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run('-ls', fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith('d') else files).append(name)
+        return dirs, files
+
+    def is_exist(self, fs_path):
+        try:
+            self._run('-test', '-e', fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        try:
+            self._run('-test', '-f', fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run('-test', '-d', fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def mkdirs(self, fs_path):
+        self._run('-mkdir', '-p', fs_path)
+
+    def delete(self, fs_path):
+        self._run('-rm', '-r', '-skipTrash', fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run('-put', local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run('-get', fs_path, local_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        self._run('-mv', fs_src_path, fs_dst_path)
+
+    def need_upload_download(self):
+        return True
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        self._run('-touchz', fs_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]

@@ -1,0 +1,37 @@
+"""Fleet logger (reference:
+python/paddle/distributed/fleet/utils/log_util.py — `logger` with
+rank-prefixed formatting, `set_log_level`)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+__all__ = ['logger', 'set_log_level', 'layer_to_str']
+
+logger = logging.getLogger('paddle_tpu.fleet')
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _rank = os.environ.get('PADDLE_TRAINER_ID', '0')
+    _h.setFormatter(logging.Formatter(
+        f'[%(asctime)s] [rank {_rank}] [%(levelname)s] %(message)s'))
+    logger.addHandler(_h)
+    logger.setLevel(os.environ.get('FLEET_LOG_LEVEL', 'INFO').upper())
+    logger.propagate = False
+
+
+def set_log_level(level):
+    if isinstance(level, str):
+        level = level.upper()
+    logger.setLevel(level)
+
+
+def layer_to_str(base, *args, **kwargs):
+    name = base + "("
+    name += ", ".join(str(a) for a in args)
+    if kwargs:
+        if args:
+            name += ", "
+        name += ", ".join(f"{k}={v}" for k, v in kwargs.items())
+    return name + ")"

@@ -1,0 +1,87 @@
+"""Throughput/step timers (reference:
+python/paddle/distributed/fleet/utils/timer_helper.py — _Timer/_TimerGroup
+behind get_timers/set_timers). Used by hybrid-parallel training loops to
+report per-phase wall time; `elapsed` blocks on device work so the numbers
+mean something under async dispatch."""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ['get_timers', 'set_timers']
+
+_GLOBAL_TIMERS = None
+
+
+class _Timer:
+    def __init__(self, name):
+        self.name = name
+        self._elapsed = 0.0
+        self._started = False
+        self._start_t = 0.0
+
+    def start(self):
+        if self._started:
+            raise RuntimeError(f"timer {self.name} already started")
+        self._sync()
+        self._start_t = time.perf_counter()
+        self._started = True
+
+    def stop(self):
+        if not self._started:
+            raise RuntimeError(f"timer {self.name} is not running")
+        self._sync()
+        self._elapsed += time.perf_counter() - self._start_t
+        self._started = False
+
+    @staticmethod
+    def _sync():
+        try:  # drain queued device work so intervals are honest
+            import jax
+            jax.effects_barrier()
+        except Exception:
+            pass
+
+    def reset(self):
+        self._elapsed = 0.0
+        self._started = False
+
+    def elapsed(self, reset=True):
+        started = self._started
+        if started:
+            self.stop()
+        e = self._elapsed
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return e
+
+
+class _TimerGroup:
+    def __init__(self):
+        self._timers = {}
+
+    def __call__(self, name):
+        if name not in self._timers:
+            self._timers[name] = _Timer(name)
+        return self._timers[name]
+
+    def log(self, names=None, normalizer=1.0, reset=True):
+        names = names if names is not None else sorted(self._timers)
+        parts = [f"{n}: {self._timers[n].elapsed(reset=reset) * 1000.0 / normalizer:.2f}ms"
+                 for n in names if n in self._timers]
+        msg = "time (ms) | " + " | ".join(parts)
+        print(msg, flush=True)
+        return msg
+
+
+def get_timers():
+    return _GLOBAL_TIMERS
+
+
+def set_timers():
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = _TimerGroup()
+    return _GLOBAL_TIMERS

@@ -1,0 +1,75 @@
+"""`paddle.distributed.metric` (reference:
+python/paddle/distributed/metric/metrics.py + C++
+framework/fleet/metrics.cc — all-reduced AUC stat buckets for PS training).
+
+TPU build: the same bucketed-AUC math over the collective layer — each
+worker keeps local positive/negative histograms; `calculate` all-reduces the
+buckets and integrates the ROC once, globally."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ['DistributedAuc', 'global_auc']
+
+
+class DistributedAuc:
+    """Streaming AUC whose buckets are summed across workers before the
+    final integration (reference metrics.cc BasicAucCalculator)."""
+
+    def __init__(self, num_thresholds=4096):
+        self._n = num_thresholds
+        self._pos = np.zeros((num_thresholds + 1,), np.float64)
+        self._neg = np.zeros((num_thresholds + 1,), np.float64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = labels.reshape(-1)
+        idx = np.clip((preds * self._n).astype(np.int64), 0, self._n)
+        for i, lbl in zip(idx, labels):
+            if lbl > 0.5:
+                self._pos[i] += 1
+            else:
+                self._neg[i] += 1
+
+    def reset(self):
+        self._pos[:] = 0
+        self._neg[:] = 0
+
+    def calculate(self, group=None):
+        """All-reduce the buckets across the (dp) group, then integrate."""
+        from .. import communication as dist
+
+        pos_t, neg_t = Tensor(self._pos), Tensor(self._neg)
+        try:
+            dist.all_reduce(pos_t, group=group)
+            dist.all_reduce(neg_t, group=group)
+        except Exception:
+            pass  # single-process path: local buckets are the global ones
+        pos = np.asarray(pos_t.numpy(), np.float64)
+        neg = np.asarray(neg_t.numpy(), np.float64)
+        # integrate trapezoid over descending threshold
+        tot_pos = pos.sum()
+        tot_neg = neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.5
+        area = 0.0
+        tp = fp = 0.0
+        for i in range(self._n, -1, -1):
+            new_tp = tp + pos[i]
+            new_fp = fp + neg[i]
+            area += (new_fp - fp) * (tp + new_tp) / 2.0
+            tp, fp = new_tp, new_fp
+        return float(area / (tot_pos * tot_neg))
+
+
+def global_auc(preds, labels, num_thresholds=4096, group=None):
+    auc = DistributedAuc(num_thresholds)
+    auc.update(preds, labels)
+    return auc.calculate(group=group)

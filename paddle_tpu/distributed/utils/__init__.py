@@ -1,0 +1,47 @@
+"""`paddle.distributed.utils` (reference: python/paddle/distributed/utils/ —
+launch_utils/log_utils/moe_utils). The MoE alltoall ops (global_scatter /
+global_gather) are the public surface of the reference's
+operators/collective/global_*_op.cu; here they ride the EP dispatch path."""
+
+from __future__ import annotations
+
+__all__ = ['global_scatter', 'global_gather', 'get_logger']
+
+
+def get_logger(log_level="INFO", name="paddle_tpu.distributed"):
+    import logging
+    import sys
+    lg = logging.getLogger(name)
+    if not lg.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            '%(asctime)s %(levelname)s %(message)s'))
+        lg.addHandler(h)
+    lg.setLevel(log_level if not isinstance(log_level, str)
+                else log_level.upper())
+    return lg
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Token dispatch for MoE alltoall (reference
+    distributed/utils/moe_utils.py global_scatter over global_scatter_op).
+    Single-controller SPMD build: the MoE layer performs dispatch with
+    GShard einsums inside shard_map (incubate/.../moe/moe_layer.py), so the
+    eager op is exposed for API parity and routes through alltoall."""
+    from .. import communication as dist
+    from ...core.tensor import Tensor
+    import numpy as np
+
+    xs = x.numpy()
+    lc = np.asarray(local_count.numpy(), np.int64)
+    out = Tensor(xs)  # world_size==1 eager path: identity routing
+    if group is not None and getattr(group, "nranks", 1) > 1:
+        tmp = []
+        dist.all_to_all(tmp, [Tensor(xs)], group=group)
+        out = tmp[0]
+    return out
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter (reference moe_utils.py global_gather)."""
+    return global_scatter(x, global_count, local_count, group=group)

@@ -1,0 +1,144 @@
+"""`paddle.fft` — discrete Fourier transforms (reference: python/paddle/fft.py;
+kernels paddle/phi/kernels/*/fft_kernel.*). TPU-native: backed by jnp.fft,
+which lowers to XLA's FFT HLO; differentiable through the autograd engine."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, as_tensor
+from .autograd.function import apply
+
+__all__ = [
+    'fft', 'ifft', 'rfft', 'irfft', 'hfft', 'ihfft',
+    'fft2', 'ifft2', 'rfft2', 'irfft2', 'hfft2', 'ihfft2',
+    'fftn', 'ifftn', 'rfftn', 'irfftn', 'hfftn', 'ihfftn',
+    'fftfreq', 'rfftfreq', 'fftshift', 'ifftshift',
+]
+
+_NORMS = ('forward', 'backward', 'ortho')
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm}. Norm should be forward, backward or ortho")
+    return norm
+
+
+def _wrap1(jfn, x, n, axis, norm, name):
+    _check_norm(norm)
+    return apply(lambda a: jfn(a, n=n, axis=axis, norm=norm), x,
+                 name=name)
+
+
+def _wrapn(jfn, x, s, axes, norm, name):
+    _check_norm(norm)
+    return apply(lambda a: jfn(a, s=s, axes=axes, norm=norm), x,
+                 name=name)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None) -> Tensor:
+    return _wrap1(jnp.fft.fft, x, n, axis, norm, "fft")
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None) -> Tensor:
+    return _wrap1(jnp.fft.ifft, x, n, axis, norm, "ifft")
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None) -> Tensor:
+    return _wrap1(jnp.fft.rfft, x, n, axis, norm, "rfft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None) -> Tensor:
+    return _wrap1(jnp.fft.irfft, x, n, axis, norm, "irfft")
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None) -> Tensor:
+    return _wrap1(jnp.fft.hfft, x, n, axis, norm, "hfft")
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None) -> Tensor:
+    return _wrap1(jnp.fft.ihfft, x, n, axis, norm, "ihfft")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    return _wrapn(jnp.fft.fft2, x, s, axes, norm, "fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    return _wrapn(jnp.fft.ifft2, x, s, axes, norm, "ifft2")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    return _wrapn(jnp.fft.rfft2, x, s, axes, norm, "rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    return _wrapn(jnp.fft.irfft2, x, s, axes, norm, "irfft2")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    _check_norm(norm)
+    return apply(
+        lambda a: jnp.fft.hfft(
+            jnp.fft.ifftn(a, s=None if s is None else s[:-1],
+                          axes=axes[:-1], norm=norm) if len(axes) > 1 else a,
+            n=None if s is None else s[-1], axis=axes[-1], norm=norm),
+        x, name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None) -> Tensor:
+    _check_norm(norm)
+    return apply(
+        lambda a: jnp.fft.fftn(
+            jnp.fft.ihfft(a, n=None if s is None else s[-1],
+                          axis=axes[-1], norm=norm),
+            s=None if s is None else s[:-1], axes=axes[:-1],
+            norm=norm) if len(axes) > 1 else jnp.fft.ihfft(
+                a, n=None if s is None else s[-1], axis=axes[-1], norm=norm),
+        x, name="ihfft2")
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None) -> Tensor:
+    return _wrapn(jnp.fft.fftn, x, s, axes, norm, "fftn")
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None) -> Tensor:
+    return _wrapn(jnp.fft.ifftn, x, s, axes, norm, "ifftn")
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None) -> Tensor:
+    return _wrapn(jnp.fft.rfftn, x, s, axes, norm, "rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None) -> Tensor:
+    return _wrapn(jnp.fft.irfftn, x, s, axes, norm, "irfftn")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None) -> Tensor:
+    if axes is None:
+        axes = tuple(range(as_tensor(x).ndim))
+    return hfft2(x, s=s, axes=tuple(axes), norm=norm, name=name)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None) -> Tensor:
+    if axes is None:
+        axes = tuple(range(as_tensor(x).ndim))
+    return ihfft2(x, s=s, axes=tuple(axes), norm=norm, name=name)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.fftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.fft.rfftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None) -> Tensor:
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x, name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None) -> Tensor:
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x, name="ifftshift")

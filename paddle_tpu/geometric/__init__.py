@@ -1,0 +1,21 @@
+"""`paddle.geometric` — graph learning ops (reference:
+python/paddle/geometric/: message_passing, math, reindex.py, sampling).
+
+TPU-native: message passing and segment reductions lower to XLA
+scatter/segment ops (`jax.ops.segment_*`), which tile onto the VPU; the
+gather/scatter pair is exactly how the reference's GPU kernels
+(graph_send_recv kernels) are structured, minus hand-written CUDA."""
+
+from __future__ import annotations
+
+from .math import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
+from .message_passing import send_u_recv, send_ue_recv, send_uv  # noqa: F401
+from .reindex import reindex_graph, reindex_heter_graph  # noqa: F401
+from .sampling import sample_neighbors, weighted_sample_neighbors  # noqa: F401
+
+__all__ = [
+    'send_u_recv', 'send_ue_recv', 'send_uv',
+    'segment_sum', 'segment_mean', 'segment_min', 'segment_max',
+    'reindex_graph', 'reindex_heter_graph',
+    'sample_neighbors', 'weighted_sample_neighbors',
+]

@@ -1,0 +1,60 @@
+"""Segment reductions (reference: python/paddle/geometric/math.py; kernels
+paddle/phi/kernels/*/segment_pool_*). num_segments is taken from the data
+(max id + 1), so pass statically-padded segment ids under jit."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, as_tensor
+from ..autograd.function import apply
+
+__all__ = ['segment_sum', 'segment_mean', 'segment_min', 'segment_max']
+
+
+def _num_segments(seg):
+    return int(jnp.max(seg)) + 1 if seg.size else 0
+
+
+def _segment(op_name, data, segment_ids, name):
+    data, segment_ids = as_tensor(data), as_tensor(segment_ids)
+    n = _num_segments(segment_ids._data)
+
+    def f(d, s):
+        fn = {'sum': jax.ops.segment_sum, 'min': jax.ops.segment_min,
+              'max': jax.ops.segment_max}.get(op_name)
+        if fn is not None:
+            out = fn(d, s, num_segments=n)
+        else:  # mean
+            tot = jax.ops.segment_sum(d, s, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), s,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (d.ndim - 1)
+            out = tot / jnp.maximum(cnt, 1).reshape(shape)
+        if op_name in ('min', 'max'):
+            # empty segments come back +-inf; the reference zeroes them
+            cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],)), s,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (d.ndim - 1)
+            out = jnp.where(cnt.reshape(shape) > 0, out,
+                            jnp.zeros((), d.dtype))
+        return out
+
+    return apply(f, data, segment_ids, name=name)
+
+
+def segment_sum(data, segment_ids, name=None) -> Tensor:
+    return _segment('sum', data, segment_ids, 'segment_sum')
+
+
+def segment_mean(data, segment_ids, name=None) -> Tensor:
+    return _segment('mean', data, segment_ids, 'segment_mean')
+
+
+def segment_min(data, segment_ids, name=None) -> Tensor:
+    return _segment('min', data, segment_ids, 'segment_min')
+
+
+def segment_max(data, segment_ids, name=None) -> Tensor:
+    return _segment('max', data, segment_ids, 'segment_max')

@@ -1,0 +1,83 @@
+"""Graph message passing (reference:
+python/paddle/geometric/message_passing/send_recv.py; GPU kernels
+graph_send_recv_kernel.cu / graph_send_ue_recv_kernel.cu).
+
+send_u_recv: gather source-node features along edges, reduce at destination.
+send_ue_recv: combine source features with edge features first.
+send_uv: per-edge combination of both endpoint features (no reduce)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, as_tensor
+from ..autograd.function import apply
+
+__all__ = ['send_u_recv', 'send_ue_recv', 'send_uv']
+
+_MSG = {
+    'add': jnp.add, 'sub': jnp.subtract, 'mul': jnp.multiply,
+    'div': jnp.divide,
+}
+
+
+def _check_reduce(reduce_op):
+    if reduce_op not in ('sum', 'mean', 'max', 'min'):
+        raise ValueError(f"reduce_op should be sum/mean/max/min, got {reduce_op}")
+
+
+def _reduce(msg, dst, n, reduce_op, dtype):
+    if reduce_op == 'sum':
+        return jax.ops.segment_sum(msg, dst, num_segments=n)
+    if reduce_op == 'mean':
+        tot = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt, 1).reshape((n,) + (1,) * (msg.ndim - 1))
+    fn = jax.ops.segment_max if reduce_op == 'max' else jax.ops.segment_min
+    out = fn(msg, dst, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],)), dst, num_segments=n)
+    return jnp.where(cnt.reshape((n,) + (1,) * (msg.ndim - 1)) > 0, out,
+                     jnp.zeros((), dtype))
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op='sum', out_size=None,
+                name=None) -> Tensor:
+    _check_reduce(reduce_op)
+    x, src_index, dst_index = (as_tensor(t) for t in (x, src_index, dst_index))
+    n = int(out_size) if out_size is not None else x.shape[0]
+
+    def f(xd, src, dst):
+        return _reduce(jnp.take(xd, src, axis=0), dst, n, reduce_op, xd.dtype)
+
+    return apply(f, x, src_index, dst_index, name='send_u_recv')
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op='add',
+                 reduce_op='sum', out_size=None, name=None) -> Tensor:
+    _check_reduce(reduce_op)
+    if message_op not in _MSG:
+        raise ValueError(f"message_op should be add/sub/mul/div, got {message_op}")
+    x, y, src_index, dst_index = (as_tensor(t)
+                                  for t in (x, y, src_index, dst_index))
+    n = int(out_size) if out_size is not None else x.shape[0]
+
+    def f(xd, yd, src, dst):
+        msg = _MSG[message_op](jnp.take(xd, src, axis=0), yd)
+        return _reduce(msg, dst, n, reduce_op, xd.dtype)
+
+    return apply(f, x, y, src_index, dst_index, name='send_ue_recv')
+
+
+def send_uv(x, y, src_index, dst_index, message_op='add', name=None) -> Tensor:
+    if message_op not in _MSG:
+        raise ValueError(f"message_op should be add/sub/mul/div, got {message_op}")
+    x, y, src_index, dst_index = (as_tensor(t)
+                                  for t in (x, y, src_index, dst_index))
+
+    def f(xd, yd, src, dst):
+        return _MSG[message_op](jnp.take(xd, src, axis=0),
+                                jnp.take(yd, dst, axis=0))
+
+    return apply(f, x, y, src_index, dst_index, name='send_uv')

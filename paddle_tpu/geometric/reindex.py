@@ -1,0 +1,55 @@
+"""Graph reindexing (reference: python/paddle/geometric/reindex.py —
+reindex_graph/reindex_heter_graph over graph_reindex kernels). Host-side
+index bookkeeping (the reference runs these on CPU for sampling pipelines),
+so plain numpy is the right tool — no jit tracing on this path."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = ['reindex_graph', 'reindex_heter_graph']
+
+
+def _reindex(x, neighbors_list, counts_list):
+    x = np.asarray(x)
+    all_nodes = [x] + [np.asarray(n) for n in neighbors_list]
+    flat = np.concatenate(all_nodes)
+    # order-preserving unique: x first, then first-seen neighbors
+    uniq, first_idx = np.unique(flat, return_index=True)
+    order = np.argsort(first_idx)
+    uniq = uniq[order]
+    remap = {int(v): i for i, v in enumerate(uniq)}
+    reindexed = [np.asarray([remap[int(v)] for v in n], dtype=np.int64)
+                 for n in neighbors_list]
+    # reindex_dst: each neighbor segment's destination is its center node
+    dsts = []
+    for neigh, cnt in zip(reindexed, counts_list):
+        cnt = np.asarray(cnt)
+        dst = np.repeat(np.arange(len(cnt), dtype=np.int64), cnt)
+        dsts.append(dst)
+    return uniq.astype(np.int64), reindexed, dsts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """→ (reindex_src, reindex_dst, out_nodes): edges renumbered into the
+    compact id space [0, len(out_nodes))."""
+    x_t, neighbors, count = as_tensor(x), as_tensor(neighbors), as_tensor(count)
+    uniq, (src,), (dst,) = _reindex(
+        x_t.numpy(), [neighbors.numpy()], [count.numpy()])
+    return Tensor(src), Tensor(dst), Tensor(uniq)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: one neighbor/count pair per edge type, all
+    renumbered into one shared id space."""
+    x_t = as_tensor(x)
+    neighbors = [as_tensor(n).numpy() for n in neighbors]
+    counts = [as_tensor(c).numpy() for c in count]
+    uniq, srcs, dsts = _reindex(x_t.numpy(), neighbors, counts)
+    src = np.concatenate(srcs) if srcs else np.zeros((0,), np.int64)
+    dst = np.concatenate(dsts) if dsts else np.zeros((0,), np.int64)
+    return Tensor(src), Tensor(dst), Tensor(uniq)

@@ -1,0 +1,70 @@
+"""Neighbor sampling (reference: python/paddle/geometric/sampling/neighbors.py
+over graph_sample_neighbors kernels). CSR graph (row = sorted dst pointers,
+colptr = offsets); host-side numpy like the reference's CPU sampling path."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, as_tensor
+from ..core import generator as gen_mod
+
+__all__ = ['sample_neighbors', 'weighted_sample_neighbors']
+
+
+def _rng():
+    return np.random.default_rng(gen_mod.default_generator.random())
+
+
+def _sample(row, colptr, nodes, sample_size, weights=None,
+            return_eids=False):
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    nodes = np.asarray(nodes)
+    rng = _rng()
+    out_neighbors, out_counts, out_eids = [], [], []
+    for nd in nodes:
+        beg, end = int(colptr[nd]), int(colptr[nd + 1])
+        cand = row[beg:end]
+        eids = np.arange(beg, end, dtype=np.int64)
+        if sample_size < 0 or len(cand) <= sample_size:
+            chosen = np.arange(len(cand))
+        elif weights is not None:
+            w = np.asarray(weights[beg:end], dtype=np.float64)
+            p = w / w.sum() if w.sum() > 0 else None
+            chosen = rng.choice(len(cand), size=sample_size, replace=False, p=p)
+        else:
+            chosen = rng.choice(len(cand), size=sample_size, replace=False)
+        out_neighbors.append(cand[chosen])
+        out_eids.append(eids[chosen])
+        out_counts.append(len(chosen))
+    neighbors = (np.concatenate(out_neighbors) if out_neighbors
+                 else np.zeros((0,), np.int64))
+    counts = np.asarray(out_counts, dtype=np.int32)
+    eids = (np.concatenate(out_eids) if out_eids
+            else np.zeros((0,), np.int64))
+    return neighbors.astype(np.int64), counts, eids
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    row_t, colptr_t, nodes_t = (as_tensor(t)
+                                for t in (row, colptr, input_nodes))
+    neigh, counts, eid = _sample(row_t.numpy(), colptr_t.numpy(),
+                                 nodes_t.numpy(), sample_size)
+    if return_eids:
+        return Tensor(neigh), Tensor(counts), Tensor(eid)
+    return Tensor(neigh), Tensor(counts)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    row_t, colptr_t, w_t, nodes_t = (as_tensor(t) for t in
+                                     (row, colptr, edge_weight, input_nodes))
+    neigh, counts, eid = _sample(row_t.numpy(), colptr_t.numpy(),
+                                 nodes_t.numpy(), sample_size,
+                                 weights=w_t.numpy())
+    if return_eids:
+        return Tensor(neigh), Tensor(counts), Tensor(eid)
+    return Tensor(neigh), Tensor(counts)

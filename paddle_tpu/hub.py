@@ -1,0 +1,83 @@
+"""`paddle.hub` (reference: python/paddle/hub.py) — load models/entry points
+from a `hubconf.py`. The TPU build supports the `local` source fully; remote
+sources (`github`/`gitee`) require network access and raise a clear error in
+the zero-egress environment unless the repo is already cached."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ['list', 'help', 'load']
+
+_HUBCONF = 'hubconf.py'
+HUB_DIR = os.environ.get(
+    'PADDLE_TPU_HUB_DIR',
+    os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu', 'hub'))
+
+
+def _cache_dir_for(repo_dir: str) -> str:
+    # "owner/repo[:branch]" → cached checkout path
+    name = repo_dir.replace('/', '_').replace(':', '_')
+    return os.path.join(HUB_DIR, name)
+
+
+def _resolve(repo_dir: str, source: str) -> str:
+    source = source.lower()
+    if source not in ('github', 'gitee', 'local'):
+        raise ValueError(
+            f"Unknown source: {source}. Valid: 'github', 'gitee', 'local'.")
+    if source == 'local':
+        path = os.path.expanduser(repo_dir)
+    else:
+        path = _cache_dir_for(repo_dir)
+        if not os.path.isdir(path):
+            raise RuntimeError(
+                f"hub source '{source}' needs network access to fetch "
+                f"{repo_dir!r}; this environment has no egress. Pre-populate "
+                f"{path} with the repo checkout, or use source='local'.")
+    if not os.path.isfile(os.path.join(path, _HUBCONF)):
+        raise FileNotFoundError(f"no {_HUBCONF} found under {path}")
+    return path
+
+
+def _import_hubconf(path: str):
+    file = os.path.join(path, _HUBCONF)
+    spec = importlib.util.spec_from_file_location('paddle_tpu_hubconf', file)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, path)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(path)
+    deps = getattr(mod, 'dependencies', [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f'hubconf dependencies missing: {missing}')
+    return mod
+
+
+def list(repo_dir, source='github', force_reload=False):  # noqa: A001
+    """Entrypoint names (public callables) defined by the repo's hubconf."""
+    mod = _import_hubconf(_resolve(repo_dir, source))
+    return [n for n, v in vars(mod).items()
+            if callable(v) and not n.startswith('_')]
+
+
+def help(repo_dir, model, source='github', force_reload=False):  # noqa: A002
+    """Docstring of one entrypoint."""
+    mod = _import_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f'no entrypoint named {model!r} in {_HUBCONF}')
+    return fn.__doc__
+
+
+def load(repo_dir, model, source='github', force_reload=False, **kwargs):
+    """Call the entrypoint and return its result (usually a Layer)."""
+    mod = _import_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f'no entrypoint named {model!r} in {_HUBCONF}')
+    return fn(**kwargs)

@@ -1,0 +1,274 @@
+"""`paddle.inference` — deploy/serving API (reference:
+python/paddle/inference/__init__.py + wrapper.py; C++ AnalysisPredictor at
+paddle/fluid/inference/api/analysis_predictor.cc).
+
+TPU-native realization: the "inference program" is the versioned StableHLO
+program serialized by `paddle.jit.save` (jax.export). `Predictor` deserializes
+it once, binds feed/fetch handles by name, and executes via the XLA runtime —
+the analysis-pass pipeline (IR fusion, memory optim) is XLA's compiler, so the
+Config switches that tune it are accepted and recorded, and precision ones are
+honored via `convert_to_mixed_precision`.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import pickle
+
+import numpy as np
+
+__all__ = [
+    'Config', 'DataType', 'PlaceType', 'PrecisionType', 'Tensor', 'Predictor',
+    'create_predictor', 'get_version', 'convert_to_mixed_precision',
+    'get_num_bytes_of_data_type', 'PredictorPool',
+]
+
+
+class DataType(enum.Enum):
+    FLOAT32 = 0
+    FLOAT16 = 1
+    BFLOAT16 = 2
+    INT32 = 3
+    INT64 = 4
+    INT8 = 5
+    UINT8 = 6
+    BOOL = 7
+
+
+class PlaceType(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+    TPU = 4
+
+
+class PrecisionType(enum.Enum):
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+_NP_TO_DT = {
+    np.dtype('float32'): DataType.FLOAT32,
+    np.dtype('float16'): DataType.FLOAT16,
+    np.dtype('int32'): DataType.INT32,
+    np.dtype('int64'): DataType.INT64,
+    np.dtype('int8'): DataType.INT8,
+    np.dtype('uint8'): DataType.UINT8,
+    np.dtype('bool'): DataType.BOOL,
+}
+
+
+def get_version() -> str:
+    from .. import __version__
+    return f"paddle_tpu inference {__version__}"
+
+
+def get_num_bytes_of_data_type(dtype: DataType) -> int:
+    return {
+        DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.BFLOAT16: 2,
+        DataType.INT32: 4, DataType.INT64: 8, DataType.INT8: 1,
+        DataType.UINT8: 1, DataType.BOOL: 1,
+    }[dtype]
+
+
+class Config:
+    """Inference config (reference wrapper.py Config / AnalysisConfig).
+
+    ``Config(prog_prefix)`` points at the path prefix given to
+    `paddle.jit.save` (files ``<prefix>.pdmodel``)."""
+
+    def __init__(self, model_path: str | None = None,
+                 params_path: str | None = None):
+        self._model_path = model_path
+        self._params_path = params_path
+        self._device = PlaceType.TPU
+        self._device_id = 0
+        self._ir_optim = True
+        self._memory_optim = True
+        self._cpu_math_threads = 1
+        self._precision = PrecisionType.Float32
+        self._enable_profile = False
+
+    # -- model location ---------------------------------------------------
+    def set_model(self, model_path, params_path=None):
+        self._model_path = model_path
+        self._params_path = params_path
+
+    def model_dir(self):
+        return self._model_path
+
+    def prog_file(self):
+        return (self._model_path or '') + '.pdmodel'
+
+    # -- device selection -------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        # accelerator on this build is the TPU; keep the switch for parity
+        self._device = PlaceType.TPU
+        self._device_id = device_id
+        self._precision = precision
+
+    def enable_tpu(self, device_id=0):
+        self._device = PlaceType.TPU
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = PlaceType.CPU
+
+    def use_gpu(self):
+        return self._device in (PlaceType.GPU, PlaceType.TPU)
+
+    # -- compiler/runtime knobs (XLA subsumes the IR pass pipeline) -------
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = bool(flag)
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = int(n)
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def summary(self) -> str:
+        return (f"model: {self._model_path}\ndevice: {self._device.name}"
+                f"\nir_optim: {self._ir_optim}"
+                f"\nprecision: {self._precision.name}")
+
+
+class Tensor:
+    """Feed/fetch handle (reference wrapper.py Tensor ~ ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._arr: np.ndarray | None = None
+
+    def copy_from_cpu(self, data):
+        self._arr = np.asarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._arr is None:
+            raise RuntimeError(f"tensor {self.name!r} has no data yet")
+        return np.asarray(self._arr)
+
+    def reshape(self, shape):
+        if self._arr is not None:
+            self._arr = self._arr.reshape(shape)
+
+    def shape(self):
+        return list(self._arr.shape) if self._arr is not None else []
+
+    def type(self) -> DataType:
+        if self._arr is None:
+            return DataType.FLOAT32
+        return _NP_TO_DT.get(self._arr.dtype, DataType.FLOAT32)
+
+
+class Predictor:
+    """Executes the exported StableHLO program (reference:
+    AnalysisPredictor::Run contract — named feeds, named fetches)."""
+
+    def __init__(self, config: Config):
+        from ..jit.save_load import load as jit_load
+        self._config = config
+        if not config.model_dir():
+            raise ValueError("Config has no model path; call set_model()")
+        if not os.path.exists(config.prog_file()):
+            raise FileNotFoundError(config.prog_file())
+        self._layer = jit_load(config.model_dir())
+        n_in = len(self._layer._payload.get("in_shapes") or [])
+        feed_names = self._layer._feed_names or [f"x{i}" for i in range(n_in)]
+        self._inputs = {n: Tensor(n) for n in feed_names}
+        self._input_order = list(feed_names)
+        self._outputs: dict[str, Tensor] = {}
+        self._output_order: list[str] = []
+
+    def get_input_names(self):
+        return list(self._input_order)
+
+    def get_input_handle(self, name) -> Tensor:
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        if inputs is not None:  # positional convenience path
+            for n, a in zip(self._input_order, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        feeds = [self._inputs[n].copy_to_cpu() for n in self._input_order]
+        out = self._layer(*feeds)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        self._output_order = [f"fetch{i}" for i in range(len(outs))]
+        self._outputs = {}
+        results = []
+        for n, o in zip(self._output_order, outs):
+            t = Tensor(n)
+            t.copy_from_cpu(np.asarray(o._data))
+            self._outputs[n] = t
+            results.append(t.copy_to_cpu())
+        return results
+
+    def get_output_names(self):
+        return list(self._output_order) or ["fetch0"]
+
+    def get_output_handle(self, name) -> Tensor:
+        if not self._outputs and name == "fetch0":
+            self._outputs[name] = Tensor(name)
+        return self._outputs[name]
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    """Pool of predictors sharing one deserialized program (reference
+    capi PredictorPool)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [Predictor(config) for _ in range(max(1, size))]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file=None,
+                               mixed_precision=PrecisionType.Bfloat16,
+                               backend=PlaceType.TPU, keep_io_types=True,
+                               black_list=None):
+    """Rewrite a saved model's params to bf16/fp16 (reference:
+    convert_to_mixed_precision pass). The program itself recompiles under the
+    new dtypes at load (XLA handles the cast insertion)."""
+    import jax.numpy as jnp
+    path = model_file[:-len('.pdmodel')] if model_file.endswith('.pdmodel') \
+        else model_file
+    with open(path + '.pdmodel', 'rb') as f:
+        payload = pickle.load(f)
+    tgt = np.dtype('float16') if mixed_precision == PrecisionType.Half \
+        else jnp.bfloat16
+    black = set(black_list or ())
+    state = {}
+    for k, v in payload['state'].items():
+        if k in black or v.dtype.kind != 'f':
+            state[k] = v
+        else:
+            state[k] = np.asarray(v, dtype=tgt)
+    payload['state'] = state
+    out = mixed_model_file[:-len('.pdmodel')] \
+        if mixed_model_file.endswith('.pdmodel') else mixed_model_file
+    os.makedirs(os.path.dirname(out) or '.', exist_ok=True)
+    with open(out + '.pdmodel', 'wb') as f:
+        pickle.dump(payload, f, protocol=4)

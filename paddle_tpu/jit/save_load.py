@@ -79,7 +79,11 @@ def save(layer, path, input_spec=None, **configs):
     one pickle holding numpy params and jax.export bytes.
     """
     state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
-    payload = {"state": state, "class": type(layer).__name__}
+    payload = {"state": state, "class": type(layer).__name__,
+               # exported-program param signature; a post-save precision
+               # conversion (inference.convert_to_mixed_precision) may store
+               # params narrower, and load casts back to this to call
+               "param_dtypes": {k: str(v.dtype) for k, v in state.items()}}
     if input_spec:
         structs = _spec_structs(input_spec)
 
@@ -150,7 +154,10 @@ class TranslatedLayer(Layer):
                 "this model was saved without input_spec, so no program was "
                 "exported; re-save with paddle.jit.save(layer, path, "
                 "input_spec=[...])")
-        params = {k: p._d for k, p in self._state.items()}
+        sig = self._payload.get("param_dtypes") or {}
+        params = {k: (p._d.astype(sig[k]) if k in sig
+                      and str(p._d.dtype) != sig[k] else p._d)
+                  for k, p in self._state.items()}
         arrs = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
                 for x in xs]
         out = self._exported.call(params, *arrs)

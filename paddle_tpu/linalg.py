@@ -1,0 +1,44 @@
+"""`paddle.linalg` namespace (reference: python/paddle/linalg.py — a re-export
+of tensor/linalg.py names). Backed by `paddle_tpu.ops.linalg`."""
+
+from __future__ import annotations
+
+from .ops.linalg import (  # noqa: F401
+    cholesky,
+    cholesky_solve,
+    cond,
+    corrcoef,
+    cov,
+    det,
+    eig,
+    eigh,
+    eigvals,
+    eigvalsh,
+    householder_product,
+    inv,
+    lstsq,
+    lu,
+    lu_unpack,
+    matrix_norm,
+    matrix_power,
+    matrix_rank,
+    multi_dot,
+    norm,
+    pca_lowrank,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    svdvals,
+    triangular_solve,
+    vector_norm,
+)
+
+__all__ = [
+    'cholesky', 'norm', 'cond', 'cov', 'corrcoef', 'inv', 'eig', 'eigvals',
+    'multi_dot', 'matrix_rank', 'svd', 'svdvals', 'qr', 'pca_lowrank', 'lu',
+    'lu_unpack', 'matrix_power', 'det', 'slogdet', 'eigh', 'eigvalsh', 'pinv',
+    'solve', 'cholesky_solve', 'triangular_solve', 'lstsq', 'vector_norm',
+    'matrix_norm', 'householder_product',
+]

@@ -33,17 +33,16 @@ def _contains_moe(model) -> bool:
                for _, sub in model.named_sublayers(include_self=True))
 
 
-def _gen_step(model, kind):
+def _gen_step(model):
     """Compiled (buffer, pos) -> [B, V] last-token logits, cached on the
-    model so repeated generate() calls skip retrace/recompile."""
+    model so repeated generate() calls skip retrace/recompile (shape
+    specialization is to_static's signature cache, not ours)."""
     import jax.numpy as jnp
     import paddle_tpu as paddle
 
-    cache = getattr(model, "_gen_step_cache", None)
-    if cache is None:
-        cache = model._gen_step_cache = {}
-    if kind in cache:
-        return cache[kind]
+    cached = getattr(model, "_gen_step", None)
+    if cached is not None:
+        return cached
 
     @paddle.jit.to_static
     def next_logits(buffer, pos):
@@ -55,7 +54,7 @@ def _gen_step(model, kind):
                 lg, p.reshape(-1, 1, 1).astype(jnp.int32), axis=1)[:, 0, :],
             logits, pos, name="gather_last_logits")
 
-    cache[kind] = next_logits
+    model._gen_step = next_logits
     return next_logits
 
 
@@ -72,7 +71,8 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
                      else input_ids).astype(np.int64)
     b, s = ids.shape
     total = s + max_new_tokens
-    max_pos = getattr(model.cfg, "max_position_embeddings", total)
+    max_pos = getattr(getattr(model, "cfg", None),
+                      "max_position_embeddings", total)
     if total > max_pos:
         raise ValueError(f"prompt {s} + max_new_tokens {max_new_tokens} "
                          f"exceeds max_position_embeddings {max_pos}")
@@ -82,7 +82,7 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
     buf[:, :s] = ids
 
     exact_slices = _contains_moe(model)
-    step_fn = _gen_step(model, "decode")
+    step_fn = _gen_step(model)
 
     was_training = getattr(model, "training", False)
     model.eval()

@@ -1,0 +1,31 @@
+"""`paddle.onnx` (reference: python/paddle/onnx/export.py — delegates to the
+external `paddle2onnx` package). The TPU build's portable interchange format
+is jax.export StableHLO (see paddle_tpu.jit.save); ONNX export additionally
+requires the optional `onnx` package, which this environment does not ship."""
+
+from __future__ import annotations
+
+__all__ = ['export']
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` to ONNX if the optional `onnx` dependency is present;
+    otherwise fall back to the StableHLO export (`<path>.pdmodel[.txt]`) and
+    raise with a pointer to it, since ONNX serialization itself cannot be
+    produced without the library."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        from ..jit.save_load import save as jit_save
+        if input_spec is not None:
+            jit_save(layer, path, input_spec=input_spec)
+            hint = (f"; the portable StableHLO program was written to "
+                    f"{path}.pdmodel instead")
+        else:
+            hint = ""
+        raise RuntimeError(
+            "paddle.onnx.export requires the optional 'onnx' package, which "
+            "is not installed in this environment" + hint)
+    raise NotImplementedError(
+        "ONNX graph serialization is not implemented; use paddle.jit.save "
+        "(StableHLO) as the deployment format on TPU")

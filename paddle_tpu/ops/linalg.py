@@ -14,7 +14,8 @@ __all__ = [
     "norm", "vector_norm", "matrix_norm", "cholesky", "qr", "svd", "svdvals",
     "inv", "pinv", "solve", "triangular_solve", "cholesky_solve", "lstsq",
     "det", "slogdet", "matrix_power", "matrix_rank", "eig", "eigh", "eigvals",
-    "eigvalsh", "lu", "cond", "cov", "corrcoef", "householder_product",
+    "eigvalsh", "lu", "lu_unpack", "pca_lowrank", "cond", "cov", "corrcoef",
+    "householder_product",
     "multi_dot", "cross", "histogram", "histogramdd", "bincount", "t",
 ]
 
@@ -150,6 +151,81 @@ def lu(x, pivot=True, get_infos=False, name=None):
     if get_infos:
         return out + (Tensor(jnp.zeros((), jnp.int32)),)
     return out
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack the LU factorization (reference: tensor/linalg.py lu_unpack;
+    kernel paddle/phi/kernels/*/lu_unpack_kernel.*) into P, L, U.
+
+    ``x`` is the packed LU matrix from :func:`lu`, ``y`` the 1-based pivots.
+    """
+    x, y = as_tensor(x), as_tensor(y)
+
+    def f(lu_, piv):
+        *batch, m, n = lu_.shape
+        k = min(m, n)
+        if unpack_ludata:
+            tril = jnp.tril(lu_[..., :, :k], k=-1)
+            eye = jnp.eye(m, k, dtype=lu_.dtype)
+            L = tril + jnp.broadcast_to(eye, tril.shape)
+            U = jnp.triu(lu_[..., :k, :])
+        else:
+            L = jnp.zeros((*batch, m, k), lu_.dtype)
+            U = jnp.zeros((*batch, k, n), lu_.dtype)
+        if unpack_pivots:
+            # pivots are 1-based row swaps applied in order i=0..k-1
+            def perm_of(pv):
+                def body(i, perm):
+                    j = pv[i] - 1
+                    pi, pj = perm[i], perm[j]
+                    perm = perm.at[i].set(pj)
+                    return perm.at[j].set(pi)
+                return jax.lax.fori_loop(0, pv.shape[0], body,
+                                         jnp.arange(m, dtype=pv.dtype))
+            pv = piv.reshape((-1, piv.shape[-1]))
+            perms = jax.vmap(perm_of)(pv).reshape((*batch, m))
+            P = jax.nn.one_hot(perms, m, dtype=lu_.dtype)
+            # rows of one_hot give P^T applied; P[perm[i], i] = 1
+            P = jnp.swapaxes(P, -1, -2)
+        else:
+            P = jnp.zeros((*batch, m, m), lu_.dtype)
+        return P, L, U
+
+    out = f(x._data, y._data)
+    return tuple(Tensor(o) for o in out)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized low-rank PCA (reference: tensor/linalg.py pca_lowrank).
+
+    Returns (U, S, V) with ``x ~ U @ diag(S) @ V^T`` using the Halko et al.
+    randomized range finder (q columns, ``niter`` power iterations).
+    """
+    from ..core import generator as gen_mod
+
+    x = as_tensor(x)
+    m, n = x._data.shape[-2], x._data.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    key = gen_mod.default_generator.split()
+
+    def f(a):
+        b = a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+        omega = jax.random.normal(key, (*b.shape[:-2], n, q), b.dtype)
+        y = b @ omega
+        # re-orthonormalize between power iterations: without the QRs the
+        # fp32 subspace collapses toward the top singular vector and the
+        # trailing singular values come out wrong for ill-conditioned inputs
+        Q, _ = jnp.linalg.qr(y)
+        for _ in range(niter):
+            Z, _ = jnp.linalg.qr(jnp.swapaxes(b, -1, -2) @ Q)
+            Q, _ = jnp.linalg.qr(b @ Z)
+        small = jnp.swapaxes(Q, -1, -2) @ b
+        Us, S, Vh = jnp.linalg.svd(small, full_matrices=False)
+        return Q @ Us, S, jnp.swapaxes(Vh, -1, -2)
+
+    U, S, V = f(x._data)
+    return Tensor(U), Tensor(S), Tensor(V)
 
 
 def cond(x, p=None, name=None) -> Tensor:

@@ -1,0 +1,136 @@
+"""`paddle.signal` — STFT family (reference: python/paddle/signal.py, kernels
+paddle/phi/kernels/*/frame_kernel.* / stft via fft). TPU-native: framing is a
+gather, STFT is frame+window+rfft (XLA FFT HLO), inverse is a scatter-add
+overlap-add — all jit-friendly static-shape code."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, as_tensor
+from .autograd.function import apply
+
+__all__ = ['stft', 'istft']
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None) -> Tensor:
+    """Slice ``x`` into overlapping frames along ``axis`` (reference
+    python/paddle/signal.py frame). axis=-1 → (..., frame_length, num_frames);
+    axis=0 → (num_frames, frame_length, ...)."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    x = as_tensor(x)
+    seq_len = x.shape[axis if axis in (0, -1) else -1]
+    if frame_length > seq_len:
+        raise ValueError(
+            f"frame_length ({frame_length}) > sequence length ({seq_len})")
+    num_frames = 1 + (seq_len - frame_length) // hop_length
+
+    def f(a):
+        if axis == 0:
+            idx = (hop_length * jnp.arange(num_frames)[:, None]
+                   + jnp.arange(frame_length)[None, :])
+            return a[idx]  # (num_frames, frame_length, ...)
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(num_frames)[None, :])
+        return jnp.take(a, idx, axis=-1)  # (..., frame_length, num_frames)
+
+    return apply(f, x, name="frame")
+
+
+def _prep_window(window, win_length, n_fft, dtype):
+    if window is None:
+        w = jnp.ones((win_length,), dtype)
+    else:
+        w = as_tensor(window)._data.astype(dtype)
+        if w.shape != (win_length,):
+            raise ValueError(
+                f"window must be 1-D of length win_length ({win_length})")
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode='reflect', normalized=False, onesided=True, name=None) -> Tensor:
+    """Short-time Fourier transform → (..., n_fft//2+1 | n_fft, num_frames)."""
+    x = as_tensor(x)
+    if x.ndim not in (1, 2):
+        raise ValueError(f"stft expects a 1-D or 2-D input, got {x.ndim}-D")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if jnp.iscomplexobj(x._data) and onesided:
+        raise ValueError("onesided must be False for complex inputs")
+    real_dtype = jnp.real(x._data).dtype
+    w = _prep_window(window, win_length, n_fft, real_dtype)
+
+    def f(a):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        seq_len = a.shape[-1]
+        num_frames = 1 + (seq_len - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[:, None]
+               + hop_length * jnp.arange(num_frames)[None, :])
+        frames = jnp.take(a, idx, axis=-1)  # (..., n_fft, num_frames)
+        frames = frames * w[:, None]
+        if onesided and not jnp.iscomplexobj(a):
+            spec = jnp.fft.rfft(frames, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, real_dtype))
+        return spec
+
+    return apply(f, x, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None) -> Tensor:
+    """Inverse STFT via windowed overlap-add with window-envelope
+    normalization; input (..., n_freq, num_frames)."""
+    x = as_tensor(x)
+    if x.ndim not in (2, 3):
+        raise ValueError(f"istft expects a 2-D or 3-D input, got {x.ndim}-D")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _prep_window(window, win_length, n_fft, jnp.float32)
+
+    n_freq, num_frames = x.shape[-2], x.shape[-1]
+    expect = n_fft // 2 + 1 if onesided else n_fft
+    if n_freq != expect:
+        raise ValueError(f"expected {expect} frequency bins, got {n_freq}")
+    out_len = n_fft + hop_length * (num_frames - 1)
+
+    def f(spec):
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-2)
+            if not return_complex:
+                frames = jnp.real(frames)
+        frames = frames * w[:, None]
+
+        pos = (hop_length * jnp.arange(num_frames)[None, :]
+               + jnp.arange(n_fft)[:, None]).reshape(-1)
+
+        def ola(fr):  # fr: (n_fft, num_frames) → (out_len,)
+            return jnp.zeros((out_len,), fr.dtype).at[pos].add(fr.reshape(-1))
+
+        batch = frames.shape[:-2]
+        flat = frames.reshape((-1, n_fft, num_frames))
+        y = jax.vmap(ola)(flat).reshape((*batch, out_len))
+        env = ola((w[:, None] * w[:, None] * jnp.ones((1, num_frames))).astype(jnp.float32))
+        y = y / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            y = y[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            y = y[..., :length]
+        return y
+
+    return apply(f, x, name="istft")

@@ -1,0 +1,20 @@
+"""`paddle.sysconfig` (reference: python/paddle/sysconfig.py) — locations of
+the package's C headers and native libraries (our csrc-built extensions)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ['get_include', 'get_lib']
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing the framework's C/C++ headers (csrc/)."""
+    return os.path.join(_PKG_DIR, 'csrc')
+
+
+def get_lib() -> str:
+    """Directory containing compiled native libraries (.so) if built."""
+    return os.path.join(_PKG_DIR, 'libs')

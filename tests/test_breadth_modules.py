@@ -1,0 +1,297 @@
+"""Tests for the breadth namespace modules: paddle.linalg, fft, signal,
+geometric, sysconfig, batch, hub, dataset, inference, onnx."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------- linalg --
+
+def test_linalg_namespace():
+    import paddle_tpu.linalg as L
+    a = np.array([[4.0, 1.0], [1.0, 3.0]], np.float32)
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(L.inv(t).numpy(), np.linalg.inv(a), atol=1e-5)
+    assert set(['cholesky', 'svd', 'lu', 'lu_unpack', 'pca_lowrank',
+                'lstsq']) <= set(L.__all__)
+    # attribute access through the package root
+    assert paddle.linalg.det(t).numpy() == pytest.approx(np.linalg.det(a), rel=1e-5)
+
+
+def test_lu_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((5, 5)).astype(np.float32)
+    lu_, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.linalg.lu_unpack(lu_, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, atol=1e-4)
+
+
+def test_pca_lowrank():
+    rng = np.random.default_rng(1)
+    # rank-2 data + tiny noise
+    base = rng.standard_normal((40, 2)) @ rng.standard_normal((2, 10))
+    x = (base + 1e-4 * rng.standard_normal((40, 10))).astype(np.float32)
+    U, S, V = paddle.linalg.pca_lowrank(paddle.to_tensor(x), q=4)
+    assert U.shape == [40, 4] and S.shape == [4] and V.shape == [10, 4]
+    s = S.numpy()
+    assert s[0] > 0 and s[2] < 1e-2 * s[0]  # rank-2 spectrum
+
+
+# ------------------------------------------------------------------- fft --
+
+def test_fft_roundtrip_and_grad():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 16)).astype(np.float32)
+    t = paddle.to_tensor(x)
+    f = paddle.fft.fft(t)
+    np.testing.assert_allclose(f.numpy(), np.fft.fft(x), atol=1e-4)
+    back = paddle.fft.ifft(f)
+    np.testing.assert_allclose(back.numpy().real, x, atol=1e-4)
+
+    rf = paddle.fft.rfft(t, norm="ortho")
+    np.testing.assert_allclose(rf.numpy(), np.fft.rfft(x, norm="ortho"),
+                               atol=1e-4)
+    rt = paddle.fft.irfft(rf, n=16, norm="ortho")
+    np.testing.assert_allclose(rt.numpy(), x, atol=1e-4)
+
+    with pytest.raises(ValueError):
+        paddle.fft.fft(t, norm="bogus")
+
+    # gradient flows through rfft -> irfft
+    t2 = paddle.to_tensor(x, stop_gradient=False)
+    y = paddle.fft.irfft(paddle.fft.rfft(t2), n=16).sum()
+    y.backward()
+    assert t2.grad is not None
+    np.testing.assert_allclose(t2.grad.numpy(), np.ones_like(x), atol=1e-4)
+
+
+def test_fft2_fftn_freq_shift():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((3, 8, 8)).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.fft.fft2(t).numpy(), np.fft.fft2(x),
+                               atol=1e-4)
+    np.testing.assert_allclose(paddle.fft.fftn(t).numpy(), np.fft.fftn(x),
+                               atol=1e-3)
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, d=0.5).astype(np.float32),
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        paddle.fft.fftshift(paddle.fft.ifftshift(t)).numpy(), x, atol=1e-6)
+
+
+# ---------------------------------------------------------------- signal --
+
+def test_stft_istft_roundtrip():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 512)).astype(np.float32)
+    t = paddle.to_tensor(x)
+    n_fft, hop = 64, 16
+    import paddle_tpu.signal as signal
+    win = paddle.to_tensor(np.hanning(n_fft).astype(np.float32))
+    spec = signal.stft(t, n_fft=n_fft, hop_length=hop, window=win)
+    assert spec.shape[1] == n_fft // 2 + 1
+    rec = signal.istft(spec, n_fft=n_fft, hop_length=hop, window=win,
+                       length=512)
+    np.testing.assert_allclose(rec.numpy(), x, atol=1e-3)
+
+
+def test_stft_matches_numpy_frames():
+    x = np.arange(128, dtype=np.float32) / 128.0
+    import paddle_tpu.signal as signal
+    spec = signal.stft(paddle.to_tensor(x), n_fft=32, hop_length=8,
+                       center=False).numpy()
+    # frame 0 == rfft of first 32 samples (rectangular window)
+    np.testing.assert_allclose(spec[:, 0], np.fft.rfft(x[:32]), atol=1e-4)
+
+
+# ------------------------------------------------------------- geometric --
+
+def test_geometric_segment_ops():
+    import paddle_tpu.geometric as geo
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                     np.float32))
+    seg = paddle.to_tensor(np.array([0, 0, 1]))
+    np.testing.assert_allclose(geo.segment_sum(data, seg).numpy(),
+                               [[4., 6.], [5., 6.]])
+    np.testing.assert_allclose(geo.segment_mean(data, seg).numpy(),
+                               [[2., 3.], [5., 6.]])
+    np.testing.assert_allclose(geo.segment_max(data, seg).numpy(),
+                               [[3., 4.], [5., 6.]])
+    np.testing.assert_allclose(geo.segment_min(data, seg).numpy(),
+                               [[1., 2.], [5., 6.]])
+
+
+def test_geometric_send_recv():
+    import paddle_tpu.geometric as geo
+    x = paddle.to_tensor(np.array([[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]],
+                                  np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+    out = geo.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(),
+                               [[0., 2., 3.], [2., 8., 10.], [1., 4., 5.]])
+    # grad flows to x
+    x.stop_gradient = False
+    geo.send_u_recv(x, src, dst, reduce_op="sum").sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy().sum(), 12.0)
+
+    e = paddle.to_tensor(np.ones((4, 3), np.float32))
+    out2 = geo.send_ue_recv(x, e, src, dst, message_op="add", reduce_op="sum")
+    np.testing.assert_allclose(out2.numpy(),
+                               [[1., 3., 4.], [4., 10., 12.], [2., 5., 6.]])
+    uv = geo.send_uv(x, x, src, dst, message_op="add")
+    assert uv.shape == [4, 3]
+
+
+def test_geometric_reindex_and_sampling():
+    import paddle_tpu.geometric as geo
+    x = paddle.to_tensor(np.array([0, 1, 2]))
+    neighbors = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7]))
+    count = paddle.to_tensor(np.array([2, 3, 2], np.int32))
+    src, dst, nodes = geo.reindex_graph(x, neighbors, count)
+    assert nodes.numpy()[:3].tolist() == [0, 1, 2]
+    assert len(src.numpy()) == 7 and len(dst.numpy()) == 7
+    # every reindexed src maps back to the original neighbor id
+    np.testing.assert_array_equal(nodes.numpy()[src.numpy()],
+                                  neighbors.numpy())
+    np.testing.assert_array_equal(dst.numpy(),
+                                  [0, 0, 1, 1, 1, 2, 2])
+
+    # CSR: node0 -> {1,2}, node1 -> {2}, node2 -> {}
+    row = paddle.to_tensor(np.array([1, 2, 2]))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 3]))
+    nodes_in = paddle.to_tensor(np.array([0, 1, 2]))
+    neigh, cnt = geo.sample_neighbors(row, colptr, nodes_in, sample_size=1)
+    assert cnt.numpy().tolist() == [1, 1, 0]
+    w = paddle.to_tensor(np.array([0.1, 0.9, 1.0], np.float32))
+    neigh2, cnt2 = geo.weighted_sample_neighbors(row, colptr, w, nodes_in,
+                                                 sample_size=-1)
+    assert cnt2.numpy().tolist() == [2, 1, 0]
+
+
+# ------------------------------------------------- sysconfig / batch / hub --
+
+def test_sysconfig():
+    inc = paddle.sysconfig.get_include()
+    assert os.path.isdir(inc)  # csrc ships headers/sources
+    assert isinstance(paddle.sysconfig.get_lib(), str)
+
+
+def test_batch():
+    def reader():
+        yield from range(7)
+
+    batches = list(paddle.batch(reader, batch_size=3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    batches = list(paddle.batch(reader, batch_size=3, drop_last=True)())
+    assert batches == [[0, 1, 2], [3, 4, 5]]
+    with pytest.raises(ValueError):
+        paddle.batch(reader, 0)
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny_model(scale=1):\n"
+        "    'returns scale*2'\n"
+        "    return scale * 2\n")
+    assert paddle.hub.list(str(tmp_path), source='local') == ['tiny_model']
+    assert 'returns' in paddle.hub.help(str(tmp_path), 'tiny_model',
+                                        source='local')
+    assert paddle.hub.load(str(tmp_path), 'tiny_model', source='local',
+                           scale=3) == 6
+    with pytest.raises(RuntimeError):
+        paddle.hub.load('owner/nonexistent_repo', 'x', source='github')
+
+
+# ---------------------------------------------------------------- dataset --
+
+def test_dataset_common(tmp_path):
+    f = tmp_path / "blob.bin"
+    f.write_bytes(b"hello paddle tpu")
+    md5 = paddle.dataset.common.md5file(str(f))
+    assert len(md5) == 32
+    with pytest.raises(RuntimeError):
+        paddle.dataset.common.download("http://x/y.tgz", "nope")
+
+
+def test_dataset_uci_housing(tmp_path, monkeypatch):
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((50, 14)).astype(np.float32)
+    path = tmp_path / "housing.data"
+    np.savetxt(path, data)
+    tr = paddle.dataset.uci_housing.train(path=str(path))
+    rows = list(tr())
+    assert len(rows) == 40
+    feats, target = rows[0]
+    assert feats.shape == (13,) and target.shape == (1,)
+    te = list(paddle.dataset.uci_housing.test(path=str(path))())
+    assert len(te) == 10
+
+
+# -------------------------------------------------------------- inference --
+
+def test_inference_predictor(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.save_load import InputSpec, save
+
+    paddle.seed(0)
+    layer = nn.Linear(4, 3)
+    prefix = str(tmp_path / "deploy" / "model")
+    save(layer, prefix, input_spec=[InputSpec([None, 4], "float32", "x")])
+
+    from paddle_tpu import inference as infer
+    cfg = infer.Config(prefix)
+    assert "model" in cfg.summary()
+    pred = infer.create_predictor(cfg)
+    names = pred.get_input_names()
+    assert names == ["x"]
+    x = np.random.default_rng(1).standard_normal((2, 4)).astype(np.float32)
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    outs = pred.run()
+    ref = layer(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(outs[0], ref, atol=1e-5)
+    out_h = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(out_h.copy_to_cpu(), ref, atol=1e-5)
+
+    assert infer.get_num_bytes_of_data_type(infer.DataType.FLOAT32) == 4
+    assert "paddle_tpu" in infer.get_version()
+
+    # mixed-precision conversion halves param storage but stays callable
+    mixed = str(tmp_path / "deploy" / "model_bf16")
+    infer.convert_to_mixed_precision(
+        prefix + ".pdmodel", None, mixed + ".pdmodel",
+        mixed_precision=infer.PrecisionType.Bfloat16)
+    cfg2 = infer.Config(mixed)
+    pred2 = infer.create_predictor(cfg2)
+    outs2 = pred2.run([x])
+    np.testing.assert_allclose(outs2[0], ref, atol=1e-1)
+
+    pool = infer.PredictorPool(cfg, 2)
+    assert pool.retrieve(1).get_input_names() == ["x"]
+
+
+# ------------------------------------------------------------------- onnx --
+
+def test_onnx_export_gated(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.save_load import InputSpec
+
+    layer = nn.Linear(2, 2)
+    prefix = str(tmp_path / "om")
+    with pytest.raises((RuntimeError, NotImplementedError)):
+        paddle.onnx.export(layer, prefix,
+                           input_spec=[InputSpec([1, 2], "float32", "x")])
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        # without the onnx package the StableHLO fallback must still land
+        assert os.path.exists(prefix + ".pdmodel")

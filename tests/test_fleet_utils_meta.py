@@ -1,0 +1,230 @@
+"""Tests for fleet.utils (fs/log/timer), meta-optimizers (LARS, LocalSGD,
+DGC, GradientMerge), distributed.metric AUC, distributed.utils."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_localfs(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+
+    fs = LocalFS()
+    d = tmp_path / "ckpt"
+    fs.mkdirs(str(d))
+    assert fs.is_dir(str(d))
+    f = d / "a.txt"
+    fs.touch(str(f))
+    assert fs.is_file(str(f)) and fs.is_exist(str(f))
+    dirs, files = fs.ls_dir(str(d))
+    assert files == ["a.txt"] and dirs == []
+    fs.mv(str(f), str(d / "b.txt"))
+    assert not fs.is_exist(str(f))
+    assert fs.list_dirs(str(tmp_path)) == ["ckpt"]
+    fs.delete(str(d))
+    assert not fs.is_exist(str(d))
+    assert fs.need_upload_download() is False
+
+
+def test_hdfs_client_gated():
+    from paddle_tpu.distributed.fleet.utils.fs import ExecuteError, HDFSClient
+
+    cli = HDFSClient(hadoop_home="/nonexistent")
+    with pytest.raises(ExecuteError):
+        cli.mkdirs("/tmp/x")
+
+
+def test_timer_helper():
+    from paddle_tpu.distributed.fleet.utils import get_timers, set_timers
+
+    timers = set_timers()
+    assert get_timers() is timers
+    t = timers("forward")
+    t.start()
+    t.stop()
+    e = t.elapsed(reset=True)
+    assert e >= 0.0
+    timers("forward").start()
+    timers("forward").stop()
+    msg = timers.log(["forward"])
+    assert "forward" in msg
+
+
+def test_log_util():
+    from paddle_tpu.distributed.fleet.utils import log_util
+
+    log_util.set_log_level("DEBUG")
+    assert log_util.logger.level == 10
+    s = log_util.layer_to_str("Linear", 3, 4, bias=True)
+    assert s == "Linear(3, 4, bias=True)"
+
+
+def _quad_problem(opt_factory, steps=30):
+    paddle.seed(0)
+    w = paddle.to_tensor(np.array([2.0, -3.0], np.float32),
+                         stop_gradient=False)
+    w.name = "w"
+    opt = opt_factory([w])
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy()
+
+
+def test_lars_optimizer_converges():
+    from paddle_tpu.distributed.fleet.meta_optimizers import Lars
+
+    # lars_coeff scales the trust ratio ||w||/||g||; for loss w^2 the ratio
+    # is 0.5, so coeff=1.0, lr=0.5 gives a 0.25 contraction per step
+    w = _quad_problem(lambda ps: Lars(learning_rate=0.5, momentum=0.0,
+                                      lars_coeff=1.0, parameters=ps),
+                      steps=30)
+    assert np.abs(w).max() < 0.5
+
+
+def test_gradient_merge_optimizer():
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        GradientMergeOptimizer,
+    )
+
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    w.name = "w"
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    opt = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+    w0 = w.numpy().copy()
+    (w * 3.0).sum().backward()
+    opt.step()  # accumulates, no update
+    np.testing.assert_allclose(w.numpy(), w0)
+    (w * 3.0).sum().backward()
+    opt.step()  # applies averaged grad (3.0)
+    np.testing.assert_allclose(w.numpy(), w0 - 0.1 * 3.0, atol=1e-6)
+
+
+def test_localsgd_optimizer_steps():
+    from paddle_tpu.distributed.fleet.meta_optimizers import LocalSGDOptimizer
+
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    w.name = "w"
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    opt = LocalSGDOptimizer(inner, k_steps=2)
+    for _ in range(4):
+        (w * 1.0).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(w.numpy(), 1.0 - 0.4, atol=1e-6)
+
+
+def test_dgc_optimizer_sparsifies():
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DGCMomentumOptimizer,
+    )
+
+    w = paddle.to_tensor(np.arange(10, dtype=np.float32),
+                         stop_gradient=False)
+    w.name = "w"
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    opt = DGCMomentumOptimizer(inner, momentum=0.0, sparsity=[0.8])
+    g = np.arange(1.0, 11.0, dtype=np.float32)  # largest entries at the end
+    loss = (w * paddle.to_tensor(g)).sum()
+    loss.backward()
+    opt.step()
+    moved = np.nonzero(w.numpy() != np.arange(10, dtype=np.float32))[0]
+    assert 1 <= len(moved) <= 3  # top ~20% of 10 entries
+    assert 9 in moved  # the largest gradient element must be sent
+    # error feedback holds the rest for later steps
+    loss = (w * paddle.to_tensor(g)).sum()
+    loss.backward()
+    opt.step()
+    assert len(opt._e) == 1
+
+
+def test_strategy_meta_optimizer_wiring():
+    strat = paddle.distributed.fleet.DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    strat.dgc = True
+    assert strat.gradient_merge_configs.k_steps == 2
+    d = strat.to_dict()
+    assert d["dgc"] is True and "lars_configs" in d
+
+
+def test_fleet_distributed_optimizer_meta_wiring():
+    """strategy.{lars,dgc,localsgd,gradient_merge} flags must select the
+    meta-optimizer wrappers through fleet.distributed_optimizer and the
+    resulting chain must actually step."""
+    from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DGCMomentumOptimizer, GradientMergeOptimizer, Lars, LocalSGDOptimizer,
+    )
+
+    strat = DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1}
+    strat.lars = True
+    strat.dgc = True
+    strat.localsgd = True
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 1, "avg": True}
+    fleet.init(is_collective=True, strategy=strat)
+
+    w = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    w.name = "w"
+    inner = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                      parameters=[w])
+    opt = fleet.distributed_optimizer(inner, strategy=strat)
+    # unwrap the chain: HybridParallelOptimizer -> GradientMerge -> LocalSGD
+    # -> DGC -> Lars
+    chain = opt._inner_opt
+    seen = [type(chain)]
+    while hasattr(chain, "_inner"):
+        chain = chain._inner
+        seen.append(type(chain))
+    assert GradientMergeOptimizer in seen
+    assert LocalSGDOptimizer in seen
+    assert DGCMomentumOptimizer in seen
+    assert isinstance(chain, Lars)
+
+    w0 = w.numpy().copy()
+    (w * w).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    assert not np.allclose(w.numpy(), w0)  # the full chain applied an update
+
+
+def test_distributed_auc():
+    from paddle_tpu.distributed.metric import DistributedAuc, global_auc
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, 2000)
+    # informative predictions: positives skew high
+    preds = np.clip(labels * 0.4 + rng.random(2000) * 0.6, 0, 1)
+    auc = DistributedAuc(num_thresholds=1 << 12)
+    auc.update(preds, labels)
+    got = auc.calculate()
+
+    # exact AUC by rank statistic
+    order = np.argsort(preds)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(preds) + 1)
+    n_pos = labels.sum()
+    n_neg = len(labels) - n_pos
+    exact = (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    assert abs(got - exact) < 5e-3
+    assert 0.4 < global_auc(preds, labels) < 1.0
+    auc.reset()
+    assert auc.calculate() == 0.5
+
+
+def test_distributed_utils_global_scatter():
+    from paddle_tpu.distributed.utils import global_gather, global_scatter
+
+    x = paddle.to_tensor(np.ones((4, 3), np.float32))
+    lc = paddle.to_tensor(np.array([4], np.int64))
+    gc = paddle.to_tensor(np.array([4], np.int64))
+    out = global_scatter(x, lc, gc)
+    np.testing.assert_allclose(out.numpy(), np.ones((4, 3), np.float32))
+    out2 = global_gather(x, lc, gc)
+    np.testing.assert_allclose(out2.numpy(), np.ones((4, 3), np.float32))
