@@ -2,3 +2,16 @@ from .grad_mode import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  
 from .engine import backward, grad  # noqa: F401
 from .function import apply, apply_multi, GradNode  # noqa: F401
 from .pylayer import PyLayer, PyLayerContext  # noqa: F401
+_FUNCTIONAL = ("Hessian", "Jacobian", "hessian", "jacobian", "jvp", "vhp",
+               "vjp")
+
+
+def __getattr__(name):
+    # functional AD imports core.tensor, which imports this package during
+    # core bootstrap — resolve lazily to break the cycle
+    if name in _FUNCTIONAL:
+        from . import functional as _f
+        val = getattr(_f, name)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module 'paddle_tpu.autograd' has no attribute {name!r}")

@@ -1,0 +1,250 @@
+"""Higher-order functional autograd (reference:
+python/paddle/incubate/autograd/primapi.py:25,108 forward_grad/grad and
+functional.py jvp/vjp/Jacobian/Hessian; C++ double-grad via
+prim/composite vjp rules).
+
+TPU-native realization: instead of re-running a taped graph, the callable is
+lifted to a pure jax function over the Tensor arrays and differentiated with
+jax's functional transforms — `jvp` (forward mode), `vjp` (reverse mode),
+`jacfwd/jacrev` (full Jacobians), composed for Hessians. All of it nests
+under `jit` and `grad`, which is exactly the property the reference's prim
+machinery exists to approximate."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = ['jvp', 'vjp', 'vhp', 'jacobian', 'hessian', 'Jacobian', 'Hessian']
+
+
+def _tensors(xs):
+    if isinstance(xs, (tuple, list)):
+        return [as_tensor(x) for x in xs], True
+    return [as_tensor(xs)], False
+
+
+def _pure(func):
+    """Lift a Tensor->Tensor callable to arrays->arrays; records whether the
+    output was a tuple so callers can mirror the structure."""
+    meta = {}
+
+    def f(*arrs):
+        ins = [Tensor(a, stop_gradient=False) for a in arrs]
+        out = func(*ins)
+        if isinstance(out, (tuple, list)):
+            meta['multi_out'] = True
+            return tuple(o._data for o in out)
+        meta['multi_out'] = False
+        return out._data
+
+    return f, meta
+
+
+def _wrap(arrs, multi):
+    if multi:
+        return tuple(Tensor(a, stop_gradient=True) for a in arrs)
+    return Tensor(arrs, stop_gradient=True)
+
+
+def jvp(func, xs, v=None, name=None):
+    """Forward-mode Jacobian-vector product → (func(xs), J·v).
+
+    v defaults to ones (reference incubate/autograd/functional.py jvp)."""
+    ts, multi_in = _tensors(xs)
+    f, meta = _pure(func)
+    arrs = [t._data for t in ts]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrs]
+    else:
+        vts, _ = _tensors(v)
+        tangents = [t._data.astype(a.dtype)
+                    for t, a in zip(vts, arrs)]
+    out, tangent_out = jax.jvp(f, tuple(arrs), tuple(tangents))
+    mo = meta['multi_out']
+    return _wrap(out, mo), _wrap(tangent_out, mo)
+
+
+def vjp(func, xs, v=None, name=None):
+    """Reverse-mode vector-Jacobian product → (func(xs), vᵀ·J)."""
+    ts, multi_in = _tensors(xs)
+    f, meta = _pure(func)
+    arrs = [t._data for t in ts]
+    out, pullback = jax.vjp(f, *arrs)
+    mo = meta['multi_out']
+    if v is None:
+        cot = (tuple(jnp.ones_like(o) for o in out) if mo
+               else jnp.ones_like(out))
+    else:
+        vts, v_multi = _tensors(v)
+        cot = (tuple(t._data for t in vts) if mo
+               else vts[0]._data)
+    grads = pullback(cot)  # tuple, one entry per positional input
+    return _wrap(out, mo), _wrap(grads if multi_in else grads[0], multi_in)
+
+
+def _structured_transform(build_fn, ts, name, create_graph):
+    """Run a jax transform producing an arbitrary pytree of arrays and
+    return the same structure with Tensor leaves.
+
+    create_graph=True routes the whole transform through apply_multi so the
+    result carries a GradNode — higher-order backward() into the inputs
+    works; otherwise the leaves are detached (reference create_graph
+    semantics)."""
+    meta = {}
+
+    def flat_fn(*arrs):
+        tree = build_fn(*arrs)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        meta['treedef'] = treedef
+        return tuple(leaves)
+
+    if create_graph:
+        from .function import apply_multi
+        outs = apply_multi(flat_fn, *ts, name=name)
+    else:
+        arrs = flat_fn(*[t._data for t in ts])
+        outs = tuple(Tensor(a, stop_gradient=True) for a in arrs)
+    return jax.tree_util.tree_unflatten(meta['treedef'], list(outs))
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False, name=None):
+    """Full Jacobian of ``func`` at ``xs`` (reverse mode, one row per output
+    element). Multiple inputs → tuple of Jacobians; with create_graph=True
+    the result stays differentiable (double backward)."""
+    ts, multi_in = _tensors(xs)
+    f, _ = _pure(func)
+    argnums = tuple(range(len(ts)))
+
+    def build(*arrs):
+        jac = jax.jacrev(f, argnums=argnums)(*arrs)
+        # normalize: per-output (if tuple) per-input
+        if isinstance(jac, tuple) and jac and isinstance(jac[0], tuple):
+            return tuple(j if multi_in else j[0] for j in jac)
+        j = jac if isinstance(jac, tuple) else (jac,)
+        return j if multi_in else j[0]
+
+    return _structured_transform(build, ts, "jacobian", create_graph)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False, name=None):
+    """Hessian of a scalar-output ``func``: forward-over-reverse
+    (jacfwd∘jacrev), the memory-lean composition on TPU."""
+    ts, multi_in = _tensors(xs)
+    f, _ = _pure(func)
+
+    def scalar_f(*arrs):
+        out = f(*arrs)
+        if isinstance(out, tuple):
+            out = out[0]
+        return jnp.sum(out)  # reference requires scalar output; sum guards
+
+    argnums = tuple(range(len(ts)))
+
+    def build(*arrs):
+        h = jax.jacfwd(jax.jacrev(scalar_f, argnums=argnums),
+                       argnums=argnums)(*arrs)
+        if multi_in:
+            return tuple(tuple(b for b in row) for row in h)
+        return h[0][0]
+
+    return _structured_transform(build, ts, "hessian", create_graph)
+
+
+def vhp(func, xs, v=None, name=None):
+    """Vector-Hessian product → (func(xs), Hᵀ·v) for scalar-output func."""
+    ts, multi_in = _tensors(xs)
+    f, _ = _pure(func)
+
+    def scalar_f(*arrs):
+        out = f(*arrs)
+        if isinstance(out, tuple):
+            out = out[0]
+        return jnp.sum(out)
+
+    arrs = [t._data for t in ts]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrs]
+    else:
+        vts, _ = _tensors(v)
+        tangents = [t._data for t in vts]
+    grad_f = jax.grad(scalar_f, argnums=tuple(range(len(arrs))))
+    out = scalar_f(*arrs)
+    _, hvp = jax.jvp(grad_f, tuple(arrs), tuple(tangents))
+    return (Tensor(out, stop_gradient=True),
+            _wrap(hvp if multi_in else hvp[0], multi_in))
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (reference incubate/autograd Jacobian): computed
+    once on first access, indexable like a 2-D (or batched 3-D) tensor."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        ts, multi_in = _tensors(self._xs)
+        f, _ = _pure(self._func)
+
+        if self._is_batched:
+            # batch axis 0 stays; differentiate per sample
+            def per_sample(*arrs):
+                return f(*arrs)
+            jac_fn = jax.vmap(jax.jacrev(per_sample,
+                                         argnums=tuple(range(len(ts)))))
+        else:
+            jac_fn = jax.jacrev(f, argnums=tuple(range(len(ts))))
+        jac = jac_fn(*[t._data for t in ts])
+        parts = jac if isinstance(jac, tuple) else (jac,)
+        flat = []
+        for p, t in zip(parts, ts):
+            if self._is_batched:
+                # vmap(jacrev) → (B, *out_shape, *in_shape_per_sample)
+                b = p.shape[0]
+                in_sz = max(1, t._data.size // t._data.shape[0])
+                flat.append(p.reshape(b, -1, in_sz))
+            else:
+                flat.append(p.reshape(-1, t._data.size))
+        self._mat = Tensor(jnp.concatenate(flat, axis=-1))
+        return self._mat
+
+    def __getitem__(self, idx):
+        return self._compute()[idx]
+
+    @property
+    def shape(self):
+        return self._compute().shape
+
+    def numpy(self):
+        return self._compute().numpy()
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian of a scalar-output func (reference incubate/autograd
+    Hessian)."""
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        h = hessian(self._func, self._xs)
+        if isinstance(h, tuple):  # multiple inputs: block matrix
+            rows = []
+            ts, _ = _tensors(self._xs)
+            for i, row in enumerate(h):
+                cols = [b._data.reshape(ts[i]._data.size,
+                                        ts[j]._data.size)
+                        for j, b in enumerate(row)]
+                rows.append(jnp.concatenate(cols, axis=1))
+            self._mat = Tensor(jnp.concatenate(rows, axis=0))
+        else:
+            ts, _ = _tensors(self._xs)
+            n = ts[0]._data.size
+            self._mat = Tensor(h._data.reshape(n, n))
+        return self._mat

@@ -1,3 +1,5 @@
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,44 @@
+"""`paddle.incubate.autograd` (reference:
+python/paddle/incubate/autograd/__init__.py — primapi forward_grad/grad +
+functional jvp/vjp/Jacobian/Hessian over the primitive-op program).
+
+TPU build: jax's functional transforms ARE the primitive system, so these
+re-export paddle_tpu.autograd.functional; `enable_prim`/`disable_prim` are
+accepted no-ops (every op here already lowers to differentiable
+primitives)."""
+
+from __future__ import annotations
+
+from ...autograd.functional import (  # noqa: F401
+    Hessian, Jacobian, hessian, jacobian, jvp, vhp, vjp,
+)
+
+__all__ = ['jvp', 'vjp', 'vhp', 'jacobian', 'hessian', 'Jacobian', 'Hessian',
+           'forward_grad', 'grad', 'enable_prim', 'disable_prim',
+           'prim_enabled']
+
+_PRIM = {'on': True}
+
+
+def enable_prim():
+    _PRIM['on'] = True
+
+
+def disable_prim():
+    _PRIM['on'] = False
+
+
+def prim_enabled():
+    return _PRIM['on']
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode gradient (reference primapi.py:25 forward_grad over the
+    primitive program): returns J·v only."""
+    return jvp(func, xs, v)[1]
+
+
+def grad(func, xs, v=None):
+    """Reverse-mode gradient of ``func`` at ``xs`` (reference primapi.py:108):
+    returns vᵀ·J only."""
+    return vjp(func, xs, v)[1]

@@ -1,0 +1,127 @@
+"""Epoch-level automatic train resumption (reference:
+python/paddle/base/incubate/checkpoint/auto_checkpoint.py —
+`train_epoch_range` generator that checkpoints per-epoch progress to a
+filesystem and fast-forwards past completed epochs on restart).
+
+TPU build: the same contract over the fleet fs abstraction. Usage:
+
+    for epoch in train_epoch_range(10, save_checkpoint_inter=0):
+        train_one_epoch()
+        # attach model/optimizer state with epoch_range.save(...)
+
+On relaunch with the same PADDLE_JOB_ID the range resumes after the last
+completed epoch, restoring any attached state."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ['train_epoch_range', 'TrainEpochRange', 'get_checkpoint_path',
+           'current_epoch_range']
+
+_CURRENT = None
+
+
+def current_epoch_range():
+    """The TrainEpochRange currently iterating (reference
+    g_train_epoch_range accessor), or None outside a loop."""
+    return _CURRENT
+
+
+def get_checkpoint_path(name=None):
+    root = os.environ.get(
+        'PADDLE_TPU_CHECKPOINT_DIR',
+        os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu',
+                     'auto_checkpoint'))
+    job = name or os.environ.get('PADDLE_JOB_ID', 'default_job')
+    return os.path.join(root, job)
+
+
+class TrainEpochRange:
+    """Iterable over epochs that persists progress (reference
+    TrainEpochRange: _serial_load/save around an epoch loop)."""
+
+    def __init__(self, max_epoch_num, name=None, save_checkpoint_inter=None):
+        self._max = int(max_epoch_num)
+        self._name = name
+        self._dir = get_checkpoint_path(name)
+        self._meta_path = os.path.join(self._dir, 'range_meta.json')
+        self._inter = save_checkpoint_inter  # seconds between saves; 0=every
+        self._last_save = 0.0
+        self._restored_epoch = -1
+        self._state_objs = {}
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                meta = json.load(f)
+            if meta.get('max_epoch_num') == self._max:
+                self._restored_epoch = int(meta.get('epoch', -1))
+
+    # -- attachable state -------------------------------------------------
+    def attach(self, **named):
+        """Attach objects with state_dict/set_state_dict (layers,
+        optimizers); their state rides each epoch checkpoint."""
+        self._state_objs.update(named)
+        if self._restored_epoch >= 0:
+            self._restore_states()
+        return self
+
+    def _state_file(self):
+        return os.path.join(self._dir, 'states.pdparams')
+
+    def _restore_states(self):
+        path = self._state_file()
+        if not os.path.exists(path) or not self._state_objs:
+            return
+        from ...framework.io import load
+        blob = load(path)
+        for k, obj in self._state_objs.items():
+            if k in blob and hasattr(obj, 'set_state_dict'):
+                obj.set_state_dict(blob[k])
+
+    def _save(self, epoch, force=False):
+        now = time.monotonic()
+        if not force and self._inter and (now - self._last_save) < self._inter:
+            return
+        self._last_save = now
+        os.makedirs(self._dir, exist_ok=True)
+        if self._state_objs:
+            from ...framework.io import save
+            save({k: obj.state_dict()
+                  for k, obj in self._state_objs.items()},
+                 self._state_file())
+        tmp = self._meta_path + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump({'epoch': epoch, 'max_epoch_num': self._max,
+                       'ts': time.time()}, f)
+        os.replace(tmp, self._meta_path)  # atomic commit marker
+
+    @property
+    def restored_from(self):
+        return self._restored_epoch
+
+    def __iter__(self):
+        global _CURRENT
+        _CURRENT = self
+        try:
+            for e in range(self._restored_epoch + 1, self._max):
+                yield e
+                # the final epoch always commits: interval throttling must
+                # not leave a cleanly-finished job looking unfinished. A
+                # crash or break mid-epoch deliberately does NOT flush —
+                # the live state is mid-epoch and must not be recorded as
+                # a completed one.
+                self._save(e, force=(e == self._max - 1))
+        finally:
+            _CURRENT = None
+
+    def clean(self):
+        import shutil
+        if os.path.isdir(self._dir):
+            shutil.rmtree(self._dir)
+
+
+def train_epoch_range(max_epoch_num, name=None, save_checkpoint_inter=None):
+    return TrainEpochRange(max_epoch_num, name=name,
+                           save_checkpoint_inter=save_checkpoint_inter)

@@ -2,3 +2,4 @@
 python/paddle/incubate/nn/). The fused layers map onto XLA-fused composites /
 pallas kernels."""
 from . import functional  # noqa: F401
+from .functional import memory_efficient_attention  # noqa: F401

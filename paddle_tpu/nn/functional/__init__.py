@@ -32,7 +32,8 @@ __all__ = list(_act_all) + list(_loss_all) + list(_conv_all) + list(_pool_all) +
     "batch_norm", "group_norm",
     "instance_norm", "normalize", "dropout", "dropout2d", "dropout3d",
     "alpha_dropout", "cosine_similarity", "pairwise_distance", "one_hot", "pad",
-    "scaled_dot_product_attention", "interpolate", "upsample", "pixel_shuffle",
+    "scaled_dot_product_attention", "sparse_attention", "interpolate",
+    "upsample", "pixel_shuffle",
     "unfold", "label_smooth", "sequence_mask", "gumbel_softmax", "rope",
 ]
 
@@ -370,6 +371,76 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
         return jnp.swapaxes(out, 1, 2)
     return apply(f, query, key, value, name="scaled_dot_product_attention")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None) -> Tensor:
+    """Block-sparse attention over a CSR pattern (reference:
+    python/paddle/nn/functional/sparse_attention.py over
+    sparse_attention kernels). query/key/value: [B, H, S, D]; offset
+    [B, H, S+1], columns [B, H, nnz] give each query row's attended keys.
+
+    TPU design: the ragged CSR is expanded host-side to flat (row, col) edge
+    lists (the pattern is static data, exactly how the reference feeds its
+    kernel), then the edge-wise scores are computed densely on the VPU and
+    reduced with segment softmax — no S×S materialization.
+    """
+    import numpy as np
+
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    off = np.asarray(as_tensor(sparse_csr_offset).numpy(), np.int64)
+    cols = np.asarray(as_tensor(sparse_csr_columns).numpy(), np.int64)
+    b, h, s, d = q.shape
+    counts = off[..., 1:] - off[..., :-1]          # [B, H, S]
+    # cols has one fixed nnz per (b,h); expand each CSR offset row to a flat
+    # row-index list of that same length
+    rows = np.stack([np.repeat(np.arange(s), counts[bi, hi])
+                     for bi in range(b) for hi in range(h)]).reshape(b, h, -1)
+    rows_j = jnp.asarray(rows)
+    cols_j = jnp.asarray(cols)
+
+    # key_padding_mask: [B, S] (0/False = padded key); attn_mask: additive
+    # [B, H, S, S] or broadcastable — both gathered down to per-edge values
+    kpm = (as_tensor(key_padding_mask)._data
+           if key_padding_mask is not None else None)
+    am = as_tensor(attn_mask)._data if attn_mask is not None else None
+
+    def f(qa, ka, va):
+        scale = 1.0 / math.sqrt(d)
+        nnz = rows_j.shape[-1]
+        bh_b = jnp.repeat(jnp.arange(b), h)  # batch id per (b*h) slice
+        bh_h = jnp.tile(jnp.arange(h), b)
+
+        def one(qbh, kbh, vbh, r, c, bi, hi):
+            e = jnp.sum(jnp.take(qbh, r, axis=0) * jnp.take(kbh, c, axis=0),
+                        -1) * scale                      # [nnz]
+            e = e.astype(jnp.float32)
+            if am is not None:
+                amb = jnp.broadcast_to(am, (b, h, s, s)).astype(jnp.float32)
+                e = e + amb[bi, hi][r, c]
+            if kpm is not None:
+                keep = jnp.broadcast_to(kpm, (b, s))[bi]
+                if jnp.issubdtype(keep.dtype, jnp.bool_):
+                    dead = ~jnp.take(keep, c)
+                else:
+                    dead = jnp.take(keep, c) == 0
+                e = jnp.where(dead, -jnp.inf, e)
+            m = jax.ops.segment_max(e, r, num_segments=s)
+            m = jnp.where(jnp.isfinite(m), m, 0.0)  # fully-masked rows
+            p = jnp.exp(e - jnp.take(m, r))
+            z = jax.ops.segment_sum(p, r, num_segments=s)
+            w = p / jnp.take(jnp.maximum(z, 1e-30), r)
+            return jax.ops.segment_sum(
+                w[:, None].astype(vbh.dtype) * jnp.take(vbh, c, axis=0), r,
+                num_segments=s)
+
+        flat = jax.vmap(one)(qa.reshape(b * h, s, d), ka.reshape(b * h, s, d),
+                             va.reshape(b * h, s, d),
+                             rows_j.reshape(b * h, -1),
+                             cols_j.reshape(b * h, -1), bh_b, bh_h)
+        return flat.reshape(b, h, s, d)
+
+    return apply(f, q, k, v, name="sparse_attention")
 
 
 def rope(q, k, sin, cos, name=None):

@@ -1,0 +1,40 @@
+"""`paddle.nn.quant` (reference: python/paddle/nn/quant/ — quant layer
+surface: Stub, quant/dequant helpers, weight-only linear). The actual
+quantization machinery lives in paddle_tpu.quantization; this namespace is
+the layer-level entry the reference exposes."""
+
+from __future__ import annotations
+
+from ...quantization.quanters import FakeQuanterWithAbsMaxObserver  # noqa: F401
+from ...quantization.wrapper import Int8WeightOnlyLinear, QuantedLinear  # noqa: F401
+from ...quantization.functional import (  # noqa: F401
+    absmax_scale,
+    dequant_matmul_int8,
+    fake_quant,
+    quantize_weight_int8 as weight_quantize,
+)
+from ..layer import Layer
+
+__all__ = ['Stub', 'QuantStub', 'weight_quantize', 'fake_quant',
+           'absmax_scale', 'dequant_matmul_int8',
+           'QuantedLinear', 'Int8WeightOnlyLinear',
+           'FakeQuanterWithAbsMaxObserver', 'quant_layers']
+
+
+class Stub(Layer):
+    """Observer insertion point (reference nn/quant/stub.py Stub): identity
+    in float graphs; the QAT pass replaces it with the configured quanter."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+QuantStub = Stub
+
+
+def quant_layers():
+    return [QuantedLinear, Int8WeightOnlyLinear]

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from ..autograd.function import apply
 from ..core.tensor import Tensor, as_tensor
 
-__all__ = ["nms", "box_area", "box_iou"]
+__all__ = ["nms", "box_area", "box_iou", "roi_align", "roi_pool", "deform_conv2d"]
 
 
 def box_area(boxes):
@@ -75,3 +75,214 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     kept_sorted = order[keep[order]]
     idx = kept_sorted if top_k is None else kept_sorted[:top_k]
     return Tensor(idx, stop_gradient=True)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None) -> Tensor:
+    """RoI Align (reference: python/paddle/vision/ops.py roi_align over
+    phi roi_align kernels). x: [N, C, H, W]; boxes: [R, 4] (x1,y1,x2,y2);
+    boxes_num: [N] rois per image. Bilinear sampling on a fixed grid —
+    gather + weighted sum, fully static shapes for the MXU-friendly path."""
+    import numpy as np
+
+    x_t, boxes_t = as_tensor(x), as_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(as_tensor(boxes_num).numpy(), np.int64)
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(bn)), bn))
+
+    if sampling_ratio > 0:
+        ns = int(sampling_ratio)
+    else:
+        # reference adaptive rule ceil(roi_size / pooled_size), which is
+        # per-RoI; the grid must be static under vmap/jit, so use the max
+        # over the (eager, host-visible) boxes, capped to bound compute
+        bnp = np.asarray(boxes_t.numpy(), np.float64)
+        rh_max = float(np.max((bnp[:, 3] - bnp[:, 1]) * spatial_scale,
+                              initial=1.0))
+        rw_max = float(np.max((bnp[:, 2] - bnp[:, 0]) * spatial_scale,
+                              initial=1.0))
+        ns = int(np.clip(np.ceil(max(rh_max / ph, rw_max / pw)), 1, 8))
+
+    def f(xa, ba):
+        n, c, hgt, wid = xa.shape
+        r = ba.shape[0]
+        half = 0.5 if aligned else 0.0
+        x1 = ba[:, 0] * spatial_scale - half
+        y1 = ba[:, 1] * spatial_scale - half
+        x2 = ba[:, 2] * spatial_scale - half
+        y2 = ba[:, 3] * spatial_scale - half
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sample grid: [R, ph*ns] y coords, [R, pw*ns] x coords
+        iy = (jnp.arange(ph * ns) + 0.5) / ns
+        ix = (jnp.arange(pw * ns) + 0.5) / ns
+        ys = y1[:, None] + bin_h[:, None] * iy[None, :]   # [R, ph*ns]
+        xs = x1[:, None] + bin_w[:, None] * ix[None, :]   # [R, pw*ns]
+
+        def bilinear(img, yy, xx):
+            # img: [C, H, W]; yy: [Sy], xx: [Sx] -> [C, Sy, Sx]
+            # reference bilinear_interpolate: samples beyond (-1, H/W) are
+            # zero, inside ones clamp to the border pixel
+            vy = (yy > -1.0) & (yy < hgt)
+            vx = (xx > -1.0) & (xx < wid)
+            yy = jnp.clip(yy, 0.0, hgt - 1.0)
+            xx = jnp.clip(xx, 0.0, wid - 1.0)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, hgt - 1)
+            x1i = jnp.minimum(x0 + 1, wid - 1)
+            wy = yy - y0
+            wx = xx - x0
+            g = lambda yi, xi: img[:, yi, :][:, :, xi]
+            top = g(y0, x0) * (1 - wx)[None, None, :] + \
+                g(y0, x1i) * wx[None, None, :]
+            bot = g(y1i, x0) * (1 - wx)[None, None, :] + \
+                g(y1i, x1i) * wx[None, None, :]
+            out = top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+            return out * (vy[:, None] & vx[None, :])[None]
+
+        def per_roi(ri):
+            img = xa[img_of_roi[ri]]
+            sampled = bilinear(img, ys[ri], xs[ri])       # [C, ph*ns, pw*ns]
+            return sampled.reshape(c, ph, ns, pw, ns).mean((2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(r))           # [R, C, ph, pw]
+
+    return apply(f, x_t, boxes_t, name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoI max-pool (reference: vision/ops.py roi_pool): roi_align with max
+    reduction semantics approximated by dense bilinear sampling + max."""
+    import numpy as np
+
+    x_t, boxes_t = as_tensor(x), as_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(as_tensor(boxes_num).numpy(), np.int64)
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(bn)), bn))
+
+    def f(xa, ba):
+        n, c, hgt, wid = xa.shape
+        x1 = jnp.floor(ba[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.floor(ba[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.ceil(ba[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.ceil(ba[:, 3] * spatial_scale).astype(jnp.int32)
+
+        ns = 4  # static sample grid per output bin
+        iy = (jnp.arange(ph * ns) + 0.5) / (ph * ns)
+        ix = (jnp.arange(pw * ns) + 0.5) / (pw * ns)
+
+        def per_roi(ri):
+            img = xa[img_of_roi[ri]]
+            hh = jnp.maximum(y2[ri] - y1[ri], 1)
+            ww = jnp.maximum(x2[ri] - x1[ri], 1)
+            yy = jnp.clip(y1[ri] + iy * hh, 0, hgt - 1).astype(jnp.int32)
+            xx = jnp.clip(x1[ri] + ix * ww, 0, wid - 1).astype(jnp.int32)
+            patch = img[:, yy, :][:, :, xx]               # [C, ph*ns, pw*ns]
+            return patch.reshape(c, ph, ns, pw, ns).max((2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(ba.shape[0]))
+
+    return apply(f, x_t, boxes_t, name="roi_pool")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None) -> Tensor:
+    """Deformable convolution v1/v2 (reference: vision/ops.py deform_conv2d
+    over deformable_conv kernels; v2 when mask is given).
+
+    TPU design: deformable sampling = bilinear gather at offset positions,
+    then the conv collapses to one big matmul over the sampled patches
+    (im2col on the gathered taps) — the gather rides the VPU, the contraction
+    the MXU."""
+    x_t, off_t, w_t = as_tensor(x), as_tensor(offset), as_tensor(weight)
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def f(xa, offa, wa, *rest):
+        maska = rest[0] if mask is not None else None
+        ba = rest[-1] if bias is not None else None
+        n, c, hgt, wid = xa.shape
+        co, ci_g, kh, kw = wa.shape
+        out_h = (hgt + 2 * p[0] - dl[0] * (kh - 1) - 1) // s[0] + 1
+        out_w = (wid + 2 * p[1] - dl[1] * (kw - 1) - 1) // s[1] + 1
+        k = kh * kw
+
+        # base sampling grid [out_h, out_w, k] in input coords
+        oy = jnp.arange(out_h) * s[0] - p[0]
+        ox = jnp.arange(out_w) * s[1] - p[1]
+        ky = jnp.arange(kh) * dl[0]
+        kx = jnp.arange(kw) * dl[1]
+        base_y = oy[:, None, None] + ky[None, None, :].repeat(kw, -1) \
+            .reshape(1, 1, k)
+        base_x = ox[None, :, None] + jnp.tile(kx, kh).reshape(1, 1, k)
+
+        # offsets: [N, 2*dg*k, H', W'], (y, x) interleaved per tap
+        offa = offa.reshape(n, deformable_groups, k, 2, out_h, out_w)
+        off_y = offa[:, :, :, 0].transpose(0, 1, 3, 4, 2)  # [N, dg, H', W', k]
+        off_x = offa[:, :, :, 1].transpose(0, 1, 3, 4, 2)
+        sy = base_y[None, None] + off_y
+        sx = base_x[None, None] + off_x
+        if maska is not None:
+            m = maska.reshape(n, deformable_groups, k, out_h, out_w) \
+                .transpose(0, 1, 3, 4, 2)
+        else:
+            m = jnp.ones_like(sy)
+
+        cpg = c // deformable_groups  # channels per deformable group
+
+        def sample_img(img, syi, sxi, mi):
+            # img [cpg, H, W]; syi/sxi/mi [H', W', k] -> [cpg, H', W', k]
+            valid = (syi > -1) & (syi < hgt) & (sxi > -1) & (sxi < wid)
+            yy = jnp.clip(syi, 0.0, hgt - 1.0)
+            xx = jnp.clip(sxi, 0.0, wid - 1.0)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, hgt - 1)
+            x1i = jnp.minimum(x0 + 1, wid - 1)
+            wy = yy - y0
+            wx = xx - x0
+            flat = img.reshape(cpg, -1)
+            gidx = lambda yi, xi: jnp.take(flat, (yi * wid + xi).reshape(-1),
+                                           axis=1).reshape(cpg, *yi.shape)
+            val = (gidx(y0, x0) * ((1 - wy) * (1 - wx))[None] +
+                   gidx(y0, x1i) * ((1 - wy) * wx)[None] +
+                   gidx(y1i, x0) * (wy * (1 - wx))[None] +
+                   gidx(y1i, x1i) * (wy * wx)[None])
+            return val * (valid * mi)[None]
+
+        def per_n(xi, syi, sxi, mi):
+            # xi [c,H,W] split into dg groups
+            xg = xi.reshape(deformable_groups, cpg, hgt, wid)
+            cols = jax.vmap(sample_img)(xg, syi, sxi, mi)
+            return cols.reshape(c, out_h, out_w, k)
+
+        cols = jax.vmap(per_n)(xa, sy, sx, m)  # [N, C, H', W', K]
+        # grouped conv as matmul: out[n,co,hw] = W[co, ci_g*k] @ cols
+        cpg_conv = c // groups
+        cols_g = cols.reshape(n, groups, cpg_conv, out_h * out_w, k)
+        w_g = wa.reshape(groups, co // groups, ci_g, kh * kw)
+        out = jnp.einsum("ngchk,gock->ngoh", cols_g, w_g,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(n, co, out_h, out_w).astype(xa.dtype)
+        if ba is not None:
+            out = out + ba.reshape(1, co, 1, 1)
+        return out
+
+    args = [x_t, off_t, w_t]
+    if mask is not None:
+        args.append(as_tensor(mask))
+    if bias is not None:
+        args.append(as_tensor(bias))
+    return apply(f, *args, name="deform_conv2d")

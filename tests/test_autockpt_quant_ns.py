@@ -1,0 +1,80 @@
+"""Tests for paddle.nn.quant namespace and incubate auto_checkpoint."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_nn_quant_namespace():
+    from paddle_tpu.nn import quant
+
+    stub = quant.Stub()
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    np.testing.assert_allclose(stub(x).numpy(), 1.0)
+    assert quant.QuantedLinear in quant.quant_layers()
+    w = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    q, scales = quant.weight_quantize(w)
+    assert np.asarray(q).dtype == np.int8
+    assert float(quant.absmax_scale(w)) > 0
+    # int8 matmul round-trips within quantization error
+    x_in = np.random.default_rng(1).standard_normal((3, 4)).astype(np.float32)
+    y = np.asarray(quant.dequant_matmul_int8(x_in, q, scales))
+    np.testing.assert_allclose(y, x_in @ w, atol=0.15)
+
+
+def test_auto_checkpoint_resume(tmp_path, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_CHECKPOINT_DIR', str(tmp_path))
+    monkeypatch.setenv('PADDLE_JOB_ID', 'job_x')
+    from paddle_tpu.incubate.checkpoint import auto_checkpoint as ac
+
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+
+    # first run: crash after epoch 2 (epochs 0,1,2 completed)
+    seen = []
+    r = ac.train_epoch_range(5, save_checkpoint_inter=0).attach(model=lin,
+                                                                opt=opt)
+    try:
+        for e in r:
+            seen.append(e)
+            # mutate a param so restore is observable
+            p = lin.weight
+            p._data = p._data + 1.0
+            if e == 2:
+                raise RuntimeError("simulated crash")
+    except RuntimeError:
+        pass
+    assert seen == [0, 1, 2]
+    # epoch 2 crashed mid-body: its mutation is NOT checkpointed; the saved
+    # state is the end of epoch 1 (+2.0 over the original init)
+    w_saved = lin.weight.numpy() - 1.0
+
+    # second run: fresh objects, resumes at epoch 2 with restored state
+    lin2 = nn.Linear(2, 2)
+    opt2 = paddle.optimizer.SGD(0.1, parameters=lin2.parameters())
+    r2 = ac.train_epoch_range(5, save_checkpoint_inter=0).attach(model=lin2,
+                                                                 opt=opt2)
+    assert r2.restored_from == 1
+    np.testing.assert_allclose(lin2.weight.numpy(), w_saved)
+    seen2 = list(r2)
+    assert seen2 == [2, 3, 4]
+    r2.clean()
+    assert not os.path.isdir(ac.get_checkpoint_path())
+
+
+def test_auto_checkpoint_throttled_final_flush(tmp_path, monkeypatch):
+    monkeypatch.setenv('PADDLE_TPU_CHECKPOINT_DIR', str(tmp_path))
+    monkeypatch.setenv('PADDLE_JOB_ID', 'job_throttle')
+    from paddle_tpu.incubate.checkpoint import auto_checkpoint as ac
+
+    # huge save interval: intermediate epochs are throttled, but a cleanly
+    # finished range must still record its last epoch
+    r = ac.train_epoch_range(4, save_checkpoint_inter=3600)
+    assert list(r) == [0, 1, 2, 3]
+    r2 = ac.train_epoch_range(4, save_checkpoint_inter=3600)
+    assert r2.restored_from == 3
+    assert list(r2) == []
+    assert ac.current_epoch_range() is None

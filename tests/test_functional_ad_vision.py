@@ -1,0 +1,291 @@
+"""Tests for higher-order functional autograd (jvp/vjp/jacobian/hessian),
+memory-efficient + sparse attention, and the new vision ops."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ------------------------------------------------------- functional AD --
+
+def test_jvp_vjp():
+    from paddle_tpu.autograd import jvp, vjp
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+
+    def func(t):
+        return (t * t).sum()
+
+    out, tangent = jvp(func, x, paddle.to_tensor(np.ones(3, np.float32)))
+    assert out.numpy() == pytest.approx(14.0)
+    assert tangent.numpy() == pytest.approx(12.0)  # sum(2x)
+
+    out2, grads = vjp(func, x)
+    np.testing.assert_allclose(grads.numpy(), [2.0, 4.0, 6.0], atol=1e-6)
+
+
+def test_jacobian_hessian():
+    from paddle_tpu.autograd import hessian, jacobian
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+
+    def func(t):
+        return t * t  # elementwise -> diagonal jacobian
+
+    J = jacobian(func, x)
+    np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0]), atol=1e-6)
+
+    def scalar(t):
+        return (t ** 3).sum()
+
+    H = hessian(scalar, x)
+    np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]), atol=1e-5)
+
+
+def test_jacobian_multi_input_and_vhp():
+    from paddle_tpu.autograd import jacobian, vhp
+
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([3.0], np.float32))
+
+    def func(x, y):
+        return x * y[0]
+
+    Ja, Jb = jacobian(func, [a, b])
+    np.testing.assert_allclose(Ja.numpy(), np.eye(2) * 3.0, atol=1e-6)
+    np.testing.assert_allclose(Jb.numpy().reshape(-1), [1.0, 2.0], atol=1e-6)
+
+    def scalar(x):
+        return (x ** 2).sum()
+
+    out, hv = vhp(scalar, a, paddle.to_tensor(np.array([1.0, 1.0],
+                                                       np.float32)))
+    np.testing.assert_allclose(hv.numpy(), [2.0, 2.0], atol=1e-6)
+
+
+def test_jacobian_create_graph_double_backward():
+    from paddle_tpu.autograd import jacobian
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    J = jacobian(lambda t: t ** 3, x, create_graph=True)  # diag(3x^2)
+    (J.sum()).backward()  # d/dx sum(3x^2) = 6x
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 12.0], atol=1e-5)
+
+
+def test_sparse_attention_masks():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(5)
+    b, h, s, d = 1, 1, 6, 4
+    q, k, v = (paddle.to_tensor(rng.standard_normal((b, h, s, d))
+                                .astype(np.float32)) for _ in range(3))
+    offset = np.broadcast_to(np.arange(s + 1) * s, (b, h, s + 1)).copy()
+    columns = np.broadcast_to(np.tile(np.arange(s), s), (b, h, s * s)).copy()
+
+    # key_padding_mask: last two keys padded -> equals attention over first 4
+    kpm = np.ones((b, s), np.float32)
+    kpm[:, 4:] = 0.0
+    out = F.sparse_attention(q, k, v, paddle.to_tensor(offset),
+                             paddle.to_tensor(columns),
+                             key_padding_mask=paddle.to_tensor(kpm))
+    qt, kt, vt = (t.numpy().transpose(0, 2, 1, 3)[:, :4] for t in (k, k, v))
+    # dense reference: mask keys 4,5 with additive -inf
+    qq = paddle.to_tensor(q.numpy().transpose(0, 2, 1, 3))
+    kk = paddle.to_tensor(k.numpy().transpose(0, 2, 1, 3))
+    vv = paddle.to_tensor(v.numpy().transpose(0, 2, 1, 3))
+    bias = np.zeros((1, 1, s, s), np.float32)
+    bias[..., 4:] = -1e9
+    ref = F.scaled_dot_product_attention(
+        qq, kk, vv, attn_mask=paddle.to_tensor(bias)).numpy() \
+        .transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+    # additive attn_mask is honored
+    am = rng.standard_normal((b, h, s, s)).astype(np.float32)
+    out2 = F.sparse_attention(q, k, v, paddle.to_tensor(offset),
+                              paddle.to_tensor(columns),
+                              attn_mask=paddle.to_tensor(am))
+    ref2 = F.scaled_dot_product_attention(
+        qq, kk, vv, attn_mask=paddle.to_tensor(am)).numpy() \
+        .transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out2.numpy(), ref2, atol=1e-4)
+
+
+def test_roi_align_zero_outside():
+    from paddle_tpu.vision.ops import roi_align
+
+    x = paddle.to_tensor(np.full((1, 1, 8, 8), 4.0, np.float32))
+    # box hanging half outside the image: outside samples contribute zeros
+    out = roi_align(x, paddle.to_tensor(np.array([[-8, 0, 8, 8]],
+                                                 np.float32)),
+                    paddle.to_tensor(np.array([1], np.int32)),
+                    output_size=2, sampling_ratio=2)
+    vals = out.numpy()[0, 0]
+    assert vals[:, 0].max() < 1e-6   # fully-outside left column
+    np.testing.assert_allclose(vals[:, 1], 4.0, atol=1e-5)
+
+
+def test_lazy_jacobian_hessian_objects():
+    from paddle_tpu.autograd import Hessian, Jacobian
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    J = Jacobian(lambda t: t * 2.0, x)
+    assert J.shape == [3, 3]
+    np.testing.assert_allclose(J[:].numpy(), np.eye(3) * 2.0, atol=1e-6)
+
+    H = Hessian(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(H[:].numpy(), np.eye(3) * 2.0, atol=1e-6)
+
+
+def test_incubate_autograd_primapi():
+    import paddle_tpu.incubate.autograd as iag
+
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    g = iag.grad(lambda t: t ** 2, x)
+    np.testing.assert_allclose(g.numpy(), [4.0], atol=1e-6)
+    fg = iag.forward_grad(lambda t: t ** 2, x,
+                          paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(fg.numpy(), [4.0], atol=1e-6)
+    iag.disable_prim()
+    assert not iag.prim_enabled()
+    iag.enable_prim()
+
+
+# ------------------------------------------------------------ attention --
+
+def test_memory_efficient_attention_matches_sdpa():
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.incubate.nn import memory_efficient_attention
+
+    rng = np.random.default_rng(0)
+    q, k, v = (paddle.to_tensor(rng.standard_normal((2, 640, 4, 16))
+                                .astype(np.float32)) for _ in range(3))
+    out = memory_efficient_attention(q, k, v)
+    ref = F.scaled_dot_product_attention(q, k, v)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=2e-3)
+
+    # grad flows
+    q.stop_gradient = False
+    memory_efficient_attention(q, k, v).sum().backward()
+    assert q.grad is not None
+
+
+def test_memory_efficient_attention_with_bias():
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.incubate.nn import memory_efficient_attention
+
+    rng = np.random.default_rng(1)
+    q, k, v = (paddle.to_tensor(rng.standard_normal((1, 64, 2, 8))
+                                .astype(np.float32)) for _ in range(3))
+    # additive causal bias [1, 1, 64, 64] ([B,H,Sq,Sk] layout)
+    bias_np = np.triu(np.full((64, 64), -1e9, np.float32), 1)[None, None]
+    out = memory_efficient_attention(q, k, v,
+                                     attn_bias=paddle.to_tensor(bias_np))
+    ref = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=2e-3)
+
+
+def test_sparse_attention():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(2)
+    b, h, s, d = 1, 2, 8, 4
+    q, k, v = (paddle.to_tensor(rng.standard_normal((b, h, s, d))
+                                .astype(np.float32)) for _ in range(3))
+    # full attention expressed as CSR: every row attends to all columns
+    offset = np.broadcast_to(np.arange(s + 1) * s, (b, h, s + 1)).copy()
+    columns = np.broadcast_to(np.tile(np.arange(s), s), (b, h, s * s)).copy()
+    out = F.sparse_attention(q, k, v, paddle.to_tensor(offset),
+                             paddle.to_tensor(columns))
+    # dense reference in [B,H,S,D] layout: transpose into SDPA's [B,S,H,D]
+    qt = paddle.to_tensor(q.numpy().transpose(0, 2, 1, 3))
+    kt = paddle.to_tensor(k.numpy().transpose(0, 2, 1, 3))
+    vt = paddle.to_tensor(v.numpy().transpose(0, 2, 1, 3))
+    ref = F.scaled_dot_product_attention(qt, kt, vt).numpy() \
+        .transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+    # causal sparsity: row i attends to 0..i
+    counts = np.arange(1, s + 1)
+    offset_c = np.broadcast_to(np.concatenate([[0], np.cumsum(counts)]),
+                               (b, h, s + 1)).copy()
+    cols_c = np.concatenate([np.arange(i + 1) for i in range(s)])
+    columns_c = np.broadcast_to(cols_c, (b, h, len(cols_c))).copy()
+    out_c = F.sparse_attention(q, k, v, paddle.to_tensor(offset_c),
+                               paddle.to_tensor(columns_c))
+    ref_c = F.scaled_dot_product_attention(qt, kt, vt, is_causal=True) \
+        .numpy().transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out_c.numpy(), ref_c, atol=1e-4)
+
+
+# -------------------------------------------------------------- vision --
+
+def test_roi_align():
+    from paddle_tpu.vision.ops import roi_align
+
+    # constant feature map: every roi output must equal the constant
+    x = paddle.to_tensor(np.full((1, 3, 16, 16), 7.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12]],
+                                      np.float32))
+    bn = paddle.to_tensor(np.array([2], np.int32))
+    out = roi_align(x, boxes, bn, output_size=4)
+    assert out.shape == [2, 3, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 7.0, atol=1e-5)
+
+    # gradient-friendly: linear-in-x map, center values interpolate linearly
+    ramp = np.arange(16, dtype=np.float32)[None, None, None, :] \
+        .repeat(16, axis=2)
+    xr = paddle.to_tensor(np.ascontiguousarray(ramp))
+    out_r = roi_align(xr, paddle.to_tensor(
+        np.array([[0, 0, 16, 16]], np.float32)),
+        paddle.to_tensor(np.array([1], np.int32)), output_size=4)
+    got = out_r.numpy()[0, 0, 0]
+    assert np.all(np.diff(got) > 0)  # monotone along the ramp
+
+
+def test_roi_pool():
+    from paddle_tpu.vision.ops import roi_pool
+
+    x_np = np.zeros((1, 1, 8, 8), np.float32)
+    x_np[0, 0, 2, 2] = 5.0
+    out = roi_pool(paddle.to_tensor(x_np),
+                   paddle.to_tensor(np.array([[0, 0, 4, 4]], np.float32)),
+                   paddle.to_tensor(np.array([1], np.int32)), output_size=2)
+    assert out.shape == [1, 1, 2, 2]
+    assert out.numpy().max() == pytest.approx(5.0)
+
+
+def test_deform_conv2d_zero_offsets_match_conv():
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.ops import deform_conv2d
+
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 8, 8)).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((4, 3, 3, 3)).astype(np.float32))
+    b, kh, kw = 2, 3, 3
+    out_h = out_w = 8
+    off = paddle.to_tensor(np.zeros((2, 2 * kh * kw, out_h, out_w),
+                                    np.float32))
+    out = deform_conv2d(x, off, w, padding=1)
+    ref = F.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-3)
+
+    # v2 with mask of ones is the same
+    m = paddle.to_tensor(np.ones((2, kh * kw, out_h, out_w), np.float32))
+    out2 = deform_conv2d(x, off, w, padding=1, mask=m)
+    np.testing.assert_allclose(out2.numpy(), ref.numpy(), atol=1e-3)
+
+    # shifting every tap by +1 in x equals conv of the shifted image away
+    # from borders
+    off_np = np.zeros((2, kh * kw, 2, out_h, out_w), np.float32)
+    off_np[:, :, 1] = 1.0  # x offsets
+    out3 = deform_conv2d(
+        x, paddle.to_tensor(off_np.reshape(2, 2 * kh * kw, out_h, out_w)),
+        w, padding=1)
+    ref3 = F.conv2d(
+        paddle.to_tensor(np.roll(x.numpy(), -1, axis=3)), w, padding=1)
+    np.testing.assert_allclose(out3.numpy()[:, :, 1:-1, 1:-2],
+                               ref3.numpy()[:, :, 1:-1, 1:-2], atol=1e-3)
