@@ -169,7 +169,7 @@ def vhp(func, xs, v=None, name=None):
         tangents = [jnp.ones_like(a) for a in arrs]
     else:
         vts, _ = _tensors(v)
-        tangents = [t._data for t in vts]
+        tangents = [t._data.astype(a.dtype) for t, a in zip(vts, arrs)]
     grad_f = jax.grad(scalar_f, argnums=tuple(range(len(arrs))))
     out = scalar_f(*arrs)
     _, hvp = jax.jvp(grad_f, tuple(arrs), tuple(tangents))

@@ -11,10 +11,14 @@ from .env import (  # noqa: F401
 from .communication import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, is_available, destroy_process_group,
     all_reduce, all_gather, all_gather_object, all_to_all, all_to_all_single,
-    broadcast, broadcast_object_list, reduce, reduce_scatter, scatter,
-    scatter_object_list, gather, send, recv, isend, irecv, barrier, wait,
-    stream,
+    alltoall, alltoall_single, broadcast, broadcast_object_list, reduce,
+    reduce_scatter, scatter, scatter_object_list, gather, send, recv, isend,
+    irecv, P2POp, batch_isend_irecv, get_backend, barrier, wait, stream,
 )
+from .interface import spawn, split, parallelize, to_static, set_mesh  # noqa: F401
+from . import launch  # noqa: F401
+from . import utils  # noqa: F401
+from . import metric  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, get_hybrid_communicate_group,
     get_mesh, ParallelMode,

@@ -24,7 +24,8 @@ __all__ = ["ReduceOp", "Group", "new_group", "get_group", "is_available",
            "all_gather_object", "all_to_all", "all_to_all_single", "broadcast",
            "broadcast_object_list", "reduce", "reduce_scatter", "scatter",
            "scatter_object_list", "gather", "send", "recv", "isend", "irecv",
-           "barrier", "wait", "stream"]
+           "barrier", "wait", "stream", "alltoall", "alltoall_single",
+           "P2POp", "batch_isend_irecv", "get_backend"]
 
 
 def _axis(group):
@@ -233,16 +234,25 @@ def recv(tensor, src=0, group=None, sync_op=True):
         raise RuntimeError(
             "recv() with no pending send(): SPMD P2P requires the matching "
             "send in the same traced program (one ppermute per pair)")
-    val, ax, d = _P2P_PENDING.pop(0)
     cur_ax = _axis(group)
     me = group.rank if group is not None and group.rank >= 0 else 0
-    if cur_ax is not None:
+    if cur_ax is None:
+        val, ax, d = _P2P_PENDING.pop(0)
+    else:
+        # match by (axis, shift), not FIFO order: batched exchanges
+        # (batch_isend_irecv) may list recvs in any order relative to their
+        # sends — the reference API allows arbitrary op order
         n = group.nranks
         expect = (me - src) % n
-        if ax != cur_ax or d != expect:
+        for i, (val_i, ax_i, d_i) in enumerate(_P2P_PENDING):
+            if ax_i == cur_ax and d_i == expect:
+                val, ax, d = _P2P_PENDING.pop(i)
+                break
+        else:
+            pend = [(a, d_) for _, a, d_ in _P2P_PENDING]
             raise RuntimeError(
-                f"recv(src={src}) on axis {cur_ax!r} (shift {expect}) does "
-                f"not match pending send (axis {ax!r}, shift {d})")
+                f"recv(src={src}) on axis {cur_ax!r} (shift {expect}) has "
+                f"no matching pending send; pending (axis, shift): {pend}")
     _in_place(tensor, val)
     return _Task(tensor)
 
@@ -258,6 +268,47 @@ def isend(tensor, dst=0, group=None):
 
 def irecv(tensor, src=0, group=None):
     return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    """One operation in a batched P2P exchange (reference:
+    communication/batch_isend_irecv.py P2POp): op is `isend`/`irecv` (or the
+    strings "isend"/"irecv"), tensor the payload/destination buffer, peer the
+    remote rank."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        name = op if isinstance(op, str) else getattr(op, "__name__", "")
+        if name not in ("isend", "irecv"):
+            raise ValueError(
+                f"P2POp op must be isend or irecv, got {op!r}")
+        self.op = name
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of P2P ops (reference batch_isend_irecv over
+    ncclGroupStart/End). On XLA, batched pairwise exchange is one
+    `ppermute` — sends are issued first so each recv can pair with the
+    in-flight value regardless of list order."""
+    if not p2p_op_list:
+        return []
+    if not all(isinstance(p, P2POp) for p in p2p_op_list):
+        raise ValueError("batch_isend_irecv expects a list of P2POp")
+    tasks = []
+    for p in sorted(p2p_op_list, key=lambda p: p.op != "isend"):
+        if p.op == "isend":
+            tasks.append(isend(p.tensor, p.peer, p.group))
+        else:
+            tasks.append(irecv(p.tensor, p.peer, p.group))
+    return tasks
+
+
+def get_backend(group=None):
+    """Communication backend name. The reference answers nccl/gloo/bkcl;
+    here every collective lowers to XLA over ICI/DCN."""
+    return "xla"
 
 
 def barrier(group=None):
@@ -282,6 +333,17 @@ def broadcast_object_list(object_list, src=0, group=None):
 def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
     if in_object_list:
         out_object_list.append(in_object_list[0])
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Legacy spelling of all_to_all (reference exports both)."""
+    return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    return all_to_all_single(out_tensor, in_tensor, in_split_sizes,
+                             out_split_sizes, group, sync_op)
 
 
 class stream:

@@ -1,0 +1,141 @@
+"""Top-level `paddle.distributed` conveniences: spawn, split, parallelize,
+to_static, set_mesh (reference: python/paddle/distributed/spawn.py,
+collective.py split, auto_parallel/api.py parallelize/to_static)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ['spawn', 'split', 'parallelize', 'to_static', 'set_mesh']
+
+
+def set_mesh(mesh):
+    """Install the global process mesh (reference auto_parallel
+    api.set_mesh). Accepts a ProcessMesh or a jax Mesh."""
+    from .topology import _set_global_mesh
+
+    jm = getattr(mesh, "_jax_mesh", mesh)
+    _set_global_mesh(jm)
+    return mesh
+
+
+def _spawn_worker(func, rank, nprocs, master, args):
+    # the env contract must exist BEFORE any jax/backend init in func
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_MASTER"] = master
+    os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Launch ``func`` in ``nprocs`` worker processes with the launcher's env
+    contract set per rank (reference: distributed/spawn.py — the API twin of
+    `python -m paddle.distributed.launch`). Returns the context with
+    `.processes`; with join=True waits and raises on the first failure."""
+    import multiprocessing as mp
+    import socket
+
+    if nprocs < 1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    master = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_worker,
+                        args=(func, rank, nprocs, master, tuple(args)),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class _Context:
+        processes = procs
+
+        @staticmethod
+        def join(timeout=None):
+            for p in procs:
+                p.join(timeout)
+            bad = [p.exitcode for p in procs if p.exitcode]
+            if bad:
+                raise RuntimeError(
+                    f"spawned workers exited with codes {bad}")
+
+    if join:
+        _Context.join()
+    return _Context
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel split op (reference: distributed/collective.py split —
+    builds a row/column-sharded linear or vocab-sharded embedding in one
+    call). Constructs the corresponding meta_parallel layer and applies it;
+    the created layer is returned via the result's `._split_layer` so its
+    parameters can be reached for training."""
+    from .meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    )
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:  # split the in-dim -> row parallel
+            layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        elif axis == 1:  # split the out-dim -> column parallel
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        else:
+            raise ValueError(f"linear split axis must be 0 or 1, got {axis}")
+    elif operation == "embedding":
+        vocab, hidden = size
+        layer = VocabParallelEmbedding(vocab, hidden,
+                                       weight_attr=weight_attr)
+    else:
+        raise ValueError(
+            f"operation must be 'linear' or 'embedding', got {operation!r}")
+    out = layer(x)
+    out._split_layer = layer
+    return out
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """Apply a dp/mp/pp plan to a dygraph model (reference:
+    auto_parallel/api.py parallelize, the 2.6+ one-call entry): initializes
+    the hybrid topology from the config degrees and returns the wrapped
+    (model, optimizer) the way fleet.distributed_model/optimizer would."""
+    from .fleet import DistributedStrategy, fleet
+
+    config = config or {}
+
+    def degree(key):
+        return int(config.get(f"{key}_degree")
+                   or config.get(f"{key}_config", {}).get("degree", 1) or 1)
+
+    dp, mp_deg, pp_deg = degree("dp"), degree("mp"), degree("pp")
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp_deg,
+                               "pp_degree": pp_deg}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(model)
+    if optimizer is not None:
+        optimizer = fleet.distributed_optimizer(optimizer)
+        return model, optimizer
+    return model
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Convert a dygraph training setup into a static auto-parallel engine
+    (reference: auto_parallel/api.py to_static → DistModel over Engine)."""
+    from .auto_parallel.engine import Engine
+
+    eng = Engine(model=layer, loss=loss, optimizer=optimizer,
+                 strategy=strategy)
+    eng._dist_loader = loader
+    return eng

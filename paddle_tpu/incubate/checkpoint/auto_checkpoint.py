@@ -88,9 +88,12 @@ class TrainEpochRange:
         os.makedirs(self._dir, exist_ok=True)
         if self._state_objs:
             from ...framework.io import save
+            # write-then-rename: a crash mid-pickle must not corrupt the
+            # checkpoint the resume depends on
+            stmp = self._state_file() + '.tmp'
             save({k: obj.state_dict()
-                  for k, obj in self._state_objs.items()},
-                 self._state_file())
+                  for k, obj in self._state_objs.items()}, stmp)
+            os.replace(stmp, self._state_file())
         tmp = self._meta_path + '.tmp'
         with open(tmp, 'w') as f:
             json.dump({'epoch': epoch, 'max_epoch_num': self._max,

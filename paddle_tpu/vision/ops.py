@@ -77,6 +77,19 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     return Tensor(idx, stop_gradient=True)
 
 
+def _bilinear_axis(coord, size):
+    """Shared bilinear-tap math (reference bilinear_interpolate semantics):
+    samples beyond (-1, size) are invalid (zero contribution), inside ones
+    clamp to the border pixel. Returns (valid, lo_idx, hi_idx, hi_weight)
+    for one coordinate array of any shape; used by roi_align (separable
+    grids) and deform_conv2d (pointwise grids)."""
+    valid = (coord > -1.0) & (coord < size)
+    cc = jnp.clip(coord, 0.0, size - 1.0)
+    lo = jnp.floor(cc).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, size - 1)
+    return valid, lo, hi, cc - lo
+
+
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None) -> Tensor:
     """RoI Align (reference: python/paddle/vision/ops.py roi_align over
@@ -127,19 +140,10 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         xs = x1[:, None] + bin_w[:, None] * ix[None, :]   # [R, pw*ns]
 
         def bilinear(img, yy, xx):
-            # img: [C, H, W]; yy: [Sy], xx: [Sx] -> [C, Sy, Sx]
-            # reference bilinear_interpolate: samples beyond (-1, H/W) are
-            # zero, inside ones clamp to the border pixel
-            vy = (yy > -1.0) & (yy < hgt)
-            vx = (xx > -1.0) & (xx < wid)
-            yy = jnp.clip(yy, 0.0, hgt - 1.0)
-            xx = jnp.clip(xx, 0.0, wid - 1.0)
-            y0 = jnp.floor(yy).astype(jnp.int32)
-            x0 = jnp.floor(xx).astype(jnp.int32)
-            y1i = jnp.minimum(y0 + 1, hgt - 1)
-            x1i = jnp.minimum(x0 + 1, wid - 1)
-            wy = yy - y0
-            wx = xx - x0
+            # img: [C, H, W]; yy: [Sy], xx: [Sx] -> [C, Sy, Sx] (separable
+            # grid: 1-D taps combined by outer product)
+            vy, y0, y1i, wy = _bilinear_axis(yy, hgt)
+            vx, x0, x1i, wx = _bilinear_axis(xx, wid)
             g = lambda yi, xi: img[:, yi, :][:, :, xi]
             top = g(y0, x0) * (1 - wx)[None, None, :] + \
                 g(y0, x1i) * wx[None, None, :]
@@ -244,15 +248,10 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
 
         def sample_img(img, syi, sxi, mi):
             # img [cpg, H, W]; syi/sxi/mi [H', W', k] -> [cpg, H', W', k]
-            valid = (syi > -1) & (syi < hgt) & (sxi > -1) & (sxi < wid)
-            yy = jnp.clip(syi, 0.0, hgt - 1.0)
-            xx = jnp.clip(sxi, 0.0, wid - 1.0)
-            y0 = jnp.floor(yy).astype(jnp.int32)
-            x0 = jnp.floor(xx).astype(jnp.int32)
-            y1i = jnp.minimum(y0 + 1, hgt - 1)
-            x1i = jnp.minimum(x0 + 1, wid - 1)
-            wy = yy - y0
-            wx = xx - x0
+            # pointwise grid: every (y, x) pair is its own tap
+            vy, y0, y1i, wy = _bilinear_axis(syi, hgt)
+            vx, x0, x1i, wx = _bilinear_axis(sxi, wid)
+            valid = vy & vx
             flat = img.reshape(cpg, -1)
             gidx = lambda yi, xi: jnp.take(flat, (yi * wid + xi).reshape(-1),
                                            axis=1).reshape(cpg, *yi.shape)

@@ -1,0 +1,94 @@
+"""Tests for the paddle.distributed convenience surface: P2POp /
+batch_isend_irecv, alltoall aliases, split, parallelize, spawn, set_mesh."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def _init(dp=1, mp=1, pp=1):
+    from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+    strat = DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp}
+    fleet.init(is_collective=True, strategy=strat)
+    return fleet
+
+
+def test_p2pop_validation():
+    t = paddle.to_tensor(np.zeros(2, np.float32))
+    with pytest.raises(ValueError):
+        dist.P2POp("allreduce", t, 0)
+    op = dist.P2POp(dist.isend, t, 1)
+    assert op.op == "isend" and op.peer == 1
+    with pytest.raises(ValueError):
+        dist.batch_isend_irecv(["nope"])
+    assert dist.batch_isend_irecv([]) == []
+
+
+def test_alltoall_alias():
+    _init()
+    xs = [paddle.to_tensor(np.ones(2, np.float32))]
+    out = []
+    dist.alltoall(out, xs)
+    assert len(out) == 1
+    np.testing.assert_allclose(out[0].numpy(), 1.0)
+    assert dist.get_backend() == "xla"
+
+
+def test_split_linear_and_embedding():
+    _init(mp=1)
+    paddle.seed(0)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((3, 8)).astype(np.float32))
+    y = dist.split(x, (8, 4), operation="linear", axis=1)
+    assert y.shape == [3, 4]
+    layer = y._split_layer
+    assert len(list(layer.parameters())) >= 1
+    # row-parallel variant
+    y2 = dist.split(x, (8, 4), operation="linear", axis=0)
+    assert y2.shape == [3, 4]
+    ids = paddle.to_tensor(np.array([[0, 2], [1, 3]]))
+    e = dist.split(ids, (16, 6), operation="embedding")
+    assert e.shape == [2, 2, 6]
+    with pytest.raises(ValueError):
+        dist.split(x, (8, 4), operation="conv")
+
+
+def test_parallelize_wraps_model():
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    model, opt2 = dist.parallelize(m, opt, config={"dp_degree": 1,
+                                                   "mp_degree": 1,
+                                                   "pp_degree": 1})
+    x = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((2, 4)).astype(np.float32))
+    loss = model(x).sum()
+    loss.backward()
+    opt2.step()
+    opt2.clear_grad()
+
+
+def test_set_mesh():
+    from paddle_tpu.distributed import ProcessMesh
+    mesh = ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+    got = dist.set_mesh(mesh)
+    assert got is mesh
+    from paddle_tpu.distributed.topology import get_mesh
+    assert get_mesh() is not None
+
+
+def _spawn_target(val):
+    import os
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    assert rank in (0, 1)
+    assert val == 42
+
+
+def test_spawn_two_procs():
+    ctx = dist.spawn(_spawn_target, args=(42,), nprocs=2, join=True)
+    assert all(p.exitcode == 0 for p in ctx.processes)

@@ -402,10 +402,37 @@ def test_send_recv_mismatch_raises():
         return r._data
 
     fn = sharded_call(body, hcg.mesh, (P("pp"),), P("pp"), axis_names=("pp",))
-    with pytest.raises(Exception, match="does not match pending send"):
+    with pytest.raises(Exception, match="matching pending send"):
         fn(jnp.asarray(np.arange(8.0)))
     from paddle_tpu.distributed import communication as comm
     comm._P2P_PENDING.clear()
+
+
+def test_batch_isend_irecv_bidirectional():
+    """Out-of-order batched exchange: both sends first, then recvs in the
+    order the reference API allows (recv-from-next before recv-from-prev) —
+    pairing is by (axis, shift), not FIFO."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    hcg, _ = _init_fleet(pp=8)
+    g = hcg.get_pipe_parallel_group()
+    from paddle_tpu.distributed.sharding_utils import sharded_call
+
+    def body(x):
+        t = paddle.Tensor(x)
+        rn = paddle.Tensor(jnp.zeros_like(x))
+        rp = paddle.Tensor(jnp.zeros_like(x))
+        ops = [dist.P2POp(dist.isend, t, 1, g),   # -> next
+               dist.P2POp(dist.isend, t, 7, g),   # -> prev
+               dist.P2POp(dist.irecv, rn, 1, g),  # <- next (shift 7)
+               dist.P2POp(dist.irecv, rp, 7, g)]  # <- prev (shift 1)
+        dist.batch_isend_irecv(ops)
+        return rn._data + 10.0 * rp._data
+
+    fn = sharded_call(body, hcg.mesh, (P("pp"),), P("pp"), axis_names=("pp",))
+    x = np.arange(8.0)
+    out = np.asarray(fn(jnp.asarray(x)))
+    np.testing.assert_allclose(out, np.roll(x, -1) + 10.0 * np.roll(x, 1))
 
 
 def test_recv_without_send_raises():

@@ -91,7 +91,6 @@ def test_sparse_attention_masks():
     out = F.sparse_attention(q, k, v, paddle.to_tensor(offset),
                              paddle.to_tensor(columns),
                              key_padding_mask=paddle.to_tensor(kpm))
-    qt, kt, vt = (t.numpy().transpose(0, 2, 1, 3)[:, :4] for t in (k, k, v))
     # dense reference: mask keys 4,5 with additive -inf
     qq = paddle.to_tensor(q.numpy().transpose(0, 2, 1, 3))
     kk = paddle.to_tensor(k.numpy().transpose(0, 2, 1, 3))
