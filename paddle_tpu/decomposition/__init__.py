@@ -1,0 +1,74 @@
+"""`paddle.decomposition` (reference: python/paddle/decomposition/decomp.py —
+rewrites composite ops in a PIR program into the primitive-op set so the
+autodiff/compiler layers only see primitives).
+
+TPU-native: tracing IS decomposition — every framework op lowers through
+jax into a jaxpr whose equations are the primitive set (add/mul/dot_general/
+reduce_*/...). `decompose` exposes that program; `primitives_of` lists the
+primitive vocabulary a callable uses, which is what the reference's
+white-list machinery reasons about."""
+
+from __future__ import annotations
+
+__all__ = ['decompose', 'primitives_of', 'has_composite']
+
+# ops the reference treats as composites with registered decomposition rules
+_COMPOSITE_HINTS = {
+    'softmax', 'log_softmax', 'gelu', 'silu', 'layer_norm', 'rms_norm',
+    'dropout', 'mean', 'batch_norm', 'sigmoid_cross_entropy',
+}
+
+
+def _pure_fn(func, stop_gradient=False):
+    """Lift a Tensor->Tensor callable to arrays->arrays (shared with
+    paddle_tpu.cost_model; stop_gradient=True skips autograd-node recording
+    for analysis-only traces)."""
+    from ..core.tensor import Tensor
+
+    def f(*arrs):
+        out = func(*[Tensor(a, stop_gradient=stop_gradient) for a in arrs])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    return f
+
+
+def decompose(func, *example_args):
+    """Trace ``func`` at ``example_args`` and return the primitive program
+    (a jaxpr — the TPU analog of the decomposed PIR program)."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    arrs = [a._data if isinstance(a, Tensor) else a for a in example_args]
+    return jax.make_jaxpr(_pure_fn(func))(*arrs)
+
+
+def primitives_of(func, *example_args):
+    """Sorted primitive names used by ``func`` (transitively through inner
+    closed-call jaxprs)."""
+    jaxpr = decompose(func, *example_args)
+
+    names = set()
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            names.add(eqn.primitive.name)
+            for v in eqn.params.values():
+                inner = getattr(v, 'jaxpr', None)
+                if inner is not None:
+                    walk(inner)
+    walk(jaxpr.jaxpr)
+    return sorted(names)
+
+
+def has_composite(func, *example_args):
+    """True if the traced program still contains ops the reference would
+    decompose (here: named custom-vjp/checkpoint wrappers that hide their
+    body from the primitive listing)."""
+    prims = set(primitives_of(func, *example_args))
+    # 'remat2' is jax's current checkpoint primitive name ('remat' kept for
+    # older traces)
+    return bool(prims & {'custom_vjp_call', 'custom_jvp_call', 'remat',
+                         'remat2'})

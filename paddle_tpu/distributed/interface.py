@@ -58,12 +58,15 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
 
         @staticmethod
         def join(timeout=None):
+            """True when every worker has exited cleanly; False if any is
+            still running after `timeout`; raises on nonzero exit."""
             for p in procs:
                 p.join(timeout)
-            bad = [p.exitcode for p in procs if p.exitcode]
+            bad = [p.exitcode for p in procs if p.exitcode not in (None, 0)]
             if bad:
                 raise RuntimeError(
                     f"spawned workers exited with codes {bad}")
+            return all(p.exitcode == 0 for p in procs)
 
     if join:
         _Context.join()
@@ -119,6 +122,14 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
                    or config.get(f"{key}_config", {}).get("degree", 1) or 1)
 
     dp, mp_deg, pp_deg = degree("dp"), degree("mp"), degree("pp")
+    if mesh is not None:
+        # a caller-built ProcessMesh fixes the axis sizes; degrees given in
+        # config must agree or they'd be silently ignored
+        sizes = dict(zip(getattr(mesh, "dim_names", ()),
+                         getattr(mesh, "shape", ())))
+        dp = sizes.get("dp", dp)
+        mp_deg = sizes.get("mp", mp_deg)
+        pp_deg = sizes.get("pp", pp_deg)
     strategy = DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp_deg,
                                "pp_degree": pp_deg}

@@ -221,3 +221,38 @@ def test_qat_idempotent():
     inner = m2._sub_layers["0"]
     assert isinstance(inner, QuantedLinear)
     assert not isinstance(inner.inner, QuantedLinear)  # no nesting
+
+
+def test_vision_model_families():
+    """AlexNet/VGG/MobileNetV2/SqueezeNet forward + one train step each
+    (reference: python/paddle/vision/models/)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import models
+
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 3, 64, 64)).astype(np.float32))
+    y = paddle.to_tensor(np.array([1, 3], np.int64))
+    import paddle_tpu.nn as nn
+    loss_fn = nn.CrossEntropyLoss()
+    # forward on every family; full train step only on the small ones to
+    # keep CPU compile time in check
+    for fn in (models.alexnet, models.vgg11):
+        m = fn(num_classes=5)
+        m.eval()
+        assert m(x).shape == [2, 5]
+    for fn in (models.mobilenet_v2, models.squeezenet1_1):
+        m = fn(num_classes=5)
+        out = m(x)
+        assert out.shape == [2, 5]
+        opt = paddle.optimizer.SGD(1e-3, parameters=m.parameters())
+        loss = loss_fn(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert np.isfinite(float(loss))
+    # deeper resnets construct
+    m101 = models.resnet101(num_classes=4)
+    m101.eval()
+    assert m101(x).shape == [2, 4]

@@ -250,3 +250,39 @@ def test_straggler_warmup_and_regime_change():
     assert flags[0] is True            # initially flagged
     assert flags[-1] is False          # adopted as the new regime
     assert abs(det.ema_s - 0.3) < 0.05
+
+
+def test_decomposition_module():
+    """paddle.decomposition: tracing to the primitive program (reference
+    decomposition/decomp.py — here the jaxpr IS the decomposed program)."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+
+    def f(t):
+        return paddle.nn.functional.softmax(t)
+
+    jaxpr = paddle.decomposition.decompose(f, x)
+    assert len(jaxpr.jaxpr.eqns) >= 1
+    prims = paddle.decomposition.primitives_of(f, x)
+    # softmax decomposes into primitive exp/reduce ops, not one opaque op
+    assert any(p in prims for p in ("exp", "reduce_max", "reduce_sum",
+                                    "custom_jvp_call"))
+    assert isinstance(paddle.decomposition.has_composite(f, x), bool)
+
+
+def test_cost_model():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    cm = paddle.cost_model.CostModel()
+    a = paddle.to_tensor(np.ones((64, 64), np.float32))
+
+    def f(t):
+        return t @ t
+
+    static = cm.static_cost(f, a)
+    assert static.get("flops", 0) > 0  # 64^3*2 matmul flops visible to XLA
+    measured = cm.profile_measure(f, a, repeat=3, warmup=1)
+    assert measured["time_s"] > 0
