@@ -169,23 +169,42 @@ def run_flash_ab(dev):
     q, k, v, g = (jnp.asarray(rng.standard_normal(shp), jnp.bfloat16)
                   for _ in range(4))
 
-    def timed(f):
+    def timed(f, kk, vv):
         fg = jax.jit(jax.grad(
             lambda q, k, v: jnp.sum((f(q, k, v) * g).astype(jnp.float32)),
             argnums=(0, 1, 2)))
-        r = fg(q, k, v)
+        r = fg(q, kk, vv)
         jax.block_until_ready(r)
         t0 = time.perf_counter()
         for _ in range(5):
-            r = fg(q, k, v)
+            r = fg(q, kk, vv)
         jax.block_until_ready(r)
         return (time.perf_counter() - t0) / 5 * 1e3
 
-    pallas_ms = timed(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))
-    xla_ms = timed(lambda q, k, v: fa._reference_attention(q, k, v, True))
-    return {"pallas_fwdbwd_ms": round(pallas_ms, 2),
-            "xla_fwdbwd_ms": round(xla_ms, 2),
-            "speedup": round(xla_ms / pallas_ms, 3)}
+    pallas_ms = timed(lambda q, k, v: fa.flash_attention(q, k, v, causal=True),
+                      k, v)
+    xla_ms = timed(lambda q, k, v: fa._reference_attention(q, k, v, True),
+                   k, v)
+    res = {"pallas_fwdbwd_ms": round(pallas_ms, 2),
+           "xla_fwdbwd_ms": round(xla_ms, 2),
+           "speedup": round(xla_ms / pallas_ms, 3)}
+
+    # GQA (Llama-bench head config 16q/4kv): the kernel reads shared kv
+    # heads via its index map vs the materialized-repeat composite
+    try:
+        kg, vg = (jnp.asarray(rng.standard_normal((4, 2048, 4, 64)),
+                              jnp.bfloat16) for _ in range(2))
+        gqa_pallas = timed(
+            lambda q, k, v: fa.flash_attention(q, k, v, causal=True), kg, vg)
+        gqa_xla = timed(
+            lambda q, k, v: fa._reference_attention(q, k, v, True), kg, vg)
+        res["gqa_pallas_fwdbwd_ms"] = round(gqa_pallas, 2)
+        res["gqa_xla_fwdbwd_ms"] = round(gqa_xla, 2)
+        res["gqa_speedup"] = round(gqa_xla / gqa_pallas, 3)
+    except Exception as e:
+        # the GQA signal must not vanish silently if the kernel path breaks
+        res["gqa_error"] = repr(e)[:300]
+    return res
 
 
 def run_dit_bench(dev):

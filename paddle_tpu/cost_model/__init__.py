@@ -18,7 +18,11 @@ __all__ = ['CostModel']
 
 class CostModel:
     def __init__(self):
-        self._static_by_fn: dict[int, dict] = {}
+        import weakref
+        # weak keys: a collected function's entry dies with it, so a reused
+        # id can never serve another function's numbers, and a long-lived
+        # CostModel does not grow unboundedly
+        self._static_by_fn = weakref.WeakKeyDictionary()
 
     # -- static analysis --------------------------------------------------
     def static_cost(self, func, *example_args):
@@ -48,7 +52,10 @@ class CostModel:
                 mem, 'output_size_in_bytes', 0)
         except Exception:
             pass
-        self._static_by_fn[id(func)] = out
+        try:
+            self._static_by_fn[func] = out
+        except TypeError:
+            pass  # non-weakref-able callable: analysis still returned
         return out
 
     # -- measured ---------------------------------------------------------
@@ -71,7 +78,10 @@ class CostModel:
             r = jf(*arrs)
         jax.block_until_ready(r)
         dt = (time.perf_counter() - t0) / repeat
-        static = self._static_by_fn.get(id(func))
+        try:
+            static = self._static_by_fn.get(func)
+        except TypeError:
+            static = None
         if static is None:
             static = self.static_cost(func, *example_args)
         flops = float(static.get('flops', 0.0))
@@ -80,8 +90,9 @@ class CostModel:
 
     def get_static_op_time(self, func=None):
         if func is not None:
-            return self._static_by_fn.get(id(func), {})
-        # most recent analysis when unkeyed (reference returns the profiled
-        # program's table)
-        return next(reversed(self._static_by_fn.values()), {}) \
-            if self._static_by_fn else {}
+            try:
+                return self._static_by_fn.get(func, {})
+            except TypeError:
+                return {}
+        vals = list(self._static_by_fn.values())
+        return vals[-1] if vals else {}

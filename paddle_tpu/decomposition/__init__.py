@@ -12,12 +12,6 @@ from __future__ import annotations
 
 __all__ = ['decompose', 'primitives_of', 'has_composite']
 
-# ops the reference treats as composites with registered decomposition rules
-_COMPOSITE_HINTS = {
-    'softmax', 'log_softmax', 'gelu', 'silu', 'layer_norm', 'rms_norm',
-    'dropout', 'mean', 'batch_norm', 'sigmoid_cross_entropy',
-}
-
 
 def _pure_fn(func, stop_gradient=False):
     """Lift a Tensor->Tensor callable to arrays->arrays (shared with
@@ -52,13 +46,24 @@ def primitives_of(func, *example_args):
 
     names = set()
 
+    def descend(v):
+        # params hold jaxprs directly, as ClosedJaxpr, or in tuples/lists
+        # (e.g. lax.cond's 'branches')
+        if isinstance(v, (tuple, list)):
+            for item in v:
+                descend(item)
+            return
+        inner = getattr(v, 'jaxpr', None)
+        if inner is not None:
+            walk(inner)
+        elif hasattr(v, 'eqns'):
+            walk(v)
+
     def walk(jx):
         for eqn in jx.eqns:
             names.add(eqn.primitive.name)
             for v in eqn.params.values():
-                inner = getattr(v, 'jaxpr', None)
-                if inner is not None:
-                    walk(inner)
+                descend(v)
     walk(jaxpr.jaxpr)
     return sorted(names)
 

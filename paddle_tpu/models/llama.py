@@ -58,16 +58,6 @@ def _rope_tables(cfg: LlamaConfig, seq_len: int, dtype="float32"):
             paddle.to_tensor(sin.reshape(shape).astype(dtype)))
 
 
-def _repeat_kv(x, n_rep: int):
-    """[B, S, KV, D] -> [B, S, KV*n_rep, D] (GQA head expansion)."""
-    if n_rep == 1:
-        return x
-    b, s, kv, d = x.shape
-    return (x.unsqueeze(3)
-             .expand([b, s, kv, n_rep, d])
-             .reshape([b, s, kv * n_rep, d]))
-
-
 class LlamaAttention(nn.Layer):
     """GQA attention; `parallel=True` shards heads over mp via Column/Row."""
 
@@ -116,8 +106,8 @@ class LlamaAttention(nn.Layer):
         k = self.k_proj(x).reshape([b, s, self.n_kv, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.n_kv, self.head_dim])
         q, k = F.rope(q, k, sin, cos)
-        k = _repeat_kv(k, self.n_head // self.n_kv)
-        v = _repeat_kv(v, self.n_head // self.n_kv)
+        # kv heads stay at n_kv: SDPA handles GQA natively — the flash
+        # kernel reads each shared kv head via its index map (no HBM repeat)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         return self.o_proj(out.reshape([b, s, self.n_head * self.head_dim]))
 

@@ -25,6 +25,10 @@ from ._common import available, force_interpret, interpret_mode  # noqa: F401
 
 
 def _reference_attention(q, k, v, causal):
+    if k.shape[2] != q.shape[2]:  # GQA fallback: expand the shared kv heads
+        n_rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
     qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
@@ -103,5 +107,9 @@ _flash_full.defvjp(lambda q, k, v: _fwd_impl(q, k, v, False),
 
 
 def flash_attention(q, k, v, causal: bool = False):
-    """[B, S, H, D] attention; fused Pallas forward+backward on TPU."""
+    """[B, S, H, D] attention; fused Pallas forward+backward on TPU.
+
+    k/v may carry fewer heads than q (GQA/MQA): the kernels read each shared
+    kv head directly via the block index map instead of materializing the
+    repeat (reference GQA glue expands kv in HBM first)."""
     return _flash_causal(q, k, v) if causal else _flash_full(q, k, v)

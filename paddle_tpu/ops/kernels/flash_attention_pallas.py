@@ -73,12 +73,29 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, block_k,
                                                (block_q, LSE_LANES))
 
 
+def _kv_index_map(h, h_kv):
+    """Grid row bi (over b*h q-heads) -> the k/v row it reads. GQA
+    (h_kv < h): each group of h//h_kv q heads shares one kv head — the
+    kernel fetches that kv block directly, with NO materialized repeat in
+    HBM (the bandwidth win over repeat_kv; reference GQA glue expands)."""
+    n_rep = h // h_kv
+
+    def imap(bi, qi):
+        return ((bi // h) * h_kv + (bi % h) // n_rep, 0, 0)
+
+    return imap
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
                                 block_k=256, interpret=False):
-    """Returns (out [B,S,H,D], lse [B*H, S] float32)."""
+    """Returns (out [B,S,H,D], lse [B*H, S] float32). k/v may carry fewer
+    heads than q (GQA): heads must divide evenly."""
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
@@ -87,8 +104,9 @@ def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
 
     # [B,S,H,D] -> [B*H, S, D] for blocking along seq
     qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
-    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
-    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h_kv, s, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h_kv, s, d)
+    kv_map = _kv_index_map(h, h_kv)
 
     grid = (b * h, s // block_q)
     with jax.enable_x64(False):
@@ -98,8 +116,8 @@ def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
-                pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
-                pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
+                pl.BlockSpec((1, s, d), kv_map),
+                pl.BlockSpec((1, s, d), kv_map),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
@@ -118,16 +136,21 @@ def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
                                              "interpret"))
 def flash_attention_forward(q, k, v, causal=False, block_q=256, block_k=256,
                             interpret=False):
-    """Primal-only forward: no logsumexp output (inference path)."""
+    """Primal-only forward: no logsumexp output (inference path). GQA
+    supported as in flash_attention_forward_lse."""
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(f"seq {s} must divide block sizes {block_q}/{block_k}")
     scale = 1.0 / math.sqrt(d)
     qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
-    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, s, d)
-    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, s, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h_kv, s, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h_kv, s, d)
+    kv_map = _kv_index_map(h, h_kv)
     with jax.enable_x64(False):
         out = pl.pallas_call(
             functools.partial(_attn_kernel, causal=causal, block_k=block_k,
@@ -135,8 +158,8 @@ def flash_attention_forward(q, k, v, causal=False, block_q=256, block_k=256,
             grid=(b * h, s // block_q),
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
-                pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
-                pl.BlockSpec((1, s, d), lambda bi, qi: (bi, 0, 0)),
+                pl.BlockSpec((1, s, d), kv_map),
+                pl.BlockSpec((1, s, d), kv_map),
             ],
             out_specs=pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
             out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
@@ -245,13 +268,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                                              "interpret"))
 def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
                              block_k=256, interpret=False):
-    """Fused FA2-style backward: (dq, dk, dv), all [B,S,H,D].
+    """Fused FA2-style backward: (dq, dk, dv) — dq [B,S,H,D], dk/dv with the
+    kv head count (GQA: gradients of shared kv heads are summed over their
+    query group).
 
     `lse` is the [B*H, S] logsumexp from flash_attention_forward_lse; `g` the
     output cotangent. delta = rowsum(dO * O) is computed outside the kernels
     (one fused XLA elementwise pass).
     """
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
+    n_rep = h // h_kv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
@@ -259,10 +288,12 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
     scale = 1.0 / math.sqrt(d)
 
     def to_bh(t):
-        return jnp.swapaxes(t, 1, 2).reshape(b * h, s, d)
+        hh = t.shape[2]
+        return jnp.swapaxes(t, 1, 2).reshape(b * hh, s, d)
 
     qt, kt, vt, dot = to_bh(q), to_bh(k), to_bh(v), to_bh(g)
     ot = to_bh(out)
+    kv_map = _kv_index_map(h, h_kv)
     delta1 = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
                      axis=-1, keepdims=True)
     delta = jnp.broadcast_to(delta1, (b * h, s, LSE_LANES))
@@ -280,8 +311,8 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
             grid=(b * h, s // block_q),
             in_specs=[
                 blk_q3,                                    # q
-                pl.BlockSpec((1, s, d), full),             # k
-                pl.BlockSpec((1, s, d), full),             # v
+                pl.BlockSpec((1, s, d), kv_map),           # k
+                pl.BlockSpec((1, s, d), kv_map),           # v
                 blk_q3,                                    # do
                 blk_q1,                                    # lse
                 blk_q1,                                    # delta
@@ -291,6 +322,8 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
             interpret=interpret,
         )(qt, kt, vt, dot, lse3, delta)
 
+    # dk/dv: per-q-head partials (kv blocks fetched through kv_map — no
+    # materialized repeat), summed over each kv head's query group after
     with jax.enable_x64(False):
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_kernel, causal=causal, block_q=block_q,
@@ -298,8 +331,10 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
             grid=(b * h, s // block_k),
             in_specs=[
                 pl.BlockSpec((1, s, d), full),             # q
-                blk_k3,                                    # k
-                blk_k3,                                    # v
+                pl.BlockSpec((1, block_k, d),
+                             lambda bi, ki: (kv_map(bi, ki)[0], ki, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda bi, ki: (kv_map(bi, ki)[0], ki, 0)),
                 pl.BlockSpec((1, s, d), full),             # do
                 pl.BlockSpec((1, s, LSE_LANES), full),     # lse
                 pl.BlockSpec((1, s, LSE_LANES), full),     # delta
@@ -310,5 +345,13 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
             interpret=interpret,
         )(qt, kt, vt, dot, lse3, delta)
 
-    from_bh = lambda t: jnp.swapaxes(t.reshape(b, h, s, d), 1, 2)
-    return from_bh(dq), from_bh(dk), from_bh(dv)
+    dq_out = jnp.swapaxes(dq.reshape(b, h, s, d), 1, 2)
+    if n_rep > 1:
+        dk = dk.reshape(b, h_kv, n_rep, s, d).sum(2)
+        dv = dv.reshape(b, h_kv, n_rep, s, d).sum(2)
+        dk_out = jnp.swapaxes(dk, 1, 2)
+        dv_out = jnp.swapaxes(dv, 1, 2)
+    else:
+        dk_out = jnp.swapaxes(dk.reshape(b, h_kv, s, d), 1, 2)
+        dv_out = jnp.swapaxes(dv.reshape(b, h_kv, s, d), 1, 2)
+    return dq_out, dk_out, dv_out
