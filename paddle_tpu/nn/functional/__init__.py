@@ -349,10 +349,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         else None
 
     def f(q, k, v):
-        if k.shape[2] != q.shape[2]:  # GQA: expand shared kv heads
-            n_rep = q.shape[2] // k.shape[2]
-            k = jnp.repeat(k, n_rep, axis=2)
-            v = jnp.repeat(v, n_rep, axis=2)
+        k, v = fa.expand_kv_heads(q, k, v)  # GQA composite fallback
         # [B, S, H, D] -> [B, H, S, D]
         qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
         scale = 1.0 / math.sqrt(q.shape[-1])

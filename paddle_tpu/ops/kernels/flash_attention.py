@@ -24,11 +24,22 @@ import jax.numpy as jnp
 from ._common import available, force_interpret, interpret_mode  # noqa: F401
 
 
-def _reference_attention(q, k, v, causal):
-    if k.shape[2] != q.shape[2]:  # GQA fallback: expand the shared kv heads
+def expand_kv_heads(q, k, v):
+    """GQA fallback for composite paths: expand shared kv heads to match q
+    (the Pallas kernels instead read shared heads via their index map)."""
+    if k.shape[2] != q.shape[2]:
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(
+                f"q heads {q.shape[2]} not a multiple of kv heads "
+                f"{k.shape[2]}")
         n_rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, n_rep, axis=2)
         v = jnp.repeat(v, n_rep, axis=2)
+    return k, v
+
+
+def _reference_attention(q, k, v, causal):
+    k, v = expand_kv_heads(q, k, v)
     qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale

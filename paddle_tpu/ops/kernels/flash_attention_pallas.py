@@ -340,18 +340,22 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
                 pl.BlockSpec((1, s, LSE_LANES), full),     # delta
             ],
             out_specs=[blk_k3, blk_k3],
-            out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-                       jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+            # GQA partials stay f32 until after the group sum — casting each
+            # partial to bf16 first would add rounding the h_kv==h path
+            # doesn't have
+            out_shape=[
+                jax.ShapeDtypeStruct(
+                    (b * h, s, d), jnp.float32 if n_rep > 1 else k.dtype),
+                jax.ShapeDtypeStruct(
+                    (b * h, s, d), jnp.float32 if n_rep > 1 else v.dtype),
+            ],
             interpret=interpret,
         )(qt, kt, vt, dot, lse3, delta)
 
     dq_out = jnp.swapaxes(dq.reshape(b, h, s, d), 1, 2)
-    if n_rep > 1:
-        dk = dk.reshape(b, h_kv, n_rep, s, d).sum(2)
-        dv = dv.reshape(b, h_kv, n_rep, s, d).sum(2)
-        dk_out = jnp.swapaxes(dk, 1, 2)
-        dv_out = jnp.swapaxes(dv, 1, 2)
-    else:
-        dk_out = jnp.swapaxes(dk.reshape(b, h_kv, s, d), 1, 2)
-        dv_out = jnp.swapaxes(dv.reshape(b, h_kv, s, d), 1, 2)
+    # n_rep==1 reduces over a size-1 axis — same result, no special case
+    dk_out = jnp.swapaxes(
+        dk.reshape(b, h_kv, n_rep, s, d).sum(2).astype(k.dtype), 1, 2)
+    dv_out = jnp.swapaxes(
+        dv.reshape(b, h_kv, n_rep, s, d).sum(2).astype(v.dtype), 1, 2)
     return dq_out, dk_out, dv_out

@@ -240,16 +240,43 @@ def test_gqa_backward_matches_reference(causal):
 
 
 def test_gqa_flash_attention_end_to_end():
-    """flash_attention() public entry with GQA under interpret mode +
-    the SDPA composite path both match the expanded reference."""
+    """flash_attention() public custom-vjp entry with GQA under interpret
+    mode (kernel path incl. kv-head-shaped cotangents) + the SDPA composite
+    path both match the expanded reference."""
     from paddle_tpu.ops.kernels._common import force_interpret
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
 
     q, k, v = _rand_qkv(h=4, kv_h=1, s=64, seed=5)  # MQA extreme
     ref = _ref(q, k, v, True)
+
+    # kernel path through the public custom_vjp wrapper (interpret mode)
+    force_interpret(True)
+    try:
+        out_k = fa.flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        loss = lambda a, b_, c: jnp.sum(fa.flash_attention(a, b_, c,
+                                                           causal=True))
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        assert dk.shape == k.shape and dv.shape == v.shape
+        ref_loss = lambda a, b_, c: jnp.sum(_ref(a, b_, c, True))
+        rdq, rdk, rdv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
+                                   rtol=2e-4, atol=2e-4)
+    finally:
+        force_interpret(False)
+
     # composite path (no pallas): SDPA expands kv internally now
     qt, kt, vt = (paddle.to_tensor(np.asarray(t)) for t in (q, k, v))
     out = F.scaled_dot_product_attention(qt, kt, vt, is_causal=True)
     np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4,
                                atol=2e-4)
+
+    # non-divisible head counts fail loudly on both paths
+    qbad = jnp.ones((1, 64, 6, 8))
+    kbad = jnp.ones((1, 64, 4, 8))
+    with pytest.raises(ValueError, match="not a multiple"):
+        fa.expand_kv_heads(qbad, kbad, kbad)

@@ -1,0 +1,190 @@
+"""Numeric-gradient op tests through the OpTest harness (reference test
+discipline: test/legacy_test/* check_output + check_grad against finite
+differences). One representative per op family."""
+
+import numpy as np
+import pytest
+from scipy import special as _sp  # noqa: F401  (guarded import below)
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+def _rand(*shape, seed=0, lo=-1.0, hi=1.0):
+    rng = np.random.default_rng(seed)
+    return (rng.random(shape) * (hi - lo) + lo).astype(np.float32)
+
+
+class TestElementwiseMul(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x, y: x * y
+        self.np_ref = lambda x, y: x * y
+        self.inputs = {"x": _rand(3, 4, seed=1), "y": _rand(3, 4, seed=2)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"])
+
+
+class TestMatmul(OpTest):
+    def setup_method(self, m):
+        self.op = paddle.matmul
+        self.np_ref = lambda x, y: x @ y
+        self.inputs = {"x": _rand(4, 5, seed=3), "y": _rand(5, 3, seed=4)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"])
+
+
+class TestSoftmax(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.nn.functional.softmax(x, axis=-1)
+
+        def ref(x):
+            e = np.exp(x - x.max(-1, keepdims=True))
+            return e / e.sum(-1, keepdims=True)
+
+        self.np_ref = ref
+        self.inputs = {"x": _rand(2, 6, seed=5, lo=-2, hi=2)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestTanh(OpTest):
+    def setup_method(self, m):
+        self.op = paddle.tanh
+        self.np_ref = np.tanh
+        self.inputs = {"x": _rand(8, seed=6, lo=-2, hi=2)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestReduceMean(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.mean(x, axis=1)
+        self.np_ref = lambda x: x.mean(1)
+        self.inputs = {"x": _rand(3, 5, seed=7)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestTransposeReshape(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.transpose(x, [1, 0]).reshape([2, 6])
+        self.np_ref = lambda x: x.T.reshape(2, 6)
+        self.inputs = {"x": _rand(4, 3, seed=8)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestSigmoidCrossEntropy(OpTest):
+    grad_atol = 1e-2
+
+    def setup_method(self, m):
+        lbl = (np.arange(6) % 2).astype(np.float32).reshape(2, 3)
+        self.op = lambda x: paddle.nn.functional \
+            .binary_cross_entropy_with_logits(x, paddle.to_tensor(lbl))
+
+        def ref(x):
+            p = 1.0 / (1.0 + np.exp(-x))
+            eps = 1e-12
+            return -(lbl * np.log(p + eps)
+                     + (1 - lbl) * np.log(1 - p + eps)).mean()
+
+        self.np_ref = ref
+        self.inputs = {"x": _rand(2, 3, seed=9, lo=-2, hi=2)}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+        self.check_grad(["x"])
+
+
+class TestGelu(OpTest):
+    def setup_method(self, m):
+        self.op = paddle.nn.functional.gelu
+
+        def ref(x):
+            from scipy.special import erf
+            return x * 0.5 * (1.0 + erf(x / np.sqrt(2.0)))
+
+        self.np_ref = ref
+        self.inputs = {"x": _rand(10, seed=10, lo=-2, hi=2)}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+        self.check_grad(["x"])
+
+
+class TestLayerNorm(OpTest):
+    grad_atol = 1e-2
+    grad_rtol = 1e-2
+
+    def setup_method(self, m):
+        self.op = lambda x: paddle.nn.functional.layer_norm(
+            x, x.shape[-1], epsilon=1e-5)
+
+        def ref(x):
+            mu = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            return (x - mu) / np.sqrt(var + 1e-5)
+
+        self.np_ref = ref
+        self.inputs = {"x": _rand(3, 8, seed=11)}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+        self.check_grad(["x"])
+
+
+class TestConv2D(OpTest):
+    grad_atol = 1e-2
+    grad_rtol = 1e-2
+
+    def setup_method(self, m):
+        self.op = lambda x, w: paddle.nn.functional.conv2d(x, w, padding=1)
+
+        def ref(x, w):
+            n, c, h, wd = x.shape
+            co, ci, kh, kw = w.shape
+            xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+            out = np.zeros((n, co, h, wd), np.float64)
+            for i in range(h):
+                for j in range(wd):
+                    patch = xp[:, :, i:i + kh, j:j + kw]
+                    out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+            return out.astype(np.float32)
+
+        self.np_ref = ref
+        self.inputs = {"x": _rand(1, 2, 4, 4, seed=12),
+                       "w": _rand(3, 2, 3, 3, seed=13)}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+        self.check_grad(["x", "w"])
+
+
+class TestWhereGather(OpTest):
+    def setup_method(self, m):
+        idx = np.array([2, 0, 1])
+        self.op = lambda x: paddle.gather(
+            paddle.where(x > 0, x, x * 0.1), paddle.to_tensor(idx), axis=0)
+
+        def ref(x):
+            y = np.where(x > 0, x, x * 0.1)
+            return y[idx]
+
+        self.np_ref = ref
+        self.inputs = {"x": _rand(4, 3, seed=14, lo=-1, hi=1)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
