@@ -1,0 +1,52 @@
+"""CIFAR reader factories (reference: python/paddle/dataset/cifar.py).
+Parses the cached python-pickle tarballs via paddle_tpu.vision.datasets."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ['train10', 'test10', 'train100', 'test100']
+
+_DIR = os.path.join(DATA_HOME, 'cifar')
+
+
+def _reader(fname, mode, data_file=None, cifar100=False):
+    from ..vision.datasets import Cifar10, Cifar100
+
+    data_file = data_file or os.path.join(_DIR, fname)
+    if not os.path.exists(data_file):
+        raise RuntimeError(
+            f"CIFAR archive not cached (no network egress); place {fname} "
+            f"under {_DIR} or pass data_file=")
+    cls = Cifar100 if cifar100 else Cifar10
+    ds = cls(data_file=data_file, mode=mode)
+
+    def reader():
+        for i in range(len(ds)):
+            img, lbl = ds[i]
+            yield np.asarray(img).reshape(-1).astype('float32') / 255.0, \
+                int(lbl)
+
+    return reader
+
+
+def train10(data_file=None):
+    return _reader('cifar-10-python.tar.gz', 'train', data_file)
+
+
+def test10(data_file=None):
+    return _reader('cifar-10-python.tar.gz', 'test', data_file)
+
+
+def train100(data_file=None):
+    return _reader('cifar-100-python.tar.gz', 'train', data_file,
+                   cifar100=True)
+
+
+def test100(data_file=None):
+    return _reader('cifar-100-python.tar.gz', 'test', data_file,
+                   cifar100=True)

@@ -1,0 +1,66 @@
+"""PTB language-model reader (reference: python/paddle/dataset/imikolov.py):
+n-gram or sequence samples over a word vocabulary built from the cached
+ptb.train.txt / ptb.valid.txt."""
+
+from __future__ import annotations
+
+import collections
+import os
+
+from .common import DATA_HOME
+
+__all__ = ['build_dict', 'train', 'test']
+
+_DIR = os.path.join(DATA_HOME, 'imikolov')
+
+
+def _lines(fname, path=None):
+    path = path or os.path.join(_DIR, fname)
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"PTB file not cached (no network egress); place {fname} under "
+            f"{_DIR}")
+    with open(path) as f:
+        for line in f:
+            yield line.strip().split()
+
+
+def build_dict(min_word_freq=50, train_filename='ptb.train.txt', path=None):
+    """word -> id, most-frequent-first; '<unk>' is always present."""
+    freq = collections.Counter()
+    for words in _lines(train_filename, path):
+        freq.update(words)
+    freq.pop('<unk>', None)
+    # strict > cutoff (reference imikolov.py build_dict) so vocab ids line
+    # up with reference-trained embeddings
+    kept = sorted((w for w, c in freq.items() if c > min_word_freq),
+                  key=lambda w: (-freq[w], w))
+    word_dict = {w: i for i, w in enumerate(kept)}
+    word_dict['<unk>'] = len(word_dict)
+    return word_dict
+
+
+def _reader(filename, word_dict, n, data_type='NGRAM', path=None):
+    unk = word_dict['<unk>']
+
+    def reader():
+        for words in _lines(filename, path):
+            sent = ['<s>'] + words + ['<e>']
+            if data_type == 'NGRAM':
+                if len(sent) < n:
+                    continue
+                ids = [word_dict.get(w, unk) for w in sent]
+                for i in range(n, len(ids) + 1):
+                    yield tuple(ids[i - n: i])
+            else:  # SEQ
+                yield [word_dict.get(w, unk) for w in sent]
+
+    return reader
+
+
+def train(word_dict, n, data_type='NGRAM', path=None):
+    return _reader('ptb.train.txt', word_dict, n, data_type, path)
+
+
+def test(word_dict, n, data_type='NGRAM', path=None):
+    return _reader('ptb.valid.txt', word_dict, n, data_type, path)

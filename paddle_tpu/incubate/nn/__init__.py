@@ -3,3 +3,13 @@ python/paddle/incubate/nn/). The fused layers map onto XLA-fused composites /
 pallas kernels."""
 from . import functional  # noqa: F401
 from .functional import memory_efficient_attention  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedDropoutAdd,
+    FusedEcMoe,
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)

@@ -16,7 +16,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "DatasetFolder"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder"]
 
 
 class MNIST(Dataset):
@@ -74,17 +74,27 @@ class Cifar10(Dataset):
         if data_file is None:
             raise ValueError("Cifar10 needs data_file (no network download)")
         self.transform = transform
-        wanted = ["data_batch"] if mode == "train" else ["test_batch"]
+        wanted = self._members(mode)
         xs, ys = [], []
         with tarfile.open(data_file, "r:*") as tf:
             for m in tf.getmembers():
-                if any(w in m.name for w in wanted):
+                if any(m.name.endswith(w) or w in m.name for w in wanted):
                     d = pickle.load(tf.extractfile(m), encoding="bytes")
                     xs.append(np.asarray(d[b"data"]))
-                    ys.extend(d[b"labels"])
+                    ys.extend(d[self._label_key])
+        if not xs:
+            raise ValueError(
+                f"no {wanted} members found in {data_file}; wrong archive "
+                f"for {type(self).__name__}?")
         self.images = np.concatenate(xs).reshape(-1, 3, 32, 32) \
             .transpose(0, 2, 3, 1)
         self.labels = np.asarray(ys, dtype=np.int64)
+
+    _label_key = b"labels"
+
+    @staticmethod
+    def _members(mode):
+        return ["data_batch"] if mode == "train" else ["test_batch"]
 
     def __len__(self):
         return len(self.labels)
@@ -94,6 +104,17 @@ class Cifar10(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return img, self.labels[i]
+
+
+class Cifar100(Cifar10):
+    """CIFAR-100 python tarball: members cifar-100-python/{train,test},
+    labels under b'fine_labels' (reference datasets/cifar.py mode100)."""
+
+    _label_key = b"fine_labels"
+
+    @staticmethod
+    def _members(mode):
+        return ["/train"] if mode == "train" else ["/test"]
 
 
 class DatasetFolder(Dataset):

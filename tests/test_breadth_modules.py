@@ -295,3 +295,46 @@ def test_onnx_export_gated(tmp_path):
     except ImportError:
         # without the onnx package the StableHLO fallback must still land
         assert os.path.exists(prefix + ".pdmodel")
+
+
+def test_dataset_imikolov(tmp_path):
+    text = "the cat sat on the mat\nthe dog sat on the log\n"
+    p = tmp_path / "ptb.train.txt"
+    p.write_text(text)
+    wd = paddle.dataset.imikolov.build_dict(min_word_freq=1, path=str(p))
+    assert '<unk>' in wd and 'the' in wd
+    grams = list(paddle.dataset.imikolov.train(wd, 3, path=str(p))())
+    # each sentence of 6 words + <s>/<e> yields 6 trigrams
+    assert len(grams) == 12
+    assert all(len(g) == 3 for g in grams)
+    seqs = list(paddle.dataset.imikolov.train(wd, 3, data_type='SEQ',
+                                              path=str(p))())
+    assert len(seqs) == 2 and len(seqs[0]) == 8
+
+
+def test_dataset_cifar_gated():
+    with pytest.raises(RuntimeError, match="not cached"):
+        paddle.dataset.cifar.train10()()
+
+
+def test_dataset_cifar100_parses_synthetic_tarball(tmp_path):
+    import pickle
+    import tarfile
+
+    rng = np.random.default_rng(0)
+    blob = {b"data": rng.integers(0, 255, (10, 3072), dtype=np.uint8),
+            b"fine_labels": list(range(10))}
+    inner = tmp_path / "train"
+    inner.write_bytes(pickle.dumps(blob, protocol=2))
+    tar = tmp_path / "cifar-100-python.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        tf.add(inner, arcname="cifar-100-python/train")
+
+    rows = list(paddle.dataset.cifar.train100(data_file=str(tar))())
+    assert len(rows) == 10
+    feats, lbl = rows[0]
+    assert feats.shape == (3072,) and 0 <= lbl < 10
+
+    from paddle_tpu.vision.datasets import Cifar10
+    with pytest.raises(ValueError, match="wrong archive"):
+        Cifar10(data_file=str(tar), mode="train")

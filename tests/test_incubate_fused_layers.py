@@ -1,0 +1,105 @@
+"""Tests for paddle.incubate.nn fused layer classes (reference:
+python/paddle/incubate/nn/layer/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _x(b=2, s=8, h=16, seed=0):
+    return paddle.to_tensor(
+        np.random.default_rng(seed).standard_normal((b, s, h))
+        .astype(np.float32))
+
+
+def test_fused_linear():
+    from paddle_tpu.incubate.nn import FusedLinear
+
+    paddle.seed(0)
+    fl = FusedLinear(16, 8)
+    x = _x()
+    out = fl(x)
+    assert out.shape == [2, 8, 8]
+    ref = paddle.nn.functional.linear(x, fl.weight, fl.bias)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    flt = FusedLinear(16, 8, transpose_weight=True)
+    assert flt.weight.shape == [8, 16]
+    assert flt(x).shape == [2, 8, 8]
+
+
+def test_fused_dropout_add():
+    from paddle_tpu.incubate.nn import FusedDropoutAdd
+
+    fda = FusedDropoutAdd(p=0.0)
+    x, y = _x(seed=1), _x(seed=2)
+    np.testing.assert_allclose(fda(x, y).numpy(), (x + y).numpy(), atol=1e-6)
+    fda.eval()
+    np.testing.assert_allclose(fda(x, y).numpy(), (x + y).numpy(), atol=1e-6)
+    assert "p=0.0" in fda.extra_repr()
+
+
+def test_fused_bias_dropout_residual_ln():
+    from paddle_tpu.incubate.nn import FusedBiasDropoutResidualLayerNorm
+
+    paddle.seed(1)
+    layer = FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+    layer.eval()
+    x, res = _x(seed=3), _x(seed=4)
+    out = layer(x, res)
+    assert out.shape == x.shape
+    # matches the composed reference ops
+    ref = paddle.nn.functional.layer_norm(
+        x + layer.linear_bias + res, 16, layer.ln_scale, layer.ln_bias, 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+
+@pytest.mark.parametrize("pre_ln", [False, True])
+def test_fused_mha_and_ffn_train(pre_ln):
+    from paddle_tpu.incubate.nn import FusedFeedForward, FusedMultiHeadAttention
+
+    paddle.seed(2)
+    attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                   attn_dropout_rate=0.0,
+                                   normalize_before=pre_ln)
+    ffn = FusedFeedForward(16, 32, dropout_rate=0.0, normalize_before=pre_ln)
+    x = _x(seed=5)
+    x.stop_gradient = False
+    out = ffn(attn(x))
+    assert out.shape == x.shape
+    out.sum().backward()
+    assert x.grad is not None
+    assert attn.qkv_weight._grad is not None
+    assert ffn.linear1_weight._grad is not None
+
+
+def test_fused_transformer_encoder_stack():
+    from paddle_tpu.incubate.nn import (
+        FusedMultiTransformer, FusedTransformerEncoderLayer,
+    )
+
+    paddle.seed(3)
+    layer = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    x = _x(seed=6)
+    assert layer(x).shape == x.shape
+
+    stack = FusedMultiTransformer(16, 4, 32, num_layers=2)
+    stack.eval()
+    assert stack(x).shape == x.shape
+
+
+def test_fused_ec_moe():
+    from paddle_tpu.incubate.nn import FusedEcMoe
+
+    paddle.seed(4)
+    moe = FusedEcMoe(16, 32, num_experts=4)
+    x = _x(b=2, s=8, seed=7)
+    x.stop_gradient = False
+    out = moe(x)
+    assert out.shape == x.shape
+    out.sum().backward()
+    assert moe.w1._grad is not None and moe.gate._grad is not None
+    with pytest.raises(ValueError):
+        FusedEcMoe(16, 32, 4, act_type="tanh")(x)
