@@ -8,8 +8,9 @@ from __future__ import annotations
 
 from . import cifar  # noqa: F401
 from . import common  # noqa: F401
+from . import imdb  # noqa: F401
 from . import imikolov  # noqa: F401
 from . import mnist  # noqa: F401
 from . import uci_housing  # noqa: F401
 
-__all__ = ['common', 'mnist', 'uci_housing', 'cifar', 'imikolov']
+__all__ = ['common', 'mnist', 'uci_housing', 'cifar', 'imikolov', 'imdb']

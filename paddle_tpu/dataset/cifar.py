@@ -28,8 +28,10 @@ def _reader(fname, mode, data_file=None, cifar100=False):
     def reader():
         for i in range(len(ds)):
             img, lbl = ds[i]
-            yield np.asarray(img).reshape(-1).astype('float32') / 255.0, \
-                int(lbl)
+            # reference rows are channel-planar CHW (1024 R, 1024 G,
+            # 1024 B); the vision Dataset stores HWC for transforms
+            chw = np.asarray(img).transpose(2, 0, 1)
+            yield chw.reshape(-1).astype('float32') / 255.0, int(lbl)
 
     return reader
 

@@ -1,0 +1,73 @@
+"""IMDB sentiment reader (reference: python/paddle/dataset/imdb.py): parses
+the cached aclImdb tarball, builds a frequency-sorted word dict, yields
+(token-id list, label) samples."""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+import string
+import tarfile
+
+from .common import DATA_HOME
+
+__all__ = ['build_dict', 'train', 'test']
+
+_DIR = os.path.join(DATA_HOME, 'imdb')
+_TARBALL = 'aclImdb_v1.tar.gz'
+
+
+def _tokenize(text: str):
+    text = text.lower().translate(
+        str.maketrans('', '', string.punctuation))
+    return text.split()
+
+
+def _docs(pattern, data_file=None):
+    path = data_file or os.path.join(_DIR, _TARBALL)
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"IMDB tarball not cached (no network egress); place {_TARBALL} "
+            f"under {_DIR} or pass data_file=")
+    pat = re.compile(pattern)
+    with tarfile.open(path, 'r:*') as tf:
+        for m in tf.getmembers():
+            if m.isfile() and pat.match(m.name):
+                yield _tokenize(tf.extractfile(m).read().decode('utf-8',
+                                                                'ignore'))
+
+
+def build_dict(pattern=r'aclImdb/train/(pos|neg)/.*\.txt$', cutoff=150,
+               data_file=None):
+    """word -> id, most frequent first; words at/below cutoff drop to
+    '<unk>' (reference imdb.py build_dict semantics)."""
+    freq = collections.Counter()
+    for words in _docs(pattern, data_file):
+        freq.update(words)
+    kept = sorted((w for w, c in freq.items() if c > cutoff),
+                  key=lambda w: (-freq[w], w))
+    word_dict = {w: i for i, w in enumerate(kept)}
+    word_dict['<unk>'] = len(word_dict)
+    return word_dict
+
+
+def _reader(word_dict, split, data_file=None):
+    unk = word_dict['<unk>']
+
+    def reader():
+        # positives (label 0) then negatives (label 1) — reference ordering
+        for label, part in ((0, 'pos'), (1, 'neg')):
+            pat = rf'aclImdb/{split}/{part}/.*\.txt$'
+            for words in _docs(pat, data_file):
+                yield [word_dict.get(w, unk) for w in words], label
+
+    return reader
+
+
+def train(word_dict, data_file=None):
+    return _reader(word_dict, 'train', data_file)
+
+
+def test(word_dict, data_file=None):
+    return _reader(word_dict, 'test', data_file)

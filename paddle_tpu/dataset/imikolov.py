@@ -45,15 +45,20 @@ def _reader(filename, word_dict, n, data_type='NGRAM', path=None):
 
     def reader():
         for words in _lines(filename, path):
-            sent = ['<s>'] + words + ['<e>']
             if data_type == 'NGRAM':
+                sent = ['<s>'] + words + ['<e>']
                 if len(sent) < n:
                     continue
                 ids = [word_dict.get(w, unk) for w in sent]
                 for i in range(n, len(ids) + 1):
                     yield tuple(ids[i - n: i])
-            else:  # SEQ
-                yield [word_dict.get(w, unk) for w in sent]
+            else:  # SEQ: (src, trg) shifted pair (reference imikolov.py:105)
+                ids = [word_dict.get(w, unk) for w in words]
+                src = [word_dict.get('<s>', unk)] + ids
+                trg = ids + [word_dict.get('<e>', unk)]
+                if n > 0 and len(src) > n:
+                    continue
+                yield src, trg
 
     return reader
 

@@ -40,6 +40,14 @@ def _parse(argv):
     p.add_argument("--devices", default=None,
                    help="comma-separated local device ids")
     p.add_argument("--max_restart", type=int, default=0)
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective", "ps"],
+                   help="collective (default) or parameter-server pods")
+    p.add_argument("--server_num", type=int, default=1,
+                   help="ps mode: number of parameter servers")
+    p.add_argument("--trainer_num", type=int, default=None,
+                   help="ps mode: number of trainers "
+                        "(default: nproc_per_node)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -75,6 +83,28 @@ def _worker_env(args, local_rank, master):
     return env
 
 
+def _ps_env(args, role, index, server_eps, trainer_eps, master):
+    """PS-mode env contract (reference launch/controllers/ps.py build_pod:
+    PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_TRAINING_ROLE / PADDLE_PORT)."""
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_MASTER": master,
+        "PADDLE_JOB_ID": args.job_id,
+        "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(trainer_eps),
+        "PADDLE_TRAINERS_NUM": str(len(trainer_eps)),
+        "PADDLE_TRAINING_ROLE": role,
+    })
+    if role == "PSERVER":
+        ip, port = server_eps[index].rsplit(":", 1)
+        env.update({"PADDLE_PORT": port, "POD_IP": ip,
+                    "PADDLE_CURRENT_ENDPOINT": server_eps[index]})
+    else:
+        env.update({"PADDLE_TRAINER_ID": str(index),
+                    "PADDLE_CURRENT_ENDPOINT": trainer_eps[index]})
+    return env
+
+
 def launch(argv=None):
     args = _parse(argv if argv is not None else sys.argv[1:])
     master = args.master or f"127.0.0.1:{_free_port()}"
@@ -82,19 +112,43 @@ def launch(argv=None):
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
 
+    if args.run_mode == "ps":
+        if args.nnodes != 1:
+            raise SystemExit(
+                "--run_mode ps supports a single node in this build; "
+                "multi-node PS pods need externally assigned endpoints "
+                "(set PADDLE_PSERVERS_IP_PORT_LIST yourself)")
+        n_tr = (args.trainer_num if args.trainer_num is not None
+                else args.nproc_per_node)
+        server_eps = [f"127.0.0.1:{_free_port()}"
+                      for _ in range(args.server_num)]
+        trainer_eps = [f"127.0.0.1:{_free_port()}" for _ in range(n_tr)]
+        jobs = ([("PSERVER", i) for i in range(args.server_num)]
+                + [("TRAINER", i) for i in range(n_tr)])
+    else:
+        jobs = None
+
     def spawn(local_rank):
-        env = _worker_env(args, local_rank, master)
+        if jobs is not None:
+            role, idx = jobs[local_rank]
+            env = _ps_env(args, role, idx, server_eps, trainer_eps, master)
+        else:
+            env = _worker_env(args, local_rank, master)
         cmd = [sys.executable, args.training_script] + \
             args.training_script_args
         if log_dir:
-            rank = env["PADDLE_TRAINER_ID"]
-            logf = open(os.path.join(
-                log_dir, f"workerlog.{rank}"), "ab")
+            if jobs is not None:
+                role, idx = jobs[local_rank]
+                tag = f"{role.lower()}log.{idx}"
+            else:
+                tag = f"workerlog.{env['PADDLE_TRAINER_ID']}"
+            logf = open(os.path.join(log_dir, tag), "ab")
             return subprocess.Popen(cmd, env=env, stdout=logf,
                                     stderr=subprocess.STDOUT), logf
         return subprocess.Popen(cmd, env=env), None
 
-    procs = [spawn(i) for i in range(args.nproc_per_node)]
+    n_procs = len(jobs) if jobs is not None else args.nproc_per_node
+    procs = [spawn(i) for i in range(n_procs)]
     restarts = [0] * len(procs)
     rc = 0
     try:

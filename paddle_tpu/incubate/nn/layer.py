@@ -71,8 +71,10 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
         self.linear_bias = self.create_parameter([embed_dim], attr=bias_attr,
                                                  is_bias=True)
         self.ln_scale = self.create_parameter(
-            [embed_dim], default_initializer=paddle.nn.initializer.Constant(1.0))
-        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+            [embed_dim], attr=weight_attr,
+            default_initializer=paddle.nn.initializer.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                             is_bias=True)
 
     def forward(self, x, residual):
         return IF.fused_bias_dropout_residual_layer_norm(
@@ -105,12 +107,13 @@ class FusedMultiHeadAttention(Layer):
         self.dropout_rate = dropout_rate
         self.attn_dropout_rate = attn_dropout_rate
         self.epsilon = epsilon
-        # [3, H, D, E] packed qkv like the reference kernel layout, stored
-        # flat [E, 3E] for one MXU-friendly contraction
-        self.qkv_weight = self.create_parameter([embed_dim, 3 * embed_dim],
-                                                attr=qkv_weight_attr)
-        self.qkv_bias = self.create_parameter([3 * embed_dim],
-                                              attr=qkv_bias_attr, is_bias=True)
+        # reference checkpoint layout: qkv_weight [3, H, D, E],
+        # qkv_bias [3, H, D] — kept verbatim so fused-transformer state
+        # dicts load; the einsum below is still ONE MXU contraction
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, embed_dim], attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, self.head_dim], attr=qkv_bias_attr, is_bias=True)
         self.linear_weight = self.create_parameter([embed_dim, embed_dim],
                                                    attr=linear_weight_attr)
         self.linear_bias = self.create_parameter([embed_dim],
@@ -143,8 +146,9 @@ class FusedMultiHeadAttention(Layer):
             x = F.layer_norm(x, self.embed_dim, self.pre_ln_scale,
                              self.pre_ln_bias, self.epsilon)
         b, s, _ = x.shape
-        qkv = F.linear(x, self.qkv_weight, self.qkv_bias)
-        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        import paddle_tpu as _p
+        qkv = _p.einsum("bse,khde->bskhd", x, self.qkv_weight) \
+            + self.qkv_bias
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,

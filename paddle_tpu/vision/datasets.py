@@ -78,7 +78,7 @@ class Cifar10(Dataset):
         xs, ys = [], []
         with tarfile.open(data_file, "r:*") as tf:
             for m in tf.getmembers():
-                if any(m.name.endswith(w) or w in m.name for w in wanted):
+                if any(w in m.name for w in wanted):
                     d = pickle.load(tf.extractfile(m), encoding="bytes")
                     xs.append(np.asarray(d[b"data"]))
                     ys.extend(d[self._label_key])

@@ -307,9 +307,15 @@ def test_dataset_imikolov(tmp_path):
     # each sentence of 6 words + <s>/<e> yields 6 trigrams
     assert len(grams) == 12
     assert all(len(g) == 3 for g in grams)
-    seqs = list(paddle.dataset.imikolov.train(wd, 3, data_type='SEQ',
+    # SEQ mode: (src, trg) shifted pair, skipped when longer than n
+    seqs = list(paddle.dataset.imikolov.train(wd, 0, data_type='SEQ',
                                               path=str(p))())
-    assert len(seqs) == 2 and len(seqs[0]) == 8
+    assert len(seqs) == 2
+    src, trg = seqs[0]
+    assert len(src) == len(trg) == 7
+    assert src[1:] == trg[:-1]  # shifted by one
+    assert list(paddle.dataset.imikolov.train(wd, 3, data_type='SEQ',
+                                              path=str(p))()) == []
 
 
 def test_dataset_cifar_gated():
@@ -338,3 +344,29 @@ def test_dataset_cifar100_parses_synthetic_tarball(tmp_path):
     from paddle_tpu.vision.datasets import Cifar10
     with pytest.raises(ValueError, match="wrong archive"):
         Cifar10(data_file=str(tar), mode="train")
+
+
+def test_dataset_imdb_synthetic_tarball(tmp_path):
+    import tarfile
+
+    root = tmp_path / "aclImdb"
+    for split in ("train", "test"):
+        for part, texts in (("pos", ["good movie great fun good",
+                                     "great great good"]),
+                            ("neg", ["bad boring bad"])):
+            d = root / split / part
+            d.mkdir(parents=True)
+            for i, t in enumerate(texts):
+                (d / f"{i}_7.txt").write_text(t)
+    tar = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        tf.add(root, arcname="aclImdb")
+
+    wd = paddle.dataset.imdb.build_dict(cutoff=0, data_file=str(tar))
+    assert "good" in wd and "<unk>" in wd
+    rows = list(paddle.dataset.imdb.train(wd, data_file=str(tar))())
+    assert len(rows) == 3
+    labels = [lbl for _, lbl in rows]
+    assert labels == [0, 0, 1]  # pos docs first, then neg
+    ids, _ = rows[0]
+    assert all(isinstance(i, int) for i in ids)

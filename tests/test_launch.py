@@ -70,3 +70,45 @@ def test_launcher_env_contract(tmp_path):
             for f in sorted(os.listdir(log_dir))]
     assert "0 2 True jobx" in logs[0]
     assert "1 2 True jobx" in logs[1]
+
+
+def test_launch_ps_mode(tmp_path):
+    """ps run_mode materializes the parameter-server env contract
+    (PADDLE_TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_PORT)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import json, os, sys\n"
+        "keys = ['PADDLE_TRAINING_ROLE', 'PADDLE_PSERVERS_IP_PORT_LIST',\n"
+        "        'PADDLE_TRAINERS_NUM', 'PADDLE_CURRENT_ENDPOINT']\n"
+        "info = {k: os.environ.get(k) for k in keys}\n"
+        "info['port'] = os.environ.get('PADDLE_PORT')\n"
+        "info['tid'] = os.environ.get('PADDLE_TRAINER_ID')\n"
+        "print('PROBE ' + json.dumps(info), flush=True)\n")
+    log_dir = tmp_path / "logs"
+    rc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--run_mode", "ps", "--server_num", "2", "--trainer_num", "2",
+         "--log_dir", str(log_dir), str(script)],
+        cwd="/root/repo", capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    logs = sorted(os.listdir(log_dir))
+    assert logs == ["pserverlog.0", "pserverlog.1",
+                    "trainerlog.0", "trainerlog.1"], logs
+    infos = []
+    for f in logs:
+        text = (log_dir / f).read_text()
+        infos.append(json.loads(text.split("PROBE ", 1)[1]))
+    servers = [i for i in infos if i["PADDLE_TRAINING_ROLE"] == "PSERVER"]
+    trainers = [i for i in infos if i["PADDLE_TRAINING_ROLE"] == "TRAINER"]
+    assert len(servers) == 2 and len(trainers) == 2
+    eps = servers[0]["PADDLE_PSERVERS_IP_PORT_LIST"].split(",")
+    assert len(eps) == 2
+    assert all(s["port"] in e for s, e in zip(servers, eps))
+    assert sorted(t["tid"] for t in trainers) == ["0", "1"]
+    assert all(t["PADDLE_TRAINERS_NUM"] == "2" for t in infos)
