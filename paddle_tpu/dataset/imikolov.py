@@ -41,6 +41,12 @@ def build_dict(min_word_freq=50, train_filename='ptb.train.txt', path=None):
 
 
 def _reader(filename, word_dict, n, data_type='NGRAM', path=None):
+    if data_type not in ('NGRAM', 'SEQ'):
+        raise ValueError(f"data_type must be NGRAM or SEQ, got {data_type!r}")
+    if data_type == 'NGRAM' and n < 1:
+        raise ValueError(
+            f"NGRAM mode needs window size n >= 1, got {n} (the reference "
+            f"asserts the same)")
     unk = word_dict['<unk>']
 
     def reader():

@@ -164,6 +164,8 @@ def launch(argv=None):
                         print(f"[launch] worker {i} exited rc={ret}; "
                               f"restart {restarts[i]}/{args.max_restart}",
                               file=sys.stderr)
+                        if logf:  # don't leak the dead worker's log fd
+                            logf.close()
                         procs[i] = spawn(i)
                         alive = True
                     else:

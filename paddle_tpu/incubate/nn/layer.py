@@ -146,8 +146,7 @@ class FusedMultiHeadAttention(Layer):
             x = F.layer_norm(x, self.embed_dim, self.pre_ln_scale,
                              self.pre_ln_bias, self.epsilon)
         b, s, _ = x.shape
-        import paddle_tpu as _p
-        qkv = _p.einsum("bse,khde->bskhd", x, self.qkv_weight) \
+        qkv = paddle.einsum("bse,khde->bskhd", x, self.qkv_weight) \
             + self.qkv_bias
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         out = F.scaled_dot_product_attention(

@@ -1,0 +1,141 @@
+"""`paddle.incubate.optimizer` (reference: python/paddle/incubate/optimizer/
+— LookAhead, ModelAverage wrapper optimizers)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ['LookAhead', 'ModelAverage']
+
+
+class _WrappedOptimizer:
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def clear_grad(self, *a, **kw):
+        self._inner.clear_grad(*a, **kw)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        self._inner.set_state_dict(sd)
+
+
+class LookAhead(_WrappedOptimizer):
+    """Lookahead (reference incubate/optimizer/lookahead.py:25): the inner
+    (fast) optimizer steps normally; every k steps the slow weights move
+    alpha of the way toward the fast weights and the fast weights reset to
+    the slow copy."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        super().__init__(inner_optimizer)
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha should be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k should be a positive integer, got {k}")
+        self.alpha = alpha
+        self.k = int(k)
+        self._step_n = 0
+        # slow weights start at the params' current values (reference
+        # lookahead.py initializes slow_params from the initial weights)
+        self._slow = {id(p): p._data
+                      for p in inner_optimizer._parameter_list}
+
+    def step(self):
+        self._inner.step()
+        self._step_n += 1
+        if self._step_n % self.k:
+            return
+        for p in self._inner._parameter_list:
+            key = id(p)
+            slow = self._slow.get(key, p._data)
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[key] = slow
+            p._data = slow
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+
+
+class ModelAverage(_WrappedOptimizer):
+    """Weight averaging (reference incubate/optimizer/modelaverage.py:28):
+    keeps a running average of parameters; `apply()` swaps the averaged
+    weights in for evaluation, `restore()` swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 inner_optimizer=None, name=None):
+        class _Null:
+            _parameter_list = list(parameters or [])
+
+            def step(self):
+                pass
+
+            def clear_grad(self, *a, **kw):
+                pass
+
+            clear_gradients = clear_grad
+
+            def state_dict(self):
+                return {}
+
+            def set_state_dict(self, sd):
+                pass
+
+        super().__init__(inner_optimizer or _Null())
+        self._params = list(parameters) if parameters is not None \
+            else self._inner._parameter_list
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._params}
+        self._count = 0
+        self._saved = None
+        self.max_average_window = max_average_window
+
+    def step(self):
+        self._inner.step()
+        if self._count >= self.max_average_window:
+            # restart the window at half weight (reference rotates
+            # sum_1/sum_2/sum_3 windows; this keeps the same bounded-memory,
+            # recent-biased behavior)
+            for k in self._sum:
+                self._sum[k] = self._sum[k] * 0.5
+            self._count //= 2
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager style also works)."""
+        if self._count == 0 or self._saved is not None:
+            # second apply() without restore() must not overwrite the saved
+            # trained weights with the averaged ones
+            return self
+        self._saved = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = (self._sum[id(p)] / self._count).astype(p._data.dtype)
+        return self
+
+    def restore(self, executor=None):
+        if self._saved is None:
+            return
+        for p in self._params:
+            p._data = self._saved[id(p)]
+        self._saved = None
+
+    def __enter__(self):
+        return self.apply()
+
+    def __exit__(self, *exc):
+        self.restore()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()

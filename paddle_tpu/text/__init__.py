@@ -1,11 +1,13 @@
-"""paddle.text equivalent (reference: python/paddle/text/ — ViterbiDecoder
-in paddle.text.viterbi_decode / paddle.nn.LayerList of datasets).
+"""paddle.text equivalent (reference: python/paddle/text/ — ViterbiDecoder +
+map-style text datasets).
 
-The dataset zoo needs network downloads (unavailable here); the compute
-pieces — Viterbi decoding for sequence labeling — are implemented as
-TPU-compilable lax scans.
+Compute pieces (Viterbi decoding for sequence labeling) are TPU-compilable
+lax scans; the datasets load from locally cached files (no egress) through
+the paddle.dataset reader factories.
 """
 
+from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
 
-__all__ = ["ViterbiDecoder", "viterbi_decode"]
+__all__ = ["ViterbiDecoder", "viterbi_decode", "Imdb", "Imikolov",
+           "UCIHousing"]

@@ -1,0 +1,71 @@
+"""`paddle.text.datasets` (reference: python/paddle/text/datasets/ — map-style
+Dataset classes over the legacy reader factories). Built on
+paddle_tpu.dataset readers; files must be cached locally (no egress)."""
+
+from __future__ import annotations
+
+from ..io import Dataset
+
+__all__ = ['Imdb', 'Imikolov', 'UCIHousing']
+
+
+def _check_mode(mode):
+    if mode not in ('train', 'test'):
+        raise ValueError(f"mode must be 'train' or 'test', got {mode!r}")
+    return mode
+
+
+class _ReaderDataset(Dataset):
+    """Materializes a reader factory into an indexable dataset (the
+    reference classes likewise load fully into memory)."""
+
+    def __init__(self, reader):
+        self._rows = list(reader())
+
+    def __len__(self):
+        return len(self._rows)
+
+    def __getitem__(self, i):
+        return self._rows[i]
+
+
+class Imdb(_ReaderDataset):
+    """IMDB sentiment (reference text/datasets/imdb.py). mode: train|test."""
+
+    def __init__(self, data_file=None, mode='train', cutoff=150):
+        from ..dataset import imdb as _imdb
+
+        _check_mode(mode)
+
+        self.word_idx = _imdb.build_dict(cutoff=cutoff, data_file=data_file)
+        reader = (_imdb.train if mode == 'train' else _imdb.test)(
+            self.word_idx, data_file=data_file)
+        super().__init__(reader)
+
+
+class Imikolov(_ReaderDataset):
+    """PTB n-gram/sequence dataset (reference text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type='NGRAM', window_size=-1,
+                 mode='train', min_word_freq=50):
+        from ..dataset import imikolov as _mik
+
+        _check_mode(mode)
+
+        self.word_idx = _mik.build_dict(min_word_freq=min_word_freq,
+                                        path=data_file)
+        fn = _mik.train if mode == 'train' else _mik.test
+        super().__init__(fn(self.word_idx, window_size, data_type=data_type,
+                            path=data_file))
+
+
+class UCIHousing(_ReaderDataset):
+    """Boston housing regression (reference text/datasets/uci_housing.py)."""
+
+    def __init__(self, data_file=None, mode='train'):
+        from ..dataset import uci_housing as _uci
+
+        _check_mode(mode)
+
+        fn = _uci.train if mode == 'train' else _uci.test
+        super().__init__(fn(path=data_file))

@@ -1,0 +1,85 @@
+"""Tests for paddle.incubate.optimizer (LookAhead/ModelAverage) and
+paddle.text dataset classes."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_lookahead():
+    from paddle_tpu.incubate.optimizer import LookAhead
+
+    paddle.seed(0)
+    w = paddle.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+    w.name = "w"
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    traj = []
+    for _ in range(4):
+        (w * w).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        traj.append(float(w.numpy()[0]))
+    # fast steps: 4 -> 3.2 -> 2.56 (sync: slow=4+(2.56-4)/2=3.28 -> w=3.28)
+    assert traj[0] == pytest.approx(3.2, rel=1e-5)
+    assert traj[1] == pytest.approx(3.28, rel=1e-5)
+    with pytest.raises(ValueError):
+        LookAhead(inner, alpha=2.0)
+    with pytest.raises(ValueError):
+        LookAhead(inner, k=0)
+
+
+def test_model_average():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+
+    w = paddle.to_tensor(np.array([0.0], np.float32), stop_gradient=False)
+    w.name = "w"
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    avg = ModelAverage(parameters=[w], inner_optimizer=inner,
+                       max_average_window=100)
+    for _ in range(4):  # grad = -1 each step -> w: 1, 2, 3, 4
+        (w * paddle.to_tensor(np.array([-1.0], np.float32))).sum().backward()
+        avg.step()
+        inner.clear_grad()
+    assert float(w.numpy()[0]) == pytest.approx(4.0)
+    with avg:  # averaged weights active: mean(1,2,3,4) = 2.5
+        assert float(w.numpy()[0]) == pytest.approx(2.5)
+    assert float(w.numpy()[0]) == pytest.approx(4.0)  # restored
+
+    # window restart keeps the average recent-biased and bounded
+    avg2 = ModelAverage(parameters=[w], inner_optimizer=inner,
+                        max_average_window=2)
+    for _ in range(5):
+        (w * paddle.to_tensor(np.array([-1.0], np.float32))).sum().backward()
+        avg2.step()
+        inner.clear_grad()
+    avg2.apply()
+    assert 4.0 < float(w.numpy()[0]) <= 9.0
+    avg2.restore()
+
+
+def test_text_datasets(tmp_path):
+    # UCIHousing over a synthetic housing.data
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((20, 14)).astype(np.float32)
+    housing = tmp_path / "housing.data"
+    np.savetxt(housing, data)
+    from paddle_tpu.text import UCIHousing
+
+    ds = UCIHousing(data_file=str(housing), mode="train")
+    assert len(ds) == 16
+    feats, tgt = ds[0]
+    assert feats.shape == (13,) and tgt.shape == (1,)
+
+    # Imikolov over a synthetic ptb file
+    ptb = tmp_path / "ptb.train.txt"
+    ptb.write_text("a b c a b c\nc b a c b a\n")
+    from paddle_tpu.text import Imikolov
+
+    ds2 = Imikolov(data_file=str(ptb), data_type="NGRAM", window_size=2,
+                   mode="train", min_word_freq=1)
+    assert len(ds2) > 0
+    gram = ds2[0]
+    assert len(gram) == 2
+    assert "a" in ds2.word_idx
