@@ -125,4 +125,15 @@ def __getattr__(name):
         globals()["Model"] = mod.Model
         globals()["callbacks"] = mod.callbacks
         return globals()[name]
+    if name in ("summary", "flops"):
+        import importlib
+        mod = importlib.import_module(".hapi.model_summary", __name__)
+        globals()["summary"] = mod.summary
+        globals()["flops"] = mod.flops
+        return globals()[name]
+    if name == "utils":
+        import importlib
+        mod = importlib.import_module(".utils", __name__)
+        globals()["utils"] = mod
+        return mod
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

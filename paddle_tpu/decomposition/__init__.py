@@ -15,12 +15,22 @@ __all__ = ['decompose', 'primitives_of', 'has_composite']
 
 def _pure_fn(func, stop_gradient=False):
     """Lift a Tensor->Tensor callable to arrays->arrays (shared with
-    paddle_tpu.cost_model; stop_gradient=True skips autograd-node recording
-    for analysis-only traces)."""
+    paddle_tpu.cost_model; stop_gradient=True runs the whole call under
+    no_grad — analysis-only traces must not build vjps, which also matters
+    because `func` may close over Parameters that require grad)."""
+    import contextlib
+
     from ..core.tensor import Tensor
 
     def f(*arrs):
-        out = func(*[Tensor(a, stop_gradient=stop_gradient) for a in arrs])
+        if stop_gradient:
+            from ..autograd.grad_mode import no_grad
+            ctx = no_grad()
+        else:
+            ctx = contextlib.nullcontext()
+        with ctx:
+            out = func(*[Tensor(a, stop_gradient=stop_gradient)
+                         for a in arrs])
         if isinstance(out, (tuple, list)):
             return tuple(o._data if isinstance(o, Tensor) else o for o in out)
         return out._data if isinstance(out, Tensor) else out

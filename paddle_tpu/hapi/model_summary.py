@@ -1,0 +1,122 @@
+"""`paddle.summary` and `paddle.flops` (reference:
+python/paddle/hapi/model_summary.py and hapi/dynamic_flops.py).
+
+summary: forward-hook walk printing per-layer output shapes and parameter
+counts. flops: XLA's own cost analysis of the traced forward — exact for
+the whole program rather than a per-op estimate table."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['summary', 'flops']
+
+
+def _make_input(shape, dtype):
+    import paddle_tpu as paddle
+
+    shape = [1 if (s is None or s == -1) else int(s) for s in shape]
+    if dtype and ('int' in str(dtype)):
+        return paddle.to_tensor(np.zeros(shape, dtype=str(dtype)))
+    return paddle.to_tensor(np.zeros(shape, np.float32))
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print (and return) the per-layer summary table.
+
+    input_size: tuple or list of tuples (batch dim may be None/-1).
+    Returns {'total_params': N, 'trainable_params': M}."""
+    import paddle_tpu as paddle
+    from ..nn.layer import Layer
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = ([input_size] if isinstance(input_size[0], (int, type(None)))
+                 else list(input_size))
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes] * len(sizes)
+        inputs = [_make_input(s, d) for s, d in zip(sizes, dts)]
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    rows = []
+    hooks = []
+
+    def add_hook(layer, name):
+        def hook(lyr, ins, out):
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            shape = [list(o.shape) for o in outs
+                     if hasattr(o, 'shape')]
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr._parameters.values()
+                           if p is not None)
+            rows.append((f"{type(lyr).__name__}-{len(rows) + 1}",
+                         shape[0] if len(shape) == 1 else shape, n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers(include_self=False):
+        add_hook(sub, name)
+    if not hooks:  # plain layer with no children
+        add_hook(net, type(net).__name__)
+
+    was_training = getattr(net, 'training', False)
+    net.eval()
+    try:
+        with paddle.no_grad():
+            net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    name_w = max([len(r[0]) for r in rows] + [12]) + 2
+    line = "-" * (name_w + 40)
+    out_lines = [line, f"{'Layer (type)':<{name_w}}{'Output Shape':<24}"
+                 f"{'Param #':>10}", line]
+    for name, shape, n in rows:
+        out_lines.append(f"{name:<{name_w}}{str(shape):<24}{n:>10,}")
+    out_lines += [line,
+                  f"Total params: {total:,}",
+                  f"Trainable params: {trainable:,}",
+                  f"Non-trainable params: {total - trainable:,}",
+                  line]
+    print("\n".join(out_lines))
+    return {'total_params': total, 'trainable_params': trainable}
+
+
+def flops(net, input_size=None, custom_ops=None, print_detail=False,
+          inputs=None):
+    """FLOPs of one forward pass, from XLA's cost analysis of the traced
+    program (reference dynamic_flops.py estimates per-op; the compiler's
+    count covers everything it actually emits)."""
+    from ..cost_model import CostModel
+
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("flops needs input_size or inputs")
+        sizes = ([input_size] if isinstance(input_size[0], (int, type(None)))
+                 else list(input_size))
+        inputs = [_make_input(s, None) for s in sizes]
+    elif not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+
+    was_training = getattr(net, 'training', False)
+    net.eval()
+    try:
+        analysis = CostModel().static_cost(lambda *xs: net(*xs), *inputs)
+    finally:
+        if was_training:
+            net.train()
+    total = int(analysis.get('flops', 0))
+    if print_detail:
+        print(f"Total Flops: {total:,}")
+        for k in sorted(analysis):
+            if k.startswith('flops'):
+                print(f"  {k}: {analysis[k]}")
+    return total

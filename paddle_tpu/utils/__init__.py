@@ -1,5 +1,40 @@
 """paddle.utils equivalent (reference: python/paddle/utils/)."""
 
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
 
-__all__ = ["cpp_extension"]
+__all__ = ["cpp_extension", "deprecated", "try_import", "unique_name",
+           "dlpack", "require_version"]
+
+
+def require_version(min_version: str, max_version: str | None = None):
+    """Check the framework version against [min_version, max_version]
+    (reference base/framework.require_version)."""
+    import re
+
+    from .. import __version__
+
+    def parse(v):
+        parts = []
+        for seg in str(v).split("."):
+            m = re.match(r"\d+", seg)
+            if m is None:
+                raise ValueError(f"invalid version segment {seg!r} in {v!r}")
+            parts.append(int(m.group()))  # '2rc0' counts as 2
+        return parts
+
+    cur = parse(__version__)
+    lo = parse(min_version)
+    hi = parse(max_version) if max_version is not None else None
+    width = max(len(cur), len(lo), len(hi or []))
+    pad = lambda p: p + [0] * (width - len(p))  # 0.1 == 0.1.0
+    cur, lo = pad(cur), pad(lo)
+    if lo > cur:
+        raise RuntimeError(
+            f"installed version {__version__} < required min {min_version}")
+    if hi is not None and pad(hi) < cur:
+        raise RuntimeError(
+            f"installed version {__version__} > allowed max {max_version}")

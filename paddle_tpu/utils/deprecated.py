@@ -1,0 +1,38 @@
+"""`paddle.utils.deprecated` decorator (reference:
+python/paddle/utils/deprecated.py)."""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ['deprecated']
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """Mark an API deprecated: appends a notice to the docstring and warns
+    (level 0 silent, 1 DeprecationWarning, 2 raise)."""
+
+    def decorator(func):
+        msg = f"API \"{func.__module__}.{func.__name__}\" is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", and will be removed in future versions. Please use "\
+                   f"\"{update_to}\" instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level == 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = (f"\n    Warning:\n        {msg}\n\n"
+                           + (func.__doc__ or ""))
+        return wrapper
+
+    return decorator

@@ -1,0 +1,47 @@
+"""`paddle.utils.dlpack` (reference: python/paddle/utils/dlpack.py —
+to_dlpack/from_dlpack for zero-copy tensor exchange). TPU build: jax arrays
+speak DLPack natively; this wraps the framework Tensor."""
+
+from __future__ import annotations
+
+__all__ = ['to_dlpack', 'from_dlpack']
+
+
+def to_dlpack(x):
+    """Framework Tensor -> DLPack capsule (zero-copy where the backend
+    allows)."""
+    from ..core.tensor import as_tensor
+
+    arr = as_tensor(x)._data
+    try:
+        return arr.__dlpack__()
+    except Exception:
+        import jax.dlpack
+        return jax.dlpack.to_dlpack(arr)
+
+
+class _CapsuleWrapper:
+    """Adapts a bare DLPack capsule to the __dlpack__ protocol jax expects;
+    a capsule carries no device info, so it is presumed host-resident
+    (kDLCPU) — which is where cross-framework capsules originate here."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None, **kw):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # (kDLCPU, device 0)
+
+
+def from_dlpack(capsule):
+    """DLPack capsule (or any __dlpack__ exporter, e.g. a torch/numpy
+    tensor) -> framework Tensor."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if not hasattr(capsule, "__dlpack__"):
+        capsule = _CapsuleWrapper(capsule)
+    return Tensor(jnp.from_dlpack(capsule))

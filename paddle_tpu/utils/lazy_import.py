@@ -1,0 +1,20 @@
+"""`paddle.utils.try_import` (reference: python/paddle/utils/lazy_import.py)."""
+
+from __future__ import annotations
+
+import importlib
+
+__all__ = ['try_import']
+
+
+def try_import(module_name, err_msg=None):
+    """Import an optional dependency with a friendly error."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        if err_msg is None:
+            err_msg = (f"Failed importing {module_name}. This likely means "
+                       f"that some paddle modules require additional "
+                       f"dependencies that have to be manually installed "
+                       f"(usually with `pip install {module_name}`).")
+        raise ImportError(err_msg) from e

@@ -1,0 +1,53 @@
+"""`paddle.utils.unique_name` (reference:
+python/paddle/utils/unique_name.py → base/unique_name.py: generate/guard/
+switch over a per-generator counter map)."""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ['generate', 'switch', 'guard']
+
+
+class _Generator:
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+        self.ids: dict[str, int] = {}
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return "_".join([self.prefix + key, str(n)]) if self.prefix \
+            else f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key: str) -> str:
+    """Unique name with the given prefix key, e.g. generate('fc') -> fc_0."""
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Replace the global generator; returns the old one."""
+    global _generator
+    old = _generator
+    _generator = new_generator if new_generator is not None else _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope with a fresh (or given) generator; restores the old one."""
+    if isinstance(new_generator, str):
+        g = _Generator(new_generator)
+    elif isinstance(new_generator, bytes):
+        g = _Generator(new_generator.decode())
+    else:
+        g = new_generator
+    old = switch(g)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -286,3 +286,62 @@ def test_cost_model():
     assert static.get("flops", 0) > 0  # 64^3*2 matmul flops visible to XLA
     measured = cm.profile_measure(f, a, repeat=3, warmup=1)
     assert measured["time_s"] > 0
+
+
+def test_utils_breadth():
+    """paddle.utils: deprecated, try_import, unique_name, dlpack,
+    require_version (reference python/paddle/utils/)."""
+    import warnings
+
+    import numpy as np
+    import paddle_tpu as paddle
+
+    @paddle.utils.deprecated(update_to="paddle.new_api", since="0.1")
+    def old_api(v):
+        return v * 2
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_api(3) == 6
+        assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    assert "deprecated" in old_api.__doc__
+
+    np_mod = paddle.utils.try_import("numpy")
+    assert np_mod is np
+    with pytest.raises(ImportError):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+
+    from paddle_tpu.utils import unique_name
+    a, b = unique_name.generate("fc"), unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = paddle.utils.dlpack.to_dlpack(t)
+    back = paddle.utils.dlpack.from_dlpack(cap)
+    np.testing.assert_allclose(back.numpy(), t.numpy())
+    # interop: torch cpu tensor -> paddle tensor (torch is optional)
+    torch = pytest.importorskip("torch")
+    tt = torch.arange(4, dtype=torch.float32)
+    np.testing.assert_allclose(
+        paddle.utils.dlpack.from_dlpack(tt).numpy(), [0, 1, 2, 3])
+
+    paddle.utils.require_version("0.0.1")
+    with pytest.raises(RuntimeError):
+        paddle.utils.require_version("99.0")
+
+
+def test_summary_and_flops():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    info = paddle.summary(net, (None, 8))
+    expect = 8 * 16 + 16 + 16 * 4 + 4
+    assert info["total_params"] == expect
+    assert info["trainable_params"] == expect
+
+    fl = paddle.flops(net, (1, 8))
+    # two matmuls dominate: 2*(8*16) + 2*(16*4) flops per sample
+    assert fl >= 2 * 8 * 16
