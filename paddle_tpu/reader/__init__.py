@@ -1,0 +1,207 @@
+"""`paddle.reader` — legacy reader-composition decorators (reference:
+python/paddle/reader/decorator.py). Readers are no-arg callables yielding
+samples; these combinators cache/shuffle/batch/parallelize them."""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random
+import threading
+
+__all__ = ['cache', 'map_readers', 'shuffle', 'chain', 'compose', 'buffered',
+           'firstn', 'xmap_readers']
+
+
+def cache(reader):
+    """Materialize once; replay from memory on every call."""
+    all_data = None
+
+    def cached_reader():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        yield from all_data
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Zip several readers and map func over the tuples."""
+
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill buf_size samples, emit in random order."""
+
+    def shuffled_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return shuffled_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def chained_reader():
+        for r in readers:
+            yield from r()
+
+    return chained_reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Side-by-side composition: one sample from each reader per output
+    tuple (check_alignment=True raises when lengths differ)."""
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed_reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return composed_reader
+
+
+def buffered(reader, size):
+    """Decouple producer/consumer with a background thread + queue; a
+    producer exception is re-raised in the consumer, never swallowed as a
+    short clean epoch."""
+
+    end = object()
+
+    def buffered_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+        err = []
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+        if err:
+            raise err[0]
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    """Only the first n samples."""
+
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (the reference uses
+    threads here too; heavy decode work belongs in io.DataLoader's process
+    workers)."""
+
+    end = object()
+
+    def xreader():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+        errors = []
+
+        def feed():
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        return
+                    i, d = item
+                    out_q.put((i, mapper(d)))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+            finally:
+                # ALWAYS deliver the sentinel, even on a mapper crash —
+                # otherwise the consumer waits forever for this worker
+                out_q.put(end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        def check_errors():
+            if errors:
+                raise errors[0]
+        if order:
+            pending = {}
+            next_i = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                i, d = item
+                pending[i] = d
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+            check_errors()
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+            check_errors()
+
+    return xreader

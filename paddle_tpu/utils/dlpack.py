@@ -21,9 +21,10 @@ def to_dlpack(x):
 
 
 class _CapsuleWrapper:
-    """Adapts a bare DLPack capsule to the __dlpack__ protocol jax expects;
-    a capsule carries no device info, so it is presumed host-resident
-    (kDLCPU) — which is where cross-framework capsules originate here."""
+    """Adapts a bare DLPack capsule to the __dlpack__ protocol jax expects.
+    A capsule carries no device info, so only host-resident capsules can be
+    adopted this way; device tensors must come through an object exporter
+    (which carries __dlpack_device__)."""
 
     def __init__(self, capsule):
         self._capsule = capsule
@@ -38,10 +39,17 @@ class _CapsuleWrapper:
 def from_dlpack(capsule):
     """DLPack capsule (or any __dlpack__ exporter, e.g. a torch/numpy
     tensor) -> framework Tensor."""
+    import jax
     import jax.numpy as jnp
 
     from ..core.tensor import Tensor
 
     if not hasattr(capsule, "__dlpack__"):
+        if jax.default_backend() != "cpu":
+            raise ValueError(
+                "a bare DLPack capsule carries no device information and is "
+                "presumed host-resident, but the default backend is "
+                f"{jax.default_backend()!r}; pass the exporting tensor "
+                "object itself (anything with __dlpack__) instead")
         capsule = _CapsuleWrapper(capsule)
     return Tensor(jnp.from_dlpack(capsule))

@@ -1,0 +1,48 @@
+"""`paddle.version` (reference: generated python/paddle/version/__init__.py
+— full_version/major/minor/patch/rc plus build metadata queries)."""
+
+from __future__ import annotations
+
+from .. import __version__ as full_version  # single source of truth
+
+major, minor, patch = (full_version.split(".") + ["0", "0"])[:3]
+rc = "0"
+istaged = True
+commit = "unknown"
+
+__all__ = ['full_version', 'major', 'minor', 'patch', 'rc', 'show',
+           'cuda', 'cudnn', 'nccl', 'xpu', 'xpu_xccl', 'tpu']
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}\nminor: {minor}\npatch: {patch}\nrc: {rc}")
+    print(f"commit: {commit}")
+    print("tpu-native build: jax/XLA compute path, no CUDA")
+
+
+def cuda():
+    """CUDA version the build links against — none; this is a TPU build."""
+    return False
+
+
+def cudnn():
+    return False
+
+
+def nccl():
+    """No NCCL: collectives are XLA over ICI/DCN."""
+    return 0
+
+
+def xpu():
+    return False
+
+
+def xpu_xccl():
+    return 0
+
+
+def tpu():
+    """Accelerator target of this build."""
+    return True

@@ -10,7 +10,9 @@ from .. import nn
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "BasicBlock", "BottleneckBlock",
            "AlexNet", "alexnet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
-           "MobileNetV2", "mobilenet_v2", "SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+           "MobileNetV2", "mobilenet_v2", "SqueezeNet", "squeezenet1_0",
+           "squeezenet1_1", "ShuffleNetV2", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "DenseNet", "densenet121", "densenet169"]
 
 
 class LeNet(nn.Layer):
@@ -367,3 +369,157 @@ def squeezenet1_0(pretrained=False, **kw):
 
 def squeezenet1_1(pretrained=False, **kw):
     return SqueezeNet("1.1", **kw)
+
+
+class _ShuffleUnit(nn.Layer):
+    """ShuffleNetV2 building block (reference vision/models/shufflenetv2.py):
+    channel split + depthwise conv branch + channel shuffle."""
+
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = oup // 2
+        if stride == 1:
+            in_branch = inp // 2
+            self.branch1 = None
+        else:
+            in_branch = inp
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU())
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in_branch, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU())
+
+    @staticmethod
+    def _shuffle(x, groups=2):
+        b, c, h, w = x.shape
+        return (x.reshape([b, groups, c // groups, h, w])
+                 .transpose([0, 2, 1, 3, 4]).reshape([b, c, h, w]))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return self._shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    """ShuffleNetV2 (reference vision/models/shufflenetv2.py)."""
+
+    _CFG = {0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+            1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048]}
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        chans = self._CFG[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, chans[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(chans[0]), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = chans[0]
+        for out, repeat in zip(chans[1:4], (4, 8, 4)):
+            stages.append(_ShuffleUnit(inp, out, 2))
+            stages += [_ShuffleUnit(out, out, 1) for _ in range(repeat - 1)]
+            inp = out
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(inp, chans[4], 1, bias_attr=False),
+            nn.BatchNorm2D(chans[4]), nn.ReLU())
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[4], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, inp, growth, bn_size):
+        super().__init__()
+        self.fn = nn.Sequential(
+            nn.BatchNorm2D(inp), nn.ReLU(),
+            nn.Conv2D(inp, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                      bias_attr=False))
+
+    def forward(self, x):
+        return paddle.concat([x, self.fn(x)], axis=1)
+
+
+class DenseNet(nn.Layer):
+    """DenseNet (reference vision/models/densenet.py); layers: 121/169/201."""
+
+    _BLOCKS = {121: (6, 12, 24, 16), 169: (6, 12, 32, 32),
+               201: (6, 12, 48, 32), 264: (6, 12, 64, 48)}
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = self._BLOCKS[layers]
+        c = 2 * growth_rate
+        feats = [nn.Conv2D(3, c, 7, stride=2, padding=3, bias_attr=False),
+                 nn.BatchNorm2D(c), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        for bi, n in enumerate(cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth_rate, bn_size))
+                c += growth_rate
+            if bi != len(cfg) - 1:  # transition: halve channels + avgpool
+                feats += [nn.BatchNorm2D(c), nn.ReLU(),
+                          nn.Conv2D(c, c // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, stride=2)]
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(paddle.flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(layers=121, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(layers=169, **kw)

@@ -370,3 +370,53 @@ def test_dataset_imdb_synthetic_tarball(tmp_path):
     assert labels == [0, 0, 1]  # pos docs first, then neg
     ids, _ = rows[0]
     assert all(isinstance(i, int) for i in ids)
+
+
+def test_reader_decorators():
+    """paddle.reader composition combinators (reference
+    reader/decorator.py)."""
+    import paddle_tpu.reader as R
+
+    base = lambda: iter(range(10))
+    assert list(R.firstn(base, 3)()) == [0, 1, 2]
+    assert list(R.chain(base, base)()) == list(range(10)) * 2
+    assert sorted(R.shuffle(base, 5)()) == list(range(10))
+    assert list(R.cache(base)()) == list(range(10))
+    assert list(R.map_readers(lambda a, b: a + b, base, base)()) == \
+        [2 * i for i in range(10)]
+    assert list(R.compose(base, base)()) == [(i, i) for i in range(10)]
+    with pytest.raises(R.ComposeNotAligned):
+        list(R.compose(base, lambda: iter(range(3)))())
+    assert sorted(R.buffered(base, 4)()) == list(range(10))
+    out = list(R.xmap_readers(lambda x: x * 10, base, 3, 4, order=True)())
+    assert out == [i * 10 for i in range(10)]
+    out2 = sorted(R.xmap_readers(lambda x: x * 10, base, 3, 4)())
+    assert out2 == [i * 10 for i in range(10)]
+
+
+def test_version_module():
+    import paddle_tpu.version as v
+
+    assert v.full_version == paddle.__version__
+    assert v.cuda() is False and v.nccl() == 0 and v.tpu() is True
+    v.show()
+
+
+def test_reader_error_propagation():
+    """Producer/mapper exceptions must surface, not hang or truncate."""
+    import paddle_tpu.reader as R
+
+    def bad_reader():
+        yield 1
+        yield 2
+        raise IOError("disk gone")
+
+    with pytest.raises(IOError):
+        list(R.buffered(bad_reader, 2)())
+
+    with pytest.raises(ZeroDivisionError):
+        list(R.xmap_readers(lambda x: 1 // x, lambda: iter([1, 0, 2]),
+                            2, 4)())
+
+    with pytest.raises(IOError):
+        list(R.xmap_readers(lambda x: x, bad_reader, 2, 4)())

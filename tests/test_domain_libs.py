@@ -256,3 +256,7 @@ def test_vision_model_families():
     m101 = models.resnet101(num_classes=4)
     m101.eval()
     assert m101(x).shape == [2, 4]
+    for fn in (models.shufflenet_v2_x0_5, models.densenet121):
+        m = fn(num_classes=5)
+        m.eval()
+        assert m(x).shape == [2, 5]
