@@ -207,6 +207,29 @@ def run_flash_ab(dev):
     return res
 
 
+def run_moe_bench(dev):
+    """Qwen2-MoE family throughput (BASELINE.md ladder #5): activated-param
+    MFU matters for MoE, so we report tokens/s plus activated fraction."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Qwen2Moe, Qwen2MoeConfig
+
+    paddle.seed(0)
+    cfg = Qwen2MoeConfig(
+        vocab_size=32000, max_position_embeddings=1024, hidden_size=512,
+        num_layers=4, num_heads=8, num_kv_heads=4,
+        moe_intermediate_size=512, shared_expert_intermediate_size=1024,
+        num_experts=8, num_experts_per_tok=2)
+    model = Qwen2Moe(cfg)
+    batch, seq, steps, warmup = 4, 1024, 8, 2
+    tokens_per_s, final, breakdown = _train_throughput(
+        model, batch, seq, steps, warmup, cfg.vocab_size, on_tpu=True)
+    return {"tokens_per_sec": round(tokens_per_s, 1),
+            "loss": round(final, 3),
+            "n_params": model.num_params(),
+            "activated_params": model.num_activated_params(),
+            "step_breakdown": breakdown}
+
+
 def run_dit_bench(dev):
     """DiT-S/2 training throughput (BASELINE.md ladder #4: 'trains;
     throughput reported'): images/s for the jitted DDPM train step."""
@@ -341,6 +364,10 @@ def _child_main(mode):
                 result["extra"]["dit_s2"] = run_dit_bench(dev)
             except Exception:
                 errs["dit_bench_error"] = traceback.format_exc(limit=2)[:600]
+            try:
+                result["extra"]["qwen2_moe"] = run_moe_bench(dev)
+            except Exception:
+                errs["moe_bench_error"] = traceback.format_exc(limit=2)[:600]
             result.setdefault("extra", {}).update(errs)
         else:
             dev = _force_cpu()

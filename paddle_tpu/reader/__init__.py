@@ -82,8 +82,9 @@ def compose(*readers, **kwargs):
             for outputs in zip(*rs):
                 yield sum((make_tuple(o) for o in outputs), ())
         else:
-            for outputs in itertools.zip_longest(*rs):
-                if any(o is None for o in outputs):
+            missing = object()  # a reader may legitimately yield None
+            for outputs in itertools.zip_longest(*rs, fillvalue=missing):
+                if any(o is missing for o in outputs):
                     raise ComposeNotAligned(
                         "outputs of readers are not aligned")
                 yield sum((make_tuple(o) for o in outputs), ())
@@ -191,9 +192,6 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 while next_i in pending:
                     yield pending.pop(next_i)
                     next_i += 1
-            while next_i in pending:
-                yield pending.pop(next_i)
-                next_i += 1
             check_errors()
         else:
             while finished < process_num:

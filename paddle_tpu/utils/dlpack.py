@@ -39,17 +39,14 @@ class _CapsuleWrapper:
 def from_dlpack(capsule):
     """DLPack capsule (or any __dlpack__ exporter, e.g. a torch/numpy
     tensor) -> framework Tensor."""
-    import jax
     import jax.numpy as jnp
 
     from ..core.tensor import Tensor
 
     if not hasattr(capsule, "__dlpack__"):
-        if jax.default_backend() != "cpu":
-            raise ValueError(
-                "a bare DLPack capsule carries no device information and is "
-                "presumed host-resident, but the default backend is "
-                f"{jax.default_backend()!r}; pass the exporting tensor "
-                "object itself (anything with __dlpack__) instead")
+        # bare capsules carry no device tag and are treated as
+        # host-resident: jax imports them through its always-present CPU
+        # backend (device tensors should be passed as their exporting
+        # object, which carries __dlpack_device__)
         capsule = _CapsuleWrapper(capsule)
     return Tensor(jnp.from_dlpack(capsule))

@@ -22,21 +22,24 @@ def show():
 
 
 def cuda():
-    """CUDA version the build links against — none; this is a TPU build."""
-    return False
+    """CUDA version the build links against — the reference returns the
+    STRING 'False' on non-CUDA builds (compat contract: callers compare
+    against 'False', not the bool)."""
+    return 'False'
 
 
 def cudnn():
-    return False
+    return 'False'
 
 
 def nccl():
-    """No NCCL: collectives are XLA over ICI/DCN."""
+    """No NCCL: collectives are XLA over ICI/DCN (reference returns 0 when
+    not built with NCCL)."""
     return 0
 
 
 def xpu():
-    return False
+    return 'False'
 
 
 def xpu_xccl():
