@@ -241,3 +241,12 @@ def _set_global_mesh(mesh: Mesh):
 def get_mesh() -> Mesh | None:
     """The active device mesh (set by fleet.init / auto_parallel)."""
     return _GLOBAL_MESH
+
+
+def reset_topology_state() -> None:
+    """Clear the global topology (mesh + hybrid group) so a process can
+    re-init fleet with a different layout — the single place that knows
+    what module state a reset must cover (tests, dryruns)."""
+    global _HCG, _GLOBAL_MESH
+    _HCG = None
+    _GLOBAL_MESH = None

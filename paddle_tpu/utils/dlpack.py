@@ -46,7 +46,14 @@ def from_dlpack(capsule):
     if not hasattr(capsule, "__dlpack__"):
         # bare capsules carry no device tag and are treated as
         # host-resident: jax imports them through its always-present CPU
-        # backend (device tensors should be passed as their exporting
-        # object, which carries __dlpack_device__)
-        capsule = _CapsuleWrapper(capsule)
+        # backend. A capsule that actually wraps device memory fails that
+        # import — surface the remedy instead of the deep XLA error.
+        try:
+            return Tensor(jnp.from_dlpack(_CapsuleWrapper(capsule)))
+        except Exception as e:
+            raise ValueError(
+                "could not adopt the bare DLPack capsule as host memory; "
+                "if it wraps a device tensor, pass the exporting tensor "
+                "object itself (anything with __dlpack__/__dlpack_device__)"
+            ) from e
     return Tensor(jnp.from_dlpack(capsule))
