@@ -11,6 +11,9 @@ from . import common  # noqa: F401
 from . import imdb  # noqa: F401
 from . import imikolov  # noqa: F401
 from . import mnist  # noqa: F401
+from . import movielens  # noqa: F401
 from . import uci_housing  # noqa: F401
+from . import wmt16  # noqa: F401
 
-__all__ = ['common', 'mnist', 'uci_housing', 'cifar', 'imikolov', 'imdb']
+__all__ = ['common', 'mnist', 'uci_housing', 'cifar', 'imikolov', 'imdb',
+           'movielens', 'wmt16']

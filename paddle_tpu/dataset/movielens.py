@@ -1,0 +1,142 @@
+"""MovieLens-1M reader (reference: python/paddle/dataset/movielens.py):
+parses the cached ml-1m.zip (users.dat / movies.dat / ratings.dat,
+'::'-separated) into (user features, movie features, rating) samples."""
+
+from __future__ import annotations
+
+import os
+import re
+import zipfile
+
+from .common import DATA_HOME
+
+__all__ = ['train', 'test', 'get_movie_title_dict', 'max_movie_id',
+           'max_user_id', 'max_job_id', 'age_table', 'movie_categories',
+           'MovieInfo', 'UserInfo']
+
+_DIR = os.path.join(DATA_HOME, 'movielens')
+_ZIP = 'ml-1m.zip'
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, title_dict):
+        return [self.index,
+                [categories_dict[c] for c in self.categories],
+                [title_dict[w] for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == 'M'
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), gender("
+                f"{'M' if self.is_male else 'F'}), age({age_table[self.age]}"
+                f"), job({self.job_id})>")
+
+
+class _Corpus:
+    def __init__(self, data_file=None):
+        path = data_file or os.path.join(_DIR, _ZIP)
+        if not os.path.exists(path):
+            raise RuntimeError(
+                f"MovieLens archive not cached (no network egress); place "
+                f"{_ZIP} under {_DIR} or pass data_file=")
+        self.movies = {}
+        self.users = {}
+        self.ratings = []
+        cats, titles = set(), set()
+        with zipfile.ZipFile(path) as zf:
+            base = next(n for n in zf.namelist() if n.endswith('movies.dat'))
+            root = os.path.dirname(base)
+
+            def lines(name):
+                with zf.open(f"{root}/{name}" if root else name) as f:
+                    for raw in f.read().decode('latin1').splitlines():
+                        if raw.strip():
+                            yield raw.strip().split('::')
+
+            pat = re.compile(r'(.*)\((\d{4})\)$')
+            for mid, title, genres in lines('movies.dat'):
+                title = pat.match(title.strip()).group(1).strip() \
+                    if pat.match(title.strip()) else title.strip()
+                gl = genres.split('|')
+                self.movies[int(mid)] = MovieInfo(mid, gl, title)
+                cats.update(gl)
+                titles.update(title.split())
+            for uid, gender, age, job, _zip in lines('users.dat'):
+                self.users[int(uid)] = UserInfo(uid, gender, age, job)
+            for uid, mid, rating, ts in lines('ratings.dat'):
+                self.ratings.append((int(uid), int(mid), float(rating)))
+        self.categories_dict = {c: i for i, c in enumerate(sorted(cats))}
+        self.title_dict = {w: i for i, w in enumerate(sorted(titles))}
+
+
+_corpus_cache: dict = {}
+
+
+def _corpus(data_file=None):
+    key = data_file or 'default'
+    if key not in _corpus_cache:
+        _corpus_cache[key] = _Corpus(data_file)
+    return _corpus_cache[key]
+
+
+def _reader(data_file, is_test, test_ratio=0.1, rand_seed=0):
+    import random
+
+    def reader():
+        c = _corpus(data_file)
+        rng = random.Random(rand_seed)
+        for uid, mid, rating in c.ratings:
+            if (rng.random() < test_ratio) == is_test:
+                usr = c.users[uid].value()
+                mov = c.movies[mid].value(c.categories_dict, c.title_dict)
+                yield usr + mov + [[rating]]
+
+    return reader
+
+
+def train(data_file=None):
+    return _reader(data_file, is_test=False)
+
+
+def test(data_file=None):
+    return _reader(data_file, is_test=True)
+
+
+def get_movie_title_dict(data_file=None):
+    return _corpus(data_file).title_dict
+
+
+def movie_categories(data_file=None):
+    return _corpus(data_file).categories_dict
+
+
+def max_movie_id(data_file=None):
+    return max(_corpus(data_file).movies)
+
+
+def max_user_id(data_file=None):
+    return max(_corpus(data_file).users)
+
+
+def max_job_id(data_file=None):
+    return max(u.job_id for u in _corpus(data_file).users.values())

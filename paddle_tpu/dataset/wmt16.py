@@ -1,0 +1,91 @@
+"""WMT16 en-de reader (reference: python/paddle/dataset/wmt16.py): builds
+source/target vocabularies from the cached tarball's parallel corpora and
+yields (src_ids, trg_ids, trg_next_ids) triples with <s>/<e>/<unk>."""
+
+from __future__ import annotations
+
+import collections
+import os
+import tarfile
+
+from .common import DATA_HOME
+
+__all__ = ['train', 'test', 'validation', 'get_dict']
+
+_DIR = os.path.join(DATA_HOME, 'wmt16')
+_TAR = 'wmt16.tar.gz'
+
+_START, _END, _UNK = '<s>', '<e>', '<unk>'
+
+
+def _open_member(name, data_file=None):
+    path = data_file or os.path.join(_DIR, _TAR)
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"WMT16 archive not cached (no network egress); place {_TAR} "
+            f"under {_DIR} or pass data_file=")
+    tf = tarfile.open(path, 'r:*')
+    member = next((m for m in tf.getmembers() if m.name.endswith(name)),
+                  None)
+    if member is None:
+        tf.close()
+        raise ValueError(f"no member ending with {name!r} in {path}")
+    return tf, tf.extractfile(member)
+
+
+def get_dict(lang, dict_size, data_file=None, split='train'):
+    """Frequency-sorted vocab of the <split>.<lang> corpus, truncated to
+    dict_size with <s>/<e>/<unk> reserved first."""
+    freq = collections.Counter()
+    tf, f = _open_member(f'{split}.{lang}', data_file)
+    try:
+        for line in f.read().decode('utf-8', 'ignore').splitlines():
+            freq.update(line.split())
+    finally:
+        tf.close()
+    words = [w for w, _ in freq.most_common(max(0, dict_size - 3))]
+    vocab = [_START, _END, _UNK] + words
+    return {w: i for i, w in enumerate(vocab)}
+
+
+def _reader(split, src_dict_size, trg_dict_size, src_lang='en',
+            data_file=None):
+    trg_lang = 'de' if src_lang == 'en' else 'en'
+
+    def reader():
+        src_dict = get_dict(src_lang, src_dict_size, data_file, 'train')
+        trg_dict = get_dict(trg_lang, trg_dict_size, data_file, 'train')
+        s_unk, t_unk = src_dict[_UNK], trg_dict[_UNK]
+        tf_s, fs = _open_member(f'{split}.{src_lang}', data_file)
+        tf_t, ft = _open_member(f'{split}.{trg_lang}', data_file)
+        try:
+            src_lines = fs.read().decode('utf-8', 'ignore').splitlines()
+            trg_lines = ft.read().decode('utf-8', 'ignore').splitlines()
+        finally:
+            tf_s.close()
+            tf_t.close()
+        for s, t in zip(src_lines, trg_lines):
+            if not s.strip() or not t.strip():
+                continue
+            src_ids = [src_dict[_START]] + \
+                [src_dict.get(w, s_unk) for w in s.split()] + \
+                [src_dict[_END]]
+            t_ids = [trg_dict.get(w, t_unk) for w in t.split()]
+            trg_ids = [trg_dict[_START]] + t_ids
+            trg_next = t_ids + [trg_dict[_END]]
+            yield src_ids, trg_ids, trg_next
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang='en', data_file=None):
+    return _reader('train', src_dict_size, trg_dict_size, src_lang,
+                   data_file)
+
+
+def test(src_dict_size, trg_dict_size, src_lang='en', data_file=None):
+    return _reader('test', src_dict_size, trg_dict_size, src_lang, data_file)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang='en', data_file=None):
+    return _reader('val', src_dict_size, trg_dict_size, src_lang, data_file)

@@ -244,9 +244,16 @@ def get_mesh() -> Mesh | None:
 
 
 def reset_topology_state() -> None:
-    """Clear the global topology (mesh + hybrid group) so a process can
-    re-init fleet with a different layout — the single place that knows
-    what module state a reset must cover (tests, dryruns)."""
+    """Clear the global topology (mesh + hybrid group + fleet strategy) so a
+    process can re-init fleet with a different layout — the single place
+    that knows what module state a reset must cover (tests, dryruns)."""
     global _HCG, _GLOBAL_MESH
     _HCG = None
     _GLOBAL_MESH = None
+    try:  # lazy: fleet imports topology, not the other way around
+        import importlib
+        _fleet_mod = importlib.import_module(".fleet.fleet",
+                                             package=__package__)
+        _fleet_mod._strategy = None
+    except Exception:
+        pass

@@ -425,3 +425,50 @@ def test_reader_error_propagation():
 
     with pytest.raises(IOError):
         list(R.xmap_readers(lambda x: x, bad_reader, 2, 4)())
+
+
+def test_dataset_movielens_synthetic(tmp_path):
+    import zipfile
+
+    z = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(z, "w") as zf:
+        zf.writestr("ml-1m/movies.dat",
+                    "1::Toy Story (1995)::Animation|Comedy\n"
+                    "2::Heat (1995)::Action|Crime\n")
+        zf.writestr("ml-1m/users.dat",
+                    "1::M::25::6::12345\n2::F::35::3::54321\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::1::5::978300760\n1::2::3::978302109\n"
+                    "2::1::4::978301968\n")
+    rows = list(paddle.dataset.movielens.train(data_file=str(z))())
+    rows += list(paddle.dataset.movielens.test(data_file=str(z))())
+    assert len(rows) == 3
+    usr_id, gender, age, job, mov_id, cats, title, rating = rows[0]
+    assert isinstance(cats, list) and isinstance(title, list)
+    assert rating[0] in (3.0, 4.0, 5.0)
+    assert paddle.dataset.movielens.max_user_id(str(z)) == 2
+    assert paddle.dataset.movielens.max_movie_id(str(z)) == 2
+    assert "Comedy" in paddle.dataset.movielens.movie_categories(str(z))
+
+
+def test_dataset_wmt16_synthetic(tmp_path):
+    import tarfile
+
+    root = tmp_path / "wmt16"
+    root.mkdir()
+    (root / "train.en").write_text("the cat sits\nthe dog runs\n")
+    (root / "train.de").write_text("die katze sitzt\nder hund rennt\n")
+    tar = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        tf.add(root / "train.en", arcname="wmt16/train.en")
+        tf.add(root / "train.de", arcname="wmt16/train.de")
+
+    d = paddle.dataset.wmt16.get_dict("en", 10, data_file=str(tar))
+    assert d["<s>"] == 0 and d["<e>"] == 1 and d["<unk>"] == 2
+    assert "the" in d
+    rows = list(paddle.dataset.wmt16.train(10, 10, data_file=str(tar))())
+    assert len(rows) == 2
+    src, trg, trg_next = rows[0]
+    assert src[0] == 0 and src[-1] == 1       # <s> ... <e>
+    assert trg[0] == 0 and trg_next[-1] == 1  # shifted decoder pair
+    assert trg[1:] == trg_next[:-1]

@@ -13,9 +13,8 @@ import jax
 
 
 def _reset_mesh():
-    from paddle_tpu.distributed import topology
-    topology._HCG = None
-    topology._GLOBAL_MESH = None
+    from paddle_tpu.distributed.topology import reset_topology_state
+    reset_topology_state()
 
 
 @pytest.fixture(autouse=True)
