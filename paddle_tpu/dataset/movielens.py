@@ -75,8 +75,9 @@ class _Corpus:
 
             pat = re.compile(r'(.*)\((\d{4})\)$')
             for mid, title, genres in lines('movies.dat'):
-                title = pat.match(title.strip()).group(1).strip() \
-                    if pat.match(title.strip()) else title.strip()
+                t = title.strip()
+                m = pat.match(t)
+                title = m.group(1).strip() if m else t
                 gl = genres.split('|')
                 self.movies[int(mid)] = MovieInfo(mid, gl, title)
                 cats.update(gl)

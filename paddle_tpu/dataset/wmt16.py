@@ -33,9 +33,20 @@ def _open_member(name, data_file=None):
     return tf, tf.extractfile(member)
 
 
+_dict_cache: dict = {}
+
+
 def get_dict(lang, dict_size, data_file=None, split='train'):
     """Frequency-sorted vocab of the <split>.<lang> corpus, truncated to
-    dict_size with <s>/<e>/<unk> reserved first."""
+    dict_size with <s>/<e>/<unk> reserved first. Cached per
+    (lang, dict_size, file, split) — multi-epoch readers must not re-count
+    the corpus every epoch."""
+    if dict_size <= 3:
+        raise ValueError(
+            f"dict_size must exceed the 3 reserved tokens, got {dict_size}")
+    key = (lang, dict_size, data_file or 'default', split)
+    if key in _dict_cache:
+        return _dict_cache[key]
     freq = collections.Counter()
     tf, f = _open_member(f'{split}.{lang}', data_file)
     try:
@@ -43,9 +54,10 @@ def get_dict(lang, dict_size, data_file=None, split='train'):
             freq.update(line.split())
     finally:
         tf.close()
-    words = [w for w, _ in freq.most_common(max(0, dict_size - 3))]
+    words = [w for w, _ in freq.most_common(dict_size - 3)]
     vocab = [_START, _END, _UNK] + words
-    return {w: i for i, w in enumerate(vocab)}
+    _dict_cache[key] = {w: i for i, w in enumerate(vocab)}
+    return _dict_cache[key]
 
 
 def _reader(split, src_dict_size, trg_dict_size, src_lang='en',

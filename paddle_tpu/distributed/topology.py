@@ -250,10 +250,9 @@ def reset_topology_state() -> None:
     global _HCG, _GLOBAL_MESH
     _HCG = None
     _GLOBAL_MESH = None
-    try:  # lazy: fleet imports topology, not the other way around
-        import importlib
-        _fleet_mod = importlib.import_module(".fleet.fleet",
-                                             package=__package__)
+    # only clear fleet's strategy if that module is actually loaded —
+    # never import the fleet package as a side effect of a reset
+    import sys
+    _fleet_mod = sys.modules.get(f"{__package__}.fleet.fleet")
+    if _fleet_mod is not None:
         _fleet_mod._strategy = None
-    except Exception:
-        pass

@@ -188,3 +188,145 @@ class TestWhereGather(OpTest):
     def test(self):
         self.check_output()
         self.check_grad(["x"])
+
+
+class TestEmbedding(OpTest):
+    def setup_method(self, m):
+        ids = np.array([[0, 2], [3, 1]])
+        self.op = lambda w: paddle.nn.functional.embedding(
+            paddle.to_tensor(ids), w)
+        self.np_ref = lambda w: w[ids]
+        self.inputs = {"w": _rand(5, 4, seed=20)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["w"])
+
+
+class TestMaxPool(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.nn.functional.max_pool2d(x, 2, stride=2)
+
+        def ref(x):
+            n, c, h, w = x.shape
+            return x.reshape(n, c, h // 2, 2, w // 2, 2).max((3, 5))
+
+        self.np_ref = ref
+        # distinct values so max is unique -> differentiable everywhere
+        self.inputs = {"x": np.arange(32, dtype=np.float32)
+                       .reshape(1, 2, 4, 4) / 32 + _rand(1, 2, 4, 4,
+                                                         seed=21) * 1e-3}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestCumsum(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.cumsum(x, axis=1)
+        self.np_ref = lambda x: np.cumsum(x, axis=1)
+        self.inputs = {"x": _rand(3, 5, seed=22)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestPadConcatSplit(OpTest):
+    def setup_method(self, m):
+        def op(x):
+            p = paddle.nn.functional.pad(x, [1, 1], value=0.0)
+            a, b_ = paddle.split(p, 2, axis=0)
+            return paddle.concat([b_, a], axis=0)
+
+        def ref(x):
+            p = np.pad(x, ((0, 0), (1, 1)))
+            a, b_ = np.split(p, 2, axis=0)
+            return np.concatenate([b_, a], axis=0)
+
+        self.op = op
+        self.np_ref = ref
+        self.inputs = {"x": _rand(4, 3, seed=23)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestLogSoftmaxNLL(OpTest):
+    grad_atol = 1e-2
+
+    def setup_method(self, m):
+        lbl = np.array([2, 0])
+        self.op = lambda x: paddle.nn.functional.cross_entropy(
+            x, paddle.to_tensor(lbl))
+
+        def ref(x):
+            e = np.exp(x - x.max(-1, keepdims=True))
+            logp = np.log(e / e.sum(-1, keepdims=True))
+            return -logp[np.arange(len(lbl)), lbl].mean()
+
+        self.np_ref = ref
+        self.inputs = {"x": _rand(2, 4, seed=24, lo=-2, hi=2)}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+        self.check_grad(["x"])
+
+
+class TestClipPow(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.clip(x, -0.5, 0.5) ** 2
+        self.np_ref = lambda x: np.clip(x, -0.5, 0.5) ** 2
+        self.inputs = {"x": _rand(10, seed=25)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestBatchNormEval(OpTest):
+    def setup_method(self, m):
+        bn = paddle.nn.BatchNorm2D(3)
+        bn.eval()
+        self._bn = bn
+        self.op = lambda x: self._bn(x)
+
+        def ref(x):  # fresh BN in eval: running mean 0, var 1
+            return x / np.sqrt(1.0 + 1e-5)
+
+        self.np_ref = ref
+        self.inputs = {"x": _rand(2, 3, 4, 4, seed=26)}
+
+    def test(self):
+        self.check_output(atol=1e-4)
+        self.check_grad(["x"])
+
+
+class TestInterpolateNearest(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x: paddle.nn.functional.interpolate(
+            x, scale_factor=2, mode="nearest")
+        self.np_ref = lambda x: x.repeat(2, axis=2).repeat(2, axis=3)
+        self.inputs = {"x": _rand(1, 2, 3, 3, seed=27)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestPReLU(OpTest):
+    def setup_method(self, m):
+        self.op = lambda x, w: paddle.nn.functional.prelu(x, w)
+
+        def ref(x, w):
+            return np.where(x >= 0, x, x * w.reshape(1, -1, 1, 1))
+
+        self.np_ref = ref
+        self.inputs = {"x": _rand(2, 3, 4, 4, seed=28),
+                       "w": np.full((3,), 0.25, np.float32)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "w"])
