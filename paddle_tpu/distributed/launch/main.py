@@ -41,8 +41,8 @@ def _parse(argv):
                    help="comma-separated local device ids")
     p.add_argument("--max_restart", type=int, default=0)
     p.add_argument("--run_mode", default="collective",
-                   choices=["collective", "ps"],
-                   help="collective (default) or parameter-server pods")
+                   choices=["collective", "ps", "rpc"],
+                   help="collective (default), parameter-server, or rpc pods")
     p.add_argument("--server_num", type=int, default=1,
                    help="ps mode: number of parameter servers")
     p.add_argument("--trainer_num", type=int, default=None,
@@ -62,7 +62,7 @@ def _free_port():
     return port
 
 
-def _worker_env(args, local_rank, master):
+def _worker_env(args, local_rank, master, endpoint=None):
     world = args.nnodes * args.nproc_per_node
     rank = args.node_rank * args.nproc_per_node + local_rank
     env = dict(os.environ)
@@ -72,7 +72,7 @@ def _worker_env(args, local_rank, master):
         "PADDLE_MASTER": master,
         "PADDLE_LOCAL_RANK": str(local_rank),
         "PADDLE_JOB_ID": args.job_id,
-        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{_free_port()}",
+        "PADDLE_CURRENT_ENDPOINT": endpoint or f"127.0.0.1:{_free_port()}",
         "RANK": str(rank),
         "WORLD_SIZE": str(world),
         "MASTER_ADDR_PORT": master,
@@ -128,12 +128,28 @@ def launch(argv=None):
     else:
         jobs = None
 
+    rpc_eps = None
+    if args.run_mode == "rpc":
+        if args.nnodes != 1:
+            raise SystemExit(
+                "--run_mode rpc supports a single node in this build; "
+                "multi-node rpc pods need externally assigned endpoints "
+                "(set PADDLE_WORKER_ENDPOINTS yourself)")
+        # rpc mode (reference launch/controllers/rpc.py): collective-style
+        # ranks plus a pre-assigned endpoint list every worker can dial
+        rpc_eps = [f"127.0.0.1:{_free_port()}"
+                   for _ in range(args.nproc_per_node)]
+
     def spawn(local_rank):
         if jobs is not None:
             role, idx = jobs[local_rank]
             env = _ps_env(args, role, idx, server_eps, trainer_eps, master)
         else:
-            env = _worker_env(args, local_rank, master)
+            env = _worker_env(
+                args, local_rank, master,
+                endpoint=rpc_eps[local_rank] if rpc_eps else None)
+            if rpc_eps is not None:
+                env["PADDLE_WORKER_ENDPOINTS"] = ",".join(rpc_eps)
         cmd = [sys.executable, args.training_script] + \
             args.training_script_args
         if log_dir:

@@ -111,9 +111,24 @@ class _Agent:
 def init_rpc(name, rank=None, world_size=None, master_endpoint=None,
              worker_endpoints=None):
     """Reference rpc.py init_rpc. worker_endpoints: list of "ip:port" in
-    rank order (port 0 = pick free); defaults to localhost ephemeral ports
-    coordinated via master_endpoint file for tests/single-host."""
+    rank order (port 0 = pick free); defaults come from the launcher env
+    contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+    PADDLE_WORKER_ENDPOINTS — `launch --run_mode rpc` materializes these),
+    else localhost ephemeral ports.
+
+    Peer NAMING under the env contract: without a rendezvous there is no
+    name exchange, so peers are addressable as "worker<rank>" — pass that
+    convention as your own `name` too (use register_worker() to install
+    custom peer names once their owners publish them)."""
+    import os
+
     global _agent
+    if rank is None and os.environ.get("PADDLE_TRAINER_ID"):
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if world_size is None and os.environ.get("PADDLE_TRAINERS_NUM"):
+        world_size = int(os.environ["PADDLE_TRAINERS_NUM"])
+    if worker_endpoints is None and os.environ.get("PADDLE_WORKER_ENDPOINTS"):
+        worker_endpoints = os.environ["PADDLE_WORKER_ENDPOINTS"].split(",")
     if worker_endpoints is None:
         worker_endpoints = [f"127.0.0.1:0"] * (world_size or 1)
     workers = {}

@@ -76,9 +76,6 @@ def test_launch_ps_mode(tmp_path):
     """ps run_mode materializes the parameter-server env contract
     (PADDLE_TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_PORT)."""
     import json
-    import os
-    import subprocess
-    import sys
 
     script = tmp_path / "probe.py"
     script.write_text(
@@ -94,7 +91,7 @@ def test_launch_ps_mode(tmp_path):
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--run_mode", "ps", "--server_num", "2", "--trainer_num", "2",
          "--log_dir", str(log_dir), str(script)],
-        cwd="/root/repo", capture_output=True, text=True, timeout=120,
+        cwd=REPO, capture_output=True, text=True, timeout=120,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert rc.returncode == 0, rc.stderr[-2000:]
     logs = sorted(os.listdir(log_dir))
@@ -112,3 +109,24 @@ def test_launch_ps_mode(tmp_path):
     assert all(s["port"] in e for s, e in zip(servers, eps))
     assert sorted(t["tid"] for t in trainers) == ["0", "1"]
     assert all(t["PADDLE_TRAINERS_NUM"] == "2" for t in infos)
+
+
+def test_launch_rpc_mode(tmp_path):
+    """rpc run_mode pre-assigns PADDLE_WORKER_ENDPOINTS that init_rpc
+    consumes from the env."""
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os\n"
+        "from paddle_tpu.distributed import rpc\n"
+        "agent = rpc.init_rpc(f\"worker{os.environ['PADDLE_TRAINER_ID']}\")\n"
+        "eps = os.environ['PADDLE_WORKER_ENDPOINTS'].split(',')\n"
+        "assert agent.world_size == 2 and len(eps) == 2, (agent.world_size, eps)\n"
+        "assert os.environ['PADDLE_CURRENT_ENDPOINT'] in eps\n"
+        "print('RPC_OK', agent.rank, flush=True)\n")
+    rc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--run_mode", "rpc", "--nproc_per_node", "2", str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    assert rc.returncode == 0, (rc.stdout[-1000:], rc.stderr[-1000:])
+    assert rc.stdout.count("RPC_OK") == 2
