@@ -11,6 +11,7 @@ rank/world mapping, the same contract the launcher env sets.
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import socketserver
@@ -120,17 +121,23 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None,
     name exchange, so peers are addressable as "worker<rank>" — pass that
     convention as your own `name` too (use register_worker() to install
     custom peer names once their owners publish them)."""
-    import os
-
     global _agent
-    if rank is None and os.environ.get("PADDLE_TRAINER_ID"):
-        rank = int(os.environ["PADDLE_TRAINER_ID"])
-    if world_size is None and os.environ.get("PADDLE_TRAINERS_NUM"):
-        world_size = int(os.environ["PADDLE_TRAINERS_NUM"])
+    # env adoption is gated on PADDLE_WORKER_ENDPOINTS (the rpc-mode
+    # marker): a collective-mode launch also sets PADDLE_TRAINER_ID, and
+    # adopting a rank the caller's own world/endpoints don't cover would
+    # leave the caller out of its workers map
     if worker_endpoints is None and os.environ.get("PADDLE_WORKER_ENDPOINTS"):
         worker_endpoints = os.environ["PADDLE_WORKER_ENDPOINTS"].split(",")
+        if rank is None and os.environ.get("PADDLE_TRAINER_ID"):
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+        if world_size is None and os.environ.get("PADDLE_TRAINERS_NUM"):
+            world_size = int(os.environ["PADDLE_TRAINERS_NUM"])
     if worker_endpoints is None:
         worker_endpoints = [f"127.0.0.1:0"] * (world_size or 1)
+    if rank is not None and rank >= len(worker_endpoints):
+        raise ValueError(
+            f"rank {rank} not covered by {len(worker_endpoints)} worker "
+            f"endpoints")
     workers = {}
     for r, ep in enumerate(worker_endpoints):
         ip, port = ep.rsplit(":", 1)

@@ -260,3 +260,8 @@ def test_vision_model_families():
         m = fn(num_classes=5)
         m.eval()
         assert m(x).shape == [2, 5]
+    gn = models.googlenet(num_classes=5)
+    out, a1, a2 = gn(x)  # train mode: aux heads like the reference
+    assert out.shape == [2, 5] and a1.shape == [2, 5]
+    gn.eval()
+    assert gn(x).shape == [2, 5]
