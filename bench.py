@@ -207,6 +207,95 @@ def run_flash_ab(dev):
     return res
 
 
+def run_llama8b_layer_bench(dev, cfg=None, n_layers=2, batch=1, seq=4096,
+                            steps=8, warmup=2, use_amp=True):
+    """North-star arithmetic at real 8B dims (BASELINE.md config #3).
+
+    A full Llama-8B doesn't fit one chip with AdamW states, but its MFU is
+    set almost entirely by the decoder layer: run a 2-layer stack at exact
+    8B dims (h=4096, 32q/8kv heads, inter=14336), measure layer MFU, and
+    project the full model analytically (the lm_head matmul is assumed to
+    run at the same MFU; embedding lookup is bandwidth-noise).
+    """
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaDecoderLayer,
+                                         _rope_tables)
+
+    if cfg is None:
+        cfg = LlamaConfig(vocab_size=128256, hidden_size=4096, num_layers=32,
+                          num_heads=32, num_kv_heads=8,
+                          intermediate_size=14336)
+
+    paddle.seed(0)
+
+    class LayerStack(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.layers = nn.LayerList(
+                [LlamaDecoderLayer(cfg) for _ in range(n_layers)])
+
+        def forward(self, x, cos, sin):
+            for l in self.layers:
+                x = l(x, cos, sin)
+            return x
+
+    model = LayerStack()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
+                                 weight_decay=0.1, multi_precision=True)
+    if use_amp:
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    # unlike the full-model benches there is no int-id embedding to set the
+    # activation dtype, so cast the inputs to bf16 explicitly — otherwise
+    # f32 @ bf16 promotes every matmul back to f32 and halves measured MFU
+    act_dtype = "bfloat16" if use_amp else "float32"
+    x = paddle.to_tensor(
+        rng.standard_normal((batch, seq, cfg.hidden_size)).astype(
+            np.float32)).cast(act_dtype)
+    cos, sin = _rope_tables(cfg, seq, dtype="float32")
+    cos, sin = cos.cast(act_dtype), sin.cast(act_dtype)
+
+    @paddle.jit.to_static
+    def step(x, cos, sin):
+        out = model(x, cos, sin)
+        loss = (out.cast("float32") ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(warmup):
+        loss = step(x, cos, sin)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, cos, sin)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    params_per_layer = sum(p.size for p in model.parameters()) / n_layers
+    # fwd+bwd = 3x fwd; fwd = 2*P + causal-attention 2*2*h*s/2 per token
+    flops_tok_layer = 3 * (2.0 * params_per_layer
+                           + 2.0 * 2.0 * cfg.hidden_size * seq / 2)
+    tokens_per_s = batch * seq * steps / dt
+    peak, peak_src = _peak_flops(dev)
+    layer_mfu = (tokens_per_s * flops_tok_layer * n_layers / peak
+                 if peak else 0.0)
+    # analytic full-8B projection: 32 layers + untied lm_head at layer MFU
+    full_flops_tok = (cfg.num_layers * flops_tok_layer
+                      + 3 * 2.0 * cfg.hidden_size * cfg.vocab_size)
+    proj_tokens_per_s = (layer_mfu * peak / full_flops_tok) if peak else 0.0
+    return {"layer_mfu_8b_dims": round(layer_mfu, 4),
+            "tokens_per_sec_2layer": round(tokens_per_s, 1),
+            "projected_8b_tokens_per_sec_per_chip": round(proj_tokens_per_s, 1),
+            "batch": batch, "seq": seq, "n_layers_measured": n_layers,
+            "params_per_layer": int(params_per_layer),
+            "peak_flops": peak, "peak_flops_source": peak_src}
+
+
 def run_moe_bench(dev):
     """Qwen2-MoE family throughput (BASELINE.md ladder #5): activated-param
     MFU matters for MoE, so we report tokens/s plus activated fraction."""
@@ -356,6 +445,11 @@ def _child_main(mode):
                 result = gpt
             if result is None:
                 raise RuntimeError(f"both tpu benches failed: {errs}")
+            try:
+                result["extra"]["llama8b_layer"] = run_llama8b_layer_bench(dev)
+            except Exception:
+                errs["llama8b_layer_error"] = \
+                    traceback.format_exc(limit=2)[:600]
             try:
                 result["extra"]["flash_ab"] = run_flash_ab(dev)
             except Exception:

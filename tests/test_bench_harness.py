@@ -1,0 +1,66 @@
+"""Bench harness stays runnable: tiny-dims smoke of the 8B-layer microbench
+and the watcher's record/selection logic (the round-3 'convert any tunnel-up
+window into a number' machinery — VERDICT r2 item #1)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_llama8b_layer_microbench_tiny_dims():
+    import bench
+    from paddle_tpu.device import force_cpu_backend
+    from paddle_tpu.models.llama import LlamaConfig
+
+    dev = force_cpu_backend().devices("cpu")[0]
+    cfg = LlamaConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                      num_heads=4, num_kv_heads=2, intermediate_size=128)
+    r = bench.run_llama8b_layer_bench(dev, cfg=cfg, n_layers=2, batch=2,
+                                      seq=64, steps=2, warmup=1,
+                                      use_amp=False)
+    assert r["tokens_per_sec_2layer"] > 0
+    assert r["n_layers_measured"] == 2
+    # attn (q+k+v+o) + mlp (gate+up+down) + 2 rmsnorm weights
+    h, kv, m = 64, 2 * 16, 128
+    expect = (h * h + 2 * h * kv + h * h) + 3 * h * m + 2 * h
+    assert r["params_per_layer"] == expect
+    # cpu → no peak flops → mfu stays 0 rather than garbage
+    assert r["layer_mfu_8b_dims"] == 0.0
+
+
+def test_bench_watch_record_keeps_best(tmp_path, monkeypatch):
+    import bench_watch as bw
+
+    monkeypatch.setattr(bw, "RUNS", str(tmp_path / "runs.jsonl"))
+    monkeypatch.setattr(bw, "LIVE", str(tmp_path / "live.json"))
+    monkeypatch.setattr(bw, "LOG", str(tmp_path / "watch.log"))
+
+    bw.record({"metric": "m", "value": 1.0, "vs_baseline": 0.5,
+               "extra": {"device": "TPU v5e"}})
+    bw.record({"metric": "m", "value": 2.0, "vs_baseline": 0.9,
+               "extra": {"device": "TPU v5e"}})
+    bw.record({"metric": "m", "value": 0.5, "vs_baseline": 0.1,
+               "extra": {"device": "TPU v5e"}})
+
+    with open(str(tmp_path / "live.json")) as f:
+        live = json.load(f)
+    assert live["vs_baseline"] == 0.9  # best kept, worse run didn't clobber
+    with open(str(tmp_path / "runs.jsonl")) as f:
+        assert len(f.read().strip().splitlines()) == 3  # every run archived
+
+
+def test_bench_watch_tpu_result_detection():
+    import bench_watch as bw
+
+    assert bw.is_tpu_result(
+        {"metric": "llama_310m_train_tokens_per_sec_per_chip",
+         "extra": {"device": "TPU v5e"}})
+    assert not bw.is_tpu_result(
+        {"metric": "gpt2_cpu_smoke_tokens_per_sec", "extra": {"device": "cpu"}})
+    assert not bw.is_tpu_result({"metric": "x", "extra": {}})
