@@ -106,6 +106,16 @@ _METHODS = {
     "softmax": _a.softmax, "relu": _a.relu, "relu_": _a.relu_,
 }
 
+# every generated op (ops.yaml) is also a Tensor method, matching the
+# reference's eager tensor patching; hand-maintained entries above win
+from . import _generated as _g  # noqa: E402
+
+for _gname in _g.OP_REGISTRY:
+    _meta = _g.OP_REGISTRY[_gname]
+    for _n in (_gname, _meta.get("inplace")):
+        if _n and _n not in _METHODS:
+            _METHODS[_n] = getattr(_g, _n)
+
 for _name, _fn in _METHODS.items():
     Tensor._install_method(_name, _method(_fn))
 

@@ -10,44 +10,18 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..autograd.function import apply
 
+from ._generated import (  # noqa: F401  (generated from ops.yaml)
+    relu, relu_, relu6, sigmoid, sigmoid_, log_sigmoid, silu, softsign,
+    tanh, tanh_, mish,
+)
+
 __all__ = [
     "relu", "relu6", "relu_", "leaky_relu", "elu", "celu", "selu", "gelu",
-    "sigmoid", "log_sigmoid", "hardsigmoid", "hardswish", "hardtanh",
-    "hardshrink", "softshrink", "tanhshrink", "silu", "swish", "mish",
-    "softplus", "softsign", "tanh", "tanh_", "softmax", "log_softmax",
+    "sigmoid", "sigmoid_", "log_sigmoid", "hardsigmoid", "hardswish",
+    "hardtanh", "hardshrink", "softshrink", "tanhshrink", "silu", "swish",
+    "mish", "softplus", "softsign", "tanh", "tanh_", "softmax", "log_softmax",
     "maxout", "thresholded_relu", "rrelu", "prelu", "glu", "swiglu",
 ]
-
-
-def _unary(jfn, name):
-    def op(x, name_=None):
-        return apply(jfn, x, name=name)
-    op.__name__ = name
-    return op
-
-
-relu = _unary(jax.nn.relu, "relu")
-relu6 = _unary(jax.nn.relu6, "relu6")
-sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
-log_sigmoid = _unary(jax.nn.log_sigmoid, "log_sigmoid")
-silu = _unary(jax.nn.silu, "silu")
-softsign = _unary(jax.nn.soft_sign, "softsign")
-tanh = _unary(jnp.tanh, "tanh")
-mish = _unary(jax.nn.mish, "mish")
-
-
-def relu_(x, name=None) -> Tensor:
-    out = relu(x)
-    x._data, x._node, x._out_index = out._data, out._node, out._out_index
-    x.stop_gradient = out.stop_gradient
-    return x
-
-
-def tanh_(x, name=None) -> Tensor:
-    out = tanh(x)
-    x._data, x._node, x._out_index = out._data, out._node, out._out_index
-    x.stop_gradient = out.stop_gradient
-    return x
 
 
 def leaky_relu(x, negative_slope=0.01, name=None) -> Tensor:

@@ -5,6 +5,12 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, as_tensor
+from ._generated import (  # noqa: F401  (generated from ops.yaml)
+    equal, not_equal, less_than, less_equal, greater_than, greater_equal,
+    logical_and, logical_or, logical_xor, logical_not, bitwise_and,
+    bitwise_or, bitwise_xor, bitwise_not, bitwise_left_shift,
+    bitwise_right_shift,
+)
 
 __all__ = [
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
@@ -13,39 +19,6 @@ __all__ = [
     "bitwise_not", "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
     "is_empty", "isreal", "iscomplex",
 ]
-
-
-def _cmp(jfn, name):
-    def op(x, y, name_=None):
-        xa = x._data if isinstance(x, Tensor) else x
-        ya = y._data if isinstance(y, Tensor) else y
-        return Tensor(jfn(xa, ya))
-    op.__name__ = name
-    return op
-
-
-equal = _cmp(jnp.equal, "equal")
-not_equal = _cmp(jnp.not_equal, "not_equal")
-less_than = _cmp(jnp.less, "less_than")
-less_equal = _cmp(jnp.less_equal, "less_equal")
-greater_than = _cmp(jnp.greater, "greater_than")
-greater_equal = _cmp(jnp.greater_equal, "greater_equal")
-logical_and = _cmp(jnp.logical_and, "logical_and")
-logical_or = _cmp(jnp.logical_or, "logical_or")
-logical_xor = _cmp(jnp.logical_xor, "logical_xor")
-bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
-bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
-bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
-bitwise_left_shift = _cmp(jnp.left_shift, "bitwise_left_shift")
-bitwise_right_shift = _cmp(jnp.right_shift, "bitwise_right_shift")
-
-
-def logical_not(x, name=None) -> Tensor:
-    return Tensor(jnp.logical_not(as_tensor(x)._data))
-
-
-def bitwise_not(x, name=None) -> Tensor:
-    return Tensor(jnp.bitwise_not(as_tensor(x)._data))
 
 
 def equal_all(x, y, name=None) -> Tensor:

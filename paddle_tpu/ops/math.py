@@ -1,9 +1,13 @@
 """Elementwise & general math ops (reference: python/paddle/tensor/math.py,
-ops declared in paddle/phi/api/yaml/ops.yaml)."""
+ops declared in paddle/phi/api/yaml/ops.yaml).
+
+The simple elementwise families (unary/binary/predicates) are GENERATED from
+`ops.yaml` into `_generated.py` and re-exported here — the YAML registry is
+their source of truth (impl, dtypes, inplace variant, vjp eligibility,
+numpy reference). Only ops with non-trivial signatures or compositions stay
+hand-written below."""
 
 from __future__ import annotations
-
-from functools import partial
 
 import numpy as np
 import jax
@@ -12,6 +16,18 @@ import jax.numpy as jnp
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor, as_tensor
 from ..autograd.function import apply
+from ._generated import (  # noqa: F401  (generated from ops.yaml)
+    neg, abs, sign, reciprocal, reciprocal_, exp, exp_, expm1, log, log_,
+    log2, log10, log1p, sqrt, sqrt_, rsqrt, rsqrt_, square, sin, cos, tan,
+    asin, acos, atan, sinh, cosh, asinh, acosh, atanh, floor, floor_, ceil,
+    ceil_, round, round_, trunc, trunc_, erf, erfinv, digamma, lgamma, i0,
+    i1, sinc, conj, real, rad2deg, deg2rad, isnan, isinf, isfinite, angle,
+    imag, abs_,
+    add, add_, subtract, subtract_, multiply, multiply_, divide, divide_,
+    floor_divide, remainder, remainder_, pow, pow_, maximum, minimum, fmax,
+    fmin, atan2, logaddexp, hypot, nextafter, heaviside, ldexp, kron, gcd,
+    lcm,
+)
 
 __all__ = [
     "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
@@ -26,96 +42,13 @@ __all__ = [
     "deg2rad", "gcd", "lcm", "heaviside", "nextafter", "hypot", "ldexp",
     "digamma", "lgamma", "polygamma", "i0", "i1", "sinc", "diff", "trapezoid",
     "kron", "cast", "increment", "angle", "conj", "real", "imag",
+    # generated in-place variants (ops.yaml `inplace:` field)
+    "abs_", "reciprocal_", "exp_", "log_", "sqrt_", "rsqrt_", "floor_",
+    "ceil_", "round_", "trunc_", "divide_", "remainder_", "pow_",
 ]
 
-
-def _binary(jfn, name):
-    def op(x, y, name_=None):
-        return apply(jfn, x, y, name=name)
-    op.__name__ = name
-    return op
-
-
-def _unary(jfn, name):
-    def op(x, name_=None):
-        return apply(jfn, x, name=name)
-    op.__name__ = name
-    return op
-
-
-add = _binary(jnp.add, "add")
-subtract = _binary(jnp.subtract, "subtract")
-multiply = _binary(jnp.multiply, "multiply")
-floor_divide = _binary(jnp.floor_divide, "floor_divide")
-remainder = _binary(jnp.remainder, "remainder")
 mod = remainder
-maximum = _binary(jnp.maximum, "maximum")
-minimum = _binary(jnp.minimum, "minimum")
-fmax = _binary(jnp.fmax, "fmax")
-fmin = _binary(jnp.fmin, "fmin")
-atan2 = _binary(jnp.arctan2, "atan2")
-logaddexp = _binary(jnp.logaddexp, "logaddexp")
-nextafter = _binary(jnp.nextafter, "nextafter")
-hypot = _binary(jnp.hypot, "hypot")
-gcd = _binary(jnp.gcd, "gcd")
-lcm = _binary(jnp.lcm, "lcm")
-heaviside = _binary(jnp.heaviside, "heaviside")
-ldexp = _binary(jnp.ldexp, "ldexp")
-kron = _binary(jnp.kron, "kron")
-
-
-def divide(x, y, name=None) -> Tensor:
-    return apply(jnp.true_divide, x, y, name="divide")
-
-
-def pow(x, y, name=None) -> Tensor:
-    return apply(jnp.power, x, y, name="pow")
-
-
 float_power = pow
-
-neg = _unary(jnp.negative, "neg")
-abs = _unary(jnp.abs, "abs")
-sign = _unary(jnp.sign, "sign")
-reciprocal = _unary(jnp.reciprocal, "reciprocal")
-exp = _unary(jnp.exp, "exp")
-expm1 = _unary(jnp.expm1, "expm1")
-log = _unary(jnp.log, "log")
-log2 = _unary(jnp.log2, "log2")
-log10 = _unary(jnp.log10, "log10")
-log1p = _unary(jnp.log1p, "log1p")
-sqrt = _unary(jnp.sqrt, "sqrt")
-rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
-square = _unary(jnp.square, "square")
-sin = _unary(jnp.sin, "sin")
-cos = _unary(jnp.cos, "cos")
-tan = _unary(jnp.tan, "tan")
-asin = _unary(jnp.arcsin, "asin")
-acos = _unary(jnp.arccos, "acos")
-atan = _unary(jnp.arctan, "atan")
-sinh = _unary(jnp.sinh, "sinh")
-cosh = _unary(jnp.cosh, "cosh")
-asinh = _unary(jnp.arcsinh, "asinh")
-acosh = _unary(jnp.arccosh, "acosh")
-atanh = _unary(jnp.arctanh, "atanh")
-floor = _unary(jnp.floor, "floor")
-ceil = _unary(jnp.ceil, "ceil")
-round = _unary(jnp.round, "round")
-trunc = _unary(jnp.trunc, "trunc")
-erf = _unary(jax.scipy.special.erf, "erf")
-erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
-isnan = _unary(jnp.isnan, "isnan")
-isinf = _unary(jnp.isinf, "isinf")
-isfinite = _unary(jnp.isfinite, "isfinite")
-digamma = _unary(jax.scipy.special.digamma, "digamma")
-lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
-i0 = _unary(jax.scipy.special.i0, "i0")
-i1 = _unary(jax.scipy.special.i1, "i1")
-sinc = _unary(jnp.sinc, "sinc")
-angle = _unary(jnp.angle, "angle")
-conj = _unary(jnp.conj, "conj")
-real = _unary(jnp.real, "real")
-imag = _unary(jnp.imag, "imag")
 
 
 def frac(x, name=None) -> Tensor:
@@ -128,10 +61,6 @@ def polygamma(x, n, name=None) -> Tensor:
 
 def stanh(x, scale_a=0.67, scale_b=1.7159, name=None) -> Tensor:
     return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
-
-
-rad2deg = _unary(jnp.rad2deg, "rad2deg")
-deg2rad = _unary(jnp.deg2rad, "deg2rad")
 
 
 def clip(x, min=None, max=None, name=None) -> Tensor:
@@ -293,18 +222,3 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None) -> Tensor:
                      name="trapezoid")
     return apply(lambda a: jnp.trapezoid(a, dx=1.0 if dx is None else dx, axis=axis),
                  y, name="trapezoid")
-
-
-# in-place style aliases (functional rebind)
-def _inplace(fn):
-    def op(x, y, name=None):
-        out = fn(x, y)
-        x._data, x._node, x._out_index = out._data, out._node, out._out_index
-        x.stop_gradient = out.stop_gradient
-        return x
-    return op
-
-
-add_ = _inplace(add)
-subtract_ = _inplace(subtract)
-multiply_ = _inplace(multiply)

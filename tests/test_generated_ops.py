@@ -1,0 +1,187 @@
+"""Auto-parametrized OpTests driven by the YAML op registry.
+
+Every entry in paddle_tpu/ops/ops.yaml gets:
+  - check_output (eager + jit) vs its numpy reference at float32,
+  - a dtype-ladder check at each additional dtype the entry declares
+    (bfloat16 with loose tolerances, int32/int64/bool exact),
+  - check_grad (analytic vs central differences) when `grad: true`,
+  - an in-place consistency check when `inplace:` is declared.
+
+This is the reference's OpTest discipline (test/legacy_test/op_test.py:379)
+driven from op metadata instead of 1,200 hand-written test classes.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import op_gen
+
+from op_test import OpTest
+
+SPECS = op_gen.load_registry()
+BY_NAME = {s.name: s for s in SPECS}
+
+# tolerance policy per dtype rung (reference op_test keeps a per-dtype map)
+TOL = {
+    "float32": dict(atol=1e-5, rtol=1e-4),
+    "bfloat16": dict(atol=2e-2, rtol=2e-2),
+}
+
+
+def _sample(spec, which, rng, dtype="float32"):
+    low = spec.get("low", -2.0)
+    high = spec.get("high", 2.0)
+    if which == "b":
+        low = spec.get("low_b", low)
+        high = spec.get("high_b", high)
+    shape = (2, 3)
+    int_arg = spec.get("int_input") or (which == "b" and spec.get("int_b"))
+    if dtype in ("int32", "int64") or int_arg:
+        dt = dtype if dtype.startswith("int") else "int32"
+        return rng.integers(int(low), int(high) + 1, shape).astype(dt)
+    if dtype == "bool":
+        return rng.random(shape) > 0.5
+    return (rng.random(shape) * (high - low) + low).astype(np.float32)
+
+
+def _inputs(spec, rng, dtype="float32"):
+    arrs = {"x": _sample(spec, "a", rng, dtype)}
+    if spec.arity == 2:
+        arrs["y"] = _sample(spec, "b", rng, dtype)
+    return arrs
+
+
+def _op(name):
+    return getattr(paddle, name)
+
+
+def _as_f32(arr):
+    """Round through bfloat16 so the reference sees the same quantization."""
+    import ml_dtypes
+    return np.asarray(arr, np.float32).astype(ml_dtypes.bfloat16).astype(
+        np.float32)
+
+
+@pytest.mark.parametrize("name", sorted(BY_NAME), ids=sorted(BY_NAME))
+def test_check_output_and_grad_f32(name):
+    spec = BY_NAME[name]
+    rng = np.random.default_rng(hash(name) % 2**32)
+    dt0 = spec.get("dtypes", ["float32"])[0]
+    inputs = _inputs(spec, rng, dt0 if dt0 != "bfloat16" else "float32")
+
+    t = OpTest()
+    t.op = _op(name)
+    t.np_ref = op_gen.resolve_np_ref(spec)
+    t.inputs = inputs
+    t.check_output()
+    if spec.differentiable:
+        t.check_grad(list(inputs))
+
+
+@pytest.mark.parametrize(
+    "name", sorted(n for n, s in BY_NAME.items()
+                   if len(s.get("dtypes", [])) > 1),
+    ids=sorted(n for n, s in BY_NAME.items() if len(s.get("dtypes", [])) > 1))
+def test_dtype_ladder(name):
+    """check_output at every declared dtype beyond the first."""
+    spec = BY_NAME[name]
+    ref = op_gen.resolve_np_ref(spec)
+    rng = np.random.default_rng(hash(name) % 2**31)
+    for dtype in spec["dtypes"][1:]:
+        inputs = _inputs(spec, rng, dtype)
+        if dtype == "bfloat16":
+            # quantize through bf16 so the f32 reference matches what the
+            # kernel actually sees
+            ref_in = {k: _as_f32(v) for k, v in inputs.items()}
+            ts = [paddle.to_tensor(v).cast("bfloat16")
+                  for v in ref_in.values()]
+        else:
+            ref_in = inputs
+            ts = [paddle.to_tensor(v) for v in inputs.values()]
+        out = _op(name)(*ts)
+        expect = ref(*ref_in.values())
+        got = out.numpy()
+        if np.asarray(expect).dtype == np.bool_ or dtype in (
+                "int32", "int64", "bool"):
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(expect),
+                err_msg=f"{name}@{dtype}")
+        else:
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32), np.asarray(expect, np.float32),
+                err_msg=f"{name}@{dtype}", **TOL.get(dtype, TOL["bfloat16"]))
+
+
+@pytest.mark.parametrize(
+    "name", sorted(n for n, s in BY_NAME.items() if s.get("inplace")),
+    ids=sorted(n for n, s in BY_NAME.items() if s.get("inplace")))
+def test_inplace_variant(name):
+    """x.op_() mutates x in place, returns x, and matches the out-of-place
+    op (grad graph rebind semantics, reference inplace op map)."""
+    spec = BY_NAME[name]
+    rng = np.random.default_rng(hash(name) % 2**30)
+    inputs = _inputs(spec, rng)
+    outplace = _op(name)(*[paddle.to_tensor(v) for v in inputs.values()])
+    ts = [paddle.to_tensor(v) for v in inputs.values()]
+    ret = _op(spec["inplace"])(*ts)
+    assert ret is ts[0], f"{spec['inplace']} must return its first input"
+    np.testing.assert_allclose(ts[0].numpy(), outplace.numpy(), rtol=1e-6)
+
+    if spec.differentiable:
+        # grads flow through the rebound tensor like the out-of-place op
+        x = paddle.to_tensor(inputs["x"], stop_gradient=False)
+        rest = [paddle.to_tensor(v) for k, v in inputs.items() if k != "x"]
+        y = _op(name)(x, *rest)
+        y.sum().backward()
+        want = x.grad.numpy()
+
+        x2 = paddle.to_tensor(inputs["x"], stop_gradient=False)
+        z = _op(spec["inplace"])(x2 * 1.0, *rest)  # rebind an interior node
+        z.sum().backward()
+        np.testing.assert_allclose(x2.grad.numpy(), want, rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_generated_file_up_to_date():
+    """CI gate: _generated.py must match a fresh regeneration of ops.yaml."""
+    assert op_gen.check_up_to_date(), (
+        "paddle_tpu/ops/_generated.py is stale — run "
+        "`python tools/gen_ops.py --write` and commit")
+
+
+def test_registry_surface_complete():
+    """Every YAML op and in-place variant is importable from paddle_tpu."""
+    assert op_gen.surface_check() == []
+
+
+def test_registry_metadata_sane():
+    assert len(SPECS) >= 50  # the migration target from VERDICT r2 item 2
+    for s in SPECS:
+        assert s.get("np_ref"), f"{s.name}: every op needs a numpy reference"
+        assert s.get("dtypes"), f"{s.name}: every op needs a dtype ladder"
+
+
+def test_op_coverage_report(capsys):
+    """Print the OpTest coverage ledger (VERDICT r2 item 8: 'coverage
+    report printed by the suite — ops covered / total'). YAML-registered
+    ops get automatic check_output (+ check_grad when differentiable);
+    test_op_numeric_grads covers further hand-written families."""
+    from paddle_tpu.ops.registry import api_surface
+
+    ops = [r for r in api_surface() if r.kind == "op"]
+    yaml_names = set()
+    for s in SPECS:
+        yaml_names.add(s.name)
+        if s.get("inplace"):
+            yaml_names.add(s["inplace"])
+    covered = [r for r in ops if r.name.split(".")[-1] in yaml_names]
+    n_grad = sum(1 for s in SPECS if s.differentiable)
+    with capsys.disabled():
+        print(f"\n[op-coverage] yaml-registered: {len(yaml_names)} ops "
+              f"({n_grad} with check_grad); public op surface: "
+              f"{len(covered)}/{len(ops)} auto-covered "
+              f"({100.0 * len(covered) / max(len(ops), 1):.0f}%)")
+    # ratchet: the YAML registry must keep covering a substantial slice of
+    # the public op surface as it grows
+    assert len(covered) >= 90, (len(covered), len(ops))

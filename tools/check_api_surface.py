@@ -27,6 +27,18 @@ def main():
     args = ap.parse_args()
 
     from paddle_tpu.ops.registry import check_manifest, save_manifest
+    from paddle_tpu.ops import op_gen
+
+    # the YAML registry is upstream of the manifest: generated code must be
+    # current and every YAML op importable before the manifest means anything
+    if not op_gen.check_up_to_date():
+        print("ops/_generated.py is stale vs ops.yaml — run "
+              "`python tools/gen_ops.py --write`")
+        return 1
+    yaml_missing = op_gen.surface_check()
+    if yaml_missing:
+        print(f"ops.yaml entries missing from the live surface: {yaml_missing}")
+        return 1
 
     if args.update:
         n = save_manifest(args.manifest)

@@ -1,0 +1,37 @@
+"""Regenerate / verify paddle_tpu/ops/_generated.py from ops.yaml.
+
+Usage:
+    python tools/gen_ops.py --write   # regenerate after editing ops.yaml
+    python tools/gen_ops.py --check   # CI gate: fail if generated file drifts
+
+Reference analog: paddle/phi/api/yaml/generator/api_gen.py (build-time
+codegen) + the CI check that generated sources match their YAML.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.ops import op_gen  # noqa: E402
+
+
+def main(argv):
+    mode = argv[1] if len(argv) > 1 else "--check"
+    if mode == "--write":
+        n = op_gen.write_generated()
+        print(f"wrote {op_gen.GENERATED_PATH} ({n} ops)")
+        return 0
+    if mode == "--check":
+        if op_gen.check_up_to_date():
+            print("ops: generated file up to date")
+            return 0
+        print("ops: _generated.py is STALE — run python tools/gen_ops.py "
+              "--write and commit", file=sys.stderr)
+        return 1
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
