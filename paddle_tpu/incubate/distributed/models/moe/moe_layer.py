@@ -92,6 +92,12 @@ class MoELayer(Layer):
         # stack expert params: [E, ...] sharded over the expert axis
         self._param_names, self._template_params, self._expert_fn = \
             _functionalize(experts[0])
+        # SwiGLU FFN experts (the Llama/Qwen2-MoE shape) get the grouped-GEMM
+        # Pallas path: capacity tiles beyond each expert's fill count are
+        # skipped instead of multiplied as zeros (reference: fused MoE
+        # grouped-GEMM dispatch kernels)
+        self._ffn_fast = self._param_names == [
+            "gate_proj.weight", "up_proj.weight", "down_proj.weight"]
         self._stacked: list[Parameter] = []
         for j, pname in enumerate(self._param_names):
             per = [dict(e.named_parameters())[pname]._d for e in experts]
@@ -117,6 +123,11 @@ class MoELayer(Layer):
         logits = self.gate(tokens)  # [n, e]
         expert_fn = self._expert_fn
         n_params = len(self._stacked)
+        from .....core.flags import flag
+        from .....ops.kernels import _common as kern
+        use_grouped = (self._ffn_fast and kern.available()
+                       and flag("use_pallas_kernels"))
+        interpret = kern.interpret_mode()
 
         def jfn(tok, lg, *stacked):
             probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
@@ -140,9 +151,19 @@ class MoELayer(Layer):
                                    tok.astype(jnp.float32)).astype(tok.dtype)
             stacked_params = list(stacked)
 
-            def run_one(param_arrays, xin):
-                return expert_fn(param_arrays, xin)
-            expert_out = jax.vmap(run_one)(stacked_params, expert_in)  # [e,c,h]
+            if use_grouped:
+                from .....ops.kernels.moe_gemm_pallas import grouped_matmul
+                counts = jnp.sum(dispatch, axis=(0, 2)).astype(jnp.int32)
+                gate_w, up_w, down_w = stacked_params
+                gh = grouped_matmul(expert_in, gate_w, counts, interpret)
+                uh = grouped_matmul(expert_in, up_w, counts, interpret)
+                act = (jax.nn.silu(gh.astype(jnp.float32))
+                       * uh.astype(jnp.float32)).astype(expert_in.dtype)
+                expert_out = grouped_matmul(act, down_w, counts, interpret)
+            else:
+                def run_one(param_arrays, xin):
+                    return expert_fn(param_arrays, xin)
+                expert_out = jax.vmap(run_one)(stacked_params, expert_in)
             out = jnp.einsum("nec,ech->nh", combine,
                              expert_out.astype(jnp.float32)).astype(tok.dtype)
             # aux load-balance loss (GShard eq.(4), generalised to top-k):

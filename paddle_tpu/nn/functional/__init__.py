@@ -446,15 +446,31 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
 
 def rope(q, k, sin, cos, name=None):
     """Rotary position embedding applied to q and k
-    (reference: fused_rope kernel, paddle/phi/kernels/fusion/gpu/fused_rope*)."""
+    (reference: fused_rope kernel, paddle/phi/kernels/fusion/gpu/fused_rope*).
+
+    On TPU this dispatches to the fused Pallas kernel (one VMEM pass per
+    tensor; the adjoint reuses the same kernel with -sin), falling back to
+    the XLA composite elsewhere."""
+    from ...core.flags import flag
+    from ...ops.kernels import _common as kern
     sin_a, cos_a = as_tensor(sin)._data, as_tensor(cos)._data
 
-    def rot(a):
-        a1, a2 = jnp.split(a, 2, axis=-1)
-        return jnp.concatenate([-a2, a1], axis=-1)
+    qt = as_tensor(q)
+    use_kernel = (kern.available() and flag("use_pallas_kernels")
+                  and qt.ndim == 4 and qt.shape[-1] % 2 == 0
+                  and cos_a.size == qt.shape[1] * qt.shape[-1])
+    if use_kernel:
+        from ...ops.kernels import rope_pallas as rp
 
-    def fq(a):
-        return a * cos_a.astype(a.dtype) + rot(a) * sin_a.astype(a.dtype)
+        def fq(a):
+            return rp.rope_apply(a, cos_a, sin_a, kern.interpret_mode())
+    else:
+        def rot(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jnp.concatenate([-a2, a1], axis=-1)
+
+        def fq(a):
+            return a * cos_a.astype(a.dtype) + rot(a) * sin_a.astype(a.dtype)
     q_out = apply(fq, q, name="rope_q")
     k_out = apply(fq, k, name="rope_k")
     return q_out, k_out

@@ -1,0 +1,107 @@
+"""Grouped expert matmul (MoE grouped-GEMM) Pallas TPU kernel.
+
+Reference analog: the grouped/segmented GEMM the reference's fused MoE path
+dispatches per expert group (paddle/phi/kernels/fusion/ moe kernels; CUDA
+grouped GEMM). On TPU the capacity-bucketed layout [E, C, H] already gives
+static shapes, so a dense einsum is MXU-friendly — but it multiplies every
+padded capacity slot. This kernel takes the per-expert fill count and SKIPS
+whole [block_c, block_f] output tiles that lie entirely beyond an expert's
+fill level: with capacity_factor 1.25 and imbalanced routing, a large slice
+of the einsum's FLOPs are zeros the compiler cannot know about.
+
+Rows past counts[e] inside a live tile are zero vectors by construction
+(the dispatch one-hot zeroes them), so no in-tile masking is needed: the
+zero rows matmul to zero.
+
+Public entry: `grouped_matmul(x, w, counts)` with custom_vjp — dx reuses the
+kernel with w transposed (skipping the same tiles); dw is a dense einsum
+(every valid row contributes; the zero rows add nothing).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(c_ref, x_ref, w_ref, o_ref, *, block_c):
+    count = c_ref[0, 0]
+    c_start = pl.program_id(1) * block_c
+
+    @pl.when(count > c_start)
+    def _compute():
+        x = x_ref[0]                                  # [bc, H]
+        w = w_ref[0]                                  # [H, bf]
+        o_ref[0] = jnp.dot(
+            x, w, preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+    @pl.when(count <= c_start)
+    def _skip():
+        o_ref[0] = jnp.zeros(o_ref.shape[1:], o_ref.dtype)
+
+
+def _pick(n, target):
+    b = min(target, n)
+    while n % b:
+        b //= 2
+        if b <= 1:
+            return 1
+    return b
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _grouped_call(x, w, counts, interpret):
+    e, c, h = x.shape
+    f = w.shape[-1]
+    bc = _pick(c, 128)
+    bf = _pick(f, 256)
+    grid = (e, c // bc, f // bf)
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            functools.partial(_kernel, block_c=bc),
+            grid=grid,
+            in_specs=[pl.BlockSpec((1, 1), lambda e_, i, j: (e_, 0)),
+                      pl.BlockSpec((1, bc, h), lambda e_, i, j: (e_, i, 0)),
+                      pl.BlockSpec((1, h, bf), lambda e_, i, j: (e_, 0, j))],
+            out_specs=pl.BlockSpec((1, bc, bf), lambda e_, i, j: (e_, i, j)),
+            out_shape=jax.ShapeDtypeStruct((e, c, f), x.dtype),
+            interpret=interpret,
+        )(counts.reshape(e, 1).astype(jnp.int32), x, w)
+
+
+def _primal(x, w, counts, interpret=False):
+    return _grouped_call(x, w, counts, interpret)
+
+
+grouped_matmul = jax.custom_vjp(_primal, nondiff_argnums=(3,))
+
+
+def _vjp_fwd(x, w, counts, interpret):
+    return _primal(x, w, counts, interpret), (x, w, counts)
+
+
+def _vjp_bwd(interpret, saved, g):
+    x, w, counts = saved
+    dx = _grouped_call(g, jnp.swapaxes(w, 1, 2), counts, interpret)
+    dw = jnp.einsum("ech,ecf->ehf", x.astype(jnp.float32),
+                    g.astype(jnp.float32)).astype(w.dtype)
+    dcounts = np.zeros(counts.shape, jax.dtypes.float0) \
+        if jnp.issubdtype(counts.dtype, jnp.integer) else jnp.zeros_like(counts)
+    return dx, dw, dcounts
+
+
+grouped_matmul.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def reference_grouped_matmul(x, w, counts):
+    """Dense einsum reference (what XLA runs without the kernel), with the
+    beyond-count slots zeroed to match the kernel's contract."""
+    out = jnp.einsum("ech,ehf->ecf", x.astype(jnp.float32),
+                     w.astype(jnp.float32)).astype(x.dtype)
+    c = x.shape[1]
+    mask = jnp.arange(c)[None, :, None] < counts.reshape(-1, 1, 1)
+    return jnp.where(mask, out, 0)

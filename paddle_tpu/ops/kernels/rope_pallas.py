@@ -1,0 +1,107 @@
+"""Fused rotary-position-embedding (RoPE) Pallas TPU kernel, fwd + bwd.
+
+Reference analog: paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu (+ grad
+kernel fused_rope_grad_kernel.cu). The XLA composite builds rotate-half via
+two lane-slices, a negate and a concat per tensor — several relayouts per
+(q, k) pair. This kernel does the rotation in one VMEM pass per row block:
+read [rows, H, D], read the per-position [rows, D] cos/sin block once, write
+the rotated block. RoPE is linear in x, and the rotation matrix is
+orthogonal: the VJP is the SAME kernel with sin negated (rotation by -theta),
+so backward reuses the forward pallas_call — no separate grad kernel needed.
+
+Public entry: `rope_apply(x, cos, sin)` (custom_vjp) for one [B, S, H, D]
+tensor; `F.rope` dispatches q and k through it when a TPU is available and
+falls back to the XLA composite otherwise. Tests run interpret mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
+    x = x_ref[0].astype(jnp.float32)                # [rows, H, D]
+    cos = cos_ref[...].astype(jnp.float32)[:, None, :]   # [rows, 1, D]
+    sin = sin_ref[...].astype(jnp.float32)[:, None, :]
+    d = x.shape[-1]
+    x1 = x[..., : d // 2]
+    x2 = x[..., d // 2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    o_ref[0] = (x * cos + rot * sin).astype(o_ref.dtype)
+
+
+def _pick_rows(total_s, feat):
+    """Rows (positions) per block: ~1 MB f32 per x buffer, divisor of S."""
+    budget = 1024 * 1024
+    rows = max(1, min(256, budget // max(feat * 4, 1)))
+    while total_s % rows:
+        rows //= 2
+        if rows <= 1:
+            return 1
+    return rows
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _rope_call(x, cos2, sin2, interpret):
+    b, s, h, d = x.shape
+    rows = _pick_rows(s, h * d)
+    nsb = s // rows
+    grid = (b * nsb,)
+    x_spec = pl.BlockSpec((1, rows, h, d), lambda i: (i // nsb, i % nsb, 0, 0))
+    t_spec = pl.BlockSpec((rows, d), lambda i: (i % nsb, 0))
+
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _rope_kernel,
+            grid=grid,
+            in_specs=[x_spec, t_spec, t_spec],
+            out_specs=x_spec,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=interpret,
+        )(x, cos2, sin2)
+
+
+def _tables_2d(cos, sin, s, d):
+    """cos/sin in any broadcastable layout ([1,S,1,D], [S,D], ...) -> [S,D]."""
+    cos2 = jnp.broadcast_to(jnp.asarray(cos).reshape(s, d), (s, d))
+    sin2 = jnp.broadcast_to(jnp.asarray(sin).reshape(s, d), (s, d))
+    return cos2, sin2
+
+
+def _primal(x, cos, sin, interpret=False):
+    b, s, h, d = x.shape
+    cos2, sin2 = _tables_2d(cos, sin, s, d)
+    return _rope_call(x, cos2, sin2, interpret)
+
+
+rope_apply = jax.custom_vjp(_primal, nondiff_argnums=(3,))
+
+
+def _vjp_fwd(x, cos, sin, interpret):
+    return _primal(x, cos, sin, interpret), (cos, sin, x.shape)
+
+
+def _vjp_bwd(interpret, saved, g):
+    cos, sin, shp = saved
+    _, s, _, d = shp
+    cos2, sin2 = _tables_2d(cos, sin, s, d)
+    # orthogonal rotation: the adjoint is rotation by -theta
+    dx = _rope_call(g, cos2, -sin2, interpret)
+    return dx, None, None
+
+
+rope_apply.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def rope_reference(x, cos, sin):
+    """XLA composite (the non-TPU fallback), kept for parity tests/A-B."""
+    d = x.shape[-1]
+    cos = jnp.asarray(cos).reshape(1, x.shape[1], 1, d).astype(x.dtype)
+    sin = jnp.asarray(sin).reshape(1, x.shape[1], 1, d).astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rot * sin

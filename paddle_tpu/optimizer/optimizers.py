@@ -87,6 +87,14 @@ class Adam(Optimizer):
     def _decoupled_decay_for(self, p) -> float:
         return 0.0  # plain Adam couples decay into the gradient instead
 
+    def _use_fused_kernel(self, p) -> bool:
+        """Fused Pallas update for big tensors on TPU (small ones aren't
+        worth a kernel launch; amsgrad needs the vmax accumulator path)."""
+        from ..core.flags import flag
+        from ..ops.kernels import _common as kern
+        return (not self._amsgrad and kern.available()
+                and flag("use_pallas_kernels") and p._data.size >= 8192)
+
     def _append_optimize_op(self, p, grad):
         """Shared Adam/AdamW body: the only behavioral fork is whether decay
         is coupled into the gradient (Adam) or applied to the weights
@@ -100,6 +108,20 @@ class Adam(Optimizer):
         v = self._add_accumulator("moment2", p, dtype=jnp.float32)
         # scalar step-based bias correction (single counter, standard Adam)
         t = self._step_tensor._data
+
+        if self._use_fused_kernel(p):
+            from ..ops.kernels import _common as kern
+            from ..ops.kernels import adamw_pallas as ap
+            new_w, m._data, v._data, p_out = ap.adamw_update(
+                w32, g, m._data, v._data, self._lr_for(p), t,
+                beta1=self._beta1, beta2=self._beta2, eps=self._epsilon,
+                wd=float(self._decoupled_decay_for(p)),
+                out_dtype=p._data.dtype, interpret=kern.interpret_mode())
+            if master is not None:
+                master._data = new_w
+            p._data = p_out
+            return
+
         m._data = self._beta1 * m._data + (1 - self._beta1) * g
         v._data = self._beta2 * v._data + (1 - self._beta2) * jnp.square(g)
         mhat = m._data / (1 - self._beta1 ** t)

@@ -1,0 +1,183 @@
+"""Interpret-mode parity tests for the round-3 Pallas kernel families:
+fused RoPE, fused AdamW update, and the MoE grouped-GEMM (VERDICT r2 #3).
+
+Each kernel's real jaxpr runs through the Pallas interpreter on CPU and is
+compared against the XLA composite it replaces on TPU.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.kernels import _common as kern
+from paddle_tpu.ops.kernels import (adamw_pallas, moe_gemm_pallas,
+                                    rope_pallas)
+
+
+def _rope_tables(s, d, dtype=np.float32):
+    ang = np.outer(np.arange(s), 1.0 / (10000 ** (np.arange(0, d, 2) / d)))
+    cos = np.concatenate([np.cos(ang), np.cos(ang)], -1)
+    sin = np.concatenate([np.sin(ang), np.sin(ang)], -1)
+    return (cos.reshape(1, s, 1, d).astype(dtype),
+            sin.reshape(1, s, 1, d).astype(dtype))
+
+
+@pytest.mark.parametrize("shape", [(2, 16, 4, 64), (1, 24, 3, 32)])
+def test_rope_kernel_matches_composite(shape):
+    b, s, h, d = shape
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    g = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    cos, sin = _rope_tables(s, d)
+
+    out = rope_pallas.rope_apply(x, cos, sin, True)
+    ref = rope_pallas.rope_reference(x, cos, sin)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    d1 = jax.grad(lambda a: jnp.sum(rope_pallas.rope_apply(a, cos, sin, True)
+                                    * g))(x)
+    d2 = jax.grad(lambda a: jnp.sum(rope_pallas.rope_reference(a, cos, sin)
+                                    * g))(x)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=1e-6)
+
+
+def test_f_rope_dispatches_to_kernel_under_interpret():
+    """F.rope uses the Pallas kernel when kernels are 'available' and still
+    matches the composite path bit-for-bit at f32."""
+    import paddle_tpu.nn.functional as F
+
+    b, s, h, d = 2, 16, 4, 64
+    rng = np.random.default_rng(1)
+    q = paddle.to_tensor(rng.standard_normal((b, s, h, d)).astype(np.float32),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.standard_normal((b, s, 2, d)).astype(np.float32))
+    cos, sin = _rope_tables(s, d)
+    qo_ref, ko_ref = F.rope(paddle.to_tensor(q.numpy()),
+                            paddle.to_tensor(k.numpy()),
+                            paddle.to_tensor(sin), paddle.to_tensor(cos))
+    kern.force_interpret(True)
+    try:
+        qo, ko = F.rope(q, k, paddle.to_tensor(sin), paddle.to_tensor(cos))
+        qo.sum().backward()
+    finally:
+        kern.force_interpret(False)
+    np.testing.assert_allclose(qo.numpy(), qo_ref.numpy(), atol=1e-6)
+    np.testing.assert_allclose(ko.numpy(), ko_ref.numpy(), atol=1e-6)
+    assert q.grad is not None
+
+
+def test_adamw_kernel_matches_reference_update():
+    rng = np.random.default_rng(2)
+    n = 3000  # pad path: not a lane multiple
+    w = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    g = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    m = jnp.asarray(rng.standard_normal(n) * 0.1, jnp.float32)
+    v = jnp.asarray(np.abs(rng.standard_normal(n)) * 0.01, jnp.float32)
+    b1, b2, eps, wd, lr, t = 0.9, 0.95, 1e-8, 0.1, 3e-4, 7.0
+
+    w2, m2, v2, po = adamw_pallas.adamw_update(
+        w, g, m, v, lr, t, beta1=b1, beta2=b2, eps=eps, wd=wd,
+        out_dtype=jnp.bfloat16, interpret=True)
+
+    me = b1 * np.asarray(m) + (1 - b1) * np.asarray(g)
+    ve = b2 * np.asarray(v) + (1 - b2) * np.asarray(g) ** 2
+    mh = me / (1 - b1 ** t)
+    vh = ve / (1 - b2 ** t)
+    we = np.asarray(w) * (1 - lr * wd) - lr * mh / (np.sqrt(vh) + eps)
+    np.testing.assert_allclose(np.asarray(w2), we, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m2), me, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(v2), ve, rtol=1e-6, atol=1e-7)
+    assert po.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(po, np.float32), we, rtol=1e-2,
+                               atol=1e-2)
+
+
+def test_adamw_optimizer_fused_path_matches_unfused():
+    """Same model, same grads: fused-kernel step == jnp step."""
+    import paddle_tpu.nn as nn
+
+    def build():
+        paddle.seed(0)
+        net = nn.Linear(96, 96)  # 9216 params >= fused threshold
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters(),
+                                     weight_decay=0.1)
+        return net, opt
+
+    x = np.random.default_rng(3).standard_normal((4, 96)).astype(np.float32)
+
+    def run(fused):
+        net, opt = build()
+        if fused:
+            kern.force_interpret(True)
+        try:
+            for _ in range(3):
+                loss = (net(paddle.to_tensor(x)) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+        finally:
+            if fused:
+                kern.force_interpret(False)
+        return net.weight.numpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=2e-6)
+
+
+def test_grouped_matmul_matches_einsum():
+    rng = np.random.default_rng(4)
+    e_, c, h, f = 4, 16, 32, 64
+    counts = jnp.asarray([16, 5, 0, 9], jnp.int32)
+    mask = jnp.arange(c)[None, :, None] < counts.reshape(-1, 1, 1)
+    x = jnp.where(mask, jnp.asarray(rng.standard_normal((e_, c, h)),
+                                    jnp.float32), 0)
+    w = jnp.asarray(rng.standard_normal((e_, h, f)), jnp.float32)
+    g = jnp.where(jnp.arange(c)[None, :, None] < counts.reshape(-1, 1, 1),
+                  jnp.asarray(rng.standard_normal((e_, c, f)), jnp.float32), 0)
+
+    out = moe_gemm_pallas.grouped_matmul(x, w, counts, True)
+    ref = moe_gemm_pallas.reference_grouped_matmul(x, w, counts)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    d1 = jax.grad(lambda a, b: jnp.sum(
+        moe_gemm_pallas.grouped_matmul(a, b, counts, True) * g),
+        argnums=(0, 1))(x, w)
+    d2 = jax.grad(lambda a, b: jnp.sum(
+        moe_gemm_pallas.reference_grouped_matmul(a, b, counts) * g),
+        argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(d1[0]), np.asarray(d2[0]),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d1[1]), np.asarray(d2[1]),
+                               atol=1e-5)
+
+
+def test_moe_layer_grouped_path_matches_vmap():
+    """MoELayer forward+backward parity: grouped-GEMM kernel vs the generic
+    vmapped expert path, same weights and routing."""
+    from paddle_tpu.models import qwen2_moe_tiny
+
+    def run(fast):
+        paddle.seed(0)
+        model = qwen2_moe_tiny()
+        if fast:
+            kern.force_interpret(True)
+        try:
+            x = paddle.to_tensor(
+                np.arange(2 * 16).reshape(2, 16).astype(np.int64) % 100)
+            y = paddle.to_tensor(
+                np.arange(2 * 16).reshape(2, 16).astype(np.int64) % 100)
+            _, loss = model(x, labels=y)
+            loss.backward()
+            grads = [p.grad.numpy().copy() for p in model.parameters()
+                     if p.grad is not None][:6]
+            return float(loss), grads
+        finally:
+            if fast:
+                kern.force_interpret(False)
+
+    loss_fast, g_fast = run(True)
+    loss_ref, g_ref = run(False)
+    assert abs(loss_fast - loss_ref) < 1e-4, (loss_fast, loss_ref)
+    for a, b in zip(g_fast, g_ref):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
