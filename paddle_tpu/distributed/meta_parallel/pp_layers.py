@@ -92,10 +92,14 @@ class SegmentLayers:
 class PipelineLayer(Layer):
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
                  seg_method="uniform", recompute_interval=0,
-                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+                 recompute_ctx=None, num_virtual_pipeline_stages=None,
+                 aux_loss_coef=0.0):
         super().__init__()
         self._layers_desc = list(layers)
         self._loss_fn = loss_fn
+        # router aux-loss weight for MoE blocks (PipelineParallel._loss adds
+        # coef * accumulated pipe_aux to the task loss)
+        self._aux_loss_coef = float(aux_loss_coef)
         self._recompute_interval = recompute_interval
         self._topology = topology
         self._num_virtual = int(num_virtual_pipeline_stages or 1)

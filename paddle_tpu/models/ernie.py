@@ -148,21 +148,75 @@ class Ernie(GenerationMixin, nn.Layer):
         return 6.0 * n + 12.0 * l * h * seq_len / 2
 
 
+class ErnieMoeBlockPipe(nn.Layer):
+    """Homogeneous MoE pipeline stage: a routed-MoE decoder layer with
+    in-block rope tables and a `pipe_aux` hook so the compiled pipeline
+    schedule accumulates the router's load-balance loss (reference composes
+    moe_layer.py:263 inside fleet hybrid-parallel models). Expert params are
+    stacked [E, ...] and marked on the expert mesh axis — orthogonal to the
+    'pp' axis the pipeline stacks over."""
+
+    def __init__(self, mcfg: Qwen2MoeConfig, seq_len: int):
+        super().__init__()
+        self.block = Qwen2MoeDecoderLayer(
+            mcfg, layer_idx=mcfg.first_k_dense_replace, parallel=True)
+        cos, sin = _rope_tables(mcfg.as_llama(), seq_len)
+        self._cos_np = cos.numpy()
+        self._sin_np = sin.numpy()
+
+    def forward(self, x):
+        cos = paddle.to_tensor(self._cos_np)
+        sin = paddle.to_tensor(self._sin_np)
+        return self.block(x, cos, sin)
+
+    def pipe_aux(self):
+        return self.block.l_aux
+
+
 def ernie_for_pipeline(cfg: ErnieConfig, seq_len: int,
                        num_stages=None) -> PipelineLayer:
-    """PipelineLayer ERNIE for the hybrid dp x mp x pp recipe. The dense
-    backbone is architecturally a Llama stack, so the desc layout (tied
-    embeddings via SharedLayerDesc, TP blocks) is delegated to
-    llama_for_pipeline — one copy of the wiring to maintain.
+    """PipelineLayer ERNIE for the hybrid dp x mp x pp recipe.
 
-    The MoE tail cannot be pipelined yet (MoELayer has no TP/pp block
-    form); raising beats silently training a dense model as 'MoE ERNIE'."""
-    if cfg.num_experts:
-        raise NotImplementedError(
-            "ernie_for_pipeline supports the dense backbone only; "
-            "set num_experts=0 (MoE pipeline stages not implemented)")
-    from .llama import llama_for_pipeline
-    return llama_for_pipeline(cfg.as_llama(), seq_len, num_stages=num_stages)
+    Dense backbone (num_experts == 0): architecturally a Llama stack, so the
+    desc layout is delegated to llama_for_pipeline.
+
+    MoE (num_experts > 0): the homogeneous pipelined run is the MoE tail
+    (ErnieMoeBlockPipe x (num_layers - first_k_dense), which must divide the
+    stage count); the leading dense blocks execute as full-batch GSPMD head
+    layers in front of the ring, and the router aux loss rides the compiled
+    schedule into the training loss via aux_loss_coef."""
+    if not cfg.num_experts:
+        from .llama import llama_for_pipeline
+        return llama_for_pipeline(cfg.as_llama(), seq_len,
+                                  num_stages=num_stages)
+
+    from .llama import (LlamaBlockPipe, LlamaEmbeddingPipe, LlamaNormPipe,
+                        LlamaPretrainLoss)
+    from ..distributed.meta_parallel.pp_layers import (LayerDesc,
+                                                       SharedLayerDesc)
+    lcfg = cfg.as_llama()
+    mcfg = cfg.as_moe()
+    descs = []
+    if cfg.tie_word_embeddings:
+        descs.append(SharedLayerDesc("embed", LlamaEmbeddingPipe, None,
+                                     "embed_tokens", lcfg))
+    else:
+        descs.append(LayerDesc(LlamaEmbeddingPipe, lcfg))
+    descs += [LayerDesc(LlamaBlockPipe, lcfg, seq_len)
+              for _ in range(cfg.first_k_dense)]
+    descs += [LayerDesc(ErnieMoeBlockPipe, mcfg, seq_len)
+              for _ in range(cfg.num_layers - cfg.first_k_dense)]
+    descs.append(LayerDesc(LlamaNormPipe, lcfg))
+    if cfg.tie_word_embeddings:
+        descs.append(SharedLayerDesc("embed", LlamaEmbeddingPipe,
+                                     lambda layer, x: layer.as_head(x),
+                                     "embed_tokens", lcfg))
+    else:
+        from .llama import LlamaHeadPipe
+        descs.append(LayerDesc(LlamaHeadPipe, lcfg))
+    return PipelineLayer(layers=descs, num_stages=num_stages,
+                         loss_fn=LlamaPretrainLoss(lcfg),
+                         aux_loss_coef=cfg.router_aux_loss_coef)
 
 
 def ernie_tiny(**kw) -> Ernie:

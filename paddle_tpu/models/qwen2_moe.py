@@ -85,8 +85,9 @@ class Qwen2MoeDecoderLayer(LlamaDecoderLayer):
     norms, attention, and the fused-residual forward are inherited, so the
     TPU-sensitive kernel call sequence lives in exactly one place."""
 
-    def __init__(self, cfg: Qwen2MoeConfig, layer_idx: int):
-        super().__init__(cfg.as_llama())
+    def __init__(self, cfg: Qwen2MoeConfig, layer_idx: int,
+                 parallel: bool = False):
+        super().__init__(cfg.as_llama(), parallel=parallel)
         self.is_dense = layer_idx < cfg.first_k_dense_replace
         if self.is_dense:
             self.mlp = _SwiGLU(cfg.hidden_size, cfg.dense_intermediate_size,

@@ -170,11 +170,28 @@ def test_dit_shapes_and_adaln_zero_identity():
     np.testing.assert_allclose(np.asarray(out.numpy()), 0.0, atol=1e-6)
 
 
-def test_ernie_for_pipeline_rejects_moe():
+def test_ernie_for_pipeline_builds_moe_descs():
+    """MoE ERNIE is pipelineable (round 3): the desc list holds the leading
+    dense blocks + homogeneous MoE tail, the MoE run is the pipelined block
+    range, and the router aux coefficient rides on the PipelineLayer (full
+    parity test: test_distributed.py::test_ernie_moe_pipeline_4d_parity)."""
     from paddle_tpu.models import ErnieConfig, ernie_for_pipeline
-    cfg = ErnieConfig(num_experts=8)
-    with pytest.raises(NotImplementedError, match="dense backbone only"):
-        ernie_for_pipeline(cfg, seq_len=16, num_stages=2)
+    from paddle_tpu.models.ernie import ErnieMoeBlockPipe
+    cfg = ErnieConfig(vocab_size=128, max_position_embeddings=16,
+                      hidden_size=32, num_layers=6, num_heads=4,
+                      num_kv_heads=2, intermediate_size=64, num_experts=4,
+                      moe_intermediate_size=32,
+                      shared_expert_intermediate_size=32, first_k_dense=2,
+                      router_aux_loss_coef=0.02)
+    pl = ernie_for_pipeline(cfg, seq_len=16, num_stages=2)
+    moe_blocks = [l for l in pl.run_function
+                  if isinstance(l, ErnieMoeBlockPipe)]
+    assert len(moe_blocks) == 4
+    assert pl._aux_loss_coef == 0.02
+    s, e = pl._block_range
+    assert e - s == 4  # the homogeneous pipelined run is the MoE tail
+    assert all(isinstance(pl.run_function[i], ErnieMoeBlockPipe)
+               for i in range(s, e))
 
 
 def test_dit_label_dropout_trains_null_row():
