@@ -9,7 +9,8 @@ steps score each candidate without burning cluster time on full launches.
 """
 
 from .tuner import (AutoTuner, Candidate,  # noqa: F401
-                    default_candidates, prune_by_divisibility)
+                    default_candidates, measure_compiled_step,
+                    prune_by_divisibility)
 
 __all__ = ["AutoTuner", "Candidate", "default_candidates",
-           "prune_by_divisibility"]
+           "measure_compiled_step", "prune_by_divisibility"]

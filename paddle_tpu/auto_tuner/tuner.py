@@ -64,6 +64,43 @@ def prune_by_divisibility(candidates, num_layers=None, num_heads=None,
     return kept
 
 
+def measure_compiled_step(build, steps=3, warmup=1):
+    """Measured-trial mode (reference tuner.py:19 launches real trials and
+    collects metrics): returns a `measure(candidate)` that initializes the
+    candidate's hybrid mesh, asks `build(candidate)` for a (step, args)
+    pair — `step` being the real jitted train step returning a loss Tensor
+    — and times `steps` executions after `warmup` (device-synced via the
+    loss read-back). The mesh/topology is reset after every trial so
+    candidates cannot contaminate one another."""
+    import time as _time
+
+    def measure(cand):
+        from ..distributed.fleet import DistributedStrategy, fleet
+        from ..distributed.topology import reset_topology_state
+
+        reset_topology_state()
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = cand.as_hybrid_configs()
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            step, args = build(cand)
+            loss = None
+            for _ in range(max(warmup, 1)):
+                loss = step(*args)
+            if loss is not None:
+                float(loss)
+            t0 = _time.perf_counter()
+            for _ in range(max(steps, 1)):
+                loss = step(*args)
+            if loss is not None:
+                float(loss)  # drain the async dispatch
+            return {"time_s": (_time.perf_counter() - t0) / max(steps, 1)}
+        finally:
+            reset_topology_state()
+
+    return measure
+
+
 class AutoTuner:
     """Search candidates with a user measure function.
 

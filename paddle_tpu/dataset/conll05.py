@@ -1,0 +1,153 @@
+"""CoNLL-2005 SRL reader (reference: python/paddle/dataset/conll05.py).
+
+Reads the cached `conll05st-tests.tar.gz` (words + props members) plus the
+word/verb/target dictionaries, and yields the reference's 9-slot SRL sample:
+(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_id, mark, label_ids)
+— one sample per (sentence, predicate) pair, labels in IOB form.
+
+No-egress environment: a cache miss raises with the expected path.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+
+from .common import DATA_HOME
+
+__all__ = ['get_dict', 'get_embedding', 'test']
+
+_DIR = os.path.join(DATA_HOME, 'conll05st')
+_TAR = 'conll05st-tests.tar.gz'
+
+UNK_IDX = 0
+
+
+def _need(path, what):
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"{what} not cached (no network egress); place it at {path}")
+    return path
+
+
+def _load_dict(path):
+    d = {}
+    with open(path) as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def get_dict(data_dir=None):
+    """(word_dict, verb_dict, label_dict) from the cached dictionary files
+    (reference load_dict + label-dict IOB expansion)."""
+    d = data_dir or _DIR
+    word_dict = _load_dict(_need(os.path.join(d, 'wordDict.txt'),
+                                 'conll05 word dict'))
+    verb_dict = _load_dict(_need(os.path.join(d, 'verbDict.txt'),
+                                 'conll05 verb dict'))
+    # reference expands each target label L into B-L / I-L and adds O
+    raw = _load_dict(_need(os.path.join(d, 'targetDict.txt'),
+                           'conll05 target dict'))
+    label_dict = {}
+    for label in raw:
+        label_dict['B-' + label] = len(label_dict)
+        label_dict['I-' + label] = len(label_dict)
+    label_dict['O'] = len(label_dict)
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding(data_dir=None):
+    """Path of the cached wikipedia embedding file (reference: emb)."""
+    return _need(os.path.join(data_dir or _DIR, 'emb'),
+                 'conll05 embedding file')
+
+
+def _read_member(tf, suffix):
+    m = next((m for m in tf.getmembers() if m.name.endswith(suffix)), None)
+    if m is None:
+        raise ValueError(f"no member ending with {suffix!r} in the archive")
+    raw = tf.extractfile(m).read()
+    if suffix.endswith('.gz'):
+        raw = gzip.decompress(raw)
+    return raw.decode('utf-8')
+
+
+def _corpus(words_text, props_text):
+    """Yield (sentence_words, [(verb, labels_iob)]) per sentence — the
+    reference corpus_reader's merge of the words and props columns."""
+    sentences = []
+    words, props = [], []
+    for wline, pline in zip(words_text.splitlines() + [''],
+                            props_text.splitlines() + ['']):
+        wline, pline = wline.strip(), pline.strip()
+        if not wline:
+            if words:
+                sentences.append((words, props))
+            words, props = [], []
+            continue
+        words.append(wline.split()[0])
+        props.append(pline.split())
+    for words, props in sentences:
+        if not props or not props[0]:
+            continue
+        n_pred = len(props[0]) - 1
+        verbs = [row[0] for row in props]
+        for p in range(n_pred):
+            cols = [row[1 + p] for row in props]
+            labels, verb = _iob(cols), None
+            for v, c in zip(verbs, cols):
+                if '(V' in c:
+                    verb = v
+                    break
+            if verb is not None:
+                yield words, verb, labels
+
+
+def _iob(cols):
+    """Convert the CoNLL bracket format '(A0*' / '*' / '*)' to IOB tags."""
+    out, state = [], 'O'
+    for c in cols:
+        if '(' in c:
+            label = c[c.index('(') + 1:].split('*')[0].rstrip(')')
+            out.append('B-' + label)
+            state = 'O' if ')' in c else 'I-' + label
+        elif state != 'O':
+            out.append(state)
+            if ')' in c:
+                state = 'O'
+        else:
+            out.append('O')
+    return out
+
+
+def test(data_file=None, data_dir=None):
+    """Reader over the cached test archive; yields the 9-slot SRL sample
+    (reference reader_creator): words + 5-gram predicate context + verb +
+    predicate mark + IOB label ids."""
+    word_dict, verb_dict, label_dict = get_dict(data_dir)
+    path = data_file or os.path.join(_DIR, _TAR)
+    _need(path, 'conll05 test archive')
+
+    def reader():
+        with tarfile.open(path, 'r:*') as tf:
+            words_text = _read_member(tf, 'words.gz')
+            props_text = _read_member(tf, 'props.gz')
+        for words, verb, labels in _corpus(words_text, props_text):
+            n = len(words)
+            v_idx = labels.index('B-V') if 'B-V' in labels else 0
+            word_ids = [word_dict.get(w.lower(), UNK_IDX) for w in words]
+
+            def ctx(off):
+                i = min(max(v_idx + off, 0), n - 1)
+                return word_dict.get(words[i].lower(), UNK_IDX)
+
+            mark = [1 if i == v_idx else 0 for i in range(n)]
+            label_ids = [label_dict.get(lb, label_dict['O'])
+                         for lb in labels]
+            yield (word_ids, [ctx(-2)] * n, [ctx(-1)] * n, [ctx(0)] * n,
+                   [ctx(1)] * n, [ctx(2)] * n,
+                   [verb_dict.get(verb, UNK_IDX)] * n, mark, label_ids)
+
+    return reader

@@ -1,0 +1,65 @@
+"""Oxford 102 Flowers reader (reference: python/paddle/dataset/flowers.py).
+
+The reference decodes the JPEG tarball with cv2/PIL; neither exists in this
+environment, so the reader consumes a pre-decoded `flowers.npz` cache with
+arrays `images` (N,H,W,3 uint8), `labels` (N int64, 1-based like the
+reference's imagelabels.mat) and `setid_{trnid,valid,tstid}` (1-based sample
+indices per split, the reference's setid.mat fields). Build it once anywhere
+with cv2/PIL via `numpy.savez`; a cache miss raises with the expected path
+and format.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ['train', 'valid', 'test']
+
+_NPZ = os.path.join(DATA_HOME, 'flowers', 'flowers.npz')
+
+
+def _load(data_file):
+    path = data_file or _NPZ
+    if not os.path.exists(path):
+        raise RuntimeError(
+            "flowers cache missing (no network egress and no image decoder "
+            f"in-env); place a numpy archive at {path} with images "
+            "(N,H,W,3 uint8), labels (N int64, 1-based), and "
+            "setid_trnid/setid_valid/setid_tstid index arrays")
+    z = np.load(path)
+    for key in ('images', 'labels'):
+        if key not in z:
+            raise ValueError(f"flowers npz missing array {key!r}")
+    return z
+
+
+def _reader_creator(setid_key, data_file, mapper):
+    def reader():
+        z = _load(data_file)
+        images, labels = z['images'], z['labels']
+        idx = z[setid_key] if setid_key in z else \
+            np.arange(1, len(images) + 1)
+        for i in idx:
+            img = images[int(i) - 1]
+            lab = int(labels[int(i) - 1]) - 1  # 0-based class id
+            if mapper is not None:
+                img = mapper(img)
+            yield img, lab
+
+    return reader
+
+
+def train(mapper=None, data_file=None, use_xmap=True, cycle=False):
+    return _reader_creator('setid_trnid', data_file, mapper)
+
+
+def valid(mapper=None, data_file=None, use_xmap=True, cycle=False):
+    return _reader_creator('setid_valid', data_file, mapper)
+
+
+def test(mapper=None, data_file=None, use_xmap=True, cycle=False):
+    return _reader_creator('setid_tstid', data_file, mapper)

@@ -1,0 +1,60 @@
+"""Pascal VOC2012 segmentation reader (reference:
+python/paddle/dataset/voc2012.py).
+
+The reference decodes JPEG images + PNG label masks with cv2; with no image
+decoder in-env, this reader consumes a pre-decoded `voc2012.npz` cache with
+arrays `images` (N,H,W,3 uint8), `masks` (N,H,W uint8 class ids), and
+optional `split_{train,val,trainval}` index arrays (0-based) mirroring the
+ImageSets/Segmentation lists. A cache miss raises with the expected path and
+format.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ['train', 'val', 'test']
+
+_NPZ = os.path.join(DATA_HOME, 'voc2012', 'voc2012.npz')
+
+
+def _load(data_file):
+    path = data_file or _NPZ
+    if not os.path.exists(path):
+        raise RuntimeError(
+            "voc2012 cache missing (no network egress and no image decoder "
+            f"in-env); place a numpy archive at {path} with images "
+            "(N,H,W,3 uint8), masks (N,H,W uint8) and optional "
+            "split_train/split_val/split_trainval index arrays")
+    z = np.load(path)
+    for key in ('images', 'masks'):
+        if key not in z:
+            raise ValueError(f"voc2012 npz missing array {key!r}")
+    return z
+
+
+def _reader_creator(split_key, data_file):
+    def reader():
+        z = _load(data_file)
+        images, masks = z['images'], z['masks']
+        idx = z[split_key] if split_key in z else np.arange(len(images))
+        for i in idx:
+            yield images[int(i)], masks[int(i)]
+
+    return reader
+
+
+def train(data_file=None):
+    return _reader_creator('split_train', data_file)
+
+
+def val(data_file=None):
+    return _reader_creator('split_val', data_file)
+
+
+def test(data_file=None):
+    return _reader_creator('split_trainval', data_file)

@@ -1,0 +1,93 @@
+"""WMT14 en-fr reader (reference: python/paddle/dataset/wmt14.py).
+
+Reads the reference's preprocessed archive layout — `train/*` and `test/*`
+members with tab-separated parallel lines, plus `src.dict` / `trg.dict`
+members — and yields (src_ids, trg_ids, trg_next_ids) with the reference's
+reserved tokens <s>=0, <e>=1, <unk>=2.
+
+No-egress environment: a cache miss raises with the expected path.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+
+from .common import DATA_HOME
+
+__all__ = ['train', 'test', 'get_dict']
+
+_DIR = os.path.join(DATA_HOME, 'wmt14')
+_TAR = 'wmt14.tgz'
+
+START, END, UNK = '<s>', '<e>', '<unk>'
+START_ID, END_ID, UNK_ID = 0, 1, 2
+
+
+def _path(data_file):
+    path = data_file or os.path.join(_DIR, _TAR)
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"WMT14 archive not cached (no network egress); place {_TAR} "
+            f"under {_DIR} or pass data_file=")
+    return path
+
+
+def _load_dicts(tf, dict_size):
+    def one(suffix):
+        m = next((m for m in tf.getmembers() if m.name.endswith(suffix)),
+                 None)
+        if m is None:
+            raise ValueError(f"no {suffix} member in the wmt14 archive")
+        words = [w.strip() for w in
+                 tf.extractfile(m).read().decode('utf-8').splitlines() if
+                 w.strip()]
+        words = [START, END, UNK] + \
+            [w for w in words if w not in (START, END, UNK)]
+        if dict_size > 0:
+            words = words[:dict_size]
+        return {w: i for i, w in enumerate(words)}
+
+    return one('src.dict'), one('trg.dict')
+
+
+def get_dict(dict_size=-1, reverse=False, data_file=None):
+    """(src_dict, trg_dict) — id->word when reverse (reference contract)."""
+    with tarfile.open(_path(data_file), 'r:*') as tf:
+        src, trg = _load_dicts(tf, dict_size)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def _reader_creator(split, dict_size, data_file):
+    def reader():
+        with tarfile.open(_path(data_file), 'r:*') as tf:
+            src_dict, trg_dict = _load_dicts(tf, dict_size)
+            members = [m for m in tf.getmembers()
+                       if f"{split}/" in m.name and m.isfile()
+                       and not m.name.endswith('.dict')]
+            for m in sorted(members, key=lambda m: m.name):
+                for line in tf.extractfile(m).read().decode(
+                        'utf-8').splitlines():
+                    parts = line.split('\t')
+                    if len(parts) != 2:
+                        continue
+                    src = [src_dict.get(w, UNK_ID)
+                           for w in parts[0].split()]
+                    trg = [trg_dict.get(w, UNK_ID)
+                           for w in parts[1].split()]
+                    if not src or not trg:
+                        continue
+                    yield (src, [START_ID] + trg, trg + [END_ID])
+
+    return reader
+
+
+def train(dict_size=-1, data_file=None):
+    return _reader_creator('train', dict_size, data_file)
+
+
+def test(dict_size=-1, data_file=None):
+    return _reader_creator('test', dict_size, data_file)

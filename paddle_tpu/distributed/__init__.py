@@ -15,7 +15,8 @@ from .communication import (  # noqa: F401
     reduce_scatter, scatter, scatter_object_list, gather, send, recv, isend,
     irecv, P2POp, batch_isend_irecv, get_backend, barrier, wait, stream,
 )
-from .interface import spawn, split, parallelize, to_static, set_mesh  # noqa: F401
+from .interface import (spawn, split, parallelize, to_static, set_mesh,  # noqa: F401
+                        DistModel)
 from . import launch  # noqa: F401
 from . import utils  # noqa: F401
 from . import metric  # noqa: F401

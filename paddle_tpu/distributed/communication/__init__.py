@@ -346,18 +346,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                              out_split_sizes, group, sync_op)
 
 
-class stream:
-    """`paddle.distributed.stream.*` parity namespace: the `use_calc_stream`
-    distinction doesn't exist on XLA (one ordered stream per device), so these
-    forward to the plain collectives."""
-
-    all_reduce = staticmethod(all_reduce)
-    all_gather = staticmethod(all_gather)
-    all_to_all = staticmethod(all_to_all)
-    alltoall = staticmethod(all_to_all)
-    broadcast = staticmethod(broadcast)
-    reduce = staticmethod(reduce)
-    reduce_scatter = staticmethod(reduce_scatter)
-    scatter = staticmethod(scatter)
-    send = staticmethod(send)
-    recv = staticmethod(recv)
+# stream-variant collectives live in their own module (reference:
+# python/paddle/distributed/communication/stream/); imported last so the
+# submodule can reuse the plain collectives above
+from . import stream  # noqa: E402,F401

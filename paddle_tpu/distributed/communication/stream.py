@@ -1,0 +1,110 @@
+"""`paddle.distributed.communication.stream` — stream-variant collectives.
+
+Reference: python/paddle/distributed/communication/stream/ (10 files:
+all_reduce/all_gather/all_to_all/broadcast/gather/recv/reduce/
+reduce_scatter/scatter/send), each taking `sync_op` + `use_calc_stream`.
+
+TPU semantics: XLA runs one ordered execution stream per device and its
+latency-hiding scheduler overlaps collectives with compute, so the CUDA
+calc-stream/comm-stream distinction has no lowering here — `use_calc_stream=
+True` (the "no extra sync, same stream" fast path) is the only behavior the
+hardware has. The functions keep the reference's contract checks
+(`use_calc_stream` is only legal for sync ops) so portable code behaves
+identically, then dispatch to the plain collectives.
+"""
+
+from __future__ import annotations
+
+from . import (all_gather as _all_gather, all_reduce as _all_reduce,
+               all_to_all as _all_to_all, all_to_all_single as
+               _all_to_all_single, broadcast as _broadcast, gather as _gather,
+               recv as _recv, reduce as _reduce, reduce_scatter as
+               _reduce_scatter, scatter as _scatter, send as _send)
+from .group import ReduceOp
+
+__all__ = ["all_reduce", "all_gather", "all_to_all", "all_to_all_single",
+           "alltoall", "broadcast", "gather", "recv", "reduce",
+           "reduce_scatter", "scatter", "send"]
+
+
+def _check_stream_args(sync_op, use_calc_stream, name):
+    # reference stream/*.py: "use_calc_stream can only be True in sync op
+    # behavior" — an async op on the calc stream is contradictory
+    if use_calc_stream and not sync_op:
+        raise RuntimeError(
+            f"stream.{name}: use_calc_stream is only allowed when "
+            "sync_op is True")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "all_reduce")
+    return _all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "all_gather")
+    return _all_gather(tensor_or_tensor_list, tensor, group=group,
+                       sync_op=sync_op)
+
+
+def all_to_all(out_tensor_or_tensor_list, in_tensor_or_tensor_list,
+               group=None, sync_op=True, use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "all_to_all")
+    return _all_to_all(out_tensor_or_tensor_list, in_tensor_or_tensor_list,
+                       group=group, sync_op=sync_op)
+
+
+alltoall = all_to_all
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
+                      out_split_sizes=None, group=None, sync_op=True,
+                      use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "all_to_all_single")
+    return _all_to_all_single(out_tensor, in_tensor, in_split_sizes,
+                              out_split_sizes, group, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "broadcast")
+    return _broadcast(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True,
+           use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "gather")
+    return _gather(tensor, gather_list=gather_list, dst=dst, group=group,
+                   sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "recv")
+    return _recv(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "reduce")
+    return _reduce(tensor, dst=dst, op=op, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "reduce_scatter")
+    return _reduce_scatter(tensor, tensor_or_tensor_list, op=op, group=group,
+                           sync_op=sync_op)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "scatter")
+    return _scatter(tensor, tensor_or_tensor_list, src=src, group=group,
+                    sync_op=sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    _check_stream_args(sync_op, use_calc_stream, "send")
+    return _send(tensor, dst=dst, group=group, sync_op=sync_op)

@@ -62,6 +62,13 @@ class ElasticManager:
             return ElasticStatus.EXIT
         live = self._listener()
         n = len(live)
+        if not self.hosts and live:
+            # membership source was empty at init (file not written yet):
+            # adopt the first real host list as the baseline instead of
+            # treating its appearance as a scale event
+            self.hosts = list(live)
+            self.np = n
+            return ElasticStatus.HOLD
         if n == self.np:
             return ElasticStatus.HOLD
         if n < self.min_hosts:

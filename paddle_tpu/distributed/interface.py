@@ -7,7 +7,8 @@ from __future__ import annotations
 import os
 import sys
 
-__all__ = ['spawn', 'split', 'parallelize', 'to_static', 'set_mesh']
+__all__ = ['spawn', 'split', 'parallelize', 'to_static', 'set_mesh',
+           'DistModel']
 
 
 def set_mesh(mesh):
@@ -141,12 +142,71 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
     return model
 
 
-def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
-    """Convert a dygraph training setup into a static auto-parallel engine
-    (reference: auto_parallel/api.py to_static → DistModel over Engine)."""
+class DistModel:
+    """Mode-switchable compiled step over the auto-parallel Engine
+    (reference: auto_parallel/api.py DistModel — what
+    `paddle.distributed.to_static` hands back).
+
+    `train()`/`eval()`/`predict()` select the mode; calling the object runs
+    ONE compiled step in that mode: loss for train/eval, outputs for
+    predict. The underlying Engine stays reachable as `._engine` for
+    fit/evaluate/cost/save."""
+
+    def __init__(self, engine, n_labels=1):
+        self._engine = engine
+        self._n_labels = int(n_labels)
+        has_loss = engine._loss is not None
+        has_opt = engine._optimizer is not None
+        self._mode = "train" if (has_loss and has_opt) else \
+            ("eval" if has_loss else "predict")
+
+    def train(self):
+        if self._engine._loss is None or self._engine._optimizer is None:
+            raise RuntimeError(
+                "DistModel.train() needs both loss and optimizer")
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        if self._engine._loss is None:
+            raise RuntimeError("DistModel.eval() needs a loss")
+        self._mode = "eval"
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        return self
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def __call__(self, *args):
+        step = self._engine._step_fn(self._mode)
+        if self._mode == "predict":
+            outs = step(*args)
+            return outs[0] if len(outs) == 1 else list(outs)
+        outs = step(*args, n_lab=self._n_labels)
+        return outs[0]  # the loss; model outputs stay on the Engine step
+
+    def state_dict(self, *a, **kw):
+        return self._engine._model.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._engine._model.set_state_dict(*a, **kw)
+
+    def dist_main_program(self, mode=None):
+        """Reference parity: the 'program' here is the compiled step."""
+        return self._engine._step_fn(mode or self._mode)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              n_labels=1):
+    """Convert a dygraph training setup into a DistModel over the static
+    auto-parallel Engine (reference: auto_parallel/api.py to_static)."""
     from .auto_parallel.engine import Engine
 
     eng = Engine(model=layer, loss=loss, optimizer=optimizer,
                  strategy=strategy)
     eng._dist_loader = loader
-    return eng
+    return DistModel(eng, n_labels=n_labels)

@@ -40,6 +40,12 @@ def _parse(argv):
     p.add_argument("--devices", default=None,
                    help="comma-separated local device ids")
     p.add_argument("--max_restart", type=int, default=0)
+    p.add_argument("--elastic_membership_file", default=None,
+                   help="elastic mode: path whose comma/newline-separated "
+                        "host list is watched; a membership change tears "
+                        "down and relaunches the pod (reference "
+                        "fleet/elastic/manager.py scale events)")
+    p.add_argument("--elastic_poll_interval", type=float, default=0.5)
     p.add_argument("--run_mode", default="collective",
                    choices=["collective", "ps", "rpc"],
                    help="collective (default), parameter-server, or rpc pods")
@@ -164,11 +170,54 @@ def launch(argv=None):
         return subprocess.Popen(cmd, env=env), None
 
     n_procs = len(jobs) if jobs is not None else args.nproc_per_node
+    relaunch_count = 0
     procs = [spawn(i) for i in range(n_procs)]
     restarts = [0] * len(procs)
+
+    elastic = None
+    if args.elastic_membership_file:
+        from ..fleet.elastic import ElasticManager, ElasticStatus
+
+        def file_listener(path=args.elastic_membership_file):
+            try:
+                with open(path) as f:
+                    raw = f.read().replace("\n", ",")
+                return [h for h in raw.split(",") if h.strip()]
+            except OSError:
+                return []
+
+        elastic = ElasticManager(listener=file_listener, min_hosts=1,
+                                 max_hosts=1 << 30, scale=1)
+    last_elastic_poll = time.monotonic()
     rc = 0
     try:
         while True:
+            if elastic is not None and \
+                    time.monotonic() - last_elastic_poll >= \
+                    args.elastic_poll_interval:
+                last_elastic_poll = time.monotonic()
+                if elastic.watch() == ElasticStatus.RESTART:
+                    # scale event: tear the pod down and relaunch every
+                    # worker (reference manager.py:487,510 re-exec path);
+                    # workers see the generation via PADDLE_RESTART_COUNT
+                    relaunch_count += 1
+                    print(f"[launch] elastic membership changed -> "
+                          f"relaunch #{relaunch_count} "
+                          f"({elastic.np} hosts)", file=sys.stderr)
+                    for proc, logf in procs:
+                        if proc.poll() is None:
+                            proc.send_signal(signal.SIGTERM)
+                    for proc, logf in procs:
+                        try:
+                            proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                        if logf:
+                            logf.close()
+                    os.environ["PADDLE_RESTART_COUNT"] = \
+                        str(relaunch_count)
+                    procs = [spawn(i) for i in range(n_procs)]
+                    restarts = [0] * len(procs)
             alive = False
             for i, (proc, logf) in enumerate(procs):
                 ret = proc.poll()
@@ -189,7 +238,7 @@ def launch(argv=None):
                         raise KeyboardInterrupt  # tear the pod down
             if not alive:
                 break
-            time.sleep(0.3)
+            time.sleep(0.1 if elastic is not None else 0.3)
     except KeyboardInterrupt:
         for proc, _ in procs:
             if proc.poll() is None:

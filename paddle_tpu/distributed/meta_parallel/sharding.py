@@ -49,14 +49,34 @@ def shard_spec_for(t, axis="sharding") -> P | None:
     return P(*entries)
 
 
+def _pin_host(arr):
+    """Move an array to pinned host memory (ZeRO-offload: optimizer states
+    live off-device and stream in per step). Raises NotImplementedError on
+    backends without host memory spaces rather than silently ignoring."""
+    import jax
+    try:
+        return jax.device_put(arr,
+                              arr.sharding.with_memory_kind("pinned_host"))
+    except Exception as e:
+        raise NotImplementedError(
+            "offload=True needs a backend with pinned_host memory support "
+            f"(reference group_sharded_stage3.py offload): {e!r}") from e
+
+
 class DygraphShardingOptimizer:
     """Stage 1: optimizer states sharded over the sharding axis
-    (reference dygraph_sharding_optimizer.py:45)."""
+    (reference dygraph_sharding_optimizer.py:45). With `offload=True` the
+    accumulators and fp32 master weights are pinned to host memory after
+    every step (CPU-offload, reference sharding_optimizer_stage2.py
+    offload_* / group_sharded_stage3.py:59): HBM holds them only
+    transiently during the update."""
 
-    def __init__(self, optimizer: Optimizer, hcg=None):
+    def __init__(self, optimizer: Optimizer, hcg=None, offload=False):
         self._inner_opt = optimizer
         self._hcg = hcg or get_hybrid_communicate_group()
+        self._offload = bool(offload)
         orig_add = optimizer._add_accumulator
+        this = self
 
         def sharded_add(name, param, fill_value=0.0, dtype=None):
             acc = orig_add(name, param, fill_value, dtype)
@@ -64,13 +84,46 @@ class DygraphShardingOptimizer:
                 spec = shard_spec_for(acc)
                 if spec is not None:
                     mark_sharding(acc, spec)
+            if this._offload:
+                # marker only — the transfer happens in step()'s post-update
+                # repin (pinning mid-update would mix memory spaces)
+                acc._pin_memory_kind = "pinned_host"
             return acc
         optimizer._add_accumulator = sharded_add
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
 
+    def _state_tensors(self):
+        opt = self._inner_opt
+        tensors = [a for accs in opt._accumulators.values()
+                   for a in accs.values()]
+        return tensors + list(opt._master_weights.values())
+
+    def _move_states(self, kind):
+        import jax
+        for t in self._state_tensors():
+            t._pin_memory_kind = "pinned_host"
+            arr = t._d
+            sh = getattr(arr, "sharding", None)
+            if sh is not None and sh.memory_kind != kind:
+                if kind == "pinned_host":
+                    t._d = _pin_host(arr)
+                else:
+                    t._d = jax.device_put(arr, sh.with_memory_kind(kind))
+
     def step(self):
+        if self._offload:
+            from ...jit.api import in_to_static_trace
+            if not in_to_static_trace():
+                # ZeRO-offload streaming cycle (eager path): states h2d,
+                # update, states d2h. Inside a to_static trace the jit state
+                # transfer in StaticFunction.__call__ honors
+                # _pin_memory_kind instead (jit/api.py).
+                self._move_states("device")
+                self._inner_opt.step()
+                self._move_states("pinned_host")
+                return
         self._inner_opt.step()
 
     def clear_grad(self, set_to_zero=False):
@@ -79,23 +132,33 @@ class DygraphShardingOptimizer:
 
 class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
     """Stage 2 optimizer side (reference sharding_optimizer_stage2.py):
-    states sharded as stage 1; gradient sharding is realized inside the
-    compiled step (reduce-scatter), see module docstring."""
+    states sharded as stage 1 and — because the state shards are the grad
+    consumers — the compiled step reduce-scatters gradients onto the
+    sharding axis while parameters stay replicated (all-gathered after the
+    shard-local update). HLO proof: test_hlo_stage2_reduce_scatter."""
 
     def __init__(self, params=None, optim=None, group=None, offload=False,
                  device="tpu", **kw):
-        super().__init__(optim or params)
+        super().__init__(optim or params, offload=offload)
         self.offload = offload
 
 
 class GroupShardedStage2:
-    """Stage 2 model wrapper (reference group_sharded_stage2.py): grad
-    bucketing/reduction is compiler-inserted; wrapper keeps API parity."""
+    """Stage 2 model wrapper (reference group_sharded_stage2.py): params
+    must remain REPLICATED (only grads+states shard) — enforced here; grad
+    bucketing/reduction is compiler-inserted."""
 
     def __init__(self, layer, sharding_optimizer=None, group=None,
                  sync_buffers=False, buffer_max_size=2 ** 23, **kw):
         self._layer = layer
         self._sharding_optimizer = sharding_optimizer
+        for p in layer.parameters():
+            spec = p._sharding_spec
+            if spec is not None and "sharding" in tuple(spec):
+                raise ValueError(
+                    "stage-2 keeps parameters replicated over the sharding "
+                    f"axis but {p.name} is sharded {spec}; use stage 3 "
+                    "(level='p_g_os') for parameter sharding")
 
     def __call__(self, *a, **kw):
         return self._layer(*a, **kw)
@@ -115,13 +178,21 @@ class GroupShardedStage3:
                  segment_size=2 ** 20, pertrain_sync_models=True, offload=False,
                  **kw):
         self._layer = layer
-        self._optimizer = optimizer
         for p in layer.parameters():
             spec = shard_spec_for(p)
             if spec is not None:
                 mark_sharding(p, spec)
         if optimizer is not None:
-            DygraphShardingOptimizer(optimizer)
+            # keep the wrapper: its step() runs the offload streaming cycle
+            # in eager mode — discarding it would silently drop offload
+            self._optimizer = DygraphShardingOptimizer(optimizer,
+                                                       offload=offload)
+        elif offload:
+            raise NotImplementedError(
+                "offload=True requires passing the optimizer so its states "
+                "can be host-pinned")
+        else:
+            self._optimizer = None
 
     def __call__(self, *a, **kw):
         return self._layer(*a, **kw)
@@ -144,7 +215,7 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     """Facade (reference: python/paddle/distributed/sharding/group_sharded.py)
     level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3)."""
     if level == "os":
-        opt = DygraphShardingOptimizer(optimizer)
+        opt = DygraphShardingOptimizer(optimizer, offload=offload)
         return model, opt, scaler
     if level == "os_g":
         opt = GroupShardedOptimizerStage2(optim=optimizer, offload=offload)
@@ -153,7 +224,9 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     if level == "p_g_os":
         wrapped = GroupShardedStage3(model, optimizer, sync_comm=sync_comm,
                                      segment_size=segment_size, offload=offload)
-        return wrapped, optimizer, scaler
+        # hand back the sharding wrapper (its step() drives offload); it
+        # proxies every other optimizer attribute
+        return wrapped, wrapped._optimizer or optimizer, scaler
     raise ValueError(f"unknown group_sharded level {level!r}")
 
 

@@ -193,9 +193,25 @@ class StaticFunction:
             entry = (jitted, cell, state_list)
             self._cache[key] = entry
         jitted, cell, state_list = entry
-        state_arrays = [t._d for t in state_list]
+        state_arrays = []
+        for t in state_list:
+            a = t._d
+            # host-pinned state (ZeRO-offload) streams to device for the
+            # compiled step — the transfer lives outside the jit boundary so
+            # the program itself stays all-device
+            if getattr(t, "_pin_memory_kind", None) is not None and \
+                    getattr(a, "sharding", None) is not None and \
+                    a.sharding.memory_kind != "device":
+                a = jax.device_put(a, a.sharding.with_memory_kind("device"))
+            state_arrays.append(a)
         new_state, out_flat = jitted(state_arrays, arg_arrays)
         for t, a in zip(state_list, new_state):
+            # honor host-pinned state (ZeRO-offload): the compiled step
+            # computed on device; park the updated state back in host memory
+            kind = getattr(t, "_pin_memory_kind", None)
+            if kind is not None and getattr(a, "sharding", None) is not None \
+                    and a.sharding.memory_kind != kind:
+                a = jax.device_put(a, a.sharding.with_memory_kind(kind))
             t._d = a
             t._node = None
         return jax.tree_util.tree_unflatten(cell["out_tree"], out_flat)
@@ -221,6 +237,21 @@ class StaticFunction:
         ma = compiled.memory_analysis()
         self._mem_analysis_cache[key] = ma
         return ma
+
+    def compiled_text(self, *args, **kwargs):
+        """Compile the step for these args and return the optimized HLO text
+        (collective-inspection hook; the analog of the reference's
+        program-desc dump for verifying pass behavior)."""
+        args_flat, treedef = jax.tree_util.tree_flatten(args)
+        sig = self._sig_of(args_flat)
+        kw_key = tuple(sorted(kwargs.items(), key=lambda kv: kv[0]))
+        key = (treedef, sig, kw_key)
+        if key not in self._state_by_key:
+            self(*args, **kwargs)
+        state_list = self._state_by_key[key]
+        jitted, _ = self._compile(treedef, sig, dict(kwargs), state_list)
+        state_arrays = [t._d for t in state_list]
+        return jitted.lower(state_arrays, list(args_flat)).compile().as_text()
 
     # -- parity surface -----------------------------------------------------
     def concrete_program(self):

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import contextlib
 
+import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
@@ -116,5 +117,56 @@ def remove_weight_norm(layer, name="weight"):
     return layer
 
 
-def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=0):
-    raise NotImplementedError("spectral_norm: planned (see SURVEY.md §2.2)")
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Reparametrize ``name`` as W / sigma_max(W), sigma estimated by power
+    iteration with persistent u/v vectors refreshed every forward
+    (reference: python/paddle/nn/utils/spectral_norm_hook.py). The u/v
+    estimates are constants w.r.t. autograd (stop-gradient, as in the
+    reference); sigma itself stays in the graph so d(W/sigma)/dW is exact
+    for the current estimate."""
+    import numpy as np
+    from ...core.tensor import Parameter
+    from ...autograd.function import apply
+
+    w = getattr(layer, name)
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+
+    def as_mat(arr):
+        return jnp.transpose(arr, perm).reshape(arr.shape[dim], -1)
+
+    h, cols = as_mat(w._data).shape
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(h).astype(np.float32)
+    layer._sn_u = jnp.asarray(u0 / max(np.linalg.norm(u0), eps))
+    layer.add_parameter(name + "_orig", Parameter(w._data))
+    del layer._parameters[name]
+
+    def _normalize(x):
+        return x / jnp.maximum(jnp.linalg.norm(x), eps)
+
+    def hook(l, inputs):
+        from ...jit.api import in_to_static_trace
+        w_orig = l._parameters[name + "_orig"]
+        wm = as_mat(w_orig._data)
+        u = l._sn_u
+        for _ in range(max(n_power_iterations, 1)):
+            v = _normalize(wm.T @ u)
+            u = _normalize(wm @ v)
+        if not in_to_static_trace():
+            # persist the refreshed estimate only when it is a concrete
+            # array — storing a trace-time tracer on the layer would poison
+            # later eager forwards (UnexpectedTracerError)
+            l._sn_u = jax.lax.stop_gradient(u)
+        uc, vc = jax.lax.stop_gradient(u), jax.lax.stop_gradient(v)
+
+        def f(ww):
+            sigma = uc @ (as_mat(ww) @ vc)
+            return ww / jnp.maximum(sigma, eps)
+        wt = apply(f, w_orig, name="spectral_norm")
+        l.__dict__[name] = wt
+        return None
+
+    layer._sn_hook = layer.register_forward_pre_hook(hook)
+    layer._sn_name = name
+    return layer

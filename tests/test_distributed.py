@@ -622,7 +622,7 @@ def test_pipeline_interleaved_virtual_stages():
     assert losses[-1] < losses[0]
 
 
-def _pipeline_temp_bytes(M, recompute, batch=32, h=64):
+def _pipeline_temp_bytes(M, recompute, batch=32, h=64, v=1):
     """Compiled temp memory of a full pipelined fwd+bwd at accumulate=M."""
     import jax
     _reset_mesh()
@@ -645,7 +645,8 @@ def _pipeline_temp_bytes(M, recompute, batch=32, h=64):
             return x + self.fc2(paddle.nn.functional.gelu(self.fc1(x)))
 
     pl = PipelineLayer(layers=[LayerDesc(Blk, h) for _ in range(8)],
-                       num_stages=4, loss_fn=nn.MSELoss())
+                       num_stages=4, loss_fn=nn.MSELoss(),
+                       num_virtual_pipeline_stages=v)
     model = fleet.distributed_model(pl)
     x = paddle.randn([batch, h])
     y = paddle.zeros([batch, h])
@@ -1123,3 +1124,212 @@ def test_pp_sep_dp_combined_attention_pipeline():
         (loss0, ref_loss)
     loss1 = float(model.train_batch([x, y], opt))
     assert np.isfinite(loss1) and loss1 < loss0
+
+
+def test_ernie_moe_pipeline_4d_parity():
+    """MoE ERNIE under dp2 x mp2 x pp2 (VERDICT r2 item 4): the MoE tail is
+    the pipelined homogeneous run (expert axis orthogonal to pp), leading
+    dense blocks run as head layers, and the router aux loss accumulated by
+    the compiled schedule matches sequential execution."""
+    import copy
+    paddle.seed(53)
+    hcg, strategy = _init_fleet(dp=2, mp=2, pp=2)
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    from paddle_tpu.models.ernie import ErnieConfig, ernie_for_pipeline
+    cfg = ErnieConfig(vocab_size=128, max_position_embeddings=16,
+                      hidden_size=32, num_layers=5, num_heads=4,
+                      num_kv_heads=2, intermediate_size=64,
+                      num_experts=4, num_experts_per_tok=2,
+                      moe_intermediate_size=32,
+                      shared_expert_intermediate_size=32, first_k_dense=1,
+                      router_aux_loss_coef=0.01)
+    pl = ernie_for_pipeline(cfg, seq_len=12, num_stages=2)
+    dense = copy.deepcopy(pl)
+    model = fleet.distributed_model(pl)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+    ids = np.random.randint(0, 128, (4, 13))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int64))
+
+    # sequential reference WITH the router aux term the pipeline adds
+    ref = float(dense._loss_fn(dense(x), y))
+    aux_ref = 0.0
+    for layer in dense.run_function:
+        get = getattr(layer, "pipe_aux", None)
+        if get is not None and get() is not None:
+            aux_ref += float(get())
+    assert aux_ref > 0.0  # the MoE tail actually routed
+    ref += cfg.router_aux_loss_coef * aux_ref
+
+    l0 = float(model.train_batch([x, y], opt))
+    assert model.l_aux is not None
+    # aux is computed per micro-batch (routing statistics are nonlinear in
+    # the batch, like the reference's per-micro gate), so micro-averaged aux
+    # only approximates the full-batch value
+    np.testing.assert_allclose(float(model.l_aux), aux_ref, rtol=5e-2)
+    np.testing.assert_allclose(l0, ref, rtol=2e-3)
+    l1 = float(model.train_batch([x, y], opt))
+    assert np.isfinite(l1)
+
+
+def test_hlo_stage2_reduce_scatter_params_replicated():
+    """Stage-2 contract (VERDICT r2 item 6): parameters stay REPLICATED over
+    the sharding axis while gradients reduce onto the sharded optimizer
+    states, and the updated param shards all-gather back — proven on the
+    compiled train step's HLO (XLA CPU may lower reduce-scatter as
+    all-reduce+slice, as in the ZeRO-3 proof)."""
+    import re
+    paddle.seed(11)
+    hcg, strategy = _init_fleet(sharding=8)
+    strategy.sharding_configs = {"stage": 2}
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    from paddle_tpu.distributed.sharding_utils import mark_sharding
+    from jax.sharding import PartitionSpec as P
+    model = nn.Linear(64, 64)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    wrapped, opt, _ = group_sharded_parallel(model, opt, level="os_g")
+
+    # params replicated (stage-2, not stage-3)
+    for p in model.parameters():
+        assert p._sharding_spec is None or \
+            "sharding" not in tuple(p._sharding_spec)
+
+    x = paddle.randn([16, 64])
+    x = mark_sharding(x, P("sharding"))
+
+    @paddle.jit.to_static
+    def step(xb):
+        loss = (wrapped(xb) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    l0 = float(step(x))
+    assert np.isfinite(l0)
+    # optimizer states sharded over the axis
+    accs = [a for d in opt._accumulators.values() for a in d.values()]
+    assert any(a._sharding_spec and "sharding" in tuple(a._sharding_spec)
+               for a in accs), [a._sharding_spec for a in accs]
+
+    txt = step.compiled_text(x)
+    ops = set(re.findall(
+        r"(all-reduce|reduce-scatter|all-gather|dynamic-slice)", txt))
+    assert "all-gather" in ops, ops  # shard-updated params regather
+    assert "reduce-scatter" in ops or \
+        ({"all-reduce", "dynamic-slice"} <= ops), ops
+
+
+def test_sharding_offload_pins_states_to_host():
+    """offload=True parks optimizer states in pinned host memory after each
+    step — eager AND under to_static — with loss parity vs offload=False
+    (reference group_sharded_stage3.py offload semantics)."""
+    paddle.seed(13)
+    hcg, strategy = _init_fleet(sharding=8)
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    x = paddle.randn([8, 32])
+
+    def run(offload, use_jit):
+        paddle.seed(13)
+        net = nn.Linear(32, 32)
+        opt = paddle.optimizer.AdamW(
+            1e-3, parameters=net.parameters())
+        wrapped, opt, _ = group_sharded_parallel(net, opt, level="os_g",
+                                                 offload=offload)
+
+        def raw(xb):
+            loss = (wrapped(xb) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        stepper = paddle.jit.to_static(raw) if use_jit else raw
+        for _ in range(2):
+            loss = stepper(x)
+        accs = [a for d in opt._accumulators.values() for a in d.values()]
+        kinds = {a._data.sharding.memory_kind for a in accs}
+        return float(loss), kinds
+
+    l_eager, kinds_eager = run(True, use_jit=False)
+    assert kinds_eager == {"pinned_host"}, kinds_eager
+    l_jit, kinds_jit = run(True, use_jit=True)
+    assert kinds_jit == {"pinned_host"}, kinds_jit
+    l_ref, kinds_ref = run(False, use_jit=False)
+    assert "pinned_host" not in kinds_ref
+    np.testing.assert_allclose(l_eager, l_ref, rtol=1e-6)
+    np.testing.assert_allclose(l_jit, l_ref, rtol=1e-5)
+
+
+def test_stage2_rejects_sharded_params():
+    """Wrapping a stage-3-sharded model in the stage-2 wrapper must raise:
+    stage 2's contract is replicated params."""
+    paddle.seed(17)
+    hcg, strategy = _init_fleet(sharding=8)
+    from paddle_tpu.distributed.meta_parallel.sharding import (
+        GroupShardedStage2, GroupShardedStage3)
+    model = nn.Linear(64, 64)
+    GroupShardedStage3(model)  # shards params over the axis
+    with pytest.raises(ValueError):
+        GroupShardedStage2(model)
+
+
+def test_pipeline_schedule_report_pp4_v2():
+    """Schedule accounting (VERDICT r2 item 5): bubble fraction of the
+    compiled ring at pp=4, v=2, M=8 matches the formula, and the v=2
+    interleaved stack holds the same remat memory bound as v=1 (the
+    measured 1F1B-equivalence claim)."""
+    from paddle_tpu.distributed.meta_parallel.pipeline_parallel import \
+        schedule_report
+
+    r = schedule_report(4, 2, 8)
+    assert r["ticks"] == 2 * (8 + 3)
+    assert r["useful_ticks"] == 16
+    np.testing.assert_allclose(r["bubble_fraction"], 6 / 22, atol=1e-4)
+    np.testing.assert_allclose(r["gpipe_bubble_fraction"], 3 / 11,
+                               atol=1e-4)
+    np.testing.assert_allclose(r["interleaved_1f1b_bubble_fraction"],
+                               3 / 19, atol=1e-4)
+
+    m_v1 = _pipeline_temp_bytes(4, recompute=True, v=1)
+    m_v2 = _pipeline_temp_bytes(4, recompute=True, v=2)
+    # interleaving must not blow the remat memory bound
+    assert m_v2 <= 1.3 * m_v1, (m_v2, m_v1)
+
+
+def test_stage3_eager_offload_pins_states():
+    """Stage-3 (p_g_os) offload must act in EAGER mode too: the facade
+    returns the sharding wrapper whose step() runs the h2d/d2h streaming
+    cycle (code-review r3 finding: the wrapper was created then dropped)."""
+    paddle.seed(19)
+    hcg, strategy = _init_fleet(sharding=8)
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    net = nn.Linear(32, 32)
+    opt0 = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+    wrapped, opt, _ = group_sharded_parallel(net, opt0, level="p_g_os",
+                                             offload=True)
+    x = paddle.randn([8, 32])
+    for _ in range(2):
+        loss = (wrapped(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    accs = [a for d in opt0._accumulators.values() for a in d.values()]
+    assert accs and {a._data.sharding.memory_kind for a in accs} == \
+        {"pinned_host"}
+
+
+def test_elastic_empty_baseline_adopts_first_hosts():
+    """A membership file that appears AFTER startup must become the
+    baseline, not a spurious scale event (code-review r3 finding)."""
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    hosts = []
+    mgr = ElasticManager(listener=lambda: list(hosts), min_hosts=1,
+                         max_hosts=100, scale=1)
+    assert mgr.watch() == ElasticStatus.HOLD  # still empty
+    hosts.extend(["a", "b"])
+    assert mgr.watch() == ElasticStatus.HOLD  # adopt, no relaunch
+    assert mgr.np == 2
+    hosts.append("c")
+    assert mgr.watch() == ElasticStatus.RESTART  # real scale event

@@ -130,3 +130,100 @@ def test_launch_rpc_mode(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
     assert rc.returncode == 0, (rc.stdout[-1000:], rc.stderr[-1000:])
     assert rc.stdout.count("RPC_OK") == 2
+
+
+def test_launch_elastic_relaunch_on_membership_change(tmp_path):
+    """Elastic end-to-end (VERDICT r2 item 10): the launcher watches a
+    membership file and, on a scale event, tears down and relaunches the
+    whole pod — workers observe the new generation via
+    PADDLE_RESTART_COUNT (reference fleet/elastic/manager.py:487,510)."""
+    import textwrap
+    import time
+
+    member = tmp_path / "hosts.txt"
+    member.write_text("host-a,host-b\n")
+    marker = tmp_path / "gen.log"
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, time
+        gen = os.environ.get("PADDLE_RESTART_COUNT", "0")
+        with open(%r, "a") as f:
+            f.write("gen=%%s rank=%%s\\n"
+                    %% (gen, os.environ.get("PADDLE_TRAINER_ID")))
+        if gen == "0":
+            time.sleep(120)   # first generation runs until relaunched
+    """ % str(marker)))
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2",
+         "--elastic_membership_file", str(member),
+         "--elastic_poll_interval", "0.2", str(script)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and (
+                not marker.exists()
+                or marker.read_text().count("gen=0") < 2):
+            time.sleep(0.2)
+        member.write_text("host-a,host-b,host-c\n")  # scale event
+        out, err = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    text = marker.read_text()
+    assert proc.returncode == 0, (out, err, text)
+    assert "relaunch #1" in err, err
+    assert text.count("gen=0") == 2, text   # original generation
+    assert text.count("gen=1") == 2, text   # relaunched generation
+
+
+def test_auto_tuner_measured_mode():
+    """The tuner's measured mode times real jitted steps per candidate and
+    picks the empirically fastest (VERDICT r2 item 10; reference
+    auto_tuner/tuner.py:19 launches trials and collects metrics)."""
+    import numpy as np
+    sys.path.insert(0, REPO)
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.auto_tuner import (AutoTuner, Candidate,
+                                       measure_compiled_step)
+
+    def build(cand):
+        paddle.seed(0)
+        # real compiled work scaled by the candidate's micro_batch: more
+        # micro-batches -> more sequential matmul work per step
+        net = nn.Linear(64, 64)
+        opt = paddle.optimizer.SGD(1e-3, parameters=net.parameters())
+        reps = cand.micro_batch
+
+        @paddle.jit.to_static
+        def step(x):
+            h = x
+            for _ in range(reps * 4):
+                h = net(h)
+            loss = (h ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal(
+                (256, 64)).astype(np.float32))
+        return step, (x,)
+
+    cands = [Candidate(dp=8, micro_batch=8), Candidate(dp=8, micro_batch=1)]
+    tuner = AutoTuner(measure_compiled_step(build, steps=3, warmup=1),
+                      cands)
+    best = tuner.search()
+    assert best is not None and best.micro_batch == 1, tuner.summary()
+    times = {c.micro_batch: r["time_s"] for c, r in tuner.history
+             if "time_s" in r}
+    assert times[1] < times[8], times

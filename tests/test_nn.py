@@ -244,3 +244,53 @@ def test_forward_hooks():
     h2.remove()
     lin(paddle.randn([1, 2]))
     assert calls == ["pre", "post"]
+
+
+def test_spectral_norm_constrains_top_singular_value():
+    """spectral_norm (reference nn/utils/spectral_norm_hook.py): after the
+    power iteration warms up, the effective weight's top singular value is
+    ~1, and grads flow to the orig parameter."""
+    paddle.seed(0)
+    from paddle_tpu.nn.utils import spectral_norm
+    lin = nn.Linear(12, 8)
+    spectral_norm(lin, n_power_iterations=2)
+    x = paddle.randn([4, 12])
+    for _ in range(10):  # converge the u/v estimates
+        out = lin(x)
+    w_eff = lin.weight.numpy()
+    s = np.linalg.svd(w_eff, compute_uv=False)
+    np.testing.assert_allclose(s.max(), 1.0, rtol=5e-2)
+
+    lin.weight_orig.stop_gradient = False
+    out = lin(x)
+    out.sum().backward()
+    assert lin.weight_orig.grad is not None
+    assert not np.allclose(lin.weight_orig.grad.numpy(), 0)
+
+
+def test_subsumed_passes_warn():
+    import warnings
+    from paddle_tpu.distributed.passes import new_pass
+    p = new_pass("comm_overlap")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out = p.apply("target")
+    assert out == "target"
+    assert any("subsumed" in str(w.message) for w in rec)
+
+
+def test_spectral_norm_under_to_static_no_tracer_leak():
+    """Tracing a spectral_norm'd layer must not leak a tracer into the
+    persistent power-iteration state (code-review r3 finding)."""
+    paddle.seed(2)
+    from paddle_tpu.nn.utils import spectral_norm
+    lin = nn.Linear(6, 6)
+    spectral_norm(lin)
+    step = paddle.jit.to_static(lambda t: lin(t).sum())
+    x = paddle.randn([2, 6])
+    float(step(x))
+    float(step(x))          # cached program
+    out = lin(x)            # eager forward after tracing must not crash
+    assert np.isfinite(float(out.sum()))
+    import jax
+    assert not isinstance(lin._sn_u, jax.core.Tracer)
