@@ -113,7 +113,8 @@ def __getattr__(name):
     if name in ("distribution", "text", "quantization", "static",
                 "auto_tuner", "audio", "sparse", "fft", "signal",
                 "sysconfig", "hub", "dataset", "geometric", "inference",
-                "onnx", "decomposition", "cost_model", "reader", "version"):
+                "onnx", "decomposition", "cost_model", "reader", "version",
+                "strings"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

@@ -19,7 +19,12 @@ from ..core.tensor import Tensor, as_tensor
 
 __all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
            "to_dense", "add", "multiply", "matmul", "relu", "coalesce",
-           "is_sparse"]
+           "is_sparse", "abs", "sin", "tan", "asin", "atan", "sinh", "tanh",
+           "asinh", "atanh", "acos", "acosh", "sqrt", "square", "log1p",
+           "expm1", "neg", "relu6", "leaky_relu", "isnan", "pow", "scale",
+           "cast", "subtract", "divide", "divide_scalar", "sum", "reshape",
+           "transpose", "slice", "full_like", "addmm", "mv", "masked_matmul",
+           "softmax", "to_sparse_coo", "to_sparse_csr"]
 
 
 class SparseCooTensor:
@@ -123,3 +128,196 @@ def relu(x):
     b = x._b
     return SparseCooTensor(jsparse.BCOO((jnp.maximum(b.data, 0), b.indices),
                                         shape=b.shape))
+
+
+# -- unary value-wise ops (reference sparse_ops.yaml: applied to the stored
+# values; the implicit zeros keep their sparsity) ---------------------------
+
+def _unary(jfn, name):
+    def op(x, *a, **kw):
+        if not is_sparse(x):
+            raise TypeError(f"sparse.{name} expects a SparseCooTensor")
+        b = x._b
+        return SparseCooTensor(
+            jsparse.BCOO((jfn(b.data, *a, **kw), b.indices), shape=b.shape))
+    op.__name__ = name
+    return op
+
+
+abs = _unary(jnp.abs, "abs")
+sin = _unary(jnp.sin, "sin")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+atanh = _unary(jnp.arctanh, "atanh")
+acos = _unary(jnp.arccos, "acos")
+acosh = _unary(jnp.arccosh, "acosh")
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+log1p = _unary(jnp.log1p, "log1p")
+expm1 = _unary(jnp.expm1, "expm1")
+neg = _unary(jnp.negative, "neg")
+relu6 = _unary(lambda v: jnp.clip(v, 0, 6), "relu6")
+isnan = _unary(jnp.isnan, "isnan")
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary(lambda v: jnp.where(v >= 0, v, negative_slope * v),
+                  "leaky_relu")(x)
+
+
+def pow(x, factor):
+    return _unary(lambda v: jnp.power(v, factor), "pow")(x)
+
+
+def scale(x, scale, bias=0.0, bias_after_scale=True):
+    """Reference sparse scale: bias applies to stored values only."""
+    def f(v):
+        return v * scale + bias if bias_after_scale else (v + bias) * scale
+    return _unary(f, "scale")(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    if not is_sparse(x):
+        raise TypeError("sparse.cast expects a SparseCooTensor")
+    b = x._b
+    from ..core.dtype import dtype_from_any
+    idx = b.indices if index_dtype is None else \
+        b.indices.astype(dtype_from_any(index_dtype).np_dtype)
+    val = b.data if value_dtype is None else \
+        b.data.astype(dtype_from_any(value_dtype).np_dtype)
+    return SparseCooTensor(jsparse.BCOO((val, idx), shape=b.shape))
+
+
+def coalesce(x):
+    return x.coalesce()
+
+
+# -- binaries / reductions / manipulation ------------------------------------
+
+def subtract(a, b):
+    return _binary(a, b, jnp.subtract)
+
+
+def divide(a, b):
+    return _binary(a, b, jnp.true_divide)
+
+
+def divide_scalar(x, scalar):
+    return _unary(lambda v: v / scalar, "divide_scalar")(x)
+
+
+def sum(x, axis=None, keepdim=False, dtype=None):
+    """Reduce over the dense view (XLA has no sparse layouts; the honest
+    lowering is gather-free dense reduction). Returns a dense Tensor."""
+    if not is_sparse(x):
+        raise TypeError("sparse.sum expects a SparseCooTensor")
+    out = jnp.sum(x._b.todense(), axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import dtype_from_any
+        out = out.astype(dtype_from_any(dtype).np_dtype)
+    return Tensor(out, stop_gradient=True)
+
+
+def reshape(x, shape):
+    if not is_sparse(x):
+        raise TypeError("sparse.reshape expects a SparseCooTensor")
+    return SparseCooTensor(jsparse.BCOO.fromdense(
+        jnp.reshape(x._b.todense(), tuple(shape))))
+
+
+def transpose(x, perm):
+    if not is_sparse(x):
+        raise TypeError("sparse.transpose expects a SparseCooTensor")
+    return SparseCooTensor(jsparse.BCOO.fromdense(
+        jnp.transpose(x._b.todense(), tuple(perm))))
+
+
+_pyslice = slice  # captured before the sparse `slice` op shadows it
+
+
+def slice(x, axes, starts, ends):
+    if not is_sparse(x):
+        raise TypeError("sparse.slice expects a SparseCooTensor")
+    dense = x._b.todense()
+    idx = [_pyslice(None)] * dense.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[int(ax)] = _pyslice(int(s), int(e))
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense[tuple(idx)]))
+
+
+def full_like(x, fill_value, dtype=None):
+    if not is_sparse(x):
+        raise TypeError("sparse.full_like expects a SparseCooTensor")
+    b = x._b
+    val = jnp.full_like(b.data, fill_value)
+    if dtype is not None:
+        from ..core.dtype import dtype_from_any
+        val = val.astype(dtype_from_any(dtype).np_dtype)
+    return SparseCooTensor(jsparse.BCOO((val, b.indices), shape=b.shape))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta * input + alpha * (sparse x @ dense y) -> dense Tensor."""
+    prod = matmul(x, y)
+    from ..autograd.function import apply
+    return apply(lambda i, p: beta * i + alpha * p, as_tensor(input), prod,
+                 name="sparse_addmm")
+
+
+def mv(x, vec):
+    """sparse [M, N] @ dense [N] -> dense [M]."""
+    return matmul(x, vec)
+
+
+def masked_matmul(x, y, mask):
+    """(dense x @ dense y) sampled at `mask`'s sparsity pattern (reference
+    sparse masked_matmul — the SDDMM primitive)."""
+    if not is_sparse(mask):
+        raise TypeError("mask must be a SparseCooTensor")
+    xa, ya = as_tensor(x)._data, as_tensor(y)._data
+    b = mask._b
+    rows, cols = b.indices[:, 0], b.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xa[rows, :], jnp.swapaxes(ya, 0, 1)[cols, :])
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+def softmax(x, axis=-1):
+    """Row softmax over stored values only (implicit zeros act as -inf,
+    reference sparse softmax semantics); 2-D COO."""
+    if not is_sparse(x):
+        raise TypeError("sparse.softmax expects a SparseCooTensor")
+    b = x._b.sum_duplicates()
+    if len(b.shape) != 2 or axis not in (-1, 1):
+        raise NotImplementedError("sparse.softmax: 2-D, last axis only")
+    rows = b.indices[:, 0]
+    n_rows = b.shape[0]
+    vals = b.data.astype(jnp.float32)
+    row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
+    e = jnp.exp(vals - jnp.take(row_max, rows))
+    denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+    out = e / jnp.take(jnp.maximum(denom, 1e-30), rows)
+    return SparseCooTensor(jsparse.BCOO((out.astype(b.data.dtype),
+                                         b.indices), shape=b.shape))
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense Tensor -> SparseCooTensor (reference Tensor.to_sparse_coo)."""
+    return SparseCooTensor(jsparse.BCOO.fromdense(as_tensor(x)._data))
+
+
+def to_sparse_csr(x):
+    """CSR view: returned as the COO wrapper (BCOO is the jax layout); the
+    CSR accessors live on the result's crows()/cols()."""
+    coo = to_sparse_coo(x)
+    b = coo._b.sum_duplicates()
+    rows = np.asarray(b.indices[:, 0])
+    crows = np.zeros(b.shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    coo.crows = lambda: Tensor(jnp.asarray(crows), stop_gradient=True)
+    coo.cols = lambda: Tensor(b.indices[:, 1], stop_gradient=True)
+    return coo

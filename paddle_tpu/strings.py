@@ -1,0 +1,93 @@
+"""`paddle.strings` — string-tensor ops.
+
+Reference: paddle/phi/api/yaml/strings_ops.yaml (empty, empty_like, lower,
+upper over pstring tensors, backing the FasterTokenizer pipeline). Strings
+never touch the TPU — XLA has no string type, and the reference's kernels
+are CPU-only too — so the TPU-native design is a host-side numpy object
+array wrapper whose ops run in the input pipeline, next to the DataLoader.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "empty_like", "lower", "upper"]
+
+
+class StringTensor:
+    """Host-side string tensor (reference: phi::StringTensor of pstring)."""
+
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __eq__(self, other):
+        other_arr = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data == other_arr)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def _as_string_tensor(x) -> StringTensor:
+    return x if isinstance(x, StringTensor) else StringTensor(x)
+
+
+def empty(shape, name=None) -> StringTensor:
+    """Uninitialized (empty-string) tensor, strings_ops.yaml `empty`."""
+    arr = np.empty(tuple(shape), dtype=object)
+    arr.fill("")
+    return StringTensor(arr, name=name)
+
+
+def empty_like(x, name=None) -> StringTensor:
+    return empty(_as_string_tensor(x).shape, name=name)
+
+
+def _elementwise(x, fn):
+    x = _as_string_tensor(x)
+    out = np.empty(x._data.shape, dtype=object)
+    flat_in = x._data.reshape(-1)
+    flat_out = out.reshape(-1)
+    for i in range(flat_in.size):
+        flat_out[i] = fn(flat_in[i])
+    return StringTensor(out)
+
+
+def lower(x, use_utf8_encoding=False, name=None) -> StringTensor:
+    """strings_ops.yaml `lower`: ASCII fold by default; utf8 flag enables
+    full unicode case folding (the reference's two kernel variants)."""
+    if use_utf8_encoding:
+        return _elementwise(x, str.lower)
+    return _elementwise(
+        x, lambda s: s.translate(_ASCII_LOWER))
+
+
+def upper(x, use_utf8_encoding=False, name=None) -> StringTensor:
+    if use_utf8_encoding:
+        return _elementwise(x, str.upper)
+    return _elementwise(
+        x, lambda s: s.translate(_ASCII_UPPER))
+
+
+_ASCII_LOWER = {c: c + 32 for c in range(ord("A"), ord("Z") + 1)}
+_ASCII_UPPER = {c: c - 32 for c in range(ord("a"), ord("z") + 1)}

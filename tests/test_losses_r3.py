@@ -1,0 +1,275 @@
+"""Round-3 loss-surface depth (VERDICT r2 missing #5): the nine losses the
+reference has that round 2 lacked, each checked against an independent
+reference (torch CPU where it implements the op, hand-rolled numpy DP
+otherwise)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def test_gaussian_nll_matches_torch():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((6, 4)).astype(np.float32)
+    y = rng.standard_normal((6, 4)).astype(np.float32)
+    v = np.abs(rng.standard_normal((6, 4))).astype(np.float32) + 0.1
+    for reduction in ("mean", "sum", "none"):
+        for full in (False, True):
+            got = F.gaussian_nll_loss(paddle.to_tensor(x),
+                                      paddle.to_tensor(y),
+                                      paddle.to_tensor(v), full=full,
+                                      reduction=reduction)
+            want = torch.nn.functional.gaussian_nll_loss(
+                torch.tensor(x), torch.tensor(y), torch.tensor(v),
+                full=full, eps=1e-6, reduction=reduction)
+            np.testing.assert_allclose(got.numpy(), want.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_multi_margin_matches_torch_unweighted():
+    """Unweighted multi-margin agrees with torch for p in {1,2}; the
+    weighted case follows the reference's exact formula instead (weight
+    inside the power, j==label corrected by weight*margin^p/C — see
+    reference loss.py:3960), which only coincides with torch at p=1."""
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((5, 7)).astype(np.float32)
+    y = rng.integers(0, 7, 5).astype(np.int64)
+    w = np.abs(rng.standard_normal(7)).astype(np.float32)
+    for p in (1, 2):
+        for reduction in ("mean", "sum", "none"):
+            got = F.multi_margin_loss(paddle.to_tensor(x),
+                                      paddle.to_tensor(y), p=p, margin=0.8,
+                                      reduction=reduction)
+            want = torch.nn.functional.multi_margin_loss(
+                torch.tensor(x), torch.tensor(y), p=p, margin=0.8,
+                reduction=reduction)
+            np.testing.assert_allclose(got.numpy(), want.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+    # weighted p=1 (where paddle and torch formulas coincide)
+    got = F.multi_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                              p=1, margin=0.8, weight=paddle.to_tensor(w))
+    want = torch.nn.functional.multi_margin_loss(
+        torch.tensor(x), torch.tensor(y), p=1, margin=0.8,
+        weight=torch.tensor(w))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    # weighted p=2: reference formula transcribed in numpy
+    got2 = F.multi_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                               p=2, margin=0.8,
+                               weight=paddle.to_tensor(w),
+                               reduction="none").numpy()
+    tgt = x[np.arange(5), y][:, None]
+    wl = w[y][:, None]
+    want2 = ((wl * np.maximum(0.8 - tgt + x, 0)) ** 2).mean(1, keepdims=True) \
+        - wl * (0.8 ** 2 / 7)
+    np.testing.assert_allclose(got2, want2.reshape(-1), rtol=1e-5)
+
+
+def test_triplet_margin_with_distance_matches_torch():
+    rng = np.random.default_rng(2)
+    a, p, n = (rng.standard_normal((6, 8)).astype(np.float32)
+               for _ in range(3))
+    for swap in (False, True):
+        got = F.triplet_margin_with_distance_loss(
+            paddle.to_tensor(a), paddle.to_tensor(p), paddle.to_tensor(n),
+            margin=0.7, swap=swap)
+        want = torch.nn.functional.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n), margin=0.7,
+            swap=swap)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+    # custom distance callable
+    got = F.triplet_margin_with_distance_loss(
+        paddle.to_tensor(a), paddle.to_tensor(p), paddle.to_tensor(n),
+        distance_function=lambda u, v: ((u - v) ** 2).sum(-1), margin=0.5)
+    want = torch.nn.functional.triplet_margin_with_distance_loss(
+        torch.tensor(a), torch.tensor(p), torch.tensor(n),
+        distance_function=lambda u, v: ((u - v) ** 2).sum(-1), margin=0.5)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_margin_cross_entropy_degenerates_to_scaled_ce():
+    rng = np.random.default_rng(3)
+    # cosine-range logits as the op expects
+    x = np.tanh(rng.standard_normal((6, 10))).astype(np.float32)
+    y = rng.integers(0, 10, 6).astype(np.int64)
+    got = F.margin_cross_entropy(paddle.to_tensor(x), paddle.to_tensor(y),
+                                 margin1=1.0, margin2=0.0, margin3=0.0,
+                                 scale=16.0)
+    want = torch.nn.functional.cross_entropy(torch.tensor(x * 16.0),
+                                             torch.tensor(y))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    loss, sm = F.margin_cross_entropy(paddle.to_tensor(x),
+                                      paddle.to_tensor(y), scale=16.0,
+                                      return_softmax=True)
+    assert sm.shape == [6, 10]
+    np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(6), rtol=1e-5)
+    # the margin raises the loss vs the plain-CE degenerate case
+    assert float(loss) > float(got)
+
+    # grads flow to the logits
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    F.margin_cross_entropy(xt, paddle.to_tensor(y)).backward()
+    assert xt.grad is not None and np.isfinite(xt.grad.numpy()).all()
+
+
+def test_npair_loss_formula():
+    rng = np.random.default_rng(4)
+    a = rng.standard_normal((6, 5)).astype(np.float32)
+    p = rng.standard_normal((6, 5)).astype(np.float32)
+    y = np.array([0, 0, 1, 1, 2, 2], np.int64)
+    got = float(F.npair_loss(paddle.to_tensor(a), paddle.to_tensor(p),
+                             paddle.to_tensor(y), l2_reg=0.01))
+    # independent numpy reference
+    soft = (y[:, None] == y[None, :]).astype(np.float32)
+    soft /= soft.sum(1, keepdims=True)
+    l2 = ((a ** 2).sum(1).mean() + (p ** 2).sum(1).mean()) * 0.25 * 0.01
+    sim = a @ p.T
+    logp = sim - sim.max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    ce_rows = -(soft * logp).sum(-1)
+    want = l2 + (soft * ce_rows[:, None]).sum(0).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_hsigmoid_loss_custom_path_and_default_tree():
+    rng = np.random.default_rng(5)
+    n, feat, classes = 4, 6, 8
+    x = rng.standard_normal((n, feat)).astype(np.float32)
+    y = rng.integers(0, classes, n).astype(np.int64)
+    w = rng.standard_normal((classes - 1, feat)).astype(np.float32)
+
+    out = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                          classes, paddle.to_tensor(w))
+    assert out.shape == [n, 1]
+    assert np.isfinite(out.numpy()).all() and (out.numpy() > 0).all()
+
+    # custom 2-step path: verify against hand-rolled BCE-with-logits
+    table = np.tile(np.array([[0, 1]], np.int64), (classes, 1))
+    code = np.tile(np.array([[1.0, 0.0]], np.float32), (classes, 1))
+    got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(y), classes,
+                          paddle.to_tensor(w),
+                          path_table=paddle.to_tensor(table),
+                          path_code=paddle.to_tensor(code)).numpy()
+    logit = x @ w[:2].T                       # [n, 2]
+    bits = np.array([1.0, 0.0], np.float32)
+    per = np.maximum(logit, 0) - logit * bits + np.log1p(
+        np.exp(-np.abs(logit)))
+    np.testing.assert_allclose(got, per.sum(1, keepdims=True), rtol=1e-5)
+
+    # grads reach the tree weights
+    wt = paddle.to_tensor(w, stop_gradient=False)
+    F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(y), classes,
+                    wt).sum().backward()
+    assert wt.grad is not None
+
+
+def _np_rnnt(lp, labels, T, U):
+    """log-space alpha DP, plain python (independent of the lax.scan)."""
+    alpha = np.full((T, U + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(T):
+        for u in range(U + 1):
+            cands = []
+            if t > 0:
+                cands.append(alpha[t - 1, u] + lp[t - 1, u, 0])
+            if u > 0:
+                cands.append(alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]])
+            if cands and not (t == 0 and u == 0):
+                m = max(cands)
+                alpha[t, u] = m + np.log(sum(np.exp(c - m) for c in cands))
+    return -(alpha[T - 1, U] + lp[T - 1, U, 0])
+
+
+def test_rnnt_loss_matches_numpy_dp():
+    rng = np.random.default_rng(6)
+    B, T, U, V = 2, 5, 3, 7
+    logits = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    labels = rng.integers(1, V, (B, U)).astype(np.int32)
+    t_len = np.array([5, 4], np.int32)
+    u_len = np.array([3, 2], np.int32)
+
+    got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(t_len), paddle.to_tensor(u_len),
+                      blank=0, reduction="none").numpy()
+    lp = torch.log_softmax(torch.tensor(logits), -1).numpy()
+    want = np.array([_np_rnnt(lp[b], labels[b], t_len[b], u_len[b])
+                     for b in range(B)])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # differentiable
+    lt = paddle.to_tensor(logits, stop_gradient=False)
+    F.rnnt_loss(lt, paddle.to_tensor(labels), paddle.to_tensor(t_len),
+                paddle.to_tensor(u_len)).backward()
+    assert lt.grad is not None and np.isfinite(lt.grad.numpy()).all()
+
+
+def test_edit_distance():
+    # "kitten" -> "sitting" = 3 (classic), "abc" -> "abc" = 0
+    def ids(s, width):
+        out = [ord(c) for c in s] + [0] * (width - len(s))
+        return out
+
+    a = np.array([ids("kitten", 7), ids("abc", 7)], np.int64)
+    b = np.array([ids("sitting", 7), ids("abc", 7)], np.int64)
+    alen = np.array([6, 3], np.int64)
+    blen = np.array([7, 3], np.int64)
+    dist, n = F.edit_distance(paddle.to_tensor(a), paddle.to_tensor(b),
+                              normalized=False,
+                              input_length=paddle.to_tensor(alen),
+                              label_length=paddle.to_tensor(blen))
+    np.testing.assert_allclose(dist.numpy(), [[3.0], [0.0]])
+    assert int(n) == 2
+
+    dist_n, _ = F.edit_distance(paddle.to_tensor(a), paddle.to_tensor(b),
+                                normalized=True,
+                                input_length=paddle.to_tensor(alen),
+                                label_length=paddle.to_tensor(blen))
+    np.testing.assert_allclose(dist_n.numpy(), [[3.0 / 7.0], [0.0]])
+
+
+def test_hsigmoid_custom_tree_negative_padding():
+    """Variable-length custom trees pad with -1 (reference CustomCode stops
+    at the first negative entry): padded steps must not contribute."""
+    x = np.array([[0.3, -0.2, 0.5]], np.float32)
+    w = np.array([[0.1, 0.2, 0.3], [-0.2, 0.4, 0.1], [0.3, -0.1, 0.2]],
+                 np.float32)
+    table = np.array([[0, -1], [1, 2]], np.int64)
+    code = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+
+    def bce(logit, bit):
+        return max(logit, 0) - logit * bit + np.log1p(np.exp(-abs(logit)))
+
+    got0 = float(F.hsigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(np.array([0], np.int64)), 3,
+        paddle.to_tensor(w), path_table=paddle.to_tensor(table),
+        path_code=paddle.to_tensor(code)))
+    np.testing.assert_allclose(got0, bce(float(x @ w[0]), 1.0), rtol=1e-5)
+
+    got1 = float(F.hsigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(np.array([1], np.int64)), 3,
+        paddle.to_tensor(w), path_table=paddle.to_tensor(table),
+        path_code=paddle.to_tensor(code)))
+    want1 = bce(float(x @ w[1]), 0.0) + bce(float(x @ w[2]), 1.0)
+    np.testing.assert_allclose(got1, want1, rtol=1e-5)
+
+
+def test_rnnt_fastemit_warns_and_is_ignored():
+    import warnings
+    rng = np.random.default_rng(7)
+    logits = rng.standard_normal((1, 3, 2, 4)).astype(np.float32)
+    args = (paddle.to_tensor(logits),
+            paddle.to_tensor(np.array([[1]], np.int32)),
+            paddle.to_tensor(np.array([3], np.int32)),
+            paddle.to_tensor(np.array([1], np.int32)))
+    base = float(F.rnnt_loss(*args))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        same = float(F.rnnt_loss(*args, fastemit_lambda=0.01))
+    assert any("fastemit" in str(w.message) for w in rec)
+    np.testing.assert_allclose(same, base)

@@ -1,0 +1,124 @@
+"""Round-3 surface depth: the widened paddle.sparse op family
+(reference sparse_ops.yaml, ~50 ops) and the paddle.strings namespace
+(reference strings_ops.yaml: empty/empty_like/lower/upper)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse, strings
+
+
+def _coo():
+    idx = np.array([[0, 0, 1, 2], [0, 2, 1, 0]])
+    val = np.array([1.0, -2.0, 3.0, -4.0], np.float32)
+    return sparse.sparse_coo_tensor(idx, val, shape=(3, 3)), idx, val
+
+
+def test_sparse_unary_value_wise():
+    x, idx, val = _coo()
+    for name, ref in [("abs", np.abs), ("sin", np.sin), ("tanh", np.tanh),
+                      ("square", np.square), ("expm1", np.expm1),
+                      ("neg", np.negative),
+                      ("relu6", lambda v: np.clip(v, 0, 6))]:
+        out = getattr(sparse, name)(x)
+        assert sparse.is_sparse(out)
+        np.testing.assert_allclose(out.values().numpy(), ref(val),
+                                   rtol=1e-6)
+        assert out.nnz == 4  # sparsity pattern preserved
+
+    out = sparse.leaky_relu(x, 0.1)
+    np.testing.assert_allclose(out.values().numpy(),
+                               np.where(val >= 0, val, 0.1 * val))
+    out = sparse.scale(x, 2.0, bias=1.0)
+    np.testing.assert_allclose(out.values().numpy(), val * 2 + 1)
+    out = sparse.pow(x, 2.0)
+    np.testing.assert_allclose(out.values().numpy(), val ** 2)
+    assert sparse.cast(x, value_dtype="float64") is not None
+    np.testing.assert_allclose(
+        sparse.full_like(x, 7.0).values().numpy(), np.full(4, 7.0))
+
+
+def test_sparse_binary_reduce_manipulate():
+    x, idx, val = _coo()
+    y = sparse.sparse_coo_tensor(idx, val * 2, shape=(3, 3))
+    np.testing.assert_allclose(
+        sparse.subtract(y, x).to_dense().numpy(),
+        x.to_dense().numpy())
+    np.testing.assert_allclose(
+        sparse.divide(y, y).values().numpy()[:1], [1.0])
+    np.testing.assert_allclose(
+        sparse.divide_scalar(x, 2.0).values().numpy(), val / 2)
+
+    dense = x.to_dense().numpy()
+    np.testing.assert_allclose(float(sparse.sum(x)), dense.sum())
+    np.testing.assert_allclose(sparse.sum(x, axis=1).numpy(), dense.sum(1))
+    np.testing.assert_allclose(
+        sparse.reshape(x, [9]).to_dense().numpy(), dense.reshape(9))
+    np.testing.assert_allclose(
+        sparse.transpose(x, [1, 0]).to_dense().numpy(), dense.T)
+    np.testing.assert_allclose(
+        sparse.slice(x, [0], [0], [2]).to_dense().numpy(), dense[:2])
+
+
+def test_sparse_matmul_family_and_softmax():
+    x, idx, val = _coo()
+    dense = x.to_dense().numpy()
+    rng = np.random.default_rng(0)
+    y = rng.standard_normal((3, 2)).astype(np.float32)
+    inp = rng.standard_normal((3, 2)).astype(np.float32)
+
+    np.testing.assert_allclose(
+        sparse.addmm(paddle.to_tensor(inp), x, paddle.to_tensor(y),
+                     beta=0.5, alpha=2.0).numpy(),
+        0.5 * inp + 2.0 * dense @ y, rtol=1e-5)
+    v = rng.standard_normal(3).astype(np.float32)
+    np.testing.assert_allclose(sparse.mv(x, paddle.to_tensor(v)).numpy(),
+                               dense @ v, rtol=1e-5)
+
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((4, 3)).astype(np.float32)
+    mm = sparse.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), x)
+    full = a @ b
+    np.testing.assert_allclose(
+        mm.values().numpy(), full[idx[0], idx[1]], rtol=1e-5)
+
+    sm = sparse.softmax(x)
+    out = sm.to_dense().numpy()
+    # each row's stored entries softmax among themselves
+    row0 = np.exp([1.0, -2.0]) / np.exp([1.0, -2.0]).sum()
+    np.testing.assert_allclose([out[0, 0], out[0, 2]], row0, rtol=1e-5)
+    np.testing.assert_allclose(out[1, 1], 1.0, rtol=1e-6)
+
+
+def test_sparse_conversions():
+    rng = np.random.default_rng(1)
+    d = rng.standard_normal((4, 5)).astype(np.float32)
+    d[d < 0.5] = 0
+    coo = sparse.to_sparse_coo(paddle.to_tensor(d))
+    np.testing.assert_allclose(coo.to_dense().numpy(), d)
+    csr = sparse.to_sparse_csr(paddle.to_tensor(d))
+    crows = csr.crows().numpy()
+    assert crows[-1] == (d != 0).sum()
+    np.testing.assert_allclose(csr.to_dense().numpy(), d)
+
+
+def test_strings_ops():
+    t = strings.StringTensor([["Hello World", "FOO"], ["bar", "Mixed42"]])
+    assert t.shape == [2, 2]
+
+    low = strings.lower(t)
+    assert low.tolist() == [["hello world", "foo"], ["bar", "mixed42"]]
+    up = strings.upper(t)
+    assert up.tolist() == [["HELLO WORLD", "FOO"], ["BAR", "MIXED42"]]
+
+    # ascii mode leaves non-ascii untouched; utf8 mode folds it
+    t2 = strings.StringTensor(["Straße", "ÀÉÎ"])
+    assert strings.lower(t2).tolist() == ["straße", "ÀÉÎ"]
+    assert strings.lower(t2, use_utf8_encoding=True).tolist() == \
+        ["straße", "àéî"]
+
+    e = strings.empty([2, 3])
+    assert e.shape == [2, 3] and e.tolist()[0] == ["", "", ""]
+    assert strings.empty_like(t).shape == [2, 2]
+    assert paddle.strings.lower is strings.lower  # namespace registered
