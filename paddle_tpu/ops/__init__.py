@@ -112,6 +112,8 @@ from . import _generated as _g  # noqa: E402
 
 for _gname in _g.OP_REGISTRY:
     _meta = _g.OP_REGISTRY[_gname]
+    if _meta.get("manual"):
+        continue  # hand-written elsewhere; YAML entry only drives tests
     for _n in (_gname, _meta.get("inplace")):
         if _n and _n not in _METHODS:
             _METHODS[_n] = getattr(_g, _n)

@@ -11,6 +11,8 @@ This is the reference's OpTest discipline (test/legacy_test/op_test.py:379)
 driven from op metadata instead of 1,200 hand-written test classes.
 """
 
+import zlib
+
 import numpy as np
 import pytest
 
@@ -29,24 +31,39 @@ TOL = {
 }
 
 
+def _seed(name, salt=0):
+    # deterministic across processes (hash() varies with PYTHONHASHSEED,
+    # which would make kink-adjacent samples an intermittent failure)
+    return zlib.crc32(name.encode()) + salt
+
+
 def _sample(spec, which, rng, dtype="float32"):
     low = spec.get("low", -2.0)
     high = spec.get("high", 2.0)
     if which == "b":
         low = spec.get("low_b", low)
         high = spec.get("high_b", high)
-    shape = (2, 3)
+    shape = tuple(spec.get("shape", (2, 3)))
     int_arg = spec.get("int_input") or (which == "b" and spec.get("int_b"))
     if dtype in ("int32", "int64") or int_arg:
         dt = dtype if dtype.startswith("int") else "int32"
         return rng.integers(int(low), int(high) + 1, shape).astype(dt)
     if dtype == "bool":
         return rng.random(shape) > 0.5
-    return (rng.random(shape) * (high - low) + low).astype(np.float32)
+    arr = (rng.random(shape) * (high - low) + low).astype(np.float32)
+    # keep finite-difference probes away from non-smooth points (the
+    # central difference straddling a kink disagrees with the analytic
+    # subgradient by O(1))
+    for k in spec.get("kinks", ()):
+        arr = np.where(np.abs(arr - k) < 0.05, arr + np.float32(0.1), arr)
+    return arr
 
 
 def _inputs(spec, rng, dtype="float32"):
     arrs = {"x": _sample(spec, "a", rng, dtype)}
+    if spec.get("inject_nan") and not dtype.startswith(("int", "bool")):
+        arrs["x"] = arrs["x"].copy()
+        arrs["x"].flat[0] = np.nan  # nan-family ops must SEE a NaN
     if spec.arity == 2:
         arrs["y"] = _sample(spec, "b", rng, dtype)
     return arrs
@@ -66,7 +83,7 @@ def _as_f32(arr):
 @pytest.mark.parametrize("name", sorted(BY_NAME), ids=sorted(BY_NAME))
 def test_check_output_and_grad_f32(name):
     spec = BY_NAME[name]
-    rng = np.random.default_rng(hash(name) % 2**32)
+    rng = np.random.default_rng(_seed(name))
     dt0 = spec.get("dtypes", ["float32"])[0]
     inputs = _inputs(spec, rng, dt0 if dt0 != "bfloat16" else "float32")
 
@@ -87,7 +104,7 @@ def test_dtype_ladder(name):
     """check_output at every declared dtype beyond the first."""
     spec = BY_NAME[name]
     ref = op_gen.resolve_np_ref(spec)
-    rng = np.random.default_rng(hash(name) % 2**31)
+    rng = np.random.default_rng(_seed(name, 1))
     for dtype in spec["dtypes"][1:]:
         inputs = _inputs(spec, rng, dtype)
         if dtype == "bfloat16":
@@ -120,7 +137,7 @@ def test_inplace_variant(name):
     """x.op_() mutates x in place, returns x, and matches the out-of-place
     op (grad graph rebind semantics, reference inplace op map)."""
     spec = BY_NAME[name]
-    rng = np.random.default_rng(hash(name) % 2**30)
+    rng = np.random.default_rng(_seed(name, 2))
     inputs = _inputs(spec, rng)
     outplace = _op(name)(*[paddle.to_tensor(v) for v in inputs.values()])
     ts = [paddle.to_tensor(v) for v in inputs.values()]
@@ -184,4 +201,4 @@ def test_op_coverage_report(capsys):
               f"({100.0 * len(covered) / max(len(ops), 1):.0f}%)")
     # ratchet: the YAML registry must keep covering a substantial slice of
     # the public op surface as it grows
-    assert len(covered) >= 90, (len(covered), len(ops))
+    assert len(covered) >= 140, (len(covered), len(ops))
