@@ -5,8 +5,11 @@ workers pull rows for a batch and push gradient updates).
 TPU mapping: DENSE params belong on-device (SPMD); the PS niche that
 survives is host-memory-scale sparse embedding tables. The implementation
 rides the framework RPC agent: `ParameterServer` holds row shards keyed by
-id hash; `SparseTable` is the worker-side handle whose pull returns a
-device tensor and whose push applies SGD-style row updates server-side.
+id; `SparseTable` is the worker-side handle. Per-table row optimizers
+mirror the reference's accessors (the_one_ps.py sparse accessor configs):
+naive SGD, AdaGrad with per-row accumulators, and Adam with per-row
+moments + step, each with optional l2 decay. `push` has a sync path and an
+async path (`push_async`/`flush`) — the async communicator analog.
 """
 
 from __future__ import annotations
@@ -15,19 +18,93 @@ import numpy as np
 
 from .. import rpc
 
-__all__ = ["ParameterServer", "SparseTable"]
+__all__ = ["ParameterServer", "SparseTable", "SGDAccessor",
+           "AdagradAccessor", "AdamAccessor"]
 
 _TABLES: dict[str, "ParameterServer"] = {}
+
+
+class SGDAccessor:
+    """Plain row SGD (reference sparse naive SGD rule)."""
+
+    state_width = 0
+
+    def __init__(self, l2=0.0):
+        self.l2 = float(l2)
+
+    def init_state(self, dim):
+        return None
+
+    def update(self, row, state, grad, lr):
+        g = grad + self.l2 * row if self.l2 else grad
+        return row - lr * g, state
+
+
+class AdagradAccessor:
+    """Per-row AdaGrad (reference sparse adagrad accessor): state is the
+    squared-gradient accumulator."""
+
+    state_width = 1
+
+    def __init__(self, epsilon=1e-6, l2=0.0):
+        self.epsilon = float(epsilon)
+        self.l2 = float(l2)
+
+    def init_state(self, dim):
+        return np.zeros((1, dim), np.float32)
+
+    def update(self, row, state, grad, lr):
+        g = grad + self.l2 * row if self.l2 else grad
+        acc = state[0] + g * g
+        new = row - lr * g / (np.sqrt(acc) + self.epsilon)
+        return new, acc[None]
+
+
+class AdamAccessor:
+    """Per-row Adam (reference sparse adam accessor): state rows are
+    [m, v, t-broadcast]; bias correction uses the per-row step count so
+    rarely-touched rows are corrected by THEIR update count, not the
+    global step."""
+
+    state_width = 3
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, l2=0.0):
+        self.beta1, self.beta2 = float(beta1), float(beta2)
+        self.epsilon = float(epsilon)
+        self.l2 = float(l2)
+
+    def init_state(self, dim):
+        return np.zeros((3, dim), np.float32)
+
+    def update(self, row, state, grad, lr):
+        g = grad + self.l2 * row if self.l2 else grad
+        m = self.beta1 * state[0] + (1 - self.beta1) * g
+        v = self.beta2 * state[1] + (1 - self.beta2) * g * g
+        t = state[2, 0] + 1.0
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        new = row - lr * mhat / (np.sqrt(vhat) + self.epsilon)
+        st = np.stack([m, v, np.full_like(m, t)])
+        return new, st
+
+
+_ACCESSORS = {"sgd": SGDAccessor, "adagrad": AdagradAccessor,
+              "adam": AdamAccessor}
 
 
 class ParameterServer:
     """Row-sharded embedding storage living on one RPC worker."""
 
-    def __init__(self, name, dim, initializer=None, lr=0.1):
+    def __init__(self, name, dim, initializer=None, lr=0.1, optimizer="sgd",
+                 **accessor_kw):
         self.name = name
         self.dim = dim
         self.lr = lr
         self._rows: dict[int, np.ndarray] = {}
+        self._states: dict[int, np.ndarray] = {}
+        if isinstance(optimizer, str):
+            optimizer = _ACCESSORS[optimizer](**accessor_kw)
+        self._accessor = optimizer
         if initializer is None:
             rng = np.random.default_rng(hash(name) % 2**31)  # one stream
             initializer = lambda: rng.standard_normal(dim)\
@@ -55,14 +132,27 @@ class ParameterServer:
     def push_grads(table, ids, grads, lr=None):
         t = _TABLES[table]
         step = t.lr if lr is None else lr
+        acc = t._accessor
         for i, g in zip(ids, grads):
+            i = int(i)
             row = ParameterServer._row(t, i)
-            t._rows[int(i)] = row - step * g.astype(np.float32)
+            state = t._states.get(i)
+            if state is None and acc.state_width:
+                state = acc.init_state(t.dim)
+            new_row, new_state = acc.update(
+                row, state, np.asarray(g, np.float32), step)
+            t._rows[i] = new_row.astype(np.float32)
+            if new_state is not None:
+                t._states[i] = new_state
         return len(ids)
 
     @staticmethod
     def row_count(table):
         return len(_TABLES[table]._rows)
+
+    @staticmethod
+    def accessor_name(table):
+        return type(_TABLES[table]._accessor).__name__
 
 
 class SparseTable:
@@ -74,6 +164,7 @@ class SparseTable:
         self.dim = dim
         self.server = server  # WorkerInfo or registered rpc name
         self.lr = lr  # None -> server-side default
+        self._pending: list = []
 
     def pull(self, ids):
         import paddle_tpu as paddle
@@ -89,6 +180,31 @@ class SparseTable:
                             args=(self.name, ids.tolist(), list(g),
                                   self.lr))
 
+    def push_async(self, ids, grads):
+        """Fire-and-track update (the reference async communicator's
+        send_sparse path); `flush()` drains outstanding pushes."""
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        g = np.asarray(grads, dtype=np.float32).reshape(len(ids), self.dim)
+        fut = rpc.rpc_async(self.server, ParameterServer.push_grads,
+                            args=(self.name, ids.tolist(), list(g),
+                                  self.lr))
+        self._pending.append(fut)
+        return fut
+
+    def flush(self):
+        """Wait for every outstanding async push; returns rows updated."""
+        total = 0
+        for fut in self._pending:
+            # rpc_async returns a concurrent.futures.Future; accept a
+            # torch-style .wait() handle too
+            total += fut.result() if hasattr(fut, "result") else fut.wait()
+        self._pending.clear()
+        return total
+
     def size(self):
         return rpc.rpc_sync(self.server, ParameterServer.row_count,
+                            args=(self.name,))
+
+    def accessor(self):
+        return rpc.rpc_sync(self.server, ParameterServer.accessor_name,
                             args=(self.name,))

@@ -74,12 +74,18 @@ def _is_floatlike(x):
 
 class StaticFunction:
     def __init__(self, fn, input_spec=None, build_strategy=None,
-                 backend=None, donate_state=False, static_argnames=None):
+                 backend=None, donate_state=False, static_argnames=None,
+                 fallback=True):
         self._fn = fn
         self._cache: dict = {}
         self._state: list[Tensor] | None = None
         self._state_by_key: dict = {}
         self._donate = donate_state
+        # SOT graph-break analog (reference python/paddle/jit/sot/): when
+        # tracing hits data-dependent Python control flow, permanently run
+        # this function eagerly instead of raising
+        self._fallback = fallback
+        self._fell_back = False
         wraps(fn)(self)
 
     def recapture(self):
@@ -164,7 +170,7 @@ class StaticFunction:
 
     # -- call ---------------------------------------------------------------
     def __call__(self, *args, **kwargs):
-        if not _to_static_enabled or in_to_static_trace():
+        if not _to_static_enabled or in_to_static_trace() or self._fell_back:
             return self._fn(*args, **kwargs)
         # kwargs that are Tensors participate as traced args
         args_flat, treedef = jax.tree_util.tree_flatten(args)
@@ -193,6 +199,30 @@ class StaticFunction:
             entry = (jitted, cell, state_list)
             self._cache[key] = entry
         jitted, cell, state_list = entry
+        try:
+            return self._run_compiled(jitted, cell, state_list, arg_arrays)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            # data-dependent Python control flow: the discovery call ran it
+            # eagerly (values were concrete), but under the jit trace the
+            # branch condition is a tracer. Reference SOT breaks the graph
+            # and keeps the Python path; here the whole function falls back
+            # to eager — correctness over speed, loudly.
+            if not self._fallback:
+                raise
+            import warnings
+            warnings.warn(
+                f"to_static: {getattr(self._fn, '__name__', self._fn)!r} "
+                "uses data-dependent Python control flow and cannot be "
+                "compiled; falling back to EAGER execution for this "
+                f"function (SOT graph-break analog). Cause: "
+                f"{type(e).__name__}", UserWarning, stacklevel=2)
+            self._fell_back = True
+            return self._fn(*args, **kwargs)
+
+    def _run_compiled(self, jitted, cell, state_list, arg_arrays):
         state_arrays = []
         for t in state_list:
             a = t._d
@@ -272,10 +302,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     def decorate(fn):
         if isinstance(fn, Layer):
             layer = fn
-            sf = StaticFunction(layer.forward, input_spec, build_strategy, backend)
+            sf = StaticFunction(layer.forward, input_spec, build_strategy,
+                                backend, **kwargs)
             layer.forward = sf
             return layer
-        return StaticFunction(fn, input_spec, build_strategy, backend)
+        return StaticFunction(fn, input_spec, build_strategy, backend,
+                              **kwargs)
 
     if function is not None:
         return decorate(function)
