@@ -14,6 +14,8 @@ async path (`push_async`/`flush`) — the async communicator analog.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from .. import rpc
@@ -102,6 +104,10 @@ class ParameterServer:
         self.lr = lr
         self._rows: dict[int, np.ndarray] = {}
         self._states: dict[int, np.ndarray] = {}
+        # push_async makes concurrent pushes to one table reachable on the
+        # ThreadingTCPServer; serialize read-modify-write per table so
+        # interleaved accessor updates (and Adam step counts) can't be lost
+        self._lock = threading.Lock()
         if isinstance(optimizer, str):
             optimizer = _ACCESSORS[optimizer](**accessor_kw)
         self._accessor = optimizer
@@ -126,24 +132,26 @@ class ParameterServer:
     @staticmethod
     def pull_rows(table, ids):
         t = _TABLES[table]
-        return np.stack([ParameterServer._row(t, i) for i in ids])
+        with t._lock:  # check-then-insert of new rows races with push_grads
+            return np.stack([ParameterServer._row(t, i) for i in ids])
 
     @staticmethod
     def push_grads(table, ids, grads, lr=None):
         t = _TABLES[table]
         step = t.lr if lr is None else lr
         acc = t._accessor
-        for i, g in zip(ids, grads):
-            i = int(i)
-            row = ParameterServer._row(t, i)
-            state = t._states.get(i)
-            if state is None and acc.state_width:
-                state = acc.init_state(t.dim)
-            new_row, new_state = acc.update(
-                row, state, np.asarray(g, np.float32), step)
-            t._rows[i] = new_row.astype(np.float32)
-            if new_state is not None:
-                t._states[i] = new_state
+        with t._lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                row = ParameterServer._row(t, i)
+                state = t._states.get(i)
+                if state is None and acc.state_width:
+                    state = acc.init_state(t.dim)
+                new_row, new_state = acc.update(
+                    row, state, np.asarray(g, np.float32), step)
+                t._rows[i] = new_row.astype(np.float32)
+                if new_state is not None:
+                    t._states[i] = new_state
         return len(ids)
 
     @staticmethod

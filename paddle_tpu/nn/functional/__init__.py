@@ -455,10 +455,17 @@ def rope(q, k, sin, cos, name=None):
     from ...ops.kernels import _common as kern
     sin_a, cos_a = as_tensor(sin)._data, as_tensor(cos)._data
 
-    qt = as_tensor(q)
+    qt, kt = as_tensor(q), as_tensor(k)
+
+    def _kernel_ok(t):
+        return (t.ndim == 4 and t.shape[-1] % 2 == 0
+                and cos_a.size == t.shape[1] * t.shape[-1])
+
+    # both tensors ride the same kernel path, so BOTH layouts must fit it
+    # (a 3-D or different-seq-len k the composite accepts must not crash
+    # inside rope_apply's [b, s, h, d] unpack)
     use_kernel = (kern.available() and flag("use_pallas_kernels")
-                  and qt.ndim == 4 and qt.shape[-1] % 2 == 0
-                  and cos_a.size == qt.shape[1] * qt.shape[-1])
+                  and _kernel_ok(qt) and _kernel_ok(kt))
     if use_kernel:
         from ...ops.kernels import rope_pallas as rp
 

@@ -249,7 +249,7 @@ class HSigmoidLoss(Layer):
 
 
 class RNNTLoss(Layer):
-    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
                  name=None):
         super().__init__()
         self.blank = blank

@@ -9,13 +9,13 @@ whole [block_c, block_f] output tiles that lie entirely beyond an expert's
 fill level: with capacity_factor 1.25 and imbalanced routing, a large slice
 of the einsum's FLOPs are zeros the compiler cannot know about.
 
-Rows past counts[e] inside a live tile are zero vectors by construction
-(the dispatch one-hot zeroes them), so no in-tile masking is needed: the
-zero rows matmul to zero.
+Rows past counts[e] inside a live tile are masked to zero in the kernel
+itself, so the zeroed-output contract holds for ANY padding content (the
+live MoE path feeds zero padding rows anyway, but callers need not).
 
 Public entry: `grouped_matmul(x, w, counts)` with custom_vjp — dx reuses the
 kernel with w transposed (skipping the same tiles); dw is a dense einsum
-(every valid row contributes; the zero rows add nothing).
+over the count-masked cotangent (padding rows contribute nothing).
 """
 
 from __future__ import annotations
@@ -36,8 +36,11 @@ def _kernel(c_ref, x_ref, w_ref, o_ref, *, block_c):
     def _compute():
         x = x_ref[0]                                  # [bc, H]
         w = w_ref[0]                                  # [H, bf]
-        o_ref[0] = jnp.dot(
-            x, w, preferred_element_type=jnp.float32).astype(o_ref.dtype)
+        out = jnp.dot(x, w, preferred_element_type=jnp.float32)
+        # mask rows past the fill level inside a partially-live tile, so the
+        # output matches the zeroed contract even for nonzero padding rows
+        rows = c_start + jax.lax.broadcasted_iota(jnp.int32, out.shape, 0)
+        o_ref[0] = jnp.where(rows < count, out, 0.0).astype(o_ref.dtype)
 
     @pl.when(count <= c_start)
     def _skip():
@@ -87,8 +90,12 @@ def _vjp_fwd(x, w, counts, interpret):
 def _vjp_bwd(interpret, saved, g):
     x, w, counts = saved
     dx = _grouped_call(g, jnp.swapaxes(w, 1, 2), counts, interpret)
+    # mask cotangent rows past the fill level so dw matches the masked
+    # forward even when x carries nonzero padding rows
+    live = jnp.arange(x.shape[1])[None, :, None] < counts.reshape(-1, 1, 1)
+    g_live = jnp.where(live, g.astype(jnp.float32), 0)
     dw = jnp.einsum("ech,ecf->ehf", x.astype(jnp.float32),
-                    g.astype(jnp.float32)).astype(w.dtype)
+                    g_live).astype(w.dtype)
     dcounts = np.zeros(counts.shape, jax.dtypes.float0) \
         if jnp.issubdtype(counts.dtype, jnp.integer) else jnp.zeros_like(counts)
     return dx, dw, dcounts

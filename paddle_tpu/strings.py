@@ -44,6 +44,10 @@ class StringTensor:
         other_arr = other._data if isinstance(other, StringTensor) else other
         return np.asarray(self._data == other_arr)
 
+    # elementwise __eq__ would otherwise set __hash__ to None; keep identity
+    # hashing like the numeric Tensor types so instances work in sets/dicts
+    __hash__ = object.__hash__
+
     def __repr__(self):
         return f"StringTensor(shape={self.shape}, {self._data!r})"
 

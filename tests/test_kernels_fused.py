@@ -152,6 +152,30 @@ def test_grouped_matmul_matches_einsum():
                                atol=1e-5)
 
 
+def test_grouped_matmul_nonzero_padding_is_masked():
+    """Rows past counts[e] are masked INSIDE live tiles: garbage padding
+    content must not leak into the output (kernel contract is unconditional,
+    not dependent on the dispatch one-hot zeroing the padding)."""
+    rng = np.random.default_rng(11)
+    e_, c, h, f = 2, 8, 16, 32
+    counts = jnp.asarray([5, 0], jnp.int32)
+    x = jnp.asarray(rng.standard_normal((e_, c, h)), jnp.float32)  # no zeroing
+    w = jnp.asarray(rng.standard_normal((e_, h, f)), jnp.float32)
+    out = moe_gemm_pallas.grouped_matmul(x, w, counts, True)
+    ref = moe_gemm_pallas.reference_grouped_matmul(x, w, counts)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    # grads must honor the mask too: dw from garbage padding rows is zero
+    d1 = jax.grad(lambda a, b: jnp.sum(
+        moe_gemm_pallas.grouped_matmul(a, b, counts, True)),
+        argnums=(0, 1))(x, w)
+    d2 = jax.grad(lambda a, b: jnp.sum(
+        moe_gemm_pallas.reference_grouped_matmul(a, b, counts)),
+        argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(d1[0]), np.asarray(d2[0]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d1[1]), np.asarray(d2[1]), atol=1e-5)
+
+
 def test_moe_layer_grouped_path_matches_vmap():
     """MoELayer forward+backward parity: grouped-GEMM kernel vs the generic
     vmapped expert path, same weights and routing."""

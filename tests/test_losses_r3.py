@@ -259,17 +259,29 @@ def test_hsigmoid_custom_tree_negative_padding():
     np.testing.assert_allclose(got1, want1, rtol=1e-5)
 
 
-def test_rnnt_fastemit_warns_and_is_ignored():
-    import warnings
+def test_rnnt_fastemit_value_unchanged_grad_scaled():
+    """FastEmit (warprnnt semantics): the loss VALUE is the plain
+    transducer loss for any lambda; the GRADIENT is affine in lambda —
+    grad(lam) = g_blank + (1+lam)*g_emit — so
+    grad(0.5) == grad(0) + 0.5*(grad(1) - grad(0))."""
     rng = np.random.default_rng(7)
     logits = rng.standard_normal((1, 3, 2, 4)).astype(np.float32)
-    args = (paddle.to_tensor(logits),
-            paddle.to_tensor(np.array([[1]], np.int32)),
-            paddle.to_tensor(np.array([3], np.int32)),
-            paddle.to_tensor(np.array([1], np.int32)))
-    base = float(F.rnnt_loss(*args))
-    with warnings.catch_warnings(record=True) as rec:
-        warnings.simplefilter("always")
-        same = float(F.rnnt_loss(*args, fastemit_lambda=0.01))
-    assert any("fastemit" in str(w.message) for w in rec)
-    np.testing.assert_allclose(same, base)
+    lab = paddle.to_tensor(np.array([[1]], np.int32))
+    ilen = paddle.to_tensor(np.array([3], np.int32))
+    llen = paddle.to_tensor(np.array([1], np.int32))
+
+    def loss_and_grad(lam):
+        x = paddle.to_tensor(logits)
+        x.stop_gradient = False
+        out = F.rnnt_loss(x, lab, ilen, llen, fastemit_lambda=lam)
+        out.backward()
+        return float(out), np.asarray(x.grad.numpy())
+
+    v0, g0 = loss_and_grad(0.0)
+    v1, g1 = loss_and_grad(1.0)
+    vh, gh = loss_and_grad(0.5)
+    np.testing.assert_allclose(v1, v0, rtol=1e-6)
+    np.testing.assert_allclose(vh, v0, rtol=1e-6)
+    assert not np.allclose(g1, g0)  # emission grads actually rescaled
+    np.testing.assert_allclose(gh, g0 + 0.5 * (g1 - g0),
+                               rtol=1e-5, atol=1e-7)
