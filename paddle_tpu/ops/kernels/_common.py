@@ -12,6 +12,9 @@ import functools
 import jax
 
 _INTERPRET = False  # test hook: run the Pallas kernels in interpret mode
+_FORCE_DISPATCH = False  # test hook: dispatch real kernels off-TPU (for
+#                          cross-platform TPU *lowering* tests — the traced
+#                          program is never executed on the host platform)
 
 
 def force_interpret(enable: bool) -> None:
@@ -20,13 +23,48 @@ def force_interpret(enable: bool) -> None:
     available.cache_clear()
 
 
+def force_dispatch(enable: bool) -> None:
+    """Make `available()` True with interpret_mode() False, so live paths
+    trace the REAL pallas_call even on CPU. Only valid for lowering-only
+    traces (jit(...).trace(...).lower(lowering_platforms=("tpu",)))."""
+    global _FORCE_DISPATCH
+    _FORCE_DISPATCH = bool(enable)
+    available.cache_clear()
+
+
 def interpret_mode() -> bool:
     return _INTERPRET
 
 
+def round_up(n, multiple):
+    """Ceil `n` to a multiple (Mosaic block-alignment arithmetic)."""
+    return -(-n // multiple) * multiple
+
+
+def pad_to_block(a, block, axis=0):
+    """Zero-pad `axis` of `a` up to a multiple of `block` (Mosaic requires
+    sublane/lane-divisible blocks; callers slice the result back)."""
+    import jax.numpy as jnp
+    pad = (-a.shape[axis]) % block
+    if not pad:
+        return a
+    widths = [(0, pad if ax == axis else 0) for ax in range(a.ndim)]
+    return jnp.pad(a, widths)
+
+
+def pick_row_block(n_rows, row_bytes, budget):
+    """Row-block size under a VMEM byte budget: a multiple of 8 (Mosaic
+    sublane rule — degraded rows=1 blocks fail TPU lowering), capped at 256
+    and at the padded input extent. No divisor search: callers zero-pad
+    indivisible inputs via pad_to_block (≤ rows-1 wasted rows beats
+    shrinking the block and multiplying grid steps)."""
+    rows = max(8, min(256, (budget // max(row_bytes, 1)) // 8 * 8))
+    return min(rows, round_up(n_rows, 8))
+
+
 @functools.cache
 def available() -> bool:
-    if _INTERPRET:
+    if _INTERPRET or _FORCE_DISPATCH:
         return True
     try:
         return jax.devices()[0].platform == "tpu"

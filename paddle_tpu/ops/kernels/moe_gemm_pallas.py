@@ -26,10 +26,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def _kernel(c_ref, x_ref, w_ref, o_ref, *, block_c):
-    count = c_ref[0, 0]
+    # c_ref is the scalar-prefetch arg: counts[e] lives in SMEM (a (1,1)
+    # VMEM block would violate Mosaic's 8x128-divisible block rule, caught
+    # by tests/test_tpu_lowering.py)
+    count = c_ref[pl.program_id(0)]
     c_start = pl.program_id(1) * block_c
 
     @pl.when(count > c_start)
@@ -47,33 +51,47 @@ def _kernel(c_ref, x_ref, w_ref, o_ref, *, block_c):
         o_ref[0] = jnp.zeros(o_ref.shape[1:], o_ref.dtype)
 
 
-def _pick(n, target):
-    b = min(target, n)
-    while n % b:
-        b //= 2
-        if b <= 1:
-            return 1
-    return b
+def _pick_bc(c, target=128):
+    """Capacity block: multiple of 8 (Mosaic sublane rule); indivisible
+    capacities are padded rather than met with a degraded block."""
+    from ._common import round_up
+    return max(8, min(target, round_up(c, 8)))
+
+
+def _pick_bf(f):
+    """Output-feature block: the lane dim must be a multiple of 128 OR the
+    full array dim, and — unlike the padded capacity axis — must DIVIDE f
+    exactly (nothing pads f, so a floored grid would leave trailing output
+    columns unwritten)."""
+    if f % 128:
+        return f  # full-dim lane block, always legal
+    return 256 if f % 256 == 0 else 128
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _grouped_call(x, w, counts, interpret):
+    from ._common import pad_to_block
     e, c, h = x.shape
     f = w.shape[-1]
-    bc = _pick(c, 128)
-    bf = _pick(f, 256)
-    grid = (e, c // bc, f // bf)
+    bc = _pick_bc(c)
+    bf = _pick_bf(f)
+    xp = pad_to_block(x, bc, axis=1)  # kernel masks rows >= counts[e] anyway
+    cp = xp.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(e, cp // bc, f // bf),
+        in_specs=[pl.BlockSpec((1, bc, h), lambda e_, i, j, c_: (e_, i, 0)),
+                  pl.BlockSpec((1, h, bf), lambda e_, i, j, c_: (e_, 0, j))],
+        out_specs=pl.BlockSpec((1, bc, bf), lambda e_, i, j, c_: (e_, i, j)),
+    )
     with jax.enable_x64(False):
-        return pl.pallas_call(
+        out = pl.pallas_call(
             functools.partial(_kernel, block_c=bc),
-            grid=grid,
-            in_specs=[pl.BlockSpec((1, 1), lambda e_, i, j: (e_, 0)),
-                      pl.BlockSpec((1, bc, h), lambda e_, i, j: (e_, i, 0)),
-                      pl.BlockSpec((1, h, bf), lambda e_, i, j: (e_, 0, j))],
-            out_specs=pl.BlockSpec((1, bc, bf), lambda e_, i, j: (e_, i, j)),
-            out_shape=jax.ShapeDtypeStruct((e, c, f), x.dtype),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((e, cp, f), x.dtype),
             interpret=interpret,
-        )(counts.reshape(e, 1).astype(jnp.int32), x, w)
+        )(counts.reshape(e).astype(jnp.int32), xp, w)
+    return out[:, :c] if cp != c else out
 
 
 def _primal(x, w, counts, interpret=False):

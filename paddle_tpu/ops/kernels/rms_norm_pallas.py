@@ -63,21 +63,24 @@ def _bwd_kernel(h_ref, w_ref, g_ref, dx_ref, dwp_ref, *, hidden, eps):
 
 
 def _pick_rows(n_rows, hidden):
-    """Row-block size: stay well under VMEM with ~4 f32 row buffers."""
-    budget = 4 * 1024 * 1024  # bytes for one [rows, H] f32 buffer
-    rows = max(8, min(256, budget // max(hidden * 4, 1)))
-    while n_rows % rows:
-        rows //= 2
-        if rows <= 1:
-            return 1
-    return rows
+    """~4 f32 row buffers of VMEM budget; zero pad rows normalise to finite
+    values under +eps and contribute nothing to dw."""
+    from ._common import pick_row_block
+    return pick_row_block(n_rows, hidden * 4, 4 * 1024 * 1024)
+
+
+def _pad_rows(a, rows):
+    from ._common import pad_to_block
+    return pad_to_block(a, rows, axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "interpret"))
 def _fused_fwd(x2, res2, w, eps, interpret):
     n, h = x2.shape
     rows = _pick_rows(n, h)
-    grid = (n // rows,)
+    x2p = _pad_rows(x2, rows)
+    np_ = x2p.shape[0]
+    grid = (np_ // rows,)
     row_spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
     w_spec = pl.BlockSpec((1, h), lambda i: (0, 0))
     if res2 is None:
@@ -87,28 +90,30 @@ def _fused_fwd(x2, res2, w, eps, interpret):
                 grid=grid,
                 in_specs=[row_spec, w_spec],
                 out_specs=row_spec,
-                out_shape=jax.ShapeDtypeStruct((n, h), x2.dtype),
+                out_shape=jax.ShapeDtypeStruct((np_, h), x2.dtype),
                 interpret=interpret,
-            )(x2, w.reshape(1, h))
-        return out, x2
+            )(x2p, w.reshape(1, h))
+        return out[:n], x2
     with jax.enable_x64(False):
         out, hsum = pl.pallas_call(
             functools.partial(_fwd_res_kernel, eps=eps),
             grid=grid,
             in_specs=[row_spec, row_spec, w_spec],
             out_specs=[row_spec, row_spec],
-            out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
-                       jax.ShapeDtypeStruct((n, h), x2.dtype)],
+            out_shape=[jax.ShapeDtypeStruct((np_, h), x2.dtype),
+                       jax.ShapeDtypeStruct((np_, h), x2.dtype)],
             interpret=interpret,
-        )(x2, res2, w.reshape(1, h))
-    return out, hsum
+        )(x2p, _pad_rows(res2, rows), w.reshape(1, h))
+    return out[:n], hsum[:n]
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "interpret"))
 def _fused_bwd(h2, w, g2, eps, interpret):
     n, h = h2.shape
     rows = _pick_rows(n, h)
-    grid = (n // rows,)
+    h2p = _pad_rows(h2, rows)
+    np_ = h2p.shape[0]
+    grid = (np_ // rows,)
     row_spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
     with jax.enable_x64(False):
         dx, dw_part = pl.pallas_call(
@@ -118,11 +123,11 @@ def _fused_bwd(h2, w, g2, eps, interpret):
                       pl.BlockSpec((1, h), lambda i: (0, 0)),
                       row_spec],
             out_specs=[row_spec, pl.BlockSpec((1, 8, h), lambda i: (i, 0, 0))],
-            out_shape=[jax.ShapeDtypeStruct((n, h), h2.dtype),
-                       jax.ShapeDtypeStruct((n // rows, 8, h), jnp.float32)],
+            out_shape=[jax.ShapeDtypeStruct((np_, h), h2.dtype),
+                       jax.ShapeDtypeStruct((np_ // rows, 8, h), jnp.float32)],
             interpret=interpret,
-        )(h2, w.reshape(1, h), g2)
-    return dx, jnp.sum(dw_part[:, 0, :], axis=0)
+        )(h2p, w.reshape(1, h), _pad_rows(g2, rows))
+    return dx[:n], jnp.sum(dw_part[:, 0, :], axis=0)
 
 
 def _run_fwd(x, weight, residual, eps, interpret):

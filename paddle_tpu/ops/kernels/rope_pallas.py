@@ -35,27 +35,28 @@ def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
 
 
 def _pick_rows(total_s, feat):
-    """Rows (positions) per block: ~1 MB f32 per x buffer, divisor of S."""
-    budget = 1024 * 1024
-    rows = max(1, min(256, budget // max(feat * 4, 1)))
-    while total_s % rows:
-        rows //= 2
-        if rows <= 1:
-            return 1
-    return rows
+    """Rows (positions) per block: ~1 MB f32 per x buffer; sequences that
+    don't divide are zero-padded by _rope_call and sliced back."""
+    from ._common import pick_row_block
+    return pick_row_block(total_s, feat * 4, 1024 * 1024)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _rope_call(x, cos2, sin2, interpret):
     b, s, h, d = x.shape
+    from ._common import pad_to_block
     rows = _pick_rows(s, h * d)
-    nsb = s // rows
+    x = pad_to_block(x, rows, axis=1)
+    cos2 = pad_to_block(cos2, rows, axis=0)
+    sin2 = pad_to_block(sin2, rows, axis=0)
+    sp = x.shape[1]
+    nsb = sp // rows
     grid = (b * nsb,)
     x_spec = pl.BlockSpec((1, rows, h, d), lambda i: (i // nsb, i % nsb, 0, 0))
     t_spec = pl.BlockSpec((rows, d), lambda i: (i % nsb, 0))
 
     with jax.enable_x64(False):
-        return pl.pallas_call(
+        out = pl.pallas_call(
             _rope_kernel,
             grid=grid,
             in_specs=[x_spec, t_spec, t_spec],
@@ -63,6 +64,7 @@ def _rope_call(x, cos2, sin2, interpret):
             out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
             interpret=interpret,
         )(x, cos2, sin2)
+    return out[:, :s] if sp != s else out
 
 
 def _tables_2d(cos, sin, s, d):

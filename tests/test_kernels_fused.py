@@ -152,6 +152,67 @@ def test_grouped_matmul_matches_einsum():
                                atol=1e-5)
 
 
+def test_padded_row_paths_numeric_parity():
+    """Non-block-divisible shapes take the zero-pad-and-slice path in the
+    rms/rope/moe kernels; verify fwd+bwd numerics (not just lowering) so a
+    wrong pad axis or slice can't hide behind all-zero lowering tests."""
+    rng = np.random.default_rng(21)
+    from paddle_tpu.ops.kernels import rms_norm_pallas as rn
+    from paddle_tpu.ops.kernels import rope_pallas as rp
+
+    # rmsnorm at n=13 rows (pads to 16)
+    x = jnp.asarray(rng.standard_normal((1, 13, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64,)), jnp.float32)
+    res = jnp.asarray(rng.standard_normal((1, 13, 64)), jnp.float32)
+
+    def comp(x, w, r):
+        h = x + r
+        return h * jax.lax.rsqrt(
+            jnp.mean(h * h, -1, keepdims=True) + 1e-6) * w
+
+    y, _ = rn.rms_norm_fused(x, w, res, 1e-6, True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(comp(x, w, res)),
+                               atol=2e-5)
+    g1 = jax.grad(lambda *t: jnp.sum(rn.rms_norm_fused(*t, 1e-6, True)[0]),
+                  argnums=(0, 1, 2))(x, w, res)
+    g2 = jax.grad(lambda *t: jnp.sum(comp(*t)), argnums=(0, 1, 2))(x, w, res)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+    # rope at s=13 (pads to 16), half-duplicated table layout
+    xq = jnp.asarray(rng.standard_normal((2, 13, 2, 32)), jnp.float32)
+    pos = np.arange(13)
+    inv = 1.0 / (10000 ** (np.arange(0, 16) / 16))
+    ang = np.concatenate([pos[:, None] * inv[None]] * 2, -1)
+    cos = jnp.asarray(np.cos(ang), jnp.float32)
+    sin = jnp.asarray(np.sin(ang), jnp.float32)
+    got = rp.rope_apply(xq, cos, sin, True)
+    want = rp.rope_reference(xq, cos, sin)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+    gk = jax.grad(lambda t: jnp.sum(rp.rope_apply(t, cos, sin, True)))(xq)
+    gc = jax.grad(lambda t: jnp.sum(rp.rope_reference(t, cos, sin)))(xq)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gc), atol=2e-5)
+
+    # moe grouped matmul at c=10 (pads to 16), f=384 (128-divisible but NOT
+    # 256-divisible — the block must divide f or trailing columns go
+    # unwritten; regression for the floored-grid NaN bug)
+    xm = jnp.asarray(rng.standard_normal((2, 10, 32)), jnp.float32)
+    wm = jnp.asarray(rng.standard_normal((2, 32, 384)), jnp.float32)
+    counts = jnp.asarray([7, 3], jnp.int32)
+    got = moe_gemm_pallas.grouped_matmul(xm, wm, counts, True)
+    want = moe_gemm_pallas.reference_grouped_matmul(xm, wm, counts)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    d1 = jax.grad(lambda a, b: jnp.sum(
+        moe_gemm_pallas.grouped_matmul(a, b, counts, True)),
+        argnums=(0, 1))(xm, wm)
+    d2 = jax.grad(lambda a, b: jnp.sum(
+        moe_gemm_pallas.reference_grouped_matmul(a, b, counts)),
+        argnums=(0, 1))(xm, wm)
+    np.testing.assert_allclose(np.asarray(d1[0]), np.asarray(d2[0]),
+                               atol=1e-4)  # f32 accumulation-order noise
+    np.testing.assert_allclose(np.asarray(d1[1]), np.asarray(d2[1]), atol=1e-4)
+
+
 def test_grouped_matmul_nonzero_padding_is_masked():
     """Rows past counts[e] are masked INSIDE live tiles: garbage padding
     content must not leak into the output (kernel contract is unconditional,

@@ -1,0 +1,236 @@
+"""Cross-platform TPU lowering proofs.
+
+The axon TPU tunnel has been down for rounds 1-3, so no Pallas kernel had
+ever been compiled for a real TPU. These tests close that gap WITHOUT the
+tunnel: `jax.jit(fn).trace(...).lower(lowering_platforms=("tpu",))` runs the
+full Mosaic lowering pipeline on CPU — bad BlockSpecs, unsupported ops, and
+dtype errors all surface here, exactly as they would on device (only
+VMEM-budget overflows, which need the Mosaic *compiler* in libtpu, escape).
+
+Covered: every Pallas kernel family (forward AND backward where one exists)
+plus the flagship GPT train step traced with real-kernel dispatch forced on,
+so the kernels are proven to lower in-context, not just in isolation.
+
+Reference analog: paddle/phi/kernels/fusion/gpu/* compiling in the
+reference's CUDA CI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.kernels import _common as kern
+
+
+def lower_tpu(fn, *args):
+    """Lower `fn(*args)` for the TPU target from the CPU host; returns the
+    StableHLO text (raises on any Mosaic lowering failure)."""
+    lowered = jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+    return lowered.as_text()
+
+
+def assert_mosaic(txt):
+    assert "tpu_custom_call" in txt, "no Mosaic custom call in lowered HLO"
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("gqa", [1, 4], ids=["mha", "gqa4"])
+def test_flash_attention_fwd_bwd_lowers(dtype, gqa):
+    from paddle_tpu.ops.kernels import flash_attention_pallas as fap
+    b, s, h, d = 2, 512, 8, 64
+    q = jnp.zeros((b, s, h, d), dtype)
+    k = jnp.zeros((b, s, h // gqa, d), dtype)
+    v = jnp.zeros((b, s, h // gqa, d), dtype)
+
+    fwd = functools.partial(fap.flash_attention_forward, causal=True)
+    assert_mosaic(lower_tpu(fwd, q, k, v))
+
+    def fwd_bwd(q, k, v):
+        out, lse = fap.flash_attention_forward_lse(q, k, v, causal=True)
+        return fap.flash_attention_backward(q, k, v, out, lse,
+                                            jnp.ones_like(out), causal=True)
+
+    assert_mosaic(lower_tpu(fwd_bwd, q, k, v))
+
+
+@pytest.mark.parametrize("shape", [(1, 509, 256), (3, 17, 384),
+                                   (1, 509, 18432)])  # 18432: rows=56 budget
+def test_rms_norm_prime_rows_lowers(shape):
+    """Row counts that defeat the divisor search (prime / tiny) must be
+    padded to a sublane-legal block, not degraded to rows=1 (which Mosaic
+    rejects). Regression for the round-3 verdict's _pick_rows finding."""
+    from paddle_tpu.ops.kernels import rms_norm_pallas as rnp_
+    x = jnp.zeros(shape, jnp.float32)
+    w = jnp.ones((shape[-1],), jnp.float32)
+    assert_mosaic(lower_tpu(
+        lambda a, b: rnp_.rms_norm_fused(a, b, None, 1e-6, False), x, w))
+
+
+@pytest.mark.parametrize("shape", [(2, 127, 4, 64), (1, 509, 2, 128),
+                                   (1, 509, 36, 128)])  # feat 4608: rows=56
+def test_rope_prime_seq_lowers(shape):
+    from paddle_tpu.ops.kernels import rope_pallas as rp
+    x = jnp.zeros(shape, jnp.float32)
+    cos = jnp.zeros((shape[1], shape[-1]), jnp.float32)
+    assert_mosaic(lower_tpu(
+        lambda a, c, s: rp.rope_apply(a, c, s, False), x, cos, cos))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rms_norm_fused_lowers(dtype):
+    from paddle_tpu.ops.kernels import rms_norm_pallas as rnp_
+    x = jnp.zeros((4, 128, 256), dtype)
+    w = jnp.ones((256,), dtype)
+    res = jnp.zeros((4, 128, 256), dtype)
+
+    fn = functools.partial(rnp_.rms_norm_fused, eps=1e-6, interpret=False)
+    assert_mosaic(lower_tpu(lambda a, b, r: fn(a, b, r), x, w, res))
+
+    def grad_fn(a, b, r):
+        return jax.grad(
+            lambda *t: jnp.sum(fn(*t)[0].astype(jnp.float32)),
+            argnums=(0, 1, 2))(a, b, r)
+
+    assert_mosaic(lower_tpu(grad_fn, x, w, res))
+
+
+@pytest.mark.parametrize("shape", [(2, 128, 8, 64), (1, 1024, 4, 128)])
+def test_rope_fwd_bwd_lowers(shape):
+    from paddle_tpu.ops.kernels import rope_pallas as rp
+    b, s, h, d = shape
+    x = jnp.zeros(shape, jnp.float32)
+    cos = jnp.zeros((s, d), jnp.float32)
+    sin = jnp.zeros((s, d), jnp.float32)
+
+    fn = lambda a, c, si: rp.rope_apply(a, c, si, False)
+    assert_mosaic(lower_tpu(fn, x, cos, sin))
+    assert_mosaic(lower_tpu(
+        lambda a, c, si: jax.grad(lambda t: jnp.sum(fn(t, c, si)))(a),
+        x, cos, sin))
+
+
+@pytest.mark.parametrize("n", [4096, 4097])  # odd size exercises padding
+def test_adamw_update_lowers(n):
+    from paddle_tpu.ops.kernels import adamw_pallas as ap
+    w = jnp.zeros((n,), jnp.float32)
+    fn = functools.partial(ap.adamw_update, beta1=0.9, beta2=0.999,
+                           eps=1e-8, wd=0.01, out_dtype=jnp.bfloat16)
+    assert_mosaic(lower_tpu(lambda a, g, m, v: fn(a, g, m, v, 1e-3, 10),
+                            w, w, w, w))
+
+
+@pytest.mark.parametrize("c,f", [(154, 1024), (313, 1000), (128, 384)])
+def test_moe_grouped_matmul_odd_capacity_lowers(c, f):
+    """Capacity = ceil(capacity_factor*n*k/e) is rarely 8-divisible (154,
+    313, ...) and intermediate sizes need not divide 128: the kernel must
+    pad/full-block, not degrade bc/bf below the Mosaic rules."""
+    from paddle_tpu.ops.kernels import moe_gemm_pallas as mg
+    e, hd = 4, 512
+    x = jnp.zeros((e, c, hd), jnp.float32)
+    w = jnp.zeros((e, hd, f), jnp.float32)
+    counts = jnp.zeros((e,), jnp.int32)
+    assert_mosaic(lower_tpu(
+        lambda a, b: mg.grouped_matmul(a, b, counts, False), x, w))
+
+
+def test_moe_grouped_matmul_fwd_bwd_lowers():
+    from paddle_tpu.ops.kernels import moe_gemm_pallas as mg
+    e, c, hd, f = 8, 256, 512, 1024
+    x = jnp.zeros((e, c, hd), jnp.bfloat16)
+    w = jnp.zeros((e, hd, f), jnp.bfloat16)
+    counts = jnp.zeros((e,), jnp.int32)
+
+    assert_mosaic(lower_tpu(
+        lambda a, b: mg.grouped_matmul(a, b, counts, False), x, w))
+
+    def grad_fn(a, b):
+        return jax.grad(lambda *t: jnp.sum(
+            mg.grouped_matmul(*t, counts, False).astype(jnp.float32)),
+            argnums=(0, 1))(a, b)
+
+    assert_mosaic(lower_tpu(grad_fn, x, w))
+
+
+@pytest.fixture
+def forced_dispatch():
+    """Trace live paths with real kernel dispatch on (lowering only — the
+    traced program is never executed on the CPU host)."""
+    kern.force_dispatch(True)
+    try:
+        yield
+    finally:
+        kern.force_dispatch(False)
+
+
+def test_flagship_train_step_lowers_with_kernels(forced_dispatch):
+    """The full GPT train step — forward, loss, backward, fused-AdamW-style
+    update — lowers for TPU with the Pallas kernels dispatched in-context.
+    This is the program bench.py times on real hardware."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.nn.utils import bind_param_arrays
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=1024, max_position_embeddings=256,
+                    hidden_size=256, num_layers=2, num_heads=4)
+    model = GPT(cfg)
+    params = list(model.parameters())
+    arrays = [p._d for p in params]
+
+    def loss_fn(arrays, ids, labels):
+        with bind_param_arrays(params, arrays):
+            _, loss = model(Tensor(ids), labels=Tensor(labels))
+        return loss._d
+
+    from paddle_tpu.ops.kernels import adamw_pallas as ap
+
+    def train_step(arrays, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(arrays, ids, labels)
+        new_arrays = []
+        for a, g in zip(arrays, grads):
+            w, _, _, _ = ap.adamw_update(
+                a.astype(jnp.float32).reshape(-1),
+                g.astype(jnp.float32).reshape(-1),
+                jnp.zeros(a.size, jnp.float32), jnp.zeros(a.size, jnp.float32),
+                1e-3, 1, beta1=0.9, beta2=0.999, eps=1e-8, wd=0.01,
+                out_dtype=a.dtype)
+            new_arrays.append(w.reshape(a.shape).astype(a.dtype))
+        return loss, new_arrays
+
+    ids = jnp.zeros((2, 256), jnp.int32)
+    labels = jnp.zeros((2, 256), jnp.int32)
+    txt = lower_tpu(train_step, arrays, ids, labels)
+    assert_mosaic(txt)
+
+
+def test_llama_forward_lowers_with_kernels(forced_dispatch):
+    """Llama (rmsnorm + rope + flash attention in one program) lowers for
+    TPU — the three transformer-glue kernels compose in-context."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.autograd.grad_mode import no_grad
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.nn.utils import bind_param_arrays
+
+    paddle.seed(0)
+    model = llama_tiny()
+    model.eval()
+    params = list(model.parameters())
+    arrays = [p._d for p in params]
+
+    def fwd(arrays, ids):
+        with bind_param_arrays(params, arrays):
+            with no_grad():
+                out = model(Tensor(ids))
+        out = out[0] if isinstance(out, tuple) else out
+        return out._d
+
+    ids = jnp.zeros((1, 256), jnp.int32)
+    assert_mosaic(lower_tpu(fwd, arrays, ids))
