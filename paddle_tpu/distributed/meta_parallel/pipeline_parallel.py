@@ -8,12 +8,17 @@ handshakes at :51).
 TPU-native redesign: the schedule is *compiled*, not imperative. The
 homogeneous block run of the PipelineLayer is stacked into [L, ...] params
 sharded over the 'pp' mesh axis; a `shard_map` body rotates micro-batch
-activations around the pp ring with `lax.ppermute` inside a `lax.scan` over
-ticks (M + S - 1 of them). Stage-local blocks execute as a scan over the
-local layer shard. jax autodiff through the scan+ppermute yields the reverse
-(backward) pipeline automatically — no hand-written 1F1B state machine, no
-shape handshakes (shapes are static, as SURVEY.md §7 prescribes). Remat of
-each block (recompute_interval) bounds activation memory like 1F1B does.
+activations around the pp ring with `lax.ppermute` inside ONE `lax.scan`
+whose ticks stagger the virtual chunks — the interleaved schedule as a
+compiled program: v*M + S - 1 ticks when accumulate_steps divides by the
+stage count (bubble (S-1)/(v*M+S-1), matching the reference's interleaved
+scheduler), falling back to v sequential fill-drain passes (GPipe bubble)
+otherwise. Stage-local blocks execute as a scan over the local layer shard.
+jax autodiff through the scan+ppermute yields the reverse (backward)
+pipeline automatically — no hand-written 1F1B state machine, no shape
+handshakes (shapes are static, as SURVEY.md §7 prescribes). Chunk-level
+remat (params slice inside the remat) bounds activation memory like 1F1B
+does; recompute adds finer per-block granularity.
 
 Head/tail layers (embedding, final norm/head) run as full-batch GSPMD ops
 outside the ring, so their FLOPs are not multiplied by pp.
@@ -41,34 +46,44 @@ __all__ = ["PipelineParallel", "schedule_report"]
 
 
 def schedule_report(num_stages, num_virtual=1, accumulate_steps=1):
-    """Analytic schedule accounting for the compiled ring (VERDICT r2 #5).
+    """Analytic schedule accounting for the compiled ring.
 
-    The compiled schedule runs v fill-drain ring passes (one per virtual
-    chunk): T = v*(M+S-1) ticks of which v*M are useful, so the bubble
-    fraction equals GPipe's (S-1)/(M+S-1) — NOT interleaved-1F1B's
-    (S-1)/(v*M+S-1). What 1F1B buys over GPipe is *memory* (activation
-    stash bounded by S, not M); the compiled ring gets the same bound from
-    per-block rematerialization instead, proven by
-    test_pipeline_recompute_memory_bound / the v=2 comparison test. The
-    reference's imperative 1F1B (pipeline_parallel.py:416) and interleaved
-    (:875) schedules trade bubble for hand-written P2P state machines;
-    under XLA the scan+ppermute program is what the compiler can actually
-    overlap and fuse.
+    With accumulate_steps divisible by the stage count (the same contract
+    the reference's interleaved scheduler enforces,
+    pipeline_parallel.py:875), the schedule is ONE compiled interleaved
+    ring scan: virtual chunks are staggered inside a single scan of
+    T = v*M + S - 1 ticks, so the bubble is the interleaved
+    (S-1)/(v*M+S-1) — not GPipe's (S-1)/(M+S-1). Device d at tick t
+    executes work item u = t - d, cycling micro-batch groups of S through
+    the v chunks (chunk c of group g runs at ticks g*v*S + c*S + ...);
+    each tick ends in one ppermute hop, which is exactly when the
+    dependency (same chunk on the previous stage, or the previous chunk
+    arriving from the last stage) is satisfied. When M is not divisible
+    by S (and v > 1), the schedule falls back to v sequential fill-drain
+    ring passes with GPipe's bubble. Memory: activation stash is bounded
+    by per-chunk rematerialization (the params slice rides inside the
+    remat so the scan never stashes per-tick param copies).
     """
     s = max(int(num_stages), 1)
     v = max(int(num_virtual), 1)
     m = max(int(accumulate_steps), 1)
-    ticks = v * (m + s - 1)
+    interleaved = v == 1 or m % s == 0
+    if interleaved:
+        ticks = v * m + s - 1
+        schedule = "compiled interleaved ring (staggered virtual chunks)"
+    else:
+        ticks = v * (m + s - 1)
+        schedule = "compiled-ring fill-drain per virtual chunk (M % S != 0)"
     useful = v * m
     return {
-        "schedule": "compiled-ring fill-drain per virtual chunk + remat",
+        "schedule": schedule,
         "num_stages": s, "num_virtual": v, "accumulate_steps": m,
         "ticks": ticks, "useful_ticks": useful,
         "bubble_fraction": round((ticks - useful) / ticks, 4),
         "gpipe_bubble_fraction": round((s - 1) / (m + s - 1), 4),
         "interleaved_1f1b_bubble_fraction":
             round((s - 1) / (v * m + s - 1), 4),
-        "memory_bound": "activation stash bounded by per-block remat "
+        "memory_bound": "activation stash bounded by per-chunk remat "
                         "(matches 1F1B's S-bound; measured by "
                         "test_pipeline_recompute_memory_bound)",
     }
@@ -193,54 +208,82 @@ class PipelineParallel(MetaParallelBase):
             h, auxs = jax.lax.scan(one, h, stacked_local)
             return h, jnp.sum(auxs)
 
-        def ring(x_micro, chunk_params):
-            # one fill-drain ring pass: x_micro [M, mb, ...] -> [M, mb, ...]
+        def interleaved(x_micro, stacked_local, v_run):
+            """One scan, `v_run` virtual chunks staggered (reference
+            interleaved schedule, pipeline_parallel.py:875, as a compiled
+            program): device d at tick t runs work item u = t - d; u
+            enumerates (group g, chunk c, slot r) as g*v_run*S + c*S + r,
+            i.e. micro-batch groups of S cycle through the chunks —
+            requiring M % S == 0 when v_run > 1. T = v_run*M + S - 1 ticks.
+            v_run == 1 is the plain fill-drain ring (any M), which the
+            M % S != 0 fallback runs once per chunk."""
+            v = v_run
             M = x_micro.shape[0]
-            T = M + S - 1
+            work = v * M
+            T = work + S - 1
             idx = jax.lax.axis_index("pp")
             buf = jnp.zeros_like(x_micro[0])
             out_buf = jnp.zeros_like(x_micro)
             perm = [(i, (i + 1) % S) for i in range(S)]
 
+            def chunk_exec(stacked_local, c, h):
+                # the dynamic params slice lives INSIDE the remat: backward
+                # recomputes it from the (loop-invariant) stacked params, so
+                # the scan stashes per-tick activations only — never
+                # per-tick copies of a whole chunk's params
+                chunk = [jax.lax.dynamic_slice_in_dim(p, c * n_chunk,
+                                                      n_chunk, 0)
+                         for p in stacked_local]
+                return local_stack(chunk, h)
+
+            chunk_exec = jax.checkpoint(chunk_exec)
+
             def tick(carry, t):
                 buf, out_buf, aux_acc = carry
+                u = t - idx
+                valid = (u >= 0) & (u < work)
+                uc = jnp.clip(u, 0, work - 1)
+                g = uc // (v * S)
+                c = (uc % (v * S)) // S
+                m = g * S + uc % S
                 mb = jax.lax.dynamic_index_in_dim(
-                    x_micro, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
-                inp = jnp.where(idx == 0, mb, buf)
-                h, aux = local_stack(chunk_params, inp)
-                # stage `idx` is processing microbatch t-idx at this tick;
-                # fill/drain ticks compute on garbage and must not leak aux
-                mvalid = ((t - idx) >= 0) & ((t - idx) < M)
-                aux_acc = aux_acc + jnp.where(mvalid, aux, 0.0)
-                # last stage writes its result for microbatch t-(S-1)
-                oi = jnp.clip(t - (S - 1), 0, M - 1)
-                valid = (t >= S - 1) & (idx == S - 1)
+                    x_micro, jnp.clip(m, 0, M - 1), axis=0, keepdims=False)
+                # stage 0 takes chunk-0 micros fresh; everything else takes
+                # the ring buffer (chunk c-1 output arriving from stage S-1,
+                # or chunk c from stage idx-1)
+                inp = jnp.where((idx == 0) & (c == 0), mb, buf)
+                h, aux = chunk_exec(stacked_local, c, inp)
+                aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+                write = valid & (idx == S - 1) & (c == v - 1)
+                oi = jnp.clip(m, 0, M - 1)
                 cur = jax.lax.dynamic_index_in_dim(out_buf, oi, 0, False)
                 out_buf = jax.lax.dynamic_update_index_in_dim(
-                    out_buf, jnp.where(valid, h, cur), oi, 0)
+                    out_buf, jnp.where(write, h, cur), oi, 0)
                 nxt = jax.lax.ppermute(h, "pp", perm)
                 return (nxt, out_buf, aux_acc), None
 
             (buf, out_buf, aux_acc), _ = jax.lax.scan(
                 tick, (buf, out_buf, jnp.zeros((), jnp.float32)),
                 jnp.arange(T))
-            # only the last stage's buffer is real: psum of masked buffers
             contrib = jnp.where(idx == S - 1, out_buf,
                                 jnp.zeros_like(out_buf))
             return jax.lax.psum(contrib, "pp"), jax.lax.psum(aux_acc, "pp")
 
         def body(x_micro, *stacked_local):
             # stacked_local: each [v*n_chunk, ...] — this stage's v chunks
-            # (chunk-major); chunk c rides one full ring pass, its drained
-            # output feeding chunk c+1 — the compiled analog of interleaved
-            # virtual stages (same per-device memory, v rings).
+            # (chunk-major). M % S == 0 (static): one interleaved scan.
+            # Otherwise: v sequential single-chunk passes (GPipe bubble).
             M = x_micro.shape[0]
-            aux_total = jnp.zeros((), jnp.float32)
-            for c in range(v):
-                chunk = [p[c * n_chunk:(c + 1) * n_chunk]
-                         for p in stacked_local]
-                x_micro, aux_c = ring(x_micro, chunk)
-                aux_total = aux_total + aux_c
+            if v == 1 or M % S == 0:
+                x_micro, aux_total = interleaved(
+                    x_micro, list(stacked_local), v)
+            else:
+                aux_total = jnp.zeros((), jnp.float32)
+                for c in range(v):
+                    chunk = [p[c * n_chunk:(c + 1) * n_chunk]
+                             for p in stacked_local]
+                    x_micro, aux_c = interleaved(x_micro, chunk, 1)
+                    aux_total = aux_total + aux_c
             # per-micro aux is a mean over that micro's tokens: average over
             # the M micros so pp matches the full-batch (non-pp) aux scale
             return x_micro, aux_total / M
