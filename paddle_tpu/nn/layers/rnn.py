@@ -1,0 +1,474 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py —
+SimpleRNNCell :697, LSTMCell :876, GRUCell :1074, RNN :1269, BiRNN :1342,
+SimpleRNN :1742, LSTM :1864, GRU :1990).
+
+TPU-native redesign: the reference unrolls a Python loop over time steps
+(`_rnn_dynamic_graph`, rnn.py:157) or dispatches to a cuDNN kernel. Here the
+whole recurrence is ONE `lax.scan` inside one traced function — the cell is
+functionalized (its params rebound to traced arrays, the same idiom as the
+compiled pipeline) and scanned over the time axis, so XLA compiles a single
+fused while-style loop whose per-step matmuls ride the MXU and whose
+backward (BPTT) falls out of autodiff through the scan. Sequence-length
+masking follows the reference's `_maybe_copy` contract exactly: step
+OUTPUTS are not masked; STATES keep their previous value past each row's
+length. Reverse runs flip the whole padded sequence (and the mask), as the
+reference does.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...autograd.function import apply_multi
+from ...autograd.grad_mode import no_grad
+from ..layer import Layer
+from .. import initializer as I
+from ..utils import bind_param_arrays
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+class RNNCellBase(Layer):
+    """Base for single-step recurrent cells (reference rnn.py:551)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch_ref = _as_tensor(batch_ref)
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape if shape is not None else self.state_shape
+        dt = dtype or "float32"
+
+        def build(s):
+            if isinstance(s, (list, tuple)) and s and \
+                    isinstance(s[0], (list, tuple)):
+                return tuple(build(sub) for sub in s)
+            dims = [batch] + [int(d) for d in s]
+            import numpy as np
+            return Tensor(jnp.full(dims, init_value,
+                                   jnp.dtype(np.dtype(dt))))
+
+        return build(tuple(shape))
+
+
+def _make_rnn_params(layer, n_gates, input_size, hidden_size,
+                     weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                     bias_hh_attr):
+    """Reference contract (rnn.py:777-840): attr=False does NOT omit the
+    parameter — it creates a FROZEN one (Constant(1.0) weights, zero
+    biases), keeping forward math and state_dict keys intact."""
+    std = 1.0 / math.sqrt(hidden_size)
+
+    def make(shape, attr, is_bias):
+        if attr is not False:
+            return layer.create_parameter(
+                shape, attr, is_bias=is_bias,
+                default_initializer=I.Uniform(-std, std))
+        p = layer.create_parameter(
+            shape, None, is_bias=is_bias,
+            default_initializer=I.Constant(0.0 if is_bias else 1.0))
+        p.stop_gradient = True
+        return p
+
+    layer.weight_ih = make((n_gates * hidden_size, input_size),
+                           weight_ih_attr, False)
+    layer.weight_hh = make((n_gates * hidden_size, hidden_size),
+                           weight_hh_attr, False)
+    layer.bias_ih = make((n_gates * hidden_size,), bias_ih_attr, True)
+    layer.bias_hh = make((n_gates * hidden_size,), bias_hh_attr, True)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (reference rnn.py:697)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        if activation not in ("tanh", "relu"):
+            raise ValueError(f"Unknown activation '{activation}'")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _make_rnn_params(self, 1, input_size, hidden_size, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        from .. import functional as F
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h = states
+        i2h = inputs.matmul(self.weight_ih, transpose_y=True) + self.bias_ih
+        h2h = pre_h.matmul(self.weight_hh, transpose_y=True) + self.bias_hh
+        act = F.tanh if self.activation == "tanh" else F.relu
+        h = act(i2h + h2h)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class LSTMCell(RNNCellBase):
+    """i,f,g,o gate LSTM step (reference rnn.py:876; gate order i,f,g,o)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        if proj_size is not None:
+            raise NotImplementedError(
+                "LSTM proj_size (hidden-state projection) is not "
+                "implemented on this backend")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _make_rnn_params(self, 4, input_size, hidden_size, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        from .. import functional as F
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_hidden, pre_cell = states
+        gates = inputs.matmul(self.weight_ih, transpose_y=True) \
+            + self.bias_ih \
+            + pre_hidden.matmul(self.weight_hh, transpose_y=True) \
+            + self.bias_hh
+        from ...ops.manipulation import split
+        gi, gf, gg, go = split(gates, 4, axis=-1)
+        i = F.sigmoid(gi)
+        f = F.sigmoid(gf)
+        o = F.sigmoid(go)
+        c = f * pre_cell + i * F.tanh(gg)
+        h = o * F.tanh(c)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class GRUCell(RNNCellBase):
+    """r,z,c gate GRU step, reset-after-matmul variant (reference
+    rnn.py:1074: c = act(x_c + r * h_c); h = (h_prev - c) * z + c)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _make_rnn_params(self, 3, input_size, hidden_size, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        from .. import functional as F
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_hidden = states
+        x_gates = inputs.matmul(self.weight_ih, transpose_y=True) \
+            + self.bias_ih
+        h_gates = pre_hidden.matmul(self.weight_hh, transpose_y=True) \
+            + self.bias_hh
+        from ...ops.manipulation import split
+        x_r, x_z, x_c = split(x_gates, 3, axis=-1)
+        h_r, h_z, h_c = split(h_gates, 3, axis=-1)
+        r = F.sigmoid(x_r + h_r)
+        z = F.sigmoid(x_z + h_z)
+        c = F.tanh(x_c + r * h_c)  # apply reset gate after matmul
+        h = (pre_hidden - c) * z + c
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+def _scan_recurrence(cell, inputs, initial_states, sequence_length,
+                     time_major, is_reverse, **cell_kwargs):
+    """Run `cell` over the time axis as ONE compiled lax.scan.
+
+    Replaces the reference's per-step Python loop (_rnn_dynamic_graph,
+    rnn.py:157) with a single scan: the cell's params are rebound to traced
+    arrays inside the traced function, so gradients flow to them through
+    the scan (BPTT) via the framework's normal vjp machinery.
+    Returns (outputs, final_states) with the reference's masking contract.
+    """
+    params = [p for _, p in cell.named_parameters()]
+    x = _as_tensor(inputs)
+    st_flat, st_def = jax.tree_util.tree_flatten(
+        initial_states, is_leaf=lambda v: isinstance(v, Tensor))
+    n_states = len(st_flat)
+    has_seq = sequence_length is not None
+    seq_in = [_as_tensor(sequence_length)] if has_seq else []
+
+    def f(x_arr, *rest):
+        rest = list(rest)
+        seq_arr = rest.pop(0) if has_seq else None
+        st0 = rest[:n_states]
+        parr = rest[n_states:]
+        xs = x_arr if time_major else jnp.swapaxes(x_arr, 0, 1)  # [T, B, I]
+        t_steps = xs.shape[0]
+        if has_seq:
+            mask = (jnp.arange(t_steps)[:, None]
+                    < seq_arr.reshape(1, -1)).astype(xs.dtype)   # [T, B]
+            if is_reverse:
+                mask = mask[::-1]
+        if is_reverse:
+            xs = xs[::-1]
+
+        def step(carry, inp):
+            st = carry
+            x_t = inp[0] if has_seq else inp
+            with bind_param_arrays(params, parr):
+                with no_grad():
+                    out, new_states = cell.forward(
+                        Tensor(x_t),
+                        jax.tree_util.tree_unflatten(
+                            st_def, [Tensor(s) for s in st]),
+                        **cell_kwargs)
+            new_flat = [t._d for t in jax.tree_util.tree_leaves(
+                new_states, is_leaf=lambda v: isinstance(v, Tensor))]
+            if has_seq:
+                m = inp[1][:, None]  # [B, 1]
+                new_flat = [m * n + (1 - m) * o
+                            for n, o in zip(new_flat, st)]
+            return tuple(new_flat), out._d
+
+        init = tuple(a.astype(xs.dtype) if a.dtype != xs.dtype else a
+                     for a in st0)
+        final, ys = jax.lax.scan(step, init,
+                                 (xs, mask) if has_seq else xs)
+        if is_reverse:
+            ys = ys[::-1]
+        out = ys if time_major else jnp.swapaxes(ys, 0, 1)
+        return (out,) + tuple(final)
+
+    outs = apply_multi(lambda *arrs: f(arrs[0], *arrs[1:]),
+                       x, *seq_in, *st_flat, *params, name="rnn_scan")
+    out, final_flat = outs[0], list(outs[1:])
+    final_states = jax.tree_util.tree_unflatten(st_def, final_flat)
+    return out, final_states
+
+
+class RNN(Layer):
+    """Wrap a cell to run over a whole sequence (reference rnn.py:1269)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        if not hasattr(self.cell, "call"):
+            self.cell.call = self.cell.forward
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(
+                batch_ref=inputs,
+                batch_dim_idx=1 if self.time_major else 0)
+        return _scan_recurrence(self.cell, inputs, initial_states,
+                                sequence_length, self.time_major,
+                                self.is_reverse, **kwargs)
+
+
+class BiRNN(Layer):
+    """Forward + reverse cells, outputs concatenated (reference :1342)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        if states_fw is None:
+            states_fw = self.cell_fw.get_initial_states(
+                batch_ref=inputs, batch_dim_idx=1 if self.time_major else 0)
+        if states_bw is None:
+            states_bw = self.cell_bw.get_initial_states(
+                batch_ref=inputs, batch_dim_idx=1 if self.time_major else 0)
+        out_fw, st_fw = _scan_recurrence(
+            self.cell_fw, inputs, states_fw, sequence_length,
+            self.time_major, False, **kwargs)
+        out_bw, st_bw = _scan_recurrence(
+            self.cell_bw, inputs, states_bw, sequence_length,
+            self.time_major, True, **kwargs)
+        from ...ops.manipulation import concat
+        outputs = concat([out_fw, out_bw], axis=-1)
+        return outputs, (st_fw, st_bw)
+
+
+class RNNBase(Layer):
+    """Stacked (multi-layer, optionally bidirectional) recurrence
+    (reference rnn.py:1426). States are packed [L*D, B, H] per component."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        if direction not in ("forward", "bidirectional", "bidirect"):
+            raise ValueError(f"Unknown direction '{direction}'")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.dropout = dropout
+        self.num_directions = 2 if direction != "forward" else 1
+        self.time_major = time_major
+        self.num_layers = num_layers
+        self.state_components = 2 if mode == "LSTM" else 1
+
+        kwargs = {"weight_ih_attr": weight_ih_attr,
+                  "weight_hh_attr": weight_hh_attr,
+                  "bias_ih_attr": bias_ih_attr,
+                  "bias_hh_attr": bias_hh_attr}
+        if mode == "LSTM":
+            cell_cls = LSTMCell
+        elif mode == "GRU":
+            cell_cls = GRUCell
+        else:
+            cell_cls = SimpleRNNCell
+            kwargs["activation"] = self.activation
+
+        self._layers_list = []
+        for i in range(num_layers):
+            in_size = input_size if i == 0 \
+                else hidden_size * self.num_directions
+            if self.num_directions == 2:
+                layer = BiRNN(cell_cls(in_size, hidden_size, **kwargs),
+                              cell_cls(in_size, hidden_size, **kwargs),
+                              time_major)
+            else:
+                layer = RNN(cell_cls(in_size, hidden_size, **kwargs),
+                            is_reverse=False, time_major=time_major)
+            self.add_sublayer(str(i), layer)
+            self._layers_list.append(layer)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        from ...ops.manipulation import stack, concat
+        inputs = _as_tensor(inputs)
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+        L, D, C = self.num_layers, self.num_directions, self.state_components
+        if initial_states is None:
+            z = Tensor(jnp.zeros((L * D, batch, self.hidden_size),
+                                 inputs._data.dtype))
+            initial_states = tuple(z for _ in range(C))
+        elif isinstance(initial_states, Tensor):
+            initial_states = (initial_states,)
+
+        final_per_layer = []
+        out = inputs
+        for i, layer in enumerate(self._layers_list):
+            if i > 0 and self.dropout:
+                out = F.dropout(out, self.dropout, training=self.training,
+                                mode="upscale_in_train")
+            # states for this layer: component tensors rows [i*D, i*D+D)
+            def pick(row):
+                comps = tuple(s[row] for s in initial_states)
+                return comps if C == 2 else comps[0]
+            if D == 2:
+                st = (pick(i * D), pick(i * D + 1))
+            else:
+                st = pick(i * D)
+            out, fin = layer(out, st, sequence_length)
+            final_per_layer.append(fin)
+
+        # repack final states to [L*D, B, H] per component
+        comps = []
+        for ci in range(C):
+            rows = []
+            for i in range(L):
+                fin = final_per_layer[i]
+                if D == 2:
+                    for d in range(2):
+                        f_d = fin[d]
+                        rows.append(f_d[ci] if C == 2 else f_d)
+                else:
+                    rows.append(fin[ci] if C == 2 else fin)
+            comps.append(stack(rows, axis=0))
+        final_states = tuple(comps) if C == 2 else comps[0]
+        return out, final_states
+
+    def extra_repr(self):
+        s = f"{self.input_size}, {self.hidden_size}"
+        if self.num_layers != 1:
+            s += f", num_layers={self.num_layers}"
+        if self.time_major:
+            s += f", time_major={self.time_major}"
+        if self.dropout:
+            s += f", dropout={self.dropout}"
+        return s
+
+
+class SimpleRNN(RNNBase):
+    """Multi-layer Elman RNN (reference rnn.py:1742)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        if activation not in ("tanh", "relu"):
+            raise ValueError(f"Unknown activation '{activation}'")
+        self.activation = activation
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class LSTM(RNNBase):
+    """Multi-layer LSTM (reference rnn.py:1864)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=None,
+                 name=None):
+        if proj_size is not None:
+            raise NotImplementedError(
+                "LSTM proj_size (hidden-state projection) is not "
+                "implemented on this backend")
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(RNNBase):
+    """Multi-layer GRU (reference rnn.py:1990)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

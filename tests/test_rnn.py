@@ -1,0 +1,291 @@
+"""RNN layer family: parity vs numpy reference recurrences + BPTT grads.
+
+Reference semantics under test: python/paddle/nn/layer/rnn.py —
+SimpleRNNCell :697, LSTMCell :876 (gate order i,f,g,o), GRUCell :1074
+(reset-after-matmul), RNN/_rnn_dynamic_graph masking contract :143 (outputs
+unmasked; states keep previous value past sequence length; reverse flips the
+whole padded sequence), RNNBase stacking :1675.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_simple_cell(x, h, wih, whh, bih, bhh, act=np.tanh):
+    return act(x @ wih.T + bih + h @ whh.T + bhh)
+
+
+def np_lstm_cell(x, h, c, wih, whh, bih, bhh):
+    g = x @ wih.T + bih + h @ whh.T + bhh
+    hs = g.shape[-1] // 4
+    i = _sigmoid(g[:, :hs])
+    f = _sigmoid(g[:, hs:2 * hs])
+    gg = np.tanh(g[:, 2 * hs:3 * hs])
+    o = _sigmoid(g[:, 3 * hs:])
+    c2 = f * c + i * gg
+    return o * np.tanh(c2), c2
+
+
+def np_gru_cell(x, h, wih, whh, bih, bhh):
+    xg = x @ wih.T + bih
+    hg = h @ whh.T + bhh
+    hs = h.shape[-1]
+    r = _sigmoid(xg[:, :hs] + hg[:, :hs])
+    z = _sigmoid(xg[:, hs:2 * hs] + hg[:, hs:2 * hs])
+    c = np.tanh(xg[:, 2 * hs:] + r * hg[:, 2 * hs:])
+    return (h - c) * z + c
+
+
+def _cell_weights(cell):
+    return (cell.weight_ih.numpy(), cell.weight_hh.numpy(),
+            cell.bias_ih.numpy(), cell.bias_hh.numpy())
+
+
+def test_simple_rnn_cell_step():
+    paddle.seed(1)
+    cell = nn.SimpleRNNCell(6, 4)
+    x = np.random.default_rng(0).standard_normal((3, 6)).astype(np.float32)
+    h0 = np.random.default_rng(1).standard_normal((3, 4)).astype(np.float32)
+    out, st = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+    want = np_simple_cell(x, h0, *_cell_weights(cell))
+    np.testing.assert_allclose(out.numpy(), want, atol=1e-5)
+    np.testing.assert_allclose(st.numpy(), want, atol=1e-5)
+    # default zero state
+    out0, _ = cell(paddle.to_tensor(x))
+    np.testing.assert_allclose(
+        out0.numpy(), np_simple_cell(x, np.zeros((3, 4), np.float32),
+                                     *_cell_weights(cell)), atol=1e-5)
+
+
+def test_lstm_cell_step():
+    paddle.seed(2)
+    cell = nn.LSTMCell(5, 4)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 5)).astype(np.float32)
+    h0 = rng.standard_normal((2, 4)).astype(np.float32)
+    c0 = rng.standard_normal((2, 4)).astype(np.float32)
+    out, (h, c) = cell(paddle.to_tensor(x),
+                       (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    wh, wc = np_lstm_cell(x, h0, c0, *_cell_weights(cell))
+    np.testing.assert_allclose(out.numpy(), wh, atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), wh, atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), wc, atol=1e-5)
+
+
+def test_gru_cell_step():
+    paddle.seed(3)
+    cell = nn.GRUCell(5, 4)
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 5)).astype(np.float32)
+    h0 = rng.standard_normal((2, 4)).astype(np.float32)
+    out, h = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+    want = np_gru_cell(x, h0, *_cell_weights(cell))
+    np.testing.assert_allclose(out.numpy(), want, atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), want, atol=1e-5)
+
+
+def test_rnn_wrapper_scan_matches_loop():
+    """RNN(cell) over [B, T, I] equals the per-step numpy loop."""
+    paddle.seed(4)
+    cell = nn.SimpleRNNCell(3, 4)
+    rnn = nn.RNN(cell)
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    out, fin = rnn(paddle.to_tensor(x))
+    w = _cell_weights(cell)
+    h = np.zeros((2, 4), np.float32)
+    outs = []
+    for t in range(5):
+        h = np_simple_cell(x[:, t], h, *w)
+        outs.append(h)
+    np.testing.assert_allclose(out.numpy(), np.stack(outs, 1), atol=1e-5)
+    np.testing.assert_allclose(fin.numpy(), h, atol=1e-5)
+
+
+def test_rnn_reverse_and_time_major():
+    paddle.seed(5)
+    cell = nn.GRUCell(3, 4)
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    # reverse: equals running the flipped sequence forward, outputs flipped
+    out_r, fin_r = nn.RNN(cell, is_reverse=True)(paddle.to_tensor(x))
+    out_f, fin_f = nn.RNN(cell)(paddle.to_tensor(x[:, ::-1].copy()))
+    np.testing.assert_allclose(out_r.numpy(), out_f.numpy()[:, ::-1],
+                               atol=1e-5)
+    np.testing.assert_allclose(fin_r.numpy(), fin_f.numpy(), atol=1e-5)
+    # time_major: same results transposed
+    out_tm, _ = nn.RNN(cell, time_major=True)(
+        paddle.to_tensor(np.swapaxes(x, 0, 1).copy()))
+    out_bm, _ = nn.RNN(cell)(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.swapaxes(out_tm.numpy(), 0, 1),
+                               out_bm.numpy(), atol=1e-5)
+
+
+def test_sequence_length_masking_contract():
+    """States freeze past each row's length (reference _maybe_copy :143);
+    outputs are NOT masked. Final state equals the state at the last valid
+    step."""
+    paddle.seed(6)
+    cell = nn.SimpleRNNCell(3, 4)
+    rnn = nn.RNN(cell)
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    seq = np.array([3, 5], np.int64)
+    out, fin = rnn(paddle.to_tensor(x), sequence_length=paddle.to_tensor(seq))
+    w = _cell_weights(cell)
+    h = np.zeros((2, 4), np.float32)
+    hs = []
+    for t in range(5):
+        h_new = np_simple_cell(x[:, t], h, *w)
+        m = (t < seq).astype(np.float32)[:, None]
+        h = m * h_new + (1 - m) * h
+        hs.append(h_new)  # outputs are the unmasked step outputs
+    np.testing.assert_allclose(out.numpy(), np.stack(hs, 1), atol=1e-5)
+    np.testing.assert_allclose(fin.numpy(), h, atol=1e-5)
+
+
+def test_birnn_concat():
+    paddle.seed(7)
+    fw, bw = nn.GRUCell(3, 4), nn.GRUCell(3, 4)
+    bi = nn.BiRNN(fw, bw)
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    out, (st_f, st_b) = bi(paddle.to_tensor(x))
+    assert out.shape == [2, 5, 8]
+    of, _ = nn.RNN(fw)(paddle.to_tensor(x))
+    ob, _ = nn.RNN(bw, is_reverse=True)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy()[..., :4], of.numpy(), atol=1e-5)
+    np.testing.assert_allclose(out.numpy()[..., 4:], ob.numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("klass,comps", [(nn.SimpleRNN, 1), (nn.LSTM, 2),
+                                         (nn.GRU, 1)])
+def test_stacked_shapes_and_state_packing(klass, comps):
+    paddle.seed(8)
+    m = klass(6, 8, num_layers=2, direction="bidirectional", dropout=0.0)
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((3, 7, 6)).astype(np.float32)
+    out, fin = m(paddle.to_tensor(x))
+    assert out.shape == [3, 7, 16]  # D * hidden
+    fins = fin if comps == 2 else (fin,)
+    for f in fins:
+        assert f.shape == [4, 3, 8]  # L*D rows
+    # layer-0 forward direction of the packed state == running layer 0 alone
+    l0 = m._layers_list[0]
+    _, (f0, _) = l0(paddle.to_tensor(x))
+    got = fins[0].numpy()[0]
+    want = (f0[0] if comps == 2 else f0).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_lstm_numeric_grads():
+    """BPTT gradients through the scan match finite differences."""
+    paddle.seed(9)
+    m = nn.LSTM(3, 4)
+    rng = np.random.default_rng(10)
+    x = rng.standard_normal((2, 4, 3)).astype(np.float32)
+
+    def loss_of(xv):
+        xt = paddle.to_tensor(xv.astype(np.float32))
+        xt.stop_gradient = False
+        out, _ = m(xt)
+        return out.square().sum(), xt
+
+    loss, xt = loss_of(x)
+    loss.backward()
+    g = xt.grad.numpy()
+    eps = 1e-3
+    for idx in [(0, 0, 0), (1, 2, 1), (0, 3, 2)]:
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        fd = (float(loss_of(xp)[0]) - float(loss_of(xm)[0])) / (2 * eps)
+        np.testing.assert_allclose(g[idx], fd, rtol=2e-2, atol=2e-3)
+
+    # param grads exist and are finite for every cell parameter
+    for p in m.parameters():
+        assert p.grad is not None
+        assert np.isfinite(p.grad.numpy()).all()
+
+
+def test_gru_trains_in_jitted_step():
+    """A GRU classifier learns a parity-style task inside @to_static."""
+    paddle.seed(10)
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((64, 6, 4)).astype(np.float32)
+    y = (x[:, :, 0].sum(1) > 0).astype(np.int64)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.rnn = nn.GRU(4, 16)
+            self.fc = nn.Linear(16, 2)
+
+        def forward(self, xv):
+            _, h = self.rnn(xv)
+            return self.fc(h[-1])
+
+    net = Net()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    @paddle.jit.to_static
+    def step(xb, yb):
+        loss = loss_fn(net(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+              for _ in range(30)]
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
+def test_attr_false_creates_frozen_params_and_proj_size_raises():
+    """attr=False keeps the parameter but freezes it (reference
+    rnn.py:777-840: Constant(1.0) weights / zero biases), so forward math
+    and state_dict keys survive; proj_size raises instead of silently
+    computing unprojected states."""
+    cell = nn.SimpleRNNCell(3, 4, weight_ih_attr=False, bias_ih_attr=False)
+    assert cell.weight_ih.stop_gradient and cell.bias_ih.stop_gradient
+    np.testing.assert_allclose(cell.weight_ih.numpy(), 1.0)
+    np.testing.assert_allclose(cell.bias_ih.numpy(), 0.0)
+    x = np.ones((2, 3), np.float32)
+    out, _ = cell(paddle.to_tensor(x))  # must not crash
+    want = np_simple_cell(x, np.zeros((2, 4), np.float32),
+                          *_cell_weights(cell))
+    np.testing.assert_allclose(out.numpy(), want, atol=1e-5)
+    assert set(cell.state_dict().keys()) == {
+        "weight_ih", "weight_hh", "bias_ih", "bias_hh"}
+
+    with pytest.raises(NotImplementedError):
+        nn.LSTM(4, 8, proj_size=2)
+    with pytest.raises(NotImplementedError):
+        nn.LSTMCell(4, 8, proj_size=2)
+
+
+def test_lstm_initial_states_and_dropout_smoke():
+    paddle.seed(11)
+    m = nn.LSTM(3, 4, num_layers=2, dropout=0.5)
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    h0 = rng.standard_normal((2, 2, 4)).astype(np.float32)
+    c0 = rng.standard_normal((2, 2, 4)).astype(np.float32)
+    out, (h, c) = m(paddle.to_tensor(x),
+                    (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    assert out.shape == [2, 5, 4] and h.shape == [2, 2, 4]
+    m.eval()
+    out_e, _ = m(paddle.to_tensor(x),
+                 (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    out_e2, _ = m(paddle.to_tensor(x),
+                  (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    np.testing.assert_allclose(out_e.numpy(), out_e2.numpy())  # no dropout
