@@ -2,18 +2,21 @@
 sampling decode driving the reference models; the deploy analog of the
 training forward).
 
-TPU design: ONE compiled program serves the whole decode for dense models.
-The token buffer is padded to its final length up front (prompt +
-max_new_tokens); causal attention guarantees positions past the current
-length cannot influence the position being read, so the step function
-(buffer, pos) -> next-token logits has fully static shapes. The compiled
-step is cached on the model keyed by (batch, total), so repeated generate()
-calls reuse it.
+TPU design: the ENTIRE decode is ONE compiled program for dense models —
+a `lax.while_loop` over emit positions inside a single traced function
+(`_decode_fn`): each iteration runs the model forward on the static padded
+buffer (prompt + max_new_tokens; causal attention guarantees positions past
+the current length cannot influence the position being read), samples the
+next token ON DEVICE (temperature / top-k / gumbel with a threaded PRNG
+key), applies eos bookkeeping, and writes the token back with a dynamic
+update. All-rows-finished exits the loop early on device. No host↔device
+round trip per token, no per-length recompiles — the compiled loop is
+cached on the model keyed by the static decode config.
 
 MoE models are the exception: capacity routing is NOT causal — padding
 tokens compete for expert capacity and can evict real tokens of other batch
-rows — so models containing a MoELayer decode with exact-length slices
-(one compile per emitted length; correct by construction).
+rows — so models containing a MoELayer decode host-side with exact-length
+slices (one compile per emitted length; correct by construction).
 """
 
 from __future__ import annotations
@@ -33,29 +36,112 @@ def _contains_moe(model) -> bool:
                for _, sub in model.named_sublayers(include_self=True))
 
 
-def _gen_step(model):
-    """Compiled (buffer, pos) -> [B, V] last-token logits, cached on the
-    model so repeated generate() calls skip retrace/recompile (shape
-    specialization is to_static's signature cache, not ours)."""
+def _decode_fn(model, total, do_sample, top_k, has_eos):
+    """One compiled whole-decode loop, cached per static config. Signature:
+    (buffer [B,total] i64, start [B] i64, key [2] u32, temp f32, eos i64)
+    -> filled buffer. Shape specialization (batch) is to_static's cache."""
+    import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
+    from ..core.tensor import Tensor
+    from ..autograd.grad_mode import no_grad
 
-    cached = getattr(model, "_gen_step", None)
-    if cached is not None:
-        return cached
+    cache = getattr(model, "_decode_fns", None)
+    if cache is None:
+        cache = model._decode_fns = {}
+    cfg = (total, do_sample, top_k, has_eos)
+    if cfg in cache:
+        return cache[cfg]
 
     @paddle.jit.to_static
-    def next_logits(buffer, pos):
-        with paddle.no_grad():
-            logits = model(buffer)
-        from ..autograd.function import apply
-        return apply(
-            lambda lg, p: jnp.take_along_axis(
-                lg, p.reshape(-1, 1, 1).astype(jnp.int32), axis=1)[:, 0, :],
-            logits, pos, name="gather_last_logits")
+    def decode(buffer, start, key, temp, eos):
+        def f(buf, start_a, key_a, temp_a, eos_a):
+            b = buf.shape[0]
+            s0 = start_a.reshape(())
 
-    model._gen_step = next_logits
-    return next_logits
+            def cond(c):
+                i, _, fin = c
+                return (i < total) & ~jnp.all(fin)
+
+            def body(c):
+                i, buf, fin = c
+                with no_grad():
+                    logits = model(Tensor(buf))
+                if isinstance(logits, tuple):
+                    logits = logits[0]
+                lg = logits._data
+                last = jnp.take_along_axis(
+                    lg, jnp.full((b, 1, 1), 0, jnp.int32) + (i - 1)
+                    .astype(jnp.int32), axis=1)[:, 0, :]
+                arr = last.astype(jnp.float32)
+                if do_sample:
+                    arr = arr / jnp.maximum(temp_a, 1e-6)
+                    if top_k is not None and top_k < arr.shape[-1]:
+                        kth = jax.lax.top_k(arr, top_k)[0][:, -1:]
+                        arr = jnp.where(arr < kth, -jnp.inf, arr)
+                    g = jax.random.gumbel(
+                        jax.random.fold_in(key_a, i.astype(jnp.uint32)),
+                        arr.shape)
+                    nxt = jnp.argmax(arr + g, axis=-1).astype(jnp.int64)
+                else:
+                    nxt = jnp.argmax(arr, axis=-1).astype(jnp.int64)
+                if has_eos:
+                    nxt = jnp.where(fin, eos_a, nxt)
+                    fin = fin | (nxt == eos_a)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, nxt[:, None], (jnp.int64(0), i))
+                return i + 1, buf, fin
+
+            fin0 = jnp.zeros((b,), jnp.bool_)
+            i_f, buf_f, _ = jax.lax.while_loop(
+                cond, body, (s0, buf, fin0))
+            if has_eos:
+                # tail after an all-finished early exit is eos-padded
+                pos = jnp.arange(total, dtype=jnp.int64)[None, :]
+                buf_f = jnp.where(pos >= i_f, eos_a, buf_f)
+            return buf_f
+
+        from ..autograd.function import apply
+        return apply(lambda *a: f(*a), buffer, start, key, temp, eos,
+                     name="decode_loop")
+
+    cache[cfg] = decode
+    return decode
+
+
+def _generate_moe_hostloop(model, buf, s, total, temperature, top_k,
+                           do_sample, eos_token_id, key):
+    """Exact-length host loop for MoE models (non-causal capacity
+    routing); one compile per emitted length."""
+    import jax
+    import paddle_tpu as paddle
+    b = buf.shape[0]
+    finished = np.zeros(b, dtype=bool)
+    for i in range(s, total):
+        feed = buf[:, :i]
+        with paddle.no_grad():
+            logits = model(paddle.to_tensor(feed))
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        arr = np.asarray(logits.numpy())[:, -1, :].astype(np.float64)
+        if do_sample:
+            arr = arr / max(temperature, 1e-6)
+            if top_k is not None and top_k < arr.shape[-1]:
+                kth = np.sort(arr, axis=-1)[:, -top_k][:, None]
+                arr = np.where(arr < kth, -np.inf, arr)
+            key, sub = jax.random.split(key)
+            gumbel = np.asarray(jax.random.gumbel(sub, arr.shape))
+            nxt = (arr + gumbel).argmax(-1)
+        else:
+            nxt = arr.argmax(-1)
+        if eos_token_id is not None:
+            nxt = np.where(finished, eos_token_id, nxt)
+            finished |= nxt == eos_token_id
+        buf[:, i] = nxt
+        if eos_token_id is not None and finished.all():
+            buf[:, i + 1:] = eos_token_id
+            break
+    return buf
 
 
 def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
@@ -81,39 +167,27 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
     buf = np.zeros((b, total), dtype=np.int64)
     buf[:, :s] = ids
 
-    exact_slices = _contains_moe(model)
-    step_fn = _gen_step(model)
-
-    was_training = getattr(model, "training", False)
-    model.eval()
     # seed=None still avoids wall-clock entropy (TPU-reproducible runs):
     # a process-level counter makes unseeded calls differ from each other
     key = jax.random.PRNGKey(seed if seed is not None
                              else next(_seed_counter))
-    finished = np.zeros(b, dtype=bool)
+
+    was_training = getattr(model, "training", False)
+    model.eval()
     try:
-        for i in range(s, total):
-            feed = buf[:, :i] if exact_slices else buf
-            pos = paddle.to_tensor(np.full((b,), i - 1, dtype=np.int64))
-            lg = step_fn(paddle.to_tensor(feed), pos)
-            arr = np.asarray(lg.numpy()).astype(np.float64)  # [B, V]
-            if do_sample:
-                arr = arr / max(temperature, 1e-6)
-                if top_k is not None and top_k < arr.shape[-1]:
-                    kth = np.sort(arr, axis=-1)[:, -top_k][:, None]
-                    arr = np.where(arr < kth, -np.inf, arr)
-                key, sub = jax.random.split(key)
-                gumbel = np.asarray(jax.random.gumbel(sub, arr.shape))
-                nxt = (arr + gumbel).argmax(-1)
-            else:
-                nxt = arr.argmax(-1)
-            if eos_token_id is not None:
-                nxt = np.where(finished, eos_token_id, nxt)
-                finished |= nxt == eos_token_id
-            buf[:, i] = nxt
-            if eos_token_id is not None and finished.all():
-                buf[:, i + 1:] = eos_token_id
-                break
+        if _contains_moe(model):
+            buf = _generate_moe_hostloop(model, buf, s, total, temperature,
+                                         top_k, do_sample, eos_token_id, key)
+        else:
+            fn = _decode_fn(model, total, bool(do_sample), top_k,
+                            eos_token_id is not None)
+            out = fn(paddle.to_tensor(buf),
+                     paddle.to_tensor(np.full((1,), s, np.int64)),
+                     paddle.to_tensor(np.asarray(key)),
+                     paddle.to_tensor(np.float32(temperature)),
+                     paddle.to_tensor(np.int64(
+                         eos_token_id if eos_token_id is not None else -1)))
+            buf = np.asarray(out.numpy()).astype(np.int64)
     finally:
         if was_training:
             model.train()

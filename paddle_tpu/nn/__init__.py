@@ -12,5 +12,6 @@ from .layers.loss import *  # noqa: F401,F403
 from .layers.transformer import *  # noqa: F401,F403
 from .layers.pooling import *  # noqa: F401,F403
 from .layers.rnn import *  # noqa: F401,F403
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters  # noqa: F401

@@ -1,0 +1,310 @@
+"""Decoding API: BeamSearchDecoder + dynamic_decode (reference:
+python/paddle/nn/decode.py — Decoder :60, BeamSearchDecoder :153,
+dynamic_decode :994).
+
+TPU-native redesign: the reference drives `decoder.step` from an imperative
+Python loop (`_dynamic_decode_imperative`, decode.py:686) growing Python
+lists. Here step 0 runs once to discover the output structure, then the
+remaining steps run inside ONE `lax.while_loop` with preallocated
+[T, ...] output buffers. In eager mode the loop exits early once all rows
+finish and the result is sliced to the actually-decoded length (matching
+the reference's dynamic output length); under `jit`/`to_static` the
+compiled loop runs all T steps — all-finished beam search is a fixed point
+(finished beams re-emit end_token with parent=identity), so the tail steps
+are exact rather than zero-garbage that would corrupt gather_tree's
+backtrace. Decoding is a no-grad path (the reference's beam top-k has no
+gradient either).
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..autograd.grad_mode import no_grad
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+_KINF = 1e9
+
+
+def _arr(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _is_tensor(v):
+    return isinstance(v, Tensor)
+
+
+def _flatten(struct):
+    return jax.tree_util.tree_flatten(struct, is_leaf=_is_tensor)
+
+
+def _to_arrays(flat):
+    return [_arr(t) for t in flat]
+
+
+def _wrap(tdef, arrays):
+    return jax.tree_util.tree_unflatten(tdef, [Tensor(a) for a in arrays])
+
+
+class Decoder:
+    """Abstract decode-step interface (reference decode.py:60)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a step cell (reference decode.py:153). The cell's
+    inputs/states ride merged [batch*beam, ...] shapes through the cell and
+    split back to [batch, beam, ...] for scoring."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] with each row repeated beam times
+        (reference decode.py:478) — for tensors used inside `cell.call`
+        such as attention memory."""
+        a = _arr(x)
+        return Tensor(jnp.repeat(a, beam_size, axis=0))
+
+    def _expand(self, t):
+        a = _arr(t)
+        return jnp.repeat(a[:, None], self.beam_size, axis=1)
+
+    def _merge(self, a):
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split(self, a):
+        return a.reshape((-1, self.beam_size) + a.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        flat, tdef = _flatten(initial_cell_states)
+        batch = _arr(flat[0]).shape[0]
+        cell_states = jax.tree_util.tree_unflatten(
+            tdef, [Tensor(self._expand(t)) for t in flat])
+        init_ids = jnp.full((batch, self.beam_size), self.start_token,
+                            jnp.int64)
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-_KINF] * (self.beam_size - 1)],
+                        jnp.float32), (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), jnp.bool_)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int64)
+        inputs = Tensor(init_ids)
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        state = self.StateWrapper(cell_states, Tensor(log_probs),
+                                  Tensor(finished), Tensor(lengths))
+        return inputs, state, Tensor(finished)
+
+    def _beam_search_step(self, time, logits, next_cell_states, beam_state):
+        lg = _arr(logits).astype(jnp.float32)       # [B, K, V]
+        b, k, v = lg.shape
+        step_lp = jax.nn.log_softmax(lg, axis=-1)
+        # finished beams may only extend with end_token (score 0)
+        noend = jnp.full((v,), -_KINF, jnp.float32).at[self.end_token].set(0.0)
+        fin = _arr(beam_state.finished)
+        step_lp = jnp.where(fin[:, :, None], noend[None, None, :], step_lp)
+        log_probs = step_lp + _arr(beam_state.log_probs)[:, :, None]
+        scores = log_probs.reshape(b, k * v)
+        topk_scores, topk_idx = jax.lax.top_k(scores, k)
+        beam_idx = (topk_idx // v).astype(jnp.int64)     # [B, K]
+        token_idx = (topk_idx % v).astype(jnp.int64)
+        b_rows = jnp.arange(b)[:, None]
+        next_lp = scores[b_rows, topk_idx]
+
+        def regather(t):
+            return Tensor(_arr(t)[b_rows, beam_idx])
+
+        cell_states = jax.tree_util.tree_map(
+            regather, next_cell_states, is_leaf=_is_tensor)
+        next_fin = fin[b_rows, beam_idx]
+        next_len = _arr(beam_state.lengths)[b_rows, beam_idx]
+        next_len = next_len + (~next_fin).astype(jnp.int64)
+        next_fin = next_fin | (token_idx == self.end_token)
+
+        out = self.OutputWrapper(Tensor(topk_scores), Tensor(token_idx),
+                                 Tensor(beam_idx))
+        st = self.StateWrapper(cell_states, Tensor(next_lp),
+                               Tensor(next_fin), Tensor(next_len))
+        return out, st
+
+    def step(self, time, inputs, states, **kwargs):
+        merged_in = jax.tree_util.tree_map(
+            lambda t: Tensor(self._merge(_arr(t))), inputs,
+            is_leaf=_is_tensor)
+        merged_states = jax.tree_util.tree_map(
+            lambda t: Tensor(self._merge(_arr(t))), states.cell_states,
+            is_leaf=_is_tensor)
+        cell_out, next_cell_states = self.cell(merged_in, merged_states,
+                                               **kwargs)
+        cell_out = jax.tree_util.tree_map(
+            lambda t: Tensor(self._split(_arr(t))), cell_out,
+            is_leaf=_is_tensor)
+        next_cell_states = jax.tree_util.tree_map(
+            lambda t: Tensor(self._split(_arr(t))), next_cell_states,
+            is_leaf=_is_tensor)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        out, st = self._beam_search_step(time, cell_out, next_cell_states,
+                                         states)
+        ids = out.predicted_ids
+        next_inputs = self.embedding_fn(ids) if self.embedding_fn else ids
+        return out, st, next_inputs, st.finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        from . import functional as F
+        predicted_ids = F.gather_tree(outputs.predicted_ids,
+                                      outputs.parent_ids)
+        return predicted_ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Repeat `decoder.step` until all finished or max_step_num is reached
+    (reference decode.py:994; runs max_step_num + 1 steps like the
+    reference's `step_idx > max_step_num` break)."""
+    if max_step_num is None:
+        raise ValueError(
+            "dynamic_decode requires max_step_num on this backend: the "
+            "compiled decode preallocates [T, ...] output buffers")
+    t_total = int(max_step_num) + 1
+
+    with no_grad():
+        inputs, states, finished = decoder.initialize(inits)
+        seq_len0 = jnp.zeros_like(_arr(finished), jnp.int64)
+
+        # step 0 outside the loop discovers the output structure
+        out0, states, inputs, next_fin = decoder.step(
+            Tensor(jnp.zeros((1,), jnp.int64)), inputs, states, **kwargs)
+        if decoder.tracks_own_finished:
+            finished = next_fin
+            seq_len = getattr(states, "lengths", None)
+            seq_len = _arr(seq_len) if seq_len is not None else seq_len0
+        else:
+            finished = Tensor(_arr(next_fin) | _arr(finished))
+            # reference decode.py:728: += ~finished AFTER the or-update
+            seq_len = seq_len0 + (~_arr(finished)).astype(jnp.int64)
+
+        out_flat0, out_def = _flatten(out0)
+        out_arr0 = _to_arrays(out_flat0)
+        bufs = tuple(
+            jnp.zeros((t_total,) + a.shape, a.dtype).at[0].set(a)
+            for a in out_arr0)
+
+        st_flat0, st_def = _flatten(states)
+        in_flat0, in_def = _flatten(inputs)
+
+        def pack(t, inputs_a, states_a, fin_a, slen, bufs):
+            return (jnp.asarray(t, jnp.int64), tuple(inputs_a),
+                    tuple(states_a), fin_a, slen, bufs)
+
+        carry0 = pack(1, _to_arrays(in_flat0), _to_arrays(st_flat0),
+                      _arr(finished), seq_len, bufs)
+
+        traced = any(isinstance(a, jax.core.Tracer)
+                     for a in (_arr(finished),) + tuple(_to_arrays(st_flat0)))
+
+        def cond_fn(c):
+            t, _, _, fin, _, _ = c
+            if traced:
+                # compiled path runs ALL steps: with static [T, ...] buffers
+                # an early exit would leave a zero-filled tail that corrupts
+                # finalize (gather_tree backtracks through zero parent_ids).
+                # All-finished decoding is a fixed point — finished beams
+                # re-emit end_token with parent=identity and unchanged
+                # scores/lengths — so the extra steps are exact, and eos
+                # masking inside decoder.step keeps them cheap for XLA.
+                return t < t_total
+            return (t < t_total) & ~jnp.all(fin)
+
+        def body_fn(c):
+            t, in_a, st_a, fin, slen, bufs = c
+            states_t = _wrap(st_def, st_a)
+            inputs_t = _wrap(in_def, in_a)
+            out, nstates, ninputs, nfin = decoder.step(
+                Tensor(t.reshape(1)), inputs_t, states_t, **kwargs)
+            if decoder.tracks_own_finished:
+                fin2 = _arr(nfin)
+                nlen = getattr(nstates, "lengths", None)
+                slen2 = _arr(nlen) if nlen is not None else slen
+            else:
+                fin2 = _arr(nfin) | fin
+                slen2 = slen + (~fin2).astype(jnp.int64)
+                if impute_finished:  # keep old states for finished rows
+                    old_flat, _ = _flatten(states_t)
+                    new_flat, ndef = _flatten(nstates)
+                    kept = []
+                    for o, n in zip(old_flat, new_flat):
+                        oa, na = _arr(o), _arr(n)
+                        m = fin.reshape(fin.shape + (1,) * (na.ndim - fin.ndim))
+                        kept.append(jnp.where(m, oa, na))
+                    nstates = _wrap(ndef, kept)
+            o_flat, _ = _flatten(out)
+            o_arr = _to_arrays(o_flat)
+            bufs2 = tuple(
+                jax.lax.dynamic_update_index_in_dim(bf, a, t, 0)
+                for bf, a in zip(bufs, o_arr))
+            n_flat, _ = _flatten(nstates)
+            i_flat, _ = _flatten(ninputs)
+            return pack(t + 1, _to_arrays(i_flat), _to_arrays(n_flat),
+                        fin2, slen2, bufs2)
+
+        t_f, _, st_f, fin_f, slen_f, bufs_f = jax.lax.while_loop(
+            cond_fn, body_fn, carry0)
+
+        concrete = not isinstance(t_f, jax.core.Tracer)
+        if concrete:  # eager: slice to the actually-decoded length
+            n = int(t_f)
+            bufs_f = tuple(b[:n] for b in bufs_f)
+        outputs = _wrap(out_def, bufs_f)          # time-major [T, ...]
+        final_states = _wrap(st_def, st_f)
+        seq_lengths = Tensor(slen_f)
+
+        try:
+            outputs, final_states = decoder.finalize(outputs, final_states,
+                                                     seq_lengths)
+        except NotImplementedError:
+            pass
+
+        if not output_time_major:
+            outputs = jax.tree_util.tree_map(
+                lambda t: Tensor(jnp.swapaxes(_arr(t), 0, 1)), outputs,
+                is_leaf=_is_tensor)
+
+    if return_length:
+        return outputs, final_states, seq_lengths
+    return outputs, final_states

@@ -35,6 +35,7 @@ __all__ = list(_act_all) + list(_loss_all) + list(_conv_all) + list(_pool_all) +
     "scaled_dot_product_attention", "sparse_attention", "interpolate",
     "upsample", "pixel_shuffle",
     "unfold", "label_smooth", "sequence_mask", "gumbel_softmax", "rope",
+    "gather_tree",
 ]
 
 
@@ -552,3 +553,29 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None) -> Te
         out = jnp.stack(patches, axis=2)  # [N, C, k*k, OH, OW]
         return out.reshape(n, c * ks[0] * ks[1], oh * ow)
     return apply(f, x, name="unfold")
+
+
+def gather_tree(ids, parents, name=None) -> Tensor:
+    """Backtrace beam-search ancestry to full sequences (reference:
+    nn/functional/extension.py:135, gather_tree CUDA kernel). ids/parents:
+    [max_time, batch, beam]. Implemented as one reverse lax.scan — the
+    TPU-native form of the reference's per-timestep backtrack loop."""
+    def f(ids_a, par_a):
+        ids_i = ids_a.astype(jnp.int64)
+        par_i = par_a.astype(jnp.int64)
+        t, b, k = ids_i.shape
+        b_rows = jnp.arange(b)[:, None]
+
+        def back(beams, xs):
+            # beams: [B, K] beam index selecting step t's entries for each
+            # final beam; out[t] = ids[t][beams], next = parents[t][beams]
+            ids_t, par_t = xs
+            out_t = ids_t[b_rows, beams]
+            prev = par_t[b_rows, beams]
+            return prev, out_t
+
+        init = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int64)[None], (b, k))
+        _, outs = jax.lax.scan(back, init, (ids_i, par_i), reverse=True)
+        return outs
+
+    return apply(f, ids, parents, name="gather_tree")

@@ -18,10 +18,11 @@ from __future__ import annotations
 from ..jit.save_load import InputSpec  # noqa: F401
 from ..jit.save_load import load as _jit_load
 from ..jit.save_load import save as _jit_save
+from . import nn  # noqa: F401  (control flow: cond/while_loop/case/switch_case)
 
 __all__ = ["InputSpec", "Program", "default_main_program",
            "default_startup_program", "Executor", "save_inference_model",
-           "load_inference_model", "name_scope"]
+           "load_inference_model", "name_scope", "nn"]
 
 
 class Program:

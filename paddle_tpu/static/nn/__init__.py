@@ -1,0 +1,216 @@
+"""paddle.static.nn control-flow surface (reference:
+python/paddle/static/nn/control_flow.py — while_loop :609, case :767,
+switch_case :899, cond :1086; PIR control-flow dialect
+paddle/pir/dialect/control_flow/).
+
+TPU mapping: data-dependent control flow inside one compiled program rides
+`lax.cond` / `lax.while_loop` / `lax.switch` — the reference's
+ConditionalBlock/While ops have no analog because the jaxpr IS the program.
+Three regimes per API:
+
+- eager (concrete python/Tensor predicate): plain Python dispatch, exactly
+  the reference's dygraph behavior; autograd records only the taken branch.
+- traced + grad recording: both branches execute and the outputs are
+  selected elementwise (`jnp.where`) — the select's vjp routes cotangents
+  to the taken branch only, so gradients match cond semantics. (This is
+  also how JAX itself batches `lax.cond` under vmap.)
+- traced + no_grad (inference/decode): true `lax.cond`/`lax.switch` — one
+  branch executes on device.
+
+`while_loop` is `lax.while_loop` when traced (forward-only: XLA cannot
+reverse-differentiate a dynamic-trip-count loop; the reference's While op
+has the same restriction in practice) and a Python loop in eager mode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...autograd.function import apply
+from ...autograd.grad_mode import is_grad_enabled, no_grad
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, Tensor) and isinstance(x._data, jax.core.Tracer)
+
+
+def _pred_scalar(pred):
+    """Bool scalar array (traced or concrete) from a Tensor/bool pred."""
+    if isinstance(pred, Tensor):
+        return pred._data.reshape(()).astype(jnp.bool_)
+    return jnp.asarray(bool(pred))
+
+
+def _tree(vals, is_leaf=None):
+    return jax.tree_util.tree_flatten(
+        vals, is_leaf=is_leaf or (lambda v: isinstance(v, Tensor)))
+
+
+def _select_outputs(pred, t_out, f_out):
+    """Elementwise select between two same-structure branch outputs; runs
+    through `apply` so the select is differentiable to both branches."""
+    t_flat, t_def = _tree(t_out)
+    f_flat, f_def = _tree(f_out)
+    if t_def != f_def or len(t_flat) != len(f_flat):
+        raise ValueError("cond branches must return the same structure")
+    sel = []
+    for t, f in zip(t_flat, f_flat):
+        sel.append(apply(
+            lambda p, a, b: jnp.where(p.reshape(()).astype(bool), a, b),
+            pred if isinstance(pred, Tensor) else Tensor(_pred_scalar(pred)),
+            t, f, name="cond_select"))
+    return jax.tree_util.tree_unflatten(t_def, sel)
+
+
+def _lax_branches(pred, fns):
+    """Run one of `fns` under lax control flow; each fn is a nullary
+    closure over (possibly traced) Tensors whose body runs the normal
+    framework ops with grad recording off."""
+
+    def wrap(fn):
+        def run():
+            with no_grad():
+                out = fn()
+            flat, tdef = _tree(out)
+            return tdef, [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                          for t in flat]
+        return run
+
+    wrapped = [wrap(f) for f in fns]
+    # discover output structure from branch 0 (traced abstractly by lax)
+    tdef_box = []
+
+    def make_branch(i):
+        def branch(_):
+            tdef, arrs = wrapped[i]()
+            if not tdef_box:
+                tdef_box.append(tdef)
+            return tuple(arrs)
+        return branch
+
+    if len(fns) == 2:
+        arrs = jax.lax.cond(_pred_scalar(pred), make_branch(0),
+                            make_branch(1), operand=None)
+    else:
+        arrs = jax.lax.switch(pred, [make_branch(i) for i in range(len(fns))],
+                              None)
+    return jax.tree_util.tree_unflatten(
+        tdef_box[0], [Tensor(a) for a in arrs])
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Reference control_flow.py:1086. See module docstring for the three
+    execution regimes."""
+    if true_fn is None and false_fn is None:
+        raise TypeError("cond needs at least one of true_fn/false_fn")
+    true_fn = true_fn or (lambda: None)
+    false_fn = false_fn or (lambda: None)
+    if not _is_traced(pred):
+        taken = bool(pred.numpy() if isinstance(pred, Tensor) else pred)
+        return true_fn() if taken else false_fn()
+    if is_grad_enabled():
+        return _select_outputs(pred, true_fn(), false_fn())
+    return _lax_branches(pred, [true_fn, false_fn])
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Reference control_flow.py:609: repeat `body` while `cond` holds.
+    Traced operands compile to ONE `lax.while_loop` (forward-only);
+    concrete operands run the reference's eager Python loop."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+    loop_vars = list(loop_vars)
+    traced = any(_is_traced(v) for v in
+                 jax.tree_util.tree_leaves(
+                     loop_vars, is_leaf=lambda v: isinstance(v, Tensor)))
+    if not traced:
+        while bool(_as_bool(cond(*loop_vars))):
+            out = body(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) else [out]
+        return loop_vars
+
+    flat, tdef = _tree(loop_vars)
+    arrs = tuple(t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                 for t in flat)
+
+    def rebuild(arr_tuple):
+        return jax.tree_util.tree_unflatten(
+            tdef, [Tensor(a) for a in arr_tuple])
+
+    def cond_fn(arr_tuple):
+        with no_grad():
+            c = cond(*rebuild(arr_tuple))
+        return _pred_scalar(c) if isinstance(c, Tensor) else jnp.asarray(c)
+
+    def body_fn(arr_tuple):
+        with no_grad():
+            out = body(*rebuild(arr_tuple))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        o_flat, _ = _tree(out)
+        return tuple(t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                     for t in o_flat)
+
+    final = jax.lax.while_loop(cond_fn, body_fn, arrs)
+    return jax.tree_util.tree_unflatten(tdef, [Tensor(a) for a in final])
+
+
+def _as_bool(c):
+    return c.numpy() if isinstance(c, Tensor) else c
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference control_flow.py:767: run the fn of the FIRST true pred.
+    Builds a nested `cond` chain, so each regime (eager / select / lax)
+    follows cond's."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        # reference: the last fn acts as the default
+        (_, default), pairs = pairs[-1], pairs[:-1]
+
+    def build(i):
+        if i == len(pairs):
+            return default
+        pred, fn = pairs[i]
+        return lambda: cond(pred, fn, build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference control_flow.py:899: select a branch by integer index.
+    Traced + no_grad compiles to ONE `lax.switch`; otherwise falls back to
+    eager dispatch / differentiable selects via a cond chain."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+
+    if not _is_traced(branch_index):
+        idx = int(branch_index.numpy()
+                  if isinstance(branch_index, Tensor) else branch_index)
+        return dict(items).get(idx, default)()
+
+    idx_arr = branch_index._data.reshape(()).astype(jnp.int32)
+    if not is_grad_enabled() and keys == list(range(len(keys))):
+        # dense 0..n-1 keys: one lax.switch (out-of-range clamps to default)
+        in_range = (idx_arr >= 0) & (idx_arr < len(fns))
+        sel = jnp.where(in_range, jnp.clip(idx_arr, 0, len(fns) - 1),
+                        jnp.int32(len(fns)))
+        return _lax_branches(sel, fns + [default])
+
+    # sparse keys or grad recording: chain of conds
+    out_fn = default
+    for k, f in reversed(items):
+        out_fn = (lambda kk, ff, nxt: lambda: cond(
+            Tensor(idx_arr == jnp.int32(kk)), ff, nxt))(k, f, out_fn)
+    return out_fn()

@@ -1,0 +1,344 @@
+"""Control-flow API + beam-search decoding.
+
+Reference under test: python/paddle/static/nn/control_flow.py (cond :1086,
+while_loop :609, case :767, switch_case :899), python/paddle/nn/decode.py
+(BeamSearchDecoder :153, dynamic_decode :994), and
+nn/functional/extension.py gather_tree :135.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.static import nn as snn
+
+
+# ---------------------------------------------------------------- control flow
+
+def test_cond_eager_runs_single_branch():
+    calls = []
+
+    def t():
+        calls.append("t")
+        return paddle.to_tensor(np.float32(1.0))
+
+    def f():
+        calls.append("f")
+        return paddle.to_tensor(np.float32(2.0))
+
+    assert float(snn.cond(paddle.to_tensor(True), t, f)) == 1.0
+    assert calls == ["t"]  # false branch never ran eagerly
+
+
+def test_cond_traced_grad_routes_to_taken_branch():
+    @paddle.jit.to_static
+    def fn(a):
+        y = snn.cond(a.sum() > 0,
+                     lambda: (a * 2).sum(),
+                     lambda: (a * 3).sum())
+        y.backward()
+        return y, a.grad
+
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    a.stop_gradient = False
+    y, g = fn(a)
+    assert float(y) == 6.0
+    np.testing.assert_allclose(g.numpy(), [2.0, 2.0])
+
+    a2 = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    a2.stop_gradient = False
+    y2, g2 = fn(a2)
+    assert float(y2) == -9.0
+    np.testing.assert_allclose(g2.numpy(), [3.0, 3.0])
+
+
+def test_cond_traced_no_grad_uses_lax_cond():
+    """Under no_grad the traced path lowers to a real lax.cond — the HLO
+    carries a conditional, not two executed branches + select."""
+    import jax
+
+    def fn(a):
+        with paddle.no_grad():
+            r = snn.cond(a.sum() > 0, lambda: a * 2, lambda: a * 3)
+        return r._data
+
+    txt = jax.jit(lambda x: fn(paddle.Tensor(x))).lower(
+        np.ones((2,), np.float32)).as_text()
+    assert "case" in txt or "conditional" in txt, txt[:500]
+
+
+def test_cond_structure_mismatch_raises():
+    import jax
+
+    def fn(x):
+        a = paddle.Tensor(x)
+        r = snn.cond(a.sum() > 0, lambda: (a, a), lambda: a)
+        return r[0]._data
+
+    with pytest.raises(ValueError):
+        jax.jit(fn)(np.ones(2, np.float32))
+
+
+def test_while_loop_compiled_and_eager():
+    # eager: concrete python loop
+    i0 = paddle.to_tensor(np.int64(0))
+    s0 = paddle.to_tensor(np.int64(0))
+    iv, sv = snn.while_loop(lambda i, s: i < 5,
+                            lambda i, s: [i + 1, s + i], [i0, s0])
+    assert int(iv) == 5 and int(sv) == 10
+
+    # traced: ONE lax.while_loop inside a compiled program
+    @paddle.jit.to_static
+    def tri(n):
+        z = n * 0
+        _, s = snn.while_loop(lambda i, s: i < n,
+                              lambda i, s: [i + 1, s + i], [z, z])
+        return s
+
+    assert int(tri(paddle.to_tensor(np.int64(10)))) == 45
+    assert int(tri(paddle.to_tensor(np.int64(7)))) == 21  # data-dependent
+
+
+def test_case_and_switch_case():
+    x = paddle.to_tensor(np.float32(2.0))
+    r = snn.case([(paddle.to_tensor(False), lambda: x + 1),
+                  (paddle.to_tensor(True), lambda: x * 10)],
+                 default=lambda: x - 5)
+    assert float(r) == 20.0
+    r2 = snn.case([(paddle.to_tensor(False), lambda: x + 1),
+                   (paddle.to_tensor(False), lambda: x * 10)],
+                  default=lambda: x - 5)
+    assert float(r2) == -3.0
+
+    @paddle.jit.to_static
+    def sw(i, v):
+        with paddle.no_grad():
+            return snn.switch_case(
+                i, [lambda: v + 1, lambda: v * 10, lambda: v - 5])
+
+    assert float(sw(paddle.to_tensor(0), x)) == 3.0
+    assert float(sw(paddle.to_tensor(2), x)) == -3.0
+    assert float(sw(paddle.to_tensor(9), x)) == -3.0  # out of range -> default
+
+
+# ------------------------------------------------------------------ decoding
+
+def test_gather_tree_reference_example():
+    ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]],
+                   np.int64)
+    parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                        [[0, 0], [0, 1]]], np.int64)
+    want = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]],
+                    np.int64)
+    got = F.gather_tree(paddle.to_tensor(ids),
+                        paddle.to_tensor(parents)).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def _np_beam_search(step_logits_fn, h0, start, end, K, steps):
+    """Reference numpy beam search over a linear-GRU-free toy step model:
+    step_logits_fn(ids [N], h [N, H]) -> (logits [N, V], h' [N, H])."""
+    b, H = h0.shape
+    h = np.repeat(h0[:, None], K, 1)                    # [B, K, H]
+    log_p = np.tile(np.array([[0.0] + [-1e9] * (K - 1)], np.float32),
+                    (b, 1))
+    ids = np.full((b, K), start, np.int64)
+    finished = np.zeros((b, K), bool)
+    all_tokens, all_parents = [], []
+    for _ in range(steps):
+        lg, h_new = step_logits_fn(ids.reshape(-1),
+                                   h.reshape(b * K, H))
+        V = lg.shape[-1]
+        lg = lg.reshape(b, K, V)
+        h_new = h_new.reshape(b, K, H)
+        m = lg.max(-1, keepdims=True)
+        slp = (lg - m) - np.log(np.exp(lg - m).sum(-1, keepdims=True))
+        noend = np.full((V,), -1e9, np.float32)
+        noend[end] = 0.0
+        slp = np.where(finished[:, :, None], noend[None, None], slp)
+        total = slp + log_p[:, :, None]
+        flat = total.reshape(b, K * V)
+        topk = np.argsort(-flat, axis=-1, kind="stable")[:, :K]
+        rows = np.arange(b)[:, None]
+        log_p = flat[rows, topk]
+        beam = topk // V
+        tok = topk % V
+        h = h_new[rows, beam]
+        finished = finished[rows, beam]
+        finished = finished | (tok == end)
+        ids = tok
+        all_tokens.append(tok)
+        all_parents.append(beam)
+    return np.stack(all_tokens), np.stack(all_parents)
+
+
+def test_beam_search_matches_numpy_reference():
+    """BeamSearchDecoder + dynamic_decode reproduce an independent numpy
+    beam search (same cell weights) for the whole decode."""
+    paddle.seed(21)
+    V, E, H, K = 11, 8, 8, 3
+    emb = nn.Embedding(V, E)
+    cell = nn.GRUCell(E, H)
+    out_l = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1, beam_size=K,
+                               embedding_fn=emb, output_fn=out_l)
+    enc = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, H)).astype(np.float32))
+    outs, states = nn.dynamic_decode(
+        dec, inits=cell.get_initial_states(enc), max_step_num=5)
+
+    def np_step(ids, h):
+        e = emb.weight.numpy()[ids]
+        lg, h2 = cell(paddle.to_tensor(e.astype(np.float32)),
+                      paddle.to_tensor(h.astype(np.float32)))
+        logits = lg.numpy() @ out_l.weight.numpy() + out_l.bias.numpy()
+        return logits, h2.numpy()
+
+    toks, parents = _np_beam_search(np_step, np.zeros((2, H), np.float32),
+                                    0, 1, K, steps=outs.shape[1])
+    want = F.gather_tree(paddle.to_tensor(toks),
+                         paddle.to_tensor(parents)).numpy()
+    got = np.swapaxes(outs.numpy(), 0, 1)  # back to time-major
+    np.testing.assert_array_equal(got, want)
+
+
+def test_dynamic_decode_compiled_one_program():
+    """The whole beam decode runs as ONE compiled program under
+    to_static (traced lax.while_loop, static output buffers)."""
+    paddle.seed(22)
+    V, E, H, K = 9, 6, 6, 2
+    emb = nn.Embedding(V, E)
+    cell = nn.GRUCell(E, H)
+    out_l = nn.Linear(H, V)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1, beam_size=K,
+                               embedding_fn=emb, output_fn=out_l)
+
+    @paddle.jit.to_static
+    def run(enc):
+        outs, _ = nn.dynamic_decode(
+            dec, inits=cell.get_initial_states(enc), max_step_num=4)
+        return outs
+
+    enc = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((2, H)).astype(np.float32))
+    compiled = run(enc).numpy()
+    eager_outs, _ = nn.dynamic_decode(
+        dec, inits=cell.get_initial_states(enc), max_step_num=4)
+    eager = eager_outs.numpy()
+    # compiled buffer keeps the static T; eager slices to decoded length
+    np.testing.assert_array_equal(compiled[:, :eager.shape[1]], eager)
+
+
+def test_dynamic_decode_early_stop_and_lengths():
+    """A cell rigged to always emit end_token finishes in one step; lengths
+    reflect it; return_length returns the per-beam lengths."""
+    paddle.seed(23)
+    V, E, H, K = 5, 4, 4, 2
+
+    class RiggedCell(nn.GRUCell):
+        def forward(self, inputs, states=None):
+            out, st = super().forward(inputs, states)
+            return out, st
+
+    emb = nn.Embedding(V, E)
+    cell = RiggedCell(E, H)
+    bias = np.zeros(V, np.float32)
+    bias[1] = 100.0  # end token dominates
+
+    class Out(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter([H, V])
+
+        def forward(self, x):
+            return x.matmul(self.w) + paddle.to_tensor(bias)
+
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1, beam_size=K,
+                               embedding_fn=emb, output_fn=Out())
+    enc = paddle.to_tensor(np.zeros((2, H), np.float32))
+    outs, states, lengths = nn.dynamic_decode(
+        dec, inits=cell.get_initial_states(enc), max_step_num=8,
+        return_length=True)
+    # beam 0 emits eos at step 0; beam 1 keeps its second-best path one
+    # more step then emits eos — early exit after 2 of the 9 allowed steps
+    assert outs.shape[1] == 2
+    assert (outs.numpy()[:, -1, :] == 1).all()  # every beam ends on eos
+    assert lengths.numpy().max() == 2 and lengths.numpy().min() >= 1
+
+
+def test_dynamic_decode_traced_early_finish_tail_is_exact():
+    """Regression: under tracing the compiled loop cannot early-exit with
+    static buffers — the tail must be the beam fixed point (eos with
+    parent=identity), NOT zero garbage that corrupts gather_tree. An
+    eos-rigged cell finishing at step 0 must decode identically compiled
+    vs eager on the eager-length prefix, with an all-eos compiled tail."""
+    paddle.seed(25)
+    V, E, H, K = 5, 4, 4, 2
+    emb = nn.Embedding(V, E)
+    cell = nn.GRUCell(E, H)
+    bias = np.zeros(V, np.float32)
+    bias[1] = 100.0
+
+    class Out(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter([H, V])
+
+        def forward(self, x):
+            return x.matmul(self.w) + paddle.to_tensor(bias)
+
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1, beam_size=K,
+                               embedding_fn=emb, output_fn=Out())
+
+    @paddle.jit.to_static
+    def run(enc):
+        outs, _ = nn.dynamic_decode(dec, inits=cell.get_initial_states(enc),
+                                    max_step_num=8)
+        return outs
+
+    enc = paddle.to_tensor(np.zeros((2, H), np.float32))
+    compiled = run(enc).numpy()                 # [B, 9, K]
+    eager, _ = nn.dynamic_decode(dec, inits=cell.get_initial_states(enc),
+                                 max_step_num=8)
+    eager = eager.numpy()                       # [B, ~2, K]
+    np.testing.assert_array_equal(compiled[:, :eager.shape[1]], eager)
+    assert (compiled[:, eager.shape[1]:] == 1).all()  # eos fixed point
+
+
+def test_dynamic_decode_lengths_match_reference_semantics():
+    """tracks_own_finished=False: lengths increment once per executed step
+    for rows still unfinished after the or-update (reference decode.py:728)
+    — a never-finishing decoder reports exactly the step count."""
+
+    class NeverDone(nn.Decoder):
+        def initialize(self, inits):
+            z = paddle.to_tensor(np.zeros((2, 3), np.float32))
+            fin = paddle.to_tensor(np.zeros((2,), bool))
+            return z, z, fin
+
+        def step(self, time, inputs, states, **kw):
+            fin = paddle.to_tensor(np.zeros((2,), bool))
+            return inputs, states, inputs, fin
+
+    outs, states, lengths = nn.dynamic_decode(
+        NeverDone(), inits=None, max_step_num=4, return_length=True)
+    assert outs.shape[1] == 5  # max_step_num + 1 executed steps
+    np.testing.assert_array_equal(lengths.numpy(), [5, 5])
+
+
+def test_generate_compiled_loop_eos_padding():
+    """Dense-model generate(): the on-device loop pads the tail with eos
+    after an all-finished early exit (old host-loop contract preserved)."""
+    from paddle_tpu.models import GPT, GPTConfig
+    paddle.seed(24)
+    m = GPT(GPTConfig(vocab_size=32, max_position_embeddings=24,
+                      hidden_size=16, num_layers=1, num_heads=2))
+    prompt = np.array([[3, 4]], np.int64)
+    g = m.generate(paddle.to_tensor(prompt), max_new_tokens=8)
+    eos = int(g[0, 2])
+    e = m.generate(paddle.to_tensor(prompt), max_new_tokens=8,
+                   eos_token_id=eos)
+    assert (e[0, 2:] == eos).all()
+    np.testing.assert_array_equal(e[:, :2], prompt)
