@@ -112,7 +112,7 @@ from . import _generated as _g  # noqa: E402
 
 for _gname in _g.OP_REGISTRY:
     _meta = _g.OP_REGISTRY[_gname]
-    if _meta.get("manual"):
+    if _meta.get("manual") or _meta.get("category") == "shaped":
         continue  # hand-written elsewhere; YAML entry only drives tests
     for _n in (_gname, _meta.get("inplace")):
         if _n and _n not in _METHODS:

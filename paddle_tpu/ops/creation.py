@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import builtins
 import numpy as np
 import jax.numpy as jnp
 
@@ -14,6 +15,7 @@ __all__ = [
     "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
     "tril", "triu", "meshgrid", "assign", "clone", "tril_indices", "triu_indices",
     "one_hot", "complex",
+    'diag_embed',
 ]
 
 
@@ -177,3 +179,40 @@ def complex(real, imag, name=None) -> Tensor:
 
 def jax_complex(r, i):
     return r + 1j * i
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
+    """Batched diagonal embedding: last dim becomes the (dim1, dim2)
+    diagonal of a new matrix (reference nn/functional/extension.py
+    diag_embed)."""
+    xt = as_tensor(input)
+    nd = xt.ndim + 1
+    if dim1 % nd == dim2 % nd:
+        raise ValueError(
+            f"diag_embed: dim1 ({dim1}) and dim2 ({dim2}) must differ")
+
+    def f(a):
+        n = a.shape[-1] + builtins.abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + builtins.max(-offset, 0)
+        c = idx + builtins.max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        if (d1, d2) != (out.ndim - 2, out.ndim - 1):
+            perm = [i for i in range(out.ndim) if i not in
+                    (out.ndim - 2, out.ndim - 1)]
+            order = []
+            k = 0
+            for i in range(out.ndim):
+                if i == d1:
+                    order.append(out.ndim - 2)
+                elif i == d2:
+                    order.append(out.ndim - 1)
+                else:
+                    order.append(perm[k])
+                    k += 1
+            out = jnp.transpose(out, order)
+        return out
+    return apply(f, xt, name="diag_embed")

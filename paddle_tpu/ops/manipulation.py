@@ -22,6 +22,7 @@ __all__ = [
     "bucketize", "as_complex", "as_real", "view", "view_as", "getitem",
     "setitem_", "crop", "tensordot", "einsum", "tolist", "atleast_1d",
     "atleast_2d", "atleast_3d", "select_scatter", "diagonal_scatter",
+    'unflatten', 'vsplit', 'hsplit', 'dsplit', 'tensor_split', 'hstack', 'vstack', 'dstack', 'column_stack', 'row_stack', 'take', 'index_fill', 'index_sample', 'shard_index', 'as_strided', 'multiplex',
 ]
 
 
@@ -604,3 +605,170 @@ def setitem_(x, idx, value) -> Tensor:
     x._data, x._node, x._out_index = out._data, out._node, out._out_index
     x.stop_gradient = out.stop_gradient
     return x
+
+
+def unflatten(x, axis, shape, name=None) -> Tensor:
+    """Expand axis into `shape` (reference: python/paddle/tensor/
+    manipulation.py unflatten)."""
+    xt = as_tensor(x)
+    ax = axis % xt.ndim
+    new = tuple(xt.shape[:ax]) + tuple(shape) + tuple(xt.shape[ax + 1:])
+    return apply(lambda a: a.reshape(new), xt, name="unflatten")
+
+
+def _nsplit(x, num_or_indices, axis, name):
+    """v/h/dsplit semantics (reference manipulation.py): an int means N
+    EQUAL sections (raising when indivisible, via split); a list means
+    split INDICES (tensor_split semantics), not section sizes."""
+    if isinstance(num_or_indices, int):
+        return split(x, num_or_indices, axis=axis, name=name)
+    return tensor_split(x, num_or_indices, axis=axis, name=name)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _nsplit(x, num_or_indices, 0, name)
+
+
+def hsplit(x, num_or_indices, name=None):
+    xt = as_tensor(x)
+    return _nsplit(xt, num_or_indices, 0 if xt.ndim == 1 else 1, name)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _nsplit(x, num_or_indices, 2, name)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """numpy.array_split semantics: uneven splits allowed (reference
+    manipulation.py tensor_split)."""
+    xt = as_tensor(x)
+    n = xt.shape[axis % xt.ndim]
+    if isinstance(num_or_indices, int):
+        k = num_or_indices
+        base, extra = divmod(n, k)
+        sizes = [base + (1 if i < extra else 0) for i in range(k)]
+        bounds = np.cumsum(sizes)[:-1].tolist()
+    else:
+        bounds = list(num_or_indices)
+    outs = []
+    prev = 0
+    for b in bounds + [n]:
+        outs.append(apply(
+            (lambda p, q: lambda a: jax.lax.slice_in_dim(
+                a, p, q, axis=axis % a.ndim))(prev, b), xt,
+            name="tensor_split"))
+        prev = b
+    return outs
+
+
+def hstack(x, name=None) -> Tensor:
+    ts = [as_tensor(t) for t in x]
+    ax = 0 if ts[0].ndim == 1 else 1
+    return concat(ts, axis=ax, name=name)
+
+
+def vstack(x, name=None) -> Tensor:
+    return concat([atleast_2d(as_tensor(t)) for t in x], axis=0, name=name)
+
+
+def dstack(x, name=None) -> Tensor:
+    return concat([atleast_3d(as_tensor(t)) for t in x], axis=2, name=name)
+
+
+def column_stack(x, name=None) -> Tensor:
+    ts = [as_tensor(t) for t in x]
+    ts = [t if t.ndim > 1 else reshape(t, [-1, 1]) for t in ts]
+    return concat(ts, axis=1, name=name)
+
+
+def row_stack(x, name=None) -> Tensor:
+    return vstack(x, name=name)
+
+
+def take(x, index, mode="raise", name=None) -> Tensor:
+    """Flattened-index gather (reference math.py take): indices address
+    x.flatten(). mode='raise' supports negative (from-the-end) indices
+    (bounds are unchecked under jit), 'wrap' is modulo, 'clip' clamps to
+    [0, n-1] — negative indexing is disabled, matching the reference."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"take mode must be raise/wrap/clip, got {mode!r}")
+    xt, it = as_tensor(x), as_tensor(index)
+
+    def f(a, i):
+        flat = a.reshape(-1)
+        i = i.astype(jnp.int64)
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:
+            i = jnp.clip(i, -n, n - 1)
+            i = jnp.where(i < 0, i + n, i)
+        return jnp.take(flat, i)
+
+    return apply(f, xt, it, name="take")
+
+
+def index_fill(x, index, axis, value, name=None) -> Tensor:
+    """Fill rows of `axis` selected by index (reference index_fill)."""
+    xt, it = as_tensor(x), as_tensor(index)
+
+    def f(a, i):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[i].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(moved, 0, axis)
+
+    return apply(f, xt, it, name="index_fill")
+
+
+def index_sample(x, index, name=None) -> Tensor:
+    """Per-row gather: out[i, j] = x[i, index[i, j]] (reference
+    index_sample op)."""
+    return apply(lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int64), 1),
+                 as_tensor(x), as_tensor(index), name="index_sample")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None) -> Tensor:
+    """Recode global ids to shard-local ids (reference shard_index op:
+    ids outside this shard map to ignore_value)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(f"shard_id {shard_id} not in [0, {nshards})")
+    size = (index_num + nshards - 1) // nshards
+
+    def f(a):
+        shard = a // size
+        local = a % size
+        return jnp.where(shard == shard_id, local,
+                         jnp.asarray(ignore_value, a.dtype))
+
+    return apply(f, as_tensor(input), name="shard_index")
+
+
+def as_strided(x, shape, stride, offset=0, name=None) -> Tensor:
+    """Strided view (reference as_strided). Computed as an explicit index
+    gather — XLA has no aliasing views, so this materializes the result."""
+    xt = as_tensor(x)
+
+    def f(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(offset)
+        for dim, st in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(dim) * st
+        return jnp.take(flat, idx.reshape(shape))
+
+    return apply(f, xt, name="as_strided")
+
+
+def multiplex(inputs, index, name=None) -> Tensor:
+    """Row-wise select among candidate tensors (reference multiplex op):
+    out[i] = inputs[index[i]][i]."""
+    ts = [as_tensor(t) for t in inputs]
+
+    def f(i, *arrs):
+        stacked = jnp.stack(arrs, 0)          # [K, B, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[i.reshape(-1).astype(jnp.int64), rows]
+
+    return apply(f, as_tensor(index), *ts, name="multiplex")

@@ -26,7 +26,8 @@ from ._generated import (  # noqa: F401  (generated from ops.yaml)
     add, add_, subtract, subtract_, multiply, multiply_, divide, divide_,
     floor_divide, remainder, remainder_, pow, pow_, maximum, minimum, fmax,
     fmin, atan2, logaddexp, hypot, nextafter, heaviside, ldexp, kron, gcd,
-    lcm,
+    lcm, copysign, fmod, floor_mod, exp2, sgn, signbit, isneginf, isposinf,
+    i0e, i1e,
 )
 
 __all__ = [
@@ -45,6 +46,9 @@ __all__ = [
     # generated in-place variants (ops.yaml `inplace:` field)
     "abs_", "reciprocal_", "exp_", "log_", "sqrt_", "rsqrt_", "floor_",
     "ceil_", "round_", "trunc_", "divide_", "remainder_", "pow_",
+    'logcumsumexp', 'trace', 'renorm', 'vander', 'nanquantile', 'rank', 'shape',
+    "copysign", "fmod", "floor_mod", "exp2", "sgn", "signbit", "isneginf",
+    "isposinf", "i0e", "i1e",
 ]
 
 mod = remainder
@@ -222,3 +226,73 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None) -> Tensor:
                      name="trapezoid")
     return apply(lambda a: jnp.trapezoid(a, dx=1.0 if dx is None else dx, axis=axis),
                  y, name="trapezoid")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None) -> Tensor:
+    """Cumulative logsumexp (reference math.py logcumsumexp). Accumulates
+    in the input (or requested) dtype; half dtypes accumulate in float32
+    for stability and cast back."""
+    def f(a):
+        if dtype is not None:
+            a = a.astype(dtypes.dtype_from_any(dtype).np_dtype)
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        if a.dtype in (jnp.bfloat16, jnp.float16):
+            return jax.lax.cumlogsumexp(
+                arr.astype(jnp.float32), axis=ax).astype(a.dtype)
+        return jax.lax.cumlogsumexp(arr, axis=ax)
+    return apply(f, x, name="logcumsumexp")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None) -> Tensor:
+    """Sum of a diagonal (reference math.py trace)."""
+    return apply(lambda a: jnp.trace(a, offset, axis1, axis2), x,
+                 name="trace")
+
+
+def renorm(x, p, axis, max_norm, name=None) -> Tensor:
+    """Clamp each slice along `axis` to p-norm <= max_norm (reference
+    math.py renorm)."""
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * factor[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply(f, x, name="renorm")
+
+
+def vander(x, n=None, increasing=False, name=None) -> Tensor:
+    """Vandermonde matrix (reference math.py vander)."""
+    xt = as_tensor(x)
+    cols = xt.shape[0] if n is None else n
+
+    def f(a):
+        powers = jnp.arange(cols)
+        if not increasing:
+            powers = powers[::-1]
+        return a[:, None] ** powers[None, :].astype(a.dtype)
+    return apply(f, xt, name="vander")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None) -> Tensor:
+    """Quantile ignoring NaNs (reference stat.py nanquantile: the result
+    is float64 regardless of input dtype — integer inputs must not have
+    their interpolated quantiles truncated)."""
+    from .reduction import _axes
+    qv = q.item() if isinstance(q, Tensor) else q
+    return apply(lambda a: jnp.nanquantile(
+        a.astype(jnp.float64), jnp.asarray(qv), axis=_axes(axis),
+        keepdims=keepdim), x, name="nanquantile")
+
+
+def rank(input, name=None) -> Tensor:
+    """Number of dimensions as a 0-D int32 tensor (reference rank op)."""
+    return Tensor(jnp.asarray(as_tensor(input).ndim, jnp.int32))
+
+
+def shape(input, name=None) -> Tensor:
+    """Shape as a 1-D int32 tensor (reference shape op)."""
+    return Tensor(jnp.asarray(as_tensor(input).shape, jnp.int32))

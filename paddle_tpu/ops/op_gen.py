@@ -30,10 +30,14 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 YAML_PATH = os.path.join(_HERE, "ops.yaml")
 GENERATED_PATH = os.path.join(_HERE, "_generated.py")
 
-_CATEGORIES = ("unary", "binary", "compare_unary", "compare_binary")
-_MODULES = ("math", "activation", "logic")
+_CATEGORIES = ("unary", "binary", "compare_unary", "compare_binary",
+               "shaped")
+_MODULES = ("math", "activation", "logic", "manipulation", "reduction",
+            "creation", "linalg", "random")
 _DTYPES = ("float32", "float64", "bfloat16", "float16", "int32", "int64",
            "bool")
+_DTYPE_RULES = ("same", "bool", "int64", "int32", "promote", "float32",
+                "float64", "complex64")
 
 
 class OpSpec(dict):
@@ -77,8 +81,41 @@ def load_registry(path: str = YAML_PATH) -> list[OpSpec]:
                 raise ValueError(f"{e['op']}: bad dtype {dt!r}")
         if e.get("grad") and e["category"].startswith("compare"):
             raise ValueError(f"{e['op']}: compare ops are not differentiable")
+        if e["category"] == "shaped":
+            _validate_shaped(e)
         specs.append(OpSpec(e))
     return specs
+
+
+def _validate_shaped(e):
+    """Schema contract for shape-bearing ops (reference: each
+    paddle/phi/api/yaml/ops.yaml entry records args + infer_meta + kernel;
+    here: tensors + attrs + dtype_rule + shape_rule + test cases)."""
+    name = e["op"]
+    if "impl" not in e:
+        raise ValueError(f"{name}: shaped entries need impl")
+    if "tensors" not in e or not isinstance(e["tensors"], list):
+        raise ValueError(f"{name}: shaped entries need a tensors list "
+                         "(may be empty for creation ops)")
+    if e.get("dtype_rule", "same") not in _DTYPE_RULES:
+        raise ValueError(f"{name}: bad dtype_rule {e.get('dtype_rule')!r}")
+    cases = e.get("cases")
+    if not cases or not isinstance(cases, list):
+        raise ValueError(f"{name}: shaped entries need >=1 test case")
+    check = e.get("check", "ref")
+    if check not in ("ref", "props", "shape_only"):
+        raise ValueError(f"{name}: bad check mode {check!r}")
+    if check == "ref" and "np_ref" not in e:
+        raise ValueError(f"{name}: check=ref needs np_ref")
+    if check == "props" and "props" not in e:
+        raise ValueError(f"{name}: check=props needs a props expression")
+    for c in cases:
+        if not isinstance(c, dict):
+            raise ValueError(f"{name}: case entries must be dicts")
+        shapes = c.get("shapes", {})
+        missing = [t for t in e["tensors"] if t not in shapes]
+        if missing:
+            raise ValueError(f"{name}: case missing shapes for {missing}")
 
 
 def resolve_np_ref(spec: OpSpec):
@@ -166,7 +203,7 @@ def generate_source(specs: list[OpSpec] | None = None) -> str:
     parts = [_HEADER]
     names = []
     for s in specs:
-        if s.get("manual"):
+        if s.get("manual") or s["category"] == "shaped":
             # hand-written op: the YAML entry drives tests + the surface
             # check only; no stub is generated
             continue
@@ -198,12 +235,24 @@ def check_up_to_date(path: str = GENERATED_PATH) -> bool:
 
 
 def surface_check() -> list[str]:
-    """Every YAML op (and in-place variant) must be reachable on the public
-    surface (`paddle_tpu.<name>`); returns the list of missing names."""
+    """Every YAML op (and in-place variant) must be reachable: elementwise
+    entries as `paddle_tpu.<name>`, shaped entries via their impl path
+    (their registry name may carry a variant suffix like sum_axis)."""
+    import importlib
+
     import paddle_tpu as paddle
 
     missing = []
     for s in load_registry():
+        if s["category"] == "shaped":
+            mod, _, fn = s["impl"].rpartition(".")
+            try:
+                ok = callable(getattr(importlib.import_module(mod), fn))
+            except Exception:
+                ok = False
+            if not ok:
+                missing.append(s["impl"])
+            continue
         for n in filter(None, (s.name, s.get("inplace"))):
             if not callable(getattr(paddle, n, None)):
                 missing.append(n)

@@ -107,7 +107,8 @@ def binomial(count, prob, name=None) -> Tensor:
 
 def standard_gamma(x, name=None) -> Tensor:
     x = as_tensor(x)
-    return Tensor(jax.random.gamma(_key(), x._data))
+    # keep the input dtype (x64 mode would otherwise upcast to float64)
+    return Tensor(jax.random.gamma(_key(), x._data, dtype=x._data.dtype))
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
