@@ -129,5 +129,29 @@ class ParallelCrossEntropy(Layer):
         if get_mesh() is not None:
             input = mark_sharding(
                 input, P(*([None] * (input.ndim - 1)), "mp"))
+            return F.cross_entropy(input, label, reduction="none",
+                                   ignore_index=self.ignore_index)
+        from ...core.flags import flag
+        from ...ops.kernels import _common as kern
+        if kern.available() and flag("use_pallas_kernels"):
+            # single-device fused path: one VMEM pass computes the row
+            # max / sum-exp / target gather (ce_pallas.py; rows at
+            # ignore_index get loss 0 / zero grads); the sharded TP path
+            # keeps GSPMD partitioning of the composite above
+            import jax.numpy as jnp
+
+            from ...autograd.function import apply
+            from ...core.tensor import as_tensor
+            from ...ops.kernels.ce_pallas import c_softmax_with_cross_entropy
+
+            lab = as_tensor(label)._data
+            if lab.ndim == input.ndim:  # reference allows [..., 1] labels
+                lab = lab[..., 0]
+            lab_arr = lab.astype(jnp.int32)
+            return apply(
+                lambda lg: c_softmax_with_cross_entropy(
+                    lg, lab_arr, 0, None, kern.interpret_mode(),
+                    self.ignore_index),
+                input, name="c_softmax_with_cross_entropy")
         return F.cross_entropy(input, label, reduction="none",
                                ignore_index=self.ignore_index)

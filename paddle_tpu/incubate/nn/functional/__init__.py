@@ -14,8 +14,48 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
                                            dropout_rate=0.0, ln_epsilon=1e-5,
                                            training=True):
     """Reference: fused_bias_dropout_residual_layer_norm op
-    (paddle/phi/kernels/fusion/gpu/fused_bias_dropout_residual_layer_norm*)."""
+    (paddle/phi/kernels/fusion/gpu/fused_bias_dropout_residual_layer_norm*).
+    On TPU the whole chain runs as ONE Pallas VMEM pass per row block
+    (ops/kernels/bias_dropout_ln_pallas.py); the dropout mask is
+    materialized like the reference op's `dropout_mask_out` and generated
+    with the framework RNG. Elsewhere: the XLA composite."""
+    from ....core.flags import flag
+    from ....ops.kernels import _common as kern
     from ....nn import functional as F
+
+    if kern.available() and flag("use_pallas_kernels"):
+        import jax
+        import jax.numpy as jnp
+
+        from ....core import generator as gen_mod
+        from ....core.tensor import as_tensor
+        from ....autograd.function import apply_multi
+
+        xt = as_tensor(x)
+        hd = xt.shape[-1]
+        if training and dropout_rate >= 1.0:
+            mask_arr = jnp.zeros(tuple(xt.shape), jnp.float32)
+        elif training and dropout_rate > 0.0:
+            key = gen_mod.default_generator.split()
+            keep = jax.random.bernoulli(key, 1.0 - dropout_rate, xt.shape)
+            mask_arr = keep.astype(jnp.float32) / (1.0 - dropout_rate)
+        else:
+            mask_arr = None  # maskless kernel variant: nothing streamed
+        zeros = jnp.zeros((hd,), jnp.float32)
+        args = [xt, residual]
+        b_in = bias if bias is not None else zeros
+        g_in = ln_scale if ln_scale is not None else zeros + 1.0
+        be_in = ln_bias if ln_bias is not None else zeros
+
+        from ....ops.kernels.bias_dropout_ln_pallas import bias_dropout_ln
+        outs = apply_multi(
+            lambda a, r, b, g, be: bias_dropout_ln(
+                a, b, r, mask_arr, g, be, ln_epsilon,
+                kern.interpret_mode()),
+            *args, b_in, g_in, be_in,
+            name="fused_bias_dropout_residual_layer_norm")
+        return outs[0]
+
     out = x if bias is None else x + bias
     out = F.dropout(out, dropout_rate, training=training)
     out = out + residual

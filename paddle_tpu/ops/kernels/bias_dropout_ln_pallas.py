@@ -1,0 +1,207 @@
+"""Fused bias + dropout + residual-add + layernorm Pallas TPU kernel.
+
+Reference analog: paddle/phi/kernels/fusion/gpu/
+fused_bias_dropout_residual_layer_norm_kernel.cu (+ its grad kernel). The
+XLA composite materializes the biased/dropped tensor and the pre-norm sum
+in HBM between fusion islands; this kernel does the whole chain in one
+VMEM pass per row block:
+
+    h = (x + bias) * mask + residual          (mask carries 1/(1-p))
+    y = (h - mean(h)) * rstd(h) * gamma + beta
+
+Like the reference op, the dropout mask is a materialized tensor (the CUDA
+kernel writes `dropout_mask_out` for its backward); it is generated with
+the framework RNG outside the kernel and read as a kernel input, so
+interpret-mode tests and TPU lowering cover the identical program.
+
+Backward recomputes mean/rstd from the saved pre-norm `h` (cheaper than
+storing two per-row vectors in an awkward 1-D layout) and fuses the
+row-local dx with per-block partial dgamma/dbeta accumulation; partials
+are summed by one XLA reduce. d(x) = dh * mask; d(bias) = sum over rows
+of dh * mask; d(residual) = dh.
+
+Public entry: `bias_dropout_ln(x, bias, residual, mask, gamma, beta, eps)`
+returning (y, h) with a custom_vjp; `incubate.nn.functional.
+fused_bias_dropout_residual_layer_norm` dispatches to it on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._common import pad_to_block, pick_row_block
+
+
+def _fwd_kernel(x_ref, b_ref, res_ref, *rest, eps, has_mask):
+    if has_mask:
+        m_ref, g_ref, be_ref, y_ref, h_ref = rest
+    else:
+        g_ref, be_ref, y_ref, h_ref = rest
+    x = x_ref[...].astype(jnp.float32)                    # [rows, H]
+    h = x + b_ref[...].astype(jnp.float32)
+    if has_mask:
+        h = h * m_ref[...].astype(jnp.float32)
+    h = h + res_ref[...].astype(jnp.float32)
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.mean((h - mu) * (h - mu), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + jnp.float32(eps))
+    xhat = (h - mu) * rstd
+    y = xhat * g_ref[...].astype(jnp.float32) + be_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    h_ref[...] = h.astype(h_ref.dtype)
+
+
+def _bwd_kernel(h_ref, *rest, hidden, eps, has_mask):
+    """dh (layernorm backward, stats recomputed from h), then the dropout
+    chain; per-block partial dgamma/dbeta/dbias ride an 8-row layout."""
+    if has_mask:
+        (m_ref, g_ref, dy_ref, dx_ref, dres_ref, dgp_ref, dbp_ref,
+         dbiasp_ref) = rest
+    else:
+        (g_ref, dy_ref, dx_ref, dres_ref, dgp_ref, dbp_ref,
+         dbiasp_ref) = rest
+    h = h_ref[...].astype(jnp.float32)
+    m = m_ref[...].astype(jnp.float32) if has_mask else jnp.float32(1.0)
+    g = g_ref[...].astype(jnp.float32)                    # [1, H]
+    dy = dy_ref[...].astype(jnp.float32)
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.mean((h - mu) * (h - mu), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + jnp.float32(eps))
+    xhat = (h - mu) * rstd
+    u = dy * g
+    c1 = jnp.mean(u, axis=-1, keepdims=True)
+    c2 = jnp.mean(u * xhat, axis=-1, keepdims=True)
+    dh = (u - c1 - xhat * c2) * rstd
+    dx_ref[...] = (dh * m).astype(dx_ref.dtype)
+    dres_ref[...] = dh.astype(dres_ref.dtype)
+    dgp_ref[0] = jnp.broadcast_to(
+        jnp.sum(dy * xhat, axis=0, keepdims=True), (8, hidden))
+    dbp_ref[0] = jnp.broadcast_to(
+        jnp.sum(dy, axis=0, keepdims=True), (8, hidden))
+    dbiasp_ref[0] = jnp.broadcast_to(
+        jnp.sum(dh * m, axis=0, keepdims=True), (8, hidden))
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _fused_fwd(x2, b, res2, m2, g, be, eps, interpret):
+    n, h = x2.shape
+    has_mask = m2 is not None
+    rows = pick_row_block(n, h * 4, 4 * 1024 * 1024)
+    x2p = pad_to_block(x2, rows)
+    np_ = x2p.shape[0]
+    grid = (np_ // rows,)
+    row_spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, h), lambda i: (0, 0))
+    ins = [x2p, b.reshape(1, h), pad_to_block(res2, rows)]
+    in_specs = [row_spec, vec_spec, row_spec]
+    if has_mask:
+        ins.append(pad_to_block(m2, rows))
+        in_specs.append(row_spec)
+    ins += [g.reshape(1, h), be.reshape(1, h)]
+    in_specs += [vec_spec, vec_spec]
+    with jax.enable_x64(False):
+        y, hsum = pl.pallas_call(
+            functools.partial(_fwd_kernel, eps=eps, has_mask=has_mask),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[row_spec, row_spec],
+            out_shape=[jax.ShapeDtypeStruct((np_, h), x2.dtype),
+                       jax.ShapeDtypeStruct((np_, h), x2.dtype)],
+            interpret=interpret,
+        )(*ins)
+    return y[:n], hsum[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _fused_bwd(h2, m2, g, dy2, eps, interpret):
+    n, h = h2.shape
+    has_mask = m2 is not None
+    rows = pick_row_block(n, h * 4, 4 * 1024 * 1024)
+    h2p = pad_to_block(h2, rows)
+    np_ = h2p.shape[0]
+    grid = (np_ // rows,)
+    row_spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
+    part_spec = pl.BlockSpec((1, 8, h), lambda i: (i, 0, 0))
+    ins = [h2p]
+    in_specs = [row_spec]
+    if has_mask:
+        ins.append(pad_to_block(m2, rows))
+        in_specs.append(row_spec)
+    ins += [g.reshape(1, h), pad_to_block(dy2, rows)]
+    in_specs += [pl.BlockSpec((1, h), lambda i: (0, 0)), row_spec]
+    with jax.enable_x64(False):
+        dx, dres, dgp, dbp, dbiasp = pl.pallas_call(
+            functools.partial(_bwd_kernel, hidden=h, eps=eps,
+                              has_mask=has_mask),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[row_spec, row_spec, part_spec, part_spec, part_spec],
+            out_shape=[jax.ShapeDtypeStruct((np_, h), h2.dtype),
+                       jax.ShapeDtypeStruct((np_, h), h2.dtype),
+                       jax.ShapeDtypeStruct((np_ // rows, 8, h), jnp.float32),
+                       jax.ShapeDtypeStruct((np_ // rows, 8, h), jnp.float32),
+                       jax.ShapeDtypeStruct((np_ // rows, 8, h), jnp.float32)],
+            interpret=interpret,
+        )(*ins)
+    return (dx[:n], dres[:n], jnp.sum(dgp[:, 0, :], axis=0),
+            jnp.sum(dbp[:, 0, :], axis=0), jnp.sum(dbiasp[:, 0, :], axis=0))
+
+
+def _primal(x, bias, residual, mask, gamma, beta, eps, interpret=False):
+    """(y, h): the normalized output and the pre-norm sum (the reference
+    op's `dropout_residual_out`). `mask=None` selects the maskless kernel
+    variant (inference / dropout_rate 0) — no ones tensor is streamed."""
+    shp = x.shape
+    hd = shp[-1]
+    m2 = mask.reshape(-1, hd) if mask is not None else None
+    y, h = _fused_fwd(x.reshape(-1, hd), bias, residual.reshape(-1, hd),
+                      m2, gamma, beta, eps, interpret)
+    return y.reshape(shp), h.reshape(shp)
+
+
+bias_dropout_ln = jax.custom_vjp(_primal, nondiff_argnums=(6, 7))
+
+
+def _vjp_fwd(x, bias, residual, mask, gamma, beta, eps, interpret):
+    y, h = _primal(x, bias, residual, mask, gamma, beta, eps, interpret)
+    return (y, h), (h, mask, gamma, x.shape)
+
+
+def _vjp_bwd(eps, interpret, saved, grads):
+    h, mask, gamma, shp = saved
+    dy, dh_extra = grads
+    hd = shp[-1]
+    m2 = mask.reshape(-1, hd) if mask is not None else None
+    dx, dres, dgamma, dbeta, dbias = _fused_bwd(
+        h.reshape(-1, hd), m2, gamma, dy.reshape(-1, hd), eps, interpret)
+    dx = dx.reshape(shp)
+    dres = dres.reshape(shp)
+    if dh_extra is not None:
+        # cotangent arriving on the pre-norm stream joins both branches
+        # through h = (x+bias)*mask + residual
+        dres = dres + dh_extra.reshape(shp)
+        masked = dh_extra.reshape(-1, hd).astype(jnp.float32)
+        if m2 is not None:
+            masked = masked * m2.astype(jnp.float32)
+        dx = dx + masked.reshape(shp).astype(dx.dtype)
+        dbias = dbias + jnp.sum(masked, axis=0)
+    return (dx, dbias.astype(gamma.dtype), dres,
+            None if mask is None else jnp.zeros_like(mask),
+            dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype))
+
+
+bias_dropout_ln.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def reference_bias_dropout_ln(x, bias, residual, mask, gamma, beta, eps):
+    """XLA composite with identical semantics, for parity tests/A-B."""
+    h = (x.astype(jnp.float32) + bias) * mask.astype(jnp.float32) + \
+        residual.astype(jnp.float32)
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(h - mu), axis=-1, keepdims=True)
+    y = (h - mu) * jax.lax.rsqrt(var + eps) * gamma + beta
+    return y.astype(x.dtype), h.astype(x.dtype)

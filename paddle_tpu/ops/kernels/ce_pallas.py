@@ -1,0 +1,162 @@
+"""Fused (vocab-shardable) softmax cross-entropy Pallas TPU kernel.
+
+Reference analog: the c_softmax_with_cross_entropy op behind
+ParallelCrossEntropy (fleet/layers/mpu/mp_layers.py; CUDA kernel
+paddle/phi/kernels/gpu/c_softmax_with_cross_entropy_kernel.cu). The XLA
+composite makes three passes over the logits (max, sum-exp, gather); this
+kernel computes all three per-row statistics in ONE VMEM pass over the
+local vocab shard:
+
+    (row_max, sum_exp(logits - row_max), target_logit_or_-inf)
+
+Labels are GLOBAL vocab ids; each shard contributes its target logit only
+when the label falls inside [vocab_start, vocab_start + V_local) — exactly
+the reference kernel's masked gather — so combining shards is a pure
+max/sum/max reduction:
+
+    m = max_i m_i;  Z = sum_i z_i * exp(m_i - m);  t = max_i t_i
+    loss = log(Z) + m - t
+
+`c_softmax_with_cross_entropy(local_logits, label, axis_name=...)` runs
+that combine with `lax.p*` collectives inside shard_map (the TP path) or
+locally when unsharded. Backward is the standard dlogits =
+(softmax - onehot) * dloss, an elementwise pass XLA fuses on its own —
+only the forward statistics need the hand-written kernel.
+
+The vocab axis is padded to the 128-lane rule with -inf so padding can
+never win the max or contribute to the sum.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._common import pick_row_block
+
+_NEG = -1e30
+
+
+def _stats_kernel(lg_ref, lb_ref, mx_ref, se_ref, tg_ref, *, vocab_start,
+                  v_valid):
+    lg = lg_ref[...].astype(jnp.float32)                   # [rows, Vp]
+    cols = jax.lax.broadcasted_iota(jnp.int32, lg.shape, 1)
+    lg = jnp.where(cols < v_valid, lg, jnp.float32(_NEG))  # mask lane pad
+    mx = jnp.max(lg, axis=-1, keepdims=True)               # [rows, 1]
+    se = jnp.sum(jnp.exp(lg - mx), axis=-1, keepdims=True)
+    lb = lb_ref[...].astype(jnp.int32)                     # [rows, 1]
+    local = lb - jnp.int32(vocab_start)
+    hit = (local >= 0) & (local < v_valid)
+    tg = jnp.sum(jnp.where(cols == jnp.clip(local, 0, v_valid - 1), lg, 0.0),
+                 axis=-1, keepdims=True)
+    tg = jnp.where(hit, tg, jnp.float32(_NEG))
+    lanes = mx_ref.shape[-1]
+    mx_ref[...] = jnp.broadcast_to(mx, (mx.shape[0], lanes))
+    se_ref[...] = jnp.broadcast_to(se, (se.shape[0], lanes))
+    tg_ref[...] = jnp.broadcast_to(tg, (tg.shape[0], lanes))
+
+
+_LANES = 128  # stat outputs keep a full lane dim; callers read lane 0
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_start", "interpret"))
+def _row_stats(logits2, labels, vocab_start, interpret):
+    n, v = logits2.shape
+    vp = -(-v // 128) * 128
+    if vp != v:
+        logits2 = jnp.pad(logits2, ((0, 0), (0, vp - v)),
+                          constant_values=_NEG)
+    rows = pick_row_block(n, vp * 4, 4 * 1024 * 1024)
+    pad_n = (-n) % rows
+    if pad_n:
+        logits2 = jnp.pad(logits2, ((0, pad_n), (0, 0)),
+                          constant_values=_NEG)
+        labels = jnp.pad(labels, (0, pad_n))
+    np_ = n + pad_n
+    grid = (np_ // rows,)
+    with jax.enable_x64(False):
+        mx, se, tg = pl.pallas_call(
+            functools.partial(_stats_kernel, vocab_start=vocab_start,
+                              v_valid=v),
+            grid=grid,
+            in_specs=[pl.BlockSpec((rows, vp), lambda i: (i, 0)),
+                      pl.BlockSpec((rows, 1), lambda i: (i, 0))],
+            out_specs=[pl.BlockSpec((rows, _LANES), lambda i: (i, 0))] * 3,
+            out_shape=[jax.ShapeDtypeStruct((np_, _LANES), jnp.float32)] * 3,
+            interpret=interpret,
+        )(logits2, labels.reshape(-1, 1).astype(jnp.int32))
+    return mx[:n, 0], se[:n, 0], tg[:n, 0]
+
+
+def _combine(mx, se, tg, axis_name):
+    """Merge per-shard stats into global (max, log-sum-exp, target)."""
+    if axis_name is None:
+        return mx, se, tg
+    gmax = jax.lax.pmax(mx, axis_name)
+    gse = jax.lax.psum(se * jnp.exp(mx - gmax), axis_name)
+    gtg = jax.lax.pmax(tg, axis_name)
+    return gmax, gse, gtg
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def c_softmax_with_cross_entropy(logits, label, vocab_start=0,
+                                 axis_name=None, interpret=False,
+                                 ignore_index=None):
+    """Per-row CE loss from (possibly vocab-sharded) logits [.., V_local]
+    and GLOBAL int labels [..]. Inside shard_map pass the mp axis name;
+    standalone it is a fused single-device softmax-CE. Rows whose label
+    equals `ignore_index` contribute loss 0 and zero gradients (the
+    reference cross_entropy contract for padded batches)."""
+    loss, _ = _fwd_impl(logits, label, vocab_start, axis_name, interpret,
+                        ignore_index)
+    return loss
+
+
+def _fwd_impl(logits, label, vocab_start, axis_name, interpret,
+              ignore_index):
+    shp = logits.shape
+    l2 = logits.reshape(-1, shp[-1])
+    lab = label.reshape(-1)
+    valid = None
+    if ignore_index is not None:
+        valid = lab != ignore_index
+        lab = jnp.where(valid, lab, 0)  # any in-range id; loss masked below
+    mx, se, tg = _row_stats(l2, lab, vocab_start, interpret)
+    gmax, gse, gtg = _combine(mx, se, tg, axis_name)
+    loss = jnp.log(gse) + gmax - gtg
+    if valid is not None:
+        loss = jnp.where(valid, loss, 0.0)
+    return loss.reshape(shp[:-1]), (l2, lab, valid, gmax, gse)
+
+
+def _vjp_fwd(logits, label, vocab_start, axis_name, interpret, ignore_index):
+    loss, res = _fwd_impl(logits, label, vocab_start, axis_name, interpret,
+                          ignore_index)
+    return loss, res + (logits.shape,)
+
+
+def _vjp_bwd(vocab_start, axis_name, interpret, ignore_index, saved, g):
+    l2, lab, valid, gmax, gse, shp = saved
+    v = l2.shape[-1]
+    soft = jnp.exp(l2.astype(jnp.float32) - gmax[:, None]) / gse[:, None]
+    local = lab.astype(jnp.int32) - jnp.int32(vocab_start)
+    onehot = (jnp.arange(v, dtype=jnp.int32)[None, :] == local[:, None])
+    dl = (soft - onehot.astype(jnp.float32)) * g.reshape(-1, 1)
+    if valid is not None:
+        dl = jnp.where(valid[:, None], dl, 0.0)
+    return dl.reshape(shp).astype(l2.dtype), None
+
+
+c_softmax_with_cross_entropy.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def reference_ce(logits, label):
+    """XLA composite softmax-CE (full logits), for parity tests/A-B."""
+    lg = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lg, axis=-1)
+    tgt = jnp.take_along_axis(lg, label[..., None].astype(jnp.int32),
+                              axis=-1)[..., 0]
+    return lse - tgt

@@ -266,3 +266,226 @@ def test_moe_layer_grouped_path_matches_vmap():
     assert abs(loss_fast - loss_ref) < 1e-4, (loss_fast, loss_ref)
     for a, b in zip(g_fast, g_ref):
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
+
+
+def test_bias_dropout_ln_matches_composite():
+    """Fused bias+dropout+residual+layernorm kernel vs the XLA composite:
+    forward AND all six gradients (x, bias, residual, gamma, beta; the
+    mask is non-differentiable), including a non-divisible row count."""
+    from paddle_tpu.ops.kernels import bias_dropout_ln_pallas as bd
+    rng = np.random.default_rng(31)
+    for shape in [(2, 16, 64), (1, 13, 32)]:
+        x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        res = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+        g = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+        be = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+        keep = rng.random(shape) > 0.3
+        mask = jnp.asarray(keep / 0.7, jnp.float32)
+
+        y, h = bd.bias_dropout_ln(x, b, res, mask, g, be, 1e-5, True)
+        yr, hr = bd.reference_bias_dropout_ln(x, b, res, mask, g, be, 1e-5)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(h), np.asarray(hr), atol=2e-5)
+
+        def loss_k(x, b, res, g, be):
+            y, h = bd.bias_dropout_ln(x, b, res, mask, g, be, 1e-5, True)
+            return jnp.sum(y * y) + jnp.sum(h)
+
+        def loss_r(x, b, res, g, be):
+            y, h = bd.reference_bias_dropout_ln(x, b, res, mask, g, be, 1e-5)
+            return jnp.sum(y * y) + jnp.sum(h)
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2, 3, 4))(x, b, res, g, be)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2, 3, 4))(x, b, res, g, be)
+        for a_, b_ in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                       atol=5e-4)
+
+
+def test_fused_bias_dropout_residual_ln_public_api():
+    """The incubate functional dispatches to the kernel under interpret
+    mode and matches eval-mode composite numerics; training mode masks."""
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.ops.kernels import _common as kern
+    rng = np.random.default_rng(32)
+    x = paddle.to_tensor(rng.standard_normal((2, 8, 32)).astype(np.float32))
+    res = paddle.to_tensor(rng.standard_normal((2, 8, 32)).astype(np.float32))
+    g = paddle.to_tensor(rng.standard_normal(32).astype(np.float32))
+    b = paddle.to_tensor(rng.standard_normal(32).astype(np.float32))
+
+    kern.force_interpret(True)
+    try:
+        out_k = IF.fused_bias_dropout_residual_layer_norm(
+            x, res, ln_scale=g, ln_bias=b, dropout_rate=0.5, training=False)
+    finally:
+        kern.force_interpret(False)
+    out_c = IF.fused_bias_dropout_residual_layer_norm(
+        x, res, ln_scale=g, ln_bias=b, dropout_rate=0.5, training=False)
+    np.testing.assert_allclose(out_k.numpy(), out_c.numpy(), atol=2e-5)
+
+    # training path produces a masked (different) result but valid grads
+    x2 = paddle.to_tensor(rng.standard_normal((2, 8, 32)).astype(np.float32))
+    x2.stop_gradient = False
+    kern.force_interpret(True)
+    try:
+        out_t = IF.fused_bias_dropout_residual_layer_norm(
+            x2, res, ln_scale=g, ln_bias=b, dropout_rate=0.5, training=True)
+        out_t.sum().backward()
+    finally:
+        kern.force_interpret(False)
+    assert x2.grad is not None
+    assert np.isfinite(x2.grad.numpy()).all()
+
+
+def test_bias_dropout_ln_maskless_variant_and_p1():
+    """mask=None selects the maskless kernel (inference path: no ones
+    tensor streamed) and matches the mask-of-ones numerics incl. grads;
+    dropout_rate=1.0 in the public API yields finite zeros-path output."""
+    from paddle_tpu.ops.kernels import bias_dropout_ln_pallas as bd
+    rng = np.random.default_rng(36)
+    shape = (2, 13, 32)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    res = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+    g = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+    be = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+    ones = jnp.ones(shape, jnp.float32)
+
+    y0, h0 = bd.bias_dropout_ln(x, b, res, None, g, be, 1e-5, True)
+    y1, h1 = bd.bias_dropout_ln(x, b, res, ones, g, be, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h0), np.asarray(h1), atol=1e-6)
+
+    gk = jax.grad(lambda *t: jnp.sum(
+        bd.bias_dropout_ln(t[0], t[1], t[2], None, t[3], t[4],
+                           1e-5, True)[0] ** 2),
+        argnums=(0, 1, 2, 3, 4))(x, b, res, g, be)
+    gr = jax.grad(lambda *t: jnp.sum(
+        bd.bias_dropout_ln(t[0], t[1], t[2], ones, t[3], t[4],
+                           1e-5, True)[0] ** 2),
+        argnums=(0, 1, 2, 3, 4))(x, b, res, g, be)
+    for a_, b_ in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a_), np.asarray(b_), atol=1e-5)
+
+    import paddle_tpu.incubate.nn.functional as IF
+    kern.force_interpret(True)
+    try:
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            paddle.to_tensor(np.asarray(x)), paddle.to_tensor(np.asarray(res)),
+            dropout_rate=1.0, training=True)
+    finally:
+        kern.force_interpret(False)
+    assert np.isfinite(out.numpy()).all()  # not NaN: mask is exact zeros
+
+
+def test_ce_kernel_ignore_index():
+    """Rows at ignore_index contribute 0 loss and exactly zero gradients
+    (the reference cross_entropy padding contract)."""
+    from paddle_tpu.ops.kernels import ce_pallas as cp
+    rng = np.random.default_rng(37)
+    n, v = 6, 40
+    lg = jnp.asarray(rng.standard_normal((n, v)), jnp.float32)
+    lb = jnp.asarray([3, -100, 7, -100, 0, 39], jnp.int32)
+    loss = cp.c_softmax_with_cross_entropy(lg, lb, 0, None, True, -100)
+    valid = np.asarray(lb) != -100
+    want = np.asarray(cp.reference_ce(lg, jnp.where(lb == -100, 0, lb)))
+    np.testing.assert_allclose(np.asarray(loss)[valid], want[valid],
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(loss)[~valid], 0.0)
+    grads = jax.grad(lambda a: jnp.sum(
+        cp.c_softmax_with_cross_entropy(a, lb, 0, None, True, -100)))(lg)
+    np.testing.assert_allclose(np.asarray(grads)[~valid], 0.0)
+    assert np.abs(np.asarray(grads)[valid]).max() > 0
+
+    # the live layer honors its configured ignore_index via the kernel
+    from paddle_tpu.distributed.meta_parallel import ParallelCrossEntropy
+    x = paddle.to_tensor(np.asarray(lg))
+    x.stop_gradient = False
+    kern.force_interpret(True)
+    try:
+        out = ParallelCrossEntropy()(x, paddle.to_tensor(
+            np.asarray(lb, np.int64)))
+        out.sum().backward()
+    finally:
+        kern.force_interpret(False)
+    np.testing.assert_allclose(out.numpy()[~valid], 0.0)
+    np.testing.assert_allclose(x.grad.numpy()[~valid], 0.0)
+
+
+def test_ce_kernel_matches_reference_and_grads():
+    """Fused softmax-CE kernel (single shard): loss + dlogits parity with
+    the XLA composite, odd row/vocab sizes included."""
+    from paddle_tpu.ops.kernels import ce_pallas as cp
+    rng = np.random.default_rng(33)
+    for n, v in [(16, 256), (13, 200)]:
+        lg = jnp.asarray(rng.standard_normal((n, v)), jnp.float32)
+        lb = jnp.asarray(rng.integers(0, v, (n,)), jnp.int32)
+        loss = cp.c_softmax_with_cross_entropy(lg, lb, 0, None, True)
+        want = cp.reference_ce(lg, lb)
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+        gk = jax.grad(lambda a: jnp.sum(
+            cp.c_softmax_with_cross_entropy(a, lb, 0, None, True)))(lg)
+        gr = jax.grad(lambda a: jnp.sum(cp.reference_ce(a, lb)))(lg)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=1e-5)
+
+
+def test_ce_kernel_sharded_combine_shard_map():
+    """Vocab-sharded CE inside shard_map over an 8-device mesh equals the
+    dense CE: per-shard one-pass stats + pmax/psum combine (the
+    c_softmax_with_cross_entropy TP contract)."""
+    from paddle_tpu.ops.kernels import ce_pallas as cp
+    from jax.sharding import Mesh, PartitionSpec as P
+    shard_map = jax.shard_map
+
+    devs = jax.devices("cpu")[:8]
+    mesh = Mesh(np.array(devs), ("mp",))
+    rng = np.random.default_rng(34)
+    n, v = 8, 64 * 8
+    lg = jnp.asarray(rng.standard_normal((n, v)), jnp.float32)
+    lb = jnp.asarray(rng.integers(0, v, (n,)), jnp.int32)
+
+    def local(lg_shard, lb_full):
+        # the stats kernel wants a STATIC vocab_start; shift the labels by
+        # this shard's offset instead (global - start == local id)
+        idx = jax.lax.axis_index("mp")
+        shifted = lb_full - idx * (v // 8)
+        return cp.c_softmax_with_cross_entropy(
+            lg_shard, shifted, 0, "mp", True)
+
+    sharded = shard_map(local, mesh=mesh,
+                        in_specs=(P(None, "mp"), P(None)),
+                        out_specs=P(None), check_vma=False)
+    got = sharded(lg, lb)
+    want = cp.reference_ce(lg, lb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_parallel_cross_entropy_fused_single_device():
+    """ParallelCrossEntropy off-mesh rides the fused CE kernel and matches
+    F.cross_entropy, including backward."""
+    from paddle_tpu.distributed.meta_parallel import ParallelCrossEntropy
+    from paddle_tpu.ops.kernels import _common as kern
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(35)
+    logits_np = rng.standard_normal((6, 50)).astype(np.float32)
+    labels_np = rng.integers(0, 50, (6,)).astype(np.int64)
+    ce = ParallelCrossEntropy()
+
+    x1 = paddle.to_tensor(logits_np)
+    x1.stop_gradient = False
+    kern.force_interpret(True)
+    try:
+        l1 = ce(x1, paddle.to_tensor(labels_np))
+        l1.sum().backward()
+    finally:
+        kern.force_interpret(False)
+    x2 = paddle.to_tensor(logits_np)
+    x2.stop_gradient = False
+    l2 = F.cross_entropy(x2, paddle.to_tensor(labels_np), reduction="none")
+    l2.sum().backward()
+    np.testing.assert_allclose(l1.numpy(), l2.numpy(), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(x1.grad.numpy(), x2.grad.numpy(), atol=1e-5)

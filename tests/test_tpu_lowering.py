@@ -157,6 +157,47 @@ def test_moe_grouped_matmul_fwd_bwd_lowers():
     assert_mosaic(lower_tpu(grad_fn, x, w))
 
 
+@pytest.mark.parametrize("shape", [(4, 128, 512), (1, 509, 384)])
+def test_bias_dropout_ln_lowers(shape):
+    from paddle_tpu.ops.kernels import bias_dropout_ln_pallas as bd
+    x = jnp.zeros(shape, jnp.float32)
+    vec = jnp.zeros((shape[-1],), jnp.float32)
+
+    def fwd(x, b, r, m, g, be):
+        return bd.bias_dropout_ln(x, b, r, m, g, be, 1e-5, False)
+
+    assert_mosaic(lower_tpu(fwd, x, vec, x, x, vec, vec))
+
+    def grad_fn(x, b, r, m, g, be):
+        return jax.grad(lambda *t: jnp.sum(
+            bd.bias_dropout_ln(t[0], t[1], t[2], m, t[3], t[4],
+                               1e-5, False)[0]),
+            argnums=(0, 1, 2, 3, 4))(x, b, r, g, be)
+
+    assert_mosaic(lower_tpu(grad_fn, x, vec, x, x, vec, vec))
+
+    # maskless (inference) kernel variant lowers too
+    assert_mosaic(lower_tpu(
+        lambda x, b, r, g, be: bd.bias_dropout_ln(x, b, r, None, g, be,
+                                                  1e-5, False),
+        x, vec, x, vec, vec))
+
+
+@pytest.mark.parametrize("nv", [(64, 32000), (13, 50257)])
+def test_ce_kernel_lowers(nv):
+    from paddle_tpu.ops.kernels import ce_pallas as cp
+    n, v = nv
+    lg = jnp.zeros((n, v), jnp.float32)
+    lb = jnp.zeros((n,), jnp.int32)
+    assert_mosaic(lower_tpu(
+        lambda a: cp.c_softmax_with_cross_entropy(a, lb, 0, None, False),
+        lg))
+    assert_mosaic(lower_tpu(
+        lambda a: jax.grad(lambda t: jnp.sum(
+            cp.c_softmax_with_cross_entropy(t, lb, 0, None, False)))(a),
+        lg))
+
+
 @pytest.fixture
 def forced_dispatch():
     """Trace live paths with real kernel dispatch on (lowering only — the
