@@ -321,18 +321,118 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 
 # -- object collectives (host-side, reference communication/*_object*) -----
+#
+# Single-controller jit sees every object already, so the collectives are
+# local appends. In MULTI-PROCESS launch mode (PADDLE_TRAINERS_NUM > 1,
+# one python process per rank) they exchange pickled objects through the
+# TCP store — the reference's TCPStore-backed object collectives
+# (python/paddle/distributed/communication/all_gather.py object path).
+
+_obj_store = None
+_obj_seq = {}
+
+
+def _multiproc_env():
+    import os
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if world <= 1:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    store_ep = os.environ.get("PADDLE_STORE_ENDPOINT", "")
+    if store_ep:  # launcher-allocated dedicated port (collision-free)
+        return rank, world, store_ep
+    master = os.environ.get("PADDLE_MASTER", "")
+    if not master and os.environ.get("PADDLE_TRAINER_ENDPOINTS"):
+        master = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
+    if not master:
+        return None
+    # launcher-less fallback: offset the port (PADDLE_MASTER's own port
+    # belongs to the jax.distributed coordinator / rank-0 endpoint)
+    host, _, port = master.rpartition(":")
+    return rank, world, f"{host or '127.0.0.1'}:{int(port) + 7}"
+
+
+def _get_obj_store():
+    global _obj_store
+    if _obj_store is None:
+        env = _multiproc_env()
+        if env is None:
+            return None
+        rank, world, master = env
+        host, _, port = master.rpartition(":")
+        from ..store import TCPStore
+        _obj_store = (TCPStore(host or "127.0.0.1", int(port),
+                               is_master=(rank == 0), world_size=world),
+                      rank, world)
+    return _obj_store
+
+
+def _obj_key(name):
+    # collectives are called in the same order on every rank (the standard
+    # collective contract), so a per-op sequence number aligns them
+    n = _obj_seq.get(name, 0)
+    _obj_seq[name] = n + 1
+    return f"obj/{name}/{n}"
+
+
+def _obj_barrier(store, key, rank, world):
+    # two-phase: every rank checks in after READING, then the store-hosting
+    # master additionally waits for release-acks — otherwise the master
+    # could exit between the counter reaching `world` and a peer's final
+    # read of it (observed as connection-refused at process teardown)
+    store.add(f"{key}/done", 1)
+    store.wait_ge(f"{key}/done", world)
+    if rank == 0:
+        if world > 1:
+            store.wait_ge(f"{key}/ack", world - 1)
+        # rank 0 is the LAST to leave (it holds the acks) and sequence
+        # numbers are never reused, so this op's keys are garbage now —
+        # drop them or the master leaks one entry set per collective call
+        store.delete_prefix(key)
+    else:
+        store.add(f"{key}/ack", 1)
+
 
 def all_gather_object(object_list, obj, group=None):
-    object_list.append(obj)  # single-controller: every rank sees the object
+    st = _get_obj_store()
+    if st is None:
+        object_list.append(obj)  # single-controller: all ranks see it
+        return
+    store, rank, world = st
+    key = _obj_key("all_gather")
+    store.set(f"{key}/{rank}", obj)
+    object_list.extend(store.get(f"{key}/{r}") for r in range(world))
+    _obj_barrier(store, key, rank, world)
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    st = _get_obj_store()
+    if st is None:
+        return object_list
+    store, rank, world = st
+    key = _obj_key("broadcast")
+    if rank == src:
+        store.set(key, list(object_list))
+    recv = store.get(key)
+    object_list[:] = recv
+    _obj_barrier(store, key, rank, world)
     return object_list
 
 
-def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
-    if in_object_list:
-        out_object_list.append(in_object_list[0])
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    st = _get_obj_store()
+    if st is None:
+        if in_object_list:
+            out_object_list.append(in_object_list[0])
+        return
+    store, rank, world = st
+    key = _obj_key("scatter")
+    if rank == src:
+        for r in range(world):
+            store.set(f"{key}/{r}", in_object_list[r])
+    out_object_list.append(store.get(f"{key}/{rank}"))
+    _obj_barrier(store, key, rank, world)
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):

@@ -68,7 +68,7 @@ def _free_port():
     return port
 
 
-def _worker_env(args, local_rank, master, endpoint=None):
+def _worker_env(args, local_rank, master, endpoint=None, store_port=None):
     world = args.nnodes * args.nproc_per_node
     rank = args.node_rank * args.nproc_per_node + local_rank
     env = dict(os.environ)
@@ -83,6 +83,12 @@ def _worker_env(args, local_rank, master, endpoint=None):
         "WORLD_SIZE": str(world),
         "MASTER_ADDR_PORT": master,
     })
+    if store_port is not None:
+        # dedicated object-store port, allocated by the launcher so it
+        # cannot collide with another job's coordinator (derived master+7
+        # offsets are only the launcher-less fallback)
+        host = master.rpartition(":")[0] or "127.0.0.1"
+        env["PADDLE_STORE_ENDPOINT"] = f"{host}:{store_port}"
     if args.devices is not None:
         devs = args.devices.split(",")
         env["FLAGS_selected_tpus"] = devs[local_rank % len(devs)]
@@ -146,6 +152,8 @@ def launch(argv=None):
         rpc_eps = [f"127.0.0.1:{_free_port()}"
                    for _ in range(args.nproc_per_node)]
 
+    store_port = _free_port()  # dedicated object-store port for this job
+
     def spawn(local_rank):
         if jobs is not None:
             role, idx = jobs[local_rank]
@@ -153,7 +161,8 @@ def launch(argv=None):
         else:
             env = _worker_env(
                 args, local_rank, master,
-                endpoint=rpc_eps[local_rank] if rpc_eps else None)
+                endpoint=rpc_eps[local_rank] if rpc_eps else None,
+                store_port=store_port)
             if rpc_eps is not None:
                 env["PADDLE_WORKER_ENDPOINTS"] = ",".join(rpc_eps)
         cmd = [sys.executable, args.training_script] + \

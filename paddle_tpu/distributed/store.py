@@ -1,0 +1,211 @@
+"""TCP key-value store for host-side rendezvous and object exchange.
+
+Reference: paddle/phi/core/distributed/store/tcp_store.h (TCPStore — the
+bootstrap KV service behind init_parallel_env and the object collectives)
+and store.py's python surface. Pure stdlib: the master rank runs a
+threaded TCP server holding a dict; clients issue pickle-framed
+set/get/add/wait requests. `get` blocks until the key exists (with a
+deadline), which is the synchronization primitive the object collectives
+build on.
+
+Device tensors never travel through this store — it moves small pickled
+python objects and rendezvous keys over DCN, exactly the reference's
+split between NCCL (tensors) and TCPStore (control plane).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+__all__ = ["TCPStore"]
+
+
+def _send_msg(sock, payload: bytes):
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+def _recv_msg(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        hdr += chunk
+    (n,) = struct.unpack("!I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        store = self.server.store  # type: ignore[attr-defined]
+        try:
+            while True:
+                op, key, value, timeout = pickle.loads(_recv_msg(self.request))
+                if op == "set":
+                    with store._cv:
+                        store._data[key] = value
+                        store._cv.notify_all()
+                    reply = (True, None)
+                elif op == "add":
+                    with store._cv:
+                        cur = store._data.get(key, 0) + value
+                        store._data[key] = cur
+                        store._cv.notify_all()
+                    reply = (True, cur)
+                elif op == "get":
+                    deadline = time.monotonic() + timeout
+                    with store._cv:
+                        while key not in store._data:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            store._cv.wait(left)
+                        if key in store._data:
+                            reply = (True, store._data[key])
+                        else:
+                            reply = (False, f"store get({key!r}) timed out")
+                elif op == "wait_ge":
+                    deadline = time.monotonic() + timeout
+                    with store._cv:
+                        while store._data.get(key, 0) < value:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            store._cv.wait(left)
+                        if store._data.get(key, 0) >= value:
+                            reply = (True, store._data[key])
+                        else:
+                            reply = (False,
+                                     f"store wait_ge({key!r}) timed out")
+                elif op == "delete":
+                    with store._cv:
+                        existed = store._data.pop(key, None) is not None
+                    reply = (True, existed)
+                elif op == "delete_prefix":
+                    with store._cv:
+                        dead = [k for k in store._data if k.startswith(key)]
+                        for k in dead:
+                            del store._data[k]
+                    reply = (True, len(dead))
+                else:
+                    reply = (False, f"unknown store op {op!r}")
+                _send_msg(self.request, pickle.dumps(reply))
+        except (ConnectionError, OSError):
+            return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class TCPStore:
+    """Reference TCPStore contract: master hosts, everyone connects. The
+    client holds ONE persistent connection (the server handler loops on a
+    socket); connect-phase failures retry until the deadline (the master
+    may come up later), but once a request has been sent, failures RAISE —
+    blind resends would double-apply non-idempotent ops like `add`."""
+
+    def __init__(self, host: str, port: int, is_master: bool,
+                 world_size: int = 1, timeout: float = 60.0):
+        self.host, self.port = host, int(port)
+        self.timeout = timeout
+        self._server = None
+        self._sock = None
+        self._lock = threading.Lock()
+        if is_master:
+            self._data: dict = {}
+            self._cv = threading.Condition()
+            self._server = _Server((host, self.port), _Handler)
+            self.port = self._server.server_address[1]  # resolves port 0
+            self._server.store = self
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True).start()
+
+    def _connect(self, deadline):
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                return socket.create_connection(
+                    (self.host, self.port),
+                    timeout=max(deadline - time.monotonic(), 1.0))
+            except OSError as e:
+                last_err = e
+                time.sleep(0.05)
+        raise TimeoutError(f"store connect failed: {last_err}")
+
+    def _request(self, op, key, value=None, timeout=None):
+        timeout = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            fresh = self._sock is None
+            if fresh:
+                self._sock = self._connect(deadline)
+            msg = pickle.dumps((op, key, value, timeout))
+            try:
+                self._sock.settimeout(timeout + 5.0)
+                _send_msg(self._sock, msg)
+            except OSError:
+                if not fresh:
+                    # a cached keepalive can go stale between collectives;
+                    # a failed send on it never reached the server, so one
+                    # reconnect + resend is safe
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = self._connect(deadline)
+                    self._sock.settimeout(timeout + 5.0)
+                    _send_msg(self._sock, msg)
+                else:
+                    raise
+            # the request is in flight: no retries past this point
+            ok, payload = pickle.loads(_recv_msg(self._sock))
+        if not ok:
+            raise TimeoutError(payload)
+        return payload
+
+    def set(self, key: str, value) -> None:
+        self._request("set", key, value)
+
+    def get(self, key: str, timeout: float | None = None):
+        return self._request("get", key, timeout=timeout)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self._request("add", key, amount)
+
+    def wait_ge(self, key: str, value: int, timeout: float | None = None):
+        """Block until the counter at `key` reaches `value` (the barrier
+        primitive the object collectives use to keep the master's store
+        alive until every rank has read)."""
+        return self._request("wait_ge", key, value, timeout=timeout)
+
+    def delete_key(self, key: str) -> bool:
+        return self._request("delete", key)
+
+    def delete_prefix(self, prefix: str) -> int:
+        """Drop every key under `prefix` (post-collective cleanup so the
+        master's dict doesn't grow with the number of collective calls)."""
+        return self._request("delete_prefix", prefix)
+
+    def shutdown(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
