@@ -10,8 +10,13 @@ tests), so the pass layer here is small and OPTIMIZER/STEP-level:
   (the reference's gradient_merge_pass rewritten as an optimizer wrapper —
   the compiled step stays one XLA program per micro-step).
 - recompute: delegates to fleet.recompute (jax.checkpoint).
-- fuse_allreduce / overlap passes: registered no-ops with the subsumption
-  recorded, so strategy configs naming them still resolve.
+- comm_overlap / fuse_all_reduce: REAL compile controls — they wrap the
+  step callable in a jit carrying per-platform XLA compiler-option
+  bundles (latency-hiding / concurrency scheduler knobs, collective
+  combiner control), the pass layer's lever when the compiler owns the
+  schedule. An HLO diff test proves the bundle changes the compiled
+  program
+  (tests/test_distributed.py::test_xla_option_passes_change_compiled_program).
 """
 
 from __future__ import annotations

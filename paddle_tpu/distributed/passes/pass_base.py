@@ -52,33 +52,158 @@ class PassManager:
         return target
 
 
-class _SubsumedPass(PassBase):
-    """Base for passes whose effect XLA already provides: applying one is a
-    deliberate no-op, but it says so out loud — `new_pass(...)` succeeding
-    silently would read as a knob that exists (VERDICT r2 weak #9)."""
+class OptionCompiled:
+    """A step callable bound to an XLA compiler-option bundle. Calling it
+    runs the jitted function compiled WITH the bundle; chained option
+    passes merge into one bundle (re-jitting a jitted fn would inline the
+    inner one and silently drop its options)."""
 
-    _subsumed_by = "XLA"
+    def __init__(self, fn, options):
+        import jax
+        self.fn = fn
+        self.xla_options = dict(options)
+        self._jitted = jax.jit(fn, compiler_options=self.xla_options) \
+            if self.xla_options else jax.jit(fn)
+
+    def __call__(self, *args, **kwargs):
+        return self._jitted(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+
+def _platform():
+    import jax
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+_OPTION_VERDICTS: dict = {}  # (platform, name, value) -> bool
+
+
+def _validate_options(options):
+    """Probe-compile a trivial program with each option on the current
+    backend; unknown options are dropped WITH a warning (never silently)
+    so one pass definition serves cpu/tpu. Verdicts are memoized per
+    (platform, option, value) — on TPU each probe is a full compiler
+    round-trip."""
+    if not options:
+        return {}
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    plat = _platform()
+    probe = None
+    kept = {}
+    for k, v in options.items():
+        ck = (plat, k, repr(v))
+        if ck not in _OPTION_VERDICTS:
+            if probe is None:
+                probe = jax.jit(lambda x: x + 1).lower(jnp.zeros(()))
+            try:
+                probe.compile(compiler_options={k: v})
+                _OPTION_VERDICTS[ck] = True
+            except Exception as e:  # backend rejects the name/value
+                _OPTION_VERDICTS[ck] = False
+                warnings.warn(
+                    f"XLA option {k}={v!r} rejected by the {plat} "
+                    f"backend and dropped from the pass bundle: {e}",
+                    UserWarning, stacklevel=3)
+        if _OPTION_VERDICTS[ck]:
+            kept[k] = v
+    return kept
+
+
+class _XlaOptionsPass(PassBase):
+    """Base for passes that are REAL compile controls: `apply(step)` wraps
+    a python step callable in a jit carrying a per-platform XLA
+    compiler-option bundle (the TPU analog of the reference's pass
+    rewrites — under XLA the schedule lives in the compiler, so the pass
+    layer's lever is compiler options, not HLO surgery). Override
+    `default_options()`; users may extend/override the bundle with
+    `set_attr('xla_options', {...})`."""
+
+    def default_options(self, platform):
+        return {}
+
+    def resolved_options(self):
+        opts = dict(self.default_options(_platform()))
+        opts.update(self.get_attr("xla_options", {}) or {})
+        return _validate_options(opts)
 
     def apply(self, target, context=None):
-        import warnings
-        warnings.warn(
-            f"pass {type(self).__name__} is subsumed by {self._subsumed_by} "
-            "and performs no rewrite (see the pass docstring for the HLO "
-            "proof)", UserWarning, stacklevel=2)
-        return target
-
-
-@register_pass("fuse_all_reduce")
-class _FuseAllReducePass(_SubsumedPass):
-    """Subsumed: XLA fuses/buckets gradient collectives during scheduling
-    (HLO proof: tests/test_distributed.py::test_hlo_* collective tests)."""
-
-    _subsumed_by = "XLA collective combining/scheduling"
+        opts = self.resolved_options()
+        if isinstance(target, OptionCompiled):
+            merged = {**target.xla_options, **opts}
+            prev = target.xla_options.get("xla_disable_hlo_passes")
+            new = opts.get("xla_disable_hlo_passes")
+            if prev and new:  # list-valued: order-preserving union
+                seen = list(dict.fromkeys(
+                    prev.split(",") + new.split(",")))
+                merged["xla_disable_hlo_passes"] = ",".join(seen)
+            out = OptionCompiled(target.fn, merged)
+        elif callable(target):
+            out = OptionCompiled(target, opts)
+        else:
+            # heterogeneous PassManager lists mix optimizer-level passes
+            # (gradient_merge) with step-level option passes; a non-step
+            # target passes through — audibly, never silently
+            import warnings
+            warnings.warn(
+                f"{type(self).__name__} applies to a step callable; "
+                f"{type(target).__name__} target passed through unchanged",
+                UserWarning, stacklevel=2)
+            return target
+        if context is not None:
+            # record the bundle ACTUALLY compiled (merged), not just this
+            # pass's contribution — auditing the context must reproduce
+            # the in-effect options
+            context.attrs["xla_options"] = dict(out.xla_options)
+        return out
 
 
 @register_pass("comm_overlap")
-class _CommOverlapPass(_SubsumedPass):
-    """Subsumed: XLA's latency-hiding scheduler overlaps collectives with
-    compute; no user-level rewrite exists or is needed."""
+class _CommOverlapPass(_XlaOptionsPass):
+    """Compute/communication overlap as a real compile control (reference:
+    passes/allreduce_matmul_grad_overlapping.py — there an HLO-level
+    reordering; here the latency-hiding scheduler knobs of the XLA
+    backend that owns the schedule). TPU: the latency-hiding scheduler +
+    async collective fusion; CPU: the concurrency-optimized scheduler.
+    Unknown names on a given backend are warn-dropped by validation."""
 
-    _subsumed_by = "XLA's latency-hiding scheduler"
+    def default_options(self, platform):
+        if platform == "tpu":
+            return {"xla_tpu_enable_latency_hiding_scheduler": True,
+                    "xla_tpu_enable_async_collective_fusion": True}
+        return {"xla_cpu_enable_concurrency_optimized_scheduler": True}
+
+
+@register_pass("fuse_all_reduce")
+class _FuseAllReducePass(_XlaOptionsPass):
+    """Gradient-collective combining as a real compile control. XLA's
+    all-reduce combiner buckets small collectives by default (the effect
+    of the reference's fuse_all_reduce pass); this pass exposes the knob:
+    `set_attr('fuse', False)` disables the combiner HLO pass entirely
+    (proving the control in an HLO diff), `set_attr('threshold_bytes', n)`
+    forwards the platform's combine-threshold option where one exists."""
+
+    def default_options(self, platform):
+        opts = {}
+        if self.get_attr("fuse", True) is False:
+            opts["xla_disable_hlo_passes"] = "all-reduce-combiner"
+        thr = self.get_attr("threshold_bytes")
+        if thr is not None:
+            if platform == "gpu":
+                opts["xla_gpu_all_reduce_combine_threshold_bytes"] = int(thr)
+            else:
+                import warnings
+                warnings.warn(
+                    f"fuse_all_reduce threshold_bytes has no XLA option on "
+                    f"the {platform} backend (its combiner thresholds are "
+                    "not compile-option-settable); the knob is ignored",
+                    UserWarning, stacklevel=3)
+        return opts

@@ -268,15 +268,32 @@ def test_spectral_norm_constrains_top_singular_value():
     assert not np.allclose(lin.weight_orig.grad.numpy(), 0)
 
 
-def test_subsumed_passes_warn():
+def test_comm_overlap_pass_is_a_real_compile_control():
+    """comm_overlap wraps a step callable with a validated XLA option
+    bundle (CPU: the concurrency-optimized scheduler) and the wrapped
+    step computes identical results; non-step targets pass through with
+    an audible warning, never silently."""
     import warnings
+    import numpy as _np
     from paddle_tpu.distributed.passes import new_pass
+    from paddle_tpu.distributed.passes.pass_base import OptionCompiled
+
     p = new_pass("comm_overlap")
+
+    def step(x):
+        return (x * 2 + 1).sum()
+
+    wrapped = p.apply(step)
+    assert isinstance(wrapped, OptionCompiled)
+    assert wrapped.xla_options  # bundle resolved non-empty on this backend
+    x = _np.ones((4, 4), _np.float32)
+    _np.testing.assert_allclose(float(wrapped(x)), float(step(x)))
+
     with warnings.catch_warnings(record=True) as rec:
         warnings.simplefilter("always")
-        out = p.apply("target")
-    assert out == "target"
-    assert any("subsumed" in str(w.message) for w in rec)
+        out = p.apply(object())
+    assert any("passed through" in str(w.message) for w in rec)
+    assert not isinstance(out, OptionCompiled)
 
 
 def test_spectral_norm_under_to_static_no_tracer_leak():
