@@ -122,26 +122,35 @@ def matmul(a, b):
     return apply(lambda d: bcoo @ d, dense, name="sparse_matmul")
 
 
-def relu(x):
-    if not is_sparse(x):
-        raise TypeError("sparse.relu expects a SparseCooTensor")
-    b = x._b
-    return SparseCooTensor(jsparse.BCOO((jnp.maximum(b.data, 0), b.indices),
-                                        shape=b.shape))
-
-
 # -- unary value-wise ops (reference sparse_ops.yaml: applied to the stored
-# values; the implicit zeros keep their sparsity) ---------------------------
+# values; the implicit zeros keep their sparsity). When the input carries a
+# live autograd edge on its values (`_values_tensor`, set by the sparse
+# conv/pool functionals), the op threads it so gradient chains survive
+# stacked sparse layers (conv -> relu -> conv). -----------------------------
+
+def _grad_values(x):
+    """The differentiable values Tensor for x (falls back to raw data)."""
+    vt = getattr(x, "_values_tensor", None)
+    return vt if vt is not None else Tensor(x._b.data, stop_gradient=True)
+
 
 def _unary(jfn, name):
     def op(x, *a, **kw):
         if not is_sparse(x):
             raise TypeError(f"sparse.{name} expects a SparseCooTensor")
         b = x._b
-        return SparseCooTensor(
-            jsparse.BCOO((jfn(b.data, *a, **kw), b.indices), shape=b.shape))
+        from ..autograd.function import apply
+        out_vals = apply(lambda v: jfn(v, *a, **kw), _grad_values(x),
+                         name=f"sparse_{name}")
+        out = SparseCooTensor(
+            jsparse.BCOO((out_vals._data, b.indices), shape=b.shape))
+        out._values_tensor = out_vals
+        return out
     op.__name__ = name
     return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0), "relu")
 
 
 abs = _unary(jnp.abs, "abs")
@@ -287,21 +296,31 @@ def masked_matmul(x, y, mask):
 
 def softmax(x, axis=-1):
     """Row softmax over stored values only (implicit zeros act as -inf,
-    reference sparse softmax semantics); 2-D COO."""
+    reference sparse softmax semantics); 2-D COO. Threads the values
+    autograd edge like the _unary ops."""
     if not is_sparse(x):
         raise TypeError("sparse.softmax expects a SparseCooTensor")
-    b = x._b.sum_duplicates()
+    has_edge = getattr(x, "_values_tensor", None) is not None
+    b = x._b if has_edge else x._b.sum_duplicates()
     if len(b.shape) != 2 or axis not in (-1, 1):
         raise NotImplementedError("sparse.softmax: 2-D, last axis only")
     rows = b.indices[:, 0]
     n_rows = b.shape[0]
-    vals = b.data.astype(jnp.float32)
-    row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
-    e = jnp.exp(vals - jnp.take(row_max, rows))
-    denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
-    out = e / jnp.take(jnp.maximum(denom, 1e-30), rows)
-    return SparseCooTensor(jsparse.BCOO((out.astype(b.data.dtype),
-                                         b.indices), shape=b.shape))
+
+    def f(v):
+        vals = v.astype(jnp.float32)
+        row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
+        e = jnp.exp(vals - jnp.take(row_max, rows))
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        out = e / jnp.take(jnp.maximum(denom, 1e-30), rows)
+        return out.astype(v.dtype)
+
+    from ..autograd.function import apply
+    out_vals = apply(f, _grad_values(x), name="sparse_softmax")
+    out = SparseCooTensor(jsparse.BCOO((out_vals._data, b.indices),
+                                       shape=b.shape))
+    out._values_tensor = out_vals
+    return out
 
 
 def to_sparse_coo(x, sparse_dim=None):
@@ -321,3 +340,7 @@ def to_sparse_csr(x):
     coo.crows = lambda: Tensor(jnp.asarray(crows), stop_gradient=True)
     coo.cols = lambda: Tensor(b.indices[:, 1], stop_gradient=True)
     return coo
+
+
+# layer/functional surface (imported last: sparse.nn uses this module)
+from . import nn  # noqa: E402,F401

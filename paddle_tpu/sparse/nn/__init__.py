@@ -1,0 +1,90 @@
+"""paddle.sparse.nn layers (reference: python/paddle/sparse/nn/layer/ —
+conv.py Conv3D :239, SubmConv3D :509, pooling.py MaxPool3D :20, plus the
+activation layers)."""
+
+from __future__ import annotations
+
+import math
+
+from ...nn.layer import Layer
+from ...nn import initializer as I
+from . import functional  # noqa: F401
+from . import functional as F
+
+__all__ = ["Conv3D", "SubmConv3D", "MaxPool3D", "ReLU", "Softmax"]
+
+
+class _Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        if groups != 1:
+            raise ValueError("sparse Conv3D supports groups=1 only "
+                             "(reference sparse/nn/layer/conv.py:31)")
+        ks = F._triple(kernel_size)
+        self._kernel_size = list(ks)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        fan_in = in_channels * ks[0] * ks[1] * ks[2]
+        std = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels, out_channels], weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = self.create_parameter(
+            [out_channels], bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std)) \
+            if bias_attr is not False else None
+
+
+class Conv3D(_Conv3D):
+    """Sparse conv3d layer (reference sparse/nn/layer/conv.py:239)."""
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class SubmConv3D(_Conv3D):
+    """Submanifold sparse conv3d layer (reference conv.py:509)."""
+
+    def __init__(self, *args, key=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._key = key
+
+    def forward(self, x):
+        return F.subm_conv3d(x, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation, self._groups,
+                             self._data_format, key=self._key)
+
+
+class MaxPool3D(Layer):
+    """Sparse max pooling layer (reference sparse/nn/layer/pooling.py:20)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.max_pool3d(x, *self._args)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from .. import relu
+        return relu(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from .. import softmax
+        return softmax(x, self._axis)

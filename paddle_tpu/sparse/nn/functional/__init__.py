@@ -1,0 +1,244 @@
+"""Sparse 3-D convolution / pooling functionals (reference:
+python/paddle/sparse/nn/functional/conv.py:199 conv3d, :305 subm_conv3d,
+pooling.py:22 max_pool3d; CUDA kernels paddle/phi/kernels/sparse/
+conv_kernel.h, gpu/conv_kernel.cu, pool_kernel.cu).
+
+TPU-native design: the reference builds a "rulebook" (per kernel-offset
+gather/scatter index pairs) on device with hash tables. Here the rulebook
+is built ONCE on host from the (host-resident) COO coordinates — sparse
+topologies change per sample, not per step, and coordinates are tiny next
+to features — and the FEATURE math runs as pure jnp over the rulebook:
+one [C, M] matmul per live kernel offset plus a segment-sum scatter, which
+is exactly the dense-GEMM-per-offset formulation the MXU wants. Gradients
+flow to values/weight/bias through the framework's normal vjp (the
+rulebook indices are constants of the traced program).
+
+Layouts match the reference: x is a SparseCooTensor [N, D, H, W, C] with
+sparse (N, D, H, W) and dense channel values [nnz, C]; weight is
+[kd, kh, kw, C, M]; only data_format="NDHWC" and groups=1 are supported
+(the reference's sparse conv has the same restrictions,
+sparse/nn/layer/conv.py:31).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ....core.tensor import Tensor, as_tensor
+from ....autograd.function import apply
+from ... import SparseCooTensor
+
+__all__ = ["conv3d", "subm_conv3d", "max_pool3d"]
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        if len(v) != 3:
+            raise ValueError(f"expected 3 values, got {v}")
+        return tuple(int(i) for i in v)
+    return (int(v),) * 3
+
+
+def _coords_values(x: SparseCooTensor):
+    """(coords [nnz, 4], values Tensor [nnz, C]). When x carries a live
+    autograd edge on its values (an upstream sparse op's output), keep it
+    — and skip sum_duplicates, whose row reorder would desynchronize the
+    edge from the coordinates (our ops always emit unique coords)."""
+    vt = getattr(x, "_values_tensor", None)
+    b = x._b if vt is not None else x._b.sum_duplicates()
+    coords = np.asarray(b.indices)          # [nnz, 4] (n, d, h, w)
+    if coords.shape[1] != 4:
+        raise ValueError(
+            "sparse conv3d expects a SparseCooTensor with sparse "
+            "(N, D, H, W) and dense channel values [nnz, C]; got sparse "
+            f"rank {coords.shape[1]}")
+    vals = vt if vt is not None else Tensor(b.data, stop_gradient=True)
+    if vals.ndim == 1:
+        from ....ops.manipulation import reshape
+        vals = reshape(vals, [-1, 1])
+    return coords, vals
+
+
+def _offset_maps(coords, spatial_out, kernel, stride, padding, dilation):
+    """Yield (offset_key, in_rows, out_coords [k, 4]) per kernel offset —
+    the single copy of the mapping math both rulebook modes share."""
+    kd, kh, kw = kernel
+    n = coords[:, 0]
+    dhw = coords[:, 1:4].astype(np.int64)
+    pads = np.array(padding)
+    strides = np.array(stride)
+    dils = np.array(dilation)
+    bound = np.array(spatial_out)
+    for oi in range(kd):
+        for oj in range(kh):
+            for ok in range(kw):
+                top = dhw + pads - np.array([oi, oj, ok]) * dils
+                q, r = np.divmod(top, strides)
+                ok_mask = (r == 0).all(1) & (q >= 0).all(1) & \
+                    (q < bound).all(1)
+                rows = np.nonzero(ok_mask)[0]
+                oc = np.concatenate([n[rows, None], q[rows]], 1)
+                yield (oi, oj, ok), rows, oc
+
+
+_RULEBOOK_CACHE: dict = {}
+_RULEBOOK_CACHE_MAX = 64
+
+
+def _rulebook(coords, spatial_in, kernel, stride, padding, dilation,
+              out_coords=None, ceil_mode=False):
+    """Per-offset (in_rows, out_rows) gather/scatter pairs + the output
+    coordinate set (reference conv_kernel.h ProductRuleBook). Memoized on
+    the coordinate set + geometry: sparse topologies repeat across layers
+    and steps, and the host-side set/dict build would otherwise serialize
+    against device compute every forward (the reference caches rulebooks
+    the same way, keyed by SubmConv3D's `key`)."""
+    ck = (coords.tobytes(), coords.shape, spatial_in, kernel, stride,
+          padding, dilation,
+          None if out_coords is None else out_coords.tobytes(), ceil_mode)
+    hit = _RULEBOOK_CACHE.get(ck)
+    if hit is not None:
+        return hit
+
+    def odim(inp, p, d, k, s):
+        num = inp + 2 * p - d * (k - 1) - 1
+        return (num + s - 1) // s + 1 if ceil_mode else num // s + 1
+
+    spatial_out = tuple(
+        odim(i, p, d, k, s) for i, p, d, k, s in
+        zip(spatial_in, padding, dilation, kernel, stride))
+
+    if out_coords is None:
+        sites = set()
+        raw = []
+        for key, rows, oc in _offset_maps(coords, spatial_out, kernel,
+                                          stride, padding, dilation):
+            raw.append((key, rows, oc))
+            for t in map(tuple, oc):
+                sites.add(t)
+        out_list = sorted(sites)
+        out_index = {t: i for i, t in enumerate(out_list)}
+        book = [(key, rows,
+                 np.asarray([out_index[tuple(t)] for t in oc], np.int64))
+                for key, rows, oc in raw if len(rows)]
+        out_arr = np.asarray(out_list, np.int64).reshape(-1, 4)
+    else:
+        # submanifold: outputs fixed to the given coordinate set
+        out_index = {tuple(t): i
+                     for i, t in enumerate(map(tuple, out_coords))}
+        book = []
+        for key, rows, oc in _offset_maps(coords, spatial_out, kernel,
+                                          stride, padding, dilation):
+            hits = [(rr, out_index[tuple(t)])
+                    for rr, t in zip(rows, map(tuple, oc))
+                    if tuple(t) in out_index]
+            if hits:
+                rr, outs = zip(*hits)
+                book.append((key, np.asarray(rr, np.int64),
+                             np.asarray(outs, np.int64)))
+        out_arr = np.asarray(out_coords, np.int64).reshape(-1, 4)
+
+    result = (book, out_arr, spatial_out)
+    if len(_RULEBOOK_CACHE) >= _RULEBOOK_CACHE_MAX:
+        _RULEBOOK_CACHE.pop(next(iter(_RULEBOOK_CACHE)))
+    _RULEBOOK_CACHE[ck] = result
+    return result
+
+
+def _conv_impl(x, weight, bias, stride, padding, dilation, groups,
+               data_format, submanifold):
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d supports data_format='NDHWC' only "
+                         "(reference restriction)")
+    if groups != 1:
+        raise ValueError("sparse conv3d supports groups=1 only "
+                         "(reference sparse/nn/layer/conv.py:31)")
+    if submanifold and _triple(stride) != (1, 1, 1):
+        raise ValueError(
+            "subm_conv3d requires stride=1: submanifold convolution is "
+            "defined on the input's own coordinate set, which a strided "
+            "output grid cannot index")
+    w_t = as_tensor(weight)
+    kd, kh, kw, cin, m = w_t.shape
+    nb, din, hin, win, c = x.shape
+    if c != cin:
+        raise ValueError(f"weight expects {cin} input channels, x has {c}")
+    coords, vals = _coords_values(x)
+    book, out_coords, (dout, hout, wout) = _rulebook(
+        coords, (din, hin, win), (kd, kh, kw), _triple(stride),
+        _triple(padding), _triple(dilation),
+        out_coords=coords if submanifold else None)
+    out_nnz = len(out_coords)
+    args = [vals, w_t] + ([as_tensor(bias)] if bias is not None else [])
+
+    def f(v, w, *b):
+        out = jnp.zeros((out_nnz, m), jnp.float32)
+        for (oi, oj, ok), rows, outs in book:
+            contrib = v[rows].astype(jnp.float32) @ \
+                w[oi, oj, ok].astype(jnp.float32)
+            out = out.at[outs].add(contrib)
+        if b:
+            out = out + b[0].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    out_vals = apply(lambda *a: f(*a), *args,
+                     name="subm_conv3d" if submanifold else "sparse_conv3d")
+    if submanifold:
+        shape = (nb, din, hin, win, m)
+    else:
+        shape = (nb, dout, hout, wout, m)
+    b = jsparse.BCOO((out_vals._data, jnp.asarray(out_coords)), shape=shape)
+    out = SparseCooTensor(b)
+    out._values_tensor = out_vals  # keeps the autograd edge reachable
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None) -> SparseCooTensor:
+    """Sparse conv3d (reference functional/conv.py:199)."""
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups,
+                      data_format, submanifold=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None,
+                name=None) -> SparseCooTensor:
+    """Submanifold sparse conv3d: output sites == input sites (reference
+    functional/conv.py:305)."""
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups,
+                      data_format, submanifold=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None) -> SparseCooTensor:
+    """Sparse max pooling over occupied sites only (reference
+    functional/pooling.py:22, pool_kernel.cu MaxPool): each output site
+    takes the per-channel max over its CONTRIBUTING input sites — empty
+    positions do not participate (they are not zeros)."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d supports NDHWC only")
+    kernel = _triple(kernel_size)
+    stride = _triple(stride if stride is not None else kernel_size)
+    padding = _triple(padding)
+    nb, din, hin, win, c = x.shape
+    coords, vals = _coords_values(x)
+    book, out_coords, (dout, hout, wout) = _rulebook(
+        coords, (din, hin, win), kernel, stride, padding, (1, 1, 1),
+        ceil_mode=ceil_mode)
+    out_nnz = len(out_coords)
+
+    def f(v):
+        vf = v.astype(jnp.float32)
+        out = jnp.full((out_nnz, vf.shape[-1]), -jnp.inf, jnp.float32)
+        for _, rows, outs in book:
+            out = out.at[outs].max(vf[rows])
+        return out.astype(v.dtype)
+
+    out_vals = apply(f, vals, name="sparse_max_pool3d")
+    b = jsparse.BCOO((out_vals._data, jnp.asarray(out_coords)),
+                     shape=(nb, dout, hout, wout, c))
+    out = SparseCooTensor(b)
+    out._values_tensor = out_vals
+    return out

@@ -1,0 +1,213 @@
+"""Sparse conv3d / subm_conv3d / max_pool3d vs numpy dense references.
+
+Reference semantics under test: python/paddle/sparse/nn/functional/conv.py
+:199/:305, pooling.py:22 and the rulebook kernels
+(paddle/phi/kernels/sparse/conv_kernel.h): NDHWC layout, weight
+[kd,kh,kw,C,M], submanifold keeps the input's coordinate set, and sparse
+max pooling reduces over OCCUPIED sites only (empty != zero).
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+
+
+def np_dense_conv3d(x, w, stride, padding, dilation=1):
+    """Naive NDHWC conv3d, zero padding."""
+    n, d, h, wd, c = x.shape
+    kd, kh, kw, _, m = w.shape
+    s, p, dl = stride, padding, dilation
+    xp = np.pad(x, ((0, 0), (p, p), (p, p), (p, p), (0, 0)))
+    do = (d + 2 * p - dl * (kd - 1) - 1) // s + 1
+    ho = (h + 2 * p - dl * (kh - 1) - 1) // s + 1
+    wo = (wd + 2 * p - dl * (kw - 1) - 1) // s + 1
+    out = np.zeros((n, do, ho, wo, m), np.float32)
+    for b in range(n):
+        for i in range(do):
+            for j in range(ho):
+                for k in range(wo):
+                    acc = np.zeros(m, np.float32)
+                    for a in range(kd):
+                        for bb in range(kh):
+                            for cc in range(kw):
+                                acc += xp[b, i * s + a * dl, j * s + bb * dl,
+                                          k * s + cc * dl] @ w[a, bb, cc]
+                    out[b, i, j, k] = acc
+    return out
+
+
+def _random_sparse(rng, shape, nnz, channels):
+    n, d, h, w, _ = shape
+    seen = set()
+    while len(seen) < nnz:
+        seen.add((int(rng.integers(n)), int(rng.integers(d)),
+                  int(rng.integers(h)), int(rng.integers(w))))
+    coords = np.asarray(sorted(seen)).T                      # [4, nnz]
+    vals = rng.standard_normal((nnz, channels)).astype(np.float32)
+    return sp.sparse_coo_tensor(coords, vals, shape=shape)
+
+
+def test_conv3d_matches_dense_reference():
+    rng = np.random.default_rng(0)
+    shape = (2, 5, 5, 5, 3)
+    x = _random_sparse(rng, shape, nnz=9, channels=3)
+    w = rng.standard_normal((3, 3, 3, 3, 4)).astype(np.float32)
+    y = sp.nn.functional.conv3d(x, paddle.to_tensor(w), stride=1, padding=1)
+    got = y.to_dense().numpy()
+    want = np_dense_conv3d(x.to_dense().numpy(), w, stride=1, padding=1)
+    # empty output sites are absent from the sparse result (bias-free, so
+    # the dense reference is zero there too)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_conv3d_stride2_shape_and_values():
+    rng = np.random.default_rng(1)
+    shape = (1, 6, 6, 6, 2)
+    x = _random_sparse(rng, shape, nnz=7, channels=2)
+    w = rng.standard_normal((2, 2, 2, 2, 5)).astype(np.float32)
+    y = sp.nn.functional.conv3d(x, paddle.to_tensor(w), stride=2, padding=0)
+    assert y.shape == [1, 3, 3, 3, 5]
+    np.testing.assert_allclose(
+        y.to_dense().numpy(),
+        np_dense_conv3d(x.to_dense().numpy(), w, stride=2, padding=0),
+        atol=1e-4)
+
+
+def test_subm_conv3d_keeps_input_sites():
+    rng = np.random.default_rng(2)
+    shape = (1, 5, 5, 5, 3)
+    x = _random_sparse(rng, shape, nnz=6, channels=3)
+    w = rng.standard_normal((3, 3, 3, 3, 3)).astype(np.float32)
+    y = sp.nn.functional.subm_conv3d(x, paddle.to_tensor(w), padding=1)
+    got_idx = set(map(tuple, np.asarray(y.indices().numpy()).T))
+    in_idx = set(map(tuple, np.asarray(x.indices().numpy()).T))
+    assert got_idx == in_idx  # submanifold: coordinate set preserved
+    dense = np_dense_conv3d(x.to_dense().numpy(), w, stride=1, padding=1)
+    got = y.to_dense().numpy()
+    for t in in_idx:
+        np.testing.assert_allclose(got[t], dense[t], atol=1e-4)
+
+
+def test_max_pool3d_occupied_sites_only():
+    """Sparse pooling maxes over OCCUPIED inputs: an all-negative channel
+    must stay negative (dense pooling with implicit zeros would give 0)."""
+    coords = np.array([[0, 0], [0, 0], [0, 1], [0, 1]])     # two sites
+    vals = np.array([[-3.0], [-1.5]], np.float32)
+    x = sp.sparse_coo_tensor(coords, vals, shape=(1, 2, 2, 2, 1))
+    y = sp.nn.functional.max_pool3d(x, kernel_size=2, stride=2)
+    assert y.shape == [1, 1, 1, 1, 1]
+    assert y.nnz == 1
+    np.testing.assert_allclose(y.values().numpy(), [[-1.5]])
+
+
+def test_conv3d_bias_and_gradients():
+    """Gradients through the rulebook: weight/bias/value grads match
+    central finite differences on the dense-equivalent loss."""
+    rng = np.random.default_rng(3)
+    shape = (1, 4, 4, 4, 2)
+    x = _random_sparse(rng, shape, nnz=5, channels=2)
+    w_np = rng.standard_normal((3, 3, 3, 2, 3)).astype(np.float32)
+    b_np = rng.standard_normal(3).astype(np.float32)
+    w = paddle.to_tensor(w_np)
+    w.stop_gradient = False
+    b = paddle.to_tensor(b_np)
+    b.stop_gradient = False
+
+    y = sp.nn.functional.conv3d(x, w, bias=b, padding=1)
+    loss = y._values_tensor.square().sum()
+    loss.backward()
+    assert w.grad is not None and b.grad is not None
+
+    def loss_of(wv, bv):
+        y2 = sp.nn.functional.conv3d(x, paddle.to_tensor(wv),
+                                     bias=paddle.to_tensor(bv), padding=1)
+        return float(y2._values_tensor.square().sum())
+
+    eps = 1e-3
+    for idx in [(0, 0, 0, 0, 0), (1, 2, 1, 1, 2), (2, 2, 2, 1, 0)]:
+        wp, wm = w_np.copy(), w_np.copy()
+        wp[idx] += eps
+        wm[idx] -= eps
+        fd = (loss_of(wp, b_np) - loss_of(wm, b_np)) / (2 * eps)
+        np.testing.assert_allclose(w.grad.numpy()[idx], fd, rtol=2e-2,
+                                   atol=2e-3)
+    bp, bm = b_np.copy(), b_np.copy()
+    bp[1] += eps
+    bm[1] -= eps
+    fd = (loss_of(w_np, bp) - loss_of(w_np, bm)) / (2 * eps)
+    np.testing.assert_allclose(b.grad.numpy()[1], fd, rtol=2e-2, atol=2e-3)
+
+
+def test_subm_conv3d_rejects_stride():
+    import pytest
+    rng = np.random.default_rng(6)
+    x = _random_sparse(rng, (1, 4, 4, 4, 2), nnz=3, channels=2)
+    w = paddle.to_tensor(rng.standard_normal((3, 3, 3, 2, 2), ).astype(
+        np.float32))
+    with pytest.raises(ValueError, match="stride"):
+        sp.nn.functional.subm_conv3d(x, w, stride=2, padding=1)
+
+
+def test_max_pool3d_ceil_mode():
+    """ceil_mode=True keeps the partial trailing window (reference pooling
+    contract): a site at the far corner of a 5^3 grid with kernel 2 stride
+    2 maps to output index 2 instead of being dropped."""
+    coords = np.array([[0], [4], [4], [4]])
+    vals = np.array([[7.0]], np.float32)
+    x = sp.sparse_coo_tensor(coords, vals, shape=(1, 5, 5, 5, 1))
+    y = sp.nn.functional.max_pool3d(x, kernel_size=2, stride=2,
+                                    ceil_mode=True)
+    assert y.shape == [1, 3, 3, 3, 1]
+    idx = np.asarray(y.indices().numpy()).T
+    np.testing.assert_array_equal(idx, [[0, 2, 2, 2]])
+    # floor mode drops it
+    y2 = sp.nn.functional.max_pool3d(x, kernel_size=2, stride=2)
+    assert y2.shape == [1, 2, 2, 2, 1] and y2.nnz == 0
+
+
+def test_softmax_threads_gradients():
+    """Conv3D -> sparse softmax -> loss backpropagates into the conv
+    weights (the values autograd edge survives softmax)."""
+    rng = np.random.default_rng(7)
+    paddle.seed(8)
+    x = _random_sparse(rng, (1, 4, 4, 4, 2), nnz=5, channels=2)
+    conv = sp.nn.Conv3D(2, 3, kernel_size=3, padding=1)
+    y = conv(x)
+    # sparse softmax is 2-D; build one from the conv's value matrix graph
+    import paddle_tpu.sparse as _sp
+    flat = _sp.sparse_coo_tensor(
+        np.stack([np.zeros(y.nnz, np.int64), np.arange(y.nnz)]),
+        np.asarray(y.values().numpy())[:, 0], shape=(1, y.nnz))
+    flat._values_tensor = y._values_tensor[:, 0]
+    out = _sp.softmax(flat)
+    loss = out._values_tensor.square().sum()
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert np.abs(conv.weight.grad.numpy()).max() > 0
+
+
+def test_sparse_layers_train_step():
+    """Conv3D -> ReLU -> SubmConv3D -> MaxPool3D stack runs forward and
+    backward as layers, and a gradient step reduces the loss."""
+    rng = np.random.default_rng(4)
+    paddle.seed(5)
+    shape = (1, 6, 6, 6, 2)
+    x = _random_sparse(rng, shape, nnz=10, channels=2)
+    net_conv = sp.nn.Conv3D(2, 4, kernel_size=3, padding=1)
+    net_subm = sp.nn.SubmConv3D(4, 4, kernel_size=3, padding=1)
+    relu = sp.nn.ReLU()
+    pool = sp.nn.MaxPool3D(kernel_size=2, stride=2)
+    params = net_conv.parameters() + net_subm.parameters()
+    opt = paddle.optimizer.AdamW(5e-2, parameters=params)
+
+    def step():
+        y = pool(net_subm(relu(net_conv(x))))
+        loss = y._values_tensor.square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    losses = [step() for _ in range(6)]
+    assert losses[-1] < losses[0], losses
