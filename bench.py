@@ -349,6 +349,46 @@ def run_kernel_ab(dev):
     res["moe_gemm_xla_ms"] = round(xla, 3)
     res["moe_gemm_speedup"] = round(xla / pal, 3)
     res["moe_fill_fraction"] = round(float(jnp.sum(counts)) / (e * c), 3)
+
+    # fused bias+dropout+residual+layernorm at GPT-3-ish dims, fwd+bwd
+    from paddle_tpu.ops.kernels import bias_dropout_ln_pallas as bd
+    rows, hid = 8192, 4096
+    xb = jnp.asarray(rng.standard_normal((rows, hid)), jnp.bfloat16)
+    resid = jnp.asarray(rng.standard_normal((rows, hid)), jnp.bfloat16)
+    bias = jnp.asarray(rng.standard_normal(hid), jnp.float32)
+    gam = jnp.asarray(rng.standard_normal(hid), jnp.float32)
+    bet = jnp.asarray(rng.standard_normal(hid), jnp.float32)
+    mask2 = jnp.asarray(rng.random((rows, hid)) > 0.1, jnp.float32) / 0.9
+
+    def bd_loss(kern):
+        def f(x_, r_, g_):
+            if kern:
+                y, hsum = bd.bias_dropout_ln(x_, bias, r_, mask2, g_, bet,
+                                             1e-5, False)
+            else:
+                y, hsum = bd.reference_bias_dropout_ln(x_, bias, r_, mask2,
+                                                       g_, bet, 1e-5)
+            return jnp.sum(y.astype(jnp.float32)) + \
+                jnp.sum(hsum.astype(jnp.float32))
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    pal = timed(bd_loss(True), xb, resid, gam)
+    xla = timed(bd_loss(False), xb, resid, gam)
+    res["bias_dropout_ln_pallas_ms"] = round(pal, 3)
+    res["bias_dropout_ln_xla_ms"] = round(xla, 3)
+    res["bias_dropout_ln_speedup"] = round(xla / pal, 3)
+
+    # fused softmax-CE at a 50k vocab, fwd+bwd
+    from paddle_tpu.ops.kernels import ce_pallas as cp
+    nrows, vocab = 4096, 50304
+    lg = jnp.asarray(rng.standard_normal((nrows, vocab)), jnp.bfloat16)
+    lb = jnp.asarray(rng.integers(0, vocab, (nrows,)), jnp.int32)
+    pal = timed(jax.grad(lambda a: jnp.sum(
+        cp.c_softmax_with_cross_entropy(a, lb, 0, None, False))), lg)
+    xla = timed(jax.grad(lambda a: jnp.sum(cp.reference_ce(a, lb))), lg)
+    res["softmax_ce_pallas_ms"] = round(pal, 3)
+    res["softmax_ce_xla_ms"] = round(xla, 3)
+    res["softmax_ce_speedup"] = round(xla / pal, 3)
     return res
 
 

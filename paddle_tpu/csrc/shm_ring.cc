@@ -1,0 +1,170 @@
+// Shared-memory bounded ring queue for the multiprocess DataLoader.
+//
+// Reference analog: paddle/fluid/framework/data_feed.cc + the reference
+// DataLoader's _shared_memory transport (C++ shared-memory batch plane
+// behind use_shared_memory=True). The Python fallback ships every batch
+// through a multiprocessing.Queue (pipe write + pickle + per-batch
+// SharedMemory create/unlink); this core maps ONE arena and moves batch
+// bytes through a lock-free multi-producer/single-consumer bounded queue
+// (Vyukov MPMC: per-slot sequence numbers, C++11 atomics — valid across
+// processes on MAP_SHARED memory).
+//
+// Layout of the arena:
+//   [Header][Slot 0][Slot 1]...[Slot n-1]
+//   Slot = [atomic<u64> seq][u32 len][u8 payload[slot_bytes]]
+//
+// C ABI (driven from Python via ctypes; no pybind11 in this image):
+//   shm_ring_bytes(slots, slot_bytes)        -> arena size to map
+//   shm_ring_init(mem, slots, slot_bytes)    -> 0/-1
+//   shm_ring_push(mem, data, len, spin_us)   -> 0 ok, -1 full-timeout,
+//                                               -2 oversized
+//   shm_ring_pop(mem, out, cap, spin_us)     -> payload len, -1 empty,
+//                                               -2 cap too small
+//
+// Build: g++ -O2 -shared -fPIC shm_ring.cc -o libshm_ring.so  (pure
+// C++17 + libc; loaded by paddle_tpu/io/shm_ring.py).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <new>
+
+namespace {
+
+struct Header {
+  uint32_t magic;
+  uint32_t slots;       // power of two
+  uint32_t slot_bytes;
+  uint32_t pad_;
+  std::atomic<uint64_t> enqueue_pos;
+  std::atomic<uint64_t> dequeue_pos;
+};
+
+struct SlotHead {
+  std::atomic<uint64_t> seq;
+  uint32_t len;
+  uint32_t pad_;
+};
+
+constexpr uint32_t kMagic = 0x52494e47;  // "RING"
+constexpr size_t kAlign = 64;            // cache-line the slot heads
+
+inline size_t slot_stride(uint32_t slot_bytes) {
+  size_t raw = sizeof(SlotHead) + slot_bytes;
+  return (raw + kAlign - 1) / kAlign * kAlign;
+}
+
+inline SlotHead* slot_at(Header* h, uint64_t idx) {
+  auto* base = reinterpret_cast<uint8_t*>(h + 1);
+  return reinterpret_cast<SlotHead*>(
+      base + (idx & (h->slots - 1)) * slot_stride(h->slot_bytes));
+}
+
+inline void backoff(uint32_t spins) {
+  // adaptive: 50us for the first ~5ms of waiting, then 1ms — long waits
+  // (slow datasets, paused consumers) must not burn 20k syscalls/s
+  long ns = spins < 100 ? 50 * 1000 : 1000 * 1000;
+  timespec ts{0, ns};
+  nanosleep(&ts, nullptr);
+}
+
+inline uint64_t now_us() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+}  // namespace
+
+extern "C" {
+
+size_t shm_ring_bytes(uint32_t slots, uint32_t slot_bytes) {
+  return sizeof(Header) + static_cast<size_t>(slots) *
+      slot_stride(slot_bytes);
+}
+
+int shm_ring_init(void* mem, uint32_t slots, uint32_t slot_bytes) {
+  if (mem == nullptr || slots == 0 || (slots & (slots - 1)) != 0) return -1;
+  auto* h = new (mem) Header();
+  h->magic = kMagic;
+  h->slots = slots;
+  h->slot_bytes = slot_bytes;
+  h->enqueue_pos.store(0, std::memory_order_relaxed);
+  h->dequeue_pos.store(0, std::memory_order_relaxed);
+  for (uint64_t i = 0; i < slots; ++i) {
+    auto* s = slot_at(h, i);
+    s->seq.store(i, std::memory_order_relaxed);
+    s->len = 0;
+  }
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  return 0;
+}
+
+int shm_ring_push(void* mem, const uint8_t* data, uint32_t len,
+                  int64_t timeout_us) {
+  auto* h = static_cast<Header*>(mem);
+  if (h->magic != kMagic) return -1;
+  if (len > h->slot_bytes) return -2;
+  const uint64_t deadline = timeout_us < 0 ? ~0ull : now_us() + timeout_us;
+  uint64_t pos = h->enqueue_pos.load(std::memory_order_relaxed);
+  uint32_t spins = 0;
+  for (;;) {
+    SlotHead* s = slot_at(h, pos);
+    uint64_t seq = s->seq.load(std::memory_order_acquire);
+    intptr_t diff = static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos);
+    if (diff == 0) {
+      if (h->enqueue_pos.compare_exchange_weak(
+              pos, pos + 1, std::memory_order_relaxed)) {
+        std::memcpy(reinterpret_cast<uint8_t*>(s + 1), data, len);
+        s->len = len;
+        s->seq.store(pos + 1, std::memory_order_release);  // publish
+        return 0;
+      }
+      // CAS lost: pos was refreshed by compare_exchange
+    } else if (diff < 0) {
+      if (now_us() >= deadline) return -1;  // full
+      backoff(spins++);
+      pos = h->enqueue_pos.load(std::memory_order_relaxed);
+    } else {
+      pos = h->enqueue_pos.load(std::memory_order_relaxed);
+    }
+  }
+}
+
+int shm_ring_pop(void* mem, uint8_t* out, uint32_t cap, int64_t timeout_us) {
+  auto* h = static_cast<Header*>(mem);
+  if (h->magic != kMagic) return -1;
+  const uint64_t deadline = timeout_us < 0 ? ~0ull : now_us() + timeout_us;
+  uint64_t pos = h->dequeue_pos.load(std::memory_order_relaxed);
+  uint32_t spins = 0;
+  for (;;) {
+    SlotHead* s = slot_at(h, pos);
+    uint64_t seq = s->seq.load(std::memory_order_acquire);
+    intptr_t diff = static_cast<intptr_t>(seq) -
+        static_cast<intptr_t>(pos + 1);
+    if (diff == 0) {
+      if (h->dequeue_pos.compare_exchange_weak(
+              pos, pos + 1, std::memory_order_relaxed)) {
+        const uint32_t len = s->len;
+        if (len > cap) {
+          // roll back: the slot stays consumable
+          h->dequeue_pos.store(pos, std::memory_order_relaxed);
+          s->seq.store(seq, std::memory_order_release);
+          return -2;
+        }
+        std::memcpy(out, reinterpret_cast<uint8_t*>(s + 1), len);
+        s->seq.store(pos + h->slots, std::memory_order_release);  // free
+        return static_cast<int>(len);
+      }
+    } else if (diff < 0) {
+      if (now_us() >= deadline) return -1;  // empty
+      backoff(spins++);
+      pos = h->dequeue_pos.load(std::memory_order_relaxed);
+    } else {
+      pos = h->dequeue_pos.load(std::memory_order_relaxed);
+    }
+  }
+}
+
+}  // extern "C"

@@ -14,6 +14,8 @@ from __future__ import annotations
 import itertools
 import multiprocessing as mp
 import os
+import pickle
+import time
 import queue as queue_mod
 import threading
 import traceback
@@ -104,11 +106,51 @@ def np_collate(batch):
 
 # -- worker loop -------------------------------------------------------------
 
+class _RingSender:
+    """Worker-side transport over the native shared-memory ring
+    (csrc/shm_ring.cc). Large arrays still ride per-array shm refs (one
+    worker-side + one parent-side copy, same as the pipe path — inlining
+    them would ADD pickle copies); the ring replaces the Queue pipe for
+    the messages themselves, cutting the pipe write/read syscalls and the
+    feeder-thread latency for small batches."""
+
+    def __init__(self, name, slots, slot_bytes):
+        from .shm_ring import ShmRing
+        self._ring = ShmRing.attach(name, slots, slot_bytes)
+        self._slot_bytes = slot_bytes
+
+    def put(self, msg):
+        if msg[0] == "ok":
+            batch_idx, data = msg[2]
+            msg = (msg[0], msg[1], (batch_idx, _encode(data)))
+        blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        if len(blob) > self._slot_bytes:
+            # still oversized after per-array encoding (e.g. huge text
+            # batches, many sub-threshold arrays): ship the whole blob via
+            # one shm segment and push only the small ref — the worker
+            # must never die on a big batch the Queue path would deliver
+            shm = shared_memory.SharedMemory(create=True, size=len(blob))
+            shm.buf[:len(blob)] = blob
+            name = shm.name
+            shm.close()
+            blob = pickle.dumps(("__blob__", name, len(blob)),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+        self._ring.push(blob, timeout=None)
+
+
 def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
-                 num_workers, init_fn, base_seed, iterable, use_shm):
+                 num_workers, init_fn, base_seed, iterable, use_shm,
+                 ring_spec=None):
     _set_worker_info(WorkerInfo(worker_id, num_workers, base_seed + worker_id,
                                 dataset))
     np.random.seed((base_seed + worker_id) % (2 ** 31))
+    inline_ring = ring_spec is not None
+    if inline_ring:
+        try:
+            out_queue = _RingSender(*ring_spec)
+        except Exception:
+            out_queue.put(("error", worker_id, traceback.format_exc()))
+            return
     try:
         if init_fn is not None:
             init_fn(worker_id)
@@ -134,7 +176,7 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
             else:
                 samples = [dataset[i] for i in payload]
             data = collate_fn(samples)
-            if use_shm:
+            if use_shm and not inline_ring:
                 data = _encode(data)
             out_queue.put(("ok", worker_id, (batch_idx, data)))
         except Exception:
@@ -161,13 +203,35 @@ class MultiprocessLoaderIter:
         self._out_queue = ctx.Queue()
         self._index_queues = [ctx.SimpleQueue() for _ in range(num_workers)]
         base_seed = int(np.random.randint(0, 2 ** 31 - 1))
+
+        # native shared-memory ring transport (csrc/shm_ring.cc) when the
+        # toolchain built it; Queue pipe otherwise. Opt out with
+        # PADDLE_TPU_LOADER_RING=0.
+        self._ring = None
+        ring_spec = None
+        if use_shm and os.environ.get("PADDLE_TPU_LOADER_RING", "1") != "0":
+            try:
+                from .shm_ring import ShmRing, available
+                if available():
+                    slots = 1
+                    want = num_workers * max(prefetch_factor, 1) * 2
+                    while slots < max(want, 8):
+                        slots *= 2
+                    slot_bytes = int(os.environ.get(
+                        "PADDLE_TPU_LOADER_RING_SLOT_BYTES", str(4 << 20)))
+                    self._ring = ShmRing(slots=slots, slot_bytes=slot_bytes)
+                    ring_spec = (self._ring.name, slots, slot_bytes)
+            except Exception:
+                self._ring = None
+                ring_spec = None
+
         self._workers = []
         for w in range(num_workers):
             p = ctx.Process(
                 target=_worker_loop,
                 args=(dataset, self._index_queues[w], self._out_queue,
                       collate_np, w, num_workers, worker_init_fn, base_seed,
-                      iterable, use_shm),
+                      iterable, use_shm, ring_spec),
                 daemon=True)
             p.start()
             self._workers.append(p)
@@ -222,7 +286,7 @@ class MultiprocessLoaderIter:
                 self.shutdown()
                 raise StopIteration
             try:
-                kind, w, payload = self._out_queue.get(timeout=self._timeout)
+                kind, w, payload = self._recv()
             except queue_mod.Empty:
                 self.shutdown()
                 raise RuntimeError(
@@ -240,6 +304,39 @@ class MultiprocessLoaderIter:
             batch_idx, data = payload
             self._reorder[batch_idx] = _decode(data)
 
+    def _recv(self):
+        if self._ring is None:
+            return self._out_queue.get(timeout=self._timeout)
+        # dead-worker defense: poll in short slices so a crashed producer
+        # surfaces as Empty/timeout instead of an infinite block; the
+        # slice respects sub-second user timeouts
+        deadline = None if self._timeout is None else \
+            (self._timeout + time.monotonic())
+        slice_s = min(self._timeout, 1.0) if self._timeout else 1.0
+        while True:
+            blob = self._ring.pop(timeout=slice_s)
+            if blob is not None:
+                msg = pickle.loads(blob)
+                if isinstance(msg, tuple) and msg and msg[0] == "__blob__":
+                    _, name, size = msg
+                    seg = shared_memory.SharedMemory(name=name)
+                    try:
+                        msg = pickle.loads(bytes(seg.buf[:size]))
+                    finally:
+                        seg.close()
+                        seg.unlink()
+                return msg
+            # a worker that failed BEFORE attaching the ring reports its
+            # traceback on the bootstrap Queue
+            try:
+                return self._out_queue.get_nowait()
+            except queue_mod.Empty:
+                pass
+            if any(not p.is_alive() for p in self._workers):
+                raise queue_mod.Empty
+            if deadline is not None and time.monotonic() > deadline:
+                raise queue_mod.Empty
+
     def shutdown(self):
         for q, p in zip(self._index_queues, self._workers):
             try:
@@ -251,6 +348,9 @@ class MultiprocessLoaderIter:
             if p.is_alive():
                 p.terminate()
         self._workers = []
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
 
     def __del__(self):
         try:

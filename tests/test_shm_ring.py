@@ -1,0 +1,137 @@
+"""Native shared-memory ring (csrc/shm_ring.cc) + DataLoader transport.
+
+Reference analog: the C++ shared-memory batch plane behind the reference
+DataLoader's use_shared_memory=True (data_feed.cc)."""
+
+import multiprocessing as mp
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io.shm_ring import ShmRing, available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="no C++ toolchain for shm_ring")
+
+
+def test_ring_semantics():
+    r = ShmRing(slots=8, slot_bytes=1024)
+    try:
+        assert r.push(b"a") and r.push(b"b" * 500)
+        assert r.pop() == b"a"
+        assert r.pop() == b"b" * 500
+        assert r.pop(timeout=0.05) is None          # empty -> timeout
+        for i in range(8):
+            assert r.push(f"m{i}".encode())
+        assert not r.push(b"x", timeout=0.05)       # full -> timeout
+        for i in range(8):
+            assert r.pop() == f"m{i}".encode()
+        with pytest.raises(ValueError):
+            r.push(b"x" * 2000)                     # oversized -> raises
+    finally:
+        r.close()
+
+
+def _producer(name, pid, count):
+    ring = ShmRing.attach(name, 16, 4096)
+    for i in range(count):
+        ring.push(pickle.dumps((pid, i)), timeout=30)
+
+
+def test_ring_multiprocess_fifo_per_producer():
+    r = ShmRing(slots=16, slot_bytes=4096)
+    try:
+        procs = [mp.get_context("fork").Process(
+            target=_producer, args=(r.name, p, 40)) for p in range(3)]
+        for p in procs:
+            p.start()
+        got = [pickle.loads(r.pop(timeout=30)) for _ in range(120)]
+        for p in procs:
+            p.join()
+        per = {p: [i for q, i in got if q == p] for p in range(3)}
+        assert all(per[p] == list(range(40)) for p in range(3)), per
+    finally:
+        r.close()
+
+
+def _loader_batches(**kw):
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Ds(Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return (np.full((4,), i, np.float32), np.int64(i))
+
+    dl = DataLoader(Ds(), batch_size=8, num_workers=2, shuffle=False, **kw)
+    out = [(x.numpy(), y.numpy()) for x, y in dl]
+    return out
+
+
+def test_loader_ring_transport_matches_queue_transport():
+    """Batches through the native ring == batches through the Queue pipe
+    == the expected deterministic order."""
+    ring = _loader_batches()
+    os.environ["PADDLE_TPU_LOADER_RING"] = "0"
+    try:
+        pipe = _loader_batches()
+    finally:
+        os.environ.pop("PADDLE_TPU_LOADER_RING", None)
+    assert len(ring) == len(pipe) == 4
+    for (xr, yr), (xp, yp) in zip(ring, pipe):
+        np.testing.assert_array_equal(xr, xp)
+        np.testing.assert_array_equal(yr, yp)
+    np.testing.assert_array_equal(ring[0][1], np.arange(8))
+
+
+def test_loader_ring_oversized_blob_without_big_arrays():
+    """A batch whose PICKLE exceeds the slot without containing any
+    >=1 MiB array (e.g. text) ships via the whole-blob shm fallback
+    instead of killing the worker."""
+    from paddle_tpu.io import DataLoader, Dataset
+
+    os.environ["PADDLE_TPU_LOADER_RING_SLOT_BYTES"] = str(1 << 14)  # 16 KiB
+    try:
+        class Text(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return f"{i}:" + "x" * 30000  # ~30 KB strings
+
+        dl = DataLoader(Text(), batch_size=2, num_workers=2, shuffle=False,
+                        collate_fn=lambda b: list(b))
+        batches = list(dl)
+    finally:
+        os.environ.pop("PADDLE_TPU_LOADER_RING_SLOT_BYTES", None)
+    assert len(batches) == 4
+    assert batches[0][0].startswith("0:")
+    assert batches[3][1].startswith("7:")
+
+
+def test_loader_ring_oversized_batches_fall_back_to_shm_refs():
+    """A batch bigger than a ring slot ships as per-array shm refs with
+    only the small ref message in the ring."""
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+
+    os.environ["PADDLE_TPU_LOADER_RING_SLOT_BYTES"] = str(1 << 16)  # 64 KiB
+    try:
+        class Big(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return np.full((1 << 18,), i, np.float32)  # 1 MiB sample
+
+        dl = DataLoader(Big(), batch_size=2, num_workers=2, shuffle=False)
+        batches = [b.numpy() for b in dl]
+    finally:
+        os.environ.pop("PADDLE_TPU_LOADER_RING_SLOT_BYTES", None)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(batches[0][0], np.zeros(1 << 18))
+    np.testing.assert_array_equal(batches[1][1], np.full(1 << 18, 3.0))
