@@ -36,10 +36,15 @@ def _contains_moe(model) -> bool:
                for _, sub in model.named_sublayers(include_self=True))
 
 
-def _decode_fn(model, total, do_sample, top_k, has_eos):
+def _decode_fn(model, total, do_sample, top_k, has_eos, prompt_len):
     """One compiled whole-decode loop, cached per static config. Signature:
     (buffer [B,total] i64, start [B] i64, key [2] u32, temp f32, eos i64)
-    -> filled buffer. Shape specialization (batch) is to_static's cache."""
+    -> filled buffer. Shape specialization (batch) is to_static's cache.
+
+    Models exposing `init_cache` decode incrementally: one full-prompt
+    prefill populates static [B, total, H, D] KV buffers, then each loop
+    step feeds ONE token — O(total^2) attention FLOPs for the whole decode
+    instead of the cacheless path's O(total^3)."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -49,7 +54,9 @@ def _decode_fn(model, total, do_sample, top_k, has_eos):
     cache = getattr(model, "_decode_fns", None)
     if cache is None:
         cache = model._decode_fns = {}
-    cfg = (total, do_sample, top_k, has_eos)
+    use_cache = callable(getattr(model, "init_cache", None))
+    cfg = (total, do_sample, top_k, has_eos,
+           prompt_len if use_cache else None)
     if cfg in cache:
         return cache[cfg]
 
@@ -58,6 +65,11 @@ def _decode_fn(model, total, do_sample, top_k, has_eos):
         def f(buf, start_a, key_a, temp_a, eos_a):
             b = buf.shape[0]
             s0 = start_a.reshape(())
+
+            if use_cache:
+                return _cached_decode(
+                    model, buf, prompt_len, key_a, temp_a, eos_a, total,
+                    do_sample, top_k, has_eos)
 
             def cond(c):
                 i, _, fin = c
@@ -74,20 +86,8 @@ def _decode_fn(model, total, do_sample, top_k, has_eos):
                     lg, jnp.full((b, 1, 1), 0, jnp.int32) + (i - 1)
                     .astype(jnp.int32), axis=1)[:, 0, :]
                 arr = last.astype(jnp.float32)
-                if do_sample:
-                    arr = arr / jnp.maximum(temp_a, 1e-6)
-                    if top_k is not None and top_k < arr.shape[-1]:
-                        kth = jax.lax.top_k(arr, top_k)[0][:, -1:]
-                        arr = jnp.where(arr < kth, -jnp.inf, arr)
-                    g = jax.random.gumbel(
-                        jax.random.fold_in(key_a, i.astype(jnp.uint32)),
-                        arr.shape)
-                    nxt = jnp.argmax(arr + g, axis=-1).astype(jnp.int64)
-                else:
-                    nxt = jnp.argmax(arr, axis=-1).astype(jnp.int64)
-                if has_eos:
-                    nxt = jnp.where(fin, eos_a, nxt)
-                    fin = fin | (nxt == eos_a)
+                nxt, fin = _sample_next(arr, fin, i, key_a, temp_a, eos_a,
+                                        do_sample, top_k, has_eos)
                 buf = jax.lax.dynamic_update_slice(
                     buf, nxt[:, None], (jnp.int64(0), i))
                 return i + 1, buf, fin
@@ -107,6 +107,136 @@ def _decode_fn(model, total, do_sample, top_k, has_eos):
 
     cache[cfg] = decode
     return decode
+
+
+def cached_attention(q, k, v, cache, cache_pos):
+    """Incremental attention against a static-length KV cache (the
+    TPU-native decode shape: fixed [B, T, Hkv, D] buffers updated with a
+    dynamic slice; masking hides positions past the current length, so
+    stale buffer contents can never leak into the output). Model-agnostic:
+    GQA attends via a grouped einsum over the shared kv heads — the cache
+    is never expanded (no HBM repeat on the hot decode path).
+
+    q/k/v: [B, s, H(_kv), D] for the s new positions starting at
+    cache_pos; cache: (k_buf, v_buf) Tensors [B, T, Hkv, D].
+    Returns (out [B, s, H, D], new (k_buf, v_buf))."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..autograd.function import apply_multi
+    from ..core.tensor import as_tensor
+
+    pos = as_tensor(cache_pos)._data.reshape(()) \
+        if not isinstance(cache_pos, int) else cache_pos
+    k_buf, v_buf = cache
+
+    def f(qa, ka, va, kb, vb):
+        b, s, hq, d = qa.shape
+        t = kb.shape[1]
+        start = jnp.asarray(pos, jnp.int32)
+        z = jnp.int32(0)
+        kb = jax.lax.dynamic_update_slice(
+            kb, ka.astype(kb.dtype), (z, start, z, z))
+        vb = jax.lax.dynamic_update_slice(
+            vb, va.astype(vb.dtype), (z, start, z, z))
+        h_kv = kb.shape[2]
+        rep = hq // h_kv
+        qg = qa.reshape(b, s, h_kv, rep, d).astype(jnp.float32)
+        scale = 1.0 / math.sqrt(d)
+        logits = jnp.einsum("bsgrd,btgd->bgrst", qg,
+                            kb.astype(jnp.float32)) * scale
+        rows = start + jnp.arange(s)                    # absolute q pos
+        mask = jnp.arange(t)[None, None, None, None, :] <= \
+            rows[None, None, None, :, None]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bgrst,btgd->bsgrd", probs,
+                         vb.astype(jnp.float32))
+        return out.reshape(b, s, hq, d).astype(qa.dtype), kb, vb
+
+    out, kb2, vb2 = apply_multi(f, q, k, v, k_buf, v_buf,
+                                name="cached_attention")
+    return out, (kb2, vb2)
+
+
+def _sample_next(arr, fin, i, key_a, temp_a, eos_a, do_sample, top_k,
+                 has_eos):
+    """Shared per-step token selection (temperature/top-k/gumbel/eos)."""
+    import jax
+    import jax.numpy as jnp
+
+    if do_sample:
+        arr = arr / jnp.maximum(temp_a, 1e-6)
+        if top_k is not None and top_k < arr.shape[-1]:
+            kth = jax.lax.top_k(arr, top_k)[0][:, -1:]
+            arr = jnp.where(arr < kth, -jnp.inf, arr)
+        g = jax.random.gumbel(
+            jax.random.fold_in(key_a, i.astype(jnp.uint32)), arr.shape)
+        nxt = jnp.argmax(arr + g, axis=-1).astype(jnp.int64)
+    else:
+        nxt = jnp.argmax(arr, axis=-1).astype(jnp.int64)
+    if has_eos:
+        nxt = jnp.where(fin, eos_a, nxt)
+        fin = fin | (nxt == eos_a)
+    return nxt, fin
+
+
+def _cached_decode(model, buf, s, key_a, temp_a, eos_a, total, do_sample,
+                   top_k, has_eos):
+    """Incremental decode over the model's KV cache: prefill the prompt
+    once, then one-token steps inside a lax.while_loop. `s` (prompt
+    length) is static; the cache buffers ride the loop carry."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..autograd.grad_mode import no_grad
+    from ..core.tensor import Tensor
+
+    b = buf.shape[0]
+    caches = [(k._data, v._data) for k, v in model.init_cache(b, total)]
+
+    with no_grad():
+        if s > 1:
+            _, new_c = model(Tensor(buf[:, :s - 1]),
+                             caches=[(Tensor(k), Tensor(v))
+                                     for k, v in caches],
+                             cache_pos=Tensor(jnp.int64(0)))
+            caches = [(k._data, v._data) for k, v in new_c]
+
+    def cond(c):
+        i, _, fin = c[0], c[1], c[2]
+        return (i < total) & ~jnp.all(fin)
+
+    def body(c):
+        i, buf, fin = c[0], c[1], c[2]
+        flat = c[3:]
+        cache_ts = [(Tensor(flat[2 * j]), Tensor(flat[2 * j + 1]))
+                    for j in range(len(flat) // 2)]
+        tok = jax.lax.dynamic_slice(buf, (jnp.int64(0), i - 1), (b, 1))
+        with no_grad():
+            logits, new_c = model(Tensor(tok), caches=cache_ts,
+                                  cache_pos=Tensor(i - 1))
+        arr = logits._data[:, 0, :].astype(jnp.float32)
+        nxt, fin = _sample_next(arr, fin, i, key_a, temp_a, eos_a,
+                                do_sample, top_k, has_eos)
+        buf = jax.lax.dynamic_update_slice(
+            buf, nxt[:, None], (jnp.int64(0), i))
+        out = [i + 1, buf, fin]
+        for k, v in new_c:
+            out.extend((k._data, v._data))
+        return tuple(out)
+
+    carry0 = [jnp.int64(s), buf, jnp.zeros((b,), jnp.bool_)]
+    for k, v in caches:
+        carry0.extend((k, v))
+    final = jax.lax.while_loop(cond, body, tuple(carry0))
+    i_f, buf_f = final[0], final[1]
+    if has_eos:
+        pos = jnp.arange(total, dtype=jnp.int64)[None, :]
+        buf_f = jnp.where(pos >= i_f, eos_a, buf_f)
+    return buf_f
 
 
 def _generate_moe_hostloop(model, buf, s, total, temperature, top_k,
@@ -180,7 +310,7 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
                                          top_k, do_sample, eos_token_id, key)
         else:
             fn = _decode_fn(model, total, bool(do_sample), top_k,
-                            eos_token_id is not None)
+                            eos_token_id is not None, s)
             out = fn(paddle.to_tensor(buf),
                      paddle.to_tensor(np.full((1,), s, np.int64)),
                      paddle.to_tensor(np.asarray(key)),

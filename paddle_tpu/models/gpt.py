@@ -54,10 +54,14 @@ class CausalSelfAttention(nn.Layer):
                                   initializer=proj_init))
         self.dropout = cfg.dropout
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cache_pos=None):
         b, s, h = x.shape
         qkv = self.qkv(x).reshape([b, s, 3, self.n_head, self.head_dim])
         q, k, v = paddle.unbind(qkv, axis=2)
+        if cache is not None:
+            from .generation import cached_attention
+            out, new_cache = cached_attention(q, k, v, cache, cache_pos)
+            return self.proj(out.reshape([b, s, h])), new_cache
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=self.dropout,
             training=self.training)
@@ -89,7 +93,12 @@ class Block(nn.Layer):
         self.ln2 = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_epsilon)
         self.mlp = MLP(cfg)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cache_pos=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln1(x), cache, cache_pos)
+            x = x + a
+            x = x + self.mlp(self.ln2(x))
+            return x, new_cache
         x = x + self.attn(self.ln1(x))
         x = x + self.mlp(self.ln2(x))
         return x
@@ -111,18 +120,46 @@ class GPT(GenerationMixin, nn.Layer):
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      weight_attr=attr, bias_attr=False)
 
-    def forward(self, input_ids, labels=None):
+    def init_cache(self, batch, max_len, dtype="float32"):
+        """Zeroed per-layer (k, v) buffers [B, T, H, D] for incremental
+        decode (the static-shape KV cache generate() threads through its
+        compiled loop)."""
+        import jax.numpy as jnp
+        shape = (batch, max_len, self.cfg.num_heads,
+                 self.cfg.hidden_size // self.cfg.num_heads)
+        return [(paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))),
+                 paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))))
+                for _ in self.blocks]
+
+    def _head(self, x):
+        """Shared final-norm + (tied) projection — ONE copy so the decode
+        cache branch can never drift from the training head."""
+        x = self.ln_f(x)
+        if self.cfg.tie_word_embeddings:
+            return paddle.matmul(x, self.wte.weight, transpose_y=True)
+        return self.lm_head(x)
+
+    def forward(self, input_ids, labels=None, caches=None, cache_pos=None):
         b, s = input_ids.shape
+        if caches is not None:
+            from ..autograd.function import apply
+            import jax.numpy as jnp
+            start = paddle.to_tensor(cache_pos) \
+                if isinstance(cache_pos, int) else cache_pos
+            pos = apply(lambda p: (p.reshape(()) + jnp.arange(s))[None, :],
+                        start, name="cache_positions")
+            x = self.wte(input_ids) + self.wpe(pos)
+            new_caches = []
+            for blk, c in zip(self.blocks, caches):
+                x, nc = blk(x, c, cache_pos)
+                new_caches.append(nc)
+            return self._head(x), new_caches
         pos = paddle.arange(s, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         for blk in self.blocks:
             x = blk(x)
-        x = self.ln_f(x)
-        if self.cfg.tie_word_embeddings:
-            logits = paddle.matmul(x, self.wte.weight, transpose_y=True)
-        else:
-            logits = self.lm_head(x)
+        logits = self._head(x)
         if labels is not None:
             loss = F.cross_entropy(
                 logits.reshape([-1, self.cfg.vocab_size]).cast("float32"),

@@ -100,12 +100,17 @@ class LlamaAttention(nn.Layer):
             self.o_proj = nn.Linear(q_out, cfg.hidden_size, weight_attr=o_attr,
                                     bias_attr=False)
 
-    def forward(self, x, cos, sin):
+    def forward(self, x, cos, sin, cache=None, cache_pos=None):
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, self.n_head, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.n_kv, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.n_kv, self.head_dim])
         q, k = F.rope(q, k, sin, cos)
+        if cache is not None:
+            from .generation import cached_attention
+            out, new_cache = cached_attention(q, k, v, cache, cache_pos)
+            return self.o_proj(
+                out.reshape([b, s, self.n_head * self.head_dim])), new_cache
         # kv heads stay at n_kv: SDPA handles GQA natively — the flash
         # kernel reads each shared kv head via its index map (no HBM repeat)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
@@ -151,14 +156,19 @@ class LlamaDecoderLayer(nn.Layer):
                                                    cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg, parallel=parallel)
 
-    def forward(self, x, cos, sin):
-        attn_out = self.self_attn(self.input_layernorm(x), cos, sin)
+    def forward(self, x, cos, sin, cache=None, cache_pos=None):
+        if cache is not None:
+            attn_out, new_cache = self.self_attn(
+                self.input_layernorm(x), cos, sin, cache, cache_pos)
+        else:
+            attn_out = self.self_attn(self.input_layernorm(x), cos, sin)
         # fused residual-add + rmsnorm (one VMEM pass on TPU): y = norm(x +
         # attn_out) and h = x + attn_out come from the same kernel
         y, h = F.fused_rms_norm_add(attn_out, x,
                                     self.post_attention_layernorm.weight,
                                     self.post_attention_layernorm._epsilon)
-        return h + self.mlp(y)
+        out = h + self.mlp(y)
+        return (out, new_cache) if cache is not None else out
 
 
 class Llama(GenerationMixin, nn.Layer):
@@ -178,22 +188,70 @@ class Llama(GenerationMixin, nn.Layer):
         self._rope_cache: dict[int, tuple] = {}
 
     def _rope(self, s):
-        if s not in self._rope_cache:
-            self._rope_cache[s] = _rope_tables(self.cfg, s)
-        return self._rope_cache[s]
+        hit = self._rope_cache.get(s)
+        if hit is not None:
+            return hit
+        tables = _rope_tables(self.cfg, s)
+        import jax
+        # never memoize tables built INSIDE a trace: to_tensor lifts the
+        # numpy constants to tracers there, and a cached tracer leaks into
+        # every later trace (UnexpectedTracerError on the next generate)
+        if not any(isinstance(t._data, jax.core.Tracer) for t in tables):
+            self._rope_cache[s] = tables
+        return tables
 
-    def forward(self, input_ids, labels=None):
+    def _head(self, x):
+        """Shared final-norm + (tied) projection — ONE copy so the decode
+        cache branch can never drift from the training head."""
+        x = self.norm(x)
+        if self.cfg.tie_word_embeddings:
+            return paddle.matmul(x, self.embed_tokens.weight,
+                                 transpose_y=True)
+        return self.lm_head(x)
+
+    def init_cache(self, batch, max_len, dtype="float32"):
+        """Zeroed per-layer (k, v) buffers [B, T, n_kv, D] for incremental
+        decode (GQA caches store the shared kv heads, not the expanded
+        ones)."""
+        import jax.numpy as jnp
+        shape = (batch, max_len, self.cfg.num_kv_heads, self.cfg.head_dim)
+        return [(paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))),
+                 paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))))
+                for _ in self.layers]
+
+    def forward(self, input_ids, labels=None, caches=None, cache_pos=None):
         b, s = input_ids.shape
+        if caches is not None:
+            from ..autograd.function import apply_multi
+            import jax
+            # rope tables for the s absolute positions starting at
+            # cache_pos, sliced from the full-length tables
+            cos_f, sin_f = self._rope(self.cfg.max_position_embeddings)
+            start = paddle.to_tensor(cache_pos) \
+                if isinstance(cache_pos, int) else cache_pos
+
+            def pick(c, si, p):
+                import jax.numpy as jnp
+                z = jnp.int32(0)
+                st = (z, p.reshape(()).astype(jnp.int32), z, z)
+                return (jax.lax.dynamic_slice(
+                            c, st, (1, s, 1, c.shape[-1])),
+                        jax.lax.dynamic_slice(
+                            si, st, (1, s, 1, si.shape[-1])))
+
+            cos, sin = apply_multi(pick, cos_f, sin_f, start,
+                                   name="rope_slice")
+            x = self.embed_tokens(input_ids)
+            new_caches = []
+            for layer, c in zip(self.layers, caches):
+                x, nc = layer(x, cos, sin, c, cache_pos)
+                new_caches.append(nc)
+            return self._head(x), new_caches
         cos, sin = self._rope(s)
         x = self.embed_tokens(input_ids)
         for layer in self.layers:
             x = layer(x, cos, sin)
-        x = self.norm(x)
-        if self.cfg.tie_word_embeddings:
-            logits = paddle.matmul(x, self.embed_tokens.weight,
-                                   transpose_y=True)
-        else:
-            logits = self.lm_head(x)
+        logits = self._head(x)
         if labels is not None:
             loss = F.cross_entropy(
                 logits.reshape([-1, self.cfg.vocab_size]).cast("float32"),

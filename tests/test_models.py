@@ -302,3 +302,32 @@ def test_generate_unseeded_calls_differ():
     with pytest.raises(ValueError, match="top_k"):
         m.generate(p, max_new_tokens=2, do_sample=True, top_k=0)
     assert m.training
+
+
+def test_generate_kv_cache_matches_cacheless():
+    """The incremental KV-cache decode (prefill + one-token steps) emits
+    EXACTLY the same tokens as the cacheless full-forward loop, for GPT
+    (MHA + learned positions) and Llama (GQA + rope at offset
+    positions), greedy and seeded sampling."""
+    from paddle_tpu.models import GPT, GPTConfig, llama_tiny
+    paddle.seed(31)
+    gpt = GPT(GPTConfig(vocab_size=96, max_position_embeddings=32,
+                        hidden_size=32, num_layers=2, num_heads=4))
+    llama = llama_tiny()
+    prompt = np.array([[5, 6, 7], [9, 3, 1]], np.int64)
+    for m in (gpt, llama):
+        pr = prompt if m is gpt else prompt[:1]
+        cached_g = m.generate(paddle.to_tensor(pr), max_new_tokens=7)
+        cached_s = m.generate(paddle.to_tensor(pr), max_new_tokens=7,
+                              do_sample=True, top_k=5, seed=11)
+        m._decode_fns = {}
+        m.init_cache = None  # disable: generate falls back to full forward
+        try:
+            plain_g = m.generate(paddle.to_tensor(pr), max_new_tokens=7)
+            plain_s = m.generate(paddle.to_tensor(pr), max_new_tokens=7,
+                                 do_sample=True, top_k=5, seed=11)
+        finally:
+            del m.init_cache
+            m._decode_fns = {}
+        np.testing.assert_array_equal(cached_g, plain_g)
+        np.testing.assert_array_equal(cached_s, plain_s)
