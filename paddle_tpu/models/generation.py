@@ -202,7 +202,8 @@ def _cached_decode(model, buf, s, key_a, temp_a, eos_a, total, do_sample,
             _, new_c = model(Tensor(buf[:, :s - 1]),
                              caches=[(Tensor(k), Tensor(v))
                                      for k, v in caches],
-                             cache_pos=Tensor(jnp.int64(0)))
+                             cache_pos=Tensor(jnp.int64(0)),
+                             with_head=False)
             caches = [(k._data, v._data) for k, v in new_c]
 
     def cond(c):

@@ -139,7 +139,8 @@ class GPT(GenerationMixin, nn.Layer):
             return paddle.matmul(x, self.wte.weight, transpose_y=True)
         return self.lm_head(x)
 
-    def forward(self, input_ids, labels=None, caches=None, cache_pos=None):
+    def forward(self, input_ids, labels=None, caches=None, cache_pos=None,
+                with_head=True):
         b, s = input_ids.shape
         if caches is not None:
             from ..autograd.function import apply
@@ -153,7 +154,9 @@ class GPT(GenerationMixin, nn.Layer):
             for blk, c in zip(self.blocks, caches):
                 x, nc = blk(x, c, cache_pos)
                 new_caches.append(nc)
-            return self._head(x), new_caches
+            # prefill only needs the caches: skip the [s, hidden x vocab]
+            # projection whose logits would be discarded
+            return (self._head(x) if with_head else None), new_caches
         pos = paddle.arange(s, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)

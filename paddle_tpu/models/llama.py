@@ -219,7 +219,8 @@ class Llama(GenerationMixin, nn.Layer):
                  paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))))
                 for _ in self.layers]
 
-    def forward(self, input_ids, labels=None, caches=None, cache_pos=None):
+    def forward(self, input_ids, labels=None, caches=None, cache_pos=None,
+                with_head=True):
         b, s = input_ids.shape
         if caches is not None:
             from ..autograd.function import apply_multi
@@ -246,7 +247,9 @@ class Llama(GenerationMixin, nn.Layer):
             for layer, c in zip(self.layers, caches):
                 x, nc = layer(x, cos, sin, c, cache_pos)
                 new_caches.append(nc)
-            return self._head(x), new_caches
+            # prefill only needs the caches: skip the [s, hidden x vocab]
+            # projection whose logits would be discarded
+            return (self._head(x) if with_head else None), new_caches
         cos, sin = self._rope(s)
         x = self.embed_tokens(input_ids)
         for layer in self.layers:

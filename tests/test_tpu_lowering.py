@@ -251,6 +251,28 @@ def test_flagship_train_step_lowers_with_kernels(forced_dispatch):
     assert_mosaic(txt)
 
 
+def test_cached_decode_loop_lowers(forced_dispatch):
+    """The whole incremental-decode program — prefill + KV-cache
+    while_loop with on-device sampling — lowers for TPU with kernels
+    dispatched (rope rides its Pallas kernel inside the loop body)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.models.generation import _cached_decode
+
+    paddle.seed(1)
+    model = llama_tiny()
+    model.eval()
+    buf = jnp.zeros((1, 24), jnp.int64)
+    key = jnp.zeros((2,), jnp.uint32)
+
+    def fn(buf, key, temp, eos):
+        return _cached_decode(model, buf, 4, key, temp, eos, 24,
+                              True, 5, True)
+
+    assert_mosaic(lower_tpu(fn, buf, key, jnp.float32(0.8), jnp.int64(1)))
+
+
 def test_llama_forward_lowers_with_kernels(forced_dispatch):
     """Llama (rmsnorm + rope + flash attention in one program) lowers for
     TPU — the three transformer-glue kernels compose in-context."""
