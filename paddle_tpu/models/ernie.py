@@ -108,13 +108,51 @@ class Ernie(GenerationMixin, nn.Layer):
                                      weight_attr=attr, bias_attr=False)
         self._rope_cache: dict[int, tuple] = {}
         self.l_aux = None
+        if cfg.num_experts:
+            self.init_cache = None  # MoE: generate() must not cache
 
     def _rope(self, s):
-        if s not in self._rope_cache:
-            self._rope_cache[s] = _rope_tables(self.cfg.as_llama(), s)
-        return self._rope_cache[s]
+        from .llama import _rope_memo
+        return _rope_memo(self._rope_cache, s,
+                          lambda: _rope_tables(self.cfg.as_llama(), s))
 
-    def forward(self, input_ids, labels=None):
+    def _head(self, x):
+        x = self.norm(x)
+        if self.cfg.tie_word_embeddings:
+            return paddle.matmul(x, self.embed_tokens.weight,
+                                 transpose_y=True)
+        return self.lm_head(x)
+
+    def init_cache(self, batch, max_len, dtype="float32"):
+        """Dense ERNIE decodes over the KV cache (its layers ARE Llama
+        decoder layers). The MoE variant nulls this out in __init__ so
+        generate() keeps its exact-length host loop (capacity routing is
+        not causal)."""
+        from .llama import _init_kv_cache
+        return _init_kv_cache(len(self.layers), batch, max_len,
+                              self.cfg.num_kv_heads, self.cfg.head_dim,
+                              dtype)
+
+    def forward(self, input_ids, labels=None, caches=None, cache_pos=None,
+                with_head=True):
+        if caches is not None:
+            if self.cfg.num_experts:
+                raise ValueError(
+                    "MoE ERNIE cannot decode over a KV cache: per-token "
+                    "capacity routing is not causal, so incremental "
+                    "logits would silently diverge from the full forward")
+            from .llama import _sliced_rope
+            s = input_ids.shape[1]
+            cos_f, sin_f = self._rope(self.cfg.max_position_embeddings)
+            start = paddle.to_tensor(cache_pos) \
+                if isinstance(cache_pos, int) else cache_pos
+            cos, sin = _sliced_rope(cos_f, sin_f, start, s)
+            x = self.embed_tokens(input_ids)
+            new_caches = []
+            for layer, c in zip(self.layers, caches):
+                x, nc = layer(x, cos, sin, c, cache_pos)
+                new_caches.append(nc)
+            return (self._head(x) if with_head else None), new_caches
         cos, sin = self._rope(input_ids.shape[1])
         x = self.embed_tokens(input_ids)
         auxes = []
@@ -123,12 +161,7 @@ class Ernie(GenerationMixin, nn.Layer):
             aux = getattr(layer, "l_aux", None)
             if aux is not None:
                 auxes.append(aux)
-        x = self.norm(x)
-        if self.cfg.tie_word_embeddings:
-            logits = paddle.matmul(x, self.embed_tokens.weight,
-                                   transpose_y=True)
-        else:
-            logits = self.lm_head(x)
+        logits = self._head(x)
         self.l_aux = sum(auxes[1:], auxes[0]) if auxes else None
         if labels is not None:
             loss = F.cross_entropy(

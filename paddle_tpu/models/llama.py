@@ -58,6 +58,49 @@ def _rope_tables(cfg: LlamaConfig, seq_len: int, dtype="float32"):
             paddle.to_tensor(sin.reshape(shape).astype(dtype)))
 
 
+def _init_kv_cache(n_layers, batch, max_len, n_kv, head_dim,
+                   dtype="float32"):
+    """Zeroed per-layer (k, v) cache buffers [B, T, n_kv, D] (shared by
+    every rope/GQA decoder family — Llama and dense ERNIE)."""
+    import jax.numpy as jnp
+    shape = (batch, max_len, n_kv, head_dim)
+    return [(paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))),
+             paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))))
+            for _ in range(n_layers)]
+
+
+def _sliced_rope(cos_f, sin_f, start, s):
+    """Slice [1, T, 1, d] rope tables at `start` for s absolute positions
+    (the incremental-decode rope lookup; one copy for all families)."""
+    import jax
+
+    from ..autograd.function import apply_multi
+
+    def pick(c, si, p):
+        import jax.numpy as jnp
+        z = jnp.int32(0)
+        st = (z, p.reshape(()).astype(jnp.int32), z, z)
+        return (jax.lax.dynamic_slice(c, st, (1, s, 1, c.shape[-1])),
+                jax.lax.dynamic_slice(si, st, (1, s, 1, si.shape[-1])))
+
+    return apply_multi(pick, cos_f, sin_f, start, name="rope_slice")
+
+
+def _rope_memo(cache, key, build):
+    """Memoize rope tables, but never tables built INSIDE a trace:
+    to_tensor lifts the numpy constants to tracers there, and a cached
+    tracer leaks into every later trace (UnexpectedTracerError on the
+    next generate)."""
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    tables = build()
+    import jax
+    if not any(isinstance(t._data, jax.core.Tracer) for t in tables):
+        cache[key] = tables
+    return tables
+
+
 class LlamaAttention(nn.Layer):
     """GQA attention; `parallel=True` shards heads over mp via Column/Row."""
 
@@ -188,17 +231,8 @@ class Llama(GenerationMixin, nn.Layer):
         self._rope_cache: dict[int, tuple] = {}
 
     def _rope(self, s):
-        hit = self._rope_cache.get(s)
-        if hit is not None:
-            return hit
-        tables = _rope_tables(self.cfg, s)
-        import jax
-        # never memoize tables built INSIDE a trace: to_tensor lifts the
-        # numpy constants to tracers there, and a cached tracer leaks into
-        # every later trace (UnexpectedTracerError on the next generate)
-        if not any(isinstance(t._data, jax.core.Tracer) for t in tables):
-            self._rope_cache[s] = tables
-        return tables
+        return _rope_memo(self._rope_cache, s,
+                          lambda: _rope_tables(self.cfg, s))
 
     def _head(self, x):
         """Shared final-norm + (tied) projection — ONE copy so the decode
@@ -213,35 +247,20 @@ class Llama(GenerationMixin, nn.Layer):
         """Zeroed per-layer (k, v) buffers [B, T, n_kv, D] for incremental
         decode (GQA caches store the shared kv heads, not the expanded
         ones)."""
-        import jax.numpy as jnp
-        shape = (batch, max_len, self.cfg.num_kv_heads, self.cfg.head_dim)
-        return [(paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))),
-                 paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))))
-                for _ in self.layers]
+        return _init_kv_cache(len(self.layers), batch, max_len,
+                              self.cfg.num_kv_heads, self.cfg.head_dim,
+                              dtype)
 
     def forward(self, input_ids, labels=None, caches=None, cache_pos=None,
                 with_head=True):
         b, s = input_ids.shape
         if caches is not None:
-            from ..autograd.function import apply_multi
-            import jax
             # rope tables for the s absolute positions starting at
             # cache_pos, sliced from the full-length tables
             cos_f, sin_f = self._rope(self.cfg.max_position_embeddings)
             start = paddle.to_tensor(cache_pos) \
                 if isinstance(cache_pos, int) else cache_pos
-
-            def pick(c, si, p):
-                import jax.numpy as jnp
-                z = jnp.int32(0)
-                st = (z, p.reshape(()).astype(jnp.int32), z, z)
-                return (jax.lax.dynamic_slice(
-                            c, st, (1, s, 1, c.shape[-1])),
-                        jax.lax.dynamic_slice(
-                            si, st, (1, s, 1, si.shape[-1])))
-
-            cos, sin = apply_multi(pick, cos_f, sin_f, start,
-                                   name="rope_slice")
+            cos, sin = _sliced_rope(cos_f, sin_f, start, s)
             x = self.embed_tokens(input_ids)
             new_caches = []
             for layer, c in zip(self.layers, caches):

@@ -309,13 +309,14 @@ def test_generate_kv_cache_matches_cacheless():
     EXACTLY the same tokens as the cacheless full-forward loop, for GPT
     (MHA + learned positions) and Llama (GQA + rope at offset
     positions), greedy and seeded sampling."""
-    from paddle_tpu.models import GPT, GPTConfig, llama_tiny
+    from paddle_tpu.models import GPT, GPTConfig, llama_tiny, ernie_tiny
     paddle.seed(31)
     gpt = GPT(GPTConfig(vocab_size=96, max_position_embeddings=32,
                         hidden_size=32, num_layers=2, num_heads=4))
     llama = llama_tiny()
+    ernie = ernie_tiny()  # dense variant: Llama layers + rope offsets
     prompt = np.array([[5, 6, 7], [9, 3, 1]], np.int64)
-    for m in (gpt, llama):
+    for m in (gpt, llama, ernie):
         pr = prompt if m is gpt else prompt[:1]
         cached_g = m.generate(paddle.to_tensor(pr), max_new_tokens=7)
         cached_s = m.generate(paddle.to_tensor(pr), max_new_tokens=7,
