@@ -50,6 +50,12 @@ class ErnieConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
 
+    def __post_init__(self):
+        if self.num_experts and self.num_experts_per_tok > self.num_experts:
+            raise ValueError(
+                f"num_experts_per_tok ({self.num_experts_per_tok}) cannot "
+                f"exceed num_experts ({self.num_experts})")
+
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads

@@ -48,6 +48,21 @@ class Qwen2MoeConfig:
     initializer_range: float = 0.02
     expert_parallel_axis: str = "dp"
 
+    def __post_init__(self):
+        # unlike ErnieConfig, there is no dense-at-zero mode here: layers
+        # past first_k_dense_replace are ALWAYS MoE
+        if self.num_experts <= 0:
+            raise ValueError(
+                f"Qwen2Moe needs num_experts >= 1, got {self.num_experts} "
+                "(the dense variant is LlamaConfig / ErnieConfig with "
+                "num_experts=0)")
+        if self.num_experts_per_tok > self.num_experts:
+            raise ValueError(
+                f"num_experts_per_tok ({self.num_experts_per_tok}) cannot "
+                f"exceed num_experts ({self.num_experts}) — the router's "
+                "top-k has nothing to select from (fails deep inside "
+                "lax.top_k otherwise)")
+
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads

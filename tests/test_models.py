@@ -180,7 +180,7 @@ def test_ernie_for_pipeline_builds_moe_descs():
     cfg = ErnieConfig(vocab_size=128, max_position_embeddings=16,
                       hidden_size=32, num_layers=6, num_heads=4,
                       num_kv_heads=2, intermediate_size=64, num_experts=4,
-                      moe_intermediate_size=32,
+                      num_experts_per_tok=2, moe_intermediate_size=32,
                       shared_expert_intermediate_size=32, first_k_dense=2,
                       router_aux_loss_coef=0.02)
     pl = ernie_for_pipeline(cfg, seq_len=16, num_stages=2)
@@ -332,3 +332,20 @@ def test_generate_kv_cache_matches_cacheless():
             m._decode_fns = {}
         np.testing.assert_array_equal(cached_g, plain_g)
         np.testing.assert_array_equal(cached_s, plain_s)
+
+
+def test_moe_config_validates_top_k():
+    """num_experts_per_tok > num_experts fails at CONFIG time with a clear
+    message, not deep inside lax.top_k at first forward."""
+    import pytest
+    from paddle_tpu.models import ErnieConfig
+    from paddle_tpu.models.qwen2_moe import Qwen2MoeConfig
+
+    with pytest.raises(ValueError, match="num_experts_per_tok"):
+        ErnieConfig(num_experts=4)  # default per_tok=6
+    with pytest.raises(ValueError, match="num_experts_per_tok"):
+        Qwen2MoeConfig(num_experts=2, num_experts_per_tok=4)
+    with pytest.raises(ValueError, match="num_experts >= 1"):
+        Qwen2MoeConfig(num_experts=0)  # no dense-at-zero mode here
+    ErnieConfig(num_experts=8)      # valid: 6 <= 8
+    ErnieConfig()                   # dense: no constraint
