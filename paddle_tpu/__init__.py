@@ -70,8 +70,12 @@ from .framework.io import save, load  # noqa: F401
 from .framework.framework import (  # noqa: F401
     CPUPlace, CUDAPlace, TPUPlace, get_device, set_device, is_compiled_with_cuda,
     is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_custom_device,
-    in_dynamic_mode, device_count,
+    in_dynamic_mode, device_count, enable_static, disable_static,
+    set_printoptions, CUDAPinnedPlace, get_cuda_rng_state,
+    set_cuda_rng_state,
 )
+from .framework import ParamAttr  # noqa: F401
+from .core.dtype import DType as dtype  # noqa: F401
 from .framework.parameter import create_parameter  # noqa: F401
 from .batch import batch  # noqa: F401
 
@@ -138,3 +142,22 @@ def __getattr__(name):
         globals()["utils"] = mod
         return mod
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def DataParallel(layers, strategy=None, comm_buffer_size_MB=25,
+                 last_comm_buffer_size_MB=1, find_unused_parameters=False,
+                 group=None):
+    """Reference paddle.DataParallel(layer): data-parallel wrapper. Under
+    SPMD the wrapping is fleet.distributed_model over a dp-only topology;
+    if fleet was never initialized, initialize a pure-dp world first
+    (matching the reference's init_parallel_env + DataParallel pairing)."""
+    from .distributed.fleet import DistributedStrategy, fleet
+    from .distributed.topology import get_hybrid_communicate_group
+    if get_hybrid_communicate_group() is None:
+        import jax
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": len(jax.devices()), "mp_degree": 1,
+                            "pp_degree": 1, "sharding_degree": 1,
+                            "sep_degree": 1}
+        fleet.init(is_collective=True, strategy=s)
+    return fleet.distributed_model(layers)

@@ -235,7 +235,9 @@ class Tensor:
     def __repr__(self):
         prefix = "Parameter" if isinstance(self, Parameter) else "Tensor"
         try:
-            body = np.array2string(self.numpy(), precision=6, separator=", ")
+            from ..framework.framework import _tensor_print_options
+            with np.printoptions(**_tensor_print_options):
+                body = np.array2string(self.numpy(), separator=", ")
         except Exception:
             body = f"<traced {self._data}>"
         return (f"{prefix}(shape={self.shape}, dtype={self.dtype.name}, "

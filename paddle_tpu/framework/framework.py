@@ -98,5 +98,70 @@ def is_compiled_with_custom_device(device_type: str = "tpu") -> bool:
 
 
 def in_dynamic_mode() -> bool:
+    if _static_mode:
+        return False
     from ..jit.api import in_to_static_trace
     return not in_to_static_trace()
+
+
+_static_mode = False
+
+
+def enable_static():
+    """Reference paddle.enable_static. Under this framework the traced
+    jaxpr IS the static program (paddle.static docstring), so the flag
+    only flips what in_dynamic_mode()/in_dygraph_mode() report — code
+    gated on it (e.g. dynamic_decode's imperative-vs-declarative split in
+    the reference) takes its static branch, and graph capture still goes
+    through jit.to_static."""
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    """Reference paddle.disable_static (the default mode here)."""
+    global _static_mode
+    _static_mode = False
+
+
+_tensor_print_options = {"precision": 6}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference paddle.set_printoptions: affects TENSOR repr only (a
+    numpy.printoptions context is applied around each Tensor render —
+    process-global numpy printing is untouched)."""
+    opts = _tensor_print_options
+    if precision is not None:
+        opts["precision"] = precision
+    if threshold is not None:
+        opts["threshold"] = threshold
+    if edgeitems is not None:
+        opts["edgeitems"] = edgeitems
+    if linewidth is not None:
+        opts["linewidth"] = linewidth
+    if sci_mode is not None:
+        opts["suppress"] = not sci_mode
+
+
+class CUDAPinnedPlace:
+    """Reference paddle.CUDAPinnedPlace: page-locked host memory. The TPU
+    analog is the pinned_host memory space the ZeRO-offload path already
+    uses (distributed/sharding pinned-host streaming)."""
+
+    def __repr__(self):
+        return "Place(tpu_pinned)"
+
+
+def get_cuda_rng_state():
+    """Reference get_cuda_rng_state (checkpoint code saves device RNG
+    state): returns the framework generator states — on TPU there is one
+    threefry key tree, not per-device CUDA states."""
+    from ..core import generator as gen_mod
+    return [gen_mod.default_generator.get_state()]
+
+
+def set_cuda_rng_state(state_list):
+    from ..core import generator as gen_mod
+    gen_mod.default_generator.set_state(state_list[0])

@@ -17,6 +17,7 @@ __all__ = [
     "eigvalsh", "lu", "lu_unpack", "pca_lowrank", "cond", "cov", "corrcoef",
     "householder_product",
     "multi_dot", "cross", "histogram", "histogramdd", "bincount", "t",
+    'mv',
 ]
 
 
@@ -301,3 +302,8 @@ def t(input, name=None) -> Tensor:
     if x.ndim < 2:
         return x
     return apply(lambda a: jnp.swapaxes(a, -1, -2), x, name="t")
+
+
+def mv(x, vec, name=None) -> Tensor:
+    """Matrix-vector product (reference linalg.py mv)."""
+    return apply(lambda a, v: a @ v, x, vec, name="mv")

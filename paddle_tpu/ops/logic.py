@@ -10,6 +10,7 @@ from ._generated import (  # noqa: F401  (generated from ops.yaml)
     logical_and, logical_or, logical_xor, logical_not, bitwise_and,
     bitwise_or, bitwise_xor, bitwise_not, bitwise_left_shift,
     bitwise_right_shift,
+    equal_, not_equal_, less_than_, less_equal_, greater_than_, greater_equal_, logical_and_, logical_or_, logical_xor_, logical_not_, bitwise_and_, bitwise_or_, bitwise_xor_, bitwise_not_,
 )
 
 __all__ = [
@@ -17,6 +18,7 @@ __all__ = [
     "greater_equal", "equal_all", "allclose", "isclose", "logical_and",
     "logical_or", "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
     "bitwise_not", "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
+    'equal_', 'not_equal_', 'less_than_', 'less_equal_', 'greater_than_', 'greater_equal_', 'logical_and_', 'logical_or_', 'logical_xor_', 'logical_not_', 'bitwise_and_', 'bitwise_or_', 'bitwise_xor_', 'bitwise_not_',
     "is_empty", "isreal", "iscomplex",
 ]
 

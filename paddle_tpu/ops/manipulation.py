@@ -23,6 +23,7 @@ __all__ = [
     "setitem_", "crop", "tensordot", "einsum", "tolist", "atleast_1d",
     "atleast_2d", "atleast_3d", "select_scatter", "diagonal_scatter",
     'unflatten', 'vsplit', 'hsplit', 'dsplit', 'tensor_split', 'hstack', 'vstack', 'dstack', 'column_stack', 'row_stack', 'take', 'index_fill', 'index_sample', 'shard_index', 'as_strided', 'multiplex',
+    'reverse', 'scatter_nd', 'unfold', 'squeeze_', 'unsqueeze_', 'transpose_', 't_', 'tril_', 'triu_', 'scatter_', 'masked_fill_', 'where_',
 ]
 
 
@@ -772,3 +773,94 @@ def multiplex(inputs, index, name=None) -> Tensor:
         return stacked[i.reshape(-1).astype(jnp.int64), rows]
 
     return apply(f, as_tensor(index), *ts, name="multiplex")
+
+
+def reverse(x, axis, name=None) -> Tensor:
+    """Reference manipulation reverse (legacy spelling of flip)."""
+    return flip(x, axis, name=name)
+
+
+def scatter_nd(index, updates, shape, name=None) -> Tensor:
+    """Scatter-add updates into a ZERO tensor of `shape` (reference
+    scatter_nd: scatter_nd_add against zeros)."""
+    def f(i, u):
+        base = jnp.zeros(tuple(shape), u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return base.at[idx].add(u)
+    return apply(f, as_tensor(index), as_tensor(updates), name="scatter_nd")
+
+
+def unfold(x, axis, size, step, name=None) -> Tensor:
+    """Sliding windows over `axis` (reference tensor unfold: returns
+    [..., n_windows, ..., size] with the window dim appended last)."""
+    xt = as_tensor(x)
+    ax = axis % xt.ndim
+    if step <= 0:
+        raise ValueError(f"unfold step must be positive, got {step}")
+    if size > xt.shape[ax]:
+        raise ValueError(
+            f"unfold size {size} exceeds axis {axis} length "
+            f"{xt.shape[ax]}")
+    n = (xt.shape[ax] - size) // step + 1
+
+    def f(a):
+        starts = jnp.arange(n) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]   # [n, size]
+        win = jnp.take(a, idx, axis=ax)  # [..., n, size, ...]
+        # reference layout: window extent becomes the LAST axis
+        return jnp.moveaxis(win, ax + 1, -1)
+    return apply(f, xt, name="unfold")
+
+
+# -- in-place variants (reference *_ surface; rebind contract) --------------
+
+def squeeze_(x, axis=None, name=None) -> Tensor:
+    from .math import _rebind
+    return _rebind(x, squeeze(x, axis))
+
+
+def unsqueeze_(x, axis, name=None) -> Tensor:
+    from .math import _rebind
+    return _rebind(x, unsqueeze(x, axis))
+
+
+def transpose_(x, perm=None, name=None) -> Tensor:
+    from .math import _rebind
+    return _rebind(x, transpose(x, perm))
+
+
+def t_(input, name=None) -> Tensor:
+    from .math import _rebind
+    from .linalg import t
+    return _rebind(input, t(input))
+
+
+def tril_(x, diagonal=0, name=None) -> Tensor:
+    from .math import _rebind
+    from .creation import tril
+    return _rebind(x, tril(x, diagonal))
+
+
+def triu_(x, diagonal=0, name=None) -> Tensor:
+    from .math import _rebind
+    from .creation import triu
+    return _rebind(x, triu(x, diagonal))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None) -> Tensor:
+    from .math import _rebind
+    return _rebind(x, scatter(x, index, updates, overwrite))
+
+
+def masked_fill_(x, mask, value, name=None) -> Tensor:
+    from .math import _rebind
+    return _rebind(x, masked_fill(x, mask, value))
+
+
+def where_(condition, x=None, y=None, name=None):
+    if x is None or y is None:
+        raise ValueError(
+            "where_ is the in-place form and needs both x and y (the "
+            "condition-only nonzero() form has no in-place target)")
+    from .math import _rebind
+    return _rebind(x, where(condition, x, y))

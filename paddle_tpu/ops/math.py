@@ -27,7 +27,8 @@ from ._generated import (  # noqa: F401  (generated from ops.yaml)
     floor_divide, remainder, remainder_, pow, pow_, maximum, minimum, fmax,
     fmin, atan2, logaddexp, hypot, nextafter, heaviside, ldexp, kron, gcd,
     lcm, copysign, fmod, floor_mod, exp2, sgn, signbit, isneginf, isposinf,
-    i0e, i1e,
+    i0e, i1e, i0_,
+    acos_, atan_, cos_, sin_, sinh_, tan_, expm1_, digamma_, lgamma_, log2_, log10_, erf_, neg_, square_, gcd_, lcm_, hypot_, ldexp_, floor_divide_, floor_mod_,
 )
 
 __all__ = [
@@ -49,6 +50,12 @@ __all__ = [
     'logcumsumexp', 'trace', 'renorm', 'vander', 'nanquantile', 'rank', 'shape',
     "copysign", "fmod", "floor_mod", "exp2", "sgn", "signbit", "isneginf",
     "isposinf", "i0e", "i1e",
+    'acos_', 'atan_', 'cos_', 'sin_', 'sinh_', 'tan_', 'expm1_', 'digamma_', 'lgamma_', 'log2_', 'log10_', 'erf_', 'neg_', 'square_', 'gcd_', 'lcm_', 'hypot_', 'ldexp_', 'floor_divide_', 'floor_mod_',
+    "add_n", "broadcast_shape", "cdist", "cumulative_trapezoid", "dist",
+    "frexp", "multigammaln", "multigammaln_", "polar", "is_complex",
+    "is_floating_point", "is_integer", "cumsum_", "cumprod_", "nan_to_num_",
+    "logit_", "frac_", "addmm_", "renorm_", "cast_", "mod_",
+    "polygamma_", "i0_",
 ]
 
 mod = remainder
@@ -296,3 +303,150 @@ def rank(input, name=None) -> Tensor:
 def shape(input, name=None) -> Tensor:
     """Shape as a 1-D int32 tensor (reference shape op)."""
     return Tensor(jnp.asarray(as_tensor(input).shape, jnp.int32))
+
+
+def _rebind(x, out) -> Tensor:
+    """In-place rebind contract (the generated inplace-variant semantics):
+    x adopts out's storage and autograd edge and is returned."""
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def add_n(inputs, name=None) -> Tensor:
+    """Elementwise sum of a tensor list (reference math.py add_n)."""
+    ts = [as_tensor(t) for t in (inputs if isinstance(inputs, (list, tuple))
+                                 else [inputs])]
+    return apply(lambda *arrs: sum(arrs[1:], arrs[0]), *ts, name="add_n")
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Broadcast result shape of two shapes (reference math.py
+    broadcast_shape; pure shape arithmetic, no tensors)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None) -> Tensor:
+    """Pairwise p-distance between row vectors (reference math.py cdist):
+    x [..., M, D], y [..., N, D] -> [..., M, N]."""
+    def f(a, b):
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        if np.isinf(p):
+            return jnp.max(diff, axis=-1)
+        return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+    return apply(f, x, y, name="cdist")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None) -> Tensor:
+    """Cumulative trapezoidal integral (reference math.py
+    cumulative_trapezoid; output has size-1 shorter `axis`)."""
+    step = 1.0 if dx is None and x is None else dx
+
+    def f(ya, *maybe_x):
+        y1 = jnp.take(ya, jnp.arange(1, ya.shape[axis]), axis=axis)
+        y0 = jnp.take(ya, jnp.arange(0, ya.shape[axis] - 1), axis=axis)
+        if maybe_x:
+            xa = maybe_x[0]
+            d = jnp.diff(xa, axis=axis)
+        else:
+            d = step
+        return jnp.cumsum((y0 + y1) * 0.5 * d, axis=axis)
+
+    args = [y] + ([x] if x is not None else [])
+    return apply(lambda *a: f(*a), *args, name="cumulative_trapezoid")
+
+
+def dist(x, y, p=2.0, name=None) -> Tensor:
+    """p-norm of (x - y) (reference math.py dist)."""
+    def f(a, b):
+        d = jnp.abs(a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == -np.inf:  # must precede isinf: isinf(-inf) is True too
+            return jnp.min(d)
+        if np.isinf(p):
+            return jnp.max(d)
+        return jnp.sum(d ** p) ** (1.0 / p)
+    return apply(f, x, y, name="dist")
+
+
+def frexp(x, name=None):
+    """(mantissa, exponent) with x = mantissa * 2**exponent (reference
+    math.py frexp; mantissa in [0.5, 1))."""
+    from ..autograd.function import apply_multi
+    return apply_multi(
+        lambda a: tuple(jnp.frexp(a)[i].astype(a.dtype if i == 0
+                                               else jnp.int32)
+                        for i in (0, 1)), x, name="frexp")
+
+
+def multigammaln(x, p, name=None) -> Tensor:
+    """Log multivariate gamma (reference math.py multigammaln)."""
+    return apply(lambda a: jax.scipy.special.multigammaln(a, p), x,
+                 name="multigammaln")
+
+
+def multigammaln_(x, p, name=None) -> Tensor:
+    return _rebind(x, multigammaln(x, p))
+
+
+def polar(abs, angle, name=None) -> Tensor:
+    """Complex tensor from magnitude + phase (reference math.py polar)."""
+    return apply(lambda r, t: (r * jnp.cos(t) +
+                               1j * (r * jnp.sin(t))).astype(jnp.complex64),
+                 abs, angle, name="polar")
+
+
+def is_complex(x) -> bool:
+    return bool(jnp.issubdtype(as_tensor(x)._data.dtype, jnp.complexfloating))
+
+
+def is_floating_point(x) -> bool:
+    return bool(jnp.issubdtype(as_tensor(x)._data.dtype, jnp.floating))
+
+
+def is_integer(x) -> bool:
+    return bool(jnp.issubdtype(as_tensor(x)._data.dtype, jnp.integer))
+
+
+# hand-written in-place variants (reference *_ surface)
+def cumsum_(x, axis=None, dtype=None, name=None) -> Tensor:
+    return _rebind(x, cumsum(x, axis, dtype))
+
+
+def cumprod_(x, dim=None, dtype=None, name=None) -> Tensor:
+    return _rebind(x, cumprod(x, dim, dtype))
+
+
+def nan_to_num_(x, nan=0.0, posinf=None, neginf=None, name=None) -> Tensor:
+    return _rebind(x, nan_to_num(x, nan, posinf, neginf))
+
+
+def logit_(x, eps=None, name=None) -> Tensor:
+    return _rebind(x, logit(x, eps))
+
+
+def frac_(x, name=None) -> Tensor:
+    return _rebind(x, frac(x))
+
+
+def addmm_(input, x, y, beta=1.0, alpha=1.0, name=None) -> Tensor:
+    return _rebind(input, addmm(input, x, y, beta, alpha))
+
+
+def renorm_(x, p, axis, max_norm, name=None) -> Tensor:
+    return _rebind(x, renorm(x, p, axis, max_norm))
+
+
+def cast_(x, dtype, name=None) -> Tensor:
+    return _rebind(x, cast(x, dtype))
+
+
+mod_ = remainder_
+
+
+def polygamma_(x, n, name=None) -> Tensor:
+    return _rebind(x, polygamma(x, n))

@@ -15,6 +15,7 @@ __all__ = [
     "rand", "randn", "uniform", "normal", "gaussian", "standard_normal",
     "randint", "randint_like", "randperm", "bernoulli", "multinomial",
     "poisson", "exponential_", "uniform_", "normal_", "binomial", "standard_gamma",
+    'cauchy_', 'geometric_',
 ]
 
 
@@ -140,3 +141,25 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
 def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
     x._data = mean + std * jax.random.normal(_key(), tuple(x.shape), x._data.dtype)
     return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None) -> Tensor:
+    """In-place fill with Cauchy samples (reference random cauchy_)."""
+    from .math import _rebind
+    x = as_tensor(x)
+    u = jax.random.uniform(_key(), tuple(x.shape),
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    vals = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    return _rebind(x, Tensor(vals.astype(x._data.dtype)))
+
+
+def geometric_(x, probs, name=None) -> Tensor:
+    """In-place fill with geometric samples (reference random geometric_;
+    number of Bernoulli(p) trials until first success, support 1, 2, ...)."""
+    from .math import _rebind
+    x = as_tensor(x)
+    p = probs._data if isinstance(probs, Tensor) else probs
+    u = jax.random.uniform(_key(), tuple(x.shape),
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    vals = jnp.ceil(jnp.log(u) / jnp.log1p(-p))
+    return _rebind(x, Tensor(vals.astype(x._data.dtype)))

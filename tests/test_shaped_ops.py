@@ -27,6 +27,8 @@ import pytest
 import scipy
 import scipy.special
 import scipy.linalg
+import scipy.spatial.distance
+import scipy.integrate
 
 import paddle_tpu as paddle
 from paddle_tpu.ops import op_gen
