@@ -10,7 +10,8 @@ steps score each candidate without burning cluster time on full launches.
 
 from .tuner import (AutoTuner, Candidate,  # noqa: F401
                     default_candidates, measure_compiled_step,
-                    prune_by_divisibility)
+                    prune_by_divisibility, tune_pallas_blocks)
 
 __all__ = ["AutoTuner", "Candidate", "default_candidates",
-           "measure_compiled_step", "prune_by_divisibility"]
+           "measure_compiled_step", "prune_by_divisibility",
+           "tune_pallas_blocks"]

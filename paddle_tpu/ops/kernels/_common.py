@@ -52,13 +52,41 @@ def pad_to_block(a, block, axis=0):
     return jnp.pad(a, widths)
 
 
-def pick_row_block(n_rows, row_bytes, budget):
+_BLOCK_OVERRIDES: dict = {}  # kernel key -> measured row-block choice
+
+
+def set_block_override(key, rows) -> None:
+    """Install a measured row-block size for a kernel family (the
+    auto_tuner's Pallas block tuning writes here; None clears)."""
+    if rows is None:
+        _BLOCK_OVERRIDES.pop(key, None)
+    else:
+        if rows % 8 or rows <= 0:
+            raise ValueError(f"block override must be a positive multiple "
+                             f"of 8, got {rows}")
+        _BLOCK_OVERRIDES[key] = int(rows)
+
+
+def get_block_override(key):
+    return _BLOCK_OVERRIDES.get(key)
+
+
+def pick_row_block(n_rows, row_bytes, budget, key=None):
     """Row-block size under a VMEM byte budget: a multiple of 8 (Mosaic
     sublane rule — degraded rows=1 blocks fail TPU lowering), capped at 256
     and at the padded input extent. No divisor search: callers zero-pad
     indivisible inputs via pad_to_block (≤ rows-1 wasted rows beats
-    shrinking the block and multiplying grid steps)."""
-    rows = max(8, min(256, (budget // max(row_bytes, 1)) // 8 * 8))
+    shrinking the block and multiplying grid steps). A measured override
+    (auto_tuner.tune_pallas_blocks) takes precedence over the heuristic.
+
+    NOTE for kernel authors: the result must reach the pallas_call as a
+    STATIC jit argument — computing it inside a shape-keyed jit would let
+    a changed override silently reuse the stale compiled program."""
+    cap = max(8, min(256, (budget // max(row_bytes, 1)) // 8 * 8))
+    o = _BLOCK_OVERRIDES.get(key)
+    # the VMEM budget stays a HARD ceiling: an override tuned on one shape
+    # must not blow VMEM on a wider hidden size (tuning explores below it)
+    rows = min(o, cap) if o is not None else cap
     return min(rows, round_up(n_rows, 8))
 
 

@@ -36,6 +36,12 @@ from jax.experimental import pallas as pl
 from ._common import pad_to_block, pick_row_block
 
 
+def _pick_rows(n_rows, hidden):
+    """~4 f32 row buffers; tunable via the "bias_dropout_ln" override."""
+    return pick_row_block(n_rows, hidden * 4, 4 * 1024 * 1024,
+                          key="bias_dropout_ln")
+
+
 def _fwd_kernel(x_ref, b_ref, res_ref, *rest, eps, has_mask):
     if has_mask:
         m_ref, g_ref, be_ref, y_ref, h_ref = rest
@@ -86,11 +92,10 @@ def _bwd_kernel(h_ref, *rest, hidden, eps, has_mask):
         jnp.sum(dh * m, axis=0, keepdims=True), (8, hidden))
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
-def _fused_fwd(x2, b, res2, m2, g, be, eps, interpret):
+@functools.partial(jax.jit, static_argnames=("eps", "interpret", "rows"))
+def _fused_fwd(x2, b, res2, m2, g, be, eps, interpret, rows):
     n, h = x2.shape
     has_mask = m2 is not None
-    rows = pick_row_block(n, h * 4, 4 * 1024 * 1024)
     x2p = pad_to_block(x2, rows)
     np_ = x2p.shape[0]
     grid = (np_ // rows,)
@@ -116,11 +121,10 @@ def _fused_fwd(x2, b, res2, m2, g, be, eps, interpret):
     return y[:n], hsum[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
-def _fused_bwd(h2, m2, g, dy2, eps, interpret):
+@functools.partial(jax.jit, static_argnames=("eps", "interpret", "rows"))
+def _fused_bwd(h2, m2, g, dy2, eps, interpret, rows):
     n, h = h2.shape
     has_mask = m2 is not None
-    rows = pick_row_block(n, h * 4, 4 * 1024 * 1024)
     h2p = pad_to_block(h2, rows)
     np_ = h2p.shape[0]
     grid = (np_ // rows,)
@@ -157,9 +161,12 @@ def _primal(x, bias, residual, mask, gamma, beta, eps, interpret=False):
     variant (inference / dropout_rate 0) — no ones tensor is streamed."""
     shp = x.shape
     hd = shp[-1]
+    import math as _math
     m2 = mask.reshape(-1, hd) if mask is not None else None
+    n_rows = _math.prod(shp[:-1])
     y, h = _fused_fwd(x.reshape(-1, hd), bias, residual.reshape(-1, hd),
-                      m2, gamma, beta, eps, interpret)
+                      m2, gamma, beta, eps, interpret,
+                      rows=_pick_rows(n_rows, hd))
     return y.reshape(shp), h.reshape(shp)
 
 
@@ -176,8 +183,10 @@ def _vjp_bwd(eps, interpret, saved, grads):
     dy, dh_extra = grads
     hd = shp[-1]
     m2 = mask.reshape(-1, hd) if mask is not None else None
+    import math as _math
     dx, dres, dgamma, dbeta, dbias = _fused_bwd(
-        h.reshape(-1, hd), m2, gamma, dy.reshape(-1, hd), eps, interpret)
+        h.reshape(-1, hd), m2, gamma, dy.reshape(-1, hd), eps, interpret,
+        rows=_pick_rows(_math.prod(shp[:-1]), hd))
     dx = dx.reshape(shp)
     dres = dres.reshape(shp)
     if dh_extra is not None:

@@ -64,9 +64,11 @@ def _bwd_kernel(h_ref, w_ref, g_ref, dx_ref, dwp_ref, *, hidden, eps):
 
 def _pick_rows(n_rows, hidden):
     """~4 f32 row buffers of VMEM budget; zero pad rows normalise to finite
-    values under +eps and contribute nothing to dw."""
+    values under +eps and contribute nothing to dw. Tunable: the
+    auto_tuner's "rms_norm" block override wins when installed."""
     from ._common import pick_row_block
-    return pick_row_block(n_rows, hidden * 4, 4 * 1024 * 1024)
+    return pick_row_block(n_rows, hidden * 4, 4 * 1024 * 1024,
+                          key="rms_norm")
 
 
 def _pad_rows(a, rows):
@@ -74,10 +76,9 @@ def _pad_rows(a, rows):
     return pad_to_block(a, rows, axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
-def _fused_fwd(x2, res2, w, eps, interpret):
+@functools.partial(jax.jit, static_argnames=("eps", "interpret", "rows"))
+def _fused_fwd(x2, res2, w, eps, interpret, rows):
     n, h = x2.shape
-    rows = _pick_rows(n, h)
     x2p = _pad_rows(x2, rows)
     np_ = x2p.shape[0]
     grid = (np_ // rows,)
@@ -107,10 +108,9 @@ def _fused_fwd(x2, res2, w, eps, interpret):
     return out[:n], hsum[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
-def _fused_bwd(h2, w, g2, eps, interpret):
+@functools.partial(jax.jit, static_argnames=("eps", "interpret", "rows"))
+def _fused_bwd(h2, w, g2, eps, interpret, rows):
     n, h = h2.shape
-    rows = _pick_rows(n, h)
     h2p = _pad_rows(h2, rows)
     np_ = h2p.shape[0]
     grid = (np_ // rows,)
@@ -138,7 +138,8 @@ def _run_fwd(x, weight, residual, eps, interpret):
     x2 = x.reshape(-1, h)
     has_res = residual is not None
     res2 = residual.reshape(-1, h) if has_res else None
-    out, hsum = _fused_fwd(x2, res2, weight, eps, interpret)
+    out, hsum = _fused_fwd(x2, res2, weight, eps, interpret,
+                           rows=_pick_rows(x2.shape[0], h))
     outs = (out.reshape(shp), hsum.reshape(shp) if has_res else None)
     return outs, (hsum, has_res)
 
@@ -161,7 +162,8 @@ def _vjp_bwd(eps, interpret, saved, grads):
     g_out, g_h = grads
     h = shp[-1]
     g2 = g_out.reshape(-1, h)
-    dx, dw = _fused_bwd(hsum, weight, g2, eps, interpret)
+    dx, dw = _fused_bwd(hsum, weight, g2, eps, interpret,
+                        rows=_pick_rows(hsum.shape[0], h))
     dx = dx.reshape(shp)
     if g_h is not None:
         dx = dx + g_h.reshape(shp)  # residual-stream cotangent joins dx

@@ -36,16 +36,16 @@ def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
 
 def _pick_rows(total_s, feat):
     """Rows (positions) per block: ~1 MB f32 per x buffer; sequences that
-    don't divide are zero-padded by _rope_call and sliced back."""
+    don't divide are zero-padded by _rope_call and sliced back. Tunable
+    via the auto_tuner's "rope" block override."""
     from ._common import pick_row_block
-    return pick_row_block(total_s, feat * 4, 1024 * 1024)
+    return pick_row_block(total_s, feat * 4, 1024 * 1024, key="rope")
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _rope_call(x, cos2, sin2, interpret):
+@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+def _rope_call(x, cos2, sin2, interpret, rows):
     b, s, h, d = x.shape
     from ._common import pad_to_block
-    rows = _pick_rows(s, h * d)
     x = pad_to_block(x, rows, axis=1)
     cos2 = pad_to_block(cos2, rows, axis=0)
     sin2 = pad_to_block(sin2, rows, axis=0)
@@ -77,7 +77,8 @@ def _tables_2d(cos, sin, s, d):
 def _primal(x, cos, sin, interpret=False):
     b, s, h, d = x.shape
     cos2, sin2 = _tables_2d(cos, sin, s, d)
-    return _rope_call(x, cos2, sin2, interpret)
+    return _rope_call(x, cos2, sin2, interpret,
+                      rows=_pick_rows(s, h * d))
 
 
 rope_apply = jax.custom_vjp(_primal, nondiff_argnums=(3,))
@@ -89,10 +90,11 @@ def _vjp_fwd(x, cos, sin, interpret):
 
 def _vjp_bwd(interpret, saved, g):
     cos, sin, shp = saved
-    _, s, _, d = shp
+    _, s, h, d = shp
     cos2, sin2 = _tables_2d(cos, sin, s, d)
     # orthogonal rotation: the adjoint is rotation by -theta
-    dx = _rope_call(g, cos2, -sin2, interpret)
+    dx = _rope_call(g, cos2, -sin2, interpret,
+                    rows=_pick_rows(s, h * d))
     return dx, None, None
 
 

@@ -489,3 +489,59 @@ def test_parallel_cross_entropy_fused_single_device():
     l2.sum().backward()
     np.testing.assert_allclose(l1.numpy(), l2.numpy(), atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(x1.grad.numpy(), x2.grad.numpy(), atol=1e-5)
+
+
+def test_pallas_block_autotune_mechanism():
+    """tune_pallas_blocks measures every candidate with its override
+    INSTALLED (a static jit arg, so each candidate compiles its own
+    program), keeps the best, and restores state on failure (VERDICT r3
+    component #24)."""
+    from paddle_tpu.auto_tuner import tune_pallas_blocks
+    from paddle_tpu.ops.kernels import _common as _kc
+    from paddle_tpu.ops.kernels import rms_norm_pallas as rn
+
+    rng = np.random.default_rng(41)
+    x = jnp.asarray(rng.standard_normal((1, 64, 128)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((128,)), jnp.float32)
+
+    seen = []
+
+    def run():
+        seen.append(_kc.get_block_override("rms_norm"))
+        return rn.rms_norm_fused(x, w, None, 1e-6, True)[0]
+
+    # rigged timer: pretend 32 is fastest — the tuner must install it
+    fake = {8: 3.0, 16: 2.0, 32: 0.5, 64: 1.0}
+
+    def timer(fn):
+        fn()
+        return fake[_kc.get_block_override("rms_norm")]
+
+    try:
+        best, timings = tune_pallas_blocks(
+            "rms_norm", run, candidates=(8, 16, 32, 64), timer=timer)
+        assert best == 32 and timings == fake
+        assert _kc.get_block_override("rms_norm") == 32
+        assert sorted(set(seen)) == [8, 16, 32, 64]  # each override ran
+
+        # the override actually changes the executed program: parity at a
+        # forced small block vs the heuristic
+        _kc.set_block_override("rms_norm", 8)
+        y8 = rn.rms_norm_fused(x, w, None, 1e-6, True)[0]
+        _kc.set_block_override("rms_norm", None)
+        yh = rn.rms_norm_fused(x, w, None, 1e-6, True)[0]
+        np.testing.assert_allclose(np.asarray(y8), np.asarray(yh),
+                                   atol=1e-6)
+
+        # failure rolls the override back
+        _kc.set_block_override("rms_norm", 16)
+
+        def boom(fn):
+            raise RuntimeError("measurement failed")
+
+        with pytest.raises(RuntimeError):
+            tune_pallas_blocks("rms_norm", run, candidates=(8,),
+                               timer=boom)
+        assert _kc.get_block_override("rms_norm") == 16
+    finally:
+        _kc.set_block_override("rms_norm", None)
