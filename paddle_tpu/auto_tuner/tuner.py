@@ -193,10 +193,22 @@ def tune_pallas_blocks(kernel_key, run_fn, candidates=None, repeats=3,
         for rows in candidates:
             kern.set_block_override(kernel_key, rows)
             jax.clear_caches()  # outer jits must re-read the override
-            timings[rows] = timer(run_fn)
+            t = timer(run_fn)
+            # a candidate above the kernel's VMEM cap is clamped at use
+            # time (pick_row_block records what it actually chose): record
+            # the timing under the EFFECTIVE rows, and stop — every larger
+            # candidate clamps to the same program
+            eff = kern.get_last_pick(kernel_key) or rows
+            timings[eff] = min(t, timings.get(eff, t))
+            if eff < rows:
+                break
     except Exception:
         kern.set_block_override(kernel_key, prev)
+        jax.clear_caches()  # the failed candidate's program must not linger
         raise
     best = min(timings, key=timings.get)
     kern.set_block_override(kernel_key, best)
+    # the last-timed candidate's compiled program is still cached; without
+    # this, an outer jit would keep serving it instead of the winner
+    jax.clear_caches()
     return best, timings

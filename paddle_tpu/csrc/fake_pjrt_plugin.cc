@@ -1,0 +1,220 @@
+// Fake PJRT plugin for testing the native serving engine without hardware
+// (reference test pattern: paddle/phi/backends/custom/fake_cpu_device.h — a
+// fake device that exercises the plugin ABI end to end in CI).
+//
+// Implements the minimal PJRT C API slice pjrt_predictor.cc touches:
+// client create/destroy, one addressable device, compile (stores the program
+// bytes), execute (identity: output[i] is a copy of input[i]), host<->device
+// buffer copies, events (always ready). Compiled against the same
+// pjrt_c_api.h as the engine, so struct-size discipline and the call
+// protocol are validated for real; only the math is fake.
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct FakeError {
+  std::string message;
+};
+
+struct FakeBuffer {
+  std::vector<char> bytes;
+  std::vector<int64_t> dims;
+  PJRT_Buffer_Type type;
+};
+
+struct FakeExec {
+  std::string program;
+  std::string format;
+};
+
+// PJRT handles are opaque pointers; we reinterpret our own structs. A single
+// static device handle marks "the" fake device.
+int g_device_tag;
+PJRT_Device* kDevice = reinterpret_cast<PJRT_Device*>(&g_device_tag);
+int g_client_tag;
+
+size_t type_size(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+    case PJRT_Buffer_Type_F32:
+      return 4;
+    default:
+      return 8;
+  }
+}
+
+PJRT_Error* err(const char* msg) { return reinterpret_cast<PJRT_Error*>(new FakeError{msg}); }
+
+// ---- error ----
+void ErrorDestroy(PJRT_Error_Destroy_Args* a) {
+  delete reinterpret_cast<FakeError*>(a->error);
+}
+void ErrorMessage(PJRT_Error_Message_Args* a) {
+  auto* e = reinterpret_cast<const FakeError*>(a->error);
+  a->message = e->message.c_str();
+  a->message_size = e->message.size();
+}
+PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* a) {
+  a->code = PJRT_Error_Code_INTERNAL;
+  return nullptr;
+}
+
+// ---- plugin / events ----
+PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args*) { return nullptr; }
+PJRT_Error* EventAwait(PJRT_Event_Await_Args*) { return nullptr; }
+PJRT_Error* EventIsReady(PJRT_Event_IsReady_Args* a) {
+  a->is_ready = true;
+  return nullptr;
+}
+
+// ---- client ----
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* a) {
+  a->client = reinterpret_cast<PJRT_Client*>(&g_client_tag);
+  return nullptr;
+}
+PJRT_Error* ClientDestroy(PJRT_Client_Destroy_Args*) { return nullptr; }
+PJRT_Error* ClientPlatformName(PJRT_Client_PlatformName_Args* a) {
+  static const char kName[] = "fake";
+  a->platform_name = kName;
+  a->platform_name_size = sizeof(kName) - 1;
+  return nullptr;
+}
+PJRT_Error* ClientAddressableDevices(PJRT_Client_AddressableDevices_Args* a) {
+  static PJRT_Device* devs[1] = {kDevice};
+  a->addressable_devices = devs;
+  a->num_addressable_devices = 1;
+  return nullptr;
+}
+PJRT_Error* ClientCompile(PJRT_Client_Compile_Args* a) {
+  auto* ex = new FakeExec();
+  ex->program.assign(a->program->code, a->program->code_size);
+  ex->format.assign(a->program->format, a->program->format_size);
+  if (ex->format != "mlir")
+    return err("fake plugin only accepts mlir programs");
+  if (a->compile_options_size == 0)
+    return err("missing serialized CompileOptionsProto");
+  a->executable = reinterpret_cast<PJRT_LoadedExecutable*>(ex);
+  return nullptr;
+}
+
+// ---- buffers ----
+PJRT_Error* BufferFromHostBuffer(PJRT_Client_BufferFromHostBuffer_Args* a) {
+  if (a->num_byte_strides != 0 && a->byte_strides != nullptr)
+    return err("fake plugin requires dense major-to-minor input");
+  auto* b = new FakeBuffer();
+  b->type = a->type;
+  b->dims.assign(a->dims, a->dims + a->num_dims);
+  size_t n = type_size(a->type);
+  for (size_t i = 0; i < a->num_dims; ++i) n *= (size_t)a->dims[i];
+  b->bytes.resize(n);
+  memcpy(b->bytes.data(), a->data, n);
+  a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  a->done_with_host_buffer = nullptr;  // copied synchronously
+  return nullptr;
+}
+PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* a) {
+  delete reinterpret_cast<FakeBuffer*>(a->buffer);
+  return nullptr;
+}
+PJRT_Error* BufferDimensions(PJRT_Buffer_Dimensions_Args* a) {
+  auto* b = reinterpret_cast<FakeBuffer*>(a->buffer);
+  a->dims = b->dims.data();
+  a->num_dims = b->dims.size();
+  return nullptr;
+}
+PJRT_Error* BufferElementType(PJRT_Buffer_ElementType_Args* a) {
+  a->type = reinterpret_cast<FakeBuffer*>(a->buffer)->type;
+  return nullptr;
+}
+PJRT_Error* BufferToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* a) {
+  auto* b = reinterpret_cast<FakeBuffer*>(a->src);
+  if (a->dst == nullptr) {
+    a->dst_size = b->bytes.size();
+    a->event = nullptr;
+    return nullptr;
+  }
+  if (a->dst_size < b->bytes.size()) return err("dst too small");
+  memcpy(a->dst, b->bytes.data(), b->bytes.size());
+  a->event = nullptr;
+  return nullptr;
+}
+
+// ---- execute ----
+// The fake "compiles" every program to the same executable: ONE output that
+// is a byte-exact copy of input 0. Both sides of the real contract size
+// output_lists from the executable's output count, so the fake also reports
+// NumOutputs == 1 through the introspection path.
+PJRT_Error* ExecutableDestroy(PJRT_LoadedExecutable_Destroy_Args* a) {
+  delete reinterpret_cast<FakeExec*>(a->executable);
+  return nullptr;
+}
+PJRT_Error* GetExecutable(PJRT_LoadedExecutable_GetExecutable_Args* a) {
+  a->executable =
+      reinterpret_cast<PJRT_Executable*>(a->loaded_executable);
+  return nullptr;
+}
+PJRT_Error* NumOutputs(PJRT_Executable_NumOutputs_Args* a) {
+  a->num_outputs = 1;
+  return nullptr;
+}
+PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* a) {
+  if (a->num_devices != 1) return err("fake plugin is single-device");
+  if (a->num_args == 0) return err("fake executable needs >= 1 input");
+  auto* src = reinterpret_cast<FakeBuffer*>(a->argument_lists[0][0]);
+  a->output_lists[0][0] =
+      reinterpret_cast<PJRT_Buffer*>(new FakeBuffer(*src));
+  if (a->device_complete_events) a->device_complete_events[0] = nullptr;
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static PJRT_Api api;
+  memset(&api, 0, sizeof(api));
+  api.struct_size = PJRT_Api_STRUCT_SIZE;
+  api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  api.PJRT_Error_Destroy = ErrorDestroy;
+  api.PJRT_Error_Message = ErrorMessage;
+  api.PJRT_Error_GetCode = ErrorGetCode;
+  api.PJRT_Plugin_Initialize = PluginInitialize;
+  api.PJRT_Event_Destroy = EventDestroy;
+  api.PJRT_Event_IsReady = EventIsReady;
+  api.PJRT_Event_Await = EventAwait;
+  api.PJRT_Client_Create = ClientCreate;
+  api.PJRT_Client_Destroy = ClientDestroy;
+  api.PJRT_Client_PlatformName = ClientPlatformName;
+  api.PJRT_Client_AddressableDevices = ClientAddressableDevices;
+  api.PJRT_Client_Compile = ClientCompile;
+  api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
+  api.PJRT_Buffer_Destroy = BufferDestroy;
+  api.PJRT_Buffer_Dimensions = BufferDimensions;
+  api.PJRT_Buffer_ElementType = BufferElementType;
+  api.PJRT_Buffer_ToHostBuffer = BufferToHostBuffer;
+  api.PJRT_LoadedExecutable_Destroy = ExecutableDestroy;
+  api.PJRT_LoadedExecutable_Execute = Execute;
+  api.PJRT_LoadedExecutable_GetExecutable = GetExecutable;
+  api.PJRT_Executable_NumOutputs = NumOutputs;
+  return &api;
+}

@@ -1,0 +1,409 @@
+// Native serving engine over the PJRT C API (reference:
+// paddle/fluid/inference/api/analysis_predictor.cc + capi_exp/ — the C++
+// AnalysisPredictor and its C API).
+//
+// TPU-native realization: the deploy artifact is a StableHLO program
+// (serialized by paddle_tpu.inference.export_native); this engine dlopens a
+// PJRT plugin (libtpu.so on TPU hosts), compiles the program through
+// PJRT_Client_Compile, and serves PJRT_LoadedExecutable_Execute round trips
+// without any Python in the loop. The fake plugin (fake_pjrt_plugin.cc)
+// stands in for hardware in CI the same way the reference tests its device
+// ABI with a fake device (paddle/phi/backends/custom/fake_cpu_device.h).
+//
+// Exposed as a plain C API (ptpu_*) for ctypes binding and for embedding in
+// C/C++ serving processes (reference capi_exp contract).
+
+#include <dlfcn.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct Engine {
+  void* dso = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_LoadedExecutable* exec = nullptr;
+  PJRT_Device* device = nullptr;
+  std::string platform;
+  std::string last_error;
+  // outputs captured after each execute (engine-owned; callers copy out)
+  std::vector<std::vector<int64_t>> out_dims;
+  std::vector<int> out_types;
+  std::vector<std::vector<char>> out_bytes;
+};
+
+void set_err(Engine* e, const std::string& msg) { e->last_error = msg; }
+
+// Consume a PJRT_Error: record its message and destroy it. Returns true if
+// there was an error.
+bool take_error(Engine* e, PJRT_Error* err, const char* where) {
+  if (err == nullptr) return false;
+  std::string msg = where;
+  msg += ": ";
+  if (e->api && e->api->PJRT_Error_Message) {
+    PJRT_Error_Message_Args margs;
+    memset(&margs, 0, sizeof(margs));
+    margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    margs.error = err;
+    e->api->PJRT_Error_Message(&margs);
+    msg.append(margs.message, margs.message_size);
+  } else {
+    msg += "(no error introspection)";
+  }
+  if (e->api && e->api->PJRT_Error_Destroy) {
+    PJRT_Error_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    dargs.error = err;
+    e->api->PJRT_Error_Destroy(&dargs);
+  }
+  set_err(e, msg);
+  return true;
+}
+
+bool await_event(Engine* e, PJRT_Event* ev, const char* where) {
+  if (ev == nullptr) return true;
+  bool ok = true;
+  if (e->api->PJRT_Event_Await) {
+    PJRT_Event_Await_Args aargs;
+    memset(&aargs, 0, sizeof(aargs));
+    aargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    aargs.event = ev;
+    ok = !take_error(e, e->api->PJRT_Event_Await(&aargs), where);
+  }
+  if (e->api->PJRT_Event_Destroy) {
+    PJRT_Event_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    dargs.event = ev;
+    e->api->PJRT_Event_Destroy(&dargs);
+  }
+  return ok;
+}
+
+void destroy_buffer(Engine* e, PJRT_Buffer* b) {
+  if (!b || !e->api->PJRT_Buffer_Destroy) return;
+  PJRT_Buffer_Destroy_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  args.buffer = b;
+  take_error(e, e->api->PJRT_Buffer_Destroy(&args), "PJRT_Buffer_Destroy");
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef struct Engine PtpuEngine;
+
+// Load `plugin_path` (a PJRT plugin .so, e.g. libtpu.so), resolve GetPjrtApi,
+// version-check, initialize the plugin, and create a client.
+PtpuEngine* ptpu_create(const char* plugin_path) {
+  Engine* e = new Engine();
+  e->dso = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
+  if (!e->dso) {
+    set_err(e, std::string("dlopen failed: ") + dlerror());
+    return e;
+  }
+  typedef const PJRT_Api* (*GetApiFn)();
+  GetApiFn get_api =
+      reinterpret_cast<GetApiFn>(dlsym(e->dso, "GetPjrtApi"));
+  if (!get_api) {
+    set_err(e, "plugin does not export GetPjrtApi");
+    return e;
+  }
+  e->api = get_api();
+  if (!e->api) {
+    set_err(e, "GetPjrtApi returned null");
+    return e;
+  }
+  if (e->api->pjrt_api_version.major_version != PJRT_API_MAJOR) {
+    char buf[128];
+    snprintf(buf, sizeof(buf),
+             "PJRT ABI major mismatch: plugin %d, host %d",
+             e->api->pjrt_api_version.major_version, PJRT_API_MAJOR);
+    set_err(e, buf);
+    return e;
+  }
+  if (e->api->PJRT_Plugin_Initialize) {
+    PJRT_Plugin_Initialize_Args iargs;
+    memset(&iargs, 0, sizeof(iargs));
+    iargs.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+    if (take_error(e, e->api->PJRT_Plugin_Initialize(&iargs),
+                   "PJRT_Plugin_Initialize"))
+      return e;
+  }
+  PJRT_Client_Create_Args cargs;
+  memset(&cargs, 0, sizeof(cargs));
+  cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (take_error(e, e->api->PJRT_Client_Create(&cargs),
+                 "PJRT_Client_Create"))
+    return e;
+  e->client = cargs.client;
+
+  PJRT_Client_PlatformName_Args pargs;
+  memset(&pargs, 0, sizeof(pargs));
+  pargs.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+  pargs.client = e->client;
+  if (!take_error(e, e->api->PJRT_Client_PlatformName(&pargs),
+                  "PJRT_Client_PlatformName"))
+    e->platform.assign(pargs.platform_name, pargs.platform_name_size);
+
+  PJRT_Client_AddressableDevices_Args dargs;
+  memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  dargs.client = e->client;
+  if (take_error(e, e->api->PJRT_Client_AddressableDevices(&dargs),
+                 "PJRT_Client_AddressableDevices"))
+    return e;
+  if (dargs.num_addressable_devices == 0) {
+    set_err(e, "no addressable devices");
+    return e;
+  }
+  e->device = dargs.addressable_devices[0];
+  e->last_error.clear();
+  return e;
+}
+
+// 1 when the engine is ready (client created, no pending error).
+int ptpu_ok(PtpuEngine* e) {
+  return e && e->client && e->last_error.empty() ? 1 : 0;
+}
+
+const char* ptpu_last_error(PtpuEngine* e) {
+  return e ? e->last_error.c_str() : "null engine";
+}
+
+const char* ptpu_platform(PtpuEngine* e) { return e->platform.c_str(); }
+
+int ptpu_api_minor(PtpuEngine* e) {
+  return e && e->api ? e->api->pjrt_api_version.minor_version : -1;
+}
+
+// Compile an MLIR (StableHLO) module. `copts` is a serialized
+// xla.CompileOptionsProto (produced at export time by the Python side so this
+// engine never links protobuf).
+int ptpu_compile(PtpuEngine* e, const char* mlir, size_t mlir_len,
+                 const char* copts, size_t copts_len) {
+  if (!ptpu_ok(e)) return -1;
+  PJRT_Program prog;
+  memset(&prog, 0, sizeof(prog));
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.code = const_cast<char*>(mlir);
+  prog.code_size = mlir_len;
+  static const char kFormat[] = "mlir";
+  prog.format = kFormat;
+  prog.format_size = sizeof(kFormat) - 1;
+
+  PJRT_Client_Compile_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  args.client = e->client;
+  args.program = &prog;
+  args.compile_options = copts;
+  args.compile_options_size = copts_len;
+  if (take_error(e, e->api->PJRT_Client_Compile(&args),
+                 "PJRT_Client_Compile"))
+    return -1;
+  e->exec = args.executable;
+  return 0;
+}
+
+// Number of outputs of the compiled program, or -1 when the plugin does not
+// implement executable introspection (the fake test plugin; callers then rely
+// on the deploy container's output specs).
+int ptpu_num_outputs(PtpuEngine* e) {
+  if (!e || !e->exec) return -1;
+  if (!e->api->PJRT_LoadedExecutable_GetExecutable ||
+      !e->api->PJRT_Executable_NumOutputs)
+    return -1;
+  PJRT_LoadedExecutable_GetExecutable_Args gargs;
+  memset(&gargs, 0, sizeof(gargs));
+  gargs.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  gargs.loaded_executable = e->exec;
+  if (take_error(e, e->api->PJRT_LoadedExecutable_GetExecutable(&gargs),
+                 "PJRT_LoadedExecutable_GetExecutable"))
+    return -1;
+  PJRT_Executable_NumOutputs_Args nargs;
+  memset(&nargs, 0, sizeof(nargs));
+  nargs.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  nargs.executable = gargs.executable;
+  if (take_error(e, e->api->PJRT_Executable_NumOutputs(&nargs),
+                 "PJRT_Executable_NumOutputs"))
+    return -1;
+  return static_cast<int>(nargs.num_outputs);
+}
+
+// Execute one inference. Inputs are dense host buffers in major-to-minor
+// layout; outputs are copied into engine-owned storage, readable through the
+// ptpu_output_* accessors until the next execute.
+//
+// dtypes use PJRT_Buffer_Type codes. Returns 0 on success.
+int ptpu_execute(PtpuEngine* e, int num_args, const void** data,
+                 const int* dtypes, const int64_t* dims_flat,
+                 const int* ndims, int num_outputs) {
+  if (!ptpu_ok(e) || !e->exec) {
+    if (e && e->last_error.empty()) set_err(e, "no compiled program");
+    return -1;
+  }
+  std::vector<PJRT_Buffer*> in_bufs(num_args, nullptr);
+  const int64_t* dcur = dims_flat;
+  for (int i = 0; i < num_args; ++i) {
+    PJRT_Client_BufferFromHostBuffer_Args bargs;
+    memset(&bargs, 0, sizeof(bargs));
+    bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    bargs.client = e->client;
+    bargs.data = data[i];
+    bargs.type = static_cast<PJRT_Buffer_Type>(dtypes[i]);
+    bargs.dims = dcur;
+    bargs.num_dims = ndims[i];
+    dcur += ndims[i];
+    // data is fully copied before the call returns, so host buffers need no
+    // lifetime coupling to the device buffer
+    bargs.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+    bargs.device = e->device;
+    if (take_error(e, e->api->PJRT_Client_BufferFromHostBuffer(&bargs),
+                   "PJRT_Client_BufferFromHostBuffer")) {
+      for (auto* b : in_bufs) destroy_buffer(e, b);
+      return -1;
+    }
+    in_bufs[i] = bargs.buffer;
+    if (!await_event(e, bargs.done_with_host_buffer, "h2d event")) {
+      for (auto* b : in_bufs) destroy_buffer(e, b);
+      return -1;
+    }
+  }
+
+  PJRT_ExecuteOptions opts;
+  memset(&opts, 0, sizeof(opts));
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  std::vector<PJRT_Buffer*> outs(num_outputs, nullptr);
+  PJRT_Buffer** out_list = outs.data();
+  PJRT_Buffer* const* arg_list = in_bufs.data();
+  PJRT_Event* done = nullptr;
+
+  PJRT_LoadedExecutable_Execute_Args eargs;
+  memset(&eargs, 0, sizeof(eargs));
+  eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  eargs.executable = e->exec;
+  eargs.options = &opts;
+  eargs.argument_lists = &arg_list;
+  eargs.num_devices = 1;
+  eargs.num_args = num_args;
+  eargs.output_lists = &out_list;
+  eargs.device_complete_events = &done;
+  eargs.execute_device = e->device;
+  bool fail = take_error(e, e->api->PJRT_LoadedExecutable_Execute(&eargs),
+                         "PJRT_LoadedExecutable_Execute");
+  for (auto* b : in_bufs) destroy_buffer(e, b);
+  if (!fail) fail = !await_event(e, done, "execute event");
+  if (fail) {
+    for (auto* b : outs) destroy_buffer(e, b);
+    return -1;
+  }
+
+  e->out_dims.assign(num_outputs, {});
+  e->out_types.assign(num_outputs, 0);
+  e->out_bytes.assign(num_outputs, {});
+  int rc = 0;
+  for (int i = 0; i < num_outputs && rc == 0; ++i) {
+    PJRT_Buffer_Dimensions_Args dims_args;
+    memset(&dims_args, 0, sizeof(dims_args));
+    dims_args.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    dims_args.buffer = outs[i];
+    if (!take_error(e, e->api->PJRT_Buffer_Dimensions(&dims_args),
+                    "PJRT_Buffer_Dimensions"))
+      e->out_dims[i].assign(dims_args.dims, dims_args.dims + dims_args.num_dims);
+    PJRT_Buffer_ElementType_Args et_args;
+    memset(&et_args, 0, sizeof(et_args));
+    et_args.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+    et_args.buffer = outs[i];
+    if (!take_error(e, e->api->PJRT_Buffer_ElementType(&et_args),
+                    "PJRT_Buffer_ElementType"))
+      e->out_types[i] = static_cast<int>(et_args.type);
+
+    PJRT_Buffer_ToHostBuffer_Args hargs;
+    memset(&hargs, 0, sizeof(hargs));
+    hargs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    hargs.src = outs[i];
+    hargs.dst = nullptr;  // size query
+    if (take_error(e, e->api->PJRT_Buffer_ToHostBuffer(&hargs),
+                   "PJRT_Buffer_ToHostBuffer(size)")) {
+      rc = -1;
+      break;
+    }
+    e->out_bytes[i].resize(hargs.dst_size);
+    hargs.dst = e->out_bytes[i].data();
+    // dst_size keeps the required size from the query
+    if (take_error(e, e->api->PJRT_Buffer_ToHostBuffer(&hargs),
+                   "PJRT_Buffer_ToHostBuffer"))
+      rc = -1;
+    else if (!await_event(e, hargs.event, "d2h event"))
+      rc = -1;
+  }
+  for (auto* b : outs) destroy_buffer(e, b);
+  return rc;
+}
+
+size_t ptpu_output_nbytes(PtpuEngine* e, int i) {
+  if (!e || i < 0 || i >= (int)e->out_bytes.size()) return 0;
+  return e->out_bytes[i].size();
+}
+
+int ptpu_output_copy(PtpuEngine* e, int i, void* dst, size_t cap) {
+  if (!e || i < 0 || i >= (int)e->out_bytes.size()) return -1;
+  if (cap < e->out_bytes[i].size()) return -1;
+  memcpy(dst, e->out_bytes[i].data(), e->out_bytes[i].size());
+  return 0;
+}
+
+int ptpu_output_ndim(PtpuEngine* e, int i) {
+  if (!e || i < 0 || i >= (int)e->out_dims.size()) return -1;
+  return (int)e->out_dims[i].size();
+}
+
+int64_t ptpu_output_dim(PtpuEngine* e, int i, int d) {
+  if (!e || i < 0 || i >= (int)e->out_dims.size()) return -1;
+  if (d < 0 || d >= (int)e->out_dims[i].size()) return -1;
+  return e->out_dims[i][d];
+}
+
+int ptpu_output_dtype(PtpuEngine* e, int i) {
+  if (!e || i < 0 || i >= (int)e->out_types.size()) return -1;
+  return e->out_types[i];
+}
+
+void ptpu_destroy(PtpuEngine* e) {
+  if (!e) return;
+  if (e->api) {
+    if (e->exec && e->api->PJRT_LoadedExecutable_Destroy) {
+      PJRT_LoadedExecutable_Destroy_Args args;
+      memset(&args, 0, sizeof(args));
+      args.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+      args.executable = e->exec;
+      e->api->PJRT_LoadedExecutable_Destroy(&args);
+    }
+    if (e->client && e->api->PJRT_Client_Destroy) {
+      PJRT_Client_Destroy_Args args;
+      memset(&args, 0, sizeof(args));
+      args.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+      args.client = e->client;
+      e->api->PJRT_Client_Destroy(&args);
+    }
+  }
+  if (e->dso) dlclose(e->dso);
+  delete e;
+}
+
+}  // extern "C"

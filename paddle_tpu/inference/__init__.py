@@ -22,7 +22,10 @@ __all__ = [
     'Config', 'DataType', 'PlaceType', 'PrecisionType', 'Tensor', 'Predictor',
     'create_predictor', 'get_version', 'convert_to_mixed_precision',
     'get_num_bytes_of_data_type', 'PredictorPool',
+    'export_native', 'NativePredictor',
 ]
+
+from .native import NativePredictor, export_native  # noqa: E402
 
 
 class DataType(enum.Enum):
@@ -92,6 +95,8 @@ class Config:
         self._cpu_math_threads = 1
         self._precision = PrecisionType.Float32
         self._enable_profile = False
+        self._native_engine = False
+        self._native_plugin = None
 
     # -- model location ---------------------------------------------------
     def set_model(self, model_path, params_path=None):
@@ -137,6 +142,17 @@ class Config:
 
     def enable_profile(self):
         self._enable_profile = True
+
+    def enable_native_engine(self, plugin_path=None):
+        """Serve through the C++ PJRT engine (csrc/pjrt_predictor.cc): the
+        model path must point at an `export_native` container. Analog of the
+        reference's C++ AnalysisPredictor deployment (no Python in the
+        request path)."""
+        self._native_engine = True
+        self._native_plugin = plugin_path
+
+    def native_engine_enabled(self):
+        return self._native_engine
 
     def summary(self) -> str:
         return (f"model: {self._model_path}\ndevice: {self._device.name}"
@@ -229,7 +245,12 @@ class Predictor:
         pass
 
 
-def create_predictor(config: Config) -> Predictor:
+def create_predictor(config: Config):
+    if config.native_engine_enabled():
+        if not config.model_dir():
+            raise ValueError("Config has no model path; call set_model()")
+        return NativePredictor(config.model_dir(),
+                               plugin_path=config._native_plugin)
     return Predictor(config)
 
 
@@ -238,7 +259,7 @@ class PredictorPool:
     capi PredictorPool)."""
 
     def __init__(self, config: Config, size: int = 1):
-        self._preds = [Predictor(config) for _ in range(max(1, size))]
+        self._preds = [create_predictor(config) for _ in range(max(1, size))]
 
     def retrieve(self, idx: int) -> Predictor:
         return self._preds[idx]

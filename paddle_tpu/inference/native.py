@@ -1,0 +1,401 @@
+"""Native serving engine binding (reference: the C++ AnalysisPredictor at
+paddle/fluid/inference/api/analysis_predictor.cc and its C API
+paddle/fluid/inference/capi_exp/pd_inference_api.h).
+
+TPU-native realization: `export_native` lowers a Layer to one STATIC-shape
+StableHLO module for the TPU target and writes a self-contained deploy
+container (program + serialized CompileOptionsProto + flat weights). The
+C++ engine (csrc/pjrt_predictor.cc) dlopens a PJRT plugin — libtpu.so on a
+TPU host — compiles the module through PJRT_Client_Compile and serves
+executions with zero Python in the request path. CI exercises the full ABI
+against csrc/fake_pjrt_plugin.cc, the analog of the reference's
+fake_cpu_device.h plugin test.
+
+Container layout (little-endian), magic ``PTPUNAT1``:
+  u32 n_args; per arg: u8 kind(0=param,1=input), i32 pjrt_dtype, u32 ndim,
+    i64 dims[ndim], u64 nbytes, u16 name_len, name utf-8
+  u32 n_outs; per out: i32 pjrt_dtype, u32 ndim, i64 dims[ndim]
+  u64 mlir_len, mlir bytes (textual StableHLO)
+  u64 copts_len, serialized xla.CompileOptionsProto
+  u64 weights_len, param buffers concatenated in arg order
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["export_native", "NativePredictor", "default_plugin_path",
+           "PJRT_DTYPE"]
+
+_MAGIC = b"PTPUNAT1"
+
+# PJRT_Buffer_Type codes (xla/pjrt/c/pjrt_c_api.h enum PJRT_Buffer_Type)
+PJRT_DTYPE = {
+    np.dtype("bool"): 1,      # PRED
+    np.dtype("int8"): 2,
+    np.dtype("int16"): 3,
+    np.dtype("int32"): 4,
+    np.dtype("int64"): 5,
+    np.dtype("uint8"): 6,
+    np.dtype("uint16"): 7,
+    np.dtype("uint32"): 8,
+    np.dtype("uint64"): 9,
+    np.dtype("float16"): 10,
+    np.dtype("float32"): 11,
+    np.dtype("float64"): 12,
+}
+_BF16_CODE = 13
+_DTYPE_NP = {v: k for k, v in PJRT_DTYPE.items()}
+
+
+def _pjrt_code(dt) -> int:
+    import jax.numpy as jnp
+    if dt == jnp.bfloat16:
+        return _BF16_CODE
+    return PJRT_DTYPE[np.dtype(dt)]
+
+
+def _np_dtype(code: int):
+    if code == _BF16_CODE:
+        import jax.numpy as jnp
+        return np.dtype(jnp.bfloat16)
+    return _DTYPE_NP[code]
+
+
+def default_plugin_path() -> str | None:
+    """libtpu.so when the image ships it (the TPU serving path)."""
+    try:
+        import libtpu
+        p = os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")
+        return p if os.path.exists(p) else None
+    except ImportError:
+        return None
+
+
+def _compile_options_bytes() -> bytes:
+    """Serialized xla.CompileOptionsProto for PJRT_Client_Compile, produced
+    here so the C++ engine never links protobuf."""
+    from jax._src import compiler
+    opts = compiler.get_compile_options(num_replicas=1, num_partitions=1)
+    return opts.SerializeAsString()
+
+
+def export_native(layer, path, input_spec, platform="tpu"):
+    """Write `<path>.ptpu`: the static-shape deploy container for the native
+    engine. `input_spec` entries must be fully static (no -1 dims) — the
+    native path trades batch polymorphism for an ahead-of-time compilable
+    module (reference save_inference_model fixes shapes the same way)."""
+    import jax
+    from jax import export as jax_export
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from .. import jit as _jit  # noqa: F401 (Layer import side effects)
+    from ..jit.save_load import InputSpec
+
+    structs = []
+    in_names = []
+    for i, s in enumerate(input_spec):
+        if isinstance(s, InputSpec):
+            if any(d == -1 for d in s.shape):
+                raise ValueError(
+                    "export_native requires static shapes; got -1 in "
+                    f"input_spec[{i}].shape={s.shape}")
+            structs.append(jax.ShapeDtypeStruct(tuple(s.shape),
+                                                s.dtype.np_dtype))
+            in_names.append(s.name or f"x{i}")
+        else:
+            arr = getattr(s, "_data", s)
+            structs.append(jax.ShapeDtypeStruct(tuple(arr.shape), arr.dtype))
+            in_names.append(f"x{i}")
+
+    state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
+    param_structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                     for k, v in state.items()}
+
+    def fn(params, *xs):
+        sd = layer.state_dict()
+        saved = {}
+        for k, t in sd.items():
+            saved[k] = t._d
+            t._d = params[k]
+        try:
+            from ..autograd.grad_mode import no_grad
+            with no_grad():
+                out = layer(*[Tensor(x) for x in xs])
+        finally:
+            for k, t in sd.items():
+                t._d = saved[k]
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+
+    # keep_unused: the StableHLO main must take EVERY flattened arg so the
+    # container's arg list matches the program's calling convention 1:1
+    exported = jax_export.export(
+        jax.jit(fn, keep_unused=True),
+        platforms=[platform])(param_structs, *structs)
+    mlir = exported.mlir_module().encode()
+    copts = _compile_options_bytes()
+
+    flat_params, _ = jax.tree_util.tree_flatten(param_structs)
+    flat_names, _ = jax.tree_util.tree_flatten(
+        {k: k for k in param_structs})
+    out_avals = exported.out_avals
+
+    buf = bytearray()
+    buf += _MAGIC
+    n_args = len(flat_params) + len(structs)
+    buf += struct.pack("<I", n_args)
+    weights = bytearray()
+    for name, spec in zip(flat_names, flat_params):
+        arr = np.ascontiguousarray(state[name])
+        buf += struct.pack("<b", 0)
+        buf += struct.pack("<i", _pjrt_code(arr.dtype))
+        buf += struct.pack("<I", arr.ndim)
+        buf += struct.pack(f"<{arr.ndim}q", *arr.shape)
+        buf += struct.pack("<Q", arr.nbytes)
+        nm = name.encode()
+        buf += struct.pack("<H", len(nm)) + nm
+        weights += arr.tobytes()
+    for name, spec in zip(in_names, structs):
+        buf += struct.pack("<b", 1)
+        buf += struct.pack("<i", _pjrt_code(spec.dtype))
+        buf += struct.pack("<I", len(spec.shape))
+        buf += struct.pack(f"<{len(spec.shape)}q", *spec.shape)
+        buf += struct.pack("<Q", 0)
+        nm = name.encode()
+        buf += struct.pack("<H", len(nm)) + nm
+    buf += struct.pack("<I", len(out_avals))
+    for av in out_avals:
+        buf += struct.pack("<i", _pjrt_code(av.dtype))
+        buf += struct.pack("<I", len(av.shape))
+        buf += struct.pack(f"<{len(av.shape)}q", *av.shape)
+    buf += struct.pack("<Q", len(mlir)) + mlir
+    buf += struct.pack("<Q", len(copts)) + copts
+    buf += struct.pack("<Q", len(weights)) + bytes(weights)
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    out_path = path + ".ptpu"
+    with open(out_path, "wb") as f:
+        f.write(bytes(buf))
+    return out_path
+
+
+class _Container:
+    __slots__ = ("args", "outs", "mlir", "copts", "weights")
+
+
+def read_container(path) -> _Container:
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:8] != _MAGIC:
+        raise ValueError(f"{path}: not a PTPUNAT1 container")
+    off = 8
+
+    def take(fmt):
+        nonlocal off
+        size = struct.calcsize(fmt)
+        vals = struct.unpack_from(fmt, data, off)
+        off += size
+        return vals if len(vals) > 1 else vals[0]
+
+    c = _Container()
+    c.args = []
+    for _ in range(take("<I")):
+        kind = take("<b")
+        dtype = take("<i")
+        ndim = take("<I")
+        dims = tuple(struct.unpack_from(f"<{ndim}q", data, off))
+        off += 8 * ndim
+        nbytes = take("<Q")
+        nlen = take("<H")
+        name = data[off:off + nlen].decode()
+        off += nlen
+        c.args.append((kind, dtype, dims, nbytes, name))
+    c.outs = []
+    for _ in range(take("<I")):
+        dtype = take("<i")
+        ndim = take("<I")
+        dims = tuple(struct.unpack_from(f"<{ndim}q", data, off))
+        off += 8 * ndim
+        c.outs.append((dtype, dims))
+    n = take("<Q")
+    c.mlir = data[off:off + n]
+    off += n
+    n = take("<Q")
+    c.copts = data[off:off + n]
+    off += n
+    n = take("<Q")
+    c.weights = data[off:off + n]
+    return c
+
+
+_LIB = None
+
+
+def _engine_include_dirs():
+    """pjrt_c_api.h ships with the image's tensorflow wheel (OpenXLA
+    header); a source checkout can override via PTPU_PJRT_INCLUDE."""
+    env = os.environ.get("PTPU_PJRT_INCLUDE")
+    if env:
+        return [env]
+    try:
+        import tensorflow
+        return [os.path.join(os.path.dirname(tensorflow.__file__),
+                             "include")]
+    except ImportError:
+        raise RuntimeError(
+            "no pjrt_c_api.h found: set PTPU_PJRT_INCLUDE to a directory "
+            "containing xla/pjrt/c/pjrt_c_api.h")
+
+
+def load_engine_lib(build_directory=None, verbose=False):
+    """Build (cached) + load libptpu_predictor with ctypes signatures."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    from ..utils.cpp_extension import _build_so
+    src = os.path.join(os.path.dirname(__file__), "..", "csrc",
+                       "pjrt_predictor.cc")
+    cflags = []
+    for inc in _engine_include_dirs():
+        cflags += ["-I", inc]
+    so = _build_so("ptpu_predictor", [os.path.abspath(src)], cflags,
+                   ["-ldl"], build_directory or os.path.join(
+                       os.path.expanduser("~"), ".cache",
+                       "paddle_tpu_extensions"), verbose)
+    lib = ctypes.CDLL(so)
+    lib.ptpu_create.argtypes = [ctypes.c_char_p]
+    lib.ptpu_create.restype = ctypes.c_void_p
+    lib.ptpu_ok.argtypes = [ctypes.c_void_p]
+    lib.ptpu_last_error.argtypes = [ctypes.c_void_p]
+    lib.ptpu_last_error.restype = ctypes.c_char_p
+    lib.ptpu_platform.argtypes = [ctypes.c_void_p]
+    lib.ptpu_platform.restype = ctypes.c_char_p
+    lib.ptpu_api_minor.argtypes = [ctypes.c_void_p]
+    lib.ptpu_compile.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_size_t, ctypes.c_char_p,
+                                 ctypes.c_size_t]
+    lib.ptpu_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.ptpu_execute.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int]
+    lib.ptpu_output_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_output_nbytes.restype = ctypes.c_size_t
+    lib.ptpu_output_copy.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_void_p, ctypes.c_size_t]
+    lib.ptpu_output_ndim.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_output_dim.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_int]
+    lib.ptpu_output_dim.restype = ctypes.c_int64
+    lib.ptpu_output_dtype.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_destroy.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+class NativePredictor:
+    """Serves a .ptpu container through the C++ PJRT engine (reference
+    contract: AnalysisPredictor::Run — named feeds in, dense fetches out)."""
+
+    def __init__(self, model_path, plugin_path=None, build_directory=None):
+        if not model_path.endswith(".ptpu"):
+            model_path += ".ptpu"
+        self._c = read_container(model_path)
+        plugin = plugin_path or default_plugin_path()
+        if plugin is None:
+            raise RuntimeError(
+                "no PJRT plugin: pass plugin_path= (libtpu.so on TPU hosts)")
+        self._lib = load_engine_lib(build_directory=build_directory)
+        self._eng = self._lib.ptpu_create(str(plugin).encode())
+        if not self._lib.ptpu_ok(self._eng):
+            raise RuntimeError("PJRT engine init failed: " +
+                               self._lib.ptpu_last_error(self._eng).decode())
+        rc = self._lib.ptpu_compile(self._eng, bytes(self._c.mlir),
+                                    len(self._c.mlir), bytes(self._c.copts),
+                                    len(self._c.copts))
+        if rc != 0:
+            raise RuntimeError("PJRT compile failed: " +
+                               self._lib.ptpu_last_error(self._eng).decode())
+        n = self._lib.ptpu_num_outputs(self._eng)
+        if n >= 0 and n != len(self._c.outs):
+            raise RuntimeError(
+                f"program has {n} outputs, container declares "
+                f"{len(self._c.outs)}")
+        # pre-slice weights into per-param arrays (zero-copy views)
+        self._params = []
+        off = 0
+        for kind, dtype, dims, nbytes, name in self._c.args:
+            if kind != 0:
+                continue
+            arr = np.frombuffer(self._c.weights, dtype=_np_dtype(dtype),
+                                count=nbytes // _np_dtype(dtype).itemsize,
+                                offset=off).reshape(dims)
+            self._params.append(arr)
+            off += nbytes
+
+    @property
+    def platform(self) -> str:
+        return self._lib.ptpu_platform(self._eng).decode()
+
+    def get_input_names(self):
+        return [a[4] for a in self._c.args if a[0] == 1]
+
+    def run(self, inputs):
+        ins = [a for a in self._c.args if a[0] == 1]
+        if len(inputs) != len(ins):
+            raise ValueError(f"expected {len(ins)} inputs, got {len(inputs)}")
+        feeds = []
+        for x, (kind, dtype, dims, _, name) in zip(inputs, ins):
+            arr = np.ascontiguousarray(x, dtype=_np_dtype(dtype))
+            if tuple(arr.shape) != dims:
+                raise ValueError(
+                    f"input {name!r}: expected shape {dims}, got {arr.shape}"
+                    " (the native engine is static-shape; re-export for "
+                    "other shapes)")
+            feeds.append(arr)
+        args = self._params + feeds
+        n = len(args)
+        data = (ctypes.c_void_p * n)(
+            *[a.ctypes.data_as(ctypes.c_void_p) for a in args])
+        dtypes = (ctypes.c_int * n)(*[_pjrt_code(a.dtype) for a in args])
+        dims_flat = np.asarray(
+            [d for a in args for d in a.shape] or [0], dtype=np.int64)
+        ndims = (ctypes.c_int * n)(*[a.ndim for a in args])
+        rc = self._lib.ptpu_execute(
+            self._eng, n, data, dtypes,
+            dims_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), ndims,
+            len(self._c.outs))
+        if rc != 0:
+            raise RuntimeError("PJRT execute failed: " +
+                               self._lib.ptpu_last_error(self._eng).decode())
+        outs = []
+        for i in range(len(self._c.outs)):
+            nd = self._lib.ptpu_output_ndim(self._eng, i)
+            if nd >= 0:
+                shape = tuple(self._lib.ptpu_output_dim(self._eng, i, d)
+                              for d in range(nd))
+                dt = _np_dtype(self._lib.ptpu_output_dtype(self._eng, i))
+            else:  # plugin without buffer introspection: container specs
+                dt, shape = (_np_dtype(self._c.outs[i][0]),
+                             self._c.outs[i][1])
+            nbytes = self._lib.ptpu_output_nbytes(self._eng, i)
+            out = np.empty(nbytes // dt.itemsize, dtype=dt)
+            if self._lib.ptpu_output_copy(
+                    self._eng, i, out.ctypes.data_as(ctypes.c_void_p),
+                    out.nbytes) != 0:
+                raise RuntimeError("output copy failed")
+            outs.append(out.reshape(shape))
+        return outs
+
+    def __del__(self):
+        eng = getattr(self, "_eng", None)
+        if eng and getattr(self, "_lib", None) is not None:
+            self._lib.ptpu_destroy(eng)
+            self._eng = None

@@ -71,6 +71,16 @@ def get_block_override(key):
     return _BLOCK_OVERRIDES.get(key)
 
 
+_LAST_PICK: dict = {}  # kernel key -> rows actually chosen at last pick
+
+
+def get_last_pick(key):
+    """Effective row-block pick_row_block last returned for `key` (the
+    auto-tuner reads this to detect VMEM-cap clamping: a candidate above
+    the cap runs the same program as the cap itself)."""
+    return _LAST_PICK.get(key)
+
+
 def pick_row_block(n_rows, row_bytes, budget, key=None):
     """Row-block size under a VMEM byte budget: a multiple of 8 (Mosaic
     sublane rule — degraded rows=1 blocks fail TPU lowering), capped at 256
@@ -87,7 +97,10 @@ def pick_row_block(n_rows, row_bytes, budget, key=None):
     # the VMEM budget stays a HARD ceiling: an override tuned on one shape
     # must not blow VMEM on a wider hidden size (tuning explores below it)
     rows = min(o, cap) if o is not None else cap
-    return min(rows, round_up(n_rows, 8))
+    rows = min(rows, round_up(n_rows, 8))
+    if key is not None:
+        _LAST_PICK[key] = rows
+    return rows
 
 
 @functools.cache
