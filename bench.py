@@ -575,10 +575,33 @@ def _child_main(mode):
         return 1
 
 
+def _acquire_bench_lock():
+    """Serialize TPU access across bench processes: the axon tunnel is
+    single-client, so a watcher run and a round-end driver run racing each
+    other makes BOTH probes hang and fall back to CPU. Blocking flock with
+    a cap; on timeout proceed anyway (worst case is the old behavior)."""
+    import fcntl
+    cap = int(os.environ.get("BENCH_LOCK_TIMEOUT", "2400"))
+    try:
+        f = open("/tmp/paddle_tpu_bench.lock", "w")
+    except OSError:
+        return None  # lock file unusable (another user owns it): proceed
+    deadline = time.time() + cap
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return f
+        except OSError:
+            if time.time() >= deadline:
+                return f
+            time.sleep(10)
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1].startswith("--child"):
         return _child_main(sys.argv[1])
 
+    _lock = _acquire_bench_lock()  # held for process lifetime
     result = None
     warning = None
     platform, kind = _probe_tpu()

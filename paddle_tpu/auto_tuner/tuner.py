@@ -176,6 +176,9 @@ def tune_pallas_blocks(kernel_key, run_fn, candidates=None, repeats=3,
                          f"{repeats}/{warmup}")
     if candidates is None:
         candidates = (8, 16, 32, 64, 128, 256)
+    # ascending order: the clamp-detection early break below assumes every
+    # candidate after a clamped one also clamps to the same program
+    candidates = sorted(set(int(c) for c in candidates))
 
     def default_timer(fn):
         for _ in range(warmup):

@@ -194,6 +194,9 @@ int ptpu_api_minor(PtpuEngine* e) {
 // engine never links protobuf).
 int ptpu_compile(PtpuEngine* e, const char* mlir, size_t mlir_len,
                  const char* copts, size_t copts_len) {
+  // non-fatal errors recorded by earlier calls (buffer destroy /
+  // introspection) must not brick a healthy engine
+  if (e && e->client) e->last_error.clear();
   if (!ptpu_ok(e)) return -1;
   PJRT_Program prog;
   memset(&prog, 0, sizeof(prog));
@@ -251,6 +254,7 @@ int ptpu_num_outputs(PtpuEngine* e) {
 int ptpu_execute(PtpuEngine* e, int num_args, const void** data,
                  const int* dtypes, const int64_t* dims_flat,
                  const int* ndims, int num_outputs) {
+  if (e && e->client) e->last_error.clear();
   if (!ptpu_ok(e) || !e->exec) {
     if (e && e->last_error.empty()) set_err(e, "no compiled program");
     return -1;
@@ -318,20 +322,35 @@ int ptpu_execute(PtpuEngine* e, int num_args, const void** data,
   e->out_bytes.assign(num_outputs, {});
   int rc = 0;
   for (int i = 0; i < num_outputs && rc == 0; ++i) {
-    PJRT_Buffer_Dimensions_Args dims_args;
-    memset(&dims_args, 0, sizeof(dims_args));
-    dims_args.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
-    dims_args.buffer = outs[i];
-    if (!take_error(e, e->api->PJRT_Buffer_Dimensions(&dims_args),
-                    "PJRT_Buffer_Dimensions"))
-      e->out_dims[i].assign(dims_args.dims, dims_args.dims + dims_args.num_dims);
-    PJRT_Buffer_ElementType_Args et_args;
-    memset(&et_args, 0, sizeof(et_args));
-    et_args.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
-    et_args.buffer = outs[i];
-    if (!take_error(e, e->api->PJRT_Buffer_ElementType(&et_args),
-                    "PJRT_Buffer_ElementType"))
-      e->out_types[i] = static_cast<int>(et_args.type);
+    // buffer introspection is OPTIONAL: out_types[i] stays 0 (INVALID) on
+    // a missing or failing plugin entry, and the binding falls back to the
+    // deploy container's output specs. Failures here must not poison
+    // last_error for the (successful) execute, so errors are consumed
+    // into a scratch slot.
+    std::string saved_err;
+    std::swap(saved_err, e->last_error);
+    if (e->api->PJRT_Buffer_Dimensions) {
+      PJRT_Buffer_Dimensions_Args dims_args;
+      memset(&dims_args, 0, sizeof(dims_args));
+      dims_args.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+      dims_args.buffer = outs[i];
+      if (!take_error(e, e->api->PJRT_Buffer_Dimensions(&dims_args),
+                      "PJRT_Buffer_Dimensions"))
+        e->out_dims[i].assign(dims_args.dims,
+                              dims_args.dims + dims_args.num_dims);
+    }
+    if (e->api->PJRT_Buffer_ElementType) {
+      PJRT_Buffer_ElementType_Args et_args;
+      memset(&et_args, 0, sizeof(et_args));
+      et_args.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+      et_args.buffer = outs[i];
+      if (!take_error(e, e->api->PJRT_Buffer_ElementType(&et_args),
+                      "PJRT_Buffer_ElementType"))
+        e->out_types[i] = static_cast<int>(et_args.type);
+      else
+        e->out_types[i] = 0;  // INVALID -> binding uses container specs
+    }
+    std::swap(saved_err, e->last_error);
 
     PJRT_Buffer_ToHostBuffer_Args hargs;
     memset(&hargs, 0, sizeof(hargs));

@@ -259,7 +259,14 @@ class PredictorPool:
     capi PredictorPool)."""
 
     def __init__(self, config: Config, size: int = 1):
-        self._preds = [create_predictor(config) for _ in range(max(1, size))]
+        if config.native_engine_enabled():
+            # one PJRT client per process (libtpu rejects a second): every
+            # slot shares the single compiled engine
+            pred = create_predictor(config)
+            self._preds = [pred] * max(1, size)
+        else:
+            self._preds = [create_predictor(config)
+                           for _ in range(max(1, size))]
 
     def retrieve(self, idx: int) -> Predictor:
         return self._preds[idx]

@@ -377,12 +377,14 @@ class NativePredictor:
                                self._lib.ptpu_last_error(self._eng).decode())
         outs = []
         for i in range(len(self._c.outs)):
-            nd = self._lib.ptpu_output_ndim(self._eng, i)
-            if nd >= 0:
+            dt_code = self._lib.ptpu_output_dtype(self._eng, i)
+            if dt_code > 0:  # engine-reported metadata (0 = plugin lacks
+                #              buffer introspection -> container specs)
+                nd = self._lib.ptpu_output_ndim(self._eng, i)
                 shape = tuple(self._lib.ptpu_output_dim(self._eng, i, d)
-                              for d in range(nd))
-                dt = _np_dtype(self._lib.ptpu_output_dtype(self._eng, i))
-            else:  # plugin without buffer introspection: container specs
+                              for d in range(max(nd, 0)))
+                dt = _np_dtype(dt_code)
+            else:
                 dt, shape = (_np_dtype(self._c.outs[i][0]),
                              self._c.outs[i][1])
             nbytes = self._lib.ptpu_output_nbytes(self._eng, i)

@@ -111,14 +111,19 @@ def _decode_fn(model, total, do_sample, top_k, has_eos, prompt_len):
 
 def cached_attention(q, k, v, cache, cache_pos):
     """Incremental attention against a static-length KV cache (the
-    TPU-native decode shape: fixed [B, T, Hkv, D] buffers updated with a
-    dynamic slice; masking hides positions past the current length, so
-    stale buffer contents can never leak into the output). Model-agnostic:
-    GQA attends via a grouped einsum over the shared kv heads — the cache
-    is never expanded (no HBM repeat on the hot decode path).
+    TPU-native decode shape: fixed [B, Hkv, T, D] buffers — time-contiguous
+    per head — updated with a dynamic slice; masking hides positions past
+    the current length, so stale buffer contents can never leak into the
+    output). Model-agnostic: GQA attends via the shared kv heads without
+    expanding the cache (no HBM repeat on the hot decode path).
+
+    The single-token steady state dispatches to the fused decode kernel
+    (ops/kernels/mmha_pallas.py — reference family
+    masked_multihead_attention_kernel.cu); multi-token prefill and
+    off-kernel shapes use the grouped-einsum composite.
 
     q/k/v: [B, s, H(_kv), D] for the s new positions starting at
-    cache_pos; cache: (k_buf, v_buf) Tensors [B, T, Hkv, D].
+    cache_pos; cache: (k_buf, v_buf) Tensors [B, Hkv, T, D].
     Returns (out [B, s, H, D], new (k_buf, v_buf))."""
     import math
 
@@ -127,6 +132,8 @@ def cached_attention(q, k, v, cache, cache_pos):
 
     from ..autograd.function import apply_multi
     from ..core.tensor import as_tensor
+    from ..ops.kernels import _common as kern
+    from ..ops.kernels import mmha_pallas
 
     pos = as_tensor(cache_pos)._data.reshape(()) \
         if not isinstance(cache_pos, int) else cache_pos
@@ -134,25 +141,30 @@ def cached_attention(q, k, v, cache, cache_pos):
 
     def f(qa, ka, va, kb, vb):
         b, s, hq, d = qa.shape
-        t = kb.shape[1]
+        t = kb.shape[2]
         start = jnp.asarray(pos, jnp.int32)
         z = jnp.int32(0)
+        # new tokens arrive [B, s, Hkv, D]; the cache stores [B, Hkv, T, D]
         kb = jax.lax.dynamic_update_slice(
-            kb, ka.astype(kb.dtype), (z, start, z, z))
+            kb, jnp.swapaxes(ka, 1, 2).astype(kb.dtype), (z, z, start, z))
         vb = jax.lax.dynamic_update_slice(
-            vb, va.astype(vb.dtype), (z, start, z, z))
-        h_kv = kb.shape[2]
+            vb, jnp.swapaxes(va, 1, 2).astype(vb.dtype), (z, z, start, z))
+        h_kv = kb.shape[1]
+        if mmha_pallas.use_kernel(qa.shape, kb.shape, kb.dtype):
+            out = mmha_pallas.mmha_decode(qa, kb, vb, start,
+                                          interpret=kern.interpret_mode())
+            return out, kb, vb
         rep = hq // h_kv
         qg = qa.reshape(b, s, h_kv, rep, d).astype(jnp.float32)
         scale = 1.0 / math.sqrt(d)
-        logits = jnp.einsum("bsgrd,btgd->bgrst", qg,
+        logits = jnp.einsum("bsgrd,bgtd->bgrst", qg,
                             kb.astype(jnp.float32)) * scale
         rows = start + jnp.arange(s)                    # absolute q pos
         mask = jnp.arange(t)[None, None, None, None, :] <= \
             rows[None, None, None, :, None]
         logits = jnp.where(mask, logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bgrst,btgd->bsgrd", probs,
+        out = jnp.einsum("bgrst,bgtd->bsgrd", probs,
                          vb.astype(jnp.float32))
         return out.reshape(b, s, hq, d).astype(qa.dtype), kb, vb
 

@@ -121,15 +121,14 @@ class GPT(GenerationMixin, nn.Layer):
                                      weight_attr=attr, bias_attr=False)
 
     def init_cache(self, batch, max_len, dtype="float32"):
-        """Zeroed per-layer (k, v) buffers [B, T, H, D] for incremental
+        """Zeroed per-layer (k, v) buffers [B, H, T, D] for incremental
         decode (the static-shape KV cache generate() threads through its
-        compiled loop)."""
-        import jax.numpy as jnp
-        shape = (batch, max_len, self.cfg.num_heads,
-                 self.cfg.hidden_size // self.cfg.num_heads)
-        return [(paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))),
-                 paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))))
-                for _ in self.blocks]
+        compiled loop; layout + T rounding per llama._init_kv_cache)."""
+        from .llama import _init_kv_cache
+        return _init_kv_cache(len(self.blocks), batch, max_len,
+                              self.cfg.num_heads,
+                              self.cfg.hidden_size // self.cfg.num_heads,
+                              dtype)
 
     def _head(self, x):
         """Shared final-norm + (tied) projection — ONE copy so the decode

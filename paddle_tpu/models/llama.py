@@ -60,10 +60,18 @@ def _rope_tables(cfg: LlamaConfig, seq_len: int, dtype="float32"):
 
 def _init_kv_cache(n_layers, batch, max_len, n_kv, head_dim,
                    dtype="float32"):
-    """Zeroed per-layer (k, v) cache buffers [B, T, n_kv, D] (shared by
-    every rope/GQA decoder family — Llama and dense ERNIE)."""
+    """Zeroed per-layer (k, v) cache buffers [B, n_kv, T, D] (shared by
+    every rope/GQA decoder family — Llama and dense ERNIE).
+
+    Layout is time-contiguous per head — each head's cache is one
+    stride-free [T, D] tile, the shape the decode-attention Pallas kernel
+    (ops/kernels/mmha_pallas.py) scans chunkwise. T is rounded up to the
+    kernel's chunk size; attention masks positions past the current length,
+    so the tail padding is never read."""
     import jax.numpy as jnp
-    shape = (batch, max_len, n_kv, head_dim)
+    from ..ops.kernels._common import round_up
+    t_alloc = round_up(max_len, 256)
+    shape = (batch, n_kv, t_alloc, head_dim)
     return [(paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))),
              paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))))
             for _ in range(n_layers)]

@@ -545,3 +545,70 @@ def test_pallas_block_autotune_mechanism():
         assert _kc.get_block_override("rms_norm") == 16
     finally:
         _kc.set_block_override("rms_norm", None)
+
+
+# ---- masked multi-head (decode) attention kernel --------------------------
+
+@pytest.mark.parametrize("cfg", [
+    # (b, h, h_kv, d, t, pos)
+    (2, 8, 2, 64, 256, 0),       # GQA, first decode step
+    (2, 8, 2, 64, 256, 130),     # GQA, mid-cache (crosses a 128 boundary)
+    (1, 4, 4, 32, 256, 255),     # MHA, cache full
+    (1, 6, 3, 128, 512, 300),    # odd rep=2, two chunks used
+])
+def test_mmha_decode_matches_composite(cfg):
+    from paddle_tpu.ops.kernels import mmha_pallas
+    b, h, h_kv, d, t, pos = cfg
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    kb = jnp.asarray(rng.standard_normal((b, h_kv, t, d)), jnp.float32)
+    vb = jnp.asarray(rng.standard_normal((b, h_kv, t, d)), jnp.float32)
+    out = mmha_pallas.mmha_decode(q, kb, vb, jnp.int32(pos), interpret=True)
+    ref = mmha_pallas.reference_mmha(q, kb, vb, jnp.int32(pos))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mmha_use_kernel_gate():
+    from paddle_tpu.ops.kernels import mmha_pallas
+    kern.force_interpret(True)
+    try:
+        ok = mmha_pallas.use_kernel((2, 1, 8, 64), (2, 2, 256, 64),
+                                    jnp.float32)
+        assert ok
+        # multi-token prefill, chunk-indivisible cache, oversized cache
+        assert not mmha_pallas.use_kernel((2, 3, 8, 64), (2, 2, 256, 64),
+                                          jnp.float32)
+        assert not mmha_pallas.use_kernel((2, 1, 8, 64), (2, 2, 300, 64),
+                                          jnp.float32)
+        assert not mmha_pallas.use_kernel((2, 1, 8, 64),
+                                          (2, 2, 65536, 64), jnp.float32)
+    finally:
+        kern.force_interpret(False)
+
+
+def test_cached_attention_dispatches_mmha_kernel():
+    """The generation-path cached_attention hits the decode kernel for the
+    single-token steady state and matches its own composite path."""
+    from paddle_tpu.models.generation import cached_attention
+    rng = np.random.default_rng(3)
+    b, h, h_kv, d, t = 2, 8, 2, 64, 256
+    pos = 100
+    kb = rng.standard_normal((b, h_kv, t, d)).astype(np.float32)
+    vb = rng.standard_normal((b, h_kv, t, d)).astype(np.float32)
+    q = paddle.to_tensor(rng.standard_normal((b, 1, h, d)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((b, 1, h_kv, d)).astype(np.float32))
+    v = paddle.to_tensor(rng.standard_normal((b, 1, h_kv, d)).astype(np.float32))
+    cache = (paddle.to_tensor(kb), paddle.to_tensor(vb))
+
+    out_ref, (kb_ref, vb_ref) = cached_attention(q, k, v, cache, pos)
+    kern.force_interpret(True)
+    try:
+        out_kern, (kb2, vb2) = cached_attention(q, k, v, cache, pos)
+    finally:
+        kern.force_interpret(False)
+    np.testing.assert_allclose(np.asarray(out_kern.numpy()),
+                               np.asarray(out_ref.numpy()),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(kb2.numpy()),
+                                  np.asarray(kb_ref.numpy()))

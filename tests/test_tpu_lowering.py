@@ -297,3 +297,18 @@ def test_llama_forward_lowers_with_kernels(forced_dispatch):
 
     ids = jnp.zeros((1, 256), jnp.int32)
     assert_mosaic(lower_tpu(fwd, arrays, ids))
+
+
+@pytest.mark.parametrize("cfg", [(2, 8, 2, 64, 512), (1, 4, 4, 128, 256)],
+                         ids=["gqa4", "mha"])
+def test_mmha_decode_lowers(cfg):
+    """The decode-attention kernel (one token over the [B, Hkv, T, D]
+    cache, scalar-prefetch position) lowers for TPU."""
+    from paddle_tpu.ops.kernels import mmha_pallas
+    b, h, h_kv, d, t = cfg
+    q = jnp.zeros((b, 1, h, d), jnp.bfloat16)
+    kb = jnp.zeros((b, h_kv, t, d), jnp.bfloat16)
+    vb = jnp.zeros((b, h_kv, t, d), jnp.bfloat16)
+    assert_mosaic(lower_tpu(
+        lambda a, kk, vv: mmha_pallas.mmha_decode(a, kk, vv, jnp.int32(37)),
+        q, kb, vb))

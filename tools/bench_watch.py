@@ -118,7 +118,11 @@ def main():
             record(res)
             last_success = time.time()
         else:
-            log("bench ran but fell back to CPU: %s" % res.get("metric"))
+            ex = res.get("extra", {})
+            log("bench ran but fell back to CPU: %s why=%r err=%r"
+                % (res.get("metric"),
+                   str(ex.get("init_warning", ""))[:500],
+                   str(res.get("error", ""))[:500]))
         time.sleep(PROBE_INTERVAL)
 
 
