@@ -329,17 +329,20 @@ int ptpu_execute(PtpuEngine* e, int num_args, const void** data,
     // into a scratch slot.
     std::string saved_err;
     std::swap(saved_err, e->last_error);
+    bool dims_ok = false;
     if (e->api->PJRT_Buffer_Dimensions) {
       PJRT_Buffer_Dimensions_Args dims_args;
       memset(&dims_args, 0, sizeof(dims_args));
       dims_args.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
       dims_args.buffer = outs[i];
       if (!take_error(e, e->api->PJRT_Buffer_Dimensions(&dims_args),
-                      "PJRT_Buffer_Dimensions"))
+                      "PJRT_Buffer_Dimensions")) {
         e->out_dims[i].assign(dims_args.dims,
                               dims_args.dims + dims_args.num_dims);
+        dims_ok = true;
+      }
     }
-    if (e->api->PJRT_Buffer_ElementType) {
+    if (dims_ok && e->api->PJRT_Buffer_ElementType) {
       PJRT_Buffer_ElementType_Args et_args;
       memset(&et_args, 0, sizeof(et_args));
       et_args.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
@@ -347,9 +350,10 @@ int ptpu_execute(PtpuEngine* e, int num_args, const void** data,
       if (!take_error(e, e->api->PJRT_Buffer_ElementType(&et_args),
                       "PJRT_Buffer_ElementType"))
         e->out_types[i] = static_cast<int>(et_args.type);
-      else
-        e->out_types[i] = 0;  // INVALID -> binding uses container specs
     }
+    // out_types[i] stays 0 (INVALID) unless BOTH dims and dtype were
+    // introspected — a dtype without a shape would make the binding
+    // reshape to (), so partial metadata falls back to container specs
     std::swap(saved_err, e->last_error);
 
     PJRT_Buffer_ToHostBuffer_Args hargs;

@@ -9,7 +9,11 @@ tests), so the pass layer here is small and OPTIMIZER/STEP-level:
 - gradient_merge: accumulate k micro-step grads before one optimizer step
   (the reference's gradient_merge_pass rewritten as an optimizer wrapper —
   the compiled step stays one XLA program per micro-step).
-- recompute: delegates to fleet.recompute (jax.checkpoint).
+- amp / recompute / sharding (transform_passes.py): object-level analogs of
+  the reference's program-rewriting passes — param-dtype cast + master
+  weights, jax.checkpoint wrapping of repeated blocks, ZeRO-stage
+  optimizer wrapping. The transform lands in the compiled step because the
+  step is traced from the transformed objects.
 - comm_overlap / fuse_all_reduce: REAL compile controls — they wrap the
   step callable in a jit carrying per-platform XLA compiler-option
   bundles (latency-hiding / concurrency scheduler knobs, collective
@@ -23,9 +27,11 @@ from __future__ import annotations
 
 from .pass_base import PassBase, PassContext, PassManager, register_pass  # noqa: F401
 from .gradient_merge import GradientMergePass  # noqa: F401
+from .transform_passes import AMPPass, RecomputePass, ShardingPass  # noqa: F401
 
 __all__ = ["PassBase", "PassContext", "PassManager", "register_pass",
-           "GradientMergePass", "new_pass"]
+           "GradientMergePass", "AMPPass", "RecomputePass", "ShardingPass",
+           "new_pass"]
 
 
 def new_pass(name, attrs=None):

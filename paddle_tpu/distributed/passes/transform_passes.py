@@ -1,0 +1,135 @@
+"""Model/optimizer transform passes (reference:
+python/paddle/distributed/passes/auto_parallel_amp.py, _fp16.py,
+_recompute.py, _sharding.py — program-rewriting passes in the reference's
+static pass pipeline).
+
+TPU-native realization: there is no Program to rewrite — the jitted step is
+compiled from the live model — so each pass transforms the OBJECTS the
+compiled step is traced from (cast params + enable master weights, wrap
+sublayer forwards in jax.checkpoint, wrap the optimizer in the sharding
+stages). The result is observable in the compiled program (dtype of the
+matmuls, rematerialized activations, sharded optimizer states), which is
+what the reference passes achieve through HLO-level surgery.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from .pass_base import PassBase, register_pass
+
+
+def _as_model_opt(target):
+    """Accept (model, optimizer) or a bare model; returns (model, opt|None,
+    was_tuple)."""
+    if isinstance(target, tuple) and len(target) == 2:
+        return target[0], target[1], True
+    return target, None, False
+
+
+@register_pass("auto_parallel_amp")
+@register_pass("auto_parallel_fp16")
+@register_pass("amp")
+class AMPPass(PassBase):
+    """Mixed-precision pass (reference auto_parallel_amp.py inserts cast
+    ops + rewrites the program to fp16/bf16; here: amp.decorate casts the
+    params and arms master weights, and the traced step inherits the
+    dtypes). Attrs: level ('O1'|'O2', default 'O2' — the pass exists to
+    flip the whole program, matching the reference fp16 pass), dtype
+    ('bfloat16' default — the TPU-native low dtype)."""
+
+    def apply(self, target, context=None):
+        from ...amp.auto_cast import decorate
+        model, opt, was_tuple = _as_model_opt(target)
+        level = self.get_attr("level", "O2")
+        dtype = self.get_attr("dtype", "bfloat16")
+        # decorate returns (model, opt) when an optimizer is given, the
+        # bare model otherwise — matching the target shape either way
+        out = decorate(model, optimizers=opt, level=level, dtype=dtype)
+        if context is not None:
+            context.attrs["amp"] = {"level": level, "dtype": dtype}
+        return out
+
+
+@register_pass("auto_parallel_recompute")
+@register_pass("recompute")
+class RecomputePass(PassBase):
+    """Activation-checkpointing pass (reference auto_parallel_recompute.py
+    marks checkpoint segments in the program; here: the selected
+    sublayers' forwards are wrapped in fleet recompute — jax.checkpoint —
+    so the compiled step rematerializes their activations in backward).
+
+    Attrs: `layer_filter` (callable Layer -> bool) or `layer_types`
+    (tuple of class-name strings); default wraps the model's direct
+    repeated blocks (children of any LayerList), the segments the
+    reference pass checkpoints."""
+
+    def _targets(self, model):
+        from ...nn.layers.container import LayerList
+        flt = self.get_attr("layer_filter")
+        types = self.get_attr("layer_types")
+        out = []
+        for _, sub in model.named_sublayers(include_self=True):
+            if flt is not None:
+                if flt(sub):
+                    out.append(sub)
+            elif types is not None:
+                if type(sub).__name__ in tuple(types):
+                    out.append(sub)
+            elif isinstance(sub, LayerList):
+                out.extend(list(sub))
+        return out
+
+    def apply(self, target, context=None):
+        from ...distributed.fleet.recompute import recompute
+        model, opt, was_tuple = _as_model_opt(target)
+        wrapped = 0
+        for sub in self._targets(model):
+            if getattr(sub, "_recompute_wrapped", False):
+                continue
+            orig = sub.forward
+            params = [p for _, p in sub.named_parameters()]
+
+            def fwd(*args, __orig=orig, __params=params, **kw):
+                return recompute(__orig, *args, recompute_params=__params,
+                                 **kw)
+
+            sub.forward = fwd
+            sub._recompute_wrapped = True
+            wrapped += 1
+        if wrapped == 0:
+            warnings.warn("recompute pass wrapped no layers (no LayerList "
+                          "children and no layer_filter/layer_types match)",
+                          UserWarning, stacklevel=2)
+        if context is not None:
+            context.attrs["recompute_wrapped"] = wrapped
+        return (model, opt) if was_tuple else model
+
+
+@register_pass("auto_parallel_sharding")
+@register_pass("sharding")
+class ShardingPass(PassBase):
+    """Optimizer-state sharding pass (reference auto_parallel_sharding.py
+    rewrites the program per ZeRO stage; here: the optimizer/model pair is
+    wrapped in the dygraph sharding stages, whose sharded states and
+    collectives land in the compiled step). Attrs: `stage` (1|2|3,
+    default 1), `offload` (bool)."""
+
+    def apply(self, target, context=None):
+        from ...distributed.meta_parallel.sharding import \
+            group_sharded_parallel
+        model, opt, was_tuple = _as_model_opt(target)
+        if opt is None:
+            warnings.warn("sharding pass needs a (model, optimizer) "
+                          "target; passed through unchanged",
+                          UserWarning, stacklevel=2)
+            return target
+        stage = int(self.get_attr("stage", 1))
+        level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(stage)
+        if level is None:
+            raise ValueError(f"sharding stage must be 1, 2 or 3, got {stage}")
+        model, opt, _ = group_sharded_parallel(
+            model, opt, level, offload=bool(self.get_attr("offload", False)))
+        if context is not None:
+            context.attrs["sharding"] = {"stage": stage}
+        return model, opt

@@ -305,6 +305,11 @@ class NativePredictor:
     contract: AnalysisPredictor::Run — named feeds in, dense fetches out)."""
 
     def __init__(self, model_path, plugin_path=None, build_directory=None):
+        import threading
+        # the C++ engine keeps per-execute output state; PredictorPool
+        # shares ONE engine across slots (single PJRT client per process),
+        # so run() serializes
+        self._run_lock = threading.Lock()
         if not model_path.endswith(".ptpu"):
             model_path += ".ptpu"
         self._c = read_container(model_path)
@@ -368,32 +373,34 @@ class NativePredictor:
         dims_flat = np.asarray(
             [d for a in args for d in a.shape] or [0], dtype=np.int64)
         ndims = (ctypes.c_int * n)(*[a.ndim for a in args])
-        rc = self._lib.ptpu_execute(
-            self._eng, n, data, dtypes,
-            dims_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), ndims,
-            len(self._c.outs))
-        if rc != 0:
-            raise RuntimeError("PJRT execute failed: " +
-                               self._lib.ptpu_last_error(self._eng).decode())
-        outs = []
-        for i in range(len(self._c.outs)):
-            dt_code = self._lib.ptpu_output_dtype(self._eng, i)
-            if dt_code > 0:  # engine-reported metadata (0 = plugin lacks
-                #              buffer introspection -> container specs)
-                nd = self._lib.ptpu_output_ndim(self._eng, i)
-                shape = tuple(self._lib.ptpu_output_dim(self._eng, i, d)
-                              for d in range(max(nd, 0)))
-                dt = _np_dtype(dt_code)
-            else:
-                dt, shape = (_np_dtype(self._c.outs[i][0]),
-                             self._c.outs[i][1])
-            nbytes = self._lib.ptpu_output_nbytes(self._eng, i)
-            out = np.empty(nbytes // dt.itemsize, dtype=dt)
-            if self._lib.ptpu_output_copy(
-                    self._eng, i, out.ctypes.data_as(ctypes.c_void_p),
-                    out.nbytes) != 0:
-                raise RuntimeError("output copy failed")
-            outs.append(out.reshape(shape))
+        with self._run_lock:
+            rc = self._lib.ptpu_execute(
+                self._eng, n, data, dtypes,
+                dims_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ndims, len(self._c.outs))
+            if rc != 0:
+                raise RuntimeError(
+                    "PJRT execute failed: " +
+                    self._lib.ptpu_last_error(self._eng).decode())
+            outs = []
+            for i in range(len(self._c.outs)):
+                dt_code = self._lib.ptpu_output_dtype(self._eng, i)
+                if dt_code > 0:  # engine metadata (0 = plugin lacks buffer
+                    #              introspection -> container specs)
+                    nd = self._lib.ptpu_output_ndim(self._eng, i)
+                    shape = tuple(self._lib.ptpu_output_dim(self._eng, i, d)
+                                  for d in range(max(nd, 0)))
+                    dt = _np_dtype(dt_code)
+                else:
+                    dt, shape = (_np_dtype(self._c.outs[i][0]),
+                                 self._c.outs[i][1])
+                nbytes = self._lib.ptpu_output_nbytes(self._eng, i)
+                out = np.empty(nbytes // dt.itemsize, dtype=dt)
+                if self._lib.ptpu_output_copy(
+                        self._eng, i, out.ctypes.data_as(ctypes.c_void_p),
+                        out.nbytes) != 0:
+                    raise RuntimeError("output copy failed")
+                outs.append(out.reshape(shape))
         return outs
 
     def __del__(self):

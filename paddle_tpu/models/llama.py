@@ -70,7 +70,8 @@ def _init_kv_cache(n_layers, batch, max_len, n_kv, head_dim,
     so the tail padding is never read."""
     import jax.numpy as jnp
     from ..ops.kernels._common import round_up
-    t_alloc = round_up(max_len, 256)
+    from ..ops.kernels.mmha_pallas import BLOCK_T
+    t_alloc = round_up(max_len, BLOCK_T)
     shape = (batch, n_kv, t_alloc, head_dim)
     return [(paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))),
              paddle.Tensor(jnp.zeros(shape, jnp.dtype(dtype))))

@@ -33,6 +33,10 @@ from ._common import round_up
 
 NEG_INF = -1e30
 
+# cache-scan chunk length; _init_kv_cache rounds cache allocations to this
+# so t % BLOCK_T == 0 always holds on the decode path
+BLOCK_T = 256
+
 # full-cache VMEM residency bound per (batch, kv-head) program: k + v blocks
 # must fit comfortably under the ~16MB VMEM budget with room for the
 # accumulators and double buffering
@@ -75,7 +79,7 @@ def _mmha_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_t, scale):
         o_ref.dtype)
 
 
-def use_kernel(q_shape, cache_shape, cache_dtype, block_t=256) -> bool:
+def use_kernel(q_shape, cache_shape, cache_dtype, block_t=BLOCK_T) -> bool:
     """Gate: single new token, chunk-divisible cache, VMEM-resident k+v."""
     from . import _common as kern
     if not kern.available():
@@ -92,7 +96,7 @@ def use_kernel(q_shape, cache_shape, cache_dtype, block_t=256) -> bool:
 
 
 @functools.partial(jax.jit, static_argnames=("block_t", "interpret"))
-def mmha_decode(q, k_buf, v_buf, pos, block_t=256, interpret=False):
+def mmha_decode(q, k_buf, v_buf, pos, block_t=BLOCK_T, interpret=False):
     """q [B, 1, H, D]; k_buf/v_buf [B, Hkv, T, D] (current token already
     written at `pos`); pos: traced scalar, last valid cache index.
     Returns [B, 1, H, D]."""
