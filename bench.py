@@ -604,9 +604,34 @@ def main():
     _lock = _acquire_bench_lock()  # held for process lifetime
     result = None
     warning = None
-    platform, kind = _probe_tpu()
+    if os.environ.get("BENCH_ASSUME_TPU") == "1":
+        # the caller (bench watcher) just probed: every extra client
+        # connect worsens the tunnel's slow-release race, so skip ours
+        platform, kind = "tpu", "assumed"
+    else:
+        platform, kind = _probe_tpu()
     if platform in ("tpu", "axon"):
-        result = _run_child("--child-tpu")
+        # the tunnel is single-client and releases slowly: give the probe
+        # subprocess's client time to drop before the child grabs it, and
+        # retry once on the release-race error signature — but only inside
+        # the total budget, so the CALLER's subprocess timeout (watcher:
+        # 2700s) always sees our JSON line rather than killing us mid-retry
+        t0 = time.time()
+        budget = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "2400"))
+        wait = int(os.environ.get("BENCH_RETRY_WAIT_S", "90"))
+        time.sleep(int(os.environ.get("BENCH_SETTLE_S", "15")))
+        for attempt in range(2):
+            result = _run_child("--child-tpu")
+            err = (result or {}).get("error", "")
+            if result is not None and "error" not in result:
+                break
+            retriable = ("UNAVAILABLE" in err or "setup/compile" in err
+                         or not err)
+            fits = time.time() - t0 + wait + _RUN_TIMEOUT <= budget
+            if attempt == 0 and retriable and fits:
+                time.sleep(wait)
+                continue
+            break
         if result is not None and "error" in result:
             warning = result["error"]
             result = None

@@ -10,7 +10,19 @@ white-list machinery reasons about."""
 
 from __future__ import annotations
 
-__all__ = ['decompose', 'primitives_of', 'has_composite']
+__all__ = ['decompose', 'decompose_fn', 'primitives_of', 'has_composite']
+
+# call-like primitives whose bodies `decompose` inlines (the TPU analog of
+# the reference rewriting composite PIR ops into primitive ops,
+# python/paddle/decomposition/decomp.py decompose): jit/pjit sub-programs,
+# checkpoint wrappers, and custom-autodiff wrappers all hide primitive
+# equations behind one opaque equation
+_CALL_PRIMS = {
+    "jit", "pjit", "closed_call", "core_call", "xla_call",
+    "remat", "remat2", "checkpoint",
+    "custom_vjp_call", "custom_jvp_call",
+    "custom_vjp_call_jaxpr", "custom_jvp_call_jaxpr",
+}
 
 
 def _pure_fn(func, stop_gradient=False):
@@ -38,27 +50,108 @@ def _pure_fn(func, stop_gradient=False):
     return f
 
 
-def decompose(func, *example_args):
-    """Trace ``func`` at ``example_args`` and return the primitive program
-    (a jaxpr — the TPU analog of the decomposed PIR program)."""
+def _inner_closed(eqn):
+    """The ClosedJaxpr a call-like equation hides (param layouts differ by
+    primitive and jax version: 'jaxpr' for jit/remat, 'call_jaxpr' for
+    custom_vjp_call, 'fun_jaxpr' historically)."""
+    from jax.extend import core as jex_core
+
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        v = eqn.params.get(key)
+        if v is None:
+            continue
+        if isinstance(v, jex_core.ClosedJaxpr):
+            return v
+        if hasattr(v, "eqns"):  # open jaxpr (remat2): no captured consts
+            return jex_core.ClosedJaxpr(v, [])
+    return None
+
+
+def _inline_eval(closed, *args):
+    """Evaluate a ClosedJaxpr, recursively inlining call-like equations so
+    a retrace sees ONLY leaf primitives (the decompose rewrite)."""
+    from jax.extend import core as jex_core
+
+    jaxpr, consts = closed.jaxpr, closed.consts
+    env = {}
+
+    def read(var):
+        return var.val if isinstance(var, jex_core.Literal) else env[var]
+
+    for v, c in zip(jaxpr.constvars, consts):
+        env[v] = c
+    for v, a in zip(jaxpr.invars, args):
+        env[v] = a
+    for eqn in jaxpr.eqns:
+        invals = [read(v) for v in eqn.invars]
+        inner = _inner_closed(eqn) \
+            if eqn.primitive.name in _CALL_PRIMS else None
+        if inner is not None:
+            outs = _inline_eval(inner, *invals)
+        else:
+            outs = eqn.primitive.bind(*invals, **eqn.params)
+            if not eqn.primitive.multiple_results:
+                outs = [outs]
+        for v, val in zip(eqn.outvars, outs):
+            env[v] = val
+    return [read(v) for v in jaxpr.outvars]
+
+
+def decompose_fn(func, *example_args):
+    """Rewrite ``func`` into an equivalent callable whose trace contains
+    only leaf primitives — jit bodies, checkpoint wrappers, and
+    custom-vjp/jvp wrappers are inlined (custom gradient rules are
+    REPLACED by primitive autodiff, exactly the reference's composite->
+    primitive contract for prim-based higher-order autodiff). Returns
+    (fn, arrays) ready for jax tracing/transforms."""
     import jax
 
     from ..core.tensor import Tensor
 
     arrs = [a._data if isinstance(a, Tensor) else a for a in example_args]
-    return jax.make_jaxpr(_pure_fn(func))(*arrs)
+    raw = jax.make_jaxpr(_pure_fn(func))(*arrs)
+
+    def inlined(*xs):
+        out = _inline_eval(raw, *xs)
+        return out[0] if len(out) == 1 else tuple(out)
+
+    return inlined, arrs
 
 
-def primitives_of(func, *example_args):
-    """Sorted primitive names used by ``func`` (transitively through inner
-    closed-call jaxprs)."""
-    jaxpr = decompose(func, *example_args)
+def decompose(func, *example_args, whitelist=None):
+    """Trace ``func`` at ``example_args`` and return the PRIMITIVE program:
+    a jaxpr in which every call-like composite (jit/pjit, checkpoint,
+    custom-vjp/jvp) has been inlined (reference decomp.py `decompose`
+    rewriting a PIR program to the primitive set).
 
+    `whitelist`: optional iterable of allowed primitive names — the
+    reference's white-list contract. Any equation outside it raises
+    ValueError naming the offenders."""
+    import jax
+
+    inlined, arrs = decompose_fn(func, *example_args)
+    out = jax.make_jaxpr(inlined)(*arrs)
+    if whitelist is not None:
+        # transitive: control-flow primitives (cond/scan/while) legally
+        # keep sub-jaxprs — their bodies are checked too. No exemption for
+        # call prims: a successfully inlined program has none left, and a
+        # wrapper _inner_closed failed to recognize must be flagged, not
+        # silently passed
+        used = _collect_primitive_names(out.jaxpr)
+        bad = sorted(used - set(whitelist))
+        if bad:
+            raise ValueError(
+                f"decompose: primitives outside the whitelist: {bad}")
+    return out
+
+
+def _collect_primitive_names(jx):
+    """Primitive names of a (open) jaxpr, transitively through params
+    holding jaxprs directly, as ClosedJaxpr, or in tuples/lists (e.g.
+    lax.cond's 'branches')."""
     names = set()
 
     def descend(v):
-        # params hold jaxprs directly, as ClosedJaxpr, or in tuples/lists
-        # (e.g. lax.cond's 'branches')
         if isinstance(v, (tuple, list)):
             for item in v:
                 descend(item)
@@ -69,13 +162,25 @@ def primitives_of(func, *example_args):
         elif hasattr(v, 'eqns'):
             walk(v)
 
-    def walk(jx):
-        for eqn in jx.eqns:
+    def walk(j):
+        for eqn in j.eqns:
             names.add(eqn.primitive.name)
             for v in eqn.params.values():
                 descend(v)
-    walk(jaxpr.jaxpr)
-    return sorted(names)
+    walk(jx)
+    return names
+
+
+def primitives_of(func, *example_args):
+    """Sorted primitive names used by ``func`` (transitively through inner
+    closed-call jaxprs). Walks the RAW trace — call-like wrappers appear
+    by name (so has_composite can detect them), their bodies too."""
+    import jax
+
+    from ..core.tensor import Tensor
+    arrs = [a._data if isinstance(a, Tensor) else a for a in example_args]
+    jaxpr = jax.make_jaxpr(_pure_fn(func))(*arrs)
+    return sorted(_collect_primitive_names(jaxpr.jaxpr))
 
 
 def has_composite(func, *example_args):

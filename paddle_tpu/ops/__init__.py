@@ -16,6 +16,7 @@ from .logic import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .activation import *  # noqa: F401,F403
+from .tensor_array import *  # noqa: F401,F403
 
 from . import creation, math, reduction, manipulation, logic, linalg, random, activation
 

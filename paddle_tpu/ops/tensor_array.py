@@ -1,0 +1,188 @@
+"""TensorArray ops (reference: python/paddle/tensor/array.py —
+array_length/read/write/create_array over the framework's LoDTensorArray,
+the growable tensor list the static control-flow and decoding ops thread
+through while_loops; plus tensor_array_to_tensor,
+python/paddle/tensor/manipulation.py:46).
+
+TPU-native design: two modes, one class.
+- Eager / unrolled-trace: a Python list of Tensors — writes append or
+  overwrite by (possibly growing) index, exactly the reference's dygraph
+  behavior (there dygraph swaps the array for a plain Python list too).
+- Inside a compiled loop (`TensorArray(size=n, ...)`): a STATIC
+  pre-allocated [n, ...] buffer written with lax.dynamic_update_slice, so
+  dynamic (traced) indices work under jit/while_loop — the static-shape
+  realization of the reference's growable array (XLA has no growable
+  tensors; beam-search/decoding buffers are exactly this shape).
+"""
+
+from __future__ import annotations
+
+__all__ = ["TensorArray", "create_array", "array_length", "array_read",
+           "array_write", "tensor_array_to_tensor"]
+
+
+def _is_traced_index(i):
+    from ..core.tensor import Tensor
+    if isinstance(i, Tensor):
+        import jax.core
+        return isinstance(i._data, jax.core.Tracer)
+    return False
+
+
+class TensorArray:
+    """List-of-tensors container; `size=None` grows like a list (eager),
+    `size=n` is a static ring buffer usable with traced indices."""
+
+    def __init__(self, dtype="float32", initialized_list=None, size=None,
+                 elem_shape=None):
+        from ..core import dtype as dtypes
+        self.dtype = dtypes.dtype_from_any(dtype)
+        self._items = list(initialized_list or [])
+        self._buffer = None
+        self._size = size
+        if size is not None:
+            if elem_shape is None:
+                raise ValueError("static TensorArray needs elem_shape")
+            import jax.numpy as jnp
+
+            from ..core.tensor import Tensor
+            self._buffer = Tensor(jnp.zeros((size,) + tuple(elem_shape),
+                                            self.dtype.np_dtype))
+
+    # -- python-list protocol (reference dygraph parity) ------------------
+    def __len__(self):
+        return self._size if self._buffer is not None else len(self._items)
+
+    def append(self, x):
+        if self._buffer is not None:
+            raise TypeError("static TensorArray has fixed size; use write()")
+        self._items.append(x)
+
+    def __getitem__(self, i):
+        return self.read(i)
+
+    # -- array ops --------------------------------------------------------
+    def write(self, i, x):
+        """Static mode mutates the buffer Tensor IN PLACE (`_d`
+        assignment) — to_static tracks state by object identity and
+        writes final arrays back into the SAME Tensors, so rebinding the
+        attribute would leak a tracer out of the compiled call. Array
+        writes are bookkeeping, not a differentiable op (matching the
+        reference's dygraph TensorArray, a plain Python list)."""
+        from ..autograd.function import apply
+        from ..core.tensor import as_tensor
+        x = as_tensor(x)
+        if self._buffer is not None:
+            import jax
+
+            def upd(buf, val, idx=i):
+                import jax.numpy as jnp
+                iarr = idx._data if hasattr(idx, "_data") else jnp.int32(idx)
+                start = (iarr.astype(jnp.int32).reshape(()),) + \
+                    (jnp.int32(0),) * (buf.ndim - 1)
+                return jax.lax.dynamic_update_slice(
+                    buf, val.astype(buf.dtype)[None], start)
+
+            if _is_traced_index(i):
+                out = apply(upd, self._buffer, x, name="array_write")
+            else:
+                out = apply(lambda b, v: upd(b, v, int(i)),
+                            self._buffer, x, name="array_write")
+            self._buffer._d = out._d
+            return self
+        idx = int(i)
+        if idx < len(self._items):
+            self._items[idx] = x
+        else:
+            # reference dygraph array_write: writing at/past the end
+            # APPENDS (python/paddle/tensor/array.py dygraph branch) —
+            # the array never holds unwritten gap slots
+            self._items.append(x)
+        return self
+
+    def read(self, i):
+        from ..autograd.function import apply
+        if self._buffer is not None:
+            import jax
+
+            def rd(buf, idx=i):
+                import jax.numpy as jnp
+                iarr = idx._data if hasattr(idx, "_data") else jnp.int32(idx)
+                start = (iarr.astype(jnp.int32).reshape(()),) + \
+                    (jnp.int32(0),) * (buf.ndim - 1)
+                return jax.lax.dynamic_slice(
+                    buf, start, (1,) + buf.shape[1:])[0]
+
+            return apply(rd, self._buffer, name="array_read")
+        return self._items[int(i)]
+
+    def stack(self, axis=0):
+        from ..core.tensor import Tensor
+        if self._buffer is not None:
+            if axis == 0:
+                return Tensor(self._buffer._data)
+            import jax.numpy as jnp
+            return Tensor(jnp.moveaxis(self._buffer._data, 0, axis))
+        from . import manipulation as mp
+        return mp.stack(self._items, axis)
+
+    def concat(self, axis=0):
+        if self._buffer is not None:
+            import jax.numpy as jnp
+
+            from ..core.tensor import Tensor
+            return Tensor(jnp.concatenate(
+                list(self._buffer._data), axis=axis))
+        from . import manipulation as mp
+        return mp.concat(self._items, axis)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """Reference python/paddle/tensor/array.py:263 create_array."""
+    return TensorArray(dtype=dtype, initialized_list=initialized_list)
+
+
+def array_length(array):
+    """Reference array.py:27 array_length."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    return Tensor(np.int64(len(array)))
+
+
+def array_read(array, i):
+    """Reference array.py:86 array_read."""
+    return array.read(i)
+
+
+def array_write(x, i, array=None):
+    """Reference array.py:164 array_write: returns the array (created on
+    demand when `array` is None)."""
+    if array is None:
+        from ..core import dtype as dtypes
+        array = TensorArray(dtype=dtypes.dtype_from_any(x.dtype))
+    array.write(i, x)
+    return array
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    """Reference manipulation.py:46: fuse the array into one tensor;
+    returns (tensor, index) where index holds the per-item sizes along
+    `axis` (stack mode: all ones)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    n = len(input)
+    if use_stack:
+        out = input.stack(axis=axis)
+        sizes = np.ones((n,), np.int32)
+    else:
+        out = input.concat(axis=axis)
+        if getattr(input, "_buffer", None) is not None:
+            sizes = np.full((n,), input._buffer.shape[1 + axis]
+                            if axis >= 0 else
+                            input._buffer.shape[axis], np.int32)
+        else:
+            sizes = np.asarray([t.shape[axis] for t in input._items],
+                               np.int32)
+    return out, Tensor(sizes)

@@ -480,3 +480,85 @@ def test_registry_volume_and_manual_retirement():
             if s.get("manual") and s["category"] != "shaped"
             and not s.get("np_ref")]
     assert not bare, f"presence-marker entries remain: {bare}"
+
+# ---- TensorArray (reference python/paddle/tensor/array.py) ----------------
+
+def test_tensor_array_eager_roundtrip():
+    arr = paddle.create_array("float32")
+    x0 = paddle.to_tensor(np.ones((2, 3), np.float32))
+    x1 = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
+    arr = paddle.array_write(x0, 0, arr)
+    paddle.array_write(x1, 1, arr)
+    assert int(paddle.array_length(arr).numpy()) == 2
+    np.testing.assert_array_equal(np.asarray(paddle.array_read(arr, 1).numpy()),
+                                  np.full((2, 3), 2.0))
+    # write past the end appends (reference dygraph array_write semantics)
+    paddle.array_write(x0, 4, arr)
+    assert len(arr) == 3
+    out, idx = paddle.tensor_array_to_tensor(arr, axis=0, use_stack=True)
+    assert tuple(out.shape) == (3, 2, 3)
+    assert len(np.asarray(idx.numpy())) == 3
+    out2, idx2 = paddle.tensor_array_to_tensor(arr, axis=0, use_stack=False)
+    assert tuple(out2.shape) == (6, 3)
+    np.testing.assert_array_equal(np.asarray(idx2.numpy()), [2, 2, 2])
+
+
+def test_tensor_array_static_buffer_traced_indices():
+    """The static-size TensorArray works with TRACED indices inside one
+    compiled loop (the XLA-native realization of the reference's growable
+    array: a pre-allocated buffer + dynamic_update_slice)."""
+    from paddle_tpu.ops.tensor_array import TensorArray
+
+    arr = TensorArray(size=4, elem_shape=(3,), dtype="float32")
+
+    @paddle.jit.to_static
+    def fill(start):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.autograd.function import apply
+
+        def f(buf, s):
+            def body(i, b):
+                val = jnp.full((1, 3), i, jnp.float32)
+                return jax.lax.dynamic_update_slice(
+                    b, val, (i, jnp.zeros((), i.dtype)))
+            return jax.lax.fori_loop(jnp.int32(0), jnp.int32(4), body, buf)
+
+        return apply(f, arr._buffer, start, name="fill")
+
+    out = fill(paddle.to_tensor(np.int32(0)))
+    np.testing.assert_array_equal(
+        np.asarray(out.numpy()),
+        np.repeat(np.arange(4, dtype=np.float32)[:, None], 3, 1))
+
+    # write/read with python ints on the static buffer
+    arr.write(2, paddle.to_tensor(np.full((3,), 9.0, np.float32)))
+    np.testing.assert_array_equal(np.asarray(arr.read(2).numpy()),
+                                  np.full((3,), 9.0))
+    assert tuple(arr.stack().shape) == (4, 3)
+
+
+def test_tensor_array_write_survives_to_static():
+    """Regression: TensorArray.write with a traced index inside a compiled
+    function must mutate the tracked buffer in place — rebinding the
+    attribute would leak a tracer and corrupt the array for later eager
+    use."""
+    from paddle_tpu.ops.tensor_array import TensorArray
+
+    ta = TensorArray(size=3, elem_shape=(2,), dtype="float32")
+
+    @paddle.jit.to_static
+    def put(i, v):
+        ta.write(i, v)
+        return ta.read(i)
+
+    i0 = paddle.to_tensor(np.int32(1))
+    v0 = paddle.to_tensor(np.array([5.0, 6.0], np.float32))
+    put(i0, v0)            # discovery
+    got = put(i0, v0)      # compiled
+    np.testing.assert_array_equal(np.asarray(got.numpy()), [5.0, 6.0])
+    # eager use afterwards works (no leaked tracer in the buffer)
+    np.testing.assert_array_equal(np.asarray(ta.read(1).numpy()),
+                                  [5.0, 6.0])
+    ta.write(0, paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+    assert tuple(ta.stack().shape) == (3, 2)

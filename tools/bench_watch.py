@@ -54,8 +54,9 @@ def probe():
 def run_bench():
     """Run the full bench suite; return parsed JSON dict or None."""
     try:
+        env = dict(os.environ, BENCH_ASSUME_TPU="1")  # we just probed
         out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                             capture_output=True, text=True,
+                             capture_output=True, text=True, env=env,
                              timeout=BENCH_TIMEOUT, cwd=REPO)
         for line in reversed(out.stdout.strip().splitlines()):
             line = line.strip()
