@@ -108,19 +108,23 @@ _static_mode = False
 
 
 def enable_static():
-    """Reference paddle.enable_static. Under this framework the traced
-    jaxpr IS the static program (paddle.static docstring), so the flag
-    only flips what in_dynamic_mode()/in_dygraph_mode() report — code
-    gated on it (e.g. dynamic_decode's imperative-vs-declarative split in
-    the reference) takes its static branch, and graph capture still goes
-    through jit.to_static."""
+    """Reference paddle.enable_static: flips in_dynamic_mode() AND makes
+    the default main program record — `static.data`/ops called outside any
+    `program_guard` trace into `static.default_main_program()` (see
+    static/program.py for the jaxpr-trace Program design)."""
     global _static_mode
+    if not _static_mode:
+        from ..static import reset_default_programs
+        reset_default_programs()
     _static_mode = True
 
 
 def disable_static():
     """Reference paddle.disable_static (the default mode here)."""
     global _static_mode
+    if _static_mode:
+        from ..static import default_main_program
+        default_main_program()._deactivate()
     _static_mode = False
 
 

@@ -26,6 +26,7 @@ class ParamAttr:
 def create_parameter(shape, dtype=None, name=None, attr=None,
                      is_bias=False, default_initializer=None) -> Parameter:
     from ..nn import initializer as init
+    from ..static.program import on_parameter_created, suspend_trace
     dt = dtypes.dtype_from_any(dtype)
     if isinstance(attr, ParamAttr):
         initializer = attr.initializer
@@ -36,9 +37,14 @@ def create_parameter(shape, dtype=None, name=None, attr=None,
     if initializer is None:
         initializer = default_initializer or (
             init.Constant(0.0) if is_bias else init.XavierNormal())
-    data = initializer(tuple(int(s) for s in shape), dt)
-    p = Parameter(data, trainable=trainable, name=name)
+    # initializers run eagerly even inside a static program_guard (the
+    # reference records them into the STARTUP program and materializes at
+    # exe.run(startup); we materialize now and snapshot for startup replay)
+    with suspend_trace():
+        data = initializer(tuple(int(s) for s in shape), dt)
+        p = Parameter(data, trainable=trainable, name=name)
     if isinstance(attr, ParamAttr):
         p.optimize_attr["learning_rate"] = attr.learning_rate
         p.regularizer = attr.regularizer
+    on_parameter_created(p)
     return p

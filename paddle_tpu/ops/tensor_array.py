@@ -88,7 +88,8 @@ class TensorArray:
             else:
                 out = apply(lambda b, v: upd(b, v, int(i)),
                             self._buffer, x, name="array_write")
-            self._buffer._d = out._d
+            self._buffer._data = out._d   # the tracked setter: a static
+            #        Program's _StateTracker must see this buffer mutation
             return self
         idx = int(i)
         if idx < len(self._items):

@@ -27,7 +27,13 @@ class Optimizer:
                  grad_clip=None, name=None, multi_precision=False):
         from .lr import LRScheduler
         if parameters is None:
-            raise ValueError("parameters must be provided (dygraph mode)")
+            # allowed while a static Program is recording: minimize() adopts
+            # the program's trainable parameters (reference static mode pulls
+            # them from the Program the same way)
+            from ..static.program import current_main_program
+            if current_main_program() is None:
+                raise ValueError("parameters must be provided (dygraph mode)")
+            parameters = []
         self._parameter_list = list(parameters)
         # support param groups: [{'params': [...], 'learning_rate': ...}, ...]
         self._param_groups = None
@@ -43,7 +49,16 @@ class Optimizer:
             lr0 = float(learning_rate())
         else:
             lr0 = float(learning_rate)
-        self._lr_tensor = Tensor(jnp.asarray(lr0, jnp.float32))
+        # eager scalars even inside a static program_guard: an ambient
+        # Program trace would otherwise turn these into foreign tracers
+        # poisoning the later compiled step (reference static mode keeps
+        # optimizer scalars in the global scope the same way)
+        from ..static.program import suspend_trace
+        with suspend_trace():
+            self._lr_tensor = Tensor(jnp.asarray(lr0, jnp.float32))
+            # device-side step counter so bias correction is data, not a
+            # baked constant, inside a jitted train step
+            self._step_tensor = Tensor(jnp.zeros((), jnp.float32))
         if self._lr_scheduler is not None:
             self._lr_scheduler.bind(self)
         # a bare float weight_decay means coupled L2 decay (reference
@@ -64,9 +79,6 @@ class Optimizer:
         self._accumulators: dict[str, dict[int, Tensor]] = defaultdict(dict)
         self._master_weights: dict[int, Tensor] = {}
         self._step_count = 0
-        # device-side step counter so bias correction is data, not a baked
-        # constant, inside a jitted train step
-        self._step_tensor = Tensor(jnp.zeros((), jnp.float32))
 
     # -- lr -----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -132,6 +144,20 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.program import maybe_record_minimize
+        if maybe_record_minimize(self, loss):
+            # static-graph mode: the backward + update ops are generated at
+            # Executor compile time (jax.value_and_grad over the replayed
+            # program), not appended here
+            return None, []
+        if not self._parameter_list:
+            # parameters=None was allowed because a Program was recording,
+            # but this loss is not traced into it — stepping nothing would
+            # be a silent no-op
+            raise ValueError(
+                "minimize() on a non-traced loss with an empty parameter "
+                "list: pass parameters= to the optimizer (dygraph mode), or "
+                "compute the loss inside the active static Program")
         loss.backward()
         self.step()
         return None, [(p, p._grad) for p in self._parameter_list]

@@ -1,16 +1,14 @@
-"""paddle.static shim (reference: python/paddle/static/ — Program,
-Executor, data, InputSpec and the graph-mode training path).
+"""paddle.static: the static-graph surface (reference: python/paddle/static/
+— Program, program_guard, data, Executor, InputSpec, save/load_inference_model
+and the graph-mode training path).
 
 TPU position (SURVEY.md L4): the jaxpr/StableHLO produced by tracing IS the
-static program, so graph capture goes through `paddle.jit.to_static` and the
-auto-parallel `Engine`; this module keeps the reference's *surface* for code
-that imports paddle.static, mapping each name onto the traced-program world:
-
-- InputSpec           -> jit.InputSpec (shape/dtype declaration, -1 dynamic)
-- default_main_program/Program -> a no-op Program handle whose str() is the
-  most recent exported StableHLO (inspection parity)
-- Executor.run        -> executes a to_static-compiled callable
-- save/load_inference_model -> jit.save / jit.load
+static program. `program.py` implements real Program recording — a
+`program_guard` installs a long-lived jaxpr trace as the ambient JAX trace,
+`static.data` declares its inputs, `optimizer.minimize` records graph-mode
+training, and `Executor.run(program, feed, fetch_list)` closes + compiles the
+trace with XLA. `paddle.jit.to_static` remains the dygraph-first capture
+path; both produce the same compiled artifact.
 """
 
 from __future__ import annotations
@@ -19,68 +17,36 @@ from ..jit.save_load import InputSpec  # noqa: F401
 from ..jit.save_load import load as _jit_load
 from ..jit.save_load import save as _jit_save
 from . import nn  # noqa: F401  (control flow: cond/while_loop/case/switch_case)
+from .program import Executor  # noqa: F401
+from .program import Program  # noqa: F401
+from .program import data  # noqa: F401
+from .program import program_guard  # noqa: F401
 
-__all__ = ["InputSpec", "Program", "default_main_program",
-           "default_startup_program", "Executor", "save_inference_model",
-           "load_inference_model", "name_scope", "nn"]
-
-
-class Program:
-    """Handle object; real program text comes from exported functions."""
-
-    def __init__(self, text=""):
-        self._text = text
-
-    def __str__(self):
-        return self._text or "<traced program: see jit.save .pdmodel.txt>"
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return Program(self._text)
+__all__ = ["InputSpec", "Program", "program_guard", "data",
+           "default_main_program", "default_startup_program", "Executor",
+           "save_inference_model", "load_inference_model", "name_scope",
+           "nn"]
 
 
 _MAIN = Program()
 _STARTUP = Program()
+_STARTUP._paired_main = _MAIN
 
 
-def default_main_program():
+def default_main_program() -> Program:
     return _MAIN
 
 
-def default_startup_program():
+def default_startup_program() -> Program:
     return _STARTUP
 
 
-class Executor:
-    """Reference static.Executor: run(program, feed, fetch_list). Here a
-    'program' is any compiled callable (to_static fn or TranslatedLayer);
-    feed maps argument names positionally."""
-
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None, **kw):
-        if not callable(program):
-            raise TypeError(
-                "static.Executor.run expects a compiled callable (a "
-                "jit.to_static function or loaded TranslatedLayer); the "
-                "op-by-op Program executor is subsumed by XLA")
-        feed = feed or {}
-        names = getattr(program, "_feed_names", None)
-        if names:
-            missing = [n for n in names if n not in feed]
-            if missing:
-                raise KeyError(f"feed missing inputs {missing}; "
-                               f"expected {names}")
-            args = [feed[n] for n in names]
-        else:
-            args = list(feed.values())  # no recorded names: caller order
-        outs = program(*args)
-        if isinstance(outs, (list, tuple)):
-            return [o.numpy() for o in outs]
-        return [outs.numpy()]
+def reset_default_programs():
+    """Fresh default programs (paddle.enable_static() starts clean)."""
+    global _MAIN, _STARTUP
+    _MAIN._deactivate()
+    _MAIN, _STARTUP = Program(), Program()
+    _STARTUP._paired_main = _MAIN
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,

@@ -214,3 +214,79 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         out_fn = (lambda kk, ff, nxt: lambda: cond(
             Tensor(idx_arr == jnp.int32(kk)), ff, nxt))(k, f, out_fn)
     return out_fn()
+
+
+# -- layer-builder functions (reference python/paddle/static/nn/common.py:
+# fc :29, embedding, conv2d, batch_norm — each appends ops + creates params
+# in the active Program; here they build the corresponding nn.Layer under
+# a suspended trace (init math stays concrete), whose
+# parameters snapshot onto the startup program, and apply it) ---------------
+
+from ..program import suspend_trace
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Reference static.nn.fc: flatten trailing dims, Linear, optional
+    activation."""
+    from ... import nn as pnn
+    from ...nn import functional as F
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= int(s)
+    if len(x.shape) > num_flatten_dims + 1:
+        x = x.reshape(list(x.shape[:num_flatten_dims]) + [in_features])
+    with suspend_trace():
+        layer = pnn.Linear(in_features, size, weight_attr=weight_attr,
+                           bias_attr=bias_attr)
+    out = layer(x)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    from ... import nn as pnn
+    with suspend_trace():
+        layer = pnn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                              weight_attr=param_attr)
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW"):
+    from ... import nn as pnn
+    from ...nn import functional as F
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    with suspend_trace():
+        layer = pnn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                           padding=padding, dilation=dilation, groups=groups,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False):
+    from ... import nn as pnn
+    from ...nn import functional as F
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    with suspend_trace():
+        layer = pnn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                                weight_attr=param_attr, bias_attr=bias_attr,
+                                data_format=data_layout)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+__all__ += ["fc", "embedding", "conv2d", "batch_norm"]

@@ -1,0 +1,231 @@
+"""Real static-graph Program tests (reference: python/paddle/static/ —
+Program/program_guard/data/Executor.run and graph-mode minimize; the
+reference exercises this surface throughout test/legacy_test, e.g.
+test_executor_and_use_program_cache, test_program.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+@pytest.fixture(autouse=True)
+def _dygraph_after():
+    yield
+    paddle.disable_static()
+
+
+def test_program_guard_fetch_forward():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3], "float32")
+        y = (x * 2.0 + 1.0).sum(axis=1)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, (xv * 2 + 1).sum(1), rtol=1e-6)
+
+
+def test_fetch_subsets_and_multiple_closes():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 2], "float32")
+        a = x + 1.0
+        b = a * 3.0
+    exe = static.Executor()
+    xv = np.ones((2, 2), np.float32)
+    (av,) = exe.run(main, feed={"x": xv}, fetch_list=[a])
+    np.testing.assert_allclose(av, xv + 1)
+    av2, bv = exe.run(main, feed={"x": xv}, fetch_list=[a, b])
+    np.testing.assert_allclose(bv, (xv + 1) * 3)
+    np.testing.assert_allclose(av2, av)
+
+
+def test_feed_pruning_only_requires_needed_inputs():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2], "float32")
+        z = static.data("unused", [5], "float32")
+        y = x * 4.0
+    exe = static.Executor()
+    (out,) = exe.run(main, feed={"x": np.ones(2, np.float32)},
+                     fetch_list=[y])
+    np.testing.assert_allclose(out, [4, 4])
+    with pytest.raises(KeyError):
+        exe.run(main, feed={"unused": np.ones(5, np.float32)},
+                fetch_list=[y])
+
+
+def test_dynamic_dims_rejected():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        with pytest.raises(ValueError, match="dynamic dims"):
+            static.data("x", [None, 3], "float32")
+
+
+def test_linear_regression_minimize_trains():
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 3)).astype(np.float32)
+    true_w = np.array([[1.5], [-2.0], [0.5]], np.float32)
+    yv = xv @ true_w + 0.3
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [16, 3], "float32")
+        y = static.data("y", [16, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = ((pred - y) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.05 * losses[0], losses[::20]
+    # rerunning startup restores the initialization -> loss jumps back up
+    exe.run(startup)
+    (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    assert float(lv) > losses[-1] * 2
+
+
+def test_adam_minimize_and_param_visibility():
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((8, 4)).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) > 0).astype(np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 4], "float32")
+        y = static.data("y", [8, 1], "float32")
+        h = static.nn.fc(x, 8, activation="relu")
+        logits = static.nn.fc(h, 1)
+        loss = nn.functional.binary_cross_entropy_with_logits(logits, y)
+        opt = paddle.optimizer.Adam(learning_rate=0.05)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    first = None
+    for _ in range(40):
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        first = first if first is not None else float(lv)
+    assert float(lv) < first
+    # the trained parameter values are visible on the live Parameters
+    for p in main._params:
+        assert not np.allclose(np.asarray(p.numpy()), 0) or p.ndim == 1
+
+
+def test_enable_static_default_program_flow():
+    paddle.enable_static()
+    assert not paddle.in_dynamic_mode()
+    x = static.data("x", [3], "float32")
+    y = x * x
+    exe = static.Executor()
+    (out,) = exe.run(static.default_main_program(),
+                     feed={"x": np.array([1, 2, 3], np.float32)},
+                     fetch_list=[y])
+    np.testing.assert_allclose(out, [1, 4, 9])
+    paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+    # dygraph still works after the static session
+    t = paddle.to_tensor(np.ones(2, np.float32))
+    np.testing.assert_allclose((t + t).numpy(), [2, 2])
+
+
+def test_eval_clone_for_test():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 2], "float32")
+        out = static.nn.fc(x, 2)
+        loss = out.mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 2), np.float32)
+    (before,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])  # one train step
+    (after,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    assert not np.allclose(before, after)  # eval sees the update
+
+
+def test_fetch_by_name_and_program_str():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("inp", [2], "float32")
+        _ = x + 1
+    exe = static.Executor()
+    (out,) = exe.run(main, feed={"inp": np.zeros(2, np.float32)},
+                     fetch_list=["inp"])
+    np.testing.assert_allclose(out, [0, 0])
+    text = str(main)
+    assert "let" in text and "add" in text  # renders the jaxpr program text
+
+
+def test_batch_norm_state_threads_across_runs():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 3, 4, 4], "float32")
+        out = static.nn.batch_norm(x)
+        s = out.sum()
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(2)
+    xv = (3.0 + 2.0 * rng.standard_normal((8, 3, 4, 4))).astype(np.float32)
+    exe.run(main, feed={"x": xv}, fetch_list=[s])
+    exe.run(main, feed={"x": xv}, fetch_list=[s])
+    # the moving mean moved toward the batch mean (3.0) across runs
+    shadows = [t for t in main._state_shadow.values()
+               if t._d.shape == (3,)]
+    assert shadows, "expected threaded BN running stats"
+    vals = [float(np.asarray(t.numpy()).mean()) for t in shadows]
+    assert any(v > 0.3 for v in vals), vals
+
+
+def test_bare_run_of_main_does_not_reset_params():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 2], "float32")
+        out = static.nn.fc(x, 1)
+        loss = out.mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 2), np.float32)
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    trained = [np.asarray(p.numpy()).copy() for p in main._params]
+    with pytest.raises(KeyError):
+        exe.run(main)  # missing feeds must error, NOT replay startup
+    for p, t in zip(main._params, trained):
+        np.testing.assert_array_equal(np.asarray(p.numpy()), t)
+
+
+def test_startup_rerun_resets_adam_step_counter():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 2], "float32")
+        loss = static.nn.fc(x, 1).mean()
+        opt = paddle.optimizer.Adam(learning_rate=0.01)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 2), np.float32)
+    for _ in range(5):
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    assert float(opt._step_tensor._d) >= 5.0
+    exe.run(startup)
+    assert float(opt._step_tensor._d) == 0.0  # bias correction restarts
+
+
+def test_dygraph_minimize_empty_params_raises():
+    paddle.enable_static()
+    opt = paddle.optimizer.SGD(learning_rate=0.1)  # legal while recording
+    paddle.disable_static()
+    t = paddle.to_tensor(np.ones(2, np.float32))
+    with pytest.raises(ValueError, match="empty parameter list"):
+        opt.minimize((t * t).sum())
