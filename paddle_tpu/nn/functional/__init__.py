@@ -579,3 +579,9 @@ def gather_tree(ids, parents, name=None) -> Tensor:
         return outs
 
     return apply(f, ids, parents, name="gather_tree")
+
+
+# flash attention module surface (reference functional/__init__.py:83
+# imports from .flash_attention; flash_attention/flash_attn_unpadded are
+# used via the module path paddle.nn.functional.flash_attention.*)
+from . import flash_attention  # noqa: F401,E402

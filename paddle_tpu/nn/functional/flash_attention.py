@@ -1,0 +1,112 @@
+"""paddle.nn.functional.flash_attention surface.
+
+Reference: python/paddle/nn/functional/flash_attention.py:142
+(`flash_attention`), :301 (`flash_attn_unpadded` — packed varlen batches
+addressed by cumulative sequence offsets), both dispatching to the FA2 CUDA
+kernels (paddle/phi/kernels/gpu/flash_attn_kernel.cu). Here both map onto
+the Pallas TPU flash kernels; the varlen path converts `cu_seqlens` into
+per-token segment ids and uses the kernels' segment masking (packed
+sequences attend only within their own segment).
+
+Deviation from the reference, made loud: attention-probability dropout is
+NOT supported — the TPU kernels never materialize the probability matrix,
+so `dropout > 0` with `training=True` raises instead of silently changing
+semantics (the reference drops individual attention links in-kernel).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...autograd.function import apply
+from ...ops.kernels import flash_attention as fa
+
+__all__ = ["flash_attention", "flash_attn_unpadded"]
+
+
+def _reject_unsupported(dropout, training, return_softmax):
+    if return_softmax:
+        raise ValueError("return_softmax is not supported by the TPU flash "
+                         "attention kernel (the probability matrix is never "
+                         "materialized)")
+    if dropout and training:
+        raise NotImplementedError(
+            "attention-probability dropout is not supported by the TPU "
+            "flash attention kernel (it never materializes the matrix the "
+            "reference kernel drops from); train with dropout=0.0, or apply "
+            "nn.functional.dropout to the attention OUTPUT explicitly if "
+            "that regularization is acceptable")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, *, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """[B, S, H, D] -> (out, None)."""
+    _reject_unsupported(dropout, training, return_softmax)
+    out = apply(lambda q, k, v: fa.flash_attention(q, k, v, causal=causal),
+                query, key, value, name="flash_attention")
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Packed varlen attention: `query/key/value` are [total_tokens, H, D]
+    with `cu_seqlens_q/k` [n_seqs+1] cumulative offsets (reference
+    flash_attn_unpadded). Sequences attend only within themselves; `causal`
+    applies inside each sequence.
+
+    TPU mapping: offsets -> per-token segment ids (searchsorted), then ONE
+    kernel launch over the packed [1, total, H, D] layout with segment
+    masking — no unpack/pad round-trip. The stream is zero-padded up to the
+    kernel's block multiple under a dedicated padding segment (sliced away
+    after), so any total length stays on the kernel path instead of
+    falling back to the O(S^2) composite.
+    """
+    _reject_unsupported(dropout, training, return_softmax)
+    cu_q_host = np.asarray(
+        cu_seqlens_q.numpy() if hasattr(cu_seqlens_q, "numpy")
+        else cu_seqlens_q)
+    cu_k_host = np.asarray(
+        cu_seqlens_k.numpy() if hasattr(cu_seqlens_k, "numpy")
+        else cu_seqlens_k)
+    if cu_q_host.shape != cu_k_host.shape or \
+            not np.array_equal(cu_q_host, cu_k_host):
+        raise NotImplementedError(
+            "flash_attn_unpadded on TPU supports self-attention packing "
+            f"(cu_seqlens_q == cu_seqlens_k); got q offsets "
+            f"{cu_q_host.tolist()} vs k offsets {cu_k_host.tolist()} — "
+            "differing q/k splits would need two-sided segment masking")
+
+    def run(q, k, v, cu_q):
+        total = q.shape[0]
+        if k.shape[0] != total:
+            raise ValueError(
+                f"flash_attn_unpadded packs q and kv to the same token "
+                f"stream; got {total} vs {k.shape[0]} tokens")
+        if scale is not None:
+            # the kernel applies 1/sqrt(d); fold any custom scale into q
+            q = q * jnp.asarray(scale * (q.shape[-1] ** 0.5), q.dtype)
+        seg = jnp.searchsorted(jnp.asarray(cu_q)[1:-1], jnp.arange(total),
+                               side="right").astype(jnp.int32)
+        # kernel constraint: seq % min(256, seq) == 0 — any length <= 256
+        # passes as-is; longer streams pad to the 256 block multiple
+        pad = (-total) % 256 if total > 256 else 0
+        if pad:
+            n_seq = int(cu_q_host.shape[0]) - 1
+            seg = jnp.concatenate(
+                [seg, jnp.full((pad,), n_seq + 1, jnp.int32)])
+            zeros = jnp.zeros((pad,) + q.shape[1:], q.dtype)
+            q = jnp.concatenate([q, zeros])
+            k = jnp.concatenate([k, zeros])
+            v = jnp.concatenate([v, zeros])
+        out = fa.flash_attention(q[None], k[None], v[None], causal=causal,
+                                 segment_ids=seg[None])
+        return out[0, :total]
+
+    out = apply(run, query, key, value, cu_seqlens_q,
+                name="flash_attn_unpadded")
+    return out, None

@@ -38,17 +38,30 @@ def expand_kv_heads(q, k, v):
     return k, v
 
 
-def _reference_attention(q, k, v, causal):
+def _reference_attention(q, k, v, causal, segment_ids=None):
     k, v = expand_kv_heads(q, k, v)
     qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
     logits = logits.astype(jnp.float32)
+    mask = None
     if causal:
         s, t = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((s, t), bool), t - s)
-        logits = jnp.where(mask, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        mask = jnp.tril(jnp.ones((s, t), bool), t - s)[None, None]
+    if segment_ids is not None:
+        same = (segment_ids[:, :, None] == segment_ids[:, None, :])[:, None]
+        mask = same if mask is None else (mask & same)
+    if segment_ids is not None:
+        # finite mask value + explicit row zeroing: -inf would make softmax
+        # (and its grad) NaN on fully-masked padding rows
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(mask.any(-1, keepdims=True), probs, 0.0)
+        probs = probs.astype(q.dtype)
+    else:
+        if mask is not None:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
     return jnp.swapaxes(out, 1, 2)
 
@@ -117,10 +130,99 @@ _flash_full.defvjp(lambda q, k, v: _fwd_impl(q, k, v, False),
                    lambda res, g: _bwd_impl(False, res, g))
 
 
-def flash_attention(q, k, v, causal: bool = False):
+def _seg_float0(seg):
+    import numpy as np
+    return np.zeros(seg.shape, jax.dtypes.float0)
+
+
+_WARNED_FALLBACK: set = set()
+
+
+def _warn_fallback(where, exc):
+    """The composite fallback is O(S^2) memory — never take it silently
+    (review finding: a varlen batch quietly falling off the kernel path is
+    exactly the blowup packing exists to avoid)."""
+    if where not in _WARNED_FALLBACK:
+        _WARNED_FALLBACK.add(where)
+        import warnings
+        warnings.warn(
+            f"flash attention {where}: Pallas kernel unavailable "
+            f"({type(exc).__name__}: {exc}); falling back to the XLA "
+            f"composite, which materializes the [S, S] matrix",
+            RuntimeWarning, stacklevel=3)
+
+
+@jax.custom_vjp
+def _flash_seg_causal(q, k, v, seg):
+    return _flash_seg_impl(q, k, v, seg, True)
+
+
+@jax.custom_vjp
+def _flash_seg_full(q, k, v, seg):
+    return _flash_seg_impl(q, k, v, seg, False)
+
+
+def _flash_seg_impl(q, k, v, seg, causal):
+    if _pallas_ok(q):
+        try:
+            from .flash_attention_pallas import flash_attention_forward
+            return flash_attention_forward(q, k, v, causal=causal,
+                                           interpret=interpret_mode(),
+                                           segment_ids=seg)
+        except Exception as e:
+            _warn_fallback("segment forward", e)
+    return _reference_attention(q, k, v, causal, seg)
+
+
+def _seg_fwd_impl(q, k, v, seg, causal):
+    if _pallas_ok(q):
+        try:
+            from .flash_attention_pallas import flash_attention_forward_lse
+            out, lse = flash_attention_forward_lse(
+                q, k, v, causal=causal, interpret=interpret_mode(),
+                segment_ids=seg)
+            return out, (q, k, v, seg, out, lse)
+        except Exception as e:
+            _warn_fallback("segment forward (vjp)", e)
+    out = _reference_attention(q, k, v, causal, seg)
+    return out, (q, k, v, seg, None, None)
+
+
+def _seg_bwd_impl(causal, res, g):
+    q, k, v, seg, out, lse = res
+    if lse is not None:
+        try:
+            from .flash_attention_pallas import flash_attention_backward
+            dq, dk, dv = flash_attention_backward(
+                q, k, v, out, lse, g, causal=causal,
+                interpret=interpret_mode(), segment_ids=seg)
+            return dq, dk, dv, _seg_float0(seg)
+        except Exception as e:
+            _warn_fallback("segment backward", e)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _reference_attention(a, b, c, causal, seg), q, k, v)
+    return (*vjp(g), _seg_float0(seg))
+
+
+_flash_seg_causal.defvjp(lambda q, k, v, s: _seg_fwd_impl(q, k, v, s, True),
+                         lambda res, g: _seg_bwd_impl(True, res, g))
+_flash_seg_full.defvjp(lambda q, k, v, s: _seg_fwd_impl(q, k, v, s, False),
+                       lambda res, g: _seg_bwd_impl(False, res, g))
+
+
+def flash_attention(q, k, v, causal: bool = False, segment_ids=None):
     """[B, S, H, D] attention; fused Pallas forward+backward on TPU.
 
     k/v may carry fewer heads than q (GQA/MQA): the kernels read each shared
     kv head directly via the block index map instead of materializing the
-    repeat (reference GQA glue expands kv in HBM first)."""
+    repeat (reference GQA glue expands kv in HBM first).
+
+    `segment_ids` [B, S] int: tokens attend only within equal segment ids —
+    the packed-varlen masking of the reference's flash_attn_unpadded
+    (paddle/phi/kernels/gpu/flash_attn_kernel.cu varlen path), with causal
+    applied inside each segment when both are set."""
+    if segment_ids is not None:
+        seg = jnp.asarray(segment_ids, jnp.int32)
+        return (_flash_seg_causal(q, k, v, seg) if causal
+                else _flash_seg_full(q, k, v, seg))
     return _flash_causal(q, k, v) if causal else _flash_full(q, k, v)

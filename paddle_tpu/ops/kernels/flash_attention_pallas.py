@@ -21,14 +21,23 @@ NEG_INF = -1e30  # wrapped in jnp.float32 at use sites (x64 safety)
 LSE_LANES = 128  # lse/delta stored [.., S, 128]: Mosaic wants full-lane layouts
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, block_k,
-                 seq_len, scale, block_q):
-    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq, d]; o_ref: [1, block_q, d]
-    # maybe_lse_ref: ([1, block_q, LSE_LANES],) on the vjp path (logsumexp of
-    # the scaled logits, for backward); empty on the primal-only path
+def _attn_kernel(q_ref, k_ref, v_ref, *rest, causal, block_k,
+                 seq_len, scale, block_q, has_seg=False, with_lse=False):
+    # q_ref: [1, block_q, d]; k_ref/v_ref: [1, seq, d]
+    # rest (in order): [qseg_ref [1, block_q, LSE_LANES], kseg_ref [1, 8, seq]
+    # when has_seg], o_ref [1, block_q, d], [lse_ref [1, block_q, LSE_LANES]
+    # when with_lse]. Segment masking follows the public TPU flash-attention
+    # layout trick: q segments lane-broadcast, kv segments sublane-broadcast,
+    # so the [block_q, block_k] compare needs no relayout.
+    it = iter(rest)
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
+    o_ref = next(it)
+    lse_ref = next(it) if with_lse else None
     d = q_ref.shape[-1]
     q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)
     q_blk = pl.program_id(1)
+    qs = qseg_ref[0][:, :1] if has_seg else None   # [block_q, 1]
 
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
@@ -42,14 +51,26 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, block_k,
         v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        valid = None
         if causal:
             q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+            valid = q_pos >= k_pos
+        if has_seg:
+            ks = kseg_ref[0, :1, pl.ds(i * block_k, block_k)]  # [1, block_k]
+            same = qs == ks
+            valid = same if valid is None else (valid & same)
+        if valid is not None:
+            s = jnp.where(valid, s, jnp.float32(NEG_INF))
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if has_seg:
+            # a fully-masked row keeps m == NEG_INF, where exp(s - m) == 1
+            # for every masked entry — zero those explicitly so padding
+            # rows produce 0 output instead of mean(v)
+            p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
         acc_new = alpha * acc + jax.lax.dot_general(
@@ -68,9 +89,9 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal, block_k,
 
     l_safe = jnp.maximum(l, jnp.float32(1e-30))
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    if maybe_lse_ref:
-        maybe_lse_ref[0][0] = jnp.broadcast_to(m + jnp.log(l_safe),
-                                               (block_q, LSE_LANES))
+    if with_lse:
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe),
+                                      (block_q, LSE_LANES))
 
 
 def _kv_index_map(h, h_kv):
@@ -86,12 +107,20 @@ def _kv_index_map(h, h_kv):
     return imap
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret"))
-def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
-                                block_k=256, interpret=False):
-    """Returns (out [B,S,H,D], lse [B*H, S] float32). k/v may carry fewer
-    heads than q (GQA): heads must divide evenly."""
+SEG_SUBLANES = 8  # kv segments sublane-broadcast [B, 8, S] (Mosaic tiling)
+
+
+def _seg_operands(segment_ids, b, s, h):
+    """(lane-broadcast q segs [B,S,LSE_LANES], sublane-broadcast kv segs
+    [B,8,S], extra in_specs) — index maps select the grid row's batch."""
+    seg = segment_ids.astype(jnp.int32)
+    seg_q = jnp.broadcast_to(seg[:, :, None], (b, s, LSE_LANES))
+    seg_kv = jnp.broadcast_to(seg[:, None, :], (b, SEG_SUBLANES, s))
+    return seg_q, seg_kv
+
+
+def _fwd_common(q, k, v, segment_ids, causal, block_q, block_k, interpret,
+                with_lse):
     b, s, h, d = q.shape
     h_kv = k.shape[2]
     if h % h_kv:
@@ -101,6 +130,7 @@ def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
     if s % block_q or s % block_k:
         raise ValueError(f"seq {s} must divide block sizes {block_q}/{block_k}")
     scale = 1.0 / math.sqrt(d)
+    has_seg = segment_ids is not None
 
     # [B,S,H,D] -> [B*H, S, D] for blocking along seq
     qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
@@ -108,78 +138,89 @@ def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
     vt = jnp.swapaxes(v, 1, 2).reshape(b * h_kv, s, d)
     kv_map = _kv_index_map(h, h_kv)
 
-    grid = (b * h, s // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
+        pl.BlockSpec((1, s, d), kv_map),
+        pl.BlockSpec((1, s, d), kv_map),
+    ]
+    operands = [qt, kt, vt]
+    if has_seg:
+        seg_q, seg_kv = _seg_operands(segment_ids, b, s, h)
+        in_specs += [
+            pl.BlockSpec((1, block_q, LSE_LANES),
+                         lambda bi, qi: (bi // h, qi, 0)),
+            pl.BlockSpec((1, SEG_SUBLANES, s), lambda bi, qi: (bi // h, 0, 0)),
+        ]
+        operands += [seg_q, seg_kv]
+
+    blk_o = pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0))
+    if with_lse:
+        out_specs = [blk_o, pl.BlockSpec((1, block_q, LSE_LANES),
+                                         lambda bi, qi: (bi, qi, 0))]
+        out_shape = [jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+                     jax.ShapeDtypeStruct((b * h, s, LSE_LANES), jnp.float32)]
+    else:
+        out_specs = blk_o
+        out_shape = jax.ShapeDtypeStruct((b * h, s, d), q.dtype)
+
     with jax.enable_x64(False):
-        out, lse = pl.pallas_call(
+        res = pl.pallas_call(
             functools.partial(_attn_kernel, causal=causal, block_k=block_k,
-                              seq_len=s, scale=scale, block_q=block_q),
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
-                pl.BlockSpec((1, s, d), kv_map),
-                pl.BlockSpec((1, s, d), kv_map),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
-                pl.BlockSpec((1, block_q, LSE_LANES), lambda bi, qi: (bi, qi, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-                jax.ShapeDtypeStruct((b * h, s, LSE_LANES), jnp.float32),
-            ],
+                              seq_len=s, scale=scale, block_q=block_q,
+                              has_seg=has_seg, with_lse=with_lse),
+            grid=(b * h, s // block_q),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
             interpret=interpret,
-        )(qt, kt, vt)
-    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2), lse[:, :, 0]
+        )(*operands)
+    if with_lse:
+        out, lse = res
+        return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2), lse[:, :, 0]
+    return jnp.swapaxes(res.reshape(b, h, s, d), 1, 2)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
+                                block_k=256, interpret=False,
+                                segment_ids=None):
+    """Returns (out [B,S,H,D], lse [B*H, S] float32). k/v may carry fewer
+    heads than q (GQA): heads must divide evenly. `segment_ids` [B, S]
+    restricts attention to equal segments (packed varlen batches,
+    reference flash_attn_unpadded)."""
+    return _fwd_common(q, k, v, segment_ids, causal, block_q, block_k,
+                       interpret, with_lse=True)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention_forward(q, k, v, causal=False, block_q=256, block_k=256,
-                            interpret=False):
-    """Primal-only forward: no logsumexp output (inference path). GQA
-    supported as in flash_attention_forward_lse."""
-    b, s, h, d = q.shape
-    h_kv = k.shape[2]
-    if h % h_kv:
-        raise ValueError(f"q heads {h} not a multiple of kv heads {h_kv}")
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        raise ValueError(f"seq {s} must divide block sizes {block_q}/{block_k}")
-    scale = 1.0 / math.sqrt(d)
-    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, s, d)
-    kt = jnp.swapaxes(k, 1, 2).reshape(b * h_kv, s, d)
-    vt = jnp.swapaxes(v, 1, 2).reshape(b * h_kv, s, d)
-    kv_map = _kv_index_map(h, h_kv)
-    with jax.enable_x64(False):
-        out = pl.pallas_call(
-            functools.partial(_attn_kernel, causal=causal, block_k=block_k,
-                              seq_len=s, scale=scale, block_q=block_q),
-            grid=(b * h, s // block_q),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
-                pl.BlockSpec((1, s, d), kv_map),
-                pl.BlockSpec((1, s, d), kv_map),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda bi, qi: (bi, qi, 0)),
-            out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            interpret=interpret,
-        )(qt, kt, vt)
-    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+                            interpret=False, segment_ids=None):
+    """Primal-only forward: no logsumexp output (inference path). GQA and
+    segment masking as in flash_attention_forward_lse."""
+    return _fwd_common(q, k, v, segment_ids, causal, block_q, block_k,
+                       interpret, with_lse=False)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               causal, block_q, block_k, seq_len, scale):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               causal, block_q, block_k, seq_len, scale, has_seg=False):
     """Grid (B*H, n_q): dQ for one q block, scanning k/v blocks.
 
     dS = P * (dO V^T - delta);  dQ = scale * dS K   with P = exp(S - lse).
+    rest = [qseg_ref [1,bq,LSE_LANES], kseg_ref [1,8,S] when has_seg], dq_ref.
     """
+    it = iter(rest)
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
+    dq_ref = next(it)
     d = q_ref.shape[-1]
     q_blk = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * jnp.float32(scale)  # pre-scaled q
     do = do_ref[0].astype(jnp.float32)                # [bq, d]
     lse = lse_ref[0][:, :1]                           # [bq, 1]
     delta = delta_ref[0][:, :1]                       # [bq, 1]
+    qs = qseg_ref[0][:, :1] if has_seg else None      # [bq, 1]
 
     n_k = seq_len // block_k
     acc = jnp.zeros((block_q, d), jnp.float32)
@@ -189,13 +230,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        valid = None
         if causal:
             q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+            valid = q_pos >= k_pos
+        if has_seg:
+            ks = kseg_ref[0, :1, pl.ds(i * block_k, block_k)]
+            same = qs == ks
+            valid = same if valid is None else (valid & same)
+        if valid is not None:
+            s = jnp.where(valid, s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse)                           # [bq, bk]
+        if has_seg:
+            # fully-masked rows have lse at the guard floor; exp(s - lse)
+            # there is garbage — zero masked entries explicitly
+            p = jnp.where(valid, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -212,16 +264,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, *, causal, block_q, block_k, seq_len, scale):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                causal, block_q, block_k, seq_len, scale, has_seg=False):
     """Grid (B*H, n_k): dK/dV for one k/v block, scanning q blocks.
 
     dV = P^T dO;  dK = scale * dS^T Q.
+    rest = [qseg_ref [1,S,LSE_LANES], kseg_ref [1,8,bk] when has_seg],
+    dk_ref, dv_ref.
     """
+    it = iter(rest)
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
+    dk_ref = next(it)
+    dv_ref = next(it)
     d = k_ref.shape[-1]
     k_blk = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)                  # [bk, d]
     v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+    ks = kseg_ref[0, :1, :] if has_seg else None      # [1, bk]
 
     n_q = seq_len // block_q
     dk = jnp.zeros((block_k, d), jnp.float32)
@@ -236,13 +296,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         delta = delta_ref[0, pl.ds(i * block_q, block_q), :][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        valid = None
         if causal:
             q_pos = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_blk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, jnp.float32(NEG_INF))
+            valid = q_pos >= k_pos
+        if has_seg:
+            qs = qseg_ref[0, pl.ds(i * block_q, block_q), :][:, :1]
+            same = qs == ks
+            valid = same if valid is None else (valid & same)
+        if valid is not None:
+            s = jnp.where(valid, s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse)                           # [bq, bk]
+        if has_seg:
+            p = jnp.where(valid, p, 0.0)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -267,7 +336,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
-                             block_k=256, interpret=False):
+                             block_k=256, interpret=False, segment_ids=None):
     """Fused FA2-style backward: (dq, dk, dv) — dq [B,S,H,D], dk/dv with the
     kv head count (GQA: gradients of shared kv heads are summed over their
     query group).
@@ -304,10 +373,29 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
     blk_q1 = pl.BlockSpec((1, block_q, LSE_LANES), lambda bi, qi: (bi, qi, 0))
     blk_k3 = pl.BlockSpec((1, block_k, d), lambda bi, ki: (bi, ki, 0))
 
+    has_seg = segment_ids is not None
+    dq_extra, dkv_extra = [], []
+    dq_specs, dkv_specs = [], []
+    if has_seg:
+        seg_q, seg_kv = _seg_operands(segment_ids, b, s, h)
+        dq_extra = [seg_q, seg_kv]
+        dq_specs = [
+            pl.BlockSpec((1, block_q, LSE_LANES),
+                         lambda bi, qi: (bi // h, qi, 0)),
+            pl.BlockSpec((1, SEG_SUBLANES, s), lambda bi, qi: (bi // h, 0, 0)),
+        ]
+        dkv_extra = [seg_q, seg_kv]
+        dkv_specs = [
+            pl.BlockSpec((1, s, LSE_LANES), lambda bi, ki: (bi // h, 0, 0)),
+            pl.BlockSpec((1, SEG_SUBLANES, block_k),
+                         lambda bi, ki: (bi // h, 0, ki)),
+        ]
+
     with jax.enable_x64(False):
         dq = pl.pallas_call(
             functools.partial(_dq_kernel, causal=causal, block_q=block_q,
-                              block_k=block_k, seq_len=s, scale=scale),
+                              block_k=block_k, seq_len=s, scale=scale,
+                              has_seg=has_seg),
             grid=(b * h, s // block_q),
             in_specs=[
                 blk_q3,                                    # q
@@ -316,18 +404,19 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
                 blk_q3,                                    # do
                 blk_q1,                                    # lse
                 blk_q1,                                    # delta
-            ],
+            ] + dq_specs,
             out_specs=blk_q3,
             out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
             interpret=interpret,
-        )(qt, kt, vt, dot, lse3, delta)
+        )(qt, kt, vt, dot, lse3, delta, *dq_extra)
 
     # dk/dv: per-q-head partials (kv blocks fetched through kv_map — no
     # materialized repeat), summed over each kv head's query group after
     with jax.enable_x64(False):
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_kernel, causal=causal, block_q=block_q,
-                              block_k=block_k, seq_len=s, scale=scale),
+                              block_k=block_k, seq_len=s, scale=scale,
+                              has_seg=has_seg),
             grid=(b * h, s // block_k),
             in_specs=[
                 pl.BlockSpec((1, s, d), full),             # q
@@ -338,7 +427,7 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
                 pl.BlockSpec((1, s, d), full),             # do
                 pl.BlockSpec((1, s, LSE_LANES), full),     # lse
                 pl.BlockSpec((1, s, LSE_LANES), full),     # delta
-            ],
+            ] + dkv_specs,
             out_specs=[blk_k3, blk_k3],
             # GQA partials stay f32 until after the group sum — casting each
             # partial to bf16 first would add rounding the h_kv==h path
@@ -350,7 +439,7 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
                     (b * h, s, d), jnp.float32 if n_rep > 1 else v.dtype),
             ],
             interpret=interpret,
-        )(qt, kt, vt, dot, lse3, delta)
+        )(qt, kt, vt, dot, lse3, delta, *dkv_extra)
 
     dq_out = jnp.swapaxes(dq.reshape(b, h, s, d), 1, 2)
     # n_rep==1 reduces over a size-1 axis — same result, no special case

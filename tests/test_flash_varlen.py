@@ -1,0 +1,204 @@
+"""Segment-packed (varlen) flash attention tests.
+
+Reference: flash_attn_unpadded (python/paddle/nn/functional/
+flash_attention.py:301) — packed token streams addressed by cu_seqlens,
+FA2 varlen CUDA kernels. Here: the Pallas kernels' segment-id masking,
+exercised in interpret mode against the XLA composite.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.kernels import _common as kern
+from paddle_tpu.ops.kernels import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _interp():
+    kern.force_interpret(True)
+    yield
+    kern.force_interpret(False)
+
+
+def _rand(shape, seed=0, dtype=jnp.float32):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), dtype)
+
+
+def _segs(b, s, seed=3):
+    """Random segment layout incl. a padding tail (segment -1 never equals
+    any other row's id because ids are per-position equal-compare)."""
+    rng = np.random.default_rng(seed)
+    seg = np.zeros((b, s), np.int32)
+    for bi in range(b):
+        n_seq = rng.integers(2, 5)
+        cuts = np.sort(rng.choice(np.arange(8, s - 8), n_seq - 1,
+                                  replace=False))
+        seg[bi] = np.searchsorted(cuts, np.arange(s), side="right")
+    return jnp.asarray(seg)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_masking_matches_composite(causal):
+    b, s, h, d = 2, 128, 4, 32
+    q, k, v = _rand((b, s, h, d), 0), _rand((b, s, h, d), 1), \
+        _rand((b, s, h, d), 2)
+    seg = _segs(b, s)
+    out = fa.flash_attention(q, k, v, causal=causal, segment_ids=seg)
+    ref = fa._reference_attention(q, k, v, causal, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_segment_gqa_grads_match_composite():
+    b, s, h, h_kv, d = 2, 128, 4, 2, 32
+    q = _rand((b, s, h, d), 0)
+    k, v = _rand((b, s, h_kv, d), 1), _rand((b, s, h_kv, d), 2)
+    seg = _segs(b, s)
+    g = _rand((b, s, h, d), 4)
+
+    def loss(f):
+        def run(q, k, v):
+            return jnp.sum(f(q, k, v) * g)
+        return jax.grad(run, argnums=(0, 1, 2))(q, k, v)
+
+    dq, dk, dv = loss(lambda q, k, v: fa.flash_attention(
+        q, k, v, causal=True, segment_ids=seg))
+    rq, rk, rv = loss(lambda q, k, v: fa._reference_attention(
+        q, k, v, True, seg))
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), atol=1e-4,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=1e-4,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=1e-4,
+                               rtol=1e-4)
+
+
+def test_no_cross_segment_leakage():
+    """Perturbing tokens of one packed sequence must not change another's
+    outputs at all — the property varlen packing exists for."""
+    b, s, h, d = 1, 128, 2, 32
+    seg = jnp.asarray(
+        np.array([[0] * 64 + [1] * 64], np.int32))
+    q, k, v = _rand((b, s, h, d), 0), _rand((b, s, h, d), 1), \
+        _rand((b, s, h, d), 2)
+    out1 = fa.flash_attention(q, k, v, causal=True, segment_ids=seg)
+    k2 = k.at[0, 70:].set(7.7)   # poke only segment 1's keys
+    v2 = v.at[0, 70:].set(-3.3)
+    out2 = fa.flash_attention(q, k2, v2, causal=True, segment_ids=seg)
+    np.testing.assert_array_equal(np.asarray(out1[0, :64]),
+                                  np.asarray(out2[0, :64]))
+    assert not np.allclose(np.asarray(out1[0, 64:]),
+                           np.asarray(out2[0, 64:]))
+
+
+def test_flash_attn_unpadded_api():
+    """Reference flash_attn_unpadded signature over a packed stream equals
+    per-sequence full attention."""
+    import paddle_tpu.nn.functional.flash_attention as F_fa
+    lens = [48, 80]
+    total, h, d = sum(lens), 4, 32
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    q = _rand((total, h, d), 0)
+    k = _rand((total, h, d), 1)
+    v = _rand((total, h, d), 2)
+    out, _ = F_fa.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu),
+        max_seqlen_q=max(lens), max_seqlen_k=max(lens),
+        scale=1.0 / np.sqrt(d), causal=True)
+    out = jnp.asarray(out.numpy())
+    start = 0
+    for L in lens:
+        piece = fa._reference_attention(
+            q[None, start:start + L], k[None, start:start + L],
+            v[None, start:start + L], True)[0]
+        np.testing.assert_allclose(np.asarray(out[start:start + L]),
+                                   np.asarray(piece), atol=2e-5, rtol=2e-5)
+        start += L
+
+
+def test_padded_tail_rows_zero_output_and_grad():
+    """Tokens in a padding segment that only contains themselves still see
+    themselves (segment equality) — use a unique id per pad token to make
+    rows fully masked? No: a row always matches itself. Instead check a
+    CROSS-only case: causal=False with per-token unique segments reduces to
+    self-attention of single tokens (softmax over itself = v)."""
+    b, s, h, d = 1, 64, 2, 16
+    q, k, v = _rand((b, s, h, d), 0), _rand((b, s, h, d), 1), \
+        _rand((b, s, h, d), 2)
+    seg = jnp.arange(s, dtype=jnp.int32)[None]
+    out = fa.flash_attention(q, k, v, causal=False, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(v), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_tpu_lowering_segment_kernel():
+    """The segment variants must lower for the TPU target from CPU (the
+    round-3 lowering gate, extended to the new kernel signature)."""
+    kern.force_interpret(False)
+    kern.force_dispatch(True)
+    try:
+        b, s, h, d = 1, 256, 2, 64
+        q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+        seg = jnp.zeros((b, s), jnp.int32)
+
+        def f(q, seg):
+            return fa.flash_attention(q, q, q, causal=True, segment_ids=seg)
+
+        jax.jit(f).trace(q, seg).lower(lowering_platforms=("tpu",))
+
+        def g(q, seg):
+            return jax.grad(lambda a: jnp.sum(
+                fa.flash_attention(a, a, a, causal=True,
+                                   segment_ids=seg).astype(jnp.float32)))(q)
+
+        jax.jit(g).trace(q, seg).lower(lowering_platforms=("tpu",))
+    finally:
+        kern.force_dispatch(False)
+
+
+def test_flash_attn_unpadded_non_block_multiple():
+    """A packed total that doesn't divide the kernel block size stays on
+    the kernel path via the padding segment (review finding: it used to
+    fall back to the O(S^2) composite silently)."""
+    import paddle_tpu.nn.functional.flash_attention as F_fa
+    lens = [130, 170]  # total 300: above one block, not a 256 multiple
+    total, h, d = sum(lens), 2, 16
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    q, k, v = _rand((total, h, d), 0), _rand((total, h, d), 1), \
+        _rand((total, h, d), 2)
+    out, _ = F_fa.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), 170, 170, causal=True)
+    out = jnp.asarray(out.numpy())
+    assert out.shape == (total, h, d)
+    start = 0
+    for L in lens:
+        piece = fa._reference_attention(
+            q[None, start:start + L], k[None, start:start + L],
+            v[None, start:start + L], True)[0]
+        np.testing.assert_allclose(np.asarray(out[start:start + L]),
+                                   np.asarray(piece), atol=2e-5, rtol=2e-5)
+        start += L
+
+
+def test_flash_attn_unpadded_mismatched_cu_raises():
+    import paddle_tpu.nn.functional.flash_attention as F_fa
+    total, h, d = 128, 2, 16
+    q = paddle.to_tensor(_rand((total, h, d), 0))
+    cu_q = paddle.to_tensor(np.array([0, 64, 128], np.int32))
+    cu_k = paddle.to_tensor(np.array([0, 32, 128], np.int32))
+    with pytest.raises(NotImplementedError, match="cu_seqlens_q"):
+        F_fa.flash_attn_unpadded(q, q, q, cu_q, cu_k, 64, 96)
+
+
+def test_flash_dropout_rejected_loudly():
+    import paddle_tpu.nn.functional.flash_attention as F_fa
+    q = paddle.to_tensor(_rand((2, 64, 2, 16), 0))
+    with pytest.raises(NotImplementedError, match="dropout"):
+        F_fa.flash_attention(q, q, q, dropout=0.1, causal=True)
