@@ -378,6 +378,20 @@ def run_kernel_ab(dev):
     res["bias_dropout_ln_xla_ms"] = round(xla, 3)
     res["bias_dropout_ln_speedup"] = round(xla / pal, 3)
 
+    # weight-only int8 matmul at decode GEMV shape (m=8) and prefill shape:
+    # the decode case is weight-bandwidth-bound, where int8 HBM reads win
+    from paddle_tpu.ops.kernels import wo_matmul_pallas as wm
+    kk, nn_ = 4096, 11008
+    wq = jnp.asarray(rng.integers(-127, 127, (kk, nn_)), jnp.int8)
+    sc = jnp.asarray(rng.random(nn_) * 0.01, jnp.float32)
+    for label, mrows in (("decode", 8), ("prefill", 1024)):
+        xa = jnp.asarray(rng.standard_normal((mrows, kk)), jnp.bfloat16)
+        pal = timed(lambda a: wm.wo_int8_matmul(a, wq, sc), xa)
+        xla = timed(lambda a: wm.reference_wo_int8_matmul(a, wq, sc), xa)
+        res[f"wo_int8_{label}_pallas_ms"] = round(pal, 3)
+        res[f"wo_int8_{label}_xla_ms"] = round(xla, 3)
+        res[f"wo_int8_{label}_speedup"] = round(xla / pal, 3)
+
     # fused softmax-CE at a 50k vocab, fwd+bwd
     from paddle_tpu.ops.kernels import ce_pallas as cp
     nrows, vocab = 4096, 50304

@@ -11,12 +11,18 @@ from ...quantization.functional import (  # noqa: F401
     absmax_scale,
     dequant_matmul_int8,
     fake_quant,
-    quantize_weight_int8 as weight_quantize,
+    quantize_weight_int8,
+)
+from .quantized_linear import (  # noqa: F401
+    weight_dequantize,
+    weight_only_linear,
+    weight_quantize,
 )
 from ..layer import Layer
 
 __all__ = ['Stub', 'QuantStub', 'weight_quantize', 'fake_quant',
-           'absmax_scale', 'dequant_matmul_int8',
+           'weight_dequantize', 'weight_only_linear',
+           'absmax_scale', 'dequant_matmul_int8', 'quantize_weight_int8',
            'QuantedLinear', 'Int8WeightOnlyLinear',
            'FakeQuanterWithAbsMaxObserver', 'quant_layers']
 

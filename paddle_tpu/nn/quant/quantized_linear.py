@@ -1,0 +1,110 @@
+"""Weight-only quantized linear (reference:
+python/paddle/nn/quant/quantized_linear.py:25 `weight_quantize`, :70
+`weight_dequantize`, :116 `weight_only_linear` — CUDA weight-only GEMM).
+
+TPU mapping: int8 weights feed the fused Pallas weight-only matmul
+(ops/kernels/wo_matmul_pallas.py — in-core dequant, halved HBM weight
+traffic). int4 stores two nibbles per int8 byte (half the HBM footprint);
+the unpack runs as XLA ops in front of the same kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...autograd.function import apply, apply_multi
+from ...quantization.functional import dequant_matmul_int8, \
+    quantize_weight_int8
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear"]
+
+_ALGOS = ("weight_only_int8", "weight_only_int4")
+
+
+def _check_algo(algo):
+    if algo not in _ALGOS:
+        raise ValueError(f"algo must be one of {_ALGOS}, got {algo!r} "
+                         f"(llm.int8 needs activation stats; use the "
+                         f"quantization PTQ flow)")
+
+
+def _pack_int4(q):
+    """[K, N] int4 values in [-7, 7] -> [K, ceil(N/2)] bytes (two nibbles,
+    low nibble = even column)."""
+    n = q.shape[1]
+    if n % 2:
+        q = jnp.pad(q, ((0, 0), (0, 1)))
+    lo = q[:, 0::2].astype(jnp.int32) & 0xF
+    hi = q[:, 1::2].astype(jnp.int32) & 0xF
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def _unpack_int4(packed, n):
+    """Inverse of _pack_int4: [K, ceil(N/2)] bytes -> [K, N] int8 in
+    [-7, 7] (sign-extend each nibble)."""
+    b = packed.astype(jnp.int32)
+    lo = (b & 0xF).astype(jnp.int8)
+    hi = ((b >> 4) & 0xF).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo).astype(jnp.int8)
+    hi = jnp.where(hi > 7, hi - 16, hi).astype(jnp.int8)
+    out = jnp.stack([lo, hi], axis=2).reshape(packed.shape[0], -1)
+    return out[:, :n]
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """[K, N] float weight -> (quantized weight, per-N-channel scales).
+
+    int8: [K, N] int8. int4: [K, ceil(N/2)] int8 bytes holding two
+    4-bit values (reference packs the same way for its CUDA kernels)."""
+    _check_algo(algo)
+    if group_size not in (-1, None):
+        raise NotImplementedError("grouped scales are not supported yet; "
+                                  "use per-channel (group_size=-1)")
+
+    def run(w):
+        if algo == "weight_only_int8":
+            return quantize_weight_int8(w, axis=1)
+        bound = 7.0
+        s = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-9)
+        q = jnp.clip(jnp.round(w / s * bound), -bound, bound)
+        return _pack_int4(q.astype(jnp.int8)), (s / bound).astype(jnp.float32)
+
+    return apply_multi(run, x, name="weight_quantize")
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float32"):
+    """Inverse transform for inspection/tests."""
+    _check_algo(algo)
+
+    def run(q, s):
+        if algo == "weight_only_int4":
+            q = _unpack_int4(q, s.shape[0])
+        return q.astype(out_dtype) * s.astype(out_dtype)
+
+    return apply(run, x, scale, name="weight_dequantize")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight) [+ bias] (reference weight_only_linear).
+
+    int8 runs the fused Pallas weight-only kernel on TPU; int4 unpacks to
+    int8 in XLA (half HBM storage; the unpack fuses into the convert) and
+    uses the same kernel."""
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"weight_dtype must be int8 or int4, "
+                         f"got {weight_dtype!r}")
+    if weight_scale is None:
+        raise ValueError("weight_scale is required (from weight_quantize)")
+
+    def run(xa, w, s, *maybe_bias):
+        if weight_dtype == "int4":
+            w = _unpack_int4(w, s.shape[0])
+        y = dequant_matmul_int8(xa, w, s)
+        if maybe_bias:
+            y = y + maybe_bias[0].astype(y.dtype)
+        return y
+
+    args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())
+    return apply(run, *args, name="weight_only_linear")
