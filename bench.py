@@ -470,6 +470,54 @@ def run_dit_bench(dev):
             "n_params": pipe.dit.num_params()}
 
 
+def run_sd3_bench(dev):
+    """SD3-class MMDiT rectified-flow training throughput (BASELINE.md
+    ladder #4 'DiT / Stable-Diffusion-3'): images/s for the jitted step at
+    a 1/4-width sd3-medium config that fits one chip with AdamW states."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import MMDiTConfig, SD3Pipeline
+
+    paddle.seed(0)
+    cfg = MMDiTConfig(input_size=32, patch_size=2, in_channels=16,
+                      hidden_size=384, num_layers=12, num_heads=6,
+                      text_dim=4096, pooled_dim=2048, max_text_len=77)
+    pipe = SD3Pipeline(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=pipe.parameters())
+    b = 16
+    rng = np.random.default_rng(0)
+    x0 = paddle.to_tensor(
+        rng.standard_normal((b, 16, 32, 32)).astype(np.float32))
+    txt = paddle.to_tensor(
+        rng.standard_normal((b, 77, 4096)).astype(np.float32))
+    pooled = paddle.to_tensor(
+        rng.standard_normal((b, 2048)).astype(np.float32))
+    noise = paddle.to_tensor(
+        rng.standard_normal((b, 16, 32, 32)).astype(np.float32))
+    t = paddle.to_tensor(rng.standard_normal(b).astype(np.float32))
+
+    @paddle.jit.to_static
+    def step(x0, txt, pooled, noise, t):
+        loss = pipe(x0, txt, pooled, noise, t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(2):
+        loss = step(x0, txt, pooled, noise, t)
+    float(loss)
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x0, txt, pooled, noise, t)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    return {"images_per_sec": round(b * steps / dt, 1),
+            "loss": round(final, 4), "batch": b,
+            "n_params": pipe.mmdit.num_params()}
+
+
 def _peak_flops(dev):
     """(bf16 peak FLOPs, source) from the device kind (spec sheets)."""
     kind = (getattr(dev, "device_kind", "") or "").lower()
@@ -572,6 +620,10 @@ def _child_main(mode):
                 result["extra"]["dit_s2"] = run_dit_bench(dev)
             except Exception:
                 errs["dit_bench_error"] = traceback.format_exc(limit=2)[:600]
+            try:
+                result["extra"]["sd3_mmdit"] = run_sd3_bench(dev)
+            except Exception:
+                errs["sd3_bench_error"] = traceback.format_exc(limit=2)[:600]
             try:
                 result["extra"]["qwen2_moe"] = run_moe_bench(dev)
             except Exception:

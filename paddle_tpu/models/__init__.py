@@ -8,4 +8,6 @@ from .ernie import (Ernie, ErnieConfig, ernie_tiny,  # noqa: F401
                     ernie_for_pipeline, ErniePretrainLoss)
 from .dit import (DiT, DiTConfig, DiTPipeline, dit_tiny, dit_s_2,  # noqa: F401
                   dit_xl_2)
+from .sd3_mmdit import (MMDiT, MMDiTConfig, SD3Pipeline,  # noqa: F401
+                        sd3_tiny, sd3_medium)
 from .generation import GenerationMixin, generate  # noqa: F401

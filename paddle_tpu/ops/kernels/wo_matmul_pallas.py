@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import pad_to_block, round_up
+from ._common import pad_to_block, pick_row_block
 
 _VMEM_BUDGET = 10 * 1024 * 1024  # bytes: x + w + out + acc blocks
 
@@ -36,12 +36,15 @@ def _wo_kernel(x_ref, w_ref, s_ref, o_ref):
 
 
 def _pick_blocks(m, k, n, itemsize):
-    """(bm, bn) blocks under the VMEM budget with full-K streaming."""
+    """(bm, bn) blocks under the VMEM budget with full-K streaming. The row
+    block goes through the shared pick_row_block so it is capped at the
+    REAL row count (a decode GEMV of 8 rows must not pad to a 256-row
+    block) and honors measured autotuner overrides."""
     bn = 256
     while k * bn > 4 * 1024 * 1024 and bn > 128:     # int8 weight block
         bn //= 2
-    budget_x = _VMEM_BUDGET - k * bn - bn * 4
-    bm = max(8, min(256, (budget_x // max(k * itemsize, 1)) // 8 * 8))
+    budget_x = max(_VMEM_BUDGET - k * bn - bn * 4, k * itemsize * 8)
+    bm = pick_row_block(m, k * itemsize, budget_x, key="wo_int8")
     return bm, bn
 
 

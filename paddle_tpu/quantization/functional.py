@@ -73,9 +73,10 @@ def _dq_mm(x, w_int8, scales):
 
 
 def _dq_mm_impl(x, w_int8, scales):
+    from ..core.flags import flag
     from ..ops.kernels import _common as kern
     from ..ops.kernels.wo_matmul_pallas import reference_wo_int8_matmul
-    if kern.available():
+    if kern.available() and flag("use_pallas_kernels"):
         try:
             from ..ops.kernels.wo_matmul_pallas import wo_int8_matmul
             return wo_int8_matmul(x, w_int8, scales,
@@ -96,20 +97,21 @@ def _dq_mm_impl(x, w_int8, scales):
 
 
 def _dq_mm_fwd(x, w_int8, scales):
-    out = _dq_mm_impl(x, w_int8, scales)
-    return out, (x, w_int8, scales, out)
+    return _dq_mm_impl(x, w_int8, scales), (x, w_int8, scales)
 
 
 def _dq_mm_bwd(res, g):
     import numpy as np
-    x, w_int8, scales, out = res
+    x, w_int8, scales = res
     # y = (x @ w) * s  =>  dx = (g * s) @ w^T;  ds_j = sum_m g[m,j]*(x@w)[m,j]
     gs = g * scales.astype(g.dtype)
     dx = jnp.matmul(gs, jnp.swapaxes(w_int8.astype(g.dtype), 0, 1))
-    # recover the pre-scale product from the saved primal instead of paying
-    # a second forward-sized matmul (scales are clamped far above zero)
-    u = out.astype(jnp.float32) / jnp.maximum(
-        scales.astype(jnp.float32), 1e-30)
+    # ds needs the PRE-scale product: recompute it exactly in f32. Dividing
+    # the saved primal by the scales would be wrong for a zero scale (the
+    # public API accepts arbitrary user scales) and noisy for bf16 outputs;
+    # when the scale cotangent is unused (the common inference/QAT-x-only
+    # case under jit) XLA dead-code-eliminates this matmul entirely.
+    u = jnp.matmul(x.astype(jnp.float32), w_int8.astype(jnp.float32))
     axes = tuple(range(g.ndim - 1))
     ds = jnp.sum(g.astype(jnp.float32) * u, axis=axes).astype(scales.dtype)
     dw = np.zeros(w_int8.shape, jax.dtypes.float0)  # int weights: no tangent

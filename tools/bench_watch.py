@@ -97,6 +97,35 @@ def record(res):
             % (res.get("metric"), res.get("value"), res.get("vs_baseline")))
 
 
+_PROOF_DONE = False  # per watcher lifetime; restart the watcher to refresh
+
+
+def run_kernel_proof():
+    """After a successful bench: run every Pallas family on the live chip
+    and persist TPU_KERNEL_PROOF.json (the round's standing evidence gap —
+    kernels had only ever run in interpret mode). Skipped only once a proof
+    from THIS watcher lifetime passed — an on-disk file from an earlier
+    run (or a corrupt one) must not block regeneration against new code."""
+    global _PROOF_DONE
+    if _PROOF_DONE:
+        return
+    try:
+        log("running TPU kernel proof")
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "tpu_kernel_proof.py")],
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT, cwd=REPO)
+        tail = out.stdout.strip().splitlines()
+        log("kernel proof rc=%d %s" % (out.returncode,
+                                       tail[0] if tail else ""))
+        if out.returncode == 0:
+            _PROOF_DONE = True
+    except subprocess.TimeoutExpired:
+        log("kernel proof timed out after %ds" % BENCH_TIMEOUT)
+    except Exception as e:
+        log("kernel proof error: %r" % (e,))
+
+
 def main():
     log("watcher started pid=%d probe_every=%ds" % (os.getpid(), PROBE_INTERVAL))
     last_success = 0.0
@@ -118,6 +147,7 @@ def main():
         if is_tpu_result(res):
             record(res)
             last_success = time.time()
+            run_kernel_proof()
         else:
             ex = res.get("extra", {})
             log("bench ran but fell back to CPU: %s why=%r err=%r"

@@ -271,8 +271,7 @@ def main():
 
     # 10. segment-masked flash attention (varlen packing)
     segs = jnp.asarray(
-        np.repeat(np.arange(4), (256 if interp else 1024) // 4)[None]
-        .repeat(2, 0), jnp.int32)
+        np.repeat(np.arange(4), SEQ // 4)[None].repeat(2, 0), jnp.int32)
     fam["flash_attention_segments"] = run_family(
         "flash_attention_segments",
         lambda q, k, v: fa.flash_attention(q, k, v, causal=True,
