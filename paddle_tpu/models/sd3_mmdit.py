@@ -205,10 +205,20 @@ class MMDiT(nn.Layer):
         return sum(p.size for p in self.parameters())
 
     def flops_per_image(self) -> float:
-        n = self.num_params()
-        s = self.cfg.num_patches + self.cfg.max_text_len
+        """6 * (params-touched x tokens-through-them) + joint-attention
+        quadratic. Unlike single-stream DiT, each stream's weights see only
+        its own tokens, so the per-param term splits by stream (charging
+        all params against image patches would overcount ~1.5x here)."""
+        n_txt = sum(
+            p.size for name, p in self.named_parameters()
+            if ".txt_" in name or name.startswith("txt_embed"))
+        n_img = self.num_params() - n_txt
+        s_img = self.cfg.num_patches
+        s_txt = self.cfg.max_text_len
         l, h = self.cfg.num_layers, self.cfg.hidden_size
-        return 6.0 * n * self.cfg.num_patches + 12.0 * l * h * s * s
+        s = s_img + s_txt
+        return (6.0 * (n_img * s_img + n_txt * s_txt)
+                + 12.0 * l * h * s * s)
 
 
 class SD3Pipeline(nn.Layer):

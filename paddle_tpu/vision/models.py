@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import paddle_tpu as paddle
 from .. import nn
+from ..nn import functional as F
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "BasicBlock", "BottleneckBlock",
@@ -61,14 +62,18 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(planes)
-        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(planes)
-        self.conv3 = nn.Conv2D(planes, planes * 4, 1, bias_attr=False)
+        # grouped/wide variants (reference resnet.py resnext*/wide_resnet*):
+        # the 3x3 runs at width = planes * base_width/64 with `groups`
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(width)
+        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=1,
+                               groups=groups, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(width)
+        self.conv3 = nn.Conv2D(width, planes * 4, 1, bias_attr=False)
         self.bn3 = nn.BatchNorm2D(planes * 4)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -86,9 +91,11 @@ class BottleneckBlock(nn.Layer):
 class ResNet(nn.Layer):
     """Reference vision/models/resnet.py ResNet."""
 
-    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True):
+    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
+                 groups=1, width=64):
         super().__init__()
         self.inplanes = 64
+        self._groups, self._base_width = groups, width
         self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3,
                                bias_attr=False)
         self.bn1 = nn.BatchNorm2D(64)
@@ -112,9 +119,13 @@ class ResNet(nn.Layer):
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
                           stride=stride, bias_attr=False),
                 nn.BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        kw = {}
+        if block is BottleneckBlock:
+            kw = dict(groups=self._groups, base_width=self._base_width)
+        layers = [block(self.inplanes, planes, stride, downsample, **kw)]
         self.inplanes = planes * block.expansion
-        layers += [block(self.inplanes, planes) for _ in range(1, blocks)]
+        layers += [block(self.inplanes, planes, **kw)
+                   for _ in range(1, blocks)]
         return nn.Sequential(*layers)
 
     def forward(self, x):
@@ -607,3 +618,334 @@ class GoogLeNet(nn.Layer):
 
 def googlenet(pretrained=False, **kw):
     return GoogLeNet(**kw)
+
+
+# -- ResNeXt / Wide-ResNet constructors (reference vision/models/resnet.py
+# :531-783 — grouped / widened BottleneckBlocks over the same ResNet) -------
+
+def resnext50_32x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], groups=32, width=4, **kw)
+
+
+def resnext50_64x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], groups=64, width=4, **kw)
+
+
+def resnext101_32x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], groups=32, width=4, **kw)
+
+
+def resnext101_64x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], groups=64, width=4, **kw)
+
+
+def resnext152_32x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], groups=32, width=4, **kw)
+
+
+def resnext152_64x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], groups=64, width=4, **kw)
+
+
+def wide_resnet50_2(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], width=128, **kw)
+
+
+def wide_resnet101_2(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], width=128, **kw)
+
+
+# -- MobileNetV1 (reference vision/models/mobilenetv1.py: depthwise-
+# separable stacks) ---------------------------------------------------------
+
+class _DWSep(nn.Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.dw = nn.Sequential(
+            nn.Conv2D(cin, cin, 3, stride=stride, padding=1, groups=cin,
+                      bias_attr=False),
+            nn.BatchNorm2D(cin), nn.ReLU())
+        self.pw = nn.Sequential(
+            nn.Conv2D(cin, cout, 1, bias_attr=False),
+            nn.BatchNorm2D(cout), nn.ReLU())
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    """Reference vision/models/mobilenetv1.py MobileNetV1."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        c = lambda ch: max(8, int(ch * scale))
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c(32), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c(32)), nn.ReLU())
+        plan = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+                (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+               [(512, 1024, 2), (1024, 1024, 1)]
+        self.blocks = nn.Sequential(
+            *[_DWSep(c(i), c(o), s) for i, o, s in plan])
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+# -- MobileNetV3 (reference vision/models/mobilenetv3.py: inverted
+# residuals with squeeze-excite and hardswish) ------------------------------
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze=4):
+        super().__init__()
+        mid = max(8, ch // squeeze)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+
+    def forward(self, x):
+        s = F.relu(self.fc1(self.pool(x)))
+        return x * F.hardsigmoid(self.fc2(s))
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, cin, exp, cout, k, stride, se, act):
+        super().__init__()
+        Act = nn.Hardswish if act == "hs" else nn.ReLU
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if exp != cin:
+            layers += [nn.Conv2D(cin, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), Act()]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride, padding=k // 2,
+                             groups=exp, bias_attr=False),
+                   nn.BatchNorm2D(exp), Act()]
+        if se:
+            layers += [_SqueezeExcite(exp)]
+        layers += [nn.Conv2D(exp, cout, 1, bias_attr=False),
+                   nn.BatchNorm2D(cout)]
+        self.body = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.body(x)
+        return x + out if self.use_res else out
+
+
+_MBV3_SMALL = [  # k, exp, out, se, act, stride (reference mobilenetv3.py)
+    (3, 16, 16, True, "re", 2), (3, 72, 24, False, "re", 2),
+    (3, 88, 24, False, "re", 1), (5, 96, 40, True, "hs", 2),
+    (5, 240, 40, True, "hs", 1), (5, 240, 40, True, "hs", 1),
+    (5, 120, 48, True, "hs", 1), (5, 144, 48, True, "hs", 1),
+    (5, 288, 96, True, "hs", 2), (5, 576, 96, True, "hs", 1),
+    (5, 576, 96, True, "hs", 1)]
+_MBV3_LARGE = [
+    (3, 16, 16, False, "re", 1), (3, 64, 24, False, "re", 2),
+    (3, 72, 24, False, "re", 1), (5, 72, 40, True, "re", 2),
+    (5, 120, 40, True, "re", 1), (5, 120, 40, True, "re", 1),
+    (3, 240, 80, False, "hs", 2), (3, 200, 80, False, "hs", 1),
+    (3, 184, 80, False, "hs", 1), (3, 184, 80, False, "hs", 1),
+    (3, 480, 112, True, "hs", 1), (3, 672, 112, True, "hs", 1),
+    (5, 672, 160, True, "hs", 2), (5, 960, 160, True, "hs", 1),
+    (5, 960, 160, True, "hs", 1)]
+
+
+class MobileNetV3(nn.Layer):
+    """Reference vision/models/mobilenetv3.py (small/large)."""
+
+    def __init__(self, cfg, last_exp, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c = lambda ch: max(8, int(ch * scale + 4) // 8 * 8)
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c(16), 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c(16)), nn.Hardswish())
+        cin = c(16)
+        blocks = []
+        for k, exp, cout, se, act, stride in cfg:
+            blocks.append(_MBV3Block(cin, c(exp), c(cout), k, stride, se,
+                                     act))
+            cin = c(cout)
+        self.blocks = nn.Sequential(*blocks)
+        self.head_conv = nn.Sequential(
+            nn.Conv2D(cin, c(last_exp), 1, bias_attr=False),
+            nn.BatchNorm2D(c(last_exp)), nn.Hardswish())
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(last_exp), last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.head_conv(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(paddle.flatten(x, 1))
+        return x
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3(_MBV3_SMALL, 576, 1024, scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3(_MBV3_LARGE, 960, 1280, scale=scale, **kw)
+
+
+# -- InceptionV3 (reference vision/models/inceptionv3.py) -------------------
+
+def _cbr(cin, cout, k, **kw):
+    return nn.Sequential(nn.Conv2D(cin, cout, k, bias_attr=False, **kw),
+                         nn.BatchNorm2D(cout), nn.ReLU())
+
+
+class _IncA(nn.Layer):
+    def __init__(self, cin, pool_ch):
+        super().__init__()
+        self.b1 = _cbr(cin, 64, 1)
+        self.b5 = nn.Sequential(_cbr(cin, 48, 1), _cbr(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_cbr(cin, 64, 1), _cbr(64, 96, 3, padding=1),
+                                _cbr(96, 96, 3, padding=1))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                  _cbr(cin, pool_ch, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b5(x), self.b3(x), self.pool(x)], axis=1)
+
+
+class _IncB(nn.Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = _cbr(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_cbr(cin, 64, 1), _cbr(64, 96, 3, padding=1),
+                                 _cbr(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _IncC(nn.Layer):
+    def __init__(self, cin, ch7):
+        super().__init__()
+        self.b1 = _cbr(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            _cbr(cin, ch7, 1), _cbr(ch7, ch7, (1, 7), padding=(0, 3)),
+            _cbr(ch7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _cbr(cin, ch7, 1), _cbr(ch7, ch7, (7, 1), padding=(3, 0)),
+            _cbr(ch7, ch7, (1, 7), padding=(0, 3)),
+            _cbr(ch7, ch7, (7, 1), padding=(3, 0)),
+            _cbr(ch7, 192, (1, 7), padding=(0, 3)))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                  _cbr(cin, 192, 1))
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b7(x), self.b7d(x), self.pool(x)], axis=1)
+
+
+class _IncD(nn.Layer):
+    """Grid reduction 17->8."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(_cbr(cin, 192, 1), _cbr(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _cbr(cin, 192, 1), _cbr(192, 192, (1, 7), padding=(0, 3)),
+            _cbr(192, 192, (7, 1), padding=(3, 0)), _cbr(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _IncE(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = _cbr(cin, 320, 1)
+        self.b3_stem = _cbr(cin, 384, 1)
+        self.b3_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_cbr(cin, 448, 1),
+                                      _cbr(448, 384, 3, padding=1))
+        self.b3d_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                  _cbr(cin, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return paddle.concat(
+            [self.b1(x), self.b3_a(s), self.b3_b(s),
+             self.b3d_a(d), self.b3d_b(d), self.pool(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Reference vision/models/inceptionv3.py InceptionV3 (299x299)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _cbr(3, 32, 3, stride=2), _cbr(32, 32, 3),
+            _cbr(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _cbr(64, 80, 1), _cbr(80, 192, 3), nn.MaxPool2D(3, stride=2))
+        self.a1, self.a2, self.a3 = (_IncA(192, 32), _IncA(256, 64),
+                                     _IncA(288, 64))
+        self.red1 = _IncB(288)
+        self.c1 = _IncC(768, 128)
+        self.c2 = _IncC(768, 160)
+        self.c3 = _IncC(768, 160)
+        self.c4 = _IncC(768, 192)
+        self.red2 = _IncD(768)
+        self.e1, self.e2 = _IncE(1280), _IncE(2048)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.a3(self.a2(self.a1(self.stem(x))))
+        x = self.c4(self.c3(self.c2(self.c1(self.red1(x)))))
+        x = self.e2(self.e1(self.red2(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(paddle.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)
+
+
+__all__ += ["resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
+            "resnext101_64x4d", "resnext152_32x4d", "resnext152_64x4d",
+            "wide_resnet50_2", "wide_resnet101_2", "MobileNetV1",
+            "mobilenet_v1", "MobileNetV3", "mobilenet_v3_small",
+            "mobilenet_v3_large", "InceptionV3", "inception_v3"]

@@ -288,3 +288,51 @@ def test_deform_conv2d_zero_offsets_match_conv():
         paddle.to_tensor(np.roll(x.numpy(), -1, axis=3)), w, padding=1)
     np.testing.assert_allclose(out3.numpy()[:, :, 1:-1, 1:-2],
                                ref3.numpy()[:, :, 1:-1, 1:-2], atol=1e-3)
+
+
+def test_vision_new_families_forward():
+    """ResNeXt/wide/MobileNetV1/V3/InceptionV3 (reference
+    vision/models/{resnet,mobilenetv1,mobilenetv3,inceptionv3}.py)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import models as M
+
+    paddle.seed(0)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 3, 64, 64))
+        .astype(np.float32))
+    for ctor, kw in [(M.resnext50_32x4d, {}), (M.wide_resnet50_2, {}),
+                     (M.mobilenet_v1, dict(scale=0.25)),
+                     (M.mobilenet_v3_small, dict(scale=0.5)),
+                     (M.mobilenet_v3_large, dict(scale=0.35))]:
+        net = ctor(num_classes=7, **kw)
+        net.eval()
+        out = net(x)
+        assert out.shape == [2, 7], ctor.__name__
+        assert np.isfinite(np.asarray(out.numpy())).all(), ctor.__name__
+
+
+def test_inception_v3_forward_299():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import models as M
+    paddle.seed(0)
+    net = M.inception_v3(num_classes=5)
+    net.eval()
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((1, 3, 299, 299))
+        .astype(np.float32))
+    out = net(x)
+    assert out.shape == [1, 5]
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_resnext_grouped_width_params_differ():
+    """The grouped 3x3 must actually change parameterization vs resnet50."""
+    from paddle_tpu.vision import models as M
+    n_rn = sum(p.size for p in M.resnet50(num_classes=0).parameters())
+    n_rx = sum(p.size for p in
+               M.resnext50_32x4d(num_classes=0).parameters())
+    n_wide = sum(p.size for p in
+                 M.wide_resnet50_2(num_classes=0).parameters())
+    assert n_rx != n_rn and n_wide > 1.5 * n_rn

@@ -115,11 +115,17 @@ def run_kernel_proof():
             [sys.executable, os.path.join(REPO, "tools",
                                           "tpu_kernel_proof.py")],
             capture_output=True, text=True, timeout=BENCH_TIMEOUT, cwd=REPO)
-        tail = out.stdout.strip().splitlines()
+        lines = out.stdout.strip().splitlines()
         log("kernel proof rc=%d %s" % (out.returncode,
-                                       tail[0] if tail else ""))
+                                       lines[0] if lines else ""))
         if out.returncode == 0:
             _PROOF_DONE = True
+        else:
+            # a failing proof re-runs after every bench: the log must say
+            # why (tracebacks go to stderr)
+            log("kernel proof stdout tail: %s" % " | ".join(lines[-3:]))
+            log("kernel proof stderr tail: %s"
+                % out.stderr.strip()[-500:].replace("\n", " | "))
     except subprocess.TimeoutExpired:
         log("kernel proof timed out after %ds" % BENCH_TIMEOUT)
     except Exception as e:
