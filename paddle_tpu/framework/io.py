@@ -68,6 +68,65 @@ def save(obj, path, protocol: int = 4, **configs):
 
 def load(path, return_numpy: bool = False, **configs):
     if hasattr(path, "read"):
-        return _unpack(pickle.load(path), return_numpy)
-    with open(os.fspath(path), "rb") as f:
-        return _unpack(pickle.load(f), return_numpy)
+        raw = _RefUnpickler(path).load()
+    else:
+        with open(os.fspath(path), "rb") as f:
+            raw = _RefUnpickler(f).load()
+    ref = _from_reference_format(raw, return_numpy)
+    if ref is not None:
+        return ref
+    return _unpack(raw, return_numpy)
+
+
+class _RefUnpickler(pickle.Unpickler):
+    """Reference .pdparams/.pdopt checkpoints normally contain only numpy
+    arrays and builtins (reference io.py:_build_saved_state_dict converts
+    every tensor via np.array); a pickle that references the reference
+    framework's own classes (e.g. a whole pickled Layer) cannot load
+    without it — fail with a message that says so instead of a bare
+    ModuleNotFoundError: paddle."""
+
+    def find_class(self, module, name):
+        if module == "paddle" or module.startswith("paddle."):
+            raise pickle.UnpicklingError(
+                f"checkpoint references {module}.{name}: only plain "
+                f"state_dict checkpoints (numpy-valued, the "
+                f"paddle.save(layer.state_dict(), ...) format) are "
+                f"portable; re-save the state_dict in the source framework")
+        return super().find_class(module, name)
+
+
+def _from_reference_format(obj, return_numpy):
+    """Recognize a checkpoint written by the REFERENCE framework's
+    paddle.save (reference io.py:646): a numpy-valued dict carrying the
+    StructuredToParameterName@@ name table and optionally
+    UnpackBigParamInfor@@ sliced big params (reference io_utils.py:216,234
+    — protocol 2/3 splits >1G-element arrays). Returns the converted state
+    dict, or None when the object is not that format. A bare top-level
+    ndarray is NOT converted: this repo's own save() writes raw ndarrays
+    through unchanged, and load() returning them as-is predates the compat
+    path (reference single-tensor checkpoints come back as ndarrays too —
+    wrap with paddle.to_tensor if needed)."""
+    if not isinstance(obj, dict):
+        return None
+    markers = ("StructuredToParameterName@@", "UnpackBigParamInfor@@")
+    if not any(m in obj for m in markers):
+        return None
+    obj = dict(obj)
+    info = obj.pop("UnpackBigParamInfor@@", None)
+    if info:
+        for key, meta in info.items():
+            slices = [obj.pop(part) for part in meta["slices"]]
+            obj[key] = np.concatenate(slices).reshape(meta["OriginShape"])
+    obj.pop("StructuredToParameterName@@", None)
+
+    def conv(v):
+        if isinstance(v, np.ndarray):
+            return v if return_numpy else Tensor(v)
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(conv(x) for x in v)
+        return v
+
+    return {k: conv(v) for k, v in obj.items()}

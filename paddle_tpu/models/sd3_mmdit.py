@@ -209,15 +209,19 @@ class MMDiT(nn.Layer):
         quadratic. Unlike single-stream DiT, each stream's weights see only
         its own tokens, so the per-param term splits by stream (charging
         all params against image patches would overcount ~1.5x here)."""
-        n_txt = sum(
-            p.size for name, p in self.named_parameters()
-            if ".txt_" in name or name.startswith("txt_embed"))
-        n_img = self.num_params() - n_txt
+        n_txt = n_img = n_cond = 0
+        for name, p in self.named_parameters():
+            if "adaLN" in name or name.startswith(("t_embed", "pool_embed")):
+                n_cond += p.size   # consume ONE conditioning vector/image
+            elif ".txt_" in name or name.startswith("txt_embed"):
+                n_txt += p.size
+            else:
+                n_img += p.size
         s_img = self.cfg.num_patches
         s_txt = self.cfg.max_text_len
         l, h = self.cfg.num_layers, self.cfg.hidden_size
         s = s_img + s_txt
-        return (6.0 * (n_img * s_img + n_txt * s_txt)
+        return (6.0 * (n_img * s_img + n_txt * s_txt + n_cond)
                 + 12.0 * l * h * s * s)
 
 

@@ -120,8 +120,16 @@ class ResNet(nn.Layer):
                           stride=stride, bias_attr=False),
                 nn.BatchNorm2D(planes * block.expansion))
         kw = {}
-        if block is BottleneckBlock:
+        if issubclass(block, BottleneckBlock):
             kw = dict(groups=self._groups, base_width=self._base_width)
+        elif self._groups != 1 or self._base_width != 64:
+            # reference resnet.py raises for BasicBlock with groups/width:
+            # silently building an ungrouped net would mismatch ResNeXt
+            # checkpoints
+            raise ValueError(
+                f"groups={self._groups}/width={self._base_width} require "
+                f"BottleneckBlock; {block.__name__} only supports the "
+                f"defaults (groups=1, width=64)")
         layers = [block(self.inplanes, planes, stride, downsample, **kw)]
         self.inplanes = planes * block.expansion
         layers += [block(self.inplanes, planes, **kw)

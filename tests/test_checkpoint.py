@@ -124,3 +124,95 @@ def test_missing_tensor_raises(tmp_path):
     other = {"not_there": paddle.zeros([2])}
     with pytest.raises(KeyError):
         dist.load_state_dict(other, path)
+
+
+class TestReferenceCheckpointCompat:
+    """Loading checkpoints written by the REFERENCE framework's paddle.save
+    (reference framework/io.py:646 numpy-valued state dicts with the
+    StructuredToParameterName@@ table; io_utils.py:234 big-param slicing)."""
+
+    def _write_ref_ckpt(self, tmp_path, extra=None):
+        import pickle
+        import numpy as np
+        rng = np.random.default_rng(0)
+        sd = {
+            "linear.weight": rng.standard_normal((4, 3)).astype(np.float32),
+            "linear.bias": np.zeros(3, np.float32),
+            "StructuredToParameterName@@": {
+                "linear.weight": "param_0", "linear.bias": "param_1"},
+        }
+        if extra:
+            sd.update(extra)
+        p = str(tmp_path / "model.pdparams")
+        with open(p, "wb") as f:
+            pickle.dump(sd, f, protocol=2)
+        return p, sd
+
+    def test_load_reference_state_dict(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        p, sd = self._write_ref_ckpt(tmp_path)
+        out = paddle.load(p)
+        assert "StructuredToParameterName@@" not in out
+        np.testing.assert_array_equal(
+            np.asarray(out["linear.weight"].numpy()), sd["linear.weight"])
+        # and it applies onto a live layer
+        layer = paddle.nn.Linear(4, 3)
+        layer.set_state_dict({"weight": out["linear.weight"],
+                              "bias": out["linear.bias"]})
+        np.testing.assert_array_equal(
+            np.asarray(layer.weight.numpy()), sd["linear.weight"])
+
+    def test_load_reference_big_param_slices(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        rng = np.random.default_rng(1)
+        full = rng.standard_normal((6, 5)).astype(np.float32)
+        flat = full.flatten()
+        extra = {
+            "big@@.0": flat[:16], "big@@.1": flat[16:],
+            "UnpackBigParamInfor@@": {
+                "big": {"OriginShape": (6, 5),
+                        "slices": ["big@@.0", "big@@.1"]}},
+        }
+        p, _ = self._write_ref_ckpt(tmp_path, extra)
+        out = paddle.load(p)
+        assert "UnpackBigParamInfor@@" not in out
+        np.testing.assert_array_equal(np.asarray(out["big"].numpy()), full)
+
+    def test_load_reference_single_tensor(self, tmp_path):
+        import pickle
+        import numpy as np
+        import paddle_tpu as paddle
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        p = str(tmp_path / "t.pdtensor")
+        with open(p, "wb") as f:
+            pickle.dump(arr, f, protocol=2)
+        # bare-ndarray checkpoints come back as ndarrays (this repo's own
+        # save() has always passed raw arrays through unchanged)
+        t = paddle.load(p)
+        assert isinstance(t, np.ndarray)
+        np.testing.assert_array_equal(t, arr)
+        np.testing.assert_array_equal(paddle.load(p, return_numpy=True), arr)
+
+    def test_layer_pickle_fails_loudly(self, tmp_path):
+        import pickle
+        import pytest
+        import paddle_tpu as paddle
+        p = str(tmp_path / "bad.pdparams")
+        # simulate a pickle referencing the reference framework's classes
+        payload = (b"\x80\x02cpaddle.nn.layer.common\nLinear\nq\x00.")
+        with open(p, "wb") as f:
+            f.write(payload)
+        with pytest.raises(Exception, match="state_dict checkpoints"):
+            paddle.load(p)
+
+    def test_own_format_roundtrip_still_works(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        layer = paddle.nn.Linear(3, 2)
+        p = str(tmp_path / "own.pdparams")
+        paddle.save(layer.state_dict(), p)
+        out = paddle.load(p)
+        np.testing.assert_array_equal(np.asarray(out["weight"].numpy()),
+                                      np.asarray(layer.weight.numpy()))

@@ -336,3 +336,10 @@ def test_resnext_grouped_width_params_differ():
     n_wide = sum(p.size for p in
                  M.wide_resnet50_2(num_classes=0).parameters())
     assert n_rx != n_rn and n_wide > 1.5 * n_rn
+
+
+def test_resnet_groups_with_basicblock_raises():
+    import pytest
+    from paddle_tpu.vision import models as M
+    with pytest.raises(ValueError, match="BottleneckBlock"):
+        M.ResNet(M.BasicBlock, [2, 2, 2, 2], groups=32, width=4)
