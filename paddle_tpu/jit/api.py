@@ -224,8 +224,23 @@ class StaticFunction:
 
     def _run_compiled(self, jitted, cell, state_list, arg_arrays):
         state_arrays = []
+        seen = {id(a) for a in arg_arrays} if self._donate else None
         for t in state_list:
             a = t._d
+            if self._donate:
+                # XLA rejects donating one buffer twice, and freshly-built
+                # state can alias INSIDE the state list (two zeros_like
+                # accumulators may share a cached constant buffer; a tied
+                # weight read through two tensors): copy the duplicate
+                # before execute. NOTE the donation contract: Tensors
+                # aliasing state from OUTSIDE the compiled fn (detach()
+                # views, EMA snapshots) are invalidated by the donated
+                # execute — standard jax donation semantics; keep
+                # donate_state=False if such aliases must stay live.
+                if id(a) in seen:
+                    a = jnp.copy(a)
+                else:
+                    seen.add(id(a))
             # host-pinned state (ZeRO-offload) streams to device for the
             # compiled step — the transfer lives outside the jit boundary so
             # the program itself stays all-device
