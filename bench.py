@@ -387,6 +387,7 @@ def run_kernel_ab(dev):
     kk, nn_ = 4096, 11008
     wq = jnp.asarray(rng.integers(-127, 127, (kk, nn_)), jnp.int8)
     sc = jnp.asarray(rng.random(nn_) * 0.01, jnp.float32)
+    wq4 = jnp.asarray(rng.integers(-127, 127, (kk, nn_ // 2)), jnp.int8)
     for label, mrows in (("decode", 8), ("prefill", 1024)):
         xa = jnp.asarray(rng.standard_normal((mrows, kk)), jnp.bfloat16)
         pal = timed(lambda a: wm.wo_int8_matmul(a, wq, sc), xa)
@@ -394,6 +395,11 @@ def run_kernel_ab(dev):
         res[f"wo_int8_{label}_pallas_ms"] = round(pal, 3)
         res[f"wo_int8_{label}_xla_ms"] = round(xla, 3)
         res[f"wo_int8_{label}_speedup"] = round(xla / pal, 3)
+        pal4 = timed(lambda a: wm.wo_int4_matmul(a, wq4, sc), xa)
+        xla4 = timed(lambda a: wm.reference_wo_int4_matmul(a, wq4, sc), xa)
+        res[f"wo_int4_{label}_pallas_ms"] = round(pal4, 3)
+        res[f"wo_int4_{label}_xla_ms"] = round(xla4, 3)
+        res[f"wo_int4_{label}_speedup"] = round(xla4 / pal4, 3)
 
     # fused softmax-CE at a 50k vocab, fwd+bwd
     from paddle_tpu.ops.kernels import ce_pallas as cp

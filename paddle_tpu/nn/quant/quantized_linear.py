@@ -4,8 +4,12 @@ python/paddle/nn/quant/quantized_linear.py:25 `weight_quantize`, :70
 
 TPU mapping: int8 weights feed the fused Pallas weight-only matmul
 (ops/kernels/wo_matmul_pallas.py — in-core dequant, halved HBM weight
-traffic). int4 stores two nibbles per int8 byte (half the HBM footprint);
-the unpack runs as XLA ops in front of the same kernel.
+traffic). int4 stores two nibbles per int8 byte in THIS FRAMEWORK'S
+halves layout (byte j = columns j and j + N/2 — chosen so the dedicated
+int4 Pallas kernel can sign-extend nibbles in VMEM without a lane
+relayout; it is NOT the reference's CUDA interleaved packing, so packed
+int4 blobs are not interchangeable across frameworks — requantize from
+the float weights when migrating).
 """
 
 from __future__ import annotations
@@ -29,33 +33,28 @@ def _check_algo(algo):
 
 
 def _pack_int4(q):
-    """[K, N] int4 values in [-7, 7] -> [K, ceil(N/2)] bytes (two nibbles,
-    low nibble = even column)."""
+    """[K, N] int4 values in [-7, 7] -> [K, ceil(N/2)] bytes in the HALVES
+    layout (byte j = columns j and j + N'/2): the layout the Pallas int4
+    kernel consumes without a lane relayout (wo_matmul_pallas)."""
+    from ...ops.kernels.wo_matmul_pallas import pack_int4_halves
     n = q.shape[1]
     if n % 2:
         q = jnp.pad(q, ((0, 0), (0, 1)))
-    lo = q[:, 0::2].astype(jnp.int32) & 0xF
-    hi = q[:, 1::2].astype(jnp.int32) & 0xF
-    return (lo | (hi << 4)).astype(jnp.int8)
+    return pack_int4_halves(q)
 
 
 def _unpack_int4(packed, n):
-    """Inverse of _pack_int4: [K, ceil(N/2)] bytes -> [K, N] int8 in
-    [-7, 7] (sign-extend each nibble)."""
-    b = packed.astype(jnp.int32)
-    lo = (b & 0xF).astype(jnp.int8)
-    hi = ((b >> 4) & 0xF).astype(jnp.int8)
-    lo = jnp.where(lo > 7, lo - 16, lo).astype(jnp.int8)
-    hi = jnp.where(hi > 7, hi - 16, hi).astype(jnp.int8)
-    out = jnp.stack([lo, hi], axis=2).reshape(packed.shape[0], -1)
-    return out[:, :n]
+    """Inverse of _pack_int4 (drops the odd-N pad column)."""
+    from ...ops.kernels.wo_matmul_pallas import unpack_int4_halves
+    return unpack_int4_halves(packed)[:, :n]
 
 
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     """[K, N] float weight -> (quantized weight, per-N-channel scales).
 
-    int8: [K, N] int8. int4: [K, ceil(N/2)] int8 bytes holding two
-    4-bit values (reference packs the same way for its CUDA kernels)."""
+    int8: [K, N] int8. int4: [K, ceil(N/2)] int8 bytes holding two 4-bit
+    values in the halves layout (see module docstring; framework-specific
+    — requantize rather than importing reference-packed int4 blobs)."""
     _check_algo(algo)
     if group_size not in (-1, None):
         raise NotImplementedError("grouped scales are not supported yet; "
@@ -89,9 +88,9 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
     """y = x @ dequant(weight) [+ bias] (reference weight_only_linear).
 
-    int8 runs the fused Pallas weight-only kernel on TPU; int4 unpacks to
-    int8 in XLA (half HBM storage; the unpack fuses into the convert) and
-    uses the same kernel."""
+    int8 and int4 each run a dedicated fused Pallas kernel on TPU; the
+    int4 kernel reads the packed bytes straight from HBM and sign-extends
+    nibbles in VMEM (half of int8's weight traffic)."""
     if weight_dtype not in ("int8", "int4"):
         raise ValueError(f"weight_dtype must be int8 or int4, "
                          f"got {weight_dtype!r}")
@@ -100,8 +99,14 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
 
     def run(xa, w, s, *maybe_bias):
         if weight_dtype == "int4":
-            w = _unpack_int4(w, s.shape[0])
-        y = dequant_matmul_int8(xa, w, s)
+            from ...quantization.functional import dequant_matmul_int4
+            n, half = s.shape[0], w.shape[1]
+            if 2 * half != n:   # odd N carries one zero pad column
+                s = jnp.concatenate(
+                    [s, jnp.zeros((2 * half - n,), s.dtype)])
+            y = dequant_matmul_int4(xa, w, s)[..., :n]
+        else:
+            y = dequant_matmul_int8(xa, w, s)
         if maybe_bias:
             y = y + maybe_bias[0].astype(y.dtype)
         return y

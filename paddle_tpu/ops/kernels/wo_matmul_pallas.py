@@ -83,3 +83,127 @@ def reference_wo_int8_matmul(x, w_q, scales):
     """XLA composite (quantization.functional.dequant_matmul_int8)."""
     y = jnp.matmul(x, w_q.astype(x.dtype))
     return y * scales.astype(x.dtype)
+
+
+# -- int4: two 4-bit values per byte, HALF-SPLIT layout --------------------
+#
+# Packing nibbles from INTERLEAVED columns (even=lo, odd=hi — the natural
+# byte packing) would need a stride-2 lane scatter inside the kernel, a
+# Mosaic relayout. Packing column halves instead — byte j holds column j
+# (lo nibble) and column j + N/2 (hi nibble) — lets the kernel emit two
+# CONTIGUOUS output slabs per packed block with plain shifts/masks.
+
+def pack_int4_halves(q):
+    """[K, N] int8 values in [-7, 7], N even -> [K, N/2] bytes."""
+    if q.shape[1] % 2:
+        raise ValueError("pack_int4_halves needs an even column count")
+    half = q.shape[1] // 2
+    lo = q[:, :half].astype(jnp.int32) & 0xF
+    hi = q[:, half:].astype(jnp.int32) & 0xF
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def unpack_int4_halves(packed):
+    """Inverse of pack_int4_halves: [K, N/2] bytes -> [K, N] int8."""
+    b = packed.astype(jnp.int32)
+    lo = (b & 0xF)
+    hi = ((b >> 4) & 0xF)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    return jnp.concatenate([lo, hi], axis=1).astype(jnp.int8)
+
+
+def _wo4_kernel(x_ref, w_ref, slo_ref, shi_ref, olo_ref, ohi_ref):
+    x = x_ref[...]
+    b = w_ref[...]                                   # [K, bn] packed bytes
+    # int8 ARITHMETIC shifts sign-extend the nibbles for free (no int32
+    # widening, no select): hi = b >> 4; lo = (b << 4) >> 4
+    lo = ((b << 4) >> 4).astype(x.dtype)   # wrap-around then sign-extend
+    hi = (b >> 4).astype(x.dtype)
+    acc_lo = jax.lax.dot_general(x, lo, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    acc_hi = jax.lax.dot_general(x, hi, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    olo_ref[...] = (acc_lo * slo_ref[0].astype(jnp.float32)).astype(
+        olo_ref.dtype)
+    ohi_ref[...] = (acc_hi * shi_ref[0].astype(jnp.float32)).astype(
+        ohi_ref.dtype)
+
+
+def _pick_blocks_int4(m, k, half, itemsize):
+    """Like _pick_blocks but budgeted for the int4 kernel's in-VMEM
+    expansion: per packed byte the kernel holds the byte plus two
+    sign-extended int8 planes plus their activation-dtype casts
+    (~3 + 2*itemsize bytes). Returns (bm, bn) or None when even the
+    smallest block cannot fit (caller falls back to the composite —
+    better a loud trace-time decision than a Mosaic OOM at compile)."""
+    per_byte = 3 + 2 * itemsize
+    bn = 256
+    while k * bn * per_byte > 6 * 1024 * 1024 and bn > 128:
+        bn //= 2
+    if k * bn * per_byte > 6 * 1024 * 1024:
+        return None
+    budget_x = max(_VMEM_BUDGET - k * bn * per_byte - 2 * bn * 4,
+                   k * itemsize * 8)
+    bm = pick_row_block(m, k * itemsize, budget_x, key="wo_int4")
+    return bm, bn
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def wo_int4_matmul(x, w_packed, scales, interpret=False):
+    """[.., K] @ int4-packed [K, N/2] * scales [N] -> [.., N] in x.dtype.
+
+    The packed bytes stay packed in HBM (half the int8 footprint AND half
+    the weight read traffic); nibbles unpack in VMEM right before the MXU
+    contraction. `scales` covers all N output columns (halves layout:
+    column j of the packed byte -> outputs j and j + N/2)."""
+    if w_packed.dtype != jnp.int8:
+        raise ValueError(f"packed weight must be int8 bytes, "
+                         f"got {w_packed.dtype}")
+    lead = x.shape[:-1]
+    k, half = w_packed.shape
+    n = 2 * half
+    if scales.shape[0] != n:
+        raise ValueError(f"scales must cover {n} columns, "
+                         f"got {scales.shape[0]}")
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    picked = _pick_blocks_int4(m, k, half, jnp.dtype(x.dtype).itemsize)
+    if picked is None:
+        raise ValueError(
+            f"int4 kernel weight block cannot fit VMEM at K={k} (needs "
+            f"K-blocking); use the composite path")
+    bm, bn = picked
+    x2 = pad_to_block(x2, bm, axis=0)
+    w_p = pad_to_block(w_packed, bn, axis=1)
+    s_lo = pad_to_block(scales[:half].reshape(1, half), bn, axis=1)
+    s_hi = pad_to_block(scales[half:].reshape(1, half), bn, axis=1)
+    mp, hp = x2.shape[0], w_p.shape[1]
+
+    with jax.enable_x64(False):
+        out_lo, out_hi = pl.pallas_call(
+            _wo4_kernel,
+            grid=(mp // bm, hp // bn),
+            in_specs=[
+                pl.BlockSpec((bm, k), lambda mi, ni: (mi, 0)),
+                pl.BlockSpec((k, bn), lambda mi, ni: (0, ni)),
+                pl.BlockSpec((1, bn), lambda mi, ni: (0, ni)),
+                pl.BlockSpec((1, bn), lambda mi, ni: (0, ni)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bm, bn), lambda mi, ni: (mi, ni)),
+                pl.BlockSpec((bm, bn), lambda mi, ni: (mi, ni)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((mp, hp), x.dtype),
+                jax.ShapeDtypeStruct((mp, hp), x.dtype),
+            ],
+            interpret=interpret,
+        )(x2, w_p, s_lo, s_hi)
+    out = jnp.concatenate([out_lo[:m, :half], out_hi[:m, :half]], axis=1)
+    return out.reshape(*lead, n)
+
+
+def reference_wo_int4_matmul(x, w_packed, scales):
+    w = unpack_int4_halves(w_packed)
+    return jnp.matmul(x, w.astype(x.dtype)) * scales.astype(x.dtype)

@@ -64,7 +64,55 @@ def dequant_matmul_int8(x, w_int8, scales):
     return _dq_mm(unwrap(x), unwrap(w_int8), unwrap(scales))
 
 
-_WO_WARNED = False
+def dequant_matmul_int4(x, w_packed, scales):
+    """x @ dequant(int4-packed w) * scales — packed bytes stay packed in
+    HBM (half of int8's footprint and read traffic); the Pallas kernel
+    sign-extends nibbles in VMEM (halves layout, see wo_matmul_pallas).
+    Accepts framework Tensors or raw arrays."""
+    unwrap = lambda t: t._data if hasattr(t, "_data") else t
+    return _dq4_mm(unwrap(x), unwrap(w_packed), unwrap(scales))
+
+
+_WO_WARNED: set = set()   # per-kernel-label warn-once
+
+
+def _wo_dispatch(label, kernel_call, composite_call):
+    """Shared weight-only dispatch: Pallas kernel behind the availability
+    check and the use_pallas_kernels kill switch; on kernel failure warn
+    ONCE PER LABEL (the composite materializes a full-width weight copy —
+    the regression these kernels exist to avoid must never be silent)."""
+    from ..core.flags import flag
+    from ..ops.kernels import _common as kern
+    if kern.available() and flag("use_pallas_kernels"):
+        try:
+            return kernel_call(kern.interpret_mode())
+        except Exception as e:
+            if label not in _WO_WARNED:
+                _WO_WARNED.add(label)
+                import warnings
+                warnings.warn(
+                    f"weight-only {label} matmul: Pallas kernel unavailable "
+                    f"({type(e).__name__}: {e}); falling back to the XLA "
+                    f"composite (full-width dequantized weight traffic)",
+                    RuntimeWarning, stacklevel=4)
+    return composite_call()
+
+
+def _wo_bwd_math(x, w_dense, scales, g):
+    """Shared weight-only VJP: y = (x @ w) * s.
+
+    dx = (g * s) @ w^T. ds needs the PRE-scale product u = x @ w:
+    recompute it exactly in f32 — dividing the saved primal by the scales
+    would be wrong for a zero scale (the public API accepts arbitrary user
+    scales) and noisy for bf16 outputs; when the scale cotangent is unused
+    (the common inference/QAT-x-only case under jit) XLA dead-code-
+    eliminates this matmul entirely."""
+    gs = g * scales.astype(g.dtype)
+    dx = jnp.matmul(gs, jnp.swapaxes(w_dense.astype(g.dtype), 0, 1))
+    u = jnp.matmul(x.astype(jnp.float32), w_dense.astype(jnp.float32))
+    axes = tuple(range(g.ndim - 1))
+    ds = jnp.sum(g.astype(jnp.float32) * u, axis=axes).astype(scales.dtype)
+    return dx.astype(x.dtype), ds
 
 
 @jax.custom_vjp
@@ -72,50 +120,49 @@ def _dq_mm(x, w_int8, scales):
     return _dq_mm_fwd(x, w_int8, scales)[0]
 
 
-def _dq_mm_impl(x, w_int8, scales):
-    from ..core.flags import flag
-    from ..ops.kernels import _common as kern
-    from ..ops.kernels.wo_matmul_pallas import reference_wo_int8_matmul
-    if kern.available() and flag("use_pallas_kernels"):
-        try:
-            from ..ops.kernels.wo_matmul_pallas import wo_int8_matmul
-            return wo_int8_matmul(x, w_int8, scales,
-                                  interpret=kern.interpret_mode())
-        except Exception as e:
-            # the composite materializes a full-width weight copy per call —
-            # the regression this kernel exists to avoid must not be silent
-            global _WO_WARNED
-            if not _WO_WARNED:
-                _WO_WARNED = True
-                import warnings
-                warnings.warn(
-                    f"weight-only int8 matmul: Pallas kernel unavailable "
-                    f"({type(e).__name__}: {e}); falling back to the XLA "
-                    f"composite (full-width dequantized weight traffic)",
-                    RuntimeWarning, stacklevel=3)
-    return reference_wo_int8_matmul(x, w_int8, scales)
-
-
 def _dq_mm_fwd(x, w_int8, scales):
-    return _dq_mm_impl(x, w_int8, scales), (x, w_int8, scales)
+    from ..ops.kernels.wo_matmul_pallas import (reference_wo_int8_matmul,
+                                                wo_int8_matmul)
+    out = _wo_dispatch(
+        "int8",
+        lambda interp: wo_int8_matmul(x, w_int8, scales, interpret=interp),
+        lambda: reference_wo_int8_matmul(x, w_int8, scales))
+    return out, (x, w_int8, scales)
 
 
 def _dq_mm_bwd(res, g):
     import numpy as np
     x, w_int8, scales = res
-    # y = (x @ w) * s  =>  dx = (g * s) @ w^T;  ds_j = sum_m g[m,j]*(x@w)[m,j]
-    gs = g * scales.astype(g.dtype)
-    dx = jnp.matmul(gs, jnp.swapaxes(w_int8.astype(g.dtype), 0, 1))
-    # ds needs the PRE-scale product: recompute it exactly in f32. Dividing
-    # the saved primal by the scales would be wrong for a zero scale (the
-    # public API accepts arbitrary user scales) and noisy for bf16 outputs;
-    # when the scale cotangent is unused (the common inference/QAT-x-only
-    # case under jit) XLA dead-code-eliminates this matmul entirely.
-    u = jnp.matmul(x.astype(jnp.float32), w_int8.astype(jnp.float32))
-    axes = tuple(range(g.ndim - 1))
-    ds = jnp.sum(g.astype(jnp.float32) * u, axis=axes).astype(scales.dtype)
+    dx, ds = _wo_bwd_math(x, w_int8, scales, g)
     dw = np.zeros(w_int8.shape, jax.dtypes.float0)  # int weights: no tangent
-    return dx.astype(x.dtype), dw, ds
+    return dx, dw, ds
 
 
 _dq_mm.defvjp(_dq_mm_fwd, _dq_mm_bwd)
+
+
+@jax.custom_vjp
+def _dq4_mm(x, w_packed, scales):
+    return _dq4_mm_fwd(x, w_packed, scales)[0]
+
+
+def _dq4_mm_fwd(x, w_packed, scales):
+    from ..ops.kernels.wo_matmul_pallas import (reference_wo_int4_matmul,
+                                                wo_int4_matmul)
+    out = _wo_dispatch(
+        "int4",
+        lambda interp: wo_int4_matmul(x, w_packed, scales, interpret=interp),
+        lambda: reference_wo_int4_matmul(x, w_packed, scales))
+    return out, (x, w_packed, scales)
+
+
+def _dq4_mm_bwd(res, g):
+    import numpy as np
+    from ..ops.kernels.wo_matmul_pallas import unpack_int4_halves
+    x, w_packed, scales = res
+    dx, ds = _wo_bwd_math(x, unpack_int4_halves(w_packed), scales, g)
+    dw = np.zeros(w_packed.shape, jax.dtypes.float0)
+    return dx, dw, ds
+
+
+_dq4_mm.defvjp(_dq4_mm_fwd, _dq4_mm_bwd)

@@ -727,3 +727,75 @@ class TestWeightOnlyLinearAPI:
         w = paddle.to_tensor(np.ones((8, 8), np.float32))
         with pytest.raises(ValueError, match="algo"):
             Q.weight_quantize(w, algo="llm.int8")
+
+
+class TestWeightOnlyInt4Kernel:
+    """Fused int4 weight-only matmul: packed bytes stay packed in HBM,
+    nibbles unpack in VMEM (halves layout, wo_matmul_pallas)."""
+
+    def test_pack_roundtrip(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.ops.kernels.wo_matmul_pallas import (
+            pack_int4_halves, unpack_int4_halves)
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.integers(-7, 8, (16, 24)), jnp.int8)
+        np.testing.assert_array_equal(
+            np.asarray(unpack_int4_halves(pack_int4_halves(q))),
+            np.asarray(q))
+
+    def test_kernel_matches_composite(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from paddle_tpu.ops.kernels.wo_matmul_pallas import (
+            pack_int4_halves, reference_wo_int4_matmul, wo_int4_matmul)
+        rng = np.random.default_rng(1)
+        k, n = 256, 120
+        q = jnp.asarray(rng.integers(-7, 8, (k, n)), jnp.int8)
+        packed = pack_int4_halves(q)
+        s = jnp.asarray(rng.random(n) * 0.05 + 0.01, jnp.float32)
+        x = jnp.asarray(rng.standard_normal((10, k)), jnp.float32)
+        out = wo_int4_matmul(x, packed, s, interpret=True)
+        ref = reference_wo_int4_matmul(x, packed, s)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_weight_only_linear_int4_grads(self):
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.kernels import _common as kern
+        from paddle_tpu.ops.kernels.wo_matmul_pallas import (
+            pack_int4_halves, unpack_int4_halves)
+        from paddle_tpu.quantization.functional import dequant_matmul_int4
+        rng = np.random.default_rng(2)
+        k, n = 64, 32
+        q = jnp.asarray(rng.integers(-7, 8, (k, n)), jnp.int8)
+        packed = pack_int4_halves(q)
+        s = jnp.asarray(rng.random(n) * 0.05 + 0.01, jnp.float32)
+        x = jnp.asarray(rng.standard_normal((6, k)), jnp.float32)
+        kern.force_interpret(True)
+        try:
+            gx, gs = jax.grad(
+                lambda x, s: jnp.sum(dequant_matmul_int4(x, packed, s) ** 2),
+                argnums=(0, 1))(x, s)
+        finally:
+            kern.force_interpret(False)
+        w = unpack_int4_halves(packed).astype(jnp.float32)
+        rx, rs = jax.grad(
+            lambda x, s: jnp.sum((jnp.matmul(x, w) * s) ** 2),
+            argnums=(0, 1))(x, s)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                                   atol=1e-3, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(rs),
+                                   atol=1e-2, rtol=1e-3)
+
+    def test_tpu_lowering(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.kernels.wo_matmul_pallas import wo_int4_matmul
+        x = jnp.zeros((64, 512), jnp.bfloat16)
+        w = jnp.zeros((512, 512), jnp.int8)   # 1024 output columns
+        s = jnp.zeros((1024,), jnp.float32)
+        jax.jit(lambda a, b, c: wo_int4_matmul(a, b, c)).trace(
+            x, w, s).lower(lowering_platforms=("tpu",))

@@ -269,6 +269,15 @@ def main():
         lambda a: wm.reference_wo_int8_matmul(a, wq, sc),
         (xw,), tol=5e-2)
 
+    # 9b. int4 weight-only matmul (packed halves layout)
+    wq4 = jnp.asarray(rng.integers(-127, 127, (kk, nn_ // 2)), jnp.int8)
+    sc4 = jnp.asarray(rng.random(nn_) * 0.01, jnp.float32)
+    fam["wo_int4_matmul"] = run_family(
+        "wo_int4_matmul",
+        lambda a: wm.wo_int4_matmul(a, wq4, sc4, interpret=interp),
+        lambda a: wm.reference_wo_int4_matmul(a, wq4, sc4),
+        (xw,), tol=5e-2)
+
     # 10. segment-masked flash attention (varlen packing)
     segs = jnp.asarray(
         np.repeat(np.arange(4), SEQ // 4)[None].repeat(2, 0), jnp.int32)
