@@ -9,7 +9,9 @@ halves layout (byte j = columns j and j + N/2 — chosen so the dedicated
 int4 Pallas kernel can sign-extend nibbles in VMEM without a lane
 relayout; it is NOT the reference's CUDA interleaved packing, so packed
 int4 blobs are not interchangeable across frameworks — requantize from
-the float weights when migrating).
+the float weights when migrating. The halves layout has been THE int4
+format of this framework since int4 support shipped; no released artifact
+ever used a different packing).
 """
 
 from __future__ import annotations

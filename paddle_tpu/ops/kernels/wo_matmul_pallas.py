@@ -130,7 +130,7 @@ def _wo4_kernel(x_ref, w_ref, slo_ref, shi_ref, olo_ref, ohi_ref):
         ohi_ref.dtype)
 
 
-def _pick_blocks_int4(m, k, half, itemsize):
+def _pick_blocks_int4(m, k, itemsize):
     """Like _pick_blocks but budgeted for the int4 kernel's in-VMEM
     expansion: per packed byte the kernel holds the byte plus two
     sign-extended int8 planes plus their activation-dtype casts
@@ -168,7 +168,7 @@ def wo_int4_matmul(x, w_packed, scales, interpret=False):
                          f"got {scales.shape[0]}")
     x2 = x.reshape(-1, k)
     m = x2.shape[0]
-    picked = _pick_blocks_int4(m, k, half, jnp.dtype(x.dtype).itemsize)
+    picked = _pick_blocks_int4(m, k, jnp.dtype(x.dtype).itemsize)
     if picked is None:
         raise ValueError(
             f"int4 kernel weight block cannot fit VMEM at K={k} (needs "

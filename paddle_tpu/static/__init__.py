@@ -51,11 +51,27 @@ def reset_default_programs():
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kw):
-    """Reference static.save_inference_model -> jit.save. The exported
-    StableHLO becomes default_main_program()'s text for inspection."""
+    """Reference static.save_inference_model. Two sources:
+
+    - a recorded static Program (the reference's native use): exports
+      feeds -> fetches as StableHLO with the trained values baked in;
+    - a dygraph Layer passed via program=<layer> (compat shim): routes
+      through jit.save with feed_vars as the input spec.
+
+    Either way `load_inference_model` returns an executable object with no
+    dependency on the original Python."""
+    prog = program
+    if prog is None and kw.get("layer") is None and \
+            _MAIN._trace is not None and fetch_vars:
+        prog = _MAIN
+    if isinstance(prog, Program):
+        prog.export_inference(path_prefix, feed_vars, fetch_vars)
+        _MAIN._text = prog._text or _MAIN._text
+        return
     layer = kw.get("layer") or program
     if layer is None or not hasattr(layer, "state_dict"):
-        raise TypeError("pass the Layer to serialize via program=<layer>")
+        raise TypeError("pass a static Program via program=, or the Layer "
+                        "to serialize via program=<layer>")
     _jit_save(layer, path_prefix, input_spec=feed_vars)
     try:
         with open(path_prefix + ".pdmodel.txt") as f:

@@ -33,6 +33,7 @@ Faithfulness notes:
 from __future__ import annotations
 
 import contextlib
+import os
 
 import jax
 import jax.numpy as jnp
@@ -391,6 +392,80 @@ class Program:
             return res
 
         return runner
+
+    def export_inference(self, path_prefix, feed_vars, fetch_vars):
+        """Serialize `feeds -> fetches` as a deployable artifact in the
+        jit.save payload format (StableHLO via jax.export), with the
+        CURRENT parameter and threaded-state values baked in as constants
+        — `jit.load` / `load_inference_model` then executes it without
+        this Program (reference static.save_inference_model writes the
+        pruned inference ProgramDesc + persistables the same way)."""
+        import pickle
+
+        import jax
+        from jax import export as jax_export
+
+        feed_vars = list(feed_vars or [])
+        fetch_vars = list(fetch_vars or [])
+        if not fetch_vars:
+            raise ValueError("save_inference_model needs fetch_vars")
+        out_tracers = []
+        for f in fetch_vars:
+            tr = _tracer_of(f)
+            if tr is None:
+                raise TypeError("fetch_vars must be traced Tensors of this "
+                                "Program")
+            out_tracers.append(tr)
+        jaxpr, consts = self._close(out_tracers)
+        jaxpr, used_consts, used_invars = pe.dce_jaxpr_consts(
+            jaxpr, [True] * len(out_tracers), instantiate=False)
+        consts = [c for c, u in zip(consts, used_consts) if u]
+        used_names = [n for n, u in zip(self._feed_order, used_invars) if u]
+        feed_names = [t.name for t in feed_vars]
+        missing = [n for n in used_names if n not in feed_names]
+        if missing:
+            raise ValueError(f"fetch_vars depend on feeds {missing} not "
+                             f"listed in feed_vars")
+
+        # bake CURRENT values: trace-time const arrays belonging to
+        # parameters / threaded state are swapped for their live values
+        cur = {}
+        for p, init in self._param_init:
+            cur[id(init)] = lambda p=p: p._d
+            cur[id(p._d)] = lambda p=p: p._d
+        for tid, (t, init) in self._state.initial.items():
+            sh = self._state_shadow.get(tid)
+            if sh is not None:
+                cur[id(init)] = lambda sh=sh: sh._d
+        consts = [cur[id(c)]() if id(c) in cur else c for c in consts]
+        replay = jcore.jaxpr_as_fun(jcore.ClosedJaxpr(jaxpr, consts))
+
+        feed_by_name = {t.name: t for t in feed_vars}
+        order = [feed_by_name[n] for n in used_names]
+
+        def fn(params, *feeds):
+            del params  # baked; empty dict keeps the jit.load convention
+            outs = replay(*feeds)
+            return tuple(outs)
+
+        structs = [jax.ShapeDtypeStruct(tuple(t.shape), t._d.dtype)
+                   for t in order]
+        with suspend_trace():
+            exported = jax_export.export(jax.jit(fn))({}, *structs)
+        payload = {
+            "state": {}, "param_dtypes": {}, "class": "StaticProgram",
+            "out_is_tuple": True, "feed_names": used_names,
+            "exported": exported.serialize(),
+            "stablehlo": exported.mlir_module(),
+        }
+        d = os.path.dirname(path_prefix)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path_prefix + ".pdmodel", "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+        with open(path_prefix + ".pdmodel.txt", "w") as f:
+            f.write(payload["stablehlo"])
+        self._text = payload["stablehlo"]
 
     def _by_name(self, name):
         for t in self._feeds.values():

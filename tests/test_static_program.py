@@ -229,3 +229,48 @@ def test_dygraph_minimize_empty_params_raises():
     t = paddle.to_tensor(np.ones(2, np.float32))
     with pytest.raises(ValueError, match="empty parameter list"):
         opt.minimize((t * t).sum())
+
+
+def test_save_inference_model_from_program(tmp_path):
+    """Reference-style static deployment: train under a Program, export
+    feeds->fetches with trained values baked in, reload WITHOUT the
+    Program and serve through Executor.run."""
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((8, 3)).astype(np.float32)
+    yv = (xv @ np.array([[1.0], [-1.0], [2.0]], np.float32))
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 3], "float32")
+        y = static.data("y", [8, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = ((pred - y) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.2)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    for _ in range(40):
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    (trained_pred,) = exe.run(main.clone(for_test=True),
+                              feed={"x": xv}, fetch_list=[pred])
+
+    prefix = str(tmp_path / "deploy/m")
+    static.save_inference_model(prefix, [x], [pred], exe, program=main)
+    loaded = static.load_inference_model(prefix)
+    (served,) = exe.run(loaded, feed={"x": xv})
+    np.testing.assert_allclose(np.asarray(served),
+                               np.asarray(trained_pred), rtol=1e-5,
+                               atol=1e-5)
+    # the artifact must carry the TRAINED weights, not the init
+    assert float(np.abs(np.asarray(served) - yv).mean()) < 0.5
+
+
+def test_save_inference_model_missing_feed_raises(tmp_path):
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        a = static.data("a", [2], "float32")
+        b = static.data("b", [2], "float32")
+        out = a * b
+    with pytest.raises(ValueError, match="depend on feeds"):
+        static.save_inference_model(str(tmp_path / "m"), [a], [out],
+                                    program=main)
