@@ -72,6 +72,18 @@ def _spec_structs(input_spec):
     return structs
 
 
+def _write_payload(path, payload):
+    """Single writer for the .pdmodel artifact layout (payload pickle +
+    StableHLO text sidecar) — jit.save and static export_inference both
+    produce it, and jit.load reads it."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    if "stablehlo" in payload:
+        with open(path + ".pdmodel.txt", "w") as f:
+            f.write(payload["stablehlo"])
+
+
 def save(layer, path, input_spec=None, **configs):
     """Serialize `layer`: state dict + exported program per input spec.
 
@@ -121,12 +133,7 @@ def save(layer, path, input_spec=None, **configs):
             (tuple(d if isinstance(d, int) else str(d) for d in s.shape),
              str(s.dtype)) for s in structs]  # symbolic dims as strings
         payload["stablehlo"] = exported.mlir_module()
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path + ".pdmodel", "wb") as f:
-        pickle.dump(payload, f, protocol=4)
-    if "stablehlo" in payload:
-        with open(path + ".pdmodel.txt", "w") as f:
-            f.write(payload["stablehlo"])
+    _write_payload(path, payload)
 
 
 class TranslatedLayer(Layer):

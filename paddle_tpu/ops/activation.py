@@ -106,10 +106,17 @@ def log_softmax(x, axis=-1, dtype=None, name=None) -> Tensor:
 
 
 def maxout(x, groups, axis=1, name=None) -> Tensor:
+    """Reference functional/activation.py:830: out channel i of C/groups
+    takes the max over input channels [groups*i, groups*(i+1)) — the OUTER
+    reshape factor is C//groups (the previous inverted grouping returned
+    `groups` channels, caught by the schema-generated OpTest)."""
     def f(a):
         ax = axis % a.ndim
         c = a.shape[ax]
-        shp = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1:]
+        if c % groups:
+            raise ValueError(f"maxout: channels {c} not divisible by "
+                             f"groups {groups}")
+        shp = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
         return jnp.max(a.reshape(shp), axis=ax + 1)
     return apply(f, x, name="maxout")
 

@@ -206,6 +206,18 @@ class Program:
         return self._trace.to_jaxpr(list(out_tracers), dbg,
                                     source_info_util.current())
 
+    def _close_pruned(self, out_tracers):
+        """Close over `out_tracers` and DCE: (jaxpr, live consts, names of
+        the feeds the pruned program still consumes). The invars-order ==
+        _feed_order invariant lives HERE only (both the runner build and
+        inference export depend on it)."""
+        jaxpr, consts = self._close(out_tracers)
+        jaxpr, used_consts, used_invars = pe.dce_jaxpr_consts(
+            jaxpr, [True] * len(out_tracers), instantiate=False)
+        consts = [c for c, u in zip(consts, used_consts) if u]
+        used_names = [n for n, u in zip(self._feed_order, used_invars) if u]
+        return jaxpr, consts, used_names
+
     def _build_runner(self, fetch_list, train):
         """Compile (feeds) -> fetches [+ param/opt updates via to_static]."""
         from ..jit.api import to_static
@@ -252,14 +264,9 @@ class Program:
                     self._state_shadow.setdefault(tid, Tensor(init))
             out_tracers += [tr for _, _, _, tr in state_items]
 
-        jaxpr, consts = self._close(out_tracers)
-
         # prune eqns (and thereby consts and feeds) this fetch set doesn't
         # need; state outputs of untouched tensors survive harmlessly
-        jaxpr, used_consts, used_invars = pe.dce_jaxpr_consts(
-            jaxpr, [True] * len(out_tracers), instantiate=False)
-        consts = [c for c, u in zip(consts, used_consts) if u]
-        used_names = [n for n, u in zip(self._feed_order, used_invars) if u]
+        jaxpr, consts, used_names = self._close_pruned(out_tracers)
 
         # lift parameter and state-initial constants into inputs so (a)
         # training can update params, (b) later eager updates stay visible,
@@ -400,8 +407,6 @@ class Program:
         — `jit.load` / `load_inference_model` then executes it without
         this Program (reference static.save_inference_model writes the
         pruned inference ProgramDesc + persistables the same way)."""
-        import pickle
-
         import jax
         from jax import export as jax_export
 
@@ -416,11 +421,7 @@ class Program:
                 raise TypeError("fetch_vars must be traced Tensors of this "
                                 "Program")
             out_tracers.append(tr)
-        jaxpr, consts = self._close(out_tracers)
-        jaxpr, used_consts, used_invars = pe.dce_jaxpr_consts(
-            jaxpr, [True] * len(out_tracers), instantiate=False)
-        consts = [c for c, u in zip(consts, used_consts) if u]
-        used_names = [n for n, u in zip(self._feed_order, used_invars) if u]
+        jaxpr, consts, used_names = self._close_pruned(out_tracers)
         feed_names = [t.name for t in feed_vars]
         missing = [n for n in used_names if n not in feed_names]
         if missing:
@@ -432,7 +433,6 @@ class Program:
         cur = {}
         for p, init in self._param_init:
             cur[id(init)] = lambda p=p: p._d
-            cur[id(p._d)] = lambda p=p: p._d
         for tid, (t, init) in self._state.initial.items():
             sh = self._state_shadow.get(tid)
             if sh is not None:
@@ -458,13 +458,8 @@ class Program:
             "exported": exported.serialize(),
             "stablehlo": exported.mlir_module(),
         }
-        d = os.path.dirname(path_prefix)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(path_prefix + ".pdmodel", "wb") as f:
-            pickle.dump(payload, f, protocol=4)
-        with open(path_prefix + ".pdmodel.txt", "w") as f:
-            f.write(payload["stablehlo"])
+        from ..jit.save_load import _write_payload
+        _write_payload(path_prefix, payload)
         self._text = payload["stablehlo"]
 
     def _by_name(self, name):

@@ -235,6 +235,9 @@ def _make_array(kind, shape, dtype, rng, spec, case):
         return a
     if kind == "bool":
         return rng.random(shape) > 0.5
+    if kind == "complexgauss":
+        return (rng.standard_normal(shape)
+                + 1j * rng.standard_normal(shape)).astype(np.complex64)
     if kind == "index":
         hi = case.get("index_high", 2)
         return rng.integers(0, hi, shape).astype(np.int64)
