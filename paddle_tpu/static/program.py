@@ -23,11 +23,12 @@ Faithfulness notes:
   the backward graph is generated at compile time by `jax.value_and_grad`
   over the replayed forward jaxpr (the reference appends backward ops via
   `append_backward` — on TPU the AD transform owns that).
-- Shapes must be concrete: `static.data(shape=[None, ...])` raises. The
-  compiled program is a fixed-shape XLA executable; a `None` batch would
-  bake batch-dependent constants (e.g. `mean`'s divisor) at a wrong size
-  and replay silently wrong. Declare the real batch size, or build one
-  Program per batch shape.
+- Dynamic dims: `static.data(shape=[None, ...])` declares jax.export
+  symbolic dimensions — batch-dependent values (e.g. `mean`'s divisor)
+  trace symbolically and `Executor.run` / `save_inference_model` refine
+  per concrete feed. Fetch-only execution and export support this;
+  `minimize()` requires concrete shapes (the compiled backward goes
+  through concrete-shape tracing) and says so.
 """
 
 from __future__ import annotations
@@ -103,6 +104,24 @@ class Program:
         self._loss: Tensor | None = None
         self._runners: dict = {}
         self._text = ""               # legacy save_inference_model text
+        self._symbolic = False        # any feed carries a dynamic dim
+        self._n_sym = 0
+        self._sym_dims: dict = {}     # symbol name -> dimension object
+        self._warned_state = False
+        from jax import export as jax_export
+        self._sym_scope = jax_export.SymbolicScope()
+
+    def _sym_dim(self, name):
+        """A symbolic dimension in this Program's scope; named symbols
+        (axis-0 'batch', user strings) are shared so feeds combine."""
+        from jax import export as jax_export
+        if name is None:
+            self._n_sym += 1
+            name = f"d{self._n_sym}"
+        if name not in self._sym_dims:
+            sym, = jax_export.symbolic_shape(name, scope=self._sym_scope)
+            self._sym_dims[name] = sym
+        return self._sym_dims[name]
 
     # -- trace lifecycle ----------------------------------------------------
     def _ensure_trace(self):
@@ -132,18 +151,26 @@ class Program:
         if name in self._feeds:
             raise ValueError(f"static.data name {name!r} already declared "
                              f"in this Program")
-        for s in shape:
-            if s is None or (isinstance(s, int) and s < 0):
-                raise ValueError(
-                    f"static.data({name!r}, shape={list(shape)}): dynamic "
-                    f"dims are not supported — the compiled program is a "
-                    f"fixed-shape XLA executable and batch-dependent "
-                    f"constants (e.g. mean's divisor) would bake wrong. "
-                    f"Declare the concrete batch size (one Program per "
-                    f"batch shape), or use paddle.jit.to_static, which "
-                    f"retraces per shape.")
+        dims = []
+        for ax, s in enumerate(shape):
+            if s is None or isinstance(s, str) or \
+                    (isinstance(s, int) and s < 0):
+                # dynamic dim -> a jax.export symbolic dimension: ops trace
+                # shape-polymorphically (mean's divisor etc. stay symbolic)
+                # and Executor.run refines per concrete feed (batch >= 1;
+                # symbolic dims cannot be zero). Training (minimize) still
+                # requires concrete shapes — _build_runner raises there.
+                # Axis 0 shares ONE "batch" symbol across feeds so
+                # x + y / paired input-label programs combine; other axes
+                # get fresh symbols unless named via a string dim.
+                dims.append(self._sym_dim(
+                    s if isinstance(s, str) else
+                    ("batch" if ax == 0 else None)))
+                self._symbolic = True
+            else:
+                dims.append(int(s))
         dt = dtypes.dtype_from_any(dtype)
-        aval = jcore.ShapedArray(tuple(int(s) for s in shape), dt.np_dtype)
+        aval = jcore.ShapedArray(tuple(dims), dt.np_dtype)
         tracer = self._ensure_trace().new_arg(
             aval, source_info=source_info_util.current())
         t = Tensor(tracer, stop_gradient=True, name=name)
@@ -218,10 +245,7 @@ class Program:
         used_names = [n for n, u in zip(self._feed_order, used_invars) if u]
         return jaxpr, consts, used_names
 
-    def _build_runner(self, fetch_list, train):
-        """Compile (feeds) -> fetches [+ param/opt updates via to_static]."""
-        from ..jit.api import to_static
-
+    def _resolve_fetches(self, fetch_list):
         fetch_info = []               # (kind, payload) per fetch entry
         out_tracers = []
         for f in fetch_list:
@@ -237,6 +261,22 @@ class Program:
             else:
                 raise TypeError(f"cannot fetch {type(f).__name__}: "
                                 f"{f!r} is not part of this Program")
+        return fetch_info, out_tracers
+
+    def _build_runner(self, fetch_list, train):
+        """Compile (feeds) -> fetches [+ param/opt updates via to_static]."""
+        from ..jit.api import to_static
+
+        if self._symbolic:
+            if train:
+                raise ValueError(
+                    "minimize() requires concrete static.data shapes; "
+                    "dynamic (None) dims support fetch-only execution — "
+                    "declare the batch size to train, or train through "
+                    "paddle.jit.to_static")
+            return self._build_symbolic_runner(fetch_list)
+
+        fetch_info, out_tracers = self._resolve_fetches(fetch_list)
         n_fetch = len(out_tracers)
         loss_idx = None
         if train:
@@ -272,33 +312,12 @@ class Program:
         # training can update params, (b) later eager updates stay visible,
         # (c) state threads run-to-run instead of restarting at its
         # initialization value
-        # the jaxpr consts hold the arrays seen at TRACE time; a parameter
-        # trained before this build (e.g. an eval clone compiled after
-        # training) has a different CURRENT array, so match on the
-        # creation-time snapshot as well as the live one
+        # consts are matched against creation-time snapshots too: an eval
+        # clone compiled after training sees new p._d arrays
         plist = (self._opt._parameter_list if train and self._opt
                  else self._params)
-        p_cand = {id(p._d): p for p in plist}
-        for q, init in self._param_init:
-            if any(q is p for p in plist):
-                p_cand.setdefault(id(init), q)
-        s_cand = {id(init): tid for tid, _, init, _ in state_items}
-        lifted, lift_vars, kept_vars, kept_consts = [], [], [], []
-        seen_lift = set()
-        for v, c in zip(jaxpr.constvars, consts):
-            p = p_cand.get(id(c))
-            tid = s_cand.get(id(c))
-            if p is not None and id(p) not in seen_lift:
-                seen_lift.add(id(p))
-                lifted.append(("param", p))
-                lift_vars.append(v)
-            elif tid is not None and ("s", tid) not in seen_lift:
-                seen_lift.add(("s", tid))
-                lifted.append(("state", tid))
-                lift_vars.append(v)
-            else:
-                kept_vars.append(v)
-                kept_consts.append(c)
+        lifted, lift_vars, kept_vars, kept_consts = self._lift_consts(
+            jaxpr, consts, plist)
         # remaining consts become explicit per-call inputs too: leaving
         # them as closure constants makes jax hoist them as hidden jit
         # parameters, which breaks the C++ fastpath on repeat executions
@@ -461,6 +480,120 @@ class Program:
         from ..jit.save_load import _write_payload
         _write_payload(path_prefix, payload)
         self._text = payload["stablehlo"]
+
+    def _lift_consts(self, jaxpr, consts, plist):
+        """Match jaxpr consts against parameters (live or creation-time
+        arrays) and threaded-state initials. Returns (lifted entries,
+        lift vars, kept constvars, kept consts) — callers decide how the
+        kept consts enter the rebuilt jaxpr. Shared by the compiled
+        runner and the symbolic/export paths."""
+        p_cand = {id(p._d): p for p in plist}
+        for q, init in self._param_init:
+            if any(q is p for p in plist):
+                p_cand.setdefault(id(init), q)
+        s_cand = {}
+        for tid, (t, init) in self._state.initial.items():
+            self._state_shadow.setdefault(tid, Tensor(init))
+            s_cand[id(init)] = tid
+        lifted, lift_vars, kept_vars, kept_consts = [], [], [], []
+        seen_lift = set()
+        for v, c in zip(jaxpr.constvars, consts):
+            p = p_cand.get(id(c))
+            tid = s_cand.get(id(c))
+            if p is not None and id(p) not in seen_lift:
+                seen_lift.add(id(p))
+                lifted.append(("param", p))
+                lift_vars.append(v)
+            elif tid is not None and ("s", tid) not in seen_lift:
+                seen_lift.add(("s", tid))
+                lifted.append(("state", tid))
+                lift_vars.append(v)
+            else:
+                kept_vars.append(v)
+                kept_consts.append(c)
+        return lifted, lift_vars, kept_vars, kept_consts
+
+    def _build_symbolic_runner(self, fetch_list):
+        """Runner for programs with dynamic (None) feed dims: the pruned
+        jaxpr is exported shape-polymorphically (jax.export over this
+        Program's symbolic scope) and refined per concrete batch at call
+        time. Parameters and read state lift to inputs (live values stay
+        visible); state WRITES are not threaded on this path — a symbolic
+        program is a fetch/serving surface, not a train loop."""
+        from jax import export as jax_export
+
+        fetch_info, out_tracers = self._resolve_fetches(fetch_list)
+        jaxpr, consts, used_names = self._close_pruned(out_tracers)
+        if self._state.written and not self._warned_state:
+            self._warned_state = True
+            import warnings
+            warnings.warn(
+                "this dynamic-dim Program mutates state (e.g. BatchNorm "
+                "running stats); the symbolic fetch path does NOT thread "
+                "those writes — stats stay at their current values. Use "
+                "concrete shapes if the mutation must persist.",
+                RuntimeWarning, stacklevel=4)
+        shadows = self._state_shadow
+        lifted, lift_vars, kept_vars, kept_consts = self._lift_consts(
+            jaxpr, consts, self._params)
+        jaxpr = jaxpr.replace(constvars=kept_vars,
+                              invars=lift_vars + list(jaxpr.invars))
+        replay = jcore.jaxpr_as_fun(jcore.ClosedJaxpr(jaxpr, kept_consts))
+
+        def read_lifted():
+            vals = []
+            for kind, key in lifted:
+                vals.append(key._d if kind == "param" else shadows[key]._d)
+            return vals
+
+        lift_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in read_lifted()]
+        feed_specs = [jax.ShapeDtypeStruct(self._feeds[n]._d.aval.shape,
+                                           self._feeds[n]._d.aval.dtype)
+                      for n in used_names]
+        with suspend_trace():
+            exported = jax_export.export(
+                jax.jit(lambda *a: tuple(replay(*a))))(
+                    *lift_specs, *feed_specs)
+
+        def runner(feed: dict):
+            missing = [n for n in used_names if n not in (feed or {})]
+            if missing:
+                raise KeyError(f"Executor.run: feed is missing {missing} "
+                               f"(required by the requested fetch_list)")
+            args = list(read_lifted())
+            for n in used_names:
+                want = self._feeds[n]
+                arr = feed[n]
+                arr = arr._data if isinstance(arr, Tensor) else jnp.asarray(
+                    np.asarray(arr))
+                decl = want._d.aval.shape
+                if arr.ndim != len(decl):
+                    raise ValueError(
+                        f"feed {n!r}: rank {arr.ndim} does not match "
+                        f"declared shape {tuple(decl)}")
+                for ax, d in enumerate(decl):
+                    if isinstance(d, int) and arr.shape[ax] != d:
+                        raise ValueError(
+                            f"feed {n!r}: dim {ax} is {arr.shape[ax]}, "
+                            f"declared {d}")
+                    if not isinstance(d, int) and arr.shape[ax] == 0:
+                        raise ValueError(
+                            f"feed {n!r}: dynamic dim {ax} cannot be 0 "
+                            f"(jax.export symbolic dims are >= 1); skip "
+                            f"empty batches before Executor.run")
+                args.append(arr.astype(want._d.dtype))
+            with suspend_trace():
+                outs = exported.call(*args)
+            res = []
+            for kind, payload in fetch_info:
+                if kind == "traced":
+                    res.append(np.asarray(outs[payload]))
+                else:
+                    res.append(payload.numpy())
+            return res
+
+        return runner
 
     def _by_name(self, name):
         for t in self._feeds.values():

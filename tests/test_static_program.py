@@ -58,11 +58,11 @@ def test_feed_pruning_only_requires_needed_inputs():
                 fetch_list=[y])
 
 
-def test_dynamic_dims_rejected():
+def test_dynamic_dims_declare_symbolically():
     main = static.Program()
     with static.program_guard(main, static.Program()):
-        with pytest.raises(ValueError, match="dynamic dims"):
-            static.data("x", [None, 3], "float32")
+        t = static.data("x", [None, 3], "float32")
+    assert t.shape[1] == 3  # the batch dim is symbolic, the rest concrete
 
 
 def test_linear_regression_minimize_trains():
@@ -274,3 +274,79 @@ def test_save_inference_model_missing_feed_raises(tmp_path):
     with pytest.raises(ValueError, match="depend on feeds"):
         static.save_inference_model(str(tmp_path / "m"), [a], [out],
                                     program=main)
+
+
+def test_dynamic_batch_fetch_only():
+    """static.data(None, ...) supports fetch-only execution: one Program
+    serves any batch size, with batch-dependent reductions (mean divisor)
+    computed symbolically, and trained-parameter updates visible."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        out = static.nn.fc(x, 2)
+        m = out.mean()
+    exe = static.Executor()
+    exe.run(startup)
+    for bs in (2, 7):
+        xv = np.ones((bs, 3), np.float32)
+        ov, mv = exe.run(main, feed={"x": xv}, fetch_list=[out, m])
+        assert ov.shape == (bs, 2)
+        np.testing.assert_allclose(float(mv), ov.mean(), rtol=1e-6)
+    # live parameter updates are visible to later runs
+    w = main._params[0]
+    w.set_value(np.zeros(w.shape, np.float32))
+    ov, = exe.run(main, feed={"x": np.ones((4, 3), np.float32)},
+                  fetch_list=[out])
+    b = main._params[1].numpy()
+    np.testing.assert_allclose(ov, np.broadcast_to(np.asarray(b), (4, 2)),
+                               atol=1e-6)
+
+
+def test_dynamic_batch_minimize_rejected():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        loss = static.nn.fc(x, 1).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    with pytest.raises(ValueError, match="concrete"):
+        exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                fetch_list=[loss])
+
+
+def test_dynamic_batch_save_inference_model(tmp_path):
+    """A None-batch Program exports batch-polymorphically: the served
+    artifact accepts any batch size."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        pred = static.nn.fc(x, 2)
+    prefix = str(tmp_path / "dyn")
+    static.save_inference_model(prefix, [x], [pred], program=main)
+    loaded = static.load_inference_model(prefix)
+    exe = static.Executor()
+    for bs in (1, 6):
+        (out,) = exe.run(loaded, feed={"x": np.ones((bs, 4), np.float32)})
+        assert np.asarray(out).shape == (bs, 2)
+
+
+def test_dynamic_batch_feeds_combine_and_validate():
+    """Two None-batch feeds share the batch symbol (input+label programs
+    combine); bad feeds produce diagnostics, not raw jax errors."""
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 3], "float32")
+        y = static.data("y", [None, 3], "float32")
+        err = ((x - y) ** 2).mean()
+    exe = static.Executor()
+    xv = np.ones((5, 3), np.float32)
+    (ev,) = exe.run(main, feed={"x": xv, "y": 2 * xv}, fetch_list=[err])
+    np.testing.assert_allclose(float(ev), 1.0, rtol=1e-6)
+    with pytest.raises(ValueError, match="rank"):
+        exe.run(main, feed={"x": np.ones(3, np.float32), "y": xv},
+                fetch_list=[err])
+    with pytest.raises(ValueError, match="cannot be 0"):
+        exe.run(main, feed={"x": np.ones((0, 3), np.float32),
+                            "y": np.ones((0, 3), np.float32)},
+                fetch_list=[err])
