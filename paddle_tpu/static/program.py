@@ -524,7 +524,10 @@ class Program:
 
         fetch_info, out_tracers = self._resolve_fetches(fetch_list)
         jaxpr, consts, used_names = self._close_pruned(out_tracers)
-        if self._state.written and not self._warned_state:
+        traced_writes = any(
+            isinstance(t._d, jcore.Tracer) or tid in self._state_tracer
+            for tid, t in self._state.written.items())
+        if traced_writes and not self._warned_state:
             self._warned_state = True
             import warnings
             warnings.warn(
