@@ -350,3 +350,27 @@ def test_dynamic_batch_feeds_combine_and_validate():
         exe.run(main, feed={"x": np.ones((0, 3), np.float32),
                             "y": np.ones((0, 3), np.float32)},
                 fetch_list=[err])
+
+
+def test_control_flow_inside_program():
+    """static.nn.cond / while_loop compose with Program recording: the
+    lax control flow traces into the Program's jaxpr and compiles."""
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [4], "float32")
+        total = x.sum()
+        branched = static.nn.cond(total > 0,
+                                  lambda: x * 2.0,
+                                  lambda: x - 10.0)
+        i, acc = static.nn.while_loop(
+            lambda i, acc: i < 3,
+            lambda i, acc: (i + 1, acc + x.sum()),
+            [paddle.to_tensor(0), paddle.to_tensor(0.0)])
+    exe = static.Executor()
+    xv = np.array([1, 2, 3, 4], np.float32)
+    bv, av = exe.run(main, feed={"x": xv}, fetch_list=[branched, acc])
+    np.testing.assert_allclose(bv, xv * 2)
+    np.testing.assert_allclose(float(av), 30.0)
+    xn = -xv
+    bv, = exe.run(main, feed={"x": xn}, fetch_list=[branched])
+    np.testing.assert_allclose(bv, xn - 10.0)  # data-dependent branch
