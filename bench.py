@@ -100,26 +100,26 @@ def run_llama_bench(dev):
     # rebuilt per attempt: a partially-run attempt leaves stepped weights
     # and an AMP-decorated optimizer behind.
     for batch in (4, 2):
-        paddle.seed(0)
-        model = Llama(cfg)
         try:
+            paddle.seed(0)
+            model = Llama(cfg)   # inside try: the retry's rebuild can OOM too
             tokens_per_s, final, breakdown = _train_throughput(
                 model, batch, seq, steps, warmup, cfg.vocab_size,
                 on_tpu=True)
             break
         except Exception as e:  # XlaRuntimeError: RESOURCE_EXHAUSTED
-            retriable = "RESOURCE_EXHAUSTED" in repr(e) or \
-                "Out of memory" in repr(e)
-            # the traceback's frames pin the failed attempt's model/opt
-            # buffers; drop it so the smaller-batch retry starts with the
-            # HBM actually freed
+            if "RESOURCE_EXHAUSTED" not in repr(e) and \
+                    "Out of memory" not in repr(e):
+                raise   # genuine bug: keep the full traceback
+            # retriable OOM: the traceback's frames pin the failed
+            # attempt's model/opt buffers; drop everything so the
+            # smaller-batch retry starts with the HBM actually freed
             last_msg = repr(e)[:500]
             e.__traceback__ = None
-            del e, model
+            model = None
+            del e
             import gc
             gc.collect()
-            if not retriable:
-                raise RuntimeError(f"llama bench failed: {last_msg}")
     else:
         raise RuntimeError(
             f"llama bench OOMed at every batch size: {last_msg}")

@@ -358,7 +358,10 @@ def test_shaped_op_case(name, ci):
                    for v in inputs.values()]
         out = op(*tensors)
         outs = out if isinstance(out, (tuple, list)) else (out,)
-        out_arrays = [np.asarray(o.numpy()) for o in outs]
+        flat_outs = []
+        for o in outs:   # e.g. histogramdd -> (hist, [edge, edge])
+            flat_outs.extend(o if isinstance(o, (tuple, list)) else [o])
+        out_arrays = [np.asarray(o.numpy()) for o in flat_outs]
 
         # shape rule
         _check_shape_rule(spec, case, inputs, [a.shape for a in out_arrays],
