@@ -30,6 +30,7 @@ from .auto_parallel import (  # noqa: F401
 )
 from .sharding_utils import mark_sharding, sharded_call  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
 from .meta_parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401

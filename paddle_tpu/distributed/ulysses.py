@@ -1,0 +1,77 @@
+"""Ulysses-style all-to-all sequence parallelism (SURVEY.md §5.7: the TPU
+build must deliver long-context scaling via ring attention OR all-to-all
+sequence parallelism — this module is the second strategy; the reference
+snapshot itself ships neither and leans on Megatron-SP + flash kernels).
+
+Where ring attention rotates K/V shards around the 'sep' axis (P-1 hops),
+Ulysses re-partitions ONCE: an all-to-all converts sequence-sharded
+activations [B, S/P, H, D] into head-sharded full-sequence activations
+[B, S, H/P, D], each device runs ordinary full attention over its head
+slice (the Pallas flash kernel on TPU — causal masking needs no ring
+bookkeeping), and a second all-to-all restores sequence sharding. Per
+device the two all-to-alls move the same O(S·H/P·D) volume as one ring
+pass but in 2 collectives instead of P-1 ppermutes — the better trade when
+heads divide P and the interconnect does fast all-to-alls (ICI).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..autograd.function import apply
+from .sharding_utils import sharded_call
+from .topology import get_mesh
+
+__all__ = ["ulysses_attention", "ulysses_attention_fn"]
+
+
+def _seq_to_heads(x, axis_name):
+    # [b, s/P, h, d] -> [b, s, h/P, d]
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def _heads_to_seq(x, axis_name):
+    # [b, s, h/P, d] -> [b, s/P, h, d]
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention_fn(q, k, v, causal=False, axis_name="sep"):
+    """Pure jax body; call inside shard_map with seq sharded on axis_name.
+
+    Requires q heads (and kv heads for GQA) divisible by the axis size."""
+    n = jax.lax.psum(1, axis_name)
+    if q.shape[2] % n:
+        raise ValueError(f"ulysses: {q.shape[2]} q heads not divisible by "
+                         f"sep={n}")
+    if k.shape[2] % n:
+        raise ValueError(f"ulysses: {k.shape[2]} kv heads not divisible by "
+                         f"sep={n} (shard GQA kv heads or use ring "
+                         f"attention)")
+    qh = _seq_to_heads(q, axis_name)
+    kh = _seq_to_heads(k, axis_name)
+    vh = _seq_to_heads(v, axis_name)
+    from ..ops.kernels import flash_attention as fa
+    # fa.flash_attention dispatches Pallas-vs-composite itself
+    out = fa.flash_attention(qh, kh, vh, causal=causal)
+    return _heads_to_seq(out, axis_name)
+
+
+def ulysses_attention(query, key, value, causal=False, axis_name="sep"):
+    """Framework entry: [B, S, H, D] tensors with S sharded over
+    `axis_name`. Falls back to plain SDPA when no mesh / sep degree 1."""
+    mesh = get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or \
+            mesh.shape[axis_name] <= 1:
+        from ..nn.functional import scaled_dot_product_attention
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal)
+    spec = P(None, axis_name, None, None)
+    body = sharded_call(
+        lambda q, k, v: ulysses_attention_fn(q, k, v, causal=causal,
+                                             axis_name=axis_name),
+        mesh, (spec, spec, spec), spec, axis_names=(axis_name,))
+    return apply(body, query, key, value, name="ulysses_attention")

@@ -129,12 +129,18 @@ def eig(x, name=None):
     x = as_tensor(x)
     import numpy as np
     w, v = np.linalg.eig(x.numpy())  # general eig: CPU (XLA lacks nonsymmetric eig on TPU)
-    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+    # always complex (reference paddle.linalg.eig contract): numpy returns
+    # FLOAT arrays when the spectrum happens to be all-real
+    ct = np.result_type(w.dtype, np.complex64)
+    return (Tensor(jnp.asarray(w.astype(ct, copy=False))),
+            Tensor(jnp.asarray(v.astype(ct, copy=False))))
 
 
 def eigvals(x, name=None) -> Tensor:
     import numpy as np
-    return Tensor(jnp.asarray(np.linalg.eigvals(as_tensor(x).numpy())))
+    w = np.linalg.eigvals(as_tensor(x).numpy())
+    ct = np.result_type(w.dtype, np.complex64)
+    return Tensor(jnp.asarray(w.astype(ct, copy=False)))
 
 
 def eigh(x, UPLO="L", name=None):

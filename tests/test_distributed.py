@@ -1399,3 +1399,36 @@ def test_xla_option_passes_change_compiled_program():
         "all-reduce-combiner", "fusion,all-reduce-combiner")
     assert "xla_cpu_enable_concurrency_optimized_scheduler" in \
         chained.xla_options  # comm_overlap's default bundle survived
+
+
+def test_ulysses_attention_matches_sdpa():
+    """All-to-all sequence parallelism (distributed/ulysses.py): seq-
+    sharded q/k/v over sep=8 must match dense attention exactly — the
+    second long-context strategy next to ring attention."""
+    paddle.seed(17)
+    hcg, _ = _init_fleet(sep=8)
+    b, s, h, d = 2, 32, 8, 8
+    q = paddle.randn([b, s, h, d])
+    k = paddle.randn([b, s, h, d])
+    v = paddle.randn([b, s, h, d])
+    out = dist.ulysses_attention(q, k, v, causal=True)
+    _reset_mesh()
+    ref = paddle.nn.functional.scaled_dot_product_attention(
+        q, k, v, is_causal=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_ulysses_attention_grads_and_head_check():
+    hcg, _ = _init_fleet(sep=4)
+    q = paddle.randn([1, 16, 4, 8])
+    q.stop_gradient = False
+    out = dist.ulysses_attention(q, q, q, causal=False)
+    out.sum().backward()
+    assert q.grad is not None
+    assert not np.allclose(q.grad.numpy(), 0)
+    # heads not divisible by sep -> loud error
+    bad = paddle.randn([1, 16, 3, 8])
+    with pytest.raises(Exception, match="divisible|heads"):
+        dist.ulysses_attention(bad, bad, bad)
+    _reset_mesh()
