@@ -71,9 +71,11 @@ def ring_attention_fn(q, k, v, causal=False, axis_name="sep"):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def ring_attention(query, key, value, causal=False, axis_name="sep"):
-    """Framework entry: [B, S, H, D] tensors with S sharded over `axis_name`.
-    Falls back to plain SDPA when no mesh / sep degree 1."""
+def _seq_parallel_entry(body_fn, name, query, key, value, causal,
+                        axis_name):
+    """Shared entry for the sequence-parallel attention strategies (ring,
+    ulysses): mesh/axis fallback to plain SDPA, shard_map over the sep
+    axis, framework apply()."""
     mesh = get_mesh()
     if mesh is None or axis_name not in mesh.axis_names or \
             mesh.shape[axis_name] <= 1:
@@ -82,7 +84,14 @@ def ring_attention(query, key, value, causal=False, axis_name="sep"):
                                             is_causal=causal)
     spec = P(None, axis_name, None, None)
     body = sharded_call(
-        lambda q, k, v: ring_attention_fn(q, k, v, causal=causal,
-                                          axis_name=axis_name),
+        lambda q, k, v: body_fn(q, k, v, causal=causal,
+                                axis_name=axis_name),
         mesh, (spec, spec, spec), spec, axis_names=(axis_name,))
-    return apply(body, query, key, value, name="ring_attention")
+    return apply(body, query, key, value, name=name)
+
+
+def ring_attention(query, key, value, causal=False, axis_name="sep"):
+    """Framework entry: [B, S, H, D] tensors with S sharded over `axis_name`.
+    Falls back to plain SDPA when no mesh / sep degree 1."""
+    return _seq_parallel_entry(ring_attention_fn, "ring_attention",
+                               query, key, value, causal, axis_name)

@@ -17,12 +17,8 @@ heads divide P and the interconnect does fast all-to-alls (ICI).
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
-from ..autograd.function import apply
-from .sharding_utils import sharded_call
-from .topology import get_mesh
+from .ring_attention import _seq_parallel_entry
 
 __all__ = ["ulysses_attention", "ulysses_attention_fn"]
 
@@ -63,15 +59,5 @@ def ulysses_attention_fn(q, k, v, causal=False, axis_name="sep"):
 def ulysses_attention(query, key, value, causal=False, axis_name="sep"):
     """Framework entry: [B, S, H, D] tensors with S sharded over
     `axis_name`. Falls back to plain SDPA when no mesh / sep degree 1."""
-    mesh = get_mesh()
-    if mesh is None or axis_name not in mesh.axis_names or \
-            mesh.shape[axis_name] <= 1:
-        from ..nn.functional import scaled_dot_product_attention
-        return scaled_dot_product_attention(query, key, value,
-                                            is_causal=causal)
-    spec = P(None, axis_name, None, None)
-    body = sharded_call(
-        lambda q, k, v: ulysses_attention_fn(q, k, v, causal=causal,
-                                             axis_name=axis_name),
-        mesh, (spec, spec, spec), spec, axis_names=(axis_name,))
-    return apply(body, query, key, value, name="ulysses_attention")
+    return _seq_parallel_entry(ulysses_attention_fn, "ulysses_attention",
+                               query, key, value, causal, axis_name)

@@ -52,33 +52,63 @@ def _unpack_int4(packed, n):
 
 
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
-    """[K, N] float weight -> (quantized weight, per-N-channel scales).
+    """[K, N] float weight -> (quantized weight, scales).
 
     int8: [K, N] int8. int4: [K, ceil(N/2)] int8 bytes holding two 4-bit
     values in the halves layout (see module docstring; framework-specific
-    — requantize rather than importing reference-packed int4 blobs)."""
+    — requantize rather than importing reference-packed int4 blobs).
+
+    `group_size` in {64, 128, ...}: scales become per-(K-group, channel)
+    [K/group_size, N] (the reference's grouped weight-only mode — finer
+    scales recover accuracy on outlier-heavy weights); -1 = one scale per
+    output channel."""
     _check_algo(algo)
-    if group_size not in (-1, None):
-        raise NotImplementedError("grouped scales are not supported yet; "
-                                  "use per-channel (group_size=-1)")
+    gs = -1 if group_size is None else int(group_size)
 
     def run(w):
-        if algo == "weight_only_int8":
-            return quantize_weight_int8(w, axis=1)
-        bound = 7.0
-        s = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-9)
-        q = jnp.clip(jnp.round(w / s * bound), -bound, bound)
-        return _pack_int4(q.astype(jnp.int8)), (s / bound).astype(jnp.float32)
+        bound = 127.0 if algo == "weight_only_int8" else 7.0
+        if gs == -1:
+            if algo == "weight_only_int8":
+                return quantize_weight_int8(w, axis=1)
+            s = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-9)
+            q = jnp.clip(jnp.round(w / s * bound), -bound, bound)
+            return (_pack_int4(q.astype(jnp.int8)),
+                    (s / bound).astype(jnp.float32))
+        k, n = w.shape
+        if k % gs:
+            raise ValueError(f"group_size {gs} must divide K={k}")
+        wg = w.reshape(k // gs, gs, n)
+        s = jnp.maximum(jnp.max(jnp.abs(wg), axis=1), 1e-9)  # [K/gs, N]
+        q = jnp.clip(jnp.round(wg / s[:, None] * bound), -bound, bound)
+        q = q.reshape(k, n).astype(jnp.int8)
+        scales = (s / bound).astype(jnp.float32)
+        if algo == "weight_only_int4":
+            return _pack_int4(q), scales
+        return q, scales
 
     return apply_multi(run, x, name="weight_quantize")
 
 
+def _dequant_grouped(q, s):
+    """[K, N] int8 x [K/gs, N] scales -> float (per-K-group scaling)."""
+    k, n = q.shape
+    gs = k // s.shape[0]
+    return (q.reshape(k // gs, gs, n).astype(s.dtype) * s[:, None]) \
+        .reshape(k, n)
+
+
 def weight_dequantize(x, scale, algo="weight_only_int8",
                       out_dtype="float32"):
-    """Inverse transform for inspection/tests."""
+    """Inverse transform for inspection/tests (per-channel [N] or grouped
+    [K/gs, N] scales)."""
     _check_algo(algo)
 
     def run(q, s):
+        if s.ndim == 2:
+            n = s.shape[1]
+            if algo == "weight_only_int4":
+                q = _unpack_int4(q, n)
+            return _dequant_grouped(q, s).astype(out_dtype)
         if algo == "weight_only_int4":
             q = _unpack_int4(q, s.shape[0])
         return q.astype(out_dtype) * s.astype(out_dtype)
@@ -100,7 +130,14 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         raise ValueError("weight_scale is required (from weight_quantize)")
 
     def run(xa, w, s, *maybe_bias):
-        if weight_dtype == "int4":
+        if s.ndim == 2:
+            # grouped scales: dequantize per K-group then one MXU matmul
+            # (the fused Pallas kernels cover the per-channel layout)
+            n = s.shape[1]
+            if weight_dtype == "int4":
+                w = _unpack_int4(w, n)
+            y = jnp.matmul(xa, _dequant_grouped(w, s).astype(xa.dtype))
+        elif weight_dtype == "int4":
             from ...quantization.functional import dequant_matmul_int4
             n, half = s.shape[0], w.shape[1]
             if 2 * half != n:   # odd N carries one zero pad column
