@@ -64,6 +64,9 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     output channel."""
     _check_algo(algo)
     gs = -1 if group_size is None else int(group_size)
+    if gs != -1 and gs < 1:
+        raise ValueError(f"group_size must be -1 (per-channel) or a "
+                         f"positive divisor of K, got {group_size}")
 
     def run(w):
         bound = 127.0 if algo == "weight_only_int8" else 7.0
@@ -91,10 +94,8 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
 
 def _dequant_grouped(q, s):
     """[K, N] int8 x [K/gs, N] scales -> float (per-K-group scaling)."""
-    k, n = q.shape
-    gs = k // s.shape[0]
-    return (q.reshape(k // gs, gs, n).astype(s.dtype) * s[:, None]) \
-        .reshape(k, n)
+    from ...ops.kernels.wo_matmul_pallas import dequant_grouped
+    return dequant_grouped(q, s).astype(s.dtype)
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8",
@@ -131,12 +132,12 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
 
     def run(xa, w, s, *maybe_bias):
         if s.ndim == 2:
-            # grouped scales: dequantize per K-group then one MXU matmul
-            # (the fused Pallas kernels cover the per-channel layout)
+            # grouped scales: the int8 kernel rescales per K-group in VMEM;
+            # int4 unpacks to int8 first (grouped-packed stays a composite)
             n = s.shape[1]
             if weight_dtype == "int4":
                 w = _unpack_int4(w, n)
-            y = jnp.matmul(xa, _dequant_grouped(w, s).astype(xa.dtype))
+            y = dequant_matmul_int8(xa, w, s)
         elif weight_dtype == "int4":
             from ...quantization.functional import dequant_matmul_int4
             n, half = s.shape[0], w.shape[1]

@@ -35,6 +35,21 @@ def _wo_kernel(x_ref, w_ref, s_ref, o_ref):
     o_ref[...] = (acc * s_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
 
 
+def _wo_g_kernel(x_ref, w_ref, s_ref, o_ref, *, gsize):
+    """Grouped scales: w [K, bn] int8, s [K/gsize, bn] — the per-K-group
+    rescale applies to the WEIGHT before the contraction (a post-matmul
+    rescale cannot express it), via a sublane-split reshape in VMEM."""
+    x = x_ref[...]
+    w = w_ref[...].astype(jnp.float32)               # [K, bn]
+    s = s_ref[...].astype(jnp.float32)               # [K/gsize, bn]
+    k, bn = w.shape
+    wd = (w.reshape(k // gsize, gsize, bn) * s[:, None, :]) \
+        .reshape(k, bn).astype(x.dtype)
+    acc = jax.lax.dot_general(x, wd, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    o_ref[...] = acc.astype(o_ref.dtype)
+
+
 def _pick_blocks(m, k, n, itemsize):
     """(bm, bn) blocks under the VMEM budget with full-K streaming. The row
     block goes through the shared pick_row_block so it is capped at the
@@ -50,27 +65,57 @@ def _pick_blocks(m, k, n, itemsize):
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def wo_int8_matmul(x, w_q, scales, interpret=False):
-    """[.., K] @ int8 [K, N] * scales [N] -> [.., N] in x.dtype."""
+    """[.., K] @ int8 [K, N] * scales -> [.., N] in x.dtype.
+
+    `scales` is [N] (per output channel) or [K/G, N] (grouped — the
+    per-K-group rescale happens in VMEM before the MXU contraction, so
+    the dequantized weight never touches HBM)."""
     if w_q.dtype != jnp.int8:
         raise ValueError(f"weight must be int8, got {w_q.dtype}")
     lead = x.shape[:-1]
     k, n = w_q.shape
+    grouped = scales.ndim == 2
+    if grouped and k % scales.shape[0]:
+        raise ValueError(f"grouped scales rows {scales.shape[0]} must "
+                         f"divide K={k}")
     x2 = x.reshape(-1, k)
     m = x2.shape[0]
     bm, bn = _pick_blocks(m, k, n, jnp.dtype(x.dtype).itemsize)
     x2 = pad_to_block(x2, bm, axis=0)
     w_p = pad_to_block(w_q, bn, axis=1)
-    s_p = pad_to_block(scales.reshape(1, n), bn, axis=1)
     mp, np_ = x2.shape[0], w_p.shape[1]
+
+    if grouped:
+        # the grouped kernel holds the int8 block PLUS an f32 dequant copy
+        # plus its x-dtype cast in VMEM: budget for the expansion, and fall
+        # back to the composite (trace-time ValueError, caught by the
+        # dispatch) when even bn=128 cannot fit
+        per_byte = 5 + jnp.dtype(x.dtype).itemsize
+        if k * bn * per_byte > 6 * 1024 * 1024:
+            bn = 128
+        if k * bn * per_byte > 6 * 1024 * 1024:
+            raise ValueError(
+                f"grouped int8 kernel weight block cannot fit VMEM at "
+                f"K={k}; use the composite path")
+        w_p = pad_to_block(w_q, bn, axis=1)
+        np_ = w_p.shape[1]
+        gsize = k // scales.shape[0]
+        s_p = pad_to_block(scales, bn, axis=1)
+        kern = functools.partial(_wo_g_kernel, gsize=gsize)
+        s_spec = pl.BlockSpec((k // gsize, bn), lambda mi, ni: (0, ni))
+    else:
+        kern = _wo_kernel
+        s_p = pad_to_block(scales.reshape(1, n), bn, axis=1)
+        s_spec = pl.BlockSpec((1, bn), lambda mi, ni: (0, ni))
 
     with jax.enable_x64(False):
         out = pl.pallas_call(
-            _wo_kernel,
+            kern,
             grid=(mp // bm, np_ // bn),
             in_specs=[
                 pl.BlockSpec((bm, k), lambda mi, ni: (mi, 0)),
                 pl.BlockSpec((k, bn), lambda mi, ni: (0, ni)),
-                pl.BlockSpec((1, bn), lambda mi, ni: (0, ni)),
+                s_spec,
             ],
             out_specs=pl.BlockSpec((bm, bn), lambda mi, ni: (mi, ni)),
             out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
@@ -79,8 +124,20 @@ def wo_int8_matmul(x, w_q, scales, interpret=False):
     return out[:m, :n].reshape(*lead, n)
 
 
+def dequant_grouped(w_q, scales):
+    """Canonical grouped dequant: [K, N] int8 x [K/G, N] scales -> f32
+    (the single definition the composites, VJP, and layers share)."""
+    k, n = w_q.shape
+    g = k // scales.shape[0]
+    return (w_q.reshape(k // g, g, n).astype(jnp.float32)
+            * scales[:, None, :].astype(jnp.float32)).reshape(k, n)
+
+
 def reference_wo_int8_matmul(x, w_q, scales):
-    """XLA composite (quantization.functional.dequant_matmul_int8)."""
+    """XLA composite (quantization.functional.dequant_matmul_int8);
+    handles per-channel [N] and grouped [K/G, N] scales."""
+    if scales.ndim == 2:
+        return jnp.matmul(x, dequant_grouped(w_q, scales).astype(x.dtype))
     y = jnp.matmul(x, w_q.astype(x.dtype))
     return y * scales.astype(x.dtype)
 

@@ -68,9 +68,17 @@ def dequant_matmul_int4(x, w_packed, scales):
     """x @ dequant(int4-packed w) * scales — packed bytes stay packed in
     HBM (half of int8's footprint and read traffic); the Pallas kernel
     sign-extends nibbles in VMEM (halves layout, see wo_matmul_pallas).
-    Accepts framework Tensors or raw arrays."""
+    Accepts framework Tensors or raw arrays. Per-channel scales only:
+    grouped int4 goes through weight_only_linear, which unpacks to dense
+    int8 first (a grouped broadcast here would be silently wrong)."""
     unwrap = lambda t: t._data if hasattr(t, "_data") else t
-    return _dq4_mm(unwrap(x), unwrap(w_packed), unwrap(scales))
+    s = unwrap(scales)
+    if getattr(s, "ndim", 1) == 2:
+        raise ValueError(
+            "dequant_matmul_int4 takes per-channel [N] scales; for grouped "
+            "[K/G, N] scales use nn.quant.weight_only_linear, which "
+            "unpacks the int4 weight to dense int8 for the grouped path")
+    return _dq4_mm(unwrap(x), unwrap(w_packed), s)
 
 
 _WO_WARNED: set = set()   # per-kernel-label warn-once
@@ -99,14 +107,28 @@ def _wo_dispatch(label, kernel_call, composite_call):
 
 
 def _wo_bwd_math(x, w_dense, scales, g):
-    """Shared weight-only VJP: y = (x @ w) * s.
+    """Shared weight-only VJP.
 
-    dx = (g * s) @ w^T. ds needs the PRE-scale product u = x @ w:
-    recompute it exactly in f32 — dividing the saved primal by the scales
-    would be wrong for a zero scale (the public API accepts arbitrary user
-    scales) and noisy for bf16 outputs; when the scale cotangent is unused
-    (the common inference/QAT-x-only case under jit) XLA dead-code-
-    eliminates this matmul entirely."""
+    Per-channel (s [N]): y = (x @ w) * s — dx = (g * s) @ w^T; ds needs
+    the PRE-scale product u = x @ w, recomputed exactly in f32 (dividing
+    the saved primal by the scales would be wrong for a zero scale, and
+    when the scale cotangent is unused XLA dead-code-eliminates the
+    recompute).
+    Grouped (s [K/G, N]): y = x @ (w ⊙ s_expanded) —
+    dx = g @ (w ⊙ s)^T; ds[kg, n] = Σ_{k∈group} (x^T g)[k, n] · w[k, n]."""
+    if scales.ndim == 2:
+        from ..ops.kernels.wo_matmul_pallas import dequant_grouped
+        k, n = w_dense.shape
+        grp = k // scales.shape[0]
+        w32 = w_dense.astype(jnp.float32)
+        wd = dequant_grouped(w_dense, scales)
+        dx = jnp.matmul(g.astype(jnp.float32), jnp.swapaxes(wd, 0, 1))
+        xtg = jnp.matmul(
+            jnp.swapaxes(x.reshape(-1, k).astype(jnp.float32), 0, 1),
+            g.reshape(-1, n).astype(jnp.float32))       # [K, N]
+        ds = (xtg * w32).reshape(k // grp, grp, n).sum(1) \
+            .astype(scales.dtype)
+        return dx.astype(x.dtype), ds
     gs = g * scales.astype(g.dtype)
     dx = jnp.matmul(gs, jnp.swapaxes(w_dense.astype(g.dtype), 0, 1))
     u = jnp.matmul(x.astype(jnp.float32), w_dense.astype(jnp.float32))

@@ -269,6 +269,14 @@ def main():
         lambda a: wm.reference_wo_int8_matmul(a, wq, sc),
         (xw,), tol=5e-2)
 
+    # 9a'. grouped-scale int8 weight-only matmul (rescale in VMEM)
+    scg = jnp.asarray(rng.random((kk // 128, nn_)) * 0.01, jnp.float32)
+    fam["wo_int8_grouped_matmul"] = run_family(
+        "wo_int8_grouped_matmul",
+        lambda a: wm.wo_int8_matmul(a, wq, scg, interpret=interp),
+        lambda a: wm.reference_wo_int8_matmul(a, wq, scg),
+        (xw,), tol=5e-2)
+
     # 9b. int4 weight-only matmul (packed halves layout)
     wq4 = jnp.asarray(rng.integers(-127, 127, (kk, nn_ // 2)), jnp.int8)
     sc4 = jnp.asarray(rng.random(nn_) * 0.01, jnp.float32)
