@@ -5,9 +5,14 @@ from the framework generator so paddle.seed governs reproducibility.
 """
 
 from .distributions import (  # noqa: F401
-    Distribution, Normal, Uniform, Categorical, Bernoulli, Exponential,
-    Beta, Gumbel, Laplace, kl_divergence, register_kl)
+    AffineTransform, Bernoulli, Beta, Categorical, Cauchy, Dirichlet,
+    Distribution, Exponential, ExpTransform, Geometric, Gumbel, Independent,
+    Laplace, LogNormal, Multinomial, Normal, SigmoidTransform, Transform,
+    TransformedDistribution, Uniform, kl_divergence, register_kl)
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
-           "Exponential", "Beta", "Gumbel", "Laplace", "kl_divergence",
+           "Exponential", "Beta", "Gumbel", "Laplace", "Cauchy", "Geometric",
+           "LogNormal", "Dirichlet", "Multinomial", "Independent",
+           "Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "TransformedDistribution", "kl_divergence",
            "register_kl"]

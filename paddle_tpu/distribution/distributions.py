@@ -300,3 +300,332 @@ def _kl_uniform_uniform(p, q):
 def _kl_exp_exp(p, q):
     r = q.rate / p.rate
     return Tensor(jnp.log(1 / r) + r - 1)
+
+
+class Cauchy(Distribution):
+    """Reference distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self._loc_t = as_tensor(loc)
+        self._scale_t = as_tensor(scale)
+
+    @property
+    def loc(self):
+        return self._loc_t._data
+
+    @property
+    def scale(self):
+        return self._scale_t._data
+
+    def sample(self, shape=()):
+        base = jnp.shape(self.loc + self.scale)
+        u = jax.random.uniform(_key(), tuple(shape) + base,
+                               minval=1e-6, maxval=1 - 1e-6)
+        return apply(lambda m, s: m + s * jnp.tan(math.pi * (u - 0.5)),
+                     self._loc_t, self._scale_t, name="cauchy_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return apply(
+            lambda x, m, s: -jnp.log(math.pi * s * (1 + ((x - m) / s) ** 2)),
+            as_tensor(value), self._loc_t, self._scale_t,
+            name="cauchy_log_prob")
+
+    def entropy(self):
+        return apply(lambda m, s: jnp.broadcast_to(
+            jnp.log(4 * math.pi * s), jnp.shape(m + s)),
+            self._loc_t, self._scale_t, name="cauchy_entropy")
+
+
+class Geometric(Distribution):
+    """Reference distribution/geometric.py: trials until first success,
+    support {0, 1, 2, ...}."""
+
+    def __init__(self, probs, name=None):
+        self._probs_t = as_tensor(probs)
+
+    @property
+    def probs(self):
+        return self._probs_t._data
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(),
+                               tuple(shape) + jnp.shape(self.probs),
+                               minval=1e-7, maxval=1 - 1e-7)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        return apply(lambda k, p: k * jnp.log1p(-p) + jnp.log(p),
+                     as_tensor(value), self._probs_t,
+                     name="geometric_log_prob")
+
+    def entropy(self):
+        p = self._probs_t
+        return apply(
+            lambda p: -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p,
+            p, name="geometric_entropy")
+
+
+class LogNormal(Distribution):
+    """Reference distribution/lognormal.py: exp of a Normal."""
+
+    def __init__(self, loc, scale, name=None):
+        self._base = Normal(loc, scale)
+
+    @property
+    def loc(self):
+        return self._base.loc
+
+    @property
+    def scale(self):
+        return self._base.scale
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + 0.5 * self._base.scale ** 2))
+
+    def rsample(self, shape=()):
+        return apply(lambda z: jnp.exp(z), self._base.rsample(shape),
+                     name="lognormal_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = as_tensor(value)
+        return apply(
+            lambda x, m, s: -((jnp.log(x) - m) ** 2) / (2 * s ** 2)
+            - jnp.log(s * x) - 0.5 * math.log(2 * math.pi),
+            v, self._base._loc_t, self._base._scale_t,
+            name="lognormal_log_prob")
+
+    def entropy(self):
+        return apply(
+            lambda m, s: jnp.broadcast_to(
+                m + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                jnp.shape(m + s)),
+            self._base._loc_t, self._base._scale_t,
+            name="lognormal_entropy")
+
+
+class Dirichlet(Distribution):
+    """Reference distribution/dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self._conc_t = as_tensor(concentration)
+
+    @property
+    def concentration(self):
+        return self._conc_t._data
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return Tensor(c / jnp.sum(c, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        batch = jnp.shape(self.concentration)[:-1]
+        return Tensor(jax.random.dirichlet(
+            _key(), self.concentration, tuple(shape) + batch))
+
+    def log_prob(self, value):
+        def f(x, c):
+            lognorm = jnp.sum(jax.lax.lgamma(c), -1) \
+                - jax.lax.lgamma(jnp.sum(c, -1))
+            return jnp.sum((c - 1) * jnp.log(x), -1) - lognorm
+        return apply(f, as_tensor(value), self._conc_t,
+                     name="dirichlet_log_prob")
+
+    def entropy(self):
+        def f(c):
+            k = c.shape[-1]
+            c0 = jnp.sum(c, -1)
+            lognorm = jnp.sum(jax.lax.lgamma(c), -1) - jax.lax.lgamma(c0)
+            return (lognorm + (c0 - k) * jax.lax.digamma(c0)
+                    - jnp.sum((c - 1) * jax.lax.digamma(c), -1))
+        return apply(f, self._conc_t, name="dirichlet_entropy")
+
+
+class Multinomial(Distribution):
+    """Reference distribution/multinomial.py: counts over k categories in
+    `total_count` draws."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self._probs_t = as_tensor(probs)
+
+    @property
+    def probs(self):
+        return self._probs_t._data
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.probs, 1e-30))
+        draws = jax.random.categorical(
+            _key(), logits, shape=tuple(shape) + (self.total_count,)
+            + jnp.shape(self.probs)[:-1])
+        k = jnp.shape(self.probs)[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        axis = len(tuple(shape))  # the draw axis
+        return Tensor(jnp.sum(onehot, axis=axis))
+
+    def log_prob(self, value):
+        def f(x, p):
+            x = x.astype(p.dtype)   # counts arrive as ints; lgamma is float
+            logc = (jax.lax.lgamma(jnp.float32(self.total_count + 1))
+                    - jnp.sum(jax.lax.lgamma(x + 1), -1))
+            return logc + jnp.sum(x * jnp.log(jnp.maximum(p, 1e-30)), -1)
+        return apply(f, as_tensor(value), self._probs_t,
+                     name="multinomial_log_prob")
+
+
+class Independent(Distribution):
+    """Reference distribution/independent.py: reinterpret the rightmost
+    `reinterpreted_batch_rank` batch dims as event dims (log_prob sums
+    over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1, name=None):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return apply(
+            lambda a: jnp.sum(a, axis=tuple(range(-self.rank, 0))), lp,
+            name="independent_log_prob")
+
+    def entropy(self):
+        e = self.base.entropy()
+        return apply(
+            lambda a: jnp.sum(a, axis=tuple(range(-self.rank, 0))), e,
+            name="independent_entropy")
+
+
+class Transform:
+    """Reference distribution/transform.py base: forward/inverse +
+    log|det J|."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = as_tensor(loc)
+        self.scale = as_tensor(scale)
+
+    def forward(self, x):
+        return apply(lambda x, m, s: m + s * x, as_tensor(x), self.loc,
+                     self.scale, name="affine_fwd")
+
+    def inverse(self, y):
+        return apply(lambda y, m, s: (y - m) / s, as_tensor(y), self.loc,
+                     self.scale, name="affine_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda x, s: jnp.broadcast_to(
+            jnp.log(jnp.abs(s)), jnp.shape(x * s)), as_tensor(x),
+            self.scale, name="affine_logdet")
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return apply(lambda a: jnp.exp(a), as_tensor(x), name="exp_fwd")
+
+    def inverse(self, y):
+        return apply(lambda a: jnp.log(a), as_tensor(y), name="exp_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply(lambda a: a, as_tensor(x), name="exp_logdet")
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return apply(jax.nn.sigmoid, as_tensor(x), name="sigmoid_fwd")
+
+    def inverse(self, y):
+        return apply(lambda a: jnp.log(a) - jnp.log1p(-a), as_tensor(y),
+                     name="sigmoid_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply(
+            lambda a: -jax.nn.softplus(-a) - jax.nn.softplus(a),
+            as_tensor(x), name="sigmoid_logdet")
+
+
+class TransformedDistribution(Distribution):
+    """Reference distribution/transformed_distribution.py: push a base
+    distribution through a chain of bijectors; log_prob uses the
+    change-of-variables formula."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = list(transforms)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = as_tensor(value)
+        lp = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            lp = ld if lp is None else apply(
+                lambda a, b: a + b, lp, ld, name="td_logdet_acc")
+            y = x
+        base_lp = self.base.log_prob(y)
+        if lp is None:   # empty transform chain: just the base
+            return base_lp
+        return apply(lambda a, b: a - b, base_lp, lp, name="td_log_prob")
+
+
+__all__ += ["Cauchy", "Geometric", "LogNormal", "Dirichlet", "Multinomial",
+            "Independent", "Transform", "AffineTransform", "ExpTransform",
+            "SigmoidTransform", "TransformedDistribution"]
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geo_geo(p, q):
+    pp, qq = p.probs, q.probs
+    return Tensor(jnp.log(pp / qq)
+                  + (1 - pp) / pp * jnp.log((1 - pp) / (1 - qq)))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))

@@ -92,12 +92,6 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     return apply_multi(run, x, name="weight_quantize")
 
 
-def _dequant_grouped(q, s):
-    """[K, N] int8 x [K/gs, N] scales -> float (per-K-group scaling)."""
-    from ...ops.kernels.wo_matmul_pallas import dequant_grouped
-    return dequant_grouped(q, s).astype(s.dtype)
-
-
 def weight_dequantize(x, scale, algo="weight_only_int8",
                       out_dtype="float32"):
     """Inverse transform for inspection/tests (per-channel [N] or grouped
@@ -106,10 +100,11 @@ def weight_dequantize(x, scale, algo="weight_only_int8",
 
     def run(q, s):
         if s.ndim == 2:
+            from ...ops.kernels.wo_matmul_pallas import dequant_grouped
             n = s.shape[1]
             if algo == "weight_only_int4":
                 q = _unpack_int4(q, n)
-            return _dequant_grouped(q, s).astype(out_dtype)
+            return dequant_grouped(q, s).astype(out_dtype)
         if algo == "weight_only_int4":
             q = _unpack_int4(q, s.shape[0])
         return q.astype(out_dtype) * s.astype(out_dtype)
