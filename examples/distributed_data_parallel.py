@@ -1,0 +1,48 @@
+"""Data-parallel training over every visible device (8 virtual CPU devices
+when run with XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+    python examples/distributed_data_parallel.py
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+
+
+def main(steps=20):
+    import jax
+    n = jax.device_count()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": n, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(32, 64), paddle.nn.GELU(),
+                               paddle.nn.Linear(64, 1))
+    model = paddle.DataParallel(net)
+    opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((64, 32)).astype(np.float32)
+    yv = xv.sum(-1, keepdims=True).astype(np.float32) * 0.1
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    first = last = None
+    for i in range(steps):
+        last = float(step(paddle.to_tensor(xv), paddle.to_tensor(yv)))
+        first = first if first is not None else last
+    print(f"dp={n}: loss {first:.4f} -> {last:.4f}")
+    assert last < first
+    return last
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Weight-only quantization for serving: train a layer, quantize int8 and
+packed int4 (per-channel and grouped scales), compare output error.
+
+    python examples/quantize_and_serve.py
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import quant as Q
+
+
+def main():
+    paddle.seed(0)
+    layer = nn.Linear(256, 64)
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (8, 256)).astype(np.float32))
+    ref = np.asarray(layer(x).numpy())
+    for algo, dt in (("weight_only_int8", "int8"),
+                     ("weight_only_int4", "int4")):
+        for gs in (-1, 64):
+            qw, s = Q.weight_quantize(layer.weight, algo=algo,
+                                      group_size=gs)
+            y = Q.weight_only_linear(x, qw, bias=layer.bias,
+                                     weight_scale=s, weight_dtype=dt)
+            rel = (np.abs(np.asarray(y.numpy()) - ref).max()
+                   / np.abs(ref).max())
+            print(f"{dt:5s} group_size={gs:>3}: weight bytes "
+                  f"{int(np.asarray(qw.numpy()).nbytes):6d}, "
+                  f"rel err {rel:.4f}")
+            assert rel < 0.3
+    return True
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""The reference's classic static-graph workflow, end to end: program_guard
++ static.data + minimize + Executor.run, then export for serving.
+
+    python examples/static_training.py
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def main(steps=80, tmpdir="/tmp/paddle_tpu_static_example"):
+    rng = np.random.default_rng(0)
+    W = rng.standard_normal((16, 4)).astype(np.float32)
+
+    def batch(bs=32, seed=None):
+        r = np.random.default_rng(seed)
+        x = r.standard_normal((bs, 16)).astype(np.float32)
+        return x, x.dot(W).argmax(-1).astype(np.int64).reshape(bs, 1)
+
+    main_prog, startup = static.Program(), static.Program()
+    with static.program_guard(main_prog, startup):
+        x = static.data("x", [32, 16], "float32")
+        y = static.data("y", [32, 1], "int64")
+        hidden = static.nn.fc(x, 64, activation="relu")
+        logits = static.nn.fc(hidden, 4)
+        loss = paddle.nn.functional.cross_entropy(logits, y.reshape([32]))
+        paddle.optimizer.Adam(learning_rate=0.02).minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    for i in range(steps):
+        xv, yv = batch(seed=i)
+        (lv,) = exe.run(main_prog, feed={"x": xv, "y": yv},
+                        fetch_list=[loss])
+        if i % 20 == 0:
+            print(f"step {i:4d}  loss {float(lv):.4f}")
+
+    static.save_inference_model(f"{tmpdir}/model", [x], [logits], exe,
+                                program=main_prog)
+    served = static.load_inference_model(f"{tmpdir}/model")
+    xv, yv = batch(seed=999)
+    (out,) = exe.run(served, feed={"x": xv})
+    acc = (np.asarray(out).argmax(-1) == yv.ravel()).mean()
+    print(f"served accuracy: {acc:.3f}")
+    assert acc > 0.8
+    return acc
+
+
+if __name__ == "__main__":
+    main()

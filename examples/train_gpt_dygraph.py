@@ -1,0 +1,50 @@
+"""Minimal dygraph training loop: GPT-2 on synthetic ids (the reference's
+dygraph workflow, runnable on one chip or CPU).
+
+    python examples/train_gpt_dygraph.py [--steps N]
+"""
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPT, GPTConfig
+
+
+def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8):
+    paddle.seed(0)
+    model = GPT(GPTConfig(vocab_size=vocab, max_position_embeddings=seq,
+                          hidden_size=hidden, num_layers=layers,
+                          num_heads=4))
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
+                                 weight_decay=0.1)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, vocab, (4 * batch, seq + 1))
+
+    @paddle.jit.to_static
+    def step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    first = last = None
+    for i in range(steps):
+        chunk = data[(i % 4) * batch:(i % 4 + 1) * batch]
+        loss = float(step(paddle.to_tensor(chunk[:, :-1].astype(np.int32)),
+                          paddle.to_tensor(chunk[:, 1:].astype(np.int32))))
+        first = first if first is not None else loss
+        last = loss
+        if i % 10 == 0:
+            print(f"step {i:4d}  loss {loss:.4f}")
+    print(f"done: {first:.4f} -> {last:.4f}")
+    assert last < first
+    return last
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    main(steps=p.parse_args().steps)

@@ -420,7 +420,12 @@ class Dirichlet(Distribution):
     """Reference distribution/dirichlet.py."""
 
     def __init__(self, concentration, name=None):
-        self._conc_t = as_tensor(concentration)
+        c = as_tensor(concentration)
+        if not jnp.issubdtype(c._data.dtype, jnp.floating):
+            # lax.lgamma/digamma and jax.random.dirichlet are float-strict
+            c = apply(lambda a: a.astype(jnp.float32), c,
+                      name="dirichlet_cast")
+        self._conc_t = c
 
     @property
     def concentration(self):
@@ -471,14 +476,14 @@ class Multinomial(Distribution):
         return Tensor(self.total_count * self.probs)
 
     def sample(self, shape=()):
-        logits = jnp.log(jnp.maximum(self.probs, 1e-30))
-        draws = jax.random.categorical(
-            _key(), logits, shape=tuple(shape) + (self.total_count,)
-            + jnp.shape(self.probs)[:-1])
-        k = jnp.shape(self.probs)[-1]
-        onehot = jax.nn.one_hot(draws, k)
-        axis = len(tuple(shape))  # the draw axis
-        return Tensor(jnp.sum(onehot, axis=axis))
+        # jax.random.multinomial draws counts in O(k) — materializing a
+        # one-hot over total_count draws would scale memory with n
+        batch = jnp.shape(self.probs)[:-1]
+        out = jax.random.multinomial(
+            _key(), jnp.float32(self.total_count),
+            jnp.broadcast_to(self.probs,
+                             tuple(shape) + jnp.shape(self.probs)))
+        return Tensor(out)
 
     def log_prob(self, value):
         def f(x, p):

@@ -265,3 +265,149 @@ def test_vision_model_families():
     assert out.shape == [2, 5] and a1.shape == [2, 5]
     gn.eval()
     assert gn(x).shape == [2, 5]
+
+
+class TestNewDistributions:
+    """Round-4 distribution families (reference python/paddle/distribution/
+    {cauchy,geometric,lognormal,dirichlet,multinomial,independent,
+    transformed_distribution}.py)."""
+
+    def test_cauchy_logprob_and_sampling(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import Cauchy
+        paddle.seed(0)
+        d = Cauchy(loc=0.0, scale=2.0)
+        lp = float(d.log_prob(paddle.to_tensor(0.0)).numpy())
+        np.testing.assert_allclose(lp, -np.log(np.pi * 2.0), rtol=1e-5)
+        s = np.asarray(d.sample([2000]).numpy())
+        assert np.isfinite(s).all()
+        # heavy tails: median near loc even though mean undefined
+        assert abs(np.median(s)) < 0.3
+
+    def test_geometric_moments(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import Geometric
+        paddle.seed(0)
+        d = Geometric(probs=0.25)
+        s = np.asarray(d.sample([4000]).numpy())
+        np.testing.assert_allclose(s.mean(), 3.0, atol=0.3)  # (1-p)/p
+        lp = float(d.log_prob(paddle.to_tensor(2.0)).numpy())
+        np.testing.assert_allclose(lp, np.log(0.75**2 * 0.25), rtol=1e-5)
+
+    def test_lognormal_matches_exp_normal(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import LogNormal, Normal
+        paddle.seed(0)
+        d = LogNormal(0.5, 0.4)
+        x = paddle.to_tensor(np.array([0.5, 1.0, 2.5], np.float32))
+        got = np.asarray(d.log_prob(x).numpy())
+        want = (np.asarray(Normal(0.5, 0.4).log_prob(
+            paddle.log(x)).numpy()) - np.log(np.asarray(x.numpy())))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        s = np.asarray(d.sample([4000]).numpy())
+        assert (s > 0).all()
+
+    def test_dirichlet_mean_and_logprob(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import Dirichlet
+        paddle.seed(0)
+        c = paddle.to_tensor(np.array([2.0, 3.0, 5.0], np.float32))
+        d = Dirichlet(c)
+        np.testing.assert_allclose(np.asarray(d.mean.numpy()),
+                                   [0.2, 0.3, 0.5], rtol=1e-6)
+        s = np.asarray(d.sample([1000]).numpy())
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.05)
+        x = paddle.to_tensor(np.array([0.2, 0.3, 0.5], np.float32))
+        from scipy.stats import dirichlet as spd
+        assert abs(float(d.log_prob(x).numpy())
+                   - spd.logpdf(np.array([0.2, 0.3, 0.5]),
+                                [2.0, 3.0, 5.0])) < 1e-4
+
+    def test_multinomial_counts(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import Multinomial
+        paddle.seed(0)
+        d = Multinomial(10, paddle.to_tensor(
+            np.array([0.2, 0.3, 0.5], np.float32)))
+        s = np.asarray(d.sample([500]).numpy())
+        np.testing.assert_allclose(s.sum(-1), 10.0)
+        np.testing.assert_allclose(s.mean(0), [2.0, 3.0, 5.0], atol=0.4)
+        lp = float(d.log_prob(paddle.to_tensor(
+            np.array([2.0, 3.0, 5.0], np.float32))).numpy())
+        from scipy.stats import multinomial as spm
+        assert abs(lp - spm.logpmf([2, 3, 5], 10, [0.2, 0.3, 0.5])) < 1e-4
+
+    def test_independent_sums_event_dims(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import Independent, Normal
+        d = Normal(paddle.zeros([3, 4]), paddle.ones([3, 4]))
+        ind = Independent(d, 1)
+        x = paddle.ones([3, 4])
+        lp = np.asarray(ind.log_prob(x).numpy())
+        assert lp.shape == (3,)
+        np.testing.assert_allclose(
+            lp, np.asarray(d.log_prob(x).numpy()).sum(-1), rtol=1e-6)
+
+    def test_transformed_lognormal_equivalence(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import (ExpTransform, LogNormal,
+                                             Normal,
+                                             TransformedDistribution)
+        td = TransformedDistribution(Normal(0.5, 0.4), [ExpTransform()])
+        ln = LogNormal(0.5, 0.4)
+        x = paddle.to_tensor(np.array([0.5, 1.5, 3.0], np.float32))
+        np.testing.assert_allclose(np.asarray(td.log_prob(x).numpy()),
+                                   np.asarray(ln.log_prob(x).numpy()),
+                                   rtol=1e-5)
+
+    def test_affine_sigmoid_transform_roundtrip(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import (AffineTransform,
+                                             SigmoidTransform)
+        x = paddle.to_tensor(np.linspace(-2, 2, 9).astype(np.float32))
+        for t in (AffineTransform(1.0, 2.5), SigmoidTransform()):
+            y = t.forward(x)
+            back = t.inverse(y)
+            np.testing.assert_allclose(np.asarray(back.numpy()),
+                                       np.asarray(x.numpy()), atol=1e-5)
+
+    def test_kl_new_pairs(self):
+        import numpy as np
+        from paddle_tpu.distribution import (Geometric, LogNormal,
+                                             kl_divergence)
+        kl = float(np.asarray(kl_divergence(
+            Geometric(0.3), Geometric(0.3)).numpy()))
+        np.testing.assert_allclose(kl, 0.0, atol=1e-6)
+        kl2 = float(np.asarray(kl_divergence(
+            LogNormal(0.0, 1.0), LogNormal(1.0, 1.0)).numpy()))
+        np.testing.assert_allclose(kl2, 0.5, rtol=1e-5)
+
+    def test_batched_dirichlet_and_int_multinomial(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import (Dirichlet, Multinomial,
+                                             Normal,
+                                             TransformedDistribution)
+        paddle.seed(0)
+        d = Dirichlet(paddle.to_tensor(np.ones((2, 3), np.float32) * 2))
+        s = np.asarray(d.sample([5]).numpy())
+        assert s.shape == (5, 2, 3)
+        m = Multinomial(6, paddle.to_tensor(
+            np.array([0.5, 0.5], np.float32)))
+        lp = float(m.log_prob(paddle.to_tensor(
+            np.array([3, 3], np.int32))).numpy())
+        assert np.isfinite(lp)
+        td = TransformedDistribution(Normal(0.0, 1.0), [])
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(
+            np.asarray(td.log_prob(x).numpy()),
+            np.asarray(Normal(0.0, 1.0).log_prob(x).numpy()))

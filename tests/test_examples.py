@@ -1,0 +1,31 @@
+"""The examples/ scripts must stay runnable (user-facing entry points)."""
+
+import importlib.util
+import os
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(EXAMPLES, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_train_gpt_dygraph():
+    assert _load("train_gpt_dygraph").main(steps=12) > 0
+
+
+def test_static_training(tmp_path):
+    acc = _load("static_training").main(steps=60, tmpdir=str(tmp_path))
+    assert acc > 0.8
+
+
+def test_quantize_and_serve():
+    assert _load("quantize_and_serve").main()
+
+
+def test_distributed_data_parallel():
+    assert _load("distributed_data_parallel").main(steps=10) is not None
