@@ -108,11 +108,13 @@ class LocalSGDOptimizer(_WrapperBase):
                 group = hcg.get_data_parallel_group()
         except Exception:
             pass
-        n = getattr(group, "nranks", 1) if group is not None else 1
+        # AVG (pmean) rather than SUM + divide-by-nranks: outside a mapped
+        # context the collective is an identity on the already-replicated
+        # value, where a post-hoc division would corrupt the params.
         for p in self._inner._parameter_list:
             t = Tensor(p._data)
-            dist.all_reduce(t, group=group)
-            p._data = (t._data / n).astype(p._data.dtype)
+            dist.all_reduce(t, op=dist.ReduceOp.AVG, group=group)
+            p._data = t._data.astype(p._data.dtype)
 
 
 class DGCMomentumOptimizer(_WrapperBase):

@@ -117,6 +117,39 @@ def test_localsgd_optimizer_steps():
     np.testing.assert_allclose(w.numpy(), 1.0 - 0.4, atol=1e-6)
 
 
+def test_localsgd_average_is_identity_on_single_controller():
+    """Regression: with a hybrid group installed (nranks=2) but no mapped
+    context, the sync step's collective is an identity on the replicated
+    value — a SUM + divide-by-nranks would halve the params (this was an
+    order-dependent failure when a prior test left an hcg installed)."""
+    import paddle_tpu.distributed.topology as topo
+    from paddle_tpu.distributed.fleet.meta_optimizers import LocalSGDOptimizer
+
+    class FakeGroup:
+        nranks = 2
+        mesh_axis = None
+
+    class FakeHCG:
+        def get_data_parallel_group(self):
+            return FakeGroup()
+
+    old = topo.get_hybrid_communicate_group()
+    topo._HCG = FakeHCG()
+    try:
+        w = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        w.name = "w"
+        inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        opt = LocalSGDOptimizer(inner, k_steps=2)
+        for _ in range(4):
+            (w * 1.0).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(w.numpy(), 0.6, atol=1e-6)
+    finally:
+        topo._HCG = old
+
+
 def test_dgc_optimizer_sparsifies():
     from paddle_tpu.distributed.fleet.meta_optimizers import (
         DGCMomentumOptimizer,
