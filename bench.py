@@ -23,6 +23,22 @@ import traceback
 
 _PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
 _RUN_TIMEOUT = int(os.environ.get("BENCH_RUN_TIMEOUT", "1800"))
+_PARTIAL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_TPU_PARTIAL.json")
+
+
+def _write_partial(result):
+    """Persist the TPU child's progress after every completed section: a
+    short tunnel window that kills the child mid-suite must not lose the
+    sections that already ran (this round's first window did exactly that
+    — 31 min of compiles, then timeout, nothing recorded)."""
+    try:
+        tmp = _PARTIAL + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dict(result, _partial_ts=time.time()), f)
+        os.replace(tmp, _PARTIAL)
+    except Exception:
+        pass
 
 
 def _force_cpu():
@@ -637,32 +653,21 @@ def _child_main(mode):
                 result = gpt
             if result is None:
                 raise RuntimeError(f"both tpu benches failed: {errs}")
-            try:
-                result["extra"]["llama8b_layer"] = run_llama8b_layer_bench(dev)
-            except Exception:
-                errs["llama8b_layer_error"] = \
-                    traceback.format_exc(limit=2)[:600]
-            try:
-                result["extra"]["flash_ab"] = run_flash_ab(dev)
-            except Exception:
-                errs["flash_ab_error"] = traceback.format_exc(limit=2)[:600]
-            try:
-                result["extra"]["kernel_ab"] = run_kernel_ab(dev)
-            except Exception:
-                errs["kernel_ab_error"] = traceback.format_exc(limit=2)[:600]
-            try:
-                result["extra"]["dit_s2"] = run_dit_bench(dev)
-            except Exception:
-                errs["dit_bench_error"] = traceback.format_exc(limit=2)[:600]
-            try:
-                result["extra"]["sd3_mmdit"] = run_sd3_bench(dev)
-            except Exception:
-                errs["sd3_bench_error"] = traceback.format_exc(limit=2)[:600]
-            try:
-                result["extra"]["qwen2_moe"] = run_moe_bench(dev)
-            except Exception:
-                errs["moe_bench_error"] = traceback.format_exc(limit=2)[:600]
+            _write_partial(result)
+            for key, fn in (
+                    ("llama8b_layer", run_llama8b_layer_bench),
+                    ("flash_ab", run_flash_ab),
+                    ("kernel_ab", run_kernel_ab),
+                    ("dit_s2", run_dit_bench),
+                    ("sd3_mmdit", run_sd3_bench),
+                    ("qwen2_moe", run_moe_bench)):
+                try:
+                    result["extra"][key] = fn(dev)
+                except Exception:
+                    errs[key + "_error"] = traceback.format_exc(limit=2)[:600]
+                _write_partial(result)
             result.setdefault("extra", {}).update(errs)
+            _write_partial(result)
         else:
             dev = _force_cpu()
             result = run_gpt_bench(dev, False)
@@ -737,6 +742,19 @@ def main():
             result = None
         elif result is None:
             warning = "tpu bench child timed out or produced no JSON"
+        if result is None:
+            # salvage: the child persists progress section-by-section, so a
+            # mid-suite kill still yields the sections that completed
+            try:
+                with open(_PARTIAL) as f:
+                    part = json.load(f)
+                if part.get("_partial_ts", 0) >= t0 and part.get("value"):
+                    part.pop("_partial_ts", None)
+                    part.setdefault("extra", {})["partial"] = \
+                        "child died mid-suite; sections up to last write"
+                    result = part
+            except Exception:
+                pass
     elif platform is None:
         warning = "tpu probe failed (backend init hung or errored)"
     else:

@@ -4,3 +4,7 @@ from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import operators  # noqa: F401
+from .operators import (  # noqa: F401
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)

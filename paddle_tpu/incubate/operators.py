@@ -1,0 +1,61 @@
+"""Fused incubate operators (reference: python/paddle/incubate/operators/).
+
+`softmax_mask_fuse` / `softmax_mask_fuse_upper_triangle` back the non-flash
+attention-score path (reference softmax_mask_fuse.py:20,
+softmax_mask_fuse_upper_triangle.py:20 over the fused_softmax_mask CUDA
+kernels). On TPU both dispatch to one Pallas VMEM pass per row block
+(ops/kernels/softmax_mask_pallas.py); the causal variant never materializes
+the [sq, sk] triangle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.function import apply
+from ..core.tensor import Tensor, as_tensor
+
+
+def _use_kernel(x):
+    from ..core.flags import flag
+    from ..ops.kernels import _common as kern
+    return (kern.available() and flag("use_pallas_kernels") and x.ndim == 4)
+
+
+def softmax_mask_fuse(x, mask, name=None) -> Tensor:
+    """out = softmax(x + mask) over the last axis; x [B, H, Sq, Sk], mask
+    broadcastable [B, 1, Sq, Sk] (reference contract)."""
+    xt = as_tensor(x)
+    mt = as_tensor(mask)
+    if _use_kernel(xt):
+        from ..ops.kernels import _common as kern
+        from ..ops.kernels import softmax_mask_pallas as sm
+        return apply(
+            lambda a, m: sm.softmax_mask_fused(a, m, kern.interpret_mode()),
+            xt, mt, name="softmax_mask_fuse")
+    return apply(
+        lambda a, m: jax.nn.softmax(a.astype(jnp.float32)
+                                    + m.astype(jnp.float32),
+                                    axis=-1).astype(a.dtype),
+        xt, mt, name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x) -> Tensor:
+    """Causal masked softmax: entries above the diagonal are masked out
+    before the row softmax; the triangle is generated in-kernel."""
+    xt = as_tensor(x)
+    if _use_kernel(xt):
+        from ..ops.kernels import _common as kern
+        from ..ops.kernels import softmax_mask_pallas as sm
+        return apply(
+            lambda a: sm.softmax_mask_tri(a, kern.interpret_mode()),
+            xt, name="softmax_mask_fuse_upper_triangle")
+
+    def f(a):
+        sq, sk = a.shape[-2:]
+        keep = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]
+        af = jnp.where(keep, a.astype(jnp.float32), -jnp.inf)
+        return jax.nn.softmax(af, axis=-1).astype(a.dtype)
+
+    return apply(f, xt, name="softmax_mask_fuse_upper_triangle")

@@ -157,6 +157,28 @@ def glu(x, axis=-1, name=None) -> Tensor:
 
 
 def swiglu(x, y=None, name=None) -> Tensor:
+    """silu(gate) * up (reference: fused swiglu / fused_bias_act kernels,
+    paddle/phi/kernels/fusion/gpu/swiglu_kernel.cu, fused_bias_act_kernel.cu
+    act_method="swiglu"). On TPU dispatches to the fused Pallas kernel —
+    packed mode slices gate/up in VMEM instead of materializing two split
+    copies, and the backward recomputes the sigmoid in-kernel."""
+    from ..core.flags import flag
+    from .kernels import _common as kern
+
+    lane = 256 if y is None else 128  # packed rows hold [g|u]: both halves
+    #                                   must stay 128-lane aligned in VMEM
+    use_kernel = (kern.available() and flag("use_pallas_kernels")
+                  and x.ndim >= 2 and x.shape[-1] % lane == 0
+                  and (y is None or (y.ndim == x.ndim and y.shape == x.shape)))
+    if use_kernel:
+        from .kernels import swiglu_pallas as sp
+        if y is None:
+            return apply(
+                lambda a: sp.swiglu_packed(a, kern.interpret_mode()),
+                x, name="swiglu")
+        return apply(
+            lambda a, b: sp.swiglu_fused(a, b, kern.interpret_mode()),
+            x, y, name="swiglu")
     if y is None:
         def f(a):
             u, v = jnp.split(a, 2, axis=-1)

@@ -1,0 +1,205 @@
+"""Fused masked-softmax Pallas TPU kernels (attention-score glue).
+
+Reference analogs: paddle/phi/kernels/fusion/gpu/fused_softmax_mask_kernel.cu
+(out = softmax(x + mask), mask broadcast over heads) and
+fused_softmax_mask_upper_triangle_kernel.cu (causal mask generated on the
+fly — no mask tensor ever materialized). Public surface:
+paddle.incubate.softmax_mask_fuse / softmax_mask_fuse_upper_triangle
+(python/paddle/incubate/operators/softmax_mask_fuse.py:20,
+softmax_mask_fuse_upper_triangle.py:20).
+
+These back the non-flash attention path: scores [b, h, sq, sk] never round
+-trip through HBM between the mask add and the row softmax, and for the
+causal variant the [sq, sk] triangle is an in-VMEM iota compare instead of
+a broadcast tensor. Backward is the row-softmax vjp fused the same way:
+
+    dx = (dy - sum(dy * y, -1)) * y        (masked cols have y = 0)
+
+Grid: (b*h, sq/rows). The additive mask [b, 1, sq, sk] is indexed with a
+block map folding the head axis (i // h) — broadcast happens in the index
+map, not by materializing [b, h, sq, sk].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._common import pad_to_block, pick_row_block
+
+
+def _pick_rows(sq, sk):
+    # ~4 f32 row buffers (x, mask/iota, y, scratch)
+    return pick_row_block(sq, sk * 4 * 4, 4 * 1024 * 1024, key="softmax_mask")
+
+
+def _fwd_kernel(x_ref, m_ref, y_ref):
+    x = x_ref[...].astype(jnp.float32)                    # [1, rows, sk]
+    x = x + m_ref[...].astype(jnp.float32)
+    x = x - jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x)
+    y_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(y_ref.dtype)
+
+
+def _fwd_tri_kernel(x_ref, y_ref, *, rows):
+    j = pl.program_id(1)
+    x = x_ref[...].astype(jnp.float32)                    # [1, rows, sk]
+    q = j * rows + jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 2)
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    x = jnp.where(col <= q, x, -jnp.inf)
+    x = x - jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x)
+    y_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(y_ref.dtype)
+
+
+def _bwd_kernel(y_ref, dy_ref, dx_ref):
+    y = y_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    dx_ref[...] = ((dy - jnp.sum(dy * y, axis=-1, keepdims=True)) * y
+                   ).astype(dx_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "interpret", "rows"))
+def _fused_fwd(x3, m3, heads, interpret, rows):
+    bh, sq, sk = x3.shape
+    x3p = pad_to_block(x3, rows, axis=1)
+    sqp = x3p.shape[1]
+    grid = (bh, sqp // rows)
+    spec = pl.BlockSpec((1, rows, sk), lambda i, j: (i, j, 0))
+    with jax.enable_x64(False):
+        y = pl.pallas_call(
+            _fwd_kernel,
+            grid=grid,
+            in_specs=[spec,
+                      pl.BlockSpec((1, rows, sk),
+                                   lambda i, j: (i // heads, j, 0))],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((bh, sqp, sk), x3.dtype),
+            interpret=interpret,
+        )(x3p, pad_to_block(m3, rows, axis=1))
+    return y[:, :sq]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+def _fused_fwd_tri(x3, interpret, rows):
+    bh, sq, sk = x3.shape
+    x3p = pad_to_block(x3, rows, axis=1)
+    sqp = x3p.shape[1]
+    spec = pl.BlockSpec((1, rows, sk), lambda i, j: (i, j, 0))
+    with jax.enable_x64(False):
+        y = pl.pallas_call(
+            functools.partial(_fwd_tri_kernel, rows=rows),
+            grid=(bh, sqp // rows),
+            in_specs=[spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((bh, sqp, sk), x3.dtype),
+            interpret=interpret,
+        )(x3p)
+    return y[:, :sq]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+def _fused_bwd(y3, dy3, interpret, rows):
+    bh, sq, sk = y3.shape
+    y3p = pad_to_block(y3, rows, axis=1)
+    sqp = y3p.shape[1]
+    spec = pl.BlockSpec((1, rows, sk), lambda i, j: (i, j, 0))
+    with jax.enable_x64(False):
+        dx = pl.pallas_call(
+            _bwd_kernel,
+            grid=(bh, sqp // rows),
+            in_specs=[spec, spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((bh, sqp, sk), y3.dtype),
+            interpret=interpret,
+        )(y3p, pad_to_block(dy3, rows, axis=1))
+    return dx[:, :sq]
+
+
+def _softmax_bwd(saved, dy, interpret):
+    y, shp = saved
+    sk = shp[-1]
+    sq = shp[-2]
+    rows = _pick_rows(sq, sk)
+    dx = _fused_bwd(y.reshape(-1, sq, sk), dy.reshape(-1, sq, sk),
+                    interpret, rows)
+    return dx.reshape(shp)
+
+
+def _primal(x, mask, interpret=False):
+    b, h, sq, sk = x.shape
+    rows = _pick_rows(sq, sk)
+    m3 = jnp.broadcast_to(mask, (b, 1, sq, sk)).reshape(b, sq, sk)
+    y = _fused_fwd(x.reshape(b * h, sq, sk), m3, h, interpret, rows)
+    return y.reshape(x.shape)
+
+
+softmax_mask_fused = jax.custom_vjp(_primal, nondiff_argnums=(2,))
+
+
+def _vjp_fwd(x, mask, interpret):
+    y = _primal(x, mask, interpret)
+    # dtype rides a 0-d sentinel: residuals are pytrees of arrays, a bare
+    # np.dtype is not a valid leaf
+    return y, (y, x.shape, mask.shape, jnp.zeros((), mask.dtype))
+
+
+def _vjp_bwd(interpret, saved, dy):
+    y, xshp, mshp, msent = saved
+    mdtype = msent.dtype
+    dx = _softmax_bwd((y, xshp), dy, interpret)
+    # d(mask) = dx reduced onto the mask's broadcast shape — the fallback
+    # composite propagates this (a trainable additive bias passed as the
+    # mask must not silently get a zero gradient on the kernel path)
+    dm = dx
+    extra = dm.ndim - len(mshp)
+    if extra:
+        dm = jnp.sum(dm, axis=tuple(range(extra)))
+    axes = tuple(i for i, (want, have) in enumerate(zip(mshp, dm.shape))
+                 if want == 1 and have != 1)
+    if axes:
+        dm = jnp.sum(dm, axis=axes, keepdims=True)
+    return dx, dm.astype(mdtype)
+
+
+softmax_mask_fused.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def _primal_tri(x, interpret=False):
+    b, h, sq, sk = x.shape
+    rows = _pick_rows(sq, sk)
+    y = _fused_fwd_tri(x.reshape(b * h, sq, sk), interpret, rows)
+    return y.reshape(x.shape)
+
+
+softmax_mask_tri = jax.custom_vjp(_primal_tri, nondiff_argnums=(1,))
+
+
+def _vjp_fwd_tri(x, interpret):
+    y = _primal_tri(x, interpret)
+    return y, (y, x.shape)
+
+
+def _vjp_bwd_tri(interpret, saved, dy):
+    return (_softmax_bwd(saved, dy, interpret),)
+
+
+softmax_mask_tri.defvjp(_vjp_fwd_tri, _vjp_bwd_tri)
+
+
+def reference_softmax_mask(x, mask=None):
+    """XLA composite with identical semantics, for parity tests/A-B.
+    mask=None selects the causal (upper-triangle-masked) variant."""
+    xf = x.astype(jnp.float32)
+    if mask is None:
+        sq, sk = x.shape[-2:]
+        q = jnp.arange(sq)[:, None]
+        c = jnp.arange(sk)[None, :]
+        xf = jnp.where(c <= q, xf, -jnp.inf)
+    else:
+        xf = xf + mask.astype(jnp.float32)
+    return jax.nn.softmax(xf, axis=-1).astype(x.dtype)

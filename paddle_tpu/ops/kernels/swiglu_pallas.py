@@ -1,0 +1,215 @@
+"""Fused SwiGLU Pallas TPU kernel (the MLP gate glue of the Llama family).
+
+Reference analog: paddle/phi/kernels/fusion/gpu/fused_bias_act_kernel.cu
+(act_method="swiglu"; also exposed as the standalone swiglu op in
+paddle/phi/kernels/fusion/gpu/swiglu_kernel.cu). The reference fuses the
+bias add + gate activation so the two intermediate-width tensors make one
+HBM round trip instead of three.
+
+On TPU the forward `silu(g) * u` is elementwise and XLA fuses it already;
+what the kernel buys is the *packed* layout and the backward:
+
+- packed mode (`swiglu(x)` with x = [..., 2I]): `jnp.split` materializes
+  two I-wide copies before the composite; the kernel reads the packed row
+  once and slices gate/up in VMEM.
+- backward: one kernel produces dg and du from (g, u, dy) with the sigmoid
+  recomputed in VMEM — no saved activations beyond the primals, and for
+  packed mode the dgu cotangent is written packed (no concatenate).
+
+    y  = silu(g) * u           sig = sigmoid(g)
+    dg = dy * u * sig * (1 + g * (1 - sig))
+    du = dy * g * sig
+
+Public entries: `swiglu_fused(g, u)` and `swiglu_packed(x)`, both with
+custom_vjp; `paddle.nn.functional.swiglu` dispatches here on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._common import pad_to_block, pick_row_block
+
+
+def _pick_rows(n_rows, hidden):
+    # ~6 f32 row buffers live at once (g, u, sig, y, dy, dg/du)
+    return pick_row_block(n_rows, hidden * 6 * 4, 4 * 1024 * 1024,
+                          key="swiglu")
+
+
+def _fwd_kernel(g_ref, u_ref, y_ref):
+    g = g_ref[...].astype(jnp.float32)
+    u = u_ref[...].astype(jnp.float32)
+    y_ref[...] = (g * jax.nn.sigmoid(g) * u).astype(y_ref.dtype)
+
+
+def _fwd_packed_kernel(x_ref, y_ref, *, hidden):
+    x = x_ref[...].astype(jnp.float32)                      # [rows, 2I]
+    g = x[:, :hidden]
+    u = x[:, hidden:]
+    y_ref[...] = (g * jax.nn.sigmoid(g) * u).astype(y_ref.dtype)
+
+
+def _bwd_kernel(g_ref, u_ref, dy_ref, dg_ref, du_ref):
+    g = g_ref[...].astype(jnp.float32)
+    u = u_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    sig = jax.nn.sigmoid(g)
+    s = g * sig
+    dg_ref[...] = (dy * u * sig * (1.0 + g - s)).astype(dg_ref.dtype)
+    du_ref[...] = (dy * s).astype(du_ref.dtype)
+
+
+def _bwd_packed_kernel(x_ref, dy_ref, dx_ref, *, hidden):
+    x = x_ref[...].astype(jnp.float32)
+    g = x[:, :hidden]
+    u = x[:, hidden:]
+    dy = dy_ref[...].astype(jnp.float32)
+    sig = jax.nn.sigmoid(g)
+    s = g * sig
+    dg = dy * u * sig * (1.0 + g - s)
+    du = dy * s
+    dx_ref[...] = jnp.concatenate([dg, du], axis=-1).astype(dx_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+def _fused_fwd(g2, u2, interpret, rows):
+    n, h = g2.shape
+    g2p = pad_to_block(g2, rows)
+    np_ = g2p.shape[0]
+    spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
+    with jax.enable_x64(False):
+        y = pl.pallas_call(
+            _fwd_kernel,
+            grid=(np_ // rows,),
+            in_specs=[spec, spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((np_, h), g2.dtype),
+            interpret=interpret,
+        )(g2p, pad_to_block(u2, rows))
+    return y[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+def _fused_fwd_packed(x2, interpret, rows):
+    n, h2 = x2.shape
+    h = h2 // 2
+    x2p = pad_to_block(x2, rows)
+    np_ = x2p.shape[0]
+    with jax.enable_x64(False):
+        y = pl.pallas_call(
+            functools.partial(_fwd_packed_kernel, hidden=h),
+            grid=(np_ // rows,),
+            in_specs=[pl.BlockSpec((rows, h2), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((rows, h), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((np_, h), x2.dtype),
+            interpret=interpret,
+        )(x2p)
+    return y[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+def _fused_bwd(g2, u2, dy2, interpret, rows):
+    n, h = g2.shape
+    g2p = pad_to_block(g2, rows)
+    np_ = g2p.shape[0]
+    spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
+    with jax.enable_x64(False):
+        dg, du = pl.pallas_call(
+            _bwd_kernel,
+            grid=(np_ // rows,),
+            in_specs=[spec, spec, spec],
+            out_specs=[spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((np_, h), g2.dtype),
+                       jax.ShapeDtypeStruct((np_, h), g2.dtype)],
+            interpret=interpret,
+        )(g2p, pad_to_block(u2, rows), pad_to_block(dy2, rows))
+    return dg[:n], du[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+def _fused_bwd_packed(x2, dy2, interpret, rows):
+    n, h2 = x2.shape
+    h = h2 // 2
+    x2p = pad_to_block(x2, rows)
+    np_ = x2p.shape[0]
+    with jax.enable_x64(False):
+        dx = pl.pallas_call(
+            functools.partial(_bwd_packed_kernel, hidden=h),
+            grid=(np_ // rows,),
+            in_specs=[pl.BlockSpec((rows, h2), lambda i: (i, 0)),
+                      pl.BlockSpec((rows, h), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((rows, h2), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((np_, h2), x2.dtype),
+            interpret=interpret,
+        )(x2p, pad_to_block(dy2, rows))
+    return dx[:n]
+
+
+def _primal(g, u, interpret=False):
+    shp = g.shape
+    h = shp[-1]
+    rows = _pick_rows(math.prod(shp[:-1]), h)
+    y = _fused_fwd(g.reshape(-1, h), u.reshape(-1, h), interpret, rows)
+    return y.reshape(shp)
+
+
+swiglu_fused = jax.custom_vjp(_primal, nondiff_argnums=(2,))
+
+
+def _vjp_fwd(g, u, interpret):
+    return _primal(g, u, interpret), (g, u)
+
+
+def _vjp_bwd(interpret, saved, dy):
+    g, u = saved
+    shp = g.shape
+    h = shp[-1]
+    rows = _pick_rows(math.prod(shp[:-1]), h)
+    dg, du = _fused_bwd(g.reshape(-1, h), u.reshape(-1, h),
+                        dy.reshape(-1, h), interpret, rows)
+    return dg.reshape(shp), du.reshape(shp)
+
+
+swiglu_fused.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def _primal_packed(x, interpret=False):
+    shp = x.shape
+    h2 = shp[-1]
+    rows = _pick_rows(math.prod(shp[:-1]), h2 // 2)
+    y = _fused_fwd_packed(x.reshape(-1, h2), interpret, rows)
+    return y.reshape(shp[:-1] + (h2 // 2,))
+
+
+swiglu_packed = jax.custom_vjp(_primal_packed, nondiff_argnums=(1,))
+
+
+def _vjp_fwd_packed(x, interpret):
+    return _primal_packed(x, interpret), (x,)
+
+
+def _vjp_bwd_packed(interpret, saved, dy):
+    (x,) = saved
+    shp = x.shape
+    h2 = shp[-1]
+    rows = _pick_rows(math.prod(shp[:-1]), h2 // 2)
+    dx = _fused_bwd_packed(x.reshape(-1, h2), dy.reshape(-1, h2 // 2),
+                           interpret, rows)
+    return (dx.reshape(shp),)
+
+
+swiglu_packed.defvjp(_vjp_fwd_packed, _vjp_bwd_packed)
+
+
+def reference_swiglu(g, u=None):
+    """XLA composite with identical semantics, for parity tests/A-B."""
+    if u is None:
+        g, u = jnp.split(g, 2, axis=-1)
+    gf = g.astype(jnp.float32)
+    return (gf * jax.nn.sigmoid(gf) * u.astype(jnp.float32)).astype(g.dtype)

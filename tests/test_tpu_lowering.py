@@ -312,3 +312,37 @@ def test_mmha_decode_lowers(cfg):
     assert_mosaic(lower_tpu(
         lambda a, kk, vv: mmha_pallas.mmha_decode(a, kk, vv, jnp.int32(37)),
         q, kb, vb))
+
+
+def test_swiglu_fwd_bwd_lowers():
+    from paddle_tpu.ops.kernels import swiglu_pallas as sg
+    g = jnp.zeros((256, 2048), jnp.bfloat16)
+    u = jnp.zeros((256, 2048), jnp.bfloat16)
+
+    def grad_fn(a, b):
+        return jax.grad(lambda t: jnp.sum(
+            sg.swiglu_fused(t[0], t[1], False)))((a, b))
+
+    assert_mosaic(lower_tpu(lambda a, b: sg.swiglu_fused(a, b, False), g, u))
+    assert_mosaic(lower_tpu(grad_fn, g, u))
+    x = jnp.zeros((256, 4096), jnp.bfloat16)
+    assert_mosaic(lower_tpu(lambda a: sg.swiglu_packed(a, False), x))
+    assert_mosaic(lower_tpu(
+        lambda a: jax.grad(lambda t: jnp.sum(sg.swiglu_packed(t, False)))(a),
+        x))
+
+
+@pytest.mark.parametrize("sq", [512, 509])
+def test_softmax_mask_fwd_bwd_lowers(sq):
+    from paddle_tpu.ops.kernels import softmax_mask_pallas as sm
+    x = jnp.zeros((2, 4, sq, 512), jnp.bfloat16)
+    m = jnp.zeros((2, 1, sq, 512), jnp.bfloat16)
+    assert_mosaic(lower_tpu(lambda a, b: sm.softmax_mask_fused(a, b, False),
+                            x, m))
+    assert_mosaic(lower_tpu(lambda a: sm.softmax_mask_tri(a, False), x))
+    assert_mosaic(lower_tpu(
+        lambda a, b: jax.grad(
+            lambda t: jnp.sum(sm.softmax_mask_fused(t, b, False)))(a), x, m))
+    assert_mosaic(lower_tpu(
+        lambda a: jax.grad(
+            lambda t: jnp.sum(sm.softmax_mask_tri(t, False)))(a), x))

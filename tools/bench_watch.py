@@ -146,6 +146,12 @@ def main():
             time.sleep(PROBE_INTERVAL)
             continue
         log("probe: tunnel UP (%s) -> running full bench" % detail)
+        if not _PROOF_DONE:
+            # the kernel proof is the round's standing evidence gap and
+            # cheaper than the full bench: claim it FIRST, while the
+            # window is known-open (the first window this round closed
+            # mid-bench and yielded neither artifact)
+            run_kernel_proof()
         res = run_bench()
         if res is None:
             time.sleep(PROBE_INTERVAL)
