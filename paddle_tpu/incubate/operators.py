@@ -17,10 +17,20 @@ from ..autograd.function import apply
 from ..core.tensor import Tensor, as_tensor
 
 
-def _use_kernel(x):
+def _use_kernel(x, mask=None):
     from ..core.flags import flag
     from ..ops.kernels import _common as kern
-    return (kern.available() and flag("use_pallas_kernels") and x.ndim == 4)
+    if not (kern.available() and flag("use_pallas_kernels") and x.ndim == 4):
+        return False
+    if mask is None:
+        return True
+    # kernel contract: mask broadcastable to [B, 1, Sq, Sk] (head axis is
+    # folded in the index map); anything else takes the composite so the
+    # same call never works on one backend and crashes on another
+    if mask.ndim != 4 or mask.shape[1] != 1:
+        return False
+    want = (x.shape[0], 1) + tuple(x.shape[2:])
+    return all(ms in (1, xs) for ms, xs in zip(tuple(mask.shape), want))
 
 
 def softmax_mask_fuse(x, mask, name=None) -> Tensor:
@@ -28,7 +38,7 @@ def softmax_mask_fuse(x, mask, name=None) -> Tensor:
     broadcastable [B, 1, Sq, Sk] (reference contract)."""
     xt = as_tensor(x)
     mt = as_tensor(mask)
-    if _use_kernel(xt):
+    if _use_kernel(xt, mt):
         from ..ops.kernels import _common as kern
         from ..ops.kernels import softmax_mask_pallas as sm
         return apply(

@@ -103,6 +103,18 @@ def pick_row_block(n_rows, row_bytes, budget, key=None):
     return rows
 
 
+def padded_rows(rows):
+    """(padded_rows, block_rows) for flat (rows, 128) optimizer layouts:
+    pad the row count UP to the block size rather than shrinking the block
+    — Mosaic requires sublane blocks in multiples of 8, so an awkward row
+    count (e.g. 2·17·23) must not degrade the block (or fail lowering
+    outright at block<8). Waste is ≤ 511 zero rows (256 KB f32)."""
+    if rows >= 512:
+        return -(-rows // 512) * 512, 512
+    rp = -(-rows // 8) * 8
+    return rp, rp
+
+
 @functools.cache
 def available() -> bool:
     if _INTERPRET or _FORCE_DISPATCH:

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._common import padded_rows as _padded_rows
+
 _LANES = 128
 
 
@@ -46,13 +48,6 @@ def _adamw_kernel(s_ref, w_ref, g_ref, m_ref, v_ref,
     po_ref[...] = w.astype(po_ref.dtype)
 
 
-def _pick_block_rows(rows):
-    br = min(512, rows)
-    while rows % br:
-        br //= 2
-        if br <= 1:
-            return 1
-    return br
 
 
 @functools.partial(
@@ -61,7 +56,7 @@ def _pick_block_rows(rows):
 def _adamw_call(w32, g, m, v, scalars, *, beta1, beta2, eps, wd, out_dtype,
                 interpret):
     n = w32.size
-    rows = -(-n // _LANES)
+    rows, br = _padded_rows(-(-n // _LANES))
     pad = rows * _LANES - n
 
     def to2d(a, dt):
@@ -75,7 +70,6 @@ def _adamw_call(w32, g, m, v, scalars, *, beta1, beta2, eps, wd, out_dtype,
     m2 = to2d(m, jnp.float32)
     v2 = to2d(v, jnp.float32)
 
-    br = _pick_block_rows(rows)
     grid = (rows // br,)
     blk = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
     s_spec = pl.BlockSpec((1, 4), lambda i: (0, 0))

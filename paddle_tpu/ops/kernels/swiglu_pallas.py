@@ -182,7 +182,9 @@ swiglu_fused.defvjp(_vjp_fwd, _vjp_bwd)
 def _primal_packed(x, interpret=False):
     shp = x.shape
     h2 = shp[-1]
-    rows = _pick_rows(math.prod(shp[:-1]), h2 // 2)
+    # budget on the PACKED width: the packed kernels hold full 2I-wide
+    # x/dx rows in VMEM, not just the I-wide halves
+    rows = _pick_rows(math.prod(shp[:-1]), h2)
     y = _fused_fwd_packed(x.reshape(-1, h2), interpret, rows)
     return y.reshape(shp[:-1] + (h2 // 2,))
 
@@ -198,7 +200,7 @@ def _vjp_bwd_packed(interpret, saved, dy):
     (x,) = saved
     shp = x.shape
     h2 = shp[-1]
-    rows = _pick_rows(math.prod(shp[:-1]), h2 // 2)
+    rows = _pick_rows(math.prod(shp[:-1]), h2)
     dx = _fused_bwd_packed(x.reshape(-1, h2), dy.reshape(-1, h2 // 2),
                            interpret, rows)
     return (dx.reshape(shp),)

@@ -267,12 +267,33 @@ class Lamb(Optimizer):
         self._lamb_wd = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
 
+    def _use_fused_kernel(self, p) -> bool:
+        from ..core.flags import flag
+        from ..ops.kernels import _common as kern
+        return (kern.available() and flag("use_pallas_kernels")
+                and p._data.size >= 8192)
+
     def _append_optimize_op(self, p, grad):
         g = grad._data.astype(jnp.float32)
         w32 = p._data.astype(jnp.float32)
         m = self._add_accumulator("moment1", p, dtype=jnp.float32)
         v = self._add_accumulator("moment2", p, dtype=jnp.float32)
         t = self._step_tensor._data
+
+        if self._use_fused_kernel(p):
+            from ..ops.kernels import _common as kern
+            from ..ops.kernels import lamb_pallas as lp
+            wd = self._lamb_wd
+            if self._exclude_fn is not None and self._exclude_fn(p):
+                wd = 0.0
+            _, m._data, v._data, p_out, _ = lp.lamb_update(
+                w32, g, m._data, v._data, self._lr(p), t,
+                beta1=self._beta1, beta2=self._beta2, eps=self._epsilon,
+                wd=float(wd), out_dtype=p._data.dtype,
+                interpret=kern.interpret_mode())
+            p._data = p_out
+            return
+
         m._data = self._beta1 * m._data + (1 - self._beta1) * g
         v._data = self._beta2 * v._data + (1 - self._beta2) * jnp.square(g)
         mhat = m._data / (1 - self._beta1 ** t)

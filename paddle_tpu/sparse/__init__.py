@@ -24,7 +24,8 @@ __all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
            "expm1", "neg", "relu6", "leaky_relu", "isnan", "pow", "scale",
            "cast", "subtract", "divide", "divide_scalar", "sum", "reshape",
            "transpose", "slice", "full_like", "addmm", "mv", "masked_matmul",
-           "softmax", "to_sparse_coo", "to_sparse_csr"]
+           "softmax", "to_sparse_coo", "to_sparse_csr", "deg2rad",
+           "rad2deg", "is_same_shape", "pca_lowrank"]
 
 
 class SparseCooTensor:
@@ -171,6 +172,25 @@ expm1 = _unary(jnp.expm1, "expm1")
 neg = _unary(jnp.negative, "neg")
 relu6 = _unary(lambda v: jnp.clip(v, 0, 6), "relu6")
 isnan = _unary(jnp.isnan, "isnan")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+
+
+def is_same_shape(x, y):
+    """Shape equality across sparse/dense operands (reference:
+    python/paddle/sparse/unary.py is_same_shape)."""
+    xs = tuple(x._b.shape) if is_sparse(x) else tuple(x.shape)
+    ys = tuple(y._b.shape) if is_sparse(y) else tuple(y.shape)
+    return xs == ys
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA of a sparse matrix (reference: sparse/unary.py
+    pca_lowrank over the dense kernel): densify and delegate — the
+    randomized range finder is dense-iterative either way on TPU."""
+    from ..ops.linalg import pca_lowrank as dense_pca
+    return dense_pca(to_dense(x) if is_sparse(x) else x, q=q,
+                     center=center, niter=niter, name=name)
 
 
 def leaky_relu(x, negative_slope=0.01):

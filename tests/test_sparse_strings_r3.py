@@ -122,3 +122,27 @@ def test_strings_ops():
     assert e.shape == [2, 3] and e.tolist()[0] == ["", "", ""]
     assert strings.empty_like(t).shape == [2, 2]
     assert paddle.strings.lower is strings.lower  # namespace registered
+
+
+def test_sparse_surface_completion_r4b():
+    """deg2rad/rad2deg/is_same_shape/pca_lowrank complete the reference
+    paddle.sparse __all__ (python/paddle/sparse/__init__.py)."""
+    import paddle_tpu as paddle
+    x, idx, val = _coo()
+    np.testing.assert_allclose(sparse.deg2rad(x).values().numpy(),
+                               np.deg2rad(val), rtol=1e-6)
+    np.testing.assert_allclose(sparse.rad2deg(x).values().numpy(),
+                               np.rad2deg(val), rtol=1e-6)
+    assert sparse.is_same_shape(x, paddle.zeros([3, 3]))
+    assert not sparse.is_same_shape(x, paddle.zeros([2, 3]))
+    u, s, v = sparse.pca_lowrank(x, q=2)
+    assert tuple(u.shape) == (3, 2) and tuple(s.shape) == (2,)
+    ref_all = ['abs', 'add', 'addmm', 'asin', 'asinh', 'atan', 'atanh',
+               'cast', 'coalesce', 'deg2rad', 'divide', 'expm1',
+               'is_same_shape', 'isnan', 'log1p', 'masked_matmul', 'matmul',
+               'multiply', 'mv', 'neg', 'pca_lowrank', 'pow', 'rad2deg',
+               'reshape', 'sin', 'sinh', 'slice', 'sparse_coo_tensor',
+               'sparse_csr_tensor', 'sqrt', 'square', 'subtract', 'sum',
+               'tan', 'tanh', 'transpose']
+    missing = [n for n in ref_all if not hasattr(sparse, n)]
+    assert not missing, missing

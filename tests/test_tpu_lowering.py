@@ -346,3 +346,26 @@ def test_softmax_mask_fwd_bwd_lowers(sq):
     assert_mosaic(lower_tpu(
         lambda a: jax.grad(
             lambda t: jnp.sum(sm.softmax_mask_tri(t, False)))(a), x))
+
+
+@pytest.mark.parametrize("n", [128 * 1024, 100003])
+def test_lamb_update_lowers(n):
+    from paddle_tpu.ops.kernels import lamb_pallas as lp
+    w = jnp.zeros((n,), jnp.float32)
+    txt = lower_tpu(
+        lambda w_, g, m, v: lp.lamb_update(
+            w_, g, m, v, 1e-3, 2.0, beta1=0.9, beta2=0.999, eps=1e-6,
+            wd=0.01, out_dtype=jnp.bfloat16),
+        w, w, w, w)
+    assert_mosaic(txt)
+
+
+def test_adamw_update_awkward_size_lowers():
+    """Regression: a row count with no multiple-of-8 divisor (2·17·23 rows)
+    must pad rows up, not shrink the block below Mosaic's sublane rule."""
+    from paddle_tpu.ops.kernels import adamw_pallas as ap
+    w = jnp.zeros((100003,), jnp.float32)
+    fn = functools.partial(ap.adamw_update, beta1=0.9, beta2=0.999,
+                           eps=1e-8, wd=0.01, out_dtype=jnp.bfloat16)
+    assert_mosaic(lower_tpu(lambda a, g, m, v: fn(a, g, m, v, 1e-3, 10),
+                            w, w, w, w))

@@ -329,6 +329,22 @@ def main():
         lambda a: sg.reference_swiglu(a),
         (xpk,), n_grad_args=1, tol=5e-2)
 
+    # 11b. fused LAMB (two-pass trust-ratio update)
+    from paddle_tpu.ops.kernels import lamb_pallas as lp
+    wl = jnp.asarray(rng.standard_normal(NADAM), jnp.float32)
+    gl = jnp.asarray(rng.standard_normal(NADAM), jnp.float32)
+    ml = jnp.asarray(rng.standard_normal(NADAM) * 0.1, jnp.float32)
+    vl = jnp.asarray(rng.random(NADAM) * 0.01, jnp.float32)
+    fam["fused_lamb"] = run_family(
+        "fused_lamb",
+        lambda w_, g_, m_, v_: lp.lamb_update(
+            w_, g_, m_, v_, 1e-3, 2.0, beta1=0.9, beta2=0.999, eps=1e-6,
+            wd=0.01, out_dtype=jnp.bfloat16, interpret=interp)[:3],
+        lambda w_, g_, m_, v_: lp.reference_lamb(
+            w_, g_, m_, v_, 1e-3, 2.0, beta1=0.9, beta2=0.999, eps=1e-6,
+            wd=0.01)[:3],
+        (wl, gl, ml, vl), tol=5e-2)
+
     # 12. fused masked softmax (additive mask + in-kernel causal triangle)
     from paddle_tpu.ops.kernels import softmax_mask_pallas as sm
     bsm, hsm, sqm = (2, 4, SEQ // 2) if interp else (4, 16, 1024)
