@@ -1,0 +1,347 @@
+// Native TCP key-value store server: the control-plane rendezvous service
+// behind init_parallel_env and the object collectives.
+//
+// Reference analog: paddle/phi/core/distributed/store/tcp_store.h — the
+// reference's TCPStore master is native C++ serving blocking get/add/wait
+// over a length-prefixed socket protocol; this is the same component for
+// the TPU build. The Python TCPStore (distributed/store.py) speaks the
+// identical binary protocol and remains the no-toolchain fallback server;
+// values are opaque bytes (the Python client pickles them), counters are
+// explicit int64s, so nothing here parses Python objects.
+//
+// Wire protocol (all integers big-endian):
+//   request :=  u32 len | u8 op | u16 keylen | key | i64 ival | f64 timeout
+//               | u32 vlen | value
+//   ops: 1=set 2=get 3=add 4=wait_ge 5=delete 6=delete_prefix
+//   reply   :=  u32 len | u8 ok | u8 kind | payload
+//   kinds: 0=none 1=int(i64) 2=bytes(u32+data); ok=0 carries kind=2 error
+//
+// Concurrency: accept thread + one detached thread per connection (the
+// client holds a persistent socket), one mutex + condvar over the map for
+// the blocking get/wait_ge primitives. Pure C++17 + POSIX sockets.
+//
+// C API (ctypes, distributed/store.py):
+//   void*  tcp_store_server_start(const char* host, int port, int* out)
+//   void   tcp_store_server_stop(void*)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Value {
+  bool is_int = false;
+  int64_t i = 0;
+  std::string bytes;
+};
+
+struct Server {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::mutex mu;
+  std::condition_variable cv;        // data changes + shutdown wakeups
+  std::condition_variable drain_cv;  // connection-thread exit
+  std::map<std::string, Value> data;
+  std::map<int, bool> conn_fds;      // live connection sockets
+  int conns = 0;
+  bool stopping = false;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+uint64_t be64(const unsigned char* p) {
+  uint64_t v = 0;
+  for (int k = 0; k < 8; ++k) v = (v << 8) | p[k];
+  return v;
+}
+
+void put_be(std::string* out, uint64_t v, int nbytes) {
+  for (int k = nbytes - 1; k >= 0; --k)
+    out->push_back(static_cast<char>((v >> (8 * k)) & 0xff));
+}
+
+bool send_reply(int fd, bool ok, int kind, int64_t ival,
+                const std::string& bytes) {
+  std::string body;
+  body.push_back(ok ? 1 : 0);
+  body.push_back(static_cast<char>(kind));
+  if (kind == 1) {
+    put_be(&body, static_cast<uint64_t>(ival), 8);
+  } else if (kind == 2) {
+    put_be(&body, bytes.size(), 4);
+    body += bytes;
+  }
+  std::string frame;
+  put_be(&frame, body.size(), 4);
+  frame += body;
+  return write_exact(fd, frame.data(), frame.size());
+}
+
+bool send_err(int fd, const std::string& msg) {
+  return send_reply(fd, false, 2, 0, msg);
+}
+
+void handle_conn(Server* s, int fd) {
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (s->stopping) {
+      ::close(fd);
+      --s->conns;
+      s->drain_cv.notify_all();
+      return;
+    }
+    s->conn_fds[fd] = true;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<unsigned char> buf;
+  for (;;) {
+    unsigned char hdr[4];
+    if (!read_exact(fd, hdr, 4)) break;
+    uint32_t len = (hdr[0] << 24) | (hdr[1] << 16) | (hdr[2] << 8) | hdr[3];
+    if (len < 1 + 2 + 8 + 8 + 4 || len > (1u << 30)) {
+      // malformed or absurd frame: the stream cannot be resynced, but the
+      // client deserves a reply before the close (post-send failures are
+      // not retried), not a silent ConnectionError
+      send_err(fd, "store frame rejected (malformed or >1GB)");
+      break;
+    }
+    buf.resize(len);
+    if (!read_exact(fd, buf.data(), len)) break;
+    const unsigned char* p = buf.data();
+    int op = *p++;
+    uint16_t keylen = (p[0] << 8) | p[1];
+    p += 2;
+    if (1u + 2 + keylen + 8 + 8 + 4 > len) break;
+    std::string key(reinterpret_cast<const char*>(p), keylen);
+    p += keylen;
+    int64_t ival = static_cast<int64_t>(be64(p));
+    p += 8;
+    uint64_t tbits = be64(p);
+    p += 8;
+    double timeout;
+    std::memcpy(&timeout, &tbits, 8);
+    uint32_t vlen = (p[0] << 24) | (p[1] << 16) | (p[2] << 8) | p[3];
+    p += 4;
+    if (1u + 2 + keylen + 8 + 8 + 4 + vlen != len) break;
+    std::string value(reinterpret_cast<const char*>(p), vlen);
+
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration_cast<
+                        std::chrono::steady_clock::duration>(
+                        std::chrono::duration<double>(timeout));
+    // Compute the reply under the lock, SEND it after release: a stalled
+    // client with a full receive window must only wedge its own
+    // connection thread, never the store mutex (cluster-wide rendezvous
+    // rides this one lock).
+    bool ok = false;
+    int kind = 0;
+    int64_t rint = 0;
+    std::string rbytes;
+    switch (op) {
+      case 1: {  // set
+        std::lock_guard<std::mutex> g(s->mu);
+        Value v;
+        v.bytes = std::move(value);
+        s->data[key] = std::move(v);
+        s->cv.notify_all();
+        ok = true;
+        break;
+      }
+      case 2: {  // get (blocks until the key exists)
+        std::unique_lock<std::mutex> g(s->mu);
+        bool present = s->cv.wait_until(g, deadline, [&] {
+          return s->stopping || s->data.count(key) > 0;
+        });
+        if (present && !s->stopping && s->data.count(key)) {
+          const Value& v = s->data[key];
+          ok = true;
+          if (v.is_int) {
+            kind = 1;
+            rint = v.i;
+          } else {
+            kind = 2;
+            rbytes = v.bytes;  // copy under lock; send after
+          }
+        } else {
+          kind = 2;
+          rbytes = "store get('" + key + "') timed out";
+        }
+        break;
+      }
+      case 3: {  // add
+        std::lock_guard<std::mutex> g(s->mu);
+        Value& v = s->data[key];
+        if (!v.is_int && !v.bytes.empty()) {
+          kind = 2;
+          rbytes = "store add on non-counter key '" + key + "'";
+          break;
+        }
+        v.is_int = true;
+        v.i += ival;
+        s->cv.notify_all();
+        ok = true;
+        kind = 1;
+        rint = v.i;
+        break;
+      }
+      case 4: {  // wait_ge
+        std::unique_lock<std::mutex> g(s->mu);
+        bool reached = s->cv.wait_until(g, deadline, [&] {
+          if (s->stopping) return true;
+          auto it = s->data.find(key);
+          return it != s->data.end() && it->second.is_int &&
+                 it->second.i >= ival;
+        });
+        auto it = s->data.find(key);
+        if (reached && !s->stopping && it != s->data.end() &&
+            it->second.is_int && it->second.i >= ival) {
+          ok = true;
+          kind = 1;
+          rint = it->second.i;
+        } else {
+          kind = 2;
+          rbytes = "store wait_ge('" + key + "') timed out";
+        }
+        break;
+      }
+      case 5: {  // delete
+        std::lock_guard<std::mutex> g(s->mu);
+        ok = true;
+        kind = 1;
+        rint = static_cast<int64_t>(s->data.erase(key));
+        break;
+      }
+      case 6: {  // delete_prefix
+        std::lock_guard<std::mutex> g(s->mu);
+        int64_t n = 0;
+        for (auto it = s->data.lower_bound(key); it != s->data.end();) {
+          if (it->first.compare(0, key.size(), key) != 0) break;
+          it = s->data.erase(it);
+          ++n;
+        }
+        ok = true;
+        kind = 1;
+        rint = n;
+        break;
+      }
+      default:
+        kind = 2;
+        rbytes = "unknown store op";
+    }
+    if (!send_reply(fd, ok, kind, rint, rbytes)) break;
+  }
+  ::close(fd);
+  std::lock_guard<std::mutex> g(s->mu);
+  s->conn_fds.erase(fd);
+  --s->conns;
+  s->drain_cv.notify_all();
+}
+
+void accept_loop(Server* s) {
+  for (;;) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      std::lock_guard<std::mutex> g(s->mu);
+      if (s->stopping) return;
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> g(s->mu);
+      ++s->conns;
+    }
+    std::thread(handle_conn, s, fd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tcp_store_server_start(const char* host, int port, int* port_out) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (!host || !*host || std::strcmp(host, "0.0.0.0") == 0) {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  } else if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    // hostname (e.g. "localhost"): bind wildcard — rendezvous servers
+    // listen for every rank anyway, name resolution stays client-side
+    addr.sin_addr.s_addr = INADDR_ANY;
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (port_out) *port_out = ntohs(addr.sin_port);
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+void tcp_store_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  if (!s) return;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->stopping = true;
+    s->cv.notify_all();  // wake blocked get/wait_ge handlers
+    for (auto& kv : s->conn_fds)
+      ::shutdown(kv.first, SHUT_RDWR);  // unblock handlers parked in read()
+  }
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // detached handler threads must all exit before the Server dies;
+    // bounded wait so a wedged handler leaks the Server instead of
+    // use-after-free-ing it
+    std::unique_lock<std::mutex> g(s->mu);
+    bool drained = s->drain_cv.wait_for(
+        g, std::chrono::seconds(5), [&] { return s->conns == 0; });
+    if (!drained) return;  // leak by design; process is tearing down
+  }
+  delete s;
+}
+
+}  // extern "C"

@@ -2,27 +2,44 @@
 
 Reference: paddle/phi/core/distributed/store/tcp_store.h (TCPStore — the
 bootstrap KV service behind init_parallel_env and the object collectives)
-and store.py's python surface. Pure stdlib: the master rank runs a
-threaded TCP server holding a dict; clients issue pickle-framed
-set/get/add/wait requests. `get` blocks until the key exists (with a
+and store.py's python surface. The master rank hosts the server — the
+native C++ one (csrc/tcp_store.cc, thread-per-connection over POSIX
+sockets, compiled on first use like the reference's native TCPStore) when
+the toolchain is present, else a pure-stdlib Python server speaking the
+IDENTICAL binary protocol. `get` blocks until the key exists (with a
 deadline), which is the synchronization primitive the object collectives
 build on.
 
-Device tensors never travel through this store — it moves small pickled
-python objects and rendezvous keys over DCN, exactly the reference's
-split between NCCL (tensors) and TCPStore (control plane).
+Wire protocol (all integers big-endian; one frame per request/reply):
+  request := u32 len | u8 op | u16 keylen | key | i64 ival | f64 timeout
+             | u32 vlen | value
+  ops: 1=set 2=get 3=add 4=wait_ge 5=delete 6=delete_prefix
+  reply   := u32 len | u8 ok | u8 kind | payload
+  kinds: 0=none 1=int(i64) 2=bytes(u32+data); ok=0 carries an error string
+
+Values are opaque bytes on the wire — this client pickles them, so the
+native server never parses Python objects. Counters (add/wait_ge) are
+explicit int64s. Device tensors never travel through this store — it
+moves small pickled python objects and rendezvous keys over DCN, exactly
+the reference's split between NCCL (tensors) and TCPStore (control plane).
 """
 
 from __future__ import annotations
 
+import ctypes
+import os
 import pickle
 import socket
 import socketserver
 import struct
+import subprocess
 import threading
 import time
 
 __all__ = ["TCPStore"]
+
+_OPS = {"set": 1, "get": 2, "add": 3, "wait_ge": 4, "delete": 5,
+        "delete_prefix": 6}
 
 
 def _send_msg(sock, payload: bytes):
@@ -46,63 +63,159 @@ def _recv_msg(sock) -> bytes:
     return buf
 
 
+def _pack_request(op: str, key: str, ival: int, timeout: float,
+                  value: bytes) -> bytes:
+    kb = key.encode()
+    return struct.pack(f"!BH{len(kb)}sqdI", _OPS[op], len(kb), kb,
+                       ival, timeout, len(value)) + value
+
+
+def _parse_request(payload: bytes):
+    op, keylen = struct.unpack_from("!BH", payload)
+    off = 3
+    key = payload[off:off + keylen].decode()
+    off += keylen
+    ival, timeout, vlen = struct.unpack_from("!qdI", payload, off)
+    off += 20
+    return op, key, ival, timeout, payload[off:off + vlen]
+
+
+def _pack_reply(ok: bool, kind: int, ival: int = 0,
+                data: bytes = b"") -> bytes:
+    out = struct.pack("!BB", 1 if ok else 0, kind)
+    if kind == 1:
+        out += struct.pack("!q", ival)
+    elif kind == 2:
+        out += struct.pack("!I", len(data)) + data
+    return out
+
+
+def _parse_reply(payload: bytes):
+    ok, kind = struct.unpack_from("!BB", payload)
+    if kind == 1:
+        (ival,) = struct.unpack_from("!q", payload, 2)
+        return bool(ok), ival
+    if kind == 2:
+        (vlen,) = struct.unpack_from("!I", payload, 2)
+        return bool(ok), payload[6:6 + vlen]
+    return bool(ok), None
+
+
+# ---- native server (csrc/tcp_store.cc) ------------------------------------
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "..", "csrc", "tcp_store.cc")
+_LIB_PATH = os.path.join(_HERE, "..", "csrc", "libtcp_store.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_native():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        # a prebuilt .so without the source (binary-only install) is used
+        # as-is; rebuild only when the source is present and newer
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+            tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+            subprocess.run(["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                            _SRC, "-o", tmp, "-lpthread"],
+                           check=True, capture_output=True)
+            os.replace(tmp, _LIB_PATH)
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.tcp_store_server_start.restype = ctypes.c_void_p
+        lib.tcp_store_server_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.tcp_store_server_stop.restype = None
+        lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def native_server_available() -> bool:
+    try:
+        _load_native()
+        return True
+    except Exception:
+        return False
+
+
+# ---- pure-Python fallback server (same protocol) --------------------------
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         store = self.server.store  # type: ignore[attr-defined]
         try:
             while True:
-                op, key, value, timeout = pickle.loads(_recv_msg(self.request))
-                if op == "set":
-                    with store._cv:
-                        store._data[key] = value
-                        store._cv.notify_all()
-                    reply = (True, None)
-                elif op == "add":
-                    with store._cv:
-                        cur = store._data.get(key, 0) + value
-                        store._data[key] = cur
-                        store._cv.notify_all()
-                    reply = (True, cur)
-                elif op == "get":
-                    deadline = time.monotonic() + timeout
-                    with store._cv:
-                        while key not in store._data:
-                            left = deadline - time.monotonic()
-                            if left <= 0:
-                                break
-                            store._cv.wait(left)
-                        if key in store._data:
-                            reply = (True, store._data[key])
-                        else:
-                            reply = (False, f"store get({key!r}) timed out")
-                elif op == "wait_ge":
-                    deadline = time.monotonic() + timeout
-                    with store._cv:
-                        while store._data.get(key, 0) < value:
-                            left = deadline - time.monotonic()
-                            if left <= 0:
-                                break
-                            store._cv.wait(left)
-                        if store._data.get(key, 0) >= value:
-                            reply = (True, store._data[key])
-                        else:
-                            reply = (False,
-                                     f"store wait_ge({key!r}) timed out")
-                elif op == "delete":
-                    with store._cv:
-                        existed = store._data.pop(key, None) is not None
-                    reply = (True, existed)
-                elif op == "delete_prefix":
-                    with store._cv:
-                        dead = [k for k in store._data if k.startswith(key)]
-                        for k in dead:
-                            del store._data[k]
-                    reply = (True, len(dead))
-                else:
-                    reply = (False, f"unknown store op {op!r}")
-                _send_msg(self.request, pickle.dumps(reply))
+                op, key, ival, timeout, value = _parse_request(
+                    _recv_msg(self.request))
+                reply = self._dispatch(store, op, key, ival, timeout, value)
+                _send_msg(self.request, reply)
         except (ConnectionError, OSError):
             return
+
+    @staticmethod
+    def _dispatch(store, op, key, ival, timeout, value) -> bytes:
+        if op == 1:  # set
+            with store._cv:
+                store._data[key] = value
+                store._cv.notify_all()
+            return _pack_reply(True, 0)
+        if op == 2:  # get
+            deadline = time.monotonic() + timeout
+            with store._cv:
+                while key not in store._data:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    store._cv.wait(left)
+                if key in store._data:
+                    v = store._data[key]
+                    if isinstance(v, int):
+                        return _pack_reply(True, 1, ival=v)
+                    return _pack_reply(True, 2, data=v)
+            return _pack_reply(False, 2,
+                               data=f"store get({key!r}) timed out".encode())
+        if op == 3:  # add
+            with store._cv:
+                cur = store._data.get(key, 0)
+                if not isinstance(cur, int):
+                    return _pack_reply(
+                        False, 2,
+                        data=f"store add on non-counter key {key!r}".encode())
+                cur += ival
+                store._data[key] = cur
+                store._cv.notify_all()
+            return _pack_reply(True, 1, ival=cur)
+        if op == 4:  # wait_ge
+            deadline = time.monotonic() + timeout
+            with store._cv:
+                while not (isinstance(store._data.get(key), int)
+                           and store._data[key] >= ival):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    store._cv.wait(left)
+                cur = store._data.get(key)
+                if isinstance(cur, int) and cur >= ival:
+                    return _pack_reply(True, 1, ival=cur)
+            return _pack_reply(
+                False, 2, data=f"store wait_ge({key!r}) timed out".encode())
+        if op == 5:  # delete
+            with store._cv:
+                existed = store._data.pop(key, None) is not None
+            return _pack_reply(True, 1, ival=int(existed))
+        if op == 6:  # delete_prefix
+            with store._cv:
+                dead = [k for k in store._data if k.startswith(key)]
+                for k in dead:
+                    del store._data[k]
+            return _pack_reply(True, 1, ival=len(dead))
+        return _pack_reply(False, 2, data=b"unknown store op")
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -115,23 +228,44 @@ class TCPStore:
     client holds ONE persistent connection (the server handler loops on a
     socket); connect-phase failures retry until the deadline (the master
     may come up later), but once a request has been sent, failures RAISE —
-    blind resends would double-apply non-idempotent ops like `add`."""
+    blind resends would double-apply non-idempotent ops like `add`.
+
+    The master hosts the native C++ server by default (set
+    PADDLE_TPU_NATIVE_STORE=0 to force the Python one; both speak the same
+    wire protocol, so clients never know the difference)."""
 
     def __init__(self, host: str, port: int, is_master: bool,
                  world_size: int = 1, timeout: float = 60.0):
         self.host, self.port = host, int(port)
         self.timeout = timeout
         self._server = None
+        self._native = None
         self._sock = None
         self._lock = threading.Lock()
         if is_master:
-            self._data: dict = {}
-            self._cv = threading.Condition()
-            self._server = _Server((host, self.port), _Handler)
-            self.port = self._server.server_address[1]  # resolves port 0
-            self._server.store = self
-            threading.Thread(target=self._server.serve_forever,
-                             daemon=True).start()
+            use_native = os.environ.get(
+                "PADDLE_TPU_NATIVE_STORE", "1") != "0"
+            if use_native and native_server_available():
+                lib = _load_native()
+                out = ctypes.c_int(0)
+                self._native = lib.tcp_store_server_start(
+                    host.encode(), self.port, ctypes.byref(out))
+                if self._native:
+                    self.port = out.value  # resolves port 0
+                # bind failure (port taken): fall through to the Python
+                # server, which will raise the real error
+            if not self._native:
+                self._data: dict = {}
+                self._cv = threading.Condition()
+                self._server = _Server((host, self.port), _Handler)
+                self.port = self._server.server_address[1]
+                self._server.store = self
+                threading.Thread(target=self._server.serve_forever,
+                                 daemon=True).start()
+
+    @property
+    def is_native(self) -> bool:
+        return self._native is not None
 
     def _connect(self, deadline):
         last_err = None
@@ -145,14 +279,14 @@ class TCPStore:
                 time.sleep(0.05)
         raise TimeoutError(f"store connect failed: {last_err}")
 
-    def _request(self, op, key, value=None, timeout=None):
+    def _request(self, op, key, ival=0, value=b"", timeout=None):
         timeout = self.timeout if timeout is None else timeout
         deadline = time.monotonic() + timeout
         with self._lock:
             fresh = self._sock is None
             if fresh:
                 self._sock = self._connect(deadline)
-            msg = pickle.dumps((op, key, value, timeout))
+            msg = _pack_request(op, key, ival, timeout, value)
             try:
                 self._sock.settimeout(timeout + 5.0)
                 _send_msg(self._sock, msg)
@@ -171,28 +305,30 @@ class TCPStore:
                 else:
                     raise
             # the request is in flight: no retries past this point
-            ok, payload = pickle.loads(_recv_msg(self._sock))
+            ok, payload = _parse_reply(_recv_msg(self._sock))
         if not ok:
-            raise TimeoutError(payload)
+            raise TimeoutError(payload.decode() if isinstance(payload, bytes)
+                               else str(payload))
         return payload
 
     def set(self, key: str, value) -> None:
-        self._request("set", key, value)
+        self._request("set", key, value=pickle.dumps(value))
 
     def get(self, key: str, timeout: float | None = None):
-        return self._request("get", key, timeout=timeout)
+        out = self._request("get", key, timeout=timeout)
+        return pickle.loads(out) if isinstance(out, bytes) else out
 
     def add(self, key: str, amount: int = 1) -> int:
-        return self._request("add", key, amount)
+        return self._request("add", key, ival=amount)
 
     def wait_ge(self, key: str, value: int, timeout: float | None = None):
         """Block until the counter at `key` reaches `value` (the barrier
         primitive the object collectives use to keep the master's store
         alive until every rank has read)."""
-        return self._request("wait_ge", key, value, timeout=timeout)
+        return self._request("wait_ge", key, ival=value, timeout=timeout)
 
     def delete_key(self, key: str) -> bool:
-        return self._request("delete", key)
+        return bool(self._request("delete", key))
 
     def delete_prefix(self, prefix: str) -> int:
         """Drop every key under `prefix` (post-collective cleanup so the
@@ -206,6 +342,9 @@ class TCPStore:
             except OSError:
                 pass
             self._sock = None
+        if self._native is not None:
+            _load_native().tcp_store_server_stop(self._native)
+            self._native = None
         if self._server is not None:
             self._server.shutdown()
             self._server = None

@@ -50,8 +50,8 @@ def _moments_kernel(s_ref, w_ref, g_ref, m_ref, v_ref,
     pu_ref[0] = jnp.broadcast_to(jnp.sum(r * r), (8, _LANES))
 
 
-def _apply_kernel(s_ref, w_ref, m_ref, v_ref, wo_ref, po_ref,
-                  *, beta1, beta2, eps, wd):
+def _apply_kernel(s_ref, w_ref, m_ref, v_ref, *out_refs,
+                  beta1, beta2, eps, wd, emit_w32):
     lr_trust = s_ref[0, 0]
     inv_bc1 = s_ref[0, 1]
     inv_bc2 = s_ref[0, 2]
@@ -59,7 +59,13 @@ def _apply_kernel(s_ref, w_ref, m_ref, v_ref, wo_ref, po_ref,
     r = (m_ref[...] * inv_bc1) / (jnp.sqrt(v_ref[...] * inv_bc2)
                                   + jnp.float32(eps)) + jnp.float32(wd) * w
     w = w - lr_trust * r
-    wo_ref[...] = w
+    if emit_w32:
+        wo_ref, po_ref = out_refs
+        wo_ref[...] = w
+    else:
+        # no master weights: the f32 write would be a dead full-tensor
+        # HBM round trip (the caller only keeps the model-dtype cast)
+        (po_ref,) = out_refs
     po_ref[...] = w.astype(po_ref.dtype)
 
 
@@ -67,9 +73,10 @@ def _apply_kernel(s_ref, w_ref, m_ref, v_ref, wo_ref, po_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("beta1", "beta2", "eps", "wd", "out_dtype", "interpret"))
+    static_argnames=("beta1", "beta2", "eps", "wd", "out_dtype", "interpret",
+                     "emit_w32"))
 def _lamb_call(w32, g, m, v, scalars, *, beta1, beta2, eps, wd, out_dtype,
-               interpret):
+               interpret, emit_w32):
     n = w32.size
     rows, br = _padded_rows(-(-n // _LANES))
     pad = rows * _LANES - n
@@ -106,32 +113,38 @@ def _lamb_call(w32, g, m, v, scalars, *, beta1, beta2, eps, wd, out_dtype,
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm,
                           jnp.float32(1.0))
         s2 = scalars.at[0, 0].multiply(trust)
-        wo, po = pl.pallas_call(
-            functools.partial(_apply_kernel, **kw),
+        out_specs = [blk, blk] if emit_w32 else [blk]
+        out_shape = ([jax.ShapeDtypeStruct((rows, _LANES), f32)]
+                     if emit_w32 else [])
+        out_shape.append(jax.ShapeDtypeStruct((rows, _LANES), out_dtype))
+        outs = pl.pallas_call(
+            functools.partial(_apply_kernel, emit_w32=emit_w32, **kw),
             grid=grid,
             in_specs=[s_spec, blk, blk, blk],
-            out_specs=[blk, blk],
-            out_shape=[jax.ShapeDtypeStruct((rows, _LANES), f32),
-                       jax.ShapeDtypeStruct((rows, _LANES), out_dtype)],
+            out_specs=out_specs,
+            out_shape=out_shape,
             interpret=interpret,
         )(s2, w2, mo, vo)
+    wo, po = outs if emit_w32 else (None, outs[0])
 
     def back(a2, shape):
         return a2.reshape(-1)[:n].reshape(shape)
 
     shp = w32.shape
-    return (back(wo, shp), back(mo, shp), back(vo, shp), back(po, shp),
-            trust)
+    return (back(wo, shp) if emit_w32 else None, back(mo, shp),
+            back(vo, shp), back(po, shp), trust)
 
 
 def lamb_update(w32, g, m, v, lr, step, *, beta1, beta2, eps, wd,
-                out_dtype, interpret=False):
+                out_dtype, interpret=False, emit_w32=True):
     """One fused LAMB step.
 
     Returns (w32', m', v', p_out, trust) — p_out is w32' cast to
     `out_dtype`, trust is the per-tensor ratio (exposed for debugging /
     the reference's found_inf-style telemetry). `lr`/`step` are traced
-    device scalars; beta/eps/wd are static per parameter group.
+    device scalars; beta/eps/wd are static per parameter group. With
+    `emit_w32=False` the f32 result write is elided (w32' is None) —
+    for callers without master weights it would be a dead HBM pass.
     """
     t = jnp.asarray(step, jnp.float32)
     inv_bc1 = 1.0 / (1.0 - jnp.float32(beta1) ** t)
@@ -141,7 +154,8 @@ def lamb_update(w32, g, m, v, lr, step, *, beta1, beta2, eps, wd,
          jnp.float32(0.0)]).reshape(1, 4)
     return _lamb_call(w32, g, m, v, scalars, beta1=float(beta1),
                       beta2=float(beta2), eps=float(eps), wd=float(wd),
-                      out_dtype=jnp.dtype(out_dtype), interpret=interpret)
+                      out_dtype=jnp.dtype(out_dtype), interpret=interpret,
+                      emit_w32=bool(emit_w32))
 
 
 def reference_lamb(w32, g, m, v, lr, step, *, beta1, beta2, eps, wd):

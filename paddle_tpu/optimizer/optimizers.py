@@ -286,11 +286,17 @@ class Lamb(Optimizer):
             wd = self._lamb_wd
             if self._exclude_fn is not None and self._exclude_fn(p):
                 wd = 0.0
-            _, m._data, v._data, p_out, _ = lp.lamb_update(
+            master = self._get_master(p)
+            if master is not None:
+                w32 = master._data
+            new_w, m._data, v._data, p_out, _ = lp.lamb_update(
                 w32, g, m._data, v._data, self._lr(p), t,
                 beta1=self._beta1, beta2=self._beta2, eps=self._epsilon,
                 wd=float(wd), out_dtype=p._data.dtype,
-                interpret=kern.interpret_mode())
+                interpret=kern.interpret_mode(),
+                emit_w32=master is not None)
+            if master is not None:
+                master._data = new_w
             p._data = p_out
             return
 
@@ -302,11 +308,17 @@ class Lamb(Optimizer):
         wd = self._lamb_wd
         if self._exclude_fn is not None and self._exclude_fn(p):
             wd = 0.0
+        master = self._get_master(p)
+        if master is not None:
+            w32 = master._data
         update = r + wd * w32
         w_norm = jnp.linalg.norm(w32)
         u_norm = jnp.linalg.norm(update)
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
-        p._data = (w32 - self._lr(p) * trust * update).astype(p._data.dtype)
+        new_w = w32 - self._lr(p) * trust * update
+        if master is not None:
+            master._data = new_w
+        p._data = new_w.astype(p._data.dtype)
 
 
 class LBFGS(Optimizer):

@@ -1077,3 +1077,36 @@ def test_lamb_optimizer_fused_path_matches_unfused():
         return w.numpy()
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-6)
+
+
+def test_lamb_multi_precision_master_weights():
+    """multi_precision Lamb keeps f32 master weights through the fused
+    kernel (emit_w32 path): repeated tiny updates on a bf16 param must
+    accumulate in the master copy instead of vanishing in bf16 rounding."""
+    rng = np.random.default_rng(23)
+    wn = (rng.standard_normal((128, 80)) * 4).astype(np.float32)
+    gn = np.full((128, 80), 1e-3, np.float32)
+
+    def run(fused):
+        paddle.seed(0)
+        w = paddle.to_tensor(wn.astype(np.float32), stop_gradient=False)
+        w._data = w._data.astype(jnp.bfloat16)
+        w.name = "w"
+        opt = paddle.optimizer.Lamb(learning_rate=1e-4,
+                                    lamb_weight_decay=0.0, parameters=[w],
+                                    multi_precision=True)
+        if fused:
+            kern.force_interpret(True)
+        try:
+            for _ in range(3):
+                (w * paddle.to_tensor(gn.astype(np.float32))).sum().backward()
+                opt.step()
+                opt.clear_grad()
+        finally:
+            if fused:
+                kern.force_interpret(False)
+        master = opt._get_master(w)
+        assert master is not None and master._data.dtype == jnp.float32
+        return np.asarray(master._data)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-6)

@@ -1,0 +1,76 @@
+"""TCPStore: native C++ server (csrc/tcp_store.cc) and Python fallback
+speak the same binary wire protocol (reference contract:
+paddle/phi/core/distributed/store/tcp_store.h)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, native_server_available
+
+
+@pytest.mark.parametrize("native", ["1", "0"], ids=["native", "python"])
+def test_store_full_op_matrix(native, monkeypatch):
+    if native == "1" and not native_server_available():
+        pytest.skip("no toolchain for the native store")
+    monkeypatch.setenv("PADDLE_TPU_NATIVE_STORE", native)
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    assert master.is_native == (native == "1")
+    c1 = TCPStore("127.0.0.1", master.port, is_master=False)
+    c2 = TCPStore("127.0.0.1", master.port, is_master=False)
+    try:
+        # set/get roundtrip pickles arbitrary objects
+        c1.set("obj", {"a": [1, 2], "b": "x"})
+        assert c1.get("obj") == {"a": [1, 2], "b": "x"}
+        # counters
+        assert c1.add("ctr", 2) == 2
+        assert c2.add("ctr", 3) == 5
+        # get blocks until another client sets the key
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(c2.get("late", timeout=5)))
+        t.start()
+        time.sleep(0.2)
+        c1.set("late", "arrived")
+        t.join(5)
+        assert got == ["arrived"]
+        # wait_ge blocks until the counter reaches the threshold
+        got2 = []
+        t2 = threading.Thread(
+            target=lambda: got2.append(c2.wait_ge("ctr", 7, timeout=5)))
+        t2.start()
+        time.sleep(0.2)
+        c1.add("ctr", 2)
+        t2.join(5)
+        assert got2 == [7]
+        # delete + timed-out get raises
+        assert c1.delete_key("obj") is True
+        with pytest.raises(TimeoutError):
+            c1.get("obj", timeout=0.3)
+        # prefix cleanup (post-collective GC)
+        c1.set("p/1", 1)
+        c1.set("p/2", 2)
+        assert c1.delete_prefix("p/") == 2
+        # counter-type safety: add on a pickled-object key errors
+        c1.set("notctr", "str")
+        with pytest.raises(TimeoutError):
+            c1.add("notctr", 1)
+    finally:
+        c1.shutdown()
+        c2.shutdown()
+        master.shutdown()
+
+
+def test_native_store_is_default_server():
+    """With the toolchain present the master hosts the C++ server by
+    default — the native path must not silently rot behind the env flag."""
+    if not native_server_available():
+        pytest.skip("no toolchain for the native store")
+    os.environ.pop("PADDLE_TPU_NATIVE_STORE", None)
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        assert master.is_native
+    finally:
+        master.shutdown()
