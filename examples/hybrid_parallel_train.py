@@ -1,0 +1,66 @@
+"""4D hybrid-parallel GPT pretraining on a device mesh (dp x mp x pp).
+
+The fleet recipe (reference: fleet.init + distributed_model +
+distributed_optimizer over PipelineLayer/TP layers): tensor-parallel
+blocks carry GSPMD shardings, the pipeline runs as ONE compiled ppermute
+ring, and data parallelism shards the batch. Works the same on 8 virtual
+CPU devices or a TPU slice:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/hybrid_parallel_train.py
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+from paddle_tpu.models import GPTConfig, gpt_for_pipeline
+
+
+def main(steps=4):
+    import jax
+    n = jax.device_count()
+    pp = 2 if n % 2 == 0 else 1
+    mp = 2 if n % (pp * 2) == 0 else 1
+    dp = n // (pp * mp)
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": pp, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, max_position_embeddings=64,
+                    hidden_size=64, num_layers=2 * max(pp, 1), num_heads=4)
+    model = fleet.distributed_model(gpt_for_pipeline(cfg, num_stages=pp))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (8, 33))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int64))
+
+    first = last = None
+    try:
+        for _ in range(steps):
+            if pp > 1:
+                last = float(model.train_batch([x, y], opt))
+            else:
+                loss = model._layers._loss_fn(model(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                last = float(loss)
+            first = first if first is not None else last
+    finally:
+        from paddle_tpu.distributed.topology import reset_topology_state
+        reset_topology_state()  # leave no ambient mesh behind, even on failure
+    print(f"mesh dp{dp} x mp{mp} x pp{pp}: loss {first:.4f} -> {last:.4f}")
+    assert last < first
+    return last
+
+
+if __name__ == "__main__":
+    main()

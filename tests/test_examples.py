@@ -29,3 +29,13 @@ def test_quantize_and_serve():
 
 def test_distributed_data_parallel():
     assert _load("distributed_data_parallel").main(steps=10) is not None
+
+
+def test_hybrid_parallel_train():
+    last = _load("hybrid_parallel_train").main(steps=3)
+    assert last > 0
+
+
+def test_long_context_ring_attention():
+    err_ring, err_uly = _load("long_context_ring_attention").main()
+    assert err_ring < 5e-3 and err_uly < 5e-3
