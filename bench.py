@@ -573,7 +573,8 @@ def _peak_flops(dev):
     kind = (getattr(dev, "device_kind", "") or "").lower()
     table = {
         "v6e": 918e12, "v6": 918e12, "v5p": 459e12, "v5e": 197e12,
-        "v5litepod": 197e12, "v4": 275e12, "v3": 123e12, "v2": 45e12,
+        "v5litepod": 197e12, "v5 lite": 197e12, "v5lite": 197e12,
+        "v4": 275e12, "v3": 123e12, "v2": 45e12,
     }
     if dev.platform not in ("tpu", "axon"):
         return 0.0, "cpu"
@@ -629,6 +630,28 @@ def _run_child(mode):
     return None
 
 
+def _with_alarm(seconds, fn, *args):
+    """Run fn under a SIGALRM watchdog so a stall inside ONE bench section
+    is turned into an exception and the child moves on. LIMITATION: the
+    alarm only fires between Python bytecodes — a wedge inside a single
+    native PJRT call defers it until that call returns. Sections make many
+    Python-level steps (per-step dispatch), so most stalls are caught; a
+    fully-wedged native call is bounded by the PARENT's subprocess kill,
+    with the incremental partial file preserving completed sections."""
+    import signal
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(f"bench section exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(int(seconds))
+    try:
+        return fn(*args)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 def _child_main(mode):
     """--child-tpu / --child-cpu: actually run the workload, print JSON."""
     try:
@@ -638,12 +661,13 @@ def _child_main(mode):
             result, gpt, errs = None, None, {}
             try:
                 # north-star family: primary metric when it runs
-                result = run_llama_bench(dev)
+                result = _with_alarm(900, run_llama_bench, dev)
             except Exception:
                 errs["llama_bench_error"] = \
                     traceback.format_exc(limit=4)[:1200]
             try:
-                gpt = run_gpt_bench(dev, dev.platform in ("tpu", "axon"))
+                gpt = _with_alarm(420, run_gpt_bench, dev,
+                                  dev.platform in ("tpu", "axon"))
             except Exception:
                 errs["gpt_bench_error"] = traceback.format_exc(limit=4)[:1200]
             if result is not None and gpt is not None:
@@ -662,7 +686,7 @@ def _child_main(mode):
                     ("sd3_mmdit", run_sd3_bench),
                     ("qwen2_moe", run_moe_bench)):
                 try:
-                    result["extra"][key] = fn(dev)
+                    result["extra"][key] = _with_alarm(420, fn, dev)
                 except Exception:
                     errs[key + "_error"] = traceback.format_exc(limit=2)[:600]
                 _write_partial(result)

@@ -148,3 +148,371 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
         # preserves the first moment and keeps the kernel deterministic
         out = F.dropout(out, p, training=True)
     return out
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode='upscale_in_train',
+                      name=None):
+    """Reference: incubate/nn/functional/fused_dropout_add.py:22 (one fused
+    kernel for dropout(x) + y). On TPU XLA fuses the chain; the framework
+    RNG keeps it deterministic per seed."""
+    from ....nn import functional as F
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference: incubate/nn/functional/fused_matmul_bias.py:21 (cublasLt
+    epilogue fusion). XLA fuses the bias add into the matmul."""
+    from .... import ops
+    out = ops.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    return out if bias is None else out + bias
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation=None):
+    """Reference: fused_matmul_bias.py:110 (gemm epilogue activation)."""
+    from ....nn import functional as F
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    if activation in (None, "", "none"):
+        return out
+    if activation == "gelu":
+        return F.gelu(out)
+    if activation == "relu":
+        return F.relu(out)
+    raise ValueError(f"unsupported activation {activation!r}")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type):
+    """Expert-choice MoE ffn (reference: fused_ec_moe.py:18): every expert
+    takes its top-capacity tokens by gate score, runs them through one
+    batched [E, ...] einsum pair (MXU-friendly), results scatter-add back.
+    `gate` carries the routing logits [B, S, E]."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....autograd.function import apply
+    from ....core.tensor import as_tensor
+
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"unsupported act_type {act_type!r}")
+    xt = as_tensor(x)
+    b, s, h = xt.shape
+    e = as_tensor(gate).shape[-1]
+    cap = max(1, (b * s) // e)
+
+    def f(xa, ga, w1, b1, w2, b2):
+        tokens = xa.reshape(b * s, h)
+        scores = jax.nn.softmax(ga.reshape(b * s, e), axis=-1)
+        gates, idx = jax.lax.top_k(scores.T, cap)              # [E, cap]
+        picked = jnp.take(tokens, idx.reshape(-1), axis=0).reshape(e, cap, h)
+        hmid = jnp.einsum("ech,ehi->eci", picked, w1) + b1
+        hmid = jax.nn.gelu(hmid) if act_type == "gelu" else jax.nn.relu(hmid)
+        out_e = jnp.einsum("eci,eih->ech", hmid, w2) + b2
+        out_e = out_e * gates[..., None]
+        flat = jnp.zeros((b * s, h), xa.dtype) \
+            .at[idx.reshape(-1)].add(out_e.reshape(e * cap, h))
+        return flat.reshape(b, s, h)
+
+    return apply(f, xt, as_tensor(gate), as_tensor(bmm0_weight),
+                 as_tensor(bmm0_bias), as_tensor(bmm1_weight),
+                 as_tensor(bmm1_bias), name="fused_ec_moe")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False):
+    """Varlen attention over padded batches (reference:
+    variable_length_memory_efficient_attention.py:28, cutlass kernel;
+    layout [B, H, S, D]). TPU-native: per-row key-validity masking fused
+    into one softmax(QK^T)V program — XLA keeps it in registers/VMEM."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....autograd.function import apply
+    from ....core.tensor import as_tensor
+
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    d = q.shape[-1]
+    sc = float(scale) if scale is not None else d ** -0.5
+
+    def f(qa, ka, va, qlen, kvlen, *maybe_mask):
+        hq, hk = qa.shape[1], ka.shape[1]
+        if hq != hk:  # GQA: repeat kv heads
+            ka2 = jnp.repeat(ka, hq // hk, axis=1)
+            va2 = jnp.repeat(va, hq // hk, axis=1)
+        else:
+            ka2, va2 = ka, va
+        s = jnp.einsum("bhqd,bhkd->bhqk", qa * sc, ka2,
+                       preferred_element_type=jnp.float32)
+        if maybe_mask:
+            s = s + maybe_mask[0].astype(jnp.float32)
+        kidx = jnp.arange(ka.shape[2])
+        valid = kidx[None, None, None, :] < kvlen[:, None, None, None]
+        if causal:
+            valid = valid & (kidx[None, None, None, :]
+                             <= jnp.arange(qa.shape[2])[None, None, :, None])
+        s = jnp.where(valid, s, -jnp.inf)
+        # a row with zero valid keys would softmax all -inf to NaN and a
+        # ragged batch containing one empty sequence would poison every
+        # downstream reduction — emit zeros for such rows instead
+        any_valid = jnp.any(valid, axis=-1, keepdims=True)
+        p = jnp.where(any_valid,
+                      jax.nn.softmax(jnp.where(valid, s, -1e30), axis=-1),
+                      0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(va2.dtype), va2)
+        # query-side padding: rows past seq_lens are zeroed (the reference
+        # kernel only writes valid query rows)
+        qidx = jnp.arange(qa.shape[2])
+        qvalid = qidx[None, None, :, None] < qlen[:, None, None, None]
+        return jnp.where(qvalid, out, 0.0)
+
+    args = (q, k, v, as_tensor(seq_lens), as_tensor(kv_seq_lens))
+    if mask is not None:
+        args = args + (as_tensor(mask),)
+    return apply(f, *args, name="variable_length_memory_efficient_attention")
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype='default', out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """One decode step of masked MHA over a static KV cache (reference:
+    masked_multihead_attention.py:19; x is this step's fused qkv
+    [B, 3*H*D], cache_kv [2, B, H, T, D]). Returns (out, cache_kv') like
+    the reference. The int8/quant arguments are GPU-kernel-specific and
+    unsupported here (TPU serving quantizes via weight_only_linear)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....autograd.function import apply_multi
+    from ....core.tensor import as_tensor
+
+    if any(a is not None for a in (qkv_out_scale, out_shift, out_smooth,
+                                   beam_cache_offset, cum_offsets)) \
+            or out_scale != -1 or rotary_emb_dims:
+        raise NotImplementedError(
+            "quant/beam/rotary arguments of masked_multihead_attention are "
+            "not supported on TPU (use weight_only_linear + F.rope)")
+    if cache_kv is None:
+        raise ValueError("cache_kv is required")
+    xt = as_tensor(x)
+    ck = as_tensor(cache_kv)
+    _, b, h, t, d = ck.shape
+
+    def f(xa, cka, *rest):
+        it = iter(rest)
+        ba = next(it) if bias is not None else None
+        ma = next(it) if src_mask is not None else None
+        sl = next(it) if sequence_lengths is not None else None
+        qkv = xa.reshape(b, 3, h, d)
+        if ba is not None:
+            qkv = qkv + ba.reshape(1, 3, h, d)
+        qv, kv, vv = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # [B, H, D]
+        pos = (sl.reshape(b) if sl is not None
+               else jnp.full((b,), jnp.int32(0)))
+        bidx = jnp.arange(b)
+        kbuf = cka[0].at[bidx, :, pos].set(kv)
+        vbuf = cka[1].at[bidx, :, pos].set(vv)
+        s = jnp.einsum("bhd,bhtd->bht", qv * (d ** -0.5), kbuf,
+                       preferred_element_type=jnp.float32)
+        tidx = jnp.arange(t)
+        valid = tidx[None, None, :] <= pos[:, None, None]
+        if ma is not None:
+            s = s + ma.reshape(b, 1, -1)[:, :, :t].astype(jnp.float32)
+        s = jnp.where(valid, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bht,bhtd->bhd", p.astype(vbuf.dtype), vbuf)
+        return out.reshape(b, h * d), jnp.stack([kbuf, vbuf])
+
+    args = [xt, ck]
+    for t_ in (bias, src_mask, sequence_lengths):
+        if t_ is not None:
+            args.append(as_tensor(t_))
+    return apply_multi(f, *args, name="masked_multihead_attention")
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-05, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-05,
+                               training=True, mode='upscale_in_train',
+                               ring_id=-1, add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Functional fused MHA block (reference: fused_transformer.py:511):
+    [pre-LN ->] qkv proj -> attention(+mask) -> out proj -> dropout ->
+    [+residual] [-> post-LN]. qkv_weight is [3, H, hd, D] (or [D, 3*D]
+    with transpose_qkv_wb and num_heads)."""
+    import jax.numpy as jnp
+
+    from ....nn import functional as F
+    from .... import ops
+    from ....core.tensor import as_tensor
+
+    if cache_kv is not None or ring_id != -1:
+        # silently dropping either would return wrong logits (no cached
+        # attention / no tensor-parallel reduce); decode callers use
+        # masked_multihead_attention, TP callers the fleet layers
+        raise NotImplementedError(
+            "fused_multi_head_attention: cache_kv/ring_id are not "
+            "supported on TPU (use masked_multihead_attention for decode, "
+            "fleet TP layers for tensor parallelism)")
+    xt = as_tensor(x)
+    dmodel = xt.shape[-1]
+    qw = as_tensor(qkv_weight)
+    if transpose_qkv_wb:
+        if num_heads <= 0:
+            raise ValueError("num_heads required with transpose_qkv_wb")
+        h, hd = num_heads, dmodel // num_heads
+    else:
+        _, h, hd, _ = qw.shape
+    residual = xt
+    out = xt
+    if pre_layer_norm:
+        out = F.layer_norm(out, dmodel, pre_ln_scale, pre_ln_bias,
+                           pre_ln_epsilon)
+    b, s, _ = out.shape
+    if transpose_qkv_wb:
+        qkv = ops.matmul(out, qw)                       # [B, S, 3D]
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias
+        qkv = ops.reshape(qkv, [b, s, 3, h, hd])
+    else:
+        qkv = ops.einsum("bsd,thkd->bsthk", out, qw)    # [B, S, 3, H, hd]
+        if qkv_bias is not None:
+            qkv = qkv + ops.reshape(as_tensor(qkv_bias), [1, 1, 3, h, hd])
+    q = ops.reshape(qkv[:, :, 0], [b, s, h, hd])
+    k = ops.reshape(qkv[:, :, 1], [b, s, h, hd])
+    v = ops.reshape(qkv[:, :, 2], [b, s, h, hd])
+    mask = None
+    if attn_mask is not None:
+        mask = as_tensor(attn_mask)
+    attn = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=mask,
+        dropout_p=attn_dropout_rate if training else 0.0)
+    attn = ops.reshape(attn, [b, s, h * hd])
+    out = ops.matmul(attn, as_tensor(linear_weight))
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, dmodel, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode='upscale_in_train', ring_id=-1,
+                      add_residual=True, name=None):
+    """Functional fused FFN block (reference: fused_transformer.py:33):
+    [pre-LN ->] linear1 -> act -> dropout1 -> linear2 -> dropout2
+    [+residual] [-> post-LN]."""
+    from ....nn import functional as F
+    from .... import ops
+    from ....core.tensor import as_tensor
+
+    xt = as_tensor(x)
+    dmodel = xt.shape[-1]
+    residual = xt
+    out = xt
+    if pre_layer_norm:
+        out = F.layer_norm(out, dmodel, ln1_scale, ln1_bias, ln1_epsilon)
+    out = ops.matmul(out, as_tensor(linear1_weight))
+    if linear1_bias is not None:
+        out = out + linear1_bias
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, dropout1_rate, training=training, mode=mode)
+    out = ops.matmul(out, as_tensor(linear2_weight))
+    if linear2_bias is not None:
+        out = out + linear2_bias
+    out = F.dropout(out, dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        out = out + residual
+    if pre_layer_norm:
+        return out
+    return F.layer_norm(out, dmodel, ln2_scale, ln2_bias, ln2_epsilon)
+
+
+def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
+                         value_weight=None, qkv_weight=None,
+                         gate_linear_weight=None, gate_linear_bias=None,
+                         out_linear_weight=None, out_linear_bias=None,
+                         nonbatched_bias=None, attn_mask=None,
+                         has_gating=True, merge_qkv=True,
+                         use_flash_attn=False):
+    """AlphaFold-style gated attention (reference:
+    fused_gate_attention.py:19; query [B, M, Sq, Dq]). merge_qkv uses one
+    [3, H, hd, Dq] projection for self-attention; otherwise separate
+    [D, H, hd] q/k/v projections attend query over `key`. The sigmoid gate
+    modulates heads before the output projection."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....autograd.function import apply
+    from ....core.tensor import as_tensor
+
+    qt = as_tensor(query)
+
+    def f(qa, *rest):
+        it = iter(rest)
+        if merge_qkv:
+            qkv_w = next(it)
+            _, h, hd, _ = qkv_w.shape
+            qkv = jnp.einsum("bmsd,thkd->tbmshk", qa, qkv_w)
+            qv, kv, vv = qkv[0], qkv[1], qkv[2]       # [B, M, S, H, hd]
+        else:
+            ka = next(it)
+            qw, kw, vw = next(it), next(it), next(it)
+            h, hd = qw.shape[-2], qw.shape[-1]
+            qv = jnp.einsum("bmsd,dhk->bmshk", qa, qw)
+            kv = jnp.einsum("bmsd,dhk->bmshk", ka, kw)
+            vv = jnp.einsum("bmsd,dhk->bmshk", ka, vw)
+        s = jnp.einsum("bmqhc,bmkhc->bmhqk", qv * (hd ** -0.5), kv,
+                       preferred_element_type=jnp.float32)
+        if nonbatched_bias is not None:
+            s = s + next(it).astype(jnp.float32)
+        if attn_mask is not None:
+            s = s + next(it).astype(jnp.float32)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bmhqk,bmkhc->bmqhc", p.astype(vv.dtype), vv)
+        if has_gating:
+            gw = next(it)
+            gb = next(it)
+            gate = jax.nn.sigmoid(
+                jnp.einsum("bmsd,dhc->bmshc", qa, gw) + gb)
+            out = out * gate
+        ow = next(it)
+        out = jnp.einsum("bmshc,hcd->bmsd", out, ow)
+        ob = next(it, None)
+        return out if ob is None else out + ob
+
+    args = [qt]
+    if merge_qkv:
+        args.append(as_tensor(qkv_weight))
+    else:
+        args += [as_tensor(key), as_tensor(query_weight),
+                 as_tensor(key_weight), as_tensor(value_weight)]
+    if nonbatched_bias is not None:
+        args.append(as_tensor(nonbatched_bias))
+    if attn_mask is not None:
+        args.append(as_tensor(attn_mask))
+    if has_gating:
+        args += [as_tensor(gate_linear_weight), as_tensor(gate_linear_bias)]
+    args.append(as_tensor(out_linear_weight))
+    if out_linear_bias is not None:
+        args.append(as_tensor(out_linear_bias))
+    return apply(f, *args, name="fused_gate_attention")

@@ -1,0 +1,218 @@
+"""The nine incubate.nn.functional surfaces added in r4b, each against a
+numpy/jnp reference (reference signatures:
+python/paddle/incubate/nn/functional/*.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+
+F = paddle.nn.functional
+
+
+def test_fused_dropout_add_and_matmul_bias():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    # p=0 makes dropout the identity: out == x + y exactly
+    out = IF.fused_dropout_add(x, y, p=0.0)
+    np.testing.assert_allclose(out.numpy(), x.numpy() + y.numpy())
+    # eval mode keeps the expectation
+    out = IF.fused_dropout_add(x, y, p=0.5, training=False)
+    np.testing.assert_allclose(out.numpy(), x.numpy() + y.numpy())
+
+    w = paddle.to_tensor(rng.standard_normal((8, 6)).astype(np.float32))
+    b = paddle.to_tensor(rng.standard_normal(6).astype(np.float32))
+    out = IF.fused_matmul_bias(x, w, b)
+    np.testing.assert_allclose(out.numpy(),
+                               x.numpy() @ w.numpy() + b.numpy(),
+                               atol=1e-5)
+    out_t = IF.fused_matmul_bias(x, paddle.to_tensor(w.numpy().T), b,
+                                 transpose_y=True)
+    np.testing.assert_allclose(out_t.numpy(), out.numpy(), atol=1e-5)
+
+    act = IF.fused_linear_activation(x, w, b, activation="gelu")
+    np.testing.assert_allclose(act.numpy(),
+                               F.gelu(out).numpy(), atol=1e-6)
+
+
+def test_fused_ec_moe_matches_layer():
+    from paddle_tpu.incubate.nn import FusedEcMoe
+    rng = np.random.default_rng(1)
+    paddle.seed(0)
+    layer = FusedEcMoe(16, 32, 4, act_type="gelu")
+    x = paddle.to_tensor(rng.standard_normal((2, 8, 16)).astype(np.float32))
+    ref = layer(x)
+    gate_logits = paddle.matmul(x, layer.gate)
+    out = IF.fused_ec_moe(x, gate_logits, layer.w1, layer.b1, layer.w2,
+                          layer.b2, "gelu")
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+
+def test_variable_length_attention_masks_kv_tail():
+    rng = np.random.default_rng(2)
+    b, h, sq, sk, d = 2, 3, 4, 8, 16
+    q = rng.standard_normal((b, h, sq, d)).astype(np.float32)
+    k = rng.standard_normal((b, h, sk, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, sk, d)).astype(np.float32)
+    kv_lens = np.array([5, 8], np.int32)
+    out = IF.variable_length_memory_efficient_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(np.array([sq, sq], np.int32)),
+        paddle.to_tensor(kv_lens))
+    # numpy reference with explicit per-batch kv masking
+    sc = d ** -0.5
+    for bi in range(b):
+        s = (q[bi] * sc) @ k[bi].transpose(0, 2, 1)
+        s[:, :, kv_lens[bi]:] = -np.inf
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out.numpy()[bi], p @ v[bi], atol=2e-5)
+    # batch 0 must differ from the full-length result (mask is live)
+    full = IF.variable_length_memory_efficient_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(np.array([sq, sq], np.int32)),
+        paddle.to_tensor(np.array([sk, sk], np.int32)))
+    assert np.abs(out.numpy()[0] - full.numpy()[0]).max() > 1e-4
+
+
+def test_masked_multihead_attention_decode_step():
+    rng = np.random.default_rng(3)
+    b, h, t, d = 2, 4, 8, 16
+    cache = np.zeros((2, b, h, t, d), np.float32)
+    # pre-fill 3 positions for batch 0, 5 for batch 1
+    lens = np.array([3, 5], np.int32)
+    for bi, L in enumerate(lens):
+        cache[:, bi, :, :L] = rng.standard_normal((2, h, L, d))
+    x = rng.standard_normal((b, 3 * h * d)).astype(np.float32)
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(lens))
+    assert tuple(out.shape) == (b, h * d)
+    nc = new_cache.numpy()
+    # the step's k/v landed at position lens[b]
+    qkv = x.reshape(b, 3, h, d)
+    for bi, L in enumerate(lens):
+        np.testing.assert_allclose(nc[0, bi, :, L], qkv[bi, 1], atol=1e-6)
+        np.testing.assert_allclose(nc[1, bi, :, L], qkv[bi, 2], atol=1e-6)
+    # numpy reference attention over the first L+1 positions
+    for bi, L in enumerate(lens):
+        qv = qkv[bi, 0] * (d ** -0.5)
+        s = np.einsum("hd,htd->ht", qv, nc[0, bi, :, :L + 1])
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("ht,htd->hd", p, nc[1, bi, :, :L + 1])
+        np.testing.assert_allclose(out.numpy()[bi].reshape(h, d), ref,
+                                   atol=2e-5)
+    with pytest.raises(NotImplementedError):
+        IF.masked_multihead_attention(
+            paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+            out_scale=0.5)
+
+
+def test_fused_mha_and_ffn_blocks():
+    rng = np.random.default_rng(4)
+    b, s, h, hd = 2, 6, 2, 8
+    dm = h * hd
+    x = rng.standard_normal((b, s, dm)).astype(np.float32)
+    qkv_w = rng.standard_normal((3, h, hd, dm)).astype(np.float32) * 0.1
+    lin_w = rng.standard_normal((dm, dm)).astype(np.float32) * 0.1
+    ln_s = np.ones(dm, np.float32)
+    ln_b = np.zeros(dm, np.float32)
+
+    out = IF.fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w),
+        paddle.to_tensor(lin_w), pre_layer_norm=True,
+        pre_ln_scale=paddle.to_tensor(ln_s),
+        pre_ln_bias=paddle.to_tensor(ln_b),
+        dropout_rate=0.0, attn_dropout_rate=0.0)
+    # composite reference
+    xn = F.layer_norm(paddle.to_tensor(x), dm, paddle.to_tensor(ln_s),
+                      paddle.to_tensor(ln_b), 1e-5).numpy()
+    qkv = np.einsum("bsd,thkd->bsthk", xn, qkv_w)
+    q, k, v = (qkv[:, :, i] for i in range(3))
+    att = F.scaled_dot_product_attention(
+        paddle.to_tensor(q.astype(np.float32)),
+        paddle.to_tensor(k.astype(np.float32)),
+        paddle.to_tensor(v.astype(np.float32))).numpy()
+    ref = att.reshape(b, s, dm) @ lin_w + x
+    np.testing.assert_allclose(out.numpy(), ref, atol=2e-4)
+
+    w1 = rng.standard_normal((dm, 32)).astype(np.float32) * 0.1
+    w2 = rng.standard_normal((32, dm)).astype(np.float32) * 0.1
+    out = IF.fused_feedforward(
+        paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+        ln1_scale=paddle.to_tensor(ln_s), ln1_bias=paddle.to_tensor(ln_b),
+        dropout1_rate=0.0, dropout2_rate=0.0, activation="gelu",
+        pre_layer_norm=True)
+    mid = F.gelu(paddle.to_tensor(xn @ w1)).numpy()
+    np.testing.assert_allclose(out.numpy(), mid @ w2 + x, atol=2e-4)
+
+
+def test_fused_gate_attention_both_projection_modes():
+    rng = np.random.default_rng(5)
+    b, m, s, dq, h, hd = 2, 3, 5, 16, 2, 8
+    q = rng.standard_normal((b, m, s, dq)).astype(np.float32)
+    qkv_w = rng.standard_normal((3, h, hd, dq)).astype(np.float32) * 0.2
+    gate_w = rng.standard_normal((dq, h, hd)).astype(np.float32) * 0.2
+    gate_b = rng.standard_normal((h, hd)).astype(np.float32) * 0.2
+    out_w = rng.standard_normal((h, hd, dq)).astype(np.float32) * 0.2
+    out_b = rng.standard_normal(dq).astype(np.float32) * 0.2
+
+    out = IF.fused_gate_attention(
+        paddle.to_tensor(q), qkv_weight=paddle.to_tensor(qkv_w),
+        gate_linear_weight=paddle.to_tensor(gate_w),
+        gate_linear_bias=paddle.to_tensor(gate_b),
+        out_linear_weight=paddle.to_tensor(out_w),
+        out_linear_bias=paddle.to_tensor(out_b))
+    assert tuple(out.shape) == (b, m, s, dq)
+
+    # numpy reference (merged-qkv self attention with gating)
+    qkv = np.einsum("bmsd,thkd->tbmshk", q, qkv_w)
+    qv, kv, vv = qkv
+    sc = np.einsum("bmqhc,bmkhc->bmhqk", qv * hd ** -0.5, kv)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    att = np.einsum("bmhqk,bmkhc->bmqhc", p, vv)
+    gate = 1 / (1 + np.exp(-(np.einsum("bmsd,dhc->bmshc", q, gate_w)
+                             + gate_b)))
+    ref = np.einsum("bmshc,hcd->bmsd", att * gate, out_w) + out_b
+    np.testing.assert_allclose(out.numpy(), ref, atol=2e-5)
+
+    # separate-projection cross attention runs and has the right shape
+    k_in = rng.standard_normal((b, m, 7, dq)).astype(np.float32)
+    qw = rng.standard_normal((dq, h, hd)).astype(np.float32) * 0.2
+    out2 = IF.fused_gate_attention(
+        paddle.to_tensor(q), key=paddle.to_tensor(k_in),
+        query_weight=paddle.to_tensor(qw),
+        key_weight=paddle.to_tensor(qw), value_weight=paddle.to_tensor(qw),
+        gate_linear_weight=paddle.to_tensor(gate_w),
+        gate_linear_bias=paddle.to_tensor(gate_b),
+        out_linear_weight=paddle.to_tensor(out_w), merge_qkv=False)
+    assert tuple(out2.shape) == (b, m, s, dq)
+
+
+def test_varlen_attention_edge_cases_and_mha_guards():
+    """kv_seq_lens==0 rows are zeros (not NaN); query rows past seq_lens
+    are zeroed; unsupported fused_multi_head_attention args raise rather
+    than silently dropping the cache / TP reduce."""
+    q = paddle.to_tensor(np.ones((1, 1, 2, 4), np.float32))
+    out = IF.variable_length_memory_efficient_attention(
+        q, q, q, paddle.to_tensor(np.array([1], np.int32)),
+        paddle.to_tensor(np.array([0], np.int32)))
+    assert np.isfinite(out.numpy()).all()
+    assert (out.numpy() == 0).all()
+    out2 = IF.variable_length_memory_efficient_attention(
+        q, q, q, paddle.to_tensor(np.array([1], np.int32)),
+        paddle.to_tensor(np.array([2], np.int32)))
+    assert (out2.numpy()[0, 0, 1:] == 0).all()
+    assert (out2.numpy()[0, 0, 0] != 0).any()
+
+    w3 = paddle.to_tensor(np.zeros((3, 1, 4, 4), np.float32))
+    lw = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    x = paddle.to_tensor(np.zeros((1, 2, 4), np.float32))
+    with pytest.raises(NotImplementedError):
+        IF.fused_multi_head_attention(x, w3, lw, cache_kv=q)
+    with pytest.raises(NotImplementedError):
+        IF.fused_multi_head_attention(x, w3, lw, ring_id=0)

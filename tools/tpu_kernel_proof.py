@@ -62,13 +62,18 @@ def _grad_of(f, n_args):
 
 
 def run_family(name, pallas_fn, ref_fn, args, n_grad_args=0, tol=5e-2):
-    """Time + compare pallas vs composite on the same inputs."""
+    """Time + compare pallas vs composite on the same inputs. bench.py's
+    SIGALRM watchdog bounds each family (same Python-bytecode-granularity
+    limitation documented there): a stall inside one family must not eat
+    the remaining families' window."""
+    import bench
+
     res = {"ok": False}
 
     def rel(pairs):
         return max(e / max(m, 1e-6) for e, m in pairs)
 
-    try:
+    def _body():
         p_ms, p_out = _timed(pallas_fn, *args)
         x_ms, x_out = _timed(ref_fn, *args)
         import jax
@@ -94,6 +99,9 @@ def run_family(name, pallas_fn, ref_fn, args, n_grad_args=0, tol=5e-2):
         res["ok"] = worst <= tol
         if not res["ok"]:
             res["error"] = f"rel err {worst} > tol {tol}"
+
+    try:
+        bench._with_alarm(420, _body)
     except Exception:
         res["error"] = traceback.format_exc(limit=6)[:1500]
     return res
