@@ -72,11 +72,11 @@ from .framework.framework import (  # noqa: F401
     is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_custom_device,
     in_dynamic_mode, device_count, enable_static, disable_static,
     set_printoptions, CUDAPinnedPlace, get_cuda_rng_state,
-    set_cuda_rng_state,
+    set_cuda_rng_state, disable_signal_handler, check_shape,
 )
 from .framework import ParamAttr  # noqa: F401
 from .core.dtype import DType as dtype  # noqa: F401
-from .framework.parameter import create_parameter  # noqa: F401
+from .framework.parameter import create_parameter, LazyGuard  # noqa: F401
 from .batch import batch  # noqa: F401
 
 # -- subpackages (paddle.nn, paddle.optimizer, ...) ------------------------

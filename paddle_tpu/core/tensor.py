@@ -306,7 +306,8 @@ class Parameter(Tensor):
     """Trainable tensor (reference: EagerParamBase,
     python/paddle/base/framework.py). ``stop_gradient`` defaults to False."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer",
+                 "is_distributed", "_lazy_spec")
 
     def __init__(self, data, trainable: bool = True, name: str | None = None):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -315,6 +316,46 @@ class Parameter(Tensor):
         self.regularizer = None
         self.is_distributed = False
         self.persistable = True
+
+    def initialize(self):
+        """Materialize a lazily-created parameter (reference:
+        EagerParamBase.initialize under paddle.LazyGuard). No-op once
+        initialized."""
+        spec = getattr(self, "_lazy_spec", None)
+        if spec is not None:
+            from ..static.program import suspend_trace
+            shape, dt, initializer = spec
+            # same contract as create_parameter's eager path: the
+            # initializer must run outside any ambient static trace, or a
+            # Tracer would be stored as the parameter's data
+            with suspend_trace():
+                self._data = initializer(shape, dt)
+            self._lazy_spec = None
+        return self
+
+    # lazy params defer only VALUE allocation (reference LazyGuard
+    # semantics): shape/dtype metadata stays readable for sharding
+    # planners and summaries before initialize()
+    @property
+    def shape(self) -> list[int]:
+        spec = getattr(self, "_lazy_spec", None)
+        if self._d is None and spec is not None:
+            return list(spec[0])
+        return Tensor.shape.fget(self)
+
+    @property
+    def ndim(self) -> int:
+        spec = getattr(self, "_lazy_spec", None)
+        if self._d is None and spec is not None:
+            return len(spec[0])
+        return Tensor.ndim.fget(self)
+
+    @property
+    def dtype(self):
+        spec = getattr(self, "_lazy_spec", None)
+        if self._d is None and spec is not None:
+            return spec[1]
+        return Tensor.dtype.fget(self)
 
     @property
     def requires_grad(self):

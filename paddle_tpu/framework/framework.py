@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax
 
 __all__ = [
+    "disable_signal_handler", "check_shape",
     "CPUPlace", "CUDAPlace", "TPUPlace", "XPUPlace", "CustomPlace",
     "get_device", "set_device", "is_compiled_with_cuda", "is_compiled_with_xpu",
     "is_compiled_with_rocm", "is_compiled_with_custom_device", "in_dynamic_mode",
@@ -169,3 +170,45 @@ def get_cuda_rng_state():
 def set_cuda_rng_state(state_list):
     from ..core import generator as gen_mod
     gen_mod.default_generator.set_state(state_list[0])
+
+
+def disable_signal_handler():
+    """Reference: paddle.disable_signal_handler (base/framework.py:801) —
+    unregisters the C++ crash-logging signal handlers so frameworks like
+    TVM can own the signals. This build installs no native handlers (the
+    XLA runtime leaves signals alone), so there is nothing to undo; the
+    API exists for script portability."""
+    return None
+
+
+def check_shape(shape, op_name="check_shape",
+                expected_shape_type=(list, tuple),
+                expected_element_type=(int,),
+                expected_tensor_dtype=("int32", "int64")):
+    """Validate a shape argument before a creation/random op (reference:
+    paddle.check_shape via base/data_feeder.py:227). Tensors are accepted
+    as dynamic shapes (their dtype must be int32/int64); list/tuple
+    elements must be non-negative ints or int tensors."""
+    from ..core.tensor import Tensor
+
+    if isinstance(shape, Tensor):
+        if str(shape.dtype).split(".")[-1] not in expected_tensor_dtype:
+            raise TypeError(
+                f"{op_name}: a shape Tensor must be one of "
+                f"{expected_tensor_dtype}, got {shape.dtype}")
+        return
+    if not isinstance(shape, expected_shape_type):
+        raise TypeError(f"{op_name}: shape must be {expected_shape_type} "
+                        f"or Tensor, got {type(shape)}")
+    for ele in shape:
+        if isinstance(ele, Tensor):
+            if str(ele.dtype).split(".")[-1] not in expected_tensor_dtype:
+                raise TypeError(
+                    f"{op_name}: shape element Tensors must be one of "
+                    f"{expected_tensor_dtype}, got {ele.dtype}")
+            continue
+        if not isinstance(ele, expected_element_type):
+            raise TypeError(f"{op_name}: shape elements must be ints, "
+                            f"got {type(ele)}")
+        # no value check: the reference only type-checks, and -1 is the
+        # standard dynamic-dim marker in ported scripts

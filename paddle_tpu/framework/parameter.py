@@ -23,6 +23,29 @@ class ParamAttr:
         self.need_clip = need_clip
 
 
+_LAZY_INIT = False  # paddle.LazyGuard: defer parameter materialization
+
+
+class LazyGuard:
+    """Defer parameter initialization for Layers built in this context
+    (reference: paddle.LazyGuard, nn/initializer/lazy_init.py:91): inside
+    the guard `create_parameter` records the init spec instead of
+    allocating; `param.initialize()` materializes on demand. The TPU use
+    case is the same as the reference's: build a sharded model's
+    structure without host memory for the full dense weights."""
+
+    def __enter__(self):
+        global _LAZY_INIT
+        self._prev = _LAZY_INIT
+        _LAZY_INIT = True
+        return self
+
+    def __exit__(self, *exc):
+        global _LAZY_INIT
+        _LAZY_INIT = self._prev
+        return False
+
+
 def create_parameter(shape, dtype=None, name=None, attr=None,
                      is_bias=False, default_initializer=None) -> Parameter:
     from ..nn import initializer as init
@@ -41,8 +64,16 @@ def create_parameter(shape, dtype=None, name=None, attr=None,
     # reference records them into the STARTUP program and materializes at
     # exe.run(startup); we materialize now and snapshot for startup replay)
     with suspend_trace():
-        data = initializer(tuple(int(s) for s in shape), dt)
-        p = Parameter(data, trainable=trainable, name=name)
+        shp = tuple(int(s) for s in shape)
+        if _LAZY_INIT:
+            import jax.numpy as jnp
+            p = Parameter(jnp.zeros((), dt.np_dtype), trainable=trainable,
+                          name=name)
+            p._d = None  # no storage until initialize(); use raises
+            p._lazy_spec = (shp, dt, initializer)
+        else:
+            data = initializer(shp, dt)
+            p = Parameter(data, trainable=trainable, name=name)
     if isinstance(attr, ParamAttr):
         p.optimize_attr["learning_rate"] = attr.learning_rate
         p.regularizer = attr.regularizer

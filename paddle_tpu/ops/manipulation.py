@@ -23,7 +23,7 @@ __all__ = [
     "setitem_", "crop", "tensordot", "einsum", "tolist", "atleast_1d",
     "atleast_2d", "atleast_3d", "select_scatter", "diagonal_scatter",
     'unflatten', 'vsplit', 'hsplit', 'dsplit', 'tensor_split', 'hstack', 'vstack', 'dstack', 'column_stack', 'row_stack', 'take', 'index_fill', 'index_sample', 'shard_index', 'as_strided', 'multiplex',
-    'reverse', 'scatter_nd', 'unfold', 'squeeze_', 'unsqueeze_', 'transpose_', 't_', 'tril_', 'triu_', 'scatter_', 'masked_fill_', 'where_',
+    'reverse', 'scatter_nd', 'unfold', 'squeeze_', 'unsqueeze_', 'transpose_', 't_', 'tril_', 'triu_', 'scatter_', 'masked_fill_', 'where_', 'index_add_', 'index_put_', 'index_fill_',
 ]
 
 
@@ -864,3 +864,18 @@ def where_(condition, x=None, y=None, name=None):
             "condition-only nonzero() form has no in-place target)")
     from .math import _rebind
     return _rebind(x, where(condition, x, y))
+
+
+def index_add_(x, index, axis, value, name=None) -> Tensor:
+    from .math import _rebind
+    return _rebind(x, index_add(x, index, axis, value))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None) -> Tensor:
+    from .math import _rebind
+    return _rebind(x, index_put(x, indices, value, accumulate))
+
+
+def index_fill_(x, index, axis, value, name=None) -> Tensor:
+    from .math import _rebind
+    return _rebind(x, index_fill(x, index, axis, value))
