@@ -9,6 +9,7 @@ import math
 import numpy as np
 
 __all__ = ["get_window", "hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
+           "fft_frequencies", "mel_frequencies",
            "create_dct", "power_to_db"]
 
 
@@ -99,3 +100,22 @@ def power_to_db(spec, ref_value=1.0, amin=1e-10, top_db=80.0):
     if top_db is not None:
         log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
     return log_spec
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """FFT bin center frequencies in Hz (reference:
+    audio/functional/functional.py:163)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """Mel-spaced frequencies in Hz (reference: functional.py:123)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    lo = hz_to_mel(f_min, htk=htk)
+    hi = hz_to_mel(f_max, htk=htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk=htk)).astype(dtype))

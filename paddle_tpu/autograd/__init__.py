@@ -2,6 +2,7 @@ from .grad_mode import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  
 from .engine import backward, grad  # noqa: F401
 from .function import apply, apply_multi, GradNode  # noqa: F401
 from .pylayer import PyLayer, PyLayerContext  # noqa: F401
+from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
 _FUNCTIONAL = ("Hessian", "Jacobian", "hessian", "jacobian", "jvp", "vhp",
                "vjp")
 

@@ -18,17 +18,29 @@ __all__ = ["PyLayer", "PyLayerContext"]
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
+        self._unpack = None
         self.materialize_grads = True
         self._non_differentiable = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        from .saved_tensors_hooks import current_hooks
+        hooks = current_hooks()
+        if hooks is not None:
+            # pack on save, unpack on read (reference
+            # saved_tensors_hooks contract for custom layers)
+            self._saved = tuple(hooks[0](t) for t in tensors)
+            self._unpack = hooks[1]
+        else:
+            self._saved = tensors
+            self._unpack = None
 
     def saved_tensor(self):
+        if getattr(self, "_unpack", None) is not None:
+            return tuple(self._unpack(t) for t in self._saved)
         return self._saved
 
     # torch-style alias used by some reference tests
-    saved_tensors = property(lambda self: self._saved)
+    saved_tensors = property(lambda self: self.saved_tensor())
 
     def mark_non_differentiable(self, *tensors):
         self._non_differentiable = tensors

@@ -1,0 +1,40 @@
+"""Pack/unpack hooks for tensors saved for backward.
+
+Reference: python/paddle/autograd/saved_tensors_hooks.py:20 — a context
+manager whose pack hook runs when an op saves a tensor for its backward
+and whose unpack hook runs when the backward reads it (the canonical use
+is offloading saved activations to host memory).
+
+TPU scope: most activation saving here happens inside `jax.vjp` closures,
+which XLA manages (remat/offload ride `jax.checkpoint` and the recompute
+transform instead). What the framework itself saves explicitly — PyLayer
+`ctx.save_for_backward` — honors these hooks, matching the reference's
+contract for custom layers.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["saved_tensors_hooks"]
+
+_STATE = threading.local()
+
+
+def current_hooks():
+    return getattr(_STATE, "hooks", None)
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._prev = current_hooks()
+        _STATE.hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.hooks = self._prev
+        return False

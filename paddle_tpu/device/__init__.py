@@ -16,6 +16,8 @@ from ..framework.framework import (  # noqa: F401
 )
 
 __all__ = ["get_device", "set_device", "device_count", "synchronize",
+           "get_cudnn_version", "IPUPlace", "is_compiled_with_ipu",
+           "is_compiled_with_cinn", "get_all_custom_device_type", "set_stream",
            "get_all_device_type", "get_available_device",
            "get_available_custom_device", "memory_allocated",
            "max_memory_allocated", "memory_reserved", "empty_cache", "Stream",
@@ -175,3 +177,35 @@ class cuda:
     @staticmethod
     def empty_cache():
         pass
+
+
+def get_cudnn_version():
+    """No cuDNN in a TPU build (reference returns None when absent)."""
+    return None
+
+
+class IPUPlace:
+    """Name-compat placeholder (no IPU runtime in this build)."""
+
+    def __repr__(self):
+        return "Place(ipu)"
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    # XLA subsumes the CINN compiler in this build (SURVEY §7 mapping)
+    return False
+
+
+def get_all_custom_device_type():
+    """Custom devices arrive as PJRT plugins; none registered by default."""
+    return []
+
+
+def set_stream(stream=None):
+    """Streams are an XLA-runtime concern on TPU (no user-facing stream
+    handles); accepted for script portability."""
+    return stream

@@ -8,9 +8,10 @@ from .distributions import (  # noqa: F401
     AffineTransform, Bernoulli, Beta, Categorical, Cauchy, Dirichlet,
     Distribution, Exponential, ExpTransform, Geometric, Gumbel, Independent,
     Laplace, LogNormal, Multinomial, Normal, SigmoidTransform, Transform,
-    TransformedDistribution, Uniform, kl_divergence, register_kl)
+    TransformedDistribution, Uniform, kl_divergence, register_kl,
+    ExponentialFamily)
 
-__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+__all__ = ["ExponentialFamily", "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Exponential", "Beta", "Gumbel", "Laplace", "Cauchy", "Geometric",
            "LogNormal", "Dirichlet", "Multinomial", "Independent",
            "Transform", "AffineTransform", "ExpTransform",

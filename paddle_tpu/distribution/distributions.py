@@ -634,3 +634,42 @@ def _kl_lognormal_lognormal(p, q):
     var_ratio = (p.scale / q.scale) ** 2
     t1 = ((p.loc - q.loc) / q.scale) ** 2
     return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class ExponentialFamily(Distribution):
+    """Base class for exponential-family distributions (reference:
+    distribution/exponential_family.py:20): subclasses expose natural
+    parameters and the log normalizer F; entropy falls out of the Bregman
+    identity H = F(θ) - <θ, ∇F(θ)> + E[k(x)], with ∇F taken by jax
+    autodiff (the reference differentiates the static graph the same way).
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        nat = [jnp.asarray(getattr(p, "_data", p), jnp.float32)
+               for p in self._natural_parameters]
+
+        # grad of the SUMMED log normalizer gives the per-element ∇F for a
+        # batch of independent distributions, so entropy keeps the batch
+        # shape (the reference returns per-distribution entropies)
+        grads = jax.grad(
+            lambda *p: jnp.sum(self._log_normalizer(*p)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = self._log_normalizer(*nat) - sum(
+            t * g for t, g in zip(nat, grads))
+        return Tensor(ent - self._mean_carrier_measure)

@@ -58,8 +58,13 @@ def create_parameter(shape, dtype=None, name=None, attr=None,
     else:
         initializer, trainable = None, True
     if initializer is None:
-        initializer = default_initializer or (
-            init.Constant(0.0) if is_bias else init.XavierNormal())
+        # precedence per the reference set_global_initializer contract:
+        # an explicit ParamAttr initializer wins, then the global override,
+        # then the layer's default, then the framework default
+        initializer = (init._global_initializer(is_bias)
+                       or default_initializer
+                       or (init.Constant(0.0) if is_bias
+                           else init.XavierNormal()))
     # initializers run eagerly even inside a static program_guard (the
     # reference records them into the STARTUP program and materializes at
     # exe.run(startup); we materialize now and snapshot for startup replay)

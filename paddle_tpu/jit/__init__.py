@@ -1,2 +1,28 @@
 from .api import to_static, not_to_static, in_to_static_trace, enable_to_static, ignore_module  # noqa: F401
 from .save_load import save, load, TranslatedLayer, InputSpec  # noqa: F401
+
+# -- dy2static logging knobs (reference: jit/dy2static/logging_utils.py:187,
+# 226 set_verbosity/set_code_level over the TRANSLATOR_VERBOSITY env) -------
+import logging as _logging
+
+_logger = _logging.getLogger("paddle_tpu.jit")
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Verbosity of the to_static tracer's logging; level 0 silences."""
+    _logger.setLevel(_logging.DEBUG if level > 0 else _logging.WARNING)
+    if also_to_stdout and not _logger.handlers:
+        import sys
+        _logger.addHandler(_logging.StreamHandler(sys.stdout))
+    return level
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log traced/transformed code at the given level (the trace-based
+    to_static has no AST rewrite stage; the traced jaxpr is logged
+    instead when any level > 0 is set)."""
+    global _code_level
+    _code_level = level
+    set_verbosity(1 if level else 0, also_to_stdout)
+    return level

@@ -26,8 +26,10 @@ from .conv import *  # noqa: F401,F403
 from .conv import __all__ as _conv_all
 from .pooling import *  # noqa: F401,F403
 from .pooling import __all__ as _pool_all
+from .vision import *  # noqa: F401,F403
+from .vision import __all__ as _vision_all
 
-__all__ = list(_act_all) + list(_loss_all) + list(_conv_all) + list(_pool_all) + [
+__all__ = list(_act_all) + list(_loss_all) + list(_conv_all) + list(_pool_all) + list(_vision_all) + [
     "linear", "embedding", "layer_norm", "rms_norm", "fused_rms_norm_add",
     "batch_norm", "group_norm",
     "instance_norm", "normalize", "dropout", "dropout2d", "dropout3d",
@@ -35,7 +37,8 @@ __all__ = list(_act_all) + list(_loss_all) + list(_conv_all) + list(_pool_all) +
     "scaled_dot_product_attention", "sparse_attention", "interpolate",
     "upsample", "pixel_shuffle",
     "unfold", "label_smooth", "sequence_mask", "gumbel_softmax", "rope",
-    "gather_tree",
+    "gather_tree", "elu_", "hardtanh_", "leaky_relu_", "softmax_",
+    "thresholded_relu_",
 ]
 
 
@@ -585,3 +588,30 @@ def gather_tree(ids, parents, name=None) -> Tensor:
 # imports from .flash_attention; flash_attention/flash_attn_unpadded are
 # used via the module path paddle.nn.functional.flash_attention.*)
 from . import flash_attention  # noqa: F401,E402
+
+
+# -- in-place activation variants (reference *_ surface; rebind contract) ---
+
+def elu_(x, alpha=1.0, name=None) -> Tensor:
+    from ...ops.math import _rebind
+    return _rebind(x, elu(x, alpha))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None) -> Tensor:
+    from ...ops.math import _rebind
+    return _rebind(x, hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None) -> Tensor:
+    from ...ops.math import _rebind
+    return _rebind(x, leaky_relu(x, negative_slope))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None) -> Tensor:
+    from ...ops.math import _rebind
+    return _rebind(x, softmax(x, axis=axis, dtype=dtype))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None) -> Tensor:
+    from ...ops.math import _rebind
+    return _rebind(x, thresholded_relu(x, threshold, value))

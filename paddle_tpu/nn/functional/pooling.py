@@ -13,7 +13,7 @@ from ...autograd.function import apply
 __all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
            "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
            "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
-           "adaptive_max_pool3d"]
+           "adaptive_max_pool3d", "max_unpool1d", "max_unpool2d", "max_unpool3d"]
 
 
 def _max_init(dt):
@@ -60,6 +60,9 @@ def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, name,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None) -> Tensor:
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
+                                   data_format == "NLC", "max_pool1d")
     return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
                  jax.lax.max, _max_init,
                  "max_pool1d")
@@ -67,6 +70,9 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None) -> Tensor:
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
+                                   data_format == "NHWC", "max_pool2d")
     return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
                  jax.lax.max, _max_init,
                  "max_pool2d")
@@ -74,6 +80,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None) -> Tensor:
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
+                                   data_format == "NDHWC", "max_pool3d")
     return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
                  jax.lax.max, _max_init,
                  "max_pool3d")
@@ -157,3 +166,99 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None) -> Tensor:
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None) -> Tensor:
     return _adaptive(x, output_size, 3, False, "max", "adaptive_max_pool3d")
+
+
+def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last, name):
+    """(out, mask): max pool + flattened-argmax indices over the input's
+    spatial dims (reference return_mask contract — the mask feeds
+    max_unpool)."""
+    import itertools
+
+    k = _tup(kernel, n)
+    st = _tup(stride if stride is not None else kernel, n)
+    pd = _tup(padding, n)
+
+    def f(a):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        sp = a.shape[2:]
+        ap = jnp.pad(a, ((0, 0), (0, 0)) + tuple((p, p) for p in pd),
+                     constant_values=_max_init(a.dtype))
+        out_sp = tuple((ap.shape[2 + i] - k[i]) // st[i] + 1
+                       for i in range(n))
+        patches, flat_idx = [], []
+        for offs in itertools.product(*[range(ki) for ki in k]):
+            sl = ap[(slice(None), slice(None)) + tuple(
+                slice(offs[i], offs[i] + out_sp[i] * st[i], st[i])
+                for i in range(n))]
+            patches.append(sl)
+            idx = jnp.zeros((1, 1) + (1,) * n, jnp.int32)
+            for i in range(n):
+                pos = jnp.arange(out_sp[i]) * st[i] + offs[i] - pd[i]
+                shape = [1, 1] + [1] * n
+                shape[2 + i] = out_sp[i]
+                idx = idx * sp[i] + pos.reshape(shape)
+            flat_idx.append(jnp.broadcast_to(idx, sl.shape))
+        stacked = jnp.stack(patches, 0)             # [K, N, C, *out]
+        arg = jnp.argmax(stacked, axis=0)
+        out = jnp.max(stacked, axis=0)
+        mask = jnp.take_along_axis(jnp.stack(flat_idx, 0), arg[None], 0)[0]
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+            mask = jnp.moveaxis(mask, 1, -1)
+        return out, mask.astype(jnp.int32)
+
+    from ...autograd.function import apply_multi
+    return apply_multi(f, x, name=name)
+
+
+def _max_unpool(x, indices, kernel, stride, padding, output_size, n,
+                data_format, name):
+    """Scatter pooled values back to their argmax positions (reference:
+    max_unpool kernels; default out extent (in-1)*stride + k - 2*pad)."""
+    k = _tup(kernel, n)
+    st = _tup(stride if stride is not None else kernel, n)
+    pd = _tup(padding, n)
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+
+    def f(a, idx):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+            idx = jnp.moveaxis(idx, -1, 1)
+        nb, c = a.shape[:2]
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size)[-n:]
+        else:
+            out_sp = tuple((in_sp[i] - 1) * st[i] + k[i] - 2 * pd[i]
+                           for i in range(n))
+        s_total = int(np.prod(out_sp))
+        bi = jnp.arange(nb).reshape(nb, 1, 1)
+        ci = jnp.arange(c).reshape(1, c, 1)
+        mi = idx.reshape(nb, c, -1)
+        vals = a.reshape(nb, c, -1)
+        flat = jnp.zeros((nb, c, s_total), a.dtype).at[bi, ci, mi].set(vals)
+        out = flat.reshape((nb, c) + out_sp)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(f, x, indices, name=name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None) -> Tensor:
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 1, data_format, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None) -> Tensor:
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 2, data_format, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None) -> Tensor:
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 3, data_format, "max_unpool3d")

@@ -1,0 +1,282 @@
+"""Vision functionals (reference: python/paddle/nn/functional/vision.py —
+affine_grid, grid_sample, pixel_unshuffle, channel_shuffle, temporal_shift
+— plus common.py fold/bilinear/zeropad2d, norm.py local_response_norm and
+the partial-FC class_center_sample from common.py).
+
+All are pure jnp compositions: gathers/interpolation fuse under XLA, and
+the scatter-adds (fold) lower to efficient TPU scatter ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, as_tensor
+from ...autograd.function import apply, apply_multi
+
+__all__ = [
+    "affine_grid", "grid_sample", "pixel_unshuffle", "channel_shuffle",
+    "temporal_shift", "local_response_norm", "zeropad2d", "bilinear",
+    "fold", "class_center_sample",
+]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None) -> Tensor:
+    """Sampling grid from batched affine matrices (reference
+    vision.py affine_grid): theta [N, 2, 3] + out [N, C, H, W] ->
+    grid [N, H, W, 2]; theta [N, 3, 4] -> [N, D, H, W, 3]."""
+    tt = as_tensor(theta)
+    nd = 3 if tt.shape[-2] == 3 else 2
+    sp = tuple(int(s) for s in out_shape)[2:]
+
+    def f(th):
+        def axis_coords(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+        axes = [axis_coords(s) for s in sp]            # slowest..fastest
+        mesh = jnp.meshgrid(*axes, indexing="ij")      # each [*sp]
+        # base grid columns ordered (x, y[, z]) = fastest-varying first
+        cols = list(reversed(mesh)) + [jnp.ones(sp)]
+        base = jnp.stack(cols, axis=-1)                # [*sp, nd+1]
+        out = jnp.einsum("...k,njk->n...j", base, th)  # [N, *sp, nd]
+        return out.astype(th.dtype)
+
+    return apply(f, tt, name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None) -> Tensor:
+    """Sample x [N, C, H, W] at normalized grid [N, Ho, Wo, 2] (x, y in
+    [-1, 1]; reference vision.py grid_sample). Modes: bilinear | nearest;
+    padding: zeros | border | reflection."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) * (size - 1) / 2.0
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    def reflect(idx, size):
+        if size == 1:
+            return jnp.zeros_like(idx)
+        # reflect across the valid range borders (align_corners handling
+        # matches the reference: reflect about -0.5/size-0.5 when False)
+        lo, hi = (0.0, size - 1.0) if align_corners else (-0.5, size - 0.5)
+        span = hi - lo
+        idx = (idx - lo) % (2 * span)
+        idx = jnp.where(idx > span, 2 * span - idx, idx) + lo
+        return idx
+
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = unnormalize(g[..., 0].astype(jnp.float32), w)
+        gy = unnormalize(g[..., 1].astype(jnp.float32), h)
+        if padding_mode == "reflection":
+            gx = reflect(gx, w)
+            gy = reflect(gy, h)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            vals = a[jnp.arange(n)[:, None, None], :, iyc, ixc]
+            vals = jnp.moveaxis(vals, -1, 1)           # [N, C, Ho, Wo]
+            if padding_mode == "zeros":
+                inb = ((iy >= 0) & (iy <= h - 1) & (ix >= 0)
+                       & (ix <= w - 1))
+                vals = vals * inb[:, None].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(gy).astype(jnp.int32),
+                          jnp.round(gx).astype(jnp.int32)).astype(a.dtype)
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = (gx - x0)[:, None]
+        wy = (gy - y0)[:, None]
+        x0i = x0.astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        v00 = gather(y0i, x0i)
+        v01 = gather(y0i, x0i + 1)
+        v10 = gather(y0i + 1, x0i)
+        v11 = gather(y0i + 1, x0i + 1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return (top * (1 - wy) + bot * wy).astype(a.dtype)
+
+    return apply(f, x, grid, name="grid_sample")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW",
+                    name=None) -> Tensor:
+    """Inverse of pixel_shuffle (reference vision.py pixel_unshuffle)."""
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return apply(f, x, name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None) -> Tensor:
+    """Interleave channel groups (reference vision.py channel_shuffle,
+    the ShuffleNet mixing op)."""
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            a = a.transpose(0, 2, 1, 3, 4)
+            return a.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        a = a.transpose(0, 1, 2, 4, 3)
+        return a.reshape(n, h, w, c)
+
+    return apply(f, x, name="channel_shuffle")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None) -> Tensor:
+    """TSM channel shift across the time axis (reference:
+    nn/functional/extension.py temporal_shift): x [N*T, C, H, W]; the
+    first fold of channels shifts t-1 -> t, the second t+1 -> t."""
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        nt, c, h, w = a.shape
+        t = seg_num
+        n = nt // t
+        fold = int(c * shift_ratio)
+        v = a.reshape(n, t, c, h, w)
+        past = jnp.pad(v[:, :-1, :fold], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                          (0, 0)))
+        future = jnp.pad(v[:, 1:, fold:2 * fold],
+                         ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+        out = jnp.concatenate([past, future, v[:, :, 2 * fold:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(f, x, name="temporal_shift")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None) -> Tensor:
+    """AlexNet-style LRN across channels (reference norm.py
+    local_response_norm): x / (k + alpha/size * sum window(x^2))^beta."""
+
+    def f(a):
+        cl = data_format in ("NLC", "NHWC", "NDHWC")
+        ax = a.ndim - 1 if cl else 1
+        sq = jnp.square(a)
+        lo = (size - 1) // 2
+        hi = size - 1 - lo
+        pads = [(0, 0)] * a.ndim
+        pads[ax] = (lo, hi)
+        sqp = jnp.pad(sq, pads)
+        win = jax.lax.reduce_window(
+            sqp, jnp.zeros((), a.dtype), jax.lax.add,
+            tuple(size if i == ax else 1 for i in range(a.ndim)),
+            (1,) * a.ndim, "VALID")
+        return a / jnp.power(k + alpha / size * win, beta)
+
+    return apply(f, x, name="local_response_norm")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None) -> Tensor:
+    """Zero-pad H/W (reference common.py zeropad2d; padding
+    [left, right, top, bottom])."""
+    pl_, pr, pt, pb = (int(p) for p in padding)
+
+    def f(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl_, pr)))
+        return jnp.pad(a, ((0, 0), (pt, pb), (pl_, pr), (0, 0)))
+
+    return apply(f, x, name="zeropad2d")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None) -> Tensor:
+    """Bilinear transform out[b, o] = x1[b] W[o] x2[b]^T (+ bias)
+    (reference common.py bilinear over the bilinear_tensor_product op)."""
+    args = [as_tensor(x1), as_tensor(x2), as_tensor(weight)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def f(a, b, w, *mb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out + mb[0] if mb else out
+
+    return apply(f, *args, name="bilinear")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None) -> Tensor:
+    """col2im: inverse of unfold (reference common.py fold) — patches
+    [N, C*kh*kw, L] scatter-add back to [N, C, H, W]."""
+    os_ = np.broadcast_to(np.atleast_1d(output_sizes), (2,))
+    ks = np.broadcast_to(np.atleast_1d(kernel_sizes), (2,))
+    st = np.broadcast_to(np.atleast_1d(strides), (2,))
+    pd = np.broadcast_to(np.atleast_1d(paddings), (2,))
+    dl = np.broadcast_to(np.atleast_1d(dilations), (2,))
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        hp = os_[0] + 2 * pd[0]
+        wp = os_[1] + 2 * pd[1]
+        oh = (hp - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (wp - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = a.reshape(n, c, ks[0] * ks[1], oh, ow)
+        out = jnp.zeros((n, c, hp, wp), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = (slice(None), slice(None),
+                      slice(i * dl[0], i * dl[0] + oh * st[0], st[0]),
+                      slice(j * dl[1], j * dl[1] + ow * st[1], st[1]))
+                out = out.at[sl].add(v[:, :, i * ks[1] + j])
+        return out[:, :, pd[0]:hp - pd[0], pd[1]:wp - pd[1]]
+
+    return apply(f, x, name="fold")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Partial-FC negative-class sampling (reference common.py
+    class_center_sample): keep every positive class plus uniformly sampled
+    negatives up to num_samples; returns (remapped_label,
+    sampled_class_indices). Deterministic per framework seed."""
+    from ...core import generator as gen_mod
+
+    lt = as_tensor(label)
+    key = gen_mod.default_generator.split()
+
+    def f(lab):
+        pos = jnp.zeros((num_classes,), jnp.bool_).at[lab].set(True)
+        # rank positives first (stable), then shuffled negatives
+        r = jax.random.uniform(key, (num_classes,))
+        order = jnp.argsort(jnp.where(pos, -1.0, r))
+        sampled = jnp.sort(order[:num_samples])
+        # remap: position of each label inside `sampled`
+        inv = jnp.zeros((num_classes,), jnp.int32).at[sampled].set(
+            jnp.arange(num_samples, dtype=jnp.int32))
+        return inv[lab], sampled.astype(jnp.int32)
+
+    return apply_multi(f, lt, name="class_center_sample")

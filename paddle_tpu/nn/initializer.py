@@ -18,7 +18,8 @@ from ..core import generator as gen_mod
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "Bilinear",
+    "set_global_initializer",
 ]
 
 
@@ -185,3 +186,38 @@ class Dirac(Initializer):
                 center = tuple(s // 2 for s in shape[2:])
                 arr[(g * per + i, i) + center] = 1.0
         return jnp.asarray(arr)
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convolutions
+    (reference: nn/initializer/Bilinear.py:26): weight [C_out, C_in, K, K]
+    gets the separable triangle kernel so conv_transpose with stride f and
+    kernel 2f-f%2 performs bilinear upsampling out of the box."""
+
+    def __call__(self, shape, dtype=None):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight, got "
+                             f"{shape}")
+        k = shape[-1]
+        f = math.ceil(k / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = jnp.ogrid[:k, :k]
+        filt = ((1 - jnp.abs(og[0] / f - c))
+                * (1 - jnp.abs(og[1] / f - c)))       # [K, K]
+        w = jnp.broadcast_to(filt, tuple(shape))
+        return w.astype(dtypes.dtype_from_any(dtype).np_dtype)
+
+
+_GLOBAL_INITIALIZER: list = [None, None]  # [weight_init, bias_init]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override the default initializers used when a ParamAttr carries
+    none (reference: nn/initializer/__init__.py set_global_initializer;
+    pass None, None to restore the framework defaults)."""
+    _GLOBAL_INITIALIZER[0] = weight_init
+    _GLOBAL_INITIALIZER[1] = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _GLOBAL_INITIALIZER[1 if is_bias else 0]

@@ -5,7 +5,7 @@ from __future__ import annotations
 from ..layer import Layer
 from .. import functional as F
 
-__all__ = ["ReLU", "ReLU6", "LeakyReLU", "ELU", "CELU", "SELU", "GELU",
+__all__ = ["Softmax2D", "ReLU", "ReLU6", "LeakyReLU", "ELU", "CELU", "SELU", "GELU",
            "Sigmoid", "LogSigmoid", "Hardsigmoid", "Hardswish", "Hardtanh",
            "Hardshrink", "Softshrink", "Tanhshrink", "Silu", "Swish", "Mish",
            "Softplus", "Softsign", "Tanh", "Softmax", "LogSoftmax", "Maxout",
@@ -84,3 +84,14 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, self._data_format)
+
+
+class Softmax2D(Layer):
+    """Channel softmax for NCHW inputs (reference nn/layer/activation.py
+    Softmax2D: softmax over C for each spatial position)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(f"Softmax2D expects 3-D/4-D input, got "
+                             f"{x.ndim}-D")
+        return F.softmax(x, axis=-3)

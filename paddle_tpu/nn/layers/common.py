@@ -10,7 +10,8 @@ from ..layer import Layer
 from .. import initializer as I
 from .. import functional as F
 
-__all__ = ["Linear", "Identity", "Flatten", "Dropout", "Dropout2D", "Dropout3D",
+__all__ = ["Fold", "PixelUnshuffle", "ChannelShuffle", "Unflatten",
+           "Linear", "Identity", "Flatten", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Embedding", "Upsample", "UpsamplingNearest2D",
            "UpsamplingBilinear2D", "Bilinear", "CosineSimilarity",
            "PairwiseDistance", "PixelShuffle", "Unfold", "Pad1D", "Pad2D", "Pad3D",
@@ -204,9 +205,14 @@ class Unfold(Layer):
 
 
 class _PadNd(Layer):
+    _n_spatial = 1
+
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCL",
                  name=None):
         super().__init__()
+        if isinstance(padding, int):
+            # reference contract: a scalar pads every spatial boundary
+            padding = [padding] * (2 * self._n_spatial)
         self.padding, self.mode = padding, mode
         self.value, self.data_format = value, data_format
 
@@ -219,12 +225,16 @@ class Pad1D(_PadNd):
 
 
 class Pad2D(_PadNd):
+    _n_spatial = 2
+
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW",
                  name=None):
         super().__init__(padding, mode, value, data_format)
 
 
 class Pad3D(_PadNd):
+    _n_spatial = 3
+
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCDHW",
                  name=None):
         super().__init__(padding, mode, value, data_format)
@@ -233,3 +243,53 @@ class Pad3D(_PadNd):
 class ZeroPad2D(Pad2D):
     def __init__(self, padding, data_format="NCHW", name=None):
         super().__init__(padding, "constant", 0.0, data_format)
+
+
+class Fold(Layer):
+    """col2im layer (reference nn/layer/common.py Fold)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes, self.kernel_sizes = output_sizes, kernel_sizes
+        self.strides, self.paddings = strides, paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor, self.data_format = downscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups, self.data_format = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unflatten(Layer):
+    """Expand one axis into a shape (reference nn/layer/common.py
+    Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, list(shape)
+
+    def forward(self, x):
+        from ... import ops
+        ax = self.axis % x.ndim
+        new_shape = (list(x.shape[:ax]) + list(self.shape)
+                     + list(x.shape[ax + 1:]))
+        return ops.reshape(x, new_shape)
+

@@ -9,7 +9,7 @@ from ..layer import Layer
 from .. import initializer as I
 from .. import functional as F
 
-__all__ = ["LayerNorm", "RMSNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
+__all__ = ["SpectralNorm", "LayerNorm", "RMSNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
            "BatchNorm3D", "SyncBatchNorm", "GroupNorm", "InstanceNorm1D",
            "InstanceNorm2D", "InstanceNorm3D", "LocalResponseNorm"]
 
@@ -201,3 +201,51 @@ class LocalResponseNorm(Layer):
                       for i in range(sz))
             return a / jnp.power(k + alpha * acc / sz, beta)
         return apply(f, input, name="local_response_norm")
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight tensor by power iteration
+    (reference: nn/layer/norm.py:1868 SpectralNorm over the spectral_norm
+    op): forward(weight) returns weight / sigma_max, with persistent u/v
+    estimate buffers updated functionally each call (jit-compatible)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32", name=None):
+        super().__init__()
+        import numpy as np
+
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        import paddle_tpu as paddle
+        self.weight_u = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal(h).astype("float32"))
+        self.weight_v = paddle.to_tensor(
+            np.random.default_rng(1).standard_normal(w).astype("float32"))
+        self.register_buffer("weight_u", self.weight_u)
+        self.register_buffer("weight_v", self.weight_v)
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+
+        from ...autograd.function import apply_multi
+
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+
+        def f(w, u, v):
+            wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ (wm @ v)
+            return w / sigma, u, v
+
+        out, new_u, new_v = apply_multi(f, weight, self.weight_u,
+                                        self.weight_v, name="spectral_norm")
+        self.weight_u._data = new_u._data
+        self.weight_v._data = new_v._data
+        return out

@@ -11,7 +11,8 @@ in-matmul (bf16 accumulation on the MXU).
 
 from .config import QuantConfig, SingleLayerConfig  # noqa: F401
 from .observers import AbsmaxObserver, MovingAverageMinMaxObserver  # noqa: F401
-from .quanters import FakeQuanterWithAbsMaxObserver  # noqa: F401
+from .quanters import FakeQuanterWithAbsMaxObserver, BaseQuanter, quanter  # noqa: F401
+from .observers import BaseObserver  # noqa: F401
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
 from .wrapper import QuantedLinear, Int8WeightOnlyLinear  # noqa: F401
@@ -21,5 +22,5 @@ __all__ = [
     "QuantConfig", "SingleLayerConfig", "AbsmaxObserver",
     "MovingAverageMinMaxObserver", "FakeQuanterWithAbsMaxObserver", "QAT",
     "PTQ", "QuantedLinear", "Int8WeightOnlyLinear", "fake_quant",
-    "quantize_weight_int8",
+    "quantize_weight_int8", "BaseQuanter", "BaseObserver", "quanter",
 ]

@@ -17,7 +17,19 @@ from ..autograd.function import apply
 from .functional import fake_quant_array
 
 
-class FakeQuanterWithAbsMaxObserver(Layer):
+class BaseQuanter(Layer):
+    """Abstract quanter contract (reference: quantization/base_quanter.py:25
+    — forward produces the (fake-)quantized tensor; scales()/zero_points()
+    expose the learned/observed parameters)."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
                  name=None):
         super().__init__()
@@ -30,6 +42,12 @@ class FakeQuanterWithAbsMaxObserver(Layer):
     def _instance(self, layer):
         return FakeQuanterWithAbsMaxObserver(self.moving_rate,
                                              self.bit_length)
+
+    def scales(self):
+        return self._scale
+
+    def zero_points(self):
+        return None  # absmax quantization is symmetric
 
     def forward(self, x):
         mr = self.moving_rate
@@ -50,3 +68,26 @@ class FakeQuanterWithAbsMaxObserver(Layer):
 
     def scale(self):
         return float(self._scale)
+
+
+def quanter(class_name):
+    """Factory-declaration decorator (reference: quantization/factory.py:76
+    @quanter("Name")): registers `class_name` in paddle.quantization as a
+    partial-construction factory for the decorated quanter layer."""
+    def deco(cls):
+        class _Factory:
+            def __init__(self, *args, **kwargs):
+                self._args, self._kwargs = args, kwargs
+
+            def _instance(self, layer=None):
+                return cls(*self._args, **self._kwargs)
+
+            def __call__(self, *a, **kw):
+                return cls(*self._args, **self._kwargs)
+
+        _Factory.__name__ = class_name
+        import sys
+        mod = sys.modules["paddle_tpu.quantization"]
+        setattr(mod, class_name, _Factory)
+        return cls
+    return deco

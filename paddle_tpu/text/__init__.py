@@ -6,8 +6,10 @@ lax scans; the datasets load from locally cached files (no egress) through
 the paddle.dataset reader factories.
 """
 
-from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb, Imikolov, UCIHousing, Conll05st, Movielens, WMT14, WMT16)
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
 
 __all__ = ["ViterbiDecoder", "viterbi_decode", "Imdb", "Imikolov",
+           "Conll05st", "Movielens", "WMT14", "WMT16",
            "UCIHousing"]

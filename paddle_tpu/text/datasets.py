@@ -6,7 +6,8 @@ from __future__ import annotations
 
 from ..io import Dataset
 
-__all__ = ['Imdb', 'Imikolov', 'UCIHousing']
+__all__ = ['Imdb', 'Imikolov', 'UCIHousing', 'Conll05st',
+           'Movielens', 'WMT14', 'WMT16']
 
 
 def _check_mode(mode):
@@ -69,3 +70,87 @@ class UCIHousing(_ReaderDataset):
 
         fn = _uci.train if mode == 'train' else _uci.test
         super().__init__(fn(path=data_file))
+
+
+class Conll05st(_ReaderDataset):
+    """CoNLL-2005 SRL (reference text/datasets/conll05.py; test split only,
+    like the reference — the train set is licensed)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode='test',
+                 data_dir=None):
+        from ..dataset import conll05 as _c05
+
+        # data_file: the test tarball; data_dir: directory of the three
+        # dictionary files (defaults to the reader cache); explicit
+        # *_dict_file paths override individual dictionaries
+        if word_dict_file or verb_dict_file or target_dict_file:
+            import os
+            d = data_dir or os.path.dirname(word_dict_file or verb_dict_file
+                                            or target_dict_file)
+            self.word_dict = _c05._load_dict(
+                word_dict_file or os.path.join(d, 'wordDict.txt'))
+            self.verb_dict = _c05._load_dict(
+                verb_dict_file or os.path.join(d, 'verbDict.txt'))
+            raw = _c05._load_dict(
+                target_dict_file or os.path.join(d, 'targetDict.txt'))
+            self.label_dict = {}
+            for label in raw:
+                self.label_dict['B-' + label] = len(self.label_dict)
+                self.label_dict['I-' + label] = len(self.label_dict)
+            self.label_dict['O'] = len(self.label_dict)
+        else:
+            (self.word_dict, self.verb_dict,
+             self.label_dict) = _c05.get_dict(data_dir=data_dir)
+        super().__init__(_c05.test(data_file=data_file, data_dir=data_dir))
+
+    def get_dict(self):
+        return self.word_dict, self.verb_dict, self.label_dict
+
+
+class Movielens(_ReaderDataset):
+    """MovieLens-1M ratings (reference text/datasets/movielens.py)."""
+
+    def __init__(self, data_file=None, mode='train', test_ratio=0.1,
+                 rand_seed=0):
+        from ..dataset import movielens as _ml
+
+        _check_mode(mode)
+        super().__init__(_ml._reader(data_file, is_test=(mode == 'test'),
+                                     test_ratio=test_ratio,
+                                     rand_seed=rand_seed))
+
+
+class WMT14(_ReaderDataset):
+    """WMT'14 en-fr (reference text/datasets/wmt14.py)."""
+
+    def __init__(self, data_file=None, mode='train', dict_size=-1):
+        from ..dataset import wmt14 as _w14
+
+        _check_mode(mode)
+        self.dict_size = dict_size
+        self._data_file = data_file
+        super().__init__((_w14.train if mode == 'train' else _w14.test)(
+            dict_size=dict_size, data_file=data_file))
+
+    def get_dict(self, reverse=False):
+        from ..dataset import wmt14 as _w14
+        return _w14.get_dict(self.dict_size, reverse=reverse,
+                             data_file=self._data_file)
+
+
+class WMT16(_ReaderDataset):
+    """WMT'16 en-de multimodal subset (reference text/datasets/wmt16.py)."""
+
+    def __init__(self, data_file=None, mode='train', src_dict_size=-1,
+                 trg_dict_size=-1, lang='en'):
+        from ..dataset import wmt16 as _w16
+
+        readers = {'train': _w16.train, 'test': _w16.test,
+                   'val': _w16.validation}
+        if mode not in readers:
+            raise ValueError(f"mode must be one of {sorted(readers)}, "
+                             f"got {mode!r}")
+        super().__init__(readers[mode](
+            src_dict_size, trg_dict_size, src_lang=lang,
+            data_file=data_file))

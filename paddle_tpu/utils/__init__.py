@@ -6,7 +6,7 @@ from . import unique_name  # noqa: F401
 from .deprecated import deprecated  # noqa: F401
 from .lazy_import import try_import  # noqa: F401
 
-__all__ = ["cpp_extension", "deprecated", "try_import", "unique_name",
+__all__ = ["run_check", "cpp_extension", "deprecated", "try_import", "unique_name",
            "dlpack", "require_version"]
 
 
@@ -38,3 +38,20 @@ def require_version(min_version: str, max_version: str | None = None):
     if hi is not None and pad(hi) < cur:
         raise RuntimeError(
             f"installed version {__version__} > allowed max {max_version}")
+
+
+def run_check():
+    """Smoke-check the install (reference: utils/install_check.py:213):
+    run a tiny matmul + grad on the current backend and report."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    y = (x @ x).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), 4.0), "gradient check failed"
+    dev = jax.devices()[0]
+    print(f"PaddleTPU is installed successfully! device: "
+          f"{getattr(dev, 'device_kind', dev.platform)}")

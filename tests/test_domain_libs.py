@@ -411,3 +411,53 @@ class TestNewDistributions:
         np.testing.assert_allclose(
             np.asarray(td.log_prob(x).numpy()),
             np.asarray(Normal(0.0, 1.0).log_prob(x).numpy()))
+
+
+def test_text_dataset_classes_r4b(tmp_path):
+    """Conll05st/WMT14 map-style Dataset classes over the cached readers
+    (reference: python/paddle/text/datasets/). Synthesized caches, same
+    fixtures as the reader roundtrip tests."""
+    import gzip
+    import io
+    import tarfile
+
+    from paddle_tpu.text import Conll05st, WMT14
+
+    # -- wmt14 ---------------------------------------------------------
+    tar_path = tmp_path / "wmt14.tgz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        def add(name, text):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        add("wmt14/src.dict", "hello\nworld\n")
+        add("wmt14/trg.dict", "bonjour\nmonde\n")
+        add("wmt14/train/part-00", "hello world\tbonjour monde\n")
+        add("wmt14/test/part-00", "world hello\tmonde bonjour\n")
+    ds = WMT14(data_file=str(tar_path), mode="train")
+    assert len(ds) == 1
+    src_ids, trg_ids, trg_next = ds[0]
+    assert src_ids == [3, 4]
+    src_dict, _ = ds.get_dict()
+    assert src_dict["hello"] == 3
+
+    # -- conll05 -------------------------------------------------------
+    d = tmp_path
+    (d / "wordDict.txt").write_text("<unk>\nthe\ncat\nsat\n")
+    (d / "verbDict.txt").write_text("<unk>\nsat\n")
+    (d / "targetDict.txt").write_text("A0\nV\n")
+    words = "The x\ncat x\nsat x\n\n"
+    props = "- *\n- (A0*)\nsat (V*)\n\n"
+    ctar = d / "conll05st-tests.tar.gz"
+    with tarfile.open(ctar, "w:gz") as tf:
+        for name, text in (("conll05st/test.wsj.words.gz", words),
+                           ("conll05st/test.wsj.props.gz", props)):
+            data = gzip.compress(text.encode())
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    ds = Conll05st(data_file=str(ctar), data_dir=str(d))
+    assert len(ds) == 1
+    word_d, verb_d, label_d = ds.get_dict()
+    assert ds[0][0] == [word_d["the"], word_d["cat"], word_d["sat"]]
