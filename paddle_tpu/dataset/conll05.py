@@ -39,17 +39,19 @@ def _load_dict(path):
     return d
 
 
-def get_dict(data_dir=None):
+def get_dict(data_dir=None, word_dict_file=None, verb_dict_file=None,
+             target_dict_file=None):
     """(word_dict, verb_dict, label_dict) from the cached dictionary files
-    (reference load_dict + label-dict IOB expansion)."""
+    (reference load_dict + label-dict IOB expansion). Explicit *_file
+    paths override individual dictionaries (the text.Conll05st surface)."""
     d = data_dir or _DIR
-    word_dict = _load_dict(_need(os.path.join(d, 'wordDict.txt'),
-                                 'conll05 word dict'))
-    verb_dict = _load_dict(_need(os.path.join(d, 'verbDict.txt'),
-                                 'conll05 verb dict'))
+    word_dict = _load_dict(word_dict_file or _need(
+        os.path.join(d, 'wordDict.txt'), 'conll05 word dict'))
+    verb_dict = _load_dict(verb_dict_file or _need(
+        os.path.join(d, 'verbDict.txt'), 'conll05 verb dict'))
     # reference expands each target label L into B-L / I-L and adds O
-    raw = _load_dict(_need(os.path.join(d, 'targetDict.txt'),
-                           'conll05 target dict'))
+    raw = _load_dict(target_dict_file or _need(
+        os.path.join(d, 'targetDict.txt'), 'conll05 target dict'))
     label_dict = {}
     for label in raw:
         label_dict['B-' + label] = len(label_dict)

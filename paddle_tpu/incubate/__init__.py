@@ -8,3 +8,108 @@ from . import operators  # noqa: F401
 from .operators import (  # noqa: F401
     softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
 )
+
+# -- legacy incubate surface: aliases over geometric/ + the wrapper
+# optimizers (reference: python/paddle/incubate/__init__.py __all__) --------
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from ..geometric import (  # noqa: F401
+    segment_sum, segment_mean, segment_max, segment_min)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Legacy alias of geometric.send_u_recv (reference:
+    incubate/operators/graph_send_recv.py)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Legacy alias of geometric.sample_neighbors (reference:
+    incubate/operators/graph_sample_neighbors.py)."""
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids,
+                            perm_buffer=perm_buffer)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Legacy alias of geometric.reindex_graph (reference:
+    incubate/operators/graph_reindex.py)."""
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighborhood sampling (reference:
+    incubate/operators/graph_khop_sampler.py:21): repeated
+    sample_neighbors, then one compact renumbering over the union of
+    frontiers. Returns (edge_src, edge_dst, sample_index,
+    reindex_nodes[, edge_eids]) — sample_index holds the ORIGINAL ids of
+    every involved node (input-first order), reindex_nodes the compact
+    positions of input_nodes. Host-side numpy like the sampling readers
+    (this path never traces)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor, as_tensor
+    from ..geometric import sample_neighbors
+
+    cur = input_nodes
+    frontiers_np = [as_tensor(input_nodes).numpy()]
+    all_neigh, all_cnt, all_eids = [], [], []
+    for size in sample_sizes:
+        if return_eids:
+            neigh, cnt, eids = sample_neighbors(
+                row, colptr, cur, sample_size=size, eids=sorted_eids,
+                return_eids=True)
+            all_eids.append(eids.numpy())
+        else:
+            neigh, cnt = sample_neighbors(row, colptr, cur,
+                                          sample_size=size)
+        all_neigh.append(neigh.numpy())
+        all_cnt.append(cnt.numpy())
+        cur = neigh                       # next frontier: this hop's output
+        frontiers_np.append(neigh.numpy())
+
+    # compact id space: input nodes first, then first-seen sampled nodes
+    flat = np.concatenate(frontiers_np)
+    uniq, first_idx = np.unique(flat, return_index=True)
+    uniq = uniq[np.argsort(first_idx)]
+    remap = {int(v): i for i, v in enumerate(uniq)}
+    # dst of each edge is the frontier NODE it was sampled for — remap the
+    # node id itself, never its (possibly duplicated) frontier position
+    centers = np.concatenate(frontiers_np[:-1])
+    counts = np.concatenate(all_cnt)
+    dst_nodes = np.repeat(centers, counts)
+    dst = np.asarray([remap[int(v)] for v in dst_nodes], np.int64)
+    src = np.asarray([remap[int(v)] for v in np.concatenate(all_neigh)],
+                     np.int64)
+    reindex_nodes = np.asarray(
+        [remap[int(v)] for v in frontiers_np[0]], np.int64)
+    out = (Tensor(src), Tensor(dst), Tensor(uniq.astype(np.int64)),
+           Tensor(reindex_nodes))
+    if return_eids:
+        return out + (Tensor(np.concatenate(all_eids)),)
+    return out
+
+
+def identity_loss(x, reduction="none"):
+    """Reduction marker for the final loss (reference:
+    incubate/nn/loss.py:21; int codes 0=sum, 1=mean, 2=none)."""
+    from ..core.tensor import as_tensor
+
+    xt = as_tensor(x)
+    if reduction in ("sum", 0):
+        return xt.sum()
+    if reduction in ("mean", 1):
+        return xt.mean()
+    if reduction in ("none", 2):
+        return xt
+    raise ValueError(f"unknown reduction {reduction!r}")

@@ -228,7 +228,14 @@ def _max_unpool(x, indices, kernel, stride, padding, output_size, n,
         nb, c = a.shape[:2]
         in_sp = a.shape[2:]
         if output_size is not None:
-            out_sp = tuple(int(s) for s in output_size)[-n:]
+            os_ = tuple(int(s) for s in output_size)
+            if len(os_) == n + 2:
+                # full-shape spec: extract the spatial dims per layout
+                os_ = os_[1:-1] if channel_last else os_[2:]
+            if len(os_) != n:
+                raise ValueError(f"output_size needs {n} spatial dims "
+                                 f"(or the full shape), got {output_size}")
+            out_sp = os_
         else:
             out_sp = tuple((in_sp[i] - 1) * st[i] + k[i] - 2 * pd[i]
                            for i in range(n))

@@ -30,6 +30,9 @@ def affine_grid(theta, out_shape, align_corners=True, name=None) -> Tensor:
     tt = as_tensor(theta)
     nd = 3 if tt.shape[-2] == 3 else 2
     sp = tuple(int(s) for s in out_shape)[2:]
+    if len(sp) != nd:
+        raise ValueError(f"theta is {nd}-D ({tt.shape[-2]}x{tt.shape[-1]}) "
+                         f"but out_shape has {len(sp)} spatial dims")
 
     def f(th):
         def axis_coords(size):

@@ -11,7 +11,9 @@ from ...nn import initializer as I
 from . import functional  # noqa: F401
 from . import functional as F
 
-__all__ = ["Conv3D", "SubmConv3D", "MaxPool3D", "ReLU", "Softmax"]
+__all__ = ["Conv3D", "SubmConv3D", "MaxPool3D", "ReLU", "Softmax",
+           "Conv2D", "SubmConv2D", "ReLU6", "LeakyReLU", "BatchNorm",
+           "SyncBatchNorm"]
 
 
 class _Conv3D(Layer):
@@ -88,3 +90,109 @@ class Softmax(Layer):
     def forward(self, x):
         from .. import softmax
         return softmax(x, self._axis)
+
+
+class _Conv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__()
+        if groups != 1:
+            raise ValueError("sparse Conv2D supports groups=1 only")
+        if isinstance(kernel_size, int):
+            ks = [kernel_size, kernel_size]
+        else:
+            ks = [int(k) for k in kernel_size]
+            if len(ks) != 2:
+                raise ValueError(f"Conv2D kernel_size needs 2 values, got "
+                                 f"{kernel_size}")
+        self._kernel_size = list(ks)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        fan_in = in_channels * ks[0] * ks[1]
+        std = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels, out_channels], weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = self.create_parameter(
+            [out_channels], bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std)) \
+            if bias_attr is not False else None
+
+
+class Conv2D(_Conv2D):
+    """Sparse conv2d layer (reference sparse/nn/layer/conv.py Conv2D)."""
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class SubmConv2D(_Conv2D):
+    """Submanifold sparse conv2d (reference conv.py SubmConv2D)."""
+
+    def __init__(self, *args, key=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._key = key
+
+    def forward(self, x):
+        return F.subm_conv2d(x, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation, self._groups,
+                             self._data_format, key=self._key)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from .. import relu6
+        return relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from .. import leaky_relu
+        return leaky_relu(x, self._slope)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the nnz values of a channel-last SparseCooTensor
+    (reference: sparse/nn/layer/norm.py:24 — dense BatchNorm1D applied to
+    the values; the sparsity pattern is untouched)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr,
+                               use_global_stats=use_global_stats)
+
+    def forward(self, x):
+        from .. import SparseCooTensor, is_sparse
+        from jax.experimental import sparse as jsparse
+
+        if not is_sparse(x):
+            raise TypeError("sparse.nn.BatchNorm expects a SparseCooTensor")
+        vals = self._bn(x.values())
+        b = x._b
+        out = SparseCooTensor(jsparse.BCOO((vals._data, b.indices),
+                                           shape=b.shape))
+        out._values_tensor = vals
+        return out
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BatchNorm (reference sparse/nn/layer/norm.py
+    SyncBatchNorm). Stats sync rides the dense SyncBatchNorm semantics:
+    under GSPMD, batch stats of replicated modules reduce automatically;
+    the single-controller path equals BatchNorm."""
+    pass

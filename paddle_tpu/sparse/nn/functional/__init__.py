@@ -30,7 +30,9 @@ from ....core.tensor import Tensor, as_tensor
 from ....autograd.function import apply
 from ... import SparseCooTensor
 
-__all__ = ["conv3d", "subm_conv3d", "max_pool3d"]
+__all__ = ["conv3d", "subm_conv3d", "max_pool3d", "conv2d",
+           "subm_conv2d", "relu", "relu6", "leaky_relu", "softmax",
+           "attention"]
 
 
 def _triple(v):
@@ -242,3 +244,99 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     out = SparseCooTensor(b)
     out._values_tensor = out_vals
     return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None) -> SparseCooTensor:
+    """Sparse conv2d (reference functional/conv.py conv2d): lifted onto
+    the 3-D rulebook machinery with a unit depth axis."""
+    return _conv2d_impl(x, weight, bias, stride, padding, dilation, groups,
+                        data_format, submanifold=False)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None,
+                name=None) -> SparseCooTensor:
+    """Submanifold sparse conv2d (reference functional/conv.py
+    subm_conv2d)."""
+    return _conv2d_impl(x, weight, bias, stride, padding, dilation, groups,
+                        data_format, submanifold=True)
+
+
+def _conv2d_impl(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, submanifold):
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ... import SparseCooTensor, sparse_coo_tensor
+    from ....core.tensor import as_tensor
+
+    if data_format != "NHWC":
+        raise ValueError("sparse conv2d is NHWC (reference contract)")
+    b = x._b
+    n, h, w, c = b.shape
+    # lift [N, H, W, C] -> [N, 1, H, W, C]
+    idx = jnp.asarray(b.indices)
+    idx3 = jnp.concatenate([idx[:, :1],
+                            jnp.zeros((idx.shape[0], 1), idx.dtype),
+                            idx[:, 1:]], axis=1)
+    x3 = sparse_coo_tensor(idx3.T, x.values(), (n, 1, h, w, c))
+    kw = as_tensor(weight)
+    if kw.ndim == 4:  # [kh, kw, C, M] -> [1, kh, kw, C, M]
+        from .... import ops
+        kw = ops.unsqueeze(kw, 0)
+
+    def lift(v, neutral):
+        a = np.atleast_1d(v)
+        if a.size == 1:
+            a = np.repeat(a, 2)
+        return [neutral] + [int(e) for e in a[:2]]
+
+    fn = subm_conv3d if submanifold else conv3d
+    out3 = fn(x3, kw, bias, lift(stride, 1), lift(padding, 0),
+              lift(dilation, 1), groups, "NDHWC")
+    ob = out3._b
+    oidx = jnp.asarray(ob.indices)
+    oidx2 = jnp.concatenate([oidx[:, :1], oidx[:, 2:]], axis=1)
+    shp = ob.shape
+    return sparse_coo_tensor(oidx2.T, out3.values(),
+                             (shp[0], shp[2], shp[3], shp[4]))
+
+
+def relu(x, name=None):
+    from ... import relu as _op
+    return _op(x)
+
+
+def relu6(x, name=None):
+    from ... import relu6 as _op
+    return _op(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from ... import leaky_relu as _op
+    return _op(x, negative_slope)
+
+
+def softmax(x, axis=-1, name=None):
+    from ... import softmax as _op
+    return _op(x, axis)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference functional/transformer.py:22): the
+    CSR sparse_mask carries the attended positions; masks add before the
+    sparse softmax. Delegates to the framework's CSR sparse-attention
+    path."""
+    from ....nn.functional import sparse_attention as dense_entry
+    from ....core.tensor import as_tensor
+
+    q = as_tensor(query)
+    crows = as_tensor(sparse_mask.crows()) if hasattr(sparse_mask, "crows") \
+        else as_tensor(sparse_mask[0])
+    cols = as_tensor(sparse_mask.cols()) if hasattr(sparse_mask, "cols") \
+        else as_tensor(sparse_mask[1])
+    return dense_entry(q, as_tensor(key), as_tensor(value), crows, cols,
+                       key_padding_mask=key_padding_mask,
+                       attn_mask=attn_mask)

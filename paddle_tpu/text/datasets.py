@@ -84,24 +84,10 @@ class Conll05st(_ReaderDataset):
         # data_file: the test tarball; data_dir: directory of the three
         # dictionary files (defaults to the reader cache); explicit
         # *_dict_file paths override individual dictionaries
-        if word_dict_file or verb_dict_file or target_dict_file:
-            import os
-            d = data_dir or os.path.dirname(word_dict_file or verb_dict_file
-                                            or target_dict_file)
-            self.word_dict = _c05._load_dict(
-                word_dict_file or os.path.join(d, 'wordDict.txt'))
-            self.verb_dict = _c05._load_dict(
-                verb_dict_file or os.path.join(d, 'verbDict.txt'))
-            raw = _c05._load_dict(
-                target_dict_file or os.path.join(d, 'targetDict.txt'))
-            self.label_dict = {}
-            for label in raw:
-                self.label_dict['B-' + label] = len(self.label_dict)
-                self.label_dict['I-' + label] = len(self.label_dict)
-            self.label_dict['O'] = len(self.label_dict)
-        else:
-            (self.word_dict, self.verb_dict,
-             self.label_dict) = _c05.get_dict(data_dir=data_dir)
+        (self.word_dict, self.verb_dict, self.label_dict) = _c05.get_dict(
+            data_dir=data_dir, word_dict_file=word_dict_file,
+            verb_dict_file=verb_dict_file,
+            target_dict_file=target_dict_file)
         super().__init__(_c05.test(data_file=data_file, data_dir=data_dir))
 
     def get_dict(self):

@@ -14,7 +14,14 @@ import numpy as np
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
-           "Transpose", "BrightnessTransform", "Pad"]
+           "Transpose", "BrightnessTransform", "Pad", "BaseTransform",
+           "RandomResizedCrop", "SaturationTransform", "ContrastTransform",
+           "HueTransform", "ColorJitter", "RandomAffine", "RandomRotation",
+           "RandomPerspective", "Grayscale", "RandomErasing", "to_tensor",
+           "hflip", "vflip", "resize", "pad", "affine", "rotate",
+           "perspective", "to_grayscale", "crop", "center_crop",
+           "adjust_brightness", "adjust_contrast", "adjust_saturation",
+           "adjust_hue", "normalize", "erase"]
 
 
 class Compose:
@@ -176,3 +183,473 @@ class Pad:
         pad = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
         return np.pad(arr, pad, mode="constant",
                       constant_values=self.fill)
+
+
+# -- r4b completion: the functional surface + remaining transform classes
+# (reference: python/paddle/vision/transforms/{functional.py,transforms.py})
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return np.asarray(img)[::-1].copy()
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _resize_np(np.asarray(img), size)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    p = padding
+    if isinstance(p, numbers.Number):
+        p = (p, p, p, p)
+    elif len(p) == 2:
+        p = (p[0], p[1], p[0], p[1])
+    widths = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, widths, mode="constant", constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(arr, widths, mode=mode)
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = np.asarray(img)
+    ceil = 255.0 if np.issubdtype(arr.dtype, np.integer) else 1.0
+    out = np.clip(arr.astype(np.float32) * brightness_factor, 0, ceil)
+    return out.astype(arr.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img)
+    ceil = 255.0 if np.issubdtype(arr.dtype, np.integer) else 1.0
+    f = arr.astype(np.float32)
+    gray_mean = f.mean() if f.ndim == 2 else \
+        (f @ np.array([0.299, 0.587, 0.114], np.float32)).mean() \
+        if f.shape[-1] == 3 else f.mean()
+    out = np.clip(gray_mean + contrast_factor * (f - gray_mean), 0, ceil)
+    return out.astype(arr.dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = np.asarray(img)
+    ceil = 255.0 if np.issubdtype(arr.dtype, np.integer) else 1.0
+    f = arr.astype(np.float32)
+    gray = f @ np.array([0.299, 0.587, 0.114], np.float32)
+    out = np.clip(gray[..., None] + saturation_factor
+                  * (f - gray[..., None]), 0, ceil)
+    return out.astype(arr.dtype)
+
+
+def _rgb_to_hsv(f):
+    mx = f.max(-1)
+    mn = f.min(-1)
+    d = mx - mn
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    h = np.zeros_like(mx)
+    nz = d > 0
+    idx = (mx == r) & nz
+    h[idx] = ((g - b)[idx] / d[idx]) % 6
+    idx = (mx == g) & nz & (mx != r)
+    h[idx] = (b - r)[idx] / d[idx] + 2
+    idx = (mx == b) & nz & (mx != r) & (mx != g)
+    h[idx] = (r - g)[idx] / d[idx] + 4
+    h = h / 6.0
+    s = np.where(mx > 0, d / np.maximum(mx, 1e-12), 0.0)
+    return h, s, mx
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int32) % 6
+    out = np.zeros(h.shape + (3,), np.float32)
+    for k, (rr, gg, bb) in enumerate(((v, t, p), (q, v, p), (p, v, t),
+                                      (p, q, v), (t, p, v), (v, p, q))):
+        m = i == k
+        out[m, 0] = rr[m]
+        out[m, 1] = gg[m]
+        out[m, 2] = bb[m]
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = np.asarray(img)
+    is_int = np.issubdtype(arr.dtype, np.integer)
+    f = arr.astype(np.float32) / (255.0 if is_int else 1.0)
+    h, s, v = _rgb_to_hsv(f)
+    h = (h + hue_factor) % 1.0
+    out = _hsv_to_rgb(h, s, v)
+    if is_int:
+        return np.clip(out * 255.0, 0, 255).astype(arr.dtype)
+    return out.astype(arr.dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img)
+    f = arr.astype(np.float32)
+    gray = f @ np.array([0.299, 0.587, 0.114], np.float32) if \
+        f.ndim == 3 and f.shape[-1] == 3 else f.reshape(f.shape[:2])
+    out = np.repeat(gray[..., None], num_output_channels, -1)
+    return out.astype(arr.dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    if out.ndim == 3 and out.shape[0] in (1, 3) and out.shape[-1] not in \
+            (1, 3):
+        out[:, i:i + h, j:j + w] = v  # CHW
+    else:
+        out[i:i + h, j:j + w] = v     # HWC
+    return out
+
+
+def _inverse_warp(arr, inv_matrix, out_hw, fill=0):
+    """Sample arr at inv_matrix @ (x_out, y_out, 1) — the shared engine
+    for affine/rotate/perspective (nearest sampling, matching _resize_np's
+    no-PIL policy)."""
+    oh, ow = out_hw
+    ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1).astype(np.float64)
+    m = np.asarray(inv_matrix, np.float64).reshape(3, 3)
+    src = m @ coords
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    xi = np.round(sx).astype(np.int64)
+    yi = np.round(sy).astype(np.int64)
+    h, w = arr.shape[:2]
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    xi = np.clip(xi, 0, w - 1)
+    yi = np.clip(yi, 0, h - 1)
+    flat = arr[yi, xi]
+    if arr.ndim == 3:
+        flat = np.where(valid[:, None], flat, np.float64(fill)).astype(
+            arr.dtype)
+        return flat.reshape(oh, ow, arr.shape[2])
+    flat = np.where(valid, flat, fill).astype(arr.dtype)
+    return flat.reshape(oh, ow)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    # forward matrix: T(center) R S Shear T(-center) + translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0], [0, 0, 1.0]]) * 1.0
+    m[:2, :2] *= scale
+    m[0, 2] = cx + translate[0] - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = cy + translate[1] - m[1, 0] * cx - m[1, 1] * cy
+    return m
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine warp (reference functional.py affine); inverse-mapped
+    nearest sampling."""
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    return _inverse_warp(arr, np.linalg.inv(m), (h, w), fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by `angle` degrees (reference
+    functional.py rotate)."""
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    # positive angle = counter-clockwise on the displayed image (y-down
+    # array coords invert the usual math orientation)
+    m = _affine_matrix(angle, (0, 0), 1.0, (0.0, 0.0), center)
+    out_hw = (h, w)
+    if expand:
+        corners = np.array([[0, 0, 1], [w - 1, 0, 1], [0, h - 1, 1],
+                            [w - 1, h - 1, 1]], np.float64).T
+        mapped = np.linalg.inv(m) @ corners
+        xs_, ys_ = mapped[0], mapped[1]
+        nw = int(np.ceil(xs_.max() - xs_.min() + 1))
+        nh = int(np.ceil(ys_.max() - ys_.min() + 1))
+        shift = np.eye(3)
+        shift[0, 2] = xs_.min()
+        shift[1, 2] = ys_.min()
+        m = m @ shift
+        out_hw = (nh, nw)
+    return _inverse_warp(arr, m, out_hw, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp mapping startpoints -> endpoints (reference
+    functional.py perspective): homography solved from the 4 pairs."""
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    a = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        a.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        bvec += [ex, ey]
+    hvec = np.linalg.solve(np.asarray(a, np.float64),
+                           np.asarray(bvec, np.float64))
+    m = np.append(hvec, 1.0).reshape(3, 3)
+    return _inverse_warp(arr, np.linalg.inv(m), (h, w), fill)
+
+
+class BaseTransform:
+    """Transform protocol (reference transforms.py BaseTransform):
+    subclasses implement _apply_image (and optionally _get_params); keys
+    select which inputs are images."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+        self.params = None
+
+    def _get_params(self, inputs):
+        return None
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        single = not isinstance(inputs, (list, tuple))
+        ins = (inputs,) if single else tuple(inputs)
+        self.params = self._get_params(ins)
+        outs = []
+        for key, data in zip(self.keys, ins):
+            outs.append(self._apply_image(data) if key == "image" else data)
+        outs += list(ins[len(self.keys):])
+        return outs[0] if single else tuple(outs)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("saturation value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        # reference sampling domain [max(0, 1-v), 1+v]: the factor never
+        # goes negative (a negative factor would invert the image)
+        return adjust_saturation(
+            img, pyrandom.uniform(max(0.0, 1 - self.value), 1 + self.value))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_contrast(
+            img, pyrandom.uniform(max(0.0, 1 - self.value), 1 + self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_hue(img, pyrandom.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order
+    (reference transforms.py ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.tfs = [BrightnessTransform(brightness),
+                    ContrastTransform(contrast),
+                    SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        pyrandom.shuffle(order)
+        for k in order:
+            t = self.tfs[k]
+            img = t._apply_image(img) if isinstance(t, BaseTransform) \
+                else t(img)
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.scale, self.ratio = scale, ratio
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self.scale)
+            ar = np.exp(pyrandom.uniform(np.log(self.ratio[0]),
+                                         np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = pyrandom.randint(0, h - ch)
+                j = pyrandom.randint(0, w - cw)
+                return _resize_np(arr[i:i + ch, j:j + cw], self.size)
+        return _resize_np(CenterCrop(min(h, w))(arr), self.size)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, numbers.Number) else tuple(degrees)
+        self.translate, self.scale_rng = translate, scale
+        self.shear, self.fill, self.center = shear, fill, center
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        angle = pyrandom.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = pyrandom.uniform(-self.translate[0], self.translate[0]) * w
+            ty = pyrandom.uniform(-self.translate[1], self.translate[1]) * h
+        sc = pyrandom.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            if isinstance(s, numbers.Number):
+                sh = (pyrandom.uniform(-s, s), 0.0)
+            elif len(s) == 2:          # x-shear range only
+                sh = (pyrandom.uniform(s[0], s[1]), 0.0)
+            elif len(s) == 4:          # (x_min, x_max, y_min, y_max)
+                sh = (pyrandom.uniform(s[0], s[1]),
+                      pyrandom.uniform(s[2], s[3]))
+            else:
+                raise ValueError(f"shear needs 1, 2 or 4 values, got {s}")
+        return affine(arr, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, numbers.Number) else tuple(degrees)
+        self.expand, self.center, self.fill = expand, center, fill
+
+    def _apply_image(self, img):
+        return rotate(img, pyrandom.uniform(*self.degrees),
+                      expand=self.expand, center=self.center,
+                      fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.distortion_scale, self.fill = (prob,
+                                                       distortion_scale,
+                                                       fill)
+
+    def _apply_image(self, img):
+        if pyrandom.random() >= self.prob:
+            return np.asarray(img)
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        hw, hh = int(w * d / 2), int(h * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(pyrandom.randint(0, hw), pyrandom.randint(0, hh)),
+               (w - 1 - pyrandom.randint(0, hw), pyrandom.randint(0, hh)),
+               (w - 1 - pyrandom.randint(0, hw),
+                h - 1 - pyrandom.randint(0, hh)),
+               (pyrandom.randint(0, hw), h - 1 - pyrandom.randint(0, hh))]
+        return perspective(arr, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if pyrandom.random() >= self.prob:
+            return arr
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            and arr.shape[-1] not in (1, 3)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * pyrandom.uniform(*self.scale)
+            ar = np.exp(pyrandom.uniform(np.log(self.ratio[0]),
+                                         np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = pyrandom.randint(0, h - eh)
+                j = pyrandom.randint(0, w - ew)
+                return erase(arr, i, j, eh, ew, self.value, self.inplace)
+        return arr

@@ -146,3 +146,48 @@ def test_sparse_surface_completion_r4b():
                'tan', 'tanh', 'transpose']
     missing = [n for n in ref_all if not hasattr(sparse, n)]
     assert not missing, missing
+
+
+def test_sparse_nn_2d_family_r4b():
+    """sparse.nn Conv2D/SubmConv2D lift onto the 3-D rulebook (parity vs
+    dense conv); activations + BatchNorm keep the sparsity pattern."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.sparse.nn as snn
+    import paddle_tpu.sparse.nn.functional as SF
+
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    n, h, w, cin, cout = 1, 6, 6, 3, 4
+    dense = np.zeros((n, h, w, cin), np.float32)
+    pts = [(0, 1, 1), (0, 2, 4), (0, 4, 3)]
+    for (bi, yi, xi) in pts:
+        dense[bi, yi, xi] = rng.standard_normal(cin)
+    idx = np.array([[b, y, x] for b, y, x in pts]).T
+    vals = np.stack([dense[b, y, x] for b, y, x in pts])
+    xs = sparse.sparse_coo_tensor(idx, vals, (n, h, w, cin))
+
+    conv = snn.Conv2D(cin, cout, 3, padding=1, bias_attr=False)
+    out = conv(xs)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(conv.weight.numpy()),
+        window_strides=(1, 1), padding=((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                               np.asarray(ref), atol=1e-4)
+
+    sub = snn.SubmConv2D(cin, cout, 3, padding=1, bias_attr=False)
+    assert sub(xs).nnz == xs.nnz  # submanifold keeps the sites
+    assert snn.ReLU6()(xs).nnz == xs.nnz
+    assert snn.LeakyReLU(0.1)(xs).nnz == xs.nnz
+    bo = snn.BatchNorm(cin, data_format="NHWC")(xs)
+    assert bo.nnz == xs.nnz and np.isfinite(bo.values().numpy()).all()
+    assert snn.SyncBatchNorm(cin)(xs).nnz == xs.nnz
+    # functional aliases exist and round-trip
+    assert SF.relu(xs).nnz == xs.nnz
+    x2, _, v = _coo()
+    SF.softmax(x2)
+    for name in ("conv2d", "subm_conv2d", "relu", "relu6", "leaky_relu",
+                 "softmax", "attention"):
+        assert hasattr(SF, name), name

@@ -1,0 +1,115 @@
+"""r4b vision.transforms completion (reference:
+python/paddle/vision/transforms/) plus incubate graph/segment aliases —
+numpy-referenced invariants for the warp engine and color ops."""
+
+import random
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.transforms as T
+
+
+@pytest.fixture
+def img():
+    random.seed(0)
+    return (np.arange(8 * 8 * 3) % 255).reshape(8, 8, 3).astype(np.uint8)
+
+
+def test_functional_geometry(img):
+    f = img.astype(np.float32)
+    np.testing.assert_array_equal(T.hflip(T.hflip(img)), img)
+    np.testing.assert_array_equal(T.vflip(T.vflip(img)), img)
+    assert T.crop(img, 1, 2, 3, 4).shape == (3, 4, 3)
+    assert T.center_crop(img, 4).shape == (4, 4, 3)
+    assert T.pad(img, 2).shape == (12, 12, 3)
+    assert T.resize(img, (4, 6)).shape == (4, 6, 3)
+    # rotate: identity at 0; 90 == rot90 (counter-clockwise); round trip
+    np.testing.assert_allclose(T.rotate(f, 0), f, atol=1e-6)
+    np.testing.assert_allclose(T.rotate(f, 90), np.rot90(f, 1, (0, 1)),
+                               atol=1e-4)
+    np.testing.assert_allclose(T.rotate(T.rotate(f, 90), -90), f, atol=1e-4)
+    # affine: identity; integer translate shifts exactly
+    np.testing.assert_allclose(T.affine(f, 0, (0, 0), 1.0, 0.0), f,
+                               atol=1e-6)
+    at = T.affine(f, 0, (2, 0), 1.0, 0.0)
+    np.testing.assert_allclose(at[:, 2:], f[:, :-2], atol=1e-6)
+    # perspective: identity corner map is the identity
+    h, w = f.shape[:2]
+    pts = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+    np.testing.assert_allclose(T.perspective(f, pts, pts), f, atol=1e-4)
+    # expand=True rotation of 90 keeps all content
+    r = T.rotate(f, 90, expand=True)
+    assert sorted(r.shape[:2]) == sorted(f.shape[:2])
+
+
+def test_functional_color(img):
+    f = img.astype(np.float32) / 255.0  # float images live in [0, 1]
+    np.testing.assert_allclose(T.adjust_brightness(f, 1.0), f, atol=1e-6)
+    np.testing.assert_allclose(T.adjust_contrast(f, 1.0), f, atol=1e-4)
+    np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1)
+    gray = np.repeat((f @ [0.299, 0.587, 0.114])[..., None], 3, -1)
+    np.testing.assert_allclose(T.adjust_saturation(f, 0.0), gray, atol=1e-3)
+    # uint8 path clips at 255, not 1
+    bright = T.adjust_brightness(img, 1.5)
+    assert bright.dtype == np.uint8 and bright.max() > 1
+    with pytest.raises(ValueError):
+        T.adjust_hue(img, 0.7)
+    g = T.to_grayscale(img, 3)
+    assert g.shape == (8, 8, 3)
+    e = T.erase(img, 1, 1, 3, 3, 0)
+    assert (e[1:4, 1:4] == 0).all() and (img[1:4, 1:4] != 0).any()
+
+
+def test_transform_classes_and_base_protocol(img):
+    for t in (T.ColorJitter(0.1, 0.1, 0.1, 0.1), T.RandomRotation(15),
+              T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                             shear=5),
+              T.RandomPerspective(prob=1.0), T.RandomErasing(prob=1.0),
+              T.Grayscale(3)):
+        assert t(img).shape == img.shape
+    assert T.RandomResizedCrop(4)(img).shape == (4, 4, 3)
+
+    class AddOne(T.BaseTransform):
+        def _apply_image(self, im):
+            return im + 1
+
+    out_img, label = AddOne(keys=("image", "label"))((img, 7))
+    assert label == 7 and (out_img == img + 1).all()
+
+
+def test_incubate_graph_and_segment_aliases():
+    inc = paddle.incubate
+    x = paddle.to_tensor(np.array([[1., 2], [3, 4], [5, 6]], np.float32))
+    seg = paddle.to_tensor(np.array([0, 0, 1], np.int64))
+    np.testing.assert_allclose(inc.segment_sum(x, seg).numpy(),
+                               [[4, 6], [5, 6]])
+    np.testing.assert_allclose(inc.segment_mean(x, seg).numpy(),
+                               [[2, 3], [5, 6]])
+    sidx = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+    didx = paddle.to_tensor(np.array([1, 2, 0], np.int64))
+    np.testing.assert_allclose(
+        inc.graph_send_recv(x, sidx, didx, pool_type="sum").numpy(),
+        [[5, 6], [1, 2], [3, 4]])
+    indptr = np.array([0, 2, 4, 6, 8], np.int64)
+    rows = np.array([1, 3, 0, 2, 1, 3, 0, 2], np.int64)
+    nb, cnt = inc.graph_sample_neighbors(
+        paddle.to_tensor(rows), paddle.to_tensor(indptr),
+        paddle.to_tensor(np.array([0, 2], np.int64)), sample_size=2)
+    assert cnt.numpy().sum() == nb.shape[0]
+    src, dst, sample_index, reindex_nodes = inc.graph_khop_sampler(
+        paddle.to_tensor(rows), paddle.to_tensor(indptr),
+        paddle.to_tensor(np.array([0], np.int64)), [2, 2])
+    s, d, nodes = src.numpy(), dst.numpy(), sample_index.numpy()
+    assert len(s) == len(d) > 0
+    # reindexed edges stay in the compact id space, inputs lead it
+    assert s.max() < len(nodes) and d.max() < len(nodes)
+    np.testing.assert_array_equal(reindex_nodes.numpy(), [0])
+    # every compact edge maps back to a REAL graph edge
+    for a, b in zip(s, d):
+        orig_s, orig_d = nodes[a], nodes[b]
+        assert orig_s in rows[indptr[orig_d]:indptr[orig_d + 1]]
+    assert abs(float(inc.identity_loss(x, "mean"))
+               - x.numpy().mean()) < 1e-6
+    assert hasattr(inc, "LookAhead") and hasattr(inc, "ModelAverage")
