@@ -38,6 +38,8 @@ class Generator:
         self._lock = threading.Lock()
         self._key_tensor = None  # built lazily: no jax backend init on import
         self._seed = int(seed)
+        self._last_concrete = None  # last concrete key (traced-key fallback)
+        self._detached = 0          # detached-fork counter (see split)
 
     def manual_seed(self, seed: int) -> "Generator":
         from .tensor import Tensor
@@ -53,18 +55,46 @@ class Generator:
     def _ensure_key(self):
         if self._key_tensor is None:
             from .tensor import Tensor
-            self._key_tensor = Tensor(jax.random.PRNGKey(self._seed))
+            # the seed key must be CONCRETE even when a static Program
+            # trace is ambient: a traced initial value cannot be lifted
+            # as threaded state (no concrete snapshot to advance run-to-
+            # run), which would freeze the program's RNG stream
+            try:
+                from ..static.program import suspend_trace
+                with suspend_trace():
+                    k = jax.random.PRNGKey(self._seed)
+            except ImportError:
+                k = jax.random.PRNGKey(self._seed)
+            self._key_tensor = Tensor(k)
         return self._key_tensor
 
     def split(self) -> jax.Array:
         with self._lock:
             kt = self._ensure_key()
-            new_key, sub = jax.random.split(kt._data)
+            try:
+                new_key, sub = jax.random.split(kt._data)
+            except jax.errors.UnexpectedTracerError:
+                # a static Program trace owns the key (its split wrote a
+                # traced value; the run threads it as program state). An
+                # eager caller arriving now — a parameter initializer
+                # under suspend_trace, or post-guard eager code — draws
+                # from a detached fork of the last CONCRETE key so the
+                # two streams never collide and nothing leaks.
+                self._detached += 1
+                base = self._last_concrete if self._last_concrete \
+                    is not None else jax.random.PRNGKey(self._seed)
+                return jax.random.fold_in(base, self._detached)
+            if not isinstance(new_key, jax.core.Tracer):
+                self._last_concrete = new_key
             kt._data = new_key
         return sub
 
     def get_state(self):
-        return (self._seed, np.asarray(jax.device_get(self._ensure_key()._d)))
+        key = self._ensure_key()._d
+        if isinstance(key, jax.core.Tracer):
+            key = self._last_concrete if self._last_concrete is not None \
+                else jax.random.PRNGKey(self._seed)
+        return (self._seed, np.asarray(jax.device_get(key)))
 
     def set_state(self, state) -> None:
         import jax.numpy as jnp

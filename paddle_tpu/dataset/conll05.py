@@ -44,7 +44,9 @@ def get_dict(data_dir=None, word_dict_file=None, verb_dict_file=None,
     """(word_dict, verb_dict, label_dict) from the cached dictionary files
     (reference load_dict + label-dict IOB expansion). Explicit *_file
     paths override individual dictionaries (the text.Conll05st surface)."""
-    d = data_dir or _DIR
+    any_file = word_dict_file or verb_dict_file or target_dict_file
+    # an explicit dict file also anchors its siblings' default directory
+    d = data_dir or (os.path.dirname(any_file) if any_file else _DIR)
     word_dict = _load_dict(word_dict_file or _need(
         os.path.join(d, 'wordDict.txt'), 'conll05 word dict'))
     verb_dict = _load_dict(verb_dict_file or _need(

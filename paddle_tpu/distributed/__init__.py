@@ -50,3 +50,10 @@ from . import rpc  # noqa: F401
 from . import passes  # noqa: F401
 from . import watchdog  # noqa: F401
 from .watchdog import StepWatchdog, StragglerDetector  # noqa: F401
+
+from . import io  # noqa: F401
+from .compat_ps import (  # noqa: F401
+    gloo_init_parallel_env, gloo_barrier, gloo_release, ProbabilityEntry,
+    CountFilterEntry, ShowClickEntry, InMemoryDataset, QueueDataset,
+    DistAttr,
+)

@@ -1,0 +1,191 @@
+"""Legacy distributed surface: gloo bootstrap, PS datasets, sparse-table
+entry configs, and DistAttr (reference: python/paddle/distributed/
+__init__.py over entry_attr.py, fleet/dataset/, parallel.py gloo_*).
+
+TPU mapping: the gloo CPU rendezvous rides the same TCPStore that backs
+the object collectives (there is no gloo to wrap — the store IS the CPU
+control plane); the PS datasets are host-side slot-file readers feeding
+the input pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = [
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "ProbabilityEntry", "CountFilterEntry", "ShowClickEntry",
+    "InMemoryDataset", "QueueDataset", "DistAttr",
+]
+
+_GLOO = {"store": None, "rank": 0, "world": 1}
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-only rendezvous (reference parallel.py gloo_init_parallel_env):
+    rank 0 hosts the store, everyone checks in and waits for the world."""
+    from .store import TCPStore
+
+    host, port = server_endpoint.split(":")
+    store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                     world_size=rank_num)
+    _GLOO.update(store=store, rank=int(rank_id), world=int(rank_num),
+                 seq=0)
+    store.add("gloo/init", 1)
+    store.wait_ge("gloo/init", rank_num)
+
+
+def gloo_barrier():
+    """Store-counter barrier (reference gloo_barrier). Each call uses a
+    fresh key so consecutive barriers cannot alias."""
+    if _GLOO["store"] is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _GLOO["seq"] = _GLOO.get("seq", 0) + 1
+    key = f"gloo/barrier/{_GLOO['seq']}"
+    _GLOO["store"].add(key, 1)
+    _GLOO["store"].wait_ge(key, _GLOO["world"])
+
+
+def gloo_release():
+    if _GLOO["store"] is not None:
+        _GLOO["store"].shutdown()
+        _GLOO["store"] = None
+
+
+class _EntryAttr:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(_EntryAttr):
+    """Sparse-table admission by probability (reference
+    entry_attr.py:61)."""
+
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class CountFilterEntry(_EntryAttr):
+    """Admission after `count_filter` shows (reference
+    entry_attr.py:106)."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be non-negative")
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ShowClickEntry(_EntryAttr):
+    """Show/click-weighted entry (reference entry_attr.py:154)."""
+
+    def __init__(self, show_name, click_name):
+        self._show_name = str(show_name)
+        self._click_name = str(click_name)
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show_name}:{self._click_name}"
+
+
+class InMemoryDataset:
+    """Slot-file dataset fully loaded to host memory (reference
+    fleet/dataset InMemoryDataset): whitespace slot lines -> per-slot
+    int/float arrays; supports local shuffle and batched iteration."""
+
+    def __init__(self):
+        self._slots = []
+        self._dtypes = {}
+        self._batch = 1
+        self._rows = []
+        self._files = []
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             thread_num=1, **kw):
+        self._batch = int(batch_size)
+        if use_var:
+            self._slots = [getattr(v, "name", str(v)) for v in use_var]
+
+    # reference two-phase api
+    _init_distributed_settings = init
+
+    def set_filelist(self, filelist):
+        self._files = list(filelist)
+
+    def load_into_memory(self):
+        self._rows = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        self._rows.append(parts)
+
+    def local_shuffle(self, seed=0):
+        import random
+        random.Random(seed).shuffle(self._rows)
+
+    def global_shuffle(self, fleet=None, thread_num=1):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._rows)
+
+    def release_memory(self):
+        self._rows = []
+
+    def __iter__(self):
+        for i in range(0, len(self._rows), self._batch):
+            yield self._rows[i:i + self._batch]
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference QueueDataset): no load phase, rows
+    stream from the files at iteration time."""
+
+    def load_into_memory(self):
+        raise RuntimeError(
+            "QueueDataset streams from files; use set_filelist + iterate")
+
+    def __iter__(self):
+        batch = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    batch.append(parts)
+                    if len(batch) == self._batch:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch
+
+
+class DistAttr:
+    """(mesh, sharding_specs) distribution attribute (reference
+    auto_parallel/api.py:33): sharding_specs name the mesh axis each
+    tensor dim is sharded over (None = replicated). Consumed by
+    shard_tensor as the placements description."""
+
+    def __init__(self, mesh, sharding_specs):
+        if not isinstance(sharding_specs, (list, tuple)):
+            raise ValueError("sharding_specs must be a list")
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+        self.dims_mapping = [
+            mesh.dim_names.index(s) if s is not None else -1
+            for s in self.sharding_specs]
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")

@@ -1,0 +1,36 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+persistable save/load for distributed programs). Rides the static
+program state serialization; per-rank sharded checkpoints live in
+distributed.checkpoint (the TPU-native path)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import compat
+
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "persistables")
+    compat.save(main_program, path)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import compat
+
+    path = os.path.join(dirname, filename or "persistables")
+    compat.load(main_program, path)
+
+
+def load_inference_model_distributed(path_prefix, executor=None, **kw):
+    from ..static import load_inference_model
+
+    return load_inference_model(path_prefix, executor, **kw)

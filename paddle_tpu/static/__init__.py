@@ -21,6 +21,8 @@ from .program import Executor  # noqa: F401
 from .program import Program  # noqa: F401
 from .program import data  # noqa: F401
 from .program import program_guard  # noqa: F401
+from .compat import *  # noqa: F401,F403
+from .compat import Scope  # noqa: F401
 
 __all__ = ["InputSpec", "Program", "program_guard", "data",
            "default_main_program", "default_startup_program", "Executor",

@@ -1,0 +1,543 @@
+"""Legacy static-graph utilities (reference: python/paddle/static/
+__init__.py surface over base/backward.py, framework.py, io.py).
+
+Everything here rides the real machinery: gradients/append_backward run
+the autograd engine (which works mid-trace — the jaxpr records the
+backward alongside the forward exactly like the reference's generated
+backward ops), the scope maps to the Program's parameter state, and the
+serialization helpers wrap the StableHLO export path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+from ..framework import ParamAttr
+
+__all__ = [
+    "append_backward", "gradients", "global_scope", "scope_guard",
+    "BuildStrategy", "CompiledProgram", "ExecutionStrategy",
+    "ipu_shard_guard", "IpuCompiledProgram", "IpuStrategy", "set_ipu_shard",
+    "Print", "py_func", "WeightNormParamAttr", "ExponentialMovingAverage",
+    "save", "load", "serialize_program", "serialize_persistables",
+    "save_to_file", "deserialize_program", "deserialize_persistables",
+    "load_from_file", "normalize_program", "load_program_state",
+    "set_program_state", "cpu_places", "cuda_places", "xpu_places",
+    "Variable", "create_global_var", "create_parameter", "accuracy", "auc",
+    "device_guard", "ctr_metric_bundle",
+]
+
+Variable = Tensor  # the traced Tensor IS the static Variable
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(sum targets)/d(inputs) recorded into the active trace (reference:
+    base/backward.py:2591). Runs the autograd engine, which composes with
+    tracing — the returned tensors are ordinary graph values."""
+    from ..autograd import grad as _grad
+
+    tl = targets if isinstance(targets, (list, tuple)) else [targets]
+    il = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gl = None
+    if target_gradients is not None:
+        gl = target_gradients if isinstance(target_gradients, (list, tuple)) \
+            else [target_gradients]
+    # retain the graph: the reference's gradients() leaves the program
+    # intact for further appends (e.g. a later append_backward)
+    return _grad(tl, il, grad_outputs=gl, allow_unused=True,
+                 retain_graph=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Record the backward for `loss` (reference: base/backward.py:1857):
+    every reachable parameter gets its .grad populated; returns
+    [(param, grad)] like the reference's params_grads."""
+    loss.backward()
+    if parameter_list is None:
+        from .program import current_main_program
+        prog = current_main_program()
+        params = list(prog._params) if prog is not None else []
+    else:
+        params = list(parameter_list)
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+# -- scope ------------------------------------------------------------------
+
+
+class _Scope:
+    """Name -> value view over parameter state (reference Scope/Variable;
+    find_var(name).get_tensor() is the checkpoint-script idiom)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    class _Var:
+        def __init__(self, t):
+            self._t = t
+
+        def get_tensor(self):
+            return self._t.numpy()
+
+        def set(self, value, place=None):
+            import jax.numpy as jnp
+            self._t._data = jnp.asarray(value)
+
+    def find_var(self, name):
+        from .program import all_programs, current_main_program
+        progs = [p for p in [current_main_program()] if p is not None]
+        progs += [p for p in reversed(all_programs()) if p not in progs]
+        for prog in progs:
+            for p in prog._params:
+                if p.name == name:
+                    return self._Var(p)
+        t = self._vars.get(name)
+        return self._Var(t) if t is not None else None
+
+    def var(self, name):
+        found = self.find_var(name)
+        if found is None:
+            import jax.numpy as jnp
+            self._vars[name] = Tensor(jnp.zeros((), jnp.float32), name=name)
+            found = self._Var(self._vars[name])
+        return found
+
+
+_GLOBAL_SCOPE = _Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope():
+    return _SCOPE_STACK[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _SCOPE_STACK.append(scope)
+    try:
+        yield
+    finally:
+        _SCOPE_STACK.pop()
+
+
+Scope = _Scope
+
+
+# -- strategies / compiled program (XLA subsumes both) ----------------------
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference BuildStrategy). XLA owns fusion and
+    memory planning, so the attributes are accepted and recorded for
+    introspection; none change compilation."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 1
+
+
+class CompiledProgram:
+    """Reference CompiledProgram: a Program plus build options. Executor
+    .run accepts it interchangeably with the Program."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        # data parallelism is mesh sharding here; the single-process
+        # CompiledProgram contract is identity
+        return self
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+# -- IPU shims (no IPU runtime in a TPU build) ------------------------------
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError(
+            "no IPU runtime in this build (device.is_compiled_with_ipu() "
+            "is False); TPU pipeline sharding rides distributed.fleet")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "no IPU runtime in this build; use jit.to_static / Executor")
+
+
+# -- debugging ops ----------------------------------------------------------
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Print-as-an-op (reference static.Print): identity on the value,
+    printing at execution time — jax.debug.print inside a trace, plain
+    print in eager."""
+    import jax
+
+    from ..autograd.function import apply
+
+    msg = message or ""
+
+    def f(a):
+        jax.debug.print(msg + " {x}", x=a)
+        return a
+
+    return apply(f, input, name="print")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host python function as an op (reference static.py_func over the
+    py_func op): forward runs through jax.pure_callback (works under jit);
+    an optional backward_func supplies the custom gradient."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..autograd.function import apply
+    from ..core.tensor import as_tensor
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    xs = [as_tensor(t) for t in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+              for o in outs]
+    single = not isinstance(out, (list, tuple))
+
+    def call_host(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return [np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                for r, s in zip(res, shapes)]
+
+    if backward_func is None:
+        def f(*arrays):
+            res = jax.pure_callback(call_host, shapes, *arrays)
+            return res[0] if single else tuple(res)
+        return apply(f, *xs, name="py_func")
+
+    # reference backward contract: backward_func(inputs..., outputs...,
+    # out_grads...) with skip_vars_in_backward_input removed from the
+    # input+output prefix (matched by variable name)
+    skip_names = {getattr(v, "name", str(v))
+                  for v in (skip_vars_in_backward_input or [])}
+    prefix_keep = [getattr(t, "name", "") not in skip_names for t in xs]
+    prefix_keep += [getattr(o, "name", "") not in skip_names for o in outs]
+
+    @jax.custom_vjp
+    def fwd(*arrays):
+        res = jax.pure_callback(call_host, shapes, *arrays)
+        return res[0] if single else tuple(res)
+
+    def fwd_fwd(*arrays):
+        out = fwd(*arrays)
+        out_arrays = (out,) if single else tuple(out)
+        return out, (arrays, out_arrays)
+
+    def fwd_bwd(saved, g):
+        ins, out_arrays = saved
+        gl = (g,) if single else tuple(g)
+        in_shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in ins]
+        prefix = [a for a, keep in zip(list(ins) + list(out_arrays),
+                                       prefix_keep) if keep]
+
+        def host_bwd(*args):
+            res = backward_func(*[np.asarray(a) for a in args])
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return [np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                    for r, s in zip(res, in_shapes)]
+
+        return tuple(jax.pure_callback(host_bwd, in_shapes, *prefix, *gl))
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    return apply(lambda *arrays: fwd(*arrays), *xs, name="py_func")
+
+
+# -- parameters / EMA -------------------------------------------------------
+
+
+class WeightNormParamAttr(ParamAttr):
+    """ParamAttr carrying the weight-norm dim (reference
+    WeightNormParamAttr); consumed by nn.utils.weight_norm-style wrappers,
+    plain ParamAttr otherwise."""
+
+    def __init__(self, dim=None, **kw):
+        super().__init__(**kw)
+        self.dim = dim
+
+
+class ExponentialMovingAverage:
+    """EMA of every trainable parameter (reference static
+    ExponentialMovingAverage): update() after each step, apply()/restore()
+    swap the shadow weights in and out for evaluation."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+        # bind the program being built (reference: EMA is constructed
+        # during program construction and owns that program's params)
+        from .program import current_main_program
+        self._bound = current_main_program()
+
+    def _params(self):
+        from .program import current_main_program
+        from . import default_main_program
+        prog = (self._bound or current_main_program()
+                or default_main_program())
+        return [p for p in (list(prog._params) if prog is not None else [])
+                if p.trainable]
+
+    def update(self):
+        import jax.numpy as jnp
+        self._step += 1
+        d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._params():
+            prev = self._shadow.get(id(p))
+            cur = jnp.asarray(p._data, jnp.float32)
+            self._shadow[id(p)] = cur if prev is None else \
+                d * prev + (1.0 - d) * cur
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+        for p in self._params():
+            if id(p) in self._shadow:
+                self._backup[id(p)] = p._data
+                p._data = self._shadow[id(p)].astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params():
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+# -- program/persistable serialization --------------------------------------
+
+
+def _program_or_default(program):
+    from . import default_main_program
+    return program if program is not None else default_main_program()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kw):
+    """Reference normalize_program prunes to the feed->fetch subgraph; the
+    recorded Program already tracks exactly that, so this pins the
+    feeds/fetches for a later save."""
+    program._normalized = (feed_vars, fetch_vars)
+    return program
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kw):
+    """Program -> bytes: the feeds/fetches plus the Program's HLO text
+    (the executable form rides save_inference_model's StableHLO export;
+    this byte form serves the serialize/deserialize_program contract)."""
+    prog = _program_or_default(program)
+    return pickle.dumps({
+        "feed": [getattr(v, "name", str(v)) for v in (feed_vars or [])],
+        "fetch": [getattr(v, "name", str(v)) for v in (fetch_vars or [])],
+        "text": getattr(prog, "_text", None),
+    })
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kw):
+    prog = _program_or_default(program)
+    return pickle.dumps({p.name: np.asarray(p.numpy())
+                         for p in prog._params})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return program
+
+
+def save(program, model_path, protocol=4, **kw):
+    """Reference static.save: <path>.pdparams + <path>.pdmodel."""
+    prog = _program_or_default(program)
+    state = {p.name: np.asarray(p.numpy()) for p in prog._params}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp
+    prog = _program_or_default(program)
+    by_name = {p.name: p for p in prog._params}
+    for name, value in state_dict.items():
+        if name in by_name:
+            p = by_name[name]
+            p._data = jnp.asarray(value).astype(p._data.dtype)
+
+
+# -- places / misc ----------------------------------------------------------
+
+
+def cpu_places(device_count=None):
+    from ..framework.framework import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", "1"))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []  # no CUDA in a TPU build (is_compiled_with_cuda() is False)
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference device_guard pins ops to a device; XLA owns placement
+    here, so the hint is accepted and ignored (documented no-op)."""
+    yield
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+
+    from ..core import dtype as dtypes
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        dtypes.dtype_from_any(dtype).np_dtype), name=name)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework.parameter import create_parameter as _cp
+    return _cp(shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+# -- metric ops -------------------------------------------------------------
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy as a graph op (reference static accuracy op)."""
+    import jax.numpy as jnp
+
+    from ..autograd.function import apply
+    from ..core.tensor import as_tensor
+
+    def f(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        hit = jnp.any(topk == lab.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply(f, as_tensor(input), as_tensor(label), name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Bucketed AUC as a graph op (reference static auc op): returns
+    (auc_value, batch_stats...) — this build returns the scalar plus the
+    positive/negative bucket counts it derived."""
+    import jax.numpy as jnp
+
+    from ..autograd.function import apply_multi
+    from ..core.tensor import as_tensor
+
+    def f(pred, lab):
+        p1 = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        ids = jnp.clip((p1 * num_thresholds).astype(jnp.int32), 0,
+                       num_thresholds)
+        labf = lab.reshape(-1).astype(jnp.float32)
+        pos = jnp.zeros((num_thresholds + 1,)).at[ids].add(labf)
+        neg = jnp.zeros((num_thresholds + 1,)).at[ids].add(1.0 - labf)
+        # integrate the ROC over descending thresholds
+        tp = jnp.cumsum(pos[::-1])
+        fp = jnp.cumsum(neg[::-1])
+        tot_pos = jnp.maximum(tp[-1], 1e-12)
+        tot_neg = jnp.maximum(fp[-1], 1e-12)
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        area = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)
+        return area, pos, neg
+
+    return apply_multi(f, as_tensor(input), as_tensor(label), name="auc")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metric bundle (reference static ctr_metric_bundle): local
+    (sqrerr, abserr, prob_sum, q_sum, pos_count, total_count) tensors for
+    the PS metric aggregation path."""
+    import jax.numpy as jnp
+
+    from ..autograd.function import apply_multi
+    from ..core.tensor import as_tensor
+
+    def f(pred, lab):
+        p = pred.reshape(-1)
+        y = lab.reshape(-1).astype(jnp.float32)
+        return (jnp.sum((p - y) ** 2), jnp.sum(jnp.abs(p - y)),
+                jnp.sum(p), jnp.sum(p), jnp.sum(y),
+                jnp.asarray(float(p.shape[0]), jnp.float32))
+
+    return apply_multi(f, as_tensor(input), as_tensor(label),
+                       name="ctr_metric_bundle")

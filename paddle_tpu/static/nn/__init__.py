@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.tensor import Tensor
 from ...autograd.function import apply
@@ -290,3 +291,601 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
 
 
 __all__ += ["fc", "embedding", "conv2d", "batch_norm"]
+
+
+# -- r4b: the remaining reference static.nn surface (reference:
+# python/paddle/static/nn/common.py + sequence_lod.py). Layer-factory
+# wrappers follow fc/conv2d above; sequence_* ops use the TPU-native
+# dense [batch, time, ...] + length representation (LoD is subsumed by
+# padding + masks — the design SURVEY §7 chose for every varlen surface).
+
+
+def _layer_op(build, x, act=None):
+    from ...nn import functional as F
+    with suspend_trace():
+        layer = build()
+    out = layer(x)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW"):
+    from ... import nn as pnn
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    return _layer_op(
+        lambda: pnn.Conv2DTranspose(
+            in_ch, num_filters, filter_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr, data_format=data_format),
+        input, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW"):
+    from ... import nn as pnn
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    return _layer_op(
+        lambda: pnn.Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                           padding=padding, dilation=dilation, groups=groups,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_format),
+        input, act)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW"):
+    from ... import nn as pnn
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    return _layer_op(
+        lambda: pnn.Conv3DTranspose(
+            in_ch, num_filters, filter_size, stride=stride, padding=padding,
+            dilation=dilation, groups=groups, weight_attr=param_attr,
+            bias_attr=bias_attr, data_format=data_format),
+        input, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None):
+    from ...nn import functional as F
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    from ...framework.parameter import create_parameter as _cp
+    from ...nn import initializer as I
+    with suspend_trace():
+        w = _cp(shape, dtype="float32", attr=param_attr,
+                default_initializer=I.Constant(1.0)) if scale else None
+        b = _cp(shape, dtype="float32", attr=bias_attr, is_bias=True) \
+            if shift else None
+    out = F.layer_norm(input, shape, w, b, epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW"):
+    from ... import nn as pnn
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    return _layer_op(
+        lambda: pnn.GroupNorm(groups, ch, epsilon=epsilon,
+                              weight_attr=param_attr, bias_attr=bias_attr,
+                              data_format=data_layout),
+        input, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None):
+    from ... import nn as pnn
+    ch = int(input.shape[1])
+    dim = len(input.shape)
+    cls = {3: pnn.InstanceNorm1D, 4: pnn.InstanceNorm2D,
+           5: pnn.InstanceNorm3D}[dim]
+    return _layer_op(
+        lambda: cls(ch, epsilon=epsilon, weight_attr=param_attr,
+                    bias_attr=bias_attr),
+        input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Reference data_norm: normalization by accumulated batch statistics
+    (size/sum/square-sum tables) rather than per-batch moments — the CTR
+    models' streaming normalizer. State threads functionally like BN."""
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply_multi
+    from ...framework.parameter import create_parameter as _cp
+    from ...nn import initializer as I
+
+    # the statistics/normalization math below is channel-LAST; NCHW input
+    # moves channels to the back and back again around it
+    chw = data_layout == "NCHW" and len(input.shape) > 2
+    if chw:
+        from ... import ops
+        input = ops.moveaxis(input, 1, -1)
+    ch = int(input.shape[-1])
+    with suspend_trace():
+        batch_size = _cp([ch], dtype="float32",
+                         default_initializer=I.Constant(1e-4))
+        batch_sum = _cp([ch], dtype="float32",
+                        default_initializer=I.Constant(0.0))
+        batch_sq = _cp([ch], dtype="float32",
+                       default_initializer=I.Constant(1e-4))
+    for p in (batch_size, batch_sum, batch_sq):
+        p.stop_gradient = True
+
+    def f(x, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(jnp.maximum(sq / n - mean * mean, 0.0) + epsilon)
+        out = (x - mean) / scale
+        cnt = jnp.asarray(float(np.prod(x.shape[:-1])), jnp.float32)
+        n2 = n + cnt
+        s2 = s + x.reshape(-1, ch).sum(0)
+        sq2 = sq + (x.reshape(-1, ch) ** 2).sum(0)
+        return out, n2, s2, sq2
+
+    out, n2, s2, sq2 = apply_multi(f, input, batch_size, batch_sum,
+                                   batch_sq, name="data_norm")
+    batch_size._data, batch_sum._data, batch_sq._data = \
+        n2._data, s2._data, sq2._data
+    if chw:
+        from ... import ops
+        out = ops.moveaxis(out, -1, 1)
+    from ...nn import functional as F
+    return getattr(F, act)(out) if act else out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from ...framework.parameter import create_parameter as _cp
+    from ...nn import functional as F
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    with suspend_trace():
+        w = _cp([size, dx, dy], dtype="float32", attr=param_attr)
+        b = _cp([size], dtype="float32", attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+    out = F.bilinear(x, y, w, b)
+    return getattr(F, act)(out) if act else out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ...framework.parameter import create_parameter as _cp
+    from ...nn import functional as F
+    from ...nn import initializer as I
+    if mode == "all":
+        n = 1
+    elif mode == "channel":
+        n = int(x.shape[1 if data_format == "NCHW" else -1])
+    else:  # element
+        n = int(np.prod([int(s) for s in x.shape[1:]]))
+    with suspend_trace():
+        alpha = _cp([n], dtype="float32", attr=param_attr,
+                    default_initializer=I.Constant(0.25))
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ... import nn as pnn
+    with suspend_trace():
+        layer = pnn.SpectralNorm([int(s) for s in weight.shape], dim=dim,
+                                 power_iters=power_iters, eps=eps)
+    return layer(weight)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """Reference sparse_embedding targets the PS sparse tables; on TPU
+    the embedding is dense-sharded (VocabParallelEmbedding under mp), so
+    this is the embedding op with the PS arguments accepted."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference static.nn.nce over the
+    nce op): logistic loss on the true class plus `num_neg_samples`
+    uniformly sampled noise classes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core import generator as gen_mod
+    from ...autograd.function import apply
+    from ...framework.parameter import create_parameter as _cp
+
+    d = int(input.shape[-1])
+    with suspend_trace():
+        w = _cp([num_total_classes, d], dtype="float32", attr=param_attr)
+        b = _cp([num_total_classes], dtype="float32", attr=bias_attr,
+                is_bias=True)
+    key = gen_mod.default_generator.split()
+
+    def f(x, lab, wt, bt):
+        bsz = x.shape[0]
+        neg = jax.random.randint(key, (bsz, num_neg_samples), 0,
+                                 num_total_classes)
+        lab2 = lab.reshape(bsz, 1)
+        pos_logit = jnp.sum(x * wt[lab2[:, 0]], -1) + bt[lab2[:, 0]]
+        neg_logit = jnp.einsum("bd,bnd->bn", x, wt[neg]) + bt[neg]
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jax.nn.softplus(neg_logit).sum(-1)
+        return (pos_loss + neg_loss).reshape(bsz, 1)
+
+    return apply(f, input, label, w, b, name="nce")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference static.nn.row_conv, the
+    Deep Speech 2 op): y[t] = sum_{i=0..k} x[t+i] * w[i], per channel."""
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply
+    from ...framework.parameter import create_parameter as _cp
+
+    d = int(input.shape[-1])
+    k = future_context_size + 1
+    with suspend_trace():
+        w = _cp([k, d], dtype="float32", attr=param_attr)
+
+    def f(x, wt):
+        pad = [(0, 0)] * x.ndim
+        pad[-2] = (0, k - 1)
+        xp = jnp.pad(x, pad)
+        t = x.shape[-2]
+        out = sum(xp[..., i:i + t, :] * wt[i] for i in range(k))
+        return out
+
+    out = apply(f, input, w, name="row_conv")
+    from ...nn import functional as F
+    return getattr(F, act)(out) if act else out
+
+
+def deform_conv2d(x, offset, mask=None, num_filters=None, filter_size=3,
+                  stride=1, padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, name=None):
+    """Deformable conv v1/v2 (reference static.nn.deform_conv2d over the
+    deformable_conv kernels): per-position kernel offsets drive bilinear
+    sampling (grid_sample machinery), then an ordinary dense contraction."""
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply
+    from ...framework.parameter import create_parameter as _cp
+
+    n, cin, h, w_ = (int(s) for s in x.shape)
+    kh = kw = int(filter_size) if isinstance(filter_size, int) else None
+    if kh is None:
+        kh, kw = (int(s) for s in filter_size)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    oh = (h + 2 * pd[0] - (kh - 1) - 1) // st[0] + 1
+    ow = (w_ + 2 * pd[1] - (kw - 1) - 1) // st[1] + 1
+    with suspend_trace():
+        weight = _cp([num_filters, cin, kh, kw], dtype="float32",
+                     attr=param_attr)
+        bias = _cp([num_filters], dtype="float32", attr=bias_attr,
+                   is_bias=True) if bias_attr is not False else None
+
+    def f(xa, off, wt, *rest):
+        m = rest[0] if mask is not None else None
+        base_y = jnp.arange(oh) * st[0] - pd[0]
+        base_x = jnp.arange(ow) * st[1] - pd[1]
+        cols = []
+        for i in range(kh):
+            for j in range(kw):
+                kidx = i * kw + j
+                dy = off[:, 2 * kidx]                  # [N, OH, OW]
+                dx = off[:, 2 * kidx + 1]
+                py = base_y[None, :, None] + i + dy
+                px = base_x[None, None, :] + j + dx
+                y0 = jnp.floor(py)
+                x0 = jnp.floor(px)
+                wy = py - y0
+                wx = px - x0
+
+                def gather(yy, xx):
+                    yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+                    xi = jnp.clip(xx.astype(jnp.int32), 0, w_ - 1)
+                    v = xa[jnp.arange(n)[:, None, None], :, yi, xi]
+                    inb = ((yy >= 0) & (yy <= h - 1) & (xx >= 0)
+                           & (xx <= w_ - 1))
+                    return jnp.moveaxis(v, -1, 1) * \
+                        inb[:, None].astype(xa.dtype)
+
+                val = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+                       + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+                       + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+                       + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+                if m is not None:
+                    val = val * m[:, kidx][:, None]
+                cols.append(val)                       # [N, Cin, OH, OW]
+        col = jnp.stack(cols, 2)                       # [N, Cin, K, OH, OW]
+        out = jnp.einsum("nckhw,ock->nohw", col,
+                         wt.reshape(num_filters, cin, kh * kw))
+        if bias is not None:
+            out = out + rest[-1].reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, name="deform_conv2d")
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Reference static.nn.static_pylayer: a PyLayer recorded into the
+    static program. The trace-based program records custom vjps natively,
+    so this builds a one-off PyLayer and applies it."""
+    from ...autograd import PyLayer
+
+    class _StaticPyLayer(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            ctx.save_for_backward(*args)
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            if backward_fn is None:
+                raise RuntimeError("static_pylayer without backward_fn "
+                                   "cannot be differentiated")
+            return backward_fn(*grads)
+
+    return _StaticPyLayer.apply(*inputs)
+
+
+# -- sequence ops over dense [batch, time, ...] + lengths -------------------
+
+
+def _time_mask(x, lengths):
+    import jax.numpy as jnp
+    t = x.shape[1]
+    return (jnp.arange(t)[None, :] < lengths.reshape(-1, 1))
+
+
+def sequence_softmax(input, lengths=None, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply
+
+    if lengths is None:
+        from ...nn.functional import softmax
+        return softmax(input, axis=1)
+
+    def f(x, ln):
+        m = _time_mask(x, ln)
+        shape = m.shape + (1,) * (x.ndim - 2)
+        xm = jnp.where(m.reshape(shape), x, -jnp.inf)
+        return jnp.where(m.reshape(shape),
+                         jax.nn.softmax(xm, axis=1), 0.0)
+
+    return apply(f, input, lengths, name="sequence_softmax")
+
+
+def sequence_pool(input, pool_type="average", lengths=None, pad_value=0.0):
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply
+
+    pt = pool_type.lower()
+
+    def f(x, *maybe_len):
+        if maybe_len:
+            m = _time_mask(x, maybe_len[0])
+            shape = m.shape + (1,) * (x.ndim - 2)
+            mf = m.reshape(shape).astype(x.dtype)
+            cnt = jnp.maximum(mf.sum(1), 1e-12)
+        else:
+            mf = jnp.ones_like(x, shape=(x.shape[0], x.shape[1]) +
+                               (1,) * (x.ndim - 2))
+            cnt = jnp.asarray(float(x.shape[1]), x.dtype)
+        if pt == "sum":
+            return (x * mf).sum(1)
+        if pt == "average":
+            return (x * mf).sum(1) / cnt
+        if pt == "sqrt":
+            return (x * mf).sum(1) / jnp.sqrt(cnt)
+        if pt == "max":
+            big = jnp.where(mf > 0, x, -jnp.inf)
+            return big.max(1)
+        if pt == "last":
+            if maybe_len:
+                idx = (maybe_len[0].reshape(-1).astype(jnp.int32) - 1)
+                return x[jnp.arange(x.shape[0]), idx]
+            return x[:, -1]
+        if pt == "first":
+            return x[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    args = (input,) + ((lengths,) if lengths is not None else ())
+    return apply(f, *args, name="sequence_pool")
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, "first", lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, "last", lengths)
+
+
+def sequence_concat(input, name=None):
+    from ... import concat
+    return concat(list(input), axis=1)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply
+
+    def f(a, *maybe_len):
+        if not maybe_len:
+            return a[:, ::-1]
+        ln = maybe_len[0].reshape(-1)
+        t = a.shape[1]
+        idx = jnp.arange(t)[None, :]
+        src = jnp.where(idx < ln[:, None], ln[:, None] - 1 - idx, idx)
+        return jnp.take_along_axis(
+            a, src.reshape(src.shape + (1,) * (a.ndim - 2)).astype(
+                jnp.int32), axis=1) if a.ndim > 2 else \
+            jnp.take_along_axis(a, src.astype(jnp.int32), axis=1)
+
+    args = (x,) + ((lengths,) if lengths is not None else ())
+    return apply(f, *args, name="sequence_reverse")
+
+
+def sequence_pad(x, pad_value, maxlen=None, lengths=None, name=None):
+    """Dense input is already padded; pins `maxlen` (pad/trim time) and
+    returns (padded, lengths) like the reference."""
+    import jax.numpy as jnp
+
+    from ... import to_tensor
+    from ...autograd.function import apply
+
+    t = int(x.shape[1])
+    ml = int(maxlen) if maxlen else t
+
+    def f(a):
+        if ml == t:
+            return a
+        if ml < t:
+            return a[:, :ml]
+        widths = [(0, 0), (0, ml - t)] + [(0, 0)] * (a.ndim - 2)
+        return jnp.pad(a, widths, constant_values=pad_value)
+
+    out = apply(f, x, name="sequence_pad")
+    if lengths is None:
+        lengths = to_tensor(np.full((int(x.shape[0]),), min(t, ml),
+                                    np.int64))
+    return out, lengths
+
+
+def sequence_unpad(x, length, name=None):
+    """Returns the padded tensor + lengths view (dense representation
+    keeps the batch dim; consumers mask with `length`)."""
+    return x, length
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    from ... import ops
+    reps = int(y.shape[1]) if len(y.shape) > 1 else 1
+    return ops.repeat_interleave(x, reps, axis=0)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_reshape(input, new_dim):
+    if len(input.shape) != 3:
+        raise ValueError("sequence_reshape expects [batch, time, dim] "
+                         f"input, got shape {list(input.shape)}")
+    from ... import ops
+    b = int(input.shape[0])
+    t2 = (int(input.shape[1]) * int(input.shape[2])) // new_dim
+    return ops.reshape(input, [b, t2, new_dim])
+
+
+def sequence_scatter(input, index, updates, name=None):
+    from ... import ops
+    return ops.scatter(input, index, updates)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply
+
+    def f(a):
+        t = a.shape[1]
+        widths = [(0, 0), (0, win_size - 1)]
+        ap = jnp.pad(a, widths, constant_values=pad_value)
+        return jnp.stack([ap[:, i:i + t] for i in range(win_size)], -1)
+
+    return apply(f, input, name="sequence_enumerate")
+
+
+def sequence_slice(input, offset, length, name=None):
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply
+
+    def f(a, off, ln):
+        t = a.shape[1]
+        idx = off.reshape(-1, 1) + jnp.arange(t)[None, :]
+        keep = jnp.arange(t)[None, :] < ln.reshape(-1, 1)
+        idx = jnp.clip(idx, 0, t - 1)
+        g = jnp.take_along_axis(
+            a, idx.reshape(idx.shape + (1,) * (a.ndim - 2)).astype(
+                jnp.int32), axis=1) if a.ndim > 2 else \
+            jnp.take_along_axis(a, idx.astype(jnp.int32), axis=1)
+        shape = keep.shape + (1,) * (a.ndim - 2)
+        return jnp.where(keep.reshape(shape), g, 0)
+
+    return apply(f, input, offset, length, name="sequence_slice")
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, param_attr=None,
+                  bias_attr=None, act=None):
+    """Context-window conv over time (reference sequence_conv): each step
+    sees [t+start, t+start+k) rows, flattened into one fc."""
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply
+    from ...framework.parameter import create_parameter as _cp
+
+    d = int(input.shape[-1])
+    k = int(filter_size)
+    start = padding_start if padding_start is not None else -(k // 2)
+    with suspend_trace():
+        w = _cp([k * d, num_filters], dtype="float32", attr=param_attr)
+        b = _cp([num_filters], dtype="float32", attr=bias_attr,
+                is_bias=True) if bias_attr is not False else None
+
+    def f(x, wt, *mb):
+        t = x.shape[1]
+        lo = max(0, -start)
+        hi = max(0, start + k - 1)
+        xp = jnp.pad(x, [(0, 0), (lo, hi), (0, 0)])
+        ctx = jnp.concatenate(
+            [xp[:, i:i + t] for i in range(k)], axis=-1)   # [B, T, k*d]
+        out = jnp.einsum("btd,df->btf", ctx, wt)
+        return out + mb[0] if mb else out
+
+    args = (input, w) + ((b,) if b is not None else ())
+    out = apply(f, *args, name="sequence_conv")
+    from ...nn import functional as F
+    return getattr(F, act)(out) if act else out
+
+
+__all__ += [
+    "conv2d_transpose", "conv3d", "conv3d_transpose", "layer_norm",
+    "group_norm", "instance_norm", "data_norm", "bilinear_tensor_product",
+    "prelu", "spectral_norm", "sparse_embedding", "nce", "row_conv",
+    "deform_conv2d", "static_pylayer", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_concat",
+    "sequence_reverse", "sequence_pad", "sequence_unpad", "sequence_expand",
+    "sequence_expand_as", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_slice", "sequence_conv",
+]
+
+# py_func doubles as a static.nn name (reference exports it both places)
+from ..compat import py_func  # noqa: F401,E402
+
+__all__ += ["py_func"]

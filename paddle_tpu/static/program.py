@@ -79,6 +79,25 @@ class _StateTracker:
         self.written[id(t)] = t
 
 
+_ALL_PROGRAMS: list = []  # weakrefs; the Scope searches across programs
+
+
+def all_programs():
+    """Live Programs, newest last (compat.Scope's search space — the
+    reference's global scope likewise spans every program run). Dead
+    weakrefs are pruned so a build-programs-in-a-loop process never
+    scans an unbounded history."""
+    alive = []
+    live_refs = []
+    for ref in _ALL_PROGRAMS:
+        p = ref()
+        if p is not None:
+            alive.append(p)
+            live_refs.append(ref)
+    _ALL_PROGRAMS[:] = live_refs
+    return alive
+
+
 class Program:
     """A recorded computation: feeds, parameters, optimizer, fetch targets.
 
@@ -87,6 +106,8 @@ class Program:
     """
 
     def __init__(self):
+        import weakref
+        _ALL_PROGRAMS.append(weakref.ref(self))
         self._dbg = api_util.debug_info("static_program", lambda *a: a,
                                         (), {})
         self._trace = None
@@ -491,15 +512,42 @@ class Program:
         for q, init in self._param_init:
             if any(q is p for p in plist):
                 p_cand.setdefault(id(init), q)
+        def _sig(a):
+            # canonicalize typed PRNG keys to their raw uint32 data so a
+            # key captured post-random_wrap matches its raw initial
+            try:
+                if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+                    a = jax.random.key_data(a)
+                return (a.shape, str(a.dtype), np.asarray(a).tobytes())
+            except Exception:
+                return None
+
         s_cand = {}
+        s_by_value = {}   # canonical signature -> [tid]; fallback match
         for tid, (t, init) in self._state.initial.items():
             self._state_shadow.setdefault(tid, Tensor(init))
             s_cand[id(init)] = tid
+            sig = _sig(init)
+            if sig is not None:
+                s_by_value.setdefault(sig, []).append(tid)
+
+        def state_for(c):
+            tid = s_cand.get(id(c))
+            if tid is not None:
+                return tid
+            # some reads re-wrap the array (jax.random wraps RNG keys), so
+            # the jaxpr const is a different OBJECT with the same value;
+            # value-match only when unambiguous — two identically-
+            # initialized states must not be cross-threaded
+            sig = _sig(c)
+            cands = s_by_value.get(sig, []) if sig else []
+            return cands[0] if len(cands) == 1 else None
+
         lifted, lift_vars, kept_vars, kept_consts = [], [], [], []
         seen_lift = set()
         for v, c in zip(jaxpr.constvars, consts):
             p = p_cand.get(id(c))
-            tid = s_cand.get(id(c))
+            tid = state_for(c)
             if p is not None and id(p) not in seen_lift:
                 seen_lift.add(id(p))
                 lifted.append(("param", p))

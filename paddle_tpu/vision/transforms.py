@@ -162,10 +162,13 @@ class BrightnessTransform:
         if self.value == 0:
             return np.asarray(img)
         src_arr = np.asarray(img)
-        # ceiling decided by the INPUT's dtype, not post-scale values
+        # ceiling decided by the INPUT's dtype, not post-scale values;
+        # dtype restored so chained transforms (ColorJitter) keep seeing
+        # the convention their own ceiling logic expects
         ceil = 255.0 if np.issubdtype(src_arr.dtype, np.integer) else 1.0
-        factor = 1 + pyrandom.uniform(-self.value, self.value)
-        return np.clip(src_arr.astype(np.float32) * factor, 0, ceil)
+        factor = max(0.0, 1 + pyrandom.uniform(-self.value, self.value))
+        out = np.clip(src_arr.astype(np.float32) * factor, 0, ceil)
+        return out.astype(src_arr.dtype)
 
 
 class Pad:
@@ -382,7 +385,10 @@ def affine(img, angle, translate, scale, shear, interpolation="nearest",
         center = ((w - 1) * 0.5, (h - 1) * 0.5)
     if isinstance(shear, numbers.Number):
         shear = (shear, 0.0)
-    m = _affine_matrix(angle, translate, scale, shear, center)
+    # positive angle = counter-clockwise on the displayed image, same as
+    # rotate(): the forward matrix takes -angle in y-down array coords,
+    # and the sampler inverts it
+    m = _affine_matrix(-angle, translate, scale, shear, center)
     return _inverse_warp(arr, np.linalg.inv(m), (h, w), fill)
 
 

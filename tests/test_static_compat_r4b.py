@@ -1,0 +1,204 @@
+"""r4b static/static.nn/distributed compat surfaces, driven end-to-end
+(reference: python/paddle/static/__init__.py, static/nn/*.py,
+distributed/__init__.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def test_gradients_append_backward_scope_roundtrip(tmp_path):
+    prog, startup = static.Program(), static.Program()
+    with static.program_guard(prog, startup):
+        x = static.data("x", [4, 8], "float32")
+        lin = static.nn.fc(x, 4)
+        loss = (lin ** 2).mean()
+        params = prog._params
+        gs = static.gradients([loss], [params[0]])
+        pg = static.append_backward(loss)
+    assert len(pg) >= 1 and pg[0][1] is not None
+    exe = static.Executor()
+    exe.run(startup)
+    out = exe.run(prog, feed={"x": np.ones((4, 8), np.float32)},
+                  fetch_list=[loss, gs[0]])
+    assert np.isfinite(out[0]).all() and out[1].shape == (8, 4)
+    # scope finds program params; save/load roundtrip restores state
+    assert static.global_scope().find_var(
+        params[0].name).get_tensor().shape == (8, 4)
+    path = str(tmp_path / "prog")
+    static.save(prog, path)
+    old = params[0].numpy().copy()
+    params[0]._data = params[0]._data * 0
+    static.load(prog, path)
+    np.testing.assert_allclose(params[0].numpy(), old)
+    static.set_program_state(prog, static.load_program_state(path))
+
+
+def test_ema_pyfunc_metric_ops():
+    prog, startup = static.Program(), static.Program()
+    with static.program_guard(prog, startup):
+        x = static.data("x", [2, 4], "float32")
+        static.nn.fc(x, 2)
+        ema = static.ExponentialMovingAverage(0.9)  # binds prog
+    import jax.numpy as jnp
+    p = prog._params[0]
+    ema.update()
+    p._data = jnp.zeros_like(p._data) + 5.0
+    ema.update()
+    with ema.apply():
+        assert abs(p.numpy().mean() - 5.0) > 1e-3  # shadow in place
+    assert abs(p.numpy().mean() - 5.0) < 1e-6      # restored
+
+    def host_sq(a):
+        return a * a
+
+    # reference contract: backward_func(inputs..., outputs..., out_grads)
+    def host_sq_bwd(a, y, g):
+        return 2 * a * g
+
+    xt = paddle.to_tensor(np.array([2., 3.], np.float32),
+                          stop_gradient=False)
+    yt = static.py_func(host_sq, xt,
+                        out=paddle.to_tensor(np.zeros(2, np.float32)),
+                        backward_func=host_sq_bwd)
+    yt.sum().backward()
+    np.testing.assert_allclose(yt.numpy(), [4., 9.])
+    np.testing.assert_allclose(xt.grad.numpy(), [4., 6.])
+
+    # skip_vars_in_backward_input drops the named member of x/out
+    def tanh_grad(y, dy):
+        return dy * (1 - np.square(y))
+
+    x2 = paddle.to_tensor(np.array([0.5], np.float32), stop_gradient=False)
+    y2 = static.py_func(np.tanh, x2,
+                        out=paddle.to_tensor(np.zeros(1, np.float32)),
+                        backward_func=tanh_grad,
+                        skip_vars_in_backward_input=[x2])
+    y2.sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(),
+                               1 - np.tanh(0.5) ** 2, rtol=1e-5)
+
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    lab = paddle.to_tensor(np.array([[1], [0]], np.int64))
+    assert abs(float(static.accuracy(pred, lab)) - 1.0) < 1e-6
+    a, pos, neg = static.auc(pred, lab)
+    assert 0.99 <= float(a) <= 1.0
+    assert len(static.ctr_metric_bundle(
+        paddle.to_tensor(np.array([0.9, 0.2], np.float32)),
+        paddle.to_tensor(np.array([1., 0.], np.float32)))) == 6
+    with pytest.raises(NotImplementedError):
+        static.IpuStrategy()
+
+
+def test_static_nn_layer_factories():
+    rng = np.random.default_rng(0)
+    sn = static.nn
+    x4 = paddle.to_tensor(rng.standard_normal((2, 3, 8, 8)).astype(np.float32))
+    assert tuple(sn.conv2d_transpose(x4, 5, 3).shape)[:2] == (2, 5)
+    x5 = paddle.to_tensor(
+        rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32))
+    assert tuple(sn.conv3d(x5, 4, 3, padding=1).shape) == (1, 4, 4, 4, 4)
+    xf = paddle.to_tensor(rng.standard_normal((4, 6)).astype(np.float32))
+    assert tuple(sn.layer_norm(xf).shape) == (4, 6)
+    assert tuple(sn.group_norm(x4, 3).shape) == (2, 3, 8, 8)
+    assert tuple(sn.instance_norm(x4).shape) == (2, 3, 8, 8)
+    assert np.isfinite(sn.data_norm(xf).numpy()).all()
+    y = paddle.to_tensor(rng.standard_normal((4, 5)).astype(np.float32))
+    assert tuple(sn.bilinear_tensor_product(xf, y, 3).shape) == (4, 3)
+    assert tuple(sn.prelu(x4, "channel").shape) == (2, 3, 8, 8)
+    wt = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    assert tuple(sn.spectral_norm(wt).shape) == (4, 8)
+    lab = paddle.to_tensor(rng.integers(0, 20, (4, 1)).astype(np.int64))
+    nl = sn.nce(xf, lab, 20, num_neg_samples=5)
+    assert tuple(nl.shape) == (4, 1) and (nl.numpy() > 0).all()
+    seq = paddle.to_tensor(rng.standard_normal((2, 5, 6)).astype(np.float32))
+    assert tuple(sn.row_conv(seq, 2).shape) == (2, 5, 6)
+    off = paddle.to_tensor(np.zeros((2, 2 * 9, 8, 8), np.float32))
+    # zero offsets: deformable conv == ordinary conv with the same weight
+    dc = sn.deform_conv2d(x4, off, num_filters=4, filter_size=3, padding=1,
+                          bias_attr=False)
+    assert tuple(dc.shape) == (2, 4, 8, 8)
+
+
+def test_static_nn_sequence_ops():
+    rng = np.random.default_rng(1)
+    sn = static.nn
+    seq = paddle.to_tensor(rng.standard_normal((2, 5, 6)).astype(np.float32))
+    lens = paddle.to_tensor(np.array([3, 5], np.int64))
+    s = sn.sequence_softmax(seq, lens).numpy()
+    np.testing.assert_allclose(s[0, :3].sum(0), np.ones(6), atol=1e-5)
+    assert np.abs(s[0, 3:]).max() == 0
+    np.testing.assert_allclose(
+        sn.sequence_pool(seq, "average", lens).numpy()[0],
+        seq.numpy()[0, :3].mean(0), atol=1e-5)
+    np.testing.assert_allclose(sn.sequence_last_step(seq, lens).numpy()[0],
+                               seq.numpy()[0, 2], atol=1e-6)
+    rv = sn.sequence_reverse(seq, lens).numpy()
+    np.testing.assert_allclose(rv[0, :3], seq.numpy()[0, :3][::-1],
+                               atol=1e-6)
+    np.testing.assert_allclose(rv[0, 3:], seq.numpy()[0, 3:], atol=1e-6)
+    padded, pl = sn.sequence_pad(seq, 0.0, maxlen=7)
+    assert tuple(padded.shape) == (2, 7, 6)
+    assert tuple(sn.sequence_concat([seq, seq]).shape) == (2, 10, 6)
+    sl = sn.sequence_slice(seq, paddle.to_tensor(np.array([1, 0], np.int64)),
+                           paddle.to_tensor(np.array([2, 3], np.int64)))
+    np.testing.assert_allclose(sl.numpy()[0, :2], seq.numpy()[0, 1:3],
+                               atol=1e-6)
+    assert tuple(sn.sequence_conv(seq, 4, 3).shape) == (2, 5, 4)
+
+
+def test_distributed_compat_and_io(tmp_path):
+    import paddle_tpu.distributed as dist
+    dist.gloo_init_parallel_env(0, 1, "127.0.0.1:0")
+    dist.gloo_barrier()
+    dist.gloo_barrier()
+    dist.gloo_release()
+    assert dist.CountFilterEntry(3)._to_attr() == "count_filter_entry:3"
+    f1 = tmp_path / "part-0"
+    f1.write_text("1 2 3\n4 5 6\n7 8 9\n")
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=2)
+    ds.set_filelist([str(f1)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    assert len(list(ds)) == 2
+    qd = dist.QueueDataset()
+    qd.init(batch_size=2)
+    qd.set_filelist([str(f1)])
+    assert sum(len(b) for b in qd) == 3
+    mesh = dist.ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+    da = dist.DistAttr(mesh=mesh, sharding_specs=["x", None])
+    assert da.dims_mapping == [0, -1]
+
+    prog, startup = static.Program(), static.Program()
+    with static.program_guard(prog, startup):
+        x = static.data("x", [2, 4], "float32")
+        static.nn.fc(x, 3)
+    exe = static.Executor()
+    exe.run(startup)
+    p0 = prog._params[0].numpy().copy()
+    dist.io.save_persistables(exe, str(tmp_path), prog)
+    prog._params[0]._data = prog._params[0]._data * 0
+    dist.io.load_persistables(exe, str(tmp_path), prog)
+    np.testing.assert_allclose(prog._params[0].numpy(), p0)
+
+
+def test_namespace_sweep_zero_missing():
+    """The round-4b milestone: every reference namespace __all__ resolves
+    (vendored spot list per namespace; full 24-namespace diff ran at
+    build time)."""
+    spot = {
+        "static": ["append_backward", "gradients", "ExponentialMovingAverage",
+                   "py_func", "CompiledProgram", "global_scope", "auc"],
+        "static.nn": ["deform_conv2d", "nce", "sequence_conv",
+                      "static_pylayer", "row_conv", "sparse_embedding"],
+        "distributed": ["io", "gloo_barrier", "InMemoryDataset", "DistAttr",
+                        "QueueDataset", "ShowClickEntry"],
+    }
+    import importlib
+    for mod, names in spot.items():
+        ours = importlib.import_module("paddle_tpu." + mod)
+        missing = [n for n in names if not hasattr(ours, n)]
+        assert not missing, f"{mod}: {missing}"
