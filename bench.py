@@ -479,6 +479,29 @@ def run_moe_bench(dev):
             "step_breakdown": breakdown}
 
 
+def run_ernie_bench(dev):
+    """ERNIE family throughput (BASELINE.md ladder #2): the native-Paddle
+    flagship — dense-first + MoE-tail backbone with the router aux loss
+    riding the same step."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Ernie, ErnieConfig
+
+    paddle.seed(0)
+    cfg = ErnieConfig(
+        vocab_size=32000, max_position_embeddings=1024, hidden_size=512,
+        num_layers=4, num_heads=8, num_kv_heads=4, intermediate_size=2048,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=512,
+        shared_expert_intermediate_size=512, first_k_dense=2)
+    model = Ernie(cfg)
+    batch, seq, steps, warmup = 4, 1024, 8, 2
+    tokens_per_s, final, breakdown = _train_throughput(
+        model, batch, seq, steps, warmup, cfg.vocab_size, on_tpu=True)
+    return {"tokens_per_sec": round(tokens_per_s, 1),
+            "loss": round(final, 3),
+            "n_params": model.num_params(),
+            "step_breakdown": breakdown}
+
+
 def run_dit_bench(dev):
     """DiT-S/2 training throughput (BASELINE.md ladder #4: 'trains;
     throughput reported'): images/s for the jitted DDPM train step."""
@@ -684,7 +707,8 @@ def _child_main(mode):
                     ("kernel_ab", run_kernel_ab),
                     ("dit_s2", run_dit_bench),
                     ("sd3_mmdit", run_sd3_bench),
-                    ("qwen2_moe", run_moe_bench)):
+                    ("qwen2_moe", run_moe_bench),
+                    ("ernie", run_ernie_bench)):
                 try:
                     result["extra"][key] = _with_alarm(420, fn, dev)
                 except Exception:

@@ -50,7 +50,8 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     # retain the graph: the reference's gradients() leaves the program
     # intact for further appends (e.g. a later append_backward)
     return _grad(tl, il, grad_outputs=gl, allow_unused=True,
-                 retain_graph=True)
+                 retain_graph=True,
+                 no_grad_vars=list(no_grad_set) if no_grad_set else None)
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
@@ -209,7 +210,8 @@ def Print(input, first_n=-1, message=None, summarize=20,
 
     from ..autograd.function import apply
 
-    msg = message or ""
+    # braces in the user message must not reach str.format
+    msg = (message or "").replace("{", "{{").replace("}", "}}")
 
     def f(a):
         jax.debug.print(msg + " {x}", x=a)

@@ -535,10 +535,17 @@ class Program:
             tid = s_cand.get(id(c))
             if tid is not None:
                 return tid
-            # some reads re-wrap the array (jax.random wraps RNG keys), so
-            # the jaxpr const is a different OBJECT with the same value;
-            # value-match only when unambiguous — two identically-
-            # initialized states must not be cross-threaded
+            # jax.random RE-WRAPS keys (random_wrap), so a key const is a
+            # different OBJECT than its raw initial — value-match, but
+            # ONLY for typed PRNG keys: a plain array that happens to
+            # equal a state initial (e.g. ones[C] both as BN stat and as
+            # a user constant) must never be lifted as state
+            try:
+                is_key = jnp.issubdtype(c.dtype, jax.dtypes.prng_key)
+            except Exception:
+                is_key = False
+            if not is_key:
+                return None
             sig = _sig(c)
             cands = s_by_value.get(sig, []) if sig else []
             return cands[0] if len(cands) == 1 else None
