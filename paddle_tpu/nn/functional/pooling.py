@@ -29,31 +29,64 @@ def _tup(v, n):
     return tuple(int(x) for x in a)
 
 
+def _ceil_extra(size, k, st, pd):
+    """Extra high-side padding so the output has ceil((size+2p-k)/st)+1
+    windows (reference ceil_mode contract; windows are clipped to the
+    padded extent)."""
+    rem = (size + 2 * pd - k) % st
+    return (st - rem) if rem else 0
+
+
 def _pool(x, kernel, stride, padding, n, channel_last, reducer, init, name,
-          ceil_mode=False, count_include_pad=True, average=False):
+          ceil_mode=False, count_include_pad=True, average=False,
+          divisor=None):
     k = _tup(kernel, n)
     st = _tup(stride if stride is not None else kernel, n)
     pd = _tup(padding, n)
+    sp = (x.shape[-1 - n:-1] if channel_last else x.shape[-n:])
+    ex = tuple(_ceil_extra(int(sp[i]), k[i], st[i], pd[i]) if ceil_mode
+               else 0 for i in range(n))
     if channel_last:
         dims = (1,) + k + (1,)
         strides = (1,) + st + (1,)
-        pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
+        pads = ((0, 0),) + tuple((p, p + e) for p, e in zip(pd, ex)) \
+            + ((0, 0),)
     else:
         dims = (1, 1) + k
         strides = (1, 1) + st
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+        pads = ((0, 0), (0, 0)) + tuple((p, p + e) for p, e in zip(pd, ex))
 
     def f(a):
         out = jax.lax.reduce_window(a, init(a.dtype), reducer, dims, strides, pads)
         if average:
-            if count_include_pad:
-                denom = float(np.prod(k))
-                out = out / denom
+            if divisor is not None:
+                out = out / divisor
+            elif count_include_pad:
+                if any(e > 0 for e in ex):
+                    # ceil-mode windows are clipped to the padded extent, so
+                    # the include-pad divisor is the clipped window size
+                    # min(start+k, size+2p) - start, not prod(k)
+                    denom = jnp.ones((), out.dtype)
+                    for i in range(n):
+                        ext = int(sp[i]) + 2 * pd[i]
+                        o_i = (ext + ex[i] - k[i]) // st[i] + 1
+                        starts = jnp.arange(o_i) * st[i]
+                        cnt_i = (jnp.minimum(starts + k[i], ext)
+                                 - starts).astype(out.dtype)
+                        shape = [1] * out.ndim
+                        shape[(1 if channel_last else 2) + i] = o_i
+                        denom = denom * cnt_i.reshape(shape)
+                    out = out / denom
+                else:
+                    out = out / float(np.prod(k))
             else:
                 ones = jnp.ones_like(a)
                 cnt = jax.lax.reduce_window(ones, jnp.zeros((), a.dtype),
                                             jax.lax.add, dims, strides, pads)
-                out = out / cnt
+                # a ceil-mode window can fall entirely in the pad margin:
+                # the reference kernel emits 0 there, never 0/0
+                out = jnp.where(cnt > 0, out / jnp.maximum(cnt, 1),
+                                jnp.zeros((), out.dtype))
         return out
     return apply(f, x, name=name)
 
@@ -62,37 +95,41 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None) -> Tensor:
     if return_mask:
         return _max_pool_with_mask(x, kernel_size, stride, padding, 1,
-                                   data_format == "NLC", "max_pool1d")
+                                   data_format == "NLC", "max_pool1d",
+                                   ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
                  jax.lax.max, _max_init,
-                 "max_pool1d")
+                 "max_pool1d", ceil_mode=ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None) -> Tensor:
     if return_mask:
         return _max_pool_with_mask(x, kernel_size, stride, padding, 2,
-                                   data_format == "NHWC", "max_pool2d")
+                                   data_format == "NHWC", "max_pool2d",
+                                   ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
                  jax.lax.max, _max_init,
-                 "max_pool2d")
+                 "max_pool2d", ceil_mode=ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None) -> Tensor:
     if return_mask:
         return _max_pool_with_mask(x, kernel_size, stride, padding, 3,
-                                   data_format == "NDHWC", "max_pool3d")
+                                   data_format == "NDHWC", "max_pool3d",
+                                   ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
                  jax.lax.max, _max_init,
-                 "max_pool3d")
+                 "max_pool3d", ceil_mode=ceil_mode)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, data_format="NCL", name=None) -> Tensor:
     return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
                  jax.lax.add, lambda dt: jnp.zeros((), dt), "avg_pool1d",
-                 count_include_pad=not exclusive, average=True)
+                 ceil_mode=ceil_mode, count_include_pad=not exclusive,
+                 average=True)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -100,7 +137,8 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                name=None) -> Tensor:
     return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
                  jax.lax.add, lambda dt: jnp.zeros((), dt), "avg_pool2d",
-                 count_include_pad=not exclusive, average=True)
+                 ceil_mode=ceil_mode, count_include_pad=not exclusive,
+                 average=True, divisor=divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -108,7 +146,8 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                name=None) -> Tensor:
     return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
                  jax.lax.add, lambda dt: jnp.zeros((), dt), "avg_pool3d",
-                 count_include_pad=not exclusive, average=True)
+                 ceil_mode=ceil_mode, count_include_pad=not exclusive,
+                 average=True, divisor=divisor_override)
 
 
 def _adaptive(x, output_size, n, channel_last, mode, name):
@@ -168,7 +207,8 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None) -> Tensor:
     return _adaptive(x, output_size, 3, False, "max", "adaptive_max_pool3d")
 
 
-def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last, name):
+def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last, name,
+                        ceil_mode=False):
     """(out, mask): max pool + flattened-argmax indices over the input's
     spatial dims (reference return_mask contract — the mask feeds
     max_unpool)."""
@@ -182,8 +222,11 @@ def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last, name):
         if channel_last:
             a = jnp.moveaxis(a, -1, 1)
         sp = a.shape[2:]
-        ap = jnp.pad(a, ((0, 0), (0, 0)) + tuple((p, p) for p in pd),
-                     constant_values=_max_init(a.dtype))
+        ex = tuple(_ceil_extra(int(sp[i]), k[i], st[i], pd[i]) if ceil_mode
+                   else 0 for i in range(n))
+        ap = jnp.pad(a, ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(pd, ex)),
+            constant_values=_max_init(a.dtype))
         out_sp = tuple((ap.shape[2 + i] - k[i]) // st[i] + 1
                        for i in range(n))
         patches, flat_idx = [], []
@@ -194,7 +237,10 @@ def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last, name):
             patches.append(sl)
             idx = jnp.zeros((1, 1) + (1,) * n, jnp.int32)
             for i in range(n):
-                pos = jnp.arange(out_sp[i]) * st[i] + offs[i] - pd[i]
+                # clamp padding-margin taps into the valid input extent so
+                # a fully-padded window cannot emit a wrapped scatter index
+                pos = jnp.clip(jnp.arange(out_sp[i]) * st[i] + offs[i]
+                               - pd[i], 0, sp[i] - 1)
                 shape = [1, 1] + [1] * n
                 shape[2 + i] = out_sp[i]
                 idx = idx * sp[i] + pos.reshape(shape)
@@ -203,6 +249,19 @@ def _max_pool_with_mask(x, kernel, stride, padding, n, channel_last, name):
         arg = jnp.argmax(stacked, axis=0)
         out = jnp.max(stacked, axis=0)
         mask = jnp.take_along_axis(jnp.stack(flat_idx, 0), arg[None], 0)[0]
+        # a window entirely in the pad margin has no valid argmax: the
+        # reference kernel leaves its index at -1. Validity is static
+        # geometry (does the window intersect the real extent?), never a
+        # value comparison — dtype-min/-inf data maxima must keep their
+        # real index.
+        for i in range(n):
+            starts = np.arange(out_sp[i]) * st[i] - pd[i]
+            valid_i = (starts < sp[i]) & (starts + k[i] > 0)
+            if valid_i.all():
+                continue
+            shape = [1, 1] + [1] * n
+            shape[2 + i] = out_sp[i]
+            mask = jnp.where(jnp.asarray(valid_i).reshape(shape), mask, -1)
         if channel_last:
             out = jnp.moveaxis(out, 1, -1)
             mask = jnp.moveaxis(mask, 1, -1)
@@ -244,7 +303,11 @@ def _max_unpool(x, indices, kernel, stride, padding, output_size, n,
         ci = jnp.arange(c).reshape(1, c, 1)
         mi = idx.reshape(nb, c, -1)
         vals = a.reshape(nb, c, -1)
-        flat = jnp.zeros((nb, c, s_total), a.dtype).at[bi, ci, mi].set(vals)
+        # route invalid (-1) indices from fully-padded ceil-mode windows
+        # into a dump slot past the real extent, then slice it off
+        mi = jnp.where(mi >= 0, mi, s_total)
+        flat = jnp.zeros((nb, c, s_total + 1), a.dtype) \
+            .at[bi, ci, mi].set(vals)[:, :, :s_total]
         out = flat.reshape((nb, c) + out_sp)
         if channel_last:
             out = jnp.moveaxis(out, 1, -1)

@@ -25,6 +25,7 @@ class _Pool(Layer):
         self.padding = padding
         self.ceil_mode = ceil_mode
         self.exclusive = exclusive
+        self.divisor_override = divisor_override
         self.data_format = data_format or self._default_fmt
         self.return_mask = return_mask
 
@@ -41,6 +42,7 @@ class AvgPool2D(_Pool):
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
                             self.ceil_mode, self.exclusive,
+                            divisor_override=self.divisor_override,
                             data_format=self.data_format)
 
 
@@ -50,6 +52,7 @@ class AvgPool3D(_Pool):
     def forward(self, x):
         return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
                             self.ceil_mode, self.exclusive,
+                            divisor_override=self.divisor_override,
                             data_format=self.data_format)
 
 

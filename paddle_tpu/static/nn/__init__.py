@@ -556,73 +556,33 @@ def deform_conv2d(x, offset, mask=None, num_filters=None, filter_size=3,
                   deformable_groups=1, im2col_step=1, param_attr=None,
                   bias_attr=None, name=None):
     """Deformable conv v1/v2 (reference static.nn.deform_conv2d over the
-    deformable_conv kernels): per-position kernel offsets drive bilinear
-    sampling (grid_sample machinery), then an ordinary dense contraction."""
-    import jax.numpy as jnp
-
-    from ...autograd.function import apply
+    deformable_conv kernels). Creates the filter/bias parameters, then
+    delegates to the vectorized vision.ops.deform_conv2d (same weight
+    [co, cin//groups, kh, kw] and offset (y, x)-interleaved channel
+    layout)."""
     from ...framework.parameter import create_parameter as _cp
+    from ...vision.ops import deform_conv2d as _dcn
 
-    n, cin, h, w_ = (int(s) for s in x.shape)
+    if num_filters is None:
+        raise ValueError("deform_conv2d: num_filters is required")
+    cin = int(x.shape[1])
     kh = kw = int(filter_size) if isinstance(filter_size, int) else None
     if kh is None:
         kh, kw = (int(s) for s in filter_size)
-    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
-    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
-    oh = (h + 2 * pd[0] - (kh - 1) - 1) // st[0] + 1
-    ow = (w_ + 2 * pd[1] - (kw - 1) - 1) // st[1] + 1
+    if cin % groups or num_filters % groups:
+        raise ValueError("deform_conv2d: groups must divide both the input "
+                         f"channels ({cin}) and num_filters ({num_filters})")
+    if cin % deformable_groups:
+        raise ValueError("deform_conv2d: deformable_groups must divide the "
+                         f"input channels ({cin})")
     with suspend_trace():
-        weight = _cp([num_filters, cin, kh, kw], dtype="float32",
+        weight = _cp([num_filters, cin // groups, kh, kw], dtype="float32",
                      attr=param_attr)
         bias = _cp([num_filters], dtype="float32", attr=bias_attr,
                    is_bias=True) if bias_attr is not False else None
-
-    def f(xa, off, wt, *rest):
-        m = rest[0] if mask is not None else None
-        base_y = jnp.arange(oh) * st[0] - pd[0]
-        base_x = jnp.arange(ow) * st[1] - pd[1]
-        cols = []
-        for i in range(kh):
-            for j in range(kw):
-                kidx = i * kw + j
-                dy = off[:, 2 * kidx]                  # [N, OH, OW]
-                dx = off[:, 2 * kidx + 1]
-                py = base_y[None, :, None] + i + dy
-                px = base_x[None, None, :] + j + dx
-                y0 = jnp.floor(py)
-                x0 = jnp.floor(px)
-                wy = py - y0
-                wx = px - x0
-
-                def gather(yy, xx):
-                    yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
-                    xi = jnp.clip(xx.astype(jnp.int32), 0, w_ - 1)
-                    v = xa[jnp.arange(n)[:, None, None], :, yi, xi]
-                    inb = ((yy >= 0) & (yy <= h - 1) & (xx >= 0)
-                           & (xx <= w_ - 1))
-                    return jnp.moveaxis(v, -1, 1) * \
-                        inb[:, None].astype(xa.dtype)
-
-                val = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
-                       + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
-                       + gather(y0 + 1, x0) * (wy * (1 - wx))[:, None]
-                       + gather(y0 + 1, x0 + 1) * (wy * wx)[:, None])
-                if m is not None:
-                    val = val * m[:, kidx][:, None]
-                cols.append(val)                       # [N, Cin, OH, OW]
-        col = jnp.stack(cols, 2)                       # [N, Cin, K, OH, OW]
-        out = jnp.einsum("nckhw,ock->nohw", col,
-                         wt.reshape(num_filters, cin, kh * kw))
-        if bias is not None:
-            out = out + rest[-1].reshape(1, -1, 1, 1)
-        return out
-
-    args = [x, offset, weight]
-    if mask is not None:
-        args.append(mask)
-    if bias is not None:
-        args.append(bias)
-    return apply(f, *args, name="deform_conv2d")
+    return _dcn(x, offset, weight, bias=bias, stride=stride, padding=padding,
+                dilation=dilation, deformable_groups=deformable_groups,
+                groups=groups, mask=mask)
 
 
 def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
@@ -863,8 +823,12 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
         lo = max(0, -start)
         hi = max(0, start + k - 1)
         xp = jnp.pad(x, [(0, 0), (lo, hi), (0, 0)])
+        # window for step t is xp rows [t + start + lo, ...): offset is 0
+        # when start <= 0 (lo == -start) and `start` when start > 0
+        off = start + lo
         ctx = jnp.concatenate(
-            [xp[:, i:i + t] for i in range(k)], axis=-1)   # [B, T, k*d]
+            [xp[:, i + off:i + off + t] for i in range(k)],
+            axis=-1)                                       # [B, T, k*d]
         out = jnp.einsum("btd,df->btf", ctx, wt)
         return out + mb[0] if mb else out
 

@@ -321,11 +321,27 @@ def to_grayscale(img, num_output_channels=1):
     return out.astype(arr.dtype)
 
 
-def erase(img, i, j, h, w, v, inplace=False):
+def _is_chw(img, data_format=None):
+    """CHW/HWC decision: explicit data_format wins; a Tensor is CHW and a
+    PIL image HWC by type (the reference contract); only a bare ndarray —
+    which this module's ToTensor emits as CHW — falls back to the shape
+    heuristic."""
+    if data_format is not None:
+        return str(data_format).upper() == "CHW"
+    from ..core.tensor import Tensor
+    if isinstance(img, Tensor):
+        return True
+    if not isinstance(img, np.ndarray):  # PIL image
+        return False
+    return (img.ndim == 3 and img.shape[0] in (1, 3)
+            and img.shape[-1] not in (1, 3))
+
+
+def erase(img, i, j, h, w, v, inplace=False, data_format=None):
+    chw = _is_chw(img, data_format)
     arr = np.asarray(img)
     out = arr if inplace else arr.copy()
-    if out.ndim == 3 and out.shape[0] in (1, 3) and out.shape[-1] not in \
-            (1, 3):
+    if out.ndim == 3 and chw:
         out[:, i:i + h, j:j + w] = v  # CHW
     else:
         out[i:i + h, j:j + w] = v     # HWC
@@ -641,11 +657,11 @@ class RandomErasing(BaseTransform):
         self.value, self.inplace = value, inplace
 
     def _apply_image(self, img):
+        chw = _is_chw(img)
         arr = np.asarray(img)
         if pyrandom.random() >= self.prob:
             return arr
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
-            and arr.shape[-1] not in (1, 3)
+        chw = chw and arr.ndim == 3
         h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
         area = h * w
         for _ in range(10):
@@ -657,5 +673,6 @@ class RandomErasing(BaseTransform):
             if eh < h and ew < w:
                 i = pyrandom.randint(0, h - eh)
                 j = pyrandom.randint(0, w - ew)
-                return erase(arr, i, j, eh, ew, self.value, self.inplace)
+                return erase(arr, i, j, eh, ew, self.value, self.inplace,
+                             data_format="CHW" if chw else "HWC")
         return arr

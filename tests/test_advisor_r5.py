@@ -1,0 +1,157 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4):
+deform_conv2d dilation/groups/deformable_groups, sequence_conv positive
+padding_start, max-pool mask index clamping + ceil_mode, erase CHW/HWC
+classification by type."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import static
+
+sn = static.nn
+
+
+def _ones_attr():
+    from paddle_tpu.framework import ParamAttr
+    from paddle_tpu.nn.initializer import Constant
+    return ParamAttr(initializer=Constant(1.0))
+
+
+def test_static_deform_conv2d_dilation_matches_conv():
+    """Zero offsets + dilation=2 must equal an ordinary dilated conv
+    (the old code ignored dilation and even produced the wrong shape)."""
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 8, 8)).astype(np.float32))
+    off = paddle.to_tensor(np.zeros((2, 2 * 9, 8, 8), np.float32))
+    out = sn.deform_conv2d(x, off, num_filters=4, filter_size=3, padding=2,
+                           dilation=2, param_attr=_ones_attr(),
+                           bias_attr=False)
+    assert tuple(out.shape) == (2, 4, 8, 8)
+    w = paddle.to_tensor(np.ones((4, 3, 3, 3), np.float32))
+    ref = F.conv2d(x, w, padding=2, dilation=2)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-3)
+
+
+def test_static_deform_conv2d_groups():
+    """groups=2 contracts each half of the channels against its own
+    filters; with ones-weights that equals a grouped ones-conv."""
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((1, 4, 6, 6)).astype(np.float32))
+    off = paddle.to_tensor(np.zeros((1, 2 * 9, 6, 6), np.float32))
+    out = sn.deform_conv2d(x, off, num_filters=4, filter_size=3, padding=1,
+                           groups=2, param_attr=_ones_attr(),
+                           bias_attr=False)
+    w = paddle.to_tensor(np.ones((4, 2, 3, 3), np.float32))
+    ref = F.conv2d(x, w, padding=1, groups=2)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-3)
+    with pytest.raises(ValueError):
+        sn.deform_conv2d(x, off, num_filters=4, filter_size=3, groups=3)
+
+
+def test_static_deform_conv2d_deformable_groups():
+    """deformable_groups=2: shifting only group 0's offsets moves only the
+    first half of the input channels."""
+    rng = np.random.default_rng(2)
+    x_np = rng.standard_normal((1, 4, 6, 6)).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    off_np = np.zeros((1, 2 * 2 * 9, 6, 6), np.float32)
+    base = sn.deform_conv2d(x, paddle.to_tensor(off_np), num_filters=2,
+                            filter_size=3, padding=1, deformable_groups=2,
+                            param_attr=_ones_attr(), bias_attr=False)
+    # shift group 1's taps far out of bounds -> its half contributes zero
+    off_np[:, 18:] = 100.0
+    shifted = sn.deform_conv2d(x, paddle.to_tensor(off_np), num_filters=2,
+                               filter_size=3, padding=1, deformable_groups=2,
+                               param_attr=_ones_attr(), bias_attr=False)
+    w_half = paddle.to_tensor(np.ones((2, 4, 3, 3), np.float32))
+    xz = paddle.to_tensor(
+        np.concatenate([x_np[:, :2], np.zeros_like(x_np[:, 2:])], 1))
+    ref = F.conv2d(xz, w_half, padding=1)
+    np.testing.assert_allclose(shifted.numpy(), ref.numpy(), atol=1e-3)
+    assert not np.allclose(base.numpy(), shifted.numpy())
+
+
+def test_sequence_conv_positive_padding_start():
+    """padding_start=+1: step t's window is rows [t+1, t+1+k) — i.e. the
+    future context only (the old slicing ignored the positive shift)."""
+    rng = np.random.default_rng(3)
+    x_np = rng.standard_normal((1, 5, 2)).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    out = sn.sequence_conv(x, 3, filter_size=2, padding_start=1,
+                           param_attr=_ones_attr(), bias_attr=False)
+    # ones-weight fc over the window == sum of the window rows, per filter
+    xp = np.pad(x_np, [(0, 0), (0, 2), (0, 0)])
+    want = np.stack([xp[0, t + 1:t + 3].sum() * np.ones(3)
+                     for t in range(5)])[None]
+    np.testing.assert_allclose(out.numpy(), want, atol=1e-4)
+
+
+def test_max_pool_mask_clamped_and_ceil_mode():
+    # window fully inside the padded margin must not emit negative indices
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out, mask = F.max_pool2d(x, kernel_size=2, stride=2, padding=1,
+                             return_mask=True)
+    assert (mask.numpy() >= 0).all() and (mask.numpy() < 16).all()
+    # ceil_mode grows the output when the window does not tile exactly
+    x2 = paddle.to_tensor(np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5))
+    floor_out = F.max_pool2d(x2, kernel_size=2, stride=2)
+    ceil_out = F.max_pool2d(x2, kernel_size=2, stride=2, ceil_mode=True)
+    assert tuple(floor_out.shape) == (1, 1, 2, 2)
+    assert tuple(ceil_out.shape) == (1, 1, 3, 3)
+    assert ceil_out.numpy()[0, 0, 2, 2] == 24.0
+    co, cm = F.max_pool2d(x2, kernel_size=2, stride=2, ceil_mode=True,
+                          return_mask=True)
+    assert tuple(co.shape) == (1, 1, 3, 3)
+    assert cm.numpy()[0, 0, 2, 2] == 24
+    # avg_pool honors ceil_mode + divisor_override too
+    av = F.avg_pool2d(x2, kernel_size=2, stride=2, ceil_mode=True)
+    assert tuple(av.shape) == (1, 1, 3, 3)
+    dv = F.avg_pool2d(x2, kernel_size=2, stride=2, divisor_override=2)
+    np.testing.assert_allclose(
+        dv.numpy(),
+        F.avg_pool2d(x2, kernel_size=2, stride=2).numpy() * 2, atol=1e-5)
+
+
+def test_erase_data_format_by_type():
+    from paddle_tpu.vision.transforms import erase
+    # ambiguous HWC ndarray (H=3): explicit data_format resolves it
+    img = np.ones((3, 8, 4), np.uint8) * 7
+    out = erase(img, 0, 0, 2, 3, 0, data_format="HWC")
+    assert (out[:2, :3] == 0).all()
+    assert (out[2, :] == 7).all()
+    # a Tensor is CHW by type, regardless of shape values
+    t = paddle.to_tensor(np.ones((4, 8, 8), np.float32))
+    out_t = erase(t, 1, 2, 3, 4, 0.0)
+    assert (out_t[:, 1:4, 2:6] == 0).all()
+    assert out_t[0, 0, 0] == 1.0
+    # a CHW ndarray (ToTensor output) keeps CHW semantics via the heuristic
+    chw = np.ones((3, 8, 8), np.float32)
+    out_c = erase(chw, 1, 2, 3, 4, 0.0)
+    assert (out_c[:, 1:4, 2:6] == 0).all()
+    assert out_c[0, 0, 0] == 1.0
+    # explicit data_format overrides the heuristic
+    out_e = erase(chw, 0, 0, 2, 3, 0.0, data_format="HWC")
+    assert (out_e[:2, :3, :] == 0).all()
+
+
+def test_avg_pool_ceil_include_pad_divisor():
+    """include-pad avg with ceil_mode divides the clipped last window by its
+    clipped size, not by prod(kernel) (reference kernel contract)."""
+    x = paddle.to_tensor(np.array([[[1.0, 2.0, 3.0]]], np.float32))
+    out = F.avg_pool1d(x, kernel_size=2, stride=2, exclusive=False,
+                       ceil_mode=True)
+    np.testing.assert_allclose(out.numpy(), [[[1.5, 3.0]]], atol=1e-6)
+
+
+def test_avg_pool_layer_divisor_override():
+    from paddle_tpu import nn
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    layer = nn.AvgPool2D(kernel_size=2, divisor_override=2)
+    np.testing.assert_allclose(
+        layer(x).numpy(),
+        F.avg_pool2d(x, kernel_size=2, divisor_override=2).numpy(),
+        atol=1e-6)
+    assert not np.allclose(layer(x).numpy(),
+                           F.avg_pool2d(x, kernel_size=2).numpy())
