@@ -1,0 +1,756 @@
+"""Detection op family (reference: python/paddle/vision/ops.py — yolo_loss
+:51, yolo_box :259, prior_box :420, box_coder :566, distribute_fpn_proposals
+:1149, read_file :1294, decode_jpeg :1336, psroi_pool :1385, generate_proposals
+:2028, matrix_nms :2205; kernels under paddle/phi/kernels/cpu/).
+
+TPU design split:
+- dense, static-shape compute (yolo_loss, yolo_box, prior_box, box_coder,
+  psroi_pool) is fully vectorized jnp — jittable, differentiable where the
+  reference is, rides the VPU/MXU;
+- dynamic-output post-processing (matrix_nms, generate_proposals,
+  distribute_fpn_proposals) runs on host in numpy, exactly like the
+  reference's CPU-only detection kernels — these are eager, after-the-model
+  ops whose output shapes depend on the data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..autograd.function import apply, apply_multi
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = [
+    "yolo_loss", "yolo_box", "prior_box", "box_coder", "matrix_nms",
+    "generate_proposals", "distribute_fpn_proposals", "psroi_pool",
+    "read_file", "decode_jpeg", "DeformConv2D", "RoIAlign", "RoIPool",
+    "PSRoIPool",
+]
+
+
+def _sce(x, label):
+    """Numerically-stable sigmoid cross entropy (reference
+    yolo_loss_kernel.cc SigmoidCrossEntropy)."""
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _cwh_iou(x1, y1, w1, h1, x2, y2, w2, h2):
+    """IoU of center/size boxes with broadcasting (CalcBoxIoU)."""
+    ov_w = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2) - \
+        jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+    ov_h = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2) - \
+        jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+    inter = jnp.where((ov_w < 0) | (ov_h < 0), 0.0, ov_w * ov_h)
+    union = w1 * h1 + w2 * h2 - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference vision/ops.py:51 over
+    phi/kernels/cpu/yolo_loss_kernel.cc). Returns per-sample loss [N].
+
+    Fully vectorized: the per-cell ignore mask is a broadcast IoU against
+    all gt boxes; positive-sample assignment scatters per-gt targets into
+    the grid. Differentiable w.r.t. x."""
+    anchors = [int(a) for a in anchors]
+    anchor_mask = [int(m) for m in anchor_mask]
+    class_num = int(class_num)
+    s_num = len(anchor_mask)
+    a_num = len(anchors) // 2
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    x_t, gtb_t, gtl_t = as_tensor(x), as_tensor(gt_box), as_tensor(gt_label)
+    args = [x_t, gtb_t, gtl_t]
+    if gt_score is not None:
+        args.append(as_tensor(gt_score))
+
+    n, c, h, w = (int(d) for d in x_t.shape)
+    b = int(gtb_t.shape[1])
+    input_size = downsample_ratio * h
+    aw = jnp.asarray([anchors[2 * i] for i in range(a_num)], jnp.float32)
+    ah = jnp.asarray([anchors[2 * i + 1] for i in range(a_num)], jnp.float32)
+    # all-anchor index -> position inside anchor_mask (or -1)
+    mask_of = np.full(a_num, -1, np.int32)
+    for pos, an in enumerate(anchor_mask):
+        mask_of[an] = pos
+    mask_of = jnp.asarray(mask_of)
+
+    if use_label_smooth:
+        smooth = min(1.0 / class_num, 1.0 / 40)
+        pos_l, neg_l = 1.0 - smooth, smooth
+    else:
+        pos_l, neg_l = 1.0, 0.0
+
+    def f(xa, gtb, gtl, *rest):
+        score = rest[0] if rest else jnp.ones((n, b), xa.dtype)
+        xr = xa.reshape(n, s_num, 5 + class_num, h, w)
+        gx, gy = gtb[..., 0], gtb[..., 1]          # [N, B] normalized
+        gw, gh = gtb[..., 2], gtb[..., 3]
+        valid = (gw >= 1e-6) & (gh >= 1e-6)
+
+        # --- per-cell ignore mask: best IoU of the predicted box vs gts
+        grid_x = jnp.arange(w, dtype=xa.dtype)
+        grid_y = jnp.arange(h, dtype=xa.dtype)
+        sig = jnp.asarray(1.0, xa.dtype) / (1.0 + jnp.exp(-xr[:, :, 0]))
+        px = (grid_x[None, None, None, :]
+              + sig * scale + bias) / w            # [N, S, H, W]
+        sig_y = 1.0 / (1.0 + jnp.exp(-xr[:, :, 1]))
+        py = (grid_y[None, None, :, None] + sig_y * scale + bias) / h
+        maw = aw[jnp.asarray(anchor_mask)]
+        mah = ah[jnp.asarray(anchor_mask)]
+        pw = jnp.exp(xr[:, :, 2]) * maw[None, :, None, None] / input_size
+        ph = jnp.exp(xr[:, :, 3]) * mah[None, :, None, None] / input_size
+        iou_all = _cwh_iou(
+            px[..., None], py[..., None], pw[..., None], ph[..., None],
+            gx[:, None, None, None, :], gy[:, None, None, None, :],
+            gw[:, None, None, None, :], gh[:, None, None, None, :])
+        iou_all = jnp.where(valid[:, None, None, None, :], iou_all, 0.0)
+        best_iou = jnp.max(iou_all, axis=-1) if b else \
+            jnp.zeros_like(px)                    # [N, S, H, W]
+        obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+
+        # --- positive assignment: best anchor per gt over ALL anchors
+        an_iou = _cwh_iou(
+            jnp.zeros(()), jnp.zeros(()),
+            (aw / input_size)[None, None, :], (ah / input_size)[None, None, :],
+            jnp.zeros(()), jnp.zeros(()), gw[..., None], gh[..., None])
+        best_n = jnp.argmax(an_iou, axis=-1)       # [N, B]
+        midx = mask_of[best_n]                     # [N, B] (-1 = unmatched)
+        is_pos = valid & (midx >= 0)
+        gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+        mc = jnp.clip(midx, 0, s_num - 1)
+
+        # positives overwrite the per-cell obj mask with their mixup score.
+        # The kernel iterates gts in order (last gt wins on a shared cell):
+        # reproduce that deterministically by electing max-t per cell first,
+        # then writing the winner's score — duplicate-index .at[].set order
+        # is unspecified in JAX.
+        n_idx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, b))
+        flat_cell = ((n_idx * s_num + mc) * h + gj) * w + gi
+        ncell = n * s_num * h * w
+        flat_cell = jnp.where(is_pos, flat_cell, ncell)
+        t_idx = jnp.broadcast_to(jnp.arange(b)[None, :], (n, b))
+        winner = jnp.full((ncell + 1,), -1, jnp.int32).at[
+            flat_cell.reshape(-1)].max(t_idx.reshape(-1).astype(jnp.int32))
+        winner = winner[:-1]                        # [ncell]
+        n_of_cell = jnp.arange(ncell) // (s_num * h * w)
+        win_score = score[n_of_cell, jnp.clip(winner, 0, b - 1)]
+        obj_flat = jnp.where(winner >= 0, win_score, obj_mask.reshape(-1))
+        obj_mask = obj_flat.reshape(n, s_num, h, w)
+
+        # --- location + class loss per gt (additive over gts, like the
+        # kernel's per-gt loop)
+        pred_at = xr[n_idx, mc, :, gj, gi]         # [N, B, 5+C]
+        tx = gx * w - gi.astype(xa.dtype)
+        ty = gy * h - gj.astype(xa.dtype)
+        tw = jnp.log(jnp.where(is_pos, gw * input_size / aw[best_n], 1.0))
+        th = jnp.log(jnp.where(is_pos, gh * input_size / ah[best_n], 1.0))
+        loc_scale = (2.0 - gw * gh) * score
+        loc = (_sce(pred_at[..., 0], tx) + _sce(pred_at[..., 1], ty)
+               + jnp.abs(pred_at[..., 2] - tw)
+               + jnp.abs(pred_at[..., 3] - th)) * loc_scale
+        cls_target = jnp.where(
+            jnp.arange(class_num)[None, None, :] == gtl[..., None], pos_l,
+            neg_l).astype(xa.dtype)
+        cls = jnp.sum(_sce(pred_at[..., 5:], cls_target), -1) * score
+        per_gt = jnp.where(is_pos, loc + cls, 0.0)
+
+        # --- objectness loss over every cell
+        pobj = xr[:, :, 4]
+        obj_loss = jnp.where(
+            obj_mask > 1e-5, _sce(pobj, 1.0) * obj_mask,
+            jnp.where(obj_mask > -0.5, _sce(pobj, 0.0), 0.0))
+        return per_gt.sum(-1) + obj_loss.sum((1, 2, 3))
+
+    return apply(f, *args, name="yolo_loss")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """YOLOv3 box decode (reference vision/ops.py:259 over
+    phi/kernels/cpu/yolo_box_kernel.cc + funcs/yolo_box_util.h).
+    Returns (boxes [N, A*H*W, 4], scores [N, A*H*W, class_num])."""
+    anchors = [int(a) for a in anchors]
+    a_num = len(anchors) // 2
+    class_num = int(class_num)
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+    x_t, img_t = as_tensor(x), as_tensor(img_size)
+    n, c, h, w = (int(d) for d in x_t.shape)
+    in_h, in_w = downsample_ratio * h, downsample_ratio * w
+    aw = jnp.asarray([anchors[2 * i] for i in range(a_num)], jnp.float32)
+    ah = jnp.asarray([anchors[2 * i + 1] for i in range(a_num)], jnp.float32)
+
+    def f(xa, img):
+        if iou_aware:
+            iou_pred = xa[:, :a_num].reshape(n, a_num, h, w)
+            body = xa[:, a_num:].reshape(n, a_num, 5 + class_num, h, w)
+        else:
+            iou_pred = None
+            body = xa.reshape(n, a_num, 5 + class_num, h, w)
+        img_h = img[:, 0].astype(xa.dtype)[:, None, None, None]
+        img_w = img[:, 1].astype(xa.dtype)[:, None, None, None]
+        sig = lambda v: 1.0 / (1.0 + jnp.exp(-v))  # noqa: E731
+        cx = (jnp.arange(w, dtype=xa.dtype)[None, None, None, :]
+              + sig(body[:, :, 0]) * scale + bias) * img_w / w
+        cy = (jnp.arange(h, dtype=xa.dtype)[None, None, :, None]
+              + sig(body[:, :, 1]) * scale + bias) * img_h / h
+        bw = jnp.exp(body[:, :, 2]) * aw[None, :, None, None] * img_w / in_w
+        bh = jnp.exp(body[:, :, 3]) * ah[None, :, None, None] * img_h / in_h
+        conf = sig(body[:, :, 4])
+        if iou_pred is not None:
+            iou = sig(iou_pred)
+            conf = conf ** (1.0 - iou_aware_factor) * iou ** iou_aware_factor
+        keep = conf >= conf_thresh
+
+        x1, y1 = cx - bw / 2, cy - bh / 2
+        x2, y2 = cx + bw / 2, cy + bh / 2
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0)
+            y1 = jnp.clip(y1, 0.0)
+            x2 = jnp.minimum(x2, img_w - 1)
+            y2 = jnp.minimum(y2, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1) * keep[..., None]
+        scores = sig(body[:, :, 5:]) * conf[:, :, None]
+        scores = scores * keep[:, :, None]
+        boxes = boxes.reshape(n, a_num * h * w, 4)
+        scores = jnp.moveaxis(scores, 2, -1).reshape(
+            n, a_num * h * w, class_num)
+        return boxes, scores
+
+    return apply_multi(f, x_t, img_t, name="yolo_box")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference vision/ops.py:420 over
+    phi/kernels/cpu/prior_box_kernel.cc). Returns (boxes, variances), each
+    [H, W, num_priors, 4]; the grid is static so this builds both as
+    constants the compiler folds."""
+    def listify(v):
+        return [float(x) for x in (v if isinstance(v, (list, tuple)) else [v])]
+
+    min_sizes = listify(min_sizes)
+    aspect_ratios = listify(aspect_ratios)
+    steps = listify(steps)
+    if len(steps) != 2:
+        raise ValueError("steps should be (step_w, step_h)")
+    max_sizes = listify(max_sizes) if max_sizes else []
+    if max_sizes and not (len(max_sizes) and max_sizes[0] > 0):
+        max_sizes = []
+
+    # ExpandAspectRatios: dedup, always lead with 1.0, optional flip
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - e) >= 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    in_t, img_t = as_tensor(input), as_tensor(image)
+    fh, fw = int(in_t.shape[2]), int(in_t.shape[3])
+    ih, iw = int(img_t.shape[2]), int(img_t.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    boxes = np.zeros((fh, fw, 0, 4), np.float32)
+    cx = (np.arange(fw) + offset) * step_w          # [fw]
+    cy = (np.arange(fh) + offset) * step_h          # [fh]
+    cxg, cyg = np.meshgrid(cx, cy)                  # [fh, fw]
+
+    def emit(bw, bh):
+        bx = np.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                       (cxg + bw) / iw, (cyg + bh) / ih], -1)
+        return bx[:, :, None, :]
+
+    per_pos = []
+    for s, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            per_pos.append(emit(ms / 2.0, ms / 2.0))
+            if max_sizes:
+                mx = np.sqrt(ms * max_sizes[s]) / 2.0
+                per_pos.append(emit(mx, mx))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                per_pos.append(emit(ms * np.sqrt(ar) / 2, ms / np.sqrt(ar) / 2))
+        else:
+            for ar in ars:
+                per_pos.append(emit(ms * np.sqrt(ar) / 2, ms / np.sqrt(ar) / 2))
+            if max_sizes:
+                mx = np.sqrt(ms * max_sizes[s]) / 2.0
+                per_pos.append(emit(mx, mx))
+    boxes = np.concatenate(per_pos, 2).astype(np.float32)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    num_priors = boxes.shape[2]
+    vars_ = np.broadcast_to(
+        np.asarray(variance, np.float32), (fh, fw, num_priors, 4)).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(vars_))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (reference vision/ops.py:566 over
+    phi/kernels/cpu/box_coder_kernel.cc)."""
+    if code_type not in ("encode_center_size", "decode_center_size"):
+        raise ValueError(
+            "code_type must be encode_center_size or decode_center_size, "
+            f"got {code_type}")
+    pb = as_tensor(prior_box)
+    tb = as_tensor(target_box)
+    norm_off = 0.0 if box_normalized else 1.0
+    var_t = None
+    var_const = None
+    if prior_box_var is None:
+        pass
+    elif isinstance(prior_box_var, (list, tuple)):
+        if len(prior_box_var) != 4:
+            raise ValueError("prior_box_var list must have 4 elements")
+        var_const = np.asarray(prior_box_var, np.float32)
+    else:
+        var_t = as_tensor(prior_box_var)
+
+    def _prior_cwh(p):
+        w = p[:, 2] - p[:, 0] + norm_off
+        h = p[:, 3] - p[:, 1] + norm_off
+        return p[:, 0] + w / 2, p[:, 1] + h / 2, w, h
+
+    if code_type == "encode_center_size":
+        def f(p, t, *rest):
+            pcx, pcy, pw, ph = _prior_cwh(p)       # [col]
+            tcx = (t[:, 2] + t[:, 0]) / 2          # [row]
+            tcy = (t[:, 3] + t[:, 1]) / 2
+            tw = t[:, 2] - t[:, 0] + norm_off
+            th = t[:, 3] - t[:, 1] + norm_off
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+            out = jnp.stack([ox, oy, ow, oh], -1)  # [row, col, 4]
+            if rest:
+                out = out / rest[0][None, :, :]
+            elif var_const is not None:
+                out = out / jnp.asarray(var_const)
+            return out
+
+        args = (pb, tb) + ((var_t,) if var_t is not None else ())
+        return apply(f, *args, name="box_coder")
+
+    def f(p, t, *rest):
+        pcx, pcy, pw, ph = _prior_cwh(p)
+        # axis=0: priors broadcast over rows; axis=1: over cols
+        ex = (lambda v: v[None, :]) if axis == 0 else (lambda v: v[:, None])
+        if rest:
+            var = ex(rest[0]) if axis == 0 else rest[0][:, None, :]
+            vx, vy, vw, vh = (var[..., k] for k in range(4))
+        elif var_const is not None:
+            vx, vy, vw, vh = (float(var_const[k]) for k in range(4))
+        else:
+            vx = vy = vw = vh = 1.0
+        tcx = vx * t[..., 0] * ex(pw) + ex(pcx)
+        tcy = vy * t[..., 1] * ex(ph) + ex(pcy)
+        tw = jnp.exp(vw * t[..., 2]) * ex(pw)
+        th = jnp.exp(vh * t[..., 3]) * ex(ph)
+        return jnp.stack([tcx - tw / 2, tcy - th / 2,
+                          tcx + tw / 2 - norm_off,
+                          tcy + th / 2 - norm_off], -1)
+
+    args = (pb, tb) + ((var_t,) if var_t is not None else ())
+    return apply(f, *args, name="box_coder")
+
+
+# --- host-side dynamic-output post-processing ------------------------------
+
+
+def _np_iou(a, b, normalized):
+    """Pairwise IoU of corner boxes (JaccardOverlap semantics: +1 extent
+    for unnormalized pixel boxes, invalid boxes have zero area)."""
+    off = 0.0 if normalized else 1.0
+
+    def area(bx):
+        w = bx[:, 2] - bx[:, 0] + off
+        h = bx[:, 3] - bx[:, 1] + off
+        bad = (bx[:, 2] < bx[:, 0]) | (bx[:, 3] < bx[:, 1])
+        return np.where(bad, 0.0, w * h)
+
+    ix = np.minimum(a[:, None, 2], b[None, :, 2]) - \
+        np.maximum(a[:, None, 0], b[None, :, 0]) + off
+    iy = np.minimum(a[:, None, 3], b[None, :, 3]) - \
+        np.maximum(a[:, None, 1], b[None, :, 1]) + off
+    inter = np.clip(ix, 0, None) * np.clip(iy, 0, None)
+    sep = (b[None, :, 0] > a[:, None, 2]) | (b[None, :, 2] < a[:, None, 0]) \
+        | (b[None, :, 1] > a[:, None, 3]) | (b[None, :, 3] < a[:, None, 1])
+    inter = np.where(sep, 0.0, inter)
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    return inter / np.maximum(union, 1e-10)
+
+
+def _matrix_nms_single(boxes, scores, score_threshold, post_threshold,
+                       nms_top_k, use_gaussian, sigma, normalized):
+    """One class, one image (NMSMatrix): decayed scores + kept indices."""
+    idx = np.where(scores > score_threshold)[0]
+    if idx.size == 0:
+        return np.empty(0, np.int64), np.empty(0, np.float64)
+    order = idx[np.argsort(-scores[idx], kind="stable")]
+    if nms_top_k > -1 and order.size > nms_top_k:
+        order = order[:nms_top_k]
+    sel = boxes[order]
+    iou = _np_iou(sel, sel, normalized)
+    m = order.size
+    tri = np.tril(np.ones((m, m), bool), -1)       # j < i
+    # iou_max[j] = max_{k<j} iou[j,k] (NMSMatrix's running per-row max)
+    iou_max = np.zeros(m)
+    if m > 1:
+        iou_max[1:] = np.max(np.where(tri, iou, 0.0), axis=1)[1:]
+    if use_gaussian:
+        decay = np.exp((iou_max[None, :] ** 2 - iou ** 2) * sigma)
+    else:
+        decay = (1.0 - iou) / (1.0 - iou_max[None, :])
+    decay = np.where(tri, decay, 1.0)
+    min_decay = np.min(decay, axis=1)
+    ds = min_decay * scores[order]
+    keep = ds > post_threshold
+    return order[keep], ds[keep]
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py:2205 over
+    phi/kernels/cpu/matrix_nms_kernel.cc). Host-side: output count is
+    data-dependent. Returns (out [No, 6], rois_num, index) following the
+    reference's (Out, RoisNum, Index) ordering."""
+    bb = np.asarray(as_tensor(bboxes).numpy(), np.float64)
+    sc = np.asarray(as_tensor(scores).numpy(), np.float64)
+    batch, cls_num, nbox = sc.shape
+    outs, idxs, per_batch = [], [], []
+    for i in range(batch):
+        all_idx, all_sc, all_cls = [], [], []
+        for c in range(cls_num):
+            if c == background_label:
+                continue
+            ki, ks = _matrix_nms_single(
+                bb[i], sc[i, c], score_threshold, post_threshold, nms_top_k,
+                use_gaussian, gaussian_sigma, normalized)
+            all_idx.append(ki)
+            all_sc.append(ks)
+            all_cls.append(np.full(ki.shape, c, np.float64))
+        all_idx = np.concatenate(all_idx) if all_idx else np.empty(0, np.int64)
+        all_sc = np.concatenate(all_sc) if all_sc else np.empty(0)
+        all_cls = np.concatenate(all_cls) if all_cls else np.empty(0)
+        num = all_idx.size
+        if keep_top_k > -1:
+            num = min(num, keep_top_k)
+        order = np.argsort(-all_sc, kind="stable")[:num]
+        det = np.stack([all_cls[order], all_sc[order]], -1)
+        det = np.concatenate([det, bb[i][all_idx[order]]], -1) if num else \
+            np.zeros((0, 2 + bb.shape[-1]))
+        outs.append(det)
+        idxs.append(i * nbox + all_idx[order])
+        per_batch.append(num)
+    out = np.concatenate(outs, 0).astype(np.float32) if outs else \
+        np.zeros((0, 6), np.float32)
+    index = np.concatenate(idxs, 0).astype(np.int32).reshape(-1, 1)
+    rois_num = np.asarray(per_batch, np.int32)
+    out_t = Tensor(jnp.asarray(out))
+    idx_t = Tensor(jnp.asarray(index)) if return_index else None
+    num_t = Tensor(jnp.asarray(rois_num)) if return_rois_num else None
+    return out_t, num_t, idx_t
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference vision/ops.py:2028 over
+    phi/kernels/cpu/generate_proposals_kernel.cc). Host-side (dynamic
+    output). Returns (rois [M,4], roi_probs [M,1][, rois_num])."""
+    sc = np.asarray(as_tensor(scores).numpy(), np.float64)    # [N, A, H, W]
+    bd = np.asarray(as_tensor(bbox_deltas).numpy(), np.float64)  # [N,4A,H,W]
+    im = np.asarray(as_tensor(img_size).numpy(), np.float64)  # [N, 2] (h, w)
+    an = np.asarray(as_tensor(anchors).numpy(), np.float64).reshape(-1, 4)
+    va = np.asarray(as_tensor(variances).numpy(), np.float64).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_probs, nums = [], [], []
+    for i in range(n):
+        # layout: anchors are [H, W, A, 4]; flatten scores/deltas to match
+        s_i = sc[i].transpose(1, 2, 0).reshape(-1)            # [H*W*A]
+        d_i = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s_i, kind="stable")
+        if pre_nms_top_n > 0 and order.size > pre_nms_top_n:
+            order = order[:pre_nms_top_n]
+        anc, var, dlt = an[order], va[order], d_i[order]
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah_ = anc[:, 3] - anc[:, 1] + off
+        acx, acy = anc[:, 0] + aw / 2, anc[:, 1] + ah_ / 2
+        cx = var[:, 0] * dlt[:, 0] * aw + acx
+        cy = var[:, 1] * dlt[:, 1] * ah_ + acy
+        bw = np.exp(np.minimum(var[:, 2] * dlt[:, 2], 15.0)) * aw
+        bh = np.exp(np.minimum(var[:, 3] * dlt[:, 3], 15.0)) * ah_
+        props = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], -1)
+        ih, iw = im[i, 0], im[i, 1]
+        props[:, 0] = np.clip(props[:, 0], 0, iw - off)
+        props[:, 1] = np.clip(props[:, 1], 0, ih - off)
+        props[:, 2] = np.clip(props[:, 2], 0, iw - off)
+        props[:, 3] = np.clip(props[:, 3], 0, ih - off)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        ms = max(min_size, 1.0)
+        if pixel_offset:
+            cx_in = (props[:, 0] + props[:, 2]) / 2
+            cy_in = (props[:, 1] + props[:, 3]) / 2
+            keep = (ws >= ms) & (hs >= ms) & (cx_in < iw) & (cy_in < ih)
+        else:
+            keep = (ws >= ms) & (hs >= ms)
+        props, probs = props[keep], s_i[order][keep]
+        if len(props) == 0:
+            # reference ProposalForOneImage: an image with nothing left
+            # emits one all-zero proposal so rois_num is never 0
+            props = np.zeros((1, 4))
+            probs = np.zeros((1,))
+        elif nms_thresh > 0:
+            # greedy NMS (adaptive eta); nms_thresh <= 0 skips NMS AND the
+            # post_nms cap, like the kernel's early return
+            sel = []
+            thresh = nms_thresh
+            cand = list(range(len(props)))
+            iou = _np_iou(props, props, not pixel_offset)
+            while cand:
+                cur = cand[0]
+                sel.append(cur)
+                if post_nms_top_n > 0 and len(sel) >= post_nms_top_n:
+                    break
+                cand = [j for j in cand[1:] if iou[cur, j] <= thresh]
+                if eta < 1.0 and thresh > 0.5:
+                    thresh *= eta
+            props, probs = props[sel], probs[sel]
+        all_rois.append(props)
+        all_probs.append(probs)
+        nums.append(len(props))
+    rois = np.concatenate(all_rois, 0).astype(np.float32) if all_rois else \
+        np.zeros((0, 4), np.float32)
+    probs = np.concatenate(all_probs, 0).astype(np.float32).reshape(-1, 1)
+    rois_t = Tensor(jnp.asarray(rois))
+    probs_t = Tensor(jnp.asarray(probs))
+    if return_rois_num:
+        return rois_t, probs_t, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois_t, probs_t
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Distribute RoIs across FPN levels (reference vision/ops.py:1149 over
+    phi/kernels/cpu/distribute_fpn_proposals_kernel.cc). Host-side."""
+    assert max_level > min_level > 0
+    rois = np.asarray(as_tensor(fpn_rois).numpy(), np.float64)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(ws * hs, 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    num_lvl = max_level - min_level + 1
+    multi_rois, restore_parts, lvl_nums = [], [], []
+    bn = None
+    if rois_num is not None:
+        bn = np.asarray(as_tensor(rois_num).numpy(), np.int64)
+        img_of = np.repeat(np.arange(len(bn)), bn)
+    for k in range(num_lvl):
+        pick = np.where(lvl == min_level + k)[0]
+        multi_rois.append(Tensor(jnp.asarray(
+            rois[pick].astype(np.float32))))
+        restore_parts.append(pick)
+        if bn is not None:
+            lvl_nums.append(Tensor(jnp.asarray(np.bincount(
+                img_of[pick], minlength=len(bn)).astype(np.int32))))
+    order = np.concatenate(restore_parts) if restore_parts else \
+        np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(order.size)
+    restore_t = Tensor(jnp.asarray(restore.astype(np.int32).reshape(-1, 1)))
+    if rois_num is not None:
+        return multi_rois, restore_t, lvl_nums
+    return multi_rois, restore_t
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pool (reference vision/ops.py:1385
+    over phi/kernels/cpu/psroi_pool_kernel.cc). Vectorized as masked
+    reductions over the full feature map — static shapes, differentiable."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = (int(v) for v in output_size)
+    if ph * pw == 0:
+        raise ValueError("output_size should not contain 0.")
+    x_t, boxes_t = as_tensor(x), as_tensor(boxes)
+    n, c, hgt, wid = (int(d) for d in x_t.shape)
+    if c % (ph * pw):
+        raise ValueError(
+            f"input channels ({c}) must be divisible by output_size "
+            f"({ph}x{pw})")
+    oc = c // (ph * pw)
+    bn = np.asarray(as_tensor(boxes_num).numpy(), np.int64)
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(bn)), bn))
+
+    def f(xa, ba):
+        r = ba.shape[0]
+        x1 = jnp.round(ba[:, 0]) * spatial_scale
+        y1 = jnp.round(ba[:, 1]) * spatial_scale
+        x2 = (jnp.round(ba[:, 2]) + 1.0) * spatial_scale
+        y2 = (jnp.round(ba[:, 3]) + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh_sz = rh / ph
+        bw_sz = rw / pw
+
+        def bin_mask(start, size, total, bins):
+            lo = jnp.floor(start[:, None] + jnp.arange(bins)[None, :]
+                           * size[:, None])
+            hi = jnp.ceil(start[:, None] + (jnp.arange(bins)[None, :] + 1)
+                          * size[:, None])
+            lo = jnp.clip(lo, 0, total)
+            hi = jnp.clip(hi, 0, total)
+            pos = jnp.arange(total)[None, None, :]
+            m = (pos >= lo[..., None]) & (pos < hi[..., None])
+            return m.astype(xa.dtype), jnp.maximum(hi - lo, 0.0)
+
+        mh, ch_ = bin_mask(y1, bh_sz, hgt, ph)     # [R, ph, H], [R, ph]
+        mw, cw_ = bin_mask(x1, bw_sz, wid, pw)     # [R, pw, W], [R, pw]
+        # per-roi feature slab, channels regrouped [oc, ph, pw]
+        feats = xa[img_of_roi].reshape(r, oc, ph, pw, hgt, wid)
+        # out[r, o, i, j] = sum_hw feats[r, o, i, j] * mh[r,i,h] * mw[r,j,w]
+        s = jnp.einsum("roijhw,rih,rjw->roij", feats, mh, mw)
+        area = ch_[:, :, None] * cw_[:, None, :]
+        out = jnp.where(area[:, None] > 0, s / jnp.maximum(area[:, None], 1.0),
+                        0.0)
+        return out
+
+    return apply(f, x_t, boxes_t, name="psroi_pool")
+
+
+def read_file(filename, name=None):
+    """Read raw file bytes into a 1-D uint8 tensor (reference
+    vision/ops.py:1294)."""
+    with open(filename, "rb") as fh:
+        data = np.frombuffer(fh.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference vision/ops.py:1336
+    over CPU decode; TPU path decodes on host via PIL)."""
+    import io
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg requires Pillow") from e
+    raw = bytes(np.asarray(as_tensor(x).numpy(), np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode in ("gray", "grey", "L"):
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                            # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)               # [C, H, W]
+    return Tensor(jnp.asarray(arr))
+
+
+# --- layer classes ---------------------------------------------------------
+
+from ..nn.layer import Layer  # noqa: E402  (nn does not import vision)
+from ..nn.initializer import Normal  # noqa: E402
+
+
+class DeformConv2D(Layer):
+    """Reference vision/ops.py:953."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if weight_attr is False:
+            raise ValueError("weight_attr should not be False in Conv.")
+        to2 = lambda v: [v, v] if isinstance(v, int) else list(v)  # noqa: E731
+        self._stride = to2(stride)
+        self._padding = to2(padding)
+        self._dilation = to2(dilation)
+        self._kernel_size = to2(kernel_size)
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        if in_channels % groups:
+            raise ValueError("in_channels must be divisible by groups.")
+        filter_shape = [out_channels, in_channels // groups] \
+            + self._kernel_size
+        std = (2.0 / (np.prod(self._kernel_size) * in_channels)) ** 0.5
+        self.weight = self.create_parameter(
+            shape=filter_shape, attr=weight_attr,
+            default_initializer=Normal(0.0, std))
+        self.bias = self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        from .ops import deform_conv2d
+        return deform_conv2d(
+            x, offset, self.weight, bias=self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups,
+            groups=self._groups, mask=mask)
+
+
+class RoIAlign(Layer):
+    """Reference vision/ops.py:1753."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        from .ops import roi_align
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(Layer):
+    """Reference vision/ops.py:1584."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        from .ops import roi_pool
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(Layer):
+    """Reference vision/ops.py:1460."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)

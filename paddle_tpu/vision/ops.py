@@ -8,7 +8,18 @@ import jax.numpy as jnp
 from ..autograd.function import apply
 from ..core.tensor import Tensor, as_tensor
 
-__all__ = ["nms", "box_area", "box_iou", "roi_align", "roi_pool", "deform_conv2d"]
+from .detection_ops import (  # noqa: F401
+    yolo_loss, yolo_box, prior_box, box_coder, matrix_nms,
+    generate_proposals, distribute_fpn_proposals, psroi_pool, read_file,
+    decode_jpeg, DeformConv2D, RoIAlign, RoIPool, PSRoIPool)
+
+__all__ = ["nms", "box_area", "box_iou", "roi_align", "roi_pool",
+           "deform_conv2d",
+           # detection family (reference vision/ops.py:29 __all__)
+           "yolo_loss", "yolo_box", "prior_box", "box_coder", "DeformConv2D",
+           "distribute_fpn_proposals", "generate_proposals", "read_file",
+           "decode_jpeg", "RoIPool", "psroi_pool", "PSRoIPool", "RoIAlign",
+           "matrix_nms"]
 
 
 def box_area(boxes):

@@ -1,0 +1,370 @@
+"""Detection op family tests (reference: test/legacy_test/test_yolov3_loss_op
+.py, test_yolo_box_op.py, test_prior_box_op.py, test_box_coder_op.py,
+test_matrix_nms_op.py, test_psroi_pool_op.py — same numpy-reference
+pattern, loop-based oracles written independently here)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _sce(x, label):
+    return max(x, 0.0) - x * label + np.log1p(np.exp(-abs(x)))
+
+
+def _iou_cwh(b1, b2):
+    def overlap(c1, w1, c2, w2):
+        return min(c1 + w1 / 2, c2 + w2 / 2) - max(c1 - w1 / 2, c2 - w2 / 2)
+    ow = overlap(b1[0], b1[2], b2[0], b2[2])
+    oh = overlap(b1[1], b1[3], b2[1], b2[3])
+    inter = 0.0 if (ow < 0 or oh < 0) else ow * oh
+    return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+
+def _yolo_loss_ref(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                   ignore_thresh, downsample_ratio, scale_x_y=1.0,
+                   use_label_smooth=True, gt_score=None):
+    """Loop-based oracle following phi/kernels/cpu/yolo_loss_kernel.cc."""
+    n, _, h, w = x.shape
+    s = len(anchor_mask)
+    an_num = len(anchors) // 2
+    b = gt_box.shape[1]
+    input_size = downsample_ratio * h
+    scale = scale_x_y
+    bias = -0.5 * (scale - 1.0)
+    if gt_score is None:
+        gt_score = np.ones((n, b))
+    if use_label_smooth:
+        sm = min(1.0 / class_num, 1.0 / 40)
+        pos_l, neg_l = 1.0 - sm, sm
+    else:
+        pos_l, neg_l = 1.0, 0.0
+    xr = x.reshape(n, s, 5 + class_num, h, w)
+    loss = np.zeros(n)
+    obj_mask = np.zeros((n, s, h, w))
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    for i in range(n):
+        valid = [(gt_box[i, t, 2] >= 1e-6 and gt_box[i, t, 3] >= 1e-6)
+                 for t in range(b)]
+        for j in range(s):
+            for k in range(h):
+                for l_ in range(w):
+                    px = (l_ + sig(xr[i, j, 0, k, l_]) * scale + bias) / w
+                    py = (k + sig(xr[i, j, 1, k, l_]) * scale + bias) / h
+                    pw = np.exp(xr[i, j, 2, k, l_]) * \
+                        anchors[2 * anchor_mask[j]] / input_size
+                    ph = np.exp(xr[i, j, 3, k, l_]) * \
+                        anchors[2 * anchor_mask[j] + 1] / input_size
+                    best = 0.0
+                    for t in range(b):
+                        if not valid[t]:
+                            continue
+                        best = max(best, _iou_cwh(
+                            (px, py, pw, ph), tuple(gt_box[i, t])))
+                    if best > ignore_thresh:
+                        obj_mask[i, j, k, l_] = -1
+        for t in range(b):
+            if not valid[t]:
+                continue
+            gx, gy, gw, gh = gt_box[i, t]
+            gi, gj = int(gx * w), int(gy * h)
+            best_iou, best_n = 0.0, 0
+            for an in range(an_num):
+                iou = _iou_cwh((0, 0, anchors[2 * an] / input_size,
+                                anchors[2 * an + 1] / input_size),
+                               (0, 0, gw, gh))
+                if iou > best_iou:
+                    best_iou, best_n = iou, an
+            if best_n not in anchor_mask:
+                continue
+            mi = anchor_mask.index(best_n)
+            score = gt_score[i, t]
+            tx, ty = gx * w - gi, gy * h - gj
+            tw = np.log(gw * input_size / anchors[2 * best_n])
+            th = np.log(gh * input_size / anchors[2 * best_n + 1])
+            sc = (2.0 - gw * gh) * score
+            loss[i] += _sce(xr[i, mi, 0, gj, gi], tx) * sc
+            loss[i] += _sce(xr[i, mi, 1, gj, gi], ty) * sc
+            loss[i] += abs(xr[i, mi, 2, gj, gi] - tw) * sc
+            loss[i] += abs(xr[i, mi, 3, gj, gi] - th) * sc
+            obj_mask[i, mi, gj, gi] = score
+            label = int(gt_label[i, t])
+            for c in range(class_num):
+                loss[i] += _sce(xr[i, mi, 5 + c, gj, gi],
+                                pos_l if c == label else neg_l) * score
+        for j in range(s):
+            for k in range(h):
+                for l_ in range(w):
+                    o = obj_mask[i, j, k, l_]
+                    p = xr[i, j, 4, k, l_]
+                    if o > 1e-5:
+                        loss[i] += _sce(p, 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += _sce(p, 0.0)
+    return loss
+
+
+def test_yolo_loss_matches_kernel_oracle():
+    rng = np.random.default_rng(0)
+    n, h, w, cnum = 2, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [1, 2]
+    x = rng.standard_normal((n, len(mask) * (5 + cnum), h, w)) * 0.5
+    gt = rng.random((n, 3, 4)) * 0.4 + 0.2
+    gt[:, :, 2:] *= 0.5
+    gt[0, 2, 2] = 0.0  # invalid box
+    lab = rng.integers(0, cnum, (n, 3))
+    got = V.yolo_loss(
+        paddle.to_tensor(x.astype(np.float32)),
+        paddle.to_tensor(gt.astype(np.float32)),
+        paddle.to_tensor(lab.astype(np.int32)),
+        anchors, mask, cnum, 0.7, 32).numpy()
+    want = _yolo_loss_ref(x, gt, lab, anchors, mask, cnum, 0.7, 32)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    # differentiable w.r.t. x
+    xt = paddle.to_tensor(x.astype(np.float32))
+    xt.stop_gradient = False
+    V.yolo_loss(xt, paddle.to_tensor(gt.astype(np.float32)),
+                paddle.to_tensor(lab.astype(np.int32)),
+                anchors, mask, cnum, 0.7, 32).sum().backward()
+    assert np.isfinite(xt.grad.numpy()).all()
+
+
+def test_yolo_box_decode():
+    rng = np.random.default_rng(1)
+    n, h, w, cnum = 2, 3, 3, 4
+    anchors = [10, 13, 16, 30]
+    a = len(anchors) // 2
+    x = rng.standard_normal((n, a * (5 + cnum), h, w)).astype(np.float32)
+    img = np.array([[96, 128], [64, 64]], np.int32)
+    boxes, scores = V.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img), anchors, cnum, 0.01, 32)
+    assert tuple(boxes.shape) == (n, a * h * w, 4)
+    assert tuple(scores.shape) == (n, a * h * w, cnum)
+    # oracle for one cell
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    xr = x.reshape(n, a, 5 + cnum, h, w)
+    i, j, k, l_ = 0, 1, 2, 1
+    in_h = in_w = 32 * h
+    cx = (l_ + sig(xr[i, j, 0, k, l_])) * img[i, 1] / w
+    cy = (k + sig(xr[i, j, 1, k, l_])) * img[i, 0] / h
+    bw = np.exp(xr[i, j, 2, k, l_]) * anchors[2] * img[i, 1] / in_w
+    bh = np.exp(xr[i, j, 3, k, l_]) * anchors[3] * img[i, 0] / in_h
+    conf = sig(xr[i, j, 4, k, l_])
+    idx = j * h * w + k * w + l_
+    if conf >= 0.01:
+        want = [max(cx - bw / 2, 0), max(cy - bh / 2, 0),
+                min(cx + bw / 2, img[i, 1] - 1), min(cy + bh / 2,
+                                                     img[i, 0] - 1)]
+        np.testing.assert_allclose(boxes.numpy()[i, idx], want, rtol=1e-4)
+        np.testing.assert_allclose(
+            scores.numpy()[i, idx],
+            sig(xr[i, j, 5:, k, l_]) * conf, rtol=1e-4)
+
+
+def test_prior_box():
+    x = paddle.zeros([1, 3, 6, 9])
+    img = paddle.zeros([1, 3, 9, 12])
+    box, var = V.prior_box(x, img, min_sizes=[2.0, 4.0], clip=True, flip=True)
+    # num_priors = len(ars) * len(min_sizes) = 1 * 2 (ar=[1.0] dedup)
+    assert tuple(box.shape) == (6, 9, 2, 4)
+    assert tuple(var.shape) == (6, 9, 2, 4)
+    b = box.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    # center of cell (0,0): ((0+0.5)*step_w)/iw horizontally
+    step_w, step_h = 12 / 9, 9 / 6
+    cx, cy = 0.5 * step_w, 0.5 * step_h
+    np.testing.assert_allclose(
+        b[0, 0, 0], np.clip([(cx - 1) / 12, (cy - 1) / 9, (cx + 1) / 12,
+                             (cy + 1) / 9], 0, 1), atol=1e-6)
+    # max_sizes add one sqrt(min*max) prior each
+    box2, _ = V.prior_box(x, img, min_sizes=[2.0], max_sizes=[4.0],
+                          aspect_ratios=[2.0], flip=True)
+    assert box2.shape[2] == 4  # ar 1 + 2 + 1/2, + 1 max prior
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.default_rng(2)
+    priors = rng.random((5, 4)).astype(np.float32)
+    priors[:, 2:] += priors[:, :2] + 0.1
+    targets = rng.random((7, 4)).astype(np.float32)
+    targets[:, 2:] += targets[:, :2] + 0.1
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = V.box_coder(paddle.to_tensor(priors), var,
+                      paddle.to_tensor(targets), "encode_center_size")
+    assert tuple(enc.shape) == (7, 5, 4)
+    dec = V.box_coder(paddle.to_tensor(priors), var, enc,
+                      "decode_center_size")
+    # decoding the encoding against the same priors recovers the targets
+    np.testing.assert_allclose(
+        dec.numpy()[np.arange(7) % 7, :],
+        np.broadcast_to(targets[:, None, :], (7, 5, 4)), atol=1e-4)
+    # tensor-variance path and axis=1
+    vt = paddle.to_tensor(np.broadcast_to(
+        np.asarray(var, np.float32), (5, 4)).copy())
+    enc2 = V.box_coder(paddle.to_tensor(priors), vt,
+                       paddle.to_tensor(targets), "encode_center_size")
+    np.testing.assert_allclose(enc2.numpy(), enc.numpy(), atol=1e-5)
+
+
+def test_matrix_nms():
+    # two heavily-overlapping boxes + one distant; the overlap decays
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]       # class 1 (class 0 = background)
+    out, rois_num, index = V.matrix_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, post_threshold=0.0, nms_top_k=-1, keep_top_k=-1,
+        return_index=True)
+    o = out.numpy()
+    assert o.shape[1] == 6
+    assert rois_num.numpy().tolist() == [o.shape[0]]
+    assert o[0, 1] == pytest.approx(0.9)  # top score undecayed
+    # the overlapping runner-up decays below its raw 0.8
+    decayed = o[o[:, 1] < 0.9][:, 1]
+    assert (decayed < 0.8 - 1e-6).any()
+    assert index.numpy().shape == (o.shape[0], 1)
+
+
+def test_generate_proposals_and_fpn_distribute():
+    rng = np.random.default_rng(3)
+    n, a, h, w = 1, 2, 4, 4
+    scores = rng.random((n, a, h, w)).astype(np.float32)
+    deltas = (rng.standard_normal((n, 4 * a, h, w)) * 0.1).astype(np.float32)
+    anchors = np.zeros((h, w, a, 4), np.float32)
+    for i in range(h):
+        for j in range(w):
+            anchors[i, j, 0] = [j * 16, i * 16, j * 16 + 24, i * 16 + 24]
+            anchors[i, j, 1] = [j * 16, i * 16, j * 16 + 48, i * 16 + 48]
+    var = np.full((h, w, a, 4), 1.0, np.float32)
+    img = np.array([[64.0, 64.0]], np.float32)
+    rois, probs, num = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(img), paddle.to_tensor(anchors),
+        paddle.to_tensor(var), pre_nms_top_n=20, post_nms_top_n=10,
+        nms_thresh=0.7, min_size=2.0, return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[1] == 4 and r.shape[0] == int(num.numpy()[0])
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 64).all()
+    p = probs.numpy().ravel()
+    assert (np.diff(p) <= 1e-6).all()     # sorted by score desc
+    ws = r[:, 2] - r[:, 0]
+    hs = r[:, 3] - r[:, 1]
+    assert (ws >= 2.0).all() and (hs >= 2.0).all()
+
+    # distribute: tiny boxes -> low level, huge -> high level
+    fpn_rois = paddle.to_tensor(np.array(
+        [[0, 0, 20, 20], [0, 0, 600, 600], [0, 0, 220, 220]], np.float32))
+    rois_num_t = paddle.to_tensor(np.array([3], np.int32))
+    multi, restore, per_lvl = V.distribute_fpn_proposals(
+        fpn_rois, 2, 5, 4, 224, rois_num=rois_num_t)
+    assert len(multi) == 4 and len(per_lvl) == 4
+    sizes = [int(m.shape[0]) for m in multi]
+    # kernel formula floor(log2(scale/refer)+refer_level): 20->lvl2 (clipped),
+    # 220->lvl3 (log2(220/224)<0), 600->lvl5
+    assert sizes == [1, 1, 0, 1]
+    order = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+    rest = restore.numpy().ravel()
+    np.testing.assert_allclose(
+        order[rest], fpn_rois.numpy(), atol=1e-6)
+
+
+def test_psroi_pool_matches_oracle():
+    rng = np.random.default_rng(4)
+    ph = pw = 2
+    oc = 3
+    x = rng.standard_normal((2, oc * ph * pw, 8, 8)).astype(np.float32)
+    boxes = np.array([[0, 0, 4, 4], [2, 2, 7, 7], [1, 0, 5, 6]], np.float32)
+    bn = np.array([2, 1], np.int32)
+    out = V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                       paddle.to_tensor(bn), 2, 1.0)
+    assert tuple(out.shape) == (3, oc, ph, pw)
+    # oracle: loop over bins (kernel semantics)
+    img_of = [0, 0, 1]
+    for r in range(3):
+        x1, y1 = round(boxes[r, 0]), round(boxes[r, 1])
+        x2, y2 = round(boxes[r, 2]) + 1, round(boxes[r, 3]) + 1
+        rh, rw = max(y2 - y1, 0.1), max(x2 - x1, 0.1)
+        for c in range(oc):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = int(np.floor(i * rh / ph + y1))
+                    he = int(np.ceil((i + 1) * rh / ph + y1))
+                    ws = int(np.floor(j * rw / pw + x1))
+                    we = int(np.ceil((j + 1) * rw / pw + x1))
+                    hs, he = max(hs, 0), min(he, 8)
+                    ws, we = max(ws, 0), min(we, 8)
+                    chan = (c * ph + i) * pw + j
+                    if he <= hs or we <= ws:
+                        want = 0.0
+                    else:
+                        want = x[img_of[r], chan, hs:he, ws:we].mean()
+                    np.testing.assert_allclose(
+                        out.numpy()[r, c, i, j], want, rtol=1e-4, atol=1e-5,
+                        err_msg=f"roi {r} chan {c} bin {i},{j}")
+    # differentiable
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    V.psroi_pool(xt, paddle.to_tensor(boxes), paddle.to_tensor(bn),
+                 2).sum().backward()
+    assert np.isfinite(xt.grad.numpy()).all()
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+    arr = (np.random.default_rng(5).random((16, 20, 3)) * 255).astype(np.uint8)
+    p = os.path.join(tmp_path, "img.jpg")
+    Image.fromarray(arr).save(p, quality=95)
+    raw = V.read_file(p)
+    assert raw.dtype == paddle.uint8 and int(raw.shape[0]) > 100
+    img = V.decode_jpeg(raw)
+    assert tuple(img.shape) == (3, 16, 20)
+    # lossy but close
+    ref = np.asarray(Image.open(io.BytesIO(bytes(raw.numpy())))).transpose(
+        2, 0, 1)
+    np.testing.assert_array_equal(img.numpy(), ref)
+
+
+def test_detection_layer_classes():
+    paddle.seed(0)
+    dc = V.DeformConv2D(4, 6, 3, padding=1, groups=2, deformable_groups=2)
+    x = paddle.to_tensor(np.ones((1, 4, 8, 8), np.float32))
+    off = paddle.to_tensor(np.zeros((1, 2 * 2 * 9, 8, 8), np.float32))
+    assert tuple(dc(x, off).shape) == (1, 6, 8, 8)
+    assert tuple(dc.weight.shape) == (6, 2, 3, 3)
+
+    feat = paddle.to_tensor(np.ones((1, 8, 8, 8), np.float32))
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    assert tuple(V.RoIAlign(2)(feat, boxes, bn).shape) == (1, 8, 2, 2)
+    assert tuple(V.RoIPool(2)(feat, boxes, bn).shape) == (1, 8, 2, 2)
+    assert tuple(V.PSRoIPool(2)(feat, boxes, bn).shape) == (1, 2, 2, 2)
+
+
+def test_generate_proposals_edge_cases():
+    # all proposals filtered out -> one all-zero proposal (kernel fallback)
+    scores = paddle.to_tensor(np.full((1, 1, 2, 2), 0.5, np.float32))
+    deltas = paddle.to_tensor(np.zeros((1, 4, 2, 2), np.float32))
+    anchors = paddle.to_tensor(np.broadcast_to(
+        np.array([0, 0, 1, 1], np.float32), (2, 2, 1, 4)).copy())
+    var = paddle.to_tensor(np.ones((2, 2, 1, 4), np.float32))
+    img = paddle.to_tensor(np.array([[64.0, 64.0]], np.float32))
+    rois, probs, num = V.generate_proposals(
+        scores, deltas, img, anchors, var, min_size=50.0,
+        return_rois_num=True)
+    assert num.numpy().tolist() == [1]
+    np.testing.assert_allclose(rois.numpy(), [[0, 0, 0, 0]])
+    # nms_thresh <= 0 skips NMS and the post_nms cap entirely
+    anchors2 = paddle.to_tensor(np.broadcast_to(
+        np.array([0, 0, 32, 32], np.float32), (2, 2, 1, 4)).copy())
+    rois2, _, num2 = V.generate_proposals(
+        scores, deltas, img, anchors2, var, nms_thresh=0.0, min_size=1.0,
+        post_nms_top_n=1, return_rois_num=True)
+    assert int(num2.numpy()[0]) == 4  # all 4 identical boxes kept
