@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import contextlib
 from collections import Counter
+from enum import Enum
 
 import jax.numpy as jnp
 
@@ -82,3 +83,88 @@ def enable_tensor_checker(config: TensorCheckerConfig):
 def disable_tensor_checker():
     from ..core.flags import set_flags
     set_flags({"check_nan_inf": False})
+
+
+class DebugMode(Enum):
+    """TensorCheckerConfig modes (reference amp/debugging.py:42)."""
+
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+    CHECK_ALL_AND_ABORT = 4
+    DUMP_ALL = 5
+
+
+def check_layer_numerics(func):
+    """Decorator checking a layer forward's tensor inputs AND output for
+    NaN/Inf (reference amp/debugging.py:64)."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                check_numerics(a, op_type=type(self).__name__,
+                               var_name=f"input_{i}")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for i, o in enumerate(outs):
+            if isinstance(o, Tensor):
+                check_numerics(o, op_type=type(self).__name__,
+                               var_name=f"output_{i}")
+        return out
+
+    return wrapper
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Compare two precision-debug dump directories (reference
+    amp/debugging.py:574): pairs same-named tensor dumps (e.g. an fp16 run
+    vs an fp32 run), writes a csv of max-abs/mean-abs deltas, and returns
+    the rows."""
+    import csv
+    import os
+
+    import numpy as np
+
+    def load_dir(path):
+        out = {}
+        for fn in sorted(os.listdir(path)):
+            full = os.path.join(path, fn)
+            if fn.endswith(".npy"):
+                out[fn[:-4]] = np.load(full)
+            elif fn.endswith((".log", ".txt")):
+                # reference-style textual dumps: one "name value..." per line
+                with open(full) as f:
+                    for line in f:
+                        parts = line.split()
+                        if len(parts) >= 2:
+                            try:
+                                out[parts[0]] = np.asarray(
+                                    [float(v) for v in parts[1:]])
+                            except ValueError:
+                                continue
+        return out
+
+    a = load_dir(dump_path)
+    b = load_dir(another_dump_path)
+    rows = []
+    for name in sorted(set(a) & set(b)):
+        x = np.asarray(a[name], np.float64) * loss_scale
+        y = np.asarray(b[name], np.float64)
+        if x.shape != y.shape:
+            rows.append((name, "shape_mismatch", x.shape, y.shape))
+            continue
+        diff = np.abs(x - y)
+        rows.append((name, "ok", float(diff.max(initial=0.0)),
+                     float(diff.mean() if diff.size else 0.0)))
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["tensor", "status", "max_abs_diff", "mean_abs_diff"])
+        w.writerows(rows)
+    return rows
+
+
+__all__ += ["DebugMode", "check_layer_numerics", "compare_accuracy"]

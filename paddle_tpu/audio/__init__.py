@@ -8,8 +8,34 @@ are subsumed by XLA fusion of the framing matmuls.
 """
 
 from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from .features import (MFCC, LogMelSpectrogram, MelSpectrogram,  # noqa: F401
                        Spectrogram)
 
-__all__ = ["functional", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+
+def info(filepath):
+    """Audio file info via the current backend (reference
+    audio/backends/backend.py info)."""
+    return backends._dispatch("info")(filepath)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load audio via the current backend (reference backend.py load)."""
+    return backends._dispatch("load")(filepath, frame_offset, num_frames,
+                                      normalize, channels_first)
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding=None,
+         bits_per_sample=16):
+    """Save audio via the current backend (reference backend.py save)."""
+    return backends._dispatch("save")(filepath, src, sample_rate,
+                                      channels_first, encoding,
+                                      bits_per_sample)
+
+
+__all__ = ["functional", "features", "datasets", "backends", "load", "info",
+           "save", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
+           "MFCC"]

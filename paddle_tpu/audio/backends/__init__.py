@@ -1,0 +1,74 @@
+"""Audio IO backends (reference: python/paddle/audio/backends/ — the
+stdlib wave backend is always available; external backends register by
+name)."""
+
+from __future__ import annotations
+
+from . import wave_backend  # noqa: F401
+from .wave_backend import AudioInfo, info, load, save  # noqa: F401
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend"]
+
+_backend = "wave_backend"
+_EXTERNAL = {}
+
+
+def list_available_backends():
+    """Backend names usable with set_backend (reference
+    init_backend.py:37)."""
+    names = ["wave_backend"]
+    try:  # the reference lists soundfile when paddleaudio is installed
+        import soundfile  # noqa: F401
+        names.append("soundfile")
+    except ImportError:
+        pass
+    return names + sorted(_EXTERNAL)
+
+
+def get_current_backend():
+    """Reference init_backend.py:95."""
+    return _backend
+
+
+def set_backend(backend_name):
+    """Reference init_backend.py:139."""
+    global _backend
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name} not in {list_available_backends()}")
+    _backend = backend_name
+
+
+def _dispatch(fn_name):
+    if _backend == "wave_backend":
+        return getattr(wave_backend, fn_name)
+    if _backend == "soundfile":
+        import soundfile
+
+        def sf_load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+                    channels_first=True):
+            from ...core.tensor import Tensor
+            import jax.numpy as jnp
+            import numpy as np
+            data, sr = soundfile.read(
+                filepath, start=frame_offset,
+                frames=num_frames if num_frames >= 0 else -1,
+                dtype="float32" if normalize else "int16", always_2d=True)
+            arr = data.T if channels_first else data
+            return Tensor(jnp.asarray(np.asarray(arr))), sr
+
+        def sf_save(filepath, src, sample_rate, channels_first=True,
+                    encoding=None, bits_per_sample=16):
+            import numpy as np
+            arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+            if channels_first and arr.ndim == 2:
+                arr = arr.T
+            soundfile.write(filepath, arr, int(sample_rate))
+
+        def sf_info(filepath):
+            i = soundfile.info(filepath)
+            return AudioInfo(i.samplerate, i.frames, i.channels, 16,
+                             i.subtype)
+
+        return {"load": sf_load, "save": sf_save, "info": sf_info}[fn_name]
+    return _EXTERNAL[_backend][fn_name]

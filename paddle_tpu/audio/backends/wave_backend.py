@@ -1,0 +1,117 @@
+"""PCM16 WAV backend over the stdlib wave module (reference:
+python/paddle/audio/backends/wave_backend.py)."""
+
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+
+class AudioInfo:
+    """Return type of info() (reference backends/backend.py:21)."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample}, "
+                f"encoding={self.encoding})")
+
+
+def _error_message():
+    return ("only PCM16 WAV supported. For other audio containers install "
+            "an external audio backend and select it with "
+            "paddle.audio.backends.set_backend")
+
+
+def _open(filepath):
+    if hasattr(filepath, "read"):
+        return filepath, False
+    return open(filepath, "rb"), True
+
+
+def info(filepath):
+    """Signal info of a WAV file (reference wave_backend.py:37)."""
+    fobj, owns = _open(filepath)
+    try:
+        wf = wave.open(fobj)
+    except wave.Error:
+        fobj.seek(0)
+        if owns:
+            fobj.close()
+        raise NotImplementedError(_error_message())
+    try:
+        return AudioInfo(wf.getframerate(), wf.getnframes(),
+                         wf.getnchannels(), wf.getsampwidth() * 8,
+                         "PCM_S")
+    finally:
+        wf.close()
+        if owns:
+            fobj.close()
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load a WAV file (reference wave_backend.py:89). Returns
+    (Tensor, sample_rate): float32 in [-1, 1] when normalize else raw
+    int16; [channels, time] when channels_first."""
+    from ...core.tensor import Tensor
+    import jax.numpy as jnp
+
+    fobj, owns = _open(filepath)
+    try:
+        wf = wave.open(fobj)
+    except wave.Error:
+        fobj.seek(0)
+        if owns:
+            fobj.close()
+        raise NotImplementedError(_error_message())
+    try:
+        sr = wf.getframerate()
+        ch = wf.getnchannels()
+        width = wf.getsampwidth()
+        if width != 2:
+            raise NotImplementedError(_error_message())
+        if frame_offset:
+            wf.setpos(frame_offset)
+        n = num_frames if num_frames >= 0 else wf.getnframes() - frame_offset
+        raw = wf.readframes(n)
+    finally:
+        wf.close()
+        if owns:
+            fobj.close()
+    data = np.frombuffer(raw, np.int16).reshape(-1, ch)
+    if normalize:
+        data = (data.astype(np.float32) / 32768.0)
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding=None,
+         bits_per_sample=16):
+    """Save PCM16 WAV (reference wave_backend.py:114). float input in
+    [-1, 1] is quantized; int16 written raw."""
+    if bits_per_sample not in (None, 16):
+        raise NotImplementedError(_error_message())
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T                                 # [time, channels]
+    if arr.dtype != np.int16:
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as wf:
+        wf.setnchannels(arr.shape[1])
+        wf.setsampwidth(2)
+        wf.setframerate(int(sample_rate))
+        wf.writeframes(arr.tobytes())

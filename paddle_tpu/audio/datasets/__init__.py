@@ -1,0 +1,112 @@
+"""Audio classification datasets (reference: python/paddle/audio/datasets/
+— ESC50 esc50.py:26, TESS tess.py:26 over AudioClassificationDataset
+dataset.py:29). No-network build: archives must already exist locally."""
+
+from __future__ import annotations
+
+import csv
+import os
+
+from ...io import Dataset
+
+__all__ = ["ESC50", "TESS"]
+
+
+class AudioClassificationDataset(Dataset):
+    """Base: (feature, label) records from audio files (reference
+    datasets/dataset.py:29)."""
+
+    _FEATS = ("raw", "melspectrogram", "mfcc", "logmelspectrogram",
+              "spectrogram")
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        super().__init__()
+        if feat_type not in self._FEATS:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(self._FEATS)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+
+    def _feature(self, waveform, sr):
+        if self.feat_type == "raw":
+            return waveform
+        from .. import features as F
+        cls = {"melspectrogram": F.MelSpectrogram, "mfcc": F.MFCC,
+               "logmelspectrogram": F.LogMelSpectrogram,
+               "spectrogram": F.Spectrogram}[self.feat_type]
+        cfg = dict(self.feat_config)
+        if self.feat_type != "spectrogram":
+            cfg.setdefault("sr", sr)
+        return cls(**cfg)(waveform.unsqueeze(0)).squeeze(0)
+
+    def __getitem__(self, idx):
+        from .. import backends
+        waveform, sr = backends.load(self.files[idx])
+        self.sample_rate = sr
+        if len(waveform.shape) == 2:
+            waveform = waveform.squeeze(0)
+        return self._feature(waveform, sr), self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference datasets/esc50.py:26): 2000
+    5-second clips, 50 classes, 5 folds; `mode='train'` keeps folds != 1,
+    `'dev'` keeps fold 1. Pass archive={'path': <extracted dir>} holding
+    meta/esc50.csv and audio/."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw", archive=None,
+                 **kwargs):
+        if not archive or "path" not in archive:
+            raise ValueError(
+                "ESC50 needs archive={'path': <local ESC-50 dir>} (no "
+                "network download available)")
+        root = archive["path"]
+        meta = os.path.join(root, "meta", "esc50.csv")
+        files, labels = [], []
+        with open(meta, newline="") as f:
+            for row in csv.DictReader(f):
+                fold = int(row["fold"])
+                if (mode == "train") != (fold == int(split)):
+                    files.append(os.path.join(root, "audio", row["filename"]))
+                    labels.append(int(row["target"]))
+        super().__init__(files, labels, feat_type, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference datasets/tess.py:26): 2800 files,
+    7 emotion classes encoded in filenames <talker>_<word>_<emotion>.wav;
+    n_folds cross-validation split like the reference."""
+
+    _EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                 "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        if not 1 <= split <= n_folds:
+            raise ValueError(f"split must be in [1, {n_folds}]")
+        if not archive or "path" not in archive:
+            raise ValueError(
+                "TESS needs archive={'path': <local TESS dir>} (no network "
+                "download available)")
+        root = archive["path"]
+        wavs = []
+        for dirpath, _, fns in sorted(os.walk(root)):
+            for fn in sorted(fns):
+                if fn.lower().endswith(".wav"):
+                    wavs.append(os.path.join(dirpath, fn))
+        files, labels = [], []
+        for i, path in enumerate(wavs):
+            fold = i % n_folds + 1
+            if (mode == "train") != (fold == split):
+                emotion = os.path.basename(path)[:-4].split("_")[-1].lower()
+                files.append(path)
+                labels.append(self._EMOTIONS.index(emotion))
+        super().__init__(files, labels, feat_type, **kwargs)

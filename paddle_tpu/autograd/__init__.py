@@ -16,3 +16,5 @@ def __getattr__(name):
         globals()[name] = val
         return val
     raise AttributeError(f"module 'paddle_tpu.autograd' has no attribute {name!r}")
+
+from . import ir_backward  # noqa: F401,E402

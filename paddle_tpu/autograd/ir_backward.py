@@ -1,0 +1,32 @@
+"""IR-level gradient construction (reference:
+python/paddle/autograd/ir_backward.py — calc_gradient :~1000,
+calc_gradient_helper).
+
+The reference walks the PIR graph appending grad ops; here the jaxpr IS
+the IR and the autograd engine composes with tracing, so both entries
+delegate to the same machinery as static.gradients (static/compat.py:38),
+returning per-input gradients recorded into the active trace."""
+
+from __future__ import annotations
+
+__all__ = ["calc_gradient", "calc_gradient_helper"]
+
+
+def calc_gradient_helper(targets, inputs, target_gradients=None,
+                         no_grad_set=None):
+    """Reference ir_backward.py calc_gradient_helper: builds the grad map
+    {input value -> grad value} without filtering."""
+    from ..static.compat import gradients
+    tl = targets if isinstance(targets, (list, tuple)) else [targets]
+    il = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    grads = gradients(tl, il, target_gradients, no_grad_set)
+    return dict(zip(il, grads))
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference ir_backward.py calc_gradient: grads of `targets` w.r.t.
+    `inputs` (None where unreachable), appended to the current program."""
+    grad_map = calc_gradient_helper(targets, inputs, target_gradients,
+                                    no_grad_set)
+    il = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return [grad_map.get(i) for i in il]

@@ -167,6 +167,11 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self) -> np.ndarray:
+        if isinstance(self._d, jax.core.Tracer):
+            # inside a to_static probe trace, a concretization request is a
+            # graph break, not an error (jit/sot.py segment compilation)
+            from ..jit import sot
+            sot.maybe_break(self)
         return np.asarray(self._data)
 
     def item(self, *args) -> Any:

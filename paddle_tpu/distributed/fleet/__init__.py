@@ -2,22 +2,25 @@
 
 from .fleet import (  # noqa: F401
     init, distributed_model, distributed_optimizer, is_first_worker,
-    worker_index, worker_num, fleet, fleet_strategy,
+    worker_index, worker_num, fleet, fleet_strategy, Fleet,
 )
+from . import base  # noqa: F401
+from .base.role_maker import (Role, PaddleCloudRoleMaker,  # noqa: F401
+                              UserDefinedRoleMaker)
+from .base.util_factory import UtilBase  # noqa: F401
+from .data_generator import (MultiSlotDataGenerator,  # noqa: F401
+                             MultiSlotStringDataGenerator)
 from .strategy import DistributedStrategy  # noqa: F401
 from ..topology import get_hybrid_communicate_group, HybridCommunicateGroup, CommunicateTopology  # noqa: F401
-from .recompute import recompute, recompute_sequential  # noqa: F401
+from .recompute import (recompute, recompute_sequential,  # noqa: F401
+                        recompute_hybrid)
 from .. import meta_parallel  # noqa: F401
 from ..meta_parallel import (  # noqa: F401
     PipelineLayer, LayerDesc, SharedLayerDesc, HybridParallelOptimizer,
 )
 
 
-class utils:
-    from .recompute import recompute, recompute_sequential  # noqa: F401
-    from ..meta_parallel.sequence_parallel_utils import (  # noqa: F401
-        register_sequence_parallel_allreduce_hooks,
-    )
+from . import utils  # noqa: F401
 
 
 class layers:

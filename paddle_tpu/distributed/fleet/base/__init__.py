@@ -1,0 +1,9 @@
+"""`paddle.distributed.fleet.base` (reference:
+python/paddle/distributed/fleet/base/)."""
+
+from . import role_maker  # noqa: F401
+from . import topology  # noqa: F401
+from . import util_factory  # noqa: F401
+from .role_maker import (PaddleCloudRoleMaker, Role,  # noqa: F401
+                         UserDefinedRoleMaker)
+from .util_factory import UtilBase  # noqa: F401

@@ -1,0 +1,146 @@
+"""Role makers (reference: python/paddle/distributed/fleet/base/
+role_maker.py — Role :33, PaddleCloudRoleMaker :396 env-contract parsing,
+UserDefinedRoleMaker :571).
+
+The launcher (`python -m paddle_tpu.distributed.launch`) sets the same env
+contract the reference launcher does (PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, TRAINING_ROLE,
+PADDLE_PORT/POD_IP, PADDLE_PSERVERS_IP_PORT_LIST); these classes parse it.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints = []
+        self._server_endpoints = []
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _is_first_worker(self):
+        return self._is_worker() and self._current_id == 0
+
+    def _worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+    def _server_num(self):
+        return len(self._server_endpoints)
+
+    def _worker_index(self):
+        return self._current_id if self._is_worker() else 0
+
+    def _server_index(self):
+        return self._current_id if self._is_server() else 0
+
+    def _role_id(self):
+        return self._current_id
+
+    def _node_num(self):
+        ips = {ep.split(":")[0] for ep in self._worker_endpoints}
+        return max(len(ips), 1)
+
+    def _get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def _get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def to_string(self):
+        return (f"role={self._role} id={self._current_id} "
+                f"workers={self._worker_endpoints} "
+                f"servers={self._server_endpoints}")
+
+    # collective helpers ride the object-collective path when a parallel
+    # env is live; single-process they are identities
+    def _barrier(self, comm_world="worker"):
+        from ... import communication as comm
+        try:
+            comm.barrier()
+        except Exception:
+            pass
+
+    def _all_gather(self, input, comm_world="worker"):
+        from ... import communication as comm
+        try:
+            out = []
+            comm.all_gather_object(out, input)
+            return out
+        except Exception:
+            return [input]
+
+    def _all_reduce(self, input, mode="sum", comm_world="worker"):
+        vals = self._all_gather(input, comm_world)
+        if mode == "sum":
+            return sum(vals)
+        if mode == "max":
+            return max(vals)
+        if mode == "min":
+            return min(vals)
+        raise ValueError(f"unknown all_reduce mode {mode}")
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-contract role maker (reference role_maker.py:396)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._generate_role()
+
+    def _generate_role(self):
+        env = os.environ
+        self._worker_endpoints = [
+            e for e in env.get("PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+        self._server_endpoints = [
+            e for e in env.get("PADDLE_PSERVERS_IP_PORT_LIST", "").split(",")
+            if e]
+        training_role = env.get("TRAINING_ROLE", "TRAINER")
+        if training_role == "PSERVER":
+            self._role = Role.SERVER
+            cur = f"{env.get('POD_IP', '127.0.0.1')}:{env.get('PADDLE_PORT')}"
+            self._current_id = self._server_endpoints.index(cur) \
+                if cur in self._server_endpoints else 0
+        else:
+            self._role = Role.WORKER
+            self._current_id = int(env.get("PADDLE_TRAINER_ID", "0"))
+        if not self._worker_endpoints:
+            n = int(env.get("PADDLE_TRAINERS_NUM", "1"))
+            self._worker_endpoints = [f"127.0.0.1:{6170 + i}"
+                                      for i in range(n)]
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Role maker with explicitly supplied membership (reference
+    role_maker.py:1100): pass current_id, role, worker_endpoints,
+    server_endpoints."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._init_kwargs = kwargs
+        super().__init__(is_collective=is_collective, **kwargs)
+
+    def _generate_role(self):
+        kw = self._init_kwargs
+        self._role = kw.get("role", Role.WORKER)
+        self._current_id = kw.get("current_id", 0)
+        self._worker_endpoints = list(kw.get("worker_endpoints", []))
+        self._server_endpoints = list(kw.get("server_endpoints", []))

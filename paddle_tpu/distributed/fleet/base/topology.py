@@ -1,0 +1,11 @@
+"""Re-export of the topology types at the reference path (reference:
+python/paddle/distributed/fleet/base/topology.py — CommunicateTopology :61,
+HybridCommunicateGroup :174; the implementations live in
+paddle_tpu/distributed/topology.py)."""
+
+from ...topology import (CommunicateTopology,  # noqa: F401
+                         HybridCommunicateGroup,
+                         get_hybrid_communicate_group,
+                         set_hybrid_communicate_group)
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]

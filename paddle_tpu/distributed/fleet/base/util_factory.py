@@ -1,0 +1,73 @@
+"""Fleet util object (reference: python/paddle/distributed/fleet/base/
+util_factory.py UtilBase :49): cross-worker helpers + file sharding."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self):
+        self.role_maker = None
+        self.dist_strategy = None
+
+    def _set_strategy(self, dist_strategy):
+        self.dist_strategy = dist_strategy
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    def _role(self):
+        if self.role_maker is None:
+            from .role_maker import PaddleCloudRoleMaker
+            self.role_maker = PaddleCloudRoleMaker()
+        return self.role_maker
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        """Reference util_factory.py:66."""
+        return self._role()._all_reduce(input, mode, comm_world)
+
+    def barrier(self, comm_world="worker"):
+        self._role()._barrier(comm_world)
+
+    def all_gather(self, input, comm_world="worker"):
+        return self._role()._all_gather(input, comm_world)
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers (reference
+        util_factory.py get_file_shard: remainder spread over the first
+        workers)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file need to be read.")
+        rm = self._role()
+        trainer_id = rm._worker_index()
+        trainers = rm._worker_num()
+        base, rem = divmod(len(files), trainers)
+        blocks = [base + (1 if i < rem else 0) for i in range(trainers)]
+        start = sum(blocks[:trainer_id])
+        return files[start:start + blocks[trainer_id]]
+
+    def print_on_rank(self, message, rank_id):
+        if self._role()._worker_index() == rank_id:
+            print(message)
+
+    def get_heter_file_shard(self, files):
+        return self.get_file_shard(files)
+
+    # fs passthroughs (reference _set_file_system / fs proxy methods)
+    def _set_file_system(self, fs_client):
+        self._fs = fs_client
+
+    def _get_file_system(self):
+        if getattr(self, "_fs", None) is None:
+            from ..utils.fs import LocalFS
+            self._fs = LocalFS()
+        return self._fs
+
+    def ls_dir(self, path):
+        return self._get_file_system().ls_dir(path)
+
+    def makedirs(self, path):
+        os.makedirs(path, exist_ok=True)

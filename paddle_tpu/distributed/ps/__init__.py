@@ -20,7 +20,7 @@ import numpy as np
 
 from .. import rpc
 
-__all__ = ["ParameterServer", "SparseTable", "SGDAccessor",
+__all__ = ["ParameterServer", "SparseTable", "SGDAccessor", "the_one_ps", "runtime", "utils",
            "AdagradAccessor", "AdamAccessor"]
 
 _TABLES: dict[str, "ParameterServer"] = {}
@@ -216,3 +216,8 @@ class SparseTable:
     def accessor(self):
         return rpc.rpc_sync(self.server, ParameterServer.accessor_name,
                             args=(self.name,))
+
+
+from . import the_one_ps  # noqa: F401,E402
+from . import runtime  # noqa: F401,E402
+from . import utils  # noqa: F401,E402

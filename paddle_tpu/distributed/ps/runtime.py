@@ -1,0 +1,73 @@
+"""PS worker/server lifecycle (reference: the TheOnePSRuntime half of
+python/paddle/distributed/ps/the_one_ps.py — _init_worker :1049,
+_init_server :1297, _run_server :1364, _stop_worker :1380).
+
+The transport is the rpc agent (distributed/rpc over the native TCP
+store): a server process hosts ParameterServer tables and serves
+pull/push rpcs; workers attach via init_rpc. Single-process use keeps the
+tables in-memory with no rpc."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_state = {"worker": False, "serving": None, "tables": {}}
+
+
+def init_worker(scopes=None):
+    """Attach this process to the PS as a worker (reference :1049):
+    joins the rpc world when the launcher env names one."""
+    _state["worker"] = True
+    if os.environ.get("PADDLE_MASTER") and \
+            os.environ.get("PADDLE_TRAINERS_NUM"):
+        from .. import rpc
+        try:
+            rpc.get_worker_info()
+        except Exception:
+            rpc.init_rpc(f"worker_{os.environ.get('PADDLE_TRAINER_ID', 0)}")
+
+
+def init_server(dirname=None, var_names=None, **kwargs):
+    """Create the server-side tables, optionally loading persistables
+    (reference :1297). Tables register lazily via create_table."""
+    if dirname:
+        import pickle
+        with open(os.path.join(dirname, "ps_tables.pkl"), "rb") as f:
+            saved = pickle.load(f)
+        for name, blob in saved.items():
+            if var_names and name not in var_names:
+                continue
+            _state["tables"][name] = blob
+    return _state["tables"]
+
+
+def create_table(name, dim, **kw):
+    """Host a live table in this server process."""
+    from . import ParameterServer
+    table = ParameterServer(name, dim, **kw)
+    _state["tables"][name] = table
+    return table
+
+
+def run_server():
+    """Serve rpc requests until stop (reference :1364). The rpc agent
+    already answers requests on its own thread; this blocks like the
+    reference's brpc run loop."""
+    stop = threading.Event()
+    _state["serving"] = stop
+    if os.environ.get("PADDLE_MASTER"):
+        from .. import rpc
+        try:
+            rpc.get_worker_info()
+        except Exception:
+            rpc.init_rpc(f"server_{os.environ.get('PADDLE_TRAINER_ID', 0)}")
+    stop.wait()
+
+
+def stop_worker():
+    """Detach the worker / release a serving loop (reference :1380)."""
+    _state["worker"] = False
+    if _state["serving"] is not None:
+        _state["serving"].set()
+        _state["serving"] = None

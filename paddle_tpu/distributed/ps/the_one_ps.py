@@ -1,0 +1,106 @@
+"""PS table descriptors + runtime glue (reference:
+python/paddle/distributed/ps/the_one_ps.py — Table :614, BarrierTable
+:628, TensorTable :663, SparseTable :686, GeoSparseTable :795, DenseTable
+:830).
+
+The reference emits proto descriptors consumed by the C++ brpc tables;
+here each descriptor *instantiates* into the in-memory/cross-process
+parameter server (distributed/ps ParameterServer + csrc tcp store), which
+is the TPU build's PS substrate. The descriptor surface (table_class,
+accessor, shard_num) matches the reference so PS configs port over."""
+
+from __future__ import annotations
+
+__all__ = ["Table", "BarrierTable", "DenseTable", "SparseTable",
+           "GeoSparseTable", "TensorTable"]
+
+
+class _Accessor:
+    def __init__(self):
+        self.accessor_class = "SparseAccessor"
+        self.optimizer = "sgd"
+        self.feature_dim = 0
+        self.embedding_dim = 0
+
+
+class Table:
+    """Reference the_one_ps.py:614."""
+
+    def __init__(self):
+        self.table_class = None
+        self.shard_num = -1
+        self.type = None
+        self.accessor = _Accessor()
+        self.common = None
+        self.tensor = None
+        self.idx = 0
+        self._live = None
+
+    def instantiate(self, name, dim, lr=0.1, optimizer=None, **kw):
+        """Materialize the descriptor into a live in-memory table."""
+        from . import ParameterServer
+        self._live = ParameterServer(
+            name, dim, lr=lr, optimizer=optimizer or self.accessor.optimizer,
+            **kw)
+        return self._live
+
+
+class BarrierTable(Table):
+    """Reference :628 — a rendezvous-only pseudo table."""
+
+    def __init__(self, context=None, idx=0):
+        super().__init__()
+        self.table_class = "BarrierTable"
+        self.type = None
+        self.idx = idx
+        self.accessor.accessor_class = "CommMergeAccessor"
+        self._context = context
+
+    def barrier(self):
+        from .. import communication as comm
+        try:
+            comm.barrier()
+        except Exception:
+            pass
+
+
+class TensorTable(Table):
+    """Reference :663 — serves whole tensors (e.g. global step)."""
+
+    def __init__(self, idx=0, tensor_dict=None, role_maker=None):
+        super().__init__()
+        self.table_class = "TensorTable"
+        self.idx = idx
+        self.tensor = dict(tensor_dict or {})
+        self._role_maker = role_maker
+
+
+class SparseTable(Table):
+    """Reference :686 — sharded embedding rows with an accessor."""
+
+    def __init__(self, context=None, send_ctx=None):
+        super().__init__()
+        self.table_class = "MemorySparseTable"
+        self.type = "sparse"
+        self.context = context
+        self.send_ctx = send_ctx
+
+
+class GeoSparseTable(SparseTable):
+    """Reference :795 — geo-async sparse table (delta pushes)."""
+
+    def __init__(self, context=None, send_ctx=None):
+        super().__init__(context, send_ctx)
+        self.table_class = "MemorySparseGeoTable"
+        self.accessor.accessor_class = "SparseAccessor"
+
+
+class DenseTable(Table):
+    """Reference :830 — dense parameter slab."""
+
+    def __init__(self, context=None, send_ctx=None):
+        super().__init__()
+        self.table_class = "MemoryDenseTable"
+        self.type = "dense"
+        self.context = context
+        self.send_ctx = send_ctx

@@ -1,0 +1,10 @@
+"""PS program-building utilities (reference:
+python/paddle/distributed/ps/utils/)."""
+
+from . import ps_factory  # noqa: F401
+from .ps_factory import (PsProgramBuilder,  # noqa: F401
+                         PsProgramBuilderFactory,
+                         CpuSyncPsProgramBuilder, CpuAsyncPsProgramBuilder,
+                         GeoPsProgramBuilder, NuPsProgramBuilder,
+                         GpuPsProgramBuilder, HeterAsyncPsProgramBuilder,
+                         FlPsProgramBuilder)

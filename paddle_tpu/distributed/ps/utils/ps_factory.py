@@ -1,0 +1,104 @@
+"""PS program builders (reference: python/paddle/distributed/ps/utils/
+ps_factory.py + ps_program_builder.py).
+
+The reference rewrites static ProgramDescs per PS mode (sync/async/geo/
+gpu/heter/fl). The trace-based programs here need no desc surgery — each
+builder instead configures the table push mode + worker sync policy used
+by SparseTable/ParameterServer, which is where those semantics live on
+the TPU build."""
+
+from __future__ import annotations
+
+__all__ = ["PsProgramBuilder", "PsProgramBuilderFactory",
+           "CpuSyncPsProgramBuilder", "CpuAsyncPsProgramBuilder",
+           "GeoPsProgramBuilder", "NuPsProgramBuilder",
+           "GpuPsProgramBuilder", "HeterAsyncPsProgramBuilder",
+           "FlPsProgramBuilder"]
+
+
+class PsProgramBuilder:
+    """Base builder (reference ps_program_builder.py:24): holds the pass
+    context and applies worker/server build steps."""
+
+    mode = "sync"          # table push policy this builder selects
+    geo_step = 0           # >0: geo delta-push interval
+
+    def __init__(self, pass_ctx=None):
+        self.pass_ctx = pass_ctx or {}
+        self.attrs = dict(getattr(pass_ctx, "_attrs", None)
+                          or (pass_ctx if isinstance(pass_ctx, dict) else {}))
+        self.loss = self.attrs.get("loss")
+        self.origin_main_program = self.attrs.get("origin_main_program")
+
+    def _build_trainer_programs(self):
+        """Configure the worker side: async builders push via push_async,
+        geo builders accumulate deltas for geo_step batches."""
+        self.attrs["push_mode"] = self.mode
+        self.attrs["geo_step"] = self.geo_step
+
+    def _build_pserver_programs(self):
+        self.attrs["server_mode"] = self.mode
+
+    def _build_programs(self):
+        role = self.attrs.get("is_server")
+        if role:
+            self._build_pserver_programs()
+        else:
+            self._build_trainer_programs()
+        return self.attrs
+
+
+class CpuSyncPsProgramBuilder(PsProgramBuilder):
+    """Reference ps_program_builder.py CpuSyncPsProgramBuilder."""
+    mode = "sync"
+
+
+class CpuAsyncPsProgramBuilder(PsProgramBuilder):
+    mode = "async"
+
+
+class GeoPsProgramBuilder(PsProgramBuilder):
+    mode = "geo"
+
+    def __init__(self, pass_ctx=None):
+        super().__init__(pass_ctx)
+        self.geo_step = int(self.attrs.get("k_steps", 100))
+
+
+class NuPsProgramBuilder(GeoPsProgramBuilder):
+    """Geo with local-update accumulation (reference NuPsProgramBuilder)."""
+
+
+class GpuPsProgramBuilder(PsProgramBuilder):
+    """Accelerator-resident PS (HeterPS analog): tables stay device-side;
+    on TPU the dense path is the sharded-parameter path, so this builder
+    keeps sync mode with device placement."""
+    mode = "sync"
+
+
+class HeterAsyncPsProgramBuilder(PsProgramBuilder):
+    mode = "async"
+
+
+class FlPsProgramBuilder(HeterAsyncPsProgramBuilder):
+    """Federated-learning mode (reference FlPsProgramBuilder)."""
+
+
+class PsProgramBuilderFactory:
+    """Reference ps_factory.py:30: pick a builder from the pass context."""
+
+    def _create_ps_program_builder(self, pass_ctx):
+        attrs = dict(getattr(pass_ctx, "_attrs", None)
+                     or (pass_ctx if isinstance(pass_ctx, dict) else {}))
+        if attrs.get("ps_mode") == "geo":
+            return (NuPsProgramBuilder if attrs.get("local_sgd")
+                    else GeoPsProgramBuilder)(pass_ctx)
+        if attrs.get("use_ps_gpu"):
+            return GpuPsProgramBuilder(pass_ctx)
+        if attrs.get("is_heter_ps_mode") and not attrs.get("is_fl_ps_mode"):
+            return HeterAsyncPsProgramBuilder(pass_ctx)
+        if attrs.get("is_fl_ps_mode"):
+            return FlPsProgramBuilder(pass_ctx)
+        if attrs.get("ps_mode") == "sync":
+            return CpuSyncPsProgramBuilder(pass_ctx)
+        return CpuAsyncPsProgramBuilder(pass_ctx)

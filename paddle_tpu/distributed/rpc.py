@@ -20,6 +20,7 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_all_worker_infos", "get_current_worker_info",
            "get_worker_info", "WorkerInfo"]
 
 _agent = None
@@ -159,6 +160,19 @@ def get_worker_info(name=None):
     if _agent is None:
         raise RuntimeError("init_rpc first")
     return _agent.workers[name or _agent.name]
+
+
+def get_current_worker_info():
+    """This process's WorkerInfo (reference rpc.py get_current_worker_info)."""
+    return get_worker_info()
+
+
+def get_all_worker_infos():
+    """All registered WorkerInfos, rank-ordered (reference rpc.py
+    get_all_worker_infos)."""
+    if _agent is None:
+        raise RuntimeError("init_rpc first")
+    return sorted(_agent.workers.values(), key=lambda w: w.rank)
 
 
 def rpc_sync(to, fn, args=(), kwargs=None, timeout=30):

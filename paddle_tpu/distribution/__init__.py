@@ -9,11 +9,20 @@ from .distributions import (  # noqa: F401
     Distribution, Exponential, ExpTransform, Geometric, Gumbel, Independent,
     Laplace, LogNormal, Multinomial, Normal, SigmoidTransform, Transform,
     TransformedDistribution, Uniform, kl_divergence, register_kl,
-    ExponentialFamily)
+)
+from .distributions import ExponentialFamily  # noqa: F401
+from . import transform  # noqa: F401
+from .transform import (  # noqa: F401
+    AbsTransform, ChainTransform, IndependentTransform, PowerTransform,
+    ReshapeTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform)
 
 __all__ = ["ExponentialFamily", "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Exponential", "Beta", "Gumbel", "Laplace", "Cauchy", "Geometric",
            "LogNormal", "Dirichlet", "Multinomial", "Independent",
            "Transform", "AffineTransform", "ExpTransform",
            "SigmoidTransform", "TransformedDistribution", "kl_divergence",
+           "AbsTransform", "ChainTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform",
            "register_kl"]

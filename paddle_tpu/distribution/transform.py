@@ -1,0 +1,255 @@
+"""Bijector library (reference: python/paddle/distribution/transform.py —
+AbsTransform :342, ChainTransform :496, IndependentTransform :670,
+PowerTransform :765, ReshapeTransform :829, SoftmaxTransform :995,
+StackTransform :1051, StickBreakingTransform :1171, TanhTransform :1237;
+Affine/Exp/Sigmoid live in distributions.py).
+
+All forward/inverse/log-det maps are jnp compositions running through
+`apply`, so they are jittable and differentiable."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.function import apply
+from ..core.tensor import as_tensor
+from .distributions import (AffineTransform, ExpTransform,  # noqa: F401
+                            SigmoidTransform, Transform)
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+class AbsTransform(Transform):
+    """y = |x| (reference transform.py:342). Not injective: inverse maps
+    to the positive branch."""
+
+    def forward(self, x):
+        return apply(jnp.abs, as_tensor(x), name="abs_fwd")
+
+    def inverse(self, y):
+        return apply(lambda a: a, as_tensor(y), name="abs_inv")
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "AbsTransform is not bijective; log|det J| undefined")
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (reference transform.py:496)."""
+
+    def __init__(self, transforms):
+        if not isinstance(transforms, (list, tuple)):
+            raise TypeError("transforms must be a list/tuple of Transform")
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing batch dims as event dims (reference
+    transform.py:670): log-det sums over the reinterpreted dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self._base.forward(x)
+
+    def inverse(self, y):
+        return self._base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self._base.forward_log_det_jacobian(x)
+        return apply(
+            lambda a: jnp.sum(a, axis=tuple(range(-self._rank, 0))), ld,
+            name="independent_logdet")
+
+
+class PowerTransform(Transform):
+    """y = x^p (reference transform.py:765)."""
+
+    def __init__(self, power):
+        self.power = as_tensor(power)
+
+    def forward(self, x):
+        return apply(lambda a, p: jnp.power(a, p), as_tensor(x), self.power,
+                     name="power_fwd")
+
+    def inverse(self, y):
+        return apply(lambda a, p: jnp.power(a, 1.0 / p), as_tensor(y),
+                     self.power, name="power_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply(
+            lambda a, p: jnp.log(jnp.abs(p * jnp.power(a, p - 1.0))),
+            as_tensor(x), self.power, name="power_logdet")
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event block (reference transform.py:829)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self._in = tuple(int(s) for s in in_event_shape)
+        self._out = tuple(int(s) for s in out_event_shape)
+        if math.prod(self._in) != math.prod(self._out):
+            raise ValueError(
+                f"in_event_shape {self._in} and out_event_shape {self._out} "
+                "must have the same number of elements")
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def forward(self, x):
+        def f(a):
+            batch = a.shape[:a.ndim - len(self._in)]
+            return a.reshape(batch + self._out)
+        return apply(f, as_tensor(x), name="reshape_fwd")
+
+    def inverse(self, y):
+        def f(a):
+            batch = a.shape[:a.ndim - len(self._out)]
+            return a.reshape(batch + self._in)
+        return apply(f, as_tensor(y), name="reshape_inv")
+
+    def forward_log_det_jacobian(self, x):
+        def f(a):
+            batch = a.shape[:a.ndim - len(self._in)]
+            return jnp.zeros(batch, a.dtype)
+        return apply(f, as_tensor(x), name="reshape_logdet")
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last dim (reference transform.py:995).
+    Not bijective: inverse is the log map."""
+
+    def forward(self, x):
+        return apply(lambda a: jax.nn.softmax(a, axis=-1), as_tensor(x),
+                     name="softmax_fwd")
+
+    def inverse(self, y):
+        return apply(jnp.log, as_tensor(y), name="softmax_inv")
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not bijective; log|det J| undefined")
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along `axis` (reference
+    transform.py:1051)."""
+
+    def __init__(self, transforms, axis=0):
+        if not isinstance(transforms, (list, tuple)) or not transforms:
+            raise TypeError("transforms must be a non-empty list/tuple")
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, value, method):
+        value = as_tensor(value)
+        n = len(self.transforms)
+        if int(value.shape[self.axis]) != n:
+            raise ValueError(
+                f"axis {self.axis} of the input (size "
+                f"{value.shape[self.axis]}) must equal the number of "
+                f"transforms ({n})")
+        from .. import stack as _  # noqa: F401  (ensure package init)
+        import paddle_tpu as paddle
+        slices = paddle.unstack(value, axis=self.axis)
+        outs = [getattr(t, method)(s)
+                for t, s in zip(self.transforms, slices)]
+        return paddle.stack(outs, axis=self.axis)
+
+    def forward(self, x):
+        return self._map(x, "forward")
+
+    def inverse(self, y):
+        return self._map(y, "inverse")
+
+    def forward_log_det_jacobian(self, x):
+        return self._map(x, "forward_log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^K -> (K+1)-simplex by stick breaking (reference
+    transform.py:1171; formulas match _forward/_inverse/_fldj there)."""
+
+    def forward(self, x):
+        def f(a):
+            k = a.shape[-1]
+            offset = k + 1 - jnp.cumsum(jnp.ones((k,), a.dtype), -1)
+            z = jax.nn.sigmoid(a - jnp.log(offset))
+            z_cumprod = jnp.cumprod(1 - z, -1)
+            pad_z = jnp.concatenate(
+                [z, jnp.ones(a.shape[:-1] + (1,), a.dtype)], -1)
+            pad_cp = jnp.concatenate(
+                [jnp.ones(a.shape[:-1] + (1,), a.dtype), z_cumprod], -1)
+            return pad_z * pad_cp
+        return apply(f, as_tensor(x), name="stickbreaking_fwd")
+
+    def inverse(self, y):
+        def f(a):
+            y_crop = a[..., :-1]
+            k = y_crop.shape[-1]
+            offset = a.shape[-1] - jnp.cumsum(jnp.ones((k,), a.dtype), -1)
+            sf = 1 - jnp.cumsum(y_crop, -1)
+            return jnp.log(y_crop) - jnp.log(sf) + jnp.log(offset)
+        return apply(f, as_tensor(y), name="stickbreaking_inv")
+
+    def forward_log_det_jacobian(self, x):
+        def f(a):
+            k = a.shape[-1]
+            offset = k + 1 - jnp.cumsum(jnp.ones((k,), a.dtype), -1)
+            z = jax.nn.sigmoid(a - jnp.log(offset))
+            z_cumprod = jnp.cumprod(1 - z, -1)
+            y = jnp.concatenate(
+                [z, jnp.ones(a.shape[:-1] + (1,), a.dtype)], -1) * \
+                jnp.concatenate(
+                    [jnp.ones(a.shape[:-1] + (1,), a.dtype), z_cumprod], -1)
+            xs = a - jnp.log(offset)
+            return jnp.sum(-xs + jax.nn.log_sigmoid(xs)
+                           + jnp.log(y[..., :-1]), -1)
+        return apply(f, as_tensor(x), name="stickbreaking_logdet")
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference transform.py:1237); log|det J| uses the
+    numerically-stable 2(log2 - x - softplus(-2x)) form."""
+
+    def forward(self, x):
+        return apply(jnp.tanh, as_tensor(x), name="tanh_fwd")
+
+    def inverse(self, y):
+        return apply(jnp.arctanh, as_tensor(y), name="tanh_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply(
+            lambda a: 2.0 * (jnp.log(2.0) - a - jax.nn.softplus(-2.0 * a)),
+            as_tensor(x), name="tanh_logdet")

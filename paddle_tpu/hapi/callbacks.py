@@ -328,3 +328,130 @@ class ReduceLROnPlateau(Callback):
                         print(f"ReduceLROnPlateau: lr {old:.2e} -> {new:.2e}")
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
+
+
+class VisualDL(Callback):
+    """VisualDL scalar logging callback (reference hapi/callbacks.py
+    VisualDL). The visualdl package is optional; absent, metrics fall
+    back to a local jsonl the VisualDL UI (or any tool) can ingest."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self.epochs = None
+        self.steps = None
+        self.epoch = 0
+        self._writers = {}
+        self._step = {"train": 0, "eval": 0}
+
+    def _writer(self, mode):
+        if mode not in self._writers:
+            try:
+                from visualdl import LogWriter
+                self._writers[mode] = LogWriter(self.log_dir)
+            except ImportError:
+                import json
+                import os
+
+                class _JsonlWriter:
+                    def __init__(self, path):
+                        os.makedirs(os.path.dirname(path), exist_ok=True)
+                        self._f = open(path, "a")
+
+                    def add_scalar(self, tag, value, step):
+                        self._f.write(json.dumps(
+                            {"tag": tag, "value": float(value),
+                             "step": int(step)}) + "\n")
+                        self._f.flush()
+
+                    def close(self):
+                        self._f.close()
+
+                import os.path as osp
+                self._writers[mode] = _JsonlWriter(
+                    osp.join(self.log_dir, f"vdl_{mode}.jsonl"))
+        return self._writers[mode]
+
+    def _log(self, mode, logs, step):
+        logs = logs or {}
+        metrics = self.params.get("metrics") or list(logs)
+        for k in metrics:
+            if k in logs and isinstance(logs[k], (int, float)):
+                self._writer(mode).add_scalar(f"{mode}/{k}", logs[k], step)
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self.epoch = epoch or 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step["train"] += 1
+        if self._step["train"] % 10 == 0:
+            self._log("train", logs, self._step["train"])
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        self._log("train", logs, self._step["train"])
+
+    def on_eval_end(self, logs=None):
+        self._step["eval"] += 1
+        self._log("eval", logs, self._step["eval"])
+
+    def on_train_end(self, logs=None):
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()
+
+
+class WandbCallback(Callback):
+    """Weights & Biases callback (reference hapi/callbacks.py
+    WandbCallback). Requires the optional wandb package."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+            self.wandb = wandb
+        except ImportError as e:
+            raise RuntimeError(
+                "You want to use wandb which is not installed yet; install "
+                "it with `pip install wandb`") from e
+        self._run = None
+        self._kwargs = dict(project=project, entity=entity, name=name,
+                            dir=dir, mode=mode, job_type=job_type, **kwargs)
+
+    @property
+    def run(self):
+        if self._run is None:
+            if self.wandb.run is not None:
+                self._run = self.wandb.run
+            else:
+                self._run = self.wandb.init(
+                    **{k: v for k, v in self._kwargs.items()
+                       if v is not None})
+        return self._run
+
+    def _log(self, prefix, logs, step=None):
+        logs = logs or {}
+        payload = {f"{prefix}/{k}": v for k, v in logs.items()
+                   if isinstance(v, (int, float))}
+        if payload:
+            self.run.log(payload, step=step)
+
+    def on_train_begin(self, logs=None):
+        _ = self.run
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        self._log("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+
+
+__all__ += ["VisualDL", "WandbCallback"]

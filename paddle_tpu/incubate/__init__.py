@@ -1,4 +1,6 @@
 from . import distributed  # noqa: F401
+from . import autotune  # noqa: F401
+from . import xpu  # noqa: F401
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401

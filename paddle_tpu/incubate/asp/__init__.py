@@ -17,7 +17,7 @@ import numpy as np
 from ...nn.layer import Layer
 from ...nn.layers.common import Linear
 
-__all__ = ["prune_model", "decorate", "set_excluded_layers",
+__all__ = ["prune_model", "decorate", "set_excluded_layers", "add_supported_layer",
            "reset_excluded_layers", "calculate_density", "check_mask_1d",
            "create_mask"]
 
@@ -73,12 +73,57 @@ def calculate_density(mat: "np.ndarray") -> float:
     return float((a != 0).sum() / a.size)
 
 
+# layer-type name -> pruning function (reference supported_layer_list.py:
+# supported_layers_and_prune_func_map; add_supported_layer extends it)
+_SUPPORTED_FUNCS = {}
+
+
+def _camel_to_snake(name):
+    import re
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+def _norm_key(name):
+    """Lookup normalization: 'Conv2D' -> 'conv2_d' by snake-casing but
+    'conv2d' when registered by plain name — strip underscores so both
+    spellings hit the same entry."""
+    return name.replace("_", "").lower()
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register a layer type (or name) as prunable, with an optional custom
+    pruning function (weight_np, n, m, mask_algo, param_name) -> (weight,
+    mask) (reference supported_layer_list.py:84)."""
+    if isinstance(layer, str):
+        name = layer
+    elif isinstance(layer, type) and issubclass(layer, Layer):
+        name = _camel_to_snake(layer.__name__)
+    elif isinstance(layer, Layer):
+        name = _camel_to_snake(type(layer).__name__)
+    else:
+        raise TypeError(
+            f"The type of layer should be string or Layer, but got "
+            f"{type(layer)}!")
+    _SUPPORTED_FUNCS[_norm_key(name)] = pruning_func
+
+
+for _n in ("fc", "linear", "conv2d"):
+    add_supported_layer(_n)
+
+
 def _prunable_params(model: Layer):
+    from ...nn.layers.conv import Conv2D
     for name, sub in model.named_sublayers(include_self=True):
-        if isinstance(sub, Linear) and sub.weight is not None:
-            if sub.weight.name in _EXCLUDED or name in _EXCLUDED:
-                continue
-            yield sub.weight
+        type_name = _norm_key(type(sub).__name__)
+        if type_name not in _SUPPORTED_FUNCS and \
+                not isinstance(sub, (Linear, Conv2D)):
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None or getattr(w, "ndim", 2) < 2:
+            continue
+        if w.name in _EXCLUDED or name in _EXCLUDED:
+            continue
+        yield w, _SUPPORTED_FUNCS.get(type_name)
 
 
 def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d",
@@ -87,9 +132,14 @@ def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d",
     `decorate`d optimizers keep them (reference asp.py:302)."""
     import jax.numpy as jnp
     masks = {}
-    for p in _prunable_params(model):
-        mask = create_mask(np.asarray(p.numpy()), n=n, m=m)
-        p._d = p._d * jnp.asarray(mask, p._d.dtype)
+    for p, custom in _prunable_params(model):
+        w = np.asarray(p.numpy())
+        if custom is not None:
+            pruned, mask = custom(w, n, m, mask_algo, p.name)
+            p._d = jnp.asarray(pruned, p._d.dtype)
+        else:
+            mask = create_mask(w, n=n, m=m)
+            p._d = p._d * jnp.asarray(mask, p._d.dtype)
         if with_mask:
             p._asp_mask = mask
             masks[p.name] = mask

@@ -1,0 +1,61 @@
+"""Auto-tuning configuration (reference: python/paddle/incubate/
+autotune.py set_config :24).
+
+Maps the reference's three tuning domains onto the TPU build:
+- kernel: toggles the measured Pallas row-block autotuner
+  (ops/kernels/_common.py block overrides) within a tuning-iteration
+  window;
+- layout: XLA already picks layouts on TPU — the switch is recorded and
+  surfaced via get_config for parity;
+- dataloader: records the num_workers tuning request consumed by
+  io.DataLoader when auto_tune=True.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["set_config"]
+
+_CONFIG = {
+    "kernel": {"enable": False, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False},
+}
+
+
+def set_config(config=None):
+    """Reference autotune.py:24: accepts a dict or a json file path; None
+    enables every domain."""
+    if config is None:
+        for dom in _CONFIG.values():
+            dom["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError("config should be a dict or a json file path")
+    for key in ("kernel", "layout", "dataloader"):
+        if key not in config:
+            continue
+        dom = config[key]
+        if not isinstance(dom, dict):
+            raise TypeError(f"config[{key!r}] should be a dict")
+        if "enable" in dom:
+            if not isinstance(dom["enable"], bool):
+                raise TypeError(f"{key}.enable should be bool")
+            _CONFIG[key]["enable"] = dom["enable"]
+        if key == "kernel" and "tuning_range" in dom:
+            rng = list(dom["tuning_range"])
+            if len(rng) != 2:
+                raise ValueError("kernel.tuning_range should be [start, end]")
+            _CONFIG[key]["tuning_range"] = rng
+        if key == "dataloader" and "num_workers" in dom:
+            _CONFIG[key]["num_workers"] = int(dom["num_workers"])
+
+
+def get_config():
+    """Current tuning configuration (consumed by the kernel autotuner and
+    DataLoader)."""
+    return {k: dict(v) for k, v in _CONFIG.items()}

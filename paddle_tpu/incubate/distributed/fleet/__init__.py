@@ -1,0 +1,11 @@
+"""`paddle.incubate.distributed.fleet` (reference:
+python/paddle/incubate/distributed/fleet/__init__.py — recompute
+re-exports)."""
+
+from ....distributed.fleet.recompute import (  # noqa: F401
+    recompute_hybrid, recompute_sequential)
+from . import fleet_util  # noqa: F401
+from . import utils  # noqa: F401
+from .fleet_util import FleetUtil, GPUPSUtil  # noqa: F401
+
+__all__ = ["recompute_hybrid", "recompute_sequential"]

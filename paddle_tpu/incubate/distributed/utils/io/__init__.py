@@ -1,0 +1,10 @@
+"""Distributed save/load helpers (reference:
+python/paddle/incubate/distributed/utils/io/)."""
+
+from . import dist_save  # noqa: F401
+from . import save_for_auto  # noqa: F401
+from .dist_load import load  # noqa: F401
+from .dist_save import save  # noqa: F401
+from .save_for_auto import save_for_auto_inference  # noqa: F401
+
+__all__ = ["save", "load", "save_for_auto_inference"]

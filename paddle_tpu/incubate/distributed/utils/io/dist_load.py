@@ -1,0 +1,15 @@
+"""Distributed-aware load (reference:
+python/paddle/incubate/distributed/utils/io/dist_load.py load): loads a
+unified file on every rank; sharded parameters pick their shard at
+assignment time via the sharding spec."""
+
+from __future__ import annotations
+
+__all__ = ["load"]
+
+
+def load(path, **configs):
+    import paddle_tpu as paddle
+    place = configs.pop("place", None)
+    _ = place  # device placement is the runtime's job on TPU
+    return paddle.load(path, **configs)

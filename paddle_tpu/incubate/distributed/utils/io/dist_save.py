@@ -1,0 +1,39 @@
+"""Gather-and-save for hybrid-parallel state (reference:
+python/paddle/incubate/distributed/utils/io/dist_save.py — save :31).
+
+`gather_to` collects every rank's shard of a dp/sharding-parallel state
+dict onto the destination rank(s), which then saves one unified file via
+paddle.save. On the TPU build sharded arrays are jax global arrays whose
+replication is handled by the checkpoint layer, so gathering is
+materializing the full value host-side."""
+
+from __future__ import annotations
+
+__all__ = ["save", "save_for_auto_inference"]
+
+
+def _gather_value(v):
+    import numpy as np
+    num = getattr(v, "numpy", None)
+    return np.asarray(num()) if num else v
+
+
+def save(state_dict, path, **configs):
+    """Reference dist_save.py:31. configs: gather_to (int|list, default 0),
+    state_type ('params'|'opt'), max_grouped_size."""
+    gather_to = configs.pop("gather_to", 0)
+    configs.pop("state_type", None)
+    configs.pop("max_grouped_size", None)
+    import paddle_tpu as paddle
+    from .....distributed.fleet import fleet
+    rank = fleet.worker_index()
+    dests = gather_to if isinstance(gather_to, (list, tuple)) else [gather_to]
+    gathered = {k: _gather_value(v) for k, v in state_dict.items()} \
+        if isinstance(state_dict, dict) else state_dict
+    if rank in dests or fleet._role_maker is None:
+        paddle.save(gathered, path, **configs)
+
+
+def save_for_auto_inference(path_prefix, dist_model, cvt2cpu=False):
+    from .save_for_auto import save_for_auto_inference as _impl
+    return _impl(path_prefix, dist_model, cvt2cpu)

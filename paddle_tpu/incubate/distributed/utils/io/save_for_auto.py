@@ -1,0 +1,32 @@
+"""Export a hybrid-parallel model for auto-parallel inference (reference:
+python/paddle/incubate/distributed/utils/io/save_for_auto.py
+save_for_auto_inference): writes <prefix>_dist<rank>.pdparams plus the
+dist attr mapping so the auto-parallel loader can reshard."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+__all__ = ["save_for_auto_inference"]
+
+
+def save_for_auto_inference(path_prefix, dist_model, cvt2cpu=False):
+    import numpy as np
+    import paddle_tpu as paddle
+    from .....distributed.fleet import fleet
+    rank = fleet.worker_index()
+    state = dist_model.state_dict() if hasattr(dist_model, "state_dict") \
+        else dict(dist_model)
+    params = {k: np.asarray(v.numpy()) if hasattr(v, "numpy") else v
+              for k, v in state.items()}
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    paddle.save(params, f"{path_prefix}_dist{rank}.pdparams")
+    # dist attrs: sharding spec per param (None for replicated)
+    attrs = {}
+    for k, v in state.items():
+        spec = getattr(v, "_sharding_spec", None)
+        attrs[k] = {"dims_mapping": spec} if spec is not None else {}
+    with open(f"{path_prefix}_dist{rank}.pdattr", "wb") as f:
+        pickle.dump(attrs, f)
+    return path_prefix

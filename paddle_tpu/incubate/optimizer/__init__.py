@@ -7,7 +7,10 @@ import jax.numpy as jnp
 
 from ...core.tensor import Tensor
 
-__all__ = ['LookAhead', 'ModelAverage']
+from ...optimizer import LBFGS  # noqa: F401  (reference re-exports it)
+from . import functional  # noqa: F401
+
+__all__ = ['LookAhead', 'ModelAverage', 'LBFGS', 'functional']
 
 
 class _WrappedOptimizer:

@@ -1,0 +1,8 @@
+"""Functional optimization (reference:
+python/paddle/incubate/optimizer/functional/ — minimize_bfgs bfgs.py:27,
+minimize_lbfgs lbfgs.py:27)."""
+
+from .bfgs import minimize_bfgs  # noqa: F401
+from .lbfgs import minimize_lbfgs  # noqa: F401
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]

@@ -1,0 +1,120 @@
+"""BFGS minimizer (reference: python/paddle/incubate/optimizer/functional/
+bfgs.py:27).
+
+TPU design: the whole optimization is one jnp program — value_and_grad of
+the (traced) objective inside a host loop with strong-Wolfe line search;
+each iteration is a handful of fused VPU ops."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....core.tensor import Tensor, as_tensor
+
+__all__ = ["minimize_bfgs"]
+
+
+def _wolfe_line_search(fg, x, d, f0, g0, a0, max_iters, c1=1e-4, c2=0.9):
+    """Strong-Wolfe line search (reference linesearch.py strong_wolfe):
+    bracket + zoom on the host; returns (alpha, f_new, g_new, calls)."""
+    dphi0 = float(jnp.vdot(g0, d))
+    calls = 0
+    alpha_prev, phi_prev = 0.0, float(f0)
+    alpha = float(a0)
+    lo = hi = None
+    phi_lo = None
+    for _ in range(max_iters):
+        f_a, g_a = fg(x + alpha * d)
+        calls += 1
+        phi_a = float(f_a)
+        dphi_a = float(jnp.vdot(g_a, d))
+        if phi_a > float(f0) + c1 * alpha * dphi0 or \
+                (calls > 1 and phi_a >= phi_prev):
+            lo, hi, phi_lo = alpha_prev, alpha, phi_prev
+            break
+        if abs(dphi_a) <= -c2 * dphi0:
+            return alpha, f_a, g_a, calls
+        if dphi_a >= 0:
+            lo, hi, phi_lo = alpha, alpha_prev, phi_a
+            break
+        alpha_prev, phi_prev = alpha, phi_a
+        alpha *= 2.0
+    else:
+        return alpha, f_a, g_a, calls
+    # zoom
+    for _ in range(max_iters):
+        mid = 0.5 * (lo + hi)
+        f_m, g_m = fg(x + mid * d)
+        calls += 1
+        phi_m = float(f_m)
+        dphi_m = float(jnp.vdot(g_m, d))
+        if phi_m > float(f0) + c1 * mid * dphi0 or phi_m >= phi_lo:
+            hi = mid
+        else:
+            if abs(dphi_m) <= -c2 * dphi0:
+                return mid, f_m, g_m, calls
+            if dphi_m * (hi - lo) >= 0:
+                hi = lo
+            lo, phi_lo = mid, phi_m
+    return mid, f_m, g_m, calls
+
+
+def _prep(objective_func, initial_position, dtype):
+    x0 = jnp.asarray(as_tensor(initial_position)._data, dtype)
+
+    def fg(xa):
+        def scalar_obj(v):
+            out = objective_func(Tensor(v))
+            return as_tensor(out)._data.reshape(())
+        return jax.value_and_grad(scalar_obj)(xa)
+
+    return x0, jax.jit(fg)
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """Reference bfgs.py:27. Returns (is_converge, num_func_calls,
+    position, objective_value, objective_gradient,
+    inverse_hessian_estimate)."""
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"only strong_wolfe line search is supported, got "
+            f"{line_search_fn}")
+    x, fg = _prep(objective_func, initial_position, dtype)
+    n = x.size
+    h = jnp.eye(n, dtype=x.dtype) if initial_inverse_hessian_estimate is None \
+        else jnp.asarray(as_tensor(initial_inverse_hessian_estimate)._data,
+                         x.dtype)
+    f, g = fg(x)
+    calls = 1
+    converged = False
+    for _ in range(int(max_iters)):
+        if float(jnp.max(jnp.abs(g))) < tolerance_grad:
+            converged = True
+            break
+        d = -(h @ g.reshape(-1)).reshape(x.shape)
+        alpha, f_new, g_new, c = _wolfe_line_search(
+            fg, x, d, f, g, initial_step_length, max_line_search_iters)
+        calls += c
+        s = (alpha * d).reshape(-1)
+        y = (g_new - g).reshape(-1)
+        if float(jnp.max(jnp.abs(alpha * d))) < tolerance_change:
+            x, f, g = x + alpha * d, f_new, g_new
+            converged = True
+            break
+        sy = jnp.vdot(s, y)
+        if float(sy) > 1e-10:
+            rho = 1.0 / sy
+            eye = jnp.eye(n, dtype=x.dtype)
+            v = eye - rho * jnp.outer(s, y)
+            h = v @ h @ v.T + rho * jnp.outer(s, s)
+        x, f, g = x + alpha * d, f_new, g_new
+    else:
+        converged = bool(float(jnp.max(jnp.abs(g))) < tolerance_grad)
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(calls)),
+            Tensor(x), Tensor(f), Tensor(g), Tensor(h))

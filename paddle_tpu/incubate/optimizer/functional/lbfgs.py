@@ -1,0 +1,73 @@
+"""L-BFGS minimizer (reference: python/paddle/incubate/optimizer/
+functional/lbfgs.py:27): two-loop recursion over a bounded (s, y)
+history."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, as_tensor
+from .bfgs import _prep, _wolfe_line_search
+
+__all__ = ["minimize_lbfgs"]
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-8, tolerance_change=1e-8,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    """Reference lbfgs.py:27. Returns (is_converge, num_func_calls,
+    position, objective_value, objective_gradient)."""
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"only strong_wolfe line search is supported, got "
+            f"{line_search_fn}")
+    x, fg = _prep(objective_func, initial_position, dtype)
+    f, g = fg(x)
+    calls = 1
+    hist_s, hist_y, hist_rho = [], [], []
+    gamma = 1.0
+    converged = False
+    for _ in range(int(max_iters)):
+        if float(jnp.max(jnp.abs(g))) < tolerance_grad:
+            converged = True
+            break
+        # two-loop recursion
+        q = g.reshape(-1)
+        alphas = []
+        for s, y, rho in zip(reversed(hist_s), reversed(hist_y),
+                             reversed(hist_rho)):
+            a = rho * jnp.vdot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        r = gamma * q
+        for (s, y, rho), a in zip(zip(hist_s, hist_y, hist_rho),
+                                  reversed(alphas)):
+            b = rho * jnp.vdot(y, r)
+            r = r + s * (a - b)
+        d = (-r).reshape(x.shape)
+        alpha, f_new, g_new, c = _wolfe_line_search(
+            fg, x, d, f, g, initial_step_length, max_line_search_iters)
+        calls += c
+        s = (alpha * d).reshape(-1)
+        y = (g_new - g).reshape(-1)
+        if float(jnp.max(jnp.abs(alpha * d))) < tolerance_change:
+            x, f, g = x + alpha * d, f_new, g_new
+            converged = True
+            break
+        sy = jnp.vdot(s, y)
+        if float(sy) > 1e-10:
+            hist_s.append(s)
+            hist_y.append(y)
+            hist_rho.append(1.0 / sy)
+            if len(hist_s) > history_size:
+                hist_s.pop(0)
+                hist_y.pop(0)
+                hist_rho.pop(0)
+            gamma = sy / jnp.vdot(y, y)
+        x, f, g = x + alpha * d, f_new, g_new
+    else:
+        converged = bool(float(jnp.max(jnp.abs(g))) < tolerance_grad)
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(calls)),
+            Tensor(x), Tensor(f), Tensor(g))

@@ -1,0 +1,9 @@
+"""`paddle.incubate.xpu` (reference: python/paddle/incubate/xpu/ — the
+fused ResNet basic block). TPU is the alternate accelerator in this
+build; the fused block is expressed as one jnp composition that XLA
+fuses."""
+
+from . import resnet_block  # noqa: F401
+from .resnet_block import ResNetBasicBlock, resnet_basic_block  # noqa: F401
+
+__all__ = ["resnet_block"]

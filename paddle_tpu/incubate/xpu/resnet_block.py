@@ -1,0 +1,141 @@
+"""Fused ResNet basic block (reference: python/paddle/incubate/xpu/
+resnet_block.py — resnet_basic_block :29, ResNetBasicBlock :327, the
+XPU fused kernel resnet_basic_block_op).
+
+The block is conv1-bn1-relu -> conv2-bn2, plus an optional conv3-bn3
+shortcut, then add + relu — one traced composition, fused by XLA into a
+handful of MXU convs + VPU epilogues (the reference fuses it by hand for
+the Kunlun XPU)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn import functional as F
+from ...nn.layer import Layer
+
+__all__ = ["ResNetBasicBlock", "resnet_basic_block"]
+
+
+def _bn(x, scale, bias, mean, var, eps, training, momentum, data_format):
+    return F.batch_norm(x, mean, var, weight=scale, bias=bias,
+                        training=training, momentum=momentum, epsilon=eps,
+                        data_format=data_format)
+
+
+def resnet_basic_block(
+        x, filter1, scale1, bias1, mean1, var1, filter2, scale2, bias2,
+        mean2, var2, filter3, scale3, bias3, mean3, var3, stride1, stride2,
+        stride3, padding1, padding2, padding3, dilation1, dilation2,
+        dilation3, groups, momentum, eps, data_format, has_shortcut,
+        use_global_stats=None, training=False, trainable_statistics=False,
+        find_conv_max=True):
+    """Reference resnet_block.py:29 (functional form)."""
+    bn_training = training and not use_global_stats
+    z = F.conv2d(x, filter1, stride=stride1, padding=padding1,
+                 dilation=dilation1, groups=groups, data_format=data_format)
+    z = _bn(z, scale1, bias1, mean1, var1, eps, bn_training, momentum,
+            data_format)
+    z = F.relu(z)
+    z = F.conv2d(z, filter2, stride=stride2, padding=padding2,
+                 dilation=dilation2, groups=groups, data_format=data_format)
+    z = _bn(z, scale2, bias2, mean2, var2, eps, bn_training, momentum,
+            data_format)
+    if has_shortcut:
+        sc = F.conv2d(x, filter3, stride=stride3, padding=padding3,
+                      dilation=dilation3, groups=groups,
+                      data_format=data_format)
+        sc = _bn(sc, scale3, bias3, mean3, var3, eps, bn_training, momentum,
+                 data_format)
+    else:
+        sc = x
+    return F.relu(z + sc)
+
+
+class ResNetBasicBlock(Layer):
+    """Reference resnet_block.py:327."""
+
+    def __init__(self, num_channels1, num_filter1, filter1_size,
+                 num_channels2, num_filter2, filter2_size, num_channels3,
+                 num_filter3, filter3_size, stride1=1, stride2=1, stride3=1,
+                 act="relu", momentum=0.9, eps=1e-5, data_format="NCHW",
+                 has_shortcut=False, use_global_stats=False,
+                 is_test=False, filter1_attr=None, scale1_attr=None,
+                 bias1_attr=None, moving_mean1_name=None,
+                 moving_var1_name=None, filter2_attr=None, scale2_attr=None,
+                 bias2_attr=None, moving_mean2_name=None,
+                 moving_var2_name=None, filter3_attr=None, scale3_attr=None,
+                 bias3_attr=None, moving_mean3_name=None,
+                 moving_var3_name=None, padding1=0, padding2=0, padding3=0,
+                 dilation1=1, dilation2=1, dilation3=1,
+                 trainable_statistics=False, find_conv_max=True):
+        super().__init__()
+        if act != "relu":
+            raise NotImplementedError(
+                "ResNetBasicBlock only supports act='relu' (reference "
+                "kernel restriction)")
+        self._stride1, self._stride2, self._stride3 = stride1, stride2, \
+            stride3
+        # reference default: padding = (filter_size - 1) // 2 when 0
+        self._padding1 = padding1 or (filter1_size - 1) // 2
+        self._padding2 = padding2 or (filter2_size - 1) // 2
+        self._padding3 = padding3
+        self._dilation1, self._dilation2, self._dilation3 = dilation1, \
+            dilation2, dilation3
+        self._momentum, self._eps = momentum, eps
+        self._data_format = data_format
+        self._has_shortcut = has_shortcut
+        self._use_global_stats = use_global_stats
+        self._is_test = is_test
+
+        def conv_p(co, ci, k, attr):
+            std = (2.0 / (k * k * co)) ** 0.5
+            from ...nn.initializer import Normal
+            return self.create_parameter(
+                shape=[co, ci, k, k], attr=attr,
+                default_initializer=Normal(0.0, std))
+
+        def bn_p(c, scale_attr, bias_attr):
+            from ...nn.initializer import Constant
+            scale = self.create_parameter(
+                shape=[c], attr=scale_attr,
+                default_initializer=Constant(1.0))
+            bias = self.create_parameter(shape=[c], attr=bias_attr,
+                                         is_bias=True)
+            mean = self.create_parameter(
+                shape=[c], default_initializer=Constant(0.0))
+            mean.stop_gradient = True
+            var = self.create_parameter(
+                shape=[c], default_initializer=Constant(1.0))
+            var.stop_gradient = True
+            return scale, bias, mean, var
+
+        self.filter_1 = conv_p(num_filter1, num_channels1, filter1_size,
+                               filter1_attr)
+        self.scale_1, self.bias_1, self.mean_1, self.var_1 = bn_p(
+            num_filter1, scale1_attr, bias1_attr)
+        self.filter_2 = conv_p(num_filter2, num_channels2, filter2_size,
+                               filter2_attr)
+        self.scale_2, self.bias_2, self.mean_2, self.var_2 = bn_p(
+            num_filter2, scale2_attr, bias2_attr)
+        if has_shortcut:
+            self.filter_3 = conv_p(num_filter3, num_channels3, filter3_size,
+                                   filter3_attr)
+            self.scale_3, self.bias_3, self.mean_3, self.var_3 = bn_p(
+                num_filter3, scale3_attr, bias3_attr)
+        else:
+            self.filter_3 = self.scale_3 = self.bias_3 = None
+            self.mean_3 = self.var_3 = None
+
+    def forward(self, x):
+        return resnet_basic_block(
+            x, self.filter_1, self.scale_1, self.bias_1, self.mean_1,
+            self.var_1, self.filter_2, self.scale_2, self.bias_2,
+            self.mean_2, self.var_2, self.filter_3, self.scale_3,
+            self.bias_3, self.mean_3, self.var_3, self._stride1,
+            self._stride2, self._stride3, self._padding1, self._padding2,
+            self._padding3, self._dilation1, self._dilation2,
+            self._dilation3, 1, self._momentum, self._eps,
+            self._data_format, self._has_shortcut,
+            use_global_stats=self._use_global_stats,
+            training=not self._is_test)

@@ -300,3 +300,51 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
     os.makedirs(os.path.dirname(out) or '.', exist_ok=True)
     with open(out + '.pdmodel', 'wb') as f:
         pickle.dump(payload, f, protocol=4)
+
+
+def get_trt_compile_version():
+    """Reference inference/wrapper.py: TensorRT version the lib was built
+    with — (0, 0, 0) when built without TRT (TPU builds never have it)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    """Reference: runtime TRT version; (0, 0, 0) without TRT."""
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    """Reference inference/wrapper.py _get_phi_kernel_name: maps an op
+    name to its kernel-registry name. The YAML registry here uses the op
+    name itself as the kernel key."""
+    from ..ops.op_gen import load_registry
+    try:
+        names = {sc.name for sc in load_registry()}
+        if op_name not in names:
+            return op_name  # legacy/compat names pass through unchanged
+    except Exception:
+        pass
+    return op_name
+
+
+class XpuConfig:
+    """Reference paddle/inference XpuConfig struct: accelerator sub-config
+    knobs. On the TPU build the meaningful analog is device id + HBM
+    quota; other fields are accepted and recorded."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+        self.l3_ptr = None
+        self.l3_autotune_size = 0
+        self.conv_autotune_level = 0
+        self.fc_autotune_level = 0
+        self.gemm_compute_precision = 1
+        self.transformer_softmax_optimize_level = 0
+        self.transformer_encoder_adaptive_seqlen = True
+        self.quant_post_static_gelu_out_threshold = 10.0
+        self.quant_post_dynamic_activation_method = 0
+
+
+__all__ += ["get_trt_compile_version", "get_trt_runtime_version",
+            "_get_phi_kernel_name", "XpuConfig"]

@@ -221,3 +221,11 @@ def set_global_initializer(weight_init, bias_init=None):
 
 def _global_initializer(is_bias: bool):
     return _GLOBAL_INITIALIZER[1 if is_bias else 0]
+
+
+# reference nn/initializer/lazy_init.py exposes LazyGuard at this path
+import types as _types  # noqa: E402
+
+from ..framework.parameter import LazyGuard  # noqa: E402,F401
+
+lazy_init = _types.SimpleNamespace(LazyGuard=LazyGuard)

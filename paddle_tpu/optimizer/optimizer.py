@@ -120,19 +120,21 @@ class Optimizer:
 
     # -- core update --------------------------------------------------------
     def step(self):
-        params_grads = []
-        for p in self._parameter_list:
-            if p.stop_gradient or p._grad is None:
-                continue
-            params_grads.append((p, p._grad))
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
-        self._step_count += 1
-        self._step_tensor._data = self._step_tensor._data + 1.0
-        for p, g in params_grads:
-            if g is None:
-                continue
-            self._append_optimize_op(p, g)
+        from ..profiler.profiler import host_self_span
+        with host_self_span("optimizer_step(host)"):
+            params_grads = []
+            for p in self._parameter_list:
+                if p.stop_gradient or p._grad is None:
+                    continue
+                params_grads.append((p, p._grad))
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            self._step_count += 1
+            self._step_tensor._data = self._step_tensor._data + 1.0
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                self._append_optimize_op(p, g)
 
     def _append_optimize_op(self, param, grad):
         raise NotImplementedError

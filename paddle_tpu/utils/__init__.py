@@ -2,11 +2,12 @@
 
 from . import cpp_extension  # noqa: F401
 from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
 from . import unique_name  # noqa: F401
 from .deprecated import deprecated  # noqa: F401
 from .lazy_import import try_import  # noqa: F401
 
-__all__ = ["run_check", "cpp_extension", "deprecated", "try_import", "unique_name",
+__all__ = ["run_check", "cpp_extension", "deprecated", "try_import", "unique_name", "download",
            "dlpack", "require_version"]
 
 

@@ -139,3 +139,38 @@ class BuildExtension:
                                   ext.extra_link_args, self.build_directory,
                                   self.verbose))
         return outs
+
+
+def get_build_directory(verbose=False):
+    """Build cache root (reference extension_utils.py:896; honors
+    PADDLE_EXTENSION_DIR)."""
+    root = os.environ.get("PADDLE_EXTENSION_DIR", _DEFAULT_BUILD_DIR)
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """Reference cpp_extension.py:289. There is no CUDA toolchain in a TPU
+    build; the sources compile as host C++ (the reference likewise falls
+    back to CppExtension when compiled without CUDA)."""
+    return CppExtension(sources, *args, **kwargs)
+
+
+def setup(**attr):
+    """setuptools-style custom-op build entry (reference
+    cpp_extension.py:79): builds every ext_modules extension into the
+    build directory eagerly — the TPU build needs no wheel step because
+    ops register through the JAX FFI at load time."""
+    name = attr.get("name", "paddle_custom_ops")
+    exts = attr.get("ext_modules") or []
+    if not isinstance(exts, (list, tuple)):
+        exts = [exts]
+    for i, ext in enumerate(exts):
+        if ext.name is None:
+            ext.name = f"{name}_{i}" if len(exts) > 1 else name
+    builder = BuildExtension(list(exts),
+                             build_directory=attr.get("build_directory"))
+    return builder.build()
+
+
+__all__ += ["setup", "CUDAExtension", "get_build_directory"]

@@ -1,0 +1,66 @@
+"""Weights/file download cache (reference: python/paddle/utils/download.py).
+
+Zero-egress policy: a file already present in the cache (or given as a
+local path) is returned; an actual network fetch raises with a clear
+message instead of hanging."""
+
+from __future__ import annotations
+
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def is_url(path):
+    """Whether path is a URL (reference download.py:62)."""
+    return path.startswith("http://") or path.startswith("https://")
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    import hashlib
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Resolve a weights URL to a local cached path (reference
+    download.py:71). Only the cache lookup is supported — this build runs
+    with zero network egress, so a miss raises instead of downloading."""
+    if not is_url(url):
+        if osp.exists(url):
+            return url
+        raise FileNotFoundError(f"weights path {url} does not exist")
+    fname = osp.split(url)[-1]
+    fullname = osp.join(WEIGHTS_HOME, fname)
+    if osp.exists(fullname) and _md5check(fullname, md5sum):
+        return fullname
+    raise RuntimeError(
+        f"weights for {url} not found in cache ({fullname}) and network "
+        "download is unavailable in this environment; place the file there "
+        "manually")
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
+                      decompress=True, method="get"):
+    """Cache-only analog of reference download.py:117."""
+    if not is_url(url):
+        if osp.exists(url):
+            return url
+        raise FileNotFoundError(f"path {url} does not exist")
+    fullname = osp.join(root_dir, osp.split(url)[-1])
+    if check_exist and osp.exists(fullname) and _md5check(fullname, md5sum):
+        return fullname
+    raise RuntimeError(
+        f"{url} not found in {root_dir} and network download is unavailable "
+        "in this environment")
+
+
+os.makedirs(WEIGHTS_HOME, exist_ok=True)

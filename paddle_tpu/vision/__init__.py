@@ -6,6 +6,9 @@ from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
 from .models import (LeNet, ResNet, resnet18, resnet34,  # noqa: F401
                      resnet50)
+from .image import (set_image_backend, get_image_backend,  # noqa: F401
+                    image_load)
 
 __all__ = ["transforms", "models", "datasets", "ops", "LeNet", "ResNet",
-           "resnet18", "resnet34", "resnet50"]
+           "resnet18", "resnet34", "resnet50", "set_image_backend",
+           "get_image_backend", "image_load"]

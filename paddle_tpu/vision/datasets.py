@@ -148,3 +148,146 @@ class DatasetFolder(Dataset):
         if self.transform is not None:
             sample = self.transform(sample)
         return sample, np.int64(target)
+
+
+class ImageFolder(Dataset):
+    """Flat folder of images (reference datasets/folder.py ImageFolder):
+    every file under root that matches `extensions` (or passes
+    is_valid_file) is one unlabeled sample."""
+
+    _EXTS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+             ".tiff", ".webp")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if loader is None:
+            def loader(path):
+                from PIL import Image
+                with open(path, "rb") as f:
+                    return Image.open(f).convert("RGB")
+        self.loader = loader
+        exts = tuple(e.lower() for e in (extensions or self._EXTS))
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return p.lower().endswith(exts)
+        samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                p = os.path.join(dirpath, fn)
+                if is_valid_file(p):
+                    samples.append(p)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in {root} with supported extensions")
+        self.samples = samples
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers from local archives (reference
+    datasets/flowers.py; no network: pass data_file/label_file/setid_file
+    to the .tgz / .mat files)."""
+
+    _FLAGS = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        if not (data_file and label_file and setid_file):
+            raise ValueError(
+                "Flowers needs explicit data_file/label_file/setid_file "
+                "(no network download available)")
+        backend = backend or "pil"
+        if backend not in ("pil", "cv2"):
+            raise ValueError(
+                f"Expected backend are one of ['pil', 'cv2'], but got "
+                f"{backend}")
+        self.backend = backend
+        self.transform = transform
+        flag = self._FLAGS[mode.lower()]
+        import scipy.io as scio
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[flag][0]
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        import io as _io
+        from PIL import Image
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]]).astype("int64")
+        raw = self._tar.extractfile(
+            self._members["jpg/image_%05d.jpg" % index]).read()
+        image = Image.open(_io.BytesIO(raw))
+        if self.backend == "cv2":
+            image = np.array(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        if self.backend == "cv2":
+            return np.asarray(image, np.float32), label
+        return image, label
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs from the local tar (reference
+    datasets/voc2012.py)."""
+
+    _FLAGS = {"train": "trainval", "test": "train", "valid": "val"}
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _DATA = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LABEL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if not data_file:
+            raise ValueError("VOC2012 needs an explicit data_file "
+                             "(no network download available)")
+        backend = backend or "pil"
+        if backend not in ("pil", "cv2"):
+            raise ValueError(
+                f"Expected backend are one of ['pil', 'cv2'], but got "
+                f"{backend}")
+        self.backend = backend
+        self.transform = transform
+        flag = self._FLAGS[mode.lower()]
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        sets = self._tar.extractfile(self._members[self._SET.format(flag)])
+        self.data, self.labels = [], []
+        for line in sets:
+            name = line.strip().decode("utf-8")
+            self.data.append(self._DATA.format(name))
+            self.labels.append(self._LABEL.format(name))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        import io as _io
+        from PIL import Image
+        data = Image.open(_io.BytesIO(self._tar.extractfile(
+            self._members[self.data[idx]]).read()))
+        label = Image.open(_io.BytesIO(self._tar.extractfile(
+            self._members[self.labels[idx]]).read()))
+        if self.backend == "cv2":
+            data, label = np.array(data), np.array(label)
+        if self.transform is not None:
+            data = self.transform(data)
+        if self.backend == "cv2":
+            return data.astype(np.float32), label.astype(np.float32)
+        return data, label
+
+
+__all__ += ["ImageFolder", "Flowers", "VOC2012"]

@@ -1,0 +1,49 @@
+"""Image backend registry (reference: python/paddle/vision/image.py).
+
+Backends: 'pil' (PIL.Image), 'cv2' (opencv if installed), 'tensor'
+(decode_jpeg into a CHW uint8 Tensor)."""
+
+from __future__ import annotations
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Select the package used to load images (reference image.py:24)."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], but got "
+            f"{backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    """Current image-loading backend name (reference image.py:91)."""
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image via the selected backend (reference image.py:112):
+    'pil' -> PIL.Image, 'cv2' -> BGR ndarray, 'tensor' -> CHW uint8
+    Tensor."""
+    if backend is None:
+        backend = _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], but got "
+            f"{backend}")
+    if backend == "pil":
+        from PIL import Image
+        return Image.open(path)
+    if backend == "cv2":
+        try:
+            import cv2
+        except ImportError as e:
+            raise RuntimeError(
+                "image_load backend 'cv2' requires opencv-python") from e
+        return cv2.imread(path)
+    from .detection_ops import decode_jpeg, read_file
+    return decode_jpeg(read_file(path))

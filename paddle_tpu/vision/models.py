@@ -395,10 +395,11 @@ class _ShuffleUnit(nn.Layer):
     """ShuffleNetV2 building block (reference vision/models/shufflenetv2.py):
     channel split + depthwise conv branch + channel shuffle."""
 
-    def __init__(self, inp, oup, stride):
+    def __init__(self, inp, oup, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_c = oup // 2
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
         if stride == 1:
             in_branch = inp // 2
             self.branch1 = None
@@ -409,15 +410,15 @@ class _ShuffleUnit(nn.Layer):
                           bias_attr=False),
                 nn.BatchNorm2D(inp),
                 nn.Conv2D(inp, branch_c, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_c), nn.ReLU())
+                nn.BatchNorm2D(branch_c), act_layer())
         self.branch2 = nn.Sequential(
             nn.Conv2D(in_branch, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.BatchNorm2D(branch_c), act_layer(),
             nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
                       groups=branch_c, bias_attr=False),
             nn.BatchNorm2D(branch_c),
             nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_c), nn.ReLU())
+            nn.BatchNorm2D(branch_c), act_layer())
 
     @staticmethod
     def _shuffle(x, groups=2):
@@ -438,26 +439,30 @@ class _ShuffleUnit(nn.Layer):
 class ShuffleNetV2(nn.Layer):
     """ShuffleNetV2 (reference vision/models/shufflenetv2.py)."""
 
-    _CFG = {0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    _CFG = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+            0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
             1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048]}
 
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
         super().__init__()
         chans = self._CFG[scale]
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, chans[0], 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(chans[0]), nn.ReLU())
+            nn.BatchNorm2D(chans[0]), act_layer())
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         inp = chans[0]
         for out, repeat in zip(chans[1:4], (4, 8, 4)):
-            stages.append(_ShuffleUnit(inp, out, 2))
-            stages += [_ShuffleUnit(out, out, 1) for _ in range(repeat - 1)]
+            stages.append(_ShuffleUnit(inp, out, 2, act=act))
+            stages += [_ShuffleUnit(out, out, 1, act=act)
+                       for _ in range(repeat - 1)]
             inp = out
         self.stages = nn.Sequential(*stages)
         self.conv_last = nn.Sequential(
             nn.Conv2D(inp, chans[4], 1, bias_attr=False),
-            nn.BatchNorm2D(chans[4]), nn.ReLU())
+            nn.BatchNorm2D(chans[4]), act_layer())
         self.with_pool = with_pool
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
@@ -482,6 +487,26 @@ def shufflenet_v2_x0_5(pretrained=False, **kw):
     return ShuffleNetV2(scale=0.5, **kw)
 
 
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, act="swish", **kw)
+
+
 class _DenseLayer(nn.Layer):
     def __init__(self, inp, growth, bn_size):
         super().__init__()
@@ -499,8 +524,9 @@ class _DenseLayer(nn.Layer):
 class DenseNet(nn.Layer):
     """DenseNet (reference vision/models/densenet.py); layers: 121/169/201."""
 
-    _BLOCKS = {121: (6, 12, 24, 16), 169: (6, 12, 32, 32),
-               201: (6, 12, 48, 32), 264: (6, 12, 64, 48)}
+    _BLOCKS = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+               169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+               264: (6, 12, 64, 48)}
 
     def __init__(self, layers=121, growth_rate=32, bn_size=4,
                  num_classes=1000, with_pool=True):
@@ -543,6 +569,19 @@ def densenet121(pretrained=False, **kw):
 
 def densenet169(pretrained=False, **kw):
     return DenseNet(layers=169, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    kw.setdefault("growth_rate", 48)
+    return DenseNet(layers=161, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(layers=201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(layers=264, **kw)
 
 
 class _Inception(nn.Layer):
@@ -818,6 +857,22 @@ def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
     return MobileNetV3(_MBV3_LARGE, 960, 1280, scale=scale, **kw)
 
 
+class MobileNetV3Small(MobileNetV3):
+    """Reference vision/models/mobilenetv3.py MobileNetV3Small."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 576, 1024, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    """Reference vision/models/mobilenetv3.py MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 960, 1280, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
 # -- InceptionV3 (reference vision/models/inceptionv3.py) -------------------
 
 def _cbr(cin, cout, k, **kw):
@@ -952,6 +1007,10 @@ def inception_v3(pretrained=False, **kw):
     return InceptionV3(**kw)
 
 
+__all__ += ["MobileNetV3Small", "MobileNetV3Large", "densenet161",
+            "densenet201", "densenet264", "shufflenet_v2_x0_25",
+            "shufflenet_v2_x0_33", "shufflenet_v2_x1_5",
+            "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
 __all__ += ["resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
             "resnext101_64x4d", "resnext152_32x4d", "resnext152_64x4d",
             "wide_resnet50_2", "wide_resnet101_2", "MobileNetV1",
