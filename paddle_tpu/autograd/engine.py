@@ -10,6 +10,8 @@ cotangents. Leaf tensors (no producing node, stop_gradient=False) receive
 
 from __future__ import annotations
 
+import time as _time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -54,6 +56,28 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, create_graph=Fa
         grad_tensors = [None] * len(tensors)
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
+
+    # graph-break replay (jit/sot.py): the prefix program already ran this
+    # backward; the replayed loss carries no graph, so the re-executed
+    # Python `backward()` is a no-op (grads were written back as state)
+    from ..jit import sot
+    sot.probe_note_backward()
+    if sot._S.mode == "replay" and \
+            all(t._node is None for t in tensors):
+        return {}
+
+    from ..profiler.profiler import host_self_span
+    with host_self_span("backward_engine(host)"):
+        return _run_backward_impl(tensors, grad_tensors, retain_graph,
+                                  create_graph, inputs, accumulate_leaf,
+                                  allow_unused)
+
+
+def _run_backward_impl(tensors, grad_tensors, retain_graph, create_graph,
+                       inputs, accumulate_leaf, allow_unused):
+    from ..core.tensor import Tensor
+    from .function import apply_multi
+    from .grad_mode import set_grad_enabled
 
     # node -> list of per-output cotangents (Tensor or None)
     cot: dict[int, list] = {}
@@ -100,6 +124,9 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, create_graph=Fa
                 raise RuntimeError(
                     "trying to backward through the graph a second time; "
                     "set retain_graph=True if you need to")
+            from ..profiler.profiler import (op_timing_active,
+                                             record_op_time)
+            t0 = _time.perf_counter() if op_timing_active() else None
             # fill missing output cotangents with zeros; integer outputs take
             # float0 zeros as jax.vjp requires for non-differentiable outputs
             cts = []
@@ -135,6 +162,9 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, create_graph=Fa
                     *cts, name=f"{node.name}_grad")
             else:
                 raw = node.vjp_fn(tuple(raw_cts) if node.multi_out else raw_cts[0])
+                if t0 is not None:
+                    record_op_time(f"{node.name}_grad",
+                                   [r for r in raw if r is not None], t0)
                 in_cots = tuple(
                     None if r is None or
                     (hasattr(r, "dtype") and r.dtype == jax.dtypes.float0)

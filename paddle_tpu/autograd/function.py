@@ -10,6 +10,7 @@ is traceable, an entire eager forward+backward executes unchanged inside
 
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Callable, Sequence
 
 import jax
@@ -89,6 +90,13 @@ def _apply_impl(jfn, inputs, name, multi):
     from ..core.tensor import Tensor
     from ..amp.auto_cast import amp_state, cast_for_op
     from ..amp.debugging import record_op
+    from ..jit import sot
+    from ..profiler.profiler import op_timing_active, record_op_time
+
+    # span opens at dispatch entry: the op row carries the WHOLE ad_func
+    # cost (python dispatch + trace + device compute), like the reference's
+    # per-ad_func RecordEvent
+    t0 = _time.perf_counter() if op_timing_active() else None
 
     record_op(name)
     if amp_state().enabled:
@@ -97,12 +105,26 @@ def _apply_impl(jfn, inputs, name, multi):
         inner = jfn
         jfn = lambda *arrs: inner(*cast_for_op(name, arrs))  # noqa: E731
 
+    # graph-break replay: the compiled prefix already computed this op —
+    # hand back its results positionally (jit/sot.py)
+    if sot.replay_active():
+        arrays = sot.replay_pop(name)
+        wrapped = tuple(Tensor(o, stop_gradient=True) for o in arrays)
+        return wrapped if multi else wrapped[0]
+
     arrays = []
     tensor_in: list[Tensor | None] = []
     need = False
     grad_on = is_grad_enabled()
+    lazy_cells = []
     for a in inputs:
         if isinstance(a, Tensor):
+            cell = sot.pending_cell(a)
+            if cell is not None:
+                lazy_cells.append((len(arrays), cell))
+                arrays.append(cell)          # placeholder; resolved below
+                tensor_in.append(a)
+                continue
             arrays.append(a._data)
             tensor_in.append(a)
             if grad_on and not a.stop_gradient:
@@ -111,16 +133,36 @@ def _apply_impl(jfn, inputs, name, multi):
             arrays.append(a)
             tensor_in.append(None)
 
+    if not need and sot.span_mode_on():
+        deferred = sot.span_defer(jfn, name, arrays, lazy_cells, multi)
+        if deferred is not None:
+            return deferred if multi else deferred[0]
+
+    if lazy_cells:
+        # op not span-eligible: materialize pending inputs first
+        for idx, cell in lazy_cells:
+            if cell.value is None:
+                cell.span.flush()
+            arrays[idx] = cell.value
+
     if not need:
         out = jfn(*arrays)
         outs = out if multi else (out,)
+        if t0 is not None:
+            record_op_time(name, outs, t0)
         _check_nan_inf(name, outs)
+        if sot.probe_active():
+            sot.probe_record(name, outs)
         wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
         return wrapped if multi else wrapped[0]
 
     out, vjp_fn = jax.vjp(jfn, *arrays)
     outs = out if multi else (out,)
+    if t0 is not None:
+        record_op_time(name, outs, t0)
     _check_nan_inf(name, outs)
+    if sot.probe_active():
+        sot.probe_record(name, outs, needed=True)
     diffable = [jnp.issubdtype(o.dtype, jnp.inexact) for o in outs]
     if not any(diffable):
         # e.g. argmax of a differentiable input: nothing to record.

@@ -86,6 +86,8 @@ class StaticFunction:
         # this function eagerly instead of raising
         self._fallback = fallback
         self._fell_back = False
+        self._segmented: set = set()    # signature keys compiled in segments
+        self._seg_cache: dict = {}
         wraps(fn)(self)
 
     def recapture(self):
@@ -182,6 +184,9 @@ class StaticFunction:
             # Tensor kwargs: fold into args via sorted binding
             raise TypeError("to_static: pass Tensors positionally")
         key = (treedef, sig, kw_key)
+        if key in self._segmented:
+            return self._call_segmented(key, treedef, kwargs, args,
+                                        arg_arrays)
         if key not in self._state_by_key:
             # first time this signature is seen: one eager step that also
             # (re)discovers the state set, catching Tensors created lazily
@@ -205,22 +210,23 @@ class StaticFunction:
                 jax.errors.TracerIntegerConversionError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.ConcretizationTypeError) as e:
-            # data-dependent Python control flow: the discovery call ran it
-            # eagerly (values were concrete), but under the jit trace the
-            # branch condition is a tracer. Reference SOT breaks the graph
-            # and keeps the Python path; here the whole function falls back
-            # to eager — correctness over speed, loudly.
+            # data-dependent Python control flow: the branch condition is a
+            # tracer under jit. Reference SOT breaks the graph and keeps
+            # compiling around the break (jit/sot/translate.py:31); the
+            # segment path below does the same at op-stream granularity:
+            # compiled prefix + replay + span-compiled continuation.
             if not self._fallback:
                 raise
+            del self._cache[key]
+            self._segmented.add(key)
             import warnings
             warnings.warn(
                 f"to_static: {getattr(self._fn, '__name__', self._fn)!r} "
-                "uses data-dependent Python control flow and cannot be "
-                "compiled; falling back to EAGER execution for this "
-                f"function (SOT graph-break analog). Cause: "
+                "uses data-dependent Python control flow; compiling in "
+                "SEGMENTS around the graph break (SOT analog). Cause: "
                 f"{type(e).__name__}", UserWarning, stacklevel=2)
-            self._fell_back = True
-            return self._fn(*args, **kwargs)
+            return self._call_segmented(key, treedef, kwargs, args,
+                                        arg_arrays)
 
     def _run_compiled(self, jitted, cell, state_list, arg_arrays):
         state_arrays = []
@@ -249,7 +255,17 @@ class StaticFunction:
                     a.sharding.memory_kind != "device":
                 a = jax.device_put(a, a.sharding.with_memory_kind("device"))
             state_arrays.append(a)
-        new_state, out_flat = jitted(state_arrays, arg_arrays)
+        from ..profiler.profiler import op_timing_active, record_program
+        if op_timing_active():
+            import time as _t
+            t0 = _t.perf_counter()
+            new_state, out_flat = jitted(state_arrays, arg_arrays)
+            jax.block_until_ready(out_flat)
+            record_program(
+                f"to_static:{getattr(self._fn, '__name__', 'fn')}",
+                _t.perf_counter() - t0)
+        else:
+            new_state, out_flat = jitted(state_arrays, arg_arrays)
         for t, a in zip(state_list, new_state):
             # honor host-pinned state (ZeRO-offload): the compiled step
             # computed on device; park the updated state back in host memory
@@ -260,6 +276,157 @@ class StaticFunction:
             t._d = a
             t._node = None
         return jax.tree_util.tree_unflatten(cell["out_tree"], out_flat)
+
+    # -- graph-break segments (SOT analog; jit/sot.py) ---------------------
+    def _compile_prefix(self, treedef, kwargs_static, state_tensors):
+        """Trace fn until its first concretization request; the compiled
+        program returns (partial state, every op output so far)."""
+        from . import sot
+        fn = self._fn
+
+        def pure_prefix(state_arrays, arg_arrays):
+            saved = [t._d for t in state_tensors]
+            saved_nodes = [(t._node, t._out_index) for t in state_tensors]
+            saved_grads = [t._grad for t in state_tensors]
+            _trace_state.active = True
+            sot._S.mode = "probe"
+            sot._S.records = []
+            sot._S.probe_grad_ops = False
+            sot._S.probe_backward_ran = False
+            completed = False
+            out_flat, out_tree = [], None
+            try:
+                for t, a in zip(state_tensors, state_arrays):
+                    t._d = a
+                    t._node = None
+                args = jax.tree_util.tree_unflatten(treedef, arg_arrays)
+                try:
+                    out = fn(*args, **kwargs_static)
+                    completed = True
+                    out_flat, out_tree = jax.tree_util.tree_flatten(out)
+                except sot.GraphBreak:
+                    pass
+                new_state = [t._d for t in state_tensors]
+                recs = sot._S.records
+                rec_meta = [(n, len(outs)) for n, outs in recs]
+                rec_flat = [o for _, outs in recs for o in outs]
+            finally:
+                sot._S.mode = None
+                sot._S.records = None
+                _trace_state.active = False
+                for t, sv, (n, oi), g in zip(state_tensors, saved,
+                                             saved_nodes, saved_grads):
+                    t._d = sv
+                    t._node, t._out_index = n, oi
+                    t._grad = g
+            cell["rec_meta"] = rec_meta
+            cell["completed"] = completed
+            cell["out_tree"] = out_tree
+            # a break that truncates a LIVE grad graph (need-grad ops
+            # recorded but backward not yet run) would silently detach the
+            # replayed prefix from autograd — refuse segmentation there
+            cell["unsound"] = (not completed and sot._S.probe_grad_ops
+                               and not sot._S.probe_backward_ran)
+            return new_state, rec_flat, out_flat
+
+        cell = {}
+        return jax.jit(pure_prefix), cell
+
+    def _abandon_segments(self, key, state_list, init_state, args, kwargs):
+        """Graph break inside a live grad graph: segments would detach the
+        prefix from autograd (silent missing grads). Restore state and run
+        this function eagerly from now on — loudly."""
+        import warnings
+        warnings.warn(
+            f"to_static: {getattr(self._fn, '__name__', self._fn)!r} "
+            "breaks the graph BEFORE backward() consumes it; segment "
+            "replay would detach gradients, so this function runs EAGERLY "
+            "from now on", UserWarning, stacklevel=3)
+        for t, a in zip(state_list, init_state):
+            t._d = a
+            t._node = None
+        self._fell_back = True
+        self._segmented.discard(key)
+        return self._fn(*args, **kwargs)
+
+    def _call_segmented(self, key, treedef, kwargs, args, arg_arrays):
+        """Run: compiled prefix -> positional replay -> span-compiled
+        continuation. Any replay divergence restores state and reruns the
+        whole call eagerly (sound fallback)."""
+        from collections import deque
+
+        from . import sot
+
+        if key not in self._state_by_key:
+            out = self._discover(args, kwargs)
+            self._state_by_key[key] = list(self._state)
+            return out
+        state_list = self._state_by_key[key]
+        entry = self._seg_cache.get(key)
+        if entry is None:
+            entry = self._compile_prefix(treedef, dict(kwargs), state_list)
+            self._seg_cache[key] = entry
+            sot._STATS["prefix_compiles"] += 1
+        jitted, cell = entry
+        init_state = [t._d for t in state_list]
+        state_arrays = list(init_state)
+        if cell.get("unsound"):
+            return self._abandon_segments(key, state_list, init_state,
+                                          args, kwargs)
+        from ..profiler.profiler import op_timing_active, record_program
+        if op_timing_active():
+            import time as _t
+            t0 = _t.perf_counter()
+            new_state, rec_flat, out_flat = jitted(state_arrays, arg_arrays)
+            jax.block_until_ready(new_state)
+            record_program(
+                f"to_static_prefix:{getattr(self._fn, '__name__', 'fn')}",
+                _t.perf_counter() - t0)
+        else:
+            new_state, rec_flat, out_flat = jitted(state_arrays, arg_arrays)
+        sot._STATS["prefix_runs"] += 1
+        for t, a in zip(state_list, new_state):
+            t._d = a
+            t._node = None
+        if cell.get("unsound"):
+            # first call: the trace just ran inside jitted() and marked the
+            # break as grad-truncating; the prefix already mutated state —
+            # restore and run eagerly, permanently
+            return self._abandon_segments(key, state_list, init_state,
+                                          args, kwargs)
+        if cell["completed"]:
+            return jax.tree_util.tree_unflatten(cell["out_tree"], out_flat)
+        queue = deque()
+        i = 0
+        for n, c in cell["rec_meta"]:
+            queue.append((n, list(rec_flat[i:i + c])))
+            i += c
+        sot._S.mode = "replay"
+        sot._S.queue = queue
+        sot._S.spans_enabled = True
+        try:
+            out = self._fn(*args, **kwargs)
+            sot.flush_current_span()
+            return out
+        except sot._ReplayMismatch as e:
+            import warnings
+            warnings.warn(
+                f"to_static: segment replay diverged ({e}); falling back "
+                "to one eager re-run with restored state", UserWarning,
+                stacklevel=2)
+            for t, a in zip(state_list, init_state):
+                t._d = a
+                t._node = None
+            sot._S.mode = None
+            sot._S.queue = None
+            sot._S.spans_enabled = False
+            sot._S.span = None
+            return self._fn(*args, **kwargs)
+        finally:
+            sot._S.mode = None
+            sot._S.queue = None
+            sot._S.spans_enabled = False
+            sot._S.span = None
 
     def memory_analysis(self, *args, **kwargs):
         """Compile the step for these args and return XLA's memory analysis

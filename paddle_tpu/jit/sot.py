@@ -1,0 +1,465 @@
+"""Graph-break segment compilation for `to_static` (reference analog:
+python/paddle/jit/sot/translate.py:31 + the CPython eval-frame hook
+paddle/fluid/pybind/eval_frame.c:560).
+
+The reference's SOT interposes on bytecode: when a traced function hits
+data-dependent Python control flow it breaks the graph, compiles the ops
+recorded so far, runs the branch in Python, and resumes capturing. The
+TPU build reaches the same granularity at the *op-stream* level, without
+frame surgery, in two cooperating pieces:
+
+1. **Prefix segment** — when the whole-function jit trace hits a
+   concretization point (``bool(t)`` / ``int(t)`` / ``t.numpy()`` on a
+   tracer), the probe trace raises :class:`GraphBreak` *inside* the traced
+   function, where the tracers are still live. The traced wrapper catches
+   it and returns (partial state, every op output recorded so far) — so
+   everything up to the break compiles into ONE fused XLA program.
+   At call time the compiled prefix executes first; the function is then
+   re-run in **replay mode**, where the first N applies pop the prefix's
+   concrete results positionally instead of recomputing, and the break's
+   ``bool()`` now sees a concrete value, so the Python branch just runs.
+
+2. **Span compilation** — past the prefix the op stream executes through
+   lazy spans: `apply` defers ops into a span graph (outputs become
+   :class:`LazyTensor`), and a concretization request flushes the span
+   into a jitted program cached by the span's structural key (op code
+   objects + closure values + input avals). A decode loop with a Python
+   stop-condition therefore runs one compiled program per iteration after
+   the first — the matmul segments stay fused even though the loop breaks
+   the graph every step.
+
+Soundness guards: replay verifies op names positionally and falls back to
+a clean eager re-run (with restored state) on any mismatch; span cache
+keys include closure values recursively and refuse unhashable closures
+(those ops run eagerly); ops that need autograd flush the span and run
+eagerly so the grad graph is never deferred.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter, deque
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GraphBreak", "stats", "reset_stats"]
+
+
+class GraphBreak(Exception):
+    """Raised inside a probe trace at a data-dependent concretization."""
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+        super().__init__("to_static graph break")
+
+
+class _ReplayMismatch(Exception):
+    pass
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.mode = None          # None | "probe" | "replay"
+        self.records = None       # probe: [(name, [tracers])]
+        self.queue = None         # replay: deque[(name, [arrays])]
+        self.span = None          # active _Span (replay/continuation)
+        self.spans_enabled = False
+        self.probe_grad_ops = False      # probe saw need-grad ops
+        self.probe_backward_ran = False  # backward executed pre-break
+
+
+_S = _State()
+_STATS = Counter()
+
+
+def stats():
+    return dict(_STATS)
+
+
+def reset_stats():
+    _STATS.clear()
+
+
+# --------------------------------------------------------------------------
+# probe side
+# --------------------------------------------------------------------------
+
+def probe_active() -> bool:
+    return _S.mode == "probe"
+
+
+def probe_record(name, outs, needed=False):
+    _S.records.append((name, list(outs)))
+    if needed:
+        _S.probe_grad_ops = True
+
+
+def probe_note_backward():
+    if _S.mode == "probe":
+        _S.probe_backward_ran = True
+
+
+def maybe_break(tensor):
+    """Called from Tensor.numpy() — break the probe trace on a tracer."""
+    if _S.mode == "probe" and isinstance(tensor._d, jax.core.Tracer):
+        raise GraphBreak(tensor)
+
+
+# --------------------------------------------------------------------------
+# replay side
+# --------------------------------------------------------------------------
+
+def replay_active() -> bool:
+    return _S.mode == "replay" and _S.queue
+
+
+def replay_pop(name):
+    """Positional replay of a prefix op; raises on sequence divergence."""
+    rname, arrays = _S.queue.popleft()
+    if rname != name:
+        raise _ReplayMismatch(f"replay expected op {rname!r}, got {name!r}")
+    _STATS["replayed_ops"] += 1
+    return arrays
+
+
+# --------------------------------------------------------------------------
+# lazy spans
+# --------------------------------------------------------------------------
+
+_UNKEYABLE = object()
+
+
+def _key_of_value(v):
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, tuple):
+        parts = tuple(_key_of_value(e) for e in v)
+        return _UNKEYABLE if any(p is _UNKEYABLE for p in parts) else parts
+    if callable(v) and hasattr(v, "__code__"):
+        return _key_of_fn(v)
+    try:
+        if isinstance(v, (jnp.dtype,)) or hasattr(v, "name"):
+            hash(v)
+            return ("o", repr(v))
+    except TypeError:
+        pass
+    return _UNKEYABLE
+
+
+def _key_of_fn(fn):
+    """Structural identity of an op body: code object + closure values,
+    recursively. _UNKEYABLE if any closure cell holds something we cannot
+    soundly hash (an array, a mutable object)."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return _UNKEYABLE
+    cells = fn.__closure__ or ()
+    parts = []
+    for c in cells:
+        try:
+            k = _key_of_value(c.cell_contents)
+        except ValueError:          # empty cell
+            k = ("empty",)
+        if k is _UNKEYABLE:
+            return _UNKEYABLE
+        parts.append(k)
+    defaults = fn.__defaults__ or ()
+    dk = tuple(_key_of_value(d) for d in defaults)
+    if any(p is _UNKEYABLE for p in dk):
+        return _UNKEYABLE
+    return (code, tuple(parts), dk)
+
+
+_EVAL_SHAPE_CACHE: dict = {}
+_SPAN_PROGRAM_CACHE: dict = {}
+
+
+class _Cell:
+    """One pending op output inside a span."""
+
+    __slots__ = ("span", "op_idx", "out_idx", "aval", "value")
+
+    def __init__(self, span, op_idx, out_idx, aval):
+        self.span = span
+        self.op_idx = op_idx
+        self.out_idx = out_idx
+        self.aval = aval
+        self.value = None
+
+
+class _Rec:
+    __slots__ = ("key", "jfn", "in_refs", "multi", "out_avals")
+
+    def __init__(self, key, jfn, in_refs, multi, out_avals):
+        self.key = key
+        self.jfn = jfn
+        self.in_refs = in_refs
+        self.multi = multi
+        self.out_avals = out_avals
+
+
+class _Span:
+    """A deferred straight-line op graph, flushed into one jitted call."""
+
+    def __init__(self):
+        self.ops: list[_Rec] = []
+        self.ext: list = []            # external concrete inputs
+        self._ext_ids: dict[int, int] = {}
+        self.cells: list[_Cell] = []
+        self.flushed = False
+
+    def ext_ref(self, arr):
+        i = self._ext_ids.get(id(arr))
+        if i is None:
+            i = len(self.ext)
+            self.ext.append(arr)
+            self._ext_ids[id(arr)] = i
+        return ("ext", i)
+
+    def aval_of(self, ref):
+        if ref[0] == "ext":
+            a = self.ext[ref[1]]
+            return jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
+        raise KeyError(ref)
+
+    def add(self, key, jfn, in_refs, in_specs, multi, name):
+        aval_key = tuple(
+            (tuple(sp.shape), str(sp.dtype))
+            if isinstance(sp, jax.ShapeDtypeStruct)
+            else ("c", repr(sp)) for sp in in_specs)
+        ck = (name, key, aval_key)
+        out_avals = _EVAL_SHAPE_CACHE.get(ck)
+        if out_avals is None:
+            out = jax.eval_shape(jfn, *in_specs)
+            out_avals = tuple(out) if multi else (out,)
+            out_avals = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype)
+                              for o in out_avals)
+            _EVAL_SHAPE_CACHE[ck] = out_avals
+        op_idx = len(self.ops)
+        self.ops.append(_Rec(key, jfn, in_refs, multi, out_avals))
+        outs = []
+        for oi, av in enumerate(out_avals):
+            cell = _Cell(self, op_idx, oi, av)
+            self.cells.append(cell)
+            outs.append(cell)
+        return outs
+
+    def structure_key(self):
+        parts = []
+        for rec in self.ops:
+            parts.append((rec.key, tuple(rec.in_refs), rec.multi))
+        ext_avals = tuple((a.shape, str(a.dtype)) if hasattr(a, "shape")
+                          else ("py", repr(a)) for a in self.ext)
+        return (tuple(parts), ext_avals)
+
+    def flush(self):
+        if self.flushed:
+            return
+        self.flushed = True
+        if _S.span is self:
+            _S.span = None
+        if not self.ops:
+            return
+        skey = self.structure_key()
+        entry = _SPAN_PROGRAM_CACHE.get(skey)
+        if entry is None:
+            ops = list(self.ops)
+
+            def span_fn(ext_arrays):
+                vals: list[tuple] = []
+                for rec in ops:
+                    ins = []
+                    for r in rec.in_refs:
+                        if r[0] == "ext":
+                            ins.append(ext_arrays[r[1]])
+                        elif r[0] == "op":
+                            ins.append(vals[r[1]][r[2]])
+                        else:                      # ("const", value)
+                            ins.append(r[1])
+                    out = rec.jfn(*ins)
+                    vals.append(tuple(out) if rec.multi else (out,))
+                return [o for outs in vals for o in outs]
+
+            entry = jax.jit(span_fn)
+            _SPAN_PROGRAM_CACHE[skey] = entry
+            _STATS["span_compiles"] += 1
+        _STATS["span_runs"] += 1
+        from ..profiler.profiler import op_timing_active, record_program
+        if op_timing_active():
+            import time as _t
+            t0 = _t.perf_counter()
+            flat = entry(self.ext)
+            jax.block_until_ready(flat)
+            record_program(f"span_program[{len(self.ops)} ops]",
+                           _t.perf_counter() - t0)
+        else:
+            flat = entry(self.ext)
+        # bind results back into the cells (flat order == emission order)
+        offsets = []
+        i = 0
+        for rec in self.ops:
+            offsets.append(i)
+            i += len(rec.out_avals)
+        for cell in self.cells:
+            cell.value = flat[offsets[cell.op_idx] + cell.out_idx]
+        self.ops = []
+
+
+def span_mode_on() -> bool:
+    return _S.spans_enabled
+
+
+def span_defer(jfn, name, arrays, lazy_cells, multi):
+    """Defer one apply() op into the active span; returns a tuple of
+    LazyTensors, or None when the op cannot be soundly keyed (the caller
+    then executes it eagerly)."""
+    key = _key_of_fn(jfn)
+    if key is _UNKEYABLE:
+        _STATS["unkeyable_ops"] += 1
+        return None
+    span = current_span()
+    if len(span.ops) >= 512:           # bound trace size per program
+        span.flush()
+        span = current_span()
+    in_refs = []
+    in_specs = []
+    for a in arrays:
+        if isinstance(a, _Cell):
+            if a.value is not None:
+                ref = span.ext_ref(a.value)
+                in_refs.append(ref)
+                in_specs.append(span.aval_of(ref))
+            else:
+                # the only unflushed span is the active one
+                if a.span is not span:
+                    a.span.flush()
+                    ref = span.ext_ref(a.value)
+                    in_refs.append(ref)
+                    in_specs.append(span.aval_of(ref))
+                else:
+                    in_refs.append(("op", a.op_idx, a.out_idx))
+                    in_specs.append(a.aval)
+        elif isinstance(a, (jax.Array,)) or hasattr(a, "shape"):
+            ref = span.ext_ref(a)
+            in_refs.append(ref)
+            in_specs.append(span.aval_of(ref))
+        elif isinstance(a, (bool, int, float)) or a is None:
+            in_refs.append(("const", a))
+            in_specs.append(a)
+        else:
+            _STATS["unkeyable_ops"] += 1
+            return None
+    cells = span.add(key, jfn, in_refs, in_specs, multi, name)
+    LT = lazy_tensor_cls()
+    _STATS["deferred_ops"] += 1
+    return tuple(LT(c) for c in cells)
+
+
+def current_span() -> _Span:
+    if _S.span is None or _S.span.flushed:
+        _S.span = _Span()
+    return _S.span
+
+
+def flush_current_span():
+    if _S.span is not None:
+        _S.span.flush()
+
+
+# --------------------------------------------------------------------------
+# LazyTensor
+# --------------------------------------------------------------------------
+
+def _make_lazy_tensor_class():
+    from ..core.tensor import Tensor
+    d_slot = Tensor.__dict__["_d"]
+
+    class LazyTensor(Tensor):
+        """A Tensor whose array is a pending span output; any access to
+        the storage flushes the span (compiling it)."""
+
+        __slots__ = ("_cell",)
+
+        def __init__(self, cell, name=None):
+            self._cell = cell
+            d_slot.__set__(self, None)
+            self.stop_gradient = True
+            self._grad = None
+            self._node = None
+            self._out_index = 0
+            self._hooks = []
+            if name is None:
+                Tensor._iid += 1
+                name = f"lazy_tensor_{Tensor._iid}"
+            self.name = name
+            self.persistable = False
+            self._sharding_spec = None
+
+        # storage: flush-on-touch
+        @property
+        def _d(self):
+            cell = self._cell
+            if cell is not None:
+                if cell.value is None:
+                    cell.span.flush()
+                d_slot.__set__(self, cell.value)
+                self._cell = None
+            return d_slot.__get__(self)
+
+        @_d.setter
+        def _d(self, value):
+            self._cell = None
+            d_slot.__set__(self, value)
+
+        # aval-backed metadata (no flush)
+        @property
+        def shape(self):
+            c = self._cell
+            if c is not None and c.value is None:
+                return list(c.aval.shape)
+            return list(self._d.shape)
+
+        @property
+        def ndim(self):
+            c = self._cell
+            if c is not None and c.value is None:
+                return len(c.aval.shape)
+            return self._d.ndim
+
+        @property
+        def size(self):
+            import math
+            c = self._cell
+            if c is not None and c.value is None:
+                return int(math.prod(c.aval.shape))
+            return int(self._d.size)
+
+        @property
+        def dtype(self):
+            from ..core import dtypes
+            c = self._cell
+            if c is not None and c.value is None:
+                return dtypes.dtype_from_any(c.aval.dtype)
+            return dtypes.dtype_from_any(self._d.dtype)
+
+    return LazyTensor
+
+
+LazyTensor = None
+
+
+def lazy_tensor_cls():
+    global LazyTensor
+    if LazyTensor is None:
+        LazyTensor = _make_lazy_tensor_class()
+    return LazyTensor
+
+
+def pending_cell(t):
+    """The unresolved span cell of a LazyTensor, else None."""
+    if LazyTensor is not None and isinstance(t, LazyTensor):
+        c = t._cell
+        if c is not None and c.value is None:
+            return c
+    return None
