@@ -516,3 +516,6 @@ def fused_gate_attention(query, key=None, query_weight=None, key_weight=None,
     if out_linear_bias is not None:
         args.append(as_tensor(out_linear_bias))
     return apply(f, *args, name="fused_gate_attention")
+
+
+from .fused_transformer_serving import fused_multi_transformer  # noqa: F401,E402

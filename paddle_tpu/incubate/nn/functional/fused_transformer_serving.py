@@ -1,0 +1,239 @@
+"""Serving-path fused transformer stack (reference:
+python/paddle/incubate/nn/functional/fused_transformer.py
+fused_multi_transformer :973 over the fused_multi_transformer CUDA op,
+paddle/phi/kernels/fusion/gpu/fused_multi_transformer_*).
+
+TPU design: the whole L-layer stack is one traced composition —
+fused LN + QKV/out projections (MXU matmuls), rotary, attention, and the
+FFN ride XLA fusion; the decode step (`time_step` given) dispatches to
+the Pallas mmha kernel (ops/kernels/mmha_pallas.py) when the cache shape
+qualifies, exactly like models/generation.py's cached_attention. The
+reference's [2, B, H, T, D] cache layout is kept so serving code ports
+unchanged."""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["fused_multi_transformer"]
+
+
+def _attention(q, k, v, attn_mask, kernel_ok, pos=None, seq_lens=None,
+               n_pre=0):
+    """q/k/v jnp [B, S, H, D]; full-sequence attention (prefill) or, when
+    pos is given, cached decode where k/v are the FULL cache buffers
+    [B, H, T, D]. In prefill, k/v may carry `n_pre` prefix-cache positions
+    ahead of the live sequence; `seq_lens` [B] masks padded tail
+    positions."""
+    import jax
+    import jax.numpy as jnp
+
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    if pos is None:
+        t = k.shape[1]                     # n_pre + s
+        logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        if attn_mask is not None and n_pre == 0:
+            logits = logits + attn_mask.astype(jnp.float32)
+        else:
+            # causal over the live block; the prefix block is fully visible
+            qpos = jnp.arange(s)[:, None] + n_pre
+            kpos = jnp.arange(t)[None, :]
+            causal = kpos <= qpos
+            logits = jnp.where(causal[None, None], logits, -jnp.inf)
+        if seq_lens is not None:
+            valid_k = jnp.arange(t)[None, :] <                 (seq_lens.reshape(b, 1) + n_pre)
+            logits = jnp.where(valid_k[:, None, None, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+        return out.astype(q.dtype)
+    # decode: k/v are cache buffers [B, H, T, D]; attend to <= pos
+    from ....ops.kernels import _common as kern
+    from ....ops.kernels import mmha_pallas
+    if kernel_ok and mmha_pallas.use_kernel(q.shape, k.shape, k.dtype):
+        return mmha_pallas.mmha_decode(q, k, v, pos,
+                                       interpret=kern.interpret_mode())
+    t = k.shape[2]
+    logits = jnp.einsum("bshd,bhtd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    valid = jnp.arange(t)[None, None, None, :] <= pos
+    logits = jnp.where(valid, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhst,bhtd->bshd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _apply_rotary(q, k, cos, sin):
+    """rotate-half RoPE (reference fused_multi_transformer rotary path);
+    cos/sin broadcast [B, 1, S, D] -> applied on [B, S, H, D]."""
+    import jax.numpy as jnp
+
+    def rot(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-x2, x1], axis=-1)
+
+    c = jnp.swapaxes(cos, 1, 2)    # [B, S, 1, D]
+    s = jnp.swapaxes(sin, 1, 2)
+    return q * c + rot(q) * s, k * c + rot(k) * s
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, seq_lens=None,
+        rotary_embs=None, time_step=None, attn_mask=None, dropout_rate=0.0,
+        rotary_emb_dims=0, activation="gelu", training=False,
+        mode="upscale_in_train", trans_qkvw=True, ring_id=-1, name=None):
+    """Reference fused_transformer.py:973. Returns `out` or
+    `(out, cache_kvs)` when caches are given (functional: the returned
+    caches are the updated buffers)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from ....autograd.function import apply, apply_multi
+    from ....core.tensor import as_tensor
+    from ....nn import functional as F
+
+    num_layers = len(qkv_weights)
+    b, s, d_model = (int(v) for v in x.shape)
+    use_cache = cache_kvs is not None
+    decode = time_step is not None
+    if decode:
+        ts = as_tensor(time_step)._data.reshape(()).astype("int32") \
+            if not isinstance(time_step, int) else time_step
+
+    def act_fn(v):
+        return F.gelu(v) if activation == "gelu" else F.relu(v)
+
+    def maybe_dropout(v):
+        if training and dropout_rate > 0.0:
+            return F.dropout(v, p=dropout_rate, training=True, mode=mode)
+        if not training and dropout_rate > 0.0 and mode == "downscale_in_infer":
+            return v * (1.0 - dropout_rate)
+        return v
+
+    out = x
+    new_caches = []
+    for i in range(num_layers):
+        residual = out
+        if pre_layer_norm:
+            ln_out = F.layer_norm(out, [d_model], weight=ln_scales[i],
+                                  bias=ln_biases[i] if ln_biases else None,
+                                  epsilon=epsilon)
+        else:
+            ln_out = out
+        qkv_w = as_tensor(qkv_weights[i])
+        nh = int(qkv_w.shape[1]) if trans_qkvw else int(qkv_w.shape[2])
+        hd = int(qkv_w.shape[2]) if trans_qkvw else int(qkv_w.shape[3])
+
+        def qkv_proj(xa, wa, *rest):
+            w = wa.reshape(3 * nh * hd, d_model).T if trans_qkvw \
+                else wa.reshape(d_model, 3 * nh * hd)
+            y = xa @ w
+            if rest:
+                y = y + rest[0].reshape(-1)
+            return y.reshape(xa.shape[0], xa.shape[1], 3, nh, hd)
+
+        qkv_args = (ln_out, qkv_w) + \
+            ((as_tensor(qkv_biases[i]),) if qkv_biases else ())
+        qkv = apply(qkv_proj, *qkv_args, name="fmt_qkv_proj")
+
+        def attn_step(qkva, *rest):
+            it = iter(rest)
+            cka = next(it) if use_cache else None
+            pca = next(it) if pre_caches is not None else None
+            sla = next(it) if seq_lens is not None else None
+            rot = next(it) if rotary_embs is not None else None
+            msk = next(it) if attn_mask is not None else None
+            q = qkva[:, :, 0]
+            k = qkva[:, :, 1]
+            v = qkva[:, :, 2]                      # [B, S, NH, HD]
+            if rot is not None and rotary_emb_dims > 0:
+                q, k = _apply_rotary(q, k, rot[0], rot[1])
+            n_pre = 0
+            if pca is not None:
+                # pre_caches [2, B, NH, C, HD]: prefix context prepends to
+                # this layer's keys/values in prefill
+                if decode:
+                    raise NotImplementedError(
+                        "pre_caches with time_step decode is not supported "
+                        "— prefill with the prefix first, then decode from "
+                        "cache_kvs")
+                n_pre = pca.shape[3]
+                k = jnp.concatenate([jnp.swapaxes(pca[0], 1, 2), k], axis=1)
+                v = jnp.concatenate([jnp.swapaxes(pca[1], 1, 2), v], axis=1)
+            if cka is None:
+                return (_attention(q, k, v, msk, kernel_ok=False,
+                                   seq_lens=sla, n_pre=n_pre)
+                        .reshape(b, s, nh * hd),)
+            kbuf, vbuf = cka[0], cka[1]            # [B, NH, T, HD]
+            z = jnp.int32(0)
+            start = jnp.asarray(ts if decode else 0, jnp.int32)
+            kbuf = jax.lax.dynamic_update_slice(
+                kbuf, jnp.swapaxes(k, 1, 2).astype(kbuf.dtype),
+                (z, z, start, z))
+            vbuf = jax.lax.dynamic_update_slice(
+                vbuf, jnp.swapaxes(v, 1, 2).astype(vbuf.dtype),
+                (z, z, start, z))
+            if decode:
+                att = _attention(q, kbuf, vbuf, None, kernel_ok=True,
+                                 pos=start)
+            else:
+                att = _attention(q, k, v, msk, kernel_ok=False,
+                                 seq_lens=sla, n_pre=n_pre)
+            return att.reshape(b, s, nh * hd), jnp.stack([kbuf, vbuf])
+
+        attn_args = [qkv]
+        if use_cache:
+            attn_args.append(as_tensor(cache_kvs[i]))
+        if pre_caches is not None:
+            attn_args.append(as_tensor(pre_caches[i]))
+        if seq_lens is not None:
+            attn_args.append(as_tensor(seq_lens))
+        if rotary_embs is not None:
+            attn_args.append(as_tensor(rotary_embs))
+        if attn_mask is not None:
+            attn_args.append(as_tensor(attn_mask))
+        if use_cache:
+            att, new_ck = apply_multi(attn_step, *attn_args,
+                                      name="fmt_attention")
+            new_caches.append(new_ck)
+        else:
+            (att,) = apply_multi(attn_step, *attn_args, name="fmt_attention")
+
+        att_out = paddle.matmul(att, as_tensor(linear_weights[i]))
+        if linear_biases:
+            att_out = att_out + as_tensor(linear_biases[i])
+        att_out = maybe_dropout(att_out)
+        out = residual + att_out
+        if not pre_layer_norm:
+            out = F.layer_norm(out, [d_model], weight=ln_scales[i],
+                               bias=ln_biases[i] if ln_biases else None,
+                               epsilon=epsilon)
+
+        ffn_residual = out
+        if pre_layer_norm:
+            ffn_in = F.layer_norm(out, [d_model], weight=ffn_ln_scales[i],
+                                  bias=ffn_ln_biases[i] if ffn_ln_biases
+                                  else None, epsilon=epsilon)
+        else:
+            ffn_in = out
+        h1 = paddle.matmul(ffn_in, as_tensor(ffn1_weights[i]))
+        if ffn1_biases:
+            h1 = h1 + as_tensor(ffn1_biases[i])
+        h1 = maybe_dropout(act_fn(h1))
+        h2 = paddle.matmul(h1, as_tensor(ffn2_weights[i]))
+        if ffn2_biases:
+            h2 = h2 + as_tensor(ffn2_biases[i])
+        out = ffn_residual + maybe_dropout(h2)
+        if not pre_layer_norm:
+            out = F.layer_norm(out, [d_model], weight=ffn_ln_scales[i],
+                               bias=ffn_ln_biases[i] if ffn_ln_biases
+                               else None, epsilon=epsilon)
+
+    if use_cache:
+        return out, new_caches
+    return out

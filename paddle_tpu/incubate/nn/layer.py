@@ -243,25 +243,103 @@ class FusedTransformerEncoderLayer(Layer):
 
 
 class FusedMultiTransformer(Layer):
-    """Reference fused_transformer.py FusedMultiTransformer: a stack of
-    fused encoder layers driven as one module (the serving fast path)."""
+    """Reference incubate/nn/layer/fused_transformer.py
+    FusedMultiTransformer (:1040): per-layer parameter lists driving ONE
+    fused serving op (functional.fused_multi_transformer), including the
+    [2, B, H, T, D] KV caches and decode `time_step`."""
 
-    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
-                 activation="gelu", normalize_before=True, num_layers=1,
-                 epsilon=1e-5, name=None):
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, name=None):
         super().__init__()
-        from ...nn import LayerList
-        self.layers = LayerList([
-            FusedTransformerEncoderLayer(
-                embed_dim, num_heads, dim_feedforward,
-                dropout_rate=dropout_rate, activation=activation,
-                normalize_before=normalize_before, epsilon=epsilon)
-            for _ in range(num_layers)])
+        from ...nn.initializer import Constant
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) \
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        head_dim = embed_dim // num_heads
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._trans_qkvw = trans_qkvw
+        self._dropout_rate = dropout_rate
+        self.activation = activation
 
-    def forward(self, src, attn_mask=None, caches=None):
-        for layer in self.layers:
-            src = layer(src, src_mask=attn_mask)
-        return src
+        def attr_at(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        (self.ln_scales, self.ln_biases, self.qkv_weights, self.qkv_biases,
+         self.linear_weights, self.linear_biases, self.ffn_ln_scales,
+         self.ffn_ln_biases, self.ffn1_weights, self.ffn1_biases,
+         self.ffn2_weights, self.ffn2_biases) = ([] for _ in range(12))
+        for i in range(num_layers):
+            self.ln_scales.append(self.create_parameter(
+                [embed_dim], attr=attr_at(ln_scale_attrs, i),
+                default_initializer=Constant(1.0)))
+            self.ln_biases.append(self.create_parameter(
+                [embed_dim], attr=attr_at(ln_bias_attrs, i), is_bias=True))
+            qkv_shape = [3, num_heads, head_dim, embed_dim] if trans_qkvw \
+                else [embed_dim, 3, num_heads, head_dim]
+            self.qkv_weights.append(self.create_parameter(
+                qkv_shape, attr=attr_at(qkv_weight_attrs, i)))
+            self.qkv_biases.append(self.create_parameter(
+                [3, num_heads, head_dim], attr=attr_at(qkv_bias_attrs, i),
+                is_bias=True))
+            self.linear_weights.append(self.create_parameter(
+                [num_heads * head_dim, embed_dim],
+                attr=attr_at(linear_weight_attrs, i)))
+            self.linear_biases.append(self.create_parameter(
+                [embed_dim], attr=attr_at(linear_bias_attrs, i),
+                is_bias=True))
+            self.ffn_ln_scales.append(self.create_parameter(
+                [embed_dim], attr=attr_at(ffn_ln_scale_attrs, i),
+                default_initializer=Constant(1.0)))
+            self.ffn_ln_biases.append(self.create_parameter(
+                [embed_dim], attr=attr_at(ffn_ln_bias_attrs, i),
+                is_bias=True))
+            self.ffn1_weights.append(self.create_parameter(
+                [embed_dim, dim_feedforward],
+                attr=attr_at(ffn1_weight_attrs, i)))
+            self.ffn1_biases.append(self.create_parameter(
+                [dim_feedforward], attr=attr_at(ffn1_bias_attrs, i),
+                is_bias=True))
+            self.ffn2_weights.append(self.create_parameter(
+                [dim_feedforward, embed_dim],
+                attr=attr_at(ffn2_weight_attrs, i)))
+            self.ffn2_biases.append(self.create_parameter(
+                [embed_dim], attr=attr_at(ffn2_bias_attrs, i), is_bias=True))
+        # register the per-layer lists as sublayer parameters
+        for lname in ("ln_scales", "ln_biases", "qkv_weights", "qkv_biases",
+                      "linear_weights", "linear_biases", "ffn_ln_scales",
+                      "ffn_ln_biases", "ffn1_weights", "ffn1_biases",
+                      "ffn2_weights", "ffn2_biases"):
+            for j, p in enumerate(getattr(self, lname)):
+                self.add_parameter(f"{lname}_{j}", p)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        from .functional import fused_multi_transformer
+        out = fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self._epsilon,
+            cache_kvs=caches, pre_caches=pre_caches, seq_lens=seq_lens,
+            rotary_embs=rotary_embs, time_step=time_step,
+            attn_mask=attn_mask, dropout_rate=self._dropout_rate,
+            rotary_emb_dims=rotary_emb_dims, activation=self.activation,
+            training=self.training, trans_qkvw=self._trans_qkvw)
+        return out
 
 
 class FusedEcMoe(Layer):

@@ -13,14 +13,22 @@ from ...quantization.functional import (  # noqa: F401
     fake_quant,
     quantize_weight_int8,
 )
+from . import quant_layers  # noqa: F401
+from .quant_layers import (  # noqa: F401
+    FakeQuantAbsMax, FakeQuantChannelWiseAbsMax, FakeQuantMAOutputScaleLayer,
+    FakeQuantMovingAverageAbsMax, MAOutputScaleLayer,
+    MovingAverageAbsMaxScale, QuantizedColumnParallelLinear, QuantizedConv2D,
+    QuantizedConv2DTranspose, QuantizedLinear, QuantizedMatmul,
+    QuantizedRowParallelLinear)
 from .quantized_linear import (  # noqa: F401
+    llm_int8_linear,
     weight_dequantize,
     weight_only_linear,
     weight_quantize,
 )
 from ..layer import Layer
 
-__all__ = ['Stub', 'QuantStub', 'weight_quantize', 'fake_quant',
+__all__ = ['Stub', 'QuantStub', 'weight_quantize', 'fake_quant', 'llm_int8_linear',
            'weight_dequantize', 'weight_only_linear',
            'absmax_scale', 'dequant_matmul_int8', 'quantize_weight_int8',
            'QuantedLinear', 'Int8WeightOnlyLinear',
@@ -42,5 +50,6 @@ class Stub(Layer):
 QuantStub = Stub
 
 
-def quant_layers():
+def quanted_layer_types():
+    """Layer classes produced by quantization wrapping."""
     return [QuantedLinear, Int8WeightOnlyLinear]

@@ -1,0 +1,281 @@
+"""QAT fake-quant layers (reference: python/paddle/nn/quant/
+quant_layers.py — FakeQuantAbsMax :51, FakeQuantMovingAverageAbsMax :152,
+FakeQuantChannelWiseAbsMax :285, MovingAverageAbsMaxScale :393,
+QuantizedConv2D :509, QuantizedConv2DTranspose :~620, QuantizedLinear
+:726, QuantizedColumnParallelLinear / QuantizedRowParallelLinear,
+QuantizedMatmul, MAOutputScaleLayer, FakeQuantMAOutputScaleLayer).
+
+All quant-dequant runs with a straight-through estimator
+(quantization/functional.fake_quant_array), so these layers train inside
+jitted steps; the moving-average scale state updates functionally."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...autograd.function import apply
+from ...quantization.functional import absmax_scale, fake_quant_array
+from ..layer import Layer
+
+__all__ = [
+    "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+    "FakeQuantChannelWiseAbsMax", "FakeQuantMAOutputScaleLayer",
+    "MAOutputScaleLayer", "MovingAverageAbsMaxScale", "QuantizedConv2D",
+    "QuantizedConv2DTranspose", "QuantizedLinear", "QuantizedMatmul",
+    "QuantizedColumnParallelLinear", "QuantizedRowParallelLinear",
+]
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor absmax quant-dequant (reference quant_layers.py:51)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32",
+                 quant_on_weight=False, reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def forward(self, x):
+        def f(a):
+            return fake_quant_array(a, absmax_scale(a), self._quant_bits)
+        return apply(f, x, name="fake_quant_abs_max")
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-channel absmax quant-dequant (reference quant_layers.py:285)."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 quant_axis=0, dtype="float32", quant_on_weight=False,
+                 reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._quant_axis = quant_axis
+
+    def forward(self, x):
+        ax = self._quant_axis
+
+        def f(a):
+            scale = absmax_scale(a, axis=ax)
+            shape = [1] * a.ndim
+            shape[ax] = -1
+            return fake_quant_array(a, scale.reshape(shape),
+                                    self._quant_bits)
+        return apply(f, x, name="fake_quant_channel_wise_abs_max")
+
+
+class _MovingScale(Layer):
+    """Shared moving-average absmax scale state:
+    scale = (r*accum + max|x|) / (r*state + 1) (reference :157)."""
+
+    def __init__(self, moving_rate=0.9):
+        super().__init__()
+        import paddle_tpu as paddle
+        self._moving_rate = moving_rate
+        self._accum = paddle.to_tensor(jnp.zeros((), jnp.float32))
+        self._state = paddle.to_tensor(jnp.zeros((), jnp.float32))
+
+    def update(self, x):
+        r = self._moving_rate
+        cur = x.abs().max().cast("float32")
+        if self.training:
+            new_accum = apply(lambda a, c: r * a + c, self._accum, cur,
+                              name="ma_scale_accum")
+            new_state = apply(lambda s: r * s + 1.0, self._state,
+                              name="ma_scale_state")
+            self._accum._d = new_accum._d
+            self._state._d = new_state._d
+        scale = apply(
+            lambda a, s: jnp.where(s > 0, a / jnp.maximum(s, 1e-9),
+                                   jnp.ones((), jnp.float32)),
+            self._accum, self._state, name="ma_scale")
+        return scale
+
+    @property
+    def scale(self):
+        import paddle_tpu as paddle
+        return paddle.to_tensor(
+            self._accum._d / jnp.maximum(self._state._d, 1e-9))
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Reference quant_layers.py:152."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32", reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._ma = _MovingScale(moving_rate)
+
+    def forward(self, x):
+        scale = self._ma.update(x)
+        bits = self._quant_bits
+        return apply(lambda a, s: fake_quant_array(a, s, bits), x, scale,
+                     name="fake_quant_moving_average_abs_max")
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Maintains the output scale only; x passes through (reference
+    quant_layers.py:393)."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32",
+                 reduce_type=None):
+        super().__init__()
+        self._ma = _MovingScale(moving_rate)
+
+    @property
+    def scale(self):
+        return self._ma.scale
+
+    def forward(self, x):
+        self._ma.update(x)
+        return x
+
+
+class MAOutputScaleLayer(Layer):
+    """Wrap a layer, tracking its output scale (reference
+    quant_layers.py MAOutputScaleLayer)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, name=None,
+                 dtype="float32", reduce_type=None):
+        super().__init__()
+        self._layer = layer
+        self._ma_output_scale = MovingAverageAbsMaxScale(
+            name, moving_rate, dtype)
+
+    def forward(self, *args, **kwargs):
+        out = self._layer(*args, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return out
+        return self._ma_output_scale(out)
+
+
+class FakeQuantMAOutputScaleLayer(Layer):
+    """Wrap a layer, fake-quantizing its output with a moving-average
+    scale (reference quant_layers.py FakeQuantMAOutputScaleLayer)."""
+
+    def __init__(self, layer=None, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, name=None, reduce_type=None, *args,
+                 **kwargs):
+        super().__init__()
+        self._layer = layer
+        self._fake_quant_output = FakeQuantMovingAverageAbsMax(
+            name, moving_rate, quant_bits=activation_bits)
+
+    def forward(self, *args, **kwargs):
+        out = self._layer(*args, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return out
+        return self._fake_quant_output(out)
+
+
+def _make_weight_quanter(weight_quantize_type, weight_bits, quant_axis=0):
+    if weight_quantize_type == "channel_wise_abs_max":
+        return FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
+                                          quant_axis=quant_axis)
+    if weight_quantize_type == "moving_average_abs_max":
+        return FakeQuantMovingAverageAbsMax(quant_bits=weight_bits)
+    return FakeQuantAbsMax(quant_bits=weight_bits, quant_on_weight=True)
+
+
+class _QuantizedWrapper(Layer):
+    """Common: fake-quant activation + weight, call the float layer's
+    functional body with the quantized pair (the reference Quantized*
+    classes follow exactly this shape)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quant_axis=0, **kwargs):
+        super().__init__()
+        self._layer = layer
+        self.weight = getattr(layer, "weight", None)
+        self.bias = getattr(layer, "bias", None)
+        if activation_quantize_type == "moving_average_abs_max":
+            self._fake_quant_input = FakeQuantMovingAverageAbsMax(
+                moving_rate=moving_rate, quant_bits=activation_bits)
+        else:
+            self._fake_quant_input = FakeQuantAbsMax(
+                quant_bits=activation_bits)
+        self._fake_quant_weight = _make_weight_quanter(
+            weight_quantize_type, weight_bits, weight_quant_axis)
+
+    def _quant_pair(self, x):
+        qx = self._fake_quant_input(x)
+        qw = self._fake_quant_weight(self.weight)
+        return qx, qw
+
+
+class QuantizedConv2D(_QuantizedWrapper):
+    """Reference quant_layers.py:509."""
+
+    def forward(self, x):
+        from .. import functional as F
+        qx, qw = self._quant_pair(x)
+        lay = self._layer
+        return F.conv2d(qx, qw, bias=self.bias,
+                        stride=getattr(lay, "_stride", 1),
+                        padding=getattr(lay, "_padding", 0),
+                        dilation=getattr(lay, "_dilation", 1),
+                        groups=getattr(lay, "_groups", 1),
+                        data_format=getattr(lay, "_data_format", "NCHW"))
+
+
+class QuantizedConv2DTranspose(_QuantizedWrapper):
+    """Reference quant_layers.py QuantizedConv2DTranspose."""
+
+    def forward(self, x, output_size=None):
+        from .. import functional as F
+        qx, qw = self._quant_pair(x)
+        lay = self._layer
+        return F.conv2d_transpose(
+            qx, qw, bias=self.bias, stride=getattr(lay, "_stride", 1),
+            padding=getattr(lay, "_padding", 0),
+            dilation=getattr(lay, "_dilation", 1),
+            groups=getattr(lay, "_groups", 1), output_size=output_size,
+            data_format=getattr(lay, "_data_format", "NCHW"))
+
+
+class QuantizedLinear(_QuantizedWrapper):
+    """Reference quant_layers.py:726."""
+
+    def forward(self, x):
+        from .. import functional as F
+        qx, qw = self._quant_pair(x)
+        return F.linear(qx, qw, self.bias)
+
+
+class QuantizedMatmul(Layer):
+    """Reference quant_layers.py QuantizedMatmul: fake-quant both matmul
+    operands."""
+
+    def __init__(self, layer=None, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kwargs):
+        super().__init__()
+        self._fake_quant_x = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+        self._fake_quant_y = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+
+    def forward(self, x, y, transpose_x=False, transpose_y=False,
+                name=None):
+        import paddle_tpu as paddle
+        return paddle.matmul(self._fake_quant_x(x), self._fake_quant_y(y),
+                             transpose_x, transpose_y)
+
+
+class QuantizedColumnParallelLinear(_QuantizedWrapper):
+    """Reference quant_layers.py QuantizedColumnParallelLinear: quantize
+    then run the column-parallel body (gather stays fp32)."""
+
+    def forward(self, x):
+        qx, qw = self._quant_pair(x)
+        lay = self._layer
+        saved_w = lay.weight
+        try:
+            lay.weight = qw
+            return lay.forward(qx)
+        finally:
+            lay.weight = saved_w
+
+
+class QuantizedRowParallelLinear(QuantizedColumnParallelLinear):
+    """Reference quant_layers.py QuantizedRowParallelLinear."""

@@ -148,3 +148,61 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
 
     args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())
     return apply(run, *args, name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    """LLM.int8 mixed-precision linear (reference:
+    python/paddle/nn/quant/quantized_linear.py:186 over the llm_int8
+    CUDA kernels).
+
+    TPU design: outlier activation channels (per-feature absmax >
+    threshold) run in the original dtype; the dense remainder quantizes
+    dynamically per row to int8 and contracts int8xint8 -> int32 on the
+    MXU, then dequantizes by (row_scale x weight_scale). weight: int8
+    [n, k] (row-major like the reference's out-feature-major layout);
+    weight_scale: [n] float."""
+    import jax.numpy as jnp
+
+    from ...autograd.function import apply
+    from ...core.tensor import as_tensor
+
+    x_t, w_t = as_tensor(x), as_tensor(weight)
+    args = [x_t, w_t]
+    if weight_scale is not None:
+        args.append(as_tensor(weight_scale))
+    if bias is not None:
+        args.append(as_tensor(bias))
+
+    def f(xa, wa, *rest):
+        it = iter(rest)
+        ws = next(it) if weight_scale is not None else \
+            jnp.ones((wa.shape[0],), jnp.float32)
+        ba = next(it) if bias is not None else None
+        k = xa.shape[-1]
+        x2 = xa.reshape(-1, k)
+        # outlier decomposition: feature columns whose absmax crosses the
+        # threshold stay in floating point (LLM.int8 core idea)
+        col_max = jnp.max(jnp.abs(x2), axis=0)
+        outlier = col_max > threshold                  # [k]
+        x_dense = jnp.where(outlier[None, :], 0.0, x2)
+        x_out = jnp.where(outlier[None, :], x2, 0.0)
+        # dynamic per-row int8 quantization of the dense part
+        row_scale = jnp.maximum(jnp.max(jnp.abs(x_dense), axis=1), 1e-9)
+        q = jnp.clip(jnp.round(x_dense / row_scale[:, None] * 127.0),
+                     -127, 127).astype(jnp.int8)
+        acc = jnp.matmul(q.astype(jnp.int32), wa.T.astype(jnp.int32),
+                         preferred_element_type=jnp.int32)
+        dense = acc.astype(jnp.float32) * (row_scale[:, None] / 127.0) \
+            * ws[None, :].astype(jnp.float32)
+        # outlier columns contract in float against dequantized weights
+        w_fp = wa.astype(jnp.float32) * ws[:, None].astype(jnp.float32)
+        out = dense + x_out.astype(jnp.float32) @ w_fp.T
+        out = out.astype(xa.dtype)
+        if ba is not None:
+            out = out + ba
+        return out.reshape(xa.shape[:-1] + (wa.shape[0],))
+
+    return apply(f, *args, name="llm_int8_linear")
+
+
+__all__ += ["llm_int8_linear"]

@@ -14,7 +14,7 @@ def test_nn_quant_namespace():
     stub = quant.Stub()
     x = paddle.to_tensor(np.ones((2, 2), np.float32))
     np.testing.assert_allclose(stub(x).numpy(), 1.0)
-    assert quant.QuantedLinear in quant.quant_layers()
+    assert quant.QuantedLinear in quant.quanted_layer_types()
     w = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
     q, scales = quant.weight_quantize(w)
     assert np.asarray(q).dtype == np.int8

@@ -369,3 +369,60 @@ def test_adamw_update_awkward_size_lowers():
                            eps=1e-8, wd=0.01, out_dtype=jnp.bfloat16)
     assert_mosaic(lower_tpu(lambda a, g, m, v: fn(a, g, m, v, 1e-3, 10),
                             w, w, w, w))
+
+
+def test_fused_multi_transformer_decode_lowers():
+    """The serving fused_multi_transformer decode step lowers for TPU with
+    the mmha Pallas kernel in-context (kernel-qualifying cache shape)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import fused_multi_transformer
+
+    rng = np.random.default_rng(0)
+    L, b, nh, hd, dff, T = 1, 1, 2, 128, 64, 64
+    d = nh * hd
+
+    def mk(*shape):
+        return paddle.to_tensor(
+            (rng.standard_normal(shape) * 0.05).astype(np.float32))
+
+    w = dict(
+        ln_s=[paddle.to_tensor(np.ones(d, np.float32))], ln_b=[mk(d)],
+        qkv_w=[mk(3, nh, hd, d)], qkv_b=[mk(3, nh, hd)],
+        lin_w=[mk(nh * hd, d)], lin_b=[mk(d)],
+        fln_s=[paddle.to_tensor(np.ones(d, np.float32))], fln_b=[mk(d)],
+        f1_w=[mk(d, dff)], f1_b=[mk(dff)], f2_w=[mk(dff, d)], f2_b=[mk(d)])
+
+    def step(x_arr, cache_arr, ts_arr):
+        out, caches = fused_multi_transformer(
+            paddle.Tensor(x_arr), w["ln_s"], w["ln_b"], w["qkv_w"],
+            w["qkv_b"], w["lin_w"], w["lin_b"], w["fln_s"], w["fln_b"],
+            w["f1_w"], w["f1_b"], w["f2_w"], w["f2_b"],
+            cache_kvs=[paddle.Tensor(cache_arr)],
+            time_step=paddle.Tensor(ts_arr))
+        return out._data, caches[0]._data
+
+    x = jnp.zeros((b, 1, d), jnp.float32)
+    cache = jnp.zeros((2, b, nh, T, hd), jnp.float32)
+    ts = jnp.asarray([3], jnp.int32)
+    kern.force_dispatch(True)
+    try:
+        txt = lower_tpu(step, x, cache, ts)
+    finally:
+        kern.force_dispatch(False)
+    assert_mosaic(txt)
+
+
+def test_llm_int8_linear_lowers():
+    """llm_int8_linear lowers for TPU (int8 dot riding the MXU)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.quant import llm_int8_linear
+
+    w = jnp.ones((32, 64), jnp.int8)
+    s = jnp.ones((32,), jnp.float32)
+
+    def f(xa):
+        return llm_int8_linear(paddle.Tensor(xa), paddle.Tensor(w),
+                               weight_scale=paddle.Tensor(s))._data
+
+    txt = lower_tpu(f, jnp.zeros((4, 64), jnp.float32))
+    assert "stablehlo" in txt or "module" in txt
