@@ -163,6 +163,88 @@ def _on_op(name: str):
         prof._op_counts[name] = prof._op_counts.get(name, 0) + 1
 
 
+def _fold(table, name, dt, with_bytes=False):
+    """Fold one duration into a [calls, total, max, min(, bytes)]
+    aggregate row."""
+    agg = table.get(name)
+    if agg is None:
+        table[name] = agg = [0, 0.0, 0.0, float("inf")] + \
+            ([0] if with_bytes else [])
+    agg[0] += 1
+    agg[1] += dt
+    agg[2] = max(agg[2], dt)
+    agg[3] = min(agg[3], dt)
+    return agg
+
+
+def op_timing_active() -> bool:
+    """True while an active profiler wants per-op wall timing (eager op
+    attribution — the reference's operator summary over host RecordEvents
+    emitted in every generated ad_func)."""
+    prof = _active_profiler
+    return prof is not None and prof._recording() and prof._op_detail
+
+
+def record_op_time(name: str, outs, t0: float):
+    """Close a per-op timing span: blocks on the outputs so the measured
+    wall time covers device compute, not just async dispatch (accurate on
+    the CPU/TPU eager path), then folds into the per-op aggregate and the
+    per-op output-bytes tally."""
+    prof = _active_profiler
+    if prof is None or not prof._recording():
+        return
+    try:
+        import jax
+        jax.block_until_ready(outs)
+    except Exception:
+        pass
+    dt = time.perf_counter() - t0
+    prof._inner_accum += dt
+    agg = _fold(prof._op_times, name, dt, with_bytes=True)
+    try:
+        agg[4] += sum(int(getattr(o, "nbytes", 0)) for o in outs)
+    except Exception:
+        pass
+
+
+class host_self_span:
+    """Attribute a framework host loop's SELF time (wall minus the op
+    spans recorded inside it) as its own operator row — the reference
+    operator table's self-time concept for framework overhead."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        prof = _active_profiler
+        self._on = prof is not None and prof._recording() and \
+            prof._op_detail
+        if self._on:
+            self._t0 = time.perf_counter()
+            self._inner0 = _active_profiler._inner_accum
+        return self
+
+    def __exit__(self, *exc):
+        if not self._on:
+            return False
+        prof = _active_profiler
+        if prof is None:
+            return False
+        wall = time.perf_counter() - self._t0
+        inner = prof._inner_accum - self._inner0
+        _fold(prof._op_times, self.name, max(wall - inner, 0.0),
+              with_bytes=True)
+        return False
+
+
+def record_program(name: str, dt: float):
+    """Compiled-program execution (to_static prefix/whole program, span
+    program) — the TPU analog of the reference's kernel summary rows."""
+    prof = _active_profiler
+    if prof is not None and prof._recording():
+        _fold(prof._program_times, name, dt)
+
+
 class Profiler:
     """Reference profiler.py:346. `timer_only=True` skips device tracing and
     just benchmarks step throughput (reference behavior)."""
@@ -181,7 +263,14 @@ class Profiler:
         self._step = 0
         self._events: list[tuple[str, float, float]] = []
         self._op_counts: dict[str, int] = {}
+        self._op_times: dict[str, list] = {}
+        self._program_times: dict[str, list] = {}
+        self._mem_samples: list[tuple[int, int]] = []
         self._step_times: list[float] = []
+        self._op_detail = True
+        self._inner_accum = 0.0
+        self._record_start_t: float | None = None
+        self._recorded_wall: float = 0.0
         self._last_step_t: float | None = None
         self._trace_dir: str | None = None
         self._jax_tracing = False
@@ -195,11 +284,17 @@ class Profiler:
         old = self.current_state
         if new_state == old:
             return
-        if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
-                and old in (ProfilerState.CLOSED, ProfilerState.READY):
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if new_state in recording and \
+                old in (ProfilerState.CLOSED, ProfilerState.READY):
             self._start_device_trace()
+            self._record_start_t = time.perf_counter()
         if new_state in (ProfilerState.CLOSED, ProfilerState.READY) and \
-                old in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                old in recording:
+            if self._record_start_t is not None:
+                self._recorded_wall += \
+                    time.perf_counter() - self._record_start_t
+                self._record_start_t = None
             self._stop_device_trace()
             if self._on_trace_ready is not None:
                 self._on_trace_ready(self)
@@ -251,7 +346,19 @@ class Profiler:
             self._step_times.append(now - self._last_step_t)
         self._last_step_t = now
         self._step += 1
+        if self._recording():
+            self._sample_memory()
         self._transition(self._scheduler(self._step))
+
+    def _sample_memory(self):
+        """Device memory snapshot per step (reference memory summary over
+        the C++ allocator stats; here the PJRT device stats)."""
+        try:
+            from ..device import memory_allocated, memory_reserved
+            self._mem_samples.append(
+                (int(memory_allocated()), int(memory_reserved())))
+        except Exception:
+            pass
 
     def step_info(self, unit: str | None = None) -> str:
         if not self._step_times:
@@ -294,7 +401,15 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms", views=None):
         from .profiler_statistic import build_summary
+        wall = self._recorded_wall
+        if self._record_start_t is not None:
+            wall += time.perf_counter() - self._record_start_t
         txt = build_summary(self._events, self._op_counts, self._step_times,
-                            sorted_by=sorted_by, time_unit=time_unit)
+                            op_times=self._op_times,
+                            program_times=self._program_times,
+                            mem_samples=self._mem_samples,
+                            recorded_wall=wall,
+                            sorted_by=sorted_by, op_detail=op_detail,
+                            time_unit=time_unit, views=views)
         print(txt)
         return txt

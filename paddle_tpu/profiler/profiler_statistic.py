@@ -1,6 +1,17 @@
 """Summary statistics tables (reference:
-python/paddle/profiler/profiler_statistic.py — SortedKeys :49 and the
-table builders behind Profiler.summary :875)."""
+python/paddle/profiler/profiler_statistic.py — SortedKeys :49,
+StatisticData and the table builders behind Profiler.summary, 2,061 LoC:
+Device/Overview/Model/Operator/Kernel/Memory/UserDefined summaries).
+
+The TPU build aggregates three native sources into the same table set:
+- per-op wall spans measured around each eager `apply` dispatch AND each
+  backward vjp execution (blocking on outputs, so device compute is
+  attributed — the analog of the reference's per-ad_func RecordEvents);
+- compiled-program executions (to_static whole programs, graph-break
+  prefix programs, span programs) — the kernel-summary analog, since one
+  fused XLA program is the TPU's "kernel";
+- user RecordEvent spans, step times, and per-step device-memory samples.
+"""
 
 from __future__ import annotations
 
@@ -23,6 +34,9 @@ class SortedKeys(Enum):
 
 _UNIT = {"s": 1.0, "ms": 1e3, "us": 1e6}
 
+# reference Model Summary buckets phases by event name
+_PHASE_NAMES = ("Dataloader", "Forward", "Backward", "Optimization")
+
 
 def _table(headers, rows, title):
     widths = [max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows
@@ -38,11 +52,98 @@ def _table(headers, rows, title):
     return "\n".join(out)
 
 
-def build_summary(events, op_counts, step_times, sorted_by=None,
-                  time_unit="ms"):
+def _fmt_bytes(n):
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TB"
+
+
+def _sort_key(sorted_by):
+    # aggregates are [calls, total, max, min, (bytes)]
+    return {
+        SortedKeys.CPUAvg: lambda kv: kv[1][1] / max(kv[1][0], 1),
+        SortedKeys.CPUMax: lambda kv: kv[1][2],
+        SortedKeys.CPUMin: lambda kv: kv[1][3],
+        SortedKeys.GPUTotal: lambda kv: kv[1][1],
+        SortedKeys.GPUAvg: lambda kv: kv[1][1] / max(kv[1][0], 1),
+        SortedKeys.GPUMax: lambda kv: kv[1][2],
+        SortedKeys.GPUMin: lambda kv: kv[1][3],
+    }.get(sorted_by, lambda kv: kv[1][1])
+
+
+def _agg_rows(agg, mul, total_base, with_bytes=False, sorted_by=None,
+              limit=None):
+    rows = []
+    items = sorted(agg.items(), key=_sort_key(sorted_by), reverse=True)
+    if limit:
+        items = items[:limit]
+    for name, a in items:
+        n, tot, mx, mn = a[0], a[1], a[2], a[3]
+        ratio = f"{100.0 * tot / total_base:.2f}%" if total_base > 0 else "-"
+        row = [name, n, f"{tot * mul:.3f}", f"{tot / max(n, 1) * mul:.3f}",
+               f"{mx * mul:.3f}",
+               f"{(0.0 if mn == float('inf') else mn) * mul:.3f}", ratio]
+        if with_bytes:
+            row.append(_fmt_bytes(a[4] if len(a) > 4 else 0))
+        rows.append(row)
+    return rows
+
+
+def build_summary(events, op_counts, step_times, op_times=None,
+                  program_times=None, mem_samples=None, recorded_wall=0.0,
+                  sorted_by=None, op_detail=True, time_unit="ms",
+                  views=None):
+    """The reference's summary view set, in its section order."""
     mul = _UNIT.get(time_unit, 1e3)
+    op_times = op_times or {}
+    program_times = program_times or {}
+    mem_samples = mem_samples or []
     parts = []
 
+    total_step = sum(step_times) if step_times else recorded_wall
+    op_total = sum(a[1] for a in op_times.values())
+    prog_total = sum(a[1] for a in program_times.values())
+    attributed = op_total + prog_total
+
+    # ---- Device Summary ---------------------------------------------------
+    try:
+        import jax
+        dev = jax.devices()[0]
+        stats = {}
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            pass
+        parts.append(_table(
+            ["Device", "Kind", "Utilization (attributed)",
+             "Mem In Use", "Mem Limit"],
+            [[str(dev), getattr(dev, "device_kind", "-"),
+              f"{100.0 * attributed / total_step:.2f}%"
+              if total_step > 0 else "-",
+              _fmt_bytes(stats.get("bytes_in_use", 0)),
+              _fmt_bytes(stats.get("bytes_limit", 0))]],
+            "Device Summary"))
+    except Exception:
+        pass
+
+    # ---- Overview Summary -------------------------------------------------
+    if total_step > 0:
+        other = max(total_step - attributed, 0.0)
+        parts.append(_table(
+            ["Event Type", f"Total Time ({time_unit})", "Ratio (%)"],
+            [["ProfileStep", f"{total_step * mul:.3f}", "100.00"],
+             ["  Operator (eager dispatch)", f"{op_total * mul:.3f}",
+              f"{100.0 * op_total / total_step:.2f}"],
+             ["  CompiledProgram (kernel)", f"{prog_total * mul:.3f}",
+              f"{100.0 * prog_total / total_step:.2f}"],
+             ["  Other (python/host)", f"{other * mul:.3f}",
+              f"{100.0 * other / total_step:.2f}"]],
+            "Overview Summary"))
+
+    # ---- Step Time Summary ------------------------------------------------
     if step_times:
         import numpy as np
         arr = np.array(step_times) * mul
@@ -56,31 +157,71 @@ def build_summary(events, op_counts, step_times, sorted_by=None,
              ["p99", f"{np.percentile(arr, 99):.3f}"]],
             "Step Time Summary"))
 
+    # ---- Model Summary (phase buckets from RecordEvent names) -------------
     if events:
-        agg = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])
+        phases = defaultdict(float)
+        for name, t0, t1 in events:
+            for ph in _PHASE_NAMES:
+                if name.lower().startswith(ph.lower()):
+                    phases[ph] += t1 - t0
+        if phases:
+            rows = [[ph, f"{phases[ph] * mul:.3f}",
+                     f"{100.0 * phases[ph] / total_step:.2f}%"
+                     if total_step > 0 else "-"]
+                    for ph in _PHASE_NAMES if ph in phases]
+            parts.append(_table(
+                ["Phase", f"Total ({time_unit})", "Ratio"], rows,
+                "Model Summary"))
+
+    # ---- Operator Summary (timed) -----------------------------------------
+    if op_times and op_detail:
+        rows = _agg_rows(op_times, mul, total_step, with_bytes=True,
+                         sorted_by=sorted_by, limit=60)
+        parts.append(_table(
+            ["Operator", "Calls", f"Total ({time_unit})",
+             f"Avg ({time_unit})", f"Max ({time_unit})",
+             f"Min ({time_unit})", "Ratio", "Out Bytes"],
+            rows, "Operator Summary (timed eager dispatches incl. grad)"))
+
+    # ---- Kernel Summary (compiled programs) --------------------------------
+    if program_times:
+        rows = _agg_rows(program_times, mul, total_step,
+                         sorted_by=sorted_by, limit=30)
+        parts.append(_table(
+            ["Program", "Calls", f"Total ({time_unit})",
+             f"Avg ({time_unit})", f"Max ({time_unit})",
+             f"Min ({time_unit})", "Ratio"],
+            rows, "Kernel Summary (compiled XLA programs)"))
+
+    # ---- Memory Summary ---------------------------------------------------
+    if mem_samples:
+        alloc = [a for a, _ in mem_samples]
+        resv = [r for _, r in mem_samples]
+        parts.append(_table(
+            ["stat", "allocated", "reserved"],
+            [["peak", _fmt_bytes(max(alloc)), _fmt_bytes(max(resv))],
+             ["last", _fmt_bytes(alloc[-1]), _fmt_bytes(resv[-1])],
+             ["samples", len(alloc), len(resv)]],
+            "Memory Summary (per-step device samples)"))
+
+    # ---- UserDefined Summary (RecordEvent spans) --------------------------
+    if events:
+        agg = {}
         for name, t0, t1 in events:
             dt = t1 - t0
-            a = agg[name]
+            a = agg.setdefault(name, [0, 0.0, 0.0, float("inf")])
             a[0] += 1
             a[1] += dt
             a[2] = max(a[2], dt)
             a[3] = min(a[3], dt)
-        key = {
-            SortedKeys.CPUAvg: lambda kv: kv[1][1] / kv[1][0],
-            SortedKeys.CPUMax: lambda kv: kv[1][2],
-            SortedKeys.CPUMin: lambda kv: kv[1][3],
-        }.get(sorted_by, lambda kv: kv[1][1])
-        rows = []
-        for name, (n, tot, mx, mn) in sorted(agg.items(), key=key,
-                                             reverse=True):
-            rows.append([name, n, f"{tot*mul:.3f}", f"{tot/n*mul:.3f}",
-                         f"{mx*mul:.3f}", f"{mn*mul:.3f}"])
+        rows = _agg_rows(agg, mul, total_step, sorted_by=sorted_by)
         parts.append(_table(
             ["Name", "Calls", f"Total ({time_unit})", f"Avg ({time_unit})",
-             f"Max ({time_unit})", f"Min ({time_unit})"],
-            rows, "Host Event Summary (RecordEvent spans)"))
+             f"Max ({time_unit})", f"Min ({time_unit})", "Ratio"],
+            rows, "UserDefined Summary (RecordEvent spans)"))
 
-    if op_counts:
+    # ---- Operator dispatch counts (fallback when timing was off) ----------
+    if op_counts and not op_times:
         rows = [[name, n] for name, n in
                 sorted(op_counts.items(), key=lambda kv: -kv[1])]
         parts.append(_table(["Operator", "Calls"], rows[:50],
