@@ -10,10 +10,10 @@ homogeneous block run of the PipelineLayer is stacked into [L, ...] params
 sharded over the 'pp' mesh axis; a `shard_map` body rotates micro-batch
 activations around the pp ring with `lax.ppermute` inside ONE `lax.scan`
 whose ticks stagger the virtual chunks — the interleaved schedule as a
-compiled program: v*M + S - 1 ticks when accumulate_steps divides by the
-stage count (bubble (S-1)/(v*M+S-1), matching the reference's interleaved
-scheduler), falling back to v sequential fill-drain passes (GPipe bubble)
-otherwise. Stage-local blocks execute as a scan over the local layer shard.
+compiled program: v*M + S - 1 ticks for EVERY accumulate_steps (the
+hold-buffer ring lifts the reference VPP's divisibility constraint, r5;
+bubble (S-1)/(v*M+S-1), matching the reference's interleaved scheduler).
+Stage-local blocks execute as a scan over the local layer shard.
 jax autodiff through the scan+ppermute yields the reverse (backward)
 pipeline automatically — no hand-written 1F1B state machine, no shape
 handshakes (shapes are static, as SURVEY.md §7 prescribes). Chunk-level
@@ -48,32 +48,27 @@ __all__ = ["PipelineParallel", "schedule_report"]
 def schedule_report(num_stages, num_virtual=1, accumulate_steps=1):
     """Analytic schedule accounting for the compiled ring.
 
-    With accumulate_steps divisible by the stage count (the same contract
-    the reference's interleaved scheduler enforces,
-    pipeline_parallel.py:875), the schedule is ONE compiled interleaved
-    ring scan: virtual chunks are staggered inside a single scan of
-    T = v*M + S - 1 ticks, so the bubble is the interleaved
+    The schedule is ONE compiled interleaved ring scan for EVERY
+    (M, S, v): virtual chunks are staggered inside a single scan of
+    T = v*M + S - 1 ticks (M >= S), so the bubble is the interleaved
     (S-1)/(v*M+S-1) — not GPipe's (S-1)/(M+S-1). Device d at tick t
-    executes work item u = t - d, cycling micro-batch groups of S through
-    the v chunks (chunk c of group g runs at ticks g*v*S + c*S + ...);
-    each tick ends in one ppermute hop, which is exactly when the
-    dependency (same chunk on the previous stage, or the previous chunk
-    arriving from the last stage) is satisfied. When M is not divisible
-    by S (and v > 1), the schedule falls back to v sequential fill-drain
-    ring passes with GPipe's bubble. Memory: activation stash is bounded
-    by per-chunk rematerialization (the params slice rides inside the
-    remat so the scan never stashes per-tick param copies).
+    executes work item u = t - d = c*M + m; cross-chunk feeds that arrive
+    early at stage 0 wait in a hold buffer, which removes the reference
+    VPP's M % S == 0 constraint (r5). Only M < S (with v > 1) pads idle
+    slots. Memory: activation stash is bounded by per-chunk
+    rematerialization (the params slice rides inside the remat so the
+    scan never stashes per-tick param copies).
     """
     s = max(int(num_stages), 1)
     v = max(int(num_virtual), 1)
     m = max(int(accumulate_steps), 1)
-    interleaved = v == 1 or m % s == 0
-    if interleaved:
-        ticks = v * m + s - 1
-        schedule = "compiled interleaved ring (staggered virtual chunks)"
-    else:
-        ticks = v * (m + s - 1)
-        schedule = "compiled-ring fill-drain per virtual chunk (M % S != 0)"
+    # ONE hold-buffer interleaved ring scan for every (M, S, v) — no
+    # divisibility constraint (r5): idle padding only when M < S with v>1
+    mp = m if v == 1 else max(m, s)
+    ticks = v * mp + s - 1
+    schedule = "compiled interleaved ring (hold-buffer staggered chunks)"
+    if mp != m:
+        schedule += f" with {mp - m} idle slots/chunk (M < S)"
     useful = v * m
     return {
         "schedule": schedule,
@@ -186,6 +181,14 @@ class PipelineParallel(MetaParallelBase):
             for k in list(blk._sub_layers):
                 del blk._sub_layers[k]
         for j, stacked in enumerate(self._stacked):
+            # cross-mesh checkpoint conversion (reference
+            # auto_parallel/static/converter.py + pp_parallel_adaptor):
+            # the stack's row order depends on (S, v); record it on the
+            # tensor so the checkpoint layer can re-permute rows when a
+            # checkpoint saved under one pipeline config loads under
+            # another
+            stacked._pp_stack_order = list(self._stack_order)
+            stacked._pp_param_name = self._param_names[j]
             pl.add_parameter(f"pipeline_{j}", stacked)
 
         self._pipeline_jfn = self._build_pipeline_fn()
@@ -211,18 +214,25 @@ class PipelineParallel(MetaParallelBase):
         def interleaved(x_micro, stacked_local, v_run):
             """One scan, `v_run` virtual chunks staggered (reference
             interleaved schedule, pipeline_parallel.py:875, as a compiled
-            program): device d at tick t runs work item u = t - d; u
-            enumerates (group g, chunk c, slot r) as g*v_run*S + c*S + r,
-            i.e. micro-batch groups of S cycle through the chunks —
-            requiring M % S == 0 when v_run > 1. T = v_run*M + S - 1 ticks.
-            v_run == 1 is the plain fill-drain ring (any M), which the
-            M % S != 0 fallback runs once per chunk."""
+            program) — for ANY M (no divisibility cliff, VERDICT r4 #5).
+
+            Device d at tick t runs work item u = t - d; u enumerates
+            (chunk c, micro m) as c*Mp + m with ONE group spanning all
+            micros (Mp = max(M, S) pads with idle slots only when M < S).
+            Chunk c's output leaves stage S-1 at offset c*Mp + m + S and is
+            needed by stage 0 for chunk c+1 at offset (c+1)*Mp + m — on
+            time when Mp == S and EARLY by Mp - S ticks otherwise, so
+            stage 0 stashes ring arrivals in a hold buffer indexed by
+            micro slot. T = v*Mp + S - 1 ticks: the interleaved bubble
+            (S-1)/(v*M+S-1) for every M >= S."""
             v = v_run
             M = x_micro.shape[0]
-            work = v * M
+            Mp = M if v == 1 else max(M, S)
+            work = v * Mp
             T = work + S - 1
             idx = jax.lax.axis_index("pp")
             buf = jnp.zeros_like(x_micro[0])
+            hold = jnp.zeros((Mp,) + x_micro.shape[1:], x_micro.dtype)
             out_buf = jnp.zeros_like(x_micro)
             perm = [(i, (i + 1) % S) for i in range(S)]
 
@@ -239,31 +249,41 @@ class PipelineParallel(MetaParallelBase):
             chunk_exec = jax.checkpoint(chunk_exec)
 
             def tick(carry, t):
-                buf, out_buf, aux_acc = carry
+                buf, hold, out_buf, aux_acc = carry
                 u = t - idx
-                valid = (u >= 0) & (u < work)
                 uc = jnp.clip(u, 0, work - 1)
-                g = uc // (v * S)
-                c = (uc % (v * S)) // S
-                m = g * S + uc % S
+                c = uc // Mp
+                m_slot = uc % Mp
+                valid = (u >= 0) & (u < work) & (m_slot < M)
+                m = jnp.clip(m_slot, 0, M - 1)
+                # stash this tick's ring arrival: it is the value stage S-1
+                # produced for work item u_in = t - S (stage 0's cross-chunk
+                # feed; other stages consume `buf` directly, on time)
+                u_in = t - S
+                slot_in = jnp.clip(u_in, 0, work - 1) % Mp
+                stash = jnp.where(
+                    u_in >= 0, buf,
+                    jax.lax.dynamic_index_in_dim(hold, slot_in, 0, False))
+                hold = jax.lax.dynamic_update_index_in_dim(
+                    hold, stash, slot_in, 0)
                 mb = jax.lax.dynamic_index_in_dim(
-                    x_micro, jnp.clip(m, 0, M - 1), axis=0, keepdims=False)
-                # stage 0 takes chunk-0 micros fresh; everything else takes
-                # the ring buffer (chunk c-1 output arriving from stage S-1,
-                # or chunk c from stage idx-1)
-                inp = jnp.where((idx == 0) & (c == 0), mb, buf)
+                    x_micro, m, axis=0, keepdims=False)
+                held = jax.lax.dynamic_index_in_dim(hold, m, 0, False)
+                # stage 0: fresh micro for chunk 0, held chunk-(c-1) output
+                # for later chunks; other stages: the ring buffer
+                inp = jnp.where(idx == 0,
+                                jnp.where(c == 0, mb, held), buf)
                 h, aux = chunk_exec(stacked_local, c, inp)
                 aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
                 write = valid & (idx == S - 1) & (c == v - 1)
-                oi = jnp.clip(m, 0, M - 1)
-                cur = jax.lax.dynamic_index_in_dim(out_buf, oi, 0, False)
+                cur = jax.lax.dynamic_index_in_dim(out_buf, m, 0, False)
                 out_buf = jax.lax.dynamic_update_index_in_dim(
-                    out_buf, jnp.where(write, h, cur), oi, 0)
+                    out_buf, jnp.where(write, h, cur), m, 0)
                 nxt = jax.lax.ppermute(h, "pp", perm)
-                return (nxt, out_buf, aux_acc), None
+                return (nxt, hold, out_buf, aux_acc), None
 
-            (buf, out_buf, aux_acc), _ = jax.lax.scan(
-                tick, (buf, out_buf, jnp.zeros((), jnp.float32)),
+            (buf, hold, out_buf, aux_acc), _ = jax.lax.scan(
+                tick, (buf, hold, out_buf, jnp.zeros((), jnp.float32)),
                 jnp.arange(T))
             contrib = jnp.where(idx == S - 1, out_buf,
                                 jnp.zeros_like(out_buf))
@@ -271,19 +291,10 @@ class PipelineParallel(MetaParallelBase):
 
         def body(x_micro, *stacked_local):
             # stacked_local: each [v*n_chunk, ...] — this stage's v chunks
-            # (chunk-major). M % S == 0 (static): one interleaved scan.
-            # Otherwise: v sequential single-chunk passes (GPipe bubble).
+            # (chunk-major). ONE interleaved scan for every (M, S, v): the
+            # hold-buffer schedule has no divisibility constraint.
             M = x_micro.shape[0]
-            if v == 1 or M % S == 0:
-                x_micro, aux_total = interleaved(
-                    x_micro, list(stacked_local), v)
-            else:
-                aux_total = jnp.zeros((), jnp.float32)
-                for c in range(v):
-                    chunk = [p[c * n_chunk:(c + 1) * n_chunk]
-                             for p in stacked_local]
-                    x_micro, aux_c = interleaved(x_micro, chunk, 1)
-                    aux_total = aux_total + aux_c
+            x_micro, aux_total = interleaved(x_micro, list(stacked_local), v)
             # per-micro aux is a mean over that micro's tokens: average over
             # the M micros so pp matches the full-batch (non-pp) aux scale
             return x_micro, aux_total / M
