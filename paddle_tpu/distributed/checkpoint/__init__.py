@@ -83,6 +83,14 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             arr = leaf._d
             entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
                      "shards": []}
+            order = getattr(leaf, "_pp_stack_order", None)
+            if order is not None:
+                # pipeline-stacked param: rows are permuted by the live
+                # (S, v) config; record the permutation so a different
+                # pipeline config can re-permute on load
+                entry["pp_stack_order"] = list(order)
+                entry["pp_param_name"] = getattr(leaf, "_pp_param_name",
+                                                 None)
             if isinstance(getattr(arr, "sharding", None), NamedSharding) and \
                     not arr.is_fully_replicated:
                 for i, sh in enumerate(_unique_shards(arr)):
@@ -158,6 +166,21 @@ def _assemble(path, entry) -> np.ndarray:
     return full
 
 
+def _repermute_pp_rows(host, entry, leaf):
+    """Cross-pipeline-config conversion (reference converter.py /
+    pp_parallel_adaptor): a pipeline-stacked tensor saved under (S_a, v_a)
+    has its rows in that config's stage-major order; re-permute into the
+    LIVE tensor's order when they differ."""
+    saved = entry.get("pp_stack_order")
+    live = getattr(leaf, "_pp_stack_order", None)
+    if saved is None or live is None or saved == live:
+        return host
+    inv = np.empty(len(saved), np.int64)
+    inv[np.asarray(saved)] = np.arange(len(saved))
+    logical = host[inv]           # row i = block i
+    return logical[np.asarray(live)]
+
+
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0):
     """Fill `state_dict`'s tensors in place from the checkpoint at `path`,
@@ -181,6 +204,7 @@ def load_state_dict(state_dict, path, process_group=None,
                 missing.append(key)
                 continue
             host = _assemble(path, entry)
+            host = _repermute_pp_rows(host, entry, leaf)
             if list(host.shape) != list(leaf.shape):
                 raise ValueError(
                     f"shape mismatch for {key!r}: checkpoint "

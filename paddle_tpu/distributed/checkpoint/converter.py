@@ -1,0 +1,106 @@
+"""Cross-parallel-config checkpoint conversion (reference:
+python/paddle/distributed/auto_parallel/static/converter.py — merge/slice
+with ProcessMesh change on load; fleet/utils/pp_parallel_adaptor.py —
+pipeline <-> single-card layout adaptation).
+
+The sharded checkpoint layer already reshards every tensor onto its LIVE
+sharding at load (load_state_dict device_puts to the current mesh) and
+re-permutes pipeline-stacked rows across (S, v) configs via the recorded
+stack order. This module adds the pp <-> per-block adaptors for moving
+between a pipeline-wrapped model and an unwrapped (single-process)
+PipelineLayer."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["load_checkpoint_into_blocks", "stacked_state_to_blocks",
+           "blocks_state_to_stacked"]
+
+
+def _read_meta(path):
+    import json
+    with open(os.path.join(path, "metadata.json")) as f:
+        return json.load(f)
+
+
+def _assemble_host(path, entry):
+    from . import _assemble
+    return _assemble(path, entry)
+
+
+def stacked_state_to_blocks(stacked_host: dict, meta: dict):
+    """{pipeline-stacked key -> host array} + checkpoint meta ->
+    {block_index -> {param_name -> host row}} in LOGICAL block order
+    (reference pp_parallel_adaptor's pp-to-single direction)."""
+    blocks: dict[int, dict] = {}
+    for key, host in stacked_host.items():
+        entry = meta["tensors"][key]
+        order = entry.get("pp_stack_order")
+        pname = entry.get("pp_param_name")
+        if order is None or pname is None:
+            continue
+        inv = np.empty(len(order), np.int64)
+        inv[np.asarray(order)] = np.arange(len(order))
+        logical = host[inv]
+        for b in range(logical.shape[0]):
+            blocks.setdefault(b, {})[pname] = logical[b]
+    return blocks
+
+
+def blocks_state_to_stacked(block_states, param_names, order):
+    """Inverse direction: per-block host params -> the stacked layout of a
+    live (S, v) config (reference pp_parallel_adaptor single-to-pp)."""
+    out = {}
+    for j, pname in enumerate(param_names):
+        rows = np.stack([block_states[b][pname]
+                         for b in range(len(block_states))], axis=0)
+        out[f"pipeline_{j}"] = rows[np.asarray(order)]
+    return out
+
+
+def load_checkpoint_into_blocks(pipeline_layer, path, prefix=None):
+    """Load a pipeline-wrapped model's sharded checkpoint into an
+    UNWRAPPED PipelineLayer (single-process execution): stacked rows are
+    un-permuted into logical block order and assigned to each block's
+    parameters by name; non-stacked tensors (head/tail/tied embeddings)
+    load by their own keys."""
+    import jax.numpy as jnp
+
+    meta = _read_meta(path)
+    # 1. stacked entries -> per-block assignment
+    stacked_host = {}
+    for key, entry in meta["tensors"].items():
+        if entry.get("pp_stack_order") is not None:
+            leaf_key = key if prefix is None else key[len(prefix):]
+            stacked_host[leaf_key] = _assemble_host(path, entry)
+    blocks_host = stacked_state_to_blocks(
+        stacked_host, {"tensors": {k: meta["tensors"][k]
+                                   for k in stacked_host}})
+    blocks = pipeline_layer.block_layers
+    if blocks_host and len(blocks) != max(blocks_host) + 1:
+        raise ValueError(
+            f"checkpoint has {max(blocks_host) + 1} pipeline blocks, the "
+            f"live model has {len(blocks)}")
+    for b, params in blocks_host.items():
+        live = dict(blocks[b].named_parameters())
+        for pname, row in params.items():
+            if pname not in live:
+                raise KeyError(f"block {b} has no parameter {pname!r}")
+            live[pname]._data = jnp.asarray(
+                row.astype(np.dtype(live[pname]._d.dtype)))
+            live[pname]._node = None
+    # 2. every non-stacked tensor that matches a live name loads directly
+    live_named = dict(pipeline_layer.named_parameters())
+    for key, entry in meta["tensors"].items():
+        if entry.get("pp_stack_order") is not None:
+            continue
+        name = key if prefix is None else key[len(prefix):]
+        if name in live_named:
+            host = _assemble_host(path, entry)
+            t = live_named[name]
+            t._data = jnp.asarray(host.astype(np.dtype(t._d.dtype)))
+            t._node = None
+    return pipeline_layer

@@ -16,7 +16,8 @@ from __future__ import annotations
 import os
 import time
 
-__all__ = ["ElasticStatus", "ElasticManager"]
+__all__ = ["ElasticStatus", "ElasticManager", "StoreHeartbeatAgent",
+           "store_listener"]
 
 
 class ElasticStatus:
@@ -29,16 +30,23 @@ class ElasticStatus:
 
 class ElasticManager:
     def __init__(self, hosts=None, scale=0, force=False, listener=None,
-                 min_hosts=None, max_hosts=None):
+                 min_hosts=None, max_hosts=None, elastic_level=None):
         """listener: callable -> current live host list (the etcd watch
-        analog); defaults to reading PADDLE_TRAINER_ENDPOINTS style env."""
+        analog; `store_listener` gives the TCP-store lease-backed source);
+        defaults to reading PADDLE_TRAINER_ENDPOINTS style env.
+        elastic_level (reference fault-tolerance levels): 0 = off, 1 =
+        relaunch on count change, 2 = also treat same-count host
+        replacement as a scale event."""
         self._listener = listener or self._env_listener
         self.hosts = list(hosts) if hosts else self._listener()
         self.np = len(self.hosts) or 1
         self.min_hosts = min_hosts or self.np
         self.max_hosts = max_hosts or self.np
-        self.elastic_level = 1 if (self.min_hosts != self.max_hosts
-                                   or scale) else 0
+        if elastic_level is None:
+            elastic_level = 1 if (self.min_hosts != self.max_hosts
+                                  or scale) else 0
+        self.elastic_level = elastic_level
+        self.last_event = None
         self._pre_hooks = []
         self._stopped = False
 
@@ -69,12 +77,24 @@ class ElasticManager:
             self.hosts = list(live)
             self.np = n
             return ElasticStatus.HOLD
-        if n == self.np:
+        added = [h for h in live if h not in self.hosts]
+        removed = [h for h in self.hosts if h not in live]
+        if n == self.np and not (added or removed):
+            return ElasticStatus.HOLD
+        if n == self.np and self.elastic_level < 2:
+            # same count, different hosts (replacement): level-1 fault
+            # tolerance ignores it; level 2 treats it as a scale event
+            # (reference fault-tolerance levels, manager.py:126)
+            self.hosts = list(live)
             return ElasticStatus.HOLD
         if n < self.min_hosts:
             # lost too many hosts: wait for replacements
+            self.last_event = ("lost", added, removed)
             return ElasticStatus.HOLD
         # membership changed within [min, max]: scale event
+        self.last_event = ("scale_out" if n > self.np else
+                           ("scale_in" if n < self.np else "replace"),
+                           added, removed)
         for hook in self._pre_hooks:
             hook()
         self.hosts = list(live)
@@ -96,3 +116,81 @@ class ElasticManager:
 
     def stop(self):
         self._stopped = True
+
+
+class StoreHeartbeatAgent:
+    """Lease/TTL heartbeat against the TCP store (reference
+    fleet/elastic/manager.py:257 — the etcd lease keepalive thread).
+
+    Each pod registers once (monotonic join counter + host slot) and then
+    beats its timestamp key every ttl/3 seconds from a daemon thread; a
+    host whose beat is older than ttl has lost its lease."""
+
+    def __init__(self, store, endpoint, ttl=6.0):
+        self._store = store
+        self.endpoint = endpoint
+        self.ttl = float(ttl)
+        self._thread = None
+        self._stop = None
+
+    def register(self):
+        idx = self._store.add("elastic/join", 1) - 1
+        self._store.set(f"elastic/host/{idx}", self.endpoint)
+        self.beat()
+        return idx
+
+    def beat(self):
+        self._store.set(f"elastic/beat/{self.endpoint}", repr(time.time()))
+
+    def start(self):
+        import threading
+        self.register()
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(self.ttl / 3.0):
+                try:
+                    self.beat()
+                except Exception:
+                    return
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def store_listener(store, ttl=6.0):
+    """Membership source over the TCP store: hosts whose lease (beat
+    timestamp) is fresher than ttl (reference manager.py host registry
+    read + lease filtering)."""
+
+    def listen():
+        try:
+            n = int(store.add("elastic/join", 0))
+        except Exception:
+            return []
+        now = time.time()
+        live = []
+        seen = set()
+        for i in range(n):
+            try:
+                ep = store.get(f"elastic/host/{i}", timeout=2.0)
+                ep = ep.decode() if isinstance(ep, bytes) else str(ep)
+                if ep in seen:
+                    continue
+                seen.add(ep)
+                raw = store.get(f"elastic/beat/{ep}", timeout=2.0)
+                ts = float(raw.decode() if isinstance(raw, bytes) else raw)
+            except Exception:
+                continue
+            if now - ts <= ttl:
+                live.append(ep)
+        return live
+
+    return listen

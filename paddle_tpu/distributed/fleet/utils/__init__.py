@@ -5,6 +5,13 @@ log_util; tensor fusion is subsumed by XLA's comm bucketing)."""
 from __future__ import annotations
 
 from ..recompute import recompute, recompute_sequential  # noqa: F401
+from ...meta_parallel.sequence_parallel_utils import (  # noqa: F401
+    register_sequence_parallel_allreduce_hooks,
+)
+from .ps_util import DistributedInfer  # noqa: F401
+from . import tensor_fusion_helper  # noqa: F401
+from .tensor_fusion_helper import (  # noqa: F401
+    FusedCommBuffer, fused_parameters)
 from . import fs  # noqa: F401
 from . import log_util  # noqa: F401
 from . import timer_helper  # noqa: F401
@@ -13,4 +20,6 @@ from .log_util import logger, set_log_level  # noqa: F401
 from .timer_helper import get_timers, set_timers  # noqa: F401
 
 __all__ = ['LocalFS', 'HDFSClient', 'recompute', 'recompute_sequential',
-           'logger', 'set_log_level', 'get_timers', 'set_timers']
+           'logger', 'set_log_level', 'get_timers', 'set_timers',
+           'DistributedInfer', 'tensor_fusion_helper', 'FusedCommBuffer',
+           'fused_parameters']

@@ -1,0 +1,171 @@
+"""Fused communication buffers (reference:
+python/paddle/distributed/fleet/utils/tensor_fusion_helper.py —
+flatten_dense_tensors :40, FusedCommBuffer :~300, fused_parameters :~600;
+also sharding stage-1 V2's fused buffers,
+dygraph_sharding_optimizer.py:438).
+
+TPU design: a fusion group's gradients concatenate into ONE flat buffer
+(dtype-bucketed, size-capped), the group communicates with a SINGLE
+collective, and the views scatter back — collapsing N small all-reduces
+into one large one. The flat buffer is built functionally (concat ->
+collective -> split), so it is donation-safe under jit: XLA aliases the
+slices in place and the "buffer" never exists as a persistent copy."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["HOOK_ACTION", "flatten_dense_tensors", "FusedCommBuffer",
+           "fused_parameters", "obtain_storage"]
+
+
+class HOOK_ACTION:
+    ALL_REDUCE = 0
+    REDUCE = 1
+    REDUCE_SCATTER = 2
+
+
+def flatten_dense_tensors(tensors):
+    """Concatenate tensors into one flat Tensor; returns (flat, specs)
+    where specs = [(offset, size, shape), ...] to rebuild the views
+    (reference tensor_fusion_helper.py flatten_dense_tensors)."""
+    import paddle_tpu as paddle
+
+    specs = []
+    off = 0
+    flats = []
+    for t in tensors:
+        n = int(np.prod(t.shape)) if len(t.shape) else 1
+        specs.append((off, n, list(t.shape)))
+        flats.append(paddle.reshape(t, [-1]))
+        off += n
+    return paddle.concat(flats, axis=0), specs
+
+
+def _unflatten(flat, specs):
+    import paddle_tpu as paddle
+
+    outs = []
+    for off, n, shape in specs:
+        outs.append(paddle.reshape(flat[off:off + n], shape))
+    return outs
+
+
+class FusedCommBuffer:
+    """One fusion group: a set of same-dtype params whose grads communicate
+    as a single flat collective (reference FusedCommBuffer)."""
+
+    def __init__(self, id, params, comm_group=None, acc_steps=1,
+                 act=HOOK_ACTION.ALL_REDUCE, dst=-1):
+        self._id = id
+        self._params = list(params)
+        self._comm_group = comm_group
+        self._acc_steps = acc_steps
+        self._act = act
+        self._dst = dst
+        self._tasks = []
+
+    @property
+    def params(self):
+        return self._params
+
+    def grads(self):
+        gs = []
+        for p in self._params:
+            if p._grad is None:
+                raise RuntimeError(
+                    f"param {p.name} has no grad to fuse (run backward "
+                    "first)")
+            gs.append(p._grad)
+        return gs
+
+    def comm_grads(self):
+        """ONE collective for the whole group: flatten -> collective ->
+        scatter views back into each param's grad."""
+        from ... import communication as comm
+        import paddle_tpu as paddle
+
+        flat, specs = flatten_dense_tensors(self.grads())
+        if self._act == HOOK_ACTION.ALL_REDUCE:
+            comm.all_reduce(flat, group=self._comm_group)
+        elif self._act == HOOK_ACTION.REDUCE:
+            comm.reduce(flat, dst=self._dst, group=self._comm_group)
+        elif self._act == HOOK_ACTION.REDUCE_SCATTER:
+            # sharding path: each rank owns ONE contiguous slice of the
+            # flat buffer (its optimizer shard). Per-param grads cannot be
+            # reconstructed from a local shard (a param may straddle the
+            # shard boundary), so the shard itself is the product — the
+            # sharded-optimizer caller consumes it directly (reference
+            # dygraph_sharding_optimizer.py:438 fused buffers)
+            nranks = getattr(self._comm_group, "nranks", 1) or 1
+            if int(flat.shape[0]) % nranks:
+                raise ValueError(
+                    f"fused buffer size {int(flat.shape[0])} not divisible "
+                    f"by nranks {nranks} for reduce_scatter")
+            shard = paddle.zeros([int(flat.shape[0]) // nranks], flat.dtype)
+            comm.reduce_scatter(shard, flat, group=self._comm_group)
+            return shard
+        for p, g in zip(self._params, _unflatten(flat, specs)):
+            p._grad._data = g._data
+        return flat
+
+    # reference surface
+    def scale_grads(self, scale=None):
+        import paddle_tpu as paddle
+        n = scale
+        if n is None:
+            n = getattr(self._comm_group, "nranks", 1) or 1
+        for p in self._params:
+            if p._grad is not None:
+                p._grad._data = (p._grad / float(n))._data
+
+    def comm_and_scale(self):
+        self.comm_grads()
+        self.scale_grads()
+
+
+def obtain_storage(parameters, dtype=None, **kwargs):
+    """Group `parameters` (optionally filtered by dtype) into one fused
+    view storage; returns the flat Tensor + specs (reference
+    obtain_storage builds the shared storage the views alias)."""
+    ps = [p for p in parameters
+          if dtype is None or str(p.dtype).endswith(str(dtype))]
+    if not ps:
+        return None, []
+    return flatten_dense_tensors(ps)
+
+
+def fused_parameters(parameters, use_main_grad=False, fuse_param=False,
+                     comm_overlap=False, comm_group=None, act=None,
+                     dst=-1, acc_step=1, scale_after_comm=True,
+                     group_size=128 * 1024 * 1024):
+    """Bucket parameters into dtype-homogeneous, size-capped fusion groups
+    (reference fused_parameters): returns (parameters, comm_buffers)."""
+    if act is None:
+        act = HOOK_ACTION.ALL_REDUCE
+    buckets = {}
+    for p in parameters:
+        if p.stop_gradient:
+            continue
+        buckets.setdefault(str(p.dtype), []).append(p)
+    buffers = []
+    bid = 0
+    for dtype, ps in buckets.items():
+        itemsize = np.dtype(
+            dtype.replace("paddle.", "").split(".")[-1]).itemsize \
+            if "float" in dtype or "int" in dtype else 4
+        cur, cur_bytes = [], 0
+        for p in ps:
+            n = int(np.prod(p.shape)) if len(p.shape) else 1
+            if cur and cur_bytes + n * itemsize > group_size:
+                buffers.append(FusedCommBuffer(bid, cur, comm_group,
+                                               acc_step, act, dst))
+                bid += 1
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes += n * itemsize
+        if cur:
+            buffers.append(FusedCommBuffer(bid, cur, comm_group, acc_step,
+                                           act, dst))
+            bid += 1
+    return list(parameters), buffers

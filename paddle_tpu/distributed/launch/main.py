@@ -46,6 +46,15 @@ def _parse(argv):
                         "down and relaunches the pod (reference "
                         "fleet/elastic/manager.py scale events)")
     p.add_argument("--elastic_poll_interval", type=float, default=0.5)
+    p.add_argument("--elastic_store", default=None,
+                   help="elastic mode over the TCP store (host:port): pod "
+                        "membership comes from lease/TTL heartbeats "
+                        "(fleet.elastic.StoreHeartbeatAgent) instead of a "
+                        "file — the reference's etcd-backed manager")
+    p.add_argument("--elastic_ttl", type=float, default=6.0)
+    p.add_argument("--elastic_endpoint", default=None,
+                   help="this pod's endpoint name to register+heartbeat in "
+                        "the elastic store (default ip:node_rank)")
     p.add_argument("--run_mode", default="collective",
                    choices=["collective", "ps", "rpc"],
                    help="collective (default), parameter-server, or rpc pods")
@@ -184,7 +193,20 @@ def launch(argv=None):
     restarts = [0] * len(procs)
 
     elastic = None
-    if args.elastic_membership_file:
+    if args.elastic_store:
+        from ..fleet.elastic import (ElasticManager, ElasticStatus,
+                                     StoreHeartbeatAgent, store_listener)
+        from ..store import TCPStore
+        host, port = args.elastic_store.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=False)
+        endpoint = args.elastic_endpoint or \
+            f"{host}:{args.node_rank}"
+        agent = StoreHeartbeatAgent(store, endpoint,
+                                    ttl=args.elastic_ttl).start()
+        elastic = ElasticManager(listener=store_listener(
+            store, ttl=args.elastic_ttl), min_hosts=1, max_hosts=1 << 30,
+            scale=1)
+    elif args.elastic_membership_file:
         from ..fleet.elastic import ElasticManager, ElasticStatus
 
         def file_listener(path=args.elastic_membership_file):

@@ -1,0 +1,154 @@
+"""Cross-parallel-config checkpoint conversion (VERDICT r4 "do this" #7;
+reference: auto_parallel/static/converter.py, fleet/utils/
+pp_parallel_adaptor.py): a dp2 x mp2 x pp2-saved distributed checkpoint
+loads into dp4 x mp2, into dp2 x pp4 (different stack order), and into an
+unwrapped single-process model — resharding/re-permuting on load — with
+loss parity after resume."""
+
+import copy
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+from paddle_tpu.models import GPTConfig, gpt_for_pipeline
+
+
+def _reset_mesh():
+    from paddle_tpu.distributed.topology import reset_topology_state
+    reset_topology_state()
+
+
+@pytest.fixture(autouse=True)
+def clean_mesh():
+    _reset_mesh()
+    yield
+    _reset_mesh()
+
+
+_CFG = GPTConfig(vocab_size=128, max_position_embeddings=16, hidden_size=32,
+                 num_layers=4, num_heads=4)
+
+
+def _build(dp, mp, pp, accumulate=2):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": pp, "sharding_degree": 1,
+                               "sep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": accumulate}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(7)
+    pl = gpt_for_pipeline(_CFG, num_stages=pp)
+    model = fleet.distributed_model(pl)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.05, parameters=model.parameters()))
+    return pl, model, opt
+
+
+def _batch():
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, _CFG.vocab_size, (4, 13))
+    return (paddle.to_tensor(ids[:, :-1].astype(np.int32)),
+            paddle.to_tensor(ids[:, 1:].astype(np.int64)))
+
+
+def _loss_of(model, pl, x, y, pp):
+    if pp > 1:
+        out = model.forward(x)
+    else:
+        out = model(x)
+    return float(pl._loss_fn(out, y))
+
+
+def test_save_a_load_b_matrix(tmp_path):
+    x, y = _batch()
+    # --- config A: dp2 x mp2 x pp2 — train one step, save ---------------
+    pl_a, model_a, opt_a = _build(2, 2, 2)
+    loss0 = float(model_a.train_batch([x, y], opt_a))
+    ref_loss = _loss_of(model_a, pl_a, x, y, pp=2)   # post-step loss
+    path = str(tmp_path / "ckpt_a")
+    ckpt.save_state_dict(model_a.state_dict(), path)
+
+    # --- load into B1: dp4 x mp2 (pp1: unstacked blocks) ----------------
+    from paddle_tpu.distributed.checkpoint.converter import \
+        load_checkpoint_into_blocks
+    _reset_mesh()
+    pl_b, model_b, opt_b = _build(4, 2, 1)
+    load_checkpoint_into_blocks(pl_b, path)
+    got = _loss_of(model_b, pl_b, x, y, pp=1)
+    np.testing.assert_allclose(got, ref_loss, rtol=1e-3)
+    # resume training must keep working on the new mesh
+    out_b = model_b(x)
+    loss_b = pl_b._loss_fn(out_b, y)
+    loss_b.backward()
+    opt_b.step()
+    opt_b.clear_grad()
+    assert np.isfinite(float(loss_b))
+
+    # --- load into B2: dp2 x pp4 (different stack permutation) ----------
+    _reset_mesh()
+    pl_c, model_c, opt_c = _build(2, 1, 4)
+    ckpt.load_state_dict(model_c.state_dict(), path)
+    got_c = _loss_of(model_c, pl_c, x, y, pp=4)
+    np.testing.assert_allclose(got_c, ref_loss, rtol=1e-3)
+    l2 = float(model_c.train_batch([x, y], opt_c))
+    assert np.isfinite(l2) and l2 < loss0 + 1.0
+
+    # --- load into an UNWRAPPED single-process model --------------------
+    _reset_mesh()
+    paddle.seed(11)
+    pl_single = gpt_for_pipeline(_CFG, num_stages=1)
+    load_checkpoint_into_blocks(pl_single, path)
+    out = pl_single(x)
+    got_s = float(pl_single._loss_fn(out, y))
+    np.testing.assert_allclose(got_s, ref_loss, rtol=1e-3)
+
+
+def test_vpp_stack_order_roundtrip(tmp_path):
+    """pp2 x v2 (interleaved) saved -> pp4 x v1 loaded: the recorded stack
+    order re-permutes rows correctly."""
+    x, y = _batch()
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(7)
+    from paddle_tpu.distributed.meta_parallel import LayerDesc, PipelineLayer
+
+    class Blk(nn.Layer):
+        def __init__(self, h):
+            super().__init__()
+            self.fc = nn.Linear(h, h)
+
+        def forward(self, v):
+            return v + paddle.nn.functional.gelu(self.fc(v))
+
+    def build_pl(stages, virtual):
+        paddle.seed(7)
+        return PipelineLayer(layers=[LayerDesc(Blk, 8) for _ in range(8)],
+                             num_stages=stages, loss_fn=nn.MSELoss(),
+                             num_virtual_pipeline_stages=virtual)
+
+    pl_a = build_pl(2, 2)
+    model_a = fleet.distributed_model(pl_a)
+    xb = paddle.to_tensor(np.random.default_rng(0)
+                          .standard_normal((4, 8)).astype(np.float32))
+    ref = model_a.forward(xb).numpy()
+    path = str(tmp_path / "vpp_ckpt")
+    ckpt.save_state_dict(model_a.state_dict(), path)
+
+    _reset_mesh()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 4, "sharding_degree": 1,
+                               "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    pl_b = build_pl(4, 1)
+    model_b = fleet.distributed_model(pl_b)
+    ckpt.load_state_dict(model_b.state_dict(), path)
+    got = model_b.forward(xb).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
