@@ -21,6 +21,12 @@ import sys
 import time
 import traceback
 
+# this process IS the bench: opt into TPU before any paddle_tpu import so
+# the package-init axon defense never mutates JAX_PLATFORMS here (a cpu
+# default set in the parent would leak into the probe/child subprocess
+# envs and silently force the whole TPU bench onto CPU)
+os.environ.setdefault("PADDLE_TPU_BENCH", "1")
+
 _PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
 _RUN_TIMEOUT = int(os.environ.get("BENCH_RUN_TIMEOUT", "1800"))
 _PARTIAL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -169,10 +175,12 @@ def run_gpt_bench(dev, on_tpu):
         # b=8 exhausts HBM on a shared v5e slice (full-residual autograd);
         # b=4 fits and the MXU stays saturated at seq 1024
         batch, seq, steps, warmup = 4, 1024, 20, 3
-    else:  # CPU smoke so the harness itself stays testable
+    else:  # CPU smoke so the harness itself stays testable. Fixed work,
+        # LONG steady state (VERDICT r4 weak #8: 5 steps measured dispatch
+        # overhead; a -3.5%% delta sat inside the noise floor unnoticed)
         cfg = GPTConfig(vocab_size=1024, max_position_embeddings=256,
                         hidden_size=256, num_layers=4, num_heads=8)
-        batch, seq, steps, warmup = 4, 256, 5, 2
+        batch, seq, steps, warmup = 4, 256, 20, 3
 
     paddle.seed(0)
     model = GPT(cfg)
@@ -453,6 +461,24 @@ def run_kernel_ab(dev):
     res["softmax_ce_pallas_ms"] = round(pal, 3)
     res["softmax_ce_xla_ms"] = round(xla, 3)
     res["softmax_ce_speedup"] = round(xla / pal, 3)
+
+    # serving decode step through fused_multi_transformer: mmha Pallas
+    # kernel vs the einsum fallback, Llama-7B-ish single layer
+    from paddle_tpu.ops.kernels import _common as kcommon
+    from paddle_tpu.ops.kernels import mmha_pallas as mp
+    bb, hh2, dd, tt = 8, 32, 128, 2048
+    q1 = jnp.asarray(rng.standard_normal((bb, 1, hh2, dd)), jnp.bfloat16)
+    kbuf = jnp.asarray(rng.standard_normal((bb, hh2, tt, dd)), jnp.bfloat16)
+    vbuf = jnp.asarray(rng.standard_normal((bb, hh2, tt, dd)), jnp.bfloat16)
+    pos = jnp.int32(tt - 1)
+    if mp.use_kernel(q1.shape, kbuf.shape, kbuf.dtype):
+        pal = timed(lambda a: mp.mmha_decode(a, kbuf, vbuf, pos,
+                                             interpret=kcommon
+                                             .interpret_mode()), q1)
+        xla = timed(lambda a: mp.reference_mmha(a, kbuf, vbuf, pos), q1)
+        res["serving_mmha_decode_pallas_ms"] = round(pal, 3)
+        res["serving_mmha_decode_xla_ms"] = round(xla, 3)
+        res["serving_mmha_decode_speedup"] = round(xla / pal, 3)
     return res
 
 
@@ -616,8 +642,17 @@ def _peak_flops(dev):
 # ---------------------------------------------------------------------------
 
 def _probe_tpu():
-    """Subprocess probe: is a TPU-ish backend alive? Hard timeout."""
-    code = ("import jax; d=jax.devices()[0]; "
+    """Subprocess probe: is a TPU-ish backend alive? Hard timeout. When the
+    environment explicitly pins a non-TPU platform and there is no tunnel,
+    nothing can be probed — skip straight to CPU (window-drill speed: the
+    first CPU measurement should land < 60s). An UNSET JAX_PLATFORMS still
+    probes: a genuine local TPU (libtpu, no axon tunnel) must be found."""
+    _plat = os.environ.get("JAX_PLATFORMS")
+    if "PALLAS_AXON_POOL_IPS" not in os.environ and \
+            _plat is not None and "tpu" not in _plat:
+        return None, None
+    code = ("import os; os.environ['PADDLE_TPU_BENCH']='1'; "
+            "import jax; d=jax.devices()[0]; "
             "print(d.platform, getattr(d,'device_kind',''))")
     for attempt in range(2):
         try:
@@ -638,12 +673,16 @@ def _probe_tpu():
 
 
 def _run_child(mode):
-    """Run the bench in a subprocess; returns parsed JSON dict or None."""
+    """Run the bench in a subprocess; returns parsed JSON dict or None.
+    PADDLE_TPU_BENCH=1 marks the child as a TPU-opted process, exempting
+    it from the package-init axon defense (which forces everyone else to
+    the CPU backend)."""
     try:
+        env = dict(os.environ, PADDLE_TPU_BENCH="1")
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), mode],
             capture_output=True, text=True, timeout=_RUN_TIMEOUT,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
         for line in reversed(out.stdout.strip().splitlines()):
             line = line.strip()
             if line.startswith("{"):
@@ -679,20 +718,26 @@ def _child_main(mode):
     """--child-tpu / --child-cpu: actually run the workload, print JSON."""
     try:
         if mode == "--child-tpu":
+            os.environ.setdefault("PADDLE_TPU_BENCH", "1")
             import jax
             dev = jax.devices()[0]
             result, gpt, errs = None, None, {}
+            # window ordering (VERDICT r4 #6): the GPT bench compiles in a
+            # fraction of the Llama one — land the first number fast, then
+            # go for the north-star model while the window holds
+            try:
+                gpt = _with_alarm(420, run_gpt_bench, dev,
+                                  dev.platform in ("tpu", "axon"))
+                if gpt is not None:
+                    _write_partial(gpt)
+            except Exception:
+                errs["gpt_bench_error"] = traceback.format_exc(limit=4)[:1200]
             try:
                 # north-star family: primary metric when it runs
                 result = _with_alarm(900, run_llama_bench, dev)
             except Exception:
                 errs["llama_bench_error"] = \
                     traceback.format_exc(limit=4)[:1200]
-            try:
-                gpt = _with_alarm(420, run_gpt_bench, dev,
-                                  dev.platform in ("tpu", "axon"))
-            except Exception:
-                errs["gpt_bench_error"] = traceback.format_exc(limit=4)[:1200]
             if result is not None and gpt is not None:
                 result["extra"]["gpt2_124m_tokens_per_s"] = gpt["value"]
                 result["extra"]["gpt2_124m_mfu"] = gpt["extra"]["mfu"]
@@ -729,25 +774,12 @@ def _child_main(mode):
 
 
 def _acquire_bench_lock():
-    """Serialize TPU access across bench processes: the axon tunnel is
-    single-client, so a watcher run and a round-end driver run racing each
-    other makes BOTH probes hang and fall back to CPU. Blocking flock with
-    a cap; on timeout proceed anyway (worst case is the old behavior)."""
-    import fcntl
-    cap = int(os.environ.get("BENCH_LOCK_TIMEOUT", "2400"))
-    try:
-        f = open("/tmp/paddle_tpu_bench.lock", "w")
-    except OSError:
-        return None  # lock file unusable (another user owns it): proceed
-    deadline = time.time() + cap
-    while True:
-        try:
-            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
-            return f
-        except OSError:
-            if time.time() >= deadline:
-                return f
-            time.sleep(10)
+    """Serialize TPU access across bench processes via the shared
+    package-level lock (paddle_tpu.device.backend_init_lock): the axon
+    tunnel is single-client, so a watcher run and a round-end driver run
+    racing each other makes BOTH probes hang and fall back to CPU."""
+    from paddle_tpu.device import backend_init_lock
+    return backend_init_lock()
 
 
 def main():

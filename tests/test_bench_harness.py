@@ -64,3 +64,39 @@ def test_bench_watch_tpu_result_detection():
     assert not bw.is_tpu_result(
         {"metric": "gpt2_cpu_smoke_tokens_per_sec", "extra": {"device": "cpu"}})
     assert not bw.is_tpu_result({"metric": "x", "extra": {}})
+
+
+
+def test_perf_gate_best_of_last3_history(tmp_path):
+    """r5 gate discipline (VERDICT r4 #10): baseline = best of the last 3
+    rounds, 3% tolerance, signed delta printed."""
+    import json
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gate = os.path.join(root, "tools", "perf_gate.py")
+    vals = {1: 1000.0, 2: 1573.0, 3: 1400.0, 4: 1500.0}
+    for r, v in vals.items():
+        with open(tmp_path / f"BENCH_r{r:02d}.json", "w") as f:
+            json.dump({"metric": "toks", "value": v}, f)
+    cur = tmp_path / "cur.json"
+    # best of last 3 (r2..r4) = 1573; 1540 is -2.1% -> OK at 3%
+    with open(cur, "w") as f:
+        json.dump({"metric": "toks", "value": 1540.0}, f)
+    out = subprocess.run(
+        [sys.executable, gate, "--history",
+         str(tmp_path / "BENCH_r*.json"), "--current", str(cur)],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout
+    assert "best-of-last-3" in out.stdout and "r02" in out.stdout
+    assert "delta -2.10%" in out.stdout, out.stdout
+    # 1518 is -3.5% below the best -> REGRESSION (the r4 case, now loud)
+    with open(cur, "w") as f:
+        json.dump({"metric": "toks", "value": 1518.0}, f)
+    out = subprocess.run(
+        [sys.executable, gate, "--history",
+         str(tmp_path / "BENCH_r*.json"), "--current", str(cur)],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+    assert "REGRESSION" in out.stdout

@@ -25,6 +25,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+os.environ.setdefault("PADDLE_TPU_BENCH", "1")  # bench-family process
 import bench  # noqa: E402  (stdlib-only module; shares the subprocess probe)
 LOG = os.path.join(REPO, "BENCH_WATCH.log")
 RUNS = os.path.join(REPO, "BENCH_TPU_RUNS.jsonl")
@@ -54,7 +55,8 @@ def probe():
 def run_bench():
     """Run the full bench suite; return parsed JSON dict or None."""
     try:
-        env = dict(os.environ, BENCH_ASSUME_TPU="1")  # we just probed
+        env = dict(os.environ, BENCH_ASSUME_TPU="1",  # we just probed
+                   PADDLE_TPU_BENCH="1")
         out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                              capture_output=True, text=True, env=env,
                              timeout=BENCH_TIMEOUT, cwd=REPO)
@@ -114,7 +116,8 @@ def run_kernel_proof():
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools",
                                           "tpu_kernel_proof.py")],
-            capture_output=True, text=True, timeout=BENCH_TIMEOUT, cwd=REPO)
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT, cwd=REPO,
+            env=dict(os.environ, PADDLE_TPU_BENCH="1"))
         lines = out.stdout.strip().splitlines()
         log("kernel proof rc=%d %s" % (out.returncode,
                                        lines[0] if lines else ""))

@@ -5,10 +5,16 @@ regressions).
 
 Usage:
   python tools/perf_gate.py --baseline BENCH_old.json --current BENCH_new.json
-      [--tolerance 0.05]
+      [--tolerance 0.03]
+  python tools/perf_gate.py --history "BENCH_r*.json" --current BENCH_new.json
 
 Each file is the bench.py one-line JSON ({"metric", "value", ...}); value is
 throughput (higher better). Exit 1 if current < baseline * (1 - tolerance).
+
+Round-over-round discipline (VERDICT r4 #10): with --history, the baseline
+is the BEST of the last 3 recorded rounds for the same metric — a slow
+round cannot quietly lower the bar for the next one — tolerance tightens
+to 3%, and the signed delta is printed so a regression fails loudly.
 """
 
 from __future__ import annotations
@@ -38,14 +44,47 @@ def load_value(path):
     return d.get("metric"), float(d.get("value", 0.0))
 
 
+def best_of_history(pattern, metric, last_n=3):
+    """Best value among the last `last_n` round files matching `pattern`
+    whose metric equals `metric` (reference analog: the op-benchmark CI
+    compares against a rolling recorded baseline)."""
+    import glob
+    import re
+
+    def round_no(p):
+        m = re.search(r"r(\d+)", p)
+        return int(m.group(1)) if m else -1
+
+    files = sorted(glob.glob(pattern), key=round_no)[-last_n:]
+    best = (None, 0.0)
+    for p in files:
+        try:
+            m, v = load_value(p)
+        except Exception:
+            continue
+        if m == metric and v > best[1]:
+            best = (p, v)
+    return best
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--baseline", required=True)
+    ap.add_argument("--baseline")
+    ap.add_argument("--history", help="glob of prior BENCH_r*.json files; "
+                    "baseline = best of the last 3 with the same metric")
     ap.add_argument("--current", required=True)
-    ap.add_argument("--tolerance", type=float, default=0.05)
+    ap.add_argument("--tolerance", type=float, default=0.03)
     args = ap.parse_args()
-    bm, bv = load_value(args.baseline)
     cm, cv = load_value(args.current)
+    if args.history:
+        src, bv = best_of_history(args.history, cm)
+        bm = cm if src else None
+        if src:
+            print(f"perf gate: baseline = best-of-last-3 {src} ({bv:.1f})")
+    elif args.baseline:
+        bm, bv = load_value(args.baseline)
+    else:
+        ap.error("need --baseline or --history")
     if bv <= 0:
         print(f"perf gate: baseline has no usable value ({bm}={bv}); pass")
         return 0
@@ -53,9 +92,11 @@ def main():
         print(f"perf gate: metric changed {bm} -> {cm}; pass (no comparison)")
         return 0
     floor = bv * (1 - args.tolerance)
+    delta = (cv - bv) / bv if bv else 0.0
     status = "OK" if cv >= floor else "REGRESSION"
     print(f"perf gate [{status}] {cm}: current {cv:.1f} vs baseline "
-          f"{bv:.1f} (floor {floor:.1f}, tol {args.tolerance:.0%})")
+          f"{bv:.1f} (delta {delta:+.2%}, floor {floor:.1f}, "
+          f"tol {args.tolerance:.0%})")
     return 0 if cv >= floor else 1
 
 

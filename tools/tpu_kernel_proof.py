@@ -21,6 +21,11 @@ import sys
 import time
 import traceback
 
+# TPU-opted process: exempt from the package-init axon defense (which
+# forces non-bench processes onto the CPU backend)
+if os.environ.get("PROOF_INTERPRET") != "1":
+    os.environ.setdefault("PADDLE_TPU_BENCH", "1")
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "TPU_KERNEL_PROOF.json")
