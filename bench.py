@@ -462,6 +462,26 @@ def run_kernel_ab(dev):
     res["softmax_ce_xla_ms"] = round(xla, 3)
     res["softmax_ce_speedup"] = round(xla / pal, 3)
 
+    # fused dropout+residual-add fwd+bwd: the in-kernel counter-hash mask
+    # vs the XLA threefry composite (which materializes the mask to HBM)
+    from paddle_tpu.ops.kernels import dropout_add_pallas as dak
+    xr = jnp.asarray(rng.standard_normal((8192, 4096)), jnp.bfloat16)
+    rr = jnp.asarray(rng.standard_normal((8192, 4096)), jnp.bfloat16)
+    sd = jnp.int32(17)
+    key = jax.random.PRNGKey(17)
+
+    def _xla_da(a):
+        keep = jax.random.bernoulli(key, 0.9, a.shape)
+        return jnp.where(keep, a / 0.9, 0).astype(a.dtype) + rr
+
+    pal = timed(jax.grad(lambda a: jnp.sum(
+        dak.dropout_add(a, rr, sd, 0.1).astype(jnp.float32))), xr)
+    xla = timed(jax.grad(lambda a: jnp.sum(_xla_da(a).astype(jnp.float32))),
+                xr)
+    res["dropout_add_pallas_ms"] = round(pal, 3)
+    res["dropout_add_xla_ms"] = round(xla, 3)
+    res["dropout_add_speedup"] = round(xla / pal, 3)
+
     # serving decode step through fused_multi_transformer: mmha Pallas
     # kernel vs the einsum fallback, Llama-7B-ish single layer
     from paddle_tpu.ops.kernels import _common as kcommon

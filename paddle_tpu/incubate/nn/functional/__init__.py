@@ -153,9 +153,36 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
 def fused_dropout_add(x, y, p=0.5, training=True, mode='upscale_in_train',
                       name=None):
     """Reference: incubate/nn/functional/fused_dropout_add.py:22 (one fused
-    kernel for dropout(x) + y). On TPU XLA fuses the chain; the framework
-    RNG keeps it deterministic per seed."""
+    kernel for dropout(x) + y; the CUDA kernel saves a seed/offset pair and
+    its grad kernel regenerates the mask). On TPU the Pallas kernel
+    (ops/kernels/dropout_add_pallas.py) goes one further: the mask is a
+    counter-hash of (seed, element index) computed in VMEM in BOTH passes,
+    so it never exists in HBM at all. Off-TPU / other modes: the XLA
+    composite with the framework RNG."""
+    from ....core.flags import flag
     from ....nn import functional as F
+    from ....ops.kernels import _common as kern
+    from ....ops.kernels import dropout_add_pallas as dak
+
+    xt = F.as_tensor(x)
+    yt = F.as_tensor(y)
+    if (training and mode == 'upscale_in_train'
+            and kern.available() and flag("use_pallas_kernels")
+            and xt.shape == yt.shape and xt.dtype == yt.dtype
+            and dak.use_kernel(tuple(xt.shape), p)):
+        import jax
+        import jax.numpy as jnp
+
+        from ....autograd.function import apply
+        from ....core import generator as gen_mod
+
+        key = gen_mod.default_generator.split()
+        seed = jax.random.randint(key, (), 0, 2147483647, dtype=jnp.int32)
+
+        def f(a, b, s):
+            return dak.dropout_add(a, b, s, float(p),
+                                   kern.interpret_mode())
+        return apply(f, xt, yt, F.as_tensor(seed), name="fused_dropout_add")
     return F.dropout(x, p, training=training, mode=mode) + y
 
 

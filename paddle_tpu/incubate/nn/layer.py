@@ -52,8 +52,8 @@ class FusedDropoutAdd(Layer):
         self.mode = mode
 
     def forward(self, x, y):
-        return F.dropout(x, self.p, training=self.training,
-                         mode=self.mode) + y
+        return IF.fused_dropout_add(x, y, p=self.p,
+                                    training=self.training, mode=self.mode)
 
     def extra_repr(self):
         return f"p={self.p}, mode={self.mode}"

@@ -1,0 +1,191 @@
+"""Fused dropout + residual-add Pallas TPU kernel with in-kernel mask
+generation.
+
+Reference analog: paddle/phi/kernels/fusion/gpu/fused_dropout_add_kernel.cu
+(+ fused_dropout_add_grad_kernel.cu), surfaced as
+incubate.nn.functional.fused_dropout_add. The reference fuses the curand
+mask draw, the scale and the residual add into one kernel, and saves a
+seed/offset pair (NOT the mask) so the grad kernel can regenerate the
+mask — paddle/phi/kernels/fusion/gpu/fused_dropout_add_kernel.cu stores
+`seed_offset` for the backward.
+
+This kernel keeps that design but TPU-first: the mask never exists in HBM
+in either direction. Forward and backward both derive the keep-mask from
+a counter-based hash of (seed, global element index) computed on the VPU:
+
+    bits = murmur3_fmix32(idx ^ seed * 0x9e3779b9)
+    keep = bits >= floor(p * 2^32)
+    y    = keep ? x / (1 - p) : 0  (+ residual)      [upscale_in_train]
+    dx   = keep ? dy / (1 - p) : 0 ;  dresidual = dy
+
+A hash of the *global flat index* (not a stateful PRNG) makes the stream
+independent of the row-block size, bit-exact between the Pallas
+interpreter and compiled Mosaic (pltpu.prng_random_bits is neither: its
+interpret stub ignores the seed), and trivially regenerable in the
+backward from the saved int32 seed — the only residual beyond the primal
+shapes. The XLA composite, by contrast, threads a threefry key and keeps
+the bool mask alive from forward to backward (one full-tensor HBM write +
+read that this kernel deletes).
+
+Public entry: `dropout_add(x, residual, seed, p)` with custom_vjp;
+`incubate.nn.functional.fused_dropout_add` dispatches here on TPU for
+training-mode upscale_in_train. murmur3 finalizer constants are public
+domain (Austin Appleby).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import pad_to_block, pick_row_block
+
+_GOLDEN = 0x9E3779B9  # 2^32 / phi; seed diffusion multiplier
+
+
+def _pick_rows(n_rows, hidden):
+    # ~4 f32 row buffers live at once (x/dy, bits, keep-scaled, residual/y)
+    return pick_row_block(n_rows, hidden * 4 * 4, 4 * 1024 * 1024,
+                          key="dropout_add")
+
+
+def _fmix32(h):
+    """murmur3 32-bit finalizer: full avalanche, 4 mul/xor/shift VPU ops."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _keep_bits(seed_ref, rows, hidden, pid):
+    """uint32 hash lattice for one [rows, hidden] block at grid step pid."""
+    r = jax.lax.broadcasted_iota(jnp.uint32, (rows, hidden), 0)
+    c = jax.lax.broadcasted_iota(jnp.uint32, (rows, hidden), 1)
+    grow = jnp.uint32(pid) * jnp.uint32(rows) + r
+    idx = grow * jnp.uint32(hidden) + c
+    return _fmix32(idx ^ (seed_ref[0].astype(jnp.uint32)
+                          * jnp.uint32(_GOLDEN)))
+
+
+def _fwd_kernel(seed_ref, x_ref, res_ref, y_ref, *, threshold, scale):
+    rows, hidden = x_ref.shape
+    bits = _keep_bits(seed_ref, rows, hidden, pl.program_id(0))
+    x = x_ref[...].astype(jnp.float32)
+    kept = jnp.where(bits >= jnp.uint32(threshold), x * jnp.float32(scale),
+                     jnp.float32(0.0))
+    y_ref[...] = (kept + res_ref[...].astype(jnp.float32)).astype(y_ref.dtype)
+
+
+def _bwd_kernel(seed_ref, dy_ref, dx_ref, *, threshold, scale):
+    rows, hidden = dy_ref.shape
+    bits = _keep_bits(seed_ref, rows, hidden, pl.program_id(0))
+    dy = dy_ref[...].astype(jnp.float32)
+    dx_ref[...] = jnp.where(bits >= jnp.uint32(threshold),
+                            dy * jnp.float32(scale),
+                            jnp.float32(0.0)).astype(dx_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("threshold", "scale", "interpret",
+                                    "rows"))
+def _fwd(x2, res2, seed, threshold, scale, interpret, rows):
+    n, h = x2.shape
+    x2p = pad_to_block(x2, rows)
+    np_ = x2p.shape[0]
+    spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
+    with jax.enable_x64(False):
+        y = pl.pallas_call(
+            functools.partial(_fwd_kernel, threshold=threshold, scale=scale),
+            grid=(np_ // rows,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), spec, spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((np_, h), x2.dtype),
+            interpret=interpret,
+        )(seed.reshape(1).astype(jnp.int32), x2p, pad_to_block(res2, rows))
+    return y[:n]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("threshold", "scale", "interpret",
+                                    "rows"))
+def _bwd(dy2, seed, threshold, scale, interpret, rows):
+    n, h = dy2.shape
+    dy2p = pad_to_block(dy2, rows)
+    np_ = dy2p.shape[0]
+    spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
+    with jax.enable_x64(False):
+        dx = pl.pallas_call(
+            functools.partial(_bwd_kernel, threshold=threshold, scale=scale),
+            grid=(np_ // rows,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((np_, h), dy2.dtype),
+            interpret=interpret,
+        )(seed.reshape(1).astype(jnp.int32), dy2p)
+    return dx[:n]
+
+
+def _params(p):
+    """(threshold, scale) for drop probability p — static per compile."""
+    threshold = min(int(p * 4294967296.0), 4294967295)
+    return threshold, 1.0 / (1.0 - p)
+
+
+def _primal(x, residual, seed, p, interpret=False):
+    shp = x.shape
+    h = shp[-1]
+    rows = _pick_rows(math.prod(shp[:-1]), h)
+    threshold, scale = _params(p)
+    y = _fwd(x.reshape(-1, h), residual.reshape(-1, h),
+             jnp.asarray(seed, jnp.int32), threshold, scale, interpret, rows)
+    return y.reshape(shp)
+
+
+dropout_add = jax.custom_vjp(_primal, nondiff_argnums=(3, 4))
+
+
+def _vjp_fwd(x, residual, seed, p, interpret):
+    # the seed IS the saved dropout state (reference seed_offset analog)
+    return _primal(x, residual, seed, p, interpret), (seed, x.shape)
+
+
+def _vjp_bwd(p, interpret, saved, dy):
+    seed, shp = saved
+    h = shp[-1]
+    rows = _pick_rows(math.prod(shp[:-1]), h)
+    threshold, scale = _params(p)
+    dx = _bwd(dy.reshape(-1, h), jnp.asarray(seed, jnp.int32),
+              threshold, scale, interpret, rows)
+    return dx.reshape(shp), dy, None
+
+
+dropout_add.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def use_kernel(shape, p):
+    """Dispatch predicate: 2D-flattenable, a real drop rate, and enough
+    rows that the kernel's fixed cost amortizes."""
+    return len(shape) >= 2 and 0.0 < p < 1.0 and math.prod(shape) >= 1024
+
+
+def reference_dropout_add(x, residual, seed, p):
+    """XLA composite with IDENTICAL mask semantics (same hash, jnp ops) —
+    for parity tests and A/B timing."""
+    shp = x.shape
+    h = shp[-1]
+    n = math.prod(shp[:-1])
+    idx = jnp.arange(n * h, dtype=jnp.uint32).reshape(n, h)
+    bits = _fmix32(idx ^ (jnp.uint32(seed) * jnp.uint32(_GOLDEN)))
+    threshold, scale = _params(p)
+    x2 = x.reshape(n, h).astype(jnp.float32)
+    kept = jnp.where(bits >= jnp.uint32(threshold), x2 * jnp.float32(scale),
+                     jnp.float32(0.0))
+    y = kept + residual.reshape(n, h).astype(jnp.float32)
+    return y.astype(x.dtype).reshape(shp)

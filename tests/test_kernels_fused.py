@@ -1110,3 +1110,106 @@ def test_lamb_multi_precision_master_weights():
         return np.asarray(master._data)
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused dropout + residual add (in-kernel counter-hash mask)
+# ---------------------------------------------------------------------------
+
+def test_dropout_add_kernel_matches_hash_reference():
+    """The Pallas kernel's mask is a pure function of (seed, index): the
+    interpret-mode kernel must match the jnp reference BIT-EXACTLY."""
+    from paddle_tpu.ops.kernels import dropout_add_pallas as dak
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((48, 256)), jnp.float32)
+    res = jnp.asarray(rng.standard_normal((48, 256)), jnp.float32)
+    seed = jnp.int32(1234)
+    y = dak.dropout_add(x, res, seed, 0.3, True)
+    want = dak.reference_dropout_add(x, res, seed, 0.3)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(want))
+    # keep rate ~ 1-p and first moment preserved (upscale_in_train)
+    kept = np.asarray(y - res) != 0.0
+    assert abs(kept.mean() - 0.7) < 0.03
+    np.testing.assert_allclose(np.asarray(y - res).mean(),
+                               np.asarray(x).mean(), atol=0.05)
+
+
+def test_dropout_add_backward_regenerates_identical_mask():
+    """No mask residual: the bwd kernel re-derives keep from the saved
+    seed — dx must be nonzero exactly where the fwd kept x."""
+    from paddle_tpu.ops.kernels import dropout_add_pallas as dak
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((40, 192)), jnp.float32)
+    res = jnp.asarray(rng.standard_normal((40, 192)), jnp.float32)
+    seed = jnp.int32(77)
+    p = 0.4
+
+    def f(a, b):
+        return dak.dropout_add(a, b, seed, p, True)
+
+    y, vjp = jax.vjp(f, x, res)
+    dy = jnp.ones_like(y)
+    dx, dres = vjp(dy)
+    kept = np.asarray(y - res) != 0.0
+    np.testing.assert_array_equal(np.asarray(dx) != 0.0, kept)
+    np.testing.assert_allclose(np.asarray(dx)[kept], 1.0 / (1.0 - p),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(dres), 1.0)
+
+
+def test_dropout_add_seed_sensitivity():
+    from paddle_tpu.ops.kernels import dropout_add_pallas as dak
+    x = jnp.ones((32, 128), jnp.float32)
+    res = jnp.zeros((32, 128), jnp.float32)
+    a = np.asarray(dak.dropout_add(x, res, jnp.int32(1), 0.5, True))
+    b = np.asarray(dak.dropout_add(x, res, jnp.int32(1), 0.5, True))
+    c = np.asarray(dak.dropout_add(x, res, jnp.int32(2), 0.5, True))
+    np.testing.assert_array_equal(a, b)
+    assert (a != c).any()
+    # block-size independence: a different row-block must not change the
+    # mask (the hash is over GLOBAL indices, not block-locals)
+    kern.set_block_override("dropout_add", 8)
+    try:
+        d = np.asarray(dak.dropout_add(x, res, jnp.int32(1), 0.5, True))
+    finally:
+        kern.set_block_override("dropout_add", None)
+    np.testing.assert_array_equal(a, d)
+
+
+def test_fused_dropout_add_public_api_dispatches(monkeypatch):
+    """The public API must actually reach the Pallas kernel: with the seed
+    draw pinned, the output bit-matches the kernel's hash reference — the
+    XLA-threefry fallback cannot produce this mask, so a silently broken
+    dispatch gate fails here."""
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.incubate.nn import FusedDropoutAdd
+    from paddle_tpu.ops.kernels import dropout_add_pallas as dak
+
+    monkeypatch.setattr(jax.random, "randint",
+                        lambda key, shape, lo, hi, dtype=None:
+                        jnp.asarray(4242, jnp.int32))
+    paddle.seed(7)
+    x = paddle.to_tensor(
+        np.random.default_rng(3).standard_normal((16, 128)).astype("float32"))
+    x.stop_gradient = False
+    y = paddle.to_tensor(
+        np.random.default_rng(4).standard_normal((16, 128)).astype("float32"))
+    kern.force_interpret(True)
+    try:
+        out = IF.fused_dropout_add(x, y, p=0.25, training=True)
+        loss = out.sum()
+        loss.backward()
+        layer_out = FusedDropoutAdd(p=0.25)(x, y)
+    finally:
+        kern.force_interpret(False)
+    want = dak.reference_dropout_add(x._data, y._data, jnp.int32(4242), 0.25)
+    np.testing.assert_array_equal(out.numpy(), np.asarray(want))
+    np.testing.assert_array_equal(layer_out.numpy(), np.asarray(want))
+    kept = (out.numpy() - y.numpy()) != 0.0
+    assert abs(kept.mean() - 0.75) < 0.05
+    g = x.grad.numpy()
+    np.testing.assert_array_equal(g != 0.0, kept)
+    # eval mode / p=0 fall back to identity
+    out_eval = IF.fused_dropout_add(x, y, p=0.25, training=False)
+    np.testing.assert_allclose(out_eval.numpy(), x.numpy() + y.numpy(),
+                               rtol=1e-6)

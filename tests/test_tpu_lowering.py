@@ -426,3 +426,24 @@ def test_llm_int8_linear_lowers():
 
     txt = lower_tpu(f, jnp.zeros((4, 64), jnp.float32))
     assert "stablehlo" in txt or "module" in txt
+
+
+def test_dropout_add_fwd_bwd_lowers():
+    """fused dropout+add: in-kernel counter-hash mask (uint32 iota, mul,
+    xor-shift) must survive Mosaic lowering in both passes."""
+    from paddle_tpu.ops.kernels import dropout_add_pallas as dak
+
+    x = jnp.zeros((64, 512), jnp.bfloat16)
+    res = jnp.zeros((64, 512), jnp.bfloat16)
+    seed = jnp.int32(5)
+
+    def fwd(a, b):
+        return dak.dropout_add(a, b, seed, 0.1)
+
+    assert_mosaic(lower_tpu(fwd, x, res))
+
+    def fwd_bwd(a, b):
+        y, vjp = jax.vjp(lambda u, v: dak.dropout_add(u, v, seed, 0.1), a, b)
+        return vjp(jnp.ones_like(y))
+
+    assert_mosaic(lower_tpu(fwd_bwd, x, res))
