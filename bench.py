@@ -482,6 +482,18 @@ def run_kernel_ab(dev):
     res["dropout_add_xla_ms"] = round(xla, 3)
     res["dropout_add_speedup"] = round(xla / pal, 3)
 
+    # fused linear param-grad accumulate: in-VMEM fp32 tile accumulation
+    # + aliased buffer vs XLA's GEMM-then-add (extra dW HBM round trip)
+    from paddle_tpu.ops.kernels import linear_grad_add_pallas as lga
+    xg = jnp.asarray(rng.standard_normal((8192, 4096)), jnp.bfloat16)
+    dyg = jnp.asarray(rng.standard_normal((8192, 4096)), jnp.bfloat16)
+    accg = jnp.zeros((4096, 4096), jnp.float32)
+    pal = timed(lambda a: lga.linear_grad_acc(a, dyg, accg), xg)
+    xla = timed(lambda a: lga.reference_grad_acc(a, dyg, accg), xg)
+    res["linear_grad_acc_pallas_ms"] = round(pal, 3)
+    res["linear_grad_acc_xla_ms"] = round(xla, 3)
+    res["linear_grad_acc_speedup"] = round(xla / pal, 3)
+
     # serving decode step through fused_multi_transformer: mmha Pallas
     # kernel vs the einsum fallback, Llama-7B-ish single layer
     from paddle_tpu.ops.kernels import _common as kcommon

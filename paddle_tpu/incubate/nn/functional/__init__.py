@@ -186,6 +186,72 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode='upscale_in_train',
     return F.dropout(x, p, training=training, mode=mode) + y
 
 
+def fused_linear_param_grad_add(x, dy, dweight=None, dbias=None,
+                                multi_precision=False, has_bias=False):
+    """Accumulate a linear layer's param grads in place:
+    dweight [K, N] += flatten(x)^T @ flatten(dy), dbias [N] += sum(dy).
+
+    Reference: paddle._C_ops.fused_linear_param_grad_add
+    (paddle/phi/kernels/fusion/gpu/fused_linear_param_grad_add_kernel.cu),
+    the op the TP linear backward and sharding optimizers use to fold the
+    weight-grad GEMM into the main_grad buffer
+    (fleet/layers/mpu/mp_layers.py:251). On TPU the Pallas kernel
+    (ops/kernels/linear_grad_add_pallas.py) keeps the [bk, bn] tile in
+    fp32 VMEM for the whole row sweep and donates the buffer; elsewhere
+    the jnp composite. `multi_precision` keeps a missing accumulator in
+    fp32 (main_grad semantics); returns (dweight, dbias or None)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....autograd.function import apply
+    from ....core.flags import flag
+    from ....core.tensor import as_tensor
+    from ....ops.kernels import _common as kern
+    from ....ops.kernels import linear_grad_add_pallas as lga
+
+    # grad accumulation is not itself differentiable (the reference op runs
+    # inside a manual backward): detach so apply() never sends the
+    # AD-rule-less pallas_call through jax.vjp
+    xt, dyt = as_tensor(x).detach(), as_tensor(dy).detach()
+    k, n = xt.shape[-1], dyt.shape[-1]
+    m = 1
+    for s in xt.shape[:-1]:
+        m *= s
+    acc_dtype = (jnp.float32 if multi_precision
+                 else jnp.dtype(str(xt._data.dtype)))
+    if dweight is None:
+        dwt = None
+    else:
+        dwt = as_tensor(dweight).detach()
+
+    def f_w(xa, dya, *acc):
+        x2 = xa.reshape(-1, k)
+        dy2 = dya.reshape(-1, n)
+        a = acc[0] if acc else jnp.zeros((k, n), acc_dtype)
+        if (kern.available() and flag("use_pallas_kernels")
+                and lga.use_kernel(m, k, n)):
+            return lga.linear_grad_acc(x2, dy2, a, kern.interpret_mode())
+        return lga.reference_grad_acc(x2, dy2, a)
+
+    args = (xt, dyt) + ((dwt,) if dwt is not None else ())
+    dw = apply(f_w, *args, name="fused_linear_param_grad_add")
+    if not has_bias:
+        return dw, None
+    dbt = as_tensor(dbias).detach() if dbias is not None else None
+
+    def f_b(dya, *acc):
+        s = jnp.sum(dya.reshape(-1, n).astype(jnp.float32), axis=0)
+        if acc:
+            # preserve the provided accumulator's dtype (an fp32 grad
+            # buffer must not flip to bf16 just because dy is bf16)
+            return (s + acc[0].astype(jnp.float32)).astype(acc[0].dtype)
+        return s.astype(acc_dtype)
+
+    db = apply(f_b, *((dyt, dbt) if dbt is not None else (dyt,)),
+               name="fused_linear_bias_grad_add")
+    return dw, db
+
+
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
                       name=None):
     """Reference: incubate/nn/functional/fused_matmul_bias.py:21 (cublasLt

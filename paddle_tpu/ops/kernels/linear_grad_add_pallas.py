@@ -1,0 +1,116 @@
+"""Fused linear param-grad accumulate Pallas TPU kernel.
+
+Reference analog: paddle/phi/kernels/fusion/gpu/
+fused_linear_param_grad_add_kernel.cu, surfaced as
+paddle._C_ops.fused_linear_param_grad_add and used by the tensor-parallel
+linear backward and the sharding optimizers' main_grad accumulation
+(python/paddle/distributed/fleet/layers/mpu/mp_layers.py:251): instead of
+materializing dW = x^T @ dy and then running a separate AXPY into the
+gradient (or fp32 main_grad) buffer, one kernel computes the GEMM and
+accumulates in place.
+
+TPU mapping: a blocked x^T @ dy with the M (row) dimension as the
+innermost sequential grid axis. The [bk, bn] output tile lives in a VMEM
+fp32 scratch for the whole M sweep — the MXU partials never round-trip
+HBM, the existing gradient tile is read once (m==0) and the result is
+written once (m==last), cast to the accumulator dtype. With
+`input_output_aliases` the gradient buffer is donated, so the update is
+in-place at the XLA level too: HBM traffic is exactly read(x) * nn +
+read(dy) * nk + read/write(dW) — the composite's extra dW-sized
+round-trip (fresh GEMM buffer, then add) is gone, and for bf16 params
+with multi_precision the accumulation itself stays fp32.
+
+The bias grad (column-sum of dy) is left to one fused XLA reduction: the
+GEMM already reads dy nk times, so the reduction's single extra read is
+1/nk of the traffic — not worth a second output spec in the kernel.
+
+Public entry: `linear_grad_acc(x2, dy2, acc)`;
+`incubate.nn.functional.fused_linear_param_grad_add` dispatches here on
+TPU and falls back to the jnp composite elsewhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import pad_to_block, round_up
+
+_BM = 512   # rows of x/dy streamed per MXU step
+_BKN = 256  # output tile edge: [256, 256] fp32 scratch = 256 KB VMEM
+
+
+def _kernel(acc_in_ref, x_ref, dy_ref, out_ref, scratch, *, n_m):
+    m = pl.program_id(2)
+
+    @pl.when(m == 0)
+    def _init():
+        scratch[...] = acc_in_ref[...].astype(jnp.float32)
+
+    # [bk, bm] @ [bm, bn] on the MXU, fp32 partials
+    scratch[...] += jax.lax.dot_general(
+        x_ref[...], dy_ref[...],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(m == n_m - 1)
+    def _flush():
+        out_ref[...] = scratch[...].astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _grad_acc(x2, dy2, acc, interpret):
+    # NOTE: no jit-level donate_argnums — an eager caller's Tensor still
+    # references `acc`, and donation would invalidate it under its feet.
+    # The pallas input_output_alias below becomes a true in-place update
+    # whenever XLA liveness allows (inside a jitted train step the padded
+    # acc value is dead after this call); eagerly XLA inserts the
+    # defensive copy, which is the correct-by-construction fallback.
+    m, k = x2.shape
+    n = dy2.shape[1]
+    kp, np_, mp = round_up(k, _BKN), round_up(n, _BKN), round_up(m, _BM)
+    x2p = pad_to_block(pad_to_block(x2, _BM, 0), _BKN, 1)
+    dy2p = pad_to_block(pad_to_block(dy2, _BM, 0), _BKN, 1)
+    accp = pad_to_block(pad_to_block(acc, _BKN, 0), _BKN, 1)
+    n_m = mp // _BM
+    grid = (kp // _BKN, np_ // _BKN, n_m)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_kernel, n_m=n_m),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((_BKN, _BKN), lambda ki, ni, mi: (ki, ni)),
+                pl.BlockSpec((_BM, _BKN), lambda ki, ni, mi: (mi, ki)),
+                pl.BlockSpec((_BM, _BKN), lambda ki, ni, mi: (mi, ni)),
+            ],
+            out_specs=pl.BlockSpec((_BKN, _BKN), lambda ki, ni, mi: (ki, ni)),
+            out_shape=jax.ShapeDtypeStruct((kp, np_), acc.dtype),
+            scratch_shapes=[pltpu.VMEM((_BKN, _BKN), jnp.float32)],
+            input_output_aliases={0: 0},
+            interpret=interpret,
+        )(accp, x2p, dy2p)
+    return out[:k, :n]
+
+
+def linear_grad_acc(x2, dy2, acc, interpret=False):
+    """acc [K, N] += x2 [M, K]^T @ dy2 [M, N], accumulated in fp32 VMEM;
+    returns the updated buffer (the input `acc` is donated)."""
+    return _grad_acc(x2, dy2, acc, interpret)
+
+
+def use_kernel(m, k, n):
+    """The kernel pays off when the GEMM is big enough that the saved
+    dW round-trip matters; tiny shapes keep the XLA composite."""
+    return m * k * n >= (1 << 20)
+
+
+def reference_grad_acc(x2, dy2, acc):
+    """XLA composite with identical semantics (fp32 accumulation)."""
+    part = jax.lax.dot_general(
+        x2, dy2, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (acc.astype(jnp.float32) + part).astype(acc.dtype)

@@ -1213,3 +1213,65 @@ def test_fused_dropout_add_public_api_dispatches(monkeypatch):
     out_eval = IF.fused_dropout_add(x, y, p=0.25, training=False)
     np.testing.assert_allclose(out_eval.numpy(), x.numpy() + y.numpy(),
                                rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused linear param-grad accumulate (x^T dy folded into the grad buffer)
+# ---------------------------------------------------------------------------
+
+def test_linear_grad_acc_kernel_matches_composite():
+    from paddle_tpu.ops.kernels import linear_grad_add_pallas as lga
+    rng = np.random.default_rng(0)
+    for (m, k, n, dt, adt) in [(700, 300, 500, jnp.bfloat16, jnp.float32),
+                               (512, 256, 256, jnp.float32, jnp.float32),
+                               (1024, 384, 128, jnp.bfloat16, jnp.bfloat16)]:
+        x = jnp.asarray(rng.standard_normal((m, k)), dt)
+        dy = jnp.asarray(rng.standard_normal((m, n)), dt)
+        acc = jnp.asarray(rng.standard_normal((k, n)), adt)
+        got = lga.linear_grad_acc(x, dy, jnp.array(acc), interpret=True)
+        want = lga.reference_grad_acc(x, dy, acc)
+        err = float(jnp.max(jnp.abs(
+            got.astype(jnp.float32) - want.astype(jnp.float32))))
+        denom = float(jnp.max(jnp.abs(want.astype(jnp.float32)))) or 1.0
+        assert err / denom < (2e-2 if adt == jnp.bfloat16 else 1e-5), \
+            (m, k, n, err, denom)
+
+
+def test_fused_linear_param_grad_add_public_api():
+    """Reference call contract (mp_layers.py:251): returns the accumulated
+    (dweight, dbias); multi_precision=True keeps a fresh accumulator fp32."""
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.default_rng(5)
+    x = paddle.to_tensor(rng.standard_normal((8, 4, 48)).astype("float32"))
+    dy = paddle.to_tensor(rng.standard_normal((8, 4, 32)).astype("float32"))
+    dw0 = paddle.to_tensor(rng.standard_normal((48, 32)).astype("float32"))
+    db0 = paddle.to_tensor(rng.standard_normal((32,)).astype("float32"))
+
+    kern.force_interpret(True)
+    try:
+        dw, db = IF.fused_linear_param_grad_add(x, dy, dw0, db0,
+                                                multi_precision=True,
+                                                has_bias=True)
+    finally:
+        kern.force_interpret(False)
+    x2 = x.numpy().reshape(-1, 48)
+    dy2 = dy.numpy().reshape(-1, 32)
+    np.testing.assert_allclose(dw.numpy(), dw0.numpy() + x2.T @ dy2,
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(db.numpy(), db0.numpy() + dy2.sum(0),
+                               rtol=2e-5, atol=2e-5)
+    # no accumulator: fresh fp32 buffer (multi_precision) from bf16 grads
+    xb = paddle.to_tensor(x.numpy().astype("float32")).astype("bfloat16")
+    dyb = paddle.to_tensor(dy.numpy().astype("float32")).astype("bfloat16")
+    kern.force_interpret(True)
+    try:
+        dw2, db2 = IF.fused_linear_param_grad_add(xb, dyb, None, None,
+                                                  multi_precision=True,
+                                                  has_bias=True)
+    finally:
+        kern.force_interpret(False)
+    assert str(dw2.dtype) in ("paddle.float32", "float32"), dw2.dtype
+    np.testing.assert_allclose(dw2.numpy(), x2.T @ dy2, rtol=2e-2, atol=2e-1)
+    dw3, db3 = IF.fused_linear_param_grad_add(x, dy, dw0, None,
+                                              has_bias=False)
+    assert db3 is None

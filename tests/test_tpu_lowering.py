@@ -447,3 +447,15 @@ def test_dropout_add_fwd_bwd_lowers():
         return vjp(jnp.ones_like(y))
 
     assert_mosaic(lower_tpu(fwd_bwd, x, res))
+
+
+def test_linear_grad_acc_lowers():
+    """fused linear param-grad accumulate: MXU dot_general + fp32 VMEM
+    scratch + revisited output tile + input/output alias must all lower."""
+    from paddle_tpu.ops.kernels import linear_grad_add_pallas as lga
+
+    x = jnp.zeros((1024, 512), jnp.bfloat16)
+    dy = jnp.zeros((1024, 768), jnp.bfloat16)
+    acc = jnp.zeros((512, 768), jnp.float32)
+    assert_mosaic(lower_tpu(lambda a, b, c: lga.linear_grad_acc(a, b, c),
+                            x, dy, acc))
