@@ -494,6 +494,23 @@ def run_kernel_ab(dev):
     res["linear_grad_acc_xla_ms"] = round(xla, 3)
     res["linear_grad_acc_speedup"] = round(xla / pal, 3)
 
+    # A8W8 prefill GEMM: in-kernel per-token quant + int8 MXU vs the
+    # bf16 matmul it replaces (the int8 MXU runs at twice the bf16 rate)
+    from paddle_tpu.ops.kernels import a8w8_matmul_pallas as a8
+    xq8 = jnp.asarray(rng.standard_normal((4096, 4096)), jnp.bfloat16)
+    wq8 = jnp.asarray(rng.integers(-127, 127, (4096, 4096)), jnp.int8)
+    wsq8 = jnp.asarray(rng.random(4096) * 0.01, jnp.float32)
+    # baseline weight is PRE-dequantized outside the timed lambda: a real
+    # bf16 deployment stores bf16 weights, so the baseline times only the
+    # matmul
+    wbf16 = jax.block_until_ready(
+        wq8.astype(jnp.bfloat16) * wsq8.astype(jnp.bfloat16)[None, :])
+    pal = timed(lambda a: a8.a8w8_matmul(a, wq8, wsq8), xq8)
+    xla = timed(lambda a: a @ wbf16, xq8)
+    res["a8w8_prefill_pallas_ms"] = round(pal, 3)
+    res["bf16_prefill_xla_ms"] = round(xla, 3)
+    res["a8w8_prefill_speedup"] = round(xla / pal, 3)
+
     # serving decode step through fused_multi_transformer: mmha Pallas
     # kernel vs the einsum fallback, Llama-7B-ish single layer
     from paddle_tpu.ops.kernels import _common as kcommon

@@ -164,7 +164,10 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
     import jax.numpy as jnp
 
     from ...autograd.function import apply
+    from ...core.flags import flag
     from ...core.tensor import as_tensor
+    from ...ops.kernels import _common as kern
+    from ...ops.kernels import a8w8_matmul_pallas as a8
 
     x_t, w_t = as_tensor(x), as_tensor(weight)
     args = [x_t, w_t]
@@ -172,6 +175,20 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
         args.append(as_tensor(weight_scale))
     if bias is not None:
         args.append(as_tensor(bias))
+    # the A8W8 Pallas kernel is inference-path (no custom_vjp): dispatch
+    # whenever nothing can need a gradient through this linear — the same
+    # need-grad test the autograd dispatcher uses (grad enabled AND some
+    # input not stop_gradient), so no_grad serving with Parameter inputs
+    # still takes the kernel
+    from ...autograd.grad_mode import is_grad_enabled
+    m_rows = 1
+    for s in x_t.shape[:-1]:
+        m_rows *= s
+    needs_grad = (is_grad_enabled()
+                  and any(not t.stop_gradient for t in args))
+    pallas_ok = (kern.available() and flag("use_pallas_kernels")
+                 and not needs_grad
+                 and a8.use_kernel(m_rows, x_t.shape[-1]))
 
     def f(xa, wa, *rest):
         it = iter(rest)
@@ -186,14 +203,23 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
         outlier = col_max > threshold                  # [k]
         x_dense = jnp.where(outlier[None, :], 0.0, x2)
         x_out = jnp.where(outlier[None, :], x2, 0.0)
-        # dynamic per-row int8 quantization of the dense part
-        row_scale = jnp.maximum(jnp.max(jnp.abs(x_dense), axis=1), 1e-9)
-        q = jnp.clip(jnp.round(x_dense / row_scale[:, None] * 127.0),
-                     -127, 127).astype(jnp.int8)
-        acc = jnp.matmul(q.astype(jnp.int32), wa.T.astype(jnp.int32),
-                         preferred_element_type=jnp.int32)
-        dense = acc.astype(jnp.float32) * (row_scale[:, None] / 127.0) \
-            * ws[None, :].astype(jnp.float32)
+        if pallas_ok:
+            # prefill regime: per-token quant + int8 MXU contraction +
+            # dequant in one VMEM pass, weight consumed in its [N, K]
+            # storage layout (no HBM transpose)
+            dense = a8.a8w8_matmul(x_dense, wa, ws, layout="nk",
+                                   interpret=kern.interpret_mode()) \
+                .astype(jnp.float32)
+        else:
+            # dynamic per-row int8 quantization of the dense part
+            row_scale = jnp.maximum(jnp.max(jnp.abs(x_dense), axis=1),
+                                    1e-9)
+            q = jnp.clip(jnp.round(x_dense / row_scale[:, None] * 127.0),
+                         -127, 127).astype(jnp.int8)
+            acc = jnp.matmul(q.astype(jnp.int32), wa.T.astype(jnp.int32),
+                             preferred_element_type=jnp.int32)
+            dense = acc.astype(jnp.float32) * (row_scale[:, None] / 127.0) \
+                * ws[None, :].astype(jnp.float32)
         # outlier columns contract in float against dequantized weights
         w_fp = wa.astype(jnp.float32) * ws[:, None].astype(jnp.float32)
         out = dense + x_out.astype(jnp.float32) @ w_fp.T

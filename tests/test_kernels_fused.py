@@ -1275,3 +1275,86 @@ def test_fused_linear_param_grad_add_public_api():
     dw3, db3 = IF.fused_linear_param_grad_add(x, dy, dw0, None,
                                               has_bias=False)
     assert db3 is None
+
+
+# ---------------------------------------------------------------------------
+# A8W8 int8 matmul (dynamic per-token quant + int8 MXU + dequant epilogue)
+# ---------------------------------------------------------------------------
+
+def test_a8w8_matmul_matches_composite_both_layouts():
+    """Bit-exact parity on a boundary-free construction: x = q * 2^-5 with
+    integer q in [-127, 127] and a pinned rowmax makes s_row exactly 2^-5,
+    so round(x/s) has no rounding ambiguity between the interpreter and
+    XLA — any kernel/composite divergence is a real bug, not a ulp flip."""
+    from paddle_tpu.ops.kernels import a8w8_matmul_pallas as a8
+    rng = np.random.default_rng(0)
+    m, k, n = 300, 384, 272
+    q_np = rng.integers(-127, 128, (m, k)).astype(np.float32)
+    q_np[:, 0] = 127.0  # pin the row max -> s_row = 2^-5 exactly
+    x = jnp.asarray(q_np * 2.0 ** -5, jnp.bfloat16)  # exactly representable
+    wkn = jnp.asarray(rng.integers(-127, 128, (k, n)), jnp.int8)
+    ws = jnp.asarray(rng.random(n) * 0.02 + 0.01, jnp.float32)
+    want = np.asarray(a8.reference_a8w8(x, wkn, ws), np.float32)
+    # cross-check the reference itself against the plain float matmul
+    dense = (q_np * 2.0 ** -5) @ np.asarray(wkn, np.float32) \
+        * np.asarray(ws)[None, :]
+    np.testing.assert_allclose(want, dense.astype(np.float32), rtol=1e-2,
+                               atol=1e-2)
+    for layout, w in (("kn", wkn), ("nk", jnp.asarray(wkn.T))):
+        got = np.asarray(a8.a8w8_matmul(x, w, ws, layout=layout,
+                                        interpret=True), np.float32)
+        np.testing.assert_array_equal(got, want, err_msg=layout)
+
+
+def test_llm_int8_linear_prefill_dispatches_to_a8w8():
+    """Prefill-shaped llm_int8_linear must agree between the Pallas A8W8
+    path (stop_gradient inputs, kernel available) and the XLA fallback."""
+    from paddle_tpu.nn.quant import llm_int8_linear
+    rng = np.random.default_rng(1)
+    m, k, n = 256, 320, 160
+    x_np = rng.standard_normal((m, k)).astype("float32")
+    x_np[:, 7] *= 40.0  # force an outlier column through the fp path
+    w_np = rng.integers(-127, 128, (n, k)).astype("int8")
+    s_np = (rng.random(n) * 0.02 + 0.01).astype("float32")
+    b_np = rng.standard_normal((n,)).astype("float32")
+
+    x = paddle.to_tensor(x_np)
+    w = paddle.to_tensor(w_np)
+    s = paddle.to_tensor(s_np)
+    b = paddle.to_tensor(b_np)
+    # count kernel invocations so a silently-dead dispatch gate fails here
+    from paddle_tpu.ops.kernels import a8w8_matmul_pallas as a8
+    calls = []
+    real = a8.a8w8_matmul
+    a8.a8w8_matmul = lambda *a, **kw: (calls.append(1), real(*a, **kw))[1]
+    kern.force_interpret(True)
+    try:
+        got = llm_int8_linear(x, w, bias=b, weight_scale=s)
+    finally:
+        kern.force_interpret(False)
+        a8.a8w8_matmul = real
+    assert calls, "prefill llm_int8_linear did not dispatch to the kernel"
+    want = llm_int8_linear(x, w, bias=b, weight_scale=s)  # XLA fallback
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=2e-3,
+                               atol=2e-2)
+    # grad-needing inputs must stay on the differentiable fallback
+    xg = paddle.to_tensor(x_np)
+    xg.stop_gradient = False
+    kern.force_interpret(True)
+    try:
+        out = llm_int8_linear(xg, w, bias=b, weight_scale=s)
+        out.sum().backward()   # must not hit the AD-rule-less pallas_call
+    finally:
+        kern.force_interpret(False)
+    assert xg.grad is not None
+    # ...but no_grad mode with the same grad-tracked input DOES dispatch
+    calls.clear()
+    a8.a8w8_matmul = lambda *a, **kw: (calls.append(1), real(*a, **kw))[1]
+    kern.force_interpret(True)
+    try:
+        with paddle.no_grad():
+            llm_int8_linear(xg, w, bias=b, weight_scale=s)
+    finally:
+        kern.force_interpret(False)
+        a8.a8w8_matmul = real
+    assert calls, "no_grad inference skipped the A8W8 kernel"

@@ -459,3 +459,16 @@ def test_linear_grad_acc_lowers():
     acc = jnp.zeros((512, 768), jnp.float32)
     assert_mosaic(lower_tpu(lambda a, b, c: lga.linear_grad_acc(a, b, c),
                             x, dy, acc))
+
+
+@pytest.mark.parametrize("layout", ["kn", "nk"])
+def test_a8w8_matmul_lowers(layout):
+    """A8W8: in-VMEM activation quantization + int8 x int8 MXU dot +
+    dequant epilogue must lower for both weight layouts."""
+    from paddle_tpu.ops.kernels import a8w8_matmul_pallas as a8
+
+    x = jnp.zeros((512, 1024), jnp.bfloat16)
+    w = jnp.zeros((1024, 768) if layout == "kn" else (768, 1024), jnp.int8)
+    ws = jnp.ones((768,), jnp.float32)
+    assert_mosaic(lower_tpu(
+        lambda a, b, c: a8.a8w8_matmul(a, b, c, layout=layout), x, w, ws))
