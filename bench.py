@@ -48,6 +48,16 @@ def _write_partial(result):
 
 
 def _force_cpu():
+    # the bench process opted into TPU (PADDLE_TPU_BENCH=1), so package
+    # init armed the persistent compile cache — but this fallback is about
+    # to compile for XLA:CPU, and CPU AOT entries record exact host machine
+    # features (cross-host reload risks SIGILL, see paddle_tpu/__init__).
+    # Drop the cache before the first CPU compile.
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
     from paddle_tpu.device import force_cpu_backend
     return force_cpu_backend().devices("cpu")[0]
 
