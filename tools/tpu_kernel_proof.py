@@ -376,6 +376,39 @@ def main():
         lambda a: sm.reference_softmax_mask(a),
         (xs,), n_grad_args=1, tol=2e-2)
 
+    # 16. fused dropout + residual add (counter-hash mask, r5)
+    from paddle_tpu.ops.kernels import dropout_add_pallas as dak
+    xd = jnp.asarray(rng.standard_normal((ROWS, 1024)), jnp.bfloat16)
+    rd = jnp.asarray(rng.standard_normal((ROWS, 1024)), jnp.bfloat16)
+    sd = jnp.int32(17)
+    fam["dropout_add"] = run_family(
+        "dropout_add",
+        lambda a, r: dak.dropout_add(a, r, sd, 0.1, interp),
+        lambda a, r: dak.reference_dropout_add(a, r, sd, 0.1),
+        (xd, rd), n_grad_args=2, tol=2e-2)
+
+    # 17. fused linear param-grad accumulate (r5)
+    from paddle_tpu.ops.kernels import linear_grad_add_pallas as lga
+    xga = jnp.asarray(rng.standard_normal((ROWS, 512)), jnp.bfloat16)
+    dyga = jnp.asarray(rng.standard_normal((ROWS, 768)), jnp.bfloat16)
+    accga = jnp.asarray(rng.standard_normal((512, 768)), jnp.float32)
+    fam["linear_grad_acc"] = run_family(
+        "linear_grad_acc",
+        lambda a, b: lga.linear_grad_acc(a, b, accga, interp),
+        lambda a, b: lga.reference_grad_acc(a, b, accga),
+        (xga, dyga), tol=2e-2)
+
+    # 18. A8W8 int8 matmul (in-kernel per-token quant, r5)
+    from paddle_tpu.ops.kernels import a8w8_matmul_pallas as a8
+    xa8 = jnp.asarray(rng.standard_normal((ROWS, 1024)), jnp.bfloat16)
+    wa8 = jnp.asarray(rng.integers(-127, 128, (1024, 1024)), jnp.int8)
+    wsa8 = jnp.asarray(rng.random(1024) * 0.02 + 0.01, jnp.float32)
+    fam["a8w8_matmul"] = run_family(
+        "a8w8_matmul",
+        lambda a: a8.a8w8_matmul(a, wa8, wsa8, interpret=interp),
+        lambda a: a8.reference_a8w8(a, wa8, wsa8),
+        (xa8,), tol=5e-2)
+
     n_ok = sum(1 for v in fam.values() if v.get("ok"))
     report["summary"] = {"ok": n_ok, "total": len(fam),
                          "all_ok": n_ok == len(fam)}
