@@ -32,6 +32,36 @@ _RUN_TIMEOUT = int(os.environ.get("BENCH_RUN_TIMEOUT", "1800"))
 _PARTIAL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_TPU_PARTIAL.json")
 
+# retrace counts observed inside each steady-state timing window (one entry
+# per _train_throughput call); summed into the telemetry block so
+# tools/perf_gate.py can fail a round whose measured window recompiled
+_STEADY_RETRACES: list = []
+
+
+def _attach_telemetry(result):
+    """Embed the observability snapshot in the bench JSON line — ALWAYS:
+    either the full telemetry block or `"telemetry": null` plus a reason,
+    so the perf trajectory is self-describing either way."""
+    try:
+        import paddle_tpu.observability as obs
+        if not obs.enabled():
+            result["telemetry"] = None
+            result["telemetry_reason"] = "disabled via PADDLE_TPU_METRICS=0"
+        else:
+            result["telemetry"] = {
+                "metrics": obs.dump(),
+                "steady_state": {
+                    "trace_cache_retraces": int(sum(_STEADY_RETRACES)),
+                    "windows": len(_STEADY_RETRACES),
+                },
+            }
+            result.pop("telemetry_reason", None)
+    except Exception:
+        result["telemetry"] = None
+        result["telemetry_reason"] = \
+            "observability unavailable: " + traceback.format_exc(limit=1)[:300]
+    return result
+
 
 def _write_partial(result):
     """Persist the TPU child's progress after every completed section: a
@@ -41,7 +71,8 @@ def _write_partial(result):
     try:
         tmp = _PARTIAL + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(dict(result, _partial_ts=time.time()), f)
+            json.dump(dict(_attach_telemetry(result), _partial_ts=time.time()),
+                      f)
         os.replace(tmp, _PARTIAL)
     except Exception:
         pass
@@ -92,11 +123,20 @@ def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
     for _ in range(warmup):
         loss = train_step(x, y)
     float(loss)  # sync
+    # steady-state telemetry window: any trace-cache retrace INSIDE the
+    # timed loop means the measurement included a recompile — perf_gate
+    # fails the round on it (observability wiring)
+    import paddle_tpu.observability as obs
+    retr0 = obs.total("paddle_tpu_jit_trace_cache_retraces_total")
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = train_step(x, y)
     final = float(loss)  # device sync
     dt = time.perf_counter() - t0
+    _STEADY_RETRACES.append(
+        int(obs.total("paddle_tpu_jit_trace_cache_retraces_total") - retr0))
+    obs.StepTimer("bench_steady").record_window(steps, batch * seq * steps,
+                                                dt)
 
     # step-time breakdown (BASELINE.md: compute vs host split): host time is
     # the non-blocking dispatch cost; the rest of the step is device time.
@@ -158,7 +198,8 @@ def run_llama_bench(dev):
     n_params = model.num_params()
     flops_per_token = model.flops_per_token(seq) * 3
     peak, peak_src = _peak_flops(dev)
-    mfu = tokens_per_s * flops_per_token / peak if peak else 0.0
+    from paddle_tpu.observability import analytic_mfu
+    mfu = analytic_mfu(tokens_per_s, flops_per_token, peak)
     return {
         "metric": "llama_310m_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_s, 1),
@@ -199,7 +240,8 @@ def run_gpt_bench(dev, on_tpu):
         model, batch, seq, steps, warmup, cfg.vocab_size, on_tpu)
 
     peak, peak_src = _peak_flops(dev)
-    mfu = tokens_per_s * flops_per_token / peak if peak else 0.0
+    from paddle_tpu.observability import analytic_mfu
+    mfu = analytic_mfu(tokens_per_s, flops_per_token, peak)
     return {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip" if on_tpu
         else "gpt2_cpu_smoke_tokens_per_sec",
@@ -344,8 +386,8 @@ def run_llama8b_layer_bench(dev, cfg=None, n_layers=2, batch=1, seq=4096,
                            + 2.0 * 2.0 * cfg.hidden_size * seq / 2)
     tokens_per_s = batch * seq * steps / dt
     peak, peak_src = _peak_flops(dev)
-    layer_mfu = (tokens_per_s * flops_tok_layer * n_layers / peak
-                 if peak else 0.0)
+    from paddle_tpu.observability import analytic_mfu
+    layer_mfu = analytic_mfu(tokens_per_s, flops_tok_layer * n_layers, peak)
     # analytic full-8B projection: 32 layers + untied lm_head at layer MFU
     full_flops_tok = (cfg.num_layers * flops_tok_layer
                       + 3 * 2.0 * cfg.hidden_size * cfg.vocab_size)
@@ -677,23 +719,11 @@ def run_sd3_bench(dev):
 
 
 def _peak_flops(dev):
-    """(bf16 peak FLOPs, source) from the device kind (spec sheets)."""
-    kind = (getattr(dev, "device_kind", "") or "").lower()
-    table = {
-        "v6e": 918e12, "v6": 918e12, "v5p": 459e12, "v5e": 197e12,
-        "v5litepod": 197e12, "v5 lite": 197e12, "v5lite": 197e12,
-        "v4": 275e12, "v3": 123e12, "v2": 45e12,
-    }
-    if dev.platform not in ("tpu", "axon"):
-        return 0.0, "cpu"
-    for k, v in table.items():
-        if k in kind:
-            return v, f"device_kind:{kind}"
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    for k, v in table.items():
-        if k in gen:
-            return v, f"env:PALLAS_AXON_TPU_GEN={gen}"
-    return table["v5e"], "default_guess_v5e"
+    """(bf16 peak FLOPs, source) from the device kind (spec sheets). The
+    table and lookup live in paddle_tpu.observability.step_timer so training
+    loops and the bench compute MFU from the same source."""
+    from paddle_tpu.observability import device_peak_flops
+    return device_peak_flops(dev)
 
 
 # ---------------------------------------------------------------------------
@@ -823,12 +853,14 @@ def _child_main(mode):
         else:
             dev = _force_cpu()
             result = run_gpt_bench(dev, False)
+        _attach_telemetry(result)
         print(json.dumps(result))
         return 0
     except Exception:
-        print(json.dumps({"metric": "bench_child_failed", "value": 0.0,
-                          "unit": "tokens/s/chip", "vs_baseline": 0.0,
-                          "error": traceback.format_exc(limit=8)}))
+        print(json.dumps(_attach_telemetry(
+            {"metric": "bench_child_failed", "value": 0.0,
+             "unit": "tokens/s/chip", "vs_baseline": 0.0,
+             "error": traceback.format_exc(limit=8)})))
         return 1
 
 
@@ -910,6 +942,10 @@ def main():
                       "error": traceback.format_exc(limit=8)}
     if warning:
         result.setdefault("extra", {})["init_warning"] = str(warning)[:2000]
+    if "telemetry" not in result:
+        # in-process fallback ran here; child-produced JSON already carries
+        # its own telemetry block from _child_main
+        _attach_telemetry(result)
     try:
         # bubble/schedule accounting for the standard pp=4, v=2, M=8 recipe
         # (VERDICT r2 item 5: report the bubble fraction in bench extra)

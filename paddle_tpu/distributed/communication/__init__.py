@@ -16,6 +16,8 @@ import jax.numpy as jnp
 
 from ...core.tensor import Tensor, as_tensor
 from ...autograd.function import apply
+from ...observability import (counter as _obs_counter,
+                              enabled as _obs_enabled)
 from .group import (Group, ReduceOp, new_group, get_group, is_available,
                     destroy_process_group, active_axis_names, _axis_scope)
 
@@ -33,6 +35,38 @@ def _axis(group):
             group.mesh_axis in active_axis_names():
         return group.mesh_axis
     return None
+
+
+# Collective telemetry (paddle_tpu.observability): per-op call counts and
+# payload bytes by group, recorded at API entry so both the lowered
+# (shard_map) and single-controller identity paths are visible. Delegating
+# wrappers (reduce -> all_reduce, gather -> all_gather) record only once,
+# under the op that actually runs.
+_OBS_COMM_CALLS = _obs_counter(
+    "paddle_tpu_comm_calls_total", "collective API invocations")
+_OBS_COMM_BYTES = _obs_counter(
+    "paddle_tpu_comm_payload_bytes_total",
+    "bytes handed to collectives (per call, input payload)")
+
+
+def _payload_nbytes(payload):
+    if isinstance(payload, (list, tuple)):
+        return sum(_payload_nbytes(p) for p in payload)
+    arr = getattr(payload, "_data", payload)
+    try:
+        return int(getattr(arr, "nbytes", 0) or 0)
+    except Exception:
+        return 0
+
+
+def _record_collective(op, payload, group):
+    if not _obs_enabled():
+        return
+    gname = getattr(group, "name", None) or "world"
+    _OBS_COMM_CALLS.inc(op=op, group=gname)
+    nbytes = _payload_nbytes(payload)
+    if nbytes:
+        _OBS_COMM_BYTES.inc(nbytes, op=op, group=gname)
 
 
 def _in_place(t, out):
@@ -65,6 +99,7 @@ class _Task:
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis(group)
     t = as_tensor(tensor)
+    _record_collective("all_reduce", t, group)
     if ax is None:
         return _Task(t)
     fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
@@ -81,6 +116,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     ax = _axis(group)
     t = as_tensor(tensor)
+    _record_collective("all_gather", t, group)
     if ax is None:
         if isinstance(tensor_list, list):
             # reference contract: the list gains one entry PER RANK; on the
@@ -102,6 +138,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True):
     ax = _axis(group)
     t = as_tensor(tensor)
+    _record_collective("all_gather_into_tensor", t, group)
     if ax is None:
         return _in_place(out_tensor, t) and _Task(out_tensor)
     out = apply(lambda a: jax.lax.all_gather(a, ax, axis=0, tiled=True), t,
@@ -112,6 +149,7 @@ def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True):
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     ax = _axis(group)
+    _record_collective("all_to_all", in_tensor_list, group)
     if ax is None:
         out_tensor_list.extend(as_tensor(t) for t in in_tensor_list)
         return _Task()
@@ -128,6 +166,7 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
                       out_split_sizes=None, group=None, sync_op=True):
     ax = _axis(group)
     t = as_tensor(in_tensor)
+    _record_collective("all_to_all_single", t, group)
     if ax is None:
         return _in_place(out_tensor, t) and _Task(out_tensor)
     out = apply(lambda a: jax.lax.all_to_all(
@@ -141,6 +180,7 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
 def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     t = as_tensor(tensor)
+    _record_collective("broadcast", t, group)
     if ax is None:
         return _Task(t)
     src_idx = group.get_group_rank(src) if src in group.ranks else src
@@ -166,6 +206,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     ax = _axis(group)
+    _record_collective("reduce_scatter", tensor_or_tensor_list, group)
     if ax is None:
         src = tensor_or_tensor_list
         if isinstance(src, (list, tuple)):
@@ -186,6 +227,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis(group)
+    _record_collective("scatter", tensor_list if tensor_list else tensor,
+                       group)
     if ax is None:
         if tensor_list:
             _in_place(tensor, as_tensor(tensor_list[0]))
@@ -217,6 +260,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     instead of silently mis-routing (r1 built a non-permutation here)."""
     ax = _axis(group)
     t = as_tensor(tensor)
+    _record_collective("send", t, group)
     me = group.rank if group is not None and group.rank >= 0 else 0
     if ax is None:
         _P2P_PENDING.append((t, None, 0))
@@ -253,6 +297,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
             raise RuntimeError(
                 f"recv(src={src}) on axis {cur_ax!r} (shift {expect}) has "
                 f"no matching pending send; pending (axis, shift): {pend}")
+    _record_collective("recv", val, group)
     _in_place(tensor, val)
     return _Task(tensor)
 
@@ -313,6 +358,7 @@ def get_backend(group=None):
 
 def barrier(group=None):
     """Device-fence barrier (reference: ProcessGroup::Barrier)."""
+    _record_collective("barrier", None, group)
     (jax.device_put(jnp.zeros(())) + 0).block_until_ready()
 
 

@@ -11,16 +11,35 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..observability import (enabled as _obs_enabled,
+                             histogram as _obs_histogram)
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
 __all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
 
 _worker_info = threading.local()
+
+# Input-pipeline telemetry (paddle_tpu.observability): per-batch WAIT time
+# (the training loop blocked on the loader — a hot wait histogram means the
+# input pipeline, not the device, bounds step time) vs the consumer's
+# COMPUTE time between batches. Finer low-end buckets than the default
+# latency ladder: a healthy prefetched loader waits microseconds.
+_IO_BUCKETS = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 0.01, 0.025,
+               0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+_OBS_WAIT = _obs_histogram(
+    "paddle_tpu_io_batch_wait_seconds",
+    "time the consumer blocked waiting for the next batch",
+    buckets=_IO_BUCKETS)
+_OBS_COMPUTE = _obs_histogram(
+    "paddle_tpu_io_compute_seconds",
+    "consumer time between batches (compute the loader must hide under)",
+    buckets=_IO_BUCKETS)
 
 
 def get_worker_info():
@@ -128,6 +147,28 @@ class DataLoader:
         return data
 
     def __iter__(self):
+        it = self._iter_batches()
+        if not _obs_enabled():
+            yield from it
+            return
+        # wait/compute split: time blocked in next() is loader wait; time
+        # between our yield returning and the consumer asking again is the
+        # consumer's compute the prefetcher must hide under
+        prev_yield = None
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            now = time.perf_counter()
+            _OBS_WAIT.observe(now - t0)
+            if prev_yield is not None:
+                _OBS_COMPUTE.observe(t0 - prev_yield)
+            yield batch
+            prev_yield = time.perf_counter()
+
+    def _iter_batches(self):
         if self.num_workers == 0:
             for batch in self._index_batches():
                 yield self._fetch(batch)

@@ -21,6 +21,7 @@ Shape/dtype changes retrace (a new cache entry), mirroring SOT guards.
 from __future__ import annotations
 
 import threading
+import time
 from functools import wraps
 
 import jax
@@ -28,9 +29,32 @@ import jax.numpy as jnp
 
 from ..core import tensor as tensor_mod
 from ..core.tensor import Tensor
+from ..observability import counter as _obs_counter, gauge as _obs_gauge
 
 __all__ = ["to_static", "not_to_static", "in_to_static_trace", "ignore_module",
            "enable_to_static"]
+
+# Trace-cache telemetry (paddle_tpu.observability): a silent retrace storm —
+# fluctuating shapes recompiling every step — shows up here as a climbing
+# retraces counter instead of an unexplained 100x step-time regression.
+_OBS_HITS = _obs_counter(
+    "paddle_tpu_jit_trace_cache_hits_total",
+    "to_static calls served by an already-discovered signature")
+_OBS_MISSES = _obs_counter(
+    "paddle_tpu_jit_trace_cache_misses_total",
+    "to_static calls that traced a new signature (discovery run)")
+_OBS_RETRACES = _obs_counter(
+    "paddle_tpu_jit_trace_cache_retraces_total",
+    "trace-cache misses AFTER a function's first signature (recompile storms)")
+_OBS_COMPILES = _obs_counter(
+    "paddle_tpu_jit_compiles_total",
+    "XLA program builds (whole-step jit compiles per signature)")
+_OBS_TRACE_SECONDS = _obs_counter(
+    "paddle_tpu_jit_trace_seconds_total",
+    "wall seconds spent in discovery tracing + program building")
+_OBS_CACHE_SIZE = _obs_gauge(
+    "paddle_tpu_jit_trace_cache_entries",
+    "live signatures per to_static function")
 
 _trace_state = threading.local()
 _to_static_enabled = True
@@ -86,6 +110,10 @@ class StaticFunction:
         # this function eagerly instead of raising
         self._fallback = fallback
         self._fell_back = False
+        # telemetry label: __qualname__ disambiguates methods that
+        # share a bare __name__ (every Layer's 'forward')
+        self._obs_name = getattr(fn, "__qualname__", None) or \
+            getattr(fn, "__name__", "fn")
         self._segmented: set = set()    # signature keys compiled in segments
         self._seg_cache: dict = {}
         wraps(fn)(self)
@@ -100,6 +128,7 @@ class StaticFunction:
         self._cache.clear()
         self._state_by_key.clear()
         self._state = None
+        _OBS_CACHE_SIZE.set(0, fn=self._obs_name)
 
     # -- helpers ------------------------------------------------------------
     @staticmethod
@@ -184,6 +213,7 @@ class StaticFunction:
             # Tensor kwargs: fold into args via sorted binding
             raise TypeError("to_static: pass Tensors positionally")
         key = (treedef, sig, kw_key)
+        fn_name = self._obs_name
         if key in self._segmented:
             return self._call_segmented(key, treedef, kwargs, args,
                                         arg_arrays)
@@ -193,14 +223,24 @@ class StaticFunction:
             # after earlier signatures were traced (VERDICT r1 weak #11).
             # Limitation: state created later under an ALREADY-compiled
             # signature stays invisible — call .recapture() for that.
+            if self._state_by_key:
+                _OBS_RETRACES.inc(fn=fn_name)
+            _OBS_MISSES.inc(fn=fn_name)
+            t0 = time.perf_counter()
             out = self._discover(args, kwargs)
+            _OBS_TRACE_SECONDS.inc(time.perf_counter() - t0, fn=fn_name)
             self._state_by_key[key] = list(self._state)
+            _OBS_CACHE_SIZE.set(len(self._state_by_key), fn=fn_name)
             return out
+        _OBS_HITS.inc(fn=fn_name)
         entry = self._cache.get(key)
         if entry is None:
             state_list = self._state_by_key[key]
+            t0 = time.perf_counter()
             jitted, cell = self._compile(treedef, sig, dict(kwargs),
                                          state_list)
+            _OBS_TRACE_SECONDS.inc(time.perf_counter() - t0, fn=fn_name)
+            _OBS_COMPILES.inc(fn=fn_name)
             entry = (jitted, cell, state_list)
             self._cache[key] = entry
         jitted, cell, state_list = entry
@@ -358,9 +398,17 @@ class StaticFunction:
         from . import sot
 
         if key not in self._state_by_key:
+            fn_name = self._obs_name
+            if self._state_by_key:
+                _OBS_RETRACES.inc(fn=fn_name)
+            _OBS_MISSES.inc(fn=fn_name)
+            t0 = time.perf_counter()
             out = self._discover(args, kwargs)
+            _OBS_TRACE_SECONDS.inc(time.perf_counter() - t0, fn=fn_name)
             self._state_by_key[key] = list(self._state)
+            _OBS_CACHE_SIZE.set(len(self._state_by_key), fn=fn_name)
             return out
+        _OBS_HITS.inc(fn=self._obs_name)
         state_list = self._state_by_key[key]
         entry = self._seg_cache.get(key)
         if entry is None:

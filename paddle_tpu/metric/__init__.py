@@ -1,1 +1,16 @@
-from .metrics import Metric, Accuracy, Precision, Recall, Auc, accuracy  # noqa: F401
+"""Evaluation metrics (reference: python/paddle/metric/__init__.py) —
+model-QUALITY metrics scored over predictions and labels: `Metric` base
+plus Accuracy/Precision/Recall/Auc and the functional `accuracy`.
+
+Not to be confused with `paddle_tpu.observability`, the runtime TELEMETRY
+registry (Counters/Gauges/Histograms for recompiles, collective traffic,
+dataloader stalls, step latency/MFU). Use this package to score what the
+model predicts; use `paddle_tpu.observability` to watch how the system
+runs.
+"""
+
+from .metrics import (  # noqa: F401
+    Metric, Accuracy, Precision, Recall, Auc, accuracy,
+)
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]

@@ -1,0 +1,67 @@
+"""paddle_tpu.observability — always-on runtime telemetry.
+
+The metrics layer every perf PR reads from: a zero-dependency registry of
+Counters, Gauges and fixed-bucket Histograms, plus exporters (Prometheus
+text exposition, JSON snapshot, Chrome-trace merge). The framework's hot
+layers are instrumented out of the box:
+
+* ``jit.to_static`` — trace-cache hits/misses/retraces, trace seconds,
+  per-function cache size (``paddle_tpu_jit_*``): a recompile storm is a
+  first-class metric, not a mystery slowdown.
+* ``distributed.communication`` — per-collective call counts and payload
+  bytes by group (``paddle_tpu_comm_*``).
+* ``io.DataLoader`` — batch wait-time vs consumer compute-time histograms
+  (``paddle_tpu_io_*``).
+* ``profiler.RecordEvent`` — span counts that survive after a trace window
+  closes (``paddle_tpu_profiler_events_total``).
+* :class:`StepTimer` — step latency, tokens/sec, analytic-FLOPs MFU, and
+  host<->device transfer bytes (``paddle_tpu_step_*``), sharing bench.py's
+  MFU math.
+
+Metric names follow ``paddle_tpu_<area>_<name>_<unit>``. Collection is on
+by default; ``PADDLE_TPU_METRICS=0`` (or :func:`enable`\\ ``(False)``)
+turns every recording call into a near-zero-cost no-op.
+
+Quick use::
+
+    import paddle_tpu.observability as obs
+    obs.dump()        # JSON-safe snapshot of every sampled metric
+    obs.serve_text()  # Prometheus text exposition
+
+NOT to be confused with ``paddle_tpu.metric`` — that package scores model
+predictions (Accuracy/Precision/Recall/Auc); this one watches the system
+run.
+"""
+
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, Registry, DEFAULT_BUCKETS,
+    get_registry, counter, gauge, histogram,
+    enabled, enable, value, total, reset,
+)
+from .exporters import (  # noqa: F401
+    render_prometheus, snapshot, merge_into_chrome_trace,
+)
+from .step_timer import (  # noqa: F401
+    StepTimer, device_peak_flops, analytic_mfu, PEAK_FLOPS_TABLE,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
+    "get_registry", "counter", "gauge", "histogram",
+    "enabled", "enable", "value", "total", "reset",
+    "render_prometheus", "snapshot", "merge_into_chrome_trace",
+    "StepTimer", "device_peak_flops", "analytic_mfu", "PEAK_FLOPS_TABLE",
+    "dump", "serve_text",
+]
+
+
+def dump(registry=None) -> dict:
+    """JSON-safe snapshot of every sampled metric — the payload bench.py
+    embeds as its ``"telemetry"`` block."""
+    return snapshot(registry)
+
+
+def serve_text(registry=None) -> str:
+    """Prometheus text exposition of the registry (one ``# TYPE`` line per
+    metric), ready to serve from a /metrics endpoint or write to a file."""
+    return render_prometheus(registry)

@@ -1,0 +1,325 @@
+"""Runtime telemetry registry: Counter / Gauge / Histogram.
+
+Zero-dependency by design (stdlib only — no jax, no numpy): every hot layer
+of the framework (jit dispatch, collectives, the dataloader, profiler spans)
+imports this module at its own import time, so it must never pull the
+accelerator stack in or add measurable import cost.
+
+Naming convention: ``paddle_tpu_<area>_<name>_<unit>`` — e.g.
+``paddle_tpu_jit_trace_cache_misses_total``, ``paddle_tpu_io_batch_wait_seconds``.
+Counters end in ``_total``; histograms and gauges end in their unit.
+
+Overhead contract: when disabled (``PADDLE_TPU_METRICS=0`` in the
+environment, or ``enable(False)`` at runtime) every mutation method returns
+after a single attribute load + bool test — no locking, no dict access —
+so instrumentation can stay in hot paths unconditionally.
+
+Thread safety: each metric owns one lock protecting its label->value table;
+registries own a lock for get-or-create. Reads used by exporters copy under
+the same lock.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from bisect import bisect_left
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
+    "get_registry", "counter", "gauge", "histogram",
+    "enabled", "enable", "value", "total", "reset",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_METRICS", "1").lower() not in (
+        "0", "false", "off")
+
+
+class _State:
+    __slots__ = ("enabled",)
+
+    def __init__(self):
+        self.enabled = _env_enabled()
+
+
+_state = _State()
+
+
+def enabled() -> bool:
+    """True while telemetry collection is on (``PADDLE_TPU_METRICS`` env,
+    overridable at runtime via :func:`enable`)."""
+    return _state.enabled
+
+
+def enable(flag: bool = True) -> bool:
+    """Turn collection on/off process-wide; returns the new state."""
+    _state.enabled = bool(flag)
+    return _state.enabled
+
+
+def _label_key(labels: dict) -> tuple:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+class MetricBase:
+    """Shared storage: a lock-guarded ``{sorted-label-tuple: value}`` table."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: dict = {}
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+    def _items(self):
+        with self._lock:
+            return sorted(self._values.items())
+
+    def snapshot(self) -> dict:
+        return {"type": self.kind, "help": self.help,
+                "values": {_format_labels(k): v for k, v in self._items()}}
+
+
+def _format_labels(key: tuple) -> str:
+    """Stable string form of one label set for JSON snapshots: ``fn="f"``
+    pairs joined by commas, empty string for the unlabeled series."""
+    return ",".join(f'{k}="{v}"' for k, v in key)
+
+
+class Counter(MetricBase):
+    kind = "counter"
+
+    def inc(self, value: float = 1, /, **labels):
+        if not _state.enabled:
+            return
+        if value < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + value
+
+    def value(self, /, **labels):
+        return self._values.get(_label_key(labels), 0)
+
+    def total(self):
+        with self._lock:
+            return sum(self._values.values())
+
+
+class Gauge(MetricBase):
+    kind = "gauge"
+
+    def set(self, value: float, /, **labels):
+        if not _state.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = value
+
+    def inc(self, value: float = 1, /, **labels):
+        if not _state.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + value
+
+    def dec(self, value: float = 1, /, **labels):
+        self.inc(-value, **labels)
+
+    def value(self, /, **labels):
+        return self._values.get(_label_key(labels), 0)
+
+
+# Prometheus-style latency buckets, in seconds.
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(MetricBase):
+    """Fixed-bucket histogram. Buckets are upper bounds (inclusive, the
+    Prometheus ``le`` contract) plus an implicit +Inf overflow slot.
+    Per-label storage is ``[per-bucket counts, sum, count]``; cumulative
+    counts are materialized only at export time."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bs
+
+    def observe(self, value: float, /, **labels):
+        if not _state.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            row = self._values.get(key)
+            if row is None:
+                row = self._values[key] = [
+                    [0] * (len(self.buckets) + 1), 0.0, 0]
+            row[0][bisect_left(self.buckets, value)] += 1
+            row[1] += value
+            row[2] += 1
+
+    def value(self, /, **labels) -> dict:
+        """``{"count", "sum", "buckets"}`` with CUMULATIVE bucket counts
+        keyed by the ``le`` bound (``repr(float)`` form, plus ``+Inf``)."""
+        with self._lock:
+            row = self._values.get(_label_key(labels))
+            if row is None:
+                return {"count": 0, "sum": 0.0, "buckets": {}}
+            counts, s, n = list(row[0]), row[1], row[2]
+        out, acc = {}, 0
+        for b, c in zip(self.buckets, counts):
+            acc += c
+            out[repr(b)] = acc
+        out["+Inf"] = acc + counts[-1]
+        return {"count": n, "sum": s, "buckets": out}
+
+    def snapshot(self) -> dict:
+        vals = {}
+        with self._lock:
+            keys = sorted(self._values)
+        for k in keys:
+            vals[_format_labels(k)] = self.value(**dict(k))
+        return {"type": self.kind, "help": self.help,
+                "buckets": [repr(b) for b in self.buckets], "values": vals}
+
+
+_METRIC_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class Registry:
+    """Get-or-create store of metrics by name. Creating the same name twice
+    returns the existing object; asking for it under a different type
+    raises (one name, one type — the Prometheus exposition contract)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, MetricBase] = {}
+
+    def _get_or_create(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as {m.kind}, "
+                        f"requested {cls.kind}")
+                want = kw.get("buckets")
+                if want is not None and \
+                        tuple(sorted(float(b) for b in want)) != m.buckets:
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {m.buckets}, requested "
+                        f"{tuple(sorted(float(b) for b in want))}")
+                return m
+            kw = {k: v for k, v in kw.items() if v is not None}
+            m = self._metrics[name] = cls(name, help, **kw)
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=None) -> Histogram:
+        """Get-or-create a histogram. buckets=None accepts an existing
+        metric's bounds (DEFAULT_BUCKETS when creating); explicit buckets
+        must MATCH an already-registered metric's bounds or this raises —
+        silently binning into bounds the caller never asked for would
+        corrupt the data."""
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> MetricBase | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> list[MetricBase]:
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def snapshot(self) -> dict:
+        """JSON-safe ``{name: metric.snapshot()}``, names sorted. Series
+        that never recorded a sample are omitted (a registered-but-silent
+        metric carries no information and would bloat bench JSON lines)."""
+        out = {}
+        for m in self.metrics():
+            snap = m.snapshot()
+            if snap["values"]:
+                out[m.name] = snap
+        return out
+
+    def value(self, name: str, /, **labels):
+        m = self.get(name)
+        if m is None:
+            return 0
+        return m.value(**labels)
+
+    def total(self, name: str):
+        """Sum of a counter across all label sets (0 for unknown names)."""
+        m = self.get(name)
+        if m is None:
+            return 0
+        if isinstance(m, Counter):
+            return m.total()
+        raise TypeError(f"total() is only defined for counters, "
+                        f"{name!r} is a {m.kind}")
+
+    def reset(self):
+        """Zero every metric's samples; registered metric OBJECTS survive,
+        so module-level handles held by instrumentation stay live."""
+        for m in self.metrics():
+            m.clear()
+
+
+_default_registry = Registry()
+
+
+def get_registry() -> Registry:
+    """The process-wide default registry all framework instrumentation
+    records into."""
+    return _default_registry
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _default_registry.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _default_registry.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", buckets=None) -> Histogram:
+    return _default_registry.histogram(name, help, buckets=buckets)
+
+
+def value(name: str, /, **labels):
+    return _default_registry.value(name, **labels)
+
+
+def total(name: str):
+    return _default_registry.total(name)
+
+
+def reset():
+    _default_registry.reset()

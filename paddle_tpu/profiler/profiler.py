@@ -20,9 +20,18 @@ import os
 import time
 from enum import Enum
 
+from ..observability import counter as _obs_counter
+
 __all__ = ["ProfilerState", "ProfilerTarget", "SummaryView", "make_scheduler",
            "export_chrome_tracing", "export_protobuf", "Profiler",
            "RecordEvent", "load_profiler_result"]
+
+# Span counts outlive trace windows (paddle_tpu.observability): RecordEvent
+# durations live only while a Profiler records, but HOW OFTEN each span ran
+# stays queryable after the window closes.
+_OBS_SPANS = _obs_counter(
+    "paddle_tpu_profiler_events_total",
+    "RecordEvent spans closed, by span name (survives trace windows)")
 
 
 class ProfilerState(Enum):
@@ -141,6 +150,7 @@ class RecordEvent:
             self._jax_ann = None
         if self._begin is None:
             return
+        _OBS_SPANS.inc(name=self.name)
         prof = _active_profiler
         if prof is not None and prof._recording():
             prof._events.append(
@@ -393,9 +403,19 @@ class Profiler:
             events.append({"name": f"ProfileStep#{i}", "ph": "C",
                            "ts": i, "pid": os.getpid(),
                            "args": {"step_time_ms": dt * 1e3}})
+        payload = {"traceEvents": events, "op_counts": self._op_counts}
+        try:
+            # merged telemetry view: the runtime metric snapshot rides along
+            # in the trace file under its own key; traceEvents themselves
+            # stay byte-identical for existing consumers
+            from ..observability import enabled as _obs_en
+            from ..observability import merge_into_chrome_trace
+            if _obs_en():
+                merge_into_chrome_trace(payload)
+        except Exception:
+            pass
         with open(path, "w") as f:
-            json.dump({"traceEvents": events,
-                       "op_counts": self._op_counts}, f)
+            json.dump(payload, f)
         return path
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,

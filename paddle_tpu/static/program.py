@@ -108,8 +108,18 @@ class Program:
     def __init__(self):
         import weakref
         _ALL_PROGRAMS.append(weakref.ref(self))
-        self._dbg = api_util.debug_info("static_program", lambda *a: a,
-                                        (), {})
+        try:
+            self._dbg = api_util.debug_info("static_program", lambda *a: a,
+                                            (), {})
+        except TypeError:
+            # older jax (<=0.4.x) signature: (traced_for, src,
+            # fun_signature, args, kwargs, static_argnums, static_argnames).
+            # Static tracing itself needs the newer jax, but this module is
+            # imported by EVERY create_parameter call — a raise here bricks
+            # eager/jit param creation process-wide (the first import dies,
+            # later ones silently reuse the cached .program submodule)
+            self._dbg = api_util.debug_info("static_program", None, None,
+                                            (), {}, (), ())
         self._trace = None
         self._ambient_cm = None       # entered set_current_trace context
         self._prev_tracker = None

@@ -1,0 +1,190 @@
+"""Registry unit tests: concurrency, histogram bucket boundaries, the
+disabled-mode no-op fast path, and Prometheus text-format golden output."""
+
+import subprocess
+import sys
+import threading
+
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import Counter, Gauge, Histogram, Registry
+from paddle_tpu.observability.exporters import render_prometheus
+
+
+def test_counter_concurrency_two_threads():
+    reg = Registry()
+    c = reg.counter("paddle_tpu_test_bumps_total", "bumps")
+
+    def bump():
+        for _ in range(10000):
+            c.inc()
+            c.inc(1, fn="labeled")
+
+    threads = [threading.Thread(target=bump) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 20000
+    assert c.value(fn="labeled") == 20000
+    assert c.total() == 40000
+
+
+def test_histogram_bucket_boundaries():
+    h = Histogram("paddle_tpu_test_lat_seconds", "lat",
+                  buckets=(0.001, 0.01, 0.1))
+    # le is inclusive: an observation exactly on a bound lands IN it
+    h.observe(0.001)
+    h.observe(0.005)
+    h.observe(0.1)
+    h.observe(5.0)   # overflow -> +Inf only
+    v = h.value()
+    assert v["count"] == 4
+    assert abs(v["sum"] - 5.106) < 1e-9
+    assert v["buckets"] == {"0.001": 1, "0.01": 2, "0.1": 3, "+Inf": 4}
+
+
+def test_counter_rejects_negative_and_type_conflicts():
+    reg = Registry()
+    c = reg.counter("paddle_tpu_test_x_total", "x")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # same name returns the same object; a different type raises
+    assert reg.counter("paddle_tpu_test_x_total") is c
+    with pytest.raises(TypeError):
+        reg.gauge("paddle_tpu_test_x_total")
+    with pytest.raises(ValueError):
+        Counter("has space")
+
+
+def test_disabled_mode_is_a_noop():
+    reg = Registry()
+    c = reg.counter("paddle_tpu_test_noop_total", "x")
+    g = reg.gauge("paddle_tpu_test_noop_depth", "x")
+    h = reg.histogram("paddle_tpu_test_noop_seconds", "x", buckets=(1.0,))
+    assert obs.enabled()
+    obs.enable(False)
+    try:
+        c.inc()
+        g.set(5)
+        h.observe(0.5)
+    finally:
+        obs.enable(True)
+    assert c.value() == 0
+    assert g.value() == 0
+    assert h.value()["count"] == 0
+    # re-enabled: recording works again
+    c.inc()
+    assert c.value() == 1
+
+
+def test_env_var_disables_collection():
+    code = (
+        "import paddle_tpu.observability as obs\n"
+        "assert not obs.enabled()\n"
+        "c = obs.counter('paddle_tpu_test_env_total')\n"
+        "c.inc()\n"
+        "assert c.value() == 0\n"
+        "print('env-disabled ok')\n")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env={"PADDLE_TPU_METRICS": "0", "PATH": "/usr/bin:/bin",
+             "HOME": "/tmp", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": __file__.rsplit("/tests/", 1)[0]})
+    assert out.returncode == 0, out.stderr
+    assert "env-disabled ok" in out.stdout
+
+
+def test_prometheus_text_golden():
+    reg = Registry()
+    c = reg.counter("paddle_tpu_test_calls_total", "calls")
+    c.inc(fn="f")
+    c.inc(2, fn="g")
+    g = reg.gauge("paddle_tpu_test_depth", "queue depth")
+    g.set(3)
+    h = reg.histogram("paddle_tpu_test_wait_seconds", "wait",
+                      buckets=(0.3, 1.0))
+    h.observe(0.25)
+    h.observe(0.5)
+    expected = (
+        '# HELP paddle_tpu_test_calls_total calls\n'
+        '# TYPE paddle_tpu_test_calls_total counter\n'
+        'paddle_tpu_test_calls_total{fn="f"} 1\n'
+        'paddle_tpu_test_calls_total{fn="g"} 2\n'
+        '# HELP paddle_tpu_test_depth queue depth\n'
+        '# TYPE paddle_tpu_test_depth gauge\n'
+        'paddle_tpu_test_depth 3\n'
+        '# HELP paddle_tpu_test_wait_seconds wait\n'
+        '# TYPE paddle_tpu_test_wait_seconds histogram\n'
+        'paddle_tpu_test_wait_seconds_bucket{le="0.3"} 1\n'
+        'paddle_tpu_test_wait_seconds_bucket{le="1.0"} 2\n'
+        'paddle_tpu_test_wait_seconds_bucket{le="+Inf"} 2\n'
+        'paddle_tpu_test_wait_seconds_sum 0.75\n'
+        'paddle_tpu_test_wait_seconds_count 2\n')
+    assert render_prometheus(reg) == expected
+
+
+def test_prometheus_label_escaping():
+    reg = Registry()
+    c = reg.counter("paddle_tpu_test_esc_total", "x")
+    c.inc(path='a"b\\c')
+    text = render_prometheus(reg)
+    assert 'path="a\\"b\\\\c"' in text
+
+
+def test_snapshot_and_reset():
+    reg = Registry()
+    c = reg.counter("paddle_tpu_test_snap_total", "x")
+    silent = reg.gauge("paddle_tpu_test_silent", "never set")
+    c.inc(5)
+    snap = reg.snapshot()
+    assert snap["paddle_tpu_test_snap_total"]["values"][""] == 5
+    # silent metrics are omitted from snapshots but keep their TYPE line
+    assert "paddle_tpu_test_silent" not in snap
+    assert "# TYPE paddle_tpu_test_silent gauge" in render_prometheus(reg)
+    reg.reset()
+    assert reg.snapshot() == {}
+    # the metric OBJECT survives a reset: held handles keep working
+    c.inc()
+    assert c.value() == 1
+    assert silent.value() == 0
+
+
+def test_gauge_inc_dec_and_histogram_labels():
+    reg = Registry()
+    g = reg.gauge("paddle_tpu_test_g", "x")
+    g.inc(3)
+    g.dec()
+    assert g.value() == 2
+    h = reg.histogram("paddle_tpu_test_h_seconds", "x", buckets=(1.0,))
+    h.observe(0.5, name="a")
+    h.observe(2.0, name="b")
+    assert h.value(name="a")["count"] == 1
+    assert h.value(name="b")["buckets"]["+Inf"] == 1
+    assert h.value(name="b")["buckets"]["1.0"] == 0
+
+
+def test_default_registry_helpers():
+    c = obs.counter("paddle_tpu_test_default_total", "x")
+    before = obs.total("paddle_tpu_test_default_total")
+    c.inc(2, k="v")
+    assert obs.total("paddle_tpu_test_default_total") == before + 2
+    assert obs.value("paddle_tpu_test_default_total", k="v") >= 2
+    assert obs.value("paddle_tpu_test_nonexistent_total") == 0
+    assert obs.total("paddle_tpu_test_nonexistent_total") == 0
+    assert "paddle_tpu_test_default_total" in obs.dump()
+
+
+def test_histogram_bucket_mismatch_raises():
+    import pytest
+    reg = Registry()
+    h = reg.histogram("paddle_tpu_test_bkt_seconds", "x", buckets=(0.1, 1.0))
+    # buckets=None (default) fetches whatever exists
+    assert reg.histogram("paddle_tpu_test_bkt_seconds") is h
+    # explicit matching buckets are fine (order-insensitive)
+    assert reg.histogram("paddle_tpu_test_bkt_seconds",
+                         buckets=(1.0, 0.1)) is h
+    # explicit DIFFERENT buckets must raise, not silently mis-bin
+    with pytest.raises(ValueError):
+        reg.histogram("paddle_tpu_test_bkt_seconds", buckets=(0.5,))

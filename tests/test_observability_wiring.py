@@ -1,0 +1,204 @@
+"""Integration-point telemetry tests: the jit trace cache, collectives,
+the dataloader, profiler spans + chrome-trace merge, StepTimer, and the
+bench/perf_gate telemetry block."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def setup_function(_fn):
+    obs.reset()
+
+
+def test_jit_trace_cache_metrics():
+    @paddle.jit.to_static
+    def obs_fn(x):
+        return (x * 2).sum()
+
+    # the fn label is the wrapped callable's __qualname__ (disambiguates
+    # Layer methods sharing a bare __name__)
+    lbl = obs_fn.__qualname__
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    b = paddle.to_tensor(np.ones((3, 3), np.float32))
+    obs_fn(a)  # discovery: miss
+    obs_fn(a)  # compiled-signature hit
+    assert obs.value("paddle_tpu_jit_trace_cache_misses_total", fn=lbl) == 1
+    obs_fn(b)  # second shape: miss AND retrace
+    assert obs.value("paddle_tpu_jit_trace_cache_misses_total", fn=lbl) == 2
+    assert obs.value("paddle_tpu_jit_trace_cache_retraces_total",
+                     fn=lbl) == 1
+    obs_fn(b)
+    obs_fn(a)
+    assert obs.value("paddle_tpu_jit_trace_cache_hits_total", fn=lbl) == 3
+    assert obs.value("paddle_tpu_jit_trace_cache_entries", fn=lbl) == 2
+    assert obs.value("paddle_tpu_jit_compiles_total", fn=lbl) == 2
+    assert obs.value("paddle_tpu_jit_trace_seconds_total", fn=lbl) > 0
+    # acceptance demo: snapshot has the counters, text exposition parses
+    snap = obs.dump()
+    assert "paddle_tpu_jit_trace_cache_misses_total" in snap
+    text = obs.serve_text()
+    type_lines = [l for l in text.splitlines() if l.startswith("# TYPE ")]
+    assert len(type_lines) == len(
+        obs.get_registry().metrics())  # one TYPE line per metric
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name_part, val = line.rsplit(" ", 1)
+        float(val)  # every sample line ends in a parseable number
+
+
+def test_comm_all_reduce_records_payload_bytes():
+    from paddle_tpu.distributed.communication import all_reduce, broadcast
+    from paddle_tpu.distributed.communication.group import Group
+
+    g = Group([0, 1], name="fake_group")
+    t = paddle.to_tensor(np.ones((4, 4), np.float32))
+    all_reduce(t, group=g)
+    assert obs.value("paddle_tpu_comm_calls_total", op="all_reduce",
+                     group="fake_group") == 1
+    assert obs.value("paddle_tpu_comm_payload_bytes_total", op="all_reduce",
+                     group="fake_group") == 64  # 4*4 float32
+    broadcast(t, src=0, group=g)
+    assert obs.value("paddle_tpu_comm_calls_total", op="broadcast",
+                     group="fake_group") == 1
+    # group=None records under the world group
+    all_reduce(t)
+    assert obs.value("paddle_tpu_comm_calls_total", op="all_reduce",
+                     group="world") == 1
+
+
+def test_dataloader_wait_and_compute_histograms():
+    class DS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return np.ones(2, np.float32)
+
+        def __len__(self):
+            return 8
+
+    loader = paddle.io.DataLoader(DS(), batch_size=2, num_workers=0)
+    batches = list(loader)
+    assert len(batches) == 4
+    wait = obs.get_registry().get("paddle_tpu_io_batch_wait_seconds").value()
+    comp = obs.get_registry().get("paddle_tpu_io_compute_seconds").value()
+    assert wait["count"] == 4           # one wait sample per batch
+    assert comp["count"] == 3           # gaps BETWEEN batches only
+    assert wait["sum"] >= 0
+
+
+def test_record_event_counter_survives_window_and_trace_merges(tmp_path):
+    from paddle_tpu.profiler import Profiler, RecordEvent
+
+    # spans count even with NO active profiler (survive outside windows)
+    with RecordEvent("obs_span"):
+        pass
+    assert obs.value("paddle_tpu_profiler_events_total",
+                     name="obs_span") == 1
+
+    prof = Profiler(timer_only=True)
+    with prof:
+        with RecordEvent("obs_span"):
+            paddle.ones([2]).sum()
+        prof.step()
+    assert obs.value("paddle_tpu_profiler_events_total",
+                     name="obs_span") == 2
+    path = str(tmp_path / "trace.json")
+    prof.export(path)
+    data = json.load(open(path))
+    # trace events unchanged; telemetry merged under its own key
+    assert any(e["name"] == "obs_span" for e in data["traceEvents"])
+    assert "paddle_tpu_profiler_events_total" in data["telemetry"]
+
+
+def test_step_timer_records_latency_tokens_and_mfu():
+    st = obs.StepTimer("wiring", tokens_per_step=1000,
+                       flops_per_token=2.0, peak_flops=1e6)
+    with st:
+        time.sleep(0.01)
+    assert st.last_step_s >= 0.009
+    assert obs.value("paddle_tpu_step_total", name="wiring") == 1
+    tps = obs.value("paddle_tpu_step_tokens_per_second", name="wiring")
+    assert 0 < tps < 1000 / 0.009
+    assert abs(obs.value("paddle_tpu_step_mfu_ratio", name="wiring")
+               - tps * 2.0 / 1e6) < 1e-12
+    # externally-timed window (the bench pattern)
+    stats = st.record_window(steps=10, tokens=20000, seconds=2.0)
+    assert stats["step_seconds"] == 0.2
+    assert stats["tokens_per_sec"] == 10000.0
+    assert obs.value("paddle_tpu_step_total", name="wiring") == 11
+    st.record_transfer(4096)
+    assert obs.value("paddle_tpu_step_transfer_bytes_total",
+                     name="wiring") == 4096
+
+
+def test_peak_flops_table_shared_with_bench():
+    sys.path.insert(0, REPO)
+    import bench
+
+    class Dev:
+        platform = "tpu"
+        device_kind = "TPU v5e"
+
+    flops, src = bench._peak_flops(Dev())
+    assert flops == 197e12 and src.startswith("device_kind")
+
+    class Cpu:
+        platform = "cpu"
+        device_kind = ""
+
+    assert bench._peak_flops(Cpu()) == (0.0, "cpu")
+
+
+def test_bench_attach_telemetry_block():
+    sys.path.insert(0, REPO)
+    import bench
+
+    obs.counter("paddle_tpu_test_bench_total").inc()
+    r = bench._attach_telemetry({"metric": "m", "value": 1.0})
+    assert isinstance(r["telemetry"], dict)
+    assert "metrics" in r["telemetry"]
+    assert "trace_cache_retraces" in r["telemetry"]["steady_state"]
+    # disabled -> null with a reason
+    obs.enable(False)
+    try:
+        r2 = bench._attach_telemetry({"metric": "m", "value": 1.0})
+    finally:
+        obs.enable(True)
+    assert r2["telemetry"] is None
+    assert "PADDLE_TPU_METRICS" in r2["telemetry_reason"]
+
+
+def test_perf_gate_fails_on_steady_state_retraces(tmp_path):
+    gate = os.path.join(REPO, "tools", "perf_gate.py")
+    base = tmp_path / "base.json"
+    cur_ok = tmp_path / "ok.json"
+    cur_retrace = tmp_path / "retrace.json"
+    base.write_text(json.dumps({"metric": "m", "value": 100.0}))
+    cur_ok.write_text(json.dumps(
+        {"metric": "m", "value": 101.0,
+         "telemetry": {"metrics": {},
+                       "steady_state": {"trace_cache_retraces": 0}}}))
+    cur_retrace.write_text(json.dumps(
+        {"metric": "m", "value": 150.0,
+         "telemetry": {"metrics": {},
+                       "steady_state": {"trace_cache_retraces": 3}}}))
+
+    def run(cur):
+        return subprocess.run(
+            [sys.executable, gate, "--baseline", str(base),
+             "--current", str(cur)], capture_output=True, text=True)
+
+    ok = run(cur_ok)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = run(cur_retrace)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "RETRACE" in bad.stdout

@@ -24,16 +24,17 @@ import json
 import sys
 
 
-def load_value(path):
+def load_bench(path):
+    """Full bench dict from a BENCH_*.json file (accepts the raw one-line
+    form, the driver's wrapped form, and the `tail`-embedded form)."""
     with open(path) as f:
         txt = f.read()
-    # the driver's BENCH_r*.json wraps the line; accept both forms
     try:
         d = json.loads(txt)
     except json.JSONDecodeError:
         lines = [l for l in txt.splitlines() if l.strip().startswith("{")]
         if not lines:
-            return None, 0.0  # no usable value: caller passes
+            return {}
         d = json.loads(lines[-1])
     if "tail" in d and isinstance(d.get("tail"), str):
         for line in reversed(d["tail"].splitlines()):
@@ -41,7 +42,27 @@ def load_value(path):
             if line.startswith("{"):
                 d = json.loads(line)
                 break
+    return d if isinstance(d, dict) else {}
+
+
+def load_value(path):
+    d = load_bench(path)
+    if not d:
+        return None, 0.0  # no usable value: caller passes
     return d.get("metric"), float(d.get("value", 0.0))
+
+
+def telemetry_retraces(d):
+    """Steady-state retrace count from a bench dict's telemetry block, or
+    None when the block is absent/null (older rounds, disabled metrics)."""
+    tel = d.get("telemetry")
+    if not isinstance(tel, dict):
+        return None
+    ss = tel.get("steady_state")
+    if not isinstance(ss, dict):
+        return None
+    r = ss.get("trace_cache_retraces")
+    return int(r) if r is not None else None
 
 
 def best_of_history(pattern, metric, last_n=3):
@@ -75,7 +96,18 @@ def main():
     ap.add_argument("--current", required=True)
     ap.add_argument("--tolerance", type=float, default=0.03)
     args = ap.parse_args()
-    cm, cv = load_value(args.current)
+    cd = load_bench(args.current)
+    cm, cv = (cd.get("metric"), float(cd.get("value", 0.0))) if cd \
+        else (None, 0.0)
+    # telemetry gate (observability wiring): a retrace during the measured
+    # steady-state window means the number includes recompiles — fail loudly
+    # even if the throughput still cleared the floor
+    retraces = telemetry_retraces(cd)
+    retrace_fail = bool(retraces and retraces > 0)
+    if retrace_fail:
+        print(f"perf gate [RETRACE] steady-state window recompiled "
+              f"{retraces}x (telemetry trace_cache_retraces): the measured "
+              f"number is not steady-state")
     if args.history:
         src, bv = best_of_history(args.history, cm)
         bm = cm if src else None
@@ -86,18 +118,21 @@ def main():
     else:
         ap.error("need --baseline or --history")
     if bv <= 0:
-        print(f"perf gate: baseline has no usable value ({bm}={bv}); pass")
-        return 0
+        print(f"perf gate: baseline has no usable value ({bm}={bv}); "
+              f"{'FAIL (retrace)' if retrace_fail else 'pass'}")
+        return 1 if retrace_fail else 0
     if bm != cm:
-        print(f"perf gate: metric changed {bm} -> {cm}; pass (no comparison)")
-        return 0
+        print(f"perf gate: metric changed {bm} -> {cm}; "
+              f"{'FAIL (retrace)' if retrace_fail else 'pass'} "
+              "(no value comparison)")
+        return 1 if retrace_fail else 0
     floor = bv * (1 - args.tolerance)
     delta = (cv - bv) / bv if bv else 0.0
     status = "OK" if cv >= floor else "REGRESSION"
     print(f"perf gate [{status}] {cm}: current {cv:.1f} vs baseline "
           f"{bv:.1f} (delta {delta:+.2%}, floor {floor:.1f}, "
           f"tol {args.tolerance:.0%})")
-    return 0 if cv >= floor else 1
+    return 0 if (cv >= floor and not retrace_fail) else 1
 
 
 if __name__ == "__main__":
