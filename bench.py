@@ -34,8 +34,22 @@ _PARTIAL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 # retrace counts observed inside each steady-state timing window (one entry
 # per _train_throughput call); summed into the telemetry block so
-# tools/perf_gate.py can fail a round whose measured window recompiled
+# tools/perf_gate.py can fail a round whose measured window recompiled.
+# _STEADY_RETRACES_BY_FN keeps the per-__qualname__ split (the retraces
+# counter is labeled fn=<qualname>) so the gate's failure message can name
+# the offending function and point at the trace-safety analyzer.
 _STEADY_RETRACES: list = []
+_STEADY_RETRACES_BY_FN: dict = {}
+
+
+def _retraces_by_fn(obs):
+    """{qualname: count} view of the labeled retraces counter."""
+    m = obs.get_registry().get(
+        "paddle_tpu_jit_trace_cache_retraces_total")
+    if m is None:
+        return {}
+    return {labels.get("fn", "_unlabeled"): float(v)
+            for labels, v in m.series()}
 
 
 def _attach_telemetry(result):
@@ -53,6 +67,10 @@ def _attach_telemetry(result):
                 "steady_state": {
                     "trace_cache_retraces": int(sum(_STEADY_RETRACES)),
                     "windows": len(_STEADY_RETRACES),
+                    "retraces_by_fn": {
+                        fn: int(v)
+                        for fn, v in sorted(_STEADY_RETRACES_BY_FN.items())
+                        if v},
                 },
             }
             result.pop("telemetry_reason", None)
@@ -128,6 +146,7 @@ def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
     # fails the round on it (observability wiring)
     import paddle_tpu.observability as obs
     retr0 = obs.total("paddle_tpu_jit_trace_cache_retraces_total")
+    by_fn0 = _retraces_by_fn(obs)
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = train_step(x, y)
@@ -135,6 +154,11 @@ def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
     dt = time.perf_counter() - t0
     _STEADY_RETRACES.append(
         int(obs.total("paddle_tpu_jit_trace_cache_retraces_total") - retr0))
+    for fn, v in _retraces_by_fn(obs).items():
+        d = v - by_fn0.get(fn, 0.0)
+        if d > 0:
+            _STEADY_RETRACES_BY_FN[fn] = \
+                _STEADY_RETRACES_BY_FN.get(fn, 0.0) + d
     obs.StepTimer("bench_steady").record_window(steps, batch * seq * steps,
                                                 dt)
 

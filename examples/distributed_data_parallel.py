@@ -35,10 +35,13 @@ def main(steps=20):
         opt.clear_grad()
         return loss
 
+    # keep the loss on device in the hot loop (per-step float() is a host
+    # sync the analyzer flags as TS008); convert once after the loop
     first = last = None
     for i in range(steps):
-        last = float(step(paddle.to_tensor(xv), paddle.to_tensor(yv)))
+        last = step(paddle.to_tensor(xv), paddle.to_tensor(yv))
         first = first if first is not None else last
+    first, last = float(first), float(last)
     print(f"dp={n}: loss {first:.4f} -> {last:.4f}")
     assert last < first
     return last

@@ -1,0 +1,130 @@
+"""Analyzer entry points: source strings, files, live functions, trees.
+
+Two consumption modes, same rule engine (:mod:`.rules`):
+
+* **decoration time** — ``to_static(..., lint=True)`` (or
+  ``PADDLE_TPU_JIT_LINT=1``) calls :func:`analyze_function` on the
+  function being decorated, via ``inspect.getsource``; findings surface
+  as :class:`~.diagnostics.TraceSafetyWarning` before the first trace.
+* **whole-file / CI** — ``python -m paddle_tpu.analysis <paths>`` lints
+  every ``to_static``-reachable region it can find statically (decorated
+  defs, ``name = to_static(fn)`` bindings) plus the module-scope rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import os
+import textwrap
+
+from .diagnostics import ERROR, Finding
+from .rules import RULES, check_module
+
+__all__ = [
+    "analyze_source", "analyze_file", "analyze_function", "analyze_paths",
+    "has_errors",
+]
+
+
+def analyze_source(source: str, filename: str = "<string>",
+                   force_traced=None,
+                   line_offset: int = 0) -> list[Finding]:
+    """Lint one module's source; returns findings sorted by position.
+
+    ``force_traced`` marks a region as traced even without a visible
+    ``to_static`` decorator: a qualname, ``"first"`` (the first function
+    in the source), or an int line number (the function whose first
+    decorator/def line matches — the decoration-time path).
+    """
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        r = RULES["TS000"]
+        return [Finding(
+            rule_id="TS000", severity=r.severity,
+            message=f"syntax error: {e.msg}", file=filename,
+            line=(e.lineno or 1) + line_offset, col=(e.offset or 1) - 1,
+            end_line=(e.lineno or 1) + line_offset,
+            end_col=e.offset or 1, hint=r.hint)]
+    return check_module(tree, filename, force_traced=force_traced,
+                        line_offset=line_offset)
+
+
+def analyze_file(path: str) -> list[Finding]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        r = RULES["TS000"]
+        return [Finding(rule_id="TS000", severity=r.severity,
+                        message=f"cannot read file: {e}", file=path,
+                        line=1, col=0, end_line=1, end_col=0,
+                        hint="check the path passed to the analyzer")]
+    return analyze_source(src, filename=path)
+
+
+def analyze_function(fn) -> list[Finding]:
+    """Decoration-time lint of a live callable handed to ``to_static``.
+
+    Lints the function's WHOLE source file (so module imports resolve —
+    ``np.random``/``time.time`` aliases are rule inputs) with the
+    function's own region forced traced, then keeps only the findings
+    inside that region. Falls back to the extracted source snippet when
+    the file is unreadable. Best effort by design: when source is
+    unavailable at all (C functions, REPL-defined code, exec'd strings)
+    the lint silently returns [] — lint must never block compilation.
+    """
+    fn = inspect.unwrap(fn)
+    if inspect.ismethod(fn):
+        fn = fn.__func__
+    try:
+        lines, start = inspect.getsourcelines(fn)
+        filename = inspect.getsourcefile(fn) or "<unknown>"
+    except (OSError, TypeError):
+        return []
+    full_src = None
+    if os.path.isfile(filename):
+        try:
+            with open(filename, encoding="utf-8") as f:
+                full_src = f.read()
+        except OSError:
+            full_src = None
+    if full_src is not None:
+        # `start` is the first decorator/def line — the force_traced key
+        end = start + len(lines) - 1
+        findings = analyze_source(full_src, filename=filename,
+                                  force_traced=start)
+        if not any(f.rule_id == "TS000" for f in findings):
+            return [f for f in findings if start <= f.line <= end]
+        # whole file unparseable (mid-edit?) — the snippet may still parse
+    src = textwrap.dedent("".join(lines))
+    return analyze_source(src, filename=filename, force_traced="first",
+                          line_offset=start - 1)
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d != "__pycache__" and
+                               not d.startswith(".")]
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+        else:
+            yield p
+
+
+def analyze_paths(paths) -> list[Finding]:
+    """Lint every .py file under the given files/directories."""
+    findings: list[Finding] = []
+    for path in _iter_py_files(paths):
+        findings.extend(analyze_file(path))
+    findings.sort(key=lambda f: f.sort_key())
+    return findings
+
+
+def has_errors(findings) -> bool:
+    return any(f.severity == ERROR for f in findings)

@@ -1,0 +1,882 @@
+"""Trace-safety rules: AST checks over functions handed to ``to_static``.
+
+The reference stack decides *statically* which Python constructs survive
+tracing (SOT's bytecode scanner + the dy2static AST pass under
+``python/paddle/jit/``); this module is that subsystem for the JAX port.
+Every rule is grounded in a concrete runtime cost the jit layer already
+pays or measures:
+
+* host syncs under trace are what ``jit/sot.py:maybe_break`` turns into
+  graph breaks (a compiled-prefix + Python-replay split per call);
+* data-dependent Python branches are the graph-break trigger itself;
+* retrace-prone signatures are what climbs the
+  ``paddle_tpu_jit_trace_cache_retraces_total`` counter (observability);
+* impure effects and host RNG run ONCE at trace time and freeze into the
+  compiled program as constants — silent wrong results, not errors.
+
+The analysis is intentionally intra-function and heuristic (a linter, not
+a prover): parameters without scalar annotations/defaults are assumed
+tensor-valued, taint propagates through assignments and calls in source
+order, and module-alias knowledge comes from the file's own imports.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .diagnostics import ERROR, INFO, WARNING, Finding
+
+__all__ = ["Rule", "RULES", "check_module"]
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str
+    hint: str
+
+
+RULES = {r.id: r for r in [
+    Rule("TS000", "parse-error", WARNING,
+         "file could not be parsed; trace safety not analyzable",
+         "fix the syntax error so the file can be linted"),
+    Rule("TS001", "host-sync-under-trace", ERROR,
+         "host sync (.numpy()/.item()/float()/bool()/np.asarray) on a "
+         "tensor inside traced code — forces a graph break per call",
+         "keep the value on device: return it from the step and sync "
+         "outside the traced function, or compute with tensor ops"),
+    Rule("TS002", "data-dependent-control-flow", ERROR,
+         "Python if/while on a tensor value inside traced code — the "
+         "condition is a tracer, so the branch breaks the graph",
+         "branch on static metadata (x.shape/dtype) or compute both sides "
+         "and select with paddle.where / a masked blend"),
+    Rule("TS003", "retrace-prone-signature", WARNING,
+         "Python scalar argument or len()-derived value flows into a "
+         "shape — every distinct value compiles a new program",
+         "pass step-varying values as 0-d tensors, pad/bucket shapes to "
+         "a fixed set, or hoist true constants into the closure"),
+    Rule("TS004", "impure-side-effect-under-trace", WARNING,
+         "side effect inside traced code runs once at trace time, not "
+         "per step (print/time/open/global mutation)",
+         "move the effect outside the traced function; it will not "
+         "re-execute on cached-program calls"),
+    Rule("TS005", "non-jax-randomness-under-trace", ERROR,
+         "host RNG (random/np.random) inside traced code freezes to a "
+         "trace-time constant — every compiled step reuses one sample",
+         "use the framework RNG (paddle.seed + paddle.randn/rand/...), "
+         "which threads traced RNG state through the compiled step"),
+    Rule("TS006", "untracked-state-write", WARNING,
+         "in-place write to non-local Python state inside traced code — "
+         "state discovery only tracks framework Tensor storage, so this "
+         "write freezes at its trace-time value",
+         "keep per-step state in framework Tensors (tracked by "
+         "discovery), or mutate outside the traced function"),
+    Rule("TS007", "dead-annotation", INFO,
+         "trace annotation has no effect (ignore_module is a no-op in "
+         "this port; not_to_static on a never-referenced function)",
+         "delete the annotation, or reference the function from traced "
+         "code if the exemption is intentional"),
+    Rule("TS008", "host-sync-in-hot-loop", WARNING,
+         "unconditional host sync on a jitted step's output every loop "
+         "iteration — serializes dispatch against the device each step",
+         "keep the loss on device across iterations; convert with "
+         "float()/.numpy() only under the logging condition or after "
+         "the loop"),
+    Rule("TS009", "tensor-assert-under-trace", WARNING,
+         "assert on a tensor value inside traced code calls bool() on a "
+         "tracer — a graph break (and silently skipped under -O)",
+         "assert on static metadata, or validate outside the step; for "
+         "traced checks use amp.check_numerics-style tensor ops"),
+]}
+
+
+def _finding(rule_id, node, file, message, symbol="", line_offset=0):
+    r = RULES[rule_id]
+    return Finding(
+        rule_id=rule_id, severity=r.severity,
+        message=message or r.summary, file=file,
+        line=getattr(node, "lineno", 0) + line_offset,
+        col=getattr(node, "col_offset", 0),
+        end_line=(getattr(node, "end_lineno", None) or
+                  getattr(node, "lineno", 0)) + line_offset,
+        end_col=getattr(node, "end_col_offset", 0) or 0,
+        symbol=symbol, hint=r.hint)
+
+
+# --------------------------------------------------------------------------
+# module context: what the file's imports tell us about names
+# --------------------------------------------------------------------------
+
+def dotted_name(node) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_FRAMEWORK_ROOTS = ("paddle_tpu", "paddle", "jax")
+_SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "bytes"}
+
+
+class ModuleContext:
+    """Alias knowledge scraped from one file's imports + defs."""
+
+    def __init__(self, tree: ast.Module):
+        self.framework_aliases: set[str] = set()   # paddle, jax, jnp, F, ...
+        self.numpy_aliases: set[str] = set()       # np, numpy
+        self.random_aliases: set[str] = set()      # random (the module)
+        self.random_names: set[str] = set()        # from random import x
+        self.time_aliases: set[str] = set()        # time
+        self.module_aliases: set[str] = set()      # every imported module name
+        self.jit_name_aliases: dict[str, str] = {} # local name -> jit api name
+        self.traced_names: set[str] = set()        # names bound to jitted fns
+        self._scan_imports(tree)
+        self._scan_bindings(tree)
+
+    def _note_import(self, modpath: str, local: str):
+        self.module_aliases.add(local)
+        root = modpath.split(".")[0]
+        if root in _FRAMEWORK_ROOTS:
+            self.framework_aliases.add(local)
+        elif root == "numpy":
+            self.numpy_aliases.add(local)
+        elif modpath == "random":
+            self.random_aliases.add(local)
+        elif modpath == "time":
+            self.time_aliases.add(local)
+
+    def _scan_imports(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self._note_import(a.name, a.asname or
+                                      a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                root = node.module.split(".")[0]
+                for a in node.names:
+                    local = a.asname or a.name
+                    if node.module == "random":
+                        self.random_names.add(local)
+                    elif a.name in ("to_static", "not_to_static",
+                                    "ignore_module") and root in (
+                                        "paddle_tpu", "paddle"):
+                        self.jit_name_aliases[local] = a.name
+                    elif root in _FRAMEWORK_ROOTS:
+                        # from paddle_tpu import nn / from jax import numpy
+                        self.framework_aliases.add(local)
+                    elif root == "numpy":
+                        self.numpy_aliases.add(local)
+
+    def jit_api(self, node) -> str | None:
+        """'to_static'/'not_to_static'/'ignore_module' if this Name/
+        Attribute resolves to that jit api, else None."""
+        d = dotted_name(node)
+        if d is None:
+            return None
+        tail = d.split(".")[-1]
+        if tail in ("to_static", "not_to_static", "ignore_module"):
+            return tail
+        return self.jit_name_aliases.get(d)
+
+    def _decorator_jit_api(self, dec) -> str | None:
+        if isinstance(dec, ast.Call):
+            # @to_static(...) and @functools.partial(to_static, ...)
+            d = dotted_name(dec.func)
+            if d and d.split(".")[-1] == "partial" and dec.args:
+                return self.jit_api(dec.args[0])
+            return self.jit_api(dec.func)
+        return self.jit_api(dec)
+
+    def decorator_apis(self, fn_node) -> set[str]:
+        return {api for dec in fn_node.decorator_list
+                if (api := self._decorator_jit_api(dec))}
+
+    def _scan_bindings(self, tree):
+        """Names bound to jitted callables: decorated defs and
+        ``step = to_static(fn)`` assignments."""
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if "to_static" in self.decorator_apis(node):
+                    self.traced_names.add(node.name)
+            elif isinstance(node, ast.Assign):
+                v = node.value
+                if isinstance(v, ast.Call) and \
+                        self.jit_api(v.func) == "to_static":
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.traced_names.add(t.id)
+                    # step = to_static(f): f's BODY is the traced region
+                    if v.args and isinstance(v.args[0], ast.Name):
+                        self.traced_names.add(v.args[0].id)
+
+
+# --------------------------------------------------------------------------
+# traced-body checker (TS001/2/4/5/6/9) with lightweight taint tracking
+# --------------------------------------------------------------------------
+
+_SANITIZE_ATTRS = {"shape", "ndim", "dtype", "name", "place",
+                   "stop_gradient", "persistable", "is_leaf"}
+_HOST_SYNC_METHODS = {"numpy", "item", "tolist", "cpu"}
+_HOST_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_UNTAINTED_BUILTINS = {"float", "int", "bool", "complex", "len", "str",
+                       "repr", "isinstance", "issubclass", "type", "id",
+                       "hash", "getattr", "hasattr", "callable", "print",
+                       "range", "format"}
+_MUTATION_METHODS = {"append", "extend", "insert", "add", "update", "pop",
+                     "popitem", "setdefault", "remove", "discard", "clear",
+                     "write"}
+_TIME_FUNCS = {"time", "perf_counter", "monotonic", "process_time",
+               "time_ns", "perf_counter_ns", "monotonic_ns"}
+
+
+def _is_scalar_param(arg: ast.arg, default) -> bool:
+    ann = arg.annotation
+    if isinstance(ann, ast.Name) and ann.id in _SCALAR_ANNOTATIONS:
+        return True
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str) and \
+            ann.value in _SCALAR_ANNOTATIONS:
+        return True
+    if isinstance(default, ast.Constant) and \
+            isinstance(default.value, (bool, int, float, str)):
+        return True
+    return False
+
+
+def _param_info(args: ast.arguments):
+    """[(ast.arg, default-or-None)] over every parameter kind."""
+    pos = list(args.posonlyargs) + list(args.args)
+    defaults = [None] * (len(pos) - len(args.defaults)) + list(args.defaults)
+    out = list(zip(pos, defaults))
+    out += [(a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)]
+    if args.vararg:
+        out.append((args.vararg, None))
+    if args.kwarg:
+        out.append((args.kwarg, None))
+    return out
+
+
+def _store_root(node):
+    """Leftmost Name of an Attribute/Subscript store target, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class TraceBodyChecker:
+    """One traced function body: walks statements in source order,
+    propagating a tensor-taint set and emitting findings at events."""
+
+    def __init__(self, ctx: ModuleContext, file: str, qualname: str,
+                 findings: list, line_offset: int = 0,
+                 outer_tainted: set | None = None,
+                 outer_locals: set | None = None):
+        self.ctx = ctx
+        self.file = file
+        self.qualname = qualname
+        self.findings = findings
+        self.line_offset = line_offset
+        self.tainted: set[str] = set(outer_tainted or ())
+        self.locals: set[str] = set(outer_locals or ())
+
+    def emit(self, rule_id, node, message):
+        self.findings.append(_finding(
+            rule_id, node, self.file, message, symbol=self.qualname,
+            line_offset=self.line_offset))
+
+    # -- entry --------------------------------------------------------------
+    def run(self, fn_node):
+        for arg, default in _param_info(fn_node.args):
+            self.locals.add(arg.arg)
+            # self/cls are module objects, not tensors: `if self.training:`
+            # is trace-safe, while `self.attr = ...` is untracked state
+            # (handled by store_event's special case below)
+            if arg.arg not in ("self", "cls") and \
+                    not _is_scalar_param(arg, default):
+                self.tainted.add(arg.arg)
+        for stmt in fn_node.body:
+            self.stmt(stmt)
+
+    # -- taint --------------------------------------------------------------
+    def is_tainted(self, e) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Attribute):
+            if e.attr in _SANITIZE_ATTRS:
+                return False
+            return self.is_tainted(e.value)
+        if isinstance(e, ast.Call):
+            return self.call_taints(e)
+        if isinstance(e, ast.Subscript):
+            return self.is_tainted(e.value)
+        if isinstance(e, ast.BinOp):
+            return self.is_tainted(e.left) or self.is_tainted(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.is_tainted(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any(self.is_tainted(v) for v in e.values)
+        if isinstance(e, ast.Compare):
+            # identity tests never touch tensor values (`x is not None`)
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False
+            return self.is_tainted(e.left) or \
+                any(self.is_tainted(c) for c in e.comparators)
+        if isinstance(e, ast.IfExp):
+            return self.is_tainted(e.body) or self.is_tainted(e.orelse)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_tainted(v) for v in e.elts)
+        if isinstance(e, ast.Starred):
+            return self.is_tainted(e.value)
+        if isinstance(e, ast.NamedExpr):
+            return self.is_tainted(e.value)
+        return False
+
+    def _any_arg_tainted(self, call: ast.Call) -> bool:
+        return any(self.is_tainted(a) for a in call.args) or \
+            any(self.is_tainted(k.value) for k in call.keywords)
+
+    def call_taints(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in _UNTAINTED_BUILTINS:
+                return False
+            # model(x), lossfn(a, b), Tensor(buf): tensor-in, tensor-out
+            return self._any_arg_tainted(call)
+        if isinstance(f, ast.Attribute):
+            root = _store_root(f)
+            if root in self.ctx.framework_aliases:
+                return True        # paddle.randn / F.relu / jnp.where
+            if root in self.ctx.numpy_aliases:
+                return False       # host arrays (TS001 handles tainted args)
+            if f.attr in _HOST_SYNC_METHODS:
+                return False       # result already lives on host
+            if self.is_tainted(f.value):
+                return True        # x.sum(), loss.detach()
+            return self._any_arg_tainted(call)
+        return self._any_arg_tainted(call)
+
+    # -- expression events --------------------------------------------------
+    def scan_expr(self, node):
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self.check_call(sub)
+            elif isinstance(sub, ast.IfExp) and self.is_tainted(sub.test):
+                self.emit("TS002", sub,
+                          "conditional expression on a tensor value "
+                          "under trace")
+            elif isinstance(sub, ast.comprehension) and \
+                    any(self.is_tainted(i) for i in sub.ifs):
+                self.emit("TS002", sub.iter,
+                          "comprehension filter on a tensor value "
+                          "under trace")
+
+    def check_call(self, call: ast.Call):
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in _HOST_CAST_BUILTINS and call.args and \
+                    self.is_tainted(call.args[0]):
+                self.emit("TS001", call,
+                          f"{f.id}() on a tensor under trace is a host "
+                          "sync (bool/int/float of a tracer)")
+            elif f.id == "print":
+                self.emit("TS004", call,
+                          "print() under trace runs once at trace time, "
+                          "not per step")
+            elif f.id == "open":
+                self.emit("TS004", call,
+                          "file I/O under trace runs once at trace time")
+            elif f.id in self.ctx.random_names:
+                self.emit("TS005", call,
+                          f"random.{f.id}() under trace samples once at "
+                          "trace time and freezes into the program")
+            return
+        if not isinstance(f, ast.Attribute):
+            return
+        d = dotted_name(f) or ""
+        parts = d.split(".")
+        root = parts[0] if parts else ""
+        # host RNG: random.x(...) / np.random.x(...)
+        if root in self.ctx.random_aliases:
+            self.emit("TS005", call,
+                      f"{d}() under trace samples once at trace time and "
+                      "freezes into the program")
+            return
+        if root in self.ctx.numpy_aliases and len(parts) > 1 and \
+                parts[1] == "random":
+            self.emit("TS005", call,
+                      f"{d}() is host RNG; under trace it freezes to a "
+                      "trace-time constant")
+            return
+        # host clock
+        if root in self.ctx.time_aliases and f.attr in _TIME_FUNCS:
+            self.emit("TS004", call,
+                      f"{d}() reads the host clock once at trace time")
+            return
+        # host syncs: x.numpy() / np.asarray(x)
+        if f.attr in _HOST_SYNC_METHODS and self.is_tainted(f.value):
+            self.emit("TS001", call,
+                      f".{f.attr}() on a tensor under trace is a host "
+                      "sync / graph break")
+            return
+        if root in self.ctx.numpy_aliases and self._any_arg_tainted(call):
+            self.emit("TS001", call,
+                      f"{d}() pulls a traced tensor to a host array "
+                      "(host sync / graph break)")
+            return
+        # container mutation on non-local state
+        if f.attr in _MUTATION_METHODS:
+            recv_root = _store_root(f.value)
+            if recv_root and self._is_untracked_state_root(recv_root):
+                self.emit("TS006", call,
+                          f"'{recv_root}.{f.attr}(...)' mutates non-local "
+                          "Python state under trace; discovery will not "
+                          "track it")
+
+    def _is_untracked_state_root(self, root: str) -> bool:
+        """True when a write rooted at `root` is invisible to state
+        discovery: self/cls attributes (Python object state), or
+        closure/global names. Tensor-tainted roots are tracked (Tensor
+        storage writes go through the discovery tracker), and writes into
+        plain function-local containers never escape the trace."""
+        if root in ("self", "cls"):
+            return True
+        if root in self.tainted:
+            return False
+        if root in self.locals or root in self.ctx.module_aliases:
+            return False
+        return True
+
+    # -- statements ---------------------------------------------------------
+    def stmt(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.locals.add(node.name)
+            if "not_to_static" in self.ctx.decorator_apis(node):
+                return  # explicitly exempted from tracing
+            sub = TraceBodyChecker(
+                self.ctx, self.file, f"{self.qualname}.{node.name}",
+                self.findings, self.line_offset,
+                outer_tainted=self.tainted, outer_locals=self.locals)
+            # nested defs run under the same trace when called; params of
+            # inner graph fns (lax.cond/while bodies) are tensor-ish too
+            sub.run(node)
+            return
+        if isinstance(node, ast.Assign):
+            self.scan_expr(node.value)
+            taint = self.is_tainted(node.value)
+            for t in node.targets:
+                self.assign_target(t, taint, node)
+            return
+        if isinstance(node, ast.AnnAssign):
+            self.scan_expr(node.value)
+            if node.value is not None:
+                self.assign_target(node.target,
+                                   self.is_tainted(node.value), node)
+            return
+        if isinstance(node, ast.AugAssign):
+            self.scan_expr(node.value)
+            if isinstance(node.target, ast.Name):
+                if self.is_tainted(node.value):
+                    self.tainted.add(node.target.id)
+                self.locals.add(node.target.id)
+            else:
+                self.store_event(node.target, node)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            self.scan_expr(node.test)
+            if self.is_tainted(node.test):
+                kind = "while" if isinstance(node, ast.While) else "if"
+                self.emit("TS002", node.test,
+                          f"`{kind}` condition depends on a tensor value; "
+                          "under trace this is a tracer bool "
+                          "(graph break)")
+            for s in node.body:
+                self.stmt(s)
+            for s in node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.Assert):
+            self.scan_expr(node.test)
+            if self.is_tainted(node.test):
+                self.emit("TS009", node,
+                          "assert on a tensor value under trace forces "
+                          "bool() on a tracer")
+            return
+        if isinstance(node, ast.For):
+            self.scan_expr(node.iter)
+            if self.is_tainted(node.iter):
+                self.assign_target(node.target, True, node)
+            else:
+                self.assign_target(node.target, False, node)
+            for s in node.body:
+                self.stmt(s)
+            for s in node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                self.scan_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.assign_target(item.optional_vars,
+                                       self.is_tainted(item.context_expr),
+                                       node)
+            for s in node.body:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.Try):
+            for s in node.body + node.orelse + node.finalbody:
+                self.stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self.stmt(s)
+            return
+        if isinstance(node, ast.Global):
+            self.emit("TS004", node,
+                      f"`global {', '.join(node.names)}` under trace: "
+                      "rebinding runs once at trace time")
+            return
+        if isinstance(node, ast.Return):
+            self.scan_expr(node.value)
+            return
+        if isinstance(node, ast.Expr):
+            self.scan_expr(node.value)
+            return
+        if isinstance(node, (ast.Delete, ast.Raise)):
+            for child in ast.iter_child_nodes(node):
+                self.scan_expr(child)
+            return
+        # Pass/Break/Continue/Import/...: nothing traced-relevant
+
+    def assign_target(self, target, taint: bool, stmt_node):
+        if isinstance(target, ast.Name):
+            self.locals.add(target.id)
+            if taint:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.assign_target(elt, taint, stmt_node)
+            return
+        if isinstance(target, ast.Starred):
+            self.assign_target(target.value, taint, stmt_node)
+            return
+        self.store_event(target, stmt_node)
+
+    def store_event(self, target, stmt_node):
+        """Attribute/Subscript store: in-place write to object state.
+        Tensor subscript stores are fine (Tensor storage writes are seen
+        by the discovery tracker); Python attribute/container writes on
+        self/closure/global state freeze at their trace-time value."""
+        root = _store_root(target)
+        if root is not None and not self._is_untracked_state_root(root):
+            return
+        desc = dotted_name(target) or (f"{root}[...]" if root else "object")
+        self.emit("TS006", stmt_node,
+                  f"write to '{desc}' under trace is untracked state "
+                  "(only framework Tensor storage is discovered)")
+
+
+# --------------------------------------------------------------------------
+# signature check (TS003)
+# --------------------------------------------------------------------------
+
+_SHAPE_METHODS = {"reshape", "reshape_", "view", "expand", "tile",
+                  "broadcast_to", "repeat"}
+_CREATION_FUNCS = {"zeros", "ones", "full", "empty", "arange", "randn",
+                   "rand", "randint", "eye", "linspace", "normal",
+                   "uniform", "zeros_like"}
+
+
+def _shape_position_exprs(fn_node):
+    """Expressions that end up as static shapes in the traced program."""
+    out = []
+    for sub in ast.walk(fn_node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name in _SHAPE_METHODS:
+            out.extend(sub.args)
+        elif name in _CREATION_FUNCS:
+            if sub.args:
+                out.append(sub.args[0])
+            for kw in sub.keywords:
+                if kw.arg == "shape":
+                    out.append(kw.value)
+    return out
+
+
+def _names_outside_sanitizers(expr):
+    """Name nodes in expr, skipping x.shape/.ndim/... subtrees (those are
+    static under trace and retrace-safe)."""
+    found = []
+
+    def visit(n):
+        if isinstance(n, ast.Attribute) and n.attr in _SANITIZE_ATTRS:
+            return
+        if isinstance(n, ast.Name):
+            found.append(n)
+        for c in ast.iter_child_nodes(n):
+            visit(c)
+
+    visit(expr)
+    return found
+
+
+def check_signature(ctx, fn_node, file, qualname, findings, line_offset):
+    params = _param_info(fn_node.args)
+    param_names = {a.arg for a, _ in params}
+    for arg, default in params:
+        if _is_scalar_param(arg, default):
+            findings.append(_finding(
+                "TS003", arg, file,
+                f"parameter '{arg.arg}' is a Python scalar in a traced "
+                "signature; every distinct value is a new trace-cache "
+                "entry (retrace)", symbol=qualname,
+                line_offset=line_offset))
+    seen = set()
+    for expr in _shape_position_exprs(fn_node):
+        for name_node in _names_outside_sanitizers(expr):
+            key = (name_node.lineno, name_node.col_offset)
+            if name_node.id in param_names and key not in seen:
+                seen.add(key)
+                findings.append(_finding(
+                    "TS003", name_node, file,
+                    f"argument '{name_node.id}' flows into a shape; "
+                    "distinct values recompile the program",
+                    symbol=qualname, line_offset=line_offset))
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Name) and \
+                    sub.func.id == "len":
+                key = (sub.lineno, sub.col_offset)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(_finding(
+                        "TS003", sub, file,
+                        "len()-derived shape: a ragged input retraces "
+                        "per length", symbol=qualname,
+                        line_offset=line_offset))
+
+
+# --------------------------------------------------------------------------
+# module-scope rules (TS007, TS008)
+# --------------------------------------------------------------------------
+
+def check_dead_annotations(ctx, tree, file, findings, line_offset):
+    # references by bare name AND by attribute (self.helper(x) counts)
+    name_loads = [n.id for n in ast.walk(tree)
+                  if isinstance(n, ast.Name) and
+                  isinstance(n.ctx, ast.Load)]
+    name_loads += [n.attr for n in ast.walk(tree)
+                   if isinstance(n, ast.Attribute)]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                ctx.jit_api(node.func) == "ignore_module":
+            findings.append(_finding(
+                "TS007", node, file,
+                "ignore_module() is a no-op in this port (trace-based "
+                "to_static has no module skip list): dead annotation",
+                line_offset=line_offset))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            apis = ctx.decorator_apis(node)
+            if "not_to_static" in apis and "to_static" in apis:
+                findings.append(_finding(
+                    "TS007", node, file,
+                    f"'{node.name}' is decorated with BOTH to_static and "
+                    "not_to_static; the annotations cancel out",
+                    symbol=node.name, line_offset=line_offset))
+            elif "not_to_static" in apis and \
+                    name_loads.count(node.name) == 0:
+                findings.append(_finding(
+                    "TS007", node, file,
+                    f"not_to_static on '{node.name}' is dead: the "
+                    "function is never referenced, so nothing traces it",
+                    symbol=node.name, line_offset=line_offset))
+
+
+def _calls_traced_fn(node, ctx) -> bool:
+    if isinstance(node, ast.Call):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        return name in ctx.traced_names
+    return False
+
+
+def check_hot_loops(ctx, tree, file, findings, line_offset,
+                    traced_fn_nodes):
+    """TS008: per-iteration host syncs on jitted outputs, outside traced
+    code. Syncs nested under an `if` are exempt (conditional logging)."""
+    inside_traced = set()
+    for fn in traced_fn_nodes:
+        inside_traced.update(id(n) for n in ast.walk(fn))
+    reported: set = set()  # one finding per sync site, not per nested loop
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)) or \
+                id(loop) in inside_traced:
+            continue
+        body_calls_traced = any(
+            _calls_traced_fn(n, ctx) for n in ast.walk(loop))
+        if not body_calls_traced:
+            continue
+
+        def all_stmts_in_order(stmts):
+            for s in stmts:
+                yield s
+                for fld in ("body", "orelse", "finalbody"):
+                    yield from all_stmts_in_order(
+                        getattr(s, fld, None) or [])
+                for h in getattr(s, "handlers", None) or []:
+                    yield from all_stmts_in_order(h.body)
+
+        def unconditional_stmts(stmts):
+            """Leaf statements that run every iteration: containers are
+            recursed into (not yielded whole, which would walk back into
+            their guarded If bodies); If/Try subtrees are skipped."""
+            for s in stmts:
+                if isinstance(s, (ast.If, ast.Try)):
+                    continue  # guarded sync = accepted logging pattern
+                if isinstance(s, (ast.For, ast.While, ast.With)):
+                    yield from unconditional_stmts(s.body)
+                else:
+                    yield s
+
+        unconditional = {id(s) for s in unconditional_stmts(loop.body)}
+
+        # Track which names hold a jitted output in SOURCE order, with
+        # reassignment kills (`loss = 1.0` drops the taint). Two passes:
+        # the second starts from the first pass's end state, modeling the
+        # wrap-around of one loop iteration into the next (a sync at the
+        # top of the body reads the PREVIOUS iteration's jit output).
+        jitted_names: set = set()
+        for check in (False, True):
+            for s in all_stmts_in_order(loop.body):
+                if check and id(s) in unconditional:
+                    for call in (n for n in ast.walk(s)
+                                 if isinstance(n, ast.Call)):
+                        site = (call.lineno, call.col_offset)
+                        if site not in reported and \
+                                _is_host_sync_of_jit_output(
+                                    call, ctx, jitted_names):
+                            reported.add(site)
+                            findings.append(_finding(
+                                "TS008", call, file,
+                                "host sync on a jitted step's output "
+                                "every iteration of the training loop",
+                                line_offset=line_offset))
+                if isinstance(s, ast.Assign):
+                    is_jit = _jit_output_expr(s.value, ctx, jitted_names)
+                    for t in s.targets:
+                        if isinstance(t, ast.Name):
+                            if is_jit:
+                                jitted_names.add(t.id)
+                            else:
+                                jitted_names.discard(t.id)
+
+
+def _jit_output_expr(expr, ctx, jitted_names) -> bool:
+    """expr is (or contains only wrappers around) a traced-fn call or a
+    name already known to hold a jitted output."""
+    if _calls_traced_fn(expr, ctx):
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id in jitted_names
+    if isinstance(expr, ast.IfExp):
+        return _jit_output_expr(expr.body, ctx, jitted_names) or \
+            _jit_output_expr(expr.orelse, ctx, jitted_names)
+    return False
+
+
+def _is_host_sync_of_jit_output(call, ctx, jitted_names) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in _HOST_CAST_BUILTINS and \
+            call.args:
+        return _jit_output_expr(call.args[0], ctx, jitted_names)
+    if isinstance(f, ast.Attribute) and f.attr in _HOST_SYNC_METHODS:
+        return _jit_output_expr(f.value, ctx, jitted_names)
+    return False
+
+
+# --------------------------------------------------------------------------
+# orchestration over one parsed module
+# --------------------------------------------------------------------------
+
+def _traced_function_nodes(ctx, tree, force_traced):
+    """(qualname, FunctionDef) for every traced region in the module.
+
+    ``force_traced`` may be a qualname, ``"first"``, or an int line
+    number matching a def's first decorator/def line (the decoration-time
+    path, where the decorator being applied may not be in the source)."""
+    out = []
+    first_fn = [None]
+
+    def forced(node, qn):
+        if isinstance(force_traced, int):
+            return min([node.lineno] +
+                       [d.lineno for d in node.decorator_list]) == \
+                force_traced
+        return force_traced is not None and qn == force_traced
+
+    def walk(nodes, prefix):
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{node.name}"
+                if first_fn[0] is None:
+                    first_fn[0] = (qn, node)
+                if "to_static" in ctx.decorator_apis(node) or \
+                        node.name in ctx.traced_names or \
+                        forced(node, qn):
+                    out.append((qn, node))
+                else:
+                    walk(node.body, qn + ".")
+            elif isinstance(node, (ast.ClassDef,)):
+                walk(node.body, f"{prefix}{node.name}.")
+            elif hasattr(node, "body") and isinstance(
+                    getattr(node, "body"), list):
+                walk(node.body, prefix)
+                for extra in ("orelse", "finalbody"):
+                    walk(getattr(node, extra, []) or [], prefix)
+
+    walk(tree.body, "")
+    if force_traced == "first" and first_fn[0] is not None and \
+            first_fn[0] not in out:
+        out.append(first_fn[0])
+    return out
+
+
+def check_module(tree: ast.Module, file: str, force_traced=None,
+                 line_offset: int = 0) -> list:
+    """Run every rule over one parsed module; returns [Finding].
+
+    ``force_traced`` marks extra traced regions: a qualname, the
+    sentinel ``"first"`` (treat the first function as traced), or an int
+    line number (the function starting at that decorator/def line — the
+    decoration-time path, where the decorator is being applied right
+    now and may not be visible in the extracted source).
+    """
+    ctx = ModuleContext(tree)
+    findings: list = []
+    traced = _traced_function_nodes(ctx, tree, force_traced)
+    for qualname, fn_node in traced:
+        checker = TraceBodyChecker(ctx, file, qualname, findings,
+                                   line_offset)
+        checker.run(fn_node)
+        check_signature(ctx, fn_node, file, qualname, findings,
+                        line_offset)
+    check_dead_annotations(ctx, tree, file, findings, line_offset)
+    check_hot_loops(ctx, tree, file, findings, line_offset,
+                    [fn for _, fn in traced])
+    findings.sort(key=lambda f: f.sort_key())
+    return findings

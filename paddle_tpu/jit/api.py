@@ -523,19 +523,47 @@ class StaticFunction:
         return inspect.getsource(self._fn)
 
 
+def _maybe_lint(fn, lint):
+    """Decoration-time trace-safety lint (paddle_tpu.analysis): opt in per
+    call site with ``lint=True`` or process-wide with
+    ``PADDLE_TPU_JIT_LINT=1``. Findings surface as TraceSafetyWarning
+    BEFORE the first trace; lint failures never block compilation."""
+    import os
+    if lint is None:
+        lint = os.environ.get("PADDLE_TPU_JIT_LINT", "") == "1"
+    if not lint:
+        return
+    try:
+        from ..analysis import analyze_function, format_text
+        from ..analysis.diagnostics import TraceSafetyWarning
+        findings = analyze_function(fn)
+    except Exception:
+        return
+    import warnings
+    for f in findings:
+        warnings.warn(f"to_static lint: {format_text(f)}",
+                      TraceSafetyWarning, stacklevel=4)
+
+
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
+              backend=None, lint=None, **kwargs):
     """Decorator/wrapper compiling a dygraph callable (reference:
-    python/paddle/jit/api.py:242)."""
+    python/paddle/jit/api.py:242).
+
+    ``lint``: run the trace-safety analyzer (paddle_tpu.analysis) on the
+    function's source at decoration time and warn on findings; defaults
+    to the PADDLE_TPU_JIT_LINT=1 env switch."""
     from ..nn.layer import Layer
 
     def decorate(fn):
         if isinstance(fn, Layer):
             layer = fn
+            _maybe_lint(layer.forward, lint)
             sf = StaticFunction(layer.forward, input_spec, build_strategy,
                                 backend, **kwargs)
             layer.forward = sf
             return layer
+        _maybe_lint(fn, lint)
         return StaticFunction(fn, input_spec, build_strategy, backend,
                               **kwargs)
 

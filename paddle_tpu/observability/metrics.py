@@ -90,6 +90,12 @@ class MetricBase:
         with self._lock:
             return sorted(self._values.items())
 
+    def series(self) -> list:
+        """``[(labels_dict, value)]`` per labeled series — the structured
+        form of ``snapshot()["values"]``, for consumers that would
+        otherwise reverse-parse the formatted label strings."""
+        return [(dict(k), v) for k, v in self._items()]
+
     def snapshot(self) -> dict:
         return {"type": self.kind, "help": self.help,
                 "values": {_format_labels(k): v for k, v in self._items()}}

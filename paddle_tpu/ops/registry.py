@@ -23,7 +23,7 @@ from dataclasses import asdict, dataclass
 @dataclass(frozen=True)
 class ApiRecord:
     name: str          # dotted public path, e.g. "paddle.matmul"
-    kind: str          # "op" | "layer" | "functional"
+    kind: str          # "op" | "layer" | "functional" | "jit" | "analysis"
     signature: str
 
     def key(self):
@@ -50,6 +50,8 @@ def _collect(module, prefix, kind, records, predicate):
 @functools.lru_cache(maxsize=1)
 def _surface_cached() -> tuple:
     import paddle_tpu as paddle
+    import paddle_tpu.analysis as analysis
+    import paddle_tpu.jit as jit
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
 
@@ -61,6 +63,13 @@ def _surface_cached() -> tuple:
              lambda o: inspect.isfunction(o))
     _collect(nn, "paddle.nn", "layer", records,
              lambda o: inspect.isclass(o))
+    # compilation + static-analysis surfaces: to_static's kwargs (lint,
+    # donate_state, ...) and the trace-safety analyzer are API contracts
+    # the same as ops are
+    _collect(jit, "paddle.jit", "jit", records,
+             lambda o: inspect.isfunction(o))
+    _collect(analysis, "paddle.analysis", "analysis", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
     return tuple(sorted(records, key=lambda r: r.name))
 
 

@@ -1,0 +1,351 @@
+"""Trace-safety linter (paddle_tpu.analysis): one positive + one negative
+fixture per rule id, the decoration-time lint path, and the CLI contract
+(exit codes, JSON spans)."""
+
+import json
+import warnings
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import (
+    ERROR, RULES, TraceSafetyWarning, analyze_function, analyze_paths,
+    analyze_source, has_errors,
+)
+from paddle_tpu.analysis.__main__ import main as cli_main
+
+HEADER = (
+    "import random\n"
+    "import time\n"
+    "import numpy as np\n"
+    "import paddle_tpu as paddle\n"
+)
+
+
+def ids_of(src, **kw):
+    return {f.rule_id for f in analyze_source(HEADER + src, **kw)}
+
+
+def traced(body, params="x"):
+    lines = "\n".join("    " + ln for ln in body.splitlines())
+    return f"@paddle.jit.to_static\ndef step({params}):\n{lines}\n"
+
+
+# -- per-rule fixtures ------------------------------------------------------
+
+def test_ts000_parse_error():
+    assert {"TS000"} == {f.rule_id
+                         for f in analyze_source("def broken(:\n")}
+    assert "TS000" not in ids_of(traced("return x"))
+
+
+@pytest.mark.parametrize("sync", [
+    "v = float(x.mean())",
+    "v = int(x.sum())",
+    "v = x.numpy()",
+    "v = x.mean().item()",
+    "v = np.asarray(x)",
+])
+def test_ts001_host_sync_positive(sync):
+    assert "TS001" in ids_of(traced(f"{sync}\nreturn x"))
+
+
+def test_ts001_negative():
+    src = traced("v = x.mean()\nn = x.shape[0]\nreturn v * n")
+    assert "TS001" not in ids_of(src)
+    # host sync OUTSIDE traced code is not TS001
+    assert "TS001" not in ids_of("def host(x):\n    return float(x)\n")
+
+
+def test_ts002_data_dependent_control_flow():
+    assert "TS002" in ids_of(traced("if x.mean() > 0:\n    x = x * 2\n"
+                                    "return x"))
+    assert "TS002" in ids_of(traced("while (x > 0).all():\n    x = x - 1\n"
+                                    "return x"))
+    # static-metadata branches are trace-safe
+    clean = traced("if x.shape[0] > 1:\n    x = x * 2\nreturn x")
+    assert "TS002" not in ids_of(clean)
+    # identity tests never touch tensor values
+    assert "TS002" not in ids_of(
+        traced("y = x if x is not None else None\nreturn y"))
+
+
+def test_ts003_retrace_prone_signature():
+    assert "TS003" in ids_of(traced("return x.reshape([n, -1])",
+                                    params="x, n"))
+    assert "TS003" in ids_of(traced("return x * scale",
+                                    params="x, scale: float"))
+    assert "TS003" in ids_of(
+        traced("return paddle.zeros([len(idx)])", params="x, idx"))
+    clean = traced("return x.reshape([x.shape[0], -1])")
+    assert "TS003" not in ids_of(clean)
+
+
+def test_ts004_impure_side_effect():
+    assert "TS004" in ids_of(traced("print(x)\nreturn x"))
+    assert "TS004" in ids_of(traced("t = time.time()\nreturn x"))
+    assert "TS004" in ids_of(
+        traced("global counter\ncounter = 1\nreturn x"))
+    assert "TS004" not in ids_of(traced("return x * 2"))
+    # print outside traced code is fine
+    assert "TS004" not in ids_of("def log(x):\n    print(x)\n")
+
+
+def test_ts005_non_jax_randomness():
+    assert "TS005" in ids_of(traced("r = np.random.rand(4)\nreturn x + r"))
+    assert "TS005" in ids_of(traced("r = random.random()\nreturn x * r"))
+    # framework RNG threads traced state — clean
+    assert "TS005" not in ids_of(traced("return x + paddle.randn([4])"))
+
+
+def test_ts006_untracked_state_write():
+    assert "TS006" in ids_of(
+        "cache = []\n" + traced("cache.append(x)\nreturn x"))
+    assert "TS006" in ids_of(traced("self.calls = 1\nreturn x",
+                                    params="self, x"))
+    # function-local containers and tensor-storage writes are tracked/ok
+    assert "TS006" not in ids_of(
+        traced("ys = []\nys.append(x)\nreturn ys"))
+
+
+def test_ts007_dead_annotation():
+    dead = ("@paddle.jit.not_to_static\n"
+            "def helper(x):\n    return x\n")
+    assert "TS007" in ids_of(dead)
+    assert "TS007" in ids_of("paddle.jit.ignore_module([np])\n")
+    used = dead + "\ndef caller(x):\n    return helper(x)\n"
+    assert "TS007" not in ids_of(used)
+    # attribute references count too: self.helper(x) is not "never used"
+    method = ("class M:\n"
+              "    @paddle.jit.not_to_static\n"
+              "    def helper(self, x):\n        return x\n"
+              "    @paddle.jit.to_static\n"
+              "    def forward(self, x):\n"
+              "        return self.helper(x)\n")
+    assert "TS007" not in ids_of(method)
+
+
+def test_ts008_host_sync_in_hot_loop():
+    loop = (traced("return x") +
+            "def train(data):\n"
+            "    for b in data:\n"
+            "        loss = float(step(b))\n"
+            "    return loss\n")
+    assert "TS008" in ids_of(loop)
+    # sync guarded by a logging condition, or after the loop, is fine
+    clean = (traced("return x") +
+             "def train(data):\n"
+             "    for i, b in enumerate(data):\n"
+             "        loss = step(b)\n"
+             "        if i % 10 == 0:\n"
+             "            print(float(loss))\n"
+             "    return float(loss)\n")
+    assert "TS008" not in ids_of(clean)
+    # the if-guard exemption survives a wrapping `with` block
+    guarded = (traced("return x") +
+               "def train(data, fh):\n"
+               "    for i, b in enumerate(data):\n"
+               "        loss = step(b)\n"
+               "        with fh:\n"
+               "            if i % 10 == 0:\n"
+               "                fh.write(str(float(loss)))\n")
+    assert "TS008" not in ids_of(guarded)
+
+
+def test_ts008_one_finding_per_sync_site():
+    nested = (traced("return x") +
+              "def train(data):\n"
+              "    for epoch in range(2):\n"
+              "        for b in data:\n"
+              "            loss = step(b)\n"
+              "            v = float(loss)\n"
+              "    return v\n")
+    findings = [f for f in analyze_source(HEADER + nested)
+                if f.rule_id == "TS008"]
+    assert len(findings) == 1
+
+
+def test_ts008_reassignment_kills_jit_taint():
+    # a name rebound to a plain Python value is no longer a jit output
+    killed = (traced("return x") +
+              "def train(data):\n"
+              "    for b in data:\n"
+              "        loss = step(b)\n"
+              "        loss = 1.0\n"
+              "        v = float(loss)\n"
+              "    return v\n")
+    assert "TS008" not in ids_of(killed)
+    # ...but a sync at the TOP of the body still sees the previous
+    # iteration's jit output (wrap-around)
+    wrap = (traced("return x") +
+            "def train(data, loss):\n"
+            "    for b in data:\n"
+            "        v = float(loss)\n"
+            "        loss = step(b)\n"
+            "    return v\n")
+    assert "TS008" in ids_of(wrap)
+
+
+def test_ts009_tensor_assert():
+    assert "TS009" in ids_of(traced("assert x.mean() > 0\nreturn x"))
+    assert "TS009" not in ids_of(
+        traced("assert x.shape[0] == 2\nreturn x"))
+
+
+def test_rule_registry_contract():
+    # >= 8 distinct checkable rules with stable ids + required metadata
+    checkable = [r for r in RULES.values() if r.id != "TS000"]
+    assert len(checkable) >= 8
+    for r in RULES.values():
+        assert r.id.startswith("TS") and r.severity in (
+            "error", "warning", "info") and r.hint
+
+
+# -- decoration-time lint ---------------------------------------------------
+
+def _dirty_fn(x):
+    v = float(x.mean())
+    return v
+
+
+def _clean_fn(x):
+    return (x * 2).mean()
+
+
+def test_to_static_lint_warns_on_host_sync():
+    with pytest.warns(TraceSafetyWarning, match="TS001"):
+        paddle.jit.to_static(_dirty_fn, lint=True)
+
+
+def test_to_static_lint_silent_on_clean_fn():
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", TraceSafetyWarning)
+        sf = paddle.jit.to_static(_clean_fn, lint=True)
+    assert float(sf(paddle.to_tensor([1.0, 2.0]))) == pytest.approx(3.0)
+
+
+def test_to_static_lint_env_switch(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_JIT_LINT", "1")
+    with pytest.warns(TraceSafetyWarning):
+        paddle.jit.to_static(_dirty_fn)
+    monkeypatch.setenv("PADDLE_TPU_JIT_LINT", "0")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", TraceSafetyWarning)
+        paddle.jit.to_static(_dirty_fn)
+
+
+def test_lint_off_by_default_and_never_blocks():
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", TraceSafetyWarning)
+        sf = paddle.jit.to_static(_clean_fn)
+    assert float(sf(paddle.to_tensor([2.0]))) == pytest.approx(4.0)
+    # unsourceable callables lint to [] instead of raising
+    assert analyze_function(len) == []
+
+
+def test_analyze_function_reports_real_file_lines():
+    findings = analyze_function(_dirty_fn)
+    assert [f.rule_id for f in findings] == ["TS001"]
+    assert findings[0].file.endswith("test_analysis.py")
+    import inspect
+    src_line = inspect.getsourcelines(_dirty_fn)[1]
+    assert findings[0].line == src_line + 1
+
+
+def test_analyze_function_sees_module_imports():
+    # decoration-time lint resolves MODULE-level aliases (np.random is
+    # TS005) — the whole-file path, not just the function snippet
+    import tempfile, textwrap, importlib.util
+    src = textwrap.dedent("""
+        import numpy as np
+        import time
+
+        def step(x):
+            r = np.random.rand(4)
+            t = time.time()
+            return x + r + t
+    """)
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(src)
+    spec = importlib.util.spec_from_file_location("_lint_mod", f.name)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    ids = {fi.rule_id for fi in analyze_function(mod.step)}
+    assert "TS005" in ids and "TS004" in ids
+
+
+def test_analyze_file_unreadable_path_is_a_finding():
+    findings = analyze_paths(["/nonexistent/not_here.py"])
+    assert [f.rule_id for f in findings] == ["TS000"]
+    assert "cannot read" in findings[0].message
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_cli_exits_nonzero_on_error_findings(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py",
+                 HEADER + traced("v = float(x.mean())\nreturn v"))
+    assert cli_main([bad]) == 1
+    out = capsys.readouterr().out
+    assert "TS001" in out and "bad.py" in out
+
+
+def test_cli_exits_zero_on_clean_tree(tmp_path, capsys):
+    _write(tmp_path, "ok.py", HEADER + traced("return x * 2"))
+    assert cli_main([str(tmp_path)]) == 0
+
+
+def test_cli_warnings_do_not_fail(tmp_path):
+    warn = _write(tmp_path, "warn.py",
+                  HEADER + traced("print(x)\nreturn x"))
+    assert cli_main([warn]) == 0
+    # ... unless selected severity filtering leaves errors
+    assert cli_main([warn, "--min-severity", "warning"]) == 0
+
+
+def test_cli_json_format_has_spans(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py",
+                 HEADER + traced("if x.mean() > 0:\n    x = x + 1\n"
+                                 "return x"))
+    rc = cli_main([bad, "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    f = payload["findings"][0]
+    assert f["rule"] == "TS002" and f["file"] == bad
+    assert f["line"] > 0 and f["end_line"] >= f["line"]
+    assert payload["counts"]["error"] == 1
+
+
+def test_cli_select_filters_rules(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py",
+                 HEADER + traced("print(x)\nv = float(x.mean())\n"
+                                 "return v"))
+    assert cli_main([bad, "--select", "TS004"]) == 0
+    out = capsys.readouterr().out
+    assert "TS004" in out and "TS001" not in out
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULES:
+        assert rid in out
+
+
+# -- the repo's own surfaces stay clean -------------------------------------
+
+def test_examples_tree_lints_clean():
+    import os
+    root = os.path.join(os.path.dirname(__file__), "..")
+    findings = analyze_paths([os.path.join(root, "examples"),
+                              os.path.join(root, "paddle_tpu", "models")])
+    assert not has_errors(findings), \
+        [f"{f.span()} {f.rule_id} {f.message}"
+         for f in findings if f.severity == ERROR]

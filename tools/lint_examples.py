@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""CI gate: trace-safety lint over the repo's runnable training surfaces.
+
+Runs ``python -m paddle_tpu.analysis`` over ``examples/`` and
+``paddle_tpu/models/`` (override by passing paths) and fails on any
+error-severity finding — the repo's own examples must stay trace-clean,
+so the analyzer's advice and the shipped code never diverge.
+
+Usage:
+  python tools/lint_examples.py                 # default tree
+  python tools/lint_examples.py path1 path2     # explicit paths
+  python tools/lint_examples.py --format json   # machine-readable
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = [os.path.join(ROOT, "examples"),
+                 os.path.join(ROOT, "paddle_tpu", "models")]
+
+
+_VALUE_OPTS = {"--format", "--select", "--min-severity"}
+
+
+def _has_paths(argv) -> bool:
+    """True when argv contains a positional path (option VALUES like the
+    'json' in '--format json' are not paths)."""
+    expect_value = False
+    for a in argv:
+        if expect_value:
+            expect_value = False
+        elif a in _VALUE_OPTS:
+            expect_value = True
+        elif not a.startswith("-"):
+            return True
+    return False
+
+
+def main(argv=None) -> int:
+    sys.path.insert(0, ROOT)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not _has_paths(argv):
+        argv = DEFAULT_PATHS + argv
+    from paddle_tpu.analysis.__main__ import main as analysis_main
+    rc = analysis_main(argv)
+    # stderr so --format json stdout stays machine-parseable
+    print("lint gate:", "FAILED (error-severity trace-safety findings)"
+          if rc else "OK", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

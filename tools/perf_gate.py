@@ -52,17 +52,52 @@ def load_value(path):
     return d.get("metric"), float(d.get("value", 0.0))
 
 
-def telemetry_retraces(d):
-    """Steady-state retrace count from a bench dict's telemetry block, or
-    None when the block is absent/null (older rounds, disabled metrics)."""
+def _steady_state(d):
     tel = d.get("telemetry")
     if not isinstance(tel, dict):
         return None
     ss = tel.get("steady_state")
-    if not isinstance(ss, dict):
+    return ss if isinstance(ss, dict) else None
+
+
+def telemetry_retraces(d):
+    """Steady-state retrace count from a bench dict's telemetry block, or
+    None when the block is absent/null (older rounds, disabled metrics)."""
+    ss = _steady_state(d)
+    if ss is None:
         return None
     r = ss.get("trace_cache_retraces")
     return int(r) if r is not None else None
+
+
+def retraces_by_fn(d):
+    """{__qualname__: retraces} for the steady-state window ({} when the
+    bench predates per-fn attribution)."""
+    ss = _steady_state(d)
+    by_fn = (ss or {}).get("retraces_by_fn")
+    return dict(by_fn) if isinstance(by_fn, dict) else {}
+
+
+def retrace_diagnosis(d) -> str:
+    """Human-actionable retrace failure text: names the offending
+    function(s) and the exact trace-safety-analyzer command to run
+    (paddle_tpu.analysis — the static side of this runtime counter)."""
+    by_fn = retraces_by_fn(d)
+    lines = []
+    if by_fn:
+        worst = sorted(by_fn.items(), key=lambda kv: -kv[1])
+        lines.append("  offending fn(s): " + ", ".join(
+            f"{fn} ({int(n)}x)" for fn, n in worst))
+    lines.append(
+        "  diagnose: python -m paddle_tpu.analysis examples/ "
+        "paddle_tpu/models/ bench.py"
+        + (f"   # then inspect the source of {worst[0][0]!r}"
+           if by_fn else ""))
+    lines.append(
+        "  (retrace-prone signatures are rule TS003; "
+        "see docs/static_analysis.md — or decorate with "
+        "to_static(lint=True) / PADDLE_TPU_JIT_LINT=1)")
+    return "\n".join(lines)
 
 
 def best_of_history(pattern, metric, last_n=3):
@@ -108,6 +143,7 @@ def main():
         print(f"perf gate [RETRACE] steady-state window recompiled "
               f"{retraces}x (telemetry trace_cache_retraces): the measured "
               f"number is not steady-state")
+        print(retrace_diagnosis(cd))
     if args.history:
         src, bv = best_of_history(args.history, cm)
         bm = cm if src else None
