@@ -72,6 +72,27 @@ def _attach_telemetry(result):
                         for fn, v in sorted(_STEADY_RETRACES_BY_FN.items())
                         if v},
                 },
+                # recovery counters (paddle_tpu.resilience): nonzero
+                # restores/NaN events in a bench run mean the measured
+                # window included recovery work — the perf number is then
+                # a fault-path number, and the trajectory should say so
+                "resilience": {
+                    "saves_ok": int(obs.value(
+                        "paddle_tpu_resilience_saves_total", status="ok")),
+                    "saves_error": int(obs.value(
+                        "paddle_tpu_resilience_saves_total",
+                        status="error")),
+                    "restores": int(obs.total(
+                        "paddle_tpu_resilience_restores_total")),
+                    "restore_fallbacks": int(obs.total(
+                        "paddle_tpu_resilience_restore_fallbacks_total")),
+                    "nan_events": int(obs.total(
+                        "paddle_tpu_resilience_nan_events_total")),
+                    "nan_rewinds": int(obs.total(
+                        "paddle_tpu_resilience_nan_rewinds_total")),
+                    "preemptions": int(obs.total(
+                        "paddle_tpu_resilience_preemptions_total")),
+                },
             }
             result.pop("telemetry_reason", None)
     except Exception:

@@ -1,17 +1,27 @@
 """Data-parallel training over every visible device (8 virtual CPU devices
-when run with XLA_FLAGS=--xla_force_host_platform_device_count=8).
+when run with XLA_FLAGS=--xla_force_host_platform_device_count=8), with the
+fault-tolerant runtime attached when a checkpoint directory is given.
 
-    python examples/distributed_data_parallel.py
+    python examples/distributed_data_parallel.py [--ckpt-dir ckpts]
+
+With --ckpt-dir the loop checkpoints atomically every --save-every steps
+(async, off the training thread), resumes from the newest good checkpoint,
+and drains + exits relaunchable (code 143) on SIGTERM — the preemption
+contract multi-host TPU schedulers assume.
 """
+
+import argparse
 
 import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import fleet
+from paddle_tpu.resilience import (CheckpointManager, NaNSentinel,
+                                   PreemptionHandler, faults)
 
 
-def main(steps=20):
+def main(steps=20, ckpt_dir=None, save_every=5):
     import jax
     n = jax.device_count()
     strategy = fleet.DistributedStrategy()
@@ -27,6 +37,27 @@ def main(steps=20):
     xv = rng.standard_normal((64, 32)).astype(np.float32)
     yv = xv.sum(-1, keepdims=True).astype(np.float32) * 0.1
 
+    manager = sentinel = handler = None
+    start = 0
+    if ckpt_dir:
+        manager = CheckpointManager(ckpt_dir, keep_n=2, async_save=True)
+        sentinel = NaNSentinel(check_every=save_every, max_consecutive=1,
+                               manager=manager)
+        handler = PreemptionHandler(manager).install()
+        restored = manager.restore(model=model, optimizer=opt)
+        if restored is not None:
+            start = restored
+            print(f"resumed from checkpoint at step {restored}")
+            if start >= steps:
+                print(f"nothing to do: checkpoint step {start} >= "
+                      f"--steps {steps}")
+                handler.uninstall()
+                return None
+        else:
+            # a step-0 baseline so a NaN arriving before the first periodic
+            # save still has a rewind target
+            manager.save(0, model=model, optimizer=opt, blocking=True)
+
     @paddle.jit.to_static
     def step(x, y):
         loss = ((model(x) - y) ** 2).mean()
@@ -38,9 +69,28 @@ def main(steps=20):
     # keep the loss on device in the hot loop (per-step float() is a host
     # sync the analyzer flags as TS008); convert once after the loop
     first = last = None
-    for i in range(steps):
-        last = step(paddle.to_tensor(xv), paddle.to_tensor(yv))
-        first = first if first is not None else last
+    try:
+        i = start
+        while i < steps:
+            last = step(paddle.to_tensor(xv), paddle.to_tensor(yv))
+            if faults.on_train_step(i):  # harness: corrupt this step's loss
+                last = last * float("nan")
+            first = first if first is not None else last
+            if manager is not None:
+                sentinel.observe(last)
+                if sentinel.check(i, model=model, optimizer=opt) == "rewind":
+                    # cursor = step actually restored, not latest_step()
+                    i = sentinel.restored_step or 0
+                    first = None
+                    continue
+                if (i + 1) % save_every == 0:
+                    manager.save(i + 1, model=model, optimizer=opt)
+                handler.maybe_exit(i + 1, model=model, optimizer=opt)
+            i += 1
+    finally:
+        if manager is not None:
+            manager.wait()
+            handler.uninstall()
     first, last = float(first), float(last)
     print(f"dp={n}: loss {first:.4f} -> {last:.4f}")
     assert last < first
@@ -48,4 +98,9 @@ def main(steps=20):
 
 
 if __name__ == "__main__":
-    main()
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--save-every", type=int, default=5)
+    a = p.parse_args()
+    main(steps=a.steps, ckpt_dir=a.ckpt_dir, save_every=a.save_every)

@@ -1,7 +1,15 @@
 """Minimal dygraph training loop: GPT-2 on synthetic ids (the reference's
-dygraph workflow, runnable on one chip or CPU).
+dygraph workflow, runnable on one chip or CPU), with the fault-tolerant
+runtime attached when a checkpoint directory is given.
 
     python examples/train_gpt_dygraph.py [--steps N]
+    python examples/train_gpt_dygraph.py --ckpt-dir ckpts --save-every 10
+
+With --ckpt-dir the run survives what kills plain loops: it resumes from
+the newest good checkpoint, SIGTERM drains the async save and exits
+relaunchable (code 143), and a persistent NaN loss rewinds to the last
+good state instead of ending the run. Inject failures deterministically
+via PADDLE_TPU_FAULTS (e.g. "sigterm@20" or "nan@15") to watch each path.
 """
 
 import argparse
@@ -10,9 +18,12 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.models import GPT, GPTConfig
+from paddle_tpu.resilience import (CheckpointManager, NaNSentinel,
+                                   PreemptionHandler, faults)
 
 
-def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8):
+def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
+         ckpt_dir=None, save_every=10):
     paddle.seed(0)
     model = GPT(GPTConfig(vocab_size=vocab, max_position_embeddings=seq,
                           hidden_size=hidden, num_layers=layers,
@@ -21,6 +32,27 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8):
                                  weight_decay=0.1)
     rng = np.random.default_rng(0)
     data = rng.integers(0, vocab, (4 * batch, seq + 1))
+
+    manager = sentinel = handler = None
+    start = 0
+    if ckpt_dir:
+        manager = CheckpointManager(ckpt_dir, keep_n=2, async_save=True)
+        sentinel = NaNSentinel(check_every=save_every, max_consecutive=1,
+                               manager=manager)
+        handler = PreemptionHandler(manager).install()
+        restored = manager.restore(model=model, optimizer=opt)
+        if restored is not None:
+            start = restored
+            print(f"resumed from checkpoint at step {restored}")
+            if start >= steps:
+                print(f"nothing to do: checkpoint step {start} >= "
+                      f"--steps {steps}")
+                handler.uninstall()
+                return None
+        else:
+            # a step-0 baseline so a NaN arriving before the first periodic
+            # save still has a rewind target
+            manager.save(0, model=model, optimizer=opt, blocking=True)
 
     @paddle.jit.to_static
     def step(x, y):
@@ -34,13 +66,34 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8):
     # step (float() per iteration) serializes dispatch against the chip —
     # the analyzer flags that pattern as TS008
     first = last = None
-    for i in range(steps):
-        chunk = data[(i % 4) * batch:(i % 4 + 1) * batch]
-        last = step(paddle.to_tensor(chunk[:, :-1].astype(np.int32)),
-                    paddle.to_tensor(chunk[:, 1:].astype(np.int32)))
-        first = first if first is not None else last
-        if i % 10 == 0:
-            print(f"step {i:4d}  loss {float(last):.4f}")
+    try:
+        i = start
+        while i < steps:
+            chunk = data[(i % 4) * batch:(i % 4 + 1) * batch]
+            last = step(paddle.to_tensor(chunk[:, :-1].astype(np.int32)),
+                        paddle.to_tensor(chunk[:, 1:].astype(np.int32)))
+            if faults.on_train_step(i):  # harness: corrupt this step's loss
+                last = last * float("nan")
+            first = first if first is not None else last
+            if i % 10 == 0:
+                print(f"step {i:4d}  loss {float(last):.4f}")
+            if manager is not None:
+                sentinel.observe(last)
+                if sentinel.check(i, model=model, optimizer=opt) == "rewind":
+                    # cursor follows the step actually restored (restore
+                    # may fall back past a corrupt newer checkpoint);
+                    # data is indexed by step so the replay is exact
+                    i = sentinel.restored_step or 0
+                    first = None
+                    continue
+                if (i + 1) % save_every == 0:
+                    manager.save(i + 1, model=model, optimizer=opt)
+                handler.maybe_exit(i + 1, model=model, optimizer=opt)
+            i += 1
+    finally:
+        if manager is not None:
+            manager.wait()
+            handler.uninstall()
     first, last = float(first), float(last)
     print(f"done: {first:.4f} -> {last:.4f}")
     assert last < first
@@ -50,4 +103,7 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8):
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=30)
-    main(steps=p.parse_args().steps)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--save-every", type=int, default=10)
+    a = p.parse_args()
+    main(steps=a.steps, ckpt_dir=a.ckpt_dir, save_every=a.save_every)

@@ -9,8 +9,14 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..observability import counter as _obs_counter
 
 __all__ = ["GradScaler", "AmpScaler"]
+
+_OBS_FOUND_INF = _obs_counter(
+    "paddle_tpu_amp_scaler_found_inf_total",
+    "unscale_ passes that found non-finite grads (update skipped; the "
+    "NaN sentinel treats these windows as scaler-handled)")
 
 
 class GradScaler:
@@ -34,6 +40,10 @@ class GradScaler:
         # first one's grads
         self._unscaled: set[int] = set()
         self._found_inf_per: dict[int, bool] = {}
+        # monotonic count of inf-detected unscale passes: the resilience
+        # NaN sentinel reads this to tell "scaler already skipped those
+        # steps" apart from "model state is polluted"
+        self._inf_steps_total = 0
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
@@ -59,6 +69,9 @@ class GradScaler:
         self._found_inf_per[id(optimizer)] = found
         # aggregate is sticky until update() resets it
         self._found_inf = self._found_inf or found
+        if found:
+            self._inf_steps_total += 1
+            _OBS_FOUND_INF.inc()
         self._unscaled.add(id(optimizer))
 
     def step(self, optimizer):
@@ -103,6 +116,17 @@ class GradScaler:
         scaled_loss.backward()
         self.step(optimizer)
         self.update()
+
+    @property
+    def found_inf(self) -> bool:
+        """Non-finite grads seen in the current scale/update cycle."""
+        return self._found_inf or any(self._found_inf_per.values())
+
+    @property
+    def inf_steps_total(self) -> int:
+        """Monotonic count of inf-detected unscale passes over the scaler's
+        lifetime (never reset by update())."""
+        return self._inf_steps_total
 
     def is_enable(self) -> bool:
         return self._enable

@@ -15,9 +15,21 @@ from __future__ import annotations
 
 import os
 import time
+import traceback
+import warnings
+
+from ...observability import counter as _obs_counter
 
 __all__ = ["ElasticStatus", "ElasticManager", "StoreHeartbeatAgent",
            "store_listener"]
+
+_OBS_RESTARTS = _obs_counter(
+    "paddle_tpu_resilience_elastic_restart_events_total",
+    "membership scale events that surfaced ElasticStatus.RESTART")
+_OBS_HOOK_ERRORS = _obs_counter(
+    "paddle_tpu_resilience_elastic_hook_errors_total",
+    "pre-restart hooks that raised (hook failures must not mask the "
+    "restart decision)")
 
 
 class ElasticStatus:
@@ -60,7 +72,10 @@ class ElasticManager:
 
     def register_pre_hook(self, fn):
         """Run before a restart decision is surfaced (the reference's
-        checkpoint-before-restart hook)."""
+        checkpoint-before-restart hook). `resilience.PreemptionHandler.
+        attach_elastic` registers its preemption request here, so a RESTART
+        drains the async checkpoint save and exits relaunchable through the
+        same path as SIGTERM."""
         self._pre_hooks.append(fn)
 
     def watch(self) -> str:
@@ -95,8 +110,17 @@ class ElasticManager:
         self.last_event = ("scale_out" if n > self.np else
                            ("scale_in" if n < self.np else "replace"),
                            added, removed)
+        _OBS_RESTARTS.inc()
         for hook in self._pre_hooks:
-            hook()
+            # a failing checkpoint hook must not swallow the RESTART
+            # decision — the scheduler relaunch is the recovery of last
+            # resort and always preferable to wedging the watch loop
+            try:
+                hook()
+            except Exception:
+                _OBS_HOOK_ERRORS.inc()
+                warnings.warn("elastic pre-restart hook raised:\n" +
+                              traceback.format_exc(), RuntimeWarning)
         self.hosts = list(live)
         self.np = n
         return ElasticStatus.RESTART

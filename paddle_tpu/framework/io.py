@@ -20,6 +20,22 @@ __all__ = ["save", "load"]
 _SENTINEL = "__paddle_tpu_tensor__"
 
 
+def _fsync_dir(path: str) -> None:
+    """Make an os.replace durable: fsync the directory so the rename itself
+    survives power loss (best effort — not every filesystem allows opening
+    a directory). Shared with resilience/checkpoint.py's commit protocol."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _pack(obj):
     if isinstance(obj, Tensor):
         arr = np.asarray(obj._data)
@@ -54,7 +70,14 @@ def _unpack(obj, return_numpy=False):
 
 
 def save(obj, path, protocol: int = 4, **configs):
-    """Serialize a (possibly nested) object containing Tensors."""
+    """Serialize a (possibly nested) object containing Tensors.
+
+    Path saves are ATOMIC: bytes go to a same-directory tmp file which is
+    flushed, fsynced and ``os.replace``d over the destination, so a crash
+    (or an injected fault) mid-save can never truncate an existing
+    checkpoint — readers see the old complete file or the new complete
+    file, nothing in between. File-object saves stream directly (the caller
+    owns that handle's durability)."""
     if hasattr(path, "write"):
         pickle.dump(_pack(obj), path, protocol=protocol)
         return
@@ -62,8 +85,22 @@ def save(obj, path, protocol: int = 4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    from ..resilience import faults as _faults
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            _faults.on_save_write(path)  # deterministic io_error injection
+            pickle.dump(_pack(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path) or ".")
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load(path, return_numpy: bool = False, **configs):

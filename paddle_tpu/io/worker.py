@@ -167,6 +167,12 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
             return
         batch_idx, payload = job
         try:
+            # fault-injection site (resilience harness): a worker_slow /
+            # worker_dead clause in PADDLE_TPU_FAULTS stalls or hard-kills
+            # this worker at a deterministic fetch — the regression tests
+            # for dead-worker propagation drive this
+            from ..resilience import faults as _faults
+            _faults.on_worker_fetch()
             if iterable:
                 # payload = batch size; worker draws from its own shard
                 samples = list(itertools.islice(ds_iter, payload))
@@ -182,6 +188,12 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
         except Exception:
             out_queue.put(("error", worker_id, traceback.format_exc()))
             return
+
+
+class WorkerDiedError(RuntimeError):
+    """A DataLoader worker process exited without reporting an error
+    (killed, segfaulted, or hard-exited) — raised by the consumer instead
+    of hanging the iterator."""
 
 
 class MultiprocessLoaderIter:
@@ -291,6 +303,9 @@ class MultiprocessLoaderIter:
                 self.shutdown()
                 raise RuntimeError(
                     f"DataLoader worker timed out after {self._timeout}s")
+            except WorkerDiedError:
+                self.shutdown()
+                raise
             except KeyboardInterrupt:
                 self.shutdown()
                 raise
@@ -304,15 +319,40 @@ class MultiprocessLoaderIter:
             batch_idx, data = payload
             self._reorder[batch_idx] = _decode(data)
 
+    def _dead_workers(self):
+        """(worker_id, exitcode) for workers that exited abnormally. Exit
+        code 0 is a clean return (error messages already queued; sentinel
+        shutdown) — only nonzero/signal exits mean lost work."""
+        return [(i, p.exitcode) for i, p in enumerate(self._workers)
+                if not p.is_alive() and p.exitcode not in (0, None)]
+
     def _recv(self):
-        if self._ring is None:
-            return self._out_queue.get(timeout=self._timeout)
-        # dead-worker defense: poll in short slices so a crashed producer
-        # surfaces as Empty/timeout instead of an infinite block; the
-        # slice respects sub-second user timeouts
+        # Both transports poll in short slices so a dead producer surfaces
+        # within ~1s as WorkerDiedError (or Empty at the user deadline)
+        # instead of blocking the consumer forever on a queue no one will
+        # ever fill.
         deadline = None if self._timeout is None else \
             (self._timeout + time.monotonic())
         slice_s = min(self._timeout, 1.0) if self._timeout else 1.0
+        if self._ring is None:
+            while True:
+                try:
+                    return self._out_queue.get(timeout=slice_s)
+                except queue_mod.Empty:
+                    pass
+                dead = self._dead_workers()
+                if dead:
+                    # drain once more: the worker may have queued its result
+                    # (or traceback) before dying
+                    try:
+                        return self._out_queue.get_nowait()
+                    except queue_mod.Empty:
+                        raise WorkerDiedError(
+                            "DataLoader worker(s) died unexpectedly: " +
+                            ", ".join(f"worker {i} exit code {c}"
+                                      for i, c in dead)) from None
+                if deadline is not None and time.monotonic() > deadline:
+                    raise queue_mod.Empty
         while True:
             blob = self._ring.pop(timeout=slice_s)
             if blob is not None:
@@ -332,6 +372,12 @@ class MultiprocessLoaderIter:
                 return self._out_queue.get_nowait()
             except queue_mod.Empty:
                 pass
+            dead = self._dead_workers()
+            if dead:
+                raise WorkerDiedError(
+                    "DataLoader worker(s) died unexpectedly: " +
+                    ", ".join(f"worker {i} exit code {c}"
+                              for i, c in dead))
             if any(not p.is_alive() for p in self._workers):
                 raise queue_mod.Empty
             if deadline is not None and time.monotonic() > deadline:

@@ -17,6 +17,11 @@ layers are instrumented out of the box:
 * :class:`StepTimer` — step latency, tokens/sec, analytic-FLOPs MFU, and
   host<->device transfer bytes (``paddle_tpu_step_*``), sharing bench.py's
   MFU math.
+* ``resilience`` — checkpoint saves/restores/fallbacks, NaN-sentinel
+  windows and rewinds, preemption drains, fault-harness activity
+  (``paddle_tpu_resilience_*``; scaler-skipped inf steps under
+  ``paddle_tpu_amp_scaler_found_inf_total``): recovery is a first-class
+  metric family, not log noise.
 
 Metric names follow ``paddle_tpu_<area>_<name>_<unit>``. Collection is on
 by default; ``PADDLE_TPU_METRICS=0`` (or :func:`enable`\\ ``(False)``)

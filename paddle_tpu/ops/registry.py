@@ -23,7 +23,8 @@ from dataclasses import asdict, dataclass
 @dataclass(frozen=True)
 class ApiRecord:
     name: str          # dotted public path, e.g. "paddle.matmul"
-    kind: str          # "op" | "layer" | "functional" | "jit" | "analysis"
+    kind: str          # "op" | "layer" | "functional" | "jit" |
+                       # "analysis" | "resilience"
     signature: str
 
     def key(self):
@@ -54,6 +55,8 @@ def _surface_cached() -> tuple:
     import paddle_tpu.jit as jit
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
+    import paddle_tpu.resilience as resilience
+    import paddle_tpu.resilience.faults as res_faults
 
     records: list[ApiRecord] = []
     # names are prefix-qualified per module, so no cross-module collisions
@@ -69,6 +72,13 @@ def _surface_cached() -> tuple:
     _collect(jit, "paddle.jit", "jit", records,
              lambda o: inspect.isfunction(o))
     _collect(analysis, "paddle.analysis", "analysis", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    # fault-tolerance runtime: the checkpoint manager, sentinel, preemption
+    # handler and the fault-injection surface are recovery contracts CI must
+    # hold as stable as ops
+    _collect(resilience, "paddle.resilience", "resilience", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    _collect(res_faults, "paddle.resilience.faults", "resilience", records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     return tuple(sorted(records, key=lambda r: r.name))
 

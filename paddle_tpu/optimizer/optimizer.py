@@ -183,6 +183,12 @@ class Optimizer:
     def set_state_dict(self, state_dict):
         state_dict = dict(state_dict)
         self._step_count = int(state_dict.pop("@step", 0))
+        # the device-side step counter drives Adam bias correction inside
+        # jitted steps; resyncing it from @step makes a restored run
+        # bit-identical to the uninterrupted one (it advances in lockstep
+        # with _step_count in step())
+        self._step_tensor._data = jnp.asarray(float(self._step_count),
+                                              jnp.float32)
         sched = state_dict.pop("LR_Scheduler", None)
         if sched is not None and self._lr_scheduler is not None:
             self._lr_scheduler.set_state_dict(sched)
@@ -191,9 +197,14 @@ class Optimizer:
             by_name = {p.name: p for p in self._parameter_list}
             for n, w in masters.items():
                 if n in by_name:
-                    self._master_weights[id(by_name[n])] = Tensor(
-                        w._data if isinstance(w, Tensor) else jnp.asarray(w))
+                    arr = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+                    existing = self._master_weights.get(id(by_name[n]))
+                    if existing is not None:
+                        existing._data = arr
+                    else:
+                        self._master_weights[id(by_name[n])] = Tensor(arr)
         by_name = {p.name: p for p in self._parameter_list}
+        unbound = []
         for k, v in state_dict.items():
             # longest-prefix match: with params 'w' and 'w_1', key
             # 'w_1_moment1' must bind to 'w_1' (ADVICE r1: arbitrary-order
@@ -204,11 +215,30 @@ class Optimizer:
                         (best is None or len(p_name) > len(best)):
                     best = p_name
             if best is None:
+                unbound.append(k)
                 continue
             p = by_name[best]
             acc_name = k[len(best) + 1:]
             arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
-            self._accumulators[acc_name][id(p)] = Tensor(arr)
+            existing = self._accumulators[acc_name].get(id(p))
+            if existing is not None:
+                # in place: a mid-run rewind (NaN sentinel) must not orphan
+                # accumulator handles already lifted into a jitted step
+                existing._data = arr
+            else:
+                self._accumulators[acc_name][id(p)] = Tensor(arr)
+        if unbound:
+            # silently dropping moments would resume Adam from zeroed state
+            # — numerically plausible but wrong; a resumed run must KNOW
+            # its accumulators didn't bind (auto-generated tensor names
+            # only reproduce in a fresh process with identical construction
+            # order; pass explicit parameter names for anything else)
+            import warnings
+            warnings.warn(
+                f"optimizer.set_state_dict: {len(unbound)} state entr"
+                f"{'y' if len(unbound) == 1 else 'ies'} matched no "
+                f"parameter (e.g. {unbound[0]!r}); accumulators for those "
+                f"parameters start fresh", RuntimeWarning)
 
     # -- state tensors for jit lifting -------------------------------------
     def _state_tensors(self) -> list[Tensor]:

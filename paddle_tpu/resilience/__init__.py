@@ -1,0 +1,41 @@
+"""paddle_tpu.resilience — the fault-tolerant training runtime.
+
+Long multi-host TPU runs are terminated by the scheduler, lose workers, and
+blow up numerically as a matter of course; this package makes all three
+survivable instead of merely observable (`paddle_tpu.observability`) or
+statically predictable (`paddle_tpu.analysis`):
+
+* :class:`CheckpointManager` — atomic (tmp + fsync + rename, sha256-hashed
+  manifest) persistence of {model, optimizer, GradScaler, LR scheduler,
+  RNG, step}, rolling ``keep_n`` retention, background (async) commits, and
+  ``restore()`` that detects corrupt/partial checkpoints and falls back to
+  the newest good one.
+* :class:`PreemptionHandler` — cooperative SIGTERM/SIGINT (and
+  ``ElasticStatus.RESTART``) handling: drain the in-flight save, write a
+  final checkpoint, exit with a scheduler-relaunchable code (143).
+* :class:`NaNSentinel` — loss/grad finiteness on a cadence via a batched
+  device-side reduction (no per-step host sync), skip-or-rewind after K
+  consecutive bad windows, cooperating with ``amp.GradScaler``.
+* :mod:`~paddle_tpu.resilience.faults` — deterministic fault injection
+  (``PADDLE_TPU_FAULTS`` spec or :func:`faults.inject` context manager):
+  IO errors mid-save, NaN losses, slow/dead DataLoader workers, SIGTERM at
+  step N — the harness the recovery tests and ``tools/chaos_check.py``
+  drive every path with.
+
+Every recovery event emits through the observability registry under
+``paddle_tpu_resilience_*`` — see docs/resilience.md for the full metric
+table, manifest format and fault-spec grammar.
+"""
+
+from .checkpoint import CheckpointManager, CheckpointNotFoundError  # noqa: F401
+from .preemption import PreemptionHandler, TrainingPreempted  # noqa: F401
+from .sentinel import NaNSentinel, NumericsError  # noqa: F401
+from . import faults  # noqa: F401
+from .faults import FaultInjector, FaultSpec, InjectedIOError  # noqa: F401
+
+__all__ = [
+    "CheckpointManager", "CheckpointNotFoundError",
+    "PreemptionHandler", "TrainingPreempted",
+    "NaNSentinel", "NumericsError",
+    "FaultInjector", "FaultSpec", "InjectedIOError", "faults",
+]

@@ -1,0 +1,340 @@
+"""Atomic, async, self-verifying training checkpoints.
+
+A checkpoint is two files in the manager's root directory::
+
+    ckpt-0000000012.pkl    pickled payload (tensors packed as numpy arrays,
+                           the framework/io.py serialization format)
+    ckpt-0000000012.json   manifest: {"step", "sha256", "bytes", "time",
+                           "format_version", "keys"}
+
+Commit protocol (crash-safe in any prefix):
+
+1. payload is snapshotted to HOST numpy at ``save()`` call time — an async
+   save never races the training loop mutating device state;
+2. bytes go to ``<name>.pkl.tmp-<pid>``, are flushed and ``fsync``\\ ed,
+   then ``os.replace``\\ d over the final ``.pkl`` name (atomic on POSIX);
+3. the manifest (carrying the payload's sha256) is written the same way,
+   LAST — a ``.pkl`` without its manifest is invisible to ``restore()``,
+   and a manifest whose hash mismatches its payload marks it corrupt.
+
+``restore()`` walks manifests newest-first and falls back across missing /
+truncated / hash-mismatched checkpoints until one verifies, so a crash at
+any byte of a save can never cost more than that one save. Retention
+(``keep_n``) deletes oldest-first and only after a newer checkpoint has
+fully committed.
+
+Telemetry (paddle_tpu.observability): ``paddle_tpu_resilience_saves_total``
+{status=ok|error}, ``_save_seconds``, ``_restores_total``,
+``_restore_fallbacks_total``, ``_corrupt_checkpoints_total``,
+``_last_checkpoint_step`` gauge.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import threading
+import time
+
+from ..framework.io import _fsync_dir
+from ..observability import (counter as _obs_counter, gauge as _obs_gauge,
+                             histogram as _obs_histogram)
+from . import faults as _faults
+
+__all__ = ["CheckpointManager", "CheckpointNotFoundError"]
+
+FORMAT_VERSION = 1
+
+_OBS_SAVES = _obs_counter(
+    "paddle_tpu_resilience_saves_total",
+    "checkpoint save attempts by terminal status (ok|error)")
+_OBS_SAVE_SECONDS = _obs_histogram(
+    "paddle_tpu_resilience_save_seconds",
+    "wall seconds per checkpoint commit (serialize + write + fsync)")
+_OBS_RESTORES = _obs_counter(
+    "paddle_tpu_resilience_restores_total",
+    "successful CheckpointManager.restore() calls")
+_OBS_FALLBACKS = _obs_counter(
+    "paddle_tpu_resilience_restore_fallbacks_total",
+    "restore() skips over a newer unusable checkpoint to an older good one")
+_OBS_CORRUPT = _obs_counter(
+    "paddle_tpu_resilience_corrupt_checkpoints_total",
+    "checkpoints rejected at restore time (missing payload, bad hash, "
+    "undecodable)")
+_OBS_LAST_STEP = _obs_gauge(
+    "paddle_tpu_resilience_last_checkpoint_step",
+    "step of the most recently committed checkpoint")
+
+
+class CheckpointNotFoundError(FileNotFoundError):
+    """restore(required=True) found no usable checkpoint."""
+
+
+def _atomic_write(path: str, data: bytes, fault_site: str | None = None):
+    """tmp + write + fsync + os.replace; the tmp file is removed on any
+    failure so a crashed write leaves nothing a reader could mistake for a
+    checkpoint."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            if fault_site is not None:
+                # fire mid-write: half the payload lands in the tmp file
+                # before the injected error, proving partial writes stay
+                # invisible
+                f.write(data[:len(data) // 2])
+                _faults.on_save_write(path)
+                f.write(data[len(data) // 2:])
+            else:
+                f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path) or ".")
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class CheckpointManager:
+    """Persist and recover full training state ({model, optimizer, scaler,
+    lr_scheduler, rng, step, extra}) with atomic commits, rolling retention
+    and optional background saves.
+
+    ::
+
+        mgr = CheckpointManager("ckpts", keep_n=3, async_save=True)
+        start = mgr.restore(model=model, optimizer=opt) or 0
+        for step in range(start, total):
+            ...
+            if (step + 1) % save_every == 0:
+                mgr.save(step + 1, model=model, optimizer=opt)
+        mgr.wait()
+    """
+
+    def __init__(self, root: str, keep_n: int = 3, async_save: bool = False,
+                 prefix: str = "ckpt"):
+        if keep_n < 1:
+            raise ValueError("keep_n must be >= 1")
+        if not re.fullmatch(r"[A-Za-z0-9_.-]+", prefix):
+            raise ValueError(f"prefix {prefix!r} must be filename-safe")
+        self.root = os.fspath(root)
+        self.keep_n = keep_n
+        self.async_save = async_save
+        self.prefix = prefix
+        os.makedirs(self.root, exist_ok=True)
+        self._io_lock = threading.Lock()   # serializes commits + retention
+        self._inflight: threading.Thread | None = None
+        self._last_error: BaseException | None = None
+        self._manifest_re = re.compile(
+            re.escape(prefix) + r"-(\d{10})\.json$")
+
+    # -- naming --------------------------------------------------------------
+
+    def _payload_path(self, step: int) -> str:
+        return os.path.join(self.root, f"{self.prefix}-{step:010d}.pkl")
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.root, f"{self.prefix}-{step:010d}.json")
+
+    def all_steps(self) -> list[int]:
+        """Steps with a committed manifest, ascending (manifest presence,
+        not payload validity — restore() verifies content)."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return out
+        for n in names:
+            m = self._manifest_re.fullmatch(n)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    @property
+    def last_error(self) -> BaseException | None:
+        """The exception that killed the most recent (async) save, if any."""
+        return self._last_error
+
+    # -- save ----------------------------------------------------------------
+
+    def save(self, step: int, model=None, optimizer=None, scaler=None,
+             lr_scheduler=None, extra=None, blocking: bool | None = None):
+        """Snapshot state now; commit synchronously or in the background.
+
+        Any component may be omitted. RNG state (global generator + named
+        tracker streams) is always captured. Returns the background thread
+        when committing asynchronously, else None.
+        """
+        payload = self._snapshot(step, model, optimizer, scaler,
+                                 lr_scheduler, extra)
+        sync = not self.async_save if blocking is None else blocking
+        self.wait()  # at most one save in flight; also bounds memory
+        if sync:
+            self._commit(step, payload)
+            return None
+        th = threading.Thread(target=self._commit_guarded,
+                              args=(step, payload), daemon=True,
+                              name=f"ckpt-save-{step}")
+        self._inflight = th
+        th.start()
+        return th
+
+    def _snapshot(self, step, model, optimizer, scaler, lr_scheduler, extra):
+        """Pack every component to host-side plain objects at call time."""
+        from ..core.generator import get_rng_state, get_rng_state_tracker
+        from ..framework.io import _pack
+        payload: dict = {"step": int(step),
+                         "rng": get_rng_state(),
+                         "rng_tracker":
+                             get_rng_state_tracker().get_states_tracker()}
+        if model is not None:
+            sd = model.state_dict() if hasattr(model, "state_dict") else model
+            payload["model"] = _pack(sd)
+        if optimizer is not None:
+            sd = optimizer.state_dict() \
+                if hasattr(optimizer, "state_dict") else optimizer
+            payload["optimizer"] = _pack(sd)
+        if scaler is not None:
+            payload["scaler"] = scaler.state_dict()
+        if lr_scheduler is not None:
+            payload["lr_scheduler"] = lr_scheduler.state_dict()
+        if extra is not None:
+            payload["extra"] = _pack(extra)
+        return payload
+
+    def _commit_guarded(self, step, payload):
+        try:
+            self._commit(step, payload)
+        except BaseException as e:  # background thread: record, don't kill
+            self._last_error = e
+
+    def _commit(self, step, payload):
+        t0 = time.perf_counter()
+        try:
+            blob = pickle.dumps(payload, protocol=4)
+            digest = hashlib.sha256(blob).hexdigest()
+            manifest = {"step": int(step), "sha256": digest,
+                        "bytes": len(blob), "time": time.time(),
+                        "format_version": FORMAT_VERSION,
+                        "keys": sorted(k for k in payload
+                                       if k not in ("step",))}
+            with self._io_lock:
+                _atomic_write(self._payload_path(step), blob,
+                              fault_site="ckpt.write")
+                _atomic_write(self._manifest_path(step),
+                              json.dumps(manifest).encode())
+                self._retain_locked()
+        except BaseException:
+            _OBS_SAVES.inc(status="error")
+            raise
+        self._last_error = None
+        _OBS_SAVES.inc(status="ok")
+        _OBS_SAVE_SECONDS.observe(time.perf_counter() - t0)
+        _OBS_LAST_STEP.set(step)
+
+    def _retain_locked(self):
+        for step in self.all_steps()[:-self.keep_n]:
+            for p in (self._manifest_path(step), self._payload_path(step)):
+                # manifest first: a crash between the two unlinks leaves an
+                # orphan payload (ignored), never a manifest without payload
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def wait(self, timeout: float | None = None) -> None:
+        """Drain the in-flight async save, if any."""
+        th = self._inflight
+        if th is not None:
+            th.join(timeout)
+            if not th.is_alive():
+                self._inflight = None
+
+    # -- restore -------------------------------------------------------------
+
+    def _verify(self, step: int) -> dict | None:
+        """Manifest + payload of `step` if internally consistent."""
+        try:
+            with open(self._manifest_path(step)) as f:
+                manifest = json.load(f)
+            with open(self._payload_path(step), "rb") as f:
+                blob = f.read()
+        except (OSError, ValueError):
+            return None
+        if manifest.get("format_version") != FORMAT_VERSION:
+            return None
+        if len(blob) != manifest.get("bytes") or \
+                hashlib.sha256(blob).hexdigest() != manifest.get("sha256"):
+            return None
+        try:
+            return pickle.loads(blob)
+        except Exception:
+            return None
+
+    def restore(self, model=None, optimizer=None, scaler=None,
+                lr_scheduler=None, step: int | None = None,
+                required: bool = False):
+        """Load the newest good checkpoint (or exactly `step`) into the
+        given components, in place. Returns the restored step, or None when
+        no usable checkpoint exists (raises CheckpointNotFoundError when
+        ``required``). Corrupt or partial checkpoints are counted, skipped,
+        and never applied."""
+        self.wait()  # an async save may still be committing
+        candidates = [step] if step is not None \
+            else list(reversed(self.all_steps()))
+        fallbacks = 0
+        for st in candidates:
+            payload = self._verify(st)
+            if payload is None:
+                _OBS_CORRUPT.inc()
+                fallbacks += 1
+                continue
+            self._apply(payload, model, optimizer, scaler, lr_scheduler)
+            _OBS_RESTORES.inc()
+            if fallbacks:
+                _OBS_FALLBACKS.inc(fallbacks)
+            return payload["step"]
+        if required:
+            raise CheckpointNotFoundError(
+                f"no usable checkpoint under {self.root!r} "
+                f"(examined {len(candidates)})")
+        return None
+
+    def _apply(self, payload, model, optimizer, scaler, lr_scheduler):
+        from ..core.generator import (set_rng_state, get_rng_state_tracker)
+        from ..framework.io import _unpack
+        if model is not None and "model" in payload:
+            model.set_state_dict(_unpack(payload["model"]))
+        if optimizer is not None and "optimizer" in payload:
+            optimizer.set_state_dict(_unpack(payload["optimizer"]))
+        if scaler is not None and "scaler" in payload:
+            scaler.load_state_dict(payload["scaler"])
+        if lr_scheduler is not None and "lr_scheduler" in payload:
+            lr_scheduler.set_state_dict(dict(payload["lr_scheduler"]))
+        if "rng" in payload:
+            set_rng_state(payload["rng"])
+        if payload.get("rng_tracker"):
+            get_rng_state_tracker().set_states_tracker(
+                payload["rng_tracker"])
+
+    def load_extra(self, step: int | None = None):
+        """The "extra" payload of the newest good checkpoint (or `step`),
+        unpacked; None when absent."""
+        from ..framework.io import _unpack
+        candidates = [step] if step is not None \
+            else list(reversed(self.all_steps()))
+        for st in candidates:
+            payload = self._verify(st)
+            if payload is not None:
+                return _unpack(payload.get("extra"))
+        return None

@@ -1,0 +1,255 @@
+"""Deterministic fault injection for the fault-tolerant runtime.
+
+Every recovery path in `paddle_tpu.resilience` is provable end-to-end only
+if the failure itself is reproducible, so injection is deterministic by
+construction: a fault fires at the Nth occurrence of a named site (or at an
+exact training step), never by random sampling.
+
+Spec grammar (``PADDLE_TPU_FAULTS`` environment variable or
+:func:`install` / :func:`inject`)::
+
+    spec     := clause ("," clause)*
+    clause   := kind "@" n [":" param]
+    kind     := "save_io" | "nan" | "sigterm" | "worker_slow" | "worker_dead"
+    n        := integer — step number for step-indexed kinds (nan, sigterm),
+                1-based occurrence count for event-indexed kinds
+                (save_io, worker_slow, worker_dead)
+    param    := float — kind-specific (worker_slow: seconds to stall)
+
+Examples::
+
+    PADDLE_TPU_FAULTS="save_io@2"          # 2nd checkpoint write raises IOError
+    PADDLE_TPU_FAULTS="nan@5"              # loss becomes NaN at step 5
+    PADDLE_TPU_FAULTS="sigterm@7"          # SIGTERM delivered entering step 7
+    PADDLE_TPU_FAULTS="worker_slow@3:2.5"  # 3rd worker fetch stalls 2.5 s
+    PADDLE_TPU_FAULTS="worker_dead@3"      # 3rd worker fetch hard-exits
+    PADDLE_TPU_FAULTS="nan@5,nan@6,sigterm@9"   # clauses compose
+
+Step-indexed clauses are one-shot: after firing at step N they are consumed,
+so a recovery path that rewinds and replays step N does not re-fault forever.
+Event-indexed clauses count occurrences monotonically and fire exactly at
+the Nth.
+
+Hook sites are no-ops when no injector is active (one module-level load +
+``None`` test), so framework code keeps them unconditionally. DataLoader
+worker processes inherit the spec through the environment (fork and spawn
+both), which is how the slow/dead-worker clauses reach the child.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from ..observability import counter as _obs_counter
+
+__all__ = ["FaultSpec", "FaultInjector", "install", "uninstall", "inject",
+           "get_active", "on_save_write", "on_train_step", "on_worker_fetch",
+           "InjectedIOError"]
+
+KINDS = ("save_io", "nan", "sigterm", "worker_slow", "worker_dead")
+_STEP_INDEXED = ("nan", "sigterm")
+
+_OBS_INJECTED = _obs_counter(
+    "paddle_tpu_resilience_faults_injected_total",
+    "faults fired by the injection harness, by kind")
+
+
+class InjectedIOError(IOError):
+    """IOError raised by a ``save_io`` clause (distinguishable from real
+    filesystem failures in logs and tests)."""
+
+
+class FaultSpec:
+    __slots__ = ("kind", "at", "param")
+
+    def __init__(self, kind: str, at: int, param: float | None = None):
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r}; expected one of {KINDS}")
+        self.kind = kind
+        self.at = int(at)
+        self.param = param
+
+    def __repr__(self):
+        p = f":{self.param}" if self.param is not None else ""
+        return f"{self.kind}@{self.at}{p}"
+
+
+def _parse(spec: str) -> list[FaultSpec]:
+    out = []
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "@" not in clause:
+            raise ValueError(
+                f"bad fault clause {clause!r}: expected kind@n[:param]")
+        kind, _, rest = clause.partition("@")
+        n, _, param = rest.partition(":")
+        out.append(FaultSpec(kind.strip(), int(n),
+                             float(param) if param else None))
+    return out
+
+
+class FaultInjector:
+    """Holds parsed clauses plus per-kind occurrence counters.
+
+    Occurrence counters are process-local: the parent counts checkpoint
+    writes, each worker process counts its own fetches. Thread-safe — the
+    async checkpoint thread and the training thread may both hit sites.
+    """
+
+    def __init__(self, clauses: list[FaultSpec]):
+        self.clauses = list(clauses)
+        self._occurrences: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultInjector":
+        return cls(_parse(spec))
+
+    def _next_occurrence(self, kind: str) -> int:
+        with self._lock:
+            n = self._occurrences.get(kind, 0) + 1
+            self._occurrences[kind] = n
+            return n
+
+    def _match_event(self, kind: str) -> FaultSpec | None:
+        """Event-indexed match: does the Nth occurrence of `kind` fire?"""
+        n = self._next_occurrence(kind)
+        for c in self.clauses:
+            if c.kind == kind and c.at == n:
+                return c
+        return None
+
+    def _match_step(self, kind: str, step: int) -> FaultSpec | None:
+        """Step-indexed match, one-shot: a recovery path that rewinds and
+        REPLAYS the faulted step must not re-trigger the same fault."""
+        with self._lock:
+            for c in self.clauses:
+                if c.kind == kind and c.at == step:
+                    self.clauses.remove(c)
+                    return c
+        return None
+
+    # -- site implementations ------------------------------------------------
+
+    def save_write(self, path: str = "") -> None:
+        c = self._match_event("save_io")
+        if c is not None:
+            _OBS_INJECTED.inc(kind="save_io")
+            raise InjectedIOError(
+                f"injected IO error during save ({path or 'checkpoint'})")
+
+    def train_step(self, step: int) -> bool:
+        """Returns True when the loop must corrupt this step's loss with NaN;
+        delivers SIGTERM to this process when a sigterm clause matches."""
+        c = self._match_step("sigterm", step)
+        if c is not None:
+            _OBS_INJECTED.inc(kind="sigterm")
+            signal.raise_signal(signal.SIGTERM)
+        c = self._match_step("nan", step)
+        if c is not None:
+            _OBS_INJECTED.inc(kind="nan")
+            return True
+        return False
+
+    def worker_fetch(self) -> None:
+        """Inside a DataLoader worker: stall or hard-exit on a matching
+        clause (hard exit bypasses Python teardown — the parent must detect
+        the dead process, not an exception message)."""
+        c = self._match_event("worker_slow")
+        if c is not None:
+            _OBS_INJECTED.inc(kind="worker_slow")
+            time.sleep(c.param if c.param is not None else 5.0)
+        c = self._match_event("worker_dead")
+        if c is not None:
+            _OBS_INJECTED.inc(kind="worker_dead")
+            os._exit(3)
+
+
+_active: FaultInjector | None = None
+_env_checked = False
+
+
+def get_active() -> FaultInjector | None:
+    """The installed injector, lazily bootstrapped from PADDLE_TPU_FAULTS
+    the first time any site is consulted."""
+    global _active, _env_checked
+    if _active is None and not _env_checked:
+        _env_checked = True
+        spec = os.environ.get("PADDLE_TPU_FAULTS", "")
+        if spec:
+            _active = FaultInjector.parse(spec)
+    return _active
+
+
+def install(spec: str) -> FaultInjector:
+    """Install an injector process-wide (replaces any active one). A string
+    spec is also exported to ``PADDLE_TPU_FAULTS`` so child processes that
+    don't inherit this interpreter's memory (spawn-started DataLoader
+    workers) bootstrap the same clauses from the environment; fork-started
+    children inherit the live injector object directly."""
+    global _active, _env_checked
+    _env_checked = True
+    if isinstance(spec, str):
+        _active = FaultInjector.parse(spec)
+        os.environ["PADDLE_TPU_FAULTS"] = spec
+    else:
+        _active = spec
+    return _active
+
+
+def uninstall() -> None:
+    global _active, _env_checked
+    _active = None
+    _env_checked = True
+    os.environ.pop("PADDLE_TPU_FAULTS", None)
+
+
+class inject:
+    """Context manager: ``with faults.inject("nan@5"): train()``."""
+
+    def __init__(self, spec: str):
+        self._spec = spec
+        self._saved = None
+        self._saved_env = None
+
+    def __enter__(self) -> FaultInjector:
+        global _active
+        self._saved = _active
+        self._saved_env = os.environ.get("PADDLE_TPU_FAULTS")
+        return install(self._spec)
+
+    def __exit__(self, *exc):
+        global _active
+        _active = self._saved
+        if self._saved_env is None:
+            os.environ.pop("PADDLE_TPU_FAULTS", None)
+        else:
+            os.environ["PADDLE_TPU_FAULTS"] = self._saved_env
+        return False
+
+
+# -- hook sites (called unconditionally from framework code) -----------------
+
+def on_save_write(path: str = "") -> None:
+    inj = get_active()
+    if inj is not None:
+        inj.save_write(path)
+
+
+def on_train_step(step: int) -> bool:
+    inj = get_active()
+    if inj is not None:
+        return inj.train_step(step)
+    return False
+
+
+def on_worker_fetch() -> None:
+    inj = get_active()
+    if inj is not None:
+        inj.worker_fetch()

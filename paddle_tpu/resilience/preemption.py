@@ -1,0 +1,149 @@
+"""Preemption-safe shutdown: SIGTERM/SIGINT → drain, checkpoint, exit.
+
+TPU slices make preemption the common case (GSPMD-era schedulers reclaim
+slices routinely), so termination is part of the training contract, not an
+error path. The handler is *cooperative*: the signal callback only records
+the request (safe in any thread/context), and the training loop surfaces it
+at the next step boundary via :meth:`PreemptionHandler.maybe_exit`, which
+
+1. drains the in-flight async checkpoint save,
+2. writes a final blocking checkpoint at the current step,
+3. raises ``SystemExit`` with a source-derived status: 143 (128+SIGTERM,
+   the conventional "killed by TERM" code schedulers relaunch) for
+   sigterm/elastic/manual, 130 (128+SIGINT) for an operator's Ctrl-C,
+   or the explicit ``exit_code`` override.
+
+``attach_elastic`` registers the same request as an
+``ElasticManager`` pre-hook, so an ``ElasticStatus.RESTART`` scale event
+drains and checkpoints through the identical path before the scheduler
+relaunches the job.
+
+Telemetry: ``paddle_tpu_resilience_preemptions_total`` {source},
+``paddle_tpu_resilience_drain_seconds``.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+from ..observability import counter as _obs_counter, histogram as _obs_histogram
+
+__all__ = ["PreemptionHandler", "TrainingPreempted"]
+
+_OBS_PREEMPTIONS = _obs_counter(
+    "paddle_tpu_resilience_preemptions_total",
+    "preemption requests by source (sigterm|sigint|elastic|manual)")
+_OBS_DRAIN_SECONDS = _obs_histogram(
+    "paddle_tpu_resilience_drain_seconds",
+    "seconds spent draining async saves + writing the final checkpoint")
+
+
+class TrainingPreempted(SystemExit):
+    """SystemExit subclass raised at the step boundary after the final
+    checkpoint committed; ``code`` is the scheduler-relaunchable status."""
+
+
+class PreemptionHandler:
+    """Cooperative SIGTERM/SIGINT (and elastic-restart) checkpoint-and-exit.
+
+    ::
+
+        handler = PreemptionHandler(mgr).install()
+        try:
+            for step in range(start, total):
+                ...
+                handler.maybe_exit(step + 1, model=model, optimizer=opt)
+        finally:
+            handler.uninstall()
+
+    Also usable as a context manager (``with PreemptionHandler(mgr) as h:``).
+    """
+
+    def __init__(self, manager=None, exit_code: int | None = None,
+                 signals=(signal.SIGTERM, signal.SIGINT)):
+        """exit_code=None derives the status from the preemption source —
+        128+TERM=143 (scheduler-relaunchable) for sigterm/elastic/manual,
+        128+INT=130 for an operator's Ctrl-C, which wrappers must NOT
+        auto-relaunch. An explicit int overrides both."""
+        self.manager = manager
+        self.exit_code = None if exit_code is None else int(exit_code)
+        self.signals = tuple(signals)
+        self._preempted = threading.Event()
+        self._source: str | None = None
+        self._prev_handlers: dict = {}
+        self._installed = False
+
+    # -- signal plumbing ----------------------------------------------------
+
+    def install(self) -> "PreemptionHandler":
+        """Register the signal handlers (main thread only, per the signal
+        module's contract); idempotent."""
+        if not self._installed:
+            for sig in self.signals:
+                self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            for sig, prev in self._prev_handlers.items():
+                signal.signal(sig, prev)
+            self._prev_handlers.clear()
+            self._installed = False
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    def _on_signal(self, signum, frame):
+        # async-signal context: record only; the loop acts at a step boundary
+        self.request_preemption(
+            "sigint" if signum == signal.SIGINT else "sigterm")
+
+    def request_preemption(self, source: str = "manual") -> None:
+        """Mark the run preempted (thread-safe; first source wins)."""
+        if not self._preempted.is_set():
+            self._source = source
+            self._preempted.set()
+            _OBS_PREEMPTIONS.inc(source=source)
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted.is_set()
+
+    @property
+    def source(self) -> str | None:
+        return self._source
+
+    def attach_elastic(self, elastic_manager) -> "PreemptionHandler":
+        """Route ElasticStatus.RESTART through this handler: the elastic
+        pre-hook requests preemption, the training loop drains + checkpoints
+        + exits for the scheduler to relaunch at the new scale."""
+        elastic_manager.register_pre_hook(
+            lambda: self.request_preemption("elastic"))
+        return self
+
+    # -- step-boundary hook --------------------------------------------------
+
+    def maybe_exit(self, step: int, model=None, optimizer=None, scaler=None,
+                   lr_scheduler=None, extra=None) -> None:
+        """No-op until preempted; then drain, write the final checkpoint at
+        `step`, and raise TrainingPreempted(exit_code)."""
+        if not self._preempted.is_set():
+            return
+        t0 = time.perf_counter()
+        if self.manager is not None:
+            self.manager.wait()       # drain the in-flight async save
+            self.manager.save(step, model=model, optimizer=optimizer,
+                              scaler=scaler, lr_scheduler=lr_scheduler,
+                              extra=extra, blocking=True)
+        _OBS_DRAIN_SECONDS.observe(time.perf_counter() - t0)
+        code = self.exit_code
+        if code is None:
+            code = 130 if self._source == "sigint" else 143
+        raise TrainingPreempted(code)

@@ -1,0 +1,147 @@
+"""NaN/Inf sentinel: numeric blow-up detection without per-step host syncs.
+
+Per-step cost is one device-side ``isfinite().all()`` folded into a running
+device scalar — no transfer, no dispatch stall (the pattern the TS00x
+trace-safety rules require: the host pull happens only on the check
+cadence, one sync per ``check_every`` steps, batched over the whole
+window).
+
+On a bad window the sentinel cooperates with ``amp.GradScaler``: steps the
+scaler already skipped (its ``found_inf`` bookkeeping) never polluted the
+parameters, so the first response is to *skip* — reset the window and let
+dynamic loss scaling back off. Only after ``max_consecutive`` consecutive
+bad windows does it escalate: rewind to the last good checkpoint
+(``action="rewind"``, needs a :class:`CheckpointManager`), or raise
+:class:`NumericsError` (``action="raise"``).
+
+Telemetry: ``paddle_tpu_resilience_nan_events_total`` (bad windows),
+``_nan_skips_total``, ``_nan_rewinds_total``.
+"""
+
+from __future__ import annotations
+
+from ..observability import counter as _obs_counter
+
+__all__ = ["NaNSentinel", "NumericsError"]
+
+_OBS_EVENTS = _obs_counter(
+    "paddle_tpu_resilience_nan_events_total",
+    "sentinel check windows containing a non-finite loss/grad")
+_OBS_SKIPS = _obs_counter(
+    "paddle_tpu_resilience_nan_skips_total",
+    "bad windows absorbed without rewind (scaler-handled or under patience)")
+_OBS_REWINDS = _obs_counter(
+    "paddle_tpu_resilience_nan_rewinds_total",
+    "rewinds to the last good checkpoint after max_consecutive bad windows")
+
+
+class NumericsError(RuntimeError):
+    """Raised by NaNSentinel(action="raise") after max_consecutive
+    consecutive bad check windows."""
+
+
+class NaNSentinel:
+    """Watch loss (and optionally grad) finiteness on a cadence.
+
+    ::
+
+        sentinel = NaNSentinel(check_every=25, max_consecutive=3,
+                               manager=mgr, scaler=scaler)
+        for step in range(start, total):
+            loss = train_step(...)
+            sentinel.observe(loss)
+            if sentinel.check(step, model=model, optimizer=opt) == "rewind":
+                step_resume = mgr.latest_step()  # loop may rewind its cursor
+
+    ``observe`` is device-only; ``check`` returns None off-cadence (no host
+    work) and otherwise one of None (window clean), "skip", "rewind".
+    """
+
+    def __init__(self, check_every: int = 25, max_consecutive: int = 3,
+                 manager=None, scaler=None, action: str = "rewind"):
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
+        if action not in ("rewind", "skip", "raise"):
+            raise ValueError(f"unknown action {action!r}")
+        if action == "rewind" and manager is None:
+            raise ValueError('action="rewind" needs a CheckpointManager')
+        self.check_every = check_every
+        self.max_consecutive = max_consecutive
+        self.manager = manager
+        self.scaler = scaler
+        self.action = action
+        self._ok_accum = None        # device scalar: AND of window finiteness
+        self._bad_windows = 0
+        self._scaler_inf_seen = self._scaler_inf_total()
+        #: step of the checkpoint the last "rewind" actually restored — the
+        #: loop must reset its cursor to THIS, not to manager.latest_step()
+        #: (restore() may have fallen back past a corrupt newer checkpoint)
+        self.restored_step: int | None = None
+
+    def _scaler_inf_total(self) -> int:
+        return getattr(self.scaler, "inf_steps_total", 0) \
+            if self.scaler is not None else 0
+
+    # -- hot path (device only) ----------------------------------------------
+
+    def observe(self, loss, optimizer=None) -> None:
+        """Fold this step's finiteness into the window accumulator —
+        device-side elementwise ops only, safe to call every step."""
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        arr = loss._data if isinstance(loss, Tensor) else jnp.asarray(loss)
+        fin = jnp.all(jnp.isfinite(arr))
+        if optimizer is not None:
+            for p in optimizer._parameter_list:
+                if p._grad is not None:
+                    fin = jnp.logical_and(
+                        fin, jnp.all(jnp.isfinite(p._grad._data)))
+        self._ok_accum = fin if self._ok_accum is None \
+            else jnp.logical_and(self._ok_accum, fin)
+
+    # -- cadence path (one host sync per window) -----------------------------
+
+    def should_check(self, step: int) -> bool:
+        return (step + 1) % self.check_every == 0
+
+    def check(self, step: int, model=None, optimizer=None,
+              lr_scheduler=None) -> str | None:
+        """Off-cadence: returns None untouched. On cadence: one host pull of
+        the window accumulator; classify the window and act."""
+        if not self.should_check(step) or self._ok_accum is None:
+            return None
+        ok = bool(self._ok_accum)   # the single batched host sync
+        self._ok_accum = None
+        if ok:
+            self._bad_windows = 0
+            self._scaler_inf_seen = self._scaler_inf_total()
+            return None
+        _OBS_EVENTS.inc()
+        self._bad_windows += 1
+        # scaler cooperation: if dynamic loss scaling caught (and skipped)
+        # those steps, parameters are clean — absorb the window
+        scaler_total = self._scaler_inf_total()
+        scaler_handled = scaler_total > self._scaler_inf_seen
+        self._scaler_inf_seen = scaler_total
+        if self._bad_windows < self.max_consecutive or \
+                (scaler_handled and self._bad_windows < 2 * self.max_consecutive):
+            _OBS_SKIPS.inc()
+            return "skip"
+        self._bad_windows = 0
+        if self.action == "raise":
+            raise NumericsError(
+                f"non-finite loss/grad persisted for {self.max_consecutive} "
+                f"consecutive check windows (step {step})")
+        if self.action == "skip":
+            _OBS_SKIPS.inc()
+            return "skip"
+        restored = self.manager.restore(model=model, optimizer=optimizer,
+                                        scaler=self.scaler,
+                                        lr_scheduler=lr_scheduler)
+        if restored is None:
+            raise NumericsError(
+                f"non-finite loss/grad at step {step} and no checkpoint to "
+                f"rewind to")
+        self.restored_step = restored
+        _OBS_REWINDS.inc()
+        return "rewind"

@@ -1,0 +1,29 @@
+"""tools/chaos_check.py is the CI chaos gate: every injected-fault profile
+must recover bit-identically, losing at most one optimizer step."""
+
+import importlib.util
+import os
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_check", os.path.join(TOOLS, "chaos_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_gate_all_profiles_pass():
+    assert _load().main([]) == 0
+
+
+def test_chaos_gate_fails_without_recovery(tmp_path):
+    """The gate must actually gate: a divergent resumed run is a failure.
+    Sanity-check the comparator on perturbed weights."""
+    cc = _load()
+    ref = cc._reference(4)
+    bad = {k: v + 1.0 for k, v in ref.items()}
+    assert not cc._same(bad, ref)
+    assert cc._same(dict(ref), ref)

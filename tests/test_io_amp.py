@@ -268,3 +268,49 @@ def test_multiprocess_worker_error_propagates():
     dl = DataLoader(BadDs(), batch_size=2, num_workers=1)
     with pytest.raises(RuntimeError, match="boom in worker"):
         list(dl)
+
+
+def test_multiprocess_dead_worker_propagates_not_hangs():
+    """A worker that dies without reporting (hard exit, OOM-kill, segfault)
+    must surface as an exception within seconds — even with no user
+    timeout — instead of wedging the consumer forever. Driven by the
+    resilience fault harness's dead-worker injector."""
+    import time
+    from paddle_tpu.resilience import faults
+
+    faults.install("worker_dead@1")  # forked workers inherit the injector
+    try:
+        dl = DataLoader(_NpDs(8), batch_size=2, num_workers=1)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="died unexpectedly"):
+            list(dl)
+        assert time.monotonic() - t0 < 30  # detection, not a hang
+    finally:
+        faults.uninstall()
+
+
+def test_multiprocess_slow_worker_hits_user_timeout():
+    """A stalled (not dead) worker trips the user's timeout with the
+    timeout message, exercising the slow-worker injector."""
+    from paddle_tpu.resilience import faults
+
+    faults.install("worker_slow@1:30")
+    try:
+        dl = DataLoader(_NpDs(8), batch_size=2, num_workers=1, timeout=1)
+        with pytest.raises(RuntimeError, match="timed out"):
+            list(dl)
+    finally:
+        faults.uninstall()
+
+
+def test_multiprocess_slow_worker_within_budget_recovers():
+    """A transient stall shorter than the timeout only delays the batch."""
+    from paddle_tpu.resilience import faults
+
+    faults.install("worker_slow@1:0.2")
+    try:
+        dl = DataLoader(_NpDs(8), batch_size=2, num_workers=1, timeout=20)
+        ys = np.concatenate([np.asarray(y.numpy()) for _, y in dl])
+        np.testing.assert_array_equal(np.sort(ys), np.arange(8))
+    finally:
+        faults.uninstall()

@@ -1,0 +1,407 @@
+"""Fault-tolerant runtime (paddle_tpu.resilience): atomic checkpoints,
+corrupt-fallback restore, NaN sentinel, preemption drain, fault harness."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+from paddle_tpu.resilience import (CheckpointManager, CheckpointNotFoundError,
+                                   FaultInjector, InjectedIOError, NaNSentinel,
+                                   NumericsError, PreemptionHandler,
+                                   TrainingPreempted, faults)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+def _named_net():
+    """Explicit parameter names: accumulator keys must rebind onto a fresh
+    model in THIS process (auto names only reproduce across real process
+    boundaries)."""
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = paddle.create_parameter([6, 3], "float32", name="rt_w")
+            self.b = paddle.create_parameter([3], "float32", name="rt_b",
+                                             is_bias=True)
+
+        def forward(self, x):
+            return x.matmul(self.w) + self.b
+
+    return Net()
+
+
+def _train_steps(model, opt, scaler, sched, start, n, noise_scale=0.01):
+    """Deterministic-by-step batches plus a framework-RNG noise draw each
+    step, so a correct resume must restore the RNG stream too."""
+    losses = []
+    for i in range(start, start + n):
+        rng = np.random.default_rng(50 + i)
+        x = paddle.to_tensor(rng.standard_normal((4, 6)).astype(np.float32))
+        noise = paddle.randn([4, 3]) * noise_scale
+        y = paddle.to_tensor(
+            rng.standard_normal((4, 3)).astype(np.float32)) + noise
+        loss = scaler.scale(((model(x) - y) ** 2).mean())
+        loss.backward()
+        scaler.step(opt)
+        scaler.update()
+        sched.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _full_stack(lr=0.05):
+    model = _named_net()
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=lr, step_size=3,
+                                          gamma=0.5)
+    opt = paddle.optimizer.Adam(sched, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=1024.0)
+    return model, opt, scaler, sched
+
+
+# -- satellite: atomic paddle.save -------------------------------------------
+
+def test_paddle_save_atomic_under_injected_io_error(tmp_path):
+    p = tmp_path / "m.pdparams"
+    paddle.save({"a": paddle.to_tensor([1.0, 2.0])}, str(p))
+    with faults.inject("save_io@1"):
+        with pytest.raises(InjectedIOError):
+            paddle.save({"a": paddle.to_tensor([9.0, 9.0])}, str(p))
+    # old complete file intact, no tmp residue anywhere in the directory
+    loaded = paddle.load(str(p))
+    np.testing.assert_array_equal(loaded["a"].numpy(), [1.0, 2.0])
+    assert os.listdir(tmp_path) == ["m.pdparams"]
+
+
+def test_paddle_save_file_object_path_unchanged(tmp_path):
+    p = tmp_path / "obj.pkl"
+    with open(p, "wb") as f:
+        paddle.save({"x": 3}, f)
+    with open(p, "rb") as f:
+        assert paddle.load(f)["x"] == 3
+
+
+# -- CheckpointManager -------------------------------------------------------
+
+def test_full_state_round_trip_bit_identical(tmp_path):
+    model, opt, scaler, sched = _full_stack()
+    _train_steps(model, opt, scaler, sched, 0, 4)
+    mgr = CheckpointManager(str(tmp_path), keep_n=2)
+    mgr.save(4, model=model, optimizer=opt, scaler=scaler, lr_scheduler=sched)
+    ref_losses = _train_steps(model, opt, scaler, sched, 4, 3)
+    ref_w = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+
+    model2, opt2, scaler2, sched2 = _full_stack()
+    mgr2 = CheckpointManager(str(tmp_path), keep_n=2)
+    assert mgr2.restore(model=model2, optimizer=opt2, scaler=scaler2,
+                        lr_scheduler=sched2) == 4
+    assert opt2._step_count == 4
+    assert float(opt2._step_tensor._data) == 4.0
+    assert scaler2._scale == scaler._scale
+    losses2 = _train_steps(model2, opt2, scaler2, sched2, 4, 3)
+    assert losses2 == ref_losses  # includes the paddle.randn RNG stream
+    for k, v in model2.state_dict().items():
+        np.testing.assert_array_equal(v.numpy(), ref_w[k])
+
+
+def test_retention_keeps_newest_n(tmp_path):
+    model = _named_net()
+    mgr = CheckpointManager(str(tmp_path), keep_n=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, model=model)
+    assert mgr.all_steps() == [3, 4]
+    # payloads of dropped steps are gone too
+    names = sorted(os.listdir(tmp_path))
+    assert not any("0000000001" in n or "0000000002" in n for n in names)
+
+
+def test_restore_falls_back_over_corrupt_checkpoint(tmp_path):
+    model = _named_net()
+    mgr = CheckpointManager(str(tmp_path), keep_n=3)
+    mgr.save(1, model=model)
+    good_w = model.w.numpy().copy()
+    model.w.set_value(model.w.numpy() + 1.0)
+    mgr.save(2, model=model)
+    # truncate the newest payload: hash check must reject it
+    with open(mgr._payload_path(2), "r+b") as f:
+        f.truncate(16)
+    before = obs.total("paddle_tpu_resilience_restore_fallbacks_total")
+    model2 = _named_net()
+    assert CheckpointManager(str(tmp_path)).restore(model=model2) == 1
+    np.testing.assert_array_equal(model2.w.numpy(), good_w)
+    assert obs.total("paddle_tpu_resilience_restore_fallbacks_total") \
+        == before + 1
+
+
+def test_payload_without_manifest_is_invisible(tmp_path):
+    model = _named_net()
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, model=model)
+    mgr.save(2, model=model)
+    os.unlink(mgr._manifest_path(2))
+    assert mgr.all_steps() == [1]
+    assert CheckpointManager(str(tmp_path)).restore(model=model) == 1
+
+
+def test_manifest_format(tmp_path):
+    model = _named_net()
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(7, model=model, extra={"tokens_seen": 123})
+    with open(mgr._manifest_path(7)) as f:
+        m = json.load(f)
+    assert m["step"] == 7 and m["format_version"] == 1
+    assert m["bytes"] == os.path.getsize(mgr._payload_path(7))
+    assert set(m["keys"]) >= {"model", "rng", "extra"}
+    assert mgr.load_extra()["tokens_seen"] == 123
+
+
+def test_async_save_drains_before_restore(tmp_path):
+    model = _named_net()
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    th = mgr.save(1, model=model)
+    assert th is not None
+    assert mgr.restore(model=model) == 1  # restore() waits for the commit
+    assert mgr.last_error is None
+
+
+def test_injected_io_error_mid_manager_save_leaves_no_partial(tmp_path):
+    model = _named_net()
+    mgr = CheckpointManager(str(tmp_path), keep_n=3)
+    mgr.save(1, model=model)
+    with faults.inject("save_io@1"):
+        with pytest.raises(InjectedIOError):
+            mgr.save(2, model=model)
+    # nothing with step 2's name — committed or temporary — survives
+    assert all("0000000002" not in n for n in os.listdir(tmp_path))
+    assert mgr.all_steps() == [1]
+    assert CheckpointManager(str(tmp_path)).restore(model=model) == 1
+
+
+def test_async_injected_error_is_recorded_not_raised(tmp_path):
+    model = _named_net()
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    mgr.save(1, model=model)
+    mgr.wait()
+    with faults.inject("save_io@1"):
+        mgr.save(2, model=model)
+        mgr.wait()
+    assert isinstance(mgr.last_error, InjectedIOError)
+    assert mgr.all_steps() == [1]
+
+
+def test_restore_required_raises_when_empty(tmp_path):
+    with pytest.raises(CheckpointNotFoundError):
+        CheckpointManager(str(tmp_path)).restore(required=True)
+    assert CheckpointManager(str(tmp_path)).restore() is None
+
+
+# -- NaN sentinel ------------------------------------------------------------
+
+def test_sentinel_off_cadence_no_action():
+    s = NaNSentinel(check_every=10, action="raise")
+    s.observe(paddle.to_tensor(float("nan")))
+    assert s.check(3) is None  # step 3: not a window boundary, no host pull
+
+
+def test_sentinel_raises_after_consecutive_bad_windows():
+    s = NaNSentinel(check_every=1, max_consecutive=2, action="raise")
+    s.observe(paddle.to_tensor(float("nan")))
+    assert s.check(0) == "skip"  # first bad window: under patience
+    s.observe(paddle.to_tensor(float("inf")))
+    with pytest.raises(NumericsError):
+        s.check(1)
+
+
+def test_sentinel_clean_window_resets_patience():
+    s = NaNSentinel(check_every=1, max_consecutive=2, action="raise")
+    s.observe(paddle.to_tensor(float("nan")))
+    assert s.check(0) == "skip"
+    s.observe(paddle.to_tensor(1.0))
+    assert s.check(1) is None
+    s.observe(paddle.to_tensor(float("nan")))
+    assert s.check(2) == "skip"  # patience restarted after the clean window
+
+
+def test_sentinel_rewinds_to_checkpoint(tmp_path):
+    model, opt, scaler, sched = _full_stack()
+    _train_steps(model, opt, scaler, sched, 0, 2)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(2, model=model, optimizer=opt)
+    good_w = model.w.numpy().copy()
+    model.w.set_value(np.full((6, 3), np.nan, np.float32))
+    s = NaNSentinel(check_every=1, max_consecutive=1, manager=mgr)
+    s.observe(model.w)
+    assert s.check(0, model=model, optimizer=opt) == "rewind"
+    np.testing.assert_array_equal(model.w.numpy(), good_w)
+    assert mgr.latest_step() == 2
+
+
+def test_sentinel_grad_observation():
+    model = _named_net()
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    x = paddle.to_tensor(np.full((2, 6), np.nan, np.float32))
+    loss = model(x).mean()
+    loss.backward()
+    s = NaNSentinel(check_every=1, max_consecutive=1, action="raise")
+    s.observe(paddle.to_tensor(1.0), optimizer=opt)  # finite loss, NaN grads
+    with pytest.raises(NumericsError):
+        s.check(0)
+    opt.clear_grad()
+
+
+def test_sentinel_scaler_cooperation_extends_patience():
+    scaler = paddle.amp.GradScaler(enable=True)
+    s = NaNSentinel(check_every=1, max_consecutive=1, scaler=scaler,
+                    action="raise")
+    # simulate the scaler having caught (and skipped) the inf steps in
+    # this window: sentinel must absorb instead of escalating
+    scaler._inf_steps_total += 1
+    s.observe(paddle.to_tensor(float("nan")))
+    assert s.check(0) == "skip"
+    # scaler saw nothing new in the next bad window -> escalate
+    s.observe(paddle.to_tensor(float("nan")))
+    with pytest.raises(NumericsError):
+        s.check(1)
+
+
+# -- preemption --------------------------------------------------------------
+
+def test_sigterm_sets_cooperative_flag_only():
+    with PreemptionHandler() as h:
+        assert not h.preempted
+        signal.raise_signal(signal.SIGTERM)
+        # the signal callback records; nothing exits until a step boundary
+        assert h.preempted and h.source == "sigterm"
+    # uninstalled: default disposition restored (a later SIGTERM would kill)
+    assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
+
+
+def test_sigterm_maybe_exit_writes_final_checkpoint(tmp_path):
+    model = _named_net()
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    h = PreemptionHandler(mgr).install()
+    try:
+        signal.raise_signal(signal.SIGTERM)
+        with pytest.raises(TrainingPreempted) as ei:
+            h.maybe_exit(5, model=model)
+        assert ei.value.code == 143
+    finally:
+        h.uninstall()
+    assert CheckpointManager(str(tmp_path)).restore(model=model) == 5
+
+
+def test_sigint_and_custom_exit_code(tmp_path):
+    h = PreemptionHandler(exit_code=77).install()
+    try:
+        signal.raise_signal(signal.SIGINT)
+        assert h.source == "sigint"
+        with pytest.raises(SystemExit) as ei:
+            h.maybe_exit(1)
+        assert ei.value.code == 77  # explicit override wins
+    finally:
+        h.uninstall()
+
+
+def test_sigint_defaults_to_130_not_relaunchable():
+    """Ctrl-C must NOT exit 143 — wrappers would auto-relaunch an
+    interactive cancellation."""
+    h = PreemptionHandler().install()
+    try:
+        signal.raise_signal(signal.SIGINT)
+        with pytest.raises(SystemExit) as ei:
+            h.maybe_exit(1)
+        assert ei.value.code == 130
+    finally:
+        h.uninstall()
+
+
+def test_maybe_exit_noop_until_preempted():
+    h = PreemptionHandler()
+    h.maybe_exit(1)  # must not raise
+    h.request_preemption()
+    with pytest.raises(TrainingPreempted):
+        h.maybe_exit(2)
+
+
+def test_elastic_restart_routes_through_preemption(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    hosts = [["a:1", "b:1"], ["a:1", "b:1", "c:1"]]
+    em = ElasticManager(hosts=hosts[0], listener=lambda: hosts[1],
+                        min_hosts=2, max_hosts=3)
+    h = PreemptionHandler().attach_elastic(em)
+    assert em.watch() == ElasticStatus.RESTART
+    assert h.preempted and h.source == "elastic"
+    with pytest.raises(TrainingPreempted):
+        h.maybe_exit(9)
+
+
+def test_elastic_hook_error_does_not_mask_restart():
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    em = ElasticManager(hosts=["a:1"], listener=lambda: ["a:1", "b:1"],
+                        min_hosts=1, max_hosts=2)
+    em.register_pre_hook(lambda: 1 / 0)
+    with pytest.warns(RuntimeWarning, match="pre-restart hook"):
+        assert em.watch() == ElasticStatus.RESTART
+
+
+# -- fault harness -----------------------------------------------------------
+
+def test_fault_spec_grammar():
+    inj = FaultInjector.parse("save_io@2, nan@5:0, worker_slow@3:2.5")
+    assert [c.kind for c in inj.clauses] == ["save_io", "nan", "worker_slow"]
+    assert inj.clauses[2].param == 2.5
+    with pytest.raises(ValueError):
+        FaultInjector.parse("explode@1")
+    with pytest.raises(ValueError):
+        FaultInjector.parse("nan5")
+
+
+def test_event_clause_fires_at_nth_occurrence_only():
+    inj = faults.install("save_io@2")
+    inj.save_write()  # occurrence 1: clean
+    with pytest.raises(InjectedIOError):
+        inj.save_write()
+    inj.save_write()  # occurrence 3: clean again
+
+
+def test_step_clause_is_one_shot():
+    inj = faults.install("nan@4")
+    assert not inj.train_step(3)
+    assert inj.train_step(4)
+    assert not inj.train_step(4)  # replay after rewind: consumed
+
+
+def test_env_bootstrap(monkeypatch):
+    faults.uninstall()  # clears any installed injector AND the env var
+    monkeypatch.setenv("PADDLE_TPU_FAULTS", "nan@1")
+    faults._env_checked = False  # force a re-read of the env
+    assert faults.on_train_step(1)
+    faults.uninstall()
+
+
+def test_install_exports_env_for_spawned_children(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_FAULTS", raising=False)
+    faults.install("worker_dead@1")
+    assert os.environ["PADDLE_TPU_FAULTS"] == "worker_dead@1"
+    faults.uninstall()
+    assert "PADDLE_TPU_FAULTS" not in os.environ
+
+
+def test_inject_context_restores_previous():
+    outer = faults.install("nan@1")
+    with faults.inject("nan@2") as inner:
+        assert faults.get_active() is inner
+    assert faults.get_active() is outer

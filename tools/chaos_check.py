@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Chaos gate: a tiny train loop must survive three injected fault profiles
+and resume bit-identically, losing at most one optimizer step.
+
+Profiles (each compared against the same fault-free reference trajectory):
+
+  kill-mid-save   an injected IO error kills the run during a checkpoint
+                  commit; the relaunched run must restore a GOOD checkpoint
+                  (never the partial one) and finish identical to the
+                  reference, having lost <= 1 step
+  nan-at-step-k   a NaN loss at step k; the NaN sentinel rewinds to the
+                  last good checkpoint and the replayed run must finish
+                  identical to the reference
+  sigterm-at-k    SIGTERM entering step k; the preemption handler drains,
+                  writes a final checkpoint, exits 143; the relaunch must
+                  resume having lost 0 steps and finish identical
+
+Exit status: 0 when every profile holds, 1 otherwise. Fast (CPU, a
+4-parameter model, eager steps) — wired into tier-1 via
+tests/test_chaos_check.py. Run directly:
+
+    python tools/chaos_check.py [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+STEPS = 8
+FAULT_STEP = 4  # mid-run: checkpoints exist before it, work remains after
+
+
+def _batch(step):
+    """Deterministic per-step batch: a resumed run regenerates the exact
+    stream from the step index alone."""
+    rng = np.random.default_rng(1000 + step)
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    y = x.sum(-1, keepdims=True).astype(np.float32) * 0.5
+    return x, y
+
+
+def _fresh():
+    """A just-launched process. Parameters carry EXPLICIT names: optimizer
+    accumulators are keyed by parameter name in the checkpoint, and
+    auto-generated tensor names only reproduce in a genuinely fresh
+    process (the global counter restarts), not in this in-process
+    relaunch simulation."""
+    import paddle_tpu as paddle
+
+    class _Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = paddle.create_parameter([4, 1], "float32",
+                                             name="chaos_w")
+            self.b = paddle.create_parameter([1], "float32", name="chaos_b",
+                                             is_bias=True)
+
+        def forward(self, x):
+            return x.matmul(self.w) + self.b
+
+    paddle.seed(0)
+    model = _Net()
+    opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+    return model, opt
+
+
+def _train(model, opt, start, steps, manager=None, sentinel=None,
+           handler=None):
+    """Eager loop [start, steps); returns the step after the last one run.
+    Checkpoints every step when a manager is attached (save_every=1 gives
+    the <=1-step loss bound this gate enforces)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.resilience import faults
+    i = start
+    while i < steps:
+        x, y = _batch(i)
+        loss = ((model(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        if faults.on_train_step(i):  # may also deliver an injected SIGTERM
+            loss = loss * float("nan")
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if sentinel is not None:
+            sentinel.observe(loss)
+            if sentinel.check(i, model=model, optimizer=opt) == "rewind":
+                # cursor = step actually restored, not latest_step()
+                i = sentinel.restored_step or 0
+                continue
+        if manager is not None:
+            manager.save(i + 1, model=model, optimizer=opt, blocking=True)
+        if handler is not None:
+            handler.maybe_exit(i + 1, model=model, optimizer=opt)
+        i += 1
+    return i
+
+
+def _weights(model):
+    return {k: v.numpy().copy() for k, v in model.state_dict().items()}
+
+
+def _same(a, b):
+    return set(a) == set(b) and all(np.array_equal(a[k], b[k]) for k in a)
+
+
+def _reference(steps):
+    model, opt = _fresh()
+    _train(model, opt, 0, steps)
+    return _weights(model)
+
+
+def profile_kill_mid_save(steps, ref):
+    """IO error during the FAULT_STEP-th checkpoint commit kills the run;
+    relaunch must restore a verified-good checkpoint and match ref."""
+    from paddle_tpu.resilience import (CheckpointManager, InjectedIOError,
+                                      faults)
+    with tempfile.TemporaryDirectory() as d:
+        model, opt = _fresh()
+        mgr = CheckpointManager(d, keep_n=steps)
+        try:
+            with faults.inject(f"save_io@{FAULT_STEP}"):
+                _train(model, opt, 0, steps, manager=mgr)
+            return "injected IO error never fired"
+        except InjectedIOError:
+            pass  # the simulated crash
+        model2, opt2 = _fresh()
+        mgr2 = CheckpointManager(d, keep_n=steps)
+        restored = mgr2.restore(model=model2, optimizer=opt2)
+        if restored is None:
+            return "no checkpoint survived the failed save"
+        if FAULT_STEP - restored > 1:
+            return f"lost {FAULT_STEP - restored} steps (restored " \
+                   f"{restored}, crashed during save of {FAULT_STEP})"
+        _train(model2, opt2, restored, steps, manager=mgr2)
+        if not _same(_weights(model2), ref):
+            return "resumed run diverged from the fault-free reference"
+    return None
+
+
+def profile_nan_at_step(steps, ref):
+    """NaN loss at FAULT_STEP; the sentinel must rewind and the replay must
+    match ref exactly (the one-shot fault does not refire on replay)."""
+    from paddle_tpu.resilience import CheckpointManager, NaNSentinel, faults
+    with tempfile.TemporaryDirectory() as d:
+        model, opt = _fresh()
+        mgr = CheckpointManager(d, keep_n=steps)
+        sent = NaNSentinel(check_every=1, max_consecutive=1, manager=mgr)
+        with faults.inject(f"nan@{FAULT_STEP}"):
+            _train(model, opt, 0, steps, manager=mgr, sentinel=sent)
+        if not _same(_weights(model), ref):
+            return "post-rewind run diverged from the fault-free reference"
+        import paddle_tpu.observability as obs
+        if obs.total("paddle_tpu_resilience_nan_rewinds_total") < 1:
+            return "sentinel never rewound"
+    return None
+
+
+def profile_sigterm_at_step(steps, ref):
+    """SIGTERM entering FAULT_STEP; drain + final checkpoint + exit 143;
+    the relaunch must lose 0 steps and match ref."""
+    from paddle_tpu.resilience import (CheckpointManager, PreemptionHandler,
+                                      faults)
+    with tempfile.TemporaryDirectory() as d:
+        model, opt = _fresh()
+        mgr = CheckpointManager(d, keep_n=steps)
+        handler = PreemptionHandler(mgr).install()
+        try:
+            with faults.inject(f"sigterm@{FAULT_STEP}"):
+                _train(model, opt, 0, steps, manager=mgr, handler=handler)
+            return "SIGTERM never surfaced"
+        except SystemExit as e:
+            if e.code != 143:
+                return f"exit code {e.code}, wanted relaunchable 143"
+        finally:
+            handler.uninstall()
+        model2, opt2 = _fresh()
+        mgr2 = CheckpointManager(d, keep_n=steps)
+        restored = mgr2.restore(model=model2, optimizer=opt2)
+        if restored != FAULT_STEP + 1:
+            return f"final checkpoint at {restored}, wanted " \
+                   f"{FAULT_STEP + 1} (0 steps lost)"
+        _train(model2, opt2, restored, steps, manager=mgr2)
+        if not _same(_weights(model2), ref):
+            return "post-preemption run diverged from the reference"
+    return None
+
+
+PROFILES = (("kill-mid-save", profile_kill_mid_save),
+            ("nan-at-step-k", profile_nan_at_step),
+            ("sigterm-at-k", profile_sigterm_at_step))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=STEPS)
+    args = ap.parse_args(argv)
+    ref = _reference(args.steps)
+    failed = 0
+    for name, fn in PROFILES:
+        err = fn(args.steps, ref)
+        if err:
+            failed += 1
+            print(f"CHAOS FAIL [{name}]: {err}")
+        else:
+            print(f"chaos ok   [{name}]")
+    if failed:
+        print(f"chaos gate: {failed}/{len(PROFILES)} profile(s) failed")
+        return 1
+    print("chaos gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
