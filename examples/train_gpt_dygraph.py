@@ -10,6 +10,13 @@ the newest good checkpoint, SIGTERM drains the async save and exits
 relaunchable (code 143), and a persistent NaN loss rewinds to the last
 good state instead of ending the run. Inject failures deterministically
 via PADDLE_TPU_FAULTS (e.g. "sigterm@20" or "nan@15") to watch each path.
+
+Every abnormal path also leaves a black box: the flight recorder dumps
+flight_<step>.json next to the checkpoints (events leading up to death,
+metrics, memory census, per-module peak HBM from the startup attribution
+pass). Render it with:
+
+    python -m paddle_tpu.observability.flight <ckpt-dir>/flight_<step>.json
 """
 
 import argparse
@@ -18,6 +25,7 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.models import GPT, GPTConfig
+from paddle_tpu.observability import flight, memory as obs_memory
 from paddle_tpu.resilience import (CheckpointManager, NaNSentinel,
                                    PreemptionHandler, faults)
 
@@ -32,6 +40,16 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
                                  weight_decay=0.1)
     rng = np.random.default_rng(0)
     data = rng.integers(0, vocab, (4 * batch, seq + 1))
+
+    # one eager forward under memory attribution: per-module allocation
+    # deltas/peaks land in observability.memory.last_attribution(), which
+    # every flight dump embeds — so a later crash can name the Layer that
+    # owned the HBM. (Eager on purpose: under to_static the step is one
+    # fused program and module boundaries don't exist on device.)
+    if flight.enabled():
+        with obs_memory.attribute_memory(model):
+            model(paddle.to_tensor(data[:1, :-1].astype(np.int32)),
+                  labels=paddle.to_tensor(data[:1, 1:].astype(np.int32)))
 
     manager = sentinel = handler = None
     start = 0
@@ -76,7 +94,11 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
                 last = last * float("nan")
             first = first if first is not None else last
             if i % 10 == 0:
-                print(f"step {i:4d}  loss {float(last):.4f}")
+                loss_val = float(last)
+                # step heartbeat into the black box, at the same cadence
+                # as the (already host-synced) log line
+                flight.record("step", step=i, loss=round(loss_val, 4))
+                print(f"step {i:4d}  loss {loss_val:.4f}")
             if manager is not None:
                 sentinel.observe(last)
                 if sentinel.check(i, model=model, optimizer=opt) == "rewind":

@@ -18,6 +18,7 @@ from ...core.tensor import Tensor, as_tensor
 from ...autograd.function import apply
 from ...observability import (counter as _obs_counter,
                               enabled as _obs_enabled)
+from ...observability import flight as _flight
 from .group import (Group, ReduceOp, new_group, get_group, is_available,
                     destroy_process_group, active_axis_names, _axis_scope)
 
@@ -67,6 +68,9 @@ def _record_collective(op, payload, group):
     nbytes = _payload_nbytes(payload)
     if nbytes:
         _OBS_COMM_BYTES.inc(nbytes, op=op, group=gname)
+    if _flight.enabled():  # black box: collective launches are the events
+        # a dead-worker/deadlock forensic needs most
+        _flight.record("collective", op=op, group=gname, bytes=nbytes)
 
 
 def _in_place(t, out):

@@ -193,7 +193,17 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, worker_id,
 class WorkerDiedError(RuntimeError):
     """A DataLoader worker process exited without reporting an error
     (killed, segfaulted, or hard-exited) — raised by the consumer instead
-    of hanging the iterator."""
+    of hanging the iterator. Construction records a ``worker_dead`` flight
+    event, so every raise site (and future ones) reaches the black box."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        try:
+            from ..observability import flight as _flight
+            _flight.record("worker_dead",
+                           detail=str(args[0])[:200] if args else "")
+        except Exception:
+            pass
 
 
 class MultiprocessLoaderIter:

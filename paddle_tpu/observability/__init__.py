@@ -23,6 +23,20 @@ layers are instrumented out of the box:
   ``paddle_tpu_amp_scaler_found_inf_total``): recovery is a first-class
   metric family, not log noise.
 
+Beyond metrics, two forensic layers (this PR's black box):
+
+* :mod:`.flight` — an always-on bounded ring buffer of structured events
+  (steps, spans, retraces, collectives, checkpoints, NaN windows,
+  preemptions, injected faults) fed by the same instrumented layers via
+  ``flight.record(kind, **fields)``; on abnormal death it dumps a
+  self-contained ``flight_<step>.json`` next to the checkpoint dir, and
+  ``python -m paddle_tpu.observability.flight <dump>`` renders it.
+  Disable with ``PADDLE_TPU_FLIGHT=0``.
+* :mod:`.memory` — HBM census (``device.memory_stats()`` +
+  ``jax.live_arrays()`` by dtype/shape, exported as
+  ``paddle_tpu_hbm_bytes{kind=...}`` gauges) and per-``nn.Layer`` peak
+  attribution via ``memory.attribute_memory(model)``.
+
 Metric names follow ``paddle_tpu_<area>_<name>_<unit>``. Collection is on
 by default; ``PADDLE_TPU_METRICS=0`` (or :func:`enable`\\ ``(False)``)
 turns every recording call into a near-zero-cost no-op.
@@ -49,6 +63,8 @@ from .exporters import (  # noqa: F401
 from .step_timer import (  # noqa: F401
     StepTimer, device_peak_flops, analytic_mfu, PEAK_FLOPS_TABLE,
 )
+from . import flight  # noqa: F401
+from . import memory  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
@@ -56,7 +72,7 @@ __all__ = [
     "enabled", "enable", "value", "total", "reset",
     "render_prometheus", "snapshot", "merge_into_chrome_trace",
     "StepTimer", "device_peak_flops", "analytic_mfu", "PEAK_FLOPS_TABLE",
-    "dump", "serve_text",
+    "dump", "serve_text", "flight", "memory",
 ]
 
 

@@ -1,0 +1,491 @@
+"""Flight recorder: a black box for every training run.
+
+A bounded, thread-safe ring buffer of structured events that the
+framework's instrumented layers (jit trace cache, collectives, profiler
+spans, checkpoint/sentinel/preemption, fault injection, loader workers)
+feed through a single :func:`record` call. When a run dies — NaN rewind
+exhaustion, SIGTERM/SIGINT, an unhandled exception — the recorder dumps a
+self-contained ``flight_<step>.json`` next to the checkpoint directory so
+the events leading up to death survive the process.
+
+Design constraints (mirrors ``metrics.py``):
+
+* stdlib-only at import time — every hot layer imports this module; jax
+  and the exporters are pulled in lazily, only inside :func:`dump`.
+* recording one event costs ~one dict build + one deque append. There is
+  NO lock on the hot path: ``deque.append`` (bounded by ``maxlen``) and
+  ``itertools.count`` are both atomic under the GIL **and safe from a
+  signal handler** — the preemption handler records from async-signal
+  context, where a held non-reentrant lock would deadlock.
+* disabled (``PADDLE_TPU_FLIGHT=0`` or ``enable(False)``) means
+  :func:`record` returns after one attribute load + bool test; hot call
+  sites additionally guard with ``if flight.enabled():`` so not even the
+  kwargs dict is allocated.
+
+Event schema: every event is a flat JSON-safe dict
+``{"seq": int, "t": epoch-seconds, "kind": str, **fields}``. Well-known
+kinds (see docs/observability.md for the field tables): ``step``,
+``span_open``/``span_close``, ``jit_trace`` (with ``retrace`` flag),
+``jit_compile``, ``collective``, ``checkpoint_save``,
+``checkpoint_restore``, ``nan_window``/``nan_skip``/``nan_rewind``/
+``nan_raise``, ``preempt``/``preempt_exit``, ``fault_injected``,
+``worker_dead``, ``exception``.
+
+CLI: ``python -m paddle_tpu.observability.flight <dump.json>`` renders
+the timeline, top memory owners and the final events before death;
+``--chrome-trace out.json`` converts the event tape to a Chrome trace.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "FlightRecorder", "DEFAULT_CAPACITY", "SCHEMA_VERSION",
+    "get_recorder", "record", "events", "clear", "enabled", "enable",
+    "set_dump_dir", "get_dump_dir", "dump", "last_dump_path",
+    "install_excepthook", "uninstall_excepthook",
+    "load_dump", "render", "to_chrome_trace", "main",
+]
+
+DEFAULT_CAPACITY = 4096
+SCHEMA_VERSION = 1
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_FLIGHT", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _env_capacity() -> int:
+    try:
+        return max(int(os.environ.get("PADDLE_TPU_FLIGHT_EVENTS",
+                                      DEFAULT_CAPACITY)), 16)
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+class FlightRecorder:
+    """Bounded event tape. ``capacity`` is the ring size (oldest events
+    fall off); ``enabled`` gates recording, not dumping."""
+
+    def __init__(self, capacity: int | None = None,
+                 enabled: bool | None = None):
+        self.capacity = _env_capacity() if capacity is None else int(capacity)
+        self.enabled = _env_enabled() if enabled is None else bool(enabled)
+        self._events: deque = deque(maxlen=self.capacity)
+        self._seq = itertools.count()
+        self.dump_dir: str | None = None
+        self.last_dump_path: str | None = None
+        # dumping IS locked: it's cold, and two death paths racing (e.g.
+        # excepthook + preemption drain) must not interleave file writes
+        self._dump_lock = threading.Lock()
+
+    # -- hot path ------------------------------------------------------------
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one structured event. ~one dict + one atomic append when
+        enabled; a single attribute test when disabled. Signal-safe."""
+        if not self.enabled:
+            return
+        fields["seq"] = next(self._seq)
+        fields["t"] = time.time()
+        fields["kind"] = kind
+        self._events.append(fields)
+
+    # -- reads ---------------------------------------------------------------
+
+    def events(self, last: int | None = None) -> list:
+        """Snapshot of the tape, oldest first (``last`` trims to the most
+        recent N; 0 means none). list(deque) is atomic under the GIL."""
+        snap = list(self._events)
+        if last is None:
+            return snap
+        return snap[-last:] if last > 0 else []
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    # -- dump ----------------------------------------------------------------
+
+    def dump(self, reason: str, step: int | None = None,
+             path: str | None = None, extra: dict | None = None,
+             last: int | None = None,
+             dump_dir: str | None = None) -> str | None:
+        """Write a self-contained forensic dump and return its path.
+
+        Contents: schema/reason/step/time, the event tape (last-N), the
+        metrics snapshot (``observability.exporters.snapshot``), a memory
+        census + the latest per-module attribution, and an env/config
+        fingerprint. ``dump_dir`` overrides the recorder-wide directory
+        for this one dump (the resilience paths pass their own manager's
+        root, so a multi-manager process never routes a training dump to
+        an eval checkpoint dir). Returns None when the recorder is
+        disabled (no forensics were requested) or the write itself fails —
+        a dying process must never die *again* in its black box."""
+        if not self.enabled:
+            return None
+        with self._dump_lock:
+            try:
+                payload = self._payload(reason, step, extra, last)
+                if path is None:
+                    d = self._dir(dump_dir)
+                    stem = f"flight_{int(step)}" if step is not None \
+                        else "flight_final"
+                    path = os.path.join(d, f"{stem}.json")
+                    n = 2
+                    while os.path.exists(path):
+                        # never clobber an earlier black box at the same
+                        # step (async save-error + sentinel rewind can both
+                        # dump for one step; each is distinct forensics)
+                        path = os.path.join(d, f"{stem}-{n}.json")
+                        n += 1
+                tmp = f"{path}.tmp-{os.getpid()}"
+                with open(tmp, "w") as f:
+                    # sanitize first: a NaN loss on the tape is the FLAGSHIP
+                    # case, and bare NaN tokens are not RFC-8259 JSON (jq,
+                    # JSON.parse and Perfetto all reject them)
+                    json.dump(_finite(payload), f, default=_json_safe)
+                os.replace(tmp, path)
+                self.last_dump_path = path
+                return path
+            except Exception:
+                return None
+
+    def _dir(self, override: str | None = None) -> str:
+        d = override or self.dump_dir or \
+            os.environ.get("PADDLE_TPU_FLIGHT_DIR") or "."
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _payload(self, reason, step, extra, last) -> dict:
+        payload = {
+            "schema": SCHEMA_VERSION,
+            "reason": reason,
+            "step": step,
+            "time": time.time(),
+            "events": self.events(last),
+            "fingerprint": _fingerprint(),
+        }
+        try:  # lazy: exporters is stdlib-only but keep dump failure-proof
+            from ..exporters import snapshot
+            payload["metrics"] = snapshot()
+        except Exception:
+            payload["metrics"] = None
+        try:  # lazy: memory census may touch jax
+            from .. import memory as _memory
+            payload["memory"] = _memory.census()
+            payload["module_peaks"] = _memory.last_attribution()
+        except Exception:
+            payload["memory"] = None
+            payload["module_peaks"] = None
+        if extra:
+            payload["extra"] = extra
+        return payload
+
+
+def _json_safe(o):
+    try:
+        f = float(o)
+        return f if f == f and f not in (float("inf"), float("-inf")) \
+            else repr(f)
+    except Exception:
+        return repr(o)
+
+
+def _finite(o):
+    """Recursively replace non-finite floats with their repr strings so the
+    dump is strict RFC-8259 JSON (json.dump would otherwise emit bare
+    ``NaN``/``Infinity`` tokens)."""
+    if isinstance(o, float):
+        if o != o or o in (float("inf"), float("-inf")):
+            return repr(o)
+        return o
+    if isinstance(o, dict):
+        return {k: _finite(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_finite(v) for v in o]
+    return o
+
+
+def _fingerprint() -> dict:
+    """Env/config fingerprint: enough to answer "what exactly was this
+    process" from the dump alone, small enough to always include."""
+    import platform
+    keep = {}
+    for k in sorted(os.environ):
+        if k.startswith(("PADDLE_TPU_", "JAX_", "XLA_", "PALLAS_")):
+            keep[k] = os.environ[k]
+    return {
+        "argv": list(sys.argv),
+        "pid": os.getpid(),
+        "cwd": os.getcwd(),
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "env": keep,
+    }
+
+
+# ---------------------------------------------------------------------------
+# process-wide default recorder + module-level API
+# ---------------------------------------------------------------------------
+
+_default = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    """The process-wide recorder all framework instrumentation feeds."""
+    return _default
+
+
+def record(kind: str, **fields) -> None:
+    _default.record(kind, **fields)
+
+
+def events(last: int | None = None) -> list:
+    return _default.events(last)
+
+
+def clear() -> None:
+    _default.clear()
+
+
+def enabled() -> bool:
+    """True while the recorder collects events (``PADDLE_TPU_FLIGHT`` env,
+    overridable via :func:`enable`). Hot call sites guard on this so a
+    disabled recorder costs nothing — not even the kwargs dict."""
+    return _default.enabled
+
+
+def enable(flag: bool = True) -> bool:
+    _default.enabled = bool(flag)
+    return _default.enabled
+
+
+def set_dump_dir(path: str) -> None:
+    """Where abnormal-death dumps land (CheckpointManager points this at
+    its root, so the black box sits next to the checkpoints)."""
+    _default.dump_dir = os.fspath(path)
+
+
+def get_dump_dir() -> str | None:
+    return _default.dump_dir
+
+
+def dump(reason: str, step: int | None = None, path: str | None = None,
+         extra: dict | None = None, last: int | None = None,
+         dump_dir: str | None = None) -> str | None:
+    return _default.dump(reason, step=step, path=path, extra=extra,
+                         last=last, dump_dir=dump_dir)
+
+
+def last_dump_path() -> str | None:
+    return _default.last_dump_path
+
+
+# ---------------------------------------------------------------------------
+# unhandled-exception hook (chained, idempotent)
+# ---------------------------------------------------------------------------
+
+_prev_excepthook = None
+
+
+def install_excepthook() -> None:
+    """Chain a dump-on-unhandled-exception hook into ``sys.excepthook``.
+    Idempotent; the previous hook always runs afterwards, so tracebacks
+    print exactly as before. SystemExit/KeyboardInterrupt never reach
+    excepthook, so normal exits and the preemption path (which dumps
+    itself) are unaffected."""
+    global _prev_excepthook
+    if _prev_excepthook is not None:
+        return
+    _prev_excepthook = sys.excepthook
+
+    def _hook(etype, evalue, tb):
+        try:
+            _default.record("exception", type=getattr(etype, "__name__",
+                                                      str(etype)),
+                            message=str(evalue)[:500])
+            _default.dump(reason="unhandled_exception")
+        except Exception:
+            pass
+        (_prev_excepthook or sys.__excepthook__)(etype, evalue, tb)
+
+    sys.excepthook = _hook
+
+
+def uninstall_excepthook() -> None:
+    global _prev_excepthook
+    if _prev_excepthook is not None:
+        sys.excepthook = _prev_excepthook
+        _prev_excepthook = None
+
+
+# ---------------------------------------------------------------------------
+# dump reader + renderers (the CLI side; cold path, imports numpy-free)
+# ---------------------------------------------------------------------------
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _fmt_bytes(n) -> str:
+    from ..memory import format_bytes
+    return format_bytes(n)
+
+
+def _fmt_event(e, t0) -> str:
+    rest = {k: v for k, v in e.items() if k not in ("seq", "t", "kind")}
+    body = " ".join(f"{k}={v}" for k, v in sorted(rest.items()))
+    return f"  +{e.get('t', t0) - t0:10.3f}s  #{e.get('seq', '?'):>6}  " \
+           f"{e.get('kind', '?'):<18} {body}"
+
+
+def render(payload: dict, last: int = 25) -> str:
+    """Human-readable view of a flight dump: header, top memory owners,
+    per-module peaks, and the final events before death."""
+    out = []
+    evs = payload.get("events") or []
+    t0 = evs[0]["t"] if evs else payload.get("time", 0.0)
+    out.append("=" * 72)
+    out.append(f"FLIGHT DUMP  reason={payload.get('reason')}  "
+               f"step={payload.get('step')}  events={len(evs)}  "
+               f"schema={payload.get('schema')}")
+    fp = payload.get("fingerprint") or {}
+    out.append(f"  argv: {' '.join(fp.get('argv', []))}")
+    faults = (fp.get("env") or {}).get("PADDLE_TPU_FAULTS")
+    if faults:
+        out.append(f"  PADDLE_TPU_FAULTS: {faults}")
+    out.append("=" * 72)
+
+    mem = payload.get("memory") or {}
+    dev = mem.get("device") or {}
+    if dev:
+        out.append("\n-- device memory " + "-" * 40)
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in dev:
+                out.append(f"  {k:<20} {_fmt_bytes(dev[k])}")
+    live = mem.get("live_arrays") or {}
+    rows = live.get("by_dtype_shape") or []
+    if rows:
+        out.append("\n-- top live arrays (by dtype/shape) " + "-" * 24)
+        out.append(f"  {'dtype':<10} {'shape':<24} {'count':>6} {'bytes':>12}")
+        for r in rows[:12]:
+            out.append(f"  {r.get('dtype', '?'):<10} "
+                       f"{str(r.get('shape', '?')):<24} "
+                       f"{r.get('count', 0):>6} "
+                       f"{_fmt_bytes(r.get('bytes', 0)):>12}")
+        out.append(f"  total: {_fmt_bytes(live.get('total_bytes', 0))} in "
+                   f"{live.get('count', 0)} arrays")
+
+    peaks = payload.get("module_peaks") or {}
+    if peaks:
+        out.append("\n-- per-module peak HBM attribution " + "-" * 25)
+        out.append(f"  {'module':<40} {'calls':>5} {'peak delta':>12} "
+                   f"{'peak bytes':>12}")
+        items = sorted(peaks.items(),
+                       key=lambda kv: -kv[1].get("peak_delta_bytes", 0))
+        for name, st in items[:20]:
+            out.append(f"  {name:<40} {st.get('calls', 0):>5} "
+                       f"{_fmt_bytes(st.get('peak_delta_bytes', 0)):>12} "
+                       f"{_fmt_bytes(st.get('peak_bytes', 0)):>12}")
+
+    if evs and last > 0:
+        out.append(f"\n-- final {min(last, len(evs))} events before death "
+                   + "-" * 30)
+        for e in evs[-last:]:
+            out.append(_fmt_event(e, t0))
+    out.append("=" * 72)
+    return "\n".join(out)
+
+
+def to_chrome_trace(payload: dict) -> dict:
+    """Chrome-trace (``chrome://tracing`` / Perfetto) conversion of the
+    event tape: ``span_close`` events (which carry ``dur``) become complete
+    ``ph="X"`` slices; everything else becomes an instant event. The
+    metrics snapshot rides along under ``"telemetry"``, matching
+    ``Profiler.export``'s merged form."""
+    evs = payload.get("events") or []
+    t0 = evs[0]["t"] if evs else 0.0
+    pid = (payload.get("fingerprint") or {}).get("pid", 0)
+    # pair span_open/span_close by name in tape order; opens the process
+    # died inside (no matching close — the most interesting spans) must
+    # still appear in the trace, as begin events
+    open_stacks: dict = {}
+    closed_opens = set()
+    for e in evs:
+        if e.get("kind") == "span_open":
+            open_stacks.setdefault(e.get("name"), []).append(e.get("seq"))
+        elif e.get("kind") == "span_close":
+            stack = open_stacks.get(e.get("name"))
+            if stack:
+                closed_opens.add(stack.pop())
+    trace_events = []
+    for e in evs:
+        ts_us = (e.get("t", t0) - t0) * 1e6
+        name = e.get("name") or e.get("fn") or e.get("op") or \
+            e.get("kind", "event")
+        args = {k: v for k, v in e.items() if k not in ("t",)}
+        if e.get("kind") == "span_close" and "dur" in e:
+            dur_us = float(e["dur"]) * 1e6
+            trace_events.append({"name": name, "ph": "X", "cat": "flight",
+                                 "ts": ts_us - dur_us, "dur": dur_us,
+                                 "pid": pid, "tid": 0, "args": args})
+        elif e.get("kind") == "span_open":
+            if e.get("seq") in closed_opens:
+                continue  # its close slice already covers the interval
+            trace_events.append({"name": name, "ph": "B", "cat": "flight",
+                                 "ts": ts_us, "pid": pid, "tid": 0,
+                                 "args": args})
+        else:
+            trace_events.append({"name": f"{e.get('kind')}:{name}", "ph": "i",
+                                 "cat": "flight", "ts": ts_us, "pid": pid,
+                                 "tid": 0, "s": "p", "args": args})
+    out = {"traceEvents": trace_events,
+           "flight": {k: payload.get(k) for k in
+                      ("schema", "reason", "step", "time")}}
+    if payload.get("metrics"):
+        out["telemetry"] = payload["metrics"]
+    return out
+
+
+def main(argv=None) -> int:
+    """``python -m paddle_tpu.observability.flight <dump.json>``"""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.flight",
+        description="Render a flight-recorder dump: timeline, top memory "
+                    "owners, final events before death.")
+    ap.add_argument("dump", help="path to a flight_<step>.json dump")
+    ap.add_argument("--last", type=int, default=25,
+                    help="how many trailing events to show (default 25)")
+    ap.add_argument("--chrome-trace", metavar="OUT",
+                    help="also write a Chrome-trace JSON conversion")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw payload instead of the rendering")
+    args = ap.parse_args(argv)
+    try:
+        payload = load_dump(args.dump)
+    except (OSError, ValueError) as e:
+        print(f"cannot read flight dump {args.dump!r}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(payload, indent=2))
+    else:
+        print(render(payload, last=args.last))
+    if args.chrome_trace:
+        with open(args.chrome_trace, "w") as f:
+            json.dump(to_chrome_trace(payload), f)
+        print(f"\nchrome trace written to {args.chrome_trace} "
+              f"(open in chrome://tracing or Perfetto)")
+    return 0

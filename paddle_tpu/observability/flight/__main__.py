@@ -1,0 +1,10 @@
+"""``python -m paddle_tpu.observability.flight <dump.json>`` entry point
+(a real ``__main__`` submodule so runpy never re-executes the already-
+imported recorder module)."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

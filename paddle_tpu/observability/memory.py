@@ -1,0 +1,252 @@
+"""HBM memory profiler: device-stats census, live-array census, and
+per-module peak attribution.
+
+Answers the question the fused-XLA/GSPMD execution model makes
+unanswerable from logs: *which arrays — and which ``nn.Layer`` — own the
+HBM that ran out*. Three tools:
+
+* :func:`census` — one shot: ``device.memory_stats()`` for every device
+  plus a ``jax.live_arrays()`` walk aggregated by (dtype, shape), exported
+  as ``paddle_tpu_hbm_bytes{kind=...}`` gauges and returned JSON-safe (the
+  flight recorder embeds it in every dump).
+* :class:`MemorySampler` — periodic census on a step cadence for training
+  loops (one ``maybe_sample(step)`` call per step, a real census every
+  ``every`` steps).
+* :func:`attribute_memory` — a context manager that hooks every sublayer's
+  forward (``register_forward_pre_hook``/``register_forward_post_hook``)
+  and attributes per-module allocation deltas and peaks. Run it around ONE
+  eager forward — under ``to_static`` the whole step is a single fused
+  program and module boundaries don't exist on device. The latest
+  attribution table is kept module-global so flight dumps carry it.
+
+Import-time stdlib-only like the rest of the package; jax is imported
+lazily inside the functions that walk device state.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import metrics as _m
+
+__all__ = ["census", "device_memory_stats", "live_array_census",
+           "MemorySampler", "attribute_memory", "last_attribution",
+           "current_bytes", "format_bytes"]
+
+
+def format_bytes(n) -> str:
+    """Human-readable byte count (shared by the flight CLI and the
+    profiler's summary tables, so both render quantities identically)."""
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{int(n)} B" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024.0
+    return f"{n:.1f} TB"
+
+_G_HBM = _m.gauge(
+    "paddle_tpu_hbm_bytes",
+    "device memory bytes by kind (in_use|peak|limit|live_arrays)")
+_G_LIVE = _m.gauge(
+    "paddle_tpu_hbm_live_arrays",
+    "count of live device arrays at the last census")
+_C_CENSUS = _m.counter(
+    "paddle_tpu_hbm_census_total", "memory censuses taken")
+
+
+def device_memory_stats(device=None) -> dict:
+    """``memory_stats()`` of one device (default: device 0), ``{}`` when
+    the backend exposes none (XLA:CPU)."""
+    try:
+        import jax
+        d = jax.devices()[0] if device is None else device
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def live_array_census(top: int = 20) -> dict:
+    """Aggregate ``jax.live_arrays()`` by (dtype, shape): the owner-level
+    view of what is actually resident. Returns ``{"count", "total_bytes",
+    "by_dtype_shape": [{"dtype", "shape", "count", "bytes"}, ...]}`` with
+    rows sorted by bytes descending, trimmed to ``top``."""
+    try:
+        import jax
+        arrs = jax.live_arrays()
+    except Exception:
+        return {"count": 0, "total_bytes": 0, "by_dtype_shape": []}
+    agg: dict = {}
+    total = 0
+    for a in arrs:
+        try:
+            nbytes = int(a.nbytes)
+            key = (str(a.dtype), tuple(a.shape))
+        except Exception:
+            continue
+        total += nbytes
+        row = agg.get(key)
+        if row is None:
+            agg[key] = [1, nbytes]
+        else:
+            row[0] += 1
+            row[1] += nbytes
+    rows = [{"dtype": k[0], "shape": list(k[1]), "count": v[0],
+             "bytes": v[1]} for k, v in agg.items()]
+    rows.sort(key=lambda r: (-r["bytes"], r["dtype"], r["shape"]))
+    return {"count": len(arrs), "total_bytes": total,
+            "by_dtype_shape": rows[:top]}
+
+
+def current_bytes() -> int:
+    """Best available 'bytes resident now': allocator ``bytes_in_use``
+    where the backend reports it, else the live-array total (XLA:CPU) —
+    the probe :func:`attribute_memory` diffs around each forward."""
+    stats = device_memory_stats()
+    b = int(stats.get("bytes_in_use", 0))
+    if b:
+        return b
+    return live_array_census(top=0)["total_bytes"]
+
+
+def census(top: int = 20) -> dict:
+    """Full memory census: device stats + live-array aggregation, exported
+    to the ``paddle_tpu_hbm_bytes{kind=...}`` gauges and returned."""
+    stats = device_memory_stats()
+    live = live_array_census(top=top)
+    _C_CENSUS.inc()
+    if stats.get("bytes_in_use") is not None:
+        _G_HBM.set(int(stats["bytes_in_use"]), kind="in_use")
+    if stats.get("peak_bytes_in_use") is not None:
+        _G_HBM.set(int(stats["peak_bytes_in_use"]), kind="peak")
+    if stats.get("bytes_limit") is not None:
+        _G_HBM.set(int(stats["bytes_limit"]), kind="limit")
+    _G_HBM.set(live["total_bytes"], kind="live_arrays")
+    _G_LIVE.set(live["count"])
+    return {"device": {k: int(v) for k, v in stats.items()
+                       if isinstance(v, (int, float))},
+            "live_arrays": live}
+
+
+class MemorySampler:
+    """Step-cadence census for training loops::
+
+        sampler = MemorySampler(every=50)
+        for step in ...:
+            ...
+            sampler.maybe_sample(step)
+
+    Off-cadence calls cost one modulo; on cadence one :func:`census`
+    (a live-array walk — keep ``every`` large on huge graphs)."""
+
+    def __init__(self, every: int = 50, top: int = 20):
+        if every < 1:
+            raise ValueError("every must be >= 1")
+        self.every = every
+        self.top = top
+        self.last: dict | None = None
+
+    def maybe_sample(self, step: int) -> dict | None:
+        if step % self.every:
+            return None
+        self.last = census(top=self.top)
+        return self.last
+
+    def sample(self) -> dict:
+        self.last = census(top=self.top)
+        return self.last
+
+
+# ---------------------------------------------------------------------------
+# per-module attribution
+# ---------------------------------------------------------------------------
+
+_attr_lock = threading.Lock()
+_last_attribution: dict = {}
+
+
+def last_attribution() -> dict:
+    """The most recent :func:`attribute_memory` table (flight dumps embed
+    this): ``{module_path: {"calls", "last_delta_bytes",
+    "peak_delta_bytes", "peak_bytes"}}``."""
+    with _attr_lock:
+        return {k: dict(v) for k, v in _last_attribution.items()}
+
+
+class attribute_memory:
+    """Attribute allocation deltas to the ``nn.Layer`` that made them::
+
+        with attribute_memory(model) as attr:
+            model(x)                      # ONE eager forward
+        attr.peaks                        # {path: {...bytes stats...}}
+        print(attr.table())
+
+    Each sublayer gets a forward pre-hook (record bytes-resident on entry)
+    and post-hook (delta on exit). ``peak_delta_bytes`` is the largest
+    single-call delta per module; ``peak_bytes`` the highest absolute
+    level seen at any of its boundaries. Nested modules both observe an
+    allocation made by the inner one — read the table leaf-first.
+
+    Hooks are removed on exit and the table is published to
+    :func:`last_attribution` so a later crash dump still carries it.
+    """
+
+    def __init__(self, model, probe=None):
+        self.model = model
+        self.peaks: dict = {}
+        self._probe = probe or current_bytes
+        self._handles: list = []
+        self._entry: dict = {}
+
+    def _path_of(self, prefix, layer):
+        return prefix or layer.__class__.__name__
+
+    def __enter__(self):
+        named = [("", self.model)]
+        try:
+            named += list(self.model.named_sublayers())
+        except Exception:
+            pass
+        for prefix, layer in named:
+            path = self._path_of(prefix, layer)
+
+            def pre(layer_, inputs, _path=path):
+                self._entry.setdefault(_path, []).append(self._probe())
+
+            def post(layer_, inputs, out, _path=path):
+                stack = self._entry.get(_path)
+                before = stack.pop() if stack else 0
+                now = self._probe()
+                st = self.peaks.setdefault(_path, {
+                    "calls": 0, "last_delta_bytes": 0,
+                    "peak_delta_bytes": 0, "peak_bytes": 0})
+                delta = now - before
+                st["calls"] += 1
+                st["last_delta_bytes"] = delta
+                st["peak_delta_bytes"] = max(st["peak_delta_bytes"], delta)
+                st["peak_bytes"] = max(st["peak_bytes"], now, before)
+
+            self._handles.append(layer.register_forward_pre_hook(pre))
+            self._handles.append(layer.register_forward_post_hook(post))
+        return self
+
+    def __exit__(self, *exc):
+        for h in self._handles:
+            try:
+                h.remove()
+            except Exception:
+                pass
+        self._handles.clear()
+        global _last_attribution
+        with _attr_lock:
+            _last_attribution = {k: dict(v) for k, v in self.peaks.items()}
+        return False
+
+    def table(self, top: int = 20) -> str:
+        rows = sorted(self.peaks.items(),
+                      key=lambda kv: -kv[1]["peak_delta_bytes"])[:top]
+        out = [f"{'module':<40} {'calls':>5} {'peak delta':>14} "
+               f"{'peak bytes':>14}"]
+        for name, st in rows:
+            out.append(f"{name:<40} {st['calls']:>5} "
+                       f"{st['peak_delta_bytes']:>14} {st['peak_bytes']:>14}")
+        return "\n".join(out)

@@ -17,6 +17,13 @@ so instrumentation can stay in hot paths unconditionally.
 Thread safety: each metric owns one lock protecting its label->value table;
 registries own a lock for get-or-create. Reads used by exporters copy under
 the same lock.
+
+Cardinality guard: a per-metric series cap (``PADDLE_TPU_METRICS_MAX_SERIES``,
+default 256) bounds the label table — per-qualname retrace counters and
+per-span counters cannot grow without limit on pathological workloads.
+Once a metric is at cap, samples for NEW label sets fold into a single
+``overflow="true"`` sink series (existing series keep recording exactly),
+and a one-time warning names the metric.
 """
 
 from __future__ import annotations
@@ -24,16 +31,31 @@ from __future__ import annotations
 import os
 import re
 import threading
+import warnings
 from bisect import bisect_left
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
+    "DEFAULT_MAX_SERIES", "OVERFLOW_KEY",
     "get_registry", "counter", "gauge", "histogram",
     "enabled", "enable", "value", "total", "reset",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: label set every over-cap sample folds into
+OVERFLOW_KEY = (("overflow", "true"),)
+
+DEFAULT_MAX_SERIES = 256
+
+
+def _env_max_series() -> int:
+    try:
+        return max(int(os.environ.get("PADDLE_TPU_METRICS_MAX_SERIES",
+                                      DEFAULT_MAX_SERIES)), 1)
+    except ValueError:
+        return DEFAULT_MAX_SERIES
 
 
 def _env_enabled() -> bool:
@@ -69,6 +91,18 @@ def _label_key(labels: dict) -> tuple:
     return tuple(sorted(labels.items()))
 
 
+def _mutation_key(labels: dict) -> tuple:
+    """Label key for WRITE paths: the ``overflow`` label is reserved for
+    the cardinality-guard sink — user data recorded under it would mix
+    indistinguishably with folded over-cap spill. Reads (``value()``)
+    stay permitted so the sink is queryable."""
+    if labels and "overflow" in labels:
+        raise ValueError(
+            "label name 'overflow' is reserved for the cardinality-guard "
+            "sink series")
+    return _label_key(labels)
+
+
 class MetricBase:
     """Shared storage: a lock-guarded ``{sorted-label-tuple: value}`` table."""
 
@@ -81,6 +115,25 @@ class MetricBase:
         self.help = help
         self._lock = threading.Lock()
         self._values: dict = {}
+        self.max_series = _env_max_series()
+        self._overflowed = False
+
+    def _slot(self, key: tuple) -> tuple:
+        """Cardinality guard; call under ``self._lock``. Existing series
+        and under-cap inserts pass through; a NEW label set on a metric at
+        cap folds into :data:`OVERFLOW_KEY` (the sink series itself is
+        exempt from the cap, so the spill is never dropped)."""
+        if key in self._values or len(self._values) < self.max_series \
+                or key == OVERFLOW_KEY:
+            return key
+        if not self._overflowed:
+            self._overflowed = True
+            warnings.warn(
+                f"metric {self.name!r} hit its label-cardinality cap "
+                f"({self.max_series} series; PADDLE_TPU_METRICS_MAX_SERIES); "
+                f'new label sets now fold into the overflow="true" series',
+                RuntimeWarning, stacklevel=3)
+        return OVERFLOW_KEY
 
     def clear(self):
         with self._lock:
@@ -115,8 +168,9 @@ class Counter(MetricBase):
             return
         if value < 0:
             raise ValueError("counters only go up; use a Gauge")
-        key = _label_key(labels)
+        key = _mutation_key(labels)
         with self._lock:
+            key = self._slot(key)
             self._values[key] = self._values.get(key, 0) + value
 
     def value(self, /, **labels):
@@ -133,15 +187,17 @@ class Gauge(MetricBase):
     def set(self, value: float, /, **labels):
         if not _state.enabled:
             return
-        key = _label_key(labels)
+        key = _mutation_key(labels)
         with self._lock:
+            key = self._slot(key)
             self._values[key] = value
 
     def inc(self, value: float = 1, /, **labels):
         if not _state.enabled:
             return
-        key = _label_key(labels)
+        key = _mutation_key(labels)
         with self._lock:
+            key = self._slot(key)
             self._values[key] = self._values.get(key, 0) + value
 
     def dec(self, value: float = 1, /, **labels):
@@ -174,8 +230,9 @@ class Histogram(MetricBase):
     def observe(self, value: float, /, **labels):
         if not _state.enabled:
             return
-        key = _label_key(labels)
+        key = _mutation_key(labels)
         with self._lock:
+            key = self._slot(key)
             row = self._values.get(key)
             if row is None:
                 row = self._values[key] = [

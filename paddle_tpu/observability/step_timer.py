@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import time
 
+from . import flight as _flight
 from . import metrics as _m
 
 __all__ = ["StepTimer", "device_peak_flops", "analytic_mfu",
@@ -129,6 +130,10 @@ class StepTimer:
         self._h_step.observe(step_s, name=self.name)
         self._c_steps.inc(steps, name=self.name)
         stats = {"step_seconds": step_s, "steps": steps}
+        if _flight.enabled():  # one event per step/window: the black box's
+            # step-timing heartbeat
+            _flight.record("step", name=self.name, steps=steps,
+                           step_seconds=round(step_s, 6))
         if tokens and seconds > 0:
             self.tokens_per_sec = tokens / seconds
             self._g_tps.set(self.tokens_per_sec, name=self.name)

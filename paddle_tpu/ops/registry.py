@@ -24,7 +24,7 @@ from dataclasses import asdict, dataclass
 class ApiRecord:
     name: str          # dotted public path, e.g. "paddle.matmul"
     kind: str          # "op" | "layer" | "functional" | "jit" |
-                       # "analysis" | "resilience"
+                       # "analysis" | "resilience" | "observability"
     signature: str
 
     def key(self):
@@ -55,6 +55,9 @@ def _surface_cached() -> tuple:
     import paddle_tpu.jit as jit
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
+    import paddle_tpu.observability as observability
+    import paddle_tpu.observability.flight as obs_flight
+    import paddle_tpu.observability.memory as obs_memory
     import paddle_tpu.resilience as resilience
     import paddle_tpu.resilience.faults as res_faults
 
@@ -79,6 +82,17 @@ def _surface_cached() -> tuple:
     _collect(resilience, "paddle.resilience", "resilience", records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     _collect(res_faults, "paddle.resilience.faults", "resilience", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    # observability: the telemetry registry, flight recorder and memory
+    # profiler are debugging contracts — dashboards and postmortem tooling
+    # parse their output, so their surfaces must hold like ops do
+    _collect(observability, "paddle.observability", "observability", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    _collect(obs_flight, "paddle.observability.flight", "observability",
+             records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    _collect(obs_memory, "paddle.observability.memory", "observability",
+             records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     return tuple(sorted(records, key=lambda r: r.name))
 

@@ -21,6 +21,7 @@ import time
 from enum import Enum
 
 from ..observability import counter as _obs_counter
+from ..observability import flight as _flight
 
 __all__ = ["ProfilerState", "ProfilerTarget", "SummaryView", "make_scheduler",
            "export_chrome_tracing", "export_protobuf", "Profiler",
@@ -137,6 +138,8 @@ class RecordEvent:
 
     def begin(self):
         self._begin = time.perf_counter()
+        if _flight.enabled():
+            _flight.record("span_open", name=self.name)
         try:
             import jax
             self._jax_ann = jax.profiler.TraceAnnotation(self.name)
@@ -151,6 +154,9 @@ class RecordEvent:
         if self._begin is None:
             return
         _OBS_SPANS.inc(name=self.name)
+        if _flight.enabled():
+            _flight.record("span_close", name=self.name,
+                           dur=round(time.perf_counter() - self._begin, 6))
         prof = _active_profiler
         if prof is not None and prof._recording():
             prof._events.append(
@@ -276,6 +282,7 @@ class Profiler:
         self._op_times: dict[str, list] = {}
         self._program_times: dict[str, list] = {}
         self._mem_samples: list[tuple[int, int]] = []
+        self._mem_census: dict | None = None
         self._step_times: list[float] = []
         self._op_detail = True
         self._inner_accum = 0.0
@@ -305,6 +312,13 @@ class Profiler:
                 self._recorded_wall += \
                     time.perf_counter() - self._record_start_t
                 self._record_start_t = None
+            # one full census per window close (a live-array walk is too
+            # heavy per step; the per-step samples above stay shallow)
+            try:
+                from ..observability import memory as _obs_memory
+                self._mem_census = _obs_memory.census(top=15)
+            except Exception:
+                pass
             self._stop_device_trace()
             if self._on_trace_ready is not None:
                 self._on_trace_ready(self)
@@ -424,10 +438,17 @@ class Profiler:
         wall = self._recorded_wall
         if self._record_start_t is not None:
             wall += time.perf_counter() - self._record_start_t
+        try:
+            from ..observability import memory as _obs_memory
+            module_peaks = _obs_memory.last_attribution()
+        except Exception:
+            module_peaks = None
         txt = build_summary(self._events, self._op_counts, self._step_times,
                             op_times=self._op_times,
                             program_times=self._program_times,
                             mem_samples=self._mem_samples,
+                            mem_census=self._mem_census,
+                            module_peaks=module_peaks,
                             recorded_wall=wall,
                             sorted_by=sorted_by, op_detail=op_detail,
                             time_unit=time_unit, views=views)

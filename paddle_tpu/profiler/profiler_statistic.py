@@ -53,12 +53,8 @@ def _table(headers, rows, title):
 
 
 def _fmt_bytes(n):
-    n = float(n)
-    for unit in ("B", "KB", "MB", "GB", "TB"):
-        if abs(n) < 1024 or unit == "TB":
-            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
-        n /= 1024.0
-    return f"{n:.1f} TB"
+    from ..observability.memory import format_bytes
+    return format_bytes(n)
 
 
 def _sort_key(sorted_by):
@@ -93,10 +89,17 @@ def _agg_rows(agg, mul, total_base, with_bytes=False, sorted_by=None,
 
 
 def build_summary(events, op_counts, step_times, op_times=None,
-                  program_times=None, mem_samples=None, recorded_wall=0.0,
+                  program_times=None, mem_samples=None, mem_census=None,
+                  module_peaks=None, recorded_wall=0.0,
                   sorted_by=None, op_detail=True, time_unit="ms",
                   views=None):
-    """The reference's summary view set, in its section order."""
+    """The reference's summary view set, in its section order.
+
+    ``mem_census`` is an ``observability.memory.census()`` dict (device
+    stats + live-array aggregation by dtype/shape) taken at window close;
+    ``module_peaks`` the latest ``attribute_memory`` table — together they
+    make the Memory view a real owner-level table rather than a shallow
+    allocated/reserved pair."""
     mul = _UNIT.get(time_unit, 1e3)
     op_times = op_times or {}
     program_times = program_times or {}
@@ -203,6 +206,33 @@ def build_summary(events, op_counts, step_times, op_times=None,
              ["last", _fmt_bytes(alloc[-1]), _fmt_bytes(resv[-1])],
              ["samples", len(alloc), len(resv)]],
             "Memory Summary (per-step device samples)"))
+
+    # ---- Memory View: live-array census (owner-level, window close) -------
+    live = (mem_census or {}).get("live_arrays") or {}
+    rows = live.get("by_dtype_shape") or []
+    if rows:
+        parts.append(_table(
+            ["Dtype", "Shape", "Count", "Bytes", "Ratio"],
+            [[r.get("dtype", "?"), str(r.get("shape", "?")),
+              r.get("count", 0), _fmt_bytes(r.get("bytes", 0)),
+              f"{100.0 * r.get('bytes', 0) / live['total_bytes']:.2f}%"
+              if live.get("total_bytes") else "-"]
+             for r in rows],
+            f"Memory Summary (live-array census: "
+            f"{live.get('count', 0)} arrays, "
+            f"{_fmt_bytes(live.get('total_bytes', 0))} total)"))
+
+    # ---- Memory View: per-module peaks (attribute_memory) -----------------
+    if module_peaks:
+        items = sorted(module_peaks.items(),
+                       key=lambda kv: -kv[1].get("peak_delta_bytes", 0))[:30]
+        parts.append(_table(
+            ["Module", "Calls", "Peak Delta", "Peak Bytes"],
+            [[name, st.get("calls", 0),
+              _fmt_bytes(st.get("peak_delta_bytes", 0)),
+              _fmt_bytes(st.get("peak_bytes", 0))] for name, st in items],
+            "Memory Summary (per-module peaks, "
+            "observability.memory.attribute_memory)"))
 
     # ---- UserDefined Summary (RecordEvent spans) --------------------------
     if events:

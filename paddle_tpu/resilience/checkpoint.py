@@ -42,6 +42,7 @@ import time
 from ..framework.io import _fsync_dir
 from ..observability import (counter as _obs_counter, gauge as _obs_gauge,
                              histogram as _obs_histogram)
+from ..observability import flight as _flight
 from . import faults as _faults
 
 __all__ = ["CheckpointManager", "CheckpointNotFoundError"]
@@ -128,6 +129,14 @@ class CheckpointManager:
         self.async_save = async_save
         self.prefix = prefix
         os.makedirs(self.root, exist_ok=True)
+        # a CheckpointManager marks a managed training run: point the
+        # flight recorder's DEFAULT dump dir at the checkpoint dir (for the
+        # excepthook path, which has no owning manager; last-constructed
+        # manager wins) and arm the unhandled-exception hook (idempotent,
+        # chained). Manager-owned death paths (save errors, NaN rewinds,
+        # preemption) pass their own root explicitly instead.
+        _flight.set_dump_dir(self.root)
+        _flight.install_excepthook()
         self._io_lock = threading.Lock()   # serializes commits + retention
         self._inflight: threading.Thread | None = None
         self._last_error: BaseException | None = None
@@ -234,13 +243,23 @@ class CheckpointManager:
                 _atomic_write(self._manifest_path(step),
                               json.dumps(manifest).encode())
                 self._retain_locked()
-        except BaseException:
+        except BaseException as e:
             _OBS_SAVES.inc(status="error")
+            # a failed commit is abnormal-death territory (the training
+            # loop may be about to crash on it): record AND dump now,
+            # while the events leading here still exist
+            _flight.record("checkpoint_save", step=int(step), status="error",
+                           error=repr(e)[:200])
+            _flight.dump(reason="checkpoint_save_error", step=int(step),
+                         dump_dir=self.root)
             raise
         self._last_error = None
         _OBS_SAVES.inc(status="ok")
         _OBS_SAVE_SECONDS.observe(time.perf_counter() - t0)
         _OBS_LAST_STEP.set(step)
+        _flight.record("checkpoint_save", step=int(step), status="ok",
+                       bytes=len(blob),
+                       seconds=round(time.perf_counter() - t0, 4))
 
     def _retain_locked(self):
         for step in self.all_steps()[:-self.keep_n]:
@@ -303,6 +322,8 @@ class CheckpointManager:
             _OBS_RESTORES.inc()
             if fallbacks:
                 _OBS_FALLBACKS.inc(fallbacks)
+            _flight.record("checkpoint_restore", step=int(payload["step"]),
+                           fallbacks=fallbacks)
             return payload["step"]
         if required:
             raise CheckpointNotFoundError(

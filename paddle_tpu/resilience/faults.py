@@ -44,6 +44,7 @@ import threading
 import time
 
 from ..observability import counter as _obs_counter
+from ..observability import flight as _flight
 
 __all__ = ["FaultSpec", "FaultInjector", "install", "uninstall", "inject",
            "get_active", "on_save_write", "on_train_step", "on_worker_fetch",
@@ -141,6 +142,7 @@ class FaultInjector:
         c = self._match_event("save_io")
         if c is not None:
             _OBS_INJECTED.inc(kind="save_io")
+            _flight.record("fault_injected", fault="save_io", at=c.at)
             raise InjectedIOError(
                 f"injected IO error during save ({path or 'checkpoint'})")
 
@@ -150,10 +152,12 @@ class FaultInjector:
         c = self._match_step("sigterm", step)
         if c is not None:
             _OBS_INJECTED.inc(kind="sigterm")
+            _flight.record("fault_injected", fault="sigterm", step=step)
             signal.raise_signal(signal.SIGTERM)
         c = self._match_step("nan", step)
         if c is not None:
             _OBS_INJECTED.inc(kind="nan")
+            _flight.record("fault_injected", fault="nan", step=step)
             return True
         return False
 
@@ -164,10 +168,15 @@ class FaultInjector:
         c = self._match_event("worker_slow")
         if c is not None:
             _OBS_INJECTED.inc(kind="worker_slow")
+            _flight.record("fault_injected", fault="worker_slow", at=c.at)
             time.sleep(c.param if c.param is not None else 5.0)
         c = self._match_event("worker_dead")
         if c is not None:
             _OBS_INJECTED.inc(kind="worker_dead")
+            # recorded for symmetry, but this lands on the WORKER's ring
+            # and dies with os._exit — the durable signal is the consumer
+            # side's worker_dead event (WorkerDiedError, exit code 3)
+            _flight.record("fault_injected", fault="worker_dead", at=c.at)
             os._exit(3)
 
 

@@ -29,6 +29,7 @@ import threading
 import time
 
 from ..observability import counter as _obs_counter, histogram as _obs_histogram
+from ..observability import flight as _flight
 
 __all__ = ["PreemptionHandler", "TrainingPreempted"]
 
@@ -111,6 +112,9 @@ class PreemptionHandler:
             self._source = source
             self._preempted.set()
             _OBS_PREEMPTIONS.inc(source=source)
+            # flight.record is signal-safe by construction (no locks);
+            # this may run inside the SIGTERM handler
+            _flight.record("preempt", source=source)
 
     @property
     def preempted(self) -> bool:
@@ -146,4 +150,12 @@ class PreemptionHandler:
         code = self.exit_code
         if code is None:
             code = 130 if self._source == "sigint" else 143
+        # the black box: final checkpoint is committed, so the tape up to
+        # here IS the full story of this incarnation — dump it next to the
+        # checkpoints before exiting
+        _flight.record("preempt_exit", step=int(step), source=self._source,
+                       code=code)
+        _flight.dump(reason=f"preempted_{self._source or 'unknown'}",
+                     step=int(step),
+                     dump_dir=getattr(self.manager, "root", None))
         raise TrainingPreempted(code)

@@ -21,6 +21,7 @@ Telemetry: ``paddle_tpu_resilience_nan_events_total`` (bad windows),
 from __future__ import annotations
 
 from ..observability import counter as _obs_counter
+from ..observability import flight as _flight
 
 __all__ = ["NaNSentinel", "NumericsError"]
 
@@ -118,6 +119,9 @@ class NaNSentinel:
             return None
         _OBS_EVENTS.inc()
         self._bad_windows += 1
+        _flight.record("nan_window", step=int(step),
+                       bad_windows=self._bad_windows,
+                       window=self.check_every)
         # scaler cooperation: if dynamic loss scaling caught (and skipped)
         # those steps, parameters are clean — absorb the window
         scaler_total = self._scaler_inf_total()
@@ -126,22 +130,40 @@ class NaNSentinel:
         if self._bad_windows < self.max_consecutive or \
                 (scaler_handled and self._bad_windows < 2 * self.max_consecutive):
             _OBS_SKIPS.inc()
+            _flight.record("nan_skip", step=int(step),
+                           scaler_handled=scaler_handled)
             return "skip"
         self._bad_windows = 0
         if self.action == "raise":
+            _flight.record("nan_raise", step=int(step))
+            _flight.dump(reason="nan_raise", step=int(step),
+                         dump_dir=getattr(self.manager, "root", None))
             raise NumericsError(
                 f"non-finite loss/grad persisted for {self.max_consecutive} "
                 f"consecutive check windows (step {step})")
         if self.action == "skip":
             _OBS_SKIPS.inc()
+            _flight.record("nan_skip", step=int(step),
+                           scaler_handled=scaler_handled)
             return "skip"
         restored = self.manager.restore(model=model, optimizer=optimizer,
                                         scaler=self.scaler,
                                         lr_scheduler=lr_scheduler)
         if restored is None:
+            # rewind exhaustion: the run is about to die — dump the tape
+            _flight.record("nan_raise", step=int(step), no_checkpoint=True)
+            _flight.dump(reason="nan_rewind_exhausted", step=int(step),
+                         dump_dir=self.manager.root)
             raise NumericsError(
                 f"non-finite loss/grad at step {step} and no checkpoint to "
                 f"rewind to")
         self.restored_step = restored
         _OBS_REWINDS.inc()
+        # near-death forensics: the run survives via rewind, but the tape
+        # up to the blow-up is exactly what a postmortem needs — snapshot
+        # it now, before replay overwrites the ring
+        _flight.record("nan_rewind", step=int(step),
+                       restored_step=int(restored))
+        _flight.dump(reason="nan_rewind", step=int(step),
+                     dump_dir=self.manager.root)
         return "rewind"

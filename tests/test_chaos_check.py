@@ -1,5 +1,6 @@
 """tools/chaos_check.py is the CI chaos gate: every injected-fault profile
-must recover bit-identically, losing at most one optimizer step."""
+must recover bit-identically, losing at most one optimizer step, AND leave
+a valid flight-recorder dump whose final events match the injected fault."""
 
 import importlib.util
 import os
@@ -27,3 +28,36 @@ def test_chaos_gate_fails_without_recovery(tmp_path):
     bad = {k: v + 1.0 for k, v in ref.items()}
     assert not cc._same(bad, ref)
     assert cc._same(dict(ref), ref)
+
+
+def test_flight_dump_validator_gates(tmp_path):
+    """The black-box half must gate too: missing dump, wrong reason, wrong
+    final events, and schema-invalid payloads are all failures; a matching
+    dump passes."""
+    import json
+    cc = _load()
+    assert "no flight dump" in cc._validate_flight_dump(
+        str(tmp_path), "nan_rewind", ["nan_window"])
+
+    def write(payload):
+        with open(tmp_path / "flight_9.json", "w") as f:
+            json.dump(payload, f)
+
+    good = {"schema": 1, "reason": "nan_rewind", "time": 1.0,
+            "fingerprint": {"pid": 1},
+            "events": [{"seq": 0, "t": 1.0, "kind": "step"},
+                       {"seq": 1, "t": 2.0, "kind": "nan_window"},
+                       {"seq": 2, "t": 3.0, "kind": "nan_rewind"}]}
+    write(good)
+    assert cc._validate_flight_dump(
+        str(tmp_path), "nan_rewind", ["nan_window", "nan_rewind"]) is None
+    # wrong reason
+    assert "reason" in cc._validate_flight_dump(
+        str(tmp_path), "preempted_sigterm", ["preempt"])
+    # wrong final events (order matters: rewind must come after window)
+    assert cc._validate_flight_dump(
+        str(tmp_path), "nan_rewind", ["nan_rewind", "nan_window"])
+    # schema-invalid
+    write({"reason": "nan_rewind", "events": []})
+    assert "missing required key" in cc._validate_flight_dump(
+        str(tmp_path), "nan_rewind", ["nan_window"])

@@ -1,0 +1,400 @@
+"""Flight recorder + HBM memory profiler: ring-buffer semantics, dump
+schema, excepthook chaining, CLI rendering/Chrome conversion, per-module
+attribution, and the metrics label-cardinality guard."""
+
+import json
+import os
+import sys
+import threading
+import warnings
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import flight, memory
+from paddle_tpu.observability.flight import FlightRecorder
+from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
+                                              OVERFLOW_KEY)
+
+
+# ---------------------------------------------------------------------------
+# recorder core
+# ---------------------------------------------------------------------------
+
+def test_ring_buffer_bounded_and_ordered():
+    rec = FlightRecorder(capacity=16, enabled=True)
+    for i in range(100):
+        rec.record("tick", i=i)
+    evs = rec.events()
+    assert len(evs) == 16 == len(rec)
+    assert [e["i"] for e in evs] == list(range(84, 100))
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+    assert all(e["kind"] == "tick" and "t" in e for e in evs)
+    assert rec.events(last=3) == evs[-3:]
+
+
+def test_record_thread_safety():
+    rec = FlightRecorder(capacity=50000, enabled=True)
+
+    def spin(tid):
+        for i in range(5000):
+            rec.record("spin", tid=tid, i=i)
+
+    threads = [threading.Thread(target=spin, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = rec.events()
+    assert len(evs) == 20000
+    # seq is collision-free across threads
+    assert len({e["seq"] for e in evs}) == 20000
+
+
+def test_disabled_recorder_records_nothing():
+    rec = FlightRecorder(capacity=16, enabled=False)
+    rec.record("tick", i=1)
+    assert rec.events() == []
+    assert rec.dump("why") is None  # disabled = no forensics requested
+
+
+def test_env_gating(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT", "0")
+    assert FlightRecorder().enabled is False
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT", "1")
+    assert FlightRecorder().enabled is True
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_EVENTS", "32")
+    assert FlightRecorder().capacity == 32
+
+
+def test_module_level_api_roundtrip():
+    flight.enable(True)
+    flight.clear()
+    flight.record("unit_test_event", detail="x")
+    assert any(e["kind"] == "unit_test_event" for e in flight.events())
+    flight.clear()
+    assert flight.events() == []
+
+
+# ---------------------------------------------------------------------------
+# dump + fingerprint
+# ---------------------------------------------------------------------------
+
+def test_dump_schema_and_contents(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TEST_MARKER", "yes")
+    rec = FlightRecorder(capacity=64, enabled=True)
+    rec.dump_dir = str(tmp_path)
+    rec.record("step", step=3, loss=1.5)
+    rec.record("nan_window", step=9)
+    path = rec.dump("unit_test", step=9, extra={"note": "hi"})
+    assert path == str(tmp_path / "flight_9.json")
+    assert rec.last_dump_path == path
+    payload = json.loads(open(path).read())
+    assert payload["schema"] == flight.SCHEMA_VERSION
+    assert payload["reason"] == "unit_test"
+    assert payload["step"] == 9
+    assert [e["kind"] for e in payload["events"]] == ["step", "nan_window"]
+    assert payload["extra"] == {"note": "hi"}
+    # metrics snapshot + memory census ride along
+    assert isinstance(payload["metrics"], dict)
+    assert "live_arrays" in (payload["memory"] or {})
+    fp = payload["fingerprint"]
+    assert fp["pid"] == os.getpid()
+    assert "PADDLE_TPU_TEST_MARKER" in fp["env"]
+    # non-framework env never leaks into the black box
+    assert "PATH" not in fp["env"]
+
+
+def test_dump_is_strict_json_even_with_nan_values(tmp_path):
+    """The flagship forensic IS a NaN loss: the dump must still be strict
+    RFC-8259 JSON (no bare NaN/Infinity tokens jq/JSON.parse reject)."""
+    rec = FlightRecorder(capacity=16, enabled=True)
+    rec.dump_dir = str(tmp_path)
+    rec.record("step", step=5, loss=float("nan"), lr=float("inf"))
+    path = rec.dump("nan_case", step=5)
+    text = open(path).read()
+
+    def boom(tok):
+        raise AssertionError(f"bare {tok} token in dump")
+
+    payload = json.loads(text, parse_constant=boom)  # strict parse
+    ev = payload["events"][-1]
+    assert ev["loss"] == "nan" and ev["lr"] == "inf"
+
+
+def test_dump_never_clobbers_same_step(tmp_path):
+    rec = FlightRecorder(capacity=16, enabled=True)
+    rec.dump_dir = str(tmp_path)
+    rec.record("a", x=1)
+    p1 = rec.dump("first", step=7)
+    rec.record("b", x=2)
+    p2 = rec.dump("second", step=7)
+    assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+    assert json.loads(open(p1).read())["reason"] == "first"
+    assert json.loads(open(p2).read())["reason"] == "second"
+    # step=None names the dump flight_final.json
+    assert os.path.basename(rec.dump("last")) == "flight_final.json"
+
+
+def test_chrome_trace_keeps_span_open_at_death(tmp_path):
+    """A span the process died inside (open, never closed) must survive the
+    Chrome conversion — it's the most interesting span on the tape."""
+    rec = FlightRecorder(capacity=16, enabled=True)
+    rec.record("span_open", name="done")
+    rec.record("span_close", name="done", dur=0.1)
+    rec.record("span_open", name="died_here")
+    trace = flight.to_chrome_trace({"events": rec.events(),
+                                    "fingerprint": {"pid": 1}})
+    assert [e["name"] for e in trace["traceEvents"]
+            if e["ph"] == "X"] == ["done"]
+    assert [e["name"] for e in trace["traceEvents"]
+            if e["ph"] == "B"] == ["died_here"]
+
+
+def test_dump_dir_override_scopes_to_owner(tmp_path):
+    """Resilience paths pass their own manager root: a per-dump dir
+    override wins over the recorder-wide default, so a second manager
+    can't reroute another run's forensics."""
+    rec = FlightRecorder(capacity=16, enabled=True)
+    rec.dump_dir = str(tmp_path / "other")
+    rec.record("a", x=1)
+    p = rec.dump("scoped", step=3, dump_dir=str(tmp_path / "mine"))
+    assert os.path.dirname(p) == str(tmp_path / "mine")
+
+
+def test_cli_main_module_import_is_safe():
+    import importlib
+    mod = importlib.import_module("paddle_tpu.observability.flight.__main__")
+    assert callable(mod.main)  # imported (not run as a script): no SystemExit
+
+
+def test_dump_trims_to_last_n(tmp_path):
+    rec = FlightRecorder(capacity=64, enabled=True)
+    rec.dump_dir = str(tmp_path)
+    for i in range(20):
+        rec.record("tick", i=i)
+    payload = json.loads(open(rec.dump("r", step=1, last=5)).read())
+    assert [e["i"] for e in payload["events"]] == list(range(15, 20))
+    assert rec.events(last=0) == []  # 0 means none, not "falsy -> all"
+
+
+def test_excepthook_chains_and_dumps(tmp_path):
+    rec = flight.get_recorder()
+    saved_dir, saved_enabled = rec.dump_dir, rec.enabled
+    called = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: called.append(a)
+    try:
+        rec.enabled = True
+        rec.dump_dir = str(tmp_path)
+        flight.install_excepthook()
+        flight.install_excepthook()  # idempotent
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+        assert len(called) == 1  # the previous hook still ran, once
+        dumps = [p for p in os.listdir(tmp_path) if p.startswith("flight_")]
+        assert dumps, "excepthook did not dump"
+        payload = json.loads(open(tmp_path / dumps[0]).read())
+        assert payload["reason"] == "unhandled_exception"
+        last = payload["events"][-1]
+        assert last["kind"] == "exception" and last["type"] == "ValueError"
+    finally:
+        flight.uninstall_excepthook()
+        sys.excepthook = prev
+        rec.dump_dir, rec.enabled = saved_dir, saved_enabled
+
+
+# ---------------------------------------------------------------------------
+# CLI + chrome conversion
+# ---------------------------------------------------------------------------
+
+def _make_dump(tmp_path):
+    rec = FlightRecorder(capacity=64, enabled=True)
+    rec.dump_dir = str(tmp_path)
+    rec.record("span_open", name="fwd")
+    rec.record("span_close", name="fwd", dur=0.25)
+    rec.record("nan_window", step=9)
+    rec.record("nan_rewind", step=9, restored_step=0)
+    return rec.dump("nan_rewind", step=9)
+
+
+def test_cli_renders_dump(tmp_path, capsys):
+    path = _make_dump(tmp_path)
+    assert flight.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "reason=nan_rewind" in out
+    assert "nan_rewind" in out and "nan_window" in out
+
+
+def test_cli_chrome_trace_and_bad_path(tmp_path, capsys):
+    path = _make_dump(tmp_path)
+    out_path = str(tmp_path / "trace.json")
+    assert flight.main([path, "--chrome-trace", out_path]) == 0
+    capsys.readouterr()
+    trace = json.loads(open(out_path).read())
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(slices) == 1 and slices[0]["name"] == "fwd"
+    assert abs(slices[0]["dur"] - 0.25e6) < 1.0
+    instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert {"nan_window", "nan_rewind"} <= \
+        {e["name"].split(":")[0] for e in instants}
+    assert flight.main([str(tmp_path / "missing.json")]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# instrumentation feeds
+# ---------------------------------------------------------------------------
+
+def test_jit_trace_events_feed_recorder():
+    flight.enable(True)
+    flight.clear()
+
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2
+
+    import numpy as np
+    f(paddle.to_tensor(np.ones((2, 2), np.float32)))
+    f(paddle.to_tensor(np.ones((3, 3), np.float32)))  # new signature
+    traces = [e for e in flight.events() if e["kind"] == "jit_trace"]
+    assert len(traces) == 2
+    assert traces[0]["retrace"] is False
+    assert traces[1]["retrace"] is True
+    assert all(e["fn"].endswith("f") for e in traces)
+
+
+def test_record_event_span_feeds_recorder():
+    from paddle_tpu.profiler import RecordEvent
+    flight.enable(True)
+    flight.clear()
+    with RecordEvent("unit_span"):
+        pass
+    kinds = [e["kind"] for e in flight.events()]
+    assert "span_open" in kinds and "span_close" in kinds
+    close = [e for e in flight.events() if e["kind"] == "span_close"][0]
+    assert close["name"] == "unit_span" and close["dur"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# memory profiler
+# ---------------------------------------------------------------------------
+
+def test_live_array_census_sees_arrays():
+    import jax.numpy as jnp
+    keep = jnp.ones((128, 128), jnp.float32)  # noqa: F841 (stays live)
+    c = memory.census(top=50)
+    live = c["live_arrays"]
+    assert live["count"] >= 1
+    assert live["total_bytes"] >= 128 * 128 * 4
+    match = [r for r in live["by_dtype_shape"]
+             if r["shape"] == [128, 128] and r["dtype"] == "float32"]
+    assert match and match[0]["bytes"] >= 128 * 128 * 4
+    # gauges exported
+    import paddle_tpu.observability as obs
+    assert obs.value("paddle_tpu_hbm_bytes", kind="live_arrays") \
+        == live["total_bytes"]
+    assert obs.value("paddle_tpu_hbm_live_arrays") == live["count"]
+
+
+def test_memory_sampler_cadence():
+    s = memory.MemorySampler(every=5)
+    assert s.maybe_sample(1) is None
+    assert s.maybe_sample(5) is not None
+    assert s.last is not None
+    with pytest.raises(ValueError):
+        memory.MemorySampler(every=0)
+
+
+def test_attribute_memory_per_module_deltas():
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(4, 8)
+            self.fc2 = paddle.nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    net = Net()
+    # deterministic probe: "allocation" grows 100 bytes per observation,
+    # so nesting (root sees both children) is exactly checkable
+    state = {"b": 0}
+
+    def probe():
+        state["b"] += 100
+        return state["b"]
+
+    import numpy as np
+    with memory.attribute_memory(net, probe=probe) as attr:
+        net(paddle.to_tensor(np.ones((1, 4), np.float32)))
+    assert set(attr.peaks) == {"Net", "fc1", "fc2"}
+    for st in attr.peaks.values():
+        assert st["calls"] == 1
+        assert st["peak_delta_bytes"] > 0
+        assert st["peak_bytes"] >= st["peak_delta_bytes"]
+    # root spans both children's probes -> largest delta
+    assert attr.peaks["Net"]["peak_delta_bytes"] > \
+        attr.peaks["fc1"]["peak_delta_bytes"]
+    # published for flight dumps
+    assert memory.last_attribution()["fc2"]["calls"] == 1
+    assert "fc1" in attr.table()
+    # hooks removed: another forward must not change the table
+    net(paddle.to_tensor(np.ones((1, 4), np.float32)))
+    assert attr.peaks["Net"]["calls"] == 1
+
+
+def test_attribute_memory_real_probe_runs():
+    lin = paddle.nn.Linear(8, 8)
+    import numpy as np
+    with memory.attribute_memory(lin) as attr:
+        lin(paddle.to_tensor(np.ones((2, 8), np.float32)))
+    assert attr.peaks["Linear"]["calls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# label-cardinality guard
+# ---------------------------------------------------------------------------
+
+def test_counter_cardinality_cap_overflow_series():
+    c = Counter("paddle_tpu_test_cap_total")
+    c.max_series = 4
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for i in range(50):
+            c.inc(fn=f"f{i}")
+    caught = [x for x in w if "cardinality cap" in str(x.message)]
+    assert len(caught) == 1  # one-time warning
+    series = c.series()
+    assert len(series) == 5  # 4 real + overflow sink
+    assert c.value(overflow="true") == 46
+    # existing series keep recording exactly
+    c.inc(fn="f0")
+    assert c.value(fn="f0") == 2
+    assert c.total() == 51
+    # the sink's label name is reserved on write paths (reads stay open)
+    with pytest.raises(ValueError):
+        c.inc(overflow="true")
+
+
+def test_gauge_and_histogram_cardinality_cap():
+    g = Gauge("paddle_tpu_test_cap_gauge")
+    g.max_series = 2
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(5):
+            g.set(i, fn=f"g{i}")
+    assert OVERFLOW_KEY in dict((tuple(sorted(lbl.items())), v)
+                                for lbl, v in g.series())
+    assert g.value(overflow="true") == 4  # last over-cap set wins
+    h = Histogram("paddle_tpu_test_cap_seconds", buckets=(1.0,))
+    h.max_series = 2
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(5):
+            h.observe(0.5, fn=f"h{i}")
+    assert h.value(overflow="true")["count"] == 3
+    assert len(h.series()) == 3

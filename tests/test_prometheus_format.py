@@ -1,0 +1,196 @@
+"""Prometheus text-exposition grammar validation for
+`observability.exporters.render_prometheus`: every rendered line must parse
+against the exposition-format 0.0.4 grammar — HELP/TYPE pairing and order,
+metric/label name charsets, label-value escaping, and histogram
+`_bucket`/`_sum`/`_count` consistency (cumulative counts, +Inf == count)."""
+
+import math
+import re
+
+import pytest
+
+from paddle_tpu.observability import Registry
+from paddle_tpu.observability.exporters import render_prometheus
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL = r"[a-zA-Z_][a-zA-Z0-9_]*"
+# label VALUE: escaped \\ , \" , \n only; no raw " or newline
+_LVALUE = r'"(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+_SAMPLE_RE = re.compile(
+    rf"^(?P<name>{_NAME})"
+    rf"(?:\{{(?P<labels>{_LABEL}={_LVALUE}(?:,{_LABEL}={_LVALUE})*)?\}})?"
+    rf" (?P<value>-?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|\+?Inf|NaN))$")
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram|"
+                      rf"summary|untyped)$")
+_LABEL_PAIR_RE = re.compile(rf"({_LABEL})=({_LVALUE})")
+
+
+def _parse_labels(s):
+    if not s:
+        return {}
+    out = {}
+    consumed = 0
+    for m in _LABEL_PAIR_RE.finditer(s):
+        raw = m.group(2)[1:-1]
+        out[m.group(1)] = raw.replace('\\"', '"').replace("\\n", "\n") \
+            .replace("\\\\", "\\")
+        consumed = m.end()
+        if consumed < len(s):
+            assert s[consumed] == ",", f"junk between label pairs: {s!r}"
+            consumed += 1
+    assert consumed >= len(s), f"unparsed label tail: {s[consumed:]!r}"
+    return out
+
+
+def validate_exposition(text):
+    """Full-grammar walk of an exposition payload. Returns
+    {metric_name: {"type", "help", "samples": [(name, labels, value)]}};
+    raises AssertionError on any grammar violation."""
+    metrics = {}
+    current = None
+    for ln, line in enumerate(text.splitlines(), 1):
+        assert line == line.rstrip(), f"line {ln}: trailing whitespace"
+        if line.startswith("# HELP "):
+            m = _HELP_RE.match(line)
+            assert m, f"line {ln}: malformed HELP: {line!r}"
+            name = m.group(1)
+            assert name not in metrics, f"line {ln}: duplicate HELP {name}"
+            metrics[name] = {"help": m.group(2), "type": None, "samples": []}
+            current = name
+        elif line.startswith("# TYPE "):
+            m = _TYPE_RE.match(line)
+            assert m, f"line {ln}: malformed TYPE: {line!r}"
+            name = m.group(1)
+            # TYPE must immediately follow its own HELP (the renderer's
+            # pairing contract), and come before any of its samples
+            assert current == name and metrics[name]["type"] is None, \
+                f"line {ln}: TYPE {name} not paired with its HELP"
+            metrics[name]["type"] = m.group(2)
+        elif line.startswith("#"):
+            raise AssertionError(f"line {ln}: unknown comment {line!r}")
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"line {ln}: malformed sample: {line!r}"
+            sname = m.group("name")
+            base = current
+            assert base is not None, f"line {ln}: sample before any TYPE"
+            if metrics[base]["type"] == "histogram":
+                assert sname in (base, f"{base}_bucket", f"{base}_sum",
+                                 f"{base}_count"), \
+                    f"line {ln}: {sname} not a series of {base}"
+            else:
+                assert sname == base, \
+                    f"line {ln}: sample {sname} outside its TYPE block"
+            metrics[base]["samples"].append(
+                (sname, _parse_labels(m.group("labels")),
+                 float(m.group("value"))))
+    # histogram internal consistency per label set
+    for name, m in metrics.items():
+        if m["type"] != "histogram" or not m["samples"]:
+            continue  # a silent histogram exposes schema only — valid
+        series = {}
+        for sname, labels, value in m["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            row = series.setdefault(key, {"buckets": [], "sum": None,
+                                          "count": None})
+            if sname.endswith("_bucket"):
+                assert "le" in labels, f"{name}: bucket without le"
+                row["buckets"].append((labels["le"], value))
+            elif sname.endswith("_sum"):
+                row["sum"] = value
+            elif sname.endswith("_count"):
+                row["count"] = value
+        for key, row in series.items():
+            assert row["sum"] is not None, f"{name}{key}: missing _sum"
+            assert row["count"] is not None, f"{name}{key}: missing _count"
+            assert row["buckets"], f"{name}{key}: no buckets"
+            bounds = [(-math.inf if le == "+Inf" else float(le), c)
+                      for le, c in row["buckets"]]
+            counts = [c for _, c in row["buckets"]]
+            assert counts == sorted(counts), \
+                f"{name}{key}: bucket counts not cumulative: {counts}"
+            assert row["buckets"][-1][0] == "+Inf", \
+                f"{name}{key}: last bucket is not +Inf"
+            assert row["buckets"][-1][1] == row["count"], \
+                f"{name}{key}: +Inf bucket != _count"
+            del bounds
+    return metrics
+
+
+def _loaded_registry():
+    reg = Registry()
+    c = reg.counter("paddle_tpu_test_requests_total", "requests served")
+    c.inc(3)
+    c.inc(2, route="decode", model="gpt-2")
+    # hostile label values: every escape class the format defines
+    c.inc(1, path='a"quoted"', note="line1\nline2", win="C:\\tmp\\x")
+    g = reg.gauge("paddle_tpu_test_depth", "queue depth\nmultiline help")
+    g.set(-4.5, stage="prefill")
+    h = reg.histogram("paddle_tpu_test_wait_seconds", "wait",
+                      buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+        h.observe(v, shard="a b")
+    return reg
+
+
+def test_rendered_output_parses_full_grammar():
+    metrics = validate_exposition(render_prometheus(_loaded_registry()))
+    assert metrics["paddle_tpu_test_requests_total"]["type"] == "counter"
+    assert metrics["paddle_tpu_test_depth"]["type"] == "gauge"
+    assert metrics["paddle_tpu_test_wait_seconds"]["type"] == "histogram"
+
+
+def test_label_escaping_roundtrip():
+    metrics = validate_exposition(render_prometheus(_loaded_registry()))
+    samples = metrics["paddle_tpu_test_requests_total"]["samples"]
+    hostile = [lbl for _, lbl, _ in samples if "path" in lbl]
+    assert hostile == [{"path": 'a"quoted"', "note": "line1\nline2",
+                        "win": "C:\\tmp\\x"}]
+
+
+def test_histogram_bucket_sum_count_values():
+    metrics = validate_exposition(render_prometheus(_loaded_registry()))
+    by_series = {}
+    for sname, labels, value in \
+            metrics["paddle_tpu_test_wait_seconds"]["samples"]:
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        by_series.setdefault(key, []).append((sname, labels, value))
+    for key in ((), (("shard", "a b"),)):
+        rows = by_series[key]
+        count = [v for n, _, v in rows if n.endswith("_count")][0]
+        total = [v for n, _, v in rows if n.endswith("_sum")][0]
+        assert count == 5
+        assert abs(total - 5.605) < 1e-9
+        buckets = {lbl["le"]: v for n, lbl, v in rows
+                   if n.endswith("_bucket")}
+        assert buckets == {"0.01": 1, "0.1": 3, "1.0": 4, "+Inf": 5}
+
+
+def test_help_type_pairing_for_every_registered_metric():
+    reg = _loaded_registry()
+    reg.counter("paddle_tpu_test_silent_total", "never sampled")
+    text = render_prometheus(reg)
+    metrics = validate_exposition(text)
+    # silent metrics still expose schema (HELP+TYPE), no samples
+    assert metrics["paddle_tpu_test_silent_total"]["samples"] == []
+    assert all(m["type"] is not None for m in metrics.values())
+
+
+def test_validator_rejects_bad_payloads():
+    with pytest.raises(AssertionError):
+        validate_exposition("# TYPE orphan counter\norphan 1")
+    with pytest.raises(AssertionError):
+        validate_exposition('# HELP m h\n# TYPE m counter\nm{x="a" 1')
+    with pytest.raises(AssertionError):  # raw newline in a label value
+        validate_exposition('# HELP m h\n# TYPE m counter\nm{x="a\nb"} 1')
+
+
+def test_default_registry_render_is_grammar_clean():
+    """The real process-wide registry — with every framework metric the
+    suite has touched so far, including overflow sink series — must render
+    grammar-clean."""
+    from paddle_tpu.observability import get_registry
+    validate_exposition(render_prometheus(get_registry()))

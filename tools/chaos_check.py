@@ -1,19 +1,26 @@
 #!/usr/bin/env python
 """Chaos gate: a tiny train loop must survive three injected fault profiles
-and resume bit-identically, losing at most one optimizer step.
+and resume bit-identically, losing at most one optimizer step — AND each
+profile must leave a valid flight-recorder dump whose final events match
+the injected fault (the black box is part of the recovery contract).
 
 Profiles (each compared against the same fault-free reference trajectory):
 
   kill-mid-save   an injected IO error kills the run during a checkpoint
                   commit; the relaunched run must restore a GOOD checkpoint
                   (never the partial one) and finish identical to the
-                  reference, having lost <= 1 step
+                  reference, having lost <= 1 step. Flight dump: reason
+                  checkpoint_save_error, final events fault_injected +
+                  checkpoint_save(status=error)
   nan-at-step-k   a NaN loss at step k; the NaN sentinel rewinds to the
                   last good checkpoint and the replayed run must finish
-                  identical to the reference
+                  identical to the reference. Flight dump: reason
+                  nan_rewind, final events nan_window ... nan_rewind
   sigterm-at-k    SIGTERM entering step k; the preemption handler drains,
                   writes a final checkpoint, exits 143; the relaunch must
-                  resume having lost 0 steps and finish identical
+                  resume having lost 0 steps and finish identical. Flight
+                  dump: reason preempted_sigterm, final events preempt ...
+                  preempt_exit
 
 Exit status: 0 when every profile holds, 1 otherwise. Fast (CPU, a
 4-parameter model, eager steps) — wired into tier-1 via
@@ -25,6 +32,8 @@ tests/test_chaos_check.py. Run directly:
 from __future__ import annotations
 
 import argparse
+import glob
+import json
 import os
 import sys
 import tempfile
@@ -116,12 +125,49 @@ def _reference(steps):
     return _weights(model)
 
 
+def _arm_flight():
+    """Fresh tape per profile: the dump's final events must be THIS
+    profile's fault, not a predecessor's."""
+    from paddle_tpu.observability import flight
+    flight.enable(True)
+    flight.clear()
+
+
+def _validate_flight_dump(ckpt_dir, reason, want_final_kinds, window=12):
+    """The black-box half of the gate: a schema-valid flight dump exists in
+    the checkpoint dir with the expected death reason, and
+    ``want_final_kinds`` appear (as an ordered subsequence) among the last
+    ``window`` recorded events. Returns an error string or None."""
+    paths = sorted(glob.glob(os.path.join(ckpt_dir, "flight_*.json")),
+                   key=os.path.getmtime)
+    if not paths:
+        return f"no flight dump written to {ckpt_dir} (wanted {reason})"
+    try:
+        with open(paths[-1]) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"flight dump {paths[-1]} unreadable: {e}"
+    for k in ("schema", "reason", "events", "fingerprint", "time"):
+        if k not in payload:
+            return f"flight dump missing required key {k!r}"
+    if payload["reason"] != reason:
+        return f"flight dump reason {payload['reason']!r}, wanted {reason!r}"
+    kinds = [e.get("kind") for e in payload["events"][-window:]]
+    it = iter(kinds)
+    for want in want_final_kinds:
+        if want not in it:  # ordered-subsequence check over final events
+            return (f"final events {kinds} do not contain {want_final_kinds}"
+                    f" in order (missing {want!r})")
+    return None
+
+
 def profile_kill_mid_save(steps, ref):
     """IO error during the FAULT_STEP-th checkpoint commit kills the run;
     relaunch must restore a verified-good checkpoint and match ref."""
     from paddle_tpu.resilience import (CheckpointManager, InjectedIOError,
                                       faults)
     with tempfile.TemporaryDirectory() as d:
+        _arm_flight()
         model, opt = _fresh()
         mgr = CheckpointManager(d, keep_n=steps)
         try:
@@ -130,6 +176,11 @@ def profile_kill_mid_save(steps, ref):
             return "injected IO error never fired"
         except InjectedIOError:
             pass  # the simulated crash
+        err = _validate_flight_dump(
+            d, "checkpoint_save_error",
+            ["fault_injected", "checkpoint_save"])
+        if err:
+            return err
         model2, opt2 = _fresh()
         mgr2 = CheckpointManager(d, keep_n=steps)
         restored = mgr2.restore(model=model2, optimizer=opt2)
@@ -149,6 +200,7 @@ def profile_nan_at_step(steps, ref):
     match ref exactly (the one-shot fault does not refire on replay)."""
     from paddle_tpu.resilience import CheckpointManager, NaNSentinel, faults
     with tempfile.TemporaryDirectory() as d:
+        _arm_flight()
         model, opt = _fresh()
         mgr = CheckpointManager(d, keep_n=steps)
         sent = NaNSentinel(check_every=1, max_consecutive=1, manager=mgr)
@@ -159,6 +211,13 @@ def profile_nan_at_step(steps, ref):
         import paddle_tpu.observability as obs
         if obs.total("paddle_tpu_resilience_nan_rewinds_total") < 1:
             return "sentinel never rewound"
+        # the dump was taken AT the rewind, so its tape must end with the
+        # sentinel's window + rewind (the replayed steps came later)
+        err = _validate_flight_dump(
+            d, "nan_rewind",
+            ["fault_injected", "nan_window", "nan_rewind"])
+        if err:
+            return err
     return None
 
 
@@ -168,6 +227,7 @@ def profile_sigterm_at_step(steps, ref):
     from paddle_tpu.resilience import (CheckpointManager, PreemptionHandler,
                                       faults)
     with tempfile.TemporaryDirectory() as d:
+        _arm_flight()
         model, opt = _fresh()
         mgr = CheckpointManager(d, keep_n=steps)
         handler = PreemptionHandler(mgr).install()
@@ -180,6 +240,11 @@ def profile_sigterm_at_step(steps, ref):
                 return f"exit code {e.code}, wanted relaunchable 143"
         finally:
             handler.uninstall()
+        err = _validate_flight_dump(
+            d, "preempted_sigterm",
+            ["preempt", "checkpoint_save", "preempt_exit"])
+        if err:
+            return err
         model2, opt2 = _fresh()
         mgr2 = CheckpointManager(d, keep_n=steps)
         restored = mgr2.restore(model=model2, optimizer=opt2)

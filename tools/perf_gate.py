@@ -15,12 +15,25 @@ Round-over-round discipline (VERDICT r4 #10): with --history, the baseline
 is the BEST of the last 3 recorded rounds for the same metric — a slow
 round cannot quietly lower the bar for the next one — tolerance tightens
 to 3%, and the signed delta is printed so a regression fails loudly.
+
+Beyond throughput, two soft gates ride the same baseline (both lower-is-
+better, both env-tunable, value <= 0 disables):
+
+  steady-state step latency  extra.step_breakdown.step_ms, tolerance
+                             PERF_GATE_STEP_TOL_PCT (default 10%)
+  peak HBM                   extra.peak_hbm_bytes (bench memory census),
+                             tolerance PERF_GATE_HBM_TOL_PCT (default 5%)
+
+so the BENCH_*.json trajectory guards latency and memory regressions
+instead of just accumulating them. Rounds that predate either field pass
+(nothing to compare).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -45,11 +58,16 @@ def load_bench(path):
     return d if isinstance(d, dict) else {}
 
 
-def load_value(path):
-    d = load_bench(path)
+def metric_value(d):
+    """(metric, value) from a bench dict — the one extraction every gate
+    path shares ((None, 0.0) when the dict is empty/unusable)."""
     if not d:
         return None, 0.0  # no usable value: caller passes
-    return d.get("metric"), float(d.get("value", 0.0))
+    return d.get("metric"), float(d.get("value") or 0.0)
+
+
+def load_value(path):
+    return metric_value(load_bench(path))
 
 
 def _steady_state(d):
@@ -100,6 +118,64 @@ def retrace_diagnosis(d) -> str:
     return "\n".join(lines)
 
 
+def step_latency_ms(d):
+    """Steady-state per-step wall latency from the bench's step breakdown
+    (None when the round predates it)."""
+    try:
+        v = d["extra"]["step_breakdown"]["step_ms"]
+        return float(v) if v else None
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def peak_hbm_bytes(d):
+    """Peak device memory from the bench's memory census (None when the
+    round predates `extra.peak_hbm_bytes`)."""
+    try:
+        v = d["extra"]["peak_hbm_bytes"]
+        return int(v) if v else None
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _tol_pct(env_name, default):
+    try:
+        return float(os.environ.get(env_name, default))
+    except ValueError:
+        return default
+
+
+def soft_gates(cd, bd):
+    """Lower-is-better soft gates (step latency, peak HBM) of current dict
+    `cd` vs baseline dict `bd`. Returns a list of failure messages (empty =
+    pass); sides that lack the field are skipped, a tolerance <= 0
+    disables that gate."""
+    fails = []
+    for name, get, env, default, unit in (
+            ("step_latency", step_latency_ms, "PERF_GATE_STEP_TOL_PCT",
+             10.0, "ms"),
+            ("peak_hbm", peak_hbm_bytes, "PERF_GATE_HBM_TOL_PCT",
+             5.0, "bytes")):
+        tol = _tol_pct(env, default)
+        if tol <= 0:
+            continue
+        cur, base = get(cd), get(bd)
+        if cur is None or base is None or base <= 0:
+            continue
+        ceiling = base * (1 + tol / 100.0)
+        delta = (cur - base) / base
+        if cur > ceiling:
+            fails.append(
+                f"perf gate [REGRESSION:{name}] current {cur:.1f} {unit} vs "
+                f"baseline {base:.1f} {unit} (delta {delta:+.2%}, ceiling "
+                f"{ceiling:.1f}, tol {tol:.0f}% via {env})")
+        else:
+            print(f"perf gate [ok:{name}] current {cur:.1f} {unit} vs "
+                  f"baseline {base:.1f} {unit} (delta {delta:+.2%}, "
+                  f"tol {tol:.0f}%)")
+    return fails
+
+
 def best_of_history(pattern, metric, last_n=3):
     """Best value among the last `last_n` round files matching `pattern`
     whose metric equals `metric` (reference analog: the op-benchmark CI
@@ -132,8 +208,7 @@ def main():
     ap.add_argument("--tolerance", type=float, default=0.03)
     args = ap.parse_args()
     cd = load_bench(args.current)
-    cm, cv = (cd.get("metric"), float(cd.get("value", 0.0))) if cd \
-        else (None, 0.0)
+    cm, cv = metric_value(cd)
     # telemetry gate (observability wiring): a retrace during the measured
     # steady-state window means the number includes recompiles — fail loudly
     # even if the throughput still cleared the floor
@@ -144,13 +219,16 @@ def main():
               f"{retraces}x (telemetry trace_cache_retraces): the measured "
               f"number is not steady-state")
         print(retrace_diagnosis(cd))
+    bd = {}
     if args.history:
         src, bv = best_of_history(args.history, cm)
         bm = cm if src else None
         if src:
             print(f"perf gate: baseline = best-of-last-3 {src} ({bv:.1f})")
+            bd = load_bench(src)
     elif args.baseline:
-        bm, bv = load_value(args.baseline)
+        bd = load_bench(args.baseline)
+        bm, bv = metric_value(bd)
     else:
         ap.error("need --baseline or --history")
     if bv <= 0:
@@ -168,7 +246,12 @@ def main():
     print(f"perf gate [{status}] {cm}: current {cv:.1f} vs baseline "
           f"{bv:.1f} (delta {delta:+.2%}, floor {floor:.1f}, "
           f"tol {args.tolerance:.0%})")
-    return 0 if (cv >= floor and not retrace_fail) else 1
+    # soft gates over the same baseline round: step latency + peak HBM
+    # (only meaningful when the metric matched — same workload shape)
+    soft_fails = soft_gates(cd, bd)
+    for msg in soft_fails:
+        print(msg)
+    return 0 if (cv >= floor and not retrace_fail and not soft_fails) else 1
 
 
 if __name__ == "__main__":
