@@ -66,27 +66,40 @@ def main(steps=20, ckpt_dir=None, save_every=5):
         opt.clear_grad()
         return loss
 
+    def batches(from_step):
+        # step-indexed so a NaN rewind can restart the stream exactly
+        for i in range(from_step, steps):
+            yield i, xv, yv
+
     # keep the loss on device in the hot loop (per-step float() is a host
-    # sync the analyzer flags as TS008); convert once after the loop
+    # sync the analyzer flags as TS008); convert once after the loop. The
+    # feed is double-buffered (paddle.io.prefetch_to_device): batch k+1
+    # streams to device while the mesh computes on batch k.
     first = last = None
     try:
-        i = start
-        while i < steps:
-            last = step(paddle.to_tensor(xv), paddle.to_tensor(yv))
+        feed = paddle.io.prefetch_to_device(batches(start), depth=2)
+        while True:
+            try:
+                i, x, y = next(feed)
+            except StopIteration:
+                break
+            last = step(x, y)
             if faults.on_train_step(i):  # harness: corrupt this step's loss
                 last = last * float("nan")
             first = first if first is not None else last
             if manager is not None:
                 sentinel.observe(last)
                 if sentinel.check(i, model=model, optimizer=opt) == "rewind":
-                    # cursor = step actually restored, not latest_step()
-                    i = sentinel.restored_step or 0
+                    # cursor = step actually restored, not latest_step();
+                    # in-flight prefetched batches belong to the abandoned
+                    # timeline — restart the feed there
+                    feed = paddle.io.prefetch_to_device(
+                        batches(sentinel.restored_step or 0), depth=2)
                     first = None
                     continue
                 if (i + 1) % save_every == 0:
                     manager.save(i + 1, model=model, optimizer=opt)
                 handler.maybe_exit(i + 1, model=model, optimizer=opt)
-            i += 1
     finally:
         if manager is not None:
             manager.wait()

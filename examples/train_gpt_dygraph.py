@@ -80,16 +80,28 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
         opt.clear_grad()
         return loss
 
+    def batches(from_step):
+        # batches are a pure function of the step index, so a NaN rewind
+        # can restart the stream at any step and replay exactly
+        for i in range(from_step, steps):
+            chunk = data[(i % 4) * batch:(i % 4 + 1) * batch]
+            yield i, chunk[:, :-1].astype(np.int32), \
+                chunk[:, 1:].astype(np.int32)
+
     # loss stays on device across iterations; syncing it to host every
     # step (float() per iteration) serializes dispatch against the chip —
-    # the analyzer flags that pattern as TS008
+    # the analyzer flags that pattern as TS008. The feed is double-buffered
+    # (paddle.io.prefetch_to_device): batch k+1 streams to device while the
+    # chip computes on batch k.
     first = last = None
     try:
-        i = start
-        while i < steps:
-            chunk = data[(i % 4) * batch:(i % 4 + 1) * batch]
-            last = step(paddle.to_tensor(chunk[:, :-1].astype(np.int32)),
-                        paddle.to_tensor(chunk[:, 1:].astype(np.int32)))
+        feed = paddle.io.prefetch_to_device(batches(start), depth=2)
+        while True:
+            try:
+                i, x, y = next(feed)
+            except StopIteration:
+                break
+            last = step(x, y)
             if faults.on_train_step(i):  # harness: corrupt this step's loss
                 last = last * float("nan")
             first = first if first is not None else last
@@ -104,14 +116,15 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
                 if sentinel.check(i, model=model, optimizer=opt) == "rewind":
                     # cursor follows the step actually restored (restore
                     # may fall back past a corrupt newer checkpoint);
-                    # data is indexed by step so the replay is exact
-                    i = sentinel.restored_step or 0
+                    # restart the prefetched feed at that step (in-flight
+                    # batches belong to the abandoned timeline)
+                    feed = paddle.io.prefetch_to_device(
+                        batches(sentinel.restored_step or 0), depth=2)
                     first = None
                     continue
                 if (i + 1) % save_every == 0:
                     manager.save(i + 1, model=model, optimizer=opt)
                 handler.maybe_exit(i + 1, model=model, optimizer=opt)
-            i += 1
     finally:
         if manager is not None:
             manager.wait()

@@ -79,6 +79,22 @@ class GradScaler:
             optimizer.step()
             return
         if id(optimizer) not in self._unscaled:
+            # fused path (optimizer/fused.py): unscale, the found_inf
+            # reduction, AND the inf-skipped update run inside the one
+            # jitted optimizer dispatch — a single host bool pull per step
+            # instead of a per-parameter pull in unscale_. Returns None
+            # when the fused path can't take it (fusion off, cold state
+            # structure, inside a trace): fall through to the legacy path.
+            # p.grad still observes the unscaled grads afterwards — the
+            # fused program returns them and step() rewrites the handles,
+            # matching unscale_'s in-place contract.
+            found = self._try_fused_scale_step(optimizer)
+            if found is not None:
+                if found:
+                    self._inf_steps_total += 1
+                    _OBS_FOUND_INF.inc()
+                    self._found_inf = True
+                return
             self.unscale_(optimizer)
         if not self._found_inf_per.get(id(optimizer), False):
             optimizer.step()
@@ -89,6 +105,18 @@ class GradScaler:
         self._found_inf = self._found_inf or \
             self._found_inf_per.pop(id(optimizer), False)
         self._unscaled.discard(id(optimizer))
+
+    def _try_fused_scale_step(self, optimizer):
+        """The fused unscale+step hook, ONLY when it cannot bypass behavior
+        layered on top of the update (see fused.resolve_scale_hook):
+        wrappers with their own step() logic — ASP mask re-application,
+        gradient merge, ZeRO offload streaming — take the legacy
+        unscale_/step path, which goes through their step() override."""
+        from ..optimizer.fused import resolve_scale_hook
+        hook = resolve_scale_hook(optimizer)
+        if hook is None:
+            return None
+        return hook(self._scale)
 
     def update(self):
         self._unscaled.clear()

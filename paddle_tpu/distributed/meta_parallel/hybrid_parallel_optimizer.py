@@ -47,6 +47,16 @@ class HybridParallelOptimizer:
     def step(self):
         self._inner_opt.step()
 
+    def _fused_scale_step(self, scale):
+        # explicit opt-in to the GradScaler fused unscale+step hook: this
+        # wrapper's step() purely delegates, so bypassing IT loses nothing —
+        # but the inner optimizer may itself be a wrapper with real step()
+        # logic (gradient merge, DGC, LocalSGD), so apply the same guard
+        # recursively instead of punching through via __getattr__
+        from ...optimizer.fused import resolve_scale_hook
+        hook = resolve_scale_hook(self._inner_opt)
+        return hook(scale) if hook is not None else None
+
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         return self._inner_opt.minimize(loss, startup_program, parameters,

@@ -16,3 +16,4 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .prefetch import prefetch_to_device  # noqa: F401

@@ -70,6 +70,46 @@ def in_to_static_trace() -> bool:
     return getattr(_trace_state, "active", False)
 
 
+def dedup_for_donation(arrays, taken_ids=None):
+    """Copy any array object that appears twice in a donated argument list
+    (or that aliases a non-donated argument in `taken_ids`): XLA rejects
+    donating one buffer twice, and freshly-built state can alias INSIDE a
+    state list — two zeros_like accumulators may share a cached constant
+    buffer; a tied weight read through two tensors. Shared by
+    StaticFunction's donated execute and the fused optimizer dispatch."""
+    seen = set(taken_ids) if taken_ids else set()
+    out = []
+    for a in arrays:
+        if id(a) in seen:
+            a = jnp.copy(a)
+        else:
+            seen.add(id(a))
+        out.append(a)
+    return out
+
+
+def stream_state_in(t, a):
+    """Host-pinned state (ZeRO-offload) streams to device for a compiled
+    step — the transfer lives outside the jit boundary so the program
+    itself stays all-device. Shared by StaticFunction and the fused
+    optimizer dispatch."""
+    if getattr(t, "_pin_memory_kind", None) is not None and \
+            getattr(a, "sharding", None) is not None and \
+            a.sharding.memory_kind != "device":
+        a = jax.device_put(a, a.sharding.with_memory_kind("device"))
+    return a
+
+
+def stream_state_out(t, a):
+    """Park updated state back in its pinned host memory kind after a
+    compiled step (the inverse of :func:`stream_state_in`)."""
+    kind = getattr(t, "_pin_memory_kind", None)
+    if kind is not None and getattr(a, "sharding", None) is not None \
+            and a.sharding.memory_kind != kind:
+        a = jax.device_put(a, a.sharding.with_memory_kind(kind))
+    return a
+
+
 class _Tracker:
     """Records concrete Tensors touched during the discovery call."""
 
@@ -278,32 +318,14 @@ class StaticFunction:
                                         arg_arrays)
 
     def _run_compiled(self, jitted, cell, state_list, arg_arrays):
-        state_arrays = []
-        seen = {id(a) for a in arg_arrays} if self._donate else None
-        for t in state_list:
-            a = t._d
-            if self._donate:
-                # XLA rejects donating one buffer twice, and freshly-built
-                # state can alias INSIDE the state list (two zeros_like
-                # accumulators may share a cached constant buffer; a tied
-                # weight read through two tensors): copy the duplicate
-                # before execute. NOTE the donation contract: Tensors
-                # aliasing state from OUTSIDE the compiled fn (detach()
-                # views, EMA snapshots) are invalidated by the donated
-                # execute — standard jax donation semantics; keep
-                # donate_state=False if such aliases must stay live.
-                if id(a) in seen:
-                    a = jnp.copy(a)
-                else:
-                    seen.add(id(a))
-            # host-pinned state (ZeRO-offload) streams to device for the
-            # compiled step — the transfer lives outside the jit boundary so
-            # the program itself stays all-device
-            if getattr(t, "_pin_memory_kind", None) is not None and \
-                    getattr(a, "sharding", None) is not None and \
-                    a.sharding.memory_kind != "device":
-                a = jax.device_put(a, a.sharding.with_memory_kind("device"))
-            state_arrays.append(a)
+        # NOTE the donation contract: Tensors aliasing state from OUTSIDE
+        # the compiled fn (detach() views, EMA snapshots) are invalidated
+        # by the donated execute — standard jax donation semantics; keep
+        # donate_state=False if such aliases must stay live.
+        state_arrays = [stream_state_in(t, t._d) for t in state_list]
+        if self._donate:
+            state_arrays = dedup_for_donation(
+                state_arrays, {id(a) for a in arg_arrays})
         from ..profiler.profiler import op_timing_active, record_program
         if op_timing_active():
             import time as _t
@@ -316,13 +338,7 @@ class StaticFunction:
         else:
             new_state, out_flat = jitted(state_arrays, arg_arrays)
         for t, a in zip(state_list, new_state):
-            # honor host-pinned state (ZeRO-offload): the compiled step
-            # computed on device; park the updated state back in host memory
-            kind = getattr(t, "_pin_memory_kind", None)
-            if kind is not None and getattr(a, "sharding", None) is not None \
-                    and a.sharding.memory_kind != kind:
-                a = jax.device_put(a, a.sharding.with_memory_kind(kind))
-            t._d = a
+            t._d = stream_state_out(t, a)
             t._node = None
         return jax.tree_util.tree_unflatten(cell["out_tree"], out_flat)
 

@@ -52,9 +52,11 @@ def _collect(module, prefix, kind, records, predicate):
 def _surface_cached() -> tuple:
     import paddle_tpu as paddle
     import paddle_tpu.analysis as analysis
+    import paddle_tpu.io as io_mod
     import paddle_tpu.jit as jit
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as optim_mod
     import paddle_tpu.observability as observability
     import paddle_tpu.observability.flight as obs_flight
     import paddle_tpu.observability.memory as obs_memory
@@ -74,6 +76,13 @@ def _surface_cached() -> tuple:
     # the same as ops are
     _collect(jit, "paddle.jit", "jit", records,
              lambda o: inspect.isfunction(o))
+    # input pipeline + optimizers: DataLoader/prefetch_to_device and every
+    # optimizer signature (incl. the fused-path `fuse=` knob) are training-
+    # loop contracts the same as ops are
+    _collect(io_mod, "paddle.io", "io", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    _collect(optim_mod, "paddle.optimizer", "optimizer", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
     _collect(analysis, "paddle.analysis", "analysis", records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     # fault-tolerance runtime: the checkpoint manager, sentinel, preemption

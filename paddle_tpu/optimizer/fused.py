@@ -1,0 +1,503 @@
+"""Fused multi-tensor optimizer step: one device dispatch per update.
+
+Reference analog: the fused multi-tensor kernels the reference ships as a
+first-class perf feature (fused_ops.yaml, fused_adam_kernel.cu, the fused
+comm buffers in the sharding stack). On TPU the fusion lives one level up:
+instead of N params x ~4 kernels per eager `step()`, the whole update —
+GradScaler unscale + found_inf fold, global-norm grad clip, the device-side
+step-counter increment, and every parameter/accumulator/master-weight
+update — is traced ONCE into a single `jax.jit` program and dispatched as
+one device computation per step, regardless of parameter count.
+
+Design:
+
+* **Trace the real code.** The fused program is built by re-running the
+  optimizer's own `_append_optimize_op` (and the attached grad-clip object)
+  under trace with the state tensors temporarily bound to tracers — the
+  exact mechanism `paddle_tpu.jit.to_static` uses. There is no second copy
+  of the update math, so the fused program is bit-identical to the unrolled
+  trace a `to_static` train step produces (guarded by
+  tests/test_fused_optimizer.py). The eager per-op path can differ by 1 ULP
+  where XLA contracts mul+sub chains into FMAs inside a compiled program.
+* **Warm-up step.** Optimizers create accumulators/master weights lazily
+  inside the first update; tracing that first step would capture concrete
+  zeros mid-trace and leave tracers behind in live Tensors. So the first
+  step for any not-yet-seen parameter runs the legacy per-param path
+  eagerly (creating all state), and every later step is fused.
+* **Structure cache.** Compiled programs are keyed on the parameter/grad/
+  accumulator STRUCTURE (ids, shapes, dtypes, sharding, per-param static
+  knobs like lr multipliers and decay exclusions, clip config, scale-fold
+  arity) — values (lr scalar, scheduler steps, loss scale) ride in as
+  device inputs, so nothing retraces step to step. Adding or removing a
+  parameter changes the key: one warm-up step, one recompile.
+* **Buckets.** Params are grouped by (dtype, sharding spec) for the
+  `paddle_tpu_optimizer_bucket_count` gauge and plan introspection; all
+  buckets still execute in the single fused program.
+* **In-place handles.** Results are written back to the existing
+  ``Tensor._data`` handles (through the tracked property, so an enclosing
+  `to_static` discovery still lifts the optimizer state), which keeps the
+  resilience runtime's in-place accumulator rebind on restore and
+  `state_dict()` layouts unchanged.
+* **Donation.** On TPU the old param/state buffers are donated to the
+  update program (halves transient HBM); on CPU donation is skipped (the
+  backend ignores it and warns). See docs/performance.md for the aliasing
+  caveat donation carries.
+
+Escape hatches: ``fuse=False`` per optimizer, ``PADDLE_TPU_FUSED_OPT=0``
+process-wide, ``PADDLE_TPU_FUSED_DONATE=0/1`` to force donation off/on.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+import warnings
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+from ..core.flags import flag
+from ..core.tensor import Tensor
+from ..observability import counter as _obs_counter, gauge as _obs_gauge
+from ..observability import flight as _flight
+
+__all__ = ["FusedOptimizerStep", "fuse_default", "donation_default"]
+
+_OBS_FUSED = _obs_counter(
+    "paddle_tpu_optimizer_fused_updates_total",
+    'optimizer steps served by a single fused device computation, by path: '
+    'path="fused" one jitted dispatch, path="warmup" the eager state-'
+    'creating first step, path="outer_jit" unrolled into an enclosing '
+    "to_static program (one dispatch for the whole train step)")
+_OBS_BUCKETS = _obs_gauge(
+    "paddle_tpu_optimizer_bucket_count",
+    "(dtype, sharding) buckets in the most recently compiled fused-update "
+    "plan, labeled by optimizer class")
+_OBS_COMPILES = _obs_counter(
+    "paddle_tpu_optimizer_fused_compiles_total",
+    "fused update program builds — one per optimizer state structure; a "
+    "climbing count means params are being added/removed every step")
+
+
+def fuse_default() -> bool:
+    """Process-wide default for the ``fuse=`` optimizer knob
+    (``PADDLE_TPU_FUSED_OPT``, on unless 0/false/off)."""
+    return os.environ.get("PADDLE_TPU_FUSED_OPT", "1").lower() not in (
+        "0", "false", "off")
+
+
+def donation_default(sample_array) -> bool:
+    """Donate state buffers to the fused program? ``PADDLE_TPU_FUSED_DONATE``
+    overrides; otherwise only on TPU — XLA:CPU ignores donation (with a
+    warning), and donation invalidates outside aliases of the old state."""
+    env = os.environ.get("PADDLE_TPU_FUSED_DONATE")
+    if env is not None:
+        return env.lower() not in ("0", "false", "off")
+    try:
+        return next(iter(sample_array.devices())).platform == "tpu"
+    except Exception:
+        return False
+
+
+def resolve_scale_hook(optimizer):
+    """The GradScaler fused unscale+step hook for `optimizer`, or None when
+    taking it would bypass behavior layered on top of the update: the hook
+    is safe only if the optimizer's step() is the stock Optimizer.step (no
+    wrapper override), or the wrapper explicitly opted in by defining its
+    own _fused_scale_step. Delegating wrappers that add post-step work
+    (ASP mask re-application, gradient merge, ZeRO offload streaming)
+    forward the attribute through __getattr__ — resolving that would
+    silently skip their step() override, so they get None and the caller
+    runs the legacy unscale_/step path (which goes through step()).
+    Opted-in pure delegators must apply this same check to THEIR inner
+    optimizer, so a non-opted-in middle wrapper is never punched through."""
+    from .optimizer import Optimizer
+    cls = type(optimizer)
+    stock_step = getattr(cls, "step", None) is Optimizer.step
+    cls_hook = getattr(cls, "_fused_scale_step", None)
+    own_hook = cls_hook is not None and \
+        cls_hook is not Optimizer._fused_scale_step
+    if not (stock_step or own_hook):
+        return None
+    return getattr(optimizer, "_fused_scale_step", None)
+
+
+def note_outer_jit_step():
+    """Called by ``Optimizer.step()`` when the unrolled loop is being traced
+    into an enclosing to_static program (the update IS fused there — into
+    the whole-train-step computation)."""
+    _OBS_FUSED.inc(path="outer_jit")
+
+
+class FusedOptimizerStep:
+    """Per-optimizer fused-update engine (built lazily by the first
+    ``step()`` on a fusion-enabled optimizer)."""
+
+    def __init__(self, opt):
+        self._opt = opt
+        self._cache: dict = {}      # structure key -> compiled entry
+        # params whose lazy state exists: id -> weakref. The weakref guards
+        # against id recycling (a GC'd param's address reused by a NEW
+        # param must not look already-warm — its accumulators would then be
+        # created mid-trace, leaking a tracer into live state) without
+        # pinning removed params alive.
+        self._warm: dict[int, weakref.ref] = {}
+        self._key_memo: dict = {}   # (param-ids, scale_fold) -> full key
+        self.dispatches = 0         # fused device dispatches (tests/bench)
+        self.compiles = 0
+        self.last_bucket_count = 0
+
+    # -- plan introspection --------------------------------------------------
+    def invalidate(self):
+        """Drop every compiled program (recompiles on next step). Structure
+        changes are detected automatically; this is the manual hatch."""
+        self._cache.clear()
+        self._key_memo.clear()
+        self._prune_warm()
+
+    def _prune_warm(self):
+        """Drop dead-weakref entries so param churn (progressive growing,
+        rebuilt adapters) can't grow the warm table without bound."""
+        self._warm = {i: r for i, r in self._warm.items()
+                      if r() is not None}
+
+    def _is_warm(self, p) -> bool:
+        ref = self._warm.get(id(p))
+        return ref is not None and ref() is p
+
+    def _mark_warm(self, params):
+        for p in params:
+            self._warm[id(p)] = weakref.ref(p)
+
+    def bucket_map(self, params_grads) -> dict:
+        """{(dtype, sharding-repr): [param indices]} — the (dtype, sharding)
+        grouping the fused program covers in one dispatch."""
+        buckets: dict = {}
+        for i, (p, _) in enumerate(params_grads):
+            key = (str(p._d.dtype), repr(p._sharding_spec))
+            buckets.setdefault(key, []).append(i)
+        return buckets
+
+    # -- step ----------------------------------------------------------------
+    def step(self, scale=None):
+        """Apply one fused update over every trainable param with a grad.
+
+        ``scale``: loss scale to fold (GradScaler path) — unscale and the
+        found_inf reduction run inside the fused program and non-finite
+        steps device-select the old state. Returns the host found_inf bool
+        on that path, None otherwise. Returns None ALSO when the scale path
+        cannot be taken yet (cold structure) — the caller must run the
+        legacy unscale+step once.
+        """
+        opt = self._opt
+        params_grads = [(p, p._grad) for p in opt._parameter_list
+                        if not p.stop_gradient and p._grad is not None]
+        if not params_grads:
+            if scale is not None:
+                return None
+            opt._step_unfused()  # counters still advance on an empty step
+            return None
+        if not self._state_ready(params_grads):
+            # state-creating step: accumulators/masters don't exist yet for
+            # at least one param — run the legacy path once, fuse from the
+            # next step on
+            if scale is not None:
+                return None
+            opt._step_unfused()
+            self._mark_warm([p for p, _ in params_grads])
+            _OBS_FUSED.inc(path="warmup")
+            return None
+        try:
+            # hot path: the structure almost never changes step-to-step, so
+            # the full key (reprs, per-param knob callbacks) is memoized on a
+            # cheap signature — attribute reads only, no Python callbacks
+            # per param: param identities/shapes/dtypes/sharding-spec ids
+            # (an in-place amp-style cast or reshard recomputes the key
+            # instead of feeding a shape- or sharding-stale executable)
+            # plus every optimizer-level knob the baked trace constants
+            # derive from (pallas-kernel flag, clip object+norm,
+            # regularizer, decay scalars, decay/lr-ratio/exclude fn
+            # identities). Per-param edits (optimize_attr, need_clip) or
+            # mutating a live clip/sharding object in place still need
+            # plan.invalidate().
+            clip = opt._grad_clip
+            fast_sig = (tuple((id(p), p._d.shape, p._d.dtype, g._d.dtype,
+                               id(p._sharding_spec))
+                              for p, g in params_grads),
+                        scale is not None, bool(flag("use_pallas_kernels")),
+                        id(clip),
+                        getattr(clip, "clip_norm", None),
+                        getattr(clip, "max", None),
+                        getattr(clip, "min", None),
+                        id(opt._regularization),
+                        getattr(opt, "_wd_value", None),
+                        getattr(opt, "_lamb_wd", None),
+                        id(getattr(opt, "_apply_decay_param_fun", None)),
+                        id(getattr(opt, "_lr_ratio", None)),
+                        id(getattr(opt, "_exclude_fn", None)))
+            key = self._key_memo.get(fast_sig)
+            if key is None:
+                key = self._structure_key(params_grads, scale is not None)
+                if len(self._key_memo) > 8:
+                    self._key_memo.clear()
+                self._key_memo[fast_sig] = key
+            entry = self._cache.get(key)
+            if entry is None:
+                entry = self._compile(key, params_grads, scale is not None)
+            args = self._prepare_args(entry, params_grads, scale)
+            if entry[4] is None:
+                # XLA-compile NOW from the concrete args (their real
+                # shardings), without executing: trace AND compile/lowering
+                # failures (bad custom update op, RESOURCE_EXHAUSTED building
+                # the program) land in this recoverable net — _execute's
+                # may-have-run zone only ever sees true dispatch failures.
+                # step() is never entered under an outer trace, so args are
+                # always concrete here.
+                entry[4] = entry[0].lower(*args).compile()
+        except Exception as e:
+            # safety net — ONLY around key/compile/arg-prep, which touch no
+            # live state: falling back here cannot double-apply an update
+            warnings.warn(
+                f"fused optimizer step failed ({type(e).__name__}: {e}); "
+                f"falling back to the per-parameter path for this "
+                f"{type(opt).__name__}", RuntimeWarning)
+            opt._fuse = False
+            if scale is not None:
+                return None
+            opt._step_unfused()
+            return None
+        try:
+            found = self._execute(entry, args, params_grads)
+        except Exception:
+            # past this point the device program may have run (and on TPU
+            # consumed donated buffers) — re-stepping could apply the update
+            # twice; surface the error instead of "recovering" silently
+            opt._fuse = False
+            warnings.warn(
+                f"fused optimizer dispatch failed for "
+                f"{type(opt).__name__}; state may be partially updated — "
+                "NOT re-running the step. Future steps use the "
+                "per-parameter path.", RuntimeWarning)
+            raise
+        _OBS_FUSED.inc(path="fused")
+        if scale is None:
+            opt._step_count += 1
+            return None
+        # scaler fold: ONE host pull for the whole step (the legacy path
+        # pulls a bool per parameter); the device already selected old vs
+        # new state, the host just mirrors the skip into _step_count
+        found = bool(found)
+        if not found:
+            opt._step_count += 1
+        return found
+
+    def _state_ready(self, params_grads) -> bool:
+        """Is every accumulator/master the update will touch already a live
+        Tensor? True means fuse NOW — critical after a checkpoint restore
+        into a fresh optimizer: `set_state_dict` created the state, and a
+        resumed run is only bit-identical to the uninterrupted one if its
+        first step runs the same fused program, not an eager warm-up.
+        Optimizers that don't declare their state names (custom
+        subclasses) fall back to the has-stepped-once heuristic."""
+        opt = self._opt
+        f32 = jnp.float32.dtype
+        for p, _ in params_grads:
+            if self._is_warm(p):
+                continue
+            names = opt._fused_state_names(p)
+            if names is None:
+                return False
+            if opt._multi_precision and p._d.dtype != f32 \
+                    and id(p) not in opt._master_weights:
+                return False
+            for n in names:
+                # plain .get: _accumulators is a defaultdict and membership
+                # probes must not materialize empty name slots
+                if id(p) not in opt._accumulators.get(n, {}):
+                    return False
+            self._mark_warm([p])
+        return True
+
+    # -- structure key -------------------------------------------------------
+    def _structure_key(self, params_grads, scale_fold: bool):
+        opt = self._opt
+        pk = []
+        for p, g in params_grads:
+            oa = getattr(p, "optimize_attr", None)
+            mult = oa.get("learning_rate", 1.0) if oa else 1.0
+            dec = opt._decoupled_decay_for(p) \
+                if hasattr(opt, "_decoupled_decay_for") else None
+            ratio = opt._lr_ratio(p) \
+                if getattr(opt, "_lr_ratio", None) is not None else None
+            excl = bool(opt._exclude_fn(p)) \
+                if getattr(opt, "_exclude_fn", None) is not None else None
+            pk.append((id(p), tuple(p._d.shape), str(p._d.dtype),
+                       str(g._d.dtype), repr(p._sharding_spec), mult, dec,
+                       ratio, excl, getattr(p, "need_clip", True)))
+        clip = opt._grad_clip
+        clip_key = (type(clip).__name__, id(clip),
+                    getattr(clip, "clip_norm", None),
+                    getattr(clip, "max", None), getattr(clip, "min", None))
+        return (tuple(pk), tuple(sorted(opt._accumulators)), clip_key,
+                id(opt._regularization), scale_fold,
+                bool(flag("use_pallas_kernels")))
+
+    def _state_list(self, params_grads) -> list[Tensor]:
+        """Every Tensor the update reads AND writes, in deterministic order:
+        lr + device step counter, params (+ masters), then accumulators.
+        Params without a grad this step are excluded — the legacy loop
+        skips them, so the fused program must not touch them either."""
+        opt = self._opt
+        state = [opt._lr_tensor, opt._step_tensor]
+        for p, _ in params_grads:
+            state.append(p)
+            mw = opt._master_weights.get(id(p))
+            if mw is not None:
+                state.append(mw)
+        for name in sorted(opt._accumulators):
+            accs = opt._accumulators[name]
+            for p, _ in params_grads:
+                t = accs.get(id(p))
+                if t is not None:
+                    state.append(t)
+        return state
+
+    # -- compile -------------------------------------------------------------
+    def _compile(self, key, params_grads, scale_fold: bool):
+        opt = self._opt
+        params = [p for p, _ in params_grads]
+        state_list = self._state_list(params_grads)
+        clip = opt._grad_clip
+        buckets = self.bucket_map(params_grads)
+        self.last_bucket_count = len(buckets)
+        _OBS_BUCKETS.set(len(buckets), opt=type(opt).__name__)
+        donate = donation_default(state_list[0]._d)
+        from ..jit.api import _trace_state
+
+        def pure(state_arrays, grad_arrays, *maybe_inv_scale):
+            # bind tracers into the live Tensors, run the optimizer's own
+            # update code, then restore — the StaticFunction._compile
+            # mechanism, specialized to the known optimizer state set
+            saved = [(t._d, t._node, t._out_index, t._grad)
+                     for t in state_list]
+            was_active = getattr(_trace_state, "active", False)
+            _trace_state.active = True
+            try:
+                for t, a in zip(state_list, state_arrays):
+                    t._d = a
+                    t._node = None
+                grads = [Tensor(a) for a in grad_arrays]
+                found = None
+                out_grads = []
+                if scale_fold:
+                    inv = maybe_inv_scale[0]
+                    unscaled, checks = [], []
+                    for g in grads:
+                        # mirror GradScaler.unscale_ exactly: f32 unscale,
+                        # finiteness on the f32 values, cast back — and
+                        # return the unscaled grads so p.grad observes them
+                        # (the legacy in-place rewrite contract)
+                        g32 = g._d.astype(jnp.float32) * inv
+                        checks.append(jnp.any(~jnp.isfinite(g32)))
+                        unscaled.append(Tensor(g32.astype(g._d.dtype)))
+                    grads = unscaled
+                    out_grads = [g._d for g in grads]
+                    found = functools.reduce(jnp.logical_or, checks)
+                pg = list(zip(params, grads))
+                if clip is not None:
+                    pg = clip(pg)
+                # device step counter first — bias correction must see the
+                # incremented value, as in the legacy step()
+                opt._step_tensor._data = opt._step_tensor._data + 1.0
+                for p, g in pg:
+                    if g is None:
+                        continue
+                    opt._append_optimize_op(p, g)
+                new_state = [t._d for t in state_list]
+                if found is not None:
+                    # inf-step skip, on device: revert every state element
+                    new_state = [jnp.where(found, old, new)
+                                 for old, new in zip(state_arrays, new_state)]
+            finally:
+                _trace_state.active = was_active
+                for t, (d, n, oi, g) in zip(state_list, saved):
+                    t._d = d
+                    t._node, t._out_index = n, oi
+                    t._grad = g
+            if found is None:
+                found = jnp.zeros((), jnp.bool_)
+            return new_state, found, out_grads
+
+        jitted = jax.jit(pure, donate_argnums=(0,) if donate else ())
+        # slot 4 holds the AOT-compiled executable, filled by step() via
+        # lower().compile() on the first dispatch — still inside the
+        # recoverable net, so trace errors (host sync in a subclass's
+        # _append_optimize_op) and XLA compile errors both fall back to the
+        # eager path instead of surfacing in _execute's may-have-run zone
+        entry = [jitted, state_list, donate, scale_fold, None]
+        # bound stale programs tightly: each entry's state_list strongly
+        # holds its params/accumulators, so a lingering entry for a removed
+        # parameter pins dead model state in device memory. 4 live entries
+        # cover the realistic mix (scale/no-scale siblings x one structure
+        # change); anything older is param churn and gets dropped.
+        if len(self._cache) >= 4:
+            self._cache.clear()
+            self._key_memo.clear()
+            self._prune_warm()
+        self._cache[key] = entry
+        self.compiles += 1
+        _OBS_COMPILES.inc(opt=type(opt).__name__)
+        if _flight.enabled():
+            _flight.record("opt_compile", opt=type(opt).__name__,
+                           params=len(params_grads), buckets=len(buckets),
+                           scale_fold=scale_fold, donate=donate)
+        return entry
+
+    # -- dispatch ------------------------------------------------------------
+    def _prepare_args(self, entry, params_grads, scale):
+        """Gather the jitted program's argument arrays. Touches no live
+        state, so a failure here is safe to fall back from."""
+        from ..jit.api import dedup_for_donation, stream_state_in
+        _, state_list, donate, scale_fold, _ = entry
+        grad_arrays = [g._data for _, g in params_grads]
+        # NOTE: reads go through ._data so an enclosing to_static DISCOVERY
+        # records the optimizer state into its own lifted state set
+        state_arrays = [stream_state_in(t, t._data) for t in state_list]
+        if donate:
+            state_arrays = dedup_for_donation(
+                state_arrays, {id(a) for a in grad_arrays})
+        args = [state_arrays, grad_arrays]
+        if scale_fold:
+            args.append(jnp.asarray(1.0 / scale, jnp.float32))
+        return args
+
+    def _execute(self, entry, args, params_grads):
+        from ..jit.api import stream_state_out
+        opt = self._opt
+        _, state_list, donate, scale_fold, compiled = entry
+        grad_arrays = args[1]
+        from ..profiler.profiler import op_timing_active, record_program
+        if op_timing_active():
+            t0 = time.perf_counter()
+            new_state, found, out_grads = compiled(*args)
+            jax.block_until_ready(new_state)
+            record_program(f"fused_opt:{type(opt).__name__}",
+                           time.perf_counter() - t0)
+        else:
+            new_state, found, out_grads = compiled(*args)
+        for t, a in zip(state_list, new_state):
+            t._data = stream_state_out(t, a)
+            t._node = None
+        if out_grads:
+            # scaler fold: p.grad must observe the UNSCALED grads, exactly
+            # like the legacy unscale_ in-place rewrite
+            for (_, g), a in zip(params_grads, out_grads):
+                g._data = a
+        self.dispatches += 1
+        if _flight.enabled():
+            _flight.record("opt_step", opt=type(opt).__name__,
+                           params=len(grad_arrays),
+                           buckets=self.last_bucket_count)
+        return found

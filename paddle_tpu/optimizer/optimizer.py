@@ -24,7 +24,7 @@ __all__ = ["Optimizer"]
 
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, name=None, multi_precision=False):
+                 grad_clip=None, name=None, multi_precision=False, fuse=None):
         from .lr import LRScheduler
         if parameters is None:
             # allowed while a static Program is recording: minimize() adopts
@@ -79,6 +79,12 @@ class Optimizer:
         self._accumulators: dict[str, dict[int, Tensor]] = defaultdict(dict)
         self._master_weights: dict[int, Tensor] = {}
         self._step_count = 0
+        # fused multi-tensor update (optimizer/fused.py): one jitted,
+        # structure-cached device computation per step instead of a kernel
+        # chain per parameter. fuse=None defers to PADDLE_TPU_FUSED_OPT.
+        from .fused import fuse_default
+        self._fuse = bool(fuse) if fuse is not None else fuse_default()
+        self._fused_impl = None
 
     # -- lr -----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -120,24 +126,67 @@ class Optimizer:
 
     # -- core update --------------------------------------------------------
     def step(self):
+        from ..jit.api import in_to_static_trace
         from ..profiler.profiler import host_self_span
         with host_self_span("optimizer_step(host)"):
-            params_grads = []
-            for p in self._parameter_list:
-                if p.stop_gradient or p._grad is None:
-                    continue
-                params_grads.append((p, p._grad))
-            if self._grad_clip is not None:
-                params_grads = self._grad_clip(params_grads)
-            self._step_count += 1
-            self._step_tensor._data = self._step_tensor._data + 1.0
-            for p, g in params_grads:
-                if g is None:
-                    continue
-                self._append_optimize_op(p, g)
+            if self._fuse and not in_to_static_trace():
+                self._fused().step()
+                return
+            if self._fuse:
+                # inside an enclosing to_static trace the unrolled loop IS
+                # fused — into the whole-train-step program; fires once per
+                # trace, not per step (host-side counter)
+                from .fused import note_outer_jit_step
+                note_outer_jit_step()
+            self._step_unfused()
+
+    def _fused(self):
+        if self._fused_impl is None:
+            from .fused import FusedOptimizerStep
+            self._fused_impl = FusedOptimizerStep(self)
+        return self._fused_impl
+
+    def _fused_scale_step(self, scale):
+        """GradScaler hook: fused unscale + found_inf + inf-skipped update in
+        one device computation. Returns the host found_inf bool, or None when
+        the fused path can't take it (fusion off, inside a trace, or the
+        state structure is cold) — the caller then runs the legacy
+        unscale_/step path."""
+        from ..jit.api import in_to_static_trace
+        if not self._fuse or in_to_static_trace():
+            return None
+        from ..profiler.profiler import host_self_span
+        with host_self_span("optimizer_step(host)"):
+            return self._fused().step(scale=scale)
+
+    def _step_unfused(self):
+        """The per-parameter update loop (the fused path's warm-up/escape
+        hatch, and the body every enclosing to_static trace unrolls)."""
+        params_grads = []
+        for p in self._parameter_list:
+            if p.stop_gradient or p._grad is None:
+                continue
+            params_grads.append((p, p._grad))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        self._step_tensor._data = self._step_tensor._data + 1.0
+        for p, g in params_grads:
+            if g is None:
+                continue
+            self._append_optimize_op(p, g)
 
     def _append_optimize_op(self, param, grad):
         raise NotImplementedError
+
+    def _fused_state_names(self, param):
+        """Accumulator names `_append_optimize_op` lazily creates for
+        `param`, or None when unknown. The fused path uses this to tell
+        "state restored in place by set_state_dict — fuse immediately, a
+        resumed run must be bit-identical to the uninterrupted one" apart
+        from "state missing — run one eager warm-up step to create it".
+        Subclasses that don't declare fall back to the warm-up heuristic."""
+        return None
 
     def clear_grad(self, set_to_zero: bool = False):
         for p in self._parameter_list:

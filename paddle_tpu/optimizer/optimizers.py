@@ -26,9 +26,12 @@ def _wd_coeff(weight_decay):
 
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, multi_precision=False, name=None):
+                 grad_clip=None, multi_precision=False, name=None, fuse=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
-                         name, multi_precision)
+                         name, multi_precision, fuse=fuse)
+
+    def _fused_state_names(self, p):
+        return []
 
     def _append_optimize_op(self, p, grad):
         g = self._apply_coupled_weight_decay(p, grad._data.astype(jnp.float32))
@@ -45,11 +48,14 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, name=None, fuse=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
-                         name, multi_precision)
+                         name, multi_precision, fuse=fuse)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+
+    def _fused_state_names(self, p):
+        return ["velocity"]
 
     def _append_optimize_op(self, p, grad):
         g = self._apply_coupled_weight_decay(p, grad._data.astype(jnp.float32))
@@ -74,12 +80,16 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
                  multi_precision=False, use_multi_tensor=False, name=None,
-                 amsgrad=False):
+                 amsgrad=False, fuse=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
-                         name, multi_precision)
+                         name, multi_precision, fuse=fuse)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._amsgrad = amsgrad
         self._decoupled = False
+
+    def _fused_state_names(self, p):
+        return ["moment1", "moment2", "moment2_max"] if self._amsgrad \
+            else ["moment1", "moment2"]
 
     def _lr_for(self, p):
         return self._lr(p)
@@ -151,10 +161,10 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, name=None, amsgrad=False):
+                 multi_precision=False, name=None, amsgrad=False, fuse=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
-                         name=name, amsgrad=amsgrad)
+                         name=name, amsgrad=amsgrad, fuse=fuse)
         self._decoupled = True
         self._regularization = None  # decay is decoupled, never coupled
         self._apply_decay_param_fun = apply_decay_param_fun
@@ -176,11 +186,14 @@ class AdamW(Adam):
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
-                 multi_precision=False, name=None):
+                 multi_precision=False, name=None, fuse=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
-                         name, multi_precision)
+                         name, multi_precision, fuse=fuse)
         self._epsilon = epsilon
         self._initial = initial_accumulator_value
+
+    def _fused_state_names(self, p):
+        return ["moment"]
 
     def _append_optimize_op(self, p, grad):
         g = self._apply_coupled_weight_decay(p, grad._data.astype(jnp.float32))
@@ -195,10 +208,13 @@ class Adagrad(Optimizer):
 class Adadelta(Optimizer):
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, name=None, fuse=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
-                         name, multi_precision)
+                         name, multi_precision, fuse=fuse)
         self._epsilon, self._rho = epsilon, rho
+
+    def _fused_state_names(self, p):
+        return ["avg_squared_grad", "avg_squared_update"]
 
     def _append_optimize_op(self, p, grad):
         g = self._apply_coupled_weight_decay(p, grad._data.astype(jnp.float32))
@@ -215,10 +231,13 @@ class Adadelta(Optimizer):
 class Adamax(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, name=None, fuse=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
-                         name, multi_precision)
+                         name, multi_precision, fuse=fuse)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _fused_state_names(self, p):
+        return ["moment", "inf_norm"]
 
     def _append_optimize_op(self, p, grad):
         g = self._apply_coupled_weight_decay(p, grad._data.astype(jnp.float32))
@@ -235,11 +254,15 @@ class Adamax(Optimizer):
 class RMSProp(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
-                 grad_clip=None, multi_precision=False, name=None):
+                 grad_clip=None, multi_precision=False, name=None, fuse=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
-                         name, multi_precision)
+                         name, multi_precision, fuse=fuse)
         self._rho, self._epsilon = rho, epsilon
         self._momentum, self._centered = momentum, centered
+
+    def _fused_state_names(self, p):
+        names = ["mean_square", "momentum"]
+        return names + ["mean_grad"] if self._centered else names
 
     def _append_optimize_op(self, p, grad):
         g = self._apply_coupled_weight_decay(p, grad._data.astype(jnp.float32))
@@ -260,12 +283,15 @@ class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, multi_precision=False,
-                 name=None):
+                 name=None, fuse=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name,
-                         multi_precision)
+                         multi_precision, fuse=fuse)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._lamb_wd = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _fused_state_names(self, p):
+        return ["moment1", "moment2"]
 
     def _use_fused_kernel(self, p) -> bool:
         from ..core.flags import flag

@@ -1,0 +1,640 @@
+"""Fused multi-tensor optimizer step (paddle_tpu/optimizer/fused.py).
+
+Contracts under test:
+
+* **Bit-exactness.** The fused program is built by tracing the optimizer's
+  own per-param update code, so it must be bit-identical to the unrolled
+  path — the trace a `to_static` step produces (the eager per-op path can
+  differ by 1 ULP where XLA contracts mul+sub into FMA inside compiled
+  programs; jit-vs-jit is the meaningful comparison and the one a real
+  train loop sees).
+* **One dispatch.** A steady-state `step()` over any number of params is
+  exactly one call into one cached jitted program — no per-param work, no
+  recompiles.
+* **Structure cache.** Adding/removing a parameter invalidates the plan
+  (one eager warm-up for new state, one recompile) and never reuses a stale
+  program.
+* **Resilience compatibility.** Checkpoint save→restore→resume through the
+  fused path is bit-identical, including an in-place restore (NaN-rewind
+  shape) into an already-compiled plan — no recompile, accumulator handles
+  rebind in place.
+* **GradScaler fold.** unscale + found_inf + the inf-step skip run inside
+  the fused program: inf steps leave every state element bit-untouched and
+  the scaler bookkeeping matches the legacy path exactly.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.observability as obs
+from paddle_tpu.optimizer import SGD, Momentum, Adam, AdamW, Lamb
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+
+def _model(seed=0, din=6, dh=12, dout=3):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(din, dh), nn.GELU(), nn.Linear(dh, dout))
+
+
+def _grads(i, params, scale=1.0):
+    rng = np.random.default_rng(1000 + i)
+    return [(rng.standard_normal(p.shape) * scale).astype(np.float32)
+            for p in params]
+
+
+def _set_grads(params, gs, dtype=None):
+    for p, g in zip(params, gs):
+        t = paddle.to_tensor(g)
+        p.grad = t.cast(dtype) if dtype else t
+
+
+def _state_arrays(opt, params):
+    """Every array the update owns, in a deterministic order."""
+    out = [np.asarray(p.numpy(), np.float32) for p in params]
+    for name in sorted(opt._accumulators):
+        for p in params:
+            t = opt._accumulators[name].get(id(p))
+            if t is not None:
+                out.append(np.asarray(t.numpy()))
+    for p in params:
+        t = opt._master_weights.get(id(p))
+        if t is not None:
+            out.append(np.asarray(t.numpy()))
+    out.append(np.float32(float(opt._step_tensor._data)))
+    return out
+
+
+def _assert_bitwise(a, b):
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert np.array_equal(x, y), \
+            f"state element {i} differs (max abs diff " \
+            f"{np.abs(x.astype(np.float64) - y.astype(np.float64)).max()})"
+
+
+def _run_fused(opt_cls, steps, grad_clip=None, bf16=False, **kw):
+    m = _model()
+    params = m.parameters()
+    if bf16:
+        for p in params:
+            p._data = p._data.astype("bfloat16")
+    opt = opt_cls(parameters=params, fuse=True, grad_clip=grad_clip, **kw)
+    for i in range(steps):
+        # step 0 runs the per-param path eagerly for EVERY class (stateless
+        # SGD included), mirroring the to_static reference whose step 0 is
+        # the eager discovery call — so both trajectories are eager at 0
+        # and jitted from 1 on, and bitwise comparison is apples-to-apples
+        if i == 0:
+            opt._fuse = False
+        _set_grads(params, _grads(i, params),
+                   dtype="bfloat16" if bf16 else None)
+        opt.step()
+        opt.clear_grad()
+        if i == 0:
+            opt._fuse = True
+    assert opt._fused_impl is not None
+    assert opt._fused_impl.dispatches == steps - 1
+    return opt, params
+
+
+def _run_unrolled(opt_cls, steps, grad_clip=None, bf16=False, **kw):
+    """Reference: the unrolled per-param loop, traced into one program by
+    to_static — today's flagship train-step path."""
+    m = _model()
+    params = m.parameters()
+    if bf16:
+        for p in params:
+            p._data = p._data.astype("bfloat16")
+    opt = opt_cls(parameters=params, fuse=False, grad_clip=grad_clip, **kw)
+
+    @paddle.jit.to_static
+    def update(*gs):
+        for p, g in zip(params, gs):
+            p._grad = g
+        opt.step()
+        return params[0].astype("float32").sum()
+
+    for i in range(steps):
+        gs = [paddle.to_tensor(g) for g in _grads(i, params)]
+        if bf16:
+            gs = [g.cast("bfloat16") for g in gs]
+        update(*gs)
+        opt.clear_grad()
+    return opt, params
+
+
+_CASES = [
+    (SGD, dict(learning_rate=0.1)),
+    (Momentum, dict(learning_rate=0.1, momentum=0.9, use_nesterov=True)),
+    (Adam, dict(learning_rate=0.01)),
+    (AdamW, dict(learning_rate=0.01, weight_decay=0.05)),
+    (Lamb, dict(learning_rate=0.01, lamb_weight_decay=0.02)),
+]
+
+
+@pytest.mark.parametrize("opt_cls,kw", _CASES,
+                         ids=[c[0].__name__ for c in _CASES])
+def test_fused_bitwise_matches_unrolled(opt_cls, kw):
+    fo, fp = _run_fused(opt_cls, 6, **kw)
+    uo, up = _run_unrolled(opt_cls, 6, **kw)
+    _assert_bitwise(_state_arrays(fo, fp), _state_arrays(uo, up))
+    # host step counter advances every fused step (the to_static reference
+    # only advances it during traces — host side effects don't replay; the
+    # DEVICE counter is authoritative and compared bitwise above)
+    assert fo._step_count == 6
+
+
+@pytest.mark.parametrize("opt_cls,kw", [(Adam, dict(learning_rate=0.01)),
+                                        (AdamW, dict(learning_rate=0.01))],
+                         ids=["Adam", "AdamW"])
+def test_fused_bitwise_global_norm_clip(opt_cls, kw):
+    clip = nn.ClipGradByGlobalNorm(0.25)
+    fo, fp = _run_fused(opt_cls, 6, grad_clip=clip, **kw)
+    clip2 = nn.ClipGradByGlobalNorm(0.25)
+    uo, up = _run_unrolled(opt_cls, 6, grad_clip=clip2, **kw)
+    _assert_bitwise(_state_arrays(fo, fp), _state_arrays(uo, up))
+
+
+@pytest.mark.parametrize("opt_cls,kw", [(AdamW, dict(learning_rate=0.01)),
+                                        (Momentum, dict(learning_rate=0.1))],
+                         ids=["AdamW", "Momentum"])
+def test_fused_bitwise_multi_precision(opt_cls, kw):
+    fo, fp = _run_fused(opt_cls, 6, bf16=True, multi_precision=True, **kw)
+    uo, up = _run_unrolled(opt_cls, 6, bf16=True, multi_precision=True, **kw)
+    assert fo._master_weights and uo._master_weights  # masters exist
+    for p in fp:
+        assert str(p._data.dtype) == "bfloat16"
+    _assert_bitwise(_state_arrays(fo, fp), _state_arrays(uo, up))
+
+
+# -- one dispatch, regardless of parameter count ----------------------------
+
+def test_single_dispatch_for_50_plus_params(monkeypatch):
+    params = []
+    for i in range(60):
+        p = paddle.framework.create_parameter([4, 3], dtype="float32",
+                                              name=f"mp_{i}")
+        p.set_value(np.full((4, 3), 0.1 * (i + 1), np.float32))
+        params.append(p)
+    opt = Adam(parameters=params, learning_rate=0.01, fuse=True)
+    for i in range(2):  # warm-up (state creation) + first fused compile
+        _set_grads(params, _grads(i, params))
+        opt.step()
+        opt.clear_grad()
+    impl = opt._fused_impl
+    d0, c0 = impl.dispatches, impl.compiles
+    f0 = obs.value("paddle_tpu_optimizer_fused_updates_total", path="fused")
+
+    # steady state: the per-param path must NEVER run — one jitted device
+    # computation per step, asserted via the dispatch/compile counters and
+    # by booby-trapping both per-param entry points
+    def boom(*a, **k):
+        raise AssertionError("per-param path used in steady state")
+
+    monkeypatch.setattr(Adam, "_append_optimize_op", boom)
+    monkeypatch.setattr(Optimizer, "_step_unfused", boom)
+    for i in range(3):
+        _set_grads(params, _grads(10 + i, params))
+        opt.step()
+        opt.clear_grad()
+    assert impl.dispatches == d0 + 3
+    assert impl.compiles == c0 == 1  # no retraces in steady state
+    assert obs.value("paddle_tpu_optimizer_fused_updates_total",
+                     path="fused") == f0 + 3
+    # the update actually applied
+    assert not np.allclose(params[0].numpy(), 0.1)
+
+
+def test_bucket_count_metric_and_flight_events():
+    from paddle_tpu.observability import flight
+    params = [paddle.framework.create_parameter([3], dtype="float32")
+              for _ in range(4)]
+    opt = Adam(parameters=params, learning_rate=0.01, fuse=True)
+    flight.clear()
+    for i in range(3):
+        _set_grads(params, _grads(i, params))
+        opt.step()
+        opt.clear_grad()
+    assert obs.value("paddle_tpu_optimizer_bucket_count", opt="Adam") >= 1
+    kinds = [e["kind"] for e in flight.events()]
+    assert "opt_compile" in kinds and "opt_step" in kinds
+
+
+# -- structure-cache invalidation -------------------------------------------
+
+def test_cache_invalidation_on_param_add_and_remove():
+    params = [paddle.framework.create_parameter([3], dtype="float32",
+                                                name=f"cp_{i}")
+              for i in range(3)]
+    opt = Adam(parameters=params, learning_rate=0.05, fuse=True)
+    for i in range(3):
+        _set_grads(params, _grads(i, params))
+        opt.step()
+        opt.clear_grad()
+    impl = opt._fused_impl
+    assert impl.compiles == 1
+
+    # ADD: the new param's state doesn't exist yet -> one eager warm-up
+    # step (covers all params), then a recompile on the next fused step
+    newp = paddle.framework.create_parameter([5], dtype="float32",
+                                             name="cp_new")
+    newp.set_value(np.zeros(5, np.float32))
+    opt._parameter_list.append(newp)
+    params2 = params + [newp]
+    _set_grads(params2, _grads(10, params2))
+    opt.step()  # eager warm-up for the changed structure
+    opt.clear_grad()
+    assert impl.compiles == 1
+    _set_grads(params2, _grads(11, params2))
+    opt.step()  # recompile + fused dispatch over 4 params
+    opt.clear_grad()
+    assert impl.compiles == 2
+    assert not np.allclose(newp.numpy(), 0.0)  # new param stepped
+
+    # REMOVE: the structure reverts to an already-seen key — the cached
+    # original program is REUSED (no recompile; the state tensors are the
+    # same objects) and the removed param is never touched again
+    # (identity-filter: Tensor == broadcasts)
+    opt._parameter_list = [q for q in opt._parameter_list if q is not newp]
+    frozen = newp.numpy().copy()
+    _set_grads(params, _grads(12, params))
+    opt.step()
+    opt.clear_grad()
+    assert impl.compiles == 2
+    np.testing.assert_array_equal(newp.numpy(), frozen)
+
+
+def test_clip_swap_mid_run_recompiles_not_stale():
+    """Swapping the grad-clip object mid-run must recompute the plan key —
+    the fast-path memo includes the clip identity, so the old program
+    (whose closure captured the old clip) must not keep running with the
+    old norm silently."""
+    p = paddle.framework.create_parameter([4], dtype="float32", name="cs_p")
+    p.set_value(np.zeros(4, np.float32))
+    opt = SGD(parameters=[p], learning_rate=1.0, fuse=True,
+              grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    g = np.full(4, 3.0, np.float32)  # global norm 6 -> always clipped
+    for i in range(3):
+        _set_grads([p], [g])
+        opt.step()
+        opt.clear_grad()
+    impl = opt._fused_impl
+    assert impl.compiles == 1
+    before = p.numpy().copy()
+    opt._grad_clip = nn.ClipGradByGlobalNorm(0.1)
+    _set_grads([p], [g])
+    opt.step()
+    opt.clear_grad()
+    assert impl.compiles == 2  # new plan for the new clip, no invalidate()
+    step_norm = np.linalg.norm(before - p.numpy())
+    np.testing.assert_allclose(step_norm, 0.1, rtol=1e-5)
+
+
+def test_weight_decay_change_mid_run_recompiles_not_stale():
+    """Decay is baked into the fused program as a trace constant, so
+    changing it mid-run must recompute the plan key (the memo stamps the
+    optimizer-level decay scalar) instead of serving the old program."""
+    m = _model()
+    params = _named_params(m)
+    opt = AdamW(parameters=params, learning_rate=0.01, weight_decay=0.5,
+                fuse=True)
+    for i in range(3):
+        _set_grads(params, _grads(i, params))
+        opt.step()
+        opt.clear_grad()
+    impl = opt._fused_impl
+    assert impl.compiles == 1
+    opt._weight_decay = 0.0  # _wd_value is a property over this knob
+    _set_grads(params, _grads(3, params))
+    opt.step()
+    opt.clear_grad()
+    assert impl.compiles == 2  # decay change -> new program, no invalidate()
+
+
+def test_pallas_flag_flip_mid_run_recompiles_not_stale():
+    """The pallas-kernel flag selects which update code the trace bakes in
+    (Lamb's fused-kernel dispatch), so flipping it mid-run must recompute
+    the plan key — the fast-path memo stamps the flag — instead of serving
+    the program traced under the old flag value."""
+    m = _model()
+    params = _named_params(m)
+    opt = SGD(parameters=params, learning_rate=0.01, fuse=True)
+    for i in range(3):
+        _set_grads(params, _grads(i, params))
+        opt.step()
+        opt.clear_grad()
+    impl = opt._fused_impl
+    assert impl.compiles == 1
+    try:
+        paddle.set_flags({"use_pallas_kernels": False})
+        _set_grads(params, _grads(3, params))
+        opt.step()
+        opt.clear_grad()
+    finally:
+        paddle.set_flags({"use_pallas_kernels": True})
+    assert impl.compiles == 2  # flag flip -> new program, no invalidate()
+
+
+def test_sharding_spec_swap_mid_run_recompiles_not_stale():
+    """Resharding a parameter replaces its sharding spec object (same
+    shape/dtype), which must recompute the plan key — the memo stamps the
+    spec identity per param — so the executable compiled against the old
+    shardings is never fed resharded arrays."""
+    m = _model()
+    params = _named_params(m)
+    opt = SGD(parameters=params, learning_rate=0.01, fuse=True)
+    for i in range(3):
+        _set_grads(params, _grads(i, params))
+        opt.step()
+        opt.clear_grad()
+    impl = opt._fused_impl
+    assert impl.compiles == 1
+    params[0]._sharding_spec = ("dp",)  # reshard: new spec object
+    _set_grads(params, _grads(3, params))
+    opt.step()
+    opt.clear_grad()
+    assert impl.compiles == 2  # new shardings -> new program, no invalidate()
+
+
+# -- checkpoint / resilience compatibility ----------------------------------
+
+def _fused_loop(opt, params, lo, hi, manager=None, save_at=None):
+    for i in range(lo, hi):
+        _set_grads(params, _grads(i, params))
+        opt.step()
+        opt.clear_grad()
+        if manager is not None and (i + 1) == save_at:
+            manager.save(i + 1, optimizer=opt, extra={"params": [
+                np.asarray(p.numpy()) for p in params]})
+
+
+def _named_params(m):
+    """Deterministic param names: state_dict binding is name-keyed, and
+    auto-generated names only reproduce across PROCESSES, not across two
+    models built in one test."""
+    params = m.parameters()
+    for j, p in enumerate(params):
+        p.name = f"fused_ck_p{j}"
+    return params
+
+
+def test_fused_checkpoint_save_restore_resume_parity(tmp_path):
+    from paddle_tpu.resilience import CheckpointManager
+
+    # straight run: 10 fused steps, checkpoint at 5
+    m = _model()
+    params = _named_params(m)
+    opt = Adam(parameters=params, learning_rate=0.05, fuse=True)
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    _fused_loop(opt, params, 0, 10, manager=mgr, save_at=5)
+    final = _state_arrays(opt, params)
+
+    # resumed run: fresh model + optimizer, restore at 5, continue to 10
+    m2 = _model()
+    params2 = _named_params(m2)
+    opt2 = Adam(parameters=params2, learning_rate=0.05, fuse=True)
+    mgr2 = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    restored = mgr2.restore(optimizer=opt2)
+    assert restored == 5
+    saved = mgr2.load_extra(5)["params"]
+    for p, a in zip(params2, saved):
+        p.set_value(a)
+    _fused_loop(opt2, params2, 5, 10)
+    # restore created complete state -> EVERY resumed step fused (no eager
+    # warm-up), which is what makes the resumed run bit-identical
+    assert opt2._fused_impl.dispatches == 5
+    _assert_bitwise(final, _state_arrays(opt2, params2))
+
+
+def test_fused_inplace_restore_keeps_compiled_plan(tmp_path):
+    """NaN-rewind shape: restore INTO a hot fused plan — accumulators rebind
+    in place, the compiled program stays valid, no recompile, and the
+    replayed trajectory is bit-identical."""
+    from paddle_tpu.resilience import CheckpointManager
+
+    m = _model()
+    params = m.parameters()
+    opt = Adam(parameters=params, learning_rate=0.05, fuse=True)
+    mgr = CheckpointManager(str(tmp_path / "ck2"), async_save=False)
+    _fused_loop(opt, params, 0, 8, manager=mgr, save_at=4)
+    state_at_8 = _state_arrays(opt, params)
+    impl = opt._fused_impl
+    compiles_before = impl.compiles
+
+    # rewind to 4 in place, replay 4..8 through the SAME plan
+    assert mgr.restore(optimizer=opt) == 4
+    for p, a in zip(params, mgr.load_extra(4)["params"]):
+        p.set_value(a)
+    _fused_loop(opt, params, 4, 8)
+    assert impl.compiles == compiles_before  # in-place rebind, no retrace
+    _assert_bitwise(state_at_8, _state_arrays(opt, params))
+
+
+# -- GradScaler fold ---------------------------------------------------------
+
+def _scaler_run(fused, inf_steps=(3,), steps=7):
+    paddle.seed(7)
+    w = paddle.framework.create_parameter([5], dtype="float32")
+    w.set_value(np.linspace(0.5, 1.5, 5).astype(np.float32))
+    opt = Adam(parameters=[w], learning_rate=0.1, fuse=fused)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=16.0)
+    snaps = []
+    for i in range(steps):
+        g = (_grads(i, [w])[0] * 16.0)
+        if i in inf_steps:
+            g[2] = np.inf
+        w.grad = paddle.to_tensor(g)
+        pre = _state_arrays(opt, [w])
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        snaps.append((pre, _state_arrays(opt, [w]), scaler._scale))
+    return opt, w, scaler, snaps
+
+
+def test_scaler_inf_step_skip_is_exact():
+    opt, w, scaler, snaps = _scaler_run(fused=True)
+    # the inf step leaves EVERY state element bit-untouched (device-side
+    # select), and the scale halves
+    pre, post, scale = snaps[3]
+    _assert_bitwise(pre, post)
+    assert scale == 8.0
+    assert scaler.inf_steps_total == 1
+    assert opt._step_count == 6  # 7 steps, 1 skipped
+    assert float(opt._step_tensor._data) == 6.0  # device counter in lockstep
+
+
+def test_scaler_fused_matches_legacy_bookkeeping():
+    of, wf, sf, nf = _scaler_run(fused=True, inf_steps=(2, 5))
+    ou, wu, su, nu = _scaler_run(fused=False, inf_steps=(2, 5))
+    assert sf._scale == su._scale
+    assert sf.inf_steps_total == su.inf_steps_total == 2
+    assert of._step_count == ou._step_count
+    assert float(of._step_tensor._data) == float(ou._step_tensor._data)
+    # trajectories agree to float precision (the legacy reference updates
+    # run eagerly, where XLA cannot FMA-contract across ops — 1 ULP class
+    # differences; fused-vs-jitted-unrolled exactness is covered above)
+    for (fa, fb, _), (ua, ub, _) in zip(nf, nu):
+        for x, y in zip(fb, ub):
+            np.testing.assert_allclose(x, y, rtol=2e-6, atol=2e-7)
+
+
+def test_scaler_explicit_unscale_then_step_still_legacy():
+    """unscale_() before step() (the clip-between pattern) keeps the legacy
+    contract: grads are rewritten unscaled in place, and step() must not
+    unscale twice."""
+    w = paddle.framework.create_parameter([4], dtype="float32")
+    w.set_value(np.ones(4, np.float32))
+    opt = Adam(parameters=[w], learning_rate=0.1, fuse=True)
+    # warm + compile the fused plan first so the fused path WOULD be taken
+    for i in range(2):
+        w.grad = paddle.to_tensor(_grads(i, [w])[0])
+        opt.step()
+        opt.clear_grad()
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    w.grad = paddle.to_tensor(np.full(4, 8.0, np.float32))
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(w.grad.numpy(), 2.0)  # unscaled in place
+    before = w.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    assert not np.array_equal(before, w.numpy())  # update applied once
+
+
+# -- escape hatches / fallback ----------------------------------------------
+
+def test_fuse_env_escape_hatch(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FUSED_OPT", "0")
+    w = paddle.framework.create_parameter([3], dtype="float32")
+    opt = SGD(parameters=[w], learning_rate=0.1)
+    assert opt._fuse is False
+    monkeypatch.setenv("PADDLE_TPU_FUSED_OPT", "1")
+    opt2 = SGD(parameters=[w], learning_rate=0.1)
+    assert opt2._fuse is True
+    opt3 = SGD(parameters=[w], learning_rate=0.1, fuse=False)
+    assert opt3._fuse is False
+
+
+def test_fused_compile_failure_falls_back_loudly(monkeypatch):
+    """A failure BEFORE the device program runs (key/compile/arg-prep) is
+    safe to recover from: the step still applies via the per-param path."""
+    from paddle_tpu.optimizer.fused import FusedOptimizerStep
+
+    def broken(self, *a, **k):
+        raise RuntimeError("injected compile failure")
+
+    monkeypatch.setattr(FusedOptimizerStep, "_compile", broken)
+    w = paddle.framework.create_parameter([3], dtype="float32")
+    w.set_value(np.zeros(3, np.float32))
+    opt = SGD(parameters=[w], learning_rate=0.5, fuse=True)
+    w.grad = paddle.to_tensor(np.ones(3, np.float32))
+    with pytest.warns(RuntimeWarning, match="fused optimizer step failed"):
+        opt.step()  # falls back, still applies the update
+    assert opt._fuse is False
+    np.testing.assert_allclose(w.numpy(), -0.5)
+    assert opt._step_count == 1
+
+
+def test_fused_execute_failure_never_resteps(monkeypatch):
+    """A failure once the device program may have run must NOT re-apply the
+    update (double-step corruption / donated-buffer reads): it surfaces,
+    and later steps use the per-param path."""
+    from paddle_tpu.optimizer.fused import FusedOptimizerStep
+
+    w = paddle.framework.create_parameter([3], dtype="float32")
+    w.set_value(np.zeros(3, np.float32))
+    opt = SGD(parameters=[w], learning_rate=0.5, fuse=True)
+    w.grad = paddle.to_tensor(np.ones(3, np.float32))
+    opt.step()  # hot fused plan
+
+    def broken(self, *a, **k):
+        raise RuntimeError("injected execute failure")
+
+    monkeypatch.setattr(FusedOptimizerStep, "_execute", broken)
+    w.grad = paddle.to_tensor(np.ones(3, np.float32))
+    before = w.numpy().copy()
+    with pytest.warns(RuntimeWarning, match="NOT re-running"):
+        with pytest.raises(RuntimeError, match="injected execute failure"):
+            opt.step()
+    np.testing.assert_array_equal(w.numpy(), before)  # no sneaky re-step
+    assert opt._fuse is False
+    # recovery: the next step runs the per-param path
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), -1.0)
+
+
+def test_scaler_fused_hook_respects_wrapper_step_overrides():
+    """A delegating wrapper whose step() adds post-update work (ASP mask
+    re-application, ZeRO offload streaming) must NOT be bypassed by the
+    scaler's fused hook — __getattr__ forwards _fused_scale_step from the
+    inner optimizer, but the wrapper never opted in."""
+    w = paddle.framework.create_parameter([4], dtype="float32")
+    w.set_value(np.ones(4, np.float32))
+    opt = Adam(parameters=[w], learning_rate=0.1, fuse=True)
+    calls = []
+
+    class Wrapper:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def step(self):
+            self._inner.step()
+            calls.append("wrapped_step")  # the behavior bypass would lose
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+    wrapped = Wrapper(opt)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    for i in range(3):
+        w.grad = paddle.to_tensor(_grads(i, [w])[0] * 4.0)
+        scaler.step(wrapped)
+        scaler.update()
+        opt.clear_grad()
+    assert calls == ["wrapped_step"] * 3  # every step went through step()
+
+    # a pure delegator that explicitly defines the hook DOES get the fold
+    from paddle_tpu.distributed.meta_parallel.hybrid_parallel_optimizer \
+        import HybridParallelOptimizer
+    w2 = paddle.framework.create_parameter([4], dtype="float32")
+    opt2 = Adam(parameters=[w2], learning_rate=0.1, fuse=True)
+    hp = HybridParallelOptimizer(opt2)
+    scaler2 = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    for i in range(3):
+        w2.grad = paddle.to_tensor(_grads(i, [w2])[0] * 4.0)
+        scaler2.step(hp)
+        scaler2.update()
+        opt2.clear_grad()
+    assert opt2._fused_impl is not None
+    assert opt2._fused_impl.dispatches >= 1  # fused fold taken via opt-in
+
+
+def test_trace_unsafe_custom_optimizer_falls_back_eagerly():
+    """A custom subclass whose update math is trace-unsafe (host sync /
+    data-dependent Python branch) worked eagerly before fusion existed; the
+    fused path must detect that AT COMPILE (jit traces lazily — step()
+    forces trace + XLA compile via lower().compile() inside the recoverable
+    net) and fall back to the per-param path instead of crashing out of the
+    first hot dispatch."""
+    import jax.numpy as jnp
+
+    class HostSyncSGD(SGD):
+        def _append_optimize_op(self, p, grad):
+            # host pull of a traced value: ConcretizationTypeError under jit
+            if float(jnp.max(jnp.abs(grad._data))) > 1e6:
+                return
+            super()._append_optimize_op(p, grad)
+
+    w = paddle.framework.create_parameter([3], dtype="float32")
+    w.set_value(np.zeros(3, np.float32))
+    opt = HostSyncSGD(parameters=[w], learning_rate=0.5, fuse=True)
+    w.grad = paddle.to_tensor(np.ones(3, np.float32))
+    with pytest.warns(RuntimeWarning, match="fused optimizer step failed"):
+        opt.step()  # trace fails during lower() -> safe eager fallback
+    assert opt._fuse is False
+    np.testing.assert_allclose(w.numpy(), -0.5)  # the update still applied
+    w.grad = paddle.to_tensor(np.ones(3, np.float32))
+    opt.step()  # stays on the per-param path, no warning, no crash
+    np.testing.assert_allclose(w.numpy(), -1.0)

@@ -314,3 +314,59 @@ def test_multiprocess_slow_worker_within_budget_recovers():
         np.testing.assert_array_equal(np.sort(ys), np.arange(8))
     finally:
         faults.uninstall()
+
+
+# -- prefetch_to_device ------------------------------------------------------
+
+def test_prefetch_to_device_order_and_structure():
+    from paddle_tpu.io import prefetch_to_device
+
+    def gen():
+        for i in range(7):
+            yield i, np.full((2, 3), i, np.float32), {"y": np.arange(i + 1)}
+
+    out = list(prefetch_to_device(gen(), depth=2))
+    assert len(out) == 7
+    for i, (idx, x, d) in enumerate(out):
+        assert idx == i  # non-array leaves pass through untouched, in order
+        assert isinstance(x, paddle.Tensor)
+        np.testing.assert_array_equal(np.asarray(x.numpy()), i)
+        assert isinstance(d["y"], paddle.Tensor)
+        np.testing.assert_array_equal(np.asarray(d["y"].numpy()),
+                                      np.arange(i + 1))
+
+
+def test_prefetch_to_device_wraps_dataloader_and_counts():
+    import paddle_tpu.observability as obs
+    from paddle_tpu.io import prefetch_to_device
+
+    c0 = obs.total("paddle_tpu_io_prefetch_batches_total")
+    ds = TensorDataset([paddle.to_tensor(np.arange(12, dtype=np.float32)
+                                         .reshape(12, 1))])
+    dl = DataLoader(ds, batch_size=3)
+    got = [b[0] for b in prefetch_to_device(dl, depth=3)]
+    assert len(got) == 4
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b.numpy()).ravel() for b in got]),
+        np.arange(12))
+    assert obs.total("paddle_tpu_io_prefetch_batches_total") == c0 + 4
+
+
+def test_prefetch_to_device_namedtuple_batches():
+    import collections
+    from paddle_tpu.io import prefetch_to_device
+
+    Batch = collections.namedtuple("Batch", "x y")
+    out = list(prefetch_to_device(
+        (Batch(np.full(3, i, np.float32), i) for i in range(4)), depth=2))
+    assert len(out) == 4
+    for i, b in enumerate(out):
+        assert isinstance(b, Batch)
+        np.testing.assert_array_equal(np.asarray(b.x.numpy()), i)
+        assert b.y == i
+
+
+def test_prefetch_depth_validation():
+    from paddle_tpu.io import prefetch_to_device
+    with pytest.raises(ValueError, match="depth"):
+        prefetch_to_device([], depth=0)

@@ -16,11 +16,16 @@ is the BEST of the last 3 recorded rounds for the same metric — a slow
 round cannot quietly lower the bar for the next one — tolerance tightens
 to 3%, and the signed delta is printed so a regression fails loudly.
 
-Beyond throughput, two soft gates ride the same baseline (both lower-is-
-better, both env-tunable, value <= 0 disables):
+Beyond throughput, three soft gates ride the same baseline (all lower-is-
+better, all env-tunable, value <= 0 disables):
 
   steady-state step latency  extra.step_breakdown.step_ms, tolerance
                              PERF_GATE_STEP_TOL_PCT (default 10%)
+  host dispatch per step     extra.step_breakdown.host_dispatch_ms,
+                             tolerance PERF_GATE_DISPATCH_TOL_PCT (default
+                             150% — the measurement is scheduler-noisy; the
+                             gate exists to catch a per-param optimizer
+                             dispatch loop creeping back, a ~10x jump)
   peak HBM                   extra.peak_hbm_bytes (bench memory census),
                              tolerance PERF_GATE_HBM_TOL_PCT (default 5%)
 
@@ -128,6 +133,19 @@ def step_latency_ms(d):
         return None
 
 
+def host_dispatch_ms(d):
+    """Steady-state host dispatch cost per step from the bench's step
+    breakdown (None when the round predates it). Guards the fused-optimizer
+    contract: step() must stay one dispatch, not a per-param kernel chain."""
+    try:
+        v = d["extra"]["step_breakdown"]["host_dispatch_ms"]
+        # explicit None check (not falsy): a genuine 0.0 reading must gate,
+        # not silently disable the gate
+        return float(v) if v is not None else None
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
 def peak_hbm_bytes(d):
     """Peak device memory from the bench's memory census (None when the
     round predates `extra.peak_hbm_bytes`)."""
@@ -154,6 +172,13 @@ def soft_gates(cd, bd):
     for name, get, env, default, unit in (
             ("step_latency", step_latency_ms, "PERF_GATE_STEP_TOL_PCT",
              10.0, "ms"),
+            # host dispatch: wide default tolerance — the single-sample
+            # measurement swung 4x between r04/r05 on scheduler noise alone
+            # (bench now averages several enqueues, but old baselines are
+            # single samples); still catches a per-param dispatch loop
+            # creeping back in, which is an order-of-magnitude regression
+            ("host_dispatch", host_dispatch_ms, "PERF_GATE_DISPATCH_TOL_PCT",
+             150.0, "ms"),
             ("peak_hbm", peak_hbm_bytes, "PERF_GATE_HBM_TOL_PCT",
              5.0, "bytes")):
         tol = _tol_pct(env, default)
