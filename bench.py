@@ -362,6 +362,57 @@ def run_llama_bench(dev):
     }
 
 
+def _graph_analysis_block(model, batch, seq, vocab):
+    """Static graph-tier analysis (paddle_tpu.analysis.graph) of the bench
+    model: the top-3 fusion candidates ranked by estimated saved HBM bytes
+    — ROADMAP item 2's mega-kernel target list — plus the static
+    peak-liveness HBM estimate cross-validated against one measured
+    attribute_memory() forward at the same shapes. Never fails the bench:
+    returns {"error": ...} on any problem."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.analysis.graph import analyze_graph, trace_layer
+        from paddle_tpu.observability.memory import attribute_memory
+
+        x = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+        y = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+        report = analyze_graph(trace_layer(model, x, labels=y),
+                               name="bench:gpt",
+                               exclude_files=(__file__,))
+        block = {
+            "top_fusion_candidates": report.top_candidates(3),
+            "static_peak_hbm_bytes": int(report.liveness.peak_bytes),
+            "static_top_owners": [dict(o) for o in
+                                  report.liveness.owners[:3]],
+            "n_findings": len(report.findings),
+            "n_errors": sum(1 for f in report.findings
+                            if f.severity == "error"),
+        }
+        # measured side of the cross-validation: ONE eager no-grad forward
+        # with per-module attribution (the same program the static tier
+        # just analyzed — forward + loss, no backward)
+        rng = np.random.default_rng(0)
+        xt = paddle.to_tensor(
+            rng.integers(0, vocab, (batch, seq)).astype("int32"))
+        yt = paddle.to_tensor(
+            rng.integers(0, vocab, (batch, seq)).astype("int32"))
+        with paddle.no_grad():
+            with attribute_memory(model) as attr:
+                model(xt, labels=yt)
+        measured = max((int(st.get("peak_bytes", 0))
+                        for st in attr.peaks.values()), default=0)
+        if measured:
+            block["measured_peak_hbm_bytes"] = measured
+            block["static_vs_measured"] = round(
+                block["static_peak_hbm_bytes"] / measured, 3)
+        return block
+    except Exception:
+        return {"error": traceback.format_exc(limit=1)[:300]}
+
+
 def run_gpt_bench(dev, on_tpu):
     import numpy as np
     import paddle_tpu as paddle
@@ -402,6 +453,8 @@ def run_gpt_bench(dev, on_tpu):
             "dtype": "bf16" if on_tpu else "f32",
             "step_breakdown": breakdown,
             "peak_flops": peak, "peak_flops_source": peak_src,
+            "graph_analysis": _graph_analysis_block(
+                model, batch, seq, cfg.vocab_size),
         },
     }
 

@@ -1,14 +1,19 @@
-"""paddle_tpu.analysis — static trace-safety analysis for ``to_static``.
+"""paddle_tpu.analysis — static analysis for ``to_static``, in two tiers.
 
-The decide-at-decoration-time subsystem (reference analog: SOT's bytecode
-scanner + the dy2static AST pass under ``python/paddle/jit/``): an AST
-rule engine that catches retrace storms, graph breaks, host syncs, frozen
-RNG/side effects, and untracked state writes in code headed for
+**AST tier** (this module; reference analog: SOT's bytecode scanner + the
+dy2static AST pass under ``python/paddle/jit/``): an AST rule engine that
+catches retrace storms, graph breaks, host syncs, frozen RNG/side
+effects, and untracked state writes in code headed for
 ``paddle_tpu.jit.to_static`` — before step 500 of a training run finds
 them as a climbing ``paddle_tpu_jit_trace_cache_retraces_total`` counter
 or a 100x step-time cliff.
 
-Three entry points:
+**Graph tier** (:mod:`paddle_tpu.analysis.graph`, rules GA100-GA109):
+lints the traced *jaxpr* — fusion boundaries, HBM traffic, implied
+reshards, peak liveness — via ``to_static(..., analyze=True)`` or
+``python -m paddle_tpu.analysis.graph``.
+
+AST-tier entry points:
 
 * ``to_static(..., lint=True)`` or ``PADDLE_TPU_JIT_LINT=1`` — lint at
   decoration time; findings become :class:`TraceSafetyWarning`.
@@ -21,8 +26,8 @@ Rule ids are stable (``TS001``..); the table lives in
 """
 
 from .diagnostics import (  # noqa: F401
-    ERROR, WARNING, INFO, SEVERITIES, Finding, TraceSafetyWarning,
-    format_text, severity_rank,
+    ERROR, WARNING, INFO, SEVERITIES, Finding, GraphAnalysisWarning,
+    TraceSafetyWarning, format_text, severity_rank,
 )
 from .engine import (  # noqa: F401
     analyze_source, analyze_file, analyze_function, analyze_paths,
@@ -32,7 +37,8 @@ from .rules import Rule, RULES, check_module  # noqa: F401
 
 __all__ = [
     "ERROR", "WARNING", "INFO", "SEVERITIES",
-    "Finding", "TraceSafetyWarning", "format_text", "severity_rank",
+    "Finding", "TraceSafetyWarning", "GraphAnalysisWarning",
+    "format_text", "severity_rank",
     "analyze_source", "analyze_file", "analyze_function", "analyze_paths",
     "has_errors", "Rule", "RULES", "check_module",
 ]

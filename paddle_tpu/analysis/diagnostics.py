@@ -12,7 +12,7 @@ from dataclasses import dataclass, field
 
 __all__ = [
     "ERROR", "WARNING", "INFO", "SEVERITIES", "severity_rank",
-    "Finding", "TraceSafetyWarning", "format_text",
+    "Finding", "TraceSafetyWarning", "GraphAnalysisWarning", "format_text",
 ]
 
 ERROR = "error"
@@ -33,6 +33,11 @@ def severity_rank(severity: str) -> int:
 
 class TraceSafetyWarning(UserWarning):
     """Emitted by ``to_static(..., lint=True)`` for each lint finding."""
+
+
+class GraphAnalysisWarning(UserWarning):
+    """Emitted by ``to_static(..., analyze=True)`` for each graph-tier
+    (jaxpr-level) finding at first compile of a signature."""
 
 
 @dataclass

@@ -1,0 +1,63 @@
+"""paddle_tpu.analysis.graph — the jaxpr-tier program analyzer.
+
+The second analysis tier: where :mod:`paddle_tpu.analysis` (the AST
+tier, rules TS000-TS009) lints Python *source*, this package lints the
+traced *program* — the jaxpr obtained by abstract-evaluating a
+``to_static``/jitted function on ShapeDtype avals, with no device
+execution. It answers the questions only the graph can answer:
+
+* where the fusion boundaries are and what each costs in HBM round
+  trips (rules GA100-GA102, the fusion-candidate ranking bench.py
+  embeds in its JSON line — ROADMAP item 2's static target list);
+* which transfers and computations are redundant or dead (GA103-GA105);
+* which PartitionSpec edges imply silent GSPMD reshards, with the
+  implied collectives counted (GA106-GA107);
+* the static peak-liveness HBM estimate cross-validated against
+  ``attribute_memory()`` measured peaks (GA108), and whether the
+  program is memory-bound at all (GA109).
+
+Entry points:
+
+* ``to_static(..., analyze=True)`` / ``PADDLE_TPU_JIT_ANALYZE=1`` —
+  analyze the compiled step's jaxpr at first compile; findings surface
+  as :class:`~paddle_tpu.analysis.diagnostics.GraphAnalysisWarning`.
+* ``python -m paddle_tpu.analysis.graph <entrypoint>`` — CLI over
+  registered entrypoints (``--list-entrypoints``) or ``file.py:fn``.
+* this module's functions — programmatic access (trace + analyze).
+
+Rule ids are stable (GA100..GA109); the table lives in
+``docs/static_analysis.md`` and ``--list-rules``.
+"""
+
+from .fusion import (  # noqa: F401
+    FusionCandidate, FusionGroup, boundary_edges, fusion_candidates,
+    fusion_groups,
+)
+from .ir import (  # noqa: F401
+    DataflowGraph, OpNode, aval_bytes, build_graph, classify,
+)
+from .liveness import LivenessReport, peak_liveness  # noqa: F401
+from .rules import (  # noqa: F401
+    GA_RULES, GraphReport, GraphRuleConfig, analyze_graph, check_graph,
+    implied_collectives,
+)
+from .trace import (  # noqa: F401
+    aval_of, avals_like, trace_callable, trace_layer,
+    trace_static_function,
+)
+from .entrypoints import (  # noqa: F401
+    ENTRYPOINTS, GATE_ENTRYPOINTS, build_entrypoint, list_entrypoints,
+)
+
+__all__ = [
+    "DataflowGraph", "OpNode", "aval_bytes", "build_graph", "classify",
+    "FusionCandidate", "FusionGroup", "boundary_edges",
+    "fusion_candidates", "fusion_groups",
+    "LivenessReport", "peak_liveness",
+    "GA_RULES", "GraphReport", "GraphRuleConfig", "analyze_graph",
+    "check_graph", "implied_collectives",
+    "aval_of", "avals_like", "trace_callable", "trace_layer",
+    "trace_static_function",
+    "ENTRYPOINTS", "GATE_ENTRYPOINTS", "build_entrypoint",
+    "list_entrypoints",
+]

@@ -1,0 +1,111 @@
+"""CLI: ``python -m paddle_tpu.analysis.graph <entrypoint> [--format json]``.
+
+The graph-tier twin of ``python -m paddle_tpu.analysis``: traces the
+entrypoint's jaxpr (abstract eval, no device execution), runs rules
+GA100-GA109, prints findings plus the ranked fusion-candidate table, and
+exits nonzero when any error-severity finding remains after filtering —
+the same CI-gate contract the AST tier has.
+
+Entrypoints: a registered name (``--list-entrypoints``) or a custom
+``path/to/file.py:fn`` where ``fn`` is a zero-arg callable returning a
+``ClosedJaxpr`` (see ``paddle.analysis.graph.trace_layer``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..diagnostics import SEVERITIES, format_text, severity_rank
+from .entrypoints import build_entrypoint, list_entrypoints
+from .rules import GA_RULES, analyze_graph
+
+
+def _rule_table() -> str:
+    rows = [f"{r.id}  {r.severity:7s}  {r.name}: {r.summary}"
+            for r in sorted(GA_RULES.values(), key=lambda r: r.id)]
+    return "\n".join(rows)
+
+
+def _candidate_table(report, top: int) -> str:
+    rows = ["top fusion candidates (est. saved HBM bytes per step):"]
+    for i, c in enumerate(report.top_candidates(top)):
+        sites = f" ×{c['sites']} sites" if c["sites"] > 1 else ""
+        span = f"  {c['span']}" if c["span"] else ""
+        rows.append(f"  {i + 1}. {c['name']}  saves {c['saved_bytes']:,} B"
+                    f"{sites}  ({c['n_ops']} ops, {c['n_regions']} "
+                    f"regions){span}")
+    if len(rows) == 1:
+        rows.append("  (none above threshold)")
+    return "\n".join(rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis.graph",
+        description="Graph-level program analyzer: fusion-boundary, "
+                    "memory-traffic, and sharding-consistency lints over "
+                    "traced jaxprs (docs/static_analysis.md#graph-tier).")
+    ap.add_argument("entrypoints", nargs="*",
+                    help="registered entrypoint name(s) or file.py:fn")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids (e.g. GA100,GA106)")
+    ap.add_argument("--min-severity", choices=SEVERITIES, default="info")
+    ap.add_argument("--top", type=int, default=3,
+                    help="fusion candidates to print (default 3)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-entrypoints", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_rule_table())
+        return 0
+    if args.list_entrypoints:
+        for name in list_entrypoints():
+            print(name)
+        return 0
+    if not args.entrypoints:
+        ap.error("no entrypoint given (or use --list-entrypoints / "
+                 "--list-rules)")
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    rc = 0
+    payloads = []
+    for spec in args.entrypoints:
+        jaxpr, name = build_entrypoint(spec)
+        report = analyze_graph(jaxpr, name=name)
+        findings = report.findings
+        if args.select:
+            keep = {s.strip().upper() for s in args.select.split(",")}
+            findings = [f for f in findings if f.rule_id in keep]
+        max_rank = severity_rank(args.min_severity)
+        findings = [f for f in findings
+                    if severity_rank(f.severity) <= max_rank]
+        n_err = sum(1 for f in findings if f.severity == "error")
+        rc = rc or (1 if n_err else 0)
+        if args.format == "json":
+            d = report.to_dict()
+            d["findings"] = [f.to_dict() for f in findings]
+            d["counts"] = {s: sum(1 for f in findings if f.severity == s)
+                           for s in SEVERITIES}
+            d["top_fusion_candidates"] = report.top_candidates(args.top)
+            payloads.append(d)
+        else:
+            print(f"== {name}: {report.n_ops} ops, "
+                  f"{report.total_flops / 1e6:.1f} MFLOP, "
+                  f"{report.total_bytes / (1 << 20):.1f} MiB op traffic")
+            for f in findings:
+                print(format_text(f))
+            print(_candidate_table(report, args.top))
+            print(f"{len(findings)} finding(s), {n_err} error(s)")
+    if args.format == "json":
+        print(json.dumps(payloads[0] if len(payloads) == 1
+                         else {"entrypoints": payloads}, indent=2))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,158 @@
+"""Named analyzable entrypoints for the graph-tier CLI and CI gate.
+
+An entrypoint is a zero-arg builder that constructs a model at a pinned
+(small, CPU-traceable) config and returns its traced ``ClosedJaxpr`` —
+abstract evaluation only, no training step runs. The registry covers the
+repo's runnable surfaces the same way ``tools/lint_examples.py`` covers
+them for the AST tier:
+
+* ``bench:gpt`` / ``bench:gpt-block`` — the bench.py CPU-smoke GPT (the
+  config whose measured peaks the cross-validation test compares against);
+* ``models:gpt-tiny`` / ``models:llama-tiny`` — the model-zoo forwards the
+  examples train;
+* ``demo:planted-reshard`` — a deliberately planted PartitionSpec
+  mismatch (two conflicting constraints around an elementwise chain);
+  the GA106 regression proof the docs and tests point at.
+
+Custom entrypoints: pass ``path/to/file.py:fn`` to the CLI, where ``fn``
+is a zero-arg callable returning a ``ClosedJaxpr`` (build one with
+:func:`~.trace.trace_layer` / :func:`~.trace.trace_callable`).
+"""
+
+from __future__ import annotations
+
+from .trace import trace_callable, trace_layer
+
+__all__ = ["ENTRYPOINTS", "build_entrypoint", "list_entrypoints",
+           "GATE_ENTRYPOINTS"]
+
+
+def _avals(*shapes_dtypes):
+    import jax
+    import jax.numpy as jnp
+    out = []
+    for shape, dt in shapes_dtypes:
+        out.append(jax.ShapeDtypeStruct(shape, getattr(jnp, dt)))
+    return out
+
+
+def _bench_gpt_cfg():
+    from ...models import GPTConfig
+    # MUST stay in lockstep with bench.py run_gpt_bench's CPU-smoke config:
+    # the cross-validation test compares this program's static peak against
+    # attribute_memory() measured on the same model
+    return GPTConfig(vocab_size=1024, max_position_embeddings=256,
+                     hidden_size=256, num_layers=4, num_heads=8)
+
+
+def ep_bench_gpt():
+    """Forward + loss of the bench CPU-smoke GPT at bench shapes."""
+    import paddle_tpu as paddle
+    from ...models import GPT
+    paddle.seed(0)
+    model = GPT(_bench_gpt_cfg())
+    x, y = _avals(((4, 256), "int32"), ((4, 256), "int32"))
+    return trace_layer(model, x, labels=y)
+
+
+def ep_bench_gpt_block():
+    """One transformer Block of the bench GPT (the mega-kernel unit)."""
+    import paddle_tpu as paddle
+    from ...models.gpt import Block
+    paddle.seed(0)
+    blk = Block(_bench_gpt_cfg())
+    (x,) = _avals(((4, 256, 256), "float32"))
+    return trace_layer(blk, x)
+
+
+def ep_models_gpt_tiny():
+    import paddle_tpu as paddle
+    from ...models import gpt2_tiny
+    paddle.seed(0)
+    model = gpt2_tiny()
+    x, y = _avals(((2, 32), "int32"), ((2, 32), "int32"))
+    return trace_layer(model, x, labels=y)
+
+
+def ep_models_llama_tiny():
+    import paddle_tpu as paddle
+    from ...models import Llama, LlamaConfig
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=256, max_position_embeddings=64,
+                      hidden_size=64, num_layers=2, num_heads=4,
+                      num_kv_heads=2, intermediate_size=128)
+    model = Llama(cfg)
+    x, y = _avals(((2, 32), "int32"), ((2, 32), "int32"))
+    return trace_layer(model, x, labels=y)
+
+
+def ep_planted_reshard():
+    """Deliberate GA106 trigger: conflicting PartitionSpecs around an
+    elementwise chain — GSPMD would silently all-gather + re-slice here."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:1])
+    mesh = Mesh(devs.reshape(1,), ("mp",))
+
+    def f(x):
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, "mp")))
+        y = jnp.tanh(x) * 2.0
+        y = jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P("mp", None)))
+        return y.sum()
+
+    (x,) = _avals(((256, 1024), "float32"))
+    return trace_callable(f, x)
+
+
+ENTRYPOINTS = {
+    "bench:gpt": ep_bench_gpt,
+    "bench:gpt-block": ep_bench_gpt_block,
+    "models:gpt-tiny": ep_models_gpt_tiny,
+    "models:llama-tiny": ep_models_llama_tiny,
+    "demo:planted-reshard": ep_planted_reshard,
+}
+
+#: the CI-gate subset (tools/lint_examples.py): the repo's own surfaces,
+#: which must stay free of error-severity GA findings. The planted-reshard
+#: demo is deliberately NOT here — it exists to fail.
+GATE_ENTRYPOINTS = ("bench:gpt", "bench:gpt-block", "models:gpt-tiny",
+                    "models:llama-tiny")
+
+
+def list_entrypoints():
+    return sorted(ENTRYPOINTS)
+
+
+def _load_custom(spec: str):
+    """``path/to/file.py:fn`` -> the ClosedJaxpr returned by fn()."""
+    import importlib.util
+    import os
+    path, _, attr = spec.rpartition(":")
+    # a typo'd registered name (bench:typo) must say so, not crash in the
+    # module loader
+    if not path or not attr or not os.path.isfile(path):
+        raise ValueError(
+            f"unknown entrypoint {spec!r}: not a registered name "
+            f"({', '.join(list_entrypoints())}) and not an existing "
+            f"file.py:fn")
+    spec_obj = importlib.util.spec_from_file_location(
+        os.path.splitext(os.path.basename(path))[0] + "_ga", path)
+    if spec_obj is None or spec_obj.loader is None:
+        raise ValueError(f"cannot import entrypoint file {path!r}")
+    mod = importlib.util.module_from_spec(spec_obj)
+    spec_obj.loader.exec_module(mod)
+    fn = getattr(mod, attr)
+    return fn()
+
+
+def build_entrypoint(name: str):
+    """(ClosedJaxpr, display_name) for a registered or custom entrypoint."""
+    builder = ENTRYPOINTS.get(name)
+    if builder is not None:
+        return builder(), name
+    return _load_custom(name), name

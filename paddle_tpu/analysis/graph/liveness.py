@@ -1,0 +1,95 @@
+"""Peak-liveness HBM estimation over a :class:`~.ir.DataflowGraph`.
+
+A linear scan in program order: a value becomes live when produced (graph
+inputs and constants are live from the start) and dies after its last
+consumer. The running live-set byte total's maximum is the static peak —
+the jaxpr-tier analog of the allocator's ``peak_bytes_in_use``, which the
+bench cross-validates against ``attribute_memory()`` measured peaks
+(docs/static_analysis.md#graph-tier documents the expected gap: the
+static scan frees at exact last use and sees intra-op temporaries that
+module-boundary probes miss, so it upper-bounds the measured number).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .ir import DataflowGraph, aval_bytes
+
+__all__ = ["LivenessReport", "peak_liveness"]
+
+
+@dataclass
+class LivenessReport:
+    peak_bytes: int = 0            # args + consts + live intermediates
+    args_bytes: int = 0            # graph inputs + constants (always live)
+    peak_index: int = -1           # node index where the peak occurs
+    peak_file: str = ""
+    peak_line: int = 0
+    owners: list = field(default_factory=list)
+    # [{"bytes", "prim", "file", "line"}] largest live values at the peak
+
+    @property
+    def intermediate_peak_bytes(self) -> int:
+        return max(self.peak_bytes - self.args_bytes, 0)
+
+    def to_dict(self) -> dict:
+        return {
+            "peak_bytes": int(self.peak_bytes),
+            "args_bytes": int(self.args_bytes),
+            "intermediate_peak_bytes": int(self.intermediate_peak_bytes),
+            "peak_at": f"{self.peak_file}:{self.peak_line}"
+                       if self.peak_file else "",
+            "owners": [dict(o) for o in self.owners],
+        }
+
+
+def peak_liveness(g: DataflowGraph, top: int = 5) -> LivenessReport:
+    rep = LivenessReport()
+    rep.args_bytes = g.args_bytes()
+
+    last_use: dict = {}
+    for node in g.nodes:
+        for v in node.invars:
+            last_use[id(v)] = node.index
+    n_nodes = len(g.nodes)
+    for v in g.outvars:
+        last_use[id(v)] = n_nodes  # outputs never die
+    for v in list(g.invars) + list(g.constvars):
+        # non-donated input buffers stay allocated for the whole call —
+        # freeing them at last use would let the static peak undercount
+        # the allocator (the documented contract is an upper bound)
+        last_use[id(v)] = n_nodes
+
+    live: dict = {}   # id(var) -> (bytes, producer OpNode | None)
+    for v in list(g.invars) + list(g.constvars):
+        live[id(v)] = (aval_bytes(v.aval), None)
+    total = sum(b for b, _ in live.values())
+    rep.peak_bytes = total
+    peak_live: dict = dict(live)
+
+    for node in g.nodes:
+        for v in node.outvars:
+            b = aval_bytes(v.aval)
+            if id(v) not in live:
+                total += b
+            live[id(v)] = (b, node)
+        if total > rep.peak_bytes:
+            rep.peak_bytes = total
+            rep.peak_index = node.index
+            rep.peak_file, rep.peak_line = node.file, node.line
+            peak_live = dict(live)
+        dead = [k for k, (_, _p) in live.items()
+                if last_use.get(k, -1) <= node.index]
+        for k in dead:
+            total -= live.pop(k)[0]
+
+    owners = sorted(((b, p) for b, p in peak_live.values() if b),
+                    key=lambda bp: -bp[0])[:top]
+    rep.owners = [
+        {"bytes": int(b),
+         "prim": p.prim if p is not None else "<input>",
+         "file": p.file if p is not None else "",
+         "line": p.line if p is not None else 0}
+        for b, p in owners]
+    return rep

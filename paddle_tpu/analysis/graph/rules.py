@@ -1,0 +1,519 @@
+"""Graph-tier rules GA100-GA109 over a traced-jaxpr dataflow graph.
+
+The AST tier (rules TS000-TS009) lints Python source; this family lints
+the PROGRAM — what XLA actually compiles. Every rule is grounded in a
+statically-decidable cost:
+
+* fusion boundaries and their HBM round trips ("Operator Fusion in XLA":
+  boundaries, not schedules, decide memory traffic) — GA100/GA101/GA102;
+* redundant transfers and dead/duplicate computation — GA103/GA104/GA105;
+* PartitionSpec mismatches that imply silent GSPMD reshards, with the
+  implied collectives counted the same way the HLO collective-count
+  proofs count them — GA106/GA107;
+* peak-liveness HBM estimation and arithmetic intensity — GA108/GA109,
+  cross-validated by the bench against ``attribute_memory()`` peaks.
+
+Findings reuse :class:`paddle_tpu.analysis.diagnostics.Finding` (stable
+ids, severities, file:line spans from jaxpr ``source_info``) so both
+tiers render and gate identically.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from ..diagnostics import ERROR, INFO, WARNING, Finding
+from ..rules import Rule
+from .fusion import (FusionCandidate, boundary_edges, fusion_candidates,
+                     fusion_groups)
+from .ir import (DataflowGraph, KIND_COLLECTIVE, KIND_CONTROL,
+                 KIND_ELEMENTWISE, KIND_GATHER, KIND_LAYOUT, KIND_MATMUL,
+                 KIND_PALLAS, KIND_REDUCE, KIND_RNG, KIND_SHARDING,
+                 KIND_TRANSFER, aval_bytes, build_graph)
+from .liveness import LivenessReport, peak_liveness
+
+__all__ = ["GA_RULES", "GraphRuleConfig", "GraphReport", "analyze_graph",
+           "check_graph", "implied_collectives"]
+
+GA_RULES = {r.id: r for r in [
+    Rule("GA100", "fusion-candidate", INFO,
+         "chain of adjacent kernelizable regions whose fusion into one "
+         "VMEM-resident pass would save the listed HBM bytes",
+         "fuse the chain into one Pallas mega-kernel (ROADMAP item 2); "
+         "the name lists the op patterns the kernel must cover"),
+    Rule("GA101", "hot-fusion-boundary", WARNING,
+         "a single fusion-group boundary moves a large value through HBM "
+         "(producer writes, consumer re-reads: one full round trip)",
+         "restructure so the producer and consumer fuse (avoid "
+         "materializing between them), or kernelize the pair"),
+    Rule("GA102", "pallas-boundary-unfused", WARNING,
+         "an elementwise/reduce chain sits adjacent to a Pallas kernel "
+         "boundary — XLA cannot fuse across pallas_call, so the chain "
+         "costs an HBM round trip the kernel could absorb",
+         "fold the chain into the kernel as a prologue/epilogue (extra "
+         "ref reads/writes inside the same VMEM residency)"),
+    Rule("GA103", "redundant-transfer", WARNING,
+         "host<->device or device<->device transfer of a value that is "
+         "already resident (chained or duplicate device_put)",
+         "transfer once and reuse the resident array; hoist device_put "
+         "out of the traced function"),
+    Rule("GA104", "dead-computation", WARNING,
+         "computed value never reaches an output, effect, or collective "
+         "— the work and its HBM traffic are pure waste",
+         "delete the computation, or return/consume its result; under "
+         "jit XLA may DCE it, but eager and pallas paths will not"),
+    Rule("GA105", "duplicate-computation", WARNING,
+         "identical op (same primitive, inputs, params) computed more "
+         "than once — tracing does not CSE across Python calls",
+         "compute once and reuse the Python value (hoist the shared "
+         "subexpression out of the repeated call)"),
+    Rule("GA106", "partition-spec-mismatch", ERROR,
+         "PartitionSpec changes across a def-use edge with no collective "
+         "between the constraints — GSPMD will insert silent resharding "
+         "collectives at this boundary",
+         "make the specs agree, or reshard explicitly where intended "
+         "(the implied collectives are counted in the message; verify "
+         "with StaticFunction.compiled_text() collective counts)"),
+    Rule("GA107", "redundant-sharding-constraint", INFO,
+         "sharding_constraint re-applies the spec its input already has "
+         "— a no-op annotation",
+         "delete the constraint, or move it to the boundary where the "
+         "spec actually changes"),
+    Rule("GA108", "peak-hbm-estimate", INFO,
+         "static peak-liveness HBM estimate for this program (args + "
+         "live intermediates at the worst program point)",
+         "informational: the bench cross-validates this against "
+         "attribute_memory() measured peaks (docs/static_analysis.md)"),
+    Rule("GA109", "memory-bound-program", INFO,
+         "arithmetic intensity (FLOPs per HBM byte moved across fusion "
+         "boundaries) is below the memory-bound threshold — the program "
+         "is HBM-traffic-limited, not compute-limited",
+         "fuse the top GA100 candidates first: saved bytes convert "
+         "directly to step time on a bandwidth-bound program"),
+]}
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class GraphRuleConfig:
+    """Thresholds for the GA rules (env-overridable, bytes unless noted).
+
+    Defaults are tuned for training-step graphs: small-value plumbing
+    (scalars, RNG keys, norm stats) must not drown the signal."""
+    boundary_bytes: int = 1 << 20        # GA101: >= 1 MiB per crossing
+    pallas_bytes: int = 1 << 16          # GA102: >= 64 KiB per crossing
+    candidate_min_bytes: int = 1 << 16   # GA100: >= 64 KiB saved
+    candidate_top: int = 5               # GA100: top-N reported
+    candidate_max_regions: int = 4       # GA100: regions per candidate
+    dead_min_bytes: int = 1 << 10        # GA104: ignore < 1 KiB outputs
+    dup_min_bytes: int = 1 << 12         # GA105: ignore < 4 KiB dupes
+    intensity_flops_per_byte: float = 4.0  # GA109 threshold
+    intensity_min_bytes: int = 1 << 20   # GA109: only for >= 1 MiB traffic
+
+    @classmethod
+    def from_env(cls) -> "GraphRuleConfig":
+        c = cls()
+        c.boundary_bytes = _env_int("PADDLE_TPU_GA_BOUNDARY_BYTES",
+                                    c.boundary_bytes)
+        c.pallas_bytes = _env_int("PADDLE_TPU_GA_PALLAS_BYTES",
+                                  c.pallas_bytes)
+        c.candidate_min_bytes = _env_int("PADDLE_TPU_GA_CANDIDATE_BYTES",
+                                         c.candidate_min_bytes)
+        c.candidate_top = _env_int("PADDLE_TPU_GA_CANDIDATE_TOP",
+                                   c.candidate_top)
+        c.candidate_max_regions = _env_int(
+            "PADDLE_TPU_GA_CANDIDATE_REGIONS", c.candidate_max_regions)
+        c.dead_min_bytes = _env_int("PADDLE_TPU_GA_DEAD_BYTES",
+                                    c.dead_min_bytes)
+        c.dup_min_bytes = _env_int("PADDLE_TPU_GA_DUP_BYTES",
+                                   c.dup_min_bytes)
+        try:
+            c.intensity_flops_per_byte = float(os.environ.get(
+                "PADDLE_TPU_GA_INTENSITY", c.intensity_flops_per_byte))
+        except ValueError:
+            pass
+        c.intensity_min_bytes = _env_int("PADDLE_TPU_GA_INTENSITY_BYTES",
+                                         c.intensity_min_bytes)
+        return c
+
+
+def _mb(n) -> str:
+    return f"{n / (1 << 20):.2f} MiB"
+
+
+def _finding(rule_id, message, node=None, symbol="", file="", line=0):
+    r = GA_RULES[rule_id]
+    if node is not None:
+        file, line = node.file, node.line
+    return Finding(rule_id=rule_id, severity=r.severity, message=message,
+                   file=file or "<jaxpr>", line=line, col=0,
+                   end_line=line, end_col=0, symbol=symbol, hint=r.hint)
+
+
+# --------------------------------------------------------------------------
+# GA106/GA107: PartitionSpec propagation along shape-preserving chains
+# --------------------------------------------------------------------------
+
+def _spec_dims(spec, ndim):
+    """Per-dim tuple of mesh axes for a PartitionSpec (None -> ())."""
+    dims = []
+    seq = tuple(spec) if spec is not None else ()
+    for i in range(ndim):
+        e = seq[i] if i < len(seq) else None
+        if e is None:
+            dims.append(())
+        elif isinstance(e, (tuple, list)):
+            dims.append(tuple(e))
+        else:
+            dims.append((e,))
+    return dims
+
+
+def implied_collectives(spec_a, spec_b, ndim):
+    """Collectives GSPMD must insert to reshard ``spec_a`` -> ``spec_b``
+    (same counting model as the HLO collective-count proofs):
+
+    * mesh axis removed from a dim (sharded -> replicated): all-gather;
+    * mesh axis moved between dims: all-to-all;
+    * mesh axis added (replicated -> sharded): a local dynamic-slice — no
+      collective.
+
+    Returns ``[(op_name, mesh_axis), ...]``.
+    """
+    a, b = _spec_dims(spec_a, ndim), _spec_dims(spec_b, ndim)
+    at = {ax: i for i, d in enumerate(a) for ax in d}
+    bt = {ax: i for i, d in enumerate(b) for ax in d}
+    out = []
+    for ax, i in sorted(at.items()):
+        j = bt.get(ax)
+        if j is None:
+            out.append(("all-gather", ax))
+        elif j != i:
+            out.append(("all-to-all", ax))
+    return out
+
+
+def _specs_equal(spec_a, spec_b, ndim) -> bool:
+    return _spec_dims(spec_a, ndim) == _spec_dims(spec_b, ndim)
+
+
+def _check_sharding(g: DataflowGraph, symbol, findings):
+    """Forward-walk from each sharding_constraint through shape-preserving
+    elementwise ops; a different spec at the next constraint implies a
+    silent reshard (GA106); an identical one is a no-op (GA107)."""
+    for node in g.nodes:
+        if node.kind != KIND_SHARDING or not node.outvars:
+            continue
+        src_var = node.outvars[0]
+        src_shape = getattr(getattr(src_var, "aval", None), "shape", None)
+        if src_shape is None:
+            continue
+        # BFS through elementwise ops that keep the exact shape
+        seen = {node.index}
+        frontier = [src_var]
+        while frontier:
+            v = frontier.pop()
+            for c in g.consumers_of(v):
+                if c.index in seen:
+                    continue
+                seen.add(c.index)
+                if c.kind == KIND_SHARDING:
+                    nbytes = aval_bytes(v.aval)
+                    if _specs_equal(node.sharding_spec, c.sharding_spec,
+                                    len(src_shape)):
+                        findings.append(_finding(
+                            "GA107",
+                            f"sharding_constraint({c.sharding_spec}) "
+                            f"re-applies the spec already set at "
+                            f"{node.span} — a no-op", node=c,
+                            symbol=symbol))
+                    else:
+                        colls = implied_collectives(
+                            node.sharding_spec, c.sharding_spec,
+                            len(src_shape))
+                        cdesc = ", ".join(
+                            f"{op}({ax})" for op, ax in colls) or \
+                            "local slice only"
+                        findings.append(_finding(
+                            "GA106",
+                            f"implicit reshard {node.sharding_spec} -> "
+                            f"{c.sharding_spec} between {node.span} and "
+                            f"this constraint ({_mb(nbytes)} value): "
+                            f"implies {len(colls)} collective(s) "
+                            f"[{cdesc}]", node=c, symbol=symbol))
+                    continue  # constraint ends the chain
+                if c.kind == KIND_ELEMENTWISE:
+                    for ov in c.outvars:
+                        oshape = getattr(getattr(ov, "aval", None),
+                                         "shape", None)
+                        if oshape == src_shape:
+                            frontier.append(ov)
+
+
+# --------------------------------------------------------------------------
+# GA104: reverse reachability (dead computation)
+# --------------------------------------------------------------------------
+
+_LIVE_ROOT_KINDS = {KIND_COLLECTIVE, KIND_TRANSFER, KIND_CONTROL,
+                    KIND_SHARDING, KIND_PALLAS}
+
+
+def _dead_nodes(g: DataflowGraph):
+    live: set = set()
+    stack = []
+    for v in g.outvars:
+        p = g.producer_of(v)
+        if p is not None:
+            stack.append(p.index)
+    for n in g.nodes:
+        if n.effectful or n.kind in _LIVE_ROOT_KINDS:
+            stack.append(n.index)
+    while stack:
+        i = stack.pop()
+        if i in live:
+            continue
+        live.add(i)
+        for v in g.nodes[i].invars:
+            p = g.producer_of(v)
+            if p is not None and p.index not in live:
+                stack.append(p.index)
+    return [n for n in g.nodes if n.index not in live]
+
+
+# --------------------------------------------------------------------------
+# the rule pass
+# --------------------------------------------------------------------------
+
+_PURE_KINDS = {KIND_ELEMENTWISE, KIND_REDUCE, KIND_MATMUL, KIND_LAYOUT,
+               KIND_GATHER, KIND_RNG, KIND_PALLAS}
+
+
+def check_graph(g: DataflowGraph, symbol: str = "",
+                config: GraphRuleConfig | None = None):
+    """Run GA100-GA109 over a :class:`DataflowGraph`.
+
+    Returns ``(findings, candidates, liveness, groups)`` — the findings
+    list plus the structured artifacts the bench/CLI render directly.
+    """
+    cfg = config or GraphRuleConfig.from_env()
+    findings: list[Finding] = []
+    groups, node_group = fusion_groups(g)
+    candidates = fusion_candidates(g, groups, node_group,
+                                   min_bytes=cfg.candidate_min_bytes,
+                                   max_regions=cfg.candidate_max_regions)
+    liveness = peak_liveness(g)
+
+    # GA100: named fusion candidates, ranked by saved HBM bytes
+    for cand in candidates[:cfg.candidate_top]:
+        findings.append(_finding(
+            "GA100",
+            f"fusion candidate '{cand.name}': {cand.n_ops} ops in "
+            f"{len(cand.groups)} regions — fusing saves an estimated "
+            f"{_mb(cand.saved_bytes)} of HBM round trips",
+            symbol=symbol, file=cand.file, line=cand.line))
+
+    # GA101 (hot boundary) + GA102 (pallas-adjacent chain): aggregate
+    # crossing bytes per ordered group pair, then threshold
+    pair_bytes: dict = {}
+    pair_edge: dict = {}
+    for p, c, v, nbytes in boundary_edges(g, node_group):
+        gp, gc = node_group[p.index], node_group[c.index]
+        key = (gp.gid, gc.gid)
+        pair_bytes[key] = pair_bytes.get(key, 0) + nbytes
+        pair_edge.setdefault(key, (p, c))
+    for (gpid, gcid), nbytes in sorted(pair_bytes.items()):
+        gp, gc = groups[gpid], groups[gcid]
+        p, c = pair_edge[(gpid, gcid)]
+        both_fused = gp.kind == "fused" and gc.kind == "fused"
+        pallas_side = (gp.kind == "breaker" and
+                       gp.first.kind == KIND_PALLAS) or \
+                      (gc.kind == "breaker" and
+                       gc.first.kind == KIND_PALLAS)
+        if both_fused and 2 * nbytes >= cfg.boundary_bytes:
+            findings.append(_finding(
+                "GA101",
+                f"fusion boundary '{gp.label}' -> '{gc.label}' "
+                f"materializes {_mb(nbytes)} to HBM "
+                f"({_mb(2 * nbytes)} round trip per step)",
+                node=c, symbol=symbol))
+        other = gc if gp.kind == "breaker" else gp
+        if pallas_side and other.kind == "fused" and \
+                2 * nbytes >= cfg.pallas_bytes and any(
+                    n.kind in (KIND_ELEMENTWISE, KIND_REDUCE)
+                    for n in other.nodes):
+            kern = gp if gp.kind == "breaker" else gc
+            findings.append(_finding(
+                "GA102",
+                f"unfused chain '{other.label}' straddles Pallas kernel "
+                f"'{kern.label}' ({_mb(nbytes)} crossing the boundary): "
+                f"fold it into the kernel",
+                node=c, symbol=symbol))
+
+    # GA103: redundant transfers — chained, or duplicate of the same value
+    seen_transfer: dict = {}
+    for n in g.nodes:
+        if n.kind != KIND_TRANSFER:
+            continue
+        srcs = tuple(id(v) for v in n.invars)
+        key = (srcs, n.param_sig)
+        if key in seen_transfer:
+            findings.append(_finding(
+                "GA103",
+                f"duplicate transfer of the same value "
+                f"({_mb(n.bytes_out)}; first at "
+                f"{seen_transfer[key].span})", node=n, symbol=symbol))
+        else:
+            seen_transfer[key] = n
+        for v in n.invars:
+            p = g.producer_of(v)
+            if p is not None and p.kind == KIND_TRANSFER:
+                findings.append(_finding(
+                    "GA103",
+                    f"chained transfer: input already moved by "
+                    f"{p.prim} at {p.span} ({_mb(n.bytes_out)} moved "
+                    f"again)", node=n, symbol=symbol))
+
+    # GA104: dead computation, grouped per source span
+    dead_by_span: dict = {}
+    for n in _dead_nodes(g):
+        if n.kind not in _PURE_KINDS:
+            continue
+        if n.bytes_out < cfg.dead_min_bytes and n.flops < 1024:
+            continue
+        row = dead_by_span.setdefault((n.file, n.line), [0, 0, n])
+        row[0] += 1
+        row[1] += n.bytes_out
+    for (file, line), (count, nbytes, n) in sorted(dead_by_span.items()):
+        findings.append(_finding(
+            "GA104",
+            f"dead computation: {count} op(s) producing {_mb(nbytes)} "
+            f"never reach an output or effect (root: {n.prim})",
+            symbol=symbol, file=file, line=line))
+
+    # GA105: duplicate computation (same prim + inputs + params)
+    dup_seen: dict = {}
+    dup_by_key: dict = {}
+    for n in g.nodes:
+        if n.kind not in _PURE_KINDS or not n.invars:
+            continue
+        if n.bytes_out < cfg.dup_min_bytes and n.flops < 1024:
+            continue
+        key = (n.prim, tuple(id(v) for v in n.invars), n.param_sig)
+        first = dup_seen.get(key)
+        if first is None:
+            dup_seen[key] = n
+        else:
+            dup_by_key.setdefault(key, [first, 0])[1] += 1
+    for key, (first, extra) in sorted(dup_by_key.items(),
+                                      key=lambda kv: kv[1][0].index):
+        findings.append(_finding(
+            "GA105",
+            f"duplicate computation: {first.prim} on the same inputs "
+            f"traced {extra + 1}x ({_mb(first.bytes_out * extra)} of "
+            f"recomputed output)", node=first, symbol=symbol))
+
+    # GA106/GA107: sharding-spec propagation
+    _check_sharding(g, symbol, findings)
+
+    # GA108: the static peak estimate (always one finding per module)
+    owner = liveness.owners[0] if liveness.owners else None
+    owner_txt = (f"; top owner {_mb(owner['bytes'])} {owner['prim']} at "
+                 f"{owner['file']}:{owner['line']}"
+                 if owner and owner.get("prim") else "")
+    findings.append(_finding(
+        "GA108",
+        f"static peak HBM estimate {_mb(liveness.peak_bytes)} "
+        f"({_mb(liveness.args_bytes)} args + "
+        f"{_mb(liveness.intermediate_peak_bytes)} intermediates)"
+        + owner_txt,
+        symbol=symbol, file=liveness.peak_file, line=liveness.peak_line))
+
+    # GA109: arithmetic intensity across fusion boundaries
+    traffic = sum(2 * b for *_ns, b in boundary_edges(g, node_group))
+    traffic += g.args_bytes()
+    flops = g.total_flops()
+    if traffic >= cfg.intensity_min_bytes:
+        intensity = flops / max(traffic, 1)
+        if intensity < cfg.intensity_flops_per_byte:
+            findings.append(_finding(
+                "GA109",
+                f"memory-bound: {intensity:.2f} FLOPs/HBM-byte across "
+                f"fusion boundaries (threshold "
+                f"{cfg.intensity_flops_per_byte:g}) — fusing the GA100 "
+                f"candidates converts saved bytes to step time",
+                symbol=symbol, file=liveness.peak_file,
+                line=liveness.peak_line))
+
+    findings.sort(key=lambda f: f.sort_key())
+    return findings, candidates, liveness, groups
+
+
+# --------------------------------------------------------------------------
+# the report object (CLI / bench / to_static hook all consume this)
+# --------------------------------------------------------------------------
+
+@dataclass
+class GraphReport:
+    name: str
+    findings: list = field(default_factory=list)
+    candidates: list = field(default_factory=list)
+    liveness: LivenessReport = field(default_factory=LivenessReport)
+    n_ops: int = 0
+    total_flops: float = 0.0
+    total_bytes: int = 0
+
+    def top_candidates(self, n: int = 3) -> list[dict]:
+        """Top-N candidates with structurally identical repeats collapsed
+        (a transformer has one attention cluster PER LAYER; one mega-kernel
+        covers every site — ``sites`` says how many)."""
+        out: list[dict] = []
+        seen: dict = {}
+        for c in self.candidates:
+            key = (c.name, c.saved_bytes, c.n_ops)
+            if key in seen:
+                seen[key]["sites"] += 1
+                continue
+            d = c.to_dict()
+            d["sites"] = 1
+            seen[key] = d
+            out.append(d)
+        return out[:n]
+
+    def has_errors(self) -> bool:
+        return any(f.severity == ERROR for f in self.findings)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "n_ops": self.n_ops,
+            "total_flops": float(self.total_flops),
+            "total_bytes": int(self.total_bytes),
+            "findings": [f.to_dict() for f in self.findings],
+            "top_fusion_candidates": self.top_candidates(3),
+            "liveness": self.liveness.to_dict(),
+        }
+
+
+def analyze_graph(jaxpr_or_graph, name: str = "<jaxpr>",
+                  prefer_file: str | None = None,
+                  config: GraphRuleConfig | None = None,
+                  exclude_files=()) -> GraphReport:
+    """Flatten (if needed) and run the GA rules; returns a GraphReport."""
+    if isinstance(jaxpr_or_graph, DataflowGraph):
+        g = jaxpr_or_graph
+    else:
+        g = build_graph(jaxpr_or_graph, name=name, prefer_file=prefer_file,
+                        exclude_files=exclude_files)
+    findings, candidates, liveness, _groups = check_graph(
+        g, symbol=name, config=config)
+    return GraphReport(name=name, findings=findings, candidates=candidates,
+                       liveness=liveness, n_ops=len(g.nodes),
+                       total_flops=g.total_flops(),
+                       total_bytes=g.total_bytes())

@@ -1,0 +1,159 @@
+"""Obtain traced jaxprs WITHOUT device execution: the graph tier's input.
+
+Three producers, all abstract-eval only (``ShapeDtypeStruct`` avals in,
+``ClosedJaxpr`` out — nothing runs on a device):
+
+* :func:`trace_callable` — a plain jnp-level function + avals, via
+  ``jax.make_jaxpr``.
+* :func:`trace_layer` — an ``nn.Layer`` forward: parameters (and any
+  registered sub-tensors) are temporarily bound to tracers exactly the
+  way ``jit/api.py``'s ``_compile`` does for ``to_static``, so the
+  traced program is the program XLA would compile — including the loss
+  head when ``labels=...`` style kwargs are passed.
+* :func:`trace_static_function` — a live ``to_static`` StaticFunction:
+  reuses its discovered state set and compiled pure function, traced on
+  avals (``jax.jit(...).trace``). The ONLY execution this can trigger is
+  the one eager discovery call to_static itself requires for a
+  never-seen signature.
+
+The jaxpr is then flattened by :func:`~.ir.build_graph` into the
+:class:`~.ir.DataflowGraph` the GA rules and the fusion/liveness models
+consume.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+__all__ = ["trace_callable", "trace_layer", "trace_static_function",
+           "aval_of", "avals_like", "source_file_of"]
+
+
+def aval_of(x):
+    """ShapeDtypeStruct mirroring any array-like (Tensor, jax.Array,
+    ShapeDtypeStruct, np.ndarray); scalars pass through unchanged."""
+    import jax
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    arr = getattr(x, "_d", x)          # paddle Tensor -> backing array
+    shape = getattr(arr, "shape", None)
+    dtype = getattr(arr, "dtype", None)
+    if shape is None or dtype is None:
+        return x
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def avals_like(xs):
+    return [aval_of(x) for x in xs]
+
+
+def trace_callable(fn, *avals, **kwargs):
+    """``ClosedJaxpr`` of ``fn(*avals, **kwargs)`` by abstract evaluation."""
+    import jax
+    return jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*avals)
+
+
+def _layer_state(layer):
+    """Every framework Tensor reachable from the layer tree (parameters
+    plus registered buffers), deduped by identity, stable order."""
+    seen: set = set()
+    out = []
+
+    def add(t):
+        if t is None or id(t) in seen:
+            return
+        if hasattr(t, "_d"):
+            seen.add(id(t))
+            out.append(t)
+
+    for p in layer.parameters():
+        add(p)
+    for sub in getattr(layer, "sublayers", lambda **k: [])(include_self=True):
+        for v in vars(sub).values():
+            add(v)
+    return out
+
+
+def trace_layer(layer, *args, **kwargs):
+    """``ClosedJaxpr`` of one forward of an ``nn.Layer`` on avals.
+
+    Parameters/buffers are bound to tracers (the ``to_static`` mechanism,
+    specialized to a forward): no discovery call, no device execution —
+    lazily-created state would be missed, which is fine for the forward
+    graphs this tier analyzes (use :func:`trace_static_function` for a
+    full train step).
+    """
+    import jax
+
+    from ...jit import api as jit_api
+
+    state = _layer_state(layer)
+    state_avals = [aval_of(t) for t in state]
+    arg_avals = [aval_of(a) for a in args]
+    kw_avals = {k: (aval_of(v) if hasattr(getattr(v, "_d", v), "shape")
+                    else v) for k, v in kwargs.items()}
+
+    def pure(state_arrays, arg_arrays, kw_arrays):
+        from ...autograd.grad_mode import no_grad
+        from ...core.tensor import Tensor
+        saved = [(t._d, t._node, t._out_index, t._grad) for t in state]
+        jit_api._trace_state.active = True
+        # no_grad: a forward-only trace must not stage jax.vjp residual
+        # math (it would read as dead computation — the backward that
+        # consumes it is never called here)
+        try:
+            with no_grad():
+                for t, a in zip(state, state_arrays):
+                    t._d = a
+                    t._node = None
+                call_args = [Tensor(a) if hasattr(a, "shape") else a
+                             for a in arg_arrays]
+                call_kw = dict(kwargs)
+                for k, a in kw_arrays.items():
+                    call_kw[k] = Tensor(a) if hasattr(a, "shape") else a
+                out = layer(*call_args, **call_kw)
+                flat, _ = jax.tree_util.tree_flatten(out)
+                return flat
+        finally:
+            jit_api._trace_state.active = False
+            for t, (d, n, oi, g) in zip(state, saved):
+                t._d = d
+                t._node, t._out_index = n, oi
+                t._grad = g
+
+    arr_kw = {k: v for k, v in kw_avals.items()
+              if hasattr(v, "shape")}
+    return jax.make_jaxpr(pure)(state_avals, arg_avals, arr_kw)
+
+
+def trace_static_function(sf, *args, **kwargs):
+    """``ClosedJaxpr`` of a ``to_static`` StaticFunction's whole compiled
+    step — forward, backward, and optimizer included, exactly the program
+    ``jax.jit`` would compile for this signature.
+
+    Requires the signature's state set: if this signature was never
+    called, ONE eager discovery call runs (to_static's own contract);
+    the trace itself is abstract.
+    """
+    import jax
+
+    args_flat, treedef = jax.tree_util.tree_flatten(args)
+    sig = sf._sig_of(args_flat)
+    kw_key = tuple(sorted(kwargs.items(), key=lambda kv: kv[0]))
+    key = (treedef, sig, kw_key)
+    if key not in sf._state_by_key:
+        sf(*args, **kwargs)
+    state_list = sf._state_by_key[key]
+    jitted, _cell = sf._compile(treedef, sig, dict(kwargs), state_list)
+    state_avals = [aval_of(t) for t in state_list]
+    arg_avals = [aval_of(a) for a in args_flat]
+    return jitted.trace(state_avals, arg_avals).jaxpr
+
+
+def source_file_of(fn) -> str | None:
+    """Best-effort defining file of a callable (span preference for
+    :func:`~.ir.build_graph`)."""
+    try:
+        return inspect.getsourcefile(inspect.unwrap(fn))
+    except (OSError, TypeError):
+        return None

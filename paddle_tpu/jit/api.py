@@ -20,6 +20,7 @@ Shape/dtype changes retrace (a new cache entry), mirroring SOT guards.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from functools import wraps
@@ -140,12 +141,17 @@ def _is_floatlike(x):
 class StaticFunction:
     def __init__(self, fn, input_spec=None, build_strategy=None,
                  backend=None, donate_state=False, static_argnames=None,
-                 fallback=True):
+                 fallback=True, analyze=None):
         self._fn = fn
         self._cache: dict = {}
         self._state: list[Tensor] | None = None
         self._state_by_key: dict = {}
         self._donate = donate_state
+        # graph-tier analysis (paddle_tpu.analysis.graph) at first compile
+        # of each signature; None defers to PADDLE_TPU_JIT_ANALYZE=1
+        self._analyze = analyze
+        self._analyzed: set = set()
+        self._last_graph_report = None
         # SOT graph-break analog (reference python/paddle/jit/sot/): when
         # tracing hits data-dependent Python control flow, permanently run
         # this function eagerly instead of raising
@@ -240,6 +246,44 @@ class StaticFunction:
                          donate_argnums=(0,) if self._donate else ())
         return jitted, cell
 
+    # -- graph-tier analysis (paddle_tpu.analysis.graph) --------------------
+    def _analyze_enabled(self) -> bool:
+        if self._analyze is not None:
+            return bool(self._analyze)
+        return os.environ.get("PADDLE_TPU_JIT_ANALYZE", "") == "1"
+
+    def _maybe_analyze(self, key, jitted, state_list, arg_arrays):
+        """Run rules GA100-GA109 on the jaxpr of a freshly compiled
+        signature (abstract trace — no device execution) and surface the
+        findings as GraphAnalysisWarning. Never blocks compilation."""
+        if not self._analyze_enabled() or key in self._analyzed:
+            return
+        self._analyzed.add(key)
+        try:
+            import warnings
+
+            from ..analysis import format_text
+            from ..analysis.diagnostics import GraphAnalysisWarning
+            from ..analysis.graph import analyze_graph
+            from ..analysis.graph.trace import aval_of, source_file_of
+            state_avals = [aval_of(t) for t in state_list]
+            arg_avals = [aval_of(a) for a in arg_arrays]
+            cj = jitted.trace(state_avals, arg_avals).jaxpr
+            report = analyze_graph(cj, name=self._obs_name,
+                                   prefer_file=source_file_of(self._fn))
+            self._last_graph_report = report
+            for f in report.findings:
+                warnings.warn(f"to_static analyze: {format_text(f)}",
+                              GraphAnalysisWarning, stacklevel=5)
+        except Exception:  # analysis must never break the train step
+            return
+
+    def graph_report(self):
+        """The :class:`~paddle_tpu.analysis.graph.GraphReport` from the
+        most recent ``analyze=True`` compile (None before first compile
+        or when analysis is off)."""
+        return self._last_graph_report
+
     # -- call ---------------------------------------------------------------
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled or in_to_static_trace() or self._fell_back:
@@ -292,6 +336,7 @@ class StaticFunction:
                 _flight.record("jit_compile", fn=fn_name)
             entry = (jitted, cell, state_list)
             self._cache[key] = entry
+            self._maybe_analyze(key, jitted, state_list, arg_arrays)
         jitted, cell, state_list = entry
         try:
             return self._run_compiled(jitted, cell, state_list, arg_arrays)
@@ -577,13 +622,19 @@ def _maybe_lint(fn, lint):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, lint=None, **kwargs):
+              backend=None, lint=None, analyze=None, **kwargs):
     """Decorator/wrapper compiling a dygraph callable (reference:
     python/paddle/jit/api.py:242).
 
     ``lint``: run the trace-safety analyzer (paddle_tpu.analysis) on the
     function's source at decoration time and warn on findings; defaults
-    to the PADDLE_TPU_JIT_LINT=1 env switch."""
+    to the PADDLE_TPU_JIT_LINT=1 env switch.
+
+    ``analyze``: run the graph-tier analyzer (paddle_tpu.analysis.graph,
+    rules GA100-GA109) on the traced jaxpr at first compile of each
+    signature and warn on findings (GraphAnalysisWarning); defaults to
+    the PADDLE_TPU_JIT_ANALYZE=1 env switch. The report is retrievable
+    via ``.graph_report()`` on the StaticFunction."""
     from ..nn.layer import Layer
 
     def decorate(fn):
@@ -591,12 +642,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             layer = fn
             _maybe_lint(layer.forward, lint)
             sf = StaticFunction(layer.forward, input_spec, build_strategy,
-                                backend, **kwargs)
+                                backend, analyze=analyze, **kwargs)
             layer.forward = sf
             return layer
         _maybe_lint(fn, lint)
         return StaticFunction(fn, input_spec, build_strategy, backend,
-                              **kwargs)
+                              analyze=analyze, **kwargs)
 
     if function is not None:
         return decorate(function)

@@ -52,6 +52,7 @@ def _collect(module, prefix, kind, records, predicate):
 def _surface_cached() -> tuple:
     import paddle_tpu as paddle
     import paddle_tpu.analysis as analysis
+    import paddle_tpu.analysis.graph as analysis_graph
     import paddle_tpu.io as io_mod
     import paddle_tpu.jit as jit
     import paddle_tpu.nn as nn
@@ -84,6 +85,11 @@ def _surface_cached() -> tuple:
     _collect(optim_mod, "paddle.optimizer", "optimizer", records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     _collect(analysis, "paddle.analysis", "analysis", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    # graph tier: the jaxpr-level analyzer (rules GA100-GA109, fusion
+    # candidates, peak-liveness) — bench/perf_gate/CI parse its reports,
+    # so trace_layer/analyze_graph/GraphReport are contracts like ops
+    _collect(analysis_graph, "paddle.analysis.graph", "analysis", records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     # fault-tolerance runtime: the checkpoint manager, sentinel, preemption
     # handler and the fault-injection surface are recovery contracts CI must

@@ -1,15 +1,25 @@
 #!/usr/bin/env python
 """CI gate: trace-safety lint over the repo's runnable training surfaces.
 
-Runs ``python -m paddle_tpu.analysis`` over ``examples/`` and
-``paddle_tpu/models/`` (override by passing paths) and fails on any
-error-severity finding — the repo's own examples must stay trace-clean,
-so the analyzer's advice and the shipped code never diverge.
+Two stages, both must pass:
+
+1. AST tier — ``python -m paddle_tpu.analysis`` over ``examples/`` and
+   ``paddle_tpu/models/`` (override by passing paths); fails on any
+   error-severity TS finding.
+2. Graph tier — ``python -m paddle_tpu.analysis.graph`` over the
+   registered gate entrypoints (the bench GPT + the model-zoo forwards);
+   fails on any error-severity GA finding not allowlisted in
+   ``tools/ga_allowlist.txt`` (accepted reshards: "<entrypoint> <rule>"
+   per line).
+
+The repo's own examples must stay clean on BOTH tiers, so the analyzers'
+advice and the shipped code never diverge.
 
 Usage:
-  python tools/lint_examples.py                 # default tree
+  python tools/lint_examples.py                 # default tree + entrypoints
   python tools/lint_examples.py path1 path2     # explicit paths
   python tools/lint_examples.py --format json   # machine-readable
+  python tools/lint_examples.py --no-graph      # AST tier only
 """
 
 from __future__ import annotations
@@ -20,9 +30,59 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_PATHS = [os.path.join(ROOT, "examples"),
                  os.path.join(ROOT, "paddle_tpu", "models")]
+ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "ga_allowlist.txt")
 
 
 _VALUE_OPTS = {"--format", "--select", "--min-severity"}
+
+
+def load_allowlist(path=ALLOWLIST):
+    """{(entrypoint, rule_id), ...} accepted-reshard entries."""
+    out = set()
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                if len(parts) >= 2:
+                    out.add((parts[0], parts[1].upper()))
+    except OSError:
+        pass
+    return out
+
+
+def graph_gate(allowlist=None, out=sys.stderr) -> int:
+    """Run GA100-GA109 over the gate entrypoints; 1 on non-allowlisted
+    error-severity findings."""
+    from paddle_tpu.analysis.diagnostics import ERROR, format_text
+    from paddle_tpu.analysis.graph import (GATE_ENTRYPOINTS,
+                                           build_entrypoint, analyze_graph)
+    allow = load_allowlist() if allowlist is None else allowlist
+    rc = 0
+    for name in GATE_ENTRYPOINTS:
+        try:
+            jaxpr, _ = build_entrypoint(name)
+            report = analyze_graph(jaxpr, name=name)
+        except Exception as e:  # entrypoint itself broken: that IS a fail
+            print(f"graph gate: {name}: trace failed: "
+                  f"{type(e).__name__}: {e}", file=out)
+            rc = 1
+            continue
+        errors = [f for f in report.findings if f.severity == ERROR]
+        kept = [f for f in errors if (name, f.rule_id) not in allow]
+        waived = len(errors) - len(kept)
+        for f in kept:
+            print(f"graph gate: {name}: {format_text(f)}", file=out)
+        status = "FAILED" if kept else "ok"
+        extra = f", {waived} allowlisted" if waived else ""
+        print(f"graph gate: {name}: {status} "
+              f"({len(report.findings)} finding(s), {len(kept)} "
+              f"error(s){extra})", file=out)
+        rc = rc or (1 if kept else 0)
+    return rc
 
 
 def _has_paths(argv) -> bool:
@@ -43,6 +103,8 @@ def main(argv=None) -> int:
     sys.path.insert(0, ROOT)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     argv = list(sys.argv[1:] if argv is None else argv)
+    run_graph = "--no-graph" not in argv
+    argv = [a for a in argv if a != "--no-graph"]
     if not _has_paths(argv):
         argv = DEFAULT_PATHS + argv
     from paddle_tpu.analysis.__main__ import main as analysis_main
@@ -50,6 +112,11 @@ def main(argv=None) -> int:
     # stderr so --format json stdout stays machine-parseable
     print("lint gate:", "FAILED (error-severity trace-safety findings)"
           if rc else "OK", file=sys.stderr)
+    if run_graph:
+        grc = graph_gate()
+        print("graph gate:", "FAILED (error-severity GA findings)"
+              if grc else "OK", file=sys.stderr)
+        rc = rc or grc
     return rc
 
 

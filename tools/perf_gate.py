@@ -123,6 +123,44 @@ def retrace_diagnosis(d) -> str:
     return "\n".join(lines)
 
 
+def graph_analysis(d):
+    """The bench's embedded graph-analyzer block (extra.graph_analysis),
+    or {} when the round predates it / analysis errored."""
+    try:
+        ga = d["extra"]["graph_analysis"]
+        return ga if isinstance(ga, dict) and "error" not in ga else {}
+    except (KeyError, TypeError):
+        return {}
+
+
+def hbm_diagnosis(d) -> str:
+    """Human-actionable peak-HBM failure text: the static analyzer's top
+    memory-owner estimate next to the measured regression, and the exact
+    graph-analyzer command to reproduce it (paddle_tpu.analysis.graph —
+    the static side of this runtime census). Mirrors retrace_diagnosis."""
+    ga = graph_analysis(d)
+    lines = []
+    static = ga.get("static_peak_hbm_bytes")
+    if static:
+        lines.append(f"  static peak estimate: {int(static):,} bytes"
+                     + (f" ({ga['static_vs_measured']}x measured)"
+                        if ga.get("static_vs_measured") else ""))
+    owners = ga.get("static_top_owners") or []
+    if owners:
+        o = owners[0]
+        span = f" at {o['file']}:{o['line']}" if o.get("file") else ""
+        lines.append(f"  top static memory owner: {int(o['bytes']):,} "
+                     f"bytes {o.get('prim', '?')}{span}")
+    lines.append(
+        "  diagnose: python -m paddle_tpu.analysis.graph bench:gpt "
+        "--select GA108 --top 5")
+    lines.append(
+        "  (peak-liveness estimation is rule GA108; "
+        "see docs/static_analysis.md#graph-tier — or compile with "
+        "to_static(analyze=True) / PADDLE_TPU_JIT_ANALYZE=1)")
+    return "\n".join(lines)
+
+
 def step_latency_ms(d):
     """Steady-state per-step wall latency from the bench's step breakdown
     (None when the round predates it)."""
@@ -190,10 +228,16 @@ def soft_gates(cd, bd):
         ceiling = base * (1 + tol / 100.0)
         delta = (cur - base) / base
         if cur > ceiling:
-            fails.append(
+            msg = (
                 f"perf gate [REGRESSION:{name}] current {cur:.1f} {unit} vs "
                 f"baseline {base:.1f} {unit} (delta {delta:+.2%}, ceiling "
                 f"{ceiling:.1f}, tol {tol:.0f}% via {env})")
+            if name == "peak_hbm":
+                # static-analyzer bridge: point the failure at the graph
+                # tier's memory-owner estimate (same pattern as the
+                # retrace gate -> TS-linter bridge)
+                msg += "\n" + hbm_diagnosis(cd)
+            fails.append(msg)
         else:
             print(f"perf gate [ok:{name}] current {cur:.1f} {unit} vs "
                   f"baseline {base:.1f} {unit} (delta {delta:+.2%}, "
