@@ -8,6 +8,11 @@ With --ckpt-dir the loop checkpoints atomically every --save-every steps
 (async, off the training thread), resumes from the newest good checkpoint,
 and drains + exits relaunchable (code 143) on SIGTERM — the preemption
 contract multi-host TPU schedulers assume.
+
+With --metrics-port it serves live telemetry over HTTP while training
+(/metrics /healthz /flight /profile) and the continuous profiler samples
+per-program step time on its bounded-overhead cadence; the SIGTERM drain
+shuts the server down with the run.
 """
 
 import argparse
@@ -17,11 +22,12 @@ import numpy as np
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import fleet
+from paddle_tpu.observability import continuous, serve
 from paddle_tpu.resilience import (CheckpointManager, NaNSentinel,
                                    PreemptionHandler, faults)
 
 
-def main(steps=20, ckpt_dir=None, save_every=5):
+def main(steps=20, ckpt_dir=None, save_every=5, metrics_port=None):
     import jax
     n = jax.device_count()
     strategy = fleet.DistributedStrategy()
@@ -36,6 +42,12 @@ def main(steps=20, ckpt_dir=None, save_every=5):
     rng = np.random.default_rng(0)
     xv = rng.standard_normal((64, 32)).astype(np.float32)
     yv = xv.sum(-1, keepdims=True).astype(np.float32) * 0.1
+
+    server = None
+    if metrics_port is not None:
+        server = serve(metrics_port)
+        print(f"telemetry: /metrics /healthz /flight /profile on "
+              f"port {server.port}")
 
     manager = sentinel = handler = None
     start = 0
@@ -84,6 +96,8 @@ def main(steps=20, ckpt_dir=None, save_every=5):
             except StopIteration:
                 break
             last = step(x, y)
+            # continuous-profiler heartbeat (sampling windows + /healthz)
+            continuous.on_step(i)
             if faults.on_train_step(i):  # harness: corrupt this step's loss
                 last = last * float("nan")
             first = first if first is not None else last
@@ -104,6 +118,8 @@ def main(steps=20, ckpt_dir=None, save_every=5):
         if manager is not None:
             manager.wait()
             handler.uninstall()
+        if server is not None:
+            server.close()
     first, last = float(first), float(last)
     print(f"dp={n}: loss {first:.4f} -> {last:.4f}")
     assert last < first
@@ -115,5 +131,9 @@ if __name__ == "__main__":
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--save-every", type=int, default=5)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve live telemetry (/metrics /healthz /flight "
+                        "/profile) on this port; 0 = ephemeral")
     a = p.parse_args()
-    main(steps=a.steps, ckpt_dir=a.ckpt_dir, save_every=a.save_every)
+    main(steps=a.steps, ckpt_dir=a.ckpt_dir, save_every=a.save_every,
+         metrics_port=a.metrics_port)

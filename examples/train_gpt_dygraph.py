@@ -17,6 +17,15 @@ metrics, memory census, per-module peak HBM from the startup attribution
 pass). Render it with:
 
     python -m paddle_tpu.observability.flight <ckpt-dir>/flight_<step>.json
+
+With --metrics-port the run serves live telemetry over HTTP while it
+trains — /metrics (Prometheus), /healthz (step liveness), /flight (the
+ring buffer), /profile?steps=N (on-demand capture) — and the continuous
+profiler samples per-program step time on its bounded-overhead cadence
+(PADDLE_TPU_PROF_EVERY / PADDLE_TPU_PROF_BUDGET_PCT):
+
+    python examples/train_gpt_dygraph.py --metrics-port 9406 &
+    curl localhost:9406/healthz
 """
 
 import argparse
@@ -25,13 +34,14 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.models import GPT, GPTConfig
-from paddle_tpu.observability import flight, memory as obs_memory
+from paddle_tpu.observability import (continuous, flight,
+                                      memory as obs_memory, serve)
 from paddle_tpu.resilience import (CheckpointManager, NaNSentinel,
                                    PreemptionHandler, faults)
 
 
 def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
-         ckpt_dir=None, save_every=10):
+         ckpt_dir=None, save_every=10, metrics_port=None):
     paddle.seed(0)
     model = GPT(GPTConfig(vocab_size=vocab, max_position_embeddings=seq,
                           hidden_size=hidden, num_layers=layers,
@@ -50,6 +60,15 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
         with obs_memory.attribute_memory(model):
             model(paddle.to_tensor(data[:1, :-1].astype(np.int32)),
                   labels=paddle.to_tensor(data[:1, 1:].astype(np.int32)))
+
+    # live telemetry: the scrape surface (metrics/health/flight/profile)
+    # plus the continuous profiler's per-program sampling; the preemption
+    # drain shuts the server down with the run
+    server = None
+    if metrics_port is not None:
+        server = serve(metrics_port)
+        print(f"telemetry: /metrics /healthz /flight /profile on "
+              f"port {server.port}")
 
     manager = sentinel = handler = None
     start = 0
@@ -102,6 +121,10 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
             except StopIteration:
                 break
             last = step(x, y)
+            # continuous profiler heartbeat: opens/closes the sampling
+            # windows (a clock read on off-cadence steps) and feeds
+            # /healthz step liveness
+            continuous.on_step(i)
             if faults.on_train_step(i):  # harness: corrupt this step's loss
                 last = last * float("nan")
             first = first if first is not None else last
@@ -129,6 +152,8 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
         if manager is not None:
             manager.wait()
             handler.uninstall()
+        if server is not None:
+            server.close()
     first, last = float(first), float(last)
     print(f"done: {first:.4f} -> {last:.4f}")
     assert last < first
@@ -140,5 +165,9 @@ if __name__ == "__main__":
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--save-every", type=int, default=10)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve live telemetry (/metrics /healthz /flight "
+                        "/profile) on this port; 0 = ephemeral")
     a = p.parse_args()
-    main(steps=a.steps, ckpt_dir=a.ckpt_dir, save_every=a.save_every)
+    main(steps=a.steps, ckpt_dir=a.ckpt_dir, save_every=a.save_every,
+         metrics_port=a.metrics_port)

@@ -36,6 +36,7 @@ from .fusion import (  # noqa: F401
 from .ir import (  # noqa: F401
     DataflowGraph, OpNode, aval_bytes, build_graph, classify,
 )
+from .join import join_measured  # noqa: F401
 from .liveness import LivenessReport, peak_liveness  # noqa: F401
 from .rules import (  # noqa: F401
     GA_RULES, GraphReport, GraphRuleConfig, analyze_graph, check_graph,
@@ -59,5 +60,5 @@ __all__ = [
     "aval_of", "avals_like", "trace_callable", "trace_layer",
     "trace_static_function",
     "ENTRYPOINTS", "GATE_ENTRYPOINTS", "build_entrypoint",
-    "list_entrypoints",
+    "list_entrypoints", "join_measured",
 ]

@@ -1,0 +1,63 @@
+"""Static->measured join: attach measured step time to GA100 candidates.
+
+The programmatic bridge ``paddle_tpu.observability.continuous`` stands on:
+given a :class:`~.rules.GraphReport` (the static tier) and one program's
+MEASURED wall ms/step (the continuous profiler's capture windows), emit
+the candidate rows of the ``fusion_targets`` table.
+
+Attribution model: a candidate's measured share is the program's measured
+time scaled by the candidate's share of the program's total HBM traffic
+(``report.total_bytes`` — every op's bytes in + out). On memory-bound
+programs (rule GA109) step time tracks HBM traffic, so saved-bytes
+fraction is the defensible prior for *time* saved; on compute-bound
+programs it over-credits, which still ranks candidates correctly within
+one program. The share is a ceiling-clamped estimate, not a promise — the
+kernel that lands proves its win in ``bench.py kernel_ab``.
+"""
+
+from __future__ import annotations
+
+__all__ = ["join_measured"]
+
+
+def join_measured(report, measured_ms: float, program: str = "",
+                  hbm_delta_bytes=None, top: int | None = None) -> list:
+    """Join one program's :class:`GraphReport` with its measured ms/step.
+
+    Returns one dict per (deduped) GA100 candidate::
+
+        {"name", "sites", "n_ops", "span", "program",
+         "est_saved_bytes",          # static estimate, per site
+         "est_saved_bytes_total",    # static estimate x sites
+         "measured_ms",              # the whole program, measured
+         "measured_ms_share",        # this candidate's attributed slice
+         ["measured_hbm_delta_bytes"]}  # when the caller probed memory
+
+    ``measured_ms_share`` = ``measured_ms`` x min(1, total saved bytes /
+    program HBM traffic). Candidates come pre-collapsed by
+    ``GraphReport.top_candidates`` (structurally identical per-layer
+    repeats carry a ``sites`` count).
+    """
+    traffic = max(int(getattr(report, "total_bytes", 0)), 1)
+    n = top if top is not None else max(len(report.candidates), 1)
+    out = []
+    for d in report.top_candidates(n):
+        sites = int(d.get("sites", 1))
+        saved = int(d["saved_bytes"])
+        saved_total = saved * sites
+        frac = min(saved_total / traffic, 1.0)
+        row = {
+            "name": d["name"],
+            "sites": sites,
+            "n_ops": int(d.get("n_ops", 0)),
+            "span": d.get("span", ""),
+            "program": program,
+            "est_saved_bytes": saved,
+            "est_saved_bytes_total": saved_total,
+            "measured_ms": round(float(measured_ms), 3),
+            "measured_ms_share": round(float(measured_ms) * frac, 3),
+        }
+        if hbm_delta_bytes is not None:
+            row["measured_hbm_delta_bytes"] = int(hbm_delta_bytes)
+        out.append(row)
+    return out

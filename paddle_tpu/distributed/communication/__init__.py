@@ -18,6 +18,7 @@ from ...core.tensor import Tensor, as_tensor
 from ...autograd.function import apply
 from ...observability import (counter as _obs_counter,
                               enabled as _obs_enabled)
+from ...observability import continuous as _cont
 from ...observability import flight as _flight
 from .group import (Group, ReduceOp, new_group, get_group, is_available,
                     destroy_process_group, active_axis_names, _axis_scope)
@@ -93,7 +94,18 @@ class _Task:
         self._t = tensor
 
     def wait(self):
-        if self._t is not None:
+        if self._t is None:
+            return
+        if _cont.sampling_active():
+            # continuous-profiler capture window: the device sync a
+            # collective's consumer pays is the measurable collective cost
+            # on the single controller — record it as a program row
+            import time as _time
+            t0 = _time.perf_counter()
+            jax.block_until_ready(self._t._data)
+            _cont.record_program("collective_wait",
+                                 _time.perf_counter() - t0)
+        else:
             jax.block_until_ready(self._t._data)
 
     def is_completed(self):

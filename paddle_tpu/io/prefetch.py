@@ -29,6 +29,7 @@ import jax
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import continuous as _cont
 from ..observability import counter as _obs_counter
 
 __all__ = ["prefetch_to_device"]
@@ -68,9 +69,25 @@ def prefetch_to_device(loader, depth: int = 2, device=None):
     if depth < 1:
         raise ValueError(f"prefetch depth must be >= 1, got {depth}")
 
+    _END = object()
+
     def _gen():
         buf = deque()
-        for item in loader:
+        it = iter(loader)
+        while True:
+            if _cont.sampling_active():
+                # continuous-profiler capture window: the feed wait is a
+                # first-class program row ("prefetch_wait") in the step's
+                # measured breakdown
+                import time as _t
+                t0 = _t.perf_counter()
+                item = next(it, _END)
+                _cont.record_program("prefetch_wait",
+                                     _t.perf_counter() - t0)
+            else:
+                item = next(it, _END)
+            if item is _END:
+                break
             buf.append(_device_put_tree(item, device))
             _OBS_PREFETCH.inc()
             if len(buf) >= depth:

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from ..core import tensor as tensor_mod
 from ..core.tensor import Tensor
 from ..observability import counter as _obs_counter, gauge as _obs_gauge
+from ..observability import continuous as _cont
 from ..observability import flight as _flight
 
 __all__ = ["to_static", "not_to_static", "in_to_static_trace", "ignore_module",
@@ -109,6 +110,16 @@ def stream_state_out(t, a):
             and a.sharding.memory_kind != kind:
         a = jax.device_put(a, a.sharding.with_memory_kind(kind))
     return a
+
+
+def _aval_or_value(x):
+    """ShapeDtypeStruct of an array-like (Tensor or jax.Array), or the
+    raw value for non-array leaves — the abstract form analyze_cached()
+    re-traces a cached signature with."""
+    d = getattr(x, "_d", x)
+    if hasattr(d, "shape") and hasattr(d, "dtype"):
+        return jax.ShapeDtypeStruct(d.shape, d.dtype)
+    return d
 
 
 class _Tracker:
@@ -284,6 +295,42 @@ class StaticFunction:
         or when analysis is off)."""
         return self._last_graph_report
 
+    def analyze_cached(self, key=None, config=None):
+        """Graph-analyze an ALREADY-compiled signature from its cached
+        avals — an abstract re-trace, no device execution, no concrete
+        arguments needed. This is the programmatic join API the
+        continuous profiler's reconciliation calls to turn a measured
+        program into ranked fusion targets. ``key=None`` uses the most
+        recently dispatched signature. Returns the
+        :class:`~paddle_tpu.analysis.graph.GraphReport` (cached per
+        signature) or None when nothing is compiled yet."""
+        explicit = key is not None
+        key = key if explicit else getattr(self, "_last_key", None)
+        entry = self._cache.get(key)
+        if entry is None:
+            # a key that misses (evicted, stale) must NOT be silently
+            # substituted with another signature's analysis; the implicit
+            # form only falls back when there is exactly one candidate
+            if explicit or len(self._cache) != 1:
+                return None
+            entry = next(iter(self._cache.values()))
+        jitted, cell, _state_list = entry
+        if config is None:
+            report = cell.get("graph_report")
+            if report is not None:
+                return report
+        avals = cell.get("avals")
+        if avals is None:
+            return None
+        from ..analysis.graph import analyze_graph
+        from ..analysis.graph.trace import source_file_of
+        cj = jitted.trace(avals[0], avals[1]).jaxpr
+        report = analyze_graph(cj, name=self._obs_name, config=config,
+                               prefer_file=source_file_of(self._fn))
+        if config is None:   # only the default-config report is cached
+            cell["graph_report"] = report
+        return report
+
     # -- call ---------------------------------------------------------------
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled or in_to_static_trace() or self._fell_back:
@@ -334,9 +381,15 @@ class StaticFunction:
             _OBS_COMPILES.inc(fn=fn_name)
             if _flight.enabled():
                 _flight.record("jit_compile", fn=fn_name)
+            # abstract shapes of this signature, kept so analyze_cached()
+            # (the continuous profiler's reconciliation) can re-trace the
+            # program later without the concrete call arguments
+            cell["avals"] = ([_aval_or_value(t._d) for t in state_list],
+                             [_aval_or_value(a) for a in arg_arrays])
             entry = (jitted, cell, state_list)
             self._cache[key] = entry
             self._maybe_analyze(key, jitted, state_list, arg_arrays)
+        self._last_key = key
         jitted, cell, state_list = entry
         try:
             return self._run_compiled(jitted, cell, state_list, arg_arrays)
@@ -352,6 +405,8 @@ class StaticFunction:
             if not self._fallback:
                 raise
             del self._cache[key]
+            if getattr(self, "_last_key", None) == key:
+                self._last_key = None   # analyze_cached must not dangle
             self._segmented.add(key)
             import warnings
             warnings.warn(
@@ -372,14 +427,22 @@ class StaticFunction:
             state_arrays = dedup_for_donation(
                 state_arrays, {id(a) for a in arg_arrays})
         from ..profiler.profiler import op_timing_active, record_program
-        if op_timing_active():
-            import time as _t
-            t0 = _t.perf_counter()
+        timed = op_timing_active()
+        sampled = _cont.sampling_active()
+        if timed or sampled:
+            # profiled dispatch: block on EVERYTHING the program produced
+            # (state updates included) so the wall time is the program's
+            # device time, not the enqueue cost
+            t0 = time.perf_counter()
             new_state, out_flat = jitted(state_arrays, arg_arrays)
-            jax.block_until_ready(out_flat)
-            record_program(
-                f"to_static:{getattr(self._fn, '__name__', 'fn')}",
-                _t.perf_counter() - t0)
+            jax.block_until_ready((new_state, out_flat))
+            dt = time.perf_counter() - t0
+            if timed:
+                record_program(
+                    f"to_static:{getattr(self._fn, '__name__', 'fn')}", dt)
+            if sampled:
+                _cont.record_program(f"to_static:{self._obs_name}", dt)
+                _cont.note_program(f"to_static:{self._obs_name}", self)
         else:
             new_state, out_flat = jitted(state_arrays, arg_arrays)
         for t, a in zip(state_list, new_state):

@@ -37,6 +37,19 @@ Beyond metrics, two forensic layers (this PR's black box):
   ``paddle_tpu_hbm_bytes{kind=...}`` gauges) and per-``nn.Layer`` peak
   attribution via ``memory.attribute_memory(model)``.
 
+And the live layer (:mod:`.continuous`):
+
+* a bounded-overhead **sampling profiler** (``continuous.on_step(step)``
+  once per training step) that captures per-dispatched-program wall time
+  into ``paddle_tpu_program_step_ms`` histograms every
+  ``PADDLE_TPU_PROF_EVERY`` steps, backs its cadence off past the
+  ``PADDLE_TPU_PROF_BUDGET_PCT`` overhead budget, and reconciles the
+  measurements with the static fusion candidates into the ranked
+  ``fusion_targets`` mega-kernel work queue;
+* a zero-dependency **telemetry HTTP server** — :func:`serve`\\ ``(port)``
+  (``PADDLE_TPU_METRICS_PORT``) with ``/metrics``, ``/healthz``,
+  ``/flight`` and ``/profile?steps=N`` endpoints.
+
 Metric names follow ``paddle_tpu_<area>_<name>_<unit>``. Collection is on
 by default; ``PADDLE_TPU_METRICS=0`` (or :func:`enable`\\ ``(False)``)
 turns every recording call into a near-zero-cost no-op.
@@ -65,6 +78,8 @@ from .step_timer import (  # noqa: F401
 )
 from . import flight  # noqa: F401
 from . import memory  # noqa: F401
+from . import continuous  # noqa: F401
+from .continuous import serve, shutdown_server, TelemetryServer  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
@@ -72,7 +87,8 @@ __all__ = [
     "enabled", "enable", "value", "total", "reset",
     "render_prometheus", "snapshot", "merge_into_chrome_trace",
     "StepTimer", "device_peak_flops", "analytic_mfu", "PEAK_FLOPS_TABLE",
-    "dump", "serve_text", "flight", "memory",
+    "dump", "serve_text", "flight", "memory", "continuous",
+    "serve", "shutdown_server", "TelemetryServer",
 ]
 
 

@@ -1,0 +1,469 @@
+"""Continuous profiler: always-on, bounded-overhead measured attribution.
+
+The missing half of the observability stack: PR 1/PR 4 count events and
+attribute memory, PR 6 *estimates* fusion wins statically — nothing until
+now measured where device time actually goes while a run is alive. This
+package closes the loop:
+
+* :class:`ContinuousProfiler` — a sampling profiler the training loop
+  drives with one ``on_step()`` call per step. Every
+  ``PADDLE_TPU_PROF_EVERY`` steps (default 50) it opens a one-step
+  **capture window**: the framework's dispatch sites (``to_static``
+  program execution, the fused optimizer step, collective ``wait()``\\ s,
+  ``prefetch_to_device`` feed waits) time themselves and record into
+  per-program ``paddle_tpu_program_step_ms`` histograms. Outside a window
+  the hooks cost one boolean test. The sampler measures its OWN cost —
+  the profiled step's excess over the steady-state EWMA plus its direct
+  bookkeeping — amortizes it over the cadence, exports it as
+  ``paddle_tpu_prof_overhead_pct``, and **backs its cadence off**
+  (doubling ``every``) whenever it exceeds the hard budget
+  ``PADDLE_TPU_PROF_BUDGET_PCT`` (default 1%).
+* :func:`fusion_targets` — the reconciliation layer: re-runs the PR 6
+  graph analyzer on each profiled ``to_static`` program (via
+  ``StaticFunction.analyze_cached``, an abstract trace — no device
+  execution) and joins the static GA100 fusion candidates with the
+  program's MEASURED ms/step and the window's measured HBM delta
+  (``observability.memory``), emitting the ranked mega-kernel work queue
+  (``bench.py`` ``extra.fusion_targets``; appended to flight dumps).
+* :func:`serve` — a zero-dependency threaded HTTP server
+  (``PADDLE_TPU_METRICS_PORT``) exposing ``/metrics`` (Prometheus text),
+  ``/healthz`` (step liveness), ``/flight`` (the ring buffer as JSON) and
+  ``/profile?steps=N`` (trigger a dense on-demand capture window).
+
+Import-time stdlib-only, like the rest of the package: jax and the graph
+analyzer are pulled in lazily, only inside reconciliation.
+
+CLI: ``python -m paddle_tpu.observability.continuous report`` renders the
+reconciled fusion-target table (live tiny-GPT run, or ``--from-bench``).
+Disable the sampler entirely with ``PADDLE_TPU_PROF=0``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+
+from .. import metrics as _m
+
+__all__ = [
+    "ContinuousProfiler", "DEFAULT_EVERY", "DEFAULT_BUDGET_PCT",
+    "MAX_EVERY", "PROGRAM_MS_BUCKETS",
+    "get_profiler", "profiler_if_started", "on_step", "stop", "reset",
+    "sampling_active", "record_program", "note_program",
+    "fusion_targets", "last_reconciliation", "profile_snapshot",
+    "serve", "shutdown_server", "TelemetryServer",
+]
+
+DEFAULT_EVERY = 50
+DEFAULT_BUDGET_PCT = 1.0
+#: backoff ceiling: even a pathologically expensive capture keeps at least
+#: one window per MAX_EVERY steps, so telemetry never goes fully dark
+MAX_EVERY = 6400
+
+#: total on-demand windows that may be queued at once (request_capture
+#: clamps to this): every pending window makes one future step's
+#: dispatches block, budget-exempt — repeated /profile requests must not
+#: be able to stack an unbounded slowdown
+MAX_PENDING_CAPTURE = 1000
+
+#: per-program latency buckets, in MILLISECONDS (the registry default is
+#: seconds-scale; dispatch latencies need sub-ms resolution)
+PROGRAM_MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+                      50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                      10000.0)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_on(name, default="1"):
+    return os.environ.get(name, default).lower() not in ("0", "false", "off")
+
+
+class ContinuousProfiler:
+    """Step-cadence sampling profiler with a hard overhead budget.
+
+    One ``on_step()`` call per training step. The step AFTER a cadence
+    hit is profiled: dispatch hooks (see module docstring) block on their
+    results and record wall ms into ``paddle_tpu_program_step_ms{program=}``.
+    The profiled step's excess over the steady-state EWMA — plus direct
+    bookkeeping (the HBM probe) — is the sampler's cost; amortized over
+    ``every`` steps it must stay under ``budget_pct`` of step time, or the
+    cadence doubles (exported: ``paddle_tpu_prof_overhead_pct``,
+    ``paddle_tpu_prof_cadence_steps``, ``paddle_tpu_prof_backoffs_total``).
+
+    Reconciliation (the one deliberate exception to the budget): after
+    ``RECONCILE_AFTER_WINDOWS`` windows the profiler re-runs the graph
+    analyzer once per profiled program — an abstract re-trace, roughly one
+    extra compile's worth of host time, amortizing to zero — so flight
+    dumps and ``/flight`` carry the measured fusion-target table without
+    any consumer having to ask. ``PADDLE_TPU_PROF_RECONCILE=0`` disables.
+    """
+
+    RECONCILE_AFTER_WINDOWS = 2
+    RECONCILE_REFRESH_WINDOWS = 64
+
+    def __init__(self, every: int | None = None,
+                 budget_pct: float | None = None, registry=None):
+        self.enabled = _env_on("PADDLE_TPU_PROF")
+        self.every = max(every if every is not None
+                         else _env_int("PADDLE_TPU_PROF_EVERY",
+                                       DEFAULT_EVERY), 1)
+        self.base_every = self.every
+        self.budget_pct = budget_pct if budget_pct is not None \
+            else _env_float("PADDLE_TPU_PROF_BUDGET_PCT", DEFAULT_BUDGET_PCT)
+        self.memory_probe = _env_on("PADDLE_TPU_PROF_MEMORY")
+        self.auto_reconcile = _env_on("PADDLE_TPU_PROF_RECONCILE")
+        reg = registry or _m.get_registry()
+        self._h_program = reg.histogram(
+            "paddle_tpu_program_step_ms",
+            "wall milliseconds per dispatched program inside profiled "
+            "step windows, by program", buckets=PROGRAM_MS_BUCKETS)
+        self._c_steps = reg.counter(
+            "paddle_tpu_prof_steps_total",
+            "training steps observed by the continuous profiler "
+            "(on_step calls; /healthz derives steps/s from its rate)",
+            windowed=True)
+        self._c_windows = reg.counter(
+            "paddle_tpu_prof_windows_total",
+            "profiled capture windows, by trigger (cadence|on_demand)")
+        self._c_backoffs = reg.counter(
+            "paddle_tpu_prof_backoffs_total",
+            "cadence doublings forced by the overhead budget")
+        self._g_overhead = reg.gauge(
+            "paddle_tpu_prof_overhead_pct",
+            "measured sampler cost as percent of steady-state step time "
+            "(amortized over the cadence; budget PADDLE_TPU_PROF_BUDGET_PCT)")
+        self._g_every = reg.gauge(
+            "paddle_tpu_prof_cadence_steps",
+            "current sampling cadence (steps between capture windows)")
+        self._g_every.set(self.every)
+        self._clock = time.perf_counter   # injectable for tests
+        self._lock = threading.Lock()     # stats reads vs the train thread
+        self.active = False               # a capture window is open NOW
+        self._pending = 0                 # dense steps requested (/profile)
+        self._count = 0                   # on_step calls seen
+        self._last_t = None               # previous on_step clock
+        self._window_t0 = None
+        self._window_trigger = "cadence"
+        self._window: dict = {}           # name -> [calls, seconds]
+        self._bytes_open = None
+        self._open_cost = 0.0
+        self.steady_step_s = None         # EWMA of UNPROFILED step wall
+        self.overhead_pct = 0.0           # EWMA, exported
+        self.windows = 0
+        self.last_step: int | None = None
+        self.last_step_wall: float | None = None   # time.time(), /healthz
+        self.hbm_delta_bytes: int | None = None
+        self._programs: dict = {}   # name -> {"ms", "calls", "windows"}
+        self._static_fns: dict = {} # name -> weakref to StaticFunction
+        self._reconciled_at = 0
+
+    # -- the per-step driver -------------------------------------------------
+
+    def on_step(self, step: int | None = None) -> None:
+        """Mark a step boundary. Cheap (a clock read + a counter) except
+        when it closes or opens a capture window. ``PADDLE_TPU_PROF=0``
+        disables SAMPLING only — step liveness (last_step, steps/s, the
+        /healthz contract) keeps updating, so turning the profiler off
+        never silences stall alerting."""
+        now = self._clock()
+        self._count += 1
+        self.last_step = step if step is not None else self._count
+        self.last_step_wall = time.time()
+        self._c_steps.inc()
+        if not self.enabled:
+            return
+        if self.active:
+            self._close_window(now)
+        elif self._last_t is not None:
+            dt = now - self._last_t
+            self.steady_step_s = dt if self.steady_step_s is None \
+                else 0.8 * self.steady_step_s + 0.2 * dt
+        if self._pending > 0 or self._count % self.every == 1 \
+                or self.every == 1:
+            self._open_window()
+        self._last_t = self._clock()
+
+    def stop(self) -> None:
+        """Close any open window WITHOUT folding it (the step it covers
+        was cut short) and deactivate until the next ``on_step``. Call
+        after a timed loop so later untimed work is not captured."""
+        with self._lock:
+            self.active = False
+            self._window = {}
+            self._window_t0 = None
+
+    def request_capture(self, steps: int = 1) -> int:
+        """Queue ``steps`` dense on-demand capture windows (the
+        ``/profile?steps=N`` endpoint); thread-safe. The TOTAL pending is
+        clamped to ``MAX_PENDING_CAPTURE``. Returns the total now
+        pending."""
+        steps = max(int(steps), 1)
+        with self._lock:
+            self._pending = min(self._pending + steps, MAX_PENDING_CAPTURE)
+            return self._pending
+
+    # -- windows -------------------------------------------------------------
+
+    def _open_window(self):
+        t0 = self._clock()
+        with self._lock:
+            on_demand = self._pending > 0
+            if on_demand:
+                self._pending -= 1
+            self._window_trigger = "on_demand" if on_demand else "cadence"
+            self._window = {}
+            self._bytes_open = self._probe_bytes()
+            self.active = True
+            self._window_t0 = self._clock()
+        self._open_cost = self._clock() - t0
+
+    def _close_window(self, now):
+        window_wall = now - (self._window_t0 or now)
+        t0 = self._clock()
+        programs_s = 0.0
+        with self._lock:
+            self.active = False
+            window, self._window = self._window, {}
+            self.windows += 1
+            trigger = self._window_trigger
+            bytes_close = self._probe_bytes()
+            if bytes_close is not None and self._bytes_open is not None:
+                delta = bytes_close - self._bytes_open
+                self.hbm_delta_bytes = delta if self.hbm_delta_bytes is None \
+                    else int(0.5 * self.hbm_delta_bytes + 0.5 * delta)
+            for name, (calls, secs) in window.items():
+                programs_s += secs
+                st = self._programs.setdefault(
+                    name, {"ms": None, "calls": 0, "windows": 0})
+                ms = secs * 1e3
+                st["ms"] = ms if st["ms"] is None \
+                    else 0.5 * st["ms"] + 0.5 * ms
+                st["calls"] += calls
+                st["windows"] += 1
+        self._c_windows.inc(trigger=trigger)
+        self._account_overhead(window_wall, programs_s,
+                               self._clock() - t0, trigger)
+        if self.auto_reconcile and self.windows >= \
+                self.RECONCILE_AFTER_WINDOWS and (
+                    self._reconciled_at == 0 or
+                    self.windows - self._reconciled_at >=
+                    self.RECONCILE_REFRESH_WINDOWS):
+            self._reconciled_at = self.windows
+            try:
+                from .reconcile import fusion_targets as _ft
+                _ft(profiler=self)
+            except Exception:
+                pass
+
+    def _account_overhead(self, window_wall, programs_s, close_cost,
+                          trigger):
+        """Fold one window's measured cost into the overhead EWMA and back
+        the cadence off past the budget. On-demand windows are exempt —
+        the operator asked for them.
+
+        The cost model is pipeline-aware: in a loop that only enqueues,
+        unprofiled steps measure host dispatch (milliseconds) while the
+        profiled step's block surfaces the device work that was
+        overlapping — wall minus steady EWMA would bill the sampler for
+        compute the device owed anyway. So the step's true cost floor is
+        ``max(steady EWMA, the window's own measured program seconds)``;
+        only wall time BEYOND that floor (plus direct bookkeeping — the
+        HBM probes) is sampler overhead, amortized over the cadence."""
+        if trigger != "cadence" or self.steady_step_s is None \
+                or self.steady_step_s <= 0:
+            return
+        step_cost = max(self.steady_step_s, programs_s)
+        excess = max(window_wall - step_cost, 0.0)
+        cost = excess + close_cost + self._open_cost
+        pct = cost / (self.every * step_cost) * 100.0
+        self.overhead_pct = pct if self.windows <= 1 \
+            else 0.5 * self.overhead_pct + 0.5 * pct
+        self._g_overhead.set(round(self.overhead_pct, 4))
+        if self.overhead_pct > self.budget_pct and self.every < MAX_EVERY:
+            self.every = min(self.every * 2, MAX_EVERY)
+            self._g_every.set(self.every)
+            self._c_backoffs.inc()
+
+    def _probe_bytes(self):
+        if not self.memory_probe:
+            return None
+        try:
+            from .. import memory as _memory
+            return int(_memory.current_bytes())
+        except Exception:
+            return None
+
+    # -- hook-side recording -------------------------------------------------
+
+    def record(self, name: str, seconds: float) -> None:
+        """One dispatched program's wall time inside the open window
+        (called by the jit/optimizer/prefetch/collective hooks)."""
+        if not self.active:
+            return
+        row = self._window.get(name)
+        if row is None:
+            row = self._window[name] = [0, 0.0]
+        row[0] += 1
+        row[1] += seconds
+        self._h_program.observe(seconds * 1e3, program=name)
+
+    def note_program(self, name: str, obj) -> None:
+        """Remember (weakly) the StaticFunction behind a profiled program
+        so reconciliation can re-analyze its jaxpr later."""
+        try:
+            self._static_fns[name] = weakref.ref(obj)
+        except TypeError:
+            pass
+
+    def static_fn(self, name: str):
+        ref = self._static_fns.get(name)
+        return ref() if ref is not None else None
+
+    # -- reads ---------------------------------------------------------------
+
+    def program_stats(self) -> dict:
+        """{program: {"ms_per_step", "calls", "windows", "share"}} —
+        EWMA wall ms per profiled step, per program."""
+        with self._lock:
+            progs = {k: dict(v) for k, v in self._programs.items()}
+        total = sum(v["ms"] or 0.0 for v in progs.values()) or 1.0
+        return {k: {"ms_per_step": round(v["ms"] or 0.0, 3),
+                    "calls": v["calls"], "windows": v["windows"],
+                    "share": round((v["ms"] or 0.0) / total, 4)}
+                for k, v in progs.items()}
+
+    def steps_per_sec(self, window: float = 30.0) -> float:
+        return self._c_steps.rate(window)
+
+    def snapshot(self) -> dict:
+        """JSON-safe self-description (flight dumps, /healthz, bench)."""
+        return {
+            "every": self.every,
+            "base_every": self.base_every,
+            "budget_pct": self.budget_pct,
+            "overhead_pct": round(self.overhead_pct, 4),
+            "windows": self.windows,
+            "steps_seen": self._count,
+            "steady_step_ms": round(self.steady_step_s * 1e3, 3)
+            if self.steady_step_s else None,
+            "hbm_delta_bytes": self.hbm_delta_bytes,
+            "programs": self.program_stats(),
+        }
+
+    def reset(self, every: int | None = None) -> None:
+        """Forget windows/EWMAs/programs (bench sections, tests); the
+        cadence returns to ``every`` or its configured base."""
+        with self._lock:
+            self.active = False
+            self._pending = 0
+            self._count = 0
+            self._last_t = None
+            self._window = {}
+            self._window_t0 = None
+            self.steady_step_s = None
+            self.overhead_pct = 0.0
+            self.windows = 0
+            self.hbm_delta_bytes = None
+            self._programs.clear()
+            self._static_fns.clear()
+            self._reconciled_at = 0
+            self.every = max(every, 1) if every is not None \
+                else self.base_every
+            self._g_every.set(self.every)
+            self._g_overhead.set(0.0)
+
+
+# ---------------------------------------------------------------------------
+# process-wide default profiler + module-level API (the hot-site surface)
+# ---------------------------------------------------------------------------
+
+_default: ContinuousProfiler | None = None
+_default_lock = threading.Lock()
+
+
+def get_profiler() -> ContinuousProfiler:
+    """The process-wide profiler every framework hook records into
+    (created on first use)."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = ContinuousProfiler()
+    return _default
+
+
+def profiler_if_started() -> ContinuousProfiler | None:
+    """The default profiler ONLY if something already created it — the
+    read-side accessor (/healthz, flight dumps) that must not spin up
+    sampling machinery in processes that never profile."""
+    return _default
+
+
+def sampling_active() -> bool:
+    """True while a capture window is open — the one test every dispatch
+    hook pays per call (an attribute read; no profiler is even created)."""
+    p = _default
+    return p is not None and p.active
+
+
+def record_program(name: str, seconds: float) -> None:
+    p = _default
+    if p is not None and p.active:
+        p.record(name, seconds)
+
+
+def note_program(name: str, obj) -> None:
+    p = _default
+    if p is not None and p.active:
+        p.note_program(name, obj)
+
+
+def on_step(step: int | None = None) -> None:
+    """Drive the default profiler: call once per training step."""
+    get_profiler().on_step(step)
+
+
+def stop() -> None:
+    p = _default
+    if p is not None:
+        p.stop()
+
+
+def reset(every: int | None = None) -> None:
+    get_profiler().reset(every=every)
+
+
+def profile_snapshot() -> dict | None:
+    """The default profiler's snapshot + last reconciliation, or None when
+    nothing ever profiled (flight dumps embed this)."""
+    p = _default
+    if p is None or (p.windows == 0 and p._count == 0):
+        return None
+    snap = p.snapshot()
+    from .reconcile import last_reconciliation
+    targets = last_reconciliation()
+    if targets is not None:
+        snap["fusion_targets"] = targets
+    return snap
+
+
+# reconciliation + server: re-exported here so the public surface is one
+# module (paddle.observability.continuous.*; serve also rides
+# paddle.observability.serve)
+from .reconcile import fusion_targets, last_reconciliation  # noqa: E402,F401
+from .server import TelemetryServer, serve, shutdown_server  # noqa: E402,F401

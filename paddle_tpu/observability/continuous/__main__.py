@@ -1,0 +1,114 @@
+"""``python -m paddle_tpu.observability.continuous report`` — render the
+reconciled fusion-target table (the measured mega-kernel work queue).
+
+Two sources:
+
+* ``--from-bench BENCH.json`` — read an existing bench line's
+  ``extra.fusion_targets`` (and ``telemetry.prof_overhead_pct``) and
+  render it; no device work.
+* default (live) — run a small profiled CPU training loop over the tiny
+  GPT (``--steps``, profiler cadence ``--every``), reconcile, and render.
+  This is the zero-to-table path: it exercises the exact sampler +
+  reconciliation machinery a real run wires in via ``on_step``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _live_targets(steps: int, every: int, top: int):
+    """Profile a tiny GPT train loop on CPU and reconcile."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.observability import continuous as cont
+
+    paddle.seed(0)
+    vocab, seq, batch = 512, 64, 8
+    model = GPT(GPTConfig(vocab_size=vocab, max_position_embeddings=seq,
+                          hidden_size=128, num_layers=2, num_heads=4))
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, seq + 1))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+
+    @paddle.jit.to_static
+    def step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    prof = cont.get_profiler()
+    prof.reset(every=every)
+    prof.auto_reconcile = False   # reconcile once, explicitly, below
+    for i in range(steps):
+        step(x, y)
+        cont.on_step(i)
+    cont.stop()
+    return cont.fusion_targets(top=top), prof.overhead_pct
+
+
+def _bench_targets(path: str):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))))
+    from tools.perf_gate import load_bench
+    d = load_bench(path)
+    targets = (d.get("extra") or {}).get("fusion_targets") or []
+    tel = d.get("telemetry")
+    overhead = tel.get("prof_overhead_pct") if isinstance(tel, dict) \
+        else None
+    return ([t for t in targets if isinstance(t, dict)], overhead)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.continuous",
+        description="Continuous-profiler tooling: measured fusion-target "
+                    "reconciliation report.")
+    sub = ap.add_subparsers(dest="cmd")
+    rep = sub.add_parser(
+        "report", help="render the ranked fusion-target table")
+    rep.add_argument("--from-bench", metavar="BENCH_JSON",
+                     help="read extra.fusion_targets from a bench line "
+                          "instead of running a live profiled loop")
+    rep.add_argument("--steps", type=int, default=8,
+                     help="live mode: profiled train steps (default 8)")
+    rep.add_argument("--every", type=int, default=2,
+                     help="live mode: profiler cadence (default 2)")
+    rep.add_argument("--top", type=int, default=10)
+    rep.add_argument("--json", action="store_true",
+                     help="print the raw target list as JSON")
+    args = ap.parse_args(argv)
+    if args.cmd != "report":
+        ap.print_help()
+        return 2
+    from .reconcile import render_targets
+    if args.from_bench:
+        try:
+            targets, overhead = _bench_targets(args.from_bench)
+        except (OSError, ValueError) as e:
+            print(f"cannot read bench file {args.from_bench!r}: {e}",
+                  file=sys.stderr)
+            return 1
+        targets = targets[:args.top]
+    else:
+        targets, overhead = _live_targets(args.steps, args.every, args.top)
+    if args.json:
+        print(json.dumps({"fusion_targets": targets,
+                          "prof_overhead_pct": overhead}))
+    else:
+        print(render_targets(targets, overhead_pct=overhead))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

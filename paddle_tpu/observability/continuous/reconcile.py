@@ -1,0 +1,102 @@
+"""Static->measured reconciliation: the ranked mega-kernel work queue.
+
+PR 6's graph analyzer ranks fusion candidates by *estimated* saved HBM
+bytes; the continuous profiler measures where step time *actually* goes.
+This module joins the two: for every profiled ``to_static`` program it
+re-runs the graph analyzer on the program's cached jaxpr
+(``StaticFunction.analyze_cached`` — an abstract trace, no device
+execution) and calls :func:`paddle_tpu.analysis.graph.join_measured` to
+attribute the program's measured ms/step to each GA100 candidate by its
+share of the program's HBM traffic (the right prior for memory-bound
+programs — rule GA109's model). The result is the ``fusion_targets``
+table: candidate name, sites, estimated saved bytes, **measured** ms/step
+share — bench.py embeds it (``extra.fusion_targets``), the report CLI
+renders it, and flight dumps carry the last computed copy.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["fusion_targets", "last_reconciliation", "render_targets"]
+
+_last_lock = threading.Lock()
+_last: list | None = None
+
+
+def last_reconciliation() -> list | None:
+    """The most recently computed fusion-target table (None before the
+    first reconciliation). Flight dumps embed this instead of re-running
+    the analyzer in a dying process."""
+    with _last_lock:
+        return None if _last is None else [dict(t) for t in _last]
+
+
+def _set_last(targets: list) -> None:
+    global _last
+    with _last_lock:
+        _last = [dict(t) for t in targets]
+
+
+def fusion_targets(top: int = 10, profiler=None) -> list:
+    """Reconcile measured per-program time with static GA100 candidates.
+
+    Returns up to ``top`` rows sorted by ``measured_ms_share`` descending
+    (ties broken by ``est_saved_bytes``), each::
+
+        {"name", "sites", "n_ops", "span", "program",
+         "est_saved_bytes",          # static, per site
+         "est_saved_bytes_total",    # static, x sites
+         "measured_ms",              # the program's measured ms/step
+         "measured_ms_share",        # attributed to this candidate
+         "measured_hbm_delta_bytes"} # window HBM delta (when probed)
+
+    Programs without an analyzable jaxpr (the fused optimizer dispatch,
+    prefetch/collective waits) contribute measured time but no candidates
+    and are skipped. Never raises past its guard: an analysis failure on
+    one program drops that program, not the table.
+    """
+    from . import get_profiler
+    p = profiler or get_profiler()
+    stats = p.program_stats()
+    targets: list = []
+    for name, st in stats.items():
+        sf = p.static_fn(name)
+        if sf is None or not hasattr(sf, "analyze_cached"):
+            continue
+        try:
+            report = sf.analyze_cached()
+        except Exception:
+            report = None
+        if report is None:
+            continue
+        from ...analysis.graph import join_measured
+        targets.extend(join_measured(
+            report, measured_ms=st["ms_per_step"], program=name,
+            hbm_delta_bytes=p.hbm_delta_bytes))
+    targets.sort(key=lambda t: (-t["measured_ms_share"],
+                                -t["est_saved_bytes"], t["name"]))
+    targets = targets[:top]
+    _set_last(targets)
+    return targets
+
+
+def render_targets(targets: list, overhead_pct=None) -> str:
+    """Human table of a fusion-target list (the report CLI's output)."""
+    out = ["rank  candidate                 sites  est saved/site  "
+           "measured ms/step  program"]
+    for i, t in enumerate(targets, 1):
+        # .get defaults: --from-bench rows come from arbitrary (older,
+        # hand-edited) bench lines, not just our own join_measured output
+        mib = t.get("est_saved_bytes", 0) / (1 << 20)
+        out.append(f"{i:<5} {t.get('name', '?'):<25} "
+                   f"{t.get('sites', 1):>5}  {mib:>10.2f} MiB  "
+                   f"{t.get('measured_ms_share', 0.0):>16.3f}  "
+                   f"{t.get('program', '')}")
+    if not targets:
+        out.append("(no reconciled candidates — profile a to_static "
+                   "program first)")
+    if overhead_pct is not None:
+        out.append(f"sampler overhead: {overhead_pct:.3f}% of steady-state "
+                   f"step time")
+    return "\n".join(out)

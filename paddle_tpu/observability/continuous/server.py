@@ -1,0 +1,259 @@
+"""Live telemetry HTTP server: the scrape surface a serving runtime and
+multi-host training stand on.
+
+Zero-dependency (stdlib ``http.server``, threaded, daemonic) so it can run
+inside every training/serving process. Endpoints:
+
+* ``GET /metrics`` — the existing Prometheus text exposition
+  (``observability.exporters.render_prometheus``), content type
+  ``text/plain; version=0.0.4``.
+* ``GET /healthz`` — step liveness as JSON: 200 while the last
+  ``continuous.on_step`` is younger than the stall threshold
+  (``PADDLE_TPU_HEALTH_STALL_S``, default 120s), **503** when steps have
+  stalled, 200 ``{"status": "idle"}`` before any step. Carries
+  ``steps_per_s`` from the registry's windowed rate — no scrape-side math.
+* ``GET /flight`` — the flight recorder's current ring buffer as strict
+  RFC-8259 JSON (NaN losses stringified, same sanitizer as dumps), plus
+  the profiler snapshot when one exists.
+* ``GET /profile?steps=N`` — queue N dense on-demand capture windows on
+  the continuous profiler (the next N training steps are profiled).
+
+Start with ``paddle_tpu.observability.serve(port)`` (env:
+``PADDLE_TPU_METRICS_PORT``; port 0 binds an ephemeral port — tests). The
+server shuts down cleanly via ``close()``; the preemption handler calls
+:func:`shutdown_server` during its drain so a preempted process leaves no
+dangling acceptor thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+__all__ = ["TelemetryServer", "serve", "shutdown_server",
+           "DEFAULT_PORT", "DEFAULT_STALL_S"]
+
+DEFAULT_PORT = 9406
+DEFAULT_STALL_S = 120.0
+#: /profile?steps=N per-request ceiling: every on-demand window makes the
+#: NEXT step's dispatches block on device results (budget-exempt), so an
+#: unauthenticated peer must not be able to queue an unbounded slowdown
+#: (the profiler also clamps TOTAL pending to its MAX_PENDING_CAPTURE)
+MAX_PROFILE_STEPS = 1000
+
+
+def _env_port() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TPU_METRICS_PORT", DEFAULT_PORT))
+    except ValueError:
+        return DEFAULT_PORT
+
+
+def _env_stall() -> float:
+    try:
+        return float(os.environ.get("PADDLE_TPU_HEALTH_STALL_S",
+                                    DEFAULT_STALL_S))
+    except ValueError:
+        return DEFAULT_STALL_S
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-telemetry/1"
+
+    def log_message(self, *args):   # stdout silence: this runs inside
+        pass                        # training processes
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload: dict):
+        # same sanitizers as flight dumps: _finite stringifies NaN/Inf,
+        # _json_safe catches non-native field values (np scalars, Paths)
+        # recorded through flight.record's open **fields API
+        from ..flight import _finite, _json_safe
+        self._send(code, json.dumps(_finite(payload),
+                                    default=_json_safe).encode(),
+                   "application/json")
+
+    # -- routes --------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (http.server contract)
+        try:
+            url = urlparse(self.path)
+            route = {"/metrics": self._metrics, "/healthz": self._healthz,
+                     "/flight": self._flight,
+                     "/profile": self._profile}.get(url.path)
+            if route is None:
+                self._send_json(404, {"error": f"no route {url.path!r}",
+                                      "routes": ["/metrics", "/healthz",
+                                                 "/flight", "/profile"]})
+                return
+            route(parse_qs(url.query))
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # a scrape must never kill the process
+            try:
+                self._send_json(500, {"error": repr(e)[:300]})
+            except Exception:
+                pass
+
+    def _metrics(self, _q):
+        from ..exporters import render_prometheus
+        self._send(200, render_prometheus().encode(),
+                   "text/plain; version=0.0.4; charset=utf-8")
+
+    def _healthz(self, _q):
+        import time
+        from . import profiler_if_started
+        p = profiler_if_started()
+        stall = self.server.stall_after_s  # type: ignore[attr-defined]
+        if p is None or p.last_step_wall is None:
+            self._send_json(200, {"status": "idle", "last_step": None,
+                                  "stall_after_s": stall})
+            return
+        age = time.time() - p.last_step_wall
+        payload = {
+            "status": "ok" if age <= stall else "stalled",
+            "last_step": p.last_step,
+            "last_step_age_s": round(age, 3),
+            "stall_after_s": stall,
+            "steps_per_s": round(p.steps_per_sec(), 4),
+            "prof_overhead_pct": round(p.overhead_pct, 4),
+        }
+        self._send_json(200 if age <= stall else 503, payload)
+
+    def _flight(self, _q):
+        from .. import flight
+        from . import profile_snapshot
+        rec = flight.get_recorder()
+        payload = {"enabled": rec.enabled, "capacity": rec.capacity,
+                   "events": rec.events()}
+        snap = profile_snapshot()
+        if snap is not None:
+            payload["profile"] = snap
+        self._send_json(200, payload)
+
+    def _profile(self, q):
+        from . import get_profiler
+        try:
+            steps = int(q.get("steps", ["1"])[0])
+        except ValueError:
+            self._send_json(400, {"error": "steps must be an int"})
+            return
+        if steps < 1 or steps > MAX_PROFILE_STEPS:
+            self._send_json(400, {"error": f"steps must be in "
+                                           f"[1, {MAX_PROFILE_STEPS}]"})
+            return
+        p = get_profiler()
+        if not p.enabled:
+            # on_step() never consumes pending windows when the sampler is
+            # off — queuing them would be a silent no-op the caller reads
+            # as "capture armed"
+            self._send_json(409, {"error": "continuous profiler is "
+                                           "disabled (PADDLE_TPU_PROF=0)"})
+            return
+        pending = p.request_capture(steps)
+        self._send_json(200, {"requested": steps, "pending": pending,
+                              "active": p.active, "every": p.every})
+
+
+class TelemetryServer:
+    """Threaded HTTP server over the process's telemetry. Construct via
+    :func:`serve` (module-tracked, drain-aware) or directly for tests::
+
+        srv = TelemetryServer(port=0).start()   # ephemeral port
+        ...
+        srv.close()                             # joins the acceptor thread
+    """
+
+    def __init__(self, port: int | None = None, host: str | None = None,
+                 stall_after_s: float | None = None):
+        port = _env_port() if port is None else int(port)
+        if host is None:
+            # scrape surfaces conventionally bind all interfaces, but the
+            # endpoints are unauthenticated (/flight leaks run internals,
+            # /profile costs step time) — PADDLE_TPU_METRICS_HOST=127.0.0.1
+            # confines them to the host on untrusted networks
+            host = os.environ.get("PADDLE_TPU_METRICS_HOST", "0.0.0.0")
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.stall_after_s = (  # type: ignore[attr-defined]
+            _env_stall() if stall_after_s is None else float(stall_after_s))
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"paddle-tpu-telemetry:{self.port}", daemon=True)
+
+    def start(self) -> "TelemetryServer":
+        # materialize the profiler so /metrics exposes the full continuous
+        # schema (HELP/TYPE of the program histograms) from the first scrape
+        from . import get_profiler
+        get_profiler()
+        self._thread.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop accepting, close the socket, join the acceptor thread.
+        Idempotent; safe from any thread, including on a server that was
+        constructed but never started (shutdown() would block forever
+        waiting on an Event only serve_forever sets)."""
+        try:
+            if self._thread.is_alive():
+                self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "TelemetryServer":
+        return self if self.running else self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+_server: TelemetryServer | None = None
+_server_lock = threading.Lock()
+
+
+def serve(port: int | None = None, host: str | None = None,
+          stall_after_s: float | None = None) -> TelemetryServer:
+    """Start (or replace) the process-wide telemetry server and return it.
+    ``port=None`` reads ``PADDLE_TPU_METRICS_PORT`` (default 9406);
+    ``port=0`` binds an ephemeral port (``.port`` says which). The
+    preemption drain shuts this server down via :func:`shutdown_server`."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.close()
+        _server = TelemetryServer(port=port, host=host,
+                                  stall_after_s=stall_after_s).start()
+        return _server
+
+
+def shutdown_server(timeout: float = 5.0) -> bool:
+    """Close the process-wide server if one is running (idempotent).
+    Returns True when a server was actually shut down."""
+    global _server
+    with _server_lock:
+        if _server is None:
+            return False
+        _server.close(timeout)
+        _server = None
+        return True

@@ -44,7 +44,11 @@ def render_prometheus(registry: Registry | None = None) -> str:
     reg = registry or get_registry()
     lines: list[str] = []
     for m in reg.metrics():
-        lines.append(f"# HELP {m.name} {_esc_help(m.help)}")
+        # help-less metrics get a bare "# HELP name" line: a trailing
+        # space is a grammar violation under strict parsers
+        help_txt = _esc_help(m.help)
+        lines.append(f"# HELP {m.name} {help_txt}" if help_txt
+                     else f"# HELP {m.name}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         if isinstance(m, (Counter, Gauge)):
             for key, v in m._items():

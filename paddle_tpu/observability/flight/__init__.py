@@ -188,6 +188,14 @@ class FlightRecorder:
         except Exception:
             payload["memory"] = None
             payload["module_peaks"] = None
+        try:
+            # continuous-profiler picture: measured per-program shares +
+            # the LAST reconciled fusion-target table (never re-analyzed
+            # here — a dying process must not start tracing jaxprs)
+            from .. import continuous as _continuous
+            payload["profile"] = _continuous.profile_snapshot()
+        except Exception:
+            payload["profile"] = None
         if extra:
             payload["extra"] = extra
         return payload
@@ -297,37 +305,64 @@ def last_dump_path() -> str | None:
 # ---------------------------------------------------------------------------
 
 _prev_excepthook = None
+_active_hook = None
+_hook_running = False
 
 
 def install_excepthook() -> None:
     """Chain a dump-on-unhandled-exception hook into ``sys.excepthook``.
-    Idempotent; the previous hook always runs afterwards, so tracebacks
-    print exactly as before. SystemExit/KeyboardInterrupt never reach
-    excepthook, so normal exits and the preemption path (which dumps
-    itself) are unaffected."""
-    global _prev_excepthook
-    if _prev_excepthook is not None:
+
+    Idempotent in the strong sense: a no-op while our hook IS the current
+    ``sys.excepthook``, and a **re-chain** when someone replaced the hook
+    after a previous install (before this, a stale install marker made
+    later installs silent no-ops that bypassed the replacement — the
+    cross-test flip PR 6's tier-1 notes). The hook in front always runs
+    afterwards, so tracebacks print exactly as before; if several flight
+    hooks end up chained, a reentrancy guard makes only the outermost one
+    dump. SystemExit/KeyboardInterrupt never reach excepthook, so normal
+    exits and the preemption path (which dumps itself) are unaffected."""
+    global _prev_excepthook, _active_hook
+    if _active_hook is not None and sys.excepthook is _active_hook:
         return
-    _prev_excepthook = sys.excepthook
+    prev = sys.excepthook
 
     def _hook(etype, evalue, tb):
+        global _hook_running
+        outermost = not _hook_running
+        _hook_running = True
         try:
-            _default.record("exception", type=getattr(etype, "__name__",
-                                                      str(etype)),
-                            message=str(evalue)[:500])
-            _default.dump(reason="unhandled_exception")
-        except Exception:
-            pass
-        (_prev_excepthook or sys.__excepthook__)(etype, evalue, tb)
+            if outermost:
+                try:
+                    _default.record(
+                        "exception",
+                        type=getattr(etype, "__name__", str(etype)),
+                        message=str(evalue)[:500])
+                    _default.dump(reason="unhandled_exception")
+                except Exception:
+                    pass
+            (prev or sys.__excepthook__)(etype, evalue, tb)
+        finally:
+            if outermost:
+                _hook_running = False
 
+    _prev_excepthook = prev
+    _active_hook = _hook
     sys.excepthook = _hook
 
 
 def uninstall_excepthook() -> None:
-    global _prev_excepthook
-    if _prev_excepthook is not None:
-        sys.excepthook = _prev_excepthook
-        _prev_excepthook = None
+    """Undo :func:`install_excepthook` (test teardown uses this so one
+    test's CheckpointManager cannot leave a chained hook that flips later
+    excepthook tests). If something replaced ``sys.excepthook`` after our
+    install, only the marker state is cleared — clobbering the
+    replacement would be a different bug."""
+    global _prev_excepthook, _active_hook
+    if _active_hook is None:
+        return
+    if sys.excepthook is _active_hook:
+        sys.excepthook = _prev_excepthook or sys.__excepthook__
+    _prev_excepthook = None
+    _active_hook = None
 
 
 # ---------------------------------------------------------------------------
@@ -387,6 +422,30 @@ def render(payload: dict, last: int = 25) -> str:
                        f"{_fmt_bytes(r.get('bytes', 0)):>12}")
         out.append(f"  total: {_fmt_bytes(live.get('total_bytes', 0))} in "
                    f"{live.get('count', 0)} arrays")
+
+    prof = payload.get("profile") or {}
+    if prof.get("programs"):
+        out.append("\n-- measured program shares (continuous profiler) "
+                   + "-" * 10)
+        out.append(f"  {'program':<36} {'ms/step':>9} {'share':>7} "
+                   f"{'calls':>6}")
+        rows = sorted(prof["programs"].items(),
+                      key=lambda kv: -kv[1].get("ms_per_step", 0))
+        for name, st in rows[:10]:
+            out.append(f"  {name:<36} {st.get('ms_per_step', 0):>9.3f} "
+                       f"{st.get('share', 0):>7.2%} "
+                       f"{st.get('calls', 0):>6}")
+        out.append(f"  sampler: every={prof.get('every')} steps, overhead "
+                   f"{prof.get('overhead_pct', 0)}% "
+                   f"(budget {prof.get('budget_pct')}%)")
+    if prof.get("fusion_targets"):
+        out.append("\n-- measured fusion targets (mega-kernel queue) "
+                   + "-" * 13)
+        for i, t in enumerate(prof["fusion_targets"][:5], 1):
+            out.append(
+                f"  {i}. {t.get('name', '?'):<24} x{t.get('sites', 1):<3} "
+                f"{t.get('measured_ms_share', 0):>8.3f} ms/step  "
+                f"{_fmt_bytes(t.get('est_saved_bytes', 0))} saved/site")
 
     peaks = payload.get("module_peaks") or {}
     if peaks:

@@ -31,8 +31,10 @@ from __future__ import annotations
 import os
 import re
 import threading
+import time
 import warnings
 from bisect import bisect_left
+from collections import deque
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
@@ -48,6 +50,17 @@ _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 OVERFLOW_KEY = (("overflow", "true"),)
 
 DEFAULT_MAX_SERIES = 256
+
+#: windowed-rate history: one (monotonic, cumulative) snapshot at most
+#: every RATE_TICK_S per labeled series, RATE_SLOTS deep, so rate()/
+#: delta() can window ~RATE_TICK_S * RATE_SLOTS = 64s of history —
+#: enough for the /healthz 30s steps/s window with slack.
+RATE_TICK_S = 0.25
+RATE_SLOTS = 256
+
+#: injectable clock (tests patch this; monotonic so wall-clock jumps
+#: cannot produce negative windows)
+_monotonic = time.monotonic
 
 
 def _env_max_series() -> int:
@@ -108,13 +121,19 @@ class MetricBase:
 
     kind = "untyped"
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "", windowed: bool = False):
         if not _NAME_RE.match(name):
             raise ValueError(f"invalid metric name {name!r}")
         self.name = name
         self.help = help
+        # rate()/delta() history is OPT-IN: every tick costs a clock read
+        # plus ring upkeep on the mutation path, and most of the registry's
+        # hot counters (collective bytes, retraces, prefetch) are only ever
+        # scraped cumulatively
+        self.windowed = bool(windowed)
         self._lock = threading.Lock()
         self._values: dict = {}
+        self._ticks: dict = {}   # key -> deque[(monotonic, cumulative)]
         self.max_series = _env_max_series()
         self._overflowed = False
 
@@ -138,6 +157,59 @@ class MetricBase:
     def clear(self):
         with self._lock:
             self._values.clear()
+            self._ticks.clear()
+
+    # -- windowed rates (Counter/Histogram opt in via _cum_of) ---------------
+
+    def _note_tick(self, key: tuple, cum: float):
+        """Under ``self._lock``: snapshot the cumulative value for the
+        rate window (``windowed=True`` metrics only). Snapshots within
+        RATE_TICK_S of the last collapse into it (value updated, timestamp
+        kept) so a hot series costs one clock read per mutation, not one
+        ring slot."""
+        if not self.windowed:
+            return
+        dq = self._ticks.get(key)
+        if dq is None:
+            dq = self._ticks[key] = deque(maxlen=RATE_SLOTS)
+        now = _monotonic()
+        if dq and now - dq[-1][0] < RATE_TICK_S:
+            dq[-1] = (dq[-1][0], cum)
+        else:
+            dq.append((now, cum))
+
+    def _window_base(self, key: tuple, window: float):
+        """Under ``self._lock``: (base_time, base_value) — the newest
+        snapshot at least ``window`` old, else the oldest available
+        (partial window). None when no history exists."""
+        dq = self._ticks.get(key)
+        if not dq:
+            return None
+        now = _monotonic()
+        base = dq[0]
+        for t, v in reversed(dq):
+            if now - t >= window:
+                base = (t, v)
+                break
+        return base
+
+    def _windowed(self, window: float, labels: dict):
+        """(delta, elapsed_seconds) of the cumulative value over (up to)
+        the last ``window`` seconds; (0.0, 0.0) without enough history."""
+        key = _label_key(labels)
+        with self._lock:
+            base = self._window_base(key, window)
+            if base is None:
+                return 0.0, 0.0
+            cum = self._cum_of(key)
+        elapsed = _monotonic() - base[0]
+        if elapsed <= 0:
+            return 0.0, 0.0
+        return max(cum - base[1], 0.0), elapsed
+
+    def _cum_of(self, key: tuple) -> float:
+        raise TypeError(
+            f"windowed rate is not defined for {self.kind} metrics")
 
     def _items(self):
         with self._lock:
@@ -171,7 +243,8 @@ class Counter(MetricBase):
         key = _mutation_key(labels)
         with self._lock:
             key = self._slot(key)
-            self._values[key] = self._values.get(key, 0) + value
+            cum = self._values[key] = self._values.get(key, 0) + value
+            self._note_tick(key, cum)
 
     def value(self, /, **labels):
         return self._values.get(_label_key(labels), 0)
@@ -179,6 +252,22 @@ class Counter(MetricBase):
     def total(self):
         with self._lock:
             return sum(self._values.values())
+
+    def _cum_of(self, key):
+        return self._values.get(key, 0)
+
+    def rate(self, window: float = 60.0, /, **labels) -> float:
+        """Counter increase per second over (up to) the last ``window``
+        seconds — /healthz-grade steps/s without scrape-side math. Needs
+        the history to actually span time: 0.0 with fewer than two
+        snapshot ticks (resolution RATE_TICK_S, depth RATE_SLOTS)."""
+        delta, elapsed = self._windowed(window, labels)
+        return delta / elapsed if elapsed > 0 else 0.0
+
+    def delta(self, window: float = 60.0, /, **labels) -> float:
+        """Raw counter increase over (up to) the last ``window`` seconds
+        (the un-divided form of :meth:`rate`)."""
+        return self._windowed(window, labels)[0]
 
 
 class Gauge(MetricBase):
@@ -220,8 +309,9 @@ class Histogram(MetricBase):
 
     kind = "histogram"
 
-    def __init__(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS):
-        super().__init__(name, help)
+    def __init__(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS,
+                 windowed: bool = False):
+        super().__init__(name, help, windowed=windowed)
         bs = tuple(sorted(float(b) for b in buckets))
         if not bs:
             raise ValueError("histogram needs at least one bucket bound")
@@ -240,6 +330,7 @@ class Histogram(MetricBase):
             row[0][bisect_left(self.buckets, value)] += 1
             row[1] += value
             row[2] += 1
+            self._note_tick(key, row[2])
 
     def value(self, /, **labels) -> dict:
         """``{"count", "sum", "buckets"}`` with CUMULATIVE bucket counts
@@ -264,6 +355,23 @@ class Histogram(MetricBase):
             vals[_format_labels(k)] = self.value(**dict(k))
         return {"type": self.kind, "help": self.help,
                 "buckets": [repr(b) for b in self.buckets], "values": vals}
+
+    def _cum_of(self, key):
+        row = self._values.get(key)
+        return row[2] if row is not None else 0
+
+    def rate(self, window: float = 60.0, /, **labels) -> float:
+        """Observations per second over (up to) the last ``window``
+        seconds (the continuous profiler's steps/s reads the step
+        histogram this way). Same snapshot semantics as
+        :meth:`Counter.rate`."""
+        delta, elapsed = self._windowed(window, labels)
+        return delta / elapsed if elapsed > 0 else 0.0
+
+    def delta(self, window: float = 60.0, /, **labels) -> float:
+        """Raw observation-count increase over (up to) the last
+        ``window`` seconds."""
+        return self._windowed(window, labels)[0]
 
 
 _METRIC_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -293,25 +401,30 @@ class Registry:
                         f"histogram {name!r} already registered with "
                         f"buckets {m.buckets}, requested "
                         f"{tuple(sorted(float(b) for b in want))}")
+                if kw.get("windowed"):
+                    m.windowed = True   # a later windowed=True request arms it
                 return m
             kw = {k: v for k, v in kw.items() if v is not None}
             m = self._metrics[name] = cls(name, help, **kw)
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "",
+                windowed: bool = False) -> Counter:
+        return self._get_or_create(Counter, name, help,
+                                   windowed=windowed or None)
 
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get_or_create(Gauge, name, help)
 
-    def histogram(self, name: str, help: str = "",
-                  buckets=None) -> Histogram:
+    def histogram(self, name: str, help: str = "", buckets=None,
+                  windowed: bool = False) -> Histogram:
         """Get-or-create a histogram. buckets=None accepts an existing
         metric's bounds (DEFAULT_BUCKETS when creating); explicit buckets
         must MATCH an already-registered metric's bounds or this raises —
         silently binning into bounds the caller never asked for would
         corrupt the data."""
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+        return self._get_or_create(Histogram, name, help, buckets=buckets,
+                                   windowed=windowed or None)
 
     def get(self, name: str) -> MetricBase | None:
         with self._lock:
@@ -364,16 +477,18 @@ def get_registry() -> Registry:
     return _default_registry
 
 
-def counter(name: str, help: str = "") -> Counter:
-    return _default_registry.counter(name, help)
+def counter(name: str, help: str = "", windowed: bool = False) -> Counter:
+    return _default_registry.counter(name, help, windowed=windowed)
 
 
 def gauge(name: str, help: str = "") -> Gauge:
     return _default_registry.gauge(name, help)
 
 
-def histogram(name: str, help: str = "", buckets=None) -> Histogram:
-    return _default_registry.histogram(name, help, buckets=buckets)
+def histogram(name: str, help: str = "", buckets=None,
+              windowed: bool = False) -> Histogram:
+    return _default_registry.histogram(name, help, buckets=buckets,
+                                       windowed=windowed)
 
 
 def value(name: str, /, **labels):

@@ -59,6 +59,7 @@ def _surface_cached() -> tuple:
     import paddle_tpu.nn.functional as F
     import paddle_tpu.optimizer as optim_mod
     import paddle_tpu.observability as observability
+    import paddle_tpu.observability.continuous as obs_continuous
     import paddle_tpu.observability.flight as obs_flight
     import paddle_tpu.observability.memory as obs_memory
     import paddle_tpu.resilience as resilience
@@ -108,6 +109,12 @@ def _surface_cached() -> tuple:
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     _collect(obs_memory, "paddle.observability.memory", "observability",
              records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    # continuous profiler + telemetry server: the live scrape surface
+    # (serve()'s endpoints, on_step's cadence semantics, fusion_targets'
+    # row schema) is a monitoring contract dashboards depend on
+    _collect(obs_continuous, "paddle.observability.continuous",
+             "observability", records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     return tuple(sorted(records, key=lambda r: r.name))
 

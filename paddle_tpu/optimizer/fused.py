@@ -61,6 +61,7 @@ import jax.numpy as jnp
 from ..core.flags import flag
 from ..core.tensor import Tensor
 from ..observability import counter as _obs_counter, gauge as _obs_gauge
+from ..observability import continuous as _cont
 from ..observability import flight as _flight
 
 __all__ = ["FusedOptimizerStep", "fuse_default", "donation_default"]
@@ -479,12 +480,17 @@ class FusedOptimizerStep:
         _, state_list, donate, scale_fold, compiled = entry
         grad_arrays = args[1]
         from ..profiler.profiler import op_timing_active, record_program
-        if op_timing_active():
+        timed = op_timing_active()
+        sampled = _cont.sampling_active()
+        if timed or sampled:
             t0 = time.perf_counter()
             new_state, found, out_grads = compiled(*args)
             jax.block_until_ready(new_state)
-            record_program(f"fused_opt:{type(opt).__name__}",
-                           time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            if timed:
+                record_program(f"fused_opt:{type(opt).__name__}", dt)
+            if sampled:
+                _cont.record_program(f"fused_opt:{type(opt).__name__}", dt)
         else:
             new_state, found, out_grads = compiled(*args)
         for t, a in zip(state_list, new_state):

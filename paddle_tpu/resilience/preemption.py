@@ -146,6 +146,14 @@ class PreemptionHandler:
             self.manager.save(step, model=model, optimizer=optimizer,
                               scaler=scaler, lr_scheduler=lr_scheduler,
                               extra=extra, blocking=True)
+        try:
+            # the live telemetry server must not outlive the run: close
+            # the socket and join the acceptor thread as part of the drain
+            # (scrapers see connection-refused, not a zombie endpoint)
+            from ..observability.continuous import shutdown_server
+            shutdown_server()
+        except Exception:
+            pass
         _OBS_DRAIN_SECONDS.observe(time.perf_counter() - t0)
         code = self.exit_code
         if code is None:

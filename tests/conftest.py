@@ -30,3 +30,8 @@ def _reseed():
     import paddle_tpu as paddle
     paddle.seed(1234)
     yield
+    # excepthook hygiene: any test that constructed a CheckpointManager
+    # armed the flight dump-on-exception hook; uninstall it so test order
+    # can never flip the excepthook-sensitive flight tests
+    from paddle_tpu.observability import flight
+    flight.uninstall_excepthook()

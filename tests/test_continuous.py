@@ -1,0 +1,475 @@
+"""Continuous profiler: windowed metric rates, capture-window cadence,
+overhead budget + backoff, static->measured reconciliation
+(fusion_targets), and the flight-dump profile block."""
+
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics as m
+from paddle_tpu.observability.continuous import ContinuousProfiler
+
+
+# ---------------------------------------------------------------------------
+# windowed rate/delta helpers (metrics registry)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    """Deterministic monotonic clock for the rate-window tests."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = _Clock()
+    monkeypatch.setattr(m, "_monotonic", c)
+    return c
+
+
+def test_counter_rate_no_samples_is_zero(clock):
+    c = m.Counter("paddle_tpu_test_rate_total", windowed=True)
+    assert c.rate(60.0) == 0.0
+    assert c.delta(60.0) == 0.0
+
+
+def test_counter_rate_single_tick_is_zero(clock):
+    c = m.Counter("paddle_tpu_test_rate1_total", windowed=True)
+    c.inc(5)
+    # one snapshot: no time span to rate over
+    assert c.rate(60.0) == 0.0
+
+
+def test_counter_rate_over_window(clock):
+    c = m.Counter("paddle_tpu_test_rate2_total", windowed=True)
+    c.inc(10)              # tick at t=1000, cum=10
+    clock.t += 10.0
+    c.inc(30)              # tick at t=1010, cum=40
+    clock.t += 0.1
+    # base = newest snapshot >= 5s old -> (1000, 10); elapsed 10.1
+    assert c.delta(5.0) == pytest.approx(30.0)
+    assert c.rate(5.0) == pytest.approx(30.0 / 10.1)
+
+
+def test_counter_rate_partial_window_uses_oldest(clock):
+    c = m.Counter("paddle_tpu_test_rate3_total", windowed=True)
+    c.inc(1)
+    clock.t += 2.0
+    c.inc(1)
+    clock.t += 1.0
+    # window (60s) is larger than the 3s of history: rate over what exists
+    assert c.delta(60.0) == pytest.approx(1.0)
+    assert c.rate(60.0) == pytest.approx(1.0 / 3.0)
+
+
+def test_counter_rate_labeled_series_are_independent(clock):
+    c = m.Counter("paddle_tpu_test_rate4_total", windowed=True)
+    c.inc(1, route="a")
+    clock.t += 1.0
+    c.inc(9, route="a")
+    clock.t += 1.0
+    assert c.delta(60.0, route="a") == pytest.approx(9.0)
+    assert c.delta(60.0, route="b") == 0.0
+
+
+def test_counter_ticks_collapse_within_resolution(clock):
+    c = m.Counter("paddle_tpu_test_rate5_total", windowed=True)
+    c.inc(1)
+    clock.t += m.RATE_TICK_S / 10   # within one tick slot
+    c.inc(1)
+    assert len(c._ticks[()]) == 1   # collapsed, value updated
+    clock.t += m.RATE_TICK_S
+    c.inc(1)
+    assert len(c._ticks[()]) == 2
+
+
+def test_histogram_rate_counts_observations(clock):
+    h = m.Histogram("paddle_tpu_test_rate_seconds", buckets=(0.1, 1.0),
+                    windowed=True)
+    h.observe(0.05)
+    clock.t += 10.0
+    h.observe(0.05)
+    h.observe(5.0)
+    clock.t += 0.1
+    assert h.delta(5.0) == pytest.approx(2.0)
+    assert h.rate(5.0) == pytest.approx(2.0 / 10.1)
+
+
+def test_rate_history_survives_until_clear(clock):
+    c = m.Counter("paddle_tpu_test_rate6_total", windowed=True)
+    c.inc(1)
+    clock.t += 1.0
+    c.inc(1)
+    assert c.delta(60.0) == 1.0
+    c.clear()
+    assert c.delta(60.0) == 0.0 and c.rate(60.0) == 0.0
+
+
+def test_gauge_has_no_rate():
+    g = m.Gauge("paddle_tpu_test_norate")
+    assert not hasattr(g, "rate")
+
+
+def test_disabled_metrics_record_no_ticks(clock):
+    c = m.Counter("paddle_tpu_test_rate7_total", windowed=True)
+    m.enable(False)
+    try:
+        c.inc(5)
+    finally:
+        m.enable(True)
+    assert c.rate(60.0) == 0.0 and c._ticks == {}
+
+
+def test_windowed_is_opt_in(clock):
+    # default counters/histograms must not pay the tick clock read/ring
+    # upkeep on their mutation path — only windowed=True metrics do
+    c = m.Counter("paddle_tpu_test_rate8_total")
+    c.inc(5)
+    clock.t += 1.0
+    c.inc(5)
+    assert c._ticks == {} and c.rate(60.0) == 0.0
+    reg = m.Registry()
+    c2 = reg.counter("paddle_tpu_test_rate9_total")
+    assert not c2.windowed
+    # a later windowed=True get-or-create arms the existing metric
+    assert reg.counter("paddle_tpu_test_rate9_total", windowed=True) is c2
+    assert c2.windowed
+
+
+# ---------------------------------------------------------------------------
+# ContinuousProfiler: cadence, windows, overhead, backoff
+# ---------------------------------------------------------------------------
+
+def _stepped(prof, clock, step_s, n, record=None):
+    """Run n fake steps of wall time step_s, recording `record` =
+    [(name, seconds)] into any open window."""
+    for _ in range(n):
+        clock.t += step_s
+        if prof.active and record:
+            for name, secs in record:
+                prof.record(name, secs)
+        prof.on_step()
+
+
+@pytest.fixture
+def prof_clock(monkeypatch):
+    c = _Clock()
+    return c
+
+
+def _make_prof(clock, every, budget=1.0, registry=None):
+    p = ContinuousProfiler(every=every, budget_pct=budget,
+                           registry=registry or m.Registry())
+    p.memory_probe = False      # no jax walks in unit tests
+    p.auto_reconcile = False
+    p._clock = clock
+    return p
+
+
+def test_cadence_opens_window_after_first_step(prof_clock):
+    p = _make_prof(prof_clock, every=10)
+    prof_clock.t += 0.01
+    p.on_step(0)
+    assert p.active          # window opens at count 1 -> profiles step 2
+    prof_clock.t += 0.01
+    p.on_step(1)
+    assert not p.active and p.windows == 1
+
+
+def test_program_stats_accumulate_ewma(prof_clock):
+    p = _make_prof(prof_clock, every=2)
+    _stepped(p, prof_clock, 0.01, 10,
+             record=[("to_static:f", 0.008), ("fused_opt:AdamW", 0.002)])
+    stats = p.program_stats()
+    assert stats["to_static:f"]["ms_per_step"] == pytest.approx(8.0)
+    assert stats["fused_opt:AdamW"]["ms_per_step"] == pytest.approx(2.0)
+    assert 0 < stats["fused_opt:AdamW"]["share"] < \
+        stats["to_static:f"]["share"]
+
+
+def test_overhead_accounting_pipeline_aware(prof_clock):
+    """A profiled step whose wall equals its measured program time costs
+    ~nothing: the block surfaced device work, it did not add any."""
+    p = _make_prof(prof_clock, every=5)
+    _stepped(p, prof_clock, 0.01, 30, record=[("to_static:f", 0.01)])
+    assert p.overhead_pct < 0.5
+    assert p.every == 5   # no backoff
+
+
+def test_overhead_backoff_doubles_cadence(prof_clock):
+    """Wall time far beyond steady AND beyond measured program time is
+    sampler cost -> the cadence must double until the budget holds."""
+    p = _make_prof(prof_clock, every=2, budget=1.0)
+
+    for i in range(20):
+        # profiled steps take 5x longer than they report doing work
+        dt = 0.05 if p.active else 0.01
+        prof_clock.t += dt
+        if p.active:
+            p.record("to_static:f", 0.01)
+        p.on_step(i)
+    assert p.every > 2
+    assert p.overhead_pct > 0.0
+
+
+def test_on_demand_capture_exempt_from_budget(prof_clock):
+    p = _make_prof(prof_clock, every=1000)
+    _stepped(p, prof_clock, 0.01, 3)   # seed steady EWMA
+    assert not p.active
+    assert p.windows == 1              # the count-1 cadence window
+    p.request_capture(2)
+    prof_clock.t += 0.01
+    p.on_step()
+    assert p.active
+    prof_clock.t += 0.5                # expensive on-demand window
+    p.on_step()
+    # second queued window opens immediately
+    assert p.active
+    prof_clock.t += 0.5
+    p.on_step()
+    assert p.windows == 3
+    assert p.every == 1000             # on-demand cost never backs off
+
+
+def test_stop_discards_open_window(prof_clock):
+    p = _make_prof(prof_clock, every=1)
+    prof_clock.t += 0.01
+    p.on_step()
+    assert p.active
+    p.record("to_static:f", 0.01)
+    p.stop()
+    assert not p.active
+    assert p.program_stats() == {}     # the cut-short window never folded
+
+
+def test_reset_restores_cadence_and_forgets(prof_clock):
+    p = _make_prof(prof_clock, every=2)
+    _stepped(p, prof_clock, 0.01, 6, record=[("to_static:f", 0.01)])
+    assert p.windows > 0
+    p.reset(every=7)
+    assert p.windows == 0 and p.every == 7 and p.program_stats() == {}
+    p.reset()
+    assert p.every == p.base_every
+
+
+def test_disabled_profiler_samples_nothing_but_stays_live(prof_clock):
+    # PADDLE_TPU_PROF=0 kills sampling, NOT liveness: /healthz must still
+    # see steps so stall alerting works with the profiler off
+    p = _make_prof(prof_clock, every=1)
+    p.enabled = False
+    _stepped(p, prof_clock, 0.01, 5)
+    assert p.windows == 0 and not p.active and p.program_stats() == {}
+    assert p.last_step is not None and p.last_step_wall is not None
+
+
+def test_snapshot_is_json_safe(prof_clock):
+    import json
+    p = _make_prof(prof_clock, every=2)
+    _stepped(p, prof_clock, 0.01, 6, record=[("to_static:f", 0.01)])
+    snap = p.snapshot()
+    json.dumps(snap)
+    assert snap["windows"] == p.windows
+    assert "to_static:f" in snap["programs"]
+
+
+def test_program_histogram_observes_ms(prof_clock):
+    reg = m.Registry()
+    p = _make_prof(prof_clock, every=1, registry=reg)
+    prof_clock.t += 0.01
+    p.on_step()
+    p.record("to_static:f", 0.0123)
+    h = reg.get("paddle_tpu_program_step_ms")
+    v = h.value(program="to_static:f")
+    assert v["count"] == 1
+    assert v["sum"] == pytest.approx(12.3)
+
+
+# ---------------------------------------------------------------------------
+# join_measured: the static->measured attribution model
+# ---------------------------------------------------------------------------
+
+class _StubReport:
+    """GraphReport lookalike: 2 deduped candidates over 100 MiB traffic."""
+    total_bytes = 100 * (1 << 20)
+    candidates = [1, 2, 3]   # only len() matters
+
+    def top_candidates(self, n):
+        return [
+            {"name": "attention", "saved_bytes": 10 * (1 << 20),
+             "sites": 4, "n_ops": 40, "span": "model.py:10"},
+            {"name": "gelu", "saved_bytes": 5 * (1 << 20),
+             "sites": 2, "n_ops": 12, "span": "model.py:20"},
+        ][:n]
+
+
+def test_join_measured_attributes_by_traffic_share():
+    from paddle_tpu.analysis.graph import join_measured
+    rows = join_measured(_StubReport(), measured_ms=100.0,
+                         program="to_static:f", hbm_delta_bytes=123)
+    att, gelu = rows
+    # attention: 4 sites x 10 MiB = 40% of 100 MiB traffic -> 40 ms
+    assert att["measured_ms_share"] == pytest.approx(40.0)
+    assert att["est_saved_bytes"] == 10 * (1 << 20)
+    assert att["est_saved_bytes_total"] == 40 * (1 << 20)
+    assert gelu["measured_ms_share"] == pytest.approx(10.0)
+    assert all(r["measured_ms"] == 100.0 for r in rows)
+    assert all(r["measured_hbm_delta_bytes"] == 123 for r in rows)
+    assert all(r["program"] == "to_static:f" for r in rows)
+
+
+def test_join_measured_share_is_ceiling_clamped():
+    from paddle_tpu.analysis.graph import join_measured
+
+    class _Tiny(_StubReport):
+        total_bytes = 1 << 20   # candidates "save" more than total traffic
+
+    rows = join_measured(_Tiny(), measured_ms=50.0)
+    assert rows[0]["measured_ms_share"] == pytest.approx(50.0)  # clamped
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: profiled to_static program -> reconciled fusion targets
+# ---------------------------------------------------------------------------
+
+def test_profiled_to_static_reconciles_fusion_targets(monkeypatch):
+    """The full loop: a real jitted train step profiled on cadence, its
+    jaxpr re-analyzed from cached avals, candidates joined with measured
+    time. The acceptance shape: every target carries BOTH a static
+    est_saved_bytes and a measured measured_ms_share."""
+    import numpy as np
+
+    from paddle_tpu.observability import continuous as cont
+
+    # small model -> lower the GA100 candidate threshold so it has targets
+    monkeypatch.setenv("PADDLE_TPU_GA_CANDIDATE_BYTES", "1024")
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(64, 256), paddle.nn.GELU(),
+        paddle.nn.LayerNorm(256), paddle.nn.Linear(256, 64))
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((32, 64)).astype("float32"))
+    y = paddle.to_tensor(rng.standard_normal((32, 64)).astype("float32"))
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    prof = cont.get_profiler()
+    prof.reset(every=2)
+    prof.auto_reconcile = False
+    try:
+        for i in range(6):
+            step(x, y)
+            cont.on_step(i)
+        cont.stop()
+        stats = prof.program_stats()
+        name = next(k for k in stats if k.startswith("to_static:"))
+        assert stats[name]["calls"] >= 1
+        assert prof.static_fn(name) is not None
+        targets = cont.fusion_targets(top=10)
+        assert targets, "no fusion targets reconciled"
+        for t in targets:
+            assert t["est_saved_bytes"] > 0
+            assert t["measured_ms_share"] >= 0
+            assert t["program"] == name
+        # the table is published for flight dumps
+        assert cont.last_reconciliation() == targets
+        snap = cont.profile_snapshot()
+        assert snap is not None and snap["fusion_targets"] == targets
+    finally:
+        prof.reset()
+
+
+def test_analyze_cached_no_concrete_args_needed():
+    """analyze_cached reports from cached avals alone — after the call
+    args are gone — and caches the report per signature."""
+    import numpy as np
+    paddle.seed(0)
+    lin = paddle.nn.Linear(8, 8)
+
+    @paddle.jit.to_static
+    def f(x):
+        return lin(x).sum()
+
+    x = paddle.to_tensor(np.ones((4, 8), dtype=np.float32))
+    f(x)   # discovery
+    f(x)   # compile + run
+    del x
+    rep = f.analyze_cached()
+    assert rep is not None and rep.n_ops > 0
+    assert f.analyze_cached() is rep   # cached
+
+
+def test_flight_dump_carries_profile_block(tmp_path, prof_clock):
+    """Flight dumps embed the profiler snapshot + last reconciliation —
+    without re-analyzing anything in the dying process."""
+    import json
+
+    from paddle_tpu.observability import continuous as cont
+    from paddle_tpu.observability import flight
+
+    p = cont.get_profiler()
+    p.reset(every=2)
+    p.memory_probe = False
+    p.auto_reconcile = False
+    saved_clock = p._clock
+    p._clock = prof_clock
+    try:
+        _stepped(p, prof_clock, 0.01, 6, record=[("to_static:f", 0.008)])
+        rec = flight.FlightRecorder(capacity=16, enabled=True)
+        path = rec.dump("test_profile_block", step=3,
+                        path=str(tmp_path / "flight_test.json"))
+        payload = json.loads(open(path).read())
+        prof_block = payload.get("profile")
+        assert prof_block is not None
+        assert "to_static:f" in prof_block["programs"]
+        assert prof_block["every"] == p.every
+    finally:
+        p._clock = saved_clock
+        p.reset()
+
+
+def test_module_level_api_routes_to_default():
+    from paddle_tpu.observability import continuous as cont
+    p = cont.get_profiler()
+    p.reset(every=1000)
+    try:
+        assert not cont.sampling_active()
+        cont.on_step(7)
+        assert p.last_step == 7
+        assert cont.sampling_active()   # window opened at count 1
+        cont.record_program("x", 0.001)
+        cont.stop()
+        assert not cont.sampling_active()
+    finally:
+        p.reset()
+
+
+def test_report_cli_from_bench(tmp_path, capsys):
+    import json
+
+    from paddle_tpu.observability.continuous.__main__ import main as cli
+    bench = {"metric": "m", "value": 1.0,
+             "telemetry": {"prof_overhead_pct": 0.42},
+             "extra": {"fusion_targets": [
+                 {"name": "attention", "sites": 4, "n_ops": 10,
+                  "span": "f.py:1", "program": "to_static:step",
+                  "est_saved_bytes": 1 << 20,
+                  "est_saved_bytes_total": 4 << 20,
+                  "measured_ms": 10.0, "measured_ms_share": 4.0}]}}
+    path = tmp_path / "BENCH_r99.json"
+    path.write_text(json.dumps(bench))
+    rc = cli(["report", "--from-bench", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "attention" in out and "0.420%" in out

@@ -162,7 +162,7 @@ def test_bench_attach_telemetry_block():
     sys.path.insert(0, REPO)
     import bench
 
-    obs.counter("paddle_tpu_test_bench_total").inc()
+    obs.counter("paddle_tpu_test_bench_total", "wiring-test marker").inc()
     r = bench._attach_telemetry({"metric": "m", "value": 1.0})
     assert isinstance(r["telemetry"], dict)
     assert "metrics" in r["telemetry"]

@@ -20,7 +20,7 @@ _SAMPLE_RE = re.compile(
     rf"^(?P<name>{_NAME})"
     rf"(?:\{{(?P<labels>{_LABEL}={_LVALUE}(?:,{_LABEL}={_LVALUE})*)?\}})?"
     rf" (?P<value>-?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|\+?Inf|NaN))$")
-_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
+_HELP_RE = re.compile(rf"^# HELP ({_NAME})(?: (.*))?$")
 _TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram|"
                       rf"summary|untyped)$")
 _LABEL_PAIR_RE = re.compile(rf"({_LABEL})=({_LVALUE})")

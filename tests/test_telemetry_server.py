@@ -1,0 +1,199 @@
+"""Live telemetry HTTP server: /metrics grammar over HTTP, /healthz
+liveness (200 -> 503 on stall), /flight JSON, /profile capture trigger,
+clean shutdown, and the preemption-drain shutdown contract."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import continuous as cont
+from paddle_tpu.observability.continuous import TelemetryServer
+
+
+def _get(port, path):
+    """(status, headers, body_bytes) — 4xx/5xx included, not raised."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+@pytest.fixture
+def server():
+    srv = TelemetryServer(port=0, host="127.0.0.1").start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture
+def prof():
+    p = cont.get_profiler()
+    p.reset(every=1000)
+    saved_wall, saved_step = p.last_step_wall, p.last_step
+    yield p
+    p.reset()
+    p.last_step_wall, p.last_step = saved_wall, saved_step
+
+
+def test_metrics_over_http_passes_exposition_grammar(server, prof):
+    # touch the continuous metrics so samples (not just schema) render
+    prof.on_step(1)
+    prof.record("to_static:test", 0.001)
+    prof.stop()
+    status, headers, body = _get(server.port, "/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "version=0.0.4" in headers["Content-Type"]
+    text = body.decode()
+    assert "paddle_tpu_program_step_ms" in text
+    # the SAME parser the exporter tests use, now over the wire
+    from test_prometheus_format import validate_exposition
+    metrics = validate_exposition(text)
+    assert metrics["paddle_tpu_program_step_ms"]["type"] == "histogram"
+
+
+def test_healthz_idle_before_any_step(server, prof):
+    prof.last_step_wall = None
+    status, _, body = _get(server.port, "/healthz")
+    assert status == 200
+    assert json.loads(body)["status"] == "idle"
+
+
+def test_healthz_ok_while_stepping_503_when_stalled(server, prof):
+    prof.on_step(42)
+    prof.stop()
+    status, _, body = _get(server.port, "/healthz")
+    payload = json.loads(body)
+    assert status == 200 and payload["status"] == "ok"
+    assert payload["last_step"] == 42
+    assert "steps_per_s" in payload
+    # stall: age the last step past the threshold
+    server._httpd.stall_after_s = 0.05
+    prof.last_step_wall = time.time() - 1.0
+    status, _, body = _get(server.port, "/healthz")
+    payload = json.loads(body)
+    assert status == 503 and payload["status"] == "stalled"
+    assert payload["last_step_age_s"] >= 1.0
+
+
+def test_flight_endpoint_returns_ring_buffer(server):
+    from paddle_tpu.observability import flight
+    marker = f"srv-test-{time.time()}"
+    if not flight.enabled():
+        pytest.skip("flight disabled in this environment")
+    flight.record("srv_test", marker=marker)
+    status, headers, body = _get(server.port, "/flight")
+    assert status == 200
+    assert headers["Content-Type"] == "application/json"
+    payload = json.loads(body)   # strict JSON parse IS the RFC check
+    assert payload["capacity"] >= 16
+    assert any(e.get("kind") == "srv_test" and e.get("marker") == marker
+               for e in payload["events"])
+
+
+def test_profile_endpoint_queues_capture(server, prof):
+    status, _, body = _get(server.port, "/profile?steps=3")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["requested"] == 3 and payload["pending"] >= 3
+    # the next step opens an on-demand window
+    prof.on_step(1)
+    assert prof.active
+    prof.stop()
+
+
+def test_profile_endpoint_rejects_garbage(server):
+    assert _get(server.port, "/profile?steps=abc")[0] == 400
+    assert _get(server.port, "/profile?steps=0")[0] == 400
+    assert _get(server.port, "/profile?steps=999999")[0] == 400
+    assert _get(server.port, "/nope")[0] == 404
+
+
+def test_profile_pending_total_is_capped(server, prof):
+    # per-request cap alone is not enough: repeated requests must not
+    # stack an unbounded budget-exempt slowdown
+    from paddle_tpu.observability.continuous import MAX_PENDING_CAPTURE
+    for _ in range(3):
+        _get(server.port, f"/profile?steps={MAX_PENDING_CAPTURE}")
+    assert prof._pending == MAX_PENDING_CAPTURE
+    prof._pending = 0
+
+
+def test_close_before_start_does_not_hang():
+    from paddle_tpu.observability.continuous import TelemetryServer
+    srv = TelemetryServer(port=0, host="127.0.0.1")
+    srv.close(timeout=1.0)   # never started: must return, not block
+    assert not srv.running
+
+
+def test_profile_endpoint_409_when_sampler_disabled(server, prof):
+    # a disabled sampler never drains pending windows — queuing must be
+    # refused, not silently accepted
+    prof.enabled = False
+    try:
+        status, _, body = _get(server.port, "/profile?steps=3")
+    finally:
+        prof.enabled = True
+    assert status == 409
+    assert "disabled" in json.loads(body)["error"]
+
+
+def test_close_joins_acceptor_thread(server):
+    port = server.port
+    assert server.running
+    server.close()
+    assert not server.running
+    assert not any(t.name == f"paddle-tpu-telemetry:{port}"
+                   for t in threading.enumerate())
+    with pytest.raises(OSError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                               timeout=2)
+
+
+def test_serve_replaces_and_shutdown_is_idempotent():
+    from paddle_tpu.observability import serve, shutdown_server
+    s1 = serve(0, host="127.0.0.1")
+    p1 = s1.port
+    s2 = serve(0, host="127.0.0.1")   # replaces s1
+    try:
+        assert not s1.running and s2.running and s2.port != p1
+    finally:
+        assert shutdown_server() is True
+    assert shutdown_server() is False  # idempotent
+    assert not s2.running
+
+
+def test_preemption_drain_shuts_server_down(tmp_path, monkeypatch):
+    """The satellite contract: a preempted process leaves no dangling
+    telemetry acceptor thread — the drain closes the module-tracked
+    server before raising TrainingPreempted."""
+    from paddle_tpu.observability import serve
+    from paddle_tpu.resilience import PreemptionHandler, TrainingPreempted
+    # manager=None means the preempt flight dump falls back to cwd —
+    # point it at tmp so suite runs don't litter the repo root
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+    srv = serve(0, host="127.0.0.1")
+    handler = PreemptionHandler(manager=None)
+    handler.request_preemption("manual")
+    with pytest.raises(TrainingPreempted):
+        handler.maybe_exit(5)
+    assert not srv.running
+    assert not any(t.name.startswith("paddle-tpu-telemetry")
+                   for t in threading.enumerate())
+
+
+def test_scrape_error_does_not_kill_server(server, monkeypatch):
+    """A failing exporter must produce a 500, not a dead endpoint."""
+    import paddle_tpu.observability.exporters as exporters
+    monkeypatch.setattr(exporters, "render_prometheus",
+                        lambda *a, **k: 1 / 0)
+    status, _, _ = _get(server.port, "/metrics")
+    assert status == 500
+    monkeypatch.undo()
+    assert _get(server.port, "/healthz")[0] in (200, 503)

@@ -223,7 +223,12 @@ def profile_nan_at_step(steps, ref):
 
 def profile_sigterm_at_step(steps, ref):
     """SIGTERM entering FAULT_STEP; drain + final checkpoint + exit 143;
-    the relaunch must lose 0 steps and match ref."""
+    the relaunch must lose 0 steps and match ref. The drain must also
+    shut the live telemetry server down — a preempted process may not
+    leave a dangling acceptor thread behind."""
+    import threading
+
+    from paddle_tpu.observability import serve
     from paddle_tpu.resilience import (CheckpointManager, PreemptionHandler,
                                       faults)
     with tempfile.TemporaryDirectory() as d:
@@ -231,6 +236,7 @@ def profile_sigterm_at_step(steps, ref):
         model, opt = _fresh()
         mgr = CheckpointManager(d, keep_n=steps)
         handler = PreemptionHandler(mgr).install()
+        server = serve(0)  # ephemeral port; the drain must close it
         try:
             with faults.inject(f"sigterm@{FAULT_STEP}"):
                 _train(model, opt, 0, steps, manager=mgr, handler=handler)
@@ -240,6 +246,11 @@ def profile_sigterm_at_step(steps, ref):
                 return f"exit code {e.code}, wanted relaunchable 143"
         finally:
             handler.uninstall()
+        if server.running or any(
+                t.name.startswith("paddle-tpu-telemetry")
+                for t in threading.enumerate()):
+            return "telemetry server survived the preemption drain " \
+                   "(dangling acceptor thread)"
         err = _validate_flight_dump(
             d, "preempted_sigterm",
             ["preempt", "checkpoint_save", "preempt_exit"])

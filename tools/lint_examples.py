@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """CI gate: trace-safety lint over the repo's runnable training surfaces.
 
-Two stages, both must pass:
+Three stages, all must pass:
 
 1. AST tier — ``python -m paddle_tpu.analysis`` over ``examples/`` and
    ``paddle_tpu/models/`` (override by passing paths); fails on any
@@ -11,6 +11,11 @@ Two stages, both must pass:
    fails on any error-severity GA finding not allowlisted in
    ``tools/ga_allowlist.txt`` (accepted reshards: "<entrypoint> <rule>"
    per line).
+3. Telemetry tier — both train examples must wire the live telemetry
+   stack: a ``--metrics-port`` flag that starts
+   ``paddle_tpu.observability.serve`` and a per-step
+   ``continuous.on_step`` call (ROADMAP item 1: observability from day
+   one on every training surface).
 
 The repo's own examples must stay clean on BOTH tiers, so the analyzers'
 advice and the shipped code never diverge.
@@ -85,6 +90,36 @@ def graph_gate(allowlist=None, out=sys.stderr) -> int:
     return rc
 
 
+#: the training surfaces that must serve live telemetry
+TELEMETRY_EXAMPLES = ("train_gpt_dygraph.py", "distributed_data_parallel.py")
+
+
+def telemetry_gate(out=sys.stderr) -> int:
+    """Both train examples must start the telemetry server behind
+    ``--metrics-port`` and drive the continuous profiler. A source-level
+    check (the examples are also *run* by tests/test_examples.py): the
+    flag, the serve() call and the per-step on_step() must all exist."""
+    import re
+    rc = 0
+    for name in TELEMETRY_EXAMPLES:
+        path = os.path.join(ROOT, "examples", name)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            src = ""
+        missing = [want for want, pat in (
+            ("--metrics-port flag", r"--metrics-port"),
+            ("observability.serve() start", r"\bserve\("),
+            ("continuous.on_step() drive", r"\bon_step\("))
+            if not re.search(pat, src)]
+        status = "ok" if not missing else f"FAILED (missing: " \
+            f"{', '.join(missing)})"
+        print(f"telemetry gate: {name}: {status}", file=out)
+        rc = rc or (1 if missing else 0)
+    return rc
+
+
 def _has_paths(argv) -> bool:
     """True when argv contains a positional path (option VALUES like the
     'json' in '--format json' are not paths)."""
@@ -117,6 +152,10 @@ def main(argv=None) -> int:
         print("graph gate:", "FAILED (error-severity GA findings)"
               if grc else "OK", file=sys.stderr)
         rc = rc or grc
+    trc = telemetry_gate()
+    print("telemetry gate:", "FAILED (examples missing the live "
+          "telemetry wiring)" if trc else "OK", file=sys.stderr)
+    rc = rc or trc
     return rc
 
 

@@ -32,6 +32,12 @@ better, all env-tunable, value <= 0 disables):
 so the BENCH_*.json trajectory guards latency and memory regressions
 instead of just accumulating them. Rounds that predate either field pass
 (nothing to compare).
+
+The continuous profiler rides its own hard gate: a round whose
+``telemetry.prof_overhead_pct`` exceeds 2x ``telemetry.prof_budget_pct``
+fails outright (the sampler's cadence backoff broke its contract), and
+peak-HBM failures print the top-3 MEASURED fusion targets
+(``extra.fusion_targets``) next to the static top-owner hint.
 """
 
 from __future__ import annotations
@@ -133,6 +139,32 @@ def graph_analysis(d):
         return {}
 
 
+def fusion_targets(d):
+    """The bench's MEASURED fusion-target table (extra.fusion_targets,
+    the continuous profiler's reconciliation), [] when absent."""
+    try:
+        ft = d["extra"]["fusion_targets"]
+        return [t for t in ft if isinstance(t, dict)] \
+            if isinstance(ft, list) else []
+    except (KeyError, TypeError):
+        return []
+
+
+def prof_overhead(d):
+    """(overhead_pct, budget_pct) of the continuous sampler from the
+    bench telemetry block, or (None, None) when the round predates it."""
+    tel = d.get("telemetry")
+    if not isinstance(tel, dict):
+        return None, None
+    v = tel.get("prof_overhead_pct")
+    if v is None:
+        return None, None
+    try:
+        return float(v), float(tel.get("prof_budget_pct", 1.0))
+    except (TypeError, ValueError):
+        return None, None
+
+
 def hbm_diagnosis(d) -> str:
     """Human-actionable peak-HBM failure text: the static analyzer's top
     memory-owner estimate next to the measured regression, and the exact
@@ -151,6 +183,14 @@ def hbm_diagnosis(d) -> str:
         span = f" at {o['file']}:{o['line']}" if o.get("file") else ""
         lines.append(f"  top static memory owner: {int(o['bytes']):,} "
                      f"bytes {o.get('prim', '?')}{span}")
+    # measured side: the continuous profiler's reconciled work queue — the
+    # candidates whose fusion actually buys back the regressed bytes/time
+    for t in fusion_targets(d)[:3]:
+        lines.append(
+            f"  measured fusion target: '{t.get('name', '?')}' "
+            f"x{t.get('sites', 1)} — "
+            f"{t.get('measured_ms_share', 0)} ms/step measured, "
+            f"{int(t.get('est_saved_bytes', 0)):,} bytes saved/site")
     lines.append(
         "  diagnose: python -m paddle_tpu.analysis.graph bench:gpt "
         "--select GA108 --top 5")
@@ -288,6 +328,22 @@ def main():
               f"{retraces}x (telemetry trace_cache_retraces): the measured "
               f"number is not steady-state")
         print(retrace_diagnosis(cd))
+    # continuous-sampler overhead gate: the profiler promises to back off
+    # past its budget; 2x budget in a bench round means the control loop
+    # is broken (or the budget knob was ignored) — fail loudly
+    overhead, budget = prof_overhead(cd)
+    # budget may legitimately be 0.0 (strictest contract): never let the
+    # falsy zero short-circuit the gate off
+    prof_fail = overhead is not None and budget is not None \
+        and overhead > 2 * budget
+    if prof_fail:
+        print(f"perf gate [PROF-OVERHEAD] continuous sampler cost "
+              f"{overhead:.3f}% of steady-state step time (budget "
+              f"{budget:g}%, hard ceiling 2x): the cadence backoff "
+              f"failed to hold the PADDLE_TPU_PROF_BUDGET_PCT contract")
+    elif overhead is not None:
+        print(f"perf gate [ok:prof_overhead] continuous sampler "
+              f"{overhead:.3f}% of step time (budget {budget:g}%)")
     bd = {}
     if args.history:
         src, bv = best_of_history(args.history, cm)
@@ -300,15 +356,16 @@ def main():
         bm, bv = metric_value(bd)
     else:
         ap.error("need --baseline or --history")
+    self_fail = retrace_fail or prof_fail
     if bv <= 0:
         print(f"perf gate: baseline has no usable value ({bm}={bv}); "
-              f"{'FAIL (retrace)' if retrace_fail else 'pass'}")
-        return 1 if retrace_fail else 0
+              f"{'FAIL (retrace/prof-overhead)' if self_fail else 'pass'}")
+        return 1 if self_fail else 0
     if bm != cm:
         print(f"perf gate: metric changed {bm} -> {cm}; "
-              f"{'FAIL (retrace)' if retrace_fail else 'pass'} "
+              f"{'FAIL (retrace/prof-overhead)' if self_fail else 'pass'} "
               "(no value comparison)")
-        return 1 if retrace_fail else 0
+        return 1 if self_fail else 0
     floor = bv * (1 - args.tolerance)
     delta = (cv - bv) / bv if bv else 0.0
     status = "OK" if cv >= floor else "REGRESSION"
@@ -320,7 +377,8 @@ def main():
     soft_fails = soft_gates(cd, bd)
     for msg in soft_fails:
         print(msg)
-    return 0 if (cv >= floor and not retrace_fail and not soft_fails) else 1
+    return 0 if (cv >= floor and not retrace_fail and not prof_fail
+                 and not soft_fails) else 1
 
 
 if __name__ == "__main__":
