@@ -515,6 +515,110 @@ def run_gpt_bench(dev, on_tpu):
     }
 
 
+def run_serve_bench(dev=None, users=8, total_requests=16, max_new=16):
+    """Serving-runtime load generator (ROADMAP item 1 acceptance): N
+    concurrent synthetic users drive the continuous-batching engine over
+    the paged KV cache; reports tokens/s, p50/p99 TTFT / per-token /
+    end-to-end latency, mean batch occupancy — and the zero-retrace
+    proof: the decode program's jit telemetry across the measured window
+    (requests joining, leaving, and growing across page boundaries) must
+    show ZERO retraces after warmup (tools/perf_gate.py hard-fails
+    otherwise)."""
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import llama_tiny
+    from paddle_tpu.serving import LLMEngine, ServingConfig
+
+    paddle.seed(0)
+    model = llama_tiny()        # vocab 512, L2 H4/KV2, hidden 64, pos 128
+    cfg = ServingConfig(page_size=16, num_pages=129, max_batch=users,
+                        max_new_tokens=max_new, temperature=0.0, seed=0)
+    engine = LLMEngine(model, cfg)
+    rng = np.random.default_rng(0)
+    # two prompt-length regimes -> two prefill buckets; decode growth
+    # crosses page boundaries (prompt 12 + 16 new > page_size 16)
+    prompt_lens = [12, 28]
+
+    def prompt(i):
+        return list(rng.integers(1, 500,
+                                 size=prompt_lens[i % len(prompt_lens)]))
+
+    # warmup: one request per bucket compiles prefill signatures and the
+    # decode program (discovery + compile); everything after is steady
+    for i in range(len(prompt_lens)):
+        engine.generate(prompt(i), timeout=600)
+        engine.generate(prompt(i), timeout=600)
+    warm = engine.program_stats()
+    occ0 = engine.scheduler.occupancy_sum
+    steps0 = engine.scheduler.decode_steps
+
+    done: list = []
+    errors: list = []
+
+    def user(uid, n):
+        for j in range(n):
+            try:
+                req = engine.submit(prompt(uid * 131 + j))
+                req.result(timeout=600)
+                done.append(req)
+            except Exception as e:  # noqa: BLE001 — survey, don't die
+                errors.append(repr(e)[:200])
+
+    per_user = max(1, total_requests // users)
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=user, args=(u, per_user))
+               for u in range(users)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    after = engine.program_stats()
+    stats = engine.stats()
+    engine.shutdown(drain=True)
+    gen_tokens = sum(len(r.tokens) for r in done)
+    ttft = [r.ttft_ms for r in done if r.ttft_ms is not None]
+    e2e = [r.e2e_ms for r in done if r.e2e_ms is not None]
+    tpot = [g for r in done for g in r.tpot_ms]
+    steps = stats["decode_steps"] - steps0
+
+    def pct(xs):
+        if not xs:
+            return None
+        return {"p50": round(float(np.percentile(xs, 50)), 2),
+                "p99": round(float(np.percentile(xs, 99)), 2),
+                "mean": round(float(np.mean(xs)), 2)}
+
+    return {
+        "users": users,
+        "requests_completed": len(done),
+        "requests_failed": len(errors),
+        "generated_tokens": gen_tokens,
+        "tokens_per_s": round(gen_tokens / wall, 1) if wall > 0 else 0.0,
+        "wall_s": round(wall, 3),
+        "ttft_ms": pct(ttft),
+        "tpot_ms": pct(tpot),
+        "e2e_ms": pct(e2e),
+        "occupancy_mean": round(
+            (stats["occupancy_mean"] * stats["decode_steps"] - occ0)
+            / steps, 4) if steps else 0.0,
+        "evictions": stats["evictions"],
+        "pages_leaked": stats["pages"]["used"],
+        "decode_program": dict(
+            after["decode"],
+            retraces_after_warmup=after["decode"]["retraces"]
+            - warm["decode"]["retraces"]),
+        "prefill_program": dict(
+            after["prefill"],
+            retraces_after_warmup=after["prefill"]["retraces"]
+            - warm["prefill"]["retraces"]),
+        "errors": errors[:5],
+    }
+
+
 def run_flash_ab(dev):
     """A/B the Pallas flash kernels vs the XLA composite: fwd+bwd wall time
     for one attention op at Llama-bench shape (BASELINE.md asks the kernel
@@ -1017,6 +1121,20 @@ def _probe_tpu():
     return None, None
 
 
+def _attach_serve(result):
+    """Ride the serving-runtime section on a bench result (skippable via
+    PADDLE_TPU_BENCH_SERVE=0; failures recorded, never fatal)."""
+    if os.environ.get("PADDLE_TPU_BENCH_SERVE", "1") == "0":
+        return result
+    try:
+        result.setdefault("extra", {})["serve"] = \
+            _with_alarm(420, run_serve_bench)
+    except Exception:
+        result.setdefault("extra", {})["serve_error"] = \
+            traceback.format_exc(limit=2)[:600]
+    return result
+
+
 def _run_child(mode):
     """Run the bench in a subprocess; returns parsed JSON dict or None.
     PADDLE_TPU_BENCH=1 marks the child as a TPU-opted process, exempting
@@ -1091,7 +1209,9 @@ def _child_main(mode):
             if result is None:
                 raise RuntimeError(f"both tpu benches failed: {errs}")
             _write_partial(result)
+            serve_on = os.environ.get("PADDLE_TPU_BENCH_SERVE", "1") != "0"
             for key, fn in (
+                    *((("serve", run_serve_bench),) if serve_on else ()),
                     ("llama8b_layer", run_llama8b_layer_bench),
                     ("flash_ab", run_flash_ab),
                     ("kernel_ab", run_kernel_ab),
@@ -1109,6 +1229,7 @@ def _child_main(mode):
         else:
             dev = _force_cpu()
             result = run_gpt_bench(dev, False)
+            _attach_serve(result)
         _attach_telemetry(result)
         print(json.dumps(result))
         return 0
@@ -1129,7 +1250,31 @@ def _acquire_bench_lock():
     return backend_init_lock()
 
 
+def _serve_main():
+    """`python bench.py serve` — the serving-runtime section alone as one
+    JSON line: tokens/s + p50/p99 TTFT/latency at N concurrent synthetic
+    users (BENCH_SERVE_USERS/REQUESTS/MAX_NEW), plus the decode-program
+    zero-retrace proof tools/perf_gate.py gates on."""
+    try:
+        blk = run_serve_bench(
+            users=int(os.environ.get("BENCH_SERVE_USERS", "8")),
+            total_requests=int(os.environ.get("BENCH_SERVE_REQUESTS", "16")),
+            max_new=int(os.environ.get("BENCH_SERVE_MAX_NEW", "16")))
+        result = {"metric": "serve_tokens_per_s",
+                  "value": blk["tokens_per_s"], "unit": "tokens/s",
+                  "vs_baseline": 0.0, "extra": {"serve": blk}}
+    except Exception:
+        result = {"metric": "serve_tokens_per_s", "value": 0.0,
+                  "unit": "tokens/s", "vs_baseline": 0.0,
+                  "error": traceback.format_exc(limit=8)}
+    _attach_telemetry(result)
+    print(json.dumps(result))
+    return 0 if result.get("value") else 1
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        return _serve_main()
     if len(sys.argv) > 1 and sys.argv[1].startswith("--child"):
         return _child_main(sys.argv[1])
 
@@ -1192,6 +1337,7 @@ def main():
         try:
             dev = _force_cpu()
             result = run_gpt_bench(dev, False)
+            _attach_serve(result)
         except Exception:
             result = {"metric": "gpt2_cpu_smoke_tokens_per_sec", "value": 0.0,
                       "unit": "tokens/s/chip", "vs_baseline": 0.0,

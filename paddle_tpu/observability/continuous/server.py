@@ -34,6 +34,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 __all__ = ["TelemetryServer", "serve", "shutdown_server",
+           "register_route", "unregister_route",
+           "register_health_provider",
            "DEFAULT_PORT", "DEFAULT_STALL_S"]
 
 DEFAULT_PORT = 9406
@@ -58,6 +60,35 @@ def _env_stall() -> float:
                                     DEFAULT_STALL_S))
     except ValueError:
         return DEFAULT_STALL_S
+
+
+# -- extension points (the serving runtime mounts itself here) ---------------
+#
+# Routes: path -> fn(handler, method, query, body_bytes). The fn owns the
+# whole response (handler._send / _send_json / raw writes for streaming).
+# Health: provider(stall_after_s) -> (code, payload) | None; a non-None
+# return REPLACES the training-step liveness payload — this is how
+# /healthz learns serving mode (decode-step staleness) when an engine is
+# attached, without the server knowing what serving is.
+
+_EXTRA_ROUTES: dict = {}
+_HEALTH_PROVIDER = None
+
+
+def register_route(path: str, fn) -> None:
+    """Mount ``fn(handler, method, query, body)`` at ``path`` on every
+    (current and future) telemetry server in this process."""
+    _EXTRA_ROUTES[path] = fn
+
+
+def unregister_route(path: str) -> None:
+    _EXTRA_ROUTES.pop(path, None)
+
+
+def register_health_provider(fn) -> None:
+    """Install (or clear, with None) the /healthz override provider."""
+    global _HEALTH_PROVIDER
+    _HEALTH_PROVIDER = fn
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -86,16 +117,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes --------------------------------------------------------------
 
-    def do_GET(self):  # noqa: N802 (http.server contract)
+    def _dispatch(self, method: str, body: bytes | None):
         try:
             url = urlparse(self.path)
+            extra = _EXTRA_ROUTES.get(url.path)
+            if extra is not None:
+                extra(self, method, parse_qs(url.query), body)
+                return
             route = {"/metrics": self._metrics, "/healthz": self._healthz,
                      "/flight": self._flight,
                      "/profile": self._profile}.get(url.path)
-            if route is None:
-                self._send_json(404, {"error": f"no route {url.path!r}",
-                                      "routes": ["/metrics", "/healthz",
-                                                 "/flight", "/profile"]})
+            if route is None or method != "GET":
+                self._send_json(404 if route is None else 405, {
+                    "error": f"no {method} route {url.path!r}",
+                    "routes": sorted(["/metrics", "/healthz", "/flight",
+                                      "/profile"] + list(_EXTRA_ROUTES))})
                 return
             route(parse_qs(url.query))
         except (BrokenPipeError, ConnectionResetError):
@@ -106,6 +142,19 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:
                 pass
 
+    def do_GET(self):  # noqa: N802 (http.server contract)
+        self._dispatch("GET", None)
+
+    def do_POST(self):  # noqa: N802 (serving's /generate arrives here)
+        try:
+            # clamp below too: a negative Content-Length would turn
+            # read() into read-until-EOF and pin this handler thread
+            n = max(0, int(self.headers.get("Content-Length") or 0))
+        except ValueError:
+            n = 0
+        body = self.rfile.read(min(n, 16 * 1024 * 1024)) if n else b""
+        self._dispatch("POST", body)
+
     def _metrics(self, _q):
         from ..exporters import render_prometheus
         self._send(200, render_prometheus().encode(),
@@ -114,8 +163,14 @@ class _Handler(BaseHTTPRequestHandler):
     def _healthz(self, _q):
         import time
         from . import profiler_if_started
-        p = profiler_if_started()
         stall = self.server.stall_after_s  # type: ignore[attr-defined]
+        if _HEALTH_PROVIDER is not None:
+            override = _HEALTH_PROVIDER(stall)
+            if override is not None:
+                code, payload = override
+                self._send_json(code, payload)
+                return
+        p = profiler_if_started()
         if p is None or p.last_step_wall is None:
             self._send_json(200, {"status": "idle", "last_step": None,
                                   "stall_after_s": stall})

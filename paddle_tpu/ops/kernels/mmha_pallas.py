@@ -31,6 +31,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._common import round_up
 
+
+def _x64_off():
+    """Version-compat: ``jax.enable_x64`` is top-level on newer jax; on
+    0.4.x it only exists as ``jax.experimental.enable_x64`` (same context
+    manager). The serving runtime's paged decode needs this kernel to
+    trace on both."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    from jax.experimental import disable_x64
+    return disable_x64()
+
+
 NEG_INF = -1e30
 
 # cache-scan chunk length; _init_kv_cache rounds cache allocations to this
@@ -45,7 +57,10 @@ _VMEM_BYTES = 8 * 1024 * 1024
 
 def _mmha_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_t, scale):
     # q_ref [1, 1, rep_p, D]; k/v_ref [1, 1, T, D]; o_ref [1, 1, rep_p, D]
-    pos = pos_ref[0]                       # last valid position (inclusive)
+    # pos_ref [B]: last valid position (inclusive) PER SEQUENCE — the
+    # serving runtime's continuous batch decodes rows at different
+    # lengths in one launch; uniform decode passes a broadcast scalar
+    pos = pos_ref[pl.program_id(0)]
     d = q_ref.shape[-1]
     rep_p = q_ref.shape[-2]
     q = q_ref[0, 0].astype(jnp.float32) * jnp.float32(scale)   # [rep_p, D]
@@ -98,7 +113,8 @@ def use_kernel(q_shape, cache_shape, cache_dtype, block_t=BLOCK_T) -> bool:
 @functools.partial(jax.jit, static_argnames=("block_t", "interpret"))
 def mmha_decode(q, k_buf, v_buf, pos, block_t=BLOCK_T, interpret=False):
     """q [B, 1, H, D]; k_buf/v_buf [B, Hkv, T, D] (current token already
-    written at `pos`); pos: traced scalar, last valid cache index.
+    written at `pos`); pos: traced scalar (uniform decode) or [B] vector
+    (per-row lengths — the paged serving batch), last valid cache index.
     Returns [B, 1, H, D]."""
     b, s, h, d = q.shape
     if s != 1:
@@ -127,26 +143,32 @@ def mmha_decode(q, k_buf, v_buf, pos, block_t=BLOCK_T, interpret=False):
         out_specs=pl.BlockSpec((1, 1, rep_p, d),
                                lambda bi, hi, p_: (bi, hi, 0, 0)),
     )
-    with jax.enable_x64(False):
+    with _x64_off():
         out = pl.pallas_call(
             functools.partial(_mmha_kernel, block_t=block_t, scale=scale),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((b, h_kv, rep_p, d), q.dtype),
             interpret=interpret,
-        )(jnp.reshape(pos, (1,)).astype(jnp.int32), qg, k_buf, v_buf)
+        )(jnp.broadcast_to(jnp.reshape(pos, (-1,)).astype(jnp.int32), (b,)),
+          qg, k_buf, v_buf)
     return out[:, :, :rep, :].reshape(b, 1, h, d)
 
 
 def reference_mmha(q, k_buf, v_buf, pos):
     """Composite decode attention (what XLA runs without the kernel):
-    grouped einsum over the [B, Hkv, T, D] cache with a <=pos mask."""
+    grouped einsum over the [B, Hkv, T, D] cache with a <=pos mask.
+    `pos` is a scalar (uniform decode) or [B] vector (the serving
+    runtime's per-row lengths) — ONE composite for both, so the training
+    and serving decode paths can never diverge."""
     b, s, h, d = q.shape
     h_kv, t = k_buf.shape[1], k_buf.shape[2]
     rep = h // h_kv
     qg = q.reshape(b, s, h_kv, rep, d).astype(jnp.float32)
     logits = jnp.einsum("bsgrd,bgtd->bgrst", qg,
                         k_buf.astype(jnp.float32)) / math.sqrt(d)
-    mask = jnp.arange(t)[None, None, None, None, :] <= pos
+    # scalar pos -> [1,1,1,1,1], vector [B] -> [B,1,1,1,1]: same mask rule
+    pos_b = jnp.reshape(jnp.asarray(pos), (-1, 1, 1, 1, 1))
+    mask = jnp.arange(t)[None, None, None, None, :] <= pos_b
     logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bgrst,bgtd->bsgrd", probs, v_buf.astype(jnp.float32))

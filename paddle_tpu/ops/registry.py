@@ -24,7 +24,8 @@ from dataclasses import asdict, dataclass
 class ApiRecord:
     name: str          # dotted public path, e.g. "paddle.matmul"
     kind: str          # "op" | "layer" | "functional" | "jit" |
-                       # "analysis" | "resilience" | "observability"
+                       # "analysis" | "resilience" | "observability" |
+                       # "serving"
     signature: str
 
     def key(self):
@@ -64,6 +65,8 @@ def _surface_cached() -> tuple:
     import paddle_tpu.observability.memory as obs_memory
     import paddle_tpu.resilience as resilience
     import paddle_tpu.resilience.faults as res_faults
+    import paddle_tpu.serving as serving_mod
+    import paddle_tpu.serving.server as serving_server
 
     records: list[ApiRecord] = []
     # names are prefix-qualified per module, so no cross-module collisions
@@ -109,6 +112,13 @@ def _surface_cached() -> tuple:
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     _collect(obs_memory, "paddle.observability.memory", "observability",
              records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    # serving runtime: LLMEngine/ServingConfig/PagePool and the HTTP
+    # mount are production request-path contracts (clients, dashboards
+    # and load balancers depend on them) — held as stable as ops
+    _collect(serving_mod, "paddle.serving", "serving", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    _collect(serving_server, "paddle.serving.server", "serving", records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     # continuous profiler + telemetry server: the live scrape surface
     # (serve()'s endpoints, on_step's cadence semantics, fusion_targets'

@@ -1,0 +1,65 @@
+"""paddle_tpu.serving — production LLM serving runtime.
+
+Continuous batching over a **paged KV cache** (ROADMAP item 1 — the
+"millions of users" half of the north star; reference analog: the
+AnalysisPredictor inference engine + fused_multi_transformer serving
+path, rebuilt TPU-native):
+
+* :mod:`.kv_cache` — fixed-size KV pages in a preallocated pool with
+  per-request page tables: every decode tensor keeps a static shape, so
+  the compiled decode program NEVER retraces as sequences grow or
+  requests join/leave. Paged decode attention feeds the existing mmha
+  Pallas kernel (per-row positions) or the cached-attention composite.
+* :mod:`.scheduler` — iteration-level (continuous) batching: FIFO
+  admission against free pages, page-growth with youngest-first
+  eviction (evictees requeue with their prefix kept), per-request
+  streaming, completion returning pages to the pool.
+* :mod:`.engine` — :class:`LLMEngine`: the threaded
+  ``submit()/stream()/generate()`` front over ONE compiled decode-step
+  program and a bucketed prefill program (both ``to_static``, weights +
+  pool threaded as state); weight-only int8/int4 linears from
+  ``nn/quant`` slot in via ``ServingConfig(quant=...)``. Serving
+  metrics (``paddle_tpu_serving_*``: queue depth, occupancy, TTFT/TPOT
+  histograms, tokens/s) and flight-recorder events are wired in from
+  day one; ``install_preemption()`` drains on SIGTERM like the training
+  runtime.
+* :mod:`.server` — ``POST /generate`` (+ serving-mode ``/healthz``)
+  mounted on the live telemetry server.
+
+Quick use::
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import llama_tiny
+
+    engine = paddle.serving.LLMEngine(
+        llama_tiny(), paddle.serving.ServingConfig(max_batch=8))
+    print(engine.generate([1, 2, 3], max_new_tokens=16))
+    paddle.serving.server.serve(engine, port=9406)   # HTTP /generate
+    engine.shutdown()
+
+Benchmarked by ``bench.py serve`` (tokens/s + p50/p99 TTFT/latency at N
+concurrent users, zero-decode-retrace proof) and chaos-gated by
+``tools/chaos_check.py``'s serving profile. See docs/serving.md.
+"""
+
+from .kv_cache import (  # noqa: F401
+    PagePool, PagePoolError, PagePoolExhausted,
+    paged_attention, reference_paged_attention,
+)
+from .model import ServingModel  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Request, Scheduler, RequestRejected, ServingError,
+)
+from .engine import (  # noqa: F401
+    LLMEngine, ServingConfig, DECODE_PROGRAM, PREFILL_PROGRAM,
+)
+from . import kv_cache, model, scheduler, engine, server  # noqa: F401
+
+__all__ = [
+    "PagePool", "PagePoolError", "PagePoolExhausted",
+    "paged_attention", "reference_paged_attention",
+    "ServingModel", "Request", "Scheduler",
+    "RequestRejected", "ServingError",
+    "LLMEngine", "ServingConfig", "DECODE_PROGRAM", "PREFILL_PROGRAM",
+    "server",
+]

@@ -1,0 +1,472 @@
+"""LLMEngine: the threaded serving front over the paged-KV scheduler.
+
+Owns the device side of the runtime: the ONE compiled decode-step program
+(static ``[max_batch]`` shapes over the paged pool — joins, leaves, and
+growth never retrace it) and the bucketed prefill program (one compiled
+signature per prompt bucket, prompt length traced so every length in a
+bucket shares the program). Both are ``to_static`` functions, so the
+repo's jit telemetry (``paddle_tpu_jit_trace_cache_*`` labeled
+``fn="serving.decode_step"`` / ``"serving.prefill"``) is the retrace
+proof `bench.py serve` asserts — and the page pool + model weights
+thread through them as state.
+
+User surface::
+
+    engine = LLMEngine(model, ServingConfig(max_batch=8))
+    req = engine.submit([1, 2, 3], max_new_tokens=16)    # non-blocking
+    for tok in engine.stream([1, 2, 3]):                  # token stream
+        ...
+    toks = engine.generate([1, 2, 3])                     # blocking
+    engine.shutdown(drain=True)
+
+A background thread runs scheduler iterations whenever work exists.
+``install_preemption()`` arms SIGTERM/SIGINT to drain in-flight requests,
+dump the flight recorder (reason ``serving_preempted``), shut the
+telemetry server down and exit 143 — the serving analog of the training
+preemption handler, gated by the chaos serving profile.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from ..autograd.grad_mode import no_grad
+from ..core.tensor import Tensor
+from ..jit.api import to_static
+from ..observability import flight as _flight
+from .kv_cache import PagePool
+from .model import ServingModel
+from .scheduler import Request, Scheduler, ServingError
+
+__all__ = ["ServingConfig", "LLMEngine", "DECODE_PROGRAM",
+           "PREFILL_PROGRAM"]
+
+#: telemetry labels of the compiled programs (paddle_tpu_jit_* counters)
+DECODE_PROGRAM = "serving.decode_step"
+PREFILL_PROGRAM = "serving.prefill"
+
+
+@dataclass
+class ServingConfig:
+    """Static knobs of the serving runtime. Everything here shapes a
+    compiled program or the pool — per-request variation (prompt length,
+    max_new_tokens, temperature) rides in VALUES, never in shapes."""
+    page_size: int = 16          # token positions per KV page
+    num_pages: int = 64          # pool pages incl. the reserved trash page
+    max_batch: int = 8           # decode slots (the continuous batch)
+    max_seq_len: int | None = None   # default: model max_position_embeddings
+    prefill_buckets: tuple | None = None  # default: powers of two
+    max_new_tokens: int = 32     # per-request default
+    temperature: float = 0.0     # per-request default (0 = greedy)
+    top_k: int | None = None     # static sampling filter (compiled in)
+    eos_token_id: int | None = None
+    quant: str | None = None     # None | weight_only_int8 | weight_only_int4
+    quant_group_size: int = -1
+    dtype: str = "float32"       # KV pool dtype
+    seed: int = 0
+    donate_state: bool = False   # donate pool/weights into the programs
+    flight_every: int = 50       # decode-step flight event cadence
+    drain_timeout_s: float = 30.0
+
+
+def _auto_buckets(max_seq_len: int) -> tuple:
+    out, b = [], 8
+    while b < max_seq_len:
+        out.append(b)
+        b *= 2
+    out.append(max_seq_len)
+    return tuple(sorted(set(out)))
+
+
+class LLMEngine:
+    """Continuous-batching serving engine over a paged KV cache."""
+
+    def __init__(self, model, config: ServingConfig | None = None,
+                 **overrides):
+        cfg = config or ServingConfig()
+        if overrides:
+            cfg = replace(cfg, **overrides)
+        self.config = cfg
+        self._sm = ServingModel(model, quant=cfg.quant,
+                                quant_group_size=cfg.quant_group_size)
+        max_seq = cfg.max_seq_len or self._sm.max_pos
+        if max_seq > self._sm.max_pos:
+            raise ValueError(
+                f"max_seq_len {max_seq} exceeds the model's "
+                f"max_position_embeddings {self._sm.max_pos}")
+        self.max_seq_len = int(max_seq)
+        self.pool = PagePool(
+            num_layers=len(model.layers), num_pages=cfg.num_pages,
+            num_kv_heads=self._sm.n_kv, page_size=cfg.page_size,
+            head_dim=self._sm.head_dim, dtype=cfg.dtype)
+        self._sm.bind_pool(self.pool)
+        self.scheduler = Scheduler(self.pool, self, cfg.max_batch,
+                                   self.max_seq_len,
+                                   eos_token_id=cfg.eos_token_id)
+        self.buckets = tuple(sorted(cfg.prefill_buckets)) \
+            if cfg.prefill_buckets else _auto_buckets(self.max_seq_len)
+        if self.buckets[-1] < self.max_seq_len:
+            raise ValueError(
+                f"largest prefill bucket {self.buckets[-1]} < max_seq_len "
+                f"{self.max_seq_len}: long prompts would have no program")
+        import jax
+        self._key_t = Tensor(np.asarray(
+            jax.random.PRNGKey(cfg.seed), dtype=np.uint32))
+        self._step_seq = 0
+        self._prog_base = self._raw_program_stats()
+        self._build_programs()
+
+        self._cond = threading.Condition()
+        self._thread: threading.Thread | None = None
+        self._stop_mode: str | None = None
+        self._drain_deadline = 0.0
+        self._t_started: float | None = None
+        self._last_step_wall: float | None = None
+        self._old_handlers: dict = {}
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _build_programs(self):
+        sm, eng = self._sm, self
+
+        def serving_decode_step(tokens, positions, tables, temps, key,
+                                step):
+            with no_grad():
+                logits = sm.decode_forward(tokens, positions, tables)
+            nxt = eng._sample(logits._data, temps._data, key._data,
+                              step._data)
+            return Tensor(nxt)
+
+        serving_decode_step.__qualname__ = DECODE_PROGRAM
+        self._decode_sf = to_static(serving_decode_step,
+                                    donate_state=self.config.donate_state)
+
+        def serving_prefill(tokens, prompt_len, table_row, temp, key,
+                            step):
+            with no_grad():
+                logits = sm.prefill_forward(tokens, prompt_len, table_row)
+            nxt = eng._sample(logits._data, temp._data.reshape(1),
+                              key._data, step._data)
+            return Tensor(nxt)
+
+        serving_prefill.__qualname__ = PREFILL_PROGRAM
+        self._prefill_sf = to_static(serving_prefill,
+                                     donate_state=self.config.donate_state)
+
+    def _sample(self, logits, temps, key, step):
+        """On-device next-token selection: greedy where temp == 0, else
+        temperature (+ static top_k) gumbel sampling. logits [N, V],
+        temps [N]; returns int32 [N]."""
+        import jax
+        import jax.numpy as jnp
+
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        arr = logits.astype(jnp.float32) / \
+            jnp.maximum(temps[:, None], 1e-6).astype(jnp.float32)
+        k = self.config.top_k
+        if k is not None and 1 <= k < arr.shape[-1]:
+            kth = jax.lax.top_k(arr, k)[0][:, -1:]
+            arr = jnp.where(arr < kth, -jnp.inf, arr)
+        kk = jax.random.fold_in(key, step.astype(jnp.uint32))
+        g = jax.random.gumbel(kk, arr.shape)
+        sampled = jnp.argmax(arr + g, axis=-1).astype(jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    # -- programs interface the scheduler drives -----------------------------
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ServingError(f"no prefill bucket holds length {n} "
+                           f"(buckets={self.buckets})")
+
+    def prefill(self, req: Request) -> int:
+        import paddle_tpu as paddle
+        ctx = req.context()
+        bucket = self.bucket_for(len(ctx))
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :len(ctx)] = ctx
+        row = np.zeros(self.scheduler.max_pages, np.int32)
+        row[:len(req.pages)] = req.pages
+        step = self._step_seq
+        self._step_seq += 1
+        out = self._prefill_sf(
+            paddle.to_tensor(toks),
+            paddle.to_tensor(np.int32(len(ctx))),
+            paddle.to_tensor(row),
+            paddle.to_tensor(np.float32(max(req.temperature, 0.0))),
+            self._key_t,
+            paddle.to_tensor(np.int32(step)))
+        self._last_step_wall = time.time()
+        return int(np.asarray(out.numpy()).reshape(-1)[0])
+
+    def decode(self, tokens, positions, tables, temps):
+        import paddle_tpu as paddle
+        step = self._step_seq
+        self._step_seq += 1
+        out = self._decode_sf(
+            paddle.to_tensor(tokens), paddle.to_tensor(positions),
+            paddle.to_tensor(tables), paddle.to_tensor(temps),
+            self._key_t, paddle.to_tensor(np.int32(step)))
+        self._last_step_wall = time.time()
+        if _flight.enabled() and self.scheduler.decode_steps % \
+                max(1, self.config.flight_every) == 0:
+            _flight.record("serving_decode",
+                           step=self.scheduler.decode_steps,
+                           active=len(self.scheduler.active_requests()),
+                           free_pages=self.pool.free_pages)
+        return np.asarray(out.numpy())
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "LLMEngine":
+        with self._cond:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop_mode = None
+            self._t_started = time.time()
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle-tpu-serving", daemon=True)
+            self._thread.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _loop(self):
+        sched = self.scheduler
+        while True:
+            with self._cond:
+                while self._stop_mode is None and not sched.has_work():
+                    self._cond.wait(0.05)
+                mode = self._stop_mode
+            if mode == "abort":
+                break
+            if mode == "drain":
+                sched.abort_queued("engine draining (shutdown)")
+                if not sched.active_requests() or \
+                        time.monotonic() > self._drain_deadline:
+                    break
+                try:
+                    sched._decode()
+                except Exception as e:   # noqa: BLE001
+                    self._engine_error(e)
+                    break
+                continue
+            try:
+                sched.step()
+            except Exception as e:       # noqa: BLE001
+                self._engine_error(e)
+                break
+
+    def _engine_error(self, e: Exception):
+        """A device/program failure is engine-fatal: every request is
+        failed loudly rather than left hanging."""
+        msg = f"serving engine error: {type(e).__name__}: {e}"
+        _flight.record("serving_engine_error", error=repr(e)[:300])
+        self.scheduler.abort_active(msg)
+        self.scheduler.abort_queued(msg)
+        with self._cond:
+            self._stop_mode = "abort"
+
+    def shutdown(self, drain: bool = True,
+                 timeout: float | None = None) -> dict:
+        """Stop the engine. ``drain=True`` finishes in-flight requests
+        (bounded by ``timeout``/config drain_timeout_s) and fails queued
+        ones; ``drain=False`` fails everything immediately. Returns a
+        summary dict; always leaves the pool leak-free."""
+        timeout = self.config.drain_timeout_s if timeout is None \
+            else float(timeout)
+        with self._cond:
+            self._drain_deadline = time.monotonic() + timeout
+            self._stop_mode = "drain" if drain else "abort"
+            self._cond.notify_all()
+        if self._thread is not None and self._thread.is_alive() and \
+                threading.current_thread() is not self._thread:
+            self._thread.join(timeout + 5.0)
+        n_queued = self.scheduler.abort_queued("engine shut down")
+        n_active = self.scheduler.abort_active(
+            "engine shut down before completion" if not drain
+            else "drain timeout exceeded")
+        leaked = self.pool.leaked()
+        summary = {"drained": drain, "failed_queued": n_queued,
+                   "failed_active": n_active,
+                   "completed": self.scheduler.completed,
+                   "pages_leaked": leaked}
+        _flight.record("serving_drain", **summary)
+        return summary
+
+    def close(self):
+        self.shutdown(drain=False)
+
+    def __enter__(self) -> "LLMEngine":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc == (None, None, None))
+        return False
+
+    # -- request surface -----------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: int | None = None,
+               temperature: float | None = None, eos_token_id=None,
+               request_id: str | None = None, on_token=None) -> Request:
+        """Enqueue one request (auto-starts the engine thread). Raises
+        :class:`RequestRejected` when the request can never fit."""
+        cfg = self.config
+        req = Request(
+            prompt_ids,
+            cfg.max_new_tokens if max_new_tokens is None else max_new_tokens,
+            cfg.temperature if temperature is None else temperature,
+            eos_token_id=eos_token_id, request_id=request_id,
+            on_token=on_token)
+        self.scheduler.submit(req)
+        self.start()
+        with self._cond:
+            self._cond.notify_all()
+        return req
+
+    def stream(self, prompt_ids, timeout: float = 300.0, **kw):
+        """Generator of generated token ids; raises ServingError on a
+        failed request, TimeoutError when no token arrives within
+        ``timeout`` seconds."""
+        import queue as _queue
+        req = self.submit(prompt_ids, **kw)
+        while True:
+            try:
+                kind, val = req.events.get(timeout=timeout)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"request {req.request_id} produced no token in "
+                    f"{timeout}s (state={req.state})") from None
+            if kind == "token":
+                yield val
+            elif kind == "done":
+                return
+            else:
+                raise ServingError(val)
+
+    def generate(self, prompt_ids, timeout: float = 300.0, **kw):
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt_ids, **kw).result(timeout)
+
+    # -- introspection -------------------------------------------------------
+
+    @staticmethod
+    def _raw_program_stats() -> dict:
+        import paddle_tpu.observability as obs
+
+        def one(label):
+            return {
+                "discoveries": int(obs.value(
+                    "paddle_tpu_jit_trace_cache_misses_total", fn=label)),
+                "compiles": int(obs.value(
+                    "paddle_tpu_jit_compiles_total", fn=label)),
+                "retraces": int(obs.value(
+                    "paddle_tpu_jit_trace_cache_retraces_total", fn=label)),
+            }
+
+        return {"decode": one(DECODE_PROGRAM),
+                "prefill": one(PREFILL_PROGRAM)}
+
+    def program_stats(self) -> dict:
+        """Trace/compile/retrace counts of THIS engine's two compiled
+        programs — the jit telemetry labels are shared process-wide, so
+        counts are deltas since engine construction (the bench's
+        zero-retrace proof reads this)."""
+        raw = self._raw_program_stats()
+        return {prog: {k: v - self._prog_base[prog][k]
+                       for k, v in vals.items()}
+                for prog, vals in raw.items()}
+
+    def stats(self) -> dict:
+        sched = self.scheduler
+        steps = sched.decode_steps
+        return {
+            "queue_depth": sched.queue_depth(),
+            "active_requests": len(sched.active_requests()),
+            "max_batch": sched.max_batch,
+            "decode_steps": steps,
+            "completed": sched.completed,
+            "evictions": sched.evictions,
+            "occupancy_mean": (sched.occupancy_sum / steps) if steps else 0.0,
+            "pages": {"free": self.pool.free_pages,
+                      "used": self.pool.used_pages,
+                      "total": self.pool.allocatable},
+            "programs": self.program_stats(),
+        }
+
+    def health(self, stall_after_s: float = 120.0) -> tuple[int, dict]:
+        """Serving liveness: (http_code, payload). Healthy while idle;
+        stalled (503) when work exists but no prefill/decode step has run
+        within ``stall_after_s``."""
+        import paddle_tpu.observability as obs
+        sched = self.scheduler
+        active = len(sched.active_requests())
+        depth = sched.queue_depth()
+        busy = bool(active or depth)
+        ref = self._last_step_wall or self._t_started
+        age = (time.time() - ref) if ref is not None else None
+        if not busy:
+            status = "idle"
+        elif age is None:
+            status = "stalled" if not self.running else "starting"
+        else:
+            status = "ok" if age <= stall_after_s else "stalled"
+        reg = obs.get_registry()
+        tok = reg.get("paddle_tpu_serving_tokens_total")
+        payload = {
+            "mode": "serving",
+            "status": status,
+            "decode_steps": sched.decode_steps,
+            "last_step_age_s": round(age, 3) if age is not None else None,
+            "stall_after_s": stall_after_s,
+            "active_requests": active,
+            "queue_depth": depth,
+            "tokens_per_s": round(
+                tok.rate(60.0, kind="generated"), 4) if tok else 0.0,
+            "kv_pages_free": self.pool.free_pages,
+            "kv_pages_used": self.pool.used_pages,
+        }
+        return (503 if status == "stalled" else 200), payload
+
+    # -- preemption ----------------------------------------------------------
+
+    def install_preemption(self, exit_code: int = 143,
+                           signals=(signal.SIGTERM,)) -> "LLMEngine":
+        """Arm signal-driven drain: on SIGTERM the engine drains (or
+        cleanly errors) in-flight requests, dumps the flight recorder
+        (reason ``serving_preempted``), shuts the telemetry server down
+        and exits ``exit_code`` — the chaos serving profile's contract."""
+
+        def _handler(signum, frame):
+            _flight.record("serving_preempt", signum=int(signum))
+            try:
+                self.shutdown(drain=True,
+                              timeout=self.config.drain_timeout_s)
+            finally:
+                _flight.dump("serving_preempted",
+                             step=self.scheduler.decode_steps,
+                             extra={"serving": self.stats()})
+                from ..observability.continuous import shutdown_server
+                shutdown_server()
+            raise SystemExit(exit_code)
+
+        for sig in signals:
+            self._old_handlers[sig] = signal.signal(sig, _handler)
+        return self
+
+    def uninstall_preemption(self) -> None:
+        for sig, old in self._old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old_handlers.clear()

@@ -1,0 +1,255 @@
+"""Paged KV cache: fixed-size pages in a preallocated pool.
+
+The decode-side analog of virtual memory (vLLM's PagedAttention, applied
+to the TPU static-shape discipline): instead of one contiguous
+``[B, Hkv, T, D]`` buffer per request — whose batch and length dimensions
+change every time a request joins, leaves, or grows, forcing a retrace —
+the KV cache is ONE preallocated pool of fixed-size pages
+
+    k_pool / v_pool: [num_layers, num_pages, num_kv_heads, page_size, D]
+
+plus a per-request **page table** (``[max_pages]`` int32, physical page id
+per logical page). Every tensor the decode program touches has a static
+shape: requests joining/leaving the batch only change *values* in the
+page-table and position arrays, and sequences growing across a page
+boundary only append a page id — the compiled decode program NEVER
+retraces after warmup (the acceptance contract `bench.py serve` proves).
+
+Page 0 is reserved as the **trash page**: unallocated page-table slots
+point at it, and in-trace writes that must go nowhere (prompt padding,
+inactive batch slots) are steered into it. Attention masks by position,
+so trash contents are never read into a real output.
+
+Device-side helpers (pure jnp, called inside traced programs):
+
+* :func:`write_token` — scatter one new (k, v) per batch row into its
+  page/slot (the decode-step write).
+* :func:`write_prefill` — scatter a whole prompt's (k, v) rows, padding
+  positions steered to the trash page (the prefill write).
+* :func:`gather_layer` — page-table gather producing the contiguous
+  ``[B, Hkv, T, D]`` view the existing mmha/cached-attention math
+  consumes.
+* :func:`paged_attention` — per-row-position decode attention over the
+  gathered view: the fused mmha Pallas kernel when eligible, else the
+  same grouped-einsum composite as ``models/generation.py:
+  cached_attention`` (interpret-parity-tested against it).
+
+Host-side :class:`PagePool` owns the pool tensors and the free-list
+accounting (alloc/free with double-free detection and leak assertion —
+the chaos gate's "leak zero KV pages" check).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..observability import gauge as _obs_gauge, counter as _obs_counter
+
+__all__ = [
+    "PagePool", "PagePoolError", "PagePoolExhausted", "TRASH_PAGE",
+    "write_token", "write_prefill", "gather_layer", "paged_attention",
+]
+
+#: physical page id reserved as the write sink for padding / inactive rows
+TRASH_PAGE = 0
+
+_PAGES = _obs_gauge("paddle_tpu_serving_kv_pages",
+                    "KV-cache pages by state (free/used/total)")
+_ALLOC_FAIL = _obs_counter(
+    "paddle_tpu_serving_page_alloc_failures_total",
+    "page allocations that failed because the pool was exhausted")
+
+
+class PagePoolError(RuntimeError):
+    """Pool accounting violation (double free, freeing an unowned page)."""
+
+
+class PagePoolExhausted(PagePoolError):
+    """No free pages left for an allocation."""
+
+
+class PagePool:
+    """Preallocated paged KV pool + thread-safe free-list accounting.
+
+    ``k``/``v`` are framework Tensors shaped
+    ``[num_layers, num_pages, num_kv_heads, page_size, head_dim]`` —
+    read and written inside the engine's compiled programs, so they
+    thread through ``to_static`` as state. Page ids are handed out from
+    a LIFO free list; page ``0`` (:data:`TRASH_PAGE`) is never handed
+    out.
+    """
+
+    def __init__(self, num_layers: int, num_pages: int, num_kv_heads: int,
+                 page_size: int, head_dim: int, dtype: str = "float32"):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved trash page)")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_layers = int(num_layers)
+        self.num_pages = int(num_pages)
+        self.num_kv_heads = int(num_kv_heads)
+        self.page_size = int(page_size)
+        self.head_dim = int(head_dim)
+        shape = (self.num_layers, self.num_pages, self.num_kv_heads,
+                 self.page_size, self.head_dim)
+        self.k = Tensor(jnp.zeros(shape, jnp.dtype(dtype)))
+        self.v = Tensor(jnp.zeros(shape, jnp.dtype(dtype)))
+        self._lock = threading.Lock()
+        # LIFO: recently-freed (warm) pages are reused first
+        self._free = list(range(self.num_pages - 1, TRASH_PAGE, -1))
+        self._used: set[int] = set()
+        self._export()
+
+    # -- accounting ----------------------------------------------------------
+
+    def _export(self):
+        _PAGES.set(len(self._free), state="free")
+        _PAGES.set(len(self._used), state="used")
+        _PAGES.set(self.allocatable, state="total")
+
+    @property
+    def allocatable(self) -> int:
+        """Total pages that can ever be handed out (pool minus trash)."""
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        with self._lock:
+            return len(self._used)
+
+    def pages_for(self, length: int) -> int:
+        """Pages needed to hold ``length`` token positions."""
+        return max(0, math.ceil(int(length) / self.page_size))
+
+    def alloc(self, n: int = 1) -> list[int]:
+        """Allocate ``n`` pages; raises :class:`PagePoolExhausted` (and
+        allocates nothing) when fewer than ``n`` are free."""
+        with self._lock:
+            if n > len(self._free):
+                _ALLOC_FAIL.inc()
+                raise PagePoolExhausted(
+                    f"need {n} page(s), {len(self._free)} free "
+                    f"(pool {self.allocatable})")
+            pages = [self._free.pop() for _ in range(n)]
+            self._used.update(pages)
+            self._export()
+            return pages
+
+    def free(self, pages) -> None:
+        """Return pages to the pool; double frees and unowned ids raise."""
+        pages = list(pages)
+        with self._lock:
+            bad = [p for p in pages if p not in self._used]
+            if bad:
+                raise PagePoolError(
+                    f"freeing page(s) {bad} not currently allocated "
+                    f"(double free or foreign id)")
+            for p in pages:
+                self._used.discard(p)
+                self._free.append(p)
+            self._export()
+
+    def leaked(self) -> int:
+        """Pages still allocated — 0 after every request completed/failed
+        (asserted by the chaos serving profile and engine shutdown)."""
+        return self.used_pages
+
+    def reset(self) -> None:
+        """Drop all allocations (does not zero page contents — stale data
+        is masked by position everywhere it could be read)."""
+        with self._lock:
+            self._free = list(range(self.num_pages - 1, TRASH_PAGE, -1))
+            self._used.clear()
+            self._export()
+
+
+# -- device-side helpers (pure jnp; run inside traced programs) -------------
+
+def write_token(pool, layer: int, page_ids, slots, vals):
+    """Scatter one new token's k or v rows into the pool.
+
+    pool ``[L, P, Hkv, ps, D]``; ``page_ids``/``slots`` ``[B]`` int32
+    (physical page and in-page slot per batch row — inactive rows point
+    at the trash page); vals ``[B, Hkv, D]``. Returns the updated pool.
+    """
+    return pool.at[layer, page_ids, :, slots, :].set(
+        vals.astype(pool.dtype))
+
+
+def write_prefill(pool, layer: int, table_row, prompt_len, vals,
+                  page_size: int):
+    """Scatter a prompt's k or v rows; positions >= ``prompt_len``
+    (bucket padding) are steered into the trash page.
+
+    pool ``[L, P, Hkv, ps, D]``; ``table_row`` ``[max_pages]`` int32;
+    ``prompt_len`` traced scalar; vals ``[L_bucket, Hkv, D]``.
+    """
+    n = vals.shape[0]
+    t = jnp.arange(n, dtype=jnp.int32)
+    page = jnp.where(t < prompt_len, table_row[t // page_size],
+                     jnp.int32(TRASH_PAGE))
+    return pool.at[layer, page, :, t % page_size, :].set(
+        vals.astype(pool.dtype))
+
+
+def gather_layer(pool, layer: int, tables):
+    """Page-table gather: one layer's pages assembled into the contiguous
+    ``[B, Hkv, max_pages * ps, D]`` view the decode-attention math reads
+    (unallocated table slots gather the trash page; masked by position).
+
+    pool ``[L, P, Hkv, ps, D]``; tables ``[B, max_pages]`` int32.
+    """
+    kp = pool[layer][tables]                  # [B, Pmax, Hkv, ps, D]
+    kp = jnp.moveaxis(kp, 2, 1)               # [B, Hkv, Pmax, ps, D]
+    b, h, pmax, ps, d = kp.shape
+    return kp.reshape(b, h, pmax * ps, d)
+
+
+def reference_paged_attention(q, k_cache, v_cache, pos):
+    """Composite decode attention with PER-ROW positions over the
+    gathered paged view: delegates to
+    ``ops/kernels/mmha_pallas.py:reference_mmha`` (which accepts vector
+    positions), so the serving composite is LITERALLY the decode math
+    the training path's ``cached_attention`` runs — one implementation,
+    no way to diverge.
+
+    q ``[B, 1, H, D]``; k/v_cache ``[B, Hkv, T, D]``; pos ``[B]`` int32,
+    last valid cache index per row. Returns ``[B, 1, H, D]``.
+    """
+    from ..ops.kernels import mmha_pallas
+    return mmha_pallas.reference_mmha(q, k_cache, v_cache,
+                                      jnp.asarray(pos, jnp.int32))
+
+
+def paged_attention(q, k_cache, v_cache, pos, interpret=None):
+    """Decode attention over a gathered paged cache, per-row positions.
+
+    Dispatch mirrors ``cached_attention``: the fused mmha Pallas kernel
+    (ops/kernels/mmha_pallas.py — extended to vector ``pos`` for this
+    runtime) when its gate admits the shape, else
+    :func:`reference_paged_attention`. ``interpret=True`` forces the
+    kernel in interpret mode (the parity tests' path);
+    ``interpret=False`` forces the composite.
+    """
+    from ..ops.kernels import _common as kern
+    from ..ops.kernels import mmha_pallas
+
+    pos = jnp.asarray(pos, jnp.int32)
+    if interpret is True:
+        return mmha_pallas.mmha_decode(q, k_cache, v_cache, pos,
+                                       interpret=True)
+    if interpret is None and mmha_pallas.use_kernel(
+            q.shape, k_cache.shape, k_cache.dtype):
+        return mmha_pallas.mmha_decode(q, k_cache, v_cache, pos,
+                                       interpret=kern.interpret_mode())
+    return reference_paged_attention(q, k_cache, v_cache, pos)

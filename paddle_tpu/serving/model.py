@@ -1,0 +1,280 @@
+"""Serving adapter: Llama-family causal LMs over the paged KV cache.
+
+The training model owns its modules (projections, norms, MLP, head); the
+adapter owns the *serving dataflow*: how prompts prefill pages, how one
+decode token flows through every layer against the paged pool, and how
+weight-only quantized linears (``nn/quant``) substitute for the float
+projections. Everything here runs both eagerly (the ``to_static``
+discovery step) and under trace (the compiled prefill/decode programs) —
+all shapes static, all per-request variation carried in values
+(positions, page tables), never in shapes.
+
+Supported model structure (the Llama family — ``models/llama.py`` and
+anything matching its module layout): ``embed_tokens``, ``layers`` of
+decoder blocks with ``input_layernorm`` / ``self_attn(q_proj, k_proj,
+v_proj, o_proj)`` / ``post_attention_layernorm`` / ``mlp(gate_proj,
+up_proj, down_proj)``, rotate-half RoPE, and a final ``_head`` (or
+``norm`` + ``lm_head``/tied embeddings). A model missing the contract
+raises at adapter construction with the missing pieces named.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from . import kv_cache
+
+__all__ = ["ServingModel"]
+
+_QUANT_ALGOS = {"weight_only_int8": "int8", "weight_only_int4": "int4",
+                "int8": "int8", "int4": "int4"}
+
+#: (tag, module path) per decoder layer — the linears the quant path swaps
+_LAYER_LINEARS = (
+    ("q", ("self_attn", "q_proj")), ("k", ("self_attn", "k_proj")),
+    ("v", ("self_attn", "v_proj")), ("o", ("self_attn", "o_proj")),
+    ("gate", ("mlp", "gate_proj")), ("up", ("mlp", "up_proj")),
+    ("down", ("mlp", "down_proj")),
+)
+
+
+def _get_path(obj, path):
+    for p in path:
+        obj = getattr(obj, p, None)
+        if obj is None:
+            return None
+    return obj
+
+
+class ServingModel:
+    """Prefill/decode forward of a Llama-family LM over a :class:`PagePool`.
+
+    ``quant`` (None | "weight_only_int8" | "weight_only_int4" | "int8" |
+    "int4") pre-quantizes every decoder-layer linear once at construction
+    and dispatches ``nn.quant.weight_only_linear`` in both forwards (the
+    lm head and embeddings stay float for logit fidelity).
+    """
+
+    def __init__(self, model, quant: str | None = None,
+                 quant_group_size: int = -1):
+        self.model = model
+        cfg = getattr(model, "cfg", None)
+        missing = [n for n in ("embed_tokens", "layers") if
+                   getattr(model, n, None) is None]
+        if cfg is None:
+            missing.append("cfg (num_heads/num_kv_heads/head_dim/"
+                           "max_position_embeddings)")
+        if not (callable(getattr(model, "_head", None))
+                or (getattr(model, "norm", None) is not None
+                    and (getattr(model, "lm_head", None) is not None
+                         or getattr(cfg, "tie_word_embeddings", False)))):
+            missing.append("_head (or norm + lm_head/tied embeddings)")
+        layers = list(getattr(model, "layers", []) or [])
+        for i, layer in enumerate(layers):
+            for n in ("input_layernorm", "post_attention_layernorm",
+                      "self_attn", "mlp"):
+                if getattr(layer, n, None) is None:
+                    missing.append(f"layers[{i}].{n}")
+        if missing:
+            raise TypeError(
+                "ServingModel needs a Llama-family module layout; "
+                f"{type(model).__name__} is missing: {', '.join(missing)}")
+        self.cfg = cfg
+        self.n_head = cfg.num_heads
+        self.n_kv = cfg.num_kv_heads
+        self.head_dim = cfg.head_dim
+        self.max_pos = cfg.max_position_embeddings
+        self.pool: kv_cache.PagePool | None = None
+
+        self._quant_dtype = None
+        self._qweights: dict = {}
+        if quant:
+            if quant not in _QUANT_ALGOS:
+                raise ValueError(f"quant must be one of "
+                                 f"{sorted(_QUANT_ALGOS)}, got {quant!r}")
+            algo = quant if quant.startswith("weight_only_") else \
+                "weight_only_" + quant
+            self._quant_dtype = _QUANT_ALGOS[quant]
+            from ..nn.quant import weight_quantize
+            for i, layer in enumerate(layers):
+                for tag, path in _LAYER_LINEARS:
+                    mod = _get_path(layer, path)
+                    if mod is None or getattr(mod, "weight", None) is None:
+                        raise TypeError(
+                            f"quant={quant!r}: layers[{i}]."
+                            f"{'.'.join(path)} has no weight to quantize")
+                    qw, scale = weight_quantize(
+                        mod.weight, algo=algo, group_size=quant_group_size)
+                    self._qweights[(tag, i)] = (qw.detach(), scale.detach())
+
+    # -- wiring --------------------------------------------------------------
+
+    def bind_pool(self, pool: kv_cache.PagePool) -> "ServingModel":
+        if (pool.num_layers, pool.num_kv_heads, pool.head_dim) != \
+                (len(self.model.layers), self.n_kv, self.head_dim):
+            raise ValueError(
+                f"pool shape (layers={pool.num_layers}, "
+                f"kv={pool.num_kv_heads}, d={pool.head_dim}) does not "
+                f"match model (layers={len(self.model.layers)}, "
+                f"kv={self.n_kv}, d={self.head_dim})")
+        self.pool = pool
+        return self
+
+    @property
+    def quantized(self) -> bool:
+        return bool(self._qweights)
+
+    # -- shared pieces -------------------------------------------------------
+
+    def _rope_tables(self):
+        """Full-length (cos, sin) ``[1, T, 1, D]`` tables, memoized on the
+        model when it exposes ``_rope`` (Llama), else built/cached here."""
+        rope = getattr(self.model, "_rope", None)
+        if callable(rope):
+            return rope(self.max_pos)
+        cached = getattr(self, "_rope_cache", None)
+        if cached is None:
+            from ..models.llama import _rope_tables
+            cached = self._rope_cache = _rope_tables(self.cfg, self.max_pos)
+        return cached
+
+    def _linear(self, tag, i, x, module):
+        q = self._qweights.get((tag, i))
+        if q is None:
+            return module(x)
+        from ..nn.quant import weight_only_linear
+        qw, scale = q
+        shp = x.shape
+        y = weight_only_linear(x.reshape([-1, shp[-1]]), qw,
+                               bias=getattr(module, "bias", None),
+                               weight_scale=scale,
+                               weight_dtype=self._quant_dtype)
+        return y.reshape(list(shp[:-1]) + [y.shape[-1]])
+
+    def _mlp(self, i, mlp, y):
+        if not self._qweights:
+            return mlp(y)
+        import paddle_tpu as paddle
+        g = self._linear("gate", i, y, mlp.gate_proj)
+        u = self._linear("up", i, y, mlp.up_proj)
+        return self._linear("down", i, paddle.swiglu(g, u), mlp.down_proj)
+
+    def _head(self, x):
+        m = self.model
+        if callable(getattr(m, "_head", None)):
+            return m._head(x)
+        x = m.norm(x)
+        if getattr(self.cfg, "tie_word_embeddings", False):
+            import paddle_tpu as paddle
+            return paddle.matmul(x, m.embed_tokens.weight, transpose_y=True)
+        return m.lm_head(x)
+
+    def _qkv(self, i, layer, h, b, s):
+        attn = layer.self_attn
+        q = self._linear("q", i, h, attn.q_proj) \
+            .reshape([b, s, self.n_head, self.head_dim])
+        k = self._linear("k", i, h, attn.k_proj) \
+            .reshape([b, s, self.n_kv, self.head_dim])
+        v = self._linear("v", i, h, attn.v_proj) \
+            .reshape([b, s, self.n_kv, self.head_dim])
+        return q, k, v
+
+    def _block_tail(self, i, layer, x, attn_out):
+        """Shared post-attention half: fused residual-add + rmsnorm, MLP
+        (the same primitive chain as ``LlamaDecoderLayer.forward``)."""
+        y, h = F.fused_rms_norm_add(attn_out, x,
+                                    layer.post_attention_layernorm.weight,
+                                    layer.post_attention_layernorm._epsilon)
+        return h + self._mlp(i, layer.mlp, y)
+
+    # -- decode --------------------------------------------------------------
+
+    def decode_forward(self, tokens, positions, tables):
+        """One continuous-batch decode token per row.
+
+        tokens ``[B]`` int32 (last emitted token per slot), positions
+        ``[B]`` int32 (absolute position that token occupies — its KV is
+        written there), tables ``[B, max_pages]`` int32. Inactive slots
+        carry position 0 and an all-trash table. Returns logits Tensor
+        ``[B, vocab]`` for the NEXT position.
+        """
+        pool = self.pool
+        ps = pool.page_size
+        pos = positions._data.astype(jnp.int32)
+        tab = tables._data.astype(jnp.int32)
+        b = int(tokens.shape[0])
+        page_ids = jnp.take_along_axis(tab, (pos // ps)[:, None],
+                                       axis=1)[:, 0]
+        slots = pos % ps
+
+        cos_f, sin_f = self._rope_tables()
+        cos = Tensor(cos_f._data[0, pos][:, None])      # [B, 1, 1, D]
+        sin = Tensor(sin_f._data[0, pos][:, None])
+
+        x = self.model.embed_tokens(Tensor(tokens._data.reshape(b, 1)))
+        for i, layer in enumerate(self.model.layers):
+            h = layer.input_layernorm(x)
+            q, k, v = self._qkv(i, layer, h, b, 1)
+            q, k = F.rope(q, k, sin, cos)
+            kp = kv_cache.write_token(pool.k._data, i, page_ids, slots,
+                                      k._data[:, 0])
+            vp = kv_cache.write_token(pool.v._data, i, page_ids, slots,
+                                      v._data[:, 0])
+            pool.k._data = kp
+            pool.v._data = vp
+            kc = kv_cache.gather_layer(kp, i, tab)
+            vc = kv_cache.gather_layer(vp, i, tab)
+            out = kv_cache.paged_attention(q._data, kc, vc, pos)
+            attn_out = self._linear(
+                "o", i, Tensor(out.reshape(b, 1,
+                                           self.n_head * self.head_dim)),
+                layer.self_attn.o_proj)
+            x = self._block_tail(i, layer, x, attn_out)
+        logits = self._head(x)
+        return Tensor(logits._data[:, 0, :])
+
+    # -- prefill -------------------------------------------------------------
+
+    def prefill_forward(self, tokens, prompt_len, table_row):
+        """Whole-prompt forward for one request, writing its KV pages.
+
+        tokens ``[1, L_bucket]`` int32 (prompt padded to the compile
+        bucket), prompt_len scalar int32 (traced — one compiled program
+        per bucket serves every length), table_row ``[max_pages]`` int32.
+        Padding positions' KV writes land in the trash page; causal
+        attention keeps them out of every real position's output.
+        Returns logits Tensor ``[1, vocab]`` at position ``prompt_len-1``
+        (the first generated token's distribution).
+        """
+        pool = self.pool
+        n = int(tokens.shape[1])
+        plen = prompt_len._data.reshape(()).astype(jnp.int32)
+        tab_row = table_row._data.astype(jnp.int32)
+
+        cos_f, sin_f = self._rope_tables()
+        cos = Tensor(cos_f._data[:, :n])
+        sin = Tensor(sin_f._data[:, :n])
+
+        x = self.model.embed_tokens(tokens)
+        for i, layer in enumerate(self.model.layers):
+            h = layer.input_layernorm(x)
+            q, k, v = self._qkv(i, layer, h, 1, n)
+            q, k = F.rope(q, k, sin, cos)
+            pool.k._data = kv_cache.write_prefill(
+                pool.k._data, i, tab_row, plen, k._data[0],
+                pool.page_size)
+            pool.v._data = kv_cache.write_prefill(
+                pool.v._data, i, tab_row, plen, v._data[0],
+                pool.page_size)
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            attn_out = self._linear(
+                "o", i, out.reshape([1, n, self.n_head * self.head_dim]),
+                layer.self_attn.o_proj)
+            x = self._block_tail(i, layer, x, attn_out)
+        import jax
+        h_last = jax.lax.dynamic_slice_in_dim(
+            x._data, plen - 1, 1, axis=1)               # [1, 1, H]
+        logits = self._head(Tensor(h_last))
+        return Tensor(logits._data[:, 0, :])

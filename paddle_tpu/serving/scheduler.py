@@ -1,0 +1,445 @@
+"""Iteration-level (continuous-batching) scheduler over the paged pool.
+
+Policy layer of the serving runtime — no device code here. Each
+:meth:`Scheduler.step` is one engine iteration:
+
+1. **Admission** (FIFO): while a decode slot AND enough free pages for
+   the request's context (+1 headroom page for its first decode write)
+   exist, pop the oldest waiting request, allocate its prompt pages, run
+   the compiled prefill program (which also samples the request's first
+   token — TTFT is prefill-bounded, not batch-bounded), and seat it in a
+   decode slot. Head-of-line blocking is deliberate: the oldest request
+   is never overtaken, so FIFO admission cannot starve.
+2. **Growth**: every active request whose next write position crosses a
+   page boundary allocates a page. On exhaustion the **youngest** active
+   request is evicted — pages freed, request requeued in arrival order
+   with its generated prefix kept (re-admission re-prefills
+   ``prompt + generated`` and continues) — so the oldest request always
+   makes progress (the no-livelock argument).
+3. **Decode**: ONE batched decode step over all ``max_batch`` slots
+   (inactive slots ride along pointed at the trash page); sampled tokens
+   stream to per-request callbacks; finished requests (eos /
+   ``max_new_tokens`` / context limit) release their pages.
+
+Requests whose *total* page need exceeds the pool (or whose total length
+exceeds the model/config limit) can never run and are rejected at
+``submit`` — the admission-control rejection path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from ..observability import (counter as _obs_counter, gauge as _obs_gauge,
+                             histogram as _obs_histogram)
+from ..observability import flight as _flight
+from .kv_cache import PagePoolExhausted
+
+__all__ = ["Request", "Scheduler", "RequestRejected", "ServingError",
+           "QUEUED", "RUNNING", "COMPLETED", "FAILED", "REJECTED",
+           "CANCELLED"]
+
+QUEUED = "queued"
+RUNNING = "running"
+COMPLETED = "completed"
+FAILED = "failed"
+REJECTED = "rejected"
+CANCELLED = "cancelled"
+
+_TERMINAL = (COMPLETED, FAILED, REJECTED, CANCELLED)
+
+_MS_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+               1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+_REQS = _obs_counter("paddle_tpu_serving_requests_total",
+                     "serving requests by terminal status")
+_SUBMITS = _obs_counter("paddle_tpu_serving_submissions_total",
+                        "requests submitted to the engine")
+_TOKENS = _obs_counter("paddle_tpu_serving_tokens_total",
+                       "tokens processed (kind=prompt|generated)",
+                       windowed=True)
+_STEPS = _obs_counter("paddle_tpu_serving_decode_steps_total",
+                      "batched decode steps executed", windowed=True)
+_PREFILLS = _obs_counter("paddle_tpu_serving_prefills_total",
+                         "prefill program runs by compile bucket")
+_EVICTIONS = _obs_counter("paddle_tpu_serving_evictions_total",
+                          "requests evicted (pages reclaimed, requeued)")
+_QUEUE = _obs_gauge("paddle_tpu_serving_queue_depth",
+                    "requests waiting for admission")
+_ACTIVE = _obs_gauge("paddle_tpu_serving_active_requests",
+                     "requests holding a decode slot")
+_OCC = _obs_gauge("paddle_tpu_serving_batch_occupancy",
+                  "active decode slots / max_batch")
+_TTFT = _obs_histogram("paddle_tpu_serving_ttft_ms",
+                       "submit -> first token (ms)", buckets=_MS_BUCKETS)
+_TPOT = _obs_histogram("paddle_tpu_serving_tpot_ms",
+                       "inter-token latency after the first (ms)",
+                       buckets=_MS_BUCKETS)
+_E2E = _obs_histogram("paddle_tpu_serving_e2e_ms",
+                      "submit -> completion (ms)", buckets=_MS_BUCKETS)
+
+_arrival = itertools.count()
+
+
+class ServingError(RuntimeError):
+    """A request failed inside the engine (carried on Request.error)."""
+
+
+class RequestRejected(ServingError):
+    """Admission control: the request can never fit (prompt + max_new
+    exceeds the pool or the length limit)."""
+
+
+class Request:
+    """One generation request and its runtime state (engine-owned; user
+    code holds it as a handle: ``result()``, ``events``, timing fields)."""
+
+    def __init__(self, prompt, max_new_tokens, temperature=0.0,
+                 eos_token_id=None, request_id=None, on_token=None):
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self.request_id = request_id or uuid.uuid4().hex[:12]
+        self.on_token = on_token
+        self.state = QUEUED
+        self.tokens: list[int] = []
+        self.error: str | None = None
+        self.pages: list[int] = []
+        self.slot: int | None = None
+        self.arrival = next(_arrival)
+        self.evictions = 0
+        self.events: queue.Queue = queue.Queue()
+        self._done = threading.Event()
+        # timing (wall seconds; ms aggregates computed at finish)
+        self.t_submit = time.monotonic()
+        self.t_first_token: float | None = None
+        self.t_done: float | None = None
+        self._t_last = None
+        self.ttft_ms: float | None = None
+        self.e2e_ms: float | None = None
+        self.tpot_ms: list[float] = []
+
+    # -- engine side ---------------------------------------------------------
+
+    def context(self) -> list[int]:
+        """Token ids whose KV must be resident: prompt + generated so far
+        (re-prefilled wholesale after an eviction)."""
+        return self.prompt + self.tokens
+
+    def cur_len(self) -> int:
+        return len(self.prompt) + len(self.tokens)
+
+    def _emit(self, token: int) -> None:
+        now = time.monotonic()
+        self.tokens.append(int(token))
+        if self.t_first_token is None:
+            self.t_first_token = now
+            self.ttft_ms = (now - self.t_submit) * 1000.0
+            _TTFT.observe(self.ttft_ms)
+        else:
+            gap = (now - self._t_last) * 1000.0
+            self.tpot_ms.append(gap)
+            _TPOT.observe(gap)
+        self._t_last = now
+        self.events.put(("token", int(token)))
+        if self.on_token is not None:
+            try:
+                self.on_token(int(token))
+            except Exception:
+                pass  # a user callback must never kill the engine loop
+
+    def _finish(self, state: str, error: str | None = None) -> None:
+        if self.state in _TERMINAL:
+            return
+        self.state = state
+        self.error = error
+        self.t_done = time.monotonic()
+        self.e2e_ms = (self.t_done - self.t_submit) * 1000.0
+        _REQS.inc(status=state)
+        if state == COMPLETED:
+            _E2E.observe(self.e2e_ms)
+        self.events.put(("error", error) if error else ("done", None))
+        self._done.set()
+
+    # -- user side -----------------------------------------------------------
+
+    @property
+    def finished(self) -> bool:
+        return self.state in _TERMINAL
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        """Block until terminal; generated tokens, or raises ServingError."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished in {timeout}s "
+                f"(state={self.state})")
+        if self.error:
+            raise ServingError(self.error)
+        return list(self.tokens)
+
+    def __repr__(self):
+        return (f"Request({self.request_id}, state={self.state}, "
+                f"prompt={len(self.prompt)}, generated={len(self.tokens)})")
+
+
+class Scheduler:
+    """Admission + continuous batching over ``max_batch`` decode slots.
+
+    ``programs`` is the engine's device side:
+    ``programs.prefill(request) -> int`` (runs the bucketed prefill
+    program, returns the first sampled token) and
+    ``programs.decode(tokens, positions, tables, temps) -> np.ndarray``
+    (one batched decode step). The scheduler owns everything else:
+    queues, slots, page tables, eviction, metrics, streaming.
+    """
+
+    def __init__(self, pool, programs, max_batch: int, max_seq_len: int,
+                 eos_token_id=None):
+        self.pool = pool
+        self.programs = programs
+        self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq_len)
+        self.max_pages = pool.pages_for(self.max_seq_len)
+        self.eos_token_id = eos_token_id
+        self.lock = threading.RLock()
+        self.waiting: list[Request] = []      # kept sorted by arrival
+        self.slots: list[Request | None] = [None] * self.max_batch
+        self.tables = np.zeros((self.max_batch, self.max_pages), np.int32)
+        self.decode_steps = 0
+        self.occupancy_sum = 0.0
+        self.completed = 0
+        self.evictions = 0
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_seq_len:
+            req._finish(REJECTED, None)
+            raise RequestRejected(
+                f"prompt ({len(req.prompt)}) + max_new_tokens "
+                f"({req.max_new_tokens}) = {total} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        if self.pool.pages_for(total) > self.pool.allocatable:
+            req._finish(REJECTED, None)
+            raise RequestRejected(
+                f"request needs {self.pool.pages_for(total)} pages at "
+                f"full length; pool holds {self.pool.allocatable}")
+        if req.eos_token_id is None:
+            req.eos_token_id = self.eos_token_id
+        _SUBMITS.inc()
+        _TOKENS.inc(len(req.prompt), kind="prompt")
+        _flight.record("serving_submit", request=req.request_id,
+                       prompt=len(req.prompt), max_new=req.max_new_tokens)
+        with self.lock:
+            self._enqueue(req)
+        return req
+
+    def _enqueue(self, req: Request) -> None:
+        """Insert keeping arrival order (evicted requests keep their
+        original position in line)."""
+        i = len(self.waiting)
+        while i > 0 and self.waiting[i - 1].arrival > req.arrival:
+            i -= 1
+        self.waiting.insert(i, req)
+        req.state = QUEUED
+        _QUEUE.set(len(self.waiting))
+
+    # -- introspection -------------------------------------------------------
+
+    def has_work(self) -> bool:
+        with self.lock:
+            return bool(self.waiting) or any(
+                r is not None for r in self.slots)
+
+    def active_requests(self) -> list[Request]:
+        with self.lock:
+            return [r for r in self.slots if r is not None]
+
+    def queue_depth(self) -> int:
+        with self.lock:
+            return len(self.waiting)
+
+    # -- the iteration -------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler iteration (admit → grow/evict → batched decode).
+        Returns True when any device work ran."""
+        admitted = self._admit()
+        ran_decode = self._decode()
+        return bool(admitted or ran_decode)
+
+    def _free_slot(self):
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def _admit(self) -> int:
+        admitted = 0
+        while True:
+            with self.lock:
+                if not self.waiting:
+                    break
+                slot = self._free_slot()
+                if slot is None:
+                    break
+                req = self.waiting[0]
+                ctx_len = req.cur_len()
+                # +1: headroom so the request's FIRST decode write (the
+                # token prefill just sampled) cannot immediately evict
+                need = self.pool.pages_for(ctx_len + 1)
+                if need > self.pool.free_pages:
+                    break                      # FIFO head-of-line wait
+                self.waiting.pop(0)
+                _QUEUE.set(len(self.waiting))
+                req.pages = self.pool.alloc(self.pool.pages_for(ctx_len))
+                req.slot = slot
+                row = self.tables[slot]
+                row[:] = 0
+                row[:len(req.pages)] = req.pages
+                self.slots[slot] = req
+                req.state = RUNNING
+                _ACTIVE.set(len([r for r in self.slots if r is not None]))
+            try:
+                first = self.programs.prefill(req)
+            except Exception as e:   # noqa: BLE001 — request-scoped failure
+                self._release(req)
+                req._finish(FAILED, f"prefill failed: {e!r}")
+                continue
+            _PREFILLS.inc(bucket=str(self.programs.bucket_for(
+                req.cur_len())))
+            _flight.record("serving_prefill", request=req.request_id,
+                           prompt=req.cur_len(), pages=len(req.pages))
+            req._emit(first)
+            _TOKENS.inc(kind="generated")
+            admitted += 1
+            self._maybe_complete(req)
+        return admitted
+
+    def _release(self, req: Request) -> None:
+        """Take req out of its slot and return its pages."""
+        with self.lock:
+            if req.pages:
+                self.pool.free(req.pages)
+                req.pages = []
+            if req.slot is not None:
+                self.tables[req.slot][:] = 0
+                self.slots[req.slot] = None
+                req.slot = None
+            _ACTIVE.set(len([r for r in self.slots if r is not None]))
+
+    def _maybe_complete(self, req: Request) -> bool:
+        done_eos = (req.eos_token_id is not None and req.tokens
+                    and req.tokens[-1] == req.eos_token_id)
+        done_len = (len(req.tokens) >= req.max_new_tokens
+                    or req.cur_len() >= self.max_seq_len)
+        if done_eos or done_len:
+            self._release(req)
+            req._finish(COMPLETED)
+            self.completed += 1
+            _flight.record("serving_complete", request=req.request_id,
+                           generated=len(req.tokens),
+                           reason="eos" if done_eos else "length")
+            return True
+        return False
+
+    def _evict(self, victim: Request) -> None:
+        self._release(victim)
+        victim.evictions += 1
+        self.evictions += 1
+        _EVICTIONS.inc()
+        _flight.record("serving_evict", request=victim.request_id,
+                       generated=len(victim.tokens))
+        with self.lock:
+            self._enqueue(victim)
+
+    def _ensure_pages(self, req: Request) -> bool:
+        """Grow req's page table to cover its next write position,
+        evicting the youngest active request on exhaustion. False when
+        req is no longer in a slot (evicted here — or already evicted as
+        a VICTIM of an earlier request's growth this same iteration)."""
+        if req.slot is None:
+            return False
+        while len(req.pages) < self.pool.pages_for(req.cur_len()):
+            try:
+                page = self.pool.alloc(1)[0]
+            except PagePoolExhausted:
+                with self.lock:
+                    others = [r for r in self.slots
+                              if r is not None and r is not req]
+                victim = max(others, key=lambda r: r.arrival, default=None)
+                if victim is None or victim.arrival < req.arrival:
+                    # req is the youngest (or alone): it yields
+                    self._evict(req)
+                    return False
+                self._evict(victim)
+                continue
+            with self.lock:
+                req.pages.append(page)
+                self.tables[req.slot][len(req.pages) - 1] = page
+        return True
+
+    def _decode(self) -> bool:
+        with self.lock:
+            active = [r for r in self.slots if r is not None]
+        if not active:
+            return False
+        for req in list(active):
+            self._ensure_pages(req)
+        with self.lock:
+            active = [r for r in self.slots if r is not None]
+            if not active:
+                return False
+            b = self.max_batch
+            tokens = np.zeros(b, np.int32)
+            positions = np.zeros(b, np.int32)
+            temps = np.zeros(b, np.float32)
+            for req in active:
+                tokens[req.slot] = req.tokens[-1]
+                positions[req.slot] = req.cur_len() - 1
+                temps[req.slot] = max(req.temperature, 0.0)
+            tables = self.tables.copy()
+            for i, r in enumerate(self.slots):
+                if r is None:
+                    tables[i][:] = 0
+        out = self.programs.decode(tokens, positions, tables, temps)
+        self.decode_steps += 1
+        occ = len(active) / float(self.max_batch)
+        self.occupancy_sum += occ
+        _STEPS.inc()
+        _OCC.set(occ)
+        for req in active:
+            req._emit(int(out[req.slot]))
+            _TOKENS.inc(kind="generated")
+            self._maybe_complete(req)
+        return True
+
+    # -- shutdown ------------------------------------------------------------
+
+    def abort_queued(self, error: str) -> int:
+        with self.lock:
+            doomed, self.waiting = self.waiting, []
+            _QUEUE.set(0)
+        for req in doomed:
+            req._finish(FAILED, error)
+        return len(doomed)
+
+    def abort_active(self, error: str) -> int:
+        n = 0
+        for req in self.active_requests():
+            self._release(req)
+            req._finish(FAILED, error)
+            n += 1
+        return n

@@ -21,6 +21,11 @@ Profiles (each compared against the same fault-free reference trajectory):
                   resume having lost 0 steps and finish identical. Flight
                   dump: reason preempted_sigterm, final events preempt ...
                   preempt_exit
+  serving-sigterm SIGTERM mid-stream into the serving engine (the serving
+                  profile): in-flight requests drain or cleanly error,
+                  exit 143, ZERO KV pages leaked (pool accounting
+                  asserted). Flight dump: reason serving_preempted, final
+                  events serving_preempt ... serving_drain
 
 Exit status: 0 when every profile holds, 1 otherwise. Fast (CPU, a
 4-parameter model, eager steps) — wired into tier-1 via
@@ -268,9 +273,64 @@ def profile_sigterm_at_step(steps, ref):
     return None
 
 
+def profile_serving_sigterm(steps, ref):
+    """SIGTERM mid-stream into the serving engine: in-flight requests must
+    drain (or cleanly error), the process must leave a schema-valid flight
+    dump with the serving events, exit relaunchable 143 — and leak ZERO
+    KV pages (pool accounting asserted). ``ref`` (the training
+    trajectory) is unused: serving has no weights to resume."""
+    import signal
+    import time
+
+    from paddle_tpu.models.llama import llama_tiny
+    from paddle_tpu.observability import flight
+    from paddle_tpu.serving import LLMEngine, ServingConfig
+    with tempfile.TemporaryDirectory() as d:
+        _arm_flight()
+        flight.set_dump_dir(d)
+        model = llama_tiny(vocab_size=64, max_position_embeddings=64,
+                           hidden_size=32, num_layers=1, num_heads=2,
+                           num_kv_heads=1, intermediate_size=64)
+        eng = LLMEngine(model, ServingConfig(
+            page_size=8, num_pages=17, max_batch=2, max_new_tokens=24,
+            drain_timeout_s=60.0))
+        eng.install_preemption()
+        try:
+            reqs = [eng.submit([1, 2, 3]), eng.submit([4, 5])]
+            deadline = time.monotonic() + 60
+            while any(len(r.tokens) < 2 for r in reqs):  # mid-stream
+                if time.monotonic() > deadline:
+                    return "requests never started streaming"
+                time.sleep(0.005)
+            try:
+                os.kill(os.getpid(), signal.SIGTERM)
+                while time.monotonic() < deadline:
+                    time.sleep(0.005)
+                return "SIGTERM never surfaced"
+            except SystemExit as e:
+                if e.code != 143:
+                    return f"exit code {e.code}, wanted relaunchable 143"
+        finally:
+            eng.uninstall_preemption()
+        bad = [r for r in reqs
+               if r.state not in ("completed", "failed")
+               or (r.state == "failed" and not r.error)]
+        if bad:
+            return f"in-flight request neither drained nor cleanly " \
+                   f"errored: {bad}"
+        if eng.pool.leaked():
+            return f"{eng.pool.leaked()} KV page(s) leaked after drain"
+        err = _validate_flight_dump(
+            d, "serving_preempted", ["serving_preempt", "serving_drain"])
+        if err:
+            return err
+    return None
+
+
 PROFILES = (("kill-mid-save", profile_kill_mid_save),
             ("nan-at-step-k", profile_nan_at_step),
-            ("sigterm-at-k", profile_sigterm_at_step))
+            ("sigterm-at-k", profile_sigterm_at_step),
+            ("serving-sigterm", profile_serving_sigterm))
 
 
 def main(argv=None):

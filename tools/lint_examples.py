@@ -16,6 +16,10 @@ Three stages, all must pass:
    ``paddle_tpu.observability.serve`` and a per-step
    ``continuous.on_step`` call (ROADMAP item 1: observability from day
    one on every training surface).
+4. Serving tier — the serving example must drive the continuous-batching
+   engine end to end: construct an ``LLMEngine``, ``submit``/``stream``
+   concurrent requests, and report TTFT + occupancy (ROADMAP item 1:
+   the serving runtime has a runnable, linted reference surface).
 
 The repo's own examples must stay clean on BOTH tiers, so the analyzers'
 advice and the shipped code never diverge.
@@ -120,6 +124,37 @@ def telemetry_gate(out=sys.stderr) -> int:
     return rc
 
 
+#: the serving surface that must drive the continuous-batching engine
+SERVING_EXAMPLES = ("quantize_and_serve.py",)
+
+
+def serving_gate(out=sys.stderr) -> int:
+    """The serving example must exercise the engine: LLMEngine
+    construction, request submission, streaming, and the TTFT/occupancy
+    report (source-level; tests/test_examples.py also *runs* it)."""
+    import re
+    rc = 0
+    for name in SERVING_EXAMPLES:
+        path = os.path.join(ROOT, "examples", name)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            src = ""
+        missing = [want for want, pat in (
+            ("LLMEngine construction", r"\bLLMEngine\("),
+            ("request submission", r"\.submit\("),
+            ("token streaming", r"\.stream\("),
+            ("TTFT report", r"ttft"),
+            ("occupancy report", r"occupancy"))
+            if not re.search(pat, src)]
+        status = "ok" if not missing else f"FAILED (missing: " \
+            f"{', '.join(missing)})"
+        print(f"serving gate: {name}: {status}", file=out)
+        rc = rc or (1 if missing else 0)
+    return rc
+
+
 def _has_paths(argv) -> bool:
     """True when argv contains a positional path (option VALUES like the
     'json' in '--format json' are not paths)."""
@@ -156,6 +191,10 @@ def main(argv=None) -> int:
     print("telemetry gate:", "FAILED (examples missing the live "
           "telemetry wiring)" if trc else "OK", file=sys.stderr)
     rc = rc or trc
+    src_rc = serving_gate()
+    print("serving gate:", "FAILED (serving example does not drive the "
+          "engine)" if src_rc else "OK", file=sys.stderr)
+    rc = rc or src_rc
     return rc
 
 

@@ -38,6 +38,11 @@ The continuous profiler rides its own hard gate: a round whose
 fails outright (the sampler's cadence backoff broke its contract), and
 peak-HBM failures print the top-3 MEASURED fusion targets
 (``extra.fusion_targets``) next to the static top-owner hint.
+
+The serving runtime (``extra.serve``, from `bench.py serve` or the full
+run) adds two HARD gates — any decode-program retrace after warmup and
+any leaked KV page fail the round — plus a soft serve-tokens/s
+comparison (PERF_GATE_SERVE_TOL_PCT, default 30%).
 """
 
 from __future__ import annotations
@@ -285,6 +290,55 @@ def soft_gates(cd, bd):
     return fails
 
 
+def serve_block(d):
+    """``extra.serve`` — the serving-runtime bench section (None when the
+    round predates the serving engine or skipped it)."""
+    blk = (d.get("extra") or {}).get("serve")
+    return blk if isinstance(blk, dict) else None
+
+
+def serve_gates(cd, bd):
+    """Serving-runtime gates. HARD: any decode-program retrace after
+    warmup (the paged-KV static-shape contract — requests joining/
+    leaving/growing must never recompile the decode step) or leaked KV
+    pages. SOFT: serve tokens/s vs the baseline round's serve section
+    (PERF_GATE_SERVE_TOL_PCT, default 30 — CPU-smoke serving numbers are
+    thread-scheduling noisy; <= 0 disables). Returns (hard, soft) failure
+    message lists."""
+    cur = serve_block(cd)
+    if cur is None:
+        return [], []
+    hard, soft = [], []
+    dec = cur.get("decode_program") or {}
+    retr = dec.get("retraces_after_warmup")
+    if retr:
+        hard.append(
+            f"perf gate [SERVE-RETRACE] decode program retraced "
+            f"{int(retr)}x after warmup while requests joined/left/grew: "
+            f"the paged-KV static-shape contract is broken (compiles="
+            f"{dec.get('compiles')}, see paddle_tpu/serving/kv_cache.py)")
+    leaked = cur.get("pages_leaked")
+    if leaked:
+        hard.append(f"perf gate [SERVE-LEAK] {int(leaked)} KV page(s) "
+                    f"still allocated after the serve bench drained")
+    tol = _tol_pct("PERF_GATE_SERVE_TOL_PCT", 30.0)
+    base = serve_block(bd) if bd else None
+    if tol > 0 and base and base.get("tokens_per_s"):
+        bv, cv = float(base["tokens_per_s"]), float(cur.get("tokens_per_s")
+                                                   or 0.0)
+        floor = bv * (1 - tol / 100.0)
+        delta = (cv - bv) / bv
+        if cv < floor:
+            soft.append(
+                f"perf gate [REGRESSION:serve] {cv:.1f} tokens/s vs "
+                f"baseline {bv:.1f} (delta {delta:+.2%}, floor "
+                f"{floor:.1f}, tol {tol:.0f}% via PERF_GATE_SERVE_TOL_PCT)")
+        else:
+            print(f"perf gate [ok:serve] {cv:.1f} tokens/s vs baseline "
+                  f"{bv:.1f} (delta {delta:+.2%}, tol {tol:.0f}%)")
+    return hard, soft
+
+
 def best_of_history(pattern, metric, last_n=3):
     """Best value among the last `last_n` round files matching `pattern`
     whose metric equals `metric` (reference analog: the op-benchmark CI
@@ -375,10 +429,14 @@ def main():
     # soft gates over the same baseline round: step latency + peak HBM
     # (only meaningful when the metric matched — same workload shape)
     soft_fails = soft_gates(cd, bd)
-    for msg in soft_fails:
+    # serving runtime: hard zero-retrace/zero-leak contract + soft
+    # tokens/s comparison against the same baseline round
+    serve_hard, serve_soft = serve_gates(cd, bd)
+    soft_fails += serve_soft
+    for msg in soft_fails + serve_hard:
         print(msg)
     return 0 if (cv >= floor and not retrace_fail and not prof_fail
-                 and not soft_fails) else 1
+                 and not soft_fails and not serve_hard) else 1
 
 
 if __name__ == "__main__":
