@@ -247,7 +247,10 @@ def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
     # profiler only holds the program weakly; the table lands in
     # continuous.last_reconciliation() for _fusion_targets_block
     try:
-        cont.fusion_targets(top=5)
+        # with_unfused: the round's JSON shows the harvested delta — the
+        # as-fused table (block mega-kernel candidates marked `fused`)
+        # next to the composite 'before' view
+        cont.fusion_targets(top=5, with_unfused=True)
     except Exception:
         print("bench: fusion_targets reconciliation failed:\n"
               + traceback.format_exc(limit=2), file=sys.stderr)
@@ -295,6 +298,17 @@ def _fusion_targets_block():
     try:
         from paddle_tpu.observability import continuous as cont
         return cont.last_reconciliation() or []
+    except Exception:
+        return []
+
+
+def _fusion_targets_unfused_block():
+    """The composite 'before' view of the same reconciliation (candidates
+    as the pure-XLA program advertises them) — embedded next to
+    extra.fusion_targets so the harvested delta is visible per round."""
+    try:
+        from paddle_tpu.observability import continuous as cont
+        return cont.last_unfused_reconciliation() or []
     except Exception:
         return []
 
@@ -395,6 +409,7 @@ def run_llama_bench(dev):
         raise RuntimeError(
             f"llama bench OOMed at every batch size: {last_msg}")
     fusion_targets = _fusion_targets_block()
+    fusion_targets_unfused = _fusion_targets_unfused_block()
     n_params = model.num_params()
     flops_per_token = model.flops_per_token(seq) * 3
     peak, peak_src = _peak_flops(dev)
@@ -412,6 +427,7 @@ def run_llama_bench(dev):
             "dtype": "bf16", "step_breakdown": breakdown,
             "peak_flops": peak, "peak_flops_source": peak_src,
             "fusion_targets": fusion_targets,
+            "fusion_targets_unfused": fusion_targets_unfused,
         },
     }
 
@@ -491,6 +507,7 @@ def run_gpt_bench(dev, on_tpu):
     tokens_per_s, final, breakdown = _train_throughput(
         model, batch, seq, steps, warmup, cfg.vocab_size, on_tpu)
     fusion_targets = _fusion_targets_block()
+    fusion_targets_unfused = _fusion_targets_unfused_block()
 
     peak, peak_src = _peak_flops(dev)
     from paddle_tpu.observability import analytic_mfu
@@ -511,6 +528,7 @@ def run_gpt_bench(dev, on_tpu):
             "graph_analysis": _graph_analysis_block(
                 model, batch, seq, cfg.vocab_size),
             "fusion_targets": fusion_targets,
+            "fusion_targets_unfused": fusion_targets_unfused,
         },
     }
 
@@ -922,6 +940,53 @@ def run_kernel_ab(dev):
     res["a8w8_prefill_pallas_ms"] = round(pal, 3)
     res["bf16_prefill_xla_ms"] = round(xla, 3)
     res["a8w8_prefill_speedup"] = round(xla / pal, 3)
+
+    # transformer-block mega-kernel epilogues (block_fused_pallas) vs the
+    # per-op composite chains they replace, fwd+bwd at GPT-3-ish dims:
+    # the three fused blocks of the fusion_targets harvest
+    from paddle_tpu.ops.kernels import block_fused_pallas as bfk
+    rows_e, hid_e = 8192, 4096
+    xe = jnp.asarray(rng.standard_normal((rows_e, hid_e)), jnp.bfloat16)
+    re_ = jnp.asarray(rng.standard_normal((rows_e, hid_e)), jnp.bfloat16)
+    we = jnp.asarray(rng.standard_normal(hid_e), jnp.float32)
+    bee = jnp.asarray(rng.standard_normal(hid_e), jnp.float32)
+    sde = jnp.int32(23)
+
+    def _epi_loss(fused, act, norm, p_drop, bias):
+        def f(x_, r_, w_):
+            if fused:
+                y, hh = bfk.fused_epilogue(x_, r_, w_, bias, sde, p_drop,
+                                           1e-5, act, norm, None, False)
+            else:
+                y, hh = bfk.reference_fused_epilogue(x_, r_, w_, bias, sde,
+                                                     p_drop, 1e-5, act, norm)
+            return jnp.sum(y.astype(jnp.float32)) + \
+                jnp.sum(hh.astype(jnp.float32))
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    # (1) attention epilogue: dropout-add + rmsnorm in one pass
+    pal = timed(_epi_loss(True, None, "rms", 0.1, None), xe, re_, we)
+    xla = timed(_epi_loss(False, None, "rms", 0.1, None), xe, re_, we)
+    res["attn_epilogue_pallas_ms"] = round(pal, 3)
+    res["attn_epilogue_xla_ms"] = round(xla, 3)
+    res["attn_epilogue_speedup"] = round(xla / pal, 3)
+
+    # (2) MLP epilogue: gelu + dropout-add + layernorm in one pass
+    pal = timed(_epi_loss(True, "gelu", "layer", 0.1, bee), xe, re_, we)
+    xla = timed(_epi_loss(False, "gelu", "layer", 0.1, bee), xe, re_, we)
+    res["mlp_epilogue_pallas_ms"] = round(pal, 3)
+    res["mlp_epilogue_xla_ms"] = round(xla, 3)
+    res["mlp_epilogue_speedup"] = round(xla / pal, 3)
+
+    # (3) serving decode epilogue at continuous-batch shape [B, 1, H]
+    xd = jnp.asarray(rng.standard_normal((64, 1, hid_e)), jnp.bfloat16)
+    rd = jnp.asarray(rng.standard_normal((64, 1, hid_e)), jnp.bfloat16)
+    pal = timed(lambda a: bfk.decode_epilogue(a, rd, we, 1e-5, False)[0], xd)
+    xla = timed(lambda a: bfk.reference_fused_epilogue(
+        a, rd, we, None, 0, 0.0, 1e-5, None, "rms")[0], xd)
+    res["decode_epilogue_pallas_ms"] = round(pal, 3)
+    res["decode_epilogue_xla_ms"] = round(xla, 3)
+    res["decode_epilogue_speedup"] = round(xla / pal, 3)
 
     # serving decode step through fused_multi_transformer: mmha Pallas
     # kernel vs the einsum fallback, Llama-7B-ish single layer

@@ -35,10 +35,26 @@ from .ir import (DataflowGraph, KIND_ELEMENTWISE, KIND_LAYOUT, KIND_MATMUL,
                  aval_bytes)
 
 __all__ = ["FusionGroup", "FusionCandidate", "fusion_groups",
-           "fusion_candidates", "boundary_edges"]
+           "fusion_candidates", "boundary_edges", "is_mega_kernel",
+           "MEGA_KERNEL_MARKERS"]
 
 _FUSE_THROUGH = {KIND_ELEMENTWISE, KIND_LAYOUT, KIND_RNG, KIND_SHARDING}
 _FUSIBLE_NODE = _FUSE_THROUGH | {KIND_REDUCE}
+
+#: pallas kernel-name markers of hand-written mega-kernels
+#: (ops/kernels/block_fused_pallas.py names its calls ``block_*_epilogue``).
+#: A candidate containing one of these regions is already HARVESTED: the
+#: epilogue chain it advertises runs as a single VMEM-resident pass, so it
+#: must stop advertising saved bytes in GA100's ranking and instead carry
+#: ``fused: true`` in the fusion_targets table.
+MEGA_KERNEL_MARKERS = ("block_attn_epilogue", "block_mlp_epilogue",
+                       "block_decode_epilogue")
+
+
+def is_mega_kernel(name) -> bool:
+    """True when a pallas_call name identifies a block mega-kernel."""
+    n = str(name or "")
+    return any(m in n for m in MEGA_KERNEL_MARKERS)
 
 
 @dataclass
@@ -65,11 +81,13 @@ class FusionCandidate:
     n_ops: int = 0
     file: str = ""
     line: int = 0
+    fused: bool = False   # a region is already a block mega-kernel
 
     def to_dict(self) -> dict:
         return {"name": self.name, "saved_bytes": int(self.saved_bytes),
                 "n_ops": int(self.n_ops), "n_regions": len(self.groups),
-                "span": f"{self.file}:{self.line}" if self.file else ""}
+                "span": f"{self.file}:{self.line}" if self.file else "",
+                "fused": bool(self.fused)}
 
 
 class _UnionFind:
@@ -173,11 +191,28 @@ def _pattern_name(prims: set) -> str | None:
     return None
 
 
+def _pallas_hint(chain: list[FusionGroup]) -> str | None:
+    """Pattern name recovered from pallas kernel names in the chain (a
+    pallas body is opaque — its prims never reach _pattern_name, but the
+    kernel NAME says what it computes). Attention first: the flash /
+    mmha / attn-epilogue cluster is the table's headline row."""
+    names = [str(grp.first.name or "") for grp in chain
+             if grp.kind == "breaker" and grp.first.kind == KIND_PALLAS]
+    joined = " ".join(names)
+    if any(k in joined for k in ("attn", "mmha", "flash")):
+        return "attention"
+    if "mlp_epilogue" in joined:
+        return "mlp-epilogue"
+    if "decode_epilogue" in joined:
+        return "decode-epilogue"
+    return None
+
+
 def _candidate_name(chain: list[FusionGroup]) -> str:
     merged: set = set()
     for grp in chain:
         merged |= grp.prims()
-    whole = _pattern_name(merged)
+    whole = _pattern_name(merged) or _pallas_hint(chain)
     labels: list[str] = []
     for grp in chain:
         if not labels or labels[-1] != grp.label:
@@ -262,6 +297,10 @@ def fusion_candidates(g: DataflowGraph, groups, node_group,
         out.append(FusionCandidate(
             name=_candidate_name(chain), saved_bytes=total, groups=chain,
             n_ops=sum(len(grp.nodes) for grp in chain),
-            file=first.file, line=first.line))
+            file=first.file, line=first.line,
+            fused=any(grp.kind == "breaker"
+                      and grp.first.kind == KIND_PALLAS
+                      and is_mega_kernel(grp.first.name)
+                      for grp in chain)))
     out.sort(key=lambda c: (-c.saved_bytes, c.file, c.line))
     return out[:top] if top else out

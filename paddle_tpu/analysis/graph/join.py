@@ -56,6 +56,10 @@ def join_measured(report, measured_ms: float, program: str = "",
             "est_saved_bytes_total": saved_total,
             "measured_ms": round(float(measured_ms), 3),
             "measured_ms_share": round(float(measured_ms) * frac, 3),
+            # harvested candidates (region already a block mega-kernel)
+            # keep their attributed share but leave the remaining-
+            # opportunity ranking
+            "fused": bool(d.get("fused")),
         }
         if hbm_delta_bytes is not None:
             row["measured_hbm_delta_bytes"] = int(hbm_delta_bytes)

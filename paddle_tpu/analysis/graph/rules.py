@@ -310,8 +310,13 @@ def check_graph(g: DataflowGraph, symbol: str = "",
                                    max_regions=cfg.candidate_max_regions)
     liveness = peak_liveness(g)
 
-    # GA100: named fusion candidates, ranked by saved HBM bytes
-    for cand in candidates[:cfg.candidate_top]:
+    # GA100: named fusion candidates, ranked by saved HBM bytes. A
+    # candidate whose region is already a block mega-kernel
+    # (``fused: true``) is HARVESTED — it no longer spends the bytes it
+    # would advertise, so it leaves the ranking (the fusion_targets table
+    # still lists it, marked, with its measured share attributed)
+    remaining = [c for c in candidates if not c.fused]
+    for cand in remaining[:cfg.candidate_top]:
         findings.append(_finding(
             "GA100",
             f"fusion candidate '{cand.name}': {cand.n_ops} ops in "
@@ -476,7 +481,7 @@ class GraphReport:
         out: list[dict] = []
         seen: dict = {}
         for c in self.candidates:
-            key = (c.name, c.saved_bytes, c.n_ops)
+            key = (c.name, c.saved_bytes, c.n_ops, bool(c.fused))
             if key in seen:
                 seen[key]["sites"] += 1
                 continue

@@ -101,6 +101,10 @@ define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf after each eage
 define_flag("matmul_precision", "default",
             "jax matmul precision: default|high|highest|bfloat16|tensorfloat32|float32", str)
 define_flag("use_pallas_kernels", True, "use pallas fused kernels on TPU where available", bool)
+define_flag("use_fused_blocks", True,
+            "use the transformer-block mega-kernel epilogues "
+            "(ops/kernels/block_fused_pallas.py) in models on TPU; "
+            "0 restores the per-op composite layer loop", bool)
 define_flag("eager_delete_tensor_gb", 0.0, "kept for API parity; XLA manages memory", float)
 define_flag("allocator_strategy", "auto_growth", "kept for API parity; XLA manages memory", str)
 define_flag("benchmark", False, "block_until_ready after each eager op for timing", bool)

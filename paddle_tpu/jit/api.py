@@ -295,7 +295,7 @@ class StaticFunction:
         or when analysis is off)."""
         return self._last_graph_report
 
-    def analyze_cached(self, key=None, config=None):
+    def analyze_cached(self, key=None, config=None, fresh=False):
         """Graph-analyze an ALREADY-compiled signature from its cached
         avals — an abstract re-trace, no device execution, no concrete
         arguments needed. This is the programmatic join API the
@@ -315,7 +315,7 @@ class StaticFunction:
                 return None
             entry = next(iter(self._cache.values()))
         jitted, cell, _state_list = entry
-        if config is None:
+        if config is None and not fresh:
             report = cell.get("graph_report")
             if report is not None:
                 return report
@@ -324,10 +324,21 @@ class StaticFunction:
             return None
         from ..analysis.graph import analyze_graph
         from ..analysis.graph.trace import source_file_of
-        cj = jitted.trace(avals[0], avals[1]).jaxpr
+        if fresh:
+            # force a RE-TRACE under the CURRENT dispatch globals (jax's
+            # trace cache keys on the function object, so a kernel-flag
+            # flip would otherwise hand back the stale jaxpr). A new
+            # closure over the unwrapped fn defeats the cache; used by the
+            # reconciliation's as-fused / composite views.
+            inner = getattr(jitted, "__wrapped__", None)
+            tracer = jax.jit(lambda *a: inner(*a)) if inner is not None \
+                else jitted
+        else:
+            tracer = jitted
+        cj = tracer.trace(avals[0], avals[1]).jaxpr
         report = analyze_graph(cj, name=self._obs_name, config=config,
                                prefer_file=source_file_of(self._fn))
-        if config is None:   # only the default-config report is cached
+        if config is None and not fresh:  # only the default report caches
             cell["graph_report"] = report
         return report
 
@@ -422,6 +433,18 @@ class StaticFunction:
         # the compiled fn (detach() views, EMA snapshots) are invalidated
         # by the donated execute — standard jax donation semantics; keep
         # donate_state=False if such aliases must stay live.
+        from ..ops.kernels import _common as _kern
+        if _kern.interpret_mode():
+            # interpret-mode pallas (the CPU test hook) re-traces its grid
+            # emulation at the OUTER program's first-call lowering; on jax
+            # 0.4.x that retrace must see the kernels' 32-bit world or the
+            # mixed-dtype helper symbols fail MLIR verification
+            with _kern.x64_off():
+                return self._run_compiled_inner(jitted, cell, state_list,
+                                                arg_arrays)
+        return self._run_compiled_inner(jitted, cell, state_list, arg_arrays)
+
+    def _run_compiled_inner(self, jitted, cell, state_list, arg_arrays):
         state_arrays = [stream_state_in(t, t._d) for t in state_list]
         if self._donate:
             state_arrays = dedup_for_donation(

@@ -130,13 +130,51 @@ class GPT(GenerationMixin, nn.Layer):
                               self.cfg.hidden_size // self.cfg.num_heads,
                               dtype)
 
-    def _head(self, x):
+    def _head(self, x, normed=False):
         """Shared final-norm + (tied) projection — ONE copy so the decode
-        cache branch can never drift from the training head."""
-        x = self.ln_f(x)
+        cache branch can never drift from the training head. ``normed``
+        skips ln_f (the fused trunk folds it into the last junction)."""
+        if not normed:
+            x = self.ln_f(x)
         if self.cfg.tie_word_embeddings:
             return paddle.matmul(x, self.wte.weight, transpose_y=True)
         return self.lm_head(x)
+
+    def _use_fused_blocks(self) -> bool:
+        """Mega-kernel trunk gate: default-on where the Pallas kernels
+        dispatch (TPU / interpret tests); FLAGS_use_fused_blocks=0 is the
+        eager/unfused escape hatch. Off-TPU the composite loop below runs
+        unchanged."""
+        from ..core.flags import flag
+        from ..ops.kernels import _common as kern
+        return (len(self.blocks) > 0 and flag("use_fused_blocks")
+                and flag("use_pallas_kernels") and kern.available())
+
+    def _fused_trunk(self, x):
+        """Mega-kernel residual trunk: every residual junction (the
+        dropout-add + the FOLLOWING norm — ln2 after attention, the next
+        block's ln1 / the final ln_f after the MLP) is ONE Pallas epilogue
+        pass (ops/kernels/block_fused_pallas.py). Same math as the layer
+        loop, regrouped so no unfused norm or residual add remains; the
+        MLP's dropout folds into its junction kernel (counter-hash mask
+        stream). Returns the ln_f-normalized hidden states."""
+        from ..nn import functional as F
+        blocks = list(self.blocks)
+        p = self.cfg.dropout if self.training else 0.0
+        y = blocks[0].ln1(x)
+        h = x
+        for i, blk in enumerate(blocks):
+            a = blk.attn(y)
+            y, h = F.fused_dropout_add_norm(
+                a, h, blk.ln2.weight, blk.ln2.bias, p=0.0,
+                epsilon=blk.ln2._epsilon, norm="layer",
+                training=self.training)
+            m = blk.mlp.proj(F.gelu(blk.mlp.fc(y), approximate=True))
+            nxt = blocks[i + 1].ln1 if i + 1 < len(blocks) else self.ln_f
+            y, h = F.fused_dropout_add_norm(
+                m, h, nxt.weight, nxt.bias, p=p,
+                epsilon=nxt._epsilon, norm="layer", training=self.training)
+        return y
 
     def forward(self, input_ids, labels=None, caches=None, cache_pos=None,
                 with_head=True):
@@ -159,9 +197,12 @@ class GPT(GenerationMixin, nn.Layer):
         pos = paddle.arange(s, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        for blk in self.blocks:
-            x = blk(x)
-        logits = self._head(x)
+        if self._use_fused_blocks():
+            logits = self._head(self._fused_trunk(x), normed=True)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+            logits = self._head(x)
         if labels is not None:
             loss = F.cross_entropy(
                 logits.reshape([-1, self.cfg.vocab_size]).cast("float32"),

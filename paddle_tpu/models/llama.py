@@ -243,14 +243,51 @@ class Llama(GenerationMixin, nn.Layer):
         return _rope_memo(self._rope_cache, s,
                           lambda: _rope_tables(self.cfg, s))
 
-    def _head(self, x):
+    def _head(self, x, normed=False):
         """Shared final-norm + (tied) projection — ONE copy so the decode
-        cache branch can never drift from the training head."""
-        x = self.norm(x)
+        cache branch can never drift from the training head. ``normed``
+        skips the final norm (the fused trunk folds it into the last
+        junction)."""
+        if not normed:
+            x = self.norm(x)
         if self.cfg.tie_word_embeddings:
             return paddle.matmul(x, self.embed_tokens.weight,
                                  transpose_y=True)
         return self.lm_head(x)
+
+    def _use_fused_blocks(self) -> bool:
+        """Mega-kernel trunk gate (mirrors models/gpt.py): default-on
+        where the Pallas kernels dispatch; FLAGS_use_fused_blocks=0 is
+        the unfused escape hatch."""
+        from ..core.flags import flag
+        from ..ops.kernels import _common as kern
+        return (len(self.layers) > 0 and flag("use_fused_blocks")
+                and flag("use_pallas_kernels") and kern.available())
+
+    def _fused_trunk(self, x, cos, sin):
+        """Mega-kernel residual trunk: both residual junctions of every
+        decoder layer — attention output -> post_attention_layernorm, and
+        MLP output -> the NEXT layer's input_layernorm (the final norm for
+        the last layer) — run as ONE Pallas epilogue pass each
+        (ops/kernels/block_fused_pallas.py), so no standalone norm or
+        residual add remains in the trunk. Returns the final-norm output."""
+        from ..nn import functional as F
+        layers = list(self.layers)
+        y = layers[0].input_layernorm(x)
+        h = x
+        for i, layer in enumerate(layers):
+            a = layer.self_attn(y, cos, sin)
+            post = layer.post_attention_layernorm
+            y, h = F.fused_dropout_add_norm(
+                a, h, post.weight, None, p=0.0, epsilon=post._epsilon,
+                norm="rms", training=self.training)
+            m = layer.mlp(y)
+            nxt = layers[i + 1].input_layernorm if i + 1 < len(layers) \
+                else self.norm
+            y, h = F.fused_dropout_add_norm(
+                m, h, nxt.weight, None, p=0.0, epsilon=nxt._epsilon,
+                norm="rms", training=self.training)
+        return y
 
     def init_cache(self, batch, max_len, dtype="float32"):
         """Zeroed per-layer (k, v) buffers [B, T, n_kv, D] for incremental
@@ -280,9 +317,12 @@ class Llama(GenerationMixin, nn.Layer):
             return (self._head(x) if with_head else None), new_caches
         cos, sin = self._rope(s)
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x, cos, sin)
-        logits = self._head(x)
+        if self._use_fused_blocks():
+            logits = self._head(self._fused_trunk(x, cos, sin), normed=True)
+        else:
+            for layer in self.layers:
+                x = layer(x, cos, sin)
+            logits = self._head(x)
         if labels is not None:
             loss = F.cross_entropy(
                 logits.reshape([-1, self.cfg.vocab_size]).cast("float32"),

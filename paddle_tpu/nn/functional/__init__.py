@@ -31,7 +31,7 @@ from .vision import __all__ as _vision_all
 
 __all__ = list(_act_all) + list(_loss_all) + list(_conv_all) + list(_pool_all) + list(_vision_all) + [
     "linear", "embedding", "layer_norm", "rms_norm", "fused_rms_norm_add",
-    "batch_norm", "group_norm",
+    "fused_dropout_add_norm", "batch_norm", "group_norm",
     "instance_norm", "normalize", "dropout", "dropout2d", "dropout3d",
     "alpha_dropout", "cosine_similarity", "pairwise_distance", "one_hot", "pad",
     "scaled_dot_product_attention", "sparse_attention", "interpolate",
@@ -134,6 +134,60 @@ def fused_rms_norm_add(x, residual, weight, epsilon=1e-6, name=None):
         ms = jnp.mean(jnp.square(hf), axis=-1, keepdims=True)
         return (hf * jax.lax.rsqrt(ms + epsilon)).astype(h.dtype) * w, h
     return apply_multi(f, x, residual, weight, name="fused_rms_norm_add")
+
+
+def fused_dropout_add_norm(x, residual, weight, bias=None, p=0.0,
+                           epsilon=1e-6, norm="rms", activation=None,
+                           seed=None, training=True, name=None):
+    """Transformer-block mega-kernel epilogue: ``activation(x)`` ->
+    dropout -> ``+ residual`` -> rms/layer norm as ONE VMEM-resident
+    Pallas pass on TPU (ops/kernels/block_fused_pallas.py, with a fused
+    custom_vjp backward); identical-semantics XLA composite elsewhere.
+    Returns ``(y, h)`` — the normalized output and the pre-norm residual
+    sum (the next junction's residual stream).
+
+    ``norm``: "rms" (no bias) | "layer". ``activation``: None (a
+    projection output feeds the junction directly — the in-model case) |
+    "gelu" (tanh form) | "swiglu" (x packed ``[.., 2I]``, residual
+    ``[.., I]``). The dropout mask is a counter-hash of (seed, element
+    index) — pass ``seed`` for a deterministic/per-step stream; without
+    one a seed is drawn from the framework RNG at trace time (constant
+    across steps inside ``to_static``, like ``fused_dropout_add``)."""
+    from ...core.flags import flag
+    from ...ops.kernels import _common as kern
+    from ...ops.kernels import block_fused_pallas as bfp
+    from ...autograd.function import apply_multi
+
+    xt, rt = as_tensor(x), as_tensor(residual)
+    p_eff = float(p) if training else 0.0
+    if seed is None:
+        if 0.0 < p_eff < 1.0:
+            key = gen_mod.default_generator.split()
+            seed = jax.random.randint(key, (), 0, 2147483647,
+                                      dtype=jnp.int32)
+        else:
+            seed = 0
+    seed_t = as_tensor(jnp.asarray(as_tensor(seed)._data, jnp.int32))
+
+    use_kern = (kern.available() and flag("use_pallas_kernels")
+                and bfp.use_kernel(tuple(xt.shape), tuple(rt.shape),
+                                   activation))
+    args = [xt, rt, weight] + ([bias] if bias is not None else [])
+    has_bias = bias is not None
+
+    if use_kern:
+        def f(a, r, w, *rest):
+            b = rest[0] if has_bias else None
+            return bfp.fused_epilogue(a, r, w, b, seed_t._data, p_eff,
+                                      epsilon, activation, norm, None,
+                                      kern.interpret_mode())
+    else:
+        def f(a, r, w, *rest):
+            b = rest[0] if has_bias else None
+            return bfp.reference_fused_epilogue(a, r, w, b, seed_t._data,
+                                                p_eff, epsilon, activation,
+                                                norm)
+    return apply_multi(f, *args, name="fused_dropout_add_norm")
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

@@ -52,7 +52,8 @@ __all__ = [
     "MAX_EVERY", "PROGRAM_MS_BUCKETS",
     "get_profiler", "profiler_if_started", "on_step", "stop", "reset",
     "sampling_active", "record_program", "note_program",
-    "fusion_targets", "last_reconciliation", "profile_snapshot",
+    "fusion_targets", "last_reconciliation",
+    "last_unfused_reconciliation", "profile_snapshot",
     "serve", "shutdown_server", "TelemetryServer",
 ]
 
@@ -465,5 +466,6 @@ def profile_snapshot() -> dict | None:
 # reconciliation + server: re-exported here so the public surface is one
 # module (paddle.observability.continuous.*; serve also rides
 # paddle.observability.serve)
-from .reconcile import fusion_targets, last_reconciliation  # noqa: E402,F401
+from .reconcile import (fusion_targets, last_reconciliation,  # noqa: E402,F401
+                        last_unfused_reconciliation)
 from .server import TelemetryServer, serve, shutdown_server  # noqa: E402,F401

@@ -18,10 +18,15 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["fusion_targets", "last_reconciliation", "render_targets"]
+__all__ = ["fusion_targets", "last_reconciliation",
+           "last_unfused_reconciliation", "render_targets"]
 
 _last_lock = threading.Lock()
 _last: list | None = None
+_last_unfused: list | None = None
+
+#: serializes the dispatch-global flips of the as-fused/composite views
+_view_lock = threading.Lock()
 
 
 def last_reconciliation() -> list | None:
@@ -32,24 +37,110 @@ def last_reconciliation() -> list | None:
         return None if _last is None else [dict(t) for t in _last]
 
 
-def _set_last(targets: list) -> None:
-    global _last
+def last_unfused_reconciliation() -> list | None:
+    """The composite-view table from the most recent reconciliation that
+    computed one (``fusion_targets(with_unfused=True)``) — the 'before'
+    side of the harvested-delta pair bench.py embeds."""
+    with _last_lock:
+        return None if _last_unfused is None \
+            else [dict(t) for t in _last_unfused]
+
+
+def _set_last(targets: list, unfused: list | None = None) -> None:
+    global _last, _last_unfused
     with _last_lock:
         _last = [dict(t) for t in targets]
+        if unfused is not None:
+            _last_unfused = [dict(t) for t in unfused]
 
 
-def fusion_targets(top: int = 10, profiler=None) -> list:
+def _view_report(sf, view: str):
+    """Analyze one profiled program as it compiles in a given world.
+
+    ``view="fused"``: the TPU program — every Pallas kernel (incl. the
+    block mega-kernels) dispatched. On a host without the kernels
+    (the CPU-smoke bench) this force-dispatches during an abstract
+    re-trace only; nothing is executed, exactly the
+    ``_common.force_dispatch`` lowering-trace contract. Candidates whose
+    region is a block kernel come back ``fused: true``.
+
+    ``view="unfused"``: the pure-XLA composite (kernels flagged off) —
+    the 'before' side showing what fusion still claims.
+
+    The re-trace runs the model's Python forward again, so: the module
+    lock serializes the brief dispatch-global flips (the continuous
+    profiler reconciles from the training thread between steps — a
+    concurrent OTHER thread executing model code inside the window would
+    see the flipped flags, so reconcile from the step loop, not a side
+    thread), the framework RNG state is snapshotted and restored (a
+    trace-time ``default_generator.split()`` in a dropout seed path must
+    not advance the run's RNG stream just because telemetry looked), and
+    any failure (a kernel wrapper rejecting the re-traced shapes, a
+    stale cache) falls back to the program's default cached report.
+    """
+    from ...analysis.graph.rules import GraphRuleConfig
+    from ...core import generator as gen_mod
+    from ...core.flags import flag, set_flags
+    from ...ops.kernels import _common as kern
+
+    def _fresh():
+        rng_state = gen_mod.default_generator.get_state()
+        try:
+            return sf.analyze_cached(config=GraphRuleConfig.from_env(),
+                                     fresh=True)
+        finally:
+            gen_mod.default_generator.set_state(rng_state)
+
+    try:
+        with _view_lock:
+            if view == "fused" and not kern.available():
+                kern.force_dispatch(True)
+                try:
+                    return _fresh()
+                finally:
+                    kern.force_dispatch(False)
+            if view == "unfused" and flag("use_pallas_kernels"):
+                set_flags({"use_pallas_kernels": 0})
+                try:
+                    return _fresh()
+                finally:
+                    set_flags({"use_pallas_kernels": 1})
+            if view == "unfused":
+                return _fresh()
+            return sf.analyze_cached()
+    except Exception:
+        try:
+            return sf.analyze_cached()
+        except Exception:
+            return None
+
+
+def fusion_targets(top: int = 10, profiler=None,
+                   with_unfused: bool = False) -> list:
     """Reconcile measured per-program time with static GA100 candidates.
 
-    Returns up to ``top`` rows sorted by ``measured_ms_share`` descending
-    (ties broken by ``est_saved_bytes``), each::
+    Returns up to ``top`` remaining-opportunity rows PLUS every harvested
+    (``fused``) row — the table must show where the measured share went,
+    so fused rows are exempt from the cap — sorted by
+    ``measured_ms_share`` descending (ties broken by
+    ``est_saved_bytes``), each::
 
         {"name", "sites", "n_ops", "span", "program",
          "est_saved_bytes",          # static, per site
          "est_saved_bytes_total",    # static, x sites
          "measured_ms",              # the program's measured ms/step
          "measured_ms_share",        # attributed to this candidate
+         "fused",                    # region already a block mega-kernel
          "measured_hbm_delta_bytes"} # window HBM delta (when probed)
+
+    The table reflects the program AS IT COMPILES WITH THE KERNELS ON
+    (the as-fused view — on a CPU-smoke host the candidates come from a
+    force-dispatch abstract re-trace, see :func:`_view_report`): rows
+    covered by a ``block_*_epilogue`` mega-kernel carry ``fused: true``
+    with their attributed share, and the un-fused rows are the REMAINING
+    opportunity ranking. ``with_unfused=True`` additionally computes the
+    composite 'before' view (``last_unfused_reconciliation``) so callers
+    (bench.py) can embed the harvested delta.
 
     Programs without an analyzable jaxpr (the fused optimizer dispatch,
     prefetch/collective waits) contribute measured time but no candidates
@@ -60,24 +151,39 @@ def fusion_targets(top: int = 10, profiler=None) -> list:
     p = profiler or get_profiler()
     stats = p.program_stats()
     targets: list = []
+    unfused_targets: list = []
+    from ...analysis.graph import join_measured
     for name, st in stats.items():
         sf = p.static_fn(name)
         if sf is None or not hasattr(sf, "analyze_cached"):
             continue
-        try:
-            report = sf.analyze_cached()
-        except Exception:
-            report = None
-        if report is None:
-            continue
-        from ...analysis.graph import join_measured
-        targets.extend(join_measured(
-            report, measured_ms=st["ms_per_step"], program=name,
-            hbm_delta_bytes=p.hbm_delta_bytes))
-    targets.sort(key=lambda t: (-t["measured_ms_share"],
-                                -t["est_saved_bytes"], t["name"]))
-    targets = targets[:top]
-    _set_last(targets)
+        report = _view_report(sf, "fused")
+        if report is not None:
+            targets.extend(join_measured(
+                report, measured_ms=st["ms_per_step"], program=name,
+                hbm_delta_bytes=p.hbm_delta_bytes))
+        if with_unfused:
+            before = _view_report(sf, "unfused")
+            if before is not None:
+                unfused_targets.extend(join_measured(
+                    before, measured_ms=st["ms_per_step"], program=name,
+                    hbm_delta_bytes=p.hbm_delta_bytes))
+
+    def _rank(rows):
+        rows.sort(key=lambda t: (-t["measured_ms_share"],
+                                 -t["est_saved_bytes"], t["name"]))
+        # harvested (fused) rows always stay visible: the table must show
+        # WHERE the measured share went, not only what remains — `top`
+        # bounds the remaining-opportunity rows
+        fused_rows = [t for t in rows if t.get("fused")]
+        remaining = [t for t in rows if not t.get("fused")][:top]
+        out = sorted(fused_rows + remaining,
+                     key=lambda t: (-t["measured_ms_share"],
+                                    -t["est_saved_bytes"], t["name"]))
+        return out
+
+    targets = _rank(targets)
+    _set_last(targets, _rank(unfused_targets) if with_unfused else None)
     return targets
 
 
@@ -89,7 +195,10 @@ def render_targets(targets: list, overhead_pct=None) -> str:
         # .get defaults: --from-bench rows come from arbitrary (older,
         # hand-edited) bench lines, not just our own join_measured output
         mib = t.get("est_saved_bytes", 0) / (1 << 20)
-        out.append(f"{i:<5} {t.get('name', '?'):<25} "
+        name = t.get("name", "?")
+        if t.get("fused"):
+            name += " [fused]"
+        out.append(f"{i:<5} {name:<25} "
                    f"{t.get('sites', 1):>5}  {mib:>10.2f} MiB  "
                    f"{t.get('measured_ms_share', 0.0):>16.3f}  "
                    f"{t.get('program', '')}")

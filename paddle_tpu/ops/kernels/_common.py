@@ -36,20 +36,60 @@ def interpret_mode() -> bool:
     return _INTERPRET
 
 
+def x64_off():
+    """Version-compat ``jax.enable_x64(False)``: top-level on newer jax,
+    only ``jax.experimental.disable_x64`` (same context manager) on
+    0.4.x. Every pallas_call in this package traces under it — the
+    framework enables x64 globally, which turns index-map/loop literals
+    into i64/f64 types Mosaic cannot legalize."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    from jax.experimental import disable_x64
+    return disable_x64()
+
+
+def jit_x64_off(fn, **jit_kwargs):
+    """``jax.jit`` whose CALLS run under :func:`x64_off` — so the trace
+    AND the compile/lowering see the same 32-bit world. On jax 0.4.x the
+    interpret-mode pallas grid emulation lowers index maps and padding
+    helpers at compile time; with only an in-body guard their python-int
+    arithmetic promotes to i64 under the framework's global x64 and
+    MLIR verification fails on the mixed-dtype calls."""
+    jitted = jax.jit(fn, **jit_kwargs)
+
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        with x64_off():
+            return jitted(*args, **kwargs)
+    return call
+
+
 def round_up(n, multiple):
     """Ceil `n` to a multiple (Mosaic block-alignment arithmetic)."""
     return -(-n // multiple) * multiple
 
 
+def pad_tail(a, pad, axis=0, value=0.0):
+    """Append ``pad`` fill rows along ``axis``.
+
+    Concatenate rather than ``jnp.pad``: jnp.pad lowers through a shared
+    ``@_pad`` pjit helper, and on jax 0.4.x a kernel traced under
+    :func:`x64_off` inside an x64-on outer program gets that helper
+    specialized with BOTH i32 and i64 scalar operands under one MLIR
+    symbol — the dedup-by-name then fails verification. Concatenate has
+    no helper symbol and XLA fuses it identically."""
+    import jax.numpy as jnp
+    if not pad:
+        return a
+    shape = list(a.shape)
+    shape[axis] = pad
+    return jnp.concatenate([a, jnp.full(shape, value, a.dtype)], axis=axis)
+
+
 def pad_to_block(a, block, axis=0):
     """Zero-pad `axis` of `a` up to a multiple of `block` (Mosaic requires
     sublane/lane-divisible blocks; callers slice the result back)."""
-    import jax.numpy as jnp
-    pad = (-a.shape[axis]) % block
-    if not pad:
-        return a
-    widths = [(0, pad if ax == axis else 0) for ax in range(a.ndim)]
-    return jnp.pad(a, widths)
+    return pad_tail(a, (-a.shape[axis]) % block, axis=axis)
 
 
 _BLOCK_OVERRIDES: dict = {}  # kernel key -> measured row-block choice

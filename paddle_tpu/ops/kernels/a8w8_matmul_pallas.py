@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import pad_to_block, pick_row_block
+from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
 
 _VMEM_BUDGET = 10 * 1024 * 1024
 
@@ -68,7 +68,7 @@ def _pick_blocks(m, k, n, itemsize):
     return bm, bn
 
 
-@functools.partial(jax.jit, static_argnames=("layout", "interpret"))
+@functools.partial(jit_x64_off, static_argnames=("layout", "interpret"))
 def a8w8_matmul(x, w_q, w_scales, layout="kn", interpret=False):
     """[.., K] float @ int8 weight -> [.., N] in x.dtype, contracted in
     int8 on the MXU with per-token dynamic activation scales and [N]
@@ -90,7 +90,7 @@ def a8w8_matmul(x, w_q, w_scales, layout="kn", interpret=False):
     np_ = w_p.shape[0] if nk else w_p.shape[1]
     w_spec = (pl.BlockSpec((bn, k), lambda mi, ni: (ni, 0)) if nk
               else pl.BlockSpec((k, bn), lambda mi, ni: (0, ni)))
-    with jax.enable_x64(False):
+    with x64_off():
         out = pl.pallas_call(
             functools.partial(_kernel, nk_layout=nk),
             grid=(mp // bm, np_ // bn),

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import padded_rows as _padded_rows
+from ._common import pad_tail, padded_rows as _padded_rows, x64_off
 
 _LANES = 128
 
@@ -40,8 +40,11 @@ def _adamw_kernel(s_ref, w_ref, g_ref, m_ref, v_ref,
     v = jnp.float32(beta2) * v_ref[...] + jnp.float32(1 - beta2) * (g * g)
     mhat = m * inv_bc1
     vhat = v * inv_bc2
-    w = w * (jnp.float32(1.0) - lr * jnp.float32(wd))
-    w = w - lr * mhat / (jnp.sqrt(vhat) + jnp.float32(eps))
+    # every multiply keeps a VECTOR operand: a ref-loaded scalar is a 0-d
+    # vector to Mosaic, and scalar x scalar products (lr * wd) lower to a
+    # mixed mulf(vector<f32>, f32) that fails verification on jax 0.4.x
+    w = w - (w * lr) * jnp.float32(wd)
+    w = w - (mhat / (jnp.sqrt(vhat) + jnp.float32(eps))) * lr
     wo_ref[...] = w
     mo_ref[...] = m
     vo_ref[...] = v
@@ -62,7 +65,7 @@ def _adamw_call(w32, g, m, v, scalars, *, beta1, beta2, eps, wd, out_dtype,
     def to2d(a, dt):
         flat = a.reshape(-1).astype(dt)
         if pad:
-            flat = jnp.pad(flat, (0, pad))
+            flat = pad_tail(flat, pad)
         return flat.reshape(rows, _LANES)
 
     w2 = to2d(w32, jnp.float32)
@@ -74,7 +77,7 @@ def _adamw_call(w32, g, m, v, scalars, *, beta1, beta2, eps, wd, out_dtype,
     blk = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
     s_spec = pl.BlockSpec((1, 4), lambda i: (0, 0))
     f32 = jnp.float32
-    with jax.enable_x64(False):
+    with x64_off():
         wo, mo, vo, po = pl.pallas_call(
             functools.partial(_adamw_kernel, beta1=beta1, beta2=beta2,
                               eps=eps, wd=wd),

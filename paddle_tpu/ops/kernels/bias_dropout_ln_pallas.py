@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import pad_to_block, pick_row_block
+from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
 
 
 def _pick_rows(n_rows, hidden):
@@ -92,7 +92,7 @@ def _bwd_kernel(h_ref, *rest, hidden, eps, has_mask):
         jnp.sum(dh * m, axis=0, keepdims=True), (8, hidden))
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("eps", "interpret", "rows"))
 def _fused_fwd(x2, b, res2, m2, g, be, eps, interpret, rows):
     n, h = x2.shape
     has_mask = m2 is not None
@@ -108,7 +108,7 @@ def _fused_fwd(x2, b, res2, m2, g, be, eps, interpret, rows):
         in_specs.append(row_spec)
     ins += [g.reshape(1, h), be.reshape(1, h)]
     in_specs += [vec_spec, vec_spec]
-    with jax.enable_x64(False):
+    with x64_off():
         y, hsum = pl.pallas_call(
             functools.partial(_fwd_kernel, eps=eps, has_mask=has_mask),
             grid=grid,
@@ -121,7 +121,7 @@ def _fused_fwd(x2, b, res2, m2, g, be, eps, interpret, rows):
     return y[:n], hsum[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("eps", "interpret", "rows"))
 def _fused_bwd(h2, m2, g, dy2, eps, interpret, rows):
     n, h = h2.shape
     has_mask = m2 is not None
@@ -137,7 +137,7 @@ def _fused_bwd(h2, m2, g, dy2, eps, interpret, rows):
         in_specs.append(row_spec)
     ins += [g.reshape(1, h), pad_to_block(dy2, rows)]
     in_specs += [pl.BlockSpec((1, h), lambda i: (0, 0)), row_spec]
-    with jax.enable_x64(False):
+    with x64_off():
         dx, dres, dgp, dbp, dbiasp = pl.pallas_call(
             functools.partial(_bwd_kernel, hidden=h, eps=eps,
                               has_mask=has_mask),

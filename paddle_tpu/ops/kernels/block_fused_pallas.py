@@ -1,0 +1,489 @@
+"""Transformer-block mega-kernel epilogues: fused (activation ->)
+dropout -> residual-add -> norm Pallas TPU passes.
+
+ROADMAP item 2, driven by the MEASURED ``fusion_targets`` ranking the
+continuous profiler reconciles (PR 7): the top candidates on the bench
+models are the attention epilogue cluster (flash-attention output ->
+residual dropout-add -> norm) and the gelu/layernorm clusters around the
+MLP. The per-op kernel families (``dropout_add_pallas``,
+``rms_norm_pallas``, ``bias_dropout_ln_pallas``, ``swiglu_pallas``) each
+deleted one HBM round trip; this module composes their math into ONE
+``pallas_call`` per residual junction so the whole epilogue chain is a
+single VMEM residency:
+
+    z = act(x)                 (optional: gelu-tanh, or swiglu on [.., 2I])
+    z = keep(z) / (1 - p)      (optional: murmur3 counter-hash mask, the
+                                dropout_add_pallas stream — regenerated in
+                                the backward from the saved int32 seed, so
+                                the mask never exists in HBM)
+    h = z + residual           (the pre-norm residual stream)
+    y = norm(h) * w (+ b)      (rmsnorm or layernorm, f32 statistics)
+
+Forward returns ``(y, h)``; the backward is ONE fused kernel too: norm
+backward (statistics recomputed from the saved ``h``), the regenerated
+dropout mask, and the activation derivative, plus per-block partial
+``dw``/``db`` accumulation — exactly the residuals the per-op kernels
+would have saved, minus every intermediate HBM write between them.
+
+Three public faces (the model/serving adoption points):
+
+* :func:`attn_epilogue` — attention-output junction (act=None);
+* :func:`mlp_epilogue`  — FFN junction, optionally fusing the gelu/swiglu
+  activation when the chain is contiguous (standalone FFN-epilogue use);
+* :func:`decode_epilogue` — the serving decode step's (mmha output ->
+  residual add -> norm) pass, shape-static so the compiled decode program
+  keeps its zero-retrace guarantee.
+
+All three trace as ``pallas_call``s named ``block_*_epilogue`` — the
+graph analyzer (``analysis/graph/fusion.py``) recognizes the prefix and
+marks candidates containing one as ``fused`` so the ranked
+``fusion_targets`` table reports *remaining* opportunity.
+
+Trainable under AMP bf16: inputs cast to f32 in VMEM, outputs cast back;
+``custom_vjp`` like every kernel family here, so GradScaler and
+``recompute`` (remat replays the forward with the SAME seed operand —
+the mask is a pure function of data, not of PRNG state) compose.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
+from .dropout_add_pallas import _GOLDEN, _fmix32, _keep_bits, _params
+
+#: sqrt(2/pi) and the cubic coefficient of the tanh gelu approximation
+_GELU_K = 0.7978845608028654
+_GELU_C = 0.044715
+
+VALID_ACTS = (None, "gelu", "swiglu")
+VALID_NORMS = ("rms", "layer")
+
+
+def _pick_rows(n_rows, hidden, act):
+    """Row block under the VMEM budget. The swiglu variant holds packed
+    [rows, 2I] x/dx rows next to the I-wide h/y/dh buffers (~10 f32 row
+    buffers live at once in the backward); budget on the widest."""
+    width = hidden * (2 if act == "swiglu" else 1)
+    return pick_row_block(n_rows, (width + 4 * hidden) * 4,
+                          4 * 1024 * 1024, key="block_fused")
+
+
+def _gelu_tanh(x):
+    """tanh-approximate gelu (the GPT MLP's activation), f32 VPU ops."""
+    u = jnp.float32(_GELU_K) * (x + jnp.float32(_GELU_C) * x * x * x)
+    return 0.5 * x * (1.0 + jnp.tanh(u))
+
+
+def _gelu_tanh_grad(x):
+    u = jnp.float32(_GELU_K) * (x + jnp.float32(_GELU_C) * x * x * x)
+    t = jnp.tanh(u)
+    du = jnp.float32(_GELU_K) * (1.0 + jnp.float32(3.0 * _GELU_C) * x * x)
+    return 0.5 * (1.0 + t) + 0.5 * x * (1.0 - t * t) * du
+
+
+def _act_fwd(x, act, hidden):
+    """(z, packed) — activation output on the hidden width."""
+    if act == "gelu":
+        return _gelu_tanh(x)
+    if act == "swiglu":
+        g = x[:, :hidden]
+        u = x[:, hidden:]
+        return g * jax.nn.sigmoid(g) * u
+    return x
+
+
+def _act_bwd(x, dz, act, hidden):
+    """dx on the input width from the activation-output cotangent dz."""
+    if act == "gelu":
+        return dz * _gelu_tanh_grad(x)
+    if act == "swiglu":
+        g = x[:, :hidden]
+        u = x[:, hidden:]
+        sig = jax.nn.sigmoid(g)
+        s = g * sig
+        dg = dz * u * sig * (1.0 + g - s)
+        du = dz * s
+        return jnp.concatenate([dg, du], axis=-1)
+    return dz
+
+
+def _fwd_kernel(*refs, hidden, eps, threshold, scale, act, norm, has_bias,
+                has_drop):
+    it = iter(refs)
+    seed_ref = next(it) if has_drop else None
+    x_ref = next(it)
+    res_ref = next(it)
+    w_ref = next(it)
+    b_ref = next(it) if has_bias else None
+    y_ref = next(it)
+    h_ref = next(it)
+
+    x = x_ref[...].astype(jnp.float32)                    # [rows, H or 2I]
+    z = _act_fwd(x, act, hidden)                          # [rows, H]
+    if has_drop:
+        rows = z.shape[0]
+        bits = _keep_bits(seed_ref, rows, hidden, pl.program_id(0))
+        z = jnp.where(bits >= jnp.uint32(threshold),
+                      z * jnp.float32(scale), jnp.float32(0.0))
+    h = z + res_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)                    # [1, H]
+    if norm == "rms":
+        rstd = jax.lax.rsqrt(jnp.mean(h * h, axis=-1, keepdims=True)
+                             + jnp.float32(eps))
+        y = h * rstd * w
+    else:
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.mean((h - mu) * (h - mu), axis=-1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + jnp.float32(eps))
+        y = (h - mu) * rstd * w
+    if has_bias:
+        y = y + b_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    h_ref[...] = h.astype(h_ref.dtype)
+
+
+def _bwd_kernel(*refs, hidden, eps, threshold, scale, act, norm, has_bias,
+                has_drop, has_gh, has_x):
+    """One fused backward pass: norm bwd (stats recomputed from h) ->
+    (+ h-stream cotangent) -> dropout mask regeneration -> activation
+    derivative, with per-block partial dw/db on the 8-row layout."""
+    it = iter(refs)
+    seed_ref = next(it) if has_drop else None
+    h_ref = next(it)
+    x_ref = next(it) if has_x else None
+    w_ref = next(it)
+    gy_ref = next(it)
+    gh_ref = next(it) if has_gh else None
+    dx_ref = next(it)
+    dres_ref = next(it)
+    dwp_ref = next(it)
+    dbp_ref = next(it) if has_bias else None
+
+    h = h_ref[...].astype(jnp.float32)                    # [rows, H]
+    w = w_ref[...].astype(jnp.float32)                    # [1, H]
+    gy = gy_ref[...].astype(jnp.float32)
+    u = gy * w
+    if norm == "rms":
+        rstd = jax.lax.rsqrt(jnp.mean(h * h, axis=-1, keepdims=True)
+                             + jnp.float32(eps))
+        dot = jnp.sum(h * u, axis=-1, keepdims=True)
+        dh = rstd * u - h * (rstd * rstd * rstd) * \
+            (dot * jnp.float32(1.0 / hidden))
+        dwp = jnp.sum(gy * h * rstd, axis=0, keepdims=True)
+    else:
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.mean((h - mu) * (h - mu), axis=-1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + jnp.float32(eps))
+        xhat = (h - mu) * rstd
+        c1 = jnp.mean(u, axis=-1, keepdims=True)
+        c2 = jnp.mean(u * xhat, axis=-1, keepdims=True)
+        dh = (u - c1 - xhat * c2) * rstd
+        dwp = jnp.sum(gy * xhat, axis=0, keepdims=True)
+        if has_bias:
+            dbp_ref[0] = jnp.broadcast_to(
+                jnp.sum(gy, axis=0, keepdims=True), (8, hidden))
+    if has_gh:
+        # cotangent arriving on the residual stream joins dh: every use of
+        # h (the norm input AND the forwarded residual) shares it
+        dh = dh + gh_ref[...].astype(jnp.float32)
+    dres_ref[...] = dh.astype(dres_ref.dtype)
+    dz = dh
+    if has_drop:
+        rows = dz.shape[0]
+        bits = _keep_bits(seed_ref, rows, hidden, pl.program_id(0))
+        dz = jnp.where(bits >= jnp.uint32(threshold),
+                       dz * jnp.float32(scale), jnp.float32(0.0))
+    x = x_ref[...].astype(jnp.float32) if has_x else None
+    dx_ref[...] = _act_bwd(x, dz, act, hidden).astype(dx_ref.dtype)
+    dwp_ref[0] = jnp.broadcast_to(dwp, (8, hidden))
+
+
+@functools.partial(jit_x64_off,
+                   static_argnames=("threshold", "scale", "eps", "act",
+                                    "norm", "kname", "interpret", "rows"))
+def _fwd(x2, res2, w, b, seed, threshold, scale, eps, act, norm, kname,
+         interpret, rows):
+    n, hd = res2.shape
+    xw = x2.shape[1]
+    has_bias = b is not None
+    has_drop = seed is not None
+    x2p = pad_to_block(x2, rows)
+    np_ = x2p.shape[0]
+    x_spec = pl.BlockSpec((rows, xw), lambda i: (i, 0))
+    row_spec = pl.BlockSpec((rows, hd), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, hd), lambda i: (0, 0))
+    ins, in_specs = [], []
+    if has_drop:
+        ins.append(seed.reshape(1).astype(jnp.int32))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    ins += [x2p, pad_to_block(res2, rows), w.reshape(1, hd)]
+    in_specs += [x_spec, row_spec, vec_spec]
+    if has_bias:
+        ins.append(b.reshape(1, hd))
+        in_specs.append(vec_spec)
+    kern = _named(functools.partial(
+        _fwd_kernel, hidden=hd, eps=eps, threshold=threshold, scale=scale,
+        act=act, norm=norm, has_bias=has_bias, has_drop=has_drop), kname)
+    with x64_off():
+        y, h = pl.pallas_call(
+            kern,
+            grid=(np_ // rows,),
+            in_specs=in_specs,
+            out_specs=[row_spec, row_spec],
+            out_shape=[jax.ShapeDtypeStruct((np_, hd), res2.dtype),
+                       jax.ShapeDtypeStruct((np_, hd), res2.dtype)],
+            interpret=interpret,
+        )(*ins)
+    return y[:n], h[:n]
+
+
+@functools.partial(jit_x64_off,
+                   static_argnames=("threshold", "scale", "eps", "act",
+                                    "norm", "kname", "interpret", "rows",
+                                    "has_bias", "x_dtype"))
+def _bwd(h2, x2, w, gy2, gh2, seed, threshold, scale, eps, act, norm,
+         kname, interpret, rows, has_bias, x_dtype):
+    n, hd = h2.shape
+    has_drop = seed is not None
+    has_gh = gh2 is not None
+    has_x = x2 is not None
+    xw = x2.shape[1] if has_x else hd
+    h2p = pad_to_block(h2, rows)
+    np_ = h2p.shape[0]
+    x_spec = pl.BlockSpec((rows, xw), lambda i: (i, 0))
+    row_spec = pl.BlockSpec((rows, hd), lambda i: (i, 0))
+    part_spec = pl.BlockSpec((1, 8, hd), lambda i: (i, 0, 0))
+    ins, in_specs = [], []
+    if has_drop:
+        ins.append(seed.reshape(1).astype(jnp.int32))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    ins.append(h2p)
+    in_specs.append(row_spec)
+    if has_x:
+        ins.append(pad_to_block(x2, rows))
+        in_specs.append(x_spec)
+    ins += [w.reshape(1, hd), pad_to_block(gy2, rows)]
+    in_specs += [pl.BlockSpec((1, hd), lambda i: (0, 0)), row_spec]
+    if has_gh:
+        ins.append(pad_to_block(gh2, rows))
+        in_specs.append(row_spec)
+    out_specs = [x_spec, row_spec, part_spec]
+    # dx carries the PRIMAL x's dtype (an O1-autocast bf16 projection can
+    # feed an f32 residual stream — the engine routes dx back to it), h's
+    # dtype covers the residual-stream cotangent
+    out_shape = [jax.ShapeDtypeStruct((np_, xw), x_dtype),
+                 jax.ShapeDtypeStruct((np_, hd), h2.dtype),
+                 jax.ShapeDtypeStruct((np_ // rows, 8, hd), jnp.float32)]
+    if has_bias:
+        out_specs.append(part_spec)
+        out_shape.append(
+            jax.ShapeDtypeStruct((np_ // rows, 8, hd), jnp.float32))
+    kern = _named(functools.partial(
+        _bwd_kernel, hidden=hd, eps=eps, threshold=threshold, scale=scale,
+        act=act, norm=norm, has_bias=has_bias, has_drop=has_drop,
+        has_gh=has_gh, has_x=has_x), kname)
+    with x64_off():
+        outs = pl.pallas_call(
+            kern,
+            grid=(np_ // rows,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*ins)
+    dx, dres, dwp = outs[0], outs[1], outs[2]
+    dw = jnp.sum(dwp[:, 0, :], axis=0)
+    db = jnp.sum(outs[3][:, 0, :], axis=0) if has_bias else None
+    return dx[:n], dres[:n], dw, db
+
+
+def _named(fn, name):
+    """Give a partial-bound kernel body a real ``__name__`` so the traced
+    ``pallas_call`` carries it — the graph analyzer's ``fused`` marker
+    recognizes the ``block_*_epilogue`` prefix by this name."""
+    def kernel(*refs):
+        return fn(*refs)
+    kernel.__name__ = kernel.__qualname__ = name
+    return kernel
+
+
+def _kname(act, tag):
+    if tag:
+        return f"block_{tag}_epilogue"
+    return "block_mlp_epilogue" if act else "block_attn_epilogue"
+
+
+def _check(act, norm, bias):
+    if act not in VALID_ACTS:
+        raise ValueError(f"act must be one of {VALID_ACTS}, got {act!r}")
+    if norm not in VALID_NORMS:
+        raise ValueError(f"norm must be one of {VALID_NORMS}, got {norm!r}")
+    if norm == "rms" and bias is not None:
+        raise ValueError("rms norm takes no bias")
+
+
+def _prep(x, residual, p, act):
+    """(x2, res2, rows, threshold, scale, seed_needed)."""
+    shp = residual.shape
+    hd = shp[-1]
+    n_rows = math.prod(shp[:-1])
+    rows = _pick_rows(n_rows, hd, act)
+    xw = hd * (2 if act == "swiglu" else 1)
+    if x.shape[-1] != xw:
+        raise ValueError(f"act={act!r} expects x width {xw}, got "
+                         f"{x.shape[-1]} (residual hidden {hd})")
+    has_drop = 0.0 < p < 1.0
+    threshold, scale = _params(p) if has_drop else (0, 1.0)
+    return (x.reshape(-1, xw), residual.reshape(-1, hd), rows, threshold,
+            scale, has_drop)
+
+
+def _primal(x, residual, weight, bias, seed, p, eps, act, norm, tag,
+            interpret=False):
+    """(y, h): y = norm(dropout(act(x)) + residual) * w (+ b), h = the
+    pre-norm residual sum. ``seed`` is the dropout counter-hash seed
+    (ignored when p is 0)."""
+    _check(act, norm, bias)
+    shp = residual.shape
+    x2, res2, rows, threshold, scale, has_drop = _prep(x, residual, p, act)
+    seed_arr = jnp.asarray(seed, jnp.int32) if has_drop else None
+    y, h = _fwd(x2, res2, weight, bias, seed_arr, threshold, scale, eps,
+                act, norm, _kname(act, tag), interpret, rows)
+    return y.reshape(shp), h.reshape(shp)
+
+
+fused_epilogue = jax.custom_vjp(_primal, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+
+
+def _vjp_fwd(x, residual, weight, bias, seed, p, eps, act, norm, tag,
+             interpret):
+    outs = _primal(x, residual, weight, bias, seed, p, eps, act, norm, tag,
+                   interpret)
+    # h is the only activation residual the norm backward needs; x rides
+    # along only when an activation derivative must be applied
+    # h is the only tensor residual the norm backward needs; x rides along
+    # only when an activation derivative must be applied. For act=None a
+    # ZERO-SIZE token still carries x's dtype (dx must match the primal —
+    # an O1-autocast bf16 projection can feed an f32 residual stream), as
+    # residual pytrees may hold jax values, not dtype objects.
+    save_x = x if act is not None else jnp.zeros((0,), x.dtype)
+    return outs, (outs[1], save_x, weight, bias, seed, x.shape,
+                  residual.shape)
+
+
+def _vjp_bwd(p, eps, act, norm, tag, interpret, saved, grads):
+    h, save_x, weight, bias, seed, x_shape, shp = saved
+    x = save_x if act is not None else None
+    x_dtype = save_x.dtype
+    gy, gh = grads
+    hd = shp[-1]
+    rows = _pick_rows(math.prod(shp[:-1]), hd, act)
+    has_drop = 0.0 < p < 1.0
+    threshold, scale = _params(p) if has_drop else (0, 1.0)
+    seed_arr = jnp.asarray(seed, jnp.int32) if has_drop else None
+    xw = hd * (2 if act == "swiglu" else 1)
+    dx, dres, dw, db = _bwd(
+        h.reshape(-1, hd),
+        x.reshape(-1, xw) if x is not None else None,
+        weight, gy.reshape(-1, hd),
+        gh.reshape(-1, hd) if gh is not None else None,
+        seed_arr, threshold, scale, eps, act, norm,
+        _kname(act, tag) + "_bwd", interpret, rows, bias is not None,
+        x_dtype=jnp.dtype(x_dtype))
+    return (dx.reshape(x_shape), dres.reshape(shp), dw.astype(weight.dtype),
+            db.astype(bias.dtype) if bias is not None else None, None)
+
+
+fused_epilogue.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# -- the three adoption faces ------------------------------------------------
+
+def attn_epilogue(x, residual, weight, bias=None, seed=0, p=0.0, eps=1e-6,
+                  norm="rms", interpret=False):
+    """Attention-output junction: dropout(x) + residual -> norm, one VMEM
+    pass. Returns (y, h)."""
+    return fused_epilogue(x, residual, weight, bias, seed, p, eps, None,
+                          norm, "attn", interpret)
+
+
+def mlp_epilogue(x, residual, weight, bias=None, seed=0, p=0.0, eps=1e-6,
+                 act=None, norm="rms", interpret=False):
+    """FFN junction: act(x) -> dropout -> + residual -> norm, one VMEM
+    pass. ``act`` is None (projection output feeds the junction directly),
+    "gelu" (tanh form), or "swiglu" (x packed [.., 2I], residual [.., I]).
+    Returns (y, h)."""
+    return fused_epilogue(x, residual, weight, bias, seed, p, eps, act,
+                          norm, "mlp", interpret)
+
+
+def decode_epilogue(x, residual, weight, eps=1e-6, interpret=False):
+    """Serving decode-step junction (mmha/projection output -> residual
+    add -> rmsnorm): dropout-free, shape-static, so the compiled decode
+    program keeps its zero-retrace guarantee. Returns (y, h)."""
+    return fused_epilogue(x, residual, weight, None, 0, 0.0, eps, None,
+                          "rms", "decode", interpret)
+
+
+def use_kernel(x_shape, res_shape, act=None) -> bool:
+    """Dispatch gate: flattenable rows, matching widths, and enough work
+    that the kernel's fixed cost amortizes. The swiglu packed layout needs
+    both 128-lane halves (mirrors ``ops.swiglu``'s packed gate)."""
+    if len(res_shape) < 2 or len(x_shape) != len(res_shape):
+        return False
+    hd = res_shape[-1]
+    xw = hd * (2 if act == "swiglu" else 1)
+    if x_shape[-1] != xw or tuple(x_shape[:-1]) != tuple(res_shape[:-1]):
+        return False
+    if act == "swiglu" and x_shape[-1] % 256:
+        return False
+    return math.prod(res_shape) >= 512
+
+
+# -- XLA composite with identical semantics (parity tests / A-B) -------------
+
+def reference_fused_epilogue(x, residual, weight, bias=None, seed=0, p=0.0,
+                             eps=1e-6, act=None, norm="rms"):
+    """Pure-jnp composite with the SAME math (incl. the counter-hash
+    dropout stream), for parity tests, A/B timing, and the off-TPU path of
+    ``nn.functional.fused_dropout_add_norm``."""
+    _check(act, norm, bias)
+    shp = residual.shape
+    hd = shp[-1]
+    n = math.prod(shp[:-1])
+    xf = x.reshape(n, -1).astype(jnp.float32)
+    if act == "gelu":
+        z = _gelu_tanh(xf)
+    elif act == "swiglu":
+        g, u = xf[:, :hd], xf[:, hd:]
+        z = g * jax.nn.sigmoid(g) * u
+    else:
+        z = xf
+    if 0.0 < p < 1.0:
+        idx = jnp.arange(n * hd, dtype=jnp.uint32).reshape(n, hd)
+        bits = _fmix32(idx ^ (jnp.asarray(seed).astype(jnp.uint32)
+                              * jnp.uint32(_GOLDEN)))
+        threshold, scale = _params(p)
+        z = jnp.where(bits >= jnp.uint32(threshold), z * jnp.float32(scale),
+                      jnp.float32(0.0))
+    h = z + residual.reshape(n, hd).astype(jnp.float32)
+    w = weight.reshape(1, hd).astype(jnp.float32)
+    if norm == "rms":
+        rstd = jax.lax.rsqrt(jnp.mean(h * h, axis=-1, keepdims=True)
+                             + jnp.float32(eps))
+        y = h * rstd * w
+    else:
+        mu = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.mean((h - mu) * (h - mu), axis=-1, keepdims=True)
+        y = (h - mu) * jax.lax.rsqrt(var + jnp.float32(eps)) * w
+    if bias is not None:
+        y = y + bias.reshape(1, hd).astype(jnp.float32)
+    dt = residual.dtype
+    return y.astype(dt).reshape(shp), h.astype(dt).reshape(shp)

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import pick_row_block
+from ._common import pad_tail, pick_row_block, x64_off, jit_x64_off
 
 _NEG = -1e30
 
@@ -62,22 +62,20 @@ def _stats_kernel(lg_ref, lb_ref, mx_ref, se_ref, tg_ref, *, vocab_start,
 _LANES = 128  # stat outputs keep a full lane dim; callers read lane 0
 
 
-@functools.partial(jax.jit, static_argnames=("vocab_start", "interpret"))
+@functools.partial(jit_x64_off, static_argnames=("vocab_start", "interpret"))
 def _row_stats(logits2, labels, vocab_start, interpret):
     n, v = logits2.shape
     vp = -(-v // 128) * 128
     if vp != v:
-        logits2 = jnp.pad(logits2, ((0, 0), (0, vp - v)),
-                          constant_values=_NEG)
+        logits2 = pad_tail(logits2, vp - v, axis=1, value=_NEG)
     rows = pick_row_block(n, vp * 4, 4 * 1024 * 1024)
     pad_n = (-n) % rows
     if pad_n:
-        logits2 = jnp.pad(logits2, ((0, pad_n), (0, 0)),
-                          constant_values=_NEG)
-        labels = jnp.pad(labels, (0, pad_n))
+        logits2 = pad_tail(logits2, pad_n, axis=0, value=_NEG)
+        labels = pad_tail(labels, pad_n)
     np_ = n + pad_n
     grid = (np_ // rows,)
-    with jax.enable_x64(False):
+    with x64_off():
         mx, se, tg = pl.pallas_call(
             functools.partial(_stats_kernel, vocab_start=vocab_start,
                               v_valid=v),

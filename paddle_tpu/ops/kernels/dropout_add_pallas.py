@@ -43,7 +43,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import pad_to_block, pick_row_block
+from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
 
 _GOLDEN = 0x9E3779B9  # 2^32 / phi; seed diffusion multiplier
 
@@ -92,7 +92,7 @@ def _bwd_kernel(seed_ref, dy_ref, dx_ref, *, threshold, scale):
                             jnp.float32(0.0)).astype(dx_ref.dtype)
 
 
-@functools.partial(jax.jit,
+@functools.partial(jit_x64_off,
                    static_argnames=("threshold", "scale", "interpret",
                                     "rows"))
 def _fwd(x2, res2, seed, threshold, scale, interpret, rows):
@@ -100,7 +100,7 @@ def _fwd(x2, res2, seed, threshold, scale, interpret, rows):
     x2p = pad_to_block(x2, rows)
     np_ = x2p.shape[0]
     spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
-    with jax.enable_x64(False):
+    with x64_off():
         y = pl.pallas_call(
             functools.partial(_fwd_kernel, threshold=threshold, scale=scale),
             grid=(np_ // rows,),
@@ -112,7 +112,7 @@ def _fwd(x2, res2, seed, threshold, scale, interpret, rows):
     return y[:n]
 
 
-@functools.partial(jax.jit,
+@functools.partial(jit_x64_off,
                    static_argnames=("threshold", "scale", "interpret",
                                     "rows"))
 def _bwd(dy2, seed, threshold, scale, interpret, rows):
@@ -120,7 +120,7 @@ def _bwd(dy2, seed, threshold, scale, interpret, rows):
     dy2p = pad_to_block(dy2, rows)
     np_ = dy2p.shape[0]
     spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
-    with jax.enable_x64(False):
+    with x64_off():
         dx = pl.pallas_call(
             functools.partial(_bwd_kernel, threshold=threshold, scale=scale),
             grid=(np_ // rows,),

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._common import x64_off, jit_x64_off
+
 NEG_INF = -1e30  # wrapped in jnp.float32 at use sites (x64 safety)
 LSE_LANES = 128  # lse/delta stored [.., S, 128]: Mosaic wants full-lane layouts
 
@@ -163,7 +165,7 @@ def _fwd_common(q, k, v, segment_ids, causal, block_q, block_k, interpret,
         out_specs = blk_o
         out_shape = jax.ShapeDtypeStruct((b * h, s, d), q.dtype)
 
-    with jax.enable_x64(False):
+    with x64_off():
         res = pl.pallas_call(
             functools.partial(_attn_kernel, causal=causal, block_k=block_k,
                               seq_len=s, scale=scale, block_q=block_q,
@@ -180,7 +182,7 @@ def _fwd_common(q, k, v, segment_ids, causal, block_q, block_k, interpret,
     return jnp.swapaxes(res.reshape(b, h, s, d), 1, 2)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+@functools.partial(jit_x64_off, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
                                 block_k=256, interpret=False,
@@ -193,7 +195,7 @@ def flash_attention_forward_lse(q, k, v, causal=False, block_q=256,
                        interpret, with_lse=True)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+@functools.partial(jit_x64_off, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention_forward(q, k, v, causal=False, block_q=256, block_k=256,
                             interpret=False, segment_ids=None):
@@ -333,7 +335,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+@functools.partial(jit_x64_off, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
                              block_k=256, interpret=False, segment_ids=None):
@@ -391,7 +393,7 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
                          lambda bi, ki: (bi // h, 0, ki)),
         ]
 
-    with jax.enable_x64(False):
+    with x64_off():
         dq = pl.pallas_call(
             functools.partial(_dq_kernel, causal=causal, block_q=block_q,
                               block_k=block_k, seq_len=s, scale=scale,
@@ -412,7 +414,7 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
 
     # dk/dv: per-q-head partials (kv blocks fetched through kv_map — no
     # materialized repeat), summed over each kv head's query group after
-    with jax.enable_x64(False):
+    with x64_off():
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_kernel, causal=causal, block_q=block_q,
                               block_k=block_k, seq_len=s, scale=scale,

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import padded_rows as _padded_rows
+from ._common import pad_tail, padded_rows as _padded_rows, x64_off
 
 _LANES = 128
 
@@ -84,7 +84,7 @@ def _lamb_call(w32, g, m, v, scalars, *, beta1, beta2, eps, wd, out_dtype,
     def to2d(a):
         flat = a.reshape(-1).astype(jnp.float32)
         if pad:
-            flat = jnp.pad(flat, (0, pad))
+            flat = pad_tail(flat, pad)
         return flat.reshape(rows, _LANES)
 
     w2, g2, m2, v2 = to2d(w32), to2d(g), to2d(m), to2d(v)
@@ -94,7 +94,7 @@ def _lamb_call(w32, g, m, v, scalars, *, beta1, beta2, eps, wd, out_dtype,
     part = pl.BlockSpec((1, 8, _LANES), lambda i: (i, 0, 0))
     f32 = jnp.float32
     kw = dict(beta1=beta1, beta2=beta2, eps=eps, wd=wd)
-    with jax.enable_x64(False):
+    with x64_off():
         mo, vo, pw, pu = pl.pallas_call(
             functools.partial(_moments_kernel, **kw),
             grid=grid,

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import pad_to_block, round_up
+from ._common import pad_to_block, round_up, x64_off, jit_x64_off
 
 _BM = 512   # rows of x/dy streamed per MXU step
 _BKN = 256  # output tile edge: [256, 256] fp32 scratch = 256 KB VMEM
@@ -62,7 +62,7 @@ def _kernel(acc_in_ref, x_ref, dy_ref, out_ref, scratch, *, n_m):
         out_ref[...] = scratch[...].astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jit_x64_off, static_argnames=("interpret",))
 def _grad_acc(x2, dy2, acc, interpret):
     # NOTE: no jit-level donate_argnums — an eager caller's Tensor still
     # references `acc`, and donation would invalidate it under its feet.
@@ -78,7 +78,7 @@ def _grad_acc(x2, dy2, acc, interpret):
     accp = pad_to_block(pad_to_block(acc, _BKN, 0), _BKN, 1)
     n_m = mp // _BM
     grid = (kp // _BKN, np_ // _BKN, n_m)
-    with jax.enable_x64(False):
+    with x64_off():
         out = pl.pallas_call(
             functools.partial(_kernel, n_m=n_m),
             grid=grid,

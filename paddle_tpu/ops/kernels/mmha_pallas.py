@@ -29,18 +29,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._common import round_up
+from ._common import round_up, jit_x64_off
 
 
-def _x64_off():
-    """Version-compat: ``jax.enable_x64`` is top-level on newer jax; on
-    0.4.x it only exists as ``jax.experimental.enable_x64`` (same context
-    manager). The serving runtime's paged decode needs this kernel to
-    trace on both."""
-    if hasattr(jax, "enable_x64"):
-        return jax.enable_x64(False)
-    from jax.experimental import disable_x64
-    return disable_x64()
+from ._common import x64_off as _x64_off  # shared shim (kept as the
+#                                           historical name callers import)
 
 
 NEG_INF = -1e30
@@ -110,7 +103,7 @@ def use_kernel(q_shape, cache_shape, cache_dtype, block_t=BLOCK_T) -> bool:
     return 2 * t * d * itemsize <= _VMEM_BYTES
 
 
-@functools.partial(jax.jit, static_argnames=("block_t", "interpret"))
+@functools.partial(jit_x64_off, static_argnames=("block_t", "interpret"))
 def mmha_decode(q, k_buf, v_buf, pos, block_t=BLOCK_T, interpret=False):
     """q [B, 1, H, D]; k_buf/v_buf [B, Hkv, T, D] (current token already
     written at `pos`); pos: traced scalar (uniform decode) or [B] vector

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import x64_off, jit_x64_off
+
 
 def _kernel(c_ref, x_ref, w_ref, o_ref, *, block_c):
     # c_ref is the scalar-prefetch arg: counts[e] lives in SMEM (a (1,1)
@@ -68,7 +70,7 @@ def _pick_bf(f):
     return 256 if f % 256 == 0 else 128
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jit_x64_off, static_argnames=("interpret",))
 def _grouped_call(x, w, counts, interpret):
     from ._common import pad_to_block
     e, c, h = x.shape
@@ -84,7 +86,7 @@ def _grouped_call(x, w, counts, interpret):
                   pl.BlockSpec((1, h, bf), lambda e_, i, j, c_: (e_, 0, j))],
         out_specs=pl.BlockSpec((1, bc, bf), lambda e_, i, j, c_: (e_, i, j)),
     )
-    with jax.enable_x64(False):
+    with x64_off():
         out = pl.pallas_call(
             functools.partial(_kernel, block_c=bc),
             grid_spec=grid_spec,

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._common import x64_off, jit_x64_off
+
 
 def _fwd_plain_kernel(x_ref, w_ref, o_ref, *, eps):
     x = x_ref[...].astype(jnp.float32)                    # [rows, H]
@@ -76,7 +78,7 @@ def _pad_rows(a, rows):
     return pad_to_block(a, rows, axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("eps", "interpret", "rows"))
 def _fused_fwd(x2, res2, w, eps, interpret, rows):
     n, h = x2.shape
     x2p = _pad_rows(x2, rows)
@@ -85,7 +87,7 @@ def _fused_fwd(x2, res2, w, eps, interpret, rows):
     row_spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
     w_spec = pl.BlockSpec((1, h), lambda i: (0, 0))
     if res2 is None:
-        with jax.enable_x64(False):
+        with x64_off():
             out = pl.pallas_call(
                 functools.partial(_fwd_plain_kernel, eps=eps),
                 grid=grid,
@@ -95,7 +97,7 @@ def _fused_fwd(x2, res2, w, eps, interpret, rows):
                 interpret=interpret,
             )(x2p, w.reshape(1, h))
         return out[:n], x2
-    with jax.enable_x64(False):
+    with x64_off():
         out, hsum = pl.pallas_call(
             functools.partial(_fwd_res_kernel, eps=eps),
             grid=grid,
@@ -108,14 +110,14 @@ def _fused_fwd(x2, res2, w, eps, interpret, rows):
     return out[:n], hsum[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("eps", "interpret", "rows"))
 def _fused_bwd(h2, w, g2, eps, interpret, rows):
     n, h = h2.shape
     h2p = _pad_rows(h2, rows)
     np_ = h2p.shape[0]
     grid = (np_ // rows,)
     row_spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
-    with jax.enable_x64(False):
+    with x64_off():
         dx, dw_part = pl.pallas_call(
             functools.partial(_bwd_kernel, hidden=h, eps=eps),
             grid=grid,

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._common import x64_off, jit_x64_off
+
 
 def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
     x = x_ref[0].astype(jnp.float32)                # [rows, H, D]
@@ -42,7 +44,7 @@ def _pick_rows(total_s, feat):
     return pick_row_block(total_s, feat * 4, 1024 * 1024, key="rope")
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("interpret", "rows"))
 def _rope_call(x, cos2, sin2, interpret, rows):
     b, s, h, d = x.shape
     from ._common import pad_to_block
@@ -55,7 +57,7 @@ def _rope_call(x, cos2, sin2, interpret, rows):
     x_spec = pl.BlockSpec((1, rows, h, d), lambda i: (i // nsb, i % nsb, 0, 0))
     t_spec = pl.BlockSpec((rows, d), lambda i: (i % nsb, 0))
 
-    with jax.enable_x64(False):
+    with x64_off():
         out = pl.pallas_call(
             _rope_kernel,
             grid=grid,

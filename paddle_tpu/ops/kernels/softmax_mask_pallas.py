@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import pad_to_block, pick_row_block
+from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
 
 
 def _pick_rows(sq, sk):
@@ -63,14 +63,14 @@ def _bwd_kernel(y_ref, dy_ref, dx_ref):
                    ).astype(dx_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("heads", "interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("heads", "interpret", "rows"))
 def _fused_fwd(x3, m3, heads, interpret, rows):
     bh, sq, sk = x3.shape
     x3p = pad_to_block(x3, rows, axis=1)
     sqp = x3p.shape[1]
     grid = (bh, sqp // rows)
     spec = pl.BlockSpec((1, rows, sk), lambda i, j: (i, j, 0))
-    with jax.enable_x64(False):
+    with x64_off():
         y = pl.pallas_call(
             _fwd_kernel,
             grid=grid,
@@ -84,13 +84,13 @@ def _fused_fwd(x3, m3, heads, interpret, rows):
     return y[:, :sq]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("interpret", "rows"))
 def _fused_fwd_tri(x3, interpret, rows):
     bh, sq, sk = x3.shape
     x3p = pad_to_block(x3, rows, axis=1)
     sqp = x3p.shape[1]
     spec = pl.BlockSpec((1, rows, sk), lambda i, j: (i, j, 0))
-    with jax.enable_x64(False):
+    with x64_off():
         y = pl.pallas_call(
             functools.partial(_fwd_tri_kernel, rows=rows),
             grid=(bh, sqp // rows),
@@ -102,13 +102,13 @@ def _fused_fwd_tri(x3, interpret, rows):
     return y[:, :sq]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("interpret", "rows"))
 def _fused_bwd(y3, dy3, interpret, rows):
     bh, sq, sk = y3.shape
     y3p = pad_to_block(y3, rows, axis=1)
     sqp = y3p.shape[1]
     spec = pl.BlockSpec((1, rows, sk), lambda i, j: (i, j, 0))
-    with jax.enable_x64(False):
+    with x64_off():
         dx = pl.pallas_call(
             _bwd_kernel,
             grid=(bh, sqp // rows),

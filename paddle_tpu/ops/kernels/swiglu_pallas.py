@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import pad_to_block, pick_row_block
+from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
 
 
 def _pick_rows(n_rows, hidden):
@@ -77,13 +77,13 @@ def _bwd_packed_kernel(x_ref, dy_ref, dx_ref, *, hidden):
     dx_ref[...] = jnp.concatenate([dg, du], axis=-1).astype(dx_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("interpret", "rows"))
 def _fused_fwd(g2, u2, interpret, rows):
     n, h = g2.shape
     g2p = pad_to_block(g2, rows)
     np_ = g2p.shape[0]
     spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
-    with jax.enable_x64(False):
+    with x64_off():
         y = pl.pallas_call(
             _fwd_kernel,
             grid=(np_ // rows,),
@@ -95,13 +95,13 @@ def _fused_fwd(g2, u2, interpret, rows):
     return y[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("interpret", "rows"))
 def _fused_fwd_packed(x2, interpret, rows):
     n, h2 = x2.shape
     h = h2 // 2
     x2p = pad_to_block(x2, rows)
     np_ = x2p.shape[0]
-    with jax.enable_x64(False):
+    with x64_off():
         y = pl.pallas_call(
             functools.partial(_fwd_packed_kernel, hidden=h),
             grid=(np_ // rows,),
@@ -113,13 +113,13 @@ def _fused_fwd_packed(x2, interpret, rows):
     return y[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("interpret", "rows"))
 def _fused_bwd(g2, u2, dy2, interpret, rows):
     n, h = g2.shape
     g2p = pad_to_block(g2, rows)
     np_ = g2p.shape[0]
     spec = pl.BlockSpec((rows, h), lambda i: (i, 0))
-    with jax.enable_x64(False):
+    with x64_off():
         dg, du = pl.pallas_call(
             _bwd_kernel,
             grid=(np_ // rows,),
@@ -132,13 +132,13 @@ def _fused_bwd(g2, u2, dy2, interpret, rows):
     return dg[:n], du[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "rows"))
+@functools.partial(jit_x64_off, static_argnames=("interpret", "rows"))
 def _fused_bwd_packed(x2, dy2, interpret, rows):
     n, h2 = x2.shape
     h = h2 // 2
     x2p = pad_to_block(x2, rows)
     np_ = x2p.shape[0]
-    with jax.enable_x64(False):
+    with x64_off():
         dx = pl.pallas_call(
             functools.partial(_bwd_packed_kernel, hidden=h),
             grid=(np_ // rows,),

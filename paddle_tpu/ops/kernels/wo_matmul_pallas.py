@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._common import pad_to_block, pick_row_block
+from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
 
 _VMEM_BUDGET = 10 * 1024 * 1024  # bytes: x + w + out + acc blocks
 
@@ -63,7 +63,7 @@ def _pick_blocks(m, k, n, itemsize):
     return bm, bn
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jit_x64_off, static_argnames=("interpret",))
 def wo_int8_matmul(x, w_q, scales, interpret=False):
     """[.., K] @ int8 [K, N] * scales -> [.., N] in x.dtype.
 
@@ -108,7 +108,7 @@ def wo_int8_matmul(x, w_q, scales, interpret=False):
         s_p = pad_to_block(scales.reshape(1, n), bn, axis=1)
         s_spec = pl.BlockSpec((1, bn), lambda mi, ni: (0, ni))
 
-    with jax.enable_x64(False):
+    with x64_off():
         out = pl.pallas_call(
             kern,
             grid=(mp // bm, np_ // bn),
@@ -206,7 +206,7 @@ def _pick_blocks_int4(m, k, itemsize):
     return bm, bn
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jit_x64_off, static_argnames=("interpret",))
 def wo_int4_matmul(x, w_packed, scales, interpret=False):
     """[.., K] @ int4-packed [K, N/2] * scales [N] -> [.., N] in x.dtype.
 
@@ -237,7 +237,7 @@ def wo_int4_matmul(x, w_packed, scales, interpret=False):
     s_hi = pad_to_block(scales[half:].reshape(1, half), bn, axis=1)
     mp, hp = x2.shape[0], w_p.shape[1]
 
-    with jax.enable_x64(False):
+    with x64_off():
         out_lo, out_hi = pl.pallas_call(
             _wo4_kernel,
             grid=(mp // bm, hp // bn),

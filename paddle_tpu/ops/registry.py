@@ -53,6 +53,7 @@ def _collect(module, prefix, kind, records, predicate):
 def _surface_cached() -> tuple:
     import paddle_tpu as paddle
     import paddle_tpu.analysis as analysis
+    import paddle_tpu.incubate.nn.functional as incubate_F
     import paddle_tpu.analysis.graph as analysis_graph
     import paddle_tpu.io as io_mod
     import paddle_tpu.jit as jit
@@ -74,6 +75,12 @@ def _surface_cached() -> tuple:
              lambda o: inspect.isfunction(o))
     _collect(F, "paddle.nn.functional", "functional", records,
              lambda o: inspect.isfunction(o))
+    # fused-op surface: the incubate functional namespace carries the
+    # fusion kernels' public entries (fused_dropout_add, the transformer
+    # block ops, weight-only linears) — serving/model code programs
+    # against these signatures, so they are contracts like core ops
+    _collect(incubate_F, "paddle.incubate.nn.functional", "functional",
+             records, lambda o: inspect.isfunction(o))
     _collect(nn, "paddle.nn", "layer", records,
              lambda o: inspect.isclass(o))
     # compilation + static-analysis surfaces: to_static's kwargs (lint,

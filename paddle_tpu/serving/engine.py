@@ -67,6 +67,9 @@ class ServingConfig:
     eos_token_id: int | None = None
     quant: str | None = None     # None | weight_only_int8 | weight_only_int4
     quant_group_size: int = -1
+    fused_block: bool = True     # block_decode_epilogue mega-kernel in the
+    #                              decode/prefill programs (TPU; shape-
+    #                              static, zero-retrace preserved)
     dtype: str = "float32"       # KV pool dtype
     seed: int = 0
     donate_state: bool = False   # donate pool/weights into the programs
@@ -93,7 +96,8 @@ class LLMEngine:
             cfg = replace(cfg, **overrides)
         self.config = cfg
         self._sm = ServingModel(model, quant=cfg.quant,
-                                quant_group_size=cfg.quant_group_size)
+                                quant_group_size=cfg.quant_group_size,
+                                fused_block=cfg.fused_block)
         max_seq = cfg.max_seq_len or self._sm.max_pos
         if max_seq > self._sm.max_pos:
             raise ValueError(

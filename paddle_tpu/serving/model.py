@@ -58,7 +58,7 @@ class ServingModel:
     """
 
     def __init__(self, model, quant: str | None = None,
-                 quant_group_size: int = -1):
+                 quant_group_size: int = -1, fused_block: bool = True):
         self.model = model
         cfg = getattr(model, "cfg", None)
         missing = [n for n in ("embed_tokens", "layers") if
@@ -87,6 +87,14 @@ class ServingModel:
         self.head_dim = cfg.head_dim
         self.max_pos = cfg.max_position_embeddings
         self.pool: kv_cache.PagePool | None = None
+        # fused decode epilogue (block_fused_pallas.decode_epilogue) needs
+        # the final norm + head EXPOSED as attributes so the last junction
+        # can fold the norm in and the head skip its own; a model carrying
+        # only an opaque _head keeps the per-op tail
+        self._fused_block = bool(fused_block) and \
+            getattr(model, "norm", None) is not None and \
+            (getattr(model, "lm_head", None) is not None
+             or getattr(cfg, "tie_word_embeddings", False))
 
         self._quant_dtype = None
         self._qweights: dict = {}
@@ -189,6 +197,44 @@ class ServingModel:
                                     layer.post_attention_layernorm._epsilon)
         return h + self._mlp(i, layer.mlp, y)
 
+    # -- fused-block (mega-kernel) serving path ------------------------------
+
+    def _fused_active(self) -> bool:
+        """Decode-epilogue mega-kernel gate: ``ServingConfig(fused_block=)``
+        AND the Pallas kernels dispatching (TPU / interpret tests). Off,
+        the per-op loops below run byte-identically to before."""
+        from ..core.flags import flag
+        from ..ops.kernels import _common as kern
+        return (self._fused_block and kern.available()
+                and flag("use_pallas_kernels") and flag("use_fused_blocks"))
+
+    def _junction(self, x, residual, norm_mod):
+        """(normed, h): one residual junction as a single
+        ``block_decode_epilogue`` Pallas pass (projection output ->
+        residual add -> rmsnorm). Shape-static — per-request variation
+        stays in values, so the compiled decode program never retraces."""
+        from ..autograd.function import apply_multi
+        from ..ops.kernels import _common as kern
+        from ..ops.kernels import block_fused_pallas as bfp
+        eps = norm_mod._epsilon
+        if bfp.use_kernel(tuple(x.shape), tuple(residual.shape)):
+            fn = lambda a, r, w: bfp.decode_epilogue(  # noqa: E731
+                a, r, w, eps, kern.interpret_mode())
+        else:  # tiny batches below the kernel's amortization floor
+            fn = lambda a, r, w: bfp.reference_fused_epilogue(  # noqa: E731
+                a, r, w, None, 0, 0.0, eps, None, "rms")
+        return apply_multi(fn, x, residual, norm_mod.weight,
+                           name="serving_decode_epilogue")
+
+    def _head_normed(self, x):
+        """lm head over an ALREADY-normalized hidden state (the fused
+        path's last junction folded the final norm in)."""
+        m = self.model
+        if getattr(m, "lm_head", None) is not None:
+            return m.lm_head(x)
+        import paddle_tpu as paddle
+        return paddle.matmul(x, m.embed_tokens.weight, transpose_y=True)
+
     # -- decode --------------------------------------------------------------
 
     def decode_forward(self, tokens, positions, tables):
@@ -213,9 +259,13 @@ class ServingModel:
         cos = Tensor(cos_f._data[0, pos][:, None])      # [B, 1, 1, D]
         sin = Tensor(sin_f._data[0, pos][:, None])
 
+        layers = list(self.model.layers)
+        fused = self._fused_active()
         x = self.model.embed_tokens(Tensor(tokens._data.reshape(b, 1)))
-        for i, layer in enumerate(self.model.layers):
-            h = layer.input_layernorm(x)
+        hres = x
+        y = layers[0].input_layernorm(x) if fused else None
+        for i, layer in enumerate(layers):
+            h = y if fused else layer.input_layernorm(x)
             q, k, v = self._qkv(i, layer, h, b, 1)
             q, k = F.rope(q, k, sin, cos)
             kp = kv_cache.write_token(pool.k._data, i, page_ids, slots,
@@ -231,8 +281,19 @@ class ServingModel:
                 "o", i, Tensor(out.reshape(b, 1,
                                            self.n_head * self.head_dim)),
                 layer.self_attn.o_proj)
-            x = self._block_tail(i, layer, x, attn_out)
-        logits = self._head(x)
+            if fused:
+                # both residual junctions of the decode step are single
+                # block_decode_epilogue passes; the final model norm folds
+                # into the LAST layer's MLP junction
+                y, hres = self._junction(attn_out, hres,
+                                         layer.post_attention_layernorm)
+                m = self._mlp(i, layer.mlp, y)
+                nxt = layers[i + 1].input_layernorm if i + 1 < len(layers) \
+                    else self.model.norm
+                y, hres = self._junction(m, hres, nxt)
+            else:
+                x = self._block_tail(i, layer, x, attn_out)
+        logits = self._head_normed(y) if fused else self._head(x)
         return Tensor(logits._data[:, 0, :])
 
     # -- prefill -------------------------------------------------------------
@@ -257,9 +318,13 @@ class ServingModel:
         cos = Tensor(cos_f._data[:, :n])
         sin = Tensor(sin_f._data[:, :n])
 
+        layers = list(self.model.layers)
+        fused = self._fused_active()
         x = self.model.embed_tokens(tokens)
-        for i, layer in enumerate(self.model.layers):
-            h = layer.input_layernorm(x)
+        hres = x
+        y = layers[0].input_layernorm(x) if fused else None
+        for i, layer in enumerate(layers):
+            h = y if fused else layer.input_layernorm(x)
             q, k, v = self._qkv(i, layer, h, 1, n)
             q, k = F.rope(q, k, sin, cos)
             pool.k._data = kv_cache.write_prefill(
@@ -272,9 +337,18 @@ class ServingModel:
             attn_out = self._linear(
                 "o", i, out.reshape([1, n, self.n_head * self.head_dim]),
                 layer.self_attn.o_proj)
-            x = self._block_tail(i, layer, x, attn_out)
+            if fused:
+                y, hres = self._junction(attn_out, hres,
+                                         layer.post_attention_layernorm)
+                m = self._mlp(i, layer.mlp, y)
+                nxt = layers[i + 1].input_layernorm if i + 1 < len(layers) \
+                    else self.model.norm
+                y, hres = self._junction(m, hres, nxt)
+            else:
+                x = self._block_tail(i, layer, x, attn_out)
         import jax
         h_last = jax.lax.dynamic_slice_in_dim(
-            x._data, plen - 1, 1, axis=1)               # [1, 1, H]
-        logits = self._head(Tensor(h_last))
+            (y if fused else x)._data, plen - 1, 1, axis=1)  # [1, 1, H]
+        last = Tensor(h_last)
+        logits = self._head_normed(last) if fused else self._head(last)
         return Tensor(logits._data[:, 0, :])

@@ -1,0 +1,531 @@
+"""Transformer-block mega-kernel epilogues (ops/kernels/block_fused_pallas).
+
+Interpret-mode parity (forward AND backward) vs the unfused composites for
+all three fused blocks, dropout-mask regeneration under remat/recompute,
+AMP bf16 + GradScaler training, the GPT/Llama fused trunks, the serving
+decode epilogue's zero-retrace + token parity, and the analyzer's
+``fused`` marker closing the fusion_targets loop.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.kernels import _common as kern
+from paddle_tpu.ops.kernels import block_fused_pallas as bf
+
+
+@pytest.fixture
+def interpret():
+    kern.force_interpret(True)
+    try:
+        yield
+    finally:
+        kern.force_interpret(False)
+
+
+def _mk(shape, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape), dtype)
+
+
+CASES = [
+    (None, "rms", 0.0, False),
+    (None, "rms", 0.3, False),
+    (None, "layer", 0.3, True),
+    ("gelu", "layer", 0.2, True),
+    ("gelu", "rms", 0.0, False),
+    ("swiglu", "rms", 0.4, False),
+    ("swiglu", "layer", 0.0, True),
+]
+
+
+@pytest.mark.parametrize("act,norm,p,bias_on", CASES)
+def test_epilogue_parity_fwd_bwd(act, norm, p, bias_on):
+    """The fused kernel must match the identical-semantics composite:
+    forward bit-close, every gradient (x, residual, weight, bias, and the
+    h-stream cotangent join) within documented atol."""
+    hd = 128
+    xw = hd * 2 if act == "swiglu" else hd
+    x = _mk((3, 17, xw), 0)
+    res = _mk((3, 17, hd), 1)
+    w = _mk((hd,), 2)
+    b = _mk((hd,), 3) if bias_on else None
+    seed = jnp.int32(42)
+
+    y, h = bf.fused_epilogue(x, res, w, b, seed, p, 1e-5, act, norm,
+                             None, True)
+    yr, hr = bf.reference_fused_epilogue(x, res, w, b, seed, p, 1e-5,
+                                         act, norm)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               atol=2e-6, rtol=2e-6)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(hr),
+                               atol=2e-6, rtol=2e-6)
+
+    def loss(impl):
+        def f(x, res, w, *bb):
+            bb = bb[0] if bb else None
+            y, hh = impl(x, res, w, bb)
+            # y AND h both consumed: the vjp must route the h-stream
+            # cotangent through the dropout/activation chain too
+            return jnp.sum(y ** 2) + jnp.sum(jnp.sin(hh))
+        return f
+
+    kern_f = loss(lambda *a: bf.fused_epilogue(*a, seed, p, 1e-5, act,
+                                               norm, None, True))
+    ref_f = loss(lambda *a: bf.reference_fused_epilogue(*a, seed, p, 1e-5,
+                                                        act, norm))
+    args = (x, res, w) + ((b,) if bias_on else ())
+    nums = tuple(range(len(args)))
+    gk = jax.grad(kern_f, argnums=nums)(*args)
+    gr = jax.grad(ref_f, argnums=nums)(*args)
+    for a, r in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   atol=3e-4, rtol=2e-4)
+
+
+def test_epilogue_mask_is_dropout_add_stream():
+    """The fused dropout uses the SAME counter-hash stream as
+    dropout_add_pallas: h must equal reference_dropout_add(x, res) under
+    one seed, and the kept-element pattern must be identical."""
+    from paddle_tpu.ops.kernels import dropout_add_pallas as dak
+    x = _mk((40, 192), 5)
+    res = _mk((40, 192), 6)
+    seed = jnp.int32(1234)
+    _, h = bf.fused_epilogue(x, res, jnp.ones(192, jnp.float32), None,
+                             seed, 0.3, 1e-6, None, "rms", None, True)
+    want = dak.reference_dropout_add(x, res, seed, 0.3)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(want),
+                               atol=1e-6, rtol=1e-6)
+    kept = np.asarray(h - res) != 0.0
+    assert abs(kept.mean() - 0.7) < 0.05
+
+
+def test_remat_replays_identical_mask():
+    """jax.remat re-runs the forward with the SAME seed operand — the
+    regenerated mask is bit-identical, so recompute-wrapped training
+    cannot diverge from the unwrapped step."""
+    x = _mk((4, 16, 128), 7)
+    res = _mk((4, 16, 128), 8)
+    w = jnp.ones(128, jnp.float32)
+
+    def f(x, res, w):
+        y, h = bf.fused_epilogue(x, res, w, None, jnp.int32(7), 0.3, 1e-5,
+                                 None, "rms", None, True)
+        return jnp.sum(y * y) + jnp.sum(h)
+
+    g1 = jax.grad(f, argnums=(0, 1, 2))(x, res, w)
+    g2 = jax.grad(jax.remat(f), argnums=(0, 1, 2))(x, res, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fleet_recompute_fused_block(interpret):
+    """fleet.recompute over a layer built on fused_dropout_add_norm
+    (p>0, fixed seed): rematerialization must regenerate the same mask —
+    grads identical to the plain forward."""
+    from paddle_tpu.distributed.fleet import recompute
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(11)
+
+    class Junction(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(64, 64)
+            self.w = self.create_parameter(
+                [64], default_initializer=nn.initializer.Constant(1.0))
+
+        def forward(self, x):
+            y, h = F.fused_dropout_add_norm(
+                self.lin(x), x, self.w, p=0.25, epsilon=1e-5, norm="rms",
+                seed=99)
+            return y + h
+
+    blk = Junction()
+    x = paddle.randn([4, 8, 64])
+    x.stop_gradient = False
+    recompute(blk, x).sum().backward()
+    g_re = x.grad.numpy().copy()
+    wg_re = blk.lin.weight.grad.numpy().copy()
+
+    x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    blk.clear_gradients()
+    blk(x2).sum().backward()
+    np.testing.assert_allclose(g_re, x2.grad.numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(wg_re, blk.lin.weight.grad.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grad_scaler_bf16_autocast(interpret):
+    """Fused-block gradients under GradScaler + bf16 autocast: the kernel
+    computes in f32 and casts back, so scaled bf16 training stays finite
+    and unscales to the f32 composite's grads."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(3)
+    lin = nn.Linear(64, 64)
+    w = paddle.create_parameter(
+        [64], "float32", default_initializer=nn.initializer.Constant(1.0))
+    opt = paddle.optimizer.SGD(0.0, parameters=list(lin.parameters()) + [w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=256.0)
+    x = paddle.randn([4, 8, 64])
+
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        y, h = F.fused_dropout_add_norm(lin(x), x, w, p=0.0,
+                                        epsilon=1e-5, norm="rms")
+        loss = (y.cast("float32") ** 2).mean() + h.cast("float32").mean()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    g_amp = lin.weight.grad.numpy()
+    assert np.isfinite(g_amp).all() and np.abs(g_amp).max() > 0
+
+    # f32 composite reference of the same loss
+    lin.clear_gradients()
+    w.clear_gradient()
+    y2, h2 = F.fused_dropout_add_norm(lin(x), x, w, p=0.0,
+                                      epsilon=1e-5, norm="rms")
+    ((y2 ** 2).mean() + h2.mean()).backward()
+    np.testing.assert_allclose(g_amp, lin.weight.grad.numpy(),
+                               atol=2e-2, rtol=2e-1)
+    scaler.step(opt)
+    scaler.update()
+
+
+def test_public_functional_dispatches(interpret, monkeypatch):
+    """F.fused_dropout_add_norm must actually reach the Pallas kernel
+    when available, and the composite otherwise."""
+    from paddle_tpu.nn import functional as F
+    calls = {"n": 0}
+    orig = bf.fused_epilogue
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(bf, "fused_epilogue", spy)
+    x = paddle.randn([4, 8, 128])
+    r = paddle.randn([4, 8, 128])
+    w = paddle.ones([128])
+    y, h = F.fused_dropout_add_norm(x, r, w, p=0.1, epsilon=1e-5,
+                                    norm="rms", seed=5)
+    assert calls["n"] == 1
+    # identical-semantics composite
+    yr, hr = bf.reference_fused_epilogue(x._data, r._data, w._data, None,
+                                         jnp.int32(5), 0.1, 1e-5, None,
+                                         "rms")
+    np.testing.assert_allclose(y.numpy(), np.asarray(yr), atol=2e-6)
+    np.testing.assert_allclose(h.numpy(), np.asarray(hr), atol=2e-6)
+
+
+def test_functional_rejects_bad_combos():
+    from paddle_tpu.nn import functional as F
+    x = paddle.randn([2, 4, 128])
+    w = paddle.ones([128])
+    b = paddle.zeros([128])
+    with pytest.raises(ValueError):
+        F.fused_dropout_add_norm(x, x, w, b, norm="rms")   # rms takes no bias
+    with pytest.raises(ValueError):
+        F.fused_dropout_add_norm(x, x, w, norm="nope")
+    with pytest.raises(ValueError):
+        F.fused_dropout_add_norm(x, x, w, activation="relu")
+
+
+# -- model adoption ----------------------------------------------------------
+
+def test_gpt_fused_trunk_parity(interpret):
+    """GPT's mega-kernel trunk (both junctions + folded ln_f) must match
+    the composite layer loop, and FLAGS_use_fused_blocks=0 must restore
+    the per-op loop."""
+    from paddle_tpu.models import gpt2_tiny
+    paddle.seed(0)
+    m = gpt2_tiny()
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, 1024, (2, 32)).astype(np.int32))
+    assert m._use_fused_blocks()
+    fused = m(ids).numpy()
+    paddle.set_flags({"use_fused_blocks": 0})
+    try:
+        assert not m._use_fused_blocks()
+        unfused = m(ids).numpy()
+    finally:
+        paddle.set_flags({"use_fused_blocks": 1})
+    np.testing.assert_allclose(fused, unfused, atol=3e-4, rtol=3e-4)
+
+
+def test_llama_fused_trunk_parity(interpret):
+    """Llama trunk: attention AND MLP junctions fused, MLP junction folds
+    the NEXT layer's input norm (final norm for the last layer)."""
+    from paddle_tpu.models import llama_tiny
+    paddle.seed(0)
+    m = llama_tiny()
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.default_rng(1).integers(0, 512, (1, 16)).astype(np.int32))
+    fused = m(ids).numpy()
+    paddle.set_flags({"use_fused_blocks": 0})
+    try:
+        unfused = m(ids).numpy()
+    finally:
+        paddle.set_flags({"use_fused_blocks": 1})
+    np.testing.assert_allclose(fused, unfused, atol=3e-4, rtol=3e-4)
+
+
+@pytest.mark.slow
+def test_gpt_fused_train_step_to_static(interpret):
+    """The canonical compiled train step (to_static + loss.backward +
+    fused optimizer) runs end-to-end through the fused trunk and learns."""
+    from paddle_tpu.models import gpt2_tiny
+    paddle.seed(0)
+    model = gpt2_tiny()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters(),
+                                 weight_decay=0.1)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1024, (2, 33))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(train_step(x, y)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
+# -- serving decode epilogue -------------------------------------------------
+
+def test_serving_fused_decode_token_exact_zero_retrace(interpret):
+    """ServingConfig(fused_block=True): decode through
+    block_decode_epilogue generates the SAME tokens as the composite
+    engine, compiles its decode program exactly once across join/leave,
+    and leaks no KV pages."""
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.serving import LLMEngine, ServingConfig
+
+    paddle.seed(0)
+    model = llama_tiny()
+    model.eval()
+    prompts = [[3, 5, 7, 11], [2, 4, 6], [9, 9, 1, 2, 3]]
+    cfg = dict(page_size=8, num_pages=32, max_batch=4, max_new_tokens=6,
+               max_seq_len=64)
+
+    kern.force_interpret(False)
+    try:
+        ref_eng = LLMEngine(model, ServingConfig(fused_block=False, **cfg))
+        ref = [ref_eng.generate(p) for p in prompts]
+        ref_eng.shutdown(drain=True)
+    finally:
+        kern.force_interpret(True)
+
+    eng = LLMEngine(model, ServingConfig(fused_block=True, **cfg))
+    assert eng._sm._fused_active()
+    out = [eng.generate(p) for p in prompts]
+    stats = eng.program_stats()
+    summary = eng.shutdown(drain=True)
+    assert out == ref
+    assert stats["decode"]["compiles"] == 1
+    assert stats["decode"]["retraces"] == 0
+    assert summary["pages_leaked"] == 0
+
+
+def test_serving_fused_flag_off_is_per_op_path():
+    """fused_block=False (or kernels unavailable) keeps the original
+    per-op decode structure — _fused_active is False on CPU."""
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.serving.model import ServingModel
+    sm = ServingModel(llama_tiny(), fused_block=True)
+    assert not sm._fused_active()   # no TPU, no interpret hook
+    sm2 = ServingModel(llama_tiny(), fused_block=False)
+    assert not sm2._fused_active()
+
+
+# -- analyzer integration: the `fused` marker --------------------------------
+
+def _forced_gpt_graph():
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models import gpt2_tiny
+    from paddle_tpu.analysis.graph.ir import build_graph
+
+    paddle.seed(0)
+    model = gpt2_tiny(num_layers=2, hidden_size=128,
+                      max_position_embeddings=128)
+    model.eval()
+    ids = jnp.zeros((2, 64), jnp.int32)
+
+    def fwd(ids):
+        return model(Tensor(ids))._data
+
+    kern.force_dispatch(True)
+    try:
+        cj = jax.jit(fwd).trace(ids).jaxpr
+    finally:
+        kern.force_dispatch(False)
+    return build_graph(cj)
+
+
+def test_candidates_containing_block_kernels_marked_fused():
+    """A candidate whose region is a block_*_epilogue pallas_call carries
+    fused=True; the flash+epilogue cluster is named 'attention'."""
+    from paddle_tpu.analysis.graph.fusion import (fusion_candidates,
+                                                  fusion_groups,
+                                                  is_mega_kernel)
+    assert is_mega_kernel("block_attn_epilogue")
+    assert is_mega_kernel("block_decode_epilogue_bwd")
+    assert not is_mega_kernel("_attn_kernel")
+
+    g = _forced_gpt_graph()
+    groups, node_group = fusion_groups(g)
+    cands = fusion_candidates(g, groups, node_group, min_bytes=1)
+    fused = [c for c in cands if c.fused]
+    assert fused, "no candidate recognized the block kernels"
+    assert any(c.name == "attention" for c in fused)
+    assert all(any("block_" in str(grp.first.name or "")
+                   for grp in c.groups if grp.kind == "breaker")
+               for c in fused)
+    # to_dict carries the marker for join_measured / the bench table
+    assert all("fused" in c.to_dict() for c in cands)
+
+
+def test_ga100_excludes_harvested_candidates():
+    """GA100 findings rank only the REMAINING candidates: a harvested
+    (fused) cluster must not keep advertising its bytes."""
+    from paddle_tpu.analysis.graph import analyze_graph
+    g = _forced_gpt_graph()
+    report = analyze_graph(g, name="gpt-forced")
+    fused_spans = {f"{c.file}:{c.line}" for c in report.candidates
+                   if c.fused}
+    ga100 = [f for f in report.findings if f.rule_id == "GA100"]
+    assert ga100, "expected remaining GA100 findings"
+    for f in ga100:
+        assert f"{f.file}:{f.line}" not in fused_spans or \
+            any(not c.fused and c.file == f.file and c.line == f.line
+                for c in report.candidates)
+    # top_candidates keeps the harvested rows, marked
+    tops = report.top_candidates(len(report.candidates))
+    assert any(t["fused"] for t in tops)
+
+
+def test_join_measured_passes_fused_through():
+    from paddle_tpu.analysis.graph import analyze_graph, join_measured
+    g = _forced_gpt_graph()
+    report = analyze_graph(g, name="gpt-forced")
+    rows = join_measured(report, measured_ms=10.0, program="p")
+    assert any(r["fused"] for r in rows)
+    assert all("measured_ms_share" in r for r in rows)
+
+
+def test_render_targets_marks_fused_rows():
+    from paddle_tpu.observability.continuous.reconcile import render_targets
+    txt = render_targets([
+        {"name": "attention", "fused": True, "sites": 4,
+         "est_saved_bytes": 1 << 20, "measured_ms_share": 5.0,
+         "program": "p"},
+        {"name": "gelu", "sites": 2, "est_saved_bytes": 2 << 20,
+         "measured_ms_share": 3.0, "program": "p"}])
+    assert "attention [fused]" in txt
+    assert "gelu" in txt and "gelu [fused]" not in txt
+
+
+@pytest.mark.slow
+def test_reconcile_views_show_harvested_delta():
+    """End-to-end static->measured loop: profile a compiled train step,
+    reconcile — the as-fused view marks the attention cluster fused while
+    the composite 'before' view still advertises it."""
+    from paddle_tpu.models import gpt2_tiny
+    from paddle_tpu.observability import continuous as cont
+
+    paddle.seed(0)
+    model = gpt2_tiny(num_layers=2, hidden_size=128,
+                      max_position_embeddings=128)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1024, (2, 65))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    prof = cont.get_profiler()
+    prof.reset(every=2)
+    prof.auto_reconcile = False
+    try:
+        for i in range(6):
+            train_step(x, y)
+            cont.on_step(i)
+    finally:
+        cont.stop()
+    after = cont.fusion_targets(top=5, with_unfused=True)
+    before = cont.last_unfused_reconciliation()
+    assert any(t["fused"] and t["name"] == "attention" and
+               t["measured_ms_share"] > 0 for t in after), after
+    assert before and all(not t["fused"] for t in before)
+    # the delta: the before view's top remaining entry advertises more
+    # bytes than the after view's top remaining one
+    rem_after = max((t["est_saved_bytes"] for t in after
+                     if not t["fused"]), default=0)
+    rem_before = max(t["est_saved_bytes"] for t in before)
+    assert rem_before >= rem_after
+
+
+# -- perf gate ---------------------------------------------------------------
+
+def _perf_gate():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "perf_gate.py")
+    spec = importlib.util.spec_from_file_location("perf_gate_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_gate_fusion_applied():
+    pg = _perf_gate()
+    harvested = {"extra": {"fusion_targets": [
+        {"name": "attention", "fused": True, "est_saved_bytes": 50 << 20,
+         "sites": 4, "measured_ms_share": 100.0},
+        {"name": "gelu", "fused": False, "est_saved_bytes": 16 << 20,
+         "sites": 4, "measured_ms_share": 30.0}]}}
+    unapplied = {"extra": {"fusion_targets": [
+        {"name": "attention", "fused": False,
+         "est_saved_bytes": 50 << 20, "sites": 4,
+         "measured_ms_share": 100.0}]}}
+    assert pg.fusion_applied_gate(harvested) == []
+    fails = pg.fusion_applied_gate(unapplied)
+    assert len(fails) == 1 and "REGRESSION:fusion" in fails[0]
+    assert pg.fusion_applied_gate({"extra": {}}) == []
+    # env ceiling 0 disables
+    import os
+    os.environ["PERF_GATE_FUSION_MAX_MIB"] = "0"
+    try:
+        assert pg.fusion_applied_gate(unapplied) == []
+    finally:
+        del os.environ["PERF_GATE_FUSION_MAX_MIB"]
+
+
+def test_use_kernel_gate():
+    assert bf.use_kernel((4, 8, 128), (4, 8, 128))
+    assert bf.use_kernel((4, 8, 256), (4, 8, 128), act="swiglu")
+    assert not bf.use_kernel((4, 8, 128), (4, 8, 128), act="swiglu")
+    assert not bf.use_kernel((4, 8, 130), (4, 8, 65), act="swiglu")  # lanes
+    assert not bf.use_kernel((128,), (128,))            # needs >= 2 dims
+    assert not bf.use_kernel((2, 2, 64), (2, 2, 64))    # below floor
+    assert not bf.use_kernel((4, 8, 128), (4, 4, 128))  # row mismatch

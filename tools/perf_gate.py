@@ -43,6 +43,11 @@ The serving runtime (``extra.serve``, from `bench.py serve` or the full
 run) adds two HARD gates — any decode-program retrace after warmup and
 any leaked KV page fail the round — plus a soft serve-tokens/s
 comparison (PERF_GATE_SERVE_TOL_PCT, default 30%).
+
+The mega-kernel harvest (``extra.fusion_targets``) adds a soft gate: the
+top remaining (not ``fused``) target's est_saved_bytes must stay below
+the pre-PR attention cluster (PERF_GATE_FUSION_MAX_MIB, default 48) —
+i.e. the block fusion stays applied round over round.
 """
 
 from __future__ import annotations
@@ -290,6 +295,43 @@ def soft_gates(cd, bd):
     return fails
 
 
+def fusion_applied_gate(cd):
+    """Soft gate: the block fusion must STAY applied. The top REMAINING
+    (not ``fused``) entry of ``extra.fusion_targets`` may not advertise
+    more saved bytes per site than the pre-PR attention cluster
+    (PERF_GATE_FUSION_MAX_MIB, default 48 — the cluster the mega-kernels
+    harvested). If the attention epilogue ever un-fuses (flag regression,
+    dispatch gate broken), that ~48 MiB candidate reappears at the top of
+    the remaining ranking and this gate names it. <= 0 disables; rounds
+    without a reconciled table pass."""
+    rows = fusion_targets(cd)
+    if not rows:
+        return []
+    ceiling_mib = _tol_pct("PERF_GATE_FUSION_MAX_MIB", 48.0)
+    if ceiling_mib <= 0:
+        return []
+    remaining = [t for t in rows if not t.get("fused")]
+    if not remaining:
+        print("perf gate [ok:fusion] every reconciled candidate is "
+              "harvested (all rows fused)")
+        return []
+    top = max(remaining, key=lambda t: int(t.get("est_saved_bytes", 0)))
+    top_mib = int(top.get("est_saved_bytes", 0)) / (1 << 20)
+    if top_mib > ceiling_mib:
+        return [
+            f"perf gate [REGRESSION:fusion] top remaining fusion target "
+            f"'{top.get('name', '?')}' x{top.get('sites', 1)} advertises "
+            f"{top_mib:.1f} MiB/site saved (> {ceiling_mib:g} MiB, the "
+            f"pre-PR attention cluster): a harvested mega-kernel fusion "
+            f"appears UNAPPLIED — check FLAGS_use_fused_blocks / "
+            f"use_pallas_kernels and the block_fused_pallas dispatch "
+            f"gates (tol via PERF_GATE_FUSION_MAX_MIB)"]
+    print(f"perf gate [ok:fusion] top remaining target "
+          f"'{top.get('name', '?')}' at {top_mib:.1f} MiB/site "
+          f"(ceiling {ceiling_mib:g} MiB)")
+    return []
+
+
 def serve_block(d):
     """``extra.serve`` — the serving-runtime bench section (None when the
     round predates the serving engine or skipped it)."""
@@ -429,6 +471,9 @@ def main():
     # soft gates over the same baseline round: step latency + peak HBM
     # (only meaningful when the metric matched — same workload shape)
     soft_fails = soft_gates(cd, bd)
+    # mega-kernel harvest gate: the top remaining fusion target must stay
+    # below the pre-PR attention cluster (the fusion stays applied)
+    soft_fails += fusion_applied_gate(cd)
     # serving runtime: hard zero-retrace/zero-leak contract + soft
     # tokens/s comparison against the same baseline round
     serve_hard, serve_soft = serve_gates(cd, bd)
