@@ -432,6 +432,83 @@ def run_llama_bench(dev):
     }
 
 
+def _plan_block(model, batch, seq, measured_step_ms, dev):
+    """Parallelism-planner round block (ROADMAP item 3 acceptance): what
+    would paddle.planner choose for this model?
+
+    Three records per round: (1) the chosen plan for the canonical
+    8-chip topology (mesh/specs summary/schedule/recompute + predicted
+    step time), (2) the rank the planner gives the repo's hand-tuned
+    multichip config (dp2 x mp2 x pp2, the hybrid_parallel_train /
+    MULTICHIP dryrun mesh) — a sanity dial: the planner should not bury
+    the config humans converged on, and if it someday should, this row
+    is the evidence, and (3) predicted-vs-measured step time for THIS
+    device at the bench's real batch (single chip, so the comparison
+    isolates the roofline compute model from the collective formulas).
+    Never fails the bench: returns {"error": ...} on any problem."""
+    try:
+        from paddle_tpu.cost_model import CHIP_PRESETS
+        from paddle_tpu.planner import ModelDesc, Topology, plan_search
+
+        desc = ModelDesc.from_model(model, seq_len=seq)
+        topo8 = "v5e:8"
+        res = plan_search(desc=desc, topology=topo8, global_batch=32,
+                          top=1)
+        best = res.best
+        block = {
+            "topology": topo8,
+            "search": {
+                "n_enumerated": res.n_enumerated,
+                "n_pruned": res.n_pruned,
+                "n_memory_rejected": res.n_memory_rejected,
+                "n_scored": res.n_scored,
+                "seconds": round(res.search_seconds, 3),
+            },
+        }
+        if best is not None:
+            block["chosen"] = {
+                "summary": best.summary(),
+                "mesh": best.mesh,
+                "micro_batches": best.schedule["micro_batches"],
+                "recompute": best.recompute["enable"],
+                "predicted_step_ms": round(
+                    best.predicted["step_time_s"] * 1e3, 3),
+                "predicted_tokens_per_s": round(
+                    best.predicted["tokens_per_s"], 1),
+                "fingerprint": best.fingerprint(),
+            }
+        hand = {"dp": 2, "mp": 2, "pp": 2}
+        rank = res.rank_of(hand)
+        block["hand_config"] = {
+            "mesh": hand, "rank": rank,
+            "of": sum(1 for s in res.scored if s.feasible)}
+        # single-chip predicted vs this round's measured step: price the
+        # current device's roofline (real peak if known, cpu preset
+        # otherwise) at the bench's actual batch
+        peak, peak_src = _peak_flops(dev)
+        cpu_preset = CHIP_PRESETS["cpu"]
+        topo1 = Topology(
+            chips=1, slice_chips=1,
+            hbm_bytes=int(cpu_preset["hbm_gb"] * (1 << 30)),
+            peak_flops=peak or cpu_preset["peak_flops"],
+            name=peak_src if peak else "cpu")
+        res1 = plan_search(desc=desc, topology=topo1, global_batch=batch,
+                           top=1)
+        if res1.best is not None:
+            pred_ms = res1.best.predicted["step_time_s"] * 1e3
+            block["single_chip"] = {
+                "predicted_step_ms": round(pred_ms, 3),
+                "measured_step_ms": measured_step_ms,
+                "predicted_vs_measured": round(
+                    pred_ms / measured_step_ms, 4)
+                if measured_step_ms else None,
+                "peak_flops_source": peak_src if peak else "cpu-preset",
+            }
+        return block
+    except Exception:
+        return {"error": traceback.format_exc(limit=2)[:500]}
+
+
 def _graph_analysis_block(model, batch, seq, vocab):
     """Static graph-tier analysis (paddle_tpu.analysis.graph) of the bench
     model: the top-3 fusion candidates ranked by estimated saved HBM bytes
@@ -527,6 +604,8 @@ def run_gpt_bench(dev, on_tpu):
             "peak_flops": peak, "peak_flops_source": peak_src,
             "graph_analysis": _graph_analysis_block(
                 model, batch, seq, cfg.vocab_size),
+            "plan": _plan_block(model, batch, seq,
+                                breakdown.get("step_ms"), dev),
             "fusion_targets": fusion_targets,
             "fusion_targets_unfused": fusion_targets_unfused,
         },

@@ -146,7 +146,8 @@ def __getattr__(name):
                 "auto_tuner", "audio", "sparse", "fft", "signal",
                 "sysconfig", "hub", "dataset", "geometric", "inference",
                 "onnx", "decomposition", "cost_model", "reader", "version",
-                "strings", "observability", "resilience", "serving"):
+                "strings", "observability", "resilience", "serving",
+                "planner"):
         import importlib
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod

@@ -13,50 +13,84 @@ class Candidate:
     pp: int = 1
     sharding: int = 1
     micro_batch: int = 1
+    sep: int = 1
     extra: dict = field(default_factory=dict)
 
     @property
     def world(self) -> int:
-        return self.dp * self.mp * self.pp * self.sharding
+        return self.dp * self.mp * self.pp * self.sharding * self.sep
 
     def as_hybrid_configs(self) -> dict:
         return {"dp_degree": self.dp, "mp_degree": self.mp,
                 "pp_degree": self.pp, "sharding_degree": self.sharding,
-                "sep_degree": 1}
+                "sep_degree": self.sep}
 
     def __repr__(self):
+        sep = f" sep{self.sep}" if self.sep > 1 else ""
         return (f"Candidate(dp{self.dp} mp{self.mp} pp{self.pp} "
-                f"sh{self.sharding} mb{self.micro_batch})")
+                f"sh{self.sharding} mb{self.micro_batch}{sep})")
+
+
+def _divisors(n, cap):
+    return [d for d in range(1, min(n, cap) + 1) if n % d == 0]
 
 
 def default_candidates(n_devices, max_mp=8, max_pp=8,
-                       micro_batches=(1,)):
-    """Every (dp, mp, pp, sharding) factorization of n_devices (the
-    reference's search space builder, auto_tuner/utils.py)."""
+                       micro_batches=(1,), max_sep=1):
+    """Every (dp, mp, pp, sharding[, sep]) factorization of n_devices (the
+    reference's search space builder, auto_tuner/utils.py). ``max_sep > 1``
+    also enumerates the sequence-parallel axis (planner search space).
+    Only divisors of ``n_devices`` are visited per axis, so enumeration
+    stays in the thousands even for pod-scale chip counts."""
     out = []
-    for mp, pp in itertools.product(range(1, max_mp + 1),
-                                    range(1, max_pp + 1)):
-        if n_devices % (mp * pp):
+    for mp, pp, sep in itertools.product(_divisors(n_devices, max_mp),
+                                         _divisors(n_devices, max_pp),
+                                         _divisors(n_devices, max_sep)):
+        if n_devices % (mp * pp * sep):
             continue
-        rest = n_devices // (mp * pp)
-        for sharding in (d for d in range(1, rest + 1) if rest % d == 0):
+        rest = n_devices // (mp * pp * sep)
+        for sharding in _divisors(rest, rest):
             dp = rest // sharding
             for mb in micro_batches:
                 out.append(Candidate(dp=dp, mp=mp, pp=pp,
-                                     sharding=sharding, micro_batch=mb))
+                                     sharding=sharding, micro_batch=mb,
+                                     sep=sep))
     return out
 
 
 def prune_by_divisibility(candidates, num_layers=None, num_heads=None,
-                          global_batch=None):
-    """Reference prune rules: mp must divide heads, pp must divide layers,
-    dp*sharding*micro_batch must divide the global batch."""
+                          global_batch=None, num_kv_heads=None,
+                          vocab_size=None, seq_len=None):
+    """Reference prune rules plus the sharded-embedding/GQA constraints:
+
+    * mp must divide ``num_heads`` AND ``num_kv_heads`` — GQA models shard
+      the kv heads, not the query heads, so an mp that only divides the
+      query heads would split a kv head across chips;
+    * mp must divide ``vocab_size`` — the vocab-parallel embedding and the
+      sharded LM head split the vocab dim over mp;
+    * pp must divide ``num_layers``; sep must divide ``seq_len`` and
+      ``num_heads`` AND ``num_kv_heads`` (Ulysses re-shards seq <-> heads
+      over sep — the head-sharded phase hits the same GQA constraint mp
+      does);
+    * dp*sharding*micro_batch must divide the global batch.
+    """
     kept = []
     for c in candidates:
         if num_heads is not None and num_heads % c.mp:
             continue
+        if num_kv_heads is not None and num_kv_heads % c.mp:
+            continue
+        if vocab_size is not None and vocab_size % c.mp:
+            continue
         if num_layers is not None and num_layers % c.pp:
             continue
+        if c.sep > 1:
+            if seq_len is not None and seq_len % c.sep:
+                continue
+            if num_heads is not None and num_heads % c.sep:
+                continue
+            if num_kv_heads is not None and num_kv_heads % c.sep:
+                continue
         if global_batch is not None and \
                 global_batch % (c.dp * c.sharding * c.micro_batch):
             continue
@@ -64,16 +98,35 @@ def prune_by_divisibility(candidates, num_layers=None, num_heads=None,
     return kept
 
 
+def run_timed_trial(step, args, steps=3, warmup=1):
+    """Seconds per execution of a real train step: `warmup` untimed runs,
+    then `steps` timed ones, device-synced via the loss read-back before
+    AND after the timed window (the async dispatch must be drained or the
+    timer measures enqueue cost). The ONE timing protocol both the
+    auto-tuner's measured mode and the planner's refinement use — fixes
+    to the drain semantics land in both."""
+    import time as _time
+
+    loss = None
+    for _ in range(max(warmup, 0)):
+        loss = step(*args)
+    if loss is not None:
+        float(loss)
+    t0 = _time.perf_counter()
+    for _ in range(max(steps, 1)):
+        loss = step(*args)
+    if loss is not None:
+        float(loss)  # drain the async dispatch
+    return (_time.perf_counter() - t0) / max(steps, 1)
+
+
 def measure_compiled_step(build, steps=3, warmup=1):
     """Measured-trial mode (reference tuner.py:19 launches real trials and
     collects metrics): returns a `measure(candidate)` that initializes the
     candidate's hybrid mesh, asks `build(candidate)` for a (step, args)
     pair — `step` being the real jitted train step returning a loss Tensor
-    — and times `steps` executions after `warmup` (device-synced via the
-    loss read-back). The mesh/topology is reset after every trial so
-    candidates cannot contaminate one another."""
-    import time as _time
-
+    — and times via :func:`run_timed_trial`. The mesh/topology is reset
+    after every trial so candidates cannot contaminate one another."""
     def measure(cand):
         from ..distributed.fleet import DistributedStrategy, fleet
         from ..distributed.topology import reset_topology_state
@@ -84,17 +137,8 @@ def measure_compiled_step(build, steps=3, warmup=1):
         fleet.init(is_collective=True, strategy=strategy)
         try:
             step, args = build(cand)
-            loss = None
-            for _ in range(max(warmup, 1)):
-                loss = step(*args)
-            if loss is not None:
-                float(loss)
-            t0 = _time.perf_counter()
-            for _ in range(max(steps, 1)):
-                loss = step(*args)
-            if loss is not None:
-                float(loss)  # drain the async dispatch
-            return {"time_s": (_time.perf_counter() - t0) / max(steps, 1)}
+            return {"time_s": run_timed_trial(step, args, steps=steps,
+                                              warmup=max(warmup, 1))}
         finally:
             reset_topology_state()
 

@@ -5,15 +5,26 @@ per-op costs; static costs come from the op cost registry).
 TPU-native: the static path is XLA's own cost analysis on the compiled
 executable (flops / bytes accessed / estimated optimal seconds — better
 than a hand-maintained op cost table), and the measured path times the
-jitted callable on device."""
+jitted callable on device.
+
+:mod:`.collective` adds the ANALYTIC tier the parallelism planner scores
+with: ICI/DCN bandwidth-latency tables and alpha-beta cost formulas for
+every collective a mesh axis can imply (all-reduce / all-gather /
+reduce-scatter / all-to-all / p2p), keyed on whether the axis rides ICI
+or crosses DCN (docs/parallelism_planner.md#cost-model)."""
 
 from __future__ import annotations
 
 import time
 
 from ..decomposition import _pure_fn
+from .collective import (CHIP_PRESETS, LinkSpec, all_gather_s,  # noqa: F401
+                         all_reduce_s, all_to_all_s, chip_preset,
+                         collective_s, p2p_s, reduce_scatter_s)
 
-__all__ = ['CostModel']
+__all__ = ['CostModel', 'LinkSpec', 'CHIP_PRESETS', 'chip_preset',
+           'all_reduce_s', 'all_gather_s', 'reduce_scatter_s',
+           'all_to_all_s', 'p2p_s', 'collective_s']
 
 
 class CostModel:

@@ -1,0 +1,147 @@
+"""Alpha-beta collective cost formulas over ICI/DCN link tables.
+
+The planner (``paddle.planner``) scores every candidate mesh analytically:
+each collective a parallelism axis implies is priced with the classic
+ring-algorithm alpha-beta model
+
+    time = latency_term * alpha  +  traffic_term / bandwidth
+
+where ``alpha`` is the per-hop launch latency of the link the axis rides
+(ICI inside a slice, DCN across slices) and the traffic term is the bytes
+each participant must move on the bottleneck link. The formulas (``n`` =
+group size, ``B`` = payload bytes per participant):
+
+==============  ======================  =====================
+collective      traffic term            latency term
+==============  ======================  =====================
+all-reduce      ``2*(n-1)/n * B``       ``2*(n-1)``
+all-gather      ``(n-1)/n * B``         ``n-1``
+reduce-scatter  ``(n-1)/n * B``         ``n-1``
+all-to-all      ``(n-1)/n * B``         ``n-1``
+p2p (send)      ``B``                   ``1``
+==============  ======================  =====================
+
+(all-reduce = reduce-scatter + all-gather, hence the doubled terms; for
+all-to-all each rank keeps 1/n of its shard and exchanges the rest.)
+
+These are upper-bound *ordering* costs, not measurements: they answer
+"which candidate's communication is cheapest on this topology", the
+question the planner's search needs — and they are unit-tested against
+hand-computed values (tests/test_planner.py) so the formulas cannot drift
+silently. ``CHIP_PRESETS`` carries public per-chip numbers (per-direction
+aggregate ICI/DCN bandwidth per chip, HBM capacity, peak dense FLOPs);
+the ``cpu`` preset exists so the 8-device test mesh plans deterministically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["LinkSpec", "CHIP_PRESETS", "chip_preset", "all_reduce_s",
+           "all_gather_s", "reduce_scatter_s", "all_to_all_s", "p2p_s",
+           "collective_s", "COLLECTIVE_FORMULAS"]
+
+
+@dataclass(frozen=True)
+class LinkSpec:
+    """One interconnect tier: per-chip aggregate bandwidth + hop latency."""
+    bandwidth_gbps: float   # bytes/s * 1e-9, per direction, per chip
+    latency_us: float       # alpha: per-hop launch latency
+
+    @property
+    def bytes_per_s(self) -> float:
+        return self.bandwidth_gbps * 1e9
+
+    @property
+    def latency_s(self) -> float:
+        return self.latency_us * 1e-6
+
+    def to_dict(self) -> dict:
+        return {"bandwidth_gbps": self.bandwidth_gbps,
+                "latency_us": self.latency_us}
+
+
+#: Public per-chip numbers (TPU system datasheets). ``ici`` is the
+#: per-chip aggregate inter-chip-interconnect bandwidth inside a slice;
+#: ``dcn`` the per-chip share of the data-center network between slices.
+#: ``peak_flops`` is dense bf16.
+CHIP_PRESETS = {
+    "v4":  {"ici": LinkSpec(300.0, 1.0), "dcn": LinkSpec(25.0, 10.0),
+            "hbm_gb": 32.0, "peak_flops": 275e12},
+    "v5e": {"ici": LinkSpec(186.0, 1.0), "dcn": LinkSpec(25.0, 10.0),
+            "hbm_gb": 16.0, "peak_flops": 197e12},
+    "v5p": {"ici": LinkSpec(600.0, 1.0), "dcn": LinkSpec(25.0, 10.0),
+            "hbm_gb": 95.0, "peak_flops": 459e12},
+    "v6e": {"ici": LinkSpec(448.0, 1.0), "dcn": LinkSpec(25.0, 10.0),
+            "hbm_gb": 32.0, "peak_flops": 918e12},
+    # the virtual 8-device CPU test mesh: numbers chosen so plans are
+    # deterministic and memory is never the binding constraint by accident
+    "cpu": {"ici": LinkSpec(10.0, 1.0), "dcn": LinkSpec(1.0, 50.0),
+            "hbm_gb": 4.0, "peak_flops": 5e10},
+}
+
+
+def chip_preset(name: str) -> dict:
+    try:
+        return CHIP_PRESETS[name]
+    except KeyError:
+        raise KeyError(f"unknown chip preset {name!r} "
+                       f"(have {sorted(CHIP_PRESETS)})") from None
+
+
+def all_reduce_s(nbytes: float, n: int, link: LinkSpec) -> float:
+    """Ring all-reduce: 2*(n-1)/n of the payload over the link + 2*(n-1)
+    hops of latency. 0 for a single-member group."""
+    if n <= 1:
+        return 0.0
+    return (2.0 * (n - 1) / n) * nbytes / link.bytes_per_s \
+        + 2.0 * (n - 1) * link.latency_s
+
+
+def all_gather_s(nbytes: float, n: int, link: LinkSpec) -> float:
+    """Ring all-gather of a ``nbytes`` result: each rank receives the
+    (n-1)/n of the full value it does not already hold."""
+    if n <= 1:
+        return 0.0
+    return ((n - 1) / n) * nbytes / link.bytes_per_s \
+        + (n - 1) * link.latency_s
+
+
+def reduce_scatter_s(nbytes: float, n: int, link: LinkSpec) -> float:
+    """Ring reduce-scatter of a ``nbytes`` input: the all-gather mirror."""
+    return all_gather_s(nbytes, n, link)
+
+
+def all_to_all_s(nbytes: float, n: int, link: LinkSpec) -> float:
+    """Each rank re-shards a ``nbytes`` local shard: keeps 1/n, sends the
+    remaining (n-1)/n (one message per peer)."""
+    if n <= 1:
+        return 0.0
+    return ((n - 1) / n) * nbytes / link.bytes_per_s \
+        + (n - 1) * link.latency_s
+
+
+def p2p_s(nbytes: float, link: LinkSpec) -> float:
+    """One point-to-point transfer (pipeline boundary send)."""
+    return nbytes / link.bytes_per_s + link.latency_s
+
+
+COLLECTIVE_FORMULAS = {
+    "all-reduce": all_reduce_s,
+    "all-gather": all_gather_s,
+    "reduce-scatter": reduce_scatter_s,
+    "all-to-all": all_to_all_s,
+}
+
+
+def collective_s(op: str, nbytes: float, n: int, link: LinkSpec) -> float:
+    """Dispatch by op name ("all-reduce" | "all-gather" | "reduce-scatter"
+    | "all-to-all" | "p2p")."""
+    if op == "p2p":
+        return p2p_s(nbytes, link)
+    try:
+        return COLLECTIVE_FORMULAS[op](nbytes, n, link)
+    except KeyError:
+        raise ValueError(f"unknown collective {op!r} "
+                         f"(have {sorted(COLLECTIVE_FORMULAS)} + p2p)") \
+            from None

@@ -233,7 +233,7 @@ def _fingerprint() -> dict:
     for k in sorted(os.environ):
         if k.startswith(("PADDLE_TPU_", "JAX_", "XLA_", "PALLAS_")):
             keep[k] = os.environ[k]
-    return {
+    out = {
         "argv": list(sys.argv),
         "pid": os.getpid(),
         "cwd": os.getcwd(),
@@ -241,6 +241,18 @@ def _fingerprint() -> dict:
         "platform": platform.platform(),
         "env": keep,
     }
+    # active parallelism plan (post-mortems must name the topology the
+    # process died under) — only if the planner is actually loaded: a
+    # dying process must never import new modules from the dump path
+    plan_mod = sys.modules.get("paddle_tpu.planner.plan")
+    if plan_mod is not None:
+        try:
+            active = plan_mod.active_plan()
+        except Exception:
+            active = None
+        if active:
+            out["plan"] = dict(active)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -64,6 +64,8 @@ def _surface_cached() -> tuple:
     import paddle_tpu.observability.continuous as obs_continuous
     import paddle_tpu.observability.flight as obs_flight
     import paddle_tpu.observability.memory as obs_memory
+    import paddle_tpu.cost_model as cost_model_mod
+    import paddle_tpu.planner as planner_mod
     import paddle_tpu.resilience as resilience
     import paddle_tpu.resilience.faults as res_faults
     import paddle_tpu.serving as serving_mod
@@ -132,6 +134,13 @@ def _surface_cached() -> tuple:
     # row schema) is a monitoring contract dashboards depend on
     _collect(obs_continuous, "paddle.observability.continuous",
              "observability", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    # parallelism planner + cost model: the Plan JSON schema, apply_plan,
+    # the validation report, and the alpha-beta formulas are deployment
+    # contracts — launch tooling stores plans and diffs their fingerprints
+    _collect(planner_mod, "paddle.planner", "planner", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    _collect(cost_model_mod, "paddle.cost_model", "cost_model", records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     return tuple(sorted(records, key=lambda r: r.name))
 

@@ -1,0 +1,43 @@
+"""`paddle.planner` — automatic parallelism planning (ROADMAP item 3).
+
+One cost-modeled search turns ``(model, chip_count, topology)`` into a
+complete, serializable :class:`Plan`: 5-D mesh shape (dp/pp/sharding/
+sep/mp), per-layer PartitionSpecs (embedding / attention / MLP / head),
+pipeline stage split + micro-batch count, and a recompute policy — with
+DCN-awareness baked in (dp pinned to the slow axis; mp/sep must stay on
+ICI).
+
+The pipeline (docs/parallelism_planner.md):
+
+* enumerate + prune with :mod:`paddle_tpu.auto_tuner`;
+* score analytically with :mod:`paddle_tpu.cost_model.collective`
+  alpha-beta formulas over the graph analyzer's per-op FLOPs and static
+  peak-HBM (:class:`~.describe.ModelDesc`), rejecting memory-infeasible
+  candidates BEFORE scoring;
+* optionally refine the survivors with dry-run compiles or measured
+  trials (:func:`refine_plans`);
+* prove every emitted plan against compiled HLO on the test mesh
+  (:func:`validate_plan` — exact per-(op, group) collective counts, the
+  PR 6 proof machinery).
+
+Apply with :func:`apply_plan` (fleet + PartitionSpecs in one call);
+inspect from the shell with ``python -m paddle_tpu.planner``.
+"""
+
+from .describe import ModelDesc  # noqa: F401
+from .plan import (Plan, SPEC_ROLES, active_plan, apply_plan,  # noqa: F401
+                   build_specs)
+from .refine import refine_plans  # noqa: F401
+from .search import (PlannerResult, ScoredCandidate,  # noqa: F401
+                     plan_search, predict_memory, predict_step_time)
+from .topology import MESH_AXES, Topology  # noqa: F401
+from .validate import (ValidationReport, axis_groups,  # noqa: F401
+                       count_hlo_collectives, validate_plan)
+
+__all__ = [
+    "Topology", "ModelDesc", "Plan", "PlannerResult", "ScoredCandidate",
+    "ValidationReport", "plan_search", "apply_plan", "validate_plan",
+    "refine_plans", "active_plan", "build_specs", "predict_memory",
+    "predict_step_time", "axis_groups", "count_hlo_collectives",
+    "MESH_AXES", "SPEC_ROLES",
+]

@@ -1,0 +1,181 @@
+"""CLI: ``python -m paddle_tpu.planner --model gpt-tiny --topology cpu:8``.
+
+Plans a registered model config on a described topology and prints the
+ranked candidates (text table or JSON). ``--validate`` proves the chosen
+plan's collective counts against compiled HLO on the local mesh (needs
+the plan's world <= local device count); ``--measured`` re-ranks the
+top-k by real timed trials on the local mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+MODELS = ("gpt-tiny", "llama-tiny", "bench-gpt")
+#: per-model default (global_batch, seq_len) for CPU-mesh planning
+_DEFAULTS = {"gpt-tiny": (32, 32), "llama-tiny": (32, 32),
+             "bench-gpt": (32, 256)}
+
+
+def build_model(name: str):
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    if name == "gpt-tiny":
+        from paddle_tpu.models import gpt2_tiny
+        return gpt2_tiny()
+    if name == "llama-tiny":
+        from paddle_tpu.models import Llama, LlamaConfig
+        return Llama(LlamaConfig(
+            vocab_size=256, max_position_embeddings=64, hidden_size=64,
+            num_layers=2, num_heads=4, num_kv_heads=2,
+            intermediate_size=128))
+    if name == "bench-gpt":
+        from paddle_tpu.models import GPT, GPTConfig
+        return GPT(GPTConfig(vocab_size=1024, max_position_embeddings=256,
+                             hidden_size=256, num_layers=4, num_heads=8))
+    raise SystemExit(f"unknown --model {name!r} (have {', '.join(MODELS)})")
+
+
+def _measured_build(model_name: str, plan_obj):
+    """(step, args) for one measured trial: fresh model, plan applied."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.planner import apply_plan
+
+    if plan_obj.degree("pp") > 1:
+        raise RuntimeError("measured trials for pp > 1 need a pipeline "
+                           "model; skipped")
+    model = build_model(model_name)
+    # the WRAPPED model: its forward shards positional inputs over
+    # dp/sharding/sep, so the timed program is the plan's program (a bare
+    # model would run replicated inputs and emit no dp collectives)
+    wrapped = apply_plan(model, plan_obj)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    vocab = plan_obj.model.get("vocab_size", 256)
+    b = max(plan_obj.micro_batch_size(), 1)
+    s = plan_obj.seq_len
+    x = paddle.to_tensor(rng.integers(0, vocab, (b, s)).astype("int32"))
+    y = paddle.to_tensor(rng.integers(0, vocab, (b, s)).astype("int32"))
+
+    @paddle.jit.to_static
+    def step(x, y):
+        _, loss = wrapped(x, y)  # positional: labels get sharded too
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    return step, (x, y)
+
+
+def _text_report(result, args, validation) -> str:
+    lines = [f"planner: {args.model} on {args.topology} "
+             f"(global_batch={args.global_batch}, seq={args.seq})",
+             f"  enumerated {result.n_enumerated}, pruned "
+             f"{result.n_pruned}, placement-rejected "
+             f"{result.n_placement_rejected}, memory-rejected "
+             f"{result.n_memory_rejected}, scored {result.n_scored} in "
+             f"{result.search_seconds * 1e3:.1f} ms", ""]
+    hdr = (f"  {'rank':<5}{'mesh':<38}{'pred ms':>9}{'tok/s':>12}"
+           f"{'HBM MiB':>9}")
+    lines.append(hdr)
+    for i, sc in enumerate(result.ranking()[:args.top]):
+        p = sc.predicted
+        lines.append(
+            f"  {i:<5}{sc.candidate!r:<38}"
+            f"{p['step_time_s'] * 1e3:>9.2f}"
+            f"{p['tokens_per_s']:>12.0f}"
+            f"{sc.memory['total_bytes'] / (1 << 20):>9.1f}"
+            + ("  +recompute" if sc.recompute else ""))
+    best = result.best
+    if best is not None:
+        lines += ["", f"  chosen: {best.summary()}  "
+                      f"fingerprint={best.fingerprint()}"]
+        for c in best.predicted.get("comm", []):
+            lines.append(
+                f"    {c['op']}@{c['axis']}: {c['count']}x "
+                f"{c['bytes'] / (1 << 20):.2f} MiB -> "
+                f"{c['seconds'] * 1e3:.3f} ms")
+        if "measured_step_s" in best.predicted:
+            lines.append(
+                f"  measured: {best.predicted['measured_step_s'] * 1e3:.2f}"
+                f" ms/step vs predicted "
+                f"{best.predicted['step_time_s'] * 1e3:.2f} ms")
+    if validation is not None:
+        lines.append(f"  validation: "
+                     f"{'OK' if validation.ok else 'MISMATCH'}")
+        for c in validation.failures():
+            lines.append(f"    FAIL {c}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.planner",
+        description="plan 5-D parallelism for a model on a topology")
+    ap.add_argument("--model", default="gpt-tiny",
+                    help=f"one of {', '.join(MODELS)}")
+    ap.add_argument("--chips", type=int, default=None,
+                    help="total chip count (when --topology has no shape)")
+    ap.add_argument("--topology", default="cpu:8",
+                    help="e.g. v5e:16x2, v4:8, cpu:8, or key=value form")
+    ap.add_argument("--global-batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--top", type=int, default=5)
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--validate", action="store_true",
+                    help="prove the chosen plan's collective counts "
+                         "against compiled HLO on the local mesh")
+    ap.add_argument("--measured", action="store_true",
+                    help="re-rank the top plans by real timed trials")
+    ap.add_argument("--out", default=None,
+                    help="also write the chosen plan's JSON here")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.planner import (Topology, plan_search, refine_plans,
+                                    validate_plan)
+
+    gb_default, seq_default = _DEFAULTS.get(args.model, (32, 32))
+    args.global_batch = args.global_batch or gb_default
+    args.seq = args.seq or seq_default
+
+    topo = Topology.from_spec(args.topology, chips=args.chips)
+    model = build_model(args.model)
+    result = plan_search(model, topology=topo,
+                         global_batch=args.global_batch,
+                         seq_len=args.seq, top=args.top)
+    if not result.plans:
+        print("planner: NO feasible plan", file=sys.stderr)
+        for sc in result.scored[:10]:
+            print(f"  {sc.candidate!r}: {sc.reject_reason}",
+                  file=sys.stderr)
+        return 1
+
+    if args.measured:
+        refine_plans(result,
+                     lambda p: _measured_build(args.model, p),
+                     mode="measured", top=args.top)
+
+    validation = None
+    if args.validate:
+        validation = validate_plan(result.best)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(result.best.to_json())
+    if args.format == "json":
+        payload = result.to_dict(top_scored=args.top)
+        if validation is not None:
+            payload["validation"] = validation.to_dict()
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print(_text_report(result, args, validation))
+    return 0 if validation is None or validation.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""ModelDesc: everything the planner's cost model needs to know about a
+model, extracted from its config plus ONE abstract trace of its forward.
+
+The trace rides the graph analyzer (:mod:`paddle_tpu.analysis.graph`):
+``trace_layer`` binds parameters to tracers and abstract-evals the forward
++ loss on ``ShapeDtypeStruct`` avals — no device execution — and
+``build_graph`` / ``peak_liveness`` turn the jaxpr into per-op FLOPs and
+the static peak-HBM the memory-fit filter scales per candidate. This is
+the same machinery PR 6 proved against compiled HLO, so the planner's
+inputs are the analyzer's outputs, not hand-maintained formulas.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["ModelDesc"]
+
+
+@dataclass
+class ModelDesc:
+    name: str
+    num_layers: int
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: int
+    vocab_size: int
+    ffn_size: int
+    seq_len: int
+    param_count: int
+    param_bytes: int
+    dtype_bytes: int = 4
+    # analyzer-derived (per ONE sample at seq_len):
+    flops_fwd_per_sample: float = 0.0
+    act_peak_bytes_per_sample: int = 0
+
+    @classmethod
+    def from_model(cls, model, seq_len: int, name: str = "",
+                   probe_batch: int = 2) -> "ModelDesc":
+        """Extract the descriptor from a live ``nn.Layer`` whose config
+        carries the transformer dims (GPTConfig / LlamaConfig shapes).
+
+        The forward+loss is traced once at ``(probe_batch, seq_len)``
+        avals; FLOPs and the liveness peak are divided back to
+        per-sample so the search can scale them to any candidate's
+        micro-batch size.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..analysis.graph.ir import build_graph
+        from ..analysis.graph.liveness import peak_liveness
+        from ..analysis.graph.trace import trace_layer
+
+        cfg = getattr(model, "cfg", None)
+        if cfg is None:
+            raise TypeError(
+                "ModelDesc.from_model needs a model with a .cfg carrying "
+                "the transformer dims (GPT/Llama style); build a ModelDesc "
+                "directly for custom models")
+        num_layers = int(cfg.num_layers)
+        hidden = int(cfg.hidden_size)
+        heads = int(cfg.num_heads)
+        kv_heads = int(getattr(cfg, "num_kv_heads", heads))
+        vocab = int(cfg.vocab_size)
+        ffn = int(getattr(cfg, "ffn_size", 0) or
+                  getattr(cfg, "intermediate_size", 0) or 4 * hidden)
+        seq_len = int(seq_len)
+        if seq_len > int(cfg.max_position_embeddings):
+            raise ValueError(
+                f"seq_len {seq_len} exceeds the model's "
+                f"max_position_embeddings {cfg.max_position_embeddings}")
+
+        params = list(model.parameters())
+        param_count = int(sum(p.size for p in params))
+        param_bytes = int(sum(
+            p.size * getattr(getattr(p, "_d", p), "dtype",
+                             jnp.float32).itemsize for p in params))
+
+        x = jax.ShapeDtypeStruct((probe_batch, seq_len), jnp.int32)
+        y = jax.ShapeDtypeStruct((probe_batch, seq_len), jnp.int32)
+        g = build_graph(trace_layer(model, x, labels=y),
+                        name=name or type(model).__name__)
+        live = peak_liveness(g)
+        return cls(
+            name=name or type(model).__name__,
+            num_layers=num_layers, hidden_size=hidden, num_heads=heads,
+            num_kv_heads=kv_heads, vocab_size=vocab, ffn_size=ffn,
+            seq_len=seq_len, param_count=param_count,
+            param_bytes=param_bytes,
+            flops_fwd_per_sample=float(g.total_flops()) / probe_batch,
+            act_peak_bytes_per_sample=int(
+                live.intermediate_peak_bytes // probe_batch))
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "num_layers": self.num_layers,
+            "hidden_size": self.hidden_size, "num_heads": self.num_heads,
+            "num_kv_heads": self.num_kv_heads,
+            "vocab_size": self.vocab_size, "ffn_size": self.ffn_size,
+            "seq_len": self.seq_len, "param_count": self.param_count,
+            "param_bytes": self.param_bytes,
+            "dtype_bytes": self.dtype_bytes,
+            "flops_fwd_per_sample": float(self.flops_fwd_per_sample),
+            "act_peak_bytes_per_sample": int(
+                self.act_peak_bytes_per_sample),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModelDesc":
+        return cls(**{k: d[k] for k in cls.__dataclass_fields__
+                      if k in d})

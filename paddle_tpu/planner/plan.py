@@ -1,0 +1,261 @@
+"""The Plan: one serializable object holding every parallelism decision.
+
+A plan answers the four questions the 5-D topology used to answer by
+hand (ROADMAP item 3):
+
+* **mesh** — degrees for the ``[dp, pp, sharding, sep, mp]`` axes (the
+  fleet hybrid order; mp innermost so tensor-parallel traffic rides ICI
+  neighbors);
+* **specs** — per-parameter-role PartitionSpecs (embedding / attention /
+  MLP / head) as ``regex pattern -> spec`` rows matched against
+  ``named_parameters()`` names, covering both the GPT and Llama naming
+  families;
+* **schedule** — pipeline stage split + micro-batch count + schedule
+  mode;
+* **recompute** — whether activation recomputation is required to fit
+  the per-chip HBM budget, and the policy.
+
+``to_json``/``from_json`` round-trip the whole object (stable key order,
+strict JSON); :func:`apply_plan` configures fleet + marks every parameter
+spec in one call; :func:`plan_fingerprint` digests the decision fields
+(not the predictions) so flight dumps can name the topology a process
+died under.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+
+from .topology import MESH_AXES
+
+__all__ = ["Plan", "apply_plan", "active_plan", "SPEC_ROLES"]
+
+PLAN_VERSION = 1
+
+#: Role table: ``(name, pattern, spec builder)`` rows matched IN ORDER
+#: against parameter names. Specs use the mesh axis names; ``None`` =
+#: replicated dim. Covers both naming families:
+#: GPT   — wte/wpe, blocks.N.attn.{qkv,proj}, blocks.N.mlp.{fc,proj}
+#: Llama — embed_tokens, layers.N.self_attn.{q,k,v,o}_proj,
+#:         layers.N.mlp.{gate,up,down}_proj, lm_head
+SPEC_ROLES = (
+    # vocab-parallel embedding: vocab dim over mp
+    ("embedding", r"(^|\.)(wte|embed_tokens)\.weight$",
+     lambda: ["mp", None]),
+    # position embedding: replicated
+    ("pos-embedding", r"(^|\.)wpe\.weight$", lambda: [None, None]),
+    # column-parallel (out-dim sharded): qkv fusions, q/k/v, MLP up/gate/fc
+    ("attention-qkv", r"(qkv|q_proj|k_proj|v_proj)\.weight$",
+     lambda: [None, "mp"]),
+    ("attention-qkv-bias", r"(qkv|q_proj|k_proj|v_proj)\.bias$",
+     lambda: ["mp"]),
+    ("mlp-in", r"(fc|gate_proj|up_proj)\.weight$", lambda: [None, "mp"]),
+    ("mlp-in-bias", r"(fc|gate_proj|up_proj)\.bias$", lambda: ["mp"]),
+    # row-parallel (in-dim sharded): attention out-proj, MLP down-proj
+    ("attention-out", r"(attn\.proj|o_proj)\.weight$",
+     lambda: ["mp", None]),
+    ("mlp-out", r"(mlp\.proj|down_proj)\.weight$", lambda: ["mp", None]),
+    # sharded LM head: vocab (out) dim over mp
+    ("head", r"(^|\.)lm_head\.weight$", lambda: [None, "mp"]),
+)
+
+
+def build_specs(mp: int) -> dict:
+    """The per-role spec table for an mp degree (empty when mp == 1:
+    everything replicated, fleet's default annotation applies).
+
+    The vocab-sharded roles (embedding, head) assume ``vocab % mp == 0``
+    — the search guarantees it (``prune_by_divisibility`` rejects every
+    mp that does not divide the vocab before a plan is built); callers
+    constructing specs directly own that check.
+    """
+    if mp <= 1:
+        return {}
+    return {pattern: {"role": role, "spec": make()}
+            for role, pattern, make in SPEC_ROLES}
+
+
+@dataclass
+class Plan:
+    mesh: dict = field(default_factory=lambda: dict.fromkeys(MESH_AXES, 1))
+    specs: dict = field(default_factory=dict)
+    schedule: dict = field(default_factory=lambda: {
+        "micro_batches": 1, "schedule_mode": "none", "stages": []})
+    recompute: dict = field(default_factory=lambda: {
+        "enable": False, "policy": "none"})
+    global_batch: int = 1
+    seq_len: int = 1
+    model: dict = field(default_factory=dict)
+    topology: dict = field(default_factory=dict)
+    predicted: dict = field(default_factory=dict)
+    version: int = PLAN_VERSION
+
+    # -- queries ------------------------------------------------------------
+    @property
+    def world(self) -> int:
+        n = 1
+        for a in MESH_AXES:
+            n *= int(self.mesh.get(a, 1))
+        return n
+
+    def degree(self, axis: str) -> int:
+        return int(self.mesh.get(axis, 1))
+
+    def mesh_shape(self) -> tuple:
+        return tuple(int(self.mesh.get(a, 1)) for a in MESH_AXES)
+
+    def spec_for(self, param_name: str):
+        """PartitionSpec entry list for a parameter name, or None when no
+        role matches (the parameter stays on fleet's default policy)."""
+        for pattern, row in self.specs.items():
+            if re.search(pattern, param_name):
+                spec = row["spec"] if isinstance(row, dict) else row
+                return [None if s is None else s for s in spec]
+        return None
+
+    def micro_batch_size(self) -> int:
+        m = int(self.schedule.get("micro_batches", 1))
+        return max(self.global_batch
+                   // (self.degree("dp") * self.degree("sharding") * m), 1)
+
+    def summary(self) -> str:
+        d = self.mesh
+        sched = self.schedule
+        rc = "on" if self.recompute.get("enable") else "off"
+        return (f"dp{d.get('dp', 1)} pp{d.get('pp', 1)} "
+                f"sh{d.get('sharding', 1)} sep{d.get('sep', 1)} "
+                f"mp{d.get('mp', 1)} "
+                f"mb{sched.get('micro_batches', 1)} recompute={rc}")
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "mesh": {a: int(self.mesh.get(a, 1)) for a in MESH_AXES},
+            "specs": self.specs,
+            "schedule": self.schedule,
+            "recompute": self.recompute,
+            "global_batch": int(self.global_batch),
+            "seq_len": int(self.seq_len),
+            "model": self.model,
+            "topology": self.topology,
+            "predicted": self.predicted,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def to_json(self, indent: int | None = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Plan":
+        version = int(d.get("version", PLAN_VERSION))
+        if version > PLAN_VERSION:
+            raise ValueError(
+                f"plan version {version} is newer than this build's "
+                f"{PLAN_VERSION}")
+        return cls(mesh=dict(d.get("mesh", {})),
+                   specs=dict(d.get("specs", {})),
+                   schedule=dict(d.get("schedule", {})),
+                   recompute=dict(d.get("recompute", {})),
+                   global_batch=int(d.get("global_batch", 1)),
+                   seq_len=int(d.get("seq_len", 1)),
+                   model=dict(d.get("model", {})),
+                   topology=dict(d.get("topology", {})),
+                   predicted=dict(d.get("predicted", {})),
+                   version=version)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Plan":
+        return cls.from_dict(json.loads(text))
+
+    def fingerprint(self) -> str:
+        """Stable digest of the DECISION fields (mesh/specs/schedule/
+        recompute/batch/seq + model and topology names) — predictions are
+        excluded so re-scoring an identical plan can't change its id."""
+        payload = json.dumps({
+            "mesh": {a: int(self.mesh.get(a, 1)) for a in MESH_AXES},
+            "specs": self.specs,
+            "schedule": self.schedule,
+            "recompute": self.recompute,
+            "global_batch": int(self.global_batch),
+            "seq_len": int(self.seq_len),
+            "model": self.model.get("name", ""),
+            "topology": (self.topology.get("name", ""),
+                         self.topology.get("chips", 0)),
+        }, sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+
+# ---------------------------------------------------------------------------
+# apply_plan: fleet + PartitionSpecs in one call
+# ---------------------------------------------------------------------------
+
+_ACTIVE: dict | None = None
+
+
+def active_plan() -> dict | None:
+    """{"fingerprint", "mesh", "summary"} of the last applied plan (flight
+    dumps embed this so post-mortems name the topology they died under)."""
+    return _ACTIVE
+
+
+def apply_plan(model, plan: Plan, devices=None):
+    """Configure fleet for ``plan`` and annotate ``model``'s parameters
+    with the plan's PartitionSpecs — the one-call version of the manual
+    ``DistributedStrategy`` + ``fleet.init`` + per-layer ``mark_sharding``
+    recipe. Returns the fleet-wrapped model.
+
+    Resets any previous topology first (a plan is a full replacement, not
+    an overlay). ``pp > 1`` plans require a ``PipelineLayer`` model, the
+    same contract ``fleet.distributed_model`` enforces.
+    """
+    global _ACTIVE
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed.fleet import DistributedStrategy, fleet
+    from ..distributed.sharding_utils import mark_sharding
+    from ..distributed.topology import reset_topology_state
+
+    reset_topology_state()
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": plan.degree("dp"), "mp_degree": plan.degree("mp"),
+        "pp_degree": plan.degree("pp"),
+        "sharding_degree": plan.degree("sharding"),
+        "sep_degree": plan.degree("sep")}
+    strategy.pipeline_configs = {
+        "accumulate_steps": int(plan.schedule.get("micro_batches", 1)),
+        "micro_batch_size": plan.micro_batch_size()}
+    if plan.degree("sharding") > 1:
+        strategy.sharding = True
+        strategy.sharding_configs = {
+            "stage": 3, "degree": plan.degree("sharding")}
+    if plan.recompute.get("enable"):
+        strategy.recompute = True
+        strategy.recompute_configs = {
+            "enable": True,
+            "policy": plan.recompute.get("policy", "full")}
+    fleet.init(is_collective=True, strategy=strategy, devices=devices)
+
+    for name, p in model.named_parameters():
+        spec = plan.spec_for(name)
+        if spec is not None:
+            mark_sharding(p, P(*spec))
+    wrapped = fleet.distributed_model(model)
+
+    _ACTIVE = {"fingerprint": plan.fingerprint(),
+               "mesh": {a: plan.degree(a) for a in MESH_AXES},
+               "summary": plan.summary()}
+    from ..observability import metrics as _m
+    _m.counter("paddle_tpu_planner_plans_applied_total",
+               "plans applied via apply_plan").inc()
+    try:
+        from ..observability.flight import record as _flight_record
+        _flight_record("plan_applied", fingerprint=_ACTIVE["fingerprint"],
+                       summary=_ACTIVE["summary"])
+    except Exception:
+        pass
+    return wrapped

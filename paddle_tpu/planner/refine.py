@@ -1,0 +1,55 @@
+"""Optional refinement of the analytic ranking: dry-run compiles and
+measured trials for the top-k plans (the auto_tuner's two trial modes,
+driven by plans instead of bare candidates).
+
+The analytic search orders hundreds of candidates in milliseconds; these
+refiners spend real compile/execute time on the few survivors. The
+caller supplies ``build(plan)`` returning ``(step_fn, args)`` — a real
+train step on a model ALREADY configured for the plan (typically via
+:func:`~.plan.apply_plan`); the refiner times it and re-ranks. The
+topology is reset after every trial so plans cannot contaminate each
+other (the ``measure_compiled_step`` contract).
+"""
+
+from __future__ import annotations
+
+from ..auto_tuner.tuner import run_timed_trial
+
+__all__ = ["refine_plans"]
+
+
+def refine_plans(result, build, mode: str = "measured", top: int = 3,
+                 steps: int = 3, warmup: int = 1):
+    """Re-rank ``result.plans[:top]`` by real trials.
+
+    ``mode="dryrun"`` runs exactly ONE step per plan (the compile +
+    first dispatch — catches compile-time OOM and pathological lowering
+    without burning steady-state time) and records
+    ``predicted["dryrun_s"]``; ``mode="measured"`` runs ``warmup`` then
+    ``steps`` timed steps and records ``predicted["measured_step_s"]``.
+    Failing trials are recorded (``predicted["trial_error"]``) and sort
+    last instead of killing the refinement. Returns the re-ranked plan
+    list (also written back to ``result.plans``).
+    """
+    from ..distributed.topology import reset_topology_state
+
+    if mode not in ("measured", "dryrun"):
+        raise ValueError(f"mode must be 'measured' or 'dryrun', not "
+                         f"{mode!r}")
+    key = "measured_step_s" if mode == "measured" else "dryrun_s"
+    trialed = []
+    for p in list(result.plans[:max(top, 1)]):
+        try:
+            step, args = build(p)
+            p.predicted[key] = run_timed_trial(
+                step, args,
+                steps=steps if mode == "measured" else 1,
+                warmup=warmup if mode == "measured" else 0)
+        except Exception as e:  # a failing trial never kills the search
+            p.predicted["trial_error"] = f"{type(e).__name__}: {e}"
+        finally:
+            reset_topology_state()
+        trialed.append(p)
+    trialed.sort(key=lambda p: p.predicted.get(key, float("inf")))
+    result.plans[:len(trialed)] = trialed
+    return trialed

@@ -1,0 +1,374 @@
+"""Cost-modeled plan search: enumerate → prune → memory-fit → score.
+
+The pipeline reuses every search primitive the repo already has, in the
+order the ISSUE names them:
+
+1. **enumerate** — :func:`paddle_tpu.auto_tuner.default_candidates` over
+   every ``(dp, pp, sharding, sep, mp, micro_batch)`` factorization of
+   the chip count;
+2. **prune** — :func:`paddle_tpu.auto_tuner.prune_by_divisibility` with
+   the model's head/kv-head/layer/vocab/seq divisibility constraints;
+3. **placement filter** — mp and sep must ride ICI
+   (:meth:`Topology.axis_on_ici`); dp is the axis allowed to cross DCN,
+   and is priced with the DCN link when it does;
+4. **memory-fit filter** — the analyzer's static peak-HBM
+   (``ModelDesc.act_peak_bytes_per_sample`` from the liveness pass)
+   scaled per candidate must fit the per-chip budget, trying the
+   recompute policy before rejecting — infeasible candidates are
+   REJECTED BEFORE SCORING;
+5. **score** — alpha-beta collective costs
+   (:mod:`paddle_tpu.cost_model.collective`) over the per-axis implied
+   collectives + roofline compute time + pipeline bubble.
+
+Every stage increments ``paddle_tpu_planner_candidates_total{stage=}``;
+the whole search records ``paddle_tpu_planner_search_seconds``. Memory
+and time formulas are documented in docs/parallelism_planner.md and
+unit-tested against hand-computed values in tests/test_planner.py.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..auto_tuner import Candidate, default_candidates, \
+    prune_by_divisibility
+from ..cost_model.collective import (all_gather_s, all_reduce_s,
+                                     all_to_all_s, p2p_s, reduce_scatter_s)
+from .describe import ModelDesc
+from .plan import Plan, build_specs
+from .topology import MESH_AXES, Topology
+
+__all__ = ["plan_search", "ScoredCandidate", "PlannerResult",
+           "predict_memory", "predict_step_time"]
+
+#: fraction of per-chip HBM a plan may claim (allocator + runtime slack)
+HBM_UTIL = 0.92
+#: achievable fraction of dense peak FLOPs (MFU target the roofline uses)
+MFU_TARGET = 0.5
+#: optimizer state elements per parameter (Adam: two f32 moments)
+OPT_SLOTS = 2
+
+
+def _dims_of(cand: Candidate) -> dict:
+    return {"dp": cand.dp, "pp": cand.pp, "sharding": cand.sharding,
+            "sep": cand.sep, "mp": cand.mp}
+
+
+def predict_memory(desc: ModelDesc, cand: Candidate, topo: Topology,
+                   global_batch: int, recompute: bool) -> dict:
+    """Per-chip HBM claim of a candidate (bytes, documented upper bound).
+
+    * params: ``param_bytes / (mp * pp)``, ZeRO-3 over sharding;
+    * grads: one f32 copy, ZeRO >= 2 shards it over sharding;
+    * optimizer: OPT_SLOTS f32 moments, ZeRO >= 1 shards them;
+    * activations: the liveness pass's per-sample intermediate peak
+      scaled to the micro-batch, divided over sep (sequence) and pp
+      (layers per stage), times the 1F1B in-flight stash factor
+      ``min(pp, micro_batches)``; with recompute only the per-layer
+      boundary tensors are stashed plus one layer's working set.
+    """
+    mp, pp, sh = cand.mp, cand.pp, cand.sharding
+    m = cand.micro_batch
+    mbs = max(global_batch // (cand.dp * sh * m), 1)
+
+    params = desc.param_bytes / (mp * pp * sh)
+    grads = desc.param_count * 4 / (mp * pp * sh)
+    opt = OPT_SLOTS * desc.param_count * 4 / (mp * pp * sh)
+
+    act_mb = desc.act_peak_bytes_per_sample * mbs / (cand.sep * pp)
+    inflight = min(pp, m) if pp > 1 else 1
+    if recompute:
+        # stash = residual-stream boundary per layer per in-flight mb
+        boundary = (desc.num_layers / pp) * mbs * desc.seq_len * \
+            desc.hidden_size * desc.dtype_bytes / cand.sep
+        one_layer = act_mb / max(desc.num_layers / pp, 1)
+        act = boundary * inflight + one_layer
+    else:
+        act = act_mb * inflight
+
+    total = params + grads + opt + act
+    return {"params_bytes": int(params), "grads_bytes": int(grads),
+            "opt_bytes": int(opt), "act_bytes": int(act),
+            "total_bytes": int(total), "micro_batch_size": mbs,
+            "budget_bytes": int(topo.hbm_bytes * HBM_UTIL),
+            "fits": total <= topo.hbm_bytes * HBM_UTIL}
+
+
+def predict_step_time(desc: ModelDesc, cand: Candidate, topo: Topology,
+                      global_batch: int, recompute: bool) -> dict:
+    """Analytic step time: roofline compute × pipeline bubble + the
+    alpha-beta cost of every implied collective, priced on the link each
+    axis actually rides (ICI vs DCN). No comm/compute overlap is assumed
+    — the result is an ordering bound, not a simulation."""
+    dims = _dims_of(cand)
+    mp, pp, sh, sep, dp = cand.mp, cand.pp, cand.sharding, cand.sep, cand.dp
+    m = cand.micro_batch
+    mbs = max(global_batch // (dp * sh * m), 1)
+
+    # compute: fwd + 2x bwd (+1 fwd when recomputing), split over the mesh
+    passes = 4.0 if recompute else 3.0
+    flops_per_chip = passes * desc.flops_fwd_per_sample * global_batch \
+        / cand.world
+    compute_s = flops_per_chip / (topo.peak_flops * MFU_TARGET)
+    bubble_factor = (m + pp - 1) / m
+    bubble_s = compute_s * (bubble_factor - 1.0)
+
+    layers_per_stage = max(desc.num_layers // pp, 1)
+    act_mb = mbs * desc.seq_len * desc.hidden_size * desc.dtype_bytes / sep
+    comm = []
+
+    def add(op, axis, count, nbytes, seconds):
+        if count and seconds > 0:
+            comm.append({"op": op, "axis": axis, "count": int(count),
+                         "bytes": int(nbytes),
+                         "seconds": float(seconds * count)})
+
+    # mp: Megatron f/g pairs — 2 activation all-reduces per layer per
+    # direction (attention out-proj + MLP down-proj), fwd + bwd
+    if mp > 1:
+        link = topo.axis_link("mp", dims)
+        count = 4 * layers_per_stage * m
+        add("all-reduce", "mp", count, act_mb,
+            all_reduce_s(act_mb, mp, link))
+    # sep (Ulysses): seq<->heads all-to-alls around each attention,
+    # 2 fwd + 2 bwd per layer
+    if sep > 1:
+        link = topo.axis_link("sep", dims)
+        count = 4 * layers_per_stage * m
+        add("all-to-all", "sep", count, act_mb,
+            all_to_all_s(act_mb, sep, link))
+    # pp: boundary activation p2p, fwd + bwd, per micro-batch
+    if pp > 1:
+        link = topo.axis_link("pp", dims)
+        count = 2 * m
+        add("p2p", "pp", count, act_mb, p2p_s(act_mb, link))
+    # dp: gradient all-reduce once per step (bucketed); under ZeRO each
+    # chip only reduces its 1/sh grad shard over dp
+    grad_bytes = desc.param_count * 4 / (mp * pp)
+    if dp > 1:
+        link = topo.axis_link("dp", dims)
+        add("all-reduce", "dp", 1, grad_bytes / sh,
+            all_reduce_s(grad_bytes / sh, dp, link))
+    # sharding (ZeRO-3): reduce-scatter grads + re-gather params for the
+    # next step's fwd and bwd
+    if sh > 1:
+        link = topo.axis_link("sharding", dims)
+        add("reduce-scatter", "sharding", 1, grad_bytes,
+            reduce_scatter_s(grad_bytes, sh, link))
+        add("all-gather", "sharding", 2, desc.param_bytes / (mp * pp),
+            all_gather_s(desc.param_bytes / (mp * pp), sh, link))
+
+    comm_s = sum(c["seconds"] for c in comm)
+    total = compute_s + bubble_s + comm_s
+    return {"compute_s": float(compute_s), "bubble_s": float(bubble_s),
+            "comm_s": float(comm_s), "step_time_s": float(total),
+            "bubble_fraction": float((pp - 1) / (m + pp - 1)) if pp > 1
+            else 0.0,
+            "tokens_per_s": float(global_batch * desc.seq_len
+                                  / max(total, 1e-12)),
+            "comm": comm}
+
+
+@dataclass
+class ScoredCandidate:
+    candidate: Candidate
+    feasible: bool = True
+    reject_reason: str = ""
+    recompute: bool = False
+    score: float = float("inf")      # predicted step seconds
+    predicted: dict = field(default_factory=dict)
+    memory: dict = field(default_factory=dict)
+
+    def mesh_dict(self) -> dict:
+        return _dims_of(self.candidate)
+
+    def key(self) -> tuple:
+        c = self.candidate
+        return (c.dp, c.pp, c.sharding, c.sep, c.mp, c.micro_batch)
+
+    def to_dict(self) -> dict:
+        return {"mesh": self.mesh_dict(),
+                "micro_batches": self.candidate.micro_batch,
+                "feasible": self.feasible,
+                "reject_reason": self.reject_reason,
+                "recompute": self.recompute,
+                "score_s": None if self.score == float("inf")
+                else float(self.score),
+                "predicted": self.predicted, "memory": self.memory}
+
+
+@dataclass
+class PlannerResult:
+    plans: list = field(default_factory=list)       # top-k Plan, ranked
+    scored: list = field(default_factory=list)      # every ScoredCandidate
+    n_enumerated: int = 0
+    n_pruned: int = 0
+    n_placement_rejected: int = 0
+    n_memory_rejected: int = 0
+    n_scored: int = 0
+    search_seconds: float = 0.0
+
+    @property
+    def best(self):
+        return self.plans[0] if self.plans else None
+
+    def ranking(self) -> list:
+        """Feasible candidates, best first."""
+        return sorted((s for s in self.scored if s.feasible),
+                      key=lambda s: s.score)
+
+    def rank_of(self, mesh: dict, micro_batches: int | None = None):
+        """0-based rank of a (hand-tuned) config in the planner's
+        ordering, or None when it was pruned/rejected. ``mesh`` uses the
+        axis-name keys; omitted axes default to 1; omitted
+        ``micro_batches`` matches that mesh's best micro-batch count."""
+        want = tuple(int(mesh.get(a, 1)) for a in MESH_AXES)
+        for i, s in enumerate(self.ranking()):
+            got = tuple(int(s.mesh_dict()[a]) for a in MESH_AXES)
+            if got == want and (micro_batches is None or
+                                s.candidate.micro_batch == micro_batches):
+                return i
+        return None
+
+    def to_dict(self, top_scored: int = 10) -> dict:
+        return {
+            "plans": [p.to_dict() for p in self.plans],
+            "ranking": [s.to_dict() for s in self.ranking()[:top_scored]],
+            "rejected": [s.to_dict() for s in self.scored
+                         if not s.feasible][:top_scored],
+            "n_enumerated": self.n_enumerated,
+            "n_pruned": self.n_pruned,
+            "n_placement_rejected": self.n_placement_rejected,
+            "n_memory_rejected": self.n_memory_rejected,
+            "n_scored": self.n_scored,
+            "search_seconds": round(self.search_seconds, 4),
+        }
+
+
+def _metrics():
+    from ..observability import metrics as m
+    return m
+
+
+def plan_search(model=None, topology="cpu:8", global_batch=32,
+                seq_len=None, micro_batches=(1, 2, 4, 8), top=3,
+                desc: ModelDesc | None = None, max_sep: int | None = None,
+                hbm_budget_bytes: int | None = None) -> PlannerResult:
+    """Search the 5-D mesh space for ``model`` on ``topology``.
+
+    ``model`` is an ``nn.Layer`` (GPT/Llama style config) — or pass a
+    prebuilt ``desc``. ``topology`` is a spec string or
+    :class:`Topology`. Returns a :class:`PlannerResult` whose ``plans``
+    are the top-k feasible candidates as full :class:`Plan` objects.
+    """
+    t0 = time.perf_counter()
+    topo = topology if isinstance(topology, Topology) \
+        else Topology.from_spec(topology)
+    if hbm_budget_bytes is not None:
+        # explicit budget override (tests pin tiny budgets to prove the
+        # memory filter fires)
+        topo = Topology(chips=topo.chips, slice_chips=topo.slice_chips,
+                        ici=topo.ici, dcn=topo.dcn,
+                        hbm_bytes=int(hbm_budget_bytes),
+                        peak_flops=topo.peak_flops, name=topo.name)
+    if desc is None:
+        if model is None:
+            raise ValueError("pass a model or a prebuilt ModelDesc")
+        if seq_len is None:
+            raise ValueError("seq_len is required when tracing a model")
+        desc = ModelDesc.from_model(model, seq_len)
+    seq_len = desc.seq_len
+
+    m = _metrics()
+    cand_counter = m.counter("paddle_tpu_planner_candidates_total",
+                             "planner candidates by pipeline stage")
+    chips = topo.chips
+    cands = default_candidates(
+        chips, max_mp=chips, max_pp=min(chips, desc.num_layers),
+        micro_batches=tuple(micro_batches),
+        max_sep=chips if max_sep is None else max_sep)
+    result = PlannerResult(n_enumerated=len(cands))
+    cand_counter.inc(len(cands), stage="enumerated")
+
+    kept = prune_by_divisibility(
+        cands, num_layers=desc.num_layers, num_heads=desc.num_heads,
+        global_batch=global_batch, num_kv_heads=desc.num_kv_heads,
+        vocab_size=desc.vocab_size, seq_len=seq_len)
+    result.n_pruned = len(cands) - len(kept)
+    cand_counter.inc(result.n_pruned, stage="pruned")
+
+    for cand in kept:
+        dims = _dims_of(cand)
+        sc = ScoredCandidate(candidate=cand)
+        # placement: fast axes must stay on ICI (DCN-awareness)
+        slow = [a for a in ("mp", "sep") if not topo.axis_on_ici(a, dims)]
+        if slow:
+            sc.feasible = False
+            sc.reject_reason = f"{'/'.join(slow)} crosses DCN"
+            result.n_placement_rejected += 1
+            cand_counter.inc(stage="placement_rejected")
+            result.scored.append(sc)
+            continue
+        # memory-fit BEFORE scoring, recompute only if needed
+        mem = predict_memory(desc, cand, topo, global_batch,
+                             recompute=False)
+        if not mem["fits"]:
+            mem_rc = predict_memory(desc, cand, topo, global_batch,
+                                    recompute=True)
+            if mem_rc["fits"]:
+                sc.recompute, mem = True, mem_rc
+            else:
+                sc.feasible = False
+                sc.reject_reason = (
+                    f"does not fit HBM: {mem_rc['total_bytes']} > "
+                    f"{mem_rc['budget_bytes']} even with recompute")
+                sc.memory = mem_rc
+                result.n_memory_rejected += 1
+                cand_counter.inc(stage="memory_rejected")
+                result.scored.append(sc)
+                continue
+        sc.memory = mem
+        sc.predicted = predict_step_time(desc, cand, topo, global_batch,
+                                         recompute=sc.recompute)
+        sc.score = sc.predicted["step_time_s"]
+        result.n_scored += 1
+        cand_counter.inc(stage="scored")
+        result.scored.append(sc)
+
+    for sc in result.ranking()[:max(top, 1)]:
+        result.plans.append(_as_plan(sc, desc, topo, global_batch))
+
+    result.search_seconds = time.perf_counter() - t0
+    m.histogram("paddle_tpu_planner_search_seconds",
+                "wall seconds per plan_search call").observe(
+        result.search_seconds)
+    if result.plans:
+        m.gauge("paddle_tpu_planner_chosen_score_s",
+                "predicted step seconds of the chosen plan").set(
+            result.plans[0].predicted["step_time_s"])
+    return result
+
+
+def _as_plan(sc: ScoredCandidate, desc: ModelDesc, topo: Topology,
+             global_batch: int) -> Plan:
+    cand = sc.candidate
+    pp = cand.pp
+    per = desc.num_layers // pp
+    stages = [per] * pp
+    for i in range(desc.num_layers - per * pp):
+        stages[i] += 1
+    predicted = dict(sc.predicted)
+    predicted["per_chip_hbm_bytes"] = sc.memory["total_bytes"]
+    predicted["memory"] = sc.memory
+    return Plan(
+        mesh=_dims_of(cand),
+        specs=build_specs(cand.mp),
+        schedule={"micro_batches": cand.micro_batch,
+                  "schedule_mode": "1F1B" if pp > 1 else "none",
+                  "stages": stages},
+        recompute={"enable": bool(sc.recompute),
+                   "policy": "full" if sc.recompute else "none"},
+        global_batch=int(global_batch), seq_len=int(desc.seq_len),
+        model=desc.to_dict(), topology=topo.to_dict(),
+        predicted=predicted)

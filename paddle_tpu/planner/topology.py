@@ -1,0 +1,152 @@
+"""Machine topology description for the parallelism planner.
+
+A :class:`Topology` describes the ICI/DCN hierarchy the plan must respect:
+how many chips, how many chips share one ICI domain (a *slice*), and the
+bandwidth/latency of each tier. The planner uses it two ways:
+
+* **placement** — mesh axes are laid out major-to-minor in the fixed order
+  ``[dp, pp, sharding, sep, mp]`` (:mod:`paddle_tpu.distributed.topology`
+  orders the jax mesh the same way), so an axis's communication groups
+  span a contiguous device range whose extent is ``degree * stride``
+  (stride = product of the dims minor to it). :meth:`Topology.axis_link`
+  resolves whether that range stays inside one slice (ICI) or crosses
+  slices (DCN) — dp, the outermost axis, is the one allowed to be slow;
+* **pricing** — the resolved :class:`~paddle_tpu.cost_model.LinkSpec`
+  feeds the alpha-beta collective formulas in
+  :mod:`paddle_tpu.cost_model.collective`.
+
+Spec strings (CLI ``--topology``, :meth:`Topology.from_spec`):
+
+* ``"v5e:16x2"`` — 2 DCN-connected slices of 16 v5e chips (32 total);
+* ``"v4:8"`` — one 8-chip v4 slice (no DCN);
+* ``"cpu:8"`` — the virtual 8-device CPU test mesh;
+* ``"chips=32,slice=16,ici_gbps=186,dcn_gbps=25,hbm_gb=16,
+  peak_tflops=197"`` — fully custom key=value form.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..cost_model.collective import CHIP_PRESETS, LinkSpec, chip_preset
+
+__all__ = ["Topology", "MESH_AXES"]
+
+#: fixed major-to-minor mesh axis order (mirrors fleet.init's default
+#: hybrid order; mp innermost so tensor-parallel traffic rides neighbors)
+MESH_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+@dataclass
+class Topology:
+    chips: int
+    slice_chips: int                  # chips per ICI domain
+    ici: LinkSpec = field(default_factory=lambda: CHIP_PRESETS["cpu"]["ici"])
+    dcn: LinkSpec = field(default_factory=lambda: CHIP_PRESETS["cpu"]["dcn"])
+    hbm_bytes: int = 4 << 30          # per-chip HBM budget
+    peak_flops: float = 5e10          # per-chip dense peak
+    name: str = "custom"
+
+    def __post_init__(self):
+        if self.chips < 1:
+            raise ValueError(f"chips must be >= 1, got {self.chips}")
+        if self.slice_chips < 1 or self.chips % self.slice_chips:
+            raise ValueError(
+                f"slice_chips ({self.slice_chips}) must divide chips "
+                f"({self.chips})")
+
+    @property
+    def n_slices(self) -> int:
+        return self.chips // self.slice_chips
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_spec(cls, spec: str, chips: int | None = None) -> "Topology":
+        """Parse a topology spec string (module docstring grammar).
+
+        ``chips`` overrides/supplies the total count for preset forms
+        like ``"v5e"`` with no explicit shape.
+        """
+        spec = (spec or "cpu").strip()
+        if "=" in spec:
+            kv = {}
+            for part in spec.split(","):
+                k, _, v = part.partition("=")
+                kv[k.strip()] = v.strip()
+            n = int(kv.get("chips", chips or 1))
+            if chips is not None and int(chips) != n:
+                raise ValueError(
+                    f"--chips {chips} contradicts topology {spec!r} "
+                    f"({n} chips)")
+            return cls(
+                chips=n,
+                slice_chips=int(kv.get("slice", n)),
+                ici=LinkSpec(float(kv.get("ici_gbps", 10.0)),
+                             float(kv.get("ici_us", 1.0))),
+                dcn=LinkSpec(float(kv.get("dcn_gbps", 1.0)),
+                             float(kv.get("dcn_us", 50.0))),
+                hbm_bytes=int(float(kv.get("hbm_gb", 4.0)) * (1 << 30)),
+                peak_flops=float(kv.get("peak_tflops", 0.05)) * 1e12,
+                name="custom")
+        preset_name, _, shape = spec.partition(":")
+        preset = chip_preset(preset_name)
+        if shape:
+            if "x" in shape:
+                per_slice, n_slices = (int(p) for p in shape.split("x"))
+            else:
+                per_slice, n_slices = int(shape), 1
+            total = per_slice * n_slices
+        else:
+            total = int(chips or 1)
+            per_slice = total
+        if chips is not None and int(chips) != total:
+            raise ValueError(
+                f"--chips {chips} contradicts topology {spec!r} "
+                f"({total} chips)")
+        return cls(chips=total, slice_chips=per_slice,
+                   ici=preset["ici"], dcn=preset["dcn"],
+                   hbm_bytes=int(preset["hbm_gb"] * (1 << 30)),
+                   peak_flops=preset["peak_flops"], name=preset_name)
+
+    # -- placement ----------------------------------------------------------
+    def axis_stride(self, axis: str, dims: dict) -> int:
+        """Device-index stride between neighbors along ``axis`` for a mesh
+        with degrees ``dims`` laid out in MESH_AXES order."""
+        stride = 1
+        for a in reversed(MESH_AXES):
+            if a == axis:
+                return stride
+            stride *= int(dims.get(a, 1))
+        raise ValueError(f"unknown mesh axis {axis!r}")
+
+    def axis_on_ici(self, axis: str, dims: dict) -> bool:
+        """True when every communication group along ``axis`` fits inside
+        one ICI slice: the group's contiguous device extent
+        (``degree * stride``) divides the slice size, so no member pair
+        straddles a slice boundary."""
+        degree = int(dims.get(axis, 1))
+        if degree <= 1:
+            return True
+        extent = degree * self.axis_stride(axis, dims)
+        return extent <= self.slice_chips and \
+            self.slice_chips % extent == 0
+
+    def axis_link(self, axis: str, dims: dict) -> LinkSpec:
+        return self.ici if self.axis_on_ici(axis, dims) else self.dcn
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {"name": self.name, "chips": self.chips,
+                "slice_chips": self.slice_chips,
+                "ici": self.ici.to_dict(), "dcn": self.dcn.to_dict(),
+                "hbm_bytes": int(self.hbm_bytes),
+                "peak_flops": float(self.peak_flops)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Topology":
+        return cls(chips=int(d["chips"]),
+                   slice_chips=int(d["slice_chips"]),
+                   ici=LinkSpec(**d["ici"]), dcn=LinkSpec(**d["dcn"]),
+                   hbm_bytes=int(d["hbm_bytes"]),
+                   peak_flops=float(d["peak_flops"]),
+                   name=d.get("name", "custom"))

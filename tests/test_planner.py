@@ -1,0 +1,610 @@
+"""paddle.planner — automatic parallelism planning (ISSUE 11).
+
+Coverage contract:
+* cost-model formulas unit-tested against HAND-COMPUTED values;
+* prune_by_divisibility rejection paths for GQA kv-heads and vocab;
+* planner end-to-end on the 8-device CPU mesh for gpt-tiny AND
+  llama-tiny: plan emitted, HLO collective-count proof passes, the
+  memory-fit filter rejects an oversized config BEFORE scoring, JSON
+  round-trip is byte-stable, apply_plan trains one step;
+* DCN-awareness: mp/sep crossing a slice boundary is rejected;
+* validation actually gates: a wrong prediction reads MISMATCH, an
+  over-budget plan fails the memory re-assertion;
+* observability: planner metrics emitted, active plan fingerprint lands
+  in the flight fingerprint.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.auto_tuner import (Candidate, default_candidates,
+                                   prune_by_divisibility)
+from paddle_tpu.cost_model import (CHIP_PRESETS, LinkSpec, all_gather_s,
+                                   all_reduce_s, all_to_all_s,
+                                   collective_s, p2p_s, reduce_scatter_s)
+from paddle_tpu.distributed.topology import reset_topology_state
+from paddle_tpu.planner import (MESH_AXES, ModelDesc, Plan, Topology,
+                                apply_plan, axis_groups, build_specs,
+                                count_hlo_collectives, plan_search,
+                                predict_memory, predict_step_time,
+                                refine_plans, validate_plan)
+
+NEEDS_MESH = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8-device mesh")
+
+
+@pytest.fixture(autouse=True)
+def _clean_topology():
+    yield
+    reset_topology_state()
+
+
+def _llama_tiny():
+    from paddle_tpu.models import Llama, LlamaConfig
+    return Llama(LlamaConfig(
+        vocab_size=256, max_position_embeddings=64, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, intermediate_size=128))
+
+
+def _gpt_tiny():
+    from paddle_tpu.models import gpt2_tiny
+    return gpt2_tiny()
+
+
+# ---------------------------------------------------------------------------
+# cost model: hand-computed values
+# ---------------------------------------------------------------------------
+
+def test_alpha_beta_formulas_hand_computed():
+    # 1 GB/s, 1 us latency; 1 MB payload; 4 participants
+    link = LinkSpec(bandwidth_gbps=1.0, latency_us=1.0)
+    b, n = 1e6, 4
+    # all-reduce: 2*(3/4)*1e6/1e9 + 2*3*1e-6 = 1.5e-3 + 6e-6
+    assert all_reduce_s(b, n, link) == pytest.approx(1.506e-3)
+    # all-gather / reduce-scatter: (3/4)*1e-3 + 3e-6
+    assert all_gather_s(b, n, link) == pytest.approx(0.753e-3)
+    assert reduce_scatter_s(b, n, link) == pytest.approx(0.753e-3)
+    # all-to-all: same traffic shape as all-gather in the ring model
+    assert all_to_all_s(b, n, link) == pytest.approx(0.753e-3)
+    # p2p: 1e-3 + 1e-6
+    assert p2p_s(b, link) == pytest.approx(1.001e-3)
+
+
+def test_formulas_single_member_group_is_free():
+    link = LinkSpec(10.0, 1.0)
+    for fn in (all_reduce_s, all_gather_s, reduce_scatter_s, all_to_all_s):
+        assert fn(1e9, 1, link) == 0.0
+
+
+def test_collective_dispatch_and_presets():
+    link = CHIP_PRESETS["v5e"]["ici"]
+    assert collective_s("all-reduce", 1e6, 8, link) == \
+        all_reduce_s(1e6, 8, link)
+    assert collective_s("p2p", 1e6, 8, link) == p2p_s(1e6, link)
+    with pytest.raises(ValueError):
+        collective_s("broadcast", 1e6, 8, link)
+    # DCN is strictly slower than ICI in every preset: the placement
+    # penalty the planner relies on is real
+    for name, preset in CHIP_PRESETS.items():
+        assert preset["ici"].bandwidth_gbps > preset["dcn"].bandwidth_gbps
+
+
+# ---------------------------------------------------------------------------
+# topology: spec parsing + ICI/DCN axis placement
+# ---------------------------------------------------------------------------
+
+def test_topology_from_spec_forms():
+    t = Topology.from_spec("v5e:16x2")
+    assert (t.chips, t.slice_chips, t.n_slices) == (32, 16, 2)
+    assert t.peak_flops == CHIP_PRESETS["v5e"]["peak_flops"]
+    t2 = Topology.from_spec("cpu:8")
+    assert (t2.chips, t2.slice_chips) == (8, 8)
+    t3 = Topology.from_spec(
+        "chips=8,slice=4,ici_gbps=100,dcn_gbps=5,hbm_gb=2,peak_tflops=1")
+    assert t3.slice_chips == 4 and t3.hbm_bytes == 2 << 30
+    assert t3.ici.bandwidth_gbps == 100.0
+    with pytest.raises(ValueError):
+        Topology.from_spec("v5e:16x2", chips=8)  # contradictory
+    with pytest.raises(ValueError):
+        Topology(chips=8, slice_chips=3)  # slice must divide chips
+
+
+def test_topology_axis_placement():
+    # two slices of 4: mp (innermost, degree 2) stays on ICI; dp
+    # (outermost, spanning both slices) rides DCN
+    t = Topology.from_spec("chips=8,slice=4")
+    dims = {"dp": 2, "pp": 1, "sharding": 1, "sep": 1, "mp": 4}
+    assert t.axis_on_ici("mp", dims)
+    assert not t.axis_on_ici("dp", dims)
+    assert t.axis_link("dp", dims) == t.dcn
+    # mp degree 8 cannot fit a 4-chip slice
+    dims8 = {"mp": 8}
+    assert not t.axis_on_ici("mp", dims8)
+    # single slice: everything is ICI
+    t1 = Topology.from_spec("cpu:8")
+    assert t1.axis_on_ici("dp", {"dp": 8})
+
+
+def test_topology_dict_round_trip():
+    t = Topology.from_spec("v4:8")
+    t2 = Topology.from_dict(t.to_dict())
+    assert t2.to_dict() == t.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# auto_tuner satellites: GQA kv-heads + vocab rejection, sep axis
+# ---------------------------------------------------------------------------
+
+def test_prune_rejects_mp_that_splits_kv_heads():
+    # 32 query heads but only 2 kv heads: mp=4 divides the query heads
+    # yet MUST be rejected (GQA shards num_kv_heads)
+    cands = [Candidate(mp=4, dp=2), Candidate(mp=2, dp=4),
+             Candidate(mp=1, dp=8)]
+    kept = prune_by_divisibility(cands, num_heads=32, num_kv_heads=2)
+    assert [c.mp for c in kept] == [2, 1]
+    # without the kv-head info the old rule would have kept mp=4
+    legacy = prune_by_divisibility(cands, num_heads=32)
+    assert [c.mp for c in legacy] == [4, 2, 1]
+
+
+def test_prune_rejects_mp_that_splits_vocab():
+    cands = [Candidate(mp=4, dp=2), Candidate(mp=2, dp=4)]
+    # vocab 1026 = 2 * 513: mp=4 cannot shard the embedding/head
+    kept = prune_by_divisibility(cands, num_heads=8, vocab_size=1026)
+    assert [c.mp for c in kept] == [2]
+
+
+def test_prune_sep_divisibility():
+    cands = [Candidate(sep=4, dp=2), Candidate(sep=2, dp=4),
+             Candidate(sep=8, dp=1)]
+    kept = prune_by_divisibility(cands, num_heads=4, seq_len=64)
+    assert [c.sep for c in kept] == [4, 2]  # sep=8 > 4 heads
+    # GQA: the Ulysses head-sharded phase hits the kv-head constraint
+    # the same way mp does — sep=4 with 2 kv heads must be rejected
+    kept_gqa = prune_by_divisibility(cands, num_heads=4, num_kv_heads=2,
+                                     seq_len=64)
+    assert [c.sep for c in kept_gqa] == [2]
+
+
+def test_default_candidates_sep_axis_and_world():
+    cands = default_candidates(8, max_sep=8)
+    assert any(c.sep > 1 for c in cands)
+    assert all(c.world == 8 for c in cands)
+    # back-compat: default enumeration has no sep axis
+    assert all(c.sep == 1 for c in default_candidates(8))
+
+
+# ---------------------------------------------------------------------------
+# memory + step-time models: hand-checked on a synthetic desc
+# ---------------------------------------------------------------------------
+
+def _toy_desc():
+    return ModelDesc(
+        name="toy", num_layers=4, hidden_size=64, num_heads=4,
+        num_kv_heads=4, vocab_size=256, ffn_size=256, seq_len=32,
+        param_count=1_000_000, param_bytes=4_000_000,
+        flops_fwd_per_sample=1e9, act_peak_bytes_per_sample=8_000_000)
+
+
+def test_predict_memory_hand_computed():
+    topo = Topology(chips=8, slice_chips=8, hbm_bytes=1 << 30,
+                    peak_flops=1e12)
+    mem = predict_memory(_toy_desc(), Candidate(dp=8), topo,
+                         global_batch=8, recompute=False)
+    # no model sharding: params 4e6, grads 4e6, opt 8e6; mbs=1 -> act 8e6
+    assert mem["params_bytes"] == 4_000_000
+    assert mem["grads_bytes"] == 4_000_000
+    assert mem["opt_bytes"] == 8_000_000
+    assert mem["act_bytes"] == 8_000_000
+    assert mem["total_bytes"] == 24_000_000
+    assert mem["fits"]
+    # mp=2: params/grads/opt halve
+    mem2 = predict_memory(_toy_desc(), Candidate(dp=4, mp=2), topo,
+                          global_batch=8, recompute=False)
+    assert mem2["params_bytes"] == 2_000_000
+    assert mem2["opt_bytes"] == 4_000_000
+    # recompute strictly reduces activation memory
+    mem3 = predict_memory(_toy_desc(), Candidate(dp=8), topo,
+                          global_batch=8, recompute=True)
+    assert mem3["act_bytes"] < mem["act_bytes"]
+
+
+def test_predict_step_time_dp_allreduce_hand_computed():
+    # uniform link so the hand formula is exact
+    topo = Topology(chips=8, slice_chips=8, ici=LinkSpec(1.0, 1.0),
+                    dcn=LinkSpec(1.0, 1.0), hbm_bytes=1 << 30,
+                    peak_flops=1e12)
+    desc = _toy_desc()
+    pred = predict_step_time(desc, Candidate(dp=8), topo,
+                             global_batch=8, recompute=False)
+    # compute: 3 * 1e9 * 8 / 8 chips / (1e12 * 0.5 MFU) = 6 ms
+    assert pred["compute_s"] == pytest.approx(6e-3)
+    assert pred["bubble_s"] == 0.0
+    (ar,) = pred["comm"]
+    assert (ar["op"], ar["axis"], ar["count"]) == ("all-reduce", "dp", 1)
+    # grads 4 MB over dp=8 on the 1 GB/s link
+    assert ar["seconds"] == pytest.approx(
+        all_reduce_s(4_000_000, 8, topo.ici))
+    assert pred["step_time_s"] == pytest.approx(
+        pred["compute_s"] + pred["comm_s"])
+
+
+def test_predict_step_time_pipeline_bubble():
+    topo = Topology(chips=8, slice_chips=8, hbm_bytes=1 << 30,
+                    peak_flops=1e12)
+    desc = _toy_desc()
+    p1 = predict_step_time(desc, Candidate(pp=4, dp=2, micro_batch=1),
+                           topo, global_batch=8, recompute=False)
+    p8 = predict_step_time(desc, Candidate(pp=4, dp=2, micro_batch=8),
+                           topo, global_batch=8, recompute=False)
+    # bubble fraction (p-1)/(m+p-1): 3/4 at m=1, 3/11 at m=8
+    assert p1["bubble_fraction"] == pytest.approx(3 / 4)
+    assert p8["bubble_fraction"] == pytest.approx(3 / 11)
+    assert p8["bubble_s"] < p1["bubble_s"]
+
+
+# ---------------------------------------------------------------------------
+# HLO counting helpers
+# ---------------------------------------------------------------------------
+
+def test_parse_replica_groups_explicit_and_iota():
+    txt = ('%r = f32[8]{0} all-reduce(f32[8]{0} %x), '
+           'replica_groups={{0,1},{2,3}}, to_apply=%add\n'
+           '%g = f32[8]{0} all-gather(f32[4]{0} %y), '
+           'replica_groups=[2,4]<=[8], dimensions={0}\n'
+           '%t = f32[8]{0} all-to-all(f32[8]{0} %z), '
+           'replica_groups=[4,2]<=[2,4]T(1,0)\n'
+           '%d = f32[8]{0} all-reduce-done(f32[8]{0} %r)\n')
+    found = count_hlo_collectives(txt)
+    assert [op for op, _ in found] == \
+        ["all-reduce", "all-gather", "all-to-all"]
+    assert found[0][1] == frozenset({(0, 1), (2, 3)})
+    assert found[1][1] == frozenset({(0, 1, 2, 3), (4, 5, 6, 7)})
+    # iota with transpose: arange(8).reshape(2,4).T.reshape(4,2)
+    assert found[2][1] == frozenset({(0, 4), (1, 5), (2, 6), (3, 7)})
+
+
+def test_axis_groups_matches_communicate_topology():
+    from paddle_tpu.distributed.topology import CommunicateTopology
+    dims = {"dp": 2, "pp": 2, "sharding": 1, "sep": 1, "mp": 2}
+    topo = CommunicateTopology(
+        hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
+        dims=[2, 2, 1, 1, 2])
+    for axis, ref in (("dp", "data"), ("pp", "pipe"), ("mp", "model")):
+        assert axis_groups(dims, axis) == \
+            frozenset(tuple(g) for g in topo.get_comm_list(ref))
+
+
+# ---------------------------------------------------------------------------
+# plan object: roles, serialization, fingerprint
+# ---------------------------------------------------------------------------
+
+def test_spec_roles_cover_both_model_families():
+    plan = Plan(mesh={"mp": 2}, specs=build_specs(2))
+    # GPT family
+    assert plan.spec_for("wte.weight") == ["mp", None]
+    assert plan.spec_for("wpe.weight") == [None, None]
+    assert plan.spec_for("blocks.0.attn.qkv.weight") == [None, "mp"]
+    assert plan.spec_for("blocks.0.attn.qkv.bias") == ["mp"]
+    assert plan.spec_for("blocks.0.attn.proj.weight") == ["mp", None]
+    assert plan.spec_for("blocks.3.mlp.fc.weight") == [None, "mp"]
+    assert plan.spec_for("blocks.3.mlp.proj.weight") == ["mp", None]
+    # Llama family
+    assert plan.spec_for("embed_tokens.weight") == ["mp", None]
+    assert plan.spec_for("layers.0.self_attn.k_proj.weight") == \
+        [None, "mp"]
+    assert plan.spec_for("layers.0.self_attn.o_proj.weight") == \
+        ["mp", None]
+    assert plan.spec_for("layers.1.mlp.gate_proj.weight") == [None, "mp"]
+    assert plan.spec_for("layers.1.mlp.down_proj.weight") == ["mp", None]
+    assert plan.spec_for("lm_head.weight") == [None, "mp"]
+    # norms fall through to fleet's default (replicated)
+    assert plan.spec_for("blocks.0.ln1.weight") is None
+    assert plan.spec_for("norm.weight") is None
+    # mp=1: no specs at all
+    assert build_specs(1) == {}
+
+
+def test_plan_json_round_trip_and_fingerprint():
+    plan = Plan(mesh={"dp": 2, "mp": 2, "pp": 2}, specs=build_specs(2),
+                schedule={"micro_batches": 4, "schedule_mode": "1F1B",
+                          "stages": [2, 2]},
+                recompute={"enable": True, "policy": "full"},
+                global_batch=64, seq_len=128,
+                model={"name": "gpt-tiny"},
+                topology=Topology.from_spec("cpu:8").to_dict(),
+                predicted={"step_time_s": 0.01})
+    j1 = plan.to_json()
+    p2 = Plan.from_json(j1)
+    assert p2.to_json() == j1                      # byte-stable
+    assert p2.fingerprint() == plan.fingerprint()
+    # predictions do NOT change identity; mesh does
+    p2.predicted["step_time_s"] = 99.0
+    assert p2.fingerprint() == plan.fingerprint()
+    p2.mesh["mp"] = 1
+    assert p2.fingerprint() != plan.fingerprint()
+    # a future version must refuse to load silently
+    d = plan.to_dict()
+    d["version"] = 99
+    with pytest.raises(ValueError):
+        Plan.from_dict(d)
+    assert json.loads(j1)["fingerprint"] == plan.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# search pipeline
+# ---------------------------------------------------------------------------
+
+def test_plan_search_end_to_end_gpt_tiny():
+    paddle.seed(0)
+    res = plan_search(_gpt_tiny(), topology="cpu:8", global_batch=32,
+                      seq_len=32, top=3)
+    assert res.plans and res.n_scored > 0
+    best = res.best
+    assert best.world == 8
+    ranking = res.ranking()
+    assert all(ranking[i].score <= ranking[i + 1].score
+               for i in range(len(ranking) - 1))
+    # the plan carries the full decision record
+    assert best.predicted["step_time_s"] > 0
+    assert best.predicted["per_chip_hbm_bytes"] > 0
+    assert sum(best.schedule["stages"]) == best.model["num_layers"]
+    # planner metrics emitted
+    import paddle_tpu.observability as obs
+    assert obs.value("paddle_tpu_planner_candidates_total",
+                     stage="scored") > 0
+
+
+def test_plan_search_memory_filter_rejects_before_scoring():
+    paddle.seed(0)
+    res = plan_search(_gpt_tiny(), topology="cpu:8", global_batch=32,
+                      seq_len=32, hbm_budget_bytes=64 << 10)
+    assert not res.plans                    # nothing fits 64 KiB
+    assert res.n_memory_rejected > 0
+    for sc in res.scored:
+        if "HBM" in sc.reject_reason:
+            assert not sc.feasible
+            assert sc.predicted == {}       # rejected BEFORE scoring
+            assert "recompute" in sc.reject_reason
+            break
+    else:
+        pytest.fail("no memory rejection recorded")
+
+
+def test_plan_search_dcn_placement_rejects_mp_across_slices():
+    paddle.seed(0)
+    topo = Topology.from_spec("chips=8,slice=2,ici_gbps=100,dcn_gbps=1,"
+                              "hbm_gb=8,peak_tflops=0.1")
+    res = plan_search(_gpt_tiny(), topology=topo, global_batch=32,
+                      seq_len=32)
+    assert res.n_placement_rejected > 0
+    bad = [s for s in res.scored if "DCN" in s.reject_reason]
+    assert bad and all(not s.feasible for s in bad)
+    # mp of every surviving plan fits inside one 2-chip slice
+    for p in res.plans:
+        assert p.degree("mp") * p.degree("sep") <= 2
+
+
+def test_plan_search_gqa_prunes_mp_beyond_kv_heads():
+    paddle.seed(0)
+    res = plan_search(_llama_tiny(), topology="cpu:8", global_batch=32,
+                      seq_len=32)
+    # llama-tiny has 2 kv heads: no scored candidate may exceed mp=2
+    assert res.n_scored > 0
+    assert all(s.candidate.mp <= 2 for s in res.scored)
+
+
+# ---------------------------------------------------------------------------
+# validation: the HLO collective-count proof
+# ---------------------------------------------------------------------------
+
+@NEEDS_MESH
+@pytest.mark.parametrize("build", [_gpt_tiny, _llama_tiny],
+                         ids=["gpt-tiny", "llama-tiny"])
+def test_best_plan_proves_against_hlo(build):
+    paddle.seed(0)
+    res = plan_search(build(), topology="cpu:8", global_batch=32,
+                      seq_len=32)
+    report = validate_plan(res.best)
+    assert report.ok, report.failures()
+    assert report.checks  # at least one probe ran
+    for c in report.checks:
+        assert c["observed"] == c["predicted"]
+
+
+@NEEDS_MESH
+def test_all_five_axes_prove_against_hlo():
+    for mesh in ({"dp": 2, "pp": 2, "sharding": 2},
+                 {"dp": 2, "sep": 2, "mp": 2}):
+        report = validate_plan(Plan(mesh=mesh))
+        assert report.ok, (mesh, report.failures())
+    axes = {c["axis"] for m in ({"dp": 2, "pp": 2, "sharding": 2},
+                                {"dp": 2, "sep": 2, "mp": 2})
+            for c in validate_plan(Plan(mesh=m)).checks}
+    assert axes == {"dp", "pp", "sharding", "sep", "mp"}
+
+
+@NEEDS_MESH
+def test_validation_gates_on_wrong_prediction(monkeypatch):
+    """The proof must be falsifiable: a probe predicting TWO all-reduces
+    where the HLO holds one must read MISMATCH."""
+    from paddle_tpu.planner import validate as V
+
+    def lying_probe(mesh, dims):
+        txt, _ = V._probe_mp(mesh, dims)
+        return txt, [("all-reduce", "mp", 2)]
+
+    monkeypatch.setattr(V, "_PROBES",
+                        (("mp", "lying-probe", lying_probe),))
+    report = V.validate_plan(Plan(mesh={"mp": 2}))
+    assert not report.ok
+    (fail,) = report.failures()
+    assert fail["predicted"] == 2 and fail["observed"] == 1
+
+
+def test_validation_gates_on_memory_smuggle():
+    """A deserialized plan claiming more HBM than its own topology
+    budget must fail the re-assertion (no probes needed)."""
+    plan = Plan(mesh={"dp": 1},
+                topology={"hbm_bytes": 1 << 20, "name": "cpu", "chips": 1},
+                predicted={"per_chip_hbm_bytes": 2 << 20})
+    report = validate_plan(plan)
+    assert not report.ok and not report.memory_ok
+    # stripping the predicted block (or the budget) is the same smuggle:
+    # a plan carrying one side but not the other must fail, not skip
+    stripped = Plan(mesh={"dp": 1},
+                    topology={"hbm_bytes": 1 << 20, "name": "cpu",
+                              "chips": 1})
+    assert not validate_plan(stripped).memory_ok
+    # a bare probe plan (no topology, no predictions) has nothing to
+    # verify and stays ok
+    assert validate_plan(Plan(mesh={"dp": 1})).memory_ok
+
+
+# ---------------------------------------------------------------------------
+# apply_plan + one train step (the end-to-end acceptance)
+# ---------------------------------------------------------------------------
+
+@NEEDS_MESH
+@pytest.mark.parametrize("build,vocab", [(_gpt_tiny, 1024),
+                                         (_llama_tiny, 256)],
+                         ids=["gpt-tiny", "llama-tiny"])
+def test_apply_plan_trains_one_step(build, vocab):
+    paddle.seed(0)
+    model = build()
+    res = plan_search(model, topology="cpu:8", global_batch=32,
+                      seq_len=32, top=10)
+    plan = next(p for p in res.plans if p.degree("pp") == 1)
+    apply_plan(model, plan)
+
+    from paddle_tpu.distributed.topology import get_mesh
+    mesh = get_mesh()
+    assert mesh is not None and mesh.devices.size == 8
+    # the plan's specs actually landed on the parameters
+    if plan.degree("mp") > 1:
+        marked = [p for n, p in model.named_parameters()
+                  if plan.spec_for(n) is not None]
+        assert marked
+        assert any("mp" in tuple(p._sharding_spec or ())
+                   for p in marked)
+
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.integers(0, vocab, (8, 32)).astype("int32"))
+    y = paddle.to_tensor(rng.integers(0, vocab, (8, 32)).astype("int32"))
+
+    @paddle.jit.to_static
+    def step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    l0 = float(step(x, y))
+    l1 = float(step(x, y))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert l1 < l0
+
+
+@NEEDS_MESH
+def test_apply_plan_records_fingerprint_in_flight():
+    from paddle_tpu.observability.flight import _fingerprint
+    from paddle_tpu.planner import active_plan
+
+    paddle.seed(0)
+    model = _gpt_tiny()
+    res = plan_search(model, topology="cpu:8", global_batch=32,
+                      seq_len=32, top=10)
+    plan = next(p for p in res.plans if p.degree("pp") == 1)
+    apply_plan(model, plan)
+    assert active_plan()["fingerprint"] == plan.fingerprint()
+    fp = _fingerprint()
+    assert fp["plan"]["fingerprint"] == plan.fingerprint()
+    assert fp["plan"]["mesh"] == {a: plan.degree(a) for a in MESH_AXES}
+
+
+@NEEDS_MESH
+def test_refine_measured_reranks_and_records():
+    paddle.seed(0)
+    res = plan_search(_gpt_tiny(), topology="cpu:8", global_batch=32,
+                      seq_len=32, top=10)
+    plans = [p for p in res.plans if p.degree("pp") == 1][:2]
+    res.plans = plans
+
+    def build(plan):
+        paddle.seed(0)
+        model = _gpt_tiny()
+        wrapped = apply_plan(model, plan)  # forward shards the batch
+        opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(
+            rng.integers(0, 1024, (4, 32)).astype("int32"))
+        y = paddle.to_tensor(
+            rng.integers(0, 1024, (4, 32)).astype("int32"))
+
+        @paddle.jit.to_static
+        def step(x, y):
+            _, loss = wrapped(x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return step, (x, y)
+
+    ranked = refine_plans(res, build, mode="measured", top=2,
+                          steps=2, warmup=1)
+    assert len(ranked) == 2
+    times = [p.predicted.get("measured_step_s") for p in ranked]
+    assert all(t is not None and t > 0 for t in times)
+    assert times == sorted(times)
+    # topology left clean after trials
+    from paddle_tpu.distributed.topology import get_mesh
+    assert get_mesh() is None
+
+
+# ---------------------------------------------------------------------------
+# ModelDesc + CLI
+# ---------------------------------------------------------------------------
+
+def test_model_desc_from_models():
+    paddle.seed(0)
+    d = ModelDesc.from_model(_gpt_tiny(), seq_len=32)
+    assert (d.num_layers, d.num_heads, d.num_kv_heads) == (2, 4, 4)
+    assert d.vocab_size == 1024
+    assert d.flops_fwd_per_sample > 0
+    assert d.act_peak_bytes_per_sample > 0
+    assert d.param_bytes == d.param_count * 4
+    d2 = ModelDesc.from_dict(d.to_dict())
+    assert d2.to_dict() == d.to_dict()
+    dl = ModelDesc.from_model(_llama_tiny(), seq_len=32)
+    assert dl.num_kv_heads == 2 and dl.ffn_size == 128
+    with pytest.raises(ValueError):
+        ModelDesc.from_model(_gpt_tiny(), seq_len=4096)  # > max pos
+
+
+@NEEDS_MESH
+def test_cli_json_and_validate(capsys):
+    from paddle_tpu.planner.__main__ import main
+    rc = main(["--model", "gpt-tiny", "--topology", "cpu:8",
+               "--format", "json", "--validate", "--top", "2"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["plans"] and payload["validation"]["ok"]
+    assert payload["n_scored"] > 0
+
+
+def test_cli_text_smoke(capsys):
+    from paddle_tpu.planner.__main__ import main
+    rc = main(["--model", "llama-tiny", "--topology", "cpu:8"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "chosen:" in out and "fingerprint=" in out

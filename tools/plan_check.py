@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""CI gate: the parallelism planner must emit PROVABLY valid plans.
+
+For each bench model config (gpt-tiny, llama-tiny — the two model
+families the planner's spec roles cover) on the 8-device CPU mesh:
+
+1. **search** — ``plan_search`` must produce a feasible plan (and count
+   its pipeline stages: enumerate/prune/score numbers must be sane);
+2. **HLO proof** — ``validate_plan`` compiles one probe per parallel
+   axis the plan uses and the predicted per-(op, group) collective
+   counts must match the compiled HLO EXACTLY (the PR 6 proof
+   machinery); any mismatch fails the gate;
+3. **memory filter** — re-running the search under a deliberately tiny
+   HBM budget must reject candidates as memory-infeasible BEFORE
+   scoring (n_memory_rejected > 0 and every rejection carries the
+   budget in its reason), proving OOM configs can never be emitted;
+4. **round-trip** — ``to_json -> from_json -> to_json`` must be
+   byte-stable and fingerprint-preserving (plans are artifacts other
+   tooling stores and diffs).
+
+Exit 0 when every check passes on every model; 1 otherwise.
+Usage: python tools/plan_check.py [--model gpt-tiny|llama-tiny]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+MODELS = ("gpt-tiny", "llama-tiny")
+#: tiny budget that no transformer fits in, to prove the filter fires
+TINY_BUDGET = 64 << 10
+
+
+def _build(name):
+    # ONE model registry for gate + CLI: the gate must prove exactly the
+    # configs the CLI plans
+    from paddle_tpu.planner.__main__ import build_model
+    return build_model(name)
+
+
+def check_model(name: str) -> list:
+    """All failures for one model ([] = green)."""
+    from paddle_tpu.planner import (ModelDesc, Plan, plan_search,
+                                    validate_plan)
+
+    failures = []
+    model = _build(name)
+    desc = ModelDesc.from_model(model, seq_len=32, name=name)
+
+    # 1. search
+    res = plan_search(desc=desc, topology="cpu:8", global_batch=32, top=3)
+    if not res.plans:
+        return [f"{name}: no feasible plan "
+                f"(scored {res.n_scored} of {res.n_enumerated})"]
+    if res.n_scored <= 0 or res.n_enumerated <= res.n_pruned:
+        failures.append(f"{name}: degenerate search "
+                        f"({res.n_enumerated} enumerated, "
+                        f"{res.n_pruned} pruned, {res.n_scored} scored)")
+    best = res.best
+    print(f"  {name}: chose {best.summary()} "
+          f"(predicted {best.predicted['step_time_s'] * 1e3:.2f} ms/step, "
+          f"{res.n_scored} candidates scored in "
+          f"{res.search_seconds * 1e3:.0f} ms)")
+
+    # 2. HLO collective-count proof
+    report = validate_plan(best)
+    if not report.ok:
+        for f in report.failures():
+            failures.append(f"{name}: HLO validation mismatch: {f}")
+    else:
+        print(f"  {name}: HLO proof OK "
+              f"({len(report.checks)} probe checks)")
+
+    # 3. memory filter fires under a tiny budget, BEFORE scoring
+    starved = plan_search(desc=desc, topology="cpu:8", global_batch=32,
+                          hbm_budget_bytes=TINY_BUDGET, top=1)
+    if starved.n_memory_rejected == 0:
+        failures.append(f"{name}: memory filter never fired under a "
+                        f"{TINY_BUDGET}-byte budget")
+    rejected = [s for s in starved.scored
+                if not s.feasible and "HBM" in s.reject_reason]
+    if not rejected:
+        failures.append(f"{name}: no candidate carries a memory "
+                        f"reject_reason under the tiny budget")
+    for s in rejected:
+        if s.predicted:
+            failures.append(f"{name}: {s.candidate!r} was scored "
+                            f"DESPITE failing the memory filter")
+            break
+    else:
+        print(f"  {name}: memory filter rejected "
+              f"{starved.n_memory_rejected} oversized candidates "
+              f"before scoring")
+
+    # 4. json round-trip stability
+    j1 = best.to_json()
+    p2 = Plan.from_json(j1)
+    if p2.to_json() != j1:
+        failures.append(f"{name}: plan JSON round-trip is not stable")
+    if p2.fingerprint() != best.fingerprint():
+        failures.append(f"{name}: fingerprint changed across round-trip")
+    return failures
+
+
+def check_probes() -> list:
+    """Model-independent sweep: every probe family must prove on meshes
+    that exercise ALL FIVE axes (a chosen plan typically uses 2-3, so
+    the per-model check alone would leave probes untested)."""
+    from paddle_tpu.planner import Plan, validate_plan
+
+    failures = []
+    for mesh in ({"dp": 2, "pp": 2, "sharding": 2},
+                 {"dp": 2, "sep": 2, "mp": 2}):
+        report = validate_plan(Plan(mesh=mesh))
+        if not report.ok:
+            for f in report.failures():
+                failures.append(f"probe sweep {mesh}: {f}")
+    if not failures:
+        print("  probe sweep: all five axes prove against compiled HLO")
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=MODELS, default=None,
+                    help="check one model instead of all")
+    args = ap.parse_args(argv)
+
+    import jax
+    if jax.device_count() < 8:
+        print(f"plan_check: need the 8-device CPU mesh, have "
+              f"{jax.device_count()} (set XLA_FLAGS before jax init)")
+        return 1
+
+    failures = check_probes()
+    for name in ([args.model] if args.model else MODELS):
+        print(f"plan_check: {name}")
+        try:
+            failures += check_model(name)
+        except Exception as e:
+            import traceback
+            traceback.print_exc()
+            failures.append(f"{name}: crashed: {type(e).__name__}: {e}")
+        finally:
+            from paddle_tpu.distributed.topology import \
+                reset_topology_state
+            reset_topology_state()
+
+    if failures:
+        print("plan_check FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("plan_check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
