@@ -13,6 +13,12 @@ lints the traced *jaxpr* — fusion boundaries, HBM traffic, implied
 reshards, peak liveness — via ``to_static(..., analyze=True)`` or
 ``python -m paddle_tpu.analysis.graph``.
 
+**Concurrency tier** (:mod:`paddle_tpu.analysis.concurrency`, rules
+CS100-CS105): lock discipline for the threaded serving/observability
+runtimes — inconsistent guards, lock-order inversions, signal-unsafe
+handlers — plus the ``PADDLE_TPU_TSAN=1`` runtime thread-sanitizer
+(``python -m paddle_tpu.analysis.concurrency``, ``tools/tsan_check.py``).
+
 AST-tier entry points:
 
 * ``to_static(..., lint=True)`` or ``PADDLE_TPU_JIT_LINT=1`` — lint at

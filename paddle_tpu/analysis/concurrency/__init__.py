@@ -1,0 +1,160 @@
+"""paddle_tpu.analysis.concurrency — the concurrency analysis tier.
+
+Third tier of the analysis stack (AST trace-safety TS0xx, jaxpr graph
+GA1xx, and now lock discipline CS1xx): static checks plus a runtime
+thread-sanitizer for the code that made the runtime genuinely concurrent
+— the serving scheduler/engine/PagePool, the telemetry HTTP server, the
+flight ring buffer, the async CheckpointManager, ``prefetch_to_device``
+and the windowed metrics.
+
+**Static tier** (:mod:`.rules`, stable ids CS100-CS105): inconsistent
+lock guards, lock-order inversions from the nested-``with`` graph,
+signal-unsafe handler bodies, unbounded shutdown waits, broken
+double-checked init, threads started mid-``__init__``.
+
+**Runtime tier** (:mod:`.tsan`, ``PADDLE_TPU_TSAN=1``): instrumented
+Lock/RLock/Condition wrappers maintaining per-thread held-lock sets and
+a global acquisition-order graph (cycle ⇒ inversion report carrying both
+acquisition stacks), plus sampled shared-attribute write checking that
+confirms — or kills — the static findings. Reports surface as flight
+events and ``paddle_tpu_tsan_*`` metrics.
+
+Entry points:
+
+* ``python -m paddle_tpu.analysis.concurrency <paths>`` — house-style
+  CLI (``--format json``/``--select``/``--min-severity``/
+  ``--list-rules``), exit 1 on unwaived error findings. Waivers live in
+  ``tools/cs_allowlist.txt`` (auto-discovered walking up from the
+  analyzed paths), one ``<file-suffix> <rule>`` per line with a
+  justification comment.
+* ``tools/tsan_check.py`` — the CI gate: serving + chaos + telemetry
+  suites re-run under ``PADDLE_TPU_TSAN=1``, zero unwaived reports.
+* ``python -m paddle_tpu.analysis.concurrency.demo`` — a deliberately
+  planted lock inversion + racy write, linted statically and confirmed
+  at runtime (the static↔runtime bridge, end to end).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..diagnostics import ERROR, Finding  # noqa: F401 (re-export)
+from . import tsan  # noqa: F401  (paddle.analysis.concurrency.tsan)
+from .tsan import (  # noqa: F401
+    TsanCondition, TsanLock, TsanRLock, condition, lock, note_write,
+    rlock,
+)
+
+# the rule engine (.rules, ~850 lines) loads LAZILY: every threaded
+# runtime module (metrics, scheduler, PagePool, checkpoint, server)
+# imports this package at ITS import time just for the tsan factories,
+# and must not pay for — or depend on — the linter machinery
+_LAZY_RULES = ("RULES", "Rule", "check_module")
+
+
+def __getattr__(name):
+    if name in _LAZY_RULES:
+        from . import rules as _rules
+        return getattr(_rules, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+__all__ = [
+    "Rule", "RULES", "check_module",
+    "analyze_source", "analyze_file", "analyze_paths", "has_errors",
+    "load_allowlist", "apply_allowlist", "discover_allowlist",
+    "tsan", "lock", "rlock", "condition", "note_write",
+    "TsanLock", "TsanRLock", "TsanCondition",
+]
+
+ALLOWLIST_NAME = os.path.join("tools", "cs_allowlist.txt")
+
+
+def analyze_source(source: str, filename: str = "<string>") -> list:
+    """Lint one module's source with the CS rules; sorted findings."""
+    from .rules import check_module
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []  # the TS tier owns parse errors (TS000)
+    return check_module(tree, filename)
+
+
+def analyze_file(path: str) -> list:
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except OSError:
+        return []
+    return analyze_source(src, filename=path)
+
+
+def analyze_paths(paths) -> list:
+    """Lint every .py file under the given files/directories (same file
+    discovery as the AST tier — one walker, one file set)."""
+    from ..engine import _iter_py_files
+    findings: list = []
+    for path in _iter_py_files(paths):
+        findings.extend(analyze_file(path))
+    findings.sort(key=lambda f: f.sort_key())
+    return findings
+
+
+def has_errors(findings) -> bool:
+    return any(f.severity == ERROR for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# allowlist (house style: tools/ga_allowlist.txt, tools/tsan_allowlist.txt)
+# ---------------------------------------------------------------------------
+
+def load_allowlist(path) -> set:
+    """``{(file_suffix, rule_id), ...}`` from one ``<path> <rule>``-per-
+    line file; ``#`` comments carry the mandatory justification."""
+    out = set()
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                if len(parts) >= 2:
+                    out.add((parts[0].replace("\\", "/"),
+                             parts[1].upper()))
+    except OSError:
+        pass
+    return out
+
+
+def discover_allowlist(paths) -> str | None:
+    """Walk up from each analyzed path looking for
+    ``tools/cs_allowlist.txt`` (the repo-root convention)."""
+    for p in paths:
+        d = os.path.abspath(p)
+        if not os.path.isdir(d):
+            d = os.path.dirname(d)
+        while True:
+            cand = os.path.join(d, ALLOWLIST_NAME)
+            if os.path.isfile(cand):
+                return cand
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return None
+
+
+def apply_allowlist(findings, entries) -> tuple:
+    """(kept, waived) after dropping findings matching an allowlist
+    entry (finding file endswith the entry path, rule ids equal)."""
+    kept, waived = [], []
+    for f in findings:
+        file = f.file.replace("\\", "/")
+        if any(file.endswith(suffix) and f.rule_id == rule
+               for suffix, rule in entries):
+            waived.append(f)
+        else:
+            kept.append(f)
+    return kept, waived

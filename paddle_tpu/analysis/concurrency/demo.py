@@ -1,0 +1,106 @@
+"""Deliberately planted concurrency bugs — the static↔runtime bridge demo.
+
+This module exists to be WRONG, on purpose, twice:
+
+* :class:`PlantedInversion` acquires its two locks in opposite orders on
+  two paths — the static tier flags both sites (CS101), and running the
+  paths under ``PADDLE_TPU_TSAN=1`` closes a cycle in the sanitizer's
+  acquisition-order graph, producing a ``lock_inversion`` report whose
+  ``static_rule`` field names CS101 back.
+* :class:`PlantedRace` writes a counter with and without its guard lock
+  — CS100 statically, a ``racy_write`` report dynamically.
+
+Both findings are waived in ``tools/cs_allowlist.txt`` (the one
+sanctioned use of the waiver file): the repo gate stays clean while the
+bridge stays demonstrable end to end:
+
+    python -m paddle_tpu.analysis.concurrency paddle_tpu/analysis/concurrency/demo.py --no-allowlist
+    PADDLE_TPU_TSAN=1 python -m paddle_tpu.analysis.concurrency.demo
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import tsan
+
+
+class PlantedInversion:
+    """Lock order a→b on one path, b→a on the other (CS101)."""
+
+    def __init__(self):
+        self.lock_a = tsan.lock("demo.lock_a")
+        self.lock_b = tsan.lock("demo.lock_b")
+        self.balance = 0
+
+    def transfer_ab(self):
+        with self.lock_a:
+            with self.lock_b:
+                self.balance += 1
+
+    def transfer_ba(self):
+        with self.lock_b:
+            with self.lock_a:
+                self.balance -= 1
+
+
+class PlantedRace:
+    """A hit counter guarded on one path, bare on the other (CS100)."""
+
+    def __init__(self):
+        self._lock = tsan.lock("demo.race")
+        self.hits = 0
+
+    def guarded_hit(self):
+        with self._lock:
+            self.hits += 1
+            tsan.note_write(self, "hits", self._lock)
+
+    def unguarded_hit(self):
+        self.hits += 1
+        tsan.note_write(self, "hits", self._lock)
+
+
+def run_demo(rounds: int = 8) -> list:
+    """Exercise both planted bugs from two threads; returns the
+    sanitizer reports (empty unless ``tsan`` is enabled).
+
+    The two lock paths run on SEQUENTIAL threads on purpose: the
+    acquisition-order graph catches the inversion from the observed
+    orders alone — letting the ABBA pair actually race would make the
+    demo itself deadlock, which is the bug class, not a demo of it."""
+    inv = PlantedInversion()
+    race = PlantedRace()
+
+    def left():
+        for _ in range(rounds):
+            inv.transfer_ab()
+            race.guarded_hit()
+
+    def right():
+        for _ in range(rounds):
+            inv.transfer_ba()
+            race.unguarded_hit()
+
+    for target, name in ((left, "demo-left"), (right, "demo-right")):
+        t = threading.Thread(target=target, name=name)
+        t.start()
+        t.join(timeout=30.0)
+    return tsan.reports()
+
+
+def main() -> int:
+    tsan.enable(True)
+    reps = run_demo()
+    print(f"{len(reps)} sanitizer report(s):")
+    for r in reps:
+        locks = r.get("locks") or [r.get("owner"), r.get("field")]
+        print(f"  {r['kind']} [{r.get('static_rule')}] "
+              f"{' / '.join(str(x) for x in locks)}")
+    kinds = {r["kind"] for r in reps}
+    # the demo's contract: both planted bugs must be caught
+    return 0 if {"lock_inversion", "racy_write"} <= kinds else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,865 @@
+"""Lock-discipline rules: AST checks over threaded runtime code.
+
+The third analysis tier (after the AST trace-safety tier TS0xx and the
+jaxpr graph tier GA1xx): concurrency correctness for the serving and
+observability runtimes, whose scheduler/engine/PagePool/telemetry-server/
+flight/checkpoint/profiler threads share mutable state across threads
+and signal handlers.
+
+Like the TS tier this is a **linter, not a prover** — intraprocedural
+with two deliberate extensions that kill the worst false-positive
+families:
+
+* **guard tracking**: a ``with self._lock:`` (or module-lock) block marks
+  the attribute accesses inside it as guarded; and
+* **call-site guard propagation**: a helper method whose every in-class
+  call site runs with lock L held is analyzed as if its body held L
+  (``_note_tick``-style "call under self._lock" helpers), iterated to a
+  fixpoint.
+
+Scope notes (documented honesty, mirrors the TS tier): analysis is
+per-file; cross-object concurrency (a thread in class A driving class B)
+is the runtime sanitizer's job (``tsan.py``), which is exactly why the
+tier ships both halves.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ..diagnostics import ERROR, INFO, WARNING, Finding
+
+__all__ = ["Rule", "RULES", "check_module"]
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str
+    hint: str
+
+
+RULES = {r.id: r for r in [
+    Rule("CS100", "inconsistent-lock-guard", ERROR,
+         "shared attribute accessed under the class's guard lock in one "
+         "method but written without it in another — a data race between "
+         "the locking and non-locking paths",
+         "hold the same lock around every write (and cross-thread read) "
+         "of the attribute, or document single-thread ownership and drop "
+         "the lock from the other path"),
+    Rule("CS101", "lock-order-inversion", ERROR,
+         "two locks are acquired in opposite orders on different paths — "
+         "the classic ABBA deadlock once both paths run concurrently",
+         "pick one global acquisition order and restructure the inner "
+         "acquisition out of the outer critical section"),
+    Rule("CS102", "signal-unsafe-handler", ERROR,
+         "a registered SIGTERM/SIGINT/excepthook handler takes locks, "
+         "records metrics, allocates threads or does blocking I/O — in "
+         "async-signal context a lock the interrupted frame holds "
+         "deadlocks the process",
+         "record a flag (plain attribute write / Event.set) plus "
+         "flight.record (the sanctioned lock-free path) in the handler; "
+         "do the heavy work at a step boundary or on a worker thread"),
+    Rule("CS103", "unbounded-shutdown-wait", WARNING,
+         "a shutdown/drain-path call blocks forever (join()/wait()/get() "
+         "with no timeout) — one stuck worker turns shutdown into a hang",
+         "pass an explicit timeout and emit a loud RuntimeWarning when "
+         "it expires (the house shutdown contract)"),
+    Rule("CS104", "broken-double-checked-init", WARNING,
+         "lazy init re-assigns shared state under a lock without "
+         "re-checking inside the critical section (or without any lock) "
+         "— two racing initializers each install their own instance",
+         "re-test the sentinel inside the locked block "
+         "(`if x is None: with lock: if x is None: x = ...`)"),
+    Rule("CS105", "thread-start-in-init", WARNING,
+         "__init__ starts a thread before the object is fully "
+         "constructed — the thread can observe attributes that are "
+         "assigned on lines below the start()",
+         "finish every attribute assignment first, or move the start() "
+         "into an explicit .start() method"),
+]}
+
+
+def _finding(rule_id, node, file, message, symbol=""):
+    r = RULES[rule_id]
+    return Finding(
+        rule_id=rule_id, severity=r.severity,
+        message=message or r.summary, file=file,
+        line=getattr(node, "lineno", 0),
+        col=getattr(node, "col_offset", 0),
+        end_line=(getattr(node, "end_lineno", None) or
+                  getattr(node, "lineno", 0)),
+        end_col=getattr(node, "end_col_offset", 0) or 0,
+        symbol=symbol, hint=r.hint)
+
+
+def _dotted(node) -> str | None:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+#: constructor call tails that produce a lock-like guard object
+_LOCK_CTOR_TAILS = {"Lock", "RLock", "Condition", "Semaphore",
+                    "BoundedSemaphore"}
+#: tsan factory tails (the instrumented-lock indirection)
+_TSAN_FACTORY_TAILS = {"lock", "rlock", "condition"}
+_TSAN_ROOTS = {"tsan", "_tsan", "concurrency"}
+
+
+def _is_lock_ctor(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    if d is None:
+        return False
+    parts = d.split(".")
+    if parts[-1] in _LOCK_CTOR_TAILS:
+        return True
+    return parts[-1] in _TSAN_FACTORY_TAILS and parts[0] in _TSAN_ROOTS
+
+
+#: method names treated as shutdown/drain paths for CS103
+_SHUTDOWN_NAME_PARTS = ("close", "shutdown", "drain", "stop", "teardown",
+                        "finalize", "uninstall", "maybe_exit", "__exit__",
+                        "__del__", "abort")
+
+#: calls a signal/excepthook handler may make (CS102): the flight
+#: recorder is lock-free by construction; Event.set / bounded Event.wait
+#: are the cooperative-flag pattern the stdlib signal docs recommend
+_SIGNAL_SANCTIONED_ROOTS = {"flight", "_flight"}
+
+#: receivers whose EVERY method takes a lock (metric handles) are found
+#: per file: module/class names bound from counter()/gauge()/histogram()
+_METRIC_FACTORY_TAILS = {"counter", "gauge", "histogram",
+                         "_obs_counter", "_obs_gauge", "_obs_histogram"}
+
+
+# ---------------------------------------------------------------------------
+# per-class model: locks, guarded accesses, call graph
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Access:
+    attr: str
+    kind: str              # "read" | "write"
+    guards: frozenset      # lexical guards held at the access
+    method: str
+    node: ast.AST
+
+
+@dataclass
+class MethodModel:
+    name: str
+    node: ast.AST
+    accesses: list = field(default_factory=list)
+    # in-class call sites this method makes: (callee_name, guards_held)
+    calls: list = field(default_factory=list)
+    # nested with-lock acquisition edges: (outer, inner, node)
+    nestings: list = field(default_factory=list)
+    # locks acquired anywhere in the body (guard name -> first node)
+    acquired: dict = field(default_factory=dict)
+    inherited: frozenset = frozenset()   # call-site-propagated guards
+
+
+class ClassModel:
+    """Locks, per-method guarded accesses, and the in-class call graph
+    of one ``class`` body."""
+
+    def __init__(self, cls: ast.ClassDef, module_locks: set):
+        self.node = cls
+        self.name = cls.name
+        self.module_locks = module_locks
+        self.lock_attrs: set[str] = set()
+        self.thread_targets: set[str] = set()
+        self.methods: dict[str, MethodModel] = {}
+        self._scan_locks(cls)
+
+    def walk_methods(self):
+        """Second phase — after :func:`_families` has unioned inherited
+        ``lock_attrs`` into this model, so ``with self._lock:`` guards
+        resolve in subclasses whose lock lives in the base __init__."""
+        for stmt in self.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                m = MethodModel(stmt.name, stmt)
+                self.methods[stmt.name] = m
+                _MethodWalker(self, m).run(stmt)
+
+    def _scan_locks(self, cls):
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_lock_ctor(node.value):
+                for t in node.targets:
+                    d = _dotted(t)
+                    if d and d.startswith("self."):
+                        self.lock_attrs.add(d[len("self."):])
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                if d.split(".")[-1] == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            td = _dotted(kw.value)
+                            if td and td.startswith("self."):
+                                self.thread_targets.add(
+                                    td[len("self."):])
+
+    def guard_key(self, expr) -> str | None:
+        """The guard name a ``with <expr>:`` acquires, or None when the
+        context manager is not a known lock."""
+        d = _dotted(expr)
+        if d is None:
+            return None
+        if d.startswith("self.") and d[len("self."):] in self.lock_attrs:
+            return d
+        if d in self.module_locks:
+            return d
+        return None
+
+    def thread_closure(self) -> set:
+        """Methods reachable from Thread(target=self.X) targets through
+        in-class calls."""
+        seen = set(t for t in self.thread_targets if t in self.methods)
+        frontier = list(seen)
+        while frontier:
+            m = self.methods.get(frontier.pop())
+            if m is None:
+                continue
+            for callee, _ in m.calls:
+                if callee in self.methods and callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+
+class _MethodWalker:
+    """Record attribute accesses, guard spans, in-class calls and lock
+    nestings for one method body."""
+
+    def __init__(self, cm: ClassModel, mm: MethodModel):
+        self.cm = cm
+        self.mm = mm
+        self.guards: list[str] = []
+
+    def run(self, fn):
+        for stmt in fn.body:
+            self.stmt(stmt)
+
+    def _record_accesses(self, node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id == "self":
+                kind = "write" if isinstance(
+                    sub.ctx, (ast.Store, ast.Del)) else "read"
+                self.mm.accesses.append(Access(
+                    sub.attr, kind, frozenset(self.guards),
+                    self.mm.name, sub))
+            elif isinstance(sub, ast.Subscript):
+                # self.X[i] = v mutates X: surface the write on X
+                d = _dotted(sub.value)
+                if d and d.startswith("self.") and \
+                        isinstance(sub.ctx, (ast.Store, ast.Del)):
+                    self.mm.accesses.append(Access(
+                        d[len("self."):].split(".")[0], "write",
+                        frozenset(self.guards), self.mm.name, sub))
+            if isinstance(sub, ast.Call):
+                d = _dotted(sub.func)
+                if d and d.startswith("self."):
+                    parts = d.split(".")
+                    if len(parts) == 2:
+                        self.mm.calls.append(
+                            (parts[1], frozenset(self.guards)))
+
+    def stmt(self, node):
+        if isinstance(node, ast.With):
+            keys = []
+            for item in node.items:
+                self._record_accesses(item.context_expr)
+                key = self.cm.guard_key(item.context_expr)
+                if key is not None:
+                    for outer in self.guards:
+                        if outer != key:
+                            self.mm.nestings.append((outer, key, node))
+                    self.guards.append(key)
+                    keys.append(key)
+                    self.mm.acquired.setdefault(key, node)
+            for s in node.body:
+                self.stmt(s)
+            for key in reversed(keys):
+                self.guards.remove(key)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs: separate execution context
+        has_block = False
+        for fld in ("body", "orelse", "finalbody"):
+            sub = getattr(node, fld, None)
+            if isinstance(sub, list):
+                if not has_block:
+                    has_block = True
+                    # the statement's own expressions (test/iter/targets)
+                    for child in ast.iter_child_nodes(node):
+                        if not isinstance(child, (ast.stmt,
+                                                  ast.excepthandler)):
+                            self._record_accesses(child)
+                for s in sub:
+                    self.stmt(s)
+        if has_block:
+            for h in getattr(node, "handlers", None) or []:
+                for s in h.body:
+                    self.stmt(s)
+            return
+        self._record_accesses(node)
+
+
+def _families(classes) -> list:
+    """Group ClassModels related by same-file inheritance (base names
+    resolved within the module) — ``self._helper()`` calls cross the
+    subclass/base boundary, so guard propagation must too."""
+    by_name = {cm.name: cm for cm in classes}
+    parent = {cm.name: cm.name for cm in classes}
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for cm in classes:
+        for base in cm.node.bases:
+            d = _dotted(base)
+            tail = d.split(".")[-1] if d else None
+            if tail in by_name:
+                parent[find(cm.name)] = find(tail)
+    groups: dict[str, list] = {}
+    for cm in classes:
+        groups.setdefault(find(cm.name), []).append(cm)
+    return list(groups.values())
+
+
+def propagate_guards(classes, module_locks) -> None:
+    """Fixpoint over each inheritance family: a method whose EVERY call
+    site (in any family member) runs with guard L held inherits L;
+    methods with no in-family call sites are entry points ({}).
+
+    Phase order matters: lock attrs are unioned across each family FIRST
+    (so subclass bodies resolve base-class guards), then method bodies
+    are walked, then guards propagate through the family call graph."""
+    for family in _families(classes):
+        family_locks: set = set()
+        for cm in family:
+            family_locks |= cm.lock_attrs
+        for cm in family:
+            cm.lock_attrs = set(family_locks)
+            cm.walk_methods()
+        all_guards = frozenset(module_locks) | \
+            {f"self.{a}" for a in family_locks}
+        defined = {name for cm in family for name in cm.methods}
+        sites: dict[str, list] = {name: [] for name in defined}
+        for cm in family:
+            for mm in cm.methods.values():
+                for callee, guards in mm.calls:
+                    if callee in defined:
+                        sites[callee].append((mm.name, guards))
+        inherited = {name: (all_guards if sites[name] else frozenset())
+                     for name in defined}
+        for _ in range(len(defined) + 1):
+            changed = False
+            for name, callers in sites.items():
+                if not callers:
+                    continue
+                acc = all_guards
+                for caller, guards in callers:
+                    if caller == name:
+                        continue    # self-recursion adds nothing
+                    acc = acc & (guards | inherited[caller])
+                if acc != inherited[name]:
+                    inherited[name] = acc
+                    changed = True
+            if not changed:
+                break
+        for cm in family:
+            for name, mm in cm.methods.items():
+                mm.inherited = inherited[name]
+
+
+# ---------------------------------------------------------------------------
+# CS100 — inconsistent lock guard
+# ---------------------------------------------------------------------------
+
+def _effective(acc: Access, mm: MethodModel) -> frozenset:
+    return acc.guards | mm.inherited
+
+
+def check_inconsistent_guard(cm: ClassModel, file, findings):
+    if not cm.lock_attrs:
+        return
+    skip_attrs = set(cm.lock_attrs)
+    by_attr: dict[str, list] = {}
+    for mm in cm.methods.values():
+        for acc in mm.accesses:
+            if acc.attr in skip_attrs:
+                continue
+            by_attr.setdefault(acc.attr, []).append((acc, mm))
+    thread_side = cm.thread_closure()
+    for attr, accs in sorted(by_attr.items()):
+        guarded = [(a, m) for a, m in accs if _effective(a, m)]
+        unguarded_writes = [
+            (a, m) for a, m in accs
+            if a.kind == "write" and not _effective(a, m)
+            and a.method not in ("__init__", "__del__", "__new__")]
+        if not unguarded_writes:
+            continue
+        flagged = False
+        if guarded:
+            gmethods = {a.method for a, _ in guarded}
+            for a, m in unguarded_writes:
+                if gmethods - {a.method}:
+                    findings.append(_finding(
+                        "CS100", a.node, file,
+                        f"'self.{attr}' is written without "
+                        f"'{sorted(_effective(*guarded[0]))[0]}' here but "
+                        f"accessed under it in "
+                        f"{cm.name}.{sorted(gmethods - {a.method})[0]}()",
+                        symbol=f"{cm.name}.{a.method}"))
+                    flagged = True
+        if flagged or not thread_side:
+            continue
+        # thread-path variant: written on a Thread(target=self.X) path,
+        # touched on the caller path, never consistently guarded
+        caller_methods = {a.method for a, _ in accs} - thread_side - \
+            {"__init__", "__del__", "__new__"}
+        for a, m in unguarded_writes:
+            if a.method in thread_side and caller_methods:
+                findings.append(_finding(
+                    "CS100", a.node, file,
+                    f"'self.{attr}' is written on the "
+                    f"Thread(target=self.…) path without the class lock, "
+                    f"and touched from the caller path in "
+                    f"{cm.name}.{sorted(caller_methods)[0]}()",
+                    symbol=f"{cm.name}.{a.method}"))
+                break
+
+
+# ---------------------------------------------------------------------------
+# CS101 — lock-order inversion (static nested-with graph)
+# ---------------------------------------------------------------------------
+
+def check_lock_order(classes, module_nestings, file, findings):
+    edges: dict[tuple, tuple] = {}   # (a, b) -> (node, symbol)
+    for cm in classes:
+        for mm in cm.methods.values():
+            held0 = mm.inherited
+            for outer, inner, node in mm.nestings:
+                a, b = (f"{cm.name}::{outer}", f"{cm.name}::{inner}")
+                edges.setdefault((a, b), (node, f"{cm.name}.{mm.name}"))
+            # inherited guards nest over every acquisition in the body
+            for key, node in mm.acquired.items():
+                for h in held0:
+                    if h != key:
+                        edges.setdefault(
+                            (f"{cm.name}::{h}", f"{cm.name}::{key}"),
+                            (node, f"{cm.name}.{mm.name}"))
+    for outer, inner, node, symbol in module_nestings:
+        edges.setdefault((f"::{outer}", f"::{inner}"), (node, symbol))
+    adj: dict[str, list] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+
+    def reaches(src, dst):
+        stack, seen = [src], {src}
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            for nxt in adj.get(n, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return False
+
+    reported = set()
+    for (a, b), (node, symbol) in sorted(
+            edges.items(), key=lambda kv: kv[0]):
+        if (b, a) in reported:
+            continue
+        # drop this edge, see if b still reaches a through the rest
+        if any(reaches(b2, a) for (a2, b2) in edges
+               if (a2, b2) != (a, b) and a2 == b) or (b, a) in edges:
+            reported.add((a, b))
+            pretty = f"{a.split('::')[-1]} -> {b.split('::')[-1]}"
+            findings.append(_finding(
+                "CS101", node, file,
+                f"lock order {pretty} here, but the opposite order "
+                f"exists on another path (ABBA deadlock once both run "
+                f"concurrently)", symbol=symbol))
+
+
+# ---------------------------------------------------------------------------
+# CS102 — signal-unsafe handlers
+# ---------------------------------------------------------------------------
+
+def _metric_handles(tree) -> set:
+    """Names bound (at module or self scope) from counter()/gauge()/
+    histogram() factory calls — every method on them takes a lock."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            d = _dotted(node.value.func) or ""
+            if d.split(".")[-1] in _METRIC_FACTORY_TAILS:
+                for t in node.targets:
+                    td = _dotted(t)
+                    if td:
+                        out.add(td.split(".")[-1])
+    return out
+
+
+def _handler_nodes(tree):
+    """(func_node, registration_node, qualname, owning_class_methods)
+    for every function registered as a signal handler or excepthook in
+    this module. ``self.X`` handlers resolve against the ENCLOSING
+    class's methods first — a flat first-def-wins name index would scan
+    the wrong body when two classes define same-named handlers."""
+    defs: dict[str, ast.AST] = {}   # flat fallback (module/nested defs)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    # id(node) -> method map of the INNERMOST enclosing class (outer
+    # classes are walked first, so nested assignments overwrite)
+    class_of_node: dict[int, dict] = {}
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef):
+            methods = {
+                n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            for sub in ast.walk(cls):
+                class_of_node[id(sub)] = methods
+    out = []
+    for node in ast.walk(tree):
+        handler_expr = None
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func) or ""
+            if d.split(".")[-1] == "signal" and len(node.args) >= 2 and \
+                    d.split(".")[0] in ("signal",):
+                handler_expr = node.args[1]
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if _dotted(t) == "sys.excepthook":
+                    handler_expr = node.value
+        if handler_expr is None:
+            continue
+        d = _dotted(handler_expr)
+        if d is None:
+            continue
+        tail = d.split(".")[-1]
+        methods = class_of_node.get(id(node), {})
+        fn = methods.get(tail) if d.startswith("self.") else None
+        if fn is None:
+            fn = defs.get(tail)
+        if fn is not None:
+            out.append((fn, node, tail, methods))
+    return out, defs
+
+
+#: zero-arg-exempt call tails inside handlers (flag/Event pattern)
+_HANDLER_EXEMPT_TAILS = {"set", "is_set", "record", "dump", "get_ident",
+                         "monotonic", "time", "getpid", "kill"}
+_HANDLER_FLAGGED_BUILTINS = {"open", "print"}
+_HANDLER_FLAGGED_TAILS = {"acquire", "put", "warn", "start", "Thread",
+                          "inc", "observe", "sleep", "join", "flush",
+                          "makedirs", "fsync", "write"}
+
+
+def check_signal_safety(tree, file, findings, metric_handles):
+    if "observability/flight" in file.replace("\\", "/"):
+        return  # the flight recorder IS the sanctioned in-handler path
+    handlers, defs = _handler_nodes(tree)
+    if not handlers:
+        return
+    seen_fn = set()
+    for fn, reg, qual, methods in handlers:
+        if id(fn) in seen_fn:
+            continue
+        seen_fn.add(id(fn))
+        # one-level closure: local helper calls made by the handler
+        # (self-calls resolve against the handler's OWN class first)
+        bodies = [fn]
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                d = _dotted(sub.func) or ""
+                parts = d.split(".")
+                callee = parts[-1]
+                if parts[0] in ("self", "") or len(parts) == 1:
+                    target = (methods.get(callee)
+                              if parts[0] == "self" else None) or \
+                        defs.get(callee)
+                    if target is not None and target is not fn and \
+                            len(bodies) < 8:
+                        bodies.append(target)
+        for body in bodies:
+            _flag_signal_unsafe(body, file, findings, qual,
+                                metric_handles)
+
+
+def _flag_signal_unsafe(fn, file, findings, qual, metric_handles):
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.With):
+            for item in sub.items:
+                d = _dotted(item.context_expr) or \
+                    (_dotted(item.context_expr.func)
+                     if isinstance(item.context_expr, ast.Call) else None)
+                findings.append(_finding(
+                    "CS102", sub, file,
+                    f"`with {d or '...'}:` inside a signal/excepthook "
+                    f"handler can deadlock on a lock the interrupted "
+                    f"frame holds", symbol=qual))
+        elif isinstance(sub, ast.Call):
+            d = _dotted(sub.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            root, tail = parts[0], parts[-1]
+            if root in _SIGNAL_SANCTIONED_ROOTS or \
+                    "flight" in parts[:-1]:
+                continue
+            if tail in _HANDLER_EXEMPT_TAILS:
+                continue
+            if tail == "wait":
+                if not sub.args and not sub.keywords:
+                    findings.append(_finding(
+                        "CS102", sub, file,
+                        f"unbounded {d}() inside a signal handler blocks "
+                        f"the whole process in async-signal context",
+                        symbol=qual))
+                continue
+            if len(parts) > 1 and parts[-2] in metric_handles:
+                findings.append(_finding(
+                    "CS102", sub, file,
+                    f"{d}() records a metric inside a signal/excepthook "
+                    f"handler — metric mutation takes the registry lock",
+                    symbol=qual))
+            elif tail in _HANDLER_FLAGGED_TAILS or \
+                    (isinstance(sub.func, ast.Name) and
+                     sub.func.id in _HANDLER_FLAGGED_BUILTINS):
+                findings.append(_finding(
+                    "CS102", sub, file,
+                    f"{d}() inside a signal/excepthook handler "
+                    f"(allocates/locks/blocks in async-signal context)",
+                    symbol=qual))
+
+
+# ---------------------------------------------------------------------------
+# CS103 — unbounded waits on shutdown/drain paths
+# ---------------------------------------------------------------------------
+
+def check_shutdown_waits(tree, file, findings):
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        lname = node.name.lower()
+        if not any(p in lname for p in _SHUTDOWN_NAME_PARTS):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) or sub.args or sub.keywords:
+                continue
+            d = _dotted(sub.func)
+            if d is None:
+                continue
+            tail = d.split(".")[-1]
+            if tail in ("join", "wait", "get") and d != tail:
+                findings.append(_finding(
+                    "CS103", sub, file,
+                    f"{d}() on the shutdown path '{node.name}' has no "
+                    f"timeout — a stuck thread/queue hangs shutdown "
+                    f"forever", symbol=node.name))
+
+
+# ---------------------------------------------------------------------------
+# CS104 — broken double-checked lazy init
+# ---------------------------------------------------------------------------
+
+def check_double_checked(tree, file, findings, module_locks, classes):
+    lockish_names = set(module_locks)
+    for cm in classes:
+        lockish_names |= {f"self.{a}" for a in cm.lock_attrs}
+
+    def none_check_target(test):
+        """'x' for `x is None` / `not x` tests, else None."""
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+                isinstance(test.ops[0], ast.Is) and \
+                isinstance(test.comparators[0], ast.Constant) and \
+                test.comparators[0].value is None:
+            return _dotted(test.left)
+        if isinstance(test, ast.UnaryOp) and \
+                isinstance(test.op, ast.Not):
+            return _dotted(test.operand)
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.If):
+            continue
+        target = none_check_target(node.test)
+        if target is None:
+            continue
+        for stmt in node.body:
+            if not isinstance(stmt, ast.With):
+                continue
+            locks_here = [item for item in stmt.items
+                          if (_dotted(item.context_expr) or "")
+                          in lockish_names]
+            if not locks_here:
+                continue
+            assigns = [s for s in ast.walk(stmt)
+                       if isinstance(s, (ast.Assign, ast.AugAssign)) and
+                       any(_dotted(t) == target for t in
+                           (s.targets if isinstance(s, ast.Assign)
+                            else [s.target]))]
+            if not assigns:
+                continue
+            rechecked = any(
+                none_check_target(s.test) == target
+                for s in ast.walk(stmt) if isinstance(s, ast.If))
+            if not rechecked:
+                findings.append(_finding(
+                    "CS104", assigns[0], file,
+                    f"double-checked init of '{target}' never re-tests "
+                    f"the sentinel inside the locked block — two racing "
+                    f"initializers both pass the outer check"))
+
+
+# ---------------------------------------------------------------------------
+# CS105 — thread started in __init__ before construction completes
+# ---------------------------------------------------------------------------
+
+def check_thread_start_in_init(classes, file, findings):
+    for cm in classes:
+        init = cm.methods.get("__init__")
+        if init is None:
+            continue
+        start_line = None
+        start_node = None
+        for sub in ast.walk(init.node):
+            if isinstance(sub, ast.Call) and not sub.args:
+                d = _dotted(sub.func) or ""
+                parts = d.split(".")
+                if parts[-1] == "start" and (
+                        "thread" in d.lower() or
+                        (len(parts) >= 2 and
+                         f"{'.'.join(parts[:-1])}"[5:] in  # self.X
+                         _thread_attrs(cm))):
+                    start_line = sub.lineno
+                    start_node = sub
+                    break
+        if start_node is None:
+            continue
+        late = [a for m in (init,) for a in m.accesses
+                if a.kind == "write" and a.node.lineno > start_line]
+        if late:
+            names = sorted({a.attr for a in late})[:3]
+            findings.append(_finding(
+                "CS105", start_node, file,
+                f"thread started in __init__ before "
+                f"{', '.join('self.' + n for n in names)} "
+                f"{'are' if len(names) > 1 else 'is'} assigned — the "
+                f"thread can observe a half-constructed object",
+                symbol=f"{cm.name}.__init__"))
+
+
+def _thread_attrs(cm: ClassModel) -> set:
+    """self attrs assigned a Thread(...) in this class."""
+    out = set()
+    for node in ast.walk(cm.node):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            d = _dotted(node.value.func) or ""
+            if d.split(".")[-1] == "Thread":
+                for t in node.targets:
+                    td = _dotted(t)
+                    if td and td.startswith("self."):
+                        out.add(td[len("self."):])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# module-scope model + orchestration
+# ---------------------------------------------------------------------------
+
+def _module_locks(tree) -> set:
+    out = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _module_nestings(tree, module_locks):
+    """(outer, inner, node, symbol) nested with-lock pairs in
+    module-scope functions (locks by module-global name)."""
+    out = []
+
+    def walk_fn(fn, qual):
+        guards = []
+
+        def stmt(node):
+            if isinstance(node, ast.With):
+                keys = []
+                for item in node.items:
+                    d = _dotted(item.context_expr)
+                    if d in module_locks:
+                        for outer in guards:
+                            if outer != d:
+                                out.append((outer, d, node, qual))
+                        guards.append(d)
+                        keys.append(d)
+                for s in node.body:
+                    stmt(s)
+                for k in reversed(keys):
+                    guards.remove(k)
+                return
+            for fld in ("body", "orelse", "finalbody"):
+                sub = getattr(node, fld, None)
+                if isinstance(sub, list):
+                    for s in sub:
+                        stmt(s)
+            for h in getattr(node, "handlers", None) or []:
+                for s in h.body:
+                    stmt(s)
+
+        for s in fn.body:
+            stmt(s)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(node, node.name)
+    return out
+
+
+def check_module(tree: ast.Module, file: str) -> list:
+    """Run every CS rule over one parsed module; returns [Finding]."""
+    module_locks = _module_locks(tree)
+    classes = [ClassModel(node, module_locks)
+               for node in ast.walk(tree)
+               if isinstance(node, ast.ClassDef)]
+    propagate_guards(classes, module_locks)
+    findings: list = []
+    for cm in classes:
+        check_inconsistent_guard(cm, file, findings)
+    check_lock_order(classes, _module_nestings(tree, module_locks),
+                     file, findings)
+    check_signal_safety(tree, file, findings, _metric_handles(tree))
+    check_shutdown_waits(tree, file, findings)
+    check_double_checked(tree, file, findings, module_locks, classes)
+    check_thread_start_in_init(classes, file, findings)
+    findings.sort(key=lambda f: f.sort_key())
+    return findings

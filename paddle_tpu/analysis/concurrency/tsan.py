@@ -1,0 +1,563 @@
+"""Runtime thread-sanitizer: instrumented locks + sampled write checking.
+
+The dynamic half of the concurrency tier (``PADDLE_TPU_TSAN=1``). The
+threaded runtime modules (serving scheduler/engine/PagePool, the metrics
+registry, the continuous profiler, the telemetry server, the checkpoint
+manager) create their guard locks through the factories here instead of
+``threading.Lock()`` directly:
+
+    from ..analysis.concurrency import tsan as _tsan
+    self._lock = _tsan.lock("serving.PagePool")
+
+**Disabled (the default), the factories return the plain ``threading``
+primitive itself** — same object type, zero wrapper, zero overhead; the
+only residue is one attribute test at the few ``active()``-guarded
+``note_write`` probe sites (the ``PADDLE_TPU_FLIGHT=0`` pattern).
+
+Enabled, every instrumented lock maintains
+
+* a **per-thread held-lock set** (ordered), and
+* a **global acquisition-order graph**: first time a thread acquires B
+  while holding A, the edge A→B is recorded with the acquiring stack.
+  A new edge that closes a cycle is a **lock-order inversion**: the
+  report carries both edges' acquisition stacks — the dynamic
+  confirmation of the static CS101 finding (``static_rule`` names it).
+
+plus **sampled shared-attribute write checking**: runtime modules call
+``tsan.note_write(obj, "field", guard_lock)`` next to writes the static
+tier reasons about; a write from a second thread without the declared
+guard held is reported as a racy write (``static_rule`` CS100) —
+confirming, or killing, the static finding.
+
+Reports go three ways: an in-process list (:func:`reports`), flight
+events (``tsan_lock_inversion`` / ``tsan_racy_write``) plus
+``paddle_tpu_tsan_*`` metrics (both imported lazily — this module is
+stdlib-only at import time, because ``observability.metrics`` itself
+creates its locks here), and — when ``PADDLE_TPU_TSAN_LOG`` names a
+file — one JSON line per report, which is how ``tools/tsan_check.py``
+collects reports across its suite subprocesses.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import traceback
+
+__all__ = [
+    "enabled", "enable", "active", "lock", "rlock", "condition",
+    "note_write", "reports", "clear", "snapshot",
+    "TsanLock", "TsanRLock", "TsanCondition",
+]
+
+_ENV = "PADDLE_TPU_TSAN"
+_LOG_ENV = "PADDLE_TPU_TSAN_LOG"
+
+#: how many frames of acquiring stack a lock-graph edge keeps
+_STACK_DEPTH = 12
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(_ENV, "0").lower() in ("1", "true", "on")
+
+
+class _State:
+    __slots__ = ("enabled",)
+
+    def __init__(self):
+        self.enabled = _env_enabled()
+
+
+_state = _State()
+
+#: guards the graph/report tables below. A PLAIN lock by design: it is
+#: the sanitizer's own leaf lock, never instrumented, never held while
+#: calling out (flight/metrics reporting happens after release).
+_registry_lock = threading.Lock()
+_edges: dict = {}       # (a, b) -> {"stack": [...], "thread": name}
+_lock_names: set = set()
+_reports: list = []
+_report_keys: set = set()
+_writes: dict = {}      # (owner_token, field) -> (thread_token, guard_held)
+#: flight/metric emissions deferred because the reporting thread still
+#: held instrumented locks (flushed at its last release)
+_pending_emit: list = []
+
+_tls = threading.local()
+
+
+def _owner_token(owner) -> int:
+    """A never-reused identity for a watched object (stashed on the
+    instance; slotted/frozen objects fall back to ``id`` and accept the
+    recycling risk)."""
+    d = getattr(owner, "__dict__", None)
+    tok = d.get("_tsan_owner_token") if d is not None else None
+    if tok is None:
+        tok = next(_owner_tokens)
+        try:
+            owner._tsan_owner_token = tok
+        except (AttributeError, TypeError):
+            return id(owner)
+    return tok
+
+
+#: never-reused per-thread token: ``threading.get_ident()`` recycles the
+#: ids of finished threads, which would make two SEQUENTIAL threads look
+#: like one writer and mask a cross-thread racy write
+_thread_tokens = itertools.count(1)
+#: never-reused per-OWNER token (same recycling hazard as thread idents:
+#: ``id()`` of a collected object can come back on a new one, conflating
+#: two objects' write histories into a false racy-write report)
+_owner_tokens = itertools.count(1)
+
+
+def _thread_token() -> int:
+    tok = getattr(_tls, "token", None)
+    if tok is None:
+        tok = _tls.token = next(_thread_tokens)
+    return tok
+
+
+def enabled() -> bool:
+    """True while the sanitizer records (``PADDLE_TPU_TSAN`` env,
+    overridable via :func:`enable`). Locks are instrumented at
+    CONSTRUCTION time: flipping this on mid-process only affects locks
+    (and writes) created afterwards."""
+    return _state.enabled
+
+
+def enable(flag: bool = True) -> bool:
+    """Turn the sanitizer on/off process-wide; returns the new state."""
+    _state.enabled = bool(flag)
+    return _state.enabled
+
+
+def active() -> bool:
+    """The one test ``note_write`` probe sites pay per call."""
+    return _state.enabled
+
+
+# ---------------------------------------------------------------------------
+# held-set + acquisition-order graph
+# ---------------------------------------------------------------------------
+
+def _held() -> list:
+    """This thread's held instrumented locks as ``(name, lock_id)``
+    pairs, outermost first: the order graph is NAME-keyed (one order per
+    subsystem class), but guard-held checks must be IDENTITY-keyed —
+    holding instance A's lock must not count as holding same-named
+    instance B's."""
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _short_stack() -> list:
+    """Innermost frames of the current stack, sanitizer frames dropped."""
+    out = []
+    for fr in traceback.extract_stack()[:-3][-_STACK_DEPTH:]:
+        out.append(f"{fr.filename}:{fr.lineno} in {fr.name}")
+    return out
+
+
+def _find_path(src: str, dst: str) -> list | None:
+    """Edge-path src -> ... -> dst in the order graph (call under
+    ``_registry_lock``); None when unreachable."""
+    stack = [(src, [src])]
+    seen = {src}
+    adj: dict = {}
+    for a, b in _edges:
+        adj.setdefault(a, []).append(b)
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in adj.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquire(name: str, oid: int = 0) -> None:
+    held = _held()
+    pendings = []   # one acquire can close SEVERAL cycles (one per
+    #                 held lock) — each is a distinct deadlock pair and
+    #                 each edge is now in _edges, so a dropped report
+    #                 here would be suppressed forever
+    if held:
+        with _registry_lock:
+            for h, _hid in held:
+                if h == name:
+                    continue          # RLock reacquire: no self edge
+                edge = (h, name)
+                if edge in _edges:
+                    continue
+                # a new edge h -> name closes a cycle iff name already
+                # reaches h; capture BOTH acquisition stacks for the report
+                back = _find_path(name, h)
+                _edges[edge] = {"stack": _short_stack(),
+                                "thread": threading.current_thread().name}
+                if back is not None:
+                    fwd = _edges.get((back[0], back[1]), {})
+                    pendings.append({
+                        "cycle": back + [name],
+                        "edge": list(edge),
+                        "stack_forward": _edges[edge]["stack"],
+                        "stack_back": fwd.get("stack"),
+                        "thread_back": fwd.get("thread"),
+                    })
+    held.append((name, oid))
+    if pendings and not getattr(_tls, "in_report", False):
+        # the in_report guard breaks recursion: _report's own lazy
+        # metric emission acquires instrumented locks, and a cycle
+        # detected DURING that emission must not re-enter _report
+        for pending in pendings:
+            _report("lock_inversion", static_rule="CS101",
+                    locks=sorted({pending["edge"][0],
+                                  pending["edge"][1]}),
+                    **pending)
+
+
+def _held_remove(name: str, oid: int = 0) -> None:
+    """Drop one held-set entry WITHOUT the deferred-emission flush —
+    for bookkeeping points where the real lock is not released yet
+    (TsanCondition.wait marks the drop before ``_inner.wait`` performs
+    it; flushing there would emit inside a live critical section)."""
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] == name and (not oid or held[i][1] == oid):
+            del held[i]
+            return
+
+
+def _note_release(name: str, oid: int = 0) -> None:
+    _held_remove(name, oid)
+    held = _held()
+    if held or getattr(_tls, "in_report", False):
+        return
+    # this thread just dropped its LAST instrumented lock: flush any
+    # emissions _report deferred to keep flight/metric lock
+    # acquisitions out of instrumented critical sections
+    with _registry_lock:
+        if not _pending_emit:
+            return
+        pending = list(_pending_emit)
+        _pending_emit.clear()
+    for rec in pending:
+        _emit(rec)
+
+
+def held_locks() -> tuple:
+    """This thread's instrumented lock NAMES, outermost first
+    (diagnostics)."""
+    return tuple(n for n, _ in _held())
+
+
+# ---------------------------------------------------------------------------
+# instrumented primitives
+# ---------------------------------------------------------------------------
+
+class TsanLock:
+    """``threading.Lock`` wrapper feeding the held-set and order graph."""
+
+    _reentrant = False
+
+    def __init__(self, name: str):
+        self._name = name
+        self._inner = self._make()
+        with _registry_lock:
+            _lock_names.add(name)
+
+    @staticmethod
+    def _make():
+        return threading.Lock()
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _note_acquire(self._name, id(self))
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _note_release(self._name, id(self))
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._name!r})"
+
+
+class TsanRLock(TsanLock):
+    _reentrant = True
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        # per-INSTANCE per-thread depth (the held-set is name-keyed and
+        # names may be shared across instances of one subsystem class)
+        self._depth = threading.local()
+
+    @staticmethod
+    def _make():
+        return threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = super().acquire(blocking, timeout)
+        if got:
+            self._depth.n = getattr(self._depth, "n", 0) + 1
+        return got
+
+    def release(self) -> None:
+        super().release()
+        self._depth.n = getattr(self._depth, "n", 1) - 1
+
+    def locked(self) -> bool:  # RLock has no locked() before 3.12
+        if getattr(self._depth, "n", 0) > 0:
+            return True       # held by THIS thread — a non-blocking
+        #                       probe would succeed reentrantly and lie
+        if self._inner.acquire(blocking=False):
+            self._inner.release()
+            return False
+        return True
+
+
+class TsanCondition:
+    """``threading.Condition`` wrapper: wait() drops the lock, so the
+    held-set must open around the inner wait and close on rearm."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._inner = threading.Condition()
+        with _registry_lock:
+            _lock_names.add(name)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, *args, **kw) -> bool:
+        got = self._inner.acquire(*args, **kw)
+        if got:
+            _note_acquire(self._name, id(self))
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _note_release(self._name, id(self))
+
+    def wait(self, timeout: float | None = None) -> bool:
+        # bookkeeping-only drop: the REAL release happens inside
+        # _inner.wait, so the flush-at-last-release path must not run
+        # here (it would emit while the condition is still held)
+        _held_remove(self._name, id(self))
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            _note_acquire(self._name, id(self))
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        _held_remove(self._name, id(self))
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            _note_acquire(self._name, id(self))
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"TsanCondition({self._name!r})"
+
+
+def lock(name: str):
+    """A guard lock for ``name``: plain ``threading.Lock()`` when the
+    sanitizer is off (zero overhead), an instrumented wrapper when on."""
+    return TsanLock(name) if _state.enabled else threading.Lock()
+
+
+def rlock(name: str):
+    return TsanRLock(name) if _state.enabled else threading.RLock()
+
+
+def condition(name: str):
+    return TsanCondition(name) if _state.enabled else threading.Condition()
+
+
+# ---------------------------------------------------------------------------
+# sampled shared-attribute write checking
+# ---------------------------------------------------------------------------
+
+def note_write(owner, field: str, guard=None) -> None:
+    """Record one shared-attribute write for race checking.
+
+    ``guard`` is the lock that is SUPPOSED to protect ``owner.field``
+    (an instrumented lock from :func:`lock`/:func:`rlock`/
+    :func:`condition`). When a second thread writes the same field and
+    either write did not hold the guard, a racy-write report (static
+    rule CS100) is emitted. Call sites stay guarded with
+    ``tsan.active()`` so the disabled cost is one attribute test."""
+    if not _state.enabled:
+        return
+    if guard is not None and not isinstance(
+            guard, (TsanLock, TsanCondition)):
+        # the guard predates enable() (a plain threading primitive from
+        # a disabled-mode construction): held-ness is UNVERIFIABLE, and
+        # reporting correctly-locked writes as races would be worse
+        # than missing them
+        return
+    # guard-held is IDENTITY-keyed (names are shared across instances of
+    # one subsystem class — holding engine A's scheduler lock must not
+    # vouch for engine B's)
+    guarded = guard is not None and \
+        any(oid == id(guard) for _, oid in _held())
+    gname = getattr(guard, "name", None)
+    key = (_owner_token(owner), field)
+    me = _thread_token()
+    report = None
+    with _registry_lock:
+        prev = _writes.get(key)
+        _writes[key] = (me, guarded)
+        if prev is not None and prev[0] != me and \
+                not (guarded and prev[1]):
+            report = {
+                "owner": type(owner).__name__,
+                "field": field,
+                "guard": gname,
+                "guard_held": guarded,
+                "prev_guard_held": prev[1],
+                "stack": _short_stack(),
+            }
+    if report is not None:
+        _report("racy_write", static_rule="CS100", **report)
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+def _report(kind: str, **fields) -> None:
+    key = (kind, fields.get("field"), fields.get("owner"),
+           tuple(fields.get("locks") or ()))
+    rec = dict(fields)
+    rec["kind"] = kind
+    rec["time"] = time.time()
+    rec["thread"] = threading.current_thread().name
+    with _registry_lock:
+        if key in _report_keys:
+            return
+        _report_keys.add(key)
+        _reports.append(rec)
+    log_path = os.environ.get(_LOG_ENV)
+    if log_path:
+        try:
+            with open(log_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
+    if _held():
+        # the reporting thread still holds instrumented locks (a report
+        # usually fires from INSIDE an acquire): emitting now would take
+        # metric/registry locks within that critical section — minting
+        # the very lock-order inversion (or a self-deadlock on the
+        # registry lock) this tool exists to find. Defer to the
+        # thread's last release; the list/log record above is already
+        # durable either way.
+        with _registry_lock:
+            _pending_emit.append(rec)
+        return
+    _emit(rec)
+
+
+def _emit(rec: dict) -> None:
+    """Flight + metrics emission, LAZY (and best-effort): metrics' own
+    locks are built by this module, so the import must never happen at
+    our import time, and a report must never take the process down. The
+    per-thread in_report flag keeps the emission's OWN instrumented-lock
+    acquisitions from re-entering _report or the release-time flush."""
+    _tls.in_report = True
+    try:
+        from ...observability import flight as _flight
+        _flight.record(f"tsan_{rec['kind']}",
+                       **{k: v for k, v in rec.items()
+                          if k in ("static_rule", "locks", "owner", "field",
+                                   "guard", "thread")})
+        from ...observability import counter as _counter
+        _counter("paddle_tpu_tsan_reports_total",
+                 "thread-sanitizer reports by kind").inc(kind=rec["kind"])
+        _export_gauges()
+    except Exception:
+        pass
+    finally:
+        _tls.in_report = False
+
+
+def _export_gauges() -> None:
+    """Best-effort gauge export; call only with ``_tls.in_report`` set
+    (the gauges themselves live behind instrumented locks)."""
+    try:
+        from ...observability import gauge as _gauge
+        with _registry_lock:
+            n_locks, n_edges = len(_lock_names), len(_edges)
+        _gauge("paddle_tpu_tsan_locks_tracked",
+               "locks instrumented by the thread sanitizer").set(n_locks)
+        _gauge("paddle_tpu_tsan_lock_graph_edges",
+               "acquisition-order edges observed").set(n_edges)
+    except Exception:
+        pass
+
+
+def reports() -> list:
+    """Snapshot of every report so far (dicts; see module docstring)."""
+    with _registry_lock:
+        return [dict(r) for r in _reports]
+
+
+def clear() -> None:
+    """Drop reports, the order graph and write history (tests; the
+    instrumented-lock name registry survives)."""
+    with _registry_lock:
+        _edges.clear()
+        _reports.clear()
+        _report_keys.clear()
+        _writes.clear()
+        _pending_emit.clear()
+
+
+def snapshot() -> dict:
+    """JSON-safe self-description (the tsan_check gate prints this)."""
+    with _registry_lock:
+        return {
+            "enabled": _state.enabled,
+            "locks": sorted(_lock_names),
+            "edges": [list(e) for e in sorted(_edges)],
+            "reports": [dict(r) for r in _reports],
+        }

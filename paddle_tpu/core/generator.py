@@ -90,16 +90,21 @@ class Generator:
         return sub
 
     def get_state(self):
-        key = self._ensure_key()._d
-        if isinstance(key, jax.core.Tracer):
-            key = self._last_concrete if self._last_concrete is not None \
-                else jax.random.PRNGKey(self._seed)
-        return (self._seed, np.asarray(jax.device_get(key)))
+        # under the same lock as split()/manual_seed(): a checkpoint
+        # snapshot racing a loader thread's split() must not capture a
+        # half-advanced key
+        with self._lock:
+            key = self._ensure_key()._d
+            if isinstance(key, jax.core.Tracer):
+                key = self._last_concrete if self._last_concrete \
+                    is not None else jax.random.PRNGKey(self._seed)
+            return (self._seed, np.asarray(jax.device_get(key)))
 
     def set_state(self, state) -> None:
         import jax.numpy as jnp
-        self._seed = int(state[0])
-        self._ensure_key()._data = jnp.asarray(state[1])
+        with self._lock:
+            self._seed = int(state[0])
+            self._ensure_key()._data = jnp.asarray(state[1])
 
     def random(self) -> int:
         """A fresh python-int seed (used to seed child processes etc.)."""

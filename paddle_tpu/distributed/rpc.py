@@ -86,10 +86,13 @@ class _Agent:
         self._server.server_activate()
         # the bound port (port=0 requests an ephemeral one)
         me.port = self._server.server_address[1]
+        # the pool must exist BEFORE the acceptor thread starts: a peer
+        # can connect (and the handler submit work) the moment
+        # serve_forever runs, and would find a half-constructed agent
+        self._pool = ThreadPoolExecutor(max_workers=8)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
-        self._pool = ThreadPoolExecutor(max_workers=8)
 
     def call(self, to, fn, args, kwargs, timeout):
         info = self.workers[to] if isinstance(to, str) else to

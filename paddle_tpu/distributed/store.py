@@ -336,12 +336,22 @@ class TCPStore:
         return self._request("delete_prefix", prefix)
 
     def shutdown(self):
-        if self._sock is not None:
+        # close FIRST, without the lock: an in-flight _request() holds
+        # self._lock across its whole network round-trip, and this
+        # close is exactly what cancels its blocked recv — waiting for
+        # the lock would stall shutdown for the full store timeout.
+        # The field is then cleared under the lock, and only if it
+        # still names the socket we closed (a racing reconnect must
+        # not be clobbered).
+        sock = self._sock
+        if sock is not None:
             try:
-                self._sock.close()
+                sock.close()
             except OSError:
                 pass
-            self._sock = None
+        with self._lock:
+            if self._sock is sock:
+                self._sock = None
         if self._native is not None:
             _load_native().tcp_store_server_stop(self._native)
             self._native = None

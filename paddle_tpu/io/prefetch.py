@@ -65,6 +65,12 @@ def prefetch_to_device(loader, depth: int = 2, device=None):
     ``device``: target `jax.Device` (default: the framework's current
     default device). Yields batches with the same structure the loader
     produced, with Tensors/ndarrays resident on-device.
+
+    Teardown is bounded by construction: the iterator owns no thread —
+    dropping it (or ``gen.close()``) releases the buffered device
+    batches immediately, and the only blocking teardown underneath is
+    the DataLoader's worker join, which is itself bounded (2s, then a
+    loud RuntimeWarning + terminate).
     """
     if depth < 1:
         raise ValueError(f"prefetch depth must be >= 1, got {depth}")

@@ -402,6 +402,13 @@ class MultiprocessLoaderIter:
         for p in self._workers:
             p.join(timeout=2)
             if p.is_alive():
+                # bounded teardown contract: escalate loudly instead of
+                # waiting on a wedged worker forever
+                import warnings
+                warnings.warn(
+                    f"loader worker pid={p.pid} did not exit within 2s "
+                    f"of shutdown; terminating it", RuntimeWarning,
+                    stacklevel=2)
                 p.terminate()
         self._workers = []
         if self._ring is not None:

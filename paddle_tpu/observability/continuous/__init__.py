@@ -45,6 +45,7 @@ import threading
 import time
 import weakref
 
+from ...analysis.concurrency import tsan as _tsan
 from .. import metrics as _m
 
 __all__ = [
@@ -153,7 +154,10 @@ class ContinuousProfiler:
             "current sampling cadence (steps between capture windows)")
         self._g_every.set(self.every)
         self._clock = time.perf_counter   # injectable for tests
-        self._lock = threading.Lock()     # stats reads vs the train thread
+        # an RLock: on_step holds it across window close/open (so /healthz
+        # snapshots and server-thread resets can never observe a window
+        # mid-transition), and the window helpers re-enter it
+        self._lock = _tsan.rlock("observability.continuous.profiler")
         self.active = False               # a capture window is open NOW
         self._pending = 0                 # dense steps requested (/profile)
         self._count = 0                   # on_step calls seen
@@ -182,22 +186,39 @@ class ContinuousProfiler:
         /healthz contract) keeps updating, so turning the profiler off
         never silences stall alerting."""
         now = self._clock()
-        self._count += 1
-        self.last_step = step if step is not None else self._count
-        self.last_step_wall = time.time()
+        want_reconcile = False
+        with self._lock:
+            self._count += 1
+            self.last_step = step if step is not None else self._count
+            self.last_step_wall = time.time()
         self._c_steps.inc()
         if not self.enabled:
             return
-        if self.active:
-            self._close_window(now)
-        elif self._last_t is not None:
-            dt = now - self._last_t
-            self.steady_step_s = dt if self.steady_step_s is None \
-                else 0.8 * self.steady_step_s + 0.2 * dt
-        if self._pending > 0 or self._count % self.every == 1 \
-                or self.every == 1:
-            self._open_window()
-        self._last_t = self._clock()
+        with self._lock:
+            if self.active:
+                want_reconcile = self._close_window(now)
+            elif self._last_t is not None:
+                dt = now - self._last_t
+                self.steady_step_s = dt if self.steady_step_s is None \
+                    else 0.8 * self.steady_step_s + 0.2 * dt
+            if self._pending > 0 or self._count % self.every == 1 \
+                    or self.every == 1:
+                self._open_window()
+            self._last_t = self._clock()
+        if want_reconcile:
+            # deliberately OUTSIDE the lock: reconciliation re-traces
+            # jaxprs (milliseconds of host work) and must not block
+            # /healthz or server-thread snapshot() readers meanwhile
+            try:
+                from .reconcile import fusion_targets as _ft
+                _ft(profiler=self)
+            except Exception:
+                pass
+            with self._lock:
+                # re-stamp so the reconcile's host milliseconds do not
+                # ride the next inter-step dt into the steady-step EWMA
+                # (which is the overhead accounting's cost floor)
+                self._last_t = self._clock()
 
     def stop(self) -> None:
         """Close any open window WITHOUT folding it (the step it covers
@@ -233,7 +254,9 @@ class ContinuousProfiler:
             self._window_t0 = self._clock()
         self._open_cost = self._clock() - t0
 
-    def _close_window(self, now):
+    def _close_window(self, now) -> bool:
+        """Fold the open window (call with ``self._lock`` held — on_step
+        does); returns True when the caller should reconcile."""
         window_wall = now - (self._window_t0 or now)
         t0 = self._clock()
         programs_s = 0.0
@@ -256,20 +279,18 @@ class ContinuousProfiler:
                     else 0.5 * st["ms"] + 0.5 * ms
                 st["calls"] += calls
                 st["windows"] += 1
-        self._c_windows.inc(trigger=trigger)
-        self._account_overhead(window_wall, programs_s,
-                               self._clock() - t0, trigger)
-        if self.auto_reconcile and self.windows >= \
-                self.RECONCILE_AFTER_WINDOWS and (
+            self._account_overhead(window_wall, programs_s,
+                                   self._clock() - t0, trigger)
+            want_reconcile = (
+                self.auto_reconcile and
+                self.windows >= self.RECONCILE_AFTER_WINDOWS and (
                     self._reconciled_at == 0 or
                     self.windows - self._reconciled_at >=
-                    self.RECONCILE_REFRESH_WINDOWS):
-            self._reconciled_at = self.windows
-            try:
-                from .reconcile import fusion_targets as _ft
-                _ft(profiler=self)
-            except Exception:
-                pass
+                    self.RECONCILE_REFRESH_WINDOWS))
+            if want_reconcile:
+                self._reconciled_at = self.windows
+        self._c_windows.inc(trigger=trigger)
+        return want_reconcile
 
     def _account_overhead(self, window_wall, programs_s, close_cost,
                           trigger):
@@ -316,20 +337,25 @@ class ContinuousProfiler:
         (called by the jit/optimizer/prefetch/collective hooks)."""
         if not self.active:
             return
-        row = self._window.get(name)
-        if row is None:
-            row = self._window[name] = [0, 0.0]
-        row[0] += 1
-        row[1] += seconds
+        with self._lock:
+            if not self.active:
+                return  # the window closed while we raced for the lock
+            row = self._window.get(name)
+            if row is None:
+                row = self._window[name] = [0, 0.0]
+            row[0] += 1
+            row[1] += seconds
         self._h_program.observe(seconds * 1e3, program=name)
 
     def note_program(self, name: str, obj) -> None:
         """Remember (weakly) the StaticFunction behind a profiled program
         so reconciliation can re-analyze its jaxpr later."""
         try:
-            self._static_fns[name] = weakref.ref(obj)
+            ref = weakref.ref(obj)
         except TypeError:
-            pass
+            return
+        with self._lock:
+            self._static_fns[name] = ref
 
     def static_fn(self, name: str):
         ref = self._static_fns.get(name)

@@ -30,8 +30,11 @@ from __future__ import annotations
 import json
 import os
 import threading
+import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
+
+from ...analysis.concurrency import tsan as _tsan
 
 __all__ = ["TelemetryServer", "serve", "shutdown_server",
            "register_route", "unregister_route",
@@ -73,22 +76,36 @@ def _env_stall() -> float:
 
 _EXTRA_ROUTES: dict = {}
 _HEALTH_PROVIDER = None
+# registration is copy-on-write under this lock: handler threads read
+# _EXTRA_ROUTES bare (one atomic load of an immutable-once-published
+# dict), so a serving runtime mounting itself mid-scrape can never make
+# a handler iterate a dict that changes size under it
+_ext_lock = _tsan.lock("observability.continuous.server.ext")
 
 
 def register_route(path: str, fn) -> None:
     """Mount ``fn(handler, method, query, body)`` at ``path`` on every
     (current and future) telemetry server in this process."""
-    _EXTRA_ROUTES[path] = fn
+    global _EXTRA_ROUTES
+    with _ext_lock:
+        routes = dict(_EXTRA_ROUTES)
+        routes[path] = fn
+        _EXTRA_ROUTES = routes
 
 
 def unregister_route(path: str) -> None:
-    _EXTRA_ROUTES.pop(path, None)
+    global _EXTRA_ROUTES
+    with _ext_lock:
+        routes = dict(_EXTRA_ROUTES)
+        routes.pop(path, None)
+        _EXTRA_ROUTES = routes
 
 
 def register_health_provider(fn) -> None:
     """Install (or clear, with None) the /healthz override provider."""
     global _HEALTH_PROVIDER
-    _HEALTH_PROVIDER = fn
+    with _ext_lock:
+        _HEALTH_PROVIDER = fn
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -120,7 +137,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _dispatch(self, method: str, body: bytes | None):
         try:
             url = urlparse(self.path)
-            extra = _EXTRA_ROUTES.get(url.path)
+            routes_snapshot = _EXTRA_ROUTES   # one load; never mutated
+            extra = routes_snapshot.get(url.path)
             if extra is not None:
                 extra(self, method, parse_qs(url.query), body)
                 return
@@ -131,7 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404 if route is None else 405, {
                     "error": f"no {method} route {url.path!r}",
                     "routes": sorted(["/metrics", "/healthz", "/flight",
-                                      "/profile"] + list(_EXTRA_ROUTES))})
+                                      "/profile"] +
+                                     list(routes_snapshot))})
                 return
             route(parse_qs(url.query))
         except (BrokenPipeError, ConnectionResetError):
@@ -164,8 +183,9 @@ class _Handler(BaseHTTPRequestHandler):
         import time
         from . import profiler_if_started
         stall = self.server.stall_after_s  # type: ignore[attr-defined]
-        if _HEALTH_PROVIDER is not None:
-            override = _HEALTH_PROVIDER(stall)
+        provider = _HEALTH_PROVIDER        # one load vs register races
+        if provider is not None:
+            override = provider(stall)
             if override is not None:
                 code, payload = override
                 self._send_json(code, payload)
@@ -262,10 +282,13 @@ class TelemetryServer:
         return self._thread.is_alive()
 
     def close(self, timeout: float = 5.0) -> None:
-        """Stop accepting, close the socket, join the acceptor thread.
-        Idempotent; safe from any thread, including on a server that was
-        constructed but never started (shutdown() would block forever
-        waiting on an Event only serve_forever sets)."""
+        """Stop accepting, close the socket, join the acceptor thread —
+        BOUNDED by ``timeout``, with a loud RuntimeWarning if the
+        acceptor refuses to die (a wedged handler must not turn process
+        shutdown into a hang). Idempotent; safe from any thread,
+        including on a server that was constructed but never started
+        (shutdown() would block forever waiting on an Event only
+        serve_forever sets)."""
         try:
             if self._thread.is_alive():
                 self._httpd.shutdown()
@@ -274,6 +297,12 @@ class TelemetryServer:
             pass
         if self._thread.is_alive():
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                warnings.warn(
+                    f"telemetry server acceptor thread "
+                    f"{self._thread.name!r} did not exit within "
+                    f"{timeout}s of close()", RuntimeWarning,
+                    stacklevel=2)
 
     def __enter__(self) -> "TelemetryServer":
         return self if self.running else self.start()

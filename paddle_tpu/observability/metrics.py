@@ -30,11 +30,16 @@ from __future__ import annotations
 
 import os
 import re
-import threading
 import time
 import warnings
 from bisect import bisect_left
 from collections import deque
+
+# the concurrency tier's runtime half: tsan.py is stdlib-only and the
+# package defers its linter machinery behind a module __getattr__, so
+# the zero-dependency contract above holds (no jax/numpy, no rule
+# engine on this import path)
+from ..analysis.concurrency import tsan as _tsan
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
@@ -131,7 +136,7 @@ class MetricBase:
         # hot counters (collective bytes, retraces, prefetch) are only ever
         # scraped cumulatively
         self.windowed = bool(windowed)
-        self._lock = threading.Lock()
+        self._lock = _tsan.lock(f"metrics.{name}")
         self._values: dict = {}
         self._ticks: dict = {}   # key -> deque[(monotonic, cumulative)]
         self.max_series = _env_max_series()
@@ -247,7 +252,8 @@ class Counter(MetricBase):
             self._note_tick(key, cum)
 
     def value(self, /, **labels):
-        return self._values.get(_label_key(labels), 0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
 
     def total(self):
         with self._lock:
@@ -293,7 +299,8 @@ class Gauge(MetricBase):
         self.inc(-value, **labels)
 
     def value(self, /, **labels):
-        return self._values.get(_label_key(labels), 0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0)
 
 
 # Prometheus-style latency buckets, in seconds.
@@ -383,7 +390,7 @@ class Registry:
     raises (one name, one type — the Prometheus exposition contract)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _tsan.lock("metrics.registry")
         self._metrics: dict[str, MetricBase] = {}
 
     def _get_or_create(self, cls, name, help, **kw):
@@ -401,8 +408,14 @@ class Registry:
                         f"histogram {name!r} already registered with "
                         f"buckets {m.buckets}, requested "
                         f"{tuple(sorted(float(b) for b in want))}")
-                if kw.get("windowed"):
-                    m.windowed = True   # a later windowed=True request arms it
+                if kw.get("windowed") and not m.windowed:
+                    # a later windowed=True request arms it. A PLAIN
+                    # write on purpose: a monotonic one-way bool flip
+                    # (worst case one missed rate tick) — taking
+                    # m._lock here, inside the registry critical
+                    # section, would mint a registry→metric lock order
+                    # no other path needs
+                    m.windowed = True
                 return m
             kw = {k: v for k, v in kw.items() if v is not None}
             m = self._metrics[name] = cls(name, help, **kw)

@@ -54,6 +54,7 @@ def _surface_cached() -> tuple:
     import paddle_tpu as paddle
     import paddle_tpu.analysis as analysis
     import paddle_tpu.incubate.nn.functional as incubate_F
+    import paddle_tpu.analysis.concurrency as analysis_conc
     import paddle_tpu.analysis.graph as analysis_graph
     import paddle_tpu.io as io_mod
     import paddle_tpu.jit as jit
@@ -103,6 +104,12 @@ def _surface_cached() -> tuple:
     # candidates, peak-liveness) — bench/perf_gate/CI parse its reports,
     # so trace_layer/analyze_graph/GraphReport are contracts like ops
     _collect(analysis_graph, "paddle.analysis.graph", "analysis", records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    # concurrency tier: the lock-discipline rules (CS100-CS105) and the
+    # runtime thread-sanitizer factories — tools/tsan_check.py and the
+    # instrumented runtimes program against these
+    _collect(analysis_conc, "paddle.analysis.concurrency", "analysis",
+             records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     # fault-tolerance runtime: the checkpoint manager, sentinel, preemption
     # handler and the fault-injection surface are recovery contracts CI must

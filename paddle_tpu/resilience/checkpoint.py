@@ -39,6 +39,7 @@ import re
 import threading
 import time
 
+from ..analysis.concurrency import tsan as _tsan
 from ..framework.io import _fsync_dir
 from ..observability import (counter as _obs_counter, gauge as _obs_gauge,
                              histogram as _obs_histogram)
@@ -137,7 +138,9 @@ class CheckpointManager:
         # preemption) pass their own root explicitly instead.
         _flight.set_dump_dir(self.root)
         _flight.install_excepthook()
-        self._io_lock = threading.Lock()   # serializes commits + retention
+        # serializes commits + retention; also guards _last_error, which
+        # the background save thread writes and caller threads read
+        self._io_lock = _tsan.lock("resilience.CheckpointManager.io")
         self._inflight: threading.Thread | None = None
         self._last_error: BaseException | None = None
         self._manifest_re = re.compile(
@@ -172,27 +175,45 @@ class CheckpointManager:
     @property
     def last_error(self) -> BaseException | None:
         """The exception that killed the most recent (async) save, if any."""
-        return self._last_error
+        with self._io_lock:
+            return self._last_error
 
     # -- save ----------------------------------------------------------------
 
     def save(self, step: int, model=None, optimizer=None, scaler=None,
-             lr_scheduler=None, extra=None, blocking: bool | None = None):
+             lr_scheduler=None, extra=None, blocking: bool | None = None,
+             wait_timeout: float | None = None):
         """Snapshot state now; commit synchronously or in the background.
 
         Any component may be omitted. RNG state (global generator + named
         tracker streams) is always captured. Returns the background thread
         when committing asynchronously, else None.
+
+        ``wait_timeout`` bounds the drain of a previous in-flight async
+        save (default: block until drained). The preemption path passes
+        0.0 — it already waited its own bounded drain, and a wedged
+        commit thread must not block the final checkpoint (whose file
+        writes are still serialized against it by the io lock).
         """
         payload = self._snapshot(step, model, optimizer, scaler,
                                  lr_scheduler, extra)
         sync = not self.async_save if blocking is None else blocking
-        self.wait()  # at most one save in flight; also bounds memory
+        drained = self.wait(wait_timeout)  # ≤1 in flight; bounds memory
         if sync:
             self._commit(step, payload)
             return None
-        th = threading.Thread(target=self._commit_guarded,
-                              args=(step, payload), daemon=True,
+        # a bounded wait that expired leaves the previous commit thread
+        # alive: CHAIN behind it instead of overwriting _inflight (which
+        # would run two commits at once and make wait() lie about being
+        # drained)
+        prev = None if drained else self._inflight
+
+        def _run():
+            if prev is not None:
+                prev.join()
+            self._commit_guarded(step, payload)
+
+        th = threading.Thread(target=_run, daemon=True,
                               name=f"ckpt-save-{step}")
         self._inflight = th
         th.start()
@@ -225,7 +246,8 @@ class CheckpointManager:
         try:
             self._commit(step, payload)
         except BaseException as e:  # background thread: record, don't kill
-            self._last_error = e
+            with self._io_lock:
+                self._last_error = e
 
     def _commit(self, step, payload):
         t0 = time.perf_counter()
@@ -253,7 +275,8 @@ class CheckpointManager:
             _flight.dump(reason="checkpoint_save_error", step=int(step),
                          dump_dir=self.root)
             raise
-        self._last_error = None
+        with self._io_lock:
+            self._last_error = None
         _OBS_SAVES.inc(status="ok")
         _OBS_SAVE_SECONDS.observe(time.perf_counter() - t0)
         _OBS_LAST_STEP.set(step)
@@ -271,13 +294,18 @@ class CheckpointManager:
                 except OSError:
                     pass
 
-    def wait(self, timeout: float | None = None) -> None:
-        """Drain the in-flight async save, if any."""
+    def wait(self, timeout: float | None = None) -> bool:
+        """Drain the in-flight async save, if any. Returns True when no
+        save remains in flight afterwards (False = the timeout expired
+        with the commit thread still running — the preemption drain
+        turns that into a loud RuntimeWarning)."""
         th = self._inflight
         if th is not None:
             th.join(timeout)
-            if not th.is_alive():
-                self._inflight = None
+            if th.is_alive():
+                return False
+            self._inflight = None
+        return True
 
     # -- restore -------------------------------------------------------------
 

@@ -63,16 +63,25 @@ class PreemptionHandler:
     """
 
     def __init__(self, manager=None, exit_code: int | None = None,
-                 signals=(signal.SIGTERM, signal.SIGINT)):
+                 signals=(signal.SIGTERM, signal.SIGINT),
+                 drain_timeout_s: float = 120.0):
         """exit_code=None derives the status from the preemption source —
         128+TERM=143 (scheduler-relaunchable) for sigterm/elastic/manual,
         128+INT=130 for an operator's Ctrl-C, which wrappers must NOT
-        auto-relaunch. An explicit int overrides both."""
+        auto-relaunch. An explicit int overrides both.
+        ``drain_timeout_s`` bounds the async-save drain in
+        :meth:`maybe_exit` (a loud RuntimeWarning on expiry)."""
         self.manager = manager
         self.exit_code = None if exit_code is None else int(exit_code)
         self.signals = tuple(signals)
+        self.drain_timeout_s = float(drain_timeout_s)
         self._preempted = threading.Event()
         self._source: str | None = None
+        self._counted = False    # metric flushed (deferred out of signal ctx)
+        # guards the _counted check-then-set: an elastic-hook thread and
+        # the training thread may flush concurrently. NEVER taken in
+        # signal context (_on_signal goes through _mark only)
+        self._metric_lock = threading.Lock()
         self._prev_handlers: dict = {}
         self._installed = False
 
@@ -102,19 +111,36 @@ class PreemptionHandler:
         return False
 
     def _on_signal(self, signum, frame):
-        # async-signal context: record only; the loop acts at a step boundary
-        self.request_preemption(
-            "sigint" if signum == signal.SIGINT else "sigterm")
+        # async-signal context: flag + flight only (both lock-free by
+        # construction — CS102). The metric counter takes the registry
+        # lock, so it is DEFERRED to the step boundary (maybe_exit); a
+        # signal landing while the main thread holds that very lock
+        # would otherwise deadlock the process.
+        self._mark("sigint" if signum == signal.SIGINT else "sigterm")
 
-    def request_preemption(self, source: str = "manual") -> None:
-        """Mark the run preempted (thread-safe; first source wins)."""
+    def _mark(self, source: str) -> None:
+        """Signal-safe core of a preemption request: a plain attribute
+        write, an Event.set, and a flight event. First source wins."""
         if not self._preempted.is_set():
             self._source = source
             self._preempted.set()
-            _OBS_PREEMPTIONS.inc(source=source)
-            # flight.record is signal-safe by construction (no locks);
-            # this may run inside the SIGTERM handler
             _flight.record("preempt", source=source)
+
+    def request_preemption(self, source: str = "manual") -> None:
+        """Mark the run preempted (thread-safe; first source wins).
+        Thread-context callers (elastic hooks, manual) — signal handlers
+        go through :meth:`_mark` and flush the metric later."""
+        self._mark(source)
+        self._flush_metric()
+
+    def _flush_metric(self) -> None:
+        if not self._preempted.is_set():
+            return
+        with self._metric_lock:
+            if self._counted:
+                return
+            self._counted = True
+        _OBS_PREEMPTIONS.inc(source=self._source or "unknown")
 
     @property
     def preempted(self) -> bool:
@@ -140,12 +166,26 @@ class PreemptionHandler:
         `step`, and raise TrainingPreempted(exit_code)."""
         if not self._preempted.is_set():
             return
+        self._flush_metric()   # the counter deferred out of signal context
         t0 = time.perf_counter()
         if self.manager is not None:
-            self.manager.wait()       # drain the in-flight async save
+            # drain the in-flight async save — BOUNDED: a wedged save
+            # thread must not turn preemption into a hang past the
+            # scheduler's kill grace period
+            if not self.manager.wait(self.drain_timeout_s):
+                import warnings
+                warnings.warn(
+                    f"async checkpoint save did not drain within "
+                    f"{self.drain_timeout_s}s of preemption; attempting "
+                    f"the final checkpoint anyway (it may still block if "
+                    f"the stuck commit holds the checkpoint io lock)",
+                    RuntimeWarning, stacklevel=2)
+            # wait_timeout=0.0: the bounded drain above already ran —
+            # save() must not re-join the wedged thread without a bound
             self.manager.save(step, model=model, optimizer=optimizer,
                               scaler=scaler, lr_scheduler=lr_scheduler,
-                              extra=extra, blocking=True)
+                              extra=extra, blocking=True,
+                              wait_timeout=0.0)
         try:
             # the live telemetry server must not outlive the run: close
             # the socket and join the acceptor thread as part of the drain

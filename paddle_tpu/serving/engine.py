@@ -35,6 +35,7 @@ from dataclasses import dataclass, replace
 
 import numpy as np
 
+from ..analysis.concurrency import tsan as _tsan
 from ..autograd.grad_mode import no_grad
 from ..core.tensor import Tensor
 from ..jit.api import to_static
@@ -125,13 +126,18 @@ class LLMEngine:
         self._prog_base = self._raw_program_stats()
         self._build_programs()
 
-        self._cond = threading.Condition()
+        self._cond = _tsan.condition("serving.LLMEngine")
         self._thread: threading.Thread | None = None
         self._stop_mode: str | None = None
         self._drain_deadline = 0.0
         self._t_started: float | None = None
         self._last_step_wall: float | None = None
         self._old_handlers: dict = {}
+        # preemption plumbing: the SIGNAL handler only writes
+        # _preempt_code and waits on _drained; the engine thread sees the
+        # flag within one loop tick, drains, dumps, and sets the event
+        self._preempt_code: int | None = None
+        self._drained = threading.Event()
 
     # -- compiled programs ---------------------------------------------------
 
@@ -247,10 +253,23 @@ class LLMEngine:
     def _loop(self):
         sched = self.scheduler
         while True:
+            if self._preempt_code is not None and self._stop_mode is None:
+                # signal-requested drain: the handler only set a flag
+                # (async-signal context may not take locks); the heavy
+                # lifting happens here, on the engine thread
+                with self._cond:
+                    if self._stop_mode is None:
+                        self._drain_deadline = time.monotonic() + \
+                            self.config.drain_timeout_s
+                        self._stop_mode = "drain"
             with self._cond:
                 while self._stop_mode is None and not sched.has_work():
                     self._cond.wait(0.05)
+                    if self._preempt_code is not None:
+                        break
                 mode = self._stop_mode
+            if mode is None and self._preempt_code is not None:
+                continue    # arm the drain at the top of the loop
             if mode == "abort":
                 break
             if mode == "drain":
@@ -269,6 +288,25 @@ class LLMEngine:
             except Exception as e:       # noqa: BLE001
                 self._engine_error(e)
                 break
+        if self._preempt_code is not None:
+            self._finish_preemption()
+
+    def _finish_preemption(self):
+        """Post-drain bookkeeping of a signal-requested shutdown, on the
+        engine thread: fail leftovers, dump the black box, close the
+        telemetry server, then release the waiting signal handler."""
+        try:
+            self._finalize(drain=True)
+            _flight.dump("serving_preempted",
+                         step=self.scheduler.decode_steps,
+                         extra={"serving": self.stats()})
+            try:
+                from ..observability.continuous import shutdown_server
+                shutdown_server()
+            except Exception:
+                pass
+        finally:
+            self._drained.set()
 
     def _engine_error(self, e: Exception):
         """A device/program failure is engine-fatal: every request is
@@ -295,6 +333,18 @@ class LLMEngine:
         if self._thread is not None and self._thread.is_alive() and \
                 threading.current_thread() is not self._thread:
             self._thread.join(timeout + 5.0)
+            if self._thread.is_alive():
+                import warnings
+                warnings.warn(
+                    f"serving engine thread did not exit within "
+                    f"{timeout + 5.0:.1f}s of shutdown (a decode step "
+                    f"may be wedged); failing requests anyway",
+                    RuntimeWarning, stacklevel=2)
+        return self._finalize(drain)
+
+    def _finalize(self, drain: bool) -> dict:
+        """Fail whatever remains, assert pool accounting, record the
+        drain event; shared by shutdown() and the preemption path."""
         n_queued = self.scheduler.abort_queued("engine shut down")
         n_active = self.scheduler.abort_active(
             "engine shut down before completion" if not drain
@@ -448,19 +498,57 @@ class LLMEngine:
         """Arm signal-driven drain: on SIGTERM the engine drains (or
         cleanly errors) in-flight requests, dumps the flight recorder
         (reason ``serving_preempted``), shuts the telemetry server down
-        and exits ``exit_code`` — the chaos serving profile's contract."""
+        and exits ``exit_code`` — the chaos serving profile's contract.
+
+        The handler body is async-signal-safe by construction (CS102):
+        it records a flight event (lock-free), writes one attribute, and
+        waits — bounded — for the ENGINE thread to do the draining,
+        dumping and server shutdown. Taking the engine condition or the
+        scheduler lock here would deadlock whenever the signal lands
+        while the interrupted main-thread frame holds it."""
 
         def _handler(signum, frame):
             _flight.record("serving_preempt", signum=int(signum))
-            try:
-                self.shutdown(drain=True,
-                              timeout=self.config.drain_timeout_s)
-            finally:
+            self._preempt_code = int(exit_code)
+            # slice the wait so an engine thread that exits WITHOUT
+            # running the preemption tail (its loop passed the flag
+            # check just before the signal landed) is noticed within
+            # one slice instead of burning the whole drain window
+            deadline = time.monotonic() + \
+                self.config.drain_timeout_s + 30.0
+            drained = False
+            last_steps = self.scheduler.decode_steps
+            stalled = 0
+            while time.monotonic() < deadline:
+                if self._drained.wait(0.2):
+                    drained = True
+                    break
+                if not self.running:
+                    break
+                steps = self.scheduler.decode_steps
+                if steps == last_steps:
+                    stalled += 1
+                    if stalled >= 50:
+                        # ~10s with ZERO decode progress: the signal
+                        # likely interrupted a main-thread frame that
+                        # holds a lock the drain needs (submit/stream
+                        # mid-critical-section) — burning the rest of
+                        # the window cannot help; exit with the dump
+                        break
+                else:
+                    stalled, last_steps = 0, steps
+            # the engine thread may finish its dump in the gap between
+            # the last wait slice and the running check — don't write a
+            # second, stats-free dump over its richer one
+            drained = drained or self._drained.is_set()
+            if not drained:
+                if self.running:
+                    _flight.record("serving_drain_timeout",
+                                   timeout_s=self.config.drain_timeout_s)
+                # nothing mid-decode (or wedged past the deadline) —
+                # leave the black box ourselves (dump is sanctioned)
                 _flight.dump("serving_preempted",
-                             step=self.scheduler.decode_steps,
-                             extra={"serving": self.stats()})
-                from ..observability.continuous import shutdown_server
-                shutdown_server()
+                             step=self.scheduler.decode_steps)
             raise SystemExit(exit_code)
 
         for sig in signals:

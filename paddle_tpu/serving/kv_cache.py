@@ -42,10 +42,10 @@ the chaos gate's "leak zero KV pages" check).
 from __future__ import annotations
 
 import math
-import threading
 
 import jax.numpy as jnp
 
+from ..analysis.concurrency import tsan as _tsan
 from ..core.tensor import Tensor
 from ..observability import gauge as _obs_gauge, counter as _obs_counter
 
@@ -99,7 +99,7 @@ class PagePool:
                  self.page_size, self.head_dim)
         self.k = Tensor(jnp.zeros(shape, jnp.dtype(dtype)))
         self.v = Tensor(jnp.zeros(shape, jnp.dtype(dtype)))
-        self._lock = threading.Lock()
+        self._lock = _tsan.lock("serving.PagePool")
         # LIFO: recently-freed (warm) pages are reused first
         self._free = list(range(self.num_pages - 1, TRASH_PAGE, -1))
         self._used: set[int] = set()
@@ -142,14 +142,24 @@ class PagePool:
                     f"(pool {self.allocatable})")
             pages = [self._free.pop() for _ in range(n)]
             self._used.update(pages)
+            if _tsan.active():
+                _tsan.note_write(self, "_free", self._lock)
             self._export()
             return pages
 
     def free(self, pages) -> None:
-        """Return pages to the pool; double frees and unowned ids raise."""
+        """Return pages to the pool; double frees and unowned ids raise.
+        A duplicate id WITHIN one call is the same bug in one step — the
+        first free would legitimize the second, and the free list would
+        hand the page out twice — so it raises before any mutation."""
         pages = list(pages)
         with self._lock:
             bad = [p for p in pages if p not in self._used]
+            if len(set(pages)) != len(pages):
+                dups = sorted({p for p in pages if pages.count(p) > 1})
+                raise PagePoolError(
+                    f"page(s) {dups} appear more than once in one free() "
+                    f"call (double free); pool left untouched")
             if bad:
                 raise PagePoolError(
                     f"freeing page(s) {bad} not currently allocated "
@@ -157,6 +167,8 @@ class PagePool:
             for p in pages:
                 self._used.discard(p)
                 self._free.append(p)
+            if _tsan.active():
+                _tsan.note_write(self, "_free", self._lock)
             self._export()
 
     def leaked(self) -> int:

@@ -36,6 +36,7 @@ import uuid
 
 import numpy as np
 
+from ..analysis.concurrency import tsan as _tsan
 from ..observability import (counter as _obs_counter, gauge as _obs_gauge,
                              histogram as _obs_histogram)
 from ..observability import flight as _flight
@@ -212,7 +213,7 @@ class Scheduler:
         self.max_seq_len = int(max_seq_len)
         self.max_pages = pool.pages_for(self.max_seq_len)
         self.eos_token_id = eos_token_id
-        self.lock = threading.RLock()
+        self.lock = _tsan.rlock("serving.Scheduler")
         self.waiting: list[Request] = []      # kept sorted by arrival
         self.slots: list[Request | None] = [None] * self.max_batch
         self.tables = np.zeros((self.max_batch, self.max_pages), np.int32)
@@ -348,7 +349,11 @@ class Scheduler:
         if done_eos or done_len:
             self._release(req)
             req._finish(COMPLETED)
-            self.completed += 1
+            with self.lock:
+                # accounting is read by stats()/health() from server
+                # threads while the engine thread steps — same lock as
+                # the slot tables, no torn counters
+                self.completed += 1
             _flight.record("serving_complete", request=req.request_id,
                            generated=len(req.tokens),
                            reason="eos" if done_eos else "length")
@@ -358,11 +363,11 @@ class Scheduler:
     def _evict(self, victim: Request) -> None:
         self._release(victim)
         victim.evictions += 1
-        self.evictions += 1
         _EVICTIONS.inc()
         _flight.record("serving_evict", request=victim.request_id,
                        generated=len(victim.tokens))
         with self.lock:
+            self.evictions += 1
             self._enqueue(victim)
 
     def _ensure_pages(self, req: Request) -> bool:
@@ -415,9 +420,13 @@ class Scheduler:
                 if r is None:
                     tables[i][:] = 0
         out = self.programs.decode(tokens, positions, tables, temps)
-        self.decode_steps += 1
         occ = len(active) / float(self.max_batch)
-        self.occupancy_sum += occ
+        with self.lock:
+            self.decode_steps += 1
+            self.occupancy_sum += occ
+            if _tsan.active():
+                _tsan.note_write(self, "decode_steps", self.lock)
+                _tsan.note_write(self, "occupancy_sum", self.lock)
         _STEPS.inc()
         _OCC.set(occ)
         for req in active:

@@ -1,0 +1,770 @@
+"""Concurrency tier (paddle_tpu.analysis.concurrency): one positive +
+one negative fixture per CS rule, the CLI contract (exit codes, JSON
+spans, allowlist), the runtime sanitizer (held sets, order graph,
+write checking), the static↔runtime bridge on the planted demo, and
+regression tests for the races this tier's self-application fixed."""
+
+import json
+import threading
+import time
+import warnings
+
+import pytest
+
+from paddle_tpu.analysis.concurrency import (
+    RULES, analyze_source, apply_allowlist, has_errors, tsan,
+)
+from paddle_tpu.analysis.concurrency.__main__ import main as cli_main
+
+HEADER = (
+    "import signal\n"
+    "import sys\n"
+    "import threading\n"
+)
+
+
+def ids_of(src):
+    return {f.rule_id for f in analyze_source(HEADER + src)}
+
+
+@pytest.fixture(autouse=True)
+def _tsan_clean():
+    """Each test starts with an empty report/graph table and leaves the
+    sanitizer disabled (the suite-wide default)."""
+    tsan.clear()
+    yield
+    tsan.clear()
+    tsan.enable(False)
+
+
+# -- per-rule fixtures ------------------------------------------------------
+
+CS100_POS = """
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.used = 0
+    def alloc(self):
+        with self._lock:
+            self.used += 1
+    def steal(self):
+        self.used -= 1
+"""
+
+CS100_NEG = """
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.used = 0
+    def alloc(self):
+        with self._lock:
+            self.used += 1
+    def free(self):
+        with self._lock:
+            self.used -= 1
+"""
+
+
+def test_cs100_inconsistent_guard():
+    assert "CS100" in ids_of(CS100_POS)
+    assert "CS100" not in ids_of(CS100_NEG)
+
+
+def test_cs100_helper_called_under_lock_is_guarded():
+    # call-site guard propagation: a helper whose every call site holds
+    # the lock is not an unguarded write (the _note_tick pattern)
+    src = """
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.used = 0
+    def alloc(self):
+        with self._lock:
+            self._bump()
+    def free(self):
+        with self._lock:
+            self._bump()
+    def _bump(self):
+        self.used += 1
+"""
+    assert "CS100" not in ids_of(src)
+
+
+def test_cs100_subclass_resolves_base_lock():
+    # inheritance-aware: the guard lives in the base __init__, the
+    # guarded use in the subclass (the MetricBase/Counter shape)
+    src = """
+class Base:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+    def _bump(self):
+        self.n += 1
+class Sub(Base):
+    def inc(self):
+        with self._lock:
+            self._bump()
+"""
+    assert "CS100" not in ids_of(src)
+
+
+def test_cs100_thread_path_variant():
+    src = """
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.steps = 0
+        self._t = threading.Thread(target=self._loop)
+    def _loop(self):
+        self.steps += 1
+    def stats(self):
+        return self.steps
+"""
+    assert "CS100" in ids_of(src)
+
+
+def test_cs101_lock_order_inversion():
+    pos = """
+class Bank:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+    def ab(self):
+        with self.a:
+            with self.b:
+                pass
+    def ba(self):
+        with self.b:
+            with self.a:
+                pass
+"""
+    assert "CS101" in ids_of(pos)
+    neg = pos.replace("with self.b:\n            with self.a:",
+                      "with self.a:\n            with self.b:")
+    assert "CS101" not in ids_of(neg)
+
+
+def test_cs102_signal_unsafe_handler():
+    pos = """
+import paddle_tpu.observability as obs
+_C = obs.counter("x_total")
+def handler(signum, frame):
+    _C.inc()
+signal.signal(signal.SIGTERM, handler)
+"""
+    assert "CS102" in ids_of(pos)
+    # the sanctioned shape: flag write + Event.set + flight.record
+    neg = """
+from paddle_tpu.observability import flight as _flight
+class H:
+    def __init__(self):
+        self._evt = threading.Event()
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+    def _on_signal(self, signum, frame):
+        _flight.record("preempt", source="sigterm")
+        self._evt.set()
+"""
+    assert "CS102" not in ids_of(neg)
+
+
+def test_cs102_lock_in_handler():
+    pos = """
+class H:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def install(self):
+        signal.signal(signal.SIGINT, self._on)
+    def _on(self, signum, frame):
+        with self._lock:
+            pass
+"""
+    assert "CS102" in ids_of(pos)
+
+
+def test_cs103_unbounded_shutdown_wait():
+    pos = """
+class Srv:
+    def close(self):
+        self._thread.join()
+"""
+    assert "CS103" in ids_of(pos)
+    neg = """
+class Srv:
+    def close(self, timeout=5.0):
+        self._thread.join(timeout)
+"""
+    assert "CS103" not in ids_of(neg)
+    # non-shutdown paths may block (a worker loop's queue.get)
+    hot = """
+class W:
+    def loop(self):
+        item = self._q.get()
+"""
+    assert "CS103" not in ids_of(hot)
+
+
+def test_cs104_broken_double_checked_init():
+    pos = """
+_lock = threading.Lock()
+_inst = None
+def get():
+    global _inst
+    if _inst is None:
+        with _lock:
+            _inst = object()
+    return _inst
+"""
+    assert "CS104" in ids_of(pos)
+    neg = pos.replace("with _lock:\n            _inst = object()",
+                      "with _lock:\n            if _inst is None:\n"
+                      "                _inst = object()")
+    assert "CS104" not in ids_of(neg)
+
+
+def test_cs105_thread_start_in_init():
+    pos = """
+class A:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+        self.state = {}
+"""
+    assert "CS105" in ids_of(pos)
+    neg = """
+class A:
+    def __init__(self):
+        self.state = {}
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+"""
+    assert "CS105" not in ids_of(neg)
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def test_cli_exit_codes_and_json_spans(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(HEADER + CS100_POS)
+    rc = cli_main([str(bad), "--format", "json", "--no-allowlist"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    f = next(f for f in out["findings"] if f["rule"] == "CS100")
+    assert f["file"] == str(bad) and f["line"] > 0 and f["symbol"]
+    assert out["counts"]["error"] >= 1
+
+    good = tmp_path / "good.py"
+    good.write_text(HEADER + CS100_NEG)
+    assert cli_main([str(good)]) == 0
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULES:
+        assert rid in out
+
+
+def test_cli_select_and_min_severity(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(HEADER + CS100_POS)
+    # selecting a warning-only rule drops the CS100 error -> exit 0
+    assert cli_main([str(bad), "--select", "CS103",
+                     "--no-allowlist"]) == 0
+
+
+def test_cli_allowlist_waives(tmp_path, capsys):
+    bad = tmp_path / "racy.py"
+    bad.write_text(HEADER + CS100_POS)
+    allow = tmp_path / "cs_allowlist.txt"
+    allow.write_text("racy.py CS100  # fixture waiver\n")
+    rc = cli_main([str(bad), "--allowlist", str(allow),
+                   "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert not out["findings"] and len(out["waived"]) == 1
+
+
+def test_repo_tree_is_clean():
+    """The acceptance contract: the self-applied linter exits 0 on the
+    whole paddle_tpu/ tree (demo waivers via tools/cs_allowlist.txt)."""
+    import os
+    root = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu")
+    assert cli_main([root]) == 0
+
+
+def test_apply_allowlist_matches_suffix():
+    from paddle_tpu.analysis.diagnostics import Finding
+    f = Finding(rule_id="CS100", severity="error", message="m",
+                file="/abs/path/pkg/mod.py", line=1)
+    kept, waived = apply_allowlist([f], {("pkg/mod.py", "CS100")})
+    assert not kept and waived
+    kept, waived = apply_allowlist([f], {("other.py", "CS100")})
+    assert kept and not waived
+
+
+# -- runtime sanitizer ------------------------------------------------------
+
+def test_disabled_factories_are_plain_primitives():
+    tsan.enable(False)
+    assert type(tsan.lock("x")) is type(threading.Lock())
+    assert type(tsan.rlock("x")) is type(threading.RLock())
+    assert type(tsan.condition("x")) is type(threading.Condition())
+    # the probe is a no-op too
+    tsan.note_write(object(), "f", None)
+    assert tsan.reports() == []
+
+
+def test_enabled_lock_tracks_held_set():
+    tsan.enable(True)
+    lk = tsan.lock("t.held")
+    assert "t.held" not in tsan.held_locks()
+    with lk:
+        assert "t.held" in tsan.held_locks()
+    assert "t.held" not in tsan.held_locks()
+
+
+def test_lock_inversion_detected_across_threads():
+    tsan.enable(True)
+    a, b = tsan.lock("t.inv_a"), tsan.lock("t.inv_b")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (ab, ba):   # sequential threads: order graph, no deadlock
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(10)
+    reps = [r for r in tsan.reports() if r["kind"] == "lock_inversion"]
+    assert reps and reps[0]["static_rule"] == "CS101"
+    assert set(reps[0]["locks"]) == {"t.inv_a", "t.inv_b"}
+    assert reps[0]["stack_forward"] and reps[0]["stack_back"]
+
+
+def test_consistent_order_is_not_reported():
+    tsan.enable(True)
+    a, b = tsan.lock("t.ord_a"), tsan.lock("t.ord_b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert tsan.reports() == []
+
+
+def test_note_write_reports_cross_thread_unguarded():
+    tsan.enable(True)
+
+    class Obj:
+        pass
+
+    o, lk = Obj(), tsan.lock("t.w")
+
+    def guarded():
+        with lk:
+            tsan.note_write(o, "v", lk)
+
+    def unguarded():
+        tsan.note_write(o, "v", lk)
+
+    for fn in (guarded, unguarded):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(10)
+    reps = [r for r in tsan.reports() if r["kind"] == "racy_write"]
+    assert reps and reps[0]["static_rule"] == "CS100"
+    assert reps[0]["field"] == "v" and reps[0]["owner"] == "Obj"
+
+
+def test_note_write_guard_is_identity_not_name_keyed():
+    """Holding instance A's lock must not vouch for same-named instance
+    B's (lock names are per-class, shared across instances)."""
+    tsan.enable(True)
+
+    class Obj:
+        pass
+
+    o = Obj()
+    lk_a, lk_b = tsan.lock("t.shared_name"), tsan.lock("t.shared_name")
+
+    def wrong_lock():
+        with lk_a:                      # same NAME, different lock
+            tsan.note_write(o, "v", lk_b)
+
+    def right_lock():
+        with lk_b:
+            tsan.note_write(o, "v", lk_b)
+
+    for fn in (right_lock, wrong_lock):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(10)
+    reps = [r for r in tsan.reports() if r["kind"] == "racy_write"]
+    assert reps and reps[0]["field"] == "v"
+
+
+def test_note_write_guarded_both_sides_is_clean():
+    tsan.enable(True)
+
+    class Obj:
+        pass
+
+    o, lk = Obj(), tsan.lock("t.w2")
+
+    def writer():
+        with lk:
+            tsan.note_write(o, "v", lk)
+
+    for _ in range(2):
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join(10)
+    assert [r for r in tsan.reports() if r["kind"] == "racy_write"] == []
+
+
+def test_rlock_locked_is_true_for_own_thread():
+    tsan.enable(True)
+    lk = tsan.rlock("t.rlocked")
+    assert lk.locked() is False
+    with lk:
+        assert lk.locked() is True    # a bare reentrant probe would lie
+        with lk:
+            assert lk.locked() is True
+        assert lk.locked() is True
+    assert lk.locked() is False
+
+
+def test_condition_wait_reopens_held_set():
+    tsan.enable(True)
+    cond = tsan.condition("t.cond")
+    seen = {}
+
+    def waiter():
+        with cond:
+            seen["in"] = tsan.held_locks()
+            cond.wait(0.05)
+            seen["after"] = tsan.held_locks()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    t.join(10)
+    assert "t.cond" in seen["in"] and "t.cond" in seen["after"]
+    assert "t.cond" not in tsan.held_locks()
+
+
+def test_tsan_reports_surface_in_flight_and_metrics():
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import flight
+    flight.enable(True)
+    flight.clear()
+    base = obs.total("paddle_tpu_tsan_reports_total")
+    tsan.enable(True)
+    a, b = tsan.lock("t.fm_a"), tsan.lock("t.fm_b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert obs.total("paddle_tpu_tsan_reports_total") == base + 1
+    kinds = [e["kind"] for e in flight.events()]
+    assert "tsan_lock_inversion" in kinds
+
+
+# -- the static<->runtime bridge (planted demo) -----------------------------
+
+def test_bridge_static_findings_confirmed_at_runtime():
+    """Acceptance: at least one static finding cross-confirmed by a
+    runtime sanitizer report — the demo is flagged CS100+CS101
+    statically, and running it under the sanitizer produces reports
+    whose static_rule fields name those exact rules."""
+    import os
+    from paddle_tpu.analysis.concurrency import analyze_file, demo
+    path = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu",
+                        "analysis", "concurrency", "demo.py")
+    static_ids = {f.rule_id for f in analyze_file(path)}
+    assert {"CS100", "CS101"} <= static_ids
+    tsan.enable(True)
+    reps = demo.run_demo()
+    confirmed = {r.get("static_rule") for r in reps}
+    assert {"CS100", "CS101"} <= confirmed
+
+
+# -- regressions for the races the self-application fixed -------------------
+
+def test_pagepool_duplicate_ids_in_one_free_raise():
+    from paddle_tpu.serving.kv_cache import PagePool, PagePoolError
+    pool = PagePool(num_layers=1, num_pages=6, num_kv_heads=1,
+                    page_size=4, head_dim=2)
+    pages = pool.alloc(2)
+    with pytest.raises(PagePoolError, match="more than once"):
+        pool.free([pages[0], pages[0]])
+    # the failed free mutated nothing: both pages still owned, a clean
+    # free still works, accounting intact
+    assert pool.used_pages == 2
+    pool.free(pages)
+    assert pool.used_pages == 0 and pool.free_pages == pool.allocatable
+
+
+def test_pagepool_accounting_under_thread_storm():
+    from paddle_tpu.serving.kv_cache import (PagePool, PagePoolExhausted)
+    tsan.enable(True)
+    pool = PagePool(num_layers=1, num_pages=33, num_kv_heads=1,
+                    page_size=4, head_dim=2)
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(200):
+                try:
+                    pages = pool.alloc(2)
+                except PagePoolExhausted:
+                    continue
+                pool.free(pages)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors
+    assert pool.used_pages == 0 and pool.free_pages == pool.allocatable
+    assert [r for r in tsan.reports() if r["kind"] == "racy_write"] == []
+
+
+def test_scheduler_accounting_consistent_under_reader_storm():
+    """The fixed race: decode_steps/completed/evictions/occupancy_sum
+    are mutated by the engine thread and read by stats()/health()
+    threads — all under the scheduler lock now; the sanitizer's write
+    probes stay silent and the final accounting adds up."""
+    import numpy as np
+    from paddle_tpu.serving.kv_cache import PagePool
+    from paddle_tpu.serving.scheduler import Request, Scheduler
+    tsan.enable(True)
+
+    class FakePrograms:
+        def prefill(self, req):
+            return 7
+
+        def bucket_for(self, n):
+            return 8
+
+        def decode(self, tokens, positions, tables, temps):
+            return np.full(tokens.shape, 7, np.int32)
+
+    pool = PagePool(num_layers=1, num_pages=65, num_kv_heads=1,
+                    page_size=4, head_dim=2)
+    sched = Scheduler(pool, FakePrograms(), max_batch=4, max_seq_len=32)
+    stop = threading.Event()
+    snaps = []
+
+    def reader():
+        while not stop.is_set():
+            snaps.append((sched.queue_depth(),
+                          len(sched.active_requests())))
+
+    readers = [threading.Thread(target=reader) for _ in range(3)]
+    for t in readers:
+        t.start()
+    n = 12
+    for i in range(n):
+        sched.submit(Request([1, 2, 3], max_new_tokens=4))
+    while sched.has_work():
+        sched.step()
+    stop.set()
+    for t in readers:
+        t.join(10)
+    assert sched.completed == n
+    assert pool.leaked() == 0
+    assert sched.decode_steps > 0
+    assert [r for r in tsan.reports() if r["kind"] == "racy_write"] == []
+
+
+def test_server_route_registration_storm():
+    """The fixed crash race: registering routes while handler threads
+    list them (copy-on-write now) — hammer both sides over live HTTP
+    and require only clean 200/404 responses."""
+    import urllib.request
+    from paddle_tpu.observability.continuous.server import (
+        TelemetryServer, register_route, unregister_route)
+    srv = TelemetryServer(port=0, host="127.0.0.1").start()
+    stop = threading.Event()
+    failures = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            path = f"/x{i % 7}"
+            register_route(path, lambda h, m, q, b: h._send_json(
+                200, {"ok": True}))
+            unregister_route(path)
+            i += 1
+
+    def scrape():
+        while not stop.is_set():
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/nosuch", timeout=5)
+            except urllib.error.HTTPError as e:
+                if e.code != 404:
+                    failures.append(e.code)
+            except Exception as e:  # noqa: BLE001
+                failures.append(repr(e))
+
+    threads = [threading.Thread(target=churn),
+               threading.Thread(target=scrape),
+               threading.Thread(target=scrape)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    srv.close()
+    assert not failures
+
+
+def test_profiler_on_step_vs_reset_thread_storm():
+    """The fixed race: on_step (train thread) vs reset()/snapshot()
+    (bench/server threads) now share the profiler lock — no torn
+    window state, no exceptions, no sanitizer reports."""
+    from paddle_tpu.observability.continuous import ContinuousProfiler
+    tsan.enable(True)
+    p = ContinuousProfiler(every=2)
+    p.enabled = True
+    errors = []
+    stop = threading.Event()
+
+    def stepper():
+        try:
+            for i in range(400):
+                p.on_step(i)
+                p.record("prog", 0.001)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            stop.set()
+
+    def churner():
+        try:
+            while not stop.is_set():
+                p.snapshot()
+                p.program_stats()
+                p.reset(every=2)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=stepper),
+               threading.Thread(target=churner)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors
+    assert [r for r in tsan.reports() if r["kind"] == "racy_write"] == []
+
+
+def test_metrics_value_reads_locked_under_storm():
+    import paddle_tpu.observability as obs
+    c = obs.counter("test_cs_storm_total", windowed=True)
+    stop = threading.Event()
+    errors = []
+
+    def inc():
+        while not stop.is_set():
+            c.inc(lbl="a")
+
+    def read():
+        try:
+            while not stop.is_set():
+                c.value(lbl="a")
+                c.rate(1.0, lbl="a")
+                c.total()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=inc), threading.Thread(target=read)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    assert not errors
+
+
+# -- bounded shutdown paths -------------------------------------------------
+
+def test_checkpoint_wait_returns_drained_bool(tmp_path, monkeypatch):
+    from paddle_tpu.resilience import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    assert mgr.wait() is True     # nothing in flight
+    release = threading.Event()
+    orig = mgr._commit
+
+    def slow_commit(step, payload):
+        release.wait(10)
+        orig(step, payload)
+
+    monkeypatch.setattr(mgr, "_commit", slow_commit)
+    mgr.save(1, extra={"x": 1}, blocking=False)
+    assert mgr.wait(0.05) is False    # bounded: still committing
+    release.set()
+    assert mgr.wait(10) is True
+    assert mgr.latest_step() == 1
+
+
+def test_preemption_drain_timeout_warns(tmp_path, monkeypatch):
+    from paddle_tpu.resilience import (CheckpointManager,
+                                       PreemptionHandler,
+                                       TrainingPreempted)
+    mgr = CheckpointManager(str(tmp_path))
+    h = PreemptionHandler(mgr, drain_timeout_s=0.01)
+    monkeypatch.setattr(mgr, "wait", lambda timeout=None: False)
+    h.request_preemption("manual")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with pytest.raises(TrainingPreempted):
+            h.maybe_exit(3)
+    assert any("did not drain" in str(x.message) and
+               issubclass(x.category, RuntimeWarning) for x in w)
+
+
+def test_preemption_metric_deferred_out_of_signal_context():
+    """The CS102 fix: a signal-context request records flight + flag
+    only; the registry-locking counter is flushed at the step boundary."""
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import flight
+    from paddle_tpu.resilience import PreemptionHandler, TrainingPreempted
+    flight.enable(True)
+    flight.clear()
+    base = obs.value("paddle_tpu_resilience_preemptions_total",
+                     source="sigterm")
+    h = PreemptionHandler()
+    h._on_signal(15, None)            # what the real handler runs
+    assert h.preempted and h.source == "sigterm"
+    assert obs.value("paddle_tpu_resilience_preemptions_total",
+                     source="sigterm") == base   # deferred
+    assert any(e["kind"] == "preempt" for e in flight.events())
+    with pytest.raises(TrainingPreempted):
+        h.maybe_exit(1)
+    assert obs.value("paddle_tpu_resilience_preemptions_total",
+                     source="sigterm") == base + 1
+
+
+def test_server_close_is_idempotent_and_bounded():
+    from paddle_tpu.observability.continuous.server import TelemetryServer
+    srv = TelemetryServer(port=0, host="127.0.0.1").start()
+    t0 = time.monotonic()
+    srv.close(timeout=5.0)
+    srv.close(timeout=5.0)   # idempotent
+    assert time.monotonic() - t0 < 5.0
+    assert not srv.running

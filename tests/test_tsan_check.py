@@ -1,0 +1,48 @@
+"""tools/tsan_check.py is the concurrency-tier CI gate: the disabled
+sanitizer must be a literal no-op (plain threading primitives), the
+planted demo must be caught by BOTH tiers (the static↔runtime bridge),
+the static self-application must exit clean, and the runtime suites must
+stay green under ``PADDLE_TPU_TSAN=1`` with zero unwaived reports."""
+
+import importlib.util
+import os
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "tsan_check", os.path.join(TOOLS, "tsan_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tsan_check_quick_gate_passes():
+    # no-op proof + bridge + static self-application + telemetry suite
+    # under the sanitizer (the serving/chaos suites run in the full
+    # gate below; they already run sanitizer-less elsewhere in tier-1)
+    assert _load().main(["--quick"]) == 0
+
+
+@pytest.mark.slow
+def test_tsan_check_full_gate_passes():
+    assert _load().main([]) == 0
+
+
+def test_tsan_allowlist_only_waives_the_demo():
+    """The waiver files must not quietly grow real-runtime entries: the
+    only sanctioned waivers are the planted demo's."""
+    tc = _load()
+    for kind, sub in tc.load_allowlist():
+        assert "demo" in sub or "Planted" in sub, (kind, sub)
+    from paddle_tpu.analysis.concurrency import (ALLOWLIST_NAME,
+                                                 load_allowlist)
+    cs = load_allowlist(os.path.join(TOOLS, "..", *
+                                     ALLOWLIST_NAME.split(os.sep)))
+    assert cs  # discovery contract: the file exists and parses
+    for suffix, rule in cs:
+        assert suffix.endswith("analysis/concurrency/demo.py"), (suffix,
+                                                                 rule)

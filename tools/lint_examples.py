@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """CI gate: trace-safety lint over the repo's runnable training surfaces.
 
-Three stages, all must pass:
+Stages, all must pass:
 
 1. AST tier — ``python -m paddle_tpu.analysis`` over ``examples/`` and
    ``paddle_tpu/models/`` (override by passing paths); fails on any
@@ -20,8 +20,12 @@ Three stages, all must pass:
    engine end to end: construct an ``LLMEngine``, ``submit``/``stream``
    concurrent requests, and report TTFT + occupancy (ROADMAP item 1:
    the serving runtime has a runnable, linted reference surface).
+5. Concurrency tier — ``python -m paddle_tpu.analysis.concurrency``
+   (rules CS100-CS105) over the whole ``paddle_tpu/`` tree; fails on any
+   error-severity CS finding not waived in ``tools/cs_allowlist.txt``
+   (whose only sanctioned entries are the planted demo's).
 
-The repo's own examples must stay clean on BOTH tiers, so the analyzers'
+The repo's own code must stay clean on EVERY tier, so the analyzers'
 advice and the shipped code never diverge.
 
 Usage:
@@ -155,6 +159,17 @@ def serving_gate(out=sys.stderr) -> int:
     return rc
 
 
+def concurrency_gate(out=sys.stderr) -> int:
+    """CS100-CS105 over the repo's own runtime tree (the self-applied
+    lock-discipline contract); 1 on non-allowlisted error findings."""
+    from paddle_tpu.analysis.concurrency.__main__ import main as cs_main
+    rc = cs_main([os.path.join(ROOT, "paddle_tpu"),
+                  "--min-severity", "error"])
+    print(f"concurrency gate: paddle_tpu/: "
+          f"{'FAILED' if rc else 'ok'}", file=out)
+    return rc
+
+
 def _has_paths(argv) -> bool:
     """True when argv contains a positional path (option VALUES like the
     'json' in '--format json' are not paths)."""
@@ -195,6 +210,10 @@ def main(argv=None) -> int:
     print("serving gate:", "FAILED (serving example does not drive the "
           "engine)" if src_rc else "OK", file=sys.stderr)
     rc = rc or src_rc
+    crc = concurrency_gate()
+    print("concurrency gate:", "FAILED (error-severity CS findings)"
+          if crc else "OK", file=sys.stderr)
+    rc = rc or crc
     return rc
 
 

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Thread-sanitizer gate: the concurrent runtimes must survive their own
+suites under ``PADDLE_TPU_TSAN=1`` with ZERO unwaived sanitizer reports.
+
+Stages, all must pass:
+
+1. **no-op proof** — with the sanitizer off, the lock factories return
+   the PLAIN ``threading`` primitives (type identity, so sanitizer-off
+   overhead is literally unmeasurable — the ``PADDLE_TPU_FLIGHT=0``
+   guarded-no-op pattern), and a micro-bench prints the measured
+   acquire/release cost both ways for the record.
+2. **bridge proof** — the planted demo
+   (``paddle_tpu/analysis/concurrency/demo.py``): the STATIC tier must
+   flag CS100+CS101 on it, and a subprocess run under
+   ``PADDLE_TPU_TSAN=1`` must produce the matching ``racy_write`` +
+   ``lock_inversion`` runtime reports — the static↔runtime loop closed
+   end to end.
+3. **static self-application** — ``python -m
+   paddle_tpu.analysis.concurrency paddle_tpu/`` exits clean (waivers
+   only in ``tools/cs_allowlist.txt``).
+4. **suites under sanitizer** — the serving, telemetry and chaos suites
+   re-run in subprocesses with ``PADDLE_TPU_TSAN=1`` and a shared
+   ``PADDLE_TPU_TSAN_LOG``; every suite must stay green AND the
+   collected reports must all be waived in ``tools/tsan_allowlist.txt``
+   (which only sanctions the planted demo).
+
+``--quick`` runs stages 1-3 plus the telemetry suite only (the tier-1
+shim ``tests/test_tsan_check.py`` uses it; CI runs the full gate).
+
+    python tools/tsan_check.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+TSAN_ALLOWLIST = os.path.join(ROOT, "tools", "tsan_allowlist.txt")
+
+#: the concurrent runtimes' own suites, re-run under the sanitizer
+SUITES = {
+    "serving": ["-m", "pytest", "tests/test_serving.py", "-q",
+                "-m", "not slow", "-p", "no:cacheprovider"],
+    "telemetry": ["-m", "pytest", "tests/test_telemetry_server.py",
+                  "tests/test_continuous.py", "-q", "-m", "not slow",
+                  "-p", "no:cacheprovider"],
+    "chaos": ["tools/chaos_check.py"],
+}
+QUICK_SUITES = ("telemetry",)
+
+
+def check_noop_overhead(out=sys.stderr) -> int:
+    """Sanitizer off ⇒ the factories return plain threading primitives
+    (type identity = zero wrapper on every acquire), measured for the
+    record."""
+    from paddle_tpu.analysis.concurrency import tsan
+    prev = tsan.enabled()
+    tsan.enable(False)
+    try:
+        plain = threading.Lock()
+        made = tsan.lock("tsan_check.noop")
+        if type(made) is not type(plain):
+            print(f"noop gate: FAILED — disabled tsan.lock() returned "
+                  f"{type(made).__name__}, not a plain lock", file=out)
+            return 1
+        if not (type(tsan.rlock("x")) is type(threading.RLock()) and
+                type(tsan.condition("x")) is type(threading.Condition())):
+            print("noop gate: FAILED — rlock/condition factories are "
+                  "not plain when disabled", file=out)
+            return 1
+
+        def bench(lk, n=200_000):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with lk:
+                    pass
+            return (time.perf_counter() - t0) / n * 1e9
+
+        ns_plain, ns_made = bench(plain), bench(made)
+        tsan.enable(True)
+        ns_on = bench(tsan.lock("tsan_check.instrumented"))
+        print(f"noop gate: ok — acquire/release "
+              f"plain {ns_plain:.0f}ns, factory-off {ns_made:.0f}ns "
+              f"(identical type, zero wrapper), instrumented "
+              f"{ns_on:.0f}ns", file=out)
+    finally:
+        tsan.enable(prev)
+    return 0
+
+
+def check_bridge(out=sys.stderr) -> int:
+    """Static CS100+CS101 on the demo, runtime racy_write +
+    lock_inversion from the same file — the tiers must agree."""
+    from paddle_tpu.analysis.concurrency import analyze_file
+    demo = os.path.join(ROOT, "paddle_tpu", "analysis", "concurrency",
+                        "demo.py")
+    static_ids = {f.rule_id for f in analyze_file(demo)}
+    if not {"CS100", "CS101"} <= static_ids:
+        print(f"bridge gate: FAILED — static tier found {static_ids} "
+              f"on the planted demo, wanted CS100+CS101", file=out)
+        return 1
+    env = dict(os.environ, PADDLE_TPU_TSAN="1")
+    env.pop("PADDLE_TPU_TSAN_LOG", None)   # demo reports stay its own
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis.concurrency.demo"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        print(f"bridge gate: FAILED — demo run exited "
+              f"{proc.returncode}:\n{proc.stdout}{proc.stderr}", file=out)
+        return 1
+    print("bridge gate: ok — CS100/CS101 static findings confirmed by "
+          "racy_write/lock_inversion runtime reports", file=out)
+    return 0
+
+
+def check_static_clean(out=sys.stderr) -> int:
+    from paddle_tpu.analysis.concurrency.__main__ import main as cs_main
+    rc = cs_main([os.path.join(ROOT, "paddle_tpu")])
+    print(f"static gate: {'ok' if rc == 0 else 'FAILED'} — "
+          f"`python -m paddle_tpu.analysis.concurrency paddle_tpu/` "
+          f"exit {rc}", file=out)
+    return rc
+
+
+def load_allowlist(path=TSAN_ALLOWLIST):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split(None, 1)
+                if len(parts) == 2:
+                    out.append((parts[0], parts[1].strip()))
+    except OSError:
+        pass
+    return out
+
+
+def _report_key(rec) -> str:
+    locks = rec.get("locks") or []
+    owner = f"{rec.get('owner')}.{rec.get('field')}" \
+        if rec.get("field") else ""
+    return " ".join([*locks, owner])
+
+
+def run_suites(names, out=sys.stderr) -> int:
+    rc = 0
+    with tempfile.TemporaryDirectory() as d:
+        log = os.path.join(d, "tsan_reports.jsonl")
+        env = dict(os.environ, PADDLE_TPU_TSAN="1",
+                   PADDLE_TPU_TSAN_LOG=log, JAX_PLATFORMS="cpu")
+        for name in names:
+            args = SUITES[name]
+            t0 = time.monotonic()
+            proc = subprocess.run([sys.executable] + args, cwd=ROOT,
+                                  env=env, capture_output=True,
+                                  text=True, timeout=1800)
+            dt = time.monotonic() - t0
+            status = "ok" if proc.returncode == 0 else \
+                f"FAILED (exit {proc.returncode})"
+            print(f"suite gate: {name}: {status} under PADDLE_TPU_TSAN=1 "
+                  f"({dt:.0f}s)", file=out)
+            if proc.returncode != 0:
+                tail = (proc.stdout + proc.stderr).splitlines()[-25:]
+                print("\n".join(f"  | {ln}" for ln in tail), file=out)
+                rc = 1
+        reports = []
+        try:
+            with open(log) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        reports.append(json.loads(line))
+        except OSError:
+            pass
+        allow = load_allowlist()
+        unwaived = []
+        for rec in reports:
+            key = _report_key(rec)
+            if not any(rec.get("kind") == kind and sub in key
+                       for kind, sub in allow):
+                unwaived.append(rec)
+        for rec in unwaived:
+            print(f"suite gate: UNWAIVED sanitizer report: "
+                  f"{rec.get('kind')} [{rec.get('static_rule')}] "
+                  f"{_report_key(rec)} (thread {rec.get('thread')})",
+                  file=out)
+        waived = len(reports) - len(unwaived)
+        print(f"suite gate: {len(reports)} sanitizer report(s), "
+              f"{waived} waived, {len(unwaived)} unwaived", file=out)
+        return rc or (1 if unwaived else 0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="CI gate: suites green under PADDLE_TPU_TSAN=1, "
+                    "zero unwaived sanitizer reports.")
+    ap.add_argument("--quick", action="store_true",
+                    help="stages 1-3 + the telemetry suite only "
+                         "(the tier-1 shim)")
+    ap.add_argument("--skip-suites", action="store_true",
+                    help="stages 1-3 only (develop the linter fast)")
+    args = ap.parse_args(argv)
+
+    rc = check_noop_overhead()
+    rc = check_bridge() or rc
+    rc = check_static_clean() or rc
+    if not args.skip_suites:
+        names = QUICK_SUITES if args.quick else tuple(SUITES)
+        rc = run_suites(names) or rc
+    print("tsan gate:", "FAILED" if rc else "OK", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
