@@ -10,10 +10,17 @@ path, rebuilt TPU-native):
   the compiled decode program NEVER retraces as sequences grow or
   requests join/leave. Paged decode attention feeds the existing mmha
   Pallas kernel (per-row positions) or the cached-attention composite.
+* :mod:`.prefix_cache` — cross-request KV reuse: full pages named by a
+  rolling token-chain hash keyed on a model/quant fingerprint; on
+  admission the longest cached page-aligned prefix is claimed
+  (refcounts bumped, copy-on-write before any write into a shared
+  page) so prefill only computes the suffix.
 * :mod:`.scheduler` — iteration-level (continuous) batching: FIFO
-  admission against free pages, page-growth with youngest-first
-  eviction (evictees requeue with their prefix kept), per-request
-  streaming, completion returning pages to the pool.
+  admission against available pages (free + reclaimable cached),
+  prefix-cache claiming, chunked prefill interleaved with decode under
+  a token budget, page-growth with youngest-first eviction (evictees
+  requeue with their prefix kept; shared pages survive for their other
+  owners), per-request streaming, completion dropping page references.
 * :mod:`.engine` — :class:`LLMEngine`: the threaded
   ``submit()/stream()/generate()`` front over ONE compiled decode-step
   program and a bucketed prefill program (both ``to_static``, weights +
@@ -43,23 +50,31 @@ concurrent users, zero-decode-retrace proof) and chaos-gated by
 """
 
 from .kv_cache import (  # noqa: F401
-    PagePool, PagePoolError, PagePoolExhausted,
-    paged_attention, reference_paged_attention,
+    PagePool, PagePoolError, PagePoolExhausted, PageDoubleFree,
+    paged_attention, reference_paged_attention, chunk_attention,
 )
 from .model import ServingModel  # noqa: F401
+from .prefix_cache import (  # noqa: F401
+    PrefixCache, chain_keys, model_fingerprint,
+)
 from .scheduler import (  # noqa: F401
     Request, Scheduler, RequestRejected, ServingError,
 )
 from .engine import (  # noqa: F401
     LLMEngine, ServingConfig, DECODE_PROGRAM, PREFILL_PROGRAM,
+    CHUNK_PROGRAM,
 )
-from . import kv_cache, model, scheduler, engine, server  # noqa: F401
+from . import (  # noqa: F401
+    kv_cache, model, prefix_cache, scheduler, engine, server,
+)
 
 __all__ = [
-    "PagePool", "PagePoolError", "PagePoolExhausted",
-    "paged_attention", "reference_paged_attention",
-    "ServingModel", "Request", "Scheduler",
+    "PagePool", "PagePoolError", "PagePoolExhausted", "PageDoubleFree",
+    "paged_attention", "reference_paged_attention", "chunk_attention",
+    "ServingModel", "PrefixCache", "chain_keys", "model_fingerprint",
+    "Request", "Scheduler",
     "RequestRejected", "ServingError",
     "LLMEngine", "ServingConfig", "DECODE_PROGRAM", "PREFILL_PROGRAM",
+    "CHUNK_PROGRAM",
     "server",
 ]

@@ -39,17 +39,23 @@ from ..analysis.concurrency import tsan as _tsan
 from ..autograd.grad_mode import no_grad
 from ..core.tensor import Tensor
 from ..jit.api import to_static
+from ..observability import counter as _obs_counter
 from ..observability import flight as _flight
 from .kv_cache import PagePool
 from .model import ServingModel
 from .scheduler import Request, Scheduler, ServingError
 
 __all__ = ["ServingConfig", "LLMEngine", "DECODE_PROGRAM",
-           "PREFILL_PROGRAM"]
+           "PREFILL_PROGRAM", "CHUNK_PROGRAM"]
 
 #: telemetry labels of the compiled programs (paddle_tpu_jit_* counters)
 DECODE_PROGRAM = "serving.decode_step"
 PREFILL_PROGRAM = "serving.prefill"
+CHUNK_PROGRAM = "serving.prefill_chunk"
+
+_CHUNKS = _obs_counter("paddle_tpu_serving_prefill_chunks_total",
+                       "chunked-prefill program runs (incl. cache-hit "
+                       "suffix chunks)")
 
 
 @dataclass
@@ -71,6 +77,14 @@ class ServingConfig:
     fused_block: bool = True     # block_decode_epilogue mega-kernel in the
     #                              decode/prefill programs (TPU; shape-
     #                              static, zero-retrace preserved)
+    prefix_cache: bool = True    # copy-on-write KV page sharing across
+    #                              requests with a common prompt prefix
+    prefill_chunk: int | None = None   # tokens per prefill chunk: chunks
+    #                              interleave with decode steps so a long
+    #                              prompt cannot stall in-flight TPOT
+    #                              (None = monolithic one-shot prefill)
+    prefill_budget: int | None = None  # max prefill tokens per engine
+    #                              iteration (default: one chunk's worth)
     dtype: str = "float32"       # KV pool dtype
     seed: int = 0
     donate_state: bool = False   # donate pool/weights into the programs
@@ -110,9 +124,30 @@ class LLMEngine:
             num_kv_heads=self._sm.n_kv, page_size=cfg.page_size,
             head_dim=self._sm.head_dim, dtype=cfg.dtype)
         self._sm.bind_pool(self.pool)
+        if cfg.prefill_chunk is not None and cfg.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 tokens, got {cfg.prefill_chunk}")
+        if cfg.prefill_budget is not None and cfg.prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget must be >= 1 tokens, got {cfg.prefill_budget}")
+        if cfg.prefill_budget is not None and cfg.prefill_chunk is None:
+            raise ValueError(
+                "prefill_budget only caps CHUNKED prefill — set "
+                "prefill_chunk too (monolithic prefill cannot be budgeted)")
+        self.prefix_cache = None
+        if cfg.prefix_cache:
+            from .prefix_cache import PrefixCache, model_fingerprint
+            self.prefix_cache = PrefixCache(
+                self.pool, model_fingerprint(
+                    model, quant=cfg.quant,
+                    quant_group_size=cfg.quant_group_size,
+                    dtype=cfg.dtype, page_size=cfg.page_size))
         self.scheduler = Scheduler(self.pool, self, cfg.max_batch,
                                    self.max_seq_len,
-                                   eos_token_id=cfg.eos_token_id)
+                                   eos_token_id=cfg.eos_token_id,
+                                   prefix_cache=self.prefix_cache,
+                                   prefill_chunk=cfg.prefill_chunk,
+                                   prefill_budget=cfg.prefill_budget)
         self.buckets = tuple(sorted(cfg.prefill_buckets)) \
             if cfg.prefill_buckets else _auto_buckets(self.max_seq_len)
         if self.buckets[-1] < self.max_seq_len:
@@ -168,6 +203,19 @@ class LLMEngine:
         self._prefill_sf = to_static(serving_prefill,
                                      donate_state=self.config.donate_state)
 
+        def serving_prefill_chunk(tokens, start, chunk_len, table_row,
+                                  temp, key, step):
+            with no_grad():
+                logits = sm.prefill_chunk_forward(tokens, start, chunk_len,
+                                                  table_row)
+            nxt = eng._sample(logits._data, temp._data.reshape(1),
+                              key._data, step._data)
+            return Tensor(nxt)
+
+        serving_prefill_chunk.__qualname__ = CHUNK_PROGRAM
+        self._chunk_sf = to_static(serving_prefill_chunk,
+                                   donate_state=self.config.donate_state)
+
     def _sample(self, logits, temps, key, step):
         """On-device next-token selection: greedy where temp == 0, else
         temperature (+ static top_k) gumbel sampling. logits [N, V],
@@ -197,8 +245,17 @@ class LLMEngine:
                            f"(buckets={self.buckets})")
 
     def prefill(self, req: Request) -> int:
+        """Whole-context prefill for one admission. With a prefix-cache
+        hit (``req.prefilled > 0``) only the SUFFIX is computed — one
+        chunk-program call over ``context[prefilled:]`` against the
+        claimed pages; otherwise the monolithic bucketed program runs as
+        before. Returns the first sampled token."""
         import paddle_tpu as paddle
         ctx = req.context()
+        if req.prefilled:
+            tok = self.prefill_chunk(req, len(ctx) - req.prefilled)
+            assert tok is not None      # suffix == final chunk
+            return tok
         bucket = self.bucket_for(len(ctx))
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :len(ctx)] = ctx
@@ -214,7 +271,41 @@ class LLMEngine:
             self._key_t,
             paddle.to_tensor(np.int32(step)))
         self._last_step_wall = time.time()
+        req.prefilled = len(ctx)
         return int(np.asarray(out.numpy()).reshape(-1)[0])
+
+    def prefill_chunk(self, req: Request, n: int):
+        """Run ONE chunk of ``req``'s prefill: ``n`` context tokens from
+        position ``req.prefilled``, padded to the power-of-2 bucket (the
+        same bucket machinery as monolithic prefill — ``start`` and the
+        valid length ride as traced values, so every chunk of a bucket
+        shares one compiled signature). Returns the first sampled token
+        when this was the final chunk, else None."""
+        import paddle_tpu as paddle
+        ctx = req.context()
+        n = int(n)
+        start = req.prefilled
+        bucket = self.bucket_for(n)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = ctx[start:start + n]
+        row = np.zeros(self.scheduler.max_pages, np.int32)
+        row[:len(req.pages)] = req.pages
+        step = self._step_seq
+        self._step_seq += 1
+        out = self._chunk_sf(
+            paddle.to_tensor(toks),
+            paddle.to_tensor(np.int32(start)),
+            paddle.to_tensor(np.int32(n)),
+            paddle.to_tensor(row),
+            paddle.to_tensor(np.float32(max(req.temperature, 0.0))),
+            self._key_t,
+            paddle.to_tensor(np.int32(step)))
+        self._last_step_wall = time.time()
+        req.prefilled = start + n
+        _CHUNKS.inc()
+        if req.prefilled >= len(ctx):
+            return int(np.asarray(out.numpy()).reshape(-1)[0])
+        return None
 
     def decode(self, tokens, positions, tables, temps):
         import paddle_tpu as paddle
@@ -278,7 +369,9 @@ class LLMEngine:
                         time.monotonic() > self._drain_deadline:
                     break
                 try:
-                    sched._decode()
+                    # chunk + decode: a mid-prefill request must finish
+                    # its chunks to drain, admission stays closed
+                    sched.drain_step()
                 except Exception as e:   # noqa: BLE001
                     self._engine_error(e)
                     break
@@ -428,7 +521,8 @@ class LLMEngine:
             }
 
         return {"decode": one(DECODE_PROGRAM),
-                "prefill": one(PREFILL_PROGRAM)}
+                "prefill": one(PREFILL_PROGRAM),
+                "chunk": one(CHUNK_PROGRAM)}
 
     def program_stats(self) -> dict:
         """Trace/compile/retrace counts of THIS engine's two compiled
@@ -453,7 +547,12 @@ class LLMEngine:
             "occupancy_mean": (sched.occupancy_sum / steps) if steps else 0.0,
             "pages": {"free": self.pool.free_pages,
                       "used": self.pool.used_pages,
+                      "cached": self.pool.cached_pages,
+                      "shared": self.pool.shared_pages,
+                      "lost": self.pool.lost(),
                       "total": self.pool.allocatable},
+            "prefix_cache": sched.prefix_stats(),
+            "prefill_chunks": sched.chunks,
             "programs": self.program_stats(),
         }
 
@@ -488,6 +587,8 @@ class LLMEngine:
                 tok.rate(60.0, kind="generated"), 4) if tok else 0.0,
             "kv_pages_free": self.pool.free_pages,
             "kv_pages_used": self.pool.used_pages,
+            "kv_pages_cached": self.pool.cached_pages,
+            "prefix_hit_rate": sched.prefix_hit_rate(),
         }
         return (503 if status == "stalled" else 200), payload
 

@@ -34,14 +34,35 @@ Device-side helpers (pure jnp, called inside traced programs):
   same grouped-einsum composite as ``models/generation.py:
   cached_attention`` (interpret-parity-tested against it).
 
-Host-side :class:`PagePool` owns the pool tensors and the free-list
-accounting (alloc/free with double-free detection and leak assertion —
-the chaos gate's "leak zero KV pages" check).
+Host-side :class:`PagePool` owns the pool tensors and the accounting.
+Since the prefix cache landed, a non-trash page is in exactly ONE of
+three states:
+
+* **free** — on the LIFO free list, contents meaningless;
+* **used** — refcount >= 1: one ref per request page-table that maps it.
+  Pages become *shared* (refcount >= 2) when the scheduler maps a cached
+  prefix page into a second request; a shared page is immutable — the
+  scheduler copy-on-writes before any write would land in it;
+* **cached** — refcount 0 but retained because a
+  :class:`~.prefix_cache.PrefixCache` key still names its contents.
+  Cached pages are the prefix cache's working set AND allocation
+  headroom: ``alloc`` reclaims them LRU-first when the free list runs
+  dry (dropping the cache entry via the reclaim hook), so admission
+  accounting over :attr:`available_pages` stays truthful.
+
+``free`` is a *decref*: a page returns to the free list (or the cached
+state, when keyed) only at refcount 0. Double-free detection
+distinguishes a **second decref** (:class:`PageDoubleFree` — the page is
+already free/cached) from true corruption (a foreign id that was never
+this pool's to free). ``leaked()`` counts refcount>=1 pages only — the
+chaos gate's "leak zero KV pages" check — and ``lost()`` proves the
+three states partition the pool exactly.
 """
 
 from __future__ import annotations
 
 import math
+from collections import OrderedDict
 
 import jax.numpy as jnp
 
@@ -50,15 +71,20 @@ from ..core.tensor import Tensor
 from ..observability import gauge as _obs_gauge, counter as _obs_counter
 
 __all__ = [
-    "PagePool", "PagePoolError", "PagePoolExhausted", "TRASH_PAGE",
+    "PagePool", "PagePoolError", "PagePoolExhausted", "PageDoubleFree",
+    "TRASH_PAGE",
     "write_token", "write_prefill", "gather_layer", "paged_attention",
+    "chunk_attention",
 ]
 
 #: physical page id reserved as the write sink for padding / inactive rows
 TRASH_PAGE = 0
 
 _PAGES = _obs_gauge("paddle_tpu_serving_kv_pages",
-                    "KV-cache pages by state (free/used/total)")
+                    "KV-cache pages by state (free/used/cached/total)")
+_SHARED = _obs_gauge("paddle_tpu_serving_shared_pages",
+                     "KV pages mapped by more than one request "
+                     "(refcount >= 2)")
 _ALLOC_FAIL = _obs_counter(
     "paddle_tpu_serving_page_alloc_failures_total",
     "page allocations that failed because the pool was exhausted")
@@ -72,15 +98,25 @@ class PagePoolExhausted(PagePoolError):
     """No free pages left for an allocation."""
 
 
+class PageDoubleFree(PagePoolError):
+    """A second decref of a page whose refcount already reached zero —
+    distinct from freeing a foreign id (true corruption): the page IS one
+    of this pool's, but nobody holds a reference to give back."""
+
+
 class PagePool:
-    """Preallocated paged KV pool + thread-safe free-list accounting.
+    """Preallocated paged KV pool + thread-safe refcounted accounting.
 
     ``k``/``v`` are framework Tensors shaped
     ``[num_layers, num_pages, num_kv_heads, page_size, head_dim]`` —
     read and written inside the engine's compiled programs, so they
     thread through ``to_static`` as state. Page ids are handed out from
-    a LIFO free list; page ``0`` (:data:`TRASH_PAGE`) is never handed
-    out.
+    a LIFO free list (recently-freed pages are warm); page ``0``
+    (:data:`TRASH_PAGE`) is never handed out. Each allocated page
+    carries a refcount; the prefix cache shares pages across requests by
+    claiming extra references, and keyed pages linger in a reclaimable
+    LRU **cached** state at refcount 0 instead of returning to the free
+    list.
     """
 
     def __init__(self, num_layers: int, num_pages: int, num_kv_heads: int,
@@ -102,15 +138,31 @@ class PagePool:
         self._lock = _tsan.lock("serving.PagePool")
         # LIFO: recently-freed (warm) pages are reused first
         self._free = list(range(self.num_pages - 1, TRASH_PAGE, -1))
-        self._used: set[int] = set()
+        self._ref: dict[int, int] = {}          # page -> refcount (>= 1)
+        self._shared = 0        # pages at refcount >= 2, kept on the
+        #                         1<->2 transitions (O(P) rescans would
+        #                         serialize into every page op)
+        self._cached: OrderedDict = OrderedDict()   # page -> key, LRU order
+        self._keys: dict[int, bytes] = {}       # page -> retained cache key
+        # prefix-cache hook, called (page, key) with the POOL lock held
+        # whenever a cached page is reclaimed (its contents die)
+        self._reclaim_cb = None
         self._export()
+
+    def set_reclaim_hook(self, cb) -> None:
+        """``cb(page, key)`` fires (pool lock held) when a cached page is
+        reclaimed for reuse — the prefix cache drops its map entry."""
+        with self._lock:
+            self._reclaim_cb = cb
 
     # -- accounting ----------------------------------------------------------
 
     def _export(self):
         _PAGES.set(len(self._free), state="free")
-        _PAGES.set(len(self._used), state="used")
+        _PAGES.set(len(self._ref), state="used")
+        _PAGES.set(len(self._cached), state="cached")
         _PAGES.set(self.allocatable, state="total")
+        _SHARED.set(self._shared)
 
     @property
     def allocatable(self) -> int:
@@ -124,64 +176,214 @@ class PagePool:
 
     @property
     def used_pages(self) -> int:
+        """Pages with refcount >= 1."""
         with self._lock:
-            return len(self._used)
+            return len(self._ref)
+
+    @property
+    def cached_pages(self) -> int:
+        """Refcount-0 pages retained for the prefix cache (reclaimable)."""
+        with self._lock:
+            return len(self._cached)
+
+    @property
+    def available_pages(self) -> int:
+        """Pages an ``alloc`` can satisfy right now: free + reclaimable
+        cached — the truthful admission-headroom number."""
+        with self._lock:
+            return len(self._free) + len(self._cached)
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages mapped by more than one request (refcount >= 2)."""
+        with self._lock:
+            return self._shared
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._ref.get(int(page), 0)
 
     def pages_for(self, length: int) -> int:
         """Pages needed to hold ``length`` token positions."""
         return max(0, math.ceil(int(length) / self.page_size))
 
     def alloc(self, n: int = 1) -> list[int]:
-        """Allocate ``n`` pages; raises :class:`PagePoolExhausted` (and
-        allocates nothing) when fewer than ``n`` are free."""
+        """Allocate ``n`` pages at refcount 1; raises
+        :class:`PagePoolExhausted` (and allocates nothing) when fewer
+        than ``n`` are available. The free list is preferred; when it
+        runs dry, refcount-0 **cached** pages are reclaimed LRU-first
+        (their prefix-cache entries dropped via the reclaim hook) —
+        refcount>=1 pages are NEVER taken."""
         with self._lock:
-            if n > len(self._free):
+            if n > len(self._free) + len(self._cached):
                 _ALLOC_FAIL.inc()
                 raise PagePoolExhausted(
-                    f"need {n} page(s), {len(self._free)} free "
-                    f"(pool {self.allocatable})")
-            pages = [self._free.pop() for _ in range(n)]
-            self._used.update(pages)
+                    f"need {n} page(s), {len(self._free)} free + "
+                    f"{len(self._cached)} cached (pool {self.allocatable})")
+            pages = []
+            for _ in range(n):
+                if self._free:
+                    p = self._free.pop()
+                else:
+                    p = self._reclaim_lru_locked()
+                self._ref[p] = 1
+                pages.append(p)
             if _tsan.active():
                 _tsan.note_write(self, "_free", self._lock)
             self._export()
             return pages
 
-    def free(self, pages) -> None:
-        """Return pages to the pool; double frees and unowned ids raise.
-        A duplicate id WITHIN one call is the same bug in one step — the
-        first free would legitimize the second, and the free list would
-        hand the page out twice — so it raises before any mutation."""
-        pages = list(pages)
+    def _reclaim_lru_locked(self) -> int:
+        """Pop the least-recently-cached refcount-0 page; its key dies."""
+        page, key = self._cached.popitem(last=False)
+        self._keys.pop(page, None)
+        cb = self._reclaim_cb
+        if cb is not None:
+            cb(page, key)
+        return page
+
+    def incref(self, pages) -> None:
+        """Take an extra reference on already-content-valid pages: live
+        (refcount >= 1) pages gain a sharer; cached (refcount 0) pages
+        revive to refcount 1. Unknown/free ids raise."""
+        pages = [int(p) for p in pages]
         with self._lock:
-            bad = [p for p in pages if p not in self._used]
+            bad = [p for p in pages
+                   if p not in self._ref and p not in self._cached]
+            if bad:
+                raise PagePoolError(
+                    f"incref of page(s) {bad} that are neither live nor "
+                    f"cached")
+            for p in pages:
+                if p in self._cached:
+                    del self._cached[p]
+                    self._ref[p] = 1
+                else:
+                    self._ref[p] += 1
+                    if self._ref[p] == 2:
+                        self._shared += 1
+            self._export()
+
+    def claim_prefix(self, pairs) -> list:
+        """Claim the longest verified prefix of ``pairs`` (``(page,
+        key)`` in chain order): each page must still carry exactly that
+        retained key — a page reclaimed-and-reused between the cache
+        lookup and this claim fails verification and ends the chain.
+        Claimed pages gain a reference (cached ones revive). Returns the
+        claimed page ids."""
+        claimed = []
+        with self._lock:
+            for page, key in pairs:
+                page = int(page)
+                if self._keys.get(page) != key:
+                    break
+                if page in self._cached:
+                    del self._cached[page]
+                    self._ref[page] = 1
+                elif page in self._ref:
+                    self._ref[page] += 1
+                    if self._ref[page] == 2:
+                        self._shared += 1
+                else:       # keyed but neither live nor cached: corrupt
+                    break
+                claimed.append(page)
+            if claimed:
+                self._export()
+        return claimed
+
+    def retain_keys(self, pairs) -> None:
+        """Mark live pages cacheable: ``(page, key)`` pairs record the
+        content key under which a page should linger (cached state)
+        instead of returning to the free list at refcount 0."""
+        with self._lock:
+            for page, key in pairs:
+                page = int(page)
+                if page in self._ref:
+                    self._keys[page] = key
+
+    def free(self, pages) -> None:
+        """Release one reference per page (decref). A page reaching
+        refcount 0 returns to the free list — or to the **cached** state
+        when a prefix-cache key is retained for it. Errors distinguish a
+        second decref (:class:`PageDoubleFree`: the page is already
+        free/cached) from true corruption (foreign id). A duplicate id
+        WITHIN one call is one request double-counting its own mapping —
+        it raises before any mutation."""
+        pages = [int(p) for p in pages]
+        with self._lock:
             if len(set(pages)) != len(pages):
                 dups = sorted({p for p in pages if pages.count(p) > 1})
                 raise PagePoolError(
                     f"page(s) {dups} appear more than once in one free() "
                     f"call (double free); pool left untouched")
-            if bad:
+            zero = [p for p in pages
+                    if p not in self._ref
+                    and (p in self._cached or p in self._free)]
+            if zero:
+                raise PageDoubleFree(
+                    f"second decref of page(s) {zero}: refcount already "
+                    f"zero (page is free/cached); pool left untouched")
+            foreign = [p for p in pages if p not in self._ref]
+            if foreign:
                 raise PagePoolError(
-                    f"freeing page(s) {bad} not currently allocated "
-                    f"(double free or foreign id)")
+                    f"freeing page(s) {foreign} this pool never "
+                    f"allocated (foreign id or trash page); pool left "
+                    f"untouched")
             for p in pages:
-                self._used.discard(p)
-                self._free.append(p)
+                self._ref[p] -= 1
+                if self._ref[p] == 1:
+                    self._shared -= 1
+                if self._ref[p] > 0:
+                    continue            # still shared: page stays live
+                del self._ref[p]
+                key = self._keys.get(p)
+                if key is not None:
+                    self._cached[p] = key       # MRU end of the LRU
+                else:
+                    self._free.append(p)
             if _tsan.active():
                 _tsan.note_write(self, "_free", self._lock)
             self._export()
 
+    def copy_page(self, src: int, dst: int) -> None:
+        """Copy one physical page's K and V across every layer (the
+        copy-on-write data move). Caller holds references on both pages;
+        runs eagerly on the engine thread, outside the compiled
+        programs."""
+        src, dst = int(src), int(dst)
+        self.k._data = self.k._data.at[:, dst].set(self.k._data[:, src])
+        self.v._data = self.v._data.at[:, dst].set(self.v._data[:, src])
+
     def leaked(self) -> int:
-        """Pages still allocated — 0 after every request completed/failed
-        (asserted by the chaos serving profile and engine shutdown)."""
+        """Pages still referenced — 0 after every request completed/
+        failed (asserted by the chaos serving profile and engine
+        shutdown). Cached (refcount-0) pages are NOT leaks: they are
+        reclaimable headroom."""
         return self.used_pages
 
-    def reset(self) -> None:
-        """Drop all allocations (does not zero page contents — stale data
-        is masked by position everywhere it could be read)."""
+    def lost(self) -> int:
+        """Pages in NO state (free/used/cached) — always 0; a nonzero
+        value means the accounting dropped a page on the floor (the
+        refcount-aware complement of :meth:`leaked`)."""
         with self._lock:
+            return self.allocatable - len(self._free) - len(self._ref) \
+                - len(self._cached)
+
+    def reset(self) -> None:
+        """Drop all allocations AND cached contents (does not zero page
+        data — stale data is masked by position everywhere it could be
+        read). The reclaim hook fires for every cached page so a prefix
+        cache stays consistent."""
+        with self._lock:
+            cb = self._reclaim_cb
+            if cb is not None:
+                for page, key in list(self._cached.items()):
+                    cb(page, key)
             self._free = list(range(self.num_pages - 1, TRASH_PAGE, -1))
-            self._used.clear()
+            self._ref.clear()
+            self._cached.clear()
+            self._keys.clear()
+            self._shared = 0
             self._export()
 
 
@@ -225,6 +427,39 @@ def gather_layer(pool, layer: int, tables):
     kp = jnp.moveaxis(kp, 2, 1)               # [B, Hkv, Pmax, ps, D]
     b, h, pmax, ps, d = kp.shape
     return kp.reshape(b, h, pmax * ps, d)
+
+
+def chunk_attention(q, k_cache, v_cache, start):
+    """Causal attention of one prefill CHUNK against the gathered paged
+    view — the chunked-prefill analog of :func:`reference_paged_attention`
+    (same grouped-einsum math, a block of queries instead of one row).
+
+    q ``[1, C, H, D]`` (chunk queries at absolute positions
+    ``start + [0..C)``); k/v_cache ``[1, Hkv, T, D]`` gathered from the
+    request's page table AFTER this chunk's KV writes (so the chunk sees
+    itself); ``start`` traced scalar int32. Key position ``j`` is
+    visible to query ``i`` iff ``j <= start + i`` — earlier chunks,
+    cached prefix pages, and the in-chunk causal triangle in one rule;
+    positions past the context (trash/stale pages) are always masked.
+    Padding lanes (``i`` beyond the chunk's valid length) produce
+    garbage outputs that nothing reads, and their KV went to the trash
+    page, so they can never contaminate a real lane. Returns
+    ``[1, C, H, D]``.
+    """
+    import jax
+    b, s, h, d = q.shape
+    h_kv, t = k_cache.shape[1], k_cache.shape[2]
+    rep = h // h_kv
+    qg = q.reshape(b, s, h_kv, rep, d).astype(jnp.float32)
+    logits = jnp.einsum("bsgrd,bgtd->bgrst", qg,
+                        k_cache.astype(jnp.float32)) / math.sqrt(d)
+    qpos = jnp.asarray(start, jnp.int32) + jnp.arange(s, dtype=jnp.int32)
+    mask = jnp.arange(t, dtype=jnp.int32)[None, :] <= qpos[:, None]
+    logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgrst,bgtd->bsgrd", probs,
+                     v_cache.astype(jnp.float32))
+    return out.reshape(b, s, h, d).astype(q.dtype)
 
 
 def reference_paged_attention(q, k_cache, v_cache, pos):

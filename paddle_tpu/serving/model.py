@@ -352,3 +352,84 @@ class ServingModel:
         last = Tensor(h_last)
         logits = self._head_normed(last) if fused else self._head(last)
         return Tensor(logits._data[:, 0, :])
+
+    # -- chunked prefill -----------------------------------------------------
+
+    def prefill_chunk_forward(self, tokens, start, chunk_len, table_row):
+        """One prefill CHUNK of a request's context against the paged
+        pool: positions ``[start, start + chunk_len)`` of the sequence,
+        attending to everything already resident (earlier chunks and
+        cached prefix pages) through the page table.
+
+        tokens ``[1, C_bucket]`` int32 (the chunk's tokens padded to the
+        compile bucket), ``start``/``chunk_len`` traced scalars int32,
+        table_row ``[max_pages]`` int32. KV writes land at absolute
+        positions through the table (padding lanes -> trash page);
+        attention is :func:`~.kv_cache.chunk_attention` over the gathered
+        view (written-then-gathered, so the chunk sees itself causally).
+        Returns logits Tensor ``[1, vocab]`` at the chunk's LAST valid
+        position — meaningful on the final chunk, where it seeds the
+        first generated token exactly like the monolithic program's
+        ``logits[prompt_len - 1]``.
+        """
+        pool = self.pool
+        ps = pool.page_size
+        n = int(tokens.shape[1])
+        s0 = start._data.reshape(()).astype(jnp.int32)
+        clen = chunk_len._data.reshape(()).astype(jnp.int32)
+        tab_row = table_row._data.astype(jnp.int32)
+        max_pages = int(tab_row.shape[0])
+
+        t_loc = jnp.arange(n, dtype=jnp.int32)
+        pos = s0 + t_loc                      # absolute sequence positions
+        valid = t_loc < clen
+        pos_c = jnp.clip(pos, 0, self.max_pos - 1)
+
+        cos_f, sin_f = self._rope_tables()
+        cos = Tensor(cos_f._data[:, pos_c])           # [1, C, 1, D]
+        sin = Tensor(sin_f._data[:, pos_c])
+
+        page_idx = jnp.minimum(pos // ps, max_pages - 1)
+        w_page = jnp.where(valid, tab_row[page_idx],
+                           jnp.int32(kv_cache.TRASH_PAGE))
+        w_slot = pos % ps
+
+        layers = list(self.model.layers)
+        fused = self._fused_active()
+        x = self.model.embed_tokens(tokens)
+        hres = x
+        y = layers[0].input_layernorm(x) if fused else None
+        for i, layer in enumerate(layers):
+            h = y if fused else layer.input_layernorm(x)
+            q, k, v = self._qkv(i, layer, h, 1, n)
+            q, k = F.rope(q, k, sin, cos)
+            # write_token's scatter semantics fit a chunk exactly: one
+            # (page, slot) per lane, padding lanes steered to trash
+            kp = kv_cache.write_token(pool.k._data, i, w_page, w_slot,
+                                      k._data[0])
+            vp = kv_cache.write_token(pool.v._data, i, w_page, w_slot,
+                                      v._data[0])
+            pool.k._data = kp
+            pool.v._data = vp
+            kc = kv_cache.gather_layer(kp, i, tab_row[None])
+            vc = kv_cache.gather_layer(vp, i, tab_row[None])
+            out = kv_cache.chunk_attention(q._data, kc, vc, s0)
+            attn_out = self._linear(
+                "o", i, Tensor(out.reshape(1, n,
+                                           self.n_head * self.head_dim)),
+                layer.self_attn.o_proj)
+            if fused:
+                y, hres = self._junction(attn_out, hres,
+                                         layer.post_attention_layernorm)
+                m = self._mlp(i, layer.mlp, y)
+                nxt = layers[i + 1].input_layernorm if i + 1 < len(layers) \
+                    else self.model.norm
+                y, hres = self._junction(m, hres, nxt)
+            else:
+                x = self._block_tail(i, layer, x, attn_out)
+        import jax
+        h_last = jax.lax.dynamic_slice_in_dim(
+            (y if fused else x)._data, clen - 1, 1, axis=1)  # [1, 1, H]
+        last = Tensor(h_last)
+        logits = self._head_normed(last) if fused else self._head(last)
+        return Tensor(logits._data[:, 0, :])

@@ -1,0 +1,182 @@
+"""Prefix cache: cross-request KV page reuse by rolling token-chain hash.
+
+Production traffic shares long prompt prefixes (system prompts, few-shot
+templates, RLHF rollout prompts), yet a cache-less engine recomputes and
+re-stores every prefix per request. The page-table indirection the paged
+pool already pays for makes reuse cheap — the GSPMD move: put the
+expensive decision behind an indirection, then optimize the mapping.
+
+**Key scheme.** A full KV page is immutable once its ``page_size`` token
+positions are written, and its contents are a pure function of (model +
+quant + dtype + page size, the token ids up to and including the page).
+So each full page of a prompt is named by a **rolling chain hash**::
+
+    h_0 = H(fingerprint || tokens[0 : ps])
+    h_i = H(h_{i-1}    || tokens[i*ps : (i+1)*ps])
+
+— page ``i``'s key commits to the ENTIRE prefix before it, so equal keys
+imply equal resident KV, and a lookup can only ever match a
+page-*aligned* prefix chain. The fingerprint folds in everything else
+that shapes page contents (:func:`model_fingerprint`), so e.g. an int8
+engine can never claim a float engine's pages.
+
+**Lifecycle.** The scheduler *inserts* a request's full context pages
+after its prefill completes (pages keep refcount >= 1 while the request
+runs; they move to the pool's reclaimable **cached** state at refcount
+0). On admission the scheduler *claims* the longest cached chain:
+:meth:`claim` looks keys up under the cache lock, then
+``PagePool.claim_prefix`` re-verifies each page still carries exactly
+that key while taking a reference — so a page reclaimed-and-reused
+between lookup and claim simply ends the chain instead of serving wrong
+KV. Claimed pages may be live in ANOTHER running request's table
+(refcount >= 2: shared); the scheduler copy-on-writes before any write
+would land in a shared page. Reclaim (the pool's LRU over refcount-0
+pages, fired with the pool lock held) drops the map entry via
+:meth:`_evicted` — the cache never holds its own lock while calling
+into the pool, so the lock order is pool -> cache, acyclic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..analysis.concurrency import tsan as _tsan
+from ..observability import counter as _obs_counter, gauge as _obs_gauge
+
+__all__ = ["PrefixCache", "chain_keys", "model_fingerprint"]
+
+_HITS = _obs_counter("paddle_tpu_serving_prefix_hits_total",
+                     "full prompt pages served from the prefix cache")
+_MISSES = _obs_counter("paddle_tpu_serving_prefix_misses_total",
+                       "full prompt pages that had to be prefilled")
+_ENTRIES = _obs_gauge("paddle_tpu_serving_prefix_entries",
+                      "hash-chain entries resident in the prefix cache")
+
+
+def chain_keys(fingerprint: bytes, tokens, page_size: int) -> list:
+    """Rolling chain hash per FULL page of ``tokens`` (len // page_size
+    keys); key ``i`` commits to every token through page ``i``'s end."""
+    ps = int(page_size)
+    out = []
+    h = bytes(fingerprint)
+    for i in range(len(tokens) // ps):
+        page = tokens[i * ps:(i + 1) * ps]
+        blob = h + b"|" + ",".join(str(int(t)) for t in page).encode()
+        h = hashlib.blake2b(blob, digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+def model_fingerprint(model, quant=None, quant_group_size: int = -1,
+                      dtype: str = "float32", page_size: int = 16) -> bytes:
+    """Identity of what a KV page's contents depend on besides tokens:
+    model architecture + quantization + pool dtype + page size. Two
+    engines differing in any of these can never match each other's
+    chains. Weights are NOT hashed (the cache is engine-local); a weight
+    hot-swap must build a fresh engine/cache."""
+    cfg = getattr(model, "cfg", None)
+    layers = list(getattr(model, "layers", []) or [])
+    fields = (
+        type(model).__name__, len(layers),
+        getattr(cfg, "num_heads", None), getattr(cfg, "num_kv_heads", None),
+        getattr(cfg, "head_dim", None), getattr(cfg, "hidden_size", None),
+        getattr(cfg, "vocab_size", None),
+        getattr(cfg, "max_position_embeddings", None),
+        quant, int(quant_group_size), str(dtype), int(page_size),
+    )
+    return hashlib.blake2b(repr(fields).encode(), digest_size=16).digest()
+
+
+class PrefixCache:
+    """Hash-chain -> physical-page map over one :class:`~.kv_cache.PagePool`.
+
+    Thread-safe (``analysis/concurrency`` lock factories); eviction is
+    the pool's LRU over refcount-0 cached pages — the cache itself never
+    frees anything and never holds a page the pool thinks is free.
+    """
+
+    def __init__(self, pool, fingerprint: bytes):
+        self.pool = pool
+        self.fingerprint = bytes(fingerprint)
+        self._lock = _tsan.lock("serving.PrefixCache")
+        self._map: dict = {}        # chain key -> physical page id
+        pool.set_reclaim_hook(self._evicted)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def keys_for(self, tokens) -> list:
+        """Chain keys for every full page of ``tokens``."""
+        return chain_keys(self.fingerprint, tokens, self.pool.page_size)
+
+    def lookup(self, keys) -> list:
+        """Longest mapped chain prefix as ``[(page, key), ...]`` — map
+        reads only; the pool verifies + claims afterwards."""
+        pairs = []
+        with self._lock:
+            for k in keys:
+                page = self._map.get(k)
+                if page is None:
+                    break
+                pairs.append((page, k))
+        return pairs
+
+    def claim(self, keys) -> list:
+        """Claim the longest cached chain for ``keys``: page references
+        taken (cached pages revive, live pages gain a sharer). Returns
+        the claimed page ids — ``len(claimed) * page_size`` context
+        tokens need no prefill."""
+        pairs = self.lookup(keys)
+        if not pairs:
+            return []
+        return self.pool.claim_prefix(pairs)
+
+    def insert(self, keys, pages) -> int:
+        """Register ``pages`` (the fully-written pages of one request's
+        context, refcount >= 1) under their chain ``keys``. Keys already
+        mapped are skipped — first writer wins; the duplicate page simply
+        never enters the cached state for that key. Returns the number
+        of new entries."""
+        with self._lock:
+            novel = [(int(p), k) for k, p in zip(keys, pages)
+                     if k not in self._map]
+        if not novel:
+            return 0
+        # retain first (pool lock), then publish (cache lock) — never
+        # nested, and the pages can't be reclaimed in between: the
+        # inserting request still holds references on them
+        self.pool.retain_keys(novel)
+        with self._lock:
+            n = 0
+            for p, k in novel:
+                if k not in self._map:
+                    self._map[k] = p
+                    n += 1
+            _ENTRIES.set(len(self._map))
+        return n
+
+    def _evicted(self, page, key) -> None:
+        """Pool reclaim hook (POOL lock held): the page's contents are
+        about to be overwritten — drop the entry if it still points
+        here."""
+        with self._lock:
+            if self._map.get(key) == int(page):
+                del self._map[key]
+            _ENTRIES.set(len(self._map))
+
+    def note_result(self, hit_pages: int, missed_pages: int) -> None:
+        """Admission-outcome metrics (page granularity)."""
+        if hit_pages:
+            _HITS.inc(hit_pages)
+        if missed_pages:
+            _MISSES.inc(missed_pages)
+
+    def stats(self) -> dict:
+        with self._lock:
+            entries = len(self._map)
+        return {"entries": entries,
+                "cached_pages": self.pool.cached_pages,
+                "shared_pages": self.pool.shared_pages,
+                "hits_total": int(_HITS.value()),
+                "misses_total": int(_MISSES.value())}

@@ -3,23 +3,38 @@
 Policy layer of the serving runtime — no device code here. Each
 :meth:`Scheduler.step` is one engine iteration:
 
-1. **Admission** (FIFO): while a decode slot AND enough free pages for
-   the request's context (+1 headroom page for its first decode write)
-   exist, pop the oldest waiting request, allocate its prompt pages, run
-   the compiled prefill program (which also samples the request's first
-   token — TTFT is prefill-bounded, not batch-bounded), and seat it in a
-   decode slot. Head-of-line blocking is deliberate: the oldest request
-   is never overtaken, so FIFO admission cannot starve.
-2. **Growth**: every active request whose next write position crosses a
-   page boundary allocates a page. On exhaustion the **youngest** active
-   request is evicted — pages freed, request requeued in arrival order
-   with its generated prefix kept (re-admission re-prefills
-   ``prompt + generated`` and continues) — so the oldest request always
-   makes progress (the no-livelock argument).
-3. **Decode**: ONE batched decode step over all ``max_batch`` slots
-   (inactive slots ride along pointed at the trash page); sampled tokens
-   stream to per-request callbacks; finished requests (eos /
-   ``max_new_tokens`` / context limit) release their pages.
+1. **Admission** (FIFO): while a decode slot AND enough available pages
+   for the request's context (+1 headroom page for its first decode
+   write) exist, pop the oldest waiting request. With a
+   :class:`~.prefix_cache.PrefixCache`, the longest cached page-aligned
+   prefix is **claimed** first (refcounts bumped, pages mapped straight
+   into the page table) so prefill only computes the *suffix*; the rest
+   is allocated fresh. Monolithic mode then runs the compiled prefill
+   program inline (which also samples the request's first token — TTFT
+   is prefill-bounded, not batch-bounded); chunked mode just seats the
+   request and lets step 2 interleave its chunks with decode steps.
+   Head-of-line blocking is deliberate: the oldest request is never
+   overtaken, so FIFO admission cannot starve.
+2. **Chunked prefill** (when ``prefill_chunk`` is set): each seated
+   not-yet-prefilled request advances by fixed-size chunks under a
+   per-iteration token budget, so a long-prompt arrival never stalls
+   in-flight decodes for its whole prompt — the final chunk samples the
+   first token. Any write that would land in a refcount>1 (shared) page
+   copy-on-writes first: **a shared page is never mutated**.
+3. **Growth**: every active request whose next write position crosses a
+   page boundary allocates a page (``alloc`` reclaims LRU refcount-0
+   cached pages before declaring exhaustion, so cache residency never
+   blocks admission). On true exhaustion the **youngest** active request
+   is evicted — its references dropped (shared pages survive with their
+   other owners; exclusive keyed pages fall back to the cached state, so
+   re-admission is mostly cache hits), request requeued in arrival order
+   with its generated prefix kept — so the oldest request always makes
+   progress (the no-livelock argument).
+4. **Decode**: ONE batched decode step over all prefill-complete slots
+   (inactive and still-prefilling slots ride along pointed at the trash
+   page); sampled tokens stream to per-request callbacks; finished
+   requests (eos / ``max_new_tokens`` / context limit) release their
+   page references.
 
 Requests whose *total* page need exceeds the pool (or whose total length
 exceeds the model/config limit) can never run and are rejected at
@@ -71,6 +86,9 @@ _PREFILLS = _obs_counter("paddle_tpu_serving_prefills_total",
                          "prefill program runs by compile bucket")
 _EVICTIONS = _obs_counter("paddle_tpu_serving_evictions_total",
                           "requests evicted (pages reclaimed, requeued)")
+_COW = _obs_counter("paddle_tpu_serving_cow_copies_total",
+                    "copy-on-write page copies (a write was about to "
+                    "land in a shared page)")
 _QUEUE = _obs_gauge("paddle_tpu_serving_queue_depth",
                     "requests waiting for admission")
 _ACTIVE = _obs_gauge("paddle_tpu_serving_active_requests",
@@ -120,6 +138,12 @@ class Request:
         self.slot: int | None = None
         self.arrival = next(_arrival)
         self.evictions = 0
+        # prefill progress: context tokens whose KV is resident (prefix
+        # cache hits count; chunked prefill advances it chunk by chunk)
+        self.prefilled = 0
+        self._prefill_target = 0     # context length at admission
+        self._cached_tokens = 0      # prefix-cache hit size at admission
+        self._chain_keys: list = []  # prefix-cache chain keys of that ctx
         self.events: queue.Queue = queue.Queue()
         self._done = threading.Event()
         # timing (wall seconds; ms aggregates computed at finish)
@@ -140,6 +164,14 @@ class Request:
 
     def cur_len(self) -> int:
         return len(self.prompt) + len(self.tokens)
+
+    @property
+    def prefill_done(self) -> bool:
+        """True once the admission context is fully resident and the
+        first token has been sampled — only then may decode pick the
+        slot up."""
+        return self._prefill_target > 0 and \
+            self.prefilled >= self._prefill_target
 
     def _emit(self, token: int) -> None:
         now = time.monotonic()
@@ -206,13 +238,19 @@ class Scheduler:
     """
 
     def __init__(self, pool, programs, max_batch: int, max_seq_len: int,
-                 eos_token_id=None):
+                 eos_token_id=None, prefix_cache=None,
+                 prefill_chunk: int | None = None,
+                 prefill_budget: int | None = None):
         self.pool = pool
         self.programs = programs
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq_len)
         self.max_pages = pool.pages_for(self.max_seq_len)
         self.eos_token_id = eos_token_id
+        self.prefix_cache = prefix_cache
+        self.chunk = int(prefill_chunk) if prefill_chunk else None
+        self.prefill_budget = int(prefill_budget) \
+            if prefill_budget is not None else self.chunk
         self.lock = _tsan.rlock("serving.Scheduler")
         self.waiting: list[Request] = []      # kept sorted by arrival
         self.slots: list[Request | None] = [None] * self.max_batch
@@ -221,6 +259,14 @@ class Scheduler:
         self.occupancy_sum = 0.0
         self.completed = 0
         self.evictions = 0
+        # prefix-cache / chunked-prefill accounting (all under self.lock)
+        self.prefix_page_hits = 0
+        self.prefix_page_misses = 0
+        self.prefix_tokens_saved = 0
+        self.prompt_tokens = 0           # context tokens at admissions
+        self.prefill_tokens_computed = 0
+        self.cow_copies = 0
+        self.chunks = 0
 
     # -- submission ----------------------------------------------------------
 
@@ -272,20 +318,91 @@ class Scheduler:
         with self.lock:
             return len(self.waiting)
 
+    def prefix_hit_rate(self):
+        """Token-level prefill reduction: context tokens served from the
+        prefix cache / context tokens admitted (None before any
+        admission or without a cache)."""
+        with self.lock:
+            if self.prefix_cache is None or not self.prompt_tokens:
+                return None
+            return self.prefix_tokens_saved / self.prompt_tokens
+
+    def prefix_stats(self) -> dict:
+        with self.lock:
+            stats = {
+                "page_hits": self.prefix_page_hits,
+                "page_misses": self.prefix_page_misses,
+                "tokens_saved": self.prefix_tokens_saved,
+                "prompt_tokens": self.prompt_tokens,
+                "prefill_tokens_computed": self.prefill_tokens_computed,
+                "cow_copies": self.cow_copies,
+                "enabled": self.prefix_cache is not None,
+            }
+        rate = self.prefix_hit_rate()
+        stats["hit_rate"] = round(rate, 4) if rate is not None else None
+        if self.prefix_cache is not None:
+            stats["entries"] = len(self.prefix_cache)
+        return stats
+
     # -- the iteration -------------------------------------------------------
 
     def step(self) -> bool:
-        """One scheduler iteration (admit → grow/evict → batched decode).
-        Returns True when any device work ran."""
+        """One scheduler iteration (admit → chunked prefill → grow/evict
+        → batched decode). Returns True when any device work ran."""
         admitted = self._admit()
+        chunked = self._prefill_chunks()
         ran_decode = self._decode()
-        return bool(admitted or ran_decode)
+        return bool(admitted or chunked or ran_decode)
+
+    def drain_step(self) -> bool:
+        """Shutdown-drain iteration: finish chunks and decode, admission
+        stays closed (the engine already aborted the queue)."""
+        chunked = self._prefill_chunks()
+        return bool(self._decode() or chunked)
 
     def _free_slot(self):
         for i, r in enumerate(self.slots):
             if r is None:
                 return i
         return None
+
+    def _claim_prefix(self, ctx, req=None):
+        """(claimed_pages, chain_keys, matched_tokens) for one admission:
+        claim the longest cached page-aligned prefix of ``ctx`` (page
+        references taken). A FULL cover is capped at ``len(ctx) - 1``
+        tokens — the last token must be recomputed because its logits
+        seed generation; its KV write then copy-on-writes the shared
+        tail page. Hit/miss accounting happens at ADMISSION (the claims
+        here are handed back when admission fails, and the head-of-line
+        request retries every iteration — counting here would inflate
+        the metrics unboundedly while it waits). The chain keys are
+        memoized on ``req`` so a blocked request does not re-hash its
+        whole context each scheduler iteration. Called under self.lock."""
+        cache = self.prefix_cache
+        if cache is None:
+            return [], [], 0
+        if req is not None and getattr(req, "_pending_keys_len", -1) == len(ctx):
+            keys = req._pending_keys
+        else:
+            keys = cache.keys_for(ctx)
+            if req is not None:
+                req._pending_keys = keys
+                req._pending_keys_len = len(ctx)
+        claimed = cache.claim(keys) if keys else []
+        matched = len(claimed) * self.pool.page_size
+        if matched >= len(ctx):
+            matched = len(ctx) - 1
+        return claimed, keys, matched
+
+    def _insert_prefix(self, req: Request) -> None:
+        """Register the now fully-written full pages of ``req``'s context
+        so later requests can claim them."""
+        cache = self.prefix_cache
+        if cache is None:
+            return
+        n_full = req._prefill_target // self.pool.page_size
+        if n_full:
+            cache.insert(req._chain_keys[:n_full], req.pages[:n_full])
 
     def _admit(self) -> int:
         admitted = 0
@@ -297,40 +414,104 @@ class Scheduler:
                 if slot is None:
                     break
                 req = self.waiting[0]
-                ctx_len = req.cur_len()
+                ctx = req.context()
+                ctx_len = len(ctx)
+                claimed, keys, matched = self._claim_prefix(ctx, req)
                 # +1: headroom so the request's FIRST decode write (the
                 # token prefill just sampled) cannot immediately evict
-                need = self.pool.pages_for(ctx_len + 1)
-                if need > self.pool.free_pages:
+                need_new = self.pool.pages_for(ctx_len + 1) - len(claimed)
+                if claimed and len(claimed) * self.pool.page_size >= ctx_len:
+                    # full-cover cap: the recomputed last token's KV
+                    # write lands MID-PAGE in a claimed page; if that
+                    # page is shared, _make_writable will copy it,
+                    # consuming one more page than the fresh-alloc count
+                    tail = claimed[(ctx_len - 1) // self.pool.page_size]
+                    if self.pool.refcount(tail) > 1:
+                        need_new += 1
+                if need_new > self.pool.available_pages:
+                    if claimed:        # hand the claims back (they fall
+                        self.pool.free(claimed)   # to the cached state)
                     break                      # FIFO head-of-line wait
+                try:
+                    fresh = self.pool.alloc(
+                        self.pool.pages_for(ctx_len) - len(claimed))
+                except PagePoolExhausted:
+                    if claimed:
+                        self.pool.free(claimed)
+                    break
                 self.waiting.pop(0)
                 _QUEUE.set(len(self.waiting))
-                req.pages = self.pool.alloc(self.pool.pages_for(ctx_len))
+                if self.prefix_cache is not None:
+                    # admission succeeded — NOW the claim outcome counts
+                    self.prefix_cache.note_result(
+                        len(claimed), len(keys) - len(claimed))
+                    self.prefix_page_hits += len(claimed)
+                    self.prefix_page_misses += len(keys) - len(claimed)
+                req.pages = claimed + fresh
                 req.slot = slot
+                req.prefilled = matched
+                req._prefill_target = ctx_len
+                req._cached_tokens = matched
+                req._chain_keys = keys
+                self.prefix_tokens_saved += matched
+                self.prompt_tokens += ctx_len
+                self.prefill_tokens_computed += ctx_len - matched
                 row = self.tables[slot]
                 row[:] = 0
                 row[:len(req.pages)] = req.pages
                 self.slots[slot] = req
                 req.state = RUNNING
                 _ACTIVE.set(len([r for r in self.slots if r is not None]))
+            if matched:
+                _flight.record("serving_prefix_hit", request=req.request_id,
+                               pages=len(claimed), tokens=matched,
+                               context=ctx_len)
+            # a mid-page suffix start (full-cover cap) or any other write
+            # into a shared page must copy-on-write BEFORE device work
+            if not self._make_writable(req, req.prefilled,
+                                       ctx_len - req.prefilled):
+                continue      # req was evicted while creating headroom
+            if self.chunk:
+                admitted += 1     # chunked mode: device work interleaves
+                continue
             try:
                 first = self.programs.prefill(req)
             except Exception as e:   # noqa: BLE001 — request-scoped failure
                 self._release(req)
                 req._finish(FAILED, f"prefill failed: {e!r}")
                 continue
-            _PREFILLS.inc(bucket=str(self.programs.bucket_for(
-                req.cur_len())))
-            _flight.record("serving_prefill", request=req.request_id,
-                           prompt=req.cur_len(), pages=len(req.pages))
-            req._emit(first)
-            _TOKENS.inc(kind="generated")
+            with self.lock:
+                # the SCHEDULER owns prefill progress — a programs
+                # implementation only runs device work (the engine
+                # advances req.prefilled too, but a bare fake must not
+                # have to), and decode may only pick the slot up once
+                # this is set
+                req.prefilled = req._prefill_target
+                if matched:   # the suffix ran as one chunk-program call
+                    self.chunks += 1
+            self._finish_prefill(req, first, matched)
             admitted += 1
-            self._maybe_complete(req)
         return admitted
 
+    def _finish_prefill(self, req: Request, first: int,
+                        cached_tokens: int) -> None:
+        """Shared tail of a completed prefill (monolithic or final
+        chunk): register cacheable pages, emit the first token."""
+        self._insert_prefix(req)
+        _PREFILLS.inc(bucket=str(self.programs.bucket_for(
+            req._prefill_target)))
+        _flight.record("serving_prefill", request=req.request_id,
+                       prompt=req.cur_len(), pages=len(req.pages),
+                       cached_tokens=cached_tokens)
+        req._emit(first)
+        _TOKENS.inc(kind="generated")
+        self._maybe_complete(req)
+
     def _release(self, req: Request) -> None:
-        """Take req out of its slot and return its pages."""
+        """Take req out of its slot and drop its page references (a
+        decref per page: shared pages stay live for their other owners,
+        exclusive keyed pages fall back to the reclaimable cached
+        state)."""
         with self.lock:
             if req.pages:
                 self.pool.free(req.pages)
@@ -339,6 +520,8 @@ class Scheduler:
                 self.tables[req.slot][:] = 0
                 self.slots[req.slot] = None
                 req.slot = None
+            req.prefilled = 0
+            req._prefill_target = 0
             _ACTIVE.set(len([r for r in self.slots if r is not None]))
 
     def _maybe_complete(self, req: Request) -> bool:
@@ -370,41 +553,139 @@ class Scheduler:
             self.evictions += 1
             self._enqueue(victim)
 
+    def _evict_for(self, req: Request) -> bool:
+        """Pool exhausted while growing/copying for ``req``: evict the
+        youngest OTHER active request to make room. False when req
+        itself is the youngest (or alone) — req yields and is evicted.
+        Eviction only drops the victim's REFERENCES: pages shared with
+        other requests stay allocated for them (the refcount-aware
+        no-still-referenced-page-freed guarantee)."""
+        with self.lock:
+            others = [r for r in self.slots
+                      if r is not None and r is not req]
+        victim = max(others, key=lambda r: r.arrival, default=None)
+        if victim is None or victim.arrival < req.arrival:
+            self._evict(req)
+            return False
+        self._evict(victim)
+        return True
+
+    def _make_writable(self, req: Request, pos: int, n: int) -> bool:
+        """Copy-on-write guard: every page holding positions
+        ``[pos, pos + n)`` of ``req`` must be exclusively owned before a
+        KV write lands there — a refcount>1 page is copied to a fresh
+        page and remapped in req's table; the shared original (and its
+        cache entry) stays intact for its other owners. False when req
+        lost its slot while creating headroom for a copy."""
+        if n <= 0:
+            return req.slot is not None
+        ps = self.pool.page_size
+        for idx in range(pos // ps, (pos + n - 1) // ps + 1):
+            while True:
+                with self.lock:
+                    if req.slot is None:
+                        return False
+                    if idx >= len(req.pages):
+                        break        # not allocated yet: growth allocs fresh
+                    page = req.pages[idx]
+                    if self.pool.refcount(page) <= 1:
+                        break        # exclusive already
+                try:
+                    fresh = self.pool.alloc(1)[0]
+                except PagePoolExhausted:
+                    if not self._evict_for(req):
+                        return False
+                    continue
+                self.pool.copy_page(page, fresh)
+                with self.lock:
+                    if req.slot is None:      # evicted meanwhile
+                        self.pool.free([fresh])
+                        return False
+                    self.pool.free([req.pages[idx]])    # drop shared ref
+                    req.pages[idx] = fresh
+                    self.tables[req.slot][idx] = fresh
+                    self.cow_copies += 1
+                _COW.inc()
+                _flight.record("serving_cow", request=req.request_id,
+                               src=int(page), page=int(fresh))
+                break
+        return True
+
+    def _prefill_chunks(self) -> int:
+        """Chunked-prefill pass: advance seated not-yet-prefilled
+        requests by fixed-size chunks, oldest first, spending at most
+        ``prefill_budget`` prefill tokens this iteration — the knob that
+        bounds how much a decode step can be delayed by prompt work."""
+        if not self.chunk:
+            return 0
+        budget = self.prefill_budget or self.chunk
+        ran = 0
+        with self.lock:
+            pending = sorted(
+                (r for r in self.slots
+                 if r is not None and not r.prefill_done),
+                key=lambda r: r.arrival)
+        for req in pending:
+            if budget <= 0:
+                break
+            with self.lock:
+                if req.slot is None or req.prefill_done:
+                    continue
+                start = req.prefilled
+                n = min(self.chunk, req._prefill_target - start, budget)
+            if n <= 0:
+                continue
+            if not self._make_writable(req, start, n):
+                continue             # evicted while making room
+            try:
+                tok = self.programs.prefill_chunk(req, n)
+            except Exception as e:   # noqa: BLE001 — request-scoped failure
+                self._release(req)
+                req._finish(FAILED, f"prefill failed: {e!r}")
+                continue
+            budget -= n
+            ran += 1
+            with self.lock:
+                # scheduler-owned progress (the engine advances it too;
+                # idempotent either way)
+                req.prefilled = max(req.prefilled, start + n)
+                self.chunks += 1
+            if tok is not None:      # final chunk sampled the first token
+                self._finish_prefill(req, tok, req._cached_tokens)
+        return ran
+
     def _ensure_pages(self, req: Request) -> bool:
-        """Grow req's page table to cover its next write position,
-        evicting the youngest active request on exhaustion. False when
-        req is no longer in a slot (evicted here — or already evicted as
-        a VICTIM of an earlier request's growth this same iteration)."""
+        """Grow req's page table to cover its next write position
+        (evicting the youngest active request on true exhaustion) and
+        copy-on-write the write page if it is shared. False when req is
+        no longer in a slot (evicted here — or already evicted as a
+        VICTIM of an earlier request's growth this same iteration)."""
         if req.slot is None:
             return False
         while len(req.pages) < self.pool.pages_for(req.cur_len()):
             try:
                 page = self.pool.alloc(1)[0]
             except PagePoolExhausted:
-                with self.lock:
-                    others = [r for r in self.slots
-                              if r is not None and r is not req]
-                victim = max(others, key=lambda r: r.arrival, default=None)
-                if victim is None or victim.arrival < req.arrival:
-                    # req is the youngest (or alone): it yields
-                    self._evict(req)
+                if not self._evict_for(req):
                     return False
-                self._evict(victim)
                 continue
             with self.lock:
                 req.pages.append(page)
                 self.tables[req.slot][len(req.pages) - 1] = page
-        return True
+        # the decode write position must be exclusively owned
+        return self._make_writable(req, req.cur_len() - 1, 1)
 
     def _decode(self) -> bool:
         with self.lock:
-            active = [r for r in self.slots if r is not None]
+            active = [r for r in self.slots
+                      if r is not None and r.prefill_done]
         if not active:
             return False
         for req in list(active):
             self._ensure_pages(req)
         with self.lock:
-            active = [r for r in self.slots if r is not None]
+            active = [r for r in self.slots
+                      if r is not None and r.prefill_done]
             if not active:
                 return False
             b = self.max_batch
@@ -417,7 +698,10 @@ class Scheduler:
                 temps[req.slot] = max(req.temperature, 0.0)
             tables = self.tables.copy()
             for i, r in enumerate(self.slots):
-                if r is None:
+                if r is None or not r.prefill_done:
+                    # empty AND still-prefilling slots decode against the
+                    # trash page — a mid-prefill table must not take the
+                    # batched write at position 0
                     tables[i][:] = 0
         out = self.programs.decode(tokens, positions, tables, temps)
         occ = len(active) / float(self.max_batch)

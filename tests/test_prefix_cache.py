@@ -1,0 +1,511 @@
+"""Prefix-cached serving: COW KV page sharing + chunked prefill (ISSUE 14).
+
+Covers the satellites: refcount-aware pool accounting (double-free
+distinction, LRU eviction never touching refcount>0 pages, eviction of a
+request whose pages are shared), chain-hash matching + claim
+verification, COW correctness when concurrent requests share live pages,
+token-exact parity cache-on vs cache-off vs ``model.generate`` (greedy),
+chunked-prefill parity vs monolithic, the decode program's
+compile-exactly-once proof across join/leave/chunk interleave, quantized
+(int8) serving with the cache on, healthz/metrics surfacing, and the
+perf-gate serve sub-block directions.
+"""
+
+import importlib.util
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+from paddle_tpu.models.llama import llama_tiny
+from paddle_tpu.serving import (LLMEngine, PageDoubleFree, PagePool,
+                                PagePoolError, PagePoolExhausted,
+                                PrefixCache, ServingConfig, chain_keys,
+                                model_fingerprint)
+
+
+def _model(**kw):
+    cfg = dict(vocab_size=128, max_position_embeddings=64, hidden_size=32,
+               num_layers=1, num_heads=2, num_kv_heads=1,
+               intermediate_size=64)
+    cfg.update(kw)
+    return llama_tiny(**cfg)
+
+
+def _engine(model=None, **kw):
+    cfg = dict(page_size=8, num_pages=17, max_batch=2, max_new_tokens=6)
+    cfg.update(kw)
+    return LLMEngine(model or _model(), ServingConfig(**cfg))
+
+
+# -- pool refcounting ---------------------------------------------------------
+
+def test_refcount_share_and_decref_states():
+    pool = PagePool(1, 9, 1, 8, 4)
+    pages = pool.alloc(2)
+    assert all(pool.refcount(p) == 1 for p in pages)
+    pool.incref(pages)                       # a second request maps them
+    assert pool.shared_pages == 2
+    pool.free(pages)                         # first owner leaves
+    assert pool.used_pages == 2 and pool.shared_pages == 0
+    assert pool.leaked() == 2                # second owner still holds
+    pool.free(pages)                         # second owner leaves
+    assert pool.used_pages == 0 and pool.leaked() == 0
+    assert pool.free_pages == 8 and pool.lost() == 0
+
+
+def test_double_free_distinguished_from_foreign_id():
+    """Bugfix satellite: a second decref (refcount already zero) and a
+    foreign id are DIFFERENT errors — refcount sharing makes repeated
+    free() of the same page legal exactly ref-count many times, so the
+    diagnostics must say which world the bug lives in."""
+    pool = PagePool(1, 9, 1, 8, 4)
+    pages = pool.alloc(1)
+    pool.free(pages)
+    with pytest.raises(PageDoubleFree):
+        pool.free(pages)                     # second decref
+    with pytest.raises(PagePoolError) as e:
+        pool.free([42])                      # foreign id
+    assert not isinstance(e.value, PageDoubleFree)
+    assert "never allocated" in str(e.value)
+    with pytest.raises(PagePoolError):
+        pool.free([3, 3])                    # dup within one call
+    # a cached page is also "refcount zero": second decref, not foreign
+    p = pool.alloc(1)
+    pool.retain_keys([(p[0], b"key")])
+    pool.free(p)
+    assert pool.cached_pages == 1
+    with pytest.raises(PageDoubleFree):
+        pool.free(p)
+    assert pool.lost() == 0
+
+
+def test_lru_reclaim_only_takes_refcount_zero_pages():
+    """Test satellite: cache eviction is LRU over refcount-0 pages ONLY —
+    exhausting the pool reclaims cached pages oldest-first and never
+    touches a referenced page."""
+    evicted = []
+    pool = PagePool(1, 9, 1, 8, 4)
+    pool.set_reclaim_hook(lambda page, key: evicted.append((page, key)))
+    held = pool.alloc(4)
+    cached = pool.alloc(4)
+    pool.retain_keys([(p, b"k%d" % i) for i, p in enumerate(cached)])
+    pool.free(cached)
+    assert pool.cached_pages == 4 and pool.free_pages == 0
+    assert pool.available_pages == 4
+    got = pool.alloc(3)                      # reclaims 3 cached, LRU first
+    assert [e[0] for e in evicted] == cached[:3]
+    assert set(got) == set(cached[:3])
+    with pytest.raises(PagePoolExhausted):
+        pool.alloc(2)                        # 1 cached left, 4 held firm
+    assert all(pool.refcount(p) == 1 for p in held)
+    assert pool.lost() == 0
+
+
+def test_claim_prefix_verifies_keys_and_stops_at_mismatch():
+    pool = PagePool(1, 9, 1, 8, 4)
+    fp = b"fp"
+    toks = list(range(24))                   # 3 full pages @ ps=8
+    keys = chain_keys(fp, toks, 8)
+    assert len(keys) == 3
+    assert keys == chain_keys(fp, toks, 8)               # deterministic
+    assert keys != chain_keys(b"other", toks, 8)         # fingerprint-keyed
+    assert keys[1] != chain_keys(fp, toks[:8] + [99] + toks[9:], 8)[1]
+
+    cache = PrefixCache(pool, fp)
+    pages = pool.alloc(3)
+    cache.insert(keys, pages)
+    pool.free(pages)                         # all three -> cached state
+    claimed = cache.claim(keys)
+    assert claimed == pages                  # full chain revived
+    pool.free(claimed)
+    # reclaim page 1's contents out from under the cache: chain now stops
+    pool.alloc(pool.free_pages)              # drain the free list
+    stolen = pool.alloc(1)                   # forces LRU reclaim
+    assert stolen[0] == pages[0]             # oldest cached page went
+    claimed2 = cache.claim(keys)
+    assert claimed2 == []                    # chain broke at page 0
+    assert pool.lost() == 0
+
+
+def test_cow_copy_page_moves_contents():
+    import jax.numpy as jnp
+    pool = PagePool(2, 5, 1, 4, 4)
+    a, b = pool.alloc(2)
+    pool.k._data = pool.k._data.at[:, a].set(7.0)
+    pool.v._data = pool.v._data.at[:, a].set(3.0)
+    pool.copy_page(a, b)
+    assert float(jnp.sum(jnp.abs(pool.k._data[:, b] - 7.0))) == 0.0
+    assert float(jnp.sum(jnp.abs(pool.v._data[:, b] - 3.0))) == 0.0
+
+
+# -- engine: prefix hits, parity, COW ----------------------------------------
+
+def test_cache_on_token_exact_vs_cache_off_vs_generate():
+    """Acceptance: greedy generation with the cache ON (second request
+    hits) is token-exact vs cache OFF vs ``model.generate``."""
+    paddle.seed(31)
+    model = llama_tiny()                     # vocab 512, pos 128
+    rng = np.random.default_rng(5)
+    prompt = [int(t) for t in rng.integers(1, 500, size=40)]  # 2 full pages
+    ref = model.generate(np.asarray([prompt]), max_new_tokens=8)
+    expect = [int(t) for t in ref[0, len(prompt):]]
+
+    on = _engine(model, page_size=16, num_pages=65, max_new_tokens=8,
+                 prefix_cache=True)
+    off = _engine(model, page_size=16, num_pages=65, max_new_tokens=8,
+                  prefix_cache=False)
+    try:
+        miss = on.generate(prompt, timeout=300)
+        hit = on.generate(prompt, timeout=300)       # claims cached pages
+        plain = off.generate(prompt, timeout=300)
+        stats = on.scheduler.prefix_stats()
+    finally:
+        on.shutdown()
+        off.shutdown()
+    assert miss == hit == plain == expect
+    assert stats["tokens_saved"] > 0 and stats["page_hits"] >= 2
+    assert on.pool.leaked() == 0 and on.pool.lost() == 0
+
+
+def test_cow_when_live_requests_share_and_diverge():
+    """Test satellite: two concurrent requests share prompt pages
+    (refcount 2) and diverge mid-page — the full-cover cap makes the
+    second request's last-token write land in the SHARED tail page, so
+    it must copy-on-write; the first request's stream must be exactly
+    what it would have been alone."""
+    paddle.seed(32)
+    model = llama_tiny()
+    rng = np.random.default_rng(6)
+    prompt = [int(t) for t in rng.integers(1, 500, size=32)]  # page-aligned
+    solo_ref = model.generate(np.asarray([prompt]), max_new_tokens=16)
+    expect = [int(t) for t in solo_ref[0, 32:]]
+
+    eng = _engine(model, page_size=16, num_pages=65, max_batch=2,
+                  max_new_tokens=16, prefix_cache=True)
+    try:
+        r1 = eng.submit(prompt)
+        while len(r1.tokens) < 2:           # r1 prefilled + decoding
+            time.sleep(0.005)
+        r2 = eng.submit(prompt)             # claims r1's LIVE pages
+        o1, o2 = r1.result(300), r2.result(300)
+        stats = eng.scheduler.prefix_stats()
+    finally:
+        eng.shutdown()
+    assert o1 == o2 == expect
+    assert stats["cow_copies"] >= 1
+    assert int(obs.value("paddle_tpu_serving_cow_copies_total")) >= 1
+    assert eng.pool.leaked() == 0 and eng.pool.lost() == 0
+
+
+def test_eviction_never_frees_shared_pages():
+    """Bugfix satellite: evicting a request whose pages are SHARED drops
+    only its references — the surviving request keeps decoding correct
+    tokens from the still-allocated pages, and re-admission recovers."""
+    paddle.seed(33)
+    model = _model(max_position_embeddings=128)
+    # pool sized so that two requests sharing a prompt page outgrow it:
+    # the youngest gets evicted while its pages are partly shared
+    eng = _engine(model, page_size=4, num_pages=11, max_batch=2,
+                  max_new_tokens=18, prefix_cache=True)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]       # 2 full pages @ ps=4
+    solo = _engine(model, page_size=4, num_pages=33, max_batch=1,
+                   max_new_tokens=18, prefix_cache=False)
+    try:
+        expect = solo.generate(prompt, timeout=300)
+        a = eng.submit(prompt)
+        b = eng.submit(prompt)
+        ra, rb = a.result(300), b.result(300)
+    finally:
+        solo.shutdown()
+        eng.shutdown()
+    assert ra == rb == expect
+    assert eng.scheduler.evictions >= 1
+    assert eng.pool.leaked() == 0 and eng.pool.lost() == 0
+    assert eng.program_stats()["decode"]["retraces"] == 0
+
+
+def _fake_sched(num_pages, max_batch=2, max_seq_len=32, page_size=4,
+                cache=True):
+    """Direct Scheduler over a FakePrograms device side — admission
+    accounting tests need exact page arithmetic, not a real model."""
+    from paddle_tpu.serving.kv_cache import PagePool
+    from paddle_tpu.serving.scheduler import Scheduler
+
+    class FakePrograms:
+        def prefill(self, req):
+            return 7
+
+        def bucket_for(self, n):
+            return 8
+
+        def decode(self, tokens, positions, tables, temps):
+            return np.full(tokens.shape, 7, np.int32)
+
+    pool = PagePool(num_layers=1, num_pages=num_pages, num_kv_heads=1,
+                    page_size=page_size, head_dim=2)
+    pc = PrefixCache(pool, b"fake-fingerprint") if cache else None
+    return Scheduler(pool, FakePrograms(), max_batch=max_batch,
+                     max_seq_len=max_seq_len, prefix_cache=pc)
+
+
+def test_blocked_admission_does_not_inflate_hit_counters():
+    """Regression: the head-of-line request retries its claim every
+    scheduler iteration while blocked on pages — hit/miss accounting
+    must count once at ADMISSION, not once per retry."""
+    from paddle_tpu.serving.scheduler import Request
+    sched = _fake_sched(num_pages=7)         # 6 allocatable @ ps=4
+    pool, cache = sched.pool, sched.prefix_cache
+    prompt = list(range(40, 60))             # 5 pages; first 2 cached
+    keys = cache.keys_for(prompt)
+    seeded = pool.alloc(2)
+    cache.insert(keys[:2], seeded)
+    pool.free(seeded)                        # -> cached state, claimable
+    hits0 = int(obs.value("paddle_tpu_serving_prefix_hits_total"))
+    misses0 = int(obs.value("paddle_tpu_serving_prefix_misses_total"))
+    hog = pool.alloc(4)                      # free 0 + cached 2 available
+    sched.submit(Request(prompt, max_new_tokens=2))
+    for _ in range(5):                       # blocked: need 4 > available 2
+        sched._admit()
+    assert len(sched.waiting) == 1
+    assert sched.prefix_page_hits == 0 and sched.prefix_page_misses == 0
+    assert int(obs.value("paddle_tpu_serving_prefix_hits_total")) == hits0
+    pool.free(hog)                           # headroom appears
+    sched._admit()
+    assert not sched.waiting
+    assert sched.prefix_page_hits == 2 and sched.prefix_page_misses == 3
+    assert int(obs.value("paddle_tpu_serving_prefix_hits_total")) == hits0 + 2
+    assert int(obs.value(
+        "paddle_tpu_serving_prefix_misses_total")) == misses0 + 3
+
+
+def test_admission_headroom_counts_full_cover_cow_page():
+    """Regression: a full-cover claim whose capped last-token write
+    lands in a SHARED page consumes one extra page for the
+    copy-on-write — admission must account for it instead of admitting
+    into a spurious first-write eviction."""
+    from paddle_tpu.serving.scheduler import Request
+    sched = _fake_sched(num_pages=9)         # 8 allocatable @ ps=4
+    pool = sched.pool
+    prompt = list(range(70, 78))             # 2 pages, page-aligned
+    r1 = sched.submit(Request(prompt, max_new_tokens=4))
+    sched._admit()                           # r1 live, its pages keyed
+    assert r1.slot is not None and pool.used_pages == 2
+    hog = pool.alloc(5)                      # available_pages == 1
+    r2 = sched.submit(Request(prompt, max_new_tokens=4))
+    for _ in range(3):
+        # full cover: need_new = pages_for(9) - 2 + 1 CoW = 2 > 1
+        sched._admit()
+    assert r2.slot is None and len(sched.waiting) == 1
+    assert sched.evictions == 0              # nobody got evicted for it
+    pool.free(hog[:1])                       # available_pages == 2
+    sched._admit()
+    assert r2.slot is not None and not sched.waiting
+    assert sched.cow_copies == 1             # the shared tail was copied
+    assert pool.lost() == 0 and sched.evictions == 0
+
+
+# -- chunked prefill ----------------------------------------------------------
+
+def test_chunked_prefill_parity_vs_monolithic():
+    paddle.seed(34)
+    model = llama_tiny()
+    rng = np.random.default_rng(8)
+    for plen in (5, 16, 40):                # sub-chunk, aligned, multi-chunk
+        prompt = [int(t) for t in rng.integers(1, 500, size=plen)]
+        mono = _engine(model, page_size=16, num_pages=65, max_new_tokens=8,
+                       prefix_cache=False)
+        chk = _engine(model, page_size=16, num_pages=65, max_new_tokens=8,
+                      prefix_cache=False, prefill_chunk=16)
+        try:
+            want = mono.generate(prompt, timeout=300)
+            got = chk.generate(prompt, timeout=300)
+            chunks = chk.scheduler.chunks
+        finally:
+            mono.shutdown()
+            chk.shutdown()
+        assert got == want, f"plen={plen}"
+        assert chunks == -(-plen // 16)     # ceil: every token chunked
+        assert chk.pool.leaked() == 0 and chk.pool.lost() == 0
+
+
+def test_decode_compiles_once_across_join_leave_chunk_interleave():
+    """Test satellite: the zero-retrace guarantee survives chunked
+    prefill — long prompts chunk while other requests decode, requests
+    join/leave, and the decode program still compiles exactly once."""
+    paddle.seed(35)
+    eng = _engine(max_batch=3, page_size=4, num_pages=65,
+                  max_new_tokens=10, prefix_cache=True, prefill_chunk=8)
+    try:
+        first = eng.submit([1, 2, 3, 4, 5])
+        first.result(timeout=300)                    # join + leave
+        long_req = eng.submit(list(range(1, 33)))    # 4 chunks of 8
+        reqs = [eng.submit([7 + i, 3, 9], max_new_tokens=9)
+                for i in range(4)]                   # joins > slots
+        long_req.result(timeout=300)
+        for r in reqs:
+            r.result(timeout=300)
+        stats = eng.program_stats()["decode"]
+        chunks = eng.scheduler.chunks
+    finally:
+        eng.shutdown()
+    assert stats["retraces"] == 0
+    assert stats["compiles"] == 1
+    assert stats["discoveries"] == 1
+    assert chunks >= 4
+    assert eng.pool.leaked() == 0 and eng.pool.lost() == 0
+
+
+def test_chunk_budget_caps_prefill_tokens_per_iteration():
+    """The token-budget knob: with budget == chunk, a long prompt takes
+    one chunk per scheduler iteration, so decode steps of an in-flight
+    request interleave between chunks (its token count grows while the
+    long prompt prefills)."""
+    paddle.seed(36)
+    eng = _engine(max_batch=2, page_size=8, num_pages=65,
+                  max_new_tokens=24, prefix_cache=False, prefill_chunk=8,
+                  prefill_budget=8)
+    try:
+        short = eng.submit([1, 2, 3])
+        while len(short.tokens) < 2:
+            time.sleep(0.005)
+        before = len(short.tokens)
+        long_req = eng.submit(list(range(1, 41)))    # 5 chunks of 8
+        long_req.result(timeout=300)
+        after_first = next(
+            i for i, _ in enumerate(long_req.tokens, 1))
+        during = len(short.tokens) - before
+        short.result(timeout=300)
+    finally:
+        eng.shutdown()
+    # the short request made progress while the long prompt chunked
+    assert during >= 1
+    assert eng.pool.leaked() == 0 and eng.pool.lost() == 0
+
+
+def test_quantized_int8_serving_with_prefix_cache():
+    paddle.seed(37)
+    model = _model(num_layers=2)
+    eng = _engine(model, quant="weight_only_int8", max_new_tokens=5,
+                  page_size=8, num_pages=33, prefix_cache=True)
+    prompt = list(range(1, 21))              # 2 full pages @ ps=8
+    try:
+        first = eng.generate(prompt, timeout=300)
+        second = eng.generate(prompt, timeout=300)   # cache hit
+        stats = eng.scheduler.prefix_stats()
+    finally:
+        eng.shutdown()
+    assert first == second
+    assert len(first) == 5 and all(0 <= t < 128 for t in first)
+    assert stats["page_hits"] >= 2 and stats["tokens_saved"] > 0
+    assert eng._sm.quantized
+    assert eng.pool.leaked() == 0 and eng.pool.lost() == 0
+
+
+def test_quant_fingerprint_differs_from_float():
+    m = _model()
+    f1 = model_fingerprint(m, quant=None, dtype="float32", page_size=8)
+    f2 = model_fingerprint(m, quant="weight_only_int8", dtype="float32",
+                           page_size=8)
+    f3 = model_fingerprint(m, quant=None, dtype="float32", page_size=16)
+    assert len({f1, f2, f3}) == 3
+
+
+# -- surfacing: healthz, stats, metrics ---------------------------------------
+
+def test_health_and_stats_report_prefix_cache():
+    paddle.seed(38)
+    eng = _engine(page_size=4, num_pages=33, max_new_tokens=4,
+                  prefix_cache=True)
+    try:
+        eng.generate([1, 2, 3, 4, 5, 6, 7, 8, 9], timeout=300)
+        eng.generate([1, 2, 3, 4, 5, 6, 7, 8, 9], timeout=300)
+        code, payload = eng.health(stall_after_s=120.0)
+        stats = eng.stats()
+    finally:
+        eng.shutdown()
+    assert code == 200
+    assert payload["prefix_hit_rate"] is not None
+    assert payload["prefix_hit_rate"] > 0
+    assert payload["kv_pages_cached"] >= 0
+    assert stats["prefix_cache"]["page_hits"] >= 1
+    assert stats["pages"]["lost"] == 0
+    assert int(obs.value("paddle_tpu_serving_prefix_hits_total")) >= 1
+    assert int(obs.value("paddle_tpu_serving_prefill_chunks_total")) >= 1
+    assert "chunk" in eng.program_stats()
+
+
+def test_prefix_metrics_in_prometheus_exposition():
+    """The new metric families are parser-valid exposition (the serving
+    HTTP test already validates the grammar end-to-end; this asserts the
+    families exist once exercised)."""
+    from paddle_tpu.observability import get_registry, render_prometheus
+    # materialize one series per family so the test is order-independent
+    reg = get_registry()
+    for fam in ("paddle_tpu_serving_prefix_hits_total",
+                "paddle_tpu_serving_prefix_misses_total",
+                "paddle_tpu_serving_cow_copies_total",
+                "paddle_tpu_serving_prefill_chunks_total"):
+        reg.get(fam).inc(0)
+    PagePool(1, 3, 1, 4, 4)          # exports the shared-pages gauge
+    text = render_prometheus()
+    from test_prometheus_format import validate_exposition
+    metrics = validate_exposition(text)
+    for fam in ("paddle_tpu_serving_prefix_hits_total",
+                "paddle_tpu_serving_prefix_misses_total",
+                "paddle_tpu_serving_cow_copies_total",
+                "paddle_tpu_serving_prefill_chunks_total",
+                "paddle_tpu_serving_shared_pages"):
+        assert fam in metrics, fam
+
+
+# -- perf gate directions -----------------------------------------------------
+
+def _perf_gate():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "tools", "perf_gate.py")
+    spec = importlib.util.spec_from_file_location("perf_gate_mod2", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_gate_serve_subblocks_both_directions():
+    pg = _perf_gate()
+    ok = {"decode_program": {"retraces_after_warmup": 0},
+          "pages_leaked": 0, "pages_lost": 0, "tokens_per_s": 50.0}
+    good = dict(ok, shared_prefix={
+        "cache_on": dict(ok, ttft_ms={"p50": 9.0}),
+        "cache_off": dict(ok, ttft_ms={"p50": 11.0})},
+        chunked_prefill={"chunked": dict(ok), "monolithic": dict(ok)})
+
+    def gates(serve):
+        return pg.serve_gates({"extra": {"serve": serve}}, {})
+
+    hard, soft = gates(good)
+    assert hard == [] and soft == []
+
+    import copy
+    bad = copy.deepcopy(good)
+    bad["shared_prefix"]["cache_on"]["pages_leaked"] = 2
+    hard, _ = gates(bad)
+    assert any("SERVE-LEAK" in m and "cache_on" in m for m in hard)
+
+    bad = copy.deepcopy(good)
+    bad["chunked_prefill"]["chunked"]["decode_program"][
+        "retraces_after_warmup"] = 1
+    hard, _ = gates(bad)
+    assert any("SERVE-RETRACE" in m for m in hard)
+
+    bad = copy.deepcopy(good)
+    bad["pages_lost"] = 1
+    hard, _ = gates(bad)
+    assert any("SERVE-LOST" in m for m in hard)
+
+    bad = copy.deepcopy(good)
+    bad["shared_prefix"]["cache_on"]["ttft_ms"]["p50"] = 20.0
+    _, soft = gates(bad)
+    assert any("prefix-ttft" in m for m in soft)

@@ -21,11 +21,13 @@ Profiles (each compared against the same fault-free reference trajectory):
                   resume having lost 0 steps and finish identical. Flight
                   dump: reason preempted_sigterm, final events preempt ...
                   preempt_exit
-  serving-sigterm SIGTERM mid-stream into the serving engine (the serving
-                  profile): in-flight requests drain or cleanly error,
-                  exit 143, ZERO KV pages leaked (pool accounting
-                  asserted). Flight dump: reason serving_preempted, final
-                  events serving_preempt ... serving_drain
+  serving-sigterm SIGTERM mid-stream into the serving engine WITH
+                  prefix-cache page sharing live (a refcount-2 KV page
+                  at signal time): in-flight requests drain or cleanly
+                  error, exit 143, ZERO KV pages leaked or lost
+                  (refcount-aware pool accounting asserted). Flight
+                  dump: reason serving_preempted, final events
+                  serving_preempt ... serving_drain
 
 Exit status: 0 when every profile holds, 1 otherwise. Fast (CPU, a
 4-parameter model, eager steps) — wired into tier-1 via
@@ -274,10 +276,13 @@ def profile_sigterm_at_step(steps, ref):
 
 
 def profile_serving_sigterm(steps, ref):
-    """SIGTERM mid-stream into the serving engine: in-flight requests must
-    drain (or cleanly error), the process must leave a schema-valid flight
-    dump with the serving events, exit relaunchable 143 — and leak ZERO
-    KV pages (pool accounting asserted). ``ref`` (the training
+    """SIGTERM mid-stream into the serving engine — with prefix-cache
+    page sharing LIVE at signal time: two in-flight requests hold the
+    same physical KV pages (refcount 2) when the signal lands. Requests
+    must drain (or cleanly error), the process must leave a schema-valid
+    flight dump with the serving events, exit relaunchable 143 — and the
+    refcount-aware pool accounting must show ZERO leaked pages (refcount
+    >= 1) AND zero LOST pages after the drain. ``ref`` (the training
     trajectory) is unused: serving has no weights to resume."""
     import signal
     import time
@@ -296,12 +301,20 @@ def profile_serving_sigterm(steps, ref):
             drain_timeout_s=60.0))
         eng.install_preemption()
         try:
-            reqs = [eng.submit([1, 2, 3]), eng.submit([4, 5])]
+            # a common 8-token prefix (one full page) shared by both
+            # requests: the second admission claims the first's LIVE
+            # page, so a refcount-2 page exists while both stream
+            common = [1, 2, 3, 4, 5, 6, 7, 8]
+            reqs = [eng.submit(common + [9, 10]),
+                    eng.submit(common + [11, 12])]
             deadline = time.monotonic() + 60
             while any(len(r.tokens) < 2 for r in reqs):  # mid-stream
                 if time.monotonic() > deadline:
                     return "requests never started streaming"
                 time.sleep(0.005)
+            if eng.pool.shared_pages < 1:
+                return "no shared KV page live at signal time (the " \
+                       "prefix cache did not share the common prefix)"
             try:
                 os.kill(os.getpid(), signal.SIGTERM)
                 while time.monotonic() < deadline:
@@ -320,6 +333,9 @@ def profile_serving_sigterm(steps, ref):
                    f"errored: {bad}"
         if eng.pool.leaked():
             return f"{eng.pool.leaked()} KV page(s) leaked after drain"
+        if eng.pool.lost():
+            return f"{eng.pool.lost()} KV page(s) lost (in no pool " \
+                   f"state) after drain"
         err = _validate_flight_dump(
             d, "serving_preempted", ["serving_preempt", "serving_drain"])
         if err:

@@ -40,9 +40,14 @@ peak-HBM failures print the top-3 MEASURED fusion targets
 (``extra.fusion_targets``) next to the static top-owner hint.
 
 The serving runtime (``extra.serve``, from `bench.py serve` or the full
-run) adds two HARD gates — any decode-program retrace after warmup and
-any leaked KV page fail the round — plus a soft serve-tokens/s
-comparison (PERF_GATE_SERVE_TOL_PCT, default 30%).
+run) adds three HARD gates, checked in EVERY serve sub-block (the
+independent workload, shared-prefix cache-on/off, chunked/monolithic):
+any decode-program retrace after warmup, any leaked KV page (refcount
+>= 1 after drain), and any LOST page (refcount accounting dropped it)
+fail the round — plus soft serve-tokens/s (PERF_GATE_SERVE_TOL_PCT,
+default 30%) and shared-prefix cache-on p50 TTFT comparisons
+(PERF_GATE_PREFIX_TTFT_TOL_PCT, default 25%: within-round vs cache-off
+AND against the baseline round).
 
 The mega-kernel harvest (``extra.fusion_targets``) adds a soft gate: the
 top remaining (not ``fused``) target's est_saved_bytes must stay below
@@ -339,30 +344,110 @@ def serve_block(d):
     return blk if isinstance(blk, dict) else None
 
 
+def serve_subblocks(cur):
+    """Every serving sub-run carrying its own zero-retrace / zero-leak
+    proof: the independent-prompts block itself, the shared-prefix
+    cache-on/off runs, and the chunked-prefill probe's two engines."""
+    blocks = [("serve", cur)]
+    sp = cur.get("shared_prefix") or {}
+    for k in ("cache_on", "cache_off"):
+        if isinstance(sp.get(k), dict):
+            blocks.append((f"serve.shared_prefix.{k}", sp[k]))
+    cp = cur.get("chunked_prefill") or {}
+    for k in ("chunked", "monolithic"):
+        if isinstance(cp.get(k), dict):
+            blocks.append((f"serve.chunked_prefill.{k}", cp[k]))
+    return blocks
+
+
+def shared_prefix_ttft(d):
+    """p50 TTFT of the shared-prefix workload's cache-on run (None when
+    the round predates the prefix cache)."""
+    blk = serve_block(d)
+    try:
+        v = blk["shared_prefix"]["cache_on"]["ttft_ms"]["p50"]
+        return float(v) if v is not None else None
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
 def serve_gates(cd, bd):
-    """Serving-runtime gates. HARD: any decode-program retrace after
-    warmup (the paged-KV static-shape contract — requests joining/
-    leaving/growing must never recompile the decode step) or leaked KV
-    pages. SOFT: serve tokens/s vs the baseline round's serve section
-    (PERF_GATE_SERVE_TOL_PCT, default 30 — CPU-smoke serving numbers are
-    thread-scheduling noisy; <= 0 disables). Returns (hard, soft) failure
+    """Serving-runtime gates. HARD (checked in EVERY serve sub-block —
+    independent, shared-prefix cache-on/off, chunked/monolithic): any
+    decode-program retrace after warmup (the paged-KV static-shape
+    contract — requests joining/leaving/growing must never recompile the
+    decode step), leaked KV pages (refcount >= 1 after drain), or LOST
+    pages (the refcount-aware complement: a page in no pool state means
+    the accounting dropped it). SOFT: serve tokens/s vs the baseline
+    round's serve section (PERF_GATE_SERVE_TOL_PCT, default 30 —
+    CPU-smoke serving numbers are thread-scheduling noisy; <= 0
+    disables), and the shared-prefix cache-on p50 TTFT both within-round
+    (must not exceed cache-off by more than PERF_GATE_PREFIX_TTFT_TOL_PCT,
+    default 25 — the prefix cache must actually BUY latency) and against
+    the baseline round's same field. Returns (hard, soft) failure
     message lists."""
     cur = serve_block(cd)
     if cur is None:
         return [], []
     hard, soft = [], []
-    dec = cur.get("decode_program") or {}
-    retr = dec.get("retraces_after_warmup")
-    if retr:
-        hard.append(
-            f"perf gate [SERVE-RETRACE] decode program retraced "
-            f"{int(retr)}x after warmup while requests joined/left/grew: "
-            f"the paged-KV static-shape contract is broken (compiles="
-            f"{dec.get('compiles')}, see paddle_tpu/serving/kv_cache.py)")
-    leaked = cur.get("pages_leaked")
-    if leaked:
-        hard.append(f"perf gate [SERVE-LEAK] {int(leaked)} KV page(s) "
-                    f"still allocated after the serve bench drained")
+    for name, blk in serve_subblocks(cur):
+        dec = blk.get("decode_program") or {}
+        retr = dec.get("retraces_after_warmup")
+        if retr:
+            hard.append(
+                f"perf gate [SERVE-RETRACE] {name}: decode program "
+                f"retraced {int(retr)}x after warmup while requests "
+                f"joined/left/grew: the paged-KV static-shape contract is "
+                f"broken (compiles={dec.get('compiles')}, see "
+                f"paddle_tpu/serving/kv_cache.py)")
+        leaked = blk.get("pages_leaked")
+        if leaked:
+            hard.append(
+                f"perf gate [SERVE-LEAK] {name}: {int(leaked)} KV "
+                f"page(s) still referenced after the serve bench drained")
+        lost = blk.get("pages_lost")
+        if lost:
+            hard.append(
+                f"perf gate [SERVE-LOST] {name}: {int(lost)} KV page(s) "
+                f"in no pool state (free/used/cached) — refcount "
+                f"accounting dropped them")
+    # shared-prefix TTFT: the cache must not cost latency on the very
+    # workload it exists for
+    ttft_tol = _tol_pct("PERF_GATE_PREFIX_TTFT_TOL_PCT", 25.0)
+    sp = cur.get("shared_prefix") or {}
+    try:
+        on_p50 = float(sp["cache_on"]["ttft_ms"]["p50"])
+        off_p50 = float(sp["cache_off"]["ttft_ms"]["p50"])
+    except (KeyError, TypeError, ValueError):
+        on_p50 = off_p50 = None
+    if ttft_tol > 0 and on_p50 is not None and off_p50 and off_p50 > 0:
+        ceiling = off_p50 * (1 + ttft_tol / 100.0)
+        delta = (on_p50 - off_p50) / off_p50
+        if on_p50 > ceiling:
+            soft.append(
+                f"perf gate [REGRESSION:prefix-ttft] shared-prefix p50 "
+                f"TTFT {on_p50:.1f} ms with the cache ON vs {off_p50:.1f} "
+                f"ms OFF (delta {delta:+.2%}, ceiling {ceiling:.1f}, tol "
+                f"{ttft_tol:.0f}% via PERF_GATE_PREFIX_TTFT_TOL_PCT): "
+                f"prefix caching is costing latency on its own workload")
+        else:
+            print(f"perf gate [ok:prefix-ttft] shared-prefix p50 TTFT "
+                  f"{on_p50:.1f} ms cache-on vs {off_p50:.1f} ms "
+                  f"cache-off (delta {delta:+.2%})")
+    base_ttft = shared_prefix_ttft(bd) if bd else None
+    cur_ttft = shared_prefix_ttft(cd)
+    if ttft_tol > 0 and base_ttft and cur_ttft is not None:
+        ceiling = base_ttft * (1 + ttft_tol / 100.0)
+        delta = (cur_ttft - base_ttft) / base_ttft
+        if cur_ttft > ceiling:
+            soft.append(
+                f"perf gate [REGRESSION:prefix-ttft] shared-prefix "
+                f"cache-on p50 TTFT {cur_ttft:.1f} ms vs baseline round "
+                f"{base_ttft:.1f} ms (delta {delta:+.2%}, ceiling "
+                f"{ceiling:.1f}, tol {ttft_tol:.0f}%)")
+        else:
+            print(f"perf gate [ok:prefix-ttft-trend] {cur_ttft:.1f} ms "
+                  f"vs baseline {base_ttft:.1f} ms (delta {delta:+.2%})")
     tol = _tol_pct("PERF_GATE_SERVE_TOL_PCT", 30.0)
     base = serve_block(bd) if bd else None
     if tol > 0 and base and base.get("tokens_per_s"):

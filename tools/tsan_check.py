@@ -49,7 +49,8 @@ TSAN_ALLOWLIST = os.path.join(ROOT, "tools", "tsan_allowlist.txt")
 
 #: the concurrent runtimes' own suites, re-run under the sanitizer
 SUITES = {
-    "serving": ["-m", "pytest", "tests/test_serving.py", "-q",
+    "serving": ["-m", "pytest", "tests/test_serving.py",
+                "tests/test_prefix_cache.py", "-q",
                 "-m", "not slow", "-p", "no:cacheprovider"],
     "telemetry": ["-m", "pytest", "tests/test_telemetry_server.py",
                   "tests/test_continuous.py", "-q", "-m", "not slow",
