@@ -796,6 +796,122 @@ def _serve_chunked_block(chunk=16, short_users=4, long_len=96, max_new=20):
             "chunked": run(chunk), "monolithic": run(None)}
 
 
+def _serve_speculative_block(users=6, suffix_len=4, max_new=96, spec_k=6):
+    """Speculative-decoding A/B (ISSUE 15 acceptance): the SAME workload
+    on identical engines, spec-on (n-gram drafting + fused K+1-token
+    verify program) vs spec-off (plain decode). Reports accepted
+    tokens/verify-step, acceptance rate, measured tokens-per-step, and
+    p50/p99 TPOT for both runs; greedy outputs must be token-exact
+    across the two (the `token_exact` proof), and both engines carry
+    the zero-retrace / zero-leak / zero-lost sub-block fields the perf
+    gate hard-checks."""
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import llama_tiny
+    from paddle_tpu.serving import LLMEngine, ServingConfig
+
+    rng = np.random.default_rng(17)
+    base = [int(t) for t in rng.integers(1, 500, size=6)]
+    # template-heavy prompts (the production shape speculation targets):
+    # a repeated boilerplate block + a short unique suffix per user
+    prompts = [base * 2 + [int(t) for t in
+                           rng.integers(1, 500, size=suffix_len)]
+               for _ in range(users)]
+    warm_prompts = [base * 2 + [int(t) for t in
+                                rng.integers(1, 500, size=suffix_len)]
+                    for _ in range(2)]
+
+    def run(k):
+        paddle.seed(0)
+        model = llama_tiny()
+        eng = LLMEngine(model, ServingConfig(
+            page_size=16, num_pages=129, max_batch=users,
+            max_new_tokens=max_new, temperature=0.0, seed=0,
+            prefix_cache=False, spec_k=k))
+        # warm every steady-state signature THROUGH compilation (second
+        # invocation compiles): prefill bucket, decode, and — via the
+        # looping greedy streams — the verify program
+        for wp in warm_prompts:
+            eng.generate(wp, timeout=600)
+            eng.generate(wp, timeout=600)
+        warm = eng.program_stats()
+        sched = eng.scheduler
+        prop0, acc0 = sched.spec_proposed, sched.spec_accepted
+        vsteps0, steps0 = sched.spec_steps, sched.decode_steps
+        stok0, srow0 = sched.step_tokens, sched.step_rows
+
+        results: dict = {}
+        errors: list = []
+
+        def user(uid):
+            try:
+                req = eng.submit(prompts[uid])
+                results[uid] = (req, req.result(timeout=600))
+            except Exception as e:  # noqa: BLE001 — survey, don't die
+                errors.append(repr(e)[:200])
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=user, args=(u,))
+                   for u in range(users)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+        after = eng.program_stats()
+        reqs = [results[u][0] for u in sorted(results)]
+        toks = {u: results[u][1] for u in sorted(results)}
+        gen = sum(len(t) for t in toks.values())
+        proposed = sched.spec_proposed - prop0
+        accepted = sched.spec_accepted - acc0
+        vsteps = sched.spec_steps - vsteps0
+        srows = sched.step_rows - srow0
+        stoks = sched.step_tokens - stok0
+        eng.shutdown(drain=True)
+        blk = {
+            "spec_k": k,
+            "requests_completed": len(results),
+            "requests_failed": len(errors),
+            "tokens_per_s": round(gen / wall, 1) if wall > 0 else 0.0,
+            "wall_s": round(wall, 3),
+            "decode_steps": sched.decode_steps - steps0,
+            "verify_steps": vsteps,
+            "proposed_tokens": proposed,
+            "accepted_tokens": accepted,
+            "acceptance_rate": round(accepted / proposed, 4)
+            if proposed else None,
+            "accepted_tokens_per_verify_step": round(accepted / vsteps, 4)
+            if vsteps else None,
+            "tokens_per_step": round(stoks / srows, 4) if srows else None,
+            "tpot_ms": _serve_pct([g for r in reqs for g in r.tpot_ms]),
+            "e2e_ms": _serve_pct([r.e2e_ms for r in reqs
+                                  if r.e2e_ms is not None]),
+            "pages_leaked": eng.pool.leaked(),
+            "pages_lost": eng.pool.lost(),
+            "decode_program": dict(
+                after["decode"],
+                retraces_after_warmup=after["decode"]["retraces"]
+                - warm["decode"]["retraces"]),
+            "verify_program": dict(
+                after["verify"],
+                retraces_after_warmup=after["verify"]["retraces"]
+                - warm["verify"]["retraces"]),
+            "errors": errors[:5],
+        }
+        return blk, toks
+
+    on, toks_on = run(spec_k)
+    off, toks_off = run(0)
+    return {
+        "users": users, "max_new": max_new, "spec_k": spec_k,
+        "token_exact": toks_on == toks_off,
+        "spec_on": on, "spec_off": off,
+    }
+
+
 def run_serve_bench(dev=None, users=8, total_requests=16, max_new=16):
     """Serving-runtime load generator (ROADMAP item 1 acceptance): N
     concurrent synthetic users drive the continuous-batching engine over
@@ -871,6 +987,7 @@ def run_serve_bench(dev=None, users=8, total_requests=16, max_new=16):
 
     shared = _serve_shared_prefix_block(users=users)
     chunked = _serve_chunked_block()
+    spec = _serve_speculative_block()
     return {
         "users": users,
         "requests_completed": len(done),
@@ -902,6 +1019,10 @@ def run_serve_bench(dev=None, users=8, total_requests=16, max_new=16):
         "chunked_prefill": chunked,
         "prefix_hit_rate": shared["cache_on"]["prefix_hit_rate"],
         "cow_copies": shared["cache_on"]["cow_copies"],
+        # ISSUE 15: speculative-decoding A/B + top-level mirrors
+        "speculative": spec,
+        "spec_acceptance_rate": spec["spec_on"]["acceptance_rate"],
+        "spec_tokens_per_step": spec["spec_on"]["tokens_per_step"],
     }
 
 

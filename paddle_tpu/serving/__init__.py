@@ -21,6 +21,14 @@ path, rebuilt TPU-native):
   a token budget, page-growth with youngest-first eviction (evictees
   requeue with their prefix kept; shared pages survive for their other
   owners), per-request streaming, completion dropping page references.
+* :mod:`.speculative` — speculative decoding: a zero-dependency
+  prompt-lookup **n-gram drafter** (propose up to K tokens from the
+  request's own prompt+generation history — no second model) feeding
+  ONE fused ``to_static`` **verify program** that scores all K+1
+  positions in a single forward over the paged cache, with exact
+  acceptance (greedy = token-identical to ``model.generate``;
+  temperature = Leviathan rejection sampling, distribution-equal) and
+  per-request adaptive K (``ServingConfig(spec_k=, spec_adaptive=)``).
 * :mod:`.engine` — :class:`LLMEngine`: the threaded
   ``submit()/stream()/generate()`` front over ONE compiled decode-step
   program and a bucketed prefill program (both ``to_static``, weights +
@@ -60,12 +68,15 @@ from .prefix_cache import (  # noqa: F401
 from .scheduler import (  # noqa: F401
     Request, Scheduler, RequestRejected, ServingError,
 )
+from .speculative import (  # noqa: F401
+    NgramDrafter, SpecState, verify_tokens,
+)
 from .engine import (  # noqa: F401
     LLMEngine, ServingConfig, DECODE_PROGRAM, PREFILL_PROGRAM,
-    CHUNK_PROGRAM,
+    CHUNK_PROGRAM, VERIFY_PROGRAM,
 )
 from . import (  # noqa: F401
-    kv_cache, model, prefix_cache, scheduler, engine, server,
+    kv_cache, model, prefix_cache, scheduler, speculative, engine, server,
 )
 
 __all__ = [
@@ -74,7 +85,8 @@ __all__ = [
     "ServingModel", "PrefixCache", "chain_keys", "model_fingerprint",
     "Request", "Scheduler",
     "RequestRejected", "ServingError",
+    "NgramDrafter", "SpecState", "verify_tokens",
     "LLMEngine", "ServingConfig", "DECODE_PROGRAM", "PREFILL_PROGRAM",
-    "CHUNK_PROGRAM",
+    "CHUNK_PROGRAM", "VERIFY_PROGRAM",
     "server",
 ]

@@ -46,12 +46,13 @@ from .model import ServingModel
 from .scheduler import Request, Scheduler, ServingError
 
 __all__ = ["ServingConfig", "LLMEngine", "DECODE_PROGRAM",
-           "PREFILL_PROGRAM", "CHUNK_PROGRAM"]
+           "PREFILL_PROGRAM", "CHUNK_PROGRAM", "VERIFY_PROGRAM"]
 
 #: telemetry labels of the compiled programs (paddle_tpu_jit_* counters)
 DECODE_PROGRAM = "serving.decode_step"
 PREFILL_PROGRAM = "serving.prefill"
 CHUNK_PROGRAM = "serving.prefill_chunk"
+VERIFY_PROGRAM = "serving.spec_verify"
 
 _CHUNKS = _obs_counter("paddle_tpu_serving_prefill_chunks_total",
                        "chunked-prefill program runs (incl. cache-hit "
@@ -85,6 +86,14 @@ class ServingConfig:
     #                              (None = monolithic one-shot prefill)
     prefill_budget: int | None = None  # max prefill tokens per engine
     #                              iteration (default: one chunk's worth)
+    spec_k: int = 0              # speculative decoding: max draft tokens
+    #                              per request per step (n-gram prompt-
+    #                              lookup drafting + one fused K+1-token
+    #                              verify program; 0 = off, decode
+    #                              program untouched)
+    spec_adaptive: bool = True   # shrink/grow per-request K on the
+    #                              measured acceptance-rate EWMA (K=0
+    #                              falls back to plain decode)
     dtype: str = "float32"       # KV pool dtype
     seed: int = 0
     donate_state: bool = False   # donate pool/weights into the programs
@@ -134,6 +143,12 @@ class LLMEngine:
             raise ValueError(
                 "prefill_budget only caps CHUNKED prefill — set "
                 "prefill_chunk too (monolithic prefill cannot be budgeted)")
+        if cfg.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {cfg.spec_k}")
+        if cfg.spec_k >= self.max_seq_len:
+            raise ValueError(
+                f"spec_k {cfg.spec_k} >= max_seq_len {self.max_seq_len}: "
+                f"a draft span could never fit a sequence")
         self.prefix_cache = None
         if cfg.prefix_cache:
             from .prefix_cache import PrefixCache, model_fingerprint
@@ -147,7 +162,9 @@ class LLMEngine:
                                    eos_token_id=cfg.eos_token_id,
                                    prefix_cache=self.prefix_cache,
                                    prefill_chunk=cfg.prefill_chunk,
-                                   prefill_budget=cfg.prefill_budget)
+                                   prefill_budget=cfg.prefill_budget,
+                                   spec_k=cfg.spec_k,
+                                   spec_adaptive=cfg.spec_adaptive)
         self.buckets = tuple(sorted(cfg.prefill_buckets)) \
             if cfg.prefill_buckets else _auto_buckets(self.max_seq_len)
         if self.buckets[-1] < self.max_seq_len:
@@ -216,20 +233,47 @@ class LLMEngine:
         self._chunk_sf = to_static(serving_prefill_chunk,
                                    donate_state=self.config.donate_state)
 
+        # speculative verify: ONE program scoring all K+1 positions of a
+        # draft hypothesis per batch row in a single forward. Static
+        # [max_batch, spec_k + 1] shapes; positions / draft lengths /
+        # tables / temps ride as values — like the decode program it
+        # compiles once and never retraces across join/leave/variable
+        # acceptance. Built only when speculation is configured: a
+        # spec_k=0 engine's decode path is byte-identical to before.
+        self._verify_sf = None
+        if self.config.spec_k > 0:
+            import jax.numpy as jnp
+
+            from . import speculative as _spec
+
+            def serving_spec_verify(tokens, positions, dlens, tables,
+                                    temps, key, step):
+                with no_grad():
+                    logits = sm.verify_forward(tokens, positions, dlens,
+                                               tables)
+                out, acc = _spec.verify_tokens(
+                    logits._data, tokens._data[:, 1:], dlens._data,
+                    temps._data, key._data, step._data,
+                    top_k=eng.config.top_k)
+                return Tensor(jnp.concatenate([out, acc[:, None]], axis=1))
+
+            serving_spec_verify.__qualname__ = VERIFY_PROGRAM
+            self._verify_sf = to_static(
+                serving_spec_verify, donate_state=self.config.donate_state)
+
     def _sample(self, logits, temps, key, step):
         """On-device next-token selection: greedy where temp == 0, else
         temperature (+ static top_k) gumbel sampling. logits [N, V],
-        temps [N]; returns int32 [N]."""
+        temps [N]; returns int32 [N]. The scaling/filtering step is
+        shared with the speculative verify acceptance — the spec-on ==
+        spec-off exactness guarantee depends on the two never drifting."""
         import jax
         import jax.numpy as jnp
 
+        from .speculative import scaled_filtered_logits
+
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        arr = logits.astype(jnp.float32) / \
-            jnp.maximum(temps[:, None], 1e-6).astype(jnp.float32)
-        k = self.config.top_k
-        if k is not None and 1 <= k < arr.shape[-1]:
-            kth = jax.lax.top_k(arr, k)[0][:, -1:]
-            arr = jnp.where(arr < kth, -jnp.inf, arr)
+        arr = scaled_filtered_logits(logits, temps, self.config.top_k)
         kk = jax.random.fold_in(key, step.astype(jnp.uint32))
         g = jax.random.gumbel(kk, arr.shape)
         sampled = jnp.argmax(arr + g, axis=-1).astype(jnp.int32)
@@ -323,6 +367,24 @@ class LLMEngine:
                            active=len(self.scheduler.active_requests()),
                            free_pages=self.pool.free_pages)
         return np.asarray(out.numpy())
+
+    def verify(self, tokens, positions, dlens, tables, temps):
+        """One speculative verify step: tokens ``[B, spec_k+1]`` (last
+        emitted token + drafts per row), positions/dlens/temps ``[B]``,
+        tables ``[B, max_pages]``. Returns ``(out_tokens [B, spec_k+1],
+        accepted [B])`` — row ``b`` emits ``out_tokens[b, :accepted[b]+1]``
+        (accepted drafts + one correction/bonus token)."""
+        import paddle_tpu as paddle
+        step = self._step_seq
+        self._step_seq += 1
+        out = self._verify_sf(
+            paddle.to_tensor(tokens), paddle.to_tensor(positions),
+            paddle.to_tensor(dlens), paddle.to_tensor(tables),
+            paddle.to_tensor(temps), self._key_t,
+            paddle.to_tensor(np.int32(step)))
+        self._last_step_wall = time.time()
+        arr = np.asarray(out.numpy())
+        return arr[:, :-1], arr[:, -1]
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -522,7 +584,8 @@ class LLMEngine:
 
         return {"decode": one(DECODE_PROGRAM),
                 "prefill": one(PREFILL_PROGRAM),
-                "chunk": one(CHUNK_PROGRAM)}
+                "chunk": one(CHUNK_PROGRAM),
+                "verify": one(VERIFY_PROGRAM)}
 
     def program_stats(self) -> dict:
         """Trace/compile/retrace counts of THIS engine's two compiled
@@ -553,6 +616,7 @@ class LLMEngine:
                       "total": self.pool.allocatable},
             "prefix_cache": sched.prefix_stats(),
             "prefill_chunks": sched.chunks,
+            "speculative": sched.spec_stats(),
             "programs": self.program_stats(),
         }
 
@@ -589,6 +653,7 @@ class LLMEngine:
             "kv_pages_used": self.pool.used_pages,
             "kv_pages_cached": self.pool.cached_pages,
             "prefix_hit_rate": sched.prefix_hit_rate(),
+            "spec_acceptance_rate": sched.spec_acceptance_rate(),
         }
         return (503 if status == "stalled" else 200), payload
 

@@ -430,21 +430,24 @@ def gather_layer(pool, layer: int, tables):
 
 
 def chunk_attention(q, k_cache, v_cache, start):
-    """Causal attention of one prefill CHUNK against the gathered paged
-    view — the chunked-prefill analog of :func:`reference_paged_attention`
-    (same grouped-einsum math, a block of queries instead of one row).
+    """Causal attention of a query BLOCK against the gathered paged view
+    — the chunked-prefill/speculative-verify analog of
+    :func:`reference_paged_attention` (same grouped-einsum math, a block
+    of queries instead of one row).
 
-    q ``[1, C, H, D]`` (chunk queries at absolute positions
-    ``start + [0..C)``); k/v_cache ``[1, Hkv, T, D]`` gathered from the
-    request's page table AFTER this chunk's KV writes (so the chunk sees
-    itself); ``start`` traced scalar int32. Key position ``j`` is
-    visible to query ``i`` iff ``j <= start + i`` — earlier chunks,
-    cached prefix pages, and the in-chunk causal triangle in one rule;
-    positions past the context (trash/stale pages) are always masked.
-    Padding lanes (``i`` beyond the chunk's valid length) produce
-    garbage outputs that nothing reads, and their KV went to the trash
-    page, so they can never contaminate a real lane. Returns
-    ``[1, C, H, D]``.
+    q ``[B, C, H, D]`` (queries at absolute positions
+    ``start + [0..C)``); k/v_cache ``[B, Hkv, T, D]`` gathered from the
+    page table AFTER this block's KV writes (so the block sees itself);
+    ``start`` traced int32 — a scalar (chunked prefill, B=1) or a
+    per-row ``[B]`` vector (the speculative verify program, one base
+    position per batch slot). Key position ``j`` is visible to query
+    ``i`` iff ``j <= start + i`` — earlier chunks, cached prefix pages,
+    in-flight draft tokens, and the in-block causal triangle in one
+    rule; positions past the context (trash/stale pages) are always
+    masked. Padding lanes (``i`` beyond the block's valid length)
+    produce garbage outputs that nothing reads, and their KV went to
+    the trash page, so they can never contaminate a real lane. Returns
+    ``[B, C, H, D]``.
     """
     import jax
     b, s, h, d = q.shape
@@ -453,9 +456,11 @@ def chunk_attention(q, k_cache, v_cache, start):
     qg = q.reshape(b, s, h_kv, rep, d).astype(jnp.float32)
     logits = jnp.einsum("bsgrd,bgtd->bgrst", qg,
                         k_cache.astype(jnp.float32)) / math.sqrt(d)
-    qpos = jnp.asarray(start, jnp.int32) + jnp.arange(s, dtype=jnp.int32)
-    mask = jnp.arange(t, dtype=jnp.int32)[None, :] <= qpos[:, None]
-    logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
+    qpos = jnp.asarray(start, jnp.int32).reshape(-1, 1) + \
+        jnp.arange(s, dtype=jnp.int32)[None, :]            # [B or 1, C]
+    mask = jnp.arange(t, dtype=jnp.int32)[None, None, :] <= \
+        qpos[:, :, None]                                   # [B|1, C, T]
+    logits = jnp.where(mask[:, None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bgrst,bgtd->bsgrd", probs,
                      v_cache.astype(jnp.float32))

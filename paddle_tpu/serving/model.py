@@ -296,6 +296,90 @@ class ServingModel:
         logits = self._head_normed(y) if fused else self._head(x)
         return Tensor(logits._data[:, 0, :])
 
+    # -- speculative verify --------------------------------------------------
+
+    def verify_forward(self, tokens, positions, draft_len, tables):
+        """One speculative-verify step: K+1 tokens per batch row — the
+        last accepted token plus up to K drafts — scored in a SINGLE
+        forward over the paged pool.
+
+        tokens ``[B, S]`` int32 (``S = K+1`` static; lane 0 = last
+        emitted token, lanes ``1..draft_len`` the drafts, the rest
+        padding), positions ``[B]`` int32 (absolute position of lane 0 —
+        the row's ``cur_len - 1``), draft_len ``[B]`` int32 (valid
+        drafts per row; lanes past ``draft_len`` write to the trash
+        page), tables ``[B, max_pages]`` int32. Draft KV is written
+        speculatively THROUGH the page table (the scheduler has already
+        grown the table and copy-on-written any shared page in the
+        span); attention is :func:`~.kv_cache.chunk_attention` with
+        per-row starts, so lane ``i`` sees everything resident through
+        position ``base + i`` — the draft hypothesis scored causally
+        against the real cache. Returns logits Tensor ``[B, S, vocab]``
+        (lane ``i`` = the distribution at position ``base + i + 1``).
+        All shapes static; per-request variation rides in values — the
+        compiled verify program NEVER retraces.
+        """
+        pool = self.pool
+        ps = pool.page_size
+        base = positions._data.astype(jnp.int32)              # [B]
+        dlen = draft_len._data.astype(jnp.int32)              # [B]
+        tab = tables._data.astype(jnp.int32)                  # [B, P]
+        b, s = int(tokens.shape[0]), int(tokens.shape[1])
+        max_pages = int(tab.shape[1])
+
+        lane = jnp.arange(s, dtype=jnp.int32)[None]           # [1, S]
+        pos = base[:, None] + lane                            # [B, S]
+        valid = lane <= dlen[:, None]
+        pos_c = jnp.clip(pos, 0, self.max_pos - 1)
+        page_idx = jnp.minimum(pos_c // ps, max_pages - 1)
+        w_page = jnp.where(valid, jnp.take_along_axis(tab, page_idx,
+                                                      axis=1),
+                           jnp.int32(kv_cache.TRASH_PAGE))    # [B, S]
+        w_slot = pos_c % ps
+
+        cos_f, sin_f = self._rope_tables()
+        cos = Tensor(cos_f._data[0, pos_c])                   # [B, S, 1, D]
+        sin = Tensor(sin_f._data[0, pos_c])
+
+        layers = list(self.model.layers)
+        fused = self._fused_active()
+        x = self.model.embed_tokens(tokens)
+        hres = x
+        y = layers[0].input_layernorm(x) if fused else None
+        for i, layer in enumerate(layers):
+            h = y if fused else layer.input_layernorm(x)
+            q, k, v = self._qkv(i, layer, h, b, s)
+            q, k = F.rope(q, k, sin, cos)
+            # write_token scatter over the flattened [B*S] lanes: one
+            # (page, slot) per lane, invalid lanes steered to trash
+            kp = kv_cache.write_token(
+                pool.k._data, i, w_page.reshape(-1), w_slot.reshape(-1),
+                k._data.reshape(b * s, self.n_kv, self.head_dim))
+            vp = kv_cache.write_token(
+                pool.v._data, i, w_page.reshape(-1), w_slot.reshape(-1),
+                v._data.reshape(b * s, self.n_kv, self.head_dim))
+            pool.k._data = kp
+            pool.v._data = vp
+            kc = kv_cache.gather_layer(kp, i, tab)
+            vc = kv_cache.gather_layer(vp, i, tab)
+            out = kv_cache.chunk_attention(q._data, kc, vc, base)
+            attn_out = self._linear(
+                "o", i, Tensor(out.reshape(b, s,
+                                           self.n_head * self.head_dim)),
+                layer.self_attn.o_proj)
+            if fused:
+                y, hres = self._junction(attn_out, hres,
+                                         layer.post_attention_layernorm)
+                m = self._mlp(i, layer.mlp, y)
+                nxt = layers[i + 1].input_layernorm if i + 1 < len(layers) \
+                    else self.model.norm
+                y, hres = self._junction(m, hres, nxt)
+            else:
+                x = self._block_tail(i, layer, x, attn_out)
+        h_all = y if fused else x                             # [B, S, H]
+        logits = self._head_normed(h_all) if fused else self._head(h_all)
+        return logits                                         # [B, S, V]
+
     # -- prefill -------------------------------------------------------------
 
     def prefill_forward(self, tokens, prompt_len, table_row):

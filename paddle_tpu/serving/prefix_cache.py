@@ -23,7 +23,12 @@ engine can never claim a float engine's pages.
 **Lifecycle.** The scheduler *inserts* a request's full context pages
 after its prefill completes (pages keep refcount >= 1 while the request
 runs; they move to the pool's reclaimable **cached** state at refcount
-0). On admission the scheduler *claims* the longest cached chain:
+0). Speculative decoding never perturbs the key space: chain hashing
+only ever covers ACCEPTED full context pages — draft tokens are written
+into fresh (or copy-on-written) pages past the keyed prefix, rejected
+drafts are rolled back before any page could complete, and a shared
+page in a draft span is copied first (`Scheduler._make_writable`), so
+equal keys still imply equal resident KV. On admission the scheduler *claims* the longest cached chain:
 :meth:`claim` looks keys up under the cache lock, then
 ``PagePool.claim_prefix`` re-verifies each page still carries exactly
 that key while taking a reference — so a page reclaimed-and-reused

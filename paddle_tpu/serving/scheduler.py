@@ -34,7 +34,19 @@ Policy layer of the serving runtime — no device code here. Each
    (inactive and still-prefilling slots ride along pointed at the trash
    page); sampled tokens stream to per-request callbacks; finished
    requests (eos / ``max_new_tokens`` / context limit) release their
-   page references.
+   page references. With **speculative decoding**
+   (``ServingConfig(spec_k=K)``), the :class:`~.speculative.NgramDrafter`
+   first proposes up to K draft tokens per request from its own
+   prompt+generation history; whenever any request drafted, the batched
+   step runs the single fused VERIFY program instead (scoring all K+1
+   positions in one sweep — rows without drafts ride along at
+   ``draft_len=0`` and still advance exactly one token), draft KV is
+   written speculatively (copy-on-write first: a shared page is never
+   mutated), and rejected-draft pages are **rolled back** — the
+   per-request cursor rewinds to the accepted length and pages that
+   only ever held rejected drafts are freed. Per-request adaptive K
+   (acceptance-rate EWMA) degrades an unpredictable stream to K=0 =
+   the untouched plain decode program.
 
 Requests whose *total* page need exceeds the pool (or whose total length
 exceeds the model/config limit) can never run and are rejected at
@@ -56,6 +68,7 @@ from ..observability import (counter as _obs_counter, gauge as _obs_gauge,
                              histogram as _obs_histogram)
 from ..observability import flight as _flight
 from .kv_cache import PagePoolExhausted
+from .speculative import NgramDrafter, SpecState
 
 __all__ = ["Request", "Scheduler", "RequestRejected", "ServingError",
            "QUEUED", "RUNNING", "COMPLETED", "FAILED", "REJECTED",
@@ -98,10 +111,28 @@ _OCC = _obs_gauge("paddle_tpu_serving_batch_occupancy",
 _TTFT = _obs_histogram("paddle_tpu_serving_ttft_ms",
                        "submit -> first token (ms)", buckets=_MS_BUCKETS)
 _TPOT = _obs_histogram("paddle_tpu_serving_tpot_ms",
-                       "inter-token latency after the first (ms)",
+                       "inter-token latency after the first (ms; a "
+                       "multi-token speculative burst amortizes the "
+                       "step gap over its tokens)",
                        buckets=_MS_BUCKETS)
 _E2E = _obs_histogram("paddle_tpu_serving_e2e_ms",
                       "submit -> completion (ms)", buckets=_MS_BUCKETS)
+_SPEC_PROPOSED = _obs_counter(
+    "paddle_tpu_serving_spec_proposed_tokens_total",
+    "draft tokens proposed to the verify program", windowed=True)
+_SPEC_ACCEPTED = _obs_counter(
+    "paddle_tpu_serving_spec_accepted_tokens_total",
+    "draft tokens accepted by verification", windowed=True)
+_SPEC_REJECTED = _obs_counter(
+    "paddle_tpu_serving_spec_rejected_tokens_total",
+    "draft tokens rejected by verification (KV rolled back)")
+_SPEC_RATE = _obs_gauge(
+    "paddle_tpu_serving_spec_acceptance_rate",
+    "windowed draft acceptance rate (accepted/proposed over the last "
+    "60s of verify steps)")
+_SPEC_K = _obs_gauge(
+    "paddle_tpu_serving_spec_k",
+    "current adaptive draft length K by decode slot")
 
 _arrival = itertools.count()
 
@@ -138,6 +169,10 @@ class Request:
         self.slot: int | None = None
         self.arrival = next(_arrival)
         self.evictions = 0
+        # speculative-decoding state (engine-thread-owned): created at
+        # admission when the engine speculates; survives eviction so a
+        # re-admitted request keeps its learned acceptance EWMA
+        self.spec: SpecState | None = None
         # prefill progress: context tokens whose KV is resident (prefix
         # cache hits count; chunked prefill advances it chunk by chunk)
         self.prefilled = 0
@@ -162,6 +197,17 @@ class Request:
         (re-prefilled wholesale after an eviction)."""
         return self.prompt + self.tokens
 
+    def context_tail(self, n: int) -> list[int]:
+        """Last ``n`` context tokens WITHOUT materializing the full
+        prompt+generation concatenation — the drafter's per-step lookback
+        must stay O(window), not O(context length)."""
+        n = int(n)
+        if n <= 0:
+            return []
+        if len(self.tokens) >= n:
+            return self.tokens[-n:]
+        return self.prompt[-(n - len(self.tokens)):] + self.tokens
+
     def cur_len(self) -> int:
         return len(self.prompt) + len(self.tokens)
 
@@ -174,21 +220,43 @@ class Request:
             self.prefilled >= self._prefill_target
 
     def _emit(self, token: int) -> None:
+        self._emit_burst([token])
+
+    def _emit_burst(self, toks) -> None:
+        """Emit one step's generated token(s). A verify step lands up to
+        K+1 accepted tokens AT ONCE — per-token latency accounting must
+        count TOKENS, not steps: the gap since the previous emission is
+        amortized over the burst (TPOT = time per output token), so the
+        TPOT histograms and tokens_total stay truthful instead of
+        silently understating throughput when speculation lands."""
+        toks = [int(t) for t in toks]
+        if not toks:
+            return
         now = time.monotonic()
-        self.tokens.append(int(token))
         if self.t_first_token is None:
             self.t_first_token = now
             self.ttft_ms = (now - self.t_submit) * 1000.0
             _TTFT.observe(self.ttft_ms)
+            self.tokens.append(toks[0])
+            self._deliver(toks[0])
+            self._t_last = now       # burst tail gaps measure from here
+            rest = toks[1:]
         else:
-            gap = (now - self._t_last) * 1000.0
-            self.tpot_ms.append(gap)
-            _TPOT.observe(gap)
+            rest = toks
+        if rest:
+            gap = (now - self._t_last) * 1000.0 / len(rest)
+            for t in rest:
+                self.tokens.append(t)
+                self.tpot_ms.append(gap)
+                _TPOT.observe(gap)
+                self._deliver(t)
         self._t_last = now
-        self.events.put(("token", int(token)))
+
+    def _deliver(self, token: int) -> None:
+        self.events.put(("token", token))
         if self.on_token is not None:
             try:
-                self.on_token(int(token))
+                self.on_token(token)
             except Exception:
                 pass  # a user callback must never kill the engine loop
 
@@ -240,7 +308,9 @@ class Scheduler:
     def __init__(self, pool, programs, max_batch: int, max_seq_len: int,
                  eos_token_id=None, prefix_cache=None,
                  prefill_chunk: int | None = None,
-                 prefill_budget: int | None = None):
+                 prefill_budget: int | None = None,
+                 spec_k: int = 0, spec_adaptive: bool = True,
+                 drafter=None):
         self.pool = pool
         self.programs = programs
         self.max_batch = int(max_batch)
@@ -251,6 +321,10 @@ class Scheduler:
         self.chunk = int(prefill_chunk) if prefill_chunk else None
         self.prefill_budget = int(prefill_budget) \
             if prefill_budget is not None else self.chunk
+        self.spec_k = int(spec_k)
+        self.spec_adaptive = bool(spec_adaptive)
+        self.drafter = drafter if drafter is not None else \
+            (NgramDrafter() if self.spec_k else None)
         self.lock = _tsan.rlock("serving.Scheduler")
         self.waiting: list[Request] = []      # kept sorted by arrival
         self.slots: list[Request | None] = [None] * self.max_batch
@@ -267,6 +341,17 @@ class Scheduler:
         self.prefill_tokens_computed = 0
         self.cow_copies = 0
         self.chunks = 0
+        # speculative-decoding accounting (under self.lock). step_tokens
+        # / step_rows count (generated tokens, participating rows) over
+        # BOTH decode and verify steps — their ratio is the measured
+        # tokens-per-step-per-request the bench's A/B reports (1.0
+        # exactly without speculation)
+        self.spec_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rejected = 0
+        self.step_tokens = 0
+        self.step_rows = 0
 
     # -- submission ----------------------------------------------------------
 
@@ -342,6 +427,41 @@ class Scheduler:
         stats["hit_rate"] = round(rate, 4) if rate is not None else None
         if self.prefix_cache is not None:
             stats["entries"] = len(self.prefix_cache)
+        return stats
+
+    def spec_acceptance_rate(self):
+        """Cumulative draft acceptance (accepted/proposed), None before
+        any proposal or with speculation off."""
+        with self.lock:
+            if not self.spec_proposed:
+                return None
+            return self.spec_accepted / self.spec_proposed
+
+    def tokens_per_step(self):
+        """Measured generated tokens per (decode|verify) step per
+        participating request — exactly 1.0 without speculation, the
+        speedup multiplier with it. None before any step."""
+        with self.lock:
+            if not self.step_rows:
+                return None
+            return self.step_tokens / self.step_rows
+
+    def spec_stats(self) -> dict:
+        with self.lock:
+            stats = {
+                "enabled": self.spec_k > 0,
+                "spec_k": self.spec_k,
+                "adaptive": self.spec_adaptive,
+                "verify_steps": self.spec_steps,
+                "proposed_tokens": self.spec_proposed,
+                "accepted_tokens": self.spec_accepted,
+                "rejected_tokens": self.spec_rejected,
+            }
+        rate = self.spec_acceptance_rate()
+        stats["acceptance_rate"] = round(rate, 4) if rate is not None \
+            else None
+        tps = self.tokens_per_step()
+        stats["tokens_per_step"] = round(tps, 4) if tps is not None else None
         return stats
 
     # -- the iteration -------------------------------------------------------
@@ -461,6 +581,8 @@ class Scheduler:
                 row[:len(req.pages)] = req.pages
                 self.slots[slot] = req
                 req.state = RUNNING
+                if self.spec_k and req.spec is None:
+                    req.spec = SpecState(self.spec_k, self.spec_adaptive)
                 _ACTIVE.set(len([r for r in self.slots if r is not None]))
             if matched:
                 _flight.record("serving_prefix_hit", request=req.request_id,
@@ -519,6 +641,10 @@ class Scheduler:
             if req.slot is not None:
                 self.tables[req.slot][:] = 0
                 self.slots[req.slot] = None
+                if self.spec_k:
+                    # the vacated slot no longer drafts: a stale K here
+                    # would read as live speculation on an empty slot
+                    _SPEC_K.set(0, slot=str(req.slot))
                 req.slot = None
             req.prefilled = 0
             req._prefill_target = 0
@@ -675,14 +801,79 @@ class Scheduler:
         # the decode write position must be exclusively owned
         return self._make_writable(req, req.cur_len() - 1, 1)
 
+    def _masked_tables(self):
+        """Page-table snapshot for one batched step: empty AND
+        still-prefilling slots ride with an all-zero row — their batched
+        writes land on the trash page and a mid-prefill table never
+        takes a write at position 0. Caller holds the lock."""
+        tables = self.tables.copy()
+        for i, r in enumerate(self.slots):
+            if r is None or not r.prefill_done:
+                tables[i][:] = 0
+        return tables
+
+    def _account_step(self, occ: float, emitted: int, rows: int,
+                      proposed: int = 0, accepted: int = 0,
+                      verify: bool = False) -> None:
+        """Per-iteration accounting shared by the plain decode and
+        speculative verify paths — decode_steps/occupancy plus the
+        tokens-vs-rows ratio (`tokens_per_step`), and the speculative
+        totals when this step ran the verify program."""
+        with self.lock:
+            self.decode_steps += 1
+            self.occupancy_sum += occ
+            self.step_tokens += emitted
+            self.step_rows += rows
+            if verify:
+                self.spec_steps += 1
+                self.spec_proposed += proposed
+                self.spec_accepted += accepted
+                self.spec_rejected += proposed - accepted
+            if _tsan.active():
+                _tsan.note_write(self, "decode_steps", self.lock)
+                _tsan.note_write(self, "occupancy_sum", self.lock)
+        _STEPS.inc()
+        _OCC.set(occ)
+
     def _decode(self) -> bool:
         with self.lock:
             active = [r for r in self.slots
                       if r is not None and r.prefill_done]
         if not active:
             return False
-        for req in list(active):
-            self._ensure_pages(req)
+        drafts = self._propose(active) if self.spec_k else {}
+        ensured = False
+        if any(drafts.values()):
+            # plain decode headroom FIRST for every row (_propose covers
+            # all of `active`), speculative growth after: optional draft
+            # pages must never consume the last free page a neighbor
+            # needs to decode (which would force an eviction
+            # speculation-off would not have caused)
+            for req in list(drafts.keys()):
+                self._ensure_pages(req)
+            ensured = True
+            for req, d in list(drafts.items()):
+                if d and not self._ensure_spec_pages(req, len(d)):
+                    drafts[req] = []
+                    # a failed span alloc wasted this row's proposal:
+                    # feed the EWMA so K backs off under sustained
+                    # memory pressure instead of re-paying the failed
+                    # growth every iteration (the K=0 probe re-enters
+                    # once pressure lifts). NOT on eviction (slot is
+                    # None): a victim's learned acceptance rate says
+                    # nothing about its draft quality and must survive
+                    # re-admission uncorrupted
+                    if req.spec is not None and req.slot is not None:
+                        req.spec.update(len(d), 0)
+                        _SPEC_K.set(req.spec.k, slot=str(req.slot))
+            if any(drafts.values()):
+                return self._spec_decode(drafts)
+            # every draft was dropped: fall through to the plain decode
+            # program rather than paying the (K+1)-wide verify sweep to
+            # advance each row one token
+        if not ensured:
+            for req in list(active):
+                self._ensure_pages(req)
         with self.lock:
             active = [r for r in self.slots
                       if r is not None and r.prefill_done]
@@ -696,27 +887,160 @@ class Scheduler:
                 tokens[req.slot] = req.tokens[-1]
                 positions[req.slot] = req.cur_len() - 1
                 temps[req.slot] = max(req.temperature, 0.0)
-            tables = self.tables.copy()
-            for i, r in enumerate(self.slots):
-                if r is None or not r.prefill_done:
-                    # empty AND still-prefilling slots decode against the
-                    # trash page — a mid-prefill table must not take the
-                    # batched write at position 0
-                    tables[i][:] = 0
+            tables = self._masked_tables()
         out = self.programs.decode(tokens, positions, tables, temps)
-        occ = len(active) / float(self.max_batch)
-        with self.lock:
-            self.decode_steps += 1
-            self.occupancy_sum += occ
-            if _tsan.active():
-                _tsan.note_write(self, "decode_steps", self.lock)
-                _tsan.note_write(self, "occupancy_sum", self.lock)
-        _STEPS.inc()
-        _OCC.set(occ)
+        self._account_step(len(active) / float(self.max_batch),
+                           emitted=len(active), rows=len(active))
         for req in active:
             req._emit(int(out[req.slot]))
             _TOKENS.inc(kind="generated")
             self._maybe_complete(req)
+        return True
+
+    # -- speculative decoding ------------------------------------------------
+
+    def _propose(self, active) -> dict:
+        """Draft up to K tokens per active request from its own history
+        (prompt-lookup n-gram matching — no model, no device work).
+        Per-request adaptive K decides how much to ask for; hard caps
+        keep a fully-accepted burst inside max_new_tokens and
+        max_seq_len. Returns {request: [draft tokens]}."""
+        drafts: dict = {}
+        # a window-bounded drafter only looks at the context tail: hand
+        # it just that (full history for custom drafters without one)
+        window = getattr(self.drafter, "window", None)
+        for req in active:
+            st = req.spec
+            k = st.draft_k() if st is not None else self.spec_k
+            k = min(k, req.max_new_tokens - len(req.tokens) - 1,
+                    self.max_seq_len - req.cur_len() - 1, self.spec_k)
+            if k <= 0:
+                drafts[req] = []
+                continue
+            hist = req.context_tail(window) if window else req.context()
+            # truncate defensively: a custom drafter ignoring the k hint
+            # must not overflow the verify program's static [B, K+1] slab
+            drafts[req] = list(self.drafter.propose(hist, k))[:k]
+        return drafts
+
+    def _ensure_spec_pages(self, req: Request, dlen: int) -> bool:
+        """Grow req's table to hold the speculative span (positions
+        ``cur_len-1 .. cur_len-1+dlen``) and copy-on-write any shared
+        page in it. Speculation must never cost ANOTHER request its
+        slot: on pool exhaustion the span is rolled back and False is
+        returned — the caller drops the drafts and the request decodes
+        plainly (where the normal eviction policy applies)."""
+        if req.slot is None:
+            return False
+        target = self.pool.pages_for(req.cur_len() + dlen)
+        while len(req.pages) < target:
+            try:
+                page = self.pool.alloc(1)[0]
+            except PagePoolExhausted:
+                self._rollback(req)
+                return False
+            with self.lock:
+                if req.slot is None:      # evicted meanwhile
+                    self.pool.free([page])
+                    return False
+                req.pages.append(page)
+                self.tables[req.slot][len(req.pages) - 1] = page
+        return self._make_writable(req, req.cur_len() - 1, dlen + 1)
+
+    def _rollback(self, req: Request) -> None:
+        """Rewind speculative page growth: free pages beyond what the
+        request's ACCEPTED length needs (``pages_for(cur_len)`` keeps
+        the next write position's page). Freed pages were allocated
+        fresh for draft positions — never claimed/shared, never keyed
+        (chain hashing only ever covers accepted full context pages) —
+        so the decref sends them straight back to the free list."""
+        with self.lock:
+            if req.slot is None:
+                return
+            need = self.pool.pages_for(req.cur_len())
+            extra = req.pages[need:]
+            if not extra:
+                return
+            del req.pages[need:]
+            self.tables[req.slot][need:need + len(extra)] = 0
+            self.pool.free(extra)
+        _flight.record("serving_spec_rollback", request=req.request_id,
+                       pages=len(extra))
+
+    def _spec_decode(self, drafts: dict) -> bool:
+        """One speculative engine iteration: write the draft span
+        (COW-guarded), run the fused K+1-token verify program over the
+        whole batch, emit each row's accepted tokens + correction as one
+        burst, roll rejected pages back, and feed the adaptive-K state.
+        Rows that drafted nothing ride along at draft_len=0 (one token,
+        exactly a decode step). ``_decode`` has already secured every
+        row's plain-decode pages and grown/COW'd the surviving draft
+        spans — at least one row still carries drafts here."""
+        with self.lock:
+            active = [r for r in self.slots
+                      if r is not None and r.prefill_done]
+            if not active:
+                return False
+            b, s = self.max_batch, self.spec_k + 1
+            tokens = np.zeros((b, s), np.int32)
+            positions = np.zeros(b, np.int32)
+            dlens = np.zeros(b, np.int32)
+            temps = np.zeros(b, np.float32)
+            for req in active:
+                d = drafts.get(req) or []
+                tokens[req.slot, 0] = req.tokens[-1]
+                tokens[req.slot, 1:1 + len(d)] = d
+                positions[req.slot] = req.cur_len() - 1
+                dlens[req.slot] = len(d)
+                temps[req.slot] = max(req.temperature, 0.0)
+            tables = self._masked_tables()
+            n_prop = int(dlens.sum())
+        _flight.record("serving_spec_propose", rows=len(active),
+                       proposed=n_prop)
+        out, acc = self.programs.verify(tokens, positions, dlens, tables,
+                                        temps)
+        occ = len(active) / float(self.max_batch)
+        n_acc = n_emit = 0
+        for req in active:
+            a = int(acc[req.slot])
+            d_n = int(dlens[req.slot])
+            emitted = [int(t) for t in out[req.slot, :a + 1]]
+            # the burst must stop exactly where sequential decode would
+            emitted = emitted[:req.max_new_tokens - len(req.tokens)]
+            if req.eos_token_id is not None and req.eos_token_id in emitted:
+                emitted = emitted[:emitted.index(req.eos_token_id) + 1]
+            req._emit_burst(emitted)
+            _TOKENS.inc(len(emitted), kind="generated")
+            n_acc += a
+            n_emit += len(emitted)
+            st = req.spec
+            if st is not None and d_n:
+                st.update(d_n, a)
+            if st is not None and req.slot is not None:
+                # every step, not just drafting ones: the gauge must
+                # track adaptive K falling to 0 (and _release zeroes it
+                # when the slot empties)
+                _SPEC_K.set(st.k, slot=str(req.slot))
+            self._rollback(req)
+            self._maybe_complete(req)
+        self._account_step(occ, emitted=n_emit, rows=len(active),
+                           proposed=n_prop, accepted=n_acc, verify=True)
+        if n_prop:
+            _SPEC_PROPOSED.inc(n_prop)
+        if n_acc:
+            _SPEC_ACCEPTED.inc(n_acc)
+        if n_prop - n_acc:
+            _SPEC_REJECTED.inc(n_prop - n_acc)
+        # windowed deltas, not rate()/rate(): the two counters snapshot
+        # their window bases on independent ticks, so a ratio of rates
+        # (each divided by its OWN elapsed) can read > 1; clamp for the
+        # residual base-tick skew
+        prop_delta = _SPEC_PROPOSED.delta(60.0)
+        if prop_delta > 0:
+            _SPEC_RATE.set(round(
+                min(1.0, _SPEC_ACCEPTED.delta(60.0) / prop_delta), 4))
+        _flight.record("serving_spec_verify", accepted=n_acc,
+                       rejected=n_prop - n_acc, emitted=n_emit)
         return True
 
     # -- shutdown ------------------------------------------------------------

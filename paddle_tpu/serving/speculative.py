@@ -1,0 +1,251 @@
+"""Speculative decoding: prompt-lookup n-gram drafting + exact K-token
+verification in ONE forward over the paged KV cache.
+
+The decode program is memory-bound (the graph analyzer's GA109 intensity
+rule and the mmha kernel both say so): every engine iteration pays a full
+weight + KV HBM sweep to advance each request by exactly ONE token.
+Speculative decoding converts that same sweep into *several* accepted
+tokens:
+
+1. **Draft** (:class:`NgramDrafter`, host side, zero extra HBM): propose
+   up to K continuation tokens by matching the request's recent suffix
+   n-gram against its OWN prompt + generation history (prompt-lookup
+   decoding — no second model). Production traffic is full of copyable
+   structure (quoted context, code, templated answers, greedy loops), so
+   a trivial matcher lands a useful fraction of drafts.
+2. **Verify** (the ``serving.spec_verify`` compiled program): score all
+   K+1 positions — the last accepted token plus the K drafts — in a
+   SINGLE forward over the paged cache. Draft KV is written
+   speculatively through the page table, attention uses the
+   chunk_attention-style per-row causal rule (key ``j`` visible to
+   query ``i`` iff ``j <= base + i``), and the program keeps the decode
+   program's guarantee discipline: static ``[max_batch, K+1]`` shapes,
+   positions/tables/draft lengths traced as VALUES — it compiles once
+   and never retraces across join/leave/variable acceptance.
+3. **Accept** (:func:`verify_tokens`, traced into the verify program):
+   greedy mode accepts a draft iff it equals the target argmax — the
+   emitted stream is token-identical to ``model.generate`` *by
+   construction*. Temperature mode uses Leviathan-style rejection
+   sampling against the deterministic (point-mass) draft distribution:
+   draft ``d`` at position ``i`` is accepted with probability
+   ``p_i(d)``; on rejection the replacement is sampled from the
+   residual ``p_i`` with ``d`` zeroed out and renormalized, and when
+   every draft survives one bonus token is sampled from ``p_K`` — the
+   output distribution equals the target model's exactly (the
+   distribution-equivalence test is chi-squared, not eyeballed).
+4. **Roll back**: the scheduler rewinds the per-request position cursor
+   to the accepted length and frees pages that only ever held rejected
+   drafts. Rejected positions hold stale KV but are masked by position
+   everywhere and overwritten before the cursor ever passes them —
+   exactly the trash-page discipline the paged pool already lives by.
+
+:class:`SpecState` adapts K per request on a measured acceptance-rate
+EWMA so an adversarial (unpredictable) stream degrades to plain decode
+(K=0 → the untouched decode program) instead of paying verify sweeps
+for rejected drafts; a periodic 1-token probe lets a stream that turns
+predictable later re-enter speculation.
+"""
+
+from __future__ import annotations
+
+__all__ = ["NgramDrafter", "SpecState", "verify_tokens"]
+
+
+def scaled_filtered_logits(logits, temps, top_k=None):
+    """Temperature scaling + static top-k filtering — THE logits
+    pipeline the decode sampler (``LLMEngine._sample``) and the verify
+    acceptance (:func:`verify_tokens`) share. The spec-on == spec-off
+    exactness guarantee holds only while both apply byte-identical
+    filtering, so it lives in exactly one place. ``logits [..., V]``;
+    ``temps`` must broadcast against the leading dims (pass ``temps``
+    for ``[N, V]`` logits, ``temps[:, None]`` for ``[B, S, V]``).
+    Returns filtered f32 logits (softmax-ready)."""
+    import jax
+    import jax.numpy as jnp
+
+    arr = logits.astype(jnp.float32) / \
+        jnp.maximum(temps, 1e-6).astype(jnp.float32)[..., None]
+    v = arr.shape[-1]
+    if top_k is not None and 1 <= top_k < v:
+        kth = jax.lax.top_k(arr, top_k)[0][..., -1:]
+        arr = jnp.where(arr < kth, -jnp.inf, arr)
+    return arr
+
+
+class NgramDrafter:
+    """Prompt-lookup drafter: longest-suffix n-gram match over the
+    request's own token history.
+
+    ``propose(history, k)`` finds the most recent earlier occurrence of
+    the history's trailing ``n``-gram (longest ``n`` first) and returns
+    the up-to-``k`` tokens that followed it. Pure host-side list work —
+    no model, no device memory; the verifier makes any proposal safe, so
+    the drafter only has to be *cheap* and *often right*.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 window: int = 512):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"min={min_ngram} max={max_ngram}")
+        if window <= max_ngram:
+            raise ValueError(
+                f"window {window} must exceed max_ngram {max_ngram}")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        # match lookback bound: drafting runs on the engine thread every
+        # iteration, so its cost must not grow with context length — an
+        # O(max_ngram * window) scan instead of O(max_ngram * L)
+        self.window = int(window)
+
+    def propose(self, history, k: int) -> list:
+        k = int(k)
+        hist = history if isinstance(history, list) else list(history)
+        hist = hist[-self.window:]
+        n_hi = min(self.max_ngram, len(hist) - 1)
+        if k <= 0 or n_hi < self.min_ngram:
+            return []
+        for n in range(n_hi, self.min_ngram - 1, -1):
+            suffix = hist[-n:]
+            # newest match first: a loop the generation just entered
+            # beats a stale prompt occurrence
+            for st in range(len(hist) - n - 1, -1, -1):
+                if hist[st:st + n] == suffix:
+                    cont = hist[st + n:st + n + k]
+                    if cont:
+                        return [int(t) for t in cont]
+        return []
+
+
+class SpecState:
+    """Per-request adaptive draft length K.
+
+    Tracks an acceptance-rate EWMA over verify outcomes; K shrinks by
+    one while the EWMA sits below ``shrink_below`` (reaching 0 = plain
+    decode for this request) and grows back toward ``k_max`` while it
+    sits above ``grow_above``. At K=0 no drafts are proposed — except a
+    single-token PROBE every ``probe_every`` draft opportunities, so a
+    stream that becomes predictable can climb back in. ``adaptive=False``
+    pins K at ``k_max``. Engine-thread-only state (one scheduler owns
+    each request): no lock needed.
+    """
+
+    def __init__(self, k_max: int, adaptive: bool = True,
+                 shrink_below: float = 0.35, grow_above: float = 0.65,
+                 alpha: float = 0.35, probe_every: int = 16):
+        self.k_max = int(k_max)
+        self.k = int(k_max)
+        self.adaptive = bool(adaptive)
+        self.shrink_below = float(shrink_below)
+        self.grow_above = float(grow_above)
+        self.alpha = float(alpha)
+        self.probe_every = int(probe_every)
+        self.ewma = 0.5          # neutral prior: neither shrink nor grow
+        self.idle = 0            # draft opportunities spent at k == 0
+        self.proposed_total = 0
+        self.accepted_total = 0
+
+    def draft_k(self) -> int:
+        """Tokens the drafter may propose this step (0 = skip)."""
+        if not self.adaptive:
+            return self.k_max
+        if self.k == 0:
+            self.idle += 1
+            if self.idle >= self.probe_every:
+                self.idle = 0
+                return 1         # probe: one cheap draft re-tests the stream
+            return 0
+        return self.k
+
+    def update(self, proposed: int, accepted: int) -> None:
+        """Fold one verify outcome into the EWMA and move K."""
+        if proposed <= 0:
+            return
+        self.proposed_total += int(proposed)
+        self.accepted_total += int(accepted)
+        rate = accepted / proposed
+        self.ewma = (1.0 - self.alpha) * self.ewma + self.alpha * rate
+        if not self.adaptive:
+            return
+        if self.ewma < self.shrink_below:
+            self.k = max(0, self.k - 1)
+        elif self.ewma > self.grow_above:
+            self.k = min(self.k_max, self.k + 1)
+
+    def acceptance_rate(self):
+        if not self.proposed_total:
+            return None
+        return self.accepted_total / self.proposed_total
+
+
+def verify_tokens(logits, drafts, draft_len, temps, key, step, top_k=None):
+    """Exact acceptance over one verify forward (pure jnp; traced inside
+    the ``serving.spec_verify`` program).
+
+    logits ``[B, S, V]`` — target logits at positions ``base .. base+K``
+    (``S = K+1``); ``logits[:, i]`` is the distribution of the token AT
+    position ``base+i+1``. drafts ``[B, K]`` int32 (proposed tokens,
+    lane ``i`` is the candidate for position ``base+i+1``), draft_len
+    ``[B]`` int32 (valid drafts per row, 0 = plain single-token decode
+    for that row), temps ``[B]`` float32 (0 = greedy), key/step the
+    engine's sampling PRNG state, ``top_k`` the engine's STATIC sampling
+    filter (compiled in, same as the decode program's).
+
+    Returns ``(out_tokens [B, S] int32, accepted [B] int32)``:
+    ``accepted[b] = a`` drafts survived and ``out_tokens[b, :a+1]`` are
+    the tokens to emit — the ``a`` accepted drafts followed by one
+    correction/bonus token from the target distribution. Greedy rows
+    accept a draft iff it equals the raw-logits argmax (token-identical
+    to sequential greedy decode); temperature rows use Leviathan
+    rejection sampling against the point-mass draft distribution, so
+    each emitted token is distributed exactly as the target model's.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, s, v = logits.shape
+    kdr = s - 1
+    drafts = drafts.astype(jnp.int32)
+    draft_len = draft_len.astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, S]
+    arr = scaled_filtered_logits(logits, temps[:, None], top_k)
+    p = jax.nn.softmax(arr, axis=-1)                             # [B, S, V]
+
+    kk = jax.random.fold_in(key, step.astype(jnp.uint32))
+    # acceptance: greedy rows match the argmax; temperature rows accept
+    # draft d at position i with probability p_i(d)
+    u = jax.random.uniform(jax.random.fold_in(kk, 1), (b, kdr))
+    p_draft = jnp.take_along_axis(p[:, :kdr], drafts[..., None],
+                                  axis=-1)[..., 0]               # [B, K]
+    accept = jnp.where(temps[:, None] > 0, u < p_draft,
+                       drafts == greedy[:, :kdr])
+    lane = jnp.arange(kdr, dtype=jnp.int32)[None]
+    accept = accept & (lane < draft_len[:, None])
+    # accepted count = length of the leading all-True run
+    acc = jnp.cumprod(accept.astype(jnp.int32), axis=1) \
+        .sum(axis=1).astype(jnp.int32)                           # [B]
+
+    # correction/bonus token from position `acc`'s target distribution;
+    # on a rejection (acc < draft_len) the rejected draft is zeroed out
+    # of the residual so the combined emit distribution equals p exactly
+    p_a = jnp.take_along_axis(p, acc[:, None, None], axis=1)[:, 0]
+    greedy_a = jnp.take_along_axis(greedy, acc[:, None], axis=1)[:, 0]
+    d_pad = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)          # [B, S]
+    d_a = jnp.take_along_axis(d_pad, acc[:, None], axis=1)[:, 0]
+    rejected = acc < draft_len
+    vocab = jnp.arange(v, dtype=jnp.int32)[None]
+    residual = jnp.where(rejected[:, None] & (vocab == d_a[:, None]),
+                         0.0, p_a)
+    sampled = jax.random.categorical(
+        jax.random.fold_in(kk, 2),
+        jnp.where(residual > 0, jnp.log(residual), -jnp.inf),
+        axis=-1).astype(jnp.int32)
+    corr = jnp.where(temps > 0, sampled, greedy_a).astype(jnp.int32)
+
+    lane_s = jnp.arange(s, dtype=jnp.int32)[None]
+    out = jnp.where(lane_s < acc[:, None], d_pad,
+                    jnp.where(lane_s == acc[:, None], corr[:, None],
+                              jnp.int32(0)))
+    return out.astype(jnp.int32), acc

@@ -194,3 +194,29 @@ def test_default_registry_render_is_grammar_clean():
     grammar-clean."""
     from paddle_tpu.observability import get_registry
     validate_exposition(render_prometheus(get_registry()))
+
+
+def test_speculative_serving_families_render_grammar_clean():
+    """ISSUE 15 satellite: the speculative-decoding metric families —
+    counters (one windowed), the acceptance-rate gauge, and the
+    slot-labeled per-request K gauge — render parser-valid exposition."""
+    import paddle_tpu.serving  # noqa: F401 — registers the families
+    from paddle_tpu.observability import get_registry
+    reg = get_registry()
+    reg.get("paddle_tpu_serving_spec_proposed_tokens_total").inc(5)
+    reg.get("paddle_tpu_serving_spec_accepted_tokens_total").inc(3)
+    reg.get("paddle_tpu_serving_spec_rejected_tokens_total").inc(2)
+    reg.get("paddle_tpu_serving_spec_acceptance_rate").set(0.6)
+    reg.get("paddle_tpu_serving_spec_k").set(4, slot="0")
+    reg.get("paddle_tpu_serving_spec_k").set(0, slot="1")
+    metrics = validate_exposition(render_prometheus(reg))
+    for fam in ("paddle_tpu_serving_spec_proposed_tokens_total",
+                "paddle_tpu_serving_spec_accepted_tokens_total",
+                "paddle_tpu_serving_spec_rejected_tokens_total",
+                "paddle_tpu_serving_spec_acceptance_rate",
+                "paddle_tpu_serving_spec_k"):
+        assert fam in metrics, fam
+        assert metrics[fam]["type"] in ("counter", "gauge")
+    slots = {lbl.get("slot") for _, lbl, _ in
+             metrics["paddle_tpu_serving_spec_k"]["samples"]}
+    assert {"0", "1"} <= slots

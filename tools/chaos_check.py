@@ -23,11 +23,16 @@ Profiles (each compared against the same fault-free reference trajectory):
                   preempt_exit
   serving-sigterm SIGTERM mid-stream into the serving engine WITH
                   prefix-cache page sharing live (a refcount-2 KV page
-                  at signal time): in-flight requests drain or cleanly
-                  error, exit 143, ZERO KV pages leaked or lost
-                  (refcount-aware pool accounting asserted). Flight
-                  dump: reason serving_preempted, final events
-                  serving_preempt ... serving_drain
+                  at signal time) AND speculation mid-flight (>= 1
+                  draft proposed to the verify program before the
+                  signal): in-flight requests drain or cleanly error,
+                  exit 143, ZERO KV pages leaked or lost (refcount-
+                  aware pool accounting asserted — speculative page
+                  growth must roll back through the preemption path
+                  too). Flight dump: reason serving_preempted, final
+                  events serving_preempt ... serving_drain, with the
+                  serving_spec_propose ... serving_spec_verify pair in
+                  order on the tape
 
 Exit status: 0 when every profile holds, 1 otherwise. Fast (CPU, a
 4-parameter model, eager steps) — wired into tier-1 via
@@ -277,13 +282,16 @@ def profile_sigterm_at_step(steps, ref):
 
 def profile_serving_sigterm(steps, ref):
     """SIGTERM mid-stream into the serving engine — with prefix-cache
-    page sharing LIVE at signal time: two in-flight requests hold the
-    same physical KV pages (refcount 2) when the signal lands. Requests
-    must drain (or cleanly error), the process must leave a schema-valid
-    flight dump with the serving events, exit relaunchable 143 — and the
-    refcount-aware pool accounting must show ZERO leaked pages (refcount
-    >= 1) AND zero LOST pages after the drain. ``ref`` (the training
-    trajectory) is unused: serving has no weights to resume."""
+    page sharing LIVE at signal time (two in-flight requests hold the
+    same physical KV pages, refcount 2) AND speculation engaged (the
+    n-gram drafter has proposed >= 1 draft to the verify program before
+    the signal lands). Requests must drain (or cleanly error), the
+    process must leave a schema-valid flight dump with the serving AND
+    speculative events, exit relaunchable 143 — and the refcount-aware
+    pool accounting must show ZERO leaked pages (refcount >= 1) AND
+    zero LOST pages after the drain: speculative page growth rolls back
+    through the preemption path too. ``ref`` (the training trajectory)
+    is unused: serving has no weights to resume."""
     import signal
     import time
 
@@ -298,19 +306,25 @@ def profile_serving_sigterm(steps, ref):
                            num_kv_heads=1, intermediate_size=64)
         eng = LLMEngine(model, ServingConfig(
             page_size=8, num_pages=17, max_batch=2, max_new_tokens=24,
-            drain_timeout_s=60.0))
+            drain_timeout_s=60.0, spec_k=3))
         eng.install_preemption()
         try:
             # a common 8-token prefix (one full page) shared by both
             # requests: the second admission claims the first's LIVE
-            # page, so a refcount-2 page exists while both stream
+            # page, so a refcount-2 page exists while both stream; the
+            # repetitive prompts also feed the n-gram drafter, so the
+            # verify program is mid-flight when the signal lands
             common = [1, 2, 3, 4, 5, 6, 7, 8]
-            reqs = [eng.submit(common + [9, 10]),
-                    eng.submit(common + [11, 12])]
+            reqs = [eng.submit(common + [1, 2]),
+                    eng.submit(common + [2, 3])]
+            sched = eng.scheduler
             deadline = time.monotonic() + 60
-            while any(len(r.tokens) < 2 for r in reqs):  # mid-stream
+            while any(len(r.tokens) < 2 for r in reqs) or \
+                    sched.spec_proposed < 1:     # mid-stream + mid-spec
                 if time.monotonic() > deadline:
-                    return "requests never started streaming"
+                    return "requests never reached streaming with >= 1 " \
+                           "in-flight draft (spec_proposed=" \
+                           f"{sched.spec_proposed})"
                 time.sleep(0.005)
             if eng.pool.shared_pages < 1:
                 return "no shared KV page live at signal time (the " \
@@ -336,8 +350,20 @@ def profile_serving_sigterm(steps, ref):
         if eng.pool.lost():
             return f"{eng.pool.lost()} KV page(s) lost (in no pool " \
                    f"state) after drain"
+        # wider window than the training profiles: the drain keeps
+        # speculating, so spec propose/verify pairs land between the
+        # preempt and the drain summary
         err = _validate_flight_dump(
-            d, "serving_preempted", ["serving_preempt", "serving_drain"])
+            d, "serving_preempted", ["serving_preempt", "serving_drain"],
+            window=64)
+        if err:
+            return err
+        # the speculative events must be on the tape, in order: a
+        # propose followed by its verify (the drain keeps speculating,
+        # so they sit near the end of the ring)
+        err = _validate_flight_dump(
+            d, "serving_preempted",
+            ["serving_spec_propose", "serving_spec_verify"], window=64)
         if err:
             return err
     return None

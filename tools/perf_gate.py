@@ -41,13 +41,16 @@ peak-HBM failures print the top-3 MEASURED fusion targets
 
 The serving runtime (``extra.serve``, from `bench.py serve` or the full
 run) adds three HARD gates, checked in EVERY serve sub-block (the
-independent workload, shared-prefix cache-on/off, chunked/monolithic):
-any decode-program retrace after warmup, any leaked KV page (refcount
->= 1 after drain), and any LOST page (refcount accounting dropped it)
-fail the round — plus soft serve-tokens/s (PERF_GATE_SERVE_TOL_PCT,
-default 30%) and shared-prefix cache-on p50 TTFT comparisons
-(PERF_GATE_PREFIX_TTFT_TOL_PCT, default 25%: within-round vs cache-off
-AND against the baseline round).
+independent workload, shared-prefix cache-on/off, chunked/monolithic,
+speculative spec-on/spec-off): any decode- OR verify-program retrace
+after warmup, any leaked KV page (refcount >= 1 after drain), and any
+LOST page (refcount accounting dropped it) fail the round — plus soft
+serve-tokens/s (PERF_GATE_SERVE_TOL_PCT, default 30%), shared-prefix
+cache-on p50 TTFT comparisons (PERF_GATE_PREFIX_TTFT_TOL_PCT, default
+25%: within-round vs cache-off AND against the baseline round), and the
+speculative A/B's spec-on p50 TPOT vs spec-off within-round
+(PERF_GATE_SPEC_TPOT_TOL_PCT, default 25% — speculation that costs
+latency on its own workload is a regression).
 
 The mega-kernel harvest (``extra.fusion_targets``) adds a soft gate: the
 top remaining (not ``fused``) target's est_saved_bytes must stay below
@@ -347,7 +350,8 @@ def serve_block(d):
 def serve_subblocks(cur):
     """Every serving sub-run carrying its own zero-retrace / zero-leak
     proof: the independent-prompts block itself, the shared-prefix
-    cache-on/off runs, and the chunked-prefill probe's two engines."""
+    cache-on/off runs, the chunked-prefill probe's two engines, and the
+    speculative A/B's spec-on/spec-off engines."""
     blocks = [("serve", cur)]
     sp = cur.get("shared_prefix") or {}
     for k in ("cache_on", "cache_off"):
@@ -357,6 +361,10 @@ def serve_subblocks(cur):
     for k in ("chunked", "monolithic"):
         if isinstance(cp.get(k), dict):
             blocks.append((f"serve.chunked_prefill.{k}", cp[k]))
+    sd = cur.get("speculative") or {}
+    for k in ("spec_on", "spec_off"):
+        if isinstance(sd.get(k), dict):
+            blocks.append((f"serve.speculative.{k}", sd[k]))
     return blocks
 
 
@@ -391,15 +399,16 @@ def serve_gates(cd, bd):
         return [], []
     hard, soft = [], []
     for name, blk in serve_subblocks(cur):
-        dec = blk.get("decode_program") or {}
-        retr = dec.get("retraces_after_warmup")
-        if retr:
-            hard.append(
-                f"perf gate [SERVE-RETRACE] {name}: decode program "
-                f"retraced {int(retr)}x after warmup while requests "
-                f"joined/left/grew: the paged-KV static-shape contract is "
-                f"broken (compiles={dec.get('compiles')}, see "
-                f"paddle_tpu/serving/kv_cache.py)")
+        for prog in ("decode", "verify"):
+            dec = blk.get(f"{prog}_program") or {}
+            retr = dec.get("retraces_after_warmup")
+            if retr:
+                hard.append(
+                    f"perf gate [SERVE-RETRACE] {name}: {prog} program "
+                    f"retraced {int(retr)}x after warmup while requests "
+                    f"joined/left/grew: the paged-KV static-shape contract "
+                    f"is broken (compiles={dec.get('compiles')}, see "
+                    f"paddle_tpu/serving/kv_cache.py)")
         leaked = blk.get("pages_leaked")
         if leaked:
             hard.append(
@@ -448,6 +457,31 @@ def serve_gates(cd, bd):
         else:
             print(f"perf gate [ok:prefix-ttft-trend] {cur_ttft:.1f} ms "
                   f"vs baseline {base_ttft:.1f} ms (delta {delta:+.2%})")
+    # speculative A/B: spec-on p50 TPOT must not exceed spec-off on the
+    # same workload — speculation that costs latency is a regression of
+    # the very thing it exists to buy
+    spec_tol = _tol_pct("PERF_GATE_SPEC_TPOT_TOL_PCT", 25.0)
+    sd = cur.get("speculative") or {}
+    try:
+        on_tpot = float(sd["spec_on"]["tpot_ms"]["p50"])
+        off_tpot = float(sd["spec_off"]["tpot_ms"]["p50"])
+    except (KeyError, TypeError, ValueError):
+        on_tpot = off_tpot = None
+    if spec_tol > 0 and on_tpot is not None and off_tpot and off_tpot > 0:
+        ceiling = off_tpot * (1 + spec_tol / 100.0)
+        delta = (on_tpot - off_tpot) / off_tpot
+        if on_tpot > ceiling:
+            soft.append(
+                f"perf gate [REGRESSION:spec-tpot] speculative p50 TPOT "
+                f"{on_tpot:.2f} ms spec-on vs {off_tpot:.2f} ms spec-off "
+                f"(delta {delta:+.2%}, ceiling {ceiling:.2f}, tol "
+                f"{spec_tol:.0f}% via PERF_GATE_SPEC_TPOT_TOL_PCT): "
+                f"speculation is costing latency on its own workload")
+        else:
+            print(f"perf gate [ok:spec-tpot] p50 TPOT {on_tpot:.2f} ms "
+                  f"spec-on vs {off_tpot:.2f} ms spec-off "
+                  f"(delta {delta:+.2%}, tokens/step "
+                  f"{sd.get('spec_on', {}).get('tokens_per_step')})")
     tol = _tol_pct("PERF_GATE_SERVE_TOL_PCT", 30.0)
     base = serve_block(bd) if bd else None
     if tol > 0 and base and base.get("tokens_per_s"):
