@@ -912,6 +912,153 @@ def _serve_speculative_block(users=6, suffix_len=4, max_new=96, spec_k=6):
     }
 
 
+def _serve_tracing_block(users=6, max_new=12):
+    """Request-tracing probe (ISSUE 16 acceptance): the serve workload
+    under tracing. Proves (1) every completed request carries a root
+    span with >=4 distinct child span kinds and span coverage >=90% of
+    its e2e wall, (2) the tracer's measured self-cost stays <1% of the
+    workload wall (PERF_GATE_TRACE_TOL_PCT soft-gates it), (3) the live
+    ``/requests`` and ``/trace/<id>`` endpoints serve parser-valid JSON
+    mid-run, (4) greedy outputs are token-exact tracing-on vs -off, and
+    (5) tracing flips none of the zero-retrace / zero-leak / zero-lost
+    invariants (perf_gate reads this block as a serve sub-block)."""
+    import json as _json
+    import threading
+    import urllib.request
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import llama_tiny
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.observability.continuous.server import TelemetryServer
+    from paddle_tpu.serving import LLMEngine, ServingConfig
+
+    rng = np.random.default_rng(23)
+    prompt_lens = [12, 28]
+    prompts = [[int(t) for t in
+                rng.integers(1, 500, size=prompt_lens[u % 2])]
+               for u in range(users)]
+    warm_prompts = [[int(t) for t in rng.integers(1, 500, size=n)]
+                    for n in prompt_lens]
+    tracer = tracing.get_tracer()
+    was_enabled = tracer.enabled
+
+    def run(trace_on, probe_endpoints=False):
+        tracer.enabled = trace_on
+        paddle.seed(0)
+        model = llama_tiny()
+        eng = LLMEngine(model, ServingConfig(
+            page_size=16, num_pages=129, max_batch=users,
+            max_new_tokens=max_new, temperature=0.0, seed=0))
+        for wp in warm_prompts:
+            eng.generate(wp, timeout=600)
+            eng.generate(wp, timeout=600)
+        warm = eng.program_stats()
+        st0 = tracer.stats()
+        results: dict = {}
+        errors: list = []
+        endpoints = None
+        srv = TelemetryServer(port=0).start() if probe_endpoints else None
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}", timeout=30) as r:
+                return r.status, _json.loads(r.read().decode())
+
+        def user(uid):
+            try:
+                req = eng.submit(prompts[uid])
+                results[uid] = (req, req.result(timeout=600))
+            except Exception as e:  # noqa: BLE001 — survey, don't die
+                errors.append(repr(e)[:200])
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=user, args=(u,))
+                   for u in range(users)]
+        for t in threads:
+            t.start()
+        if probe_endpoints:
+            endpoints = {"requests_ok": False, "trace_ok": False}
+            try:
+                # mid-run scrape: the endpoint must serve DURING a live run
+                code, body = fetch("/requests")
+                endpoints["requests_ok"] = (
+                    code == 200 and isinstance(body.get("requests"), list))
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"/requests probe: {e!r}"[:200])
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        st1 = tracer.stats()
+        after = eng.program_stats()
+        reqs = [results[u][0] for u in sorted(results)]
+        if probe_endpoints:
+            try:
+                tid = reqs[0].trace.trace_id
+                code, body = fetch(f"/trace/{tid}")
+                endpoints["trace_ok"] = (code == 200 and
+                                         body.get("trace_id") == tid)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"/trace probe: {e!r}"[:200])
+            srv.close()
+        eng.shutdown(drain=True)
+        toks = {u: results[u][1] for u in sorted(results)}
+        gen = sum(len(t) for t in toks.values())
+
+        covs, kind_counts = [], []
+        slowest = None
+        for req in reqs:
+            snap = tracing.get_trace(req.trace.trace_id) or {}
+            rec = snap.get("record") or {}
+            covs.append(float(rec.get("span_coverage") or 0.0))
+            kind_counts.append(len(rec.get("span_kinds") or ()))
+            if slowest is None or (rec.get("e2e_ms") or 0.0) > \
+                    (slowest.get("e2e_ms") or 0.0):
+                slowest = {k: rec.get(k) for k in (
+                    "trace_id", "request_id", "e2e_ms", "ttft_ms",
+                    "queue_ms", "prefill_ms", "decode_ms",
+                    "span_coverage", "span_kinds", "spans")}
+        cost_s = st1["cost_s"] - st0["cost_s"]
+        spans = st1["spans_total"] - st0["spans_total"]
+        blk = {
+            "requests_completed": len(results),
+            "requests_failed": len(errors),
+            "tokens_per_s": round(gen / wall, 1) if wall > 0 else 0.0,
+            "wall_s": round(wall, 3),
+            "spans_recorded": spans,
+            "span_cost_us": round(cost_s / spans * 1e6, 3) if spans else 0.0,
+            "overhead_pct": round(100.0 * cost_s / wall, 4)
+            if wall > 0 else 0.0,
+            "coverage": {
+                "mean": round(sum(covs) / len(covs), 4) if covs else None,
+                "min": round(min(covs), 4) if covs else None,
+                "frac_ge_90": round(
+                    sum(1 for c in covs if c >= 0.9) / len(covs), 4)
+                if covs else None,
+            },
+            "min_child_span_kinds": min(kind_counts) if kind_counts
+            else None,
+            "slowest_request": slowest,
+            "endpoints": endpoints,
+            "pages_leaked": eng.pool.leaked(),
+            "pages_lost": eng.pool.lost(),
+            "decode_program": dict(
+                after["decode"],
+                retraces_after_warmup=after["decode"]["retraces"]
+                - warm["decode"]["retraces"]),
+            "errors": errors[:5],
+        }
+        return blk, toks
+
+    try:
+        on, toks_on = run(True, probe_endpoints=True)
+        _, toks_off = run(False)
+    finally:
+        tracer.enabled = was_enabled
+    return dict(on, users=users, max_new=max_new,
+                token_exact=toks_on == toks_off)
+
+
 def run_serve_bench(dev=None, users=8, total_requests=16, max_new=16):
     """Serving-runtime load generator (ROADMAP item 1 acceptance): N
     concurrent synthetic users drive the continuous-batching engine over
@@ -988,6 +1135,7 @@ def run_serve_bench(dev=None, users=8, total_requests=16, max_new=16):
     shared = _serve_shared_prefix_block(users=users)
     chunked = _serve_chunked_block()
     spec = _serve_speculative_block()
+    tracing_blk = _serve_tracing_block()
     return {
         "users": users,
         "requests_completed": len(done),
@@ -1023,6 +1171,10 @@ def run_serve_bench(dev=None, users=8, total_requests=16, max_new=16):
         "speculative": spec,
         "spec_acceptance_rate": spec["spec_on"]["acceptance_rate"],
         "spec_tokens_per_step": spec["spec_on"]["tokens_per_step"],
+        # ISSUE 16: request-tracing probe + top-level mirrors
+        "tracing": tracing_blk,
+        "trace_overhead_pct": tracing_blk["overhead_pct"],
+        "trace_span_coverage": tracing_blk["coverage"]["mean"],
     }
 
 

@@ -17,6 +17,11 @@ inside every training/serving process. Endpoints:
   the profiler snapshot when one exists.
 * ``GET /profile?steps=N`` — queue N dense on-demand capture windows on
   the continuous profiler (the next N training steps are profiled).
+* ``GET /requests?last=N`` — the request tracer's ring of completed
+  serving requests (lifecycle timing breakdown per record) plus the
+  TTFT/TPOT histogram exemplars (bucket → trace id).
+* ``GET /trace/<trace_id>`` — one request's span tree (completed
+  reservoir or still in flight); 404 on an unknown id.
 
 Start with ``paddle_tpu.observability.serve(port)`` (env:
 ``PADDLE_TPU_METRICS_PORT``; port 0 binds an ephemeral port — tests). The
@@ -143,13 +148,21 @@ class _Handler(BaseHTTPRequestHandler):
                 extra(self, method, parse_qs(url.query), body)
                 return
             route = {"/metrics": self._metrics, "/healthz": self._healthz,
-                     "/flight": self._flight,
-                     "/profile": self._profile}.get(url.path)
+                     "/flight": self._flight, "/profile": self._profile,
+                     "/requests": self._requests}.get(url.path)
+            if route is None and url.path.startswith("/trace/"):
+                if method != "GET":
+                    self._send_json(405, {
+                        "error": f"no {method} route {url.path!r}"})
+                    return
+                self._trace(url.path[len("/trace/"):], parse_qs(url.query))
+                return
             if route is None or method != "GET":
                 self._send_json(404 if route is None else 405, {
                     "error": f"no {method} route {url.path!r}",
                     "routes": sorted(["/metrics", "/healthz", "/flight",
-                                      "/profile"] +
+                                      "/profile", "/requests",
+                                      "/trace/<trace_id>"] +
                                      list(routes_snapshot))})
                 return
             route(parse_qs(url.query))
@@ -216,6 +229,31 @@ class _Handler(BaseHTTPRequestHandler):
         if snap is not None:
             payload["profile"] = snap
         self._send_json(200, payload)
+
+    def _requests(self, q):
+        """Recent completed requests: the tracer's request-log ring plus
+        histogram exemplars (the trace-id join for TTFT/TPOT buckets)."""
+        from .. import tracing
+        try:
+            last = int(q.get("last", ["50"])[0])
+        except ValueError:
+            self._send_json(400, {"error": "last must be an int"})
+            return
+        tr = tracing.get_tracer()
+        self._send_json(200, {"enabled": tr.enabled,
+                              "requests": tr.requests(last),
+                              "exemplars": tr.exemplars(),
+                              "stats": tr.stats()})
+
+    def _trace(self, trace_id, _q):
+        """Span tree of one trace (completed reservoir or in-flight)."""
+        from .. import tracing
+        snap = tracing.get_trace(trace_id)
+        if snap is None:
+            self._send_json(404, {"error": f"unknown trace id "
+                                           f"{trace_id!r}"})
+            return
+        self._send_json(200, snap)
 
     def _profile(self, q):
         from . import get_profiler

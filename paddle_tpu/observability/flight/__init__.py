@@ -196,6 +196,15 @@ class FlightRecorder:
             payload["profile"] = _continuous.profile_snapshot()
         except Exception:
             payload["profile"] = None
+        # request-tracer picture: open spans of in-flight requests + the
+        # request-log tail — only if the tracer is actually loaded (a
+        # dying process must never import new modules from the dump path)
+        tracing_mod = sys.modules.get("paddle_tpu.observability.tracing")
+        if tracing_mod is not None:
+            try:
+                payload["tracing"] = tracing_mod.flight_snapshot()
+            except Exception:
+                payload["tracing"] = None
         if extra:
             payload["extra"] = extra
         return payload

@@ -1,0 +1,8 @@
+"""``python -m paddle_tpu.observability.tracing`` — see :func:`main`."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

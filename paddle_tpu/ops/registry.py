@@ -65,6 +65,7 @@ def _surface_cached() -> tuple:
     import paddle_tpu.observability.continuous as obs_continuous
     import paddle_tpu.observability.flight as obs_flight
     import paddle_tpu.observability.memory as obs_memory
+    import paddle_tpu.observability.tracing as obs_tracing
     import paddle_tpu.cost_model as cost_model_mod
     import paddle_tpu.planner as planner_mod
     import paddle_tpu.resilience as resilience
@@ -127,6 +128,11 @@ def _surface_cached() -> tuple:
              records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     _collect(obs_memory, "paddle.observability.memory", "observability",
+             records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    # request tracing: traceparent propagation, the request-log record
+    # shape and the /trace endpoints are debugging contracts too
+    _collect(obs_tracing, "paddle.observability.tracing", "observability",
              records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     # serving runtime: LLMEngine/ServingConfig/PagePool and the HTTP

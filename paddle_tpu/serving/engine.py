@@ -190,6 +190,10 @@ class LLMEngine:
         # flag within one loop tick, drains, dumps, and sets the event
         self._preempt_code: int | None = None
         self._drained = threading.Event()
+        # open-span snapshot taken on the engine thread when the drain
+        # arms: the post-drain flight dump must still carry the spans
+        # that were in flight AT the signal, not after draining
+        self._preempt_spans: list | None = None
 
     # -- compiled programs ---------------------------------------------------
 
@@ -410,11 +414,21 @@ class LLMEngine:
                 # signal-requested drain: the handler only set a flag
                 # (async-signal context may not take locks); the heavy
                 # lifting happens here, on the engine thread
+                armed = False
                 with self._cond:
                     if self._stop_mode is None:
                         self._drain_deadline = time.monotonic() + \
                             self.config.drain_timeout_s
                         self._stop_mode = "drain"
+                        armed = True
+                if armed:
+                    # engine thread, not the signal handler (CS102):
+                    # tracer locks are safe to take here
+                    try:
+                        from ..observability import tracing as _tracing
+                        self._preempt_spans = _tracing.open_spans()
+                    except Exception:
+                        self._preempt_spans = None
             with self._cond:
                 while self._stop_mode is None and not sched.has_work():
                     self._cond.wait(0.05)
@@ -452,9 +466,13 @@ class LLMEngine:
         telemetry server, then release the waiting signal handler."""
         try:
             self._finalize(drain=True)
+            extra = {"serving": self.stats()}
+            if self._preempt_spans is not None:
+                extra["tracing_at_preempt"] = {
+                    "open_spans": self._preempt_spans}
             _flight.dump("serving_preempted",
                          step=self.scheduler.decode_steps,
-                         extra={"serving": self.stats()})
+                         extra=extra)
             try:
                 from ..observability.continuous import shutdown_server
                 shutdown_server()
@@ -526,16 +544,19 @@ class LLMEngine:
 
     def submit(self, prompt_ids, max_new_tokens: int | None = None,
                temperature: float | None = None, eos_token_id=None,
-               request_id: str | None = None, on_token=None) -> Request:
+               request_id: str | None = None, on_token=None,
+               traceparent: str | None = None) -> Request:
         """Enqueue one request (auto-starts the engine thread). Raises
-        :class:`RequestRejected` when the request can never fit."""
+        :class:`RequestRejected` when the request can never fit.
+        ``traceparent`` joins an inbound W3C trace context (malformed
+        values are ignored — the request gets a fresh trace)."""
         cfg = self.config
         req = Request(
             prompt_ids,
             cfg.max_new_tokens if max_new_tokens is None else max_new_tokens,
             cfg.temperature if temperature is None else temperature,
             eos_token_id=eos_token_id, request_id=request_id,
-            on_token=on_token)
+            on_token=on_token, traceparent=traceparent)
         self.scheduler.submit(req)
         self.start()
         with self._cond:
@@ -654,6 +675,8 @@ class LLMEngine:
             "kv_pages_cached": self.pool.cached_pages,
             "prefix_hit_rate": sched.prefix_hit_rate(),
             "spec_acceptance_rate": sched.spec_acceptance_rate(),
+            # TTFT attribution: queue wait vs prefill vs decode means
+            "timing_split": sched.timing_split(),
         }
         return (503 if status == "stalled" else 200), payload
 

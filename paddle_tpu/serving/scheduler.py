@@ -67,6 +67,7 @@ from ..analysis.concurrency import tsan as _tsan
 from ..observability import (counter as _obs_counter, gauge as _obs_gauge,
                              histogram as _obs_histogram)
 from ..observability import flight as _flight
+from ..observability import tracing as _tracing
 from .kv_cache import PagePoolExhausted
 from .speculative import NgramDrafter, SpecState
 
@@ -117,6 +118,11 @@ _TPOT = _obs_histogram("paddle_tpu_serving_tpot_ms",
                        buckets=_MS_BUCKETS)
 _E2E = _obs_histogram("paddle_tpu_serving_e2e_ms",
                       "submit -> completion (ms)", buckets=_MS_BUCKETS)
+_QUEUE_WAIT = _obs_histogram(
+    "paddle_tpu_serving_queue_wait_ms",
+    "enqueue -> admission wait (ms; a re-admission after eviction "
+    "counts each wait segment) — the scheduler-delay share of TTFT",
+    buckets=_MS_BUCKETS)
 _SPEC_PROPOSED = _obs_counter(
     "paddle_tpu_serving_spec_proposed_tokens_total",
     "draft tokens proposed to the verify program", windowed=True)
@@ -151,7 +157,8 @@ class Request:
     code holds it as a handle: ``result()``, ``events``, timing fields)."""
 
     def __init__(self, prompt, max_new_tokens, temperature=0.0,
-                 eos_token_id=None, request_id=None, on_token=None):
+                 eos_token_id=None, request_id=None, on_token=None,
+                 traceparent=None):
         self.prompt = [int(t) for t in prompt]
         if not self.prompt:
             raise ValueError("empty prompt")
@@ -189,6 +196,22 @@ class Request:
         self.ttft_ms: float | None = None
         self.e2e_ms: float | None = None
         self.tpot_ms: list[float] = []
+        # lifecycle split (scheduler queue wait vs prefill compute vs
+        # decode wall) — tracked with tracing on OR off: the TTFT
+        # attribution fields in the request log / summary need them
+        self.queue_ms = 0.0
+        self.prefill_ms = 0.0
+        self.decode_ms: float | None = None
+        self._t_enqueued = self.t_submit
+        self._t_enqueued_wall = time.time()
+        # request trace: NOOP_TRACE when PADDLE_TPU_TRACE=0 — hot paths
+        # identity-check it before building span attributes
+        self.trace = _tracing.start_request(
+            request_id=self.request_id, traceparent=traceparent,
+            prompt_tokens=len(self.prompt),
+            max_new_tokens=self.max_new_tokens)
+        self._tr_burst: dict | None = None   # engine-thread-owned
+        self._stream_span = None
 
     # -- engine side ---------------------------------------------------------
 
@@ -237,6 +260,9 @@ class Request:
             self.t_first_token = now
             self.ttft_ms = (now - self.t_submit) * 1000.0
             _TTFT.observe(self.ttft_ms)
+            if self.trace is not _tracing.NOOP_TRACE:
+                # stream-emission span: first delivered token -> finish
+                self._stream_span = self.trace.span("stream")
             self.tokens.append(toks[0])
             self._deliver(toks[0])
             self._t_last = now       # burst tail gaps measure from here
@@ -260,6 +286,39 @@ class Request:
             except Exception:
                 pass  # a user callback must never kill the engine loop
 
+    def _trace_step(self, kind: str, t_start: float, tokens: int = 1,
+                    **extra) -> None:
+        """Fold one decode/verify iteration into the current span burst.
+        Per-token spans would dominate tracer cost, so consecutive
+        same-kind steps aggregate into ONE span until the kind changes
+        or the burst cap (``PADDLE_TPU_TRACE_BURST``) is hit; numeric
+        extras (proposed/accepted/rollback_pages) sum across the burst.
+        Engine-thread-owned state — never touched from user threads."""
+        if self.trace is _tracing.NOOP_TRACE:
+            return
+        b = self._tr_burst
+        if b is not None and b["kind"] != kind:
+            self._trace_flush()
+            b = None
+        if b is None:
+            b = self._tr_burst = {"kind": kind, "t0": t_start,
+                                  "steps": 0, "tokens": 0, "extra": {}}
+        b["steps"] += 1
+        b["tokens"] += tokens
+        for k, v in extra.items():
+            b["extra"][k] = b["extra"].get(k, 0) + v
+        if b["steps"] >= _tracing.decode_burst():
+            self._trace_flush()
+
+    def _trace_flush(self) -> None:
+        b = self._tr_burst
+        if b is None:
+            return
+        self._tr_burst = None
+        self.trace.add_span(b["kind"], t_start=b["t0"], t_end=time.time(),
+                            steps=b["steps"], tokens=b["tokens"],
+                            **b["extra"])
+
     def _finish(self, state: str, error: str | None = None) -> None:
         if self.state in _TERMINAL:
             return
@@ -267,9 +326,39 @@ class Request:
         self.error = error
         self.t_done = time.monotonic()
         self.e2e_ms = (self.t_done - self.t_submit) * 1000.0
+        if self.t_first_token is not None:
+            self.decode_ms = (self.t_done - self.t_first_token) * 1000.0
         _REQS.inc(status=state)
         if state == COMPLETED:
             _E2E.observe(self.e2e_ms)
+        if self.trace is not _tracing.NOOP_TRACE:
+            self._trace_flush()
+            if self._stream_span is not None:
+                self._stream_span.end(tokens=len(self.tokens))
+                self._stream_span = None
+            if state == COMPLETED:
+                # exemplars: the TTFT/TPOT histograms' buckets gain a
+                # trace id, so a p99 outlier names its trace
+                if self.ttft_ms is not None:
+                    _tracing.note_exemplar(
+                        "paddle_tpu_serving_ttft_ms", self.ttft_ms,
+                        self.trace.trace_id, buckets=_MS_BUCKETS)
+                if self.tpot_ms:
+                    _tracing.note_exemplar(
+                        "paddle_tpu_serving_tpot_ms", max(self.tpot_ms),
+                        self.trace.trace_id, buckets=_MS_BUCKETS)
+            self.trace.finish(
+                state=state, error=error,
+                prompt_tokens=len(self.prompt),
+                generated=len(self.tokens),
+                cached_tokens=self._cached_tokens or None,
+                evictions=self.evictions or None,
+                ttft_ms=round(self.ttft_ms, 3)
+                if self.ttft_ms is not None else None,
+                queue_ms=round(self.queue_ms, 3),
+                prefill_ms=round(self.prefill_ms, 3),
+                decode_ms=round(self.decode_ms, 3)
+                if self.decode_ms is not None else None)
         self.events.put(("error", error) if error else ("done", None))
         self._done.set()
 
@@ -352,6 +441,13 @@ class Scheduler:
         self.spec_rejected = 0
         self.step_tokens = 0
         self.step_rows = 0
+        # lifecycle-split accounting (under self.lock): queue wait sums
+        # at each admission; prefill/decode sums fold at completion
+        self.queue_wait_ms_sum = 0.0
+        self.admissions = 0
+        self.prefill_ms_sum = 0.0
+        self.decode_ms_sum = 0.0
+        self.finished_timed = 0
 
     # -- submission ----------------------------------------------------------
 
@@ -386,6 +482,8 @@ class Scheduler:
             i -= 1
         self.waiting.insert(i, req)
         req.state = QUEUED
+        req._t_enqueued = time.monotonic()
+        req._t_enqueued_wall = time.time()
         _QUEUE.set(len(self.waiting))
 
     # -- introspection -------------------------------------------------------
@@ -428,6 +526,22 @@ class Scheduler:
         if self.prefix_cache is not None:
             stats["entries"] = len(self.prefix_cache)
         return stats
+
+    def timing_split(self) -> dict:
+        """Mean per-request lifecycle split: scheduler queue wait vs
+        prefill compute vs decode wall — the TTFT attribution fix
+        (queue wait used to be invisibly folded into TTFT). Surfaced in
+        the ``/healthz`` serving payload."""
+        with self.lock:
+            adm, fin = self.admissions, self.finished_timed
+            return {
+                "queue_wait_ms_mean": round(
+                    self.queue_wait_ms_sum / adm, 3) if adm else None,
+                "prefill_ms_mean": round(
+                    self.prefill_ms_sum / fin, 3) if fin else None,
+                "decode_ms_mean": round(
+                    self.decode_ms_sum / fin, 3) if fin else None,
+            }
 
     def spec_acceptance_rate(self):
         """Cumulative draft acceptance (accepted/proposed), None before
@@ -527,6 +641,7 @@ class Scheduler:
     def _admit(self) -> int:
         admitted = 0
         while True:
+            t_adm0 = time.time()
             with self.lock:
                 if not self.waiting:
                     break
@@ -583,7 +698,21 @@ class Scheduler:
                 req.state = RUNNING
                 if self.spec_k and req.spec is None:
                     req.spec = SpecState(self.spec_k, self.spec_adaptive)
+                wait_ms = (time.monotonic() - req._t_enqueued) * 1000.0
+                req.queue_ms += wait_ms
+                self.queue_wait_ms_sum += wait_ms
+                self.admissions += 1
                 _ACTIVE.set(len([r for r in self.slots if r is not None]))
+            _QUEUE_WAIT.observe(wait_ms)
+            if req.trace is not _tracing.NOOP_TRACE:
+                t_now = time.time()
+                req.trace.add_span("queue_wait",
+                                   t_start=req._t_enqueued_wall, t_end=t_now)
+                req.trace.add_span("admit", t_start=t_adm0, t_end=t_now,
+                                   cached_tokens=matched,
+                                   claimed_pages=len(claimed),
+                                   pages=len(req.pages), context=ctx_len,
+                                   evictions=req.evictions)
             if matched:
                 _flight.record("serving_prefix_hit", request=req.request_id,
                                pages=len(claimed), tokens=matched,
@@ -596,12 +725,19 @@ class Scheduler:
             if self.chunk:
                 admitted += 1     # chunked mode: device work interleaves
                 continue
+            t_pf0 = time.time()
             try:
                 first = self.programs.prefill(req)
             except Exception as e:   # noqa: BLE001 — request-scoped failure
                 self._release(req)
                 req._finish(FAILED, f"prefill failed: {e!r}")
                 continue
+            t_pf1 = time.time()
+            req.prefill_ms += (t_pf1 - t_pf0) * 1000.0
+            if req.trace is not _tracing.NOOP_TRACE:
+                req.trace.add_span("prefill", t_start=t_pf0, t_end=t_pf1,
+                                   tokens=ctx_len - matched,
+                                   cached_tokens=matched)
             with self.lock:
                 # the SCHEDULER owns prefill progress — a programs
                 # implementation only runs device work (the engine
@@ -634,6 +770,7 @@ class Scheduler:
         decref per page: shared pages stay live for their other owners,
         exclusive keyed pages fall back to the reclaimable cached
         state)."""
+        req._trace_flush()        # a slot change ends the current burst
         with self.lock:
             if req.pages:
                 self.pool.free(req.pages)
@@ -663,6 +800,9 @@ class Scheduler:
                 # threads while the engine thread steps — same lock as
                 # the slot tables, no torn counters
                 self.completed += 1
+                self.prefill_ms_sum += req.prefill_ms
+                self.decode_ms_sum += req.decode_ms or 0.0
+                self.finished_timed += 1
             _flight.record("serving_complete", request=req.request_id,
                            generated=len(req.tokens),
                            reason="eos" if done_eos else "length")
@@ -675,6 +815,11 @@ class Scheduler:
         _EVICTIONS.inc()
         _flight.record("serving_evict", request=victim.request_id,
                        generated=len(victim.tokens))
+        if victim.trace is not _tracing.NOOP_TRACE:
+            now = time.time()
+            victim.trace.add_span("evict", t_start=now, t_end=now,
+                                  generated=len(victim.tokens),
+                                  evictions=victim.evictions)
         with self.lock:
             self.evictions += 1
             self._enqueue(victim)
@@ -722,6 +867,7 @@ class Scheduler:
                     if not self._evict_for(req):
                         return False
                     continue
+                t_cp0 = time.time()
                 self.pool.copy_page(page, fresh)
                 with self.lock:
                     if req.slot is None:      # evicted meanwhile
@@ -734,6 +880,10 @@ class Scheduler:
                 _COW.inc()
                 _flight.record("serving_cow", request=req.request_id,
                                src=int(page), page=int(fresh))
+                if req.trace is not _tracing.NOOP_TRACE:
+                    req.trace.add_span("cow", t_start=t_cp0,
+                                       t_end=time.time(), src=int(page),
+                                       page=int(fresh))
                 break
         return True
 
@@ -763,12 +913,18 @@ class Scheduler:
                 continue
             if not self._make_writable(req, start, n):
                 continue             # evicted while making room
+            t_ch0 = time.time()
             try:
                 tok = self.programs.prefill_chunk(req, n)
             except Exception as e:   # noqa: BLE001 — request-scoped failure
                 self._release(req)
                 req._finish(FAILED, f"prefill failed: {e!r}")
                 continue
+            t_ch1 = time.time()
+            req.prefill_ms += (t_ch1 - t_ch0) * 1000.0
+            if req.trace is not _tracing.NOOP_TRACE:
+                req.trace.add_span("prefill_chunk", t_start=t_ch0,
+                                   t_end=t_ch1, start=start, n=n)
             budget -= n
             ran += 1
             with self.lock:
@@ -888,12 +1044,14 @@ class Scheduler:
                 positions[req.slot] = req.cur_len() - 1
                 temps[req.slot] = max(req.temperature, 0.0)
             tables = self._masked_tables()
+        t_dec0 = time.time()
         out = self.programs.decode(tokens, positions, tables, temps)
         self._account_step(len(active) / float(self.max_batch),
                            emitted=len(active), rows=len(active))
         for req in active:
             req._emit(int(out[req.slot]))
             _TOKENS.inc(kind="generated")
+            req._trace_step("decode", t_dec0)
             self._maybe_complete(req)
         return True
 
@@ -947,25 +1105,27 @@ class Scheduler:
                 self.tables[req.slot][len(req.pages) - 1] = page
         return self._make_writable(req, req.cur_len() - 1, dlen + 1)
 
-    def _rollback(self, req: Request) -> None:
+    def _rollback(self, req: Request) -> int:
         """Rewind speculative page growth: free pages beyond what the
         request's ACCEPTED length needs (``pages_for(cur_len)`` keeps
         the next write position's page). Freed pages were allocated
         fresh for draft positions — never claimed/shared, never keyed
         (chain hashing only ever covers accepted full context pages) —
-        so the decref sends them straight back to the free list."""
+        so the decref sends them straight back to the free list.
+        Returns the number of pages rolled back (a span attribute)."""
         with self.lock:
             if req.slot is None:
-                return
+                return 0
             need = self.pool.pages_for(req.cur_len())
             extra = req.pages[need:]
             if not extra:
-                return
+                return 0
             del req.pages[need:]
             self.tables[req.slot][need:need + len(extra)] = 0
             self.pool.free(extra)
         _flight.record("serving_spec_rollback", request=req.request_id,
                        pages=len(extra))
+        return len(extra)
 
     def _spec_decode(self, drafts: dict) -> bool:
         """One speculative engine iteration: write the draft span
@@ -997,6 +1157,7 @@ class Scheduler:
             n_prop = int(dlens.sum())
         _flight.record("serving_spec_propose", rows=len(active),
                        proposed=n_prop)
+        t_ver0 = time.time()
         out, acc = self.programs.verify(tokens, positions, dlens, tables,
                                         temps)
         occ = len(active) / float(self.max_batch)
@@ -1021,7 +1182,9 @@ class Scheduler:
                 # track adaptive K falling to 0 (and _release zeroes it
                 # when the slot empties)
                 _SPEC_K.set(st.k, slot=str(req.slot))
-            self._rollback(req)
+            rb = self._rollback(req)
+            req._trace_step("speculate", t_ver0, tokens=len(emitted),
+                            proposed=d_n, accepted=a, rollback_pages=rb)
             self._maybe_complete(req)
         self._account_step(occ, emitted=n_emit, rows=len(active),
                            proposed=n_prop, accepted=n_acc, verify=True)

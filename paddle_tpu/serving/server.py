@@ -94,6 +94,17 @@ def _route_generate(handler, method, query, body):
         kw["temperature"] = float(payload["temperature"])
     if payload.get("eos_token_id") is not None:
         kw["eos_token_id"] = int(payload["eos_token_id"])
+    # inbound W3C trace context: header wins, body field as fallback;
+    # malformed values degrade to a fresh trace (never a 4xx)
+    tp = None
+    try:
+        tp = handler.headers.get("traceparent")
+    except Exception:
+        tp = None
+    if not tp:
+        tp = payload.get("traceparent")
+    if tp is not None:
+        kw["traceparent"] = str(tp)
     timeout = float(payload.get("timeout_s") or 300.0)
     try:
         req = eng.submit(prompt, **kw)
@@ -158,9 +169,15 @@ def _route_generate(handler, method, query, body):
 def _summary(req, toks) -> dict:
     return {
         "request_id": req.request_id,
+        "trace_id": req.trace.trace_id,
         "tokens": [int(t) for t in toks],
         "num_generated": len(toks),
         "ttft_ms": round(req.ttft_ms, 3) if req.ttft_ms is not None else None,
         "e2e_ms": round(req.e2e_ms, 3) if req.e2e_ms is not None else None,
+        # TTFT attribution split (queue wait vs prefill vs decode)
+        "queue_ms": round(req.queue_ms, 3),
+        "prefill_ms": round(req.prefill_ms, 3),
+        "decode_ms": round(req.decode_ms, 3)
+        if req.decode_ms is not None else None,
         "state": req.state,
     }

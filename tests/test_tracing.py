@@ -1,0 +1,392 @@
+"""Request tracing (ISSUE 16): span lifecycle/nesting, disabled-mode
+type-identity no-ops + guard cost, traceparent round-trip + malformed
+rejection, exemplar-to-trace join, HTTP endpoints (404, bounded
+reservoir), Chrome-trace schema, strict-RFC-8259 request log, flight
+integration, and a concurrent submit/complete storm (TSAN suite)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import flight
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.continuous import TelemetryServer
+from paddle_tpu.observability.tracing import (
+    NOOP_SPAN, NOOP_TRACE, RequestTrace, TraceContext, Tracer,
+    parse_traceparent)
+from paddle_tpu.serving.scheduler import Request
+
+
+@pytest.fixture
+def tracer():
+    """The global tracer, reset and enabled for the test."""
+    tr = tracing.get_tracer()
+    was = tr.enabled
+    tr.reset()
+    tr.enabled = True
+    yield tr
+    tr.enabled = was
+    tr.reset()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# -- span lifecycle ----------------------------------------------------------
+
+def test_span_lifecycle_and_nesting(tracer):
+    tr = tracing.start_request(request_id="r1", kind="test")
+    assert tr.trace_id and len(tr.trace_id) == 32
+    with tr.span("prefill", tokens=8) as outer:
+        with tr.span("cow", parent=outer) as inner:
+            pass
+    rec = tr.finish(state="completed")
+    assert rec["spans"] == 2 and rec["state"] == "completed"
+    snap = tracing.get_trace(tr.trace_id)
+    by_name = {s["name"]: s for s in snap["spans"]}
+    assert by_name["prefill"]["parent_id"] == snap["root"]["span_id"]
+    assert by_name["cow"]["parent_id"] == by_name["prefill"]["span_id"]
+    for s in snap["spans"]:
+        assert s["t_end"] >= s["t_start"]
+    # idempotent finish
+    assert tr.finish() is None
+
+
+def test_unfinished_child_closed_at_finish(tracer):
+    tr = tracing.start_request(request_id="r2")
+    tr.span("stream")              # never ended
+    tr.finish(state="failed")
+    snap = tracing.get_trace(tr.trace_id)
+    (s,) = snap["spans"]
+    assert s["attributes"]["unfinished"] is True
+    assert s["t_end"] is not None
+
+
+def test_span_buffer_is_bounded():
+    t = Tracer(enabled=True, max_spans=4, reservoir=8, log_capacity=8)
+    tr = t.start_request(request_id="r")
+    for i in range(10):
+        tr.add_span("decode", time.time(), time.time())
+    rec = tr.finish()
+    assert rec["spans"] == 4 and rec["dropped_spans"] == 6
+
+
+def test_coverage_union_of_child_intervals():
+    t = Tracer(enabled=True)
+    tr = t.start_request()
+    t0 = tr.root.t_start
+    # two overlapping children covering ~half the root interval
+    tr.add_span("a", t0, t0 + 0.06)
+    tr.add_span("b", t0 + 0.04, t0 + 0.05)   # nested inside a
+    time.sleep(0.1)
+    rec = tr.finish()
+    assert 0.0 < rec["span_coverage"] < 1.0
+
+
+# -- disabled mode -----------------------------------------------------------
+
+def test_disabled_mode_is_type_identity_noop():
+    t = Tracer(enabled=False)
+    tr = t.start_request(request_id="x")
+    assert tr is NOOP_TRACE
+    assert tr.span("decode") is NOOP_SPAN
+    assert tr.add_span("decode", 0.0, 1.0) is NOOP_SPAN
+    with tr.span("prefill") as s:
+        assert s is NOOP_SPAN and s.set(a=1) is NOOP_SPAN
+    assert tr.finish() is None and tr.trace_id is None
+    assert t.stats()["completions"] == 0
+
+
+def test_disabled_mode_guard_cost_is_measured_small():
+    t = Tracer(enabled=False)
+    tr = t.start_request()
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tr.span("decode")
+    per_call = (time.perf_counter() - t0) / n
+    # a disabled span must cost nanoseconds, not microseconds; 5us is
+    # an extremely generous CI bound that still catches accidental
+    # allocation/locking on the disabled path
+    assert per_call < 5e-6, f"disabled span() costs {per_call * 1e6:.2f}us"
+
+
+# -- traceparent -------------------------------------------------------------
+
+def test_traceparent_round_trip():
+    ctx = TraceContext("ab" * 16, "cd" * 8, flags=1)
+    s = ctx.to_traceparent()
+    assert s == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    back = parse_traceparent(s)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id and back.flags == 1
+
+
+@pytest.mark.parametrize("bad", [
+    None, 42, "", "garbage", "00-abc-def-01",
+    "00-" + "g" * 32 + "-" + "cd" * 8 + "-01",          # non-hex
+    "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",          # zero trace id
+    "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",         # zero span id
+    "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",         # forbidden version
+    "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",         # uppercase hex
+    "00-" + "ab" * 16 + "-" + "cd" * 8,                 # missing flags
+])
+def test_malformed_traceparent_rejected(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_malformed_traceparent_does_not_fail_the_request(tracer):
+    req = Request([1, 2, 3], 4, traceparent="not-a-traceparent")
+    assert req.trace is not NOOP_TRACE
+    assert len(req.trace.trace_id) == 32     # fresh trace, no error
+    req._finish("completed")
+    assert tracing.get_trace(req.trace.trace_id) is not None
+
+
+def test_inbound_traceparent_joins_the_trace(tracer):
+    tp = f"00-{'ab' * 16}-{'cd' * 8}-01"
+    req = Request([1, 2, 3], 4, traceparent=tp)
+    assert req.trace.trace_id == "ab" * 16
+    snap = req.trace.snapshot()
+    assert snap["root"]["parent_id"] == "cd" * 8
+    # outbound context is a child of OUR root span, same trace id
+    out = parse_traceparent(req.trace.context().to_traceparent())
+    assert out.trace_id == "ab" * 16
+    assert out.span_id == snap["root"]["span_id"]
+    req._finish("cancelled")
+
+
+# -- request integration -----------------------------------------------------
+
+def test_request_finish_carries_timing_split(tracer):
+    req = Request([1, 2, 3], 4)
+    req._emit(7)                   # first token: ttft + stream span open
+    req._finish("completed")
+    assert req.decode_ms is not None
+    recs = [r for r in tracing.requests()
+            if r["trace_id"] == req.trace.trace_id]
+    assert len(recs) == 1
+    rec = recs[0]
+    for k in ("queue_ms", "prefill_ms", "decode_ms", "ttft_ms",
+              "span_coverage", "span_kinds"):
+        assert k in rec, k
+    assert "stream" in rec["span_kinds"]
+
+
+def test_burst_aggregation_one_span_per_kind_run(tracer):
+    req = Request([1], 4)
+    t0 = time.time()
+    for _ in range(5):
+        req._trace_step("decode", t0)
+    req._trace_step("speculate", t0, tokens=2, proposed=3, accepted=1)
+    req._trace_flush()
+    req._finish("completed")
+    snap = tracing.get_trace(req.trace.trace_id)
+    kinds = [s["name"] for s in snap["spans"]]
+    # 5 decode steps collapsed into ONE span; kind change flushed it
+    assert kinds.count("decode") == 1 and kinds.count("speculate") == 1
+    dec = next(s for s in snap["spans"] if s["name"] == "decode")
+    assert dec["attributes"]["steps"] == 5
+    rec = snap["record"]
+    assert rec["spec"] == {"proposed": 3, "accepted": 1}
+
+
+def test_exemplar_joins_top_bucket_to_trace(tracer):
+    req = Request([1, 2], 4)
+    req._emit(9)
+    req._finish("completed")
+    ex = tracing.exemplars()
+    top = ex["paddle_tpu_serving_ttft_ms"]["top"]
+    assert top["trace_id"] == req.trace.trace_id
+    assert tracing.get_trace(top["trace_id"]) is not None
+
+
+# -- bounded global state ----------------------------------------------------
+
+def test_reservoir_evicts_oldest():
+    t = Tracer(enabled=True, reservoir=4, log_capacity=4)
+    ids = []
+    for i in range(10):
+        tr = t.start_request(request_id=f"r{i}")
+        ids.append(tr.trace_id)
+        tr.finish()
+    assert t.stats()["reservoir"] <= 4
+    assert t.get_trace(ids[0]) is None         # oldest evicted
+    assert t.get_trace(ids[-1]) is not None    # newest kept
+    assert len(t.requests()) == 4              # log ring bounded too
+
+
+def test_live_table_bounded_on_leaked_requests():
+    t = Tracer(enabled=True, reservoir=4, log_capacity=4)
+    for i in range(t._live_capacity + 20):
+        t.start_request(request_id=f"leak{i}")  # never finished
+    assert t.stats()["live"] <= t._live_capacity
+    assert t.stats()["dropped_live"] >= 20
+
+
+def test_sampled_reservoir_keeps_every_nth():
+    t = Tracer(enabled=True, reservoir=64, log_capacity=64, sample_every=3)
+    kept = 0
+    for i in range(9):
+        tr = t.start_request()
+        tr.finish()
+        kept += t.get_trace(tr.trace_id) is not None
+    assert kept == 3                      # 1 in 3 full span trees
+    assert len(t.requests()) == 9         # but EVERY request logged
+
+
+# -- HTTP endpoints ----------------------------------------------------------
+
+def test_requests_and_trace_endpoints(tracer):
+    tr = tracing.start_request(request_id="httpreq")
+    tr.add_span("decode", time.time(), time.time())
+    tr.finish(state="completed", queue_ms=1.5)
+    srv = TelemetryServer(port=0, host="127.0.0.1").start()
+    try:
+        code, body = _get(srv.port, "/requests")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert any(r["trace_id"] == tr.trace_id
+                   for r in payload["requests"])
+        code, body = _get(srv.port, f"/trace/{tr.trace_id}")
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["trace_id"] == tr.trace_id
+        assert snap["spans"][0]["name"] == "decode"
+        code, body = _get(srv.port, "/trace/" + "0" * 32)
+        assert code == 404 and b"unknown trace id" in body
+        code, _ = _get(srv.port, "/requests?last=oops")
+        assert code == 400
+    finally:
+        srv.close()
+
+
+# -- exporters ---------------------------------------------------------------
+
+def test_chrome_trace_schema(tracer):
+    tr = tracing.start_request(request_id="ct")
+    tr.add_span("prefill", time.time(), time.time() + 0.01)
+    tr.finish()
+    open_span = {"name": "request", "span_id": "a" * 16,
+                 "parent_id": None, "t_start": time.time(), "t_end": None,
+                 "trace_id": "b" * 32, "request_id": "open1"}
+    ct = tracing.to_chrome_trace([tracing.get_trace(tr.trace_id)],
+                                 open_spans=[open_span])
+    assert isinstance(ct["traceEvents"], list)
+    phs = set()
+    for ev in ct["traceEvents"]:
+        assert {"name", "ph", "ts", "pid", "tid", "args"} <= set(ev)
+        assert isinstance(ev["ts"], float)
+        phs.add(ev["ph"])
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    # closed spans render complete; the open span is KEPT as a begin
+    # event (flight death-span convention), never dropped
+    assert phs == {"X", "B"}
+    json.dumps(ct)  # serializable
+
+
+def test_request_log_is_strict_rfc8259(tracer):
+    tr = tracing.start_request(request_id="nan")
+    tr.add_span("decode", time.time(), time.time(),
+                loss=float("nan"), lr=float("inf"))
+    tr.finish(state="completed", bad=float("nan"))
+    text = tracing.render_request_log()
+
+    def boom(tok):
+        raise AssertionError(f"bare {tok} token in request log")
+
+    for line in text.strip().splitlines():
+        rec = json.loads(line, parse_constant=boom)   # strict parse
+        assert rec["trace_id"] == tr.trace_id
+        assert rec["bad"] == "nan"
+
+
+def test_flight_dump_carries_open_spans(tracer, tmp_path):
+    tr = tracing.start_request(request_id="inflight")
+    tr.span("prefill")
+    rec = flight.FlightRecorder(capacity=8, enabled=True)
+    rec.dump_dir = str(tmp_path)
+    rec.record("step", step=1)
+    path = rec.dump("death", step=1)
+    payload = json.loads(open(path).read())
+    spans = payload["tracing"]["open_spans"]
+    assert any(s["request_id"] == "inflight" and s["name"] == "request"
+               for s in spans)
+    assert any(s["name"] == "prefill" for s in spans)
+    tr.finish(state="failed")
+
+
+def test_cli_renders_dump_with_open_spans(tracer, tmp_path):
+    dump = {
+        "tracing": {"open_spans": [], "traces": [], "requests": []},
+        "extra": {"tracing_at_preempt": {"open_spans": [
+            {"name": "request", "span_id": "a" * 16, "parent_id": None,
+             "t_start": 123.0, "t_end": None, "trace_id": "c" * 32,
+             "request_id": "rq1"}]}},
+    }
+    p = tmp_path / "flight_test.json"
+    p.write_text(json.dumps(dump))
+    out = tmp_path / "chrome.json"
+    assert tracing.main([str(p), "--chrome-trace", str(out)]) == 0
+    ct = json.loads(out.read_text())
+    bevs = [e for e in ct["traceEvents"] if e["ph"] == "B"]
+    assert bevs and bevs[0]["args"]["request_id"] == "rq1"
+    assert tracing.main([str(tmp_path / "missing.json")]) == 2
+
+
+# -- concurrency -------------------------------------------------------------
+
+def test_concurrent_submit_complete_storm(tracer):
+    """8 threads x 40 requests: open, span, finish, while readers
+    snapshot — runs under PADDLE_TPU_TSAN=1 in the tsan_check suite."""
+    n_threads, per_thread = 8, 40
+    errors: list = []
+    done = threading.Event()
+
+    def worker(wid):
+        try:
+            for i in range(per_thread):
+                tr = tracing.start_request(request_id=f"w{wid}-{i}")
+                with tr.span("prefill"):
+                    pass
+                tr.add_span("decode", time.time(), time.time(), steps=3)
+                tracing.note_exemplar("storm_ms", float(i), tr.trace_id,
+                                      buckets=(10.0, 100.0))
+                tr.finish(state="completed")
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    def reader():
+        while not done.is_set():
+            tracing.open_spans()
+            tracing.requests(8)
+            tracing.stats()
+            tracing.exemplars()
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(n_threads)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    done.set()
+    r.join()
+    assert not errors
+    st = tracer.stats()
+    assert st["completions"] == n_threads * per_thread
+    assert st["live"] == 0
+    assert st["spans_total"] == 2 * n_threads * per_thread

@@ -366,6 +366,40 @@ def profile_serving_sigterm(steps, ref):
             ["serving_spec_propose", "serving_spec_verify"], window=64)
         if err:
             return err
+        # ISSUE 16: the dump must carry an OPEN trace span for every
+        # request that was in flight at SIGTERM (the engine snapshots
+        # the tracer when the drain arms and stashes it in extra), and
+        # the tracing CLI must render them as Chrome-trace "B" begin
+        # events — unmatched spans KEPT, the flight death-span
+        # convention
+        from paddle_tpu.observability import tracing
+        dump_path = sorted(glob.glob(os.path.join(d, "flight_*.json")),
+                           key=os.path.getmtime)[-1]
+        with open(dump_path) as f:
+            payload = json.load(f)
+        at_preempt = (payload.get("extra") or {}).get(
+            "tracing_at_preempt") or {}
+        open_reqs = {s.get("request_id")
+                     for s in at_preempt.get("open_spans") or ()}
+        missing = [r.request_id for r in reqs
+                   if r.request_id not in open_reqs]
+        if missing:
+            return (f"preemption dump carries no open span for "
+                    f"in-flight request(s) {missing} (open spans for "
+                    f"{sorted(open_reqs)})")
+        chrome_out = os.path.join(d, "preempt_trace.json")
+        if tracing.main([dump_path, "--chrome-trace", chrome_out]) != 0:
+            return "tracing CLI failed on the preemption dump"
+        with open(chrome_out) as f:
+            chrome = json.load(f)
+        b_reqs = {(e.get("args") or {}).get("request_id")
+                  for e in chrome.get("traceEvents", ())
+                  if e.get("ph") == "B"}
+        missing = [r.request_id for r in reqs
+                   if r.request_id not in b_reqs]
+        if missing:
+            return (f"tracing CLI rendered no open-span 'B' event for "
+                    f"request(s) {missing}")
     return None
 
 

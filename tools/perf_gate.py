@@ -50,7 +50,10 @@ cache-on p50 TTFT comparisons (PERF_GATE_PREFIX_TTFT_TOL_PCT, default
 25%: within-round vs cache-off AND against the baseline round), and the
 speculative A/B's spec-on p50 TPOT vs spec-off within-round
 (PERF_GATE_SPEC_TPOT_TOL_PCT, default 25% — speculation that costs
-latency on its own workload is a regression).
+latency on its own workload is a regression). The request-tracing probe
+(``extra.serve.tracing``) joins the hard sub-block sweep (tracing must
+not flip SERVE-RETRACE/SERVE-LEAK/SERVE-LOST) and soft-gates the
+tracer's measured overhead (PERF_GATE_TRACE_TOL_PCT, default 1%).
 
 The mega-kernel harvest (``extra.fusion_targets``) adds a soft gate: the
 top remaining (not ``fused``) target's est_saved_bytes must stay below
@@ -365,6 +368,10 @@ def serve_subblocks(cur):
     for k in ("spec_on", "spec_off"):
         if isinstance(sd.get(k), dict):
             blocks.append((f"serve.speculative.{k}", sd[k]))
+    # the tracing probe's engine runs with the tracer ON: if tracing
+    # flipped a retrace / leaked a page, the hard gates catch it HERE
+    if isinstance(cur.get("tracing"), dict):
+        blocks.append(("serve.tracing", cur["tracing"]))
     return blocks
 
 
@@ -482,6 +489,23 @@ def serve_gates(cd, bd):
                   f"spec-on vs {off_tpot:.2f} ms spec-off "
                   f"(delta {delta:+.2%}, tokens/step "
                   f"{sd.get('spec_on', {}).get('tokens_per_step')})")
+    # request tracing must stay effectively free: the tracer's measured
+    # self-cost (span-append wall folded into tracer stats) as a share
+    # of the traced workload's wall
+    trace_tol = _tol_pct("PERF_GATE_TRACE_TOL_PCT", 1.0)
+    tb = cur.get("tracing") or {}
+    ov = tb.get("overhead_pct")
+    if trace_tol > 0 and ov is not None:
+        if float(ov) > trace_tol:
+            soft.append(
+                f"perf gate [REGRESSION:trace-overhead] request tracing "
+                f"cost {float(ov):.3f}% of the serve wall (ceiling "
+                f"{trace_tol:g}% via PERF_GATE_TRACE_TOL_PCT)")
+        else:
+            print(f"perf gate [ok:trace-overhead] request tracing "
+                  f"{float(ov):.3f}% of the serve wall (ceiling "
+                  f"{trace_tol:g}%, span cost "
+                  f"{tb.get('span_cost_us')} us)")
     tol = _tol_pct("PERF_GATE_SERVE_TOL_PCT", 30.0)
     base = serve_block(bd) if bd else None
     if tol > 0 and base and base.get("tokens_per_s"):

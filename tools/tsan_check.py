@@ -53,8 +53,8 @@ SUITES = {
                 "tests/test_prefix_cache.py", "-q",
                 "-m", "not slow", "-p", "no:cacheprovider"],
     "telemetry": ["-m", "pytest", "tests/test_telemetry_server.py",
-                  "tests/test_continuous.py", "-q", "-m", "not slow",
-                  "-p", "no:cacheprovider"],
+                  "tests/test_continuous.py", "tests/test_tracing.py",
+                  "-q", "-m", "not slow", "-p", "no:cacheprovider"],
     "chaos": ["tools/chaos_check.py"],
 }
 QUICK_SUITES = ("telemetry",)
