@@ -560,6 +560,85 @@ def _graph_analysis_block(model, batch, seq, vocab):
         return {"error": traceback.format_exc(limit=1)[:300]}
 
 
+# which kernel_ab measured row each static sheet governs: (module,
+# kernel symbol, measured-ms key). The join is by identity — the sheets
+# are computed at the module's pk_examples() shapes, the timings at the
+# bench's A/B shapes — so read the pair as "this measured kernel, whose
+# static budget/traffic model says THIS", not as a same-shape prediction.
+_KERNEL_AB_JOIN = (
+    ("rope_pallas", "_rope_kernel", "rope_pallas_fwdbwd_ms"),
+    ("moe_gemm_pallas", "_kernel", "moe_gemm_pallas_ms"),
+    ("bias_dropout_ln_pallas", "_fwd_kernel", "bias_dropout_ln_pallas_ms"),
+    ("wo_matmul_pallas", "_wo_kernel", "wo_int8_decode_pallas_ms"),
+    ("wo_matmul_pallas", "_wo4_kernel", "wo_int4_decode_pallas_ms"),
+)
+
+
+def _kernel_static_block(kernel_ab):
+    """Static per-kernel RESOURCE SHEETS (``cost_model.kernel_cost`` —
+    the kernel analyzer's VMEM/FLOPs/HBM accounting) joined with the
+    measured ``kernel_ab`` rows per ``_KERNEL_AB_JOIN``, plus a
+    graph-tier HBM cross-check on the swiglu forward example.
+
+    Cross-check tolerance (asserted in tests/test_kernel_analysis.py):
+    the sheet's hbm_bytes (distinct blocks x block bytes over the grid)
+    must agree with the graph tier's input+output byte count for the
+    same computation within 2x either way — the pallas pipeline re-reads
+    broadcast blocks and pads tails, while the graph tier counts each
+    array exactly once, so a ratio outside [0.5, 2.0] means one of the
+    two static models is wrong. Never fails the bench: {"error": ...}.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.analysis.graph import (
+            aval_bytes, build_graph, trace_callable)
+        from paddle_tpu.cost_model import kernel_cost
+
+        block = {"sheets": [], "joined": []}
+        costs = {}
+        for mod, kern, ms_key in _KERNEL_AB_JOIN:
+            if mod not in costs:
+                costs[mod] = kernel_cost("paddle_tpu.ops.kernels." + mod)
+                block.setdefault("chip", costs[mod]["chip"])
+                block.setdefault("vmem_budget", costs[mod]["vmem_budget"])
+                block["sheets"].extend(costs[mod]["kernels"])
+            sheet = next((s for s in costs[mod]["kernels"]
+                          if s["kernel"] == kern), None)
+            if sheet is None:
+                continue
+            block["joined"].append({
+                "kernel": kern, "module": mod, "measured_key": ms_key,
+                "measured_ms": (kernel_ab or {}).get(ms_key),
+                "fits_vmem": sheet["fits_vmem"],
+                "vmem_bytes": sheet["vmem_bytes"],
+                "hbm_bytes": sheet["hbm_bytes"],
+                "arithmetic_intensity": sheet["arithmetic_intensity"],
+            })
+
+        from paddle_tpu.ops.kernels import swiglu_pallas as sw
+        cc = kernel_cost("paddle_tpu.ops.kernels.swiglu_pallas")
+        sheet = next(s for s in cc["kernels"] if s["label"] == "swiglu_fwd")
+        g = jax.ShapeDtypeStruct((512, 2048), jnp.bfloat16)
+        closed = trace_callable(sw.reference_swiglu, g, g)
+        jx = closed.jaxpr
+        io_bytes = (sum(aval_bytes(v.aval) for v in jx.invars)
+                    + sum(aval_bytes(v.aval) for v in jx.outvars))
+        ratio = sheet["hbm_bytes"] / max(io_bytes, 1)
+        block["graph_cross_check"] = {
+            "kernel": "swiglu_pallas _fwd_kernel",
+            "sheet_hbm_bytes": sheet["hbm_bytes"],
+            "graph_io_bytes": int(io_bytes),
+            "graph_composite_bytes": int(build_graph(closed).total_bytes()),
+            "ratio": round(ratio, 3),
+            "tolerance": [0.5, 2.0],
+            "ok": bool(0.5 <= ratio <= 2.0),
+        }
+        return block
+    except Exception:
+        return {"error": traceback.format_exc(limit=2)[:500]}
+
+
 def run_gpt_bench(dev, on_tpu):
     import numpy as np
     import paddle_tpu as paddle
@@ -1830,6 +1909,13 @@ def _child_main(mode):
                 except Exception:
                     errs[key + "_error"] = traceback.format_exc(limit=2)[:600]
                 _write_partial(result)
+            try:
+                result["extra"]["kernel_static"] = _kernel_static_block(
+                    result["extra"].get("kernel_ab"))
+            except Exception:
+                errs["kernel_static_error"] = \
+                    traceback.format_exc(limit=2)[:600]
+            _write_partial(result)
             result.setdefault("extra", {}).update(errs)
             _write_partial(result)
         else:

@@ -2,70 +2,29 @@
 
 Lints files/directories with the trace-safety rules and exits nonzero
 when any error-severity finding remains after filtering — the CI-gate
-contract ``tools/lint_examples.py`` builds on.
+contract ``tools/lint_examples.py`` builds on. The flag surface and
+exit-code policy are the shared driver's (:mod:`..analysis.cli`).
 """
 
 from __future__ import annotations
 
-import argparse
-import json
 import sys
 
-from .diagnostics import SEVERITIES, format_text, severity_rank
-from .engine import analyze_paths, has_errors
+from .cli import run_lint_cli
+from .engine import analyze_paths
 from .rules import RULES
 
 
-def _rule_table() -> str:
-    rows = [f"{r.id}  {r.severity:7s}  {r.name}: {r.summary}"
-            for r in sorted(RULES.values(), key=lambda r: r.id)]
-    return "\n".join(rows)
-
-
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
+    return run_lint_cli(
+        argv,
         prog="python -m paddle_tpu.analysis",
         description="Trace-safety linter: catches retrace storms, graph "
                     "breaks, and host syncs in to_static code before "
-                    "they run (docs/static_analysis.md).")
-    ap.add_argument("paths", nargs="*",
-                    help=".py files or directories to lint")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
-    ap.add_argument("--select", default=None,
-                    help="comma-separated rule ids to report "
-                         "(e.g. TS001,TS005); default: all")
-    ap.add_argument("--min-severity", choices=SEVERITIES, default="info",
-                    help="drop findings below this severity")
-    ap.add_argument("--list-rules", action="store_true",
-                    help="print the rule table and exit")
-    args = ap.parse_args(argv)
-
-    if args.list_rules:
-        print(_rule_table())
-        return 0
-    if not args.paths:
-        ap.error("no paths given (or use --list-rules)")
-
-    findings = analyze_paths(args.paths)
-    if args.select:
-        keep = {s.strip().upper() for s in args.select.split(",")}
-        findings = [f for f in findings if f.rule_id in keep]
-    max_rank = severity_rank(args.min_severity)
-    findings = [f for f in findings
-                if severity_rank(f.severity) <= max_rank]
-
-    if args.format == "json":
-        print(json.dumps({
-            "findings": [f.to_dict() for f in findings],
-            "counts": {s: sum(1 for f in findings if f.severity == s)
-                       for s in SEVERITIES},
-        }, indent=2))
-    else:
-        for f in findings:
-            print(format_text(f))
-        n_err = sum(1 for f in findings if f.severity == "error")
-        print(f"{len(findings)} finding(s), {n_err} error(s)")
-    return 1 if has_errors(findings) else 0
+                    "they run (docs/static_analysis.md).",
+        rules=RULES,
+        analyze=analyze_paths,
+        select_example="TS001,TS005")
 
 
 if __name__ == "__main__":

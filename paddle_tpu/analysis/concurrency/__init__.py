@@ -106,55 +106,16 @@ def has_errors(findings) -> bool:
 
 
 # ---------------------------------------------------------------------------
-# allowlist (house style: tools/ga_allowlist.txt, tools/tsan_allowlist.txt)
+# allowlist — the generic machinery now lives in ..cli (shared with the
+# kernel tier's tools/pk_allowlist.txt); these re-exports keep the
+# published paddle.analysis.concurrency surface stable
 # ---------------------------------------------------------------------------
 
-def load_allowlist(path) -> set:
-    """``{(file_suffix, rule_id), ...}`` from one ``<path> <rule>``-per-
-    line file; ``#`` comments carry the mandatory justification."""
-    out = set()
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.split("#", 1)[0].strip()
-                if not line:
-                    continue
-                parts = line.split()
-                if len(parts) >= 2:
-                    out.add((parts[0].replace("\\", "/"),
-                             parts[1].upper()))
-    except OSError:
-        pass
-    return out
+from ..cli import apply_allowlist, load_allowlist  # noqa: E402,F401
+from ..cli import discover_allowlist as _discover_allowlist  # noqa: E402
 
 
 def discover_allowlist(paths) -> str | None:
     """Walk up from each analyzed path looking for
     ``tools/cs_allowlist.txt`` (the repo-root convention)."""
-    for p in paths:
-        d = os.path.abspath(p)
-        if not os.path.isdir(d):
-            d = os.path.dirname(d)
-        while True:
-            cand = os.path.join(d, ALLOWLIST_NAME)
-            if os.path.isfile(cand):
-                return cand
-            parent = os.path.dirname(d)
-            if parent == d:
-                break
-            d = parent
-    return None
-
-
-def apply_allowlist(findings, entries) -> tuple:
-    """(kept, waived) after dropping findings matching an allowlist
-    entry (finding file endswith the entry path, rule ids equal)."""
-    kept, waived = [], []
-    for f in findings:
-        file = f.file.replace("\\", "/")
-        if any(file.endswith(suffix) and f.rule_id == rule
-               for suffix, rule in entries):
-            waived.append(f)
-        else:
-            kept.append(f)
-    return kept, waived
+    return _discover_allowlist(paths, ALLOWLIST_NAME)

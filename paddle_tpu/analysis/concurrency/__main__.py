@@ -6,84 +6,30 @@ and allowlisting — the CI-gate contract ``tools/lint_examples.py`` and
 ``tools/tsan_check.py`` build on. Waivers (each with a one-line
 justification) live in ``tools/cs_allowlist.txt``, auto-discovered by
 walking up from the analyzed paths (override with ``--allowlist``,
-disable with ``--no-allowlist``).
+disable with ``--no-allowlist``). Flags, waiver handling and exit codes
+come from the shared driver (:mod:`..cli`).
 """
 
 from __future__ import annotations
 
-import argparse
-import json
 import sys
 
-from ..diagnostics import SEVERITIES, format_text, severity_rank
-from . import (RULES, analyze_paths, apply_allowlist, discover_allowlist,
-               has_errors, load_allowlist)
-
-
-def _rule_table() -> str:
-    rows = [f"{r.id}  {r.severity:7s}  {r.name}: {r.summary}"
-            for r in sorted(RULES.values(), key=lambda r: r.id)]
-    return "\n".join(rows)
+from ..cli import run_lint_cli
+from . import ALLOWLIST_NAME, RULES, analyze_paths
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
+    return run_lint_cli(
+        argv,
         prog="python -m paddle_tpu.analysis.concurrency",
         description="Lock-discipline linter: inconsistent guards, "
                     "lock-order inversions, signal-unsafe handlers, "
                     "unbounded shutdown waits "
-                    "(docs/static_analysis.md#concurrency-tier).")
-    ap.add_argument("paths", nargs="*",
-                    help=".py files or directories to lint")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
-    ap.add_argument("--select", default=None,
-                    help="comma-separated rule ids to report "
-                         "(e.g. CS100,CS101); default: all")
-    ap.add_argument("--min-severity", choices=SEVERITIES, default="info",
-                    help="drop findings below this severity")
-    ap.add_argument("--allowlist", default=None,
-                    help="waiver file (default: tools/cs_allowlist.txt "
-                         "discovered above the analyzed paths)")
-    ap.add_argument("--no-allowlist", action="store_true",
-                    help="report waived findings too (fixture tests)")
-    ap.add_argument("--list-rules", action="store_true",
-                    help="print the rule table and exit")
-    args = ap.parse_args(argv)
-
-    if args.list_rules:
-        print(_rule_table())
-        return 0
-    if not args.paths:
-        ap.error("no paths given (or use --list-rules)")
-
-    findings = analyze_paths(args.paths)
-    waived: list = []
-    if not args.no_allowlist:
-        path = args.allowlist or discover_allowlist(args.paths)
-        if path:
-            findings, waived = apply_allowlist(
-                findings, load_allowlist(path))
-    if args.select:
-        keep = {s.strip().upper() for s in args.select.split(",")}
-        findings = [f for f in findings if f.rule_id in keep]
-    max_rank = severity_rank(args.min_severity)
-    findings = [f for f in findings
-                if severity_rank(f.severity) <= max_rank]
-
-    if args.format == "json":
-        print(json.dumps({
-            "findings": [f.to_dict() for f in findings],
-            "waived": [f.to_dict() for f in waived],
-            "counts": {s: sum(1 for f in findings if f.severity == s)
-                       for s in SEVERITIES},
-        }, indent=2))
-    else:
-        for f in findings:
-            print(format_text(f))
-        n_err = sum(1 for f in findings if f.severity == "error")
-        extra = f", {len(waived)} waived" if waived else ""
-        print(f"{len(findings)} finding(s), {n_err} error(s){extra}")
-    return 1 if has_errors(findings) else 0
+                    "(docs/static_analysis.md#concurrency-tier).",
+        rules=RULES,
+        analyze=analyze_paths,
+        allowlist_name=ALLOWLIST_NAME,
+        select_example="CS100,CS101")
 
 
 if __name__ == "__main__":

@@ -13,20 +13,14 @@ Entrypoints: a registered name (``--list-entrypoints``) or a custom
 
 from __future__ import annotations
 
-import argparse
 import json
 import os
 import sys
 
-from ..diagnostics import SEVERITIES, format_text, severity_rank
+from ..cli import build_parser, filter_findings, rule_table
+from ..diagnostics import SEVERITIES, format_text
 from .entrypoints import build_entrypoint, list_entrypoints
 from .rules import GA_RULES, analyze_graph
-
-
-def _rule_table() -> str:
-    rows = [f"{r.id}  {r.severity:7s}  {r.name}: {r.summary}"
-            for r in sorted(GA_RULES.values(), key=lambda r: r.id)]
-    return "\n".join(rows)
 
 
 def _candidate_table(report, top: int) -> str:
@@ -43,25 +37,21 @@ def _candidate_table(report, top: int) -> str:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
+    ap = build_parser(
         prog="python -m paddle_tpu.analysis.graph",
         description="Graph-level program analyzer: fusion-boundary, "
                     "memory-traffic, and sharding-consistency lints over "
-                    "traced jaxprs (docs/static_analysis.md#graph-tier).")
-    ap.add_argument("entrypoints", nargs="*",
-                    help="registered entrypoint name(s) or file.py:fn")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
-    ap.add_argument("--select", default=None,
-                    help="comma-separated rule ids (e.g. GA100,GA106)")
-    ap.add_argument("--min-severity", choices=SEVERITIES, default="info")
+                    "traced jaxprs (docs/static_analysis.md#graph-tier).",
+        positional="entrypoints",
+        positional_help="registered entrypoint name(s) or file.py:fn",
+        select_example="GA100,GA106")
     ap.add_argument("--top", type=int, default=3,
                     help="fusion candidates to print (default 3)")
-    ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--list-entrypoints", action="store_true")
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        print(_rule_table())
+        print(rule_table(GA_RULES))
         return 0
     if args.list_entrypoints:
         for name in list_entrypoints():
@@ -77,13 +67,8 @@ def main(argv=None) -> int:
     for spec in args.entrypoints:
         jaxpr, name = build_entrypoint(spec)
         report = analyze_graph(jaxpr, name=name)
-        findings = report.findings
-        if args.select:
-            keep = {s.strip().upper() for s in args.select.split(",")}
-            findings = [f for f in findings if f.rule_id in keep]
-        max_rank = severity_rank(args.min_severity)
-        findings = [f for f in findings
-                    if severity_rank(f.severity) <= max_rank]
+        findings = filter_findings(report.findings, args.select,
+                                   args.min_severity)
         n_err = sum(1 for f in findings if f.severity == "error")
         rc = rc or (1 if n_err else 0)
         if args.format == "json":

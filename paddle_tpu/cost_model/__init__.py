@@ -18,13 +18,30 @@ from __future__ import annotations
 import time
 
 from ..decomposition import _pure_fn
-from .collective import (CHIP_PRESETS, LinkSpec, all_gather_s,  # noqa: F401
-                         all_reduce_s, all_to_all_s, chip_preset,
-                         collective_s, p2p_s, reduce_scatter_s)
+from .collective import (CHIP_PRESETS, ChipSpec, LinkSpec,  # noqa: F401
+                         all_gather_s, all_reduce_s, all_to_all_s,
+                         chip_preset, chip_vmem_bytes, collective_s,
+                         p2p_s, reduce_scatter_s)
 
-__all__ = ['CostModel', 'LinkSpec', 'CHIP_PRESETS', 'chip_preset',
+__all__ = ['CostModel', 'LinkSpec', 'ChipSpec', 'CHIP_PRESETS',
+           'chip_preset', 'chip_vmem_bytes', 'kernel_cost',
            'all_reduce_s', 'all_gather_s', 'reduce_scatter_s',
            'all_to_all_s', 'p2p_s', 'collective_s']
+
+
+def kernel_cost(module_or_path, chip=None):
+    """STATIC resource sheets for every ``pallas_call`` a kernel module's
+    ``pk_examples()`` invocations reach: per-grid-step VMEM residency,
+    FLOPs, HBM bytes moved and arithmetic intensity, judged against the
+    ``chip`` preset's ``vmem_bytes`` budget.
+
+    This is the analyzer→cost-model bridge (docs/static_analysis.md
+    #kernel-tier): the future block-shape autotuner calls this as its
+    admissibility filter — only candidates whose sheet fits VMEM are
+    worth a measured trial. Lazy import keeps the analysis tier out of
+    every ``import paddle_tpu.cost_model``."""
+    from ..analysis.kernels import kernel_cost as _impl
+    return _impl(module_or_path, chip=chip)
 
 
 class CostModel:

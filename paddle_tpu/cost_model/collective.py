@@ -37,8 +37,9 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-__all__ = ["LinkSpec", "CHIP_PRESETS", "chip_preset", "all_reduce_s",
-           "all_gather_s", "reduce_scatter_s", "all_to_all_s", "p2p_s",
+__all__ = ["LinkSpec", "ChipSpec", "CHIP_PRESETS", "chip_preset",
+           "chip_vmem_bytes", "all_reduce_s", "all_gather_s",
+           "reduce_scatter_s", "all_to_all_s", "p2p_s",
            "collective_s", "COLLECTIVE_FORMULAS"]
 
 
@@ -61,32 +62,61 @@ class LinkSpec:
                 "latency_us": self.latency_us}
 
 
+class ChipSpec(dict):
+    """A chip preset: a plain dict (the planner indexes ``preset["ici"]``)
+    that also answers attribute access (``chip_preset("v5e").vmem_bytes``)
+    so the kernels and the kernel analyzer read one source of truth."""
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
 #: Public per-chip numbers (TPU system datasheets). ``ici`` is the
 #: per-chip aggregate inter-chip-interconnect bandwidth inside a slice;
 #: ``dcn`` the per-chip share of the data-center network between slices.
-#: ``peak_flops`` is dense bf16.
+#: ``peak_flops`` is dense bf16. ``vmem_bytes`` is the per-core VMEM the
+#: Pallas pipeline stages blocks through (~16 MiB/core on current chips;
+#: v6e doubles it) — the budget every kernel's block picker and the PK200
+#: residency check share.
+_MIB = 1024 * 1024
 CHIP_PRESETS = {
-    "v4":  {"ici": LinkSpec(300.0, 1.0), "dcn": LinkSpec(25.0, 10.0),
-            "hbm_gb": 32.0, "peak_flops": 275e12},
-    "v5e": {"ici": LinkSpec(186.0, 1.0), "dcn": LinkSpec(25.0, 10.0),
-            "hbm_gb": 16.0, "peak_flops": 197e12},
-    "v5p": {"ici": LinkSpec(600.0, 1.0), "dcn": LinkSpec(25.0, 10.0),
-            "hbm_gb": 95.0, "peak_flops": 459e12},
-    "v6e": {"ici": LinkSpec(448.0, 1.0), "dcn": LinkSpec(25.0, 10.0),
-            "hbm_gb": 32.0, "peak_flops": 918e12},
+    "v4":  ChipSpec(ici=LinkSpec(300.0, 1.0), dcn=LinkSpec(25.0, 10.0),
+                    hbm_gb=32.0, peak_flops=275e12, vmem_bytes=16 * _MIB),
+    "v5e": ChipSpec(ici=LinkSpec(186.0, 1.0), dcn=LinkSpec(25.0, 10.0),
+                    hbm_gb=16.0, peak_flops=197e12, vmem_bytes=16 * _MIB),
+    "v5p": ChipSpec(ici=LinkSpec(600.0, 1.0), dcn=LinkSpec(25.0, 10.0),
+                    hbm_gb=95.0, peak_flops=459e12, vmem_bytes=16 * _MIB),
+    "v6e": ChipSpec(ici=LinkSpec(448.0, 1.0), dcn=LinkSpec(25.0, 10.0),
+                    hbm_gb=32.0, peak_flops=918e12, vmem_bytes=32 * _MIB),
     # the virtual 8-device CPU test mesh: numbers chosen so plans are
-    # deterministic and memory is never the binding constraint by accident
-    "cpu": {"ici": LinkSpec(10.0, 1.0), "dcn": LinkSpec(1.0, 50.0),
-            "hbm_gb": 4.0, "peak_flops": 5e10},
+    # deterministic and memory is never the binding constraint by accident;
+    # vmem_bytes mirrors v5e so interpret-mode kernels pick real shapes
+    "cpu": ChipSpec(ici=LinkSpec(10.0, 1.0), dcn=LinkSpec(1.0, 50.0),
+                    hbm_gb=4.0, peak_flops=5e10, vmem_bytes=16 * _MIB),
 }
 
 
-def chip_preset(name: str) -> dict:
+def chip_preset(name: str) -> ChipSpec:
     try:
         return CHIP_PRESETS[name]
     except KeyError:
         raise KeyError(f"unknown chip preset {name!r} "
                        f"(have {sorted(CHIP_PRESETS)})") from None
+
+
+def chip_vmem_bytes(name: str | None = None) -> int:
+    """Per-core VMEM budget for the current (or named) chip preset.
+
+    The chip is named by ``$PADDLE_TPU_CHIP`` (default ``v5e``); unknown
+    names fall back to ``v5e`` too, so an exotic env value degrades to
+    the conservative 16 MiB rather than crashing a kernel import."""
+    import os
+    name = name or os.environ.get("PADDLE_TPU_CHIP", "v5e")
+    preset = CHIP_PRESETS.get(name) or CHIP_PRESETS["v5e"]
+    return int(preset["vmem_bytes"])
 
 
 def all_reduce_s(nbytes: float, n: int, link: LinkSpec) -> float:

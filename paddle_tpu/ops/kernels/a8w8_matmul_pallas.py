@@ -39,9 +39,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ...cost_model.collective import chip_vmem_bytes
 from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
 
-_VMEM_BUDGET = 10 * 1024 * 1024
+# 5/8 of the chip preset's VMEM (10 MiB on the 16 MiB presets): x + w +
+# out + acc blocks, leaving headroom for the pipeline's double buffering
+_VMEM_BUDGET = (chip_vmem_bytes() * 5) // 8
 
 
 def _kernel(x_ref, w_ref, ws_ref, o_ref, *, nk_layout):
@@ -61,7 +64,7 @@ def _kernel(x_ref, w_ref, ws_ref, o_ref, *, nk_layout):
 
 def _pick_blocks(m, k, n, itemsize):
     bn = 256
-    while k * bn > 4 * 1024 * 1024 and bn > 128:     # int8 weight block
+    while k * bn > chip_vmem_bytes() // 4 and bn > 128:  # int8 weight block
         bn //= 2
     budget_x = max(_VMEM_BUDGET - k * bn - bn * 4, k * itemsize * 8)
     bm = pick_row_block(m, k * itemsize, budget_x, key="a8w8")
@@ -124,3 +127,16 @@ def reference_a8w8(x, w_q, w_scales):
     acc = q @ w_q.astype(jnp.float32)
     out = acc * s_row * w_scales.reshape(1, n).astype(jnp.float32)
     return out.astype(x.dtype).reshape(*lead, n)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    return [
+        ("a8w8_kn", a8w8_matmul,
+         (s((512, 1024), jnp.bfloat16), s((1024, 2048), jnp.int8),
+          s((2048,), jnp.float32)), {}),
+        ("a8w8_nk", a8w8_matmul,
+         (s((512, 1024), jnp.bfloat16), s((2048, 1024), jnp.int8),
+          s((2048,), jnp.float32)), {"layout": "nk"}),
+    ]

@@ -115,3 +115,16 @@ def adamw_update(w32, g, m, v, lr, step, *, beta1, beta2, eps, wd,
     return _adamw_call(w32, g, m, v, scalars, beta1=float(beta1),
                        beta2=float(beta2), eps=float(eps), wd=float(wd),
                        out_dtype=jnp.dtype(out_dtype), interpret=interpret)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    arrs = (s((4096, 1024), f32),) * 4
+    return [
+        ("adamw_update", adamw_update,
+         arrs + (s((), f32), s((), f32)),
+         dict(beta1=0.9, beta2=0.999, eps=1e-8, wd=0.01,
+              out_dtype=jnp.bfloat16)),
+    ]

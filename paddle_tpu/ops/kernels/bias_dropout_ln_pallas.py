@@ -214,3 +214,17 @@ def reference_bias_dropout_ln(x, bias, residual, mask, gamma, beta, eps):
     var = jnp.mean(jnp.square(h - mu), axis=-1, keepdims=True)
     y = (h - mu) * jax.lax.rsqrt(var + eps) * gamma + beta
     return y.astype(x.dtype), h.astype(x.dtype)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    bf16 = jnp.bfloat16
+    x = s((512, 1024), bf16)
+    vec = s((1024,), bf16)
+    kw = dict(eps=1e-5, interpret=False, rows=128)
+    return [
+        ("fused_fwd", _fused_fwd, (x, vec, x, None, vec, vec), kw),
+        ("fused_fwd_mask", _fused_fwd, (x, vec, x, x, vec, vec), kw),
+        ("fused_bwd", _fused_bwd, (x, x, vec, x), kw),
+    ]

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...cost_model.collective import chip_vmem_bytes
 from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
 from .dropout_add_pallas import _GOLDEN, _fmix32, _keep_bits, _params
 
@@ -72,7 +73,7 @@ def _pick_rows(n_rows, hidden, act):
     buffers live at once in the backward); budget on the widest."""
     width = hidden * (2 if act == "swiglu" else 1)
     return pick_row_block(n_rows, (width + 4 * hidden) * 4,
-                          4 * 1024 * 1024, key="block_fused")
+                          chip_vmem_bytes() // 4, key="block_fused")
 
 
 def _gelu_tanh(x):
@@ -487,3 +488,24 @@ def reference_fused_epilogue(x, residual, weight, bias=None, seed=0, p=0.0,
         y = y + bias.reshape(1, hd).astype(jnp.float32)
     dt = residual.dtype
     return y.astype(dt).reshape(shp), h.astype(dt).reshape(shp)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    bf16 = jnp.bfloat16
+    hd = 1024
+    x = s((512, hd), bf16)
+    x2w = s((512, 2 * hd), bf16)
+    w = s((hd,), bf16)
+    base = dict(threshold=0, scale=1.0, eps=1e-6, norm="rms",
+                interpret=False, rows=128)
+    return [
+        ("attn_epilogue_fwd", _fwd, (x, x, w, None, None),
+         dict(base, act=None, kname="pk_attn")),
+        ("mlp_swiglu_fwd", _fwd, (x2w, x, w, None, None),
+         dict(base, act="swiglu", kname="pk_mlp")),
+        ("epilogue_bwd", _bwd, (x, None, w, x, None, None),
+         dict(base, act=None, kname="pk_bwd", has_bias=False,
+              x_dtype=jnp.dtype(jnp.bfloat16))),
+    ]

@@ -158,3 +158,13 @@ def reference_ce(logits, label):
     tgt = jnp.take_along_axis(lg, label[..., None].astype(jnp.int32),
                               axis=-1)[..., 0]
     return lse - tgt
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    return [
+        ("row_stats", _row_stats,
+         (s((512, 4096), jnp.float32), s((512,), jnp.int32)),
+         dict(vocab_start=0, interpret=False)),
+    ]

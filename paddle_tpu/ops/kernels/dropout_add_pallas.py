@@ -189,3 +189,16 @@ def reference_dropout_add(x, residual, seed, p):
                      jnp.float32(0.0))
     y = kept + residual.reshape(n, h).astype(jnp.float32)
     return y.astype(x.dtype).reshape(shp)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    thr, scl = _params(0.1)
+    x = s((512, 1024), jnp.bfloat16)
+    seed = s((), jnp.int32)
+    kw = dict(threshold=thr, scale=scl, interpret=False, rows=128)
+    return [
+        ("dropout_add_fwd", _fwd, (x, x, seed), kw),
+        ("dropout_add_bwd", _bwd, (x, seed), kw),
+    ]

@@ -450,3 +450,21 @@ def flash_attention_backward(q, k, v, out, lse, g, causal=False, block_q=256,
     dv_out = jnp.swapaxes(
         dv.reshape(b, h_kv, n_rep, s, d).sum(2).astype(v.dtype), 1, 2)
     return dq_out, dk_out, dv_out
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    bf16 = jnp.bfloat16
+    b, sq, h, h_kv, d = 2, 1024, 8, 2, 128
+    q = s((b, sq, h, d), bf16)
+    kv = s((b, sq, h_kv, d), bf16)
+    full = s((b, sq, h, d), bf16)
+    lse = s((b * h, sq), jnp.float32)
+    return [
+        ("fwd_causal", flash_attention_forward, (q, kv, kv),
+         dict(causal=True)),
+        ("fwd_lse", flash_attention_forward_lse, (q, kv, kv), {}),
+        ("bwd_causal", flash_attention_backward,
+         (q, kv, kv, full, lse, full), dict(causal=True)),
+    ]

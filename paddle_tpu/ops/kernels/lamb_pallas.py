@@ -172,3 +172,16 @@ def reference_lamb(w32, g, m, v, lr, step, *, beta1, beta2, eps, wd):
     trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
     w2 = w32 - lr * trust * r
     return w2, m2, v2, trust
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    arrs = (s((4096, 1024), f32),) * 4
+    return [
+        ("lamb_update", lamb_update,
+         arrs + (s((), f32), s((), f32)),
+         dict(beta1=0.9, beta2=0.999, eps=1e-6, wd=0.01,
+              out_dtype=jnp.bfloat16)),
+    ]

@@ -114,3 +114,13 @@ def reference_grad_acc(x2, dy2, acc):
         x2, dy2, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     return (acc.astype(jnp.float32) + part).astype(acc.dtype)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    return [
+        ("grad_acc", _grad_acc,
+         (s((512, 1024), jnp.bfloat16), s((512, 2048), jnp.bfloat16),
+          s((1024, 2048), jnp.float32)), dict(interpret=False)),
+    ]

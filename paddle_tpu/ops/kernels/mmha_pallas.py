@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...cost_model.collective import chip_vmem_bytes
 from ._common import round_up, jit_x64_off
 
 
@@ -43,9 +44,10 @@ NEG_INF = -1e30
 BLOCK_T = 256
 
 # full-cache VMEM residency bound per (batch, kv-head) program: k + v blocks
-# must fit comfortably under the ~16MB VMEM budget with room for the
-# accumulators and double buffering
-_VMEM_BYTES = 8 * 1024 * 1024
+# must fit comfortably under the chip preset's VMEM capacity with room for
+# the accumulators and double buffering — half the shared budget
+# (cost_model.chip_vmem_bytes, also the kernel analyzer's PK200 bound)
+_VMEM_BYTES = chip_vmem_bytes() // 2
 
 
 def _mmha_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_t, scale):
@@ -166,3 +168,14 @@ def reference_mmha(q, k_buf, v_buf, pos):
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bgrst,bgtd->bsgrd", probs, v_buf.astype(jnp.float32))
     return out.reshape(b, s, h, d).astype(q.dtype)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    bf16 = jnp.bfloat16
+    return [
+        ("mmha_decode", mmha_decode,
+         (s((8, 1, 32, 128), bf16), s((8, 8, 2048, 128), bf16),
+          s((8, 8, 2048, 128), bf16), s((8,), jnp.int32)), {}),
+    ]

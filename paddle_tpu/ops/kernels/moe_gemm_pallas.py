@@ -132,3 +132,13 @@ def reference_grouped_matmul(x, w, counts):
     c = x.shape[1]
     mask = jnp.arange(c)[None, :, None] < counts.reshape(-1, 1, 1)
     return jnp.where(mask, out, 0)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    return [
+        ("grouped_gemm", _grouped_call,
+         (s((8, 256, 1024), jnp.bfloat16), s((8, 1024, 4096), jnp.bfloat16),
+          s((8,), jnp.int32)), dict(interpret=False)),
+    ]

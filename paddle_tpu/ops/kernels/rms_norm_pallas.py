@@ -174,3 +174,17 @@ def _vjp_bwd(eps, interpret, saved, grads):
 
 
 rms_norm_fused.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    bf16 = jnp.bfloat16
+    x = s((512, 1024), bf16)
+    vec = s((1024,), bf16)
+    kw = dict(eps=1e-6, interpret=False, rows=128)
+    return [
+        ("rms_fwd_plain", _fused_fwd, (x, None, vec), kw),
+        ("rms_fwd_res", _fused_fwd, (x, x, vec), kw),
+        ("rms_bwd", _fused_bwd, (x, vec, x), kw),
+    ]

@@ -111,3 +111,13 @@ def rope_reference(x, cos, sin):
     x1, x2 = jnp.split(x, 2, axis=-1)
     rot = jnp.concatenate([-x2, x1], axis=-1)
     return x * cos + rot * sin
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    return [
+        ("rope", _rope_call,
+         (s((2, 1024, 16, 128), jnp.bfloat16), s((1024, 128), jnp.float32),
+          s((1024, 128), jnp.float32)), dict(interpret=False, rows=128)),
+    ]

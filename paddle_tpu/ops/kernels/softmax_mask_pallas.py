@@ -203,3 +203,18 @@ def reference_softmax_mask(x, mask=None):
     else:
         xf = xf + mask.astype(jnp.float32)
     return jax.nn.softmax(xf, axis=-1).astype(x.dtype)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    bf16 = jnp.bfloat16
+    b, heads, sq, sk = 2, 8, 512, 512
+    x = s((b * heads, sq, sk), bf16)
+    kw = dict(interpret=False, rows=128)
+    return [
+        ("softmax_mask_fwd", _fused_fwd, (x, s((b, sq, sk), bf16)),
+         dict(kw, heads=heads)),
+        ("softmax_tri_fwd", _fused_fwd_tri, (x,), kw),
+        ("softmax_bwd", _fused_bwd, (x, x), kw),
+    ]

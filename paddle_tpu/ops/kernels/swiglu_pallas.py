@@ -215,3 +215,16 @@ def reference_swiglu(g, u=None):
         g, u = jnp.split(g, 2, axis=-1)
     gf = g.astype(jnp.float32)
     return (gf * jax.nn.sigmoid(gf) * u.astype(jnp.float32)).astype(g.dtype)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    bf16 = jnp.bfloat16
+    x = s((512, 2048), bf16)
+    kw = dict(interpret=False, rows=128)
+    return [
+        ("swiglu_fwd", _fused_fwd, (x, x), kw),
+        ("swiglu_fwd_packed", _fused_fwd_packed, (s((512, 4096), bf16),), kw),
+        ("swiglu_bwd", _fused_bwd, (x, x, x), kw),
+    ]

@@ -22,9 +22,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ...cost_model.collective import chip_vmem_bytes
 from ._common import pad_to_block, pick_row_block, x64_off, jit_x64_off
 
-_VMEM_BUDGET = 10 * 1024 * 1024  # bytes: x + w + out + acc blocks
+# x + w + out + acc blocks: 5/8 of the shared chip VMEM budget (10 MiB
+# on the 16 MiB presets), same source of truth as the kernel analyzer
+_VMEM_BUDGET = (chip_vmem_bytes() * 5) // 8
 
 
 def _wo_kernel(x_ref, w_ref, s_ref, o_ref):
@@ -56,7 +59,7 @@ def _pick_blocks(m, k, n, itemsize):
     REAL row count (a decode GEMV of 8 rows must not pad to a 256-row
     block) and honors measured autotuner overrides."""
     bn = 256
-    while k * bn > 4 * 1024 * 1024 and bn > 128:     # int8 weight block
+    while k * bn > chip_vmem_bytes() // 4 and bn > 128:  # int8 weight block
         bn //= 2
     budget_x = max(_VMEM_BUDGET - k * bn - bn * 4, k * itemsize * 8)
     bm = pick_row_block(m, k * itemsize, budget_x, key="wo_int8")
@@ -264,3 +267,18 @@ def wo_int4_matmul(x, w_packed, scales, interpret=False):
 def reference_wo_int4_matmul(x, w_packed, scales):
     w = unpack_int4_halves(w_packed)
     return jnp.matmul(x, w.astype(x.dtype)) * scales.astype(x.dtype)
+
+
+def pk_examples():
+    """Representative invocations for the kernel analyzer (PK tier)."""
+    s = jax.ShapeDtypeStruct
+    bf16 = jnp.bfloat16
+    x = s((8, 1024), bf16)
+    return [
+        ("wo_int8", wo_int8_matmul,
+         (x, s((1024, 4096), jnp.int8), s((4096,), jnp.float32)), {}),
+        ("wo_int8_grouped", wo_int8_matmul,
+         (x, s((1024, 4096), jnp.int8), s((8, 4096), jnp.float32)), {}),
+        ("wo_int4", wo_int4_matmul,
+         (x, s((1024, 2048), jnp.int8), s((4096,), jnp.float32)), {}),
+    ]

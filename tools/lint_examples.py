@@ -170,6 +170,17 @@ def concurrency_gate(out=sys.stderr) -> int:
     return rc
 
 
+def kernel_gate(out=sys.stderr) -> int:
+    """PK200-PK209 over the in-tree Pallas kernels (pk_examples traces +
+    resource sheets); 1 on non-allowlisted error findings."""
+    from paddle_tpu.analysis.kernels.__main__ import main as pk_main
+    rc = pk_main([os.path.join(ROOT, "paddle_tpu", "ops", "kernels"),
+                  "--min-severity", "error"])
+    print(f"kernel gate: paddle_tpu/ops/kernels/: "
+          f"{'FAILED' if rc else 'ok'}", file=out)
+    return rc
+
+
 def _has_paths(argv) -> bool:
     """True when argv contains a positional path (option VALUES like the
     'json' in '--format json' are not paths)."""
@@ -214,6 +225,10 @@ def main(argv=None) -> int:
     print("concurrency gate:", "FAILED (error-severity CS findings)"
           if crc else "OK", file=sys.stderr)
     rc = rc or crc
+    krc = kernel_gate()
+    print("kernel gate:", "FAILED (error-severity PK findings)"
+          if krc else "OK", file=sys.stderr)
+    rc = rc or krc
     return rc
 
 

@@ -219,6 +219,9 @@ def hbm_diagnosis(d) -> str:
         "  (peak-liveness estimation is rule GA108; "
         "see docs/static_analysis.md#graph-tier — or compile with "
         "to_static(analyze=True) / PADDLE_TPU_JIT_ANALYZE=1)")
+    lines.append(
+        "  kernel-side HBM sheets: python -m paddle_tpu.analysis.kernels "
+        "paddle_tpu/ops/kernels")
     return "\n".join(lines)
 
 
