@@ -71,6 +71,52 @@ def _flight_overhead():
     return out
 
 
+def _hist_quantile(name, q):
+    """Approximate quantile of an unlabelled histogram by linear
+    interpolation inside the owning bucket (the Prometheus
+    ``histogram_quantile`` estimate); None when the metric is absent or
+    has no observations. Overflow-bucket hits return the top finite bound
+    — a lower bound on the true quantile, still gate-worthy."""
+    import paddle_tpu.observability as obs
+    m = obs.get_registry().get(name)
+    if m is None or getattr(m, "kind", "") != "histogram":
+        return None
+    v = m.value()
+    n = v["count"]
+    if not n:
+        return None
+    target = q * n
+    prev_le, prev_acc = 0.0, 0
+    for le, acc in v["buckets"].items():
+        if le == "+Inf":
+            continue
+        bound = float(le)
+        if acc >= target:
+            span = acc - prev_acc
+            frac = (target - prev_acc) / span if span else 1.0
+            return prev_le + (bound - prev_le) * frac
+        prev_le, prev_acc = bound, acc
+    return prev_le
+
+
+def _data_pipeline_block(obs):
+    """Input-pipeline counters + consumer-side wait p50 for the telemetry
+    block. ``wait_p50_ms`` is None when no DataLoader ran in the round
+    (perf_gate skips the data-wait soft gate then)."""
+    p50 = _hist_quantile("paddle_tpu_io_batch_wait_seconds", 0.5)
+    return {
+        "batches": int(obs.total("paddle_tpu_data_batches_total")),
+        "epochs": int(obs.total("paddle_tpu_data_epochs_total")),
+        "resume_replayed": int(obs.total(
+            "paddle_tpu_data_resume_replayed_total")),
+        "resume_discarded": int(obs.total(
+            "paddle_tpu_data_resume_discarded_total")),
+        "read_retries": int(obs.total(
+            "paddle_tpu_data_read_retries_total")),
+        "wait_p50_ms": None if p50 is None else round(p50 * 1000.0, 3),
+    }
+
+
 def _attach_telemetry(result):
     """Embed the observability snapshot in the bench JSON line — ALWAYS:
     either the full telemetry block or `"telemetry": null` plus a reason,
@@ -112,6 +158,10 @@ def _attach_telemetry(result):
                     "preemptions": int(obs.total(
                         "paddle_tpu_resilience_preemptions_total")),
                 },
+                # input pipeline: delivery counters + the consumer-side
+                # wait p50 perf_gate soft-gates (a loader that starts
+                # starving the step shows up here before tokens/s moves)
+                "data_pipeline": _data_pipeline_block(obs),
             }
             # continuous profiler (observability.continuous): the measured
             # sampler cost vs its hard budget — the acceptance contract

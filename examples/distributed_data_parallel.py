@@ -7,7 +7,11 @@ fault-tolerant runtime attached when a checkpoint directory is given.
 With --ckpt-dir the loop checkpoints atomically every --save-every steps
 (async, off the training thread), resumes from the newest good checkpoint,
 and drains + exits relaunchable (code 143) on SIGTERM — the preemption
-contract multi-host TPU schedulers assume.
+contract multi-host TPU schedulers assume. The checkpoint carries the
+input pipeline too: the DataLoader is seeded (checkpointable mode) and
+fed through a per-host ShardedDataset, so a relaunch resumes the batch
+stream exactly-once at the saved cursor — and refuses a cursor restore
+under a changed shard geometry instead of silently re-dealing samples.
 
 With --metrics-port it serves live telemetry over HTTP while training
 (/metrics /healthz /flight /profile) and the continuous profiler samples
@@ -43,6 +47,27 @@ def main(steps=20, ckpt_dir=None, save_every=5, metrics_port=None):
     xv = rng.standard_normal((64, 32)).astype(np.float32)
     yv = xv.sum(-1, keepdims=True).astype(np.float32) * 0.1
 
+    class Regress(paddle.io.Dataset):
+        def __getitem__(self, i):
+            return xv[i], yv[i]
+
+        def __len__(self):
+            return len(xv)
+
+    # per-host shard view: this demo is one host (all 8 virtual devices in
+    # one process), so the deal is 1-way — a multi-host launch passes its
+    # host count/index (or ShardedDataset.from_plan with a planner plan)
+    # and each host streams a disjoint, relaunch-stable slice. The shard
+    # geometry rides the iterator checkpoint: restoring under a different
+    # deal refuses instead of silently duplicating samples.
+    hosts, host_id = 1, 0
+    shard = paddle.io.ShardedDataset(Regress(), hosts, host_id)
+    # seed= turns on checkpointable mode: epoch order is a pure function
+    # of (seed, epoch) and the cursor rides every checkpoint
+    loader = paddle.io.DataLoader(shard, batch_size=16, shuffle=True,
+                                  seed=0)
+    feed = paddle.io.prefetch_to_device(loader, depth=2, loop=True)
+
     server = None
     if metrics_port is not None:
         server = serve(metrics_port)
@@ -56,7 +81,10 @@ def main(steps=20, ckpt_dir=None, save_every=5, metrics_port=None):
         sentinel = NaNSentinel(check_every=save_every, max_consecutive=1,
                                manager=manager)
         handler = PreemptionHandler(manager).install()
-        restored = manager.restore(model=model, optimizer=opt)
+        # dataloader= restores the iterator cursor with the weights — the
+        # resumed stream continues exactly-once from the saved position
+        restored = manager.restore(model=model, optimizer=opt,
+                                   dataloader=feed)
         if restored is not None:
             start = restored
             print(f"resumed from checkpoint at step {restored}")
@@ -68,7 +96,8 @@ def main(steps=20, ckpt_dir=None, save_every=5, metrics_port=None):
         else:
             # a step-0 baseline so a NaN arriving before the first periodic
             # save still has a rewind target
-            manager.save(0, model=model, optimizer=opt, blocking=True)
+            manager.save(0, model=model, optimizer=opt, dataloader=feed,
+                         blocking=True)
 
     @paddle.jit.to_static
     def step(x, y):
@@ -78,23 +107,15 @@ def main(steps=20, ckpt_dir=None, save_every=5, metrics_port=None):
         opt.clear_grad()
         return loss
 
-    def batches(from_step):
-        # step-indexed so a NaN rewind can restart the stream exactly
-        for i in range(from_step, steps):
-            yield i, xv, yv
-
     # keep the loss on device in the hot loop (per-step float() is a host
     # sync the analyzer flags as TS008); convert once after the loop. The
     # feed is double-buffered (paddle.io.prefetch_to_device): batch k+1
     # streams to device while the mesh computes on batch k.
     first = last = None
+    i = start
     try:
-        feed = paddle.io.prefetch_to_device(batches(start), depth=2)
-        while True:
-            try:
-                i, x, y = next(feed)
-            except StopIteration:
-                break
+        while i < steps:
+            x, y = next(feed)
             last = step(x, y)
             # continuous-profiler heartbeat (sampling windows + /healthz)
             continuous.on_step(i)
@@ -103,18 +124,23 @@ def main(steps=20, ckpt_dir=None, save_every=5, metrics_port=None):
             first = first if first is not None else last
             if manager is not None:
                 sentinel.observe(last)
-                if sentinel.check(i, model=model, optimizer=opt) == "rewind":
+                if sentinel.check(i, model=model, optimizer=opt,
+                                  dataloader=feed) == "rewind":
                     # cursor = step actually restored, not latest_step();
-                    # in-flight prefetched batches belong to the abandoned
-                    # timeline — restart the feed there
-                    feed = paddle.io.prefetch_to_device(
-                        batches(sentinel.restored_step or 0), depth=2)
+                    # the iterator rewound with the weights — in-flight
+                    # prefetched batches belonged to the abandoned
+                    # timeline and were discarded (counted in telemetry)
+                    i = sentinel.restored_step or 0
                     first = None
                     continue
                 if (i + 1) % save_every == 0:
-                    manager.save(i + 1, model=model, optimizer=opt)
-                handler.maybe_exit(i + 1, model=model, optimizer=opt)
+                    manager.save(i + 1, model=model, optimizer=opt,
+                                 dataloader=feed)
+                handler.maybe_exit(i + 1, model=model, optimizer=opt,
+                                   dataloader=feed)
+            i += 1
     finally:
+        feed.close()
         if manager is not None:
             manager.wait()
             handler.uninstall()

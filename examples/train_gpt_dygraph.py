@@ -8,8 +8,13 @@ runtime attached when a checkpoint directory is given.
 With --ckpt-dir the run survives what kills plain loops: it resumes from
 the newest good checkpoint, SIGTERM drains the async save and exits
 relaunchable (code 143), and a persistent NaN loss rewinds to the last
-good state instead of ending the run. Inject failures deterministically
-via PADDLE_TPU_FAULTS (e.g. "sigterm@20" or "nan@15") to watch each path.
+good state instead of ending the run. The checkpoint carries the INPUT
+PIPELINE too: the DataLoader is seeded (checkpointable mode), so its
+cursor rides every save and a relaunch resumes the batch stream
+exactly-once — zero duplicated, zero dropped samples, even with batches
+in flight in the prefetcher. Inject failures deterministically via
+PADDLE_TPU_FAULTS (e.g. "sigterm@20", "nan@15", "data_io@3") to watch
+each path.
 
 Every abnormal path also leaves a black box: the flight recorder dumps
 flight_<step>.json next to the checkpoints (events leading up to death,
@@ -51,6 +56,23 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
     rng = np.random.default_rng(0)
     data = rng.integers(0, vocab, (4 * batch, seq + 1))
 
+    class TokenRows(paddle.io.Dataset):
+        def __getitem__(self, i):
+            row = data[i]
+            return row[:-1].astype(np.int32), row[1:].astype(np.int32)
+
+        def __len__(self):
+            return len(data)
+
+    # checkpointable input pipeline: seed= makes every epoch's order a pure
+    # function of (seed, epoch), so the iterator cursor can ride the
+    # checkpoint alongside model+optimizer and resume exactly-once. The
+    # feed is double-buffered (prefetch_to_device): batch k+1 streams to
+    # device while the chip computes on batch k.
+    loader = paddle.io.DataLoader(TokenRows(), batch_size=batch,
+                                  shuffle=True, seed=0)
+    feed = paddle.io.prefetch_to_device(loader, depth=2, loop=True)
+
     # one eager forward under memory attribution: per-module allocation
     # deltas/peaks land in observability.memory.last_attribution(), which
     # every flight dump embeds — so a later crash can name the Layer that
@@ -77,7 +99,11 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
         sentinel = NaNSentinel(check_every=save_every, max_consecutive=1,
                                manager=manager)
         handler = PreemptionHandler(manager).install()
-        restored = manager.restore(model=model, optimizer=opt)
+        # dataloader= restores the iterator cursor with the weights: the
+        # resumed stream replays exactly the batches that were speculative
+        # at save time and continues where the killed run left off
+        restored = manager.restore(model=model, optimizer=opt,
+                                   dataloader=feed)
         if restored is not None:
             start = restored
             print(f"resumed from checkpoint at step {restored}")
@@ -89,7 +115,8 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
         else:
             # a step-0 baseline so a NaN arriving before the first periodic
             # save still has a rewind target
-            manager.save(0, model=model, optimizer=opt, blocking=True)
+            manager.save(0, model=model, optimizer=opt, dataloader=feed,
+                         blocking=True)
 
     @paddle.jit.to_static
     def step(x, y):
@@ -99,27 +126,14 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
         opt.clear_grad()
         return loss
 
-    def batches(from_step):
-        # batches are a pure function of the step index, so a NaN rewind
-        # can restart the stream at any step and replay exactly
-        for i in range(from_step, steps):
-            chunk = data[(i % 4) * batch:(i % 4 + 1) * batch]
-            yield i, chunk[:, :-1].astype(np.int32), \
-                chunk[:, 1:].astype(np.int32)
-
     # loss stays on device across iterations; syncing it to host every
     # step (float() per iteration) serializes dispatch against the chip —
-    # the analyzer flags that pattern as TS008. The feed is double-buffered
-    # (paddle.io.prefetch_to_device): batch k+1 streams to device while the
-    # chip computes on batch k.
+    # the analyzer flags that pattern as TS008.
     first = last = None
+    i = start
     try:
-        feed = paddle.io.prefetch_to_device(batches(start), depth=2)
-        while True:
-            try:
-                i, x, y = next(feed)
-            except StopIteration:
-                break
+        while i < steps:
+            x, y = next(feed)
             last = step(x, y)
             # continuous profiler heartbeat: opens/closes the sampling
             # windows (a clock read on off-cadence steps) and feeds
@@ -136,19 +150,24 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
                 print(f"step {i:4d}  loss {loss_val:.4f}")
             if manager is not None:
                 sentinel.observe(last)
-                if sentinel.check(i, model=model, optimizer=opt) == "rewind":
+                if sentinel.check(i, model=model, optimizer=opt,
+                                  dataloader=feed) == "rewind":
                     # cursor follows the step actually restored (restore
-                    # may fall back past a corrupt newer checkpoint);
-                    # restart the prefetched feed at that step (in-flight
-                    # batches belong to the abandoned timeline)
-                    feed = paddle.io.prefetch_to_device(
-                        batches(sentinel.restored_step or 0), depth=2)
+                    # may fall back past a corrupt newer checkpoint); the
+                    # iterator rewound with the weights — its in-flight
+                    # batches were discarded (abandoned timeline) and the
+                    # stream replays from the restored cursor
+                    i = sentinel.restored_step or 0
                     first = None
                     continue
                 if (i + 1) % save_every == 0:
-                    manager.save(i + 1, model=model, optimizer=opt)
-                handler.maybe_exit(i + 1, model=model, optimizer=opt)
+                    manager.save(i + 1, model=model, optimizer=opt,
+                                 dataloader=feed)
+                handler.maybe_exit(i + 1, model=model, optimizer=opt,
+                                   dataloader=feed)
+            i += 1
     finally:
+        feed.close()
         if manager is not None:
             manager.wait()
             handler.uninstall()

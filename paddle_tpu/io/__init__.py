@@ -16,4 +16,6 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
-from .prefetch import prefetch_to_device  # noqa: F401
+from .prefetch import DevicePrefetcher, prefetch_to_device  # noqa: F401
+from .sharding import DataReadError, ShardedDataset, ShardedStreamReader  # noqa: F401
+from .state import IteratorStateError, batch_fingerprint  # noqa: F401

@@ -2,12 +2,11 @@
 the model's compute on batch *k*.
 
 ``jax.device_put`` is asynchronous — it enqueues the transfer and returns
-immediately — so holding a small deque of already-device_put batches ahead
-of the consumer means the copy engine streams the next batch in while the
+immediately — so a feeder thread that keeps up to ``depth`` already-
+device_put batches queued ahead of the consumer means the copy engine (and
+the host-side loader behind it) streams the next batch in while the
 accelerator is busy with the current one. This is the TPU analog of the
-reference's `DataLoader(..., use_buffer_reader=True)` device buffering: the
-DataLoader's thread/process workers overlap host-side IO + collate; this
-iterator overlaps the final host->device hop.
+reference's `DataLoader(..., use_buffer_reader=True)` device buffering.
 
 Usage::
 
@@ -19,24 +18,45 @@ Works over any iterable (a DataLoader, a generator of numpy tuples, ...).
 Tensors and numpy arrays anywhere in a (possibly nested) list/tuple/dict
 batch structure are moved; other leaves (ints, strings) pass through
 untouched.
+
+Teardown discipline (the PR 11 bounded-shutdown contract): the feeder
+thread is daemonic and its join is bounded — ``close()`` (also invoked by
+``with``-exit, iterator exhaustion, and a GC backstop) signals the stop
+event, drains the handoff queue so a blocked feeder put wakes, joins for
+a bounded window, and warns loudly on a wedged feeder instead of hanging
+the training process. A consumer that exits its loop early (break /
+exception) without calling ``close()`` leaks nothing durable: the next
+GC pass or interpreter exit runs the same bounded path.
+
+Checkpointable feeds: when the wrapped loader exposes ``state_dict()`` /
+``load_state_dict()`` (a ``DataLoader(seed=...)``), the prefetcher
+forwards both — adjusting the cursor so ``consumed`` counts batches the
+*training loop* received, and everything sitting in this queue (plus the
+loader's own worker window) is part of the speculative ``inflight`` that
+a resume replays.
 """
 
 from __future__ import annotations
 
-from collections import deque
+import queue
+import threading
+import warnings
 
 import jax
 import numpy as np
 
+from ..analysis.concurrency import tsan as _tsan
 from ..core.tensor import Tensor
 from ..observability import continuous as _cont
 from ..observability import counter as _obs_counter
 
-__all__ = ["prefetch_to_device"]
+__all__ = ["prefetch_to_device", "DevicePrefetcher"]
 
 _OBS_PREFETCH = _obs_counter(
     "paddle_tpu_io_prefetch_batches_total",
     "batches moved to device ahead of the consumer by prefetch_to_device")
+
+_END = object()
 
 
 def _device_put_tree(item, device):
@@ -53,52 +73,248 @@ def _device_put_tree(item, device):
     return item
 
 
-def prefetch_to_device(loader, depth: int = 2, device=None):
-    """Double-buffered device-transfer iterator over ``loader``.
+class DevicePrefetcher:
+    """Feeder-thread prefetch iterator over ``loader``.
 
     Keeps up to ``depth`` batches in flight: while the consumer computes on
-    batch *k*, batch *k+1* is already being transferred (``device_put`` is
-    async). ``depth=2`` is classic double buffering; deeper helps only when
-    batch arrival is bursty. Each prefetched batch pins its device memory
+    batch *k*, batch *k+1* is already transferred (``device_put`` is
+    async) and *k+2* is being fetched from the loader on the feeder
+    thread. ``depth=2`` is classic double buffering; deeper helps only
+    when batch arrival is bursty. Each queued batch pins its device memory
     until consumed — budget ``depth * batch_bytes`` of extra HBM.
 
-    ``device``: target `jax.Device` (default: the framework's current
-    default device). Yields batches with the same structure the loader
-    produced, with Tensors/ndarrays resident on-device.
-
-    Teardown is bounded by construction: the iterator owns no thread —
-    dropping it (or ``gen.close()``) releases the buffered device
-    batches immediately, and the only blocking teardown underneath is
-    the DataLoader's worker join, which is itself bounded (2s, then a
-    loud RuntimeWarning + terminate).
+    ``loop=True`` restarts ``iter(loader)`` when it drains (an infinite
+    epoch feed for training loops); the iterator then never raises
+    StopIteration and must be torn down with :meth:`close` (or a ``with``
+    block).
     """
-    if depth < 1:
-        raise ValueError(f"prefetch depth must be >= 1, got {depth}")
 
-    _END = object()
+    _JOIN_TIMEOUT_S = 2.0
 
-    def _gen():
-        buf = deque()
-        it = iter(loader)
+    def __init__(self, loader, depth: int = 2, device=None,
+                 loop: bool = False):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._loader = loader
+        self._depth = depth
+        self._device = device
+        self._loop = loop
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._closed = False
+        self._exhausted = False
+        self._consumed = 0           # batches the CONSUMER received
+        self._feeder_consumed = 0    # batches the feeder pulled from loader
+        self._thread: threading.Thread | None = None
+        self._feed_iter = None
+        self._state_lock = _tsan.lock("io.DevicePrefetcher")
+
+    # -- feeder thread -------------------------------------------------------
+
+    def _ensure_feeder(self):
+        if self._thread is not None or self._closed:
+            return
+        self._thread = threading.Thread(
+            target=self._feed, args=(self._queue, self._stop),
+            name="paddle-tpu-prefetch-feeder", daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _put(q, stop, item) -> bool:
+        """Stop-aware bounded put; False when teardown was requested."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _feed(self, q, stop):
+        # q/stop are captured per-generation: a wedged, abandoned feeder
+        # from before a load_state_dict must never touch the replacements
+        try:
+            while not stop.is_set():
+                with self._state_lock:
+                    it = self._feed_iter = iter(self._loader)
+                while not stop.is_set():
+                    if _cont.sampling_active():
+                        # continuous-profiler capture window: the feed wait
+                        # is a first-class program row ("prefetch_wait") in
+                        # the step's measured breakdown
+                        import time as _t
+                        t0 = _t.perf_counter()
+                        item = next(it, _END)
+                        _cont.record_program("prefetch_wait",
+                                             _t.perf_counter() - t0)
+                    else:
+                        item = next(it, _END)
+                    if item is _END:
+                        break
+                    batch = _device_put_tree(item, self._device)
+                    with self._state_lock:
+                        self._feeder_consumed += 1
+                    _OBS_PREFETCH.inc()
+                    if not self._put(q, stop, ("ok", batch)):
+                        return
+                if not self._loop:
+                    self._put(q, stop, ("end", None))
+                    return
+        except BaseException as e:  # forwarded to the consumer, not lost
+            self._put(q, stop, ("error", e))
+
+    # -- consumer side -------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        if self._closed:
+            raise RuntimeError("DevicePrefetcher is closed")
+        self._ensure_feeder()
         while True:
-            if _cont.sampling_active():
-                # continuous-profiler capture window: the feed wait is a
-                # first-class program row ("prefetch_wait") in the step's
-                # measured breakdown
-                import time as _t
-                t0 = _t.perf_counter()
-                item = next(it, _END)
-                _cont.record_program("prefetch_wait",
-                                     _t.perf_counter() - t0)
-            else:
-                item = next(it, _END)
-            if item is _END:
-                break
-            buf.append(_device_put_tree(item, device))
-            _OBS_PREFETCH.inc()
-            if len(buf) >= depth:
-                yield buf.popleft()
-        while buf:
-            yield buf.popleft()
+            try:
+                kind, payload = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                t = self._thread
+                if t is None or not t.is_alive():
+                    # feeder died without posting end/error (should be
+                    # impossible short of interpreter teardown) — surface
+                    # it instead of spinning forever
+                    raise RuntimeError(
+                        "prefetch feeder thread died without delivering "
+                        "an end-of-stream marker") from None
+                continue
+            if kind == "ok":
+                with self._state_lock:
+                    self._consumed += 1
+                return payload
+            if kind == "end":
+                with self._state_lock:
+                    self._exhausted = True
+                self.close()
+                raise StopIteration
+            with self._state_lock:
+                self._exhausted = True
+            self.close()
+            raise payload  # kind == "error"
 
-    return _gen()
+    # -- bounded teardown ----------------------------------------------------
+
+    def close(self, timeout: float | None = None) -> None:
+        """Idempotent bounded teardown: stop the feeder, drain the handoff
+        queue (wakes a blocked put), join for ``timeout`` seconds (default
+        2), and warn on a wedged feeder rather than hang."""
+        with self._state_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        t = self._thread
+        deadline = self._JOIN_TIMEOUT_S if timeout is None else timeout
+        if t is not None and t.is_alive():
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.1)
+                if not t.is_alive():
+                    break
+                deadline -= 0.1
+                if deadline <= 0:
+                    warnings.warn(
+                        "prefetch feeder thread did not exit within the "
+                        "teardown window; abandoning it (daemon thread — "
+                        "it cannot outlive the process)", RuntimeWarning,
+                        stacklevel=2)
+                    break
+        self._thread = None
+        # deterministically close the loader-side generator so the loader's
+        # live-iterator record clears NOW (not at some later GC pass) — a
+        # following load_state_dict must see a settled loader
+        with self._state_lock:
+            it, self._feed_iter = self._feed_iter, None
+        if it is not None and hasattr(it, "close"):
+            try:
+                it.close()
+            except (ValueError, RuntimeError):
+                pass  # wedged feeder still inside the generator frame
+        # release buffered device batches immediately
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- checkpointable-iterator passthrough ---------------------------------
+
+    def in_flight(self) -> int:
+        """Speculative batches between the training loop and the dataset:
+        this queue + the feeder's pulled-but-unqueued batch + the loader's
+        own worker window."""
+        ahead = max(self._feeder_consumed - self._consumed, 0)
+        loader_inflight = getattr(self._loader, "in_flight", lambda: 0)()
+        return ahead + int(loader_inflight)
+
+    def state_dict(self) -> dict:
+        """Loader state with the cursor moved back to the consumer's
+        position: batches this prefetcher has staged (and the loader's own
+        in-flight window) are speculative, so they fold into ``inflight``
+        and will be replayed on restore."""
+        sd = dict(self._loader.state_dict())
+        ahead = max(int(sd["consumed"]) - self._consumed, 0)
+        sd["consumed"] = self._consumed
+        sd["inflight"] = int(sd.get("inflight") or 0) + ahead
+        eb = int(sd["epoch_batches"])
+        sd["epoch"] = self._consumed // eb
+        sd["cursor"] = self._consumed % eb
+        return sd
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore in place: tear the feeder down (bounded), hand the
+        cursor to the loader, and restart lazily at the next ``next()``."""
+        discarded = self.in_flight() if not self._closed else 0
+        self.close()
+        if discarded:
+            from .state import OBS_RESUME_DISCARDED
+            OBS_RESUME_DISCARDED.inc(discarded)
+        self._loader.load_state_dict(sd)
+        with self._state_lock:
+            self._consumed = int(sd["consumed"])
+            self._feeder_consumed = self._consumed
+            self._queue = queue.Queue(maxsize=self._depth)
+            self._stop = threading.Event()
+            self._exhausted = False
+            self._closed = False  # reopened; feeder restarts on next pull
+
+    def state(self) -> dict:
+        """Small telemetry block (flight dumps, bench)."""
+        return {"consumed": self._consumed, "depth": self._depth,
+                "queued": self._queue.qsize(), "loop": self._loop,
+                "closed": self._closed}
+
+
+def prefetch_to_device(loader, depth: int = 2, device=None,
+                       loop: bool = False) -> DevicePrefetcher:
+    """Feeder-thread device-transfer iterator over ``loader`` — see
+    :class:`DevicePrefetcher`. ``device``: target `jax.Device` (default:
+    the framework's current default device). Yields batches with the same
+    structure the loader produced, with Tensors/ndarrays resident
+    on-device."""
+    return DevicePrefetcher(loader, depth=depth, device=device, loop=loop)

@@ -1,0 +1,156 @@
+"""Per-host sharded datasets and streaming reads for dp-parallel feeding.
+
+Two layers, both stacked in front of the existing loader transports (the
+thread pool, the forked workers, the shm ring — none of them change):
+
+- :class:`ShardedDataset` — a map-style strided shard view. Host *s* of *S*
+  owns global indices ``{s, s+S, s+2S, ...}``; the assignment is a pure
+  function of ``(num_shards, shard_id)``, so tearing a job down and
+  relaunching with the same host count reproduces the exact same shards
+  (the rescale-to-same-count stability the resume proof needs). Shards are
+  padded to equal length by wrapping, so every dp rank sees the same batch
+  count per epoch — collectives cannot desynchronise on a ragged tail.
+
+- :class:`ShardedStreamReader` — an IterableDataset that streams a shard
+  record-by-record with bounded retry+backoff around each read. The read
+  site consults the fault harness (``data_io@n`` clauses), so the chaos
+  gate can prove a transient storage fault is absorbed by retry while a
+  persistent one surfaces as :class:`DataReadError` instead of a hang.
+  Inside multiprocess loader workers the shard is sub-strided per worker
+  (via ``get_worker_info``) so N workers never duplicate records.
+
+``ShardedDataset.from_plan`` derives the shard geometry from the planner's
+emitted plan (dp × sharding axes) instead of example-script convention.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .dataset import Dataset, IterableDataset
+
+__all__ = ["ShardedDataset", "ShardedStreamReader", "DataReadError"]
+
+
+class DataReadError(IOError):
+    """A streaming record read failed past the bounded retry budget."""
+
+
+def _shard_args(num_shards: int, shard_id: int):
+    num_shards = int(num_shards)
+    shard_id = int(shard_id)
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if not 0 <= shard_id < num_shards:
+        raise ValueError(
+            f"shard_id must be in [0, {num_shards}), got {shard_id}")
+    return num_shards, shard_id
+
+
+def _plan_shards(plan) -> int:
+    """Data shards a plan implies: the dp and sharding (zero-redundancy)
+    axes both consume distinct input batches; mp/pp/sep replicate them."""
+    if hasattr(plan, "data_shards"):
+        return max(int(plan.data_shards()), 1)
+    return max(int(plan.degree("dp")) * int(plan.degree("sharding")), 1)
+
+
+class ShardedDataset(Dataset):
+    """Strided per-host shard view of a map-style dataset."""
+
+    def __init__(self, dataset, num_shards: int, shard_id: int):
+        self.dataset = dataset
+        self.num_shards, self.shard_id = _shard_args(num_shards, shard_id)
+        n = len(dataset)
+        if n < 1:
+            raise ValueError("cannot shard an empty dataset")
+        self._source_len = n
+        # equal length across shards: pad by wrapping (ceil division)
+        self._len = (n + self.num_shards - 1) // self.num_shards
+
+    @classmethod
+    def from_plan(cls, dataset, plan, rank: int | None = None):
+        """Shard according to a planner plan: ``num_shards`` is the product
+        of the plan's dp and sharding degrees; ``rank`` defaults to this
+        process's distributed rank (modulo the shard count, so model-
+        parallel replicas of the same dp rank read the same shard)."""
+        shards = _plan_shards(plan)
+        if rank is None:
+            from ..distributed import get_rank
+            rank = get_rank()
+        return cls(dataset, shards, int(rank) % shards)
+
+    def global_index(self, i: int) -> int:
+        if not 0 <= i < self._len:
+            raise IndexError(f"index {i} out of range for shard of {self._len}")
+        g = self.shard_id + i * self.num_shards
+        return g % self._source_len  # wrap the padded tail
+
+    def __getitem__(self, i):
+        return self.dataset[self.global_index(i)]
+
+    def __len__(self):
+        return self._len
+
+    def state(self) -> dict:
+        """Shard-assignment block embedded in a loader state_dict — restore
+        refuses a geometry change instead of silently re-dealing samples."""
+        return {"num_shards": self.num_shards, "shard_id": self.shard_id,
+                "source_len": self._source_len}
+
+
+class ShardedStreamReader(IterableDataset):
+    """Stream a shard of a map-style record source with bounded read retry.
+
+    ``source`` is anything indexable with a length (a Dataset, a list, a
+    memory-mapped record file wrapper). Each record read goes through the
+    ``data_io`` fault site and is retried up to ``max_retries`` times with
+    exponential backoff starting at ``backoff_s`` before raising
+    :class:`DataReadError`. Only IO-shaped failures (OSError) are retried;
+    anything else propagates immediately.
+    """
+
+    def __init__(self, source, num_shards: int = 1, shard_id: int = 0,
+                 max_retries: int = 3, backoff_s: float = 0.05):
+        self.source = source
+        self.num_shards, self.shard_id = _shard_args(num_shards, shard_id)
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+
+    def _read(self, g: int):
+        from ..resilience import faults as _faults
+        from .state import OBS_READ_RETRIES
+        delay = self.backoff_s
+        for attempt in range(self.max_retries + 1):
+            try:
+                _faults.on_data_read(f"record {g}")
+                return self.source[g]
+            except OSError as e:
+                if attempt >= self.max_retries:
+                    raise DataReadError(
+                        f"record {g} failed after {attempt + 1} attempts: "
+                        f"{e}") from e
+                OBS_READ_RETRIES.inc()
+                time.sleep(delay)
+                delay *= 2
+
+    def __len__(self):
+        """Records in this host's shard (parent-side view; inside a loader
+        worker, iteration yields this shard sub-strided across workers)."""
+        n = len(self.source)
+        return max((n - self.shard_id + self.num_shards - 1)
+                   // self.num_shards, 0)
+
+    def __iter__(self):
+        # sub-stride across loader workers so each record is read once:
+        # effective stride = host shards x workers, offset by both ids
+        from .worker import get_worker_info
+        info = get_worker_info()
+        workers = info.num_workers if info is not None else 1
+        wid = info.id if info is not None else 0
+        stride = self.num_shards * workers
+        start = self.shard_id + wid * self.num_shards
+        for g in range(start, len(self.source), stride):
+            yield self._read(g)

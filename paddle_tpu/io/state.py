@@ -1,0 +1,112 @@
+"""Checkpointable-iterator state: counters, fingerprints, live registry.
+
+The exactly-once contract for the input pipeline (docs/resilience.md
+"Input pipeline") hinges on one number: ``consumed`` — batches the training
+loop has actually received, monotone across epochs. Everything else in a
+loader's ``state_dict()`` (epoch, cursor) is derived by divmod against the
+fixed per-epoch batch count, so an in-flight prefetch buffer that spans an
+epoch roll cannot desynchronise the cursor. This module holds the shared
+pieces: the telemetry counters, the batch fingerprint used by the chaos
+ledger, and a weak registry of live checkpointable loaders the flight
+recorder snapshots into post-mortem dumps.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import weakref
+
+import numpy as np
+
+from ..observability import counter as _obs_counter
+
+__all__ = ["IteratorStateError", "batch_fingerprint", "snapshot_active",
+           "STATE_VERSION"]
+
+#: bump when the state_dict schema changes incompatibly; load_state_dict
+#: rejects versions it does not understand instead of misreading them
+STATE_VERSION = 1
+
+OBS_BATCHES = _obs_counter(
+    "paddle_tpu_data_batches_total",
+    "batches delivered to the training loop by checkpointable loaders")
+OBS_RESUME_REPLAYED = _obs_counter(
+    "paddle_tpu_data_resume_replayed_total",
+    "speculative in-flight batches recomputed after load_state_dict")
+OBS_RESUME_DISCARDED = _obs_counter(
+    "paddle_tpu_data_resume_discarded_total",
+    "materialized-but-unconsumed batches abandoned by load_state_dict")
+OBS_EPOCHS = _obs_counter(
+    "paddle_tpu_data_epochs_total",
+    "epochs completed by checkpointable loaders")
+OBS_READ_RETRIES = _obs_counter(
+    "paddle_tpu_data_read_retries_total",
+    "streaming record reads retried after a transient IO failure")
+
+
+class IteratorStateError(RuntimeError):
+    """A loader state operation cannot be honoured: unsupported dataset
+    kind (IterableDataset has no replayable cursor), incompatible schema
+    version, or a shard/geometry mismatch between save and restore."""
+
+
+def batch_fingerprint(batch) -> str:
+    """Deterministic sha256 hex digest of a batch's array contents.
+
+    The chaos ledger proves exactly-once delivery by comparing fingerprint
+    sequences across a killed run, its resume, and an uninterrupted
+    reference — so the digest must be a pure function of the sample values,
+    independent of device placement, batch object identity, or tree
+    container type (tuple vs list collate round-trips through workers).
+    """
+    h = hashlib.sha256()
+
+    def _feed(item):
+        data = getattr(item, "_data", item)  # Tensor -> backing array
+        if hasattr(data, "__array__") or isinstance(data, np.ndarray):
+            arr = np.asarray(data)
+            h.update(str(arr.dtype).encode())
+            h.update(str(arr.shape).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        elif isinstance(data, dict):
+            for k in sorted(data):
+                h.update(str(k).encode())
+                _feed(data[k])
+        elif isinstance(data, (tuple, list)):
+            for v in data:
+                _feed(v)
+        else:
+            h.update(repr(data).encode())
+
+    _feed(batch)
+    return h.hexdigest()
+
+
+# -- live-loader registry (flight-recorder surface) ---------------------------
+
+_live_lock = threading.Lock()
+_live: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register(loader) -> None:
+    """Track a live checkpointable loader for post-mortem state dumps."""
+    with _live_lock:
+        _live.add(loader)
+
+
+def snapshot_active() -> list[dict]:
+    """state_dict() of every live checkpointable loader, best-effort.
+
+    Called from the flight recorder's dump path, possibly in a dying
+    process — must never raise and never import anything new.
+    """
+    out = []
+    with _live_lock:
+        loaders = list(_live)
+    for ld in loaders:
+        try:
+            out.append(ld.state_dict())
+        except Exception as e:  # a loader mid-teardown must not kill the dump
+            out.append({"error": f"{type(e).__name__}: {e}"})
+    return out

@@ -295,6 +295,12 @@ class MultiprocessLoaderIter:
     def __iter__(self):
         return self
 
+    def in_flight(self) -> int:
+        """Index batches dispatched to workers but not yet delivered to the
+        consumer — the speculative window a checkpointable loader must
+        discard (live abandon) or replay (resume) on restore."""
+        return max(self._send_idx - self._rcvd_idx, 0)
+
     def __next__(self):
         while True:
             if self._rcvd_idx in self._reorder:

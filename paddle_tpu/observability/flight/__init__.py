@@ -205,6 +205,16 @@ class FlightRecorder:
                 payload["tracing"] = tracing_mod.flight_snapshot()
             except Exception:
                 payload["tracing"] = None
+        # input-pipeline cursors: where every live checkpointable loader's
+        # stream died (epoch/cursor/in-flight) — same no-new-imports rule
+        io_state_mod = sys.modules.get("paddle_tpu.io.state")
+        if io_state_mod is not None:
+            try:
+                snap = io_state_mod.snapshot_active()
+                if snap:
+                    payload["iterator_state"] = snap
+            except Exception:
+                payload["iterator_state"] = None
         if extra:
             payload["extra"] = extra
         return payload

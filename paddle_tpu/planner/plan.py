@@ -121,6 +121,13 @@ class Plan:
         return max(self.global_batch
                    // (self.degree("dp") * self.degree("sharding") * m), 1)
 
+    def data_shards(self) -> int:
+        """How many distinct input shards this plan's feeding needs: the
+        dp and sharding axes both consume different batches; mp/pp/sep
+        ranks replicate their dp rank's stream. This is the shard count
+        ``paddle.io.ShardedDataset.from_plan`` deals the dataset into."""
+        return max(self.degree("dp") * self.degree("sharding"), 1)
+
     def summary(self) -> str:
         d = self.mesh
         sched = self.schedule
@@ -248,7 +255,8 @@ def apply_plan(model, plan: Plan, devices=None):
 
     _ACTIVE = {"fingerprint": plan.fingerprint(),
                "mesh": {a: plan.degree(a) for a in MESH_AXES},
-               "summary": plan.summary()}
+               "summary": plan.summary(),
+               "data_shards": plan.data_shards()}
     from ..observability import metrics as _m
     _m.counter("paddle_tpu_planner_plans_applied_total",
                "plans applied via apply_plan").inc()

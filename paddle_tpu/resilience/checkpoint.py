@@ -181,13 +181,19 @@ class CheckpointManager:
     # -- save ----------------------------------------------------------------
 
     def save(self, step: int, model=None, optimizer=None, scaler=None,
-             lr_scheduler=None, extra=None, blocking: bool | None = None,
+             lr_scheduler=None, dataloader=None, extra=None,
+             blocking: bool | None = None,
              wait_timeout: float | None = None):
         """Snapshot state now; commit synchronously or in the background.
 
         Any component may be omitted. RNG state (global generator + named
-        tracker streams) is always captured. Returns the background thread
-        when committing asynchronously, else None.
+        tracker streams) is always captured. ``dataloader`` is anything
+        exposing the checkpointable-iterator contract (``state_dict()`` —
+        a ``DataLoader(seed=...)`` or the ``DevicePrefetcher`` wrapping
+        one); its cursor is captured at call time like every other
+        component, so the restored stream resumes at exactly the batch the
+        training loop would have consumed next. Returns the background
+        thread when committing asynchronously, else None.
 
         ``wait_timeout`` bounds the drain of a previous in-flight async
         save (default: block until drained). The preemption path passes
@@ -196,7 +202,7 @@ class CheckpointManager:
         writes are still serialized against it by the io lock).
         """
         payload = self._snapshot(step, model, optimizer, scaler,
-                                 lr_scheduler, extra)
+                                 lr_scheduler, dataloader, extra)
         sync = not self.async_save if blocking is None else blocking
         drained = self.wait(wait_timeout)  # ≤1 in flight; bounds memory
         if sync:
@@ -219,7 +225,8 @@ class CheckpointManager:
         th.start()
         return th
 
-    def _snapshot(self, step, model, optimizer, scaler, lr_scheduler, extra):
+    def _snapshot(self, step, model, optimizer, scaler, lr_scheduler,
+                  dataloader, extra):
         """Pack every component to host-side plain objects at call time."""
         from ..core.generator import get_rng_state, get_rng_state_tracker
         from ..framework.io import _pack
@@ -227,6 +234,8 @@ class CheckpointManager:
                          "rng": get_rng_state(),
                          "rng_tracker":
                              get_rng_state_tracker().get_states_tracker()}
+        if dataloader is not None:
+            payload["data"] = dict(dataloader.state_dict())
         if model is not None:
             sd = model.state_dict() if hasattr(model, "state_dict") else model
             payload["model"] = _pack(sd)
@@ -329,11 +338,14 @@ class CheckpointManager:
             return None
 
     def restore(self, model=None, optimizer=None, scaler=None,
-                lr_scheduler=None, step: int | None = None,
+                lr_scheduler=None, dataloader=None, step: int | None = None,
                 required: bool = False):
         """Load the newest good checkpoint (or exactly `step`) into the
-        given components, in place. Returns the restored step, or None when
-        no usable checkpoint exists (raises CheckpointNotFoundError when
+        given components, in place. ``dataloader`` receives the saved
+        iterator cursor via ``load_state_dict`` (exactly-once resume: the
+        batches that were speculative at save time are replayed, nothing
+        is skipped). Returns the restored step, or None when no usable
+        checkpoint exists (raises CheckpointNotFoundError when
         ``required``). Corrupt or partial checkpoints are counted, skipped,
         and never applied."""
         self.wait()  # an async save may still be committing
@@ -346,7 +358,8 @@ class CheckpointManager:
                 _OBS_CORRUPT.inc()
                 fallbacks += 1
                 continue
-            self._apply(payload, model, optimizer, scaler, lr_scheduler)
+            self._apply(payload, model, optimizer, scaler, lr_scheduler,
+                        dataloader)
             _OBS_RESTORES.inc()
             if fallbacks:
                 _OBS_FALLBACKS.inc(fallbacks)
@@ -359,7 +372,8 @@ class CheckpointManager:
                 f"(examined {len(candidates)})")
         return None
 
-    def _apply(self, payload, model, optimizer, scaler, lr_scheduler):
+    def _apply(self, payload, model, optimizer, scaler, lr_scheduler,
+               dataloader=None):
         from ..core.generator import (set_rng_state, get_rng_state_tracker)
         from ..framework.io import _unpack
         if model is not None and "model" in payload:
@@ -370,6 +384,8 @@ class CheckpointManager:
             scaler.load_state_dict(payload["scaler"])
         if lr_scheduler is not None and "lr_scheduler" in payload:
             lr_scheduler.set_state_dict(dict(payload["lr_scheduler"]))
+        if dataloader is not None and "data" in payload:
+            dataloader.load_state_dict(dict(payload["data"]))
         if "rng" in payload:
             set_rng_state(payload["rng"])
         if payload.get("rng_tracker"):

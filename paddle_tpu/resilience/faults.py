@@ -11,10 +11,12 @@ Spec grammar (``PADDLE_TPU_FAULTS`` environment variable or
     spec     := clause ("," clause)*
     clause   := kind "@" n [":" param]
     kind     := "save_io" | "nan" | "sigterm" | "worker_slow" | "worker_dead"
+              | "data_io" | "loader_stall"
     n        := integer — step number for step-indexed kinds (nan, sigterm),
                 1-based occurrence count for event-indexed kinds
-                (save_io, worker_slow, worker_dead)
-    param    := float — kind-specific (worker_slow: seconds to stall)
+                (save_io, worker_slow, worker_dead, data_io, loader_stall)
+    param    := float — kind-specific (worker_slow / loader_stall: seconds
+                to stall)
 
 Examples::
 
@@ -23,6 +25,8 @@ Examples::
     PADDLE_TPU_FAULTS="sigterm@7"          # SIGTERM delivered entering step 7
     PADDLE_TPU_FAULTS="worker_slow@3:2.5"  # 3rd worker fetch stalls 2.5 s
     PADDLE_TPU_FAULTS="worker_dead@3"      # 3rd worker fetch hard-exits
+    PADDLE_TPU_FAULTS="data_io@2"          # 2nd streaming record read raises
+    PADDLE_TPU_FAULTS="loader_stall@4:1.5" # 4th loader batch stalls 1.5 s
     PADDLE_TPU_FAULTS="nan@5,nan@6,sigterm@9"   # clauses compose
 
 Step-indexed clauses are one-shot: after firing at step N they are consumed,
@@ -48,9 +52,10 @@ from ..observability import flight as _flight
 
 __all__ = ["FaultSpec", "FaultInjector", "install", "uninstall", "inject",
            "get_active", "on_save_write", "on_train_step", "on_worker_fetch",
-           "InjectedIOError"]
+           "on_data_read", "on_loader_next", "InjectedIOError"]
 
-KINDS = ("save_io", "nan", "sigterm", "worker_slow", "worker_dead")
+KINDS = ("save_io", "nan", "sigterm", "worker_slow", "worker_dead",
+         "data_io", "loader_stall")
 _STEP_INDEXED = ("nan", "sigterm")
 
 _OBS_INJECTED = _obs_counter(
@@ -179,6 +184,28 @@ class FaultInjector:
             _flight.record("fault_injected", fault="worker_dead", at=c.at)
             os._exit(3)
 
+    def data_read(self, detail: str = "") -> None:
+        """Inside a streaming record read: the Nth read raises an
+        InjectedIOError. The sharded reader's bounded retry+backoff is the
+        recovery path under test — a transient clause is absorbed, repeated
+        clauses exhaust the retry budget and surface DataReadError."""
+        c = self._match_event("data_io")
+        if c is not None:
+            _OBS_INJECTED.inc(kind="data_io")
+            _flight.record("fault_injected", fault="data_io", at=c.at)
+            raise InjectedIOError(
+                f"injected IO error during data read ({detail or 'record'})")
+
+    def loader_next(self) -> None:
+        """In the loader's batch-yield path: the Nth batch stalls for
+        ``param`` seconds (default 1.0), modelling a slow storage tier the
+        wait histogram and prefetch buffer must absorb."""
+        c = self._match_event("loader_stall")
+        if c is not None:
+            _OBS_INJECTED.inc(kind="loader_stall")
+            _flight.record("fault_injected", fault="loader_stall", at=c.at)
+            time.sleep(c.param if c.param is not None else 1.0)
+
 
 _active: FaultInjector | None = None
 _env_checked = False
@@ -262,3 +289,15 @@ def on_worker_fetch() -> None:
     inj = get_active()
     if inj is not None:
         inj.worker_fetch()
+
+
+def on_data_read(detail: str = "") -> None:
+    inj = get_active()
+    if inj is not None:
+        inj.data_read(detail)
+
+
+def on_loader_next() -> None:
+    inj = get_active()
+    if inj is not None:
+        inj.loader_next()

@@ -161,7 +161,7 @@ class PreemptionHandler:
     # -- step-boundary hook --------------------------------------------------
 
     def maybe_exit(self, step: int, model=None, optimizer=None, scaler=None,
-                   lr_scheduler=None, extra=None) -> None:
+                   lr_scheduler=None, dataloader=None, extra=None) -> None:
         """No-op until preempted; then drain, write the final checkpoint at
         `step`, and raise TrainingPreempted(exit_code)."""
         if not self._preempted.is_set():
@@ -184,8 +184,8 @@ class PreemptionHandler:
             # save() must not re-join the wedged thread without a bound
             self.manager.save(step, model=model, optimizer=optimizer,
                               scaler=scaler, lr_scheduler=lr_scheduler,
-                              extra=extra, blocking=True,
-                              wait_timeout=0.0)
+                              dataloader=dataloader, extra=extra,
+                              blocking=True, wait_timeout=0.0)
         try:
             # the live telemetry server must not outlive the run: close
             # the socket and join the acceptor thread as part of the drain

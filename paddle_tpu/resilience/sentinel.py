@@ -106,7 +106,7 @@ class NaNSentinel:
         return (step + 1) % self.check_every == 0
 
     def check(self, step: int, model=None, optimizer=None,
-              lr_scheduler=None) -> str | None:
+              lr_scheduler=None, dataloader=None) -> str | None:
         """Off-cadence: returns None untouched. On cadence: one host pull of
         the window accumulator; classify the window and act."""
         if not self.should_check(step) or self._ok_accum is None:
@@ -148,7 +148,8 @@ class NaNSentinel:
             return "skip"
         restored = self.manager.restore(model=model, optimizer=optimizer,
                                         scaler=self.scaler,
-                                        lr_scheduler=lr_scheduler)
+                                        lr_scheduler=lr_scheduler,
+                                        dataloader=dataloader)
         if restored is None:
             # rewind exhaustion: the run is about to die — dump the tape
             _flight.record("nan_raise", step=int(step), no_checkpoint=True)

@@ -30,6 +30,25 @@ def test_chaos_gate_fails_without_recovery(tmp_path):
     assert cc._same(dict(ref), ref)
 
 
+def test_ledger_comparator_gates():
+    """The data-resume half must gate too: duplicated steps, dropped steps,
+    a diverged batch hash, and diverged loss bits are all failures; the
+    untouched ledger passes."""
+    cc = _load()
+    ref = [{"i": i, "sha": f"s{i}", "loss_bits": f"b{i}"} for i in range(4)]
+    ok = [dict(r) for r in ref]
+    assert cc._compare_ledgers(ref, ok, 4) is None
+    dup = ok[:2] + [dict(ok[1])] + ok[2:]
+    assert "exactly-once" in cc._compare_ledgers(ref, dup, 4)
+    assert "exactly-once" in cc._compare_ledgers(ref, ok[:3], 4)
+    wrong_sha = [dict(r) for r in ref]
+    wrong_sha[2]["sha"] = "X"
+    assert "batch hash diverged" in cc._compare_ledgers(ref, wrong_sha, 4)
+    wrong_loss = [dict(r) for r in ref]
+    wrong_loss[3]["loss_bits"] = "X"
+    assert "loss bits diverged" in cc._compare_ledgers(ref, wrong_loss, 4)
+
+
 def test_flight_dump_validator_gates(tmp_path):
     """The black-box half must gate too: missing dump, wrong reason, wrong
     final events, and schema-invalid payloads are all failures; a matching

@@ -21,6 +21,16 @@ Profiles (each compared against the same fault-free reference trajectory):
                   resume having lost 0 steps and finish identical. Flight
                   dump: reason preempted_sigterm, final events preempt ...
                   preempt_exit
+  data-resume     SIGKILL mid-epoch while a multi-worker prefetched
+                  DataLoader is streaming (real subprocess — SIGKILL
+                  cannot be survived in-process); the relaunch restores
+                  model+optimizer+ITERATOR state from the checkpoint and
+                  trains on. A per-step batch-hash ledger (fsynced JSONL)
+                  across the killed run + its resume must equal the
+                  uninterrupted reference exactly: zero duplicated, zero
+                  dropped batches, bit-identical loss curve, and the
+                  resume summary must show the speculative in-flight
+                  batches replayed (counted, not silently recomputed)
   serving-sigterm SIGTERM mid-stream into the serving engine WITH
                   prefix-cache page sharing live (a refcount-2 KV page
                   at signal time) AND speculation mid-flight (>= 1
@@ -47,6 +57,7 @@ import argparse
 import glob
 import json
 import os
+import subprocess
 import sys
 import tempfile
 
@@ -57,6 +68,14 @@ import numpy as np  # noqa: E402
 
 STEPS = 8
 FAULT_STEP = 4  # mid-run: checkpoints exist before it, work remains after
+
+# data-resume geometry: 64 samples / batch 8 = 8 batches per epoch; 12
+# steps = 1.5 epochs so the resumed stream crosses an epoch boundary;
+# SIGKILL after the step-5 checkpoint = mid-epoch with prefetch in flight
+DATA_STEPS = 12
+DATA_KILL = 5
+DATA_SAMPLES = 64
+DATA_BATCH = 8
 
 
 def _batch(step):
@@ -403,16 +422,222 @@ def profile_serving_sigterm(steps, ref):
     return None
 
 
+# -- data-resume: exactly-once input pipeline under SIGKILL ------------------
+
+def _data_child(ckpt_dir, steps, kill_at):
+    """One incarnation of the data-resume training process. Streams a
+    seeded, shuffled, multi-worker-prefetched DataLoader, checkpoints
+    model+optimizer+iterator every step, and appends a fsynced ledger line
+    per consumed batch. ``kill_at > 0``: SIGKILL self right after that
+    step's checkpoint commits — with speculative batches in the worker
+    queues, which is the whole point."""
+    import signal
+    import time
+
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as obs
+    from paddle_tpu.io import (DataLoader, batch_fingerprint,
+                               prefetch_to_device)
+    from paddle_tpu.io.dataset import Dataset
+    from paddle_tpu.resilience import CheckpointManager
+
+    class _Rows(Dataset):
+        """Sample i is a pure function of i: any duplicate or dropped batch
+        changes its fingerprint chain."""
+
+        def __getitem__(self, i):
+            rng = np.random.default_rng(2000 + i)
+            x = rng.standard_normal(4).astype(np.float32)
+            return x, np.float32(x.sum() * 0.5).reshape(1)
+
+        def __len__(self):
+            return DATA_SAMPLES
+
+    class _Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = paddle.create_parameter([4, 1], "float32",
+                                             name="chaos_data_w")
+            self.b = paddle.create_parameter([1], "float32",
+                                             name="chaos_data_b",
+                                             is_bias=True)
+
+        def forward(self, x):
+            return x.matmul(self.w) + self.b
+
+    obs.enable(True)  # the replay-accounting counters are part of the proof
+    paddle.seed(0)
+    model = _Net()
+    opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+    loader = DataLoader(_Rows(), batch_size=DATA_BATCH, shuffle=True,
+                        seed=7, num_workers=2, prefetch_factor=2)
+    feed = prefetch_to_device(loader, depth=2, loop=True)
+    mgr = CheckpointManager(ckpt_dir, keep_n=steps + 1)
+    start = mgr.restore(model=model, optimizer=opt, dataloader=feed) or 0
+    replay0 = obs.total("paddle_tpu_data_resume_replayed_total")
+    restored_inflight = loader._replay_budget  # what the restore owes us
+    ledger = open(os.path.join(ckpt_dir, "ledger.jsonl"), "a")
+    for i in range(start, steps):
+        x, y = feed.__next__()
+        sha = batch_fingerprint((x, y))
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        loss_bits = np.float32(np.asarray(loss.numpy())).tobytes().hex()
+        ledger.write(json.dumps({"i": i, "sha": sha,
+                                 "loss_bits": loss_bits}) + "\n")
+        ledger.flush()
+        os.fsync(ledger.fileno())
+        if kill_at and i + 1 == kill_at:
+            # let the workers refill the speculative window so the saved
+            # state carries inflight > 0 — the replay the gate must prove
+            time.sleep(0.2)
+        mgr.save(i + 1, model=model, optimizer=opt, dataloader=feed,
+                 blocking=True)
+        if kill_at and i + 1 == kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)
+    summary = {"summary": {
+        "start": start, "steps": steps,
+        "restored_inflight": int(restored_inflight),
+        "replayed": int(obs.total("paddle_tpu_data_resume_replayed_total")
+                        - replay0)}}
+    ledger.write(json.dumps(summary) + "\n")
+    ledger.flush()
+    os.fsync(ledger.fileno())
+    ledger.close()
+    feed.close()
+    return 0
+
+
+def _read_ledger(path):
+    """(entries, summaries) from a ledger JSONL file."""
+    entries, summaries = [], []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            (summaries if "summary" in row else entries).append(row)
+    return entries, [s["summary"] for s in summaries]
+
+
+def _compare_ledgers(ref_entries, entries, steps):
+    """The exactly-once proof: the killed-run + resume ledger must cover
+    step 0..steps-1 exactly once, with the same batch hash AND the same
+    loss bits as the uninterrupted reference at every step. Returns an
+    error string or None."""
+    seq = [e["i"] for e in entries]
+    if sorted(seq) != list(range(steps)):
+        dup = sorted({i for i in seq if seq.count(i) > 1})
+        missing = sorted(set(range(steps)) - set(seq))
+        return (f"ledger is not exactly-once: duplicated steps {dup}, "
+                f"dropped steps {missing}")
+    if seq != list(range(steps)):
+        return f"ledger out of order: {seq}"
+    ref_by_i = {e["i"]: e for e in ref_entries}
+    for e in entries:
+        r = ref_by_i.get(e["i"])
+        if r is None:
+            return f"reference ledger has no step {e['i']}"
+        if e["sha"] != r["sha"]:
+            return (f"batch hash diverged at step {e['i']}: the resumed "
+                    f"stream delivered a different batch than the "
+                    f"uninterrupted reference")
+        if e["loss_bits"] != r["loss_bits"]:
+            return (f"loss bits diverged at step {e['i']}: "
+                    f"{e['loss_bits']} vs reference {r['loss_bits']}")
+    return None
+
+
+def _run_data_child(ckpt_dir, steps, kill_at=0, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_TPU_FAULTS", None)  # SIGKILL is the only fault here
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--data-child", ckpt_dir, "--steps", str(steps)]
+    if kill_at:
+        cmd += ["--kill-at", str(kill_at)]
+    # stdout/stderr go to a FILE, not a pipe: the SIGKILLed child leaves
+    # orphaned loader workers holding the fds, and capture_output would
+    # block on pipe EOF long after waitpid() has the exit status
+    log_path = os.path.join(ckpt_dir, "child.log")
+    with open(log_path, "a") as log:
+        proc = subprocess.run(cmd, env=env, timeout=timeout,
+                              stdout=log, stderr=subprocess.STDOUT)
+    with open(log_path) as f:
+        proc.tail = f.read()[-500:]
+    return proc
+
+
+def profile_data_resume(steps, ref):
+    """SIGKILL mid-epoch under multi-worker prefetch; relaunch; the batch-
+    hash ledger across both incarnations must equal an uninterrupted
+    reference run exactly (zero dup, zero drop, bit-identical loss) and
+    the resume must account for every replayed speculative batch. ``ref``
+    (the in-process trajectory) is unused: this profile runs real
+    processes, because SIGKILL is not deliverable any other way."""
+    with tempfile.TemporaryDirectory() as ref_d, \
+            tempfile.TemporaryDirectory() as d:
+        r = _run_data_child(ref_d, DATA_STEPS)
+        if r.returncode != 0:
+            return f"reference run failed rc={r.returncode}: {r.tail}"
+        ref_entries, _ = _read_ledger(os.path.join(ref_d, "ledger.jsonl"))
+        if [e["i"] for e in ref_entries] != list(range(DATA_STEPS)):
+            return f"reference ledger malformed: {ref_entries}"
+
+        r = _run_data_child(d, DATA_STEPS, kill_at=DATA_KILL)
+        if r.returncode != -9:
+            return (f"killed run exited rc={r.returncode}, wanted -9 "
+                    f"(SIGKILL): {r.tail}")
+        entries, _ = _read_ledger(os.path.join(d, "ledger.jsonl"))
+        if [e["i"] for e in entries] != list(range(DATA_KILL)):
+            return (f"killed run's ledger should hold exactly steps "
+                    f"0..{DATA_KILL - 1}, got {[e['i'] for e in entries]}")
+
+        r = _run_data_child(d, DATA_STEPS)
+        if r.returncode != 0:
+            return f"resumed run failed rc={r.returncode}: {r.tail}"
+        entries, summaries = _read_ledger(os.path.join(d, "ledger.jsonl"))
+        err = _compare_ledgers(ref_entries, entries, DATA_STEPS)
+        if err:
+            return err
+        if not summaries:
+            return "resumed run wrote no summary line"
+        s = summaries[-1]
+        if s["start"] != DATA_KILL:
+            return f"resume started at {s['start']}, wanted {DATA_KILL}"
+        if s["restored_inflight"] < 1:
+            return ("saved state carried no speculative in-flight batches "
+                    "— the kill did not land under multi-worker prefetch")
+        if s["replayed"] != s["restored_inflight"]:
+            return (f"replay accounting broken: {s['replayed']} counted, "
+                    f"{s['restored_inflight']} speculative batches were in "
+                    f"flight at save")
+    return None
+
+
 PROFILES = (("kill-mid-save", profile_kill_mid_save),
             ("nan-at-step-k", profile_nan_at_step),
             ("sigterm-at-k", profile_sigterm_at_step),
+            ("data-resume", profile_data_resume),
             ("serving-sigterm", profile_serving_sigterm))
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=STEPS)
+    ap.add_argument("--data-child", metavar="CKPT_DIR", default=None,
+                    help="internal: run one data-resume training "
+                         "incarnation against CKPT_DIR and exit")
+    ap.add_argument("--kill-at", type=int, default=0,
+                    help="internal: with --data-child, SIGKILL self right "
+                         "after this step's checkpoint commits")
     args = ap.parse_args(argv)
+    if args.data_child is not None:
+        steps = args.steps if args.steps != STEPS else DATA_STEPS
+        return _data_child(args.data_child, steps, args.kill_at)
     ref = _reference(args.steps)
     failed = 0
     for name, fn in PROFILES:

@@ -16,7 +16,7 @@ is the BEST of the last 3 recorded rounds for the same metric — a slow
 round cannot quietly lower the bar for the next one — tolerance tightens
 to 3%, and the signed delta is printed so a regression fails loudly.
 
-Beyond throughput, three soft gates ride the same baseline (all lower-is-
+Beyond throughput, four soft gates ride the same baseline (all lower-is-
 better, all env-tunable, value <= 0 disables):
 
   steady-state step latency  extra.step_breakdown.step_ms, tolerance
@@ -28,6 +28,12 @@ better, all env-tunable, value <= 0 disables):
                              dispatch loop creeping back, a ~10x jump)
   peak HBM                   extra.peak_hbm_bytes (bench memory census),
                              tolerance PERF_GATE_HBM_TOL_PCT (default 5%)
+  data-loader wait p50       telemetry.data_pipeline.wait_p50_ms (consumer
+                             blocked on the input pipeline), tolerance
+                             PERF_GATE_DATA_WAIT_TOL_PCT (default 50% —
+                             sub-ms p50s are host-noisy; the gate catches
+                             prefetch ceasing to hide the load, a ~10x
+                             jump)
 
 so the BENCH_*.json trajectory guards latency and memory regressions
 instead of just accumulating them. Rounds that predate either field pass
@@ -258,6 +264,19 @@ def peak_hbm_bytes(d):
         return None
 
 
+def data_wait_p50_ms(d):
+    """Consumer-side DataLoader wait p50 from the bench telemetry's
+    data_pipeline block (None when the round predates it or no loader ran
+    in the measured window). Guards the input pipeline: a feeding path
+    that starts starving the training step shows up here before the
+    headline tokens/s clearly moves."""
+    try:
+        v = d["telemetry"]["data_pipeline"]["wait_p50_ms"]
+        return float(v) if v is not None else None
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
 def _tol_pct(env_name, default):
     try:
         return float(os.environ.get(env_name, default))
@@ -282,7 +301,13 @@ def soft_gates(cd, bd):
             ("host_dispatch", host_dispatch_ms, "PERF_GATE_DISPATCH_TOL_PCT",
              150.0, "ms"),
             ("peak_hbm", peak_hbm_bytes, "PERF_GATE_HBM_TOL_PCT",
-             5.0, "bytes")):
+             5.0, "bytes"),
+            # data-loader wait: p50 of a sub-millisecond histogram is
+            # noisy between hosts, so the default tolerance is wide; it
+            # still catches a prefetch pipeline that stopped hiding the
+            # load (an order-of-magnitude move)
+            ("data_wait_p50", data_wait_p50_ms, "PERF_GATE_DATA_WAIT_TOL_PCT",
+             50.0, "ms")):
         tol = _tol_pct(env, default)
         if tol <= 0:
             continue
