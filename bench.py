@@ -41,6 +41,11 @@ _PARTIAL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 _STEADY_RETRACES: list = []
 _STEADY_RETRACES_BY_FN: dict = {}
 
+# HealthMonitor snapshot of the LAST _train_throughput loop (observability
+# .health rides inside the measured window — the <1% overhead contract is
+# only honest measured live); consumed by _attach_telemetry
+_HEALTH_BLOCK: dict = {}
+
 
 def _retraces_by_fn(obs):
     """{qualname: count} view of the labeled retraces counter."""
@@ -72,31 +77,16 @@ def _flight_overhead():
 
 
 def _hist_quantile(name, q):
-    """Approximate quantile of an unlabelled histogram by linear
-    interpolation inside the owning bucket (the Prometheus
-    ``histogram_quantile`` estimate); None when the metric is absent or
-    has no observations. Overflow-bucket hits return the top finite bound
-    — a lower bound on the true quantile, still gate-worthy."""
+    """Quantile of an unlabelled histogram via the registry's shared
+    ``Histogram.quantile`` (linear interpolation inside the owning bucket;
+    overflow hits return the top finite bound — a lower bound on the true
+    quantile, still gate-worthy); None when the metric is absent or has
+    no observations."""
     import paddle_tpu.observability as obs
     m = obs.get_registry().get(name)
     if m is None or getattr(m, "kind", "") != "histogram":
         return None
-    v = m.value()
-    n = v["count"]
-    if not n:
-        return None
-    target = q * n
-    prev_le, prev_acc = 0.0, 0
-    for le, acc in v["buckets"].items():
-        if le == "+Inf":
-            continue
-        bound = float(le)
-        if acc >= target:
-            span = acc - prev_acc
-            frac = (target - prev_acc) / span if span else 1.0
-            return prev_le + (bound - prev_le) * frac
-        prev_le, prev_acc = bound, acc
-    return prev_le
+    return m.quantile(q)
 
 
 def _data_pipeline_block(obs):
@@ -163,6 +153,13 @@ def _attach_telemetry(result):
                 # starving the step shows up here before tokens/s moves)
                 "data_pipeline": _data_pipeline_block(obs),
             }
+            # training-health monitor: the window stats + the measured
+            # monitor cost (<1% of window wall, the acceptance contract —
+            # perf_gate soft-gates health_overhead_pct on it)
+            if _HEALTH_BLOCK:
+                result["telemetry"]["health"] = dict(_HEALTH_BLOCK)
+                result["telemetry"]["health_overhead_pct"] = round(
+                    float(_HEALTH_BLOCK.get("overhead_pct", 0.0)), 4)
             # continuous profiler (observability.continuous): the measured
             # sampler cost vs its hard budget — the acceptance contract
             # (<1% of steady-state step time) rides every trajectory line,
@@ -255,6 +252,14 @@ def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
     x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
     y = paddle.to_tensor(ids[:, 1:].astype(np.int32))
 
+    # training-health telemetry rides inside the measured loop (like the
+    # continuous profiler): the fold inlines into the step program, the
+    # cadence check is the one host pull per window, and the snapshot's
+    # overhead_pct is the <1% acceptance number perf_gate soft-gates
+    from paddle_tpu.observability.health import HealthMonitor
+    health = HealthMonitor(opt, check_every=5,
+                           tokens_per_step=batch * seq)
+
     # donate param/opt-state buffers on TPU: halves the peak HBM the update
     # step holds (old + new state), buying batch/activation headroom
     @functools.partial(paddle.jit.to_static, donate_state=on_tpu)
@@ -262,12 +267,15 @@ def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
         _, loss = model(x, labels=y)
         loss.backward()
         opt.step()
+        health.observe_grads()  # folded into the step program
         opt.clear_grad()
         return loss
 
     for _ in range(warmup):
         loss = train_step(x, y)
     float(loss)  # sync
+    health.reset_window()  # drop the warmup partial window
+    pulls0 = health.host_pulls
     # steady-state telemetry window: any trace-cache retrace INSIDE the
     # timed loop means the measurement included a recompile — perf_gate
     # fails the round on it (observability wiring)
@@ -286,6 +294,8 @@ def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
     try:
         for i in range(steps):
             loss = train_step(x, y)
+            health.observe(loss)
+            health.check(i)
             cont.on_step(i)
         final = float(loss)  # device sync
         dt = time.perf_counter() - t0
@@ -306,6 +316,9 @@ def _train_throughput(model, batch, seq, steps, warmup, vocab, on_tpu,
               + traceback.format_exc(limit=2), file=sys.stderr)
     _STEADY_RETRACES.append(
         int(obs.total("paddle_tpu_jit_trace_cache_retraces_total") - retr0))
+    _HEALTH_BLOCK.clear()
+    _HEALTH_BLOCK.update(health.snapshot(),
+                         measured_pulls=health.host_pulls - pulls0)
     for fn, v in _retraces_by_fn(obs).items():
         d = v - by_fn0.get(fn, 0.0)
         if d > 0:

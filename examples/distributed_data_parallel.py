@@ -14,9 +14,12 @@ stream exactly-once at the saved cursor — and refuses a cursor restore
 under a changed shard geometry instead of silently re-dealing samples.
 
 With --metrics-port it serves live telemetry over HTTP while training
-(/metrics /healthz /flight /profile) and the continuous profiler samples
-per-program step time on its bounded-overhead cadence; the SIGTERM drain
-shuts the server down with the run.
+(/metrics /healthz /flight /profile /dashboard) and the continuous
+profiler samples per-program step time on its bounded-overhead cadence;
+the SIGTERM drain shuts the server down with the run. A HealthMonitor
+(observability.health) folds per-layer gradient statistics into the step
+program and checks anomaly rules once per save window; with --ckpt-dir
+its step-series ledger lands next to the checkpoints.
 """
 
 import argparse
@@ -27,6 +30,7 @@ import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import fleet
 from paddle_tpu.observability import continuous, serve
+from paddle_tpu.observability.health import HealthMonitor
 from paddle_tpu.resilience import (CheckpointManager, NaNSentinel,
                                    PreemptionHandler, faults)
 
@@ -99,11 +103,18 @@ def main(steps=20, ckpt_dir=None, save_every=5, metrics_port=None):
             manager.save(0, model=model, optimizer=opt, dataloader=feed,
                          blocking=True)
 
+    # training-health telemetry: folded into the step program (zero extra
+    # dispatches), one host pull per save window; the ledger (if any)
+    # lands next to the checkpoints
+    health = HealthMonitor(opt, check_every=save_every,
+                           ledger=ckpt_dir or None, tokens_per_step=16)
+
     @paddle.jit.to_static
     def step(x, y):
         loss = ((model(x) - y) ** 2).mean()
         loss.backward()
         opt.step()
+        health.observe_grads()
         opt.clear_grad()
         return loss
 
@@ -122,10 +133,15 @@ def main(steps=20, ckpt_dir=None, save_every=5, metrics_port=None):
             if faults.on_train_step(i):  # harness: corrupt this step's loss
                 last = last * float("nan")
             first = first if first is not None else last
+            # health check precedes the sentinel: the anomaly diagnosis
+            # lands on the flight tape before the nan_window verdict
+            health.observe(last)
+            health.check(i)
             if manager is not None:
                 sentinel.observe(last)
                 if sentinel.check(i, model=model, optimizer=opt,
-                                  dataloader=feed) == "rewind":
+                                  dataloader=feed,
+                                  health=health) == "rewind":
                     # cursor = step actually restored, not latest_step();
                     # the iterator rewound with the weights — in-flight
                     # prefetched batches belonged to the abandoned
@@ -141,6 +157,8 @@ def main(steps=20, ckpt_dir=None, save_every=5, metrics_port=None):
             i += 1
     finally:
         feed.close()
+        if health.ledger is not None:
+            health.ledger.close()
         if manager is not None:
             manager.wait()
             handler.uninstall()

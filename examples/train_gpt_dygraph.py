@@ -25,12 +25,22 @@ pass). Render it with:
 
 With --metrics-port the run serves live telemetry over HTTP while it
 trains — /metrics (Prometheus), /healthz (step liveness), /flight (the
-ring buffer), /profile?steps=N (on-demand capture) — and the continuous
-profiler samples per-program step time on its bounded-overhead cadence
+ring buffer), /profile?steps=N (on-demand capture), /dashboard (live
+training-health sparklines) — and the continuous profiler samples
+per-program step time on its bounded-overhead cadence
 (PADDLE_TPU_PROF_EVERY / PADDLE_TPU_PROF_BUDGET_PCT):
 
     python examples/train_gpt_dygraph.py --metrics-port 9406 &
     curl localhost:9406/healthz
+
+A HealthMonitor rides the loop (observability.health): per-layer
+gradient norms, update ratios and anomaly rules folded device-side into
+the step program, one host pull per check window. With --ckpt-dir it
+also appends the per-run step-series ledger health_ledger.jsonl next to
+the checkpoints; compare two runs with:
+
+    python -m paddle_tpu.observability.health compare \
+        runA/health_ledger.jsonl runB/health_ledger.jsonl
 """
 
 import argparse
@@ -41,6 +51,7 @@ import paddle_tpu as paddle
 from paddle_tpu.models import GPT, GPTConfig
 from paddle_tpu.observability import (continuous, flight,
                                       memory as obs_memory, serve)
+from paddle_tpu.observability.health import HealthMonitor
 from paddle_tpu.resilience import (CheckpointManager, NaNSentinel,
                                    PreemptionHandler, faults)
 
@@ -118,11 +129,20 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
             manager.save(0, model=model, optimizer=opt, dataloader=feed,
                          blocking=True)
 
+    # training-health telemetry: the gradient-dynamics counterpart to the
+    # NaN sentinel. The fold inlines into the step program below (zero
+    # extra dispatches); check(i) costs one host pull per window. With a
+    # checkpoint dir the step-series ledger rides next to the checkpoints.
+    health = HealthMonitor(opt, check_every=save_every,
+                           ledger=ckpt_dir or None,
+                           tokens_per_step=batch * seq)
+
     @paddle.jit.to_static
     def step(x, y):
         _, loss = model(x, labels=y)
         loss.backward()
         opt.step()
+        health.observe_grads()
         opt.clear_grad()
         return loss
 
@@ -142,6 +162,12 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
             if faults.on_train_step(i):  # harness: corrupt this step's loss
                 last = last * float("nan")
             first = first if first is not None else last
+            # health window: observed on the possibly-corrupted loss (the
+            # one the rest of the loop sees) and checked BEFORE the
+            # sentinel, so an anomaly diagnosis precedes the nan_window
+            # verdict on the flight tape
+            health.observe(last)
+            health.check(i)
             if i % 10 == 0:
                 loss_val = float(last)
                 # step heartbeat into the black box, at the same cadence
@@ -151,7 +177,8 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
             if manager is not None:
                 sentinel.observe(last)
                 if sentinel.check(i, model=model, optimizer=opt,
-                                  dataloader=feed) == "rewind":
+                                  dataloader=feed,
+                                  health=health) == "rewind":
                     # cursor follows the step actually restored (restore
                     # may fall back past a corrupt newer checkpoint); the
                     # iterator rewound with the weights — its in-flight
@@ -168,6 +195,8 @@ def main(steps=30, hidden=128, layers=2, vocab=512, seq=64, batch=8,
             i += 1
     finally:
         feed.close()
+        if health.ledger is not None:
+            health.ledger.close()
         if manager is not None:
             manager.wait()
             handler.uninstall()

@@ -53,6 +53,12 @@ class ClipGradByGlobalNorm(ClipGradBase):
     """Global-norm clip (reference: ClipGradByGlobalNorm; the hybrid-parallel
     variant lives in distributed.fleet HybridParallelClipGrad)."""
 
+    #: the global norm the most recent __call__ computed — a concrete device
+    #: scalar after an eager step (the fused program returns it explicitly),
+    #: a tracer mid-trace, None before any call / when nothing was clipped.
+    #: HealthMonitor reads this instead of running a second device reduction.
+    last_global_norm = None
+
     def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
         self.clip_norm = float(clip_norm)
 
@@ -63,8 +69,10 @@ class ClipGradByGlobalNorm(ClipGradBase):
                 continue
             sq.append(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
         if not sq:
+            self.last_global_norm = None
             return params_grads
         global_norm = jnp.sqrt(sum(sq))
+        self.last_global_norm = global_norm
         scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
         out = []
         for p, g in params_grads:

@@ -79,6 +79,7 @@ from .step_timer import (  # noqa: F401
 from . import flight  # noqa: F401
 from . import memory  # noqa: F401
 from . import tracing  # noqa: F401
+from . import health  # noqa: F401  (after flight: health records to the tape)
 from . import continuous  # noqa: F401
 from .continuous import serve, shutdown_server, TelemetryServer  # noqa: F401
 
@@ -88,8 +89,8 @@ __all__ = [
     "enabled", "enable", "value", "total", "reset",
     "render_prometheus", "snapshot", "merge_into_chrome_trace",
     "StepTimer", "device_peak_flops", "analytic_mfu", "PEAK_FLOPS_TABLE",
-    "dump", "serve_text", "flight", "memory", "tracing", "continuous",
-    "serve", "shutdown_server", "TelemetryServer",
+    "dump", "serve_text", "flight", "memory", "tracing", "health",
+    "continuous", "serve", "shutdown_server", "TelemetryServer",
 ]
 
 

@@ -149,7 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             route = {"/metrics": self._metrics, "/healthz": self._healthz,
                      "/flight": self._flight, "/profile": self._profile,
-                     "/requests": self._requests}.get(url.path)
+                     "/requests": self._requests,
+                     "/dashboard": self._dashboard}.get(url.path)
             if route is None and url.path.startswith("/trace/"):
                 if method != "GET":
                     self._send_json(405, {
@@ -161,7 +162,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404 if route is None else 405, {
                     "error": f"no {method} route {url.path!r}",
                     "routes": sorted(["/metrics", "/healthz", "/flight",
-                                      "/profile", "/requests",
+                                      "/profile", "/requests", "/dashboard",
                                       "/trace/<trace_id>"] +
                                      list(routes_snapshot))})
                 return
@@ -191,6 +192,13 @@ class _Handler(BaseHTTPRequestHandler):
         from ..exporters import render_prometheus
         self._send(200, render_prometheus().encode(),
                    "text/plain; version=0.0.4; charset=utf-8")
+
+    def _dashboard(self, _q):
+        # zero-dep HTML view: inline SVG sparklines over the active
+        # HealthMonitor's window history + live ledger (health tier)
+        from ..health import dashboard as _hd
+        self._send(200, _hd.render_dashboard().encode("utf-8"),
+                   "text/html; charset=utf-8")
 
     def _healthz(self, _q):
         import time

@@ -215,6 +215,17 @@ class FlightRecorder:
                     payload["iterator_state"] = snap
             except Exception:
                 payload["iterator_state"] = None
+        # training-health picture: the monitor's last window statistics
+        # and anomaly tallies (what the run's dynamics looked like on the
+        # way down) — same no-new-imports rule
+        health_mod = sys.modules.get("paddle_tpu.observability.health")
+        if health_mod is not None:
+            try:
+                snap = health_mod.snapshot_for_flight()
+                if snap:
+                    payload["health"] = snap
+            except Exception:
+                payload["health"] = None
         if extra:
             payload["extra"] = extra
         return payload

@@ -1,0 +1,572 @@
+"""paddle_tpu.observability.health — training-health telemetry.
+
+The training-side counterpart to the serving tracing/profiling stack:
+where :class:`~paddle_tpu.resilience.NaNSentinel` sees only a binary
+``isfinite``, the :class:`HealthMonitor` watches the run's *dynamics* —
+per-layer gradient norms, parameter norms, update-to-weight ratios, the
+global gradient norm and the loss — and raises structured anomalies
+(loss spike, gradient explosion/vanish, dead layer, update ratio out of
+band) before divergence turns into NaN.
+
+Cost model (the NaNSentinel window pattern, applied to statistics):
+
+* ``observe_grads()`` — called inside the train step, after
+  ``optimizer.step()`` and before ``clear_grad()`` — folds every
+  statistic into ONE stacked device array. Under a ``to_static`` trace
+  the fold is inlined into the step program (zero extra dispatches, zero
+  retraces: the accumulator is ordinary lifted state, exactly like
+  optimizer moments); eagerly it is a single jitted program compiled
+  once per monitor.
+* ``observe(loss)`` — callable anywhere the loss Tensor is live (also
+  outside the jitted step, so harness-corrupted losses are seen) — one
+  device-side add, no sync.
+* ``check(step)`` — on the ``check_every`` cadence only — performs the
+  window's ONE device→host pull, evaluates the anomaly rules, exports
+  ``paddle_tpu_health_*`` metrics, records ``health_anomaly`` flight
+  events, and appends one line to the optional step-series
+  :class:`~paddle_tpu.observability.health.ledger.StepLedger`.
+
+When ``ClipGradByGlobalNorm`` is active, the global gradient norm is the
+one the (fused) optimizer step already computed — exposed via
+``clip.last_global_norm`` — not a second device reduction.
+
+Run-to-run comparison::
+
+    python -m paddle_tpu.observability.health compare runA.jsonl runB.jsonl
+
+Live view: the telemetry server serves ``/dashboard`` (zero-dependency
+HTML with inline SVG sparklines over the monitor's window history and
+the live ledger).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import time
+import weakref
+
+from ..metrics import counter as _counter, gauge as _gauge, total as _total
+from .. import flight as _flight
+from ...analysis.concurrency import tsan as _tsan
+from .ledger import StepLedger, read_ledger, compare_ledgers
+
+__all__ = ["HealthMonitor", "HealthAnomalyError", "StepLedger",
+           "read_ledger", "compare_ledgers", "get_monitor",
+           "snapshot_for_flight", "RULES"]
+
+#: the anomaly rule vocabulary, in evaluation order
+RULES = ("loss_spike", "grad_explosion", "grad_vanish", "dead_layer",
+         "update_ratio_oob")
+
+_M_WINDOWS = _counter("paddle_tpu_health_windows_total",
+                      "health check windows completed")
+_M_PULLS = _counter("paddle_tpu_health_host_pulls_total",
+                    "device->host stat pulls (exactly one per window)")
+_M_ANOM = _counter("paddle_tpu_health_anomalies_total",
+                   "anomaly-rule firings, labeled by rule")
+_M_GRAD = _gauge("paddle_tpu_health_grad_norm",
+                 "global gradient norm, window RMS (clip-provided when "
+                 "ClipGradByGlobalNorm is active)")
+_M_PARAM = _gauge("paddle_tpu_health_param_norm",
+                  "global parameter norm at window end")
+_M_RATIO = _gauge("paddle_tpu_health_update_ratio",
+                  "global update-to-weight proxy lr*|g|/|p|")
+_M_LOSS = _gauge("paddle_tpu_health_loss", "window-mean loss")
+_M_LAYER = _gauge("paddle_tpu_health_layer_grad_norm",
+                  "per-parameter gradient norm, window RMS")
+_M_OVER = _gauge("paddle_tpu_health_overhead_pct",
+                 "monitor host cost as % of window wall time (EWMA)")
+
+_ACTIVE = None  # weakref to the most recent monitor (dashboard/flight)
+
+
+class HealthAnomalyError(RuntimeError):
+    """Raised by HealthMonitor(action="raise") after ``max_consecutive``
+    consecutive windows with a ``rewind_on`` anomaly."""
+
+
+class HealthMonitor:
+    """Device-folded per-layer gradient statistics on a check cadence.
+
+    ::
+
+        health = HealthMonitor(opt, check_every=25, ledger=ckpt_dir)
+
+        @paddle.jit.to_static
+        def step(x, y):
+            _, loss = model(x, labels=y)
+            loss.backward()
+            opt.step()
+            health.observe_grads()   # folded into the step program
+            opt.clear_grad()
+            return loss
+
+        for i in range(steps):
+            loss = step(x, y)
+            health.observe(loss)     # device add, no sync
+            health.check(i)          # one host pull per window
+
+    ``action`` mirrors :class:`NaNSentinel`: ``"none"`` (default —
+    anomalies are telemetry only), ``"rewind"`` (needs ``manager``;
+    restores the last good checkpoint after ``max_consecutive``
+    consecutive windows with a ``rewind_on`` anomaly and sets
+    ``restored_step``), or ``"raise"``.
+    """
+
+    def __init__(self, optimizer, check_every: int = 25, *,
+                 ledger=None, run_id=None, tokens_per_step=None,
+                 manager=None, action: str = "none",
+                 rewind_on=("grad_explosion", "loss_spike"),
+                 max_consecutive: int = 3, warmup_windows: int = 3,
+                 ewma_alpha: float = 0.2, loss_spike_z: float = 6.0,
+                 grad_explode_abs: float = 1e4,
+                 grad_explode_ratio: float = 10.0,
+                 grad_vanish_abs: float = 1e-10, dead_abs: float = 0.0,
+                 update_ratio_min: float = 1e-8,
+                 update_ratio_max: float = 1e-1, history: int = 256):
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
+        if action not in ("none", "rewind", "raise"):
+            raise ValueError(f"unknown action {action!r}")
+        if action == "rewind" and manager is None:
+            raise ValueError('action="rewind" needs a CheckpointManager')
+        import jax.numpy as jnp
+        from ...core.tensor import Tensor
+        self._opt = optimizer
+        self.check_every = check_every
+        self.manager = manager
+        self.action = action
+        self.rewind_on = tuple(rewind_on)
+        self.max_consecutive = max_consecutive
+        self.warmup_windows = warmup_windows
+        self.ewma_alpha = float(ewma_alpha)
+        self.loss_spike_z = float(loss_spike_z)
+        self.grad_explode_abs = float(grad_explode_abs)
+        self.grad_explode_ratio = float(grad_explode_ratio)
+        self.grad_vanish_abs = float(grad_vanish_abs)
+        self.dead_abs = float(dead_abs)
+        self.update_ratio_min = float(update_ratio_min)
+        self.update_ratio_max = float(update_ratio_max)
+        self.tokens_per_step = tokens_per_step
+        self.ledger = ledger if isinstance(ledger, (StepLedger, type(None))) \
+            else StepLedger(ledger, run_id=run_id)
+
+        self._params = list(optimizer._parameter_list)
+        names, seen = [], set()
+        for i, p in enumerate(self._params):
+            n = getattr(p, "name", None) or f"param_{i}"
+            if n in seen:
+                n = f"{n}#{i}"
+            seen.add(n)
+            names.append(n)
+        self._names = names
+        self._shapes = [(tuple(p._data.shape), p._data.dtype)
+                       for p in self._params]
+        # reuse the clip's already-computed global norm instead of a second
+        # device reduction (only ClipGradByGlobalNorm carries the attr)
+        clip = getattr(optimizer, "_grad_clip", None)
+        self._use_extern = clip is not None and \
+            hasattr(clip, "last_global_norm")
+        n = len(self._params)
+        # the stacked stats accumulator — ordinary Tensors, so an enclosing
+        # to_static trace lifts them into the step program's state set
+        # (the fused-optimizer tracing machinery), exactly like moments.
+        # Row n+1 col 0 counts fold applications DEVICE-side: under a
+        # to_static trace the python body runs once, so a host counter
+        # cannot know how many times the compiled program folded
+        self._acc_t = Tensor(jnp.zeros((n + 2, 2), jnp.float32))
+        self._loss_t = Tensor(jnp.zeros((), jnp.float32))
+        self._jit_fold = None
+        self._fold_traced = False
+
+        self.windows = 0
+        self.host_pulls = 0
+        self.fold_dispatches = 0
+        self.restored_step: int | None = None
+        self.anomaly_counts: dict = {}
+        self.stats: dict | None = None
+        self.history = collections.deque(maxlen=history)
+        self._grad_steps = 0
+        self._loss_steps = 0
+        self._consecutive = 0
+        self._ew_loss = None
+        self._ew_loss_var = 0.0
+        self._ew_gnorm = None
+        self.overhead_pct = 0.0
+        self._cost_s = 0.0
+        self.compile_s = 0.0
+        self.total_cost_s = 0.0
+        self._win_t0 = time.perf_counter()
+        self._lock = _tsan.lock("health.monitor")
+        global _ACTIVE
+        _ACTIVE = weakref.ref(self)
+
+    # -- hot path (device only) ----------------------------------------------
+
+    def _fold(self, acc, lr, grads, params, *ext):
+        """One window-fold step over the stacked accumulator: rows
+        0..n-1 are per-parameter [grad_sq (summed over the window),
+        param_sq (last)], row n is [global grad_sq (summed), lr (last)],
+        row n+1 is [fold count (summed), 0]. Pure jnp, so it inlines
+        under a to_static trace and jits for the eager path."""
+        import jax.numpy as jnp
+        gsq = jnp.stack([jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in grads])
+        psq = jnp.stack([jnp.sum(jnp.square(p.astype(jnp.float32)))
+                         for p in params])
+        if ext:
+            # clip-provided global norm; negative sentinel = not available
+            # this step (e.g. the very first observe before any clip ran)
+            e = ext[0].astype(jnp.float32)
+            g_glob = jnp.where(e >= 0, jnp.square(e), jnp.sum(gsq))
+        else:
+            g_glob = jnp.sum(gsq)
+        col0 = acc[:, 0] + jnp.concatenate(
+            [gsq, g_glob[None], jnp.ones((1,), jnp.float32)])
+        col1 = jnp.concatenate([psq, lr.astype(jnp.float32)[None],
+                                jnp.zeros((1,), jnp.float32)])
+        return jnp.stack([col0, col1], axis=1)
+
+    def _extern_norm(self, tracing):
+        import jax
+        import jax.numpy as jnp
+        v = getattr(self._opt._grad_clip, "last_global_norm", None)
+        if v is not None and isinstance(v, jax.core.Tracer) and not tracing:
+            v = None  # stale tracer left by a completed trace
+        return jnp.asarray(-1.0, jnp.float32) if v is None else v
+
+    def observe_grads(self) -> None:
+        """Fold this step's gradient/parameter statistics into the device
+        accumulator. Call after ``optimizer.step()`` (so a global-norm
+        clip's computed norm is available) and before ``clear_grad()``.
+        Device-side only — inlined under to_static, one jitted dispatch
+        eagerly."""
+        from ...jit.api import _trace_state
+        tracing = getattr(_trace_state, "active", False)
+        t0 = 0.0 if tracing else time.perf_counter()
+        import jax.numpy as jnp
+        grads = []
+        for i, p in enumerate(self._params):
+            g = p._grad
+            if g is not None:
+                grads.append(g._data)
+            else:
+                shape, dtype = self._shapes[i]
+                grads.append(jnp.zeros(shape, dtype))
+        params = [p._data for p in self._params]
+        lr = self._opt._lr_tensor._data
+        ext = (self._extern_norm(tracing),) if self._use_extern else ()
+        acc = self._acc_t._data
+        if tracing:
+            new = self._fold(acc, lr, grads, params, *ext)
+            self._fold_traced = True
+        else:
+            first = self._jit_fold is None
+            if first:
+                import jax
+                self._jit_fold = jax.jit(self._fold)
+            new = self._jit_fold(acc, lr, grads, params, *ext)
+            self.fold_dispatches += 1
+        self._acc_t._data = new
+        self._grad_steps += 1
+        if not tracing:
+            dt = time.perf_counter() - t0
+            if first:
+                # one-time jit trace+compile: not steady-state overhead
+                self.compile_s += dt
+            else:
+                self._cost_s += dt
+
+    def observe(self, loss) -> None:
+        """Fold this step's loss into the window accumulator — one
+        device-side add, safe to call every step (and outside the jitted
+        step, so it sees the loss the rest of the loop sees)."""
+        from ...jit.api import _trace_state
+        tracing = getattr(_trace_state, "active", False)
+        t0 = 0.0 if tracing else time.perf_counter()
+        import jax.numpy as jnp
+        from ...core.tensor import Tensor
+        arr = loss._data if isinstance(loss, Tensor) else jnp.asarray(loss)
+        self._loss_t._data = self._loss_t._data + \
+            jnp.mean(arr.astype(jnp.float32))
+        self._loss_steps += 1
+        if not tracing:
+            self._cost_s += time.perf_counter() - t0
+
+    # -- cadence path (one host sync per window) -----------------------------
+
+    def should_check(self, step: int) -> bool:
+        return (step + 1) % self.check_every == 0
+
+    def check(self, step: int, model=None, optimizer=None,
+              lr_scheduler=None, dataloader=None) -> str | None:
+        """Off-cadence: None, untouched. On cadence: the window's single
+        host pull, rule evaluation, metric/flight/ledger export. Returns
+        None (clean), "anomaly" (rules fired, telemetry only), "rewind"
+        (escalated through the checkpoint manager)."""
+        from ...jit.api import _trace_state
+        if getattr(_trace_state, "active", False):
+            return None  # never pull host-side state mid-trace
+        if not self.should_check(step):
+            return None
+        if self._grad_steps == 0 and self._loss_steps == 0 \
+                and not self._fold_traced:
+            return None
+        import numpy as np
+        import jax.numpy as jnp
+        n = len(self._params)
+        combined = jnp.concatenate(
+            [self._acc_t._data.ravel(), self._loss_t._data[None]])
+        # Drain first, UNBILLED: blocking here waits out the window's
+        # still-in-flight async step programs — pipeline time the loop
+        # pays at its next sync anyway, not monitor cost (the continuous
+        # profiler's pipeline-aware floor, applied to the pull).
+        try:
+            combined.block_until_ready()
+        except AttributeError:
+            pass
+        t0 = time.perf_counter()
+        wall_w = max(t0 - self._win_t0, 1e-9)
+        a = np.asarray(combined)        # THE one batched host sync
+        self.host_pulls += 1
+        _M_PULLS.inc()
+        acc = a[:-1].reshape(n + 2, 2)
+        loss_sum = float(a[-1])
+        # the device-side fold count is the one source of truth: under a
+        # to_static trace the python body ran once, however many times the
+        # compiled program actually folded
+        gsteps, lsteps = int(round(float(acc[n + 1, 0]))), self._loss_steps
+        # fresh zeros each window (never reuse a cached array: an enclosing
+        # donate_state program may have consumed the old buffer)
+        self._acc_t._data = jnp.zeros((n + 2, 2), jnp.float32)
+        self._loss_t._data = jnp.zeros((), jnp.float32)
+        self._grad_steps = 0
+        self._loss_steps = 0
+        if gsteps == 0 and lsteps == 0:
+            self._win_t0 = time.perf_counter()
+            return None  # empty window (step program never ran)
+
+        stats = self._window_stats(step, acc, loss_sum, gsteps, lsteps,
+                                   wall_w)
+        anomalies = self._run_rules(stats)
+        stats["anomalies"] = [x["rule"] for x in anomalies]
+        self._update_ewma(stats)
+        self._export(stats, anomalies)
+        row = {k: stats.get(k) for k in
+               ("step", "wall", "window_steps", "loss", "lr", "grad_norm",
+                "param_norm", "update_ratio", "step_ms", "tokens_per_s",
+                "anomalies")}
+        with self._lock:
+            self.stats = stats
+            self.history.append(row)
+            self.windows += 1
+            for x in anomalies:
+                self.anomaly_counts[x["rule"]] = \
+                    self.anomaly_counts.get(x["rule"], 0) + 1
+        _M_WINDOWS.inc()
+        if self.ledger is not None:
+            self.ledger.append(dict(
+                row,
+                peak_hbm_bytes=_peak_hbm(),
+                retraces=int(_total(
+                    "paddle_tpu_jit_trace_cache_retraces_total"))))
+        # overhead accounting: everything this monitor cost on the host
+        # this window (fold dispatch enqueues + this check) over wall time
+        cost = self._cost_s + (time.perf_counter() - t0)
+        self._cost_s = 0.0
+        self.total_cost_s += cost
+        pct = 100.0 * cost / wall_w
+        self.overhead_pct = pct if self.windows == 1 \
+            else 0.5 * self.overhead_pct + 0.5 * pct
+        _M_OVER.set(self.overhead_pct)
+        self._win_t0 = time.perf_counter()
+        return self._escalate(step, anomalies, model, optimizer,
+                              lr_scheduler, dataloader)
+
+    # -- window math ---------------------------------------------------------
+
+    def _window_stats(self, step, acc, loss_sum, gsteps, lsteps, wall_w):
+        import numpy as np
+        n = len(self._params)
+        stats = {"step": int(step), "wall": time.time(),
+                 "window_steps": int(gsteps or lsteps),
+                 "step_ms": round(wall_w / max(gsteps, lsteps, 1) * 1e3, 4),
+                 "tokens_per_s": None, "loss": None, "lr": None,
+                 "grad_norm": None, "param_norm": None,
+                 "update_ratio": None, "layers": {}}
+        if self.tokens_per_step:
+            stats["tokens_per_s"] = round(
+                self.tokens_per_step * max(gsteps, lsteps) / wall_w, 2)
+        if lsteps:
+            stats["loss"] = loss_sum / lsteps
+        if gsteps:
+            layer_gn = np.sqrt(np.maximum(acc[:n, 0], 0.0) / gsteps)
+            layer_pn = np.sqrt(np.maximum(acc[:n, 1], 0.0))
+            gnorm = float(np.sqrt(np.maximum(acc[n, 0], 0.0) / gsteps))
+            pnorm = float(np.sqrt(np.maximum(np.sum(acc[:n, 1]), 0.0)))
+            lr = float(acc[n, 1])
+            stats["lr"] = lr
+            stats["grad_norm"] = gnorm
+            stats["param_norm"] = pnorm
+            stats["update_ratio"] = lr * gnorm / (pnorm + 1e-12)
+            stats["layers"] = {
+                name: {"grad_norm": float(layer_gn[i]),
+                       "param_norm": float(layer_pn[i]),
+                       "update_ratio":
+                           lr * float(layer_gn[i]) /
+                           (float(layer_pn[i]) + 1e-12)}
+                for i, name in enumerate(self._names)}
+        return stats
+
+    def _run_rules(self, s):
+        out = []
+        warm = self.windows >= self.warmup_windows
+        loss, gn = s["loss"], s["grad_norm"]
+        pn, ur = s["param_norm"], s["update_ratio"]
+        if loss is not None:
+            if not math.isfinite(loss):
+                out.append({"rule": "loss_spike", "loss": loss})
+            elif warm and self._ew_loss is not None:
+                std = math.sqrt(max(self._ew_loss_var, 1e-12))
+                z = (loss - self._ew_loss) / std
+                if z > self.loss_spike_z:
+                    out.append({"rule": "loss_spike", "loss": loss,
+                                "z": round(z, 2),
+                                "ewma": round(self._ew_loss, 6)})
+        if gn is not None:
+            if not math.isfinite(gn) or gn > self.grad_explode_abs:
+                out.append({"rule": "grad_explosion", "grad_norm": gn})
+            elif warm and self._ew_gnorm and \
+                    gn > self.grad_explode_ratio * self._ew_gnorm:
+                out.append({"rule": "grad_explosion", "grad_norm": gn,
+                            "ewma": round(self._ew_gnorm, 6)})
+            if math.isfinite(gn) and gn < self.grad_vanish_abs and \
+                    (pn or 0.0) > 0.0:
+                out.append({"rule": "grad_vanish", "grad_norm": gn})
+            if math.isfinite(gn) and gn > 0.0:
+                dead = [name for name, d in s["layers"].items()
+                        if d["grad_norm"] <= self.dead_abs]
+                if dead:
+                    out.append({"rule": "dead_layer", "count": len(dead),
+                                "layers": dead[:8]})
+        if ur is not None and math.isfinite(ur) and warm and \
+                (ur > self.update_ratio_max or
+                 (ur < self.update_ratio_min and (gn or 0.0) > 0.0)):
+            out.append({"rule": "update_ratio_oob", "update_ratio": ur})
+        return out
+
+    def _update_ewma(self, s):
+        a = self.ewma_alpha
+        loss, gn = s["loss"], s["grad_norm"]
+        if loss is not None and math.isfinite(loss):
+            if self._ew_loss is None:
+                self._ew_loss, self._ew_loss_var = loss, 0.0
+            else:
+                d = loss - self._ew_loss
+                self._ew_loss += a * d
+                self._ew_loss_var = (1 - a) * (self._ew_loss_var + a * d * d)
+        if gn is not None and math.isfinite(gn):
+            self._ew_gnorm = gn if self._ew_gnorm is None \
+                else (1 - a) * self._ew_gnorm + a * gn
+
+    def _export(self, s, anomalies):
+        for gauge, key in ((_M_GRAD, "grad_norm"), (_M_PARAM, "param_norm"),
+                           (_M_RATIO, "update_ratio"), (_M_LOSS, "loss")):
+            v = s.get(key)
+            if v is not None and math.isfinite(v):
+                gauge.set(v)
+        for name, d in s["layers"].items():
+            if math.isfinite(d["grad_norm"]):
+                _M_LAYER.set(d["grad_norm"], layer=name)
+        for x in anomalies:
+            _M_ANOM.inc(rule=x["rule"])
+            if _flight.enabled():
+                _flight.record("health_anomaly", step=s["step"], **x)
+
+    def _escalate(self, step, anomalies, model, optimizer, lr_scheduler,
+                  dataloader):
+        hit = any(x["rule"] in self.rewind_on for x in anomalies)
+        if not hit:
+            self._consecutive = 0
+            return "anomaly" if anomalies else None
+        self._consecutive += 1
+        if self.action == "none" or self._consecutive < self.max_consecutive:
+            return "anomaly"
+        self._consecutive = 0
+        if self.action == "raise":
+            _flight.record("health_raise", step=int(step),
+                           rules=[x["rule"] for x in anomalies])
+            _flight.dump(reason="health_raise", step=int(step),
+                         dump_dir=getattr(self.manager, "root", None))
+            raise HealthAnomalyError(
+                f"{[x['rule'] for x in anomalies]} persisted for "
+                f"{self.max_consecutive} consecutive windows (step {step})")
+        restored = self.manager.restore(
+            model=model, optimizer=optimizer or self._opt,
+            lr_scheduler=lr_scheduler, dataloader=dataloader)
+        if restored is None:
+            return "anomaly"  # advisory tier: no target, no crash
+        self.restored_step = restored
+        self.on_restore(restored)
+        _flight.record("health_rewind", step=int(step),
+                       restored_step=int(restored))
+        _flight.dump(reason="health_rewind", step=int(step),
+                     dump_dir=self.manager.root)
+        return "rewind"
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def reset_window(self) -> None:
+        """Drop the in-flight window accumulator (stale timeline — e.g.
+        after an external rewind restored older weights)."""
+        import jax.numpy as jnp
+        n = len(self._params)
+        self._acc_t._data = jnp.zeros((n + 2, 2), jnp.float32)
+        self._loss_t._data = jnp.zeros((), jnp.float32)
+        self._grad_steps = 0
+        self._loss_steps = 0
+        self._cost_s = 0.0
+        self._win_t0 = time.perf_counter()
+
+    def on_restore(self, step) -> None:
+        """Checkpoint-restore hook (CheckpointManager.restore(health=...)):
+        the run's timeline just rewound, so the window in flight is from
+        an abandoned future — drop it."""
+        self.reset_window()
+        self._consecutive = 0
+
+    def snapshot(self) -> dict:
+        """Plain-dict summary for bench telemetry / flight dumps."""
+        with self._lock:
+            last = dict(self.stats) if self.stats else None
+            counts = dict(self.anomaly_counts)
+        if last is not None:
+            last.pop("layers", None)
+        return {"windows": self.windows, "host_pulls": self.host_pulls,
+                "fold_dispatches": self.fold_dispatches,
+                "check_every": self.check_every,
+                "params": len(self._params),
+                "uses_clip_norm": self._use_extern,
+                "overhead_pct": round(self.overhead_pct, 4),
+                "anomalies": counts, "last": last}
+
+
+def _peak_hbm():
+    from ..memory import device_memory_stats
+    v = int(device_memory_stats().get("peak_bytes_in_use", 0))
+    return v or None
+
+
+def get_monitor() -> HealthMonitor | None:
+    """The most recently constructed monitor, if still alive."""
+    return _ACTIVE() if _ACTIVE is not None else None
+
+
+def snapshot_for_flight():
+    """Guarded monitor summary for flight dumps (None when no monitor)."""
+    try:
+        m = get_monitor()
+        return m.snapshot() if m is not None else None
+    except Exception:
+        return None

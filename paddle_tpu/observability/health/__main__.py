@@ -1,0 +1,103 @@
+"""CLI for step-series ledgers.
+
+    python -m paddle_tpu.observability.health compare runA.jsonl runB.jsonl
+        [--tol-pct 5] [--tol metric=pct ...] [--json]
+    python -m paddle_tpu.observability.health show run.jsonl [--last 20]
+
+``compare`` prints a per-metric verdict table (baseline = runA) and
+exits non-zero when any directional metric regressed past tolerance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .ledger import compare_ledgers, read_ledger
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _parse_tols(pairs):
+    tols = {}
+    for p in pairs:
+        if "=" not in p:
+            raise SystemExit(f"--tol wants METRIC=PCT, got {p!r}")
+        k, v = p.split("=", 1)
+        tols[k.strip()] = float(v)
+    return tols
+
+
+def _cmd_show(a) -> int:
+    header, rows = read_ledger(a.path)
+    if header:
+        print(f"ledger {a.path}  schema={header.get('schema')}  "
+              f"run_id={header.get('run_id')}  windows={len(rows)}")
+    cols = ("step", "loss", "grad_norm", "update_ratio", "step_ms",
+            "tokens_per_s", "retraces", "anomalies")
+    print("  ".join(f"{c:>14}" for c in cols))
+    for r in rows[-a.last:]:
+        print("  ".join(f"{_fmt(r.get(c)):>14}"[:14].rjust(14)
+                        for c in cols))
+    return 0
+
+
+def _cmd_compare(a) -> int:
+    _, base = read_ledger(a.base)
+    _, cur = read_ledger(a.current)
+    if not base or not cur:
+        print(f"compare: empty ledger ({a.base}: {len(base)} rows, "
+              f"{a.current}: {len(cur)} rows)", file=sys.stderr)
+        return 2
+    results = compare_ledgers(base, cur, a.tol_pct, _parse_tols(a.tol))
+    if a.json:
+        print(json.dumps(results, indent=2))
+    else:
+        print(f"{'metric':>16} {'baseline':>12} {'current':>12} "
+              f"{'delta':>9}  verdict")
+        for r in results:
+            print(f"{r['metric']:>16} {_fmt(r['baseline']):>12} "
+                  f"{_fmt(r['current']):>12} {r['delta_pct']:>+8.2f}%  "
+                  f"{r['verdict']}")
+    bad = [r for r in results if r["verdict"] == "regressed"]
+    for r in bad:
+        print(f"REGRESSED: {r['metric']} {_fmt(r['baseline'])} -> "
+              f"{_fmt(r['current'])} ({r['delta_pct']:+.2f}%, tolerance "
+              f"{r['tol_pct']:g}%)", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.health",
+        description="step-series ledger tools")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser("compare", help="diff two run ledgers")
+    c.add_argument("base")
+    c.add_argument("current")
+    c.add_argument("--tol-pct", type=float, default=5.0,
+                   help="default per-metric tolerance (percent)")
+    c.add_argument("--tol", action="append", default=[],
+                   metavar="METRIC=PCT",
+                   help="per-metric tolerance override; <=0 disables")
+    c.add_argument("--json", action="store_true")
+    s = sub.add_parser("show", help="render one ledger")
+    s.add_argument("path")
+    s.add_argument("--last", type=int, default=20)
+    a = p.parse_args(argv)
+    try:
+        return _cmd_show(a) if a.cmd == "show" else _cmd_compare(a)
+    except (OSError, ValueError) as e:
+        print(f"{a.cmd}: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

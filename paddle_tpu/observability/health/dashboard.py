@@ -1,0 +1,154 @@
+"""Zero-dependency HTML dashboard for the telemetry server's
+``/dashboard`` route: inline SVG sparklines over the active
+HealthMonitor's window history plus the live step-series ledger tail,
+and a counters strip from the metrics registry. Pure stdlib string
+assembly — nothing to install, safe inside a training process."""
+
+from __future__ import annotations
+
+import html
+import math
+import time
+
+from ..metrics import total as _total
+
+_CARDS = (("loss", "window-mean loss", "#b83280"),
+          ("grad_norm", "global grad norm", "#2b6cb0"),
+          ("update_ratio", "update ratio lr·|g|/|p|", "#2f855a"),
+          ("step_ms", "step wall (ms)", "#975a16"),
+          ("tokens_per_s", "tokens / s", "#6b46c1"))
+
+_COUNTERS = (("windows", "paddle_tpu_health_windows_total"),
+             ("anomalies", "paddle_tpu_health_anomalies_total"),
+             ("host pulls", "paddle_tpu_health_host_pulls_total"),
+             ("retraces", "paddle_tpu_jit_trace_cache_retraces_total"),
+             ("nan windows", "paddle_tpu_resilience_nan_events_total"))
+
+_CSS = """
+body{font:14px/1.45 system-ui,sans-serif;margin:1.2em;background:#fafafa;
+color:#1a202c}
+h1{font-size:1.25em;margin:0 0 .2em}
+.sub{color:#718096;margin-bottom:1em}
+.cards{display:flex;flex-wrap:wrap;gap:12px}
+.card{background:#fff;border:1px solid #e2e8f0;border-radius:8px;
+padding:10px 14px;min-width:280px}
+.card h2{font-size:.85em;margin:0 0 4px;color:#4a5568;font-weight:600}
+.card .v{font-size:1.15em;font-weight:700}
+.counters{display:flex;gap:18px;margin:1em 0;flex-wrap:wrap}
+.counters div{background:#edf2f7;border-radius:6px;padding:6px 12px}
+table{border-collapse:collapse;margin-top:.5em}
+td,th{padding:3px 10px;border-bottom:1px solid #e2e8f0;text-align:right}
+th{color:#4a5568}td:first-child,th:first-child{text-align:left}
+.anom{color:#c53030;font-weight:600}
+"""
+
+
+def _spark(vals, width=260, height=48, color="#2b6cb0"):
+    """Inline SVG sparkline of a numeric series (non-finite points are
+    dropped; <2 points renders a placeholder)."""
+    pts = [(i, v) for i, v in enumerate(vals)
+           if isinstance(v, (int, float)) and math.isfinite(v)]
+    if len(pts) < 2:
+        return (f'<svg width="{width}" height="{height}">'
+                f'<text x="4" y="{height // 2}" fill="#a0aec0" '
+                f'font-size="11">waiting for data…</text></svg>')
+    lo = min(v for _, v in pts)
+    hi = max(v for _, v in pts)
+    span = (hi - lo) or 1.0
+    x0, xn = pts[0][0], pts[-1][0]
+    xs = (xn - x0) or 1
+    coords = " ".join(
+        f"{(i - x0) / xs * (width - 4) + 2:.1f},"
+        f"{height - 4 - (v - lo) / span * (height - 8):.1f}"
+        for i, v in pts)
+    return (f'<svg width="{width}" height="{height}" class="sparkline">'
+            f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+            f'points="{coords}"/></svg>')
+
+
+def _fmt(v):
+    if v is None:
+        return "–"
+    if isinstance(v, float):
+        return f"{v:.5g}"
+    return html.escape(str(v))
+
+
+def _ledger_tail(mon, last):
+    if mon is None or mon.ledger is None:
+        return []
+    try:
+        from .ledger import read_ledger
+        _, rows = read_ledger(mon.ledger.path)
+        return rows[-last:]
+    except Exception:
+        return []
+
+
+def render_dashboard(last: int = 180) -> str:
+    """The full /dashboard page as a string (auto-refreshes)."""
+    from . import get_monitor
+    mon = get_monitor()
+    parts = ['<!doctype html><html><head><meta charset="utf-8">',
+             '<meta http-equiv="refresh" content="5">',
+             '<title>paddle_tpu training health</title>',
+             f'<style>{_CSS}</style></head><body>',
+             '<h1>Training health</h1>']
+    if mon is None:
+        parts.append('<p class="sub">no active HealthMonitor in this '
+                     'process — attach one to the train loop to light '
+                     'this page up</p>')
+        hist, stats = [], None
+    else:
+        with mon._lock:
+            hist = list(mon.history)[-last:]
+            stats = dict(mon.stats) if mon.stats else None
+        snap = mon.snapshot()
+        parts.append(
+            f'<p class="sub">windows {snap["windows"]} · check every '
+            f'{snap["check_every"]} steps · {snap["params"]} params · '
+            f'overhead {snap["overhead_pct"]:.3f}% · anomalies '
+            f'{sum(snap["anomalies"].values()) or 0}</p>')
+    parts.append('<div class="counters">')
+    for label, name in _COUNTERS:
+        parts.append(f'<div>{label}: <b>{int(_total(name))}</b></div>')
+    parts.append('</div><div class="cards">')
+    for key, title, color in _CARDS:
+        series = [r.get(key) for r in hist]
+        lastv = next((v for v in reversed(series)
+                      if isinstance(v, (int, float)) and math.isfinite(v)),
+                     None)
+        parts.append(f'<div class="card"><h2>{title}</h2>'
+                     f'<div class="v">{_fmt(lastv)}</div>'
+                     f'{_spark(series, color=color)}</div>')
+    parts.append('</div>')
+    if stats and stats.get("layers"):
+        top = sorted(stats["layers"].items(),
+                     key=lambda kv: -(kv[1]["grad_norm"]
+                                      if math.isfinite(kv[1]["grad_norm"])
+                                      else float("inf")))[:12]
+        parts.append('<h2 style="font-size:1em">top layers by grad norm '
+                     f'(window @ step {stats["step"]})</h2>'
+                     '<table><tr><th>layer</th><th>grad norm</th>'
+                     '<th>param norm</th><th>update ratio</th></tr>')
+        for name, d in top:
+            parts.append(
+                f'<tr><td>{html.escape(name)}</td>'
+                f'<td>{_fmt(d["grad_norm"])}</td>'
+                f'<td>{_fmt(d["param_norm"])}</td>'
+                f'<td>{_fmt(d["update_ratio"])}</td></tr>')
+        parts.append('</table>')
+    recent = [r for r in hist if r.get("anomalies")][-8:]
+    if recent:
+        parts.append('<h2 style="font-size:1em">recent anomalies</h2><ul>')
+        for r in reversed(recent):
+            parts.append(f'<li class="anom">step {r["step"]}: '
+                         f'{html.escape(", ".join(r["anomalies"]))}</li>')
+        parts.append('</ul>')
+    tail = _ledger_tail(mon, last)
+    if tail:
+        parts.append(f'<p class="sub">ledger: {html.escape(mon.ledger.path)}'
+                     f' · {len(tail)} windows shown</p>')
+    parts.append(f'<p class="sub">rendered {time.strftime("%H:%M:%S")}</p>'
+                 '</body></html>')
+    return "".join(parts)

@@ -1,0 +1,184 @@
+"""Append-only per-run step-series ledger + run-to-run comparison.
+
+One compact, strictly RFC-8259 JSON line per health window (step, wall
+clock, loss, lr, grad norm, tokens/s, peak HBM, retrace count, fired
+anomaly rules), so every run leaves a durable trajectory that outlives
+the process — the measured-history artifact ``perf_trend``/``compare``
+diff. The file is bounded: past ``max_bytes`` it rotates by atomic
+rename (``path`` -> ``path.1`` -> ... -> ``path.keep``, older dropped),
+so a long run can never fill the disk.
+
+Non-finite values never reach the file as bare tokens: records pass
+through the flight recorder's sanitizers and ``json.dumps(...,
+allow_nan=False)`` proves it — a NaN loss arrives as the string
+``"nan"``, parseable by any strict JSON reader.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import statistics
+import time
+
+from ..flight import _finite, _json_safe
+from ...analysis.concurrency import tsan as _tsan
+
+__all__ = ["StepLedger", "read_ledger", "compare_ledgers", "SCHEMA",
+           "COMPARE_METRICS"]
+
+SCHEMA = "paddle_tpu.health.ledger/1"
+
+#: (metric, direction, aggregation) — how `compare` judges each series.
+#: "lower"/"higher" say which way is better; "band" metrics are training
+#: dynamics (a shift is worth flagging but is not a perf regression).
+COMPARE_METRICS = (
+    ("tokens_per_s", "higher", "median"),
+    ("step_ms", "lower", "median"),
+    ("loss", "lower", "median"),
+    ("peak_hbm_bytes", "lower", "max"),
+    ("retraces", "lower", "last"),
+    ("grad_norm", "band", "median"),
+    ("update_ratio", "band", "median"),
+)
+
+
+class StepLedger:
+    """Bounded append-only JSONL ledger, one record per health window."""
+
+    def __init__(self, path: str, run_id=None,
+                 max_bytes: int = 4 * 1024 * 1024, keep: int = 2):
+        if os.path.isdir(path):
+            path = os.path.join(path, "health_ledger.jsonl")
+        self.path = path
+        self.run_id = str(run_id) if run_id is not None \
+            else f"{int(time.time())}-{os.getpid()}"
+        self.max_bytes = int(max_bytes)
+        self.keep = max(0, int(keep))
+        self.rotations = 0
+        self._lock = _tsan.lock("health.ledger")
+        self._f = None
+
+    # -- write path ----------------------------------------------------------
+
+    def _open(self):
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fresh = not os.path.exists(self.path) or \
+            os.path.getsize(self.path) == 0
+        self._f = open(self.path, "a", encoding="utf-8")
+        if fresh:
+            self._write({"schema": SCHEMA, "run_id": self.run_id,
+                         "wall": time.time()})
+
+    def _write(self, rec: dict):
+        line = json.dumps(_finite(rec), default=_json_safe,
+                          separators=(",", ":"), allow_nan=False)
+        self._f.write(line + "\n")
+        self._f.flush()
+
+    def append(self, rec: dict) -> None:
+        with self._lock:
+            if self._f is None:
+                self._open()
+            self._write(rec)
+            if self._f.tell() > self.max_bytes:
+                self._rotate()
+
+    def _rotate(self):
+        self._f.close()
+        self._f = None
+        if self.keep == 0:
+            os.remove(self.path)
+        else:
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        self.rotations += 1
+        self._open()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+# -- read / compare ----------------------------------------------------------
+
+def read_ledger(path: str):
+    """Parse one ledger file -> (header dict | None, list of row dicts)."""
+    header, rows = None, []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if "schema" in rec:
+                if header is None:
+                    header = rec
+            else:
+                rows.append(rec)
+    return header, rows
+
+
+def _num(v):
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v) if math.isfinite(v) else None
+
+
+def _agg(rows, key, how):
+    vals = [x for x in (_num(r.get(key)) for r in rows) if x is not None]
+    if not vals:
+        return None
+    if how == "last":
+        return vals[-1]
+    if how == "max":
+        return max(vals)
+    # steady half: skip the warmup/ramp windows at the head of the run
+    return statistics.median(vals[len(vals) // 2:])
+
+
+def compare_ledgers(base_rows, cur_rows, tol_pct: float = 5.0,
+                    tols: dict | None = None) -> list:
+    """Per-metric tolerance verdicts of `cur_rows` against `base_rows`.
+
+    Returns a list of ``{"metric", "baseline", "current", "delta_pct",
+    "direction", "tol_pct", "verdict"}`` with verdict one of ``ok``,
+    ``improved``, ``regressed`` (directional metrics) or ``shifted``
+    (band metrics). Metrics missing on either side are skipped; a
+    per-metric tolerance <= 0 disables that metric."""
+    tols = tols or {}
+    out = []
+    for key, direction, how in COMPARE_METRICS:
+        tol = float(tols.get(key, tol_pct))
+        if tol <= 0:
+            continue
+        b, c = _agg(base_rows, key, how), _agg(cur_rows, key, how)
+        if b is None or c is None:
+            continue
+        delta = (c - b) / max(abs(b), 1e-12) * 100.0
+        verdict = "ok"
+        if direction == "band":
+            if abs(delta) > tol:
+                verdict = "shifted"
+        elif direction == "lower":
+            if delta > tol:
+                verdict = "regressed"
+            elif delta < -tol:
+                verdict = "improved"
+        else:  # higher is better
+            if delta < -tol:
+                verdict = "regressed"
+            elif delta > tol:
+                verdict = "improved"
+        out.append({"metric": key, "baseline": b, "current": c,
+                    "delta_pct": round(delta, 2), "direction": direction,
+                    "tol_pct": tol, "verdict": verdict})
+    return out

@@ -354,6 +354,32 @@ class Histogram(MetricBase):
         out["+Inf"] = acc + counts[-1]
         return {"count": n, "sum": s, "buckets": out}
 
+    def quantile(self, q: float, /, **labels):
+        """Approximate ``q``-quantile by linear interpolation inside the
+        owning bucket (the Prometheus ``histogram_quantile`` estimate,
+        anchored at 0 below the first bound). None when the series has no
+        observations. Overflow-bucket hits return the top finite bound —
+        a lower bound on the true quantile, still gate-worthy. Shared by
+        bench.py's data-wait p50 and the serving ``timing_split`` p50s."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile wants 0 <= q <= 1, got {q}")
+        v = self.value(**labels)
+        n = v["count"]
+        if not n:
+            return None
+        target = q * n
+        prev_le, prev_acc = 0.0, 0
+        for le, acc in v["buckets"].items():
+            if le == "+Inf":
+                continue
+            bound = float(le)
+            if acc >= target:
+                span = acc - prev_acc
+                frac = (target - prev_acc) / span if span else 1.0
+                return prev_le + (bound - prev_le) * frac
+            prev_le, prev_acc = bound, acc
+        return prev_le
+
     def snapshot(self) -> dict:
         vals = {}
         with self._lock:

@@ -64,6 +64,7 @@ def _surface_cached() -> tuple:
     import paddle_tpu.observability as observability
     import paddle_tpu.observability.continuous as obs_continuous
     import paddle_tpu.observability.flight as obs_flight
+    import paddle_tpu.observability.health as obs_health
     import paddle_tpu.observability.memory as obs_memory
     import paddle_tpu.observability.tracing as obs_tracing
     import paddle_tpu.cost_model as cost_model_mod
@@ -133,6 +134,12 @@ def _surface_cached() -> tuple:
     # request tracing: traceparent propagation, the request-log record
     # shape and the /trace endpoints are debugging contracts too
     _collect(obs_tracing, "paddle.observability.tracing", "observability",
+             records,
+             lambda o: inspect.isfunction(o) or inspect.isclass(o))
+    # training health: the monitor's observe/check cadence, the ledger's
+    # line schema and the compare verdicts are run-comparison contracts —
+    # dashboards and the perf trend tool parse them
+    _collect(obs_health, "paddle.observability.health", "observability",
              records,
              lambda o: inspect.isfunction(o) or inspect.isclass(o))
     # serving runtime: LLMEngine/ServingConfig/PagePool and the HTTP

@@ -407,8 +407,13 @@ class FusedOptimizerStep:
                     out_grads = [g._d for g in grads]
                     found = functools.reduce(jnp.logical_or, checks)
                 pg = list(zip(params, grads))
+                gnorm = None
                 if clip is not None:
                     pg = clip(pg)
+                    # a global-norm clip just reduced the whole grad set —
+                    # return that scalar as a program output so the health
+                    # monitor never pays for a second device reduction
+                    gnorm = getattr(clip, "last_global_norm", None)
                 # device step counter first — bias correction must see the
                 # incremented value, as in the legacy step()
                 opt._step_tensor._data = opt._step_tensor._data + 1.0
@@ -427,9 +432,14 @@ class FusedOptimizerStep:
                     t._d = d
                     t._node, t._out_index = n, oi
                     t._grad = g
+                if clip is not None and \
+                        hasattr(type(clip), "last_global_norm"):
+                    # never leak the trace-time tracer onto the live clip;
+                    # _execute reinstates the concrete value per dispatch
+                    clip.last_global_norm = None
             if found is None:
                 found = jnp.zeros((), jnp.bool_)
-            return new_state, found, out_grads
+            return new_state, found, out_grads, gnorm
 
         jitted = jax.jit(pure, donate_argnums=(0,) if donate else ())
         # slot 4 holds the AOT-compiled executable, filled by step() via
@@ -484,7 +494,7 @@ class FusedOptimizerStep:
         sampled = _cont.sampling_active()
         if timed or sampled:
             t0 = time.perf_counter()
-            new_state, found, out_grads = compiled(*args)
+            new_state, found, out_grads, gnorm = compiled(*args)
             jax.block_until_ready(new_state)
             dt = time.perf_counter() - t0
             if timed:
@@ -492,7 +502,11 @@ class FusedOptimizerStep:
             if sampled:
                 _cont.record_program(f"fused_opt:{type(opt).__name__}", dt)
         else:
-            new_state, found, out_grads = compiled(*args)
+            new_state, found, out_grads, gnorm = compiled(*args)
+        if gnorm is not None and opt._grad_clip is not None:
+            # the clip's computed global norm, as a concrete device scalar
+            # (no host sync) — HealthMonitor folds it instead of re-reducing
+            opt._grad_clip.last_global_norm = gnorm
         for t, a in zip(state_list, new_state):
             t._data = stream_state_out(t, a)
             t._node = None

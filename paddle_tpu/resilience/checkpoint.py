@@ -339,13 +339,15 @@ class CheckpointManager:
 
     def restore(self, model=None, optimizer=None, scaler=None,
                 lr_scheduler=None, dataloader=None, step: int | None = None,
-                required: bool = False):
+                required: bool = False, health=None):
         """Load the newest good checkpoint (or exactly `step`) into the
         given components, in place. ``dataloader`` receives the saved
         iterator cursor via ``load_state_dict`` (exactly-once resume: the
         batches that were speculative at save time are replayed, nothing
-        is skipped). Returns the restored step, or None when no usable
-        checkpoint exists (raises CheckpointNotFoundError when
+        is skipped). ``health`` (a HealthMonitor) is notified via
+        ``on_restore`` so its window accumulators and EWMA baselines drop
+        the poisoned tail. Returns the restored step, or None when no
+        usable checkpoint exists (raises CheckpointNotFoundError when
         ``required``). Corrupt or partial checkpoints are counted, skipped,
         and never applied."""
         self.wait()  # an async save may still be committing
@@ -365,6 +367,8 @@ class CheckpointManager:
                 _OBS_FALLBACKS.inc(fallbacks)
             _flight.record("checkpoint_restore", step=int(payload["step"]),
                            fallbacks=fallbacks)
+            if health is not None:
+                health.on_restore(int(payload["step"]))
             return payload["step"]
         if required:
             raise CheckpointNotFoundError(

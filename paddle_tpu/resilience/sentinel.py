@@ -106,9 +106,11 @@ class NaNSentinel:
         return (step + 1) % self.check_every == 0
 
     def check(self, step: int, model=None, optimizer=None,
-              lr_scheduler=None, dataloader=None) -> str | None:
+              lr_scheduler=None, dataloader=None, health=None) -> str | None:
         """Off-cadence: returns None untouched. On cadence: one host pull of
-        the window accumulator; classify the window and act."""
+        the window accumulator; classify the window and act. ``health`` (a
+        HealthMonitor) is forwarded to ``manager.restore`` on rewind so its
+        accumulators are reset to the restored step."""
         if not self.should_check(step) or self._ok_accum is None:
             return None
         ok = bool(self._ok_accum)   # the single batched host sync
@@ -149,7 +151,8 @@ class NaNSentinel:
         restored = self.manager.restore(model=model, optimizer=optimizer,
                                         scaler=self.scaler,
                                         lr_scheduler=lr_scheduler,
-                                        dataloader=dataloader)
+                                        dataloader=dataloader,
+                                        health=health)
         if restored is None:
             # rewind exhaustion: the run is about to die — dump the tape
             _flight.record("nan_raise", step=int(step), no_checkpoint=True)

@@ -528,13 +528,15 @@ class Scheduler:
         return stats
 
     def timing_split(self) -> dict:
-        """Mean per-request lifecycle split: scheduler queue wait vs
-        prefill compute vs decode wall — the TTFT attribution fix
-        (queue wait used to be invisibly folded into TTFT). Surfaced in
+        """Per-request lifecycle split: scheduler queue wait vs prefill
+        compute vs decode wall — the TTFT attribution fix (queue wait
+        used to be invisibly folded into TTFT). Means from the
+        scheduler's own sums, p50s straight off the latency histograms
+        via the registry's shared ``Histogram.quantile``. Surfaced in
         the ``/healthz`` serving payload."""
         with self.lock:
             adm, fin = self.admissions, self.finished_timed
-            return {
+            out = {
                 "queue_wait_ms_mean": round(
                     self.queue_wait_ms_sum / adm, 3) if adm else None,
                 "prefill_ms_mean": round(
@@ -542,6 +544,12 @@ class Scheduler:
                 "decode_ms_mean": round(
                     self.decode_ms_sum / fin, 3) if fin else None,
             }
+        for key, hist in (("queue_wait_p50_ms", _QUEUE_WAIT),
+                          ("ttft_p50_ms", _TTFT),
+                          ("tpot_p50_ms", _TPOT)):
+            q = hist.quantile(0.5)
+            out[key] = round(q, 3) if q is not None else None
+        return out
 
     def spec_acceptance_rate(self):
         """Cumulative draft acceptance (accepted/proposed), None before

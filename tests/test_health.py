@@ -1,0 +1,566 @@
+"""Training-health telemetry: device-folded window statistics vs an eager
+NumPy reference, the one-host-pull + zero-added-retrace contract under
+to_static, each anomaly rule positive+negative, ledger round-trip /
+rotation / strict-RFC-8259, compare verdict directions + CLI exit codes,
+the /dashboard route, Histogram.quantile, and the perf_gate/perf_trend
+tooling around it."""
+
+import importlib.util
+import json
+import math
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import flight
+from paddle_tpu.observability.health import (HealthMonitor, RULES,
+                                             StepLedger, compare_ledgers,
+                                             get_monitor, read_ledger,
+                                             snapshot_for_flight)
+from paddle_tpu.observability.health.__main__ import main as health_cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model_opt(lr=1e-2):
+    model = paddle.nn.Linear(4, 3)
+    opt = paddle.optimizer.Adam(lr, parameters=model.parameters())
+    return model, opt
+
+
+def _loss_fn(model, x, y):
+    return ((model(x) - y) ** 2).mean()
+
+
+# ---------------------------------------------------------------------------
+# window statistics: eager fold vs a NumPy reference
+# ---------------------------------------------------------------------------
+
+def test_eager_window_stats_match_numpy_reference():
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=3)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 3)).astype(np.float32))
+
+    ref_gsq_per_step = []        # global grad^2 per step
+    ref_layer_gsq = None         # per-param grad^2, window-summed
+    ref_psq_last = None          # per-param param^2 at the last fold
+    for i in range(3):
+        loss = _loss_fn(model, x, y)
+        loss.backward()
+        opt.step()
+        gsq = np.array([float(np.sum(np.square(
+            np.asarray(p._grad._data, dtype=np.float64))))
+            for p in model.parameters()])
+        ref_layer_gsq = gsq if ref_layer_gsq is None else ref_layer_gsq + gsq
+        ref_gsq_per_step.append(gsq.sum())
+        ref_psq_last = np.array([float(np.sum(np.square(
+            np.asarray(p._data, dtype=np.float64))))
+            for p in model.parameters()])
+        health.observe_grads()
+        opt.clear_grad()
+        health.observe(loss)
+        health.check(i)
+
+    assert health.windows == 1 and health.host_pulls == 1
+    s = health.stats
+    assert s["window_steps"] == 3
+    k = 3
+    ref_gnorm = math.sqrt(sum(ref_gsq_per_step) / k)
+    ref_pnorm = math.sqrt(ref_psq_last.sum())
+    assert s["grad_norm"] == pytest.approx(ref_gnorm, rel=1e-4)
+    assert s["param_norm"] == pytest.approx(ref_pnorm, rel=1e-4)
+    assert s["lr"] == pytest.approx(1e-2, rel=1e-5)
+    assert s["update_ratio"] == pytest.approx(
+        s["lr"] * ref_gnorm / (ref_pnorm + 1e-12), rel=1e-4)
+    # per-layer RMS norms in declaration order
+    names = list(s["layers"])
+    assert len(names) == len(list(model.parameters()))
+    for i, name in enumerate(names):
+        assert s["layers"][name]["grad_norm"] == pytest.approx(
+            math.sqrt(ref_layer_gsq[i] / k), rel=1e-4)
+
+
+def test_window_mean_loss_and_reset_between_windows():
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=2)
+    for i, v in enumerate((1.0, 3.0)):
+        health.observe(v)
+        health.check(i)
+    assert health.stats["loss"] == pytest.approx(2.0)
+    # second window sees only its own losses
+    for i, v in enumerate((10.0, 20.0), start=2):
+        health.observe(v)
+        health.check(i)
+    assert health.stats["loss"] == pytest.approx(15.0)
+    assert health.windows == 2 and health.host_pulls == 2
+
+
+# ---------------------------------------------------------------------------
+# to_static: fold inlined, one pull per window, zero added retraces
+# ---------------------------------------------------------------------------
+
+def test_to_static_one_pull_per_window_and_zero_added_retraces():
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=4)
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = _loss_fn(model, x, y)
+        loss.backward()
+        opt.step()
+        health.observe_grads()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 3)).astype(np.float32))
+    step(x, y)  # warmup: discovery + compile
+    health.reset_window()
+    pulls0 = health.host_pulls
+    dispatch0 = health.fold_dispatches
+    retr0 = obs.total("paddle_tpu_jit_trace_cache_retraces_total")
+    for i in range(8):
+        loss = step(x, y)
+        health.observe(loss)
+        health.check(i)
+    assert obs.total(
+        "paddle_tpu_jit_trace_cache_retraces_total") == retr0
+    assert health.host_pulls - pulls0 == 2          # one per window, only
+    assert health.fold_dispatches == dispatch0       # fold inlined, no extra
+    # the DEVICE-side fold counter saw every compiled-program application
+    assert health.stats["window_steps"] == 4
+    assert snapshot_for_flight()["host_pulls"] == health.host_pulls
+    assert get_monitor() is health
+
+
+def test_check_off_cadence_touches_nothing():
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=100)
+    health.observe(1.0)
+    assert health.check(0) is None
+    assert health.host_pulls == 0 and health.windows == 0
+
+
+def test_empty_window_is_skipped_without_stats():
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=1)
+    assert health.check(0) is None          # nothing observed at all
+    assert health.windows == 0 and health.stats is None
+
+
+# ---------------------------------------------------------------------------
+# anomaly rules, positive + negative
+# ---------------------------------------------------------------------------
+
+def _stats(**kw):
+    base = {"step": 10, "loss": 1.0, "grad_norm": 1.0, "param_norm": 10.0,
+            "update_ratio": 1e-4, "layers": {}}
+    base.update(kw)
+    return base
+
+
+@pytest.fixture
+def warm_monitor():
+    model, opt = _model_opt()
+    h = HealthMonitor(opt, check_every=1)
+    h.windows = 10                       # past warmup_windows
+    h._ew_loss, h._ew_loss_var = 1.0, 0.01
+    h._ew_gnorm = 1.0
+    return h
+
+
+def _rules(h, s):
+    return [x["rule"] for x in h._run_rules(s)]
+
+
+def test_rule_vocabulary_is_stable():
+    assert RULES == ("loss_spike", "grad_explosion", "grad_vanish",
+                     "dead_layer", "update_ratio_oob")
+
+
+def test_loss_spike_fires_on_z_score_and_on_nonfinite(warm_monitor):
+    h = warm_monitor
+    # z = (2.0 - 1.0)/0.1 = 10 > default 6
+    assert "loss_spike" in _rules(h, _stats(loss=2.0))
+    assert "loss_spike" in _rules(h, _stats(loss=float("nan")))
+    assert "loss_spike" not in _rules(h, _stats(loss=1.05))
+
+
+def test_loss_spike_needs_warmup_unless_nonfinite():
+    model, opt = _model_opt()
+    h = HealthMonitor(opt, check_every=1)
+    h._ew_loss, h._ew_loss_var = 1.0, 0.01
+    assert "loss_spike" not in _rules(h, _stats(loss=100.0))  # cold
+    assert "loss_spike" in _rules(h, _stats(loss=float("inf")))
+
+
+def test_grad_explosion_abs_ratio_and_negative(warm_monitor):
+    h = warm_monitor
+    assert "grad_explosion" in _rules(h, _stats(grad_norm=2e4))   # abs
+    assert "grad_explosion" in _rules(h, _stats(grad_norm=20.0))  # 20x ewma
+    assert "grad_explosion" in _rules(
+        h, _stats(grad_norm=float("nan")))
+    assert "grad_explosion" not in _rules(h, _stats(grad_norm=2.0))
+
+
+def test_grad_vanish_needs_nonzero_params(warm_monitor):
+    h = warm_monitor
+    assert "grad_vanish" in _rules(h, _stats(grad_norm=1e-12))
+    assert "grad_vanish" not in _rules(
+        h, _stats(grad_norm=1e-12, param_norm=0.0))
+    assert "grad_vanish" not in _rules(h, _stats(grad_norm=1.0))
+
+
+def test_dead_layer_positive_and_negative(warm_monitor):
+    h = warm_monitor
+    layers = {"a": {"grad_norm": 0.0}, "b": {"grad_norm": 0.5}}
+    fired = h._run_rules(_stats(layers=layers))
+    dead = [x for x in fired if x["rule"] == "dead_layer"]
+    assert dead and dead[0]["layers"] == ["a"]
+    assert "dead_layer" not in _rules(
+        h, _stats(layers={"a": {"grad_norm": 0.5}}))
+    # a globally-zero gradient is grad_vanish territory, not dead_layer
+    assert "dead_layer" not in _rules(
+        h, _stats(grad_norm=0.0, layers=layers))
+
+
+def test_update_ratio_oob_both_sides_and_in_band(warm_monitor):
+    h = warm_monitor
+    assert "update_ratio_oob" in _rules(h, _stats(update_ratio=0.5))
+    assert "update_ratio_oob" in _rules(h, _stats(update_ratio=1e-10))
+    assert "update_ratio_oob" not in _rules(h, _stats(update_ratio=1e-4))
+    # vanishing ratio with a zero gradient is not "too small an update"
+    assert "update_ratio_oob" not in _rules(
+        h, _stats(update_ratio=1e-10, grad_norm=0.0))
+
+
+def test_nan_loss_end_to_end_counts_anomaly_and_flight_event():
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=1)
+    before = len([e for e in flight.events()
+                  if e.get("kind") == "health_anomaly"])
+    health.observe(float("nan"))
+    assert health.check(0) == "anomaly"
+    assert health.anomaly_counts.get("loss_spike") == 1
+    evs = [e for e in flight.events() if e.get("kind") == "health_anomaly"]
+    assert len(evs) == before + 1
+    assert evs[-1]["rule"] == "loss_spike"
+
+
+def test_nonfinite_window_never_poisons_ewma_baselines():
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=1, warmup_windows=0)
+    health.observe(1.0)
+    health.check(0)
+    assert health._ew_loss == pytest.approx(1.0)
+    health.observe(float("nan"))
+    health.check(1)
+    assert health._ew_loss == pytest.approx(1.0)  # unchanged
+
+
+def test_on_restore_drops_window_and_patience():
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=10)
+    health.observe(1.0)
+    health._consecutive = 2
+    health.on_restore(5)
+    assert health._loss_steps == 0 and health._consecutive == 0
+
+
+def test_checkpoint_restore_forwards_to_health(tmp_path):
+    from paddle_tpu.resilience import CheckpointManager
+    model, opt = _model_opt()
+    manager = CheckpointManager(str(tmp_path), keep_n=2, async_save=False)
+    manager.save(3, model=model, optimizer=opt, blocking=True)
+    health = HealthMonitor(opt, check_every=10)
+    health.observe(1.0)
+    health._consecutive = 1
+    assert manager.restore(model=model, optimizer=opt, health=health) == 3
+    assert health._loss_steps == 0 and health._consecutive == 0
+
+
+def test_action_rewind_restores_after_consecutive_windows(tmp_path):
+    from paddle_tpu.resilience import CheckpointManager
+    model, opt = _model_opt()
+    manager = CheckpointManager(str(tmp_path), keep_n=2, async_save=False)
+    manager.save(0, model=model, optimizer=opt, blocking=True)
+    health = HealthMonitor(opt, check_every=1, manager=manager,
+                           action="rewind", max_consecutive=2)
+    health.observe(float("nan"))
+    assert health.check(0) == "anomaly"       # patience 1 of 2
+    health.observe(float("nan"))
+    assert health.check(1) == "rewind"
+    assert health.restored_step == 0
+
+
+def test_action_raise_raises_health_anomaly_error(tmp_path):
+    from paddle_tpu.observability.health import HealthAnomalyError
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=1, action="raise",
+                           max_consecutive=1)
+    flight.set_dump_dir(str(tmp_path))
+    health.observe(float("inf"))
+    with pytest.raises(HealthAnomalyError):
+        health.check(0)
+
+
+def test_constructor_validation():
+    model, opt = _model_opt()
+    with pytest.raises(ValueError):
+        HealthMonitor(opt, check_every=0)
+    with pytest.raises(ValueError):
+        HealthMonitor(opt, action="explode")
+    with pytest.raises(ValueError):
+        HealthMonitor(opt, action="rewind")   # no manager
+
+
+# ---------------------------------------------------------------------------
+# step-series ledger: round-trip, strict JSON, rotation
+# ---------------------------------------------------------------------------
+
+def _boom(tok):
+    raise AssertionError(f"bare non-RFC-8259 token {tok!r} in ledger")
+
+
+def test_ledger_round_trip_and_strict_json(tmp_path):
+    led = StepLedger(str(tmp_path), run_id="runA")
+    led.append({"step": 9, "loss": 1.5, "grad_norm": 0.25,
+                "nan_val": float("nan"), "inf_val": float("inf")})
+    led.close()
+    path = os.path.join(str(tmp_path), "health_ledger.jsonl")
+    with open(path) as f:
+        for line in f:
+            json.loads(line, parse_constant=_boom)  # strict RFC-8259
+    header, rows = read_ledger(path)
+    assert header["schema"] == "paddle_tpu.health.ledger/1"
+    assert header["run_id"] == "runA"
+    assert rows == [{"step": 9, "loss": 1.5, "grad_norm": 0.25,
+                     "nan_val": "nan", "inf_val": "inf"}]
+
+
+def test_ledger_rotation_is_bounded(tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    led = StepLedger(path, run_id="r", max_bytes=256, keep=2)
+    for i in range(64):
+        led.append({"step": i, "loss": 1.0, "pad": "x" * 32})
+    led.close()
+    assert led.rotations > 0
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    assert not os.path.exists(path + f".{led.keep + 1}")
+    # every surviving file still parses strictly, newest first
+    _, rows = read_ledger(path)
+    assert rows and rows[-1]["step"] == 63
+
+
+def test_monitor_appends_ledger_rows_with_hbm_and_retraces(tmp_path):
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=1, ledger=str(tmp_path),
+                           run_id="runM", tokens_per_step=32)
+    health.observe(2.0)
+    health.check(0)
+    health.ledger.close()
+    header, rows = read_ledger(
+        os.path.join(str(tmp_path), "health_ledger.jsonl"))
+    assert header["run_id"] == "runM"
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["step"] == 0 and row["loss"] == pytest.approx(2.0)
+    assert row["tokens_per_s"] is not None
+    assert "retraces" in row and "peak_hbm_bytes" in row
+
+
+# ---------------------------------------------------------------------------
+# compare: verdict directions + CLI exit codes
+# ---------------------------------------------------------------------------
+
+def _rows(**cols):
+    n = len(next(iter(cols.values())))
+    return [{k: v[i] for k, v in cols.items()} for i in range(n)]
+
+
+def test_compare_verdict_directions():
+    base = _rows(step_ms=[10.0] * 4, tokens_per_s=[100.0] * 4,
+                 loss=[1.0] * 4, grad_norm=[1.0] * 4)
+    cur = _rows(step_ms=[20.0] * 4,        # lower-is-better, worse
+                tokens_per_s=[200.0] * 4,  # higher-is-better, better
+                loss=[1.0] * 4,            # unchanged
+                grad_norm=[3.0] * 4)       # band metric, shifted
+    got = {r["metric"]: r["verdict"]
+           for r in compare_ledgers(base, cur, tol_pct=5.0)}
+    assert got["step_ms"] == "regressed"
+    assert got["tokens_per_s"] == "improved"
+    assert got["loss"] == "ok"
+    assert got["grad_norm"] == "shifted"   # band: flagged, never regressed
+
+
+def test_compare_tolerance_and_per_metric_disable():
+    base = _rows(step_ms=[10.0] * 4)
+    cur = _rows(step_ms=[10.4] * 4)        # +4% < default 5%
+    assert compare_ledgers(base, cur)[0]["verdict"] == "ok"
+    assert compare_ledgers(base, cur, tol_pct=2.0)[0][
+        "verdict"] == "regressed"
+    assert compare_ledgers(base, cur, tols={"step_ms": 0}) == []
+
+
+def test_compare_uses_steady_half_median():
+    # warmup windows 10x slower must not drag the baseline
+    base = _rows(step_ms=[100.0, 100.0, 10.0, 10.0])
+    cur = _rows(step_ms=[10.0] * 4)
+    assert compare_ledgers(base, cur)[0]["verdict"] == "ok"
+
+
+def _write_ledger(path, rows, run_id="r"):
+    led = StepLedger(str(path), run_id=run_id)
+    for r in rows:
+        led.append(r)
+    led.close()
+    return str(path) if not os.path.isdir(str(path)) else \
+        os.path.join(str(path), "health_ledger.jsonl")
+
+
+def test_compare_cli_exit_codes(tmp_path, capsys):
+    a = _write_ledger(tmp_path / "a.jsonl",
+                      _rows(step_ms=[10.0] * 4, loss=[1.0] * 4))
+    b = _write_ledger(tmp_path / "b.jsonl",
+                      _rows(step_ms=[30.0] * 4, loss=[1.0] * 4))
+    assert health_cli(["compare", a, b]) == 1          # planted slowdown
+    assert "REGRESSED: step_ms" in capsys.readouterr().err
+    assert health_cli(["compare", a, a]) == 0          # self-compare clean
+    empty = _write_ledger(tmp_path / "e.jsonl", [])    # header only
+    assert health_cli(["compare", a, empty]) == 2
+    assert health_cli(["compare", a, str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_show_cli_renders(tmp_path, capsys):
+    a = _write_ledger(tmp_path / "a.jsonl",
+                      _rows(step=[1, 2], loss=[1.0, 0.5]))
+    assert health_cli(["show", a]) == 0
+    out = capsys.readouterr().out
+    assert "run_id=r" in out and "loss" in out
+
+
+# ---------------------------------------------------------------------------
+# /dashboard route
+# ---------------------------------------------------------------------------
+
+def test_dashboard_route_serves_html_with_sparklines():
+    from paddle_tpu.observability.continuous import TelemetryServer
+    model, opt = _model_opt()
+    health = HealthMonitor(opt, check_every=1)
+    for i, v in enumerate((2.0, 1.5, 1.0)):
+        health.observe(v)
+        health.check(i)
+    srv = TelemetryServer(port=0, host="127.0.0.1").start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/dashboard", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/html")
+            body = r.read().decode("utf-8")
+    finally:
+        srv.close()
+    assert "<svg" in body               # inline sparklines, zero deps
+    assert "grad norm" in body or "loss" in body
+
+
+def test_dashboard_renders_without_a_monitor():
+    from paddle_tpu.observability.health import dashboard as hd
+    import paddle_tpu.observability.health as hmod
+    saved = hmod._ACTIVE
+    hmod._ACTIVE = None
+    try:
+        body = hd.render_dashboard()
+    finally:
+        hmod._ACTIVE = saved
+    assert "<html" in body.lower()
+
+
+# ---------------------------------------------------------------------------
+# Histogram.quantile (the shared percentile helper)
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantile_interpolates_within_bucket():
+    from paddle_tpu.observability.metrics import Registry
+    reg = Registry()
+    h = reg.histogram("paddle_tpu_test_q_seconds", "t",
+                      buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 3.5):
+        h.observe(v)
+    # p50 target=2 obs -> second bucket (le=2.0), 1 prior: 1 + (2-1)*1/1
+    assert h.quantile(0.5) == pytest.approx(2.0)
+    assert h.quantile(0.0) == pytest.approx(0.0)
+    assert h.quantile(1.0) == pytest.approx(4.0)
+
+
+def test_histogram_quantile_empty_overflow_and_validation():
+    from paddle_tpu.observability.metrics import Registry
+    reg = Registry()
+    h = reg.histogram("paddle_tpu_test_q2_seconds", "t", buckets=(1.0, 2.0))
+    assert h.quantile(0.5) is None
+    h.observe(50.0)                    # lands in +Inf
+    assert h.quantile(0.5) == pytest.approx(2.0)  # top finite bound
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+# ---------------------------------------------------------------------------
+# tooling: perf_gate health gate + perf_trend report
+# ---------------------------------------------------------------------------
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"{name}_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_gate_health_overhead_both_directions(monkeypatch):
+    pg = _load_tool("perf_gate")
+    monkeypatch.delenv("PERF_GATE_HEALTH_TOL_PCT", raising=False)
+    ok = {"telemetry": {"health_overhead_pct": 0.4}}
+    bad = {"telemetry": {"health_overhead_pct": 2.5}}
+    assert pg.health_overhead_gate(ok) == []
+    fails = pg.health_overhead_gate(bad)
+    assert len(fails) == 1 and "health-overhead" in fails[0]
+    # <=0 disables; missing telemetry passes vacuously
+    monkeypatch.setenv("PERF_GATE_HEALTH_TOL_PCT", "0")
+    assert pg.health_overhead_gate(bad) == []
+    monkeypatch.delenv("PERF_GATE_HEALTH_TOL_PCT", raising=False)
+    assert pg.health_overhead_gate({}) == []
+    assert pg.health_overhead({"telemetry": {"health_overhead_pct": 0.4}}) \
+        == pytest.approx(0.4)
+
+
+def test_perf_trend_flags_planted_regression(tmp_path):
+    pt = _load_tool("perf_trend")
+    for rnd, tok in ((1, 1000.0), (2, 1010.0), (3, 500.0)):
+        (tmp_path / f"BENCH_r{rnd}.json").write_text(json.dumps(
+            {"metric": "tokens_per_sec", "value": tok,
+             "extra": {"step_breakdown": {"step_ms": 1.0}}}))
+    out = pt.render_bench_trend(str(tmp_path / "BENCH_r*.json"))
+    assert "3 round(s)" in out
+    line = [ln for ln in out.splitlines() if "tokens/s" in ln][0]
+    assert "regressed" in line and "▁" in line or "█" in line
+
+
+def test_perf_trend_ledger_report_and_cli(tmp_path, capsys):
+    pt = _load_tool("perf_trend")
+    led = _write_ledger(
+        tmp_path / "led.jsonl",
+        _rows(step=list(range(8)), loss=[2.0, 1.8, 1.6, 1.4, 1.2, 1.1,
+                                         1.05, 1.0],
+              step_ms=[10.0] * 8))
+    out = pt.render_ledger_trend(led)
+    assert "8 window(s)" in out and "loss" in out
+    assert pt.main(["--ledger", led]) == 0
+    capsys.readouterr()
+    assert pt.main(["--ledger", str(tmp_path / "missing.jsonl")]) == 2

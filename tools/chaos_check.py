@@ -113,7 +113,7 @@ def _fresh():
 
 
 def _train(model, opt, start, steps, manager=None, sentinel=None,
-           handler=None):
+           handler=None, health=None):
     """Eager loop [start, steps); returns the step after the last one run.
     Checkpoints every step when a manager is attached (save_every=1 gives
     the <=1-step loss bound this gate enforces)."""
@@ -127,10 +127,18 @@ def _train(model, opt, start, steps, manager=None, sentinel=None,
             loss = loss * float("nan")
         loss.backward()
         opt.step()
+        if health is not None:
+            health.observe_grads()  # grads still live pre-clear_grad
         opt.clear_grad()
+        if health is not None:
+            # checked BEFORE the sentinel: the anomaly diagnosis must be
+            # on the tape ahead of the nan_window verdict it explains
+            health.observe(loss)
+            health.check(i)
         if sentinel is not None:
             sentinel.observe(loss)
-            if sentinel.check(i, model=model, optimizer=opt) == "rewind":
+            if sentinel.check(i, model=model, optimizer=opt,
+                              health=health) == "rewind":
                 # cursor = step actually restored, not latest_step()
                 i = sentinel.restored_step or 0
                 continue
@@ -228,25 +236,38 @@ def profile_kill_mid_save(steps, ref):
 
 def profile_nan_at_step(steps, ref):
     """NaN loss at FAULT_STEP; the sentinel must rewind and the replay must
-    match ref exactly (the one-shot fault does not refire on replay)."""
+    match ref exactly (the one-shot fault does not refire on replay). A
+    HealthMonitor rides along (telemetry-only, action="none"): its anomaly
+    diagnosis (grad explosion / loss spike) must land on the flight tape
+    BEFORE the sentinel's nan_window verdict — the black box should say
+    WHY before it says WHAT."""
+    from paddle_tpu.observability.health import HealthMonitor
     from paddle_tpu.resilience import CheckpointManager, NaNSentinel, faults
     with tempfile.TemporaryDirectory() as d:
         _arm_flight()
         model, opt = _fresh()
         mgr = CheckpointManager(d, keep_n=steps)
         sent = NaNSentinel(check_every=1, max_consecutive=1, manager=mgr)
+        health = HealthMonitor(opt, check_every=1)
         with faults.inject(f"nan@{FAULT_STEP}"):
-            _train(model, opt, 0, steps, manager=mgr, sentinel=sent)
+            _train(model, opt, 0, steps, manager=mgr, sentinel=sent,
+                   health=health)
         if not _same(_weights(model), ref):
             return "post-rewind run diverged from the fault-free reference"
         import paddle_tpu.observability as obs
         if obs.total("paddle_tpu_resilience_nan_rewinds_total") < 1:
             return "sentinel never rewound"
+        if not any(k in health.anomaly_counts
+                   for k in ("grad_explosion", "loss_spike")):
+            return ("health monitor saw the NaN window but classified no "
+                    f"anomaly (counts: {health.anomaly_counts})")
         # the dump was taken AT the rewind, so its tape must end with the
-        # sentinel's window + rewind (the replayed steps came later)
+        # sentinel's window + rewind (the replayed steps came later) —
+        # and the health diagnosis must precede the nan_window verdict
         err = _validate_flight_dump(
             d, "nan_rewind",
-            ["fault_injected", "nan_window", "nan_rewind"])
+            ["fault_injected", "health_anomaly", "nan_window", "nan_rewind"],
+            window=16)
         if err:
             return err
     return None

@@ -65,6 +65,17 @@ The mega-kernel harvest (``extra.fusion_targets``) adds a soft gate: the
 top remaining (not ``fused``) target's est_saved_bytes must stay below
 the pre-PR attention cluster (PERF_GATE_FUSION_MAX_MIB, default 48) —
 i.e. the block fusion stays applied round over round.
+
+The training-health monitor (``telemetry.health_overhead_pct``, from the
+HealthMonitor riding inside the bench's measured loop) adds an ABSOLUTE
+soft gate: the monitor's measured host cost must stay under
+PERF_GATE_HEALTH_TOL_PCT (default 1) percent of window wall time —
+mirroring the continuous profiler's budget contract. <= 0 disables;
+rounds that predate the field pass.
+
+After the gates, a non-fatal trend report (tools/perf_trend.py) renders
+the BENCH_*.json trajectory with per-metric sparkline + verdict lines —
+purely informational, never changes the exit status.
 """
 
 from __future__ import annotations
@@ -282,6 +293,44 @@ def _tol_pct(env_name, default):
         return float(os.environ.get(env_name, default))
     except ValueError:
         return default
+
+
+def health_overhead(d):
+    """Measured HealthMonitor cost as % of window wall from the bench
+    telemetry block (None when the round predates training-health
+    telemetry)."""
+    tel = d.get("telemetry")
+    if not isinstance(tel, dict):
+        return None
+    v = tel.get("health_overhead_pct")
+    try:
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def health_overhead_gate(cd):
+    """Absolute soft gate on the training-health monitor's measured cost:
+    the device-folded stats + one-pull-per-window design promises <1% of
+    step time, and this holds the promise round over round. Ceiling via
+    PERF_GATE_HEALTH_TOL_PCT (default 1); <= 0 disables; rounds without
+    the field pass. Returns a list of failure messages (empty = pass)."""
+    tol = _tol_pct("PERF_GATE_HEALTH_TOL_PCT", 1.0)
+    if tol <= 0:
+        return []
+    ov = health_overhead(cd)
+    if ov is None:
+        return []
+    if ov > tol:
+        return [
+            f"perf gate [REGRESSION:health-overhead] training-health "
+            f"monitor cost {ov:.3f}% of window wall time (ceiling {tol:g}% "
+            f"via PERF_GATE_HEALTH_TOL_PCT): the one-pull-per-window / "
+            f"device-folded contract is broken — check HealthMonitor."
+            f"observe_grads dispatch count and check() host work"]
+    print(f"perf gate [ok:health-overhead] training-health monitor "
+          f"{ov:.3f}% of window wall (ceiling {tol:g}%)")
+    return []
 
 
 def soft_gates(cd, bd):
@@ -645,12 +694,26 @@ def main():
     # mega-kernel harvest gate: the top remaining fusion target must stay
     # below the pre-PR attention cluster (the fusion stays applied)
     soft_fails += fusion_applied_gate(cd)
+    # training-health monitor: its measured cost must hold the <1%-of-
+    # window contract (absolute ceiling, not baseline-relative)
+    soft_fails += health_overhead_gate(cd)
     # serving runtime: hard zero-retrace/zero-leak contract + soft
     # tokens/s comparison against the same baseline round
     serve_hard, serve_soft = serve_gates(cd, bd)
     soft_fails += serve_soft
     for msg in soft_fails + serve_hard:
         print(msg)
+    # trend report: purely informational (never changes the exit status) —
+    # the round-over-round trajectory next to the pass/fail verdicts
+    if args.history:
+        try:
+            try:
+                from tools.perf_trend import render_trend
+            except ImportError:
+                from perf_trend import render_trend
+            print(render_trend(args.history, current=args.current))
+        except Exception as e:  # noqa: BLE001 — report step, never fatal
+            print(f"perf gate: trend report unavailable ({e!r})")
     return 0 if (cv >= floor and not retrace_fail and not prof_fail
                  and not soft_fails and not serve_hard) else 1
 

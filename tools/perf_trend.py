@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Perf trajectory report: the BENCH_*.json round series (and optionally a
+training-health step-series ledger) rendered as unicode sparklines with a
+per-metric verdict — the narrative companion to tools/perf_gate.py's
+pass/fail. The gate runs this as a NON-FATAL report step after its
+verdicts; nothing here ever changes an exit status on the gate's behalf.
+
+Usage:
+  python tools/perf_trend.py --history "BENCH_r*.json" [--current BENCH.json]
+  python tools/perf_trend.py --ledger ckpts/health_ledger.jsonl
+  python tools/perf_trend.py --history "BENCH_r*.json" --ledger run/ledger.jsonl
+
+Verdict per metric: the newest round vs the best of the previous rounds
+(mirroring the gate's best-of-history discipline): `improved` / `ok`
+(within tolerance) / `regressed` (worse by more than --tol-pct, default
+5%). Directions: tokens/s higher-is-better; latency, HBM, overhead
+lower-is-better. Exit status: always 0 with a readable report, 2 when
+no input could be read at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    from tools import perf_gate as _pg
+except ImportError:
+    import perf_gate as _pg
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def spark(vals):
+    """Unicode sparkline of a numeric series; '·' marks missing points."""
+    xs = [v for v in vals if v is not None]
+    if not xs:
+        return ""
+    lo, hi = min(xs), max(xs)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in vals:
+        if v is None:
+            out.append("·")
+        else:
+            out.append(_BLOCKS[int((v - lo) / span * (len(_BLOCKS) - 1))])
+    return "".join(out)
+
+
+def _health_block(d):
+    tel = d.get("telemetry")
+    return (tel or {}).get("health") if isinstance(tel, dict) else None
+
+
+def _health_field(key):
+    def get(d):
+        blk = _health_block(d)
+        last = (blk or {}).get("last") or {}
+        v = last.get(key)
+        try:
+            return float(v) if v is not None else None
+        except (TypeError, ValueError):
+            return None
+    return get
+
+
+def _throughput(d):
+    _, v = _pg.metric_value(d)
+    return v or None
+
+
+# (label, getter over a bench dict, direction, unit). Direction "lower"
+# means smaller is better; "band" metrics get a sparkline but no verdict
+# (a gradient norm drifting is information, not automatically regression).
+BENCH_METRICS = (
+    ("tokens/s", _throughput, "higher", "tok/s"),
+    ("step_ms", _pg.step_latency_ms, "lower", "ms"),
+    ("host_dispatch_ms", _pg.host_dispatch_ms, "lower", "ms"),
+    ("peak_hbm", lambda d: (lambda v: v / (1 << 20) if v else None)(
+        _pg.peak_hbm_bytes(d)), "lower", "MiB"),
+    ("data_wait_p50", _pg.data_wait_p50_ms, "lower", "ms"),
+    ("prof_overhead", lambda d: _pg.prof_overhead(d)[0], "lower", "%"),
+    ("health_overhead", _pg.health_overhead, "lower", "%"),
+    ("health_loss", _health_field("loss"), "lower", ""),
+    ("health_grad_norm", _health_field("grad_norm"), "band", ""),
+)
+
+# ledger columns worth a trajectory line (subset of health.ledger's
+# COMPARE_METRICS, same directions)
+LEDGER_METRICS = (
+    ("loss", "lower"), ("grad_norm", "band"), ("update_ratio", "band"),
+    ("step_ms", "lower"), ("tokens_per_s", "higher"),
+    ("peak_hbm_bytes", "lower"), ("retraces", "lower"),
+)
+
+
+def _verdict(vals, direction, tol_pct):
+    """Newest value vs best-of-previous: improved / ok / regressed / n/a."""
+    xs = [(i, v) for i, v in enumerate(vals) if v is not None]
+    if len(xs) < 2 or direction == "band":
+        return "n/a", None
+    last = xs[-1][1]
+    prev = [v for _, v in xs[:-1]]
+    best = max(prev) if direction == "higher" else min(prev)
+    if best == 0:
+        return "n/a", None
+    delta = (last - best) / abs(best) * 100.0
+    worse = -delta if direction == "higher" else delta
+    if worse > tol_pct:
+        return "regressed", delta
+    if worse < -tol_pct:
+        return "improved", delta
+    return "ok", delta
+
+
+def _round_no(p):
+    m = re.search(r"r(\d+)", os.path.basename(p))
+    return int(m.group(1)) if m else -1
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    return f"{v:.4g}" if abs(v) < 1e6 else f"{v:.3e}"
+
+
+def render_bench_trend(pattern, current=None, tol_pct=5.0, last_n=10):
+    """Report over the round files matching ``pattern`` (sorted by rNN in
+    the filename), with ``current`` appended when it isn't already in the
+    series. Returns the report string ('' when nothing was readable)."""
+    files = sorted(glob.glob(pattern), key=_round_no)[-last_n:]
+    if current and os.path.exists(current) and \
+            os.path.abspath(current) not in map(os.path.abspath, files):
+        files.append(current)
+    rounds = []
+    for p in files:
+        try:
+            d = _pg.load_bench(p)
+        except Exception:
+            continue
+        if d:
+            rounds.append((os.path.basename(p), d))
+    if not rounds:
+        return ""
+    lines = [f"perf trend: {len(rounds)} round(s) "
+             f"({rounds[0][0]} .. {rounds[-1][0]})"]
+    for label, get, direction, unit in BENCH_METRICS:
+        vals = [get(d) for _, d in rounds]
+        if not any(v is not None for v in vals):
+            continue
+        verdict, delta = _verdict(vals, direction, tol_pct)
+        tail = f" {verdict}" if verdict != "n/a" else ""
+        if delta is not None:
+            tail += f" ({delta:+.1f}% vs best)"
+        lines.append(f"  {label:>18} {spark(vals)}  last="
+                     f"{_fmt(vals[-1])}{unit and ' ' + unit}{tail}")
+    return "\n".join(lines)
+
+
+def render_ledger_trend(path, tol_pct=5.0, width=40):
+    """Report over one training-health step-series ledger: each metric's
+    trajectory across the run's check windows, with the steady-half
+    median split (first half vs second half) as the verdict basis."""
+    from paddle_tpu.observability.health.ledger import read_ledger
+    header, rows = read_ledger(path)
+    if not rows:
+        return ""
+    run = (header or {}).get("run_id") or os.path.basename(path)
+    lines = [f"ledger trend: {run} — {len(rows)} window(s), "
+             f"steps {rows[0].get('step')}..{rows[-1].get('step')}"]
+    # downsample long runs so the sparkline stays terminal-width
+    stride = max(1, len(rows) // width)
+    view = rows[::stride]
+    for key, direction in LEDGER_METRICS:
+        vals = []
+        for r in view:
+            v = r.get(key)
+            try:
+                v = float(v) if v is not None else None
+            except (TypeError, ValueError):
+                v = None
+            if v is not None and not (v == v):  # NaN
+                v = None
+            vals.append(v)
+        if not any(v is not None for v in vals):
+            continue
+        xs = [v for v in vals if v is not None]
+        half = xs[:max(1, len(xs) // 2)], xs[len(xs) // 2:] or xs[-1:]
+        verdict = "n/a"
+        if direction != "band" and half[0] and half[1]:
+            a = sorted(half[0])[len(half[0]) // 2]
+            b = sorted(half[1])[len(half[1]) // 2]
+            if a:
+                delta = (b - a) / abs(a) * 100.0
+                worse = -delta if direction == "higher" else delta
+                verdict = ("regressed" if worse > tol_pct else
+                           "improved" if worse < -tol_pct else "ok")
+                verdict += f" ({delta:+.1f}% second-half median)"
+        lines.append(f"  {key:>18} {spark(vals)}  last={_fmt(vals[-1])}"
+                     f"{'' if verdict == 'n/a' else '  ' + verdict}")
+    return "\n".join(lines)
+
+
+def render_trend(pattern=None, current=None, ledger=None, tol_pct=5.0):
+    """Combined report (the entry point perf_gate calls)."""
+    parts = []
+    if pattern:
+        parts.append(render_bench_trend(pattern, current=current,
+                                        tol_pct=tol_pct))
+    if ledger:
+        parts.append(render_ledger_trend(ledger, tol_pct=tol_pct))
+    return "\n".join(p for p in parts if p)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--history", help="glob of BENCH_r*.json round files")
+    ap.add_argument("--current", help="newest round file to append")
+    ap.add_argument("--ledger", help="health step-series ledger (JSONL)")
+    ap.add_argument("--tol-pct", type=float, default=5.0,
+                    help="verdict tolerance in percent (default 5)")
+    args = ap.parse_args(argv)
+    if not args.history and not args.ledger:
+        ap.error("need --history and/or --ledger")
+    try:
+        out = render_trend(args.history, current=args.current,
+                           ledger=args.ledger, tol_pct=args.tol_pct)
+    except (OSError, ValueError) as e:
+        print(f"perf trend: unreadable input: {e}", file=sys.stderr)
+        return 2
+    if not out:
+        print("perf trend: no readable rounds/ledger rows", file=sys.stderr)
+        return 2
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,7 @@ SUITES = {
                 "-m", "not slow", "-p", "no:cacheprovider"],
     "telemetry": ["-m", "pytest", "tests/test_telemetry_server.py",
                   "tests/test_continuous.py", "tests/test_tracing.py",
+                  "tests/test_health.py",
                   "-q", "-m", "not slow", "-p", "no:cacheprovider"],
     "chaos": ["tools/chaos_check.py"],
 }
