@@ -77,25 +77,32 @@ class ChipSpec(dict):
 #: Public per-chip numbers (TPU system datasheets). ``ici`` is the
 #: per-chip aggregate inter-chip-interconnect bandwidth inside a slice;
 #: ``dcn`` the per-chip share of the data-center network between slices.
-#: ``peak_flops`` is dense bf16. ``vmem_bytes`` is the per-core VMEM the
-#: Pallas pipeline stages blocks through (~16 MiB/core on current chips;
-#: v6e doubles it) — the budget every kernel's block picker and the PK200
-#: residency check share.
+#: ``peak_flops`` is dense bf16. ``hbm_gbps`` is the per-chip HBM
+#: bandwidth — the memory side of the per-kernel roofline the autotuner's
+#: predicted-vs-measured comparison uses. ``vmem_bytes`` is the per-core
+#: VMEM the Pallas pipeline stages blocks through (~16 MiB/core on
+#: current chips; v6e doubles it) — the budget every kernel's block
+#: picker and the PK200 residency check share.
 _MIB = 1024 * 1024
 CHIP_PRESETS = {
     "v4":  ChipSpec(ici=LinkSpec(300.0, 1.0), dcn=LinkSpec(25.0, 10.0),
-                    hbm_gb=32.0, peak_flops=275e12, vmem_bytes=16 * _MIB),
+                    hbm_gb=32.0, hbm_gbps=1200.0, peak_flops=275e12,
+                    vmem_bytes=16 * _MIB),
     "v5e": ChipSpec(ici=LinkSpec(186.0, 1.0), dcn=LinkSpec(25.0, 10.0),
-                    hbm_gb=16.0, peak_flops=197e12, vmem_bytes=16 * _MIB),
+                    hbm_gb=16.0, hbm_gbps=820.0, peak_flops=197e12,
+                    vmem_bytes=16 * _MIB),
     "v5p": ChipSpec(ici=LinkSpec(600.0, 1.0), dcn=LinkSpec(25.0, 10.0),
-                    hbm_gb=95.0, peak_flops=459e12, vmem_bytes=16 * _MIB),
+                    hbm_gb=95.0, hbm_gbps=2765.0, peak_flops=459e12,
+                    vmem_bytes=16 * _MIB),
     "v6e": ChipSpec(ici=LinkSpec(448.0, 1.0), dcn=LinkSpec(25.0, 10.0),
-                    hbm_gb=32.0, peak_flops=918e12, vmem_bytes=32 * _MIB),
+                    hbm_gb=32.0, hbm_gbps=1640.0, peak_flops=918e12,
+                    vmem_bytes=32 * _MIB),
     # the virtual 8-device CPU test mesh: numbers chosen so plans are
     # deterministic and memory is never the binding constraint by accident;
     # vmem_bytes mirrors v5e so interpret-mode kernels pick real shapes
     "cpu": ChipSpec(ici=LinkSpec(10.0, 1.0), dcn=LinkSpec(1.0, 50.0),
-                    hbm_gb=4.0, peak_flops=5e10, vmem_bytes=16 * _MIB),
+                    hbm_gb=4.0, hbm_gbps=50.0, peak_flops=5e10,
+                    vmem_bytes=16 * _MIB),
 }
 
 
@@ -117,6 +124,21 @@ def chip_vmem_bytes(name: str | None = None) -> int:
     name = name or os.environ.get("PADDLE_TPU_CHIP", "v5e")
     preset = CHIP_PRESETS.get(name) or CHIP_PRESETS["v5e"]
     return int(preset["vmem_bytes"])
+
+
+def roofline_ms(flops: float, hbm_bytes: float,
+                name: str | None = None) -> float:
+    """Analytic per-kernel time: the max of the compute and HBM legs of
+    the chip's roofline, in milliseconds. The prediction the tuning
+    cache's measured entries are compared against (``kernel_cost``'s
+    ``predicted_vs_measured``)."""
+    import os
+    chip = CHIP_PRESETS.get(
+        name or os.environ.get("PADDLE_TPU_CHIP", "v5e"),
+        CHIP_PRESETS["v5e"])
+    compute_s = float(flops) / float(chip["peak_flops"])
+    memory_s = float(hbm_bytes) / (float(chip["hbm_gbps"]) * 1e9)
+    return max(compute_s, memory_s) * 1e3
 
 
 def all_reduce_s(nbytes: float, n: int, link: LinkSpec) -> float:

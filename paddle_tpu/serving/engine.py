@@ -78,6 +78,12 @@ class ServingConfig:
     fused_block: bool = True     # block_decode_epilogue mega-kernel in the
     #                              decode/prefill programs (TPU; shape-
     #                              static, zero-retrace preserved)
+    fused_decode_layer: bool = False  # block_decode_layer mega-kernel: the
+    #                              WHOLE decode layer (page gather -> mmha
+    #                              -> o_proj -> junctions -> MLP) as one
+    #                              VMEM-resident pallas_call per layer;
+    #                              composite path is the parity oracle
+    #                              (escape hatch PADDLE_TPU_FUSED_DECODE=0)
     prefix_cache: bool = True    # copy-on-write KV page sharing across
     #                              requests with a common prompt prefix
     prefill_chunk: int | None = None   # tokens per prefill chunk: chunks
@@ -121,7 +127,8 @@ class LLMEngine:
         self.config = cfg
         self._sm = ServingModel(model, quant=cfg.quant,
                                 quant_group_size=cfg.quant_group_size,
-                                fused_block=cfg.fused_block)
+                                fused_block=cfg.fused_block,
+                                fused_decode_layer=cfg.fused_decode_layer)
         max_seq = cfg.max_seq_len or self._sm.max_pos
         if max_seq > self._sm.max_pos:
             raise ValueError(
@@ -175,6 +182,14 @@ class LLMEngine:
         self._key_t = Tensor(np.asarray(
             jax.random.PRNGKey(cfg.seed), dtype=np.uint32))
         self._step_seq = 0
+        self.tuning = None  # autotune entry (or None) for bench/telemetry
+        if self._sm._fused_layer_active():
+            # the measured block_i must be installed BEFORE the one
+            # decode trace below — tuning after would force a retrace
+            from ..ops.kernels import autotune as _autotune
+            self.tuning = _autotune.tune_for_serving(
+                self._sm, cfg.page_size, cfg.num_pages,
+                self.scheduler.max_pages, cfg.max_batch)
         self._prog_base = self._raw_program_stats()
         self._build_programs()
 

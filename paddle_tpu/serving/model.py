@@ -58,7 +58,8 @@ class ServingModel:
     """
 
     def __init__(self, model, quant: str | None = None,
-                 quant_group_size: int = -1, fused_block: bool = True):
+                 quant_group_size: int = -1, fused_block: bool = True,
+                 fused_decode_layer: bool = False):
         self.model = model
         cfg = getattr(model, "cfg", None)
         missing = [n for n in ("embed_tokens", "layers") if
@@ -95,6 +96,15 @@ class ServingModel:
             getattr(model, "norm", None) is not None and \
             (getattr(model, "lm_head", None) is not None
              or getattr(cfg, "tie_word_embeddings", False))
+        # decode-layer mega-kernel (ops/kernels/decode_layer_pallas):
+        # needs the same exposed norm/head contract PLUS bias-free
+        # o/gate/up/down projections (the kernel folds them whole)
+        self._fused_decode_layer = bool(fused_decode_layer) and \
+            self._fused_block and all(
+                getattr(_get_path(layer, path), "bias", None) is None
+                for layer in layers
+                for tag, path in _LAYER_LINEARS
+                if tag in ("o", "gate", "up", "down"))
 
         self._quant_dtype = None
         self._qweights: dict = {}
@@ -116,6 +126,18 @@ class ServingModel:
                     qw, scale = weight_quantize(
                         mod.weight, algo=algo, group_size=quant_group_size)
                     self._qweights[(tag, i)] = (qw.detach(), scale.detach())
+        # the decode-layer mega-kernel consumes dense weights; for quant
+        # engines it must see the QUANTIZED values (dequantized once here)
+        # or its output would diverge from the weight_only_linear oracle
+        self._dq_weights: dict = {}
+        if self._fused_decode_layer and self._qweights:
+            from ..nn.quant import weight_dequantize
+            algo = "weight_only_" + self._quant_dtype
+            for i in range(len(layers)):
+                for tag in ("o", "gate", "up", "down"):
+                    qw, scale = self._qweights[(tag, i)]
+                    self._dq_weights[(tag, i)] = weight_dequantize(
+                        qw, scale, algo=algo).detach()
 
     # -- wiring --------------------------------------------------------------
 
@@ -208,6 +230,32 @@ class ServingModel:
         return (self._fused_block and kern.available()
                 and flag("use_pallas_kernels") and flag("use_fused_blocks"))
 
+    def _fused_layer_active(self) -> bool:
+        """Decode-layer mega-kernel gate: ``ServingConfig(
+        fused_decode_layer=True)`` AND the Pallas kernels dispatching AND
+        the escape hatch ``PADDLE_TPU_FUSED_DECODE=0`` not pulled. The
+        per-call shape gate (``decode_layer_pallas.use_kernel``) is
+        checked at trace time in :meth:`decode_forward` — layers too big
+        for VMEM fall back to the composite path below."""
+        import os
+
+        from ..core.flags import flag
+        from ..ops.kernels import _common as kern
+        return (self._fused_decode_layer and kern.available()
+                and flag("use_pallas_kernels")
+                and os.environ.get("PADDLE_TPU_FUSED_DECODE", "1") != "0")
+
+    def _layer_mats(self, i, layer):
+        """(wo, wg, wu, wd) raw jnp weights the decode-layer kernel folds
+        — the dequantized copies on quant engines."""
+        def pick(tag, mod):
+            dq = self._dq_weights.get((tag, i))
+            return (dq if dq is not None else mod.weight)._data
+        return (pick("o", layer.self_attn.o_proj),
+                pick("gate", layer.mlp.gate_proj),
+                pick("up", layer.mlp.up_proj),
+                pick("down", layer.mlp.down_proj))
+
     def _junction(self, x, residual, norm_mod):
         """(normed, h): one residual junction as a single
         ``block_decode_epilogue`` Pallas pass (projection output ->
@@ -260,6 +308,16 @@ class ServingModel:
         sin = Tensor(sin_f._data[0, pos][:, None])
 
         layers = list(self.model.layers)
+        if self._fused_layer_active():
+            from ..ops.kernels import decode_layer_pallas as dlp
+            hd = int(self.model.embed_tokens.weight.shape[1])
+            if all(dlp.use_kernel(
+                    (b, self.n_head, self.head_dim),
+                    tuple(pool.k._data.shape[1:]), int(tab.shape[1]), hd,
+                    int(layer.mlp.gate_proj.weight.shape[1]),
+                    pool.k._data.dtype) for layer in layers):
+                return self._decode_forward_fused_layer(
+                    tokens, pos, tab, page_ids, slots, sin, cos, b)
         fused = self._fused_active()
         x = self.model.embed_tokens(Tensor(tokens._data.reshape(b, 1)))
         hres = x
@@ -294,6 +352,49 @@ class ServingModel:
             else:
                 x = self._block_tail(i, layer, x, attn_out)
         logits = self._head_normed(y) if fused else self._head(x)
+        return Tensor(logits._data[:, 0, :])
+
+    def _decode_forward_fused_layer(self, tokens, pos, tab, page_ids,
+                                    slots, sin, cos, b):
+        """Decode step through the decode-LAYER mega-kernel: per layer,
+        QKV + RoPE + the KV scatter run as before (a scatter into the
+        paged pool cannot ride a read-steered kernel), then ONE
+        ``block_decode_layer`` pallas_call covers page-table gather ->
+        mmha -> o_proj -> attention junction -> swiglu MLP -> MLP
+        junction, returning the next layer's normed input and the
+        residual stream. The final model norm folds into the LAST
+        layer's second junction — same dataflow as the composite
+        epilogue path, so greedy output is token-exact against it.
+        Shapes all static: the compiled decode program never retraces.
+        """
+        from ..ops.kernels import _common as kern
+        from ..ops.kernels import decode_layer_pallas as dlp
+        pool = self.pool
+        layers = list(self.model.layers)
+        x = self.model.embed_tokens(Tensor(tokens._data.reshape(b, 1)))
+        hres = x._data[:, 0]                                  # [B, Hd]
+        y = layers[0].input_layernorm(x)
+        for i, layer in enumerate(layers):
+            q, k, v = self._qkv(i, layer, y, b, 1)
+            q, k = F.rope(q, k, sin, cos)
+            kp = kv_cache.write_token(pool.k._data, i, page_ids, slots,
+                                      k._data[:, 0])
+            vp = kv_cache.write_token(pool.v._data, i, page_ids, slots,
+                                      v._data[:, 0])
+            pool.k._data = kp
+            pool.v._data = vp
+            wo, wg, wu, wd = self._layer_mats(i, layer)
+            nxt = layers[i + 1].input_layernorm if i + 1 < len(layers) \
+                else self.model.norm
+            post = layer.post_attention_layernorm
+            yj, hres = dlp.decode_layer(
+                q._data[:, 0], kp[i], vp[i], tab, pos, hres, wo,
+                post.weight._data, wg, wu, wd, nxt.weight._data,
+                eps_post=post._epsilon,
+                eps_next=getattr(nxt, "_epsilon", 1e-6),
+                interpret=kern.interpret_mode())
+            y = Tensor(yj[:, None])
+        logits = self._head_normed(y)
         return Tensor(logits._data[:, 0, :])
 
     # -- speculative verify --------------------------------------------------

@@ -1,0 +1,309 @@
+"""Measured Pallas autotuner + tuning cache (ops/kernels/autotune).
+
+The round-trip contract: first run measures every candidate through the
+shared ``run_timed_trial`` protocol and persists the winner; a second
+run with the same key loads it with ZERO trials (telemetry-proven via
+``tuning_cache.hits``); any key ingredient change (dims, dtype, chip)
+re-measures instead of serving a stale schedule. Plus the cost-model
+join (``kernel_cost`` prefers measured ms over the analytic roofline)
+and the PERF_GATE_KERNEL_PRED_TOL_X both-directions gate.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+from paddle_tpu.ops.kernels import _common as kern
+from paddle_tpu.ops.kernels import autotune
+from paddle_tpu.ops.kernels.decode_layer_pallas import BLOCK_I_KEY
+
+
+@pytest.fixture
+def cache(tmp_path):
+    c = autotune.TuningCache(path=str(tmp_path / "tuning_cache.json"))
+    yield c
+    kern.set_block_override(BLOCK_I_KEY, None)
+
+
+def _fake_trial(times):
+    """A run_timed_trial stand-in: records calls, returns scripted
+    seconds per candidate (largest block_i is tried first)."""
+    calls = []
+
+    def trial(step, args, steps=3, warmup=1):
+        calls.append(step)
+        return times[len(calls) - 1]
+    trial.calls = calls
+    return trial
+
+
+_DIMS = dict(b=2, h=4, h_kv=2, d=16, page_size=8, n_pages=4, hd=64,
+             i_size=64)
+
+
+def _tune(cache, trial, **over):
+    kern.force_interpret(True)  # use_kernel() gate without a TPU
+    try:
+        return autotune.tune_decode_layer(
+            **dict(_DIMS, **over), cache=cache, trial=trial)
+    finally:
+        kern.force_interpret(False)
+
+
+def test_fingerprint_covers_every_invalidator():
+    base = autotune.kernel_fingerprint(
+        "k", [(2, 4, 16)], ["float32"], chip="v5e", quant=None)
+    assert base == autotune.kernel_fingerprint(
+        "k", [(2, 4, 16)], ["float32"], chip="v5e", quant=None)
+    for variant in (
+            autotune.kernel_fingerprint("k2", [(2, 4, 16)], ["float32"],
+                                        chip="v5e"),
+            autotune.kernel_fingerprint("k", [(2, 4, 32)], ["float32"],
+                                        chip="v5e"),
+            autotune.kernel_fingerprint("k", [(2, 4, 16)], ["bfloat16"],
+                                        chip="v5e"),
+            autotune.kernel_fingerprint("k", [(2, 4, 16)], ["float32"],
+                                        chip="v6e"),
+            autotune.kernel_fingerprint("k", [(2, 4, 16)], ["float32"],
+                                        chip="v5e", quant="int8")):
+        assert variant != base
+
+
+def test_round_trip_second_run_zero_trials(cache):
+    # candidates for i_size=64 are (64, 32, 16, 8); make 32 the winner
+    trial = _fake_trial([3.0, 1.0, 2.0, 4.0])
+    entry = _tune(cache, trial)
+    assert entry["block_i"] == 32
+    assert len(trial.calls) == 4
+    assert kern.get_block_override(BLOCK_I_KEY) == 32
+    assert cache.stats()["misses"] == 1 and cache.stats()["hits"] == 0
+    assert cache.stats()["measure_seconds"] > 0
+    assert os.path.exists(cache.path)
+
+    # second run, same key: the persisted winner loads with ZERO trials
+    # — even through a FRESH cache object (the JSON is the truth)
+    kern.set_block_override(BLOCK_I_KEY, None)
+    cache2 = autotune.TuningCache(path=cache.path)
+    trial2 = _fake_trial([9.9] * 8)
+    entry2 = _tune(cache2, trial2)
+    assert entry2["block_i"] == 32
+    assert trial2.calls == []
+    assert cache2.stats()["hits"] == 1
+    assert cache2.stats()["misses"] == 0
+    assert cache2.stats()["measure_seconds"] == 0.0
+    assert kern.get_block_override(BLOCK_I_KEY) == 32
+
+
+def test_key_change_remeasures_not_stale(cache):
+    trial = _fake_trial([3.0, 1.0, 2.0, 4.0])
+    _tune(cache, trial)
+    assert len(trial.calls) == 4
+
+    # a different hidden size is a different key: re-measure, and the
+    # larger i_size searches its own candidate set
+    trial2 = _fake_trial([1.0] + [5.0] * 8)
+    entry2 = _tune(cache, trial2, hd=128, i_size=128,
+                   b=2, h=8, h_kv=4)
+    assert trial2.calls, "changed dims must re-measure, not cache-hit"
+    assert entry2["block_i"] == 128  # candidate #0 scripted fastest
+    assert cache.stats()["entries"] == 2
+
+    # a different chip is a different key too
+    trial3 = _fake_trial([2.0, 1.0, 3.0, 4.0])
+    entry3 = _tune(cache, trial3, chip="v6e")
+    assert trial3.calls and entry3["chip"] == "v6e"
+    assert cache.stats()["entries"] == 3
+
+
+def test_tune_disabled_skips_measurement(cache, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TUNE", "0")
+    trial = _fake_trial([1.0] * 8)
+    assert _tune(cache, trial) is None
+    assert trial.calls == []
+    # but a persisted winner still LOADS under PADDLE_TPU_TUNE=0 —
+    # loading costs nothing; only new trials are skippable
+    monkeypatch.delenv("PADDLE_TPU_TUNE")
+    _tune(cache, _fake_trial([3.0, 1.0, 2.0, 4.0]))
+    kern.set_block_override(BLOCK_I_KEY, None)
+    monkeypatch.setenv("PADDLE_TPU_TUNE", "0")
+    entry = _tune(cache, trial)
+    assert entry is not None and trial.calls == []
+    assert kern.get_block_override(BLOCK_I_KEY) == entry["block_i"]
+
+
+def test_unavailable_kernel_never_tunes(cache):
+    trial = _fake_trial([1.0] * 8)
+    # no interpret hook, no TPU: use_kernel is False -> no measurement
+    out = autotune.tune_decode_layer(**_DIMS, cache=cache, trial=trial)
+    assert out is None and trial.calls == []
+
+
+def test_corrupt_cache_file_is_a_miss_not_a_crash(tmp_path):
+    p = tmp_path / "tuning_cache.json"
+    p.write_text("{not json")
+    c = autotune.TuningCache(path=str(p))
+    assert c.get("anything") is None
+    c.put("k", {"kernel": "x", "block_i": 8})
+    assert json.loads(p.read_text())["k"]["block_i"] == 8
+
+
+def test_engine_tunes_before_decode_trace(tmp_path, monkeypatch):
+    """The LLMEngine hook: a fused engine measures on first construction
+    and cache-hits on the second — with the decode program still
+    compiled exactly once each time (the winner installs BEFORE the one
+    decode trace)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.auto_tuner.tuner as tuner
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.serving import LLMEngine, ServingConfig
+
+    monkeypatch.setenv("PADDLE_TPU_TUNE_CACHE",
+                       str(tmp_path / "tuning_cache.json"))
+    calls = []
+    orig = tuner.run_timed_trial
+
+    def spy(step, args, steps=3, warmup=1):
+        calls.append(1)
+        return float(len(calls))  # first candidate (full width) wins
+
+    monkeypatch.setattr(tuner, "run_timed_trial", spy)
+    paddle.seed(0)
+    model = llama_tiny()
+    model.eval()
+    cfg = ServingConfig(fused_decode_layer=True, page_size=8,
+                        num_pages=32, max_batch=4, max_new_tokens=4,
+                        max_seq_len=64)
+    kern.force_interpret(True)
+    try:
+        eng = LLMEngine(model, cfg)
+        assert eng.tuning is not None
+        n_measured = len(calls)
+        assert n_measured > 0
+        out1 = eng.generate([1, 2, 3, 4])
+        stats1 = eng.program_stats()
+        eng.shutdown(drain=True)
+
+        eng2 = LLMEngine(model, cfg)
+        assert len(calls) == n_measured, \
+            "second engine must cache-hit with zero run_timed_trial calls"
+        assert eng2.tuning["block_i"] == eng.tuning["block_i"]
+        out2 = eng2.generate([1, 2, 3, 4])
+        stats2 = eng2.program_stats()
+        eng2.shutdown(drain=True)
+    finally:
+        kern.force_interpret(False)
+        kern.set_block_override(BLOCK_I_KEY, None)
+        monkeypatch.setattr(tuner, "run_timed_trial", orig)
+    assert out1 == out2
+    assert stats1["decode"]["compiles"] == 1
+    assert stats2["decode"]["compiles"] == 1
+    assert stats1["decode"]["retraces"] == stats2["decode"]["retraces"] == 0
+
+
+def test_real_measurement_roundtrip_interpret(cache):
+    """One REAL (no fake trial) measurement at tiny dims through the
+    interpreter: the shared timing protocol runs the actual kernel and
+    the persisted entry round-trips."""
+    entry = _tune(cache, None)
+    assert entry is not None
+    assert entry["block_i"] in (8, 16, 32, 64)
+    assert entry["ms"] > 0
+    assert set(entry["timings_ms"]) == {"8", "16", "32", "64"}
+    stats = cache.stats()
+    assert stats["misses"] == 1 and stats["measure_seconds"] > 0
+
+
+# -- cost-model join ----------------------------------------------------------
+
+def test_kernel_cost_prefers_measured(tmp_path, monkeypatch):
+    from paddle_tpu.cost_model import kernel_cost
+    from paddle_tpu.ops.kernels import decode_layer_pallas as dlp
+
+    monkeypatch.setenv("PADDLE_TPU_TUNE_CACHE",
+                       str(tmp_path / "tuning_cache.json"))
+    cost = kernel_cost(dlp, chip="v5e")
+    sheet = next(s for s in cost["kernels"]
+                 if s["kernel"] == "block_decode_layer")
+    assert sheet["cost_source"] == "roofline"
+    assert sheet["predicted_ms"] > 0
+    assert "measured_ms" not in sheet
+
+    # plant a measured entry; the sheet flips to measured + the ratio
+    cache = autotune.default_cache()
+    cache.put("somekey", {"kernel": "block_decode_layer", "chip": "v5e",
+                          "block_i": 32, "ms": sheet["predicted_ms"] * 2,
+                          "measured_at": 1.0})
+    cost2 = kernel_cost(dlp, chip="v5e")
+    sheet2 = next(s for s in cost2["kernels"]
+                  if s["kernel"] == "block_decode_layer")
+    assert sheet2["cost_source"] == "measured"
+    assert sheet2["measured_ms"] == pytest.approx(
+        sheet["predicted_ms"] * 2)
+    assert sheet2["tuned_block"] == 32
+    assert sheet2["predicted_vs_measured"] == pytest.approx(0.5, abs=1e-3)
+
+
+def test_lookup_measured_latest_wins(cache):
+    cache.put("a", {"kernel": "block_decode_layer", "chip": "v5e",
+                    "block_i": 8, "ms": 1.0, "measured_at": 1.0})
+    cache.put("b", {"kernel": "block_decode_layer", "chip": "v5e",
+                    "block_i": 16, "ms": 2.0, "measured_at": 2.0})
+    cache.put("c", {"kernel": "block_decode_layer", "chip": "v6e",
+                    "block_i": 32, "ms": 3.0, "measured_at": 3.0})
+    got = autotune.lookup_measured("block_decode_layer", chip="v5e",
+                                   cache=cache)
+    assert got["block_i"] == 16, "most recent entry for the chip wins"
+    assert autotune.lookup_measured("nope", chip="v5e", cache=cache) \
+        is None
+
+
+def test_roofline_ms_uses_hbm_bandwidth():
+    from paddle_tpu.cost_model.collective import CHIP_PRESETS, roofline_ms
+    for chip, spec in CHIP_PRESETS.items():
+        assert spec["hbm_gbps"] > 0
+    # memory-bound: 1 GB at v5e's 820 GB/s ~ 1.22 ms
+    assert roofline_ms(1.0, 1e9, "v5e") == pytest.approx(1e3 / 820.0)
+    # compute-bound: 197 TFLOP at 197 TFLOP/s = 1 s
+    assert roofline_ms(197e12, 1, "v5e") == pytest.approx(1000.0)
+
+
+# -- perf gate: predicted-vs-measured tolerance, both directions --------------
+
+def _perf_gate():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "tools", "perf_gate.py")
+    spec = importlib.util.spec_from_file_location("perf_gate_mod20t", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_gate_kernel_pred_both_directions(monkeypatch):
+    pg = _perf_gate()
+
+    def gate(ratio):
+        return pg.kernel_pred_gate({"extra": {"plan": {
+            "kernel_calibration": {
+                "source": "tuning_cache",
+                "ratios": {"block_decode_layer": ratio}}}}})
+
+    assert gate(1.0) == []
+    assert gate(1.9) == []
+    assert gate(0.55) == []
+    over = gate(2.5)       # static model overpredicts
+    assert over and "kernel-pred" in over[0] and "overpredicts" in over[0]
+    under = gate(0.3)      # kernel far off its roofline
+    assert under and "roofline" in under[0]
+
+    # rounds with no tuning-backed calibration pass trivially
+    assert pg.kernel_pred_gate({"extra": {}}) == []
+    assert pg.kernel_pred_gate({"extra": {"plan": {}}}) == []
+
+    # tolerance knob, and <= 0 disables
+    monkeypatch.setenv("PERF_GATE_KERNEL_PRED_TOL_X", "3")
+    assert gate(2.5) == []
+    monkeypatch.setenv("PERF_GATE_KERNEL_PRED_TOL_X", "0")
+    assert gate(100.0) == []

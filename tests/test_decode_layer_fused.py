@@ -1,0 +1,327 @@
+"""Whole-decode-layer mega-kernel (ops/kernels/decode_layer_pallas).
+
+Interpret-mode parity vs the composite reference (the parity oracle),
+the whole-layer VMEM dispatch gate, serving token-exactness with the
+decode program compiled exactly once and zero leaked/lost pages —
+composed with prefix-cache COW, chunked prefill, speculation, and
+weight-only int8 — the PK200 VMEM residency bound on every chip preset,
+the reconcile view's ``decode-layer [fused]`` cluster, and the
+perf-gate directions for the fused-decode serve sub-block.
+"""
+
+import copy
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.kernels import _common as kern
+from paddle_tpu.ops.kernels import decode_layer_pallas as dlp
+
+
+@pytest.fixture
+def interpret():
+    kern.force_interpret(True)
+    try:
+        yield
+    finally:
+        kern.force_interpret(False)
+
+
+@pytest.fixture
+def no_tune(monkeypatch, tmp_path):
+    """Serving tests skip autotune measurement (the cache round-trip has
+    its own suite) and never touch the user's cache file."""
+    monkeypatch.setenv("PADDLE_TPU_TUNE", "0")
+    monkeypatch.setenv("PADDLE_TPU_TUNE_CACHE",
+                       str(tmp_path / "tuning_cache.json"))
+
+
+def _layer_args(b=2, h=4, h_kv=2, d=16, ps=8, pages=8, n_tab=4, i=64,
+                seed=0):
+    rng = np.random.default_rng(seed)
+    hd = h * d
+    f32 = jnp.float32
+
+    def mk(*shape, scale=1.0):
+        return jnp.asarray(rng.standard_normal(shape) * scale, f32)
+
+    # each row steers through its own shuffled non-trash pages (rows may
+    # share pages — the kernel only ever READS them); positions mid-page
+    tab = jnp.asarray(
+        np.stack([rng.choice(pages - 1, n_tab, replace=False) + 1
+                  for _ in range(b)]), jnp.int32)
+    pos = jnp.asarray(rng.integers(ps, n_tab * ps, size=b), jnp.int32)
+    return dict(
+        q=mk(b, h, d), k_layer=mk(pages, h_kv, ps, d),
+        v_layer=mk(pages, h_kv, ps, d), tables=tab, pos=pos,
+        hres=mk(b, hd), wo=mk(h * d, hd, scale=0.05),
+        w_post=jnp.asarray(1.0 + 0.1 * rng.standard_normal(hd), f32),
+        wg=mk(hd, i, scale=0.05), wu=mk(hd, i, scale=0.05),
+        wd=mk(i, hd, scale=0.05),
+        w_next=jnp.asarray(1.0 + 0.1 * rng.standard_normal(hd), f32))
+
+
+@pytest.mark.parametrize("dims", [
+    dict(),                                      # GQA rep=2
+    dict(h=4, h_kv=4),                           # MHA rep=1
+    dict(h=8, h_kv=1, d=8),                      # extreme GQA rep=8
+    dict(b=3, n_tab=3, ps=16, pages=6),          # odd batch, wide pages
+])
+def test_kernel_parity_vs_composite(interpret, dims):
+    a = _layer_args(**dims)
+    y, h = dlp.decode_layer(**a, interpret=True)
+    yr, hr = dlp.reference_decode_layer(**a)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(hr), atol=2e-5)
+
+
+def test_kernel_parity_block_i_chunked(interpret):
+    """Every legal MLP column chunk computes the same layer output —
+    block_i is a pure schedule knob, never a semantics knob."""
+    a = _layer_args(i=64)
+    yr, hr = dlp.reference_decode_layer(**a)
+    for bi in (8, 16, 32, 64):
+        y, h = dlp.decode_layer(**a, block_i=bi, interpret=True)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   atol=2e-5, err_msg=f"block_i={bi}")
+        np.testing.assert_allclose(np.asarray(h), np.asarray(hr),
+                                   atol=2e-5, err_msg=f"block_i={bi}")
+
+
+def test_block_i_override_clamped_to_divisor(interpret):
+    """A measured override that does not divide the intermediate size is
+    clamped to the nearest smaller divisor, never trusted blindly."""
+    kern.set_block_override(dlp.BLOCK_I_KEY, 48)  # 48 does not divide 64
+    try:
+        assert dlp._pick_block_i(64) == 32
+        assert dlp._pick_block_i(48) == 48
+        a = _layer_args(i=64)
+        y, _ = dlp.decode_layer(**a, interpret=True)
+        yr, _ = dlp.reference_decode_layer(**a)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   atol=2e-5)
+    finally:
+        kern.set_block_override(dlp.BLOCK_I_KEY, None)
+
+
+def test_use_kernel_gate():
+    assert not dlp.use_kernel((2, 4, 16), (8, 2, 8, 16), 4, 64, 64), \
+        "no TPU and no interpret hook: the kernel must not dispatch"
+    kern.force_interpret(True)
+    try:
+        assert dlp.use_kernel((2, 4, 16), (8, 2, 8, 16), 4, 64, 64)
+        # head-dim mismatch / non-divisible GQA / tiny pages all bail
+        assert not dlp.use_kernel((2, 4, 16), (8, 2, 8, 32), 4, 64, 64)
+        assert not dlp.use_kernel((2, 3, 16), (8, 2, 8, 16), 4, 48, 64)
+        assert not dlp.use_kernel((2, 4, 16), (8, 2, 4, 16), 4, 64, 64)
+        # a serving-scale hidden size blows the whole-layer VMEM budget
+        assert not dlp.use_kernel((8, 32, 128), (256, 32, 16, 128), 16,
+                                  4096, 11008)
+    finally:
+        kern.force_interpret(False)
+
+
+# -- serving: token-exact, compiled once, composed with everything -----------
+
+_SERVE_CFG = dict(page_size=8, num_pages=32, max_batch=4,
+                  max_new_tokens=6, max_seq_len=64)
+_PROMPTS = [[3, 5, 7, 11], [2, 4, 6], [9, 9, 1, 2, 3]]
+
+
+def _ab_engines(no_tune_marker, extra_cfg=None, prompts=_PROMPTS):
+    """(fused tokens, composite tokens, fused stats, fused summary) on
+    identical engines — composite under real CPU, fused under the
+    interpreter (the only way the kernel runs off-TPU)."""
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.serving import LLMEngine, ServingConfig
+
+    paddle.seed(0)
+    model = llama_tiny()
+    model.eval()
+    extra = extra_cfg or {}
+
+    kern.force_interpret(False)
+    ref_eng = LLMEngine(model, ServingConfig(
+        fused_decode_layer=False, **_SERVE_CFG, **extra))
+    ref = [ref_eng.generate(p) for p in prompts]
+    ref_eng.shutdown(drain=True)
+
+    kern.force_interpret(True)
+    try:
+        eng = LLMEngine(model, ServingConfig(
+            fused_decode_layer=True, **_SERVE_CFG, **extra))
+        assert eng._sm._fused_layer_active()
+        out = [eng.generate(p) for p in prompts]
+        stats = eng.program_stats()
+        summary = eng.shutdown(drain=True)
+        lost = eng.pool.lost()
+    finally:
+        kern.force_interpret(False)
+    return out, ref, stats, summary, lost
+
+
+def test_serving_fused_layer_token_exact_zero_retrace(no_tune):
+    out, ref, stats, summary, lost = _ab_engines(no_tune)
+    assert out == ref
+    assert stats["decode"]["compiles"] == 1
+    assert stats["decode"]["retraces"] == 0
+    assert summary["pages_leaked"] == 0
+    assert lost == 0
+
+
+@pytest.mark.parametrize("name,extra", [
+    ("prefix_cache_cow", dict(prefix_cache=True)),
+    ("chunked_prefill", dict(prefill_chunk=4)),
+    ("speculation", dict(spec_k=3)),
+    ("int8", dict(quant="weight_only_int8")),
+])
+def test_serving_fused_layer_composes(no_tune, name, extra):
+    """The mega-kernel must ride every serving feature unchanged: COW'd
+    shared prefixes, chunked prefill, the speculative verify program
+    (untouched — it stays on the composite path), and weight-only int8
+    (the kernel consumes dequantized weight VALUES)."""
+    prompts = _PROMPTS
+    if name == "prefix_cache_cow":
+        shared = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+        prompts = [shared + [11], shared + [12, 13], [2, 4, 6]]
+    out, ref, stats, summary, lost = _ab_engines(
+        no_tune, extra_cfg=extra, prompts=prompts)
+    assert out == ref, f"{name}: fused path diverged from composite"
+    assert stats["decode"]["retraces"] == 0
+    assert summary["pages_leaked"] == 0
+    assert lost == 0
+
+
+def test_serving_env_escape_hatch(no_tune, monkeypatch):
+    """PADDLE_TPU_FUSED_DECODE=0 disables the fused layer even when the
+    config asks for it — the documented rollback lever."""
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.serving.model import ServingModel
+    monkeypatch.setenv("PADDLE_TPU_FUSED_DECODE", "0")
+    kern.force_interpret(True)
+    try:
+        sm = ServingModel(llama_tiny(), fused_decode_layer=True)
+        assert sm._fused_decode_layer
+        assert not sm._fused_layer_active()
+    finally:
+        kern.force_interpret(False)
+
+
+def test_serving_flag_off_on_bare_cpu():
+    from paddle_tpu.models import llama_tiny
+    from paddle_tpu.serving.model import ServingModel
+    sm = ServingModel(llama_tiny(), fused_decode_layer=True)
+    assert not sm._fused_layer_active()  # no TPU, no interpret hook
+
+
+# -- PK tier: resource sheet + VMEM residency on every preset -----------------
+
+def test_pk200_fits_vmem_on_every_chip_preset():
+    """The pk_examples shape must hold the PK200 whole-layer VMEM bound
+    on EVERY CHIP_PRESETS entry (ISSUE 20 acceptance)."""
+    from paddle_tpu.cost_model import kernel_cost
+    from paddle_tpu.cost_model.collective import CHIP_PRESETS
+    for chip in CHIP_PRESETS:
+        cost = kernel_cost(dlp, chip=chip)
+        sheets = [s for s in cost["kernels"]
+                  if s["kernel"] == "block_decode_layer"]
+        assert sheets, f"{chip}: no block_decode_layer sheet"
+        for s in sheets:
+            assert s["fits_vmem"], (
+                f"{chip}: decode-layer kernel blows VMEM "
+                f"({s['vmem_bytes']} > {s['vmem_budget']})")
+
+
+def test_sheet_carries_roofline_prediction():
+    from paddle_tpu.cost_model import kernel_cost
+    cost = kernel_cost(dlp, chip="v5e")
+    s = next(s for s in cost["kernels"]
+             if s["kernel"] == "block_decode_layer")
+    assert s["predicted_ms"] > 0
+    assert s["cost_source"] in ("roofline", "measured")
+
+
+# -- reconcile view: the decode-layer cluster is harvested --------------------
+
+def test_fusion_marks_decode_layer_cluster_fused():
+    from paddle_tpu.analysis.graph.fusion import (fusion_candidates,
+                                                  fusion_groups,
+                                                  is_mega_kernel)
+    from paddle_tpu.analysis.graph.ir import build_graph
+    assert is_mega_kernel("block_decode_layer")
+
+    a = _layer_args()
+    kern.force_dispatch(True)
+    try:
+        with kern.x64_off():
+            cj = jax.jit(lambda kw: dlp.decode_layer(**kw)).trace(a).jaxpr
+        g = build_graph(cj)
+    finally:
+        kern.force_dispatch(False)
+    groups, node_group = fusion_groups(g)
+    cands = fusion_candidates(g, groups, node_group, min_bytes=1)
+    dl = [c for c in cands if c.name == "decode-layer"]
+    assert dl, "no decode-layer cluster in the reconcile view"
+    assert all(c.fused for c in dl), \
+        "the decode-layer mega-kernel cluster must be marked harvested"
+
+
+# -- perf gate: fused-decode serve sub-block directions -----------------------
+
+def _perf_gate():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "tools", "perf_gate.py")
+    spec = importlib.util.spec_from_file_location("perf_gate_mod20", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_gate_fused_decode_directions():
+    pg = _perf_gate()
+    ok = {"decode_program": {"retraces_after_warmup": 0},
+          "pages_leaked": 0, "pages_lost": 0, "tokens_per_s": 50.0}
+    good = dict(ok, fused_decode={
+        "fused_on": dict(ok, tpot_ms={"p50": 4.0}, fused_active=True,
+                         tuned_block_i=256),
+        "fused_off": dict(ok, tpot_ms={"p50": 5.0})})
+
+    def gates(serve):
+        return pg.serve_gates({"extra": {"serve": serve}}, {})
+
+    hard, soft = gates(good)
+    assert hard == [] and soft == []
+
+    bad = copy.deepcopy(good)
+    bad["fused_decode"]["fused_on"]["pages_leaked"] = 1
+    hard, _ = gates(bad)
+    assert any("SERVE-LEAK" in m and "fused_on" in m for m in hard)
+
+    bad = copy.deepcopy(good)
+    bad["fused_decode"]["fused_on"]["decode_program"][
+        "retraces_after_warmup"] = 2
+    hard, _ = gates(bad)
+    assert any("SERVE-RETRACE" in m and "fused_on" in m for m in hard)
+
+    bad = copy.deepcopy(good)
+    bad["fused_decode"]["fused_on"]["pages_lost"] = 1
+    hard, _ = gates(bad)
+    assert any("SERVE-LOST" in m and "fused_on" in m for m in hard)
+
+    # soft: fused p50 TPOT beyond the composite + tolerance regresses
+    bad = copy.deepcopy(good)
+    bad["fused_decode"]["fused_on"]["tpot_ms"]["p50"] = 9.0
+    _, soft = gates(bad)
+    assert any("decode-fused-tpot" in m for m in soft)
+
+    # inactive kernel (CPU round): the TPOT comparison is noise — no gate
+    bad = copy.deepcopy(bad)
+    bad["fused_decode"]["fused_on"]["fused_active"] = False
+    _, soft = gates(bad)
+    assert not any("decode-fused-tpot" in m for m in soft)
